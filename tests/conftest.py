@@ -1,0 +1,41 @@
+"""Test session setup: force an 8-device virtual CPU platform BEFORE jax import.
+
+Mirrors the reference's cluster-free multi-process testing (2-proc Gloo pool,
+reference tests/unittests/conftest.py:28-63) the JAX way: one process, 8
+virtual CPU devices via ``--xla_force_host_platform_device_count``, meshes +
+``shard_map`` standing in for process groups.
+"""
+
+import os
+import sys
+
+# must happen before the first jax backend initialization (jax itself may
+# already be imported by the environment's sitecustomize)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+NUM_DEVICES = 8
+NUM_PROCESSES = 2  # emulated world size for rank-strided DDP-style tests
+NUM_BATCHES = 4  # keep divisible by emulated world size
+BATCH_SIZE = 32
+NUM_CLASSES = 5
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+
+
+def setup_ddp():
+    assert len(jax.devices()) == NUM_DEVICES, (
+        f"expected {NUM_DEVICES} virtual devices, got {len(jax.devices())}: {jax.devices()}"
+    )
+
+
+def pytest_configure(config):
+    setup_ddp()

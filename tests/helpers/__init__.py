@@ -1,0 +1,11 @@
+import os
+import random
+
+import numpy as np
+
+
+def seed_all(seed: int = 42) -> None:
+    """Deterministic seeding for tests (reference tests/unittests/helpers/__init__.py:20-25)."""
+    random.seed(seed)
+    np.random.seed(seed)
+    os.environ["PYTHONHASHSEED"] = str(seed)

@@ -1,0 +1,93 @@
+"""Aggregation metric tests (counterpart of reference tests/unittests/bases/test_aggregation.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics.aggregation import (
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MinMetric,
+    RunningMean,
+    RunningSum,
+    SumMetric,
+)
+
+
+@pytest.mark.parametrize(
+    ("metric_cls", "np_fn"),
+    [
+        (SumMetric, np.sum),
+        (MeanMetric, np.mean),
+        (MaxMetric, np.max),
+        (MinMetric, np.min),
+    ],
+)
+def test_aggregator_vs_numpy(metric_cls, np_fn):
+    rng = np.random.default_rng(0)
+    values = rng.normal(size=(4, 16)).astype(np.float32)
+    metric = metric_cls()
+    for row in values:
+        metric.update(jnp.asarray(row))
+    assert np.allclose(float(metric.compute()), np_fn(values), atol=1e-6)
+
+
+def test_cat_metric():
+    metric = CatMetric()
+    metric.update(1.0)
+    metric.update(jnp.asarray([2.0, 3.0]))
+    assert metric.compute().tolist() == [1.0, 2.0, 3.0]
+
+
+def test_mean_metric_weighted():
+    metric = MeanMetric()
+    metric.update(1.0, weight=2.0)
+    metric.update(3.0, weight=6.0)
+    # (1*2 + 3*6) / 8 = 2.5
+    assert float(metric.compute()) == 2.5
+
+
+@pytest.mark.parametrize("metric_cls", [SumMetric, MeanMetric, MaxMetric, MinMetric])
+def test_nan_error_strategy(metric_cls):
+    metric = metric_cls(nan_strategy="error")
+    with pytest.raises(RuntimeError, match="nan"):
+        metric.update(jnp.asarray([1.0, float("nan")]))
+
+
+def test_nan_ignore_strategy():
+    metric = SumMetric(nan_strategy="ignore")
+    metric.update(jnp.asarray([1.0, float("nan"), 2.0]))
+    assert float(metric.compute()) == 3.0
+
+
+def test_nan_impute_strategy():
+    metric = SumMetric(nan_strategy=10.0)
+    metric.update(jnp.asarray([1.0, float("nan"), 2.0]))
+    assert float(metric.compute()) == 13.0
+
+
+def test_invalid_nan_strategy():
+    with pytest.raises(ValueError, match="nan_strategy"):
+        SumMetric(nan_strategy="whatever")
+
+
+def test_running_sum():
+    metric = RunningSum(window=3)
+    for i in range(6):
+        metric.update(jnp.asarray(float(i)))
+    assert float(metric.compute()) == 3.0 + 4.0 + 5.0
+
+
+def test_running_mean():
+    metric = RunningMean(window=2)
+    for i in range(4):
+        metric.update(jnp.asarray(float(i)))
+    assert float(metric.compute()) == 2.5
+
+
+def test_running_forward_returns_batch_value():
+    metric = RunningSum(window=3)
+    vals = [float(metric(jnp.asarray(float(i)))) for i in range(6)]
+    assert vals == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+    assert float(metric.compute()) == 12.0

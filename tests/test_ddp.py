@@ -1,0 +1,121 @@
+"""Distributed sync tests over the virtual 8-device CPU mesh.
+
+Counterpart of reference tests/unittests/bases/test_ddp.py:33-274, exercised
+through shard_map collectives (the ICI path) and the pure merge helper (the
+DCN path).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tests.test_metric import DummyListMetric, DummyMeanMetric, DummyMetric
+from tpumetrics.parallel import AxisBackend
+from tpumetrics.parallel.merge import merge_metric_states
+
+from tests.helpers.testers import shard_map
+
+
+def _mesh(ws):
+    return Mesh(np.array(jax.devices()[:ws]), ("r",))
+
+
+@pytest.mark.parametrize("world_size", [2, 4, 8])
+def test_sum_state_psum_inside_shard_map(world_size):
+    metric = DummyMetric()
+
+    def run(x):
+        state = metric.init_state()
+        state = metric.functional_update(state, x[0])
+        return metric.functional_compute(state, axis_name="r")
+
+    xs = jnp.arange(world_size, dtype=jnp.float32).reshape(world_size, 1)
+    out = jax.jit(shard_map(run, mesh=_mesh(world_size), in_specs=P("r"), out_specs=P()))(xs)
+    assert float(out) == sum(range(world_size))
+
+
+@pytest.mark.parametrize("world_size", [2, 4])
+def test_cat_state_all_gather_inside_shard_map(world_size):
+    metric = DummyListMetric()
+
+    def run(x):
+        state = metric.init_state()
+        state = metric.functional_update(state, x[0])
+        return metric.functional_compute(state, axis_name="r")
+
+    xs = jnp.arange(world_size * 3, dtype=jnp.float32).reshape(world_size, 3)
+    out = jax.jit(shard_map(run, mesh=_mesh(world_size), in_specs=P("r"), out_specs=P()))(xs)
+    assert out.tolist() == list(range(world_size * 3))
+
+
+def test_mean_metric_distributed_equals_global():
+    ws = 4
+    metric = DummyMeanMetric()
+
+    def run(x):
+        state = metric.init_state()
+        state = metric.functional_update(state, x[0])
+        return metric.functional_compute(state, axis_name="r")
+
+    rng = np.random.default_rng(1)
+    data = rng.normal(size=(ws, 16)).astype(np.float32)
+    out = jax.jit(shard_map(run, mesh=_mesh(ws), in_specs=P("r"), out_specs=P()))(jnp.asarray(data))
+    assert np.allclose(float(out), data.mean(), atol=1e-6)
+
+
+def test_merge_metric_states_sum_and_cat():
+    m1, m2 = DummyMetric(), DummyMetric()
+    m1.update(1.0)
+    m2.update(2.0)
+    merged = merge_metric_states([m1.metric_state(), m2.metric_state()], m1._reductions)
+    assert float(merged["x"]) == 3.0
+
+    l1, l2 = DummyListMetric(), DummyListMetric()
+    l1.update(jnp.asarray([1.0, 2.0]))
+    l2.update(jnp.asarray([3.0]))
+    merged = merge_metric_states([l1.metric_state(), l2.metric_state()], l1._reductions)
+    assert merged["x"][0].tolist() == [1.0, 2.0, 3.0]
+
+
+def test_merge_empty_list_states():
+    l1, l2 = DummyListMetric(), DummyListMetric()
+    merged = merge_metric_states([l1.metric_state(), l2.metric_state()], l1._reductions)
+    assert merged["x"] == []
+
+
+def test_eager_sync_with_custom_dist_fn():
+    """Emulate a 2-rank gather through the dist_sync_fn injection point
+    (reference test_ddp.py:33-59)."""
+    metric = DummyMetric(
+        distributed_available_fn=lambda: True,
+        dist_sync_fn=lambda x, group: [x, x],  # each rank contributes the same value
+    )
+    metric.update(3.0)
+    assert float(metric.compute()) == 6.0
+    # after compute, state is unsynced back to the local value
+    assert float(metric.x) == 3.0
+
+
+def test_eager_sync_cat_with_custom_dist_fn():
+    metric = DummyListMetric(
+        distributed_available_fn=lambda: True,
+        dist_sync_fn=lambda x, group: [x, x + 10.0],
+    )
+    metric.update(jnp.asarray([1.0, 2.0]))
+    out = metric.compute()
+    assert out.tolist() == [1.0, 2.0, 11.0, 12.0]
+    assert [v.tolist() for v in metric.x] == [[1.0, 2.0]]
+
+
+def test_axis_backend_world_size_and_allreduce():
+    ws = 4
+
+    def run(x):
+        backend = AxisBackend("r", axis_size=ws)
+        return backend.all_reduce(x[0, 0], "max")
+
+    xs = jnp.arange(ws, dtype=jnp.float32).reshape(ws, 1)
+    out = jax.jit(shard_map(run, mesh=_mesh(ws), in_specs=P("r"), out_specs=P()))(xs)
+    assert float(out) == ws - 1

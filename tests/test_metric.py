@@ -1,0 +1,244 @@
+"""Core Metric lifecycle tests (counterpart of reference tests/unittests/bases/test_metric.py)."""
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics import Metric
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+
+class DummyMetric(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.x = self.x + jnp.asarray(x, dtype=jnp.float32)
+
+    def compute(self):
+        return self.x
+
+
+class DummyListMetric(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", default=[], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.x.append(jnp.asarray(x, dtype=jnp.float32))
+
+    def compute(self):
+        from tpumetrics.utils.data import dim_zero_cat
+
+        if isinstance(self.x, list) and not self.x:
+            return jnp.zeros((0,))
+        return dim_zero_cat(self.x)
+
+
+class DummyMeanMetric(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("count", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        x = jnp.asarray(x, dtype=jnp.float32)
+        self.total = self.total + jnp.sum(x)
+        self.count = self.count + x.size
+
+    def compute(self):
+        return self.total / self.count
+
+
+def test_add_state_validation():
+    m = DummyMetric()
+    with pytest.raises(ValueError):
+        m.add_state("bad name", jnp.asarray(0.0), "sum")
+    with pytest.raises(ValueError):
+        m.add_state("ok", [1, 2], "cat")
+    with pytest.raises(ValueError):
+        m.add_state("ok", jnp.asarray(0.0), "unknown_reduce")
+
+
+def test_update_and_compute():
+    m = DummyMetric()
+    m.update(1.0)
+    m.update(2.0)
+    assert float(m.compute()) == 3.0
+    assert m.update_count == 2
+
+
+def test_reset():
+    m = DummyMetric()
+    m.update(5.0)
+    m.reset()
+    assert float(m.x) == 0.0
+    assert m.update_count == 0
+
+    lm = DummyListMetric()
+    lm.update(1.0)
+    lm.reset()
+    assert lm.x == []
+
+
+def test_compute_cache():
+    m = DummyMetric()
+    m.update(1.0)
+    v1 = m.compute()
+    assert m._computed is not None
+    m.update(1.0)  # invalidates cache
+    assert m._computed is None
+    assert float(m.compute()) == 2.0
+
+
+def test_compute_without_update_warns():
+    m = DummyMetric()
+    with pytest.warns(UserWarning, match="called before"):
+        m.compute()
+
+
+def test_forward_returns_batch_value_and_accumulates():
+    m = DummyMeanMetric()
+    batch1 = m(jnp.asarray([1.0, 1.0]))
+    assert float(batch1) == 1.0
+    batch2 = m(jnp.asarray([3.0, 3.0]))
+    assert float(batch2) == 3.0
+    assert float(m.compute()) == 2.0  # global mean over both batches
+
+
+def test_forward_full_state_update_flag():
+    class FullState(DummyMeanMetric):
+        full_state_update = True
+
+    m = FullState()
+    assert float(m(jnp.asarray([1.0, 1.0]))) == 1.0
+    assert float(m(jnp.asarray([3.0, 3.0]))) == 3.0
+    assert float(m.compute()) == 2.0
+
+
+def test_const_attr_guard():
+    m = DummyMetric()
+    with pytest.raises(RuntimeError):
+        m.full_state_update = True
+    with pytest.raises(RuntimeError):
+        m.higher_is_better = False
+
+
+def test_pickle_roundtrip():
+    m = DummyMetric()
+    m.update(2.0)
+    m2 = pickle.loads(pickle.dumps(m))
+    assert float(m2.compute()) == 2.0
+    m2.update(1.0)
+    assert float(m2.compute()) == 3.0
+
+
+def test_clone_is_independent():
+    m = DummyMetric()
+    m.update(1.0)
+    m2 = m.clone()
+    m2.update(1.0)
+    assert float(m.compute()) == 1.0
+    assert float(m2.compute()) == 2.0
+
+
+def test_state_dict_persistence():
+    m = DummyMetric()
+    assert m.state_dict() == {}
+    m.persistent(True)
+    m.update(3.0)
+    sd = m.state_dict()
+    assert float(sd["x"]) == 3.0
+    m2 = DummyMetric()
+    m2.persistent(True)
+    m2.load_state_dict(sd)
+    assert float(m2.x) == 3.0
+
+
+def test_double_sync_raises():
+    m = DummyMetric(distributed_available_fn=lambda: True)
+    m.update(1.0)
+    m.sync()
+    with pytest.raises(TPUMetricsUserError):
+        m.sync()
+    m.unsync()
+    with pytest.raises(TPUMetricsUserError):
+        m.unsync()
+
+
+def test_sync_context_restores_state():
+    m = DummyMetric(distributed_available_fn=lambda: True)
+    m.update(2.0)
+    with m.sync_context():
+        assert float(m.x) == 2.0  # world size 1: sync is identity
+    assert not m._is_synced
+    assert float(m.x) == 2.0
+
+
+def test_set_dtype():
+    m = DummyMetric()
+    m.update(1.0)
+    m.set_dtype(jnp.bfloat16)
+    assert m.x.dtype == jnp.bfloat16
+    m.float()
+    assert m.x.dtype == jnp.float32
+
+
+def test_functional_bridge_jit():
+    m = DummyMeanMetric()
+
+    @jax.jit
+    def step(state, x):
+        return m.functional_update(state, x)
+
+    state = m.init_state()
+    state = step(state, jnp.asarray([1.0, 2.0]))
+    state = step(state, jnp.asarray([3.0, 4.0]))
+    assert float(m.functional_compute(state)) == 2.5
+    # live object state untouched by the functional path
+    assert float(m.total) == 0.0
+
+
+def test_metric_state_and_repr():
+    m = DummyMetric()
+    m.update(1.0)
+    assert set(m.metric_state()) == {"x"}
+    assert "DummyMetric" in repr(m)
+
+
+def test_composition_operators():
+    a = DummyMetric()
+    b = DummyMetric()
+    comp = a + b
+    a.update(1.0)
+    b.update(2.0)
+    assert float(comp.compute()) == 3.0
+
+    comp2 = a * 2.0
+    assert float(comp2.compute()) == 2.0
+
+    comp3 = abs(a - b)
+    assert float(comp3.compute()) == 1.0
+
+
+def test_composition_forward_updates_children():
+    a = DummyMetric()
+    comp = a + 1.0
+    out = comp(1.0)
+    assert float(out) == 2.0
+    assert float(a.compute()) == 1.0
+
+
+def test_unexpected_kwargs_raise():
+    with pytest.raises(ValueError, match="Unexpected keyword"):
+        DummyMetric(not_a_real_kwarg=True)

@@ -1,0 +1,34 @@
+"""tpumetrics — a TPU-native metrics framework on JAX/XLA.
+
+Brand-new implementation of the capabilities of the reference TorchMetrics
+fork (/root/reference, v1.3.0dev): a core ``Metric`` engine with declared
+accumulator states and automatic cross-device synchronization, rebuilt
+idiomatically for TPU — state as ``jax.Array`` pytrees, updates that can run
+inside jitted/pjit-ed step functions, and sync lowered to XLA collectives
+over ICI/DCN instead of ``torch.distributed``.
+"""
+
+from tpumetrics.__about__ import __version__
+from tpumetrics.aggregation import (
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MinMetric,
+    RunningMean,
+    RunningSum,
+    SumMetric,
+)
+from tpumetrics.metric import CompositionalMetric, Metric
+
+__all__ = [
+    "CatMetric",
+    "CompositionalMetric",
+    "MaxMetric",
+    "MeanMetric",
+    "Metric",
+    "MinMetric",
+    "RunningMean",
+    "RunningSum",
+    "SumMetric",
+    "__version__",
+]

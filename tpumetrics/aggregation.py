@@ -1,0 +1,258 @@
+"""Aggregation metrics: Max / Min / Sum / Cat / Mean and running variants.
+
+Counterpart of reference ``src/torchmetrics/aggregation.py`` (BaseAggregator
+:30, MaxMetric :114, MinMetric :219, SumMetric :324, CatMetric :429,
+MeanMetric :493, RunningMean :616, RunningSum :673).
+
+NaN handling note (TPU): the "error"/"warn" strategies require a host
+read-back of the NaN mask and therefore only run in eager mode — when the
+input is a traced (jit) value they degrade gracefully to "ignore"
+semantics, which are implemented with masking and stay on device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+from tpumetrics.utils.prints import rank_zero_warn
+from tpumetrics.wrappers.running import Running
+
+Array = jax.Array
+
+
+class BaseAggregator(Metric):
+    """Base class for aggregation metrics: single state + configurable reduce fn
+    (reference aggregation.py:30-111)."""
+
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        fn: Union[str, Any],
+        default_value: Union[Array, list],
+        nan_strategy: Union[str, float] = "error",
+        state_name: str = "value",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_nan_strategy = ("error", "warn", "ignore", "disable")
+        if nan_strategy not in allowed_nan_strategy and not isinstance(nan_strategy, float):
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy} but got {nan_strategy}."
+            )
+        self.nan_strategy = nan_strategy
+        # fill value used for jit-safe NaN masking (identity element of the reduction)
+        self._traced_nan_fill = 0.0
+        self.add_state(state_name, default=default_value, dist_reduce_fx=fn)
+        self.state_name = state_name
+
+    def _cast_and_nan_check_input(self, x: Union[float, Array], weight: Optional[Union[float, Array]] = None) -> tuple:
+        """Cast to float arrays and apply the NaN policy (reference aggregation.py:75-105)."""
+        x = jnp.asarray(x, dtype=self._dtype)
+        if weight is None:
+            weight = jnp.ones_like(x)
+        weight = jnp.broadcast_to(jnp.asarray(weight, dtype=self._dtype), x.shape)
+
+        if self.nan_strategy == "disable":
+            return x, weight
+
+        nans = jnp.isnan(x)
+        wnans = jnp.isnan(weight)
+        anynan = jnp.logical_or(nans, wnans)
+
+        if isinstance(self.nan_strategy, float):
+            x = jnp.where(nans, self.nan_strategy, x)
+            weight = jnp.where(wnans, self.nan_strategy, weight)
+            return x, weight
+
+        is_traced = isinstance(anynan, jax.core.Tracer)
+        if not is_traced and bool(jnp.any(anynan)):
+            if self.nan_strategy == "error":
+                raise RuntimeError("Encountered `nan` values in tensor")
+            if self.nan_strategy == "warn":
+                rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+        if not is_traced and (self.nan_strategy in ("ignore", "warn")) and bool(jnp.any(anynan)):
+            keep = ~anynan
+            return x[keep], weight[keep]
+        if is_traced:
+            # jit-safe ignore: replace with the reduction's identity element and zero the weight
+            x = jnp.where(anynan, self._traced_nan_fill, x)
+            weight = jnp.where(anynan, 0.0, weight)
+        return x, weight
+
+    def update(self, value: Union[float, Array]) -> None:
+        """Overwritten in child classes (reference aggregation.py:106-108)."""
+
+    def compute(self) -> Array:
+        """Aggregated value."""
+        return getattr(self, self.state_name)
+
+
+class MaxMetric(BaseAggregator):
+    """Running max of a stream of values (reference aggregation.py:114-216).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.aggregation import MaxMetric
+        >>> metric = MaxMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.asarray([2.0, 3.0]))
+        >>> float(metric.compute())
+        3.0
+    """
+
+    full_state_update: bool = True
+    plot_lower_bound = None
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", -jnp.asarray(jnp.inf), nan_strategy, state_name="max_value", **kwargs)
+        self._traced_nan_fill = float("-inf")
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        if value.size:  # make sure an empty (fully-nan-filtered) batch is a no-op
+            self.max_value = jnp.maximum(self.max_value, jnp.max(value))
+
+
+class MinMetric(BaseAggregator):
+    """Running min of a stream of values (reference aggregation.py:219-321).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.aggregation import MinMetric
+        >>> metric = MinMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.asarray([2.0, 3.0]))
+        >>> float(metric.compute())
+        1.0
+    """
+
+    full_state_update: bool = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.asarray(jnp.inf), nan_strategy, state_name="min_value", **kwargs)
+        self._traced_nan_fill = float("inf")
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.min_value = jnp.minimum(self.min_value, jnp.min(value))
+
+
+class SumMetric(BaseAggregator):
+    """Running sum of a stream of values (reference aggregation.py:324-426).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.aggregation import SumMetric
+        >>> metric = SumMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.asarray([2.0, 3.0]))
+        >>> float(metric.compute())
+        6.0
+    """
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, state_name="sum_value", **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.sum_value = self.sum_value + jnp.sum(value)
+
+
+class CatMetric(BaseAggregator):
+    """Concatenate a stream of values (reference aggregation.py:429-490).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.aggregation import CatMetric
+        >>> metric = CatMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.asarray([2.0, 3.0]))
+        >>> metric.compute().tolist()
+        [1.0, 2.0, 3.0]
+    """
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value.append(value)
+
+    def compute(self) -> Array:
+        if isinstance(self.value, list) and self.value:
+            return dim_zero_cat(self.value)
+        return self.value
+
+
+class MeanMetric(BaseAggregator):
+    """(Weighted) running mean of a stream of values (reference aggregation.py:493-613).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.aggregation import MeanMetric
+        >>> metric = MeanMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.asarray([2.0, 3.0]))
+        >>> float(metric.compute())
+        2.0
+    """
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, state_name="mean_value", **kwargs)
+        self.add_state("weight", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> None:
+        """Accumulate weighted sum + total weight (reference aggregation.py:546-570)."""
+        value, weight = self._cast_and_nan_check_input(value, weight)
+        if value.size == 0:
+            return
+        self.mean_value = self.mean_value + jnp.sum(value * weight)
+        self.weight = self.weight + jnp.sum(weight)
+
+    def compute(self) -> Array:
+        return self.mean_value / self.weight
+
+
+class RunningMean(Running):
+    """Mean over a running window (reference aggregation.py:616-670).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.aggregation import RunningMean
+        >>> metric = RunningMean(window=2)
+        >>> for i in range(4):
+        ...     _ = metric.update(jnp.asarray(float(i)))
+        >>> float(metric.compute())  # mean of [2, 3]
+        2.5
+    """
+
+    def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__(base_metric=MeanMetric(nan_strategy=nan_strategy, **kwargs), window=window)
+
+
+class RunningSum(Running):
+    """Sum over a running window (reference aggregation.py:673-727).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.aggregation import RunningSum
+        >>> metric = RunningSum(window=2)
+        >>> for i in range(4):
+        ...     _ = metric.update(jnp.asarray(float(i)))
+        >>> float(metric.compute())  # 2 + 3
+        5.0
+    """
+
+    def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__(base_metric=SumMetric(nan_strategy=nan_strategy, **kwargs), window=window)

@@ -1,0 +1,993 @@
+"""The Metric base class — TPU-native core engine.
+
+Counterpart of the reference's ``src/torchmetrics/metric.py`` (Metric :50,
+add_state :194, forward :273, sync machinery :423-587, operator overloads
+:925-1060, CompositionalMetric :1075), redesigned for JAX/XLA rather than
+translated:
+
+- Metric state is a flat pytree of immutable ``jax.Array`` leaves (plus
+  Python lists of arrays for "cat"-style list states). Because arrays are
+  immutable, caching/restoring state for sync/unsync and forward's
+  double-compute is alias-free by construction — no defensive deep copies.
+- The stateful OO API (``m.update(...)``, ``m.compute()``, ``m(...)``)
+  matches the reference's ergonomics for eager/host-driven use.
+- A **functional bridge** (:meth:`Metric.init_state`,
+  :meth:`Metric.functional_update`, :meth:`Metric.functional_compute`)
+  exposes the same metric as pure functions over an explicit state pytree so
+  updates can live *inside* a jitted/`shard_map`-ed step function, with
+  cross-device sync lowered to single XLA collectives (psum/pmax/all_gather)
+  over a named mesh axis — the reference's eager
+  ``torch.distributed.all_gather`` + local reduce (metric.py:423-453) becomes
+  one fused ICI collective.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from abc import ABC, abstractmethod
+from contextlib import contextmanager
+from copy import deepcopy
+from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.parallel.backend import (
+    AxisBackend,
+    DistributedBackend,
+    distributed_available as _default_distributed_available,
+    get_default_backend,
+)
+from tpumetrics.utils.data import (
+    _flatten,
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+)
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+from tpumetrics.utils.prints import rank_zero_warn
+
+Array = jax.Array
+StateType = Union[Array, List[Array]]
+
+
+def jit_distributed_available() -> bool:
+    """Reference parity shim (reference metric.py:45-47)."""
+    return _default_distributed_available()
+
+
+def _squeeze_if_scalar(value: Any) -> Any:
+    """Collapse single-element arrays to 0-d arrays (reference utilities/data `_squeeze_if_scalar`)."""
+    def _sq(x: Any) -> Any:
+        if isinstance(x, jax.Array) and x.ndim > 0 and x.size == 1:
+            return jnp.reshape(x, ())
+        return x
+
+    return jax.tree_util.tree_map(_sq, value)
+
+
+_CONST_ATTRS = (
+    "higher_is_better",
+    "is_differentiable",
+    "full_state_update",
+    "plot_lower_bound",
+    "plot_upper_bound",
+    "plot_legend_name",
+)
+
+_REDUCE_FNS = {
+    "sum": dim_zero_sum,
+    "mean": dim_zero_mean,
+    "cat": dim_zero_cat,
+    "min": dim_zero_min,
+    "max": dim_zero_max,
+}
+
+
+class Metric(ABC):
+    """Base class for all metrics (reference metric.py:50).
+
+    Subclasses implement :meth:`update` and :meth:`compute`; states are
+    declared with :meth:`add_state` and accumulated across batches (and, at
+    sync points, across devices/hosts).
+
+    Args (all keyword-only, mirroring reference metric.py:112-147):
+        compute_on_cpu: move list states to host memory after each update.
+        dist_sync_on_step: synchronize state every ``forward`` call.
+        process_group: backend-specific group (mesh-axis name for AxisBackend).
+        dist_sync_fn: custom gather function ``(array, group) -> list[array]``.
+        distributed_available_fn: predicate deciding whether to sync.
+        sync_on_compute: synchronize automatically in ``compute`` (default True).
+        compute_with_cache: cache the ``compute`` result until next update.
+        sync_backend: explicit :class:`DistributedBackend` strategy; defaults
+            to the ambient backend (multi-host over DCN when running under
+            ``jax.distributed``, no-op single process). Pass
+            ``AxisBackend("dp")`` for in-trace ICI sync.
+    """
+
+    __jit_ignored_attributes__ = ["device", "dtype"]
+
+    is_differentiable: Optional[bool] = None
+    higher_is_better: Optional[bool] = None
+    full_state_update: Optional[bool] = None
+
+    plot_lower_bound: Optional[float] = None
+    plot_upper_bound: Optional[float] = None
+    plot_legend_name: Optional[str] = None
+
+    def __init__(self, **kwargs: Any) -> None:
+        self._dtype = jnp.float32
+
+        self.compute_on_cpu = kwargs.pop("compute_on_cpu", False)
+        if not isinstance(self.compute_on_cpu, bool):
+            raise ValueError(f"Expected keyword argument `compute_on_cpu` to be a `bool` but got {self.compute_on_cpu}")
+
+        self.dist_sync_on_step = kwargs.pop("dist_sync_on_step", False)
+        if not isinstance(self.dist_sync_on_step, bool):
+            raise ValueError(
+                f"Expected keyword argument `dist_sync_on_step` to be a `bool` but got {self.dist_sync_on_step}"
+            )
+
+        self.process_group = kwargs.pop("process_group", None)
+
+        self.dist_sync_fn = kwargs.pop("dist_sync_fn", None)
+        if self.dist_sync_fn is not None and not callable(self.dist_sync_fn):
+            raise ValueError(
+                f"Expected keyword argument `dist_sync_fn` to be a callable or None but got {self.dist_sync_fn}"
+            )
+
+        self.distributed_available_fn = kwargs.pop("distributed_available_fn", None) or _default_distributed_available
+
+        self.sync_on_compute = kwargs.pop("sync_on_compute", True)
+        if not isinstance(self.sync_on_compute, bool):
+            raise ValueError(
+                f"Expected keyword argument `sync_on_compute` to be a `bool` but got {self.sync_on_compute}"
+            )
+        self.compute_with_cache = kwargs.pop("compute_with_cache", True)
+        if not isinstance(self.compute_with_cache, bool):
+            raise ValueError(
+                f"Expected keyword argument `compute_with_cache` to be a `bool` but got {self.compute_with_cache}"
+            )
+
+        self.sync_backend: Optional[DistributedBackend] = kwargs.pop("sync_backend", None)
+
+        if kwargs:
+            kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
+            raise ValueError(f"Unexpected keyword arguments: {', '.join(kwargs_)}")
+
+        # state management
+        self._defaults: Dict[str, StateType] = {}
+        self._persistent: Dict[str, bool] = {}
+        self._reductions: Dict[str, Union[str, Callable, None]] = {}
+
+        self._update_signature = inspect.signature(self.update)
+        self.update: Callable = self._wrap_update(self.update)  # type: ignore[method-assign]
+        self.compute: Callable = self._wrap_compute(self.compute)  # type: ignore[method-assign]
+        self._computed: Any = None
+        self._forward_cache: Any = None
+        self._update_count = 0
+        self._to_sync = self.sync_on_compute
+        self._should_unsync = True
+
+        self._cache: Optional[Dict[str, StateType]] = None
+        self._is_synced = False
+
+    # ------------------------------------------------------------------ state
+
+    def add_state(
+        self,
+        name: str,
+        default: Union[Array, list, int, float],
+        dist_reduce_fx: Optional[Union[str, Callable]] = None,
+        persistent: bool = False,
+    ) -> None:
+        """Register an accumulator state (reference metric.py:194-271).
+
+        ``default`` is either an array (scalar allowed) for tensor states or
+        an empty list for "cat"-style list states. ``dist_reduce_fx`` is one
+        of ``"sum" | "mean" | "max" | "min" | "cat" | None`` or a custom
+        callable operating on a rank-stacked array.
+        """
+        if not name.isidentifier():
+            raise ValueError(f"Argument `name` must be a valid python identifier, got {name!r}")
+        if not isinstance(default, list):
+            default = jnp.asarray(default)
+            if jnp.issubdtype(default.dtype, jnp.floating):
+                default = default.astype(self._dtype)
+        elif default:
+            raise ValueError("state variable must be an array or an *empty* list (where you can append arrays)")
+
+        if dist_reduce_fx is not None and not (dist_reduce_fx in _REDUCE_FNS or callable(dist_reduce_fx)):
+            raise ValueError(
+                "`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]"
+            )
+        reduce_fn = _REDUCE_FNS.get(dist_reduce_fx, dist_reduce_fx) if isinstance(dist_reduce_fx, str) else dist_reduce_fx
+
+        self._defaults[name] = default
+        self._persistent[name] = persistent
+        self._reductions[name] = reduce_fn
+        object.__setattr__(self, name, [] if isinstance(default, list) else default)
+
+    @property
+    def _state_names(self) -> List[str]:
+        return list(self._defaults)
+
+    def metric_state(self) -> Dict[str, StateType]:
+        """Current state values as a dict pytree."""
+        return {attr: getattr(self, attr) for attr in self._defaults}
+
+    @property
+    def update_called(self) -> bool:
+        """Whether ``update``/``forward`` has been called since init/reset (reference metric.py)."""
+        return self._update_count > 0
+
+    @property
+    def update_count(self) -> int:
+        return self._update_count
+
+    def _copy_state_dict(self) -> Dict[str, StateType]:
+        """Snapshot of states. Arrays are immutable so aliasing is safe; lists are shallow-copied."""
+        return {
+            attr: list(val) if isinstance(val, list) else val for attr, val in self.metric_state().items()
+        }
+
+    # ---------------------------------------------------------------- forward
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Accumulate into the global state AND return the batch-local value
+        (reference metric.py:273-305)."""
+        if self._is_synced:
+            raise TPUMetricsUserError(
+                "The Metric shouldn't be synced when performing ``forward``. "
+                "HINT: Did you forget to call ``unsync``?"
+            )
+        if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
+            self._forward_cache = self._forward_full_state_update(*args, **kwargs)
+        else:
+            self._forward_cache = self._forward_reduce_state_update(*args, **kwargs)
+        return self._forward_cache
+
+    def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
+        """Two-pass forward: global update + fresh single-batch compute
+        (reference metric.py:307-350)."""
+        self.update(*args, **kwargs)
+        _update_count = self._update_count
+        self._to_sync = self.dist_sync_on_step
+        self._should_unsync = False
+        _temp_compute_on_cpu = self.compute_on_cpu
+        self.compute_on_cpu = False
+
+        cache = self._copy_state_dict()
+
+        self.reset()
+        self.update(*args, **kwargs)
+        batch_val = self.compute()
+
+        for attr, val in cache.items():
+            object.__setattr__(self, attr, val)
+        self._update_count = _update_count
+        self._is_synced = False
+        self._should_unsync = True
+        self._to_sync = self.sync_on_compute
+        self._computed = None
+        self.compute_on_cpu = _temp_compute_on_cpu
+        if self.compute_on_cpu:
+            self._move_list_states_to_cpu()
+        return batch_val
+
+    def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
+        """Single-pass forward: batch update on empty state then merge into the
+        global state (reference metric.py:352-390)."""
+        global_state = self._copy_state_dict()
+        _update_count = self._update_count
+        self.reset()
+
+        self._to_sync = self.dist_sync_on_step
+        self._should_unsync = False
+        _temp_compute_on_cpu = self.compute_on_cpu
+        self.compute_on_cpu = False
+
+        self.update(*args, **kwargs)
+        batch_val = self.compute()
+
+        self._update_count = _update_count + 1
+        self._reduce_states(global_state)
+
+        self._is_synced = False
+        self._should_unsync = True
+        self._to_sync = self.sync_on_compute
+        self._computed = None
+        self.compute_on_cpu = _temp_compute_on_cpu
+        if self.compute_on_cpu:
+            self._move_list_states_to_cpu()
+        return batch_val
+
+    def _reduce_states(self, incoming_state: Dict[str, StateType]) -> None:
+        """Merge an incoming (global) state into the current (batch) state
+        per each state's reduction (reference metric.py:392-421)."""
+        for attr, reduction_fn in self._reductions.items():
+            local_state = getattr(self, attr)
+            global_state = incoming_state[attr]
+            if reduction_fn == dim_zero_sum:
+                reduced = global_state + local_state
+            elif reduction_fn == dim_zero_mean:
+                reduced = ((self._update_count - 1) * global_state + local_state) / self._update_count
+            elif reduction_fn == dim_zero_max:
+                reduced = jnp.maximum(global_state, local_state)
+            elif reduction_fn == dim_zero_min:
+                reduced = jnp.minimum(global_state, local_state)
+            elif reduction_fn == dim_zero_cat:
+                if isinstance(global_state, jax.Array):
+                    reduced = jnp.concatenate([jnp.atleast_1d(global_state), jnp.atleast_1d(local_state)])
+                else:
+                    reduced = global_state + local_state
+            elif reduction_fn is None and isinstance(global_state, jax.Array):
+                reduced = jnp.stack([global_state, local_state])
+            elif reduction_fn is None and isinstance(global_state, list):
+                reduced = _flatten([global_state, local_state])
+            else:
+                reduced = reduction_fn(jnp.stack([jnp.asarray(global_state), jnp.asarray(local_state)]))  # type: ignore[misc]
+            object.__setattr__(self, attr, reduced)
+
+    # ------------------------------------------------------------------- sync
+
+    def _active_backend(self) -> DistributedBackend:
+        return self.sync_backend if self.sync_backend is not None else get_default_backend()
+
+    def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
+        """Gather+reduce every state across ranks (reference metric.py:423-453).
+
+        When no custom ``dist_sync_fn`` is given, "sum"/"mean"/"max"/"min"
+        tensor states take the fused ``all_reduce`` path (one psum-style
+        collective) instead of gather + local reduce — the key ICI
+        optimization over the reference's always-gather wire protocol.
+        """
+        group = process_group or self.process_group
+        backend = self._active_backend()
+
+        if dist_sync_fn is None:
+            # fused backend path
+            for attr, reduction_fn in self._reductions.items():
+                current_val = getattr(self, attr)
+                op = _reduce_fn_to_op(reduction_fn)
+                if isinstance(current_val, list):
+                    # a locally-empty list still participates in the collective
+                    # (zero-length contribution) so ranks never diverge on the
+                    # number of collectives issued — a hang otherwise
+                    catted = dim_zero_cat(current_val) if current_val else jnp.zeros((0,), dtype=self._dtype)
+                    gathered = backend.all_gather(catted, group=group)
+                    merged = dim_zero_cat(gathered)
+                    object.__setattr__(self, attr, [merged] if merged.size else [])
+                elif op in ("sum", "mean", "max", "min"):
+                    object.__setattr__(self, attr, backend.all_reduce(current_val, op, group=group))
+                else:
+                    gathered = backend.all_gather(current_val, group=group)
+                    if op == "cat":
+                        object.__setattr__(self, attr, dim_zero_cat(gathered))
+                    elif reduction_fn is None:
+                        object.__setattr__(self, attr, jnp.stack(gathered))
+                    elif callable(reduction_fn):
+                        object.__setattr__(self, attr, reduction_fn(jnp.stack(gathered)))
+                    else:
+                        raise TypeError("reduction_fn must be callable or None")
+            return
+
+        # reference-faithful custom-gather path
+        input_dict = {attr: getattr(self, attr) for attr in self._reductions}
+        for attr, reduction_fn in self._reductions.items():
+            if reduction_fn == dim_zero_cat and isinstance(input_dict[attr], list) and len(input_dict[attr]) > 1:
+                input_dict[attr] = [dim_zero_cat(input_dict[attr])]
+
+        output_dict: Dict[str, Any] = {}
+        for attr, val in input_dict.items():
+            if isinstance(val, list):
+                output_dict[attr] = [dist_sync_fn(v, group) for v in val]
+            else:
+                output_dict[attr] = dist_sync_fn(val, group)
+
+        for attr, reduction_fn in self._reductions.items():
+            if isinstance(output_dict[attr], list) and len(output_dict[attr]) == 0:
+                object.__setattr__(self, attr, [])
+                continue
+            out = output_dict[attr]
+            if isinstance(out[0], list):
+                out = _flatten(out)
+            if not (callable(reduction_fn) or reduction_fn is None):
+                raise TypeError("reduction_fn must be callable or None")
+            if reduction_fn is None:
+                reduced: Any = out
+            elif reduction_fn == dim_zero_cat:
+                reduced = dim_zero_cat(out)
+            else:
+                reduced = reduction_fn(jnp.stack(out))
+            object.__setattr__(self, attr, reduced)
+
+    def sync(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        distributed_available: Optional[Callable] = None,
+    ) -> None:
+        """Synchronize state across ranks, caching the local state for
+        :meth:`unsync` (reference metric.py:486-528)."""
+        if self._is_synced and should_sync:
+            raise TPUMetricsUserError("The Metric has already been synced.")
+
+        if distributed_available is None and self.distributed_available_fn is not None:
+            distributed_available = self.distributed_available_fn
+        is_distributed = distributed_available() if callable(distributed_available) else None
+        if not should_sync or not is_distributed:
+            return
+
+        if dist_sync_fn is None:
+            dist_sync_fn = self.dist_sync_fn  # may remain None → fused backend path
+
+        # cache prior to syncing
+        self._cache = self._copy_state_dict()
+        self._sync_dist(dist_sync_fn, process_group=process_group)
+        self._is_synced = True
+
+    def unsync(self, should_unsync: bool = True) -> None:
+        """Restore the cached pre-sync local state (reference metric.py:530-550)."""
+        if not should_unsync:
+            return
+        if not self._is_synced:
+            raise TPUMetricsUserError("The Metric has already been un-synced.")
+        if self._cache is None:
+            raise TPUMetricsUserError("The internal cache should exist to unsync the Metric.")
+        for attr, val in self._cache.items():
+            object.__setattr__(self, attr, val)
+        self._is_synced = False
+        self._cache = None
+
+    @contextmanager
+    def sync_context(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        should_unsync: bool = True,
+        distributed_available: Optional[Callable] = None,
+    ) -> Generator[None, None, None]:
+        """Sync on entry, restore on exit (reference metric.py:552-587)."""
+        self.sync(
+            dist_sync_fn=dist_sync_fn,
+            process_group=process_group,
+            should_sync=should_sync,
+            distributed_available=distributed_available,
+        )
+        yield
+        self.unsync(should_unsync=self._is_synced and should_unsync)
+
+    # ------------------------------------------------------------ wrap update
+
+    def _wrap_update(self, update: Callable) -> Callable:
+        @functools.wraps(update)
+        def wrapped_func(*args: Any, **kwargs: Any) -> None:
+            self._computed = None
+            self._update_count += 1
+            update(*args, **kwargs)
+            if self.compute_on_cpu:
+                self._move_list_states_to_cpu()
+
+        return wrapped_func
+
+    def _move_list_states_to_cpu(self) -> None:
+        """Move list states to host memory (reference metric.py:481-484)."""
+        for key in self._defaults:
+            current_val = getattr(self, key)
+            if isinstance(current_val, Sequence):
+                object.__setattr__(self, key, [jax.device_get(cur_v) for cur_v in current_val])
+
+    def _wrap_compute(self, compute: Callable) -> Callable:
+        @functools.wraps(compute)
+        def wrapped_func(*args: Any, **kwargs: Any) -> Any:
+            if self._update_count == 0:
+                rank_zero_warn(
+                    f"The ``compute`` method of metric {self.__class__.__name__}"
+                    " was called before the ``update`` method which may lead to errors,"
+                    " as metric states have not yet been updated.",
+                    UserWarning,
+                )
+            if self._computed is not None:
+                return self._computed
+            with self.sync_context(
+                dist_sync_fn=self.dist_sync_fn,
+                should_sync=self._to_sync,
+                should_unsync=self._should_unsync,
+            ):
+                value = _squeeze_if_scalar(compute(*args, **kwargs))
+            if self.compute_with_cache:
+                self._computed = value
+            return value
+
+        return wrapped_func
+
+    # --------------------------------------------------------------- abstract
+
+    @abstractmethod
+    def update(self, *_: Any, **__: Any) -> None:
+        """Override to update the metric state (reference metric.py:621)."""
+
+    @abstractmethod
+    def compute(self) -> Any:
+        """Override to compute the final value from state (reference metric.py:628)."""
+
+    # ------------------------------------------------------- functional bridge
+
+    def init_state(self) -> Dict[str, StateType]:
+        """Fresh default state pytree (pure; for the functional/jit path)."""
+        return {
+            attr: ([] if isinstance(default, list) else default) for attr, default in self._defaults.items()
+        }
+
+    @contextmanager
+    def _borrowed_state(self, state: Dict[str, StateType]) -> Generator[None, None, None]:
+        """Temporarily swap ``state`` in as the live state.
+
+        List states are shallow-copied on the way in so in-place appends made
+        by ``update`` never mutate the caller's pytree (array leaves are
+        immutable anyway).
+        """
+        saved = self._copy_state_dict()
+        for attr, val in state.items():
+            object.__setattr__(self, attr, list(val) if isinstance(val, list) else val)
+        try:
+            yield
+        finally:
+            for attr, val in saved.items():
+                object.__setattr__(self, attr, val)
+
+    def functional_update(self, state: Dict[str, StateType], *args: Any, **kwargs: Any) -> Dict[str, StateType]:
+        """Pure state transition: ``update(state, batch) -> new_state``.
+
+        Traceable under ``jit`` — usable inside the user's compiled train/eval
+        step with the state pytree carried explicitly (donate it for in-place
+        buffer reuse on TPU).
+        """
+        with self._borrowed_state(state):
+            self.__wrapped__update_raw(*args, **kwargs)
+            new_state = self._copy_state_dict()
+        return new_state
+
+    def __wrapped__update_raw(self, *args: Any, **kwargs: Any) -> None:
+        # call the subclass update without counters/cache side effects
+        type(self).update(self, *args, **kwargs)
+
+    def functional_compute(
+        self,
+        state: Dict[str, StateType],
+        axis_name: Optional[str] = None,
+        backend: Optional[DistributedBackend] = None,
+    ) -> Any:
+        """Pure compute from an explicit state pytree, optionally syncing
+        in-trace over ``axis_name`` (ICI collectives) first."""
+        if axis_name is not None:
+            backend = AxisBackend(axis_name)
+        if backend is not None:
+            state = self.sync_state(state, backend)
+        with self._borrowed_state(state):
+            value = _squeeze_if_scalar(type(self).compute(self))
+        return value
+
+    def sync_state(
+        self, state: Dict[str, StateType], backend: DistributedBackend
+    ) -> Dict[str, StateType]:
+        """Pure cross-rank merge of a state pytree using each state's reduce op."""
+        out: Dict[str, StateType] = {}
+        for attr, reduction_fn in self._reductions.items():
+            val = state[attr]
+            op = _reduce_fn_to_op(reduction_fn)
+            if isinstance(val, list):
+                # empty lists still issue the collective — see _sync_dist
+                catted = dim_zero_cat(val) if val else jnp.zeros((0,), dtype=self._dtype)
+                merged = dim_zero_cat(backend.all_gather(catted))
+                out[attr] = [merged] if merged.size else []
+            elif op in ("sum", "mean", "max", "min"):
+                out[attr] = backend.all_reduce(val, op)
+            elif op == "cat":
+                out[attr] = dim_zero_cat(backend.all_gather(val))
+            elif reduction_fn is None:
+                out[attr] = jnp.stack(backend.all_gather(val))
+            else:
+                out[attr] = reduction_fn(jnp.stack(backend.all_gather(val)))
+        return out
+
+    # ------------------------------------------------------------------ reset
+
+    def reset(self) -> None:
+        """Reset state to defaults (reference metric.py:669-684)."""
+        self._update_count = 0
+        self._forward_cache = None
+        self._computed = None
+        for attr, default in self._defaults.items():
+            if isinstance(default, list):
+                object.__setattr__(self, attr, [])
+            else:
+                object.__setattr__(self, attr, default)
+        self._cache = None
+        self._is_synced = False
+
+    def clone(self) -> "Metric":
+        """Deep copy of the metric (reference metric.py:686-688)."""
+        return deepcopy(self)
+
+    # ------------------------------------------------------------ persistence
+
+    def persistent(self, mode: bool = False) -> None:
+        """Toggle persistence for all states (reference metric.py:823-826)."""
+        for key in self._persistent:
+            self._persistent[key] = mode
+
+    def state_dict(self, destination: Optional[Dict] = None, prefix: str = "") -> Dict[str, Any]:
+        """States marked persistent, as plain host arrays (reference metric.py:828-858)."""
+        destination = {} if destination is None else destination
+        for key in self._defaults:
+            if not self._persistent[key]:
+                continue
+            current_val = getattr(self, key)
+            if isinstance(current_val, list):
+                destination[prefix + key] = [jax.device_get(v) for v in current_val]
+            else:
+                destination[prefix + key] = jax.device_get(current_val)
+        return destination
+
+    def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
+        """Restore persistent states (reference metric.py:860-877)."""
+        for key in self._defaults:
+            name = prefix + key
+            if name in state_dict:
+                value = state_dict[name]
+                if isinstance(value, list):
+                    object.__setattr__(self, key, [jnp.asarray(v) for v in value])
+                else:
+                    object.__setattr__(self, key, jnp.asarray(value))
+            elif strict and self._persistent[key]:
+                raise KeyError(f"Missing key {name!r} in state_dict")
+
+    # ------------------------------------------------------------ dev / dtype
+
+    @property
+    def device(self) -> Any:
+        """Device of the metric states (probe-array derivation, reference metric.py:813)."""
+        for attr in self._defaults:
+            val = getattr(self, attr)
+            if isinstance(val, jax.Array):
+                devs = val.devices()
+                return next(iter(devs))
+            if isinstance(val, list) and val and isinstance(val[0], jax.Array):
+                return next(iter(val[0].devices()))
+        return jax.devices()[0]
+
+    @property
+    def dtype(self) -> Any:
+        return self._dtype
+
+    def to(self, device: Any) -> "Metric":
+        """Move all states to ``device`` (reference `_apply`, metric.py:773-820)."""
+        def _move(val: Any) -> Any:
+            if isinstance(val, jax.Array):
+                return jax.device_put(val, device)
+            return val
+
+        for attr in self._defaults:
+            val = getattr(self, attr)
+            if isinstance(val, list):
+                object.__setattr__(self, attr, [_move(v) for v in val])
+            else:
+                object.__setattr__(self, attr, _move(val))
+        self._defaults = {
+            k: ([] if isinstance(v, list) else _move(v)) for k, v in self._defaults.items()
+        }
+        return self
+
+    def set_dtype(self, dst_type: Any) -> "Metric":
+        """Convert floating-point states to ``dst_type`` (reference metric.py:761-771).
+
+        Note: accumulators should generally stay fp32 even under bf16 inputs —
+        this mirrors the reference API for explicit opt-in.
+        """
+        self._dtype = jnp.dtype(dst_type)
+
+        def _convert(val: Any) -> Any:
+            if isinstance(val, jax.Array) and jnp.issubdtype(val.dtype, jnp.floating):
+                return val.astype(dst_type)
+            return val
+
+        for attr in self._defaults:
+            val = getattr(self, attr)
+            if isinstance(val, list):
+                object.__setattr__(self, attr, [_convert(v) for v in val])
+            else:
+                object.__setattr__(self, attr, _convert(val))
+        self._defaults = {
+            k: ([] if isinstance(v, list) else _convert(v)) for k, v in self._defaults.items()
+        }
+        self._computed = jax.tree_util.tree_map(_convert, self._computed)
+        return self
+
+    def float(self) -> "Metric":
+        return self.set_dtype(jnp.float32)
+
+    def double(self) -> "Metric":
+        return self.set_dtype(jnp.float64)
+
+    def half(self) -> "Metric":
+        return self.set_dtype(jnp.bfloat16)
+
+    # --------------------------------------------------------------- plumbing
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        """Filter kwargs to those accepted by this metric's update signature
+        (reference metric.py:879-898; used by MetricCollection routing)."""
+        _params = (inspect.Parameter.VAR_POSITIONAL, inspect.Parameter.VAR_KEYWORD)
+        _sign_params = self._update_signature.parameters
+        filtered_kwargs = {
+            k: v for k, v in kwargs.items() if (k in _sign_params and _sign_params[k].kind not in _params)
+        }
+        exists_var_keyword = any(v.kind == inspect.Parameter.VAR_KEYWORD for v in _sign_params.values())
+        if not filtered_kwargs and not exists_var_keyword:
+            filtered_kwargs = kwargs
+        if exists_var_keyword:
+            filtered_kwargs = kwargs
+        return filtered_kwargs
+
+    def __getstate__(self) -> Dict[str, Any]:
+        """Pickle support: drop wrapped bound methods (reference metric.py:690-696)."""
+        return {k: v for k, v in self.__dict__.items() if k not in ("update", "compute", "_update_signature")}
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        self._update_signature = inspect.signature(self.update)
+        self.update = self._wrap_update(self.update)  # type: ignore[method-assign]
+        self.compute = self._wrap_compute(self.compute)  # type: ignore[method-assign]
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        """Guard const class attributes against instance mutation (reference metric.py:711-722)."""
+        if name in _CONST_ATTRS:
+            raise RuntimeError(f"Can't change const `{name}`.")
+        object.__setattr__(self, name, value)
+
+    def __hash__(self) -> int:
+        """Hash over identity-relevant fields (reference metric.py:900-911)."""
+        hash_vals: List[Any] = [self.__class__.__name__]
+        for key in self._defaults:
+            val = getattr(self, key)
+            if isinstance(val, list):
+                hash_vals.extend(id(v) for v in val)
+            else:
+                hash_vals.append(id(val))
+        return hash(tuple(hash_vals))
+
+    def __repr__(self) -> str:
+        return f"{self.__class__.__name__}()"
+
+    def _repr_kwargs(self) -> str:
+        return ""
+
+    # ------------------------------------------------------------------- plot
+
+    def _plot(self, val: Any = None, ax: Any = None) -> Any:
+        from tpumetrics.utils.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute()
+        fig, ax = plot_single_or_multi_val(
+            val,
+            ax=ax,
+            higher_is_better=self.higher_is_better,
+            lower_bound=self.plot_lower_bound,
+            upper_bound=self.plot_upper_bound,
+            legend_name=self.plot_legend_name,
+            name=self.__class__.__name__,
+        )
+        return fig, ax
+
+    def plot(self, *args: Any, **kwargs: Any) -> Any:
+        """Plot the metric value(s); requires matplotlib (reference metric.py:633-667)."""
+        return self._plot(*args, **kwargs)
+
+    # ---------------------------------------------------------- compositional
+    # operator overloads (reference metric.py:925-1060)
+
+    def __add__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.add, self, other)
+
+    def __and__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_and, self, other)
+
+    def __eq__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
+        return CompositionalMetric(jnp.equal, self, other)
+
+    def __floordiv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.floor_divide, self, other)
+
+    def __ge__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.greater_equal, self, other)
+
+    def __gt__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.greater, self, other)
+
+    def __le__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.less_equal, self, other)
+
+    def __lt__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.less, self, other)
+
+    def __matmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.matmul, self, other)
+
+    def __mod__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.mod, self, other)
+
+    def __mul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.multiply, self, other)
+
+    def __ne__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
+        return CompositionalMetric(jnp.not_equal, self, other)
+
+    def __or__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_or, self, other)
+
+    def __pow__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.power, self, other)
+
+    def __radd__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.add, other, self)
+
+    def __rand__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(lambda x, y: jnp.bitwise_and(y, x), self, other)
+
+    def __rfloordiv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.floor_divide, other, self)
+
+    def __rmatmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.matmul, other, self)
+
+    def __rmod__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.mod, other, self)
+
+    def __rmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.multiply, other, self)
+
+    def __ror__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(lambda x, y: jnp.bitwise_or(y, x), self, other)
+
+    def __rpow__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.power, other, self)
+
+    def __rsub__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.subtract, other, self)
+
+    def __rtruediv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.true_divide, other, self)
+
+    def __rxor__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(lambda x, y: jnp.bitwise_xor(y, x), self, other)
+
+    def __sub__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.subtract, self, other)
+
+    def __truediv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.true_divide, self, other)
+
+    def __xor__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_xor, self, other)
+
+    def __abs__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __inv__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_not, self, None)
+
+    def __invert__(self) -> "CompositionalMetric":
+        return self.__inv__()
+
+    def __neg__(self) -> "CompositionalMetric":
+        return CompositionalMetric(_neg, self, None)
+
+    def __pos__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __getitem__(self, idx: Any) -> "CompositionalMetric":
+        return CompositionalMetric(lambda x: x[idx], self, None)
+
+    def __getnewargs__(self) -> tuple:
+        return tuple()
+
+    __iter__ = None
+
+
+def _neg(x: Array) -> Array:
+    return -jnp.abs(x)
+
+
+def _reduce_fn_to_op(reduction_fn: Any) -> Optional[str]:
+    """Map a registered reduce function back to its wire-op name."""
+    if reduction_fn == dim_zero_sum:
+        return "sum"
+    if reduction_fn == dim_zero_mean:
+        return "mean"
+    if reduction_fn == dim_zero_max:
+        return "max"
+    if reduction_fn == dim_zero_min:
+        return "min"
+    if reduction_fn == dim_zero_cat:
+        return "cat"
+    return None
+
+
+class CompositionalMetric(Metric):
+    """Lazy arithmetic composition of two metrics (reference metric.py:1075-1198)."""
+
+    def __init__(
+        self,
+        operator: Callable,
+        metric_a: Union[Metric, float, int, Array, None],
+        metric_b: Union[Metric, float, int, Array, None],
+    ) -> None:
+        super().__init__()
+        self.op = operator
+        self.metric_a = jnp.asarray(metric_a) if isinstance(metric_a, (int, float)) else metric_a
+        self.metric_b = jnp.asarray(metric_b) if isinstance(metric_b, (int, float)) else metric_b
+
+    def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
+        pass  # children sync themselves (reference metric.py:1114-1119)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.update(*args, **self.metric_a._filter_kwargs(**kwargs))
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.update(*args, **self.metric_b._filter_kwargs(**kwargs))
+
+    def compute(self) -> Any:
+        val_a = self.metric_a.compute() if isinstance(self.metric_a, Metric) else self.metric_a
+        val_b = self.metric_b.compute() if isinstance(self.metric_b, Metric) else self.metric_b
+        if val_b is None:
+            return self.op(val_a)
+        return self.op(val_a, val_b)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        val_a = (
+            self.metric_a(*args, **self.metric_a._filter_kwargs(**kwargs))
+            if isinstance(self.metric_a, Metric)
+            else self.metric_a
+        )
+        val_b = (
+            self.metric_b(*args, **self.metric_b._filter_kwargs(**kwargs))
+            if isinstance(self.metric_b, Metric)
+            else self.metric_b
+        )
+        if val_a is None:
+            self._forward_cache = None
+            return self._forward_cache
+        if val_b is None:
+            if isinstance(self.metric_b, Metric):
+                self._forward_cache = None
+                return self._forward_cache
+            self._forward_cache = self.op(val_a)
+            return self._forward_cache
+        self._forward_cache = self.op(val_a, val_b)
+        return self._forward_cache
+
+    def reset(self) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.reset()
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.reset()
+
+    def persistent(self, mode: bool = False) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.persistent(mode=mode)
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.persistent(mode=mode)
+
+    def __repr__(self) -> str:
+        _op_metrics = f"(\n  {self.op.__name__ if hasattr(self.op, '__name__') else self.op}(\n    {self.metric_a!r},\n    {self.metric_b!r}\n  )\n)"
+        return self.__class__.__name__ + _op_metrics

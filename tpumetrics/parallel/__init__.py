@@ -1,0 +1,21 @@
+"""Distributed sync strategies (ICI / DCN / no-op) for metric state."""
+
+from tpumetrics.parallel.backend import (
+    AxisBackend,
+    DistributedBackend,
+    MultiHostBackend,
+    NoOpBackend,
+    distributed_available,
+    get_default_backend,
+    set_default_backend,
+)
+
+__all__ = [
+    "AxisBackend",
+    "DistributedBackend",
+    "MultiHostBackend",
+    "NoOpBackend",
+    "distributed_available",
+    "get_default_backend",
+    "set_default_backend",
+]

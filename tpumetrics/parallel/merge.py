@@ -1,0 +1,52 @@
+"""Pure cross-replica state merging.
+
+This is the reduce step the reference applies after its eager all_gather
+(reference metric.py:438-453), factored out as a standalone pure function so
+it can be reused by: the eager DCN sync path, checkpoint merging across
+hosts, and the test harness's emulated-rank mode.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.utils.data import (
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+)
+
+
+def merge_metric_states(
+    states: List[Dict[str, Any]], reductions: Dict[str, Optional[Union[str, Callable]]]
+) -> Dict[str, Any]:
+    """Merge per-rank state dicts into one global state per each state's reduce op.
+
+    ``reductions`` maps state name → registered reduce function (as stored in
+    ``Metric._reductions``). List states are concatenated; ``None`` states are
+    stacked along a new leading rank axis, matching the reference's gather
+    semantics.
+    """
+    if not states:
+        raise ValueError("need at least one state to merge")
+    out: Dict[str, Any] = {}
+    for name, reduction_fn in reductions.items():
+        vals = [s[name] for s in states]
+        if isinstance(vals[0], list):
+            flat = [v for sub in vals for v in sub]
+            out[name] = [dim_zero_cat(flat)] if flat else []
+            continue
+        if reduction_fn is dim_zero_cat:
+            out[name] = dim_zero_cat([jnp.atleast_1d(v) for v in vals])
+        elif reduction_fn is None:
+            out[name] = jnp.stack(vals)
+        elif callable(reduction_fn):
+            out[name] = reduction_fn(jnp.stack(vals))
+        else:
+            raise TypeError(f"reduction for state {name!r} must be callable or None")
+    return out

@@ -1,0 +1,116 @@
+"""Input checking utilities.
+
+Counterpart of the reference's ``utilities/checks.py``
+(/root/reference/src/torchmetrics/utilities/checks.py). Validation runs
+host-side in eager mode and is automatically skipped for traced (jit) inputs
+— shape checks remain (shapes are static under jit), value checks that would
+force a device sync are bypassed.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _is_tracer(*xs: Any) -> bool:
+    return any(isinstance(x, jax.core.Tracer) for x in xs)
+
+
+def _check_same_shape(preds: Array, target: Array) -> None:
+    """Check that predictions and target have the same shape (reference checks.py:39-46)."""
+    if preds.shape != target.shape:
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, "
+            f"but got {preds.shape} and {target.shape}."
+        )
+
+
+def is_overridden(method_name: str, instance: object, parent: type) -> bool:
+    """Whether ``instance`` overrides ``parent.method_name`` (reference checks.py:741-752)."""
+    instance_attr = getattr(type(instance), method_name, None)
+    parent_attr = getattr(parent, method_name, None)
+    return instance_attr is not None and instance_attr is not parent_attr
+
+
+def check_forward_full_state_property(
+    metric_class: type,
+    init_args: Optional[Dict[str, Any]] = None,
+    input_args: Optional[Dict[str, Any]] = None,
+    num_update_to_compare: Sequence[int] = (10, 100, 1000),
+    reps: int = 5,
+) -> None:
+    """Empirically time ``forward`` with ``full_state_update=True`` vs ``False``.
+
+    Port of the reference's developer profiling tool (checks.py:636-740): runs
+    both variants for each update count, prints the timings and a
+    recommendation for the class's ``full_state_update`` flag.
+    """
+    init_args = init_args or {}
+    input_args = input_args or {}
+
+    class FullState(metric_class):  # type: ignore[misc,valid-type]
+        full_state_update = True
+
+    class PartState(metric_class):  # type: ignore[misc,valid-type]
+        full_state_update = False
+
+    fullstate = FullState(**init_args)
+    partstate = PartState(**init_args)
+
+    equal = True
+    try:
+        for _ in range(num_update_to_compare[0]):
+            out1 = fullstate(**input_args)
+            out2 = partstate(**input_args)
+        equal = equal & bool(jnp.allclose(jnp.asarray(out1), jnp.asarray(out2)))
+    except Exception:
+        equal = False
+
+    res = jnp.zeros((2, len(num_update_to_compare), reps))
+    for i, metric in enumerate([fullstate, partstate]):
+        for j, t in enumerate(num_update_to_compare):
+            for r in range(reps):
+                metric.reset()
+                start = time.perf_counter()
+                for _ in range(t):
+                    _ = metric(**input_args)
+                jax.block_until_ready(metric.compute())
+                end = time.perf_counter()
+                res = res.at[i, j, r].set(end - start)
+
+    mean = jnp.mean(res, axis=-1)
+    std = jnp.std(res, axis=-1)
+    print("Timings using full_state_update=True / False:")
+    for j, t in enumerate(num_update_to_compare):
+        print(
+            f"  {t} updates: full={float(mean[0, j]):.4f}s±{float(std[0, j]):.4f} "
+            f"partial={float(mean[1, j]):.4f}s±{float(std[1, j]):.4f}"
+        )
+    faster = bool((mean[1, -1] < mean[0, -1]).item())
+    if not equal:
+        print(
+            "Output of the metric differs between full_state_update=True and False; "
+            "the recommendation is to set the flag to True."
+        )
+    else:
+        print(f"Recommended setting: `full_state_update={not faster}`")
+
+
+def _try_proceed_with_timeout(fn: Callable, timeout: int = 15) -> bool:
+    """Run ``fn`` guarding against hangs (download guard, reference checks.py:766-795)."""
+    import multiprocessing
+
+    proc = multiprocessing.Process(target=fn)
+    proc.start()
+    proc.join(timeout)
+    if not proc.is_alive():
+        return proc.exitcode == 0
+    proc.terminate()
+    proc.join()
+    return False

@@ -1,0 +1,104 @@
+"""Numerically-safe compute helpers.
+
+Counterpart of the reference's ``utilities/compute.py``
+(/root/reference/src/torchmetrics/utilities/compute.py:20-157). All helpers
+are pure jnp and jit-safe; where the reference branches on data-dependent
+conditions (e.g. ``auc`` reorder) we use ``where``-style masking instead so
+everything lowers to a single XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _safe_matmul(x: Array, y: Array) -> Array:
+    """Matmul; in float32 (or bf16) on TPU this maps straight onto the MXU."""
+    return x @ y.T
+
+
+def _safe_xlogy(x: Array, y: Array) -> Array:
+    """x * log(y) with 0*log(0) := 0 (reference compute.py:31-42)."""
+    res = jnp.where(x == 0.0, 0.0, x * jnp.log(jnp.where(x == 0.0, 1.0, y)))
+    return res
+
+
+def _safe_divide(num: Array, denom: Array, zero_division: float = 0.0) -> Array:
+    """Division with 0/0 := zero_division (reference compute.py:45-54)."""
+    num = num if jnp.issubdtype(jnp.asarray(num).dtype, jnp.floating) else jnp.asarray(num, jnp.float32)
+    denom = denom if jnp.issubdtype(jnp.asarray(denom).dtype, jnp.floating) else jnp.asarray(denom, jnp.float32)
+    zero_mask = denom == 0
+    return jnp.where(zero_mask, zero_division, num / jnp.where(zero_mask, 1.0, denom))
+
+
+def _adjust_weights_safe_divide(
+    score: Array, average: Optional[str], multilabel: bool, tp: Array, fp: Array, fn: Array
+) -> Array:
+    """Apply micro/macro/weighted/none weighting to per-class scores
+    (reference compute.py:57-86)."""
+    if average is None or average == "none":
+        return score
+    if average == "weighted":
+        weights = (tp + fn).astype(jnp.float32)
+    else:
+        weights = jnp.ones_like(score)
+        if not multilabel:
+            # macro: classes absent from both preds & target are excluded
+            weights = jnp.where((tp + fp + fn) == 0, 0.0, weights)
+    return jnp.sum(_safe_divide(weights, jnp.sum(weights, axis=-1, keepdims=True)) * score, axis=-1)
+
+
+def _auc_compute_without_check(x: Array, y: Array, direction: float, axis: int = -1) -> Array:
+    """Trapezoidal area under (x, y) assuming sorted x (reference compute.py:89-104)."""
+    dx = jnp.diff(x, axis=axis)
+    mean_y = (jax.lax.slice_in_dim(y, 1, None, axis=axis) + jax.lax.slice_in_dim(y, 0, -1, axis=axis)) / 2.0
+    return jnp.sum(mean_y * dx, axis=axis) * direction
+
+
+def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
+    """AUC with optional reorder and direction detection (reference compute.py:107-127).
+
+    jit-safe: direction is computed with ``where`` instead of a data-dependent
+    python branch.
+    """
+    if reorder:
+        order = jnp.argsort(x)
+        x, y = x[order], y[order]
+    dx = jnp.diff(x)
+    any_neg = jnp.any(dx < 0)
+    all_nonpos = jnp.all(dx <= 0)
+    direction = jnp.where(any_neg, jnp.where(all_nonpos, -1.0, jnp.nan), 1.0)
+    return _auc_compute_without_check(x, y, direction)
+
+
+def auc(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Area under curve (trapezoidal), public helper (reference compute.py:130-132)."""
+    if x.ndim != 1 or y.ndim != 1 or x.shape[0] != y.shape[0]:
+        raise ValueError(
+            f"Expected both `x` and `y` to be 1d arrays of the same size, got {x.shape} and {y.shape}"
+        )
+    return _auc_compute(x, y, reorder=reorder)
+
+
+def interp(x: Array, xp: Array, fp: Array) -> Array:
+    """1-D linear interpolation matching ``np.interp`` (reference compute.py:135-157)."""
+    return jnp.interp(x, xp, fp)
+
+
+def normalize_logits_if_needed(tensor: Array, normalization: str) -> Array:
+    """Apply sigmoid/softmax only when input looks like logits (outside [0,1]).
+
+    jit-safe rewrite of the reference's data-dependent branch
+    (functional/classification helpers): uses ``where`` on a global predicate.
+    """
+    is_prob = jnp.logical_and(jnp.min(tensor) >= 0, jnp.max(tensor) <= 1)
+    if normalization == "sigmoid":
+        return jnp.where(is_prob, tensor, jax.nn.sigmoid(tensor))
+    if normalization == "softmax":
+        return jnp.where(is_prob, tensor, jax.nn.softmax(tensor, axis=1))
+    return tensor

@@ -1,0 +1,135 @@
+"""Array helpers for metric state handling.
+
+Counterpart of the reference's ``utilities/data.py``
+(/root/reference/src/torchmetrics/utilities/data.py:28-237), rebuilt on
+``jax.numpy``. Notably the reference carries an explicit XLA *fallback loop*
+for ``_bincount`` (data.py:169-199) because ``torch.bincount`` is unsupported
+on XLA/deterministic backends — here bincount is implemented with a static
+``length`` argument, which lowers to a one-hot sum natively on TPU, so no
+fallback is needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _is_tracer(x: Any) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
+    """Concatenate (a possibly-listed) state along dim 0."""
+    if isinstance(x, (jnp.ndarray, jax.Array)) and not isinstance(x, (list, tuple)):
+        return x
+    if not x:  # empty list
+        raise ValueError("No samples to concatenate")
+    x = [y[None] if jnp.ndim(y) == 0 else y for y in x]
+    return jnp.concatenate(x, axis=0)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(x, axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(x, axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(x, axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(x, axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    """Flatten list of lists into one list."""
+    return [item for sublist in x for item in sublist]
+
+
+def _flatten_dict(x: Dict) -> tuple[Dict, bool]:
+    """Flatten dict of dicts into one level; returns (flat_dict, all_values_were_dicts)."""
+    new_dict = {}
+    duplicates = False
+    for key, value in x.items():
+        if isinstance(value, dict):
+            for k, v in value.items():
+                if k in new_dict:
+                    duplicates = True
+                new_dict[k] = v
+        else:
+            if key in new_dict:
+                duplicates = True
+            new_dict[key] = value
+    return new_dict, duplicates
+
+
+def to_onehot(label_tensor: Array, num_classes: Optional[int] = None) -> Array:
+    """Convert dense label array ``(N, d1, ...)`` to one-hot ``(N, C, d1, ...)``.
+
+    Matches the reference layout (class axis inserted at position 1,
+    utilities/data.py:80-112); ``jax.nn.one_hot`` puts the class axis last so
+    we move it.
+    """
+    if num_classes is None:
+        num_classes = int(jnp.max(label_tensor)) + 1
+    onehot = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int32)
+    return jnp.moveaxis(onehot, -1, 1)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binary mask of the top-k entries along ``dim`` (reference data.py:115-139).
+
+    Uses ``jax.lax.top_k`` (static k) so it lowers cleanly on TPU.
+    """
+    if topk == 1:  # fast path: argmax one-hot
+        idx = jnp.argmax(prob_tensor, axis=dim)
+        return jnp.moveaxis(jax.nn.one_hot(idx, prob_tensor.shape[dim], dtype=jnp.int32), -1, dim)
+    moved = jnp.moveaxis(prob_tensor, dim, -1)
+    _, idx = jax.lax.top_k(moved, topk)
+    mask = jnp.sum(jax.nn.one_hot(idx, moved.shape[-1], dtype=jnp.int32), axis=-2)
+    return jnp.moveaxis(mask, -1, dim)
+
+
+def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
+    """Probabilities/logits to dense labels via argmax (reference data.py:142-166)."""
+    return jnp.argmax(x, axis=argmax_dim)
+
+
+def _bincount(x: Array, minlength: Optional[int] = None) -> Array:
+    """Count occurrences of ints in ``x``.
+
+    The static ``length`` makes this jit-safe; XLA lowers it to a scatter-add /
+    one-hot sum on TPU (no host fallback needed, unlike reference data.py:169-199).
+    """
+    if minlength is None:
+        if _is_tracer(x):
+            raise ValueError("_bincount under jit requires a static `minlength`.")
+        minlength = int(jnp.max(x)) + 1 if x.size else 0
+    return jnp.bincount(jnp.ravel(x), length=minlength)
+
+
+def _cumsum(x: Array, dim: Optional[int] = 0, dtype: Optional[Any] = None) -> Array:
+    """Cumulative sum (deterministic on TPU, unlike CUDA — reference data.py:202-211)."""
+    return jnp.cumsum(x, axis=dim, dtype=dtype)
+
+
+def _flexible_bincount(x: Array) -> Array:
+    """Counts of each *unique* value (dynamic output — eager/host only)."""
+    # remap values to contiguous ids, then dense bincount
+    _, inverse = jnp.unique(x, return_inverse=True)
+    return _bincount(inverse, minlength=int(jnp.max(inverse)) + 1 if x.size else 0)
+
+
+def allclose(tensor1: Array, tensor2: Array, rtol: float = 1e-5, atol: float = 1e-8) -> bool:
+    """dtype-safe allclose (reference data.py:233-237)."""
+    if tensor1.dtype != tensor2.dtype:
+        tensor2 = tensor2.astype(tensor1.dtype)
+    return bool(jnp.allclose(tensor1, tensor2, rtol=rtol, atol=atol))

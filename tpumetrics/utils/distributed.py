@@ -1,0 +1,56 @@
+"""Distributed gather/reduce helpers.
+
+Counterpart of the reference's ``utilities/distributed.py``
+(/root/reference/src/torchmetrics/utilities/distributed.py:22-147), with the
+wire ops delegated to the pluggable backend in
+:mod:`tpumetrics.parallel.backend`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.parallel.backend import get_default_backend
+from tpumetrics.utils.compute import _safe_divide
+
+Array = jax.Array
+
+
+def reduce(x: Array, reduction: str) -> Array:
+    """Reduce a tensor: 'elementwise_mean' | 'sum' | 'none' (reference distributed.py:22-42)."""
+    if reduction == "elementwise_mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    if reduction is None or reduction == "none":
+        return x
+    raise ValueError("Reduction parameter unknown.")
+
+
+def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: str = "none") -> Array:
+    """Per-class fraction reduction: micro/macro/weighted/none (reference distributed.py:45-88)."""
+    valid_reduction = ("micro", "macro", "weighted", "none", None)
+    fraction = _safe_divide(jnp.sum(num), jnp.sum(denom)) if class_reduction == "micro" else _safe_divide(num, denom)
+    if class_reduction == "micro":
+        return fraction
+    if class_reduction == "macro":
+        return jnp.mean(fraction)
+    if class_reduction == "weighted":
+        return jnp.sum(fraction * (weights.astype(fraction.dtype) / jnp.sum(weights)))
+    if class_reduction == "none" or class_reduction is None:
+        return fraction
+    raise ValueError(f"Reduction parameter {class_reduction} unknown. Choose between one of these: {valid_reduction}")
+
+
+def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array]:
+    """Gather a tensor from all ranks, handling uneven dim-0 sizes.
+
+    THE sync primitive, equivalent of reference distributed.py:97-147;
+    delegates to the ambient backend (ICI AxisBackend in-trace, DCN
+    MultiHostBackend eagerly, NoOp single-replica).
+    """
+    backend = get_default_backend()
+    return backend.all_gather(jnp.asarray(result), group=group)

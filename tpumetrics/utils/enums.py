@@ -1,0 +1,113 @@
+"""Enums driving task-string dispatch.
+
+Counterpart of the reference's ``utilities/enums.py``
+(/root/reference/src/torchmetrics/utilities/enums.py:20-154). Implemented
+standalone (no lightning_utilities dependency).
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Optional
+
+
+class EnumStr(str, Enum):
+    """Base class: case/sep-insensitive string enum with a helpful error message."""
+
+    @staticmethod
+    def _name() -> str:
+        return "Task"
+
+    @staticmethod
+    def _normalize(value: str) -> str:
+        return value.lower().replace("-", "_").replace(" ", "_")
+
+    @classmethod
+    def from_str(cls, value: str, source: str = "key") -> "EnumStr":
+        norm = cls._normalize(value)
+        for member in cls:
+            if cls._normalize(str(member.value)) == norm:
+                return member
+        valid = [str(m.value) for m in cls]
+        raise ValueError(f"Invalid {cls._name()}: expected one of {valid}, but got {value}.")
+
+    @classmethod
+    def from_str_or_none(cls, value: Optional[str]) -> Optional["EnumStr"]:
+        if value is None:
+            return None
+        return cls.from_str(value)
+
+    def __str__(self) -> str:
+        return str(self.value)
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, str):
+            return self._normalize(str(self.value)) == self._normalize(other)
+        return Enum.__eq__(self, other)
+
+    def __hash__(self) -> int:
+        return hash(str(self.value))
+
+
+class DataType(EnumStr):
+    """Type of an input (legacy input-format classifier vocabulary)."""
+
+    @staticmethod
+    def _name() -> str:
+        return "Data type"
+
+    BINARY = "binary"
+    MULTILABEL = "multi-label"
+    MULTICLASS = "multi-class"
+    MULTIDIM_MULTICLASS = "multi-dim multi-class"
+
+
+class AverageMethod(EnumStr):
+    """Reduction over classes: micro / macro / weighted / none / samples."""
+
+    @staticmethod
+    def _name() -> str:
+        return "Average method"
+
+    MICRO = "micro"
+    MACRO = "macro"
+    WEIGHTED = "weighted"
+    NONE = "none"
+    SAMPLES = "samples"
+
+
+class MDMCAverageMethod(EnumStr):
+    """Reduction over the extra multidim dimension."""
+
+    GLOBAL = "global"
+    SAMPLEWISE = "samplewise"
+
+
+class ClassificationTask(EnumStr):
+    """Task vocabulary for the task-string classification wrappers."""
+
+    @staticmethod
+    def _name() -> str:
+        return "Classification"
+
+    BINARY = "binary"
+    MULTICLASS = "multiclass"
+    MULTILABEL = "multilabel"
+
+
+class ClassificationTaskNoBinary(EnumStr):
+    @staticmethod
+    def _name() -> str:
+        return "Classification"
+
+    MULTICLASS = "multiclass"
+    MULTILABEL = "multilabel"
+
+
+class ClassificationTaskNoMultilabel(EnumStr):
+    @staticmethod
+    def _name() -> str:
+        return "Classification"
+
+    BINARY = "binary"
+    MULTICLASS = "multiclass"

@@ -1,0 +1,19 @@
+"""Exception types for tpumetrics.
+
+TPU-native counterpart of the reference's ``utilities/exceptions.py``
+(/root/reference/src/torchmetrics/utilities/exceptions.py:1-21).
+"""
+
+
+class TPUMetricsUserError(Exception):
+    """Error raised when a misuse of the metric API is detected (e.g. double sync)."""
+
+
+class TPUMetricsUserWarning(UserWarning):
+    """Warning raised for non-fatal metric API misuse or degraded behavior."""
+
+
+# Aliases matching the reference naming so users migrating from torchmetrics
+# can except the familiar names.
+TorchMetricsUserError = TPUMetricsUserError
+TorchMetricsUserWarning = TPUMetricsUserWarning

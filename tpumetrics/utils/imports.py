@@ -1,0 +1,65 @@
+"""Availability flags for optional dependencies.
+
+Counterpart of the reference's ``utilities/imports.py``
+(/root/reference/src/torchmetrics/utilities/imports.py:1-67). On TPU the
+roles are inverted: JAX/Flax are the core stack, torch & friends are the
+optional extras used mainly as test references.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import shutil
+import sys
+
+
+def package_available(name: str) -> bool:
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+_PYTHON_GREATER_EQUAL_3_10 = sys.version_info >= (3, 10)
+
+# Core stack (required — flags exist for symmetry / graceful degradation in docs builds).
+_JAX_AVAILABLE = package_available("jax")
+_FLAX_AVAILABLE = package_available("flax")
+
+# Optional scientific stack.
+_SCIPY_AVAILABLE = package_available("scipy")
+_SKLEARN_AVAILABLE = package_available("sklearn")
+_MATPLOTLIB_AVAILABLE = package_available("matplotlib")
+_SCIENCEPLOT_AVAILABLE = package_available("scienceplots")
+_PANDAS_AVAILABLE = package_available("pandas")
+
+# Text / multimodal extras.
+_TRANSFORMERS_AVAILABLE = package_available("transformers")
+_TRANSFORMERS_GREATER_EQUAL_4_4 = _TRANSFORMERS_AVAILABLE
+_NLTK_AVAILABLE = package_available("nltk")
+_REGEX_AVAILABLE = package_available("regex")
+_TQDM_AVAILABLE = package_available("tqdm")
+_MECAB_AVAILABLE = package_available("MeCab")
+_IPADIC_AVAILABLE = package_available("ipadic")
+_SENTENCEPIECE_AVAILABLE = package_available("sentencepiece")
+
+# Image / detection extras.
+_TORCH_AVAILABLE = package_available("torch")
+_TORCHVISION_AVAILABLE = package_available("torchvision")
+_TORCH_FIDELITY_AVAILABLE = package_available("torch_fidelity")
+_PYCOCOTOOLS_AVAILABLE = package_available("pycocotools")
+_FASTER_COCO_EVAL_AVAILABLE = package_available("faster_coco_eval")
+_PIQ_GREATER_EQUAL_0_8 = package_available("piq")
+
+# Audio extras (all host-side C/NumPy packages).
+_PESQ_AVAILABLE = package_available("pesq")
+_PYSTOI_AVAILABLE = package_available("pystoi")
+_GAMMATONE_AVAILABLE = package_available("gammatone")
+_TORCHAUDIO_AVAILABLE = package_available("torchaudio")
+_SACREBLEU_AVAILABLE = package_available("sacrebleu")
+
+# Multi-host launch helpers.
+_MULTIPROCESSING_AVAILABLE = True
+
+# Latex rendering for plots.
+_LATEX_AVAILABLE = shutil.which("latex") is not None

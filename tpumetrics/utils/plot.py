@@ -1,0 +1,169 @@
+"""Plotting helpers (matplotlib optional).
+
+Counterpart of reference ``utilities/plot.py``
+(/root/reference/src/torchmetrics/utilities/plot.py:62-328):
+``plot_single_or_multi_val``, ``plot_confusion_matrix``, ``plot_curve``.
+"""
+
+from __future__ import annotations
+
+from itertools import product
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from tpumetrics.utils.imports import _MATPLOTLIB_AVAILABLE
+
+if _MATPLOTLIB_AVAILABLE:
+    import matplotlib
+    import matplotlib.axes
+    import matplotlib.pyplot as plt
+
+    _AX_TYPE = "matplotlib.axes.Axes"
+    _PLOT_OUT_TYPE = Tuple["plt.Figure", Union["matplotlib.axes.Axes", np.ndarray]]
+else:
+    _AX_TYPE = Any  # type: ignore[misc,assignment]
+    _PLOT_OUT_TYPE = Tuple[object, object]  # type: ignore[misc,assignment]
+
+
+def _error_on_missing_matplotlib() -> None:
+    if not _MATPLOTLIB_AVAILABLE:
+        raise ModuleNotFoundError(
+            "Plot function expects `matplotlib` to be installed, which is not available in this environment."
+        )
+
+
+def _to_numpy(x: Any) -> np.ndarray:
+    return np.asarray(x)
+
+
+def plot_single_or_multi_val(
+    val: Any,
+    ax: Optional[Any] = None,
+    higher_is_better: Optional[bool] = None,
+    lower_bound: Optional[float] = None,
+    upper_bound: Optional[float] = None,
+    legend_name: Optional[str] = None,
+    name: Optional[str] = None,
+) -> "_PLOT_OUT_TYPE":
+    """Plot a single scalar/array value or a sequence of them over steps
+    (reference plot.py:62-196)."""
+    _error_on_missing_matplotlib()
+    fig, ax = (None, ax) if ax is not None else plt.subplots(1, 1)
+
+    if isinstance(val, Sequence) and not isinstance(val, (str, bytes)):
+        vals = [_to_numpy(v) for v in val]
+        if vals and vals[0].ndim == 0:
+            ax.plot(range(len(vals)), [float(v) for v in vals], marker="o")
+        else:
+            arr = np.stack(vals)
+            for i in range(arr.shape[-1]):
+                label = f"{legend_name or 'class'} {i}"
+                ax.plot(range(arr.shape[0]), arr[..., i], marker="o", label=label)
+            ax.legend()
+        ax.set_xlabel("Step")
+    elif isinstance(val, dict):
+        for i, (k, v) in enumerate(val.items()):
+            v = _to_numpy(v)
+            if v.ndim == 0:
+                ax.bar(i, float(v), label=k)
+            else:
+                ax.plot(v, label=k)
+        ax.legend()
+    else:
+        v = _to_numpy(val)
+        if v.ndim == 0:
+            ax.bar(0, float(v))
+        else:
+            ax.bar(np.arange(v.size), v.ravel())
+    if lower_bound is not None or upper_bound is not None:
+        ax.set_ylim(lower_bound, upper_bound)
+    if name:
+        ax.set_title(name)
+    return fig, ax
+
+
+def plot_confusion_matrix(
+    confmat: Any,
+    ax: Optional[Any] = None,
+    add_text: bool = True,
+    labels: Optional[List[str]] = None,
+    cmap: Optional[str] = None,
+) -> "_PLOT_OUT_TYPE":
+    """Heatmap plot of a (num_classes, num_classes) or (N, C, C) confusion matrix
+    (reference plot.py:199-265)."""
+    _error_on_missing_matplotlib()
+    confmat = _to_numpy(confmat)
+    if confmat.ndim == 3:  # multilabel
+        nb, n_classes = confmat.shape[0], confmat.shape[1]
+        rows, cols = 1, nb
+    else:
+        nb, n_classes = 1, confmat.shape[0]
+        rows, cols = 1, 1
+        confmat = confmat[None]
+
+    if labels is not None and len(labels) != n_classes:
+        raise ValueError(
+            "Expected number of elements in arg `labels` to match number of labels in confmat got "
+            f"{len(labels)} and {n_classes}"
+        )
+    labels = labels or [str(i) for i in range(n_classes)]
+
+    if ax is not None:
+        if nb > 1:
+            raise ValueError(
+                f"Cannot plot a multilabel confusion matrix ({nb} panels) onto a single provided axis."
+            )
+        fig = None
+        axs = np.asarray([ax])
+    else:
+        fig, axs = plt.subplots(rows, cols, squeeze=False)
+        axs = axs.ravel()
+    for b in range(nb):
+        a = axs[b]
+        im = a.imshow(confmat[b], cmap=cmap or "viridis")
+        a.set_xlabel("Predicted class")
+        a.set_ylabel("True class")
+        a.set_xticks(range(n_classes))
+        a.set_yticks(range(n_classes))
+        a.set_xticklabels(labels)
+        a.set_yticklabels(labels)
+        if add_text:
+            for i, j in product(range(n_classes), range(n_classes)):
+                a.text(j, i, str(round(float(confmat[b, i, j]), 2)), ha="center", va="center")
+    return fig, (axs[0] if nb == 1 else axs)
+
+
+def plot_curve(
+    curve: Tuple[Any, ...],
+    score: Optional[Any] = None,
+    ax: Optional[Any] = None,
+    label_names: Optional[Tuple[str, str]] = None,
+    legend_name: Optional[str] = None,
+    name: Optional[str] = None,
+) -> "_PLOT_OUT_TYPE":
+    """Plot a (x, y[, thresholds]) curve, e.g. ROC / PR (reference plot.py:268-328)."""
+    _error_on_missing_matplotlib()
+    if len(curve) < 2:
+        raise ValueError("Expected 2 or more elements in curve object")
+    x, y = _to_numpy(curve[0]), _to_numpy(curve[1])
+    fig, ax = (None, ax) if ax is not None else plt.subplots(1, 1)
+    if x.ndim == 1:
+        label = f"AUC={float(_to_numpy(score)):0.3f}" if score is not None else None
+        ax.plot(x, y, linestyle="-", linewidth=2, label=label)
+        if label:
+            ax.legend()
+    else:
+        for i in range(x.shape[0]):
+            label = f"{legend_name or 'class'} {i}"
+            if score is not None:
+                label += f" AUC={float(_to_numpy(score)[i]):0.3f}"
+            ax.plot(x[i], y[i], linestyle="-", linewidth=2, label=label)
+        ax.legend()
+    ax.grid(True)
+    if label_names is not None:
+        ax.set_xlabel(label_names[0])
+        ax.set_ylabel(label_names[1])
+    if name is not None:
+        ax.set_title(name)
+    return fig, ax

@@ -1,0 +1,66 @@
+"""Rank-zero-only printing / warning helpers.
+
+Counterpart of the reference's ``utilities/prints.py``
+(/root/reference/src/torchmetrics/utilities/prints.py:22-73), rebuilt on
+``jax.process_index`` instead of env-var ranks: on a multi-host TPU pod each
+host is one JAX process and only process 0 emits warnings/info.
+"""
+
+from __future__ import annotations
+
+import warnings
+from functools import partial, wraps
+from typing import Any, Callable
+
+
+def _get_rank() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Decorate ``fn`` so it only runs on JAX process 0."""
+
+    @wraps(fn)
+    def wrapped_fn(*args: Any, **kwargs: Any) -> Any:
+        if _get_rank() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped_fn
+
+
+@rank_zero_only
+def rank_zero_warn(message: str, *args: Any, **kwargs: Any) -> None:
+    kwargs.setdefault("stacklevel", 5)
+    warnings.warn(message, *args, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_info(message: str, *args: Any, **kwargs: Any) -> None:
+    print(message, *args, **kwargs)
+
+
+rank_zero_debug = rank_zero_info
+
+_future_warning = partial(warnings.warn, category=FutureWarning)
+
+
+def _deprecated_root_import_class(name: str, domain: str) -> None:
+    """Warn that a root-level class import is deprecated (reference parity)."""
+    _future_warning(
+        f"`tpumetrics.{name}` was deprecated and will be removed in a future version."
+        f" Import `tpumetrics.{domain}.{name}` instead."
+    )
+
+
+def _deprecated_root_import_func(name: str, domain: str) -> None:
+    """Warn that a root-level functional import is deprecated (reference parity)."""
+    _future_warning(
+        f"`tpumetrics.functional.{name}` was deprecated and will be removed in a future version."
+        f" Import `tpumetrics.functional.{domain}.{name}` instead."
+    )

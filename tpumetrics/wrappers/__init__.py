@@ -1,0 +1,9 @@
+"""Wrapper metrics (counterpart of reference ``torchmetrics/wrappers``)."""
+
+from tpumetrics.wrappers.abstract import WrapperMetric
+from tpumetrics.wrappers.running import Running
+
+__all__ = [
+    "Running",
+    "WrapperMetric",
+]

@@ -1,0 +1,30 @@
+"""Abstract base for wrapper metrics.
+
+Counterpart of reference ``wrappers/abstract.py:19`` — wrapper metrics
+forward all calls to the wrapped metric, which owns sync/counter logic, so
+the default update/compute wrapping is disabled here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from tpumetrics.metric import Metric
+
+
+class WrapperMetric(Metric):
+    """Base class for metrics that wrap other metrics.
+
+    The wrapped metric handles synchronization and bookkeeping; this base
+    disables the outer wrapping so it doesn't run twice.
+    """
+
+    def _wrap_update(self, update: Callable) -> Callable:
+        return update
+
+    def _wrap_compute(self, compute: Callable) -> Callable:
+        return compute
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Each wrapper defines its own forward protocol."""
+        raise NotImplementedError

@@ -1,0 +1,102 @@
+"""Sliding-window wrapper metric.
+
+Counterpart of reference ``wrappers/running.py:27-135``: keeps ``window``
+copies of the wrapped metric's state (one per recent step) and computes the
+metric over their merge. Requires ``full_state_update=False`` on the wrapped
+metric.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+
+from tpumetrics.metric import Metric
+from tpumetrics.wrappers.abstract import WrapperMetric
+
+Array = jax.Array
+
+
+class Running(WrapperMetric):
+    """Compute a metric over a running window of the last ``window`` updates.
+
+    ``forward`` still returns the current-batch value; ``compute`` returns the
+    windowed value. Memory grows linearly with ``window`` (one state clone per
+    slot — reference running.py:103-107).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.wrappers import Running
+        >>> from tpumetrics.aggregation import SumMetric
+        >>> metric = Running(SumMetric(), window=3)
+        >>> for i in range(6):
+        ...     _ = metric.update(jnp.asarray([float(i)]))
+        >>> float(metric.compute())  # 3 + 4 + 5
+        12.0
+    """
+
+    def __init__(self, base_metric: Metric, window: int = 5) -> None:
+        super().__init__()
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected argument `metric` to be an instance of `tpumetrics.Metric` but got {base_metric}"
+            )
+        if not (isinstance(window, int) and window > 0):
+            raise ValueError(f"Expected argument `window` to be a positive integer but got {window}")
+        self.base_metric = base_metric
+        self.window = window
+        if base_metric.full_state_update is not False:
+            raise ValueError(
+                f"Expected attribute `full_state_update` set to `False` but got {base_metric.full_state_update}"
+            )
+        self._num_vals_seen = 0
+
+        for key in base_metric._defaults:
+            for i in range(window):
+                self.add_state(
+                    name=f"{key}_{i}",
+                    default=base_metric._defaults[key],
+                    dist_reduce_fx=base_metric._reductions[key],
+                )
+
+    def _store_slot(self) -> None:
+        slot = self._num_vals_seen % self.window
+        for key in self.base_metric._defaults:
+            setattr(self, f"{key}_{slot}", getattr(self.base_metric, key))
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update the wrapped metric, snapshot its state into the current slot, reset it."""
+        self.base_metric.update(*args, **kwargs)
+        self._store_slot()
+        self.base_metric.reset()
+        self._num_vals_seen += 1
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Forward to the wrapped metric (batch value) and snapshot state."""
+        res = self.base_metric.forward(*args, **kwargs)
+        self._store_slot()
+        self.base_metric.reset()
+        self._num_vals_seen += 1
+        self._computed = None
+        return res
+
+    def compute(self) -> Any:
+        """Merge all window slots into the wrapped metric and compute."""
+        for i in range(self.window):
+            self.base_metric._reduce_states(
+                {key: getattr(self, f"{key}_{i}") for key in self.base_metric._defaults}
+            )
+        # make sure the inner compute does not warn about a missing update
+        self.base_metric._update_count = max(self._num_vals_seen, 1)
+        val = self.base_metric.compute()
+        self.base_metric.reset()
+        return val
+
+    def reset(self) -> None:
+        super().reset()
+        self.base_metric.reset()
+        self._num_vals_seen = 0
+
+    def plot(self, val: Optional[Union[Array, Sequence[Array]]] = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)
