@@ -1,0 +1,23 @@
+"""Shared random input fixtures for classification tests
+(counterpart of reference tests/unittests/classification/inputs.py)."""
+
+import numpy as np
+
+from tests.conftest import BATCH_SIZE, NUM_BATCHES, NUM_CLASSES
+
+_rng = np.random.default_rng(42)
+
+# binary: probabilities and hard labels
+binary_probs_preds = _rng.random((NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+binary_label_preds = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE))
+binary_target = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE))
+
+# multiclass: logits and hard labels
+multiclass_logits_preds = _rng.normal(size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32)
+multiclass_label_preds = _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+multiclass_target = _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+
+# multilabel: probabilities and hard labels
+multilabel_probs_preds = _rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32)
+multilabel_label_preds = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
+multilabel_target = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))

@@ -1,0 +1,332 @@
+"""Stat-scores family (accuracy/precision/recall/F1/specificity/stat-scores/
+confusion-matrix/hamming/exact-match) validated against sklearn
+(counterpart of reference tests/unittests/classification/test_{accuracy,
+precision_recall,f_beta,specificity,stat_scores,confusion_matrix,hamming,
+exact_match}.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    accuracy_score as sk_accuracy,
+    confusion_matrix as sk_confusion_matrix,
+    f1_score as sk_f1,
+    hamming_loss as sk_hamming_loss,
+    multilabel_confusion_matrix as sk_multilabel_confusion_matrix,
+    precision_score as sk_precision,
+    recall_score as sk_recall,
+)
+
+import tpumetrics.classification as tmc
+import tpumetrics.functional.classification as tmf
+from tests.classification import inputs
+from tests.conftest import NUM_CLASSES
+from tests.helpers.testers import MetricTester
+
+
+def _sk_binary(preds, target, fn, **kw):
+    preds = (preds >= 0.5).astype(int) if preds.dtype.kind == "f" else preds
+    return fn(target.ravel(), preds.ravel(), **kw)
+
+
+def _to_labels(preds):
+    """sklearn-compatible hard labels from logits (argmax over class dim) or pass-through."""
+    preds = np.asarray(preds)
+    if preds.dtype.kind == "f" and preds.ndim >= 2 and preds.shape[-1] == NUM_CLASSES:
+        return preds.argmax(-1)
+    return preds
+
+
+class TestBinaryStatFamily(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize(
+        ("metric_class", "metric_fn", "sk_fn"),
+        [
+            (tmc.BinaryAccuracy, tmf.binary_accuracy, sk_accuracy),
+            (tmc.BinaryPrecision, tmf.binary_precision, sk_precision),
+            (tmc.BinaryRecall, tmf.binary_recall, sk_recall),
+            (tmc.BinaryF1Score, tmf.binary_f1_score, sk_f1),
+        ],
+    )
+    @pytest.mark.parametrize("use_probs", [True, False])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_vs_sklearn(self, metric_class, metric_fn, sk_fn, use_probs, ddp):
+        preds = inputs.binary_probs_preds if use_probs else inputs.binary_label_preds
+        target = inputs.binary_target
+        ref = lambda p, t: _sk_binary(p, t, sk_fn)  # noqa: E731
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=[jnp.asarray(p) for p in preds],
+            target=[jnp.asarray(t) for t in target],
+            metric_class=metric_class,
+            reference_metric=lambda p, t: ref(p, t),
+        )
+        if not ddp:
+            self.run_functional_metric_test(
+                [jnp.asarray(p) for p in preds],
+                [jnp.asarray(t) for t in target],
+                metric_fn,
+                lambda p, t: ref(p, t),
+            )
+
+    def test_specificity(self):
+        preds, target = inputs.binary_label_preds, inputs.binary_target
+        p, t = preds.ravel(), target.ravel()
+        tn = ((p == 0) & (t == 0)).sum()
+        fp = ((p == 1) & (t == 0)).sum()
+        expected = tn / (tn + fp)
+        self.run_class_metric_test(
+            ddp=False,
+            preds=[jnp.asarray(x) for x in preds],
+            target=[jnp.asarray(x) for x in target],
+            metric_class=tmc.BinarySpecificity,
+            reference_metric=lambda p_, t_: _sk_spec_binary(p_, t_),
+            check_batch=False,
+        )
+        got = tmf.binary_specificity(jnp.asarray(p), jnp.asarray(t))
+        assert np.allclose(float(got), expected)
+
+    def test_confusion_matrix(self):
+        preds, target = inputs.binary_label_preds, inputs.binary_target
+        got = tmf.binary_confusion_matrix(jnp.asarray(preds.ravel()), jnp.asarray(target.ravel()))
+        expected = sk_confusion_matrix(target.ravel(), preds.ravel())
+        assert np.allclose(np.asarray(got), expected)
+
+    def test_hamming(self):
+        preds, target = inputs.binary_label_preds, inputs.binary_target
+        got = tmf.binary_hamming_distance(jnp.asarray(preds.ravel()), jnp.asarray(target.ravel()))
+        expected = sk_hamming_loss(target.ravel(), preds.ravel())
+        assert np.allclose(float(got), expected)
+
+    def test_stat_scores(self):
+        preds, target = inputs.binary_label_preds, inputs.binary_target
+        got = np.asarray(tmf.binary_stat_scores(jnp.asarray(preds.ravel()), jnp.asarray(target.ravel())))
+        cm = sk_confusion_matrix(target.ravel(), preds.ravel())
+        tn, fp, fn, tp = cm.ravel()
+        assert got.tolist() == [tp, fp, tn, fn, tp + fn]
+
+    def test_ignore_index(self):
+        target = inputs.binary_target.copy().ravel()
+        preds = inputs.binary_label_preds.ravel()
+        target[::5] = -1
+        got = tmf.binary_accuracy(jnp.asarray(preds), jnp.asarray(target), ignore_index=-1)
+        keep = target != -1
+        expected = sk_accuracy(target[keep], preds[keep])
+        assert np.allclose(float(got), expected)
+
+
+def _sk_spec_binary(preds, target):
+    preds = (preds >= 0.5).astype(int) if preds.dtype.kind == "f" else preds
+    p, t = preds.ravel(), target.ravel()
+    tn = ((p == 0) & (t == 0)).sum()
+    fp = ((p == 1) & (t == 0)).sum()
+    return tn / (tn + fp) if tn + fp else 0.0
+
+
+class TestMulticlassStatFamily(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize(
+        ("metric_class", "metric_fn", "sk_fn", "average"),
+        [
+            (tmc.MulticlassAccuracy, tmf.multiclass_accuracy, None, "micro"),
+            (tmc.MulticlassAccuracy, tmf.multiclass_accuracy, sk_recall, "macro"),
+            (tmc.MulticlassPrecision, tmf.multiclass_precision, sk_precision, "macro"),
+            (tmc.MulticlassPrecision, tmf.multiclass_precision, sk_precision, "micro"),
+            (tmc.MulticlassPrecision, tmf.multiclass_precision, sk_precision, "weighted"),
+            (tmc.MulticlassPrecision, tmf.multiclass_precision, sk_precision, None),
+            (tmc.MulticlassRecall, tmf.multiclass_recall, sk_recall, "macro"),
+            (tmc.MulticlassF1Score, tmf.multiclass_f1_score, sk_f1, "macro"),
+            (tmc.MulticlassF1Score, tmf.multiclass_f1_score, sk_f1, "weighted"),
+        ],
+    )
+    @pytest.mark.parametrize("use_logits", [True, False])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_vs_sklearn(self, metric_class, metric_fn, sk_fn, average, use_logits, ddp):
+        preds = inputs.multiclass_logits_preds if use_logits else inputs.multiclass_label_preds
+        target = inputs.multiclass_target
+        labels = list(range(NUM_CLASSES))
+
+        if sk_fn is None:  # micro accuracy
+
+            def ref(p, t):
+                return sk_accuracy(t.ravel(), _to_labels(np.asarray(p)).ravel())
+
+        else:
+
+            def ref(p, t):
+                return sk_fn(
+                    t.ravel(),
+                    _to_labels(np.asarray(p)).ravel(),
+                    average=average,
+                    labels=labels,
+                    zero_division=0,
+                )
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=[jnp.asarray(p) for p in preds],
+            target=[jnp.asarray(t) for t in target],
+            metric_class=metric_class,
+            reference_metric=ref,
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+        )
+        if not ddp:
+            self.run_functional_metric_test(
+                [jnp.asarray(p) for p in preds],
+                [jnp.asarray(t) for t in target],
+                metric_fn,
+                ref,
+                metric_args={"num_classes": NUM_CLASSES, "average": average},
+            )
+
+    def test_confusion_matrix(self):
+        preds, target = inputs.multiclass_label_preds, inputs.multiclass_target
+        got = tmf.multiclass_confusion_matrix(
+            jnp.asarray(preds.ravel()), jnp.asarray(target.ravel()), num_classes=NUM_CLASSES
+        )
+        expected = sk_confusion_matrix(target.ravel(), preds.ravel(), labels=list(range(NUM_CLASSES)))
+        assert np.allclose(np.asarray(got), expected)
+
+    @pytest.mark.parametrize("normalize", ["true", "pred", "all", None])
+    def test_confusion_matrix_normalize(self, normalize):
+        preds, target = inputs.multiclass_label_preds, inputs.multiclass_target
+        got = tmf.multiclass_confusion_matrix(
+            jnp.asarray(preds.ravel()),
+            jnp.asarray(target.ravel()),
+            num_classes=NUM_CLASSES,
+            normalize=normalize,
+        )
+        expected = sk_confusion_matrix(
+            target.ravel(), preds.ravel(), labels=list(range(NUM_CLASSES)), normalize=normalize
+        )
+        assert np.allclose(np.asarray(got), expected)
+
+    def test_top_k(self):
+        preds, target = inputs.multiclass_logits_preds, inputs.multiclass_target
+        p, t = preds.reshape(-1, NUM_CLASSES), target.ravel()
+        got = tmf.multiclass_accuracy(
+            jnp.asarray(p), jnp.asarray(t), num_classes=NUM_CLASSES, top_k=2, average="micro"
+        )
+        topk = np.argsort(-p, axis=1)[:, :2]
+        expected = np.mean([t[i] in topk[i] for i in range(len(t))])
+        assert np.allclose(float(got), expected)
+
+    def test_ignore_index(self):
+        preds = inputs.multiclass_label_preds.ravel()
+        target = inputs.multiclass_target.copy().ravel()
+        target[::7] = NUM_CLASSES  # use an extra id as ignore
+        got = tmf.multiclass_accuracy(
+            jnp.asarray(preds), jnp.asarray(target), num_classes=NUM_CLASSES,
+            average="micro", ignore_index=NUM_CLASSES,
+        )
+        keep = target != NUM_CLASSES
+        expected = sk_accuracy(target[keep], preds[keep])
+        assert np.allclose(float(got), expected)
+
+    def test_exact_match(self):
+        preds, target = inputs.multiclass_label_preds, inputs.multiclass_target
+        got = tmf.multiclass_exact_match(jnp.asarray(preds), jnp.asarray(target), num_classes=NUM_CLASSES)
+        expected = np.mean([(p == t).all() for p, t in zip(preds, target)])
+        assert np.allclose(float(got), expected)
+
+
+class TestMultilabelStatFamily(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize(
+        ("metric_class", "metric_fn", "sk_fn", "average"),
+        [
+            (tmc.MultilabelPrecision, tmf.multilabel_precision, sk_precision, "macro"),
+            (tmc.MultilabelPrecision, tmf.multilabel_precision, sk_precision, "micro"),
+            (tmc.MultilabelRecall, tmf.multilabel_recall, sk_recall, "macro"),
+            (tmc.MultilabelF1Score, tmf.multilabel_f1_score, sk_f1, "macro"),
+            (tmc.MultilabelF1Score, tmf.multilabel_f1_score, sk_f1, "weighted"),
+        ],
+    )
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_vs_sklearn(self, metric_class, metric_fn, sk_fn, average, ddp):
+        preds, target = inputs.multilabel_label_preds, inputs.multilabel_target
+
+        def ref(p, t):
+            p = p.reshape(-1, NUM_CLASSES)
+            t = t.reshape(-1, NUM_CLASSES)
+            return sk_fn(t, p, average=average, zero_division=0)
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=[jnp.asarray(p) for p in preds],
+            target=[jnp.asarray(t) for t in target],
+            metric_class=metric_class,
+            reference_metric=ref,
+            metric_args={"num_labels": NUM_CLASSES, "average": average},
+        )
+        if not ddp:
+            self.run_functional_metric_test(
+                [jnp.asarray(p) for p in preds],
+                [jnp.asarray(t) for t in target],
+                metric_fn,
+                ref,
+                metric_args={"num_labels": NUM_CLASSES, "average": average},
+            )
+
+    def test_confusion_matrix(self):
+        preds, target = inputs.multilabel_label_preds, inputs.multilabel_target
+        got = tmf.multilabel_confusion_matrix(
+            jnp.asarray(preds.reshape(-1, NUM_CLASSES)),
+            jnp.asarray(target.reshape(-1, NUM_CLASSES)),
+            num_labels=NUM_CLASSES,
+        )
+        expected = sk_multilabel_confusion_matrix(
+            target.reshape(-1, NUM_CLASSES), preds.reshape(-1, NUM_CLASSES)
+        )
+        assert np.allclose(np.asarray(got), expected)
+
+    def test_hamming(self):
+        preds, target = inputs.multilabel_label_preds, inputs.multilabel_target
+        got = tmf.multilabel_hamming_distance(
+            jnp.asarray(preds.reshape(-1, NUM_CLASSES)),
+            jnp.asarray(target.reshape(-1, NUM_CLASSES)),
+            num_labels=NUM_CLASSES,
+            average="micro",
+        )
+        expected = sk_hamming_loss(target.reshape(-1, NUM_CLASSES), preds.reshape(-1, NUM_CLASSES))
+        assert np.allclose(float(got), expected)
+
+    def test_exact_match(self):
+        preds, target = inputs.multilabel_label_preds, inputs.multilabel_target
+        p = preds.reshape(-1, NUM_CLASSES)
+        t = target.reshape(-1, NUM_CLASSES)
+        got = tmf.multilabel_exact_match(jnp.asarray(p), jnp.asarray(t), num_labels=NUM_CLASSES)
+        expected = np.mean([(pi == ti).all() for pi, ti in zip(p, t)])
+        assert np.allclose(float(got), expected)
+
+
+class TestTaskWrappers:
+    def test_accuracy_dispatch(self):
+        m = tmc.Accuracy(task="multiclass", num_classes=4)
+        assert isinstance(m, tmc.MulticlassAccuracy)
+        m = tmc.Accuracy(task="binary")
+        assert isinstance(m, tmc.BinaryAccuracy)
+        m = tmc.Accuracy(task="multilabel", num_labels=3)
+        assert isinstance(m, tmc.MultilabelAccuracy)
+
+    def test_wrapper_raises_on_bad_task(self):
+        with pytest.raises(ValueError, match="Invalid Classification"):
+            tmc.Accuracy(task="not_a_task")
+
+    def test_wrapper_requires_num_classes(self):
+        with pytest.raises(ValueError, match="num_classes"):
+            tmc.F1Score(task="multiclass")
+
+    def test_samplewise_multidim(self):
+        rng = np.random.default_rng(3)
+        preds = rng.integers(0, 3, (4, 10))
+        target = rng.integers(0, 3, (4, 10))
+        got = tmf.multiclass_accuracy(
+            jnp.asarray(preds), jnp.asarray(target), num_classes=3,
+            average="micro", multidim_average="samplewise",
+        )
+        expected = (preds == target).mean(axis=1)
+        assert np.allclose(np.asarray(got), expected)

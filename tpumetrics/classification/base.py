@@ -1,0 +1,29 @@
+"""Task-string dispatch base for classification wrapper classes.
+
+Counterpart of reference ``classification/base.py:19`` — classes like
+``Accuracy(task="binary")`` resolve to the Binary/Multiclass/Multilabel
+implementation in ``__new__``; calling update/compute on the wrapper itself
+is an error.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpumetrics.metric import Metric
+
+
+class _ClassificationTaskWrapper(Metric):
+    """Base class for the task-dispatching wrapper metrics."""
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        raise NotImplementedError(
+            f"{self.__class__.__name__} metric does not have an `update` method. "
+            "This is a wrapper class — construct it with a `task` argument to get a concrete metric."
+        )
+
+    def compute(self) -> None:
+        raise NotImplementedError(
+            f"{self.__class__.__name__} metric does not have a `compute` method. "
+            "This is a wrapper class — construct it with a `task` argument to get a concrete metric."
+        )

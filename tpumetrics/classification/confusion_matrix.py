@@ -1,0 +1,217 @@
+"""Modular confusion-matrix metrics (counterpart of reference
+``classification/confusion_matrix.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.classification.base import _ClassificationTaskWrapper
+from tpumetrics.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_arg_validation,
+    _confusion_matrix_reduce,
+    _masked_confmat,
+)
+from tpumetrics.functional.classification.stat_scores import (
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multilabel_stat_scores_arg_validation,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+)
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import _bincount
+from tpumetrics.utils.enums import ClassificationTask
+from tpumetrics.utils.plot import plot_confusion_matrix
+
+Array = jax.Array
+
+
+class BinaryConfusionMatrix(Metric):
+    """2x2 confusion matrix for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import BinaryConfusionMatrix
+        >>> metric = BinaryConfusionMatrix()
+        >>> metric.update(jnp.asarray([0, 1, 0, 0]), jnp.asarray([1, 1, 0, 0]))
+        >>> metric.compute().tolist()
+        [[2, 0], [1, 1]]
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update: bool = False
+
+    confmat: Array
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        normalize: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize)
+        self.threshold = threshold
+        self.normalize = normalize
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("confmat", jnp.zeros((2, 2), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _binary_stat_scores_tensor_validation(preds, target, "global", self.ignore_index)
+        preds, target, mask = _binary_stat_scores_format(preds, target, self.threshold, self.ignore_index)
+        self.confmat = self.confmat + _masked_confmat(preds, target, mask, 2)
+
+    def compute(self) -> Array:
+        return _confusion_matrix_reduce(self.confmat, self.normalize)
+
+    def plot(self, val: Optional[Array] = None, ax: Any = None, add_text: bool = True, labels: Any = None) -> Any:
+        val = val if val is not None else self.compute()
+        return plot_confusion_matrix(val, ax=ax, add_text=add_text, labels=labels)
+
+
+class MulticlassConfusionMatrix(Metric):
+    """(C, C) confusion matrix for multiclass tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MulticlassConfusionMatrix
+        >>> metric = MulticlassConfusionMatrix(num_classes=3)
+        >>> metric.update(jnp.asarray([2, 1, 0, 1]), jnp.asarray([2, 1, 0, 0]))
+        >>> metric.compute().tolist()
+        [[1, 1, 0], [0, 1, 0], [0, 0, 1]]
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update: bool = False
+
+    confmat: Array
+
+    def __init__(
+        self,
+        num_classes: int,
+        normalize: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            if not isinstance(num_classes, int) or num_classes < 2:
+                raise ValueError(
+                    f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}"
+                )
+        self.num_classes = num_classes
+        self.normalize = normalize
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("confmat", jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _multiclass_stat_scores_tensor_validation(preds, target, self.num_classes, "global", self.ignore_index)
+        preds, target, mask = _multiclass_stat_scores_format(preds, target, self.num_classes, self.ignore_index, 1)
+        self.confmat = self.confmat + _masked_confmat(preds, target, mask, self.num_classes)
+
+    def compute(self) -> Array:
+        return _confusion_matrix_reduce(self.confmat, self.normalize)
+
+    def plot(self, val: Optional[Array] = None, ax: Any = None, add_text: bool = True, labels: Any = None) -> Any:
+        val = val if val is not None else self.compute()
+        return plot_confusion_matrix(val, ax=ax, add_text=add_text, labels=labels)
+
+
+class MultilabelConfusionMatrix(Metric):
+    """(num_labels, 2, 2) per-label confusion matrices.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MultilabelConfusionMatrix
+        >>> metric = MultilabelConfusionMatrix(num_labels=3)
+        >>> metric.update(jnp.asarray([[0, 0, 1], [1, 0, 1]]), jnp.asarray([[0, 1, 0], [1, 0, 1]]))
+        >>> metric.compute().tolist()
+        [[[1, 0], [0, 1]], [[1, 0], [1, 0]], [[0, 1], [0, 1]]]
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update: bool = False
+
+    confmat: Array
+
+    def __init__(
+        self,
+        num_labels: int,
+        threshold: float = 0.5,
+        normalize: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multilabel_stat_scores_arg_validation(num_labels, threshold, None, "global", ignore_index)
+        self.num_labels = num_labels
+        self.threshold = threshold
+        self.normalize = normalize
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("confmat", jnp.zeros((num_labels, 2, 2), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _multilabel_stat_scores_tensor_validation(preds, target, self.num_labels, "global", self.ignore_index)
+        preds, target, mask = _multilabel_stat_scores_format(
+            preds, target, self.num_labels, self.threshold, self.ignore_index
+        )
+        idx = jnp.arange(self.num_labels)[None, :, None] * 4 + target * 2 + preds
+        idx = jnp.where(mask == 1, idx, self.num_labels * 4)
+        update = _bincount(idx.ravel(), minlength=self.num_labels * 4 + 1)[:-1].reshape(self.num_labels, 2, 2)
+        self.confmat = self.confmat + update
+
+    def compute(self) -> Array:
+        return _confusion_matrix_reduce(self.confmat, self.normalize)
+
+    def plot(self, val: Optional[Array] = None, ax: Any = None, add_text: bool = True, labels: Any = None) -> Any:
+        val = val if val is not None else self.compute()
+        return plot_confusion_matrix(val, ax=ax, add_text=add_text, labels=labels)
+
+
+class ConfusionMatrix(_ClassificationTaskWrapper):
+    """Task-string wrapper for confusion matrix."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        normalize: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"normalize": normalize, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryConfusionMatrix(threshold, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassConfusionMatrix(num_classes, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelConfusionMatrix(num_labels, threshold, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

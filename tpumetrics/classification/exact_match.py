@@ -1,0 +1,181 @@
+"""Modular exact-match metrics (counterpart of reference ``classification/exact_match.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.classification.base import _ClassificationTaskWrapper
+from tpumetrics.functional.classification.exact_match import (
+    _exact_match_reduce,
+    _multiclass_exact_match_update,
+    _multilabel_exact_match_update,
+)
+from tpumetrics.functional.classification.stat_scores import (
+    _multiclass_stat_scores_arg_validation,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multilabel_stat_scores_arg_validation,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+)
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+from tpumetrics.utils.enums import ClassificationTaskNoBinary
+
+Array = jax.Array
+
+
+class _AbstractExactMatch(Metric):
+    """Shared correct/total state (reference classification/exact_match.py)."""
+
+    correct: Any
+    total: Any
+
+    def _create_state(self, multidim_average: str) -> None:
+        if multidim_average == "samplewise":
+            self.add_state("correct", [], dist_reduce_fx="cat")
+            self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+        else:
+            self.add_state("correct", jnp.asarray(0), dist_reduce_fx="sum")
+            self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def _update_state(self, correct: Array, total: Array) -> None:
+        if isinstance(self.correct, list):
+            self.correct.append(correct)
+        else:
+            self.correct = self.correct + correct
+        self.total = self.total + jnp.sum(total)
+
+    def compute(self) -> Array:
+        correct = dim_zero_cat(self.correct)
+        if self.multidim_average == "samplewise":
+            return correct.astype(jnp.float32)
+        return _exact_match_reduce(correct, self.total)
+
+
+class MulticlassExactMatch(_AbstractExactMatch):
+    """Exact match for multidim multiclass inputs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MulticlassExactMatch
+        >>> metric = MulticlassExactMatch(num_classes=3)
+        >>> metric.update(jnp.asarray([[0, 1], [2, 1]]), jnp.asarray([[0, 1], [2, 2]]))
+        >>> float(metric.compute())
+        0.5
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multiclass_stat_scores_arg_validation(num_classes, 1, None, multidim_average, ignore_index)
+        self.num_classes = num_classes
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_state(multidim_average)
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _multiclass_stat_scores_tensor_validation(
+                preds, target, self.num_classes, self.multidim_average, self.ignore_index
+            )
+        preds, target, mask = _multiclass_stat_scores_format(
+            preds, target, self.num_classes, self.ignore_index, 1
+        )
+        correct, total = _multiclass_exact_match_update(preds, target, mask, self.multidim_average)
+        self._update_state(correct, total)
+
+
+class MultilabelExactMatch(_AbstractExactMatch):
+    """Exact match for multilabel inputs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MultilabelExactMatch
+        >>> metric = MultilabelExactMatch(num_labels=3)
+        >>> metric.update(jnp.asarray([[0, 1, 0], [1, 0, 0]]), jnp.asarray([[0, 1, 0], [1, 0, 1]]))
+        >>> float(metric.compute())
+        0.5
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        num_labels: int,
+        threshold: float = 0.5,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multilabel_stat_scores_arg_validation(num_labels, threshold, None, multidim_average, ignore_index)
+        self.num_labels = num_labels
+        self.threshold = threshold
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_state(multidim_average)
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _multilabel_stat_scores_tensor_validation(
+                preds, target, self.num_labels, self.multidim_average, self.ignore_index
+            )
+        preds, target, mask = _multilabel_stat_scores_format(
+            preds, target, self.num_labels, self.threshold, self.ignore_index
+        )
+        correct, total = _multilabel_exact_match_update(preds, target, mask, self.multidim_average)
+        self._update_state(correct, total)
+
+
+class ExactMatch(_ClassificationTaskWrapper):
+    """Task-string wrapper for exact match (multiclass | multilabel)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTaskNoBinary.from_str(task)
+        kwargs.update(
+            {"multidim_average": multidim_average, "ignore_index": ignore_index, "validate_args": validate_args}
+        )
+        if task == ClassificationTaskNoBinary.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassExactMatch(num_classes, **kwargs)
+        if task == ClassificationTaskNoBinary.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelExactMatch(num_labels, threshold, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

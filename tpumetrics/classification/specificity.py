@@ -1,0 +1,105 @@
+"""Modular specificity metrics (counterpart of reference ``classification/specificity.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from tpumetrics.classification.base import _ClassificationTaskWrapper
+from tpumetrics.classification.stat_scores import BinaryStatScores, MulticlassStatScores, MultilabelStatScores
+from tpumetrics.functional.classification.specificity import _specificity_reduce
+from tpumetrics.metric import Metric
+from tpumetrics.utils.enums import ClassificationTask
+
+Array = jax.Array
+
+
+class BinarySpecificity(BinaryStatScores):
+    """Binary specificity: tn / (tn + fp).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import BinarySpecificity
+        >>> metric = BinarySpecificity()
+        >>> metric.update(jnp.asarray([0, 0, 1, 1, 0, 1]), jnp.asarray([0, 1, 0, 1, 0, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.6667
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._final_state()
+        return _specificity_reduce(tp, fp, tn, fn, average="binary", multidim_average=self.multidim_average)
+
+
+class MulticlassSpecificity(MulticlassStatScores):
+    """Multiclass specificity."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    plot_legend_name: str = "Class"
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._final_state()
+        return _specificity_reduce(tp, fp, tn, fn, average=self.average, multidim_average=self.multidim_average)
+
+
+class MultilabelSpecificity(MultilabelStatScores):
+    """Multilabel specificity."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    plot_legend_name: str = "Label"
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._final_state()
+        return _specificity_reduce(
+            tp, fp, tn, fn, average=self.average, multidim_average=self.multidim_average, multilabel=True
+        )
+
+
+class Specificity(_ClassificationTaskWrapper):
+    """Task-string wrapper for specificity."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        average: Optional[str] = "micro",
+        multidim_average: str = "global",
+        top_k: Optional[int] = 1,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update(
+            {"multidim_average": multidim_average, "ignore_index": ignore_index, "validate_args": validate_args}
+        )
+        if task == ClassificationTask.BINARY:
+            return BinarySpecificity(threshold, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            if not isinstance(top_k, int):
+                raise ValueError(f"`top_k` is expected to be `int` but `{type(top_k)} was passed.`")
+            return MulticlassSpecificity(num_classes, top_k, average, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelSpecificity(num_labels, threshold, average, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

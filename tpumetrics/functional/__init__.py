@@ -1,0 +1,27 @@
+"""Functional API root (counterpart of reference ``torchmetrics/functional/__init__.py``)."""
+
+from tpumetrics.functional.classification import (
+    accuracy,
+    confusion_matrix,
+    exact_match,
+    f1_score,
+    fbeta_score,
+    hamming_distance,
+    precision,
+    recall,
+    specificity,
+    stat_scores,
+)
+
+__all__ = [
+    "accuracy",
+    "confusion_matrix",
+    "exact_match",
+    "f1_score",
+    "fbeta_score",
+    "hamming_distance",
+    "precision",
+    "recall",
+    "specificity",
+    "stat_scores",
+]

@@ -1,0 +1,100 @@
+"""Functional classification metrics (counterpart of reference
+``functional/classification/__init__.py``)."""
+
+from tpumetrics.functional.classification.accuracy import (
+    accuracy,
+    binary_accuracy,
+    multiclass_accuracy,
+    multilabel_accuracy,
+)
+from tpumetrics.functional.classification.confusion_matrix import (
+    binary_confusion_matrix,
+    confusion_matrix,
+    multiclass_confusion_matrix,
+    multilabel_confusion_matrix,
+)
+from tpumetrics.functional.classification.exact_match import (
+    exact_match,
+    multiclass_exact_match,
+    multilabel_exact_match,
+)
+from tpumetrics.functional.classification.f_beta import (
+    binary_f1_score,
+    binary_fbeta_score,
+    f1_score,
+    fbeta_score,
+    multiclass_f1_score,
+    multiclass_fbeta_score,
+    multilabel_f1_score,
+    multilabel_fbeta_score,
+)
+from tpumetrics.functional.classification.hamming import (
+    binary_hamming_distance,
+    hamming_distance,
+    multiclass_hamming_distance,
+    multilabel_hamming_distance,
+)
+from tpumetrics.functional.classification.precision_recall import (
+    binary_precision,
+    binary_recall,
+    multiclass_precision,
+    multiclass_recall,
+    multilabel_precision,
+    multilabel_recall,
+    precision,
+    recall,
+)
+from tpumetrics.functional.classification.specificity import (
+    binary_specificity,
+    multiclass_specificity,
+    multilabel_specificity,
+    specificity,
+)
+from tpumetrics.functional.classification.stat_scores import (
+    binary_stat_scores,
+    multiclass_stat_scores,
+    multilabel_stat_scores,
+    stat_scores,
+)
+
+__all__ = [
+    "accuracy",
+    "binary_accuracy",
+    "binary_confusion_matrix",
+    "binary_f1_score",
+    "binary_fbeta_score",
+    "binary_hamming_distance",
+    "binary_precision",
+    "binary_recall",
+    "binary_specificity",
+    "binary_stat_scores",
+    "confusion_matrix",
+    "exact_match",
+    "f1_score",
+    "fbeta_score",
+    "hamming_distance",
+    "multiclass_accuracy",
+    "multiclass_confusion_matrix",
+    "multiclass_exact_match",
+    "multiclass_f1_score",
+    "multiclass_fbeta_score",
+    "multiclass_hamming_distance",
+    "multiclass_precision",
+    "multiclass_recall",
+    "multiclass_specificity",
+    "multiclass_stat_scores",
+    "multilabel_accuracy",
+    "multilabel_confusion_matrix",
+    "multilabel_exact_match",
+    "multilabel_f1_score",
+    "multilabel_fbeta_score",
+    "multilabel_hamming_distance",
+    "multilabel_precision",
+    "multilabel_recall",
+    "multilabel_specificity",
+    "multilabel_stat_scores",
+    "precision",
+    "recall",
+    "specificity",
+    "stat_scores",
+]

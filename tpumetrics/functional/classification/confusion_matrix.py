@@ -1,0 +1,175 @@
+"""Confusion matrices (binary / multiclass / multilabel).
+
+Counterpart of reference ``functional/classification/confusion_matrix.py``.
+Computed as weighted bincounts over flattened ``target * C + pred`` indices —
+one scatter-add on TPU; the reference's XLA bincount fallback loop
+(reference utilities/data.py:169-199) is unnecessary here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.classification.stat_scores import (
+    _binary_stat_scores_arg_validation,
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+)
+from tpumetrics.utils.checks import _check_same_shape
+from tpumetrics.utils.data import _bincount
+
+Array = jax.Array
+
+
+def _confusion_matrix_reduce(confmat: Array, normalize: Optional[str] = None) -> Array:
+    """Apply 'true' | 'pred' | 'all' | 'none' normalization (reference confusion_matrix.py:24-56)."""
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
+    if normalize is not None and normalize != "none":
+        confmat = confmat.astype(jnp.float32)
+        if normalize == "true":
+            confmat = confmat / jnp.sum(confmat, axis=-1, keepdims=True)
+        elif normalize == "pred":
+            confmat = confmat / jnp.sum(confmat, axis=-2, keepdims=True)
+        elif normalize == "all":
+            confmat = confmat / jnp.sum(confmat, axis=(-2, -1), keepdims=True)
+        confmat = jnp.nan_to_num(confmat)
+    return confmat
+
+
+def _masked_confmat(preds: Array, target: Array, mask: Array, n: int) -> Array:
+    """(n, n) confusion matrix over valid positions only."""
+    idx = target.ravel() * n + preds.ravel()
+    idx = jnp.where(mask.ravel() == 1, idx, n * n)
+    return _bincount(idx, minlength=n * n + 1)[:-1].reshape(n, n)
+
+
+def _binary_confusion_matrix_arg_validation(
+    threshold: float = 0.5, ignore_index: Optional[int] = None, normalize: Optional[str] = None
+) -> None:
+    _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
+
+
+def binary_confusion_matrix(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """2x2 confusion matrix for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import binary_confusion_matrix
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> binary_confusion_matrix(preds, target).tolist()
+        [[2, 0], [1, 1]]
+    """
+    if validate_args:
+        _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize)
+        _binary_stat_scores_tensor_validation(preds, target, "global", ignore_index)
+    preds, target, mask = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    confmat = _masked_confmat(preds, target, mask, 2)
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def multiclass_confusion_matrix(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """(C, C) confusion matrix for multiclass tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multiclass_confusion_matrix
+        >>> target = jnp.asarray([2, 1, 0, 0])
+        >>> preds = jnp.asarray([2, 1, 0, 1])
+        >>> multiclass_confusion_matrix(preds, target, num_classes=3).tolist()
+        [[1, 1, 0], [0, 1, 0], [0, 0, 1]]
+    """
+    if validate_args:
+        if not isinstance(num_classes, int) or num_classes < 2:
+            raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, "global", ignore_index)
+    preds, target, mask = _multiclass_stat_scores_format(preds, target, num_classes, ignore_index, 1)
+    confmat = _masked_confmat(preds, target, mask, num_classes)
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def multilabel_confusion_matrix(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """(num_labels, 2, 2) per-label confusion matrices.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multilabel_confusion_matrix
+        >>> target = jnp.asarray([[0, 1, 0], [1, 0, 1]])
+        >>> preds = jnp.asarray([[0, 0, 1], [1, 0, 1]])
+        >>> multilabel_confusion_matrix(preds, target, num_labels=3).tolist()
+        [[[1, 0], [0, 1]], [[1, 0], [1, 0]], [[0, 1], [0, 1]]]
+    """
+    if validate_args:
+        if not isinstance(num_labels, int) or num_labels < 2:
+            raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, "global", ignore_index)
+    preds, target, mask = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    # per-label 2x2 via bincount over label_id * 4 + target*2 + pred
+    idx = jnp.arange(num_labels)[None, :, None] * 4 + target * 2 + preds
+    idx = jnp.where(mask == 1, idx, num_labels * 4)
+    confmat = _bincount(idx.ravel(), minlength=num_labels * 4 + 1)[:-1].reshape(num_labels, 2, 2)
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def confusion_matrix(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-string dispatcher for confusion matrix."""
+    from tpumetrics.utils.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_confusion_matrix(preds, target, threshold, normalize, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_confusion_matrix(preds, target, num_classes, normalize, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_confusion_matrix(
+            preds, target, num_labels, threshold, normalize, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

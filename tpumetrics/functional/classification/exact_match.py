@@ -1,0 +1,135 @@
+"""Exact match (subset accuracy) for multiclass-multidim and multilabel inputs.
+
+Counterpart of reference ``functional/classification/exact_match.py``: a
+sample scores 1 only when ALL its positions/labels are correct. Ignored
+positions (``ignore_index``) count as correct via masking.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.classification.stat_scores import (
+    _multiclass_stat_scores_arg_validation,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multilabel_stat_scores_arg_validation,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+)
+from tpumetrics.utils.compute import _safe_divide
+
+Array = jax.Array
+
+
+def _exact_match_reduce(correct: Array, total: Array) -> Array:
+    return _safe_divide(correct, total)
+
+
+def _multiclass_exact_match_update(
+    preds: Array, target: Array, mask: Array, multidim_average: str = "global"
+) -> Tuple[Array, Array]:
+    """correct = every (valid) position matches, per sample."""
+    position_ok = (preds == target) | (mask == 0)
+    correct = jnp.all(position_ok, axis=1).astype(jnp.int32)
+    if multidim_average == "global":
+        return jnp.sum(correct), jnp.asarray(correct.shape[0])
+    return correct, jnp.ones_like(correct)
+
+
+def multiclass_exact_match(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Exact-match ratio for multidim multiclass inputs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multiclass_exact_match
+        >>> target = jnp.asarray([[0, 1], [2, 2]])
+        >>> preds = jnp.asarray([[0, 1], [2, 1]])
+        >>> float(multiclass_exact_match(preds, target, num_classes=3))
+        0.5
+    """
+    if validate_args:
+        _multiclass_stat_scores_arg_validation(num_classes, 1, None, multidim_average, ignore_index)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
+    preds, target, mask = _multiclass_stat_scores_format(preds, target, num_classes, ignore_index, 1)
+    correct, total = _multiclass_exact_match_update(preds, target, mask, multidim_average)
+    if multidim_average == "global":
+        return _exact_match_reduce(correct, total)
+    return correct.astype(jnp.float32)
+
+
+def _multilabel_exact_match_update(
+    preds: Array, target: Array, mask: Array, multidim_average: str = "global"
+) -> Tuple[Array, Array]:
+    position_ok = (preds == target) | (mask == 0)
+    correct = jnp.all(position_ok, axis=(1, 2)).astype(jnp.int32)
+    if multidim_average == "global":
+        return jnp.sum(correct), jnp.asarray(correct.shape[0])
+    return correct, jnp.ones_like(correct)
+
+
+def multilabel_exact_match(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Exact-match ratio for multilabel inputs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multilabel_exact_match
+        >>> target = jnp.asarray([[0, 1, 0], [1, 0, 1]])
+        >>> preds = jnp.asarray([[0, 1, 0], [1, 0, 0]])
+        >>> float(multilabel_exact_match(preds, target, num_labels=3))
+        0.5
+    """
+    if validate_args:
+        _multilabel_stat_scores_arg_validation(num_labels, threshold, None, multidim_average, ignore_index)
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
+    preds, target, mask = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    correct, total = _multilabel_exact_match_update(preds, target, mask, multidim_average)
+    if multidim_average == "global":
+        return _exact_match_reduce(correct, total)
+    return correct.astype(jnp.float32)
+
+
+def exact_match(
+    preds: Array,
+    target: Array,
+    task: str,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-string dispatcher for exact match (multiclass | multilabel)."""
+    from tpumetrics.utils.enums import ClassificationTaskNoBinary
+
+    task = ClassificationTaskNoBinary.from_str(task)
+    if task == ClassificationTaskNoBinary.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_exact_match(preds, target, num_classes, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTaskNoBinary.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_exact_match(
+            preds, target, num_labels, threshold, multidim_average, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

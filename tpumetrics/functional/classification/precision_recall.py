@@ -1,0 +1,192 @@
+"""Precision & Recall (binary / multiclass / multilabel).
+
+Counterpart of reference ``functional/classification/precision_recall.py``
+(`_precision_recall_reduce` + public functions).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.classification.stat_scores import (
+    _binary_stat_scores_arg_validation,
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+    _binary_stat_scores_update,
+    _multiclass_stat_scores_arg_validation,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multiclass_stat_scores_update,
+    _multilabel_stat_scores_arg_validation,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+    _multilabel_stat_scores_update,
+)
+from tpumetrics.utils.compute import _adjust_weights_safe_divide, _safe_divide
+
+Array = jax.Array
+
+
+def _precision_recall_reduce(
+    stat: str,
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+    zero_division: float = 0.0,
+) -> Array:
+    """precision = tp/(tp+fp); recall = tp/(tp+fn) with averaging
+    (reference precision_recall.py:24-60)."""
+    different_stat = fp if stat == "precision" else fn
+    if average == "binary":
+        return _safe_divide(tp, tp + different_stat, zero_division)
+    if average == "micro":
+        axis = 0 if multidim_average == "global" else 1
+        tp = jnp.sum(tp, axis=axis)
+        different_stat = jnp.sum(different_stat, axis=axis)
+        return _safe_divide(tp, tp + different_stat, zero_division)
+
+    score = _safe_divide(tp, tp + different_stat, zero_division)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn)
+
+
+def _make_prf(stat: str):
+    def binary_fn(
+        preds: Array,
+        target: Array,
+        threshold: float = 0.5,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+    ) -> Array:
+        if validate_args:
+            _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index)
+            _binary_stat_scores_tensor_validation(preds, target, multidim_average, ignore_index)
+        preds, target, mask = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+        tp, fp, tn, fn = _binary_stat_scores_update(preds, target, mask, multidim_average)
+        return _precision_recall_reduce(stat, tp, fp, tn, fn, average="binary", multidim_average=multidim_average)
+
+    def multiclass_fn(
+        preds: Array,
+        target: Array,
+        num_classes: int,
+        average: Optional[str] = "macro",
+        top_k: int = 1,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+    ) -> Array:
+        if validate_args:
+            _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
+            _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
+        preds, target, mask = _multiclass_stat_scores_format(preds, target, num_classes, ignore_index, top_k)
+        tp, fp, tn, fn = _multiclass_stat_scores_update(
+            preds, target, mask, num_classes, top_k, average, multidim_average
+        )
+        return _precision_recall_reduce(stat, tp, fp, tn, fn, average=average, multidim_average=multidim_average)
+
+    def multilabel_fn(
+        preds: Array,
+        target: Array,
+        num_labels: int,
+        threshold: float = 0.5,
+        average: Optional[str] = "macro",
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+    ) -> Array:
+        if validate_args:
+            _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index)
+            _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
+        preds, target, mask = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+        tp, fp, tn, fn = _multilabel_stat_scores_update(preds, target, mask, multidim_average)
+        return _precision_recall_reduce(
+            stat, tp, fp, tn, fn, average=average, multidim_average=multidim_average, multilabel=True
+        )
+
+    return binary_fn, multiclass_fn, multilabel_fn
+
+
+binary_precision, multiclass_precision, multilabel_precision = _make_prf("precision")
+binary_recall, multiclass_recall, multilabel_recall = _make_prf("recall")
+
+binary_precision.__name__ = "binary_precision"
+multiclass_precision.__name__ = "multiclass_precision"
+multilabel_precision.__name__ = "multilabel_precision"
+binary_recall.__name__ = "binary_recall"
+multiclass_recall.__name__ = "multiclass_recall"
+multilabel_recall.__name__ = "multilabel_recall"
+
+binary_precision.__doc__ = """Binary precision: tp / (tp + fp).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import binary_precision
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> float(binary_precision(preds, target))
+        0.6666666865348816
+    """
+binary_recall.__doc__ = """Binary recall: tp / (tp + fn).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import binary_recall
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> float(binary_recall(preds, target))
+        0.6666666865348816
+    """
+
+
+def _task_dispatch(stat: str):
+    binary_fn, multiclass_fn, multilabel_fn = (
+        (binary_precision, multiclass_precision, multilabel_precision)
+        if stat == "precision"
+        else (binary_recall, multiclass_recall, multilabel_recall)
+    )
+
+    def task_fn(
+        preds: Array,
+        target: Array,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        average: Optional[str] = "micro",
+        multidim_average: str = "global",
+        top_k: int = 1,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+    ) -> Array:
+        from tpumetrics.utils.enums import ClassificationTask
+
+        task = ClassificationTask.from_str(task)
+        if task == ClassificationTask.BINARY:
+            return binary_fn(preds, target, threshold, multidim_average, ignore_index, validate_args)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return multiclass_fn(
+                preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args
+            )
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return multilabel_fn(
+                preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args
+            )
+        raise ValueError(f"Not handled value: {task}")
+
+    task_fn.__name__ = stat
+    return task_fn
+
+
+precision = _task_dispatch("precision")
+recall = _task_dispatch("recall")

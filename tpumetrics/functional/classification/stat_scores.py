@@ -1,0 +1,500 @@
+"""Stat scores (tp/fp/tn/fn) — the root of the classification family.
+
+Counterpart of reference ``functional/classification/stat_scores.py`` (the
+``_binary/_multiclass/_multilabel_stat_scores_{arg_validation,
+tensor_validation, format, update, compute}`` helper convention,
+reference :25-134 and onwards), redesigned for XLA:
+
+- ``ignore_index`` is handled with a **validity mask** carried next to the
+  data instead of boolean-index dropping (reference drops positions, which is
+  a dynamic-shape op XLA can't tile) — every update is mask-weighted, so all
+  shapes stay static under ``jit``.
+- The multiclass global path uses a weighted ``bincount`` over ``C²`` flat
+  indices (lowers to one scatter-add); the top-k / samplewise paths use
+  one-hot contractions that map onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.utils.checks import _check_same_shape, _is_tracer
+from tpumetrics.utils.compute import normalize_logits_if_needed
+from tpumetrics.utils.data import _bincount, select_topk
+
+Array = jax.Array
+
+
+# --------------------------------------------------------------------- binary
+
+
+def _binary_stat_scores_arg_validation(
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+    if multidim_average not in ("global", "samplewise"):
+        raise ValueError(
+            f"Expected argument `multidim_average` to be one of ('global', 'samplewise'), but got {multidim_average}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an int, but got {ignore_index}")
+
+
+def _binary_stat_scores_tensor_validation(
+    preds: Array,
+    target: Array,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    _check_same_shape(preds, target)
+    if _is_tracer(preds, target):
+        return  # value checks need host sync; shapes were already validated
+    unique_values = jnp.unique(target)
+    allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+    bad = [v for v in unique_values.tolist() if v not in allowed]
+    if bad:
+        raise RuntimeError(
+            f"Detected the following values in `target`: {bad} but expected only"
+            f" the following values {sorted(allowed)}."
+        )
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        unique_p = jnp.unique(preds).tolist()
+        if any(v not in (0, 1) for v in unique_p):
+            raise RuntimeError(
+                "Detected the following values in `preds`: "
+                f"{[v for v in unique_p if v not in (0, 1)]} but expected only the following values [0, 1]."
+            )
+    if multidim_average != "global" and preds.ndim < 2:
+        raise ValueError("Expected input to be at least 2D when multidim_average is set to `samplewise`")
+
+
+def _binary_stat_scores_format(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    """Binarize and flatten; returns (preds, target, valid_mask) with static shapes."""
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        preds = (preds > threshold).astype(jnp.int32)
+    else:
+        preds = preds.astype(jnp.int32)
+
+    if ignore_index is not None:
+        mask = (target != ignore_index).astype(jnp.int32)
+        target = jnp.where(target == ignore_index, 0, target)
+    else:
+        mask = jnp.ones_like(target, dtype=jnp.int32)
+    target = target.astype(jnp.int32)
+
+    preds = preds.reshape(preds.shape[0], -1)
+    target = target.reshape(target.shape[0], -1)
+    mask = mask.reshape(mask.shape[0], -1)
+    return preds, target, mask
+
+
+def _binary_stat_scores_update(
+    preds: Array,
+    target: Array,
+    mask: Array,
+    multidim_average: str = "global",
+) -> Tuple[Array, Array, Array, Array]:
+    """Mask-weighted confusion counts; sums over everything (global) or per
+    sample (samplewise)."""
+    axis = None if multidim_average == "global" else 1
+    tp = jnp.sum((preds == 1) & (target == 1) & (mask == 1), axis=axis)
+    fp = jnp.sum((preds == 1) & (target == 0) & (mask == 1), axis=axis)
+    tn = jnp.sum((preds == 0) & (target == 0) & (mask == 1), axis=axis)
+    fn = jnp.sum((preds == 0) & (target == 1) & (mask == 1), axis=axis)
+    return tp, fp, tn, fn
+
+
+def _binary_stat_scores_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, multidim_average: str = "global"
+) -> Array:
+    """Stack into the reference's output layout [tp, fp, tn, fn, support]."""
+    return jnp.stack([tp, fp, tn, fn, tp + fn], axis=0 if multidim_average == "global" else -1).squeeze()
+
+
+def binary_stat_scores(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute tp/fp/tn/fn for binary tasks (reference functional stat_scores public API).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import binary_stat_scores
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> binary_stat_scores(preds, target).tolist()
+        [2, 1, 2, 1, 3]
+    """
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, multidim_average, ignore_index)
+    preds, target, mask = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    tp, fp, tn, fn = _binary_stat_scores_update(preds, target, mask, multidim_average)
+    return _binary_stat_scores_compute(tp, fp, tn, fn, multidim_average)
+
+
+# ----------------------------------------------------------------- multiclass
+
+
+def _multiclass_stat_scores_arg_validation(
+    num_classes: int,
+    top_k: int = 1,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if not (isinstance(top_k, int) and top_k >= 1):
+        raise ValueError(f"Expected argument `top_k` to be an integer larger than or equal to 1, but got {top_k}")
+    if top_k > num_classes:
+        raise ValueError(
+            f"Expected argument `top_k` to be smaller or equal to `num_classes` but got {top_k} and {num_classes}"
+        )
+    allowed_average = ("micro", "macro", "weighted", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"Expected argument `average` to be one of {allowed_average}, but got {average}")
+    if multidim_average not in ("global", "samplewise"):
+        raise ValueError(
+            f"Expected argument `multidim_average` to be one of ('global', 'samplewise'), but got {multidim_average}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an int, but got {ignore_index}")
+
+
+def _multiclass_stat_scores_tensor_validation(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if preds.ndim == target.ndim + 1:
+        if not jnp.issubdtype(preds.dtype, jnp.floating):
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[1] != num_classes:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds.shape[1]` should be"
+                             " equal to number of classes.")
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                " (N, C, ...), and the shape of `target` should be (N, ...)."
+            )
+    elif preds.ndim == target.ndim:
+        _check_same_shape(preds, target)
+        if multidim_average != "global" and preds.ndim < 2:
+            raise ValueError("Expected input to be at least 2D when multidim_average is set to `samplewise`")
+    else:
+        raise ValueError(
+            "Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be (N, ...)"
+            " and `preds` should be (N, C, ...)."
+        )
+    if _is_tracer(preds, target):
+        return
+    if target.size:
+        unique_values = jnp.unique(target).tolist()
+        bad = [v for v in unique_values if (v < 0 or v >= num_classes) and v != ignore_index]
+        if bad:
+            raise RuntimeError(
+                f"Detected the following values in `target`: {bad} but expected only values in"
+                f" [0, {num_classes}) (ignore_index={ignore_index})."
+            )
+    if preds.ndim == target.ndim and not jnp.issubdtype(preds.dtype, jnp.floating) and preds.size:
+        if int(jnp.max(preds)) >= num_classes or int(jnp.min(preds)) < 0:
+            raise RuntimeError(f"Detected more unique values in `preds` than expected. Expected only {num_classes}.")
+
+
+def _multiclass_stat_scores_format(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+    top_k: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """Convert probabilities/logits to labels (top_k=1) or keep scores
+    (top_k>1); flatten extra dims; build the validity mask."""
+    if preds.ndim == target.ndim + 1:
+        if top_k == 1:
+            preds = jnp.argmax(preds, axis=1)
+        else:
+            # keep class scores: (N, C, extra) -> handled one-hot in update
+            pass
+    if ignore_index is not None:
+        mask = (target != ignore_index).astype(jnp.int32)
+        target = jnp.where(target == ignore_index, 0, target)
+    else:
+        mask = jnp.ones_like(target, dtype=jnp.int32)
+    target = target.astype(jnp.int32)
+
+    if preds.ndim == target.ndim + 1:  # top_k > 1: scores retained
+        preds = preds.reshape(preds.shape[0], num_classes, -1)
+    else:
+        preds = preds.astype(jnp.int32).reshape(preds.shape[0], -1)
+    target = target.reshape(target.shape[0], -1)
+    mask = mask.reshape(mask.shape[0], -1)
+    return preds, target, mask
+
+
+def _multiclass_stat_scores_update(
+    preds: Array,
+    target: Array,
+    mask: Array,
+    num_classes: int,
+    top_k: int = 1,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+) -> Tuple[Array, Array, Array, Array]:
+    """Per-class tp/fp/tn/fn.
+
+    Label path (top_k == 1): weighted bincount over ``target * C + preds``
+    (one scatter-add on TPU). Score path (top_k > 1): multi-hot top-k
+    contraction.
+    """
+    if preds.ndim == target.ndim + 1:  # top_k > 1 score path
+        preds_oh = select_topk(preds, top_k, dim=1)  # (N, C, X)
+        target_oh = jnp.moveaxis(jax.nn.one_hot(target, num_classes, dtype=jnp.int32), -1, 1)  # (N, C, X)
+        m = mask[:, None, :]
+        axis = (0, 2) if multidim_average == "global" else 2
+        tp = jnp.sum(preds_oh * target_oh * m, axis=axis)
+        fp = jnp.sum(preds_oh * (1 - target_oh) * m, axis=axis)
+        fn = jnp.sum((1 - preds_oh) * target_oh * m, axis=axis)
+        tn = jnp.sum((1 - preds_oh) * (1 - target_oh) * m, axis=axis)
+        return tp, fp, tn, fn
+
+    if multidim_average == "global":
+        idx = target * num_classes + preds
+        confmat = _bincount(jnp.where(mask.ravel() == 1, idx.ravel(), num_classes * num_classes),
+                            minlength=num_classes * num_classes + 1)[:-1].reshape(num_classes, num_classes)
+        tp = jnp.diagonal(confmat)
+        fp = jnp.sum(confmat, axis=0) - tp
+        fn = jnp.sum(confmat, axis=1) - tp
+        tn = jnp.sum(confmat) - tp - fp - fn
+        return tp, fp, tn, fn
+
+    # samplewise label path: one-hot contraction per sample
+    preds_oh = jax.nn.one_hot(preds, num_classes, dtype=jnp.int32)  # (N, X, C)
+    target_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.int32)
+    m = mask[..., None]
+    tp = jnp.sum(preds_oh * target_oh * m, axis=1)
+    fp = jnp.sum(preds_oh * (1 - target_oh) * m, axis=1)
+    fn = jnp.sum((1 - preds_oh) * target_oh * m, axis=1)
+    tn = jnp.sum((1 - preds_oh) * (1 - target_oh) * m, axis=1)
+    return tp, fp, tn, fn
+
+
+def _multiclass_stat_scores_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, average: Optional[str] = "macro", multidim_average: str = "global"
+) -> Array:
+    """Apply micro-sum if requested and stack [tp, fp, tn, fn, support]."""
+    res = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    if average == "micro":
+        return jnp.sum(res, axis=-2)
+    return res
+
+
+def multiclass_stat_scores(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    top_k: int = 1,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute per-class tp/fp/tn/fn for multiclass tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multiclass_stat_scores
+        >>> target = jnp.asarray([2, 1, 0, 0])
+        >>> preds = jnp.asarray([2, 1, 0, 1])
+        >>> multiclass_stat_scores(preds, target, num_classes=3, average='micro').tolist()
+        [3, 1, 7, 1, 4]
+    """
+    if validate_args:
+        _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
+    preds, target, mask = _multiclass_stat_scores_format(preds, target, num_classes, ignore_index, top_k)
+    tp, fp, tn, fn = _multiclass_stat_scores_update(
+        preds, target, mask, num_classes, top_k, average, multidim_average
+    )
+    return _multiclass_stat_scores_compute(tp, fp, tn, fn, average, multidim_average)
+
+
+# ----------------------------------------------------------------- multilabel
+
+
+def _multilabel_stat_scores_arg_validation(
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(num_labels, int) or num_labels < 2:
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float, but got {threshold}.")
+    allowed_average = ("micro", "macro", "weighted", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"Expected argument `average` to be one of {allowed_average}, but got {average}")
+    if multidim_average not in ("global", "samplewise"):
+        raise ValueError(
+            f"Expected argument `multidim_average` to be one of ('global', 'samplewise'), but got {multidim_average}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an int, but got {ignore_index}")
+
+
+def _multilabel_stat_scores_tensor_validation(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    _check_same_shape(preds, target)
+    if preds.shape[1] != num_labels:
+        raise ValueError(
+            f"Expected both `target.shape[1]` and `preds.shape[1]` to be equal to the number of labels"
+            f" but got {preds.shape[1]} and expected {num_labels}"
+        )
+    if multidim_average != "global" and preds.ndim < 3:
+        raise ValueError("Expected input to be at least 3D when multidim_average is set to `samplewise`")
+    if _is_tracer(preds, target):
+        return
+    unique_values = jnp.unique(target)
+    allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+    bad = [v for v in unique_values.tolist() if v not in allowed]
+    if bad:
+        raise RuntimeError(
+            f"Detected the following values in `target`: {bad} but expected only"
+            f" the following values {sorted(allowed)}."
+        )
+
+
+def _multilabel_stat_scores_format(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        preds = (preds > threshold).astype(jnp.int32)
+    else:
+        preds = preds.astype(jnp.int32)
+    if ignore_index is not None:
+        mask = (target != ignore_index).astype(jnp.int32)
+        target = jnp.where(target == ignore_index, 0, target)
+    else:
+        mask = jnp.ones_like(target, dtype=jnp.int32)
+    target = target.astype(jnp.int32)
+    preds = preds.reshape(preds.shape[0], num_labels, -1)
+    target = target.reshape(target.shape[0], num_labels, -1)
+    mask = mask.reshape(mask.shape[0], num_labels, -1)
+    return preds, target, mask
+
+
+def _multilabel_stat_scores_update(
+    preds: Array, target: Array, mask: Array, multidim_average: str = "global"
+) -> Tuple[Array, Array, Array, Array]:
+    axis = (0, -1) if multidim_average == "global" else -1
+    tp = jnp.sum((preds == 1) & (target == 1) & (mask == 1), axis=axis)
+    fp = jnp.sum((preds == 1) & (target == 0) & (mask == 1), axis=axis)
+    tn = jnp.sum((preds == 0) & (target == 0) & (mask == 1), axis=axis)
+    fn = jnp.sum((preds == 0) & (target == 1) & (mask == 1), axis=axis)
+    return tp, fp, tn, fn
+
+
+def _multilabel_stat_scores_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, average: Optional[str] = "macro", multidim_average: str = "global"
+) -> Array:
+    res = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    if average == "micro":
+        return jnp.sum(res, axis=-2)
+    return res
+
+
+def multilabel_stat_scores(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute per-label tp/fp/tn/fn for multilabel tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multilabel_stat_scores
+        >>> target = jnp.asarray([[0, 1, 0], [1, 0, 1]])
+        >>> preds = jnp.asarray([[0, 0, 1], [1, 0, 1]])
+        >>> multilabel_stat_scores(preds, target, num_labels=3, average='micro').tolist()
+        [2, 1, 2, 1, 3]
+    """
+    if validate_args:
+        _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index)
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
+    preds, target, mask = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    tp, fp, tn, fn = _multilabel_stat_scores_update(preds, target, mask, multidim_average)
+    return _multilabel_stat_scores_compute(tp, fp, tn, fn, average, multidim_average)
+
+
+# --------------------------------------------------------------- task dispatch
+
+
+def stat_scores(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "micro",
+    multidim_average: str = "global",
+    top_k: int = 1,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-string dispatcher over the binary/multiclass/multilabel variants
+    (reference pattern: task wrapper classes, classification/base.py:19)."""
+    from tpumetrics.utils.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_stat_scores(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_stat_scores(
+            preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_stat_scores(
+            preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

@@ -141,17 +141,25 @@ class MultiHostBackend(DistributedBackend):
         return [jnp.asarray(stacked[i]) for i in range(stacked.shape[0])]
 
     _MAX_NDIM = 8
+    _DTYPE_CODES = ("bool", "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+                    "uint64", "float16", "bfloat16", "float32", "float64", "complex64")
 
     def all_gather(self, x: Array, group: Optional[Any] = None) -> List[Array]:
         x = jnp.atleast_1d(x)
-        # gather (ndim, shape...) as a fixed-width vector so ranks with
-        # different ndims (e.g. a zero-length placeholder from an empty list
-        # state) can still agree on one collective schedule
-        shape_vec = np.full((self._MAX_NDIM + 1,), -1, dtype=np.int64)
+        # gather (ndim, shape..., dtype) as a fixed-width vector so ranks with
+        # different ndims/dtypes (e.g. a zero-length placeholder from an empty
+        # list state) can still agree on one collective schedule
+        shape_vec = np.full((self._MAX_NDIM + 2,), -1, dtype=np.int64)
         shape_vec[0] = x.ndim
         shape_vec[1 : 1 + x.ndim] = x.shape
+        shape_vec[-1] = self._DTYPE_CODES.index(str(x.dtype)) if str(x.dtype) in self._DTYPE_CODES else -1
         all_vecs = [np.asarray(v) for v in self._gather_equal(jnp.asarray(shape_vec))]
         all_shapes = [tuple(int(d) for d in v[1 : 1 + int(v[0])]) for v in all_vecs]
+
+        # a rank with no data (size 0) adopts the dtype of the ranks that have data
+        data_dtypes = [int(v[-1]) for v, s in zip(all_vecs, all_shapes) if int(np.prod(s) if s else 0) > 0]
+        if x.size == 0 and data_dtypes and data_dtypes[0] >= 0:
+            x = x.astype(self._DTYPE_CODES[data_dtypes[0]])
 
         if all(s == all_shapes[0] for s in all_shapes):
             return self._gather_equal(x)
