@@ -1,0 +1,206 @@
+"""MetricCollection behavior tests (counterpart of reference
+tests/unittests/bases/test_collections.py: input forms, renaming, clone,
+compute-group merging correctness, error handling)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpumetrics import MeanMetric, MetricCollection, SumMetric
+from tpumetrics.classification import (
+    BinaryAccuracy,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+
+from tests.conftest import NUM_CLASSES
+
+_preds = jnp.asarray(np.random.default_rng(0).standard_normal((10, 32, NUM_CLASSES)), dtype=jnp.float32)
+_target = jnp.asarray(np.random.default_rng(1).integers(0, NUM_CLASSES, (10, 32)))
+
+
+def test_list_input_keys_are_class_names():
+    mc = MetricCollection([MulticlassAccuracy(num_classes=NUM_CLASSES), MulticlassPrecision(num_classes=NUM_CLASSES)])
+    out = mc(_preds[0], _target[0])
+    assert set(out) == {"MulticlassAccuracy", "MulticlassPrecision"}
+
+
+def test_args_input():
+    mc = MetricCollection(MulticlassAccuracy(num_classes=NUM_CLASSES), MulticlassPrecision(num_classes=NUM_CLASSES))
+    out = mc(_preds[0], _target[0])
+    assert set(out) == {"MulticlassAccuracy", "MulticlassPrecision"}
+
+
+def test_dict_input_and_sorted_keys():
+    mc = MetricCollection(
+        {
+            "micro": MulticlassRecall(num_classes=NUM_CLASSES, average="micro"),
+            "macro": MulticlassRecall(num_classes=NUM_CLASSES, average="macro"),
+        }
+    )
+    assert list(mc.keys()) == ["macro", "micro"]
+    out = mc(_preds[0], _target[0])
+    assert set(out) == {"macro", "micro"}
+
+
+def test_duplicate_class_names_raise():
+    with pytest.raises(ValueError, match="two metrics both named"):
+        MetricCollection([BinaryAccuracy(), BinaryAccuracy()])
+
+
+def test_not_a_metric_raises():
+    with pytest.raises(ValueError, match="not a instance"):
+        MetricCollection([BinaryAccuracy(), "nope"])
+
+
+def test_prefix_postfix():
+    mc = MetricCollection([MulticlassAccuracy(num_classes=NUM_CLASSES)], prefix="val/", postfix="_e1")
+    out = mc(_preds[0], _target[0])
+    assert list(out) == ["val/MulticlassAccuracy_e1"]
+    with pytest.raises(ValueError, match="Expected input `prefix`"):
+        MetricCollection([BinaryAccuracy()], prefix=5)
+
+
+def test_clone_reprefix():
+    mc = MetricCollection([MulticlassAccuracy(num_classes=NUM_CLASSES)], prefix="train_")
+    mc2 = mc.clone(prefix="val_")
+    assert list(mc.keys()) == ["train_MulticlassAccuracy"]
+    assert list(mc2.keys()) == ["val_MulticlassAccuracy"]
+    mc.update(_preds[0], _target[0])
+    assert mc2.MulticlassAccuracy.update_count == 0  # clone is independent
+
+
+def test_nested_collections_flatten():
+    mc = MetricCollection(
+        [
+            MetricCollection([MulticlassAccuracy(num_classes=NUM_CLASSES)], postfix="_macro"),
+            MetricCollection([MulticlassPrecision(num_classes=NUM_CLASSES)], postfix="_micro"),
+        ],
+        prefix="valmetrics/",
+    )
+    out = mc(_preds[0], _target[0])
+    assert set(out) == {"valmetrics/MulticlassAccuracy_macro", "valmetrics/MulticlassPrecision_micro"}
+
+
+def test_compute_groups_formed_and_correct():
+    mc = MetricCollection(
+        MulticlassRecall(num_classes=NUM_CLASSES, average="macro"),
+        MulticlassPrecision(num_classes=NUM_CLASSES, average="macro"),
+        MulticlassF1Score(num_classes=NUM_CLASSES, average="macro"),
+        MulticlassConfusionMatrix(num_classes=NUM_CLASSES),
+        )
+    mc_ref = MetricCollection(
+        MulticlassRecall(num_classes=NUM_CLASSES, average="macro"),
+        MulticlassPrecision(num_classes=NUM_CLASSES, average="macro"),
+        MulticlassF1Score(num_classes=NUM_CLASSES, average="macro"),
+        MulticlassConfusionMatrix(num_classes=NUM_CLASSES),
+        compute_groups=False,
+    )
+    for i in range(4):
+        mc.update(_preds[i], _target[i])
+        mc_ref.update(_preds[i], _target[i])
+    # stat-scores metrics share one group; confusion matrix has its own state
+    groups = {tuple(sorted(v)) for v in mc.compute_groups.values()}
+    assert tuple(sorted(["MulticlassRecall", "MulticlassPrecision", "MulticlassF1Score"])) in groups
+    # before propagation: leaders updated 4 times, members only once (the
+    # group-forming update) — the compute-group cost saving
+    counts = sorted(m._update_count for m in mc._modules.values())
+    assert counts[0] == 1 and counts[-1] == 4
+    out, ref = mc.compute(), mc_ref.compute()
+    for k in ref:
+        np.testing.assert_allclose(np.asarray(out[k]), np.asarray(ref[k]), atol=1e-6)
+
+
+def test_compute_groups_survive_item_access():
+    mc = MetricCollection(
+        MulticlassRecall(num_classes=NUM_CLASSES, average="macro"),
+        MulticlassPrecision(num_classes=NUM_CLASSES, average="macro"),
+    )
+    mc.update(_preds[0], _target[0])
+    _ = mc["MulticlassPrecision"].compute()  # member access forces state propagation
+    mc.update(_preds[1], _target[1])
+    ref = MulticlassRecall(num_classes=NUM_CLASSES, average="macro")
+    ref.update(_preds[0], _target[0])
+    ref.update(_preds[1], _target[1])
+    np.testing.assert_allclose(
+        np.asarray(mc.compute()["MulticlassRecall"]), np.asarray(ref.compute()), atol=1e-6
+    )
+
+
+def test_user_compute_groups_validated():
+    with pytest.raises(ValueError, match="does not match a metric"):
+        MetricCollection(
+            [MulticlassRecall(num_classes=NUM_CLASSES)],
+            compute_groups=[["MulticlassRecall", "DoesNotExist"]],
+        )
+    mc = MetricCollection(
+        MulticlassRecall(num_classes=NUM_CLASSES, average="macro"),
+        MulticlassPrecision(num_classes=NUM_CLASSES, average="macro"),
+        compute_groups=[["MulticlassRecall", "MulticlassPrecision"]],
+    )
+    mc.update(_preds[0], _target[0])
+    assert mc.compute_groups == {0: ["MulticlassRecall", "MulticlassPrecision"]}
+    out = mc.compute()
+    ref = MulticlassPrecision(num_classes=NUM_CLASSES, average="macro")
+    ref.update(_preds[0], _target[0])
+    np.testing.assert_allclose(np.asarray(out["MulticlassPrecision"]), np.asarray(ref.compute()), atol=1e-6)
+
+
+def test_heterogeneous_states_not_grouped():
+    from tpumetrics import MaxMetric
+
+    mc = MetricCollection([SumMetric(), MeanMetric(), MaxMetric()])
+    mc.update(jnp.asarray([0.3, 0.8]))
+    assert len(mc.compute_groups) == 3
+    out = mc.compute()
+    assert abs(float(out["SumMetric"]) - 1.1) < 1e-6
+    assert abs(float(out["MeanMetric"]) - 0.55) < 1e-6
+    assert abs(float(out["MaxMetric"]) - 0.8) < 1e-6
+
+
+def test_reset_resets_all():
+    mc = MetricCollection(
+        MulticlassRecall(num_classes=NUM_CLASSES, average="macro"),
+        MulticlassPrecision(num_classes=NUM_CLASSES, average="macro"),
+    )
+    mc.update(_preds[0], _target[0])
+    mc.reset()
+    assert all(m._update_count == 0 for m in mc._modules.values())
+    mc.update(_preds[1], _target[1])  # re-forms groups and works
+    assert mc.compute() is not None
+
+
+def test_functional_bridge_jit():
+    import jax
+
+    mc = MetricCollection(
+        MulticlassRecall(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+        MulticlassPrecision(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+    )
+    # establish groups with one eager update
+    mc.update(_preds[0], _target[0])
+    mc.reset()
+
+    @jax.jit
+    def step(state, preds, target):
+        new_state = mc.functional_update(state, preds, target)
+        return new_state, mc.functional_compute(new_state)
+
+    state = mc.init_state()
+    assert len(state) == 1  # deduplicated: one group leader carries the state
+    for i in range(3):
+        state, out = step(state, _preds[i], _target[i])
+
+    ref = MetricCollection(
+        MulticlassRecall(num_classes=NUM_CLASSES, average="macro"),
+        MulticlassPrecision(num_classes=NUM_CLASSES, average="macro"),
+    )
+    for i in range(3):
+        ref.update(_preds[i], _target[i])
+    ref_out = ref.compute()
+    for k in ref_out:
+        np.testing.assert_allclose(np.asarray(out[k]), np.asarray(ref_out[k]), atol=1e-6)

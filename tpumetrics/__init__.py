@@ -18,6 +18,7 @@ from tpumetrics.aggregation import (
     RunningSum,
     SumMetric,
 )
+from tpumetrics.collections import MetricCollection
 from tpumetrics.metric import CompositionalMetric, Metric
 
 __all__ = [
@@ -26,6 +27,7 @@ __all__ = [
     "MaxMetric",
     "MeanMetric",
     "Metric",
+    "MetricCollection",
     "MinMetric",
     "RunningMean",
     "RunningSum",

@@ -1,0 +1,450 @@
+"""MetricCollection — chain metrics sharing one call pattern.
+
+Counterpart of reference ``collections.py`` (`MetricCollection` :34, compute
+groups :228-307, `_compute_and_reduce` :313-358, `add_metrics` :388,
+dict-style access :498-549), redesigned for immutable-array state:
+
+The reference shares compute-group state **by mutable reference** — members
+alias the leader's tensors and see its in-place ``+=`` updates
+(reference collections.py:289-307). JAX arrays are immutable and updates
+rebind attributes, so aliasing can't propagate; instead the leader's state is
+**lazily propagated** to group members (array aliasing is free and safe)
+right before any member access — ``compute``/``items``/``values``/
+``__getitem__``/``reset`` — preserving the reference's observable semantics
+including the 1/N update-cost saving of compute groups.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
+
+import jax
+
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import _flatten_dict, allclose
+from tpumetrics.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class MetricCollection:
+    """Dict-like container of metrics updated/computed together
+    (reference collections.py:34).
+
+    Args:
+        metrics: a single metric, a sequence of metrics (keyed by class name),
+            or a dict name -> metric. Nested collections are flattened with
+            their prefix/postfix applied.
+        additional_metrics: more metrics when ``metrics`` is a sequence.
+        prefix: string prepended to every output key.
+        postfix: string appended to every output key.
+        compute_groups: ``True`` (default) to automatically share state
+            between metrics with identical states (e.g. precision/recall/F1
+            all over tp/fp/tn/fn — only the group leader runs ``update``);
+            ``False`` to disable; or an explicit list of lists of names.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import MetricCollection
+        >>> from tpumetrics.classification import MulticlassAccuracy, MulticlassPrecision, MulticlassRecall
+        >>> target = jnp.asarray([0, 2, 0, 2, 0, 1, 0, 2])
+        >>> preds = jnp.asarray([2, 1, 2, 0, 1, 2, 2, 2])
+        >>> metrics = MetricCollection([MulticlassAccuracy(num_classes=3, average='micro'),
+        ...                             MulticlassPrecision(num_classes=3, average='macro'),
+        ...                             MulticlassRecall(num_classes=3, average='macro')])
+        >>> {k: round(float(v), 4) for k, v in metrics(preds, target).items()}
+        {'MulticlassAccuracy': 0.125, 'MulticlassPrecision': 0.0667, 'MulticlassRecall': 0.1111}
+    """
+
+    _modules: "OrderedDict[str, Metric]"
+    _groups: Dict[int, List[str]]
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        compute_groups: Union[bool, List[List[str]]] = True,
+    ) -> None:
+        self._modules = OrderedDict()
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self._enable_compute_groups = compute_groups
+        self._groups_checked: bool = False
+        self._state_is_copy: bool = False
+
+        self.add_metrics(metrics, *additional_metrics)
+
+    # ---------------------------------------------------------------- updates
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Call ``forward`` on every metric; kwargs are routed per signature
+        (reference collections.py:191-198). No compute-group fast path —
+        forward's batch-value semantics need every metric to run."""
+        return self._compute_and_reduce("forward", *args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update every metric — or, once compute groups are established, only
+        each group's leader (reference collections.py:200-226)."""
+        if self._groups_checked:
+            for cg in self._groups.values():
+                m0 = self._modules[cg[0]]
+                m0.update(*args, **m0._filter_kwargs(**kwargs))
+            # leaders advanced: members are stale until the next propagation
+            self._state_is_copy = False
+        else:
+            # first update runs per-metric so states exist to compare
+            for m in self._modules.values():
+                m.update(*args, **m._filter_kwargs(**kwargs))
+            if self._enable_compute_groups:
+                self._merge_compute_groups()
+                self._groups_checked = True
+                self._state_is_copy = True  # members just updated themselves
+
+    def _merge_compute_groups(self) -> None:
+        """Merge groups whose leaders hold value-identical states — O(n²)
+        pairwise comparison after the first update (reference collections.py:228-262)."""
+        num_groups = len(self._groups)
+        while True:
+            for cg_idx1, cg_members1 in list(self._groups.items()):
+                merged = False
+                for cg_idx2, cg_members2 in list(self._groups.items()):
+                    if cg_idx1 == cg_idx2 or cg_idx1 not in self._groups or cg_idx2 not in self._groups:
+                        continue
+                    metric1 = self._modules[cg_members1[0]]
+                    metric2 = self._modules[cg_members2[0]]
+                    if self._equal_metric_states(metric1, metric2):
+                        self._groups[cg_idx1].extend(self._groups.pop(cg_idx2))
+                        merged = True
+                        break
+                if merged:
+                    break
+            if len(self._groups) == num_groups:
+                break
+            num_groups = len(self._groups)
+        self._groups = dict(enumerate(self._groups.values()))
+
+    @staticmethod
+    def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
+        """Value equality of two metrics' full state (reference collections.py:264-287)."""
+        if len(metric1._defaults) == 0 or len(metric2._defaults) == 0:
+            return False
+        if metric1._defaults.keys() != metric2._defaults.keys():
+            return False
+        for key in metric1._defaults:
+            state1 = getattr(metric1, key)
+            state2 = getattr(metric2, key)
+            if type(state1) is not type(state2):
+                return False
+            if isinstance(state1, jax.Array):
+                if state1.shape != state2.shape or not allclose(state1, state2):
+                    return False
+            elif isinstance(state1, list):
+                if len(state1) != len(state2) or not all(
+                    s1.shape == s2.shape and allclose(s1, s2) for s1, s2 in zip(state1, state2)
+                ):
+                    return False
+        return True
+
+    def _compute_groups_create_state_ref(self, copy: bool = False) -> None:
+        """Propagate each group leader's state to its members (reference
+        collections.py:289-307 shares by mutable reference; here arrays are
+        immutable so propagation IS aliasing — free and alias-safe)."""
+        if not self._state_is_copy:
+            for cg in self._groups.values():
+                m0 = self._modules[cg[0]]
+                for name in cg[1:]:
+                    mi = self._modules[name]
+                    for state in m0._defaults:
+                        m0_state = getattr(m0, state)
+                        object.__setattr__(mi, state, list(m0_state) if isinstance(m0_state, list) else m0_state)
+                    mi._update_count = m0._update_count
+                    mi._computed = None
+        self._state_is_copy = copy
+
+    # ---------------------------------------------------------------- results
+
+    def compute(self) -> Dict[str, Any]:
+        """Compute every metric into one flat dict (reference collections.py:309-311)."""
+        return self._compute_and_reduce("compute")
+
+    def _compute_and_reduce(self, method_name: str, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Run compute/forward per metric, flatten dict-valued results, apply
+        prefix/postfix (reference collections.py:313-358)."""
+        if method_name == "compute":
+            self._compute_groups_create_state_ref(copy=False)
+        result = {}
+        for k, m in self._modules.items():
+            if method_name == "compute":
+                res = m.compute()
+            elif method_name == "forward":
+                res = m(*args, **m._filter_kwargs(**kwargs))
+            else:
+                raise ValueError(f"method_name should be either 'compute' or 'forward', but got {method_name}")
+            result[k] = res
+        if method_name == "forward":
+            self._state_is_copy = False  # every metric advanced its own state
+
+        _, duplicates = _flatten_dict(result)
+
+        flattened_results: Dict[str, Any] = {}
+        for k, m in self._modules.items():
+            res = result[k]
+            if isinstance(res, dict):
+                for key, v in res.items():
+                    if duplicates:
+                        stripped_k = k.replace(getattr(m, "prefix", "") or "", "")
+                        stripped_k = stripped_k.replace(getattr(m, "postfix", "") or "", "")
+                        key = f"{stripped_k}_{key}"
+                    if getattr(m, "_from_collection", None) and m.prefix is not None:
+                        key = f"{m.prefix}{key}"
+                    if getattr(m, "_from_collection", None) and m.postfix is not None:
+                        key = f"{key}{m.postfix}"
+                    flattened_results[key] = v
+            else:
+                flattened_results[k] = res
+        return {self._set_name(k): v for k, v in flattened_results.items()}
+
+    def reset(self) -> None:
+        """Reset every metric (reference collections.py:360-366)."""
+        for m in self._modules.values():
+            m.reset()
+        self._state_is_copy = True  # all states are (equal) defaults again
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        """Deep copy, optionally re-keyed (reference collections.py:368-381)."""
+        mc = deepcopy(self)
+        if prefix:
+            mc.prefix = self._check_arg(prefix, "prefix")
+        if postfix:
+            mc.postfix = self._check_arg(postfix, "postfix")
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        for m in self._modules.values():
+            m.persistent(mode)
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Concatenated per-metric state dicts, keyed ``<name>.<state>``."""
+        self._compute_groups_create_state_ref(copy=False)
+        destination: Dict[str, Any] = {}
+        for name, m in self._modules.items():
+            m.state_dict(destination=destination, prefix=f"{name}.")
+        return destination
+
+    def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
+        for name, m in self._modules.items():
+            m.load_state_dict(state_dict, prefix=f"{name}.", strict=strict)
+
+    # ------------------------------------------------------------- containers
+
+    def add_metrics(
+        self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
+    ) -> None:
+        """Add metrics from a metric / sequence / dict / nested collection
+        (reference collections.py:388-459)."""
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence) and not isinstance(metrics, str):
+            metrics = list(metrics)
+            remain: list = []
+            for m in additional_metrics:
+                sel = metrics if isinstance(m, (Metric, MetricCollection)) else remain
+                sel.append(m)
+            if remain:
+                rank_zero_warn(
+                    f"You have passed extra arguments {remain} which are not `Metric` so they will be ignored."
+                )
+        elif additional_metrics:
+            raise ValueError(
+                f"You have passed extra arguments {additional_metrics} which are not compatible"
+                f" with first passed dictionary {metrics} so they will be ignored."
+            )
+
+        if isinstance(metrics, dict):
+            for name in sorted(metrics.keys()):
+                metric = metrics[name]
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Value {metric} belonging to key {name} is not an instance of"
+                        " `tpumetrics.Metric` or `tpumetrics.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        v.postfix = metric.postfix
+                        v.prefix = metric.prefix
+                        v._from_collection = True
+                        self._modules[f"{name}_{k}"] = v
+        elif isinstance(metrics, Sequence):
+            for metric in metrics:
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Input {metric} to `MetricCollection` is not a instance of"
+                        " `tpumetrics.Metric` or `tpumetrics.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    name = metric.__class__.__name__
+                    if name in self._modules:
+                        raise ValueError(f"Encountered two metrics both named {name}")
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        v.postfix = metric.postfix
+                        v.prefix = metric.prefix
+                        v._from_collection = True
+                        self._modules[k] = v
+        else:
+            raise ValueError(
+                "Unknown input to MetricCollection. Expected, `Metric`, `MetricCollection` or `dict`/`sequence` of the"
+                f" previous, but got {metrics}"
+            )
+
+        self._groups_checked = False
+        if self._enable_compute_groups:
+            self._init_compute_groups()
+        else:
+            # singleton groups: no state sharing, but the functional bridge
+            # and group iteration still cover every metric
+            self._groups = {i: [str(k)] for i, k in enumerate(self._modules)}
+
+    def _init_compute_groups(self) -> None:
+        """Seed groups from the user list (validated) or one group per metric
+        (reference collections.py:461-480)."""
+        if isinstance(self._enable_compute_groups, list):
+            self._groups = dict(enumerate(self._enable_compute_groups))
+            for v in self._groups.values():
+                for metric in v:
+                    if metric not in self._modules:
+                        raise ValueError(
+                            f"Input {metric} in `compute_groups` argument does not match a metric in the"
+                            f" collection. Please make sure that {self._enable_compute_groups} matches"
+                            f" {list(self._modules)}"
+                        )
+            self._groups_checked = True
+        else:
+            self._groups = {i: [str(k)] for i, k in enumerate(self._modules)}
+
+    @property
+    def compute_groups(self) -> Dict[int, List[str]]:
+        """Current compute groups (reference collections.py:482-485)."""
+        return self._groups
+
+    def _set_name(self, base: str) -> str:
+        name = base if self.prefix is None else self.prefix + base
+        return name if self.postfix is None else name + self.postfix
+
+    def _to_renamed_dict(self) -> "OrderedDict[str, Metric]":
+        od: "OrderedDict[str, Metric]" = OrderedDict()
+        for k, v in self._modules.items():
+            od[self._set_name(k)] = v
+        return od
+
+    def __iter__(self) -> Iterator[Hashable]:
+        return iter(self.keys())
+
+    def __len__(self) -> int:
+        return len(self._modules)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._modules
+
+    def keys(self, keep_base: bool = False) -> Iterable[Hashable]:
+        if keep_base:
+            return self._modules.keys()
+        return self._to_renamed_dict().keys()
+
+    def items(self, keep_base: bool = False, copy_state: bool = True) -> Iterable[Tuple[str, Metric]]:
+        """Key/metric pairs; propagates group state to members first
+        (reference collections.py:514-526)."""
+        self._compute_groups_create_state_ref(copy_state)
+        if keep_base:
+            return self._modules.items()
+        return self._to_renamed_dict().items()
+
+    def values(self, copy_state: bool = True) -> Iterable[Metric]:
+        self._compute_groups_create_state_ref(copy_state)
+        return self._modules.values()
+
+    def __getitem__(self, key: str) -> Metric:
+        self._compute_groups_create_state_ref(copy=True)
+        return self._modules[key]
+
+    def __getattr__(self, name: str) -> Any:
+        modules = self.__dict__.get("_modules")
+        if modules is not None and name in modules:
+            # member access must see the group leader's latest state, same as
+            # __getitem__ — otherwise grouped metrics read stale results
+            self._compute_groups_create_state_ref(copy=True)
+            return modules[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
+
+    def __repr__(self) -> str:
+        repr_str = self.__class__.__name__ + "(\n  "
+        repr_str += ",\n  ".join(f"{k}: {v!r}" for k, v in self._modules.items())
+        if self.prefix:
+            repr_str += f",\n  prefix={self.prefix}"
+        if self.postfix:
+            repr_str += f",\n  postfix={self.postfix}"
+        return repr_str + "\n)"
+
+    def set_dtype(self, dst_type: Any) -> "MetricCollection":
+        for m in self._modules.values():
+            m.set_dtype(dst_type)
+        return self
+
+    def to(self, device: Any) -> "MetricCollection":
+        for m in self._modules.values():
+            m.to(device)
+        return self
+
+    # ------------------------------------------------------ functional bridge
+
+    def init_state(self) -> Dict[str, Dict[str, Any]]:
+        """Fresh per-metric state pytrees, deduplicated by compute group: only
+        group leaders carry state (name -> state dict)."""
+        self._compute_groups_create_state_ref(copy=False)
+        return {cg[0]: self._modules[cg[0]].init_state() for cg in self._groups.values()}
+
+    def functional_update(self, state: Dict[str, Dict[str, Any]], *args: Any, **kwargs: Any) -> Dict[str, Dict[str, Any]]:
+        """Pure collection update: one update per compute group leader —
+        the compute-group saving, inside jit."""
+        out = {}
+        for cg in self._groups.values():
+            m0 = self._modules[cg[0]]
+            out[cg[0]] = m0.functional_update(state[cg[0]], *args, **m0._filter_kwargs(**kwargs))
+        return out
+
+    def functional_compute(
+        self, state: Dict[str, Dict[str, Any]], axis_name: Optional[Any] = None
+    ) -> Dict[str, Any]:
+        """Pure collection compute from group-leader states; each member
+        computes from its leader's (synced) state."""
+        results: Dict[str, Any] = {}
+        for cg in self._groups.values():
+            leader = self._modules[cg[0]]
+            synced = leader.sync_state(state[cg[0]], _axis_backend(axis_name)) if axis_name is not None else state[cg[0]]
+            for name in cg:
+                m = self._modules[name]
+                results[name] = m.functional_compute(synced)
+        flattened, _ = _flatten_dict({k: v for k, v in results.items()})
+        return {self._set_name(k): v for k, v in flattened.items()}
+
+
+def _axis_backend(axis_name: Any) -> Any:
+    from tpumetrics.parallel.backend import AxisBackend
+
+    return AxisBackend(axis_name)
