@@ -1,0 +1,244 @@
+"""Curve family (PR-curve/ROC/AUROC/AveragePrecision + fixed-point metrics)
+validated against sklearn (counterpart of reference
+tests/unittests/classification/test_{precision_recall_curve,roc,auroc,
+average_precision,recall_fixed_precision,specificity_sensitivity}.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    average_precision_score as sk_average_precision,
+    precision_recall_curve as sk_precision_recall_curve,
+    roc_auc_score as sk_roc_auc,
+    roc_curve as sk_roc_curve,
+)
+
+import tpumetrics.classification as tmc
+import tpumetrics.functional.classification as tmf
+from tests.classification import inputs
+from tests.conftest import NUM_CLASSES
+from tests.helpers.testers import MetricTester
+
+
+def _softmax(x):
+    e = np.exp(x - x.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+class TestBinaryCurves(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_auroc_exact_vs_sklearn(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=[jnp.asarray(p) for p in inputs.binary_probs_preds],
+            target=[jnp.asarray(t) for t in inputs.binary_target],
+            metric_class=tmc.BinaryAUROC,
+            reference_metric=lambda p, t: sk_roc_auc(t.ravel(), p.ravel()),
+            check_batch=False,
+            shard_map_mode=False,  # exact path computes eagerly (dynamic shapes)
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_auroc_binned_vs_sklearn(self, ddp):
+        # dense threshold grid: binned result is within grid resolution of exact
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=[jnp.asarray(p) for p in inputs.binary_probs_preds],
+            target=[jnp.asarray(t) for t in inputs.binary_target],
+            metric_class=tmc.BinaryAUROC,
+            reference_metric=lambda p, t: sk_roc_auc(t.ravel(), p.ravel()),
+            metric_args={"thresholds": 2000},
+            check_batch=False,
+        )
+        # functional parity
+        p, t = inputs.binary_probs_preds[0], inputs.binary_target[0]
+        exact = float(tmf.binary_auroc(jnp.asarray(p), jnp.asarray(t)))
+        binned = float(tmf.binary_auroc(jnp.asarray(p), jnp.asarray(t), thresholds=2000))
+        assert abs(exact - binned) < 5e-3
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_average_precision_vs_sklearn(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=[jnp.asarray(p) for p in inputs.binary_probs_preds],
+            target=[jnp.asarray(t) for t in inputs.binary_target],
+            metric_class=tmc.BinaryAveragePrecision,
+            reference_metric=lambda p, t: sk_average_precision(t.ravel(), p.ravel()),
+            check_batch=False,
+            shard_map_mode=False,
+        )
+
+    def test_pr_curve_exact_vs_sklearn(self):
+        p = np.concatenate(inputs.binary_probs_preds)
+        t = np.concatenate(inputs.binary_target)
+        precision, recall, thresholds = tmf.binary_precision_recall_curve(jnp.asarray(p), jnp.asarray(t))
+        sp, sr, st = sk_precision_recall_curve(t, p)
+        np.testing.assert_allclose(np.asarray(precision), sp, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(recall), sr, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(thresholds), st, atol=1e-6)
+
+    def test_roc_exact_vs_sklearn(self):
+        p = np.concatenate(inputs.binary_probs_preds)
+        t = np.concatenate(inputs.binary_target)
+        fpr, tpr, _ = tmf.binary_roc(jnp.asarray(p), jnp.asarray(t))
+        sf, st_, _ = sk_roc_curve(t, p, drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fpr), sf, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(tpr), st_, atol=1e-6)
+
+    def test_pr_curve_class_binned_state_is_jittable(self):
+        import jax
+
+        metric = tmc.BinaryPrecisionRecallCurve(thresholds=50, validate_args=False)
+
+        @jax.jit
+        def step(state, p, t):
+            return metric.functional_update(state, p, t)
+
+        state = metric.init_state()
+        for i in range(2):
+            state = step(state, jnp.asarray(inputs.binary_probs_preds[i]), jnp.asarray(inputs.binary_target[i]))
+        precision, recall, thresholds = metric.functional_compute(state)
+        assert precision.shape == (51,)
+
+    def test_recall_at_fixed_precision(self):
+        p = np.concatenate(inputs.binary_probs_preds)
+        t = np.concatenate(inputs.binary_target)
+        for min_precision in (0.2, 0.5, 0.8):
+            r, thr = tmf.binary_recall_at_fixed_precision(jnp.asarray(p), jnp.asarray(t), min_precision)
+            # brute-force reference over the sklearn PR curve
+            sp, sr, st = sk_precision_recall_curve(t, p)
+            valid = sp[:-1] >= min_precision
+            best = sr[:-1][valid].max() if valid.any() else 0.0
+            assert abs(float(r) - best) < 1e-6
+
+    def test_precision_at_fixed_recall(self):
+        p = np.concatenate(inputs.binary_probs_preds)
+        t = np.concatenate(inputs.binary_target)
+        for min_recall in (0.2, 0.5, 0.8):
+            pr, thr = tmf.binary_precision_at_fixed_recall(jnp.asarray(p), jnp.asarray(t), min_recall)
+            sp, sr, st = sk_precision_recall_curve(t, p)
+            valid = sr[:-1] >= min_recall
+            best = sp[:-1][valid].max() if valid.any() else 0.0
+            assert abs(float(pr) - best) < 1e-6
+
+    def test_specificity_at_sensitivity(self):
+        p = np.concatenate(inputs.binary_probs_preds)
+        t = np.concatenate(inputs.binary_target)
+        spec, thr = tmf.binary_specificity_at_sensitivity(jnp.asarray(p), jnp.asarray(t), 0.5)
+        fpr, tpr, _ = sk_roc_curve(t, p, drop_intermediate=False)
+        best = (1 - fpr)[tpr >= 0.5].max()
+        assert abs(float(spec) - best) < 1e-6
+
+
+class TestMulticlassCurves(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("average", ["macro", "weighted"])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_auroc_vs_sklearn(self, average, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=[jnp.asarray(_softmax(p)) for p in inputs.multiclass_logits_preds],
+            target=[jnp.asarray(t) for t in inputs.multiclass_target],
+            metric_class=tmc.MulticlassAUROC,
+            reference_metric=lambda p, t: sk_roc_auc(t, p, multi_class="ovr", average=average),
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+            check_batch=False,
+            shard_map_mode=False,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_average_precision_vs_sklearn(self, ddp):
+        def ref(p, t):
+            onehot = np.eye(NUM_CLASSES)[t.astype(int)]
+            return sk_average_precision(onehot, p, average="macro")
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=[jnp.asarray(_softmax(p)) for p in inputs.multiclass_logits_preds],
+            target=[jnp.asarray(t) for t in inputs.multiclass_target],
+            metric_class=tmc.MulticlassAveragePrecision,
+            reference_metric=ref,
+            metric_args={"num_classes": NUM_CLASSES},
+            check_batch=False,
+            shard_map_mode=False,
+        )
+
+    def test_binned_auroc_close_to_exact(self):
+        p = _softmax(np.concatenate(inputs.multiclass_logits_preds))
+        t = np.concatenate(inputs.multiclass_target)
+        exact = float(tmf.multiclass_auroc(jnp.asarray(p), jnp.asarray(t), NUM_CLASSES))
+        binned = float(tmf.multiclass_auroc(jnp.asarray(p), jnp.asarray(t), NUM_CLASSES, thresholds=2000))
+        assert abs(exact - binned) < 5e-3
+
+    def test_roc_curves_match_sklearn_per_class(self):
+        p = _softmax(np.concatenate(inputs.multiclass_logits_preds))
+        t = np.concatenate(inputs.multiclass_target)
+        fprs, tprs, _ = tmf.multiclass_roc(jnp.asarray(p), jnp.asarray(t), NUM_CLASSES)
+        for i in range(NUM_CLASSES):
+            sf, st_, _ = sk_roc_curve((t == i).astype(int), p[:, i], drop_intermediate=False)
+            np.testing.assert_allclose(np.asarray(fprs[i]), sf, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(tprs[i]), st_, atol=1e-6)
+
+
+class TestMultilabelCurves(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("average", ["macro", "micro"])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_auroc_vs_sklearn(self, average, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=[jnp.asarray(p) for p in inputs.multilabel_probs_preds],
+            target=[jnp.asarray(t) for t in inputs.multilabel_target],
+            metric_class=tmc.MultilabelAUROC,
+            reference_metric=lambda p, t: sk_roc_auc(t, p, average=average),
+            metric_args={"num_labels": NUM_CLASSES, "average": average},
+            check_batch=False,
+            shard_map_mode=False,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_average_precision_vs_sklearn(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=[jnp.asarray(p) for p in inputs.multilabel_probs_preds],
+            target=[jnp.asarray(t) for t in inputs.multilabel_target],
+            metric_class=tmc.MultilabelAveragePrecision,
+            reference_metric=lambda p, t: sk_average_precision(t, p, average="macro"),
+            metric_args={"num_labels": NUM_CLASSES},
+            check_batch=False,
+            shard_map_mode=False,
+        )
+
+
+def test_binned_class_ddp_shard_map():
+    """Binned AUROC state syncs inside shard_map (the TPU pod path)."""
+    from tests.helpers.testers import _class_test_shard_map
+
+    _class_test_shard_map(
+        preds=[jnp.asarray(p) for p in inputs.binary_probs_preds],
+        target=[jnp.asarray(t) for t in inputs.binary_target],
+        metric_class=tmc.BinaryAUROC,
+        reference_metric=lambda p, t: sk_roc_auc(t.ravel(), p.ravel()),
+        metric_args={"thresholds": 2000, "validate_args": False},
+        atol=5e-3,
+    )
+
+
+def test_task_wrappers_dispatch():
+    assert isinstance(tmc.AUROC(task="binary"), tmc.BinaryAUROC)
+    assert isinstance(tmc.ROC(task="multiclass", num_classes=3), tmc.MulticlassROC)
+    assert isinstance(tmc.PrecisionRecallCurve(task="multilabel", num_labels=3), tmc.MultilabelPrecisionRecallCurve)
+    assert isinstance(tmc.AveragePrecision(task="binary"), tmc.BinaryAveragePrecision)
+    assert isinstance(
+        tmc.RecallAtFixedPrecision(task="binary", min_precision=0.5), tmc.BinaryRecallAtFixedPrecision
+    )
+    assert isinstance(
+        tmc.PrecisionAtFixedRecall(task="binary", min_recall=0.5), tmc.BinaryPrecisionAtFixedRecall
+    )
+    assert isinstance(
+        tmc.SpecificityAtSensitivity(task="binary", min_sensitivity=0.5), tmc.BinarySpecificityAtSensitivity
+    )

@@ -1,0 +1,290 @@
+"""Calibration/kappa/MCC/jaccard/hinge/dice/ranking/fairness validated against
+sklearn or manual numpy references (counterpart of reference
+tests/unittests/classification/test_{calibration_error,cohen_kappa,
+matthews_corrcoef,jaccard,hinge,dice,ranking,group_fairness}.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    cohen_kappa_score as sk_cohen_kappa,
+    coverage_error as sk_coverage_error,
+    f1_score as sk_f1,
+    jaccard_score as sk_jaccard,
+    label_ranking_average_precision_score as sk_lrap,
+    label_ranking_loss as sk_ranking_loss,
+    matthews_corrcoef as sk_mcc,
+)
+
+import tpumetrics.classification as tmc
+import tpumetrics.functional.classification as tmf
+from tests.classification import inputs
+from tests.conftest import NUM_CLASSES
+from tests.helpers.testers import MetricTester
+
+
+def _labels(p):
+    p = np.asarray(p)
+    if p.dtype.kind == "f":
+        if p.ndim >= 2 and p.shape[-1] == NUM_CLASSES:
+            return p.argmax(-1)
+        return (p >= 0.5).astype(int)
+    return p
+
+
+class TestCohenKappa(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binary_vs_sklearn(self, weights, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=[jnp.asarray(p) for p in inputs.binary_probs_preds],
+            target=[jnp.asarray(t) for t in inputs.binary_target],
+            metric_class=tmc.BinaryCohenKappa,
+            reference_metric=lambda p, t: sk_cohen_kappa(t.ravel(), _labels(p).ravel(), weights=weights),
+            metric_args={"weights": weights},
+            check_batch=False,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_multiclass_vs_sklearn(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=[jnp.asarray(p) for p in inputs.multiclass_label_preds],
+            target=[jnp.asarray(t) for t in inputs.multiclass_target],
+            metric_class=tmc.MulticlassCohenKappa,
+            reference_metric=lambda p, t: sk_cohen_kappa(t.ravel(), p.ravel()),
+            metric_args={"num_classes": NUM_CLASSES},
+            check_batch=False,
+        )
+
+
+class TestMatthewsCorrCoef(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binary_vs_sklearn(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=[jnp.asarray(p) for p in inputs.binary_probs_preds],
+            target=[jnp.asarray(t) for t in inputs.binary_target],
+            metric_class=tmc.BinaryMatthewsCorrCoef,
+            reference_metric=lambda p, t: sk_mcc(t.ravel(), _labels(p).ravel()),
+            check_batch=False,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_multiclass_vs_sklearn(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=[jnp.asarray(p) for p in inputs.multiclass_label_preds],
+            target=[jnp.asarray(t) for t in inputs.multiclass_target],
+            metric_class=tmc.MulticlassMatthewsCorrCoef,
+            reference_metric=lambda p, t: sk_mcc(t.ravel(), p.ravel()),
+            metric_args={"num_classes": NUM_CLASSES},
+            check_batch=False,
+        )
+
+
+class TestJaccard(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binary_vs_sklearn(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=[jnp.asarray(p) for p in inputs.binary_probs_preds],
+            target=[jnp.asarray(t) for t in inputs.binary_target],
+            metric_class=tmc.BinaryJaccardIndex,
+            reference_metric=lambda p, t: sk_jaccard(t.ravel(), _labels(p).ravel()),
+            check_batch=False,
+        )
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_multiclass_vs_sklearn(self, average, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=[jnp.asarray(p) for p in inputs.multiclass_label_preds],
+            target=[jnp.asarray(t) for t in inputs.multiclass_target],
+            metric_class=tmc.MulticlassJaccardIndex,
+            reference_metric=lambda p, t: sk_jaccard(t.ravel(), p.ravel(), average=average),
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+            check_batch=False,
+        )
+
+    @pytest.mark.parametrize("average", ["micro", "macro"])
+    def test_multilabel_vs_sklearn(self, average):
+        p = np.concatenate(inputs.multilabel_label_preds)
+        t = np.concatenate(inputs.multilabel_target)
+        res = tmf.multilabel_jaccard_index(jnp.asarray(p), jnp.asarray(t), NUM_CLASSES, average=average)
+        ref = sk_jaccard(t, p, average=average)
+        assert abs(float(res) - ref) < 1e-6
+
+
+class TestCalibrationError(MetricTester):
+    atol = 1e-6
+
+    @staticmethod
+    def _manual_ece(conf, acc, n_bins, norm):
+        edges = np.linspace(0, 1, n_bins + 1)
+        idx = np.clip(np.searchsorted(edges[1:-1], conf, side="right"), 0, n_bins - 1)
+        errs, props = [], []
+        for b in range(n_bins):
+            m = idx == b
+            if m.sum() == 0:
+                continue
+            errs.append(abs(acc[m].mean() - conf[m].mean()))
+            props.append(m.mean())
+        errs, props = np.asarray(errs), np.asarray(props)
+        if norm == "l1":
+            return float((errs * props).sum())
+        if norm == "max":
+            return float(errs.max())
+        return float(np.sqrt((errs**2 * props).sum()))
+
+    @pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binary(self, norm, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=[jnp.asarray(p) for p in inputs.binary_probs_preds],
+            target=[jnp.asarray(t) for t in inputs.binary_target],
+            metric_class=tmc.BinaryCalibrationError,
+            reference_metric=lambda p, t: self._manual_ece(p.ravel(), t.ravel(), 15, norm),
+            metric_args={"n_bins": 15, "norm": norm},
+            check_batch=False,
+            shard_map_mode=False,
+        )
+
+    def test_multiclass(self):
+        p = np.concatenate(inputs.multiclass_logits_preds)
+        e = np.exp(p - p.max(-1, keepdims=True))
+        probs = e / e.sum(-1, keepdims=True)
+        t = np.concatenate(inputs.multiclass_target)
+        res = tmf.multiclass_calibration_error(jnp.asarray(probs), jnp.asarray(t), NUM_CLASSES)
+        conf = probs.max(-1)
+        acc = (probs.argmax(-1) == t).astype(float)
+        ref = self._manual_ece(conf, acc, 15, "l1")
+        assert abs(float(res) - ref) < 1e-6
+
+
+class TestHinge(MetricTester):
+    @pytest.mark.parametrize("squared", [False, True])
+    def test_binary_manual(self, squared):
+        p = np.concatenate(inputs.binary_probs_preds)
+        t = np.concatenate(inputs.binary_target)
+        res = tmf.binary_hinge_loss(jnp.asarray(p), jnp.asarray(t), squared=squared)
+        margin = np.where(t == 1, p, -p)
+        measures = np.maximum(1 - margin, 0)
+        ref = (measures**2 if squared else measures).mean()
+        assert abs(float(res) - ref) < 1e-5
+
+    def test_multiclass_crammer_singer_manual(self):
+        logits = np.concatenate(inputs.multiclass_logits_preds)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        probs = e / e.sum(-1, keepdims=True)
+        t = np.concatenate(inputs.multiclass_target)
+        res = tmf.multiclass_hinge_loss(jnp.asarray(probs), jnp.asarray(t), NUM_CLASSES)
+        n = len(t)
+        pred_t = probs[np.arange(n), t]
+        masked = probs.copy()
+        masked[np.arange(n), t] = -np.inf
+        margin = pred_t - masked.max(-1)
+        ref = np.maximum(1 - margin, 0).mean()
+        assert abs(float(res) - ref) < 1e-5
+
+
+class TestDice(MetricTester):
+    def test_micro_equals_sklearn_f1_micro(self):
+        p = np.concatenate(inputs.multiclass_label_preds)
+        t = np.concatenate(inputs.multiclass_target)
+        res = tmf.dice(jnp.asarray(p), jnp.asarray(t), average="micro", num_classes=NUM_CLASSES)
+        ref = sk_f1(t, p, average="micro")
+        assert abs(float(res) - ref) < 1e-6
+
+    def test_macro_equals_sklearn_f1_macro(self):
+        p = np.concatenate(inputs.multiclass_label_preds)
+        t = np.concatenate(inputs.multiclass_target)
+        res = tmf.dice(jnp.asarray(p), jnp.asarray(t), average="macro", num_classes=NUM_CLASSES)
+        ref = sk_f1(t, p, average="macro")
+        assert abs(float(res) - ref) < 1e-6
+
+    def test_class_accumulates(self):
+        m = tmc.Dice(average="micro")
+        for i in range(4):
+            m.update(jnp.asarray(inputs.multiclass_label_preds[i]), jnp.asarray(inputs.multiclass_target[i]))
+        p = np.concatenate(inputs.multiclass_label_preds[:4])
+        t = np.concatenate(inputs.multiclass_target[:4])
+        assert abs(float(m.compute()) - sk_f1(t, p, average="micro")) < 1e-6
+
+
+class TestRanking(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize(
+        ("metric_class", "functional", "sk_fn"),
+        [
+            (tmc.MultilabelCoverageError, tmf.multilabel_coverage_error, sk_coverage_error),
+            (tmc.MultilabelRankingAveragePrecision, tmf.multilabel_ranking_average_precision, sk_lrap),
+            (tmc.MultilabelRankingLoss, tmf.multilabel_ranking_loss, sk_ranking_loss),
+        ],
+    )
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_vs_sklearn(self, metric_class, functional, sk_fn, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=[jnp.asarray(p) for p in inputs.multilabel_probs_preds],
+            target=[jnp.asarray(t) for t in inputs.multilabel_target],
+            metric_class=metric_class,
+            reference_metric=lambda p, t: sk_fn(t, p),
+            metric_args={"num_labels": NUM_CLASSES},
+            check_batch=False,
+        )
+
+
+class TestGroupFairness(MetricTester):
+    def test_group_stat_rates_manual(self):
+        rng = np.random.default_rng(5)
+        p = rng.integers(0, 2, 200)
+        t = rng.integers(0, 2, 200)
+        g = rng.integers(0, 3, 200)
+        res = tmf.binary_groups_stat_rates(jnp.asarray(p), jnp.asarray(t), jnp.asarray(g), 3)
+        for gi in range(3):
+            m = g == gi
+            tp = ((p == 1) & (t == 1) & m).sum()
+            fp = ((p == 1) & (t == 0) & m).sum()
+            tn = ((p == 0) & (t == 0) & m).sum()
+            fn = ((p == 0) & (t == 1) & m).sum()
+            total = tp + fp + tn + fn
+            np.testing.assert_allclose(
+                np.asarray(res[f"group_{gi}"]), np.array([tp, fp, tn, fn]) / total, atol=1e-6
+            )
+
+    def test_fairness_metrics(self):
+        rng = np.random.default_rng(6)
+        p = rng.random(500).astype(np.float32)
+        t = rng.integers(0, 2, 500)
+        g = rng.integers(0, 2, 500)
+        res = tmf.binary_fairness(jnp.asarray(p), jnp.asarray(t), jnp.asarray(g), task="all")
+        hard = (p >= 0.5).astype(int)
+        pos_rates = np.array([hard[g == i].mean() for i in range(2)])
+        tprs = np.array([hard[(g == i) & (t == 1)].mean() for i in range(2)])
+        dp_key = [k for k in res if k.startswith("DP")][0]
+        eo_key = [k for k in res if k.startswith("EO")][0]
+        assert abs(float(res[dp_key]) - pos_rates.min() / pos_rates.max()) < 1e-6
+        assert abs(float(res[eo_key]) - tprs.min() / tprs.max()) < 1e-6
+
+    def test_modular_fairness(self):
+        rng = np.random.default_rng(7)
+        m = tmc.BinaryFairness(num_groups=2)
+        for _ in range(3):
+            m.update(
+                jnp.asarray(rng.random(64).astype(np.float32)),
+                jnp.asarray(rng.integers(0, 2, 64)),
+                jnp.asarray(rng.integers(0, 2, 64)),
+            )
+        out = m.compute()
+        assert any(k.startswith("DP") for k in out) and any(k.startswith("EO") for k in out)

@@ -1,0 +1,190 @@
+"""Modular AUROC metrics (counterpart of reference ``classification/auroc.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from tpumetrics.classification.base import _ClassificationTaskWrapper
+from tpumetrics.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from tpumetrics.functional.classification.auroc import (
+    _binary_auroc_arg_validation,
+    _binary_auroc_compute,
+    _multiclass_auroc_arg_validation,
+    _multiclass_auroc_compute,
+    _multilabel_auroc_arg_validation,
+    _multilabel_auroc_compute,
+)
+from tpumetrics.functional.classification.precision_recall_curve import Thresholds
+from tpumetrics.metric import Metric
+from tpumetrics.utils.enums import ClassificationTask
+
+Array = jax.Array
+
+
+class BinaryAUROC(BinaryPrecisionRecallCurve):
+    """Area under the ROC curve, binary tasks (reference classification/auroc.py:35).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import BinaryAUROC
+        >>> metric = BinaryAUROC()
+        >>> metric.update(jnp.asarray([0.1, 0.4, 0.35, 0.8]), jnp.asarray([0, 0, 1, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.75
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        max_fpr: Optional[float] = None,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs)
+        if validate_args:
+            _binary_auroc_arg_validation(max_fpr, thresholds, ignore_index)
+        self.max_fpr = max_fpr
+        self.validate_args = validate_args
+
+    def compute(self) -> Array:
+        return _binary_auroc_compute(self._final_state(), self.thresholds, self.max_fpr)
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)
+
+
+class MulticlassAUROC(MulticlassPrecisionRecallCurve):
+    """AUROC over one-vs-rest curves, multiclass (reference classification/auroc.py:146).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MulticlassAUROC
+        >>> metric = MulticlassAUROC(num_classes=3)
+        >>> metric.update(jnp.asarray([[0.8, 0.1, 0.1], [0.1, 0.8, 0.1], [0.1, 0.1, 0.8]]),
+        ...               jnp.asarray([0, 1, 2]))
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    plot_legend_name: str = "Class"
+
+    def __init__(
+        self,
+        num_classes: int,
+        average: Optional[str] = "macro",
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        # curve-state average stays None; `average` here is the AUC reduction
+        super().__init__(
+            num_classes=num_classes, thresholds=thresholds, average=None,
+            ignore_index=ignore_index, validate_args=False, **kwargs,
+        )
+        if validate_args:
+            _multiclass_auroc_arg_validation(num_classes, average, thresholds, ignore_index)
+        self.average_auroc = average
+        self.validate_args = validate_args
+
+    def compute(self) -> Array:
+        return _multiclass_auroc_compute(
+            self._final_state(), self.num_classes, self.average_auroc, self.thresholds
+        )
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)
+
+
+class MultilabelAUROC(MultilabelPrecisionRecallCurve):
+    """AUROC over per-label curves, multilabel (reference classification/auroc.py:263).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MultilabelAUROC
+        >>> metric = MultilabelAUROC(num_labels=2)
+        >>> metric.update(jnp.asarray([[0.8, 0.1], [0.1, 0.8]]), jnp.asarray([[1, 0], [0, 1]]))
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    plot_legend_name: str = "Label"
+
+    def __init__(
+        self,
+        num_labels: int,
+        average: Optional[str] = "macro",
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index,
+            validate_args=False, **kwargs,
+        )
+        if validate_args:
+            _multilabel_auroc_arg_validation(num_labels, average, thresholds, ignore_index)
+        self.average_auroc = average
+        self.validate_args = validate_args
+
+    def compute(self) -> Array:
+        return _multilabel_auroc_compute(
+            self._final_state(), self.num_labels, self.average_auroc, self.thresholds, self.ignore_index
+        )
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)
+
+
+class AUROC(_ClassificationTaskWrapper):
+    """Task-string wrapper for AUROC (reference classification/auroc.py:391)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        thresholds: Thresholds = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        average: Optional[str] = "macro",
+        max_fpr: Optional[float] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryAUROC(max_fpr, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassAUROC(num_classes, average, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelAUROC(num_labels, average, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

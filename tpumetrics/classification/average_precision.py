@@ -1,0 +1,176 @@
+"""Modular AveragePrecision metrics (counterpart of reference
+``classification/average_precision.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from tpumetrics.classification.base import _ClassificationTaskWrapper
+from tpumetrics.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from tpumetrics.functional.classification.average_precision import (
+    _binary_average_precision_compute,
+    _multiclass_average_precision_arg_validation,
+    _multiclass_average_precision_compute,
+    _multilabel_average_precision_arg_validation,
+    _multilabel_average_precision_compute,
+)
+from tpumetrics.functional.classification.precision_recall_curve import Thresholds
+from tpumetrics.metric import Metric
+from tpumetrics.utils.enums import ClassificationTask
+
+Array = jax.Array
+
+
+class BinaryAveragePrecision(BinaryPrecisionRecallCurve):
+    """Average precision for binary tasks (reference classification/average_precision.py:34).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import BinaryAveragePrecision
+        >>> metric = BinaryAveragePrecision()
+        >>> metric.update(jnp.asarray([0.1, 0.4, 0.35, 0.8]), jnp.asarray([0, 0, 1, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.8333
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def compute(self) -> Array:
+        return _binary_average_precision_compute(self._final_state(), self.thresholds)
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)
+
+
+class MulticlassAveragePrecision(MulticlassPrecisionRecallCurve):
+    """Average precision over one-vs-rest PR curves, multiclass (reference
+    classification/average_precision.py:143).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MulticlassAveragePrecision
+        >>> metric = MulticlassAveragePrecision(num_classes=3)
+        >>> metric.update(jnp.asarray([[0.8, 0.1, 0.1], [0.1, 0.8, 0.1], [0.1, 0.1, 0.8]]),
+        ...               jnp.asarray([0, 1, 2]))
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    plot_legend_name: str = "Class"
+
+    def __init__(
+        self,
+        num_classes: int,
+        average: Optional[str] = "macro",
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, thresholds=thresholds, average=None,
+            ignore_index=ignore_index, validate_args=False, **kwargs,
+        )
+        if validate_args:
+            _multiclass_average_precision_arg_validation(num_classes, average, thresholds, ignore_index)
+        self.average_ap = average
+        self.validate_args = validate_args
+
+    def compute(self) -> Array:
+        return _multiclass_average_precision_compute(
+            self._final_state(), self.num_classes, self.average_ap, self.thresholds
+        )
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)
+
+
+class MultilabelAveragePrecision(MultilabelPrecisionRecallCurve):
+    """Average precision over per-label PR curves, multilabel (reference
+    classification/average_precision.py:261).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MultilabelAveragePrecision
+        >>> metric = MultilabelAveragePrecision(num_labels=2)
+        >>> metric.update(jnp.asarray([[0.8, 0.1], [0.1, 0.8]]), jnp.asarray([[1, 0], [0, 1]]))
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    plot_legend_name: str = "Label"
+
+    def __init__(
+        self,
+        num_labels: int,
+        average: Optional[str] = "macro",
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index,
+            validate_args=False, **kwargs,
+        )
+        if validate_args:
+            _multilabel_average_precision_arg_validation(num_labels, average, thresholds, ignore_index)
+        self.average_ap = average
+        self.validate_args = validate_args
+
+    def compute(self) -> Array:
+        return _multilabel_average_precision_compute(
+            self._final_state(), self.num_labels, self.average_ap, self.thresholds, self.ignore_index
+        )
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)
+
+
+class AveragePrecision(_ClassificationTaskWrapper):
+    """Task-string wrapper (reference classification/average_precision.py:388)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        thresholds: Thresholds = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        average: Optional[str] = "macro",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryAveragePrecision(**kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassAveragePrecision(num_classes, average, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelAveragePrecision(num_labels, average, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

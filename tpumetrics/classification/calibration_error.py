@@ -1,0 +1,173 @@
+"""Modular calibration error metrics (counterpart of reference
+``classification/calibration_error.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.classification.base import _ClassificationTaskWrapper
+from tpumetrics.functional.classification.calibration_error import (
+    _binary_calibration_error_arg_validation,
+    _binary_calibration_error_update,
+    _ce_compute,
+    _multiclass_calibration_error_arg_validation,
+    _multiclass_calibration_error_update,
+)
+from tpumetrics.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_tensor_validation,
+)
+from tpumetrics.functional.classification.stat_scores import (
+    _multiclass_stat_scores_tensor_validation,
+)
+from tpumetrics.metric import Metric
+from tpumetrics.utils.compute import normalize_logits_if_needed
+from tpumetrics.utils.data import dim_zero_cat
+from tpumetrics.utils.enums import ClassificationTaskNoMultilabel
+
+Array = jax.Array
+
+
+class BinaryCalibrationError(Metric):
+    """Top-label calibration error, binary (reference classification/calibration_error.py:33).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import BinaryCalibrationError
+        >>> metric = BinaryCalibrationError(n_bins=2, norm='l1')
+        >>> metric.update(jnp.asarray([0.25, 0.25, 0.55, 0.75, 0.75]), jnp.asarray([0, 0, 1, 1, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.29
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    confidences: List[Array]
+    accuracies: List[Array]
+
+    def __init__(
+        self,
+        n_bins: int = 15,
+        norm: str = "l1",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+        self.n_bins = n_bins
+        self.norm = norm
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("confidences", [], dist_reduce_fx="cat")
+        self.add_state("accuracies", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _binary_precision_recall_curve_tensor_validation(preds, target, self.ignore_index)
+        preds = preds.ravel()
+        target = target.ravel()
+        if self.ignore_index is not None:
+            idx = target != self.ignore_index
+            preds = preds[idx]
+            target = target[idx]
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        confidences, accuracies = _binary_calibration_error_update(preds, target)
+        self.confidences.append(confidences.astype(jnp.float32))
+        self.accuracies.append(accuracies.astype(jnp.float32))
+
+    def compute(self) -> Array:
+        confidences = dim_zero_cat(self.confidences)
+        accuracies = dim_zero_cat(self.accuracies)
+        return _ce_compute(confidences, accuracies, self.n_bins, self.norm)
+
+
+class MulticlassCalibrationError(Metric):
+    """Top-label calibration error, multiclass (reference classification/calibration_error.py:165).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MulticlassCalibrationError
+        >>> metric = MulticlassCalibrationError(num_classes=3)
+        >>> metric.update(jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1]]), jnp.asarray([0, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.15
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    confidences: List[Array]
+    accuracies: List[Array]
+
+    def __init__(
+        self,
+        num_classes: int,
+        n_bins: int = 15,
+        norm: str = "l1",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multiclass_calibration_error_arg_validation(num_classes, n_bins, norm, ignore_index)
+        self.num_classes = num_classes
+        self.n_bins = n_bins
+        self.norm = norm
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("confidences", [], dist_reduce_fx="cat")
+        self.add_state("accuracies", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _multiclass_stat_scores_tensor_validation(preds, target, self.num_classes, "global", self.ignore_index)
+        preds = jnp.moveaxis(preds, 1, -1).reshape(-1, self.num_classes)
+        target = target.ravel()
+        if self.ignore_index is not None:
+            idx = target != self.ignore_index
+            preds = preds[idx]
+            target = target[idx]
+        confidences, accuracies = _multiclass_calibration_error_update(preds, target)
+        self.confidences.append(confidences)
+        self.accuracies.append(accuracies)
+
+    def compute(self) -> Array:
+        confidences = dim_zero_cat(self.confidences)
+        accuracies = dim_zero_cat(self.accuracies)
+        return _ce_compute(confidences, accuracies, self.n_bins, self.norm)
+
+
+class CalibrationError(_ClassificationTaskWrapper):
+    """Task-string wrapper (reference classification/calibration_error.py:297)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        n_bins: int = 15,
+        norm: str = "l1",
+        num_classes: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTaskNoMultilabel.from_str(task)
+        kwargs.update({"n_bins": n_bins, "norm": norm, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTaskNoMultilabel.BINARY:
+            return BinaryCalibrationError(**kwargs)
+        if task == ClassificationTaskNoMultilabel.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassCalibrationError(num_classes, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

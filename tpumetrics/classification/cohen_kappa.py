@@ -1,0 +1,125 @@
+"""Modular Cohen's kappa metrics (counterpart of reference
+``classification/cohen_kappa.py`` — subclasses of the confusion-matrix
+metrics overriding ``compute``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from tpumetrics.classification.base import _ClassificationTaskWrapper
+from tpumetrics.classification.confusion_matrix import BinaryConfusionMatrix, MulticlassConfusionMatrix
+from tpumetrics.functional.classification.cohen_kappa import (
+    _cohen_kappa_reduce,
+    _cohen_kappa_weights_validation,
+)
+from tpumetrics.metric import Metric
+from tpumetrics.utils.enums import ClassificationTaskNoMultilabel
+
+Array = jax.Array
+
+
+class BinaryCohenKappa(BinaryConfusionMatrix):
+    """Cohen's kappa, binary (reference classification/cohen_kappa.py:31).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import BinaryCohenKappa
+        >>> metric = BinaryCohenKappa()
+        >>> metric.update(jnp.asarray([0.35, 0.85, 0.48, 0.01]), jnp.asarray([1, 1, 0, 0]))
+        >>> round(float(metric.compute()), 4)
+        0.5
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        weights: Optional[str] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            threshold=threshold, normalize=None, ignore_index=ignore_index, validate_args=validate_args, **kwargs
+        )
+        if validate_args:
+            _cohen_kappa_weights_validation(weights)
+        self.weights = weights
+
+    def compute(self) -> Array:
+        return _cohen_kappa_reduce(self.confmat, self.weights)
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)
+
+
+class MulticlassCohenKappa(MulticlassConfusionMatrix):
+    """Cohen's kappa, multiclass (reference classification/cohen_kappa.py:142).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MulticlassCohenKappa
+        >>> metric = MulticlassCohenKappa(num_classes=3)
+        >>> metric.update(jnp.asarray([2, 1, 0, 1]), jnp.asarray([2, 1, 0, 0]))
+        >>> round(float(metric.compute()), 4)
+        0.6364
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        ignore_index: Optional[int] = None,
+        weights: Optional[str] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, normalize=None, ignore_index=ignore_index,
+            validate_args=validate_args, **kwargs,
+        )
+        if validate_args:
+            _cohen_kappa_weights_validation(weights)
+        self.weights = weights
+
+    def compute(self) -> Array:
+        return _cohen_kappa_reduce(self.confmat, self.weights)
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)
+
+
+class CohenKappa(_ClassificationTaskWrapper):
+    """Task-string wrapper (reference classification/cohen_kappa.py:252)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        weights: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTaskNoMultilabel.from_str(task)
+        kwargs.update({"weights": weights, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTaskNoMultilabel.BINARY:
+            return BinaryCohenKappa(threshold, **kwargs)
+        if task == ClassificationTaskNoMultilabel.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassCohenKappa(num_classes, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,118 @@
+"""Modular Dice metric (counterpart of reference ``classification/dice.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.classification.dice import _dice_format
+from tpumetrics.metric import Metric
+from tpumetrics.utils.compute import _safe_divide
+
+Array = jax.Array
+
+
+class Dice(Metric):
+    """Dice = 2*TP / (2*TP + FP + FN) (reference classification/dice.py:33).
+
+    ``average='micro'``/``'samples'`` keep scalar accumulators; the per-class
+    averages (``'macro'``/``'weighted'``/``'none'``) require ``num_classes``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import Dice
+        >>> metric = Dice(average='micro')
+        >>> metric.update(jnp.asarray([2, 0, 2, 1]), jnp.asarray([1, 1, 2, 0]))
+        >>> round(float(metric.compute()), 4)
+        0.25
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    tp: Array
+    fp: Array
+    fn: Array
+
+    def __init__(
+        self,
+        zero_division: int = 0,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: Optional[str] = "micro",
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+        if average not in allowed_average:
+            raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+        if average in ("macro", "weighted", "none", None) and num_classes is None:
+            raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+        if num_classes is not None and ignore_index is not None and not 0 <= ignore_index < num_classes:
+            raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+        self.zero_division = zero_division
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.average = average
+        self.ignore_index = ignore_index
+        self.top_k = top_k
+
+        size = 1 if average in ("micro", "samples") else num_classes
+        default = lambda: jnp.zeros(size, dtype=jnp.float32)  # noqa: E731
+        self.add_state("tp", default(), dist_reduce_fx="sum")
+        self.add_state("fp", default(), dist_reduce_fx="sum")
+        self.add_state("fn", default(), dist_reduce_fx="sum")
+        if average == "samples":
+            self.add_state("sample_score", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("sample_total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds_oh, target_oh, n_cls = _dice_format(preds, target, self.threshold, self.top_k, self.num_classes)
+        if self.ignore_index is not None and 0 <= self.ignore_index < n_cls:
+            keep = jnp.ones(n_cls).at[self.ignore_index].set(0.0).astype(jnp.int32)
+            preds_oh = preds_oh * keep
+            target_oh = target_oh * keep
+
+        if self.average == "samples":
+            tp = jnp.sum(preds_oh * target_oh, axis=1).astype(jnp.float32)
+            fp = jnp.sum(preds_oh * (1 - target_oh), axis=1).astype(jnp.float32)
+            fn = jnp.sum((1 - preds_oh) * target_oh, axis=1).astype(jnp.float32)
+            scores = _safe_divide(2.0 * tp, 2.0 * tp + fp + fn, self.zero_division)
+            self.sample_score = self.sample_score + scores.sum()
+            self.sample_total = self.sample_total + scores.shape[0]
+            return
+
+        tp = jnp.sum(preds_oh * target_oh, axis=0).astype(jnp.float32)
+        fp = jnp.sum(preds_oh * (1 - target_oh), axis=0).astype(jnp.float32)
+        fn = jnp.sum((1 - preds_oh) * target_oh, axis=0).astype(jnp.float32)
+        if self.average == "micro":
+            tp, fp, fn = tp.sum(keepdims=True), fp.sum(keepdims=True), fn.sum(keepdims=True)
+        self.tp = self.tp + tp
+        self.fp = self.fp + fp
+        self.fn = self.fn + fn
+
+    def compute(self) -> Array:
+        if self.average == "samples":
+            return self.sample_score / self.sample_total
+        if self.average == "micro":
+            return _safe_divide(2.0 * self.tp[0], 2.0 * self.tp[0] + self.fp[0] + self.fn[0], self.zero_division)
+        scores = _safe_divide(2.0 * self.tp, 2.0 * self.tp + self.fp + self.fn, self.zero_division)
+        if self.average in ("none", None):
+            return scores
+        if self.average == "weighted":
+            weights = self.tp + self.fn
+            return jnp.sum(scores * _safe_divide(weights, weights.sum()))
+        present = ((self.tp + self.fp + self.fn) > 0).astype(scores.dtype)
+        if self.ignore_index is not None and self.num_classes and 0 <= self.ignore_index < self.num_classes:
+            present = present.at[self.ignore_index].set(0.0)
+        return jnp.sum(scores * present) / jnp.maximum(present.sum(), 1.0)
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)

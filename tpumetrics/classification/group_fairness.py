@@ -1,0 +1,150 @@
+"""Modular group-fairness metrics (counterpart of reference
+``classification/group_fairness.py`` — `_AbstractGroupStatScores` :33,
+`BinaryGroupStatRates` :62, `BinaryFairness` :129)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.classification.group_fairness import (
+    _binary_groups_stat_scores,
+    _compute_binary_demographic_parity,
+    _compute_binary_equal_opportunity,
+    _groups_reduce,
+    _groups_stat_transform,
+)
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class _AbstractGroupStatScores(Metric):
+    """Per-group tp/fp/tn/fn accumulators, shape (num_groups,)
+    (reference group_fairness.py:33-59)."""
+
+    tp: Array
+    fp: Array
+    tn: Array
+    fn: Array
+
+    def _create_states(self, num_groups: int) -> None:
+        default = lambda: jnp.zeros(num_groups, dtype=jnp.int32)  # noqa: E731
+        for name in ("tp", "fp", "tn", "fn"):
+            self.add_state(name, default(), dist_reduce_fx="sum")
+
+    def _update_states(self, group_stats: list) -> None:
+        self.tp = self.tp + jnp.stack([s[0] for s in group_stats])
+        self.fp = self.fp + jnp.stack([s[1] for s in group_stats])
+        self.tn = self.tn + jnp.stack([s[2] for s in group_stats])
+        self.fn = self.fn + jnp.stack([s[3] for s in group_stats])
+
+
+class BinaryGroupStatRates(_AbstractGroupStatScores):
+    """tp/fp/tn/fn rates by group (reference group_fairness.py:62).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import BinaryGroupStatRates
+        >>> metric = BinaryGroupStatRates(num_groups=2)
+        >>> metric.update(jnp.asarray([0, 1, 0, 1]), jnp.asarray([0, 1, 0, 1]), jnp.asarray([0, 1, 0, 1]))
+        >>> {k: v.tolist() for k, v in metric.compute().items()}
+        {'group_0': [0.0, 0.0, 1.0, 0.0], 'group_1': [1.0, 0.0, 0.0, 0.0]}
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = None
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        num_groups: int,
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args and (not isinstance(num_groups, int) or num_groups < 2):
+            raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
+        self.num_groups = num_groups
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_states(num_groups)
+
+    def update(self, preds: Array, target: Array, groups: Array) -> None:
+        group_stats = _binary_groups_stat_scores(
+            preds, target, groups, self.num_groups, self.threshold, self.ignore_index, self.validate_args
+        )
+        self._update_states(group_stats)
+
+    def compute(self) -> Dict[str, Array]:
+        group_stats = [(self.tp[g], self.fp[g], self.tn[g], self.fn[g]) for g in range(self.num_groups)]
+        return _groups_reduce(group_stats)
+
+
+class BinaryFairness(_AbstractGroupStatScores):
+    """Demographic parity / equal opportunity between groups
+    (reference group_fairness.py:129).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import BinaryFairness
+        >>> metric = BinaryFairness(num_groups=2)
+        >>> metric.update(jnp.asarray([0.11, 0.84, 0.22, 0.73]), jnp.asarray([0, 1, 0, 1]),
+        ...               jnp.asarray([0, 1, 0, 1]))
+        >>> sorted(metric.compute().keys())
+        ['DP_0_1', 'EO_0_1']
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = None
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        num_groups: int,
+        task: str = "all",
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if task not in ["demographic_parity", "equal_opportunity", "all"]:
+            raise ValueError(
+                f"Expected argument `task` to either be ``demographic_parity``,"
+                f"``equal_opportunity`` or ``all`` but got {task}."
+            )
+        if validate_args and (not isinstance(num_groups, int) or num_groups < 2):
+            raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
+        self.num_groups = num_groups
+        self.task = task
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_states(num_groups)
+
+    def update(self, preds: Array, target: Optional[Array], groups: Array) -> None:
+        if self.task == "demographic_parity":
+            target = jnp.zeros_like(jnp.asarray(preds), dtype=jnp.int32)
+        group_stats = _binary_groups_stat_scores(
+            preds, target, groups, self.num_groups, self.threshold, self.ignore_index, self.validate_args
+        )
+        self._update_states(group_stats)
+
+    def compute(self) -> Dict[str, Array]:
+        transformed = _groups_stat_transform(
+            [(self.tp[g], self.fp[g], self.tn[g], self.fn[g]) for g in range(self.num_groups)]
+        )
+        if self.task == "demographic_parity":
+            return _compute_binary_demographic_parity(**transformed)
+        if self.task == "equal_opportunity":
+            return _compute_binary_equal_opportunity(**transformed)
+        return {
+            **_compute_binary_demographic_parity(**transformed),
+            **_compute_binary_equal_opportunity(**transformed),
+        }

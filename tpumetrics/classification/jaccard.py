@@ -1,0 +1,176 @@
+"""Modular Jaccard index metrics (counterpart of reference
+``classification/jaccard.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from tpumetrics.classification.base import _ClassificationTaskWrapper
+from tpumetrics.classification.confusion_matrix import (
+    BinaryConfusionMatrix,
+    MulticlassConfusionMatrix,
+    MultilabelConfusionMatrix,
+)
+from tpumetrics.functional.classification.jaccard import _jaccard_index_reduce
+from tpumetrics.metric import Metric
+from tpumetrics.utils.enums import ClassificationTask
+
+Array = jax.Array
+
+
+class BinaryJaccardIndex(BinaryConfusionMatrix):
+    """Jaccard index / IoU, binary (reference classification/jaccard.py:30).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import BinaryJaccardIndex
+        >>> metric = BinaryJaccardIndex()
+        >>> metric.update(jnp.asarray([0.35, 0.85, 0.48, 0.01]), jnp.asarray([1, 1, 0, 0]))
+        >>> round(float(metric.compute()), 4)
+        0.5
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            threshold=threshold, normalize=None, ignore_index=ignore_index, validate_args=validate_args, **kwargs
+        )
+
+    def compute(self) -> Array:
+        return _jaccard_index_reduce(self.confmat, average="binary")
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)
+
+
+class MulticlassJaccardIndex(MulticlassConfusionMatrix):
+    """Jaccard index, multiclass (reference classification/jaccard.py:137).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MulticlassJaccardIndex
+        >>> metric = MulticlassJaccardIndex(num_classes=3)
+        >>> metric.update(jnp.asarray([2, 1, 0, 1]), jnp.asarray([2, 1, 0, 0]))
+        >>> round(float(metric.compute()), 4)
+        0.6667
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    plot_legend_name: str = "Class"
+
+    def __init__(
+        self,
+        num_classes: int,
+        average: Optional[str] = "macro",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, normalize=None, ignore_index=ignore_index,
+            validate_args=validate_args, **kwargs,
+        )
+        if validate_args and average not in ("micro", "macro", "weighted", "none", None):
+            raise ValueError(
+                f"Expected argument `average` to be one of ('micro', 'macro', 'weighted', 'none', None)"
+                f" but got {average}"
+            )
+        self.average = average
+
+    def compute(self) -> Array:
+        return _jaccard_index_reduce(self.confmat, average=self.average, ignore_index=self.ignore_index)
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)
+
+
+class MultilabelJaccardIndex(MultilabelConfusionMatrix):
+    """Jaccard index, multilabel (reference classification/jaccard.py:248).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MultilabelJaccardIndex
+        >>> metric = MultilabelJaccardIndex(num_labels=3)
+        >>> metric.update(jnp.asarray([[0, 0, 1], [1, 0, 1]]), jnp.asarray([[0, 1, 0], [1, 0, 1]]))
+        >>> round(float(metric.compute()), 4)
+        0.5
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    plot_legend_name: str = "Label"
+
+    def __init__(
+        self,
+        num_labels: int,
+        threshold: float = 0.5,
+        average: Optional[str] = "macro",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, threshold=threshold, normalize=None, ignore_index=ignore_index,
+            validate_args=validate_args, **kwargs,
+        )
+        if validate_args and average not in ("micro", "macro", "weighted", "none", None):
+            raise ValueError(
+                f"Expected argument `average` to be one of ('micro', 'macro', 'weighted', 'none', None)"
+                f" but got {average}"
+            )
+        self.average = average
+
+    def compute(self) -> Array:
+        return _jaccard_index_reduce(self.confmat, average=self.average, ignore_index=self.ignore_index)
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)
+
+
+class JaccardIndex(_ClassificationTaskWrapper):
+    """Task-string wrapper (reference classification/jaccard.py:357)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        average: Optional[str] = "macro",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryJaccardIndex(threshold, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassJaccardIndex(num_classes, average, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelJaccardIndex(num_labels, threshold, average, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,159 @@
+"""Modular Matthews correlation coefficient metrics (counterpart of reference
+``classification/matthews_corrcoef.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from tpumetrics.classification.base import _ClassificationTaskWrapper
+from tpumetrics.classification.confusion_matrix import (
+    BinaryConfusionMatrix,
+    MulticlassConfusionMatrix,
+    MultilabelConfusionMatrix,
+)
+from tpumetrics.functional.classification.matthews_corrcoef import _matthews_corrcoef_reduce
+from tpumetrics.metric import Metric
+from tpumetrics.utils.enums import ClassificationTask
+
+Array = jax.Array
+
+
+class BinaryMatthewsCorrCoef(BinaryConfusionMatrix):
+    """MCC, binary (reference classification/matthews_corrcoef.py:29).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import BinaryMatthewsCorrCoef
+        >>> metric = BinaryMatthewsCorrCoef()
+        >>> metric.update(jnp.asarray([0.35, 0.85, 0.48, 0.01]), jnp.asarray([1, 1, 0, 0]))
+        >>> round(float(metric.compute()), 4)
+        0.5774
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = -1.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            threshold=threshold, normalize=None, ignore_index=ignore_index, validate_args=validate_args, **kwargs
+        )
+
+    def compute(self) -> Array:
+        return _matthews_corrcoef_reduce(self.confmat)
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)
+
+
+class MulticlassMatthewsCorrCoef(MulticlassConfusionMatrix):
+    """MCC, multiclass (reference classification/matthews_corrcoef.py:139).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MulticlassMatthewsCorrCoef
+        >>> metric = MulticlassMatthewsCorrCoef(num_classes=3)
+        >>> metric.update(jnp.asarray([2, 1, 0, 1]), jnp.asarray([2, 1, 0, 0]))
+        >>> round(float(metric.compute()), 4)
+        0.7
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = -1.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, normalize=None, ignore_index=ignore_index,
+            validate_args=validate_args, **kwargs,
+        )
+
+    def compute(self) -> Array:
+        return _matthews_corrcoef_reduce(self.confmat)
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)
+
+
+class MultilabelMatthewsCorrCoef(MultilabelConfusionMatrix):
+    """MCC, multilabel (reference classification/matthews_corrcoef.py:245).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MultilabelMatthewsCorrCoef
+        >>> metric = MultilabelMatthewsCorrCoef(num_labels=3)
+        >>> metric.update(jnp.asarray([[0, 0, 1], [1, 0, 1]]), jnp.asarray([[0, 1, 0], [1, 0, 1]]))
+        >>> round(float(metric.compute()), 4)
+        0.3333
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = -1.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        num_labels: int,
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, threshold=threshold, normalize=None, ignore_index=ignore_index,
+            validate_args=validate_args, **kwargs,
+        )
+
+    def compute(self) -> Array:
+        return _matthews_corrcoef_reduce(self.confmat)
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)
+
+
+class MatthewsCorrCoef(_ClassificationTaskWrapper):
+    """Task-string wrapper (reference classification/matthews_corrcoef.py:355)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryMatthewsCorrCoef(threshold, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassMatthewsCorrCoef(num_classes, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelMatthewsCorrCoef(num_labels, threshold, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

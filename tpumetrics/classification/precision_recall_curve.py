@@ -1,0 +1,310 @@
+"""Modular precision-recall-curve metrics — the curve-family state machine.
+
+Counterpart of reference ``classification/precision_recall_curve.py``: the
+two state modes (exact ``thresholds=None`` -> preds/target "cat" list
+states; binned -> one static ``(T, [C,] 2, 2)`` "sum" confusion tensor,
+reference functional precision_recall_curve.py:83-91/:190-240). The binned
+mode is the TPU recommendation — constant memory, jit-able update, one psum
+to sync. ROC/AUROC/AveragePrecision/{Precision,Recall}AtFixed*/
+SpecificityAtSensitivity all subclass these classes, overriding ``compute``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.classification.base import _ClassificationTaskWrapper
+from tpumetrics.functional.classification.precision_recall_curve import (
+    Thresholds,
+    _adjust_threshold_arg,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+from tpumetrics.utils.enums import ClassificationTask
+from tpumetrics.utils.plot import plot_curve
+
+Array = jax.Array
+
+
+class BinaryPrecisionRecallCurve(Metric):
+    """Precision-recall curve for binary tasks (reference
+    classification/precision_recall_curve.py:29).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import BinaryPrecisionRecallCurve
+        >>> metric = BinaryPrecisionRecallCurve(thresholds=5)
+        >>> metric.update(jnp.asarray([0.1, 0.4, 0.35, 0.8]), jnp.asarray([0, 0, 1, 1]))
+        >>> precision, recall, thresholds = metric.compute()
+        >>> precision.tolist()
+        [0.5, 0.6666666865348816, 1.0, 1.0, 0.0, 1.0]
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = None
+    full_state_update: bool = False
+
+    preds: List[Array]
+    target: List[Array]
+    confmat: Array
+
+    def __init__(
+        self,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+
+        thresholds = _adjust_threshold_arg(thresholds)
+        if thresholds is None:
+            self.thresholds = thresholds
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+        else:
+            self.thresholds = thresholds
+            self.add_state(
+                "confmat", default=jnp.zeros((len(thresholds), 2, 2), dtype=jnp.int32), dist_reduce_fx="sum"
+            )
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _binary_precision_recall_curve_tensor_validation(preds, target, self.ignore_index)
+        preds, target, _ = _binary_precision_recall_curve_format(
+            preds, target, self.thresholds, self.ignore_index
+        )
+        state = _binary_precision_recall_curve_update(preds, target, self.thresholds, self.ignore_index)
+        if isinstance(state, tuple):
+            self.preds.append(state[0])
+            self.target.append(state[1])
+        else:
+            self.confmat = self.confmat + state
+
+    def _final_state(self) -> Union[Array, Tuple[Array, Array]]:
+        if self.thresholds is not None:
+            return self.confmat
+        return dim_zero_cat(self.preds), dim_zero_cat(self.target)
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        return _binary_precision_recall_curve_compute(self._final_state(), self.thresholds)
+
+    def plot(self, curve: Optional[Tuple] = None, score: Any = None, ax: Any = None) -> Any:
+        curve_computed = curve or self.compute()
+        return plot_curve(
+            curve_computed, score=score, ax=ax, label_names=("Recall", "Precision"),
+            name=self.__class__.__name__,
+        )
+
+
+class MulticlassPrecisionRecallCurve(Metric):
+    """Per-class precision-recall curves for multiclass tasks (reference
+    classification/precision_recall_curve.py:168).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MulticlassPrecisionRecallCurve
+        >>> metric = MulticlassPrecisionRecallCurve(num_classes=3, thresholds=5)
+        >>> metric.update(jnp.asarray([[0.8, 0.1, 0.1], [0.1, 0.8, 0.1]]), jnp.asarray([0, 1]))
+        >>> precision, recall, thresholds = metric.compute()
+        >>> precision.shape
+        (3, 6)
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = None
+    full_state_update: bool = False
+
+    preds: List[Array]
+    target: List[Array]
+    confmat: Array
+
+    def __init__(
+        self,
+        num_classes: int,
+        thresholds: Thresholds = None,
+        average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index, average)
+        self.num_classes = num_classes
+        self.average = average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+
+        thresholds = _adjust_threshold_arg(thresholds)
+        self.thresholds = thresholds
+        if thresholds is None:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+        else:
+            shape = (len(thresholds), 2, 2) if average == "micro" else (len(thresholds), num_classes, 2, 2)
+            self.add_state("confmat", default=jnp.zeros(shape, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _multiclass_precision_recall_curve_tensor_validation(preds, target, self.num_classes, self.ignore_index)
+        preds, target, _ = _multiclass_precision_recall_curve_format(
+            preds, target, self.num_classes, self.thresholds, self.ignore_index, self.average
+        )
+        state = _multiclass_precision_recall_curve_update(
+            preds, target, self.num_classes, self.thresholds, self.average, self.ignore_index
+        )
+        if isinstance(state, tuple):
+            self.preds.append(state[0])
+            self.target.append(state[1])
+        else:
+            self.confmat = self.confmat + state
+
+    def _final_state(self) -> Union[Array, Tuple[Array, Array]]:
+        if self.thresholds is not None:
+            return self.confmat
+        return dim_zero_cat(self.preds), dim_zero_cat(self.target)
+
+    def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        return _multiclass_precision_recall_curve_compute(
+            self._final_state(), self.num_classes, self.thresholds, self.average
+        )
+
+    def plot(self, curve: Optional[Tuple] = None, score: Any = None, ax: Any = None) -> Any:
+        curve_computed = curve or self.compute()
+        return plot_curve(
+            curve_computed, score=score, ax=ax, label_names=("Recall", "Precision"),
+            name=self.__class__.__name__,
+        )
+
+
+class MultilabelPrecisionRecallCurve(Metric):
+    """Per-label precision-recall curves for multilabel tasks (reference
+    classification/precision_recall_curve.py:317).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MultilabelPrecisionRecallCurve
+        >>> metric = MultilabelPrecisionRecallCurve(num_labels=2, thresholds=5)
+        >>> metric.update(jnp.asarray([[0.8, 0.1], [0.1, 0.8]]), jnp.asarray([[1, 0], [0, 1]]))
+        >>> precision, recall, thresholds = metric.compute()
+        >>> precision.shape
+        (2, 6)
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = None
+    full_state_update: bool = False
+
+    preds: List[Array]
+    target: List[Array]
+    confmat: Array
+
+    def __init__(
+        self,
+        num_labels: int,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+
+        thresholds = _adjust_threshold_arg(thresholds)
+        self.thresholds = thresholds
+        if thresholds is None:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+        else:
+            self.add_state(
+                "confmat",
+                default=jnp.zeros((len(thresholds), num_labels, 2, 2), dtype=jnp.int32),
+                dist_reduce_fx="sum",
+            )
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _multilabel_precision_recall_curve_tensor_validation(preds, target, self.num_labels, self.ignore_index)
+        preds, target, _ = _multilabel_precision_recall_curve_format(
+            preds, target, self.num_labels, self.thresholds, self.ignore_index
+        )
+        state = _multilabel_precision_recall_curve_update(
+            preds, target, self.num_labels, self.thresholds, self.ignore_index
+        )
+        if isinstance(state, tuple):
+            self.preds.append(state[0])
+            self.target.append(state[1])
+        else:
+            self.confmat = self.confmat + state
+
+    def _final_state(self) -> Union[Array, Tuple[Array, Array]]:
+        if self.thresholds is not None:
+            return self.confmat
+        return dim_zero_cat(self.preds), dim_zero_cat(self.target)
+
+    def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        return _multilabel_precision_recall_curve_compute(
+            self._final_state(), self.num_labels, self.thresholds, self.ignore_index
+        )
+
+    def plot(self, curve: Optional[Tuple] = None, score: Any = None, ax: Any = None) -> Any:
+        curve_computed = curve or self.compute()
+        return plot_curve(
+            curve_computed, score=score, ax=ax, label_names=("Recall", "Precision"),
+            name=self.__class__.__name__,
+        )
+
+
+class PrecisionRecallCurve(_ClassificationTaskWrapper):
+    """Task-string wrapper (reference classification/precision_recall_curve.py:463)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        thresholds: Thresholds = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryPrecisionRecallCurve(**kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassPrecisionRecallCurve(num_classes, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelPrecisionRecallCurve(num_labels, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,129 @@
+"""Modular multilabel ranking metrics (counterpart of reference
+``classification/ranking.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.classification.precision_recall_curve import (
+    _multilabel_precision_recall_curve_tensor_validation,
+)
+from tpumetrics.functional.classification.ranking import (
+    _multilabel_coverage_error_update,
+    _multilabel_ranking_average_precision_update,
+    _multilabel_ranking_format,
+    _multilabel_ranking_loss_update,
+    _ranking_reduce,
+)
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class _MultilabelRankingMetric(Metric):
+    """Shared score/total sum-state machine for the ranking family."""
+
+    is_differentiable: bool = False
+    full_state_update: bool = False
+
+    score: Array
+    total: Array
+
+    _update_fn = None  # set by subclass
+
+    def __init__(
+        self,
+        num_labels: int,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            if not isinstance(num_labels, int) or num_labels < 2:
+                raise ValueError(
+                    f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}"
+                )
+            if ignore_index is not None and not isinstance(ignore_index, int):
+                raise ValueError(
+                    f"Expected argument `ignore_index` to either be `None` or an int, but got {ignore_index}"
+                )
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("score", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _multilabel_precision_recall_curve_tensor_validation(
+                preds, target, self.num_labels, self.ignore_index
+            )
+        preds, target = _multilabel_ranking_format(preds, target, self.num_labels, self.ignore_index)
+        score, total = type(self)._update_fn(preds, target)
+        self.score = self.score + score
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _ranking_reduce(self.score, self.total)
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)
+
+
+class MultilabelCoverageError(_MultilabelRankingMetric):
+    """Coverage error (reference classification/ranking.py:28).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MultilabelCoverageError
+        >>> metric = MultilabelCoverageError(num_labels=3)
+        >>> metric.update(jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.35]]),
+        ...               jnp.asarray([[1, 0, 1], [0, 0, 1], [0, 1, 1]]))
+        >>> round(float(metric.compute()), 4)
+        2.3333
+    """
+
+    higher_is_better: bool = False
+    plot_lower_bound: float = 0.0
+    _update_fn = staticmethod(_multilabel_coverage_error_update)
+
+
+class MultilabelRankingAveragePrecision(_MultilabelRankingMetric):
+    """Label ranking average precision (reference classification/ranking.py:123).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MultilabelRankingAveragePrecision
+        >>> metric = MultilabelRankingAveragePrecision(num_labels=3)
+        >>> metric.update(jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.35]]),
+        ...               jnp.asarray([[1, 0, 1], [0, 0, 1], [0, 1, 1]]))
+        >>> round(float(metric.compute()), 4)
+        0.7778
+    """
+
+    higher_is_better: bool = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    _update_fn = staticmethod(_multilabel_ranking_average_precision_update)
+
+
+class MultilabelRankingLoss(_MultilabelRankingMetric):
+    """Label ranking loss (reference classification/ranking.py:219).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MultilabelRankingLoss
+        >>> metric = MultilabelRankingLoss(num_labels=3)
+        >>> metric.update(jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.35]]),
+        ...               jnp.asarray([[1, 0, 1], [0, 0, 1], [0, 1, 1]]))
+        >>> round(float(metric.compute()), 4)
+        0.3333
+    """
+
+    higher_is_better: bool = False
+    plot_lower_bound: float = 0.0
+    _update_fn = staticmethod(_multilabel_ranking_loss_update)

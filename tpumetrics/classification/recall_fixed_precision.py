@@ -1,0 +1,186 @@
+"""Modular RecallAtFixedPrecision metrics (counterpart of reference
+``classification/recall_fixed_precision.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+
+from tpumetrics.classification.base import _ClassificationTaskWrapper
+from tpumetrics.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from tpumetrics.functional.classification.precision_recall_curve import Thresholds
+from tpumetrics.functional.classification.recall_fixed_precision import (
+    _binary_recall_at_fixed_precision_arg_validation,
+    _binary_recall_at_fixed_precision_compute,
+    _multiclass_recall_at_fixed_precision_arg_validation,
+    _multiclass_recall_at_fixed_precision_compute,
+    _multilabel_recall_at_fixed_precision_arg_validation,
+    _multilabel_recall_at_fixed_precision_compute,
+)
+from tpumetrics.metric import Metric
+from tpumetrics.utils.enums import ClassificationTask
+
+Array = jax.Array
+
+
+class BinaryRecallAtFixedPrecision(BinaryPrecisionRecallCurve):
+    """Max recall subject to precision >= min_precision, binary (reference
+    classification/recall_fixed_precision.py:29).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import BinaryRecallAtFixedPrecision
+        >>> metric = BinaryRecallAtFixedPrecision(min_precision=0.5)
+        >>> metric.update(jnp.asarray([0.1, 0.4, 0.35, 0.8]), jnp.asarray([0, 0, 1, 1]))
+        >>> recall, threshold = metric.compute()
+        >>> (round(float(recall), 4), round(float(threshold), 4))
+        (1.0, 0.35)
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = None
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        min_precision: float,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs)
+        if validate_args:
+            _binary_recall_at_fixed_precision_arg_validation(min_precision, thresholds, ignore_index)
+        self.validate_args = validate_args
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:
+        return _binary_recall_at_fixed_precision_compute(
+            self._final_state(), self.thresholds, self.min_precision
+        )
+
+
+class MulticlassRecallAtFixedPrecision(MulticlassPrecisionRecallCurve):
+    """Per-class max recall subject to precision >= min_precision (reference
+    classification/recall_fixed_precision.py:136).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MulticlassRecallAtFixedPrecision
+        >>> metric = MulticlassRecallAtFixedPrecision(num_classes=3, min_precision=0.5)
+        >>> metric.update(jnp.asarray([[0.8, 0.1, 0.1], [0.1, 0.8, 0.1], [0.1, 0.1, 0.8]]),
+        ...               jnp.asarray([0, 1, 2]))
+        >>> recall, thresholds = metric.compute()
+        >>> recall.tolist()
+        [1.0, 1.0, 1.0]
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = None
+    full_state_update: bool = False
+    plot_legend_name: str = "Class"
+
+    def __init__(
+        self,
+        num_classes: int,
+        min_precision: float,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, thresholds=thresholds, average=None,
+            ignore_index=ignore_index, validate_args=False, **kwargs,
+        )
+        if validate_args:
+            _multiclass_recall_at_fixed_precision_arg_validation(
+                num_classes, min_precision, thresholds, ignore_index
+            )
+        self.validate_args = validate_args
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:
+        return _multiclass_recall_at_fixed_precision_compute(
+            self._final_state(), self.num_classes, self.thresholds, self.min_precision
+        )
+
+
+class MultilabelRecallAtFixedPrecision(MultilabelPrecisionRecallCurve):
+    """Per-label max recall subject to precision >= min_precision (reference
+    classification/recall_fixed_precision.py:247).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MultilabelRecallAtFixedPrecision
+        >>> metric = MultilabelRecallAtFixedPrecision(num_labels=2, min_precision=0.5)
+        >>> metric.update(jnp.asarray([[0.8, 0.1], [0.1, 0.8]]), jnp.asarray([[1, 0], [0, 1]]))
+        >>> recall, thresholds = metric.compute()
+        >>> recall.tolist()
+        [1.0, 1.0]
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = None
+    full_state_update: bool = False
+    plot_legend_name: str = "Label"
+
+    def __init__(
+        self,
+        num_labels: int,
+        min_precision: float,
+        thresholds: Thresholds = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index,
+            validate_args=False, **kwargs,
+        )
+        if validate_args:
+            _multilabel_recall_at_fixed_precision_arg_validation(
+                num_labels, min_precision, thresholds, ignore_index
+            )
+        self.validate_args = validate_args
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:
+        return _multilabel_recall_at_fixed_precision_compute(
+            self._final_state(), self.num_labels, self.thresholds, self.ignore_index, self.min_precision
+        )
+
+
+class RecallAtFixedPrecision(_ClassificationTaskWrapper):
+    """Task-string wrapper (reference classification/recall_fixed_precision.py:358)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        min_precision: float,
+        thresholds: Thresholds = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryRecallAtFixedPrecision(min_precision, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassRecallAtFixedPrecision(num_classes, min_precision, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelRecallAtFixedPrecision(num_labels, min_precision, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

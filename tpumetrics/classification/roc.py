@@ -1,0 +1,126 @@
+"""Modular ROC metrics (counterpart of reference ``classification/roc.py`` —
+subclasses of the PR-curve state classes overriding ``compute``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple, Union
+
+import jax
+
+from tpumetrics.classification.base import _ClassificationTaskWrapper
+from tpumetrics.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from tpumetrics.functional.classification.precision_recall_curve import Thresholds
+from tpumetrics.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from tpumetrics.metric import Metric
+from tpumetrics.utils.enums import ClassificationTask
+from tpumetrics.utils.plot import plot_curve
+
+Array = jax.Array
+
+
+class BinaryROC(BinaryPrecisionRecallCurve):
+    """ROC curve for binary tasks (reference classification/roc.py:26).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import BinaryROC
+        >>> metric = BinaryROC(thresholds=5)
+        >>> metric.update(jnp.asarray([0.1, 0.4, 0.35, 0.8]), jnp.asarray([0, 0, 1, 1]))
+        >>> fpr, tpr, thresholds = metric.compute()
+        >>> tpr.tolist()
+        [0.0, 0.5, 0.5, 1.0, 1.0]
+    """
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        return _binary_roc_compute(self._final_state(), self.thresholds)
+
+    def plot(self, curve: Optional[Tuple] = None, score: Any = None, ax: Any = None) -> Any:
+        curve_computed = curve or self.compute()
+        return plot_curve(
+            curve_computed, score=score, ax=ax, label_names=("False positive rate", "True positive rate"),
+            name=self.__class__.__name__,
+        )
+
+
+class MulticlassROC(MulticlassPrecisionRecallCurve):
+    """Per-class one-vs-rest ROC curves (reference classification/roc.py:154).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MulticlassROC
+        >>> metric = MulticlassROC(num_classes=3, thresholds=5)
+        >>> metric.update(jnp.asarray([[0.8, 0.1, 0.1], [0.1, 0.8, 0.1]]), jnp.asarray([0, 1]))
+        >>> fpr, tpr, thresholds = metric.compute()
+        >>> fpr.shape
+        (3, 5)
+    """
+
+    def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        return _multiclass_roc_compute(self._final_state(), self.num_classes, self.thresholds, self.average)
+
+    def plot(self, curve: Optional[Tuple] = None, score: Any = None, ax: Any = None) -> Any:
+        curve_computed = curve or self.compute()
+        return plot_curve(
+            curve_computed, score=score, ax=ax, label_names=("False positive rate", "True positive rate"),
+            name=self.__class__.__name__,
+        )
+
+
+class MultilabelROC(MultilabelPrecisionRecallCurve):
+    """Per-label ROC curves (reference classification/roc.py:265).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.classification import MultilabelROC
+        >>> metric = MultilabelROC(num_labels=2, thresholds=5)
+        >>> metric.update(jnp.asarray([[0.8, 0.1], [0.1, 0.8]]), jnp.asarray([[1, 0], [0, 1]]))
+        >>> fpr, tpr, thresholds = metric.compute()
+        >>> fpr.shape
+        (2, 5)
+    """
+
+    def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        return _multilabel_roc_compute(self._final_state(), self.num_labels, self.thresholds, self.ignore_index)
+
+    def plot(self, curve: Optional[Tuple] = None, score: Any = None, ax: Any = None) -> Any:
+        curve_computed = curve or self.compute()
+        return plot_curve(
+            curve_computed, score=score, ax=ax, label_names=("False positive rate", "True positive rate"),
+            name=self.__class__.__name__,
+        )
+
+
+class ROC(_ClassificationTaskWrapper):
+    """Task-string wrapper for ROC (reference classification/roc.py:389)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        thresholds: Thresholds = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryROC(**kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassROC(num_classes, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelROC(num_labels, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

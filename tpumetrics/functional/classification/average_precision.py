@@ -1,0 +1,273 @@
+"""Average precision (area under the PR curve, step interpolation).
+
+Counterpart of reference ``functional/classification/average_precision.py``
+(`_reduce_average_precision` :43, `_binary_average_precision_compute` :78,
+multiclass :160-210, multilabel :285-330). AP is the step-function sum
+``-Σ (recall[i+1]-recall[i]) * precision[i]`` over each curve.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.classification.precision_recall_curve import (
+    Thresholds,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from tpumetrics.utils.compute import _safe_divide
+from tpumetrics.utils.data import _bincount
+from tpumetrics.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _average_precision_step_sum(precision: Array, recall: Array) -> Array:
+    return -jnp.sum((recall[1:] - recall[:-1]) * precision[:-1])
+
+
+def _reduce_average_precision(
+    precision: Union[Array, List[Array]],
+    recall: Union[Array, List[Array]],
+    average: Optional[str] = "macro",
+    weights: Optional[Array] = None,
+) -> Array:
+    """Reduce per-class APs (reference average_precision.py:43-69)."""
+    if isinstance(precision, jax.Array) and isinstance(recall, jax.Array):
+        res = -jnp.sum((recall[:, 1:] - recall[:, :-1]) * precision[:, :-1], axis=1)
+    else:
+        res = jnp.stack([_average_precision_step_sum(p, r) for p, r in zip(precision, recall)])
+    if average is None or average == "none":
+        return res
+    if not isinstance(res, jax.core.Tracer) and bool(jnp.isnan(res).any()):
+        rank_zero_warn(
+            f"Average precision score for one or more classes was `nan`. Ignoring these classes in {average}-average",
+            UserWarning,
+        )
+    idx = ~jnp.isnan(res)
+    if average == "macro":
+        return jnp.sum(jnp.where(idx, res, 0.0)) / jnp.sum(idx)
+    if average == "weighted" and weights is not None:
+        weights = jnp.where(idx, weights, 0.0)
+        weights = _safe_divide(weights, jnp.sum(weights))
+        return jnp.sum(jnp.where(idx, res * weights, 0.0))
+    raise ValueError("Received an incompatible combinations of inputs to make reduction.")
+
+
+def _binary_average_precision_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Array:
+    precision, recall, _ = _binary_precision_recall_curve_compute(state, thresholds, pos_label)
+    return _average_precision_step_sum(precision, recall)
+
+
+def binary_average_precision(
+    preds: Array,
+    target: Array,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Average precision for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import binary_average_precision
+        >>> preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> round(float(binary_average_precision(preds, target)), 4)
+        0.8333
+    """
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds, ignore_index)
+    return _binary_average_precision_compute(state, thresholds)
+
+
+def _multiclass_average_precision_arg_validation(
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    if average not in ("macro", "weighted", "none", None):
+        raise ValueError(f"Expected argument `average` to be one of ('macro', 'weighted', 'none', None)"
+                         f" but got {average}")
+    _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+
+
+def _multiclass_average_precision_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds: Optional[Array] = None,
+) -> Array:
+    precision, recall, _ = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds, average=None)
+    return _reduce_average_precision(
+        precision,
+        recall,
+        average,
+        weights=(
+            _bincount(state[1], minlength=num_classes).astype(jnp.float32)
+            if thresholds is None
+            else state[0][:, 1, :].sum(-1).astype(jnp.float32)
+        ),
+    )
+
+
+def multiclass_average_precision(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Average precision over one-vs-rest PR curves for multiclass tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multiclass_average_precision
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.05, 0.9, 0.05], [0.05, 0.05, 0.9], [0.3, 0.4, 0.3]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> round(float(multiclass_average_precision(preds, target, num_classes=3)), 4)
+        1.0
+    """
+    if validate_args:
+        _multiclass_average_precision_arg_validation(num_classes, average, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds_arr = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(
+        preds, target, num_classes, thresholds_arr, None, ignore_index
+    )
+    return _multiclass_average_precision_compute(state, num_classes, average, thresholds_arr)
+
+
+def _multilabel_average_precision_arg_validation(
+    num_labels: int,
+    average: Optional[str],
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    if average not in ("micro", "macro", "weighted", "none", None):
+        raise ValueError(
+            f"Expected argument `average` to be one of ('micro', 'macro', 'weighted', 'none', None)"
+            f" but got {average}"
+        )
+    _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+
+
+def _multilabel_average_precision_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    average: Optional[str],
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Reference average_precision.py:285-330."""
+    if average == "micro":
+        if isinstance(state, jax.Array) and thresholds is not None:
+            return _binary_average_precision_compute(state.sum(1), thresholds)
+        preds = state[0].ravel()
+        target = state[1].ravel()
+        if ignore_index is not None:
+            idx = target != ignore_index
+            preds = preds[idx]
+            target = target[idx]
+        return _binary_average_precision_compute((preds, target), thresholds)
+
+    precision, recall, _ = _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
+    return _reduce_average_precision(
+        precision,
+        recall,
+        average,
+        weights=(
+            (state[1] == 1).sum(0).astype(jnp.float32)
+            if thresholds is None
+            else state[0][:, 1, :].sum(-1).astype(jnp.float32)
+        ),
+    )
+
+
+def multilabel_average_precision(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    average: Optional[str] = "macro",
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Average precision over per-label PR curves for multilabel tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multilabel_average_precision
+        >>> preds = jnp.asarray([[0.75, 0.05], [0.05, 0.75], [0.05, 0.05], [0.75, 0.75]])
+        >>> target = jnp.asarray([[1, 0], [0, 1], [0, 0], [1, 1]])
+        >>> round(float(multilabel_average_precision(preds, target, num_labels=2)), 4)
+        1.0
+    """
+    if validate_args:
+        _multilabel_average_precision_arg_validation(num_labels, average, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds_arr = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds_arr, ignore_index)
+    return _multilabel_average_precision_compute(state, num_labels, average, thresholds_arr, ignore_index)
+
+
+def average_precision(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Thresholds = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-string dispatcher (reference average_precision.py task wrapper)."""
+    from tpumetrics.utils.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_average_precision(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_average_precision(
+            preds, target, num_classes, average, thresholds, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_average_precision(
+            preds, target, num_labels, average, thresholds, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

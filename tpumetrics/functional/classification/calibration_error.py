@@ -1,0 +1,202 @@
+"""Top-label calibration error (binary / multiclass).
+
+Counterpart of reference ``functional/classification/calibration_error.py``
+(`_ce_compute` :62-109 with l1/l2/max norms, `_binary_calibration_error_update`
+:136, `_multiclass_calibration_error_update` :238-246). Binning is a
+fixed-width histogram -> one scatter-add per batch, jit-able.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.classification.confusion_matrix import (
+    _multiclass_confusion_matrix_arg_validation,
+)
+from tpumetrics.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_tensor_validation,
+)
+from tpumetrics.functional.classification.stat_scores import (
+    _multiclass_stat_scores_tensor_validation,
+)
+from tpumetrics.utils.compute import _safe_divide, normalize_logits_if_needed
+
+Array = jax.Array
+
+
+def _binning_bucketize(
+    confidences: Array, accuracies: Array, bin_boundaries: Array
+) -> Tuple[Array, Array, Array]:
+    """Per-bin mean accuracy, mean confidence and bin proportion — a
+    fixed-width histogram lowered to scatter-adds (reference helper used by
+    :62-109)."""
+    n_bins = bin_boundaries.shape[0] - 1
+    indices = jnp.clip(jnp.searchsorted(bin_boundaries[1:-1], confidences, side="right"), 0, n_bins - 1)
+    count_bin = jax.ops.segment_sum(jnp.ones_like(confidences), indices, num_segments=n_bins)
+    conf_bin = _safe_divide(
+        jax.ops.segment_sum(confidences, indices, num_segments=n_bins), count_bin
+    )
+    acc_bin = _safe_divide(
+        jax.ops.segment_sum(accuracies.astype(confidences.dtype), indices, num_segments=n_bins), count_bin
+    )
+    prop_bin = count_bin / count_bin.sum()
+    return acc_bin, conf_bin, prop_bin
+
+
+def _ce_compute(
+    confidences: Array,
+    accuracies: Array,
+    bin_boundaries: Union[Array, int],
+    norm: str = "l1",
+    debias: bool = False,
+) -> Array:
+    """Reference calibration_error.py:62-109."""
+    if isinstance(bin_boundaries, int):
+        bin_boundaries = jnp.linspace(0, 1, bin_boundaries + 1, dtype=confidences.dtype)
+    if norm not in {"l1", "l2", "max"}:
+        raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+
+    acc_bin, conf_bin, prop_bin = _binning_bucketize(confidences, accuracies, bin_boundaries)
+
+    if norm == "l1":
+        return jnp.sum(jnp.abs(acc_bin - conf_bin) * prop_bin)
+    if norm == "max":
+        return jnp.max(jnp.abs(acc_bin - conf_bin))
+    ce = jnp.sum(jnp.power(acc_bin - conf_bin, 2) * prop_bin)
+    if debias:
+        debias_bins = (acc_bin * (acc_bin - 1) * prop_bin) / (prop_bin * accuracies.shape[0] - 1)
+        ce = ce + jnp.sum(jnp.nan_to_num(debias_bins))
+    return jnp.where(ce > 0, jnp.sqrt(jnp.maximum(ce, 0.0)), 0.0)
+
+
+def _binary_calibration_error_arg_validation(
+    n_bins: int,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(n_bins, int) or n_bins < 1:
+        raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
+    allowed_norm = ("l1", "l2", "max")
+    if norm not in allowed_norm:
+        raise ValueError(f"Expected argument `norm` to be one of {allowed_norm}, but got {norm}.")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Confidences are the raw positive-class probabilities; accuracies the
+     targets (reference :136-138)."""
+    return preds, target
+
+
+def binary_calibration_error(
+    preds: Array,
+    target: Array,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Top-label calibration error for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import binary_calibration_error
+        >>> preds = jnp.asarray([0.25, 0.25, 0.55, 0.75, 0.75])
+        >>> target = jnp.asarray([0, 0, 1, 1, 1])
+        >>> round(float(binary_calibration_error(preds, target, n_bins=2, norm='l1')), 4)
+        0.29
+    """
+    if validate_args:
+        _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds = preds.ravel()
+    target = target.ravel()
+    if ignore_index is not None:
+        idx = target != ignore_index
+        preds = preds[idx]
+        target = target[idx]
+    preds = normalize_logits_if_needed(preds, "sigmoid")
+    confidences, accuracies = _binary_calibration_error_update(preds, target)
+    return _ce_compute(confidences.astype(jnp.float32), accuracies.astype(jnp.float32), n_bins, norm)
+
+
+def _multiclass_calibration_error_arg_validation(
+    num_classes: int,
+    n_bins: int,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+) -> None:
+    _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, None)
+    if not isinstance(n_bins, int) or n_bins < 1:
+        raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
+    allowed_norm = ("l1", "l2", "max")
+    if norm not in allowed_norm:
+        raise ValueError(f"Expected argument `norm` to be one of {allowed_norm}, but got {norm}.")
+
+
+def _multiclass_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Top-1 confidence and correctness (reference :238-246)."""
+    preds = normalize_logits_if_needed(preds, "softmax")
+    confidences = jnp.max(preds, axis=1)
+    predictions = jnp.argmax(preds, axis=1)
+    accuracies = (predictions == target).astype(jnp.float32)
+    return confidences.astype(jnp.float32), accuracies
+
+
+def multiclass_calibration_error(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Top-label calibration error for multiclass tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multiclass_calibration_error
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1]])
+        >>> target = jnp.asarray([0, 1])
+        >>> round(float(multiclass_calibration_error(preds, target, num_classes=3)), 4)
+        0.15
+    """
+    if validate_args:
+        _multiclass_calibration_error_arg_validation(num_classes, n_bins, norm, ignore_index)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, "global", ignore_index)
+    preds = jnp.moveaxis(preds, 1, -1).reshape(-1, num_classes)
+    target = target.ravel()
+    if ignore_index is not None:
+        idx = target != ignore_index
+        preds = preds[idx]
+        target = target[idx]
+    confidences, accuracies = _multiclass_calibration_error_update(preds, target)
+    return _ce_compute(confidences, accuracies, n_bins, norm)
+
+
+def calibration_error(
+    preds: Array,
+    target: Array,
+    task: str,
+    n_bins: int = 15,
+    norm: str = "l1",
+    num_classes: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-string dispatcher (reference calibration_error.py task wrapper)."""
+    from tpumetrics.utils.enums import ClassificationTaskNoMultilabel
+
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_calibration_error(preds, target, n_bins, norm, ignore_index, validate_args)
+    if task == ClassificationTaskNoMultilabel.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_calibration_error(preds, target, num_classes, n_bins, norm, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,133 @@
+"""Cohen's kappa (binary / multiclass).
+
+Counterpart of reference ``functional/classification/cohen_kappa.py``
+(`_cohen_kappa_reduce` :33-54 with none/linear/quadratic weighting).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_arg_validation,
+    _masked_confmat,
+    _multiclass_confusion_matrix_arg_validation,
+)
+from tpumetrics.functional.classification.stat_scores import (
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+)
+
+Array = jax.Array
+
+
+def _cohen_kappa_reduce(confmat: Array, weights: Optional[str] = None) -> Array:
+    """Confusion matrix -> kappa (reference cohen_kappa.py:33-54)."""
+    confmat = confmat.astype(jnp.float32)
+    num_classes = confmat.shape[0]
+    sum0 = confmat.sum(axis=0, keepdims=True)
+    sum1 = confmat.sum(axis=1, keepdims=True)
+    expected = sum1 @ sum0 / sum0.sum()
+
+    if weights is None or weights == "none":
+        w_mat = jnp.ones_like(confmat).ravel()
+        w_mat = w_mat.at[:: num_classes + 1].set(0)
+        w_mat = w_mat.reshape(num_classes, num_classes)
+    elif weights in ("linear", "quadratic"):
+        w_mat = jnp.zeros_like(confmat) + jnp.arange(num_classes, dtype=confmat.dtype)
+        w_mat = jnp.abs(w_mat - w_mat.T) if weights == "linear" else jnp.power(w_mat - w_mat.T, 2.0)
+    else:
+        raise ValueError(
+            f"Received {weights} for argument ``weights`` but should be either None, 'linear' or 'quadratic'"
+        )
+    k = jnp.sum(w_mat * confmat) / jnp.sum(w_mat * expected)
+    return 1 - k
+
+
+def _cohen_kappa_weights_validation(weights: Optional[str]) -> None:
+    if weights not in (None, "none", "linear", "quadratic"):
+        raise ValueError(
+            f"Received {weights} for argument ``weights`` but should be either None, 'linear' or 'quadratic'"
+        )
+
+
+def binary_cohen_kappa(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    weights: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Cohen's kappa for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import binary_cohen_kappa
+        >>> preds = jnp.asarray([0.35, 0.85, 0.48, 0.01])
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> round(float(binary_cohen_kappa(preds, target)), 4)
+        0.5
+    """
+    if validate_args:
+        _binary_confusion_matrix_arg_validation(threshold, ignore_index, None)
+        _cohen_kappa_weights_validation(weights)
+        _binary_stat_scores_tensor_validation(preds, target, "global", ignore_index)
+    preds, target, mask = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    confmat = _masked_confmat(preds, target, mask, 2)
+    return _cohen_kappa_reduce(confmat, weights)
+
+
+def multiclass_cohen_kappa(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    weights: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Cohen's kappa for multiclass tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multiclass_cohen_kappa
+        >>> preds = jnp.asarray([2, 1, 0, 1])
+        >>> target = jnp.asarray([2, 1, 0, 0])
+        >>> round(float(multiclass_cohen_kappa(preds, target, num_classes=3)), 4)
+        0.6364
+    """
+    if validate_args:
+        _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, None)
+        _cohen_kappa_weights_validation(weights)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, "global", ignore_index)
+    preds, target, mask = _multiclass_stat_scores_format(preds, target, num_classes, ignore_index, 1)
+    confmat = _masked_confmat(preds, target, mask, num_classes)
+    return _cohen_kappa_reduce(confmat, weights)
+
+
+def cohen_kappa(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    weights: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-string dispatcher (reference cohen_kappa.py task wrapper)."""
+    from tpumetrics.utils.enums import ClassificationTaskNoMultilabel
+
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_cohen_kappa(preds, target, threshold, weights, ignore_index, validate_args)
+    if task == ClassificationTaskNoMultilabel.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_cohen_kappa(preds, target, num_classes, weights, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,120 @@
+"""Dice score.
+
+Counterpart of reference ``functional/classification/dice.py`` (:67-176,
+``2*TP / (2*TP + FP + FN)`` over the legacy auto-detected input formats).
+Implemented on one-hot contractions instead of the reference's legacy
+``_input_format_classification`` machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.utils.compute import _safe_divide, normalize_logits_if_needed
+
+Array = jax.Array
+
+
+def _dice_format(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+) -> Tuple[Array, Array, int]:
+    """Auto-detect input form and produce (N, C) one-hot preds/target."""
+    if preds.ndim == target.ndim + 1:  # (N, C, ...) scores
+        n_cls = preds.shape[1]
+        preds = jnp.moveaxis(preds, 1, -1).reshape(-1, n_cls)
+        target = target.ravel()
+        preds = normalize_logits_if_needed(preds, "softmax")
+        if top_k is not None and top_k > 1:
+            from tpumetrics.utils.data import select_topk
+
+            preds_oh = select_topk(preds, top_k, dim=1)
+        else:
+            preds_oh = jax.nn.one_hot(jnp.argmax(preds, axis=1), n_cls, dtype=jnp.int32)
+        target_oh = jax.nn.one_hot(target, n_cls, dtype=jnp.int32)
+        return preds_oh, target_oh, n_cls
+    if jnp.issubdtype(preds.dtype, jnp.floating):  # binary probabilities
+        preds = normalize_logits_if_needed(preds.ravel(), "sigmoid")
+        preds_lab = (preds > threshold).astype(jnp.int32)
+        target_lab = target.ravel().astype(jnp.int32)
+        n_cls = num_classes if num_classes is not None else 2
+        return (
+            jax.nn.one_hot(preds_lab, n_cls, dtype=jnp.int32),
+            jax.nn.one_hot(target_lab, n_cls, dtype=jnp.int32),
+            n_cls,
+        )
+    # integer labels
+    preds_lab = preds.ravel().astype(jnp.int32)
+    target_lab = target.ravel().astype(jnp.int32)
+    n_cls = num_classes if num_classes is not None else int(jnp.max(jnp.maximum(preds_lab, target_lab))) + 1
+    return (
+        jax.nn.one_hot(preds_lab, n_cls, dtype=jnp.int32),
+        jax.nn.one_hot(target_lab, n_cls, dtype=jnp.int32),
+        n_cls,
+    )
+
+
+def dice(
+    preds: Array,
+    target: Array,
+    zero_division: int = 0,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = "global",
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Dice = 2*TP / (2*TP + FP + FN) (reference dice.py:67-176).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import dice
+        >>> preds = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> round(float(dice(preds, target, average='micro')), 4)
+        0.25
+    """
+    allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+
+    preds_oh, target_oh, n_cls = _dice_format(preds, target, threshold, top_k, num_classes)
+
+    if ignore_index is not None and 0 <= ignore_index < n_cls:
+        keep = jnp.ones(n_cls).at[ignore_index].set(0.0)
+        preds_oh = preds_oh * keep.astype(jnp.int32)
+        target_oh = target_oh * keep.astype(jnp.int32)
+
+    if average == "samples":
+        tp = jnp.sum(preds_oh * target_oh, axis=1)
+        fp = jnp.sum(preds_oh * (1 - target_oh), axis=1)
+        fn = jnp.sum((1 - preds_oh) * target_oh, axis=1)
+        scores = _safe_divide(2.0 * tp, 2.0 * tp + fp + fn, zero_division)
+        return scores.mean()
+
+    tp = jnp.sum(preds_oh * target_oh, axis=0)
+    fp = jnp.sum(preds_oh * (1 - target_oh), axis=0)
+    fn = jnp.sum((1 - preds_oh) * target_oh, axis=0)
+
+    if average == "micro":
+        return _safe_divide(2.0 * tp.sum(), 2.0 * tp.sum() + fp.sum() + fn.sum(), zero_division)
+
+    scores = _safe_divide(2.0 * tp, 2.0 * tp + fp + fn, zero_division)
+    if average in ("none", None):
+        return scores
+    if average == "weighted":
+        weights = tp + fn
+        return jnp.sum(scores * _safe_divide(weights, weights.sum()))
+    # macro: average over classes present in either preds or target
+    present = ((tp + fp + fn) > 0).astype(scores.dtype)
+    if ignore_index is not None and 0 <= ignore_index < n_cls:
+        present = present.at[ignore_index].set(0.0)
+    return jnp.sum(scores * present) / jnp.maximum(present.sum(), 1.0)

@@ -1,0 +1,246 @@
+"""Group fairness: per-group stat rates, demographic parity, equal opportunity.
+
+Counterpart of reference ``functional/classification/group_fairness.py``
+(`_binary_groups_stat_scores` :52-84, `_compute_binary_demographic_parity`
+:164, `_compute_binary_equal_opportunity` :243, `binary_fairness` :326).
+
+TPU redesign: the reference sorts by group and host-splits
+(``_flexible_bincount(...).cpu().tolist()`` + ``torch.split``, reference
+:75-82 — a host sync with dynamic shapes). Here per-group tp/fp/tn/fn are
+one one-hot contraction ``group_onehot.T @ indicators`` — static shapes,
+jit-able, MXU-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.classification.stat_scores import (
+    _binary_stat_scores_arg_validation,
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+)
+from tpumetrics.utils.checks import _is_tracer
+from tpumetrics.utils.compute import _safe_divide
+from tpumetrics.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _groups_validation(groups: Array, num_groups: int) -> None:
+    """Reference group_fairness.py:30-44."""
+    if _is_tracer(groups):
+        return
+    if int(jnp.max(groups)) > num_groups:
+        raise ValueError(
+            f"The largest number in the groups tensor is {int(jnp.max(groups))}, which is larger than the specified"
+            f" number of groups {num_groups}. The group identifiers should be ``0, 1, ..., (num_groups - 1)``."
+        )
+    if not jnp.issubdtype(groups.dtype, jnp.integer):
+        raise ValueError(f"Expected dtype of argument groups to be int, not {groups.dtype}.")
+
+
+def _groups_format(groups: Array) -> Array:
+    return groups.reshape(groups.shape[0], -1)
+
+
+def _binary_groups_stat_scores(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    num_groups: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> List[Tuple[Array, Array, Array, Array]]:
+    """Per-group (tp, fp, tn, fn) via one one-hot contraction (cf. reference
+    :52-84 sort/split)."""
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, "global", ignore_index)
+        _groups_validation(groups, num_groups)
+
+    preds, target, mask = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    groups = _groups_format(groups)
+
+    g_oh = jax.nn.one_hot(groups.ravel(), num_groups, dtype=jnp.int32)  # (N, G)
+    p = preds.ravel()
+    t = target.ravel()
+    m = mask.ravel()
+    indicators = jnp.stack(
+        [
+            (p == 1) & (t == 1) & (m == 1),  # tp
+            (p == 1) & (t == 0) & (m == 1),  # fp
+            (p == 0) & (t == 0) & (m == 1),  # tn
+            (p == 0) & (t == 1) & (m == 1),  # fn
+        ],
+        axis=1,
+    ).astype(jnp.int32)  # (N, 4)
+    stats = g_oh.T @ indicators  # (G, 4)
+    return [(stats[g, 0], stats[g, 1], stats[g, 2], stats[g, 3]) for g in range(num_groups)]
+
+
+def _groups_reduce(group_stats: List[Tuple[Array, Array, Array, Array]]) -> Dict[str, Array]:
+    """Rates per group (reference :87-91)."""
+    return {
+        f"group_{group}": jnp.stack(stats) / jnp.stack(stats).sum() for group, stats in enumerate(group_stats)
+    }
+
+
+def _groups_stat_transform(group_stats: List[Tuple[Array, Array, Array, Array]]) -> Dict[str, Array]:
+    """Reference :94-102."""
+    return {
+        "tp": jnp.stack([stat[0] for stat in group_stats]),
+        "fp": jnp.stack([stat[1] for stat in group_stats]),
+        "tn": jnp.stack([stat[2] for stat in group_stats]),
+        "fn": jnp.stack([stat[3] for stat in group_stats]),
+    }
+
+
+def binary_groups_stat_rates(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    num_groups: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """tp/fp/tn/fn rates by group.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import binary_groups_stat_rates
+        >>> preds = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> groups = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> {k: v.tolist() for k, v in binary_groups_stat_rates(preds, target, groups, 2).items()}
+        {'group_0': [0.0, 0.0, 1.0, 0.0], 'group_1': [1.0, 0.0, 0.0, 0.0]}
+    """
+    group_stats = _binary_groups_stat_scores(
+        preds, target, groups, num_groups, threshold, ignore_index, validate_args
+    )
+    return _groups_reduce(group_stats)
+
+
+def _compute_binary_demographic_parity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
+    """Reference :164-175."""
+    pos_rates = _safe_divide(tp + fp, tp + fp + tn + fn)
+    min_pos_rate_id = int(jnp.argmin(pos_rates))
+    max_pos_rate_id = int(jnp.argmax(pos_rates))
+    return {
+        f"DP_{min_pos_rate_id}_{max_pos_rate_id}": _safe_divide(
+            pos_rates[min_pos_rate_id], pos_rates[max_pos_rate_id]
+        )
+    }
+
+
+def _compute_binary_equal_opportunity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
+    """Reference :243-255."""
+    true_pos_rates = _safe_divide(tp, tp + fn)
+    min_tpr_id = int(jnp.argmin(true_pos_rates))
+    max_tpr_id = int(jnp.argmax(true_pos_rates))
+    return {
+        f"EO_{min_tpr_id}_{max_tpr_id}": _safe_divide(true_pos_rates[min_tpr_id], true_pos_rates[max_tpr_id])
+    }
+
+
+def demographic_parity(
+    preds: Array,
+    groups: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Positivity-rate parity between groups (reference :177-241).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import demographic_parity
+        >>> preds = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> groups = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> {k: round(float(v), 4) for k, v in demographic_parity(preds, groups).items()}
+        {'DP_0_1': 0.0}
+    """
+    num_groups = int(jnp.max(groups)) + 1
+    target = jnp.zeros_like(preds, dtype=jnp.int32)
+    group_stats = _binary_groups_stat_scores(
+        preds, target, groups, num_groups, threshold, ignore_index, validate_args
+    )
+    transformed = _groups_stat_transform(group_stats)
+    return _compute_binary_demographic_parity(**transformed)
+
+
+def equal_opportunity(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """True-positive-rate parity between groups (reference :258-324).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import equal_opportunity
+        >>> preds = jnp.asarray([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> groups = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> {k: round(float(v), 4) for k, v in equal_opportunity(preds, target, groups).items()}
+        {'EO_0_1': 0.0}
+    """
+    num_groups = int(jnp.max(groups)) + 1
+    group_stats = _binary_groups_stat_scores(
+        preds, target, groups, num_groups, threshold, ignore_index, validate_args
+    )
+    transformed = _groups_stat_transform(group_stats)
+    return _compute_binary_equal_opportunity(**transformed)
+
+
+def binary_fairness(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    task: str = "all",
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Demographic parity and/or equal opportunity (reference :326-380).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import binary_fairness
+        >>> preds = jnp.asarray([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> groups = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> sorted(binary_fairness(preds, target, groups).keys())
+        ['DP_0_1', 'EO_0_1']
+    """
+    if task not in ["demographic_parity", "equal_opportunity", "all"]:
+        raise ValueError(
+            f"Expected argument `task` to either be ``demographic_parity``,"
+            f"``equal_opportunity`` or ``all`` but got {task}."
+        )
+    if task == "demographic_parity":
+        if target is not None:
+            rank_zero_warn("The task demographic_parity does not require a target.", UserWarning)
+        target = jnp.zeros_like(preds, dtype=jnp.int32)
+
+    num_groups = int(jnp.max(groups)) + 1
+    group_stats = _binary_groups_stat_scores(
+        preds, target, groups, num_groups, threshold, ignore_index, validate_args
+    )
+    transformed = _groups_stat_transform(group_stats)
+    if task == "demographic_parity":
+        return _compute_binary_demographic_parity(**transformed)
+    if task == "equal_opportunity":
+        return _compute_binary_equal_opportunity(**transformed)
+    return {
+        **_compute_binary_demographic_parity(**transformed),
+        **_compute_binary_equal_opportunity(**transformed),
+    }

@@ -1,0 +1,168 @@
+"""Hinge loss (binary / multiclass).
+
+Counterpart of reference ``functional/classification/hinge.py``
+(`_binary_hinge_loss_update` :50-63, `_multiclass_hinge_loss_update`
+:150-175 with crammer-singer / one-vs-all modes). The reference's
+boolean-mask scatter writes become ``jnp.where`` selects.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_tensor_validation,
+)
+from tpumetrics.utils.compute import normalize_logits_if_needed
+
+Array = jax.Array
+
+
+def _hinge_loss_compute(measure: Array, total: Array) -> Array:
+    return measure / total
+
+
+def _binary_hinge_loss_arg_validation(squared: bool, ignore_index: Optional[int] = None) -> None:
+    if not isinstance(squared, bool):
+        raise ValueError(f"Expected argument `squared` to be an bool but got {squared}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an int, but got {ignore_index}")
+
+
+def _binary_hinge_loss_update(preds: Array, target: Array, squared: bool) -> Tuple[Array, Array]:
+    """margin = +preds where positive, -preds where negative (reference :50-63)."""
+    margin = jnp.where(target == 1, preds, -preds)
+    measures = jnp.maximum(1 - margin, 0.0)
+    if squared:
+        measures = measures**2
+    total = jnp.asarray(target.shape[0])
+    return measures.sum(), total
+
+
+def binary_hinge_loss(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Mean hinge loss for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import binary_hinge_loss
+        >>> preds = jnp.asarray([0.25, 0.25, 0.55, 0.75, 0.75])
+        >>> target = jnp.asarray([0, 0, 1, 1, 1])
+        >>> round(float(binary_hinge_loss(preds, target)), 4)
+        0.69
+    """
+    if validate_args:
+        _binary_hinge_loss_arg_validation(squared, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds = preds.ravel()
+    target = target.ravel()
+    if ignore_index is not None:
+        idx = target != ignore_index
+        preds = preds[idx]
+        target = target[idx]
+    preds = normalize_logits_if_needed(preds, "sigmoid")
+    measures, total = _binary_hinge_loss_update(preds, target, squared)
+    return _hinge_loss_compute(measures, total)
+
+
+def _multiclass_hinge_loss_arg_validation(
+    num_classes: int,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    _binary_hinge_loss_arg_validation(squared, ignore_index)
+    if multiclass_mode not in ("crammer-singer", "one-vs-all"):
+        raise ValueError(
+            f"Expected argument `multiclass_mode` to be one of ('crammer-singer', 'one-vs-all'),"
+            f" but got {multiclass_mode}"
+        )
+
+
+def _multiclass_hinge_loss_update(
+    preds: Array,
+    target: Array,
+    squared: bool,
+    multiclass_mode: str = "crammer-singer",
+) -> Tuple[Array, Array]:
+    """Reference :150-175, vectorized with where-selects."""
+    target_oh = jax.nn.one_hot(target, preds.shape[1], dtype=jnp.bool_)
+    if multiclass_mode == "crammer-singer":
+        margin = jnp.sum(jnp.where(target_oh, preds, 0.0), axis=1)
+        margin = margin - jnp.max(jnp.where(target_oh, -jnp.inf, preds), axis=1)
+    else:  # one-vs-all
+        margin = jnp.where(target_oh, preds, -preds)
+    measures = jnp.maximum(1 - margin, 0.0)
+    if squared:
+        measures = measures**2
+    total = jnp.asarray(target.shape[0])
+    return measures.sum(axis=0), total
+
+
+def multiclass_hinge_loss(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Mean hinge loss for multiclass tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multiclass_hinge_loss
+        >>> preds = jnp.asarray([[0.25, 0.20, 0.55], [0.55, 0.05, 0.40], [0.10, 0.30, 0.60], [0.90, 0.05, 0.05]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> round(float(multiclass_hinge_loss(preds, target, num_classes=3)), 4)
+        0.9125
+    """
+    if validate_args:
+        _multiclass_hinge_loss_arg_validation(num_classes, squared, multiclass_mode, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds = jnp.moveaxis(preds, 1, -1).reshape(-1, num_classes)
+    target = target.ravel()
+    if ignore_index is not None:
+        idx = target != ignore_index
+        preds = preds[idx]
+        target = target[idx]
+    preds = normalize_logits_if_needed(preds, "softmax")
+    measures, total = _multiclass_hinge_loss_update(preds, target, squared, multiclass_mode)
+    return _hinge_loss_compute(measures, total)  # scalar (crammer-singer) or per-class (one-vs-all)
+
+
+def hinge_loss(
+    preds: Array,
+    target: Array,
+    task: str,
+    num_classes: Optional[int] = None,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-string dispatcher (reference hinge.py task wrapper)."""
+    from tpumetrics.utils.enums import ClassificationTaskNoMultilabel
+
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_hinge_loss(preds, target, squared, ignore_index, validate_args)
+    if task == ClassificationTaskNoMultilabel.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_hinge_loss(
+            preds, target, num_classes, squared, multiclass_mode, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

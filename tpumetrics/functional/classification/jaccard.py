@@ -1,0 +1,179 @@
+"""Jaccard index / IoU (binary / multiclass / multilabel).
+
+Counterpart of reference ``functional/classification/jaccard.py``
+(`_jaccard_index_reduce` :38-94 with binary/micro/macro/weighted/none
+averaging and absent-class down-weighting).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_arg_validation,
+    _masked_confmat,
+    _multiclass_confusion_matrix_arg_validation,
+    _multilabel_confmat,
+    _multilabel_confusion_matrix_arg_validation,
+)
+from tpumetrics.functional.classification.stat_scores import (
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+)
+from tpumetrics.utils.compute import _safe_divide
+
+Array = jax.Array
+
+
+def _jaccard_index_reduce(
+    confmat: Array,
+    average: Optional[str],
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Confusion matrix -> jaccard score (reference jaccard.py:38-94)."""
+    allowed_average = ("binary", "micro", "macro", "weighted", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    confmat = confmat.astype(jnp.float32)
+    if average == "binary":
+        return confmat[1, 1] / (confmat[0, 1] + confmat[1, 0] + confmat[1, 1])
+
+    ignore_index_cond = ignore_index is not None and 0 <= ignore_index < confmat.shape[0]
+    multilabel = confmat.ndim == 3
+    if multilabel:
+        num = confmat[:, 1, 1]
+        denom = confmat[:, 1, 1] + confmat[:, 0, 1] + confmat[:, 1, 0]
+    else:
+        num = jnp.diagonal(confmat)
+        denom = confmat.sum(0) + confmat.sum(1) - num
+
+    if average == "micro":
+        num = num.sum()
+        denom = denom.sum() - (denom[ignore_index] if ignore_index_cond else 0.0)
+
+    jaccard = _safe_divide(num, denom)
+
+    if average is None or average in ("none", "micro"):
+        return jaccard
+    if average == "weighted":
+        weights = confmat[:, 1, 1] + confmat[:, 1, 0] if multilabel else confmat.sum(1)
+    else:
+        weights = jnp.ones_like(jaccard)
+        if ignore_index_cond:
+            weights = weights.at[ignore_index].set(0.0)
+        if not multilabel:
+            weights = jnp.where(confmat.sum(1) + confmat.sum(0) == 0, 0.0, weights)
+    return ((weights * jaccard) / weights.sum()).sum()
+
+
+def binary_jaccard_index(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Jaccard index for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import binary_jaccard_index
+        >>> preds = jnp.asarray([0.35, 0.85, 0.48, 0.01])
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> round(float(binary_jaccard_index(preds, target)), 4)
+        0.5
+    """
+    if validate_args:
+        _binary_confusion_matrix_arg_validation(threshold, ignore_index, None)
+        _binary_stat_scores_tensor_validation(preds, target, "global", ignore_index)
+    preds, target, mask = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    confmat = _masked_confmat(preds, target, mask, 2)
+    return _jaccard_index_reduce(confmat, average="binary")
+
+
+def multiclass_jaccard_index(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Jaccard index for multiclass tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multiclass_jaccard_index
+        >>> preds = jnp.asarray([2, 1, 0, 1])
+        >>> target = jnp.asarray([2, 1, 0, 0])
+        >>> round(float(multiclass_jaccard_index(preds, target, num_classes=3)), 4)
+        0.6667
+    """
+    if validate_args:
+        _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, None)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, "global", ignore_index)
+    preds, target, mask = _multiclass_stat_scores_format(preds, target, num_classes, ignore_index, 1)
+    confmat = _masked_confmat(preds, target, mask, num_classes)
+    return _jaccard_index_reduce(confmat, average=average, ignore_index=ignore_index)
+
+
+def multilabel_jaccard_index(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Jaccard index for multilabel tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multilabel_jaccard_index
+        >>> preds = jnp.asarray([[0, 0, 1], [1, 0, 1]])
+        >>> target = jnp.asarray([[0, 1, 0], [1, 0, 1]])
+        >>> round(float(multilabel_jaccard_index(preds, target, num_labels=3)), 4)
+        0.5
+    """
+    if validate_args:
+        _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, None)
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, "global", ignore_index)
+    preds, target, mask = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    confmat = _multilabel_confmat(preds, target, mask, num_labels)
+    return _jaccard_index_reduce(confmat, average=average, ignore_index=ignore_index)
+
+
+def jaccard_index(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-string dispatcher (reference jaccard.py task wrapper)."""
+    from tpumetrics.utils.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_jaccard_index(preds, target, threshold, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_jaccard_index(preds, target, num_classes, average, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_jaccard_index(preds, target, num_labels, threshold, average, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,169 @@
+"""Matthews correlation coefficient (binary / multiclass / multilabel).
+
+Counterpart of reference ``functional/classification/matthews_corrcoef.py``
+(`_matthews_corrcoef_reduce` :37-77 incl. the R_K generalization and the
+zero-denominator epsilon handling).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_arg_validation,
+    _masked_confmat,
+    _multiclass_confusion_matrix_arg_validation,
+    _multilabel_confmat,
+    _multilabel_confusion_matrix_arg_validation,
+)
+from tpumetrics.functional.classification.stat_scores import (
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+)
+
+Array = jax.Array
+
+
+def _matthews_corrcoef_reduce(confmat: Array) -> Array:
+    """Confusion matrix -> MCC via the R_K statistic (reference
+    matthews_corrcoef.py:37-77), fully traceable: the reference's
+    data-dependent branches become where-selects so the reduce can run
+    inside jit/shard_map."""
+    confmat = confmat.sum(0) if confmat.ndim == 3 else confmat  # multilabel -> binary
+
+    tk = confmat.sum(axis=-1).astype(jnp.float32)
+    pk = confmat.sum(axis=-2).astype(jnp.float32)
+    c = jnp.trace(confmat).astype(jnp.float32)
+    s = confmat.sum().astype(jnp.float32)
+
+    cov_ytyp = c * s - jnp.sum(tk * pk)
+    cov_ypyp = s**2 - jnp.sum(pk * pk)
+    cov_ytyt = s**2 - jnp.sum(tk * tk)
+    denom = cov_ypyp * cov_ytyt
+
+    standard = jnp.where(denom == 0, 0.0, cov_ytyp / jnp.sqrt(jnp.where(denom == 0, 1.0, denom)))
+    if confmat.size != 4:
+        return standard
+
+    # binary special cases (reference :46-52, :62-75)
+    flat = confmat.reshape(-1).astype(jnp.float32)
+    tn, fp, fn, tp = flat[0], flat[1], flat[2], flat[3]
+    eps = float(np.finfo(np.float32).eps)
+    a = jnp.where((tp == 0) | (tn == 0), tp + tn, 0.0)
+    b = jnp.where((fp == 0) | (fn == 0), fp + fn, 0.0)
+    eps_num = np.sqrt(eps) * (a - b)
+    eps_denom = (tp + fp + eps) * (tp + fn + eps) * (tn + fp + eps) * (tn + fn + eps)
+    res = jnp.where(denom == 0, eps_num / jnp.sqrt(eps_denom), standard)
+    res = jnp.where((tp + tn != 0) & (fp + fn == 0), 1.0, res)
+    return jnp.where((tp + tn == 0) & (fp + fn != 0), -1.0, res)
+
+
+def binary_matthews_corrcoef(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """MCC for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import binary_matthews_corrcoef
+        >>> preds = jnp.asarray([0.35, 0.85, 0.48, 0.01])
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> round(float(binary_matthews_corrcoef(preds, target)), 4)
+        0.5774
+    """
+    if validate_args:
+        _binary_confusion_matrix_arg_validation(threshold, ignore_index, None)
+        _binary_stat_scores_tensor_validation(preds, target, "global", ignore_index)
+    preds, target, mask = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    confmat = _masked_confmat(preds, target, mask, 2)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def multiclass_matthews_corrcoef(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """MCC for multiclass tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multiclass_matthews_corrcoef
+        >>> preds = jnp.asarray([2, 1, 0, 1])
+        >>> target = jnp.asarray([2, 1, 0, 0])
+        >>> round(float(multiclass_matthews_corrcoef(preds, target, num_classes=3)), 4)
+        0.7
+    """
+    if validate_args:
+        _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, None)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, "global", ignore_index)
+    preds, target, mask = _multiclass_stat_scores_format(preds, target, num_classes, ignore_index, 1)
+    confmat = _masked_confmat(preds, target, mask, num_classes)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def multilabel_matthews_corrcoef(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """MCC for multilabel tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multilabel_matthews_corrcoef
+        >>> preds = jnp.asarray([[0, 0, 1], [1, 0, 1]])
+        >>> target = jnp.asarray([[0, 1, 0], [1, 0, 1]])
+        >>> round(float(multilabel_matthews_corrcoef(preds, target, num_labels=3)), 4)
+        0.3333
+    """
+    if validate_args:
+        _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, None)
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, "global", ignore_index)
+    preds, target, mask = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    confmat = _multilabel_confmat(preds, target, mask, num_labels)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def matthews_corrcoef(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-string dispatcher (reference matthews_corrcoef.py task wrapper)."""
+    from tpumetrics.utils.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_matthews_corrcoef(preds, target, threshold, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_matthews_corrcoef(preds, target, num_classes, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_matthews_corrcoef(preds, target, num_labels, threshold, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,171 @@
+"""Multilabel ranking metrics: coverage error, ranking average precision,
+ranking loss.
+
+Counterpart of reference ``functional/classification/ranking.py``
+(`_multilabel_coverage_error_update` :48-55,
+`_multilabel_ranking_average_precision_update` :112-128,
+`_multilabel_ranking_loss_update` :185-213). The reference's per-sample
+Python loop for ranking AP becomes one batched max-rank contraction —
+O(N·L²) elementwise ops that XLA fuses, no host loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.classification.precision_recall_curve import (
+    _multilabel_precision_recall_curve_tensor_validation,
+)
+from tpumetrics.utils.compute import normalize_logits_if_needed
+
+Array = jax.Array
+
+
+def _ranking_reduce(score: Array, num_elements: Array) -> Array:
+    return score / num_elements
+
+
+def _rank_data_max(x: Array) -> Array:
+    """'max' ranking along the last axis: rank of v = #elements <= v (ties get
+    the max rank, matching scipy.stats.rankdata(method='max'))."""
+    return jnp.sum(x[..., None, :] <= x[..., :, None], axis=-1)
+
+
+def _multilabel_ranking_format(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int]
+) -> Tuple[Array, Array]:
+    preds = preds.reshape(preds.shape[0], num_labels, -1)
+    preds = jnp.moveaxis(preds, 1, -1).reshape(-1, num_labels)
+    target = target.reshape(target.shape[0], num_labels, -1)
+    target = jnp.moveaxis(target, 1, -1).reshape(-1, num_labels)
+    preds = normalize_logits_if_needed(preds, "sigmoid")
+    if ignore_index is not None:
+        # reference confusion_matrix.py:509-516: mark BOTH with -4*num_labels,
+        # so ignored entries rank strictly last and never count as relevant
+        idx = target == ignore_index
+        preds = jnp.where(idx, -4.0 * num_labels, preds)
+        target = jnp.where(idx, -4 * num_labels, target)
+    return preds, target
+
+
+def _multilabel_coverage_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Reference ranking.py:48-55, with the boolean-mask offset as a where."""
+    offset = jnp.where(target == 0, jnp.abs(preds.min()) + 10, 0.0)
+    preds_mod = preds + offset
+    preds_min = preds_mod.min(axis=1)
+    coverage = jnp.sum(preds >= preds_min[:, None], axis=1).astype(jnp.float32)
+    return coverage.sum(), jnp.asarray(coverage.shape[0])
+
+
+def multilabel_coverage_error(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """How far down the ranking one must go to cover all true labels.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multilabel_coverage_error
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.35]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 1], [0, 1, 1]])
+        >>> round(float(multilabel_coverage_error(preds, target, num_labels=3)), 4)
+        2.3333
+    """
+    if validate_args:
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target = _multilabel_ranking_format(preds, target, num_labels, ignore_index)
+    score, num_elements = _multilabel_coverage_error_update(preds, target)
+    return _ranking_reduce(score, num_elements)
+
+
+def _multilabel_ranking_average_precision_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Batched max-rank formulation of reference ranking.py:112-128."""
+    neg_preds = -preds
+    relevant = target == 1
+    num_labels = preds.shape[1]
+
+    # rank among all labels ('max' ties): (N, L)
+    rank_all = _rank_data_max(neg_preds).astype(jnp.float32)
+    # rank among relevant labels only: #relevant j with neg_preds[j] <= neg_preds[i]
+    rank_rel = jnp.sum(
+        (neg_preds[:, None, :] <= neg_preds[:, :, None]) & relevant[:, None, :], axis=-1
+    ).astype(jnp.float32)
+
+    n_rel = relevant.sum(axis=1)
+    per_label = jnp.where(relevant, rank_rel / rank_all, 0.0)
+    score_per_sample = jnp.where(
+        (n_rel > 0) & (n_rel < num_labels),
+        jnp.sum(per_label, axis=1) / jnp.maximum(n_rel, 1),
+        1.0,
+    )
+    return score_per_sample.sum(), jnp.asarray(preds.shape[0])
+
+
+def multilabel_ranking_average_precision(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Label ranking average precision for multilabel data.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multilabel_ranking_average_precision
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.35]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 1], [0, 1, 1]])
+        >>> round(float(multilabel_ranking_average_precision(preds, target, num_labels=3)), 4)
+        0.7778
+    """
+    if validate_args:
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target = _multilabel_ranking_format(preds, target, num_labels, ignore_index)
+    score, num_elements = _multilabel_ranking_average_precision_update(preds, target)
+    return _ranking_reduce(score, num_elements)
+
+
+def _multilabel_ranking_loss_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Reference ranking.py:185-213, with sample masking instead of dropping."""
+    num_preds, num_labels = preds.shape
+    relevant = target == 1
+    num_relevant = relevant.sum(axis=1)
+
+    mask = (num_relevant > 0) & (num_relevant < num_labels)
+
+    inverse = jnp.argsort(jnp.argsort(preds, axis=1), axis=1)
+    per_label_loss = ((num_labels - inverse) * relevant).astype(jnp.float32)
+    correction = 0.5 * num_relevant * (num_relevant + 1)
+    denom = num_relevant * (num_labels - num_relevant)
+    loss = jnp.where(mask, (per_label_loss.sum(axis=1) - correction) / jnp.maximum(denom, 1), 0.0)
+    return loss.sum(), jnp.asarray(num_preds)
+
+
+def multilabel_ranking_loss(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Ranking loss for multilabel data (lower is better).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multilabel_ranking_loss
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.35]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 1], [0, 1, 1]])
+        >>> round(float(multilabel_ranking_loss(preds, target, num_labels=3)), 4)
+        0.3333
+    """
+    if validate_args:
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target = _multilabel_ranking_format(preds, target, num_labels, ignore_index)
+    score, num_elements = _multilabel_ranking_loss_update(preds, target)
+    return _ranking_reduce(score, num_elements)

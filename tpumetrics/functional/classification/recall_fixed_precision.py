@@ -1,0 +1,248 @@
+"""Best recall subject to a minimum-precision constraint.
+
+Counterpart of reference ``functional/classification/recall_fixed_precision.py``
+(`_recall_at_precision` :58-76 with lexicographic tie-breaking,
+`_binary_recall_at_fixed_precision_compute` :91-99, multiclass/multilabel
+variants).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.classification.precision_recall_curve import (
+    Thresholds,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+
+Array = jax.Array
+
+
+def _lexmax_constrained(
+    primary: Array, secondary: Array, thresholds: Array, valid: Array
+) -> Tuple[Array, Array]:
+    """Among valid entries, lexicographic max of (primary, secondary,
+    threshold); returns (max primary, its threshold). Trace-safe equivalent
+    of the reference's boolean-filter + ``_lexargmax`` (reference
+    recall_fixed_precision.py:58-76) — fully where/max based so the binned
+    path stays jit-able."""
+    neg = -jnp.inf
+    p = jnp.where(valid, primary, neg)
+    max_p = jnp.max(p)
+    v2 = valid & (primary == max_p)
+    s = jnp.where(v2, secondary, neg)
+    max_s = jnp.max(s)
+    v3 = v2 & (secondary == max_s)
+    best_t = jnp.max(jnp.where(v3, thresholds, neg))
+    any_valid = jnp.any(valid)
+    max_primary = jnp.where(any_valid, max_p, 0.0)
+    best_t = jnp.where(any_valid, best_t, 0.0)
+    best_t = jnp.where(max_primary == 0.0, jnp.asarray(1e6, dtype=thresholds.dtype), best_t)
+    return max_primary.astype(primary.dtype), best_t.astype(thresholds.dtype)
+
+
+def _recall_at_precision(
+    precision: Array,
+    recall: Array,
+    thresholds: Array,
+    min_precision: float,
+) -> Tuple[Array, Array]:
+    """Max recall with precision >= min_precision; threshold 1e6 when
+    unattainable (reference :58-76)."""
+    zipped_len = min(t.shape[0] for t in (recall, precision, thresholds))
+    recall, precision, thresholds = recall[:zipped_len], precision[:zipped_len], thresholds[:zipped_len]
+    return _lexmax_constrained(recall, precision, thresholds, precision >= min_precision)
+
+
+def _binary_recall_at_fixed_precision_arg_validation(
+    min_precision: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+    if not isinstance(min_precision, float) or not (0 <= min_precision <= 1):
+        raise ValueError(
+            f"Expected argument `min_precision` to be an float in the [0,1] range, but got {min_precision}"
+        )
+
+
+def _binary_recall_at_fixed_precision_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    min_precision: float,
+    pos_label: int = 1,
+    reduce_fn: Callable = _recall_at_precision,
+) -> Tuple[Array, Array]:
+    precision, recall, thresholds = _binary_precision_recall_curve_compute(state, thresholds, pos_label)
+    return reduce_fn(precision, recall, thresholds, min_precision)
+
+
+def binary_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    min_precision: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """(max recall, threshold) subject to precision >= min_precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import binary_recall_at_fixed_precision
+        >>> preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> recall, threshold = binary_recall_at_fixed_precision(preds, target, min_precision=0.5)
+        >>> (round(float(recall), 4), round(float(threshold), 4))
+        (1.0, 0.35)
+    """
+    if validate_args:
+        _binary_recall_at_fixed_precision_arg_validation(min_precision, thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds, ignore_index)
+    return _binary_recall_at_fixed_precision_compute(state, thresholds, min_precision)
+
+
+def _multiclass_recall_at_fixed_precision_arg_validation(
+    num_classes: int,
+    min_precision: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+    if not isinstance(min_precision, float) or not (0 <= min_precision <= 1):
+        raise ValueError(
+            f"Expected argument `min_precision` to be an float in the [0,1] range, but got {min_precision}"
+        )
+
+
+def _multiclass_recall_at_fixed_precision_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+    min_precision: float,
+    reduce_fn: Callable = _recall_at_precision,
+) -> Tuple[Array, Array]:
+    precision, recall, thresholds = _multiclass_precision_recall_curve_compute(
+        state, num_classes, thresholds, average=None
+    )
+    if isinstance(precision, jax.Array):
+        res = [reduce_fn(precision[i], recall[i], thresholds, min_precision) for i in range(num_classes)]
+    else:
+        res = [reduce_fn(precision[i], recall[i], thresholds[i], min_precision) for i in range(num_classes)]
+    return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
+
+
+def multiclass_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_precision: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-class (max recall, threshold) subject to precision >= min_precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multiclass_recall_at_fixed_precision
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.05, 0.9, 0.05], [0.05, 0.05, 0.9]])
+        >>> target = jnp.asarray([0, 1, 2])
+        >>> recall, thresholds = multiclass_recall_at_fixed_precision(preds, target, num_classes=3,
+        ...                                                           min_precision=0.5)
+        >>> recall.tolist()
+        [1.0, 1.0, 1.0]
+    """
+    if validate_args:
+        _multiclass_recall_at_fixed_precision_arg_validation(num_classes, min_precision, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds_arr = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(
+        preds, target, num_classes, thresholds_arr, None, ignore_index
+    )
+    return _multiclass_recall_at_fixed_precision_compute(state, num_classes, thresholds_arr, min_precision)
+
+
+def _multilabel_recall_at_fixed_precision_arg_validation(
+    num_labels: int,
+    min_precision: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+    if not isinstance(min_precision, float) or not (0 <= min_precision <= 1):
+        raise ValueError(
+            f"Expected argument `min_precision` to be an float in the [0,1] range, but got {min_precision}"
+        )
+
+
+def _multilabel_recall_at_fixed_precision_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int],
+    min_precision: float,
+    reduce_fn: Callable = _recall_at_precision,
+) -> Tuple[Array, Array]:
+    precision, recall, thresholds = _multilabel_precision_recall_curve_compute(
+        state, num_labels, thresholds, ignore_index
+    )
+    if isinstance(precision, jax.Array):
+        res = [reduce_fn(precision[i], recall[i], thresholds, min_precision) for i in range(num_labels)]
+    else:
+        res = [reduce_fn(precision[i], recall[i], thresholds[i], min_precision) for i in range(num_labels)]
+    return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
+
+
+def multilabel_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_precision: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-label (max recall, threshold) subject to precision >= min_precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multilabel_recall_at_fixed_precision
+        >>> preds = jnp.asarray([[0.75, 0.05], [0.05, 0.75], [0.05, 0.05], [0.75, 0.75]])
+        >>> target = jnp.asarray([[1, 0], [0, 1], [0, 0], [1, 1]])
+        >>> recall, thresholds = multilabel_recall_at_fixed_precision(preds, target, num_labels=2,
+        ...                                                           min_precision=0.5)
+        >>> recall.tolist()
+        [1.0, 1.0]
+    """
+    if validate_args:
+        _multilabel_recall_at_fixed_precision_arg_validation(num_labels, min_precision, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds_arr = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds_arr, ignore_index)
+    return _multilabel_recall_at_fixed_precision_compute(
+        state, num_labels, thresholds_arr, ignore_index, min_precision
+    )

@@ -1,0 +1,243 @@
+"""Best specificity subject to a minimum-sensitivity constraint.
+
+Counterpart of reference ``functional/classification/specificity_sensitivity.py``
+(`_convert_fpr_to_specificity` :42, `_specificity_at_sensitivity` :47-70,
+binary/multiclass/multilabel variants). Built on the ROC state machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.classification.precision_recall_curve import (
+    Thresholds,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from tpumetrics.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+
+Array = jax.Array
+
+
+def _convert_fpr_to_specificity(fpr: Array) -> Array:
+    return 1 - fpr
+
+
+def _specificity_at_sensitivity(
+    specificity: Array,
+    sensitivity: Array,
+    thresholds: Array,
+    min_sensitivity: float,
+) -> Tuple[Array, Array]:
+    """Max specificity with sensitivity >= min_sensitivity; (0, 1e6) when
+    unattainable (reference :47-70). Trace-safe: the reference's boolean
+    filter + argmax becomes where/argmax so the binned path stays jit-able."""
+    valid = sensitivity >= min_sensitivity
+    masked_spec = jnp.where(valid, specificity, -jnp.inf)
+    idx = jnp.argmax(masked_spec)
+    any_valid = jnp.any(valid)
+    max_spec = jnp.where(any_valid, specificity[idx], jnp.asarray(0.0, dtype=specificity.dtype))
+    best_threshold = jnp.where(any_valid, thresholds[idx], jnp.asarray(1e6, dtype=thresholds.dtype))
+    return max_spec, best_threshold
+
+
+def _validate_min_sensitivity(min_sensitivity: float) -> None:
+    if not isinstance(min_sensitivity, float) or not (0 <= min_sensitivity <= 1):
+        raise ValueError(
+            f"Expected argument `min_sensitivity` to be an float in the [0,1] range, but got {min_sensitivity}"
+        )
+
+
+def _binary_specificity_at_sensitivity_arg_validation(
+    min_sensitivity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+    _validate_min_sensitivity(min_sensitivity)
+
+
+def _multiclass_specificity_at_sensitivity_arg_validation(
+    num_classes: int,
+    min_sensitivity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+    _validate_min_sensitivity(min_sensitivity)
+
+
+def _multilabel_specificity_at_sensitivity_arg_validation(
+    num_labels: int,
+    min_sensitivity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+    _validate_min_sensitivity(min_sensitivity)
+
+
+def _binary_specificity_at_sensitivity_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    min_sensitivity: float,
+    pos_label: int = 1,
+) -> Tuple[Array, Array]:
+    fpr, tpr, thresholds = _binary_roc_compute(state, thresholds, pos_label)
+    specificity = _convert_fpr_to_specificity(fpr)
+    return _specificity_at_sensitivity(specificity, tpr, thresholds, min_sensitivity)
+
+
+def binary_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    min_sensitivity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """(max specificity, threshold) subject to sensitivity >= min_sensitivity.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import binary_specificity_at_sensitivity
+        >>> preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> spec, threshold = binary_specificity_at_sensitivity(preds, target, min_sensitivity=0.5)
+        >>> (round(float(spec), 4), round(float(threshold), 4))
+        (1.0, 0.8)
+    """
+    if validate_args:
+        _binary_specificity_at_sensitivity_arg_validation(min_sensitivity, thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds, ignore_index)
+    return _binary_specificity_at_sensitivity_compute(state, thresholds, min_sensitivity)
+
+
+def _multiclass_specificity_at_sensitivity_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+    min_sensitivity: float,
+) -> Tuple[Array, Array]:
+    fpr, tpr, thresholds = _multiclass_roc_compute(state, num_classes, thresholds)
+    if isinstance(fpr, jax.Array):
+        res = [
+            _specificity_at_sensitivity(_convert_fpr_to_specificity(fpr[i]), tpr[i], thresholds, min_sensitivity)
+            for i in range(num_classes)
+        ]
+    else:
+        res = [
+            _specificity_at_sensitivity(
+                _convert_fpr_to_specificity(fpr[i]), tpr[i], thresholds[i], min_sensitivity
+            )
+            for i in range(num_classes)
+        ]
+    return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
+
+
+def multiclass_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_sensitivity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-class (max specificity, threshold) subject to sensitivity >= min.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multiclass_specificity_at_sensitivity
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.05, 0.9, 0.05], [0.05, 0.05, 0.9]])
+        >>> target = jnp.asarray([0, 1, 2])
+        >>> spec, thresholds = multiclass_specificity_at_sensitivity(preds, target, num_classes=3,
+        ...                                                          min_sensitivity=0.5)
+        >>> spec.tolist()
+        [1.0, 1.0, 1.0]
+    """
+    if validate_args:
+        _multiclass_specificity_at_sensitivity_arg_validation(num_classes, min_sensitivity, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds_arr = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(
+        preds, target, num_classes, thresholds_arr, None, ignore_index
+    )
+    return _multiclass_specificity_at_sensitivity_compute(state, num_classes, thresholds_arr, min_sensitivity)
+
+
+def _multilabel_specificity_at_sensitivity_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int],
+    min_sensitivity: float,
+) -> Tuple[Array, Array]:
+    fpr, tpr, thresholds = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+    if isinstance(fpr, jax.Array):
+        res = [
+            _specificity_at_sensitivity(_convert_fpr_to_specificity(fpr[i]), tpr[i], thresholds, min_sensitivity)
+            for i in range(num_labels)
+        ]
+    else:
+        res = [
+            _specificity_at_sensitivity(
+                _convert_fpr_to_specificity(fpr[i]), tpr[i], thresholds[i], min_sensitivity
+            )
+            for i in range(num_labels)
+        ]
+    return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
+
+
+def multilabel_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_sensitivity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-label (max specificity, threshold) subject to sensitivity >= min.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.classification import multilabel_specificity_at_sensitivity
+        >>> preds = jnp.asarray([[0.75, 0.05], [0.05, 0.75], [0.05, 0.05], [0.75, 0.75]])
+        >>> target = jnp.asarray([[1, 0], [0, 1], [0, 0], [1, 1]])
+        >>> spec, thresholds = multilabel_specificity_at_sensitivity(preds, target, num_labels=2,
+        ...                                                          min_sensitivity=0.5)
+        >>> spec.tolist()
+        [1.0, 1.0]
+    """
+    if validate_args:
+        _multilabel_specificity_at_sensitivity_arg_validation(num_labels, min_sensitivity, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds_arr = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds_arr, ignore_index)
+    return _multilabel_specificity_at_sensitivity_compute(
+        state, num_labels, thresholds_arr, ignore_index, min_sensitivity
+    )
