@@ -1,0 +1,253 @@
+"""Regression domain validated against sklearn/scipy (counterpart of reference
+tests/unittests/regression/test_*.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import kendalltau, pearsonr, spearmanr
+from sklearn.metrics import (
+    explained_variance_score as sk_ev,
+    mean_absolute_error as sk_mae,
+    mean_absolute_percentage_error as sk_mape,
+    mean_squared_error as sk_mse,
+    mean_squared_log_error as sk_msle,
+    mean_tweedie_deviance as sk_tweedie,
+    r2_score as sk_r2,
+)
+
+import tpumetrics.functional.regression as tmr
+import tpumetrics.regression as tmrc
+from tests.conftest import BATCH_SIZE, NUM_BATCHES
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.default_rng(123)
+preds = _rng.standard_normal((NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+target = (preds + 0.4 * _rng.standard_normal((NUM_BATCHES, BATCH_SIZE))).astype(np.float32)
+pos_preds = np.abs(preds) + 0.1
+pos_target = np.abs(target) + 0.1
+preds_2d = _rng.standard_normal((NUM_BATCHES, BATCH_SIZE, 3)).astype(np.float32)
+target_2d = (preds_2d + 0.4 * _rng.standard_normal((NUM_BATCHES, BATCH_SIZE, 3))).astype(np.float32)
+
+
+def _j(x):
+    return [jnp.asarray(b) for b in x]
+
+
+class TestBasicErrors(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize(
+        ("metric_class", "metric_fn", "ref", "args"),
+        [
+            (tmrc.MeanSquaredError, tmr.mean_squared_error, lambda p, t: sk_mse(t, p), {}),
+            (
+                tmrc.MeanSquaredError,
+                tmr.mean_squared_error,
+                lambda p, t: sk_mse(t, p) ** 0.5,
+                {"squared": False},
+            ),
+            (tmrc.MeanAbsoluteError, tmr.mean_absolute_error, lambda p, t: sk_mae(t, p), {}),
+            (
+                tmrc.MeanAbsolutePercentageError,
+                tmr.mean_absolute_percentage_error,
+                lambda p, t: sk_mape(t, p),
+                {},
+            ),
+        ],
+    )
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_vs_sklearn(self, metric_class, metric_fn, ref, args, ddp):
+        self.run_class_metric_test(
+            ddp=ddp, preds=_j(preds), target=_j(target), metric_class=metric_class,
+            reference_metric=ref, metric_args=args, check_batch=False,
+        )
+        self.run_functional_metric_test(_j(preds), _j(target), metric_fn, ref, metric_args=args)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_msle(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp, preds=_j(pos_preds), target=_j(pos_target), metric_class=tmrc.MeanSquaredLogError,
+            reference_metric=lambda p, t: sk_msle(t, p), check_batch=False,
+        )
+
+    @pytest.mark.parametrize("power", [0.0, 1.0, 2.0, 1.5, 3.0])
+    def test_tweedie(self, power):
+        p, t = np.concatenate(pos_preds), np.concatenate(pos_target)
+        res = tmr.tweedie_deviance_score(jnp.asarray(p), jnp.asarray(t), power=power)
+        assert abs(float(res) - sk_tweedie(t, p, power=power)) < 1e-4
+
+    def test_minkowski(self):
+        p, t = np.concatenate(preds), np.concatenate(target)
+        res = tmr.minkowski_distance(jnp.asarray(p), jnp.asarray(t), p=3)
+        ref = (np.abs(p - t) ** 3).sum() ** (1 / 3)
+        assert abs(float(res) - ref) < 1e-4
+
+    def test_log_cosh(self):
+        p, t = np.concatenate(preds), np.concatenate(target)
+        res = tmr.log_cosh_error(jnp.asarray(p), jnp.asarray(t))
+        ref = np.log(np.cosh(p - t)).mean()
+        assert abs(float(res) - ref) < 1e-5
+
+    def test_smape_wmape(self):
+        p, t = np.concatenate(preds), np.concatenate(target)
+        smape = float(tmr.symmetric_mean_absolute_percentage_error(jnp.asarray(p), jnp.asarray(t)))
+        ref_smape = np.mean(2 * np.abs(p - t) / np.maximum(np.abs(p) + np.abs(t), 1.17e-6))
+        assert abs(smape - ref_smape) < 1e-5
+        wmape = float(tmr.weighted_mean_absolute_percentage_error(jnp.asarray(p), jnp.asarray(t)))
+        assert abs(wmape - np.abs(p - t).sum() / np.abs(t).sum()) < 1e-5
+
+
+class TestVarianceMetrics(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_explained_variance(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp, preds=_j(preds), target=_j(target), metric_class=tmrc.ExplainedVariance,
+            reference_metric=lambda p, t: sk_ev(t, p), check_batch=False,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_r2(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp, preds=_j(preds), target=_j(target), metric_class=tmrc.R2Score,
+            reference_metric=lambda p, t: sk_r2(t, p), check_batch=False,
+        )
+
+    @pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average", "variance_weighted"])
+    def test_r2_multioutput(self, multioutput):
+        p, t = np.concatenate(preds_2d), np.concatenate(target_2d)
+        res = tmr.r2_score(jnp.asarray(p), jnp.asarray(t), multioutput=multioutput)
+        np.testing.assert_allclose(np.asarray(res), sk_r2(t, p, multioutput=multioutput), atol=1e-5)
+
+    def test_r2_adjusted(self):
+        p, t = np.concatenate(preds), np.concatenate(target)
+        n = len(p)
+        base = sk_r2(t, p)
+        adj_ref = 1 - (1 - base) * (n - 1) / (n - 5 - 1)
+        res = tmr.r2_score(jnp.asarray(p), jnp.asarray(t), adjusted=5)
+        assert abs(float(res) - adj_ref) < 1e-5
+
+    def test_rse(self):
+        p, t = np.concatenate(preds), np.concatenate(target)
+        res = float(tmr.relative_squared_error(jnp.asarray(p), jnp.asarray(t)))
+        ref = ((t - p) ** 2).sum() / ((t - t.mean()) ** 2).sum()
+        assert abs(res - ref) < 1e-5
+
+
+class TestCorrelations(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_pearson(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp, preds=_j(preds), target=_j(target), metric_class=tmrc.PearsonCorrCoef,
+            reference_metric=lambda p, t: pearsonr(p, t)[0], check_batch=False,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_concordance(self, ddp):
+        def ref(p, t):
+            vx, vy = p.var(ddof=1), t.var(ddof=1)
+            return 2 * pearsonr(p, t)[0] * np.sqrt(vx * vy) / (vx + vy + (p.mean() - t.mean()) ** 2)
+
+        self.run_class_metric_test(
+            ddp=ddp, preds=_j(preds), target=_j(target), metric_class=tmrc.ConcordanceCorrCoef,
+            reference_metric=ref, check_batch=False,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_spearman(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp, preds=_j(preds), target=_j(target), metric_class=tmrc.SpearmanCorrCoef,
+            reference_metric=lambda p, t: spearmanr(p, t)[0], check_batch=False,
+            shard_map_mode=False,  # rank computation needs concrete sizes
+        )
+
+    @pytest.mark.parametrize("variant", ["b", "c"])
+    def test_kendall(self, variant):
+        p, t = np.concatenate(preds), np.concatenate(target)
+        res = float(tmr.kendall_rank_corrcoef(jnp.asarray(p), jnp.asarray(t), variant=variant))
+        assert abs(res - kendalltau(p, t, variant=variant)[0]) < 1e-5
+
+    def test_kendall_class_with_pvalue(self):
+        m = tmrc.KendallRankCorrCoef(t_test=True)
+        for i in range(NUM_BATCHES):
+            m.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        tau, pval = m.compute()
+        p, t = np.concatenate(preds), np.concatenate(target)
+        ref_tau, ref_p = kendalltau(p, t)
+        assert abs(float(tau) - ref_tau) < 1e-5
+        assert abs(float(pval) - ref_p) < 2e-2
+
+    def test_pearson_multioutput(self):
+        p, t = np.concatenate(preds_2d), np.concatenate(target_2d)
+        res = tmr.pearson_corrcoef(jnp.asarray(p), jnp.asarray(t))
+        ref = [pearsonr(p[:, i], t[:, i])[0] for i in range(3)]
+        np.testing.assert_allclose(np.asarray(res), ref, atol=1e-4)
+
+    def test_pearson_parallel_merge_matches_single(self):
+        """The rank-stacked _final_aggregation must equal single-stream stats."""
+        m_single = tmrc.PearsonCorrCoef()
+        replicas = [tmrc.PearsonCorrCoef() for _ in range(4)]
+        for i in range(NUM_BATCHES):
+            m_single.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+            replicas[i % 4].update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        from tpumetrics.parallel.merge import merge_metric_states
+
+        merged = merge_metric_states([m.metric_state() for m in replicas], replicas[0]._reductions)
+        res = replicas[0].functional_compute(merged)
+        assert abs(float(res) - float(m_single.compute())) < 1e-4
+
+
+class TestOthers(MetricTester):
+    def test_cosine_similarity(self):
+        p, t = np.concatenate(preds_2d), np.concatenate(target_2d)
+        res = tmr.cosine_similarity(jnp.asarray(p), jnp.asarray(t), reduction="mean")
+        ref = np.mean(
+            (p * t).sum(1) / (np.linalg.norm(p, axis=1) * np.linalg.norm(t, axis=1))
+        )
+        assert abs(float(res) - ref) < 1e-5
+
+    @pytest.mark.parametrize("log_prob", [False, True])
+    def test_kl_divergence(self, log_prob):
+        from scipy.stats import entropy
+
+        d1 = np.abs(_rng.random((20, 5))) + 1e-3
+        d1 /= d1.sum(1, keepdims=True)
+        d2 = np.abs(_rng.random((20, 5))) + 1e-3
+        d2 /= d2.sum(1, keepdims=True)
+        ref = np.mean([entropy(d1[i], d2[i]) for i in range(20)])
+        if log_prob:
+            res = tmr.kl_divergence(jnp.asarray(np.log(d1)), jnp.asarray(np.log(d2)), log_prob=True)
+        else:
+            res = tmr.kl_divergence(jnp.asarray(d1), jnp.asarray(d2))
+        assert abs(float(res) - ref) < 1e-5
+
+    def test_kl_class(self):
+        d1 = np.abs(_rng.random((20, 5))) + 1e-3
+        d1 /= d1.sum(1, keepdims=True)
+        d2 = np.abs(_rng.random((20, 5))) + 1e-3
+        d2 /= d2.sum(1, keepdims=True)
+        m = tmrc.KLDivergence()
+        m.update(jnp.asarray(d1[:10]), jnp.asarray(d2[:10]))
+        m.update(jnp.asarray(d1[10:]), jnp.asarray(d2[10:]))
+        from scipy.stats import entropy
+
+        ref = np.mean([entropy(d1[i], d2[i]) for i in range(20)])
+        assert abs(float(m.compute()) - ref) < 1e-5
+
+    def test_jit_functional_bridge(self):
+        import jax
+
+        m = tmrc.MeanSquaredError()
+
+        @jax.jit
+        def step(state, p, t):
+            s = m.functional_update(state, p, t)
+            return s, m.functional_compute(s)
+
+        state = m.init_state()
+        for i in range(NUM_BATCHES):
+            state, out = step(state, jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        assert abs(float(out) - sk_mse(np.concatenate(target), np.concatenate(preds))) < 1e-5

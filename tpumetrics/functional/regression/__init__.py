@@ -1,0 +1,44 @@
+"""Functional regression metrics (counterpart of reference
+``functional/regression/__init__.py``)."""
+
+from tpumetrics.functional.regression.concordance import concordance_corrcoef
+from tpumetrics.functional.regression.cosine_similarity import cosine_similarity
+from tpumetrics.functional.regression.explained_variance import explained_variance
+from tpumetrics.functional.regression.kendall import kendall_rank_corrcoef
+from tpumetrics.functional.regression.kl_divergence import kl_divergence
+from tpumetrics.functional.regression.log_cosh import log_cosh_error
+from tpumetrics.functional.regression.log_mse import mean_squared_log_error
+from tpumetrics.functional.regression.mae import mean_absolute_error
+from tpumetrics.functional.regression.mape import (
+    mean_absolute_percentage_error,
+    symmetric_mean_absolute_percentage_error,
+    weighted_mean_absolute_percentage_error,
+)
+from tpumetrics.functional.regression.minkowski import minkowski_distance
+from tpumetrics.functional.regression.mse import mean_squared_error
+from tpumetrics.functional.regression.pearson import pearson_corrcoef
+from tpumetrics.functional.regression.r2 import r2_score
+from tpumetrics.functional.regression.rse import relative_squared_error
+from tpumetrics.functional.regression.spearman import spearman_corrcoef
+from tpumetrics.functional.regression.tweedie_deviance import tweedie_deviance_score
+
+__all__ = [
+    "concordance_corrcoef",
+    "cosine_similarity",
+    "explained_variance",
+    "kendall_rank_corrcoef",
+    "kl_divergence",
+    "log_cosh_error",
+    "mean_absolute_error",
+    "mean_absolute_percentage_error",
+    "mean_squared_error",
+    "mean_squared_log_error",
+    "minkowski_distance",
+    "pearson_corrcoef",
+    "r2_score",
+    "relative_squared_error",
+    "spearman_corrcoef",
+    "symmetric_mean_absolute_percentage_error",
+    "tweedie_deviance_score",
+    "weighted_mean_absolute_percentage_error",
+]

@@ -1,0 +1,77 @@
+"""Explained variance (counterpart of reference
+``functional/regression/explained_variance.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+ALLOWED_MULTIOUTPUT = ("raw_values", "uniform_average", "variance_weighted")
+
+
+def _explained_variance_update(preds: Array, target: Array) -> Tuple[int, Array, Array, Array, Array]:
+    """Sufficient statistics (reference explained_variance.py:25-43)."""
+    _check_same_shape(preds, target)
+    num_obs = preds.shape[0]
+    sum_error = jnp.sum(target - preds, axis=0)
+    diff = target - preds
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    sum_target = jnp.sum(target, axis=0)
+    sum_squared_target = jnp.sum(target * target, axis=0)
+    return num_obs, sum_error, sum_squared_error, sum_target, sum_squared_target
+
+
+def _explained_variance_compute(
+    num_obs: Union[int, Array],
+    sum_error: Array,
+    sum_squared_error: Array,
+    sum_target: Array,
+    sum_squared_target: Array,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """Reference explained_variance.py:46-103."""
+    diff_avg = sum_error / num_obs
+    numerator = sum_squared_error / num_obs - diff_avg * diff_avg
+    target_avg = sum_target / num_obs
+    denominator = sum_squared_target / num_obs - target_avg * target_avg
+
+    nonzero_numerator = numerator != 0
+    nonzero_denominator = denominator != 0
+
+    output_scores = jnp.where(
+        nonzero_numerator & nonzero_denominator,
+        1.0 - numerator / jnp.where(nonzero_denominator, denominator, 1.0),
+        jnp.where(nonzero_numerator, 0.0, 1.0),
+    )
+
+    if multioutput == "raw_values":
+        return output_scores
+    if multioutput == "uniform_average":
+        return jnp.mean(output_scores)
+    if multioutput == "variance_weighted":
+        denom_sum = jnp.sum(denominator)
+        return jnp.sum(denominator / denom_sum * output_scores)
+    raise ValueError(f"Argument `multioutput` must be one of {ALLOWED_MULTIOUTPUT}, but got {multioutput}")
+
+
+def explained_variance(preds: Array, target: Array, multioutput: str = "uniform_average") -> Array:
+    """Explained variance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.regression import explained_variance
+        >>> target = jnp.asarray([3., -0.5, 2, 7])
+        >>> preds = jnp.asarray([2.5, 0.0, 2, 8])
+        >>> round(float(explained_variance(preds, target)), 4)
+        0.9572
+    """
+    if multioutput not in ALLOWED_MULTIOUTPUT:
+        raise ValueError(f"Argument `multioutput` must be one of {ALLOWED_MULTIOUTPUT}, but got {multioutput}")
+    num_obs, sum_error, ss_error, sum_target, ss_target = _explained_variance_update(preds, target)
+    return _explained_variance_compute(num_obs, sum_error, ss_error, sum_target, ss_target, multioutput)

@@ -1,0 +1,52 @@
+"""LogCosh error (counterpart of reference
+``functional/regression/log_cosh.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.regression.utils import _check_data_shape_to_num_outputs
+from tpumetrics.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _unsqueeze_tensors(preds: Array, target: Array) -> Tuple[Array, Array]:
+    if preds.ndim == 2:
+        return preds, target
+    return preds[:, None], target[:, None]
+
+
+def _log_cosh_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, int]:
+    """Numerically-stable log(cosh(p - t)) sum (reference log_cosh.py:29-47)."""
+    _check_same_shape(preds, target)
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    preds, target = _unsqueeze_tensors(preds, target)
+    diff = preds - target
+    # log(cosh(x)) = x + softplus(-2x) - log(2), stable for large |x|
+    sum_log_cosh_error = jnp.sum(diff + jax.nn.softplus(-2.0 * diff) - jnp.log(2.0), axis=0).squeeze()
+    return sum_log_cosh_error, preds.shape[0]
+
+
+def _log_cosh_error_compute(sum_log_cosh_error: Array, num_obs: Union[int, Array]) -> Array:
+    return (sum_log_cosh_error / num_obs).squeeze()
+
+
+def log_cosh_error(preds: Array, target: Array) -> Array:
+    """LogCosh error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.regression import log_cosh_error
+        >>> preds = jnp.asarray([3.0, 5.0, 2.5, 7.0])
+        >>> target = jnp.asarray([2.5, 5.0, 4.0, 8.0])
+        >>> round(float(log_cosh_error(preds, target)), 4)
+        0.3523
+    """
+    sum_log_cosh_error, num_obs = _log_cosh_error_update(
+        preds, target, num_outputs=1 if preds.ndim == 1 else preds.shape[1]
+    )
+    return _log_cosh_error_compute(sum_log_cosh_error, num_obs)

@@ -1,0 +1,38 @@
+"""Mean squared log error (counterpart of reference
+``functional/regression/log_mse.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    sum_squared_log_error = jnp.sum(jnp.power(jnp.log1p(preds) - jnp.log1p(target), 2))
+    return sum_squared_log_error, target.size
+
+
+def _mean_squared_log_error_compute(sum_squared_log_error: Array, num_obs: Union[int, Array]) -> Array:
+    return sum_squared_log_error / num_obs
+
+
+def mean_squared_log_error(preds: Array, target: Array) -> Array:
+    """MSLE.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.regression import mean_squared_log_error
+        >>> x = jnp.asarray([0., 1, 2, 3])
+        >>> y = jnp.asarray([0., 1, 2, 2])
+        >>> round(float(mean_squared_log_error(x, y)), 4)
+        0.0207
+    """
+    sum_squared_log_error, num_obs = _mean_squared_log_error_update(preds, target)
+    return _mean_squared_log_error_compute(sum_squared_log_error, num_obs)

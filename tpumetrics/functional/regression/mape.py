@@ -1,0 +1,100 @@
+"""Mean absolute percentage error family: MAPE, SMAPE, WMAPE.
+
+Counterpart of reference ``functional/regression/{mape,symmetric_mape,
+wmape}.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+_EPSILON = 1.17e-06
+
+
+def _mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = _EPSILON
+) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    abs_diff = jnp.abs(preds - target)
+    abs_per_error = abs_diff / jnp.clip(jnp.abs(target), min=epsilon)
+    return jnp.sum(abs_per_error), target.size
+
+
+def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs: Union[int, Array]) -> Array:
+    return sum_abs_per_error / num_obs
+
+
+def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """MAPE.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.regression import mean_absolute_percentage_error
+        >>> target = jnp.asarray([1., 10, 1e6])
+        >>> preds = jnp.asarray([0.9, 15, 1.2e6])
+        >>> round(float(mean_absolute_percentage_error(preds, target)), 4)
+        0.2667
+    """
+    sum_abs_per_error, num_obs = _mean_absolute_percentage_error_update(preds, target)
+    return _mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)
+
+
+def _symmetric_mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = _EPSILON
+) -> Tuple[Array, int]:
+    """2|t-p| / max(|t|+|p|, eps) summed (reference symmetric_mape.py:22-46)."""
+    _check_same_shape(preds, target)
+    abs_diff = jnp.abs(preds - target)
+    arr = 2 * abs_diff / jnp.clip(jnp.abs(target) + jnp.abs(preds), min=epsilon)
+    return jnp.sum(arr), target.size
+
+
+def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """SMAPE.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.regression import symmetric_mean_absolute_percentage_error
+        >>> target = jnp.asarray([1., 10, 1e6])
+        >>> preds = jnp.asarray([0.9, 15, 1.2e6])
+        >>> round(float(symmetric_mean_absolute_percentage_error(preds, target)), 4)
+        0.229
+    """
+    sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
+    return sum_abs_per_error / num_obs
+
+
+def _weighted_mean_absolute_percentage_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Sum |t-p| and sum |t| (reference wmape.py:22-45)."""
+    _check_same_shape(preds, target)
+    sum_abs_error = jnp.sum(jnp.abs((preds - target).ravel()))
+    sum_scale = jnp.sum(jnp.abs(target.ravel()))
+    return sum_abs_error, sum_scale
+
+
+def _weighted_mean_absolute_percentage_error_compute(
+    sum_abs_error: Array, sum_scale: Array, epsilon: float = _EPSILON
+) -> Array:
+    return sum_abs_error / jnp.clip(sum_scale, min=epsilon)
+
+
+def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """WMAPE.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.regression import weighted_mean_absolute_percentage_error
+        >>> target = jnp.asarray([1., 10, 1e6])
+        >>> preds = jnp.asarray([0.9, 15, 1.2e6])
+        >>> round(float(weighted_mean_absolute_percentage_error(preds, target)), 4)
+        0.2
+    """
+    sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
+    return _weighted_mean_absolute_percentage_error_compute(sum_abs_error, sum_scale)

@@ -1,0 +1,39 @@
+"""Minkowski distance (counterpart of reference
+``functional/regression/minkowski.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.utils.checks import _check_same_shape
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+Array = jax.Array
+
+
+def _minkowski_distance_update(preds: Array, targets: Array, p: float) -> Array:
+    _check_same_shape(preds, targets)
+    if not (isinstance(p, (float, int)) and p >= 1):
+        raise TPUMetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
+    difference = jnp.abs(preds - targets)
+    return jnp.sum(jnp.power(difference, p))
+
+
+def _minkowski_distance_compute(distance: Array, p: float) -> Array:
+    return jnp.power(distance, 1.0 / p)
+
+
+def minkowski_distance(preds: Array, targets: Array, p: float) -> Array:
+    """Minkowski distance of order p.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.regression import minkowski_distance
+        >>> preds = jnp.asarray([0., 1, 2, 3])
+        >>> target = jnp.asarray([0., 2, 3, 1])
+        >>> round(float(minkowski_distance(preds, target, p=5)), 4)
+        2.0244
+    """
+    distance = _minkowski_distance_update(preds, targets, p)
+    return _minkowski_distance_compute(distance, p)

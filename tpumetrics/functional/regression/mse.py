@@ -1,0 +1,42 @@
+"""Mean squared error (counterpart of reference ``functional/regression/mse.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_squared_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    return sum_squared_error, target.shape[0]
+
+
+def _mean_squared_error_compute(sum_squared_error: Array, num_obs: Union[int, Array], squared: bool = True) -> Array:
+    mse = sum_squared_error / num_obs
+    return mse if squared else jnp.sqrt(mse)
+
+
+def mean_squared_error(preds: Array, target: Array, squared: bool = True, num_outputs: int = 1) -> Array:
+    """MSE (or RMSE with ``squared=False``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.regression import mean_squared_error
+        >>> x = jnp.asarray([0., 1, 2, 3])
+        >>> y = jnp.asarray([0., 1, 2, 2])
+        >>> round(float(mean_squared_error(x, y)), 4)
+        0.25
+    """
+    sum_squared_error, num_obs = _mean_squared_error_update(preds, target, num_outputs)
+    return _mean_squared_error_compute(sum_squared_error, num_obs, squared)

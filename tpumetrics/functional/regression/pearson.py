@@ -1,0 +1,134 @@
+"""Pearson correlation coefficient.
+
+Counterpart of reference ``functional/regression/pearson.py``
+(`_pearson_corrcoef_update` :25-77 keeping streaming mean/variance/
+covariance moments, `_pearson_corrcoef_compute` :80-114) and
+``regression/pearson.py`` `_final_aggregation` :28-70 — the parallel
+Chan-et-al. moment merge that combines per-device statistics, the template
+for any metric whose state is not a plain sum.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.functional.regression.utils import _check_data_shape_to_num_outputs
+from tpumetrics.utils.checks import _check_same_shape
+from tpumetrics.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _pearson_corrcoef_update(
+    preds: Array,
+    target: Array,
+    mean_x: Array,
+    mean_y: Array,
+    var_x: Array,
+    var_y: Array,
+    corr_xy: Array,
+    num_prior: Array,
+    num_outputs: int,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Streaming update of moments (reference pearson.py:25-77), branch-free
+    so it traces: the reference's first-batch special case folds into the
+    same formulas because the priors start at zero."""
+    _check_same_shape(preds, target)
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    num_obs = preds.shape[0]
+
+    mx_new = (num_prior * mean_x + preds.sum(axis=0)) / (num_prior + num_obs)
+    my_new = (num_prior * mean_y + target.sum(axis=0)) / (num_prior + num_obs)
+    num_prior = num_prior + num_obs
+    var_x = var_x + ((preds - mx_new) * (preds - mean_x)).sum(axis=0)
+    var_y = var_y + ((target - my_new) * (target - mean_y)).sum(axis=0)
+    corr_xy = corr_xy + ((preds - mx_new) * (target - mean_y)).sum(axis=0)
+    return mx_new, my_new, var_x, var_y, corr_xy, num_prior
+
+
+def _final_aggregation(
+    means_x: Array,
+    means_y: Array,
+    vars_x: Array,
+    vars_y: Array,
+    corrs_xy: Array,
+    nbs: Array,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Merge per-device moment statistics (reference regression/pearson.py:28-70,
+    'Aggregate the statistics from multiple devices')."""
+    if means_x.shape[0] == 1:
+        return means_x[0], means_y[0], vars_x[0], vars_y[0], corrs_xy[0], nbs[0]
+
+    def merge(carry, xs):
+        mx1, my1, vx1, vy1, cxy1, n1 = carry
+        mx2, my2, vx2, vy2, cxy2, n2 = xs
+        nb = n1 + n2
+        mean_x = (n1 * mx1 + n2 * mx2) / nb
+        mean_y = (n1 * my1 + n2 * my2) / nb
+
+        element_x1 = (n1 + 1) * mean_x - n1 * mx1
+        vx1 = vx1 + (element_x1 - mx1) * (element_x1 - mean_x) - (element_x1 - mean_x) ** 2
+        element_x2 = (n2 + 1) * mean_x - n2 * mx2
+        vx2 = vx2 + (element_x2 - mx2) * (element_x2 - mean_x) - (element_x2 - mean_x) ** 2
+        var_x = vx1 + vx2
+
+        element_y1 = (n1 + 1) * mean_y - n1 * my1
+        vy1 = vy1 + (element_y1 - my1) * (element_y1 - mean_y) - (element_y1 - mean_y) ** 2
+        element_y2 = (n2 + 1) * mean_y - n2 * my2
+        vy2 = vy2 + (element_y2 - my2) * (element_y2 - mean_y) - (element_y2 - mean_y) ** 2
+        var_y = vy1 + vy2
+
+        cxy1 = cxy1 + (element_x1 - mx1) * (element_y1 - mean_y) - (element_x1 - mean_x) * (element_y1 - mean_y)
+        cxy2 = cxy2 + (element_x2 - mx2) * (element_y2 - mean_y) - (element_x2 - mean_x) * (element_y2 - mean_y)
+        corr_xy = cxy1 + cxy2
+
+        return (mean_x, mean_y, var_x, var_y, corr_xy, nb), None
+
+    init = (means_x[0], means_y[0], vars_x[0], vars_y[0], corrs_xy[0], nbs[0])
+    rest = (means_x[1:], means_y[1:], vars_x[1:], vars_y[1:], corrs_xy[1:], nbs[1:])
+    (mean_x, mean_y, var_x, var_y, corr_xy, nb), _ = jax.lax.scan(merge, init, rest)
+    return mean_x, mean_y, var_x, var_y, corr_xy, nb
+
+
+def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Array) -> Array:
+    """Final correlation from accumulated moments (reference pearson.py:80-114)."""
+    var_x = var_x / (nb - 1)
+    var_y = var_y / (nb - 1)
+    corr_xy = corr_xy / (nb - 1)
+
+    if not isinstance(var_x, jax.core.Tracer):
+        bound = np.sqrt(np.finfo(np.dtype(var_x.dtype)).eps)
+        if bool(jnp.any(var_x < bound)) or bool(jnp.any(var_y < bound)):
+            rank_zero_warn(
+                "The variance of predictions or target is close to zero. This can cause instability in Pearson"
+                " correlation coefficient, leading to wrong results. Consider re-scaling the input if possible or"
+                f" computing using a larger dtype (currently using {var_x.dtype}).",
+                UserWarning,
+            )
+    corrcoef = (corr_xy / jnp.sqrt(var_x * var_y)).squeeze()
+    return jnp.clip(corrcoef, -1.0, 1.0)
+
+
+def pearson_corrcoef(preds: Array, target: Array) -> Array:
+    """Pearson correlation coefficient.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.regression import pearson_corrcoef
+        >>> target = jnp.asarray([3., -0.5, 2, 7])
+        >>> preds = jnp.asarray([2.5, 0.0, 2, 8])
+        >>> round(float(pearson_corrcoef(preds, target)), 4)
+        0.9849
+    """
+    d = preds.shape[1] if preds.ndim == 2 else 1
+    _temp = jnp.zeros(d, dtype=preds.dtype)
+    mean_x, mean_y, var_x = _temp, _temp.copy(), _temp.copy()
+    var_y, corr_xy, nb = _temp.copy(), _temp.copy(), _temp.copy()
+    _, _, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
+        preds, target, mean_x, mean_y, var_x, var_y, corr_xy, nb, num_outputs=d
+    )
+    return _pearson_corrcoef_compute(var_x, var_y, corr_xy, nb)

@@ -1,0 +1,45 @@
+"""Relative squared error (counterpart of reference
+``functional/regression/rse.py``)."""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.regression.r2 import _r2_score_update
+
+Array = jax.Array
+
+
+def _relative_squared_error_compute(
+    sum_squared_obs: Array,
+    sum_obs: Array,
+    sum_squared_error: Array,
+    num_obs: Union[int, Array],
+    squared: bool = True,
+) -> Array:
+    """Reference rse.py:22-51."""
+    epsilon = jnp.finfo(jnp.float32).eps
+    rse = sum_squared_error / jnp.clip(
+        sum_squared_obs - sum_obs * sum_obs / num_obs, min=epsilon
+    )
+    if not squared:
+        rse = jnp.sqrt(rse)
+    return jnp.mean(rse)
+
+
+def relative_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
+    """RSE = Σ(y-ŷ)² / Σ(y-ȳ)² (averaged over outputs for 2D inputs).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.regression import relative_squared_error
+        >>> target = jnp.asarray([3., -0.5, 2, 7])
+        >>> preds = jnp.asarray([2.5, 0.0, 2, 8])
+        >>> round(float(relative_squared_error(preds, target)), 4)
+        0.0514
+    """
+    sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
+    return _relative_squared_error_compute(sum_squared_obs, sum_obs, rss, num_obs, squared=squared)

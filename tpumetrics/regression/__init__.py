@@ -1,0 +1,42 @@
+"""Modular regression metrics (counterpart of reference
+``torchmetrics/regression/__init__.py``)."""
+
+from tpumetrics.regression.concordance import ConcordanceCorrCoef
+from tpumetrics.regression.cosine_similarity import CosineSimilarity
+from tpumetrics.regression.explained_variance import ExplainedVariance
+from tpumetrics.regression.kendall import KendallRankCorrCoef
+from tpumetrics.regression.kl_divergence import KLDivergence
+from tpumetrics.regression.log_cosh import LogCoshError
+from tpumetrics.regression.log_mse import MeanSquaredLogError
+from tpumetrics.regression.mae import MeanAbsoluteError
+from tpumetrics.regression.mape import MeanAbsolutePercentageError
+from tpumetrics.regression.minkowski import MinkowskiDistance
+from tpumetrics.regression.mse import MeanSquaredError
+from tpumetrics.regression.pearson import PearsonCorrCoef
+from tpumetrics.regression.r2 import R2Score
+from tpumetrics.regression.rse import RelativeSquaredError
+from tpumetrics.regression.spearman import SpearmanCorrCoef
+from tpumetrics.regression.symmetric_mape import SymmetricMeanAbsolutePercentageError
+from tpumetrics.regression.tweedie_deviance import TweedieDevianceScore
+from tpumetrics.regression.wmape import WeightedMeanAbsolutePercentageError
+
+__all__ = [
+    "ConcordanceCorrCoef",
+    "CosineSimilarity",
+    "ExplainedVariance",
+    "KLDivergence",
+    "KendallRankCorrCoef",
+    "LogCoshError",
+    "MeanAbsoluteError",
+    "MeanAbsolutePercentageError",
+    "MeanSquaredError",
+    "MeanSquaredLogError",
+    "MinkowskiDistance",
+    "PearsonCorrCoef",
+    "R2Score",
+    "RelativeSquaredError",
+    "SpearmanCorrCoef",
+    "SymmetricMeanAbsolutePercentageError",
+    "TweedieDevianceScore",
+    "WeightedMeanAbsolutePercentageError",
+]

@@ -1,0 +1,33 @@
+"""ConcordanceCorrCoef (counterpart of reference ``regression/concordance.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from tpumetrics.functional.regression.concordance import _concordance_corrcoef_compute
+from tpumetrics.regression.pearson import PearsonCorrCoef
+
+Array = jax.Array
+
+
+class ConcordanceCorrCoef(PearsonCorrCoef):
+    """Concordance correlation (reference regression/concordance.py:26 —
+    shares the Pearson moment states).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.regression import ConcordanceCorrCoef
+        >>> metric = ConcordanceCorrCoef()
+        >>> metric.update(jnp.asarray([2.5, 0.0, 2, 8]), jnp.asarray([3., -0.5, 2, 7]))
+        >>> round(float(metric.compute()), 4)
+        0.9777
+    """
+
+    def compute(self) -> Array:
+        mean_x, mean_y, var_x, var_y, corr_xy, n_total = self._aggregated()
+        return _concordance_corrcoef_compute(mean_x, mean_y, var_x, var_y, corr_xy, n_total).squeeze()
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)

@@ -1,0 +1,78 @@
+"""KendallRankCorrCoef (counterpart of reference ``regression/kendall.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+
+from tpumetrics.functional.regression.kendall import (
+    _ALLOWED_ALTERNATIVES,
+    _ALLOWED_VARIANTS,
+    kendall_rank_corrcoef,
+)
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class KendallRankCorrCoef(Metric):
+    """Kendall's tau (reference regression/kendall.py:30).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.regression import KendallRankCorrCoef
+        >>> metric = KendallRankCorrCoef()
+        >>> metric.update(jnp.asarray([2.5, 1.0, 4.0, 3.0]), jnp.asarray([3.0, 2.0, 1.0, 4.0]))
+        >>> round(float(metric.compute()), 4)
+        0.0
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = True
+    plot_lower_bound: float = -1.0
+    plot_upper_bound: float = 1.0
+
+    preds: List[Array]
+    target: List[Array]
+
+    def __init__(
+        self,
+        variant: str = "b",
+        t_test: bool = False,
+        alternative: Optional[str] = "two-sided",
+        num_outputs: int = 1,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if variant not in _ALLOWED_VARIANTS:
+            raise ValueError(f"Argument `variant` is expected to be one of {_ALLOWED_VARIANTS}, but got {variant!r}")
+        if not isinstance(t_test, bool):
+            raise ValueError(f"Argument `t_test` is expected to be of a type `bool`, but got {t_test}.")
+        if t_test and alternative is None:
+            raise ValueError("Argument `alternative` is required if `t_test=True` but got `None`.")
+        if alternative not in _ALLOWED_ALTERNATIVES:
+            raise ValueError(
+                f"Argument `alternative` is expected to be one of {_ALLOWED_ALTERNATIVES},"
+                f" but got {alternative!r}"
+            )
+        self.variant = variant
+        self.t_test = t_test
+        self.alternative = alternative
+        self.num_outputs = num_outputs
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self):
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return kendall_rank_corrcoef(preds, target, self.variant, self.t_test, self.alternative)
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)

@@ -1,0 +1,50 @@
+"""MeanAbsoluteError (counterpart of reference ``regression/mae.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.regression.mae import _mean_absolute_error_compute, _mean_absolute_error_update
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class MeanAbsoluteError(Metric):
+    """MAE (reference regression/mae.py:26).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.regression import MeanAbsoluteError
+        >>> metric = MeanAbsoluteError()
+        >>> metric.update(jnp.asarray([0., 1, 2, 3]), jnp.asarray([0., 1, 2, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.5
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    sum_abs_error: Array
+    total: Array
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_error", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        sum_abs_error, num_obs = _mean_absolute_error_update(preds, target)
+        self.sum_abs_error = self.sum_abs_error + sum_abs_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        return _mean_absolute_error_compute(self.sum_abs_error, self.total)
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)

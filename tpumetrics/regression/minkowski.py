@@ -1,0 +1,53 @@
+"""MinkowskiDistance (counterpart of reference ``regression/minkowski.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.regression.minkowski import (
+    _minkowski_distance_compute,
+    _minkowski_distance_update,
+)
+from tpumetrics.metric import Metric
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+Array = jax.Array
+
+
+class MinkowskiDistance(Metric):
+    """Minkowski distance of order p (reference regression/minkowski.py:25).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.regression import MinkowskiDistance
+        >>> metric = MinkowskiDistance(p=3)
+        >>> metric.update(jnp.asarray([0., 1, 2, 3]), jnp.asarray([0., 2, 3, 1]))
+        >>> round(float(metric.compute()), 4)
+        2.1544
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    minkowski_dist_sum: Array
+
+    def __init__(self, p: float, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(p, (float, int)) and p >= 1):
+            raise TPUMetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
+        self.p = p
+        self.add_state("minkowski_dist_sum", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, targets: Array) -> None:
+        self.minkowski_dist_sum = self.minkowski_dist_sum + _minkowski_distance_update(preds, targets, self.p)
+
+    def compute(self) -> Array:
+        return _minkowski_distance_compute(self.minkowski_dist_sum, self.p)
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)

@@ -1,0 +1,75 @@
+"""PearsonCorrCoef (counterpart of reference ``regression/pearson.py``).
+
+The state is per-device streaming moments with ``dist_reduce_fx=None``
+(rank-stack), merged at compute with the Chan parallel-moment aggregation —
+the template for metrics whose state is not a plain sum (reference
+regression/pearson.py:28-70,137-142).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.regression.pearson import (
+    _final_aggregation,
+    _pearson_corrcoef_compute,
+    _pearson_corrcoef_update,
+)
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class PearsonCorrCoef(Metric):
+    """Pearson correlation (reference regression/pearson.py:73).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.regression import PearsonCorrCoef
+        >>> metric = PearsonCorrCoef()
+        >>> metric.update(jnp.asarray([2.5, 0.0, 2, 8]), jnp.asarray([3., -0.5, 2, 7]))
+        >>> round(float(metric.compute()), 4)
+        0.9849
+    """
+
+    is_differentiable = True
+    higher_is_better = None
+    full_state_update = True
+    plot_lower_bound: float = -1.0
+    plot_upper_bound: float = 1.0
+
+    mean_x: Array
+    mean_y: Array
+    var_x: Array
+    var_y: Array
+    corr_xy: Array
+    n_total: Array
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        self.num_outputs = num_outputs
+        for name in ("mean_x", "mean_y", "var_x", "var_y", "corr_xy", "n_total"):
+            self.add_state(name, jnp.zeros(self.num_outputs), dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total = _pearson_corrcoef_update(
+            preds, target, self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total,
+            self.num_outputs,
+        )
+
+    def _aggregated(self) -> tuple:
+        if self.mean_x.ndim > 1:  # rank-stacked states from sync
+            return _final_aggregation(self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total)
+        return self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+
+    def compute(self) -> Array:
+        _, _, var_x, var_y, corr_xy, n_total = self._aggregated()
+        return _pearson_corrcoef_compute(var_x, var_y, corr_xy, n_total)
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)

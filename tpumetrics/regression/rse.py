@@ -1,0 +1,60 @@
+"""RelativeSquaredError (counterpart of reference ``regression/rse.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.regression.r2 import _r2_score_update
+from tpumetrics.functional.regression.rse import _relative_squared_error_compute
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class RelativeSquaredError(Metric):
+    """RSE (reference regression/rse.py:25).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.regression import RelativeSquaredError
+        >>> metric = RelativeSquaredError()
+        >>> metric.update(jnp.asarray([2.5, 0.0, 2, 8]), jnp.asarray([3., -0.5, 2, 7]))
+        >>> round(float(metric.compute()), 4)
+        0.0514
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    sum_squared_obs: Array
+    sum_obs: Array
+    sum_squared_error: Array
+    total: Array
+
+    def __init__(self, num_outputs: int = 1, squared: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        self.squared = squared
+        self.add_state("sum_squared_obs", jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("sum_obs", jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
+        self.sum_squared_obs = self.sum_squared_obs + sum_squared_obs
+        self.sum_obs = self.sum_obs + sum_obs
+        self.sum_squared_error = self.sum_squared_error + rss
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        return _relative_squared_error_compute(
+            self.sum_squared_obs, self.sum_obs, self.sum_squared_error, self.total, self.squared
+        )
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)

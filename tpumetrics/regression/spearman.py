@@ -1,0 +1,59 @@
+"""SpearmanCorrCoef (counterpart of reference ``regression/spearman.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+
+from tpumetrics.functional.regression.spearman import (
+    _spearman_corrcoef_compute,
+    _spearman_corrcoef_update,
+)
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class SpearmanCorrCoef(Metric):
+    """Spearman rank correlation (reference regression/spearman.py:25).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.regression import SpearmanCorrCoef
+        >>> metric = SpearmanCorrCoef()
+        >>> metric.update(jnp.asarray([2.5, 0.0, 2, 8]), jnp.asarray([3., -0.5, 2, 7]))
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = -1.0
+    plot_upper_bound: float = 1.0
+
+    preds: List[Array]
+    target: List[Array]
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _spearman_corrcoef_update(preds, target, self.num_outputs)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _spearman_corrcoef_compute(preds, target)
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)

@@ -1,0 +1,57 @@
+"""TweedieDevianceScore (counterpart of reference
+``regression/tweedie_deviance.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.regression.tweedie_deviance import (
+    _tweedie_deviance_score_compute,
+    _tweedie_deviance_score_update,
+)
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class TweedieDevianceScore(Metric):
+    """Tweedie deviance (reference regression/tweedie_deviance.py:26).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.regression import TweedieDevianceScore
+        >>> metric = TweedieDevianceScore(power=2)
+        >>> metric.update(jnp.asarray([4.0, 3.0, 2.0, 1.0]), jnp.asarray([1.0, 2.0, 3.0, 4.0]))
+        >>> round(float(metric.compute()), 4)
+        1.2083
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    sum_deviance_score: Array
+    num_observations: Array
+
+    def __init__(self, power: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if 0 < power < 1:
+            raise ValueError(f"Deviance Score is not defined for power={power}.")
+        self.power = power
+        self.add_state("sum_deviance_score", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("num_observations", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, targets: Array) -> None:
+        sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, self.power)
+        self.sum_deviance_score = self.sum_deviance_score + sum_deviance_score
+        self.num_observations = self.num_observations + num_observations
+
+    def compute(self) -> Array:
+        return _tweedie_deviance_score_compute(self.sum_deviance_score, self.num_observations)
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)

@@ -1,0 +1,54 @@
+"""WeightedMeanAbsolutePercentageError (counterpart of reference
+``regression/wmape.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.regression.mape import (
+    _weighted_mean_absolute_percentage_error_compute,
+    _weighted_mean_absolute_percentage_error_update,
+)
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class WeightedMeanAbsolutePercentageError(Metric):
+    """WMAPE (reference regression/wmape.py:26).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.regression import WeightedMeanAbsolutePercentageError
+        >>> metric = WeightedMeanAbsolutePercentageError()
+        >>> metric.update(jnp.asarray([0.9, 15., 1.2e6]), jnp.asarray([1., 10, 1e6]))
+        >>> round(float(metric.compute()), 4)
+        0.2
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    sum_abs_error: Array
+    sum_scale: Array
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_error", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_scale", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
+        self.sum_abs_error = self.sum_abs_error + sum_abs_error
+        self.sum_scale = self.sum_scale + sum_scale
+
+    def compute(self) -> Array:
+        return _weighted_mean_absolute_percentage_error_compute(self.sum_abs_error, self.sum_scale)
+
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        return self._plot(val, ax)
