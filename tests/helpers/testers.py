@@ -66,6 +66,25 @@ def _assert_allclose(res: Any, ref: Any, atol: float = 1e-8, key: Optional[str] 
     assert np.allclose(res, ref, atol=atol, equal_nan=True), f"mismatch: {res} vs {ref}"
 
 
+def _is_per_batch_kwarg(v: Any) -> bool:
+    """Per-batch update kwargs are passed as a list/tuple with one entry per
+    batch (same convention as ``preds``/``target``); anything else is constant."""
+    return isinstance(v, (list, tuple))
+
+
+def _batch_kwargs(kwargs_update: Dict[str, Any], i: int) -> Dict[str, Any]:
+    """Slice per-batch kwargs to batch ``i``."""
+    return {k: (v[i] if _is_per_batch_kwarg(v) else v) for k, v in kwargs_update.items()}
+
+
+def _total_kwargs(kwargs_update: Dict[str, Any], order: Sequence[int]) -> Dict[str, Any]:
+    """Concatenate per-batch kwargs over batches in ``order`` for the reference."""
+    return {
+        k: np.concatenate([np.asarray(v[i]) for i in order]) if _is_per_batch_kwarg(v) else v
+        for k, v in kwargs_update.items()
+    }
+
+
 def _functional_test(
     preds: Any,
     target: Any,
@@ -79,8 +98,11 @@ def _functional_test(
     metric_args = metric_args or {}
     metric = partial(metric_functional, **metric_args)
     for i in range(NUM_BATCHES):
-        result = metric(preds[i], target[i], **kwargs_update)
-        ref_result = reference_metric(np.asarray(preds[i]), np.asarray(target[i]), **kwargs_update)
+        kw = _batch_kwargs(kwargs_update, i)
+        result = metric(preds[i], target[i], **kw)
+        ref_result = reference_metric(
+            np.asarray(preds[i]), np.asarray(target[i]), **{k: np.asarray(v) for k, v in kw.items()}
+        )
         _assert_allclose(result, ref_result, atol=atol)
 
 
@@ -114,9 +136,12 @@ def _class_test(
     metric = metric.clone()
 
     for i in range(NUM_BATCHES):
-        batch_result = metric(preds[i], target[i], **kwargs_update)
+        kw = _batch_kwargs(kwargs_update, i)
+        batch_result = metric(preds[i], target[i], **kw)
         if check_batch:
-            batch_ref = reference_metric(np.asarray(preds[i]), np.asarray(target[i]), **kwargs_update)
+            batch_ref = reference_metric(
+                np.asarray(preds[i]), np.asarray(target[i]), **{k: np.asarray(v) for k, v in kw.items()}
+            )
             _assert_allclose(batch_result, batch_ref, atol=atol)
 
     # hashability (reference testers.py:192)
@@ -129,19 +154,13 @@ def _class_test(
     result = metric.compute()
     total_preds = np.concatenate([np.asarray(p) for p in preds])
     total_target = np.concatenate([np.asarray(t) for t in target])
-    total_kwargs = {
-        k: np.concatenate([np.asarray(vi) for vi in v]) if isinstance(v, (list, tuple)) or (
-            hasattr(v, "ndim") and v.ndim > 1
-        ) else v
-        for k, v in kwargs_update.items()
-    }
-    ref_result = reference_metric(total_preds, total_target, **total_kwargs)
+    ref_result = reference_metric(total_preds, total_target, **_total_kwargs(kwargs_update, range(NUM_BATCHES)))
     _assert_allclose(result, ref_result, atol=atol)
 
     # reset + update path agrees with forward path
     metric.reset()
     for i in range(NUM_BATCHES):
-        metric.update(preds[i], target[i], **kwargs_update)
+        metric.update(preds[i], target[i], **_batch_kwargs(kwargs_update, i))
     result2 = metric.compute()
     _assert_allclose(result2, ref_result, atol=atol)
 
@@ -162,20 +181,18 @@ def _class_test_emulated_ddp(
     replicas = [metric_class(**metric_args) for _ in range(world_size)]
     for rank, metric in enumerate(replicas):
         for i in range(rank, NUM_BATCHES, world_size):
-            metric.update(preds[i], target[i], **kwargs_update)
+            metric.update(preds[i], target[i], **_batch_kwargs(kwargs_update, i))
 
     merged = merge_metric_states(
         [m.metric_state() for m in replicas], replicas[0]._reductions
     )
     result = replicas[0].functional_compute(merged)
 
-    total_preds = np.concatenate(
-        [np.asarray(preds[i]) for r in range(world_size) for i in range(r, NUM_BATCHES, world_size)]
-    )
-    total_target = np.concatenate(
-        [np.asarray(target[i]) for r in range(world_size) for i in range(r, NUM_BATCHES, world_size)]
-    )
-    ref_result = reference_metric(total_preds, total_target)
+    rank_order = [i for r in range(world_size) for i in range(r, NUM_BATCHES, world_size)]
+    total_preds = np.concatenate([np.asarray(preds[i]) for i in rank_order])
+    total_target = np.concatenate([np.asarray(target[i]) for i in rank_order])
+    # per-batch update kwargs must reach the reference in the same rank order
+    ref_result = reference_metric(total_preds, total_target, **_total_kwargs(kwargs_update, rank_order))
     _assert_allclose(result, ref_result, atol=atol)
 
 
@@ -308,8 +325,22 @@ class MetricTester:
                 out = sum(jnp.sum(o) for o in out)
             return jnp.sum(out)
 
-        grad = jax.grad(loss)(preds[0].astype(jnp.float32))
+        p0 = preds[0].astype(jnp.float32)
+        grad = jax.grad(loss)(p0)
         assert jnp.all(jnp.isfinite(grad)), "gradient through metric is not finite"
+
+        # numerical check (reference gradcheck analogue, testers.py:552): compare
+        # a directional derivative against central differences on a random
+        # direction — cheap and catches wrong (not just non-finite) gradients
+        rng = np.random.default_rng(42)
+        direction = jnp.asarray(rng.standard_normal(p0.shape), dtype=jnp.float32)
+        direction = direction / (jnp.linalg.norm(direction) + 1e-12)
+        eps = 1e-3
+        numerical = (loss(p0 + eps * direction) - loss(p0 - eps * direction)) / (2 * eps)
+        analytical = jnp.sum(grad * direction)
+        assert np.isclose(
+            float(numerical), float(analytical), rtol=5e-2, atol=5e-3
+        ), f"directional derivative mismatch: numerical={float(numerical)} vs grad={float(analytical)}"
 
     def run_precision_test(
         self,
@@ -321,11 +352,24 @@ class MetricTester:
         dtype: Any = jnp.bfloat16,
     ) -> None:
         """Half-precision robustness (reference run_precision_test_cpu/gpu :454-520);
-        bf16 rather than fp16, as native on TPU."""
+        bf16 rather than fp16, as native on TPU. The half-precision result is
+        compared against the full-precision result with a loose tolerance
+        (reference compares against the reference implementation)."""
         metric_args = metric_args or {}
         metric = metric_module(**metric_args)
         metric.set_dtype(dtype)
-        p = preds[0].astype(dtype) if jnp.issubdtype(preds[0].dtype, jnp.floating) else preds[0]
+        is_float = jnp.issubdtype(preds[0].dtype, jnp.floating)
+        p = preds[0].astype(dtype) if is_float else preds[0]
         metric.update(p, target[0])
         out = metric.compute()
         assert out is not None
+
+        ref = metric_module(**metric_args)
+        ref.update(preds[0], target[0])
+        ref_out = ref.compute()
+        for o, r in zip(jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(ref_out)):
+            o = np.asarray(jax.device_get(o), dtype=np.float64)
+            r = np.asarray(jax.device_get(r), dtype=np.float64)
+            assert np.allclose(o, r, rtol=5e-2, atol=1e-2, equal_nan=True), (
+                f"half-precision result diverges from fp32: {o} vs {r}"
+            )

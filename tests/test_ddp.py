@@ -119,3 +119,89 @@ def test_axis_backend_world_size_and_allreduce():
     xs = jnp.arange(ws, dtype=jnp.float32).reshape(ws, 1)
     out = jax.jit(shard_map(run, mesh=_mesh(ws), in_specs=P("r"), out_specs=P()))(xs)
     assert float(out) == ws - 1
+
+
+@pytest.mark.parametrize("world_size", [2, 8])
+def test_dist_sync_on_step_forward_over_mesh(world_size):
+    """`functional_forward` with in-trace sync == reference on the union of the
+    step's shards, while the local state keeps accumulating (the
+    dist_sync_on_step=True BASELINE config)."""
+    from sklearn.metrics import accuracy_score
+
+    from tpumetrics.classification import MulticlassAccuracy
+
+    num_classes = 4
+    n_steps = 3
+    per_dev = 8
+    metric = MulticlassAccuracy(num_classes=num_classes, average="micro", validate_args=False)
+    mesh = _mesh(world_size)
+
+    rng = np.random.default_rng(7)
+    preds = jnp.asarray(rng.standard_normal((n_steps, world_size * per_dev, num_classes)), dtype=jnp.float32)
+    target = jnp.asarray(rng.integers(0, num_classes, (n_steps, world_size * per_dev)), dtype=jnp.int32)
+
+    # carried state is per-device (each device accumulates its own shard), so
+    # it must stay sharded over the axis: leading device dim + P("r") specs
+    def step(state, p, t):
+        local = jax.tree_util.tree_map(lambda x: x[0], state)
+        new_state, val = metric.functional_forward(local, p, t, axis_name="r")
+        return jax.tree_util.tree_map(lambda x: x[None], new_state), val
+
+    fn = jax.jit(shard_map(step, mesh=mesh, in_specs=(P("r"), P("r"), P("r")), out_specs=(P("r"), P())))
+
+    state = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x, (world_size,) + x.shape), metric.init_state()
+    )
+    for i in range(n_steps):
+        state, batch_val = fn(state, preds[i], target[i])
+        ref = accuracy_score(np.asarray(target[i]), np.argmax(np.asarray(preds[i]), axis=1))
+        assert np.allclose(np.asarray(batch_val), ref, atol=1e-6)
+
+    final = jax.jit(
+        shard_map(
+            lambda s: metric.functional_compute(jax.tree_util.tree_map(lambda x: x[0], s), axis_name="r"),
+            mesh=mesh,
+            in_specs=(P("r"),),
+            out_specs=P(),
+        )
+    )(state)
+    all_t = np.asarray(target).reshape(-1)
+    all_p = np.argmax(np.asarray(preds).reshape(-1, num_classes), axis=1)
+    assert np.allclose(np.asarray(final), accuracy_score(all_t, all_p), atol=1e-6)
+
+
+def test_collection_dist_sync_on_step_forward_over_mesh():
+    """MetricCollection functional_forward over the mesh: per-step synced values
+    for every member of the collection (BASELINE config row 2)."""
+    from sklearn.metrics import accuracy_score, f1_score
+
+    from tpumetrics.classification import MulticlassAccuracy, MulticlassF1Score
+    from tpumetrics.collections import MetricCollection
+
+    num_classes, world_size, per_dev = 4, 8, 8
+    col = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=num_classes, average="micro", validate_args=False),
+            "f1": MulticlassF1Score(num_classes=num_classes, average="macro", validate_args=False),
+        }
+    )
+    mesh = _mesh(world_size)
+    rng = np.random.default_rng(3)
+    preds = jnp.asarray(rng.standard_normal((world_size * per_dev, num_classes)), dtype=jnp.float32)
+    target = jnp.asarray(rng.integers(0, num_classes, (world_size * per_dev,)), dtype=jnp.int32)
+
+    def step(state, p, t):
+        local = jax.tree_util.tree_map(lambda x: x[0], state)
+        new_state, vals = col.functional_forward(local, p, t, axis_name="r")
+        return jax.tree_util.tree_map(lambda x: x[None], new_state), vals
+
+    fn = jax.jit(shard_map(step, mesh=mesh, in_specs=(P("r"), P("r"), P("r")), out_specs=(P("r"), P())))
+    state = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x, (world_size,) + x.shape), col.init_state()
+    )
+    state, vals = fn(state, preds, target)
+
+    t = np.asarray(target)
+    p = np.argmax(np.asarray(preds), axis=1)
+    assert np.allclose(np.asarray(vals["acc"]), accuracy_score(t, p), atol=1e-6)
+    assert np.allclose(np.asarray(vals["f1"]), f1_score(t, p, average="macro"), atol=1e-6)

@@ -28,6 +28,7 @@ from tpumetrics.functional.classification.stat_scores import (
 from tpumetrics.metric import Metric
 from tpumetrics.utils.enums import ClassificationTask
 from tpumetrics.utils.plot import plot_confusion_matrix
+from tpumetrics.utils.data import _count_dtype
 
 Array = jax.Array
 
@@ -65,7 +66,7 @@ class BinaryConfusionMatrix(Metric):
         self.normalize = normalize
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        self.add_state("confmat", jnp.zeros((2, 2), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("confmat", jnp.zeros((2, 2), dtype=_count_dtype()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:
@@ -114,7 +115,7 @@ class MulticlassConfusionMatrix(Metric):
         self.normalize = normalize
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        self.add_state("confmat", jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("confmat", jnp.zeros((num_classes, num_classes), dtype=_count_dtype()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:
@@ -165,7 +166,7 @@ class MultilabelConfusionMatrix(Metric):
         self.normalize = normalize
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        self.add_state("confmat", jnp.zeros((num_labels, 2, 2), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("confmat", jnp.zeros((num_labels, 2, 2), dtype=_count_dtype()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:

@@ -17,6 +17,7 @@ from tpumetrics.functional.classification.group_fairness import (
     _groups_stat_transform,
 )
 from tpumetrics.metric import Metric
+from tpumetrics.utils.data import _count_dtype
 
 Array = jax.Array
 
@@ -31,7 +32,7 @@ class _AbstractGroupStatScores(Metric):
     fn: Array
 
     def _create_states(self, num_groups: int) -> None:
-        default = lambda: jnp.zeros(num_groups, dtype=jnp.int32)  # noqa: E731
+        default = lambda: jnp.zeros(num_groups, dtype=_count_dtype())  # noqa: E731
         for name in ("tp", "fp", "tn", "fn"):
             self.add_state(name, default(), dist_reduce_fx="sum")
 

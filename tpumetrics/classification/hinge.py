@@ -23,6 +23,7 @@ from tpumetrics.functional.classification.precision_recall_curve import (
 from tpumetrics.metric import Metric
 from tpumetrics.utils.compute import normalize_logits_if_needed
 from tpumetrics.utils.enums import ClassificationTaskNoMultilabel
+from tpumetrics.utils.data import _count_dtype
 
 Array = jax.Array
 
@@ -61,7 +62,7 @@ class BinaryHingeLoss(Metric):
         self.ignore_index = ignore_index
         self.validate_args = validate_args
         self.add_state("measures", jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=_count_dtype()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:
@@ -128,7 +129,7 @@ class MulticlassHingeLoss(Metric):
             jnp.zeros(()) if multiclass_mode == "crammer-singer" else jnp.zeros(num_classes),
             dist_reduce_fx="sum",
         )
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=_count_dtype()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:

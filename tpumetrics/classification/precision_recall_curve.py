@@ -37,7 +37,7 @@ from tpumetrics.functional.classification.precision_recall_curve import (
     _multilabel_precision_recall_curve_update,
 )
 from tpumetrics.metric import Metric
-from tpumetrics.utils.data import dim_zero_cat
+from tpumetrics.utils.data import _count_dtype, dim_zero_cat
 from tpumetrics.utils.enums import ClassificationTask
 from tpumetrics.utils.plot import plot_curve
 
@@ -87,7 +87,7 @@ class BinaryPrecisionRecallCurve(Metric):
         else:
             self.thresholds = thresholds
             self.add_state(
-                "confmat", default=jnp.zeros((len(thresholds), 2, 2), dtype=jnp.int32), dist_reduce_fx="sum"
+                "confmat", default=jnp.zeros((len(thresholds), 2, 2), dtype=_count_dtype()), dist_reduce_fx="sum"
             )
 
     def update(self, preds: Array, target: Array) -> None:
@@ -165,7 +165,7 @@ class MulticlassPrecisionRecallCurve(Metric):
             self.add_state("target", default=[], dist_reduce_fx="cat")
         else:
             shape = (len(thresholds), 2, 2) if average == "micro" else (len(thresholds), num_classes, 2, 2)
-            self.add_state("confmat", default=jnp.zeros(shape, dtype=jnp.int32), dist_reduce_fx="sum")
+            self.add_state("confmat", default=jnp.zeros(shape, dtype=_count_dtype()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:
@@ -245,7 +245,7 @@ class MultilabelPrecisionRecallCurve(Metric):
         else:
             self.add_state(
                 "confmat",
-                default=jnp.zeros((len(thresholds), num_labels, 2, 2), dtype=jnp.int32),
+                default=jnp.zeros((len(thresholds), num_labels, 2, 2), dtype=_count_dtype()),
                 dist_reduce_fx="sum",
             )
 

@@ -19,6 +19,7 @@ from tpumetrics.functional.classification.ranking import (
     _ranking_reduce,
 )
 from tpumetrics.metric import Metric
+from tpumetrics.utils.data import _count_dtype
 
 Array = jax.Array
 
@@ -55,7 +56,7 @@ class _MultilabelRankingMetric(Metric):
         self.ignore_index = ignore_index
         self.validate_args = validate_args
         self.add_state("score", jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=_count_dtype()), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:

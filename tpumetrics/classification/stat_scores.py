@@ -33,7 +33,7 @@ from tpumetrics.functional.classification.stat_scores import (
     _multilabel_stat_scores_update,
 )
 from tpumetrics.metric import Metric
-from tpumetrics.utils.data import dim_zero_cat
+from tpumetrics.utils.data import _count_dtype, dim_zero_cat
 from tpumetrics.utils.enums import ClassificationTask
 
 Array = jax.Array
@@ -54,7 +54,7 @@ class _AbstractStatScores(Metric):
             default = lambda: []  # noqa: E731
             dist_reduce_fx = "cat"
         else:
-            default = lambda: jnp.zeros(size, dtype=jnp.int32)  # noqa: E731
+            default = lambda: jnp.zeros(size, dtype=_count_dtype())  # noqa: E731
             dist_reduce_fx = "sum"
         for name in ("tp", "fp", "tn", "fn"):
             self.add_state(name, default(), dist_reduce_fx=dist_reduce_fx)

@@ -190,12 +190,17 @@ class MetricCollection:
             result[k] = res
         if method_name == "forward":
             self._state_is_copy = False  # every metric advanced its own state
+        return self._flatten_results(result)
 
+    def _flatten_results(self, result: Dict[str, Any]) -> Dict[str, Any]:
+        """Flatten dict-valued metric results, disambiguating colliding inner
+        keys with the metric name, and apply prefix/postfix (shared by
+        `_compute_and_reduce` and `functional_compute`)."""
         _, duplicates = _flatten_dict(result)
 
         flattened_results: Dict[str, Any] = {}
-        for k, m in self._modules.items():
-            res = result[k]
+        for k, res in result.items():
+            m = self._modules[k]
             if isinstance(res, dict):
                 for key, v in res.items():
                     if duplicates:
@@ -428,6 +433,17 @@ class MetricCollection:
             out[cg[0]] = m0.functional_update(state[cg[0]], *args, **m0._filter_kwargs(**kwargs))
         return out
 
+    def functional_forward(
+        self, state: Dict[str, Dict[str, Any]], *args: Any, axis_name: Optional[Any] = None, **kwargs: Any
+    ) -> Tuple[Dict[str, Dict[str, Any]], Dict[str, Any]]:
+        """Pure collection ``forward``: accumulate into ``state`` and return
+        this batch's values, optionally synced in-trace over ``axis_name``
+        (the ``dist_sync_on_step=True`` BASELINE config as one jitted step)."""
+        new_state = self.functional_update(state, *args, **kwargs)
+        batch_state = self.functional_update(self.init_state(), *args, **kwargs)
+        batch_vals = self.functional_compute(batch_state, axis_name=axis_name)
+        return new_state, batch_vals
+
     def functional_compute(
         self, state: Dict[str, Dict[str, Any]], axis_name: Optional[Any] = None
     ) -> Dict[str, Any]:
@@ -440,8 +456,7 @@ class MetricCollection:
             for name in cg:
                 m = self._modules[name]
                 results[name] = m.functional_compute(synced)
-        flattened, _ = _flatten_dict({k: v for k, v in results.items()})
-        return {self._set_name(k): v for k, v in flattened.items()}
+        return self._flatten_results(results)
 
 
 def _axis_backend(axis_name: Any) -> Any:

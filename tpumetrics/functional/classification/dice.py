@@ -41,7 +41,9 @@ def _dice_format(
         return preds_oh, target_oh, n_cls
     if jnp.issubdtype(preds.dtype, jnp.floating):  # binary probabilities
         preds = normalize_logits_if_needed(preds.ravel(), "sigmoid")
-        preds_lab = (preds > threshold).astype(jnp.int32)
+        # legacy-dice binary formatting thresholds inclusively (reference
+        # `_input_format_classification` semantics: preds >= threshold)
+        preds_lab = (preds >= threshold).astype(jnp.int32)
         target_lab = target.ravel().astype(jnp.int32)
         n_cls = num_classes if num_classes is not None else 2
         return (

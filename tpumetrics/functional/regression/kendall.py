@@ -1,9 +1,13 @@
 """Kendall rank correlation (tau-a/b/c, optional significance test).
 
-Counterpart of reference ``functional/regression/kendall.py``. The
-reference counts concordant/discordant pairs with sorting-based helpers;
-here it is one batched O(n²) pairwise sign contraction — XLA-fused,
-MXU-friendly, no host loop.
+Counterpart of reference ``functional/regression/kendall.py``. The reference
+counts concordant/discordant pairs with sorting-based helpers; here it is a
+**chunked** batched pairwise sign contraction — XLA-fused and MXU-friendly
+with peak memory O(chunk * n) instead of O(n²), so large eval sets do not
+OOM (the concern spearman.py's docstring raises about naive pairwise forms).
+Tie statistics (for tau-b/c denominators and the tie-corrected significance
+test, reference ``_calculate_p_value``) come from an O(n log n) sort-based
+run-length pass rather than n×n equality masks.
 """
 
 from __future__ import annotations
@@ -21,46 +25,100 @@ Array = jax.Array
 _ALLOWED_VARIANTS = ("a", "b", "c")
 _ALLOWED_ALTERNATIVES = ("two-sided", "less", "greater", None)
 
+_PAIR_CHUNK = 512  # rows per pairwise-contraction block: peak memory O(chunk*n)
 
-def _kendall_tau_1d(preds: Array, target: Array, variant: str) -> Tuple[Array, Array]:
-    """(tau, concordance statistic) for one output column."""
+
+def _tie_stats(x: Array) -> Tuple[Array, Array, Array, Array]:
+    """Sort-based tie-group statistics for one variable.
+
+    Returns ``(tie_pairs, p1, p2, n_distinct)`` where, with ``t`` the size of
+    each tie group (reference kendall.py `_get_ties`):
+      - ``tie_pairs`` = Σ t(t-1)/2   (number of tied pairs)
+      - ``p1``        = Σ t(t-1)(t-2)
+      - ``p2``        = Σ t(t-1)(2t+5)
+      - ``n_distinct`` = number of distinct values
+    """
+    n = x.shape[0]
+    xs = jnp.sort(x)
+    new_group = jnp.concatenate([jnp.ones((1,), dtype=bool), xs[1:] != xs[:-1]])
+    gid = jnp.cumsum(new_group) - 1
+    t = jnp.zeros((n,), dtype=jnp.float32).at[gid].add(1.0)
+    tie_pairs = jnp.sum(t * (t - 1) / 2)
+    p1 = jnp.sum(t * (t - 1) * (t - 2))
+    p2 = jnp.sum(t * (t - 1) * (2 * t + 5))
+    n_distinct = jnp.sum(new_group)
+    return tie_pairs, p1, p2, n_distinct
+
+
+def _pair_stats(preds: Array, target: Array) -> Array:
+    """Concordant − discordant pair count via a row-chunked pairwise
+    contraction (memory O(chunk·n)); subtraction stays in the native input
+    dtype so tie/order decisions match the sort-based `_tie_stats` pass."""
     n = preds.shape[0]
-    sx = jnp.sign(preds[:, None] - preds[None, :])
-    sy = jnp.sign(target[:, None] - target[None, :])
-    prod = sx * sy
-    con_min_dis = jnp.sum(jnp.triu(prod, k=1))  # concordant - discordant
+    chunk = min(_PAIR_CHUNK, n)
+    nchunks = -(-n // chunk)
+    npad = nchunks * chunk
+    xp = jnp.pad(preds, (0, npad - n))
+    yp = jnp.pad(target, (0, npad - n))
+    col_idx = jnp.arange(npad)
 
+    def body(cmd, c):
+        start = c * chunk
+        rows_x = jax.lax.dynamic_slice(xp, (start,), (chunk,))
+        rows_y = jax.lax.dynamic_slice(yp, (start,), (chunk,))
+        row_idx = start + jnp.arange(chunk)
+        # strict upper triangle of the full n×n pair matrix, valid rows/cols only
+        mask = (col_idx[None, :] > row_idx[:, None]) & (col_idx[None, :] < n) & (row_idx[:, None] < n)
+        sx = jnp.sign((rows_x[:, None] - xp[None, :]).astype(jnp.float32))
+        sy = jnp.sign((rows_y[:, None] - yp[None, :]).astype(jnp.float32))
+        return cmd + jnp.sum(sx * sy * mask), None
+
+    cmd, _ = jax.lax.scan(body, jnp.zeros(()), jnp.arange(nchunks))
+    return cmd
+
+
+def _kendall_tau_1d(preds: Array, target: Array, variant: str) -> Tuple[Array, Array, tuple, tuple]:
+    """(tau, concordance statistic, x tie stats, y tie stats) for one column.
+
+    Tie-pair counts come from the exact sort-based run-length pass (float32
+    sums of group-size polynomials — relative error ≤ ~1e-7 even at billions
+    of tied pairs, where an int32 accumulator would wrap).
+    """
+    n = preds.shape[0]
+    con_min_dis = _pair_stats(preds, target)
     n0 = n * (n - 1) / 2.0
-    tx = jnp.sum(jnp.triu(sx == 0, k=1))  # ties in x (pairs)
-    ty = jnp.sum(jnp.triu(sy == 0, k=1))
+    x_stats = _tie_stats(preds)
+    y_stats = _tie_stats(target)
 
     if variant == "a":
         tau = con_min_dis / n0
     elif variant == "b":
-        tau = con_min_dis / jnp.sqrt((n0 - tx) * (n0 - ty))
+        tau = con_min_dis / jnp.sqrt((n0 - x_stats[0]) * (n0 - y_stats[0]))
     else:  # "c"
-        # distinct-value counts with static shapes: an element is a duplicate
-        # if it equals an earlier element
-        distinct_x = n - jnp.sum(
-            jnp.sum((preds[:, None] == preds[None, :]) & (jnp.arange(n)[None, :] < jnp.arange(n)[:, None]), axis=1)
-            > 0
-        )
-        distinct_y = n - jnp.sum(
-            jnp.sum((target[:, None] == target[None, :]) & (jnp.arange(n)[None, :] < jnp.arange(n)[:, None]), axis=1)
-            > 0
-        )
-        m = jnp.minimum(distinct_x, distinct_y).astype(jnp.float32)
+        m = jnp.minimum(x_stats[3], y_stats[3]).astype(jnp.float32)
         tau = 2.0 * con_min_dis / (n**2 * (m - 1) / m)
-    return jnp.clip(tau, -1.0, 1.0), con_min_dis
+    return jnp.clip(tau, -1.0, 1.0), con_min_dis, x_stats, y_stats
 
 
-def _kendall_pvalue_1d(tau: Array, con_min_dis: Array, n: int, alternative: str) -> Array:
-    """Normal-approximation significance test for tau (reference kendall.py
-    `_calculate_p_value`)."""
+def _kendall_pvalue_1d(
+    x_stats: tuple, y_stats: tuple, con_min_dis: Array, n: int, variant: str, alternative: str
+) -> Array:
+    """Normal-approximation significance test for tau with tie corrections
+    for variants "b"/"c" (reference kendall.py `_calculate_p_value`)."""
     from jax.scipy.stats import norm
 
-    var = n * (n - 1) * (2.0 * n + 5.0) / 18.0
-    z = con_min_dis / jnp.sqrt(var)
+    base = n * (n - 1) * (2.0 * n + 5.0)
+    if variant == "a" or n <= 2:
+        # n<=2: tie-correction terms are 0/0 — fall back to the untied form
+        z = con_min_dis / jnp.sqrt(base / 18.0)
+    else:
+        x_tie, x_p1, x_p2, _ = x_stats
+        y_tie, y_p1, y_p2, _ = y_stats
+        m = n * (n - 1.0)
+        var = (base - x_p2 - y_p2) / 18.0
+        var = var + (2.0 * x_tie * y_tie) / m
+        var = var + x_p1 * y_p1 / (9.0 * m * (n - 2.0))
+        z = con_min_dis / jnp.sqrt(var)
     if alternative == "two-sided":
         return 2 * norm.sf(jnp.abs(z))
     if alternative == "greater":
@@ -100,16 +158,16 @@ def kendall_rank_corrcoef(
     _check_data_shape_to_num_outputs(preds, target, num_outputs, allow_1d_reshape=True)
 
     if preds.ndim == 1:
-        tau, cmd = _kendall_tau_1d(preds, target, variant)
+        tau, cmd, xs, ys = _kendall_tau_1d(preds, target, variant)
         if t_test:
-            return tau, _kendall_pvalue_1d(tau, cmd, preds.shape[0], alternative)
+            return tau, _kendall_pvalue_1d(xs, ys, cmd, preds.shape[0], variant, alternative)
         return tau
     taus, pvals = [], []
     for i in range(num_outputs):
-        tau, cmd = _kendall_tau_1d(preds[:, i], target[:, i], variant)
+        tau, cmd, xs, ys = _kendall_tau_1d(preds[:, i], target[:, i], variant)
         taus.append(tau)
         if t_test:
-            pvals.append(_kendall_pvalue_1d(tau, cmd, preds.shape[0], alternative))
+            pvals.append(_kendall_pvalue_1d(xs, ys, cmd, preds.shape[0], variant, alternative))
     if t_test:
         return jnp.stack(taus), jnp.stack(pvals)
     return jnp.stack(taus)

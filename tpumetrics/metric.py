@@ -577,6 +577,33 @@ class Metric(ABC):
             value = _squeeze_if_scalar(type(self).compute(self))
         return value
 
+    def functional_forward(
+        self,
+        state: Dict[str, StateType],
+        *args: Any,
+        axis_name: Optional[str] = None,
+        backend: Optional[DistributedBackend] = None,
+        **kwargs: Any,
+    ) -> tuple:
+        """Pure ``forward``: accumulate into ``state`` AND return this batch's
+        value, optionally synced in-trace over ``axis_name``.
+
+        The TPU-idiomatic ``dist_sync_on_step=True`` path (reference
+        metric.py:273-305 + per-step collective): both the state transition
+        and the per-step cross-device sync live inside the jitted step, so
+        the sync is one fused ICI collective instead of an eager gather.
+        Returns ``(new_state, batch_value)``.
+
+        Inside ``shard_map``, the returned state is **per-device** (each
+        device accumulates only its own shard) — carry it with the device
+        axis explicit (``out_specs=P(axis)`` on a leading device dim), not as
+        a falsely-replicated ``P()`` output.
+        """
+        new_state = self.functional_update(state, *args, **kwargs)
+        batch_state = self.functional_update(self.init_state(), *args, **kwargs)
+        batch_val = self.functional_compute(batch_state, axis_name=axis_name, backend=backend)
+        return new_state, batch_val
+
     def sync_state(
         self, state: Dict[str, StateType], backend: DistributedBackend
     ) -> Dict[str, StateType]:

@@ -103,6 +103,19 @@ def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
     return jnp.argmax(x, axis=argmax_dim)
 
 
+def _count_dtype() -> Any:
+    """Integer dtype for long-running count accumulators.
+
+    int64 when jax x64 is enabled; otherwise int32, which silently wraps past
+    ~2.1B accumulated samples — enable ``jax.config.update("jax_enable_x64",
+    True)`` for longer accumulation runs (the reference uses torch.long
+    unconditionally).
+    """
+    import jax as _jax
+
+    return jnp.int64 if _jax.config.jax_enable_x64 else jnp.int32
+
+
 def _bincount(x: Array, minlength: Optional[int] = None) -> Array:
     """Count occurrences of ints in ``x``.
 
