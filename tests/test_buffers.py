@@ -1,0 +1,180 @@
+"""Fixed-capacity masked buffer machinery (jit-safe cat/ragged states).
+
+The VERDICT r1 acceptance case lives here: ranks contributing **different
+valid row counts inside shard_map** whose merged metric matches sklearn —
+the static-shape replacement of the reference's pad-gather-trim
+(reference utilities/distributed.py:135-147) and `all_gather_object` ragged
+sync (reference detection/mean_ap.py:994-1024).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tests.helpers.testers import shard_map
+from tpumetrics.buffers import (
+    MaskedBuffer,
+    buffer_all_gather,
+    buffer_append,
+    buffer_merge,
+    buffer_overflowed,
+    create_buffer,
+    masked_values,
+    materialize,
+)
+from tpumetrics.metric import Metric
+from tpumetrics.parallel import AxisBackend
+from tpumetrics.parallel.merge import merge_metric_states
+
+
+def _mesh(ws):
+    return Mesh(np.array(jax.devices()[:ws]), ("r",))
+
+
+class MaskedCatAUROC(Metric):
+    """Exact-AUROC metric over masked cat states (a metric-author example of
+    the fixed-capacity machinery: masked appends, eager-exact compute)."""
+
+    def __init__(self, capacity: int = 256, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("preds", default=[], dist_reduce_fx="cat", capacity=capacity)
+        self.add_state(
+            "target", default=[], dist_reduce_fx="cat", capacity=capacity, feature_dtype=jnp.int32
+        )
+
+    def update(self, preds, target, valid=None):
+        self._append_state("preds", preds, valid=valid)
+        self._append_state("target", target, valid=valid)
+
+    def compute(self):
+        from tpumetrics.functional.classification import binary_auroc
+
+        from tpumetrics.utils.data import dim_zero_cat
+
+        return binary_auroc(dim_zero_cat(self.preds), dim_zero_cat(self.target), thresholds=None)
+
+
+def test_append_materialize_roundtrip():
+    buf = create_buffer(10, (), jnp.float32)
+    buf = buffer_append(buf, jnp.asarray([1.0, 2.0, 3.0]))
+    buf = buffer_append(buf, jnp.asarray([4.0]))
+    assert int(buf.count) == 4
+    np.testing.assert_allclose(np.asarray(materialize(buf)), [1, 2, 3, 4])
+
+
+def test_masked_append_drops_invalid_rows():
+    buf = create_buffer(10)
+    batch = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    buf = buffer_append(buf, batch, valid=jnp.asarray([True, False, True, False]))
+    np.testing.assert_allclose(np.asarray(materialize(buf)), [1, 3])
+    # appends keep packing contiguously
+    buf = buffer_append(buf, batch, valid=jnp.asarray([False, True, False, True]))
+    np.testing.assert_allclose(np.asarray(materialize(buf)), [1, 3, 2, 4])
+
+
+def test_overflow_drops_and_flags():
+    buf = create_buffer(3)
+    buf = buffer_append(buf, jnp.asarray([1.0, 2.0]))
+    buf = buffer_append(buf, jnp.asarray([3.0, 4.0]))  # 4th row dropped
+    assert int(buf.count) == 3
+    assert bool(buffer_overflowed(buf))
+    np.testing.assert_allclose(np.asarray(materialize(buf)), [1, 2, 3])
+
+
+def test_append_under_jit_static_shapes():
+    buf = create_buffer(8, (2,), jnp.float32)
+
+    @jax.jit
+    def step(b, x, valid):
+        return buffer_append(b, x, valid=valid)
+
+    x = jnp.arange(6.0).reshape(3, 2)
+    buf = step(buf, x, jnp.asarray([True, True, False]))
+    buf = step(buf, x + 10, jnp.asarray([False, True, True]))
+    np.testing.assert_allclose(np.asarray(materialize(buf)), [[0, 1], [2, 3], [12, 13], [14, 15]])
+
+
+def test_buffer_merge_eager_matches_union():
+    b1 = buffer_append(create_buffer(5), jnp.asarray([1.0, 2.0]))
+    b2 = buffer_append(create_buffer(5), jnp.asarray([3.0]))
+    b3 = create_buffer(5)  # empty rank
+    merged = buffer_merge([b1, b2, b3])
+    np.testing.assert_allclose(np.asarray(materialize(merged)), [1, 2, 3])
+    vals, mask = masked_values(merged)
+    assert vals.shape == (15,) and int(mask.sum()) == 3
+
+
+@pytest.mark.parametrize("world_size", [2, 4, 8])
+def test_uneven_shard_sync_inside_shard_map_matches_sklearn(world_size):
+    """Each rank contributes a DIFFERENT, data-dependent number of valid rows
+    inside shard_map; the in-trace gather+mask sync must merge them exactly
+    (VERDICT r1 'Done' criterion for task 2)."""
+    from sklearn.metrics import roc_auc_score
+
+    per_dev = 20  # >= 3 + 2*7 so every rank's request fits its shard
+    cap = 64
+    metric = MaskedCatAUROC(capacity=cap)
+    mesh = _mesh(world_size)
+
+    rng = np.random.default_rng(11)
+    preds = jnp.asarray(rng.random((world_size * per_dev,)), dtype=jnp.float32)
+    target = jnp.asarray(rng.integers(0, 2, (world_size * per_dev,)), dtype=jnp.int32)
+
+    def run(p, t):
+        r = jax.lax.axis_index("r")
+        # rank r keeps 3 + 2r rows — uneven by construction
+        valid = jnp.arange(per_dev) < (3 + 2 * r)
+        state = metric.init_state()
+        state = metric.functional_update(state, p, t, valid=valid)
+        return metric.sync_state(state, AxisBackend("r"))
+
+    synced = jax.jit(shard_map(run, mesh=mesh, in_specs=(P("r"), P("r")), out_specs=P()))(preds, target)
+
+    assert isinstance(synced["preds"], MaskedBuffer)
+    assert synced["preds"].values.shape == (world_size * cap,)
+    assert int(synced["preds"].count) == sum(3 + 2 * r for r in range(world_size))
+
+    result = metric.functional_compute(synced)
+
+    keep = np.concatenate(
+        [np.arange(r * per_dev, r * per_dev + 3 + 2 * r) for r in range(world_size)]
+    )
+    ref = roc_auc_score(np.asarray(target)[keep], np.asarray(preds)[keep])
+    assert np.allclose(np.asarray(result), ref, atol=1e-6), (float(result), ref)
+
+
+def test_uneven_emulated_rank_merge_matches_sklearn():
+    """Same criterion on the eager (DCN/emulated-rank) path via merge_metric_states."""
+    from sklearn.metrics import roc_auc_score
+
+    rng = np.random.default_rng(5)
+    metric = MaskedCatAUROC(capacity=32)
+    replicas = [MaskedCatAUROC(capacity=32) for _ in range(3)]
+    all_p, all_t = [], []
+    states = []
+    for r, m in enumerate(replicas):
+        n = 4 + 3 * r
+        p = jnp.asarray(rng.random((n,)), dtype=jnp.float32)
+        t = jnp.asarray(rng.integers(0, 2, (n,)), dtype=jnp.int32)
+        state = m.functional_update(m.init_state(), p, t)
+        states.append(state)
+        all_p.append(np.asarray(p))
+        all_t.append(np.asarray(t))
+
+    merged = merge_metric_states(states, metric._reductions)
+    result = metric.functional_compute(merged)
+    ref = roc_auc_score(np.concatenate(all_t), np.concatenate(all_p))
+    assert np.allclose(np.asarray(result), ref, atol=1e-6)
+
+
+def test_forward_reduce_merge_with_buffers():
+    """forward-style merge of a batch state into a global buffer state."""
+    metric = MaskedCatAUROC(capacity=16)
+    g = metric.functional_update(metric.init_state(), jnp.asarray([0.9, 0.1]), jnp.asarray([1, 0]))
+    b = metric.functional_update(metric.init_state(), jnp.asarray([0.8]), jnp.asarray([1]))
+    from tpumetrics.buffers import buffer_extend
+
+    merged = buffer_extend(g["preds"], b["preds"])
+    np.testing.assert_allclose(np.asarray(materialize(merged)), [0.9, 0.1, 0.8], rtol=1e-6)

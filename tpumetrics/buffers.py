@@ -1,0 +1,190 @@
+"""Fixed-capacity masked buffers — jit-safe "cat"/ragged list states.
+
+The reference accumulates variable-length state in Python lists of tensors
+and syncs them with a pad-gather-trim collective (reference
+``utilities/distributed.py:135-147``) or pickled object gather for truly
+ragged state (reference ``detection/mean_ap.py:994-1024``). Neither shape
+dance exists under XLA: compiled programs need static shapes. The TPU-native
+redesign is a **fixed-capacity buffer + valid count**:
+
+- ``values``: a preallocated ``(capacity, *feature)`` array,
+- ``count``: how many leading rows are real data.
+
+``append`` writes a batch at offset ``count`` with one scatter (optionally
+masked, so a batch can contribute an *uneven, data-dependent* number of
+rows while shapes stay static). Cross-device sync is one ``all_gather`` of
+values+counts followed by a static-shape compaction scatter — the
+pad-gather-trim of the reference becomes pad-gather-*mask*, fully inside the
+compiled program, riding ICI. Off-trace, :func:`materialize` recovers the
+exact variable-length array, so eager code paths behave exactly like the
+reference's list states.
+
+Overflow policy: rows beyond ``capacity`` are silently dropped (the dump-row
+scatter). Size ``capacity`` to the worst-case number of accumulated samples;
+:func:`buffer_overflowed` exposes the would-be count for host-side checks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class MaskedBuffer(NamedTuple):
+    """Fixed-capacity masked accumulation buffer (a pytree of two arrays)."""
+
+    values: Array  # (capacity, *feature)
+    count: Array  # () int32 — number of valid leading rows
+    requested: Array  # () int32 — rows ever requested (== count unless overflowed)
+
+    @property
+    def capacity(self) -> int:
+        return self.values.shape[0]
+
+    def valid_mask(self) -> Array:
+        """Boolean ``(capacity,)`` mask of rows holding real data."""
+        return jnp.arange(self.capacity) < self.count
+
+
+def create_buffer(capacity: int, feature_shape: Tuple[int, ...] = (), dtype: Any = jnp.float32) -> MaskedBuffer:
+    """Fresh empty buffer of static shape ``(capacity, *feature_shape)``."""
+    return MaskedBuffer(
+        values=jnp.zeros((capacity,) + tuple(feature_shape), dtype=dtype),
+        count=jnp.zeros((), dtype=jnp.int32),
+        requested=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+def buffer_append(buf: MaskedBuffer, batch: Array, valid: Optional[Array] = None) -> MaskedBuffer:
+    """Append ``batch`` rows (optionally only where ``valid``) at the write
+    offset — one static-shape scatter, traceable under jit.
+
+    ``valid`` enables data-dependent contribution counts with static shapes:
+    invalid rows are routed to a dump slot past the end of the buffer, which
+    is then trimmed away. Rows past capacity are dropped (see module note).
+    """
+    batch = jnp.asarray(batch)
+    if batch.ndim == buf.values.ndim - 1:
+        batch = batch[None]  # single row
+    b = batch.shape[0]
+    cap = buf.values.shape[0]
+    if valid is None:
+        valid = jnp.ones((b,), dtype=bool)
+    valid = valid.astype(bool)
+    pos = jnp.where(valid, buf.count + jnp.cumsum(valid.astype(jnp.int32)) - 1, cap)
+    # invalid/overflow rows get out-of-bounds indices; scatter mode="drop"
+    # discards them with no extra buffer copy
+    new_values = buf.values.at[pos].set(batch.astype(buf.values.dtype), mode="drop")
+    n_new = jnp.sum(valid.astype(jnp.int32))
+    return MaskedBuffer(
+        values=new_values,
+        count=jnp.minimum(buf.count + n_new, cap),
+        requested=buf.requested + n_new,
+    )
+
+
+def buffer_extend(buf: MaskedBuffer, other: MaskedBuffer) -> MaskedBuffer:
+    """Append another buffer's valid rows (used when merging a batch state
+    into a global state, e.g. ``forward``'s reduce-state merge)."""
+    return buffer_append(buf, other.values, valid=other.valid_mask())
+
+
+def buffer_compact(stacked_values: Array, counts: Array) -> MaskedBuffer:
+    """Compact per-rank buffers ``(W, cap, *f)`` with valid ``counts`` ``(W,)``
+    into one ``(W*cap, *f)`` buffer — the static-shape replacement for the
+    reference's gather-then-trim (utilities/distributed.py:141-147)."""
+    w, cap = stacked_values.shape[0], stacked_values.shape[1]
+    counts = counts.astype(jnp.int32)
+    offsets = jnp.cumsum(counts) - counts
+    idx = jnp.arange(cap)
+    pos = offsets[:, None] + idx[None, :]  # (W, cap) global positions
+    valid = idx[None, :] < counts[:, None]
+    total = w * cap
+    pos = jnp.where(valid, pos, total)  # invalid rows -> out of bounds, dropped
+    flat = stacked_values.reshape((total,) + stacked_values.shape[2:])
+    out = jnp.zeros((total,) + stacked_values.shape[2:], stacked_values.dtype)
+    out = out.at[pos.reshape(-1)].set(flat, mode="drop")
+    return MaskedBuffer(values=out, count=jnp.sum(counts), requested=jnp.sum(counts))
+
+
+def buffer_all_gather(buf: MaskedBuffer, backend: Any, group: Optional[Any] = None) -> MaskedBuffer:
+    """Gather + compact a buffer across ranks through a sync backend
+    (in-trace: one XLA all_gather over ICI; eager: DCN process gather).
+
+    Two wire ops per buffer: the values gather and one packed (count,
+    requested) scalar gather.
+    """
+    vals = backend.all_gather(buf.values, group)  # list of (cap, *f)
+    meta = backend.all_gather(jnp.stack([buf.count, buf.requested]).astype(jnp.int32), group)
+    stacked = jnp.stack(list(vals))
+    meta_arr = jnp.stack([jnp.reshape(m, (2,)) for m in meta])  # (W, 2)
+    merged = buffer_compact(stacked, meta_arr[:, 0])
+    return MaskedBuffer(values=merged.values, count=merged.count, requested=jnp.sum(meta_arr[:, 1]))
+
+
+def buffer_merge(bufs: Sequence[MaskedBuffer]) -> MaskedBuffer:
+    """Merge same-capacity per-rank buffers eagerly (DCN/emulated-rank path)."""
+    stacked = jnp.stack([b.values for b in bufs])
+    counts = jnp.stack([jnp.reshape(b.count, ()) for b in bufs])
+    merged = buffer_compact(stacked, counts)
+    requested = sum((jnp.reshape(b.requested, ()) for b in bufs), start=jnp.zeros((), jnp.int32))
+    return MaskedBuffer(values=merged.values, count=merged.count, requested=requested)
+
+
+def buffer_overflowed(buf: MaskedBuffer) -> Array:
+    """True when rows were dropped because capacity was exceeded."""
+    return buf.requested > buf.count
+
+
+def materialize(buf: MaskedBuffer) -> Array:
+    """Exact variable-length contents ``values[:count]`` — **off-trace only**
+    (the result shape is data-dependent)."""
+    from tpumetrics.utils.data import _is_tracer
+
+    if _is_tracer(buf.count) or _is_tracer(buf.values):
+        raise ValueError(
+            "materialize() of a MaskedBuffer is data-dependent and cannot run under jit;"
+            " use masked_values() and mask-aware math inside compiled code."
+        )
+    return buf.values[: int(buf.count)]
+
+
+def masked_values(state: Any) -> Tuple[Array, Array]:
+    """Uniform (values, valid_mask) view of a cat-style state: a Python list
+    of arrays (eager path — all rows valid) or a MaskedBuffer (jit path)."""
+    from tpumetrics.utils.data import dim_zero_cat
+
+    if isinstance(state, MaskedBuffer):
+        return state.values, state.valid_mask()
+    if isinstance(state, list):
+        if not state:  # empty eager state mirrors an empty buffer, not an error
+            return jnp.zeros((0,)), jnp.zeros((0,), dtype=bool)
+        cat = dim_zero_cat(state)
+        return cat, jnp.ones((cat.shape[0],), dtype=bool)
+    if isinstance(state, (jnp.ndarray, jax.Array)):
+        return state, jnp.ones((state.shape[0],), dtype=bool)
+    raise TypeError(f"Unsupported cat-state type {type(state)}")
+
+
+class _BufferList:
+    """List-like adapter so subclass ``update`` code written for list states
+    (``self.preds.append(x)``) transparently drives a MaskedBuffer when the
+    metric runs through the functional/jit bridge."""
+
+    __slots__ = ("buffer",)
+
+    def __init__(self, buffer: MaskedBuffer) -> None:
+        self.buffer = buffer
+
+    def append(self, x: Array, valid: Optional[Array] = None) -> None:
+        self.buffer = buffer_append(self.buffer, x, valid=valid)
+
+    def __iter__(self):
+        return iter([materialize(self.buffer)])
+
+    def __len__(self) -> int:
+        return 1

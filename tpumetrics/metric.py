@@ -162,6 +162,7 @@ class Metric(ABC):
         self._defaults: Dict[str, StateType] = {}
         self._persistent: Dict[str, bool] = {}
         self._reductions: Dict[str, Union[str, Callable, None]] = {}
+        self._buffer_specs: Dict[str, tuple] = {}  # name -> (capacity, feature_shape, dtype)
 
         self._update_signature = inspect.signature(self.update)
         self.update: Callable = self._wrap_update(self.update)  # type: ignore[method-assign]
@@ -183,6 +184,9 @@ class Metric(ABC):
         default: Union[Array, list, int, float],
         dist_reduce_fx: Optional[Union[str, Callable]] = None,
         persistent: bool = False,
+        capacity: Optional[int] = None,
+        feature_shape: tuple = (),
+        feature_dtype: Optional[Any] = None,
     ) -> None:
         """Register an accumulator state (reference metric.py:194-271).
 
@@ -190,6 +194,15 @@ class Metric(ABC):
         an empty list for "cat"-style list states. ``dist_reduce_fx`` is one
         of ``"sum" | "mean" | "max" | "min" | "cat" | None`` or a custom
         callable operating on a rank-stacked array.
+
+        For list states, ``capacity`` (+ ``feature_shape``/``feature_dtype``)
+        declares a **fixed-capacity masked buffer** used on the functional/
+        jit path: the state becomes a :class:`~tpumetrics.buffers.MaskedBuffer`
+        with static shapes, in-trace appends, and one all_gather+mask sync
+        even when ranks contribute uneven row counts (the static-shape
+        replacement for the reference's pad-gather-trim,
+        utilities/distributed.py:135-147). The eager OO path keeps exact
+        Python-list behavior.
         """
         if not name.isidentifier():
             raise ValueError(f"Argument `name` must be a valid python identifier, got {name!r}")
@@ -199,6 +212,13 @@ class Metric(ABC):
                 default = default.astype(self._dtype)
         elif default:
             raise ValueError("state variable must be an array or an *empty* list (where you can append arrays)")
+
+        if capacity is not None:
+            if not isinstance(default, list):
+                raise ValueError("`capacity` is only valid for list ('cat'-style) states")
+            # dtype=None resolves to self._dtype lazily at init_state so a
+            # later set_dtype()/half() affects buffers like other states
+            self._buffer_specs[name] = (int(capacity), tuple(feature_shape), feature_dtype)
 
         if dist_reduce_fx is not None and not (dist_reduce_fx in _REDUCE_FNS or callable(dist_reduce_fx)):
             raise ValueError(
@@ -210,6 +230,38 @@ class Metric(ABC):
         self._persistent[name] = persistent
         self._reductions[name] = reduce_fn
         object.__setattr__(self, name, [] if isinstance(default, list) else default)
+
+    def set_state_capacity(
+        self,
+        name: str,
+        capacity: int,
+        feature_shape: tuple = (),
+        feature_dtype: Optional[Any] = None,
+    ) -> None:
+        """Declare (or change) the fixed capacity of an existing list state so
+        the functional/jit path uses a static-shape MaskedBuffer for it."""
+        if name not in self._defaults or not isinstance(self._defaults[name], list):
+            raise ValueError(f"State {name!r} is not a registered list state")
+        self._buffer_specs[name] = (int(capacity), tuple(feature_shape), feature_dtype)
+
+    def _append_state(self, name: str, x: Array, valid: Optional[Array] = None) -> None:
+        """Append a batch to a list state, optionally masked.
+
+        On the eager path (Python-list state) invalid rows are dropped
+        exactly; on the functional/jit path (MaskedBuffer state) the mask
+        routes them to the dump slot with static shapes — this is how a
+        metric contributes an uneven, data-dependent number of rows per
+        device without breaking the compiled program.
+        """
+        from tpumetrics.buffers import _BufferList
+
+        val = getattr(self, name)
+        if isinstance(val, _BufferList):
+            val.append(x, valid=valid)
+        else:
+            if valid is not None:
+                x = x[valid]
+            val.append(x)
 
     @property
     def _state_names(self) -> List[str]:
@@ -229,10 +281,19 @@ class Metric(ABC):
         return self._update_count
 
     def _copy_state_dict(self) -> Dict[str, StateType]:
-        """Snapshot of states. Arrays are immutable so aliasing is safe; lists are shallow-copied."""
-        return {
-            attr: list(val) if isinstance(val, list) else val for attr, val in self.metric_state().items()
-        }
+        """Snapshot of states. Arrays are immutable so aliasing is safe; lists are
+        shallow-copied; buffer adapters unwrap to their MaskedBuffer pytree."""
+        from tpumetrics.buffers import _BufferList
+
+        out: Dict[str, StateType] = {}
+        for attr, val in self.metric_state().items():
+            if isinstance(val, _BufferList):
+                out[attr] = val.buffer
+            elif isinstance(val, list):
+                out[attr] = list(val)
+            else:
+                out[attr] = val
+        return out
 
     # ---------------------------------------------------------------- forward
 
@@ -351,12 +412,16 @@ class Metric(ABC):
         group = process_group or self.process_group
         backend = self._active_backend()
 
+        from tpumetrics.buffers import MaskedBuffer, buffer_all_gather
+
         if dist_sync_fn is None:
             # fused backend path
             for attr, reduction_fn in self._reductions.items():
                 current_val = getattr(self, attr)
                 op = _reduce_fn_to_op(reduction_fn)
-                if isinstance(current_val, list):
+                if isinstance(current_val, MaskedBuffer):
+                    object.__setattr__(self, attr, buffer_all_gather(current_val, backend, group=group))
+                elif isinstance(current_val, list):
                     # a locally-empty list still participates in the collective
                     # (zero-length contribution) so ranks never diverge on the
                     # number of collectives issued — a hang otherwise
@@ -523,10 +588,24 @@ class Metric(ABC):
     # ------------------------------------------------------- functional bridge
 
     def init_state(self) -> Dict[str, StateType]:
-        """Fresh default state pytree (pure; for the functional/jit path)."""
-        return {
-            attr: ([] if isinstance(default, list) else default) for attr, default in self._defaults.items()
-        }
+        """Fresh default state pytree (pure; for the functional/jit path).
+
+        List states declared with a ``capacity`` become fixed-capacity
+        :class:`~tpumetrics.buffers.MaskedBuffer` leaves so the whole state is
+        a static-shape pytree usable inside jit/shard_map.
+        """
+        from tpumetrics.buffers import create_buffer
+
+        out: Dict[str, StateType] = {}
+        for attr, default in self._defaults.items():
+            if attr in self._buffer_specs:
+                cap, fshape, fdtype = self._buffer_specs[attr]
+                out[attr] = create_buffer(cap, fshape, fdtype if fdtype is not None else self._dtype)
+            elif isinstance(default, list):
+                out[attr] = []
+            else:
+                out[attr] = default
+        return out
 
     @contextmanager
     def _borrowed_state(self, state: Dict[str, StateType]) -> Generator[None, None, None]:
@@ -534,11 +613,18 @@ class Metric(ABC):
 
         List states are shallow-copied on the way in so in-place appends made
         by ``update`` never mutate the caller's pytree (array leaves are
-        immutable anyway).
+        immutable anyway). MaskedBuffer leaves are wrapped in a list-like
+        adapter so subclass ``update`` code can ``.append`` to them.
         """
+        from tpumetrics.buffers import MaskedBuffer, _BufferList
+
         saved = self._copy_state_dict()
         for attr, val in state.items():
-            object.__setattr__(self, attr, list(val) if isinstance(val, list) else val)
+            if isinstance(val, MaskedBuffer):
+                val = _BufferList(val)
+            elif isinstance(val, list):
+                val = list(val)
+            object.__setattr__(self, attr, val)
         try:
             yield
         finally:
@@ -608,11 +694,17 @@ class Metric(ABC):
         self, state: Dict[str, StateType], backend: DistributedBackend
     ) -> Dict[str, StateType]:
         """Pure cross-rank merge of a state pytree using each state's reduce op."""
+        from tpumetrics.buffers import MaskedBuffer, buffer_all_gather
+
         out: Dict[str, StateType] = {}
         for attr, reduction_fn in self._reductions.items():
             val = state[attr]
             op = _reduce_fn_to_op(reduction_fn)
-            if isinstance(val, list):
+            if isinstance(val, MaskedBuffer):
+                # one all_gather + static-shape compaction; uneven per-rank
+                # valid counts are handled by the mask, not by shape surgery
+                out[attr] = buffer_all_gather(val, backend)
+            elif isinstance(val, list):
                 # empty lists still issue the collective — see _sync_dist
                 catted = dim_zero_cat(val) if val else jnp.zeros((0,), dtype=self._dtype)
                 merged = dim_zero_cat(backend.all_gather(catted))

@@ -32,11 +32,16 @@ def merge_metric_states(
     stacked along a new leading rank axis, matching the reference's gather
     semantics.
     """
+    from tpumetrics.buffers import MaskedBuffer, buffer_merge
+
     if not states:
         raise ValueError("need at least one state to merge")
     out: Dict[str, Any] = {}
     for name, reduction_fn in reductions.items():
         vals = [s[name] for s in states]
+        if isinstance(vals[0], MaskedBuffer):
+            out[name] = buffer_merge(vals)
+            continue
         if isinstance(vals[0], list):
             flat = [v for sub in vals for v in sub]
             out[name] = [dim_zero_cat(flat)] if flat else []

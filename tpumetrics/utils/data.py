@@ -24,11 +24,23 @@ def _is_tracer(x: Any) -> bool:
 
 
 def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
-    """Concatenate (a possibly-listed) state along dim 0."""
+    """Concatenate (a possibly-listed) state along dim 0.
+
+    MaskedBuffer states materialize to their exact valid rows (off-trace
+    only — under jit use mask-aware math via ``buffers.masked_values``).
+    """
+    from tpumetrics.buffers import MaskedBuffer, _BufferList, materialize
+
+    if isinstance(x, _BufferList):
+        x = x.buffer
+    if isinstance(x, MaskedBuffer):
+        return materialize(x)
     if isinstance(x, (jnp.ndarray, jax.Array)) and not isinstance(x, (list, tuple)):
         return x
     if not x:  # empty list
         raise ValueError("No samples to concatenate")
+    x = [y.buffer if isinstance(y, _BufferList) else y for y in x]
+    x = [materialize(y) if isinstance(y, MaskedBuffer) else y for y in x]
     x = [y[None] if jnp.ndim(y) == 0 else y for y in x]
     return jnp.concatenate(x, axis=0)
 
