@@ -1,0 +1,83 @@
+"""Pairwise functional family vs sklearn/scipy (counterpart of reference
+``tests/unittests/pairwise/test_pairwise_distance.py``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.spatial.distance import cdist
+from sklearn.metrics.pairwise import (
+    cosine_similarity as sk_cosine,
+    euclidean_distances as sk_euclidean,
+    linear_kernel as sk_linear,
+    manhattan_distances as sk_manhattan,
+)
+
+from tpumetrics.functional.pairwise import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+    pairwise_minkowski_distance,
+)
+
+_rng = np.random.default_rng(7)
+X = _rng.standard_normal((24, 13)).astype(np.float32) * 3.0 + 1.5
+Y = _rng.standard_normal((17, 13)).astype(np.float32) * 2.0 - 0.5
+
+
+def _sk_minkowski(x, y, p):
+    return cdist(x, y, metric="minkowski", p=p)
+
+
+CASES = [
+    (pairwise_cosine_similarity, sk_cosine, {}, 1e-5),
+    (pairwise_euclidean_distance, sk_euclidean, {}, 1e-3),
+    (pairwise_linear_similarity, sk_linear, {}, 1e-3),
+    (pairwise_manhattan_distance, sk_manhattan, {}, 1e-3),
+    (pairwise_minkowski_distance, lambda x, y: _sk_minkowski(x, y, 3), {"exponent": 3}, 1e-3),
+]
+
+
+@pytest.mark.parametrize("metric, sk_fn, kwargs, atol", CASES, ids=[c[0].__name__ for c in CASES])
+@pytest.mark.parametrize("reduction", [None, "mean", "sum"])
+def test_pairwise_xy(metric, sk_fn, kwargs, atol, reduction):
+    expected = sk_fn(X, Y)
+    if reduction == "mean":
+        expected = expected.mean(axis=-1)
+    elif reduction == "sum":
+        expected = expected.sum(axis=-1)
+    result = metric(jnp.asarray(X), jnp.asarray(Y), reduction=reduction, **kwargs)
+    assert np.allclose(np.asarray(result), expected, atol=atol)
+
+
+@pytest.mark.parametrize("metric, sk_fn, kwargs, atol", CASES, ids=[c[0].__name__ for c in CASES])
+def test_pairwise_self_zero_diagonal(metric, sk_fn, kwargs, atol):
+    """Self mode (y omitted) zeroes the diagonal by default."""
+    expected = np.asarray(sk_fn(X, X))
+    np.fill_diagonal(expected, 0)
+    result = metric(jnp.asarray(X), **kwargs)
+    assert np.allclose(np.asarray(result), expected, atol=atol)
+
+
+def test_pairwise_input_validation():
+    with pytest.raises(ValueError, match="Expected argument `x`"):
+        pairwise_cosine_similarity(jnp.zeros((3,)))
+    with pytest.raises(ValueError, match="Expected argument `y`"):
+        pairwise_cosine_similarity(jnp.zeros((3, 2)), jnp.zeros((3, 4)))
+    with pytest.raises(ValueError, match="Expected reduction"):
+        pairwise_cosine_similarity(jnp.zeros((3, 2)), reduction="bad")
+    from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+    with pytest.raises(TPUMetricsUserError, match="must be a float or int greater than or equal to 1"):
+        pairwise_minkowski_distance(jnp.zeros((3, 2)), exponent=0.5)
+
+
+def test_pairwise_jittable():
+    import jax
+
+    fn = jax.jit(lambda x, y: pairwise_euclidean_distance(x, y, reduction="mean"))
+    out = fn(jnp.asarray(X), jnp.asarray(Y))
+    expected = sk_euclidean(X, Y).mean(axis=-1)
+    assert np.allclose(np.asarray(out), expected, atol=1e-3)

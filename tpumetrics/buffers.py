@@ -89,8 +89,13 @@ def buffer_append(buf: MaskedBuffer, batch: Array, valid: Optional[Array] = None
 
 def buffer_extend(buf: MaskedBuffer, other: MaskedBuffer) -> MaskedBuffer:
     """Append another buffer's valid rows (used when merging a batch state
-    into a global state, e.g. ``forward``'s reduce-state merge)."""
-    return buffer_append(buf, other.values, valid=other.valid_mask())
+    into a global state, e.g. ``forward``'s reduce-state merge).
+
+    Overflow accounting carries over: rows the *source* buffer already
+    dropped stay visible in the merged ``requested``, so
+    :func:`buffer_overflowed` cannot be laundered away by a merge."""
+    merged = buffer_append(buf, other.values, valid=other.valid_mask())
+    return merged._replace(requested=buf.requested + other.requested)
 
 
 def buffer_compact(stacked_values: Array, counts: Array) -> MaskedBuffer:
@@ -153,16 +158,24 @@ def materialize(buf: MaskedBuffer) -> Array:
     return buf.values[: int(buf.count)]
 
 
-def masked_values(state: Any) -> Tuple[Array, Array]:
+def masked_values(
+    state: Any, feature_shape: Tuple[int, ...] = (), dtype: Any = jnp.float32
+) -> Tuple[Array, Array]:
     """Uniform (values, valid_mask) view of a cat-style state: a Python list
-    of arrays (eager path — all rows valid) or a MaskedBuffer (jit path)."""
+    of arrays (eager path — all rows valid) or a MaskedBuffer (jit path).
+
+    ``feature_shape``/``dtype`` shape the zero-row result for an *empty* eager
+    list (an empty list carries no shape information of its own); pass the
+    state's declared spec so empty and non-empty states produce consistent
+    downstream shapes and trace signatures.
+    """
     from tpumetrics.utils.data import dim_zero_cat
 
     if isinstance(state, MaskedBuffer):
         return state.values, state.valid_mask()
     if isinstance(state, list):
         if not state:  # empty eager state mirrors an empty buffer, not an error
-            return jnp.zeros((0,)), jnp.zeros((0,), dtype=bool)
+            return jnp.zeros((0,) + tuple(feature_shape), dtype=dtype), jnp.zeros((0,), dtype=bool)
         cat = dim_zero_cat(state)
         return cat, jnp.ones((cat.shape[0],), dtype=bool)
     if isinstance(state, (jnp.ndarray, jax.Array)):

@@ -1,0 +1,16 @@
+"""Pairwise functional family (counterpart of reference
+``functional/pairwise/``, 5 public functions)."""
+
+from tpumetrics.functional.pairwise.cosine import pairwise_cosine_similarity
+from tpumetrics.functional.pairwise.euclidean import pairwise_euclidean_distance
+from tpumetrics.functional.pairwise.linear import pairwise_linear_similarity
+from tpumetrics.functional.pairwise.manhattan import pairwise_manhattan_distance
+from tpumetrics.functional.pairwise.minkowski import pairwise_minkowski_distance
+
+__all__ = [
+    "pairwise_cosine_similarity",
+    "pairwise_euclidean_distance",
+    "pairwise_linear_similarity",
+    "pairwise_manhattan_distance",
+    "pairwise_minkowski_distance",
+]

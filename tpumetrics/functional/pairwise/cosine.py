@@ -1,0 +1,47 @@
+"""Pairwise cosine similarity (counterpart of reference
+``functional/pairwise/cosine.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+from tpumetrics.utils.compute import _safe_matmul
+
+Array = jax.Array
+
+
+def _pairwise_cosine_similarity_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Row-normalize then one MXU matmul (reference cosine.py:24-45)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x = x / jnp.linalg.norm(x, axis=1, keepdims=True)
+    y = y / jnp.linalg.norm(y, axis=1, keepdims=True)
+    distance = _safe_matmul(x, y)
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_cosine_similarity(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise cosine similarity between rows of ``x`` and ``y`` (or of ``x``
+    with itself when ``y`` is omitted).
+
+    Example:
+        >>> import numpy as np
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.pairwise import pairwise_cosine_similarity
+        >>> x = jnp.asarray([[2., 3], [3, 5], [5, 8]])
+        >>> y = jnp.asarray([[1., 0], [2, 1]])
+        >>> np.round(np.asarray(pairwise_cosine_similarity(x, y), dtype=np.float64), 4).tolist()
+        [[0.5547, 0.8682], [0.5145, 0.8437], [0.53, 0.8533]]
+    """
+    distance = _pairwise_cosine_similarity_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

@@ -1,0 +1,57 @@
+"""Shared helpers for the pairwise functional family (counterpart of the
+reference's ``functional/pairwise/helpers.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _check_input(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Tuple[Array, Array, bool]:
+    """Validate shapes and resolve the ``zero_diagonal`` default
+    (reference helpers.py:19-43): ``True`` for the self-similarity case
+    (``y is None``), else ``False``."""
+    x = jnp.asarray(x)
+    if x.ndim != 2:
+        raise ValueError(f"Expected argument `x` to be a 2D tensor of shape `[N, d]` but got {x.shape}")
+    if y is not None:
+        y = jnp.asarray(y)
+        if y.ndim != 2 or y.shape[1] != x.shape[1]:
+            raise ValueError(
+                "Expected argument `y` to be a 2D tensor of shape `[M, d]` where"
+                " `d` should be same as the last dimension of `x`"
+            )
+        zero_diagonal = False if zero_diagonal is None else zero_diagonal
+    else:
+        y = x
+        zero_diagonal = True if zero_diagonal is None else zero_diagonal
+    return x, y, zero_diagonal
+
+
+def _zero_diagonal(distance: Array, zero_diagonal: bool) -> Array:
+    """Functionally zero the diagonal (the reference mutates in place with
+    ``fill_diagonal_``; arrays are immutable here, and a where-mask fuses into
+    the surrounding XLA computation)."""
+    if not zero_diagonal:
+        return distance
+    n, m = distance.shape
+    eye = jnp.eye(n, m, dtype=bool)
+    return jnp.where(eye, jnp.zeros((), dtype=distance.dtype), distance)
+
+
+def _reduce_distance_matrix(distmat: Array, reduction: Optional[str] = None) -> Array:
+    """Row-wise mean/sum/none reduction of an ``[N, M]`` distance matrix
+    (reference helpers.py:46-60)."""
+    if reduction == "mean":
+        return distmat.mean(axis=-1)
+    if reduction == "sum":
+        return distmat.sum(axis=-1)
+    if reduction is None or reduction == "none":
+        return distmat
+    raise ValueError(f"Expected reduction to be one of `['mean', 'sum', None]` but got {reduction}")

@@ -1,0 +1,42 @@
+"""Pairwise linear similarity (counterpart of reference
+``functional/pairwise/linear.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from tpumetrics.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+from tpumetrics.utils.compute import _safe_matmul
+
+Array = jax.Array
+
+
+def _pairwise_linear_similarity_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Plain inner-product kernel — one MXU matmul (reference linear.py:23-40)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = _safe_matmul(x, y)
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_linear_similarity(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise linear similarity ``<x_i, y_j>`` between rows.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.pairwise import pairwise_linear_similarity
+        >>> x = jnp.asarray([[2., 3], [3, 5], [5, 8]])
+        >>> y = jnp.asarray([[1., 0], [2, 1]])
+        >>> pairwise_linear_similarity(x, y).tolist()
+        [[2.0, 7.0], [3.0, 11.0], [5.0, 18.0]]
+    """
+    distance = _pairwise_linear_similarity_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

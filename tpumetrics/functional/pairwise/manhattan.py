@@ -1,0 +1,44 @@
+"""Pairwise manhattan distance (counterpart of reference
+``functional/pairwise/manhattan.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+
+Array = jax.Array
+
+
+def _pairwise_manhattan_distance_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Broadcasted |x_i - y_j| contraction (reference manhattan.py:23-39)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x = x.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    distance = jnp.abs(x[:, None, :] - y[None, :, :]).sum(axis=-1)
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_manhattan_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise manhattan (L1) distance between rows.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.pairwise import pairwise_manhattan_distance
+        >>> x = jnp.asarray([[2., 3], [3, 5], [5, 8]])
+        >>> y = jnp.asarray([[1., 0], [2, 1]])
+        >>> pairwise_manhattan_distance(x, y).tolist()
+        [[4.0, 2.0], [7.0, 5.0], [12.0, 10.0]]
+    """
+    distance = _pairwise_manhattan_distance_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

@@ -18,8 +18,10 @@ Array = jax.Array
 
 
 def _safe_matmul(x: Array, y: Array) -> Array:
-    """Matmul; in float32 (or bf16) on TPU this maps straight onto the MXU."""
-    return x @ y.T
+    """Matmul at HIGHEST precision: on TPU the MXU's default fast path
+    truncates fp32 operands to bf16, which is visibly lossy for metric values;
+    HIGHEST selects the fp32-accurate (multi-pass) MXU mode."""
+    return jnp.matmul(x, y.T, precision=jax.lax.Precision.HIGHEST)
 
 
 def _safe_xlogy(x: Array, y: Array) -> Array:
