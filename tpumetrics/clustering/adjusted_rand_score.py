@@ -1,0 +1,35 @@
+"""AdjustedRandScore (counterpart of reference ``clustering/adjusted_rand_score.py``)."""
+
+from __future__ import annotations
+
+import jax
+
+from tpumetrics.clustering.base import _LabelPairClusterMetric
+from tpumetrics.functional.clustering.adjusted_rand_score import adjusted_rand_score
+
+Array = jax.Array
+
+
+class AdjustedRandScore(_LabelPairClusterMetric):
+    """Chance-adjusted Rand score between cluster assignments.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.clustering import AdjustedRandScore
+        >>> metric = AdjustedRandScore()
+        >>> round(float(metric(jnp.asarray([0, 0, 1, 2]), jnp.asarray([0, 0, 1, 1]))), 4)
+        0.5714
+    """
+
+    plot_lower_bound: float = -0.5
+    plot_upper_bound: float = 1.0
+
+    def compute(self) -> Array:
+        preds, target, mask = self._catted()
+        return adjusted_rand_score(
+            preds,
+            target,
+            num_classes_preds=self.num_classes_preds,
+            num_classes_target=self.num_classes_target,
+            mask=mask,
+        )

@@ -1,0 +1,100 @@
+"""Shared bases for clustering metrics.
+
+The reference repeats the same two-list-state skeleton in every clustering
+class (e.g. ``clustering/mutual_info_score.py:85-100``); here it is factored
+into two bases. Both keep "cat" list states; declare a ``capacity`` via
+``set_state_capacity`` to run the update through the fixed-capacity masked
+buffers on the jit path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.buffers import _BufferList
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+def _state_values_and_mask(state: Any) -> Tuple[Array, Optional[Array]]:
+    """(values, valid_mask) of a cat state: mask is None on the exact eager
+    path, and the buffer's validity mask on the fixed-capacity jit path."""
+    if isinstance(state, _BufferList):
+        return state.buffer.values, state.buffer.valid_mask()
+    return dim_zero_cat(state), None
+
+
+class _LabelPairClusterMetric(Metric):
+    """Base for extrinsic metrics fed (preds, target) cluster-label pairs.
+
+    ``num_classes_preds``/``num_classes_target`` (TPU extension, absent in
+    the reference) declare a static class space so ``compute`` runs fully
+    inside jit/shard_map; without them compute sizes the contingency matrix
+    from the observed labels eagerly, exactly like the reference.
+    """
+
+    is_differentiable: bool = True
+    higher_is_better: Optional[bool] = True
+    full_state_update: bool = False
+
+    preds: List[Array]
+    target: List[Array]
+
+    def __init__(
+        self,
+        num_classes_preds: Optional[int] = None,
+        num_classes_target: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes_preds = num_classes_preds
+        self.num_classes_target = num_classes_target
+        self.add_state("preds", default=[], dist_reduce_fx="cat", feature_dtype=jnp.int32)
+        self.add_state("target", default=[], dist_reduce_fx="cat", feature_dtype=jnp.int32)
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Append a batch of predicted and ground-truth cluster labels."""
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def _catted(self) -> tuple:
+        """(preds, target, valid_mask) of the accumulated labels. The mask is
+        None unless the states run through fixed-capacity buffers (jit path),
+        where invalid rows must be excluded by the contingency builders."""
+        preds, mask = _state_values_and_mask(self.preds)
+        target, _ = _state_values_and_mask(self.target)
+        return preds, target, mask
+
+
+class _IntrinsicClusterMetric(Metric):
+    """Base for intrinsic metrics fed (data, labels): embedded vectors plus
+    one clustering."""
+
+    is_differentiable: bool = True
+    higher_is_better: Optional[bool] = True
+    full_state_update: bool = False
+
+    data: List[Array]
+    labels: List[Array]
+
+    def __init__(self, num_labels: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_labels = num_labels
+        self.add_state("data", default=[], dist_reduce_fx="cat")
+        self.add_state("labels", default=[], dist_reduce_fx="cat", feature_dtype=jnp.int32)
+
+    def update(self, data: Array, labels: Array) -> None:
+        """Append a batch of embedded data points and their cluster labels."""
+        self.data.append(data)
+        self.labels.append(labels)
+
+    def _catted(self) -> tuple:
+        """(data, labels, valid_mask); see _LabelPairClusterMetric._catted."""
+        data, mask = _state_values_and_mask(self.data)
+        labels, _ = _state_values_and_mask(self.labels)
+        return data, labels, mask

@@ -1,0 +1,31 @@
+"""CalinskiHarabaszScore (counterpart of reference
+``clustering/calinski_harabasz_score.py``)."""
+
+from __future__ import annotations
+
+import jax
+
+from tpumetrics.clustering.base import _IntrinsicClusterMetric
+from tpumetrics.functional.clustering.calinski_harabasz_score import calinski_harabasz_score
+
+Array = jax.Array
+
+
+class CalinskiHarabaszScore(_IntrinsicClusterMetric):
+    """Calinski-Harabasz (variance-ratio) score of a clustering.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.clustering import CalinskiHarabaszScore
+        >>> data = jnp.asarray([[0., 0], [1.1, 0], [0, 1], [2, 2], [2.2, 2.1], [2, 2.2]])
+        >>> labels = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> metric = CalinskiHarabaszScore()
+        >>> round(float(metric(data, labels)), 2)
+        23.73
+    """
+
+    plot_lower_bound: float = 0.0
+
+    def compute(self) -> Array:
+        data, labels, mask = self._catted()
+        return calinski_harabasz_score(data, labels, num_labels=self.num_labels, mask=mask)

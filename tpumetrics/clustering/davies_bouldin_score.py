@@ -1,0 +1,32 @@
+"""DaviesBouldinScore (counterpart of reference
+``clustering/davies_bouldin_score.py``)."""
+
+from __future__ import annotations
+
+import jax
+
+from tpumetrics.clustering.base import _IntrinsicClusterMetric
+from tpumetrics.functional.clustering.davies_bouldin_score import davies_bouldin_score
+
+Array = jax.Array
+
+
+class DaviesBouldinScore(_IntrinsicClusterMetric):
+    """Davies-Bouldin score of a clustering (lower is better).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.clustering import DaviesBouldinScore
+        >>> data = jnp.asarray([[0., 0], [1.1, 0], [0, 1], [2, 2], [2.2, 2.1], [2, 2.2]])
+        >>> labels = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> metric = DaviesBouldinScore()
+        >>> round(float(metric(data, labels)), 4)
+        0.3311
+    """
+
+    higher_is_better: bool = False
+    plot_lower_bound: float = 0.0
+
+    def compute(self) -> Array:
+        data, labels, mask = self._catted()
+        return davies_bouldin_score(data, labels, num_labels=self.num_labels, mask=mask)

@@ -1,0 +1,39 @@
+"""DunnIndex (counterpart of reference ``clustering/dunn_index.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from tpumetrics.clustering.base import _IntrinsicClusterMetric
+from tpumetrics.functional.clustering.dunn_index import dunn_index
+
+Array = jax.Array
+
+
+class DunnIndex(_IntrinsicClusterMetric):
+    """Dunn index of a clustering (higher is better).
+
+    Args:
+        p: p-norm used for the distance metric.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.clustering import DunnIndex
+        >>> data = jnp.asarray([[0., 0], [0.5, 0], [1, 0], [0.5, 1]])
+        >>> labels = jnp.asarray([0, 0, 0, 1])
+        >>> metric = DunnIndex(p=2)
+        >>> float(metric(data, labels))
+        2.0
+    """
+
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, p: float = 2, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.p = p
+
+    def compute(self) -> Array:
+        data, labels, mask = self._catted()
+        return dunn_index(data, labels, p=self.p, num_labels=self.num_labels, mask=mask)

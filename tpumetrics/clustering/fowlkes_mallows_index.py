@@ -1,0 +1,36 @@
+"""FowlkesMallowsIndex (counterpart of reference
+``clustering/fowlkes_mallows_index.py``)."""
+
+from __future__ import annotations
+
+import jax
+
+from tpumetrics.clustering.base import _LabelPairClusterMetric
+from tpumetrics.functional.clustering.fowlkes_mallows_index import fowlkes_mallows_index
+
+Array = jax.Array
+
+
+class FowlkesMallowsIndex(_LabelPairClusterMetric):
+    """Fowlkes-Mallows index between cluster assignments.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.clustering import FowlkesMallowsIndex
+        >>> metric = FowlkesMallowsIndex()
+        >>> round(float(metric(jnp.asarray([2, 2, 0, 1, 0]), jnp.asarray([2, 2, 1, 1, 0]))), 4)
+        0.5
+    """
+
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def compute(self) -> Array:
+        preds, target, mask = self._catted()
+        return fowlkes_mallows_index(
+            preds,
+            target,
+            num_classes_preds=self.num_classes_preds,
+            num_classes_target=self.num_classes_target,
+            mask=mask,
+        )

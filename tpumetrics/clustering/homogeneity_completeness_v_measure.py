@@ -1,0 +1,102 @@
+"""HomogeneityScore / CompletenessScore / VMeasureScore (counterpart of
+reference ``clustering/homogeneity_completeness_v_measure.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from tpumetrics.clustering.base import _LabelPairClusterMetric
+from tpumetrics.functional.clustering.homogeneity_completeness_v_measure import (
+    completeness_score,
+    homogeneity_score,
+    v_measure_score,
+)
+
+Array = jax.Array
+
+
+class HomogeneityScore(_LabelPairClusterMetric):
+    """Homogeneity: each predicted cluster contains only members of one class.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.clustering import HomogeneityScore
+        >>> metric = HomogeneityScore()
+        >>> round(float(metric(jnp.asarray([0, 0, 1, 2]), jnp.asarray([0, 0, 1, 1]))), 4)
+        1.0
+    """
+
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def compute(self) -> Array:
+        preds, target, mask = self._catted()
+        return homogeneity_score(
+            preds,
+            target,
+            num_classes_preds=self.num_classes_preds,
+            num_classes_target=self.num_classes_target,
+            mask=mask,
+        )
+
+
+class CompletenessScore(_LabelPairClusterMetric):
+    """Completeness: all members of a class land in the same predicted cluster.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.clustering import CompletenessScore
+        >>> metric = CompletenessScore()
+        >>> round(float(metric(jnp.asarray([0, 0, 1, 2]), jnp.asarray([0, 0, 1, 1]))), 4)
+        0.6667
+    """
+
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def compute(self) -> Array:
+        preds, target, mask = self._catted()
+        return completeness_score(
+            preds,
+            target,
+            num_classes_preds=self.num_classes_preds,
+            num_classes_target=self.num_classes_target,
+            mask=mask,
+        )
+
+
+class VMeasureScore(_LabelPairClusterMetric):
+    """V-measure: harmonic mean of homogeneity and completeness.
+
+    Args:
+        beta: weight of homogeneity in the harmonic mean.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.clustering import VMeasureScore
+        >>> metric = VMeasureScore(beta=1.0)
+        >>> round(float(metric(jnp.asarray([0, 0, 1, 2]), jnp.asarray([0, 0, 1, 1]))), 4)
+        0.8
+    """
+
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, beta: float = 1.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(beta, (int, float)) and beta > 0):
+            raise ValueError(f"Argument `beta` should be a positive float. Got {beta}.")
+        self.beta = float(beta)
+
+    def compute(self) -> Array:
+        preds, target, mask = self._catted()
+        return v_measure_score(
+            preds,
+            target,
+            beta=self.beta,
+            num_classes_preds=self.num_classes_preds,
+            num_classes_target=self.num_classes_target,
+            mask=mask,
+        )

@@ -1,0 +1,36 @@
+"""MutualInfoScore (counterpart of reference ``clustering/mutual_info_score.py:50``)."""
+
+from __future__ import annotations
+
+import jax
+
+from tpumetrics.clustering.base import _LabelPairClusterMetric
+from tpumetrics.functional.clustering.mutual_info_score import mutual_info_score
+
+Array = jax.Array
+
+
+class MutualInfoScore(_LabelPairClusterMetric):
+    """Mutual information between cluster assignments.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.clustering import MutualInfoScore
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> mi = MutualInfoScore()
+        >>> round(float(mi(preds, target)), 4)
+        0.5004
+    """
+
+    plot_lower_bound: float = 0.0
+
+    def compute(self) -> Array:
+        preds, target, mask = self._catted()
+        return mutual_info_score(
+            preds,
+            target,
+            num_classes_preds=self.num_classes_preds,
+            num_classes_target=self.num_classes_target,
+            mask=mask,
+        )

@@ -1,0 +1,51 @@
+"""NormalizedMutualInfoScore (counterpart of reference
+``clustering/normalized_mutual_info_score.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from tpumetrics.clustering.base import _LabelPairClusterMetric
+from tpumetrics.functional.clustering.normalized_mutual_info_score import normalized_mutual_info_score
+from tpumetrics.functional.clustering.utils import _validate_average_method_arg
+
+Array = jax.Array
+
+
+class NormalizedMutualInfoScore(_LabelPairClusterMetric):
+    """Normalized mutual information between cluster assignments.
+
+    Args:
+        average_method: normalizer computation method
+            (``min``/``geometric``/``arithmetic``/``max``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.clustering import NormalizedMutualInfoScore
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> nmi = NormalizedMutualInfoScore("arithmetic")
+        >>> round(float(nmi(preds, target)), 4)
+        0.4744
+    """
+
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, average_method: str = "arithmetic", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_average_method_arg(average_method)
+        self.average_method = average_method
+
+    def compute(self) -> Array:
+        preds, target, mask = self._catted()
+        return normalized_mutual_info_score(
+            preds,
+            target,
+            self.average_method,
+            num_classes_preds=self.num_classes_preds,
+            num_classes_target=self.num_classes_target,
+            mask=mask,
+        )

@@ -1,0 +1,36 @@
+"""RandScore (counterpart of reference ``clustering/rand_score.py``)."""
+
+from __future__ import annotations
+
+import jax
+
+from tpumetrics.clustering.base import _LabelPairClusterMetric
+from tpumetrics.functional.clustering.rand_score import rand_score
+
+Array = jax.Array
+
+
+class RandScore(_LabelPairClusterMetric):
+    """Rand score between cluster assignments.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.clustering import RandScore
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> metric = RandScore()
+        >>> round(float(metric(preds, target)), 4)
+        0.6
+    """
+
+    plot_lower_bound: float = 0.0
+
+    def compute(self) -> Array:
+        preds, target, mask = self._catted()
+        return rand_score(
+            preds,
+            target,
+            num_classes_preds=self.num_classes_preds,
+            num_classes_target=self.num_classes_target,
+            mask=mask,
+        )

@@ -1,0 +1,156 @@
+"""Adjusted mutual information (counterpart of reference
+``functional/clustering/adjusted_mutual_info_score.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.functional.clustering.mutual_info_score import (
+    _mutual_info_score_compute,
+    _mutual_info_score_update,
+)
+from tpumetrics.functional.clustering.utils import (
+    _validate_average_method_arg,
+    calculate_entropy,
+    calculate_generalized_mean,
+)
+from tpumetrics.utils.data import _is_tracer
+
+Array = jax.Array
+
+
+def adjusted_mutual_info_score(
+    preds: Array,
+    target: Array,
+    average_method: str = "arithmetic",
+    num_classes_preds: Optional[int] = None,
+    num_classes_target: Optional[int] = None,
+    mask: Optional[Array] = None,
+) -> Array:
+    """AMI = (MI - E[MI]) / (gen-mean(H(U), H(V)) - E[MI]) (reference :27-62).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.clustering import adjusted_mutual_info_score
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> round(float(adjusted_mutual_info_score(preds, target, "arithmetic")), 2)
+        -0.25
+    """
+    _validate_average_method_arg(average_method)
+    contingency = _mutual_info_score_update(preds, target, num_classes_preds, num_classes_target, mask)
+    mutual_info = _mutual_info_score_compute(contingency)
+    # true sample count = valid rows only; the static row count still bounds
+    # the n_ij grid under jit
+    n_samples = jnp.sum(contingency)
+    expected_mutual_info = expected_mutual_info_score(contingency, n_samples, nij_bound=preds.shape[0] + 1)
+    normalizer = calculate_generalized_mean(
+        jnp.stack([
+            calculate_entropy(preds, num_classes=num_classes_preds, mask=mask),
+            calculate_entropy(target, num_classes=num_classes_target, mask=mask),
+        ]),
+        average_method,
+    )
+    denominator = normalizer - expected_mutual_info
+    eps = jnp.finfo(jnp.float32).eps
+    # sign-preserving clamp away from 0 (reference :56-60), branch-free
+    denominator = jnp.where(
+        denominator < 0, jnp.minimum(denominator, -eps), jnp.maximum(denominator, eps)
+    )
+    return (mutual_info - expected_mutual_info) / denominator
+
+
+def expected_mutual_info_score(
+    contingency: Array, n_samples: Any, nij_bound: Optional[int] = None
+) -> Array:
+    """Expected MI of two random clusterings with fixed marginals
+    (hypergeometric model; reference :65-121 ports sklearn's triple-loop
+    Cython).
+
+    Fully vectorized over the ``(rows, cols, n_ij)`` grid with a validity
+    mask — no Python loops. Off-trace the sum runs in float64 on host (the
+    lgamma-difference terms lose ~3 digits in fp32); under jit a fp32 XLA
+    version of the same masked grid is used, with ``nij_bound`` as the static
+    grid size (``n_samples`` itself may be data-dependent there, e.g. the
+    valid count of a masked buffer).
+    """
+    if not _is_tracer(contingency) and not _is_tracer(n_samples):
+        return jnp.asarray(_expected_mutual_info_host(np.asarray(contingency, dtype=np.float64), int(n_samples)))
+    if nij_bound is None:
+        raise ValueError("expected_mutual_info_score under jit needs a static `nij_bound` grid size.")
+    return _expected_mutual_info_grid(
+        jnp, jax.lax.lgamma, contingency.astype(jnp.float32), n_samples, nij_hi=nij_bound
+    )
+
+
+_EMI_HOST_CHUNK = 8192  # n_ij rows per host chunk — bounds peak memory
+
+
+def _expected_mutual_info_host(contingency: "np.ndarray", n_samples: int) -> "np.ndarray":
+    """Host float64 EMI. The grid's n_ij axis only needs to reach the largest
+    marginal (n_ij <= min(a_i, b_j)), and is evaluated in chunks so epoch-scale
+    sample counts stay at O(R*C*chunk) memory instead of O(R*C*n)."""
+    from scipy.special import gammaln
+
+    a = contingency.sum(axis=1)
+    b = contingency.sum(axis=0)
+    if a.shape[0] == 1 or b.shape[0] == 1:
+        return np.float32(0.0)
+    m = int(max(a.max(), b.max())) + 1
+    total = 0.0
+    for lo in range(0, m, _EMI_HOST_CHUNK):
+        hi = min(lo + _EMI_HOST_CHUNK, m)
+        total += float(_expected_mutual_info_grid(np, gammaln, contingency, n_samples, nij_lo=lo, nij_hi=hi))
+    return np.float32(total)
+
+
+def _expected_mutual_info_grid(xp, lgamma, contingency, n_samples, nij_lo: int = 0, nij_hi: Optional[int] = None):
+    """One masked (R, C, M) grid evaluation of the EMI sum over the n_ij
+    window ``[nij_lo, nij_hi)``, shared between the host float64 and
+    on-device float32 paths. ``n_samples`` may be a traced scalar."""
+    a = contingency.sum(axis=1)  # (R,) target marginals
+    b = contingency.sum(axis=0)  # (C,) preds marginals
+    if a.shape[0] == 1 or b.shape[0] == 1:
+        return xp.zeros(())
+
+    n = xp.asarray(n_samples, dtype=contingency.dtype)
+    nijs = xp.arange(nij_lo, nij_hi, dtype=contingency.dtype)
+    safe_nijs = xp.where(nijs == 0, 1.0, nijs)  # nijs[0] only matters masked-out
+
+    start = xp.maximum(1.0, a[:, None] + b[None, :] - n)  # (R, C)
+    end = xp.minimum(a[:, None], b[None, :]) + 1
+    mask = (nijs[None, None, :] >= start[:, :, None]) & (nijs[None, None, :] < end[:, :, None])
+
+    safe_a = xp.where(a > 0, a, 1.0)
+    safe_b = xp.where(b > 0, b, 1.0)
+    term1 = nijs / n
+    log_nnij = xp.log(n) + xp.log(safe_nijs)
+    term2 = log_nnij[None, None, :] - xp.log(safe_a)[:, None, None] - xp.log(safe_b)[None, :, None]
+
+    gln_a = lgamma(safe_a + 1)
+    gln_b = lgamma(safe_b + 1)
+    gln_na = lgamma(xp.maximum(n - a, 0) + 1)
+    gln_nb = lgamma(xp.maximum(n - b, 0) + 1)
+    gln_nnij = lgamma(nijs + 1) + lgamma(n + 1)
+
+    # lgamma poles at non-positive args only occur off-mask; sanitize first
+    arg_an = xp.where(mask, a[:, None, None] - nijs[None, None, :] + 1, 1.0)
+    arg_bn = xp.where(mask, b[None, :, None] - nijs[None, None, :] + 1, 1.0)
+    arg_nabn = xp.where(mask, n - a[:, None, None] - b[None, :, None] + nijs[None, None, :] + 1, 1.0)
+
+    gln = (
+        gln_a[:, None, None]
+        + gln_b[None, :, None]
+        + gln_na[:, None, None]
+        + gln_nb[None, :, None]
+        - gln_nnij[None, None, :]
+        - lgamma(arg_an)
+        - lgamma(arg_bn)
+        - lgamma(arg_nabn)
+    )
+    terms = term1[None, None, :] * term2 * xp.exp(gln)
+    return xp.sum(xp.where(mask, terms, 0.0))

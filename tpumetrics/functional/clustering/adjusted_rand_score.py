@@ -1,0 +1,63 @@
+"""Adjusted Rand score (counterpart of reference
+``functional/clustering/adjusted_rand_score.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.clustering.utils import (
+    calculate_contingency_matrix,
+    calculate_pair_cluster_confusion_matrix,
+    check_cluster_labels,
+)
+
+Array = jax.Array
+
+
+def _adjusted_rand_score_update(
+    preds: Array,
+    target: Array,
+    num_classes_preds: Optional[int] = None,
+    num_classes_target: Optional[int] = None,
+    mask: Optional[Array] = None,
+) -> Array:
+    check_cluster_labels(preds, target)
+    return calculate_contingency_matrix(
+        preds, target, num_classes_preds=num_classes_preds, num_classes_target=num_classes_target, mask=mask
+    )
+
+
+def _adjusted_rand_score_compute(contingency: Array) -> Array:
+    """ARI from the 2x2 pair matrix; perfect-agreement degenerate case
+    (fn == fp == 0) maps to 1.0 via where (reference adjusted_rand_score.py:39-52)."""
+    pair_matrix = calculate_pair_cluster_confusion_matrix(contingency=contingency)
+    tn, fp = pair_matrix[0, 0], pair_matrix[0, 1]
+    fn, tp = pair_matrix[1, 0], pair_matrix[1, 1]
+    denominator = (tp + fn) * (fn + tn) + (tp + fp) * (fp + tn)
+    degenerate = (fn == 0) & (fp == 0)
+    safe_den = jnp.where(denominator == 0, 1.0, denominator)
+    return jnp.where(degenerate, 1.0, 2.0 * (tp * tn - fn * fp) / safe_den).astype(jnp.float32)
+
+
+def adjusted_rand_score(
+    preds: Array,
+    target: Array,
+    num_classes_preds: Optional[int] = None,
+    num_classes_target: Optional[int] = None,
+    mask: Optional[Array] = None,
+) -> Array:
+    """Adjusted Rand score between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.clustering import adjusted_rand_score
+        >>> float(adjusted_rand_score(jnp.asarray([0, 0, 1, 1]), jnp.asarray([0, 0, 1, 1])))
+        1.0
+        >>> round(float(adjusted_rand_score(jnp.asarray([0, 0, 1, 2]), jnp.asarray([0, 0, 1, 1]))), 4)
+        0.5714
+    """
+    contingency = _adjusted_rand_score_update(preds, target, num_classes_preds, num_classes_target, mask)
+    return _adjusted_rand_score_compute(contingency)

@@ -1,0 +1,53 @@
+"""Calinski-Harabasz score (counterpart of reference
+``functional/clustering/calinski_harabasz_score.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.clustering.utils import (
+    _cluster_centroids,
+    _validate_intrinsic_cluster_data,
+    _validate_intrinsic_labels_to_samples,
+    _zero_index_labels,
+)
+
+Array = jax.Array
+
+
+def calinski_harabasz_score(
+    data: Array, labels: Array, num_labels: Optional[int] = None, mask: Optional[Array] = None
+) -> Array:
+    """Variance-ratio criterion for a clustering of embedded data.
+
+    The reference (calinski_harabasz_score.py:24-62) loops over clusters in
+    Python; here both dispersions come from two ``segment_sum`` calls —
+    static-shape, one XLA program, jit-safe when ``num_labels`` is given
+    (labels then assumed zero-indexed).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.clustering import calinski_harabasz_score
+        >>> data = jnp.asarray([[0., 0], [1.1, 0], [0, 1], [2, 2], [2.2, 2.1], [2, 2.2]])
+        >>> labels = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> round(float(calinski_harabasz_score(data, labels)), 2)
+        23.73
+    """
+    data = jnp.asarray(data)
+    labels = jnp.asarray(labels)
+    _validate_intrinsic_cluster_data(data, labels)
+    labels, k = _zero_index_labels(labels, num_labels)
+    w = jnp.ones((data.shape[0],), data.dtype) if mask is None else mask.astype(data.dtype)
+    num_samples = data.shape[0] if mask is None else jnp.sum(mask)
+    _validate_intrinsic_labels_to_samples(k, num_samples)
+
+    mean = jnp.sum(data * w[:, None], axis=0) / jnp.sum(w)
+    centroids, counts = _cluster_centroids(data, labels, k, mask=mask)
+    between = jnp.sum(counts * jnp.sum((centroids - mean[None, :]) ** 2, axis=1))
+    within = jnp.sum(w[:, None] * (data - centroids[jnp.clip(labels, 0, k - 1)]) ** 2)
+    safe_within = jnp.where(within == 0, 1.0, within)
+    score = between * (num_samples - k) / (safe_within * (k - 1.0))
+    return jnp.where(within == 0, 1.0, score).astype(jnp.float32)

@@ -1,0 +1,59 @@
+"""Davies-Bouldin score (counterpart of reference
+``functional/clustering/davies_bouldin_score.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.clustering.utils import (
+    _cluster_centroids,
+    _mask_labels,
+    _validate_intrinsic_cluster_data,
+    _validate_intrinsic_labels_to_samples,
+    _zero_index_labels,
+)
+
+Array = jax.Array
+
+
+def davies_bouldin_score(
+    data: Array, labels: Array, num_labels: Optional[int] = None, mask: Optional[Array] = None
+) -> Array:
+    """Average worst-case ratio of within-cluster to between-cluster distances.
+
+    The reference (davies_bouldin_score.py:23-67) loops per cluster; here
+    intra-cluster mean distances come from one ``segment_sum`` and centroid
+    distances from one pairwise matrix — jit-safe with static ``num_labels``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.clustering import davies_bouldin_score
+        >>> data = jnp.asarray([[0., 0], [1.1, 0], [0, 1], [2, 2], [2.2, 2.1], [2, 2.2]])
+        >>> labels = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> round(float(davies_bouldin_score(data, labels)), 4)
+        0.3311
+    """
+    data = jnp.asarray(data)
+    labels = jnp.asarray(labels)
+    _validate_intrinsic_cluster_data(data, labels)
+    labels, k = _zero_index_labels(labels, num_labels)
+    num_samples = data.shape[0] if mask is None else jnp.sum(mask)
+    _validate_intrinsic_labels_to_samples(k, num_samples)
+
+    centroids, counts = _cluster_centroids(data, labels, k, mask=mask)
+    seg_labels = _mask_labels(labels, k, mask)
+    dists = jnp.linalg.norm(data - centroids[jnp.clip(labels, 0, k - 1)], axis=1)
+    safe_counts = jnp.where(counts > 0, counts, 1.0)
+    intra = jax.ops.segment_sum(dists, seg_labels, num_segments=k) / safe_counts
+
+    diff = centroids[:, None, :] - centroids[None, :, :]
+    centroid_distances = jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, axis=-1), 0.0))
+
+    degenerate = jnp.isclose(intra, 0.0).all() | jnp.isclose(centroid_distances, 0.0).all()
+    centroid_distances = jnp.where(centroid_distances == 0, jnp.inf, centroid_distances)
+    combined = intra[None, :] + intra[:, None]
+    scores = jnp.max(combined / centroid_distances, axis=1)
+    return jnp.where(degenerate, 0.0, scores.mean()).astype(jnp.float32)

@@ -1,0 +1,56 @@
+"""Dunn index (counterpart of reference ``functional/clustering/dunn_index.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.clustering.utils import _cluster_centroids, _mask_labels, _zero_index_labels
+
+Array = jax.Array
+
+
+def _dunn_index_update(
+    data: Array, labels: Array, p: float, num_labels: Optional[int] = None, mask: Optional[Array] = None
+) -> Tuple[Array, Array]:
+    """Centroid p-norm distances (all pairs, masked to i<j) + per-cluster max
+    point-to-centroid distance via ``segment_max`` — no Python loops over
+    clusters (reference dunn_index.py:21-46 builds per-cluster Python lists)."""
+    labels, k = _zero_index_labels(labels, num_labels)
+    centroids, _ = _cluster_centroids(data, labels, k, mask=mask)
+    seg_labels = _mask_labels(labels, k, mask)
+
+    diff = jnp.abs(centroids[:, None, :] - centroids[None, :, :])
+    inter = jnp.sum(diff**p, axis=-1) ** (1.0 / p)  # (K, K) ord=p vector norm
+    iu = jnp.triu_indices(k, 1)
+    intercluster_distance = inter[iu]
+
+    point_dist = jnp.sum(jnp.abs(data - centroids[jnp.clip(labels, 0, k - 1)]) ** p, axis=-1) ** (1.0 / p)
+    max_intracluster_distance = jax.ops.segment_max(point_dist, seg_labels, num_segments=k)
+    return intercluster_distance, max_intracluster_distance
+
+
+def _dunn_index_compute(intercluster_distance: Array, max_intracluster_distance: Array) -> Array:
+    """min inter-cluster / max intra-cluster (reference :50-60)."""
+    return intercluster_distance.min() / max_intracluster_distance.max()
+
+
+def dunn_index(
+    data: Array, labels: Array, p: float = 2, num_labels: Optional[int] = None, mask: Optional[Array] = None
+) -> Array:
+    """Dunn index of a clustering of embedded data.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.clustering import dunn_index
+        >>> data = jnp.asarray([[0., 0], [0.5, 0], [1, 0], [0.5, 1]])
+        >>> labels = jnp.asarray([0, 0, 0, 1])
+        >>> float(dunn_index(data, labels))
+        2.0
+    """
+    data = jnp.asarray(data)
+    labels = jnp.asarray(labels)
+    pairwise_distance, max_distance = _dunn_index_update(data, labels, p, num_labels, mask)
+    return _dunn_index_compute(pairwise_distance, max_distance)

@@ -1,0 +1,63 @@
+"""Fowlkes-Mallows index (counterpart of reference
+``functional/clustering/fowlkes_mallows_index.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.clustering.utils import calculate_contingency_matrix, check_cluster_labels
+
+Array = jax.Array
+
+
+def _fowlkes_mallows_index_update(
+    preds: Array,
+    target: Array,
+    num_classes_preds: Optional[int] = None,
+    num_classes_target: Optional[int] = None,
+    mask: Optional[Array] = None,
+) -> Tuple[Array, int]:
+    check_cluster_labels(preds, target)
+    return (
+        calculate_contingency_matrix(
+            preds, target, num_classes_preds=num_classes_preds, num_classes_target=num_classes_target, mask=mask
+        ),
+        preds.shape[0] if mask is None else jnp.sum(mask),
+    )
+
+
+def _fowlkes_mallows_index_compute(contingency: Array, n: int) -> Array:
+    """sqrt(TP/(TP+FP)) * sqrt(TP/(TP+FN)) in pair counts; the tk == 0
+    degenerate case maps to 0.0 via where (reference fowlkes_mallows_index.py:37-55)."""
+    contingency = contingency.astype(jnp.float32)
+    tk = jnp.sum(contingency**2) - n
+    pk = jnp.sum(contingency.sum(axis=0) ** 2) - n
+    qk = jnp.sum(contingency.sum(axis=1) ** 2) - n
+    safe_pk = jnp.where(pk == 0, 1.0, pk)
+    safe_qk = jnp.where(qk == 0, 1.0, qk)
+    score = jnp.sqrt(jnp.maximum(tk / safe_pk, 0.0)) * jnp.sqrt(jnp.maximum(tk / safe_qk, 0.0))
+    return jnp.where(jnp.isclose(tk, 0.0), 0.0, score)
+
+
+def fowlkes_mallows_index(
+    preds: Array,
+    target: Array,
+    num_classes_preds: Optional[int] = None,
+    num_classes_target: Optional[int] = None,
+    mask: Optional[Array] = None,
+) -> Array:
+    """Fowlkes-Mallows index between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.clustering import fowlkes_mallows_index
+        >>> preds = jnp.asarray([2, 2, 0, 1, 0])
+        >>> target = jnp.asarray([2, 2, 1, 1, 0])
+        >>> round(float(fowlkes_mallows_index(preds, target)), 4)
+        0.5
+    """
+    contingency, n = _fowlkes_mallows_index_update(preds, target, num_classes_preds, num_classes_target, mask)
+    return _fowlkes_mallows_index_compute(contingency, n)

@@ -1,0 +1,122 @@
+"""Homogeneity / completeness / V-measure (counterpart of reference
+``functional/clustering/homogeneity_completeness_v_measure.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.clustering.mutual_info_score import mutual_info_score
+from tpumetrics.functional.clustering.utils import calculate_entropy, check_cluster_labels
+
+Array = jax.Array
+
+
+def _homogeneity_score_compute(
+    preds: Array,
+    target: Array,
+    num_classes_preds: Optional[int] = None,
+    num_classes_target: Optional[int] = None,
+    mask: Optional[Array] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """homogeneity = MI / H(target), guarded where-style (reference :23-36)."""
+    check_cluster_labels(preds, target)
+    if preds.shape[0] == 0:
+        zero = jnp.zeros((), dtype=jnp.float32)
+        return zero, zero, zero, zero
+
+    entropy_target = calculate_entropy(target, num_classes=num_classes_target, mask=mask)
+    entropy_preds = calculate_entropy(preds, num_classes=num_classes_preds, mask=mask)
+    mutual_info = mutual_info_score(
+        preds, target, num_classes_preds=num_classes_preds, num_classes_target=num_classes_target, mask=mask
+    )
+    homogeneity = jnp.where(
+        entropy_target != 0, mutual_info / jnp.where(entropy_target != 0, entropy_target, 1.0), 1.0
+    )
+    return homogeneity, mutual_info, entropy_preds, entropy_target
+
+
+def _completeness_score_compute(
+    preds: Array,
+    target: Array,
+    num_classes_preds: Optional[int] = None,
+    num_classes_target: Optional[int] = None,
+    mask: Optional[Array] = None,
+) -> Tuple[Array, Array]:
+    """completeness = MI / H(preds) (reference :39-43)."""
+    homogeneity, mutual_info, entropy_preds, _ = _homogeneity_score_compute(
+        preds, target, num_classes_preds, num_classes_target, mask
+    )
+    completeness = jnp.where(
+        entropy_preds != 0, mutual_info / jnp.where(entropy_preds != 0, entropy_preds, 1.0), 1.0
+    )
+    return completeness, homogeneity
+
+
+def homogeneity_score(
+    preds: Array,
+    target: Array,
+    num_classes_preds: Optional[int] = None,
+    num_classes_target: Optional[int] = None,
+    mask: Optional[Array] = None,
+) -> Array:
+    """Homogeneity: each predicted cluster contains only members of one class.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.clustering import homogeneity_score
+        >>> round(float(homogeneity_score(jnp.asarray([0, 0, 1, 2]), jnp.asarray([0, 0, 1, 1]))), 4)
+        1.0
+    """
+    homogeneity, _, _, _ = _homogeneity_score_compute(preds, target, num_classes_preds, num_classes_target, mask)
+    return homogeneity
+
+
+def completeness_score(
+    preds: Array,
+    target: Array,
+    num_classes_preds: Optional[int] = None,
+    num_classes_target: Optional[int] = None,
+    mask: Optional[Array] = None,
+) -> Array:
+    """Completeness: all members of a class land in the same predicted cluster.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.clustering import completeness_score
+        >>> round(float(completeness_score(jnp.asarray([0, 0, 1, 2]), jnp.asarray([0, 0, 1, 1]))), 4)
+        0.6667
+    """
+    completeness, _ = _completeness_score_compute(preds, target, num_classes_preds, num_classes_target, mask)
+    return completeness
+
+
+def v_measure_score(
+    preds: Array,
+    target: Array,
+    beta: float = 1.0,
+    num_classes_preds: Optional[int] = None,
+    num_classes_target: Optional[int] = None,
+    mask: Optional[Array] = None,
+) -> Array:
+    """V-measure: beta-weighted harmonic mean of homogeneity and completeness
+    (reference :94-115).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.clustering import v_measure_score
+        >>> round(float(v_measure_score(jnp.asarray([0, 0, 1, 2]), jnp.asarray([0, 0, 1, 1]))), 4)
+        0.8
+    """
+    completeness, homogeneity = _completeness_score_compute(
+        preds, target, num_classes_preds, num_classes_target, mask
+    )
+    total = beta * homogeneity + completeness
+    safe_total = jnp.where(total != 0, total, 1.0)
+    return jnp.where(
+        homogeneity + completeness == 0.0,
+        1.0,
+        (1 + beta) * homogeneity * completeness / safe_total,
+    )

@@ -1,0 +1,79 @@
+"""Mutual information score (counterpart of reference
+``functional/clustering/mutual_info_score.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.clustering.utils import calculate_contingency_matrix, check_cluster_labels
+
+Array = jax.Array
+
+
+def _mutual_info_score_update(
+    preds: Array,
+    target: Array,
+    num_classes_preds: Optional[int] = None,
+    num_classes_target: Optional[int] = None,
+    mask: Optional[Array] = None,
+) -> Array:
+    """Validate labels and build the contingency matrix (reference :21-33).
+    ``mask`` excludes invalid fixed-capacity buffer rows (jit path)."""
+    check_cluster_labels(preds, target)
+    return calculate_contingency_matrix(
+        preds, target, num_classes_preds=num_classes_preds, num_classes_target=num_classes_target, mask=mask
+    )
+
+
+def _mutual_info_score_compute(contingency: Array) -> Array:
+    """MI from a contingency matrix (reference :36-61).
+
+    Where the reference gathers the nonzero entries (data-dependent shapes),
+    every term here is where-masked: zero cells — including entire zero
+    rows/columns from a static class space — contribute exactly 0, so the
+    whole compute stays one fused XLA program. The single-cluster special
+    case (reference :50-51) also falls out: each cell then equals its column
+    marginal and every log term cancels.
+    """
+    contingency = contingency.astype(jnp.float32)
+    n = contingency.sum()
+    u = contingency.sum(axis=1)
+    v = contingency.sum(axis=0)
+
+    nonzero = contingency > 0
+    safe_c = jnp.where(nonzero, contingency, 1.0)
+    safe_u = jnp.where(u > 0, u, 1.0)
+    safe_v = jnp.where(v > 0, v, 1.0)
+    safe_n = jnp.where(n > 0, n, 1.0)
+
+    log_outer = jnp.log(safe_u)[:, None] + jnp.log(safe_v)[None, :]
+    terms = contingency / safe_n * (jnp.log(safe_n) + jnp.log(safe_c) - log_outer)
+    return jnp.sum(jnp.where(nonzero, terms, 0.0))
+
+
+def mutual_info_score(
+    preds: Array,
+    target: Array,
+    num_classes_preds: Optional[int] = None,
+    num_classes_target: Optional[int] = None,
+    mask: Optional[Array] = None,
+) -> Array:
+    """Mutual information between two clusterings.
+
+    ``num_classes_*`` are optional static class-space bounds; passing them
+    makes the whole metric jit/shard_map-safe (zero rows/columns do not
+    change the value).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.clustering import mutual_info_score
+        >>> target = jnp.asarray([0, 3, 2, 2, 1])
+        >>> preds = jnp.asarray([1, 3, 2, 0, 1])
+        >>> round(float(mutual_info_score(preds, target)), 4)
+        1.0549
+    """
+    contingency = _mutual_info_score_update(preds, target, num_classes_preds, num_classes_target, mask)
+    return _mutual_info_score_compute(contingency)

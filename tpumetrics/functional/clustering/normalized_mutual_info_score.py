@@ -1,0 +1,58 @@
+"""Normalized mutual information (counterpart of reference
+``functional/clustering/normalized_mutual_info_score.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.clustering.mutual_info_score import mutual_info_score
+from tpumetrics.functional.clustering.utils import (
+    _validate_average_method_arg,
+    calculate_entropy,
+    calculate_generalized_mean,
+    check_cluster_labels,
+)
+
+Array = jax.Array
+
+
+def normalized_mutual_info_score(
+    preds: Array,
+    target: Array,
+    average_method: str = "arithmetic",
+    num_classes_preds: Optional[int] = None,
+    num_classes_target: Optional[int] = None,
+    mask: Optional[Array] = None,
+) -> Array:
+    """NMI = MI / generalized-mean(H(preds), H(target)) (reference :29-59).
+
+    The reference early-returns when MI is ~0; here that branch is a
+    where-mask so the function stays jit-safe.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.clustering import normalized_mutual_info_score
+        >>> target = jnp.asarray([0, 3, 2, 2, 1])
+        >>> preds = jnp.asarray([1, 3, 2, 0, 1])
+        >>> round(float(normalized_mutual_info_score(preds, target, "arithmetic")), 4)
+        0.7919
+    """
+    check_cluster_labels(preds, target)
+    _validate_average_method_arg(average_method)
+    mutual_info = mutual_info_score(
+        preds, target, num_classes_preds=num_classes_preds, num_classes_target=num_classes_target, mask=mask
+    )
+    normalizer = calculate_generalized_mean(
+        jnp.stack([
+            calculate_entropy(preds, num_classes=num_classes_preds, mask=mask),
+            calculate_entropy(target, num_classes=num_classes_target, mask=mask),
+        ]),
+        average_method,
+    )
+    eps = jnp.finfo(jnp.float32).eps
+    mi_is_zero = jnp.abs(mutual_info) <= eps
+    safe_normalizer = jnp.where(normalizer != 0, normalizer, 1.0)
+    return jnp.where(mi_is_zero, mutual_info, mutual_info / safe_normalizer)

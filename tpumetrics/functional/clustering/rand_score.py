@@ -1,0 +1,60 @@
+"""Rand score (counterpart of reference ``functional/clustering/rand_score.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.clustering.utils import (
+    calculate_contingency_matrix,
+    calculate_pair_cluster_confusion_matrix,
+    check_cluster_labels,
+)
+
+Array = jax.Array
+
+
+def _rand_score_update(
+    preds: Array,
+    target: Array,
+    num_classes_preds: Optional[int] = None,
+    num_classes_target: Optional[int] = None,
+    mask: Optional[Array] = None,
+) -> Array:
+    check_cluster_labels(preds, target)
+    return calculate_contingency_matrix(
+        preds, target, num_classes_preds=num_classes_preds, num_classes_target=num_classes_target, mask=mask
+    )
+
+
+def _rand_score_compute(contingency: Array) -> Array:
+    """Agreeing pairs / all pairs, with the degenerate no-split/all-unique
+    cases mapping to 1.0 via a where-mask (reference rand_score.py:39-60)."""
+    pair_matrix = calculate_pair_cluster_confusion_matrix(contingency=contingency)
+    numerator = pair_matrix[0, 0] + pair_matrix[1, 1]
+    denominator = pair_matrix.sum()
+    degenerate = (numerator == denominator) | (denominator == 0)
+    return jnp.where(degenerate, 1.0, numerator / jnp.where(denominator == 0, 1.0, denominator)).astype(jnp.float32)
+
+
+def rand_score(
+    preds: Array,
+    target: Array,
+    num_classes_preds: Optional[int] = None,
+    num_classes_target: Optional[int] = None,
+    mask: Optional[Array] = None,
+) -> Array:
+    """Rand score between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.clustering import rand_score
+        >>> float(rand_score(jnp.asarray([0, 0, 1, 1]), jnp.asarray([1, 1, 0, 0])))
+        1.0
+        >>> round(float(rand_score(jnp.asarray([0, 0, 1, 2]), jnp.asarray([0, 0, 1, 1]))), 4)
+        0.8333
+    """
+    contingency = _rand_score_update(preds, target, num_classes_preds, num_classes_target, mask)
+    return _rand_score_compute(contingency)

@@ -1,0 +1,261 @@
+"""Shared clustering helpers (counterpart of reference
+``functional/clustering/utils.py``), redesigned for XLA:
+
+- the contingency matrix is one static-shape scatter-add (optionally over a
+  user-declared class space, making it jit/shard_map-safe), not a host-side
+  sparse tensor build (reference utils.py:119-176);
+- entropy/MI terms use where-masked logs so zero rows/columns contribute
+  exactly zero — no data-dependent ``nonzero`` indexing (reference
+  mutual_info_score.py:54-60), which XLA cannot compile.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.utils.checks import _check_same_shape
+from tpumetrics.utils.data import _is_tracer
+
+Array = jax.Array
+
+
+def is_nonnegative(x: Array, atol: float = 1e-5) -> Array:
+    """True when all elements are nonnegative within tolerance (reference utils.py:23-34)."""
+    return jnp.all(jnp.logical_or(x > 0.0, jnp.abs(x) < atol))
+
+
+def _validate_average_method_arg(average_method: str = "arithmetic") -> None:
+    if average_method not in ("min", "geometric", "arithmetic", "max"):
+        raise ValueError(
+            "Expected argument `average_method` to be one of  `min`, `geometric`, `arithmetic`, `max`,"
+            f"but got {average_method}"
+        )
+
+
+def _relabel(x: Array) -> Tuple[Array, int]:
+    """Map observed labels to ``0..K-1`` (eager/host only — the result size is
+    data-dependent). Returns (zero-indexed labels, number of observed classes)."""
+    classes, idx = jnp.unique(x, return_inverse=True)
+    return idx.reshape(x.shape), int(classes.shape[0])
+
+
+def counts_per_class(
+    x: Array, num_classes: Optional[int] = None, mask: Optional[Array] = None
+) -> Array:
+    """Occurrences of each label as a dense count vector.
+
+    With ``num_classes`` this is one static-shape scatter-add (jit-safe);
+    without, observed classes are found eagerly via unique (reference
+    behavior, utils.py:66-69).
+    """
+    if num_classes is None:
+        if _is_tracer(x):
+            raise ValueError(
+                "Cluster-label metrics need a static `num_classes` to run under jit;"
+                " pass num_classes or run eagerly."
+            )
+        x, num_classes = _relabel(x)
+    x = x.astype(jnp.int32)
+    if mask is not None:
+        x = jnp.where(mask, x, num_classes)  # routed out of range -> dropped
+    # negative labels would wrap under JAX scatter semantics; route them out
+    # of bounds so they are dropped like any other out-of-range label
+    x = jnp.where(x < 0, num_classes, x)
+    out = jnp.zeros((num_classes,), dtype=jnp.float32)
+    return out.at[x].add(1.0, mode="drop")
+
+
+def calculate_entropy(
+    x: Array, num_classes: Optional[int] = None, mask: Optional[Array] = None
+) -> Array:
+    """Entropy of a label tensor in log form (reference utils.py:47-76).
+
+    Empty input returns 1.0 and a single observed class returns 0.0, matching
+    the reference; both fall out of the masked arithmetic (no branches), so
+    the same expression works under jit with a static class space.
+    """
+    x = jnp.asarray(x)
+    if x.size == 0 and not _is_tracer(x):
+        return jnp.asarray(1.0, dtype=jnp.float32)
+    p = counts_per_class(x, num_classes=num_classes, mask=mask)
+    n = jnp.sum(p)
+    safe_p = jnp.where(p > 0, p, 1.0)
+    safe_n = jnp.where(n > 0, n, 1.0)
+    return -jnp.sum(jnp.where(p > 0, (p / safe_n) * (jnp.log(safe_p) - jnp.log(safe_n)), 0.0))
+
+
+def calculate_generalized_mean(x: Array, p: Union[int, float, str]) -> Array:
+    """Generalized (power) mean of a positive tensor (reference utils.py:79-115)."""
+    x = jnp.asarray(x)
+    if not _is_tracer(x):
+        if jnp.iscomplexobj(x) or not bool(is_nonnegative(x)):
+            raise ValueError("`x` must contain positive real numbers")
+    if isinstance(p, str):
+        if p == "min":
+            return x.min()
+        if p == "geometric":
+            safe_x = jnp.where(x > 0, x, 1.0)
+            # exact 0 entries drive a geometric mean to 0
+            return jnp.where(jnp.any(x <= 0), 0.0, jnp.exp(jnp.mean(jnp.log(safe_x))))
+        if p == "arithmetic":
+            return x.mean()
+        if p == "max":
+            return x.max()
+        raise ValueError("'method' must be 'min', 'geometric', 'arirthmetic', or 'max'")
+    return jnp.mean(jnp.power(x, p)) ** (1.0 / p)
+
+
+def calculate_contingency_matrix(
+    preds: Array,
+    target: Array,
+    eps: Optional[float] = None,
+    num_classes_preds: Optional[int] = None,
+    num_classes_target: Optional[int] = None,
+    mask: Optional[Array] = None,
+) -> Array:
+    """Dense contingency matrix ``(n_classes_target, n_classes_preds)``.
+
+    One fused scatter-add of encoded pair indices (the reference builds a COO
+    sparse tensor and densifies, utils.py:119-176). With explicit class
+    counts the shape is static and the whole thing jits; ``mask`` drops rows
+    (for fixed-capacity buffer states) by routing them out of range.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.clustering.utils import calculate_contingency_matrix
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> calculate_contingency_matrix(preds, target).astype(int).tolist()
+        [[1, 0, 1], [1, 1, 0], [0, 1, 0]]
+    """
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if preds.ndim != 1 or target.ndim != 1:
+        raise ValueError(f"Expected 1d `preds` and `target` but got {preds.ndim} and {target.ndim}.")
+    if num_classes_preds is None:
+        if _is_tracer(preds):
+            raise ValueError("Pass static num_classes_preds/num_classes_target to jit the contingency matrix.")
+        preds, num_classes_preds = _relabel(preds)
+    if num_classes_target is None:
+        if _is_tracer(target):
+            raise ValueError("Pass static num_classes_preds/num_classes_target to jit the contingency matrix.")
+        target, num_classes_target = _relabel(target)
+    t = target.astype(jnp.int32)
+    p = preds.astype(jnp.int32)
+    pair = t * num_classes_preds + p
+    # out-of-range (incl. negative, which would wrap) labels drop their row
+    in_range = (t >= 0) & (t < num_classes_target) & (p >= 0) & (p < num_classes_preds)
+    if mask is not None:
+        in_range = in_range & mask
+    pair = jnp.where(in_range, pair, num_classes_target * num_classes_preds)
+    flat = jnp.zeros((num_classes_target * num_classes_preds,), dtype=jnp.float32)
+    contingency = flat.at[pair].add(1.0, mode="drop").reshape(num_classes_target, num_classes_preds)
+    if eps is not None:
+        contingency = contingency + eps
+    return contingency
+
+
+def _is_real_discrete_label(x: Array) -> bool:
+    if x.ndim != 1:
+        raise ValueError(f"Expected arguments to be 1-d tensors but got {x.ndim}-d tensors.")
+    return not (jnp.issubdtype(x.dtype, jnp.floating) or jnp.issubdtype(x.dtype, jnp.complexfloating))
+
+
+def check_cluster_labels(preds: Array, target: Array) -> None:
+    """Same-shape + integer-dtype validation (reference utils.py:186-197)."""
+    _check_same_shape(preds, target)
+    if not (_is_real_discrete_label(preds) and _is_real_discrete_label(target)):
+        raise ValueError(f"Expected real, discrete values for x but received {preds.dtype} and {target.dtype}.")
+
+
+def _validate_intrinsic_cluster_data(data: Array, labels: Array) -> None:
+    if data.ndim != 2:
+        raise ValueError(f"Expected 2D data, got {data.ndim}D data instead")
+    if not jnp.issubdtype(data.dtype, jnp.floating):
+        raise ValueError(f"Expected floating point data, got {data.dtype} data instead")
+    if labels.ndim != 1:
+        raise ValueError(f"Expected 1D labels, got {labels.ndim}D labels instead")
+
+
+def _validate_intrinsic_labels_to_samples(num_labels: int, num_samples: Any) -> None:
+    if _is_tracer(num_samples):
+        return  # data-dependent sample count under jit: validated by the caller eagerly
+    if not 1 < num_labels < int(num_samples):
+        raise ValueError(
+            "Number of detected clusters must be greater than one and less than the number of samples."
+            f"Got {num_labels} clusters and {num_samples} samples."
+        )
+
+
+def _zero_index_labels(labels: Array, num_labels: Optional[int]) -> Tuple[Array, int]:
+    """Resolve labels to ``0..K-1``: statically when ``num_labels`` is given
+    (jit-safe), else by observed classes (eager)."""
+    if num_labels is not None:
+        return labels.astype(jnp.int32), int(num_labels)
+    if _is_tracer(labels):
+        raise ValueError("Intrinsic cluster metrics need static `num_labels` to run under jit.")
+    idx, k = _relabel(labels)
+    return idx.astype(jnp.int32), k
+
+
+def _mask_labels(labels: Array, num_labels: int, mask: Optional[Array]) -> Array:
+    """Route invalid (masked-out or out-of-range) rows to segment ``num_labels``
+    so every segment op drops them with static shapes."""
+    out_of_range = (labels < 0) | (labels >= num_labels)
+    if mask is not None:
+        out_of_range = out_of_range | ~mask
+    return jnp.where(out_of_range, num_labels, labels)
+
+
+def _cluster_centroids(
+    data: Array, labels: Array, num_labels: int, mask: Optional[Array] = None
+) -> Tuple[Array, Array]:
+    """Per-cluster centroids + sizes with two segment-sums (replaces the
+    reference's per-cluster Python loops, e.g. calinski_harabasz_score.py:53-58).
+    ``mask`` excludes invalid buffer rows with static shapes."""
+    labels = _mask_labels(labels, num_labels, mask)
+    counts = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype), labels, num_segments=num_labels)
+    sums = jax.ops.segment_sum(data, labels, num_segments=num_labels)
+    centroids = sums / jnp.where(counts > 0, counts, 1.0)[:, None]
+    return centroids, counts
+
+
+def calculate_pair_cluster_confusion_matrix(
+    preds: Optional[Array] = None,
+    target: Optional[Array] = None,
+    contingency: Optional[Array] = None,
+) -> Array:
+    """2x2 pair-counting confusion matrix of two clusterings
+    (reference utils.py:219-283; same entry layout, functional construction).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.clustering.utils import calculate_pair_cluster_confusion_matrix
+        >>> preds = jnp.asarray([0, 0, 1, 2])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> calculate_pair_cluster_confusion_matrix(preds, target).astype(int).tolist()
+        [[8, 2], [0, 2]]
+    """
+    if preds is None and target is None and contingency is None:
+        raise ValueError("Must provide either `preds` and `target` or `contingency`.")
+    if preds is not None and target is not None and contingency is not None:
+        raise ValueError("Must provide either `preds` and `target` or `contingency`, not both.")
+    if preds is not None and target is not None:
+        contingency = calculate_contingency_matrix(preds, target)
+    if contingency is None:
+        raise ValueError("Must provide `contingency` if `preds` and `target` are not provided.")
+
+    num_samples = contingency.sum()
+    sum_c = contingency.sum(axis=1)
+    sum_k = contingency.sum(axis=0)
+    sum_squared = (contingency**2).sum()
+
+    same_same = sum_squared - num_samples
+    same_diff = (contingency * sum_k[None, :]).sum() - sum_squared
+    diff_same = (contingency.T * sum_c[None, :]).sum() - sum_squared
+    diff_diff = num_samples**2 - diff_same - same_diff - sum_squared
+    return jnp.stack(
+        [jnp.stack([diff_diff, diff_same]), jnp.stack([same_diff, same_same])]
+    ).astype(contingency.dtype)

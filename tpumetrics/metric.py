@@ -163,6 +163,7 @@ class Metric(ABC):
         self._persistent: Dict[str, bool] = {}
         self._reductions: Dict[str, Union[str, Callable, None]] = {}
         self._buffer_specs: Dict[str, tuple] = {}  # name -> (capacity, feature_shape, dtype)
+        self._state_spec_hints: Dict[str, tuple] = {}  # name -> (feature_shape, dtype) for list states
 
         self._update_signature = inspect.signature(self.update)
         self.update: Callable = self._wrap_update(self.update)  # type: ignore[method-assign]
@@ -213,6 +214,10 @@ class Metric(ABC):
         elif default:
             raise ValueError("state variable must be an array or an *empty* list (where you can append arrays)")
 
+        if isinstance(default, list):
+            # remember the declared row spec so a later set_state_capacity
+            # builds a buffer of the right dtype/shape without re-declaring
+            self._state_spec_hints[name] = (tuple(feature_shape), feature_dtype)
         if capacity is not None:
             if not isinstance(default, list):
                 raise ValueError("`capacity` is only valid for list ('cat'-style) states")
@@ -239,9 +244,18 @@ class Metric(ABC):
         feature_dtype: Optional[Any] = None,
     ) -> None:
         """Declare (or change) the fixed capacity of an existing list state so
-        the functional/jit path uses a static-shape MaskedBuffer for it."""
+        the functional/jit path uses a static-shape MaskedBuffer for it.
+
+        ``feature_shape``/``feature_dtype`` default to what ``add_state``
+        declared for this state (so e.g. integer label states get integer
+        buffers without repeating the spec here)."""
         if name not in self._defaults or not isinstance(self._defaults[name], list):
             raise ValueError(f"State {name!r} is not a registered list state")
+        hint_shape, hint_dtype = self._state_spec_hints.get(name, ((), None))
+        if feature_shape == () and hint_shape != ():
+            feature_shape = hint_shape
+        if feature_dtype is None:
+            feature_dtype = hint_dtype
         self._buffer_specs[name] = (int(capacity), tuple(feature_shape), feature_dtype)
 
     def _append_state(self, name: str, x: Array, valid: Optional[Array] = None) -> None:
