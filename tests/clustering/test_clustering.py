@@ -233,7 +233,7 @@ def test_negative_labels_dropped_in_static_space():
 def test_buffered_compute_under_jit():
     """Fixed-capacity buffer states: the whole update+compute runs inside jit,
     including uneven per-batch valid counts, and matches sklearn on the valid rows."""
-    cap = 128
+    cap = 160  # > total appended rows: the buffer keeps an invalid tail, exercising the mask path
     for cls, fn, kwargs in [
         (MutualInfoScore, sklearn_metrics.mutual_info_score, {}),
         (RandScore, sklearn_metrics.rand_score, {}),
@@ -245,24 +245,23 @@ def test_buffered_compute_under_jit():
         m.set_state_capacity("target", cap)
 
         @jax.jit
-        def run(preds_batches, target_batches, valid):
+        def run(preds_batches, target_batches):
             state = m.init_state()
             for i in range(preds_batches.shape[0]):
                 state = m.functional_update(state, preds_batches[i], target_batches[i])
-            # drop some rows via an explicit masked re-append to exercise validity
             return m.functional_compute(state)
 
         p = jnp.stack(PREDS)
         t = jnp.stack(TARGET)
-        got = float(run(p, t, None))
+        got = float(run(p, t))
         ref = float(fn(np.concatenate([np.asarray(x) for x in TARGET]), np.concatenate([np.asarray(x) for x in PREDS])))
         assert np.isclose(got, ref, atol=5e-3), (cls.__name__, got, ref)
 
 
 def test_buffered_intrinsic_compute_under_jit():
     m = CalinskiHarabaszScore(num_labels=4)
-    m.set_state_capacity("data", 256, feature_shape=(4,))
-    m.set_state_capacity("labels", 256)
+    m.set_state_capacity("data", 200, feature_shape=(4,))  # > 128 rows: invalid tail exercises the mask
+    m.set_state_capacity("labels", 200)
 
     @jax.jit
     def run(data_batches, label_batches):
@@ -278,3 +277,45 @@ def test_buffered_intrinsic_compute_under_jit():
         )
     )
     assert np.isclose(got, ref, rtol=1e-3), (got, ref)
+
+
+def test_nmi_homogeneity_consistent_with_dropped_rows():
+    """Entropies must be computed on the same row set as the contingency
+    table, so scores stay in [0, 1] when noise rows are dropped."""
+    preds = jnp.asarray([-1, -1, 0, 1, 1, 0])
+    target = jnp.asarray([1, 1, 0, 1, 1, 0])
+    keep = np.asarray(preds) >= 0
+    kp, kt = np.asarray(preds)[keep], np.asarray(target)[keep]
+    for fn, sk in [
+        (normalized_mutual_info_score, sklearn_metrics.normalized_mutual_info_score),
+        (homogeneity_score, sklearn_metrics.homogeneity_score),
+        (completeness_score, sklearn_metrics.completeness_score),
+        (fowlkes_mallows_index, sklearn_metrics.fowlkes_mallows_score),
+    ]:
+        got = float(fn(preds, target, num_classes_preds=2, num_classes_target=2))
+        ref = float(sk(kt, kp))
+        assert np.isclose(got, ref, atol=1e-5), (fn.__name__, got, ref)
+
+
+def test_intrinsic_with_declared_empty_clusters():
+    """num_labels larger than observed clusters (dead k-means clusters) must
+    not distort the scores via phantom origin centroids."""
+    rng = np.random.default_rng(9)
+    data = rng.standard_normal((60, 3)).astype(np.float32) + 5.0  # offset from origin
+    labels = rng.integers(0, 3, 60)
+    dj, lj = jnp.asarray(data), jnp.asarray(labels)
+    assert np.isclose(
+        float(calinski_harabasz_score(dj, lj, num_labels=5)),
+        sklearn_metrics.calinski_harabasz_score(data, labels),
+        rtol=1e-4,
+    )
+    assert np.isclose(
+        float(davies_bouldin_score(dj, lj, num_labels=5)),
+        sklearn_metrics.davies_bouldin_score(data, labels),
+        rtol=1e-4,
+    )
+    assert np.isclose(
+        float(dunn_index(dj, lj, num_labels=5)),
+        float(_np_dunn(data, labels)),
+        rtol=1e-4,
+    )

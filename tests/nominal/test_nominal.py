@@ -1,0 +1,227 @@
+"""Nominal domain vs scipy + independent numpy implementations (counterpart
+of reference ``tests/unittests/nominal/``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats.contingency import association
+
+from tests.conftest import BATCH_SIZE, NUM_BATCHES
+from tests.helpers.testers import MetricTester
+from tpumetrics.functional.nominal import (
+    cramers_v,
+    cramers_v_matrix,
+    fleiss_kappa,
+    pearsons_contingency_coefficient,
+    pearsons_contingency_coefficient_matrix,
+    theils_u,
+    theils_u_matrix,
+    tschuprows_t,
+    tschuprows_t_matrix,
+)
+from tpumetrics.nominal import (
+    CramersV,
+    FleissKappa,
+    PearsonsContingencyCoefficient,
+    TheilsU,
+    TschuprowsT,
+)
+
+NUM_CLASSES = 5
+_rng = np.random.default_rng(11)
+_p = [_rng.integers(0, NUM_CLASSES, BATCH_SIZE) for _ in range(NUM_BATCHES)]
+PREDS = [jnp.asarray(x) for x in _p]
+TARGET = [jnp.asarray(np.clip(np.round(x + _rng.standard_normal(BATCH_SIZE)), 0, NUM_CLASSES - 1).astype(np.int64)) for x in _p]
+
+
+def _observed(preds, target):
+    obs = np.zeros((NUM_CLASSES, NUM_CLASSES), dtype=np.int64)
+    np.add.at(obs, (np.asarray(target), np.asarray(preds)), 1)
+    # drop empty rows/cols like the reference does before computing
+    obs = obs[obs.sum(1) > 0][:, obs.sum(0) > 0]
+    return obs
+
+
+def _np_bias_corrected(obs, kind):
+    """Independent numpy implementation of the Bergsma bias correction used
+    by the reference (reference functional/nominal/utils.py:84-111)."""
+    obs = obs.astype(np.float64)
+    n = obs.sum()
+    expected = np.outer(obs.sum(1), obs.sum(0)) / n
+    r, c = obs.shape
+    df = (r - 1) * (c - 1)
+    o = obs.copy()
+    if df == 1:  # Yates
+        direction = np.sign(expected - o)
+        o = o + direction * np.minimum(0.5, np.abs(expected - o))
+    chi2 = 0.0 if df == 0 else np.sum((o - expected) ** 2 / expected, where=expected > 0)
+    phi2 = chi2 / n
+    phi2c = max(0.0, phi2 - (r - 1) * (c - 1) / (n - 1))
+    rc = r - (r - 1) ** 2 / (n - 1)
+    cc = c - (c - 1) ** 2 / (n - 1)
+    if min(rc, cc) == 1:
+        return np.nan
+    if kind == "cramer":
+        return np.clip(np.sqrt(phi2c / min(rc - 1, cc - 1)), 0, 1)
+    return np.clip(np.sqrt(phi2c / np.sqrt((rc - 1) * (cc - 1))), 0, 1)
+
+
+def _sk_cramers(preds, target):
+    return association(_observed(preds, target), method="cramer", correction=False)
+
+
+def _sk_cramers_bc(preds, target):
+    return _np_bias_corrected(_observed(preds, target), "cramer")
+
+
+def _sk_tschuprow(preds, target):
+    return association(_observed(preds, target), method="tschuprow", correction=False)
+
+
+def _sk_tschuprow_bc(preds, target):
+    return _np_bias_corrected(_observed(preds, target), "tschuprow")
+
+
+def _sk_pearson(preds, target):
+    return association(_observed(preds, target), method="pearson", correction=False)
+
+
+def _np_theils_u(preds, target):
+    cm = _observed(preds, target).astype(np.float64)
+    total = cm.sum()
+    p_xy = cm / total
+    p_y = cm.sum(1) / total
+    with np.errstate(divide="ignore", invalid="ignore"):
+        s_xy = np.nansum(p_xy * np.log(p_y[:, None] / p_xy))
+    p_x = cm.sum(0) / total
+    s_x = -np.sum(p_x[p_x > 0] * np.log(p_x[p_x > 0]))
+    return (s_x - s_xy) / s_x
+
+
+CASES = [
+    (CramersV, cramers_v, {"bias_correction": False}, _sk_cramers, "cramers"),
+    (CramersV, cramers_v, {"bias_correction": True}, _sk_cramers_bc, "cramers_bc"),
+    (TschuprowsT, tschuprows_t, {"bias_correction": False}, _sk_tschuprow, "tschuprow"),
+    (TschuprowsT, tschuprows_t, {"bias_correction": True}, _sk_tschuprow_bc, "tschuprow_bc"),
+    (PearsonsContingencyCoefficient, pearsons_contingency_coefficient, {}, _sk_pearson, "pearson"),
+    (TheilsU, theils_u, {}, _np_theils_u, "theils_u"),
+]
+
+
+class TestNominal(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("metric_class, metric_fn, args, ref_fn, _id", CASES, ids=[c[4] for c in CASES])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, metric_class, metric_fn, args, ref_fn, _id, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=PREDS,
+            target=TARGET,
+            metric_class=metric_class,
+            reference_metric=ref_fn,
+            metric_args={**args, "num_classes": NUM_CLASSES},
+            check_batch=False,  # batch tables can be bias-correction degenerate
+        )
+
+    @pytest.mark.parametrize("metric_class, metric_fn, args, ref_fn, _id", CASES, ids=[c[4] for c in CASES])
+    def test_functional(self, metric_class, metric_fn, args, ref_fn, _id):
+        full_p = jnp.concatenate(PREDS)
+        full_t = jnp.concatenate(TARGET)
+        got = float(metric_fn(full_p, full_t, **args))
+        ref = float(ref_fn(np.asarray(full_p), np.asarray(full_t)))
+        assert np.isclose(got, ref, atol=self.atol), (got, ref)
+
+
+def _np_fleiss(c):
+    c = c.astype(np.float64)
+    n_samples = c.shape[0]
+    n = c.sum(1).max()
+    p_i = c.sum(0) / (n_samples * n)
+    p_j = ((c**2).sum(1) - n) / (n * (n - 1))
+    return (p_j.mean() - (p_i**2).sum()) / (1 - (p_i**2).sum())
+
+
+def test_fleiss_kappa_counts():
+    ratings = _rng.multinomial(8, [0.25, 0.35, 0.4], size=60)
+    got = float(fleiss_kappa(jnp.asarray(ratings)))
+    assert np.isclose(got, _np_fleiss(ratings), atol=1e-4)
+
+    m = FleissKappa(mode="counts")
+    for i in range(0, 60, 20):
+        m.update(jnp.asarray(ratings[i : i + 20]))
+    assert np.isclose(float(m.compute()), _np_fleiss(ratings), atol=1e-4)
+
+
+def test_fleiss_kappa_probs():
+    probs = jax.nn.softmax(jnp.asarray(_rng.standard_normal((40, 4, 6)), dtype=jnp.float32), axis=1)
+    got = float(fleiss_kappa(probs, mode="probs"))
+    choices = np.asarray(probs).argmax(axis=1)
+    counts = np.zeros((40, 4), dtype=np.int64)
+    for i in range(40):
+        np.add.at(counts[i], choices[i], 1)
+    assert np.isclose(got, _np_fleiss(counts), atol=1e-4)
+
+
+def test_fleiss_kappa_buffered_jit():
+    m = FleissKappa(mode="counts")
+    m.set_state_capacity("counts", 64, feature_shape=(3,))
+    ratings = _rng.multinomial(8, [0.25, 0.35, 0.4], size=40)
+
+    @jax.jit
+    def run(r):
+        state = m.init_state()
+        state = m.functional_update(state, r[:20])
+        state = m.functional_update(state, r[20:])
+        return m.functional_compute(state)
+
+    got = float(run(jnp.asarray(ratings)))
+    assert np.isclose(got, _np_fleiss(ratings), atol=1e-4)
+
+
+def test_matrix_variants():
+    matrix = _rng.integers(0, 4, (150, 4))
+    jm = jnp.asarray(matrix)
+    for fn, pair_fn, kwargs in [
+        (cramers_v_matrix, cramers_v, {"bias_correction": False}),
+        (tschuprows_t_matrix, tschuprows_t, {"bias_correction": False}),
+        (pearsons_contingency_coefficient_matrix, pearsons_contingency_coefficient, {}),
+    ]:
+        got = np.asarray(fn(jm, **kwargs))
+        assert got.shape == (4, 4)
+        assert np.allclose(got.diagonal(), 1.0)
+        for i in range(4):
+            for j in range(i + 1, 4):
+                pair = float(pair_fn(jm[:, i], jm[:, j], **kwargs))
+                assert np.isclose(got[i, j], pair, atol=1e-6)
+                assert np.isclose(got[j, i], got[i, j], atol=1e-6)
+    # Theil's U matrix is asymmetric
+    got = np.asarray(theils_u_matrix(jm))
+    for i in range(4):
+        for j in range(4):
+            if i != j:
+                assert np.isclose(got[i, j], float(theils_u(jm[:, i], jm[:, j])), atol=1e-6)
+
+
+def test_jit_with_static_num_classes():
+    full_p = jnp.concatenate(PREDS)
+    full_t = jnp.concatenate(TARGET)
+    fn = jax.jit(lambda a, b: cramers_v(a, b, bias_correction=True, num_classes=NUM_CLASSES))
+    got = float(fn(full_p, full_t))
+    ref = float(_sk_cramers_bc(np.asarray(full_p), np.asarray(full_t)))
+    assert np.isclose(got, ref, atol=1e-4)
+
+
+def test_nan_strategies():
+    p = jnp.asarray([0.0, 1, 2, jnp.nan, 1])
+    t = jnp.asarray([0.0, 1, 2, 2, jnp.nan])
+    v_replace = float(cramers_v(p, t, bias_correction=False, nan_strategy="replace", nan_replace_value=0.0))
+    v_drop = float(cramers_v(p, t, bias_correction=False, nan_strategy="drop"))
+    assert np.isfinite(v_replace) and np.isfinite(v_drop)
+    with pytest.raises(ValueError, match="nan_strategy"):
+        cramers_v(p, t, nan_strategy="bad")
+    with pytest.raises(ValueError, match="nan_replace"):
+        cramers_v(p, t, nan_strategy="replace", nan_replace_value=None)

@@ -17,6 +17,7 @@ from tpumetrics.functional.clustering.utils import (
     _validate_average_method_arg,
     calculate_entropy,
     calculate_generalized_mean,
+    pair_valid_mask,
 )
 from tpumetrics.utils.data import _is_tracer
 
@@ -48,10 +49,11 @@ def adjusted_mutual_info_score(
     # the n_ij grid under jit
     n_samples = jnp.sum(contingency)
     expected_mutual_info = expected_mutual_info_score(contingency, n_samples, nij_bound=preds.shape[0] + 1)
+    valid = pair_valid_mask(preds, target, num_classes_preds, num_classes_target, mask)
     normalizer = calculate_generalized_mean(
         jnp.stack([
-            calculate_entropy(preds, num_classes=num_classes_preds, mask=mask),
-            calculate_entropy(target, num_classes=num_classes_target, mask=mask),
+            calculate_entropy(preds, num_classes=num_classes_preds, mask=valid),
+            calculate_entropy(target, num_classes=num_classes_target, mask=valid),
         ]),
         average_method,
     )

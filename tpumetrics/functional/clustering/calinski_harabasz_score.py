@@ -46,8 +46,12 @@ def calinski_harabasz_score(
 
     mean = jnp.sum(data * w[:, None], axis=0) / jnp.sum(w)
     centroids, counts = _cluster_centroids(data, labels, k, mask=mask)
+    # declared-but-empty clusters (dead k-means clusters, or a static label
+    # space sized for jit) must not count: use the effective cluster count
+    k_eff = jnp.sum(counts > 0).astype(data.dtype)
     between = jnp.sum(counts * jnp.sum((centroids - mean[None, :]) ** 2, axis=1))
     within = jnp.sum(w[:, None] * (data - centroids[jnp.clip(labels, 0, k - 1)]) ** 2)
     safe_within = jnp.where(within == 0, 1.0, within)
-    score = between * (num_samples - k) / (safe_within * (k - 1.0))
+    safe_k = jnp.maximum(k_eff, 2.0)
+    score = between * (num_samples - safe_k) / (safe_within * (safe_k - 1.0))
     return jnp.where(within == 0, 1.0, score).astype(jnp.float32)

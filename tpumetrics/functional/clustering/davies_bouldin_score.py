@@ -49,11 +49,23 @@ def davies_bouldin_score(
     safe_counts = jnp.where(counts > 0, counts, 1.0)
     intra = jax.ops.segment_sum(dists, seg_labels, num_segments=k) / safe_counts
 
+    # declared-but-empty clusters sit at the origin as phantom centroids;
+    # exclude them from both the per-cluster max and the final mean
+    valid_k = counts > 0
+    k_eff = jnp.sum(valid_k).astype(jnp.float32)
+    pair_valid = valid_k[:, None] & valid_k[None, :]
+
     diff = centroids[:, None, :] - centroids[None, :, :]
     centroid_distances = jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, axis=-1), 0.0))
 
-    degenerate = jnp.isclose(intra, 0.0).all() | jnp.isclose(centroid_distances, 0.0).all()
-    centroid_distances = jnp.where(centroid_distances == 0, jnp.inf, centroid_distances)
+    degenerate = (
+        jnp.all(jnp.where(valid_k, jnp.isclose(intra, 0.0), True))
+        | jnp.all(jnp.where(pair_valid, jnp.isclose(centroid_distances, 0.0), True))
+    )
+    centroid_distances = jnp.where(
+        pair_valid & (centroid_distances != 0), centroid_distances, jnp.inf
+    )
     combined = intra[None, :] + intra[:, None]
     scores = jnp.max(combined / centroid_distances, axis=1)
-    return jnp.where(degenerate, 0.0, scores.mean()).astype(jnp.float32)
+    mean_score = jnp.sum(jnp.where(valid_k, scores, 0.0)) / jnp.maximum(k_eff, 1.0)
+    return jnp.where(degenerate, 0.0, mean_score).astype(jnp.float32)

@@ -19,16 +19,22 @@ def _dunn_index_update(
     point-to-centroid distance via ``segment_max`` — no Python loops over
     clusters (reference dunn_index.py:21-46 builds per-cluster Python lists)."""
     labels, k = _zero_index_labels(labels, num_labels)
-    centroids, _ = _cluster_centroids(data, labels, k, mask=mask)
+    centroids, counts = _cluster_centroids(data, labels, k, mask=mask)
     seg_labels = _mask_labels(labels, k, mask)
 
+    # phantom (empty) clusters must not produce distances: mask their pairs
+    # to +inf before the min, and their intra rows to -inf before the max
+    valid_k = counts > 0
     diff = jnp.abs(centroids[:, None, :] - centroids[None, :, :])
     inter = jnp.sum(diff**p, axis=-1) ** (1.0 / p)  # (K, K) ord=p vector norm
+    pair_valid = valid_k[:, None] & valid_k[None, :]
+    inter = jnp.where(pair_valid, inter, jnp.inf)
     iu = jnp.triu_indices(k, 1)
     intercluster_distance = inter[iu]
 
     point_dist = jnp.sum(jnp.abs(data - centroids[jnp.clip(labels, 0, k - 1)]) ** p, axis=-1) ** (1.0 / p)
     max_intracluster_distance = jax.ops.segment_max(point_dist, seg_labels, num_segments=k)
+    max_intracluster_distance = jnp.where(valid_k, max_intracluster_distance, -jnp.inf)
     return intercluster_distance, max_intracluster_distance
 
 

@@ -21,12 +21,12 @@ def _fowlkes_mallows_index_update(
     mask: Optional[Array] = None,
 ) -> Tuple[Array, int]:
     check_cluster_labels(preds, target)
-    return (
-        calculate_contingency_matrix(
-            preds, target, num_classes_preds=num_classes_preds, num_classes_target=num_classes_target, mask=mask
-        ),
-        preds.shape[0] if mask is None else jnp.sum(mask),
+    contingency = calculate_contingency_matrix(
+        preds, target, num_classes_preds=num_classes_preds, num_classes_target=num_classes_target, mask=mask
     )
+    # n = rows actually in the table (out-of-range/negative/masked rows are
+    # dropped there, and must not count here either)
+    return contingency, jnp.sum(contingency)
 
 
 def _fowlkes_mallows_index_compute(contingency: Array, n: int) -> Array:

@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 
 from tpumetrics.functional.clustering.mutual_info_score import mutual_info_score
-from tpumetrics.functional.clustering.utils import calculate_entropy, check_cluster_labels
+from tpumetrics.functional.clustering.utils import calculate_entropy, check_cluster_labels, pair_valid_mask
 
 Array = jax.Array
 
@@ -27,8 +27,9 @@ def _homogeneity_score_compute(
         zero = jnp.zeros((), dtype=jnp.float32)
         return zero, zero, zero, zero
 
-    entropy_target = calculate_entropy(target, num_classes=num_classes_target, mask=mask)
-    entropy_preds = calculate_entropy(preds, num_classes=num_classes_preds, mask=mask)
+    valid = pair_valid_mask(preds, target, num_classes_preds, num_classes_target, mask)
+    entropy_target = calculate_entropy(target, num_classes=num_classes_target, mask=valid)
+    entropy_preds = calculate_entropy(preds, num_classes=num_classes_preds, mask=valid)
     mutual_info = mutual_info_score(
         preds, target, num_classes_preds=num_classes_preds, num_classes_target=num_classes_target, mask=mask
     )

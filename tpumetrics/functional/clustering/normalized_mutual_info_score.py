@@ -14,6 +14,7 @@ from tpumetrics.functional.clustering.utils import (
     calculate_entropy,
     calculate_generalized_mean,
     check_cluster_labels,
+    pair_valid_mask,
 )
 
 Array = jax.Array
@@ -45,10 +46,11 @@ def normalized_mutual_info_score(
     mutual_info = mutual_info_score(
         preds, target, num_classes_preds=num_classes_preds, num_classes_target=num_classes_target, mask=mask
     )
+    valid = pair_valid_mask(preds, target, num_classes_preds, num_classes_target, mask)
     normalizer = calculate_generalized_mean(
         jnp.stack([
-            calculate_entropy(preds, num_classes=num_classes_preds, mask=mask),
-            calculate_entropy(target, num_classes=num_classes_target, mask=mask),
+            calculate_entropy(preds, num_classes=num_classes_preds, mask=valid),
+            calculate_entropy(target, num_classes=num_classes_target, mask=valid),
         ]),
         average_method,
     )

@@ -104,7 +104,7 @@ def calculate_generalized_mean(x: Array, p: Union[int, float, str]) -> Array:
             return x.mean()
         if p == "max":
             return x.max()
-        raise ValueError("'method' must be 'min', 'geometric', 'arirthmetic', or 'max'")
+        raise ValueError("Argument `p` must be 'min', 'geometric', 'arithmetic', or 'max', or a numeric power")
     return jnp.mean(jnp.power(x, p)) ** (1.0 / p)
 
 
@@ -168,6 +168,30 @@ def check_cluster_labels(preds: Array, target: Array) -> None:
     _check_same_shape(preds, target)
     if not (_is_real_discrete_label(preds) and _is_real_discrete_label(target)):
         raise ValueError(f"Expected real, discrete values for x but received {preds.dtype} and {target.dtype}.")
+
+
+def pair_valid_mask(
+    preds: Array,
+    target: Array,
+    num_classes_preds: Optional[int],
+    num_classes_target: Optional[int],
+    mask: Optional[Array],
+) -> Optional[Array]:
+    """Rows that survive the contingency build: in both class spaces and not
+    masked out. Entropies and sample counts must use exactly this row set so
+    MI and its normalizers stay consistent (a row dropped from the table but
+    counted in H(·) can push NMI/homogeneity outside [0, 1])."""
+    valid = None
+    if num_classes_preds is not None:
+        p = preds.astype(jnp.int32)
+        valid = (p >= 0) & (p < num_classes_preds)
+    if num_classes_target is not None:
+        t = target.astype(jnp.int32)
+        v_t = (t >= 0) & (t < num_classes_target)
+        valid = v_t if valid is None else valid & v_t
+    if mask is not None:
+        valid = mask if valid is None else valid & mask
+    return valid
 
 
 def _validate_intrinsic_cluster_data(data: Array, labels: Array) -> None:

@@ -1,0 +1,23 @@
+"""Nominal functional metrics (counterpart of reference
+``functional/nominal/__init__.py``)."""
+
+from tpumetrics.functional.nominal.cramers import cramers_v, cramers_v_matrix
+from tpumetrics.functional.nominal.fleiss_kappa import fleiss_kappa
+from tpumetrics.functional.nominal.pearson import (
+    pearsons_contingency_coefficient,
+    pearsons_contingency_coefficient_matrix,
+)
+from tpumetrics.functional.nominal.theils_u import theils_u, theils_u_matrix
+from tpumetrics.functional.nominal.tschuprows import tschuprows_t, tschuprows_t_matrix
+
+__all__ = [
+    "cramers_v",
+    "cramers_v_matrix",
+    "fleiss_kappa",
+    "pearsons_contingency_coefficient",
+    "pearsons_contingency_coefficient_matrix",
+    "theils_u",
+    "theils_u_matrix",
+    "tschuprows_t",
+    "tschuprows_t_matrix",
+]

@@ -1,0 +1,118 @@
+"""Cramer's V (counterpart of reference ``functional/nominal/cramers.py``)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.nominal.utils import (  # noqa: I001
+    _infer_num_classes,
+    _compute_bias_corrected_values,
+    _compute_chi_squared,
+    _effective_shape,
+    _nominal_confmat,
+    _nominal_input_validation,
+    _unable_to_use_bias_correction_warning,
+)
+from tpumetrics.utils.data import _is_tracer
+
+Array = jax.Array
+
+
+def _cramers_v_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Contingency table for Cramer's V (reference cramers.py:33-56)."""
+    return _nominal_confmat(preds, target, num_classes, nan_strategy, nan_replace_value)
+
+
+def _cramers_v_compute(confmat: Array, bias_correction: bool) -> Array:
+    """V = sqrt(phi² / min(r-1, c-1)) on effective (non-empty) rows/columns
+    (reference cramers.py:59-87); emits NaN when bias correction collapses the
+    table to one effective row or column."""
+    confmat = confmat.astype(jnp.float32)
+    cm_sum = confmat.sum()
+    chi_squared = _compute_chi_squared(confmat, bias_correction)
+    phi_squared = chi_squared / jnp.where(cm_sum > 0, cm_sum, 1.0)
+    num_rows, num_cols = _effective_shape(confmat)
+
+    if bias_correction:
+        phi_squared_corrected, rows_corrected, cols_corrected = _compute_bias_corrected_values(
+            phi_squared, num_rows, num_cols, cm_sum
+        )
+        denom = jnp.minimum(rows_corrected - 1, cols_corrected - 1)
+        degenerate = jnp.minimum(rows_corrected, cols_corrected) == 1
+        if not _is_tracer(degenerate) and bool(degenerate):
+            _unable_to_use_bias_correction_warning(metric_name="Cramer's V")
+        value = jnp.sqrt(phi_squared_corrected / jnp.where(degenerate, 1.0, denom))
+        value = jnp.where(degenerate, jnp.nan, value)
+    else:
+        denom = jnp.minimum(num_rows - 1, num_cols - 1)
+        value = jnp.sqrt(phi_squared / jnp.where(denom > 0, denom, 1.0))
+    return jnp.clip(value, 0.0, 1.0)
+
+
+def cramers_v(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+    num_classes: Optional[int] = None,
+) -> Array:
+    """Cramer's V association between two categorical series.
+
+    ``num_classes`` (TPU extension) fixes the table size statically so the
+    whole computation jits; otherwise it is inferred from the observed values
+    (eager only, like the reference cramers.py:135).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.nominal import cramers_v
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 0])
+        >>> round(float(cramers_v(preds, target, bias_correction=False)), 4)
+        0.6667
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    if num_classes is None:
+        if _is_tracer(preds):
+            raise ValueError("Pass a static `num_classes` to run cramers_v under jit.")
+        num_classes = _infer_num_classes(preds, target, nan_strategy, nan_replace_value)
+    confmat = _cramers_v_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    return _cramers_v_compute(confmat, bias_correction)
+
+
+def cramers_v_matrix(
+    matrix: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Pairwise Cramer's V between all column pairs of a categorical dataset
+    (reference cramers.py:141-183).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.nominal import cramers_v_matrix
+        >>> matrix = jnp.asarray([[0, 0, 0], [1, 1, 1], [2, 2, 2], [1, 2, 1]])
+        >>> cramers_v_matrix(matrix, bias_correction=False).shape
+        (3, 3)
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    num_variables = matrix.shape[1]
+    value = jnp.ones((num_variables, num_variables), dtype=jnp.float32)
+    for i, j in itertools.combinations(range(num_variables), 2):
+        x, y = matrix[:, i], matrix[:, j]
+        num_classes = _infer_num_classes(x, y, nan_strategy, nan_replace_value)
+        confmat = _cramers_v_update(x, y, num_classes, nan_strategy, nan_replace_value)
+        v = _cramers_v_compute(confmat, bias_correction)
+        value = value.at[i, j].set(v).at[j, i].set(v)
+    return value

@@ -1,0 +1,61 @@
+"""Fleiss kappa (counterpart of reference ``functional/nominal/fleiss_kappa.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _fleiss_kappa_update(ratings: Array, mode: str = "counts") -> Array:
+    """Normalize ratings to a [n_samples, n_categories] counts matrix
+    (reference fleiss_kappa.py:20-42): 'probs' input [n, C, raters] is
+    argmax-ed per rater then histogrammed with one one-hot sum."""
+    ratings = jnp.asarray(ratings)
+    if mode == "probs":
+        if ratings.ndim != 3 or not jnp.issubdtype(ratings.dtype, jnp.floating):
+            raise ValueError(
+                "If argument ``mode`` is 'probs', ratings must have 3 dimensions with the format"
+                " [n_samples, n_categories, n_raters] and be floating point."
+            )
+        num_categories = ratings.shape[1]
+        choices = ratings.argmax(axis=1)  # (n_samples, n_raters)
+        one_hot = jax.nn.one_hot(choices, num_categories, dtype=jnp.int32)  # (n, raters, C)
+        ratings = one_hot.sum(axis=1)
+    elif mode == "counts" and (ratings.ndim != 2 or jnp.issubdtype(ratings.dtype, jnp.floating)):
+        raise ValueError(
+            "If argument ``mode`` is `counts`, ratings must have 2 dimensions with the format"
+            " [n_samples, n_categories] and be none floating point."
+        )
+    return ratings
+
+
+def _fleiss_kappa_compute(counts: Array) -> Array:
+    """kappa = (p_bar - pe_bar) / (1 - pe_bar) (reference fleiss_kappa.py:45-59)."""
+    counts = counts.astype(jnp.float32)
+    total = counts.shape[0]
+    num_raters = counts.sum(axis=1).max()
+
+    p_i = counts.sum(axis=0) / (total * num_raters)
+    p_j = ((counts**2).sum(axis=1) - num_raters) / (num_raters * (num_raters - 1))
+    p_bar = p_j.mean()
+    pe_bar = (p_i**2).sum()
+    return (p_bar - pe_bar) / (1 - pe_bar + 1e-5)
+
+
+def fleiss_kappa(ratings: Array, mode: str = "counts") -> Array:
+    """Fleiss kappa: chance-adjusted inter-rater agreement for multiple raters.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.nominal import fleiss_kappa
+        >>> # 4 samples, 3 categories, 5 raters (as per-category counts)
+        >>> ratings = jnp.asarray([[5, 0, 0], [2, 3, 0], [1, 1, 3], [0, 5, 0]])
+        >>> round(float(fleiss_kappa(ratings)), 4)
+        0.4715
+    """
+    if mode not in ["counts", "probs"]:
+        raise ValueError("Argument ``mode`` must be one of ['counts', 'probs'].")
+    counts = _fleiss_kappa_update(ratings, mode)
+    return _fleiss_kappa_compute(counts)

@@ -1,0 +1,85 @@
+"""Pearson's contingency coefficient (counterpart of reference
+``functional/nominal/pearson.py``)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.nominal.utils import (  # noqa: I001
+    _infer_num_classes,
+    _compute_chi_squared,
+    _nominal_confmat,
+    _nominal_input_validation,
+)
+from tpumetrics.utils.data import _is_tracer
+
+Array = jax.Array
+
+
+def _pearsons_contingency_coefficient_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Contingency table (reference pearson.py:30-53)."""
+    return _nominal_confmat(preds, target, num_classes, nan_strategy, nan_replace_value)
+
+
+def _pearsons_contingency_coefficient_compute(confmat: Array) -> Array:
+    """C = sqrt(phi² / (1 + phi²)) (reference pearson.py:56-73)."""
+    confmat = confmat.astype(jnp.float32)
+    cm_sum = confmat.sum()
+    chi_squared = _compute_chi_squared(confmat, bias_correction=False)
+    phi_squared = chi_squared / jnp.where(cm_sum > 0, cm_sum, 1.0)
+    return jnp.clip(jnp.sqrt(phi_squared / (1 + phi_squared)), 0.0, 1.0)
+
+
+def pearsons_contingency_coefficient(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+    num_classes: Optional[int] = None,
+) -> Array:
+    """Pearson's contingency coefficient between two categorical series.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.nominal import pearsons_contingency_coefficient
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 0])
+        >>> round(float(pearsons_contingency_coefficient(preds, target)), 4)
+        0.686
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    if num_classes is None:
+        if _is_tracer(preds):
+            raise ValueError("Pass a static `num_classes` to run pearsons_contingency_coefficient under jit.")
+        num_classes = _infer_num_classes(preds, target, nan_strategy, nan_replace_value)
+    confmat = _pearsons_contingency_coefficient_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    return _pearsons_contingency_coefficient_compute(confmat)
+
+
+def pearsons_contingency_coefficient_matrix(
+    matrix: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Pairwise Pearson's contingency coefficient between all column pairs
+    (reference pearson.py:127-174)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    num_variables = matrix.shape[1]
+    value = jnp.ones((num_variables, num_variables), dtype=jnp.float32)
+    for i, j in itertools.combinations(range(num_variables), 2):
+        x, y = matrix[:, i], matrix[:, j]
+        num_classes = _infer_num_classes(x, y, nan_strategy, nan_replace_value)
+        confmat = _pearsons_contingency_coefficient_update(x, y, num_classes, nan_strategy, nan_replace_value)
+        v = _pearsons_contingency_coefficient_compute(confmat)
+        value = value.at[i, j].set(v).at[j, i].set(v)
+    return value

@@ -1,0 +1,103 @@
+"""Theil's U (counterpart of reference ``functional/nominal/theils_u.py``)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.nominal.utils import (  # noqa: I001
+    _infer_num_classes,
+    _nominal_confmat,
+    _nominal_input_validation,
+)
+from tpumetrics.utils.data import _is_tracer
+
+Array = jax.Array
+
+
+def _conditional_entropy_compute(confmat: Array) -> Array:
+    """H(X|Y) from the contingency table (reference theils_u.py:29-52), with
+    zero cells masked instead of relying on ``nansum`` over log(0/0)."""
+    confmat = confmat.astype(jnp.float32)
+    total = confmat.sum()
+    safe_total = jnp.where(total > 0, total, 1.0)
+    p_xy = confmat / safe_total
+    p_y = confmat.sum(axis=1) / safe_total  # row marginals
+    nonzero = p_xy > 0
+    safe_p_xy = jnp.where(nonzero, p_xy, 1.0)
+    safe_p_y = jnp.where(p_y > 0, p_y, 1.0)
+    terms = p_xy * (jnp.log(safe_p_y)[:, None] - jnp.log(safe_p_xy))
+    return jnp.sum(jnp.where(nonzero, terms, 0.0))
+
+
+def _theils_u_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Contingency table (reference theils_u.py:55-78)."""
+    return _nominal_confmat(preds, target, num_classes, nan_strategy, nan_replace_value)
+
+
+def _theils_u_compute(confmat: Array) -> Array:
+    """U = (H(X) - H(X|Y)) / H(X) in masked arithmetic (reference theils_u.py:81-104)."""
+    confmat = confmat.astype(jnp.float32)
+    s_xy = _conditional_entropy_compute(confmat)
+
+    total = confmat.sum()
+    safe_total = jnp.where(total > 0, total, 1.0)
+    p_x = confmat.sum(axis=0) / safe_total  # column marginals
+    safe_p_x = jnp.where(p_x > 0, p_x, 1.0)
+    s_x = -jnp.sum(jnp.where(p_x > 0, p_x * jnp.log(safe_p_x), 0.0))
+
+    return jnp.where(s_x == 0, 0.0, (s_x - s_xy) / jnp.where(s_x == 0, 1.0, s_x))
+
+
+def theils_u(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+    num_classes: Optional[int] = None,
+) -> Array:
+    """Theil's uncertainty coefficient U(X|Y) — an asymmetric association
+    measure between two categorical series.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.nominal import theils_u
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 0])
+        >>> round(float(theils_u(preds, target)), 4)
+        0.4943
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    if num_classes is None:
+        if _is_tracer(preds):
+            raise ValueError("Pass a static `num_classes` to run theils_u under jit.")
+        num_classes = _infer_num_classes(preds, target, nan_strategy, nan_replace_value)
+    confmat = _theils_u_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    return _theils_u_compute(confmat)
+
+
+def theils_u_matrix(
+    matrix: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Pairwise (asymmetric) Theil's U between all column pairs
+    (reference theils_u.py:147-195): entry (i, j) is U(x_i | x_j)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    num_variables = matrix.shape[1]
+    value = jnp.ones((num_variables, num_variables), dtype=jnp.float32)
+    for i, j in itertools.permutations(range(num_variables), 2):
+        x, y = matrix[:, i], matrix[:, j]
+        num_classes = _infer_num_classes(x, y, nan_strategy, nan_replace_value)
+        confmat = _theils_u_update(x, y, num_classes, nan_strategy, nan_replace_value)
+        value = value.at[i, j].set(_theils_u_compute(confmat))
+    return value

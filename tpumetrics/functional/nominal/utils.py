@@ -1,0 +1,164 @@
+"""Shared nominal-association helpers (counterpart of reference
+``functional/nominal/utils.py``), redesigned for XLA.
+
+The reference physically drops empty rows/columns of the contingency table
+(``_drop_empty_rows_and_cols``, reference utils.py:62-81) — a data-dependent
+shape change XLA cannot compile. Here empty rows/columns stay in the table
+and every statistic is computed with where-masked arithmetic over *effective*
+row/column counts (traced scalars, not shapes), so all nominal metrics run
+fully inside jit/shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _nominal_input_validation(nan_strategy: str, nan_replace_value: Optional[float]) -> None:
+    if nan_strategy not in ["replace", "drop"]:
+        raise ValueError(
+            f"Argument `nan_strategy` is expected to be one of `['replace', 'drop']`, but got {nan_strategy}"
+        )
+    if nan_strategy == "replace" and not isinstance(nan_replace_value, (float, int)):
+        raise ValueError(
+            "Argument `nan_replace` is expected to be of a type `int` or `float` when `nan_strategy = 'replace`, "
+            f"but got {nan_replace_value}"
+        )
+
+
+def _handle_nan_in_data(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Tuple[Array, Array]:
+    """Replace or drop NaN rows (reference utils.py:113-137). ``replace`` is
+    jit-safe; ``drop`` changes shapes and therefore only runs eagerly."""
+    if nan_strategy == "replace":
+        if jnp.issubdtype(preds.dtype, jnp.floating):
+            preds = jnp.nan_to_num(preds, nan=nan_replace_value)
+        if jnp.issubdtype(target.dtype, jnp.floating):
+            target = jnp.nan_to_num(target, nan=nan_replace_value)
+        return preds, target
+    p_nan = jnp.isnan(preds) if jnp.issubdtype(preds.dtype, jnp.floating) else jnp.zeros(preds.shape, bool)
+    t_nan = jnp.isnan(target) if jnp.issubdtype(target.dtype, jnp.floating) else jnp.zeros(target.shape, bool)
+    keep = ~(p_nan | t_nan)
+    return preds[keep], target[keep]
+
+
+def _effective_shape(confmat: Array) -> Tuple[Array, Array]:
+    """Number of non-empty rows/columns as traced scalars — the masked-
+    arithmetic replacement for physically dropping them (reference
+    utils.py:62-81)."""
+    rows = jnp.sum(confmat.sum(axis=1) > 0)
+    cols = jnp.sum(confmat.sum(axis=0) > 0)
+    return rows.astype(jnp.float32), cols.astype(jnp.float32)
+
+
+def _compute_expected_freqs(confmat: Array) -> Array:
+    """Outer product of marginals / total (reference utils.py:35-39)."""
+    margin_rows = confmat.sum(axis=1)
+    margin_cols = confmat.sum(axis=0)
+    total = confmat.sum()
+    return margin_rows[:, None] * margin_cols[None, :] / jnp.where(total > 0, total, 1.0)
+
+
+def _compute_chi_squared(confmat: Array, bias_correction: bool) -> Array:
+    """Chi-squared independence statistic with optional Yates continuity
+    correction at one degree of freedom (reference utils.py:41-59, after
+    scipy.stats.contingency), in masked arithmetic: cells with zero expected
+    frequency (empty rows/columns) contribute exactly zero and the
+    df computation uses effective counts."""
+    confmat = confmat.astype(jnp.float32)
+    expected = _compute_expected_freqs(confmat)
+    rows_eff, cols_eff = _effective_shape(confmat)
+    df = (rows_eff - 1) * (cols_eff - 1)
+
+    if bias_correction:
+        # Yates correction applies only when df == 1; keep it branch-free
+        diff = expected - confmat
+        direction = jnp.sign(diff)
+        corrected = confmat + direction * jnp.minimum(0.5, jnp.abs(diff))
+        confmat = jnp.where(df == 1, corrected, confmat)
+
+    positive = expected > 0
+    safe_expected = jnp.where(positive, expected, 1.0)
+    chi = jnp.sum(jnp.where(positive, (confmat - expected) ** 2 / safe_expected, 0.0))
+    return jnp.where(df == 0, 0.0, chi)
+
+
+def _compute_phi_squared_corrected(
+    phi_squared: Array, num_rows: Array, num_cols: Array, confmat_sum: Array
+) -> Array:
+    """Bias-corrected phi squared (reference utils.py:84-95)."""
+    return jnp.maximum(0.0, phi_squared - ((num_rows - 1) * (num_cols - 1)) / (confmat_sum - 1))
+
+
+def _compute_rows_and_cols_corrected(
+    num_rows: Array, num_cols: Array, confmat_sum: Array
+) -> Tuple[Array, Array]:
+    """Bias-corrected row/column counts (reference utils.py:98-102)."""
+    rows_corrected = num_rows - (num_rows - 1) ** 2 / (confmat_sum - 1)
+    cols_corrected = num_cols - (num_cols - 1) ** 2 / (confmat_sum - 1)
+    return rows_corrected, cols_corrected
+
+
+def _compute_bias_corrected_values(
+    phi_squared: Array, num_rows: Array, num_cols: Array, confmat_sum: Array
+) -> Tuple[Array, Array, Array]:
+    """Bias-corrected phi squared + effective row/column counts (reference utils.py:105-111)."""
+    phi_squared_corrected = _compute_phi_squared_corrected(phi_squared, num_rows, num_cols, confmat_sum)
+    rows_corrected, cols_corrected = _compute_rows_and_cols_corrected(num_rows, num_cols, confmat_sum)
+    return phi_squared_corrected, rows_corrected, cols_corrected
+
+
+def _infer_num_classes(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> int:
+    """Size the static class space from the observed values (eager only):
+    max label + 1, after NaN resolution (a NaN max is unusable). Negative
+    labels are excluded — the scatter drops them."""
+    preds, target = _handle_nan_in_data(jnp.asarray(preds), jnp.asarray(target), nan_strategy, nan_replace_value)
+    joined = jnp.concatenate([jnp.unique(preds), jnp.unique(target)])
+    return max(int(joined.max()) + 1, 2)
+
+
+def _unable_to_use_bias_correction_warning(metric_name: str) -> None:
+    rank_zero_warn(
+        f"Unable to compute {metric_name} using bias correction. Please consider to set `bias_correction=False`."
+    )
+
+
+def _nominal_confmat(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Contingency table of two nominal series: argmax 2D inputs, handle NaN,
+    then one scatter-add confusion matrix (reference cramers.py:33-56 →
+    `_multiclass_confusion_matrix_update`)."""
+    from tpumetrics.functional.classification.stat_scores import _masked_confmat
+
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds = preds.argmax(1) if preds.ndim == 2 else preds
+    target = target.argmax(1) if target.ndim == 2 else target
+    preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+    p = preds.astype(jnp.int32)
+    t = target.astype(jnp.int32)
+    # out-of-range (incl. negative, which would alias another cell in the
+    # flat scatter index) rows are dropped
+    in_range = (p >= 0) & (p < num_classes) & (t >= 0) & (t < num_classes)
+    return _masked_confmat(jnp.clip(p, 0, num_classes - 1), jnp.clip(t, 0, num_classes - 1), in_range.astype(jnp.int32), num_classes)

@@ -1,0 +1,15 @@
+"""Nominal metric domain (counterpart of reference ``nominal/__init__.py``)."""
+
+from tpumetrics.nominal.cramers import CramersV
+from tpumetrics.nominal.fleiss_kappa import FleissKappa
+from tpumetrics.nominal.pearson import PearsonsContingencyCoefficient
+from tpumetrics.nominal.theils_u import TheilsU
+from tpumetrics.nominal.tschuprows import TschuprowsT
+
+__all__ = [
+    "CramersV",
+    "FleissKappa",
+    "PearsonsContingencyCoefficient",
+    "TheilsU",
+    "TschuprowsT",
+]

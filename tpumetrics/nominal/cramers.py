@@ -1,0 +1,71 @@
+"""CramersV (counterpart of reference ``nominal/cramers.py:30``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.nominal.cramers import _cramers_v_compute, _cramers_v_update
+from tpumetrics.functional.nominal.utils import _nominal_input_validation
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class CramersV(Metric):
+    """Cramer's V association between two categorical series.
+
+    Keeps one dense ``(C, C)`` contingency-table sum state — fully static,
+    synced with a single psum (reference nominal/cramers.py:105).
+
+    Args:
+        num_classes: size of the (static) class space.
+        bias_correction: apply Bergsma's bias correction.
+        nan_strategy: ``replace`` (jit-safe) or ``drop`` (eager only).
+        nan_replace_value: replacement value for ``replace``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.nominal import CramersV
+        >>> metric = CramersV(num_classes=5, bias_correction=False)
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1, 3, 4])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 0, 3, 4])
+        >>> round(float(metric(preds, target)), 4)
+        0.8498
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    confmat: Array
+
+    def __init__(
+        self,
+        num_classes: int,
+        bias_correction: bool = True,
+        nan_strategy: str = "replace",
+        nan_replace_value: Optional[float] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_classes, int) or num_classes < 2:
+            raise ValueError(f"Argument `num_classes` is expected to be an integer >= 2, but got {num_classes}")
+        self.num_classes = num_classes
+        self.bias_correction = bias_correction
+        _nominal_input_validation(nan_strategy, nan_replace_value)
+        self.nan_strategy = nan_strategy
+        self.nan_replace_value = nan_replace_value
+        self.add_state("confmat", jnp.zeros((num_classes, num_classes)), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate the contingency table."""
+        confmat = _cramers_v_update(preds, target, self.num_classes, self.nan_strategy, self.nan_replace_value)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        return _cramers_v_compute(self.confmat, self.bias_correction)

@@ -1,0 +1,72 @@
+"""FleissKappa (counterpart of reference ``nominal/fleiss_kappa.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+
+from tpumetrics.functional.nominal.fleiss_kappa import _fleiss_kappa_compute, _fleiss_kappa_update
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class FleissKappa(Metric):
+    """Fleiss kappa: inter-rater agreement for multiple raters.
+
+    Args:
+        mode: ``counts`` — input is an int ``[n_samples, n_categories]``
+            counts matrix; ``probs`` — input is a float
+            ``[n_samples, n_categories, n_raters]`` probability tensor.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.nominal import FleissKappa
+        >>> metric = FleissKappa(mode='counts')
+        >>> ratings = jnp.asarray([[5, 0, 0], [2, 3, 0], [1, 1, 3], [0, 5, 0]])
+        >>> round(float(metric(ratings)), 4)
+        0.4715
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    counts: List[Array]
+
+    def __init__(self, mode: str = "counts", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if mode not in ["counts", "probs"]:
+            raise ValueError("Argument ``mode`` must be one of ['counts', 'probs'].")
+        self.mode = mode
+        self.add_state("counts", default=[], dist_reduce_fx="cat", feature_dtype=jax.numpy.int32)
+
+    def update(self, ratings: Array) -> None:
+        """Accumulate a batch of rating counts/probabilities."""
+        counts = _fleiss_kappa_update(ratings, self.mode)
+        self.counts.append(counts)
+
+    def compute(self) -> Array:
+        from tpumetrics.buffers import _BufferList
+
+        counts = self.counts
+        if isinstance(counts, _BufferList):
+            buf = counts.buffer
+            valid = buf.valid_mask()
+            # masked rows carry zero counts and a zero p_j numerator; exclude
+            # them from the sample mean by weighting
+            c = buf.values.astype(jax.numpy.float32)
+            import jax.numpy as jnp
+
+            num_raters = jnp.where(valid, c.sum(axis=1), 0.0).max()
+            total = jnp.sum(valid)
+            p_i = c.sum(axis=0) / (total * num_raters)
+            p_j = ((c**2).sum(axis=1) - num_raters) / (num_raters * (num_raters - 1))
+            p_bar = jnp.sum(jnp.where(valid, p_j, 0.0)) / total
+            pe_bar = (p_i**2).sum()
+            return (p_bar - pe_bar) / (1 - pe_bar + 1e-5)
+        return _fleiss_kappa_compute(dim_zero_cat(counts))

@@ -1,0 +1,60 @@
+"""TheilsU (counterpart of reference ``nominal/theils_u.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.nominal.theils_u import _theils_u_compute, _theils_u_update
+from tpumetrics.functional.nominal.utils import _nominal_input_validation
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class TheilsU(Metric):
+    """Theil's uncertainty coefficient U(X|Y) between two categorical series.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.nominal import TheilsU
+        >>> metric = TheilsU(num_classes=5)
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1, 3, 4])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 0, 3, 4])
+        >>> round(float(metric(preds, target)), 4)
+        0.7214
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    confmat: Array
+
+    def __init__(
+        self,
+        num_classes: int,
+        nan_strategy: str = "replace",
+        nan_replace_value: Optional[float] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_classes, int) or num_classes < 2:
+            raise ValueError(f"Argument `num_classes` is expected to be an integer >= 2, but got {num_classes}")
+        self.num_classes = num_classes
+        _nominal_input_validation(nan_strategy, nan_replace_value)
+        self.nan_strategy = nan_strategy
+        self.nan_replace_value = nan_replace_value
+        self.add_state("confmat", jnp.zeros((num_classes, num_classes)), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate the contingency table."""
+        confmat = _theils_u_update(preds, target, self.num_classes, self.nan_strategy, self.nan_replace_value)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        return _theils_u_compute(self.confmat)
