@@ -1,0 +1,318 @@
+"""Retrieval domain vs per-query sklearn/numpy references (counterpart of
+reference ``tests/unittests/retrieval/``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score, ndcg_score
+
+from tests.conftest import BATCH_SIZE, NUM_BATCHES
+from tests.helpers.testers import MetricTester
+from tpumetrics.functional.retrieval import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_precision_recall_curve,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from tpumetrics.retrieval import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalPrecisionRecallCurve,
+    RetrievalRecall,
+    RetrievalRecallAtFixedPrecision,
+    RetrievalRPrecision,
+)
+
+NUM_QUERIES = 8
+_rng = np.random.default_rng(33)
+PREDS = [jnp.asarray(_rng.random(BATCH_SIZE), dtype=jnp.float32) for _ in range(NUM_BATCHES)]
+TARGET = [jnp.asarray(_rng.random(BATCH_SIZE) < 0.35) for _ in range(NUM_BATCHES)]
+INDEXES = [jnp.asarray(_rng.integers(0, NUM_QUERIES, BATCH_SIZE)) for _ in range(NUM_BATCHES)]
+GRADED_TARGET = [jnp.asarray(_rng.integers(0, 4, BATCH_SIZE)) for _ in range(NUM_BATCHES)]
+
+
+# ------------------------- per-query numpy references
+
+
+def _np_ap(p, t, top_k=None):
+    order = np.argsort(-p, kind="stable")
+    t_k = t[order][: (top_k or len(t))]
+    if t_k.sum() == 0:
+        return 0.0
+    pos = np.nonzero(t_k)[0]
+    return float(np.mean((np.arange(len(pos)) + 1) / (pos + 1)))
+
+
+def _np_mrr(p, t, top_k=None):
+    order = np.argsort(-p, kind="stable")
+    t_k = t[order][: (top_k or len(t))]
+    pos = np.nonzero(t_k)[0]
+    return float(1.0 / (pos[0] + 1)) if len(pos) else 0.0
+
+
+def _np_precision(p, t, top_k=None, adaptive_k=False):
+    n = len(t)
+    k = top_k or n
+    if adaptive_k:
+        k = min(k, n)
+    order = np.argsort(-p, kind="stable")
+    return float(t[order][: min(k, n)].sum() / k)
+
+
+def _np_recall(p, t, top_k=None):
+    order = np.argsort(-p, kind="stable")
+    return float(t[order][: (top_k or len(t))].sum() / t.sum())
+
+
+def _np_fall_out(p, t, top_k=None):
+    neg = 1 - t
+    order = np.argsort(-p, kind="stable")
+    return float(neg[order][: (top_k or len(t))].sum() / neg.sum())
+
+
+def _np_hit_rate(p, t, top_k=None):
+    order = np.argsort(-p, kind="stable")
+    return float(t[order][: (top_k or len(t))].sum() > 0)
+
+
+def _np_r_precision(p, t):
+    r = int(t.sum())
+    order = np.argsort(-p, kind="stable")
+    return float(t[order][:r].sum() / r)
+
+
+def _np_ndcg(p, t, top_k=None):
+    return float(ndcg_score(np.asarray(t)[None], np.asarray(p)[None], k=top_k))
+
+
+def _np_grouped(per_query_fn, requires="positive", empty="neg"):
+    def ref(preds, target, indexes):
+        preds, target, indexes = np.asarray(preds), np.asarray(target), np.asarray(indexes)
+        res = []
+        for q in np.unique(indexes):
+            m = indexes == q
+            p, t = preds[m], target[m].astype(np.float64)
+            req = (1 - t).sum() if requires == "negative" else t.sum()
+            if req == 0:
+                if empty == "skip":
+                    continue
+                res.append(1.0 if empty == "pos" else 0.0)
+            else:
+                res.append(per_query_fn(p, t))
+        return float(np.mean(res)) if res else 0.0
+
+    return ref
+
+
+CLASS_CASES = [
+    (RetrievalMAP, {}, _np_grouped(_np_ap), TARGET, "map"),
+    (RetrievalMAP, {"top_k": 3}, _np_grouped(lambda p, t: _np_ap(p, t, 3)), TARGET, "map_top3"),
+    (RetrievalMRR, {}, _np_grouped(_np_mrr), TARGET, "mrr"),
+    (RetrievalPrecision, {"top_k": 4}, _np_grouped(lambda p, t: _np_precision(p, t, 4)), TARGET, "precision_top4"),
+    (
+        RetrievalPrecision,
+        {"top_k": 100, "adaptive_k": True},
+        _np_grouped(lambda p, t: _np_precision(p, t, 100, adaptive_k=True)),
+        TARGET,
+        "precision_adaptive",
+    ),
+    (RetrievalRecall, {"top_k": 4}, _np_grouped(lambda p, t: _np_recall(p, t, 4)), TARGET, "recall_top4"),
+    (RetrievalFallOut, {"top_k": 4}, _np_grouped(lambda p, t: _np_fall_out(p, t, 4), requires="negative", empty="pos"), TARGET, "fall_out_top4"),
+    (RetrievalHitRate, {"top_k": 4}, _np_grouped(lambda p, t: _np_hit_rate(p, t, 4)), TARGET, "hit_rate_top4"),
+    (RetrievalRPrecision, {}, _np_grouped(_np_r_precision), TARGET, "r_precision"),
+    (RetrievalNormalizedDCG, {}, _np_grouped(_np_ndcg), GRADED_TARGET, "ndcg"),
+    (RetrievalNormalizedDCG, {"top_k": 5}, _np_grouped(lambda p, t: _np_ndcg(p, t, 5)), GRADED_TARGET, "ndcg_top5"),
+]
+
+
+class TestRetrievalMetrics(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("metric_class, args, ref_fn, target, _id", CLASS_CASES, ids=[c[4] for c in CLASS_CASES])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, metric_class, args, ref_fn, target, _id, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=PREDS,
+            target=target,
+            metric_class=metric_class,
+            reference_metric=ref_fn,
+            metric_args=args,
+            check_batch=False,  # batch-level value covers only that batch's queries
+            indexes=INDEXES,
+        )
+
+
+@pytest.mark.parametrize(
+    "fn, np_fn, kwargs",
+    [
+        (retrieval_average_precision, _np_ap, {}),
+        (retrieval_reciprocal_rank, _np_mrr, {}),
+        (retrieval_precision, _np_precision, {"top_k": 3}),
+        (retrieval_recall, _np_recall, {"top_k": 3}),
+        (retrieval_fall_out, _np_fall_out, {"top_k": 3}),
+        (retrieval_hit_rate, _np_hit_rate, {"top_k": 3}),
+        (retrieval_r_precision, _np_r_precision, {}),
+    ],
+    ids=["ap", "mrr", "precision", "recall", "fall_out", "hit_rate", "r_precision"],
+)
+def test_functional_single_query(fn, np_fn, kwargs):
+    p = np.asarray(PREDS[0])
+    t = np.asarray(TARGET[0]).astype(np.float64)
+    got = float(fn(jnp.asarray(p), jnp.asarray(t > 0)))
+    assert np.isclose(got, np_fn(p, t), atol=1e-6)
+    if kwargs:
+        got = float(fn(jnp.asarray(p), jnp.asarray(t > 0), **kwargs))
+        assert np.isclose(got, np_fn(p, t, *kwargs.values()), atol=1e-6)
+
+
+def test_functional_ap_vs_sklearn():
+    p = np.asarray(PREDS[0])
+    t = np.asarray(TARGET[0])
+    got = float(retrieval_average_precision(jnp.asarray(p), jnp.asarray(t)))
+    assert np.isclose(got, average_precision_score(t, p), atol=1e-6)
+
+
+def test_functional_ndcg_vs_sklearn_with_ties():
+    p = np.round(np.asarray(PREDS[0]) * 4) / 4  # force score ties
+    t = np.asarray(GRADED_TARGET[0])
+    got = float(retrieval_normalized_dcg(jnp.asarray(p), jnp.asarray(t)))
+    assert np.isclose(got, ndcg_score(t[None], p[None]), atol=1e-5)
+    got = float(retrieval_normalized_dcg(jnp.asarray(p), jnp.asarray(t), top_k=5))
+    assert np.isclose(got, ndcg_score(t[None], p[None], k=5), atol=1e-5)
+
+
+def test_precision_recall_curve_matches_manual():
+    p = np.asarray(PREDS[0])
+    t = np.asarray(TARGET[0]).astype(np.float64)
+    prec, rec, topk = retrieval_precision_recall_curve(jnp.asarray(p), jnp.asarray(t > 0), max_k=10)
+    order = np.argsort(-p, kind="stable")
+    cum = np.cumsum(t[order])[:10]
+    assert np.allclose(np.asarray(prec), cum / np.arange(1, 11), atol=1e-6)
+    assert np.allclose(np.asarray(rec), cum / t.sum(), atol=1e-6)
+    assert np.array_equal(np.asarray(topk), np.arange(1, 11))
+
+
+@pytest.mark.parametrize("empty_action", ["neg", "pos", "skip"])
+def test_empty_target_actions(empty_action):
+    indexes = jnp.asarray([0, 0, 1, 1])
+    preds = jnp.asarray([0.3, 0.6, 0.4, 0.7])
+    target = jnp.asarray([True, False, False, False])  # query 1 has no positives
+    m = RetrievalMAP(empty_target_action=empty_action)
+    m.update(preds, target, indexes)
+    got = float(m.compute())
+    q0 = _np_ap(np.asarray(preds[:2]), np.asarray(target[:2], dtype=np.float64))
+    expected = {"neg": (q0 + 0.0) / 2, "pos": (q0 + 1.0) / 2, "skip": q0}[empty_action]
+    assert np.isclose(got, expected, atol=1e-6)
+
+
+def test_empty_target_error_action():
+    m = RetrievalMAP(empty_target_action="error")
+    m.update(jnp.asarray([0.3, 0.6]), jnp.asarray([False, False]), jnp.asarray([0, 0]))
+    with pytest.raises(ValueError, match="no positive target"):
+        m.compute()
+
+
+def test_ignore_index():
+    m = RetrievalMAP(ignore_index=-100)
+    preds = jnp.asarray([0.3, 0.6, 0.4, 0.7])
+    target = jnp.asarray([1, -100, 0, 1])
+    indexes = jnp.asarray([0, 0, 1, 1])
+    m.update(preds, target, indexes)
+    got = float(m.compute())
+    ref = (_np_ap(np.array([0.3]), np.array([1.0])) + _np_ap(np.array([0.4, 0.7]), np.array([0.0, 1.0]))) / 2
+    assert np.isclose(got, ref, atol=1e-6)
+
+
+def test_retrieval_fully_in_jit_with_buffers():
+    """The flagship path: buffered states + static num_queries → update and
+    compute both inside jit, uneven valid counts via capacity slack."""
+    cap = NUM_BATCHES * BATCH_SIZE + 32
+    m = RetrievalMAP(num_queries=NUM_QUERIES)
+    for name in ("indexes", "preds", "target"):
+        m.set_state_capacity(name, cap)
+
+    @jax.jit
+    def run(preds_b, target_b, indexes_b):
+        state = m.init_state()
+        for i in range(preds_b.shape[0]):
+            state = m.functional_update(state, preds_b[i], target_b[i], indexes_b[i])
+        return m.functional_compute(state)
+
+    got = float(run(jnp.stack(PREDS), jnp.stack([t.astype(jnp.float32) for t in TARGET]), jnp.stack(INDEXES)))
+    ref = _np_grouped(_np_ap)(
+        np.concatenate([np.asarray(p) for p in PREDS]),
+        np.concatenate([np.asarray(t) for t in TARGET]),
+        np.concatenate([np.asarray(i) for i in INDEXES]),
+    )
+    assert np.isclose(got, ref, atol=1e-5)
+
+
+def test_recall_at_fixed_precision():
+    m = RetrievalRecallAtFixedPrecision(min_precision=0.3, max_k=8)
+    for p, t, i in zip(PREDS, TARGET, INDEXES):
+        m.update(p, t, i)
+    max_recall, best_k = m.compute()
+
+    curve = RetrievalPrecisionRecallCurve(max_k=8)
+    for p, t, i in zip(PREDS, TARGET, INDEXES):
+        curve.update(p, t, i)
+    precisions, recalls, topk = curve.compute()
+    qualifying = [(float(r), int(k)) for p_, r, k in zip(np.asarray(precisions), np.asarray(recalls), np.asarray(topk)) if p_ >= 0.3]
+    exp_recall, exp_k = max(qualifying)
+    assert np.isclose(float(max_recall), exp_recall, atol=1e-6)
+    assert int(best_k) == exp_k
+
+
+def test_pr_curve_class_averages_queries():
+    curve = RetrievalPrecisionRecallCurve(max_k=5)
+    for p, t, i in zip(PREDS, TARGET, INDEXES):
+        curve.update(p, t, i)
+    precisions, recalls, topk = curve.compute()
+
+    preds = np.concatenate([np.asarray(p) for p in PREDS])
+    target = np.concatenate([np.asarray(t) for t in TARGET]).astype(np.float64)
+    indexes = np.concatenate([np.asarray(i) for i in INDEXES])
+    pk, rk = [], []
+    for q in np.unique(indexes):
+        mask = indexes == q
+        p_, t_ = preds[mask], target[mask]
+        if t_.sum() == 0:
+            pk.append(np.zeros(5)); rk.append(np.zeros(5))
+            continue
+        order = np.argsort(-p_, kind="stable")
+        cum = np.cumsum(np.pad(t_[order], (0, max(0, 5 - len(t_)))))[:5]
+        pk.append(cum / np.arange(1, 6))
+        rk.append(cum / t_.sum())
+    assert np.allclose(np.asarray(precisions), np.mean(pk, axis=0), atol=1e-6)
+    assert np.allclose(np.asarray(recalls), np.mean(rk, axis=0), atol=1e-6)
+
+
+def test_input_validation():
+    m = RetrievalMAP()
+    with pytest.raises(ValueError, match="`indexes` cannot be None"):
+        m.update(jnp.asarray([0.1]), jnp.asarray([1]), None)
+    with pytest.raises(ValueError, match="same shape"):
+        m.update(jnp.asarray([0.1, 0.2]), jnp.asarray([1]), jnp.asarray([0]))
+    with pytest.raises(ValueError, match="long integers"):
+        m.update(jnp.asarray([0.1]), jnp.asarray([1]), jnp.asarray([0.5]))
+    with pytest.raises(ValueError, match="binary"):
+        m.update(jnp.asarray([0.1]), jnp.asarray([3]), jnp.asarray([0]))
+    with pytest.raises(ValueError, match="empty_target_action"):
+        RetrievalMAP(empty_target_action="bad")
+    with pytest.raises(ValueError, match="ignore_index"):
+        RetrievalMAP(ignore_index=1.5)
+    with pytest.raises(ValueError, match="top_k"):
+        RetrievalPrecision(top_k=-1)

@@ -1,0 +1,264 @@
+"""Vectorized multi-query retrieval kernels — the TPU-native core.
+
+The reference computes retrieval metrics by sorting on host, splitting into
+per-query Python lists, and looping (``retrieval/base.py:125-147``, with a
+``.cpu().tolist()`` device sync at :125). That shape-dynamic loop cannot
+compile. Here every query is processed simultaneously:
+
+1. one ``lexsort`` by (query id, -score) puts each query's documents in
+   ranked order, contiguously;
+2. within-group ranks and cumulative relevances come from global cumsums
+   minus per-group offsets;
+3. per-query statistics are ``segment_sum``/``segment_min`` reductions over
+   the query-id segments;
+4. the empty-query policy (reference ``empty_target_action``) is a
+   where-mask over per-query validity.
+
+Everything is static-shape given ``num_queries``, so the whole metric —
+update, cross-device sync, and compute — runs inside one jitted step.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class SortedQueries(NamedTuple):
+    """Documents of all queries, ranked per query, plus per-query stats."""
+
+    idx: Array  # (N,) int32 sorted query ids; invalid rows hold num_queries
+    preds: Array  # (N,) float32, descending within each query
+    target: Array  # (N,) float32 relevance
+    rank: Array  # (N,) int32 0-based rank within its query
+    cum_target: Array  # (N,) within-query cumulative relevance (inclusive)
+    counts: Array  # (Q,) docs per query
+    pos: Array  # (Q,) total relevance per query
+    num_queries: int
+
+
+def sort_queries(
+    indexes: Array,
+    preds: Array,
+    target: Array,
+    num_queries: int,
+    mask: Optional[Array] = None,
+) -> SortedQueries:
+    """Rank all queries' documents with one lexsort + segment bookkeeping."""
+    idx = indexes.astype(jnp.int32)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    invalid = (idx < 0) | (idx >= num_queries)
+    if mask is not None:
+        invalid = invalid | ~mask
+    idx = jnp.where(invalid, num_queries, idx)
+
+    order = jnp.lexsort((-preds, idx))
+    idx_s = idx[order]
+    preds_s = preds[order]
+    target_s = target[order]
+
+    n = idx_s.shape[0]
+    ones = jnp.ones((n,), jnp.int32)
+    counts = jax.ops.segment_sum(ones, idx_s, num_segments=num_queries)
+    starts = jnp.cumsum(counts) - counts  # (Q,) first position of each query
+    positions = jnp.arange(n, dtype=jnp.int32)
+    rank = positions - starts[jnp.clip(idx_s, 0, num_queries - 1)]
+
+    cum_all = jnp.cumsum(target_s)
+    # inclusive within-group cumsum = global cumsum minus the total before the group
+    before_group = cum_all[jnp.clip(starts, 0, max(n - 1, 0))] - target_s[jnp.clip(starts, 0, max(n - 1, 0))]
+    cum_target = cum_all - before_group[jnp.clip(idx_s, 0, num_queries - 1)]
+
+    pos = jax.ops.segment_sum(target_s, idx_s, num_segments=num_queries)
+    return SortedQueries(idx_s, preds_s, target_s, rank, cum_target, counts, pos, num_queries)
+
+
+def _segment_sum(values: Array, sq: SortedQueries) -> Array:
+    return jax.ops.segment_sum(values, sq.idx, num_segments=sq.num_queries)
+
+
+def reduce_queries(
+    values: Array,
+    computable: Array,
+    observed: Array,
+    empty_target_action: str,
+    requirement: str = "positive",
+) -> Array:
+    """Mean over queries with the reference's empty-target policy
+    (reference retrieval/base.py:131-147) as where-masks.
+
+    ``computable`` marks queries with the required target present;
+    ``observed`` marks queries with any documents at all (index gaps between
+    0 and num_queries-1 never contribute, exactly like the reference, which
+    only iterates observed groups).
+    """
+    from tpumetrics.utils.data import _is_tracer
+
+    if empty_target_action == "error":
+        bad = observed & ~computable
+        if _is_tracer(bad):
+            raise NotImplementedError(
+                "empty_target_action='error' is a data-dependent host check and cannot run under jit;"
+                " use 'skip'/'neg'/'pos' inside compiled code."
+            )
+        if bool(jnp.any(bad)):
+            raise ValueError(f"`compute` method was provided with a query with no {requirement} target.")
+
+    if empty_target_action == "skip":
+        used = observed & computable
+        filler = jnp.zeros_like(values)
+    elif empty_target_action == "pos":
+        used = observed
+        filler = jnp.ones_like(values)
+    else:  # "neg" (and "error" after the check above)
+        used = observed
+        filler = jnp.zeros_like(values)
+
+    values = jnp.where(computable, values, filler)
+    total = jnp.sum(jnp.where(used, values, 0.0))
+    denom = jnp.sum(used)
+    return jnp.where(denom > 0, total / jnp.maximum(denom, 1), 0.0)
+
+
+def _topk_mask(sq: SortedQueries, top_k: Optional[int]) -> Array:
+    if top_k is None:
+        return jnp.ones_like(sq.rank, dtype=bool)
+    return sq.rank < top_k
+
+
+def grouped_precision(
+    sq: SortedQueries, top_k: Optional[int] = None, adaptive_k: bool = False
+) -> Tuple[Array, Array]:
+    """precision@k per query (reference functional/retrieval/precision.py)."""
+    k = jnp.asarray(top_k if top_k is not None else sq.counts.max(), jnp.float32)
+    denom = jnp.minimum(k, sq.counts.astype(jnp.float32)) if (adaptive_k or top_k is None) else k
+    rel = _segment_sum(sq.target * _topk_mask(sq, top_k), sq)
+    values = rel / jnp.maximum(denom, 1.0)
+    return values, sq.pos > 0
+
+
+def grouped_recall(sq: SortedQueries, top_k: Optional[int] = None) -> Tuple[Array, Array]:
+    """recall@k per query (reference functional/retrieval/recall.py)."""
+    rel = _segment_sum(sq.target * _topk_mask(sq, top_k), sq)
+    values = rel / jnp.maximum(sq.pos, 1.0)
+    return values, sq.pos > 0
+
+
+def grouped_fall_out(sq: SortedQueries, top_k: Optional[int] = None) -> Tuple[Array, Array]:
+    """fall-out@k per query: retrieved non-relevant / all non-relevant
+    (reference functional/retrieval/fall_out.py)."""
+    neg_target = 1.0 - sq.target
+    neg_total = _segment_sum(neg_target, sq)
+    neg_rel = _segment_sum(neg_target * _topk_mask(sq, top_k), sq)
+    values = neg_rel / jnp.maximum(neg_total, 1.0)
+    return values, neg_total > 0
+
+
+def grouped_hit_rate(sq: SortedQueries, top_k: Optional[int] = None) -> Tuple[Array, Array]:
+    """hit-rate@k per query (reference functional/retrieval/hit_rate.py)."""
+    rel = _segment_sum(sq.target * _topk_mask(sq, top_k), sq)
+    return (rel > 0).astype(jnp.float32), sq.pos > 0
+
+
+def grouped_r_precision(sq: SortedQueries) -> Tuple[Array, Array]:
+    """R-precision per query: precision at R = number of relevant docs
+    (reference functional/retrieval/r_precision.py)."""
+    r_of_doc = sq.pos[jnp.clip(sq.idx, 0, sq.num_queries - 1)]
+    rel = _segment_sum(sq.target * (sq.rank < r_of_doc), sq)
+    values = rel / jnp.maximum(sq.pos, 1.0)
+    return values, sq.pos > 0
+
+
+def grouped_reciprocal_rank(sq: SortedQueries, top_k: Optional[int] = None) -> Tuple[Array, Array]:
+    """MRR per query: 1 / rank of the first relevant document
+    (reference functional/retrieval/reciprocal_rank.py)."""
+    n = sq.rank.shape[0]
+    first_rel_rank = jax.ops.segment_min(
+        jnp.where(sq.target > 0, sq.rank, n), sq.idx, num_segments=sq.num_queries
+    )
+    in_k = first_rel_rank < (top_k if top_k is not None else n)
+    values = jnp.where(in_k, 1.0 / jnp.maximum(first_rel_rank + 1.0, 1.0), 0.0)
+    return values, sq.pos > 0
+
+
+def grouped_average_precision(sq: SortedQueries, top_k: Optional[int] = None) -> Tuple[Array, Array]:
+    """MAP per query: mean over relevant docs in the top-k of
+    (relevant seen so far) / (rank + 1) (reference functional/retrieval/average_precision.py)."""
+    in_k = _topk_mask(sq, top_k)
+    hits = sq.target * in_k
+    precision_at = sq.cum_target / (sq.rank + 1.0)
+    ap_sum = _segment_sum(hits * precision_at, sq)
+    rel_in_k = _segment_sum(hits, sq)
+    values = ap_sum / jnp.maximum(rel_in_k, 1.0)
+    return values, sq.pos > 0
+
+
+def grouped_ndcg(sq_by_pred: SortedQueries, sq_by_target: SortedQueries, top_k: Optional[int] = None) -> Tuple[Array, Array]:
+    """Tie-averaged nDCG per query (reference functional/retrieval/ndcg.py,
+    itself a port of sklearn's ``_tie_averaged_dcg``).
+
+    The per-tie-group averaging is expressed per element: each document
+    contributes (mean target of its tie group) * (its rank discount), which
+    sums to sklearn's per-group formulation. Tie groups are runs of equal
+    (query, score) pairs — adjacent after the lexsort — identified by one
+    change-detection cumsum.
+    """
+    n = sq_by_pred.rank.shape[0]
+    k = top_k if top_k is not None else n
+
+    discount = jnp.where(
+        sq_by_pred.rank < k, 1.0 / jnp.log2(sq_by_pred.rank.astype(jnp.float32) + 2.0), 0.0
+    )
+
+    same_as_prev = jnp.concatenate(
+        [
+            jnp.zeros((1,), bool),
+            (sq_by_pred.idx[1:] == sq_by_pred.idx[:-1]) & (sq_by_pred.preds[1:] == sq_by_pred.preds[:-1]),
+        ]
+    )
+    tie_id = jnp.cumsum(~same_as_prev) - 1
+    tie_count = jax.ops.segment_sum(jnp.ones((n,), jnp.float32), tie_id, num_segments=n)
+    tie_t_sum = jax.ops.segment_sum(sq_by_pred.target, tie_id, num_segments=n)
+    avg_t = (tie_t_sum / jnp.maximum(tie_count, 1.0))[tie_id]
+    dcg = _segment_sum(avg_t * discount, sq_by_pred)
+
+    ideal_discount = jnp.where(
+        sq_by_target.rank < k, 1.0 / jnp.log2(sq_by_target.rank.astype(jnp.float32) + 2.0), 0.0
+    )
+    idcg = _segment_sum(sq_by_target.target * ideal_discount, sq_by_target)
+
+    values = jnp.where(idcg > 0, dcg / jnp.where(idcg > 0, idcg, 1.0), 0.0)
+    return values, sq_by_pred.pos > 0
+
+
+def grouped_precision_recall_curve(
+    sq: SortedQueries, max_k: int, adaptive_k: bool = False
+) -> Tuple[Array, Array, Array]:
+    """(Q, max_k) precision/recall at every k per query
+    (reference functional/retrieval/precision_recall_curve.py).
+
+    One scatter of the ranked relevances into a dense (Q, max_k) grid, then a
+    cumsum along k — queries shorter than max_k plateau, exactly like the
+    reference's zero-padding.
+    """
+    q = sq.num_queries
+    flat = jnp.zeros((q * max_k,), jnp.float32)
+    dest = jnp.where(
+        (sq.rank < max_k) & (sq.idx < q), jnp.clip(sq.idx, 0, q - 1) * max_k + sq.rank, q * max_k
+    )
+    flat = flat.at[dest].add(sq.target, mode="drop")
+    rel_cum = jnp.cumsum(flat.reshape(q, max_k), axis=1)
+
+    topk = jnp.arange(1, max_k + 1, dtype=jnp.float32)[None, :]
+    if adaptive_k:
+        denom = jnp.minimum(topk, jnp.maximum(sq.counts[:, None].astype(jnp.float32), 1.0))
+    else:
+        denom = topk
+    precision = rel_cum / denom
+    recall = rel_cum / jnp.maximum(sq.pos[:, None], 1.0)
+    return precision, recall, sq.pos > 0

@@ -1,0 +1,34 @@
+"""Retrieval average precision (counterpart of reference
+``functional/retrieval/average_precision.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.retrieval._grouped import grouped_average_precision
+from tpumetrics.functional.retrieval.precision import _single_query, _validate_top_k
+from tpumetrics.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_average_precision(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Average precision over the top k for a single query (reference
+    average_precision.py:21-58).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.retrieval import retrieval_average_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> round(float(retrieval_average_precision(preds, target)), 4)
+        0.8333
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _validate_top_k(top_k)
+    sq = _single_query(preds, target)
+    values, computable = grouped_average_precision(sq, top_k)
+    return jnp.where(computable[0], values[0], 0.0)

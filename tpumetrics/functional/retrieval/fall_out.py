@@ -1,0 +1,34 @@
+"""Retrieval fall-out (counterpart of reference ``functional/retrieval/fall_out.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.retrieval._grouped import grouped_fall_out
+from tpumetrics.functional.retrieval.precision import _single_query, _validate_top_k
+from tpumetrics.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_fall_out(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Fall-out@k for a single query (reference fall_out.py:21-69): fraction
+    of the non-relevant documents retrieved in the top k; 0.0 when the query
+    has no negative target.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.retrieval import retrieval_fall_out
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> float(retrieval_fall_out(preds, target, top_k=2))
+        1.0
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _validate_top_k(top_k)
+    sq = _single_query(preds, target)
+    values, computable = grouped_fall_out(sq, top_k)
+    return jnp.where(computable[0], values[0], 0.0)

@@ -1,0 +1,33 @@
+"""Retrieval hit rate (counterpart of reference ``functional/retrieval/hit_rate.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.retrieval._grouped import grouped_hit_rate
+from tpumetrics.functional.retrieval.precision import _single_query, _validate_top_k
+from tpumetrics.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_hit_rate(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Hit rate@k for a single query (reference hit_rate.py:21-61): 1.0 when
+    any relevant document appears in the top k.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.retrieval import retrieval_hit_rate
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> float(retrieval_hit_rate(preds, target, top_k=2))
+        1.0
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _validate_top_k(top_k)
+    sq = _single_query(preds, target)
+    values, computable = grouped_hit_rate(sq, top_k)
+    return jnp.where(computable[0], values[0], 0.0)

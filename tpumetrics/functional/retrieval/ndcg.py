@@ -1,0 +1,36 @@
+"""Retrieval normalized DCG (counterpart of reference
+``functional/retrieval/ndcg.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.retrieval._grouped import grouped_ndcg, sort_queries
+from tpumetrics.functional.retrieval.precision import _validate_top_k
+from tpumetrics.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Tie-averaged nDCG@k for a single query (reference ndcg.py:22-117, a
+    port of sklearn's dcg machinery); supports graded (non-binary) relevance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.retrieval import retrieval_normalized_dcg
+        >>> preds = jnp.asarray([.1, .2, .3, 4., 70.])
+        >>> target = jnp.asarray([10, 0, 0, 1, 5])
+        >>> round(float(retrieval_normalized_dcg(preds, target)), 4)
+        0.6957
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
+    _validate_top_k(top_k)
+    zeros = jnp.zeros(preds.shape, jnp.int32)
+    sq_pred = sort_queries(zeros, preds, target, 1)
+    sq_tgt = sort_queries(zeros, target, target, 1)
+    values, _ = grouped_ndcg(sq_pred, sq_tgt, top_k)
+    return values[0]

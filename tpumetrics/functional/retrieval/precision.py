@@ -1,0 +1,47 @@
+"""Retrieval precision (counterpart of reference
+``functional/retrieval/precision.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.retrieval._grouped import grouped_precision, sort_queries
+from tpumetrics.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def _validate_top_k(top_k: Optional[int]) -> None:
+    if top_k is not None and not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+
+
+def _single_query(preds: Array, target: Array):
+    return sort_queries(jnp.zeros(preds.shape, jnp.int32), preds, target, 1)
+
+
+def retrieval_precision(
+    preds: Array, target: Array, top_k: Optional[int] = None, adaptive_k: bool = False
+) -> Array:
+    """Precision@k for a single query (reference precision.py:21-74): fraction
+    of the top-k retrieved documents that are relevant; 0.0 when the query has
+    no positive target.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.retrieval import retrieval_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> float(retrieval_precision(preds, target, top_k=2))
+        0.5
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    _validate_top_k(top_k)
+    sq = _single_query(preds, target)
+    values, computable = grouped_precision(sq, top_k, adaptive_k)
+    return jnp.where(computable[0], values[0], 0.0)

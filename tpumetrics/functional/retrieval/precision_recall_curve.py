@@ -1,0 +1,75 @@
+"""Retrieval precision-recall curve (counterpart of reference
+``functional/retrieval/precision_recall_curve.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.retrieval._grouped import grouped_precision_recall_curve, sort_queries
+from tpumetrics.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def _retrieval_recall_at_fixed_precision(
+    precision: Array, recall: Array, top_k: Array, min_precision: float
+) -> Tuple[Array, Array]:
+    """Max recall whose precision is >= ``min_precision``, with its k
+    (reference retrieval/precision_recall_curve.py:30-58), as where-masks:
+    no qualifying point (or zero max recall) maps best_k to ``len(top_k)``."""
+    qualifying = precision >= min_precision
+    masked_recall = jnp.where(qualifying, recall, -jnp.inf)
+    max_recall = masked_recall.max()
+    # the reference's lexicographic max prefers the largest k on recall ties
+    at_max = qualifying & (masked_recall == max_recall)
+    best_k = jnp.where(at_max, top_k, -1).max()
+    none_qualify = ~jnp.any(qualifying)
+    max_recall = jnp.where(none_qualify, 0.0, max_recall)
+    fallback_k = jnp.asarray(top_k.shape[0], best_k.dtype)
+    best_k = jnp.where(none_qualify | (max_recall == 0.0), fallback_k, best_k)
+    return max_recall.astype(jnp.float32), best_k
+
+
+def retrieval_precision_recall_curve(
+    preds: Array, target: Array, max_k: Optional[int] = None, adaptive_k: bool = False
+) -> Tuple[Array, Array, Array]:
+    """Precision/recall at every k in ``1..max_k`` for a single query
+    (reference precision_recall_curve.py:61-142).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.retrieval import retrieval_precision_recall_curve
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> precision, recall, top_k = retrieval_precision_recall_curve(preds, target)
+        >>> import numpy as np
+        >>> np.round(np.asarray(precision, dtype=np.float64), 4).tolist()
+        [1.0, 0.5, 0.6667]
+        >>> recall.tolist()
+        [0.5, 0.5, 1.0]
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    if max_k is None:
+        max_k = preds.shape[-1]
+    if not (isinstance(max_k, int) and max_k > 0):
+        raise ValueError("`max_k` has to be a positive integer or None")
+
+    n = preds.shape[-1]
+    if adaptive_k and max_k > n:
+        topk = jnp.concatenate(
+            [jnp.arange(1, n + 1, dtype=jnp.float32), jnp.full((max_k - n,), float(n), jnp.float32)]
+        )
+    else:
+        topk = jnp.arange(1, max_k + 1, dtype=jnp.float32)
+
+    sq = sort_queries(jnp.zeros(preds.shape, jnp.int32), preds, target, 1)
+    precision, recall, computable = grouped_precision_recall_curve(sq, max_k, adaptive_k)
+    empty = ~computable[0]
+    precision = jnp.where(empty, jnp.zeros((max_k,)), precision[0])
+    recall = jnp.where(empty, jnp.zeros((max_k,)), recall[0])
+    return precision, recall, topk

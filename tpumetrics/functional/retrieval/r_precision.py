@@ -1,0 +1,31 @@
+"""Retrieval R-precision (counterpart of reference
+``functional/retrieval/r_precision.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.retrieval._grouped import grouped_r_precision
+from tpumetrics.functional.retrieval.precision import _single_query
+from tpumetrics.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_r_precision(preds: Array, target: Array) -> Array:
+    """R-precision for a single query (reference r_precision.py:21-56):
+    precision at R, where R is the query's number of relevant documents.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.retrieval import retrieval_r_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> float(retrieval_r_precision(preds, target))
+        0.5
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    sq = _single_query(preds, target)
+    values, computable = grouped_r_precision(sq)
+    return jnp.where(computable[0], values[0], 0.0)

@@ -1,0 +1,33 @@
+"""Retrieval recall (counterpart of reference ``functional/retrieval/recall.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.retrieval._grouped import grouped_recall
+from tpumetrics.functional.retrieval.precision import _single_query, _validate_top_k
+from tpumetrics.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_recall(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Recall@k for a single query (reference recall.py:21-68): fraction of
+    the relevant documents retrieved in the top k.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.retrieval import retrieval_recall
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> float(retrieval_recall(preds, target, top_k=2))
+        0.5
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _validate_top_k(top_k)
+    sq = _single_query(preds, target)
+    values, computable = grouped_recall(sq, top_k)
+    return jnp.where(computable[0], values[0], 0.0)

@@ -1,0 +1,34 @@
+"""Retrieval reciprocal rank (counterpart of reference
+``functional/retrieval/reciprocal_rank.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.retrieval._grouped import grouped_reciprocal_rank
+from tpumetrics.functional.retrieval.precision import _single_query, _validate_top_k
+from tpumetrics.utils.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Reciprocal rank of the first relevant document in the top k
+    (reference reciprocal_rank.py:21-59).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.retrieval import retrieval_reciprocal_rank
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([False, True, False])
+        >>> float(retrieval_reciprocal_rank(preds, target))
+        0.5
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _validate_top_k(top_k)
+    sq = _single_query(preds, target)
+    values, computable = grouped_reciprocal_rank(sq, top_k)
+    return jnp.where(computable[0], values[0], 0.0)

@@ -1,0 +1,29 @@
+"""Retrieval metric domain (counterpart of reference ``retrieval/__init__.py``)."""
+
+from tpumetrics.retrieval.average_precision import RetrievalMAP
+from tpumetrics.retrieval.base import RetrievalMetric
+from tpumetrics.retrieval.fall_out import RetrievalFallOut
+from tpumetrics.retrieval.hit_rate import RetrievalHitRate
+from tpumetrics.retrieval.ndcg import RetrievalNormalizedDCG
+from tpumetrics.retrieval.precision import RetrievalPrecision
+from tpumetrics.retrieval.precision_recall_curve import (
+    RetrievalPrecisionRecallCurve,
+    RetrievalRecallAtFixedPrecision,
+)
+from tpumetrics.retrieval.r_precision import RetrievalRPrecision
+from tpumetrics.retrieval.recall import RetrievalRecall
+from tpumetrics.retrieval.reciprocal_rank import RetrievalMRR
+
+__all__ = [
+    "RetrievalFallOut",
+    "RetrievalHitRate",
+    "RetrievalMAP",
+    "RetrievalMRR",
+    "RetrievalMetric",
+    "RetrievalNormalizedDCG",
+    "RetrievalPrecision",
+    "RetrievalPrecisionRecallCurve",
+    "RetrievalRecall",
+    "RetrievalRecallAtFixedPrecision",
+    "RetrievalRPrecision",
+]

@@ -1,0 +1,127 @@
+"""RetrievalMetric base (counterpart of reference ``retrieval/base.py:25``).
+
+The reference's ``compute`` sorts on host, splits per query with a
+``.cpu().tolist()`` sync (reference retrieval/base.py:125-130), and loops in
+Python. Here compute is one :func:`~tpumetrics.functional.retrieval._grouped.sort_queries`
+lexsort + segment reductions over **all** queries at once — no host sync, no
+dynamic shapes — so with ``num_queries`` declared the whole metric (update,
+cross-device sync of the fixed-capacity document buffers, and compute) runs
+inside a jitted/shard_map-ed step.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.buffers import _BufferList
+from tpumetrics.functional.retrieval._grouped import SortedQueries, reduce_queries, sort_queries
+from tpumetrics.metric import Metric
+from tpumetrics.utils.checks import _check_retrieval_inputs
+from tpumetrics.utils.data import _is_tracer, dim_zero_cat
+
+Array = jax.Array
+
+
+class RetrievalMetric(Metric, ABC):
+    """Base for query-grouped retrieval metrics fed (preds, target, indexes).
+
+    Args:
+        empty_target_action: policy for queries without the required target
+            (``neg``: count 0.0; ``pos``: count 1.0; ``skip``: exclude;
+            ``error``: raise — eager only).
+        ignore_index: target value whose rows are dropped (as a validity
+            mask, so it stays jit-safe).
+        num_queries: static number of queries (TPU extension). Required for
+            in-jit compute; inferred from observed indexes eagerly otherwise.
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+
+    indexes: List[Array]
+    preds: List[Array]
+    target: List[Array]
+
+    allow_non_binary_target: bool = False
+    _empty_requirement: str = "positive"
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        num_queries: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+
+        empty_target_action_options = ("error", "skip", "neg", "pos")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+        self.num_queries = num_queries
+
+        self.add_state("indexes", default=[], dist_reduce_fx=None, feature_dtype=jnp.int32)
+        self.add_state("preds", default=[], dist_reduce_fx=None)
+        self.add_state("target", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        """Validate, flatten, and append; ``ignore_index`` rows are masked
+        out rather than dropped (static shapes)."""
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        indexes, preds, target, keep = _check_retrieval_inputs(
+            indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target,
+            ignore_index=self.ignore_index,
+        )
+        self._append_state("indexes", indexes, valid=keep)
+        self._append_state("preds", preds, valid=keep)
+        self._append_state("target", target, valid=keep)
+
+    def _flat_state(self) -> Tuple[Array, Array, Array, Optional[Array], int]:
+        """(indexes, preds, target, valid_mask, num_queries) from the state."""
+        if isinstance(self.indexes, _BufferList):
+            idx = self.indexes.buffer.values
+            preds = self.preds.buffer.values
+            target = self.target.buffer.values
+            mask = self.indexes.buffer.valid_mask()
+        else:
+            idx = dim_zero_cat(self.indexes) if self.indexes else jnp.zeros((0,), jnp.int32)
+            preds = dim_zero_cat(self.preds) if self.preds else jnp.zeros((0,), jnp.float32)
+            target = dim_zero_cat(self.target) if self.target else jnp.zeros((0,), jnp.float32)
+            mask = None
+
+        num_queries = self.num_queries
+        if num_queries is None:
+            if _is_tracer(idx):
+                raise ValueError(
+                    "Retrieval metrics need a static `num_queries` to compute under jit;"
+                    " pass num_queries= at construction or compute eagerly."
+                )
+            valid_idx = idx if mask is None else idx[jnp.asarray(mask)]
+            num_queries = int(valid_idx.max()) + 1 if valid_idx.size else 1
+        return idx, preds, target, mask, num_queries
+
+    def compute(self) -> Array:
+        """Rank every query and reduce per-query scores with the
+        empty-target policy (reference retrieval/base.py:116-147)."""
+        idx, preds, target, mask, num_queries = self._flat_state()
+        if idx.shape[0] == 0:
+            return jnp.zeros((), jnp.float32)
+        sq = sort_queries(idx, preds, target, num_queries, mask)
+        values, computable = self._grouped_metric(sq)
+        return reduce_queries(
+            values, computable, sq.counts > 0, self.empty_target_action, self._empty_requirement
+        )
+
+    @abstractmethod
+    def _grouped_metric(self, sq: SortedQueries) -> Tuple[Array, Array]:
+        """Per-query (values, computable) for all queries at once."""

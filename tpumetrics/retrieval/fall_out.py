@@ -1,0 +1,45 @@
+"""RetrievalFallOut (counterpart of reference ``retrieval/fall_out.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+
+from tpumetrics.functional.retrieval._grouped import SortedQueries, grouped_fall_out
+from tpumetrics.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalFallOut(RetrievalMetric):
+    """Mean fall-out@k over queries; the empty-target policy keys on queries
+    with no *negative* target (reference fall_out.py compute override).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.retrieval import RetrievalFallOut
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> fo2 = RetrievalFallOut(top_k=2)
+        >>> round(float(fo2(preds, target, indexes=indexes)), 4)
+        0.5
+    """
+
+    higher_is_better: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    _empty_requirement: str = "negative"
+
+    def __init__(self, top_k: Optional[int] = None, empty_target_action: str = "pos", **kwargs: Any) -> None:
+        # default differs from the base: a query with no negatives counts as
+        # worst-case 1.0 fall-out (reference fall_out.py:89)
+        super().__init__(empty_target_action=empty_target_action, **kwargs)
+        if top_k is not None and not (isinstance(top_k, int) and top_k > 0):
+            raise ValueError("`top_k` has to be a positive integer or None")
+        self.top_k = top_k
+
+    def _grouped_metric(self, sq: SortedQueries) -> Tuple[Array, Array]:
+        return grouped_fall_out(sq, self.top_k)

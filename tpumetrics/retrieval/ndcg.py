@@ -1,0 +1,54 @@
+"""RetrievalNormalizedDCG (counterpart of reference ``retrieval/ndcg.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.retrieval._grouped import grouped_ndcg, reduce_queries, sort_queries
+from tpumetrics.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalNormalizedDCG(RetrievalMetric):
+    """Mean (tie-averaged) nDCG@k over queries; targets may be graded.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.retrieval import RetrievalNormalizedDCG
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> ndcg = RetrievalNormalizedDCG()
+        >>> round(float(ndcg(preds, target, indexes=indexes)), 4)
+        0.8467
+    """
+
+    allow_non_binary_target: bool = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if top_k is not None and not (isinstance(top_k, int) and top_k > 0):
+            raise ValueError("`top_k` has to be a positive integer or None")
+        self.top_k = top_k
+
+    def _grouped_metric(self, sq):  # pragma: no cover - unused, compute overridden
+        raise NotImplementedError
+
+    def compute(self) -> Array:
+        """nDCG needs a second (ideal) ranking by target; both rankings are
+        one lexsort each, then the tie-averaged gains reduce per query."""
+        idx, preds, target, mask, num_queries = self._flat_state()
+        if idx.shape[0] == 0:
+            return jnp.zeros((), jnp.float32)
+        sq_pred = sort_queries(idx, preds, target, num_queries, mask)
+        sq_tgt = sort_queries(idx, target, target, num_queries, mask)
+        values, computable = grouped_ndcg(sq_pred, sq_tgt, self.top_k)
+        return reduce_queries(
+            values, computable, sq_pred.counts > 0, self.empty_target_action, self._empty_requirement
+        )

@@ -1,0 +1,42 @@
+"""RetrievalPrecision (counterpart of reference ``retrieval/precision.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+
+from tpumetrics.functional.retrieval._grouped import SortedQueries, grouped_precision
+from tpumetrics.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalPrecision(RetrievalMetric):
+    """Mean precision@k over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.retrieval import RetrievalPrecision
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> p2 = RetrievalPrecision(top_k=2)
+        >>> float(p2(preds, target, indexes=indexes))
+        0.5
+    """
+
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, top_k: Optional[int] = None, adaptive_k: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if top_k is not None and not (isinstance(top_k, int) and top_k > 0):
+            raise ValueError("`top_k` has to be a positive integer or None")
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.top_k = top_k
+        self.adaptive_k = adaptive_k
+
+    def _grouped_metric(self, sq: SortedQueries) -> Tuple[Array, Array]:
+        return grouped_precision(sq, self.top_k, self.adaptive_k)

@@ -1,0 +1,132 @@
+"""RetrievalPrecisionRecallCurve + RetrievalRecallAtFixedPrecision
+(counterpart of reference ``retrieval/precision_recall_curve.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.retrieval._grouped import grouped_precision_recall_curve, sort_queries
+from tpumetrics.functional.retrieval.precision_recall_curve import _retrieval_recall_at_fixed_precision
+from tpumetrics.retrieval.base import RetrievalMetric
+from tpumetrics.utils.data import _is_tracer
+
+Array = jax.Array
+
+
+class RetrievalPrecisionRecallCurve(RetrievalMetric):
+    """Average precision/recall at every k in ``1..max_k`` over queries
+    (reference precision_recall_curve.py:61-219).
+
+    The reference loops queries and stacks per-query curves; here the whole
+    (num_queries, max_k) grid is built with one scatter + cumsum, and the
+    empty-target policy is a row mask.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.retrieval import RetrievalPrecisionRecallCurve
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([True, False, True, False, True, False, True])
+        >>> curve = RetrievalPrecisionRecallCurve(max_k=2)
+        >>> precisions, recalls, top_k = curve(preds, target, indexes=indexes)
+        >>> precisions.tolist()
+        [0.5, 0.5]
+        >>> recalls.tolist()
+        [0.25, 0.5]
+        >>> top_k.tolist()
+        [1, 2]
+    """
+
+    higher_is_better: bool = True
+
+    def __init__(
+        self,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if max_k is not None and not (isinstance(max_k, int) and max_k > 0):
+            raise ValueError("`max_k` has to be a positive integer or None")
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.max_k = max_k
+        self.adaptive_k = adaptive_k
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        idx, preds, target, mask, num_queries = self._flat_state()
+        sq = sort_queries(idx, preds, target, num_queries, mask)
+        max_k = self.max_k
+        if max_k is None:
+            if _is_tracer(idx):
+                raise ValueError("Pass a static `max_k` to compute the retrieval PR curve under jit.")
+            max_k = max(int(sq.counts.max()), 1)
+        precision_qk, recall_qk, computable = grouped_precision_recall_curve(sq, max_k, self.adaptive_k)
+        observed = sq.counts > 0
+
+        if self.empty_target_action == "error":
+            bad = observed & ~computable
+            if _is_tracer(bad):
+                raise NotImplementedError(
+                    "empty_target_action='error' cannot run under jit; use 'skip'/'neg'/'pos'."
+                )
+            if bool(jnp.any(bad)):
+                raise ValueError("`compute` method was provided with a query with no positive target.")
+
+        if self.empty_target_action == "skip":
+            used = observed & computable
+            fill_p = fill_r = jnp.zeros_like(precision_qk)
+        elif self.empty_target_action == "pos":
+            used = observed
+            fill_p = fill_r = jnp.ones_like(precision_qk)
+        else:
+            used = observed
+            fill_p = fill_r = jnp.zeros_like(precision_qk)
+
+        precision_qk = jnp.where(computable[:, None], precision_qk, fill_p)
+        recall_qk = jnp.where(computable[:, None], recall_qk, fill_r)
+        denom = jnp.maximum(jnp.sum(used), 1)
+        any_used = jnp.sum(used) > 0
+        precision = jnp.where(any_used, jnp.sum(jnp.where(used[:, None], precision_qk, 0.0), axis=0) / denom, 0.0)
+        recall = jnp.where(any_used, jnp.sum(jnp.where(used[:, None], recall_qk, 0.0), axis=0) / denom, 0.0)
+        top_k = jnp.arange(1, max_k + 1, dtype=jnp.int32)
+        return precision, recall, top_k
+
+    def _grouped_metric(self, sq):  # pragma: no cover - unused, compute overridden
+        raise NotImplementedError
+
+
+class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
+    """Highest recall whose averaged precision@k clears ``min_precision``,
+    plus the k achieving it (reference precision_recall_curve.py:222-312).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.retrieval import RetrievalRecallAtFixedPrecision
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([True, False, True, False, True, False, True])
+        >>> metric = RetrievalRecallAtFixedPrecision(min_precision=0.5)
+        >>> max_recall, best_k = metric(preds, target, indexes=indexes)
+        >>> (round(float(max_recall), 4), int(best_k))
+        (1.0, 4)
+    """
+
+    def __init__(
+        self,
+        min_precision: float = 0.0,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(max_k=max_k, adaptive_k=adaptive_k, **kwargs)
+        if not (isinstance(min_precision, float) and 0.0 <= min_precision <= 1.0):
+            raise ValueError("`min_precision` has to be a positive float between 0 and 1")
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
+        precisions, recalls, top_k = super().compute()
+        return _retrieval_recall_at_fixed_precision(precisions, recalls, top_k, self.min_precision)

@@ -1,0 +1,33 @@
+"""RetrievalRPrecision (counterpart of reference ``retrieval/r_precision.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+
+from tpumetrics.functional.retrieval._grouped import SortedQueries, grouped_r_precision
+from tpumetrics.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """Mean R-precision over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.retrieval import RetrievalRPrecision
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> p2 = RetrievalRPrecision()
+        >>> round(float(p2(preds, target, indexes=indexes)), 4)
+        0.75
+    """
+
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def _grouped_metric(self, sq: SortedQueries) -> Tuple[Array, Array]:
+        return grouped_r_precision(sq)

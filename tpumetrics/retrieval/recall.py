@@ -1,0 +1,39 @@
+"""RetrievalRecall (counterpart of reference ``retrieval/recall.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+
+from tpumetrics.functional.retrieval._grouped import SortedQueries, grouped_recall
+from tpumetrics.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalRecall(RetrievalMetric):
+    """Mean recall@k over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.retrieval import RetrievalRecall
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> r2 = RetrievalRecall(top_k=2)
+        >>> round(float(r2(preds, target, indexes=indexes)), 4)
+        0.75
+    """
+
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if top_k is not None and not (isinstance(top_k, int) and top_k > 0):
+            raise ValueError("`top_k` has to be a positive integer or None")
+        self.top_k = top_k
+
+    def _grouped_metric(self, sq: SortedQueries) -> Tuple[Array, Array]:
+        return grouped_recall(sq, self.top_k)

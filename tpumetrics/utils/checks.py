@@ -114,3 +114,68 @@ def _try_proceed_with_timeout(fn: Callable, timeout: int = 15) -> bool:
     proc.terminate()
     proc.join()
     return False
+
+# ------------------------------------------------------- retrieval inputs
+
+
+def _check_retrieval_target_and_prediction_types(
+    preds: Array, target: Array, allow_non_binary_target: bool = False
+) -> Tuple[Array, Array]:
+    """Dtype checks + flatten for retrieval inputs (reference checks.py:598-630)."""
+    if jnp.issubdtype(target.dtype, jnp.complexfloating):
+        raise ValueError("`target` must be a tensor of booleans, integers or floats")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError("`preds` must be a tensor of floats")
+    if not allow_non_binary_target and not _is_tracer(target):
+        if bool((target.max() > 1) | (target.min() < 0)):
+            raise ValueError("`target` must contain `binary` values")
+    target = target.astype(jnp.float32)
+    preds = preds.astype(jnp.float32)
+    return preds.ravel(), target.ravel()
+
+
+def _check_retrieval_functional_inputs(
+    preds: Array, target: Array, allow_non_binary_target: bool = False
+) -> Tuple[Array, Array]:
+    """Shape/dtype validation for single-query retrieval functions
+    (reference checks.py:509-538)."""
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must be of the same shape")
+    if preds.size == 0 or preds.ndim == 0:
+        raise ValueError("`preds` and `target` must be non-empty and non-scalar tensors")
+    return _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
+
+
+def _check_retrieval_inputs(
+    indexes: Array,
+    preds: Array,
+    target: Array,
+    allow_non_binary_target: bool = False,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Optional[Array]]:
+    """Shape/dtype validation for batched retrieval updates (reference
+    checks.py:541-595).
+
+    Where the reference physically drops rows whose target equals
+    ``ignore_index`` (a shape change), this returns a keep-mask as a fourth
+    value — jit-safe, and exact on the eager path too (masked rows are
+    dropped by the list-state append, routed to the dump slot by buffers).
+    """
+    if indexes.shape != preds.shape or preds.shape != target.shape:
+        raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+    if not jnp.issubdtype(indexes.dtype, jnp.integer):
+        raise ValueError("`indexes` must be a tensor of long integers")
+    if indexes.size == 0 or indexes.ndim == 0:
+        raise ValueError("`indexes`, `preds` and `target` must be non-empty and non-scalar tensors")
+
+    keep = None
+    if ignore_index is not None:
+        keep = (target != ignore_index).ravel()
+        # the binary-values check must only see kept rows (ignore_index
+        # itself may be outside [0, 1], reference drops those rows first)
+        target = jnp.where(target == ignore_index, jnp.zeros_like(target), target)
+
+    preds, target = _check_retrieval_target_and_prediction_types(
+        preds, target, allow_non_binary_target=allow_non_binary_target
+    )
+    return indexes.ravel().astype(jnp.int32), preds, target, keep
