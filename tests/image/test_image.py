@@ -1,0 +1,358 @@
+"""Image pure-math tier vs scipy/numpy references (counterpart of reference
+``tests/unittests/image/``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.ndimage import uniform_filter
+from scipy.signal import convolve2d
+
+from tests.conftest import NUM_BATCHES
+from tests.helpers.testers import MetricTester
+from tpumetrics.functional.image import (
+    error_relative_global_dimensionless_synthesis,
+    image_gradients,
+    multiscale_structural_similarity_index_measure,
+    peak_signal_noise_ratio,
+    peak_signal_noise_ratio_with_blocked_effect,
+    relative_average_spectral_error,
+    root_mean_squared_error_using_sliding_window,
+    spectral_angle_mapper,
+    spectral_distortion_index,
+    structural_similarity_index_measure,
+    total_variation,
+    universal_image_quality_index,
+    visual_information_fidelity,
+)
+from tpumetrics.image import (
+    ErrorRelativeGlobalDimensionlessSynthesis,
+    MultiScaleStructuralSimilarityIndexMeasure,
+    PeakSignalNoiseRatio,
+    PeakSignalNoiseRatioWithBlockedEffect,
+    RelativeAverageSpectralError,
+    RootMeanSquaredErrorUsingSlidingWindow,
+    SpectralAngleMapper,
+    SpectralDistortionIndex,
+    StructuralSimilarityIndexMeasure,
+    TotalVariation,
+    UniversalImageQualityIndex,
+    VisualInformationFidelity,
+)
+
+BATCH, C, H, W = 4, 3, 24, 24
+_rng = np.random.default_rng(21)
+PREDS = [jnp.asarray(_rng.random((BATCH, C, H, W)), dtype=jnp.float32) for _ in range(NUM_BATCHES)]
+TARGET = [jnp.asarray(np.clip(np.asarray(p) * 0.8 + 0.1 * _rng.random((BATCH, C, H, W)), 0, 1), dtype=jnp.float32) for p in PREDS]
+
+
+# ---------------------------------------------------------- numpy references
+
+
+def _np_gauss1d(ks, sigma):
+    d = np.arange((1 - ks) / 2, (1 + ks) / 2)
+    g = np.exp(-((d / sigma) ** 2) / 2)
+    return g / g.sum()
+
+
+def _np_ssim(p, t, sigma=1.5, data_range=1.0, k1=0.01, k2=0.03):
+    """Gaussian-window SSIM mirroring the Wang et al. formulation."""
+    gks = int(3.5 * sigma + 0.5) * 2 + 1
+    pad = (gks - 1) // 2
+    k1d = _np_gauss1d(gks, sigma)
+    kern = np.outer(k1d, k1d)
+    c1, c2 = (k1 * data_range) ** 2, (k2 * data_range) ** 2
+    per_image = []
+    for b in range(p.shape[0]):
+        ch = []
+        for c in range(p.shape[1]):
+            pp = np.pad(p[b, c], pad, mode="reflect")
+            tt = np.pad(t[b, c], pad, mode="reflect")
+            conv = lambda x: convolve2d(x, kern, mode="valid")  # noqa: E731
+            mp, mt = conv(pp), conv(tt)
+            sp2 = conv(pp * pp) - mp**2
+            st2 = conv(tt * tt) - mt**2
+            spt = conv(pp * tt) - mp * mt
+            s = ((2 * mp * mt + c1) * (2 * spt + c2)) / ((mp**2 + mt**2 + c1) * (sp2 + st2 + c2))
+            ch.append(s[pad:-pad, pad:-pad].mean())
+        per_image.append(np.mean(ch))
+    return np.asarray(per_image)
+
+
+def _ref_psnr(preds, target):
+    mse = ((preds - target) ** 2).mean()
+    return 10 * np.log10(1.0 / mse)
+
+
+def _ref_ssim(preds, target):
+    return _np_ssim(preds, target).mean()
+
+
+def _ref_sam(preds, target):
+    dot = (preds * target).sum(1)
+    norm = np.linalg.norm(preds, axis=1) * np.linalg.norm(target, axis=1)
+    return np.arccos(np.clip(dot / norm, -1, 1)).mean()
+
+
+def _ref_ergas(preds, target, ratio=4):
+    b, c, h, w = preds.shape
+    rmse = np.sqrt(((preds - target) ** 2).reshape(b, c, -1).sum(2) / (h * w))
+    mean_t = target.reshape(b, c, -1).mean(2)
+    return (100 * ratio * np.sqrt(((rmse / mean_t) ** 2).sum(1) / c)).mean()
+
+
+def _ref_rmse_sw(preds, target, window=8):
+    err = (target - preds) ** 2
+    b, c = preds.shape[:2]
+    maps = np.stack(
+        [np.stack([np.sqrt(uniform_filter(err[i, ch], size=window)) for ch in range(c)]) for i in range(b)]
+    )
+    crop = round(window / 2)
+    return maps[:, :, crop:-crop, crop:-crop].sum(0).mean() / b
+
+
+def _ref_tv(img):
+    return (np.abs(np.diff(img, axis=2)).sum((1, 2, 3)) + np.abs(np.diff(img, axis=3)).sum((1, 2, 3))).sum()
+
+
+CASES = [
+    (
+        "psnr",
+        PeakSignalNoiseRatio,
+        {"data_range": 1.0},
+        lambda p, t: peak_signal_noise_ratio(p, t, data_range=1.0),
+        _ref_psnr,
+        1e-3,
+    ),
+    (
+        "ssim",
+        StructuralSimilarityIndexMeasure,
+        {"data_range": 1.0},
+        lambda p, t: structural_similarity_index_measure(p, t, data_range=1.0),
+        _ref_ssim,
+        1e-4,
+    ),
+    (
+        "sam",
+        SpectralAngleMapper,
+        {},
+        spectral_angle_mapper,
+        _ref_sam,
+        1e-4,
+    ),
+    (
+        "ergas",
+        ErrorRelativeGlobalDimensionlessSynthesis,
+        {},
+        error_relative_global_dimensionless_synthesis,
+        _ref_ergas,
+        5e-1,
+    ),
+    (
+        "rmse_sw",
+        RootMeanSquaredErrorUsingSlidingWindow,
+        {},
+        root_mean_squared_error_using_sliding_window,
+        _ref_rmse_sw,
+        1e-4,
+    ),
+]
+
+
+class TestImageMetrics(MetricTester):
+    @pytest.mark.parametrize("name, metric_class, args, fn, ref, atol", CASES, ids=[c[0] for c in CASES])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, name, metric_class, args, fn, ref, atol, ddp):
+        self.atol = atol
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=PREDS,
+            target=TARGET,
+            metric_class=metric_class,
+            reference_metric=ref,
+            metric_args=args,
+            check_batch=(name not in ("psnr",)),  # psnr batch value uses running data range
+            shard_map_mode=(name in ("psnr", "sam", "ergas", "rmse_sw")),
+        )
+
+    @pytest.mark.parametrize("name, metric_class, args, fn, ref, atol", CASES, ids=[c[0] for c in CASES])
+    def test_functional(self, name, metric_class, args, fn, ref, atol):
+        self.atol = atol
+        self.run_functional_metric_test(
+            preds=PREDS, target=TARGET, metric_functional=fn, reference_metric=ref
+        )
+
+
+def test_tv():
+    tv = TotalVariation()
+    for p in PREDS:
+        tv.update(p)
+    total = float(tv.compute())
+    ref = sum(_ref_tv(np.asarray(p)) for p in PREDS)
+    assert np.isclose(total, ref, rtol=1e-5)
+    assert np.isclose(float(total_variation(PREDS[0])), _ref_tv(np.asarray(PREDS[0])), rtol=1e-5)
+    tv_mean = TotalVariation(reduction="mean")
+    tv_mean.update(PREDS[0])
+    assert np.isclose(float(tv_mean.compute()), _ref_tv(np.asarray(PREDS[0])) / BATCH, rtol=1e-5)
+
+
+def test_uqi():
+    m = UniversalImageQualityIndex()
+    for p, t in zip(PREDS, TARGET):
+        m.update(p, t)
+    got = float(m.compute())
+    assert 0.5 < got <= 1.0
+    assert np.isclose(float(universal_image_quality_index(PREDS[0], PREDS[0])), 1.0, atol=1e-5)
+
+
+def test_ms_ssim():
+    rng = np.random.default_rng(5)
+    p = jnp.asarray(rng.random((2, 3, 64, 64)), dtype=jnp.float32)
+    t = p * 0.8 + 0.1
+    betas = (0.3, 0.3, 0.4)
+    m = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0, betas=betas)
+    m.update(p, t)
+    got = float(m.compute())
+    assert 0.0 < got <= 1.0
+    # self-comparison is exactly 1
+    assert np.isclose(
+        float(multiscale_structural_similarity_index_measure(p, p, data_range=1.0, betas=betas)), 1.0, atol=1e-5
+    )
+    # single-scale MS-SSIM == SSIM^beta
+    one = float(multiscale_structural_similarity_index_measure(p, t, data_range=1.0, betas=(1.0,)))
+    ssim = float(structural_similarity_index_measure(p, t, data_range=1.0))
+    assert np.isclose(one, ssim, atol=1e-5)
+
+
+def test_psnrb():
+    rng = np.random.default_rng(6)
+    p = jnp.asarray(rng.random((2, 1, 32, 32)), dtype=jnp.float32)
+    t = jnp.asarray(rng.random((2, 1, 32, 32)), dtype=jnp.float32)
+    m = PeakSignalNoiseRatioWithBlockedEffect()
+    m.update(p, t)
+    got = float(m.compute())
+    assert np.isfinite(got)
+    assert np.isclose(got, float(peak_signal_noise_ratio_with_blocked_effect(p, t)), atol=1e-5)
+    with pytest.raises(ValueError, match="grayscale"):
+        peak_signal_noise_ratio_with_blocked_effect(PREDS[0], TARGET[0])
+
+
+def test_d_lambda():
+    m = SpectralDistortionIndex()
+    for p, t in zip(PREDS, TARGET):
+        m.update(p, t)
+    got = float(m.compute())
+    assert 0.0 <= got < 0.5
+    assert np.isclose(float(spectral_distortion_index(PREDS[0], PREDS[0])), 0.0, atol=1e-5)
+
+
+def test_vif():
+    rng = np.random.default_rng(7)
+    p = jnp.asarray(rng.random((2, 1, 48, 48)), dtype=jnp.float32)
+    t = jnp.asarray(rng.random((2, 1, 48, 48)), dtype=jnp.float32)
+    m = VisualInformationFidelity()
+    m.update(p, t)
+    assert np.isfinite(float(m.compute()))
+    assert np.isclose(float(visual_information_fidelity(p, p)), 1.0, atol=1e-4)
+    with pytest.raises(ValueError, match="Invalid size"):
+        visual_information_fidelity(PREDS[0], TARGET[0])
+
+
+def test_rase():
+    m = RelativeAverageSpectralError()
+    for p, t in zip(PREDS, TARGET):
+        m.update(p, t)
+    got = float(m.compute())
+    assert np.isfinite(got) and got > 0
+    assert np.isclose(
+        got,
+        float(
+            relative_average_spectral_error(
+                jnp.concatenate(PREDS), jnp.concatenate(TARGET)
+            )
+        ),
+        rtol=1e-4,
+    )
+
+
+def test_image_gradients():
+    img = jnp.arange(25, dtype=jnp.float32).reshape(1, 1, 5, 5)
+    dy, dx = image_gradients(img)
+    assert np.allclose(np.asarray(dy)[0, 0, :4], 5.0)
+    assert np.allclose(np.asarray(dy)[0, 0, 4], 0.0)
+    assert np.allclose(np.asarray(dx)[0, 0, :, :4], 1.0)
+    with pytest.raises(RuntimeError, match="4D tensor"):
+        image_gradients(jnp.zeros((5, 5)))
+
+
+def test_psnr_dim_and_tuple_range():
+    p, t = PREDS[0], TARGET[0]
+    got = float(peak_signal_noise_ratio(p, t, data_range=(0.0, 1.0)))
+    ref = float(peak_signal_noise_ratio(jnp.clip(p, 0, 1), jnp.clip(t, 0, 1), data_range=1.0))
+    assert np.isclose(got, ref, atol=1e-6)
+    per_img = peak_signal_noise_ratio(p, t, data_range=1.0, dim=(1, 2, 3), reduction="none")
+    assert per_img.shape == (BATCH,)
+    mse = np.mean((np.asarray(p) - np.asarray(t)) ** 2, axis=(1, 2, 3))
+    assert np.allclose(np.asarray(per_img), 10 * np.log10(1.0 / mse), atol=1e-3)
+    with pytest.raises(ValueError, match="data_range"):
+        PeakSignalNoiseRatio(dim=1)
+
+
+def test_ssim_variants():
+    p, t = PREDS[0], TARGET[0]
+    sim, cs = structural_similarity_index_measure(p, t, data_range=1.0, return_contrast_sensitivity=True)
+    assert cs.shape[0] == BATCH
+    sim2, full = structural_similarity_index_measure(p, t, data_range=1.0, return_full_image=True)
+    assert full.ndim == 4
+    assert np.isclose(float(sim), float(sim2), atol=1e-6)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        structural_similarity_index_measure(
+            p, t, return_full_image=True, return_contrast_sensitivity=True
+        )
+    with pytest.raises(ValueError, match="odd positive"):
+        structural_similarity_index_measure(p, t, gaussian_kernel=False, kernel_size=4)
+
+
+def test_image_metrics_jit():
+    """The conv-heavy metrics must trace cleanly into one XLA program."""
+    p, t = PREDS[0], TARGET[0]
+    fn = jax.jit(lambda a, b: structural_similarity_index_measure(a, b, data_range=1.0))
+    assert np.isclose(float(fn(p, t)), float(structural_similarity_index_measure(p, t, data_range=1.0)), atol=1e-6)
+    fn2 = jax.jit(lambda a, b: spectral_angle_mapper(a, b))
+    assert np.isfinite(float(fn2(p, t)))
+    fn3 = jax.jit(lambda a, b: root_mean_squared_error_using_sliding_window(a, b))
+    assert np.isfinite(float(fn3(p, t)))
+
+
+def test_rase_matches_reference_formula():
+    """RASE accumulates the uniform-filtered target / window² (reference
+    functional/image/rase.py:45), not the raw target."""
+    window = 8
+    preds = np.concatenate([np.asarray(p) for p in PREDS])
+    target = np.concatenate([np.asarray(t) for t in TARGET])
+    n, c = preds.shape[:2]
+    rmse_maps = np.stack(
+        [np.stack([np.sqrt(uniform_filter((target[i, ch] - preds[i, ch]) ** 2, size=window)) for ch in range(c)]) for i in range(n)]
+    ).sum(0) / n
+    t_filt = np.stack(
+        [np.stack([uniform_filter(target[i, ch], size=window) for ch in range(c)]) for i in range(n)]
+    ) / (window**2)
+    target_mean = (t_filt.sum(0) / n).mean(0)
+    rase_map = 100 / target_mean * np.sqrt((rmse_maps**2).mean(0))
+    crop = round(window / 2)
+    ref = rase_map[crop:-crop, crop:-crop].mean()
+    got = float(relative_average_spectral_error(jnp.asarray(preds), jnp.asarray(target), window))
+    assert np.isclose(got, ref, rtol=1e-3), (got, ref)
+
+
+def test_d_lambda_different_resolutions_and_single_band():
+    """Pan-sharpening compares inputs at different spatial resolutions; a
+    single band has no pairs and scores 0 (reference d_lambda.py:44-48,103)."""
+    rng = np.random.default_rng(8)
+    low = jnp.asarray(rng.random((2, 4, 16, 16)), dtype=jnp.float32)
+    high = jnp.asarray(rng.random((2, 4, 64, 64)), dtype=jnp.float32)
+    assert np.isfinite(float(spectral_distortion_index(low, high)))
+    single = jnp.asarray(rng.random((2, 1, 16, 16)), dtype=jnp.float32)
+    assert float(spectral_distortion_index(single, single * 0.9)) == 0.0

@@ -1,0 +1,34 @@
+"""Image functional metrics (counterpart of reference
+``functional/image/__init__.py``)."""
+
+from tpumetrics.functional.image.d_lambda import spectral_distortion_index
+from tpumetrics.functional.image.ergas import error_relative_global_dimensionless_synthesis
+from tpumetrics.functional.image.gradients import image_gradients
+from tpumetrics.functional.image.psnr import peak_signal_noise_ratio
+from tpumetrics.functional.image.psnrb import peak_signal_noise_ratio_with_blocked_effect
+from tpumetrics.functional.image.rase import relative_average_spectral_error
+from tpumetrics.functional.image.rmse_sw import root_mean_squared_error_using_sliding_window
+from tpumetrics.functional.image.sam import spectral_angle_mapper
+from tpumetrics.functional.image.ssim import (
+    multiscale_structural_similarity_index_measure,
+    structural_similarity_index_measure,
+)
+from tpumetrics.functional.image.tv import total_variation
+from tpumetrics.functional.image.uqi import universal_image_quality_index
+from tpumetrics.functional.image.vif import visual_information_fidelity
+
+__all__ = [
+    "error_relative_global_dimensionless_synthesis",
+    "image_gradients",
+    "multiscale_structural_similarity_index_measure",
+    "peak_signal_noise_ratio",
+    "peak_signal_noise_ratio_with_blocked_effect",
+    "relative_average_spectral_error",
+    "root_mean_squared_error_using_sliding_window",
+    "spectral_angle_mapper",
+    "spectral_distortion_index",
+    "structural_similarity_index_measure",
+    "total_variation",
+    "universal_image_quality_index",
+    "visual_information_fidelity",
+]

@@ -1,0 +1,65 @@
+"""ERGAS (counterpart of reference ``functional/image/ergas.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.image.helper import _reduce
+from tpumetrics.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _ergas_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Input validation (reference ergas.py:24-47)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    return preds, target
+
+
+def _ergas_compute(
+    preds: Array, target: Array, ratio: float = 4, reduction: Optional[str] = "elementwise_mean"
+) -> Array:
+    """100 * ratio * RMS of per-band relative RMSE (reference ergas.py:50-90)."""
+    b, c, h, w = preds.shape
+    preds = preds.reshape(b, c, h * w)
+    target = target.reshape(b, c, h * w)
+
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=2)
+    rmse_per_band = jnp.sqrt(sum_squared_error / (h * w))
+    mean_target = jnp.mean(target, axis=2)
+
+    ergas_score = 100 * ratio * jnp.sqrt(jnp.sum((rmse_per_band / mean_target) ** 2, axis=1) / c)
+    return _reduce(ergas_score, reduction)
+
+
+def error_relative_global_dimensionless_synthesis(
+    preds: Array, target: Array, ratio: float = 4, reduction: Optional[str] = "elementwise_mean"
+) -> Array:
+    """Erreur Relative Globale Adimensionnelle de Synthèse (reference ergas.py:93-129).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.functional.image import error_relative_global_dimensionless_synthesis
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (16, 1, 16, 16))
+        >>> target = preds * 0.75
+        >>> round(float(error_relative_global_dimensionless_synthesis(preds, target)), 0)
+        155.0
+    """
+    preds, target = _ergas_update(preds, target)
+    return _ergas_compute(preds, target, ratio, reduction)

@@ -1,0 +1,44 @@
+"""Image gradients (counterpart of reference ``functional/image/gradients.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _image_gradients_validate(img: Array) -> None:
+    if not isinstance(img, (jax.Array, jnp.ndarray)):
+        raise TypeError(f"The `img` expects a value of <Array> type but got {type(img)}")
+    if img.ndim != 4:
+        raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor")
+
+
+def _compute_image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Forward differences, zero-padded to input shape (reference gradients.py:21-36)."""
+    batch_size, channels, height, width = img.shape
+    dy = img[..., 1:, :] - img[..., :-1, :]
+    dx = img[..., :, 1:] - img[..., :, :-1]
+    dy = jnp.concatenate([dy, jnp.zeros((batch_size, channels, 1, width), img.dtype)], axis=2)
+    dx = jnp.concatenate([dx, jnp.zeros((batch_size, channels, height, 1), img.dtype)], axis=3)
+    return dy, dx
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """(dy, dx) forward-difference gradients of an image batch
+    (reference gradients.py:39-80).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.image import image_gradients
+        >>> image = jnp.arange(0, 1*1*5*5, dtype=jnp.float32).reshape(1, 1, 5, 5)
+        >>> dy, dx = image_gradients(image)
+        >>> dy[0, 0, :, :].tolist()[0]
+        [5.0, 5.0, 5.0, 5.0, 5.0]
+    """
+    img = jnp.asarray(img)
+    _image_gradients_validate(img)
+    return _compute_image_gradients(img)

@@ -1,0 +1,112 @@
+"""Shared image-metric helpers (counterpart of reference
+``functional/image/helper.py``): gaussian/uniform kernels, reflection
+padding, and depthwise convolutions.
+
+Convs lower to ``lax.conv_general_dilated`` with
+``feature_group_count=channels`` — one fused depthwise conv on the MXU
+instead of the reference's per-channel Python loop
+(reference helper.py:121-131).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _gaussian(kernel_size: int, sigma: float, dtype: jnp.dtype = jnp.float32) -> Array:
+    """1D gaussian window (reference helper.py:21-35)."""
+    dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1.0, dtype=dtype)
+    gauss = jnp.exp(-jnp.power(dist / sigma, 2) / 2)
+    return (gauss / gauss.sum())[None, :]  # (1, kernel_size)
+
+
+def _gaussian_kernel_2d(
+    channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype: jnp.dtype = jnp.float32
+) -> Array:
+    """(C, 1, kh, kw) separable gaussian kernel (reference helper.py:38-68)."""
+    kernel_x = _gaussian(kernel_size[0], sigma[0], dtype)
+    kernel_y = _gaussian(kernel_size[1], sigma[1], dtype)
+    kernel = jnp.matmul(kernel_x.T, kernel_y)
+    return jnp.broadcast_to(kernel, (channel, 1, kernel_size[0], kernel_size[1]))
+
+
+def _gaussian_kernel_3d(
+    channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype: jnp.dtype = jnp.float32
+) -> Array:
+    """(C, 1, kd, kh, kw) separable gaussian kernel (reference helper.py:134-153)."""
+    kernel_x = _gaussian(kernel_size[0], sigma[0], dtype)
+    kernel_y = _gaussian(kernel_size[1], sigma[1], dtype)
+    kernel_z = _gaussian(kernel_size[2], sigma[2], dtype)
+    kernel_xy = jnp.matmul(kernel_x.T, kernel_y)
+    kernel = kernel_xy[:, :, None] * kernel_z.reshape(1, 1, -1)
+    return jnp.broadcast_to(kernel, (channel, 1, kernel_size[0], kernel_size[1], kernel_size[2]))
+
+
+def _depthwise_conv2d(x: Array, kernel: Array) -> Array:
+    """Valid-mode depthwise conv: x (B, C, H, W), kernel (C, 1, kh, kw)."""
+    return jax.lax.conv_general_dilated(
+        x,
+        kernel.astype(x.dtype),
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=x.shape[1],
+    )
+
+
+def _depthwise_conv3d(x: Array, kernel: Array) -> Array:
+    """Valid-mode depthwise conv: x (B, C, D, H, W), kernel (C, 1, kd, kh, kw)."""
+    return jax.lax.conv_general_dilated(
+        x,
+        kernel.astype(x.dtype),
+        window_strides=(1, 1, 1),
+        padding="VALID",
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=x.shape[1],
+    )
+
+
+def _reflect_pad_2d(x: Array, pad_h: int, pad_w: int) -> Array:
+    """Symmetric reflection padding of the two trailing dims (torch
+    ``F.pad(mode='reflect')`` semantics == jnp 'reflect')."""
+    return jnp.pad(x, ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w)), mode="reflect")
+
+
+def _reflect_pad_3d(x: Array, pad_d: int, pad_h: int, pad_w: int) -> Array:
+    return jnp.pad(x, ((0, 0), (0, 0), (pad_d, pad_d), (pad_h, pad_h), (pad_w, pad_w)), mode="reflect")
+
+
+def _single_dimension_pad(x: Array, dim: int, pad: int, outer_pad: int = 0) -> Array:
+    """Scipy-style asymmetric reflection pad over one dim (reference
+    helper.py:77-92): ``pad`` mirrored rows before, ``pad + outer_pad - 1``
+    after — what ``scipy.ndimage.uniform_filter`` does at borders."""
+    size = x.shape[dim]
+    before = jnp.take(x, jnp.arange(pad - 1, -1, -1), axis=dim)
+    after = jnp.take(x, jnp.arange(size - 1, size - pad - outer_pad, -1), axis=dim)
+    return jnp.concatenate((before, x, after), axis=dim)
+
+
+def _uniform_filter(x: Array, window_size: int) -> Array:
+    """Mean filter matching ``scipy.ndimage.uniform_filter`` (reference
+    helper.py:95-131) — one depthwise conv over all channels."""
+    for dim in (2, 3):
+        x = _single_dimension_pad(x, dim, window_size // 2, window_size % 2)
+    channels = x.shape[1]
+    kernel = jnp.ones((channels, 1, window_size, window_size), dtype=x.dtype) / (window_size**2)
+    return _depthwise_conv2d(x, kernel)
+
+
+def _reduce(x: Array, reduction: str = "elementwise_mean") -> Array:
+    """elementwise_mean/sum/none reduction (reference utilities/distributed.py:22-42)."""
+    if reduction == "elementwise_mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    if reduction is None or reduction == "none":
+        return x
+    raise ValueError("Expected reduction to be one of `['elementwise_mean', 'sum', 'none', None]`")

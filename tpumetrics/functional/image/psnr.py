@@ -1,0 +1,90 @@
+"""PSNR (counterpart of reference ``functional/image/psnr.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.image.helper import _reduce
+from tpumetrics.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _psnr_update(
+    preds: Array,
+    target: Array,
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Tuple[Array, Array]:
+    """Sum of squared error + observation count, optionally per-dim
+    (reference psnr.py:49-82)."""
+    if dim is None:
+        diff = preds - target
+        sum_squared_error = jnp.sum(diff * diff)
+        num_obs = jnp.asarray(target.size, dtype=jnp.float32)
+        return sum_squared_error, num_obs
+
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=dim)
+    dim_list = [dim] if isinstance(dim, int) else list(dim)
+    num = 1
+    for d in dim_list:
+        num *= target.shape[d]
+    num_obs = jnp.broadcast_to(jnp.asarray(num, jnp.float32), sum_squared_error.shape)
+    return sum_squared_error, num_obs
+
+
+def _psnr_compute(
+    sum_squared_error: Array,
+    num_obs: Array,
+    data_range: Array,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """PSNR from accumulated sums (reference psnr.py:20-46)."""
+    psnr_base_e = 2 * jnp.log(data_range) - jnp.log(sum_squared_error / num_obs)
+    psnr_vals = psnr_base_e * (10 / jnp.log(jnp.asarray(base, jnp.float32)))
+    return _reduce(psnr_vals, reduction)
+
+
+def peak_signal_noise_ratio(
+    preds: Array,
+    target: Array,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Array:
+    """Peak signal-to-noise ratio (reference psnr.py:85-154).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.image import peak_signal_noise_ratio
+        >>> pred = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])
+        >>> target = jnp.asarray([[3.0, 2.0], [1.0, 0.0]])
+        >>> round(float(peak_signal_noise_ratio(pred, target)), 4)
+        2.5531
+    """
+    if dim is None and reduction != "elementwise_mean":
+        from tpumetrics.utils.prints import rank_zero_warn
+
+        rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    _check_same_shape(preds, target)
+
+    if data_range is None:
+        if dim is not None:
+            raise ValueError("The `data_range` must be given when `dim` is not None.")
+        data_range_t = target.max() - target.min()
+    elif isinstance(data_range, tuple):
+        preds = jnp.clip(preds, data_range[0], data_range[1])
+        target = jnp.clip(target, data_range[0], data_range[1])
+        data_range_t = jnp.asarray(data_range[1] - data_range[0], jnp.float32)
+    else:
+        data_range_t = jnp.asarray(float(data_range), jnp.float32)
+    sum_squared_error, num_obs = _psnr_update(preds, target, dim=dim)
+    return _psnr_compute(sum_squared_error, num_obs, data_range_t, base=base, reduction=reduction)

@@ -1,0 +1,62 @@
+"""RASE (counterpart of reference ``functional/image/rase.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.image.rmse_sw import _rmse_sw_compute, _rmse_sw_update
+
+Array = jax.Array
+
+
+def _rase_update(
+    preds: Array, target: Array, window_size: int, rmse_map: Array, target_sum: Array, total_images: Array
+) -> Tuple[Array, Array, Array]:
+    """Accumulate the RMSE map and locally-averaged target sums (reference
+    rase.py:23-46: the target enters through the same uniform filter as the
+    error, scaled by 1/window_size²)."""
+    from tpumetrics.functional.image.helper import _uniform_filter
+
+    _, rmse_map, total_images = _rmse_sw_update(
+        preds, target, window_size, rmse_val_sum=None, rmse_map=rmse_map, total_images=total_images
+    )
+    filtered = _uniform_filter(jnp.asarray(target, jnp.float32), window_size) / (window_size**2)
+    target_sum = target_sum + filtered.sum(0)
+    return rmse_map, target_sum, total_images
+
+
+def _rase_compute(rmse_map: Array, target_sum: Array, total_images: Array, window_size: int) -> Array:
+    """100/mean(target) * RMS over channels of the RMSE map, border-cropped
+    (reference rase.py:53-76)."""
+    _, rmse_map = _rmse_sw_compute(rmse_val_sum=None, rmse_map=rmse_map, total_images=total_images)
+    target_mean = target_sum / total_images
+    target_mean = target_mean.mean(0)  # mean over image channels
+    rase_map = 100 / target_mean * jnp.sqrt(jnp.mean(rmse_map**2, axis=0))
+    crop_slide = round(window_size / 2)
+    return jnp.mean(rase_map[crop_slide:-crop_slide, crop_slide:-crop_slide])
+
+
+def relative_average_spectral_error(preds: Array, target: Array, window_size: int = 8) -> Array:
+    """Relative Average Spectral Error (reference rase.py:79-103).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.functional.image import relative_average_spectral_error
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (4, 3, 16, 16))
+        >>> target = preds * 0.75
+        >>> float(relative_average_spectral_error(preds, target)) > 0
+        True
+    """
+    if not (isinstance(window_size, int) and window_size >= 1):
+        raise ValueError(f"Argument `window_size` is expected to be a positive integer. Got {window_size}")
+    img_shape = jnp.asarray(target).shape[1:]
+    rmse_map = jnp.zeros(img_shape, jnp.float32)
+    target_sum = jnp.zeros(img_shape, jnp.float32)
+    total_images = jnp.zeros((), jnp.float32)
+    rmse_map, target_sum, total_images = _rase_update(
+        preds, target, window_size, rmse_map, target_sum, total_images
+    )
+    return _rase_compute(rmse_map, target_sum, total_images, window_size)

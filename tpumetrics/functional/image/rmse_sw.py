@@ -1,0 +1,79 @@
+"""RMSE with sliding window (counterpart of reference
+``functional/image/rmse_sw.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.image.helper import _uniform_filter
+from tpumetrics.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _rmse_sw_update(
+    preds: Array,
+    target: Array,
+    window_size: int,
+    rmse_val_sum: Optional[Array],
+    rmse_map: Optional[Array],
+    total_images: Optional[Array],
+) -> Tuple[Array, Array, Array]:
+    """Accumulate windowed-RMSE sums (reference rmse_sw.py:22-98)."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. But got {preds.shape}.")
+    if round(window_size / 2) >= target.shape[2] or round(window_size / 2) >= target.shape[3]:
+        raise ValueError(
+            f"Parameter `round(window_size / 2)` is expected to be smaller than"
+            f" {min(target.shape[2], target.shape[3])} but got {round(window_size / 2)}."
+        )
+
+    total = (total_images if total_images is not None else 0) + target.shape[0]
+    error = (target - preds) ** 2
+    error = _uniform_filter(error, window_size)
+    _rmse_map = jnp.sqrt(error)
+    crop_slide = round(window_size / 2)
+
+    val = _rmse_map[:, :, crop_slide:-crop_slide, crop_slide:-crop_slide].sum(0).mean()
+    rmse_val_sum = val if rmse_val_sum is None else rmse_val_sum + val
+    batch_map = _rmse_map.sum(0)
+    rmse_map = batch_map if rmse_map is None else rmse_map + batch_map
+    return rmse_val_sum, rmse_map, jnp.asarray(total, jnp.float32)
+
+
+def _rmse_sw_compute(
+    rmse_val_sum: Optional[Array], rmse_map: Array, total_images: Array
+) -> Tuple[Optional[Array], Array]:
+    """Normalize accumulated sums by image count (reference rmse_sw.py:101-120)."""
+    rmse = rmse_val_sum / total_images if rmse_val_sum is not None else None
+    rmse_map = rmse_map / total_images
+    return rmse, rmse_map
+
+
+def root_mean_squared_error_using_sliding_window(
+    preds: Array, target: Array, window_size: int = 8
+) -> Optional[Array]:
+    """RMSE over sliding windows, scipy-uniform-filter compatible
+    (reference rmse_sw.py:123-148).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.functional.image import root_mean_squared_error_using_sliding_window
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (4, 3, 16, 16))
+        >>> target = preds * 0.75
+        >>> float(root_mean_squared_error_using_sliding_window(preds, target)) > 0
+        True
+    """
+    if not (isinstance(window_size, int) and window_size >= 1):
+        raise ValueError(f"Argument `window_size` is expected to be a positive integer. Got {window_size}")
+    rmse_val_sum, rmse_map, total_images = _rmse_sw_update(
+        preds, target, window_size, rmse_val_sum=None, rmse_map=None, total_images=None
+    )
+    rmse, _ = _rmse_sw_compute(rmse_val_sum, rmse_map, total_images)
+    return rmse

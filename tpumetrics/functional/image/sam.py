@@ -1,0 +1,62 @@
+"""Spectral Angle Mapper (counterpart of reference ``functional/image/sam.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.image.helper import _reduce
+from tpumetrics.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _sam_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Input validation (reference sam.py:25-52)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    if preds.shape[1] <= 1:
+        raise ValueError(
+            "Expected channel dimension of `preds` and `target` to be larger than 1."
+            f" Got preds: {preds.shape[1]} and target: {target.shape[1]}."
+        )
+    return preds, target
+
+
+def _sam_compute(preds: Array, target: Array, reduction: Optional[str] = "elementwise_mean") -> Array:
+    """Per-pixel spectral angle arccos(<p, t>/(|p||t|)) (reference sam.py:55-84)."""
+    dot_product = (preds * target).sum(axis=1)
+    preds_norm = jnp.linalg.norm(preds, axis=1)
+    target_norm = jnp.linalg.norm(target, axis=1)
+    sam_score = jnp.arccos(jnp.clip(dot_product / (preds_norm * target_norm), -1, 1))
+    return _reduce(sam_score, reduction)
+
+
+def spectral_angle_mapper(
+    preds: Array, target: Array, reduction: Optional[str] = "elementwise_mean"
+) -> Array:
+    """Spectral Angle Mapper for multispectral images (reference sam.py:87-123).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.functional.image import spectral_angle_mapper
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (16, 3, 16, 16))
+        >>> target = jax.random.uniform(jax.random.PRNGKey(123), (16, 3, 16, 16))
+        >>> 0.0 < float(spectral_angle_mapper(preds, target)) < 1.6
+        True
+    """
+    preds, target = _sam_update(preds, target)
+    return _sam_compute(preds, target, reduction)

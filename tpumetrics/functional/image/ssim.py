@@ -1,0 +1,329 @@
+"""SSIM / MS-SSIM (counterpart of reference ``functional/image/ssim.py``).
+
+The five moment maps (mu_p, mu_t, E[p²], E[t²], E[pt]) come from ONE
+depthwise conv over a 5x-stacked batch (the reference does the same stacking,
+ssim.py:150-153); on TPU that is a single MXU-friendly conv kernel launch.
+MS-SSIM's scale pyramid is a Python loop over ``len(betas)`` static scales —
+unrolled by jit, each scale a halved-resolution conv.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.image.helper import (
+    _depthwise_conv2d,
+    _depthwise_conv3d,
+    _gaussian_kernel_2d,
+    _gaussian_kernel_3d,
+    _reduce,
+    _reflect_pad_2d,
+    _reflect_pad_3d,
+)
+from tpumetrics.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _ssim_check_inputs(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Shape/dtype harmonization (reference ssim.py:26-43)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target, dtype=preds.dtype)
+    _check_same_shape(preds, target)
+    if preds.ndim not in (4, 5):
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW or BxCxDxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    return preds, target
+
+
+def _ssim_update(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+) -> Union[Array, Tuple[Array, Array]]:
+    """Per-image SSIM (reference ssim.py:46-187)."""
+    is_3d = preds.ndim == 5
+
+    if not isinstance(kernel_size, Sequence):
+        kernel_size = 3 * [kernel_size] if is_3d else 2 * [kernel_size]
+    if not isinstance(sigma, Sequence):
+        sigma = 3 * [sigma] if is_3d else 2 * [sigma]
+
+    if len(kernel_size) != preds.ndim - 2 or len(kernel_size) not in (2, 3):
+        raise ValueError(
+            f"`kernel_size` has dimension {len(kernel_size)}, but expected to be two less that target dimensionality,"
+            f" which is: {preds.ndim}"
+        )
+    if len(sigma) != preds.ndim - 2 or len(sigma) not in (2, 3):
+        raise ValueError(
+            f"`sigma` has dimension {len(sigma)}, but expected to be two less that target dimensionality,"
+            f" which is: {preds.ndim}"
+        )
+    if return_full_image and return_contrast_sensitivity:
+        raise ValueError("Arguments `return_full_image` and `return_contrast_sensitivity` are mutually exclusive.")
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    if any(y <= 0 for y in sigma):
+        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+
+    if data_range is None:
+        data_range_t = jnp.maximum(preds.max() - preds.min(), target.max() - target.min())
+    elif isinstance(data_range, tuple):
+        preds = jnp.clip(preds, data_range[0], data_range[1])
+        target = jnp.clip(target, data_range[0], data_range[1])
+        data_range_t = jnp.asarray(data_range[1] - data_range[0], preds.dtype)
+    else:
+        data_range_t = jnp.asarray(data_range, preds.dtype)
+
+    c1 = (k1 * data_range_t) ** 2
+    c2 = (k2 * data_range_t) ** 2
+
+    channel = preds.shape[1]
+    dtype = preds.dtype
+    # gaussian support sized from sigma, also defining the crop border
+    # (reference ssim.py:126-129)
+    gauss_kernel_size = [int(3.5 * s + 0.5) * 2 + 1 for s in sigma]
+    pad_h = (gauss_kernel_size[0] - 1) // 2
+    pad_w = (gauss_kernel_size[1] - 1) // 2
+
+    if is_3d:
+        pad_d = (gauss_kernel_size[2] - 1) // 2
+        preds = _reflect_pad_3d(preds, pad_d, pad_w, pad_h)
+        target = _reflect_pad_3d(target, pad_d, pad_w, pad_h)
+        if gaussian_kernel:
+            kernel = _gaussian_kernel_3d(channel, gauss_kernel_size, sigma, dtype)
+        else:
+            kernel = jnp.ones((channel, 1, *kernel_size), dtype=dtype) / jnp.prod(
+                jnp.asarray(kernel_size, dtype)
+            )
+        conv = _depthwise_conv3d
+    else:
+        preds = _reflect_pad_2d(preds, pad_h, pad_w)
+        target = _reflect_pad_2d(target, pad_h, pad_w)
+        if gaussian_kernel:
+            kernel = _gaussian_kernel_2d(channel, gauss_kernel_size, sigma, dtype)
+        else:
+            kernel = jnp.ones((channel, 1, *kernel_size), dtype=dtype) / jnp.prod(
+                jnp.asarray(kernel_size, dtype)
+            )
+        conv = _depthwise_conv2d
+
+    # one conv over the 5-stacked moment inputs (reference ssim.py:150-153)
+    input_list = jnp.concatenate((preds, target, preds * preds, target * target, preds * target))
+    outputs = conv(input_list, kernel)
+    b = preds.shape[0]
+    mu_pred, mu_target = outputs[:b], outputs[b : 2 * b]
+    e_pred_sq, e_target_sq, e_pred_target = outputs[2 * b : 3 * b], outputs[3 * b : 4 * b], outputs[4 * b :]
+
+    mu_pred_sq = mu_pred**2
+    mu_target_sq = mu_target**2
+    mu_pred_target = mu_pred * mu_target
+
+    sigma_pred_sq = e_pred_sq - mu_pred_sq
+    sigma_target_sq = e_target_sq - mu_target_sq
+    sigma_pred_target = e_pred_target - mu_pred_target
+
+    upper = 2 * sigma_pred_target + c2
+    lower = sigma_pred_sq + sigma_target_sq + c2
+
+    ssim_idx_full_image = ((2 * mu_pred_target + c1) * upper) / ((mu_pred_sq + mu_target_sq + c1) * lower)
+
+    if is_3d:
+        ssim_idx = ssim_idx_full_image[..., pad_h:-pad_h, pad_w:-pad_w, pad_d:-pad_d]
+    else:
+        ssim_idx = ssim_idx_full_image[..., pad_h:-pad_h, pad_w:-pad_w]
+
+    if return_contrast_sensitivity:
+        contrast_sensitivity = upper / lower
+        if is_3d:
+            contrast_sensitivity = contrast_sensitivity[..., pad_h:-pad_h, pad_w:-pad_w, pad_d:-pad_d]
+        else:
+            contrast_sensitivity = contrast_sensitivity[..., pad_h:-pad_h, pad_w:-pad_w]
+        return ssim_idx.reshape(b, -1).mean(-1), contrast_sensitivity.reshape(b, -1).mean(-1)
+
+    if return_full_image:
+        return ssim_idx.reshape(b, -1).mean(-1), ssim_idx_full_image
+
+    return ssim_idx.reshape(b, -1).mean(-1)
+
+
+def _ssim_compute(similarities: Array, reduction: Optional[str] = "elementwise_mean") -> Array:
+    return _reduce(similarities, reduction)
+
+
+def structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+) -> Union[Array, Tuple[Array, Array]]:
+    """Structural Similarity Index Measure (reference ssim.py:209-283).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.functional.image import structural_similarity_index_measure
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (8, 3, 32, 32))
+        >>> target = preds * 0.75
+        >>> round(float(structural_similarity_index_measure(preds, target, data_range=1.0)), 4)
+        0.9219
+    """
+    preds, target = _ssim_check_inputs(preds, target)
+    similarity_pack = _ssim_update(
+        preds,
+        target,
+        gaussian_kernel,
+        sigma,
+        kernel_size,
+        data_range,
+        k1,
+        k2,
+        return_full_image,
+        return_contrast_sensitivity,
+    )
+    if isinstance(similarity_pack, tuple):
+        similarity, image = similarity_pack
+        return _ssim_compute(similarity, reduction), image
+    return _ssim_compute(similarity_pack, reduction)
+
+
+def _get_normalized_sim_and_cs(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    normalize: Optional[str] = None,
+) -> Tuple[Array, Array]:
+    sim, contrast_sensitivity = _ssim_update(
+        preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2,
+        return_contrast_sensitivity=True,
+    )
+    if normalize == "relu":
+        sim = jax.nn.relu(sim)
+        contrast_sensitivity = jax.nn.relu(contrast_sensitivity)
+    return sim, contrast_sensitivity
+
+
+def _multiscale_ssim_update(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = None,
+) -> Array:
+    """MS-SSIM across a 2x-downsampling pyramid (reference ssim.py:286-424)."""
+    is_3d = preds.ndim == 5
+
+    if not isinstance(kernel_size, Sequence):
+        kernel_size = 3 * [kernel_size] if is_3d else 2 * [kernel_size]
+    if not isinstance(sigma, Sequence):
+        sigma = 3 * [sigma] if is_3d else 2 * [sigma]
+
+    if preds.shape[-1] < 2 ** len(betas) or preds.shape[-2] < 2 ** len(betas):
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)}, the image height and width dimensions must be"
+            f" larger than or equal to {2 ** len(betas)}."
+        )
+    _betas_div = max(1, (len(betas) - 1)) ** 2
+    if preds.shape[-2] // _betas_div <= kernel_size[0] - 1:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)} and kernel size {kernel_size[0]},"
+            f" the image height must be larger than {(kernel_size[0] - 1) * _betas_div}."
+        )
+    if preds.shape[-1] // _betas_div <= kernel_size[1] - 1:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)} and kernel size {kernel_size[1]},"
+            f" the image width must be larger than {(kernel_size[1] - 1) * _betas_div}."
+        )
+
+    mcs_list = []
+    sim = None
+    for _ in range(len(betas)):
+        sim, contrast_sensitivity = _get_normalized_sim_and_cs(
+            preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2, normalize=normalize
+        )
+        mcs_list.append(contrast_sensitivity)
+        window = (1, 1) + (2,) * (preds.ndim - 2)
+        preds = jax.lax.reduce_window(preds, 0.0, jax.lax.add, window, window, "VALID") / (
+            2 ** (preds.ndim - 2)
+        )
+        target = jax.lax.reduce_window(target, 0.0, jax.lax.add, window, window, "VALID") / (
+            2 ** (target.ndim - 2)
+        )
+
+    mcs_list[-1] = sim
+    mcs_stack = jnp.stack(mcs_list)
+
+    if normalize == "simple":
+        mcs_stack = (mcs_stack + 1) / 2
+
+    betas_arr = jnp.asarray(betas, mcs_stack.dtype).reshape(-1, 1)
+    mcs_weighted = mcs_stack**betas_arr
+    return jnp.prod(mcs_weighted, axis=0)
+
+
+def _multiscale_ssim_compute(mcs_per_image: Array, reduction: Optional[str] = "elementwise_mean") -> Array:
+    return _reduce(mcs_per_image, reduction)
+
+
+def multiscale_structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = "relu",
+) -> Array:
+    """Multi-scale SSIM (reference ssim.py:446-527).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.functional.image import multiscale_structural_similarity_index_measure
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (8, 3, 64, 64))
+        >>> target = preds * 0.75
+        >>> round(float(multiscale_structural_similarity_index_measure(
+        ...     preds, target, data_range=1.0, betas=(0.3, 0.3, 0.4))), 4)
+        0.9466
+    """
+    if not isinstance(betas, tuple) or not all(isinstance(beta, float) for beta in betas):
+        raise ValueError("Argument `betas` is expected to be of a tuple of floats.")
+    if normalize and normalize not in ("relu", "simple"):
+        raise ValueError("Argument `normalize` to be expected either `None`, `relu` or `simple`")
+
+    preds, target = _ssim_check_inputs(preds, target)
+    mcs_per_image = _multiscale_ssim_update(
+        preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2, betas, normalize
+    )
+    return _multiscale_ssim_compute(mcs_per_image, reduction)

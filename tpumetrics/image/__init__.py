@@ -1,0 +1,31 @@
+"""Image metric domain (counterpart of reference ``image/__init__.py``)."""
+
+from tpumetrics.image.d_lambda import SpectralDistortionIndex
+from tpumetrics.image.ergas import ErrorRelativeGlobalDimensionlessSynthesis
+from tpumetrics.image.psnr import PeakSignalNoiseRatio
+from tpumetrics.image.psnrb import PeakSignalNoiseRatioWithBlockedEffect
+from tpumetrics.image.rase import RelativeAverageSpectralError
+from tpumetrics.image.rmse_sw import RootMeanSquaredErrorUsingSlidingWindow
+from tpumetrics.image.sam import SpectralAngleMapper
+from tpumetrics.image.ssim import (
+    MultiScaleStructuralSimilarityIndexMeasure,
+    StructuralSimilarityIndexMeasure,
+)
+from tpumetrics.image.tv import TotalVariation
+from tpumetrics.image.uqi import UniversalImageQualityIndex
+from tpumetrics.image.vif import VisualInformationFidelity
+
+__all__ = [
+    "ErrorRelativeGlobalDimensionlessSynthesis",
+    "MultiScaleStructuralSimilarityIndexMeasure",
+    "PeakSignalNoiseRatio",
+    "PeakSignalNoiseRatioWithBlockedEffect",
+    "RelativeAverageSpectralError",
+    "RootMeanSquaredErrorUsingSlidingWindow",
+    "SpectralAngleMapper",
+    "SpectralDistortionIndex",
+    "StructuralSimilarityIndexMeasure",
+    "TotalVariation",
+    "UniversalImageQualityIndex",
+    "VisualInformationFidelity",
+]

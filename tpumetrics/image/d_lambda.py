@@ -1,0 +1,63 @@
+"""SpectralDistortionIndex (counterpart of reference ``image/d_lambda.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+
+from tpumetrics.functional.image.d_lambda import (
+    _spectral_distortion_index_compute,
+    _spectral_distortion_index_update,
+)
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class SpectralDistortionIndex(Metric):
+    """D_lambda pan-sharpening distortion, accumulated over batches
+    (reference d_lambda.py:33-146).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.image import SpectralDistortionIndex
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (16, 3, 16, 16))
+        >>> target = preds * 0.75
+        >>> sdi = SpectralDistortionIndex()
+        >>> float(sdi(preds, target)) < 0.2
+        True
+    """
+
+    higher_is_better: bool = True
+    is_differentiable: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    preds: List[Array]
+    target: List[Array]
+
+    def __init__(self, p: int = 1, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(p, int) or p <= 0:
+            raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+        self.p = p
+        allowed_reductions = ("elementwise_mean", "sum", "none")
+        if reduction not in allowed_reductions:
+            raise ValueError(f"Expected argument `reduction` be one of {allowed_reductions} but got {reduction}")
+        self.reduction = reduction
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Append image batches."""
+        preds, target = _spectral_distortion_index_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        return _spectral_distortion_index_compute(
+            dim_zero_cat(self.preds), dim_zero_cat(self.target), self.p, self.reduction
+        )

@@ -1,0 +1,107 @@
+"""PeakSignalNoiseRatio (counterpart of reference ``image/psnr.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.image.psnr import _psnr_compute, _psnr_update
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class PeakSignalNoiseRatio(Metric):
+    """PSNR accumulated over batches (reference psnr.py:33-154).
+
+    Args:
+        data_range: value range of the input; ``None`` tracks the observed
+            target min/max (only valid with ``dim=None``), a tuple clamps
+            inputs into the range.
+        base: logarithm base.
+        reduction: reduction over per-``dim`` scores.
+        dim: dimensions to compute PSNR over; ``None`` means global.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.image import PeakSignalNoiseRatio
+        >>> psnr = PeakSignalNoiseRatio(data_range=3.0)
+        >>> preds = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])
+        >>> target = jnp.asarray([[3.0, 2.0], [1.0, 0.0]])
+        >>> round(float(psnr(preds, target)), 4)
+        2.5531
+    """
+
+    is_differentiable: bool = True
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(
+        self,
+        data_range: Optional[Union[float, Tuple[float, float]]] = None,
+        base: float = 10.0,
+        reduction: Optional[str] = "elementwise_mean",
+        dim: Optional[Union[int, Tuple[int, ...]]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if dim is None and reduction != "elementwise_mean":
+            from tpumetrics.utils.prints import rank_zero_warn
+
+            rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+
+        if dim is None:
+            self.add_state("sum_squared_error", default=jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        else:
+            self.add_state("sum_squared_error", default=[], dist_reduce_fx="cat")
+            self.add_state("total", default=[], dist_reduce_fx="cat")
+
+        self.clamping_fn = None
+        if data_range is None:
+            if dim is not None:
+                raise ValueError("The `data_range` must be given when `dim` is not None.")
+            self.data_range = None
+            self.add_state("min_target", default=jnp.zeros(()), dist_reduce_fx="min")
+            self.add_state("max_target", default=jnp.zeros(()), dist_reduce_fx="max")
+        elif isinstance(data_range, tuple):
+            self.add_state("data_range", default=jnp.asarray(data_range[1] - data_range[0]), dist_reduce_fx="mean")
+            self.clamping_fn = lambda x: jnp.clip(x, data_range[0], data_range[1])
+        else:
+            self.add_state("data_range", default=jnp.asarray(float(data_range)), dist_reduce_fx="mean")
+        self.base = base
+        self.reduction = reduction
+        self.dim = tuple(dim) if isinstance(dim, Sequence) else dim
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate squared error and (when untracked) the data range."""
+        preds = jnp.asarray(preds, jnp.float32)
+        target = jnp.asarray(target, jnp.float32)
+        if self.clamping_fn is not None:
+            preds = self.clamping_fn(preds)
+            target = self.clamping_fn(target)
+
+        sum_squared_error, num_obs = _psnr_update(preds, target, dim=self.dim)
+        if self.dim is None:
+            if self.data_range is None:
+                self.min_target = jnp.minimum(target.min(), self.min_target)
+                self.max_target = jnp.maximum(target.max(), self.max_target)
+            self.sum_squared_error = self.sum_squared_error + sum_squared_error
+            self.total = self.total + num_obs
+        else:
+            self.sum_squared_error.append(sum_squared_error)
+            self.total.append(num_obs)
+
+    def compute(self) -> Array:
+        data_range = self.data_range if self.data_range is not None else (self.max_target - self.min_target)
+        if self.dim is None:
+            sum_squared_error = self.sum_squared_error
+            total = self.total
+        else:
+            sum_squared_error = dim_zero_cat(self.sum_squared_error)
+            total = dim_zero_cat(self.total)
+        return _psnr_compute(sum_squared_error, total, data_range, base=self.base, reduction=self.reduction)

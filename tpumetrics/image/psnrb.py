@@ -1,0 +1,55 @@
+"""PeakSignalNoiseRatioWithBlockedEffect (counterpart of reference ``image/psnrb.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.image.psnrb import _psnrb_compute, _psnrb_update
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class PeakSignalNoiseRatioWithBlockedEffect(Metric):
+    """PSNR with a blockiness penalty, for grayscale images (reference psnrb.py:33-136).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.image import PeakSignalNoiseRatioWithBlockedEffect
+        >>> metric = PeakSignalNoiseRatioWithBlockedEffect()
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (2, 1, 16, 16))
+        >>> target = jax.random.uniform(jax.random.PRNGKey(1), (2, 1, 16, 16))
+        >>> float(metric(preds, target)) > 0
+        True
+    """
+
+    is_differentiable: bool = True
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, block_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(block_size, int) or block_size < 1:
+            raise ValueError("Argument `block_size` should be a positive integer")
+        self.block_size = block_size
+        self.add_state("sum_squared_error", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("bef", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("data_range", default=jnp.zeros(()), dist_reduce_fx="max")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate squared error, blocked effect, and observed range."""
+        preds = jnp.asarray(preds, jnp.float32)
+        target = jnp.asarray(target, jnp.float32)
+        sum_squared_error, bef, num_obs = _psnrb_update(preds, target, block_size=self.block_size)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.bef = self.bef + bef
+        self.total = self.total + num_obs
+        self.data_range = jnp.maximum(self.data_range, target.max() - target.min())
+
+    def compute(self) -> Array:
+        return _psnrb_compute(self.sum_squared_error, self.bef, self.total, self.data_range)

@@ -1,0 +1,53 @@
+"""RelativeAverageSpectralError (counterpart of reference ``image/rase.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+
+from tpumetrics.functional.image.rase import relative_average_spectral_error
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class RelativeAverageSpectralError(Metric):
+    """RASE accumulated over batches (reference rase.py:30-117).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.image import RelativeAverageSpectralError
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (4, 3, 16, 16))
+        >>> target = preds * 0.75
+        >>> rase = RelativeAverageSpectralError()
+        >>> float(rase(preds, target)) > 0
+        True
+    """
+
+    higher_is_better: bool = False
+    is_differentiable: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    preds: List[Array]
+    target: List[Array]
+
+    def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(window_size, int) or window_size < 1:
+            raise ValueError(f"Argument `window_size` is expected to be a positive integer, but got {window_size}")
+        self.window_size = window_size
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Append image batches."""
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        return relative_average_spectral_error(
+            dim_zero_cat(self.preds), dim_zero_cat(self.target), self.window_size
+        )

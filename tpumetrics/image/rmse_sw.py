@@ -1,0 +1,54 @@
+"""RootMeanSquaredErrorUsingSlidingWindow (counterpart of reference ``image/rmse_sw.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.image.rmse_sw import _rmse_sw_compute, _rmse_sw_update
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class RootMeanSquaredErrorUsingSlidingWindow(Metric):
+    """Windowed RMSE accumulated over batches (reference rmse_sw.py:26-109).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.image import RootMeanSquaredErrorUsingSlidingWindow
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (4, 3, 16, 16))
+        >>> target = preds * 0.75
+        >>> rmse_sw = RootMeanSquaredErrorUsingSlidingWindow()
+        >>> float(rmse_sw(preds, target)) > 0
+        True
+    """
+
+    higher_is_better: bool = False
+    is_differentiable: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(window_size, int) and window_size > 0):
+            raise ValueError("Argument `window_size` is expected to be a positive integer.")
+        self.window_size = window_size
+        self.add_state("rmse_val_sum", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total_images", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate windowed-RMSE sums (the map itself is not needed for
+        the scalar result, so only the sums are carried; reference keeps the
+        map as an unsynced plain attribute, rmse_sw.py:84-89)."""
+        rmse_val_sum, _, total = _rmse_sw_update(
+            preds, target, self.window_size, rmse_val_sum=None, rmse_map=None, total_images=None
+        )
+        self.rmse_val_sum = self.rmse_val_sum + rmse_val_sum
+        self.total_images = self.total_images + total
+
+    def compute(self) -> Optional[Array]:
+        rmse, _ = _rmse_sw_compute(self.rmse_val_sum, jnp.zeros(()), self.total_images)
+        return rmse

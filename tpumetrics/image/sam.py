@@ -1,0 +1,62 @@
+"""SpectralAngleMapper (counterpart of reference ``image/sam.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.image.sam import _sam_compute, _sam_update
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class SpectralAngleMapper(Metric):
+    """Spectral angle between multispectral images, accumulated over batches
+    (reference sam.py:35-152).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.image import SpectralAngleMapper
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (16, 3, 16, 16))
+        >>> target = jax.random.uniform(jax.random.PRNGKey(123), (16, 3, 16, 16))
+        >>> sam = SpectralAngleMapper()
+        >>> 0.0 < float(sam(preds, target)) < 1.6
+        True
+    """
+
+    higher_is_better: bool = False
+    is_differentiable: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if reduction == "none" or reduction is None:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+        else:
+            self.add_state("sum_sam", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("numel", jnp.zeros(()), dist_reduce_fx="sum")
+        self.reduction = reduction
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate spectral-angle sums (or raw images for reduction='none')."""
+        preds, target = _sam_update(preds, target)
+        if self.reduction == "none" or self.reduction is None:
+            self.preds.append(preds)
+            self.target.append(target)
+        else:
+            sam_map = _sam_compute(preds, target, reduction="none")
+            self.sum_sam = self.sum_sam + sam_map.sum()
+            self.numel = self.numel + sam_map.size
+
+    def compute(self) -> Array:
+        if self.reduction == "none" or self.reduction is None:
+            return _sam_compute(dim_zero_cat(self.preds), dim_zero_cat(self.target), self.reduction)
+        if self.reduction == "sum":
+            return self.sum_sam
+        return self.sum_sam / self.numel

@@ -1,0 +1,55 @@
+"""TotalVariation (counterpart of reference ``image/tv.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.image.tv import _total_variation_compute, _total_variation_update
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class TotalVariation(Metric):
+    """Total variation accumulated over batches (reference tv.py:30-123).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.image import TotalVariation
+        >>> tv = TotalVariation()
+        >>> img = jax.random.uniform(jax.random.PRNGKey(42), (5, 3, 28, 28))
+        >>> float(tv(img)) > 0
+        True
+    """
+
+    full_state_update: bool = False
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if reduction is not None and reduction not in ("sum", "mean", "none"):
+            raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+        self.reduction = reduction
+        self.add_state("score_list", default=[], dist_reduce_fx="cat")
+        self.add_state("score", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("num_elements", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, img: Array) -> None:
+        """Accumulate per-image TV scores."""
+        score, num_elements = _total_variation_update(img)
+        if self.reduction is None or self.reduction == "none":
+            self.score_list.append(score)
+        else:
+            self.score = self.score + score.sum()
+        self.num_elements = self.num_elements + num_elements
+
+    def compute(self) -> Array:
+        if self.reduction is None or self.reduction == "none":
+            return dim_zero_cat(self.score_list)
+        return _total_variation_compute(self.score, self.num_elements, self.reduction)
