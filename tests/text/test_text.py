@@ -1,0 +1,345 @@
+"""Text domain vs sacrebleu + independent references (counterpart of
+reference ``tests/unittests/text/``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import sacrebleu
+from sacrebleu.metrics import CHRF as SbCHRF, TER as SbTER
+
+from tpumetrics.functional.text import (
+    bleu_score,
+    char_error_rate,
+    chrf_score,
+    edit_distance,
+    extended_edit_distance,
+    match_error_rate,
+    perplexity,
+    rouge_score,
+    sacre_bleu_score,
+    squad,
+    translation_edit_rate,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+from tpumetrics.text import (
+    BLEUScore,
+    CharErrorRate,
+    CHRFScore,
+    EditDistance,
+    ExtendedEditDistance,
+    MatchErrorRate,
+    Perplexity,
+    ROUGEScore,
+    SacreBLEUScore,
+    SQuAD,
+    TranslationEditRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+
+PREDS_A = ["the cat is on the mat", "hello there general kenobi"]
+TARGETS_A = [["there is a cat on the mat", "a cat is on the mat"], ["hello there general kenobi", "hello there!"]]
+PREDS_B = ["it is a guide to action which ensures that the military always obeys the commands of the party"]
+TARGETS_B = [
+    [
+        "it is a guide to action that ensures that the military will forever heed party commands",
+        "it is the guiding principle which guarantees the military forces always being under the command of the party",
+    ]
+]
+REFS_T_A = list(zip(*TARGETS_A))
+REFS_T_B = list(zip(*TARGETS_B))
+
+
+# ------------------------------------------------------------ BLEU family
+
+
+@pytest.mark.parametrize("tokenize", ["13a", "none", "char", "intl", "zh"])
+def test_sacre_bleu_vs_sacrebleu(tokenize):
+    got = float(sacre_bleu_score(PREDS_A, TARGETS_A, tokenize=tokenize))
+    ref = sacrebleu.corpus_bleu(PREDS_A, REFS_T_A, tokenize=tokenize).score / 100
+    assert np.isclose(got, ref, atol=1e-4)
+
+
+@pytest.mark.parametrize("lowercase", [False, True])
+@pytest.mark.parametrize("smooth", [False, True])
+def test_sacre_bleu_options(lowercase, smooth):
+    got = float(sacre_bleu_score(PREDS_B, TARGETS_B, lowercase=lowercase, smooth=smooth))
+    ref = (
+        sacrebleu.corpus_bleu(
+            PREDS_B, REFS_T_B, lowercase=lowercase, smooth_method="add-k" if smooth else "none", smooth_value=1
+        ).score
+        / 100
+    )
+    assert np.isclose(got, ref, atol=1e-4)
+
+
+def test_bleu_class_streaming():
+    metric = SacreBLEUScore()
+    metric.update(PREDS_A[:1], TARGETS_A[:1])
+    metric.update(PREDS_A[1:], TARGETS_A[1:])
+    got = float(metric.compute())
+    ref = sacrebleu.corpus_bleu(PREDS_A, REFS_T_A).score / 100
+    assert np.isclose(got, ref, atol=1e-4)
+
+
+def test_bleu_plain():
+    got = float(bleu_score(["the cat is on the mat"], [["there is a cat on the mat", "a cat is on the mat"]]))
+    assert np.isclose(got, 0.7598, atol=1e-4)
+    m = BLEUScore(n_gram=2, smooth=True)
+    out = m(["the cat is on the mat"], [["a cat is on the mat"]])
+    assert 0.0 < float(out) <= 1.0
+
+
+def test_bleu_zero_matches():
+    assert float(bleu_score(["xyz abc"], [["completely different words"]])) == 0.0
+
+
+# ------------------------------------------------------------------ chrF
+
+
+@pytest.mark.parametrize("word_order", [0, 2])
+@pytest.mark.parametrize("lowercase", [False, True])
+def test_chrf_vs_sacrebleu(word_order, lowercase):
+    got = float(chrf_score(PREDS_A, TARGETS_A, n_word_order=word_order, lowercase=lowercase))
+    ref = (
+        SbCHRF(word_order=word_order, lowercase=lowercase, eps_smoothing=True)
+        .corpus_score(PREDS_A, REFS_T_A)
+        .score
+        / 100
+    )
+    assert np.isclose(got, ref, atol=1e-5)
+
+
+def test_chrf_class_streaming_and_sentence_scores():
+    metric = CHRFScore(return_sentence_level_score=True)
+    metric.update(PREDS_A[:1], TARGETS_A[:1])
+    metric.update(PREDS_A[1:], TARGETS_A[1:])
+    score, sentence_scores = metric.compute()
+    ref = SbCHRF(word_order=2, eps_smoothing=True).corpus_score(PREDS_A, REFS_T_A).score / 100
+    assert np.isclose(float(score), ref, atol=1e-5)
+    assert sentence_scores.shape == (2,)
+
+
+# ------------------------------------------------------------------- TER
+
+
+@pytest.mark.parametrize(
+    "kwargs, sb_kwargs",
+    [
+        ({}, {}),
+        ({"normalize": True}, {"normalized": True}),
+        ({"lowercase": False}, {"case_sensitive": True}),
+        ({"no_punctuation": True}, {"no_punct": True}),
+    ],
+    ids=["default", "normalize", "case_sensitive", "no_punct"],
+)
+def test_ter_vs_sacrebleu(kwargs, sb_kwargs):
+    got = float(translation_edit_rate(PREDS_A + PREDS_B, TARGETS_A + TARGETS_B, **kwargs))
+    refs = list(zip(*[t + [t[0]] * (2 - len(t)) for t in TARGETS_A + TARGETS_B]))
+    ref = SbTER(**sb_kwargs).corpus_score(PREDS_A + PREDS_B, refs).score / 100
+    assert np.isclose(got, ref, atol=1e-4)
+
+
+def test_ter_class():
+    metric = TranslationEditRate(return_sentence_level_score=True)
+    metric.update(PREDS_A, TARGETS_A)
+    score, sentence = metric.compute()
+    assert sentence.shape == (2,)
+    ref = SbTER().corpus_score(PREDS_A, REFS_T_A).score / 100
+    assert np.isclose(float(score), ref, atol=1e-4)
+
+
+# ----------------------------------------------------------- error rates
+
+
+def test_error_rates_documented_values():
+    preds = ["this is the prediction", "there is an other sample"]
+    target = ["this is the reference", "there is another one"]
+    assert np.isclose(float(word_error_rate(preds, target)), 0.5, atol=1e-4)
+    assert np.isclose(float(char_error_rate(preds, target)), 0.3415, atol=1e-4)
+    assert np.isclose(float(match_error_rate(preds, target)), 0.4444, atol=1e-4)
+    assert np.isclose(float(word_information_lost(preds, target)), 0.6528, atol=1e-4)
+    assert np.isclose(float(word_information_preserved(preds, target)), 0.3472, atol=1e-4)
+
+
+@pytest.mark.parametrize(
+    "metric_class, fn",
+    [
+        (WordErrorRate, word_error_rate),
+        (CharErrorRate, char_error_rate),
+        (MatchErrorRate, match_error_rate),
+        (WordInfoLost, word_information_lost),
+        (WordInfoPreserved, word_information_preserved),
+    ],
+    ids=["wer", "cer", "mer", "wil", "wip"],
+)
+def test_error_rate_class_streaming_matches_corpus(metric_class, fn):
+    preds = ["this is the prediction", "there is an other sample", "a third longer sample here"]
+    target = ["this is the reference", "there is another one", "a third long sample there"]
+    m = metric_class()
+    for p, t in zip(preds, target):
+        m.update(p, t)
+    assert np.isclose(float(m.compute()), float(fn(preds, target)), atol=1e-6)
+
+
+def test_edit_distance():
+    assert float(edit_distance(["rain"], ["shine"])) == 3.0
+    assert edit_distance(["rain", "lnaguaeg"], ["shine", "language"], reduction=None).tolist() == [3, 4]
+    assert float(edit_distance(["rain", "lnaguaeg"], ["shine", "language"], reduction="sum")) == 7.0
+    m = EditDistance(reduction="mean")
+    m.update(["rain"], ["shine"])
+    m.update(["lnaguaeg"], ["language"])
+    assert float(m.compute()) == 3.5
+    with pytest.raises(ValueError, match="same length"):
+        edit_distance(["a", "b"], ["c"])
+
+
+# ------------------------------------------------------------ perplexity
+
+
+def test_perplexity_uniform_is_vocab_size():
+    preds = jnp.zeros((2, 10, 7))
+    target = jax.random.randint(jax.random.PRNGKey(0), (2, 10), 0, 7)
+    assert np.isclose(float(perplexity(preds, target)), 7.0, rtol=1e-4)
+
+
+def test_perplexity_vs_manual():
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.standard_normal((3, 12, 9)), dtype=jnp.float32)
+    target = jnp.asarray(rng.integers(0, 9, (3, 12)))
+    got = float(perplexity(preds, target))
+    p = np.asarray(preds, np.float64)
+    logp = p - np.log(np.exp(p - p.max(-1, keepdims=True)).sum(-1, keepdims=True)) - p.max(-1, keepdims=True)
+    tl = np.take_along_axis(logp.reshape(-1, 9), np.asarray(target).reshape(-1, 1), 1)
+    assert np.isclose(got, np.exp(-tl.mean()), rtol=1e-4)
+
+
+def test_perplexity_ignore_index_and_class():
+    rng = np.random.default_rng(1)
+    preds = jnp.asarray(rng.standard_normal((2, 6, 5)), dtype=jnp.float32)
+    target = jnp.asarray([[0, 1, 2, -100, 4, 1], [2, -100, 1, 0, 3, 2]])
+    m = Perplexity(ignore_index=-100)
+    m.update(preds, target)
+    assert np.isfinite(float(m.compute()))
+
+    # jit functional path
+    m2 = Perplexity()
+    state = m2.init_state()
+    state = jax.jit(m2.functional_update)(state, preds, jnp.clip(jnp.abs(target), 0, 4))
+    assert np.isfinite(float(jax.jit(m2.functional_compute)(state)))
+
+
+# ------------------------------------------------------------------- EED
+
+
+def test_eed_documented_value():
+    preds = ["this is the prediction", "here is an other sample"]
+    target = ["this is the reference", "here is another one"]
+    assert np.isclose(float(extended_edit_distance(preds, target)), 0.3078, atol=1e-4)
+    m = ExtendedEditDistance(return_sentence_level_score=True)
+    m.update(preds[:1], target[:1])
+    m.update(preds[1:], target[1:])
+    avg, sent = m.compute()
+    assert sent.shape == (2,)
+    assert np.isclose(float(avg), 0.3078, atol=1e-4)
+
+
+# ----------------------------------------------------------------- ROUGE
+
+
+def test_rouge_known_values():
+    result = rouge_score("My name is John", "Is your name John")
+    assert np.isclose(float(result["rouge1_fmeasure"]), 0.75, atol=1e-4)
+    assert np.isclose(float(result["rouge1_precision"]), 0.75, atol=1e-4)
+    assert np.isclose(float(result["rouge2_fmeasure"]), 0.0, atol=1e-4)
+    assert np.isclose(float(result["rougeL_fmeasure"]), 0.5, atol=1e-4)
+
+
+def test_rouge_class_multi_batch():
+    m = ROUGEScore(rouge_keys=("rouge1", "rougeL"))
+    m.update(["My name is John"], ["Is your name John"])
+    m.update(["The cat sat on the mat"], ["The cat was sitting on the mat"])
+    out = m.compute()
+    r1 = rouge_score("My name is John", "Is your name John", rouge_keys=("rouge1", "rougeL"))
+    r2 = rouge_score("The cat sat on the mat", "The cat was sitting on the mat", rouge_keys=("rouge1", "rougeL"))
+    for k in out:
+        assert np.isclose(float(out[k]), (float(r1[k]) + float(r2[k])) / 2, atol=1e-5), k
+
+
+def test_rouge_multi_reference_best_vs_avg():
+    preds = ["the cat sat on the mat"]
+    targets = [["a cat sat on a mat", "the cat was on the mat"]]
+    best = rouge_score(preds, targets, accumulate="best", rouge_keys="rouge1")
+    avg = rouge_score(preds, targets, accumulate="avg", rouge_keys="rouge1")
+    assert float(best["rouge1_fmeasure"]) >= float(avg["rouge1_fmeasure"])
+
+
+# ----------------------------------------------------------------- SQuAD
+
+
+def test_squad():
+    preds = [{"prediction_text": "1976", "id": "a"}, {"prediction_text": "the big apple", "id": "b"}]
+    target = [
+        {"answers": {"answer_start": [97], "text": ["1976"]}, "id": "a"},
+        {"answers": {"answer_start": [1], "text": ["The Big Apple", "New York"]}, "id": "b"},
+    ]
+    out = squad(preds, target)
+    assert float(out["exact_match"]) == 100.0
+    assert float(out["f1"]) == 100.0
+
+    m = SQuAD()
+    m.update(preds[:1], target[:1])
+    m.update(preds[1:], target[1:])
+    out = m.compute()
+    assert float(out["exact_match"]) == 100.0
+
+    with pytest.raises(KeyError, match="Expected keys"):
+        squad([{"id": "a"}], target[:1])
+
+
+# ----------------------------------------------------- DDP-style merging
+
+
+def test_text_states_merge_across_replicas():
+    """Sum-state text metrics merge exactly like the reference's DDP path."""
+    from tpumetrics.parallel.merge import merge_metric_states
+
+    preds = ["this is the prediction", "there is an other sample", "one more line here", "the last sample now"]
+    target = ["this is the reference", "there is another one", "one more line there", "the last example now"]
+
+    replicas = [WordErrorRate() for _ in range(2)]
+    for rank in range(2):
+        for i in range(rank, 4, 2):
+            replicas[rank].update(preds[i], target[i])
+    merged = merge_metric_states([m.metric_state() for m in replicas], replicas[0]._reductions)
+    got = float(replicas[0].functional_compute(merged))
+    assert np.isclose(got, float(word_error_rate(preds, target)), atol=1e-6)
+
+    replicas = [SacreBLEUScore() for _ in range(2)]
+    for rank in range(2):
+        for i in range(rank, 2, 2):
+            replicas[rank].update(PREDS_A[i : i + 1], TARGETS_A[i : i + 1])
+    merged = merge_metric_states([m.metric_state() for m in replicas], replicas[0]._reductions)
+    got = float(replicas[0].functional_compute(merged))
+    ref = sacrebleu.corpus_bleu(PREDS_A, REFS_T_A).score / 100
+    assert np.isclose(got, ref, atol=1e-4)
+
+
+def test_error_rates_reject_mismatched_corpora():
+    with pytest.raises(ValueError, match="same length"):
+        word_error_rate(["a b c", "totally wrong"], ["a b c"])
+    with pytest.raises(ValueError, match="same length"):
+        char_error_rate(["ab"], ["ab", "cd"])
+
+
+def test_eed_empty_batch_is_noop():
+    assert float(extended_edit_distance([], [])) == 0.0
+    m = ExtendedEditDistance()
+    m.update([], [])
+    assert float(m.compute()) == 0.0

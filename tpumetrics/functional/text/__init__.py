@@ -1,0 +1,34 @@
+"""Text functional metrics (counterpart of reference
+``functional/text/__init__.py``)."""
+
+from tpumetrics.functional.text.bleu import bleu_score
+from tpumetrics.functional.text.cer import char_error_rate
+from tpumetrics.functional.text.chrf import chrf_score
+from tpumetrics.functional.text.edit import edit_distance
+from tpumetrics.functional.text.eed import extended_edit_distance
+from tpumetrics.functional.text.mer import match_error_rate
+from tpumetrics.functional.text.perplexity import perplexity
+from tpumetrics.functional.text.rouge import rouge_score
+from tpumetrics.functional.text.sacre_bleu import sacre_bleu_score
+from tpumetrics.functional.text.squad import squad
+from tpumetrics.functional.text.ter import translation_edit_rate
+from tpumetrics.functional.text.wer import word_error_rate
+from tpumetrics.functional.text.wil import word_information_lost
+from tpumetrics.functional.text.wip import word_information_preserved
+
+__all__ = [
+    "bleu_score",
+    "char_error_rate",
+    "chrf_score",
+    "edit_distance",
+    "extended_edit_distance",
+    "match_error_rate",
+    "perplexity",
+    "rouge_score",
+    "sacre_bleu_score",
+    "squad",
+    "translation_edit_rate",
+    "word_error_rate",
+    "word_information_lost",
+    "word_information_preserved",
+]

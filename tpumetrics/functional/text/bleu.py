@@ -1,0 +1,134 @@
+"""BLEU score (counterpart of reference ``functional/text/bleu.py``).
+
+N-gram counting is host-side Python (strings); the four count accumulators
+are device arrays with sum-reduce sync, and the final brevity-penalty /
+geometric-mean arithmetic is jnp (jit-safe given the accumulated counts).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _count_ngram(ngram_input_list: Sequence[str], n_gram: int) -> Counter:
+    """All 1..n gram counts of a token list (reference bleu.py:29-46)."""
+    ngram_counter: Counter = Counter()
+    for i in range(1, n_gram + 1):
+        for j in range(len(ngram_input_list) - i + 1):
+            ngram_counter[tuple(ngram_input_list[j : i + j])] += 1
+    return ngram_counter
+
+
+def _tokenize_fn(sentence: str) -> Sequence[str]:
+    """Whitespace tokenization (reference bleu.py:49-58)."""
+    return sentence.split()
+
+
+def _bleu_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    numerator: np.ndarray,
+    denominator: np.ndarray,
+    preds_len: float,
+    target_len: float,
+    n_gram: int = 4,
+    tokenizer: Callable[[str], Sequence[str]] = _tokenize_fn,
+) -> Tuple[float, float]:
+    """Accumulate clipped n-gram matches per order (reference bleu.py:61-121).
+    Mutates ``numerator``/``denominator`` (host numpy) and returns updated
+    length sums."""
+    target_tokens = [[tokenizer(line) if line else [] for line in t] for t in target]
+    preds_tokens = [tokenizer(line) if line else [] for line in preds]
+
+    for pred, targets in zip(preds_tokens, target_tokens):
+        preds_len += len(pred)
+        target_len_list = [len(tgt) for tgt in targets]
+        target_len_diff = [abs(len(pred) - x) for x in target_len_list]
+        target_len += target_len_list[target_len_diff.index(min(target_len_diff))]
+        preds_counter = _count_ngram(pred, n_gram)
+        target_counter: Counter = Counter()
+        for tgt in targets:
+            target_counter |= _count_ngram(tgt, n_gram)
+
+        ngram_counter_clip = preds_counter & target_counter
+        for counter_clip in ngram_counter_clip:
+            numerator[len(counter_clip) - 1] += ngram_counter_clip[counter_clip]
+        for counter in preds_counter:
+            denominator[len(counter) - 1] += preds_counter[counter]
+
+    return preds_len, target_len
+
+
+def _bleu_score_compute(
+    preds_len: Array,
+    target_len: Array,
+    numerator: Array,
+    denominator: Array,
+    n_gram: int,
+    weights: Sequence[float],
+    smooth: bool,
+) -> Array:
+    """Geometric mean of n-gram precisions × brevity penalty (reference
+    bleu.py:124-160), branch-free: the zero-match early return and the
+    ``preds_len > target_len`` brevity branch are where-masks."""
+    numerator = jnp.asarray(numerator, jnp.float32)
+    denominator = jnp.asarray(denominator, jnp.float32)
+    preds_len = jnp.asarray(preds_len, jnp.float32)
+    target_len = jnp.asarray(target_len, jnp.float32)
+
+    any_zero = jnp.min(numerator) == 0.0
+    safe_den = jnp.where(denominator > 0, denominator, 1.0)
+    if smooth:
+        precision_scores = (numerator + 1.0) / (safe_den + 1.0)
+        precision_scores = precision_scores.at[0].set(numerator[0] / safe_den[0])
+    else:
+        precision_scores = numerator / safe_den
+
+    safe_precision = jnp.where(precision_scores > 0, precision_scores, 1.0)
+    log_precision_scores = jnp.asarray(weights, jnp.float32) * jnp.log(safe_precision)
+    geometric_mean = jnp.exp(jnp.sum(log_precision_scores))
+    safe_preds_len = jnp.where(preds_len > 0, preds_len, 1.0)
+    brevity_penalty = jnp.where(preds_len > target_len, 1.0, jnp.exp(1 - (target_len / safe_preds_len)))
+    return jnp.where(any_zero, 0.0, brevity_penalty * geometric_mean)
+
+
+def bleu_score(
+    preds: Union[str, Sequence[str]],
+    target: Union[Sequence[str], Sequence[Sequence[str]]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    weights: Optional[Sequence[float]] = None,
+) -> Array:
+    """BLEU score of translated corpus against reference corpora
+    (reference bleu.py:163-209).
+
+    Example:
+        >>> from tpumetrics.functional.text import bleu_score
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> round(float(bleu_score(preds, target)), 4)
+        0.7598
+    """
+    preds_ = [preds] if isinstance(preds, str) else preds
+    target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+
+    if len(preds_) != len(target_):
+        raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+    if weights is None:
+        weights = [1.0 / n_gram] * n_gram
+
+    numerator = np.zeros(n_gram)
+    denominator = np.zeros(n_gram)
+    preds_len, target_len = _bleu_score_update(preds_, target_, numerator, denominator, 0.0, 0.0, n_gram)
+    return _bleu_score_compute(
+        preds_len, target_len, jnp.asarray(numerator), jnp.asarray(denominator), n_gram, weights, smooth
+    )

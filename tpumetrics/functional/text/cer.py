@@ -1,0 +1,43 @@
+"""Char error rate (counterpart of reference ``functional/text/cer.py``)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.text.helper import _edit_distance, _normalize_inputs
+
+Array = jax.Array
+
+
+def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Char-level edit distance + reference char count (reference cer.py:22-49)."""
+    preds, target = _normalize_inputs(preds, target)
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred
+        tgt_tokens = tgt
+        errors += _edit_distance(list(pred_tokens), list(tgt_tokens))
+        total += len(tgt_tokens)
+    return jnp.asarray(errors, jnp.float32), jnp.asarray(total, jnp.float32)
+
+
+def _cer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Character error rate of transcriptions (reference cer.py:66-87).
+
+    Example:
+        >>> from tpumetrics.functional.text import char_error_rate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> round(float(char_error_rate(preds, target)), 4)
+        0.3415
+    """
+    errors, total = _cer_update(preds, target)
+    return _cer_compute(errors, total)

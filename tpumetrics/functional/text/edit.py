@@ -1,0 +1,63 @@
+"""Edit distance (counterpart of reference ``functional/text/edit.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.text.helper import _edit_distance, _normalize_inputs, _validate_all_str
+
+Array = jax.Array
+
+
+def _edit_distance_update(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    substitution_cost: int = 1,
+) -> Array:
+    """Per-pair distances (reference edit.py:24-48)."""
+    preds, target = _normalize_inputs(preds, target)
+    _validate_all_str("preds", preds)
+    _validate_all_str("target", target)
+    if len(preds) != len(target):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have same length, but got {len(preds)} and {len(target)}"
+        )
+    distances = [_edit_distance(list(p), list(t), substitution_cost) for p, t in zip(preds, target)]
+    return jnp.asarray(distances, jnp.int32)
+
+
+def _edit_distance_compute(
+    edit_scores: Array, num_elements: Union[Array, int], reduction: Optional[str] = "mean"
+) -> Array:
+    """mean/sum/none reduction (reference edit.py:51-69)."""
+    if edit_scores.size == 0:
+        return jnp.asarray(0, jnp.int32) if reduction != "none" else jnp.zeros((0,), jnp.int32)
+    if reduction == "mean":
+        return edit_scores.sum().astype(jnp.float32) / num_elements
+    if reduction == "sum":
+        return edit_scores.sum()
+    if reduction is None or reduction == "none":
+        return edit_scores
+    raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+
+
+def edit_distance(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    substitution_cost: int = 1,
+    reduction: Optional[str] = "mean",
+) -> Array:
+    """Character-level Levenshtein edit distance (reference edit.py:72-118).
+
+    Example:
+        >>> from tpumetrics.functional.text import edit_distance
+        >>> float(edit_distance(["rain"], ["shine"]))
+        3.0
+        >>> edit_distance(["rain", "lnaguaeg"], ["shine", "language"], reduction=None).tolist()
+        [3, 4]
+    """
+    distances = _edit_distance_update(preds, target, substitution_cost)
+    return _edit_distance_compute(distances, num_elements=distances.size, reduction=reduction)

@@ -1,0 +1,58 @@
+"""Shared text-metric helpers (counterpart of reference
+``functional/text/helper.py``).
+
+String processing is host-side Python by design (SURVEY §7 hard-part 8:
+strings cannot cross into XLA); only the resulting count statistics live on
+device as sum-reduce states.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Union
+
+import numpy as np
+
+
+def _edit_distance(
+    prediction_tokens: Sequence, reference_tokens: Sequence, substitution_cost: int = 1
+) -> int:
+    """Levenshtein distance between two token sequences (reference
+    helper.py:329-350), with the DP inner loop vectorized over numpy rows."""
+    m, n = len(prediction_tokens), len(reference_tokens)
+    if m == 0:
+        return n
+    if n == 0:
+        return m
+    ref = np.asarray([hash(t) for t in reference_tokens])
+    prev = np.arange(n + 1)
+    for i in range(1, m + 1):
+        cur = np.empty(n + 1, dtype=np.int64)
+        cur[0] = i
+        sub = prev[:-1] + (ref != hash(prediction_tokens[i - 1])) * substitution_cost
+        # deletions/substitutions are vectorized; insertions need the scan
+        np.minimum(sub, prev[1:] + 1, out=sub)
+        running = cur[0]
+        for j in range(1, n + 1):
+            running = min(sub[j - 1], running + 1)
+            cur[j] = running
+        prev = cur
+    return int(prev[-1])
+
+
+def _normalize_inputs(preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> tuple:
+    """Promote single strings to lists and validate pairing."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    preds, target = list(preds), list(target)
+    if len(preds) != len(target):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have same length, but got {len(preds)} and {len(target)}"
+        )
+    return preds, target
+
+
+def _validate_all_str(name: str, values: Sequence) -> None:
+    if not all(isinstance(x, str) for x in values):
+        raise ValueError(f"Expected all values in argument `{name}` to be string type, but got {values}")

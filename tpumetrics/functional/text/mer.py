@@ -1,0 +1,43 @@
+"""Match error rate (counterpart of reference ``functional/text/mer.py``)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.text.helper import _edit_distance, _normalize_inputs
+
+Array = jax.Array
+
+
+def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Word-level edit distance + max-length count (reference mer.py:22-51)."""
+    preds, target = _normalize_inputs(preds, target)
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += max(len(tgt_tokens), len(pred_tokens))
+    return jnp.asarray(errors, jnp.float32), jnp.asarray(total, jnp.float32)
+
+
+def _mer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def match_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Match error rate of transcriptions (reference mer.py:68-91).
+
+    Example:
+        >>> from tpumetrics.functional.text import match_error_rate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> round(float(match_error_rate(preds, target)), 4)
+        0.4444
+    """
+    errors, total = _mer_update(preds, target)
+    return _mer_compute(errors, total)

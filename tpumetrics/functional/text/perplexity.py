@@ -1,0 +1,75 @@
+"""Perplexity (counterpart of reference ``functional/text/perplexity.py``).
+
+Pure device math: one fused log-softmax + gather (the reference materializes
+``probs[:, target]``, an O(N²) (N, N) matrix, then takes its diagonal —
+reference perplexity.py:72; here it is a ``take_along_axis`` gather, O(N),
+and log-softmax is used directly for numerical stability).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _check_shape_and_type_consistency(preds: Array, target: Array) -> None:
+    """Shape/dtype validation (reference perplexity.py:22-49)."""
+    if preds.ndim != 3:
+        raise ValueError(
+            f"Input tensor `preds` is expected to have 3 dimensions, [batch_size, seq_len, vocab_size], but got {preds.ndim}."
+        )
+    if target.ndim != 2:
+        raise ValueError(
+            f"Input tensor `target` is expected to have 2 dimensions, [batch_size, seq_len], but got {target.ndim}."
+        )
+    if preds.shape[:2] != target.shape:
+        raise ValueError(
+            "Input tensors `preds` and `target` are expected to have equaling first two dimensions,"
+            f" [batch_size, seq_len], but got {preds.shape[:2]} and {target.shape}."
+        )
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise TypeError(f"Input tensor `preds` is expected to be of a type one of the floating types, got {preds.dtype}.")
+    if not jnp.issubdtype(target.dtype, jnp.integer):
+        raise TypeError(f"Input tensor `target` is expected to be of a type one of the integer types, got {target.dtype}.")
+
+
+def _perplexity_update(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Tuple[Array, Array]:
+    """Summed negative log probabilities + token count (reference perplexity.py:52-84)."""
+    _check_shape_and_type_consistency(preds, target)
+
+    log_probs = jax.nn.log_softmax(preds.reshape(-1, preds.shape[-1]).astype(jnp.float32), axis=-1)
+    target = target.reshape(-1)
+
+    if ignore_index is not None:
+        mask = target != ignore_index
+        target = jnp.where(mask, target, 0)
+    else:
+        mask = jnp.ones_like(target, dtype=bool)
+
+    token_log_probs = jnp.take_along_axis(log_probs, target[:, None], axis=1)[:, 0]
+    total_log_probs = -jnp.sum(jnp.where(mask, token_log_probs, 0.0))
+    count = mask.sum()
+    return total_log_probs, count.astype(jnp.float32)
+
+
+def _perplexity_compute(total: Array, count: Array) -> Array:
+    return jnp.exp(total / count)
+
+
+def perplexity(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Array:
+    """Perplexity of a language model's token scores (reference perplexity.py:87-148).
+
+    Example:
+        >>> import jax
+        >>> from tpumetrics.functional.text import perplexity
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(22), (2, 8, 5))
+        >>> target = jax.random.randint(jax.random.PRNGKey(89), (2, 8), 0, 5)
+        >>> 4.0 < float(perplexity(preds, target)) < 6.0
+        True
+    """
+    total, count = _perplexity_update(preds, target, ignore_index)
+    return _perplexity_compute(total, count)

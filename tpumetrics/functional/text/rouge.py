@@ -1,0 +1,324 @@
+"""ROUGE score (counterpart of reference ``functional/text/rouge.py``,
+following Lin (2004) and google-research/rouge)."""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.utils.imports import _NLTK_AVAILABLE
+
+Array = jax.Array
+
+ALLOWED_ROUGE_KEYS: Dict[str, Union[int, str]] = {
+    "rouge1": 1,
+    "rouge2": 2,
+    "rouge3": 3,
+    "rouge4": 4,
+    "rouge5": 5,
+    "rouge6": 6,
+    "rouge7": 7,
+    "rouge8": 8,
+    "rouge9": 9,
+    "rougeL": "L",
+    "rougeLsum": "Lsum",
+}
+ALLOWED_ACCUMULATE_VALUES = ("avg", "best")
+
+
+_PUNKT_STATE: dict = {}  # memoized availability: one lookup/download attempt per process
+
+
+def _ensure_nltk_punkt_is_downloaded() -> None:
+    """Make sure the sentence tokenizer data exists (reference rouge.py:42-59).
+    The outcome is memoized so a missing-punkt environment pays the lookup
+    (and possible network timeout) once, not per sentence."""
+    if "ok" in _PUNKT_STATE:
+        if not _PUNKT_STATE["ok"]:
+            raise OSError("`nltk` punkt data is required for `rougeLsum`, and it could not be downloaded.")
+        return
+    import nltk
+
+    try:
+        nltk.data.find("tokenizers/punkt_tab/english/")
+        _PUNKT_STATE["ok"] = True
+    except LookupError:
+        try:
+            nltk.data.find("tokenizers/punkt")
+            _PUNKT_STATE["ok"] = True
+        except LookupError as err:
+            try:
+                nltk.download("punkt_tab", quiet=True, force=False, halt_on_error=False, raise_on_error=True)
+                _PUNKT_STATE["ok"] = True
+            except ValueError:
+                _PUNKT_STATE["ok"] = False
+                raise OSError(
+                    "`nltk` punkt data is required for `rougeLsum`, and it could not be downloaded."
+                ) from err
+
+
+def _split_sentence(x: str) -> Sequence[str]:
+    """Sentence splitting for rougeLsum (reference rouge.py:62-71); falls
+    back to a regex splitter when the nltk punkt data cannot be obtained
+    (e.g. no network egress)."""
+    x = re.sub("<n>", "", x)  # remove pegasus newline char
+    if _NLTK_AVAILABLE:
+        try:
+            import nltk
+
+            _ensure_nltk_punkt_is_downloaded()
+            return nltk.sent_tokenize(x)
+        except (LookupError, OSError):
+            from tpumetrics.utils.prints import rank_zero_warn
+
+            rank_zero_warn(
+                "nltk punkt sentence tokenizer data is unavailable; falling back to a regex splitter"
+                " for rougeLsum sentence splitting."
+            )
+    return [s for s in re.split(r"(?<=[.!?])\s+", x.strip()) if s]
+
+
+def _compute_metrics(hits_or_lcs: int, pred_len: int, target_len: int) -> Dict[str, float]:
+    """precision/recall/F from a match count (reference rouge.py:74-93)."""
+    precision = hits_or_lcs / pred_len
+    recall = hits_or_lcs / target_len
+    if precision == recall == 0.0:
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
+    fmeasure = 2 * precision * recall / (precision + recall)
+    return {"precision": precision, "recall": recall, "fmeasure": fmeasure}
+
+
+def _lcs_table(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> np.ndarray:
+    """Full LCS DP table, numpy-vectorized over rows (reference rouge.py:95-116)."""
+    m, n = len(pred_tokens), len(target_tokens)
+    table = np.zeros((n + 1, m + 1), dtype=np.int64)
+    pred_arr = np.asarray([hash(t) for t in pred_tokens]) if m else np.zeros(0, np.int64)
+    for i in range(1, n + 1):
+        eq = pred_arr == hash(target_tokens[i - 1])
+        row = table[i]
+        prev = table[i - 1]
+        for j in range(1, m + 1):
+            row[j] = prev[j - 1] + 1 if eq[j - 1] else max(prev[j], row[j - 1])
+    return table
+
+
+def _lcs(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> int:
+    return int(_lcs_table(pred_tokens, target_tokens)[-1, -1])
+
+
+def _backtracked_lcs(
+    lcs_table: np.ndarray, pred_tokens: Sequence[str], target_tokens: Sequence[str]
+) -> Sequence[int]:
+    """Indices of target tokens on one LCS path (reference rouge.py:118-141)."""
+    i = len(pred_tokens)
+    j = len(target_tokens)
+    backtracked: List[int] = []
+    while i > 0 and j > 0:
+        if pred_tokens[i - 1] == target_tokens[j - 1]:
+            backtracked.insert(0, j - 1)
+            i -= 1
+            j -= 1
+        elif lcs_table[j][i - 1] > lcs_table[j - 1][i]:
+            i -= 1
+        else:
+            j -= 1
+    return backtracked
+
+
+def _union_lcs(pred_tokens_list: Sequence[Sequence[str]], target_tokens: Sequence[str]) -> Sequence[str]:
+    """Union of per-sentence LCS matches (reference rouge.py:144-163)."""
+    def lcs_ind(pred_tokens: Sequence[str]) -> Sequence[int]:
+        return _backtracked_lcs(_lcs_table(pred_tokens, target_tokens), pred_tokens, target_tokens)
+
+    indices = sorted(set().union(*(lcs_ind(pred) for pred in pred_tokens_list)))
+    return [target_tokens[i] for i in indices]
+
+
+def _normalize_and_tokenize_text(
+    text: str,
+    stemmer: Optional[Any] = None,
+    normalizer: Optional[Callable[[str], str]] = None,
+    tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+) -> Sequence[str]:
+    """rouge-score compatible normalization + tokenization + optional Porter
+    stemming (reference rouge.py:166-199)."""
+    text = normalizer(text) if callable(normalizer) else re.sub(r"[^a-z0-9]+", " ", text.lower())
+    tokens = tokenizer(text) if callable(tokenizer) else re.split(r"\s+", text)
+    if stemmer:
+        tokens = [stemmer.stem(x) if len(x) > 3 else x for x in tokens]
+    return [x for x in tokens if (isinstance(x, str) and len(x) > 0)]
+
+
+def _create_ngrams(tokens: Sequence[str], n: int) -> Counter:
+    ngrams: Counter = Counter()
+    for i in range(len(tokens) - n + 1):
+        ngrams[tuple(tokens[i : i + n])] += 1
+    return ngrams
+
+
+def _rouge_n_score(pred: Sequence[str], target: Sequence[str], n_gram: int) -> Dict[str, float]:
+    """ROUGE-N (reference rouge.py:202-225)."""
+    pred_ngrams, target_ngrams = _create_ngrams(pred, n_gram), _create_ngrams(target, n_gram)
+    pred_len, target_len = sum(pred_ngrams.values()), sum(target_ngrams.values())
+    if 0 in (pred_len, target_len):
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
+    hits = sum((pred_ngrams & target_ngrams).values())
+    return _compute_metrics(hits, max(pred_len, 1), max(target_len, 1))
+
+
+def _rouge_l_score(pred: Sequence[str], target: Sequence[str]) -> Dict[str, float]:
+    """ROUGE-L (reference rouge.py:228-241)."""
+    pred_len, target_len = len(pred), len(target)
+    if 0 in (pred_len, target_len):
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
+    return _compute_metrics(_lcs(pred, target), pred_len, target_len)
+
+
+def _rouge_lsum_score(pred: Sequence[Sequence[str]], target: Sequence[Sequence[str]]) -> Dict[str, float]:
+    """ROUGE-Lsum over sentence-split summaries (reference rouge.py:244-284)."""
+    pred_len = sum(map(len, pred))
+    target_len = sum(map(len, target))
+    if 0 in (pred_len, target_len):
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
+
+    def _get_token_counts(sentences: Sequence[Sequence[str]]) -> Counter:
+        ngrams: Counter = Counter()
+        for sentence in sentences:
+            ngrams.update(sentence)
+        return ngrams
+
+    pred_tokens_count = _get_token_counts(pred)
+    target_tokens_count = _get_token_counts(target)
+
+    hits = 0
+    for tgt in target:
+        lcs = _union_lcs(pred, tgt)
+        for token in lcs:
+            if pred_tokens_count[token] > 0 and target_tokens_count[token] > 0:
+                hits += 1
+                pred_tokens_count[token] -= 1
+                target_tokens_count[token] -= 1
+    return _compute_metrics(hits, pred_len, target_len)
+
+
+def _rouge_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    rouge_keys_values: List[Union[int, str]],
+    accumulate: str,
+    stemmer: Optional[Any] = None,
+    normalizer: Optional[Callable[[str], str]] = None,
+    tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+) -> Dict[Union[int, str], List[Dict[str, float]]]:
+    """Per-pair rouge results with best/avg multi-reference accumulation
+    (reference rouge.py:287-402)."""
+    results: Dict[Union[int, str], List[Dict[str, float]]] = {key: [] for key in rouge_keys_values}
+
+    for pred_raw, target_raw in zip(preds, target):
+        pred = _normalize_and_tokenize_text(pred_raw, stemmer, normalizer, tokenizer)
+        if "Lsum" in rouge_keys_values:
+            pred_lsum = [
+                _normalize_and_tokenize_text(s, stemmer, normalizer, tokenizer)
+                for s in _split_sentence(pred_raw)
+            ]
+
+        list_results = []
+        for target_raw_inner in target_raw:
+            tgt = _normalize_and_tokenize_text(target_raw_inner, stemmer, normalizer, tokenizer)
+            if "Lsum" in rouge_keys_values:
+                target_lsum = [
+                    _normalize_and_tokenize_text(s, stemmer, normalizer, tokenizer)
+                    for s in _split_sentence(target_raw_inner)
+                ]
+            result_inner: Dict[Union[int, str], Dict[str, float]] = {}
+            for rouge_key in rouge_keys_values:
+                if isinstance(rouge_key, int):
+                    result_inner[rouge_key] = _rouge_n_score(pred, tgt, rouge_key)
+                elif rouge_key == "L":
+                    result_inner[rouge_key] = _rouge_l_score(pred, tgt)
+                else:  # Lsum
+                    result_inner[rouge_key] = _rouge_lsum_score(pred_lsum, target_lsum)
+            list_results.append(result_inner)
+
+        if accumulate == "best":
+            key_curr = rouge_keys_values[0]
+            highest_idx = int(np.argmax([v[key_curr]["fmeasure"] for v in list_results]))
+            for rouge_key in rouge_keys_values:
+                results[rouge_key].append(list_results[highest_idx][rouge_key])
+        else:  # avg
+            for rouge_key in rouge_keys_values:
+                avg = {
+                    t: float(np.mean([res[rouge_key][t] for res in list_results]))
+                    for t in ("precision", "recall", "fmeasure")
+                }
+                results[rouge_key].append(avg)
+    return results
+
+
+def _rouge_score_compute(sentence_results: Dict[str, List[Array]]) -> Dict[str, Array]:
+    """Mean over accumulated sentence scores (reference rouge.py:405-420)."""
+    return {k: jnp.mean(jnp.stack(v)) if v else jnp.zeros(()) for k, v in sentence_results.items()}
+
+
+def rouge_score(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str], Sequence[Sequence[str]]],
+    accumulate: str = "best",
+    use_stemmer: bool = False,
+    normalizer: Optional[Callable[[str], str]] = None,
+    tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+    rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+) -> Dict[str, Array]:
+    """ROUGE-N / ROUGE-L / ROUGE-Lsum (reference rouge.py:423-524).
+
+    Example:
+        >>> from tpumetrics.functional.text import rouge_score
+        >>> preds = "My name is John"
+        >>> target = "Is your name John"
+        >>> result = rouge_score(preds, target, rouge_keys="rouge1")
+        >>> round(float(result["rouge1_fmeasure"]), 4)
+        0.75
+    """
+    if use_stemmer and not _NLTK_AVAILABLE:
+        raise ModuleNotFoundError("Stemmer requires that `nltk` is installed.")
+    stemmer = None
+    if use_stemmer:
+        import nltk
+
+        stemmer = nltk.stem.porter.PorterStemmer()
+    if accumulate not in ALLOWED_ACCUMULATE_VALUES:
+        raise ValueError(
+            f"Got unknown accumulate value {accumulate}. Expected to be one of {ALLOWED_ACCUMULATE_VALUES}"
+        )
+
+    if not isinstance(rouge_keys, tuple):
+        rouge_keys = (rouge_keys,)
+    for key in rouge_keys:
+        if key not in ALLOWED_ROUGE_KEYS:
+            raise ValueError(f"Got unknown rouge key {key}. Expected to be one of {list(ALLOWED_ROUGE_KEYS)}")
+    rouge_keys_values = [ALLOWED_ROUGE_KEYS[key] for key in rouge_keys]
+
+    if isinstance(target, list) and all(isinstance(tgt, str) for tgt in target):
+        target = [target] if isinstance(preds, str) else [[tgt] for tgt in target]
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [[target]]
+
+    sentence_results = _rouge_score_update(
+        preds, target, rouge_keys_values, accumulate, stemmer, normalizer, tokenizer
+    )
+
+    output: Dict[str, Array] = {}
+    for rouge_key, results in sentence_results.items():
+        suffix = rouge_key if isinstance(rouge_key, str) else str(rouge_key)
+        prefix = f"rouge{suffix}"
+        for t in ("precision", "recall", "fmeasure"):
+            vals = [r[t] for r in results]
+            output[f"{prefix}_{t}"] = jnp.asarray(np.mean(vals) if vals else 0.0, jnp.float32)
+    return output

@@ -1,0 +1,43 @@
+"""Word error rate (counterpart of reference ``functional/text/wer.py``)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.text.helper import _edit_distance, _normalize_inputs
+
+Array = jax.Array
+
+
+def _wer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Word-level edit distance + reference word count (reference wer.py:22-49)."""
+    preds, target = _normalize_inputs(preds, target)
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += len(tgt_tokens)
+    return jnp.asarray(errors, jnp.float32), jnp.asarray(total, jnp.float32)
+
+
+def _wer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def word_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word error rate of transcriptions (reference wer.py:65-87).
+
+    Example:
+        >>> from tpumetrics.functional.text import word_error_rate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> round(float(word_error_rate(preds, target)), 4)
+        0.5
+    """
+    errors, total = _wer_update(preds, target)
+    return _wer_compute(errors, total)

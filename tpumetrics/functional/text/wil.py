@@ -1,0 +1,55 @@
+"""Word information lost (counterpart of reference ``functional/text/wil.py``)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.text.helper import _edit_distance, _normalize_inputs
+
+Array = jax.Array
+
+
+def _word_info_lost_update(
+    preds: Union[str, List[str]], target: Union[str, List[str]]
+) -> Tuple[Array, Array, Array]:
+    """(edit distance - max length) sum + word totals (reference wil.py:23-54);
+    the difference is minus the number of word hits."""
+    preds, target = _normalize_inputs(preds, target)
+    errors = 0
+    total = 0
+    target_total = 0
+    preds_total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        target_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, target_tokens)
+        target_total += len(target_tokens)
+        preds_total += len(pred_tokens)
+        total += max(len(target_tokens), len(pred_tokens))
+    return (
+        jnp.asarray(errors - total, jnp.float32),
+        jnp.asarray(target_total, jnp.float32),
+        jnp.asarray(preds_total, jnp.float32),
+    )
+
+
+def _word_info_lost_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    """WIL = 1 - (H/N_target)(H/N_preds) (reference wil.py:57-69)."""
+    return 1 - ((errors / target_total) * (errors / preds_total))
+
+
+def word_information_lost(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word Information Lost of transcriptions (reference wil.py:72-94).
+
+    Example:
+        >>> from tpumetrics.functional.text import word_information_lost
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> round(float(word_information_lost(preds, target)), 4)
+        0.6528
+    """
+    errors, target_total, preds_total = _word_info_lost_update(preds, target)
+    return _word_info_lost_compute(errors, target_total, preds_total)

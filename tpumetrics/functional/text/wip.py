@@ -1,0 +1,54 @@
+"""Word information preserved (counterpart of reference ``functional/text/wip.py``)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.text.helper import _edit_distance, _normalize_inputs
+
+Array = jax.Array
+
+
+def _wip_update(
+    preds: Union[str, List[str]], target: Union[str, List[str]]
+) -> Tuple[Array, Array, Array]:
+    """(edit distance - max length) sum + word totals (reference wip.py:22-53)."""
+    preds, target = _normalize_inputs(preds, target)
+    errors = 0
+    total = 0
+    target_total = 0
+    preds_total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        target_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, target_tokens)
+        target_total += len(target_tokens)
+        preds_total += len(pred_tokens)
+        total += max(len(target_tokens), len(pred_tokens))
+    return (
+        jnp.asarray(errors - total, jnp.float32),
+        jnp.asarray(target_total, jnp.float32),
+        jnp.asarray(preds_total, jnp.float32),
+    )
+
+
+def _wip_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    """WIP = (H/N_target)(H/N_preds) (reference wip.py:56-68)."""
+    return (errors / target_total) * (errors / preds_total)
+
+
+def word_information_preserved(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word Information Preserved of transcriptions (reference wip.py:71-93).
+
+    Example:
+        >>> from tpumetrics.functional.text import word_information_preserved
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> round(float(word_information_preserved(preds, target)), 4)
+        0.3472
+    """
+    errors, total, preds_total = _wip_update(preds, target)
+    return _wip_compute(errors, total, preds_total)

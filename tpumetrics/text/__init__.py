@@ -1,0 +1,33 @@
+"""Text metric domain (counterpart of reference ``text/__init__.py``)."""
+
+from tpumetrics.text.bleu import BLEUScore
+from tpumetrics.text.cer import CharErrorRate
+from tpumetrics.text.chrf import CHRFScore
+from tpumetrics.text.edit import EditDistance
+from tpumetrics.text.eed import ExtendedEditDistance
+from tpumetrics.text.mer import MatchErrorRate
+from tpumetrics.text.perplexity import Perplexity
+from tpumetrics.text.rouge import ROUGEScore
+from tpumetrics.text.sacre_bleu import SacreBLEUScore
+from tpumetrics.text.squad import SQuAD
+from tpumetrics.text.ter import TranslationEditRate
+from tpumetrics.text.wer import WordErrorRate
+from tpumetrics.text.wil import WordInfoLost
+from tpumetrics.text.wip import WordInfoPreserved
+
+__all__ = [
+    "BLEUScore",
+    "CHRFScore",
+    "CharErrorRate",
+    "EditDistance",
+    "ExtendedEditDistance",
+    "MatchErrorRate",
+    "Perplexity",
+    "ROUGEScore",
+    "SQuAD",
+    "SacreBLEUScore",
+    "TranslationEditRate",
+    "WordErrorRate",
+    "WordInfoLost",
+    "WordInfoPreserved",
+]

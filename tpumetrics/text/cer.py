@@ -1,0 +1,45 @@
+"""CharErrorRate (counterpart of reference ``text/cer.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.text.cer import _cer_compute, _cer_update
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class CharErrorRate(Metric):
+    """Character error rate accumulated over batches.
+
+    Example:
+        >>> from tpumetrics.text import CharErrorRate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> cer = CharErrorRate()
+        >>> round(float(cer(preds, target)), 4)
+        0.3415
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        """Accumulate char edit distances and reference char counts."""
+        errors, total = _cer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _cer_compute(self.errors, self.total)

@@ -1,0 +1,90 @@
+"""CHRFScore (counterpart of reference ``text/chrf.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.functional.text.chrf import _chrf_score_compute, _chrf_score_update
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class CHRFScore(Metric):
+    """chrF/chrF++ accumulated over batches. Where the reference keeps six
+    dicts of scalar states (reference text/chrf.py class), the totals here
+    are a single (6, max_order) sum state — one psum on sync.
+
+    Example:
+        >>> from tpumetrics.text import CHRFScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat']]
+        >>> chrf = CHRFScore()
+        >>> round(float(chrf(preds, target)), 4)
+        0.4942
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        n_char_order: int = 6,
+        n_word_order: int = 2,
+        beta: float = 2.0,
+        lowercase: bool = False,
+        whitespace: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(n_char_order, int) or n_char_order < 1:
+            raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
+        if not isinstance(n_word_order, int) or n_word_order < 0:
+            raise ValueError("Expected argument `n_word_order` to be an integer greater than or equal to 0.")
+        if beta < 0:
+            raise ValueError("Expected argument `beta` to be greater than 0.")
+        self.n_char_order = n_char_order
+        self.n_word_order = n_word_order
+        self.beta = beta
+        self.lowercase = lowercase
+        self.whitespace = whitespace
+        self.return_sentence_level_score = return_sentence_level_score
+
+        max_order = max(n_char_order, n_word_order, 1)
+        self.add_state("totals", default=jnp.zeros((6, max_order)), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_chrf_score", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[Sequence[str], Sequence[Sequence[str]]]) -> None:
+        """Accumulate corpus n-gram totals."""
+        sentence_scores: Optional[List[float]] = [] if self.return_sentence_level_score else None
+        totals = np.asarray(self.totals, np.float64).copy()
+        totals = _chrf_score_update(
+            preds,
+            target,
+            totals,
+            self.n_char_order,
+            self.n_word_order,
+            self.beta,
+            self.lowercase,
+            self.whitespace,
+            sentence_scores,
+        )
+        self.totals = jnp.asarray(totals, jnp.float32)
+        if sentence_scores is not None:
+            self.sentence_chrf_score.append(jnp.asarray(sentence_scores, jnp.float32))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        score = _chrf_score_compute(self.totals, self.n_char_order, self.n_word_order, self.beta)
+        if self.return_sentence_level_score:
+            return score, dim_zero_cat(self.sentence_chrf_score)
+        return score

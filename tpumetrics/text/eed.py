@@ -1,0 +1,77 @@
+"""ExtendedEditDistance (counterpart of reference ``text/eed.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.text.eed import _eed_compute, _eed_update
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class ExtendedEditDistance(Metric):
+    """EED accumulated over batches (sentence scores as a cat state).
+
+    Example:
+        >>> from tpumetrics.text import ExtendedEditDistance
+        >>> preds = ["this is the prediction", "here is an other sample"]
+        >>> target = ["this is the reference", "here is another one"]
+        >>> eed = ExtendedEditDistance()
+        >>> round(float(eed(preds, target)), 4)
+        0.3078
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        language: str = "en",
+        return_sentence_level_score: bool = False,
+        alpha: float = 2.0,
+        rho: float = 0.3,
+        deletion: float = 0.2,
+        insertion: float = 1.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if language not in ("en", "ja"):
+            raise ValueError(f"Expected argument `language` to either be `en` or `ja` but got {language}")
+        self.language = language
+        self.return_sentence_level_score = return_sentence_level_score
+        for param_name, param in (("alpha", alpha), ("rho", rho), ("deletion", deletion), ("insertion", insertion)):
+            if not isinstance(param, float) or param < 0:
+                raise ValueError(f"Parameter `{param_name}` is expected to be a non-negative float.")
+        self.alpha = alpha
+        self.rho = rho
+        self.deletion = deletion
+        self.insertion = insertion
+
+        self.add_state("sentence_eed", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]) -> None:
+        """Accumulate sentence scores."""
+        scores = _eed_update(
+            preds, target, self.language, self.alpha, self.rho, self.deletion, self.insertion
+        )
+        if scores:
+            self.sentence_eed.append(jnp.asarray(scores, jnp.float32))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        if not self.sentence_eed:
+            average = jnp.zeros(())
+            sentence_scores = jnp.zeros((0,), jnp.float32)
+        else:
+            sentence_scores = dim_zero_cat(self.sentence_eed)
+            average = sentence_scores.mean()
+        if self.return_sentence_level_score:
+            return average, sentence_scores
+        return average

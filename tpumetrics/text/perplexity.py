@@ -1,0 +1,50 @@
+"""Perplexity (counterpart of reference ``text/perplexity.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.text.perplexity import _perplexity_compute, _perplexity_update
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class Perplexity(Metric):
+    """Perplexity accumulated over batches — pure device math, fully
+    jit/shard_map safe through the functional bridge.
+
+    Example:
+        >>> import jax
+        >>> from tpumetrics.text import Perplexity
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(22), (2, 8, 5))
+        >>> target = jax.random.randint(jax.random.PRNGKey(89), (2, 8), 0, 5)
+        >>> perp = Perplexity()
+        >>> 4.0 < float(perp(preds, target)) < 6.0
+        True
+    """
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, ignore_index: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError(f"Argument `ignore_index` expected to either be `None` or an `int` but got {ignore_index}")
+        self.ignore_index = ignore_index
+        self.add_state("total_log_probs", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("count", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate negative log probabilities."""
+        total_log_probs, count = _perplexity_update(preds, target, self.ignore_index)
+        self.total_log_probs = self.total_log_probs + total_log_probs
+        self.count = self.count + count
+
+    def compute(self) -> Array:
+        return _perplexity_compute(self.total_log_probs, self.count)

@@ -1,0 +1,128 @@
+"""ROUGEScore (counterpart of reference ``text/rouge.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.functional.text.rouge import (
+    ALLOWED_ACCUMULATE_VALUES,
+    ALLOWED_ROUGE_KEYS,
+    _rouge_score_update,
+)
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+from tpumetrics.utils.imports import _NLTK_AVAILABLE
+
+Array = jax.Array
+
+
+class ROUGEScore(Metric):
+    """ROUGE-N/L/Lsum accumulated over batches: per-key per-sentence score
+    lists as cat states (reference text/rouge.py:143).
+
+    Example:
+        >>> from tpumetrics.text import ROUGEScore
+        >>> rouge = ROUGEScore(rouge_keys="rouge1")
+        >>> result = rouge(["My name is John"], ["Is your name John"])
+        >>> round(float(result["rouge1_fmeasure"]), 4)
+        0.75
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        use_stemmer: bool = False,
+        normalizer: Optional[Callable[[str], str]] = None,
+        tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+        accumulate: str = "best",
+        rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if use_stemmer and not _NLTK_AVAILABLE:
+            raise ModuleNotFoundError("Stemmer requires that `nltk` is installed.")
+        if not isinstance(rouge_keys, tuple):
+            rouge_keys = (rouge_keys,)
+        for key in rouge_keys:
+            if key not in ALLOWED_ROUGE_KEYS:
+                raise ValueError(f"Got unknown rouge key {key}. Expected to be one of {list(ALLOWED_ROUGE_KEYS)}")
+        if accumulate not in ALLOWED_ACCUMULATE_VALUES:
+            raise ValueError(
+                f"Got unknown accumulate value {accumulate}. Expected to be one of {ALLOWED_ACCUMULATE_VALUES}"
+            )
+
+        self.rouge_keys = rouge_keys
+        self.rouge_keys_values = [ALLOWED_ROUGE_KEYS[key] for key in rouge_keys]
+        self.use_stemmer = use_stemmer
+        self.normalizer = normalizer
+        self.tokenizer = tokenizer
+        self.accumulate = accumulate
+
+        if use_stemmer:
+            import nltk
+
+            self.stemmer = nltk.stem.porter.PorterStemmer()
+        else:
+            self.stemmer = None
+
+        for rouge_key in self.rouge_keys:
+            for score_type in ("fmeasure", "precision", "recall"):
+                self.add_state(f"{rouge_key}_{score_type}", default=[], dist_reduce_fx="cat")
+
+    def update(
+        self,
+        preds: Union[str, Sequence[str]],
+        target: Union[str, Sequence[str], Sequence[Sequence[str]]],
+    ) -> None:
+        """Accumulate per-sentence rouge scores."""
+        if isinstance(target, list) and all(isinstance(tgt, str) for tgt in target):
+            target = [target] if isinstance(preds, str) else [[tgt] for tgt in target]
+        if isinstance(preds, str):
+            preds = [preds]
+        if isinstance(target, str):
+            target = [[target]]
+
+        output = _rouge_score_update(
+            preds,
+            target,
+            self.rouge_keys_values,
+            accumulate=self.accumulate,
+            stemmer=self.stemmer,
+            normalizer=self.normalizer,
+            tokenizer=self.tokenizer,
+        )
+        for rouge_key, metrics in output.items():
+            suffix = rouge_key if isinstance(rouge_key, str) else str(rouge_key)
+            for metric in metrics:
+                for score_type, score in metric.items():
+                    getattr(self, f"rouge{suffix}_{score_type}").append(
+                        jnp.asarray([score], jnp.float32)
+                    )
+
+    def compute(self) -> Dict[str, Array]:
+        """Mean per rouge key/score type (reference text/rouge.py compute)."""
+        update_output = {}
+        for rouge_key in self.rouge_keys:
+            for score_type in ("fmeasure", "precision", "recall"):
+                vals = getattr(self, f"{rouge_key}_{score_type}")
+                update_output[f"{rouge_key}_{score_type}"] = (
+                    jnp.mean(dim_zero_cat(vals)) if vals else jnp.zeros(())
+                )
+        return update_output
+
+    def __hash__(self) -> int:
+        # cat list states of variable length: hash over names + lengths
+        hash_vals = [self.__class__.__name__]
+        for key in self._defaults:
+            val = getattr(self, key)
+            hash_vals.append(len(val) if isinstance(val, list) else val)
+        return hash(tuple(hash_vals))

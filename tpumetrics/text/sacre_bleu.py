@@ -1,0 +1,41 @@
+"""SacreBLEUScore (counterpart of reference ``text/sacre_bleu.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+from tpumetrics.functional.text.sacre_bleu import _SacreBLEUTokenizer
+from tpumetrics.text.bleu import BLEUScore
+
+
+class SacreBLEUScore(BLEUScore):
+    """BLEU with sacrebleu-compatible tokenization (reference sacre_bleu.py
+    class). Shares all count states with :class:`BLEUScore`.
+
+    Args:
+        n_gram: maximum n-gram order.
+        smooth: apply add-one smoothing.
+        tokenize: one of ``none``/``13a``/``zh``/``intl``/``char``.
+        lowercase: case-insensitive scoring.
+        weights: per-order weights (default uniform).
+
+    Example:
+        >>> from tpumetrics.text import SacreBLEUScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> sacre_bleu = SacreBLEUScore()
+        >>> round(float(sacre_bleu(preds, target)), 4)
+        0.7598
+    """
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        tokenize: str = "13a",
+        lowercase: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(n_gram=n_gram, smooth=smooth, weights=weights, **kwargs)
+        self.tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)

@@ -1,0 +1,73 @@
+"""TranslationEditRate (counterpart of reference ``text/ter.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.text.ter import _TercomTokenizer, _ter_compute, _ter_update
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class TranslationEditRate(Metric):
+    """TER accumulated over batches.
+
+    Example:
+        >>> from tpumetrics.text import TranslationEditRate
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> ter = TranslationEditRate()
+        >>> round(float(ter(preds, target)), 4)
+        0.1538
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(normalize, bool):
+            raise ValueError(f"Expected argument `normalize` to be of type boolean but got {normalize}.")
+        if not isinstance(no_punctuation, bool):
+            raise ValueError(f"Expected argument `no_punctuation` to be of type boolean but got {no_punctuation}.")
+        if not isinstance(lowercase, bool):
+            raise ValueError(f"Expected argument `lowercase` to be of type boolean but got {lowercase}.")
+        if not isinstance(asian_support, bool):
+            raise ValueError(f"Expected argument `asian_support` to be of type boolean but got {asian_support}.")
+        self.tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+        self.return_sentence_level_score = return_sentence_level_score
+
+        self.add_state("total_num_edits", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total_tgt_length", default=jnp.zeros(()), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_ter", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[Sequence[str], Sequence[Sequence[str]]]) -> None:
+        """Accumulate edit counts and reference lengths."""
+        sentence_scores: Optional[List[float]] = [] if self.return_sentence_level_score else None
+        num_edits, tgt_length = _ter_update(preds, target, self.tokenizer, 0.0, 0.0, sentence_scores)
+        self.total_num_edits = self.total_num_edits + num_edits
+        self.total_tgt_length = self.total_tgt_length + tgt_length
+        if sentence_scores is not None:
+            self.sentence_ter.append(jnp.asarray(sentence_scores, jnp.float32))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        score = _ter_compute(self.total_num_edits, self.total_tgt_length)
+        if self.return_sentence_level_score:
+            return score, dim_zero_cat(self.sentence_ter)
+        return score

@@ -1,0 +1,45 @@
+"""WordErrorRate (counterpart of reference ``text/wer.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.text.wer import _wer_compute, _wer_update
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class WordErrorRate(Metric):
+    """Word error rate accumulated over batches.
+
+    Example:
+        >>> from tpumetrics.text import WordErrorRate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> wer = WordErrorRate()
+        >>> round(float(wer(preds, target)), 4)
+        0.5
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        """Accumulate edit distances and reference word counts."""
+        errors, total = _wer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _wer_compute(self.errors, self.total)
