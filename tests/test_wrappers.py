@@ -1,0 +1,245 @@
+"""Wrapper metrics (counterpart of reference ``tests/unittests/wrappers/``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score, r2_score as sk_r2
+
+from tpumetrics import MetricCollection
+from tpumetrics.classification import BinaryAccuracy, MulticlassAccuracy, MulticlassRecall
+from tpumetrics.regression import MeanAbsoluteError, MeanSquaredError, R2Score
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+from tpumetrics.wrappers import (
+    BootStrapper,
+    ClasswiseWrapper,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    MultitaskWrapper,
+)
+
+_rng = np.random.default_rng(17)
+
+
+# ------------------------------------------------------------ BootStrapper
+
+
+def test_bootstrapper_statistics_converge():
+    """Bootstrap mean approaches the plain metric; std is small for large n."""
+    n = 2000
+    preds = jnp.asarray(_rng.integers(0, 5, n))
+    target = jnp.asarray(np.where(_rng.random(n) < 0.7, np.asarray(preds), _rng.integers(0, 5, n)))
+    boot = BootStrapper(MulticlassAccuracy(num_classes=5), num_bootstraps=20, quantile=0.5, raw=True, seed=0)
+    boot.update(preds, target)
+    out = boot.compute()
+    plain = accuracy_score(np.asarray(target), np.asarray(preds))
+    assert abs(float(out["mean"]) - plain) < 0.03
+    assert float(out["std"]) < 0.05
+    assert out["raw"].shape == (20,)
+    assert abs(float(out["quantile"]) - plain) < 0.03
+
+
+def test_bootstrapper_multinomial_and_reset():
+    boot = BootStrapper(BinaryAccuracy(), num_bootstraps=5, sampling_strategy="multinomial", seed=1)
+    boot.update(jnp.asarray([1, 0, 1, 1]), jnp.asarray([1, 0, 1, 0]))
+    out1 = boot.compute()
+    boot.reset()
+    boot.update(jnp.asarray([1, 1, 1, 1]), jnp.asarray([1, 1, 1, 1]))
+    out2 = boot.compute()
+    assert float(out2["mean"]) == 1.0
+    assert set(out1.keys()) == {"mean", "std"}
+    with pytest.raises(ValueError, match="sampling_strategy"):
+        BootStrapper(BinaryAccuracy(), sampling_strategy="bad")
+    with pytest.raises(ValueError, match="base metric"):
+        BootStrapper(lambda x: x)
+
+
+# -------------------------------------------------------- ClasswiseWrapper
+
+
+def test_classwise_wrapper():
+    preds = jnp.asarray([0, 1, 2, 1, 0, 2])
+    target = jnp.asarray([0, 1, 1, 1, 0, 0])
+    metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None))
+    out = metric(preds, target)
+    assert set(out.keys()) == {"multiclassaccuracy_0", "multiclassaccuracy_1", "multiclassaccuracy_2"}
+
+    labeled = ClasswiseWrapper(
+        MulticlassAccuracy(num_classes=3, average=None), labels=["horse", "fish", "dog"], prefix="acc-"
+    )
+    labeled.update(preds, target)
+    out = labeled.compute()
+    assert set(out.keys()) == {"acc-horse", "acc-fish", "acc-dog"}
+    per_class = np.asarray(MulticlassAccuracy(num_classes=3, average=None)(preds, target))
+    assert np.isclose(float(out["acc-horse"]), per_class[0])
+
+    postfixed = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None), postfix="-acc")
+    postfixed.update(preds, target)
+    assert set(postfixed.compute().keys()) == {"0-acc", "1-acc", "2-acc"}
+
+
+def test_classwise_in_collection():
+    preds = jnp.asarray([0, 1, 2, 1, 0, 2])
+    target = jnp.asarray([0, 1, 1, 1, 0, 0])
+    collection = MetricCollection(
+        {
+            "accuracy": ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None), ["a", "b", "c"]),
+            "recall": ClasswiseWrapper(MulticlassRecall(num_classes=3, average=None), ["a", "b", "c"]),
+        }
+    )
+    collection.update(preds, target)
+    out = collection.compute()
+    assert "multiclassaccuracy_a" in out and "multiclassrecall_c" in out
+
+
+# ------------------------------------------------------------ MinMaxMetric
+
+
+def test_minmax_metric():
+    metric = MinMaxMetric(BinaryAccuracy())
+    metric.update(jnp.asarray([1, 0, 1, 1]), jnp.asarray([1, 0, 1, 1]))
+    out = metric.compute()
+    assert float(out["raw"]) == 1.0 and float(out["max"]) == 1.0 and float(out["min"]) == 1.0
+    metric.update(jnp.asarray([0, 0, 0, 0]), jnp.asarray([1, 1, 1, 1]))
+    out = metric.compute()
+    assert float(out["min"]) == 0.5 and float(out["max"]) == 1.0
+    metric.reset()
+    metric.update(jnp.asarray([1, 0]), jnp.asarray([1, 1]))
+    out = metric.compute()
+    assert float(out["min"]) == 0.5 and float(out["max"]) == 0.5
+
+
+# ------------------------------------------------------ MultioutputWrapper
+
+
+def test_multioutput_wrapper_r2():
+    target = jnp.asarray([[0.5, 1.0], [-1.0, 1.0], [7.0, -6.0]])
+    preds = jnp.asarray([[0.25, 0.5], [-1.0, 1.0], [8.0, -5.0]])
+    r2 = MultioutputWrapper(R2Score(), num_outputs=2)
+    r2.update(preds, target)
+    got = np.asarray(r2.compute())
+    ref = sk_r2(np.asarray(target), np.asarray(preds), multioutput="raw_values")
+    assert np.allclose(got, ref, atol=1e-4)
+
+
+def test_multioutput_wrapper_remove_nans():
+    target = jnp.asarray([[0.5, jnp.nan], [-1.0, 1.0], [7.0, -6.0]])
+    preds = jnp.asarray([[0.25, 0.5], [-1.0, 1.0], [8.0, -5.0]])
+    mse = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+    mse.update(preds, target)
+    got = np.asarray(mse.compute())
+    # column 1 drops its NaN row
+    ref0 = np.mean((np.asarray(preds)[:, 0] - np.asarray(target)[:, 0]) ** 2)
+    ref1 = np.mean((np.asarray(preds)[1:, 1] - np.asarray(target)[1:, 1]) ** 2)
+    assert np.allclose(got, [ref0, ref1], atol=1e-5)
+
+
+# -------------------------------------------------------- MultitaskWrapper
+
+
+def test_multitask_wrapper():
+    metrics = MultitaskWrapper({"cls": BinaryAccuracy(), "reg": MeanSquaredError()})
+    preds = {"cls": jnp.asarray([0, 1, 1]), "reg": jnp.asarray([2.0, 3.0, 4.0])}
+    target = {"cls": jnp.asarray([0, 1, 0]), "reg": jnp.asarray([1.0, 3.0, 4.0])}
+    metrics.update(preds, target)
+    out = metrics.compute()
+    assert np.isclose(float(out["cls"]), 2 / 3, atol=1e-5)
+    assert np.isclose(float(out["reg"]), 1 / 3, atol=1e-5)
+
+    fwd = metrics(preds, target)
+    assert set(fwd.keys()) == {"cls", "reg"}
+    metrics.reset()
+
+    with pytest.raises(ValueError, match="same keys"):
+        metrics.update({"cls": preds["cls"]}, target)
+    with pytest.raises(TypeError, match="to be a dict"):
+        MultitaskWrapper([BinaryAccuracy()])
+    with pytest.raises(TypeError, match="Metric or a MetricCollection"):
+        MultitaskWrapper({"a": lambda x: x})
+
+
+def test_multitask_with_collections():
+    metrics = MultitaskWrapper(
+        {
+            "cls": MetricCollection([BinaryAccuracy()]),
+            "reg": MetricCollection([MeanSquaredError(), MeanAbsoluteError()]),
+        }
+    )
+    preds = {"cls": jnp.asarray([0, 1, 1]), "reg": jnp.asarray([2.0, 3.0, 4.0])}
+    target = {"cls": jnp.asarray([0, 1, 0]), "reg": jnp.asarray([1.0, 3.0, 4.0])}
+    metrics.update(preds, target)
+    out = metrics.compute()
+    assert "MeanSquaredError" in out["reg"] and "MeanAbsoluteError" in out["reg"]
+
+
+# ------------------------------------------------------------ MetricTracker
+
+
+def test_tracker_single_metric():
+    tracker = MetricTracker(MulticlassAccuracy(num_classes=10))
+    values = []
+    for step in range(5):
+        tracker.increment()
+        preds = jnp.asarray(_rng.integers(0, 10, 100))
+        target = jnp.asarray(_rng.integers(0, 10, 100))
+        tracker.update(preds, target)
+        values.append(float(tracker.compute()))
+    assert tracker.n_steps == 5
+    all_vals = np.asarray(tracker.compute_all())
+    assert np.allclose(all_vals, values, atol=1e-6)
+    best, step = tracker.best_metric(return_step=True)
+    assert np.isclose(best, max(values), atol=1e-6)
+    assert step == int(np.argmax(values))
+
+
+def test_tracker_collection_and_minimize():
+    tracker = MetricTracker(
+        MetricCollection([MeanSquaredError(), MeanAbsoluteError()]), maximize=[False, False]
+    )
+    for _ in range(3):
+        tracker.increment()
+        tracker.update(jnp.asarray(_rng.random(50)), jnp.asarray(_rng.random(50)))
+    res = tracker.compute_all()
+    assert res["MeanSquaredError"].shape == (3,)
+    best, steps = tracker.best_metric(return_step=True)
+    assert set(best.keys()) == {"MeanSquaredError", "MeanAbsoluteError"}
+    assert np.isclose(
+        best["MeanSquaredError"], float(res["MeanSquaredError"].min()), atol=1e-6
+    )
+
+
+def test_tracker_guards():
+    tracker = MetricTracker(BinaryAccuracy())
+    with pytest.raises(TPUMetricsUserError, match="increment"):
+        tracker.update(jnp.asarray([1]), jnp.asarray([1]))
+    with pytest.raises(TypeError, match="Metric"):
+        MetricTracker(lambda x: x)
+
+
+def test_minmax_forward_accumulates():
+    """forward must not destroy the base metric's accumulation."""
+    metric = MinMaxMetric(BinaryAccuracy())
+    metric(jnp.asarray([1, 0, 1, 1]), jnp.asarray([1, 0, 1, 1]))  # acc 1.0
+    out = metric(jnp.asarray([0, 0, 0, 0]), jnp.asarray([1, 1, 0, 0]))  # batch acc 0.5
+    # accumulated accuracy over both batches = 6/8
+    assert np.isclose(float(out["raw"]), 0.75, atol=1e-6)
+    assert float(out["max"]) == 1.0
+    # min/max are registered states: present in sync machinery
+    assert "min_val" in metric._defaults and "max_val" in metric._defaults
+
+
+def test_tracker_maximize_validation():
+    with pytest.raises(ValueError, match="single bool"):
+        MetricTracker(BinaryAccuracy(), maximize=[False])
+    with pytest.raises(ValueError, match="len of argument"):
+        MetricTracker(MetricCollection([BinaryAccuracy(), MeanSquaredError()]), maximize=[True])
+    # minimize on a single metric
+    tracker = MetricTracker(MeanSquaredError(), maximize=False)
+    for err in (1.0, 5.0):
+        tracker.increment()
+        tracker.update(jnp.asarray([err]), jnp.asarray([0.0]))
+    best, step = tracker.best_metric(return_step=True)
+    assert np.isclose(best, 1.0) and step == 0

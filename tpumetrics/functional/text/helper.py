@@ -13,6 +13,17 @@ from typing import List, Sequence, Union
 import numpy as np
 
 
+def _token_ids(*token_sequences: Sequence) -> list:
+    """Map tokens to dense collision-free integer ids (shared vocabulary
+    across the given sequences) so DP comparisons can vectorize over numpy
+    without relying on ``hash`` equality."""
+    vocab: dict = {}
+    out = []
+    for seq in token_sequences:
+        out.append(np.asarray([vocab.setdefault(t, len(vocab)) for t in seq], dtype=np.int64))
+    return out
+
+
 def _edit_distance(
     prediction_tokens: Sequence, reference_tokens: Sequence, substitution_cost: int = 1
 ) -> int:
@@ -23,12 +34,12 @@ def _edit_distance(
         return n
     if n == 0:
         return m
-    ref = np.asarray([hash(t) for t in reference_tokens])
+    pred_ids, ref_ids = _token_ids(prediction_tokens, reference_tokens)
     prev = np.arange(n + 1)
     for i in range(1, m + 1):
         cur = np.empty(n + 1, dtype=np.int64)
         cur[0] = i
-        sub = prev[:-1] + (ref != hash(prediction_tokens[i - 1])) * substitution_cost
+        sub = prev[:-1] + (ref_ids != pred_ids[i - 1]) * substitution_cost
         # deletions/substitutions are vectorized; insertions need the scan
         np.minimum(sub, prev[1:] + 1, out=sub)
         running = cur[0]

@@ -95,11 +95,13 @@ def _compute_metrics(hits_or_lcs: int, pred_len: int, target_len: int) -> Dict[s
 
 def _lcs_table(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> np.ndarray:
     """Full LCS DP table, numpy-vectorized over rows (reference rouge.py:95-116)."""
+    from tpumetrics.functional.text.helper import _token_ids
+
     m, n = len(pred_tokens), len(target_tokens)
     table = np.zeros((n + 1, m + 1), dtype=np.int64)
-    pred_arr = np.asarray([hash(t) for t in pred_tokens]) if m else np.zeros(0, np.int64)
+    pred_ids, target_ids = _token_ids(pred_tokens, target_tokens)
     for i in range(1, n + 1):
-        eq = pred_arr == hash(target_tokens[i - 1])
+        eq = pred_ids == target_ids[i - 1]
         row = table[i]
         prev = table[i - 1]
         for j in range(1, m + 1):

@@ -1,9 +1,21 @@
 """Wrapper metrics (counterpart of reference ``torchmetrics/wrappers``)."""
 
 from tpumetrics.wrappers.abstract import WrapperMetric
+from tpumetrics.wrappers.bootstrapping import BootStrapper
+from tpumetrics.wrappers.classwise import ClasswiseWrapper
+from tpumetrics.wrappers.minmax import MinMaxMetric
+from tpumetrics.wrappers.multioutput import MultioutputWrapper
+from tpumetrics.wrappers.multitask import MultitaskWrapper
 from tpumetrics.wrappers.running import Running
+from tpumetrics.wrappers.tracker import MetricTracker
 
 __all__ = [
+    "BootStrapper",
+    "ClasswiseWrapper",
+    "MetricTracker",
+    "MinMaxMetric",
+    "MultioutputWrapper",
+    "MultitaskWrapper",
     "Running",
     "WrapperMetric",
 ]

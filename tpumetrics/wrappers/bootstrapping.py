@@ -1,0 +1,136 @@
+"""BootStrapper (counterpart of reference ``wrappers/bootstrapping.py:54``)."""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.metric import Metric
+from tpumetrics.wrappers.abstract import WrapperMetric
+
+Array = jax.Array
+
+
+def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optional[np.random.Generator] = None) -> np.ndarray:
+    """Resample indices 0..size-1 with replacement (reference bootstrapping.py:31-51)."""
+    rng = rng or np.random.default_rng()
+    if sampling_strategy == "poisson":
+        n = rng.poisson(1.0, size=size)
+        return np.repeat(np.arange(size), n)
+    if sampling_strategy == "multinomial":
+        return rng.integers(0, size, size=size)
+    raise ValueError("Unknown sampling strategy")
+
+
+class BootStrapper(WrapperMetric):
+    """Bootstrapped confidence statistics of any metric: ``num_bootstraps``
+    copies each fed an index-resampled view of every update batch
+    (reference bootstrapping.py:54-212).
+
+    Args:
+        base_metric: metric instance to bootstrap.
+        num_bootstraps: number of resampled copies.
+        mean/std/quantile/raw: which statistics ``compute`` returns.
+        sampling_strategy: ``multinomial`` (default — exact batch-level
+            bootstrap with fixed-size index arrays, so each inner metric's
+            jitted update compiles once) or ``poisson`` (the reference's
+            default; its resample length varies per draw, forcing an XLA
+            recompile of the inner update on almost every call — use it only
+            for strict reference parity or eager metrics).
+        seed: optional seed for the resampling generator (TPU extension —
+            the reference draws from the global torch RNG).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.wrappers import BootStrapper
+        >>> from tpumetrics.classification import MulticlassAccuracy
+        >>> metric = BootStrapper(MulticlassAccuracy(num_classes=5), num_bootstraps=20, seed=42)
+        >>> preds = jnp.asarray([0, 1, 2, 3, 4, 0, 1, 2, 3, 4])
+        >>> target = jnp.asarray([0, 1, 2, 3, 4, 0, 0, 0, 0, 0])
+        >>> metric.update(preds, target)
+        >>> out = metric.compute()
+        >>> sorted(out.keys())
+        ['mean', 'std']
+    """
+
+    full_state_update: Optional[bool] = True
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        mean: bool = True,
+        std: bool = True,
+        quantile: Optional[Union[float, Sequence[float]]] = None,
+        raw: bool = False,
+        sampling_strategy: str = "multinomial",
+        seed: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of tpumetrics.Metric but received {base_metric}"
+            )
+        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+        self.mean = mean
+        self.std = std
+        self.quantile = quantile
+        self.raw = raw
+        allowed_sampling = ("poisson", "multinomial")
+        if sampling_strategy not in allowed_sampling:
+            raise ValueError(
+                f"Expected argument ``sampling_strategy`` to be one of {allowed_sampling}"
+                f" but received {sampling_strategy}"
+            )
+        self.sampling_strategy = sampling_strategy
+        self._rng = np.random.default_rng(seed)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Resample every array input along dim 0, once per bootstrap copy."""
+        sizes = [len(a) for a in args if isinstance(a, (jax.Array, jnp.ndarray))]
+        sizes += [len(v) for v in kwargs.values() if isinstance(v, (jax.Array, jnp.ndarray))]
+        if not sizes:
+            raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+        size = sizes[0]
+
+        def _select(x: Any, idx: Array) -> Any:
+            return jnp.take(x, idx, axis=0) if isinstance(x, (jax.Array, jnp.ndarray)) else x
+
+        for idx in range(self.num_bootstraps):
+            sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
+            if sample_idx.size == 0:
+                continue
+            sample = jnp.asarray(sample_idx)
+            new_args = tuple(_select(a, sample) for a in args)
+            new_kwargs = {k: _select(v, sample) for k, v in kwargs.items()}
+            self.metrics[idx].update(*new_args, **new_kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """mean/std/quantile/raw over the bootstrap copies (reference :162-181)."""
+        computed_vals = jnp.stack([m.compute() for m in self.metrics], axis=0)
+        output_dict: Dict[str, Array] = {}
+        if self.mean:
+            output_dict["mean"] = computed_vals.mean(axis=0)
+        if self.std:
+            output_dict["std"] = computed_vals.std(axis=0, ddof=1)
+        if self.quantile is not None:
+            output_dict["quantile"] = jnp.quantile(computed_vals, jnp.asarray(self.quantile), axis=0)
+        if self.raw:
+            output_dict["raw"] = computed_vals
+        return output_dict
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Update with resampling and return the current statistics."""
+        self.update(*args, **kwargs)
+        return self.compute()
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()
+        super().reset()

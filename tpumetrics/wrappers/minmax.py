@@ -1,0 +1,80 @@
+"""MinMaxMetric (counterpart of reference ``wrappers/minmax.py:29``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.metric import Metric
+from tpumetrics.wrappers.abstract import WrapperMetric
+
+Array = jax.Array
+
+
+class MinMaxMetric(WrapperMetric):
+    """Track the running min/max of a metric's compute value.
+
+    The extrema are registered states (``min``/``max`` reduce), so they sync
+    across devices and persist through ``state_dict`` — unlike the
+    reference's plain attributes (reference minmax.py:51-52). ``forward``
+    accumulates into the base metric and returns the refreshed statistics
+    (the reference's double-compute forward would silently reset the base
+    metric's accumulation, since the wrapper itself holds no batch states).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.wrappers import MinMaxMetric
+        >>> from tpumetrics.classification import BinaryAccuracy
+        >>> metric = MinMaxMetric(BinaryAccuracy())
+        >>> _ = metric(jnp.asarray([1, 0, 1, 1]), jnp.asarray([1, 0, 1, 1]))
+        >>> {k: float(v) for k, v in metric.compute().items()}
+        {'raw': 1.0, 'max': 1.0, 'min': 1.0}
+    """
+
+    full_state_update: Optional[bool] = True
+
+    min_val: Array
+    max_val: Array
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of `tpumetrics.Metric` but received {base_metric}"
+            )
+        self._base_metric = base_metric
+        self.add_state("min_val", default=jnp.asarray(jnp.inf), dist_reduce_fx="min")
+        self.add_state("max_val", default=jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._base_metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """{raw, max, min}; the extrema refresh on every compute (reference minmax.py:92-103)."""
+        val = self._base_metric.compute()
+        if not self._is_suitable_val(val):
+            raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}.")
+        val = jnp.asarray(val)
+        self.max_val = jnp.maximum(self.max_val, val)
+        self.min_val = jnp.minimum(self.min_val, val)
+        return {"raw": val, "max": self.max_val, "min": self.min_val}
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        """Accumulate the batch into the base metric and return the
+        refreshed running statistics."""
+        self.update(*args, **kwargs)
+        return self.compute()
+
+    def reset(self) -> None:
+        super().reset()
+        self._base_metric.reset()
+
+    @staticmethod
+    def _is_suitable_val(val: Union[float, Array]) -> bool:
+        if isinstance(val, (int, float)):
+            return True
+        if isinstance(val, (jax.Array, jnp.ndarray)):
+            return val.size == 1
+        return False

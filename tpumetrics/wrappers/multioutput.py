@@ -1,0 +1,113 @@
+"""MultioutputWrapper (counterpart of reference ``wrappers/multioutput.py:43``)."""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.metric import Metric
+from tpumetrics.wrappers.abstract import WrapperMetric
+
+Array = jax.Array
+
+
+def _get_nan_indices(*tensors: Array) -> Array:
+    """Rows where any tensor has a NaN (reference multioutput.py:26-40)."""
+    if len(tensors) == 0:
+        raise ValueError("Must pass at least one tensor as argument")
+    nan_idxs = jnp.zeros(len(tensors[0]), dtype=bool)
+    for tensor in tensors:
+        permuted = tensor.reshape(len(tensor), -1)
+        nan_idxs = nan_idxs | jnp.isnan(permuted).any(axis=1)
+    return nan_idxs
+
+
+class MultioutputWrapper(WrapperMetric):
+    """One inner metric clone per output column (e.g. multi-target R2).
+
+    ``remove_nans`` drops rows containing NaN before each inner update —
+    data-dependent shapes, so the wrapper is eager-only by design (the inner
+    metrics may still jit their own math).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.wrappers import MultioutputWrapper
+        >>> from tpumetrics.regression import R2Score
+        >>> target = jnp.asarray([[0.5, 1.0], [-1.0, 1.0], [7.0, -6.0]])
+        >>> preds = jnp.asarray([[0.25, 0.5], [-1.0, 1.0], [8.0, -5.0]])
+        >>> r2 = MultioutputWrapper(R2Score(), num_outputs=2)
+        >>> r2.update(preds, target)
+        >>> [round(float(x), 4) for x in r2.compute()]
+        [0.9706, 0.9617]
+    """
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array) -> List[Tuple]:
+        """Slice every array input down to one output column (reference :100-124)."""
+        args_kwargs_by_output = []
+        for i in range(len(self.metrics)):
+            def _select(x: Any) -> Any:
+                if isinstance(x, (jax.Array, jnp.ndarray)):
+                    return jnp.take(x, jnp.asarray([i]), axis=self.output_dim)
+                return x
+
+            selected_args = [_select(a) for a in args]
+            selected_kwargs = {k: _select(v) for k, v in kwargs.items()}
+            if self.remove_nans:
+                args_kwargs = tuple(selected_args) + tuple(selected_kwargs.values())
+                nan_idxs = _get_nan_indices(*args_kwargs)
+                selected_args = [arg[~nan_idxs] for arg in selected_args]
+                selected_kwargs = {k: v[~nan_idxs] for k, v in selected_kwargs.items()}
+            if self.squeeze_outputs:
+                selected_args = [jnp.squeeze(arg, axis=self.output_dim) for arg in selected_args]
+                selected_kwargs = {k: jnp.squeeze(v, axis=self.output_dim) for k, v in selected_kwargs.items()}
+            args_kwargs_by_output.append((selected_args, selected_kwargs))
+        return args_kwargs_by_output
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Route each output column into its inner clone."""
+        reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
+        for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs):
+            metric.update(*selected_args, **selected_kwargs)
+
+    def compute(self) -> Array:
+        """Stacked per-output results."""
+        return jnp.stack([m.compute() for m in self.metrics], 0)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Per-output forwards, stacked (accumulates inner state like update)."""
+        reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
+        results = [
+            metric(*selected_args, **selected_kwargs)
+            for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs)
+        ]
+        if results[0] is None:
+            return None
+        return jnp.stack(results, 0)
+
+    def reset(self) -> None:
+        for metric in self.metrics:
+            metric.reset()
+        super().reset()
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        return self.metrics[0]._filter_kwargs(**kwargs)

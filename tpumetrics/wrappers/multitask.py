@@ -1,0 +1,75 @@
+"""MultitaskWrapper (counterpart of reference ``wrappers/multitask.py:29``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Union
+
+import jax
+
+from tpumetrics.collections import MetricCollection
+from tpumetrics.metric import Metric
+from tpumetrics.wrappers.abstract import WrapperMetric
+
+Array = jax.Array
+
+
+class MultitaskWrapper(WrapperMetric):
+    """Route per-task predictions/targets to per-task metrics.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.wrappers import MultitaskWrapper
+        >>> from tpumetrics.classification import BinaryAccuracy
+        >>> from tpumetrics.regression import MeanSquaredError
+        >>> metrics = MultitaskWrapper({"Classification": BinaryAccuracy(), "Regression": MeanSquaredError()})
+        >>> preds = {"Classification": jnp.asarray([0, 1, 1]), "Regression": jnp.asarray([127.5, 87.1, 25.6])}
+        >>> target = {"Classification": jnp.asarray([0, 1, 0]), "Regression": jnp.asarray([120.0, 85.0, 30.0])}
+        >>> metrics.update(preds, target)
+        >>> {k: round(float(v), 4) for k, v in metrics.compute().items()}
+        {'Classification': 0.6667, 'Regression': 26.6733}
+    """
+
+    is_differentiable = False
+
+    def __init__(self, task_metrics: Dict[str, Union[Metric, MetricCollection]]) -> None:
+        self._check_task_metrics_type(task_metrics)
+        super().__init__()
+        self.task_metrics = dict(task_metrics)
+
+    @staticmethod
+    def _check_task_metrics_type(task_metrics: Dict[str, Union[Metric, MetricCollection]]) -> None:
+        if not isinstance(task_metrics, dict):
+            raise TypeError(f"Expected argument `task_metrics` to be a dict. Found task_metrics = {task_metrics}")
+        for metric in task_metrics.values():
+            if not isinstance(metric, (Metric, MetricCollection)):
+                raise TypeError(
+                    "Expected each task's metric to be a Metric or a MetricCollection. "
+                    f"Found a metric of type {type(metric)}"
+                )
+
+    def update(self, task_preds: Dict[str, Array], task_targets: Dict[str, Array]) -> None:
+        """Route each task's batch to its metric."""
+        if not self.task_metrics.keys() == task_preds.keys() == task_targets.keys():
+            raise ValueError(
+                "Expected arguments `task_preds` and `task_targets` to have the same keys as the wrapped"
+                f" `task_metrics`. Found task_preds.keys() = {task_preds.keys()},"
+                f" task_targets.keys() = {task_targets.keys()}"
+                f" and self.task_metrics.keys() = {self.task_metrics.keys()}"
+            )
+        for task_name, metric in self.task_metrics.items():
+            metric.update(task_preds[task_name], task_targets[task_name])
+
+    def compute(self) -> Dict[str, Any]:
+        return {task_name: metric.compute() for task_name, metric in self.task_metrics.items()}
+
+    def forward(self, task_preds: Dict[str, Array], task_targets: Dict[str, Array]) -> Dict[str, Any]:
+        """Per-task forwards; each inner metric accumulates itself."""
+        return {
+            task_name: metric(task_preds[task_name], task_targets[task_name])
+            for task_name, metric in self.task_metrics.items()
+        }
+
+    def reset(self) -> None:
+        for metric in self.task_metrics.values():
+            metric.reset()
+        super().reset()
