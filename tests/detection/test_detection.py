@@ -1,0 +1,340 @@
+"""Detection domain (counterpart of reference ``tests/unittests/detection/``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics.detection import (
+    CompleteIntersectionOverUnion,
+    DistanceIntersectionOverUnion,
+    GeneralizedIntersectionOverUnion,
+    IntersectionOverUnion,
+    MeanAveragePrecision,
+    ModifiedPanopticQuality,
+    PanopticQuality,
+)
+from tpumetrics.functional.detection import (
+    complete_intersection_over_union,
+    distance_intersection_over_union,
+    generalized_intersection_over_union,
+    intersection_over_union,
+    modified_panoptic_quality,
+    panoptic_quality,
+)
+from tpumetrics.functional.detection._box_ops import box_convert, box_iou
+
+_rng = np.random.default_rng(31)
+
+
+def _random_boxes(n: int) -> np.ndarray:
+    xy = _rng.random((n, 2)) * 100
+    wh = _rng.random((n, 2)) * 50 + 1
+    return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+
+# --------------------------------------------------------------- box ops
+
+
+def _np_iou(b1, b2):
+    lt = np.maximum(b1[:, None, :2], b2[None, :, :2])
+    rb = np.minimum(b1[:, None, 2:], b2[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    a1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+    a2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+    return inter / (a1[:, None] + a2[None, :] - inter)
+
+
+def test_box_iou_vs_numpy():
+    b1, b2 = _random_boxes(16), _random_boxes(11)
+    got = np.asarray(box_iou(jnp.asarray(b1), jnp.asarray(b2)))
+    assert np.allclose(got, _np_iou(b1, b2), atol=1e-5)
+
+
+def test_box_convert_roundtrip():
+    b = _random_boxes(8)
+    for fmt in ("xywh", "cxcywh"):
+        converted = box_convert(jnp.asarray(b), "xyxy", fmt)
+        back = box_convert(converted, fmt, "xyxy")
+        assert np.allclose(np.asarray(back), b, atol=1e-4)
+
+
+def test_iou_variant_properties():
+    """GIoU <= IoU; DIoU <= IoU; identical boxes score exactly 1 everywhere."""
+    b1, b2 = _random_boxes(10), _random_boxes(10)
+    j1, j2 = jnp.asarray(b1), jnp.asarray(b2)
+    iou = np.asarray(intersection_over_union(j1, j2, aggregate=False))
+    giou = np.asarray(generalized_intersection_over_union(j1, j2, aggregate=False))
+    diou = np.asarray(distance_intersection_over_union(j1, j2, aggregate=False))
+    ciou = np.asarray(complete_intersection_over_union(j1, j2, aggregate=False))
+    assert (giou <= iou + 1e-6).all()
+    assert (diou <= iou + 1e-6).all()
+    assert (ciou <= diou + 1e-6).all()
+    for fn in (intersection_over_union, generalized_intersection_over_union,
+               distance_intersection_over_union, complete_intersection_over_union):
+        assert np.isclose(float(fn(j1, j1)), 1.0, atol=1e-5)
+
+
+def test_iou_class_respect_labels():
+    preds = [dict(boxes=jnp.asarray([[0.0, 0, 10, 10], [20, 20, 30, 30]]), labels=jnp.asarray([1, 2]))]
+    target = [dict(boxes=jnp.asarray([[0.0, 0, 10, 10], [20, 20, 30, 30]]), labels=jnp.asarray([1, 3]))]
+    m = IntersectionOverUnion(respect_labels=True)
+    m.update(preds, target)
+    assert np.isclose(float(m.compute()["iou"]), 1.0, atol=1e-6)  # only the label-1 pair is valid
+    m2 = IntersectionOverUnion(respect_labels=False)
+    m2.update(preds, target)
+    # now the zero-IoU cross pairs are included
+    assert float(m2.compute()["iou"]) < 1.0
+
+
+def test_iou_class_metrics_per_class():
+    preds = [dict(boxes=jnp.asarray([[0.0, 0, 10, 10], [20, 20, 30, 30]]), labels=jnp.asarray([0, 1]))]
+    target = [dict(boxes=jnp.asarray([[0.0, 0, 5, 10], [20, 20, 30, 30]]), labels=jnp.asarray([0, 1]))]
+    m = IntersectionOverUnion(class_metrics=True)
+    m.update(preds, target)
+    out = m.compute()
+    assert np.isclose(float(out["iou/cl_0"]), 0.5, atol=1e-6)
+    assert np.isclose(float(out["iou/cl_1"]), 1.0, atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "metric_class, key",
+    [
+        (GeneralizedIntersectionOverUnion, "giou"),
+        (DistanceIntersectionOverUnion, "diou"),
+        (CompleteIntersectionOverUnion, "ciou"),
+    ],
+    ids=["giou", "diou", "ciou"],
+)
+def test_iou_variant_classes(metric_class, key):
+    # unique labels: only the diagonal (identical-box) pairs are valid
+    preds = [dict(boxes=jnp.asarray(_random_boxes(4)), labels=jnp.asarray([0, 1, 2, 3]))]
+    target = [dict(boxes=preds[0]["boxes"], labels=preds[0]["labels"])]
+    m = metric_class()
+    m.update(preds, target)
+    assert np.isclose(float(m.compute()[key]), 1.0, atol=1e-5)
+
+
+# ------------------------------------------------------------------- mAP
+
+
+def test_map_reference_documented_example():
+    """The reference's docstring example, whose values come straight from
+    pycocotools (reference mean_ap.py:239-269)."""
+    preds = [
+        dict(boxes=jnp.asarray([[258.0, 41.0, 606.0, 285.0]]), scores=jnp.asarray([0.536]), labels=jnp.asarray([0]))
+    ]
+    target = [dict(boxes=jnp.asarray([[214.0, 41.0, 562.0, 285.0]]), labels=jnp.asarray([0]))]
+    metric = MeanAveragePrecision()
+    metric.update(preds, target)
+    result = metric.compute()
+    expected = {
+        "map": 0.6, "map_50": 1.0, "map_75": 1.0, "map_large": 0.6,
+        "map_medium": -1.0, "map_small": -1.0,
+        "mar_1": 0.6, "mar_10": 0.6, "mar_100": 0.6, "mar_large": 0.6,
+        "mar_medium": -1.0, "mar_small": -1.0,
+    }
+    for k, v in expected.items():
+        assert np.isclose(float(result[k]), v, atol=1e-4), (k, float(result[k]), v)
+
+
+def test_map_perfect_predictions():
+    boxes = _random_boxes(6)
+    labels = _rng.integers(0, 3, 6)
+    preds = [dict(boxes=jnp.asarray(boxes), scores=jnp.asarray(np.linspace(0.9, 0.4, 6), dtype=jnp.float32),
+                  labels=jnp.asarray(labels))]
+    target = [dict(boxes=jnp.asarray(boxes), labels=jnp.asarray(labels))]
+    m = MeanAveragePrecision()
+    m.update(preds, target)
+    result = m.compute()
+    assert np.isclose(float(result["map"]), 1.0, atol=1e-5)
+    assert np.isclose(float(result["mar_100"]), 1.0, atol=1e-5)
+
+
+def test_map_false_positive_penalty():
+    """A high-scoring false positive must lower AP below a low-scoring one."""
+    gt_box = np.asarray([[10.0, 10, 50, 50]], np.float32)
+    fp_box = np.asarray([[200.0, 200, 240, 240]], np.float32)
+
+    def run(fp_score):
+        m = MeanAveragePrecision()
+        preds = [dict(
+            boxes=jnp.asarray(np.concatenate([gt_box, fp_box])),
+            scores=jnp.asarray([0.9, fp_score], dtype=jnp.float32),
+            labels=jnp.asarray([0, 0]),
+        )]
+        target = [dict(boxes=jnp.asarray(gt_box), labels=jnp.asarray([0]))]
+        m.update(preds, target)
+        return float(m.compute()["map"])
+
+    assert run(0.95) < run(0.1)
+
+
+def test_map_iscrowd_ignored():
+    """Detections matching a crowd ground truth are neither TP nor FP."""
+    gt = np.asarray([[10.0, 10, 50, 50], [100.0, 100, 160, 160]], np.float32)
+    preds = [dict(
+        boxes=jnp.asarray(gt),
+        scores=jnp.asarray([0.9, 0.8], dtype=jnp.float32),
+        labels=jnp.asarray([0, 0]),
+    )]
+    target = [dict(boxes=jnp.asarray(gt), labels=jnp.asarray([0, 0]), iscrowd=jnp.asarray([0, 1]))]
+    m = MeanAveragePrecision()
+    m.update(preds, target)
+    result = m.compute()
+    # the only counted gt (non-crowd) is matched perfectly
+    assert np.isclose(float(result["map"]), 1.0, atol=1e-5)
+
+
+def test_map_multiclass_and_class_metrics():
+    boxes = _random_boxes(8)
+    labels = np.asarray([0, 0, 1, 1, 1, 2, 2, 2])
+    # class 2 predictions are shifted off-target -> AP 0 for class 2
+    pred_boxes = boxes.copy()
+    pred_boxes[5:] += 500.0
+    preds = [dict(boxes=jnp.asarray(pred_boxes), scores=jnp.asarray(np.full(8, 0.9), dtype=jnp.float32),
+                  labels=jnp.asarray(labels))]
+    target = [dict(boxes=jnp.asarray(boxes), labels=jnp.asarray(labels))]
+    m = MeanAveragePrecision(class_metrics=True)
+    m.update(preds, target)
+    result = m.compute()
+    per_class = np.asarray(result["map_per_class"])
+    assert per_class.shape == (3,)
+    assert np.isclose(per_class[0], 1.0, atol=1e-5)
+    assert np.isclose(per_class[1], 1.0, atol=1e-5)
+    assert per_class[2] <= 0.0 + 1e-6
+    assert np.isclose(float(result["map"]), per_class.mean(), atol=1e-5)
+
+
+def test_map_max_detections():
+    """mar_1 only counts the single best detection per image."""
+    boxes = _random_boxes(5)
+    preds = [dict(boxes=jnp.asarray(boxes), scores=jnp.asarray(np.linspace(0.9, 0.5, 5), dtype=jnp.float32),
+                  labels=jnp.asarray(np.zeros(5, np.int64)))]
+    target = [dict(boxes=jnp.asarray(boxes), labels=jnp.asarray(np.zeros(5, np.int64)))]
+    m = MeanAveragePrecision()
+    m.update(preds, target)
+    result = m.compute()
+    assert np.isclose(float(result["mar_1"]), 0.2, atol=1e-5)
+    assert np.isclose(float(result["mar_100"]), 1.0, atol=1e-5)
+
+
+def test_map_micro_average():
+    boxes = _random_boxes(4)
+    labels = np.asarray([0, 1, 2, 3])
+    preds = [dict(boxes=jnp.asarray(boxes), scores=jnp.asarray(np.full(4, 0.9), dtype=jnp.float32),
+                  labels=jnp.asarray(labels))]
+    target = [dict(boxes=jnp.asarray(boxes), labels=jnp.asarray(labels))]
+    m = MeanAveragePrecision(average="micro")
+    m.update(preds, target)
+    assert np.isclose(float(m.compute()["map"]), 1.0, atol=1e-5)
+
+
+def test_map_empty_cases():
+    m = MeanAveragePrecision()
+    # image with no predictions but ground truth -> recall 0
+    m.update(
+        [dict(boxes=jnp.zeros((0, 4)), scores=jnp.zeros((0,)), labels=jnp.zeros((0,), jnp.int32))],
+        [dict(boxes=jnp.asarray([[10.0, 10, 20, 20]]), labels=jnp.asarray([0]))],
+    )
+    result = m.compute()
+    assert np.isclose(float(result["map"]), 0.0, atol=1e-6)
+
+
+def test_map_ddp_merge_preserves_images():
+    """Per-image boundaries survive the replica merge (VERDICT weak #2)."""
+    from tpumetrics.parallel.merge import merge_metric_states
+
+    all_preds, all_targets = [], []
+    for _ in range(4):
+        boxes = _random_boxes(3)
+        labels = _rng.integers(0, 2, 3)
+        all_preds.append(dict(boxes=jnp.asarray(boxes), scores=jnp.asarray(_rng.random(3), dtype=jnp.float32),
+                              labels=jnp.asarray(labels)))
+        all_targets.append(dict(boxes=jnp.asarray(boxes + _rng.normal(0, 2, boxes.shape).astype(np.float32)),
+                                labels=jnp.asarray(labels)))
+
+    replicas = [MeanAveragePrecision() for _ in range(2)]
+    for rank in range(2):
+        for i in range(rank, 4, 2):
+            replicas[rank].update([all_preds[i]], [all_targets[i]])
+    merged = merge_metric_states([m.metric_state() for m in replicas], replicas[0]._reductions)
+    got = replicas[0].functional_compute(merged)
+
+    single = MeanAveragePrecision()
+    for i in [0, 2, 1, 3]:  # rank order
+        single.update([all_preds[i]], [all_targets[i]])
+    ref = single.compute()
+    assert np.isclose(float(got["map"]), float(ref["map"]), atol=1e-6)
+    assert np.isclose(float(got["mar_100"]), float(ref["mar_100"]), atol=1e-6)
+
+
+def test_map_input_validation():
+    m = MeanAveragePrecision()
+    with pytest.raises(ValueError, match="Expected argument `preds` and `target` to have the same length"):
+        m.update([], [dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros((0,)))])
+    with pytest.raises(ValueError, match="`scores`"):
+        m.update([dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros((0,)))],
+                 [dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros((0,)))])
+    with pytest.raises(ValueError, match="box_format"):
+        MeanAveragePrecision(box_format="bad")
+
+
+# -------------------------------------------------------- panoptic quality
+
+
+_PQ_PREDS = jnp.asarray([[[[6, 0], [0, 0], [6, 0], [6, 0]],
+                          [[0, 0], [0, 0], [6, 0], [0, 1]],
+                          [[0, 0], [0, 0], [6, 0], [0, 1]],
+                          [[0, 0], [7, 0], [6, 0], [1, 0]],
+                          [[0, 0], [7, 0], [7, 0], [7, 0]]]])
+_PQ_TARGET = jnp.asarray([[[[6, 0], [0, 1], [6, 0], [0, 1]],
+                           [[0, 1], [0, 1], [6, 0], [0, 1]],
+                           [[0, 1], [0, 1], [6, 0], [1, 0]],
+                           [[0, 1], [7, 0], [1, 0], [1, 0]],
+                           [[0, 1], [7, 0], [7, 0], [7, 0]]]])
+
+
+def test_panoptic_quality_reference_example():
+    assert np.isclose(float(panoptic_quality(_PQ_PREDS, _PQ_TARGET, things={0, 1}, stuffs={6, 7})), 0.5463, atol=1e-4)
+    m = PanopticQuality(things={0, 1}, stuffs={6, 7})
+    m.update(_PQ_PREDS, _PQ_TARGET)
+    assert np.isclose(float(m.compute()), 0.5463, atol=1e-4)
+
+
+def test_modified_panoptic_quality_reference_example():
+    preds = jnp.asarray([[[0, 0], [0, 1], [6, 0], [7, 0], [0, 2], [1, 0]]])
+    target = jnp.asarray([[[0, 1], [0, 0], [6, 0], [7, 0], [6, 0], [255, 0]]])
+    assert np.isclose(
+        float(modified_panoptic_quality(preds, target, things={0, 1}, stuffs={6, 7})), 0.7667, atol=1e-4
+    )
+    m = ModifiedPanopticQuality(things={0, 1}, stuffs={6, 7})
+    m.update(preds, target)
+    assert np.isclose(float(m.compute()), 0.7667, atol=1e-4)
+
+
+def test_panoptic_quality_perfect_and_streaming():
+    pq = PanopticQuality(things={0, 1}, stuffs={6, 7})
+    pq.update(_PQ_TARGET, _PQ_TARGET)
+    assert np.isclose(float(pq.compute()), 1.0, atol=1e-6)
+
+    # streaming across batches == single batch
+    pq2 = PanopticQuality(things={0, 1}, stuffs={6, 7})
+    pq2.update(_PQ_PREDS, _PQ_TARGET)
+    pq2.update(_PQ_PREDS, _PQ_TARGET)
+    assert np.isclose(float(pq2.compute()), 0.5463, atol=1e-4)  # same images twice -> same PQ
+
+
+def test_panoptic_quality_validation():
+    with pytest.raises(ValueError, match="distinct"):
+        PanopticQuality(things={0, 1}, stuffs={1, 2})
+    with pytest.raises(TypeError, match="int"):
+        PanopticQuality(things={0.5}, stuffs={1})
+    pq = PanopticQuality(things={0}, stuffs={1})
+    with pytest.raises(ValueError, match="same shape"):
+        pq.update(jnp.zeros((1, 4, 2), jnp.int32), jnp.zeros((1, 5, 2), jnp.int32))
+    with pytest.raises(ValueError, match="Unknown categories"):
+        pq.update(jnp.full((1, 4, 2), 9, jnp.int32), jnp.zeros((1, 4, 2), jnp.int32))

@@ -205,3 +205,32 @@ def test_collection_dist_sync_on_step_forward_over_mesh():
     p = np.argmax(np.asarray(preds), axis=1)
     assert np.allclose(np.asarray(vals["acc"]), accuracy_score(t, p), atol=1e-6)
     assert np.allclose(np.asarray(vals["f1"]), f1_score(t, p, average="macro"), atol=1e-6)
+
+
+def test_gather_ragged_list_preserves_boundaries():
+    """Reduce-None ragged list states gather item-by-item, preserving
+    per-item (e.g. per-image) boundaries and uneven rank counts."""
+    import jax.numpy as jnp
+
+    from tpumetrics.metric import _gather_ragged_list
+
+    local = [jnp.ones((2, 4)), 2 * jnp.ones((3, 4))]
+    peer = [3 * jnp.ones((1, 4))]
+
+    class _FakeTwoRankBackend:
+        def __init__(self):
+            self.step = 0
+
+        def all_gather(self, v, group=None):
+            if self.step == 0:
+                self.step += 1
+                return [v, jnp.asarray(len(peer), jnp.int32)]
+            idx = self.step - 1
+            self.step += 1
+            peer_v = peer[idx] if idx < len(peer) else jnp.zeros((0, 4), peer[0].dtype)
+            return [v, peer_v]
+
+    merged = _gather_ragged_list(_FakeTwoRankBackend(), local, None, jnp.float32)
+    assert len(merged) == 3
+    assert merged[0].shape == (2, 4) and merged[1].shape == (3, 4) and merged[2].shape == (1, 4)
+    assert float(merged[2].mean()) == 3.0

@@ -1,0 +1,18 @@
+"""Detection metric domain (counterpart of reference ``detection/__init__.py``)."""
+
+from tpumetrics.detection.ciou import CompleteIntersectionOverUnion
+from tpumetrics.detection.diou import DistanceIntersectionOverUnion
+from tpumetrics.detection.giou import GeneralizedIntersectionOverUnion
+from tpumetrics.detection.iou import IntersectionOverUnion
+from tpumetrics.detection.mean_ap import MeanAveragePrecision
+from tpumetrics.detection.panoptic_qualities import ModifiedPanopticQuality, PanopticQuality
+
+__all__ = [
+    "CompleteIntersectionOverUnion",
+    "DistanceIntersectionOverUnion",
+    "GeneralizedIntersectionOverUnion",
+    "IntersectionOverUnion",
+    "MeanAveragePrecision",
+    "ModifiedPanopticQuality",
+    "PanopticQuality",
+]

@@ -1,0 +1,270 @@
+"""COCO-faithful detection evaluation in vectorized numpy.
+
+A from-scratch reimplementation of the COCO mAP protocol (the semantics of
+pycocotools' ``COCOeval``, which the reference shells out to on CPU from
+``detection/mean_ap.py:501``; the reference's pure-torch blueprint is
+``detection/_mean_ap.py``):
+
+- IoU thresholds 0.50:0.05:0.95, recall thresholds 0:0.01:1 (101 points),
+  max-detection caps (1, 10, 100), area ranges all/small/medium/large;
+- per (class, image): detections sorted by score, greedily matched to the
+  not-yet-matched ground truth with the highest IoU above the threshold;
+  crowd ground truths may match many detections and use a detection-area
+  union (``iscrowd`` semantics); ignored ground truths (crowd or
+  out-of-area-range) absorb matches without counting;
+- accumulation: detections merged across images per class, re-sorted by
+  score, TP/FP cumsums over non-ignored entries, precision made monotone
+  from the right, sampled at the recall thresholds.
+
+Everything after the per-image matching is dense numpy (the matching itself
+is a data-dependent greedy loop, which is why — like the reference — this
+runs on host at ``compute`` time; states stay on device until then).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_AREA_RANGES = {
+    "all": (0.0, 1e10),
+    "small": (0.0, 32.0**2),
+    "medium": (32.0**2, 96.0**2),
+    "large": (96.0**2, 1e10),
+}
+
+
+def _np_box_iou(det: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray) -> np.ndarray:
+    """(D, G) IoU with pycocotools crowd semantics: for a crowd gt the
+    denominator is the detection area alone."""
+    if det.size == 0 or gt.size == 0:
+        return np.zeros((det.shape[0], gt.shape[0]))
+    lt = np.maximum(det[:, None, :2], gt[None, :, :2])
+    rb = np.minimum(det[:, None, 2:], gt[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    det_area = (det[:, 2] - det[:, 0]) * (det[:, 3] - det[:, 1])
+    gt_area = (gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1])
+    union = det_area[:, None] + gt_area[None, :] - inter
+    union = np.where(iscrowd[None, :].astype(bool), det_area[:, None], union)
+    return inter / np.where(union > 0, union, 1.0)
+
+
+def _evaluate_image(
+    det_boxes: np.ndarray,
+    det_scores: np.ndarray,
+    gt_boxes: np.ndarray,
+    gt_crowd: np.ndarray,
+    gt_area: np.ndarray,
+    iou_thresholds: np.ndarray,
+    area_range: Tuple[float, float],
+    max_det: int,
+) -> Optional[dict]:
+    """Match one (image, class) pair at every IoU threshold
+    (pycocotools ``evaluateImg`` semantics; reference _mean_ap.py:521-649)."""
+    n_gt, n_det = gt_boxes.shape[0], det_boxes.shape[0]
+    if n_gt == 0 and n_det == 0:
+        return None
+
+    # ignored gts: crowd or outside the area range; sorted ignored-last
+    gt_ignore = gt_crowd.astype(bool) | (gt_area < area_range[0]) | (gt_area > area_range[1])
+    gt_order = np.argsort(gt_ignore, kind="stable")
+    gt_boxes = gt_boxes[gt_order]
+    gt_crowd = gt_crowd[gt_order]
+    gt_ignore = gt_ignore[gt_order]
+
+    det_order = np.argsort(-det_scores, kind="stable")[:max_det]
+    det_boxes = det_boxes[det_order]
+    det_scores = det_scores[det_order]
+    n_det = det_boxes.shape[0]
+
+    ious = _np_box_iou(det_boxes, gt_boxes, gt_crowd)
+
+    num_thrs = len(iou_thresholds)
+    det_matches = np.zeros((num_thrs, n_det), dtype=np.int64)  # 1 if matched
+    det_ignore = np.zeros((num_thrs, n_det), dtype=bool)
+    gt_matches = np.zeros((num_thrs, n_gt), dtype=bool)
+
+    for t_idx, t in enumerate(iou_thresholds):
+        for d_idx in range(n_det):
+            best_iou = min(t, 1 - 1e-10)
+            best_g = -1
+            for g_idx in range(n_gt):
+                # non-crowd gts can only be matched once
+                if gt_matches[t_idx, g_idx] and not gt_crowd[g_idx]:
+                    continue
+                # gts are sorted ignored-last: once we have a real match,
+                # stop at the first ignored gt (pycocotools rule)
+                if best_g > -1 and not gt_ignore[best_g] and gt_ignore[g_idx]:
+                    break
+                if ious[d_idx, g_idx] < best_iou:
+                    continue
+                best_iou = ious[d_idx, g_idx]
+                best_g = g_idx
+            if best_g == -1:
+                continue
+            det_matches[t_idx, d_idx] = 1
+            det_ignore[t_idx, d_idx] = gt_ignore[best_g]
+            gt_matches[t_idx, best_g] = True
+
+    # unmatched detections outside the area range are ignored
+    det_area = (det_boxes[:, 2] - det_boxes[:, 0]) * (det_boxes[:, 3] - det_boxes[:, 1])
+    det_out_of_range = (det_area < area_range[0]) | (det_area > area_range[1])
+    det_ignore = det_ignore | ((det_matches == 0) & det_out_of_range[None, :])
+
+    return {
+        "det_scores": det_scores,
+        "det_matches": det_matches,
+        "det_ignore": det_ignore,
+        "num_gt": int((~gt_ignore).sum()),
+    }
+
+
+def _accumulate_class_area(
+    results: List[Optional[dict]], num_thrs: int, rec_thresholds: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Merge per-image matchings of one (class, area, maxdet) cell into
+    precision-at-recall-thresholds and best recall (pycocotools
+    ``accumulate``; reference _mean_ap.py:696-782)."""
+    results = [r for r in results if r is not None]
+    num_rec = len(rec_thresholds)
+    precision = -np.ones((num_thrs, num_rec))
+    recall = -np.ones(num_thrs)
+    if not results:
+        return precision, recall
+
+    scores = np.concatenate([r["det_scores"] for r in results])
+    matches = np.concatenate([r["det_matches"] for r in results], axis=1)
+    ignore = np.concatenate([r["det_ignore"] for r in results], axis=1)
+    npig = sum(r["num_gt"] for r in results)
+    if npig == 0:
+        return precision, recall
+
+    order = np.argsort(-scores, kind="mergesort")
+    matches = matches[:, order]
+    ignore = ignore[:, order]
+
+    tps = np.logical_and(matches, ~ignore)
+    fps = np.logical_and(~matches.astype(bool), ~ignore)
+    tp_sum = np.cumsum(tps, axis=1).astype(np.float64)
+    fp_sum = np.cumsum(fps, axis=1).astype(np.float64)
+
+    for t_idx in range(num_thrs):
+        tp = tp_sum[t_idx]
+        fp = fp_sum[t_idx]
+        nd = len(tp)
+        rc = tp / npig
+        pr = tp / np.maximum(fp + tp, np.finfo(np.float64).eps)
+        recall[t_idx] = rc[-1] if nd else 0.0
+
+        # monotone precision envelope from the right (pycocotools loop)
+        pr = np.maximum.accumulate(pr[::-1])[::-1]
+        inds = np.searchsorted(rc, rec_thresholds, side="left")
+        q = np.zeros(num_rec)
+        valid = inds < nd
+        q[valid] = pr[inds[valid]]
+        precision[t_idx] = q
+    return precision, recall
+
+
+def coco_evaluate(
+    detections: Sequence[Tuple[np.ndarray, np.ndarray, np.ndarray]],
+    groundtruths: Sequence[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]],
+    iou_thresholds: Sequence[float],
+    rec_thresholds: Sequence[float],
+    max_detection_thresholds: Sequence[int],
+    class_ids: Sequence[int],
+    average: str = "macro",
+) -> Dict[str, np.ndarray]:
+    """Full COCO evaluation over per-image detections/groundtruths.
+
+    Args:
+        detections: per image (boxes xyxy (D,4), scores (D,), labels (D,)).
+        groundtruths: per image (boxes xyxy (G,4), labels (G,), iscrowd (G,),
+            area (G,) — zero entries fall back to the box area).
+        class_ids: the class label space to evaluate.
+        average: ``macro`` (per-class then averaged, COCO standard) or
+            ``micro`` (all classes pooled into one).
+    """
+    iou_thrs = np.asarray(iou_thresholds, dtype=np.float64)
+    rec_thrs = np.asarray(rec_thresholds, dtype=np.float64)
+    max_dets = sorted(max_detection_thresholds)
+    num_imgs = len(detections)
+
+    if average == "micro":
+        class_ids = [0]
+
+    area_names = list(_AREA_RANGES)
+    # precision[T, R, K, A, M], recall[T, K, A, M]
+    precision = -np.ones((len(iou_thrs), len(rec_thrs), len(class_ids), len(area_names), len(max_dets)))
+    recall = -np.ones((len(iou_thrs), len(class_ids), len(area_names), len(max_dets)))
+
+    for k_idx, class_id in enumerate(class_ids):
+        per_image_cls = []
+        for img in range(num_imgs):
+            det_boxes, det_scores, det_labels = detections[img]
+            gt_boxes, gt_labels, gt_crowd, gt_area = groundtruths[img]
+            if average == "micro":
+                det_sel = np.ones(det_labels.shape[0], dtype=bool)
+                gt_sel = np.ones(gt_labels.shape[0], dtype=bool)
+            else:
+                det_sel = det_labels == class_id
+                gt_sel = gt_labels == class_id
+            area = gt_area[gt_sel]
+            boxes = gt_boxes[gt_sel]
+            box_area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1]) if boxes.size else area
+            area = np.where(area > 0, area, box_area)
+            per_image_cls.append(
+                (det_boxes[det_sel], det_scores[det_sel], boxes, gt_crowd[gt_sel], area)
+            )
+
+        for a_idx, a_name in enumerate(area_names):
+            a_range = _AREA_RANGES[a_name]
+            for m_idx, max_det in enumerate(max_dets):
+                results = [
+                    _evaluate_image(db, ds, gb, gc, ga, iou_thrs, a_range, max_det)
+                    for (db, ds, gb, gc, ga) in per_image_cls
+                ]
+                prec, rec = _accumulate_class_area(results, len(iou_thrs), rec_thrs)
+                precision[:, :, k_idx, a_idx, m_idx] = prec
+                recall[:, k_idx, a_idx, m_idx] = rec
+
+    def _map(thr_sel=slice(None), area="all", max_det_idx=-1, class_idx=None):
+        a_idx = area_names.index(area)
+        p = precision[thr_sel, :, :, a_idx, max_det_idx]
+        if class_idx is not None:
+            p = p[..., class_idx]
+        p = p[p > -1]
+        return np.float32(p.mean()) if p.size else np.float32(-1.0)
+
+    def _mar(area="all", max_det_idx=-1, class_idx=None):
+        a_idx = area_names.index(area)
+        r = recall[:, :, a_idx, max_det_idx]
+        if class_idx is not None:
+            r = r[..., class_idx]
+        r = r[r > -1]
+        return np.float32(r.mean()) if r.size else np.float32(-1.0)
+
+    thr50 = [i for i, t in enumerate(iou_thrs) if abs(t - 0.5) < 1e-9]
+    thr75 = [i for i, t in enumerate(iou_thrs) if abs(t - 0.75) < 1e-9]
+
+    out: Dict[str, np.ndarray] = {
+        "map": _map(),
+        "map_50": _map(thr_sel=thr50) if thr50 else np.float32(-1.0),
+        "map_75": _map(thr_sel=thr75) if thr75 else np.float32(-1.0),
+        "map_small": _map(area="small"),
+        "map_medium": _map(area="medium"),
+        "map_large": _map(area="large"),
+        "mar_small": _mar(area="small"),
+        "mar_medium": _mar(area="medium"),
+        "mar_large": _mar(area="large"),
+        "classes": np.asarray(class_ids, dtype=np.int32),
+    }
+    for m_idx, max_det in enumerate(max_dets):
+        out[f"mar_{max_det}"] = _mar(max_det_idx=m_idx)
+    out["map_per_class"] = np.asarray([_map(class_idx=k) for k in range(len(class_ids))], np.float32)
+    out["mar_per_class"] = np.asarray(
+        [_mar(class_idx=k, max_det_idx=len(max_dets) - 1) for k in range(len(class_ids))], np.float32
+    )
+    return out

@@ -1,0 +1,29 @@
+"""CompleteIntersectionOverUnion (counterpart of reference ``detection/ciou.py``)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from tpumetrics.detection.iou import IntersectionOverUnion
+from tpumetrics.functional.detection.ciou import _ciou_compute, _ciou_update
+
+
+class CompleteIntersectionOverUnion(IntersectionOverUnion):
+    """CIoU accumulated over batches (reference detection/ciou.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.detection import CompleteIntersectionOverUnion
+        >>> preds = [dict(boxes=jnp.asarray([[296.55, 93.96, 314.97, 152.79]]), labels=jnp.asarray([4]))]
+        >>> target = [dict(boxes=jnp.asarray([[300.00, 100.00, 315.00, 150.00]]), labels=jnp.asarray([4]))]
+        >>> metric = CompleteIntersectionOverUnion()
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()["ciou"]), 4)
+        0.6883
+    """
+
+    _iou_type: str = "ciou"
+    _invalid_val: float = -2.0  # CIoU is bounded in [-2, 1] (reference ciou.py)
+
+    _iou_update_fn: Callable = staticmethod(_ciou_update)
+    _iou_compute_fn: Callable = staticmethod(_ciou_compute)

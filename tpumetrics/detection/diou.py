@@ -1,0 +1,29 @@
+"""DistanceIntersectionOverUnion (counterpart of reference ``detection/diou.py``)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from tpumetrics.detection.iou import IntersectionOverUnion
+from tpumetrics.functional.detection.diou import _diou_compute, _diou_update
+
+
+class DistanceIntersectionOverUnion(IntersectionOverUnion):
+    """DIoU accumulated over batches (reference detection/diou.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.detection import DistanceIntersectionOverUnion
+        >>> preds = [dict(boxes=jnp.asarray([[296.55, 93.96, 314.97, 152.79]]), labels=jnp.asarray([4]))]
+        >>> target = [dict(boxes=jnp.asarray([[300.00, 100.00, 315.00, 150.00]]), labels=jnp.asarray([4]))]
+        >>> metric = DistanceIntersectionOverUnion()
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()["diou"]), 4)
+        0.6883
+    """
+
+    _iou_type: str = "diou"
+    _invalid_val: float = -1.0
+
+    _iou_update_fn: Callable = staticmethod(_diou_update)
+    _iou_compute_fn: Callable = staticmethod(_diou_compute)

@@ -1,0 +1,29 @@
+"""GeneralizedIntersectionOverUnion (counterpart of reference ``detection/giou.py``)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from tpumetrics.detection.iou import IntersectionOverUnion
+from tpumetrics.functional.detection.giou import _giou_compute, _giou_update
+
+
+class GeneralizedIntersectionOverUnion(IntersectionOverUnion):
+    """GIoU accumulated over batches (reference detection/giou.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.detection import GeneralizedIntersectionOverUnion
+        >>> preds = [dict(boxes=jnp.asarray([[296.55, 93.96, 314.97, 152.79]]), labels=jnp.asarray([4]))]
+        >>> target = [dict(boxes=jnp.asarray([[300.00, 100.00, 315.00, 150.00]]), labels=jnp.asarray([4]))]
+        >>> metric = GeneralizedIntersectionOverUnion()
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()["giou"]), 4)
+        0.6895
+    """
+
+    _iou_type: str = "giou"
+    _invalid_val: float = -1.0
+
+    _iou_update_fn: Callable = staticmethod(_giou_update)
+    _iou_compute_fn: Callable = staticmethod(_giou_compute)

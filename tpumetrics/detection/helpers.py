@@ -1,0 +1,74 @@
+"""Detection input validation helpers (counterpart of reference
+``detection/helpers.py``)."""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _fix_empty_tensors(boxes: Array) -> Array:
+    """Empty tensors get a (0, 4) shape so downstream ops are well-defined
+    (reference helpers.py:88-93)."""
+    boxes = jnp.asarray(boxes)
+    if boxes.size == 0 and boxes.ndim == 1:
+        return boxes.reshape(0, 4)
+    return boxes
+
+
+def _input_validator(
+    preds: Sequence[Dict[str, Array]],
+    targets: Sequence[Dict[str, Array]],
+    iou_type: Union[str, tuple] = "bbox",
+    ignore_score: bool = False,
+) -> None:
+    """Validate the list-of-dict detection input format (reference helpers.py:22-85)."""
+    if isinstance(iou_type, str):
+        iou_type = (iou_type,)
+    item_val_name = {"bbox": "boxes", "segm": "masks"}
+    if any(t not in ("bbox", "segm") for t in iou_type):
+        raise Exception(f"IOU type {iou_type} is not supported")
+
+    if not isinstance(preds, Sequence):
+        raise ValueError(f"Expected argument `preds` to be of type Sequence, but got {preds}")
+    if not isinstance(targets, Sequence):
+        raise ValueError(f"Expected argument `target` to be of type Sequence, but got {targets}")
+    if len(preds) != len(targets):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have the same length, but got {len(preds)} and {len(targets)}"
+        )
+
+    for t in iou_type:
+        name = item_val_name[t]
+        if any(name not in p for p in preds):
+            raise ValueError(f"Expected all dicts in `preds` to contain the `{name}` key")
+        if any(name not in tgt for tgt in targets):
+            raise ValueError(f"Expected all dicts in `target` to contain the `{name}` key")
+    if not ignore_score and any("scores" not in p for p in preds):
+        raise ValueError("Expected all dicts in `preds` to contain the `scores` key")
+    if any("labels" not in p for p in preds):
+        raise ValueError("Expected all dicts in `preds` to contain the `labels` key")
+    if any("labels" not in tgt for tgt in targets):
+        raise ValueError("Expected all dicts in `target` to contain the `labels` key")
+
+    for i, item in enumerate(targets):
+        name = item_val_name[iou_type[0]]
+        if item[name].shape[0] != item["labels"].shape[0]:
+            raise ValueError(
+                f"Input '{name}' and labels of sample {i} in targets have a"
+                f" different length (expected {item[name].shape[0]} labels, got {item['labels'].shape[0]})"
+            )
+    if ignore_score:
+        return
+    for i, item in enumerate(preds):
+        name = item_val_name[iou_type[0]]
+        if not (item[name].shape[0] == item["labels"].shape[0] == item["scores"].shape[0]):
+            raise ValueError(
+                f"Input '{name}', labels and scores of sample {i} in predictions have a"
+                f" different length (expected {item[name].shape[0]} labels and scores,"
+                f" got {item['labels'].shape[0]} labels and {item['scores'].shape[0]})"
+            )

@@ -1,0 +1,121 @@
+"""IntersectionOverUnion (counterpart of reference ``detection/iou.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.detection.helpers import _fix_empty_tensors, _input_validator
+from tpumetrics.functional.detection._box_ops import box_convert
+from tpumetrics.functional.detection.iou import _iou_compute, _iou_update
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class IntersectionOverUnion(Metric):
+    """IoU between per-image detection and ground-truth boxes, accumulated
+    over batches (reference detection/iou.py:30-291).
+
+    Args:
+        box_format: input box format.
+        iou_threshold: entries below the threshold count as the invalid value.
+        class_metrics: include per-class scores in the output.
+        respect_labels: only compare boxes of matching labels.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.detection import IntersectionOverUnion
+        >>> preds = [dict(boxes=jnp.asarray([[296.55, 93.96, 314.97, 152.79]]), labels=jnp.asarray([4]))]
+        >>> target = [dict(boxes=jnp.asarray([[300.00, 100.00, 315.00, 150.00]]), labels=jnp.asarray([4]))]
+        >>> metric = IntersectionOverUnion()
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()["iou"]), 4)
+        0.6898
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = True
+
+    _iou_type: str = "iou"
+    _invalid_val: float = -1.0
+
+    groundtruth_labels: List[Array]
+    iou_matrix: List[Array]
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_threshold: Optional[float] = None,
+        class_metrics: bool = False,
+        respect_labels: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_box_formats = ("xyxy", "xywh", "cxcywh")
+        if box_format not in allowed_box_formats:
+            raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+        self.box_format = box_format
+        self.iou_threshold = iou_threshold
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+        if not isinstance(respect_labels, bool):
+            raise ValueError("Expected argument `respect_labels` to be a boolean")
+        self.respect_labels = respect_labels
+
+        self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
+        self.add_state("iou_matrix", default=[], dist_reduce_fx=None)
+
+    _iou_update_fn: Callable = staticmethod(_iou_update)
+    _iou_compute_fn: Callable = staticmethod(_iou_compute)
+
+    def update(self, preds: Sequence[Dict[str, Array]], target: Sequence[Dict[str, Array]]) -> None:
+        """Accumulate per-image IoU matrices (reference detection/iou.py:142-160)."""
+        _input_validator(preds, target, ignore_score=True)
+        for p, t in zip(preds, target):
+            det_boxes = self._get_safe_item_values(p["boxes"])
+            gt_boxes = self._get_safe_item_values(t["boxes"])
+            self.groundtruth_labels.append(jnp.asarray(t["labels"], jnp.int32).ravel())
+
+            iou_matrix = type(self)._iou_update_fn(det_boxes, gt_boxes, self.iou_threshold, self._invalid_val)
+            if self.respect_labels:
+                label_eq = jnp.asarray(p["labels"]).reshape(-1, 1) == jnp.asarray(t["labels"]).reshape(1, -1)
+                iou_matrix = jnp.where(label_eq, iou_matrix, self._invalid_val)
+            self.iou_matrix.append(iou_matrix)
+
+    def _get_safe_item_values(self, boxes: Array) -> Array:
+        boxes = _fix_empty_tensors(jnp.asarray(boxes, jnp.float32))
+        if boxes.size > 0:
+            boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
+        return boxes
+
+    def compute(self) -> Dict[str, Array]:
+        """Mean over valid matrix entries, plus optional per-class means."""
+        valid_entries = [mat[mat != self._invalid_val] for mat in self.iou_matrix]
+        all_entries = (
+            jnp.concatenate([v.ravel() for v in valid_entries])
+            if valid_entries
+            else jnp.zeros((0,), jnp.float32)
+        )
+        score = all_entries.mean() if all_entries.size else jnp.zeros(())
+        results: Dict[str, Array] = {f"{self._iou_type}": score}
+
+        if self.class_metrics:
+            gt_labels = dim_zero_cat(self.groundtruth_labels) if self.groundtruth_labels else jnp.zeros((0,))
+            import numpy as np
+
+            classes = sorted(np.unique(np.asarray(gt_labels)).astype(int).tolist()) if gt_labels.size else []
+            for cl in classes:
+                masked = []
+                for mat, labels in zip(self.iou_matrix, self.groundtruth_labels):
+                    class_mask = jnp.asarray(labels) == cl
+                    sub = mat[:, class_mask]
+                    masked.append(sub[sub != self._invalid_val].ravel())
+                vals = jnp.concatenate(masked) if masked else jnp.zeros((0,))
+                results[f"{self._iou_type}/cl_{cl}"] = vals.mean() if vals.size else jnp.zeros(())
+        return results

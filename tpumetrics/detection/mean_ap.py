@@ -1,0 +1,223 @@
+"""MeanAveragePrecision (counterpart of reference ``detection/mean_ap.py:76``).
+
+The reference keeps 9 ragged list states and shells out to pycocotools on
+CPU at compute (reference mean_ap.py:50-71, :501). Here:
+
+- states are per-image ragged lists (reduce ``None``), merged across
+  replicas with per-image boundaries preserved
+  (:func:`tpumetrics.parallel.merge.merge_metric_states`);
+- compute runs the from-scratch vectorized numpy COCO protocol in
+  :mod:`tpumetrics.detection._coco_eval` — no external backend needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.detection._coco_eval import coco_evaluate
+from tpumetrics.detection.helpers import _fix_empty_tensors, _input_validator
+from tpumetrics.functional.detection._box_ops import box_convert
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class MeanAveragePrecision(Metric):
+    """Mean Average Precision / Recall for object detection (COCO protocol).
+
+    Inputs follow the reference's list-of-dicts format: per image,
+    ``preds`` = {"boxes" (D, 4), "scores" (D,), "labels" (D,)} and
+    ``target`` = {"boxes" (G, 4), "labels" (G,)} with optional ``iscrowd``
+    and ``area`` keys.
+
+    Args:
+        box_format: ``xyxy``/``xywh``/``cxcywh`` input box format.
+        iou_type: only ``bbox`` is supported (``segm`` requires mask inputs).
+        iou_thresholds: IoU thresholds; defaults to COCO's 0.50:0.05:0.95.
+        rec_thresholds: recall thresholds; defaults to COCO's 0:0.01:1.
+        max_detection_thresholds: per-image detection caps (default 1/10/100).
+        class_metrics: include per-class map/mar in the output.
+        average: ``macro`` (COCO standard) or ``micro`` (classes pooled).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.detection import MeanAveragePrecision
+        >>> preds = [dict(boxes=jnp.asarray([[258.0, 41.0, 606.0, 285.0]]),
+        ...               scores=jnp.asarray([0.536]), labels=jnp.asarray([0]))]
+        >>> target = [dict(boxes=jnp.asarray([[214.0, 41.0, 562.0, 285.0]]),
+        ...                labels=jnp.asarray([0]))]
+        >>> metric = MeanAveragePrecision()
+        >>> metric.update(preds, target)
+        >>> result = metric.compute()
+        >>> round(float(result["map"]), 4)
+        0.6
+        >>> round(float(result["map_50"]), 4)
+        1.0
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    detection_boxes: List[Array]
+    detection_scores: List[Array]
+    detection_labels: List[Array]
+    groundtruth_boxes: List[Array]
+    groundtruth_labels: List[Array]
+    groundtruth_crowds: List[Array]
+    groundtruth_area: List[Array]
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_type: str = "bbox",
+        iou_thresholds: Optional[List[float]] = None,
+        rec_thresholds: Optional[List[float]] = None,
+        max_detection_thresholds: Optional[List[int]] = None,
+        class_metrics: bool = False,
+        average: str = "macro",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+
+        allowed_box_formats = ("xyxy", "xywh", "cxcywh")
+        if box_format not in allowed_box_formats:
+            raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+        self.box_format = box_format
+        if iou_type != "bbox":
+            raise ValueError(f"Expected argument `iou_type` to be `bbox` but got {iou_type}")
+        self.iou_type = iou_type
+
+        if iou_thresholds is not None and not isinstance(iou_thresholds, list):
+            raise ValueError(
+                f"Expected argument `iou_thresholds` to either be `None` or a list of floats but got {iou_thresholds}"
+            )
+        self.iou_thresholds = iou_thresholds or np.linspace(0.5, 0.95, 10).tolist()
+
+        if rec_thresholds is not None and not isinstance(rec_thresholds, list):
+            raise ValueError(
+                f"Expected argument `rec_thresholds` to either be `None` or a list of floats but got {rec_thresholds}"
+            )
+        self.rec_thresholds = rec_thresholds or np.linspace(0.0, 1.0, 101).tolist()
+
+        if max_detection_thresholds is not None and not isinstance(max_detection_thresholds, list):
+            raise ValueError(
+                f"Expected argument `max_detection_thresholds` to either be `None` or a list of ints"
+                f" but got {max_detection_thresholds}"
+            )
+        self.max_detection_thresholds = sorted(max_detection_thresholds or [1, 10, 100])
+
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+        if average not in ("macro", "micro"):
+            raise ValueError(f"Expected argument `average` to be one of ('macro', 'micro') but got {average}")
+        self.average = average
+
+        self.add_state("detection_boxes", default=[], dist_reduce_fx=None)
+        self.add_state("detection_scores", default=[], dist_reduce_fx=None)
+        self.add_state("detection_labels", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruth_boxes", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruth_crowds", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruth_area", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: Sequence[Dict[str, Array]], target: Sequence[Dict[str, Array]]) -> None:
+        """Append one batch of per-image detections and ground truths
+        (reference mean_ap.py:366-400)."""
+        _input_validator(preds, target, iou_type=self.iou_type)
+
+        for item in preds:
+            boxes = self._convert_boxes(item["boxes"])
+            self.detection_boxes.append(boxes)
+            self.detection_scores.append(jnp.asarray(item["scores"], jnp.float32).ravel())
+            self.detection_labels.append(jnp.asarray(item["labels"], jnp.int32).ravel())
+
+        for item in target:
+            boxes = self._convert_boxes(item["boxes"])
+            n = boxes.shape[0]
+            self.groundtruth_boxes.append(boxes)
+            self.groundtruth_labels.append(jnp.asarray(item["labels"], jnp.int32).ravel())
+            crowds = item.get("iscrowd")
+            self.groundtruth_crowds.append(
+                jnp.asarray(crowds, jnp.int32).ravel() if crowds is not None else jnp.zeros((n,), jnp.int32)
+            )
+            area = item.get("area")
+            self.groundtruth_area.append(
+                jnp.asarray(area, jnp.float32).ravel() if area is not None else jnp.zeros((n,), jnp.float32)
+            )
+
+    def _convert_boxes(self, boxes: Array) -> Array:
+        boxes = _fix_empty_tensors(jnp.asarray(boxes, jnp.float32))
+        if boxes.size > 0:
+            boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
+        return boxes
+
+    def _get_classes(self) -> List[int]:
+        """All observed class ids (reference mean_ap.py:412-416)."""
+        labels = self.detection_labels + self.groundtruth_labels
+        if labels:
+            cat = np.concatenate([np.asarray(la) for la in labels]) if labels else np.zeros(0)
+            return sorted(np.unique(cat).astype(int).tolist())
+        return []
+
+    def compute(self) -> Dict[str, Array]:
+        """Run the COCO protocol over the accumulated images."""
+        num_imgs = len(self.detection_boxes)
+        detections = [
+            (
+                np.asarray(self.detection_boxes[i]),
+                np.asarray(self.detection_scores[i]),
+                np.asarray(self.detection_labels[i]),
+            )
+            for i in range(num_imgs)
+        ]
+        groundtruths = [
+            (
+                np.asarray(self.groundtruth_boxes[i]),
+                np.asarray(self.groundtruth_labels[i]),
+                np.asarray(self.groundtruth_crowds[i]),
+                np.asarray(self.groundtruth_area[i]),
+            )
+            for i in range(num_imgs)
+        ]
+        class_ids = self._get_classes()
+        result = coco_evaluate(
+            detections,
+            groundtruths,
+            self.iou_thresholds,
+            self.rec_thresholds,
+            self.max_detection_thresholds,
+            class_ids,
+            average=self.average,
+        )
+
+        max_det = self.max_detection_thresholds[-1]
+        out: Dict[str, Array] = {}
+        for key in (
+            "map",
+            "map_50",
+            "map_75",
+            "map_small",
+            "map_medium",
+            "map_large",
+            "mar_small",
+            "mar_medium",
+            "mar_large",
+            *(f"mar_{m}" for m in self.max_detection_thresholds),
+        ):
+            out[key] = jnp.asarray(result[key])
+        if self.class_metrics:
+            out["map_per_class"] = jnp.asarray(result["map_per_class"])
+            out[f"mar_{max_det}_per_class"] = jnp.asarray(result["mar_per_class"])
+        else:
+            out["map_per_class"] = jnp.asarray(-1.0)
+            out[f"mar_{max_det}_per_class"] = jnp.asarray(-1.0)
+        out["classes"] = jnp.asarray(result["classes"])
+        return out

@@ -1,0 +1,20 @@
+"""Detection functional metrics (counterpart of reference
+``functional/detection/__init__.py``)."""
+
+from tpumetrics.functional.detection.ciou import complete_intersection_over_union
+from tpumetrics.functional.detection.diou import distance_intersection_over_union
+from tpumetrics.functional.detection.giou import generalized_intersection_over_union
+from tpumetrics.functional.detection.iou import intersection_over_union
+from tpumetrics.functional.detection.panoptic_qualities import (
+    modified_panoptic_quality,
+    panoptic_quality,
+)
+
+__all__ = [
+    "complete_intersection_over_union",
+    "distance_intersection_over_union",
+    "generalized_intersection_over_union",
+    "intersection_over_union",
+    "modified_panoptic_quality",
+    "panoptic_quality",
+]

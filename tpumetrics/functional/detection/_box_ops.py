@@ -1,0 +1,102 @@
+"""Bounding-box primitives (pure jnp replacements for the torchvision ops
+the reference calls: ``box_convert``, ``box_iou``, ``generalized_box_iou``,
+``distance_box_iou``, ``complete_box_iou``). All are batched matrix forms
+that jit and fuse on TPU."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def box_convert(boxes: Array, in_fmt: str, out_fmt: str) -> Array:
+    """Convert between xyxy / xywh / cxcywh box formats."""
+    if in_fmt == out_fmt:
+        return boxes
+    # normalize to xyxy first
+    if in_fmt == "xywh":
+        x, y, w, h = jnp.split(boxes, 4, axis=-1)
+        xyxy = jnp.concatenate([x, y, x + w, y + h], axis=-1)
+    elif in_fmt == "cxcywh":
+        cx, cy, w, h = jnp.split(boxes, 4, axis=-1)
+        xyxy = jnp.concatenate([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+    elif in_fmt == "xyxy":
+        xyxy = boxes
+    else:
+        raise ValueError(f"Unsupported box format {in_fmt}")
+
+    if out_fmt == "xyxy":
+        return xyxy
+    x1, y1, x2, y2 = jnp.split(xyxy, 4, axis=-1)
+    if out_fmt == "xywh":
+        return jnp.concatenate([x1, y1, x2 - x1, y2 - y1], axis=-1)
+    if out_fmt == "cxcywh":
+        return jnp.concatenate([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], axis=-1)
+    raise ValueError(f"Unsupported box format {out_fmt}")
+
+
+def box_area(boxes: Array) -> Array:
+    """Areas of xyxy boxes."""
+    return (boxes[..., 2] - boxes[..., 0]) * (boxes[..., 3] - boxes[..., 1])
+
+
+def _pairwise_intersection(boxes1: Array, boxes2: Array) -> Array:
+    """(N, M) intersection areas of two xyxy box sets."""
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    return wh[..., 0] * wh[..., 1]
+
+
+def box_iou(boxes1: Array, boxes2: Array) -> Array:
+    """(N, M) IoU matrix of two xyxy box sets."""
+    inter = _pairwise_intersection(boxes1, boxes2)
+    union = box_area(boxes1)[:, None] + box_area(boxes2)[None, :] - inter
+    return inter / jnp.where(union > 0, union, 1.0)
+
+
+def _enclosing_box(boxes1: Array, boxes2: Array) -> Array:
+    """(N, M, 4) smallest boxes enclosing every pair."""
+    lt = jnp.minimum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.maximum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    return jnp.concatenate([lt, rb], axis=-1)
+
+
+def generalized_box_iou(boxes1: Array, boxes2: Array) -> Array:
+    """GIoU (Rezatofighi et al. 2019): IoU - (hull - union)/hull."""
+    inter = _pairwise_intersection(boxes1, boxes2)
+    union = box_area(boxes1)[:, None] + box_area(boxes2)[None, :] - inter
+    iou = inter / jnp.where(union > 0, union, 1.0)
+    hull = _enclosing_box(boxes1, boxes2)
+    hull_area = (hull[..., 2] - hull[..., 0]) * (hull[..., 3] - hull[..., 1])
+    return iou - (hull_area - union) / jnp.where(hull_area > 0, hull_area, 1.0)
+
+
+def _center_distance_sq(boxes1: Array, boxes2: Array) -> Array:
+    c1 = (boxes1[:, None, :2] + boxes1[:, None, 2:]) / 2
+    c2 = (boxes2[None, :, :2] + boxes2[None, :, 2:]) / 2
+    d = c1 - c2
+    return d[..., 0] ** 2 + d[..., 1] ** 2
+
+
+def distance_box_iou(boxes1: Array, boxes2: Array, eps: float = 1e-7) -> Array:
+    """DIoU (Zheng et al. 2020): IoU - center distance² / hull diagonal²."""
+    iou = box_iou(boxes1, boxes2)
+    hull = _enclosing_box(boxes1, boxes2)
+    diag_sq = (hull[..., 2] - hull[..., 0]) ** 2 + (hull[..., 3] - hull[..., 1]) ** 2
+    return iou - _center_distance_sq(boxes1, boxes2) / (diag_sq + eps)
+
+
+def complete_box_iou(boxes1: Array, boxes2: Array, eps: float = 1e-7) -> Array:
+    """CIoU (Zheng et al. 2020): DIoU - alpha * v (aspect-ratio consistency)."""
+    iou = box_iou(boxes1, boxes2)
+    diou = distance_box_iou(boxes1, boxes2, eps)
+    w1 = boxes1[:, None, 2] - boxes1[:, None, 0]
+    h1 = boxes1[:, None, 3] - boxes1[:, None, 1]
+    w2 = boxes2[None, :, 2] - boxes2[None, :, 0]
+    h2 = boxes2[None, :, 3] - boxes2[None, :, 1]
+    v = (4 / (jnp.pi**2)) * (jnp.arctan(w2 / (h2 + eps)) - jnp.arctan(w1 / (h1 + eps))) ** 2
+    alpha = v / (1 - iou + v + eps)
+    return diou - jax.lax.stop_gradient(alpha) * v

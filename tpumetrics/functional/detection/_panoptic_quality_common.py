@@ -1,0 +1,239 @@
+"""Panoptic quality shared machinery (counterpart of reference
+``functional/detection/_panoptic_quality_common.py``).
+
+Segment ("color" = (category_id, instance_id)) areas and pairwise
+intersections come from one ``np.unique`` over encoded color pairs per image
+— the reference builds Python dicts pixel-group by pixel-group
+(reference :50-63). The per-category accumulators (iou_sum, TP, FP, FN) are
+device sum states.
+"""
+
+from __future__ import annotations
+
+from typing import Collection, Dict, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _parse_categories(things: Collection[int], stuffs: Collection[int]) -> Tuple[Set[int], Set[int]]:
+    """Validate and normalize the category sets (reference :65-93)."""
+    things_parsed = set(things)
+    stuffs_parsed = set(stuffs)
+    if not all(isinstance(t, (int, np.integer)) for t in things_parsed):
+        raise TypeError(f"Expected argument `things` to contain `int` categories, but got {things}")
+    if not all(isinstance(s, (int, np.integer)) for s in stuffs_parsed):
+        raise TypeError(f"Expected argument `stuffs` to contain `int` categories, but got {stuffs}")
+    if things_parsed & stuffs_parsed:
+        raise ValueError(
+            f"Expected arguments `things` and `stuffs` to have distinct keys, but got {things} and {stuffs}"
+        )
+    if not (things_parsed | stuffs_parsed):
+        raise ValueError("At least one of `things` and `stuffs` must be non-empty.")
+    return things_parsed, stuffs_parsed
+
+
+def _validate_inputs(preds: Array, target: Array) -> None:
+    """Shape validation (reference :96-121)."""
+    if preds.shape != target.shape:
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have the same shape, got {preds.shape} and {target.shape}"
+        )
+    if preds.ndim < 3:
+        raise ValueError(
+            "Expected argument `preds` to have at least one spatial dimension (B, *spatial_dims, 2),"
+            f" got {preds.shape}"
+        )
+    if preds.shape[-1] != 2:
+        raise ValueError(
+            f"Expected argument `preds` to have exactly 2 channels in the last dimension, got {preds.shape}"
+        )
+
+
+def _get_void_color(things: Set[int], stuffs: Set[int]) -> Tuple[int, int]:
+    """A color guaranteed unused (reference :124-136)."""
+    unused_category_id = 1 + max([0, *list(things), *list(stuffs)])
+    return unused_category_id, 0
+
+
+def _get_category_id_to_continuous_id(things: Set[int], stuffs: Set[int]) -> Dict[int, int]:
+    """Map category ids to 0..K-1, things first (reference :139-157)."""
+    thing_id_to_continuous_id = {thing_id: idx for idx, thing_id in enumerate(sorted(things))}
+    stuff_id_to_continuous_id = {
+        stuff_id: idx + len(things) for idx, stuff_id in enumerate(sorted(stuffs))
+    }
+    cat_id_to_continuous_id = {}
+    cat_id_to_continuous_id.update(thing_id_to_continuous_id)
+    cat_id_to_continuous_id.update(stuff_id_to_continuous_id)
+    return cat_id_to_continuous_id
+
+
+def _prepocess_inputs(
+    things: Set[int],
+    stuffs: Set[int],
+    inputs: Array,
+    void_color: Tuple[int, int],
+    allow_unknown_category: bool,
+) -> np.ndarray:
+    """Flatten spatial dims, zero stuff instance ids, map unknown categories
+    to void (reference :175-211). Returns a host (B, P, 2) int array."""
+    out = np.asarray(jax.device_get(inputs)).copy()
+    out = out.reshape(out.shape[0], -1, 2)
+    cats = out[:, :, 0]
+    mask_stuffs = np.isin(cats, list(stuffs))
+    mask_things = np.isin(cats, list(things))
+    out[:, :, 1] = np.where(mask_stuffs, 0, out[:, :, 1])
+    known = mask_things | mask_stuffs
+    if not allow_unknown_category and not known.all():
+        raise ValueError(f"Unknown categories found: {np.unique(cats[~known])}")
+    out[~known] = np.asarray(void_color)
+    return out
+
+
+def _panoptic_quality_update_sample(
+    flatten_preds: np.ndarray,
+    flatten_target: np.ndarray,
+    cat_id_to_continuous_id: Dict[int, int],
+    void_color: Tuple[int, int],
+    stuffs_modified_metric: Optional[Set[int]] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Per-sample segment matching with IoU > 0.5 (reference :312-394),
+    with all segment/intersection areas from one np.unique pass.
+
+    For the modified PQ variant, stuff categories accumulate IoU at
+    threshold 0 and ``true_positives`` counts target segments instead.
+    """
+    stuffs_modified_metric = stuffs_modified_metric or set()
+    num_categories = len(cat_id_to_continuous_id)
+    iou_sum = np.zeros(num_categories, dtype=np.float64)
+    true_positives = np.zeros(num_categories, dtype=np.int64)
+    false_positives = np.zeros(num_categories, dtype=np.int64)
+    false_negatives = np.zeros(num_categories, dtype=np.int64)
+
+    # encode (cat, inst) pairs into single int64 keys for fast unique counting
+    def _encode(x: np.ndarray) -> np.ndarray:
+        return x[:, 0].astype(np.int64) * 2_000_003 + x[:, 1].astype(np.int64)
+
+    pred_keys = _encode(flatten_preds)
+    target_keys = _encode(flatten_target)
+    void_key = int(void_color[0]) * 2_000_003 + int(void_color[1])
+
+    pred_unique, pred_inv, pred_counts = np.unique(pred_keys, return_inverse=True, return_counts=True)
+    tgt_unique, tgt_inv, tgt_counts = np.unique(target_keys, return_inverse=True, return_counts=True)
+    pred_areas = dict(zip(pred_unique.tolist(), pred_counts.tolist()))
+    target_areas = dict(zip(tgt_unique.tolist(), tgt_counts.tolist()))
+    # first pixel of each unique segment recovers its (cat, inst) color
+    pred_color_of = {
+        int(k): tuple(flatten_preds[np.argmax(pred_inv == i)]) for i, k in enumerate(pred_unique)
+    }
+    tgt_color_of = {
+        int(k): tuple(flatten_target[np.argmax(tgt_inv == i)]) for i, k in enumerate(tgt_unique)
+    }
+
+    pair_keys = pred_inv.astype(np.int64) * len(tgt_unique) + tgt_inv
+    pair_unique, pair_counts = np.unique(pair_keys, return_counts=True)
+    intersections: Dict[Tuple[int, int], int] = {}
+    for pk, cnt in zip(pair_unique.tolist(), pair_counts.tolist()):
+        pi, ti = divmod(pk, len(tgt_unique))
+        intersections[(int(pred_unique[pi]), int(tgt_unique[ti]))] = cnt
+
+    pred_segment_matched: Set[int] = set()
+    target_segment_matched: Set[int] = set()
+    for (pred_key, tgt_key), intersection in intersections.items():
+        if tgt_key == void_key:
+            continue
+        pred_cat = pred_color_of[pred_key][0]
+        tgt_cat = tgt_color_of[tgt_key][0]
+        if pred_cat != tgt_cat or pred_key == void_key:
+            continue
+        pred_void_area = intersections.get((pred_key, void_key), 0)
+        void_target_area = intersections.get((void_key, tgt_key), 0)
+        union = pred_areas[pred_key] - pred_void_area + target_areas[tgt_key] - void_target_area - intersection
+        iou = intersection / union
+        continuous_id = cat_id_to_continuous_id[int(tgt_cat)]
+        if int(tgt_cat) not in stuffs_modified_metric and iou > 0.5:
+            pred_segment_matched.add(pred_key)
+            target_segment_matched.add(tgt_key)
+            iou_sum[continuous_id] += iou
+            true_positives[continuous_id] += 1
+        elif int(tgt_cat) in stuffs_modified_metric and iou > 0:
+            iou_sum[continuous_id] += iou
+
+    # false negatives: unmatched target segments not mostly void in the preds
+    for tgt_key in set(target_areas) - target_segment_matched:
+        if tgt_key == void_key:
+            continue
+        cat_id = int(tgt_color_of[tgt_key][0])
+        if cat_id in stuffs_modified_metric:
+            continue
+        void_target_area = intersections.get((void_key, tgt_key), 0)
+        if void_target_area / target_areas[tgt_key] <= 0.5:
+            false_negatives[cat_id_to_continuous_id[cat_id]] += 1
+
+    # false positives: unmatched predicted segments not mostly void in the target
+    for pred_key in set(pred_areas) - pred_segment_matched:
+        if pred_key == void_key:
+            continue
+        cat_id = int(pred_color_of[pred_key][0])
+        if cat_id in stuffs_modified_metric:
+            continue
+        pred_void_area = intersections.get((pred_key, void_key), 0)
+        if pred_void_area / pred_areas[pred_key] <= 0.5:
+            false_positives[cat_id_to_continuous_id[cat_id]] += 1
+
+    # modified variant: stuff "TP" counts target segments
+    for tgt_key in target_areas:
+        cat_id = int(tgt_color_of[tgt_key][0])
+        if cat_id in stuffs_modified_metric:
+            true_positives[cat_id_to_continuous_id[cat_id]] += 1
+
+    return iou_sum, true_positives, false_positives, false_negatives
+
+
+def _panoptic_quality_update(
+    flatten_preds: np.ndarray,
+    flatten_target: np.ndarray,
+    cat_id_to_continuous_id: Dict[int, int],
+    void_color: Tuple[int, int],
+    modified_metric_stuffs: Optional[Set[int]] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Per-batch accumulation — samples are matched independently (reference :397-444)."""
+    num_categories = len(cat_id_to_continuous_id)
+    iou_sum = np.zeros(num_categories, dtype=np.float64)
+    true_positives = np.zeros(num_categories, dtype=np.int64)
+    false_positives = np.zeros(num_categories, dtype=np.int64)
+    false_negatives = np.zeros(num_categories, dtype=np.int64)
+
+    for flatten_preds_single, flatten_target_single in zip(flatten_preds, flatten_target):
+        result = _panoptic_quality_update_sample(
+            flatten_preds_single,
+            flatten_target_single,
+            cat_id_to_continuous_id,
+            void_color,
+            stuffs_modified_metric=modified_metric_stuffs,
+        )
+        iou_sum += result[0]
+        true_positives += result[1]
+        false_positives += result[2]
+        false_negatives += result[3]
+
+    return (
+        jnp.asarray(iou_sum, jnp.float32),
+        jnp.asarray(true_positives, jnp.float32),
+        jnp.asarray(false_positives, jnp.float32),
+        jnp.asarray(false_negatives, jnp.float32),
+    )
+
+
+def _panoptic_quality_compute(
+    iou_sum: Array, true_positives: Array, false_positives: Array, false_negatives: Array
+) -> Array:
+    """PQ = mean over categories of IoU / (TP + FP/2 + FN/2) (reference :447-469)."""
+    denominator = true_positives + 0.5 * false_positives + 0.5 * false_negatives
+    per_class = iou_sum / jnp.where(denominator > 0, denominator, 1.0)
+    valid = denominator > 0
+    n_valid = jnp.sum(valid)
+    return jnp.where(n_valid > 0, jnp.sum(jnp.where(valid, per_class, 0.0)) / jnp.maximum(n_valid, 1), 0.0)

@@ -1,0 +1,48 @@
+"""CIoU (counterpart of reference ``functional/detection/ciou.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.detection._box_ops import complete_box_iou
+
+Array = jax.Array
+
+
+def _ciou_update(
+    preds: Array, target: Array, iou_threshold: Optional[float], replacement_val: float = 0
+) -> Array:
+    iou = complete_box_iou(preds, target)
+    if iou_threshold is not None:
+        iou = jnp.where(iou < iou_threshold, replacement_val, iou)
+    return iou
+
+
+def _ciou_compute(iou: Array, aggregate: bool = True) -> Array:
+    if not aggregate:
+        return iou
+    return jnp.diagonal(iou).mean() if iou.size > 0 else jnp.zeros(())
+
+
+def complete_intersection_over_union(
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> Array:
+    """Complete IoU between two xyxy box sets (reference ciou.py:41-118).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.detection import complete_intersection_over_union
+        >>> preds = jnp.asarray([[296.55, 93.96, 314.97, 152.79]])
+        >>> target = jnp.asarray([[300.00, 100.00, 315.00, 150.00]])
+        >>> round(float(complete_intersection_over_union(preds, target)), 4)
+        0.6883
+    """
+    iou = _ciou_update(preds, target, iou_threshold, replacement_val)
+    return _ciou_compute(iou, aggregate)

@@ -1,0 +1,48 @@
+"""DIoU (counterpart of reference ``functional/detection/diou.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.detection._box_ops import distance_box_iou
+
+Array = jax.Array
+
+
+def _diou_update(
+    preds: Array, target: Array, iou_threshold: Optional[float], replacement_val: float = 0
+) -> Array:
+    iou = distance_box_iou(preds, target)
+    if iou_threshold is not None:
+        iou = jnp.where(iou < iou_threshold, replacement_val, iou)
+    return iou
+
+
+def _diou_compute(iou: Array, aggregate: bool = True) -> Array:
+    if not aggregate:
+        return iou
+    return jnp.diagonal(iou).mean() if iou.size > 0 else jnp.zeros(())
+
+
+def distance_intersection_over_union(
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> Array:
+    """Distance IoU between two xyxy box sets (reference diou.py:41-118).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.detection import distance_intersection_over_union
+        >>> preds = jnp.asarray([[296.55, 93.96, 314.97, 152.79]])
+        >>> target = jnp.asarray([[300.00, 100.00, 315.00, 150.00]])
+        >>> round(float(distance_intersection_over_union(preds, target)), 4)
+        0.6883
+    """
+    iou = _diou_update(preds, target, iou_threshold, replacement_val)
+    return _diou_compute(iou, aggregate)

@@ -11,6 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpumetrics.functional.text.helper import _token_ids
 from tpumetrics.utils.imports import _NLTK_AVAILABLE
 
 Array = jax.Array
@@ -95,8 +96,6 @@ def _compute_metrics(hits_or_lcs: int, pred_len: int, target_len: int) -> Dict[s
 
 def _lcs_table(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> np.ndarray:
     """Full LCS DP table, numpy-vectorized over rows (reference rouge.py:95-116)."""
-    from tpumetrics.functional.text.helper import _token_ids
-
     m, n = len(pred_tokens), len(target_tokens)
     table = np.zeros((n + 1, m + 1), dtype=np.int64)
     pred_ids, target_ids = _token_ids(pred_tokens, target_tokens)

@@ -436,6 +436,15 @@ class Metric(ABC):
                 if isinstance(current_val, MaskedBuffer):
                     object.__setattr__(self, attr, buffer_all_gather(current_val, backend, group=group))
                 elif isinstance(current_val, list):
+                    if reduction_fn is None:
+                        # ragged per-item list (e.g. per-image detection
+                        # states): item boundaries are part of the state, so
+                        # each item is gathered separately (reference uses
+                        # all_gather_object, detection/mean_ap.py:994-1024)
+                        object.__setattr__(
+                            self, attr, _gather_ragged_list(backend, current_val, group, self._dtype)
+                        )
+                        continue
                     # a locally-empty list still participates in the collective
                     # (zero-length contribution) so ranks never diverge on the
                     # number of collectives issued — a hang otherwise
@@ -719,6 +728,9 @@ class Metric(ABC):
                 # valid counts are handled by the mask, not by shape surgery
                 out[attr] = buffer_all_gather(val, backend)
             elif isinstance(val, list):
+                if reduction_fn is None:
+                    out[attr] = _gather_ragged_list(backend, val, None, self._dtype)
+                    continue
                 # empty lists still issue the collective — see _sync_dist
                 catted = dim_zero_cat(val) if val else jnp.zeros((0,), dtype=self._dtype)
                 merged = dim_zero_cat(backend.all_gather(catted))
@@ -1039,6 +1051,39 @@ class Metric(ABC):
 
 def _neg(x: Array) -> Array:
     return -jnp.abs(x)
+
+
+def _gather_ragged_list(
+    backend: DistributedBackend, items: List[Array], group: Optional[Any], fallback_dtype: Any
+) -> List[Array]:
+    """Gather a reduce-None ragged list across ranks, preserving item
+    boundaries: rank counts are exchanged first, then every item slot is one
+    collective (ranks with fewer items contribute empty arrays that are
+    dropped on receipt). Eager backends only — in-trace ragged gathers need
+    the fixed-capacity MaskedBuffer states instead."""
+    from tpumetrics.utils.data import _is_tracer
+
+    local_count = jnp.asarray(len(items), jnp.int32)
+    if any(_is_tracer(v) for v in items) or _is_tracer(local_count):
+        raise TPUMetricsUserError(
+            "Ragged (dist_reduce_fx=None) list states cannot be gathered inside jit;"
+            " declare a fixed capacity for the state (set_state_capacity) to sync in-trace."
+        )
+    counts = [int(c) for c in backend.all_gather(local_count, group=group)]
+    max_n = max(counts) if counts else 0
+    template = items[0] if items else None
+    per_rank: List[List[Array]] = [[] for _ in counts]
+    for i in range(max_n):
+        if i < len(items):
+            value = items[i]
+        else:
+            shape = (0,) + (tuple(template.shape[1:]) if template is not None else ())
+            value = jnp.zeros(shape, template.dtype if template is not None else fallback_dtype)
+        gathered = backend.all_gather(value, group=group)
+        for rank, g in enumerate(gathered):
+            if i < counts[rank]:
+                per_rank[rank].append(g)
+    return [v for rank_items in per_rank for v in rank_items]
 
 
 def _reduce_fn_to_op(reduction_fn: Any) -> Optional[str]:

@@ -44,7 +44,13 @@ def merge_metric_states(
             continue
         if isinstance(vals[0], list):
             flat = [v for sub in vals for v in sub]
-            out[name] = [dim_zero_cat(flat)] if flat else []
+            if reduction_fn is None:
+                # reduce-None ragged lists (e.g. per-image detection states)
+                # keep their per-item boundaries, like the reference's
+                # object gather (reference detection/mean_ap.py:994-1024)
+                out[name] = flat
+            else:
+                out[name] = [dim_zero_cat(flat)] if flat else []
             continue
         if reduction_fn is dim_zero_cat:
             out[name] = dim_zero_cat([jnp.atleast_1d(v) for v in vals])

@@ -59,6 +59,11 @@ class ClasswiseWrapper(WrapperMetric):
             postfix = self._postfix or ""
         if self.labels is None:
             return {f"{prefix}{i}{postfix}": val for i, val in enumerate(x)}
+        if len(self.labels) != len(x):
+            raise ValueError(
+                f"Expected argument `labels` to have {len(x)} entries (one per class in the wrapped"
+                f" metric's output), but got {len(self.labels)}"
+            )
         return {f"{prefix}{lab}{postfix}": val for lab, val in zip(self.labels, x)}
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:

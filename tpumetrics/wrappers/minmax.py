@@ -45,8 +45,8 @@ class MinMaxMetric(WrapperMetric):
                 f"Expected base metric to be an instance of `tpumetrics.Metric` but received {base_metric}"
             )
         self._base_metric = base_metric
-        self.add_state("min_val", default=jnp.asarray(jnp.inf), dist_reduce_fx="min")
-        self.add_state("max_val", default=jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+        self.add_state("min_val", default=jnp.asarray(jnp.inf), dist_reduce_fx="min", persistent=True)
+        self.add_state("max_val", default=jnp.asarray(-jnp.inf), dist_reduce_fx="max", persistent=True)
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         self._base_metric.update(*args, **kwargs)
