@@ -1,0 +1,245 @@
+"""Audio domain vs independent numpy implementations (counterpart of
+reference ``tests/unittests/audio/``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics.audio import (
+    ComplexScaleInvariantSignalNoiseRatio,
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+    SourceAggregatedSignalDistortionRatio,
+)
+from tpumetrics.functional.audio import (
+    complex_scale_invariant_signal_noise_ratio,
+    permutation_invariant_training,
+    pit_permutate,
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_distortion_ratio,
+    signal_noise_ratio,
+    source_aggregated_signal_distortion_ratio,
+)
+
+_rng = np.random.default_rng(23)
+TARGET = _rng.standard_normal((4, 4000)).astype(np.float32)
+PREDS = (TARGET + 0.3 * _rng.standard_normal((4, 4000))).astype(np.float32)
+
+
+# -------------------------------------------------------- numpy references
+
+
+def _np_snr(preds, target, zero_mean=False):
+    if zero_mean:
+        target = target - target.mean(-1, keepdims=True)
+        preds = preds - preds.mean(-1, keepdims=True)
+    noise = target - preds
+    return 10 * np.log10((target**2).sum(-1) / (noise**2).sum(-1))
+
+
+def _np_si_sdr(preds, target, zero_mean=False):
+    if zero_mean:
+        target = target - target.mean(-1, keepdims=True)
+        preds = preds - preds.mean(-1, keepdims=True)
+    alpha = (preds * target).sum(-1, keepdims=True) / (target**2).sum(-1, keepdims=True)
+    t = alpha * target
+    return 10 * np.log10((t**2).sum(-1) / ((t - preds) ** 2).sum(-1))
+
+
+def _np_sdr(preds, target, filter_length=512):
+    """Float64 BSS-eval SDR via explicit Toeplitz solve (independent of the
+    jnp implementation)."""
+    out = []
+    for p, t in zip(np.atleast_2d(preds).astype(np.float64), np.atleast_2d(target).astype(np.float64)):
+        t = t / np.linalg.norm(t)
+        p = p / np.linalg.norm(p)
+        n_fft = 2 ** int(np.ceil(np.log2(p.shape[-1] + t.shape[-1] - 1)))
+        t_fft = np.fft.rfft(t, n=n_fft)
+        r_full = np.fft.irfft(np.abs(t_fft) ** 2, n=n_fft)[:filter_length]
+        b = np.fft.irfft(np.conj(t_fft) * np.fft.rfft(p, n=n_fft), n=n_fft)[:filter_length]
+        from scipy.linalg import solve_toeplitz
+
+        sol = solve_toeplitz(r_full, b)
+        coh = b @ sol
+        out.append(10 * np.log10(coh / (1 - coh)))
+    return np.asarray(out)
+
+
+def test_snr_vs_numpy():
+    got = np.asarray(signal_noise_ratio(jnp.asarray(PREDS), jnp.asarray(TARGET)))
+    assert np.allclose(got, _np_snr(PREDS, TARGET), atol=1e-3)
+    got = np.asarray(signal_noise_ratio(jnp.asarray(PREDS), jnp.asarray(TARGET), zero_mean=True))
+    assert np.allclose(got, _np_snr(PREDS, TARGET, zero_mean=True), atol=1e-3)
+
+
+def test_si_sdr_and_si_snr_vs_numpy():
+    got = np.asarray(scale_invariant_signal_distortion_ratio(jnp.asarray(PREDS), jnp.asarray(TARGET)))
+    assert np.allclose(got, _np_si_sdr(PREDS, TARGET), atol=1e-3)
+    got = np.asarray(scale_invariant_signal_noise_ratio(jnp.asarray(PREDS), jnp.asarray(TARGET)))
+    assert np.allclose(got, _np_si_sdr(PREDS, TARGET, zero_mean=True), atol=1e-3)
+    # known documented value
+    t = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+    p = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+    assert np.isclose(float(scale_invariant_signal_distortion_ratio(p, t)), 18.4030, atol=5e-3)
+    assert np.isclose(float(signal_noise_ratio(p, t)), 16.1805, atol=5e-3)
+    assert np.isclose(float(scale_invariant_signal_noise_ratio(p, t)), 15.0918, atol=5e-3)
+
+
+def test_sdr_vs_float64_toeplitz():
+    got = np.asarray(signal_distortion_ratio(jnp.asarray(PREDS), jnp.asarray(TARGET)))
+    ref = _np_sdr(PREDS, TARGET)
+    # fp32 solve with diagonal loading vs float64 exact solve
+    assert np.allclose(got, ref, atol=0.3), (got, ref)
+    # identical signals → very high SDR
+    clean = np.asarray(signal_distortion_ratio(jnp.asarray(TARGET), jnp.asarray(TARGET)))
+    assert (clean > 30).all()
+
+
+def test_sa_sdr():
+    preds = jnp.asarray(PREDS.reshape(2, 2, -1))
+    target = jnp.asarray(TARGET.reshape(2, 2, -1))
+    got = np.asarray(source_aggregated_signal_distortion_ratio(preds, target))
+    assert got.shape == (2,)
+    assert np.isfinite(got).all()
+    # scale invariance: scaling preds leaves the SI variant unchanged
+    scaled = np.asarray(source_aggregated_signal_distortion_ratio(preds * 2.0, target))
+    not_scaled = np.asarray(source_aggregated_signal_distortion_ratio(preds, target))
+    si = np.asarray(
+        source_aggregated_signal_distortion_ratio(preds * 2.0, target, scale_invariant=False)
+    )
+    assert not np.allclose(si, not_scaled, atol=0.5)
+
+
+def test_complex_si_snr():
+    g = _rng.standard_normal((1, 129, 20, 2)).astype(np.float32)
+    noisy = g + 0.05 * _rng.standard_normal((1, 129, 20, 2)).astype(np.float32)
+    got = float(jnp.squeeze(complex_scale_invariant_signal_noise_ratio(jnp.asarray(noisy), jnp.asarray(g))))
+    # equals SI-SDR on the flattened real/imag stream
+    ref = _np_si_sdr(noisy.reshape(1, -1), g.reshape(1, -1))[0]
+    assert np.isclose(got, ref, atol=1e-3)
+    # complex input path
+    comp = g[..., 0] + 1j * g[..., 1]
+    comp_noisy = noisy[..., 0] + 1j * noisy[..., 1]
+    got_c = float(jnp.squeeze(complex_scale_invariant_signal_noise_ratio(jnp.asarray(comp_noisy), jnp.asarray(comp))))
+    assert np.isclose(got_c, got, atol=1e-4)
+    with pytest.raises(RuntimeError, match="frequency"):
+        complex_scale_invariant_signal_noise_ratio(jnp.zeros((8,)), jnp.zeros((8,)))
+
+
+# ------------------------------------------------------------------- PIT
+
+
+def test_pit_recovers_permutation():
+    target = _rng.standard_normal((3, 2, 500)).astype(np.float32)
+    preds = target[:, ::-1, :] + 0.05 * _rng.standard_normal((3, 2, 500)).astype(np.float32)
+    best_metric, best_perm = permutation_invariant_training(
+        jnp.asarray(preds), jnp.asarray(target), scale_invariant_signal_distortion_ratio
+    )
+    assert np.asarray(best_perm).tolist() == [[1, 0]] * 3
+    permuted = pit_permutate(jnp.asarray(preds), best_perm)
+    direct = np.asarray(
+        scale_invariant_signal_distortion_ratio(permuted, jnp.asarray(target)).mean(-1)
+    )
+    assert np.allclose(np.asarray(best_metric), direct, atol=1e-4)
+
+
+def test_pit_three_speakers_uses_lsa():
+    target = _rng.standard_normal((2, 3, 300)).astype(np.float32)
+    perm = [2, 0, 1]
+    preds = target[:, perm, :] + 0.05 * _rng.standard_normal((2, 3, 300)).astype(np.float32)
+    best_metric, best_perm = permutation_invariant_training(
+        jnp.asarray(preds), jnp.asarray(target), scale_invariant_signal_distortion_ratio
+    )
+    # preds[:, best_perm] must realign to target: best_perm inverts `perm`
+    realigned = np.asarray(pit_permutate(jnp.asarray(preds), best_perm))
+    si = _np_si_sdr(realigned.reshape(-1, 300), target.reshape(-1, 300), zero_mean=True)
+    assert (si > 20).all()
+
+
+def test_pit_permutation_wise_mode():
+    target = _rng.standard_normal((2, 2, 200)).astype(np.float32)
+    preds = target[:, ::-1, :].copy()
+
+    def sa_metric(p, t):
+        return source_aggregated_signal_distortion_ratio(p, t)
+
+    best_metric, best_perm = permutation_invariant_training(
+        jnp.asarray(preds), jnp.asarray(target), sa_metric, mode="permutation-wise"
+    )
+    assert np.asarray(best_perm).tolist() == [[1, 0]] * 2
+
+
+def test_pit_validation():
+    with pytest.raises(ValueError, match="eval_func"):
+        permutation_invariant_training(
+            jnp.zeros((1, 2, 10)), jnp.zeros((1, 2, 10)), signal_noise_ratio, eval_func="bad"
+        )
+    with pytest.raises(ValueError, match="mode"):
+        permutation_invariant_training(
+            jnp.zeros((1, 2, 10)), jnp.zeros((1, 2, 10)), signal_noise_ratio, mode="bad"
+        )
+    with pytest.raises(RuntimeError, match="same shape"):
+        permutation_invariant_training(
+            jnp.zeros((1, 2, 10)), jnp.zeros((1, 3, 10)), signal_noise_ratio
+        )
+
+
+# ------------------------------------------------------------ class APIs
+
+
+@pytest.mark.parametrize(
+    "metric_class, fn",
+    [
+        (SignalNoiseRatio, signal_noise_ratio),
+        (ScaleInvariantSignalNoiseRatio, scale_invariant_signal_noise_ratio),
+        (ScaleInvariantSignalDistortionRatio, scale_invariant_signal_distortion_ratio),
+        (SignalDistortionRatio, signal_distortion_ratio),
+    ],
+    ids=["snr", "si_snr", "si_sdr", "sdr"],
+)
+def test_audio_class_streaming(metric_class, fn):
+    m = metric_class()
+    for i in range(2):
+        m.update(jnp.asarray(PREDS[2 * i : 2 * i + 2]), jnp.asarray(TARGET[2 * i : 2 * i + 2]))
+    got = float(m.compute())
+    ref = float(np.asarray(fn(jnp.asarray(PREDS), jnp.asarray(TARGET))).mean())
+    assert np.isclose(got, ref, atol=1e-4)
+
+
+def test_pit_class():
+    target = _rng.standard_normal((4, 2, 300)).astype(np.float32)
+    preds = target[:, ::-1, :] + 0.05 * _rng.standard_normal((4, 2, 300)).astype(np.float32)
+    m = PermutationInvariantTraining(scale_invariant_signal_distortion_ratio, eval_func="max")
+    m.update(jnp.asarray(preds[:2]), jnp.asarray(target[:2]))
+    m.update(jnp.asarray(preds[2:]), jnp.asarray(target[2:]))
+    assert float(m.compute()) > 20
+
+
+def test_sa_sdr_class_and_complex_class():
+    preds = jnp.asarray(PREDS.reshape(2, 2, -1))
+    target = jnp.asarray(TARGET.reshape(2, 2, -1))
+    m = SourceAggregatedSignalDistortionRatio()
+    m.update(preds, target)
+    assert np.isfinite(float(m.compute()))
+
+    g = jnp.asarray(_rng.standard_normal((1, 65, 10, 2)), dtype=jnp.float32)
+    m2 = ComplexScaleInvariantSignalNoiseRatio()
+    m2.update(g, g)
+    assert float(m2.compute()) > 50
+
+
+def test_audio_jit_path():
+    m = ScaleInvariantSignalDistortionRatio()
+    state = m.init_state()
+    step = jax.jit(m.functional_update)
+    state = step(state, jnp.asarray(PREDS), jnp.asarray(TARGET))
+    got = float(jax.jit(m.functional_compute)(state))
+    ref = float(_np_si_sdr(PREDS, TARGET).mean())
+    assert np.isclose(got, ref, atol=1e-3)

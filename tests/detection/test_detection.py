@@ -338,3 +338,24 @@ def test_panoptic_quality_validation():
         pq.update(jnp.zeros((1, 4, 2), jnp.int32), jnp.zeros((1, 5, 2), jnp.int32))
     with pytest.raises(ValueError, match="Unknown categories"):
         pq.update(jnp.full((1, 4, 2), 9, jnp.int32), jnp.zeros((1, 4, 2), jnp.int32))
+
+
+def test_panoptic_quality_large_instance_ids():
+    """COCO-panoptic RGB-encoded instance ids (up to 16.7M) must not collide."""
+    big = 2_000_003  # the previous multiplicative encoding collided here
+    preds = jnp.asarray([[[0, big], [0, big], [1, 0], [1, 0]]])
+    assert np.isclose(float(panoptic_quality(preds, preds, things={0, 1}, stuffs=set())), 1.0)
+    # different categories with colliding encodings must not match
+    p2 = jnp.asarray([[[0, big], [0, big], [0, big], [0, big]]])
+    t2 = jnp.asarray([[[1, 0], [1, 0], [1, 0], [1, 0]]])
+    assert float(panoptic_quality(p2, t2, things={0, 1}, stuffs=set())) == 0.0
+
+
+def test_map_micro_reports_observed_classes():
+    boxes = _random_boxes(2)
+    preds = [dict(boxes=jnp.asarray(boxes), scores=jnp.asarray([0.9, 0.8]), labels=jnp.asarray([7, 3]))]
+    target = [dict(boxes=jnp.asarray(boxes), labels=jnp.asarray([7, 3]))]
+    m = MeanAveragePrecision(average="micro")
+    m.update(preds, target)
+    out = m.compute()
+    assert sorted(np.asarray(out["classes"]).tolist()) == [3, 7]

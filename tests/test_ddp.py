@@ -218,17 +218,17 @@ def test_gather_ragged_list_preserves_boundaries():
     peer = [3 * jnp.ones((1, 4))]
 
     class _FakeTwoRankBackend:
+        """Two collectives: the per-item lengths vector, then the cat data."""
+
         def __init__(self):
             self.step = 0
 
         def all_gather(self, v, group=None):
-            if self.step == 0:
-                self.step += 1
-                return [v, jnp.asarray(len(peer), jnp.int32)]
-            idx = self.step - 1
             self.step += 1
-            peer_v = peer[idx] if idx < len(peer) else jnp.zeros((0, 4), peer[0].dtype)
-            return [v, peer_v]
+            if self.step == 1:
+                return [v, jnp.asarray([p.shape[0] for p in peer], jnp.int32)]
+            assert self.step == 2, "ragged gather must use exactly two collectives"
+            return [v, jnp.concatenate(peer)]
 
     merged = _gather_ragged_list(_FakeTwoRankBackend(), local, None, jnp.float32)
     assert len(merged) == 3

@@ -1,0 +1,33 @@
+"""Audio metric domain (counterpart of reference ``audio/__init__.py``).
+
+PESQ/STOI/SRMR wrap host-side reference implementations and raise an
+informative ``ModuleNotFoundError`` at construction when their backing
+package is absent (mirroring the reference's gating)."""
+
+from tpumetrics.audio.pesq import PerceptualEvaluationSpeechQuality
+from tpumetrics.audio.pit import PermutationInvariantTraining
+from tpumetrics.audio.sdr import (
+    ScaleInvariantSignalDistortionRatio,
+    SignalDistortionRatio,
+    SourceAggregatedSignalDistortionRatio,
+)
+from tpumetrics.audio.snr import (
+    ComplexScaleInvariantSignalNoiseRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalNoiseRatio,
+)
+from tpumetrics.audio.srmr import SpeechReverberationModulationEnergyRatio
+from tpumetrics.audio.stoi import ShortTimeObjectiveIntelligibility
+
+__all__ = [
+    "ComplexScaleInvariantSignalNoiseRatio",
+    "PerceptualEvaluationSpeechQuality",
+    "PermutationInvariantTraining",
+    "ScaleInvariantSignalDistortionRatio",
+    "ScaleInvariantSignalNoiseRatio",
+    "ShortTimeObjectiveIntelligibility",
+    "SignalDistortionRatio",
+    "SignalNoiseRatio",
+    "SourceAggregatedSignalDistortionRatio",
+    "SpeechReverberationModulationEnergyRatio",
+]

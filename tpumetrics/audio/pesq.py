@@ -1,0 +1,54 @@
+"""PerceptualEvaluationSpeechQuality (counterpart of reference ``audio/pesq.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.audio.pesq import perceptual_evaluation_speech_quality
+from tpumetrics.metric import Metric
+from tpumetrics.utils.imports import _PESQ_AVAILABLE
+
+Array = jax.Array
+
+
+class PerceptualEvaluationSpeechQuality(Metric):
+    """Mean PESQ over samples — a documented host-side (CPU) metric, like the
+    reference (reference audio/pesq.py)."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = -0.5
+    plot_upper_bound: float = 4.5
+
+    def __init__(
+        self, fs: int, mode: str, n_processes: int = 1, **kwargs: Any
+    ) -> None:
+        super().__init__(**kwargs)
+        if not _PESQ_AVAILABLE:
+            raise ModuleNotFoundError(
+                "PerceptualEvaluationSpeechQuality metric requires that `pesq` is installed."
+                " Either install as `pip install torchmetrics[audio]` or `pip install pesq`."
+            )
+        if fs not in (8000, 16000):
+            raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+        self.fs = fs
+        if mode not in ("wb", "nb"):
+            raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+        self.mode = mode
+        self.n_processes = n_processes
+        self.add_state("sum_pesq", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        pesq_batch = perceptual_evaluation_speech_quality(
+            preds, target, self.fs, self.mode, n_processes=self.n_processes
+        )
+        self.sum_pesq = self.sum_pesq + pesq_batch.sum()
+        self.total = self.total + pesq_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_pesq / self.total

@@ -1,0 +1,63 @@
+"""PermutationInvariantTraining (counterpart of reference ``audio/pit.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.audio.pit import permutation_invariant_training
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class PermutationInvariantTraining(Metric):
+    """Mean best-permutation metric over batches
+    (reference audio/pit.py PermutationInvariantTraining).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.audio import PermutationInvariantTraining
+        >>> from tpumetrics.functional.audio import scale_invariant_signal_distortion_ratio
+        >>> target = jax.random.normal(jax.random.PRNGKey(1), (3, 2, 100))
+        >>> preds = target[:, ::-1, :] + 0.1 * jax.random.normal(jax.random.PRNGKey(2), (3, 2, 100))
+        >>> pit = PermutationInvariantTraining(scale_invariant_signal_distortion_ratio, eval_func="max")
+        >>> float(pit(preds, target)) > 15
+        True
+    """
+
+    is_differentiable: bool = True
+    higher_is_better: bool = True
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        metric_func: Callable,
+        mode: str = "speaker-wise",
+        eval_func: str = "max",
+        **kwargs: Any,
+    ) -> None:
+        base_kwargs: dict = {k: kwargs.pop(k) for k in list(kwargs) if k in Metric._BASE_KWARGS}
+        super().__init__(**base_kwargs)
+        if eval_func not in ("max", "min"):
+            raise ValueError(f'eval_func can only be "max" or "min" but got {eval_func}')
+        if mode not in ("speaker-wise", "permutation-wise"):
+            raise ValueError(f'mode can only be "speaker-wise" or "permutation-wise" but got {mode}')
+        self.metric_func = metric_func
+        self.mode = mode
+        self.eval_func = eval_func
+        self.kwargs = kwargs
+        self.add_state("sum_pit_metric", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        pit_metric = permutation_invariant_training(
+            preds, target, self.metric_func, self.mode, self.eval_func, **self.kwargs
+        )[0]
+        self.sum_pit_metric = self.sum_pit_metric + pit_metric.sum()
+        self.total = self.total + pit_metric.size
+
+    def compute(self) -> Array:
+        return self.sum_pit_metric / self.total

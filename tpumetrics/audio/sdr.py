@@ -1,0 +1,132 @@
+"""SDR metrics (counterpart of reference ``audio/sdr.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.audio.sdr import (
+    scale_invariant_signal_distortion_ratio,
+    signal_distortion_ratio,
+    source_aggregated_signal_distortion_ratio,
+)
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class SignalDistortionRatio(Metric):
+    """Mean SDR over samples (reference audio/sdr.py SignalDistortionRatio).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.audio import SignalDistortionRatio
+        >>> g = jax.random.normal(jax.random.PRNGKey(1), (2, 8000))
+        >>> preds = g + 0.1 * jax.random.normal(jax.random.PRNGKey(2), (2, 8000))
+        >>> sdr = SignalDistortionRatio()
+        >>> float(sdr(preds, g)) > 15
+        True
+    """
+
+    is_differentiable: bool = True
+    higher_is_better: bool = True
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        use_cg_iter: Optional[int] = None,
+        filter_length: int = 512,
+        zero_mean: bool = False,
+        load_diag: Optional[float] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.use_cg_iter = use_cg_iter
+        self.filter_length = filter_length
+        self.zero_mean = zero_mean
+        self.load_diag = load_diag
+        self.add_state("sum_sdr", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        sdr_batch = signal_distortion_ratio(
+            preds, target, self.use_cg_iter, self.filter_length, self.zero_mean, self.load_diag
+        )
+        self.sum_sdr = self.sum_sdr + sdr_batch.sum()
+        self.total = self.total + sdr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_sdr / self.total
+
+
+class ScaleInvariantSignalDistortionRatio(Metric):
+    """Mean SI-SDR over samples (reference audio/sdr.py ScaleInvariantSignalDistortionRatio).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.audio import ScaleInvariantSignalDistortionRatio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> si_sdr = ScaleInvariantSignalDistortionRatio()
+        >>> round(float(si_sdr(preds, target)), 4)
+        18.403
+    """
+
+    is_differentiable: bool = True
+    higher_is_better: bool = True
+    full_state_update: bool = False
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(zero_mean, bool):
+            raise ValueError(f"Expected argument `zero_mean` to be a bool, but got {zero_mean}")
+        self.zero_mean = zero_mean
+        self.add_state("sum_si_sdr", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        si_sdr_batch = scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
+        self.sum_si_sdr = self.sum_si_sdr + si_sdr_batch.sum()
+        self.total = self.total + si_sdr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_si_sdr / self.total
+
+
+class SourceAggregatedSignalDistortionRatio(Metric):
+    """Mean SA-SDR over samples (reference audio/sdr.py SourceAggregatedSignalDistortionRatio).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.audio import SourceAggregatedSignalDistortionRatio
+        >>> g = jax.random.normal(jax.random.PRNGKey(1), (2, 2, 8000))
+        >>> preds = g + 0.1 * jax.random.normal(jax.random.PRNGKey(2), (2, 2, 8000))
+        >>> sa_sdr = SourceAggregatedSignalDistortionRatio()
+        >>> float(sa_sdr(preds, g)) > 15
+        True
+    """
+
+    is_differentiable: bool = True
+    higher_is_better: bool = True
+    full_state_update: bool = False
+
+    def __init__(self, scale_invariant: bool = True, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(scale_invariant, bool):
+            raise ValueError(f"Expected argument `scale_invariant` to be a bool, but got {scale_invariant}")
+        self.scale_invariant = scale_invariant
+        if not isinstance(zero_mean, bool):
+            raise ValueError(f"Expected argument `zero_mean` to be a bool, but got {zero_mean}")
+        self.zero_mean = zero_mean
+        self.add_state("msdr_sum", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("num", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        msdr = source_aggregated_signal_distortion_ratio(preds, target, self.scale_invariant, self.zero_mean)
+        self.msdr_sum = self.msdr_sum + msdr.sum()
+        self.num = self.num + msdr.size
+
+    def compute(self) -> Array:
+        return self.msdr_sum / self.num

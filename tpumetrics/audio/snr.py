@@ -1,0 +1,105 @@
+"""SNR metrics (counterpart of reference ``audio/snr.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.audio.snr import (
+    complex_scale_invariant_signal_noise_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_noise_ratio,
+)
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class SignalNoiseRatio(Metric):
+    """Mean SNR over samples (reference audio/snr.py SignalNoiseRatio).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.audio import SignalNoiseRatio
+        >>> snr = SignalNoiseRatio()
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(snr(preds, target)), 4)
+        16.1802
+    """
+
+    is_differentiable: bool = True
+    higher_is_better: bool = True
+    full_state_update: bool = False
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+        self.add_state("sum_snr", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        snr_batch = signal_noise_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
+        self.sum_snr = self.sum_snr + snr_batch.sum()
+        self.total = self.total + snr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_snr / self.total
+
+
+class ScaleInvariantSignalNoiseRatio(Metric):
+    """Mean SI-SNR over samples (reference audio/snr.py ScaleInvariantSignalNoiseRatio).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.audio import ScaleInvariantSignalNoiseRatio
+        >>> si_snr = ScaleInvariantSignalNoiseRatio()
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(si_snr(preds, target)), 4)
+        15.0918
+    """
+
+    is_differentiable: bool = True
+    higher_is_better: bool = True
+    full_state_update: bool = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_si_snr", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        si_snr_batch = scale_invariant_signal_noise_ratio(preds=preds, target=target)
+        self.sum_si_snr = self.sum_si_snr + si_snr_batch.sum()
+        self.total = self.total + si_snr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_si_snr / self.total
+
+
+class ComplexScaleInvariantSignalNoiseRatio(Metric):
+    """Mean C-SI-SNR over complex spectrogram samples
+    (reference audio/snr.py ComplexScaleInvariantSignalNoiseRatio)."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = True
+    full_state_update: bool = False
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(zero_mean, bool):
+            raise ValueError(f"Expected argument `zero_mean` to be a bool, but got {zero_mean}")
+        self.zero_mean = zero_mean
+        self.add_state("ci_snr_sum", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("num", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        value = complex_scale_invariant_signal_noise_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
+        self.ci_snr_sum = self.ci_snr_sum + value.sum()
+        self.num = self.num + value.size
+
+    def compute(self) -> Array:
+        return self.ci_snr_sum / self.num

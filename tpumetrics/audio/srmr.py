@@ -1,0 +1,48 @@
+"""SpeechReverberationModulationEnergyRatio (counterpart of reference ``audio/srmr.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.audio.srmr import speech_reverberation_modulation_energy_ratio
+from tpumetrics.metric import Metric
+from tpumetrics.utils.imports import _SRMRPY_AVAILABLE
+
+Array = jax.Array
+
+
+class SpeechReverberationModulationEnergyRatio(Metric):
+    """Mean SRMR over samples — gated on the host-side ``srmrpy`` package
+    (reference audio/srmr.py gates on ``gammatone``/``torchaudio``)."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, fs: int, **kwargs: Any) -> None:
+        self._srmr_kwargs = {
+            k: kwargs.pop(k)
+            for k in ("n_cochlear_filters", "low_freq", "min_cf", "max_cf", "norm", "fast")
+            if k in kwargs
+        }
+        super().__init__(**kwargs)
+        if not _SRMRPY_AVAILABLE:
+            raise ModuleNotFoundError(
+                "SpeechReverberationModulationEnergyRatio requires that `srmrpy` is installed."
+                " Install it with `pip install srmrpy`."
+            )
+        self.fs = fs
+        self.add_state("sum_srmr", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array) -> None:
+        srmr_batch = speech_reverberation_modulation_energy_ratio(preds, self.fs, **self._srmr_kwargs)
+        self.sum_srmr = self.sum_srmr + srmr_batch.sum()
+        self.total = self.total + srmr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_srmr / self.total

@@ -1,0 +1,45 @@
+"""ShortTimeObjectiveIntelligibility (counterpart of reference ``audio/stoi.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.audio.stoi import short_time_objective_intelligibility
+from tpumetrics.metric import Metric
+from tpumetrics.utils.imports import _PYSTOI_AVAILABLE
+
+Array = jax.Array
+
+
+class ShortTimeObjectiveIntelligibility(Metric):
+    """Mean STOI over samples — a documented host-side (CPU) metric, like the
+    reference (reference audio/stoi.py)."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not _PYSTOI_AVAILABLE:
+            raise ModuleNotFoundError(
+                "STOI metric requires that `pystoi` is installed."
+                " Either install as `pip install torchmetrics[audio]` or `pip install pystoi`."
+            )
+        self.fs = fs
+        self.extended = extended
+        self.add_state("sum_stoi", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        stoi_batch = short_time_objective_intelligibility(preds, target, self.fs, self.extended)
+        self.sum_stoi = self.sum_stoi + stoi_batch.sum()
+        self.total = self.total + stoi_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_stoi / self.total

@@ -122,11 +122,15 @@ def _evaluate_image(
 
 
 def _accumulate_class_area(
-    results: List[Optional[dict]], num_thrs: int, rec_thresholds: np.ndarray
+    results: List[Optional[dict]], num_thrs: int, rec_thresholds: np.ndarray, max_det: Optional[int] = None
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Merge per-image matchings of one (class, area, maxdet) cell into
     precision-at-recall-thresholds and best recall (pycocotools
-    ``accumulate``; reference _mean_ap.py:696-782)."""
+    ``accumulate``; reference _mean_ap.py:696-782).
+
+    ``max_det`` slices each image's (already score-sorted) detections, so the
+    greedy matching runs once per (class, area) at the largest cap and is
+    reused for the smaller ones — pycocotools does the same."""
     results = [r for r in results if r is not None]
     num_rec = len(rec_thresholds)
     precision = -np.ones((num_thrs, num_rec))
@@ -134,9 +138,10 @@ def _accumulate_class_area(
     if not results:
         return precision, recall
 
-    scores = np.concatenate([r["det_scores"] for r in results])
-    matches = np.concatenate([r["det_matches"] for r in results], axis=1)
-    ignore = np.concatenate([r["det_ignore"] for r in results], axis=1)
+    m = max_det if max_det is not None else max(r["det_scores"].shape[0] for r in results)
+    scores = np.concatenate([r["det_scores"][:m] for r in results])
+    matches = np.concatenate([r["det_matches"][:, :m] for r in results], axis=1)
+    ignore = np.concatenate([r["det_ignore"][:, :m] for r in results], axis=1)
     npig = sum(r["num_gt"] for r in results)
     if npig == 0:
         return precision, recall
@@ -192,15 +197,16 @@ def coco_evaluate(
     max_dets = sorted(max_detection_thresholds)
     num_imgs = len(detections)
 
-    if average == "micro":
-        class_ids = [0]
+    # micro pools all classes into one evaluation bucket, but the reported
+    # `classes` stay the observed ids
+    eval_class_ids: Sequence[int] = [0] if average == "micro" else class_ids
 
     area_names = list(_AREA_RANGES)
     # precision[T, R, K, A, M], recall[T, K, A, M]
-    precision = -np.ones((len(iou_thrs), len(rec_thrs), len(class_ids), len(area_names), len(max_dets)))
-    recall = -np.ones((len(iou_thrs), len(class_ids), len(area_names), len(max_dets)))
+    precision = -np.ones((len(iou_thrs), len(rec_thrs), len(eval_class_ids), len(area_names), len(max_dets)))
+    recall = -np.ones((len(iou_thrs), len(eval_class_ids), len(area_names), len(max_dets)))
 
-    for k_idx, class_id in enumerate(class_ids):
+    for k_idx, class_id in enumerate(eval_class_ids):
         per_image_cls = []
         for img in range(num_imgs):
             det_boxes, det_scores, det_labels = detections[img]
@@ -221,12 +227,13 @@ def coco_evaluate(
 
         for a_idx, a_name in enumerate(area_names):
             a_range = _AREA_RANGES[a_name]
+            # match once at the largest cap; smaller caps reuse by slicing
+            results = [
+                _evaluate_image(db, ds, gb, gc, ga, iou_thrs, a_range, max_dets[-1])
+                for (db, ds, gb, gc, ga) in per_image_cls
+            ]
             for m_idx, max_det in enumerate(max_dets):
-                results = [
-                    _evaluate_image(db, ds, gb, gc, ga, iou_thrs, a_range, max_det)
-                    for (db, ds, gb, gc, ga) in per_image_cls
-                ]
-                prec, rec = _accumulate_class_area(results, len(iou_thrs), rec_thrs)
+                prec, rec = _accumulate_class_area(results, len(iou_thrs), rec_thrs, max_det)
                 precision[:, :, k_idx, a_idx, m_idx] = prec
                 recall[:, k_idx, a_idx, m_idx] = rec
 
@@ -263,8 +270,8 @@ def coco_evaluate(
     }
     for m_idx, max_det in enumerate(max_dets):
         out[f"mar_{max_det}"] = _mar(max_det_idx=m_idx)
-    out["map_per_class"] = np.asarray([_map(class_idx=k) for k in range(len(class_ids))], np.float32)
+    out["map_per_class"] = np.asarray([_map(class_idx=k) for k in range(len(eval_class_ids))], np.float32)
     out["mar_per_class"] = np.asarray(
-        [_mar(class_idx=k, max_det_idx=len(max_dets) - 1) for k in range(len(class_ids))], np.float32
+        [_mar(class_idx=k, max_det_idx=len(max_dets) - 1) for k in range(len(eval_class_ids))], np.float32
     )
     return out

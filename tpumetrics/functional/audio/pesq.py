@@ -1,0 +1,68 @@
+"""PESQ wrapper (counterpart of reference ``functional/audio/pesq.py``).
+
+PESQ is an ITU-T P.862 C implementation with data-dependent host-side
+processing — it stays a documented CPU escape hatch on TPU, exactly like the
+reference (reference pesq.py:38, which also moves tensors to host)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.utils.checks import _check_same_shape
+from tpumetrics.utils.imports import _PESQ_AVAILABLE
+
+Array = jax.Array
+
+__doctest_skip__ = ["perceptual_evaluation_speech_quality"]
+
+
+def perceptual_evaluation_speech_quality(
+    preds: Array,
+    target: Array,
+    fs: int,
+    mode: str,
+    keep_same_device: bool = False,
+    n_processes: int = 1,
+) -> Array:
+    """PESQ (requires the ``pesq`` package; host-side C implementation).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.functional.audio import perceptual_evaluation_speech_quality
+        >>> g = jax.random.normal(jax.random.PRNGKey(1), (8000,))
+        >>> perceptual_evaluation_speech_quality(g, g, 8000, 'nb')  # doctest: +SKIP
+        Array(4.5, dtype=float32)
+    """
+    if not _PESQ_AVAILABLE:
+        raise ModuleNotFoundError(
+            "PESQ metric requires that pesq is installed. Either install as `pip install torchmetrics[audio]`"
+            " or `pip install pesq`."
+        )
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if mode not in ("wb", "nb"):
+        raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+    _check_same_shape(preds, target)
+
+    import pesq as pesq_backend
+
+    preds_np = np.asarray(jax.device_get(preds), np.float32)
+    target_np = np.asarray(jax.device_get(target), np.float32)
+    if preds_np.ndim == 1:
+        pesq_val = np.asarray(pesq_backend.pesq(fs, target_np, preds_np, mode))
+    else:
+        preds_np = preds_np.reshape(-1, preds_np.shape[-1])
+        target_np = target_np.reshape(-1, target_np.shape[-1])
+        if n_processes == 1:
+            pesq_val = np.asarray(
+                [pesq_backend.pesq(fs, t, p, mode) for t, p in zip(target_np, preds_np)]
+            ).reshape(preds.shape[:-1])
+        else:
+            pesq_val = np.asarray(
+                pesq_backend.pesq_batch(fs, target_np, preds_np, mode, n_processor=n_processes)
+            ).reshape(preds.shape[:-1])
+    return jnp.asarray(pesq_val, jnp.float32)

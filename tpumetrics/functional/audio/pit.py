@@ -1,0 +1,141 @@
+"""Permutation invariant training (counterpart of reference
+``functional/audio/pit.py``)."""
+
+from __future__ import annotations
+
+from itertools import permutations
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+_ps_dict: Dict[int, np.ndarray] = {}  # host-level cache: jnp arrays created
+# under jit would be tracers and must never be cached across traces
+
+
+def _gen_permutations(spk_num: int) -> Array:
+    """All speaker permutations, cached per count (reference pit.py:30-39)."""
+    if spk_num not in _ps_dict:
+        _ps_dict[spk_num] = np.asarray(list(permutations(range(spk_num))), np.int32)
+    return jnp.asarray(_ps_dict[spk_num])
+
+
+def _find_best_perm_by_linear_sum_assignment(
+    metric_mtx: Array, eval_func: str
+) -> Tuple[Array, Array]:
+    """Hungarian assignment on host (reference pit.py:42-64) — for large
+    speaker counts where the exhaustive O(spk!) search explodes."""
+    from scipy.optimize import linear_sum_assignment
+
+    mmtx = np.asarray(metric_mtx)
+    best_perm = np.asarray([linear_sum_assignment(pwm, eval_func == "max")[1] for pwm in mmtx])
+    best_perm_j = jnp.asarray(best_perm)
+    best_metric = jnp.take_along_axis(metric_mtx, best_perm_j[:, :, None], axis=2).mean(axis=(-1, -2))
+    return best_metric, best_perm_j
+
+
+def _find_best_perm_by_exhaustive_method(metric_mtx: Array, eval_func: str) -> Tuple[Array, Array]:
+    """Exhaustive search over all permutations — static-shape gathers, fully
+    jit-safe (reference pit.py:67-104)."""
+    batch_size, spk_num = metric_mtx.shape[:2]
+    ps = _gen_permutations(spk_num)  # (perm_num, spk)
+    perm_num = ps.shape[0]
+    bps = jnp.broadcast_to(ps.T[None, ...], (batch_size, spk_num, perm_num))
+    metric_of_ps_details = jnp.take_along_axis(metric_mtx, bps, axis=2)
+    metric_of_ps = metric_of_ps_details.mean(axis=1)  # (batch, perm_num)
+
+    if eval_func == "max":
+        best_indexes = jnp.argmax(metric_of_ps, axis=1)
+        best_metric = jnp.max(metric_of_ps, axis=1)
+    else:
+        best_indexes = jnp.argmin(metric_of_ps, axis=1)
+        best_metric = jnp.min(metric_of_ps, axis=1)
+    best_perm = ps[best_indexes, :]
+    return best_metric, best_perm
+
+
+def permutation_invariant_training(
+    preds: Array,
+    target: Array,
+    metric_func: Callable,
+    mode: str = "speaker-wise",
+    eval_func: str = "max",
+    **kwargs: Any,
+) -> Tuple[Array, Array]:
+    """Evaluate a metric under the best speaker permutation
+    (reference pit.py:107-227).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.functional.audio import permutation_invariant_training
+        >>> from tpumetrics.functional.audio import scale_invariant_signal_distortion_ratio
+        >>> target = jax.random.normal(jax.random.PRNGKey(1), (2, 2, 100))
+        >>> preds = target[:, ::-1, :] + 0.1 * jax.random.normal(jax.random.PRNGKey(2), (2, 2, 100))
+        >>> best_metric, best_perm = permutation_invariant_training(
+        ...     preds, target, scale_invariant_signal_distortion_ratio)
+        >>> best_perm.tolist()  # swapped speakers are recovered
+        [[1, 0], [1, 0]]
+    """
+    if preds.shape[0:2] != target.shape[0:2]:
+        raise RuntimeError(
+            "Predictions and targets are expected to have the same shape at the batch and speaker dimensions"
+        )
+    if eval_func not in ["max", "min"]:
+        raise ValueError(f'eval_func can only be "max" or "min" but got {eval_func}')
+    if mode not in ["speaker-wise", "permutation-wise"]:
+        raise ValueError(f'mode can only be "speaker-wise" or "permutation-wise" but got {mode}')
+    if target.ndim < 2:
+        raise ValueError(f"Inputs must be of shape [batch, spk, ...], got {target.shape} and {preds.shape} instead")
+
+    eval_op = jnp.max if eval_func == "max" else jnp.min
+    batch_size, spk_num = target.shape[0:2]
+
+    if mode == "permutation-wise":
+        perms = _gen_permutations(spk_num)
+        perm_num = perms.shape[0]
+        metric_of_ps = jnp.stack(
+            [metric_func(preds[:, perm], target, **kwargs) for perm in np.asarray(perms)], axis=1
+        )
+        if eval_func == "max":
+            best_indexes = jnp.argmax(metric_of_ps, axis=1)
+            best_metric = jnp.max(metric_of_ps, axis=1)
+        else:
+            best_indexes = jnp.argmin(metric_of_ps, axis=1)
+            best_metric = jnp.min(metric_of_ps, axis=1)
+        best_perm = perms[best_indexes, :]
+        return best_metric, best_perm
+
+    # speaker-wise: build the (batch, spk, spk) metric matrix
+    metric_mtx = jnp.stack(
+        [
+            jnp.stack([metric_func(preds[:, p, ...], target[:, t, ...], **kwargs) for p in range(spk_num)], axis=1)
+            for t in range(spk_num)
+        ],
+        axis=1,
+    )  # (batch, target_spk, pred_spk)
+
+    from tpumetrics.utils.data import _is_tracer
+
+    if spk_num < 3:
+        return _find_best_perm_by_exhaustive_method(metric_mtx, eval_func)
+    if _is_tracer(metric_mtx):
+        # Hungarian assignment is a host algorithm; under jit fall back to
+        # the (jit-safe, static-shape) exhaustive search while it is tractable
+        if spk_num <= 6:
+            return _find_best_perm_by_exhaustive_method(metric_mtx, eval_func)
+        raise ValueError(
+            "permutation_invariant_training with more than 6 speakers uses a host-side Hungarian"
+            " assignment and cannot run under jit; call it eagerly."
+        )
+    return _find_best_perm_by_linear_sum_assignment(metric_mtx, eval_func)
+
+
+def pit_permutate(preds: Array, perm: Array) -> Array:
+    """Reorder predictions by the best permutation from
+    :func:`permutation_invariant_training` (reference pit.py:225-247)."""
+    return jnp.take_along_axis(
+        preds, perm.reshape(perm.shape + (1,) * (preds.ndim - 2)).astype(jnp.int32), axis=1
+    )

@@ -1,0 +1,80 @@
+"""Signal-to-noise ratios (counterpart of reference ``functional/audio/snr.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.audio.sdr import scale_invariant_signal_distortion_ratio
+from tpumetrics.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SNR = 10 log10(P_target / P_noise) per sample (reference snr.py:22-63).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.audio import signal_noise_ratio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(signal_noise_ratio(preds, target)), 4)
+        16.1802
+    """
+    _check_same_shape(preds, target)
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    eps = jnp.finfo(preds.dtype).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    noise = target - preds
+    snr_value = (jnp.sum(target**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps)
+    return 10 * jnp.log10(snr_value)
+
+
+def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
+    """SI-SNR: SI-SDR with zero-mean inputs (reference snr.py:66-95).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.functional.audio import scale_invariant_signal_noise_ratio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(scale_invariant_signal_noise_ratio(preds, target)), 4)
+        15.0918
+    """
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=True)
+
+
+def complex_scale_invariant_signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """C-SI-SNR over complex (or stacked real/imag) spectrograms
+    (reference snr.py:98-132).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.functional.audio import complex_scale_invariant_signal_noise_ratio
+        >>> g = jax.random.normal(jax.random.PRNGKey(1), (1, 257, 100, 2))
+        >>> preds = g + 0.05 * jax.random.normal(jax.random.PRNGKey(2), (1, 257, 100, 2))
+        >>> float(complex_scale_invariant_signal_noise_ratio(preds, g)[0]) > 20
+        True
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if jnp.iscomplexobj(preds):
+        preds = jnp.stack([preds.real, preds.imag], axis=-1)
+    if jnp.iscomplexobj(target):
+        target = jnp.stack([target.real, target.imag], axis=-1)
+
+    if (preds.ndim < 3 or preds.shape[-1] != 2) or (target.ndim < 3 or target.shape[-1] != 2):
+        raise RuntimeError(
+            "Predictions and targets are expected to have the shape (..., frequency, time, 2),"
+            f" but got {preds.shape} and {target.shape}."
+        )
+
+    preds = preds.reshape(*preds.shape[:-3], -1)
+    target = target.reshape(*target.shape[:-3], -1)
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=zero_mean)

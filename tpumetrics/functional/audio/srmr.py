@@ -1,0 +1,62 @@
+"""SRMR wrapper (counterpart of reference ``functional/audio/srmr.py``).
+
+The reference re-implements gammatone/modulation filterbanks in torch but
+still imports filter coefficients from the ``gammatone`` package
+(reference srmr.py:39-50); without that package the metric is gated, so this
+is a documented host-side escape hatch calling ``srmrpy`` when available."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.utils.imports import _SRMRPY_AVAILABLE
+
+Array = jax.Array
+
+__doctest_skip__ = ["speech_reverberation_modulation_energy_ratio"]
+
+
+def speech_reverberation_modulation_energy_ratio(
+    preds: Array,
+    fs: int,
+    n_cochlear_filters: int = 23,
+    low_freq: float = 125,
+    min_cf: float = 4,
+    max_cf: float = 128,
+    norm: bool = False,
+    fast: bool = False,
+) -> Array:
+    """SRMR (requires the ``srmrpy`` package; host-side implementation).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.functional.audio import speech_reverberation_modulation_energy_ratio
+        >>> g = jax.random.normal(jax.random.PRNGKey(1), (8000,))
+        >>> speech_reverberation_modulation_energy_ratio(g, 8000).shape  # doctest: +SKIP
+        ()
+    """
+    if not _SRMRPY_AVAILABLE:
+        raise ModuleNotFoundError(
+            "speech_reverberation_modulation_energy_ratio requires that `srmrpy` is installed."
+            " Install it with `pip install srmrpy`."
+        )
+    import srmrpy
+
+    preds_np = np.asarray(jax.device_get(preds), np.float32)
+    if preds_np.ndim == 1:
+        val = srmrpy.srmr(
+            preds_np, fs, n_cochlear_filters=n_cochlear_filters, low_freq=low_freq,
+            min_cf=min_cf, max_cf=max_cf, norm=norm, fast=fast,
+        )[0]
+        return jnp.asarray(val, jnp.float32)
+    flat = preds_np.reshape(-1, preds_np.shape[-1])
+    vals = [
+        srmrpy.srmr(
+            p, fs, n_cochlear_filters=n_cochlear_filters, low_freq=low_freq,
+            min_cf=min_cf, max_cf=max_cf, norm=norm, fast=fast,
+        )[0]
+        for p in flat
+    ]
+    return jnp.asarray(np.asarray(vals).reshape(preds.shape[:-1]), jnp.float32)

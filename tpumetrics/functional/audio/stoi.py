@@ -1,0 +1,51 @@
+"""STOI wrapper (counterpart of reference ``functional/audio/stoi.py``).
+
+Like the reference (stoi.py:38), STOI runs the ``pystoi`` reference
+implementation on host — a documented CPU escape hatch."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.utils.checks import _check_same_shape
+from tpumetrics.utils.imports import _PYSTOI_AVAILABLE
+
+Array = jax.Array
+
+__doctest_skip__ = ["short_time_objective_intelligibility"]
+
+
+def short_time_objective_intelligibility(
+    preds: Array, target: Array, fs: int, extended: bool = False, keep_same_device: bool = False
+) -> Array:
+    """STOI (requires the ``pystoi`` package; host-side implementation).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.functional.audio import short_time_objective_intelligibility
+        >>> g = jax.random.normal(jax.random.PRNGKey(1), (8000,))
+        >>> float(short_time_objective_intelligibility(g, g, 8000)) > 0.99  # doctest: +SKIP
+        True
+    """
+    if not _PYSTOI_AVAILABLE:
+        raise ModuleNotFoundError(
+            "ShortTimeObjectiveIntelligibility metric requires that `pystoi` is installed."
+            " Either install as `pip install torchmetrics[audio]` or `pip install pystoi`."
+        )
+    _check_same_shape(preds, target)
+
+    import pystoi
+
+    preds_np = np.asarray(jax.device_get(preds), np.float32)
+    target_np = np.asarray(jax.device_get(target), np.float32)
+    if preds_np.ndim == 1:
+        stoi_val = np.asarray(pystoi.stoi(target_np, preds_np, fs, extended=extended))
+    else:
+        preds_np = preds_np.reshape(-1, preds_np.shape[-1])
+        target_np = target_np.reshape(-1, target_np.shape[-1])
+        stoi_val = np.asarray(
+            [pystoi.stoi(t, p, fs, extended=extended) for t, p in zip(target_np, preds_np)]
+        ).reshape(preds.shape[:-1])
+    return jnp.asarray(stoi_val, jnp.float32)

@@ -113,25 +113,26 @@ def _panoptic_quality_update_sample(
     false_positives = np.zeros(num_categories, dtype=np.int64)
     false_negatives = np.zeros(num_categories, dtype=np.int64)
 
-    # encode (cat, inst) pairs into single int64 keys for fast unique counting
+    # encode (cat, inst) pairs into single collision-free int64 keys
+    # (cat in the high 32 bits; COCO-panoptic RGB instance ids fit 32 bits)
     def _encode(x: np.ndarray) -> np.ndarray:
-        return x[:, 0].astype(np.int64) * 2_000_003 + x[:, 1].astype(np.int64)
+        return (x[:, 0].astype(np.int64) << 32) | (x[:, 1].astype(np.int64) & 0xFFFFFFFF)
 
     pred_keys = _encode(flatten_preds)
     target_keys = _encode(flatten_target)
-    void_key = int(void_color[0]) * 2_000_003 + int(void_color[1])
+    void_key = (int(void_color[0]) << 32) | int(void_color[1])
 
-    pred_unique, pred_inv, pred_counts = np.unique(pred_keys, return_inverse=True, return_counts=True)
-    tgt_unique, tgt_inv, tgt_counts = np.unique(target_keys, return_inverse=True, return_counts=True)
+    pred_unique, pred_first, pred_inv, pred_counts = np.unique(
+        pred_keys, return_index=True, return_inverse=True, return_counts=True
+    )
+    tgt_unique, tgt_first, tgt_inv, tgt_counts = np.unique(
+        target_keys, return_index=True, return_inverse=True, return_counts=True
+    )
     pred_areas = dict(zip(pred_unique.tolist(), pred_counts.tolist()))
     target_areas = dict(zip(tgt_unique.tolist(), tgt_counts.tolist()))
-    # first pixel of each unique segment recovers its (cat, inst) color
-    pred_color_of = {
-        int(k): tuple(flatten_preds[np.argmax(pred_inv == i)]) for i, k in enumerate(pred_unique)
-    }
-    tgt_color_of = {
-        int(k): tuple(flatten_target[np.argmax(tgt_inv == i)]) for i, k in enumerate(tgt_unique)
-    }
+    # first-occurrence pixel of each unique segment recovers its color
+    pred_color_of = {int(k): tuple(flatten_preds[j]) for k, j in zip(pred_unique, pred_first)}
+    tgt_color_of = {int(k): tuple(flatten_target[j]) for k, j in zip(tgt_unique, tgt_first)}
 
     pair_keys = pred_inv.astype(np.int64) * len(tgt_unique) + tgt_inv
     pair_unique, pair_counts = np.unique(pair_keys, return_counts=True)

@@ -110,6 +110,21 @@ class Metric(ABC):
 
     __jit_ignored_attributes__ = ["device", "dtype"]
 
+    # every kwarg Metric.__init__ itself consumes — wrappers that split
+    # base kwargs from passthrough kwargs must filter against this set
+    _BASE_KWARGS = frozenset(
+        (
+            "compute_on_cpu",
+            "dist_sync_on_step",
+            "process_group",
+            "dist_sync_fn",
+            "distributed_available_fn",
+            "sync_on_compute",
+            "compute_with_cache",
+            "sync_backend",
+        )
+    )
+
     is_differentiable: Optional[bool] = None
     higher_is_better: Optional[bool] = None
     full_state_update: Optional[bool] = None
@@ -1057,33 +1072,33 @@ def _gather_ragged_list(
     backend: DistributedBackend, items: List[Array], group: Optional[Any], fallback_dtype: Any
 ) -> List[Array]:
     """Gather a reduce-None ragged list across ranks, preserving item
-    boundaries: rank counts are exchanged first, then every item slot is one
-    collective (ranks with fewer items contribute empty arrays that are
-    dropped on receipt). Eager backends only — in-trace ragged gathers need
-    the fixed-capacity MaskedBuffer states instead."""
+    boundaries with two collectives per state: one gather of the per-item
+    row-length vector and one of the concatenated rows, split back on
+    receipt. Eager backends only — in-trace ragged gathers need the
+    fixed-capacity MaskedBuffer states instead."""
     from tpumetrics.utils.data import _is_tracer
 
-    local_count = jnp.asarray(len(items), jnp.int32)
-    if any(_is_tracer(v) for v in items) or _is_tracer(local_count):
+    if any(_is_tracer(v) for v in items):
         raise TPUMetricsUserError(
             "Ragged (dist_reduce_fx=None) list states cannot be gathered inside jit;"
             " declare a fixed capacity for the state (set_state_capacity) to sync in-trace."
         )
-    counts = [int(c) for c in backend.all_gather(local_count, group=group)]
-    max_n = max(counts) if counts else 0
-    template = items[0] if items else None
-    per_rank: List[List[Array]] = [[] for _ in counts]
-    for i in range(max_n):
-        if i < len(items):
-            value = items[i]
-        else:
-            shape = (0,) + (tuple(template.shape[1:]) if template is not None else ())
-            value = jnp.zeros(shape, template.dtype if template is not None else fallback_dtype)
-        gathered = backend.all_gather(value, group=group)
-        for rank, g in enumerate(gathered):
-            if i < counts[rank]:
-                per_rank[rank].append(g)
-    return [v for rank_items in per_rank for v in rank_items]
+    lengths = jnp.asarray([v.shape[0] for v in items], jnp.int32)
+    if items:
+        data = jnp.concatenate([jnp.atleast_1d(v) for v in items], axis=0)
+    else:
+        data = jnp.zeros((0,), fallback_dtype)
+
+    gathered_lengths = backend.all_gather(lengths, group=group)
+    gathered_data = backend.all_gather(data, group=group)
+
+    out: List[Array] = []
+    for rank_lengths, rank_data in zip(gathered_lengths, gathered_data):
+        offset = 0
+        for n in [int(x) for x in rank_lengths]:
+            out.append(rank_data[offset : offset + n])
+            offset += n
+    return out
 
 
 def _reduce_fn_to_op(reduction_fn: Any) -> Optional[str]:
