@@ -108,9 +108,11 @@ def test_sa_sdr():
     got = np.asarray(source_aggregated_signal_distortion_ratio(preds, target))
     assert got.shape == (2,)
     assert np.isfinite(got).all()
-    # scale invariance: scaling preds leaves the SI variant unchanged
+    # scale invariance: scaling preds leaves the SI variant unchanged...
     scaled = np.asarray(source_aggregated_signal_distortion_ratio(preds * 2.0, target))
     not_scaled = np.asarray(source_aggregated_signal_distortion_ratio(preds, target))
+    assert np.allclose(scaled, not_scaled, atol=1e-3)
+    # ...while the non-SI variant changes
     si = np.asarray(
         source_aggregated_signal_distortion_ratio(preds * 2.0, target, scale_invariant=False)
     )

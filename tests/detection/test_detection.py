@@ -359,3 +359,27 @@ def test_map_micro_reports_observed_classes():
     m.update(preds, target)
     out = m.compute()
     assert sorted(np.asarray(out["classes"]).tolist()) == [3, 7]
+
+
+def test_micro_class_metrics_align_with_classes():
+    """Under average='micro', per-class scores are recomputed macro-style so
+    they pair 1:1 with the observed `classes` ids."""
+    from tpumetrics.detection import MeanAveragePrecision
+
+    m = MeanAveragePrecision(average="micro", class_metrics=True)
+    preds = [{
+        "boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0], [20.0, 20.0, 30.0, 30.0]]),
+        "scores": jnp.asarray([0.9, 0.8]),
+        "labels": jnp.asarray([3, 7]),
+    }]
+    target = [{
+        "boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0], [20.0, 20.0, 30.0, 30.0]]),
+        "labels": jnp.asarray([3, 7]),
+    }]
+    m.update(preds, target)
+    out = m.compute()
+    classes = np.asarray(out["classes"])
+    per_class = np.asarray(out["map_per_class"])
+    assert classes.shape == per_class.shape == (2,)
+    assert np.allclose(per_class, 1.0)
+    assert np.asarray(out["mar_100_per_class"]).shape == (2,)

@@ -1,0 +1,234 @@
+"""Heavy image tier: FID/KID/IS/MiFID/LPIPS/PPL with deterministic
+feature extractors (counterpart of reference ``tests/unittests/image/test_{fid,kid,inception,mifid,lpips}.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import linalg as scipy_linalg
+
+from tpumetrics.functional.image import learned_perceptual_image_patch_similarity
+from tpumetrics.image import (
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+    LearnedPerceptualImagePatchSimilarity,
+    MemorizationInformedFrechetInceptionDistance,
+    PerceptualPathLength,
+)
+from tpumetrics.image.perceptual_path_length import perceptual_path_length
+
+_rng = np.random.default_rng(13)
+_DIM = 12
+
+
+def _extract(imgs):
+    """Deterministic stand-in feature extractor: channel-wise spatial moments."""
+    x = jnp.asarray(imgs, jnp.float32)
+    flat = x.reshape(x.shape[0], -1)
+    return flat[:, :_DIM]
+
+
+def _np_fid(feat_real, feat_fake):
+    """Exact Fréchet distance via scipy sqrtm — the classic formulation."""
+    mu1, mu2 = feat_real.mean(0), feat_fake.mean(0)
+    s1 = np.cov(feat_real, rowvar=False)
+    s2 = np.cov(feat_fake, rowvar=False)
+    covmean = scipy_linalg.sqrtm(s1 @ s2)
+    if np.iscomplexobj(covmean):
+        covmean = covmean.real
+    return ((mu1 - mu2) ** 2).sum() + np.trace(s1 + s2 - 2 * covmean)
+
+
+def _images(n, seed):
+    return np.random.default_rng(seed).integers(0, 255, (n, 3, 4, 4)).astype(np.float32)
+
+
+def test_fid_vs_scipy_sqrtm():
+    real = _images(64, 1)
+    fake = _images(64, 2) * 0.8 + 20
+    fid = FrechetInceptionDistance(feature=_extract, num_features=_DIM)
+    fid.update(jnp.asarray(real[:32]), real=True)
+    fid.update(jnp.asarray(real[32:]), real=True)
+    fid.update(jnp.asarray(fake), real=False)
+    got = float(fid.compute())
+    ref = _np_fid(np.asarray(_extract(real)), np.asarray(_extract(fake)))
+    assert np.isclose(got, ref, rtol=1e-3), (got, ref)
+
+
+def test_fid_identical_distributions_near_zero():
+    real = _images(128, 3)
+    fid = FrechetInceptionDistance(feature=_extract, num_features=_DIM)
+    fid.update(jnp.asarray(real), real=True)
+    fid.update(jnp.asarray(real), real=False)
+    # fp32 streaming moments of 0-255-scale features leave ~1e-2 residue,
+    # negligible against typical FID magnitudes of O(10-100)
+    assert abs(float(fid.compute())) < 0.05
+
+
+def test_fid_reset_real_features():
+    real, fake = _images(8, 4), _images(8, 5)
+    fid = FrechetInceptionDistance(feature=_extract, num_features=_DIM, reset_real_features=False)
+    fid.update(jnp.asarray(real), real=True)
+    fid.update(jnp.asarray(fake), real=False)
+    fid.reset()
+    assert float(fid.real_features_num_samples) == 8
+    assert float(fid.fake_features_num_samples) == 0
+    with pytest.raises(ModuleNotFoundError, match="InceptionV3"):
+        FrechetInceptionDistance(feature=2048)
+
+
+def test_fid_streaming_equals_single_pass():
+    real, fake = _images(32, 6), _images(32, 7)
+    fid_a = FrechetInceptionDistance(feature=_extract, num_features=_DIM)
+    for i in range(0, 32, 8):
+        fid_a.update(jnp.asarray(real[i : i + 8]), real=True)
+        fid_a.update(jnp.asarray(fake[i : i + 8]), real=False)
+    fid_b = FrechetInceptionDistance(feature=_extract, num_features=_DIM)
+    fid_b.update(jnp.asarray(real), real=True)
+    fid_b.update(jnp.asarray(fake), real=False)
+    assert np.isclose(float(fid_a.compute()), float(fid_b.compute()), rtol=1e-4)
+
+
+def _np_poly_mmd(f_real, f_fake, degree=3, coef=1.0):
+    gamma = 1.0 / f_real.shape[1]
+    k11 = (f_real @ f_real.T * gamma + coef) ** degree
+    k22 = (f_fake @ f_fake.T * gamma + coef) ** degree
+    k12 = (f_real @ f_fake.T * gamma + coef) ** degree
+    m = f_real.shape[0]
+    return (
+        (k11.sum() - np.trace(k11)) / (m * (m - 1))
+        + (k22.sum() - np.trace(k22)) / (m * (m - 1))
+        - 2 * k12.sum() / m**2
+    )
+
+
+def test_kid_vs_numpy_mmd():
+    real, fake = _images(16, 8), _images(16, 9)
+    kid = KernelInceptionDistance(feature=_extract, subsets=4, subset_size=16, seed=0)
+    kid.update(jnp.asarray(real), real=True)
+    kid.update(jnp.asarray(fake), real=False)
+    kid_mean, kid_std = kid.compute()
+    # subset_size == n: every subset is the full set, std == 0, mean == exact MMD
+    ref = _np_poly_mmd(np.asarray(_extract(real), np.float64), np.asarray(_extract(fake), np.float64))
+    assert np.isclose(float(kid_mean), ref, rtol=1e-2)
+    assert float(kid_std) < 1e-6
+    with pytest.raises(ValueError, match="subset_size"):
+        small = KernelInceptionDistance(feature=_extract, subset_size=100)
+        small.update(jnp.asarray(real), real=True)
+        small.update(jnp.asarray(fake), real=False)
+        small.compute()
+
+
+def test_inception_score():
+    imgs = _images(32, 10)
+    m = InceptionScore(feature=_extract, splits=4, seed=0)
+    m.update(jnp.asarray(imgs))
+    mean, std = m.compute()
+    assert float(mean) >= 1.0  # IS is exp(KL) >= 1
+
+    # uniform logits -> IS exactly 1
+    m2 = InceptionScore(feature=lambda x: jnp.zeros((x.shape[0], 10)), splits=2)
+    m2.update(jnp.asarray(imgs))
+    mean, _ = m2.compute()
+    assert np.isclose(float(mean), 1.0, atol=1e-5)
+
+
+def test_mifid():
+    real, fake = _images(16, 11), _images(16, 12)
+    m = MemorizationInformedFrechetInceptionDistance(feature=_extract)
+    m.update(jnp.asarray(real), real=True)
+    m.update(jnp.asarray(fake), real=False)
+    got = float(m.compute())
+    assert np.isfinite(got) and got >= 0
+    # memorized (identical) features → tiny distance → huge ratio vs plain FID
+    m2 = MemorizationInformedFrechetInceptionDistance(feature=_extract)
+    m2.update(jnp.asarray(real), real=True)
+    m2.update(jnp.asarray(real * 1.001), real=False)
+    assert np.isfinite(float(m2.compute()))
+
+
+def _toy_backbone(x):
+    return [x[:, :, ::2, ::2], jnp.tanh(x).mean(axis=1, keepdims=True)]
+
+
+def test_lpips():
+    img1 = jnp.asarray(_rng.uniform(-1, 1, (4, 3, 16, 16)), jnp.float32)
+    img2 = jnp.asarray(_rng.uniform(-1, 1, (4, 3, 16, 16)), jnp.float32)
+    d_same = float(learned_perceptual_image_patch_similarity(img1, img1, _toy_backbone))
+    d_diff = float(learned_perceptual_image_patch_similarity(img1, img2, _toy_backbone))
+    assert d_same == 0.0
+    assert d_diff > 0
+
+    m = LearnedPerceptualImagePatchSimilarity(net_type=_toy_backbone)
+    m.update(img1, img2)
+    m.update(img1, img2)
+    assert np.isclose(float(m.compute()), d_diff, atol=1e-6)
+
+    with pytest.raises(ModuleNotFoundError, match="torchvision weights"):
+        LearnedPerceptualImagePatchSimilarity(net_type="alex")
+    with pytest.raises(ValueError, match="net_type"):
+        LearnedPerceptualImagePatchSimilarity(net_type="bad")
+
+    # jit + grad flow (it is a training loss)
+    g = jax.grad(lambda a: learned_perceptual_image_patch_similarity(a, img2, _toy_backbone))(img1)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_perceptual_path_length():
+    def generator(z):
+        img = jnp.tanh(z[:, :48].reshape(z.shape[0], 3, 4, 4))
+        return jnp.repeat(jnp.repeat(img, 4, axis=2), 4, axis=3)
+
+    mean, std, dist = perceptual_path_length(
+        generator,
+        num_samples=32,
+        batch_size=16,
+        sim_net=_toy_backbone,
+        latent_dim=128,
+        resize=None,
+    )
+    assert np.isfinite(float(mean))
+    assert dist.shape == (32,)
+
+    m = PerceptualPathLength(num_samples=16, batch_size=16, sim_net=_toy_backbone, resize=None)
+    m.update(generator)
+    mean, std, dist = m.compute()
+    assert np.isfinite(float(mean))
+    with pytest.raises(ModuleNotFoundError, match="sim_net"):
+        perceptual_path_length(generator, num_samples=8, batch_size=8)
+
+
+def test_ppl_matches_definition_and_gates_conditional():
+    """Per-pair distances equal LPIPS(g(t), g(t+eps))/eps^2 sampled at
+    t ~ U[0,1) on the same path; conditional sampling is gated."""
+    from tpumetrics.functional.image.lpips import learned_perceptual_image_patch_similarity as lpips
+    from tpumetrics.image.perceptual_path_length import perceptual_path_length
+
+    def toy_net(x):
+        return [x[:, :, ::2, ::2], jnp.tanh(x) + 0.3 * x]
+
+    W = jax.random.normal(jax.random.PRNGKey(2), (8, 3 * 8 * 8))
+
+    def gen(z):
+        return (z @ W).reshape(z.shape[0], 3, 8, 8)
+
+    eps, B = 1e-3, 8
+    key0 = jax.random.PRNGKey(7)
+    _, _, dist = perceptual_path_length(
+        gen, num_samples=B, batch_size=B, epsilon=eps, resize=None, sim_net=toy_net,
+        latent_dim=8, key=key0, lower_discard=None, upper_discard=None,
+    )
+    key, k1, k2, k3 = jax.random.split(key0, 4)
+    z1 = jax.random.normal(k1, (B, 8))
+    z2 = jax.random.normal(k2, (B, 8))
+    t = jax.random.uniform(k3, (B, 1))
+    a, b = gen(z1 + (z2 - z1) * t), gen(z1 + (z2 - z1) * (t + eps))
+    ref = np.asarray(lpips(a, b, toy_net, reduction="none")) / eps**2
+    assert np.allclose(np.asarray(dist), ref, rtol=1e-5)
+    assert np.asarray(dist).std() > 0  # per-pair, not batch-mean replicated
+
+    with pytest.raises(NotImplementedError):
+        perceptual_path_length(gen, conditional=True, sim_net=toy_net)

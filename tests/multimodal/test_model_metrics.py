@@ -1,0 +1,248 @@
+"""Model-backed metrics (BERTScore/InfoLM/CLIPScore/CLIP-IQA) with tiny
+randomly-initialized offline models (counterpart of reference
+``tests/unittests/{text/test_bertscore,multimodal}/``)."""
+
+from __future__ import annotations
+
+import os
+
+os.environ.setdefault("HF_HUB_OFFLINE", "1")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics.functional.multimodal import clip_image_quality_assessment, clip_score
+from tpumetrics.functional.text import bert_score, infolm
+from tpumetrics.multimodal import CLIPImageQualityAssessment, CLIPScore
+from tpumetrics.text import BERTScore, InfoLM
+
+
+# ------------------------------------------------- tiny offline fixtures
+
+
+class _WordTokenizer:
+    """Whitespace tokenizer with a growing vocabulary and [CLS]/[SEP]."""
+
+    cls_token_id = 1
+    sep_token_id = 2
+    pad_token_id = 0
+    mask_token_id = 3
+
+    def __init__(self):
+        self.vocab = {}
+
+    def _id(self, word):
+        if word not in self.vocab:
+            self.vocab[word] = 4 + (len(self.vocab) % 96)
+        return self.vocab[word]
+
+    def __call__(self, sentences, **kwargs):
+        rows = [[self.cls_token_id] + [self._id(w) for w in s.lower().split()] + [self.sep_token_id] for s in sentences]
+        max_len = max(len(r) for r in rows)
+        input_ids = np.full((len(rows), max_len), self.pad_token_id, np.int32)
+        attention = np.zeros((len(rows), max_len), np.int32)
+        for i, r in enumerate(rows):
+            input_ids[i, : len(r)] = r
+            attention[i, : len(r)] = 1
+        return {"input_ids": input_ids, "attention_mask": attention}
+
+
+class _ToyEmbedder:
+    """Deterministic embedding model: token-id embedding table."""
+
+    def __init__(self, dim=16, vocab=100, seed=0):
+        rng = np.random.default_rng(seed)
+        self.table = jnp.asarray(rng.standard_normal((vocab, dim)), jnp.float32)
+
+    def __call__(self, model, batch):
+        ids = jnp.asarray(batch["input_ids"])
+        return self.table[ids]
+
+
+class _ToyMLM:
+    """Deterministic masked LM whose per-position logits mix in sequence
+    context (a context-free table would predict the same distribution at
+    every masked slot, making InfoLM degenerate)."""
+
+    def __init__(self, vocab=100, seed=0):
+        rng = np.random.default_rng(seed)
+        self.table = jnp.asarray(rng.standard_normal((vocab, vocab)), jnp.float32)
+
+    def __call__(self, input_ids, attention_mask=None):
+        class _Out:
+            pass
+
+        ids = jnp.asarray(input_ids)
+        token_logits = self.table[ids]
+        context = token_logits.mean(axis=1, keepdims=True)
+        out = _Out()
+        out.logits = token_logits + 2.0 * context
+        return out
+
+
+# -------------------------------------------------------------- BERTScore
+
+
+def test_bert_score_perfect_match():
+    tok = _WordTokenizer()
+    emb = _ToyEmbedder()
+    preds = ["hello there general kenobi", "the cat sat"]
+    out = bert_score(preds, preds, model=emb, user_tokenizer=tok, user_forward_fn=emb)
+    assert np.allclose(np.asarray(out["f1"]), 1.0, atol=1e-5)
+    assert np.allclose(np.asarray(out["precision"]), 1.0, atol=1e-5)
+
+
+def test_bert_score_orders_similarity():
+    tok = _WordTokenizer()
+    emb = _ToyEmbedder()
+    target = ["the quick brown fox jumps"]
+    close = ["the quick brown fox leaps"]
+    far = ["completely unrelated words entirely different"]
+    f_close = float(bert_score(close, target, model=emb, user_tokenizer=tok, user_forward_fn=emb)["f1"][0])
+    f_far = float(bert_score(far, target, model=emb, user_tokenizer=tok, user_forward_fn=emb)["f1"][0])
+    assert f_close > f_far
+
+
+def test_bert_score_class_and_idf():
+    tok = _WordTokenizer()
+    emb = _ToyEmbedder()
+    metric = BERTScore(model=emb, user_tokenizer=tok, user_forward_fn=emb, idf=True)
+    metric.update(["the cat sat on the mat"], ["the cat sat on a mat"])
+    metric.update(["a dog barked"], ["the dog barked"])
+    out = metric.compute()
+    assert np.asarray(out["f1"]).shape == (2,)
+    assert (np.asarray(out["f1"]) > 0).all()
+    metric.reset()
+    assert metric._preds == []
+
+
+def test_bert_score_gated_default():
+    with pytest.raises(ModuleNotFoundError, match="Pass your own"):
+        bert_score(["a"], ["a"], model_name_or_path="definitely-not-cached-model")
+
+
+# ----------------------------------------------------------------- InfoLM
+
+
+def test_infolm_identical_is_best():
+    tok = _WordTokenizer()
+    mlm = _ToyMLM()
+    preds = ["the cat sat on the mat"]
+    target_same = ["the cat sat on the mat"]
+    target_diff = ["a dog runs fast outside today"]
+    same = float(infolm(preds, target_same, model=mlm, user_tokenizer=tok, information_measure="l2_distance", idf=False))
+    diff = float(infolm(preds, target_diff, model=mlm, user_tokenizer=tok, information_measure="l2_distance", idf=False))
+    assert same < 1e-6
+    assert diff > same
+
+
+@pytest.mark.parametrize(
+    "measure, kwargs",
+    [
+        ("kl_divergence", {}),
+        ("alpha_divergence", {"alpha": 0.5}),
+        ("beta_divergence", {"beta": 0.5}),
+        ("ab_divergence", {"alpha": 0.5, "beta": 0.5}),
+        ("renyi_divergence", {"alpha": 0.5}),
+        ("l1_distance", {}),
+        ("l_infinity_distance", {}),
+        ("fisher_rao_distance", {}),
+    ],
+)
+def test_infolm_measures(measure, kwargs):
+    tok = _WordTokenizer()
+    mlm = _ToyMLM()
+    val = infolm(
+        ["the cat sat"], ["a cat sits"], model=mlm, user_tokenizer=tok,
+        information_measure=measure, idf=False, **kwargs,
+    )
+    assert np.isfinite(float(val))
+
+
+def test_infolm_class_and_validation():
+    tok = _WordTokenizer()
+    mlm = _ToyMLM()
+    m = InfoLM(model=mlm, user_tokenizer=tok, information_measure="l1_distance", idf=True)
+    m.update(["the cat sat"], ["a cat sat"])
+    assert np.isfinite(float(m.compute()))
+    with pytest.raises(ValueError, match="information_measure"):
+        InfoLM(information_measure="bad")
+    with pytest.raises(ValueError, match="alpha"):
+        InfoLM(information_measure="alpha_divergence", alpha=1.0)
+
+
+# ------------------------------------------------------------- CLIP family
+
+
+@pytest.fixture(scope="module")
+def tiny_clip():
+    from transformers import CLIPConfig, CLIPTextConfig, CLIPVisionConfig, FlaxCLIPModel
+
+    tc = CLIPTextConfig(
+        hidden_size=32, intermediate_size=64, num_attention_heads=2, num_hidden_layers=2,
+        vocab_size=100, max_position_embeddings=64, projection_dim=32,
+    )
+    vc = CLIPVisionConfig(
+        hidden_size=32, intermediate_size=64, num_attention_heads=2, num_hidden_layers=2,
+        image_size=32, patch_size=8, projection_dim=32,
+    )
+    cfg = CLIPConfig(text_config=tc.to_dict(), vision_config=vc.to_dict(), projection_dim=32)
+    model = FlaxCLIPModel(cfg)
+
+    class _ClipProcessor(_WordTokenizer):
+        def __call__(self, text=None, images=None, return_tensors="np", padding=True):
+            out = {}
+            if text is not None:
+                out.update(super().__call__(text))
+            if images is not None:
+                pix = np.stack([np.asarray(i, np.float32) for i in images])
+                if pix.shape[-1] == 3:  # HWC -> CHW
+                    pix = pix.transpose(0, 3, 1, 2)
+                out["pixel_values"] = pix
+            return out
+
+    return model, _ClipProcessor()
+
+
+def test_clip_score(tiny_clip):
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.integers(0, 255, (2, 3, 32, 32)), jnp.float32)
+    texts = ["a photo of a cat", "a photo of a dog"]
+    score = clip_score(images, texts, model_name_or_path=tiny_clip)
+    assert np.isfinite(float(score)) and float(score) >= 0
+
+    metric = CLIPScore(model_name_or_path=tiny_clip)
+    metric.update(images, texts)
+    metric.update(images, texts)
+    assert np.isclose(float(metric.compute()), max(float(score), 0.0), atol=1e-4)
+
+    with pytest.raises(ValueError, match="same"):
+        clip_score(images, ["just one"], model_name_or_path=tiny_clip)
+
+
+def test_clip_iqa(tiny_clip):
+    rng = np.random.default_rng(1)
+    images = jnp.asarray(rng.random((2, 3, 32, 32)), jnp.float32)
+    out = clip_image_quality_assessment(images, model_name_or_path=tiny_clip, prompts=("quality",))
+    assert out.shape == (2,)
+    assert ((np.asarray(out) >= 0) & (np.asarray(out) <= 1)).all()
+
+    out = clip_image_quality_assessment(
+        images, model_name_or_path=tiny_clip, prompts=("quality", ("Nice photo.", "Terrible photo."))
+    )
+    assert set(out.keys()) == {"quality", "user_defined_0"}
+
+    metric = CLIPImageQualityAssessment(model_name_or_path=tiny_clip, prompts=("quality", "sharpness"))
+    metric.update(images)
+    res = metric.compute()
+    assert set(res.keys()) == {"quality", "sharpness"}
+
+    with pytest.raises(ValueError, match="prompts"):
+        clip_image_quality_assessment(images, model_name_or_path=tiny_clip, prompts=("nonexistent-prompt",))
+
+
+def test_clip_score_gated_default():
+    with pytest.raises(ModuleNotFoundError, match="network"):
+        CLIPScore(model_name_or_path="openai/clip-not-cached")

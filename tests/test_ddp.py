@@ -214,11 +214,11 @@ def test_gather_ragged_list_preserves_boundaries():
 
     from tpumetrics.metric import _gather_ragged_list
 
-    local = [jnp.ones((2, 4)), 2 * jnp.ones((3, 4))]
-    peer = [3 * jnp.ones((1, 4))]
+    local = [jnp.ones((2, 4)), 2 * jnp.ones((3, 5))]  # ragged in BOTH dims
+    peer = [3 * jnp.ones((1, 7))]
 
     class _FakeTwoRankBackend:
-        """Two collectives: the per-item lengths vector, then the cat data."""
+        """Two collectives: the per-item shape matrix, then the flat data."""
 
         def __init__(self):
             self.step = 0
@@ -226,11 +226,11 @@ def test_gather_ragged_list_preserves_boundaries():
         def all_gather(self, v, group=None):
             self.step += 1
             if self.step == 1:
-                return [v, jnp.asarray([p.shape[0] for p in peer], jnp.int32)]
+                return [v, jnp.asarray([(p.ndim,) + p.shape for p in peer], jnp.int32)]
             assert self.step == 2, "ragged gather must use exactly two collectives"
-            return [v, jnp.concatenate(peer)]
+            return [v, jnp.concatenate([jnp.ravel(p) for p in peer])]
 
     merged = _gather_ragged_list(_FakeTwoRankBackend(), local, None, jnp.float32)
     assert len(merged) == 3
-    assert merged[0].shape == (2, 4) and merged[1].shape == (3, 4) and merged[2].shape == (1, 4)
-    assert float(merged[2].mean()) == 3.0
+    assert merged[0].shape == (2, 4) and merged[1].shape == (3, 5) and merged[2].shape == (1, 7)
+    assert abs(float(merged[2].mean()) - 3.0) < 1e-5

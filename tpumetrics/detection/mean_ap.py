@@ -214,8 +214,24 @@ class MeanAveragePrecision(Metric):
         ):
             out[key] = jnp.asarray(result[key])
         if self.class_metrics:
-            out["map_per_class"] = jnp.asarray(result["map_per_class"])
-            out[f"mar_{max_det}_per_class"] = jnp.asarray(result["mar_per_class"])
+            if self.average == "micro":
+                # micro pools classes for the global scores, but per-class
+                # values only make sense macro-style (reference mean_ap.py
+                # recomputes them with average="macro"), keeping
+                # map_per_class aligned with the observed `classes`
+                per_class = coco_evaluate(
+                    detections,
+                    groundtruths,
+                    self.iou_thresholds,
+                    self.rec_thresholds,
+                    self.max_detection_thresholds,
+                    class_ids,
+                    average="macro",
+                )
+            else:
+                per_class = result
+            out["map_per_class"] = jnp.asarray(per_class["map_per_class"])
+            out[f"mar_{max_det}_per_class"] = jnp.asarray(per_class["mar_per_class"])
         else:
             out["map_per_class"] = jnp.asarray(-1.0)
             out[f"mar_{max_det}_per_class"] = jnp.asarray(-1.0)

@@ -4,6 +4,7 @@
 from tpumetrics.functional.image.d_lambda import spectral_distortion_index
 from tpumetrics.functional.image.ergas import error_relative_global_dimensionless_synthesis
 from tpumetrics.functional.image.gradients import image_gradients
+from tpumetrics.functional.image.lpips import learned_perceptual_image_patch_similarity
 from tpumetrics.functional.image.psnr import peak_signal_noise_ratio
 from tpumetrics.functional.image.psnrb import peak_signal_noise_ratio_with_blocked_effect
 from tpumetrics.functional.image.rase import relative_average_spectral_error
@@ -20,6 +21,7 @@ from tpumetrics.functional.image.vif import visual_information_fidelity
 __all__ = [
     "error_relative_global_dimensionless_synthesis",
     "image_gradients",
+    "learned_perceptual_image_patch_similarity",
     "multiscale_structural_similarity_index_measure",
     "peak_signal_noise_ratio",
     "peak_signal_noise_ratio_with_blocked_effect",

@@ -1,0 +1,98 @@
+"""LPIPS machinery (counterpart of reference ``functional/image/lpips.py``,
+a port of richzhang/PerceptualSimilarity).
+
+The perceptual distance is: per backbone layer, unit-normalize the feature
+maps along channels, take squared differences, weight per channel, average
+spatially, and sum over layers. The backbone is pluggable — any callable
+returning a list of (N, C_i, H_i, W_i) feature maps — because pretrained
+AlexNet/VGG weights cannot be downloaded here (the reference vendors only
+the linear-head weights and pulls backbones from torchvision,
+reference lpips.py / image/lpip.py:40)."""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# ImageNet scaling constants of the original LPIPS ScalingLayer
+_SHIFT = (-0.030, -0.088, -0.188)
+_SCALE = (0.458, 0.448, 0.450)
+
+
+def _normalize_tensor(in_feat: Array, eps: float = 1e-10) -> Array:
+    """Unit-normalize along the channel axis (reference lpips.py ``normalize_tensor``)."""
+    norm_factor = jnp.sqrt(jnp.sum(in_feat**2, axis=1, keepdims=True))
+    return in_feat / (norm_factor + eps)
+
+
+def _spatial_average(in_tens: Array, keepdim: bool = True) -> Array:
+    """Mean over the spatial dims (reference lpips.py ``spatial_average``)."""
+    return in_tens.mean(axis=(2, 3), keepdims=keepdim)
+
+
+def _scaling_layer(x: Array) -> Array:
+    shift = jnp.asarray(_SHIFT).reshape(1, 3, 1, 1)
+    scale = jnp.asarray(_SCALE).reshape(1, 3, 1, 1)
+    return (x - shift) / scale
+
+
+def learned_perceptual_image_patch_similarity(
+    img1: Array,
+    img2: Array,
+    net: Callable[[Array], Sequence[Array]],
+    layer_weights: Optional[Sequence[Array]] = None,
+    normalize: bool = False,
+    reduction: str = "mean",
+) -> Array:
+    """LPIPS distance between two image batches given a feature backbone.
+
+    Args:
+        img1 / img2: (N, 3, H, W) images in [-1, 1] (or [0, 1] with
+            ``normalize=True``).
+        net: callable returning the list of per-layer feature maps.
+        layer_weights: optional per-layer channel weights (C_i,) — the
+            trained linear heads of the original LPIPS; uniform weighting
+            (the paper's "baseline" variant) otherwise.
+        reduction: ``mean``, ``sum`` or ``none`` (per-image values) over the batch.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.functional.image import learned_perceptual_image_patch_similarity
+        >>> def toy_net(x):
+        ...     return [x[:, :, ::2, ::2], x.mean(axis=1, keepdims=True)]
+        >>> img1 = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 16, 16)) * 2 - 1
+        >>> img2 = jax.random.uniform(jax.random.PRNGKey(1), (2, 3, 16, 16)) * 2 - 1
+        >>> float(learned_perceptual_image_patch_similarity(img1, img2, toy_net)) > 0
+        True
+    """
+    if normalize:  # [0,1] -> [-1,1]
+        img1 = 2 * img1 - 1
+        img2 = 2 * img2 - 1
+
+    feats1 = net(_scaling_layer(img1))
+    feats2 = net(_scaling_layer(img2))
+    if len(feats1) != len(feats2):
+        raise ValueError("Backbone returned different numbers of feature maps for the two inputs")
+
+    total: Array = jnp.zeros((img1.shape[0], 1, 1, 1))
+    for layer_idx, (f1, f2) in enumerate(zip(feats1, feats2)):
+        d = (_normalize_tensor(f1) - _normalize_tensor(f2)) ** 2
+        if layer_weights is not None:
+            w = jnp.asarray(layer_weights[layer_idx]).reshape(1, -1, 1, 1)
+            d = d * w
+            total = total + _spatial_average(d.sum(axis=1, keepdims=True), keepdim=True)
+        else:
+            total = total + _spatial_average(d.mean(axis=1, keepdims=True), keepdim=True)
+
+    per_image = total.reshape(-1)
+    if reduction == "mean":
+        return per_image.mean()
+    if reduction == "sum":
+        return per_image.sum()
+    if reduction in ("none", None):
+        return per_image
+    raise ValueError(f"Argument `reduction` must be 'mean', 'sum' or 'none', got {reduction}")

@@ -1,0 +1,10 @@
+"""Multimodal functional metrics (counterpart of reference
+``functional/multimodal/__init__.py``)."""
+
+from tpumetrics.functional.multimodal.clip_iqa import clip_image_quality_assessment
+from tpumetrics.functional.multimodal.clip_score import clip_score
+
+__all__ = [
+    "clip_image_quality_assessment",
+    "clip_score",
+]

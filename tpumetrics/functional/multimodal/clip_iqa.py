@@ -1,0 +1,97 @@
+"""CLIP Image Quality Assessment (counterpart of reference
+``functional/multimodal/clip_iqa.py``, after Wang, Chan & Loy 2022)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.multimodal.clip_score import _get_clip_model_and_processor
+
+Array = jax.Array
+
+_PROMPTS: Dict[str, Tuple[str, str]] = {
+    "quality": ("Good photo.", "Bad photo."),
+    "brightness": ("Bright photo.", "Dark photo."),
+    "noisiness": ("Clean photo.", "Noisy photo."),
+    "colorfullness": ("Colorful photo.", "Dull photo."),
+    "sharpness": ("Sharp photo.", "Blurry photo."),
+    "contrast": ("High contrast photo.", "Low contrast photo."),
+    "complexity": ("Complex photo.", "Simple photo."),
+    "natural": ("Natural photo.", "Synthetic photo."),
+    "happy": ("Happy photo.", "Sad photo."),
+    "scary": ("Scary photo.", "Peaceful photo."),
+    "new": ("New photo.", "Old photo."),
+    "warm": ("Warm photo.", "Cold photo."),
+    "real": ("Real photo.", "Abstract photo."),
+    "beautiful": ("Beautiful photo.", "Ugly photo."),
+    "lonely": ("Lonely photo.", "Sociable photo."),
+    "relaxing": ("Relaxing photo.", "Stressful photo."),
+}
+
+
+def _clip_iqa_format_prompts(prompts: Tuple[Union[str, Tuple[str, str]], ...]) -> Tuple[List[str], List[str]]:
+    """Resolve built-in prompt names / custom (positive, negative) pairs
+    (reference clip_iqa.py prompt handling)."""
+    if not isinstance(prompts, tuple):
+        raise ValueError("Argument `prompts` must be a tuple")
+    prompts_names: List[str] = []
+    prompts_list: List[str] = []
+    count = 0
+    for p in prompts:
+        if not isinstance(p, (str, tuple)):
+            raise ValueError("Argument `prompts` must be a tuple containing strings or tuples of strings")
+        if isinstance(p, str):
+            if p not in _PROMPTS:
+                raise ValueError(
+                    f"All elements of `prompts` must be one of {list(_PROMPTS)} if not custom tuple prompts,"
+                    f" got {p}."
+                )
+            prompts_names.append(p)
+            prompts_list.extend(_PROMPTS[p])
+        else:
+            if len(p) != 2:
+                raise ValueError("If a tuple is provided in argument `prompts`, it must be of length 2")
+            prompts_names.append(f"user_defined_{count}")
+            prompts_list.extend(p)
+            count += 1
+    return prompts_names, prompts_list
+
+
+def clip_image_quality_assessment(
+    images: Array,
+    model_name_or_path: Union[str, Tuple[Any, Any]] = "clip_iqa",
+    data_range: float = 1.0,
+    prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",),
+) -> Union[Array, Dict[str, Array]]:
+    """CLIP-IQA: softmax of the image's similarity to antonym prompt pairs
+    (reference clip_iqa.py).
+
+    ``model_name_or_path`` accepts an explicit ``(model, processor)`` pair
+    for offline/custom CLIP checkpoints.
+    """
+    prompts_names, prompts_list = _clip_iqa_format_prompts(prompts)
+    model, processor = _get_clip_model_and_processor(model_name_or_path)
+
+    images = jnp.asarray(images, jnp.float32) / float(data_range)
+    if images.ndim != 4:
+        raise ValueError(f"Expected 4D (N, C, H, W) image input but got {images.shape}")
+
+    processed = processor(
+        text=prompts_list, images=list(jax.device_get(images)), return_tensors="np", padding=True
+    )
+    img_features = jnp.asarray(model.get_image_features(jnp.asarray(processed["pixel_values"])))
+    img_features = img_features / jnp.linalg.norm(img_features, axis=-1, keepdims=True)
+    txt_features = jnp.asarray(
+        model.get_text_features(jnp.asarray(processed["input_ids"]), jnp.asarray(processed["attention_mask"]))
+    )
+    txt_features = txt_features / jnp.linalg.norm(txt_features, axis=-1, keepdims=True)
+
+    logits = 100 * img_features @ txt_features.T  # (N, 2 * num_prompts)
+    logits = logits.reshape(logits.shape[0], -1, 2)
+    probs = jax.nn.softmax(logits, axis=-1)[..., 0]  # P(positive prompt)
+    if len(prompts_names) == 1:
+        return probs.squeeze(-1)
+    return {name: probs[:, i] for i, name in enumerate(prompts_names)}

@@ -1,0 +1,100 @@
+"""CLIPScore (counterpart of reference ``functional/multimodal/clip_score.py``).
+
+The model is a Flax CLIP (``transformers.FlaxCLIPModel``) — pass a
+``(model, processor)`` pair directly for offline/custom checkpoints; a hub
+id string downloads via HF (gated when offline, like the reference's
+transformers gating)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.utils.imports import _TRANSFORMERS_AVAILABLE
+from tpumetrics.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _get_clip_model_and_processor(model_name_or_path: Union[str, Tuple[Any, Any]]) -> Tuple[Any, Any]:
+    """Resolve a hub id or an explicit (model, processor) pair."""
+    if isinstance(model_name_or_path, tuple):
+        model, processor = model_name_or_path
+        return model, processor
+    if not _TRANSFORMERS_AVAILABLE:
+        raise ModuleNotFoundError(
+            "`clip_score` metric requires `transformers` package be installed."
+            " Either install with `pip install transformers>=4.10.0` or `pip install torchmetrics[multimodal]`."
+        )
+    from transformers import CLIPProcessor, FlaxCLIPModel
+
+    try:
+        model = FlaxCLIPModel.from_pretrained(model_name_or_path)
+        processor = CLIPProcessor.from_pretrained(model_name_or_path)
+    except Exception as err:  # offline environments cannot download checkpoints
+        raise ModuleNotFoundError(
+            f"Could not load pretrained CLIP `{model_name_or_path}` (no model cache/network?)."
+            " Pass an explicit `(model, processor)` tuple instead — e.g. a FlaxCLIPModel you"
+            " constructed or loaded locally, and a callable processor(text=..., images=...) returning"
+            " a dict with `pixel_values`, `input_ids` and `attention_mask` arrays."
+        ) from err
+    return model, processor
+
+
+def _clip_score_update(
+    images: Union[Array, List[Array]],
+    text: Union[str, List[str]],
+    model: Any,
+    processor: Any,
+) -> Tuple[Array, int]:
+    """Cosine similarity of image/text embedding pairs × 100
+    (reference clip_score.py:33-80)."""
+    if not isinstance(images, list):
+        if images.ndim == 3:
+            images = [images]
+        else:
+            images = list(images)
+    if not all(i.ndim == 3 for i in images):
+        raise ValueError("Expected all images to be 3d but found image that has either more or less")
+    if not isinstance(text, list):
+        text = [text]
+    if len(text) != len(images):
+        raise ValueError(
+            f"Expected the number of images and text examples to be the same but got {len(images)} and {len(text)}"
+        )
+
+    processed = processor(text=text, images=[jax.device_get(i) for i in images], return_tensors="np", padding=True)
+
+    max_position_embeddings = model.config.text_config.max_position_embeddings
+    if processed["attention_mask"].shape[-1] > max_position_embeddings:
+        rank_zero_warn(
+            f"Encountered caption longer than max_position_embeddings={max_position_embeddings}."
+            " Will truncate captions to this length.",
+            UserWarning,
+        )
+        processed["attention_mask"] = processed["attention_mask"][..., :max_position_embeddings]
+        processed["input_ids"] = processed["input_ids"][..., :max_position_embeddings]
+
+    img_features = jnp.asarray(model.get_image_features(jnp.asarray(processed["pixel_values"])))
+    img_features = img_features / jnp.linalg.norm(img_features, axis=-1, keepdims=True)
+    txt_features = jnp.asarray(
+        model.get_text_features(jnp.asarray(processed["input_ids"]), jnp.asarray(processed["attention_mask"]))
+    )
+    txt_features = txt_features / jnp.linalg.norm(txt_features, axis=-1, keepdims=True)
+
+    score = 100 * jnp.sum(img_features * txt_features, axis=-1)
+    return score, len(text)
+
+
+def clip_score(
+    images: Union[Array, List[Array]],
+    text: Union[str, List[str]],
+    model_name_or_path: Union[str, Tuple[Any, Any]] = "openai/clip-vit-large-patch14",
+) -> Array:
+    """CLIPScore: 100 × cosine similarity of CLIP image and caption
+    embeddings, floored at 0 (reference clip_score.py:96-148)."""
+    model, processor = _get_clip_model_and_processor(model_name_or_path)
+    score, _ = _clip_score_update(images, text, model, processor)
+    return jnp.maximum(score.mean(), 0.0)

@@ -1,11 +1,13 @@
 """Text functional metrics (counterpart of reference
 ``functional/text/__init__.py``)."""
 
+from tpumetrics.functional.text.bert import bert_score
 from tpumetrics.functional.text.bleu import bleu_score
 from tpumetrics.functional.text.cer import char_error_rate
 from tpumetrics.functional.text.chrf import chrf_score
 from tpumetrics.functional.text.edit import edit_distance
 from tpumetrics.functional.text.eed import extended_edit_distance
+from tpumetrics.functional.text.infolm import infolm
 from tpumetrics.functional.text.mer import match_error_rate
 from tpumetrics.functional.text.perplexity import perplexity
 from tpumetrics.functional.text.rouge import rouge_score
@@ -17,11 +19,13 @@ from tpumetrics.functional.text.wil import word_information_lost
 from tpumetrics.functional.text.wip import word_information_preserved
 
 __all__ = [
+    "bert_score",
     "bleu_score",
     "char_error_rate",
     "chrf_score",
     "edit_distance",
     "extended_edit_distance",
+    "infolm",
     "match_error_rate",
     "perplexity",
     "rouge_score",

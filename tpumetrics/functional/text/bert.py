@@ -1,0 +1,220 @@
+"""BERTScore (counterpart of reference ``functional/text/bert.py``).
+
+Embedding extraction runs through a pluggable Flax/JAX model (a hub id
+string is gated when checkpoints cannot be downloaded, exactly like the
+reference's transformers gating); the greedy cosine matching is one fused
+einsum + max — MXU-friendly."""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.utils.imports import _TRANSFORMERS_AVAILABLE
+
+Array = jax.Array
+
+
+def _load_default_model(model_name_or_path: Optional[str], num_layers: Optional[int]):
+    if not _TRANSFORMERS_AVAILABLE:
+        raise ModuleNotFoundError(
+            "`bert_score` metric with default models requires `transformers` package be installed."
+            " Either install with `pip install transformers>=4.4` or `pip install torchmetrics[text]`."
+        )
+    from transformers import AutoTokenizer, FlaxAutoModel
+
+    try:
+        tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
+        model = FlaxAutoModel.from_pretrained(model_name_or_path)
+    except Exception as err:
+        raise ModuleNotFoundError(
+            f"Could not load pretrained model `{model_name_or_path}` (no cache/network?)."
+            " Pass your own `model` (+ `user_tokenizer`/`user_forward_fn`) instead: any callable"
+            " producing token embeddings works — see the argument docs."
+        ) from err
+    return model, tokenizer
+
+
+def _default_forward(
+    model: Any, batch: Dict[str, Array], all_layers: bool, num_layers: Optional[int] = None
+) -> Array:
+    """(B, L, S, D) embeddings from a Flax transformers model; ``num_layers``
+    selects a specific hidden layer (reference bert.py num_layers handling)."""
+    out = model(
+        input_ids=jnp.asarray(batch["input_ids"]),
+        attention_mask=jnp.asarray(batch["attention_mask"]),
+        output_hidden_states=True,
+    )
+    if all_layers:
+        return jnp.stack(out.hidden_states, axis=1)  # (B, L, S, D)
+    if num_layers is not None:
+        return jnp.asarray(out.hidden_states[num_layers])[:, None]
+    return jnp.asarray(out.last_hidden_state)[:, None]  # (B, 1, S, D)
+
+
+def _tokenize_padded(tokenizer: Any, sentences: List[str], max_length: int) -> Dict[str, "np.ndarray"]:
+    """Tokenize with padding/truncation; HF tokenizers return ragged Python
+    lists without padding=True, so try the rich signature first and fall
+    back to manual padding for bare-bones custom tokenizers."""
+    try:
+        batch = tokenizer(sentences, padding=True, truncation=True, max_length=max_length)
+    except TypeError:
+        batch = tokenizer(sentences)
+    input_ids = batch["input_ids"]
+    attention_mask = batch["attention_mask"]
+    if isinstance(input_ids, list) and input_ids and isinstance(input_ids[0], list):
+        longest = min(max(len(r) for r in input_ids), max_length)
+        ids = np.zeros((len(input_ids), longest), np.int32)
+        att = np.zeros((len(input_ids), longest), np.int32)
+        for i, (row, arow) in enumerate(zip(input_ids, attention_mask)):
+            row, arow = row[:longest], arow[:longest]
+            ids[i, : len(row)] = row
+            att[i, : len(arow)] = arow
+        return {"input_ids": ids, "attention_mask": att}
+    return {"input_ids": np.asarray(input_ids), "attention_mask": np.asarray(attention_mask)}
+
+
+def _compute_idf(corpus_ids: List[List[int]], num_docs: int) -> Dict[int, float]:
+    """Inverse document frequencies over the reference corpus; tokens unseen
+    in the corpus default to log(N+1) — bert_score's defaultdict behavior —
+    so candidate-only tokens still carry weight."""
+    df: Counter = Counter()
+    for doc in corpus_ids:
+        df.update(set(doc))
+    idf = {tid: float(np.log((num_docs + 1) / (c + 1))) for tid, c in df.items()}
+    idf["__default__"] = float(np.log(num_docs + 1))
+    return idf
+
+
+def _get_precision_recall_f1(
+    preds_embeddings: Array,
+    target_embeddings: Array,
+    preds_idf_scale: Array,
+    target_idf_scale: Array,
+) -> Tuple[Array, Array, Array]:
+    """Greedy-matching P/R/F1 over unit-normalized token embeddings
+    (reference bert.py:143-166): one (b, l, p, r) einsum, row/col maxima,
+    idf-weighted sums."""
+    cos_sim = jnp.einsum(
+        "blpd, blrd -> blpr", preds_embeddings, target_embeddings, precision=jax.lax.Precision.HIGHEST
+    )
+    precision = jnp.einsum("blp, bp -> bl", cos_sim.max(axis=-1), preds_idf_scale)
+    recall = jnp.einsum("blr, br -> bl", cos_sim.max(axis=-2), target_idf_scale)
+    f1_score = 2 * precision * recall / (precision + recall)
+    f1_score = jnp.where(jnp.isnan(f1_score), 0.0, f1_score)
+    return precision.squeeze(-1), recall.squeeze(-1), f1_score.squeeze(-1)
+
+
+def _embed(
+    sentences: List[str],
+    model: Any,
+    tokenizer: Any,
+    user_forward_fn: Optional[Callable],
+    all_layers: bool,
+    max_length: int,
+    idf: bool,
+    idf_map: Optional[Dict[int, float]] = None,
+    num_layers: Optional[int] = None,
+) -> Tuple[Array, Array, List[List[int]]]:
+    """Tokenize + embed + unit-normalize + mask; returns (embeddings,
+    idf-or-uniform token weights, token id lists)."""
+    batch = _tokenize_padded(tokenizer, sentences, max_length)
+    input_ids = batch["input_ids"]
+    attention_mask = batch["attention_mask"]
+    model_batch = {"input_ids": input_ids, "attention_mask": attention_mask}
+
+    if user_forward_fn is not None:
+        emb = jnp.asarray(user_forward_fn(model, model_batch))
+        if emb.ndim == 3:
+            emb = emb[:, None]
+    else:
+        emb = _default_forward(model, model_batch, all_layers, num_layers)
+
+    emb = emb / jnp.clip(jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-12)
+    mask = jnp.asarray(attention_mask, jnp.float32)
+    emb = emb * mask[:, None, :, None]
+
+    token_lists = [[int(t) for t, a in zip(row, arow) if a] for row, arow in zip(input_ids, attention_mask)]
+    if idf and idf_map is not None:
+        weights = np.zeros_like(attention_mask, dtype=np.float32)
+        for i, row in enumerate(input_ids):
+            for j, (tid, a) in enumerate(zip(row, attention_mask[i])):
+                if a:
+                    weights[i, j] = idf_map.get(int(tid), idf_map.get("__default__", 0.0))
+        sums = weights.sum(axis=1, keepdims=True)
+        weights = weights / np.where(sums > 0, sums, 1.0)
+        scale = jnp.asarray(weights)
+    else:
+        counts = mask.sum(axis=1, keepdims=True)
+        scale = mask / jnp.where(counts > 0, counts, 1.0)
+    return emb, scale, token_lists
+
+
+def bert_score(
+    preds: Union[str, List[str]],
+    target: Union[str, List[str]],
+    model_name_or_path: Optional[str] = None,
+    num_layers: Optional[int] = None,
+    all_layers: bool = False,
+    model: Optional[Any] = None,
+    user_tokenizer: Optional[Any] = None,
+    user_forward_fn: Optional[Callable] = None,
+    verbose: bool = False,
+    idf: bool = False,
+    max_length: int = 512,
+    batch_size: int = 64,
+    return_hash: bool = False,
+    lang: str = "en",
+    rescale_with_baseline: bool = False,
+) -> Dict[str, Array]:
+    """BERTScore: greedy cosine matching of contextual token embeddings
+    (reference bert.py:246-447).
+
+    Pass ``model`` + ``user_tokenizer`` (+ optionally ``user_forward_fn``)
+    to use any embedding model; a hub id downloads via transformers.
+    """
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    if len(preds) != len(target):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have same length, but got {len(preds)} and {len(target)}"
+        )
+    if rescale_with_baseline:
+        raise NotImplementedError(
+            "Baseline rescaling requires downloadable baseline files and is not supported here."
+        )
+
+    if model is None:
+        model, tokenizer = _load_default_model(model_name_or_path or "roberta-large", num_layers)
+    else:
+        if user_tokenizer is None:
+            raise ValueError("`user_tokenizer` must be provided together with a custom `model`")
+        tokenizer = user_tokenizer
+
+    idf_map: Optional[Dict[int, float]] = None
+    if idf:
+        target_batch = _tokenize_padded(tokenizer, list(target), max_length)
+        token_lists = [
+            [int(t) for t, a in zip(row, arow) if a]
+            for row, arow in zip(target_batch["input_ids"], target_batch["attention_mask"])
+        ]
+        idf_map = _compute_idf(token_lists, len(target))
+
+    preds_emb, preds_scale, _ = _embed(
+        list(preds), model, tokenizer, user_forward_fn, all_layers, max_length, idf, idf_map, num_layers
+    )
+    target_emb, target_scale, _ = _embed(
+        list(target), model, tokenizer, user_forward_fn, all_layers, max_length, idf, idf_map, num_layers
+    )
+
+    precision, recall, f1 = _get_precision_recall_f1(preds_emb, target_emb, preds_scale, target_scale)
+    output = {"precision": precision, "recall": recall, "f1": f1}
+    if return_hash:
+        output["hash"] = f"tpumetrics-bert_score-idf:{idf}"  # type: ignore[assignment]
+    return output

@@ -1,0 +1,231 @@
+"""InfoLM (counterpart of reference ``functional/text/infolm.py``, after
+Colombo, Staerman, Clavel & Piantanida, AAAI 2022).
+
+Per sentence, each (non-special) token position is masked and the masked
+language model's vocabulary distribution at that position is collected; the
+positionwise distributions aggregate into one per-sentence distribution
+(idf-weighted optionally), and candidate/reference distributions are
+compared with an information measure. The MLM is pluggable (hub ids are
+gated offline, like the reference's transformers gating)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.utils.imports import _TRANSFORMERS_AVAILABLE
+
+Array = jax.Array
+
+_ALLOWED_INFORMATION_MEASURE = (
+    "kl_divergence",
+    "alpha_divergence",
+    "beta_divergence",
+    "ab_divergence",
+    "renyi_divergence",
+    "l1_distance",
+    "l2_distance",
+    "l_infinity_distance",
+    "fisher_rao_distance",
+)
+
+
+class _InformationMeasure:
+    """Information measures between discrete distributions
+    (reference infolm.py:72-290)."""
+
+    def __init__(
+        self,
+        information_measure: str,
+        alpha: Optional[float] = None,
+        beta: Optional[float] = None,
+    ) -> None:
+        if information_measure not in _ALLOWED_INFORMATION_MEASURE:
+            raise ValueError(
+                f"Argument `information_measure` is expected to be one of {_ALLOWED_INFORMATION_MEASURE}"
+            )
+        if information_measure in ("alpha_divergence", "ab_divergence", "renyi_divergence"):
+            if not isinstance(alpha, float) or alpha in (0, 1):
+                raise ValueError(f"Parameter `alpha` is expected to be a float differing from 0 and 1, got {alpha}")
+        if information_measure in ("beta_divergence", "ab_divergence"):
+            if not isinstance(beta, float) or beta == 0:
+                raise ValueError(f"Parameter `beta` is expected to be a non-zero float, got {beta}")
+        if information_measure == "ab_divergence" and (alpha is not None and beta is not None and alpha + beta == 0):
+            raise ValueError("Parameters `alpha` and `beta` cannot sum to 0 for `ab_divergence`")
+        self.information_measure = information_measure
+        self.alpha = alpha
+        self.beta = beta
+
+    def __call__(self, preds_distribution: Array, target_distribution: Array) -> Array:
+        return getattr(self, f"_calculate_{self.information_measure}")(preds_distribution, target_distribution)
+
+    @staticmethod
+    def _calculate_kl_divergence(p: Array, t: Array) -> Array:
+        """KL(t || p) = Σ t·log(t/p) — non-negative, zero iff identical.
+
+        Deliberate deviation: the reference computes ``Σ t·log(p/t)``
+        (reference infolm.py:159), i.e. *negative* KL, which inverts the
+        lower-is-better ranking (a perfect match scores 0 but any mismatch
+        scores below it)."""
+        return jnp.sum(t * jnp.log(t / p), axis=-1)
+
+    def _calculate_alpha_divergence(self, p: Array, t: Array) -> Array:
+        denom = self.alpha * (self.alpha - 1)
+        return (1 - jnp.sum(t**self.alpha * p ** (1 - self.alpha), axis=-1)) / denom
+
+    def _calculate_ab_divergence(self, p: Array, t: Array) -> Array:
+        a = jnp.log(jnp.sum(t ** (self.beta + self.alpha), axis=-1)) / (self.beta * (self.beta + self.alpha))
+        b = jnp.log(jnp.sum(p ** (self.beta + self.alpha), axis=-1)) / (self.alpha * (self.beta + self.alpha))
+        c = jnp.log(jnp.sum(t**self.alpha * p**self.beta, axis=-1)) / (self.alpha * self.beta)
+        return a + b - c
+
+    def _calculate_beta_divergence(self, p: Array, t: Array) -> Array:
+        self.alpha = 1.0
+        return self._calculate_ab_divergence(p, t)
+
+    def _calculate_renyi_divergence(self, p: Array, t: Array) -> Array:
+        return jnp.log(jnp.sum(t**self.alpha * p ** (1 - self.alpha), axis=-1)) / (self.alpha - 1)
+
+    @staticmethod
+    def _calculate_l1_distance(p: Array, t: Array) -> Array:
+        return jnp.sum(jnp.abs(t - p), axis=-1)
+
+    @staticmethod
+    def _calculate_l2_distance(p: Array, t: Array) -> Array:
+        return jnp.sqrt(jnp.sum((t - p) ** 2, axis=-1))
+
+    @staticmethod
+    def _calculate_l_infinity_distance(p: Array, t: Array) -> Array:
+        return jnp.max(jnp.abs(t - p), axis=-1)
+
+    @staticmethod
+    def _calculate_fisher_rao_distance(p: Array, t: Array) -> Array:
+        return 2 * jnp.arccos(jnp.clip(jnp.sum(jnp.sqrt(p * t), axis=-1), 0, 1))
+
+
+def _load_default_mlm(model_name_or_path: str):
+    if not _TRANSFORMERS_AVAILABLE:
+        raise ModuleNotFoundError(
+            "`infolm` metric with default models requires `transformers` package be installed."
+            " Either install with `pip install transformers>=4.4` or `pip install torchmetrics[text]`."
+        )
+    from transformers import AutoTokenizer, FlaxAutoModelForMaskedLM
+
+    try:
+        tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
+        model = FlaxAutoModelForMaskedLM.from_pretrained(model_name_or_path)
+    except Exception as err:
+        raise ModuleNotFoundError(
+            f"Could not load pretrained MLM `{model_name_or_path}` (no cache/network?)."
+            " Pass `model` and `user_tokenizer` for a locally constructed masked language model."
+        ) from err
+    return model, tokenizer
+
+
+def _sentence_distribution(
+    model: Any,
+    input_ids: np.ndarray,
+    attention_mask: np.ndarray,
+    mask_token_id: int,
+    special_ids: set,
+    temperature: float,
+    idf_weights: Optional[np.ndarray] = None,
+) -> Array:
+    """Aggregate positionwise masked-token distributions of one batch
+    (reference infolm.py:367-430): every maskable position is masked in its
+    own copy, one batched forward yields all distributions."""
+    batch_size, seq_len = input_ids.shape
+    maskable = (attention_mask == 1) & ~np.isin(input_ids, list(special_ids))
+
+    rows, positions = np.nonzero(maskable)
+    masked_inputs = input_ids[rows].copy()
+    masked_inputs[np.arange(len(rows)), positions] = mask_token_id
+    logits = jnp.asarray(
+        model(input_ids=jnp.asarray(masked_inputs), attention_mask=jnp.asarray(attention_mask[rows])).logits
+    )
+    probs = jax.nn.softmax(logits[jnp.arange(len(rows)), jnp.asarray(positions)] / temperature, axis=-1)
+
+    vocab = probs.shape[-1]
+    weights = np.ones(len(rows)) if idf_weights is None else idf_weights[rows, positions]
+    weighted = probs * jnp.asarray(weights, jnp.float32)[:, None]
+    summed = jnp.zeros((batch_size, vocab)).at[jnp.asarray(rows)].add(weighted)
+    norm = jnp.zeros((batch_size,)).at[jnp.asarray(rows)].add(jnp.asarray(weights, jnp.float32))
+    return summed / jnp.clip(norm, 1e-12)[:, None]
+
+
+def infolm(
+    preds: Union[str, List[str]],
+    target: Union[str, List[str]],
+    model_name_or_path: str = "bert-base-uncased",
+    temperature: float = 0.25,
+    information_measure: str = "kl_divergence",
+    idf: bool = True,
+    alpha: Optional[float] = None,
+    beta: Optional[float] = None,
+    max_length: Optional[int] = None,
+    return_sentence_level_score: bool = False,
+    model: Optional[Any] = None,
+    user_tokenizer: Optional[Any] = None,
+) -> Union[Array, Tuple[Array, Array]]:
+    """InfoLM score between candidate and reference sentences
+    (reference infolm.py:470-653)."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    if len(preds) != len(target):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have same length, but got {len(preds)} and {len(target)}"
+        )
+    measure = _InformationMeasure(information_measure, alpha, beta)
+
+    if model is None:
+        model, tokenizer = _load_default_mlm(model_name_or_path)
+    else:
+        if user_tokenizer is None:
+            raise ValueError("`user_tokenizer` must be provided together with a custom `model`")
+        tokenizer = user_tokenizer
+
+    mask_token_id = getattr(tokenizer, "mask_token_id", 0) or 0
+    special_ids = {
+        tid
+        for tid in (
+            getattr(tokenizer, "pad_token_id", None),
+            getattr(tokenizer, "cls_token_id", None),
+            getattr(tokenizer, "sep_token_id", None),
+        )
+        if tid is not None
+    }
+
+    from tpumetrics.functional.text.bert import _tokenize_padded
+
+    limit = max_length or 512
+    preds_batch = _tokenize_padded(tokenizer, list(preds), limit)
+    target_batch = _tokenize_padded(tokenizer, list(target), limit)
+    p_ids, p_mask = preds_batch["input_ids"], preds_batch["attention_mask"]
+    t_ids, t_mask = target_batch["input_ids"], target_batch["attention_mask"]
+
+    idf_p = idf_t = None
+    if idf:
+        from tpumetrics.functional.text.bert import _compute_idf
+
+        token_lists = [[int(t) for t, a in zip(r, ar) if a] for r, ar in zip(t_ids, t_mask)]
+        idf_map = _compute_idf(token_lists, len(target))
+        default_idf = idf_map.get("__default__", 0.0)
+        idf_p = np.vectorize(lambda t: idf_map.get(int(t), default_idf))(p_ids)
+        idf_t = np.vectorize(lambda t: idf_map.get(int(t), default_idf))(t_ids)
+
+    preds_distribution = _sentence_distribution(
+        model, p_ids, p_mask, mask_token_id, special_ids, temperature, idf_p
+    )
+    target_distribution = _sentence_distribution(
+        model, t_ids, t_mask, mask_token_id, special_ids, temperature, idf_t
+    )
+
+    sentence_scores = measure(preds_distribution, target_distribution)
+    if return_sentence_level_score:
+        return sentence_scores.mean(), sentence_scores
+    return sentence_scores.mean()

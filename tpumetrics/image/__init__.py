@@ -1,6 +1,12 @@
 """Image metric domain (counterpart of reference ``image/__init__.py``)."""
 
 from tpumetrics.image.d_lambda import SpectralDistortionIndex
+from tpumetrics.image.fid import FrechetInceptionDistance
+from tpumetrics.image.inception import InceptionScore
+from tpumetrics.image.kid import KernelInceptionDistance
+from tpumetrics.image.lpip import LearnedPerceptualImagePatchSimilarity
+from tpumetrics.image.mifid import MemorizationInformedFrechetInceptionDistance
+from tpumetrics.image.perceptual_path_length import PerceptualPathLength
 from tpumetrics.image.ergas import ErrorRelativeGlobalDimensionlessSynthesis
 from tpumetrics.image.psnr import PeakSignalNoiseRatio
 from tpumetrics.image.psnrb import PeakSignalNoiseRatioWithBlockedEffect
@@ -17,9 +23,15 @@ from tpumetrics.image.vif import VisualInformationFidelity
 
 __all__ = [
     "ErrorRelativeGlobalDimensionlessSynthesis",
+    "FrechetInceptionDistance",
+    "InceptionScore",
+    "KernelInceptionDistance",
+    "LearnedPerceptualImagePatchSimilarity",
+    "MemorizationInformedFrechetInceptionDistance",
     "MultiScaleStructuralSimilarityIndexMeasure",
     "PeakSignalNoiseRatio",
     "PeakSignalNoiseRatioWithBlockedEffect",
+    "PerceptualPathLength",
     "RelativeAverageSpectralError",
     "RootMeanSquaredErrorUsingSlidingWindow",
     "SpectralAngleMapper",

@@ -1,0 +1,168 @@
+"""KernelInceptionDistance (counterpart of reference ``image/kid.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.image.fid import _resolve_feature_extractor
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+def poly_kernel(f1: Array, f2: Array, degree: int = 3, gamma: Optional[float] = None, coef: float = 1.0) -> Array:
+    """Polynomial kernel (reference kid.py:53-57)."""
+    if gamma is None:
+        gamma = 1.0 / f1.shape[1]
+    return (jnp.matmul(f1, f2.T, precision=jax.lax.Precision.HIGHEST) * gamma + coef) ** degree
+
+
+def _np_poly_mmd(
+    f_real: "np.ndarray", f_fake: "np.ndarray", degree: int = 3, gamma: Optional[float] = None, coef: float = 1.0
+) -> float:
+    """Host float64 unbiased polynomial-kernel MMD (compute-time path)."""
+    if gamma is None:
+        gamma = 1.0 / f_real.shape[1]
+    k_11 = (f_real @ f_real.T * gamma + coef) ** degree
+    k_22 = (f_fake @ f_fake.T * gamma + coef) ** degree
+    k_12 = (f_real @ f_fake.T * gamma + coef) ** degree
+    m = k_11.shape[0]
+    value = ((k_11.sum() - np.trace(k_11)) + (k_22.sum() - np.trace(k_22))) / (m * (m - 1))
+    return float(value - 2 * k_12.sum() / (m**2))
+
+
+def poly_mmd(
+    f_real: Array, f_fake: Array, degree: int = 3, gamma: Optional[float] = None, coef: float = 1.0
+) -> Array:
+    """Unbiased polynomial-kernel MMD (reference kid.py:60-72)."""
+    k_11 = poly_kernel(f_real, f_real, degree, gamma, coef)
+    k_22 = poly_kernel(f_fake, f_fake, degree, gamma, coef)
+    k_12 = poly_kernel(f_real, f_fake, degree, gamma, coef)
+
+    m = k_11.shape[0]
+    diag_x = jnp.diagonal(k_11)
+    diag_y = jnp.diagonal(k_22)
+
+    kt_xx_sums = k_11.sum(axis=-1) - diag_x
+    kt_yy_sums = k_22.sum(axis=-1) - diag_y
+    k_xy_sums = k_12.sum(axis=0)
+
+    value = (kt_xx_sums.sum() + kt_yy_sums.sum()) / (m * (m - 1))
+    value -= 2 * k_xy_sums.sum() / (m**2)
+    return value
+
+
+class KernelInceptionDistance(Metric):
+    """KID: mean/std of unbiased polynomial MMD over random feature subsets
+    (reference kid.py:74-280).
+
+    Args:
+        feature: callable image→(N, D) extractor, or gated int (see FID).
+        subsets / subset_size: subset sampling configuration.
+        degree / gamma / coef: polynomial kernel parameters.
+        seed: subset-sampling seed (TPU extension; the reference draws from
+            the global torch RNG).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.image import KernelInceptionDistance
+        >>> extract = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :8].astype(jnp.float32)
+        >>> kid = KernelInceptionDistance(feature=extract, subsets=3, subset_size=8)
+        >>> real = jax.random.randint(jax.random.PRNGKey(0), (16, 3, 8, 8), 0, 255)
+        >>> fake = jax.random.randint(jax.random.PRNGKey(1), (16, 3, 8, 8), 0, 255)
+        >>> kid.update(real, real=True)
+        >>> kid.update(fake, real=False)
+        >>> kid_mean, kid_std = kid.compute()
+        >>> bool(jnp.isfinite(kid_mean))
+        True
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(
+        self,
+        feature: Union[int, Callable] = 2048,
+        subsets: int = 100,
+        subset_size: int = 1000,
+        degree: int = 3,
+        gamma: Optional[float] = None,
+        coef: float = 1.0,
+        reset_real_features: bool = True,
+        normalize: bool = False,
+        seed: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.inception, _ = _resolve_feature_extractor(feature, type(self).__name__)
+
+        if not (isinstance(subsets, int) and subsets > 0):
+            raise ValueError("Argument `subsets` expected to be integer larger than 0")
+        self.subsets = subsets
+        if not (isinstance(subset_size, int) and subset_size > 0):
+            raise ValueError("Argument `subset_size` expected to be integer larger than 0")
+        self.subset_size = subset_size
+        if not (isinstance(degree, int) and degree > 0):
+            raise ValueError("Argument `degree` expected to be integer larger than 0")
+        self.degree = degree
+        if gamma is not None and not (isinstance(gamma, float) and gamma > 0):
+            raise ValueError("Argument `gamma` expected to be `None` or float larger than 0")
+        self.gamma = gamma
+        if not (isinstance(coef, float) and coef > 0):
+            raise ValueError("Argument `coef` expected to be float larger than 0")
+        self.coef = coef
+        if not isinstance(reset_real_features, bool):
+            raise ValueError("Argument `reset_real_features` expected to be a bool")
+        self.reset_real_features = reset_real_features
+        if not isinstance(normalize, bool):
+            raise ValueError("Argument `normalize` expected to be a bool")
+        self.normalize = normalize
+        self._rng = np.random.default_rng(seed)
+
+        self.add_state("real_features", default=[], dist_reduce_fx=None)
+        self.add_state("fake_features", default=[], dist_reduce_fx=None)
+
+    def update(self, imgs: Array, real: bool) -> None:
+        """Extract and store features (reference kid.py:240-252)."""
+        imgs = (imgs * 255).astype(jnp.uint8) if self.normalize else imgs
+        features = jnp.asarray(self.inception(imgs), jnp.float32)
+        if real:
+            self.real_features.append(features)
+        else:
+            self.fake_features.append(features)
+
+    def compute(self) -> Tuple[Array, Array]:
+        """Subset-sampled MMD mean/std (reference kid.py:254-280).
+
+        The cubed polynomial kernel of raw feature magnitudes overflows fp32
+        precision, so — like the reference's double-precision states — the
+        compute-time MMD runs on host in float64."""
+        real_features = np.asarray(dim_zero_cat(self.real_features), np.float64)
+        fake_features = np.asarray(dim_zero_cat(self.fake_features), np.float64)
+        if real_features.shape[0] < self.subset_size or fake_features.shape[0] < self.subset_size:
+            raise ValueError("Argument `subset_size` should be smaller than the number of samples")
+
+        kid_scores = []
+        for _ in range(self.subsets):
+            perm = self._rng.permutation(real_features.shape[0])[: self.subset_size]
+            f_real = real_features[perm]
+            perm = self._rng.permutation(fake_features.shape[0])[: self.subset_size]
+            f_fake = fake_features[perm]
+            kid_scores.append(_np_poly_mmd(f_real, f_fake, self.degree, self.gamma, self.coef))
+        kid_scores_arr = np.asarray(kid_scores)
+        return jnp.asarray(kid_scores_arr.mean(), jnp.float32), jnp.asarray(kid_scores_arr.std(), jnp.float32)
+
+    def reset(self) -> None:
+        if not self.reset_real_features:
+            real = self.real_features
+            super().reset()
+            self.real_features = real
+        else:
+            super().reset()

@@ -1,0 +1,92 @@
+"""LearnedPerceptualImagePatchSimilarity (counterpart of reference
+``image/lpip.py:40``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.image.lpips import learned_perceptual_image_patch_similarity
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class LearnedPerceptualImagePatchSimilarity(Metric):
+    """LPIPS accumulated over batches: sum/total scalar states
+    (reference lpip.py:136-137).
+
+    Args:
+        net_type: a callable feature backbone (image→list of feature maps).
+            The reference's string variants (``vgg``/``alex``/``squeeze``)
+            need torchvision pretrained weights and are gated here.
+        layer_weights: optional trained per-layer channel weights.
+        reduction: ``mean`` or ``sum`` over accumulated images.
+        normalize: inputs are [0,1] instead of [-1,1].
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.image import LearnedPerceptualImagePatchSimilarity
+        >>> def toy_net(x):
+        ...     return [x[:, :, ::2, ::2], x.mean(axis=1, keepdims=True)]
+        >>> lpips = LearnedPerceptualImagePatchSimilarity(net_type=toy_net)
+        >>> img1 = jax.random.uniform(jax.random.PRNGKey(0), (4, 3, 16, 16)) * 2 - 1
+        >>> img2 = jax.random.uniform(jax.random.PRNGKey(1), (4, 3, 16, 16)) * 2 - 1
+        >>> lpips.update(img1, img2)
+        >>> float(lpips.compute()) > 0
+        True
+    """
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(
+        self,
+        net_type: Union[str, Callable] = "alex",
+        reduction: str = "mean",
+        normalize: bool = False,
+        layer_weights: Optional[Sequence[Array]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if isinstance(net_type, str):
+            valid_net_type = ("vgg", "alex", "squeeze")
+            if net_type not in valid_net_type:
+                raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
+            raise ModuleNotFoundError(
+                f"LPIPS with the pretrained `{net_type}` backbone requires torchvision weights, which"
+                " cannot be downloaded in this environment. Pass a callable backbone (image -> list of"
+                " (N, C, H, W) feature maps, e.g. a Flax VGG) as `net_type` instead."
+            )
+        if not callable(net_type):
+            raise ValueError("Argument `net_type` must be a string or a callable backbone")
+        self.net = net_type
+        valid_reduction = ("mean", "sum")
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+        self.reduction = reduction
+        if not isinstance(normalize, bool):
+            raise ValueError(f"Argument `normalize` should be a bool but got {normalize}")
+        self.normalize = normalize
+        self.layer_weights = layer_weights
+
+        self.add_state("sum_scores", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, img1: Array, img2: Array) -> None:
+        """Accumulate LPIPS sums (reference lpip.py:139-145)."""
+        loss = learned_perceptual_image_patch_similarity(
+            img1, img2, self.net, self.layer_weights, self.normalize, reduction="sum"
+        )
+        self.sum_scores = self.sum_scores + loss
+        self.total = self.total + img1.shape[0]
+
+    def compute(self) -> Array:
+        """Reduced LPIPS (reference lpip.py:147-152)."""
+        if self.reduction == "mean":
+            return self.sum_scores / self.total
+        return self.sum_scores

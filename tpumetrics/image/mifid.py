@@ -1,0 +1,118 @@
+"""MemorizationInformedFrechetInceptionDistance (counterpart of reference
+``image/mifid.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.image.fid import _compute_fid, _resolve_feature_extractor
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+def _compute_cosine_distance(features1: Array, features2: Array, cosine_distance_eps: float = 0.1) -> Array:
+    """Mean minimum cosine distance, thresholded (reference mifid.py:36-47)."""
+    features1 = features1[jnp.asarray(np.sum(np.asarray(features1), axis=1) != 0)]
+    features2 = features2[jnp.asarray(np.sum(np.asarray(features2), axis=1) != 0)]
+    norm_f1 = features1 / jnp.linalg.norm(features1, axis=1, keepdims=True)
+    norm_f2 = features2 / jnp.linalg.norm(features2, axis=1, keepdims=True)
+    d = 1.0 - jnp.abs(jnp.matmul(norm_f1, norm_f2.T, precision=jax.lax.Precision.HIGHEST))
+    mean_min_d = jnp.mean(d.min(axis=1))
+    return jnp.where(mean_min_d < cosine_distance_eps, mean_min_d, jnp.ones_like(mean_min_d))
+
+
+def _mifid_compute(
+    mu1: Array,
+    sigma1: Array,
+    features1: Array,
+    mu2: Array,
+    sigma2: Array,
+    features2: Array,
+    cosine_distance_eps: float = 0.1,
+) -> Array:
+    """FID weighted by the memorization distance (reference mifid.py:50-63)."""
+    fid_value = _compute_fid(mu1, sigma1, mu2, sigma2)
+    distance = _compute_cosine_distance(features1, features2, cosine_distance_eps)
+    return jnp.where(fid_value > 1e-8, fid_value / (distance + 1e-14), jnp.zeros_like(fid_value))
+
+
+class MemorizationInformedFrechetInceptionDistance(Metric):
+    """MiFID = FID / memorization distance: penalizes generators that copy
+    the training set (reference mifid.py:66-250).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.image import MemorizationInformedFrechetInceptionDistance
+        >>> extract = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :8].astype(jnp.float32)
+        >>> mifid = MemorizationInformedFrechetInceptionDistance(feature=extract)
+        >>> real = jax.random.randint(jax.random.PRNGKey(0), (8, 3, 8, 8), 0, 255)
+        >>> fake = jax.random.randint(jax.random.PRNGKey(1), (8, 3, 8, 8), 0, 255)
+        >>> mifid.update(real, real=True)
+        >>> mifid.update(fake, real=False)
+        >>> float(mifid.compute()) >= 0
+        True
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(
+        self,
+        feature: Union[int, Callable] = 2048,
+        reset_real_features: bool = True,
+        normalize: bool = False,
+        cosine_distance_eps: float = 0.1,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.inception, _ = _resolve_feature_extractor(feature, type(self).__name__)
+        if not isinstance(reset_real_features, bool):
+            raise ValueError("Argument `reset_real_features` expected to be a bool")
+        self.reset_real_features = reset_real_features
+        if not isinstance(normalize, bool):
+            raise ValueError("Argument `normalize` expected to be a bool")
+        self.normalize = normalize
+        if not (isinstance(cosine_distance_eps, float) and 1 >= cosine_distance_eps > 0):
+            raise ValueError("Argument `cosine_distance_eps` expected to be a float greater than 0 and less than 1")
+        self.cosine_distance_eps = cosine_distance_eps
+
+        self.add_state("real_features", default=[], dist_reduce_fx=None)
+        self.add_state("fake_features", default=[], dist_reduce_fx=None)
+
+    def update(self, imgs: Array, real: bool) -> None:
+        """Extract and store features (reference mifid.py:219-227)."""
+        imgs = (imgs * 255).astype(jnp.uint8) if self.normalize else imgs
+        features = jnp.asarray(self.inception(imgs), jnp.float32)
+        if features.ndim == 1:
+            features = features[None]
+        if real:
+            self.real_features.append(features)
+        else:
+            self.fake_features.append(features)
+
+    def compute(self) -> Array:
+        """MiFID over all stored features (reference mifid.py:229-243)."""
+        real_features = dim_zero_cat(self.real_features)
+        fake_features = dim_zero_cat(self.fake_features)
+        mean_real, mean_fake = real_features.mean(axis=0), fake_features.mean(axis=0)
+        cov_real = jnp.cov(real_features.T)
+        cov_fake = jnp.cov(fake_features.T)
+        return _mifid_compute(
+            mean_real, cov_real, real_features, mean_fake, cov_fake, fake_features, self.cosine_distance_eps
+        )
+
+    def reset(self) -> None:
+        if not self.reset_real_features:
+            real = self.real_features
+            super().reset()
+            self.real_features = real
+        else:
+            super().reset()

@@ -1,0 +1,163 @@
+"""PerceptualPathLength (counterpart of reference
+``image/perceptual_path_length.py`` / ``functional/image/perceptual_path_length.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.functional.image.lpips import learned_perceptual_image_patch_similarity
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+def _interpolate(
+    latents1: Array, latents2: Array, epsilon: Union[float, Array], interpolation_method: str
+) -> Array:
+    """Lerp/slerp the fraction-``epsilon`` point on the latents1→latents2 path
+    (reference functional/perceptual_path_length.py); ``epsilon`` may be a
+    per-sample (B, 1) array."""
+    eps = epsilon
+    if interpolation_method == "lerp":
+        return latents1 + (latents2 - latents1) * eps
+    if interpolation_method in ("slerp_any", "slerp_unit"):
+        ndims = tuple(range(1, latents1.ndim))
+        unit1 = latents1 / jnp.linalg.norm(latents1, axis=ndims, keepdims=True)
+        unit2 = latents2 / jnp.linalg.norm(latents2, axis=ndims, keepdims=True)
+        cos = jnp.sum(unit1 * unit2, axis=ndims, keepdims=True)
+        omega = jnp.arccos(jnp.clip(cos, -1 + 1e-7, 1 - 1e-7))
+        so = jnp.sin(omega)
+        res = (jnp.sin((1.0 - eps) * omega) / so) * latents1 + (jnp.sin(eps * omega) / so) * latents2
+        if interpolation_method == "slerp_unit":
+            res = res / jnp.linalg.norm(res, axis=ndims, keepdims=True)
+        return res
+    raise ValueError(f"Interpolation method {interpolation_method} not supported.")
+
+
+def perceptual_path_length(
+    generator: Callable[[Array], Array],
+    num_samples: int = 10_000,
+    conditional: bool = False,
+    batch_size: int = 64,
+    interpolation_method: str = "lerp",
+    epsilon: float = 1e-4,
+    resize: Optional[int] = 64,
+    lower_discard: Optional[float] = 0.01,
+    upper_discard: Optional[float] = 0.99,
+    sim_net: Optional[Callable] = None,
+    latent_dim: int = 128,
+    key: Optional[Array] = None,
+) -> Tuple[Array, Array, Array]:
+    """PPL (Karras et al. 2019): LPIPS distance between images generated from
+    epsilon-separated latents, scaled by 1/eps², with percentile discarding.
+
+    ``generator`` maps latent batches to image batches; ``sim_net`` is the
+    perceptual backbone (see LPIPS — the pretrained default is gated).
+
+    Returns (mean, std, per-pair distances).
+    """
+    if sim_net is None:
+        raise ModuleNotFoundError(
+            "perceptual_path_length requires a perceptual backbone: pass `sim_net` (see"
+            " LearnedPerceptualImagePatchSimilarity — the pretrained default is unavailable here)."
+        )
+    if conditional:
+        raise NotImplementedError(
+            "Conditional PPL (sampling labels alongside latents) is not implemented;"
+            " evaluate with conditional=False or close over fixed labels in `generator`."
+        )
+    key = key if key is not None else jax.random.PRNGKey(0)
+    distances = []
+    num_batches = max(1, num_samples // batch_size)
+    for i in range(num_batches):
+        key, k1, k2, k3 = jax.random.split(key, 4)
+        z1 = jax.random.normal(k1, (batch_size, latent_dim))
+        z2 = jax.random.normal(k2, (batch_size, latent_dim))
+        # sample t ~ U[0,1) per path and measure the segment t -> t+epsilon
+        # ON the z1→z2 path (Karras et al. 2019), so the latent step is
+        # always exactly epsilon of the path
+        t = jax.random.uniform(k3, (batch_size,) + (1,) * (z1.ndim - 1))
+        z_t = _interpolate(z1, z2, t, interpolation_method)
+        z_t_eps = _interpolate(z1, z2, t + epsilon, interpolation_method)
+        img1 = generator(z_t)
+        img2 = generator(z_t_eps)
+        if resize is not None:
+            img1 = jax.image.resize(img1, (img1.shape[0], img1.shape[1], resize, resize), "bilinear")
+            img2 = jax.image.resize(img2, (img2.shape[0], img2.shape[1], resize, resize), "bilinear")
+        per_pair = learned_perceptual_image_patch_similarity(img1, img2, sim_net, reduction="none")
+        distances.append(per_pair / (epsilon**2))
+    dist = jnp.concatenate(distances)
+
+    if lower_discard is not None or upper_discard is not None:
+        lo = jnp.quantile(dist, lower_discard) if lower_discard is not None else -jnp.inf
+        hi = jnp.quantile(dist, upper_discard) if upper_discard is not None else jnp.inf
+        mask = (dist >= lo) & (dist <= hi)
+        kept = jnp.where(mask, dist, 0.0)
+        n = jnp.maximum(mask.sum(), 1)
+        mean = kept.sum() / n
+        std = jnp.sqrt(jnp.where(mask, (dist - mean) ** 2, 0.0).sum() / n)
+        return mean, std, dist
+    return dist.mean(), dist.std(), dist
+
+
+class PerceptualPathLength(Metric):
+    """PPL as a metric object: ``update`` is a no-op (the generator is
+    sampled at compute), mirroring the reference's design where the metric
+    owns the sampling loop."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = False
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        num_samples: int = 10_000,
+        conditional: bool = False,
+        batch_size: int = 128,
+        interpolation_method: str = "lerp",
+        epsilon: float = 1e-4,
+        resize: Optional[int] = 64,
+        lower_discard: Optional[float] = 0.01,
+        upper_discard: Optional[float] = 0.99,
+        sim_net: Optional[Callable] = None,
+        latent_dim: int = 128,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_samples = num_samples
+        self.conditional = conditional
+        self.batch_size = batch_size
+        self.interpolation_method = interpolation_method
+        self.epsilon = epsilon
+        self.resize = resize
+        self.lower_discard = lower_discard
+        self.upper_discard = upper_discard
+        self.sim_net = sim_net
+        self.latent_dim = latent_dim
+        self._generator: Optional[Callable] = None
+        self.add_state("dummy", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, generator: Callable[[Array], Array]) -> None:
+        """Register the generator to be path-sampled at compute."""
+        self._generator = generator
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        if self._generator is None:
+            raise RuntimeError("No generator registered; call update(generator) first.")
+        return perceptual_path_length(
+            self._generator,
+            num_samples=self.num_samples,
+            conditional=self.conditional,
+            batch_size=self.batch_size,
+            interpolation_method=self.interpolation_method,
+            epsilon=self.epsilon,
+            resize=self.resize,
+            lower_discard=self.lower_discard,
+            upper_discard=self.upper_discard,
+            sim_net=self.sim_net,
+            latent_dim=self.latent_dim,
+        )

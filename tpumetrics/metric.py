@@ -453,9 +453,10 @@ class Metric(ABC):
                 elif isinstance(current_val, list):
                     if reduction_fn is None:
                         # ragged per-item list (e.g. per-image detection
-                        # states): item boundaries are part of the state, so
-                        # each item is gathered separately (reference uses
-                        # all_gather_object, detection/mean_ap.py:994-1024)
+                        # states): item boundaries are part of the state and
+                        # travel as a shape matrix beside the flattened data
+                        # (reference uses all_gather_object,
+                        # detection/mean_ap.py:994-1024)
                         object.__setattr__(
                             self, attr, _gather_ragged_list(backend, current_val, group, self._dtype)
                         )
@@ -1073,9 +1074,13 @@ def _gather_ragged_list(
 ) -> List[Array]:
     """Gather a reduce-None ragged list across ranks, preserving item
     boundaries with two collectives per state: one gather of the per-item
-    row-length vector and one of the concatenated rows, split back on
-    receipt. Eager backends only — in-trace ragged gathers need the
-    fixed-capacity MaskedBuffer states instead."""
+    shape matrix and one of the fully-flattened elements, split + reshaped
+    back on receipt. Items may be ragged in every dimension (e.g. per-image
+    (D_i, G_i) IoU matrices) and of any rank incl. 0-d. Eager backends
+    only — in-trace ragged gathers need the fixed-capacity MaskedBuffer
+    states instead."""
+    import numpy as np
+
     from tpumetrics.utils.data import _is_tracer
 
     if any(_is_tracer(v) for v in items):
@@ -1083,20 +1088,29 @@ def _gather_ragged_list(
             "Ragged (dist_reduce_fx=None) list states cannot be gathered inside jit;"
             " declare a fixed capacity for the state (set_state_capacity) to sync in-trace."
         )
-    lengths = jnp.asarray([v.shape[0] for v in items], jnp.int32)
+    # each row is [ndim, d0, d1, ...] padded with trailing 1s so mixed-rank
+    # items round-trip with their exact rank (a bare shape row cannot tell
+    # (3,) from (3, 1))
+    rank_ndim = max((v.ndim for v in items), default=1)
+    shapes = jnp.asarray(
+        [(v.ndim,) + tuple(v.shape) + (1,) * (rank_ndim - v.ndim) for v in items], jnp.int32
+    ).reshape(len(items), 1 + rank_ndim)
     if items:
-        data = jnp.concatenate([jnp.atleast_1d(v) for v in items], axis=0)
+        data = jnp.concatenate([jnp.ravel(v) for v in items])
     else:
         data = jnp.zeros((0,), fallback_dtype)
 
-    gathered_lengths = backend.all_gather(lengths, group=group)
+    gathered_shapes = backend.all_gather(shapes, group=group)
     gathered_data = backend.all_gather(data, group=group)
 
     out: List[Array] = []
-    for rank_lengths, rank_data in zip(gathered_lengths, gathered_data):
+    for rank_shapes, rank_data in zip(gathered_shapes, gathered_data):
         offset = 0
-        for n in [int(x) for x in rank_lengths]:
-            out.append(rank_data[offset : offset + n])
+        for shape_row in np.asarray(rank_shapes).reshape(-1, np.asarray(rank_shapes).shape[-1]):
+            ndim = int(shape_row[0])
+            shape = tuple(int(x) for x in shape_row[1 : 1 + ndim])
+            n = int(np.prod(shape))
+            out.append(rank_data[offset : offset + n].reshape(shape))
             offset += n
     return out
 

@@ -1,0 +1,9 @@
+"""Multimodal metric domain (counterpart of reference ``multimodal/__init__.py``)."""
+
+from tpumetrics.multimodal.clip_iqa import CLIPImageQualityAssessment
+from tpumetrics.multimodal.clip_score import CLIPScore
+
+__all__ = [
+    "CLIPImageQualityAssessment",
+    "CLIPScore",
+]

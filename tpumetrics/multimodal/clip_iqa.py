@@ -1,0 +1,63 @@
+"""CLIPImageQualityAssessment metric (counterpart of reference
+``multimodal/clip_iqa.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.multimodal.clip_iqa import (
+    _clip_iqa_format_prompts,
+    clip_image_quality_assessment,
+)
+from tpumetrics.functional.multimodal.clip_score import _get_clip_model_and_processor
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class CLIPImageQualityAssessment(Metric):
+    """CLIP-IQA accumulated over batches: per-prompt probability sums."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        model_name_or_path: Union[str, Tuple[Any, Any]] = "clip_iqa",
+        data_range: float = 1.0,
+        prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",),
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.prompts_names, _ = _clip_iqa_format_prompts(prompts)
+        self.prompts = prompts
+        self.model, self.processor = _get_clip_model_and_processor(model_name_or_path)
+        self.model_name_or_path = (self.model, self.processor)
+        self.data_range = data_range
+        n = len(self.prompts_names)
+        self.add_state("score_sums", jnp.zeros(n), dist_reduce_fx="sum")
+        self.add_state("n_samples", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, images: Array) -> None:
+        """Accumulate per-prompt probability sums."""
+        out = clip_image_quality_assessment(
+            images, self.model_name_or_path, self.data_range, self.prompts
+        )
+        if isinstance(out, dict):
+            sums = jnp.stack([out[name].sum() for name in self.prompts_names])
+        else:
+            sums = jnp.asarray([out.sum()])
+        self.score_sums = self.score_sums + sums
+        self.n_samples = self.n_samples + jnp.asarray(images.shape[0], jnp.float32)
+
+    def compute(self) -> Union[Array, Dict[str, Array]]:
+        means = self.score_sums / self.n_samples
+        if len(self.prompts_names) == 1:
+            return means[0]
+        return {name: means[i] for i, name in enumerate(self.prompts_names)}

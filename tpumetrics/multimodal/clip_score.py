@@ -1,0 +1,48 @@
+"""CLIPScore metric (counterpart of reference ``multimodal/clip_score.py:43``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.multimodal.clip_score import _clip_score_update, _get_clip_model_and_processor
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class CLIPScore(Metric):
+    """CLIPScore accumulated over batches: scalar sum + count states
+    (reference multimodal/clip_score.py:115-116).
+
+    Args:
+        model_name_or_path: HF hub id of a CLIP checkpoint, or an explicit
+            ``(model, processor)`` pair for offline/custom models.
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 100.0
+
+    def __init__(
+        self,
+        model_name_or_path: Union[str, Tuple[Any, Any]] = "openai/clip-vit-large-patch14",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model, self.processor = _get_clip_model_and_processor(model_name_or_path)
+        self.add_state("score", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("n_samples", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, images: Union[Array, List[Array]], text: Union[str, List[str]]) -> None:
+        """Accumulate similarity sums (reference multimodal/clip_score.py:118-129)."""
+        score, n_samples = _clip_score_update(images, text, self.model, self.processor)
+        self.score = self.score + score.sum()
+        self.n_samples = self.n_samples + n_samples
+
+    def compute(self) -> Array:
+        return jnp.maximum(self.score / self.n_samples, jnp.zeros(()))

@@ -1,10 +1,12 @@
 """Text metric domain (counterpart of reference ``text/__init__.py``)."""
 
+from tpumetrics.text.bert import BERTScore
 from tpumetrics.text.bleu import BLEUScore
 from tpumetrics.text.cer import CharErrorRate
 from tpumetrics.text.chrf import CHRFScore
 from tpumetrics.text.edit import EditDistance
 from tpumetrics.text.eed import ExtendedEditDistance
+from tpumetrics.text.infolm import InfoLM
 from tpumetrics.text.mer import MatchErrorRate
 from tpumetrics.text.perplexity import Perplexity
 from tpumetrics.text.rouge import ROUGEScore
@@ -16,11 +18,13 @@ from tpumetrics.text.wil import WordInfoLost
 from tpumetrics.text.wip import WordInfoPreserved
 
 __all__ = [
+    "BERTScore",
     "BLEUScore",
     "CHRFScore",
     "CharErrorRate",
     "EditDistance",
     "ExtendedEditDistance",
+    "InfoLM",
     "MatchErrorRate",
     "Perplexity",
     "ROUGEScore",

@@ -1,0 +1,110 @@
+"""BERTScore metric (counterpart of reference ``text/bert.py:54``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.text.bert import bert_score
+from tpumetrics.metric import Metric
+from tpumetrics.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class BERTScore(Metric):
+    """BERTScore accumulated over batches.
+
+    Where the reference stores tokenized input-id/attention-mask cat states
+    and runs the model inside ``compute`` (reference text/bert.py:191-194),
+    the raw sentences are stored here and embedded at compute — strings
+    cannot live in device states, and this keeps update cheap while the
+    heavy model forward batches once at the end.
+
+    Args:
+        model_name_or_path: transformers hub id (gated when not downloadable).
+        model / user_tokenizer / user_forward_fn: custom embedding stack.
+        idf: inverse-document-frequency weighting over the reference corpus.
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        num_layers: Optional[int] = None,
+        all_layers: bool = False,
+        model: Optional[Any] = None,
+        user_tokenizer: Optional[Any] = None,
+        user_forward_fn: Optional[Callable] = None,
+        verbose: bool = False,
+        idf: bool = False,
+        max_length: int = 512,
+        batch_size: int = 64,
+        return_hash: bool = False,
+        lang: str = "en",
+        rescale_with_baseline: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model_name_or_path = model_name_or_path
+        self.num_layers = num_layers
+        self.all_layers = all_layers
+        self.model = model
+        self.user_tokenizer = user_tokenizer
+        self.user_forward_fn = user_forward_fn
+        self.verbose = verbose
+        self.idf = idf
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self.return_hash = return_hash
+        self.lang = lang
+        self.rescale_with_baseline = rescale_with_baseline
+
+        self._preds: List[str] = []
+        self._target: List[str] = []
+        self.add_state("dummy", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        """Store sentences for the compute-time embedding pass."""
+        if isinstance(preds, str):
+            preds = [preds]
+        if isinstance(target, str):
+            target = [target]
+        if len(preds) != len(target):
+            raise ValueError(
+                f"Expected argument `preds` and `target` to have same length, but got {len(preds)} and {len(target)}"
+            )
+        self._preds.extend(preds)
+        self._target.extend(target)
+
+    def compute(self) -> Dict[str, Array]:
+        """Embed everything and score (reference text/bert.py compute)."""
+        return bert_score(
+            self._preds,
+            self._target,
+            model_name_or_path=self.model_name_or_path,
+            num_layers=self.num_layers,
+            all_layers=self.all_layers,
+            model=self.model,
+            user_tokenizer=self.user_tokenizer,
+            user_forward_fn=self.user_forward_fn,
+            verbose=self.verbose,
+            idf=self.idf,
+            max_length=self.max_length,
+            batch_size=self.batch_size,
+            return_hash=self.return_hash,
+            lang=self.lang,
+            rescale_with_baseline=self.rescale_with_baseline,
+        )
+
+    def reset(self) -> None:
+        super().reset()
+        self._preds = []
+        self._target = []

@@ -1,0 +1,87 @@
+"""InfoLM metric (counterpart of reference ``text/infolm.py:41``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.functional.text.infolm import _InformationMeasure, infolm
+from tpumetrics.metric import Metric
+
+Array = jax.Array
+
+
+class InfoLM(Metric):
+    """InfoLM accumulated over batches (sentences stored, embedded at compute
+    like :class:`~tpumetrics.text.bert.BERTScore`)."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = False
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        model_name_or_path: str = "bert-base-uncased",
+        temperature: float = 0.25,
+        information_measure: str = "kl_divergence",
+        idf: bool = True,
+        alpha: Optional[float] = None,
+        beta: Optional[float] = None,
+        max_length: Optional[int] = None,
+        return_sentence_level_score: bool = False,
+        model: Optional[Any] = None,
+        user_tokenizer: Optional[Any] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        _InformationMeasure(information_measure, alpha, beta)  # validate early
+        self.model_name_or_path = model_name_or_path
+        self.temperature = temperature
+        self.information_measure = information_measure
+        self.idf = idf
+        self.alpha = alpha
+        self.beta = beta
+        self.max_length = max_length
+        self.return_sentence_level_score = return_sentence_level_score
+        self.model = model
+        self.user_tokenizer = user_tokenizer
+
+        self._preds: List[str] = []
+        self._target: List[str] = []
+        self.add_state("dummy", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        """Store sentences for the compute-time model pass."""
+        if isinstance(preds, str):
+            preds = [preds]
+        if isinstance(target, str):
+            target = [target]
+        if len(preds) != len(target):
+            raise ValueError(
+                f"Expected argument `preds` and `target` to have same length, but got {len(preds)} and {len(target)}"
+            )
+        self._preds.extend(preds)
+        self._target.extend(target)
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        return infolm(
+            self._preds,
+            self._target,
+            model_name_or_path=self.model_name_or_path,
+            temperature=self.temperature,
+            information_measure=self.information_measure,
+            idf=self.idf,
+            alpha=self.alpha,
+            beta=self.beta,
+            max_length=self.max_length,
+            return_sentence_level_score=self.return_sentence_level_score,
+            model=self.model,
+            user_tokenizer=self.user_tokenizer,
+        )
+
+    def reset(self) -> None:
+        super().reset()
+        self._preds = []
+        self._target = []
