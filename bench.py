@@ -1,11 +1,14 @@
 """Headline benchmark: metric update+compute latency per step (the hot loop).
 
-Measures the jitted fused update+compute step of ``MulticlassAccuracy`` on a
-large batch (BASELINE.md north star: "metric update+sync us/step"), and
-compares against the reference TorchMetrics implementation running on torch
-(CPU build in this image; the reference has no TPU path at all).
+Covers the BASELINE.md target configs:
+- MulticlassAccuracy jitted update+compute (headline; vs reference on torch)
+- MetricCollection(Accuracy, F1, AUROC) with dist_sync_on_step semantics,
+  synced in-trace over an 8-device mesh (subprocess with 8 virtual CPU
+  devices — the driver machine exposes one TPU chip)
+- detection.MeanAveragePrecision update+compute (ragged-state cost)
+- image.FrechetInceptionDistance streaming update (feature-state bandwidth)
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "details"}.
 ``vs_baseline`` = reference_us / ours_us (higher is better; >1 means faster
 than the reference).
 """
@@ -13,6 +16,8 @@ than the reference).
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -101,6 +106,141 @@ def _bench_reference() -> float:
     return (t1 - t0) / STEPS * 1e6  # us/step
 
 
+_COLLECTION_SYNC_SCRIPT = r"""
+import os, sys, time, json
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+sys.path.insert(0, "/root/repo")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from tpumetrics import MetricCollection
+from tpumetrics.classification import MulticlassAccuracy, MulticlassF1Score, MulticlassAUROC
+
+C, B, STEPS = 16, 1024, 20
+col = MetricCollection({
+    "acc": MulticlassAccuracy(num_classes=C, average="micro", validate_args=False),
+    "f1": MulticlassF1Score(num_classes=C, average="macro", validate_args=False),
+    "auroc": MulticlassAUROC(num_classes=C, validate_args=False, thresholds=64),
+})
+mesh = Mesh(np.asarray(jax.devices()[:8]), ("dp",))
+
+def sharded_step(state, preds, target):
+    # dist_sync_on_step: accumulate locally, sync in-trace, return batch vals
+    new_state, vals = col.functional_forward(state, preds, target, axis_name="dp")
+    return new_state, vals
+
+# no donation: compute-group leaders share state refs with trace constants
+step = jax.jit(
+    jax.shard_map(
+        sharded_step, mesh=mesh,
+        in_specs=(P(), P("dp"), P("dp")), out_specs=(P(), P()),
+        check_vma=False,
+    ),
+)
+rng = np.random.default_rng(0)
+preds = jnp.asarray(jax.nn.softmax(jnp.asarray(rng.standard_normal((B, C), dtype=np.float32))))
+target = jnp.asarray(rng.integers(0, C, size=(B,)), dtype=jnp.int32)
+state = col.init_state()
+state, vals = step(state, preds, target)
+jax.block_until_ready(vals)
+t0 = time.perf_counter()
+for _ in range(STEPS):
+    state, vals = step(state, preds, target)
+jax.block_until_ready(vals)
+t1 = time.perf_counter()
+print(json.dumps({"us_per_step": (t1 - t0) / STEPS * 1e6}))
+"""
+
+
+def _bench_collection_sync_8dev() -> float:
+    """Per-step latency of MetricCollection(Accuracy, F1, AUROC) with
+    in-trace cross-device sync (dist_sync_on_step) over an 8-device mesh.
+    Runs in a subprocess because the parent owns the TPU backend."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    out = subprocess.run(
+        [sys.executable, "-c", _COLLECTION_SYNC_SCRIPT],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(out.stderr[-2000:])
+    return float(json.loads(out.stdout.strip().splitlines()[-1])["us_per_step"])
+
+
+def _bench_map() -> float:
+    """MeanAveragePrecision update+compute on synthetic detections — the
+    ragged-state path (variable boxes per image)."""
+    import jax.numpy as jnp
+
+    from tpumetrics.detection import MeanAveragePrecision
+
+    rng = np.random.default_rng(0)
+    n_imgs, steps = 16, 5
+
+    def boxes(n):
+        xy = rng.uniform(0, 80, size=(n, 2))
+        wh = rng.uniform(4, 20, size=(n, 2))
+        return np.concatenate([xy, xy + wh], axis=1)
+
+    preds, target = [], []
+    for i in range(n_imgs):
+        nd, ng = int(rng.integers(3, 12)), int(rng.integers(2, 8))
+        preds.append({
+            "boxes": jnp.asarray(boxes(nd), jnp.float32),
+            "scores": jnp.asarray(rng.uniform(0.1, 1.0, nd), jnp.float32),
+            "labels": jnp.asarray(rng.integers(0, 4, nd), jnp.int32),
+        })
+        target.append({
+            "boxes": jnp.asarray(boxes(ng), jnp.float32),
+            "labels": jnp.asarray(rng.integers(0, 4, ng), jnp.int32),
+        })
+
+    m = MeanAveragePrecision()
+    m.update(preds, target)  # warmup (traces IoU kernels)
+    m.compute()
+    m.reset()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        m.update(preds, target)
+        m.compute()
+        m.reset()  # fixed 16-image cost per step
+    t1 = time.perf_counter()
+    return (t1 - t0) / steps * 1e6
+
+
+def _bench_fid() -> float:
+    """FID streaming update throughput with a deterministic extractor —
+    exercises the large feature-state accumulation path."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpumetrics.image import FrechetInceptionDistance
+
+    dim, batch, steps = 256, 128, 20
+    rng = np.random.default_rng(0)
+    proj = jnp.asarray(rng.standard_normal((3 * 32 * 32, dim), dtype=np.float32))
+
+    def extractor(imgs):
+        flat = imgs.reshape(imgs.shape[0], -1).astype(jnp.float32)
+        return jnp.tanh(flat @ proj)
+
+    m = FrechetInceptionDistance(feature=extractor, num_features=dim)
+    real = jnp.asarray(rng.integers(0, 255, size=(batch, 3, 32, 32)), jnp.uint8)
+    fake = jnp.asarray(rng.integers(0, 255, size=(batch, 3, 32, 32)), jnp.uint8)
+    m.update(real, real=True)  # warmup
+    m.update(fake, real=False)
+    jax.block_until_ready(m.real_features_sum)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        m.update(real, real=True)
+        m.update(fake, real=False)
+    jax.block_until_ready(m.real_features_sum)
+    t1 = time.perf_counter()
+    return (t1 - t0) / steps * 1e6
+
+
 def main() -> None:
     ours_us = _bench_tpumetrics()
     try:
@@ -108,6 +248,18 @@ def main() -> None:
         vs_baseline = round(ref_us / ours_us, 3)
     except Exception:
         vs_baseline = None  # baseline unavailable — not a measured tie
+
+    details = {}
+    for name, fn in (
+        ("collection_sync_8dev_us", _bench_collection_sync_8dev),
+        ("map_ragged_update_compute_us", _bench_map),
+        ("fid_stream_update_us", _bench_fid),
+    ):
+        try:
+            details[name] = round(fn(), 2)
+        except Exception as err:  # sub-bench failure must not kill the headline
+            details[name] = f"error: {type(err).__name__}"
+
     print(
         json.dumps(
             {
@@ -115,6 +267,7 @@ def main() -> None:
                 "value": round(ours_us, 2),
                 "unit": "us/step",
                 "vs_baseline": vs_baseline,
+                "details": details,
             }
         )
     )

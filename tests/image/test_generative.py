@@ -232,3 +232,35 @@ def test_ppl_matches_definition_and_gates_conditional():
 
     with pytest.raises(NotImplementedError):
         perceptual_path_length(gen, conditional=True, sim_net=toy_net)
+
+
+def test_inception_score_fewer_samples_than_splits():
+    """n < splits must yield fewer non-empty chunks, never NaN (torch.chunk
+    semantics)."""
+    from tpumetrics.image import InceptionScore
+
+    def extractor(x):
+        return x.reshape(x.shape[0], -1)[:, :16].astype(jnp.float32)
+
+    m = InceptionScore(feature=extractor, splits=10)
+    imgs = jax.random.randint(jax.random.PRNGKey(0), (8, 3, 8, 8), 0, 255).astype(jnp.uint8)
+    m.update(imgs)
+    mean, std = m.compute()
+    assert np.isfinite(float(mean)) and np.isfinite(float(std))
+
+
+def test_ppl_honors_num_samples():
+    from tpumetrics.image.perceptual_path_length import perceptual_path_length
+
+    def toy_net(x):
+        return [x, jnp.tanh(x) + 0.3 * x]
+
+    W = jax.random.normal(jax.random.PRNGKey(2), (8, 3 * 8 * 8))
+
+    def gen(z):
+        return (z @ W).reshape(z.shape[0], 3, 8, 8)
+
+    for n, b in ((10, 64), (100, 64)):
+        _, _, dist = perceptual_path_length(gen, num_samples=n, batch_size=b, resize=None,
+                                            sim_net=toy_net, latent_dim=8)
+        assert dist.shape == (n,), (n, b, dist.shape)

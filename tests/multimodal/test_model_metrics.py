@@ -246,3 +246,31 @@ def test_clip_iqa(tiny_clip):
 def test_clip_score_gated_default():
     with pytest.raises(ModuleNotFoundError, match="network"):
         CLIPScore(model_name_or_path="openai/clip-not-cached")
+
+
+def test_bert_score_batched_forward_matches_single():
+    """Chunked model forwards (batch_size) must not change scores."""
+    from tpumetrics.functional.text import bert_score
+
+    tok = _WordTokenizer()
+    emb = _ToyEmbedder()
+    preds = ["the cat sat", "a dog ran fast", "hello", "one two three four"]
+    target = ["the cat sat down", "a dog ran", "hello there", "one two three"]
+    big = bert_score(preds, target, model=emb, user_tokenizer=tok, user_forward_fn=emb, batch_size=64)
+    tiny = bert_score(preds, target, model=emb, user_tokenizer=tok, user_forward_fn=emb, batch_size=1)
+    for k in ("precision", "recall", "f1"):
+        assert np.allclose(np.asarray(big[k]), np.asarray(tiny[k]), atol=1e-6), k
+
+
+def test_text_model_metrics_refuse_string_state_sync():
+    """Sentence buffers are host strings; a cross-process sync must raise
+    rather than silently score one rank's shard."""
+    from tpumetrics.metric import TPUMetricsUserError
+    from tpumetrics.text import BERTScore
+
+    tok = _WordTokenizer()
+    emb = _ToyEmbedder()
+    m = BERTScore(model=emb, user_tokenizer=tok, user_forward_fn=emb)
+    m.update(["a b"], ["a b"])
+    with pytest.raises(TPUMetricsUserError):
+        m._sync_dist()

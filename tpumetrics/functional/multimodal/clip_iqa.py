@@ -3,7 +3,7 @@
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Tuple, Union
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -60,17 +60,30 @@ def _clip_iqa_format_prompts(prompts: Tuple[Union[str, Tuple[str, str]], ...]) -
     return prompts_names, prompts_list
 
 
+def _clip_iqa_text_features(model: Any, processor: Any, prompts_list: Any) -> Array:
+    """Unit-normalized anchor embeddings of the antonym prompts; they depend
+    only on the prompts, so callers streaming many image batches should
+    compute them once (the class metric caches them at construction)."""
+    processed = processor(text=prompts_list, return_tensors="np", padding=True)
+    txt = jnp.asarray(
+        model.get_text_features(jnp.asarray(processed["input_ids"]), jnp.asarray(processed["attention_mask"]))
+    )
+    return txt / jnp.linalg.norm(txt, axis=-1, keepdims=True)
+
+
 def clip_image_quality_assessment(
     images: Array,
     model_name_or_path: Union[str, Tuple[Any, Any]] = "clip_iqa",
     data_range: float = 1.0,
     prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",),
+    text_features: Optional[Array] = None,
 ) -> Union[Array, Dict[str, Array]]:
     """CLIP-IQA: softmax of the image's similarity to antonym prompt pairs
     (reference clip_iqa.py).
 
     ``model_name_or_path`` accepts an explicit ``(model, processor)`` pair
-    for offline/custom CLIP checkpoints.
+    for offline/custom CLIP checkpoints. ``text_features`` skips the text
+    tower with precomputed anchors (see :func:`_clip_iqa_text_features`).
     """
     prompts_names, prompts_list = _clip_iqa_format_prompts(prompts)
     model, processor = _get_clip_model_and_processor(model_name_or_path)
@@ -79,15 +92,14 @@ def clip_image_quality_assessment(
     if images.ndim != 4:
         raise ValueError(f"Expected 4D (N, C, H, W) image input but got {images.shape}")
 
-    processed = processor(
-        text=prompts_list, images=list(jax.device_get(images)), return_tensors="np", padding=True
-    )
+    processed = processor(images=list(jax.device_get(images)), return_tensors="np")
     img_features = jnp.asarray(model.get_image_features(jnp.asarray(processed["pixel_values"])))
     img_features = img_features / jnp.linalg.norm(img_features, axis=-1, keepdims=True)
-    txt_features = jnp.asarray(
-        model.get_text_features(jnp.asarray(processed["input_ids"]), jnp.asarray(processed["attention_mask"]))
+    txt_features = (
+        jnp.asarray(text_features)
+        if text_features is not None
+        else _clip_iqa_text_features(model, processor, prompts_list)
     )
-    txt_features = txt_features / jnp.linalg.norm(txt_features, axis=-1, keepdims=True)
 
     logits = 100 * img_features @ txt_features.T  # (N, 2 * num_prompts)
     logits = logits.reshape(logits.shape[0], -1, 2)

@@ -119,20 +119,30 @@ def _embed(
     idf: bool,
     idf_map: Optional[Dict[int, float]] = None,
     num_layers: Optional[int] = None,
+    batch_size: int = 64,
 ) -> Tuple[Array, Array, List[List[int]]]:
     """Tokenize + embed + unit-normalize + mask; returns (embeddings,
-    idf-or-uniform token weights, token id lists)."""
+    idf-or-uniform token weights, token id lists). The model forward runs in
+    ``batch_size`` chunks so corpus size never sets device memory."""
     batch = _tokenize_padded(tokenizer, sentences, max_length)
     input_ids = batch["input_ids"]
     attention_mask = batch["attention_mask"]
-    model_batch = {"input_ids": input_ids, "attention_mask": attention_mask}
 
-    if user_forward_fn is not None:
-        emb = jnp.asarray(user_forward_fn(model, model_batch))
-        if emb.ndim == 3:
-            emb = emb[:, None]
-    else:
-        emb = _default_forward(model, model_batch, all_layers, num_layers)
+    chunks = []
+    step = max(1, batch_size)
+    for lo in range(0, len(sentences), step):
+        model_batch = {
+            "input_ids": input_ids[lo : lo + step],
+            "attention_mask": attention_mask[lo : lo + step],
+        }
+        if user_forward_fn is not None:
+            part = jnp.asarray(user_forward_fn(model, model_batch))
+            if part.ndim == 3:
+                part = part[:, None]
+        else:
+            part = _default_forward(model, model_batch, all_layers, num_layers)
+        chunks.append(part)
+    emb = jnp.concatenate(chunks, axis=0)
 
     emb = emb / jnp.clip(jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-12)
     mask = jnp.asarray(attention_mask, jnp.float32)
@@ -207,13 +217,29 @@ def bert_score(
         idf_map = _compute_idf(token_lists, len(target))
 
     preds_emb, preds_scale, _ = _embed(
-        list(preds), model, tokenizer, user_forward_fn, all_layers, max_length, idf, idf_map, num_layers
+        list(preds), model, tokenizer, user_forward_fn, all_layers, max_length, idf, idf_map,
+        num_layers, batch_size
     )
     target_emb, target_scale, _ = _embed(
-        list(target), model, tokenizer, user_forward_fn, all_layers, max_length, idf, idf_map, num_layers
+        list(target), model, tokenizer, user_forward_fn, all_layers, max_length, idf, idf_map,
+        num_layers, batch_size
     )
 
-    precision, recall, f1 = _get_precision_recall_f1(preds_emb, target_emb, preds_scale, target_scale)
+    # score in chunks too: the (b, l, p, r) similarity tensor is the peak
+    step = max(1, batch_size)
+    parts = []
+    for lo in range(0, preds_emb.shape[0], step):
+        parts.append(
+            _get_precision_recall_f1(
+                preds_emb[lo : lo + step],
+                target_emb[lo : lo + step],
+                preds_scale[lo : lo + step],
+                target_scale[lo : lo + step],
+            )
+        )
+    precision = jnp.concatenate([jnp.atleast_1d(p) for p, _, _ in parts])
+    recall = jnp.concatenate([jnp.atleast_1d(r) for _, r, _ in parts])
+    f1 = jnp.concatenate([jnp.atleast_1d(f) for _, _, f in parts])
     output = {"precision": precision, "recall": recall, "f1": f1}
     if return_hash:
         output["hash"] = f"tpumetrics-bert_score-idf:{idf}"  # type: ignore[assignment]

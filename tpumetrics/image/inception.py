@@ -76,13 +76,21 @@ class InceptionScore(Metric):
         prob = jax.nn.softmax(features, axis=1)
         log_prob = jax.nn.log_softmax(features, axis=1)
 
-        prob_chunks = jnp.array_split(prob, self.splits, axis=0)
-        log_prob_chunks = jnp.array_split(log_prob, self.splits, axis=0)
+        # torch.chunk semantics: chunk size ceil(n/splits) yields at most
+        # `splits` chunks, all non-empty — array_split would emit empty
+        # chunks (and NaN means) when n < splits
+        n = int(prob.shape[0])
+        chunk = -(-n // self.splits) if n else 1
+        bounds = list(range(0, n, chunk)) or [0]
+        prob_chunks = [prob[i : i + chunk] for i in bounds]
+        log_prob_chunks = [log_prob[i : i + chunk] for i in bounds]
 
         kl_list = []
         for p, log_p in zip(prob_chunks, log_prob_chunks):
             mean_prob = p.mean(axis=0, keepdims=True)
-            kl = p * (log_p - jnp.log(mean_prob))
+            # p == 0 contributes 0 to the KL; the raw expression is
+            # 0 * log(0) = NaN when a class prob underflows
+            kl = jnp.where(p > 0, p * (log_p - jnp.log(mean_prob)), 0.0)
             kl_list.append(jnp.exp(kl.sum(axis=1).mean()))
         kl_arr = jnp.stack(kl_list)
         return kl_arr.mean(), kl_arr.std()

@@ -72,7 +72,7 @@ def perceptual_path_length(
         )
     key = key if key is not None else jax.random.PRNGKey(0)
     distances = []
-    num_batches = max(1, num_samples // batch_size)
+    num_batches = -(-num_samples // batch_size)  # ceil: sample at least num_samples
     for i in range(num_batches):
         key, k1, k2, k3 = jax.random.split(key, 4)
         z1 = jax.random.normal(k1, (batch_size, latent_dim))
@@ -90,7 +90,7 @@ def perceptual_path_length(
             img2 = jax.image.resize(img2, (img2.shape[0], img2.shape[1], resize, resize), "bilinear")
         per_pair = learned_perceptual_image_patch_similarity(img1, img2, sim_net, reduction="none")
         distances.append(per_pair / (epsilon**2))
-    dist = jnp.concatenate(distances)
+    dist = jnp.concatenate(distances)[:num_samples]
 
     if lower_discard is not None or upper_discard is not None:
         lo = jnp.quantile(dist, lower_discard) if lower_discard is not None else -jnp.inf

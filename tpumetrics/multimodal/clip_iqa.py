@@ -10,6 +10,7 @@ import jax.numpy as jnp
 
 from tpumetrics.functional.multimodal.clip_iqa import (
     _clip_iqa_format_prompts,
+    _clip_iqa_text_features,
     clip_image_quality_assessment,
 )
 from tpumetrics.functional.multimodal.clip_score import _get_clip_model_and_processor
@@ -35,11 +36,13 @@ class CLIPImageQualityAssessment(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        self.prompts_names, _ = _clip_iqa_format_prompts(prompts)
+        self.prompts_names, prompts_list = _clip_iqa_format_prompts(prompts)
         self.prompts = prompts
         self.model, self.processor = _get_clip_model_and_processor(model_name_or_path)
         self.model_name_or_path = (self.model, self.processor)
         self.data_range = data_range
+        # prompt anchors depend only on `prompts`: encode once, reuse every update
+        self._text_features = _clip_iqa_text_features(self.model, self.processor, prompts_list)
         n = len(self.prompts_names)
         self.add_state("score_sums", jnp.zeros(n), dist_reduce_fx="sum")
         self.add_state("n_samples", jnp.zeros(()), dist_reduce_fx="sum")
@@ -47,7 +50,8 @@ class CLIPImageQualityAssessment(Metric):
     def update(self, images: Array) -> None:
         """Accumulate per-prompt probability sums."""
         out = clip_image_quality_assessment(
-            images, self.model_name_or_path, self.data_range, self.prompts
+            images, self.model_name_or_path, self.data_range, self.prompts,
+            text_features=self._text_features,
         )
         if isinstance(out, dict):
             sums = jnp.stack([out[name].sum() for name in self.prompts_names])

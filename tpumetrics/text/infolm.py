@@ -85,3 +85,16 @@ class InfoLM(Metric):
         super().reset()
         self._preds = []
         self._target = []
+
+    def _sync_dist(self, dist_sync_fn=None, process_group=None) -> None:
+        """Sentence buffers are Python strings, outside the array sync path —
+        refuse a cross-process sync rather than silently scoring only this
+        rank's shard (the registered array states alone would gather)."""
+        from tpumetrics.metric import TPUMetricsUserError
+
+        raise TPUMetricsUserError(
+            f"{type(self).__name__} keeps raw sentences as host-side state and cannot"
+            " dist-sync them; compute per process and aggregate the returned scores,"
+            " or gather the sentences before update()."
+        )
+
