@@ -109,7 +109,7 @@ def _bench_reference() -> float:
 _COLLECTION_SYNC_SCRIPT = r"""
 import os, sys, time, json
 os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, {repo_dir!r})
 import jax
 jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
@@ -131,13 +131,13 @@ def sharded_step(state, preds, target):
     new_state, vals = col.functional_forward(state, preds, target, axis_name="dp")
     return new_state, vals
 
-# no donation: compute-group leaders share state refs with trace constants
 step = jax.jit(
     jax.shard_map(
         sharded_step, mesh=mesh,
         in_specs=(P(), P("dp"), P("dp")), out_specs=(P(), P()),
         check_vma=False,
     ),
+    donate_argnums=(0,),
 )
 rng = np.random.default_rng(0)
 preds = jnp.asarray(jax.nn.softmax(jnp.asarray(rng.standard_normal((B, C), dtype=np.float32))))
@@ -160,8 +160,11 @@ def _bench_collection_sync_8dev() -> float:
     Runs in a subprocess because the parent owns the TPU backend."""
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
+    script = _COLLECTION_SYNC_SCRIPT.replace(
+        "{repo_dir!r}", repr(os.path.dirname(os.path.abspath(__file__)))
+    )
     out = subprocess.run(
-        [sys.executable, "-c", _COLLECTION_SYNC_SCRIPT],
+        [sys.executable, "-c", script],
         capture_output=True, text=True, timeout=300, env=env,
     )
     if out.returncode != 0:

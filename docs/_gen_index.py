@@ -1,0 +1,28 @@
+"""Regenerate docs/metrics_index.md from the live package."""
+import importlib
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from tpumetrics.metric import Metric
+
+DOMS = ["aggregation", "classification", "regression", "clustering", "nominal", "retrieval",
+        "image", "text", "audio", "detection", "multimodal", "wrappers"]
+
+lines = ["# All metrics", "", "Generated from the live package (`python docs/_gen_index.py`).", ""]
+total = 0
+for d in DOMS:
+    mod = importlib.import_module(f"tpumetrics.{d}")
+    names = sorted(n for n, o in vars(mod).items()
+                   if inspect.isclass(o) and issubclass(o, Metric) and o is not Metric
+                   and not n.startswith("_"))
+    total += len(names)
+    lines.append(f"## `tpumetrics.{d}` ({len(names)})\n")
+    lines.extend(f"- `{n}`" for n in names)
+    lines.append("")
+lines.insert(3, f"**{total} metric classes**, each with a `tpumetrics.functional.*`"
+                " counterpart where the reference has one.\n")
+out = os.path.join(os.path.dirname(__file__), "metrics_index.md")
+open(out, "w").write("\n".join(lines) + "\n")
+print("wrote", out)

@@ -1,0 +1,72 @@
+"""Plotting metric values (analogue of reference ``examples/plotting.py``).
+
+Every metric has ``.plot()``: scalar metrics render single/multi values,
+confusion matrices render as annotated grids, and curve metrics (ROC,
+precision-recall) render as curves. Figures are written to
+``examples/_plots/`` (non-interactive Agg backend).
+
+Run:
+    python examples/plotting.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import jax
+import jax.numpy as jnp
+
+OUT_DIR = os.path.join(os.path.dirname(__file__), "_plots")
+
+
+def _save(fig, name):
+    os.makedirs(OUT_DIR, exist_ok=True)
+    path = os.path.join(OUT_DIR, name)
+    fig.savefig(path)
+    print("wrote", path)
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    preds = jax.nn.softmax(jax.random.normal(k1, (128, 3)), axis=-1)
+    target = jax.random.randint(k2, (128,), 0, 3)
+
+    # scalar metric over several steps -> line plot
+    from tpumetrics.classification import MulticlassAccuracy
+
+    acc = MulticlassAccuracy(num_classes=3)
+    values = []
+    for lo in range(0, 128, 32):
+        values.append(acc(preds[lo : lo + 32], target[lo : lo + 32]))
+    fig, _ = acc.plot(values)
+    _save(fig, "accuracy_over_steps.png")
+
+    # confusion matrix -> annotated grid
+    from tpumetrics.classification import MulticlassConfusionMatrix
+
+    confmat = MulticlassConfusionMatrix(num_classes=3)
+    confmat.update(preds, target)
+    fig, _ = confmat.plot()
+    _save(fig, "confusion_matrix.png")
+
+    # ROC -> one curve per class
+    from tpumetrics.classification import MulticlassROC
+
+    roc = MulticlassROC(num_classes=3, thresholds=None)
+    roc.update(preds, target)
+    fig, _ = roc.plot()
+    _save(fig, "roc.png")
+
+    print("plotting OK")
+
+
+if __name__ == "__main__":
+    main()

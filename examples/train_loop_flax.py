@@ -1,0 +1,137 @@
+"""Training-loop integration: a flax/optax classifier logging a
+``MetricCollection`` (analogue of reference ``tests/integrations/test_lightning.py``
+and ``examples/``).
+
+The whole step — forward, loss, gradient, optimizer update, AND metric
+update — is one jitted, mesh-sharded function. Metric state is an explicit
+pytree threaded through the step (the functional API), so it lives on
+device, shards with the data, and syncs over the mesh axis in-trace: no
+host round-trips in the hot loop, which is the TPU-first redesign of the
+reference's module-state + hook pattern.
+
+Run (any machine; forces an 8-device CPU mesh when no 8-chip TPU exists):
+    python examples/train_loop_flax.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "") and None
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+if len(jax.devices()) < 8 or jax.default_backend() != "tpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpumetrics import MetricCollection
+from tpumetrics.aggregation import MeanMetric
+from tpumetrics.classification import MulticlassAccuracy, MulticlassF1Score
+
+NUM_CLASSES = 10
+BATCH = 512  # global batch, sharded over the dp axis
+STEPS_PER_EPOCH = 20
+EPOCHS = 3
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        return nn.Dense(NUM_CLASSES)(x)
+
+
+def make_data(key, n=BATCH * STEPS_PER_EPOCH):
+    """Linearly separable-ish synthetic classification data."""
+    kx, kw = jax.random.split(key)
+    x = jax.random.normal(kx, (n, 32))
+    w_true = jax.random.normal(kw, (32, NUM_CLASSES))
+    y = jnp.argmax(x @ w_true + 0.5 * jax.random.normal(kx, (n, NUM_CLASSES)), axis=-1)
+    return x, y
+
+
+def main():
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("dp",))
+    model = MLP()
+    tx = optax.adam(1e-2)
+
+    metrics = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False),
+            "f1": MulticlassF1Score(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+        }
+    )
+    loss_metric = MeanMetric()  # different update signature -> own state
+
+    key = jax.random.PRNGKey(0)
+    params = model.init(key, jnp.zeros((1, 32)))
+    opt_state = tx.init(params)
+    x_all, y_all = make_data(key)
+
+    def train_step(params, opt_state, metric_state, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean(), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        grads = jax.lax.pmean(grads, "dp")  # data-parallel gradient sync over ICI
+        loss = jax.lax.pmean(loss, "dp")
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+
+        # metric accumulation is part of the same compiled program
+        cls_state, loss_state = metric_state
+        cls_state = metrics.functional_update(cls_state, logits, y)
+        loss_state = loss_metric.functional_update(loss_state, loss)
+        return params, opt_state, (cls_state, loss_state), loss
+
+    step = jax.jit(
+        jax.shard_map(
+            train_step,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P("dp"), P("dp")),
+            out_specs=(P(), P(), P(), P()),
+            check_vma=False,
+        ),
+        donate_argnums=(2,),
+    )
+
+    # epoch-end compute syncs the sharded metric state over the mesh axis
+    @jax.jit
+    def epoch_compute(metric_state):
+        def _compute(state):
+            cls_state, loss_state = state
+            vals = metrics.functional_compute(cls_state, axis_name="dp")
+            vals["loss"] = loss_metric.functional_compute(loss_state, axis_name="dp")
+            return vals
+
+        return jax.shard_map(
+            _compute, mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False
+        )(metric_state)
+
+    for epoch in range(EPOCHS):
+        metric_state = (metrics.init_state(), loss_metric.init_state())
+        for i in range(STEPS_PER_EPOCH):
+            lo = i * BATCH
+            x, y = x_all[lo : lo + BATCH], y_all[lo : lo + BATCH]
+            params, opt_state, metric_state, loss = step(params, opt_state, metric_state, x, y)
+        vals = {k: float(v) for k, v in epoch_compute(metric_state).items()}
+        print(f"epoch {epoch}: " + "  ".join(f"{k}={v:.4f}" for k, v in sorted(vals.items())))
+
+    assert vals["acc"] > 0.5, "model should beat chance by epoch 3"
+    print("train_loop_flax OK")
+
+
+if __name__ == "__main__":
+    main()

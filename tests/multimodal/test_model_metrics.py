@@ -274,3 +274,8 @@ def test_text_model_metrics_refuse_string_state_sync():
     m.update(["a b"], ["a b"])
     with pytest.raises(TPUMetricsUserError):
         m._sync_dist()
+
+    # escape hatch: user declares every rank holds the full corpus
+    m2 = BERTScore(model=emb, user_tokenizer=tok, user_forward_fn=emb, sentences_replicated=True)
+    m2.update(["a b"], ["a b"])
+    m2._sync_dist()  # must not raise

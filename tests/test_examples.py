@@ -1,0 +1,28 @@
+"""Every shipped example must run green end-to-end (the reference keeps its
+``examples/`` exercised through docs builds; here they run directly)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), "..", "examples")
+_EXAMPLES = sorted(f for f in os.listdir(_EXAMPLES_DIR) if f.endswith(".py"))
+
+
+@pytest.mark.parametrize("example", _EXAMPLES)
+def test_example_runs(example):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # examples pick their own platform
+    out = subprocess.run(
+        [sys.executable, os.path.join(_EXAMPLES_DIR, example)],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env=env,
+    )
+    assert out.returncode == 0, f"{example} failed:\n{out.stdout[-1500:]}\n{out.stderr[-1500:]}"
+    assert "OK" in out.stdout, f"{example} did not reach its final assertion:\n{out.stdout[-500:]}"

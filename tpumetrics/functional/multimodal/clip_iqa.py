@@ -95,11 +95,18 @@ def clip_image_quality_assessment(
     processed = processor(images=list(jax.device_get(images)), return_tensors="np")
     img_features = jnp.asarray(model.get_image_features(jnp.asarray(processed["pixel_values"])))
     img_features = img_features / jnp.linalg.norm(img_features, axis=-1, keepdims=True)
-    txt_features = (
-        jnp.asarray(text_features)
-        if text_features is not None
-        else _clip_iqa_text_features(model, processor, prompts_list)
-    )
+    if text_features is not None:
+        txt_features = jnp.asarray(text_features)
+        if txt_features.ndim != 2 or txt_features.shape[0] != len(prompts_list):
+            raise ValueError(
+                f"Expected `text_features` of shape ({len(prompts_list)}, D) — one row per"
+                f" positive/negative prompt — but got {txt_features.shape}"
+            )
+        # re-normalize defensively: raw embeddings would turn the 100x-scaled
+        # softmax into garbage silently
+        txt_features = txt_features / jnp.linalg.norm(txt_features, axis=-1, keepdims=True)
+    else:
+        txt_features = _clip_iqa_text_features(model, processor, prompts_list)
 
     logits = 100 * img_features @ txt_features.T  # (N, 2 * num_prompts)
     logits = logits.reshape(logits.shape[0], -1, 2)

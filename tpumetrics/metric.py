@@ -643,7 +643,10 @@ class Metric(ABC):
             elif isinstance(default, list):
                 out[attr] = []
             else:
-                out[attr] = default
+                # fresh buffer, not the stored default itself: callers may
+                # donate the returned state to jit (donation deletes the
+                # buffer, which would poison every later init_state/reset)
+                out[attr] = jnp.copy(default)
         return out
 
     @contextmanager

@@ -33,9 +33,11 @@ class InfoLM(Metric):
         return_sentence_level_score: bool = False,
         model: Optional[Any] = None,
         user_tokenizer: Optional[Any] = None,
+        sentences_replicated: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        self.sentences_replicated = sentences_replicated
         _InformationMeasure(information_measure, alpha, beta)  # validate early
         self.model_name_or_path = model_name_or_path
         self.temperature = temperature
@@ -89,12 +91,18 @@ class InfoLM(Metric):
     def _sync_dist(self, dist_sync_fn=None, process_group=None) -> None:
         """Sentence buffers are Python strings, outside the array sync path —
         refuse a cross-process sync rather than silently scoring only this
-        rank's shard (the registered array states alone would gather)."""
+        rank's shard. Escapes: construct with ``sentences_replicated=True``
+        when every rank already holds the full corpus, or pass a custom
+        ``dist_sync_fn`` (it receives the array states; the sentence lists
+        are assumed replicated in that case too)."""
         from tpumetrics.metric import TPUMetricsUserError
 
+        if getattr(self, "sentences_replicated", False) or dist_sync_fn is not None:
+            return super()._sync_dist(dist_sync_fn=dist_sync_fn, process_group=process_group)
         raise TPUMetricsUserError(
             f"{type(self).__name__} keeps raw sentences as host-side state and cannot"
-            " dist-sync them; compute per process and aggregate the returned scores,"
-            " or gather the sentences before update()."
+            " dist-sync them. Either compute per process and aggregate the returned"
+            " scores, or replicate the sentences to every rank before update() and"
+            " construct with sentences_replicated=True (or sync_on_compute=False)."
         )
 
