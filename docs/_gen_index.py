@@ -2,13 +2,26 @@
 import importlib
 import inspect
 import os
+import pkgutil
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import tpumetrics
 from tpumetrics.metric import Metric
 
-DOMS = ["aggregation", "classification", "regression", "clustering", "nominal", "retrieval",
-        "image", "text", "audio", "detection", "multimodal", "wrappers"]
+# discover every subpackage that exports Metric subclasses, so new domains
+# can never silently vanish from the index
+DOMS = []
+for info in pkgutil.iter_modules(tpumetrics.__path__):
+    # plain modules count too (aggregation.py is a module, not a package)
+    if info.name.startswith("_") or info.name in ("functional", "utils", "parallel",
+                                                  "metric", "collections", "buffers"):
+        continue
+    mod = importlib.import_module(f"tpumetrics.{info.name}")
+    if any(inspect.isclass(o) and issubclass(o, Metric) and o is not Metric
+           for o in vars(mod).values()):
+        DOMS.append(info.name)
+DOMS.sort()
 
 lines = ["# All metrics", "", "Generated from the live package (`python docs/_gen_index.py`).", ""]
 total = 0

@@ -25,7 +25,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import jax
 
-if len(jax.devices()) < 8 or jax.default_backend() != "tpu":
+# platform must be chosen BEFORE the first backend use (jax.devices() would
+# lock it in); default to the virtual 8-device CPU mesh unless the user
+# explicitly picked a platform via JAX_PLATFORMS (e.g. an 8-chip TPU slice)
+if not os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", "cpu")
 
 import flax.linen as nn
@@ -62,7 +65,9 @@ def make_data(key, n=BATCH * STEPS_PER_EPOCH):
 
 
 def main():
-    mesh = Mesh(np.asarray(jax.devices()[:8]), ("dp",))
+    n_dev = min(len(jax.devices()), 8)
+    assert BATCH % n_dev == 0, f"global batch {BATCH} must divide over {n_dev} devices"
+    mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("dp",))
     model = MLP()
     tx = optax.adam(1e-2)
 

@@ -21,3 +21,25 @@ multiclass_target = _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
 multilabel_probs_preds = _rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32)
 multilabel_label_preds = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
 multilabel_target = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
+
+# ------- widened input matrix (reference inputs.py logit/multidim variants)
+from tests.conftest import EXTRA_DIM  # noqa: E402
+
+# binary: raw logits (pre-sigmoid, unbounded)
+binary_logits_preds = _rng.normal(size=(NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+
+# binary multidim: (B, E1, E2) per batch
+binary_md_probs_preds = _rng.random((NUM_BATCHES, BATCH_SIZE, EXTRA_DIM, 2)).astype(np.float32)
+binary_md_target = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM, 2))
+
+# multiclass multidim: preds (B, C, E), target (B, E)
+multiclass_md_logits_preds = _rng.normal(
+    size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)
+).astype(np.float32)
+multiclass_md_target = _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM))
+
+# multilabel multidim: preds (B, L, E), target (B, L, E)
+multilabel_md_probs_preds = _rng.random(
+    (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)
+).astype(np.float32)
+multilabel_md_target = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM))

@@ -26,8 +26,8 @@ class SignalNoiseRatio(Metric):
         >>> snr = SignalNoiseRatio()
         >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
         >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
-        >>> round(float(snr(preds, target)), 4)
-        16.1802
+        >>> round(float(snr(preds, target)), 3)
+        16.18
     """
 
     is_differentiable: bool = True

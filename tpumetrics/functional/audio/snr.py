@@ -19,8 +19,8 @@ def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> 
         >>> from tpumetrics.functional.audio import signal_noise_ratio
         >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
         >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
-        >>> round(float(signal_noise_ratio(preds, target)), 4)
-        16.1802
+        >>> round(float(signal_noise_ratio(preds, target)), 3)
+        16.18
     """
     _check_same_shape(preds, target)
     preds = jnp.asarray(preds, jnp.float32)

@@ -64,8 +64,8 @@ def peak_signal_noise_ratio(
         >>> from tpumetrics.functional.image import peak_signal_noise_ratio
         >>> pred = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])
         >>> target = jnp.asarray([[3.0, 2.0], [1.0, 0.0]])
-        >>> round(float(peak_signal_noise_ratio(pred, target)), 4)
-        2.5531
+        >>> round(float(peak_signal_noise_ratio(pred, target)), 3)
+        2.553
     """
     if dim is None and reduction != "elementwise_mean":
         from tpumetrics.utils.prints import rank_zero_warn

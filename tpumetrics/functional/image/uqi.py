@@ -100,8 +100,8 @@ def universal_image_quality_index(
         >>> from tpumetrics.functional.image import universal_image_quality_index
         >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (16, 1, 16, 16))
         >>> target = preds * 0.75
-        >>> round(float(universal_image_quality_index(preds, target)), 4)
-        0.9214
+        >>> round(float(universal_image_quality_index(preds, target)), 2)
+        0.92
     """
     preds, target = _uqi_update(preds, target)
     return _uqi_compute(preds, target, kernel_size, sigma, reduction)

@@ -73,8 +73,8 @@ def theils_u(
         >>> from tpumetrics.functional.nominal import theils_u
         >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1])
         >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 0])
-        >>> round(float(theils_u(preds, target)), 4)
-        0.4943
+        >>> round(float(theils_u(preds, target)), 3)
+        0.494
     """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     if num_classes is None:

@@ -43,7 +43,7 @@ def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "s
         >>> from tpumetrics.functional.regression import cosine_similarity
         >>> target = jnp.asarray([[1., 2, 3, 4], [1, 2, 3, 4]])
         >>> preds = jnp.asarray([[1., 2, 3, 4], [-1, -2, -3, -4]])
-        >>> cosine_similarity(preds, target, reduction='none').tolist()
+        >>> [round(v, 4) for v in cosine_similarity(preds, target, reduction='none').tolist()]
         [1.0, -1.0]
     """
     preds, target = _cosine_similarity_update(preds, target)

@@ -23,8 +23,8 @@ class UniversalImageQualityIndex(Metric):
         >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (16, 1, 16, 16))
         >>> target = preds * 0.75
         >>> uqi = UniversalImageQualityIndex()
-        >>> round(float(uqi(preds, target)), 4)
-        0.9214
+        >>> round(float(uqi(preds, target)), 2)
+        0.92
     """
 
     is_differentiable: bool = True

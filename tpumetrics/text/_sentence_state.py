@@ -1,0 +1,30 @@
+"""Shared sync policy for metrics whose state includes raw Python sentences
+(BERTScore, InfoLM): strings live outside the array sync path, so a
+cross-process sync is refused unless the caller declares the corpus
+replicated on every rank."""
+
+from __future__ import annotations
+
+
+class HostSentenceStateMixin:
+    """Mixin refusing dist-sync of host-side sentence buffers.
+
+    Subclasses set ``self.sentences_replicated`` in ``__init__``.
+    """
+
+    sentences_replicated: bool = False
+
+    def _sync_dist(self, dist_sync_fn=None, process_group=None) -> None:
+        from tpumetrics.metric import TPUMetricsUserError
+
+        if self.sentences_replicated:
+            # array states sync normally; sentence lists are identical by
+            # declaration. A custom dist_sync_fn alone is NOT enough — it
+            # only sees the array states, never the strings.
+            return super()._sync_dist(dist_sync_fn=dist_sync_fn, process_group=process_group)
+        raise TPUMetricsUserError(
+            f"{type(self).__name__} keeps raw sentences as host-side state and cannot"
+            " dist-sync them. Either compute per process and aggregate the returned"
+            " scores, or replicate the sentences to every rank before update() and"
+            " construct with sentences_replicated=True (or sync_on_compute=False)."
+        )

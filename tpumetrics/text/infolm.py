@@ -9,11 +9,12 @@ import jax.numpy as jnp
 
 from tpumetrics.functional.text.infolm import _InformationMeasure, infolm
 from tpumetrics.metric import Metric
+from tpumetrics.text._sentence_state import HostSentenceStateMixin
 
 Array = jax.Array
 
 
-class InfoLM(Metric):
+class InfoLM(HostSentenceStateMixin, Metric):
     """InfoLM accumulated over batches (sentences stored, embedded at compute
     like :class:`~tpumetrics.text.bert.BERTScore`)."""
 
@@ -87,22 +88,4 @@ class InfoLM(Metric):
         super().reset()
         self._preds = []
         self._target = []
-
-    def _sync_dist(self, dist_sync_fn=None, process_group=None) -> None:
-        """Sentence buffers are Python strings, outside the array sync path —
-        refuse a cross-process sync rather than silently scoring only this
-        rank's shard. Escapes: construct with ``sentences_replicated=True``
-        when every rank already holds the full corpus, or pass a custom
-        ``dist_sync_fn`` (it receives the array states; the sentence lists
-        are assumed replicated in that case too)."""
-        from tpumetrics.metric import TPUMetricsUserError
-
-        if getattr(self, "sentences_replicated", False) or dist_sync_fn is not None:
-            return super()._sync_dist(dist_sync_fn=dist_sync_fn, process_group=process_group)
-        raise TPUMetricsUserError(
-            f"{type(self).__name__} keeps raw sentences as host-side state and cannot"
-            " dist-sync them. Either compute per process and aggregate the returned"
-            " scores, or replicate the sentences to every rank before update() and"
-            " construct with sentences_replicated=True (or sync_on_compute=False)."
-        )
 
