@@ -242,3 +242,96 @@ def test_task_wrappers_dispatch():
     assert isinstance(
         tmc.SpecificityAtSensitivity(task="binary", min_sensitivity=0.5), tmc.BinarySpecificityAtSensitivity
     )
+
+
+class TestBinnedConfusionTensor:
+    """Regression tests for the scatter-free binned confusion redesign:
+    the MXU-contraction path and the histogram fallback must match a direct
+    per-threshold comparison exactly — including unsorted threshold lists,
+    predictions tied exactly at a threshold, and ignore_index masking."""
+
+    @staticmethod
+    def _brute(preds2d, bits2d, valid2d, thr):
+        out = np.zeros((len(thr), preds2d.shape[1], 2, 2), np.int64)
+        for ti, th in enumerate(np.asarray(thr)):
+            pr = (preds2d >= th).astype(int)
+            for y in (0, 1):
+                for pp in (0, 1):
+                    out[ti, :, y, pp] = np.sum((bits2d == y) & (pr == pp) & valid2d, axis=0)
+        return out
+
+    @pytest.mark.parametrize("sorted_thr", [True, False])
+    @pytest.mark.parametrize("ignore_index", [None, -1])
+    def test_binary_matches_bruteforce(self, sorted_thr, ignore_index):
+        from tpumetrics.functional.classification.precision_recall_curve import (
+            _binary_precision_recall_curve_update,
+        )
+
+        rng = np.random.default_rng(7)
+        n_t = 13
+        thr_np = np.sort(rng.random(n_t).astype(np.float32))
+        if not sorted_thr:
+            thr_np = rng.permutation(thr_np)
+        thr = jnp.asarray(thr_np)
+        preds = jnp.asarray(rng.random(199, dtype=np.float32))
+        preds = preds.at[:n_t].set(thr)  # exact ties at every threshold
+        target = jnp.asarray(rng.integers(0, 2, 199), dtype=jnp.int32)
+        if ignore_index is not None:
+            target = target.at[::7].set(ignore_index)
+        got = np.asarray(_binary_precision_recall_curve_update(preds, target, thr, ignore_index))
+        valid = np.ones((199, 1), bool) if ignore_index is None else (np.asarray(target) != ignore_index)[:, None]
+        bits = np.where(valid[:, 0], np.asarray(target), 0)[:, None]
+        expected = self._brute(np.asarray(preds)[:, None], bits, valid, thr_np)[:, 0]
+        assert np.array_equal(got, expected)
+
+    @pytest.mark.parametrize("ignore_index", [None, -1])
+    def test_multilabel_contract_matches_hist(self, ignore_index):
+        from tpumetrics.functional.classification.precision_recall_curve import (
+            _binned_confusion_contract,
+            _binned_confusion_hist,
+        )
+
+        rng = np.random.default_rng(11)
+        n, c, n_t = 157, 4, 9
+        thr = jnp.asarray(rng.permutation(rng.random(n_t).astype(np.float32)))
+        preds = jnp.asarray(rng.random((n, c), dtype=np.float32))
+        target = jnp.asarray(rng.integers(0, 2, (n, c)), dtype=jnp.int32)
+        invalid = None
+        if ignore_index is not None:
+            invalid = jnp.asarray(rng.integers(0, 2, (n, c)).astype(bool))
+        a = _binned_confusion_contract(preds, target, thr, invalid)
+        b = _binned_confusion_hist(preds, target, thr, invalid)
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_multiclass_matches_bruteforce_with_ignore(self):
+        from tpumetrics.functional.classification.precision_recall_curve import (
+            _multiclass_precision_recall_curve_update,
+        )
+
+        rng = np.random.default_rng(3)
+        n, c, n_t = 211, NUM_CLASSES, 11
+        thr_np = np.sort(rng.random(n_t).astype(np.float32))
+        thr = jnp.asarray(thr_np)
+        preds = jnp.asarray(rng.random((n, c), dtype=np.float32))
+        target = jnp.asarray(rng.integers(0, c, n), dtype=jnp.int32).at[::5].set(-1)
+        got = np.asarray(_multiclass_precision_recall_curve_update(preds, target, c, thr, None, -1))
+        valid = np.broadcast_to((np.asarray(target) != -1)[:, None], (n, c))
+        onehot = np.eye(c, dtype=int)[np.where(np.asarray(target) == -1, 0, np.asarray(target))]
+        expected = self._brute(np.asarray(preds), onehot, valid, thr_np)
+        assert np.array_equal(got, expected)
+
+    def test_contract_and_hist_agree_on_nan_preds(self):
+        from tpumetrics.functional.classification.precision_recall_curve import (
+            _binned_confusion_contract,
+            _binned_confusion_hist,
+        )
+
+        preds = jnp.asarray([[0.2], [jnp.nan], [0.8]], dtype=jnp.float32)
+        target = jnp.asarray([[1], [1], [0]], dtype=jnp.int32)
+        thr = jnp.asarray([0.5], dtype=jnp.float32)
+        a = _binned_confusion_contract(preds, target, thr, None)
+        b = _binned_confusion_hist(preds, target, thr, None)
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+        # NaN >= thr is False -> the NaN positive sample is a false negative:
+        # 0.2/y=1 -> fn, NaN/y=1 -> fn, 0.8/y=0 -> fp
+        assert np.array_equal(np.asarray(a[0, 0]), [[0, 1], [2, 0]])

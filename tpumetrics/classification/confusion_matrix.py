@@ -174,7 +174,7 @@ class MultilabelConfusionMatrix(Metric):
         preds, target, mask = _multilabel_stat_scores_format(
             preds, target, self.num_labels, self.threshold, self.ignore_index
         )
-        self.confmat = self.confmat + _multilabel_confmat(preds, target, mask, self.num_labels)
+        self.confmat = self.confmat + _multilabel_confmat(preds, target, mask)
 
     def compute(self) -> Array:
         return _confusion_matrix_reduce(self.confmat, self.normalize)

@@ -34,7 +34,14 @@ def _binning_bucketize(
     fixed-width histogram lowered to scatter-adds (reference helper used by
     :62-109)."""
     n_bins = bin_boundaries.shape[0] - 1
-    indices = jnp.clip(jnp.searchsorted(bin_boundaries[1:-1], confidences, side="right"), 0, n_bins - 1)
+    # compare_all: XLA's default searchsorted ("scan") is a serial binary
+    # search — log T sequential gather rounds, pathological on TPU; for a
+    # handful of bin edges one vectorized comparison round is far faster
+    indices = jnp.clip(
+        jnp.searchsorted(bin_boundaries[1:-1], confidences, side="right", method="compare_all"),
+        0,
+        n_bins - 1,
+    )
     count_bin = jax.ops.segment_sum(jnp.ones_like(confidences), indices, num_segments=n_bins)
     conf_bin = _safe_divide(
         jax.ops.segment_sum(confidences, indices, num_segments=n_bins), count_bin

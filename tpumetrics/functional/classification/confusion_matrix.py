@@ -1,8 +1,10 @@
 """Confusion matrices (binary / multiclass / multilabel).
 
 Counterpart of reference ``functional/classification/confusion_matrix.py``.
-Computed as weighted bincounts over flattened ``target * C + pred`` indices —
-one scatter-add on TPU; the reference's XLA bincount fallback loop
+Scatter-free on TPU: the multiclass path is a one-hot MXU matmul
+(:func:`_masked_confmat`), the multilabel path four masked VPU reductions
+(:func:`_multilabel_confmat`) — the reference's flat-index bincount would
+lower to a serializing scatter-add, and its XLA bincount fallback loop
 (reference utilities/data.py:169-199) is unnecessary here.
 """
 
@@ -23,8 +25,8 @@ from tpumetrics.functional.classification.stat_scores import (
     _multilabel_stat_scores_arg_validation,
     _multilabel_stat_scores_format,
     _multilabel_stat_scores_tensor_validation,
+    _multilabel_stat_scores_update,
 )
-from tpumetrics.utils.data import _bincount
 
 Array = jax.Array
 
@@ -46,12 +48,13 @@ def _confusion_matrix_reduce(confmat: Array, normalize: Optional[str] = None) ->
     return confmat
 
 
-def _multilabel_confmat(preds: Array, target: Array, mask: Array, num_labels: int) -> Array:
-    """(num_labels, 2, 2) per-label confusion matrices via bincount over
-    ``label_id * 4 + target*2 + pred`` flat indices."""
-    idx = jnp.arange(num_labels)[None, :, None] * 4 + target * 2 + preds
-    idx = jnp.where(mask == 1, idx, num_labels * 4)
-    return _bincount(idx.ravel(), minlength=num_labels * 4 + 1)[:-1].reshape(num_labels, 2, 2)
+def _multilabel_confmat(preds: Array, target: Array, mask: Array) -> Array:
+    """(num_labels, 2, 2) per-label confusion matrices — scatter-free (the
+    reference builds ``label_id * 4 + target*2 + pred`` flat indices +
+    bincount, which lowers to a serializing scatter-add on TPU). The four
+    cells are the same masked VPU reductions stat-scores uses."""
+    tp, fp, tn, fn = _multilabel_stat_scores_update(preds, target, mask, "global")
+    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2).astype(jnp.int32)
 
 
 def _validate_normalize(normalize: Optional[str]) -> None:
@@ -159,7 +162,7 @@ def multilabel_confusion_matrix(
         _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, normalize)
         _multilabel_stat_scores_tensor_validation(preds, target, num_labels, "global", ignore_index)
     preds, target, mask = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
-    confmat = _multilabel_confmat(preds, target, mask, num_labels)
+    confmat = _multilabel_confmat(preds, target, mask)
     return _confusion_matrix_reduce(confmat, normalize)
 
 

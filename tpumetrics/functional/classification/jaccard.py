@@ -147,7 +147,7 @@ def multilabel_jaccard_index(
         _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, None)
         _multilabel_stat_scores_tensor_validation(preds, target, num_labels, "global", ignore_index)
     preds, target, mask = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
-    confmat = _multilabel_confmat(preds, target, mask, num_labels)
+    confmat = _multilabel_confmat(preds, target, mask)
     return _jaccard_index_reduce(confmat, average=average, ignore_index=ignore_index)
 
 

@@ -138,7 +138,7 @@ def multilabel_matthews_corrcoef(
         _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, None)
         _multilabel_stat_scores_tensor_validation(preds, target, num_labels, "global", ignore_index)
     preds, target, mask = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
-    confmat = _multilabel_confmat(preds, target, mask, num_labels)
+    confmat = _multilabel_confmat(preds, target, mask)
     return _matthews_corrcoef_reduce(confmat)
 
 

@@ -9,9 +9,10 @@ reference :25-134 and onwards), redesigned for XLA:
   data instead of boolean-index dropping (reference drops positions, which is
   a dynamic-shape op XLA can't tile) — every update is mask-weighted, so all
   shapes stay static under ``jit``.
-- The multiclass global path uses a weighted ``bincount`` over ``C²`` flat
-  indices (lowers to one scatter-add); the top-k / samplewise paths use
-  one-hot contractions that map onto the MXU.
+- The multiclass global path builds the confusion matrix as a one-hot MXU
+  matmul (falling back to a flat-index bincount scatter only for gigantic
+  inputs); the top-k / samplewise paths use one-hot contractions that map
+  onto the MXU as well.
 """
 
 from __future__ import annotations
@@ -29,11 +30,23 @@ Array = jax.Array
 
 
 def _masked_confmat(preds: Array, target: Array, mask: Array, n: int) -> Array:
-    """(n, n) confusion matrix over valid positions only: weighted bincount on
-    ``target * n + pred`` flat indices (one scatter-add on TPU); masked-out
-    positions route to a sentinel bucket that is dropped."""
-    idx = target.ravel() * n + preds.ravel()
-    idx = jnp.where(mask.ravel() == 1, idx, n * n)
+    """(n, n) confusion matrix over valid positions only.
+
+    MXU path: ``conf = (one_hot(target)·mask)ᵀ @ one_hot(pred)`` — a single
+    matmul the systolic array eats, exact because every count is an integer
+    < 2^24 in f32; out-of-range labels one-hot to a zero row, i.e. the same
+    drop semantics as the reference's sentinel bucket. Falls back to the
+    bincount scatter when the one-hot operands would not fit comfortably in
+    HBM (the scatter is O(N) memory)."""
+    preds = preds.ravel()
+    target = target.ravel()
+    valid = (mask.ravel() == 1).astype(jnp.float32)
+    if preds.shape[0] < (1 << 24) and preds.shape[0] * n <= (1 << 27):
+        t1 = jax.nn.one_hot(target, n, dtype=jnp.float32) * valid[:, None]
+        p1 = jax.nn.one_hot(preds, n, dtype=jnp.float32)
+        return jnp.round(t1.T @ p1).astype(jnp.int32)
+    idx = target * n + preds
+    idx = jnp.where(valid == 1, idx, n * n)
     return _bincount(idx, minlength=n * n + 1)[:-1].reshape(n, n)
 
 
