@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from tpumetrics.utils.checks import _check_same_shape, _is_tracer
-from tpumetrics.utils.compute import _safe_divide, interp, normalize_logits_if_needed
+from tpumetrics.utils.compute import EXACT_F32_COUNT, _safe_divide, interp, normalize_logits_if_needed
 from tpumetrics.utils.data import _bincount, _cumsum
 
 Array = jax.Array
@@ -174,7 +174,7 @@ def _binned_confusion_tensor(
             invalid = invalid[:, None]
     n = preds.shape[0]
     pos_elems = n * preds.shape[1] * thresholds.shape[0]
-    if n < (1 << 24) and pos_elems <= (1 << 28):
+    if n < EXACT_F32_COUNT and pos_elems <= (1 << 28):
         # f32 contraction counts are exact only below 2^24 samples per call,
         # and the (N, C, T) comparison operand must fit comfortably in HBM
         conf = _binned_confusion_contract(preds, target_bits, thresholds, invalid)

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from tpumetrics.utils.checks import _check_same_shape, _is_tracer
-from tpumetrics.utils.compute import normalize_logits_if_needed
+from tpumetrics.utils.compute import masked_onehot_count_matmul, normalize_logits_if_needed
 from tpumetrics.utils.data import _bincount, select_topk
 
 Array = jax.Array
@@ -40,13 +40,11 @@ def _masked_confmat(preds: Array, target: Array, mask: Array, n: int) -> Array:
     HBM (the scatter is O(N) memory)."""
     preds = preds.ravel()
     target = target.ravel()
-    valid = (mask.ravel() == 1).astype(jnp.float32)
-    if preds.shape[0] < (1 << 24) and preds.shape[0] * n <= (1 << 27):
-        t1 = jax.nn.one_hot(target, n, dtype=jnp.float32) * valid[:, None]
-        p1 = jax.nn.one_hot(preds, n, dtype=jnp.float32)
-        return jnp.round(t1.T @ p1).astype(jnp.int32)
-    idx = target * n + preds
-    idx = jnp.where(valid == 1, idx, n * n)
+    valid = mask.ravel() == 1
+    counts = masked_onehot_count_matmul(target, preds, n, n, valid)
+    if counts is not None:
+        return jnp.round(counts).astype(jnp.int32)
+    idx = jnp.where(valid, target * n + preds, n * n)
     return _bincount(idx, minlength=n * n + 1)[:-1].reshape(n, n)
 
 

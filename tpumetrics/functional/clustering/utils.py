@@ -1,9 +1,10 @@
 """Shared clustering helpers (counterpart of reference
 ``functional/clustering/utils.py``), redesigned for XLA:
 
-- the contingency matrix is one static-shape scatter-add (optionally over a
-  user-declared class space, making it jit/shard_map-safe), not a host-side
-  sparse tensor build (reference utils.py:119-176);
+- the contingency matrix is a one-hot MXU contraction (scatter fallback for
+  gigantic inputs), optionally over a user-declared class space so it is
+  jit/shard_map-safe — not a host-side sparse tensor build (reference
+  utils.py:119-176);
 - entropy/MI terms use where-masked logs so zero rows/columns contribute
   exactly zero — no data-dependent ``nonzero`` indexing (reference
   mutual_info_score.py:54-60), which XLA cannot compile.
@@ -16,6 +17,7 @@ from typing import Any, Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from tpumetrics.utils.compute import EXACT_F32_COUNT, ONEHOT_HBM_ELEMS, masked_onehot_count_matmul
 from tpumetrics.utils.checks import _check_same_shape
 from tpumetrics.utils.data import _is_tracer
 
@@ -118,10 +120,11 @@ def calculate_contingency_matrix(
 ) -> Array:
     """Dense contingency matrix ``(n_classes_target, n_classes_preds)``.
 
-    One fused scatter-add of encoded pair indices (the reference builds a COO
-    sparse tensor and densifies, utils.py:119-176). With explicit class
-    counts the shape is static and the whole thing jits; ``mask`` drops rows
-    (for fixed-capacity buffer states) by routing them out of range.
+    A one-hot MXU contraction (scatter-add of encoded pair indices for
+    gigantic inputs; the reference builds a COO sparse tensor and densifies,
+    utils.py:119-176). With explicit class counts the shape is static and the
+    whole thing jits; ``mask`` drops rows (for fixed-capacity buffer states)
+    by routing them out of range.
 
     Example:
         >>> import jax.numpy as jnp
@@ -144,14 +147,15 @@ def calculate_contingency_matrix(
         target, num_classes_target = _relabel(target)
     t = target.astype(jnp.int32)
     p = preds.astype(jnp.int32)
-    pair = t * num_classes_preds + p
     # out-of-range (incl. negative, which would wrap) labels drop their row
     in_range = (t >= 0) & (t < num_classes_target) & (p >= 0) & (p < num_classes_preds)
     if mask is not None:
         in_range = in_range & mask
-    pair = jnp.where(in_range, pair, num_classes_target * num_classes_preds)
-    flat = jnp.zeros((num_classes_target * num_classes_preds,), dtype=jnp.float32)
-    contingency = flat.at[pair].add(1.0, mode="drop").reshape(num_classes_target, num_classes_preds)
+    contingency = masked_onehot_count_matmul(t, p, num_classes_target, num_classes_preds, in_range)
+    if contingency is None:
+        pair = jnp.where(in_range, t * num_classes_preds + p, num_classes_target * num_classes_preds)
+        flat = jnp.zeros((num_classes_target * num_classes_preds,), dtype=jnp.float32)
+        contingency = flat.at[pair].add(1.0, mode="drop").reshape(num_classes_target, num_classes_preds)
     if eps is not None:
         contingency = contingency + eps
     return contingency
@@ -240,8 +244,18 @@ def _cluster_centroids(
     reference's per-cluster Python loops, e.g. calinski_harabasz_score.py:53-58).
     ``mask`` excludes invalid buffer rows with static shapes."""
     labels = _mask_labels(labels, num_labels, mask)
-    counts = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype), labels, num_segments=num_labels)
-    sums = jax.ops.segment_sum(data, labels, num_segments=num_labels)
+    n = data.shape[0]
+    if n < EXACT_F32_COUNT and n * (num_labels + 1) <= ONEHOT_HBM_ELEMS:
+        # MXU path: per-cluster sums/counts as a one-hot matmul instead of a
+        # serializing scatter-add (the sentinel segment is sliced off);
+        # HIGHEST precision because `data` is arbitrary float — TPU matmuls
+        # otherwise truncate inputs to bf16
+        onehot = jax.nn.one_hot(labels, num_labels + 1, dtype=data.dtype)[:, :num_labels]
+        counts = jnp.sum(onehot, axis=0)
+        sums = jnp.matmul(onehot.T, data, precision=jax.lax.Precision.HIGHEST)
+    else:
+        counts = jax.ops.segment_sum(jnp.ones((n,), data.dtype), labels, num_segments=num_labels)
+        sums = jax.ops.segment_sum(data, labels, num_segments=num_labels)
     centroids = sums / jnp.where(counts > 0, counts, 1.0)[:, None]
     return centroids, counts
 

@@ -64,7 +64,7 @@ def sort_queries(
 
     n = idx_s.shape[0]
     ones = jnp.ones((n,), jnp.int32)
-    counts = jax.ops.segment_sum(ones, idx_s, num_segments=num_queries)
+    counts = jax.ops.segment_sum(ones, idx_s, num_segments=num_queries, indices_are_sorted=True)
     starts = jnp.cumsum(counts) - counts  # (Q,) first position of each query
     positions = jnp.arange(n, dtype=jnp.int32)
     rank = positions - starts[jnp.clip(idx_s, 0, num_queries - 1)]
@@ -74,12 +74,12 @@ def sort_queries(
     before_group = cum_all[jnp.clip(starts, 0, max(n - 1, 0))] - target_s[jnp.clip(starts, 0, max(n - 1, 0))]
     cum_target = cum_all - before_group[jnp.clip(idx_s, 0, num_queries - 1)]
 
-    pos = jax.ops.segment_sum(target_s, idx_s, num_segments=num_queries)
+    pos = jax.ops.segment_sum(target_s, idx_s, num_segments=num_queries, indices_are_sorted=True)
     return SortedQueries(idx_s, preds_s, target_s, rank, cum_target, counts, pos, num_queries)
 
 
 def _segment_sum(values: Array, sq: SortedQueries) -> Array:
-    return jax.ops.segment_sum(values, sq.idx, num_segments=sq.num_queries)
+    return jax.ops.segment_sum(values, sq.idx, num_segments=sq.num_queries, indices_are_sorted=True)
 
 
 def reduce_queries(
@@ -179,7 +179,7 @@ def grouped_reciprocal_rank(sq: SortedQueries, top_k: Optional[int] = None) -> T
     (reference functional/retrieval/reciprocal_rank.py)."""
     n = sq.rank.shape[0]
     first_rel_rank = jax.ops.segment_min(
-        jnp.where(sq.target > 0, sq.rank, n), sq.idx, num_segments=sq.num_queries
+        jnp.where(sq.target > 0, sq.rank, n), sq.idx, num_segments=sq.num_queries, indices_are_sorted=True
     )
     in_k = first_rel_rank < (top_k if top_k is not None else n)
     values = jnp.where(in_k, 1.0 / jnp.maximum(first_rel_rank + 1.0, 1.0), 0.0)

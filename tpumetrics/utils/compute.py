@@ -104,3 +104,43 @@ def normalize_logits_if_needed(tensor: Array, normalization: str) -> Array:
     if normalization == "softmax":
         return jnp.where(is_prob, tensor, jax.nn.softmax(tensor, axis=1))
     return tensor
+
+
+# ---- scatter-free counting contractions -----------------------------------
+#
+# TPU scatter-adds serialize, so count-shaped reductions (confusion matrices,
+# contingency tables, histograms) are computed as one-hot MXU matmuls where
+# the operands fit. The two gates below are shared by every such path:
+#
+# EXACT_F32_COUNT: largest sample count whose partial sums stay exactly
+#   representable in the MXU's f32 accumulator (0/1 operands are exact in
+#   bf16, so exactness is bounded only by the accumulator).
+# ONEHOT_HBM_ELEMS: largest one-hot / comparison operand (in elements) we are
+#   willing to materialize in HBM before falling back to an O(N) scatter.
+EXACT_F32_COUNT = 1 << 24
+ONEHOT_HBM_ELEMS = 1 << 27
+
+
+def masked_onehot_count_matmul(
+    row_labels: Array,
+    col_labels: Array,
+    num_rows: int,
+    num_cols: int,
+    valid: Optional[Array] = None,
+) -> Optional[Array]:
+    """(num_rows, num_cols) co-occurrence counts as a one-hot MXU matmul.
+
+    ``counts[i, j] = Σ_n valid · (row==i) · (col==j)`` — exact (f32 integer
+    counts, see :data:`EXACT_F32_COUNT`); out-of-range labels one-hot to a
+    zero row and drop out, matching sentinel-bucket scatter semantics.
+    Returns ``None`` when the inputs exceed the exactness or HBM gates — the
+    caller falls back to its O(N)-memory scatter path.
+    """
+    n = row_labels.shape[0]
+    if n >= EXACT_F32_COUNT or n * max(num_rows, num_cols) > ONEHOT_HBM_ELEMS:
+        return None
+    rows = jax.nn.one_hot(row_labels, num_rows, dtype=jnp.float32)
+    if valid is not None:
+        rows = rows * valid.astype(jnp.float32)[:, None]
+    cols = jax.nn.one_hot(col_labels, num_cols, dtype=jnp.float32)
+    return rows.T @ cols
