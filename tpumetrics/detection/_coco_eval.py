@@ -51,70 +51,67 @@ def _np_box_iou(det: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray) -> np.ndar
     return inter / np.where(union > 0, union, 1.0)
 
 
-def _evaluate_image(
-    det_boxes: np.ndarray,
+def _match_image(
+    ious: np.ndarray,
+    det_areas: np.ndarray,
     det_scores: np.ndarray,
-    gt_boxes: np.ndarray,
     gt_crowd: np.ndarray,
     gt_area: np.ndarray,
     iou_thresholds: np.ndarray,
     area_range: Tuple[float, float],
     max_det: int,
 ) -> Optional[dict]:
-    """Match one (image, class) pair at every IoU threshold
-    (pycocotools ``evaluateImg`` semantics; reference _mean_ap.py:521-649)."""
-    n_gt, n_det = gt_boxes.shape[0], det_boxes.shape[0]
+    """Match one (image, class) pair at every IoU threshold simultaneously
+    (pycocotools ``evaluateImg`` semantics; reference _mean_ap.py:521-649).
+
+    ``ious``/``det_areas``/``det_scores`` are already score-sorted (descending,
+    stable) — computed once per (image, class) by the caller and shared across
+    the four area ranges. Only the detection loop is sequential (each det
+    claims a gt); the per-det candidate search is vectorized over all
+    (threshold, gt) pairs, replicating the greedy loop's rules exactly:
+    non-ignored gts take precedence over ignored ones (the reference's
+    sorted-ignored-last + break), ties replace (last-wins argmax), crowd gts
+    can absorb any number of detections.
+    """
+    n_gt = gt_crowd.shape[0]
+    n_det = min(det_scores.shape[0], max_det)
     if n_gt == 0 and n_det == 0:
         return None
 
-    # ignored gts: crowd or outside the area range; sorted ignored-last
     gt_ignore = gt_crowd.astype(bool) | (gt_area < area_range[0]) | (gt_area > area_range[1])
-    gt_order = np.argsort(gt_ignore, kind="stable")
-    gt_boxes = gt_boxes[gt_order]
-    gt_crowd = gt_crowd[gt_order]
-    gt_ignore = gt_ignore[gt_order]
-
-    det_order = np.argsort(-det_scores, kind="stable")[:max_det]
-    det_boxes = det_boxes[det_order]
-    det_scores = det_scores[det_order]
-    n_det = det_boxes.shape[0]
-
-    ious = _np_box_iou(det_boxes, gt_boxes, gt_crowd)
-
     num_thrs = len(iou_thresholds)
+    thr = np.minimum(np.asarray(iou_thresholds)[:, None], 1 - 1e-10)  # (T, 1)
     det_matches = np.zeros((num_thrs, n_det), dtype=np.int64)  # 1 if matched
     det_ignore = np.zeros((num_thrs, n_det), dtype=bool)
-    gt_matches = np.zeros((num_thrs, n_gt), dtype=bool)
+    avail = np.ones((num_thrs, n_gt), dtype=bool)  # gt not yet claimed
+    ious = ious[:n_det]
+    real = ~gt_ignore
+    crowd = gt_crowd.astype(bool)
 
-    for t_idx, t in enumerate(iou_thresholds):
-        for d_idx in range(n_det):
-            best_iou = min(t, 1 - 1e-10)
-            best_g = -1
-            for g_idx in range(n_gt):
-                # non-crowd gts can only be matched once
-                if gt_matches[t_idx, g_idx] and not gt_crowd[g_idx]:
-                    continue
-                # gts are sorted ignored-last: once we have a real match,
-                # stop at the first ignored gt (pycocotools rule)
-                if best_g > -1 and not gt_ignore[best_g] and gt_ignore[g_idx]:
-                    break
-                if ious[d_idx, g_idx] < best_iou:
-                    continue
-                best_iou = ious[d_idx, g_idx]
-                best_g = g_idx
-            if best_g == -1:
-                continue
-            det_matches[t_idx, d_idx] = 1
-            det_ignore[t_idx, d_idx] = gt_ignore[best_g]
-            gt_matches[t_idx, best_g] = True
+    for d_idx in range(n_det):
+        iou_row = ious[d_idx][None, :]  # (1, G)
+        cand = avail & (iou_row >= thr)  # (T, G)
+        cand_real = cand & real[None, :]
+        use_real = cand_real.any(axis=1)
+        pick_from = np.where(use_real[:, None], cand_real, cand & gt_ignore[None, :])
+        has = pick_from.any(axis=1)
+        if not has.any():
+            continue
+        vals = np.where(pick_from, iou_row, -1.0)
+        best_g = n_gt - 1 - np.argmax(vals[:, ::-1], axis=1)  # last-wins argmax
+        rows = np.nonzero(has)[0]
+        bg = best_g[rows]
+        det_matches[rows, d_idx] = 1
+        det_ignore[rows, d_idx] = gt_ignore[bg]
+        noncrowd = ~crowd[bg]
+        avail[rows[noncrowd], bg[noncrowd]] = False
 
     # unmatched detections outside the area range are ignored
-    det_area = (det_boxes[:, 2] - det_boxes[:, 0]) * (det_boxes[:, 3] - det_boxes[:, 1])
-    det_out_of_range = (det_area < area_range[0]) | (det_area > area_range[1])
+    det_out_of_range = (det_areas[:n_det] < area_range[0]) | (det_areas[:n_det] > area_range[1])
     det_ignore = det_ignore | ((det_matches == 0) & det_out_of_range[None, :])
 
     return {
-        "det_scores": det_scores,
+        "det_scores": det_scores[:n_det],
         "det_matches": det_matches,
         "det_ignore": det_ignore,
         "num_gt": int((~gt_ignore).sum()),
@@ -207,6 +204,9 @@ def coco_evaluate(
     recall = -np.ones((len(iou_thrs), len(eval_class_ids), len(area_names), len(max_dets)))
 
     for k_idx, class_id in enumerate(eval_class_ids):
+        # per (image, class): sort detections by score and compute IoUs ONCE,
+        # shared across all four area ranges (pycocotools computes computeIoU
+        # once per (img, cat) the same way)
         per_image_cls = []
         for img in range(num_imgs):
             det_boxes, det_scores, det_labels = detections[img]
@@ -221,16 +221,19 @@ def coco_evaluate(
             boxes = gt_boxes[gt_sel]
             box_area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1]) if boxes.size else area
             area = np.where(area > 0, area, box_area)
-            per_image_cls.append(
-                (det_boxes[det_sel], det_scores[det_sel], boxes, gt_crowd[gt_sel], area)
-            )
+            db, ds, gc = det_boxes[det_sel], det_scores[det_sel], gt_crowd[gt_sel]
+            det_order = np.argsort(-ds, kind="stable")[: max_dets[-1]]
+            db, ds = db[det_order], ds[det_order]
+            ious = _np_box_iou(db, boxes, gc)
+            da = (db[:, 2] - db[:, 0]) * (db[:, 3] - db[:, 1])
+            per_image_cls.append((ious, da, ds, gc, area))
 
         for a_idx, a_name in enumerate(area_names):
             a_range = _AREA_RANGES[a_name]
             # match once at the largest cap; smaller caps reuse by slicing
             results = [
-                _evaluate_image(db, ds, gb, gc, ga, iou_thrs, a_range, max_dets[-1])
-                for (db, ds, gb, gc, ga) in per_image_cls
+                _match_image(ious, da, ds, gc, ga, iou_thrs, a_range, max_dets[-1])
+                for (ious, da, ds, gc, ga) in per_image_cls
             ]
             for m_idx, max_det in enumerate(max_dets):
                 prec, rec = _accumulate_class_area(results, len(iou_thrs), rec_thrs, max_det)

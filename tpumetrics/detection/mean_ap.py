@@ -26,6 +26,21 @@ from tpumetrics.metric import Metric
 Array = jax.Array
 
 
+@jax.jit
+def _pack_ragged_state(dbx, dsc, gbx, gar, dlb, glb, gcr, dct, gct):
+    """Flatten the ragged per-update state into one f32 + one i32 buffer.
+
+    Jitted so the whole gather is ONE device dispatch — issuing one eager
+    reshape/concat op per state entry (or fetching each entry individually)
+    pays a device round trip per op on remote-attached accelerators. The jit
+    cache keys on the shape tuple, so repeated evaluations of a fixed eval
+    set hit cache.
+    """
+    f = jnp.concatenate([b.reshape(-1) for b in dbx] + dsc + [b.reshape(-1) for b in gbx] + gar)
+    i = jnp.concatenate(dlb + glb + gcr + dct + gct)
+    return f, i
+
+
 class MeanAveragePrecision(Metric):
     """Mean Average Precision / Recall for object detection (COCO protocol).
 
@@ -68,10 +83,12 @@ class MeanAveragePrecision(Metric):
     detection_boxes: List[Array]
     detection_scores: List[Array]
     detection_labels: List[Array]
+    detection_counts: List[Array]
     groundtruth_boxes: List[Array]
     groundtruth_labels: List[Array]
     groundtruth_crowds: List[Array]
     groundtruth_area: List[Array]
+    groundtruth_counts: List[Array]
 
     def __init__(
         self,
@@ -123,64 +140,124 @@ class MeanAveragePrecision(Metric):
         self.add_state("detection_boxes", default=[], dist_reduce_fx=None)
         self.add_state("detection_scores", default=[], dist_reduce_fx=None)
         self.add_state("detection_labels", default=[], dist_reduce_fx=None)
+        self.add_state("detection_counts", default=[], dist_reduce_fx=None)
         self.add_state("groundtruth_boxes", default=[], dist_reduce_fx=None)
         self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
         self.add_state("groundtruth_crowds", default=[], dist_reduce_fx=None)
         self.add_state("groundtruth_area", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruth_counts", default=[], dist_reduce_fx=None)
 
     def update(self, preds: Sequence[Dict[str, Array]], target: Sequence[Dict[str, Array]]) -> None:
         """Append one batch of per-image detections and ground truths
-        (reference mean_ap.py:366-400)."""
+        (reference mean_ap.py:366-400).
+
+        The whole batch is packed into ONE concatenated device array per
+        field, with per-image boundaries kept as an int32 counts array (the
+        shapes are host-known, so the counts cost nothing to build) — the
+        reference appends per-image tensors, which on a metrics state means
+        O(images) eager device ops per update and O(images) transfers at
+        compute. Per-image ragged views are reconstructed on host at compute
+        time by splitting on the counts."""
         _input_validator(preds, target, iou_type=self.iou_type)
+        if not preds:
+            return
 
-        for item in preds:
-            boxes = self._convert_boxes(item["boxes"])
-            self.detection_boxes.append(boxes)
-            self.detection_scores.append(jnp.asarray(item["scores"], jnp.float32).ravel())
-            self.detection_labels.append(jnp.asarray(item["labels"], jnp.int32).ravel())
+        dcounts = [int(_fix_empty_tensors(p["boxes"]).shape[0]) for p in preds]
+        self.detection_boxes.append(
+            self._convert_boxes(jnp.concatenate([_fix_empty_tensors(p["boxes"]) for p in preds]))
+        )
+        self.detection_scores.append(
+            jnp.concatenate([jnp.ravel(p["scores"]) for p in preds]).astype(jnp.float32)
+        )
+        self.detection_labels.append(
+            jnp.concatenate([jnp.ravel(p["labels"]) for p in preds]).astype(jnp.int32)
+        )
+        self.detection_counts.append(jnp.asarray(dcounts, jnp.int32))
 
-        for item in target:
-            boxes = self._convert_boxes(item["boxes"])
-            n = boxes.shape[0]
-            self.groundtruth_boxes.append(boxes)
-            self.groundtruth_labels.append(jnp.asarray(item["labels"], jnp.int32).ravel())
-            crowds = item.get("iscrowd")
-            self.groundtruth_crowds.append(
-                jnp.asarray(crowds, jnp.int32).ravel() if crowds is not None else jnp.zeros((n,), jnp.int32)
-            )
-            area = item.get("area")
-            self.groundtruth_area.append(
-                jnp.asarray(area, jnp.float32).ravel() if area is not None else jnp.zeros((n,), jnp.float32)
-            )
+        gcounts = [int(_fix_empty_tensors(t["boxes"]).shape[0]) for t in target]
+        self.groundtruth_boxes.append(
+            self._convert_boxes(jnp.concatenate([_fix_empty_tensors(t["boxes"]) for t in target]))
+        )
+        self.groundtruth_labels.append(
+            jnp.concatenate([jnp.ravel(t["labels"]) for t in target]).astype(jnp.int32)
+        )
+        self.groundtruth_crowds.append(
+            jnp.concatenate(
+                [
+                    jnp.ravel(jnp.asarray(t["iscrowd"])) if t.get("iscrowd") is not None
+                    else jnp.zeros((n,), jnp.int32)
+                    for t, n in zip(target, gcounts)
+                ]
+            ).astype(jnp.int32)
+        )
+        self.groundtruth_area.append(
+            jnp.concatenate(
+                [
+                    jnp.ravel(jnp.asarray(t["area"])) if t.get("area") is not None
+                    else jnp.zeros((n,), jnp.float32)
+                    for t, n in zip(target, gcounts)
+                ]
+            ).astype(jnp.float32)
+        )
+        self.groundtruth_counts.append(jnp.asarray(gcounts, jnp.int32))
 
     def _convert_boxes(self, boxes: Array) -> Array:
-        boxes = _fix_empty_tensors(jnp.asarray(boxes, jnp.float32))
-        if boxes.size > 0:
+        boxes = jnp.asarray(boxes, jnp.float32)
+        if boxes.size > 0 and self.box_format != "xyxy":
             boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
         return boxes
 
     def compute(self) -> Dict[str, Array]:
         """Run the COCO protocol over the accumulated images.
 
-        All per-image device arrays are fetched with one batched
-        ``jax.device_get`` — serial ``np.asarray`` fetches pay the full
-        device round-trip latency per array, which dwarfs the evaluation
-        itself on remote-attached accelerators."""
-        num_imgs = len(self.detection_boxes)
-        host = jax.device_get(
-            (
-                list(self.detection_boxes),
-                list(self.detection_scores),
-                list(self.detection_labels),
-                list(self.groundtruth_boxes),
-                list(self.groundtruth_labels),
-                list(self.groundtruth_crowds),
-                list(self.groundtruth_area),
+        The ragged state is concatenated ON DEVICE into one float32 and one
+        int32 buffer by a single jitted dispatch and fetched with exactly two
+        transfers — ``jax.device_get`` of the raw lists pays a full device
+        round trip per array on remote-attached accelerators. All split
+        boundaries come from the arrays' static shapes and the fetched
+        per-image counts."""
+        num_updates = len(self.detection_boxes)
+        if num_updates:
+            dtotals = [int(x.shape[0]) for x in self.detection_scores]
+            gtotals = [int(x.shape[0]) for x in self.groundtruth_labels]
+            ducounts = [int(x.shape[0]) for x in self.detection_counts]
+            fbuf, ibuf = jax.device_get(
+                _pack_ragged_state(
+                    list(self.detection_boxes),
+                    list(self.detection_scores),
+                    list(self.groundtruth_boxes),
+                    list(self.groundtruth_area),
+                    list(self.detection_labels),
+                    list(self.groundtruth_labels),
+                    list(self.groundtruth_crowds),
+                    list(self.detection_counts),
+                    list(self.groundtruth_counts),
+                )
             )
-        )
-        det_boxes, det_scores, det_labels, gt_boxes, gt_labels, gt_crowds, gt_area = (
-            [np.asarray(x) for x in group] for group in host
-        )
+            fbuf, ibuf = np.asarray(fbuf), np.asarray(ibuf)
+            dtot, gtot = sum(dtotals), sum(gtotals)
+            fb = np.split(fbuf, np.cumsum([4 * dtot, dtot, 4 * gtot]))
+            det_boxes_flat = fb[0].reshape(-1, 4)
+            det_scores_flat = fb[1]
+            gt_boxes_flat = fb[2].reshape(-1, 4)
+            gt_area_flat = fb[3]
+            ib = np.split(ibuf, np.cumsum([dtot, gtot, gtot, sum(ducounts)]))
+            det_labels_flat, gt_labels_flat, gt_crowds_flat, dcounts, gcounts = ib
+
+            dends = np.cumsum(dcounts)
+            gends = np.cumsum(gcounts)
+            num_imgs = len(dcounts)
+            det_boxes = np.split(det_boxes_flat, dends[:-1])
+            det_scores = np.split(det_scores_flat, dends[:-1])
+            det_labels = np.split(det_labels_flat, dends[:-1])
+            gt_boxes = np.split(gt_boxes_flat, gends[:-1])
+            gt_labels = np.split(gt_labels_flat, gends[:-1])
+            gt_crowds = np.split(gt_crowds_flat, gends[:-1])
+            gt_area = np.split(gt_area_flat, gends[:-1])
+        else:
+            num_imgs = 0
+            det_boxes = det_scores = det_labels = []
+            gt_boxes = gt_labels = gt_crowds = gt_area = []
         detections = [(det_boxes[i], det_scores[i], det_labels[i]) for i in range(num_imgs)]
         groundtruths = [
             (gt_boxes[i], gt_labels[i], gt_crowds[i], gt_area[i]) for i in range(num_imgs)
