@@ -1,0 +1,43 @@
+"""Pallas kernel semantics, pinned via the interpreter (CPU-safe).
+
+The kernels in ``tpumetrics/ops`` are explicit alternatives to XLA paths;
+these tests pin their exact semantics so the kernel code stays correct even
+while it is not the default lowering (see the module docstrings)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics.ops import binned_confusion_fused
+
+
+@pytest.mark.parametrize("n,c,t", [(257, 5, 13), (64, 1, 3), (130, 4, 129)])
+def test_binned_confusion_fused_matches_bruteforce(n, c, t):
+    rng = np.random.default_rng(42)
+    preds = rng.random((n, c)).astype(np.float32)
+    bits = rng.integers(0, 2, (n, c)).astype(np.float32)
+    valid = rng.integers(0, 2, (n, c)).astype(np.float32)
+    y = bits * valid
+    thr = np.sort(rng.random(t).astype(np.float32))
+    # exact ties at thresholds exercise the >= semantics
+    preds[: min(n, t), 0] = thr[: min(n, t)]
+
+    tp, pp = binned_confusion_fused(
+        jnp.asarray(preds), jnp.asarray(y), jnp.asarray(valid), jnp.asarray(thr), interpret=True
+    )
+    pos = (preds[:, :, None] >= thr[None, None, :]).astype(np.float64)
+    tp_ref = np.einsum("nct,nc->tc", pos, y)
+    pp_ref = np.einsum("nct,nc->tc", pos, valid)
+    assert np.array_equal(np.asarray(tp), tp_ref)
+    assert np.array_equal(np.asarray(pp), pp_ref)
+
+
+def test_binned_confusion_fused_nan_preds_below_all_thresholds():
+    preds = jnp.asarray([[0.2], [float("nan")], [0.8]], dtype=jnp.float32)
+    y = jnp.asarray([[1.0], [1.0], [0.0]])
+    v = jnp.ones((3, 1), jnp.float32)
+    thr = jnp.asarray([0.5], dtype=jnp.float32)
+    tp, pp = binned_confusion_fused(preds, y, v, thr, interpret=True)
+    # NaN >= thr is False: only the 0.8/y=0 sample is predicted positive
+    assert float(tp[0, 0]) == 0.0
+    assert float(pp[0, 0]) == 1.0

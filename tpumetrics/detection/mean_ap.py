@@ -26,19 +26,10 @@ from tpumetrics.metric import Metric
 Array = jax.Array
 
 
-@jax.jit
-def _pack_ragged_state(dbx, dsc, gbx, gar, dlb, glb, gcr, dct, gct):
-    """Flatten the ragged per-update state into one f32 + one i32 buffer.
-
-    Jitted so the whole gather is ONE device dispatch — issuing one eager
-    reshape/concat op per state entry (or fetching each entry individually)
-    pays a device round trip per op on remote-attached accelerators. The jit
-    cache keys on the shape tuple, so repeated evaluations of a fixed eval
-    set hit cache.
-    """
-    f = jnp.concatenate([b.reshape(-1) for b in dbx] + dsc + [b.reshape(-1) for b in gbx] + gar)
-    i = jnp.concatenate(dlb + glb + gcr + dct + gct)
-    return f, i
+def _cat(parts: List[Array]) -> Array:
+    """Concatenate a field's per-update arrays — one eager op (no jit, so no
+    per-shape recompiles when the state grows between ``compute`` calls)."""
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 
 
 class MeanAveragePrecision(Metric):
@@ -162,10 +153,9 @@ class MeanAveragePrecision(Metric):
         if not preds:
             return
 
-        dcounts = [int(_fix_empty_tensors(p["boxes"]).shape[0]) for p in preds]
-        self.detection_boxes.append(
-            self._convert_boxes(jnp.concatenate([_fix_empty_tensors(p["boxes"]) for p in preds]))
-        )
+        dboxes = [_fix_empty_tensors(p["boxes"]) for p in preds]
+        dcounts = [int(b.shape[0]) for b in dboxes]
+        self.detection_boxes.append(self._convert_boxes(jnp.concatenate(dboxes)))
         self.detection_scores.append(
             jnp.concatenate([jnp.ravel(p["scores"]) for p in preds]).astype(jnp.float32)
         )
@@ -174,10 +164,9 @@ class MeanAveragePrecision(Metric):
         )
         self.detection_counts.append(jnp.asarray(dcounts, jnp.int32))
 
-        gcounts = [int(_fix_empty_tensors(t["boxes"]).shape[0]) for t in target]
-        self.groundtruth_boxes.append(
-            self._convert_boxes(jnp.concatenate([_fix_empty_tensors(t["boxes"]) for t in target]))
-        )
+        gboxes = [_fix_empty_tensors(t["boxes"]) for t in target]
+        gcounts = [int(b.shape[0]) for b in gboxes]
+        self.groundtruth_boxes.append(self._convert_boxes(jnp.concatenate(gboxes)))
         self.groundtruth_labels.append(
             jnp.concatenate([jnp.ravel(t["labels"]) for t in target]).astype(jnp.int32)
         )
@@ -210,39 +199,41 @@ class MeanAveragePrecision(Metric):
     def compute(self) -> Dict[str, Array]:
         """Run the COCO protocol over the accumulated images.
 
-        The ragged state is concatenated ON DEVICE into one float32 and one
-        int32 buffer by a single jitted dispatch and fetched with exactly two
-        transfers — ``jax.device_get`` of the raw lists pays a full device
-        round trip per array on remote-attached accelerators. All split
-        boundaries come from the arrays' static shapes and the fetched
-        per-image counts."""
+        Each field's per-update arrays are concatenated ON DEVICE (one eager
+        concat per field — 9 dispatches total, independent of how many
+        updates or images accumulated) and fetched with one transfer per
+        field; fetching the raw per-update lists would pay a device round
+        trip per array on remote-attached accelerators, and a jitted pack
+        would recompile every time the state's shape signature changes.
+        Per-image boundaries come from the fetched counts arrays."""
         num_updates = len(self.detection_boxes)
         if num_updates:
-            dtotals = [int(x.shape[0]) for x in self.detection_scores]
-            gtotals = [int(x.shape[0]) for x in self.groundtruth_labels]
-            ducounts = [int(x.shape[0]) for x in self.detection_counts]
-            fbuf, ibuf = jax.device_get(
-                _pack_ragged_state(
-                    list(self.detection_boxes),
-                    list(self.detection_scores),
-                    list(self.groundtruth_boxes),
-                    list(self.groundtruth_area),
-                    list(self.detection_labels),
-                    list(self.groundtruth_labels),
-                    list(self.groundtruth_crowds),
-                    list(self.detection_counts),
-                    list(self.groundtruth_counts),
+            (
+                det_boxes_flat,
+                det_scores_flat,
+                det_labels_flat,
+                dcounts,
+                gt_boxes_flat,
+                gt_labels_flat,
+                gt_crowds_flat,
+                gt_area_flat,
+                gcounts,
+            ) = (
+                np.asarray(x)
+                for x in jax.device_get(
+                    (
+                        _cat(self.detection_boxes),
+                        _cat(self.detection_scores),
+                        _cat(self.detection_labels),
+                        _cat(self.detection_counts),
+                        _cat(self.groundtruth_boxes),
+                        _cat(self.groundtruth_labels),
+                        _cat(self.groundtruth_crowds),
+                        _cat(self.groundtruth_area),
+                        _cat(self.groundtruth_counts),
+                    )
                 )
             )
-            fbuf, ibuf = np.asarray(fbuf), np.asarray(ibuf)
-            dtot, gtot = sum(dtotals), sum(gtotals)
-            fb = np.split(fbuf, np.cumsum([4 * dtot, dtot, 4 * gtot]))
-            det_boxes_flat = fb[0].reshape(-1, 4)
-            det_scores_flat = fb[1]
-            gt_boxes_flat = fb[2].reshape(-1, 4)
-            gt_area_flat = fb[3]
-            ib = np.split(ibuf, np.cumsum([dtot, gtot, gtot, sum(ducounts)]))
-            det_labels_flat, gt_labels_flat, gt_crowds_flat, dcounts, gcounts = ib
 
             dends = np.cumsum(dcounts)
             gends = np.cumsum(gcounts)

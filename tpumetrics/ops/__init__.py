@@ -1,0 +1,10 @@
+"""Hand-written Pallas TPU kernels for the framework's hot ops.
+
+Everything here has a pure-XLA fallback at its call site — kernels are an
+optimization, never a requirement, and each wrapper exposes ``interpret=True``
+so the exact kernel code is testable on CPU.
+"""
+
+from tpumetrics.ops.binned_confusion import binned_confusion_fused
+
+__all__ = ["binned_confusion_fused"]
