@@ -109,6 +109,9 @@ def _get_precision_recall_f1(
     return precision.squeeze(-1), recall.squeeze(-1), f1_score.squeeze(-1)
 
 
+_get_precision_recall_f1_jit = jax.jit(_get_precision_recall_f1)
+
+
 def _embed(
     sentences: List[str],
     model: Any,
@@ -128,9 +131,20 @@ def _embed(
     input_ids = batch["input_ids"]
     attention_mask = batch["attention_mask"]
 
-    chunks = []
+    # pad the corpus to a whole number of chunks so every model forward sees
+    # ONE batch shape — otherwise the tail chunk triggers a second trace and
+    # XLA compile of the embedding forward for every distinct corpus size
+    n = len(sentences)
     step = max(1, batch_size)
-    for lo in range(0, len(sentences), step):
+    n_pad = -(-n // step) * step if n else 0
+    if n_pad != n:
+        input_ids = np.concatenate([input_ids, np.zeros((n_pad - n, input_ids.shape[1]), input_ids.dtype)])
+        attention_mask = np.concatenate(
+            [attention_mask, np.zeros((n_pad - n, attention_mask.shape[1]), attention_mask.dtype)]
+        )
+
+    chunks = []
+    for lo in range(0, n_pad, step):
         model_batch = {
             "input_ids": input_ids[lo : lo + step],
             "attention_mask": attention_mask[lo : lo + step],
@@ -142,7 +156,9 @@ def _embed(
         else:
             part = _default_forward(model, model_batch, all_layers, num_layers)
         chunks.append(part)
-    emb = jnp.concatenate(chunks, axis=0)
+    emb = jnp.concatenate(chunks, axis=0)[:n]
+    input_ids = input_ids[:n]
+    attention_mask = attention_mask[:n]
 
     emb = emb / jnp.clip(jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-12)
     mask = jnp.asarray(attention_mask, jnp.float32)
@@ -225,21 +241,31 @@ def bert_score(
         num_layers, batch_size
     )
 
-    # score in chunks too: the (b, l, p, r) similarity tensor is the peak
+    # score in chunks too: the (b, l, p, r) similarity tensor is the peak;
+    # chunks are padded to one uniform shape and the scoring fn is jitted, so
+    # the whole loop costs a single XLA compile regardless of corpus size
+    n = preds_emb.shape[0]
     step = max(1, batch_size)
+    n_pad = -(-n // step) * step if n else 0
+    if n_pad != n:
+        pad = [(0, n_pad - n)] + [(0, 0)] * (preds_emb.ndim - 1)
+        preds_emb = jnp.pad(preds_emb, pad)
+        target_emb = jnp.pad(target_emb, pad)
+        preds_scale = jnp.pad(preds_scale, [(0, n_pad - n), (0, 0)])
+        target_scale = jnp.pad(target_scale, [(0, n_pad - n), (0, 0)])
     parts = []
-    for lo in range(0, preds_emb.shape[0], step):
+    for lo in range(0, n_pad, step):
         parts.append(
-            _get_precision_recall_f1(
+            _get_precision_recall_f1_jit(
                 preds_emb[lo : lo + step],
                 target_emb[lo : lo + step],
                 preds_scale[lo : lo + step],
                 target_scale[lo : lo + step],
             )
         )
-    precision = jnp.concatenate([jnp.atleast_1d(p) for p, _, _ in parts])
-    recall = jnp.concatenate([jnp.atleast_1d(r) for _, r, _ in parts])
-    f1 = jnp.concatenate([jnp.atleast_1d(f) for _, _, f in parts])
+    precision = jnp.concatenate([jnp.atleast_1d(p) for p, _, _ in parts])[:n]
+    recall = jnp.concatenate([jnp.atleast_1d(r) for _, r, _ in parts])[:n]
+    f1 = jnp.concatenate([jnp.atleast_1d(f) for _, _, f in parts])[:n]
     output = {"precision": precision, "recall": recall, "f1": f1}
     if return_hash:
         output["hash"] = f"tpumetrics-bert_score-idf:{idf}"  # type: ignore[assignment]

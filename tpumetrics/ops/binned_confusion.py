@@ -75,12 +75,18 @@ def binned_confusion_fused(
     n, c = preds.shape
     t = thresholds.shape[0]
 
-    # tile sizes: N-tile sized so the (TN, C, TT) compare stays well under
-    # VMEM; T-tile at the 128-lane width (or the padded T if smaller)
-    tt = min(128, -(-t // 8) * 8)
     # the (TN, C, TT) compare plus its two broadcast products must fit in
-    # ~16 MB VMEM alongside the (C, TT) accumulators; budget ~0.5M elements
-    tn = max(8, min(1024, (1 << 19) // max(c * tt, 1) // 8 * 8))
+    # ~16 MB VMEM alongside the (C, TT) accumulators; budget ~0.5M elements.
+    # Wide class counts shrink the T-tile first, then the N-tile; beyond the
+    # budget even at the minimum (8, C, 8) tile the kernel cannot run
+    budget = 1 << 19
+    if c * 64 > budget:
+        raise ValueError(
+            f"binned_confusion_fused: num_classes={c} is too wide for the VMEM tile budget; "
+            "use the XLA path (_binned_confusion_contract)"
+        )
+    tt = max(8, min(128, -(-t // 8) * 8, budget // (c * 8) // 8 * 8))
+    tn = max(8, min(1024, budget // max(c * tt, 1) // 8 * 8))
     n_pad = -(-n // tn) * tn
     t_pad = -(-t // tt) * tt
 
