@@ -1,0 +1,97 @@
+"""Image metrics over the widened input matrix: odd spatial sizes, single
+channel, non-unit data ranges, alternative kernel sigmas, uint8-style value
+ranges, and batch-of-one (counterpart of the reference's parametrized
+tests/unittests/image/test_ssim.py / test_psnr.py input grids)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.image.test_image import _np_ssim
+from tpumetrics.functional.image import (
+    peak_signal_noise_ratio,
+    structural_similarity_index_measure,
+)
+from tpumetrics.image import PeakSignalNoiseRatio, StructuralSimilarityIndexMeasure
+
+_rng = np.random.default_rng(31)
+
+
+def _pair(shape, scale=1.0):
+    p = (_rng.random(shape) * scale).astype(np.float32)
+    t = np.clip(p * 0.85 + 0.1 * scale * _rng.random(shape), 0, scale).astype(np.float32)
+    return p, t
+
+
+@pytest.mark.parametrize(
+    "shape",
+    [(2, 1, 17, 23), (1, 3, 32, 32), (3, 4, 24, 15)],
+    ids=["odd-single-channel", "batch-of-one", "nonsquare-4ch"],
+)
+def test_ssim_shapes_vs_numpy(shape):
+    p, t = _pair(shape)
+    ours = float(structural_similarity_index_measure(jnp.asarray(p), jnp.asarray(t)))
+    ref = float(_np_ssim(p, t).mean())
+    assert np.isclose(ours, ref, atol=2e-4)
+
+
+@pytest.mark.parametrize("sigma", [0.8, 2.5])
+def test_ssim_sigma_vs_numpy(sigma):
+    p, t = _pair((2, 3, 28, 28))
+    ours = float(
+        structural_similarity_index_measure(jnp.asarray(p), jnp.asarray(t), sigma=sigma)
+    )
+    ref = float(_np_ssim(p, t, sigma=sigma).mean())
+    assert np.isclose(ours, ref, atol=2e-4)
+
+
+def test_ssim_data_range_255():
+    """uint8-style images with data_range=255 equal the [0,1] result."""
+    p01, t01 = _pair((2, 3, 24, 24))
+    ours255 = float(
+        structural_similarity_index_measure(
+            jnp.asarray(p01 * 255), jnp.asarray(t01 * 255), data_range=255.0
+        )
+    )
+    ours01 = float(
+        structural_similarity_index_measure(jnp.asarray(p01), jnp.asarray(t01), data_range=1.0)
+    )
+    assert np.isclose(ours255, ours01, atol=1e-4)
+
+
+def test_psnr_data_range_and_base():
+    p, t = _pair((2, 3, 16, 16), scale=255.0)
+    mse = float(np.mean((np.float64(p) - np.float64(t)) ** 2))
+    expected10 = 10 * np.log10(255.0**2 / mse)
+    ours = float(peak_signal_noise_ratio(jnp.asarray(p), jnp.asarray(t), data_range=255.0))
+    assert np.isclose(ours, expected10, atol=1e-3)
+    # base-e variant
+    ours_e = float(
+        peak_signal_noise_ratio(jnp.asarray(p), jnp.asarray(t), data_range=255.0, base=np.e)
+    )
+    assert np.isclose(ours_e, 10 * np.log(255.0**2 / mse), atol=1e-3)
+
+
+def test_psnr_identical_images_infinite():
+    p, _ = _pair((1, 1, 8, 8))
+    val = float(peak_signal_noise_ratio(jnp.asarray(p), jnp.asarray(p), data_range=1.0))
+    assert np.isinf(val)
+
+
+def test_class_api_streams_match_functional():
+    """Streaming class API over uneven batch sizes equals one functional call."""
+    p1, t1 = _pair((2, 3, 20, 20))
+    p2, t2 = _pair((5, 3, 20, 20))
+    m = PeakSignalNoiseRatio(data_range=1.0)
+    m.update(jnp.asarray(p1), jnp.asarray(t1))
+    m.update(jnp.asarray(p2), jnp.asarray(t2))
+    pall = np.concatenate([p1, p2])
+    tall = np.concatenate([t1, t2])
+    ref = float(peak_signal_noise_ratio(jnp.asarray(pall), jnp.asarray(tall), data_range=1.0))
+    assert np.isclose(float(m.compute()), ref, atol=1e-5)
+
+    s = StructuralSimilarityIndexMeasure()
+    s.update(jnp.asarray(p1), jnp.asarray(t1))
+    s.update(jnp.asarray(p2), jnp.asarray(t2))
+    ref_s = float(structural_similarity_index_measure(jnp.asarray(pall), jnp.asarray(tall)))
+    assert np.isclose(float(s.compute()), ref_s, atol=1e-5)

@@ -1,0 +1,145 @@
+"""Regression metrics over the widened input matrix: multi-output shapes,
+RMSE mode, per-column correlations, emulated DDP, and shard_map sync
+(counterpart of the reference's per-metric parametrizations in
+tests/unittests/regression/test_*.py, e.g. test_mean_error.py's
+num_outputs/multioutput cases)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import pearsonr, spearmanr
+from sklearn.metrics import (
+    explained_variance_score as sk_ev,
+    mean_absolute_error as sk_mae,
+    mean_squared_error as sk_mse,
+    r2_score as sk_r2,
+)
+
+import tpumetrics.regression as tmrc
+from tests.conftest import BATCH_SIZE, NUM_BATCHES
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.default_rng(7)
+N_OUT = 3
+preds_mo = _rng.standard_normal((NUM_BATCHES, BATCH_SIZE, N_OUT)).astype(np.float32)
+target_mo = (preds_mo + 0.3 * _rng.standard_normal(preds_mo.shape)).astype(np.float32)
+
+
+def _j(x):
+    return [jnp.asarray(b) for b in x]
+
+
+class TestMultioutput(MetricTester):
+    """num_outputs > 1 keeps per-column values (sklearn multioutput='raw_values')."""
+
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize(
+        ("metric_class", "args", "ref"),
+        [
+            (tmrc.MeanSquaredError, {"num_outputs": N_OUT}, lambda p, t: sk_mse(t, p, multioutput="raw_values")),
+            (
+                tmrc.LogCoshError,
+                {"num_outputs": N_OUT},
+                lambda p, t: np.mean(np.log(np.cosh(np.float64(p) - np.float64(t))), axis=0),
+            ),
+            (
+                tmrc.R2Score,
+                {"num_outputs": N_OUT, "multioutput": "raw_values"},
+                lambda p, t: sk_r2(t, p, multioutput="raw_values"),
+            ),
+            (
+                tmrc.ExplainedVariance,
+                {"multioutput": "raw_values"},
+                lambda p, t: sk_ev(t, p, multioutput="raw_values"),
+            ),
+        ],
+        ids=["mse", "log_cosh", "r2", "explained_variance"],
+    )
+    def test_vs_sklearn_raw_values(self, metric_class, args, ref, ddp):
+        def np_ref(p, t):
+            return np.asarray(ref(p.reshape(-1, N_OUT), t.reshape(-1, N_OUT)), np.float64)
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_j(preds_mo),
+            target=_j(target_mo),
+            metric_class=metric_class,
+            reference_metric=np_ref,
+            metric_args=args,
+            check_batch=False,
+        )
+
+    def test_rmse_mode(self):
+        m = tmrc.MeanSquaredError(squared=False)
+        for i in range(NUM_BATCHES):
+            m.update(jnp.asarray(preds_mo[i, :, 0]), jnp.asarray(target_mo[i, :, 0]))
+        expected = np.sqrt(sk_mse(target_mo[:, :, 0].ravel(), preds_mo[:, :, 0].ravel()))
+        assert np.isclose(float(m.compute()), expected, atol=1e-5)
+
+    def test_rmse_multioutput(self):
+        m = tmrc.MeanSquaredError(squared=False, num_outputs=N_OUT)
+        for i in range(NUM_BATCHES):
+            m.update(jnp.asarray(preds_mo[i]), jnp.asarray(target_mo[i]))
+        expected = np.sqrt(
+            sk_mse(target_mo.reshape(-1, N_OUT), preds_mo.reshape(-1, N_OUT), multioutput="raw_values")
+        )
+        assert np.allclose(np.asarray(m.compute()), expected, atol=1e-5)
+
+
+class TestPerColumnCorrelation(MetricTester):
+    """Pearson/Spearman with num_outputs > 1 match scipy column-by-column."""
+
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_pearson_multioutput(self, ddp):
+        def ref(p, t):
+            p, t = p.reshape(-1, N_OUT), t.reshape(-1, N_OUT)
+            return np.asarray([pearsonr(p[:, k], t[:, k])[0] for k in range(N_OUT)])
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_j(preds_mo),
+            target=_j(target_mo),
+            metric_class=tmrc.PearsonCorrCoef,
+            reference_metric=ref,
+            metric_args={"num_outputs": N_OUT},
+            check_batch=False,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_spearman_multioutput(self, ddp):
+        def ref(p, t):
+            p, t = p.reshape(-1, N_OUT), t.reshape(-1, N_OUT)
+            return np.asarray([spearmanr(p[:, k], t[:, k])[0] for k in range(N_OUT)])
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_j(preds_mo),
+            target=_j(target_mo),
+            metric_class=tmrc.SpearmanCorrCoef,
+            reference_metric=ref,
+            metric_args={"num_outputs": N_OUT},
+            check_batch=False,
+        )
+
+
+def test_single_element_batches():
+    """Streaming one sample at a time equals the full-batch value."""
+    p = preds_mo[:, :4, 0].ravel()
+    t = target_mo[:, :4, 0].ravel()
+    m = tmrc.MeanSquaredError()
+    for x, y in zip(p, t):
+        m.update(jnp.asarray([x]), jnp.asarray([y]))
+    assert np.isclose(float(m.compute()), sk_mse(t, p), atol=1e-6)
+
+
+def test_float64_inputs_under_x64_disabled():
+    """f64 numpy inputs are accepted and downcast cleanly."""
+    m = tmrc.MeanAbsoluteError()
+    m.update(jnp.asarray(preds_mo[0, :, 0].astype(np.float64)), jnp.asarray(target_mo[0, :, 0].astype(np.float64)))
+    assert np.isclose(
+        float(m.compute()), sk_mae(target_mo[0, :, 0], preds_mo[0, :, 0]), atol=1e-5
+    )

@@ -1,0 +1,117 @@
+"""Text-metric edge cases: empty/identical/unicode inputs, multi-reference
+corpora, and streaming-vs-batch equality (counterpart of the reference's
+edge parametrizations in tests/unittests/text/)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import sacrebleu
+
+from tpumetrics.functional.text import (
+    bleu_score,
+    char_error_rate,
+    edit_distance,
+    match_error_rate,
+    rouge_score,
+    sacre_bleu_score,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+from tpumetrics.text import BLEUScore, CharErrorRate, ROUGEScore, WordErrorRate
+
+
+def test_identical_sentences_are_perfect():
+    preds = ["the quick brown fox", "jumps over the dog"]
+    assert float(word_error_rate(preds, preds)) == 0.0
+    assert float(char_error_rate(preds, preds)) == 0.0
+    assert float(match_error_rate(preds, preds)) == 0.0
+    assert float(word_information_lost(preds, preds)) == 0.0
+    assert np.isclose(float(word_information_preserved(preds, preds)), 1.0)
+    assert np.isclose(float(bleu_score(preds, [[p] for p in preds])), 1.0)
+
+
+def test_empty_hypothesis():
+    """Empty predictions: WER is 1 (all deleted), BLEU is 0."""
+    assert float(word_error_rate([""], ["a b c"])) == 1.0
+    assert float(char_error_rate([""], ["abc"])) == 1.0
+    assert float(bleu_score([""], [["a b c"]])) == 0.0
+
+
+def test_unicode_and_whitespace():
+    preds = ["café naïve – résumé", "  spaced   out  "]
+    target = ["café naïve – résumé", "spaced out"]
+    assert float(char_error_rate([preds[0]], [target[0]])) == 0.0
+    # extra whitespace collapses at the word level
+    assert float(word_error_rate([preds[1]], [target[1]])) == 0.0
+
+
+def test_edit_distance_known_values():
+    assert float(edit_distance(["kitten"], ["sitting"])) == 3.0
+    assert float(edit_distance([""], ["abc"])) == 3.0
+    assert float(edit_distance(["abc"], [""])) == 3.0
+    assert float(edit_distance(["abc"], ["abc"])) == 0.0
+
+
+@pytest.mark.parametrize("n_refs", [2, 3])
+def test_sacrebleu_multi_reference_parity(n_refs):
+    preds = ["the cat is on the mat", "there is a dog in the park"]
+    refs = [
+        ["the cat sits on the mat", "a dog runs in the park"],
+        ["a cat is on the mat", "the dog is in a park"],
+        ["cat on mat", "dog in park"],
+    ][:n_refs]
+    # tpumetrics wants per-sentence reference lists
+    target = [[refs[r][i] for r in range(n_refs)] for i in range(len(preds))]
+    ours = float(sacre_bleu_score(preds, target))
+    expected = sacrebleu.corpus_bleu(preds, refs).score / 100
+    assert np.isclose(ours, expected, atol=1e-6)
+
+
+def test_bleu_streaming_matches_corpus():
+    preds = ["a b c d", "e f g h", "a c e g"]
+    target = [["a b c d e"], ["e f g"], ["a b c e g"]]
+    m = BLEUScore()
+    for p, t in zip(preds, target):
+        m.update([p], [t])
+    corpus = float(bleu_score(preds, target))
+    assert np.isclose(float(m.compute()), corpus, atol=1e-7)
+
+
+def test_wer_streaming_matches_corpus():
+    preds = ["hello world", "good morning everyone", "short"]
+    target = ["hello there world", "good morning", "a longer target here"]
+    m = WordErrorRate()
+    for p, t in zip(preds, target):
+        m.update([p], [t])
+    assert np.isclose(float(m.compute()), float(word_error_rate(preds, target)), atol=1e-7)
+
+
+def test_cer_class_empty_update_then_data():
+    m = CharErrorRate()
+    m.update([], [])
+    m.update(["abc"], ["axc"])
+    assert np.isclose(float(m.compute()), 1 / 3, atol=1e-7)
+
+
+def test_rouge_vs_rouge_score_package():
+    rs = pytest.importorskip("rouge_score.rouge_scorer")
+    preds = ["the cat sat on the mat", "a quick brown fox"]
+    target = ["the cat was sitting on the mat", "the quick brown fox jumps"]
+    ours = rouge_score(preds, target, rouge_keys=("rouge1", "rouge2", "rougeL"))
+    scorer = rs.RougeScorer(["rouge1", "rouge2", "rougeL"], use_stemmer=False)
+    for key in ("rouge1", "rouge2", "rougeL"):
+        expected = np.mean([scorer.score(t, p)[key].fmeasure for p, t in zip(preds, target)])
+        assert np.isclose(float(ours[f"{key}_fmeasure"]), expected, atol=1e-6), key
+
+
+def test_rouge_class_accumulates_mean():
+    preds = ["the cat sat", "dogs run fast"]
+    target = ["the cat sat down", "dogs often run fast"]
+    m = ROUGEScore(rouge_keys=("rouge1",))
+    for p, t in zip(preds, target):
+        m.update([p], [t])
+    batched = rouge_score(preds, target, rouge_keys=("rouge1",))
+    assert np.isclose(
+        float(m.compute()["rouge1_fmeasure"]), float(batched["rouge1_fmeasure"]), atol=1e-7
+    )
