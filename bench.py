@@ -327,8 +327,7 @@ def _bench_bertscore_ddp() -> float:
     # followed by ONE batched embed+score over the union
     combined = make()
     for m in replicas:
-        combined._preds.extend(m._preds)
-        combined._target.extend(m._target)
+        combined.update(m._preds, m._target)
     out = combined.compute()
     f1 = np.asarray(out["f1"])
     assert f1.shape[0] == world * steps * per_rank, f1.shape

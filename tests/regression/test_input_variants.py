@@ -94,32 +94,21 @@ class TestPerColumnCorrelation(MetricTester):
     atol = 1e-4
 
     @pytest.mark.parametrize("ddp", [False, True])
-    def test_pearson_multioutput(self, ddp):
+    @pytest.mark.parametrize(
+        ("metric_class", "scipy_fn"),
+        [(tmrc.PearsonCorrCoef, pearsonr), (tmrc.SpearmanCorrCoef, spearmanr)],
+        ids=["pearson", "spearman"],
+    )
+    def test_correlation_multioutput(self, metric_class, scipy_fn, ddp):
         def ref(p, t):
             p, t = p.reshape(-1, N_OUT), t.reshape(-1, N_OUT)
-            return np.asarray([pearsonr(p[:, k], t[:, k])[0] for k in range(N_OUT)])
+            return np.asarray([scipy_fn(p[:, k], t[:, k])[0] for k in range(N_OUT)])
 
         self.run_class_metric_test(
             ddp=ddp,
             preds=_j(preds_mo),
             target=_j(target_mo),
-            metric_class=tmrc.PearsonCorrCoef,
-            reference_metric=ref,
-            metric_args={"num_outputs": N_OUT},
-            check_batch=False,
-        )
-
-    @pytest.mark.parametrize("ddp", [False, True])
-    def test_spearman_multioutput(self, ddp):
-        def ref(p, t):
-            p, t = p.reshape(-1, N_OUT), t.reshape(-1, N_OUT)
-            return np.asarray([spearmanr(p[:, k], t[:, k])[0] for k in range(N_OUT)])
-
-        self.run_class_metric_test(
-            ddp=ddp,
-            preds=_j(preds_mo),
-            target=_j(target_mo),
-            metric_class=tmrc.SpearmanCorrCoef,
+            metric_class=metric_class,
             reference_metric=ref,
             metric_args={"num_outputs": N_OUT},
             check_batch=False,

@@ -242,3 +242,116 @@ def test_composition_forward_updates_children():
 def test_unexpected_kwargs_raise():
     with pytest.raises(ValueError, match="Unexpected keyword"):
         DummyMetric(not_a_real_kwarg=True)
+
+
+def test_forward_paths_agree():
+    """full_state_update=True and False produce identical batch values and
+    identical accumulated state (reference test_metric.py forward cases)."""
+    import tpumetrics.classification as tmc
+
+    rng = np.random.default_rng(0)
+    preds = [rng.random((16,)).astype(np.float32) for _ in range(3)]
+    target = [rng.integers(0, 2, (16,)).astype(np.int32) for _ in range(3)]
+
+    class FullState(tmc.BinaryAccuracy):
+        full_state_update = True
+
+    fast = tmc.BinaryAccuracy()
+    slow = FullState()
+    for p, t in zip(preds, target):
+        v_fast = fast(jnp.asarray(p), jnp.asarray(t))
+        v_slow = slow(jnp.asarray(p), jnp.asarray(t))
+        assert np.isclose(float(v_fast), float(v_slow)), "batch values diverge"
+    assert np.isclose(float(fast.compute()), float(slow.compute()))
+
+
+def test_compute_with_cache_disabled_recomputes():
+    from tpumetrics.aggregation import SumMetric
+
+    cached = SumMetric()
+    cached.update(jnp.asarray(1.0))
+    cached.compute()
+    assert cached._computed is not None  # cache populated
+
+    m = SumMetric(compute_with_cache=False)
+    m.update(jnp.asarray(1.0))
+    assert float(m.compute()) == 1.0
+    assert m._computed is None  # nothing cached between back-to-back computes
+    assert float(m.compute()) == 1.0
+
+
+def test_sync_on_compute_false_keeps_local_value():
+    """With sync_on_compute=False, compute() must not invoke the backend."""
+    from tpumetrics.aggregation import SumMetric
+
+    calls = []
+
+    def recording_sync(x, group=None):
+        calls.append(x)
+        return [x, x]
+
+    m = SumMetric(sync_on_compute=False, dist_sync_fn=recording_sync, distributed_available_fn=lambda: True)
+    m.update(jnp.asarray(2.0))
+    assert float(m.compute()) == 2.0
+    assert not calls, "backend was called despite sync_on_compute=False"
+
+
+def test_load_state_dict_roundtrip():
+    from tpumetrics.aggregation import MeanMetric
+
+    m = MeanMetric()
+    m.persistent(True)
+    m.update(jnp.asarray([1.0, 2.0, 3.0]))
+    sd = m.state_dict()
+    m2 = MeanMetric()
+    m2.load_state_dict(sd)
+    assert np.isclose(float(m2.compute()), 2.0)
+
+
+def test_set_dtype_keeps_integer_states():
+    """bf16 set_dtype must not downcast integer count states."""
+    import tpumetrics.classification as tmc
+
+    m = tmc.BinaryAccuracy()
+    m.set_dtype(jnp.bfloat16)
+    m.update(jnp.asarray([0.9, 0.2], dtype=jnp.bfloat16), jnp.asarray([1, 0]))
+    out = m.compute()
+    assert float(out) == 1.0
+
+
+def test_reset_clears_compute_cache():
+    from tpumetrics.aggregation import SumMetric
+
+    import warnings
+
+    m = SumMetric()
+    m.update(jnp.asarray(5.0))
+    assert float(m.compute()) == 5.0
+    m.reset()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # compute-before-update warning
+        assert float(m.compute()) == 0.0, "stale compute cache survived reset"
+
+
+def test_state_donation_functional_update():
+    """functional_update under jit with donated state buffers is safe."""
+    from tpumetrics.aggregation import SumMetric
+
+    m = SumMetric()
+    step = jax.jit(m.functional_update, donate_argnums=(0,))
+    state = m.init_state()
+    for v in (1.0, 2.0, 3.5):
+        state = step(state, jnp.asarray(v))
+    assert np.isclose(float(m.functional_compute(state)), 6.5)
+
+
+def test_metric_keeps_python_attribute_types():
+    """Non-state attrs survive pickling and cloning untouched."""
+    import pickle
+
+    import tpumetrics.classification as tmc
+
+    m = tmc.MulticlassAccuracy(num_classes=7, average="macro")
+    m2 = pickle.loads(pickle.dumps(m)).clone()
+    assert m2.num_classes == 7
+    assert m2.average == "macro"
