@@ -383,3 +383,28 @@ def test_micro_class_metrics_align_with_classes():
     assert classes.shape == per_class.shape == (2,)
     assert np.allclose(per_class, 1.0)
     assert np.asarray(out["mar_100_per_class"]).shape == (2,)
+
+
+def test_map_box_format_xywh_matches_xyxy():
+    """mAP with xywh inputs equals mAP with the same boxes given as xyxy
+    (conversion happens on the batched update path)."""
+    gt = np.asarray([[10.0, 10, 50, 50], [100.0, 100, 160, 180]], np.float32)
+    det = gt + np.asarray([[2.0, -3, 4, 1], [-2.0, 2, -5, 3]], np.float32)
+
+    def xywh(b):
+        out = b.copy()
+        out[:, 2:] = b[:, 2:] - b[:, :2]
+        return out
+
+    scores = jnp.asarray([0.9, 0.6], dtype=jnp.float32)
+    labels = jnp.asarray([0, 1])
+
+    m1 = MeanAveragePrecision()
+    m1.update([dict(boxes=jnp.asarray(det), scores=scores, labels=labels)],
+              [dict(boxes=jnp.asarray(gt), labels=labels)])
+    m2 = MeanAveragePrecision(box_format="xywh")
+    m2.update([dict(boxes=jnp.asarray(xywh(det)), scores=scores, labels=labels)],
+              [dict(boxes=jnp.asarray(xywh(gt)), labels=labels)])
+    r1, r2 = m1.compute(), m2.compute()
+    for k in ("map", "map_50", "map_75", "mar_100"):
+        assert np.isclose(float(r1[k]), float(r2[k]), atol=1e-7), k

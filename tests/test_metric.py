@@ -356,3 +356,26 @@ def test_metric_keeps_python_attribute_types():
     m2 = pickle.loads(pickle.dumps(m)).clone()
     assert m2.num_classes == 7
     assert m2.average == "macro"
+
+
+def test_metric_state_checkpoints_with_orbax(tmp_path):
+    """Functional metric states are plain pytrees of arrays — they round-trip
+    through orbax exactly like model params (TPU-native checkpoint path; the
+    reference piggybacks on torch state_dict instead)."""
+    orbax = pytest.importorskip("orbax.checkpoint")
+
+    import tpumetrics.classification as tmc
+
+    m = tmc.MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+    state = m.init_state()
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.random((32, 4), dtype=np.float32))
+    target = jnp.asarray(rng.integers(0, 4, 32))
+    state = m.functional_update(state, preds, target)
+    expected = float(m.functional_compute(state))
+
+    ckpt = orbax.PyTreeCheckpointer()
+    path = tmp_path / "metric_state"
+    ckpt.save(path, state)
+    restored = ckpt.restore(path)
+    assert np.isclose(float(m.functional_compute(restored)), expected)
