@@ -71,6 +71,17 @@ def binned_confusion_fused(
     ``preds``/``y``/``v`` are ``(N, C)`` f32; ``thresholds`` is ``(T,)`` f32.
     ``interpret=True`` runs the kernel in the Pallas interpreter (CPU-safe,
     used by the test suite to pin the kernel's exact semantics).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.ops import binned_confusion_fused
+        >>> preds = jnp.asarray([[0.2], [0.7], [0.9]])
+        >>> y = jnp.asarray([[0.0], [1.0], [1.0]])
+        >>> v = jnp.ones((3, 1))
+        >>> thr = jnp.asarray([0.5])
+        >>> tp, predpos = binned_confusion_fused(preds, y, v, thr, interpret=True)
+        >>> float(tp[0, 0]), float(predpos[0, 0])
+        (2.0, 2.0)
     """
     n, c = preds.shape
     t = thresholds.shape[0]
