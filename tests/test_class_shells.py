@@ -1,0 +1,242 @@
+"""Class-shell wiring sweep: every thin modular class must accumulate over
+batches to exactly what its functional form computes on the concatenated
+data (the reference exercises this pairing per metric file; here one
+parametrized harness covers the classes that have no dedicated test)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tpumetrics.classification as tmc
+import tpumetrics.functional.classification as tmf
+import tpumetrics.functional.regression as tmfr
+import tpumetrics.regression as tmr
+from tests.conftest import NUM_BATCHES
+from tests.helpers.testers import _class_test
+
+_rng = np.random.default_rng(99)
+N_BATCH, B, C, L, E = NUM_BATCHES, 64, 5, 4, 6
+
+bin_probs = [_rng.random(B).astype(np.float32) for _ in range(N_BATCH)]
+bin_target = [_rng.integers(0, 2, B).astype(np.int32) for _ in range(N_BATCH)]
+mc_logits = [_rng.standard_normal((B, C)).astype(np.float32) for _ in range(N_BATCH)]
+mc_target = [_rng.integers(0, C, B).astype(np.int32) for _ in range(N_BATCH)]
+mc_logits_md = [_rng.standard_normal((B, C, E)).astype(np.float32) for _ in range(N_BATCH)]
+mc_target_md = [_rng.integers(0, C, (B, E)).astype(np.int32) for _ in range(N_BATCH)]
+ml_probs = [_rng.random((B, L)).astype(np.float32) for _ in range(N_BATCH)]
+ml_target = [_rng.integers(0, 2, (B, L)).astype(np.int32) for _ in range(N_BATCH)]
+reg_preds = [_rng.standard_normal(B).astype(np.float32) for _ in range(N_BATCH)]
+reg_target = [(p + 0.3 * _rng.standard_normal(B)).astype(np.float32) for p in reg_preds]
+reg_pos_preds = [np.abs(p) + 0.1 for p in reg_preds]
+reg_pos_target = [np.abs(t) + 0.1 for t in reg_target]
+
+_INPUTS = {
+    "binary": (bin_probs, bin_target),
+    "multiclass": (mc_logits, mc_target),
+    "multiclass_md": (mc_logits_md, mc_target_md),
+    "multilabel": (ml_probs, ml_target),
+    "regression": (reg_preds, reg_target),
+    "regression_pos": (reg_pos_preds, reg_pos_target),
+}
+
+CASES = [
+    # classification: binary
+    (tmc.BinaryStatScores, {}, tmf.binary_stat_scores, {}, "binary"),
+    (tmc.BinaryFBetaScore, {"beta": 0.5}, tmf.binary_fbeta_score, {"beta": 0.5}, "binary"),
+    (tmc.BinaryHammingDistance, {}, tmf.binary_hamming_distance, {}, "binary"),
+    (tmc.BinaryHingeLoss, {}, tmf.binary_hinge_loss, {}, "binary"),
+    (tmc.BinaryConfusionMatrix, {}, tmf.binary_confusion_matrix, {}, "binary"),
+    (tmc.BinaryROC, {"thresholds": 16}, tmf.binary_roc, {"thresholds": 16}, "binary"),
+    # classification: multiclass
+    (tmc.MulticlassStatScores, {"num_classes": C}, tmf.multiclass_stat_scores, {"num_classes": C}, "multiclass"),
+    (
+        tmc.MulticlassFBetaScore,
+        {"num_classes": C, "beta": 2.0},
+        tmf.multiclass_fbeta_score,
+        {"num_classes": C, "beta": 2.0},
+        "multiclass",
+    ),
+    (
+        tmc.MulticlassHammingDistance,
+        {"num_classes": C},
+        tmf.multiclass_hamming_distance,
+        {"num_classes": C},
+        "multiclass",
+    ),
+    (tmc.MulticlassHingeLoss, {"num_classes": C}, tmf.multiclass_hinge_loss, {"num_classes": C}, "multiclass"),
+    (
+        tmc.MulticlassCalibrationError,
+        {"num_classes": C, "n_bins": 10},
+        tmf.multiclass_calibration_error,
+        {"num_classes": C, "n_bins": 10},
+        "multiclass",
+    ),
+    (
+        tmc.MulticlassSpecificity,
+        {"num_classes": C},
+        tmf.multiclass_specificity,
+        {"num_classes": C},
+        "multiclass",
+    ),
+    (
+        tmc.MulticlassExactMatch,
+        {"num_classes": C},
+        tmf.multiclass_exact_match,
+        {"num_classes": C},
+        "multiclass_md",
+    ),
+    (
+        tmc.MulticlassPrecisionRecallCurve,
+        {"num_classes": C, "thresholds": 16},
+        tmf.multiclass_precision_recall_curve,
+        {"num_classes": C, "thresholds": 16},
+        "multiclass",
+    ),
+    (
+        tmc.MulticlassPrecisionAtFixedRecall,
+        {"num_classes": C, "min_recall": 0.5, "thresholds": 32},
+        tmf.multiclass_precision_at_fixed_recall,
+        {"num_classes": C, "min_recall": 0.5, "thresholds": 32},
+        "multiclass",
+    ),
+    (
+        tmc.MulticlassRecallAtFixedPrecision,
+        {"num_classes": C, "min_precision": 0.5, "thresholds": 32},
+        tmf.multiclass_recall_at_fixed_precision,
+        {"num_classes": C, "min_precision": 0.5, "thresholds": 32},
+        "multiclass",
+    ),
+    (
+        tmc.MulticlassSpecificityAtSensitivity,
+        {"num_classes": C, "min_sensitivity": 0.5, "thresholds": 32},
+        tmf.multiclass_specificity_at_sensitivity,
+        {"num_classes": C, "min_sensitivity": 0.5, "thresholds": 32},
+        "multiclass",
+    ),
+    # classification: multilabel
+    (tmc.MultilabelStatScores, {"num_labels": L}, tmf.multilabel_stat_scores, {"num_labels": L}, "multilabel"),
+    (
+        tmc.MultilabelFBetaScore,
+        {"num_labels": L, "beta": 0.5},
+        tmf.multilabel_fbeta_score,
+        {"num_labels": L, "beta": 0.5},
+        "multilabel",
+    ),
+    (
+        tmc.MultilabelHammingDistance,
+        {"num_labels": L},
+        tmf.multilabel_hamming_distance,
+        {"num_labels": L},
+        "multilabel",
+    ),
+    (
+        tmc.MultilabelConfusionMatrix,
+        {"num_labels": L},
+        tmf.multilabel_confusion_matrix,
+        {"num_labels": L},
+        "multilabel",
+    ),
+    (tmc.MultilabelROC, {"num_labels": L, "thresholds": 16}, tmf.multilabel_roc, {"num_labels": L, "thresholds": 16}, "multilabel"),
+    (
+        tmc.MultilabelJaccardIndex,
+        {"num_labels": L},
+        tmf.multilabel_jaccard_index,
+        {"num_labels": L},
+        "multilabel",
+    ),
+    (
+        tmc.MultilabelMatthewsCorrCoef,
+        {"num_labels": L},
+        tmf.multilabel_matthews_corrcoef,
+        {"num_labels": L},
+        "multilabel",
+    ),
+    (
+        tmc.MultilabelExactMatch,
+        {"num_labels": L},
+        tmf.multilabel_exact_match,
+        {"num_labels": L},
+        "multilabel",
+    ),
+    (
+        tmc.MultilabelSpecificity,
+        {"num_labels": L},
+        tmf.multilabel_specificity,
+        {"num_labels": L},
+        "multilabel",
+    ),
+    (
+        tmc.MultilabelPrecisionAtFixedRecall,
+        {"num_labels": L, "min_recall": 0.5, "thresholds": 32},
+        tmf.multilabel_precision_at_fixed_recall,
+        {"num_labels": L, "min_recall": 0.5, "thresholds": 32},
+        "multilabel",
+    ),
+    (
+        tmc.MultilabelRecallAtFixedPrecision,
+        {"num_labels": L, "min_precision": 0.5, "thresholds": 32},
+        tmf.multilabel_recall_at_fixed_precision,
+        {"num_labels": L, "min_precision": 0.5, "thresholds": 32},
+        "multilabel",
+    ),
+    (
+        tmc.MultilabelSpecificityAtSensitivity,
+        {"num_labels": L, "min_sensitivity": 0.5, "thresholds": 32},
+        tmf.multilabel_specificity_at_sensitivity,
+        {"num_labels": L, "min_sensitivity": 0.5, "thresholds": 32},
+        "multilabel",
+    ),
+    # regression
+    (tmr.CosineSimilarity, {}, tmfr.cosine_similarity, {}, "regression"),
+    (tmr.MinkowskiDistance, {"p": 3.0}, tmfr.minkowski_distance, {"p": 3.0}, "regression"),
+    (tmr.RelativeSquaredError, {}, tmfr.relative_squared_error, {}, "regression"),
+    (
+        tmr.SymmetricMeanAbsolutePercentageError,
+        {},
+        tmfr.symmetric_mean_absolute_percentage_error,
+        {},
+        "regression_pos",
+    ),
+    (
+        tmr.WeightedMeanAbsolutePercentageError,
+        {},
+        tmfr.weighted_mean_absolute_percentage_error,
+        {},
+        "regression_pos",
+    ),
+    (
+        tmr.TweedieDevianceScore,
+        {"power": 1.5},
+        tmfr.tweedie_deviance_score,
+        {"power": 1.5},
+        "regression_pos",
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    ("metric_class", "args", "fn", "fn_args", "kind"),
+    CASES,
+    ids=[c[0].__name__ for c in CASES],
+)
+def test_class_accumulates_to_functional(metric_class, args, fn, fn_args, kind):
+    """Full protocol harness (const-attr guard, pickle, clone, forward-vs-
+    update agreement, state_dict) with the functional form as the reference."""
+    preds, target = _INPUTS[kind]
+    _class_test(
+        [jnp.asarray(p) for p in preds],
+        [jnp.asarray(t) for t in target],
+        metric_class,
+        lambda p, t: fn(jnp.asarray(p), jnp.asarray(t), **fn_args),
+        metric_args=args,
+        atol=1e-5,
+    )
+
+
+def test_task_wrappers_dispatch_extra():
+    assert isinstance(tmc.StatScores(task="binary"), tmc.BinaryStatScores)
+    assert isinstance(tmc.FBetaScore(task="multiclass", num_classes=C, beta=0.5), tmc.MulticlassFBetaScore)
+    assert isinstance(tmc.HammingDistance(task="multilabel", num_labels=L), tmc.MultilabelHammingDistance)
+    assert isinstance(tmc.HingeLoss(task="binary"), tmc.BinaryHingeLoss)
+    assert isinstance(tmc.ExactMatch(task="multiclass", num_classes=C), tmc.MulticlassExactMatch)
+    assert isinstance(tmc.ConfusionMatrix(task="multilabel", num_labels=L), tmc.MultilabelConfusionMatrix)
