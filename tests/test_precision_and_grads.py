@@ -1,0 +1,85 @@
+"""Half-precision robustness and gradient checks across domains.
+
+Invokes the strengthened harness hooks (tests/helpers/testers.py):
+``run_precision_test`` compares the bf16 result against fp32 with a loose
+tolerance (reference run_precision_test_cpu/gpu :454-520), and
+``run_differentiability_test`` checks ``jax.grad`` finiteness plus a
+directional-derivative match against central differences (reference
+gradcheck :522-560)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tpumetrics.classification as tmc
+import tpumetrics.functional.classification as tmf
+import tpumetrics.functional.image as tmfi
+import tpumetrics.functional.regression as tmfr
+import tpumetrics.image as tmi
+import tpumetrics.regression as tmr
+from tpumetrics.functional.audio import signal_noise_ratio
+from tpumetrics.audio import SignalNoiseRatio
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.default_rng(17)
+N = 64
+
+reg_preds = [jnp.asarray(_rng.standard_normal(N).astype(np.float32)) for _ in range(2)]
+reg_target = [jnp.asarray((np.asarray(p) + 0.3 * _rng.standard_normal(N)).astype(np.float32)) for p in reg_preds]
+vec_preds = [jnp.asarray(_rng.standard_normal((N, 8)).astype(np.float32)) for _ in range(2)]
+vec_target = [jnp.asarray((np.asarray(p) + 0.3 * _rng.standard_normal((N, 8))).astype(np.float32)) for p in vec_preds]
+img_preds = [jnp.asarray(_rng.random((2, 3, 16, 16)).astype(np.float32)) for _ in range(2)]
+img_target = [jnp.asarray(np.clip(np.asarray(p) * 0.9 + 0.05, 0, 1).astype(np.float32)) for p in img_preds]
+bin_probs = [jnp.asarray(_rng.random(N).astype(np.float32)) for _ in range(2)]
+bin_target = [jnp.asarray(_rng.integers(0, 2, N).astype(np.int32)) for _ in range(2)]
+mc_logits = [jnp.asarray(_rng.standard_normal((N, 5)).astype(np.float32)) for _ in range(2)]
+mc_target = [jnp.asarray(_rng.integers(0, 5, N).astype(np.int32)) for _ in range(2)]
+
+
+DIFF_CASES = [
+    ("mse", tmr.MeanSquaredError, {}, tmfr.mean_squared_error, reg_preds, reg_target),
+    ("log_cosh", tmr.LogCoshError, {}, tmfr.log_cosh_error, reg_preds, reg_target),
+    ("cosine", tmr.CosineSimilarity, {}, tmfr.cosine_similarity, vec_preds, vec_target),
+    ("binary_hinge", tmc.BinaryHingeLoss, {}, tmf.binary_hinge_loss, bin_probs, bin_target),
+    ("psnr", tmi.PeakSignalNoiseRatio, {}, tmfi.peak_signal_noise_ratio, img_preds, img_target),
+    (
+        "ssim",
+        tmi.StructuralSimilarityIndexMeasure,
+        {},
+        tmfi.structural_similarity_index_measure,
+        img_preds,
+        img_target,
+    ),
+    ("snr", SignalNoiseRatio, {}, signal_noise_ratio, reg_preds, reg_target),
+]
+
+PRECISION_CASES = DIFF_CASES + [
+    ("multiclass_acc", tmc.MulticlassAccuracy, {"num_classes": 5}, tmf.multiclass_accuracy, mc_logits, mc_target),
+    ("binary_auroc", tmc.BinaryAUROC, {"thresholds": 32}, tmf.binary_auroc, bin_probs, bin_target),
+]
+
+
+class TestDifferentiability(MetricTester):
+    @pytest.mark.parametrize(
+        ("name", "metric_class", "args", "fn", "preds", "target"),
+        DIFF_CASES,
+        ids=[c[0] for c in DIFF_CASES],
+    )
+    def test_grad_matches_central_difference(self, name, metric_class, args, fn, preds, target):
+        metric = metric_class(**args)
+        assert metric.is_differentiable, f"{name} should declare is_differentiable"
+        self.run_differentiability_test(
+            preds=preds, target=target, metric_module=metric, metric_functional=fn, metric_args=args
+        )
+
+
+class TestHalfPrecision(MetricTester):
+    @pytest.mark.parametrize(
+        ("name", "metric_class", "args", "fn", "preds", "target"),
+        PRECISION_CASES,
+        ids=[c[0] for c in PRECISION_CASES],
+    )
+    def test_bf16_close_to_fp32(self, name, metric_class, args, fn, preds, target):
+        self.run_precision_test(
+            preds=preds, target=target, metric_module=metric_class, metric_functional=fn, metric_args=args
+        )
