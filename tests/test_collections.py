@@ -204,3 +204,49 @@ def test_functional_bridge_jit():
     ref_out = ref.compute()
     for k in ref_out:
         np.testing.assert_allclose(np.asarray(out[k]), np.asarray(ref_out[k]), atol=1e-6)
+
+
+def test_compute_groups_randomized_sweep():
+    """Random collections over a metric pool: grouped compute must equal each
+    metric computed standalone on the same stream (stresses the lazy
+    leader-state propagation across arbitrary group shapes)."""
+    from tpumetrics.classification import MulticlassAUROC, MulticlassSpecificity
+
+    C = 4
+    pool = {
+        "acc_micro": lambda: MulticlassAccuracy(num_classes=C, average="micro", validate_args=False),
+        "acc_macro": lambda: MulticlassAccuracy(num_classes=C, average="macro", validate_args=False),
+        "f1": lambda: MulticlassF1Score(num_classes=C, average="macro", validate_args=False),
+        "prec": lambda: MulticlassPrecision(num_classes=C, average="macro", validate_args=False),
+        "rec": lambda: MulticlassRecall(num_classes=C, average="macro", validate_args=False),
+        "spec": lambda: MulticlassSpecificity(num_classes=C, average="macro", validate_args=False),
+        "auroc": lambda: MulticlassAUROC(num_classes=C, thresholds=16, validate_args=False),
+        "confmat": lambda: MulticlassConfusionMatrix(num_classes=C, validate_args=False),
+    }
+    rng = np.random.default_rng(5)
+    for trial in range(8):
+        names = list(rng.choice(sorted(pool), size=rng.integers(3, 7), replace=False))
+        col = MetricCollection({n: pool[n]() for n in names})
+        solo = {n: pool[n]() for n in names}
+        for _ in range(3):
+            logits = jnp.asarray(rng.standard_normal((32, C)).astype(np.float32))
+            labels = jnp.asarray(rng.integers(0, C, 32))
+            col.update(logits, labels)
+            for m in solo.values():
+                m.update(logits, labels)
+        got = col.compute()
+        stat_family = {"acc_micro", "acc_macro", "f1", "prec", "rec", "spec"} & set(names)
+        if len(stat_family) >= 2:
+            # stat-score metrics share identical states and MUST merge
+            groups = [set(g) for g in col.compute_groups.values()]
+            assert any(stat_family <= g for g in groups), (
+                f"stat-score family {stat_family} not merged: {col.compute_groups}"
+            )
+        for n in names:
+            expected = solo[n].compute()
+            np.testing.assert_allclose(
+                np.asarray(got[n], dtype=np.float64),
+                np.asarray(expected, dtype=np.float64),
+                atol=1e-6,
+                err_msg=f"trial {trial}, metric {n}, groups {col.compute_groups}",
+            )
