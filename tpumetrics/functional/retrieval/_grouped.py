@@ -221,9 +221,11 @@ def grouped_ndcg(sq_by_pred: SortedQueries, sq_by_target: SortedQueries, top_k: 
             (sq_by_pred.idx[1:] == sq_by_pred.idx[:-1]) & (sq_by_pred.preds[1:] == sq_by_pred.preds[:-1]),
         ]
     )
-    tie_id = jnp.cumsum(~same_as_prev) - 1
-    tie_count = jax.ops.segment_sum(jnp.ones((n,), jnp.float32), tie_id, num_segments=n)
-    tie_t_sum = jax.ops.segment_sum(sq_by_pred.target, tie_id, num_segments=n)
+    tie_id = jnp.cumsum(~same_as_prev) - 1  # cumsum of bools -> nondecreasing
+    tie_count = jax.ops.segment_sum(
+        jnp.ones((n,), jnp.float32), tie_id, num_segments=n, indices_are_sorted=True
+    )
+    tie_t_sum = jax.ops.segment_sum(sq_by_pred.target, tie_id, num_segments=n, indices_are_sorted=True)
     avg_t = (tie_t_sum / jnp.maximum(tie_count, 1.0))[tie_id]
     dcg = _segment_sum(avg_t * discount, sq_by_pred)
 
