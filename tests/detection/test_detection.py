@@ -408,3 +408,107 @@ def test_map_box_format_xywh_matches_xyxy():
     r1, r2 = m1.compute(), m2.compute()
     for k in ("map", "map_50", "map_75", "mar_100"):
         assert np.isclose(float(r1[k]), float(r2[k]), atol=1e-7), k
+
+
+# --------------------------------------------------------------- segm mAP
+
+
+def _box_masks(boxes: np.ndarray, h: int = 64, w: int = 64) -> np.ndarray:
+    """Rasterize xyxy boxes into (N, h, w) boolean masks."""
+    n = boxes.shape[0]
+    out = np.zeros((n, h, w), dtype=bool)
+    ys, xs = np.arange(h)[:, None], np.arange(w)[None, :]
+    for i, (x1, y1, x2, y2) in enumerate(boxes):
+        out[i] = (ys >= y1) & (ys < y2) & (xs >= x1) & (xs < x2)
+    return out
+
+
+def _inside_boxes(n: int, extent: float = 64.0) -> np.ndarray:
+    """Non-degenerate xyxy boxes fully inside an extent x extent canvas."""
+    xy = _rng.random((n, 2)) * (extent - 12)
+    wh = _rng.random((n, 2)) * 10 + 2
+    return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+
+def test_segm_map_perfect_predictions():
+    boxes = _inside_boxes(5)
+    labels = _rng.integers(0, 2, 5)
+    masks = _box_masks(boxes)
+    preds = [dict(masks=jnp.asarray(masks), scores=jnp.asarray(np.linspace(0.9, 0.5, 5), dtype=jnp.float32),
+                  labels=jnp.asarray(labels))]
+    target = [dict(masks=jnp.asarray(masks), labels=jnp.asarray(labels))]
+    m = MeanAveragePrecision(iou_type="segm")
+    m.update(preds, target)
+    result = m.compute()
+    assert np.isclose(float(result["map"]), 1.0, atol=1e-5)
+    assert np.isclose(float(result["mar_100"]), 1.0, atol=1e-5)
+
+
+def test_segm_map_mask_not_box_geometry():
+    """Masks with equal bounding boxes but disjoint pixels must NOT match."""
+    a = np.zeros((1, 32, 32), dtype=bool)
+    b = np.zeros((1, 32, 32), dtype=bool)
+    # checkerboard complement: same bbox, zero mask overlap
+    a[0, 4:28, 4:28] = (np.add.outer(np.arange(24), np.arange(24)) % 2) == 0
+    b[0, 4:28, 4:28] = (np.add.outer(np.arange(24), np.arange(24)) % 2) == 1
+    preds = [dict(masks=jnp.asarray(a), scores=jnp.asarray([0.9], dtype=jnp.float32),
+                  labels=jnp.asarray([0]))]
+    target = [dict(masks=jnp.asarray(b), labels=jnp.asarray([0]))]
+    m = MeanAveragePrecision(iou_type="segm")
+    m.update(preds, target)
+    assert float(m.compute()["map"]) == 0.0
+
+
+def test_segm_map_iscrowd_ignored():
+    """Crowd semantics carry over to mask IoU (detection-area union)."""
+    gt_masks = _box_masks(np.asarray([[4.0, 4, 20, 20], [30.0, 30, 60, 60]], np.float32))
+    preds = [dict(masks=jnp.asarray(gt_masks), scores=jnp.asarray([0.9, 0.8], dtype=jnp.float32),
+                  labels=jnp.asarray([0, 0]))]
+    target = [dict(masks=jnp.asarray(gt_masks), labels=jnp.asarray([0, 0]), iscrowd=jnp.asarray([0, 1]))]
+    m = MeanAveragePrecision(iou_type="segm")
+    m.update(preds, target)
+    assert np.isclose(float(m.compute()["map"]), 1.0, atol=1e-5)
+
+
+def test_segm_map_ddp_merge_preserves_images():
+    """RLE run states merge across replicas with per-image boundaries intact."""
+    from tpumetrics.parallel.merge import merge_metric_states
+
+    all_preds, all_targets = [], []
+    for _ in range(4):
+        boxes = _inside_boxes(3)
+        jitter = np.clip(boxes + _rng.normal(0, 3, boxes.shape), 0, 64)
+        labels = _rng.integers(0, 2, 3)
+        all_preds.append(dict(masks=jnp.asarray(_box_masks(boxes)),
+                              scores=jnp.asarray(_rng.random(3), dtype=jnp.float32),
+                              labels=jnp.asarray(labels)))
+        all_targets.append(dict(masks=jnp.asarray(_box_masks(jitter)), labels=jnp.asarray(labels)))
+
+    replicas = [MeanAveragePrecision(iou_type="segm") for _ in range(2)]
+    for rank in range(2):
+        for i in range(rank, 4, 2):
+            replicas[rank].update([all_preds[i]], [all_targets[i]])
+    merged = merge_metric_states([m.metric_state() for m in replicas], replicas[0]._reductions)
+    got = replicas[0].functional_compute(merged)
+
+    single = MeanAveragePrecision(iou_type="segm")
+    for i in [0, 2, 1, 3]:
+        single.update([all_preds[i]], [all_targets[i]])
+    ref = single.compute()
+    assert np.isclose(float(got["map"]), float(ref["map"]), atol=1e-6)
+    assert np.isclose(float(got["mar_100"]), float(ref["mar_100"]), atol=1e-6)
+
+
+def test_segm_map_empty_and_validation():
+    m = MeanAveragePrecision(iou_type="segm")
+    # empty-mask image on both sides contributes nothing
+    m.update(
+        [dict(masks=jnp.zeros((0, 16, 16), dtype=bool), scores=jnp.zeros((0,)), labels=jnp.zeros((0,), jnp.int32))],
+        [dict(masks=jnp.zeros((0, 16, 16), dtype=bool), labels=jnp.zeros((0,), jnp.int32))],
+    )
+    assert float(m.compute()["map"]) == -1.0
+    with pytest.raises(ValueError, match="masks"):
+        m.update([dict(scores=jnp.asarray([0.5]), labels=jnp.asarray([0]))],
+                 [dict(masks=jnp.zeros((1, 16, 16), dtype=bool), labels=jnp.asarray([0]))])
+    with pytest.raises(ValueError):
+        MeanAveragePrecision(iou_type="nope")

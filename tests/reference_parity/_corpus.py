@@ -1,0 +1,84 @@
+"""Random detection corpora shared by the mAP parity tests.
+
+Generates multi-image, multi-class corpora with empty-prediction and
+empty-ground-truth images and a spread of box areas covering the COCO
+small/medium/large ranges.  No ``iscrowd``/``area`` keys are emitted: the
+reference's pure-torch oracle (`/root/reference/src/torchmetrics/detection/
+_mean_ap.py`) has no crowd handling, so crowd semantics are covered by the
+repo's own pycocotools-consistency tests instead (tests/detection/).
+"""
+
+from typing import List, Tuple
+
+import numpy as np
+
+
+def random_boxes(rng: np.ndarray, n: int, extent: float = 200.0) -> np.ndarray:
+    """(n, 4) xyxy boxes with areas spanning the small/medium/large ranges."""
+    xy = rng.uniform(0.0, extent * 0.7, size=(n, 2))
+    # mix tiny (<32^2), medium and large (>96^2) boxes
+    scale = rng.choice([8.0, 40.0, 120.0], size=(n, 1), p=[0.3, 0.4, 0.3])
+    wh = rng.uniform(0.4, 1.0, size=(n, 2)) * scale
+    return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+
+def make_detection_corpus(
+    seed: int,
+    num_images: int = 8,
+    num_classes: int = 3,
+    max_det: int = 8,
+    max_gt: int = 6,
+) -> Tuple[List[dict], List[dict]]:
+    """Return (preds, target) as lists of numpy dicts, one per image."""
+    rng = np.random.default_rng(seed)
+    preds, target = [], []
+    for img in range(num_images):
+        # force one empty-pred and one empty-gt image into every corpus
+        n_det = 0 if img == 1 else int(rng.integers(1, max_det + 1))
+        n_gt = 0 if img == 2 else int(rng.integers(1, max_gt + 1))
+        gt_boxes = random_boxes(rng, n_gt)
+        # half the detections perturb a gt box (realistic near-matches),
+        # the rest are unrelated
+        det_boxes = random_boxes(rng, n_det)
+        for d in range(n_det):
+            if n_gt and rng.uniform() < 0.5:
+                g = int(rng.integers(n_gt))
+                jitter = rng.normal(0.0, 4.0, size=4).astype(np.float32)
+                det_boxes[d] = gt_boxes[g] + jitter
+                det_boxes[d, 2:] = np.maximum(det_boxes[d, 2:], det_boxes[d, :2] + 1.0)
+        preds.append(
+            {
+                "boxes": det_boxes,
+                "scores": rng.uniform(0.05, 1.0, size=n_det).astype(np.float32),
+                "labels": rng.integers(0, num_classes, size=n_det).astype(np.int64),
+            }
+        )
+        target.append(
+            {
+                "boxes": gt_boxes,
+                "labels": rng.integers(0, num_classes, size=n_gt).astype(np.int64),
+            }
+        )
+    return preds, target
+
+
+def boxes_to_masks(boxes: np.ndarray, height: int, width: int, rng=None) -> np.ndarray:
+    """(N, H, W) boolean masks rasterized from xyxy boxes, optionally with
+    random interior holes so masks are not exactly their bounding boxes."""
+    n = boxes.shape[0]
+    out = np.zeros((n, height, width), dtype=bool)
+    ys = np.arange(height)[:, None]
+    xs = np.arange(width)[None, :]
+    for i in range(n):
+        x1, y1, x2, y2 = boxes[i]
+        m = (ys >= y1) & (ys < y2) & (xs >= x1) & (xs < x2)
+        if rng is not None and m.any() and rng.uniform() < 0.5:
+            hx1, hy1 = rng.uniform([x1, y1], [(x1 + x2) / 2, (y1 + y2) / 2])
+            hx2 = rng.uniform(hx1, x2)
+            hy2 = rng.uniform(hy1, y2)
+            hole = (ys >= hy1) & (ys < hy2) & (xs >= hx1) & (xs < hx2)
+            keep = m & ~hole
+            if keep.any():
+                m = keep
+        out[i] = m
+    return out

@@ -1,0 +1,18 @@
+"""Minimal stand-in for the ``lightning_utilities`` package.
+
+The mounted reference implementation (/root/reference/src) imports a handful of
+helpers from ``lightning_utilities``; the real package is not installed in this
+environment.  This shim re-implements just the surface the reference touches
+(see ``grep -r "from lightning_utilities" /root/reference/src``):
+
+- ``apply_to_collection``
+- ``core.enums.StrEnum``
+- ``core.imports.package_available`` / ``compare_version``
+
+It exists only so the differential-parity test suite can import the reference
+as an oracle; nothing in ``tpumetrics`` itself depends on it.
+"""
+
+from lightning_utilities.core.apply_func import apply_to_collection
+
+__all__ = ["apply_to_collection"]

@@ -1,0 +1,48 @@
+"""Case-insensitive string enum (shim for lightning_utilities.core.enums.StrEnum)."""
+
+from enum import Enum
+from typing import Optional
+
+
+class StrEnum(str, Enum):
+    """String enum with case-insensitive lookup and comparison."""
+
+    @classmethod
+    def from_str(cls, value: str, source: str = "key") -> "StrEnum":
+        matched = cls.try_from_str(value, source=source)
+        if matched is None:
+            raise ValueError(f"Invalid match: expected one of {cls._allowed_matches(source)}, but got {value}.")
+        return matched
+
+    @classmethod
+    def try_from_str(cls, value: str, source: str = "key") -> Optional["StrEnum"]:
+        try:
+            if source in ("key", "any"):
+                for st in cls:
+                    if st.name.lower() == value.lower():
+                        return st
+            if source in ("value", "any"):
+                for st in cls:
+                    if st.value.lower() == value.lower():
+                        return st
+        except AttributeError:
+            pass
+        return None
+
+    @classmethod
+    def _allowed_matches(cls, source: str) -> list:
+        out = []
+        for st in cls:
+            if source in ("key", "any"):
+                out.append(st.name)
+            if source in ("value", "any"):
+                out.append(st.value)
+        return out
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, Enum):
+            other = other.value
+        return self.value.lower() == str(other).lower()
+
+    def __hash__(self) -> int:
+        return hash(self.value.lower())

@@ -1,0 +1,44 @@
+"""Package-availability helpers (shim for lightning_utilities.core.imports)."""
+
+import importlib
+import importlib.util
+from functools import lru_cache
+
+from packaging.version import Version
+
+
+@lru_cache()
+def package_available(package_name: str) -> bool:
+    """Return whether ``package_name`` can be found by the import machinery."""
+    try:
+        return importlib.util.find_spec(package_name) is not None
+    except ModuleNotFoundError:
+        return False
+
+
+@lru_cache()
+def module_available(module_path: str) -> bool:
+    """Return whether a dotted module path is importable."""
+    if not package_available(module_path.split(".")[0]):
+        return False
+    try:
+        importlib.import_module(module_path)
+    except ImportError:
+        return False
+    return True
+
+
+def compare_version(package: str, op, version: str, use_base_version: bool = False) -> bool:
+    """Compare an installed package's ``__version__`` against ``version`` with ``op``."""
+    try:
+        pkg = importlib.import_module(package)
+    except (ImportError, AttributeError):
+        return False
+    try:
+        pkg_version = Version(pkg.__version__)
+    except (TypeError, AttributeError):
+        return False
+    if use_base_version:
+        pkg_version = Version(pkg_version.base_version)
+        version = Version(version).base_version
+    return op(pkg_version, Version(version))

@@ -1,0 +1,15 @@
+"""Minimal stand-in for ``pycocotools`` (mask RLE ops only).
+
+Provides just the ``pycocotools.mask`` surface the reference's pure-torch mAP
+(`/root/reference/src/torchmetrics/detection/_mean_ap.py:43-145,396-408`) uses:
+``encode`` / ``decode`` / ``area`` / ``iou``.  The RLE representation here is
+COCO's column-major run-length format (runs alternate 0s/1s starting with 0s),
+with ``counts`` kept as an uncompressed uint32 array — the reference treats
+``counts`` opaquely, so only self-consistency within this shim matters.
+``iou`` implements the documented crowd semantics (union = detection area for
+crowd ground truths).
+"""
+
+from . import mask  # noqa: F401
+
+__version__ = "2.0.8"

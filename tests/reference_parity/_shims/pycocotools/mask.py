@@ -1,0 +1,98 @@
+"""COCO mask RLE operations in pure numpy (see package docstring)."""
+
+from typing import List, Union
+
+import numpy as np
+
+
+def _encode_one(bitmap: np.ndarray) -> dict:
+    """RLE-encode one (H, W) binary mask in column-major order."""
+    h, w = bitmap.shape
+    flat = bitmap.reshape(-1, order="F").astype(np.uint8)
+    # run boundaries; runs alternate starting with a (possibly empty) run of 0s
+    if flat.size == 0:
+        counts = np.zeros((0,), dtype=np.uint32)
+    else:
+        change = np.flatnonzero(flat[1:] != flat[:-1]) + 1
+        starts = np.concatenate([[0], change, [flat.size]])
+        runs = np.diff(starts).astype(np.uint32)
+        if flat[0] == 1:  # format requires an initial 0-run
+            runs = np.concatenate([[np.uint32(0)], runs])
+        counts = runs
+    return {"size": [int(h), int(w)], "counts": counts}
+
+
+def encode(bitmap: np.ndarray) -> Union[dict, List[dict]]:
+    """Encode an (H, W) mask -> RLE dict, or (H, W, N) masks -> list of RLE dicts."""
+    if bitmap.ndim == 2:
+        return _encode_one(bitmap)
+    return [_encode_one(bitmap[:, :, i]) for i in range(bitmap.shape[2])]
+
+
+def decode(rles: Union[dict, List[dict]]) -> np.ndarray:
+    """Decode RLE dict(s) back to (H, W) or (H, W, N) uint8 masks."""
+    single = isinstance(rles, dict)
+    if single:
+        rles = [rles]
+    outs = []
+    for rle in rles:
+        h, w = rle["size"]
+        counts = np.asarray(rle["counts"], dtype=np.int64)
+        vals = np.zeros(counts.shape[0], dtype=np.uint8)
+        vals[1::2] = 1
+        flat = np.repeat(vals, counts)
+        outs.append(flat.reshape((h, w), order="F"))
+    out = np.stack(outs, axis=2) if outs else np.zeros((0, 0, 0), dtype=np.uint8)
+    return out[:, :, 0] if single else out
+
+
+def area(rles: Union[dict, List[dict]]) -> np.ndarray:
+    """Foreground pixel count per RLE (sum of the odd-indexed runs)."""
+    single = isinstance(rles, dict)
+    if single:
+        rles = [rles]
+    out = np.array([int(np.asarray(r["counts"], dtype=np.int64)[1::2].sum()) for r in rles], dtype=np.uint32)
+    return out[0] if single else out
+
+
+def iou(dt: List[dict], gt: List[dict], iscrowd: List[int]) -> np.ndarray:
+    """(D, G) mask IoU; for crowd gt the union is the detection area."""
+    if len(dt) == 0 or len(gt) == 0:
+        return np.zeros((len(dt), len(gt)))
+    dmasks = np.stack([decode(d).astype(np.int64) for d in dt])  # (D, H, W)
+    gmasks = np.stack([decode(g).astype(np.int64) for g in gt])  # (G, H, W)
+    d_area = dmasks.sum(axis=(1, 2))  # (D,)
+    g_area = gmasks.sum(axis=(1, 2))  # (G,)
+    inter = np.einsum("dhw,ghw->dg", dmasks, gmasks)
+    union = d_area[:, None] + g_area[None, :] - inter
+    crowd = np.asarray(iscrowd, dtype=bool)
+    union = np.where(crowd[None, :], d_area[:, None], union)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        out = np.where(union > 0, inter / union, 0.0)
+    return out
+
+
+def merge(rles: List[dict], intersect: bool = False) -> dict:
+    """Merge masks by union (or intersection)."""
+    ms = decode(rles)
+    agg = ms.all(axis=2) if intersect else ms.any(axis=2)
+    return _encode_one(agg.astype(np.uint8))
+
+
+def toBbox(rles: Union[dict, List[dict]]) -> np.ndarray:
+    """Tight xywh bounding box per mask (zeros for empty masks)."""
+    single = isinstance(rles, dict)
+    if single:
+        rles = [rles]
+    out = []
+    for r in rles:
+        m = decode(r)
+        ys, xs = np.nonzero(m)
+        if ys.size == 0:
+            out.append([0.0, 0.0, 0.0, 0.0])
+        else:
+            x0, x1 = xs.min(), xs.max()
+            y0, y1 = ys.min(), ys.max()
+            out.append([float(x0), float(y0), float(x1 - x0 + 1), float(y1 - y0 + 1)])
+    arr = np.asarray(out)
+    return arr[0] if single else arr

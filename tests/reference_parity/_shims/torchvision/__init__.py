@@ -1,0 +1,13 @@
+"""Minimal stand-in for ``torchvision`` (box ops only).
+
+The reference's pure-torch mAP (`/root/reference/src/torchmetrics/detection/_mean_ap.py`)
+and IoU metrics import ``box_area`` / ``box_iou`` / ``box_convert`` /
+``generalized_box_iou`` / ``distance_box_iou`` / ``complete_box_iou`` from
+``torchvision.ops``.  These are small, publicly-specified formulas implemented
+here from their definitions so the reference can run as a test oracle.  The
+version string satisfies the reference's ``>= 0.8`` / ``>= 0.13`` gates.
+"""
+
+from . import ops  # noqa: F401
+
+__version__ = "0.20.0"

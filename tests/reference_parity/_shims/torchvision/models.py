@@ -1,0 +1,12 @@
+"""Stub: pretrained model zoo is not available offline.
+
+The reference's ``lpips.py`` does ``from torchvision import models as tv`` at
+module scope; any actual model constructor lookup raises here.
+"""
+
+
+def __getattr__(name):  # noqa: D105
+    raise RuntimeError(
+        f"torchvision.models.{name} is unavailable: this is the offline test shim "
+        "(pretrained backbones cannot be downloaded in this environment)"
+    )

@@ -1,0 +1,102 @@
+"""Box operations implemented from their published definitions (torch-only)."""
+
+import torch
+from torch import Tensor
+
+
+def box_area(boxes: Tensor) -> Tensor:
+    """Area of xyxy boxes, shape (N,) from (N, 4)."""
+    return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+
+def _upcast(t: Tensor) -> Tensor:
+    if t.is_floating_point():
+        return t if t.dtype in (torch.float32, torch.float64) else t.float()
+    return t if t.dtype in (torch.int32, torch.int64) else t.int()
+
+
+def _inter_union(boxes1: Tensor, boxes2: Tensor):
+    area1 = box_area(boxes1)
+    area2 = box_area(boxes2)
+    lt = torch.max(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = torch.min(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = _upcast(rb - lt).clamp(min=0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return inter, union
+
+
+def box_iou(boxes1: Tensor, boxes2: Tensor) -> Tensor:
+    """(N, M) pairwise IoU of xyxy boxes."""
+    inter, union = _inter_union(boxes1, boxes2)
+    return inter / union
+
+
+def generalized_box_iou(boxes1: Tensor, boxes2: Tensor) -> Tensor:
+    """(N, M) pairwise GIoU: IoU - (hull - union) / hull."""
+    inter, union = _inter_union(boxes1, boxes2)
+    iou = inter / union
+    lt = torch.min(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = torch.max(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = _upcast(rb - lt).clamp(min=0)
+    hull = wh[..., 0] * wh[..., 1]
+    return iou - (hull - union) / hull
+
+
+def _box_centers(boxes: Tensor):
+    cx = (boxes[:, 0] + boxes[:, 2]) / 2
+    cy = (boxes[:, 1] + boxes[:, 3]) / 2
+    return cx, cy
+
+
+def distance_box_iou(boxes1: Tensor, boxes2: Tensor, eps: float = 1e-7) -> Tensor:
+    """(N, M) pairwise DIoU: IoU - center_dist^2 / diag^2."""
+    iou = box_iou(boxes1, boxes2)
+    lt = torch.min(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = torch.max(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = _upcast(rb - lt).clamp(min=0)
+    diag = wh[..., 0] ** 2 + wh[..., 1] ** 2 + eps
+    cx1, cy1 = _box_centers(_upcast(boxes1))
+    cx2, cy2 = _box_centers(_upcast(boxes2))
+    dist = (cx1[:, None] - cx2[None, :]) ** 2 + (cy1[:, None] - cy2[None, :]) ** 2
+    return iou - dist / diag
+
+
+def complete_box_iou(boxes1: Tensor, boxes2: Tensor, eps: float = 1e-7) -> Tensor:
+    """(N, M) pairwise CIoU: DIoU - alpha * v (aspect-ratio consistency term)."""
+    boxes1 = _upcast(boxes1)
+    boxes2 = _upcast(boxes2)
+    diou = distance_box_iou(boxes1, boxes2, eps=eps)
+    iou = box_iou(boxes1, boxes2)
+    w1 = boxes1[:, 2] - boxes1[:, 0]
+    h1 = boxes1[:, 3] - boxes1[:, 1]
+    w2 = boxes2[:, 2] - boxes2[:, 0]
+    h2 = boxes2[:, 3] - boxes2[:, 1]
+    v = (4 / (torch.pi**2)) * (
+        torch.atan(w1[:, None] / h1[:, None]) - torch.atan(w2[None, :] / h2[None, :])
+    ) ** 2
+    with torch.no_grad():
+        alpha = v / (1 - iou + v + eps)
+    return diou - alpha * v
+
+
+def box_convert(boxes: Tensor, in_fmt: str, out_fmt: str) -> Tensor:
+    """Convert between xyxy / xywh / cxcywh box formats."""
+    allowed = ("xyxy", "xywh", "cxcywh")
+    if in_fmt not in allowed or out_fmt not in allowed:
+        raise ValueError(f"Unsupported box format: {in_fmt} -> {out_fmt}")
+    if in_fmt == out_fmt:
+        return boxes.clone()
+    if in_fmt == "xywh":
+        x, y, w, h = boxes.unbind(-1)
+        boxes = torch.stack([x, y, x + w, y + h], dim=-1)
+    elif in_fmt == "cxcywh":
+        cx, cy, w, h = boxes.unbind(-1)
+        boxes = torch.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], dim=-1)
+    if out_fmt == "xywh":
+        x1, y1, x2, y2 = boxes.unbind(-1)
+        boxes = torch.stack([x1, y1, x2 - x1, y2 - y1], dim=-1)
+    elif out_fmt == "cxcywh":
+        x1, y1, x2, y2 = boxes.unbind(-1)
+        boxes = torch.stack([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], dim=-1)
+    return boxes

@@ -1,0 +1,62 @@
+"""Differential-parity suite setup: import the mounted reference as an oracle.
+
+The reference implementation (torch CPU) is mounted read-only at
+``/root/reference/src``.  It needs ``lightning_utilities`` plus — for the
+detection oracle — ``torchvision`` box ops and ``pycocotools`` mask ops; none
+are installed, so minimal shims live in ``_shims/`` (see their docstrings).
+
+Path handling: the shim + reference dirs are inserted into ``sys.path``
+LAZILY, inside the session-scoped ``ref`` fixture, so the stub packages never
+shadow availability gates evaluated at collection time (e.g.
+``tpumetrics/utils/imports.py`` probes ``torchvision``/``pycocotools``; with
+an eager insert those gates would flip to the stubs for the whole session).
+Once a parity test has run, the paths stay installed — the reference does
+lazy in-function imports of the shimmed packages — so main-suite tests that
+probe those package names should run before this directory (pytest's
+alphabetical order already does that for the existing suite).
+
+When the reference tree or torch is unavailable every test here SKIPS with a
+visible reason (never silently deselected), so a green run can't be confused
+with a verified parity run.
+"""
+
+import os
+import sys
+
+import pytest
+
+_REFERENCE_SRC = os.environ.get("TPUMETRICS_REFERENCE_SRC", "/root/reference/src")
+_SHIMS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_shims")
+
+collect_ignore_glob = ["_shims/*"]
+
+
+def _missing_prerequisite() -> str:
+    if not os.path.isdir(_REFERENCE_SRC):
+        return f"reference tree not mounted at {_REFERENCE_SRC}"
+    try:
+        import torch  # noqa: F401
+    except ImportError:
+        return "torch (CPU) is not installed"
+    return ""
+
+
+def _install_oracle_paths() -> None:
+    for p in (_SHIMS, _REFERENCE_SRC):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+
+
+@pytest.fixture(scope="session")
+def ref():
+    """The reference ``torchmetrics`` package, imported from the mounted tree."""
+    missing = _missing_prerequisite()
+    if missing:
+        pytest.skip(f"reference parity oracle unavailable: {missing}")
+    _install_oracle_paths()
+    import torchmetrics
+
+    assert os.path.realpath(torchmetrics.__file__).startswith(os.path.realpath(_REFERENCE_SRC)), (
+        f"oracle import resolved outside the reference tree: {torchmetrics.__file__}"
+    )
+    return torchmetrics

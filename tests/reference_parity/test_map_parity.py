@@ -1,0 +1,165 @@
+"""Randomized differential parity: our mAP vs the reference's pure-torch mAP.
+
+Oracle: `/root/reference/src/torchmetrics/detection/_mean_ap.py` (the
+reference's own pure-torch COCO implementation, run on CPU with the box-op /
+mask-op shims in ``_shims/``).  Corpora are multi-image, multi-class, with
+empty-pred and empty-gt images and areas spanning the COCO small/medium/large
+ranges (see ``_corpus.py``).
+
+Tolerance: both sides implement the same greedy protocol; differences are
+float32-vs-float64 accumulation order only, so agreement is expected to 1e-5.
+Crowd (`iscrowd`) semantics are NOT covered here — the pure-torch oracle has
+none — they are pinned by ``tests/detection/test_detection.py``.
+"""
+
+import numpy as np
+import pytest
+
+SCALAR_KEYS = [
+    "map",
+    "map_50",
+    "map_75",
+    "map_small",
+    "map_medium",
+    "map_large",
+    "mar_1",
+    "mar_10",
+    "mar_100",
+    "mar_small",
+    "mar_medium",
+    "mar_large",
+]
+
+
+def _run_ours(preds_np, target_np, iou_type="bbox", masks=None, gt_masks=None, **kwargs):
+    import jax.numpy as jnp
+
+    from tpumetrics.detection import MeanAveragePrecision
+
+    metric = MeanAveragePrecision(iou_type=iou_type, **kwargs)
+    # feed in two update calls to exercise state accumulation
+    half = len(preds_np) // 2
+    for sl in (slice(0, half), slice(half, None)):
+        preds = []
+        target = []
+        for i in range(*sl.indices(len(preds_np))):
+            p = {k: jnp.asarray(v) for k, v in preds_np[i].items()}
+            t = {k: jnp.asarray(v) for k, v in target_np[i].items()}
+            if iou_type == "segm":
+                p["masks"] = jnp.asarray(masks[i])
+                t["masks"] = jnp.asarray(gt_masks[i])
+            preds.append(p)
+            target.append(t)
+        metric.update(preds, target)
+    return {k: np.asarray(v) for k, v in metric.compute().items()}
+
+
+def _run_reference(preds_np, target_np, iou_type="bbox", masks=None, gt_masks=None, **kwargs):
+    import torch
+    from torchmetrics.detection._mean_ap import MeanAveragePrecision as RefMAP
+
+    metric = RefMAP(iou_type=iou_type, **kwargs)
+    half = len(preds_np) // 2
+    for sl in (slice(0, half), slice(half, None)):
+        preds = []
+        target = []
+        for i in range(*sl.indices(len(preds_np))):
+            p = {k: torch.from_numpy(np.asarray(v)) for k, v in preds_np[i].items()}
+            t = {k: torch.from_numpy(np.asarray(v)) for k, v in target_np[i].items()}
+            if iou_type == "segm":
+                p["masks"] = torch.from_numpy(masks[i])
+                t["masks"] = torch.from_numpy(gt_masks[i])
+            preds.append(p)
+            target.append(t)
+        metric.update(preds, target)
+    return {k: v.numpy() if hasattr(v, "numpy") else v for k, v in metric.compute().items()}
+
+
+def _assert_close(ours: dict, ref: dict, keys=SCALAR_KEYS, atol: float = 1e-5):
+    for key in keys:
+        assert key in ours, f"missing key {key}"
+        np.testing.assert_allclose(
+            np.asarray(ours[key], dtype=np.float64),
+            np.asarray(ref[key], dtype=np.float64),
+            atol=atol,
+            err_msg=f"mismatch on {key}",
+        )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_bbox_map_matches_reference(ref, seed):
+    from tests.reference_parity._corpus import make_detection_corpus
+
+    preds, target = make_detection_corpus(seed)
+    ours = _run_ours(preds, target)
+    oracle = _run_reference(preds, target)
+    _assert_close(ours, oracle)
+
+
+@pytest.mark.parametrize("seed", [10, 11])
+def test_bbox_map_class_metrics_matches_reference(ref, seed):
+    from tests.reference_parity._corpus import make_detection_corpus
+
+    preds, target = make_detection_corpus(seed, num_images=6, num_classes=4)
+    ours = _run_ours(preds, target, class_metrics=True)
+    oracle = _run_reference(preds, target, class_metrics=True)
+    _assert_close(ours, oracle)
+    np.testing.assert_allclose(
+        np.sort(np.asarray(ours["classes"]).ravel()),
+        np.sort(np.asarray(oracle["classes"]).ravel()),
+    )
+    _assert_close(ours, oracle, keys=["map_per_class", "mar_100_per_class"])
+
+
+@pytest.mark.parametrize("box_format", ["xywh", "cxcywh"])
+def test_bbox_map_box_formats_match_reference(ref, box_format):
+    import numpy as np
+
+    from tests.reference_parity._corpus import make_detection_corpus
+
+    preds, target = make_detection_corpus(7)
+
+    def to_fmt(boxes):
+        boxes = np.asarray(boxes)
+        if boxes.size == 0:
+            return boxes
+        x1, y1, x2, y2 = boxes.T
+        if box_format == "xywh":
+            return np.stack([x1, y1, x2 - x1, y2 - y1], axis=1)
+        return np.stack([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], axis=1)
+
+    preds_f = [dict(p, boxes=to_fmt(p["boxes"])) for p in preds]
+    target_f = [dict(t, boxes=to_fmt(t["boxes"])) for t in target]
+    ours = _run_ours(preds_f, target_f, box_format=box_format)
+    oracle = _run_reference(preds_f, target_f, box_format=box_format)
+    _assert_close(ours, oracle)
+
+
+@pytest.mark.parametrize("seed", [30, 31, 32])
+def test_segm_map_matches_reference(ref, seed):
+    from tests.reference_parity._corpus import boxes_to_masks, make_detection_corpus
+
+    rng = np.random.default_rng(1000 + seed)
+    preds, target = make_detection_corpus(seed, num_images=5, num_classes=2, max_det=5, max_gt=4)
+    height, width = 96, 80
+    masks, gt_masks = [], []
+    for p, t in zip(preds, target):
+        clipped_p = np.clip(p["boxes"], 0, [width, height, width, height])
+        clipped_t = np.clip(t["boxes"], 0, [width, height, width, height])
+        masks.append(boxes_to_masks(clipped_p, height, width, rng))
+        gt_masks.append(boxes_to_masks(clipped_t, height, width, rng))
+        del p["boxes"], t["boxes"]
+    ours = _run_ours(preds, target, iou_type="segm", masks=masks, gt_masks=gt_masks)
+    oracle = _run_reference(preds, target, iou_type="segm", masks=masks, gt_masks=gt_masks)
+    _assert_close(ours, oracle)
+
+
+def test_bbox_map_custom_thresholds_match_reference(ref):
+    from tests.reference_parity._corpus import make_detection_corpus
+
+    preds, target = make_detection_corpus(21, num_images=6)
+    kwargs = dict(iou_thresholds=[0.3, 0.55, 0.8], max_detection_thresholds=[2, 5, 50])
+    ours = _run_ours(preds, target, **kwargs)
+    oracle = _run_reference(preds, target, **kwargs)
+    keys = ["map", "map_small", "map_medium", "map_large", "mar_2", "mar_5", "mar_50"]
+    _assert_close(ours, oracle, keys=keys)

@@ -35,20 +35,61 @@ _AREA_RANGES = {
 }
 
 
-def _np_box_iou(det: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray) -> np.ndarray:
-    """(D, G) IoU with pycocotools crowd semantics: for a crowd gt the
-    denominator is the detection area alone."""
-    if det.size == 0 or gt.size == 0:
-        return np.zeros((det.shape[0], gt.shape[0]))
-    lt = np.maximum(det[:, None, :2], gt[None, :, :2])
-    rb = np.minimum(det[:, None, 2:], gt[None, :, 2:])
-    wh = np.clip(rb - lt, 0, None)
-    inter = wh[..., 0] * wh[..., 1]
-    det_area = (det[:, 2] - det[:, 0]) * (det[:, 3] - det[:, 1])
-    gt_area = (gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1])
-    union = det_area[:, None] + gt_area[None, :] - inter
-    union = np.where(iscrowd[None, :].astype(bool), det_area[:, None], union)
-    return inter / np.where(union > 0, union, 1.0)
+def rle_decode_flat(runs: np.ndarray, num_pixels: int) -> np.ndarray:
+    """Decode column-major RLE runs (alternating 0s/1s, leading 0-run) to a
+    flat (num_pixels,) uint8 vector."""
+    runs = np.asarray(runs, dtype=np.int64)
+    vals = np.zeros(runs.shape[0], dtype=np.uint8)
+    vals[1::2] = 1
+    flat = np.repeat(vals, runs)
+    if flat.shape[0] != num_pixels:
+        raise ValueError(f"RLE decodes to {flat.shape[0]} pixels, expected {num_pixels}")
+    return flat
+
+
+def _pairwise_geometry(
+    det_geom, gt_geom, iou_type: str
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Precompute class-independent pairwise pieces for one image: the
+    intersection matrix (D, G) and the per-item geometry areas.
+
+    For ``bbox`` the geometry is an xyxy (N, 4) array; for ``segm`` it is
+    ``((h, w), [runs, ...])`` — column-major RLE runs per mask.  Masks are
+    decoded once per image and intersected with ONE (D, HW) x (HW, G)
+    matmul, so the per-class loop below only slices — the pycocotools
+    equivalent recomputes ``maskUtils.iou`` per (image, category).
+    """
+    if iou_type == "bbox":
+        det, gt = det_geom, gt_geom
+        det_area = (det[:, 2] - det[:, 0]) * (det[:, 3] - det[:, 1]) if det.size else np.zeros(det.shape[0])
+        gt_area = (gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1]) if gt.size else np.zeros(gt.shape[0])
+        if det.shape[0] == 0 or gt.shape[0] == 0:
+            inter = np.zeros((det.shape[0], gt.shape[0]))
+        else:
+            lt = np.maximum(det[:, None, :2], gt[None, :, :2])
+            rb = np.minimum(det[:, None, 2:], gt[None, :, 2:])
+            wh = np.clip(rb - lt, 0, None)
+            inter = (wh[..., 0] * wh[..., 1]).astype(np.float64)
+        return inter, np.asarray(det_area, np.float64), np.asarray(gt_area, np.float64)
+
+    (h, w), det_runs = det_geom
+    _, gt_runs = gt_geom
+    num_px = h * w
+    det_area = np.array([float(np.asarray(r, np.int64)[1::2].sum()) for r in det_runs])
+    gt_area = np.array([float(np.asarray(r, np.int64)[1::2].sum()) for r in gt_runs])
+    if len(det_runs) == 0 or len(gt_runs) == 0:
+        return np.zeros((len(det_runs), len(gt_runs))), det_area, gt_area
+    # decode to uint8 and matmul in float32, chunked over detections: f32 is
+    # exact for pixel counts < 2^24 (any mask below 16.7 Mpx) at half the
+    # float64 footprint, and chunking bounds the peak to the gt matrix plus
+    # one chunk rather than the full (D, HW) dense float block
+    dmat = np.stack([rle_decode_flat(r, num_px) for r in det_runs])
+    gmat32 = np.stack([rle_decode_flat(r, num_px) for r in gt_runs]).astype(np.float32).T
+    inter = np.empty((dmat.shape[0], gmat32.shape[1]), dtype=np.float64)
+    chunk = max(1, min(dmat.shape[0], (1 << 25) // max(num_px, 1)))  # ~128 MB f32 per chunk
+    for i in range(0, dmat.shape[0], chunk):
+        inter[i : i + chunk] = dmat[i : i + chunk].astype(np.float32) @ gmat32
+    return inter, det_area, gt_area
 
 
 def _match_image(
@@ -178,13 +219,16 @@ def coco_evaluate(
     max_detection_thresholds: Sequence[int],
     class_ids: Sequence[int],
     average: str = "macro",
+    iou_type: str = "bbox",
 ) -> Dict[str, np.ndarray]:
     """Full COCO evaluation over per-image detections/groundtruths.
 
     Args:
-        detections: per image (boxes xyxy (D,4), scores (D,), labels (D,)).
-        groundtruths: per image (boxes xyxy (G,4), labels (G,), iscrowd (G,),
-            area (G,) — zero entries fall back to the box area).
+        detections: per image (geometry, scores (D,), labels (D,)).
+        groundtruths: per image (geometry, labels (G,), iscrowd (G,),
+            area (G,) — zero entries fall back to the geometry area).
+        iou_type: geometry kind — ``bbox`` (geometry = xyxy (N, 4) array) or
+            ``segm`` (geometry = ``((h, w), [RLE runs per mask])``).
         class_ids: the class label space to evaluate.
         average: ``macro`` (per-class then averaged, COCO standard) or
             ``micro`` (all classes pooled into one).
@@ -203,14 +247,25 @@ def coco_evaluate(
     precision = -np.ones((len(iou_thrs), len(rec_thrs), len(eval_class_ids), len(area_names), len(max_dets)))
     recall = -np.ones((len(iou_thrs), len(eval_class_ids), len(area_names), len(max_dets)))
 
+    # class-independent pairwise geometry, ONCE per image (intersections +
+    # areas); the per-class loop only slices these.  pycocotools recomputes
+    # IoU per (image, category) — for masks that means re-decoding RLEs K
+    # times; here each mask is decoded once and intersected by one matmul.
+    per_image_geom = []
+    for img in range(num_imgs):
+        det_geom = detections[img][0]
+        gt_geom = groundtruths[img][0]
+        per_image_geom.append(_pairwise_geometry(det_geom, gt_geom, iou_type))
+
     for k_idx, class_id in enumerate(eval_class_ids):
         # per (image, class): sort detections by score and compute IoUs ONCE,
         # shared across all four area ranges (pycocotools computes computeIoU
         # once per (img, cat) the same way)
         per_image_cls = []
         for img in range(num_imgs):
-            det_boxes, det_scores, det_labels = detections[img]
-            gt_boxes, gt_labels, gt_crowd, gt_area = groundtruths[img]
+            _, det_scores, det_labels = detections[img]
+            _, gt_labels, gt_crowd, gt_area = groundtruths[img]
+            inter_full, det_area_full, gt_area_geom_full = per_image_geom[img]
             if average == "micro":
                 det_sel = np.ones(det_labels.shape[0], dtype=bool)
                 gt_sel = np.ones(gt_labels.shape[0], dtype=bool)
@@ -218,14 +273,16 @@ def coco_evaluate(
                 det_sel = det_labels == class_id
                 gt_sel = gt_labels == class_id
             area = gt_area[gt_sel]
-            boxes = gt_boxes[gt_sel]
-            box_area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1]) if boxes.size else area
-            area = np.where(area > 0, area, box_area)
-            db, ds, gc = det_boxes[det_sel], det_scores[det_sel], gt_crowd[gt_sel]
+            geom_area = gt_area_geom_full[gt_sel]
+            area = np.where(area > 0, area, geom_area)
+            ds, gc = det_scores[det_sel], gt_crowd[gt_sel]
             det_order = np.argsort(-ds, kind="stable")[: max_dets[-1]]
-            db, ds = db[det_order], ds[det_order]
-            ious = _np_box_iou(db, boxes, gc)
-            da = (db[:, 2] - db[:, 0]) * (db[:, 3] - db[:, 1])
+            ds = ds[det_order]
+            da = det_area_full[det_sel][det_order]
+            inter = inter_full[det_sel][:, gt_sel][det_order]
+            union = da[:, None] + geom_area[None, :] - inter
+            union = np.where(gc[None, :].astype(bool), da[:, None], union)
+            ious = inter / np.where(union > 0, union, 1.0)
             per_image_cls.append((ious, da, ds, gc, area))
 
         for a_idx, a_name in enumerate(area_names):

@@ -32,17 +32,42 @@ def _cat(parts: List[Array]) -> Array:
     return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 
 
+def _rle_encode_batch(masks: np.ndarray) -> tuple:
+    """Column-major RLE encode an (N, H, W) boolean stack.
+
+    Returns ``(flat_runs int32, nruns int32 (N,))`` — all masks' runs
+    concatenated, plus the per-mask run count to split them back."""
+    n = masks.shape[0]
+    if n == 0:
+        return np.zeros(0, np.int32), np.zeros(0, np.int32)
+    flat_all = masks.transpose(0, 2, 1).reshape(n, -1)
+    runs_list = []
+    nruns = np.empty(n, np.int32)
+    for i in range(n):
+        f = flat_all[i]
+        change = np.flatnonzero(f[1:] != f[:-1]) + 1
+        starts = np.concatenate(([0], change, [f.size]))
+        runs = np.diff(starts)
+        if f.size and f[0]:
+            runs = np.concatenate(([0], runs))
+        runs_list.append(runs)
+        nruns[i] = runs.shape[0]
+    return np.concatenate(runs_list).astype(np.int32), nruns
+
+
 class MeanAveragePrecision(Metric):
     """Mean Average Precision / Recall for object detection (COCO protocol).
 
     Inputs follow the reference's list-of-dicts format: per image,
     ``preds`` = {"boxes" (D, 4), "scores" (D,), "labels" (D,)} and
     ``target`` = {"boxes" (G, 4), "labels" (G,)} with optional ``iscrowd``
-    and ``area`` keys.
+    and ``area`` keys.  With ``iou_type="segm"``, ``masks`` (N, H, W) boolean
+    stacks replace ``boxes`` (reference mean_ap.py:430-438); masks are
+    RLE-encoded at update and matched by mask IoU at compute.
 
     Args:
         box_format: ``xyxy``/``xywh``/``cxcywh`` input box format.
-        iou_type: only ``bbox`` is supported (``segm`` requires mask inputs).
+        iou_type: ``bbox`` (box IoU) or ``segm`` (instance-mask IoU).
         iou_thresholds: IoU thresholds; defaults to COCO's 0.50:0.05:0.95.
         rec_thresholds: recall thresholds; defaults to COCO's 0:0.01:1.
         max_detection_thresholds: per-image detection caps (default 1/10/100).
@@ -80,6 +105,15 @@ class MeanAveragePrecision(Metric):
     groundtruth_crowds: List[Array]
     groundtruth_area: List[Array]
     groundtruth_counts: List[Array]
+    # segm-only ragged mask state (column-major RLE runs, flattened with
+    # per-mask run counts — same counts-array pattern as the box states, so
+    # the generic device-array merge syncs masks too; the reference instead
+    # needs a custom object-gather for its RLE tuples, ref mean_ap.py:994-1024)
+    detection_mask_runs: List[Array]
+    detection_mask_nruns: List[Array]
+    groundtruth_mask_runs: List[Array]
+    groundtruth_mask_nruns: List[Array]
+    mask_sizes: List[Array]
 
     def __init__(
         self,
@@ -98,8 +132,8 @@ class MeanAveragePrecision(Metric):
         if box_format not in allowed_box_formats:
             raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
         self.box_format = box_format
-        if iou_type != "bbox":
-            raise ValueError(f"Expected argument `iou_type` to be `bbox` but got {iou_type}")
+        if iou_type not in ("bbox", "segm"):
+            raise ValueError(f"Expected argument `iou_type` to be one of ('bbox', 'segm') but got {iou_type}")
         self.iou_type = iou_type
 
         if iou_thresholds is not None and not isinstance(iou_thresholds, list):
@@ -128,15 +162,22 @@ class MeanAveragePrecision(Metric):
             raise ValueError(f"Expected argument `average` to be one of ('macro', 'micro') but got {average}")
         self.average = average
 
-        self.add_state("detection_boxes", default=[], dist_reduce_fx=None)
         self.add_state("detection_scores", default=[], dist_reduce_fx=None)
         self.add_state("detection_labels", default=[], dist_reduce_fx=None)
         self.add_state("detection_counts", default=[], dist_reduce_fx=None)
-        self.add_state("groundtruth_boxes", default=[], dist_reduce_fx=None)
         self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
         self.add_state("groundtruth_crowds", default=[], dist_reduce_fx=None)
         self.add_state("groundtruth_area", default=[], dist_reduce_fx=None)
         self.add_state("groundtruth_counts", default=[], dist_reduce_fx=None)
+        if iou_type == "bbox":
+            self.add_state("detection_boxes", default=[], dist_reduce_fx=None)
+            self.add_state("groundtruth_boxes", default=[], dist_reduce_fx=None)
+        else:
+            self.add_state("detection_mask_runs", default=[], dist_reduce_fx=None)
+            self.add_state("detection_mask_nruns", default=[], dist_reduce_fx=None)
+            self.add_state("groundtruth_mask_runs", default=[], dist_reduce_fx=None)
+            self.add_state("groundtruth_mask_nruns", default=[], dist_reduce_fx=None)
+            self.add_state("mask_sizes", default=[], dist_reduce_fx=None)
 
     def update(self, preds: Sequence[Dict[str, Array]], target: Sequence[Dict[str, Array]]) -> None:
         """Append one batch of per-image detections and ground truths
@@ -153,9 +194,13 @@ class MeanAveragePrecision(Metric):
         if not preds:
             return
 
-        dboxes = [_fix_empty_tensors(p["boxes"]) for p in preds]
-        dcounts = [int(b.shape[0]) for b in dboxes]
-        self.detection_boxes.append(self._convert_boxes(jnp.concatenate(dboxes)))
+        if self.iou_type == "bbox":
+            dboxes = [_fix_empty_tensors(p["boxes"]) for p in preds]
+            dcounts = [int(b.shape[0]) for b in dboxes]
+            self.detection_boxes.append(self._convert_boxes(jnp.concatenate(dboxes)))
+        else:
+            dcounts = [int(p["masks"].shape[0]) for p in preds]
+            self._append_masks(preds, target)
         self.detection_scores.append(
             jnp.concatenate([jnp.ravel(p["scores"]) for p in preds]).astype(jnp.float32)
         )
@@ -164,9 +209,12 @@ class MeanAveragePrecision(Metric):
         )
         self.detection_counts.append(jnp.asarray(dcounts, jnp.int32))
 
-        gboxes = [_fix_empty_tensors(t["boxes"]) for t in target]
-        gcounts = [int(b.shape[0]) for b in gboxes]
-        self.groundtruth_boxes.append(self._convert_boxes(jnp.concatenate(gboxes)))
+        if self.iou_type == "bbox":
+            gboxes = [_fix_empty_tensors(t["boxes"]) for t in target]
+            gcounts = [int(b.shape[0]) for b in gboxes]
+            self.groundtruth_boxes.append(self._convert_boxes(jnp.concatenate(gboxes)))
+        else:
+            gcounts = [int(t["masks"].shape[0]) for t in target]
         self.groundtruth_labels.append(
             jnp.concatenate([jnp.ravel(t["labels"]) for t in target]).astype(jnp.int32)
         )
@@ -196,6 +244,61 @@ class MeanAveragePrecision(Metric):
             boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
         return boxes
 
+    def _unpack_mask_geoms(self, geom_flat, dcounts, gcounts):
+        """Rebuild per-image ``((h, w), [runs per mask])`` geometries from the
+        fetched flat run arrays (the inverse of :meth:`_append_masks`)."""
+        d_runs_flat, d_nruns, g_runs_flat, g_nruns, sizes = geom_flat
+        d_masks = np.split(d_runs_flat, np.cumsum(d_nruns)[:-1]) if d_nruns.size else []
+        g_masks = np.split(g_runs_flat, np.cumsum(g_nruns)[:-1]) if g_nruns.size else []
+        det_geoms, gt_geoms = [], []
+        d_pos = g_pos = 0
+        for i in range(len(dcounts)):
+            h, w = int(sizes[i, 0]), int(sizes[i, 1])
+            dc, gc = int(dcounts[i]), int(gcounts[i])
+            det_geoms.append(((h, w), d_masks[d_pos : d_pos + dc]))
+            gt_geoms.append(((h, w), g_masks[g_pos : g_pos + gc]))
+            d_pos += dc
+            g_pos += gc
+        return det_geoms, gt_geoms
+
+    def _append_masks(self, preds, target) -> None:
+        """RLE-encode one batch of instance masks and append device-array state.
+
+        Encoding happens on host (the masks' run structure is data-dependent),
+        but the stored state is four flat int32 device arrays + a sizes array
+        per update — NOT python objects — so cross-replica merge uses the same
+        concatenation path as every other ragged state (the reference keeps
+        RLE tuples on CPU and needs ``all_gather_object``, ref
+        mean_ap.py:994-1024)."""
+        # one host fetch per mask stack, reused for both the size check and
+        # the RLE encode (device->host transfers dominate on remote chips)
+        pred_masks = [np.asarray(p["masks"]).astype(bool) for p in preds]
+        target_masks = [np.asarray(t["masks"]).astype(bool) for t in target]
+        sizes = []
+        for pm, tm in zip(pred_masks, target_masks):
+            ph, pw = (pm.shape[-2], pm.shape[-1]) if pm.ndim == 3 and pm.shape[0] else (0, 0)
+            th, tw = (tm.shape[-2], tm.shape[-1]) if tm.ndim == 3 and tm.shape[0] else (0, 0)
+            if ph and th and (ph, pw) != (th, tw):
+                raise ValueError(
+                    f"Prediction and target masks of one image have different sizes: {(ph, pw)} vs {(th, tw)}"
+                )
+            sizes.append((max(ph, th), max(pw, tw)))
+        self.mask_sizes.append(jnp.asarray(np.asarray(sizes, np.int32).reshape(-1, 2)))
+
+        for stacks, runs_state, nruns_state in (
+            (pred_masks, self.detection_mask_runs, self.detection_mask_nruns),
+            (target_masks, self.groundtruth_mask_runs, self.groundtruth_mask_nruns),
+        ):
+            flats, nruns = [], []
+            for masks in stacks:
+                if masks.ndim != 3:
+                    masks = masks.reshape((0, 0, 0)) if masks.size == 0 else masks
+                f, n = _rle_encode_batch(masks)
+                flats.append(f)
+                nruns.append(n)
+            runs_state.append(jnp.asarray(np.concatenate(flats) if flats else np.zeros(0, np.int32)))
+            nruns_state.append(jnp.asarray(np.concatenate(nruns) if nruns else np.zeros(0, np.int32)))
+
     def compute(self) -> Dict[str, Array]:
         """Run the COCO protocol over the accumulated images.
 
@@ -206,31 +309,41 @@ class MeanAveragePrecision(Metric):
         trip per array on remote-attached accelerators, and a jitted pack
         would recompile every time the state's shape signature changes.
         Per-image boundaries come from the fetched counts arrays."""
-        num_updates = len(self.detection_boxes)
+        num_updates = len(self.detection_scores)
+        is_segm = self.iou_type == "segm"
         if num_updates:
+            geom_states = (
+                (
+                    _cat(self.detection_mask_runs),
+                    _cat(self.detection_mask_nruns),
+                    _cat(self.groundtruth_mask_runs),
+                    _cat(self.groundtruth_mask_nruns),
+                    _cat(self.mask_sizes),
+                )
+                if is_segm
+                else (_cat(self.detection_boxes), _cat(self.groundtruth_boxes))
+            )
             (
-                det_boxes_flat,
                 det_scores_flat,
                 det_labels_flat,
                 dcounts,
-                gt_boxes_flat,
                 gt_labels_flat,
                 gt_crowds_flat,
                 gt_area_flat,
                 gcounts,
+                *geom_flat,
             ) = (
                 np.asarray(x)
                 for x in jax.device_get(
                     (
-                        _cat(self.detection_boxes),
                         _cat(self.detection_scores),
                         _cat(self.detection_labels),
                         _cat(self.detection_counts),
-                        _cat(self.groundtruth_boxes),
                         _cat(self.groundtruth_labels),
                         _cat(self.groundtruth_crowds),
                         _cat(self.groundtruth_area),
                         _cat(self.groundtruth_counts),
+                        *geom_states,
                     )
                 )
             )
@@ -238,20 +351,23 @@ class MeanAveragePrecision(Metric):
             dends = np.cumsum(dcounts)
             gends = np.cumsum(gcounts)
             num_imgs = len(dcounts)
-            det_boxes = np.split(det_boxes_flat, dends[:-1])
             det_scores = np.split(det_scores_flat, dends[:-1])
             det_labels = np.split(det_labels_flat, dends[:-1])
-            gt_boxes = np.split(gt_boxes_flat, gends[:-1])
             gt_labels = np.split(gt_labels_flat, gends[:-1])
             gt_crowds = np.split(gt_crowds_flat, gends[:-1])
             gt_area = np.split(gt_area_flat, gends[:-1])
+            if is_segm:
+                det_geoms, gt_geoms = self._unpack_mask_geoms(geom_flat, dcounts, gcounts)
+            else:
+                det_geoms = np.split(geom_flat[0], dends[:-1])
+                gt_geoms = np.split(geom_flat[1], gends[:-1])
         else:
             num_imgs = 0
-            det_boxes = det_scores = det_labels = []
-            gt_boxes = gt_labels = gt_crowds = gt_area = []
-        detections = [(det_boxes[i], det_scores[i], det_labels[i]) for i in range(num_imgs)]
+            det_geoms = det_scores = det_labels = []
+            gt_geoms = gt_labels = gt_crowds = gt_area = []
+        detections = [(det_geoms[i], det_scores[i], det_labels[i]) for i in range(num_imgs)]
         groundtruths = [
-            (gt_boxes[i], gt_labels[i], gt_crowds[i], gt_area[i]) for i in range(num_imgs)
+            (gt_geoms[i], gt_labels[i], gt_crowds[i], gt_area[i]) for i in range(num_imgs)
         ]
         all_labels = det_labels + gt_labels
         class_ids = (
@@ -265,6 +381,7 @@ class MeanAveragePrecision(Metric):
             self.max_detection_thresholds,
             class_ids,
             average=self.average,
+            iou_type=self.iou_type,
         )
 
         max_det = self.max_detection_thresholds[-1]
@@ -296,6 +413,7 @@ class MeanAveragePrecision(Metric):
                     self.max_detection_thresholds,
                     class_ids,
                     average="macro",
+                    iou_type=self.iou_type,
                 )
             else:
                 per_class = result
