@@ -512,3 +512,17 @@ def test_segm_map_empty_and_validation():
                  [dict(masks=jnp.zeros((1, 16, 16), dtype=bool), labels=jnp.asarray([0]))])
     with pytest.raises(ValueError):
         MeanAveragePrecision(iou_type="nope")
+
+
+def test_segm_map_bad_rank_mask_leaves_state_clean():
+    """A malformed masks input must raise BEFORE any state is appended."""
+    m = MeanAveragePrecision(iou_type="segm")
+    with pytest.raises(ValueError, match="num_masks, H, W"):
+        m.update([dict(masks=jnp.ones((1, 16), dtype=bool), scores=jnp.asarray([0.5]), labels=jnp.asarray([0]))],
+                 [dict(masks=jnp.ones((1, 16, 16), dtype=bool), labels=jnp.asarray([0]))])
+    assert not m.mask_sizes and not m.detection_mask_runs and not m.detection_scores
+    # the metric remains fully usable afterwards
+    good = jnp.ones((1, 16, 16), dtype=bool)
+    m.update([dict(masks=good, scores=jnp.asarray([0.9]), labels=jnp.asarray([0]))],
+             [dict(masks=good, labels=jnp.asarray([0]))])
+    assert np.isclose(float(m.compute()["map"]), 1.0, atol=1e-6)

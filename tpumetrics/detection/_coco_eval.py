@@ -211,6 +211,22 @@ def _accumulate_class_area(
     return precision, recall
 
 
+def precompute_geometries(
+    detections: Sequence[Tuple],
+    groundtruths: Sequence[Tuple],
+    iou_type: str,
+) -> List[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Class-independent pairwise geometry, ONCE per image (intersections +
+    areas); the per-class loop in :func:`coco_evaluate` only slices these.
+    pycocotools recomputes IoU per (image, category) — for masks that means
+    re-decoding RLEs K times; here each mask is decoded once and intersected
+    by one matmul."""
+    return [
+        _pairwise_geometry(detections[img][0], groundtruths[img][0], iou_type)
+        for img in range(len(detections))
+    ]
+
+
 def coco_evaluate(
     detections: Sequence[Tuple[np.ndarray, np.ndarray, np.ndarray]],
     groundtruths: Sequence[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]],
@@ -220,6 +236,7 @@ def coco_evaluate(
     class_ids: Sequence[int],
     average: str = "macro",
     iou_type: str = "bbox",
+    geom_cache: Optional[List[Tuple[np.ndarray, np.ndarray, np.ndarray]]] = None,
 ) -> Dict[str, np.ndarray]:
     """Full COCO evaluation over per-image detections/groundtruths.
 
@@ -232,6 +249,10 @@ def coco_evaluate(
         class_ids: the class label space to evaluate.
         average: ``macro`` (per-class then averaged, COCO standard) or
             ``micro`` (all classes pooled into one).
+        geom_cache: output of a prior :func:`precompute_geometries` call on
+            the same inputs — lets a caller that evaluates twice (e.g. micro
+            scores + macro per-class values) pay the mask-decode/intersection
+            cost once.
     """
     iou_thrs = np.asarray(iou_thresholds, dtype=np.float64)
     rec_thrs = np.asarray(rec_thresholds, dtype=np.float64)
@@ -247,15 +268,9 @@ def coco_evaluate(
     precision = -np.ones((len(iou_thrs), len(rec_thrs), len(eval_class_ids), len(area_names), len(max_dets)))
     recall = -np.ones((len(iou_thrs), len(eval_class_ids), len(area_names), len(max_dets)))
 
-    # class-independent pairwise geometry, ONCE per image (intersections +
-    # areas); the per-class loop only slices these.  pycocotools recomputes
-    # IoU per (image, category) — for masks that means re-decoding RLEs K
-    # times; here each mask is decoded once and intersected by one matmul.
-    per_image_geom = []
-    for img in range(num_imgs):
-        det_geom = detections[img][0]
-        gt_geom = groundtruths[img][0]
-        per_image_geom.append(_pairwise_geometry(det_geom, gt_geom, iou_type))
+    per_image_geom = (
+        geom_cache if geom_cache is not None else precompute_geometries(detections, groundtruths, iou_type)
+    )
 
     for k_idx, class_id in enumerate(eval_class_ids):
         # per (image, class): sort detections by score and compute IoUs ONCE,

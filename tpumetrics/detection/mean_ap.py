@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpumetrics.detection._coco_eval import coco_evaluate
+from tpumetrics.detection._coco_eval import coco_evaluate, precompute_geometries
 from tpumetrics.detection.helpers import _fix_empty_tensors, _input_validator
 from tpumetrics.functional.detection._box_ops import box_convert
 from tpumetrics.metric import Metric
@@ -270,12 +270,23 @@ class MeanAveragePrecision(Metric):
         concatenation path as every other ragged state (the reference keeps
         RLE tuples on CPU and needs ``all_gather_object``, ref
         mean_ap.py:994-1024)."""
-        # one host fetch per mask stack, reused for both the size check and
-        # the RLE encode (device->host transfers dominate on remote chips)
-        pred_masks = [np.asarray(p["masks"]).astype(bool) for p in preds]
-        target_masks = [np.asarray(t["masks"]).astype(bool) for t in target]
+        # ONE batched host fetch for every mask stack in the update
+        # (device->host round trips dominate on remote chips), then validate
+        # everything BEFORE the first state append so a bad input can't leave
+        # the metric with half-appended, misaligned state
+        pred_masks, target_masks = jax.device_get(
+            ([p["masks"] for p in preds], [t["masks"] for t in target])
+        )
+        pred_masks = [np.asarray(m).astype(bool) for m in pred_masks]
+        target_masks = [np.asarray(m).astype(bool) for m in target_masks]
         sizes = []
-        for pm, tm in zip(pred_masks, target_masks):
+        for i, (pm, tm) in enumerate(zip(pred_masks, target_masks)):
+            for side, m in (("preds", pm), ("target", tm)):
+                if m.ndim != 3 and m.size:
+                    raise ValueError(
+                        f"Expected `masks` of sample {i} in {side} to have shape (num_masks, H, W),"
+                        f" but got {m.shape}"
+                    )
             ph, pw = (pm.shape[-2], pm.shape[-1]) if pm.ndim == 3 and pm.shape[0] else (0, 0)
             th, tw = (tm.shape[-2], tm.shape[-1]) if tm.ndim == 3 and tm.shape[0] else (0, 0)
             if ph and th and (ph, pw) != (th, tw):
@@ -283,21 +294,27 @@ class MeanAveragePrecision(Metric):
                     f"Prediction and target masks of one image have different sizes: {(ph, pw)} vs {(th, tw)}"
                 )
             sizes.append((max(ph, th), max(pw, tw)))
-        self.mask_sizes.append(jnp.asarray(np.asarray(sizes, np.int32).reshape(-1, 2)))
 
-        for stacks, runs_state, nruns_state in (
-            (pred_masks, self.detection_mask_runs, self.detection_mask_nruns),
-            (target_masks, self.groundtruth_mask_runs, self.groundtruth_mask_nruns),
-        ):
+        staged = []  # encode everything first; append states only on success
+        for stacks in (pred_masks, target_masks):
             flats, nruns = [], []
             for masks in stacks:
                 if masks.ndim != 3:
-                    masks = masks.reshape((0, 0, 0)) if masks.size == 0 else masks
+                    masks = masks.reshape((0, 0, 0))
                 f, n = _rle_encode_batch(masks)
                 flats.append(f)
                 nruns.append(n)
-            runs_state.append(jnp.asarray(np.concatenate(flats) if flats else np.zeros(0, np.int32)))
-            nruns_state.append(jnp.asarray(np.concatenate(nruns) if nruns else np.zeros(0, np.int32)))
+            staged.append(
+                (
+                    jnp.asarray(np.concatenate(flats) if flats else np.zeros(0, np.int32)),
+                    jnp.asarray(np.concatenate(nruns) if nruns else np.zeros(0, np.int32)),
+                )
+            )
+        self.mask_sizes.append(jnp.asarray(np.asarray(sizes, np.int32).reshape(-1, 2)))
+        self.detection_mask_runs.append(staged[0][0])
+        self.detection_mask_nruns.append(staged[0][1])
+        self.groundtruth_mask_runs.append(staged[1][0])
+        self.groundtruth_mask_nruns.append(staged[1][1])
 
     def compute(self) -> Dict[str, Array]:
         """Run the COCO protocol over the accumulated images.
@@ -373,6 +390,9 @@ class MeanAveragePrecision(Metric):
         class_ids = (
             sorted(np.unique(np.concatenate(all_labels)).astype(int).tolist()) if all_labels else []
         )
+        # pay the geometry cost (mask decode + intersections) once, shared by
+        # the optional second macro evaluation below
+        geom_cache = precompute_geometries(detections, groundtruths, self.iou_type)
         result = coco_evaluate(
             detections,
             groundtruths,
@@ -382,6 +402,7 @@ class MeanAveragePrecision(Metric):
             class_ids,
             average=self.average,
             iou_type=self.iou_type,
+            geom_cache=geom_cache,
         )
 
         max_det = self.max_detection_thresholds[-1]
@@ -414,6 +435,7 @@ class MeanAveragePrecision(Metric):
                     class_ids,
                     average="macro",
                     iou_type=self.iou_type,
+                    geom_cache=geom_cache,
                 )
             else:
                 per_class = result
