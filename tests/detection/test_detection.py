@@ -521,6 +521,12 @@ def test_segm_map_bad_rank_mask_leaves_state_clean():
         m.update([dict(masks=jnp.ones((1, 16), dtype=bool), scores=jnp.asarray([0.5]), labels=jnp.asarray([0]))],
                  [dict(masks=jnp.ones((1, 16, 16), dtype=bool), labels=jnp.asarray([0]))])
     assert not m.mask_sizes and not m.detection_mask_runs and not m.detection_scores
+    # 2-D empty with nonzero leading dim: counts would say 2, encoder would see 0
+    with pytest.raises(ValueError, match="num_masks, H, W"):
+        m.update([dict(masks=jnp.zeros((2, 0), dtype=bool), scores=jnp.asarray([0.5, 0.6]),
+                       labels=jnp.asarray([0, 0]))],
+                 [dict(masks=jnp.ones((1, 16, 16), dtype=bool), labels=jnp.asarray([0]))])
+    assert not m.mask_sizes and not m.detection_mask_runs and not m.detection_scores
     # the metric remains fully usable afterwards
     good = jnp.ones((1, 16, 16), dtype=bool)
     m.update([dict(masks=good, scores=jnp.asarray([0.9]), labels=jnp.asarray([0]))],

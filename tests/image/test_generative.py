@@ -167,7 +167,7 @@ def test_lpips():
     m.update(img1, img2)
     assert np.isclose(float(m.compute()), d_diff, atol=1e-6)
 
-    with pytest.raises(ModuleNotFoundError, match="torchvision weights"):
+    with pytest.raises(ModuleNotFoundError, match="backbone_params"):
         LearnedPerceptualImagePatchSimilarity(net_type="alex")
     with pytest.raises(ValueError, match="net_type"):
         LearnedPerceptualImagePatchSimilarity(net_type="bad")

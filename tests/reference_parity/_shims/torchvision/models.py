@@ -1,12 +1,105 @@
-"""Stub: pretrained model zoo is not available offline.
+"""Torchvision model-zoo stand-in: the three LPIPS backbone architectures.
 
-The reference's ``lpips.py`` does ``from torchvision import models as tv`` at
-module scope; any actual model constructor lookup raises here.
+The reference's ``_LPIPS`` (``functional/image/lpips.py``) builds its
+backbones via ``getattr(tv, net)(weights=None).features``.  The architectures
+(AlexNet, VGG-16, SqueezeNet-1.1 feature stacks) are public; only the
+pretrained ImageNet WEIGHTS are unavailable offline.  These untrained replicas
+let the parity suite instantiate the reference LPIPS with ``pnet_rand=True``
+(random backbone + its vendored trained heads) as a full-pipeline oracle.
+Layer indices match torchvision's ``features`` Sequentials exactly — the
+reference slices by index.
+
+Any other model lookup raises.
 """
+
+import torch
+from torch import nn
+
+
+class _FeaturesOnly(nn.Module):
+    def __init__(self, features: nn.Sequential) -> None:
+        super().__init__()
+        self.features = features
+
+
+def alexnet(weights=None, **kwargs) -> _FeaturesOnly:
+    if weights is not None:
+        raise RuntimeError("pretrained weights unavailable in the offline test shim")
+    return _FeaturesOnly(
+        nn.Sequential(
+            nn.Conv2d(3, 64, kernel_size=11, stride=4, padding=2),
+            nn.ReLU(inplace=True),
+            nn.MaxPool2d(kernel_size=3, stride=2),
+            nn.Conv2d(64, 192, kernel_size=5, padding=2),
+            nn.ReLU(inplace=True),
+            nn.MaxPool2d(kernel_size=3, stride=2),
+            nn.Conv2d(192, 384, kernel_size=3, padding=1),
+            nn.ReLU(inplace=True),
+            nn.Conv2d(384, 256, kernel_size=3, padding=1),
+            nn.ReLU(inplace=True),
+            nn.Conv2d(256, 256, kernel_size=3, padding=1),
+            nn.ReLU(inplace=True),
+            nn.MaxPool2d(kernel_size=3, stride=2),
+        )
+    )
+
+
+def vgg16(weights=None, **kwargs) -> _FeaturesOnly:
+    if weights is not None:
+        raise RuntimeError("pretrained weights unavailable in the offline test shim")
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M", 512, 512, 512, "M"]
+    layers = []
+    in_ch = 3
+    for v in cfg:
+        if v == "M":
+            layers.append(nn.MaxPool2d(kernel_size=2, stride=2))
+        else:
+            layers += [nn.Conv2d(in_ch, v, kernel_size=3, padding=1), nn.ReLU(inplace=True)]
+            in_ch = v
+    return _FeaturesOnly(nn.Sequential(*layers))
+
+
+class _Fire(nn.Module):
+    def __init__(self, inplanes: int, squeeze: int, expand: int) -> None:
+        super().__init__()
+        self.squeeze = nn.Conv2d(inplanes, squeeze, kernel_size=1)
+        self.squeeze_activation = nn.ReLU(inplace=True)
+        self.expand1x1 = nn.Conv2d(squeeze, expand, kernel_size=1)
+        self.expand1x1_activation = nn.ReLU(inplace=True)
+        self.expand3x3 = nn.Conv2d(squeeze, expand, kernel_size=3, padding=1)
+        self.expand3x3_activation = nn.ReLU(inplace=True)
+
+    def forward(self, x):
+        x = self.squeeze_activation(self.squeeze(x))
+        return torch.cat(
+            [self.expand1x1_activation(self.expand1x1(x)), self.expand3x3_activation(self.expand3x3(x))], 1
+        )
+
+
+def squeezenet1_1(weights=None, **kwargs) -> _FeaturesOnly:
+    if weights is not None:
+        raise RuntimeError("pretrained weights unavailable in the offline test shim")
+    return _FeaturesOnly(
+        nn.Sequential(
+            nn.Conv2d(3, 64, kernel_size=3, stride=2),
+            nn.ReLU(inplace=True),
+            nn.MaxPool2d(kernel_size=3, stride=2, ceil_mode=True),
+            _Fire(64, 16, 64),
+            _Fire(128, 16, 64),
+            nn.MaxPool2d(kernel_size=3, stride=2, ceil_mode=True),
+            _Fire(128, 32, 128),
+            _Fire(256, 32, 128),
+            nn.MaxPool2d(kernel_size=3, stride=2, ceil_mode=True),
+            _Fire(256, 48, 192),
+            _Fire(384, 48, 192),
+            _Fire(384, 64, 256),
+            _Fire(512, 64, 256),
+        )
+    )
 
 
 def __getattr__(name):  # noqa: D105
     raise RuntimeError(
         f"torchvision.models.{name} is unavailable: this is the offline test shim "
-        "(pretrained backbones cannot be downloaded in this environment)"
+        "(only the untrained LPIPS backbone architectures are provided)"
     )

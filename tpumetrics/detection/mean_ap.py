@@ -282,7 +282,10 @@ class MeanAveragePrecision(Metric):
         sizes = []
         for i, (pm, tm) in enumerate(zip(pred_masks, target_masks)):
             for side, m in (("preds", pm), ("target", tm)):
-                if m.ndim != 3 and m.size:
+                # non-3D is only acceptable as a fully-empty stack with a zero
+                # leading dim — e.g. shape (2, 0) would record 2 detections in
+                # the counts but encode 0 masks, corrupting downstream state
+                if m.ndim != 3 and (m.ndim == 0 or m.shape[0] != 0):
                     raise ValueError(
                         f"Expected `masks` of sample {i} in {side} to have shape (num_masks, H, W),"
                         f" but got {m.shape}"

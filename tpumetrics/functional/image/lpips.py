@@ -11,10 +11,13 @@ reference lpips.py / image/lpip.py:40)."""
 
 from __future__ import annotations
 
+import os
+from functools import lru_cache
 from typing import Callable, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 Array = jax.Array
 
@@ -23,10 +26,34 @@ _SHIFT = (-0.030, -0.088, -0.188)
 _SCALE = (0.458, 0.448, 0.450)
 
 
-def _normalize_tensor(in_feat: Array, eps: float = 1e-10) -> Array:
-    """Unit-normalize along the channel axis (reference lpips.py ``normalize_tensor``)."""
-    norm_factor = jnp.sqrt(jnp.sum(in_feat**2, axis=1, keepdims=True))
-    return in_feat / (norm_factor + eps)
+@lru_cache(maxsize=None)
+def _load_head_file() -> dict:
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "..", "image", "_lpips_weights", "lpips_heads.npz")
+    with np.load(os.path.abspath(path)) as data:
+        return dict(data)
+
+
+def lpips_head_weights(net_type: str) -> List[np.ndarray]:
+    """The trained LPIPS linear-head channel weights, bundled with the package.
+
+    Converted from the reference's vendored ``lpips_models/{alex,vgg,squeeze}.pth``
+    (originally richzhang/PerceptualSimilarity, BSD-2-Clause, Copyright (c)
+    2018 Richard Zhang et al.; vendored by torchmetrics the same way — reference
+    ``functional/image/lpips.py:322-326``).  Returns one non-negative (C_i,)
+    array per backbone layer.
+    """
+    heads = _load_head_file()
+    keys = sorted((k for k in heads if k.startswith(f"{net_type}_lin")), key=lambda k: int(k.rsplit("lin", 1)[1]))
+    if not keys:
+        raise ValueError(f"No bundled LPIPS heads for net_type={net_type!r} (have alex/vgg/squeeze)")
+    return [heads[k] for k in keys]
+
+
+def _normalize_tensor(in_feat: Array, eps: float = 1e-8) -> Array:
+    """Unit-normalize along the channel axis (reference lpips.py:219-222 —
+    the eps lives inside the sqrt, following PerceptualSimilarity PR#114)."""
+    norm_factor = jnp.sqrt(eps + jnp.sum(in_feat**2, axis=1, keepdims=True))
+    return in_feat / norm_factor
 
 
 def _spatial_average(in_tens: Array, keepdim: bool = True) -> Array:
@@ -43,21 +70,29 @@ def _scaling_layer(x: Array) -> Array:
 def learned_perceptual_image_patch_similarity(
     img1: Array,
     img2: Array,
-    net: Callable[[Array], Sequence[Array]],
+    net: Union[str, Callable[[Array], Sequence[Array]]] = "alex",
     layer_weights: Optional[Sequence[Array]] = None,
     normalize: bool = False,
     reduction: str = "mean",
+    backbone_params: Optional[Sequence[Tuple[Array, Array]]] = None,
 ) -> Array:
     """LPIPS distance between two image batches given a feature backbone.
 
     Args:
         img1 / img2: (N, 3, H, W) images in [-1, 1] (or [0, 1] with
             ``normalize=True``).
-        net: callable returning the list of per-layer feature maps.
+        net: callable returning the list of per-layer feature maps, OR one of
+            ``"alex"``/``"vgg"``/``"squeeze"`` — then ``backbone_params``
+            (conv weights converted offline, see
+            :mod:`tpumetrics.image._backbones`) must be given, and the
+            bundled trained linear heads are applied automatically.
         layer_weights: optional per-layer channel weights (C_i,) — the
             trained linear heads of the original LPIPS; uniform weighting
-            (the paper's "baseline" variant) otherwise.
+            (the paper's "baseline" variant) otherwise.  Defaults to the
+            bundled trained heads when ``net`` is a string.
         reduction: ``mean``, ``sum`` or ``none`` (per-image values) over the batch.
+        backbone_params: converted conv ``(weight, bias)`` pairs for a string
+            ``net`` (torch OIHW layout).
 
     Example:
         >>> import jax, jax.numpy as jnp
@@ -69,6 +104,22 @@ def learned_perceptual_image_patch_similarity(
         >>> float(learned_perceptual_image_patch_similarity(img1, img2, toy_net)) > 0
         True
     """
+    if isinstance(net, str):
+        from tpumetrics.image._backbones import lpips_backbone
+
+        if net not in ("alex", "vgg", "squeeze"):
+            raise ValueError(f"Argument `net` must be 'alex', 'vgg', 'squeeze' or a callable, got {net!r}")
+        if backbone_params is None:
+            raise ModuleNotFoundError(
+                f"LPIPS with the `{net}` backbone needs its pretrained conv weights, which cannot be"
+                " downloaded in an offline environment. Convert them once with torchvision (see"
+                " tpumetrics.image._backbones) and pass them as `backbone_params`; the trained"
+                " linear heads are bundled and applied automatically."
+            )
+        if layer_weights is None:
+            layer_weights = lpips_head_weights(net)
+        net = lpips_backbone(net, backbone_params)
+
     if normalize:  # [0,1] -> [-1,1]
         img1 = 2 * img1 - 1
         img2 = 2 * img2 - 1

@@ -1,0 +1,178 @@
+"""LPIPS feature backbones as pure-jax forward functions.
+
+The LPIPS metric needs the feature stacks of AlexNet / VGG-16 / SqueezeNet-1.1
+sliced at specific ReLUs (reference ``functional/image/lpips.py:66-203``,
+itself a port of richzhang/PerceptualSimilarity, BSD-2-Clause).  The
+architectures are public; pretrained ImageNet weights cannot be downloaded in
+an offline environment, so these forwards take the convolution parameters as
+data: a flat list of ``(weight, bias)`` pairs in torch's OIHW layout, which a
+user converts offline from torchvision with::
+
+    feats = torchvision.models.alexnet(weights="IMAGENET1K_V1").features
+    params = [(m.weight.detach().numpy(), m.bias.detach().numpy())
+              for m in feats.modules() if isinstance(m, torch.nn.Conv2d)]
+
+(for SqueezeNet each Fire module contributes its squeeze / expand1x1 /
+expand3x3 convs, in that order — i.e. the order ``Conv2d`` modules appear in
+``features.modules()``).
+
+Everything here is jit-compatible: fixed conv plans, ``lax`` pooling windows,
+no data-dependent control flow.  On TPU the convs land on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+ConvParams = Tuple[Array, Array]
+
+# per-layer feature channels each backbone must emit — the bundled LPIPS
+# heads (lpips_head_weights) are trained against exactly these widths
+LPIPS_CHANNELS = {
+    "alex": [64, 192, 384, 256, 256],
+    "vgg": [64, 128, 256, 512, 512],
+    "squeeze": [64, 128, 256, 384, 384, 512, 512],
+}
+
+
+def _conv(x: Array, wb: ConvParams, stride: int = 1, padding: int = 0) -> Array:
+    w, b = wb
+    out = lax.conv_general_dilated(
+        x,
+        jnp.asarray(w, x.dtype),
+        window_strides=(stride, stride),
+        padding=[(padding, padding), (padding, padding)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    return out + jnp.asarray(b, x.dtype).reshape(1, -1, 1, 1)
+
+
+def _maxpool(x: Array, kernel: int = 3, stride: int = 2, ceil_mode: bool = False) -> Array:
+    """torch ``MaxPool2d(kernel, stride)``; ``ceil_mode`` pads the bottom/right
+    edge with -inf so partial windows count (SqueezeNet uses ceil_mode=True)."""
+    pads = [(0, 0), (0, 0)]
+    for dim in (2, 3):
+        size = x.shape[dim]
+        if ceil_mode:
+            out = -(-(size - kernel) // stride) + 1
+            needed = (out - 1) * stride + kernel
+            pads.append((0, max(0, needed - size)))
+        else:
+            pads.append((0, 0))
+    return lax.reduce_window(
+        x,
+        -jnp.inf,
+        lax.max,
+        window_dimensions=(1, 1, kernel, kernel),
+        window_strides=(1, 1, stride, stride),
+        padding=pads,
+    )
+
+
+def _check_params(net_type: str, params: Sequence[ConvParams], expected: int) -> None:
+    if len(params) != expected:
+        raise ValueError(
+            f"LPIPS `{net_type}` backbone expects {expected} (weight, bias) conv-parameter pairs"
+            f" in torch Conv2d order, got {len(params)}"
+        )
+
+
+def alexnet_features(params: Sequence[ConvParams]) -> Callable[[Array], List[Array]]:
+    """AlexNet feature stack sliced at the 5 LPIPS ReLUs (reference lpips.py:104-152)."""
+    _check_params("alex", params, 5)
+
+    def forward(x: Array) -> List[Array]:
+        outs = []
+        h = jax.nn.relu(_conv(x, params[0], stride=4, padding=2))
+        outs.append(h)  # relu1 (64)
+        h = jax.nn.relu(_conv(_maxpool(h), params[1], padding=2))
+        outs.append(h)  # relu2 (192)
+        h = jax.nn.relu(_conv(_maxpool(h), params[2], padding=1))
+        outs.append(h)  # relu3 (384)
+        h = jax.nn.relu(_conv(h, params[3], padding=1))
+        outs.append(h)  # relu4 (256)
+        h = jax.nn.relu(_conv(h, params[4], padding=1))
+        outs.append(h)  # relu5 (256)
+        return outs
+
+    return forward
+
+
+def vgg16_features(params: Sequence[ConvParams]) -> Callable[[Array], List[Array]]:
+    """VGG-16 feature stack sliced at relu{1_2,2_2,3_3,4_3,5_3} (reference lpips.py:155-203)."""
+    _check_params("vgg", params, 13)
+    # conv counts per slice; a maxpool precedes every slice but the first
+    blocks = [2, 2, 3, 3, 3]
+
+    def forward(x: Array) -> List[Array]:
+        outs = []
+        h = x
+        idx = 0
+        for block_i, n_convs in enumerate(blocks):
+            if block_i:
+                h = _maxpool(h, kernel=2, stride=2)
+            for _ in range(n_convs):
+                h = jax.nn.relu(_conv(h, params[idx], padding=1))
+                idx += 1
+            outs.append(h)
+        return outs
+
+    return forward
+
+
+def squeezenet_features(params: Sequence[ConvParams]) -> Callable[[Array], List[Array]]:
+    """SqueezeNet-1.1 feature stack sliced at the 7 LPIPS points (reference lpips.py:66-101).
+
+    ``params``: conv0 then 8 Fire modules x (squeeze, expand1x1, expand3x3) = 25 pairs.
+    """
+    _check_params("squeeze", params, 25)
+
+    def fire(h: Array, base: int) -> Array:
+        s = jax.nn.relu(_conv(h, params[base]))
+        e1 = jax.nn.relu(_conv(s, params[base + 1]))
+        e3 = jax.nn.relu(_conv(s, params[base + 2], padding=1))
+        return jnp.concatenate([e1, e3], axis=1)
+
+    def forward(x: Array) -> List[Array]:
+        outs = []
+        h = jax.nn.relu(_conv(x, params[0], stride=2))
+        outs.append(h)  # relu1 (64)
+        h = _maxpool(h, ceil_mode=True)
+        h = fire(h, 1)
+        h = fire(h, 4)
+        outs.append(h)  # relu2 (128)
+        h = _maxpool(h, ceil_mode=True)
+        h = fire(h, 7)
+        h = fire(h, 10)
+        outs.append(h)  # relu3 (256)
+        h = _maxpool(h, ceil_mode=True)
+        h = fire(h, 13)
+        outs.append(h)  # relu4 (384)
+        h = fire(h, 16)
+        outs.append(h)  # relu5 (384)
+        h = fire(h, 19)
+        outs.append(h)  # relu6 (512)
+        h = fire(h, 22)
+        outs.append(h)  # relu7 (512)
+        return outs
+
+    return forward
+
+
+_BACKBONE_BUILDERS = {
+    "alex": alexnet_features,
+    "vgg": vgg16_features,
+    "squeeze": squeezenet_features,
+}
+
+
+def lpips_backbone(net_type: str, params: Sequence[ConvParams]) -> Callable[[Array], List[Array]]:
+    """Build the named LPIPS backbone forward from converted conv parameters."""
+    if net_type not in _BACKBONE_BUILDERS:
+        raise ValueError(f"Argument `net_type` must be one of {tuple(_BACKBONE_BUILDERS)}, got {net_type}")
+    return _BACKBONE_BUILDERS[net_type](params)

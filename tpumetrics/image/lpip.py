@@ -19,10 +19,15 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
     (reference lpip.py:136-137).
 
     Args:
-        net_type: a callable feature backbone (image→list of feature maps).
-            The reference's string variants (``vgg``/``alex``/``squeeze``)
-            need torchvision pretrained weights and are gated here.
-        layer_weights: optional trained per-layer channel weights.
+        net_type: ``"alex"``/``"vgg"``/``"squeeze"`` (pass the offline-converted
+            conv weights as ``backbone_params``; the trained LPIPS linear heads
+            ship with the package and are applied automatically) or a callable
+            feature backbone (image→list of feature maps).
+        backbone_params: converted ``(weight, bias)`` conv pairs for a string
+            ``net_type`` — see :mod:`tpumetrics.image._backbones` for the
+            one-line torchvision conversion recipe.
+        layer_weights: optional trained per-layer channel weights (defaults to
+            the bundled heads for string ``net_type``).
         reduction: ``mean`` or ``sum`` over accumulated images.
         normalize: inputs are [0,1] instead of [-1,1].
 
@@ -50,6 +55,7 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         reduction: str = "mean",
         normalize: bool = False,
         layer_weights: Optional[Sequence[Array]] = None,
+        backbone_params: Optional[Sequence] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -57,11 +63,20 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
             valid_net_type = ("vgg", "alex", "squeeze")
             if net_type not in valid_net_type:
                 raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
-            raise ModuleNotFoundError(
-                f"LPIPS with the pretrained `{net_type}` backbone requires torchvision weights, which"
-                " cannot be downloaded in this environment. Pass a callable backbone (image -> list of"
-                " (N, C, H, W) feature maps, e.g. a Flax VGG) as `net_type` instead."
-            )
+            if backbone_params is None:
+                raise ModuleNotFoundError(
+                    f"LPIPS with the pretrained `{net_type}` backbone needs its conv weights, which"
+                    " cannot be downloaded in an offline environment. Convert them once with"
+                    " torchvision (recipe in tpumetrics.image._backbones) and pass them as"
+                    " `backbone_params`; the trained LPIPS linear heads are bundled and applied"
+                    " automatically. Alternatively pass a callable backbone as `net_type`."
+                )
+            from tpumetrics.image._backbones import lpips_backbone
+            from tpumetrics.functional.image.lpips import lpips_head_weights
+
+            if layer_weights is None:
+                layer_weights = lpips_head_weights(net_type)
+            net_type = lpips_backbone(net_type, backbone_params)
         if not callable(net_type):
             raise ValueError("Argument `net_type` must be a string or a callable backbone")
         self.net = net_type
