@@ -245,3 +245,50 @@ def test_audio_jit_path():
     got = float(jax.jit(m.functional_compute)(state))
     ref = float(_np_si_sdr(PREDS, TARGET).mean())
     assert np.isclose(got, ref, atol=1e-3)
+
+
+# ----------------------------------------------------------------- SRMR
+
+
+def test_srmr_native_basic_properties():
+    """Native SRMR: shape handling, class-metric mean, clean>reverb ordering."""
+    from tpumetrics.audio import SpeechReverberationModulationEnergyRatio
+    from tpumetrics.functional.audio import speech_reverberation_modulation_energy_ratio
+
+    rng = np.random.default_rng(7)
+    fs = 8000
+    t = np.arange(fs) / fs
+    # modulated noise ~ speech; heavy smearing ~ reverberation
+    clean = (rng.normal(0, 1, fs) * (1 + 0.8 * np.sin(2 * np.pi * 5 * t))).astype(np.float32)
+    kernel = np.exp(-np.arange(2000) / 600.0)
+    reverb = np.convolve(clean, kernel)[:fs].astype(np.float32)
+
+    s_clean = float(speech_reverberation_modulation_energy_ratio(jnp.asarray(clean), fs))
+    s_reverb = float(speech_reverberation_modulation_energy_ratio(jnp.asarray(reverb), fs))
+    assert np.isfinite(s_clean) and np.isfinite(s_reverb) and s_clean > 0 and s_reverb > 0
+    # the score is an energy RATIO: rescaling the waveform must not move it
+    s_scaled = float(speech_reverberation_modulation_energy_ratio(jnp.asarray(clean * 3.0), fs))
+    np.testing.assert_allclose(s_scaled, s_clean, rtol=1e-4)
+
+    batch = jnp.asarray(np.stack([clean, reverb]))
+    s_batch = speech_reverberation_modulation_energy_ratio(batch, fs)
+    assert s_batch.shape == (2,)
+    np.testing.assert_allclose(np.asarray(s_batch), [s_clean, s_reverb], rtol=1e-5)
+
+    m = SpeechReverberationModulationEnergyRatio(fs=fs)
+    m.update(jnp.asarray(clean))
+    m.update(batch)
+    want = (s_clean + s_clean + s_reverb) / 3
+    np.testing.assert_allclose(float(m.compute()), want, rtol=1e-5)
+
+    with pytest.raises(ValueError, match="fs"):
+        SpeechReverberationModulationEnergyRatio(fs=-1)
+    with pytest.raises(NotImplementedError, match="fast"):
+        speech_reverberation_modulation_energy_ratio(jnp.asarray(clean), fs, fast=True)
+
+
+def test_srmr_rejects_sub_window_input():
+    from tpumetrics.functional.audio import speech_reverberation_modulation_energy_ratio
+
+    with pytest.raises(ValueError, match="0.256 s"):
+        speech_reverberation_modulation_energy_ratio(jnp.zeros(1000), 8000)

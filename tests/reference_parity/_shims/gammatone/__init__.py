@@ -1,0 +1,12 @@
+"""Minimal stand-in for the ``gammatone`` package (detly/gammatone).
+
+The reference's SRMR imports ``centre_freqs`` / ``make_erb_filters`` from it
+(reference ``functional/audio/srmr.py:39-55``).  The functions implement
+Slaney's published ERB filter design (Apple TR #35 / MakeERBFilters); this
+shim transcribes the original complex-exponential MATLAB expressions directly
+— deliberately a DIFFERENT algebraic form than the simplified real-valued one
+in ``tpumetrics/functional/audio/srmr.py`` — so an algebra slip on the
+product side shows up in the parity tests.
+"""
+
+__version__ = "1.0"

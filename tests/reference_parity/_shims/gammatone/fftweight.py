@@ -1,0 +1,5 @@
+"""Stub: the FFT gammatonegram approximation (fast=True) is not shimmed."""
+
+
+def fft_gtgram(*args, **kwargs):  # noqa: D103
+    raise RuntimeError("gammatone.fftweight.fft_gtgram is unavailable in the offline test shim")

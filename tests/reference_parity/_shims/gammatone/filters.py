@@ -1,0 +1,83 @@
+"""Slaney ERB filter design, transcribed from the original MATLAB listings."""
+
+import numpy as np
+
+DEFAULT_FILTER_NUM = 100
+DEFAULT_LOW_FREQ = 100
+DEFAULT_HIGH_FREQ = 44100 / 4
+
+
+def erb_space(low_freq: float, high_freq: float, num: int) -> np.ndarray:
+    """ERBSpace: num center frequencies, highest first, lowest == low_freq."""
+    ear_q = 9.26449
+    min_bw = 24.7
+    return -(ear_q * min_bw) + np.exp(
+        np.arange(1, num + 1) * (-np.log(high_freq + ear_q * min_bw) + np.log(low_freq + ear_q * min_bw)) / num
+    ) * (high_freq + ear_q * min_bw)
+
+
+def centre_freqs(fs: float, num_freqs: int, cutoff: float) -> np.ndarray:
+    """Center frequencies for a filterbank from ``cutoff`` up to ``fs / 2``."""
+    return erb_space(cutoff, fs / 2, num_freqs)
+
+
+def make_erb_filters(fs: float, centre_freqs: np.ndarray, width: float = 1.0) -> np.ndarray:
+    """MakeERBFilters: (N, 10) coefficient rows [A0 A11 A12 A13 A14 A2 B0 B1 B2 gain].
+
+    Direct transcription of the complex-form MATLAB expressions.
+    """
+    t = 1.0 / fs
+    cf = np.asarray(centre_freqs, dtype=np.float64)
+    ear_q = 9.26449
+    min_bw = 24.7
+    order = 1
+
+    erb = width * ((cf / ear_q) ** order + min_bw**order) ** (1 / order)
+    b = 1.019 * 2 * np.pi * erb
+
+    a0 = t
+    a2 = 0.0
+    b0 = 1.0
+    b1 = -2 * np.cos(2 * cf * np.pi * t) / np.exp(b * t)
+    b2 = np.exp(-2 * b * t)
+
+    a11 = -(2 * t * np.cos(2 * cf * np.pi * t) / np.exp(b * t)
+            + 2 * np.sqrt(3 + 2**1.5) * t * np.sin(2 * cf * np.pi * t) / np.exp(b * t)) / 2
+    a12 = -(2 * t * np.cos(2 * cf * np.pi * t) / np.exp(b * t)
+            - 2 * np.sqrt(3 + 2**1.5) * t * np.sin(2 * cf * np.pi * t) / np.exp(b * t)) / 2
+    a13 = -(2 * t * np.cos(2 * cf * np.pi * t) / np.exp(b * t)
+            + 2 * np.sqrt(3 - 2**1.5) * t * np.sin(2 * cf * np.pi * t) / np.exp(b * t)) / 2
+    a14 = -(2 * t * np.cos(2 * cf * np.pi * t) / np.exp(b * t)
+            - 2 * np.sqrt(3 - 2**1.5) * t * np.sin(2 * cf * np.pi * t) / np.exp(b * t)) / 2
+
+    i = 1j
+    gain = np.abs(
+        (-2 * np.exp(4 * i * cf * np.pi * t) * t
+         + 2 * np.exp(-(b * t) + 2 * i * cf * np.pi * t) * t
+         * (np.cos(2 * cf * np.pi * t) - np.sqrt(3 - 2**1.5) * np.sin(2 * cf * np.pi * t)))
+        * (-2 * np.exp(4 * i * cf * np.pi * t) * t
+           + 2 * np.exp(-(b * t) + 2 * i * cf * np.pi * t) * t
+           * (np.cos(2 * cf * np.pi * t) + np.sqrt(3 - 2**1.5) * np.sin(2 * cf * np.pi * t)))
+        * (-2 * np.exp(4 * i * cf * np.pi * t) * t
+           + 2 * np.exp(-(b * t) + 2 * i * cf * np.pi * t) * t
+           * (np.cos(2 * cf * np.pi * t) - np.sqrt(3 + 2**1.5) * np.sin(2 * cf * np.pi * t)))
+        * (-2 * np.exp(4 * i * cf * np.pi * t) * t
+           + 2 * np.exp(-(b * t) + 2 * i * cf * np.pi * t) * t
+           * (np.cos(2 * cf * np.pi * t) + np.sqrt(3 + 2**1.5) * np.sin(2 * cf * np.pi * t)))
+        / (-2 / np.exp(2 * b * t) - 2 * np.exp(4 * i * cf * np.pi * t)
+           + 2 * (1 + np.exp(4 * i * cf * np.pi * t)) / np.exp(b * t)) ** 4
+    )
+
+    n = cf.shape[0]
+    fcoefs = np.zeros((n, 10))
+    fcoefs[:, 0] = a0
+    fcoefs[:, 1] = a11
+    fcoefs[:, 2] = a12
+    fcoefs[:, 3] = a13
+    fcoefs[:, 4] = a14
+    fcoefs[:, 5] = a2
+    fcoefs[:, 6] = b0
+    fcoefs[:, 7] = b1
+    fcoefs[:, 8] = b2
+    fcoefs[:, 9] = gain
+    return fcoefs

@@ -1,0 +1,12 @@
+"""Minimal stand-in for ``torchaudio``: just ``functional.filtering.lfilter``.
+
+The reference's SRMR uses torchaudio's batched IIR ``lfilter``
+(reference ``functional/audio/srmr.py:127-145,283-300``).  The shim delegates
+to ``scipy.signal.lfilter`` — an independent, widely-validated IIR
+implementation — per filter channel, with torchaudio's batching and clamping
+semantics on top.
+"""
+
+from . import functional  # noqa: F401
+
+__version__ = "2.5.0"

@@ -1,0 +1,2 @@
+from . import filtering  # noqa: F401
+from .filtering import lfilter  # noqa: F401

@@ -1,0 +1,36 @@
+"""Batched IIR filtering on top of scipy.signal.lfilter (see package docstring)."""
+
+import numpy as np
+import torch
+from scipy.signal import lfilter as _scipy_lfilter
+
+
+def lfilter(
+    waveform: torch.Tensor,
+    a_coeffs: torch.Tensor,
+    b_coeffs: torch.Tensor,
+    clamp: bool = True,
+    batching: bool = False,
+) -> torch.Tensor:
+    """torchaudio-compatible ``lfilter``.
+
+    ``waveform``: (..., C, T); ``a_coeffs``/``b_coeffs``: (C, n_taps) with the
+    filter for channel c applied along the last axis of channel c (batching
+    semantics — the reference only calls it with ``batching=True``).
+    """
+    if not batching:
+        raise NotImplementedError("shim supports the batching=True form the reference uses")
+    x = waveform.detach().cpu().numpy().astype(np.float64)
+    a = a_coeffs.detach().cpu().numpy().astype(np.float64)
+    b = b_coeffs.detach().cpu().numpy().astype(np.float64)
+    shape = x.shape
+    num_ch = shape[-2]
+    if a.shape[0] != num_ch:
+        raise ValueError(f"coefficient rows {a.shape[0]} != channel dim {num_ch}")
+    flat = x.reshape(-1, num_ch, shape[-1])
+    out = np.empty_like(flat)
+    for c in range(num_ch):
+        out[:, c] = _scipy_lfilter(b[c], a[c], flat[:, c], axis=-1)
+    if clamp:
+        out = np.clip(out, -1.0, 1.0)
+    return torch.from_numpy(out.reshape(shape)).to(waveform.dtype)

@@ -7,16 +7,16 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from tpumetrics.functional.audio.srmr import speech_reverberation_modulation_energy_ratio
+from tpumetrics.functional.audio.srmr import _srmr_arg_validate, speech_reverberation_modulation_energy_ratio
 from tpumetrics.metric import Metric
-from tpumetrics.utils.imports import _SRMRPY_AVAILABLE
 
 Array = jax.Array
 
 
 class SpeechReverberationModulationEnergyRatio(Metric):
-    """Mean SRMR over samples — gated on the host-side ``srmrpy`` package
-    (reference audio/srmr.py gates on ``gammatone``/``torchaudio``)."""
+    """Mean SRMR over samples — native gammatone + modulation filterbank
+    implementation, no external DSP packages (the reference audio/srmr.py
+    gates on ``gammatone``/``torchaudio``; see functional/audio/srmr.py)."""
 
     is_differentiable: bool = False
     higher_is_better: bool = True
@@ -30,11 +30,7 @@ class SpeechReverberationModulationEnergyRatio(Metric):
             if k in kwargs
         }
         super().__init__(**kwargs)
-        if not _SRMRPY_AVAILABLE:
-            raise ModuleNotFoundError(
-                "SpeechReverberationModulationEnergyRatio requires that `srmrpy` is installed."
-                " Install it with `pip install srmrpy`."
-            )
+        _srmr_arg_validate(fs, **self._srmr_kwargs)
         self.fs = fs
         self.add_state("sum_srmr", default=jnp.zeros(()), dist_reduce_fx="sum")
         self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")

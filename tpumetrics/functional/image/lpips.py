@@ -49,6 +49,38 @@ def lpips_head_weights(net_type: str) -> List[np.ndarray]:
     return [heads[k] for k in keys]
 
 
+def resolve_lpips_net(
+    net: Union[str, Callable],
+    backbone_params: Optional[Sequence] = None,
+    layer_weights: Optional[Sequence] = None,
+) -> Tuple[Callable, Optional[Sequence]]:
+    """Resolve a ``net`` spec into (backbone callable, layer weights).
+
+    A string net (``alex``/``vgg``/``squeeze``) requires ``backbone_params``
+    (offline-converted convs, see :mod:`tpumetrics.image._backbones`) and
+    defaults ``layer_weights`` to the bundled trained heads; a callable passes
+    through unchanged.  Shared by the functional and the Metric class."""
+    if isinstance(net, str):
+        from tpumetrics.image._backbones import lpips_backbone
+
+        if net not in ("alex", "vgg", "squeeze"):
+            raise ValueError(f"Argument `net_type` must be 'alex', 'vgg', 'squeeze' or a callable, got {net!r}")
+        if backbone_params is None:
+            raise ModuleNotFoundError(
+                f"LPIPS with the pretrained `{net}` backbone needs its conv weights, which cannot be"
+                " downloaded in an offline environment. Convert them once with torchvision (recipe in"
+                " tpumetrics.image._backbones) and pass them as `backbone_params`; the trained LPIPS"
+                " linear heads are bundled and applied automatically. Alternatively pass a callable"
+                " backbone."
+            )
+        if layer_weights is None:
+            layer_weights = lpips_head_weights(net)
+        net = lpips_backbone(net, backbone_params)
+    if not callable(net):
+        raise ValueError("Argument `net_type` must be a string or a callable backbone")
+    return net, layer_weights
+
+
 def _normalize_tensor(in_feat: Array, eps: float = 1e-8) -> Array:
     """Unit-normalize along the channel axis (reference lpips.py:219-222 —
     the eps lives inside the sqrt, following PerceptualSimilarity PR#114)."""
@@ -104,21 +136,7 @@ def learned_perceptual_image_patch_similarity(
         >>> float(learned_perceptual_image_patch_similarity(img1, img2, toy_net)) > 0
         True
     """
-    if isinstance(net, str):
-        from tpumetrics.image._backbones import lpips_backbone
-
-        if net not in ("alex", "vgg", "squeeze"):
-            raise ValueError(f"Argument `net` must be 'alex', 'vgg', 'squeeze' or a callable, got {net!r}")
-        if backbone_params is None:
-            raise ModuleNotFoundError(
-                f"LPIPS with the `{net}` backbone needs its pretrained conv weights, which cannot be"
-                " downloaded in an offline environment. Convert them once with torchvision (see"
-                " tpumetrics.image._backbones) and pass them as `backbone_params`; the trained"
-                " linear heads are bundled and applied automatically."
-            )
-        if layer_weights is None:
-            layer_weights = lpips_head_weights(net)
-        net = lpips_backbone(net, backbone_params)
+    net, layer_weights = resolve_lpips_net(net, backbone_params, layer_weights)
 
     if normalize:  # [0,1] -> [-1,1]
         img1 = 2 * img1 - 1

@@ -59,26 +59,9 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        if isinstance(net_type, str):
-            valid_net_type = ("vgg", "alex", "squeeze")
-            if net_type not in valid_net_type:
-                raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
-            if backbone_params is None:
-                raise ModuleNotFoundError(
-                    f"LPIPS with the pretrained `{net_type}` backbone needs its conv weights, which"
-                    " cannot be downloaded in an offline environment. Convert them once with"
-                    " torchvision (recipe in tpumetrics.image._backbones) and pass them as"
-                    " `backbone_params`; the trained LPIPS linear heads are bundled and applied"
-                    " automatically. Alternatively pass a callable backbone as `net_type`."
-                )
-            from tpumetrics.image._backbones import lpips_backbone
-            from tpumetrics.functional.image.lpips import lpips_head_weights
+        from tpumetrics.functional.image.lpips import resolve_lpips_net
 
-            if layer_weights is None:
-                layer_weights = lpips_head_weights(net_type)
-            net_type = lpips_backbone(net_type, backbone_params)
-        if not callable(net_type):
-            raise ValueError("Argument `net_type` must be a string or a callable backbone")
+        net_type, layer_weights = resolve_lpips_net(net_type, backbone_params, layer_weights)
         self.net = net_type
         valid_reduction = ("mean", "sum")
         if reduction not in valid_reduction:

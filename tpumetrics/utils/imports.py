@@ -55,7 +55,6 @@ _PIQ_GREATER_EQUAL_0_8 = package_available("piq")
 _PESQ_AVAILABLE = package_available("pesq")
 _PYSTOI_AVAILABLE = package_available("pystoi")
 _GAMMATONE_AVAILABLE = package_available("gammatone")
-_SRMRPY_AVAILABLE = package_available("srmrpy")
 _TORCHAUDIO_AVAILABLE = package_available("torchaudio")
 _SACREBLEU_AVAILABLE = package_available("sacrebleu")
 
