@@ -250,3 +250,45 @@ def test_compute_groups_randomized_sweep():
                 atol=1e-6,
                 err_msg=f"trial {trial}, metric {n}, groups {col.compute_groups}",
             )
+
+
+def test_establish_compute_groups_enables_functional_dedup():
+    """Pure-functional users get group dedup after one probe batch; the probe
+    must not touch accumulated state."""
+    from tpumetrics.classification import MulticlassAccuracy, MulticlassF1Score, MulticlassPrecision
+
+    col = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=3, validate_args=False),
+            "f1": MulticlassF1Score(num_classes=3, validate_args=False),
+            "prec": MulticlassPrecision(num_classes=3, validate_args=False),
+        }
+    )
+    p = jnp.asarray(np.random.default_rng(0).random((8, 3)), jnp.float32)
+    t = jnp.asarray([0, 1, 2, 0, 1, 2, 0, 1])
+
+    assert len(col._groups) == 3
+    col.establish_compute_groups(p, t)
+    assert len(col._groups) == 1  # all three share stat-scores state
+    # probe did not accumulate anything
+    assert all(m._update_count == 0 for m in col.values())
+
+    state = col.init_state()
+    assert len(state) == 1  # one leader state only
+    state = col.functional_update(state, p, t)
+    vals = col.functional_compute(state)
+    # equals the eager path on the same data
+    col2 = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=3, validate_args=False),
+            "f1": MulticlassF1Score(num_classes=3, validate_args=False),
+            "prec": MulticlassPrecision(num_classes=3, validate_args=False),
+        }
+    )
+    col2.update(p, t)
+    want = col2.compute()
+    for k in want:
+        np.testing.assert_allclose(np.asarray(vals[k]), np.asarray(want[k]), atol=1e-6)
+    # idempotent
+    col.establish_compute_groups(p, t)
+    assert len(col._groups) == 1

@@ -418,9 +418,46 @@ class MetricCollection:
 
     # ------------------------------------------------------ functional bridge
 
+    def establish_compute_groups(self, *args: Any, **kwargs: Any) -> None:
+        """Discover compute groups from ONE throwaway eager update on example
+        inputs, without touching accumulated state.
+
+        Group discovery is dynamic (value-identical states after an update,
+        reference collections.py:228-262), which the eager path does on its
+        first ``update``.  The functional path never updates eagerly, so a
+        pure-jit user would silently lose the dedup — call this once with a
+        representative batch before ``init_state`` (tracers can't be compared
+        by value, so discovery can't happen inside the compiled program)."""
+        if self._groups_checked:
+            return
+        saved = []
+        for m in self._modules.values():
+            states = {
+                s: (list(getattr(m, s)) if isinstance(getattr(m, s), list) else getattr(m, s))
+                for s in m._defaults
+            }
+            saved.append((states, m._update_count, m._computed))
+        try:
+            for m in self._modules.values():
+                m.update(*args, **m._filter_kwargs(**kwargs))
+            if self._enable_compute_groups:
+                self._merge_compute_groups()
+            self._groups_checked = True
+        finally:
+            for m, (states, update_count, computed) in zip(self._modules.values(), saved):
+                for s, v in states.items():
+                    object.__setattr__(m, s, v)
+                m._update_count = update_count
+                m._computed = computed
+        self._state_is_copy = False
+
     def init_state(self) -> Dict[str, Dict[str, Any]]:
         """Fresh per-metric state pytrees, deduplicated by compute group: only
-        group leaders carry state (name -> state dict)."""
+        group leaders carry state (name -> state dict).
+
+        Note: group discovery is dynamic — run one eager ``update`` or call
+        :meth:`establish_compute_groups` with a representative batch first,
+        otherwise every metric is its own group and no state is shared."""
         self._compute_groups_create_state_ref(copy=False)
         return {cg[0]: self._modules[cg[0]].init_state() for cg in self._groups.values()}
 

@@ -252,6 +252,12 @@ def speech_reverberation_modulation_energy_ratio(
             ``gammatone`` package's FFT approximation, which it itself warns
             is inconsistent); raises ``NotImplementedError``.
 
+    .. note:: with non-default ``min_cf``/``max_cf`` ranges whose fifth
+        modulation cutoff exceeds the signal's 90%-energy ERB bandwidth, the
+        reference raises at compute time; this implementation is jit-safe and
+        instead clamps the band selection to ``kstar=5`` (the smallest
+        denominator the protocol defines).
+
     Returns:
         SRMR score(s) with shape ``preds.shape[:-1]``.
 
@@ -305,16 +311,19 @@ def speech_reverberation_modulation_energy_ratio(
     num_frames = int(1 + (time - w_length) // w_inc)
     pad_t = max(ceil(time / w_inc) * w_inc - time, w_length - time)
     mod_pad = jnp.pad(mod_out, ((0, 0), (0, 0), (0, 0), (0, pad_t)))
-    total_frames = 1 + (mod_pad.shape[-1] - w_length) // w_inc
-    # frame extraction: strided gather (static shapes)
-    starts = np.arange(total_frames) * w_inc
-    idx = starts[:, None] + np.arange(w_length)[None, :]
-    frames = mod_pad[..., idx]  # (B, N, 8, total_frames, w_length)
-    # periodic hamming of length w_length+1 minus the last sample, like
-    # torch.hamming_window(w_length+1)[:-1] = symmetric(w_length+2)[:w_length]
+    # windowed energy = sum_k (x[t+k] w[k])^2 = (x^2 * w^2)[t] — a strided
+    # 1-D correlation, so no (…, frames, w_length) gather tensor is ever
+    # materialized (the overlap would cost w_length/w_inc = 4x mod_out's
+    # footprint; the conv needs none and maps onto the TPU conv units).
+    # window: periodic hamming of length w_length+1 minus the last sample,
+    # like torch.hamming_window(w_length+1)[:-1] = symmetric(w_length+2)[:w_length]
     window = jnp.asarray(np.hamming(w_length + 2)[:w_length], mod_pad.dtype)
-    # energy per frame, then frames transposed last: (B, N, 8, n_frames)
-    energy = jnp.sum((frames * window) ** 2, axis=-1)[..., :num_frames]
+    sq = (mod_pad**2).reshape(-1, 1, mod_pad.shape[-1])  # (B*N*8, 1 chan, T')
+    kernel = (window**2).reshape(1, 1, w_length)  # (out chan, in chan, K)
+    energy = lax.conv_general_dilated(
+        sq, kernel, window_strides=(w_inc,), padding="VALID",
+        dimension_numbers=("NCH", "OIH", "NCH"),
+    ).reshape(*mod_out.shape[:3], -1)[..., :num_frames]
     if norm:
         energy = _normalize_energy(energy)
 
@@ -329,7 +338,11 @@ def speech_reverberation_modulation_energy_ratio(
     bw = erbs_asc[k90perc_idx]  # (B,)
 
     cut = jnp.asarray(cutoffs)
-    # kstar in {5,..,8}: how many of the left cutoffs 5..7 lie at/below bw
+    # kstar in {5,..,8}: how many of the left cutoffs 5..7 lie at/below bw.
+    # Divergence note: when bw < cutoffs[4] (possible only with non-default
+    # min_cf/max_cf ranges) the reference raises at compute time; raising on
+    # a data-dependent value is impossible under jit, so this clamps to
+    # kstar=5 instead (documented in the docstring).
     kstar = 5 + jnp.sum(cut[None, 5:8] <= bw[:, None], axis=-1)  # (B,)
     band_idx = jnp.arange(8)
     num_energy = jnp.sum(jnp.where(band_idx[None, None, :] < 4, avg_energy, 0.0), axis=(1, 2))

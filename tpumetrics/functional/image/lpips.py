@@ -53,18 +53,21 @@ def resolve_lpips_net(
     net: Union[str, Callable],
     backbone_params: Optional[Sequence] = None,
     layer_weights: Optional[Sequence] = None,
+    arg_name: str = "net_type",
 ) -> Tuple[Callable, Optional[Sequence]]:
     """Resolve a ``net`` spec into (backbone callable, layer weights).
 
     A string net (``alex``/``vgg``/``squeeze``) requires ``backbone_params``
     (offline-converted convs, see :mod:`tpumetrics.image._backbones`) and
     defaults ``layer_weights`` to the bundled trained heads; a callable passes
-    through unchanged.  Shared by the functional and the Metric class."""
+    through unchanged.  Shared by the functional (``arg_name="net"``) and the
+    Metric class (``arg_name="net_type"``) so errors name the caller's
+    parameter."""
     if isinstance(net, str):
         from tpumetrics.image._backbones import lpips_backbone
 
         if net not in ("alex", "vgg", "squeeze"):
-            raise ValueError(f"Argument `net_type` must be 'alex', 'vgg', 'squeeze' or a callable, got {net!r}")
+            raise ValueError(f"Argument `{arg_name}` must be 'alex', 'vgg', 'squeeze' or a callable, got {net!r}")
         if backbone_params is None:
             raise ModuleNotFoundError(
                 f"LPIPS with the pretrained `{net}` backbone needs its conv weights, which cannot be"
@@ -77,7 +80,7 @@ def resolve_lpips_net(
             layer_weights = lpips_head_weights(net)
         net = lpips_backbone(net, backbone_params)
     if not callable(net):
-        raise ValueError("Argument `net_type` must be a string or a callable backbone")
+        raise ValueError(f"Argument `{arg_name}` must be a string or a callable backbone")
     return net, layer_weights
 
 
@@ -136,7 +139,7 @@ def learned_perceptual_image_patch_similarity(
         >>> float(learned_perceptual_image_patch_similarity(img1, img2, toy_net)) > 0
         True
     """
-    net, layer_weights = resolve_lpips_net(net, backbone_params, layer_weights)
+    net, layer_weights = resolve_lpips_net(net, backbone_params, layer_weights, arg_name="net")
 
     if normalize:  # [0,1] -> [-1,1]
         img1 = 2 * img1 - 1
