@@ -11,9 +11,22 @@ Covers the BASELINE.md target configs:
 - text.BERTScore under emulated 4-rank DDP: rank-strided updates, state
   merge, one batched embed+score (multi-host/DCN-scale stand-in)
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "details"}.
-``vs_baseline`` = reference_us / ours_us (higher is better; >1 means faster
-than the reference).
+Methodology (VERDICT r2 weak #4): every config is measured as
+**interleaved min-of-k** — ours and the torch-CPU reference alternate inside
+one process, and the minimum over rounds is reported — so the tunneled chip's
+~2x run-to-run variance and ambient host load cannot fake a regression or a
+win.  (Exception: collection_sync_8dev's "ours" needs its own CPU-mesh
+subprocess, so there ours and the reference each take an internal min-of-3
+without alternation.)  The reference side runs the mounted reference
+implementation where it can run offline (shimmed deps), and an equivalent
+hand-written torch step where it cannot (noted per config).  A failing
+reference side never discards the "ours" measurement — each ref setup is
+exception-guarded to None.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "details"};
+each details entry is {"us", "ref_us", "vs_baseline"}.  ``vs_baseline`` =
+reference_us / ours_us (higher is better; >1 means faster than the
+reference).
 """
 
 from __future__ import annotations
@@ -30,8 +43,44 @@ BATCH = 8192
 NUM_CLASSES = 128
 STEPS = 50
 
+_REPO = os.path.dirname(os.path.abspath(__file__))
+_SHIMS = os.path.join(_REPO, "tests", "reference_parity", "_shims")
+_REF_SRC = "/root/reference/src"
 
-def _bench_tpumetrics() -> float:
+
+def _ensure_reference_importable() -> bool:
+    if not os.path.isdir(_REF_SRC):
+        return False
+    for p in (_SHIMS, _REF_SRC):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    return True
+
+
+def _interleaved(ours_once, ref_once, rounds: int = 3):
+    """Alternate ours/reference measurements in one process; min over rounds."""
+    ours_times, ref_times = [], []
+    for _ in range(rounds):
+        ours_times.append(ours_once())
+        if ref_once is not None:
+            ref_times.append(ref_once())
+    ours = min(ours_times)
+    ref = min(ref_times) if ref_times else None
+    return ours, ref
+
+
+def _entry(ours_us, ref_us):
+    out = {"us": round(ours_us, 2)}
+    if ref_us is not None:
+        out["ref_us"] = round(ref_us, 2)
+        out["vs_baseline"] = round(ref_us / ours_us, 3)
+    return out
+
+
+# ------------------------------------------------------------------ headline
+
+
+def _make_ours_accuracy():
     import jax
     import jax.numpy as jnp
 
@@ -43,55 +92,57 @@ def _bench_tpumetrics() -> float:
         new_state = metric.functional_update(state, preds, target)
         return new_state, metric.functional_compute(new_state)
 
-    step = jax.jit(step, donate_argnums=(0,))
+    step = jax.jit(step)
 
     rng = np.random.default_rng(0)
     preds = jnp.asarray(rng.standard_normal((BATCH, NUM_CLASSES), dtype=np.float32))
     target = jnp.asarray(rng.integers(0, NUM_CLASSES, size=(BATCH,)), dtype=jnp.int32)
-
-    state = metric.init_state()
-    state, val = step(state, preds, target)  # compile
+    state0 = metric.init_state()
+    _, val = step(state0, preds, target)  # compile
     jax.block_until_ready(val)
 
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        state, val = step(state, preds, target)
-    jax.block_until_ready(val)
-    t1 = time.perf_counter()
-    return (t1 - t0) / STEPS * 1e6  # us/step
+    def run_once():
+        state = state0
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            state, val = step(state, preds, target)
+        jax.block_until_ready(val)
+        return (time.perf_counter() - t0) / STEPS * 1e6
+
+    return run_once
 
 
-def _bench_reference() -> float:
-    """Time the reference TorchMetrics MulticlassAccuracy (torch CPU); falls
-    back to an equivalent hand-written torch update+compute step when the
-    reference's deps (lightning_utilities) are absent."""
+def _make_ref_accuracy():
+    """The reference MulticlassAccuracy on torch CPU (same batch/classes)."""
     import torch
 
     rng = np.random.default_rng(0)
     preds = torch.from_numpy(rng.standard_normal((BATCH, NUM_CLASSES), dtype=np.float32))
     target = torch.from_numpy(rng.integers(0, NUM_CLASSES, size=(BATCH,)).astype(np.int64))
 
-    try:
-        sys.path.insert(0, "/root/reference/src")
-        from torchmetrics.classification import MulticlassAccuracy as RefAccuracy
+    if _ensure_reference_importable():
+        try:
+            from torchmetrics.classification import MulticlassAccuracy as RefAccuracy
 
-        metric = RefAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
-        metric.update(preds, target)  # warmup
-        metric.compute()
-        metric.reset()
-
-        t0 = time.perf_counter()
-        for _ in range(STEPS):
-            metric.update(preds, target)
-            metric._computed = None
+            metric = RefAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+            metric.update(preds, target)  # warmup
             metric.compute()
-        t1 = time.perf_counter()
-        return (t1 - t0) / STEPS * 1e6  # us/step
-    except Exception:
-        pass
+
+            def run_once():
+                metric.reset()
+                t0 = time.perf_counter()
+                for _ in range(STEPS):
+                    metric.update(preds, target)
+                    metric._computed = None
+                    metric.compute()
+                return (time.perf_counter() - t0) / STEPS * 1e6
+
+            return run_once
+        except Exception:
+            pass
 
     # equivalent torch step: argmax -> bincount confusion counts -> micro acc
-    def step(tp, total, preds, target):
+    def step(tp, total):
         labels = preds.argmax(dim=1)
         counts = torch.bincount(target * NUM_CLASSES + labels, minlength=NUM_CLASSES * NUM_CLASSES)
         confmat = counts.reshape(NUM_CLASSES, NUM_CLASSES)
@@ -99,15 +150,20 @@ def _bench_reference() -> float:
         total = total + target.numel()
         return tp, total, tp.float() / total.float()
 
-    tp = torch.zeros((), dtype=torch.long)
-    total = torch.zeros((), dtype=torch.long)
-    step(tp, total, preds, target)  # warmup
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        tp, total, val = step(tp, total, preds, target)
-    t1 = time.perf_counter()
-    return (t1 - t0) / STEPS * 1e6  # us/step
+    step(torch.zeros((), dtype=torch.long), torch.zeros((), dtype=torch.long))  # warmup
 
+    def run_once():
+        tp = torch.zeros((), dtype=torch.long)
+        total = torch.zeros((), dtype=torch.long)
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            tp, total, val = step(tp, total)
+        return (time.perf_counter() - t0) / STEPS * 1e6
+
+    return run_once
+
+
+# ------------------------------------------------- collection w/ 8-dev sync
 
 _COLLECTION_SYNC_SCRIPT = r"""
 import os, sys, time, json
@@ -121,7 +177,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tpumetrics import MetricCollection
 from tpumetrics.classification import MulticlassAccuracy, MulticlassF1Score, MulticlassAUROC
 
-C, B, STEPS = 16, 1024, 20
+C, B, STEPS, ROUNDS = 16, 1024, 20, 3
 col = MetricCollection({
     "acc": MulticlassAccuracy(num_classes=C, average="micro", validate_args=False),
     "f1": MulticlassF1Score(num_classes=C, average="macro", validate_args=False),
@@ -130,95 +186,175 @@ col = MetricCollection({
 mesh = Mesh(np.asarray(jax.devices()[:8]), ("dp",))
 
 def sharded_step(state, preds, target):
-    # dist_sync_on_step: accumulate locally, sync in-trace, return batch vals
     new_state, vals = col.functional_forward(state, preds, target, axis_name="dp")
     return new_state, vals
 
+rng = np.random.default_rng(0)
+preds = jnp.asarray(jax.nn.softmax(jnp.asarray(rng.standard_normal((B, C), dtype=np.float32))))
+target = jnp.asarray(rng.integers(0, C, size=(B,)), dtype=jnp.int32)
+col.establish_compute_groups(preds[:8], target[:8])
 step = jax.jit(
     jax.shard_map(
         sharded_step, mesh=mesh,
         in_specs=(P(), P("dp"), P("dp")), out_specs=(P(), P()),
         check_vma=False,
     ),
-    donate_argnums=(0,),
 )
-rng = np.random.default_rng(0)
-preds = jnp.asarray(jax.nn.softmax(jnp.asarray(rng.standard_normal((B, C), dtype=np.float32))))
-target = jnp.asarray(rng.integers(0, C, size=(B,)), dtype=jnp.int32)
-state = col.init_state()
-state, vals = step(state, preds, target)
+state0 = col.init_state()
+state, vals = step(state0, preds, target)
 jax.block_until_ready(vals)
-t0 = time.perf_counter()
-for _ in range(STEPS):
-    state, vals = step(state, preds, target)
-jax.block_until_ready(vals)
-t1 = time.perf_counter()
-print(json.dumps({"us_per_step": (t1 - t0) / STEPS * 1e6}))
+times = []
+for _ in range(ROUNDS):
+    state = state0
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, vals = step(state, preds, target)
+    jax.block_until_ready(vals)
+    times.append((time.perf_counter() - t0) / STEPS * 1e6)
+print(json.dumps({"us_per_step": min(times)}))
 """
 
 
-def _bench_collection_sync_8dev() -> float:
-    """Per-step latency of MetricCollection(Accuracy, F1, AUROC) with
-    in-trace cross-device sync (dist_sync_on_step) over an 8-device mesh.
-    Runs in a subprocess because the parent owns the TPU backend."""
+def _bench_collection_sync_8dev():
+    """Ours: per-step MetricCollection forward with in-trace 8-device sync
+    (subprocess owns a CPU mesh).  Reference: the same collection's eager
+    ``forward`` on torch CPU over the same global batch — its per-step cost
+    WITHOUT any cross-process sync (gloo can't run here), i.e. a lower bound
+    for the reference."""
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
-    script = _COLLECTION_SYNC_SCRIPT.replace(
-        "{repo_dir!r}", repr(os.path.dirname(os.path.abspath(__file__)))
-    )
+    script = _COLLECTION_SYNC_SCRIPT.replace("{repo_dir!r}", repr(_REPO))
     out = subprocess.run(
-        [sys.executable, "-c", script],
-        capture_output=True, text=True, timeout=300, env=env,
+        [sys.executable, "-c", script], capture_output=True, text=True, timeout=600, env=env
     )
     if out.returncode != 0:
         raise RuntimeError(out.stderr[-2000:])
-    return float(json.loads(out.stdout.strip().splitlines()[-1])["us_per_step"])
+    ours = float(json.loads(out.stdout.strip().splitlines()[-1])["us_per_step"])
+
+    ref = None
+    try:
+        if not _ensure_reference_importable():
+            raise ImportError("reference tree unavailable")
+        import torch
+        from torchmetrics import MetricCollection as RefCollection
+        from torchmetrics.classification import (
+            MulticlassAccuracy as RefAcc,
+            MulticlassAUROC as RefAUROC,
+            MulticlassF1Score as RefF1,
+        )
+
+        C, B, steps = 16, 1024, 20
+        col = RefCollection(
+            {
+                "acc": RefAcc(num_classes=C, average="micro", validate_args=False),
+                "f1": RefF1(num_classes=C, average="macro", validate_args=False),
+                "auroc": RefAUROC(num_classes=C, validate_args=False, thresholds=64),
+            }
+        )
+        rng = np.random.default_rng(0)
+        preds = torch.softmax(torch.from_numpy(rng.standard_normal((B, C), dtype=np.float32)), dim=1)
+        target = torch.from_numpy(rng.integers(0, C, size=(B,)).astype(np.int64))
+        col.forward(preds, target)  # warmup + group discovery
+        times = []
+        for _ in range(3):
+            col.reset()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                col.forward(preds, target)
+            times.append((time.perf_counter() - t0) / steps * 1e6)
+        ref = min(times)
+    except Exception:
+        ref = None
+    return ours, ref
 
 
-def _bench_map() -> float:
-    """MeanAveragePrecision update+compute on synthetic detections — the
-    ragged-state path (variable boxes per image)."""
-    import jax.numpy as jnp
+# ------------------------------------------------------------------------ mAP
 
-    from tpumetrics.detection import MeanAveragePrecision
 
+def _map_corpus():
     rng = np.random.default_rng(0)
-    n_imgs, steps = 16, 5
+    n_imgs = 16
 
     def boxes(n):
         xy = rng.uniform(0, 80, size=(n, 2))
         wh = rng.uniform(4, 20, size=(n, 2))
-        return np.concatenate([xy, xy + wh], axis=1)
+        return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
 
     preds, target = [], []
-    for i in range(n_imgs):
+    for _ in range(n_imgs):
         nd, ng = int(rng.integers(3, 12)), int(rng.integers(2, 8))
-        preds.append({
-            "boxes": jnp.asarray(boxes(nd), jnp.float32),
-            "scores": jnp.asarray(rng.uniform(0.1, 1.0, nd), jnp.float32),
-            "labels": jnp.asarray(rng.integers(0, 4, nd), jnp.int32),
-        })
-        target.append({
-            "boxes": jnp.asarray(boxes(ng), jnp.float32),
-            "labels": jnp.asarray(rng.integers(0, 4, ng), jnp.int32),
-        })
+        preds.append(
+            {
+                "boxes": boxes(nd),
+                "scores": rng.uniform(0.1, 1.0, nd).astype(np.float32),
+                "labels": rng.integers(0, 4, nd).astype(np.int64),
+            }
+        )
+        target.append({"boxes": boxes(ng), "labels": rng.integers(0, 4, ng).astype(np.int64)})
+    return preds, target
+
+
+def _bench_map():
+    """MeanAveragePrecision update+compute (ragged-state path). Reference:
+    the mounted reference's pure-torch ``_mean_ap`` on the same corpus (its
+    pycocotools backend cannot run offline; ``_mean_ap`` is the reference's
+    own all-torch implementation)."""
+    import jax.numpy as jnp
+
+    from tpumetrics.detection import MeanAveragePrecision
+
+    preds_np, target_np = _map_corpus()
+    preds = [{k: jnp.asarray(v) for k, v in p.items()} for p in preds_np]
+    target = [{k: jnp.asarray(v) for k, v in t.items()} for t in target_np]
+    steps = 5
 
     m = MeanAveragePrecision()
     m.update(preds, target)  # warmup (traces IoU kernels)
     m.compute()
-    m.reset()
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        m.update(preds, target)
-        m.compute()
-        m.reset()  # fixed 16-image cost per step
-    t1 = time.perf_counter()
-    return (t1 - t0) / steps * 1e6
+
+    def ours_once():
+        m.reset()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            m.update(preds, target)
+            m.compute()
+            m.reset()
+        return (time.perf_counter() - t0) / steps * 1e6
+
+    ref_once = None
+    try:
+        if not _ensure_reference_importable():
+            raise ImportError("reference tree unavailable")
+        import torch
+        from torchmetrics.detection._mean_ap import MeanAveragePrecision as RefMAP
+
+        tpreds = [{k: torch.from_numpy(v) for k, v in p.items()} for p in preds_np]
+        ttarget = [{k: torch.from_numpy(v) for k, v in t.items()} for t in target_np]
+        rm = RefMAP()
+        rm.update(tpreds, ttarget)
+        rm.compute()
+
+        def ref_once():
+            rm.reset()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                rm.update(tpreds, ttarget)
+                rm.compute()
+                rm.reset()
+            return (time.perf_counter() - t0) / steps * 1e6
+
+    except Exception:
+        ref_once = None
+
+    return _interleaved(ours_once, ref_once, rounds=2)
 
 
-def _bench_fid() -> float:
-    """FID streaming update throughput with a deterministic extractor —
-    exercises the large feature-state accumulation path."""
+# ------------------------------------------------------------------------ FID
+
+
+def _bench_fid():
+    """FID streaming update with a deterministic extractor on both sides
+    (the reference accepts any ``nn.Module`` as ``feature``)."""
     import jax
     import jax.numpy as jnp
 
@@ -226,38 +362,86 @@ def _bench_fid() -> float:
 
     dim, batch, steps = 256, 128, 20
     rng = np.random.default_rng(0)
-    proj = jnp.asarray(rng.standard_normal((3 * 32 * 32, dim), dtype=np.float32))
+    proj_np = rng.standard_normal((3 * 32 * 32, dim)).astype(np.float32)
+    proj = jnp.asarray(proj_np)
 
     def extractor(imgs):
         flat = imgs.reshape(imgs.shape[0], -1).astype(jnp.float32)
         return jnp.tanh(flat @ proj)
 
+    real_np = rng.integers(0, 255, size=(batch, 3, 32, 32)).astype(np.uint8)
+    fake_np = rng.integers(0, 255, size=(batch, 3, 32, 32)).astype(np.uint8)
+
     m = FrechetInceptionDistance(feature=extractor, num_features=dim)
-    real = jnp.asarray(rng.integers(0, 255, size=(batch, 3, 32, 32)), jnp.uint8)
-    fake = jnp.asarray(rng.integers(0, 255, size=(batch, 3, 32, 32)), jnp.uint8)
+    real = jnp.asarray(real_np)
+    fake = jnp.asarray(fake_np)
     m.update(real, real=True)  # warmup
     m.update(fake, real=False)
     jax.block_until_ready(m.real_features_sum)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        m.update(real, real=True)
-        m.update(fake, real=False)
-    jax.block_until_ready(m.real_features_sum)
-    t1 = time.perf_counter()
-    return (t1 - t0) / steps * 1e6
+
+    def ours_once():
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            m.update(real, real=True)
+            m.update(fake, real=False)
+        jax.block_until_ready(m.real_features_sum)
+        return (time.perf_counter() - t0) / steps * 1e6
+
+    ref_once = None
+    try:
+        if not _ensure_reference_importable():
+            raise ImportError("reference tree unavailable")
+        import torch
+        from torchmetrics.image.fid import FrechetInceptionDistance as RefFID
+
+        class TorchExtractor(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.proj = torch.nn.Parameter(torch.from_numpy(proj_np), requires_grad=False)
+
+            def forward(self, imgs):
+                # truncate so the ref's 299x299 num_features probe image also
+                # works; for the real 3x32x32 batches flat is exactly 3072
+                flat = imgs.reshape(imgs.shape[0], -1).float()[:, : self.proj.shape[0]]
+                return torch.tanh(flat @ self.proj)
+
+        rm = RefFID(feature=TorchExtractor())
+        treal = torch.from_numpy(real_np)
+        tfake = torch.from_numpy(fake_np)
+        rm.update(treal, real=True)
+        rm.update(tfake, real=False)
+
+        def ref_once():
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                rm.update(treal, real=True)
+                rm.update(tfake, real=False)
+            return (time.perf_counter() - t0) / steps * 1e6
+
+    except Exception:
+        ref_once = None
+
+    return _interleaved(ours_once, ref_once, rounds=3)
 
 
-def _bench_lpips() -> float:
-    """LPIPS streaming update with a deterministic conv backbone — exercises
-    the feature-distance accumulation path (BASELINE 'FID + LPIPS' config)."""
+# ---------------------------------------------------------------------- LPIPS
+
+
+def _bench_lpips():
+    """LPIPS streaming update with the same deterministic conv backbone on
+    both sides (pretrained torchvision backbones can't load offline, so the
+    reference side is the equivalent hand-written torch LPIPS step: same
+    convs, same unit-normalize/diff/spatial-average formula)."""
     import jax
     import jax.numpy as jnp
 
     from tpumetrics.image import LearnedPerceptualImagePatchSimilarity
 
     rng = np.random.default_rng(0)
-    k1 = jnp.asarray(rng.standard_normal((16, 3, 3, 3), dtype=np.float32) * 0.1)
-    k2 = jnp.asarray(rng.standard_normal((32, 16, 3, 3), dtype=np.float32) * 0.1)
+    k1_np = (rng.standard_normal((16, 3, 3, 3)) * 0.1).astype(np.float32)
+    k2_np = (rng.standard_normal((32, 16, 3, 3)) * 0.1).astype(np.float32)
+    k1 = jnp.asarray(k1_np)
+    k2 = jnp.asarray(k2_np)
 
     def backbone(x):
         h1 = jax.nn.relu(jax.lax.conv_general_dilated(x, k1, (2, 2), "SAME"))
@@ -266,73 +450,192 @@ def _bench_lpips() -> float:
 
     m = LearnedPerceptualImagePatchSimilarity(net_type=backbone)
     batch, steps = 64, 20
-    img1 = jnp.asarray(rng.uniform(-1, 1, (batch, 3, 64, 64)), jnp.float32)
-    img2 = jnp.asarray(rng.uniform(-1, 1, (batch, 3, 64, 64)), jnp.float32)
+    img1_np = rng.uniform(-1, 1, (batch, 3, 64, 64)).astype(np.float32)
+    img2_np = rng.uniform(-1, 1, (batch, 3, 64, 64)).astype(np.float32)
+    img1 = jnp.asarray(img1_np)
+    img2 = jnp.asarray(img2_np)
     m.update(img1, img2)  # warmup
     jax.block_until_ready(m.sum_scores)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        m.update(img1, img2)
-    jax.block_until_ready(m.sum_scores)
-    t1 = time.perf_counter()
-    return (t1 - t0) / steps * 1e6
+
+    def ours_once():
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            m.update(img1, img2)
+        jax.block_until_ready(m.sum_scores)
+        return (time.perf_counter() - t0) / steps * 1e6
+
+    import torch
+    import torch.nn.functional as F
+
+    tk1 = torch.from_numpy(k1_np)
+    tk2 = torch.from_numpy(k2_np)
+    ti1 = torch.from_numpy(img1_np)
+    ti2 = torch.from_numpy(img2_np)
+    shift = torch.tensor([-0.030, -0.088, -0.188]).view(1, 3, 1, 1)
+    scale = torch.tensor([0.458, 0.448, 0.450]).view(1, 3, 1, 1)
+
+    def t_backbone(x):
+        h1 = F.relu(F.conv2d(x, tk1, stride=2, padding=1))
+        h2 = F.relu(F.conv2d(h1, tk2, stride=2, padding=1))
+        return [h1, h2]
+
+    def t_lpips_sum(a, b):
+        fa = t_backbone((a - shift) / scale)
+        fb = t_backbone((b - shift) / scale)
+        total = 0.0
+        for x, y in zip(fa, fb):
+            xn = x / torch.sqrt(1e-8 + (x**2).sum(dim=1, keepdim=True))
+            yn = y / torch.sqrt(1e-8 + (y**2).sum(dim=1, keepdim=True))
+            total = total + ((xn - yn) ** 2).mean(dim=1, keepdim=True).mean(dim=(2, 3)).sum()
+        return total
+
+    with torch.no_grad():
+        t_lpips_sum(ti1, ti2)  # warmup
+
+    def ref_once():
+        acc = 0.0
+        t0 = time.perf_counter()
+        with torch.no_grad():
+            for _ in range(steps):
+                acc = acc + t_lpips_sum(ti1, ti2)
+        return (time.perf_counter() - t0) / steps * 1e6
+
+    return _interleaved(ours_once, ref_once, rounds=3)
 
 
-def _bench_bertscore_ddp() -> float:
-    """BERTScore under emulated DDP: 4 rank-strided replicas with a
-    deterministic embedder, states merged then computed once (BASELINE
-    'BERTScore under DDP' config — multi-host merge + batched embed)."""
+# ------------------------------------------------------------------ BERTScore
+
+
+def _bertscore_fixture():
+    """A transformer-scale embedder (token embedding + 4 dense layers,
+    d=512): the BASELINE config is 'BERTScore under DDP', whose cost in the
+    reference is the model forward — a toy lookup embedder would benchmark
+    host/tunnel latency instead of the workload."""
+    rng = np.random.default_rng(0)
+    vocab = [f"tok{i}" for i in range(64)]
+
+    def sentences(n):
+        return [" ".join(rng.choice(vocab, size=rng.integers(6, 20))) for _ in range(n)]
+
+    word_ids = {w: i + 1 for i, w in enumerate(vocab)}
+    d = 512
+    weights = {
+        "emb": (rng.standard_normal((len(vocab) + 2, d)) * 0.1).astype(np.float32),
+        "layers": [(rng.standard_normal((d, d)) * (1.0 / np.sqrt(d))).astype(np.float32) for _ in range(4)],
+    }
+    world, steps, per_rank = 4, 8, 64
+    preds = [sentences(per_rank) for _ in range(world * steps)]
+    target = [sentences(per_rank) for _ in range(world * steps)]
+    return word_ids, weights, world, steps, per_rank, preds, target
+
+
+def _bench_bertscore_ddp():
+    """BERTScore under emulated DDP on both sides: 4 rank-strided replicas
+    with the SAME deterministic embedder (the reference supports
+    user_tokenizer/user_forward_fn), merged, one batched embed+score."""
     import jax.numpy as jnp
 
     from tpumetrics.text import BERTScore
 
-    rng = np.random.default_rng(0)
-    vocab = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta", "theta"]
+    word_ids, weights, world, steps, per_rank, preds, target = _bertscore_fixture()
+    emb = jnp.asarray(weights["emb"])
+    layers = [jnp.asarray(w) for w in weights["layers"]]
 
-    def sentences(n):
-        return [" ".join(rng.choice(vocab, size=rng.integers(3, 9))) for _ in range(n)]
-
-    word_ids = {w: i + 1 for i, w in enumerate(vocab)}  # deterministic ids
-
-    def tokenizer(batch, max_length=16):
+    def tokenizer(batch, max_length=24):
         ids = np.zeros((len(batch), max_length), np.int32)
         mask = np.zeros((len(batch), max_length), np.int32)
         for i, s in enumerate(batch):
             toks = [word_ids[w] for w in s.split()][:max_length]
             ids[i, : len(toks)] = toks
             mask[i, : len(toks)] = 1
-        return {"input_ids": jnp.asarray(ids), "attention_mask": jnp.asarray(mask)}
-
-    emb = jnp.asarray(rng.standard_normal((98, 32), dtype=np.float32))
+        return {"input_ids": ids, "attention_mask": mask}
 
     def forward_fn(model, batch):
-        return emb[batch["input_ids"]]
-
-    world, steps, per_rank = 4, 8, 32
-    preds = [sentences(per_rank) for _ in range(world * steps)]
-    target = [sentences(per_rank) for _ in range(world * steps)]
+        h = emb[jnp.asarray(batch["input_ids"])]
+        for w in layers:
+            h = jnp.tanh(h @ w)
+        return h
 
     def make():
         return BERTScore(model=object(), user_tokenizer=tokenizer, user_forward_fn=forward_fn)
 
     make().update(preds[0], target[0])  # warm tokenizer path
-    t0 = time.perf_counter()
-    replicas = [make() for _ in range(world)]
-    for rank, m in enumerate(replicas):
-        for i in range(rank, world * steps, world):
-            m.update(preds[i], target[i])
-    # sentence states are host-side Python lists (device sync is refused for
-    # them, tpumetrics/text/_sentence_state.py) — the multi-host analogue is
-    # an all_gather_object of the sentences, emulated here by concatenation,
-    # followed by ONE batched embed+score over the union
-    combined = make()
-    for m in replicas:
-        combined.update(m._preds, m._target)
-    out = combined.compute()
-    f1 = np.asarray(out["f1"])
-    assert f1.shape[0] == world * steps * per_rank, f1.shape
-    t1 = time.perf_counter()
-    return (t1 - t0) * 1e6  # us for the full merged evaluation
+
+    def ours_once():
+        t0 = time.perf_counter()
+        replicas = [make() for _ in range(world)]
+        for rank, m in enumerate(replicas):
+            for i in range(rank, world * steps, world):
+                m.update(preds[i], target[i])
+        # sentence states are host-side Python lists (device sync is refused
+        # for them, tpumetrics/text/_sentence_state.py) — the multi-host
+        # analogue is an all_gather_object of the sentences, emulated by
+        # concatenation, followed by ONE batched embed+score over the union
+        combined = make()
+        for m in replicas:
+            combined.update(*m.sentence_state)
+        out = combined.compute()
+        f1 = np.asarray(out["f1"])
+        assert f1.shape[0] == world * steps * per_rank, f1.shape
+        return (time.perf_counter() - t0) * 1e6
+
+    ref_once = None
+    if _ensure_reference_importable():
+        import torch
+        from torchmetrics.text.bert import BERTScore as RefBERTScore
+
+        temb = torch.from_numpy(weights["emb"])
+        tlayers = [torch.from_numpy(w) for w in weights["layers"]]
+
+        def t_tokenizer(batch, max_length=24):
+            ids = np.zeros((len(batch), max_length), np.int64)
+            mask = np.zeros((len(batch), max_length), np.int64)
+            for i, s in enumerate(batch):
+                toks = [word_ids[w] for w in s.split()][:max_length]
+                ids[i, : len(toks)] = toks
+                mask[i, : len(toks)] = 1
+            return {"input_ids": torch.from_numpy(ids), "attention_mask": torch.from_numpy(mask)}
+
+        def t_forward_fn(model, batch):
+            with torch.no_grad():
+                h = temb[batch["input_ids"]]
+                for w in tlayers:
+                    h = torch.tanh(h @ w)
+            return h
+
+        def ref_make():
+            return RefBERTScore(
+                model=torch.nn.Identity(), user_tokenizer=t_tokenizer, user_forward_fn=t_forward_fn
+            )
+
+        try:
+            ref_make().update(preds[0], target[0])
+
+            def ref_once():
+                t0 = time.perf_counter()
+                replicas = [ref_make() for _ in range(world)]
+                rank_texts = [([], []) for _ in range(world)]
+                for rank, m in enumerate(replicas):
+                    for i in range(rank, world * steps, world):
+                        m.update(preds[i], target[i])
+                        rank_texts[rank][0].extend(preds[i])
+                        rank_texts[rank][1].extend(target[i])
+                # the reference stores tokenized tensors; the multi-host merge
+                # analogue is an object-gather of the raw sentences, emulated
+                # by re-feeding each rank's text into one combined metric
+                combined = ref_make()
+                for ptexts, ttexts in rank_texts:
+                    combined.update(ptexts, ttexts)
+                out = combined.compute()
+                f1 = out["f1"]
+                n = len(f1) if not hasattr(f1, "numel") else f1.numel()
+                assert n == world * steps * per_rank
+                return (time.perf_counter() - t0) * 1e6
+
+        except Exception:
+            ref_once = None
+
+    return _interleaved(ours_once, ref_once, rounds=2)
 
 
 def _enable_compilation_cache() -> None:
@@ -341,7 +644,7 @@ def _enable_compilation_cache() -> None:
     any long-lived production process."""
     import jax
 
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    cache_dir = os.path.join(_REPO, ".jax_cache")
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
@@ -349,25 +652,28 @@ def _enable_compilation_cache() -> None:
 
 def main() -> None:
     _enable_compilation_cache()
-    ours_us = _bench_tpumetrics()
+
+    # headline: interleaved min-of-5
     try:
-        ref_us = _bench_reference()
-        vs_baseline = round(ref_us / ours_us, 3)
+        ref_run = _make_ref_accuracy()
     except Exception:
-        vs_baseline = None  # baseline unavailable — not a measured tie
+        ref_run = None
+    ours_us, ref_us = _interleaved(_make_ours_accuracy(), ref_run, rounds=5)
+    vs_baseline = round(ref_us / ours_us, 3) if ref_us is not None else None
 
     details = {}
     for name, fn in (
-        ("collection_sync_8dev_us", _bench_collection_sync_8dev),
-        ("map_ragged_update_compute_us", _bench_map),
-        ("fid_stream_update_us", _bench_fid),
-        ("lpips_stream_update_us", _bench_lpips),
-        ("bertscore_ddp_eval_us", _bench_bertscore_ddp),
+        ("collection_sync_8dev", _bench_collection_sync_8dev),
+        ("map_ragged_update_compute", _bench_map),
+        ("fid_stream_update", _bench_fid),
+        ("lpips_stream_update", _bench_lpips),
+        ("bertscore_ddp_eval", _bench_bertscore_ddp),
     ):
         try:
-            details[name] = round(fn(), 2)
+            ours, ref = fn()
+            details[name] = _entry(ours, ref)
         except Exception as err:  # sub-bench failure must not kill the headline
-            details[name] = f"error: {type(err).__name__}"
+            details[name] = f"error: {type(err).__name__}: {err}"
 
     print(
         json.dumps(

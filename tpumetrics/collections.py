@@ -110,25 +110,34 @@ class MetricCollection:
     def _merge_compute_groups(self) -> None:
         """Merge groups whose leaders hold value-identical states — O(n²)
         pairwise comparison after the first update (reference collections.py:228-262)."""
-        num_groups = len(self._groups)
+        self._groups = self._merged_groups(self._groups, self._modules)
+
+    @classmethod
+    def _merged_groups(
+        cls, groups: Dict[int, List[str]], modules: "OrderedDict[str, Metric]"
+    ) -> Dict[int, List[str]]:
+        """The group-merge algorithm over any metric mapping (the real
+        modules after an eager update, or probe deep-copies)."""
+        groups = {k: list(v) for k, v in groups.items()}
+        num_groups = len(groups)
         while True:
-            for cg_idx1, cg_members1 in list(self._groups.items()):
+            for cg_idx1, cg_members1 in list(groups.items()):
                 merged = False
-                for cg_idx2, cg_members2 in list(self._groups.items()):
-                    if cg_idx1 == cg_idx2 or cg_idx1 not in self._groups or cg_idx2 not in self._groups:
+                for cg_idx2, cg_members2 in list(groups.items()):
+                    if cg_idx1 == cg_idx2 or cg_idx1 not in groups or cg_idx2 not in groups:
                         continue
-                    metric1 = self._modules[cg_members1[0]]
-                    metric2 = self._modules[cg_members2[0]]
-                    if self._equal_metric_states(metric1, metric2):
-                        self._groups[cg_idx1].extend(self._groups.pop(cg_idx2))
+                    metric1 = modules[cg_members1[0]]
+                    metric2 = modules[cg_members2[0]]
+                    if cls._equal_metric_states(metric1, metric2):
+                        groups[cg_idx1].extend(groups.pop(cg_idx2))
                         merged = True
                         break
                 if merged:
                     break
-            if len(self._groups) == num_groups:
+            if len(groups) == num_groups:
                 break
-            num_groups = len(self._groups)
-        self._groups = dict(enumerate(self._groups.values()))
+            num_groups = len(groups)
+        return dict(enumerate(groups.values()))
 
     @staticmethod
     def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
@@ -430,25 +439,17 @@ class MetricCollection:
         by value, so discovery can't happen inside the compiled program)."""
         if self._groups_checked:
             return
-        saved = []
-        for m in self._modules.values():
-            states = {
-                s: (list(getattr(m, s)) if isinstance(getattr(m, s), list) else getattr(m, s))
-                for s in m._defaults
-            }
-            saved.append((states, m._update_count, m._computed))
-        try:
-            for m in self._modules.values():
-                m.update(*args, **m._filter_kwargs(**kwargs))
-            if self._enable_compute_groups:
-                self._merge_compute_groups()
-            self._groups_checked = True
-        finally:
-            for m, (states, update_count, computed) in zip(self._modules.values(), saved):
-                for s, v in states.items():
-                    object.__setattr__(m, s, v)
-                m._update_count = update_count
-                m._computed = computed
+        import copy
+
+        # probe DEEP COPIES, never the real metrics: an update may touch
+        # state outside _defaults (e.g. host-side sentence buffers), so a
+        # snapshot/restore of registered states alone would leak the probe
+        probes = {name: copy.deepcopy(m) for name, m in self._modules.items()}
+        for m in probes.values():
+            m.update(*args, **m._filter_kwargs(**kwargs))
+        if self._enable_compute_groups:
+            self._groups = self._merged_groups(self._groups, probes)
+        self._groups_checked = True
         self._state_is_copy = False
 
     def init_state(self) -> Dict[str, Dict[str, Any]]:

@@ -126,26 +126,44 @@ def binary_groups_stat_rates(
     return _groups_reduce(group_stats)
 
 
+def _infer_num_groups(groups: Array) -> int:
+    """``max(groups) + 1`` — needs concrete values; under a trace the caller
+    must pass ``num_groups`` explicitly (the Metric class always does)."""
+    from tpumetrics.utils.data import _is_tracer
+
+    if _is_tracer(groups):
+        raise ValueError(
+            "`num_groups` cannot be inferred from traced data under jit; pass num_groups explicitly"
+        )
+    return int(jnp.max(groups)) + 1
+
+
+def _min_max_ratio_entry(prefix: str, rates: Array) -> Dict[str, Array]:
+    """``{prefix}_{argmin}_{argmax}: min/max`` like the reference — except
+    under a jax trace, where dict keys must be static: there the entry is
+    ``{prefix}_min_max`` and the ratio is computed with traced argmin/argmax
+    (same value, static name)."""
+    from tpumetrics.utils.data import _is_tracer
+
+    if _is_tracer(rates):
+        lo = jnp.min(rates)
+        hi = jnp.max(rates)
+        return {f"{prefix}_min_max": _safe_divide(lo, hi)}
+    min_id = int(jnp.argmin(rates))
+    max_id = int(jnp.argmax(rates))
+    return {f"{prefix}_{min_id}_{max_id}": _safe_divide(rates[min_id], rates[max_id])}
+
+
 def _compute_binary_demographic_parity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
     """Reference :164-175."""
     pos_rates = _safe_divide(tp + fp, tp + fp + tn + fn)
-    min_pos_rate_id = int(jnp.argmin(pos_rates))
-    max_pos_rate_id = int(jnp.argmax(pos_rates))
-    return {
-        f"DP_{min_pos_rate_id}_{max_pos_rate_id}": _safe_divide(
-            pos_rates[min_pos_rate_id], pos_rates[max_pos_rate_id]
-        )
-    }
+    return _min_max_ratio_entry("DP", pos_rates)
 
 
 def _compute_binary_equal_opportunity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
     """Reference :243-255."""
     true_pos_rates = _safe_divide(tp, tp + fn)
-    min_tpr_id = int(jnp.argmin(true_pos_rates))
-    max_tpr_id = int(jnp.argmax(true_pos_rates))
-    return {
-        f"EO_{min_tpr_id}_{max_tpr_id}": _safe_divide(true_pos_rates[min_tpr_id], true_pos_rates[max_tpr_id])
-    }
+    return _min_max_ratio_entry("EO", true_pos_rates)
 
 
 def demographic_parity(
@@ -165,7 +183,7 @@ def demographic_parity(
         >>> {k: round(float(v), 4) for k, v in demographic_parity(preds, groups).items()}
         {'DP_0_1': 0.0}
     """
-    num_groups = int(jnp.max(groups)) + 1
+    num_groups = _infer_num_groups(groups)
     target = jnp.zeros_like(preds, dtype=jnp.int32)
     group_stats = _binary_groups_stat_scores(
         preds, target, groups, num_groups, threshold, ignore_index, validate_args
@@ -193,7 +211,7 @@ def equal_opportunity(
         >>> {k: round(float(v), 4) for k, v in equal_opportunity(preds, target, groups).items()}
         {'EO_0_1': 0.0}
     """
-    num_groups = int(jnp.max(groups)) + 1
+    num_groups = _infer_num_groups(groups)
     group_stats = _binary_groups_stat_scores(
         preds, target, groups, num_groups, threshold, ignore_index, validate_args
     )
@@ -209,8 +227,12 @@ def binary_fairness(
     threshold: float = 0.5,
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
+    num_groups: Optional[int] = None,
 ) -> Dict[str, Array]:
     """Demographic parity and/or equal opportunity (reference :326-380).
+
+    ``num_groups`` defaults to ``max(groups) + 1`` inferred from the data —
+    that inference needs concrete values, so pass it explicitly under jit.
 
     Example:
         >>> import jax.numpy as jnp
@@ -231,7 +253,7 @@ def binary_fairness(
             rank_zero_warn("The task demographic_parity does not require a target.", UserWarning)
         target = jnp.zeros_like(preds, dtype=jnp.int32)
 
-    num_groups = int(jnp.max(groups)) + 1
+    num_groups = _infer_num_groups(groups) if num_groups is None else num_groups
     group_stats = _binary_groups_stat_scores(
         preds, target, groups, num_groups, threshold, ignore_index, validate_args
     )

@@ -174,9 +174,14 @@ def _binned_confusion_tensor(
             invalid = invalid[:, None]
     n = preds.shape[0]
     pos_elems = n * preds.shape[1] * thresholds.shape[0]
-    if n < EXACT_F32_COUNT and pos_elems <= (1 << 28):
-        # f32 contraction counts are exact only below 2^24 samples per call,
-        # and the (N, C, T) comparison operand must fit comfortably in HBM
+    if n < EXACT_F32_COUNT and pos_elems <= (1 << 26):
+        # f32 contraction counts are exact only below 2^24 samples per call.
+        # The 2^26-element (256 MiB) budget on the (N, C, T) comparison
+        # operand assumes XLA fuses it into the contraction and it never
+        # materializes in HBM — true today, but a compiler regression would
+        # turn the budget into a real allocation, so it is kept small enough
+        # to survive one (ADVICE r2); the histogram path (and the pinned
+        # Pallas kernel in tpumetrics/ops) covers everything larger
         conf = _binned_confusion_contract(preds, target_bits, thresholds, invalid)
     else:
         # gigantic/wide batches take the O(N·C)-memory histogram path instead

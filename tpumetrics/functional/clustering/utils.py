@@ -245,17 +245,21 @@ def _cluster_centroids(
     ``mask`` excludes invalid buffer rows with static shapes."""
     labels = _mask_labels(labels, num_labels, mask)
     n = data.shape[0]
+    # counts/one-hot accumulate in AT LEAST f32 regardless of data dtype:
+    # bf16 counts lose exactness past 256, so the EXACT_F32_COUNT gate would
+    # overstate the guarantee for low-precision inputs (ADVICE r2)
+    acc_dtype = data.dtype if jnp.finfo(data.dtype).bits >= 32 else jnp.float32
     if n < EXACT_F32_COUNT and n * (num_labels + 1) <= ONEHOT_HBM_ELEMS:
         # MXU path: per-cluster sums/counts as a one-hot matmul instead of a
         # serializing scatter-add (the sentinel segment is sliced off);
         # HIGHEST precision because `data` is arbitrary float — TPU matmuls
         # otherwise truncate inputs to bf16
-        onehot = jax.nn.one_hot(labels, num_labels + 1, dtype=data.dtype)[:, :num_labels]
+        onehot = jax.nn.one_hot(labels, num_labels + 1, dtype=acc_dtype)[:, :num_labels]
         counts = jnp.sum(onehot, axis=0)
-        sums = jnp.matmul(onehot.T, data, precision=jax.lax.Precision.HIGHEST)
+        sums = jnp.matmul(onehot.T, data.astype(acc_dtype), precision=jax.lax.Precision.HIGHEST)
     else:
-        counts = jax.ops.segment_sum(jnp.ones((n,), data.dtype), labels, num_segments=num_labels)
-        sums = jax.ops.segment_sum(data, labels, num_segments=num_labels)
+        counts = jax.ops.segment_sum(jnp.ones((n,), acc_dtype), labels, num_segments=num_labels)
+        sums = jax.ops.segment_sum(data.astype(acc_dtype), labels, num_segments=num_labels)
     centroids = sums / jnp.where(counts > 0, counts, 1.0)[:, None]
     return centroids, counts
 

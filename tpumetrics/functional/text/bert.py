@@ -75,6 +75,10 @@ def _tokenize_padded(tokenizer: Any, sentences: List[str], max_length: int) -> D
             ids[i, : len(row)] = row
             att[i, : len(arow)] = arow
         return {"input_ids": ids, "attention_mask": att}
+    if isinstance(input_ids, jax.Array) or isinstance(attention_mask, jax.Array):
+        # leave device arrays alone — the caller batches ONE fetch for both
+        # fields (each np.asarray here would be its own full round trip)
+        return {"input_ids": input_ids, "attention_mask": attention_mask}
     return {"input_ids": np.asarray(input_ids), "attention_mask": np.asarray(attention_mask)}
 
 
@@ -112,6 +116,50 @@ def _get_precision_recall_f1(
 _get_precision_recall_f1_jit = jax.jit(_get_precision_recall_f1)
 
 
+_CHUNK_EMBED_CACHE: Dict[Tuple, Callable] = {}
+
+
+def _chunk_embed_fn(model: Any, user_forward_fn: Optional[Callable], all_layers: bool, num_layers: Optional[int]):
+    """A jitted forward + unit-normalize + mask pipeline for one chunk,
+    cached per (model, forward, layer-config) identity so repeated ``compute``
+    calls (and every chunk within one) reuse one compiled program.
+
+    Falls back to an unjitted pipeline when the model/forward are unhashable
+    or refuse tracing (exotic user forwards that leave jax)."""
+    key = (id(model), id(user_forward_fn), all_layers, num_layers)
+    cached = _CHUNK_EMBED_CACHE.get(key)
+    # guard id-reuse after GC: keep strong refs alongside the compiled fn
+    if cached is not None and cached[1] is model and cached[2] is user_forward_fn:
+        return cached[0]
+
+    def pipeline(ids, mask):
+        model_batch = {"input_ids": ids, "attention_mask": mask}
+        if user_forward_fn is not None:
+            part = jnp.asarray(user_forward_fn(model, model_batch))
+            if part.ndim == 3:
+                part = part[:, None]
+        else:
+            part = _default_forward(model, model_batch, all_layers, num_layers)
+        part = part / jnp.clip(jnp.linalg.norm(part, axis=-1, keepdims=True), 1e-12)
+        return part * jnp.asarray(mask, jnp.float32)[:, None, :, None]
+
+    jitted = jax.jit(pipeline)
+
+    def safe(ids, mask):
+        try:
+            return jitted(ids, mask)
+        except Exception:
+            return pipeline(jnp.asarray(ids), jnp.asarray(mask))
+
+    # bounded FIFO: the cached closure necessarily pins its model, so cap how
+    # many distinct models stay pinned; evicting oldest (not clearing all)
+    # keeps the hot entries compiled
+    while len(_CHUNK_EMBED_CACHE) >= 8:
+        _CHUNK_EMBED_CACHE.pop(next(iter(_CHUNK_EMBED_CACHE)))
+    _CHUNK_EMBED_CACHE[key] = (safe, model, user_forward_fn)
+    return safe
+
+
 def _embed(
     sentences: List[str],
     model: Any,
@@ -128,8 +176,16 @@ def _embed(
     idf-or-uniform token weights, token id lists). The model forward runs in
     ``batch_size`` chunks so corpus size never sets device memory."""
     batch = _tokenize_padded(tokenizer, sentences, max_length)
+    # all bookkeeping (padding, token lists, idf weights) is host numpy; if a
+    # custom tokenizer produced device arrays, fetch them ONCE — every eager
+    # slice/iteration over a device array is a full round trip on a
+    # remote-attached accelerator
     input_ids = batch["input_ids"]
     attention_mask = batch["attention_mask"]
+    if isinstance(input_ids, jax.Array) or isinstance(attention_mask, jax.Array):
+        input_ids, attention_mask = jax.device_get((input_ids, attention_mask))
+    input_ids = np.asarray(input_ids)
+    attention_mask = np.asarray(attention_mask)
 
     # pad the corpus to a whole number of chunks so every model forward sees
     # ONE batch shape — otherwise the tail chunk triggers a second trace and
@@ -143,26 +199,16 @@ def _embed(
             [attention_mask, np.zeros((n_pad - n, attention_mask.shape[1]), attention_mask.dtype)]
         )
 
+    # forward + unit-normalize + mask fused into ONE jit call per chunk
+    # (cached across chunks AND compute calls — uniform chunking keeps the
+    # shape signature constant); eagerly this path is dozens of dispatches
+    chunk_fn = _chunk_embed_fn(model, user_forward_fn, all_layers, num_layers)
     chunks = []
     for lo in range(0, n_pad, step):
-        model_batch = {
-            "input_ids": input_ids[lo : lo + step],
-            "attention_mask": attention_mask[lo : lo + step],
-        }
-        if user_forward_fn is not None:
-            part = jnp.asarray(user_forward_fn(model, model_batch))
-            if part.ndim == 3:
-                part = part[:, None]
-        else:
-            part = _default_forward(model, model_batch, all_layers, num_layers)
-        chunks.append(part)
-    emb = jnp.concatenate(chunks, axis=0)[:n]
+        chunks.append(chunk_fn(input_ids[lo : lo + step], attention_mask[lo : lo + step]))
+    emb = jnp.concatenate(chunks, axis=0)[:n] if len(chunks) > 1 else (chunks[0][:n] if chunks else jnp.zeros((0, 1, 0, 0)))
     input_ids = input_ids[:n]
     attention_mask = attention_mask[:n]
-
-    emb = emb / jnp.clip(jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-12)
-    mask = jnp.asarray(attention_mask, jnp.float32)
-    emb = emb * mask[:, None, :, None]
 
     token_lists = [[int(t) for t, a in zip(row, arow) if a] for row, arow in zip(input_ids, attention_mask)]
     if idf and idf_map is not None:
@@ -172,12 +218,12 @@ def _embed(
                 if a:
                     weights[i, j] = idf_map.get(int(tid), idf_map.get("__default__", 0.0))
         sums = weights.sum(axis=1, keepdims=True)
-        weights = weights / np.where(sums > 0, sums, 1.0)
-        scale = jnp.asarray(weights)
+        scale = weights / np.where(sums > 0, sums, 1.0)
     else:
-        counts = mask.sum(axis=1, keepdims=True)
-        scale = mask / jnp.where(counts > 0, counts, 1.0)
-    return emb, scale, token_lists
+        maskf = attention_mask.astype(np.float32)
+        counts = maskf.sum(axis=1, keepdims=True)
+        scale = maskf / np.where(counts > 0, counts, 1.0)
+    return emb, jnp.asarray(scale), token_lists
 
 
 def bert_score(

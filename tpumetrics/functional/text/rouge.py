@@ -64,9 +64,15 @@ def _ensure_nltk_punkt_is_downloaded() -> None:
 
 
 def _split_sentence(x: str) -> Sequence[str]:
-    """Sentence splitting for rougeLsum (reference rouge.py:62-71); falls
-    back to a regex splitter when the nltk punkt data cannot be obtained
-    (e.g. no network egress)."""
+    """Sentence splitting for rougeLsum (reference rouge.py:62-71).
+
+    With nltk punkt data available this matches the reference
+    (``nltk.sent_tokenize``).  Without it (e.g. no network egress) the
+    PINNED fallback is: split on newlines first — the ``rouge_score``
+    package's own ``rougeLsum`` convention, where summaries carry one
+    sentence per line — then on sentence-final punctuation within each
+    line.  The divergence is warned ONCE per process and tested head-to-head
+    against ``rouge_score`` (tests/text/test_edge_cases.py)."""
     x = re.sub("<n>", "", x)  # remove pegasus newline char
     if _NLTK_AVAILABLE:
         try:
@@ -75,13 +81,21 @@ def _split_sentence(x: str) -> Sequence[str]:
             _ensure_nltk_punkt_is_downloaded()
             return nltk.sent_tokenize(x)
         except (LookupError, OSError):
-            from tpumetrics.utils.prints import rank_zero_warn
+            if not _PUNKT_STATE.get("warned"):
+                _PUNKT_STATE["warned"] = True
+                from tpumetrics.utils.prints import rank_zero_warn
 
-            rank_zero_warn(
-                "nltk punkt sentence tokenizer data is unavailable; falling back to a regex splitter"
-                " for rougeLsum sentence splitting."
-            )
-    return [s for s in re.split(r"(?<=[.!?])\s+", x.strip()) if s]
+                rank_zero_warn(
+                    "nltk punkt sentence tokenizer data is unavailable; rougeLsum falls back to"
+                    " newline-then-punctuation sentence splitting (the rouge_score newline"
+                    " convention). This is pinned behavior, warned once per process."
+                )
+    return [
+        s
+        for line in x.strip().splitlines()
+        for s in re.split(r"(?<=[.!?])\s+", line.strip())
+        if s
+    ]
 
 
 def _compute_metrics(hits_or_lcs: int, pred_len: int, target_len: int) -> Dict[str, float]:

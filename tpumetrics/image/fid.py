@@ -103,6 +103,7 @@ class FrechetInceptionDistance(Metric):
             raise ValueError("Argument `normalize` expected to be a bool")
         self.normalize = normalize
 
+        self._jit_accum = None  # built lazily; cached across updates
         mx = (num_features, num_features)
         self.add_state("real_features_sum", jnp.zeros(num_features), dist_reduce_fx="sum")
         self.add_state("real_features_cov_sum", jnp.zeros(mx), dist_reduce_fx="sum")
@@ -113,19 +114,40 @@ class FrechetInceptionDistance(Metric):
 
     def update(self, imgs: Array, real: bool) -> None:
         """Extract features and accumulate first/second moments
-        (reference fid.py:322-338)."""
-        imgs = (imgs * 255).astype(jnp.uint8) if self.normalize else imgs
-        features = jnp.asarray(self.inception(imgs), jnp.float32)
-        if features.ndim == 1:
-            features = features[None]
+        (reference fid.py:322-338).
+
+        Extractor + moment accumulation run as ONE jit call (cached per input
+        shape): eagerly each op is a separate dispatch, and on a
+        remote-attached accelerator the per-update cost is round trips, not
+        FLOPs."""
+        if self._jit_accum is None:
+            inception, normalize = self.inception, self.normalize
+
+            def accum(feat_sum, cov_sum, n, imgs):
+                x = (imgs * 255).astype(jnp.uint8) if normalize else imgs
+                f = jnp.asarray(inception(x), jnp.float32)
+                if f.ndim == 1:
+                    f = f[None]
+                return feat_sum + f.sum(axis=0), cov_sum + f.T @ f, n + imgs.shape[0]
+
+            self._jit_accum = jax.jit(accum)
         if real:
-            self.real_features_sum = self.real_features_sum + features.sum(axis=0)
-            self.real_features_cov_sum = self.real_features_cov_sum + features.T @ features
-            self.real_features_num_samples = self.real_features_num_samples + imgs.shape[0]
+            self.real_features_sum, self.real_features_cov_sum, self.real_features_num_samples = self._jit_accum(
+                self.real_features_sum, self.real_features_cov_sum, self.real_features_num_samples, imgs
+            )
         else:
-            self.fake_features_sum = self.fake_features_sum + features.sum(axis=0)
-            self.fake_features_cov_sum = self.fake_features_cov_sum + features.T @ features
-            self.fake_features_num_samples = self.fake_features_num_samples + imgs.shape[0]
+            self.fake_features_sum, self.fake_features_cov_sum, self.fake_features_num_samples = self._jit_accum(
+                self.fake_features_sum, self.fake_features_cov_sum, self.fake_features_num_samples, imgs
+            )
+
+    def __getstate__(self):
+        state = super().__getstate__()
+        state.pop("_jit_accum", None)  # compiled fn, unpicklable; rebuilt lazily
+        return state
+
+    def __setstate__(self, state):
+        super().__setstate__(state)
+        self._jit_accum = None
 
     def compute(self) -> Array:
         """FID from the accumulated moments (reference fid.py:340-351)."""

@@ -72,14 +72,25 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         self.normalize = normalize
         self.layer_weights = layer_weights
 
+        self._jit_loss = None  # built lazily; cached across updates
         self.add_state("sum_scores", jnp.zeros(()), dist_reduce_fx="sum")
         self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
 
     def update(self, img1: Array, img2: Array) -> None:
-        """Accumulate LPIPS sums (reference lpip.py:139-145)."""
-        loss = learned_perceptual_image_patch_similarity(
-            img1, img2, self.net, self.layer_weights, self.normalize, reduction="sum"
-        )
+        """Accumulate LPIPS sums (reference lpip.py:139-145).
+
+        The per-batch distance is computed under ONE jit call (cached per
+        input shape): eagerly, the backbone + normalize/diff/average chain is
+        dozens of dispatches, each a full round trip on a remote-attached
+        accelerator."""
+        if self._jit_loss is None:
+            net, weights, normalize = self.net, self.layer_weights, self.normalize
+            self._jit_loss = jax.jit(
+                lambda a, b: learned_perceptual_image_patch_similarity(
+                    a, b, net, weights, normalize, reduction="sum"
+                )
+            )
+        loss = self._jit_loss(img1, img2)
         self.sum_scores = self.sum_scores + loss
         self.total = self.total + img1.shape[0]
 
@@ -88,3 +99,12 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         if self.reduction == "mean":
             return self.sum_scores / self.total
         return self.sum_scores
+
+    def __getstate__(self):
+        state = super().__getstate__()
+        state.pop("_jit_loss", None)  # compiled fn, unpicklable; rebuilt lazily
+        return state
+
+    def __setstate__(self, state):
+        super().__setstate__(state)
+        self._jit_loss = None

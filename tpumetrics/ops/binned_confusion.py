@@ -96,11 +96,20 @@ def binned_confusion_fused(
             f"binned_confusion_fused: num_classes={c} is too wide for the VMEM tile budget; "
             "use the XLA path (_binned_confusion_contract)"
         )
-    tt = max(8, min(128, -(-t // 8) * 8, budget // (c * 8) // 8 * 8))
-    tn = max(8, min(1024, budget // max(c * tt, 1) // 8 * 8))
+    # pad C to the 128-lane multiple: C is a block-shape lane dimension below,
+    # and real-TPU tiling requires lane-aligned blocks (interpret mode would
+    # accept any C and hide the misalignment — ADVICE r2)
+    c_pad = max(128, -(-c // 128) * 128)
+    tt = max(8, min(128, -(-t // 8) * 8, budget // (c_pad * 8) // 8 * 8))
+    tn = max(8, min(1024, budget // max(c_pad * tt, 1) // 8 * 8))
     n_pad = -(-n // tn) * tn
     t_pad = -(-t // tt) * tt
 
+    if c_pad != c:
+        pad = ((0, 0), (0, c_pad - c))
+        preds = jnp.pad(preds, pad)
+        y = jnp.pad(y, pad)  # padded classes have v = y = 0 -> all-zero counts
+        v = jnp.pad(v, pad)
     if n_pad != n:
         pad = ((0, n_pad - n), (0, 0))
         preds = jnp.pad(preds, pad)
@@ -116,18 +125,18 @@ def binned_confusion_fused(
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, tt), lambda i, j: (0, i)),
-            pl.BlockSpec((tn, c), lambda i, j: (j, 0)),
-            pl.BlockSpec((tn, c), lambda i, j: (j, 0)),
-            pl.BlockSpec((tn, c), lambda i, j: (j, 0)),
+            pl.BlockSpec((tn, c_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((tn, c_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((tn, c_pad), lambda i, j: (j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((c, tt), lambda i, j: (0, i)),
-            pl.BlockSpec((c, tt), lambda i, j: (0, i)),
+            pl.BlockSpec((c_pad, tt), lambda i, j: (0, i)),
+            pl.BlockSpec((c_pad, tt), lambda i, j: (0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((c, t_pad), jnp.float32),
-            jax.ShapeDtypeStruct((c, t_pad), jnp.float32),
+            jax.ShapeDtypeStruct((c_pad, t_pad), jnp.float32),
+            jax.ShapeDtypeStruct((c_pad, t_pad), jnp.float32),
         ],
         interpret=interpret,
     )(thresholds, preds, y, v)
-    return tp.T[:t], pp.T[:t]
+    return tp.T[:t, :c], pp.T[:t, :c]

@@ -9,10 +9,19 @@ from __future__ import annotations
 class HostSentenceStateMixin:
     """Mixin refusing dist-sync of host-side sentence buffers.
 
-    Subclasses set ``self.sentences_replicated`` in ``__init__``.
+    Subclasses set ``self.sentences_replicated`` in ``__init__`` and keep
+    their sentence buffers in ``self._preds`` / ``self._target``.
     """
 
     sentences_replicated: bool = False
+
+    @property
+    def sentence_state(self):
+        """The accumulated (predictions, references) sentence lists — the
+        public handle for a multi-host object-gather: gather both lists from
+        every rank (e.g. over DCN), feed the union into one metric, compute
+        once.  Returns copies; mutating them does not touch the metric."""
+        return list(self._preds), list(self._target)
 
     def _sync_dist(self, dist_sync_fn=None, process_group=None) -> None:
         from tpumetrics.metric import TPUMetricsUserError
