@@ -132,7 +132,9 @@ def _chunk_embed_fn(model: Any, user_forward_fn: Optional[Callable], all_layers:
     if cached is not None and cached[1] is model and cached[2] is user_forward_fn:
         return cached[0]
 
-    def pipeline(ids, mask):
+    def pipeline(ids, mask, weight_mask):
+        # the model sees the real attention mask; the score weighting uses the
+        # special-token-stripped one (reference helper_embedding_metric.py:35-50)
         model_batch = {"input_ids": ids, "attention_mask": mask}
         if user_forward_fn is not None:
             part = jnp.asarray(user_forward_fn(model, model_batch))
@@ -141,15 +143,15 @@ def _chunk_embed_fn(model: Any, user_forward_fn: Optional[Callable], all_layers:
         else:
             part = _default_forward(model, model_batch, all_layers, num_layers)
         part = part / jnp.clip(jnp.linalg.norm(part, axis=-1, keepdims=True), 1e-12)
-        return part * jnp.asarray(mask, jnp.float32)[:, None, :, None]
+        return part * jnp.asarray(weight_mask, jnp.float32)[:, None, :, None]
 
     jitted = jax.jit(pipeline)
 
-    def safe(ids, mask):
+    def safe(ids, mask, weight_mask):
         try:
-            return jitted(ids, mask)
+            return jitted(ids, mask, weight_mask)
         except Exception:
-            return pipeline(jnp.asarray(ids), jnp.asarray(mask))
+            return pipeline(jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(weight_mask))
 
     # bounded FIFO: the cached closure necessarily pins its model, so cap how
     # many distinct models stay pinned; evicting oldest (not clearing all)
@@ -199,28 +201,43 @@ def _embed(
             [attention_mask, np.zeros((n_pad - n, attention_mask.shape[1]), attention_mask.dtype)]
         )
 
+    # score weighting strips the special tokens: first position ([CLS]) and
+    # the last attended position ([SEP]) get zero weight, exactly like the
+    # reference (helper_embedding_metric.py:35-50) — this applies even to
+    # custom tokenizers, matching the reference's unconditional behavior
+    weight_mask = attention_mask.copy()
+    if weight_mask.shape[1]:
+        weight_mask[:, 0] = 0
+        # last attended position via the reference's cumsum-argmax, which is
+        # padding-side-agnostic (left-padded decoder tokenizers included)
+        last = np.argmax(np.cumsum(attention_mask - 0.1, axis=1), axis=1)
+        weight_mask[np.arange(weight_mask.shape[0]), last] = 0
+
     # forward + unit-normalize + mask fused into ONE jit call per chunk
     # (cached across chunks AND compute calls — uniform chunking keeps the
     # shape signature constant); eagerly this path is dozens of dispatches
     chunk_fn = _chunk_embed_fn(model, user_forward_fn, all_layers, num_layers)
     chunks = []
     for lo in range(0, n_pad, step):
-        chunks.append(chunk_fn(input_ids[lo : lo + step], attention_mask[lo : lo + step]))
+        chunks.append(
+            chunk_fn(input_ids[lo : lo + step], attention_mask[lo : lo + step], weight_mask[lo : lo + step])
+        )
     emb = jnp.concatenate(chunks, axis=0)[:n] if len(chunks) > 1 else (chunks[0][:n] if chunks else jnp.zeros((0, 1, 0, 0)))
     input_ids = input_ids[:n]
     attention_mask = attention_mask[:n]
+    weight_mask = weight_mask[:n]
 
     token_lists = [[int(t) for t, a in zip(row, arow) if a] for row, arow in zip(input_ids, attention_mask)]
     if idf and idf_map is not None:
         weights = np.zeros_like(attention_mask, dtype=np.float32)
         for i, row in enumerate(input_ids):
-            for j, (tid, a) in enumerate(zip(row, attention_mask[i])):
+            for j, (tid, a) in enumerate(zip(row, weight_mask[i])):
                 if a:
                     weights[i, j] = idf_map.get(int(tid), idf_map.get("__default__", 0.0))
         sums = weights.sum(axis=1, keepdims=True)
         scale = weights / np.where(sums > 0, sums, 1.0)
     else:
-        maskf = attention_mask.astype(np.float32)
+        maskf = weight_mask.astype(np.float32)
         counts = maskf.sum(axis=1, keepdims=True)
         scale = maskf / np.where(counts > 0, counts, 1.0)
     return emb, jnp.asarray(scale), token_lists
