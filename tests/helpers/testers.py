@@ -374,9 +374,11 @@ class MetricTester:
         metric_args = metric_args or {}
         metric = metric_module(**metric_args)
         metric.set_dtype(dtype)
-        is_float = jnp.issubdtype(preds[0].dtype, jnp.floating)
-        p = preds[0].astype(dtype) if is_float else preds[0]
-        metric.update(p, target[0])
+        # cast every floating input (the reference harness moves the whole
+        # metric+inputs to half); integer targets/labels stay integer
+        p = preds[0].astype(dtype) if jnp.issubdtype(preds[0].dtype, jnp.floating) else preds[0]
+        t = target[0].astype(dtype) if jnp.issubdtype(target[0].dtype, jnp.floating) else target[0]
+        metric.update(p, t)
         out = metric.compute()
         assert out is not None
 

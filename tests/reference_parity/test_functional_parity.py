@@ -274,6 +274,92 @@ C("tweedie_2", "tweedie_deviance_score", "regression.tweedie_deviance_score", re
 C("rse", "relative_squared_error", "regression.relative_squared_error", reg_pair)
 
 
+# --- classification stat-family sweep: metric x task x average x ignore_index
+# (the reference parametrizes every stat metric this way,
+# tests/unittests/classification/inputs.py — here the reference IS the oracle)
+def _with_ignore(gen, rate=0.15, sentinel=-1):
+    """Wrap an input generator so ~rate of the targets become the ignored
+    sentinel — one definition for every task's ignore_index variant."""
+
+    def wrapped(rng):
+        p, t = gen(rng)
+        t = t.copy()
+        t[rng.uniform(size=t.shape) < rate] = sentinel
+        return p, t
+
+    return wrapped
+
+
+bin_probs_ignore = _with_ignore(bin_probs)
+mc_logits_ignore = _with_ignore(mc_logits)
+
+
+def mc_md_logits(rng):
+    # (B, C, E): class dim is axis 1, extra dims flatten into samples
+    return (
+        rng.normal(0, 2, (32, NC, 6)).astype(np.float32),
+        rng.integers(0, NC, (32, 6)).astype(np.int64),
+    )
+
+
+ml_probs_ignore = _with_ignore(ml_probs)
+
+
+_STAT_FAMILY = [
+    "accuracy",
+    "precision",
+    "recall",
+    "f1_score",
+    "specificity",
+    "jaccard_index",
+    "hamming_distance",
+]
+for _fn in _STAT_FAMILY:
+    C(f"sweep_binary_{_fn}_ignore", f"binary_{_fn}", f"classification.binary_{_fn}", bin_probs_ignore, kwargs={"ignore_index": -1})
+    for _avg in ("micro", "macro", "weighted", "none"):
+        C(
+            f"sweep_mc_{_fn}_{_avg}_ignore",
+            f"multiclass_{_fn}",
+            f"classification.multiclass_{_fn}",
+            mc_logits_ignore,
+            kwargs={"num_classes": NC, "average": _avg, "ignore_index": -1},
+        )
+        C(
+            f"sweep_mc_{_fn}_{_avg}_multidim",
+            f"multiclass_{_fn}",
+            f"classification.multiclass_{_fn}",
+            mc_md_logits,
+            kwargs={"num_classes": NC, "average": _avg},
+        )
+        C(
+            f"sweep_ml_{_fn}_{_avg}_ignore",
+            f"multilabel_{_fn}",
+            f"classification.multilabel_{_fn}",
+            ml_probs_ignore,
+            kwargs={"num_labels": NL, "average": _avg, "ignore_index": -1},
+        )
+for _k in (2, 3):
+    for _avg in ("micro", "macro"):
+        C(
+            f"sweep_mc_accuracy_top{_k}_{_avg}",
+            "multiclass_accuracy",
+            "classification.multiclass_accuracy",
+            mc_logits,
+            kwargs={"num_classes": NC, "top_k": _k, "average": _avg},
+        )
+        C(
+            f"sweep_mc_recall_top{_k}_{_avg}",
+            "multiclass_recall",
+            "classification.multiclass_recall",
+            mc_logits,
+            kwargs={"num_classes": NC, "top_k": _k, "average": _avg},
+        )
+C("sweep_mc_stat_scores_multidim", "multiclass_stat_scores", "classification.multiclass_stat_scores", mc_md_logits, kwargs={"num_classes": NC, "average": "micro"})
+C("sweep_ml_stat_scores_ignore", "multilabel_stat_scores", "classification.multilabel_stat_scores", ml_probs_ignore, kwargs={"num_labels": NL, "average": None, "ignore_index": -1})
+C("sweep_binary_stat_scores_multidim", "binary_stat_scores", "classification.binary_stat_scores", lambda rng: (rng.uniform(0, 1, (16, 4, 5)).astype(np.float32), rng.integers(0, 2, (16, 4, 5)).astype(np.int64)), kwargs={"multidim_average": "samplewise"})
+C("sweep_mc_f1_samplewise", "multiclass_f1_score", "classification.multiclass_f1_score", mc_md_logits, kwargs={"num_classes": NC, "average": "macro", "multidim_average": "samplewise"})
+
+
 # --- image
 def img_pair(rng, shape=(2, 3, 48, 48), noise=0.1):
     t = rng.uniform(0, 1, shape).astype(np.float32)

@@ -12,13 +12,26 @@ import numpy as np
 import pytest
 
 import tpumetrics.classification as tmc
+import tpumetrics.clustering as tmcl
 import tpumetrics.functional.classification as tmf
+import tpumetrics.functional.clustering as tmfcl
 import tpumetrics.functional.image as tmfi
 import tpumetrics.functional.regression as tmfr
+import tpumetrics.functional.retrieval as tmfre
 import tpumetrics.image as tmi
 import tpumetrics.regression as tmr
-from tpumetrics.functional.audio import signal_noise_ratio
-from tpumetrics.audio import SignalNoiseRatio
+from tpumetrics.functional.audio import (
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_noise_ratio,
+    source_aggregated_signal_distortion_ratio,
+)
+from tpumetrics.audio import (
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalNoiseRatio,
+    SourceAggregatedSignalDistortionRatio,
+)
 from tests.helpers.testers import MetricTester
 
 _rng = np.random.default_rng(17)
@@ -26,6 +39,8 @@ N = 64
 
 reg_preds = [jnp.asarray(_rng.standard_normal(N).astype(np.float32)) for _ in range(2)]
 reg_target = [jnp.asarray((np.asarray(p) + 0.3 * _rng.standard_normal(N)).astype(np.float32)) for p in reg_preds]
+reg_pos_preds = [jnp.asarray(_rng.uniform(0.5, 4, N).astype(np.float32)) for _ in range(2)]
+reg_pos_target = [jnp.asarray((np.asarray(p) * _rng.uniform(0.8, 1.2, N)).astype(np.float32)) for p in reg_pos_preds]
 vec_preds = [jnp.asarray(_rng.standard_normal((N, 8)).astype(np.float32)) for _ in range(2)]
 vec_target = [jnp.asarray((np.asarray(p) + 0.3 * _rng.standard_normal((N, 8))).astype(np.float32)) for p in vec_preds]
 img_preds = [jnp.asarray(_rng.random((2, 3, 16, 16)).astype(np.float32)) for _ in range(2)]
@@ -34,11 +49,21 @@ bin_probs = [jnp.asarray(_rng.random(N).astype(np.float32)) for _ in range(2)]
 bin_target = [jnp.asarray(_rng.integers(0, 2, N).astype(np.int32)) for _ in range(2)]
 mc_logits = [jnp.asarray(_rng.standard_normal((N, 5)).astype(np.float32)) for _ in range(2)]
 mc_target = [jnp.asarray(_rng.integers(0, 5, N).astype(np.int32)) for _ in range(2)]
+audio_target = [jnp.asarray(_rng.standard_normal((2, 800)).astype(np.float32)) for _ in range(2)]
+audio_preds = [jnp.asarray((np.asarray(t) + 0.2 * _rng.standard_normal((2, 800))).astype(np.float32)) for t in audio_target]
+sa_target = [jnp.asarray(_rng.standard_normal((2, 2, 400)).astype(np.float32)) for _ in range(2)]
+sa_preds = [jnp.asarray((np.asarray(t) + 0.2 * _rng.standard_normal((2, 2, 400))).astype(np.float32)) for t in sa_target]
+clu_data = [jnp.asarray(_rng.standard_normal((N, 4)).astype(np.float32)) for _ in range(2)]
+clu_labels = [jnp.asarray(_rng.integers(0, 4, N).astype(np.int32)) for _ in range(2)]
 
 
 DIFF_CASES = [
     ("mse", tmr.MeanSquaredError, {}, tmfr.mean_squared_error, reg_preds, reg_target),
+    ("mae", tmr.MeanAbsoluteError, {}, tmfr.mean_absolute_error, reg_preds, reg_target),
     ("log_cosh", tmr.LogCoshError, {}, tmfr.log_cosh_error, reg_preds, reg_target),
+    ("explained_variance", tmr.ExplainedVariance, {}, tmfr.explained_variance, reg_preds, reg_target),
+    ("tweedie", tmr.TweedieDevianceScore, {"power": 1.5}, tmfr.tweedie_deviance_score, reg_pos_preds, reg_pos_target),
+    ("minkowski", tmr.MinkowskiDistance, {"p": 3}, tmfr.minkowski_distance, reg_preds, reg_target),
     ("cosine", tmr.CosineSimilarity, {}, tmfr.cosine_similarity, vec_preds, vec_target),
     ("binary_hinge", tmc.BinaryHingeLoss, {}, tmf.binary_hinge_loss, bin_probs, bin_target),
     ("psnr", tmi.PeakSignalNoiseRatio, {}, tmfi.peak_signal_noise_ratio, img_preds, img_target),
@@ -50,13 +75,53 @@ DIFF_CASES = [
         img_preds,
         img_target,
     ),
+    ("uqi", tmi.UniversalImageQualityIndex, {}, tmfi.universal_image_quality_index, img_preds, img_target),
+    ("sam", tmi.SpectralAngleMapper, {}, tmfi.spectral_angle_mapper, img_preds, img_target),
     ("snr", SignalNoiseRatio, {}, signal_noise_ratio, reg_preds, reg_target),
+    ("si_snr", ScaleInvariantSignalNoiseRatio, {}, scale_invariant_signal_noise_ratio, audio_preds, audio_target),
+    ("si_sdr", ScaleInvariantSignalDistortionRatio, {}, scale_invariant_signal_distortion_ratio, audio_preds, audio_target),
+    (
+        "sa_sdr",
+        SourceAggregatedSignalDistortionRatio,
+        {},
+        source_aggregated_signal_distortion_ratio,
+        sa_preds,
+        sa_target,
+    ),
 ]
 
 PRECISION_CASES = DIFF_CASES + [
     ("multiclass_acc", tmc.MulticlassAccuracy, {"num_classes": 5}, tmf.multiclass_accuracy, mc_logits, mc_target),
+    ("multiclass_f1_macro", tmc.MulticlassF1Score, {"num_classes": 5, "average": "macro"}, tmf.multiclass_f1_score, mc_logits, mc_target),
     ("binary_auroc", tmc.BinaryAUROC, {"thresholds": 32}, tmf.binary_auroc, bin_probs, bin_target),
+    ("binary_ap", tmc.BinaryAveragePrecision, {"thresholds": 32}, tmf.binary_average_precision, bin_probs, bin_target),
+    ("pearson", tmr.PearsonCorrCoef, {}, tmfr.pearson_corrcoef, reg_preds, reg_target),
+    ("concordance", tmr.ConcordanceCorrCoef, {}, tmfr.concordance_corrcoef, reg_preds, reg_target),
+    ("calinski", tmcl.CalinskiHarabaszScore, {}, tmfcl.calinski_harabasz_score, clu_data, clu_labels),
+    ("davies_bouldin", tmcl.DaviesBouldinScore, {}, tmfcl.davies_bouldin_score, clu_data, clu_labels),
 ]
+
+
+RETRIEVAL_PRECISION_FNS = [
+    ("retrieval_ap", tmfre.retrieval_average_precision, {}),
+    ("retrieval_ndcg", tmfre.retrieval_normalized_dcg, {"top_k": 10}),
+    ("retrieval_rr", tmfre.retrieval_reciprocal_rank, {}),
+]
+
+
+class TestRetrievalPrecision:
+    """bf16 preds must rank (and therefore score) like fp32 for the
+    retrieval functionals — the sweep's retrieval-domain coverage."""
+
+    @pytest.mark.parametrize(("name", "fn", "kwargs"), RETRIEVAL_PRECISION_FNS, ids=[c[0] for c in RETRIEVAL_PRECISION_FNS])
+    def test_bf16_close_to_fp32(self, name, fn, kwargs):
+        rng = np.random.default_rng(3)
+        # well-separated scores so bf16 rounding cannot flip the ranking
+        preds = jnp.asarray(np.round(rng.random(32), 2).astype(np.float32))
+        target = jnp.asarray((rng.random(32) > 0.6).astype(np.int32))
+        full = float(fn(preds, target, **kwargs))
+        half = float(fn(preds.astype(jnp.bfloat16), target, **kwargs))
+        assert np.isclose(half, full, atol=2e-2), (name, half, full)
 
 
 class TestDifferentiability(MetricTester):

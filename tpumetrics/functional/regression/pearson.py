@@ -101,7 +101,8 @@ def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Ar
     corr_xy = corr_xy / (nb - 1)
 
     if not isinstance(var_x, jax.core.Tracer):
-        bound = np.sqrt(np.finfo(np.dtype(var_x.dtype)).eps)
+        # jnp.finfo, not np.finfo: numpy rejects ml_dtypes like bfloat16
+        bound = np.sqrt(float(jnp.finfo(var_x.dtype).eps))
         if bool(jnp.any(var_x < bound)) or bool(jnp.any(var_y < bound)):
             rank_zero_warn(
                 "The variance of predictions or target is close to zero. This can cause instability in Pearson"
