@@ -1,12 +1,21 @@
-"""Regenerate docs/metrics_index.md from the live package."""
+"""Regenerate docs/metrics_index.md and the per-metric pages under
+docs/metrics/ from the live package (`python docs/_gen_index.py`).
+
+Every exported Metric class gets a section with its constructor signature,
+its full docstring (args, shapes, examples), and the matching
+``tpumetrics.functional`` counterpart with its signature and docstring.
+"""
+
 import importlib
 import inspect
 import os
 import pkgutil
+import re
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import tpumetrics
+import tpumetrics.functional as F
 from tpumetrics.metric import Metric
 
 # discover every subpackage that exports Metric subclasses, so new domains
@@ -23,7 +32,96 @@ for info in pkgutil.iter_modules(tpumetrics.__path__):
         DOMS.append(info.name)
 DOMS.sort()
 
-lines = ["# All metrics", "", "Generated from the live package (`python docs/_gen_index.py`).", ""]
+
+def _snake(name: str) -> str:
+    s = re.sub(r"(?<!^)(?=[A-Z][a-z])", "_", name)
+    s = re.sub(r"(?<=[a-z0-9])(?=[A-Z])", "_", s)
+    return s.lower()
+
+
+# hand map for classes whose functional name is not the mechanical snake_case
+# (None = streaming/protocol metric with no functional form)
+_FUNCTIONAL_ALIASES = {
+    "MeanAveragePrecision": None,  # COCO protocol over accumulated images
+    "MetricTracker": None,
+    "FrechetInceptionDistance": None,  # streaming moment states
+    "KernelInceptionDistance": None,
+    "InceptionScore": None,
+    "MemorizationInformedFrechetInceptionDistance": None,
+    "PerceptualPathLength": "perceptual_path_length" if hasattr(F, "perceptual_path_length") else None,
+    "RetrievalMetric": None,  # abstract base
+    "PrecisionAtFixedRecall": None,  # task-dispatch shells
+    "RecallAtFixedPrecision": None,
+    "SpecificityAtSensitivity": None,
+    "ROUGEScore": "rouge_score",
+    "BERTScore": "bert_score",
+    "InfoLM": "infolm",
+    "CLIPScore": "clip_score",
+    "CLIPImageQualityAssessment": "clip_image_quality_assessment",
+    "SacreBLEUScore": "sacre_bleu_score",
+    "BLEUScore": "bleu_score",
+    "CHRFScore": "chrf_score",
+    "WordErrorRate": "word_error_rate",
+    "CharErrorRate": "char_error_rate",
+    "SQuAD": "squad",
+    "BinaryGroupStatRates": "binary_groups_stat_rates",
+    "RetrievalMAP": "retrieval_average_precision",
+    "RetrievalMRR": "retrieval_reciprocal_rank",
+    "WordInfoLost": "word_information_lost",
+    "WordInfoPreserved": "word_information_preserved",
+    "MultiScaleStructuralSimilarityIndexMeasure": "multiscale_structural_similarity_index_measure",
+}
+
+
+def _functional_for(cls_name: str):
+    if cls_name in _FUNCTIONAL_ALIASES:
+        alias = _FUNCTIONAL_ALIASES[cls_name]
+        return (getattr(F, alias, None) if isinstance(alias, str) else None)
+    for cand in (
+        _snake(cls_name),
+        _snake(cls_name).replace("_co_ef", "_coef"),
+        _snake(cls_name).replace("_corr_coef", "_corrcoef"),
+        _snake(cls_name).replace("f_beta", "fbeta"),
+        _snake(cls_name).replace("f_beta", "fbeta").replace("_corr_coef", "_corrcoef"),
+        _snake(cls_name.replace("IoU", "Iou")),
+    ):
+        fn = getattr(F, cand, None)
+        if callable(fn):
+            return fn
+    return None
+
+
+def _clean_doc(obj) -> str:
+    doc = inspect.getdoc(obj) or "(no docstring)"
+    # demote any headers and fence doctest examples for markdown rendering
+    out = []
+    in_example = False
+    for line in doc.splitlines():
+        stripped = line.strip()
+        if stripped.startswith("Example") and stripped.rstrip(":") in ("Example", "Examples"):
+            out.append("**Example**")
+            out.append("```python")
+            in_example = True
+            continue
+        if in_example and stripped and not line.startswith((" ", "\t", ">")) and not stripped.startswith((">>>", "...")):
+            out.append("```")
+            in_example = False
+        out.append(line)
+    if in_example:
+        out.append("```")
+    return "\n".join(out)
+
+
+def _sig(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+os.makedirs(os.path.join(os.path.dirname(__file__), "metrics"), exist_ok=True)
+
+index_lines = ["# All metrics", "", "Generated from the live package (`python docs/_gen_index.py`).", ""]
 total = 0
 for d in DOMS:
     mod = importlib.import_module(f"tpumetrics.{d}")
@@ -31,11 +129,49 @@ for d in DOMS:
                    if inspect.isclass(o) and issubclass(o, Metric) and o is not Metric
                    and not n.startswith("_"))
     total += len(names)
-    lines.append(f"## `tpumetrics.{d}` ({len(names)})\n")
-    lines.extend(f"- `{n}`" for n in names)
-    lines.append("")
-lines.insert(3, f"**{total} metric classes**, each with a `tpumetrics.functional.*`"
-                " counterpart where the reference has one.\n")
+    index_lines.append(f"## `tpumetrics.{d}` ({len(names)})\n")
+    index_lines.extend(f"- [`{n}`](metrics/{d}.md#{n.lower()})" for n in names)
+    index_lines.append("")
+
+    page = [
+        f"# {d} metrics",
+        "",
+        f"Generated from the live package (`python docs/_gen_index.py`). "
+        f"Import from `tpumetrics.{d}`.",
+        "",
+    ]
+    for n in names:
+        cls = getattr(mod, n)
+        page.append(f"## {n}")
+        page.append("")
+        page.append(f"```python\ntpumetrics.{d}.{n}{_sig(cls.__init__).replace('(self, ', '(').replace('(self)', '()')}\n```")
+        page.append("")
+        flags = []
+        for attr in ("is_differentiable", "higher_is_better", "full_state_update"):
+            val = getattr(cls, attr, None)
+            if val is not None:
+                flags.append(f"`{attr}={val}`")
+        if flags:
+            page.append("Flags: " + ", ".join(flags))
+            page.append("")
+        page.append(_clean_doc(cls))
+        page.append("")
+        fn = _functional_for(n)
+        if fn is not None:
+            page.append(f"**Functional:** `tpumetrics.functional.{fn.__name__}{_sig(fn)}`")
+            page.append("")
+            fn_doc = _clean_doc(fn)
+            first = fn_doc.split("\n\n")[0]
+            if first != "(no docstring)":
+                page.append(first)
+                page.append("")
+    out_page = os.path.join(os.path.dirname(__file__), "metrics", f"{d}.md")
+    open(out_page, "w").write("\n".join(page) + "\n")
+    print("wrote", out_page)
+
+index_lines.insert(3, f"**{total} metric classes**, each with a `tpumetrics.functional.*`"
+                      " counterpart where the reference has one. Click through for"
+                      " per-metric args, shapes, and examples.\n")
 out = os.path.join(os.path.dirname(__file__), "metrics_index.md")
-open(out, "w").write("\n".join(lines) + "\n")
+open(out, "w").write("\n".join(index_lines) + "\n")
 print("wrote", out)
