@@ -166,12 +166,12 @@ for d in DOMS:
                 page.append(first)
                 page.append("")
     out_page = os.path.join(os.path.dirname(__file__), "metrics", f"{d}.md")
-    open(out_page, "w").write("\n".join(page) + "\n")
+    open(out_page, "w", encoding="utf-8").write("\n".join(page) + "\n")
     print("wrote", out_page)
 
 index_lines.insert(3, f"**{total} metric classes**, each with a `tpumetrics.functional.*`"
                       " counterpart where the reference has one. Click through for"
                       " per-metric args, shapes, and examples.\n")
 out = os.path.join(os.path.dirname(__file__), "metrics_index.md")
-open(out, "w").write("\n".join(index_lines) + "\n")
+open(out, "w", encoding="utf-8").write("\n".join(index_lines) + "\n")
 print("wrote", out)
