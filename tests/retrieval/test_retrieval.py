@@ -151,6 +151,10 @@ class TestRetrievalMetrics(MetricTester):
             reference_metric=ref_fn,
             metric_args=args,
             check_batch=False,  # batch-level value covers only that batch's queries
+            # default retrieval states are ragged (capacity-less) lists, which
+            # correctly REFUSE in-trace gather; the fully-in-jit path with
+            # declared capacities is covered by test_retrieval_fully_in_jit_with_buffers
+            shard_map_mode=False,
             indexes=INDEXES,
         )
 
