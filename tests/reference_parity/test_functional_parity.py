@@ -546,6 +546,48 @@ C("theils_u_matrix", "theils_u_matrix", "nominal.theils_u_matrix", nominal_matri
 C("fleiss_kappa", "fleiss_kappa", "nominal.fleiss_kappa", fleiss_gen)
 
 
+# --- detection (box IoU variants; the torchvision ops come from the shim on
+# the reference side and are re-derived from the formulas on ours)
+def det_boxes(rng):
+    def boxes(n):
+        xy = rng.uniform(0, 80, (n, 2))
+        wh = rng.uniform(5, 30, (n, 2))
+        return np.concatenate([xy, xy + wh], 1).astype(np.float32)
+
+    return boxes(8), boxes(6)
+
+
+C("det_iou", "intersection_over_union", "detection.intersection_over_union", det_boxes)
+C("det_iou_thresholded", "intersection_over_union", "detection.intersection_over_union", det_boxes, kwargs={"iou_threshold": 0.4, "aggregate": False})
+C("det_giou", "generalized_intersection_over_union", "detection.generalized_intersection_over_union", det_boxes)
+C("det_diou", "distance_intersection_over_union", "detection.distance_intersection_over_union", det_boxes)
+C("det_ciou", "complete_intersection_over_union", "detection.complete_intersection_over_union", det_boxes)
+
+
+def panoptic_gen(rng):
+    h, w = 24, 24
+    # category ids: things {0, 1}, stuffs {6, 7}; instance ids vary for things
+    cats = np.array([0, 1, 6, 7])
+    target = np.zeros((h, w, 2), np.int64)
+    preds = np.zeros((h, w, 2), np.int64)
+    for arr in (target, preds):
+        cat_field = cats[rng.integers(0, 4, (h // 4, w // 4))].repeat(4, 0).repeat(4, 1)
+        inst_field = rng.integers(0, 3, (h // 4, w // 4)).repeat(4, 0).repeat(4, 1)
+        arr[..., 0] = cat_field
+        arr[..., 1] = np.where(np.isin(cat_field, [0, 1]), inst_field, 0)
+    return preds, target
+
+
+C("panoptic_quality", "panoptic_quality", "detection.panoptic_quality", panoptic_gen, kwargs={"things": {0, 1}, "stuffs": {6, 7}})
+C(
+    "modified_panoptic_quality",
+    "modified_panoptic_quality",
+    "detection.modified_panoptic_quality",
+    panoptic_gen,
+    kwargs={"things": {0, 1}, "stuffs": {6, 7}},
+)
+
+
 # --- pairwise
 def pw(rng):
     return rng.normal(0, 1, (10, 6)).astype(np.float32), rng.normal(0, 1, (8, 6)).astype(np.float32)
