@@ -68,15 +68,19 @@ def test_minmax_wrapper_streaming_matches_reference(ref):
     rng = np.random.default_rng(5)
     ours = MinMaxMetric(BinaryAccuracy())
     want = RefMinMax(RefBinAcc())
+    # compute INSIDE the loop: extrema refresh only at compute() on both
+    # sides, so a single final compute would make raw == min == max trivially
     for _ in range(4):
         p = rng.random(32).astype(np.float32)
         t = rng.integers(0, 2, 32)
         ours.update(jnp.asarray(p), jnp.asarray(t))
         want.update(torch.from_numpy(p.copy()), torch.from_numpy(t.copy()))
-    got = ours.compute()
-    exp = want.compute()
-    for key in ("raw", "min", "max"):
-        np.testing.assert_allclose(float(got[key]), float(exp[key]), atol=1e-6, err_msg=key)
+        got = ours.compute()
+        exp = want.compute()
+        for key in ("raw", "min", "max"):
+            np.testing.assert_allclose(float(got[key]), float(exp[key]), atol=1e-6, err_msg=key)
+    # the tracked extrema must actually have diverged from the final raw value
+    assert float(got["min"]) < float(got["raw"]) or float(got["max"]) > float(got["raw"])
 
 
 def test_multioutput_wrapper_streaming_matches_reference(ref):
