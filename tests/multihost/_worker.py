@@ -1,0 +1,280 @@
+"""Subprocess worker for the multi-process (DCN) test pool.
+
+Launched N times by ``tests/test_multihost.py`` with a localhost
+coordinator; each process initializes ``jax.distributed`` on the CPU
+backend (Gloo collectives) and runs every scenario, writing its results to
+``<out>/rank<r>.json``.  This is the process-level analogue of the
+reference's session-global 2-process Gloo pool
+(reference tests/unittests/conftest.py:28-63) — here it drives
+``MultiHostBackend``'s shape/dtype negotiation, empty-rank adoption,
+pad-gather-trim, and the host-object wire end-to-end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _tolist(x):
+    import numpy as np
+
+    return np.asarray(x).tolist()
+
+
+# ------------------------------------------------- tiny offline text stack
+# (mirrors tests/multimodal/test_model_metrics.py; duplicated here because the
+# worker runs outside pytest and must not import test modules)
+
+
+class WordTokenizer:
+    cls_token_id = 1
+    sep_token_id = 2
+    pad_token_id = 0
+    mask_token_id = 3
+
+    def __init__(self):
+        self.vocab = {}
+
+    def _id(self, word):
+        if word not in self.vocab:
+            self.vocab[word] = 4 + (len(self.vocab) % 96)
+        return self.vocab[word]
+
+    def __call__(self, sentences, **kwargs):
+        import numpy as np
+
+        rows = [
+            [self.cls_token_id] + [self._id(w) for w in s.lower().split()] + [self.sep_token_id]
+            for s in sentences
+        ]
+        max_len = max(len(r) for r in rows)
+        input_ids = np.full((len(rows), max_len), self.pad_token_id, np.int32)
+        attention = np.zeros((len(rows), max_len), np.int32)
+        for i, r in enumerate(rows):
+            input_ids[i, : len(r)] = r
+            attention[i, : len(r)] = 1
+        return {"input_ids": input_ids, "attention_mask": attention}
+
+
+class ToyEmbedder:
+    def __init__(self, dim=16, vocab=100, seed=0):
+        import jax.numpy as jnp
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        self.table = jnp.asarray(rng.standard_normal((vocab, dim)), jnp.float32)
+
+    def __call__(self, model, batch):
+        import jax.numpy as jnp
+
+        ids = jnp.asarray(batch["input_ids"])
+        return self.table[ids]
+
+
+# --------------------------------------------------------------- corpora
+# deterministic and rank-strided so the parent can recompute the union
+
+
+def classification_shard(rank, world, n=256, classes=7):
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    logits = rng.standard_normal((n, classes)).astype(np.float32)
+    labels = rng.integers(0, classes, n)
+    return logits[rank::world], labels[rank::world]
+
+
+def sentence_shard(rank, world):
+    preds, target = sentence_corpus()
+    return preds[rank::world], target[rank::world]
+
+
+def sentence_corpus():
+    preds = [
+        "the cat sat on the mat",
+        "a dog barked loudly",
+        "hello there general kenobi",
+        "one two three four five",
+        "the quick brown fox jumps",
+        "rain falls on the plain",
+        "metrics are fun to build",
+    ]
+    target = [
+        "the cat sat on a mat",
+        "the dog barked",
+        "hello there",
+        "one two three four",
+        "a quick brown fox leaps",
+        "rain fell on a plain",
+        "metrics are hard to build",
+    ]
+    return preds, target
+
+
+def detection_corpus(n_images=12, seed=3):
+    """Per-image random boxes; image i has (i % 4) detections and (i % 3 + 1) gts."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    preds, target = [], []
+    for i in range(n_images):
+        nd, ng = i % 4, i % 3 + 1
+        db = rng.uniform(0, 50, (nd, 2))
+        preds.append(
+            {
+                "boxes": np.concatenate([db, db + rng.uniform(5, 40, (nd, 2))], -1).astype(np.float32),
+                "scores": rng.uniform(0.1, 1.0, nd).astype(np.float32),
+                "labels": rng.integers(0, 3, nd),
+            }
+        )
+        gb = rng.uniform(0, 50, (ng, 2))
+        target.append(
+            {
+                "boxes": np.concatenate([gb, gb + rng.uniform(5, 40, (ng, 2))], -1).astype(np.float32),
+                "labels": rng.integers(0, 3, ng),
+            }
+        )
+    return preds, target
+
+
+# -------------------------------------------------------------- scenarios
+
+
+def run_scenarios(rank: int, world: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpumetrics import MetricCollection
+    from tpumetrics.aggregation import CatMetric
+    from tpumetrics.classification import MulticlassAccuracy, MulticlassAUROC, MulticlassF1Score
+    from tpumetrics.detection import MeanAveragePrecision
+    from tpumetrics.parallel.backend import MultiHostBackend, get_default_backend
+    from tpumetrics.text import BERTScore
+
+    backend = MultiHostBackend()
+    results = {
+        "init": {
+            "rank": rank,
+            "world": world,
+            "process_count": jax.process_count(),
+            "default_backend": type(get_default_backend()).__name__,
+            "available": backend.available(),
+            "world_size": backend.world_size(),
+        }
+    }
+
+    # --- backend branch coverage -------------------------------------
+    # equal shapes → _gather_equal fast path
+    g = backend.all_gather(jnp.arange(4, dtype=jnp.int32) + 10 * rank)
+    results["gather_equal"] = [_tolist(v) for v in g]
+
+    # 0-d input → atleast_1d
+    g = backend.all_gather(jnp.float32(rank + 0.5))
+    results["gather_scalar"] = [_tolist(v) for v in g]
+
+    # per-rank dim-0 sizes → pad-gather-trim
+    x = jnp.arange((rank + 1) * 3, dtype=jnp.float32).reshape(rank + 1, 3) + 100 * rank
+    g = backend.all_gather(x)
+    results["gather_uneven"] = [{"shape": list(v.shape), "vals": _tolist(v)} for v in g]
+
+    # rank 0 holds an empty f32 1-D placeholder, everyone else (rank+1, 2)
+    # int32 → dtype adoption + ndim normalization + pad-gather-trim
+    if rank == 0:
+        x = jnp.zeros((0,), jnp.float32)
+    else:
+        x = jnp.arange((rank + 1) * 2, dtype=jnp.int32).reshape(rank + 1, 2) + 100 * rank
+    g = backend.all_gather(x)
+    results["gather_empty_rank"] = [
+        {"shape": list(v.shape), "dtype": str(v.dtype), "vals": _tolist(v)} for v in g
+    ]
+
+    # every rank empty → equal-shape fast path with zero-size payloads
+    g = backend.all_gather(jnp.zeros((0,), jnp.float32))
+    results["gather_all_empty"] = [{"shape": list(v.shape), "dtype": str(v.dtype)} for v in g]
+
+    # fused reductions
+    x = jnp.asarray([rank + 1.0, rank * 2.0], jnp.float32)
+    results["allreduce"] = {op: _tolist(backend.all_reduce(x, op)) for op in ("sum", "mean", "max", "min")}
+
+    # host-object wire (ragged pickled payloads)
+    obj = {"rank": rank, "words": [f"w{rank}_{i}" for i in range(rank + 1)]}
+    results["gather_object"] = backend.all_gather_object(obj)
+
+    # --- metric end-to-end over the ambient backend ------------------
+    # sum-reduced states
+    logits, labels = classification_shard(rank, world)
+    acc = MulticlassAccuracy(num_classes=7, average="micro")
+    acc.update(jnp.asarray(logits), jnp.asarray(labels))
+    results["metric_acc"] = float(acc.compute())
+
+    # uneven cat-state with an empty rank (rank 0 never updates)
+    cat = CatMetric()
+    for i in range(rank * 2):
+        cat.update(jnp.float32(rank * 10 + i))
+    results["metric_cat"] = _tolist(cat.compute())
+
+    # MetricCollection (mixed state shapes incl. binned curve state)
+    coll = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=7, average="micro"),
+            "f1": MulticlassF1Score(num_classes=7, average="macro"),
+            "auroc": MulticlassAUROC(num_classes=7, thresholds=64),
+        }
+    )
+    coll.update(jnp.asarray(logits), jnp.asarray(labels))
+    results["metric_collection"] = {k: float(v) for k, v in coll.compute().items()}
+
+    # BERTScore sentence-state merge over the host-object wire
+    preds, target = sentence_shard(rank, world)
+    bs = BERTScore(model=ToyEmbedder(), user_tokenizer=WordTokenizer(), user_forward_fn=ToyEmbedder(), idf=True)
+    if preds:
+        bs.update(list(preds), list(target))
+    out = bs.compute()
+    results["metric_bertscore"] = {k: _tolist(out[k]) for k in ("precision", "recall", "f1")}
+    # unsync must restore the local shard
+    results["bertscore_local_after_compute"] = list(bs._preds)
+
+    # mAP: ragged per-image reduce-None list states via _gather_ragged_list
+    dpreds, dtarget = detection_corpus()
+    mp = MeanAveragePrecision(iou_type="bbox")
+    mp.update(
+        [{k: jnp.asarray(v) for k, v in p.items()} for p in dpreds[rank::world]],
+        [{k: jnp.asarray(v) for k, v in t.items()} for t in dtarget[rank::world]],
+    )
+    mres = mp.compute()
+    results["metric_map"] = {k: float(np.asarray(v).reshape(-1)[0]) for k, v in mres.items() if k != "classes"}
+
+    return results
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{args.port}",
+        num_processes=args.world,
+        process_id=args.rank,
+    )
+
+    results = run_scenarios(args.rank, args.world)
+
+    path = os.path.join(args.out, f"rank{args.rank}.json")
+    with open(path + ".tmp", "w") as fh:
+        json.dump(results, fh)
+    os.replace(path + ".tmp", path)
+    print(f"worker rank {args.rank}/{args.world} OK", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -262,15 +262,18 @@ def test_bert_score_batched_forward_matches_single():
         assert np.allclose(np.asarray(big[k]), np.asarray(tiny[k]), atol=1e-6), k
 
 
-def test_text_model_metrics_refuse_string_state_sync():
-    """Sentence buffers are host strings; a cross-process sync must raise
-    rather than silently score one rank's shard."""
+def test_text_model_metrics_string_state_sync_policy():
+    """Sentence buffers are host strings: an in-trace (array-only) backend
+    must raise rather than silently score one rank's shard; an eager backend
+    with a host-object channel merges them (cross-process this is
+    MultiHostBackend.all_gather_object — tests/test_multihost.py)."""
     from tpumetrics.metric import TPUMetricsUserError
+    from tpumetrics.parallel.backend import AxisBackend
     from tpumetrics.text import BERTScore
 
     tok = _WordTokenizer()
     emb = _ToyEmbedder()
-    m = BERTScore(model=emb, user_tokenizer=tok, user_forward_fn=emb)
+    m = BERTScore(model=emb, user_tokenizer=tok, user_forward_fn=emb, sync_backend=AxisBackend("ddp"))
     m.update(["a b"], ["a b"])
     with pytest.raises(TPUMetricsUserError):
         m._sync_dist()
@@ -279,3 +282,25 @@ def test_text_model_metrics_refuse_string_state_sync():
     m2 = BERTScore(model=emb, user_tokenizer=tok, user_forward_fn=emb, sentences_replicated=True)
     m2.update(["a b"], ["a b"])
     m2._sync_dist()  # must not raise
+
+    # eager single-process backend: object-gather is the identity, sync succeeds
+    m3 = BERTScore(model=emb, user_tokenizer=tok, user_forward_fn=emb)
+    m3.update(["a b"], ["a b"])
+    m3._sync_dist()  # must not raise
+    assert m3._preds == ["a b"]
+    m3.reset()
+    assert m3._sentence_cache is None
+
+    # a custom dist_sync_fn only sees array states — it must not silently
+    # merge arrays while keeping one rank's sentence shard
+    m4 = BERTScore(model=emb, user_tokenizer=tok, user_forward_fn=emb)
+    m4.update(["a b"], ["a b"])
+    with pytest.raises(TPUMetricsUserError):
+        m4._sync_dist(dist_sync_fn=lambda x, group: [x])
+
+    # dist_sync_on_step would merge-but-never-restore the unregistered
+    # sentence buffers through forward's per-step sync — must stay loud
+    m5 = BERTScore(model=emb, user_tokenizer=tok, user_forward_fn=emb, dist_sync_on_step=True)
+    m5.update(["a b"], ["a b"])
+    with pytest.raises(TPUMetricsUserError):
+        m5._sync_dist()

@@ -1,0 +1,364 @@
+"""Multi-process (DCN) sync tests: a real ``jax.distributed`` CPU pool.
+
+The reference proves its sync machinery with a session-global 2-process Gloo
+pool through which every metric test runs (reference
+tests/unittests/conftest.py:28-63, helpers/testers.py:368-431).  This is the
+TPU-framework analogue for the *process-level* half of the distributed story
+(the in-trace ICI half lives in tests/test_ddp.py): a session-scoped pool of
+2 (and 4) subprocesses, each ``jax.distributed.initialize``-d against a
+localhost coordinator on the CPU backend, drives ``MultiHostBackend``'s
+shape/dtype negotiation, empty-rank adoption, pad-gather-trim, the
+host-object wire, and whole metrics (sum states, uneven cat states,
+BERTScore sentence merge, MetricCollection, ragged mAP states) end-to-end.
+Workers live in ``tests/multihost/_worker.py``; every rank writes its
+results as JSON and the parent asserts them against the union-of-shards
+reference computed in-process.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO_ROOT, "tests", "multihost", "_worker.py")
+WORLD_SIZES = (2, 4)
+
+
+def _load_worker_module():
+    spec = importlib.util.spec_from_file_location("_mh_worker", WORKER)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_worker = _load_worker_module()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _subprocess_env() -> dict:
+    env = dict(os.environ)
+    # drop the axon TPU boot (sitecustomize registers a PJRT plugin that
+    # pre-initializes jax before jax.distributed.initialize could run)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("AXON_POOL_SVC_OVERRIDE", None)
+    env["PYTHONPATH"] = REPO_ROOT
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""  # one CPU device per process
+    env["HF_HUB_OFFLINE"] = "1"
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO_ROOT, ".jax_cache")
+    return env
+
+
+def _run_pool(world: int, tmpdir: str, timeout: float = 600.0):
+    port = _free_port()
+    procs = []
+    for rank in range(world):
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, WORKER, "--rank", str(rank), "--world", str(world),
+                 "--port", str(port), "--out", tmpdir],
+                env=_subprocess_env(),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                cwd=REPO_ROOT,
+            )
+        )
+    logs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            logs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            out, _ = p.communicate()
+            logs.append(out)
+        raise RuntimeError(f"multihost pool (world={world}) timed out.\n" + "\n---\n".join(logs))
+    if any(p.returncode != 0 for p in procs):
+        raise RuntimeError(
+            f"multihost pool (world={world}) failed: rc={[p.returncode for p in procs]}\n"
+            + "\n---\n".join(logs)
+        )
+    results = []
+    for rank in range(world):
+        with open(os.path.join(tmpdir, f"rank{rank}.json")) as fh:
+            results.append(json.load(fh))
+    return results
+
+
+_POOL_CACHE: dict = {}
+
+
+@pytest.fixture(scope="session")
+def mh_pool(tmp_path_factory):
+    """Session pool launcher: one subprocess fleet per world size, results cached."""
+
+    def get(world: int):
+        if world not in _POOL_CACHE:
+            out = tmp_path_factory.mktemp(f"mh{world}")
+            _POOL_CACHE[world] = _run_pool(world, str(out))
+        return _POOL_CACHE[world]
+
+    return get
+
+
+@pytest.fixture(params=WORLD_SIZES)
+def pool(request, mh_pool):
+    return request.param, mh_pool(request.param)
+
+
+# ----------------------------------------------------------------- backend
+
+
+def test_pool_initialized(pool):
+    world, results = pool
+    for rank, res in enumerate(results):
+        assert res["init"]["rank"] == rank
+        assert res["init"]["world"] == world
+        assert res["init"]["process_count"] == world
+        # get_default_backend() must auto-select the DCN backend under jax.distributed
+        assert res["init"]["default_backend"] == "MultiHostBackend"
+        assert res["init"]["available"] is True
+        assert res["init"]["world_size"] == world
+
+
+def test_gather_equal_shapes(pool):
+    world, results = pool
+    expected = [[10 * r + i for i in range(4)] for r in range(world)]
+    for res in results:
+        assert res["gather_equal"] == expected
+
+
+def test_gather_scalar_promotes_to_1d(pool):
+    world, results = pool
+    expected = [[r + 0.5] for r in range(world)]
+    for res in results:
+        assert res["gather_scalar"] == expected
+
+
+def test_gather_uneven_dim0_pad_gather_trim(pool):
+    world, results = pool
+    for res in results:
+        for r in range(world):
+            entry = res["gather_uneven"][r]
+            assert entry["shape"] == [r + 1, 3]
+            expect = (np.arange((r + 1) * 3, dtype=np.float32).reshape(r + 1, 3) + 100 * r).tolist()
+            assert entry["vals"] == expect
+
+
+def test_gather_empty_rank_adopts_dtype_and_ndim(pool):
+    world, results = pool
+    for res in results:
+        entry0 = res["gather_empty_rank"][0]
+        # rank 0's zero-size f32 1-D placeholder came back as an empty row of
+        # the data ranks' 2-D int32 layout
+        assert entry0["shape"] == [0, 2]
+        assert entry0["dtype"] == "int32"
+        for r in range(1, world):
+            entry = res["gather_empty_rank"][r]
+            assert entry["shape"] == [r + 1, 2]
+            assert entry["dtype"] == "int32"
+            expect = (np.arange((r + 1) * 2, dtype=np.int32).reshape(r + 1, 2) + 100 * r).tolist()
+            assert entry["vals"] == expect
+
+
+def test_gather_all_empty(pool):
+    world, results = pool
+    for res in results:
+        assert len(res["gather_all_empty"]) == world
+        for entry in res["gather_all_empty"]:
+            assert entry["shape"] == [0]
+
+
+def test_allreduce_ops(pool):
+    world, results = pool
+    ranks = np.arange(world, dtype=np.float64)
+    per_rank = np.stack([ranks + 1.0, ranks * 2.0], axis=-1)  # (world, 2)
+    expected = {
+        "sum": per_rank.sum(0).tolist(),
+        "mean": per_rank.mean(0).tolist(),
+        "max": per_rank.max(0).tolist(),
+        "min": per_rank.min(0).tolist(),
+    }
+    for res in results:
+        for op, want in expected.items():
+            assert np.allclose(res["allreduce"][op], want), op
+
+
+def test_gather_object_wire(pool):
+    world, results = pool
+    expected = [{"rank": r, "words": [f"w{r}_{i}" for i in range(r + 1)]} for r in range(world)]
+    for res in results:
+        assert res["gather_object"] == expected
+
+
+# ----------------------------------------------------------------- metrics
+
+
+def test_metric_sum_state_equals_full_corpus(pool):
+    from tpumetrics.classification import MulticlassAccuracy
+
+    world, results = pool
+    logits, labels = _worker.classification_shard(0, 1)
+    full = MulticlassAccuracy(num_classes=7, average="micro")
+    full.update(jnp.asarray(logits), jnp.asarray(labels))
+    want = float(full.compute())
+    for res in results:
+        assert res["metric_acc"] == pytest.approx(want, abs=1e-6)
+
+
+def test_metric_uneven_cat_state_with_empty_rank(pool):
+    world, results = pool
+    want = [float(r * 10 + i) for r in range(world) for i in range(r * 2)]
+    for res in results:
+        assert np.allclose(res["metric_cat"], want)
+
+
+def test_metric_collection_syncs_every_member(pool):
+    from tpumetrics import MetricCollection
+    from tpumetrics.classification import MulticlassAccuracy, MulticlassAUROC, MulticlassF1Score
+
+    world, results = pool
+    logits, labels = _worker.classification_shard(0, 1)
+    full = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=7, average="micro"),
+            "f1": MulticlassF1Score(num_classes=7, average="macro"),
+            "auroc": MulticlassAUROC(num_classes=7, thresholds=64),
+        }
+    )
+    full.update(jnp.asarray(logits), jnp.asarray(labels))
+    want = {k: float(v) for k, v in full.compute().items()}
+    for res in results:
+        for k, v in want.items():
+            assert res["metric_collection"][k] == pytest.approx(v, abs=1e-6), k
+
+
+def test_bertscore_sentence_state_merge(pool):
+    from tpumetrics.text import BERTScore
+
+    world, results = pool
+    # union in rank order — the order the object-gather produces
+    preds_all, target_all = [], []
+    for r in range(world):
+        p, t = _worker.sentence_shard(r, world)
+        preds_all += p
+        target_all += t
+    full = BERTScore(
+        model=_worker.ToyEmbedder(),
+        user_tokenizer=_worker.WordTokenizer(),
+        user_forward_fn=_worker.ToyEmbedder(),
+        idf=True,
+    )
+    full.update(preds_all, target_all)
+    want = {k: np.asarray(v) for k, v in full.compute().items()}
+    for rank, res in enumerate(results):
+        for k in ("precision", "recall", "f1"):
+            assert np.allclose(res["metric_bertscore"][k], want[k], atol=1e-5), k
+        # unsync restored the local shard after compute
+        local_preds, _ = _worker.sentence_shard(rank, world)
+        assert res["bertscore_local_after_compute"] == list(local_preds)
+
+
+def test_map_ragged_states_gather(pool):
+    from tpumetrics.detection import MeanAveragePrecision
+
+    world, results = pool
+    dpreds, dtarget = _worker.detection_corpus()
+    full = MeanAveragePrecision(iou_type="bbox")
+    # feed in the rank-gather order (ragged gather concatenates rank blocks)
+    order = [i for r in range(world) for i in range(r, len(dpreds), world)]
+    full.update(
+        [{k: jnp.asarray(v) for k, v in dpreds[i].items()} for i in order],
+        [{k: jnp.asarray(v) for k, v in dtarget[i].items()} for i in order],
+    )
+    res_full = full.compute()
+    want = {
+        k: float(np.asarray(v).reshape(-1)[0]) for k, v in res_full.items() if k != "classes"
+    }
+    for res in results:
+        for k, v in want.items():
+            assert res["metric_map"][k] == pytest.approx(v, abs=1e-6), k
+
+
+def test_ranks_agree_on_everything(pool):
+    world, results = pool
+    for res in results[1:]:
+        for key in results[0]:
+            if key == "init" or key == "bertscore_local_after_compute":
+                continue
+            assert res[key] == results[0][key], key
+
+
+# ----------------------------------------------------------------- example
+
+
+def test_multihost_eval_example_multiprocess(tmp_path):
+    """examples/multihost_eval.py in its real 2-process mode, values asserted
+    against an in-process full-corpus recompute."""
+    from tpumetrics import MetricCollection
+    from tpumetrics.classification import MulticlassAccuracy, MulticlassAUROC, MulticlassF1Score
+
+    example = os.path.join(REPO_ROOT, "examples", "multihost_eval.py")
+    port = _free_port()
+    env = _subprocess_env()
+    env.update({"JAX_COORDINATOR": f"127.0.0.1:{port}", "JAX_NUM_PROCESSES": "2"})
+    procs = []
+    for rank in range(2):
+        env_r = dict(env, JAX_PROCESS_ID=str(rank))
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, example], env=env_r, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True, cwd=REPO_ROOT,
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    assert all(p.returncode == 0 for p in procs), "\n---\n".join(outs)
+    rank0_out = outs[0]
+    assert "multihost_eval OK" in rank0_out
+
+    spec = importlib.util.spec_from_file_location("_mh_example", example)
+    example_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(example_mod)
+    logits, labels = example_mod.local_shard(0, 1)
+    full = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=example_mod.NUM_CLASSES, average="micro"),
+            "f1": MulticlassF1Score(num_classes=example_mod.NUM_CLASSES, average="macro"),
+            "auroc": MulticlassAUROC(num_classes=example_mod.NUM_CLASSES, thresholds=128),
+        }
+    )
+    full.update(jnp.asarray(logits), jnp.asarray(labels))
+    want = {k: float(v) for k, v in full.compute().items()}
+    printed = {}
+    for line in rank0_out.splitlines():
+        parts = line.strip().split(": ")
+        if len(parts) == 2 and parts[0] in want:
+            printed[parts[0]] = float(parts[1])
+    assert set(printed) == set(want)
+    for k, v in want.items():
+        assert printed[k] == pytest.approx(v, abs=5e-4), k

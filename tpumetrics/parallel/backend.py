@@ -48,6 +48,18 @@ class DistributedBackend:
         """
         raise NotImplementedError
 
+    def all_gather_object(self, obj: Any, group: Optional[Any] = None) -> List[Any]:
+        """Gather an arbitrary picklable host object from every rank.
+
+        Counterpart of ``torch.distributed.all_gather_object`` (used by the
+        reference for string/dict states, e.g. detection/mean_ap.py); only
+        eager cross-process backends can move host objects — an in-trace
+        backend has no host round trip and must leave this unimplemented.
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} cannot gather host objects (no eager host channel)."
+        )
+
     def all_reduce(self, x: Array, op: str, group: Optional[Any] = None) -> Array:
         """Fused reduction (op in sum/mean/max/min); default = gather + local reduce."""
         gathered = jnp.stack(self.all_gather(x, group))
@@ -76,6 +88,9 @@ class NoOpBackend(DistributedBackend):
 
     def all_gather(self, x: Array, group: Optional[Any] = None) -> List[Array]:
         return [x]
+
+    def all_gather_object(self, obj: Any, group: Optional[Any] = None) -> List[Any]:
+        return [obj]
 
     def all_reduce(self, x: Array, op: str, group: Optional[Any] = None) -> Array:
         return x
@@ -180,6 +195,19 @@ class MultiHostBackend(DistributedBackend):
         return [
             g[tuple(slice(0, int(d)) for d in shape)] for g, shape in zip(gathered, norm_shapes)
         ]
+
+    def all_gather_object(self, obj: Any, group: Optional[Any] = None) -> List[Any]:
+        """Pickle → uint8 vector → uneven all_gather → unpickle per rank.
+
+        The host-object wire the reference gets from
+        ``torch.distributed.all_gather_object``; rides the same padded DCN
+        gather as array states, so ragged payload sizes are fine.
+        """
+        import pickle
+
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        gathered = self.all_gather(jnp.asarray(payload), group=group)
+        return [pickle.loads(np.asarray(g).tobytes()) for g in gathered]
 
 
 _DEFAULT_BACKEND: Optional[DistributedBackend] = None

@@ -1,26 +1,31 @@
 """Shared sync policy for metrics whose state includes raw Python sentences
-(BERTScore, InfoLM): strings live outside the array sync path, so a
-cross-process sync is refused unless the caller declares the corpus
-replicated on every rank."""
+(BERTScore, InfoLM): strings live outside the array sync path.  Across
+processes (DCN) they travel through the backend's ``all_gather_object``
+host-object wire — the analogue of the reference running its tokenized cat
+states through ``all_gather`` (reference text/bert.py:191-194).  Inside a
+trace there is no host channel, so an in-trace sync is refused unless the
+caller declares the corpus replicated on every rank."""
 
 from __future__ import annotations
 
 
 class HostSentenceStateMixin:
-    """Mixin refusing dist-sync of host-side sentence buffers.
+    """Mixin syncing host-side sentence buffers via object-gather.
 
     Subclasses set ``self.sentences_replicated`` in ``__init__`` and keep
     their sentence buffers in ``self._preds`` / ``self._target``.
     """
 
     sentences_replicated: bool = False
+    _sentence_cache = None
 
     @property
     def sentence_state(self):
         """The accumulated (predictions, references) sentence lists — the
-        public handle for a multi-host object-gather: gather both lists from
-        every rank (e.g. over DCN), feed the union into one metric, compute
-        once.  Returns copies; mutating them does not touch the metric."""
+        public handle for a manual multi-host object-gather: gather both
+        lists from every rank (e.g. over DCN), feed the union into one
+        metric, compute once.  Returns copies; mutating them does not touch
+        the metric."""
         return list(self._preds), list(self._target)
 
     def _sync_dist(self, dist_sync_fn=None, process_group=None) -> None:
@@ -31,9 +36,59 @@ class HostSentenceStateMixin:
             # declaration. A custom dist_sync_fn alone is NOT enough — it
             # only sees the array states, never the strings.
             return super()._sync_dist(dist_sync_fn=dist_sync_fn, process_group=process_group)
-        raise TPUMetricsUserError(
-            f"{type(self).__name__} keeps raw sentences as host-side state and cannot"
-            " dist-sync them. Either compute per process and aggregate the returned"
-            " scores, or replicate the sentences to every rank before update() and"
-            " construct with sentences_replicated=True (or sync_on_compute=False)."
-        )
+
+        if getattr(self, "dist_sync_on_step", False):
+            # forward()'s in-step sync saves/restores *registered* states only
+            # (metric.py:346-362); the unregistered sentence lists would be
+            # merged but never restored — silent corpus corruption. The
+            # pre-object-gather behavior (always raise) is kept for this flag.
+            raise TPUMetricsUserError(
+                f"{type(self).__name__} keeps raw sentences as host-side state and does"
+                " not support dist_sync_on_step=True (forward's per-step sync cannot"
+                " restore host-side sentence buffers). Sync once at compute() instead,"
+                " or replicate sentences on every rank with sentences_replicated=True."
+            )
+        if dist_sync_fn is not None:
+            # a custom gather fn only ever sees the array states; letting it
+            # run would merge arrays while silently keeping one rank's
+            # sentence shard
+            raise TPUMetricsUserError(
+                f"{type(self).__name__} keeps raw sentences as host-side state; a custom"
+                " dist_sync_fn cannot move them (it only sees array states). Either"
+                " drop dist_sync_fn (the backend's host-object channel syncs sentences),"
+                " compute per process and aggregate the returned scores, or replicate"
+                " the sentences to every rank and construct with"
+                " sentences_replicated=True."
+            )
+
+        backend = self._active_backend()
+        group = process_group or self.process_group
+        try:
+            gathered = backend.all_gather_object(
+                (list(self._preds), list(self._target)), group=group
+            )
+        except NotImplementedError:
+            raise TPUMetricsUserError(
+                f"{type(self).__name__} keeps raw sentences as host-side state, and the"
+                f" active backend ({type(backend).__name__}) has no host-object channel"
+                " to sync them (in-trace collectives move arrays only). Either compute"
+                " per process and aggregate the returned scores, or replicate the"
+                " sentences to every rank before update() and construct with"
+                " sentences_replicated=True (or sync_on_compute=False)."
+            ) from None
+        # merge the array states first: if that fails, the sentence buffers
+        # are still untouched and a retried sync re-gathers the local shard
+        super()._sync_dist(dist_sync_fn=dist_sync_fn, process_group=process_group)
+        self._sentence_cache = (self._preds, self._target)
+        self._preds = [p for rank_preds, _ in gathered for p in rank_preds]
+        self._target = [t for _, rank_target in gathered for t in rank_target]
+
+    def unsync(self, should_unsync: bool = True) -> None:
+        super().unsync(should_unsync)
+        if should_unsync and self._sentence_cache is not None:
+            self._preds, self._target = self._sentence_cache
+            self._sentence_cache = None
+
+    def reset(self) -> None:
+        super().reset()
+        self._sentence_cache = None
