@@ -264,3 +264,121 @@ def test_ppl_honors_num_samples():
         _, _, dist = perceptual_path_length(gen, num_samples=n, batch_size=b, resize=None,
                                             sim_net=toy_net, latent_dim=8)
         assert dist.shape == (n,), (n, b, dist.shape)
+
+
+# --------------------------------------------- pretrained-backbone path
+# int/str `feature` with converted InceptionV3 weights (architecture parity
+# itself is proven in test_inception_backbone.py; here: the metric wiring)
+
+
+@pytest.fixture(scope="module")
+def inception_npz(tmp_path_factory):
+    from tpumetrics.image._inception import random_inception_params
+
+    path = tmp_path_factory.mktemp("inception") / "inception.npz"
+    np.savez(path, **random_inception_params(seed=2))
+    return str(path)
+
+
+def test_fid_int_feature_with_weights(inception_npz):
+    imgs_a = np.asarray(_rng.integers(0, 256, (6, 3, 64, 64)), np.uint8)
+    # a *different* distribution (dark, low-contrast) so FID(real, fake) ≫ FID(real, real)
+    imgs_b = np.asarray(_rng.integers(0, 64, (6, 3, 64, 64)), np.uint8)
+    fid = FrechetInceptionDistance(feature=64, feature_extractor_weights_path=inception_npz)
+    assert fid.num_features == 64
+    fid.update(jnp.asarray(imgs_a), real=True)
+    fid.update(jnp.asarray(imgs_b), real=False)
+    different = float(fid.compute())
+    assert np.isfinite(different) and different > 0
+
+    fid_same = FrechetInceptionDistance(feature=64, feature_extractor_weights_path=inception_npz)
+    fid_same.update(jnp.asarray(imgs_a), real=True)
+    fid_same.update(jnp.asarray(imgs_a), real=False)
+    same = abs(float(fid_same.compute()))
+    assert same < 1e-3 and same < 0.01 * different
+
+
+def test_fid_int_feature_env_var(inception_npz, monkeypatch):
+    monkeypatch.setenv("TPUMETRICS_INCEPTION_WEIGHTS", inception_npz)
+    fid = FrechetInceptionDistance(feature=192)
+    assert fid.num_features == 192
+
+
+def test_int_feature_without_weights_raises_with_recipe(monkeypatch):
+    monkeypatch.delenv("TPUMETRICS_INCEPTION_WEIGHTS", raising=False)
+    for cls in (FrechetInceptionDistance, KernelInceptionDistance,
+                MemorizationInformedFrechetInceptionDistance):
+        with pytest.raises(ModuleNotFoundError, match="_inception_convert"):
+            cls(feature=2048)
+    with pytest.raises(ModuleNotFoundError, match="_inception_convert"):
+        InceptionScore()  # default feature="logits_unbiased"
+    with pytest.raises(ValueError, match="feature"):
+        FrechetInceptionDistance(feature=123)
+
+
+def test_kid_is_mifid_int_feature_with_weights(inception_npz):
+    imgs_a = np.asarray(_rng.integers(0, 256, (5, 3, 32, 32)), np.uint8)
+    imgs_b = np.asarray(_rng.integers(0, 256, (5, 3, 32, 32)), np.uint8)
+
+    kid = KernelInceptionDistance(
+        feature=192, subsets=2, subset_size=5, feature_extractor_weights_path=inception_npz
+    )
+    kid.update(jnp.asarray(imgs_a), real=True)
+    kid.update(jnp.asarray(imgs_b), real=False)
+    k_mean, _ = kid.compute()
+    assert np.isfinite(float(k_mean))
+
+    mifid = MemorizationInformedFrechetInceptionDistance(
+        feature=64, feature_extractor_weights_path=inception_npz
+    )
+    mifid.update(jnp.asarray(imgs_a), real=True)
+    mifid.update(jnp.asarray(imgs_b), real=False)
+    assert np.isfinite(float(mifid.compute()))
+
+    is_ = InceptionScore(splits=2, feature_extractor_weights_path=inception_npz)
+    is_.update(jnp.asarray(imgs_a))
+    mean, std = is_.compute()
+    # a random-weight classifier still yields a valid IS >= 1 (up to f32 eps)
+    assert float(mean) >= 1.0 - 1e-5 and np.isfinite(float(std))
+
+
+def test_fid_untraceable_extractor_falls_back_to_eager(recwarn):
+    """A host/numpy-based extractor can't be jit-traced; update must warn once
+    and run eagerly instead of raising (advisor r3)."""
+
+    def host_extract(imgs):
+        arr = np.asarray(imgs, np.float32)  # leaves jax → TracerArrayConversionError under jit
+        return jnp.asarray(arr.reshape(arr.shape[0], -1)[:, :_DIM])
+
+    real, fake = _images(8, 3), _images(8, 4)
+    fid = FrechetInceptionDistance(feature=host_extract, num_features=_DIM)
+    fid.update(jnp.asarray(real), real=True)
+    fid.update(jnp.asarray(fake), real=False)
+    assert fid._jit_accum.eager_mode
+    assert any("not jit-traceable" in str(w.message) for w in recwarn.list)
+    n_warn = sum("not jit-traceable" in str(w.message) for w in recwarn.list)
+    assert n_warn == 1  # warn once, not per update
+    want = FrechetInceptionDistance(feature=_extract, num_features=_DIM)
+    want.update(jnp.asarray(real), real=True)
+    want.update(jnp.asarray(fake), real=False)
+    assert np.isclose(float(fid.compute()), float(want.compute()), rtol=1e-5)
+
+
+def test_fid_transient_error_does_not_latch_eager(recwarn):
+    """A data error (wrong feature width for one batch) must propagate and NOT
+    permanently downgrade the metric to eager dispatch."""
+    width = {"w": _DIM}
+
+    def flaky_extract(imgs):
+        flat = jnp.asarray(imgs, jnp.float32).reshape(imgs.shape[0], -1)
+        return flat[:, : width["w"]]
+
+    fid = FrechetInceptionDistance(feature=flaky_extract, num_features=_DIM)
+    width["w"] = _DIM + 3  # wrong feature width → shape error in the accumulate
+    with pytest.raises(TypeError):
+        fid.update(jnp.asarray(_images(4, 1)), real=True)
+    assert not fid._jit_accum.eager_mode  # transient failure did not latch
+    assert not any("not jit-traceable" in str(w.message) for w in recwarn.list)
+    width["w"] = _DIM
+    fid.update(jnp.asarray(_images(4, 1)), real=True)  # jit path still active
+    assert not fid._jit_accum.eager_mode

@@ -1,0 +1,354 @@
+"""Architecture parity for the FID InceptionV3 backbone.
+
+The reference's extractor is torch-fidelity's TF-ported InceptionV3
+(reference image/fid.py:30-44); that package isn't installed here and its
+pretrained checkpoint can't be downloaded, so the oracle is a torch
+re-implementation of the same architecture (the LPIPS-backbone pattern,
+tests/reference_parity/test_lpips_parity.py): both sides load the SAME
+random parameters and must produce the same features at every tap, through
+the TF1-compatible resize, for non-square inputs, up- and down-scaled.
+This also exercises the offline weight converter end to end
+(torch ``state_dict`` → ``convert_state_dict`` → ``.npz`` →
+``load_inception_params``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+from torch import nn
+
+from tpumetrics.image._inception import (
+    NUM_CLASSES,
+    inception_param_spec,
+    inception_v3_features,
+    load_inception_params,
+    random_inception_params,
+    tf1_bilinear_resize,
+)
+from tpumetrics.image._inception_convert import convert_state_dict
+
+TAPS = ("64", "192", "768", "2048", "logits_unbiased", "logits")
+
+
+# ------------------------------------------------------------- torch twin
+
+
+def _tf1_resize_torch(x: torch.Tensor, size) -> torch.Tensor:
+    """TF1 align_corners=False bilinear (src = dst * in/out, clamped lerp)."""
+    out_h, out_w = size
+    _, _, in_h, in_w = x.shape
+
+    def tables(insz, outsz):
+        scale = insz / outsz
+        src = torch.arange(outsz, dtype=x.dtype) * scale
+        lo = src.floor().long().clamp(0, insz - 1)
+        hi = (lo + 1).clamp(max=insz - 1)
+        frac = src - lo.to(x.dtype)
+        return lo, hi, frac
+
+    h_lo, h_hi, h_frac = tables(in_h, out_h)
+    w_lo, w_hi, w_frac = tables(in_w, out_w)
+    top, bot = x[:, :, h_lo, :], x[:, :, h_hi, :]
+    rows = top + (bot - top) * h_frac[None, None, :, None]
+    left, right = rows[..., w_lo], rows[..., w_hi]
+    return left + (right - left) * w_frac
+
+
+class _BasicConv2d(nn.Module):
+    def __init__(self, cin, cout, **kw):
+        super().__init__()
+        self.conv = nn.Conv2d(cin, cout, bias=False, **kw)
+        self.bn = nn.BatchNorm2d(cout, eps=0.001)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+class _BlockA(nn.Module):
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.branch1x1 = _BasicConv2d(cin, 64, kernel_size=1)
+        self.branch5x5_1 = _BasicConv2d(cin, 48, kernel_size=1)
+        self.branch5x5_2 = _BasicConv2d(48, 64, kernel_size=5, padding=2)
+        self.branch3x3dbl_1 = _BasicConv2d(cin, 64, kernel_size=1)
+        self.branch3x3dbl_2 = _BasicConv2d(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = _BasicConv2d(96, 96, kernel_size=3, padding=1)
+        self.branch_pool = _BasicConv2d(cin, pool_features, kernel_size=1)
+
+    def forward(self, x):
+        pool = F.avg_pool2d(x, 3, stride=1, padding=1, count_include_pad=False)
+        return torch.cat(
+            [
+                self.branch1x1(x),
+                self.branch5x5_2(self.branch5x5_1(x)),
+                self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x))),
+                self.branch_pool(pool),
+            ],
+            1,
+        )
+
+
+class _BlockB(nn.Module):
+    def __init__(self, cin):
+        super().__init__()
+        self.branch3x3 = _BasicConv2d(cin, 384, kernel_size=3, stride=2)
+        self.branch3x3dbl_1 = _BasicConv2d(cin, 64, kernel_size=1)
+        self.branch3x3dbl_2 = _BasicConv2d(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = _BasicConv2d(96, 96, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        return torch.cat(
+            [
+                self.branch3x3(x),
+                self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x))),
+                F.max_pool2d(x, 3, stride=2),
+            ],
+            1,
+        )
+
+
+class _BlockC(nn.Module):
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.branch1x1 = _BasicConv2d(cin, 192, kernel_size=1)
+        self.branch7x7_1 = _BasicConv2d(cin, c7, kernel_size=1)
+        self.branch7x7_2 = _BasicConv2d(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7_3 = _BasicConv2d(c7, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_1 = _BasicConv2d(cin, c7, kernel_size=1)
+        self.branch7x7dbl_2 = _BasicConv2d(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_3 = _BasicConv2d(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7dbl_4 = _BasicConv2d(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_5 = _BasicConv2d(c7, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch_pool = _BasicConv2d(cin, 192, kernel_size=1)
+
+    def forward(self, x):
+        b7 = self.branch7x7_3(self.branch7x7_2(self.branch7x7_1(x)))
+        bd = x
+        for mod in (self.branch7x7dbl_1, self.branch7x7dbl_2, self.branch7x7dbl_3,
+                    self.branch7x7dbl_4, self.branch7x7dbl_5):
+            bd = mod(bd)
+        pool = F.avg_pool2d(x, 3, stride=1, padding=1, count_include_pad=False)
+        return torch.cat([self.branch1x1(x), b7, bd, self.branch_pool(pool)], 1)
+
+
+class _BlockD(nn.Module):
+    def __init__(self, cin):
+        super().__init__()
+        self.branch3x3_1 = _BasicConv2d(cin, 192, kernel_size=1)
+        self.branch3x3_2 = _BasicConv2d(192, 320, kernel_size=3, stride=2)
+        self.branch7x7x3_1 = _BasicConv2d(cin, 192, kernel_size=1)
+        self.branch7x7x3_2 = _BasicConv2d(192, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7x3_3 = _BasicConv2d(192, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7x3_4 = _BasicConv2d(192, 192, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        b7 = x
+        for mod in (self.branch7x7x3_1, self.branch7x7x3_2, self.branch7x7x3_3, self.branch7x7x3_4):
+            b7 = mod(b7)
+        return torch.cat(
+            [self.branch3x3_2(self.branch3x3_1(x)), b7, F.max_pool2d(x, 3, stride=2)], 1
+        )
+
+
+class _BlockE(nn.Module):
+    def __init__(self, cin, pool):
+        super().__init__()
+        self.pool = pool
+        self.branch1x1 = _BasicConv2d(cin, 320, kernel_size=1)
+        self.branch3x3_1 = _BasicConv2d(cin, 384, kernel_size=1)
+        self.branch3x3_2a = _BasicConv2d(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3_2b = _BasicConv2d(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = _BasicConv2d(cin, 448, kernel_size=1)
+        self.branch3x3dbl_2 = _BasicConv2d(448, 384, kernel_size=3, padding=1)
+        self.branch3x3dbl_3a = _BasicConv2d(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3dbl_3b = _BasicConv2d(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch_pool = _BasicConv2d(cin, 192, kernel_size=1)
+
+    def forward(self, x):
+        b3 = self.branch3x3_1(x)
+        b3 = torch.cat([self.branch3x3_2a(b3), self.branch3x3_2b(b3)], 1)
+        bd = self.branch3x3dbl_2(self.branch3x3dbl_1(x))
+        bd = torch.cat([self.branch3x3dbl_3a(bd), self.branch3x3dbl_3b(bd)], 1)
+        if self.pool == "max":
+            pool = F.max_pool2d(x, 3, stride=1, padding=1)
+        else:
+            pool = F.avg_pool2d(x, 3, stride=1, padding=1, count_include_pad=False)
+        return torch.cat([self.branch1x1(x), b3, bd, self.branch_pool(pool)], 1)
+
+
+class _TwinInceptionV3(nn.Module):
+    """torch re-implementation of torch-fidelity's FID InceptionV3 forward."""
+
+    def __init__(self):
+        super().__init__()
+        self.Conv2d_1a_3x3 = _BasicConv2d(3, 32, kernel_size=3, stride=2)
+        self.Conv2d_2a_3x3 = _BasicConv2d(32, 32, kernel_size=3)
+        self.Conv2d_2b_3x3 = _BasicConv2d(32, 64, kernel_size=3, padding=1)
+        self.Conv2d_3b_1x1 = _BasicConv2d(64, 80, kernel_size=1)
+        self.Conv2d_4a_3x3 = _BasicConv2d(80, 192, kernel_size=3)
+        self.Mixed_5b = _BlockA(192, 32)
+        self.Mixed_5c = _BlockA(256, 64)
+        self.Mixed_5d = _BlockA(288, 64)
+        self.Mixed_6a = _BlockB(288)
+        self.Mixed_6b = _BlockC(768, 128)
+        self.Mixed_6c = _BlockC(768, 160)
+        self.Mixed_6d = _BlockC(768, 160)
+        self.Mixed_6e = _BlockC(768, 192)
+        self.Mixed_7a = _BlockD(768)
+        self.Mixed_7b = _BlockE(1280, pool="avg")
+        self.Mixed_7c = _BlockE(2048, pool="max")
+        self.fc = nn.Linear(2048, NUM_CLASSES)
+
+    @torch.no_grad()
+    def forward(self, x_uint8: torch.Tensor) -> dict:
+        out = {}
+        x = x_uint8.to(self.fc.weight.dtype)
+        x = _tf1_resize_torch(x, (299, 299))
+        x = (x - 128) / 128
+        x = self.Conv2d_1a_3x3(x)
+        x = self.Conv2d_2a_3x3(x)
+        x = self.Conv2d_2b_3x3(x)
+        x = F.max_pool2d(x, 3, stride=2)
+        out["64"] = F.adaptive_avg_pool2d(x, (1, 1)).squeeze(-1).squeeze(-1)
+        x = self.Conv2d_3b_1x1(x)
+        x = self.Conv2d_4a_3x3(x)
+        x = F.max_pool2d(x, 3, stride=2)
+        out["192"] = F.adaptive_avg_pool2d(x, (1, 1)).squeeze(-1).squeeze(-1)
+        for name in ("Mixed_5b", "Mixed_5c", "Mixed_5d", "Mixed_6a", "Mixed_6b", "Mixed_6c",
+                     "Mixed_6d", "Mixed_6e"):
+            x = getattr(self, name)(x)
+        out["768"] = F.adaptive_avg_pool2d(x, (1, 1)).squeeze(-1).squeeze(-1)
+        for name in ("Mixed_7a", "Mixed_7b", "Mixed_7c"):
+            x = getattr(self, name)(x)
+        x = F.adaptive_avg_pool2d(x, (1, 1)).flatten(1)
+        out["2048"] = x
+        out["logits_unbiased"] = x.mm(self.fc.weight.T)
+        out["logits"] = out["logits_unbiased"] + self.fc.bias.unsqueeze(0)
+        return out
+
+
+@pytest.fixture(scope="module")
+def twin_and_params():
+    params = random_inception_params(seed=5)
+    twin = _TwinInceptionV3().eval()
+    missing, unexpected = twin.load_state_dict(
+        {k: torch.from_numpy(v) for k, v in params.items()}, strict=False
+    )
+    # the only keys our spec doesn't carry are BN bookkeeping counters
+    assert not unexpected
+    assert all(k.endswith("num_batches_tracked") for k in missing)
+    return twin, params
+
+
+# ---------------------------------------------------------------- resize
+
+
+def test_tf1_resize_known_values():
+    """src = dst * in/out with edge clamp — NOT half-pixel (TF2/torch) mapping."""
+    import jax.numpy as jnp
+
+    x = jnp.arange(4, dtype=jnp.float32).reshape(1, 1, 1, 4)
+    out = np.asarray(tf1_bilinear_resize(x, (1, 8)))[0, 0, 0]
+    np.testing.assert_allclose(out, [0.0, 0.5, 1.0, 1.5, 2.0, 2.5, 3.0, 3.0], atol=1e-6)
+    # torch's align_corners=False half-pixel resize gives a different vector —
+    # the TF1 projection is the whole point
+    half_pixel = F.interpolate(
+        torch.arange(4, dtype=torch.float32).reshape(1, 1, 1, 4), size=(1, 8), mode="bilinear",
+        align_corners=False,
+    ).numpy()[0, 0, 0]
+    assert not np.allclose(out, half_pixel)
+
+
+@pytest.mark.parametrize("in_shape", [(31, 45), (299, 299), (512, 340), (150, 200)])
+def test_tf1_resize_matches_twin(in_shape):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0, 255, (2, 3) + in_shape).astype(np.float32)
+    ours = np.asarray(tf1_bilinear_resize(jnp.asarray(x), (299, 299)))
+    want = _tf1_resize_torch(torch.from_numpy(x), (299, 299)).numpy()
+    np.testing.assert_allclose(ours, want, rtol=1e-5, atol=1e-3)
+
+
+# ------------------------------------------------------------ full parity
+
+
+@pytest.mark.parametrize("in_shape", [(200, 150), (320, 300)])
+def test_inception_architecture_parity(twin_and_params, tmp_path, in_shape):
+    import jax.numpy as jnp
+
+    twin, params = twin_and_params
+    # converter round trip: torch state_dict → npz → loaded params
+    converted = convert_state_dict(twin.state_dict())
+    for k, v in params.items():
+        np.testing.assert_array_equal(converted[k], v)
+    path = tmp_path / "inception.npz"
+    np.savez(path, **converted)
+    loaded = load_inception_params(str(path))
+
+    rng = np.random.default_rng(1)
+    imgs = rng.integers(0, 256, (2, 3) + in_shape, dtype=np.uint8)
+    want = twin(torch.from_numpy(imgs))
+    fwd = inception_v3_features(loaded, TAPS)
+    got = fwd(jnp.asarray(imgs))
+    for tap, ours in zip(TAPS, got):
+        ref = want[tap].numpy()
+        assert ours.shape == ref.shape, tap
+        scale = np.maximum(np.abs(ref).max(), 1e-3)
+        np.testing.assert_allclose(
+            np.asarray(ours), ref, atol=2e-3 * scale, rtol=2e-3, err_msg=f"tap {tap}"
+        )
+
+
+def test_inception_parity_float64_exact(tmp_path):
+    """Same comparison in float64 (x64 subprocess, torch double): agreement at
+    1e-10 proves the f32 tolerance above is roundoff, not topology drift."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    script = """
+import jax
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_enable_x64', True)
+import sys
+sys.path.insert(0, {repo!r})
+import importlib.util
+spec = importlib.util.spec_from_file_location('twin_mod', {this!r})
+m = importlib.util.module_from_spec(spec); spec.loader.exec_module(m)
+import numpy as np, torch, jax.numpy as jnp
+from tpumetrics.image._inception import inception_v3_features, random_inception_params
+params = random_inception_params(seed=5)
+twin = m._TwinInceptionV3().double().eval()
+twin.load_state_dict({{k: torch.from_numpy(v).double() for k, v in params.items()}}, strict=False)
+rng = np.random.default_rng(1)
+imgs = rng.integers(0, 256, (1, 3, 200, 150), dtype=np.uint8)
+want = twin(torch.from_numpy(imgs))
+fwd = inception_v3_features({{k: jnp.asarray(v, jnp.float64) for k, v in params.items()}}, m.TAPS)
+got = fwd(jnp.asarray(imgs).astype(jnp.float64))
+for tap, ours in zip(m.TAPS, got):
+    ref = want[tap].numpy()
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=1e-10, rtol=1e-8, err_msg=tap)
+print('INCEPTION_F64_OK')
+"""
+    code = script.format(repo=repo, this=os.path.abspath(__file__))
+    env = dict(os.environ, JAX_ENABLE_X64="1", JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = repo
+    out = subprocess.run(
+        [_sys.executable, "-c", code], capture_output=True, text=True, env=env, timeout=580
+    )
+    assert "INCEPTION_F64_OK" in out.stdout, f"stdout:\n{out.stdout}\nstderr:\n{out.stderr[-2000:]}"
+
+
+def test_param_spec_matches_twin_exactly(twin_and_params):
+    twin, _ = twin_and_params
+    spec = inception_param_spec()
+    sd = {k: v for k, v in twin.state_dict().items() if not k.endswith("num_batches_tracked")}
+    assert set(spec) == set(sd)
+    for k, shape in spec.items():
+        assert tuple(sd[k].shape) == shape, k

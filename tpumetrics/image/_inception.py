@@ -1,0 +1,451 @@
+"""FID InceptionV3 feature extractor as a pure-jax forward function.
+
+The reference's default feature extractor for FID/KID/IS/MiFID is
+``NoTrainInceptionV3`` (reference ``image/fid.py:30-44,45-157``), a wrapper
+around torch-fidelity's ``FeatureExtractorInceptionV3`` — the TF-ported
+"pt_inception-2015-12-05" network whose exact quirks define the metric:
+
+- the **TF1-compatible bilinear resize** to 299×299 with ``align_corners=False``
+  semantics (``src = dst * in/out``, *no* half-pixel offset — reference
+  fid.py:32,83-88; FID values are famously sensitive to exactly this resize);
+- ``(x - 128) / 128`` input scaling from uint8;
+- torchvision's InceptionV3 topology with the FID deviations: the pooling
+  branches of the A/C/E blocks use ``count_include_pad=False`` average
+  pooling, and ``Mixed_7c`` (E_2) uses a **max** pool branch;
+- feature taps at ``64`` / ``192`` / ``768`` / ``2048`` / ``logits_unbiased``
+  / ``logits`` (1008 classes), reference fid.py:90-151.
+
+Pretrained weights cannot be downloaded in an offline environment, so the
+forward takes its parameters as data (same pattern as the LPIPS backbones in
+``_backbones.py``): a flat ``{torch_state_dict_key: array}`` mapping that a
+user converts offline from the reference's checkpoint with::
+
+    python -m tpumetrics.image._inception_convert pt_inception-2015-12-05-6726825d.pth inception.npz
+
+Everything is jit-compatible: static conv plans, ``lax`` pooling windows, no
+data-dependent control flow.  On TPU the convs land on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+Array = jax.Array
+
+INPUT_IMAGE_SIZE = 299
+NUM_CLASSES = 1008
+VALID_INT_FEATURES = (64, 192, 768, 2048)
+VALID_STR_FEATURES = ("logits_unbiased", "logits")
+_BN_EPS = 1e-3
+
+
+# ------------------------------------------------------------ architecture
+# every BasicConv2d as (name, in_ch, out_ch, (kh, kw), stride, (ph, pw));
+# block topology mirrors torch-fidelity's FeatureExtractorInceptionV3
+
+
+def _inception_a(name: str, in_ch: int, pool_features: int):
+    return [
+        (f"{name}.branch1x1", in_ch, 64, (1, 1), 1, (0, 0)),
+        (f"{name}.branch5x5_1", in_ch, 48, (1, 1), 1, (0, 0)),
+        (f"{name}.branch5x5_2", 48, 64, (5, 5), 1, (2, 2)),
+        (f"{name}.branch3x3dbl_1", in_ch, 64, (1, 1), 1, (0, 0)),
+        (f"{name}.branch3x3dbl_2", 64, 96, (3, 3), 1, (1, 1)),
+        (f"{name}.branch3x3dbl_3", 96, 96, (3, 3), 1, (1, 1)),
+        (f"{name}.branch_pool", in_ch, pool_features, (1, 1), 1, (0, 0)),
+    ]
+
+
+def _inception_b(name: str, in_ch: int):
+    return [
+        (f"{name}.branch3x3", in_ch, 384, (3, 3), 2, (0, 0)),
+        (f"{name}.branch3x3dbl_1", in_ch, 64, (1, 1), 1, (0, 0)),
+        (f"{name}.branch3x3dbl_2", 64, 96, (3, 3), 1, (1, 1)),
+        (f"{name}.branch3x3dbl_3", 96, 96, (3, 3), 2, (0, 0)),
+    ]
+
+
+def _inception_c(name: str, in_ch: int, c7: int):
+    return [
+        (f"{name}.branch1x1", in_ch, 192, (1, 1), 1, (0, 0)),
+        (f"{name}.branch7x7_1", in_ch, c7, (1, 1), 1, (0, 0)),
+        (f"{name}.branch7x7_2", c7, c7, (1, 7), 1, (0, 3)),
+        (f"{name}.branch7x7_3", c7, 192, (7, 1), 1, (3, 0)),
+        (f"{name}.branch7x7dbl_1", in_ch, c7, (1, 1), 1, (0, 0)),
+        (f"{name}.branch7x7dbl_2", c7, c7, (7, 1), 1, (3, 0)),
+        (f"{name}.branch7x7dbl_3", c7, c7, (1, 7), 1, (0, 3)),
+        (f"{name}.branch7x7dbl_4", c7, c7, (7, 1), 1, (3, 0)),
+        (f"{name}.branch7x7dbl_5", c7, 192, (1, 7), 1, (0, 3)),
+        (f"{name}.branch_pool", in_ch, 192, (1, 1), 1, (0, 0)),
+    ]
+
+
+def _inception_d(name: str, in_ch: int):
+    return [
+        (f"{name}.branch3x3_1", in_ch, 192, (1, 1), 1, (0, 0)),
+        (f"{name}.branch3x3_2", 192, 320, (3, 3), 2, (0, 0)),
+        (f"{name}.branch7x7x3_1", in_ch, 192, (1, 1), 1, (0, 0)),
+        (f"{name}.branch7x7x3_2", 192, 192, (1, 7), 1, (0, 3)),
+        (f"{name}.branch7x7x3_3", 192, 192, (7, 1), 1, (3, 0)),
+        (f"{name}.branch7x7x3_4", 192, 192, (3, 3), 2, (0, 0)),
+    ]
+
+
+def _inception_e(name: str, in_ch: int):
+    return [
+        (f"{name}.branch1x1", in_ch, 320, (1, 1), 1, (0, 0)),
+        (f"{name}.branch3x3_1", in_ch, 384, (1, 1), 1, (0, 0)),
+        (f"{name}.branch3x3_2a", 384, 384, (1, 3), 1, (0, 1)),
+        (f"{name}.branch3x3_2b", 384, 384, (3, 1), 1, (1, 0)),
+        (f"{name}.branch3x3dbl_1", in_ch, 448, (1, 1), 1, (0, 0)),
+        (f"{name}.branch3x3dbl_2", 448, 384, (3, 3), 1, (1, 1)),
+        (f"{name}.branch3x3dbl_3a", 384, 384, (1, 3), 1, (0, 1)),
+        (f"{name}.branch3x3dbl_3b", 384, 384, (3, 1), 1, (1, 0)),
+        (f"{name}.branch_pool", in_ch, 192, (1, 1), 1, (0, 0)),
+    ]
+
+
+_CONV_SPECS: List[Tuple[str, int, int, Tuple[int, int], int, Tuple[int, int]]] = [
+    ("Conv2d_1a_3x3", 3, 32, (3, 3), 2, (0, 0)),
+    ("Conv2d_2a_3x3", 32, 32, (3, 3), 1, (0, 0)),
+    ("Conv2d_2b_3x3", 32, 64, (3, 3), 1, (1, 1)),
+    ("Conv2d_3b_1x1", 64, 80, (1, 1), 1, (0, 0)),
+    ("Conv2d_4a_3x3", 80, 192, (3, 3), 1, (0, 0)),
+    *_inception_a("Mixed_5b", 192, 32),
+    *_inception_a("Mixed_5c", 256, 64),
+    *_inception_a("Mixed_5d", 288, 64),
+    *_inception_b("Mixed_6a", 288),
+    *_inception_c("Mixed_6b", 768, 128),
+    *_inception_c("Mixed_6c", 768, 160),
+    *_inception_c("Mixed_6d", 768, 160),
+    *_inception_c("Mixed_6e", 768, 192),
+    *_inception_d("Mixed_7a", 768),
+    *_inception_e("Mixed_7b", 1280),
+    *_inception_e("Mixed_7c", 2048),
+]
+
+
+def inception_param_spec() -> Dict[str, Tuple[int, ...]]:
+    """``{torch_state_dict_key: shape}`` for every parameter of the network."""
+    spec: Dict[str, Tuple[int, ...]] = {}
+    for name, cin, cout, (kh, kw), _stride, _pad in _CONV_SPECS:
+        spec[f"{name}.conv.weight"] = (cout, cin, kh, kw)
+        spec[f"{name}.bn.weight"] = (cout,)
+        spec[f"{name}.bn.bias"] = (cout,)
+        spec[f"{name}.bn.running_mean"] = (cout,)
+        spec[f"{name}.bn.running_var"] = (cout,)
+    spec["fc.weight"] = (NUM_CLASSES, 2048)
+    spec["fc.bias"] = (NUM_CLASSES,)
+    return spec
+
+
+def random_inception_params(seed: int = 0) -> Dict[str, np.ndarray]:
+    """Random-but-stable parameters (BN stats kept benign so activations stay
+    O(1) through the 48-conv stack) — for architecture parity tests."""
+    rng = np.random.default_rng(seed)
+    params: Dict[str, np.ndarray] = {}
+    for key, shape in inception_param_spec().items():
+        if key.endswith("conv.weight") or key == "fc.weight":
+            fan_in = int(np.prod(shape[1:]))
+            params[key] = (rng.standard_normal(shape) / np.sqrt(fan_in)).astype(np.float32)
+        elif key.endswith("running_var"):
+            params[key] = rng.uniform(0.5, 1.5, shape).astype(np.float32)
+        elif key.endswith("bn.weight"):
+            params[key] = (1.0 + 0.1 * rng.standard_normal(shape)).astype(np.float32)
+        else:  # bn.bias / running_mean / fc.bias
+            params[key] = (0.1 * rng.standard_normal(shape)).astype(np.float32)
+    return params
+
+
+def check_inception_params(params: Mapping[str, np.ndarray]) -> None:
+    spec = inception_param_spec()
+    missing = sorted(set(spec) - set(params))
+    if missing:
+        raise ValueError(
+            f"InceptionV3 parameters are missing {len(missing)} entries, e.g. {missing[:4]};"
+            " convert the reference checkpoint with"
+            " `python -m tpumetrics.image._inception_convert <pt_inception.pth> <out.npz>`."
+        )
+    for key, shape in spec.items():
+        got = tuple(params[key].shape)
+        if got != shape:
+            raise ValueError(f"InceptionV3 parameter `{key}` has shape {got}, expected {shape}")
+
+
+_PARAMS_CACHE: Dict[Tuple[str, float], Dict[str, Array]] = {}
+
+
+def load_inception_params(path: str) -> Dict[str, Array]:
+    """Load a converted ``.npz`` parameter file (see ``_inception_convert``).
+
+    Cached per (absolute path, mtime): a typical eval builds FID + KID + IS
+    against the same file, and the ~24M-parameter upload should happen once.
+    Treat the returned mapping as read-only.
+    """
+    import os
+
+    key = (os.path.abspath(path), os.path.getmtime(path))
+    if key in _PARAMS_CACHE:
+        return _PARAMS_CACHE[key]
+    with np.load(path) as data:
+        params = {k: np.asarray(data[k]) for k in data.files}
+    check_inception_params(params)
+    loaded = {k: jnp.asarray(v) for k, v in params.items()}
+    _PARAMS_CACHE.clear()  # keep at most one weight set resident
+    _PARAMS_CACHE[key] = loaded
+    return loaded
+
+
+# ---------------------------------------------------------------- kernels
+
+
+def tf1_bilinear_resize(x: Array, size: Tuple[int, int]) -> Array:
+    """TF1 ``resize_bilinear(align_corners=False)`` on NCHW input.
+
+    Source coordinate is ``dst * (in / out)`` — the legacy TF1 projection with
+    no half-pixel offset (what torch-fidelity's
+    ``interpolate_bilinear_2d_like_tensorflow1x`` replicates and FID scores
+    depend on, reference fid.py:83-88).  Gather + lerp per axis; fully
+    jit/TPU-compatible (static index tables).
+    """
+    out_h, out_w = size
+    _, _, in_h, in_w = x.shape
+    dtype = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+
+    def axis_tables(in_size: int, out_size: int):
+        scale = in_size / out_size
+        src = jnp.arange(out_size, dtype=dtype) * scale
+        lo = jnp.floor(src).astype(jnp.int32)
+        lo = jnp.clip(lo, 0, in_size - 1)
+        hi = jnp.minimum(lo + 1, in_size - 1)
+        frac = src - lo.astype(dtype)
+        return lo, hi, frac
+
+    h_lo, h_hi, h_frac = axis_tables(in_h, out_h)
+    w_lo, w_hi, w_frac = axis_tables(in_w, out_w)
+
+    x = x.astype(dtype)
+    top = x[:, :, h_lo, :]
+    bottom = x[:, :, h_hi, :]
+    rows = top + (bottom - top) * h_frac[None, None, :, None]
+    left = rows[:, :, :, w_lo]
+    right = rows[:, :, :, w_hi]
+    return left + (right - left) * w_frac[None, None, None, :]
+
+
+def _avgpool3_no_pad_count(x: Array) -> Array:
+    """torch ``avg_pool2d(kernel=3, stride=1, padding=1, count_include_pad=False)``
+    — the FID-variant pooling in the A/C/E_1 blocks."""
+    summed = lax.reduce_window(
+        x, 0.0, lax.add, (1, 1, 3, 3), (1, 1, 1, 1), [(0, 0), (0, 0), (1, 1), (1, 1)]
+    )
+    ones = jnp.ones((1, 1) + x.shape[2:], x.dtype)
+    counts = lax.reduce_window(
+        ones, 0.0, lax.add, (1, 1, 3, 3), (1, 1, 1, 1), [(0, 0), (0, 0), (1, 1), (1, 1)]
+    )
+    return summed / counts
+
+
+def _maxpool3(x: Array, stride: int, padding: int = 0) -> Array:
+    return lax.reduce_window(
+        x,
+        -jnp.inf,
+        lax.max,
+        (1, 1, 3, 3),
+        (1, 1, stride, stride),
+        [(0, 0), (0, 0), (padding, padding), (padding, padding)],
+    )
+
+
+def _global_avgpool(x: Array) -> Array:
+    return jnp.mean(x, axis=(2, 3))
+
+
+class _Net:
+    """Bound parameters + per-BasicConv2d fused conv→BN→relu application."""
+
+    def __init__(self, params: Mapping[str, Array]):
+        self.p = params
+        self.spec = {name: (k, s, pad) for name, _ci, _co, k, s, pad in _CONV_SPECS}
+
+    def conv(self, x: Array, name: str) -> Array:
+        kernel, stride, (ph, pw) = self.spec[name]
+        w = jnp.asarray(self.p[f"{name}.conv.weight"], x.dtype)
+        out = lax.conv_general_dilated(
+            x, w, (stride, stride), [(ph, ph), (pw, pw)], dimension_numbers=("NCHW", "OIHW", "NCHW")
+        )
+        # inference BN folded to scale/shift (eps matches torch BN default for
+        # inception: 0.001)
+        gamma = self.p[f"{name}.bn.weight"]
+        beta = self.p[f"{name}.bn.bias"]
+        mean = self.p[f"{name}.bn.running_mean"]
+        var = self.p[f"{name}.bn.running_var"]
+        scale = (gamma / jnp.sqrt(var + _BN_EPS)).astype(x.dtype).reshape(1, -1, 1, 1)
+        shift = (beta - gamma * mean / jnp.sqrt(var + _BN_EPS)).astype(x.dtype).reshape(1, -1, 1, 1)
+        return jax.nn.relu(out * scale + shift)
+
+    def block_a(self, x: Array, name: str) -> Array:
+        b1 = self.conv(x, f"{name}.branch1x1")
+        b5 = self.conv(self.conv(x, f"{name}.branch5x5_1"), f"{name}.branch5x5_2")
+        b3 = self.conv(
+            self.conv(self.conv(x, f"{name}.branch3x3dbl_1"), f"{name}.branch3x3dbl_2"),
+            f"{name}.branch3x3dbl_3",
+        )
+        bp = self.conv(_avgpool3_no_pad_count(x), f"{name}.branch_pool")
+        return jnp.concatenate([b1, b5, b3, bp], axis=1)
+
+    def block_b(self, x: Array, name: str) -> Array:
+        b3 = self.conv(x, f"{name}.branch3x3")
+        bd = self.conv(
+            self.conv(self.conv(x, f"{name}.branch3x3dbl_1"), f"{name}.branch3x3dbl_2"),
+            f"{name}.branch3x3dbl_3",
+        )
+        bp = _maxpool3(x, stride=2)
+        return jnp.concatenate([b3, bd, bp], axis=1)
+
+    def block_c(self, x: Array, name: str) -> Array:
+        b1 = self.conv(x, f"{name}.branch1x1")
+        b7 = self.conv(
+            self.conv(self.conv(x, f"{name}.branch7x7_1"), f"{name}.branch7x7_2"),
+            f"{name}.branch7x7_3",
+        )
+        bd = x
+        for i in range(1, 6):
+            bd = self.conv(bd, f"{name}.branch7x7dbl_{i}")
+        bp = self.conv(_avgpool3_no_pad_count(x), f"{name}.branch_pool")
+        return jnp.concatenate([b1, b7, bd, bp], axis=1)
+
+    def block_d(self, x: Array, name: str) -> Array:
+        b3 = self.conv(self.conv(x, f"{name}.branch3x3_1"), f"{name}.branch3x3_2")
+        b7 = x
+        for i in range(1, 5):
+            b7 = self.conv(b7, f"{name}.branch7x7x3_{i}")
+        bp = _maxpool3(x, stride=2)
+        return jnp.concatenate([b3, b7, bp], axis=1)
+
+    def block_e(self, x: Array, name: str, pool: str) -> Array:
+        b1 = self.conv(x, f"{name}.branch1x1")
+        b3 = self.conv(x, f"{name}.branch3x3_1")
+        b3 = jnp.concatenate(
+            [self.conv(b3, f"{name}.branch3x3_2a"), self.conv(b3, f"{name}.branch3x3_2b")], axis=1
+        )
+        bd = self.conv(self.conv(x, f"{name}.branch3x3dbl_1"), f"{name}.branch3x3dbl_2")
+        bd = jnp.concatenate(
+            [self.conv(bd, f"{name}.branch3x3dbl_3a"), self.conv(bd, f"{name}.branch3x3dbl_3b")], axis=1
+        )
+        # E_2 (Mixed_7c) uses a max pool where E_1 averages — the TF port's
+        # deviation from torchvision that FID features depend on
+        pooled = _maxpool3(x, stride=1, padding=1) if pool == "max" else _avgpool3_no_pad_count(x)
+        bp = self.conv(pooled, f"{name}.branch_pool")
+        return jnp.concatenate([b1, b3, bd, bp], axis=1)
+
+
+def inception_v3_features(
+    params: Mapping[str, Array], features: Sequence[str] = ("2048",)
+) -> Callable[[Array], Tuple[Array, ...]]:
+    """Build the forward: uint8 NCHW images → tuple of requested feature taps.
+
+    ``features`` entries are the reference's names: "64", "192", "768",
+    "2048", "logits_unbiased", "logits" (reference fid.py:90-151).  The
+    network is truncated after the deepest requested tap.
+    """
+    known = tuple(str(f) for f in VALID_INT_FEATURES) + VALID_STR_FEATURES
+    for f in features:
+        if f not in known:
+            raise ValueError(f"InceptionV3 feature must be one of {known}, got {f!r}")
+    check_inception_params(params)
+    net = _Net(params)
+    wanted = list(features)
+    depth_order = [str(f) for f in VALID_INT_FEATURES] + list(VALID_STR_FEATURES)
+    deepest = max(depth_order.index(f) for f in wanted)
+
+    def forward(x: Array) -> Tuple[Array, ...]:
+        if x.ndim != 4 or x.shape[1] != 3:
+            raise ValueError(f"Expected (N, 3, H, W) image batch, got shape {tuple(x.shape)}")
+        out: Dict[str, Array] = {}
+        h = x if jnp.issubdtype(x.dtype, jnp.floating) else x.astype(jnp.float32)
+        h = tf1_bilinear_resize(h, (INPUT_IMAGE_SIZE, INPUT_IMAGE_SIZE))
+        h = (h - 128.0) / 128.0
+
+        h = net.conv(h, "Conv2d_1a_3x3")
+        h = net.conv(h, "Conv2d_2a_3x3")
+        h = net.conv(h, "Conv2d_2b_3x3")
+        h = _maxpool3(h, stride=2)
+        if "64" in wanted:
+            out["64"] = _global_avgpool(h)
+        if deepest > depth_order.index("64"):
+            h = net.conv(h, "Conv2d_3b_1x1")
+            h = net.conv(h, "Conv2d_4a_3x3")
+            h = _maxpool3(h, stride=2)
+            if "192" in wanted:
+                out["192"] = _global_avgpool(h)
+        if deepest > depth_order.index("192"):
+            h = net.block_a(h, "Mixed_5b")
+            h = net.block_a(h, "Mixed_5c")
+            h = net.block_a(h, "Mixed_5d")
+            h = net.block_b(h, "Mixed_6a")
+            h = net.block_c(h, "Mixed_6b")
+            h = net.block_c(h, "Mixed_6c")
+            h = net.block_c(h, "Mixed_6d")
+            h = net.block_c(h, "Mixed_6e")
+            if "768" in wanted:
+                out["768"] = _global_avgpool(h)
+        if deepest > depth_order.index("768"):
+            h = net.block_d(h, "Mixed_7a")
+            h = net.block_e(h, "Mixed_7b", pool="avg")
+            h = net.block_e(h, "Mixed_7c", pool="max")
+            h = _global_avgpool(h)
+            if "2048" in wanted:
+                out["2048"] = h
+        if deepest > depth_order.index("2048"):
+            logits = h @ jnp.asarray(params["fc.weight"], h.dtype).T
+            if "logits_unbiased" in wanted:
+                out["logits_unbiased"] = logits
+            if "logits" in wanted:
+                out["logits"] = logits + jnp.asarray(params["fc.bias"], h.dtype)[None]
+        return tuple(out[f] for f in wanted)
+
+    return forward
+
+
+def inception_feature_extractor(
+    feature, weights_path: Optional[str] = None
+) -> Callable[[Array], Array]:
+    """Resolve an int/str ``feature`` request into a single-tap extractor.
+
+    The converted-weights path comes from ``weights_path`` or the
+    ``TPUMETRICS_INCEPTION_WEIGHTS`` environment variable; without one this
+    raises with the conversion recipe (the reference equally gates this path
+    on torch-fidelity being installed + its checkpoint download,
+    reference fid.py:53-58).
+    """
+    import os
+
+    tap = str(feature)
+    known = tuple(str(f) for f in VALID_INT_FEATURES) + VALID_STR_FEATURES
+    if tap not in known:
+        raise ValueError(
+            f"Integer/str `feature` must be one of {VALID_INT_FEATURES + VALID_STR_FEATURES}, got {feature!r}"
+        )
+    path = weights_path or os.environ.get("TPUMETRICS_INCEPTION_WEIGHTS")
+    if not path:
+        raise ModuleNotFoundError(
+            f"feature={feature!r} requests the pretrained FID InceptionV3, whose weights are not"
+            " bundled and cannot be downloaded here. Convert the reference checkpoint offline with"
+            " `python -m tpumetrics.image._inception_convert pt_inception-2015-12-05-6726825d.pth"
+            " inception.npz` and pass feature_extractor_weights_path='inception.npz' (or set"
+            " TPUMETRICS_INCEPTION_WEIGHTS). Alternatively pass any callable image→(N, D)"
+            " feature extractor."
+        )
+    params = load_inception_params(path)
+    fwd = inception_v3_features(params, (tap,))
+
+    def extract(imgs: Array) -> Array:
+        return fwd(imgs)[0]
+
+    return extract

@@ -14,22 +14,33 @@ from tpumetrics.utils.data import _is_tracer
 Array = jax.Array
 
 
-def _resolve_feature_extractor(feature: Union[int, Callable], metric_name: str):
+def _resolve_feature_extractor(
+    feature: Union[int, str, Callable],
+    metric_name: str,
+    weights_path: Optional[str] = None,
+):
     """Resolve the ``feature`` argument: a callable extractor (any function
     mapping an image batch to (N, D) features — e.g. a jitted Flax apply) is
-    used directly; an int requests the reference's pretrained InceptionV3,
-    which needs downloadable weights and is therefore gated (the reference
-    gates the same path on torch-fidelity, reference fid.py:30-44)."""
+    used directly; an int/str selects a tap of the FID InceptionV3
+    (reference fid.py:30-44 → ``_inception.py``), built from converted
+    weights (``weights_path`` / ``TPUMETRICS_INCEPTION_WEIGHTS``) and raising
+    with the conversion recipe when none are available."""
     if callable(feature):
         return feature, None
-    if isinstance(feature, int):
-        raise ModuleNotFoundError(
-            f"{metric_name} with an integer `feature` requires pretrained InceptionV3 weights, which are"
-            " not bundled and cannot be downloaded in this environment. Pass a callable feature extractor"
-            " instead (any function mapping an image batch to (N, num_features) embeddings, e.g. a"
-            " jitted Flax InceptionV3 or CLIP vision tower)."
-        )
+    if isinstance(feature, (int, str)):
+        from tpumetrics.image._inception import inception_feature_extractor
+
+        return inception_feature_extractor(feature, weights_path), feature
     raise TypeError("Got unknown input to argument `feature`")
+
+
+def _tap_num_features(tap: Union[int, str, None]) -> Optional[int]:
+    """Feature dimensionality of a named InceptionV3 tap (None for callables)."""
+    if tap is None:
+        return None
+    if isinstance(tap, str) and tap.startswith("logits"):
+        return 1008
+    return int(tap)
 
 
 def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
@@ -55,12 +66,18 @@ class FrechetInceptionDistance(Metric):
     any number of images, synced with six psums (reference fid.py:314-320).
 
     Args:
-        feature: a callable image→(N, D) feature extractor, or an int to
-            request the (gated) pretrained InceptionV3.
+        feature: a callable image→(N, D) feature extractor, or one of
+            64/192/768/2048 selecting a tap of the FID InceptionV3
+            (reference fid.py:30-44; built from converted weights — see
+            ``feature_extractor_weights_path``).
         reset_real_features: whether ``reset()`` clears the real statistics.
         normalize: inputs are [0,1] floats instead of [0,255] bytes.
-        num_features: feature dimensionality; inferred by probing the
-            extractor with a tiny batch when not given.
+        num_features: feature dimensionality; inferred from the tap or by
+            probing the extractor with a tiny batch when not given.
+        feature_extractor_weights_path: ``.npz`` produced by
+            ``python -m tpumetrics.image._inception_convert`` from the
+            reference's ``pt_inception-2015-12-05`` checkpoint; defaults to
+            the ``TPUMETRICS_INCEPTION_WEIGHTS`` environment variable.
 
     Example:
         >>> import jax, jax.numpy as jnp
@@ -87,10 +104,15 @@ class FrechetInceptionDistance(Metric):
         reset_real_features: bool = True,
         normalize: bool = False,
         num_features: Optional[int] = None,
+        feature_extractor_weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        self.inception, _ = _resolve_feature_extractor(feature, type(self).__name__)
+        self.inception, tap = _resolve_feature_extractor(
+            feature, type(self).__name__, feature_extractor_weights_path
+        )
+        if num_features is None:
+            num_features = _tap_num_features(tap)
         if num_features is None:
             probe = jnp.zeros((1, 3, 299, 299), jnp.float32)
             num_features = int(np.asarray(self.inception(probe)).shape[-1])
@@ -119,7 +141,8 @@ class FrechetInceptionDistance(Metric):
         Extractor + moment accumulation run as ONE jit call (cached per input
         shape): eagerly each op is a separate dispatch, and on a
         remote-attached accelerator the per-update cost is round trips, not
-        FLOPs."""
+        FLOPs.  A user extractor that cannot be traced (host/numpy-based)
+        falls back to the eager path with a one-time warning."""
         if self._jit_accum is None:
             inception, normalize = self.inception, self.normalize
 
@@ -130,15 +153,16 @@ class FrechetInceptionDistance(Metric):
                     f = f[None]
                 return feat_sum + f.sum(axis=0), cov_sum + f.T @ f, n + imgs.shape[0]
 
-            self._jit_accum = jax.jit(accum)
-        if real:
-            self.real_features_sum, self.real_features_cov_sum, self.real_features_num_samples = self._jit_accum(
-                self.real_features_sum, self.real_features_cov_sum, self.real_features_num_samples, imgs
+            from tpumetrics.utils.jit_fallback import JitWithEagerFallback
+
+            self._jit_accum = JitWithEagerFallback(
+                accum, f"The `feature` extractor of {type(self).__name__}"
             )
-        else:
-            self.fake_features_sum, self.fake_features_cov_sum, self.fake_features_num_samples = self._jit_accum(
-                self.fake_features_sum, self.fake_features_cov_sum, self.fake_features_num_samples, imgs
-            )
+        prefix = "real" if real else "fake"
+        states = tuple(getattr(self, f"{prefix}_features_{s}") for s in ("sum", "cov_sum", "num_samples"))
+        out = self._jit_accum(*states, imgs)
+        for s, val in zip(("sum", "cov_sum", "num_samples"), out):
+            setattr(self, f"{prefix}_features_{s}", val)
 
     def __getstate__(self):
         state = super().__getstate__()

@@ -44,16 +44,17 @@ class InceptionScore(Metric):
 
     def __init__(
         self,
-        feature: Union[int, Callable] = "logits_unbiased",
+        feature: Union[int, str, Callable] = "logits_unbiased",
         splits: int = 10,
         normalize: bool = False,
         seed: Optional[int] = None,
+        feature_extractor_weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        if isinstance(feature, str):
-            feature = 1008  # the reference's logits head — equally gated
-        self.inception, _ = _resolve_feature_extractor(feature, type(self).__name__)
+        self.inception, _ = _resolve_feature_extractor(
+            feature, type(self).__name__, feature_extractor_weights_path
+        )
         if not isinstance(normalize, bool):
             raise ValueError("Argument `normalize` expected to be a bool")
         self.normalize = normalize

@@ -98,10 +98,13 @@ class KernelInceptionDistance(Metric):
         reset_real_features: bool = True,
         normalize: bool = False,
         seed: Optional[int] = None,
+        feature_extractor_weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        self.inception, _ = _resolve_feature_extractor(feature, type(self).__name__)
+        self.inception, _ = _resolve_feature_extractor(
+            feature, type(self).__name__, feature_extractor_weights_path
+        )
 
         if not (isinstance(subsets, int) and subsets > 0):
             raise ValueError("Argument `subsets` expected to be integer larger than 0")

@@ -85,11 +85,15 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         accelerator."""
         if self._jit_loss is None:
             net, weights, normalize = self.net, self.layer_weights, self.normalize
-            self._jit_loss = jax.jit(
-                lambda a, b: learned_perceptual_image_patch_similarity(
+
+            def loss_fn(a, b):
+                return learned_perceptual_image_patch_similarity(
                     a, b, net, weights, normalize, reduction="sum"
                 )
-            )
+
+            from tpumetrics.utils.jit_fallback import JitWithEagerFallback
+
+            self._jit_loss = JitWithEagerFallback(loss_fn, "The LPIPS backbone")
         loss = self._jit_loss(img1, img2)
         self.sum_scores = self.sum_scores + loss
         self.total = self.total + img1.shape[0]

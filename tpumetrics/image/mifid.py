@@ -70,10 +70,13 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
         reset_real_features: bool = True,
         normalize: bool = False,
         cosine_distance_eps: float = 0.1,
+        feature_extractor_weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        self.inception, _ = _resolve_feature_extractor(feature, type(self).__name__)
+        self.inception, _ = _resolve_feature_extractor(
+            feature, type(self).__name__, feature_extractor_weights_path
+        )
         if not isinstance(reset_real_features, bool):
             raise ValueError("Argument `reset_real_features` expected to be a bool")
         self.reset_real_features = reset_real_features

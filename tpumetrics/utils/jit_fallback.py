@@ -1,0 +1,48 @@
+"""Shared jit-with-eager-fallback wrapper for metrics that jit a
+user-supplied callable (FID's extractor, LPIPS's backbone, ...).
+
+The jitted path is the remote-accelerator fast path (one dispatch per
+update instead of dozens); a user callable that leaves jax (host/numpy
+code) cannot be traced, so the first trace failure falls back to eager —
+but only *latches* eager mode after the eager run succeeds, so a transient
+data error (bad shapes for one batch) doesn't permanently downgrade the
+metric with a misleading diagnosis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+
+class JitWithEagerFallback:
+    """Callable wrapping ``jax.jit(fn)`` with a one-time eager fallback.
+
+    Not picklable (holds a compiled function); owners drop it in
+    ``__getstate__`` and rebuild lazily.
+    """
+
+    def __init__(self, fn: Callable, what: str) -> None:
+        self._fn = fn
+        self._jitted = jax.jit(fn)
+        self._what = what
+        self.eager_mode = False
+
+    def __call__(self, *args: Any) -> Any:
+        if self.eager_mode:
+            return self._fn(*args)
+        try:
+            return self._jitted(*args)
+        except (jax.errors.JAXTypeError, TypeError) as err:
+            # eager re-run first: a genuine data error raises here too and
+            # must NOT flip the metric into permanent eager dispatch
+            out = self._fn(*args)
+            self.eager_mode = True
+            from tpumetrics.utils.prints import rank_zero_warn
+
+            rank_zero_warn(
+                f"{self._what} is not jit-traceable ({type(err).__name__}); falling back to"
+                " eager evaluation for all further updates."
+            )
+            return out
