@@ -82,3 +82,104 @@ def boxes_to_masks(boxes: np.ndarray, height: int, width: int, rng=None) -> np.n
                 m = keep
         out[i] = m
     return out
+
+
+def make_crowd_corpus(
+    seed: int,
+    num_images: int = 8,
+    num_classes: int = 3,
+    max_det: int = 8,
+    max_gt: int = 5,
+    crowd_prob: float = 0.35,
+    empty_gt_image: bool = True,
+) -> Tuple[List[dict], List[dict]]:
+    """Corpus with ``iscrowd`` ground truths and exact area-boundary boxes.
+
+    Crowd gts are larger regions seeded with 2-3 detections INSIDE them (a
+    crowd may absorb several detections without any counting as a miss);
+    image 0 carries a gt with area exactly 32² and image 1 one with exactly
+    96² — both COCO area-range boundaries are inclusive on both sides, so
+    these boxes belong to two ranges at once.
+    """
+    rng = np.random.default_rng(seed)
+    preds, target = [], []
+    for img in range(num_images):
+        n_gt = 0 if (img == 2 and empty_gt_image) else int(rng.integers(1, max_gt + 1))
+        gt_boxes = random_boxes(rng, n_gt)
+        iscrowd = (rng.uniform(size=n_gt) < crowd_prob).astype(np.int64)
+        if img == 0 and n_gt:
+            gt_boxes[0] = (10.0, 10.0, 42.0, 42.0)  # area exactly 32² = 1024
+        if img == 1 and n_gt:
+            gt_boxes[0] = (5.0, 5.0, 101.0, 101.0)  # area exactly 96² = 9216
+        gt_labels = rng.integers(0, num_classes, size=n_gt).astype(np.int64)
+
+        n_det = 0 if img == 3 else int(rng.integers(1, max_det + 1))
+        det_boxes = random_boxes(rng, n_det)
+        det_labels = rng.integers(0, num_classes, size=n_det).astype(np.int64)
+        for d in range(n_det):
+            if n_gt and rng.uniform() < 0.4:
+                g = int(rng.integers(n_gt))
+                jitter = rng.normal(0.0, 4.0, size=4).astype(np.float32)
+                det_boxes[d] = gt_boxes[g] + jitter
+                det_boxes[d, 2:] = np.maximum(det_boxes[d, 2:], det_boxes[d, :2] + 1.0)
+                if rng.uniform() < 0.7:
+                    det_labels[d] = gt_labels[g]
+        # seed detections inside every crowd region (same label) so crowds
+        # absorb multiple detections
+        extra_boxes, extra_labels = [], []
+        for g in range(n_gt):
+            if iscrowd[g]:
+                for _ in range(int(rng.integers(2, 4))):
+                    x1, y1, x2, y2 = gt_boxes[g]
+                    cx1 = rng.uniform(x1, max(x1 + 1.0, x2 - 2.0))
+                    cy1 = rng.uniform(y1, max(y1 + 1.0, y2 - 2.0))
+                    cx2 = rng.uniform(cx1 + 1.0, max(cx1 + 2.0, x2))
+                    cy2 = rng.uniform(cy1 + 1.0, max(cy1 + 2.0, y2))
+                    extra_boxes.append([cx1, cy1, cx2, cy2])
+                    extra_labels.append(gt_labels[g])
+        if extra_boxes:
+            det_boxes = np.concatenate([det_boxes, np.asarray(extra_boxes, np.float32)])
+            det_labels = np.concatenate([det_labels, np.asarray(extra_labels, np.int64)])
+            n_det = det_boxes.shape[0]
+
+        preds.append(
+            {
+                "boxes": det_boxes.astype(np.float32),
+                "scores": rng.uniform(0.05, 1.0, size=n_det).astype(np.float32),
+                "labels": det_labels,
+            }
+        )
+        target.append({"boxes": gt_boxes, "labels": gt_labels, "iscrowd": iscrowd})
+    return preds, target
+
+
+def make_overflow_corpus(seed: int, num_images: int = 4, num_classes: int = 2) -> Tuple[List[dict], List[dict]]:
+    """Corpus whose images carry more detections than the default maxDet=100
+    cap (and far more than the 1/10 caps), exercising truncation order."""
+    rng = np.random.default_rng(seed)
+    preds, target = [], []
+    for img in range(num_images):
+        n_gt = int(rng.integers(3, 8))
+        gt_boxes = random_boxes(rng, n_gt)
+        n_det = int(rng.integers(110, 140)) if img % 2 == 0 else int(rng.integers(5, 15))
+        det_boxes = random_boxes(rng, n_det)
+        for d in range(n_det):
+            if rng.uniform() < 0.5:
+                g = int(rng.integers(n_gt))
+                jitter = rng.normal(0.0, 5.0, size=4).astype(np.float32)
+                det_boxes[d] = gt_boxes[g] + jitter
+                det_boxes[d, 2:] = np.maximum(det_boxes[d, 2:], det_boxes[d, :2] + 1.0)
+        preds.append(
+            {
+                "boxes": det_boxes,
+                "scores": rng.uniform(0.05, 1.0, size=n_det).astype(np.float32),
+                "labels": rng.integers(0, num_classes, size=n_det).astype(np.int64),
+            }
+        )
+        target.append(
+            {
+                "boxes": gt_boxes,
+                "labels": rng.integers(0, num_classes, size=n_gt).astype(np.int64),
+            }
+        )
+    return preds, target

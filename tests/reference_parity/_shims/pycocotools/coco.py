@@ -1,0 +1,116 @@
+"""Minimal ``pycocotools.coco.COCO`` stand-in for the COCOeval shim.
+
+Provides exactly the surface the reference's primary ``MeanAveragePrecision``
+(`/root/reference/src/torchmetrics/detection/mean_ap.py:586-607`) and our
+``cocoeval`` shim use: an assignable ``.dataset`` dict in COCO format,
+``createIndex``, id-based lookups, and ``annToRLE`` (annotations arrive with
+``segmentation`` already as an RLE dict from ``mask.encode``).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+from collections import defaultdict
+
+
+class COCO:
+    def __init__(self, annotation_file=None):
+        self.dataset = {}
+        self.anns = {}
+        self.cats = {}
+        self.imgs = {}
+        self.imgToAnns = defaultdict(list)
+        self.catToImgs = defaultdict(list)
+        if annotation_file is not None:
+            with open(annotation_file) as fh:
+                self.dataset = json.load(fh)
+            self.createIndex()
+
+    def createIndex(self) -> None:
+        anns, cats, imgs = {}, {}, {}
+        imgToAnns, catToImgs = defaultdict(list), defaultdict(list)
+        for ann in self.dataset.get("annotations", []):
+            imgToAnns[ann["image_id"]].append(ann)
+            anns[ann["id"]] = ann
+            if "category_id" in ann:
+                catToImgs[ann["category_id"]].append(ann["image_id"])
+        for img in self.dataset.get("images", []):
+            imgs[img["id"]] = img
+        for cat in self.dataset.get("categories", []):
+            cats[cat["id"]] = cat
+        self.anns, self.cats, self.imgs = anns, cats, imgs
+        self.imgToAnns, self.catToImgs = imgToAnns, catToImgs
+
+    # ------------------------------------------------------------- lookups
+
+    def getAnnIds(self, imgIds=[], catIds=[], areaRng=[], iscrowd=None):
+        imgIds = imgIds if isinstance(imgIds, (list, tuple)) else [imgIds]
+        catIds = catIds if isinstance(catIds, (list, tuple)) else [catIds]
+        if len(imgIds) > 0:
+            anns = [a for i in imgIds for a in self.imgToAnns[i]]
+        else:
+            anns = self.dataset.get("annotations", [])
+        if len(catIds) > 0:
+            anns = [a for a in anns if a["category_id"] in catIds]
+        if len(areaRng) > 0:
+            anns = [a for a in anns if areaRng[0] < a["area"] < areaRng[1]]
+        if iscrowd is not None:
+            anns = [a for a in anns if a.get("iscrowd", 0) == iscrowd]
+        return [a["id"] for a in anns]
+
+    def getCatIds(self, catNms=[], supNms=[], catIds=[]):
+        cats = self.dataset.get("categories", [])
+        if len(catIds) > 0:
+            cats = [c for c in cats if c["id"] in catIds]
+        return [c["id"] for c in cats]
+
+    def getImgIds(self, imgIds=[], catIds=[]):
+        if len(imgIds) == 0 and len(catIds) == 0:
+            return list(self.imgs.keys())
+        ids = set(imgIds) if imgIds else set(self.imgs.keys())
+        for i, catId in enumerate(catIds if isinstance(catIds, (list, tuple)) else [catIds]):
+            ids &= set(self.catToImgs[catId])
+        return list(ids)
+
+    def loadAnns(self, ids=[]):
+        ids = ids if isinstance(ids, (list, tuple)) else [ids]
+        return [self.anns[i] for i in ids]
+
+    def loadCats(self, ids=[]):
+        ids = ids if isinstance(ids, (list, tuple)) else [ids]
+        return [self.cats[i] for i in ids]
+
+    def loadImgs(self, ids=[]):
+        ids = ids if isinstance(ids, (list, tuple)) else [ids]
+        return [self.imgs[i] for i in ids]
+
+    def annToRLE(self, ann):
+        seg = ann["segmentation"]
+        if isinstance(seg, dict) and "counts" in seg:
+            return seg
+        raise NotImplementedError(
+            "COCO shim supports RLE-dict segmentations only (polygon conversion not needed"
+            " by the reference path under test)"
+        )
+
+    def loadRes(self, resFile):
+        """Results loader (list of annotation dicts or json path) — used by
+        the reference's ``coco_to_tm`` utility."""
+        res = COCO()
+        res.dataset = {"images": copy.deepcopy(self.dataset.get("images", []))}
+        if isinstance(resFile, str):
+            with open(resFile) as fh:
+                anns = json.load(fh)
+        else:
+            anns = copy.deepcopy(resFile)
+        for aid, ann in enumerate(anns, start=1):
+            if "bbox" in ann and "area" not in ann:
+                x, y, w, h = ann["bbox"]
+                ann["area"] = w * h
+            ann.setdefault("id", aid)
+            ann.setdefault("iscrowd", 0)
+        res.dataset["annotations"] = anns
+        res.dataset["categories"] = copy.deepcopy(self.dataset.get("categories", []))
+        res.createIndex()
+        return res

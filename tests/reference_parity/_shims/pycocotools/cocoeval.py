@@ -1,0 +1,329 @@
+"""``pycocotools.cocoeval.COCOeval`` stand-in: the COCO detection protocol
+in plain numpy, written from the published specification
+(https://cocodataset.org/#detection-eval) so the reference's PRIMARY
+``MeanAveragePrecision`` path (`mean_ap.py:50-71,500-560`) can run as a
+differential oracle — including the pieces the pure-torch ``_mean_ap``
+oracle lacks: ``iscrowd`` matching (crowd gts may absorb several
+detections and never count as misses), area-range gt/dt ignoring, and
+maxDet truncation.
+
+Protocol summary implemented here (greedy matching identical to the
+original ``evaluateImg``): per (image, category) IoUs are computed once on
+score-sorted detections; per (category, area range, maxDet) each detection
+in score order takes the best still-available gt above the threshold
+(crowd gts stay available; once a real match exists, ignored gts are not
+preferred); unmatched detections outside the area range are ignored rather
+than counted as false positives; accumulation merges images, sorts all
+scores (stable), builds interpolated precision sampled at the 101 recall
+thresholds, and ``summarize`` reduces to the standard 12 stats.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+
+from . import mask as maskUtils
+
+
+class Params:
+    def __init__(self, iouType="bbox"):
+        self.imgIds = []
+        self.catIds = []
+        self.iouThrs = np.linspace(0.5, 0.95, int(np.round((0.95 - 0.5) / 0.05)) + 1, endpoint=True)
+        self.recThrs = np.linspace(0.0, 1.00, int(np.round((1.00 - 0.0) / 0.01)) + 1, endpoint=True)
+        self.maxDets = [1, 10, 100]
+        self.areaRng = [[0, 1e5**2], [0, 32**2], [32**2, 96**2], [96**2, 1e5**2]]
+        self.areaRngLbl = ["all", "small", "medium", "large"]
+        self.useCats = 1
+        self.iouType = iouType
+
+
+class COCOeval:
+    def __init__(self, cocoGt=None, cocoDt=None, iouType="bbox"):
+        if iouType not in ("bbox", "segm"):
+            raise ValueError(f"COCOeval shim supports iouType bbox/segm, got {iouType}")
+        self.cocoGt = cocoGt
+        self.cocoDt = cocoDt
+        self.params = Params(iouType)
+        self.evalImgs = defaultdict(list)
+        self.eval = {}
+        self.stats = []
+        self.ious = {}
+        if cocoGt is not None:
+            self.params.imgIds = sorted(cocoGt.getImgIds())
+            self.params.catIds = sorted(cocoGt.getCatIds())
+
+    # ------------------------------------------------------------ prepare
+
+    def _prepare(self):
+        p = self.params
+        cat_ids = p.catIds if p.useCats else []
+        gts = self.cocoGt.loadAnns(self.cocoGt.getAnnIds(imgIds=p.imgIds, catIds=cat_ids))
+        dts = self.cocoDt.loadAnns(self.cocoDt.getAnnIds(imgIds=p.imgIds, catIds=cat_ids))
+        if p.iouType == "segm":
+            for ann in gts + dts:
+                ann["segmentation"] = self.cocoGt.annToRLE(ann)
+        for gt in gts:
+            gt["ignore"] = gt.get("ignore", 0)
+            gt["ignore"] = 1 if gt.get("iscrowd", 0) else gt["ignore"]
+        self._gts = defaultdict(list)
+        self._dts = defaultdict(list)
+        for gt in gts:
+            self._gts[gt["image_id"], gt["category_id"]].append(gt)
+        for dt in dts:
+            self._dts[dt["image_id"], dt["category_id"]].append(dt)
+        self.evalImgs = defaultdict(list)
+        self.eval = {}
+
+    # ----------------------------------------------------------- evaluate
+
+    def evaluate(self):
+        p = self.params
+        p.imgIds = list(np.unique(p.imgIds))
+        if p.useCats:
+            p.catIds = list(np.unique(p.catIds))
+        p.maxDets = sorted(p.maxDets)
+        self._prepare()
+        cat_ids = p.catIds if p.useCats else [-1]
+        self.ious = {
+            (imgId, catId): self.computeIoU(imgId, catId) for imgId in p.imgIds for catId in cat_ids
+        }
+        maxDet = p.maxDets[-1]
+        self.evalImgs = [
+            self.evaluateImg(imgId, catId, areaRng, maxDet)
+            for catId in cat_ids
+            for areaRng in p.areaRng
+            for imgId in p.imgIds
+        ]
+        self._paramsEval = _copy_params(p)
+
+    def computeIoU(self, imgId, catId):
+        p = self.params
+        if p.useCats:
+            gt = self._gts[imgId, catId]
+            dt = self._dts[imgId, catId]
+        else:
+            gt = [g for c in p.catIds for g in self._gts[imgId, c]]
+            dt = [d for c in p.catIds for d in self._dts[imgId, c]]
+        if len(gt) == 0 or len(dt) == 0:
+            return []
+        inds = np.argsort([-d["score"] for d in dt], kind="mergesort")
+        dt = [dt[i] for i in inds]
+        if len(dt) > p.maxDets[-1]:
+            dt = dt[0 : p.maxDets[-1]]
+        if p.iouType == "segm":
+            g = [g["segmentation"] for g in gt]
+            d = [d["segmentation"] for d in dt]
+        else:
+            g = [g["bbox"] for g in gt]
+            d = [d["bbox"] for d in dt]
+        iscrowd = [int(o.get("iscrowd", 0)) for o in gt]
+        return maskUtils.iou(d, g, iscrowd)
+
+    def evaluateImg(self, imgId, catId, aRng, maxDet):
+        p = self.params
+        if p.useCats:
+            gt = self._gts[imgId, catId]
+            dt = self._dts[imgId, catId]
+        else:
+            gt = [g for c in p.catIds for g in self._gts[imgId, c]]
+            dt = [d for c in p.catIds for d in self._dts[imgId, c]]
+        if len(gt) == 0 and len(dt) == 0:
+            return None
+
+        for g in gt:
+            g["_ignore"] = 1 if (g["ignore"] or g["area"] < aRng[0] or g["area"] > aRng[1]) else 0
+
+        gtind = np.argsort([g["_ignore"] for g in gt], kind="mergesort")
+        gt = [gt[i] for i in gtind]
+        dtind = np.argsort([-d["score"] for d in dt], kind="mergesort")
+        dt = [dt[i] for i in dtind[0:maxDet]]
+        iscrowd = [int(o.get("iscrowd", 0)) for o in gt]
+        ious = (
+            np.asarray(self.ious[imgId, catId])[:, gtind]
+            if len(self.ious[imgId, catId]) > 0
+            else self.ious[imgId, catId]
+        )
+
+        T = len(p.iouThrs)
+        G = len(gt)
+        D = len(dt)
+        gtm = np.zeros((T, G))
+        dtm = np.zeros((T, D))
+        gtIg = np.array([g["_ignore"] for g in gt])
+        dtIg = np.zeros((T, D))
+        if len(ious) != 0:
+            for tind, t in enumerate(p.iouThrs):
+                for dind, d in enumerate(dt):
+                    iou = min([t, 1 - 1e-10])
+                    m = -1
+                    for gind in range(G):
+                        # gt already matched at this threshold and not a crowd → unavailable
+                        if gtm[tind, gind] > 0 and not iscrowd[gind]:
+                            continue
+                        # gts are sorted non-ignored first: stop looking once a
+                        # real match exists and only ignored gts remain
+                        if m > -1 and gtIg[m] == 0 and gtIg[gind] == 1:
+                            break
+                        if ious[dind, gind] < iou:
+                            continue
+                        iou = ious[dind, gind]
+                        m = gind
+                    if m == -1:
+                        continue
+                    dtIg[tind, dind] = gtIg[m]
+                    dtm[tind, dind] = gt[m]["id"]
+                    gtm[tind, m] = d["id"]
+        # unmatched detections outside the area range are ignored, not FPs
+        a = np.array([d["area"] < aRng[0] or d["area"] > aRng[1] for d in dt]).reshape((1, len(dt)))
+        dtIg = np.logical_or(dtIg, np.logical_and(dtm == 0, np.repeat(a, T, 0)))
+        return {
+            "image_id": imgId,
+            "category_id": catId,
+            "aRng": aRng,
+            "maxDet": maxDet,
+            "dtIds": [d["id"] for d in dt],
+            "gtIds": [g["id"] for g in gt],
+            "dtMatches": dtm,
+            "gtMatches": gtm,
+            "dtScores": [d["score"] for d in dt],
+            "gtIgnore": gtIg,
+            "dtIgnore": dtIg,
+        }
+
+    # --------------------------------------------------------- accumulate
+
+    def accumulate(self, p=None):
+        if not self.evalImgs:
+            raise RuntimeError("Please run evaluate() first")
+        if p is None:
+            p = self.params
+        p.catIds = p.catIds if p.useCats == 1 else [-1]
+        T = len(p.iouThrs)
+        R = len(p.recThrs)
+        K = len(p.catIds)
+        A = len(p.areaRng)
+        M = len(p.maxDets)
+        precision = -np.ones((T, R, K, A, M))
+        recall = -np.ones((T, K, A, M))
+        scores = -np.ones((T, R, K, A, M))
+
+        _pe = self._paramsEval
+        setK = set(_pe.catIds)
+        setA = set(map(tuple, _pe.areaRng))
+        setM = set(_pe.maxDets)
+        setI = set(_pe.imgIds)
+        k_list = [n for n, k in enumerate(p.catIds) if k in setK]
+        m_list = [m for n, m in enumerate(p.maxDets) if m in setM]
+        a_list = [n for n, a in enumerate(map(lambda x: tuple(x), p.areaRng)) if a in setA]
+        i_list = [n for n, i in enumerate(p.imgIds) if i in setI]
+        I0 = len(_pe.imgIds)
+        A0 = len(_pe.areaRng)
+        for k, k0 in enumerate(k_list):
+            Nk = k0 * A0 * I0
+            for a, a0 in enumerate(a_list):
+                Na = a0 * I0
+                for m, maxDet in enumerate(m_list):
+                    E = [self.evalImgs[Nk + Na + i] for i in i_list]
+                    E = [e for e in E if e is not None]
+                    if len(E) == 0:
+                        continue
+                    dtScores = np.concatenate([e["dtScores"][0:maxDet] for e in E])
+                    inds = np.argsort(-dtScores, kind="mergesort")
+                    dtScoresSorted = dtScores[inds]
+                    dtm = np.concatenate([e["dtMatches"][:, 0:maxDet] for e in E], axis=1)[:, inds]
+                    dtIg = np.concatenate([e["dtIgnore"][:, 0:maxDet] for e in E], axis=1)[:, inds]
+                    gtIg = np.concatenate([e["gtIgnore"] for e in E])
+                    npig = np.count_nonzero(gtIg == 0)
+                    if npig == 0:
+                        continue
+                    tps = np.logical_and(dtm, np.logical_not(dtIg))
+                    fps = np.logical_and(np.logical_not(dtm), np.logical_not(dtIg))
+                    tp_sum = np.cumsum(tps, axis=1).astype(dtype=np.float64)
+                    fp_sum = np.cumsum(fps, axis=1).astype(dtype=np.float64)
+                    for t, (tp, fp) in enumerate(zip(tp_sum, fp_sum)):
+                        tp = np.array(tp)
+                        fp = np.array(fp)
+                        nd = len(tp)
+                        rc = tp / npig
+                        pr = tp / (fp + tp + np.spacing(1))
+                        q = np.zeros((R,))
+                        ss = np.zeros((R,))
+                        recall[t, k, a, m] = rc[-1] if nd else 0
+                        pr = pr.tolist()
+                        q = q.tolist()
+                        for i in range(nd - 1, 0, -1):
+                            if pr[i] > pr[i - 1]:
+                                pr[i - 1] = pr[i]
+                        inds = np.searchsorted(rc, p.recThrs, side="left")
+                        try:
+                            for ri, pi in enumerate(inds):
+                                q[ri] = pr[pi]
+                                ss[ri] = dtScoresSorted[pi]
+                        except IndexError:
+                            pass
+                        precision[t, :, k, a, m] = np.array(q)
+                        scores[t, :, k, a, m] = np.array(ss)
+        self.eval = {
+            "params": p,
+            "counts": [T, R, K, A, M],
+            "precision": precision,
+            "recall": recall,
+            "scores": scores,
+        }
+
+    # ---------------------------------------------------------- summarize
+
+    def summarize(self):
+        def _summarize(ap=1, iouThr=None, areaRng="all", maxDets=100):
+            p = self.params
+            aind = [i for i, a in enumerate(p.areaRngLbl) if a == areaRng]
+            mind = [i for i, m in enumerate(p.maxDets) if m == maxDets]
+            if ap == 1:
+                s = self.eval["precision"]
+                if iouThr is not None:
+                    t = np.where(np.isclose(iouThr, p.iouThrs))[0]
+                    s = s[t]
+                s = s[:, :, :, aind, mind]
+            else:
+                s = self.eval["recall"]
+                if iouThr is not None:
+                    t = np.where(np.isclose(iouThr, p.iouThrs))[0]
+                    s = s[t]
+                s = s[:, :, aind, mind]
+            if len(s[s > -1]) == 0:
+                return -1.0
+            return np.mean(s[s > -1])
+
+        if not self.eval:
+            raise RuntimeError("Please run accumulate() first")
+        p = self.params
+        stats = np.zeros((12,))
+        stats[0] = _summarize(1, maxDets=p.maxDets[-1])
+        stats[1] = _summarize(1, iouThr=0.5, maxDets=p.maxDets[-1])
+        stats[2] = _summarize(1, iouThr=0.75, maxDets=p.maxDets[-1])
+        stats[3] = _summarize(1, areaRng="small", maxDets=p.maxDets[-1])
+        stats[4] = _summarize(1, areaRng="medium", maxDets=p.maxDets[-1])
+        stats[5] = _summarize(1, areaRng="large", maxDets=p.maxDets[-1])
+        stats[6] = _summarize(0, maxDets=p.maxDets[0])
+        stats[7] = _summarize(0, maxDets=p.maxDets[1]) if len(p.maxDets) > 1 else -1.0
+        stats[8] = _summarize(0, maxDets=p.maxDets[-1]) if len(p.maxDets) > 2 else -1.0
+        stats[9] = _summarize(0, areaRng="small", maxDets=p.maxDets[-1])
+        stats[10] = _summarize(0, areaRng="medium", maxDets=p.maxDets[-1])
+        stats[11] = _summarize(0, areaRng="large", maxDets=p.maxDets[-1])
+        self.stats = stats
+
+
+def _copy_params(p: Params) -> Params:
+    out = Params(p.iouType)
+    out.imgIds = list(p.imgIds)
+    out.catIds = list(p.catIds)
+    out.iouThrs = np.array(p.iouThrs)
+    out.recThrs = np.array(p.recThrs)
+    out.maxDets = list(p.maxDets)
+    out.areaRng = [list(a) for a in p.areaRng]
+    out.areaRngLbl = list(p.areaRngLbl)
+    out.useCats = p.useCats
+    return out

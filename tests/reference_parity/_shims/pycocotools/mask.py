@@ -55,10 +55,38 @@ def area(rles: Union[dict, List[dict]]) -> np.ndarray:
     return out[0] if single else out
 
 
+def _box_iou(dt, gt, iscrowd) -> np.ndarray:
+    """(D, G) xywh box IoU; for crowd gt the union is the detection area
+    (pycocotools ``bbIou`` semantics, used by COCOeval with iouType='bbox')."""
+    d = np.asarray(dt, dtype=np.float64).reshape(len(dt), 4)
+    g = np.asarray(gt, dtype=np.float64).reshape(len(gt), 4)
+    d_area = d[:, 2] * d[:, 3]
+    g_area = g[:, 2] * g[:, 3]
+    ix = np.maximum(
+        0.0,
+        np.minimum(d[:, None, 0] + d[:, None, 2], g[None, :, 0] + g[None, :, 2])
+        - np.maximum(d[:, None, 0], g[None, :, 0]),
+    )
+    iy = np.maximum(
+        0.0,
+        np.minimum(d[:, None, 1] + d[:, None, 3], g[None, :, 1] + g[None, :, 3])
+        - np.maximum(d[:, None, 1], g[None, :, 1]),
+    )
+    inter = ix * iy
+    union = d_area[:, None] + g_area[None, :] - inter
+    crowd = np.asarray(iscrowd, dtype=bool)
+    union = np.where(crowd[None, :], d_area[:, None], union)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return np.where(union > 0, inter / union, 0.0)
+
+
 def iou(dt: List[dict], gt: List[dict], iscrowd: List[int]) -> np.ndarray:
-    """(D, G) mask IoU; for crowd gt the union is the detection area."""
+    """(D, G) IoU; accepts RLE dicts or xywh boxes (like pycocotools).
+    For crowd gt the union is the detection area."""
     if len(dt) == 0 or len(gt) == 0:
         return np.zeros((len(dt), len(gt)))
+    if not isinstance(dt[0], dict) or not isinstance(gt[0], dict):
+        return _box_iou(dt, gt, iscrowd)
     dmasks = np.stack([decode(d).astype(np.int64) for d in dt])  # (D, H, W)
     gmasks = np.stack([decode(g).astype(np.int64) for g in gt])  # (G, H, W)
     d_area = dmasks.sum(axis=(1, 2))  # (D,)

@@ -84,6 +84,28 @@ def _own(x):
     return np.asarray(x)
 
 
+def _torch_f32_linspace(start: float, end: float, steps: int) -> List[float]:
+    """The reference's default thresholds, bit-for-bit.
+
+    ``torch.linspace`` in float32 (reference mean_ap.py:396,402) anchors the
+    first half at ``start`` and the second half at ``end`` and evaluates
+    ``base ± i*step`` with a fused multiply-add (one rounding).  The exact
+    doubles matter: a recall of exactly 3/5 samples on the opposite side of
+    recThr[60] depending on whether it is float32-0.6 (0.6000000238…) or a
+    float64 0.6 — a whole precision column flips with it.  Emulated here with
+    exact f64 intermediates (i ≤ 2²⁴, step a f32 value → products and sums
+    are exact in f64) and a single final cast.
+    """
+    if steps == 1:
+        return [float(np.float32(start))]
+    step = np.float64(np.float32((np.float32(end) - np.float32(start)) / np.float32(steps - 1)))
+    i = np.arange(steps, dtype=np.float64)
+    lo = np.float64(np.float32(start)) + i * step
+    hi = np.float64(np.float32(end)) - (steps - 1 - i) * step
+    vals = np.where(np.arange(steps) < steps // 2, lo, hi).astype(np.float32).astype(np.float64)
+    return vals.tolist()
+
+
 def _fix_empty_boxes(boxes) -> np.ndarray:
     """Empty box inputs get a host (0, 4) shape so downstream shape math is
     well-defined (reference helpers.py:88-93) — no device op for the empty
@@ -201,13 +223,13 @@ class MeanAveragePrecision(Metric):
             raise ValueError(
                 f"Expected argument `iou_thresholds` to either be `None` or a list of floats but got {iou_thresholds}"
             )
-        self.iou_thresholds = iou_thresholds or np.linspace(0.5, 0.95, 10).tolist()
+        self.iou_thresholds = iou_thresholds or _torch_f32_linspace(0.5, 0.95, 10)
 
         if rec_thresholds is not None and not isinstance(rec_thresholds, list):
             raise ValueError(
                 f"Expected argument `rec_thresholds` to either be `None` or a list of floats but got {rec_thresholds}"
             )
-        self.rec_thresholds = rec_thresholds or np.linspace(0.0, 1.0, 101).tolist()
+        self.rec_thresholds = rec_thresholds or _torch_f32_linspace(0.0, 1.0, 101)
 
         if max_detection_thresholds is not None and not isinstance(max_detection_thresholds, list):
             raise ValueError(
@@ -286,18 +308,29 @@ class MeanAveragePrecision(Metric):
         self.groundtruth_counts.append(np.asarray(gcounts, np.int64))
 
     def _convert_boxes_host(self, boxes: np.ndarray) -> np.ndarray:
-        """Cast to f32 xyxy on host (box_format conversion is 6 flops/box —
-        never worth a device round trip)."""
+        """Convert to xyxy on host (box_format conversion is 6 flops/box —
+        never worth a device round trip).
+
+        Bit-faithful to the reference's primary path: boxes pass through
+        float32 xywh (reference mean_ap.py:803-812 ``box_convert(...,
+        out_fmt='xywh')`` on f32 tensors) and the xyxy extents are rebuilt in
+        float64 as ``x + w`` — exactly what pycocotools' double-precision IoU
+        sees.  Skipping the f32 xywh rounding shifts IoUs by ~1e-8, enough to
+        flip matches that land on an IoU threshold."""
         b = np.asarray(boxes, np.float32).reshape(-1, 4)
-        if b.size and self.box_format != "xyxy":
-            if self.box_format == "xywh":
-                b = np.stack([b[:, 0], b[:, 1], b[:, 0] + b[:, 2], b[:, 1] + b[:, 3]], axis=1)
+        if b.size:
+            if self.box_format == "xyxy":
+                xywh = np.stack([b[:, 0], b[:, 1], b[:, 2] - b[:, 0], b[:, 3] - b[:, 1]], axis=1)
+            elif self.box_format == "xywh":
+                xywh = b
             else:  # cxcywh
-                b = np.stack(
-                    [b[:, 0] - b[:, 2] / 2, b[:, 1] - b[:, 3] / 2, b[:, 0] + b[:, 2] / 2, b[:, 1] + b[:, 3] / 2],
-                    axis=1,
+                xywh = np.stack(
+                    [b[:, 0] - b[:, 2] / 2, b[:, 1] - b[:, 3] / 2, b[:, 2], b[:, 3]], axis=1
                 )
-        return b
+            xywh = xywh.astype(np.float32)
+            x, y, w, h = (xywh[:, i].astype(np.float64) for i in range(4))
+            return np.stack([x, y, x + w, y + h], axis=1)
+        return b.astype(np.float64)
 
     def _unpack_mask_geoms(self, dcounts, gcounts):
         """Rebuild per-image ``((h, w), [runs per mask])`` geometries from the

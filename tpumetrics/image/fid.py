@@ -39,7 +39,9 @@ def _tap_num_features(tap: Union[int, str, None]) -> Optional[int]:
     if tap is None:
         return None
     if isinstance(tap, str) and tap.startswith("logits"):
-        return 1008
+        from tpumetrics.image._inception import NUM_CLASSES
+
+        return NUM_CLASSES
     return int(tap)
 
 
@@ -100,7 +102,7 @@ class FrechetInceptionDistance(Metric):
 
     def __init__(
         self,
-        feature: Union[int, Callable] = 2048,
+        feature: Union[int, str, Callable] = 2048,
         reset_real_features: bool = True,
         normalize: bool = False,
         num_features: Optional[int] = None,

@@ -89,7 +89,7 @@ class KernelInceptionDistance(Metric):
 
     def __init__(
         self,
-        feature: Union[int, Callable] = 2048,
+        feature: Union[int, str, Callable] = 2048,
         subsets: int = 100,
         subset_size: int = 1000,
         degree: int = 3,

@@ -66,7 +66,7 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
 
     def __init__(
         self,
-        feature: Union[int, Callable] = 2048,
+        feature: Union[int, str, Callable] = 2048,
         reset_real_features: bool = True,
         normalize: bool = False,
         cosine_distance_eps: float = 0.1,
