@@ -1,16 +1,108 @@
-"""Every shipped example must run green end-to-end (the reference keeps its
-``examples/`` exercised through docs builds; here they run directly)."""
+"""Every shipped example must run green end-to-end AND produce sane values.
+
+The reference keeps its ``examples/`` exercised through docs builds; here
+each example runs as a subprocess and its printed outputs are parsed and
+asserted (value ranges, relationships, produced files) so example rot is
+caught — a smoke "OK" alone would not notice a metric silently returning
+garbage."""
 
 from __future__ import annotations
 
 import os
+import re
 import subprocess
 import sys
 
 import pytest
 
-_EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), "..", "examples")
+_EXAMPLES_DIR = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "examples"))
 _EXAMPLES = sorted(f for f in os.listdir(_EXAMPLES_DIR) if f.endswith(".py"))
+
+
+def _floats(pattern: str, text: str):
+    return [float(v) for v in re.findall(pattern, text)]
+
+
+def _check_bert_score(out: str) -> None:
+    f1s = _floats(r"f1=(-?[0-9.]+)", out)
+    assert f1s, "no per-pair f1 lines"
+    assert all(0.0 <= v <= 1.0 + 1e-6 for v in f1s)
+    streamed = re.search(r"streamed idf f1: \[([^\]]+)\]", out)
+    assert streamed, "no streamed idf line"
+    vals = [float(v) for v in streamed.group(1).split(",")]
+    assert vals and all(-1.0 <= v <= 1.0 + 1e-6 for v in vals)
+
+
+def _check_detection_map(out: str) -> None:
+    m = {k: _floats(rf"{k}\s*= ([0-9.\-]+)", out) for k in ("mAP", "mAP@50", "mAP@75")}
+    assert all(len(v) == 1 for v in m.values()), out
+    # jittered-box corpus: real signal, ordered as COCO demands
+    assert 0.0 < m["mAP"][0] <= m["mAP@50"][0] <= 1.0
+    aps = _floats(r"class \d+: AP = ([0-9.\-]+)", out)
+    assert aps and all(-1.0 <= v <= 1.0 for v in aps)
+
+
+def _check_multihost(out: str) -> None:
+    vals = {k: v for k, v in re.findall(r"^(acc|f1|auroc): ([0-9.]+)$", out, re.M)}
+    assert set(vals) == {"acc", "f1", "auroc"}, out
+    # random logits over 10 classes: accuracy must sit near chance, auroc near 0.5
+    assert 0.02 <= float(vals["acc"]) <= 0.3
+    assert 0.3 <= float(vals["auroc"]) <= 0.7
+
+
+def _check_plotting(out: str) -> None:
+    paths = re.findall(r"^wrote (.+)$", out, re.M)
+    assert paths, "plotting example wrote no files"
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(_EXAMPLES_DIR, "..", p)
+        assert os.path.isfile(full) and os.path.getsize(full) > 0, p
+
+
+def _check_rouge(out: str) -> None:
+    default = _floats(r"default tokenization\s+rouge1_fmeasure = ([0-9.]+)", out)
+    custom = _floats(r"hyphens kept\s+rouge1_fmeasure = ([0-9.]+)", out)
+    assert len(default) == 1 and len(custom) == 1
+    assert 0.0 <= default[0] <= 1.0 and 0.0 <= custom[0] <= 1.0
+    # the example's whole point: the custom tokenizer changes the score
+    assert default[0] != custom[0]
+
+
+def _check_segm_map(out: str) -> None:
+    map50 = _floats(r"segm mAP@50: ([0-9.\-]+)", out)
+    lpips = _floats(r"LPIPS mean over 8 pairs: ([0-9.\-]+)", out)
+    assert len(map50) == 1 and 0.0 < map50[0] <= 1.0
+    assert len(lpips) == 1 and lpips[0] >= 0.0
+
+
+def _check_train_loop(out: str) -> None:
+    epochs = re.findall(r"^epoch \d+: (.+)$", out, re.M)
+    assert len(epochs) >= 2, out
+    def parse(line):
+        return {k: float(v) for k, v in re.findall(r"(\w+)=([0-9.\-]+)", line)}
+    first, last = parse(epochs[0]), parse(epochs[-1])
+    assert {"acc", "loss"} <= set(first), first
+    # training on a learnable synthetic task must actually learn
+    assert last["loss"] < first["loss"], (first, last)
+    assert last["acc"] >= first["acc"] - 1e-6, (first, last)
+    assert 0.0 <= last["acc"] <= 1.0
+
+
+_CHECKS = {
+    "bert_score-own_model.py": _check_bert_score,
+    "detection_map.py": _check_detection_map,
+    "multihost_eval.py": _check_multihost,
+    "plotting.py": _check_plotting,
+    "rouge_score-own_normalizer_and_tokenizer.py": _check_rouge,
+    "segm_map_and_lpips.py": _check_segm_map,
+    "train_loop_flax.py": _check_train_loop,
+}
+
+
+def test_every_example_has_a_value_check():
+    assert set(_CHECKS) == set(_EXAMPLES), (
+        "examples and value-checks out of sync: "
+        f"missing={sorted(set(_EXAMPLES) - set(_CHECKS))} stale={sorted(set(_CHECKS) - set(_EXAMPLES))}"
+    )
 
 
 @pytest.mark.parametrize("example", _EXAMPLES)
@@ -26,3 +118,6 @@ def test_example_runs(example):
     )
     assert out.returncode == 0, f"{example} failed:\n{out.stdout[-1500:]}\n{out.stderr[-1500:]}"
     assert "OK" in out.stdout, f"{example} did not reach its final assertion:\n{out.stdout[-500:]}"
+    check = _CHECKS.get(example)
+    if check is not None:
+        check(out.stdout)

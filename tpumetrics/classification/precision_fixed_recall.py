@@ -10,6 +10,7 @@ import jax
 from tpumetrics.classification.base import _ClassificationTaskWrapper
 from tpumetrics.classification.precision_recall_curve import (
     BinaryPrecisionRecallCurve,
+    _AtFixedValuePlotMixin,
     MulticlassPrecisionRecallCurve,
     MultilabelPrecisionRecallCurve,
 )
@@ -29,7 +30,7 @@ from tpumetrics.utils.enums import ClassificationTask
 Array = jax.Array
 
 
-class BinaryPrecisionAtFixedRecall(BinaryPrecisionRecallCurve):
+class BinaryPrecisionAtFixedRecall(_AtFixedValuePlotMixin, BinaryPrecisionRecallCurve):
     """Max precision subject to recall >= min_recall, binary (reference
     classification/precision_fixed_recall.py:32).
 
@@ -67,7 +68,7 @@ class BinaryPrecisionAtFixedRecall(BinaryPrecisionRecallCurve):
         )
 
 
-class MulticlassPrecisionAtFixedRecall(MulticlassPrecisionRecallCurve):
+class MulticlassPrecisionAtFixedRecall(_AtFixedValuePlotMixin, MulticlassPrecisionRecallCurve):
     """Per-class max precision subject to recall >= min_recall (reference
     classification/precision_fixed_recall.py:141).
 
@@ -112,7 +113,7 @@ class MulticlassPrecisionAtFixedRecall(MulticlassPrecisionRecallCurve):
         )
 
 
-class MultilabelPrecisionAtFixedRecall(MultilabelPrecisionRecallCurve):
+class MultilabelPrecisionAtFixedRecall(_AtFixedValuePlotMixin, MultilabelPrecisionRecallCurve):
     """Per-label max precision subject to recall >= min_recall (reference
     classification/precision_fixed_recall.py:252).
 

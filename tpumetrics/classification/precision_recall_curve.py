@@ -308,3 +308,16 @@ class PrecisionRecallCurve(_ClassificationTaskWrapper):
                 raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
             return MultilabelPrecisionRecallCurve(num_labels, **kwargs)
         raise ValueError(f"Not handled value: {task}")
+
+
+class _AtFixedValuePlotMixin:
+    """Plot override for the (value, threshold)-tuple metrics
+    (Precision@Recall / Recall@Precision / Specificity@Sensitivity): the
+    default plot shows the primary value only, matching the reference's
+    per-class ``plot`` overrides (reference
+    classification/precision_fixed_recall.py:135-177)."""
+
+    def plot(self, val=None, ax=None):
+        if val is None:
+            val = self.compute()[0]
+        return self._plot(val, ax)

@@ -10,6 +10,7 @@ import jax
 from tpumetrics.classification.base import _ClassificationTaskWrapper
 from tpumetrics.classification.precision_recall_curve import (
     BinaryPrecisionRecallCurve,
+    _AtFixedValuePlotMixin,
     MulticlassPrecisionRecallCurve,
     MultilabelPrecisionRecallCurve,
 )
@@ -28,7 +29,7 @@ from tpumetrics.utils.enums import ClassificationTask
 Array = jax.Array
 
 
-class BinaryRecallAtFixedPrecision(BinaryPrecisionRecallCurve):
+class BinaryRecallAtFixedPrecision(_AtFixedValuePlotMixin, BinaryPrecisionRecallCurve):
     """Max recall subject to precision >= min_precision, binary (reference
     classification/recall_fixed_precision.py:29).
 
@@ -66,7 +67,7 @@ class BinaryRecallAtFixedPrecision(BinaryPrecisionRecallCurve):
         )
 
 
-class MulticlassRecallAtFixedPrecision(MulticlassPrecisionRecallCurve):
+class MulticlassRecallAtFixedPrecision(_AtFixedValuePlotMixin, MulticlassPrecisionRecallCurve):
     """Per-class max recall subject to precision >= min_precision (reference
     classification/recall_fixed_precision.py:136).
 
@@ -112,7 +113,7 @@ class MulticlassRecallAtFixedPrecision(MulticlassPrecisionRecallCurve):
         )
 
 
-class MultilabelRecallAtFixedPrecision(MultilabelPrecisionRecallCurve):
+class MultilabelRecallAtFixedPrecision(_AtFixedValuePlotMixin, MultilabelPrecisionRecallCurve):
     """Per-label max recall subject to precision >= min_precision (reference
     classification/recall_fixed_precision.py:247).
 

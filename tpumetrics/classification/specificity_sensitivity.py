@@ -10,6 +10,7 @@ import jax
 from tpumetrics.classification.base import _ClassificationTaskWrapper
 from tpumetrics.classification.precision_recall_curve import (
     BinaryPrecisionRecallCurve,
+    _AtFixedValuePlotMixin,
     MulticlassPrecisionRecallCurve,
     MultilabelPrecisionRecallCurve,
 )
@@ -28,7 +29,7 @@ from tpumetrics.utils.enums import ClassificationTask
 Array = jax.Array
 
 
-class BinarySpecificityAtSensitivity(BinaryPrecisionRecallCurve):
+class BinarySpecificityAtSensitivity(_AtFixedValuePlotMixin, BinaryPrecisionRecallCurve):
     """Max specificity subject to sensitivity >= min_sensitivity, binary
     (reference classification/specificity_sensitivity.py:33).
 
@@ -66,7 +67,7 @@ class BinarySpecificityAtSensitivity(BinaryPrecisionRecallCurve):
         )
 
 
-class MulticlassSpecificityAtSensitivity(MulticlassPrecisionRecallCurve):
+class MulticlassSpecificityAtSensitivity(_AtFixedValuePlotMixin, MulticlassPrecisionRecallCurve):
     """Per-class max specificity subject to sensitivity >= min_sensitivity
     (reference classification/specificity_sensitivity.py:146).
 
@@ -112,7 +113,7 @@ class MulticlassSpecificityAtSensitivity(MulticlassPrecisionRecallCurve):
         )
 
 
-class MultilabelSpecificityAtSensitivity(MultilabelPrecisionRecallCurve):
+class MultilabelSpecificityAtSensitivity(_AtFixedValuePlotMixin, MultilabelPrecisionRecallCurve):
     """Per-label max specificity subject to sensitivity >= min_sensitivity
     (reference classification/specificity_sensitivity.py:255).
 

@@ -10,6 +10,7 @@ import jax.numpy as jnp
 
 from tpumetrics.functional.retrieval._grouped import grouped_precision_recall_curve, sort_queries
 from tpumetrics.functional.retrieval.precision_recall_curve import _retrieval_recall_at_fixed_precision
+from tpumetrics.classification.precision_recall_curve import _AtFixedValuePlotMixin
 from tpumetrics.retrieval.base import RetrievalMetric
 from tpumetrics.utils.data import _is_tracer
 
@@ -99,7 +100,7 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
         raise NotImplementedError
 
 
-class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
+class RetrievalRecallAtFixedPrecision(_AtFixedValuePlotMixin, RetrievalPrecisionRecallCurve):
     """Highest recall whose averaged precision@k clears ``min_precision``,
     plus the k achieving it (reference precision_recall_curve.py:222-312).
 
