@@ -304,3 +304,31 @@ def test_text_model_metrics_string_state_sync_policy():
     m5.update(["a b"], ["a b"])
     with pytest.raises(TPUMetricsUserError):
         m5._sync_dist()
+
+
+def test_bert_score_all_layers_output_contract():
+    """all_layers (layer axis > 1) returns (num_layers, n) like the reference's
+    transpose-and-squeeze (ref functional/text/bert.py:139-140); layer 0 of a
+    stacked forward must equal the plain single-layer score."""
+    tok = _WordTokenizer()
+    base = _ToyEmbedder()
+
+    class _ThreeLayer:
+        def __call__(self, model, batch):
+            h = base(model, batch)  # (b, s, d)
+            return jnp.stack([h, 0.5 * h + 0.1, -h], axis=1)  # (b, 3, s, d)
+
+    preds = ["the cat sat on the mat", "a dog barked", "hello there friend"]
+    target = ["the cat sat on a mat", "the dog barked", "hello there"]
+    out = bert_score(preds, target, model=object(), user_tokenizer=tok, user_forward_fn=_ThreeLayer())
+    for key in ("precision", "recall", "f1"):
+        assert np.asarray(out[key]).shape == (3, len(preds)), key
+    single = bert_score(preds, target, model=object(), user_tokenizer=tok, user_forward_fn=base)
+    # layer 0 is the unscaled embedding — identical to the single-layer run
+    np.testing.assert_allclose(np.asarray(out["f1"][0]), np.asarray(single["f1"]), atol=1e-6)
+    # small corpus (single chunk) and large corpus (scan path) agree
+    big_preds, big_target = preds * 80, target * 80
+    big = bert_score(big_preds, big_target, model=object(), user_tokenizer=tok,
+                     user_forward_fn=_ThreeLayer(), batch_size=32)
+    assert np.asarray(big["f1"]).shape == (3, len(big_preds))
+    np.testing.assert_allclose(np.asarray(big["f1"])[:, : len(preds)], np.asarray(out["f1"]), atol=1e-5)

@@ -92,71 +92,83 @@ def _pairwise_geometry(
     return inter, det_area, gt_area
 
 
-def _match_image(
+def _match_image_areas(
     ious: np.ndarray,
     det_areas: np.ndarray,
     det_scores: np.ndarray,
     gt_crowd: np.ndarray,
     gt_area: np.ndarray,
     iou_thresholds: np.ndarray,
-    area_range: Tuple[float, float],
+    area_ranges: Sequence[Tuple[float, float]],
     max_det: int,
-) -> Optional[dict]:
-    """Match one (image, class) pair at every IoU threshold simultaneously
-    (pycocotools ``evaluateImg`` semantics; reference _mean_ap.py:521-649).
+) -> Optional[List[dict]]:
+    """Match one (image, class) pair at every (area range, IoU threshold)
+    simultaneously (pycocotools ``evaluateImg`` semantics; reference
+    _mean_ap.py:521-649).
 
-    ``ious``/``det_areas``/``det_scores`` are already score-sorted (descending,
-    stable) — computed once per (image, class) by the caller and shared across
-    the four area ranges. Only the detection loop is sequential (each det
-    claims a gt); the per-det candidate search is vectorized over all
-    (threshold, gt) pairs, replicating the greedy loop's rules exactly:
-    non-ignored gts take precedence over ignored ones (the reference's
-    sorted-ignored-last + break), ties replace (last-wins argmax), crowd gts
-    can absorb any number of detections.
+    ``ious``/``det_areas``/``det_scores`` are already score-sorted
+    (descending, stable) — computed once per (image, class) by the caller.
+    Only the detection loop is sequential (each det claims a gt); the per-det
+    candidate search is vectorized over all (area, threshold, gt) triples —
+    area ranges only change which gts are ignored, so evaluating all four in
+    one pass quarters the Python-loop overhead of the hot host path.  The
+    greedy rules are replicated exactly: non-ignored gts take precedence over
+    ignored ones (the reference's sorted-ignored-last + break), ties replace
+    (last-wins argmax), crowd gts can absorb any number of detections.
     """
     n_gt = gt_crowd.shape[0]
     n_det = min(det_scores.shape[0], max_det)
     if n_gt == 0 and n_det == 0:
         return None
 
-    gt_ignore = gt_crowd.astype(bool) | (gt_area < area_range[0]) | (gt_area > area_range[1])
+    lo = np.asarray([r[0] for r in area_ranges])  # (A,)
+    hi = np.asarray([r[1] for r in area_ranges])
+    crowd = gt_crowd.astype(bool)
+    gt_ignore = crowd[None, :] | (gt_area[None, :] < lo[:, None]) | (gt_area[None, :] > hi[:, None])  # (A, G)
+    num_areas = len(area_ranges)
     num_thrs = len(iou_thresholds)
-    thr = np.minimum(np.asarray(iou_thresholds)[:, None], 1 - 1e-10)  # (T, 1)
-    det_matches = np.zeros((num_thrs, n_det), dtype=np.int64)  # 1 if matched
-    det_ignore = np.zeros((num_thrs, n_det), dtype=bool)
-    avail = np.ones((num_thrs, n_gt), dtype=bool)  # gt not yet claimed
+    thr = np.minimum(np.asarray(iou_thresholds)[None, :, None], 1 - 1e-10)  # (1, T, 1)
+    det_matches = np.zeros((num_areas, num_thrs, n_det), dtype=np.int64)  # 1 if matched
+    det_ignore = np.zeros((num_areas, num_thrs, n_det), dtype=bool)
+    avail = np.ones((num_areas, num_thrs, n_gt), dtype=bool)  # gt not yet claimed
     ious = ious[:n_det]
     real = ~gt_ignore
-    crowd = gt_crowd.astype(bool)
 
     for d_idx in range(n_det):
-        iou_row = ious[d_idx][None, :]  # (1, G)
-        cand = avail & (iou_row >= thr)  # (T, G)
-        cand_real = cand & real[None, :]
-        use_real = cand_real.any(axis=1)
-        pick_from = np.where(use_real[:, None], cand_real, cand & gt_ignore[None, :])
-        has = pick_from.any(axis=1)
+        iou_row = ious[d_idx][None, None, :]  # (1, 1, G)
+        cand = avail & (iou_row >= thr)  # (A, T, G)
+        cand_real = cand & real[:, None, :]
+        use_real = cand_real.any(axis=2)
+        pick_from = np.where(use_real[..., None], cand_real, cand & gt_ignore[:, None, :])
+        has = pick_from.any(axis=2)
         if not has.any():
             continue
         vals = np.where(pick_from, iou_row, -1.0)
-        best_g = n_gt - 1 - np.argmax(vals[:, ::-1], axis=1)  # last-wins argmax
-        rows = np.nonzero(has)[0]
-        bg = best_g[rows]
-        det_matches[rows, d_idx] = 1
-        det_ignore[rows, d_idx] = gt_ignore[bg]
+        best_g = n_gt - 1 - np.argmax(vals[..., ::-1], axis=2)  # last-wins argmax
+        rows_a, rows_t = np.nonzero(has)
+        bg = best_g[rows_a, rows_t]
+        det_matches[rows_a, rows_t, d_idx] = 1
+        det_ignore[rows_a, rows_t, d_idx] = gt_ignore[rows_a, bg]
         noncrowd = ~crowd[bg]
-        avail[rows[noncrowd], bg[noncrowd]] = False
+        avail[rows_a[noncrowd], rows_t[noncrowd], bg[noncrowd]] = False
 
     # unmatched detections outside the area range are ignored
-    det_out_of_range = (det_areas[:n_det] < area_range[0]) | (det_areas[:n_det] > area_range[1])
-    det_ignore = det_ignore | ((det_matches == 0) & det_out_of_range[None, :])
+    da = det_areas[:n_det]
+    det_out_of_range = (da[None, :] < lo[:, None]) | (da[None, :] > hi[:, None])  # (A, D)
+    det_ignore = det_ignore | ((det_matches == 0) & det_out_of_range[:, None, :])
 
-    return {
-        "det_scores": det_scores[:n_det],
-        "det_matches": det_matches,
-        "det_ignore": det_ignore,
-        "num_gt": int((~gt_ignore).sum()),
-    }
+    scores = det_scores[:n_det]
+    return [
+        {
+            "det_scores": scores,
+            "det_matches": det_matches[a],
+            "det_ignore": det_ignore[a],
+            "num_gt": int((~gt_ignore[a]).sum()),
+        }
+        for a in range(num_areas)
+    ]
+
+
 
 
 def _accumulate_class_area(
@@ -300,13 +312,15 @@ def coco_evaluate(
             ious = inter / np.where(union > 0, union, 1.0)
             per_image_cls.append((ious, da, ds, gc, area))
 
-        for a_idx, a_name in enumerate(area_names):
-            a_range = _AREA_RANGES[a_name]
-            # match once at the largest cap; smaller caps reuse by slicing
-            results = [
-                _match_image(ious, da, ds, gc, ga, iou_thrs, a_range, max_dets[-1])
-                for (ious, da, ds, gc, ga) in per_image_cls
-            ]
+        # match once per image across ALL area ranges at the largest cap;
+        # smaller caps reuse by slicing
+        all_ranges = [_AREA_RANGES[a] for a in area_names]
+        per_image_areas = [
+            _match_image_areas(ious, da, ds, gc, ga, iou_thrs, all_ranges, max_dets[-1])
+            for (ious, da, ds, gc, ga) in per_image_cls
+        ]
+        for a_idx in range(len(area_names)):
+            results = [r if r is None else r[a_idx] for r in per_image_areas]
             for m_idx, max_det in enumerate(max_dets):
                 prec, rec = _accumulate_class_area(results, len(iou_thrs), rec_thrs, max_det)
                 precision[:, :, k_idx, a_idx, m_idx] = prec

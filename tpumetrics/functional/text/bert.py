@@ -7,6 +7,7 @@ einsum + max — MXU-friendly."""
 
 from __future__ import annotations
 
+import functools
 from collections import Counter
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
@@ -110,26 +111,100 @@ def _get_precision_recall_f1(
     recall = jnp.einsum("blr, br -> bl", cos_sim.max(axis=-2), target_idf_scale)
     f1_score = 2 * precision * recall / (precision + recall)
     f1_score = jnp.where(jnp.isnan(f1_score), 0.0, f1_score)
-    return precision.squeeze(-1), recall.squeeze(-1), f1_score.squeeze(-1)
+
+    def fmt(x: Array) -> Array:
+        # (b, l) → (b,) single-layer / (l, b) multi-layer, the reference's
+        # transpose-and-squeeze contract (reference bert.py:139-140)
+        return x[:, 0] if x.shape[1] == 1 else x.T
+
+    return fmt(precision), fmt(recall), fmt(f1_score)
 
 
-_get_precision_recall_f1_jit = jax.jit(_get_precision_recall_f1)
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def _score_scan(pe, te, ps, ts, k, step):
+    """Whole-corpus scoring as ONE dispatch: pad to ``k`` chunks of ``step``,
+    ``lax.scan`` the chunked scorer (peak memory stays one chunk's
+    similarity tensor), flatten back.  Replaces a Python loop of per-chunk
+    slices + calls — hundreds of eager dispatches on a remote-attached
+    accelerator.  The sentence axis always ends up LAST, so the caller
+    trims padding with ``[..., :n]`` in both the single-layer ``(n,)`` and
+    ``all_layers`` ``(l, n)`` output forms."""
+    rows = k * step
+    pe = jnp.pad(pe, [(0, rows - pe.shape[0])] + [(0, 0)] * (pe.ndim - 1))
+    te = jnp.pad(te, [(0, rows - te.shape[0])] + [(0, 0)] * (te.ndim - 1))
+    ps = jnp.pad(ps, [(0, rows - ps.shape[0]), (0, 0)])
+    ts = jnp.pad(ts, [(0, rows - ts.shape[0]), (0, 0)])
+    chunked = lambda a: a.reshape((k, step) + a.shape[1:])
+    _, out = jax.lax.scan(
+        lambda _, xs: (None, _get_precision_recall_f1(*xs)), None,
+        (chunked(pe), chunked(te), chunked(ps), chunked(ts)),
+    )
+
+    def flatten(x: Array) -> Array:
+        if x.ndim == 2:  # (k, b) single-layer chunks
+            return x.reshape(-1)
+        return jnp.moveaxis(x, 0, 1).reshape(x.shape[1], -1)  # (k, l, b) → (l, k*b)
+
+    return tuple(flatten(x) for x in out)
 
 
 _CHUNK_EMBED_CACHE: Dict[Tuple, Callable] = {}
 
 
+class _EmbedFns:
+    """The compiled embed entry points for one (model, forward, layer-config):
+
+    - ``chunk``: jit of the single-chunk pipeline with a one-time eager
+      fallback (a user forward that leaves jax warns once and runs eagerly);
+    - ``scan``: jit of a ``lax.scan`` over stacked chunks — the whole-corpus
+      embed as ONE dispatch + one upload per array instead of per-chunk
+      round trips (on a remote-attached accelerator ~250 round trips for a
+      2k-sentence corpus otherwise).  ``None`` result → caller falls back to
+      the chunk loop.
+    """
+
+    def __init__(self, pipeline):
+        from tpumetrics.utils.jit_fallback import JitWithEagerFallback
+
+        self.chunk = JitWithEagerFallback(pipeline, "The BERTScore embedding pipeline")
+        self._scan_jitted = jax.jit(
+            lambda ids3, mask3, wm3: jax.lax.scan(
+                lambda _, xs: (None, pipeline(*xs)), None, (ids3, mask3, wm3)
+            )[1]
+        )
+
+    def scan(self, ids3, mask3, wm3):
+        if self.chunk.eager_mode:
+            return None  # pipeline is untraceable; the chunk loop handles it
+        try:
+            return self._scan_jitted(ids3, mask3, wm3)
+        except Exception:
+            # any trace failure → chunk loop, whose own fallback decides
+            # whether the pipeline is eager-only (and warns once)
+            return None
+
+
 def _chunk_embed_fn(model: Any, user_forward_fn: Optional[Callable], all_layers: bool, num_layers: Optional[int]):
-    """A jitted forward + unit-normalize + mask pipeline for one chunk,
-    cached per (model, forward, layer-config) identity so repeated ``compute``
-    calls (and every chunk within one) reuse one compiled program.
+    """The :class:`_EmbedFns` for one (model, forward, layer-config),
+    cached by identity so repeated ``compute`` calls (and every chunk within
+    one) reuse the compiled programs.
 
     Falls back to an unjitted pipeline when the model/forward are unhashable
     or refuse tracing (exotic user forwards that leave jax)."""
-    key = (id(model), id(user_forward_fn), all_layers, num_layers)
+    # a bare ``object()`` sentinel (the reference-faithful placeholder when a
+    # user_forward_fn closes over the weights itself) carries no state, so
+    # any two are interchangeable — key them equal, or every freshly
+    # constructed metric would recompile the chunk pipeline (~seconds on a
+    # remote-attached accelerator) for an identical program
+    stateless = type(model) is object
+    key = ("__stateless__" if stateless else id(model), id(user_forward_fn), all_layers, num_layers)
     cached = _CHUNK_EMBED_CACHE.get(key)
     # guard id-reuse after GC: keep strong refs alongside the compiled fn
-    if cached is not None and cached[1] is model and cached[2] is user_forward_fn:
+    if (
+        cached is not None
+        and (cached[1] is model or (stateless and type(cached[1]) is object))
+        and cached[2] is user_forward_fn
+    ):
         return cached[0]
 
     def pipeline(ids, mask, weight_mask):
@@ -145,21 +220,15 @@ def _chunk_embed_fn(model: Any, user_forward_fn: Optional[Callable], all_layers:
         part = part / jnp.clip(jnp.linalg.norm(part, axis=-1, keepdims=True), 1e-12)
         return part * jnp.asarray(weight_mask, jnp.float32)[:, None, :, None]
 
-    jitted = jax.jit(pipeline)
-
-    def safe(ids, mask, weight_mask):
-        try:
-            return jitted(ids, mask, weight_mask)
-        except Exception:
-            return pipeline(jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(weight_mask))
+    fns = _EmbedFns(pipeline)
 
     # bounded FIFO: the cached closure necessarily pins its model, so cap how
     # many distinct models stay pinned; evicting oldest (not clearing all)
     # keeps the hot entries compiled
     while len(_CHUNK_EMBED_CACHE) >= 8:
         _CHUNK_EMBED_CACHE.pop(next(iter(_CHUNK_EMBED_CACHE)))
-    _CHUNK_EMBED_CACHE[key] = (safe, model, user_forward_fn)
-    return safe
+    _CHUNK_EMBED_CACHE[key] = (fns, model, user_forward_fn)
+    return fns
 
 
 def _embed(
@@ -213,16 +282,38 @@ def _embed(
         last = np.argmax(np.cumsum(attention_mask - 0.1, axis=1), axis=1)
         weight_mask[np.arange(weight_mask.shape[0]), last] = 0
 
-    # forward + unit-normalize + mask fused into ONE jit call per chunk
-    # (cached across chunks AND compute calls — uniform chunking keeps the
-    # shape signature constant); eagerly this path is dozens of dispatches
-    chunk_fn = _chunk_embed_fn(model, user_forward_fn, all_layers, num_layers)
-    chunks = []
-    for lo in range(0, n_pad, step):
-        chunks.append(
-            chunk_fn(input_ids[lo : lo + step], attention_mask[lo : lo + step], weight_mask[lo : lo + step])
+    # forward + unit-normalize + mask fused into jit (cached across chunks
+    # AND compute calls — uniform chunking keeps the shape signature
+    # constant); eagerly this path is dozens of dispatches
+    fns = _chunk_embed_fn(model, user_forward_fn, all_layers, num_layers)
+    n_chunks = n_pad // step if step else 0
+    emb = None
+    if n_chunks > 4:
+        # whole-corpus embed as ONE lax.scan dispatch; the chunk COUNT is
+        # padded to the next power of two so corpora of different sizes share
+        # a handful of compiled signatures instead of one each
+        k = 1 << (n_chunks - 1).bit_length()
+        rows = k * step
+        ids3 = np.zeros((rows, input_ids.shape[1]), input_ids.dtype)
+        mask3 = np.zeros((rows, attention_mask.shape[1]), attention_mask.dtype)
+        wm3 = np.zeros((rows, weight_mask.shape[1]), weight_mask.dtype)
+        ids3[:n_pad], mask3[:n_pad], wm3[:n_pad] = input_ids, attention_mask, weight_mask
+        out = fns.scan(
+            ids3.reshape(k, step, -1), mask3.reshape(k, step, -1), wm3.reshape(k, step, -1)
         )
-    emb = jnp.concatenate(chunks, axis=0)[:n] if len(chunks) > 1 else (chunks[0][:n] if chunks else jnp.zeros((0, 1, 0, 0)))
+        if out is not None:
+            emb = out.reshape((rows,) + out.shape[2:])[:n]
+    if emb is None:
+        chunks = []
+        for lo in range(0, n_pad, step):
+            chunks.append(
+                fns.chunk(input_ids[lo : lo + step], attention_mask[lo : lo + step], weight_mask[lo : lo + step])
+            )
+        emb = (
+            jnp.concatenate(chunks, axis=0)[:n]
+            if len(chunks) > 1
+            else (chunks[0][:n] if chunks else jnp.zeros((0, 1, 0, 0)))
+        )
     input_ids = input_ids[:n]
     attention_mask = attention_mask[:n]
     weight_mask = weight_mask[:n]
@@ -305,30 +396,20 @@ def bert_score(
     )
 
     # score in chunks too: the (b, l, p, r) similarity tensor is the peak;
-    # chunks are padded to one uniform shape and the scoring fn is jitted, so
-    # the whole loop costs a single XLA compile regardless of corpus size
+    # the whole chunked loop (pad, slice, score, concatenate) runs as ONE
+    # dispatch via _score_scan, with the chunk count padded to a power of two
+    # so corpora of different sizes share a handful of compiled signatures
     n = preds_emb.shape[0]
     step = max(1, batch_size)
-    n_pad = -(-n // step) * step if n else 0
-    if n_pad != n:
-        pad = [(0, n_pad - n)] + [(0, 0)] * (preds_emb.ndim - 1)
-        preds_emb = jnp.pad(preds_emb, pad)
-        target_emb = jnp.pad(target_emb, pad)
-        preds_scale = jnp.pad(preds_scale, [(0, n_pad - n), (0, 0)])
-        target_scale = jnp.pad(target_scale, [(0, n_pad - n), (0, 0)])
-    parts = []
-    for lo in range(0, n_pad, step):
-        parts.append(
-            _get_precision_recall_f1_jit(
-                preds_emb[lo : lo + step],
-                target_emb[lo : lo + step],
-                preds_scale[lo : lo + step],
-                target_scale[lo : lo + step],
-            )
+    n_chunks = -(-n // step) if n else 0
+    if n_chunks:
+        k = 1 << (n_chunks - 1).bit_length()
+        precision, recall, f1 = (
+            x[..., :n]
+            for x in _score_scan(preds_emb, target_emb, preds_scale, target_scale, k, step)
         )
-    precision = jnp.concatenate([jnp.atleast_1d(p) for p, _, _ in parts])[:n]
-    recall = jnp.concatenate([jnp.atleast_1d(r) for _, r, _ in parts])[:n]
-    f1 = jnp.concatenate([jnp.atleast_1d(f) for _, _, f in parts])[:n]
+    else:
+        precision = recall = f1 = jnp.zeros((0,), jnp.float32)
     output = {"precision": precision, "recall": recall, "f1": f1}
     if return_hash:
         output["hash"] = f"tpumetrics-bert_score-idf:{idf}"  # type: ignore[assignment]

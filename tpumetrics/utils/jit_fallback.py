@@ -34,9 +34,12 @@ class JitWithEagerFallback:
             return self._fn(*args)
         try:
             return self._jitted(*args)
-        except (jax.errors.JAXTypeError, TypeError) as err:
-            # eager re-run first: a genuine data error raises here too and
-            # must NOT flip the metric into permanent eager dispatch
+        except Exception as err:
+            # broad on purpose: exotic user callables raise arbitrary types
+            # when handed a tracer.  The eager re-run below keeps this safe —
+            # a genuine data error raises there too and propagates, and the
+            # eager latch only flips after an eager SUCCESS, so transient
+            # failures never permanently downgrade dispatch.
             out = self._fn(*args)
             self.eager_mode = True
             from tpumetrics.utils.prints import rank_zero_warn
