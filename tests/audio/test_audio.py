@@ -263,11 +263,12 @@ def test_srmr_native_basic_properties():
     kernel = np.exp(-np.arange(2000) / 600.0)
     reverb = np.convolve(clean, kernel)[:fs].astype(np.float32)
 
-    s_clean = float(speech_reverberation_modulation_energy_ratio(jnp.asarray(clean), fs))
-    s_reverb = float(speech_reverberation_modulation_energy_ratio(jnp.asarray(reverb), fs))
+    # 1-D input yields shape (1,), matching the reference's unsqueezed batch axis
+    s_clean = float(speech_reverberation_modulation_energy_ratio(jnp.asarray(clean), fs)[0])
+    s_reverb = float(speech_reverberation_modulation_energy_ratio(jnp.asarray(reverb), fs)[0])
     assert np.isfinite(s_clean) and np.isfinite(s_reverb) and s_clean > 0 and s_reverb > 0
     # the score is an energy RATIO: rescaling the waveform must not move it
-    s_scaled = float(speech_reverberation_modulation_energy_ratio(jnp.asarray(clean * 3.0), fs))
+    s_scaled = float(speech_reverberation_modulation_energy_ratio(jnp.asarray(clean * 3.0), fs)[0])
     np.testing.assert_allclose(s_scaled, s_clean, rtol=1e-4)
 
     batch = jnp.asarray(np.stack([clean, reverb]))

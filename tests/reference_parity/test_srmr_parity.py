@@ -9,12 +9,18 @@ filters with a fused ``lax.scan`` biquad cascade, so coefficient algebra and
 recursion implementations are cross-checked, not shared.
 
 Tolerance: the reference pipeline runs float64 end to end; ours runs in the
-input dtype (float32 under default-x64-disabled JAX). The gammatone recursion
-over thousands of samples amplifies that gap, so f32 scores are compared at
-5% relative; ``test_srmr_float64_exact_parity`` reruns the same comparison in
-a JAX_ENABLE_X64 subprocess and pins 1e-6, proving the DSP itself is exact
-and the residual is purely precision.  The independent frequency-response
-test pins the filter DESIGN at 1e-10 with no oracle at all.
+input dtype (float32 under default-x64-disabled JAX). The gammatone/IIR
+recursion over thousands of samples amplifies that gap — measured over 80
+randomized speech-like signals (2 rates x norm on/off x 20 seeds, the sweep
+in ``test_srmr_f32_divergence_distribution``'s docstring): median relative
+error 3.4e-3, p95 6.0e-2, max 8.0e-2.  The tail is inherent to f32 IIR
+feedback accumulation (not a bias): ``test_srmr_float64_exact_parity``
+reruns the comparison in a JAX_ENABLE_X64 subprocess and pins 1e-6, proving
+the DSP itself is exact, and the distribution test below pins the f32 error
+empirically — a tight median bound (catches systematic divergence) plus the
+observed-tail bound, instead of one round blanket number.  The independent
+frequency-response test pins the filter DESIGN at 1e-10 with no oracle at
+all.
 """
 
 import numpy as np
@@ -44,6 +50,42 @@ def test_srmr_matches_reference(ref, fs, seconds, norm):
     np.testing.assert_allclose(np.asarray(got, np.float64), want.numpy(), rtol=5e-2)
 
 
+def test_srmr_f32_divergence_distribution(ref):
+    """Empirical f32 bound: across a randomized signal family spanning both
+    sample rates and both norm modes, the relative error vs the (f64)
+    reference must keep a small MEDIAN (no systematic divergence) and stay
+    under the observed tail.  Reference sweep (80 signals: fs in {8k, 16k}
+    x norm x 20 seeds): median 3.4e-3, p95 6.0e-2, max 8.0e-2.  This test
+    runs a 14-signal subset of the same generator; bounds carry headroom for
+    subset variance — median 4x the full-sweep median, max 1.5x the
+    full-sweep max."""
+    import jax.numpy as jnp
+    import torch
+    from torchmetrics.functional.audio.srmr import speech_reverberation_modulation_energy_ratio as ref_srmr
+
+    from tpumetrics.functional.audio import speech_reverberation_modulation_energy_ratio as our_srmr
+
+    rels = []
+    for fs, norm, seeds in ((8000, False, 8), (16000, True, 3), (8000, True, 3)):
+        for seed in range(seeds):
+            rng = np.random.default_rng(seed * 13 + fs + int(norm))
+            t = np.arange(fs) / fs
+            carrier = rng.normal(0, 1, t.shape)
+            f1, f2 = rng.uniform(2, 8), rng.uniform(8, 16)
+            env = (
+                1
+                + rng.uniform(0.4, 0.9) * np.sin(2 * np.pi * f1 * t)
+                + rng.uniform(0.1, 0.5) * np.sin(2 * np.pi * f2 * t)
+            )
+            wave = (carrier * env).astype(np.float32)
+            want = float(ref_srmr(torch.from_numpy(wave.copy()), fs, norm=norm)[0])
+            got = float(our_srmr(jnp.asarray(wave), fs, norm=norm)[0])
+            rels.append(abs(got - want) / abs(want))
+    rels = np.asarray(rels)
+    assert np.median(rels) < 1.5e-2, f"median f32 divergence drifted: {np.median(rels):.3e}"
+    assert rels.max() < 1.2e-1, f"f32 divergence tail exceeded observed max: {rels.max():.3e}"
+
+
 def test_srmr_single_waveform_shape_and_parity(ref):
     import jax.numpy as jnp
     import torch
@@ -55,9 +97,10 @@ def test_srmr_single_waveform_shape_and_parity(ref):
     t = np.arange(8000) / 8000
     wave = (rng.normal(0, 1, 8000) * (1 + 0.7 * np.sin(2 * np.pi * 6 * t))).astype(np.float32)
     got = our_srmr(jnp.asarray(wave), 8000)
-    assert got.shape == ()
     want = ref_srmr(torch.from_numpy(wave.copy()), 8000)
-    np.testing.assert_allclose(float(got), float(want), rtol=2e-2)
+    # the reference never squeezes its batch axis: 1-D input -> shape (1,)
+    assert got.shape == tuple(want.shape) == (1,)
+    np.testing.assert_allclose(float(got[0]), float(want[0]), rtol=2e-2)
 
 
 def test_srmr_float64_exact_parity(ref):

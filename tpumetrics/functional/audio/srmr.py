@@ -259,7 +259,9 @@ def speech_reverberation_modulation_energy_ratio(
         denominator the protocol defines).
 
     Returns:
-        SRMR score(s) with shape ``preds.shape[:-1]``.
+        SRMR score(s) with shape ``preds.shape[:-1]``; a 1-D waveform yields
+        shape ``(1,)``, matching the reference (its batch axis never
+        squeezes — reference srmr.py doctest ``tensor([0.3354])``).
 
     Example:
         >>> import jax, jax.numpy as jnp
@@ -267,7 +269,7 @@ def speech_reverberation_modulation_energy_ratio(
         >>> g = jax.random.normal(jax.random.PRNGKey(1), (8000,))
         >>> score = speech_reverberation_modulation_energy_ratio(g, 8000)
         >>> score.shape
-        ()
+        (1,)
     """
     _srmr_arg_validate(fs, n_cochlear_filters, low_freq, min_cf, max_cf, norm, fast)
     preds = jnp.asarray(preds)
@@ -350,7 +352,7 @@ def speech_reverberation_modulation_energy_ratio(
     denom_energy = jnp.sum(jnp.where(denom_mask, avg_energy, 0.0), axis=(1, 2))
     score = num_energy / denom_energy
 
-    return score.reshape(shape[:-1]) if len(shape) > 1 else score.reshape(())
+    return score.reshape(shape[:-1]) if len(shape) > 1 else score.reshape((1,))
 
 
 def _srmr_arg_validate(
