@@ -119,6 +119,27 @@ def _sig(obj) -> str:
         return "(...)"
 
 
+def _check_examples() -> None:
+    """Generation FAILS if an exported metric class ships without a runnable
+    example block: the per-metric pages embed each class docstring, and the
+    doctest sweep (tests/test_doctests.py) executes what's embedded — so this
+    gate keeps every page's example real, not decorative."""
+    missing = [
+        n
+        for n in tpumetrics.__all__
+        if inspect.isclass(getattr(tpumetrics, n, None))
+        and issubclass(getattr(tpumetrics, n), Metric)
+        and getattr(tpumetrics, n) is not Metric
+        and ">>>" not in (inspect.getdoc(getattr(tpumetrics, n)) or "")
+    ]
+    if missing:
+        raise SystemExit(
+            f"exported metric classes without a runnable docstring example: {sorted(missing)}"
+        )
+
+
+_check_examples()
+
 os.makedirs(os.path.join(os.path.dirname(__file__), "metrics"), exist_ok=True)
 
 index_lines = ["# All metrics", "", "Generated from the live package (`python docs/_gen_index.py`).", ""]

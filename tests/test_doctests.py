@@ -27,3 +27,22 @@ def test_module_doctests(module_name):
         verbose=False,
     )
     assert results.failed == 0, f"{results.failed} doctest failure(s) in {module_name}"
+
+
+def test_every_exported_metric_has_a_runnable_example():
+    """CI mirror of docs/_gen_index.py's generation gate: the per-metric doc
+    pages embed class docstrings, and the sweep above executes what's
+    embedded — so every exported metric class must carry a doctest block."""
+    import inspect
+
+    from tpumetrics.metric import Metric
+
+    missing = [
+        n
+        for n in tpumetrics.__all__
+        if inspect.isclass(getattr(tpumetrics, n, None))
+        and issubclass(getattr(tpumetrics, n), Metric)
+        and getattr(tpumetrics, n) is not Metric
+        and ">>>" not in (inspect.getdoc(getattr(tpumetrics, n)) or "")
+    ]
+    assert not missing, f"exported metric classes without a runnable docstring example: {sorted(missing)}"

@@ -16,7 +16,17 @@ Array = jax.Array
 
 class PerceptualEvaluationSpeechQuality(Metric):
     """Mean PESQ over samples — a documented host-side (CPU) metric, like the
-    reference (reference audio/pesq.py)."""
+    reference (reference audio/pesq.py).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.audio import PerceptualEvaluationSpeechQuality
+        >>> wave = jax.random.normal(jax.random.PRNGKey(0), (8000,))
+        >>> metric = PerceptualEvaluationSpeechQuality(8000, 'nb')  # doctest: +SKIP
+        >>> metric.update(wave, wave)  # doctest: +SKIP
+        >>> round(float(metric.compute()), 2)  # doctest: +SKIP
+        4.64
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True

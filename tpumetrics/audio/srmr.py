@@ -16,7 +16,17 @@ Array = jax.Array
 class SpeechReverberationModulationEnergyRatio(Metric):
     """Mean SRMR over samples — native gammatone + modulation filterbank
     implementation, no external DSP packages (the reference audio/srmr.py
-    gates on ``gammatone``/``torchaudio``; see functional/audio/srmr.py)."""
+    gates on ``gammatone``/``torchaudio``; see functional/audio/srmr.py).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.audio import SpeechReverberationModulationEnergyRatio
+        >>> wave = jax.random.normal(jax.random.PRNGKey(1), (8000,))
+        >>> metric = SpeechReverberationModulationEnergyRatio(fs=8000)
+        >>> metric.update(wave)
+        >>> round(float(metric.compute()), 4)
+        0.3088
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True

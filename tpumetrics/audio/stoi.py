@@ -16,7 +16,17 @@ Array = jax.Array
 
 class ShortTimeObjectiveIntelligibility(Metric):
     """Mean STOI over samples — a documented host-side (CPU) metric, like the
-    reference (reference audio/stoi.py)."""
+    reference (reference audio/stoi.py).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.audio import ShortTimeObjectiveIntelligibility
+        >>> wave = jax.random.normal(jax.random.PRNGKey(0), (8000,))
+        >>> metric = ShortTimeObjectiveIntelligibility(fs=8000)  # doctest: +SKIP
+        >>> metric.update(wave, wave)  # doctest: +SKIP
+        >>> round(float(metric.compute()), 2)  # doctest: +SKIP
+        1.0
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True

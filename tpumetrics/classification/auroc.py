@@ -161,7 +161,18 @@ class MultilabelAUROC(MultilabelPrecisionRecallCurve):
 
 
 class AUROC(_ClassificationTaskWrapper):
-    """Task-string wrapper for AUROC (reference classification/auroc.py:391)."""
+    """Task-string wrapper for AUROC (reference classification/auroc.py:391).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import AUROC
+        >>> probs = jnp.asarray([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> metric = AUROC(task="binary", thresholds=8)
+        >>> metric.update(probs, target)
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -148,7 +148,18 @@ class MultilabelAveragePrecision(MultilabelPrecisionRecallCurve):
 
 
 class AveragePrecision(_ClassificationTaskWrapper):
-    """Task-string wrapper (reference classification/average_precision.py:388)."""
+    """Task-string wrapper (reference classification/average_precision.py:388).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import AveragePrecision
+        >>> probs = jnp.asarray([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> metric = AveragePrecision(task="binary", thresholds=8)
+        >>> metric.update(probs, target)
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

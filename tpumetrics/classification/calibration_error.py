@@ -150,7 +150,18 @@ class MulticlassCalibrationError(Metric):
 
 
 class CalibrationError(_ClassificationTaskWrapper):
-    """Task-string wrapper (reference classification/calibration_error.py:297)."""
+    """Task-string wrapper (reference classification/calibration_error.py:297).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import CalibrationError
+        >>> probs = jnp.asarray([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> metric = CalibrationError(task="binary", n_bins=4)
+        >>> metric.update(probs, target)
+        >>> round(float(metric.compute()), 4)
+        0.195
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

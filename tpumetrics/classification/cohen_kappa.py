@@ -102,7 +102,18 @@ class MulticlassCohenKappa(MulticlassConfusionMatrix):
 
 
 class CohenKappa(_ClassificationTaskWrapper):
-    """Task-string wrapper (reference classification/cohen_kappa.py:252)."""
+    """Task-string wrapper (reference classification/cohen_kappa.py:252).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import CohenKappa
+        >>> logits = jnp.asarray([[2.0, 0.5, 0.1], [0.3, 2.1, 0.2], [0.2, 0.3, 2.2], [2.0, 0.1, 0.4]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = CohenKappa(task="multiclass", num_classes=3)
+        >>> metric.update(logits, target)
+        >>> round(float(metric.compute()), 4)
+        0.6364
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -185,7 +185,20 @@ class MultilabelConfusionMatrix(Metric):
 
 
 class ConfusionMatrix(_ClassificationTaskWrapper):
-    """Task-string wrapper for confusion matrix."""
+    """Task-string wrapper for confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import ConfusionMatrix
+        >>> logits = jnp.asarray([[2.0, 0.5, 0.1], [0.3, 2.1, 0.2], [0.2, 0.3, 2.2], [2.0, 0.1, 0.4]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = ConfusionMatrix(task="multiclass", num_classes=3)
+        >>> metric.update(logits, target)
+        >>> metric.compute()
+        Array([[1, 0, 0],
+               [1, 1, 0],
+               [0, 0, 1]], dtype=int32)
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

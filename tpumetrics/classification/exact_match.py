@@ -153,7 +153,18 @@ class MultilabelExactMatch(_AbstractExactMatch):
 
 
 class ExactMatch(_ClassificationTaskWrapper):
-    """Task-string wrapper for exact match (multiclass | multilabel)."""
+    """Task-string wrapper for exact match (multiclass | multilabel).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import ExactMatch
+        >>> preds = jnp.asarray([[0, 1], [2, 2], [1, 1]])
+        >>> target = jnp.asarray([[0, 1], [2, 0], [1, 1]])
+        >>> metric = ExactMatch(task="multiclass", num_classes=3)
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.6667
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

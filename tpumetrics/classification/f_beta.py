@@ -222,7 +222,18 @@ class MultilabelF1Score(MultilabelFBetaScore):
 
 
 class FBetaScore(_ClassificationTaskWrapper):
-    """Task-string wrapper for F-beta."""
+    """Task-string wrapper for F-beta.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import FBetaScore
+        >>> logits = jnp.asarray([[2.0, 0.5, 0.1], [0.3, 2.1, 0.2], [0.2, 0.3, 2.2], [2.0, 0.1, 0.4]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = FBetaScore(task="multiclass", num_classes=3, beta=0.5)
+        >>> metric.update(logits, target)
+        >>> round(float(metric.compute()), 4)
+        0.75
+    """
 
     def __new__(  # type: ignore[misc]
         cls,
@@ -258,7 +269,18 @@ class FBetaScore(_ClassificationTaskWrapper):
 
 
 class F1Score(_ClassificationTaskWrapper):
-    """Task-string wrapper for F1."""
+    """Task-string wrapper for F1.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import F1Score
+        >>> logits = jnp.asarray([[2.0, 0.5, 0.1], [0.3, 2.1, 0.2], [0.2, 0.3, 2.2], [2.0, 0.1, 0.4]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = F1Score(task="multiclass", num_classes=3)
+        >>> metric.update(logits, target)
+        >>> round(float(metric.compute()), 4)
+        0.75
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

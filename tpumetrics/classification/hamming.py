@@ -73,7 +73,18 @@ class MultilabelHammingDistance(MultilabelStatScores):
 
 
 class HammingDistance(_ClassificationTaskWrapper):
-    """Task-string wrapper for Hamming distance."""
+    """Task-string wrapper for Hamming distance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import HammingDistance
+        >>> probs = jnp.asarray([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> metric = HammingDistance(task="binary")
+        >>> metric.update(probs, target)
+        >>> round(float(metric.compute()), 4)
+        0.0
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -153,7 +153,18 @@ class MulticlassHingeLoss(Metric):
 
 
 class HingeLoss(_ClassificationTaskWrapper):
-    """Task-string wrapper (reference classification/hinge.py:233)."""
+    """Task-string wrapper (reference classification/hinge.py:233).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import HingeLoss
+        >>> probs = jnp.asarray([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> metric = HingeLoss(task="binary")
+        >>> metric.update(probs, target)
+        >>> round(float(metric.compute()), 4)
+        0.695
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

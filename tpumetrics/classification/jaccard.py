@@ -148,7 +148,18 @@ class MultilabelJaccardIndex(MultilabelConfusionMatrix):
 
 
 class JaccardIndex(_ClassificationTaskWrapper):
-    """Task-string wrapper (reference classification/jaccard.py:357)."""
+    """Task-string wrapper (reference classification/jaccard.py:357).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import JaccardIndex
+        >>> logits = jnp.asarray([[2.0, 0.5, 0.1], [0.3, 2.1, 0.2], [0.2, 0.3, 2.2], [2.0, 0.1, 0.4]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = JaccardIndex(task="multiclass", num_classes=3)
+        >>> metric.update(logits, target)
+        >>> round(float(metric.compute()), 4)
+        0.6667
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

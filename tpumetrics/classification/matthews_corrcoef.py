@@ -132,7 +132,18 @@ class MultilabelMatthewsCorrCoef(MultilabelConfusionMatrix):
 
 
 class MatthewsCorrCoef(_ClassificationTaskWrapper):
-    """Task-string wrapper (reference classification/matthews_corrcoef.py:355)."""
+    """Task-string wrapper (reference classification/matthews_corrcoef.py:355).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import MatthewsCorrCoef
+        >>> logits = jnp.asarray([[2.0, 0.5, 0.1], [0.3, 2.1, 0.2], [0.2, 0.3, 2.2], [2.0, 0.1, 0.4]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = MatthewsCorrCoef(task="multiclass", num_classes=3)
+        >>> metric.update(logits, target)
+        >>> round(float(metric.compute()), 4)
+        0.7
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -158,7 +158,18 @@ class MultilabelPrecisionAtFixedRecall(_AtFixedValuePlotMixin, MultilabelPrecisi
 
 
 class PrecisionAtFixedRecall(_ClassificationTaskWrapper):
-    """Task-string wrapper (reference classification/precision_fixed_recall.py:356)."""
+    """Task-string wrapper (reference classification/precision_fixed_recall.py:356).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import PrecisionAtFixedRecall
+        >>> probs = jnp.asarray([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> metric = PrecisionAtFixedRecall(task="binary", min_recall=0.5)
+        >>> metric.update(probs, target)
+        >>> [round(float(v), 4) for v in metric.compute()]
+        [1.0, 0.73]
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

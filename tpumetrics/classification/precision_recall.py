@@ -137,7 +137,18 @@ class MultilabelRecall(MultilabelStatScores):
 
 
 class Precision(_ClassificationTaskWrapper):
-    """Task-string wrapper for precision."""
+    """Task-string wrapper for precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import Precision
+        >>> logits = jnp.asarray([[2.0, 0.5, 0.1], [0.3, 2.1, 0.2], [0.2, 0.3, 2.2], [2.0, 0.1, 0.4]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = Precision(task="multiclass", num_classes=3, average="macro")
+        >>> metric.update(logits, target)
+        >>> round(float(metric.compute()), 4)
+        0.8333
+    """
 
     def __new__(  # type: ignore[misc]
         cls,
@@ -172,7 +183,18 @@ class Precision(_ClassificationTaskWrapper):
 
 
 class Recall(_ClassificationTaskWrapper):
-    """Task-string wrapper for recall."""
+    """Task-string wrapper for recall.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import Recall
+        >>> logits = jnp.asarray([[2.0, 0.5, 0.1], [0.3, 2.1, 0.2], [0.2, 0.3, 2.2], [2.0, 0.1, 0.4]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = Recall(task="multiclass", num_classes=3, average="macro")
+        >>> metric.update(logits, target)
+        >>> round(float(metric.compute()), 4)
+        0.8333
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -283,7 +283,19 @@ class MultilabelPrecisionRecallCurve(Metric):
 
 
 class PrecisionRecallCurve(_ClassificationTaskWrapper):
-    """Task-string wrapper (reference classification/precision_recall_curve.py:463)."""
+    """Task-string wrapper (reference classification/precision_recall_curve.py:463).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import PrecisionRecallCurve
+        >>> probs = jnp.asarray([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> metric = PrecisionRecallCurve(task="binary", thresholds=4)
+        >>> metric.update(probs, target)
+        >>> precision, recall, thresholds = metric.compute()
+        >>> precision.shape, recall.shape, thresholds.shape
+        ((5,), (5,), (4,))
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

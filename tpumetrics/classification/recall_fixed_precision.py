@@ -159,7 +159,18 @@ class MultilabelRecallAtFixedPrecision(_AtFixedValuePlotMixin, MultilabelPrecisi
 
 
 class RecallAtFixedPrecision(_ClassificationTaskWrapper):
-    """Task-string wrapper (reference classification/recall_fixed_precision.py:358)."""
+    """Task-string wrapper (reference classification/recall_fixed_precision.py:358).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import RecallAtFixedPrecision
+        >>> probs = jnp.asarray([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> metric = RecallAtFixedPrecision(task="binary", min_precision=0.5)
+        >>> metric.update(probs, target)
+        >>> [round(float(v), 4) for v in metric.compute()]
+        [1.0, 0.73]
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -99,7 +99,19 @@ class MultilabelROC(MultilabelPrecisionRecallCurve):
 
 
 class ROC(_ClassificationTaskWrapper):
-    """Task-string wrapper for ROC (reference classification/roc.py:389)."""
+    """Task-string wrapper for ROC (reference classification/roc.py:389).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import ROC
+        >>> probs = jnp.asarray([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> metric = ROC(task="binary", thresholds=4)
+        >>> metric.update(probs, target)
+        >>> fpr, tpr, thresholds = metric.compute()
+        >>> fpr.shape, tpr.shape, thresholds.shape
+        ((4,), (4,), (4,))
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -71,7 +71,18 @@ class MultilabelSpecificity(MultilabelStatScores):
 
 
 class Specificity(_ClassificationTaskWrapper):
-    """Task-string wrapper for specificity."""
+    """Task-string wrapper for specificity.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import Specificity
+        >>> logits = jnp.asarray([[2.0, 0.5, 0.1], [0.3, 2.1, 0.2], [0.2, 0.3, 2.2], [2.0, 0.1, 0.4]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = Specificity(task="multiclass", num_classes=3, average="macro")
+        >>> metric.update(logits, target)
+        >>> round(float(metric.compute()), 4)
+        0.8889
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

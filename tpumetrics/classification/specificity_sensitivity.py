@@ -159,7 +159,18 @@ class MultilabelSpecificityAtSensitivity(_AtFixedValuePlotMixin, MultilabelPreci
 
 
 class SpecificityAtSensitivity(_ClassificationTaskWrapper):
-    """Task-string wrapper (reference classification/specificity_sensitivity.py:364)."""
+    """Task-string wrapper (reference classification/specificity_sensitivity.py:364).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import SpecificityAtSensitivity
+        >>> probs = jnp.asarray([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> metric = SpecificityAtSensitivity(task="binary", min_sensitivity=0.5)
+        >>> metric.update(probs, target)
+        >>> [round(float(v), 4) for v in metric.compute()]
+        [1.0, 0.84]
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -243,7 +243,18 @@ class MultilabelStatScores(_AbstractStatScores):
 
 class StatScores(_ClassificationTaskWrapper):
     """Task-string wrapper: ``StatScores(task="binary", ...)`` resolves to the
-    concrete metric (reference classification/stat_scores.py:480, ``__new__`` dispatch)."""
+    concrete metric (reference classification/stat_scores.py:480, ``__new__`` dispatch).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import StatScores
+        >>> probs = jnp.asarray([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> metric = StatScores(task="binary")
+        >>> metric.update(probs, target)
+        >>> metric.compute()
+        Array([3, 0, 3, 0, 3], dtype=int32)
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -107,7 +107,23 @@ def perceptual_path_length(
 class PerceptualPathLength(Metric):
     """PPL as a metric object: ``update`` is a no-op (the generator is
     sampled at compute), mirroring the reference's design where the metric
-    owns the sampling loop."""
+    owns the sampling loop.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.image import PerceptualPathLength
+        >>> def generator(z):
+        ...     img = jnp.tanh(z[:, :48].reshape(z.shape[0], 3, 4, 4))
+        ...     return jnp.repeat(jnp.repeat(img, 4, axis=2), 4, axis=3)
+        >>> def sim_net(x):  # toy perceptual feature stack
+        ...     return [x[:, :, ::2, ::2], jnp.tanh(x).mean(axis=1, keepdims=True)]
+        >>> metric = PerceptualPathLength(num_samples=8, batch_size=8, sim_net=sim_net,
+        ...                               resize=None, latent_dim=64)
+        >>> metric.update(generator)
+        >>> mean, std, dist = metric.compute()
+        >>> bool(jnp.isfinite(mean)), dist.shape
+        (True, (8,))
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = False

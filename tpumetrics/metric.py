@@ -1134,7 +1134,18 @@ def _reduce_fn_to_op(reduction_fn: Any) -> Optional[str]:
 
 
 class CompositionalMetric(Metric):
-    """Lazy arithmetic composition of two metrics (reference metric.py:1075-1198)."""
+    """Lazy arithmetic composition of two metrics (reference metric.py:1075-1198).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics import SumMetric
+        >>> a, b = SumMetric(), SumMetric()
+        >>> combined = a + b  # CompositionalMetric(jnp.add, a, b)
+        >>> a.update(2.0)
+        >>> b.update(3.0)
+        >>> float(combined.compute())
+        5.0
+    """
 
     def __init__(
         self,

@@ -20,7 +20,17 @@ Array = jax.Array
 
 
 class CLIPImageQualityAssessment(Metric):
-    """CLIP-IQA accumulated over batches: per-prompt probability sums."""
+    """CLIP-IQA accumulated over batches: per-prompt probability sums.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.multimodal import CLIPImageQualityAssessment
+        >>> metric = CLIPImageQualityAssessment()  # doctest: +SKIP
+        >>> imgs = jax.random.uniform(jax.random.PRNGKey(0), (1, 3, 224, 224))
+        >>> metric.update(imgs)  # doctest: +SKIP
+        >>> metric.compute().shape  # doctest: +SKIP
+        (1,)
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = True

@@ -20,6 +20,15 @@ class CLIPScore(Metric):
     Args:
         model_name_or_path: HF hub id of a CLIP checkpoint, or an explicit
             ``(model, processor)`` pair for offline/custom models.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from tpumetrics.multimodal import CLIPScore
+        >>> metric = CLIPScore(model_name_or_path='openai/clip-vit-base-patch16')  # doctest: +SKIP
+        >>> imgs = jax.random.randint(jax.random.PRNGKey(0), (1, 3, 224, 224), 0, 255)
+        >>> metric.update(imgs, ['a photo of a cat'])  # doctest: +SKIP
+        >>> round(float(metric.compute()), 1)  # doctest: +SKIP
+        19.1
     """
 
     is_differentiable: bool = False

@@ -28,6 +28,13 @@ class BERTScore(HostSentenceStateMixin, Metric):
         model_name_or_path: transformers hub id (gated when not downloadable).
         model / user_tokenizer / user_forward_fn: custom embedding stack.
         idf: inverse-document-frequency weighting over the reference corpus.
+
+    Example:
+        >>> from tpumetrics.text import BERTScore
+        >>> metric = BERTScore(model_name_or_path='roberta-large')  # doctest: +SKIP
+        >>> metric.update(['the cat sat'], ['a cat sat'])  # doctest: +SKIP
+        >>> {k: round(float(v[0]), 3) for k, v in metric.compute().items()}  # doctest: +SKIP
+        {'precision': 0.998, 'recall': 0.998, 'f1': 0.998}
     """
 
     is_differentiable: bool = False

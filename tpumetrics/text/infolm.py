@@ -16,7 +16,15 @@ Array = jax.Array
 
 class InfoLM(HostSentenceStateMixin, Metric):
     """InfoLM accumulated over batches (sentences stored, embedded at compute
-    like :class:`~tpumetrics.text.bert.BERTScore`)."""
+    like :class:`~tpumetrics.text.bert.BERTScore`).
+
+    Example:
+        >>> from tpumetrics.text import InfoLM
+        >>> metric = InfoLM(model_name_or_path='google/bert_uncased_L-2_H-128_A-2')  # doctest: +SKIP
+        >>> metric.update(['the cat sat'], ['a cat sat'])  # doctest: +SKIP
+        >>> float(metric.compute())  # doctest: +SKIP
+        -0.1784
+    """
 
     is_differentiable: bool = False
     higher_is_better: bool = False
