@@ -155,3 +155,93 @@ def test_segm_crowd_parity(ref, seed):
     ours = _run_ours(preds, target, iou_type="segm", masks=masks, gt_masks=gt_masks)
     oracle = _run_cocoeval_reference(preds, target, iou_type="segm", masks=masks, gt_masks=gt_masks)
     _assert_close(ours, oracle)
+
+
+@pytest.mark.parametrize("seed", [60])
+def test_extended_summary_parity(ref, seed):
+    """extended_summary tensors (ious, precision, recall) match the
+    reference's pycocotools path cell for cell."""
+    import jax.numpy as jnp
+    import torch
+    from torchmetrics.detection.mean_ap import MeanAveragePrecision as RefMAP
+
+    from tests.reference_parity._corpus import make_crowd_corpus
+    from tpumetrics.detection import MeanAveragePrecision
+
+    preds, target = make_crowd_corpus(seed)
+    ours = MeanAveragePrecision(extended_summary=True)
+    ours.update([{k: jnp.asarray(v) for k, v in p.items()} for p in preds],
+                [{k: jnp.asarray(v) for k, v in t.items()} for t in target])
+    got = ours.compute()
+
+    oracle = RefMAP(iou_type="bbox", backend="pycocotools", extended_summary=True)
+    oracle.update([{k: torch.from_numpy(np.asarray(v)) for k, v in p.items()} for p in preds],
+                  [{k: torch.from_numpy(np.asarray(v)) for k, v in t.items()} for t in target])
+    want = oracle.compute()
+
+    np.testing.assert_allclose(np.asarray(got["precision"]), want["precision"].numpy(), atol=1e-9)
+    np.testing.assert_allclose(np.asarray(got["recall"]), want["recall"].numpy(), atol=1e-9)
+    ours_ious = {k: np.asarray(v) for k, v in got["ious"].items()}
+    want_ious = {k: (v.numpy() if hasattr(v, "numpy") else np.asarray(v)) for k, v in want["ious"].items()}
+    assert set(ours_ious) == set(want_ious)
+    for k in ours_ious:
+        if ours_ious[k].size or want_ious[k].size:
+            np.testing.assert_allclose(
+                ours_ious[k], want_ious[k].reshape(ours_ious[k].shape), atol=1e-6, err_msg=str(k)
+            )
+
+
+def test_tm_to_coco_round_trip(ref, tmp_path):
+    """tm_to_coco -> coco_to_tm -> a fresh metric reproduces the same scores."""
+    import jax.numpy as jnp
+
+    from tests.reference_parity._corpus import make_crowd_corpus
+    from tpumetrics.detection import MeanAveragePrecision
+
+    preds, target = make_crowd_corpus(70, num_images=6)
+    m = MeanAveragePrecision()
+    m.update([{k: jnp.asarray(v) for k, v in p.items()} for p in preds],
+             [{k: jnp.asarray(v) for k, v in t.items()} for t in target])
+    want = {k: np.asarray(v) for k, v in m.compute().items()}
+    m.tm_to_coco(str(tmp_path / "rt"))
+
+    p2, t2 = MeanAveragePrecision.coco_to_tm(str(tmp_path / "rt_preds.json"), str(tmp_path / "rt_target.json"))
+    m2 = MeanAveragePrecision(box_format="xywh")
+    m2.update(p2, t2)
+    got = {k: np.asarray(v) for k, v in m2.compute().items()}
+    for k in SCALAR_KEYS:
+        np.testing.assert_allclose(got[k], want[k], atol=1e-6, err_msg=k)
+
+
+def test_coco_to_tm_backfills_empty_images(tmp_path):
+    """Images with gt but no detections (and vice versa) must yield aligned
+    empty entries, not misaligned positional pairs."""
+    import json
+
+    from tpumetrics.detection import MeanAveragePrecision
+
+    target = {
+        "images": [{"id": 0}, {"id": 1}, {"id": 2}],
+        "annotations": [
+            {"id": 1, "image_id": 0, "bbox": [0, 0, 10, 10], "area": 100, "category_id": 1, "iscrowd": 0},
+            {"id": 2, "image_id": 1, "bbox": [5, 5, 10, 10], "area": 100, "category_id": 1, "iscrowd": 0},
+        ],
+        "categories": [{"id": 1}],
+    }
+    # detections only on images 0 and 3 (3 has no ground truth at all)
+    preds = [
+        {"image_id": 0, "bbox": [0, 0, 10, 10], "score": 0.9, "category_id": 1},
+        {"image_id": 3, "bbox": [1, 1, 5, 5], "score": 0.8, "category_id": 1},
+    ]
+    tp, tg = tmp_path / "p.json", tmp_path / "t.json"
+    tp.write_text(json.dumps(preds))
+    tg.write_text(json.dumps(target))
+    p, t = MeanAveragePrecision.coco_to_tm(str(tp), str(tg))
+    assert len(p) == len(t) == 4  # union of image ids {0, 1, 2, 3}
+    assert p[1]["boxes"].shape == (0, 4) and t[1]["boxes"].shape == (1, 4)  # img 1: gt only
+    assert p[3]["boxes"].shape == (1, 4) and t[3]["boxes"].shape == (0, 4)  # img 3: dets only
+    m = MeanAveragePrecision(box_format="xywh")
+    m.update(p, t)
+    res = m.compute()
+    # img0 perfect match; img1 gt missed; img3 detection is a pure FP
+    assert 0.0 < float(res["map_50"]) < 1.0

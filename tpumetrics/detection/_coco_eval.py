@@ -249,6 +249,7 @@ def coco_evaluate(
     average: str = "macro",
     iou_type: str = "bbox",
     geom_cache: Optional[List[Tuple[np.ndarray, np.ndarray, np.ndarray]]] = None,
+    extended: bool = False,
 ) -> Dict[str, np.ndarray]:
     """Full COCO evaluation over per-image detections/groundtruths.
 
@@ -284,6 +285,7 @@ def coco_evaluate(
         geom_cache if geom_cache is not None else precompute_geometries(detections, groundtruths, iou_type)
     )
 
+    iou_map: Dict[Tuple[int, int], np.ndarray] = {}
     for k_idx, class_id in enumerate(eval_class_ids):
         # per (image, class): sort detections by score and compute IoUs ONCE,
         # shared across all four area ranges (pycocotools computes computeIoU
@@ -311,6 +313,8 @@ def coco_evaluate(
             union = np.where(gc[None, :].astype(bool), da[:, None], union)
             ious = inter / np.where(union > 0, union, 1.0)
             per_image_cls.append((ious, da, ds, gc, area))
+            if extended:
+                iou_map[(img, int(class_id))] = ious
 
         # match once per image across ALL area ranges at the largest cap;
         # smaller caps reuse by slicing
@@ -363,4 +367,11 @@ def coco_evaluate(
     out["mar_per_class"] = np.asarray(
         [_mar(class_idx=k, max_det_idx=len(max_dets) - 1) for k in range(len(eval_class_ids))], np.float32
     )
+    if extended:
+        # the reference's extended_summary payload (reference mean_ap.py:525-536):
+        # score-sorted per-(image, class) IoU matrices plus the raw
+        # precision/recall tensors over (T, R, K, A, M) / (T, K, A, M)
+        out["ious"] = iou_map
+        out["precision"] = precision
+        out["recall"] = recall
     return out

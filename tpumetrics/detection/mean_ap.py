@@ -165,6 +165,9 @@ class MeanAveragePrecision(Metric):
         rec_thresholds: recall thresholds; defaults to COCO's 0:0.01:1.
         max_detection_thresholds: per-image detection caps (default 1/10/100).
         class_metrics: include per-class map/mar in the output.
+        extended_summary: additionally return the per-(image, class) IoU
+            matrices and the raw ``precision``/``recall`` tensors over
+            (T, R, K, A, M) / (T, K, A, M) (reference mean_ap.py:525-536).
         average: ``macro`` (COCO standard) or ``micro`` (classes pooled).
 
     Example:
@@ -216,6 +219,7 @@ class MeanAveragePrecision(Metric):
         rec_thresholds: Optional[List[float]] = None,
         max_detection_thresholds: Optional[List[int]] = None,
         class_metrics: bool = False,
+        extended_summary: bool = False,
         average: str = "macro",
         **kwargs: Any,
     ) -> None:
@@ -251,6 +255,9 @@ class MeanAveragePrecision(Metric):
         if not isinstance(class_metrics, bool):
             raise ValueError("Expected argument `class_metrics` to be a boolean")
         self.class_metrics = class_metrics
+        if not isinstance(extended_summary, bool):
+            raise ValueError("Expected argument `extended_summary` to be a boolean")
+        self.extended_summary = extended_summary
         if average not in ("macro", "micro"):
             raise ValueError(f"Expected argument `average` to be one of ('macro', 'micro') but got {average}")
         self.average = average
@@ -316,6 +323,150 @@ class MeanAveragePrecision(Metric):
             for t, n in zip(target, gcounts)
         )
         self.groundtruth_counts.append(np.asarray(gcounts, np.int64))
+
+    @staticmethod
+    def coco_to_tm(
+        coco_preds: str,
+        coco_target: str,
+        iou_type: str = "bbox",
+    ):
+        """Convert COCO-format json files into this metric's input format
+        (reference mean_ap.py:612-719, without needing pycocotools: the files
+        are plain json).  Boxes come back in COCO's xywh layout — construct
+        the metric with ``box_format="xywh"`` — and segm masks must be
+        uncompressed-RLE dicts (compressed-string counts / polygons need the
+        real pycocotools toolchain).
+
+        Returns:
+            ``(preds, target)`` lists of per-image dicts of jnp arrays.
+        """
+        import json
+
+        if iou_type not in ("bbox", "segm"):
+            raise ValueError(f"Expected argument `iou_type` to be bbox or segm, got {iou_type}")
+        with open(coco_target) as fh:
+            gt_data = json.load(fh)
+        with open(coco_preds) as fh:
+            dt_anns = json.load(fh)
+        if isinstance(dt_anns, dict):
+            dt_anns = dt_anns.get("annotations", [])
+        gt_anns = gt_data.get("annotations") if isinstance(gt_data, dict) else gt_data
+        if not isinstance(gt_anns, list):
+            raise ValueError(
+                "coco_target must be a COCO dataset dict with an `annotations` list or a bare"
+                " annotation list"
+            )
+        # one entry per image for BOTH sides, in one shared order: gt images
+        # without detections (and vice versa) get empty entries, exactly like
+        # the reference's backfill (reference mean_ap.py:700-718) — without
+        # it, positional update() pairing silently misaligns images
+        image_ids = sorted(
+            {img["id"] for img in (gt_data.get("images", []) if isinstance(gt_data, dict) else [])}
+            | {a["image_id"] for a in gt_anns}
+            | {a["image_id"] for a in dt_anns}
+        )
+
+        def decode_mask(ann):
+            seg = ann.get("segmentation")
+            if not (isinstance(seg, dict) and isinstance(seg.get("counts"), (list, tuple))):
+                raise NotImplementedError(
+                    "coco_to_tm supports uncompressed-RLE segmentations only (dict with a"
+                    " list `counts`); compressed strings and polygons need pycocotools."
+                )
+            h, w = seg["size"]
+            flat = np.repeat(
+                np.arange(len(seg["counts"])) % 2, np.asarray(seg["counts"], np.int64)
+            ).astype(bool)
+            return flat.reshape(w, h).T  # column-major, like COCO
+
+        def group(anns, with_scores):
+            by_img: Dict[int, Dict[str, list]] = {
+                i: {"labels": [], "scores": [], "iscrowd": [], "area": [], "boxes": [], "masks": []}
+                for i in image_ids
+            }
+            for a in anns:
+                entry = by_img[a["image_id"]]
+                entry["labels"].append(a["category_id"])
+                if with_scores:
+                    entry["scores"].append(a["score"])
+                else:
+                    entry["iscrowd"].append(a.get("iscrowd", 0))
+                    entry["area"].append(a.get("area", 0))
+                if iou_type == "bbox":
+                    entry["boxes"].append(a["bbox"])
+                else:
+                    entry["masks"].append(decode_mask(a))
+            out = []
+            for img_id in image_ids:
+                e = by_img[img_id]
+                d = {"labels": jnp.asarray(np.asarray(e["labels"], np.int64))}
+                if iou_type == "bbox":
+                    d["boxes"] = jnp.asarray(np.asarray(e["boxes"], np.float32).reshape(-1, 4))
+                else:
+                    d["masks"] = jnp.asarray(np.stack(e["masks"]) if e["masks"] else np.zeros((0, 0, 0), bool))
+                if with_scores:
+                    d["scores"] = jnp.asarray(np.asarray(e["scores"], np.float32))
+                else:
+                    d["iscrowd"] = jnp.asarray(np.asarray(e["iscrowd"], np.int64))
+                    d["area"] = jnp.asarray(np.asarray(e["area"], np.float32))
+                out.append(d)
+            return out
+
+        return group(dt_anns, True), group(gt_anns, False)
+
+    def tm_to_coco(self, name: str = "tm_map_input") -> None:
+        """Dump the accumulated state as COCO-format json
+        (``{name}_preds.json`` / ``{name}_target.json``; reference
+        mean_ap.py:721-792)."""
+        import json
+
+        if self.iou_type != "bbox":
+            raise NotImplementedError(
+                "tm_to_coco currently exports bbox states (segm export needs a compressed-RLE"
+                " writer to be readable by pycocotools)."
+            )
+        dcounts = np.concatenate([np.asarray(c) for c in self.detection_counts]).astype(int) if self.detection_counts else np.zeros(0, int)
+        gcounts = np.concatenate([np.asarray(c) for c in self.groundtruth_counts]).astype(int) if self.groundtruth_counts else np.zeros(0, int)
+
+        def xywh(b):
+            b = np.asarray(b, np.float64).reshape(-1, 4)
+            return np.stack([b[:, 0], b[:, 1], b[:, 2] - b[:, 0], b[:, 3] - b[:, 1]], 1)
+
+        images = [{"id": i} for i in range(len(gcounts))]
+        ann_id = 1
+        target_anns = []
+        for img, (boxes, labels, crowds, areas) in enumerate(
+            zip(self.groundtruth_boxes, self.groundtruth_labels, self.groundtruth_crowds, self.groundtruth_area)
+        ):
+            for b, lab, c, a in zip(xywh(self._convert_boxes_host(np.asarray(boxes))),
+                                    np.asarray(labels).reshape(-1),
+                                    np.asarray(crowds).reshape(-1),
+                                    np.asarray(areas).reshape(-1)):
+                target_anns.append({
+                    "id": ann_id, "image_id": img, "bbox": [float(v) for v in b],
+                    "area": float(a) if a > 0 else float(b[2] * b[3]),
+                    "category_id": int(lab), "iscrowd": int(c),
+                })
+                ann_id += 1
+        pred_anns = []
+        ann_id = 1
+        for img, (boxes, labels, scores) in enumerate(
+            zip(self.detection_boxes, self.detection_labels, self.detection_scores)
+        ):
+            for b, lab, s in zip(xywh(self._convert_boxes_host(np.asarray(boxes))),
+                                 np.asarray(labels).reshape(-1), np.asarray(scores).reshape(-1)):
+                pred_anns.append({
+                    "id": ann_id, "image_id": img, "bbox": [float(v) for v in b],
+                    "area": float(b[2] * b[3]), "category_id": int(lab), "score": float(s),
+                })
+                ann_id += 1
+        classes = sorted({a["category_id"] for a in target_anns + pred_anns})
+        target_dataset = {"images": images, "annotations": target_anns,
+                          "categories": [{"id": c, "name": str(c)} for c in classes]}
+        with open(f"{name}_preds.json", "w") as fh:
+            json.dump(pred_anns, fh, indent=4)
+        with open(f"{name}_target.json", "w") as fh:
+            json.dump(target_dataset, fh, indent=4)
 
     def _convert_boxes_host(self, boxes: np.ndarray) -> np.ndarray:
         """Convert to xyxy on host (box_format conversion is 6 flops/box —
@@ -494,10 +645,20 @@ class MeanAveragePrecision(Metric):
             average=self.average,
             iou_type=self.iou_type,
             geom_cache=geom_cache,
+            extended=self.extended_summary,
         )
 
         max_det = self.max_detection_thresholds[-1]
         out: Dict[str, Array] = {}
+        if self.extended_summary:
+            # reference mean_ap.py:525-536: score-sorted (image, class) IoU
+            # matrices + the raw precision/recall tensors (T, R, K, A, M).
+            # The IoU dict stays numpy: it is host-produced diagnostics, and
+            # device_put-ing O(images x classes) tiny matrices would pay one
+            # transfer round trip each
+            out["ious"] = {k: np.asarray(v, np.float32) for k, v in result["ious"].items()}
+            out["precision"] = jnp.asarray(result["precision"])
+            out["recall"] = jnp.asarray(result["recall"])
         for key in (
             "map",
             "map_50",
