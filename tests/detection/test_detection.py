@@ -532,3 +532,29 @@ def test_segm_map_bad_rank_mask_leaves_state_clean():
     m.update([dict(masks=good, scores=jnp.asarray([0.9]), labels=jnp.asarray([0]))],
              [dict(masks=good, labels=jnp.asarray([0]))])
     assert np.isclose(float(m.compute()["map"]), 1.0, atol=1e-6)
+
+
+def test_map_dual_iou_type_validation_is_atomic():
+    """In iou_type=("bbox","segm") mode, count mismatches raise BEFORE any
+    state is appended (a caught error must not leave orphaned half-state),
+    and duplicate iou_type entries are rejected."""
+    import pytest as _pytest
+
+    from tpumetrics.detection import MeanAveragePrecision
+
+    with _pytest.raises(ValueError, match="distinct"):
+        MeanAveragePrecision(iou_type=("bbox", "bbox"))
+
+    m = MeanAveragePrecision(iou_type=("bbox", "segm"))
+    good_pred = dict(boxes=jnp.asarray([[0.0, 0.0, 4.0, 4.0]]), scores=jnp.asarray([0.9]),
+                     labels=jnp.asarray([0]), masks=jnp.ones((1, 8, 8), bool))
+    bad_target = dict(boxes=jnp.asarray([[0.0, 0.0, 4.0, 4.0], [1.0, 1.0, 5.0, 5.0]]),
+                      labels=jnp.asarray([0, 0]), masks=jnp.ones((1, 8, 8), bool))  # 2 boxes, 1 mask
+    with _pytest.raises(ValueError, match="same"):
+        m.update([good_pred], [bad_target])
+    assert not m.detection_boxes and not m.detection_scores and not m.groundtruth_mask_runs
+
+    good_target = dict(boxes=bad_target["boxes"], labels=bad_target["labels"], masks=jnp.ones((2, 8, 8), bool))
+    m.update([good_pred], [good_target])
+    res = m.compute()
+    assert {"bbox_map", "segm_map"} <= set(np.asarray(v) is not None and k for k, v in res.items())

@@ -245,3 +245,46 @@ def test_coco_to_tm_backfills_empty_images(tmp_path):
     res = m.compute()
     # img0 perfect match; img1 gt missed; img3 detection is a pure FP
     assert 0.0 < float(res["map_50"]) < 1.0
+
+
+@pytest.mark.parametrize("seed", [80])
+def test_both_iou_types_at_once_parity(ref, seed):
+    """iou_type=("bbox", "segm") evaluates both geometries in one metric with
+    prefixed outputs, each matching its single-type run (and the single-type
+    runs are themselves oracle-pinned above)."""
+    import jax.numpy as jnp
+
+    from tests.reference_parity._corpus import boxes_to_masks, make_crowd_corpus
+    from tpumetrics.detection import MeanAveragePrecision
+
+    height, width = 96, 128
+    preds, target = make_crowd_corpus(seed, num_images=6, max_det=5, max_gt=4, empty_gt_image=False)
+    rng = np.random.default_rng(seed)
+    masks = [boxes_to_masks(np.clip(p["boxes"] * 0.5, 0, [width - 1, height - 1] * 2), height, width, rng)
+             for p in preds]
+    gt_masks = [boxes_to_masks(np.clip(t["boxes"] * 0.5, 0, [width - 1, height - 1] * 2), height, width, rng)
+                for t in target]
+
+    def feed(metric, with_boxes=True, with_masks=True):
+        ps, ts = [], []
+        for i in range(len(preds)):
+            p = {"scores": jnp.asarray(preds[i]["scores"]), "labels": jnp.asarray(preds[i]["labels"])}
+            t = {"labels": jnp.asarray(target[i]["labels"]), "iscrowd": jnp.asarray(target[i]["iscrowd"])}
+            if with_boxes:
+                p["boxes"] = jnp.asarray(preds[i]["boxes"])
+                t["boxes"] = jnp.asarray(target[i]["boxes"])
+            if with_masks:
+                p["masks"] = jnp.asarray(masks[i])
+                t["masks"] = jnp.asarray(gt_masks[i])
+            ps.append(p)
+            ts.append(t)
+        metric.update(ps, ts)
+        return {k: np.asarray(v) for k, v in metric.compute().items() if not isinstance(v, dict)}
+
+    both = feed(MeanAveragePrecision(iou_type=("bbox", "segm")))
+    bbox_only = feed(MeanAveragePrecision(iou_type="bbox"), with_masks=False)
+    segm_only = feed(MeanAveragePrecision(iou_type="segm"), with_boxes=False)
+    for key in SCALAR_KEYS:
+        np.testing.assert_allclose(both[f"bbox_{key}"], bbox_only[key], atol=1e-9, err_msg=f"bbox_{key}")
+        np.testing.assert_allclose(both[f"segm_{key}"], segm_only[key], atol=1e-9, err_msg=f"segm_{key}")
+    np.testing.assert_array_equal(both["classes"], bbox_only["classes"])

@@ -160,7 +160,10 @@ class MeanAveragePrecision(Metric):
 
     Args:
         box_format: ``xyxy``/``xywh``/``cxcywh`` input box format.
-        iou_type: ``bbox`` (box IoU) or ``segm`` (instance-mask IoU).
+        iou_type: ``bbox`` (box IoU), ``segm`` (instance-mask IoU), or a
+            list/tuple of both — inputs then carry ``boxes`` AND ``masks``
+            and every output key is prefixed ``bbox_``/``segm_``
+            (reference mean_ap.py:390,508).
         iou_thresholds: IoU thresholds; defaults to COCO's 0.50:0.05:0.95.
         rec_thresholds: recall thresholds; defaults to COCO's 0:0.01:1.
         max_detection_thresholds: per-image detection caps (default 1/10/100).
@@ -229,9 +232,24 @@ class MeanAveragePrecision(Metric):
         if box_format not in allowed_box_formats:
             raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
         self.box_format = box_format
-        if iou_type not in ("bbox", "segm"):
-            raise ValueError(f"Expected argument `iou_type` to be one of ('bbox', 'segm') but got {iou_type}")
-        self.iou_type = iou_type
+        if isinstance(iou_type, str):
+            iou_types = (iou_type,)
+        else:
+            iou_types = tuple(iou_type)
+        if (
+            not iou_types
+            or any(t not in ("bbox", "segm") for t in iou_types)
+            or len(set(iou_types)) != len(iou_types)
+        ):
+            raise ValueError(
+                f"Expected argument `iou_type` to be one of ('bbox', 'segm') or a list of distinct"
+                f" entries, but got {iou_type}"
+            )
+        # single-type callers read the plain string (and our internal
+        # branches key off membership); the reference normalizes to a tuple
+        # the same way (reference helpers.py _validate_iou_type_arg)
+        self.iou_type = iou_types[0] if len(iou_types) == 1 else iou_types
+        self._iou_types = iou_types
 
         if iou_thresholds is not None and not isinstance(iou_thresholds, list):
             raise ValueError(
@@ -269,10 +287,10 @@ class MeanAveragePrecision(Metric):
         self.add_state("groundtruth_crowds", default=[], dist_reduce_fx=None)
         self.add_state("groundtruth_area", default=[], dist_reduce_fx=None)
         self.add_state("groundtruth_counts", default=[], dist_reduce_fx=None)
-        if iou_type == "bbox":
+        if "bbox" in iou_types:
             self.add_state("detection_boxes", default=[], dist_reduce_fx=None)
             self.add_state("groundtruth_boxes", default=[], dist_reduce_fx=None)
-        else:
+        if "segm" in iou_types:
             self.add_state("detection_mask_runs", default=[], dist_reduce_fx=None)
             self.add_state("detection_mask_nruns", default=[], dist_reduce_fx=None)
             self.add_state("groundtruth_mask_runs", default=[], dist_reduce_fx=None)
@@ -296,23 +314,38 @@ class MeanAveragePrecision(Metric):
         if not preds:
             return
 
-        if self.iou_type == "bbox":
+        # ALL validation happens before the first state append (the invariant
+        # _append_masks documents): a raising update must leave no
+        # half-appended state behind, or every later compute is misaligned
+        if "bbox" in self._iou_types:
             dboxes = [_own(_fix_empty_boxes(p["boxes"])) for p in preds]
             dcounts = [int(b.shape[0]) for b in dboxes]
-            self.detection_boxes.extend(dboxes)
+            gboxes = [_own(_fix_empty_boxes(t["boxes"])) for t in target]
+            gcounts = [int(b.shape[0]) for b in gboxes]
         else:
             dcounts = [int(p["masks"].shape[0]) for p in preds]
+            gcounts = [int(t["masks"].shape[0]) for t in target]
+        if "segm" in self._iou_types:
+            if "bbox" in self._iou_types:
+                for i, (p, t, nd, ng) in enumerate(zip(preds, target, dcounts, gcounts)):
+                    if int(p["masks"].shape[0]) != nd:
+                        raise ValueError(
+                            f"Sample {i}: prediction `boxes` and `masks` must describe the same"
+                            f" detections, got {nd} boxes vs {int(p['masks'].shape[0])} masks"
+                        )
+                    if int(t["masks"].shape[0]) != ng:
+                        raise ValueError(
+                            f"Sample {i}: target `boxes` and `masks` must describe the same"
+                            f" ground truths, got {ng} boxes vs {int(t['masks'].shape[0])} masks"
+                        )
             self._append_masks(preds, target)
+
+        if "bbox" in self._iou_types:
+            self.detection_boxes.extend(dboxes)
+            self.groundtruth_boxes.extend(gboxes)
         self.detection_scores.extend(_own(p["scores"]) for p in preds)
         self.detection_labels.extend(_own(p["labels"]) for p in preds)
         self.detection_counts.append(np.asarray(dcounts, np.int64))
-
-        if self.iou_type == "bbox":
-            gboxes = [_own(_fix_empty_boxes(t["boxes"])) for t in target]
-            gcounts = [int(b.shape[0]) for b in gboxes]
-            self.groundtruth_boxes.extend(gboxes)
-        else:
-            gcounts = [int(t["masks"].shape[0]) for t in target]
         self.groundtruth_labels.extend(_own(t["labels"]) for t in target)
         self.groundtruth_crowds.extend(
             _own(t["iscrowd"]) if t.get("iscrowd") is not None else np.zeros(n, np.int64)
@@ -420,7 +453,7 @@ class MeanAveragePrecision(Metric):
         mean_ap.py:721-792)."""
         import json
 
-        if self.iou_type != "bbox":
+        if "bbox" not in self._iou_types:
             raise NotImplementedError(
                 "tm_to_coco currently exports bbox states (segm export needs a compressed-RLE"
                 " writer to be readable by pycocotools)."
@@ -587,13 +620,13 @@ class MeanAveragePrecision(Metric):
         cost.  Host-resident pieces (numpy inputs, placeholder zeros, RLE
         runs) never touch the device.  Per-image boundaries come from the
         host-side counts."""
-        is_segm = self.iou_type == "segm"
+        types = self._iou_types
         if self.detection_counts:
             dcounts = np.concatenate([np.asarray(c) for c in self.detection_counts]).astype(np.int64)
             gcounts = np.concatenate([np.asarray(c) for c in self.groundtruth_counts]).astype(np.int64)
             num_imgs = len(dcounts)
 
-            geom_pieces = [] if is_segm else (self.detection_boxes + self.groundtruth_boxes)
+            geom_pieces = (self.detection_boxes + self.groundtruth_boxes) if "bbox" in types else []
             fetched = _fetch_pieces(
                 list(self.detection_scores)
                 + list(self.detection_labels)
@@ -615,63 +648,81 @@ class MeanAveragePrecision(Metric):
             gt_labels = [lab.reshape(-1).astype(np.int64) for lab in take(num_imgs)]
             gt_crowds = [c.reshape(-1).astype(np.int64) for c in take(num_imgs)]
             gt_area = [a.reshape(-1).astype(np.float32) for a in take(num_imgs)]
-            if is_segm:
-                det_geoms, gt_geoms = self._unpack_mask_geoms(dcounts, gcounts)
-            else:
-                det_geoms = [self._convert_boxes_host(b) for b in take(num_imgs)]
-                gt_geoms = [self._convert_boxes_host(b) for b in take(num_imgs)]
+            geoms_by_type: Dict[str, tuple] = {}
+            if "bbox" in types:
+                geoms_by_type["bbox"] = (
+                    [self._convert_boxes_host(b) for b in take(num_imgs)],
+                    [self._convert_boxes_host(b) for b in take(num_imgs)],
+                )
+            if "segm" in types:
+                geoms_by_type["segm"] = self._unpack_mask_geoms(dcounts, gcounts)
         else:
             num_imgs = 0
-            det_geoms = det_scores = det_labels = []
-            gt_geoms = gt_labels = gt_crowds = gt_area = []
-        detections = [(det_geoms[i], det_scores[i], det_labels[i]) for i in range(num_imgs)]
-        groundtruths = [
-            (gt_geoms[i], gt_labels[i], gt_crowds[i], gt_area[i]) for i in range(num_imgs)
-        ]
+            det_scores = det_labels = []
+            gt_labels = gt_crowds = gt_area = []
+            geoms_by_type = {t: ([], []) for t in types}
         all_labels = det_labels + gt_labels
         class_ids = (
             sorted(np.unique(np.concatenate(all_labels)).astype(int).tolist()) if all_labels else []
         )
-        # pay the geometry cost (mask decode + intersections) once, shared by
-        # the optional second macro evaluation below
-        geom_cache = precompute_geometries(detections, groundtruths, self.iou_type)
-        result = coco_evaluate(
-            detections,
-            groundtruths,
-            self.iou_thresholds,
-            self.rec_thresholds,
-            self.max_detection_thresholds,
-            class_ids,
-            average=self.average,
-            iou_type=self.iou_type,
-            geom_cache=geom_cache,
-            extended=self.extended_summary,
-        )
 
         max_det = self.max_detection_thresholds[-1]
         out: Dict[str, Array] = {}
-        if self.extended_summary:
-            # reference mean_ap.py:525-536: score-sorted (image, class) IoU
-            # matrices + the raw precision/recall tensors (T, R, K, A, M).
-            # The IoU dict stays numpy: it is host-produced diagnostics, and
-            # device_put-ing O(images x classes) tiny matrices would pay one
-            # transfer round trip each
-            out["ious"] = {k: np.asarray(v, np.float32) for k, v in result["ious"].items()}
-            out["precision"] = jnp.asarray(result["precision"])
-            out["recall"] = jnp.asarray(result["recall"])
-        for key in (
-            "map",
-            "map_50",
-            "map_75",
-            "map_small",
-            "map_medium",
-            "map_large",
-            "mar_small",
-            "mar_medium",
-            "mar_large",
-            *(f"mar_{m}" for m in self.max_detection_thresholds),
-        ):
-            out[key] = jnp.asarray(result[key])
+        for i_type in types:
+            # prefix outputs only when evaluating both geometries at once,
+            # like the reference (mean_ap.py:508)
+            prefix = "" if len(types) == 1 else f"{i_type}_"
+            det_geoms, gt_geoms = geoms_by_type[i_type]
+            detections = [(det_geoms[i], det_scores[i], det_labels[i]) for i in range(num_imgs)]
+            groundtruths = [
+                (gt_geoms[i], gt_labels[i], gt_crowds[i], gt_area[i]) for i in range(num_imgs)
+            ]
+            # pay the geometry cost (mask decode + intersections) once,
+            # shared by the optional second macro evaluation below
+            geom_cache = precompute_geometries(detections, groundtruths, i_type)
+            result = coco_evaluate(
+                detections,
+                groundtruths,
+                self.iou_thresholds,
+                self.rec_thresholds,
+                self.max_detection_thresholds,
+                class_ids,
+                average=self.average,
+                iou_type=i_type,
+                geom_cache=geom_cache,
+                extended=self.extended_summary,
+            )
+            if self.extended_summary:
+                # reference mean_ap.py:525-536: score-sorted (image, class)
+                # IoU matrices + the raw precision/recall tensors over
+                # (T, R, K, A, M).  The IoU dict stays numpy: it is
+                # host-produced diagnostics, and device_put-ing
+                # O(images x classes) tiny matrices would pay one transfer
+                # round trip each
+                out[f"{prefix}ious"] = {k: np.asarray(v, np.float32) for k, v in result["ious"].items()}
+                out[f"{prefix}precision"] = jnp.asarray(result["precision"])
+                out[f"{prefix}recall"] = jnp.asarray(result["recall"])
+            for key in (
+                "map",
+                "map_50",
+                "map_75",
+                "map_small",
+                "map_medium",
+                "map_large",
+                "mar_small",
+                "mar_medium",
+                "mar_large",
+                *(f"mar_{m}" for m in self.max_detection_thresholds),
+            ):
+                out[f"{prefix}{key}"] = jnp.asarray(result[key])
+            self._add_per_class(out, prefix, result, detections, groundtruths, class_ids, i_type, geom_cache, max_det)
+        out["classes"] = jnp.asarray(
+            np.asarray(class_ids, np.int32) if class_ids else np.zeros(0, np.int32)
+        )
+        return out
+
+    def _add_per_class(self, out, prefix, result, detections, groundtruths, class_ids, i_type, geom_cache, max_det):
+        """Per-class map/mar entries for one iou type (reference mean_ap.py:538-570)."""
         if self.class_metrics:
             if self.average == "micro":
                 # micro pools classes for the global scores, but per-class
@@ -686,15 +737,13 @@ class MeanAveragePrecision(Metric):
                     self.max_detection_thresholds,
                     class_ids,
                     average="macro",
-                    iou_type=self.iou_type,
+                    iou_type=i_type,
                     geom_cache=geom_cache,
                 )
             else:
                 per_class = result
-            out["map_per_class"] = jnp.asarray(per_class["map_per_class"])
-            out[f"mar_{max_det}_per_class"] = jnp.asarray(per_class["mar_per_class"])
+            out[f"{prefix}map_per_class"] = jnp.asarray(per_class["map_per_class"])
+            out[f"{prefix}mar_{max_det}_per_class"] = jnp.asarray(per_class["mar_per_class"])
         else:
-            out["map_per_class"] = jnp.asarray(-1.0)
-            out[f"mar_{max_det}_per_class"] = jnp.asarray(-1.0)
-        out["classes"] = jnp.asarray(result["classes"])
-        return out
+            out[f"{prefix}map_per_class"] = jnp.asarray(-1.0)
+            out[f"{prefix}mar_{max_det}_per_class"] = jnp.asarray(-1.0)
