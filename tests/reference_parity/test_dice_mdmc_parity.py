@@ -1,0 +1,127 @@
+"""Deprecated-Dice mdmc parity: samplewise/global multidim reduction vs the
+reference's stat-scores machinery (reference classification/dice.py:82-96)."""
+
+import numpy as np
+import pytest
+
+
+@pytest.mark.parametrize("average", ["micro", "macro"])
+@pytest.mark.parametrize("mdmc_average", ["global", "samplewise"])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_dice_mdmc_matches_reference(ref, average, mdmc_average, seed):
+    import jax.numpy as jnp
+    import torch
+    from torchmetrics.classification.dice import Dice as RefDice
+
+    from tpumetrics.classification import Dice
+
+    rng = np.random.default_rng(seed)
+    C, N, X = 4, 6, 10
+    preds = rng.standard_normal((N, C, X)).astype(np.float32)
+    target = rng.integers(0, C, (N, X))
+
+    ours = Dice(average=average, mdmc_average=mdmc_average, num_classes=C)
+    theirs = RefDice(average=average, mdmc_average=mdmc_average, num_classes=C)
+    for lo in (0, 3):
+        ours.update(jnp.asarray(preds[lo : lo + 3]), jnp.asarray(target[lo : lo + 3]))
+        theirs.update(torch.from_numpy(preds[lo : lo + 3]), torch.from_numpy(target[lo : lo + 3]))
+    np.testing.assert_allclose(float(ours.compute()), float(theirs.compute()), atol=1e-6)
+
+
+@pytest.mark.parametrize("seed", [2])
+def test_dice_samplewise_ignore_index_matches_reference(ref, seed):
+    """The ignored class column is DROPPED from the per-sample macro mean,
+    not averaged in as a zero (divide by C-1, like the reference)."""
+    import jax.numpy as jnp
+    import torch
+    from torchmetrics.classification.dice import Dice as RefDice
+
+    from tpumetrics.classification import Dice
+
+    rng = np.random.default_rng(seed)
+    C, N, X = 4, 6, 10
+    preds = rng.standard_normal((N, C, X)).astype(np.float32)
+    target = rng.integers(0, C, (N, X))
+    ours = Dice(average="macro", mdmc_average="samplewise", num_classes=C, ignore_index=0)
+    theirs = RefDice(average="macro", mdmc_average="samplewise", num_classes=C, ignore_index=0)
+    ours.update(jnp.asarray(preds), jnp.asarray(target))
+    theirs.update(torch.from_numpy(preds), torch.from_numpy(target))
+    np.testing.assert_allclose(float(ours.compute()), float(theirs.compute()), atol=1e-6)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro"])
+def test_dice_samplewise_standard_inputs_own_contract(average):
+    """For NON-multidim inputs the reference's deprecated samplewise path is
+    not a usable oracle: its value-dependent input reclassification crashes
+    on 1-D labels and on clean one-hot probabilities ("zero-dimensional
+    tensor cannot be concatenated"), and yields inconsistent reductions on
+    logit-valued inputs.  Our contract is well-defined instead: each row is
+    a one-position sample, so micro == accuracy and macro == accuracy / C.
+    The functional must agree with the class."""
+    import jax.numpy as jnp
+
+    from tpumetrics.classification import Dice
+    from tpumetrics.functional.classification import dice as dice_fn
+
+    rng = np.random.default_rng(3)
+    preds = rng.standard_normal((8, 4)).astype(np.float32)
+    target = rng.integers(0, 4, 8)
+    acc = float((preds.argmax(1) == target).mean())
+    want = acc if average == "micro" else acc / 4
+    ours = Dice(average=average, mdmc_average="samplewise", num_classes=4)
+    ours.update(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(float(ours.compute()), want, atol=1e-6)
+    got_fn = float(dice_fn(jnp.asarray(preds), jnp.asarray(target), average=average,
+                           mdmc_average="samplewise", num_classes=4))
+    np.testing.assert_allclose(got_fn, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro"])
+def test_dice_functional_samplewise_matches_reference(ref, average):
+    import jax.numpy as jnp
+    import torch
+    from torchmetrics.functional.classification import dice as ref_dice
+
+    from tpumetrics.functional.classification import dice as dice_fn
+
+    rng = np.random.default_rng(4)
+    C, N, X = 4, 6, 10
+    preds = rng.standard_normal((N, C, X)).astype(np.float32)
+    target = rng.integers(0, C, (N, X))
+    got = float(dice_fn(jnp.asarray(preds), jnp.asarray(target), average=average,
+                        mdmc_average="samplewise", num_classes=C))
+    want = float(ref_dice(torch.from_numpy(preds), torch.from_numpy(target), average=average,
+                          mdmc_average="samplewise", num_classes=C))
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_dice_samplewise_mixed_shapes_accumulate():
+    """Every batch contributes per-sample scores regardless of shape (1-D
+    label inputs generalize to one-element samples — the reference's 1-D
+    samplewise path crashes outright)."""
+    import jax.numpy as jnp
+
+    from tpumetrics.classification import Dice
+
+    m = Dice(average="micro", mdmc_average="samplewise", num_classes=3)
+    m.update(jnp.asarray(np.random.default_rng(0).standard_normal((4, 3, 5)).astype(np.float32)),
+             jnp.asarray(np.random.default_rng(1).integers(0, 3, (4, 5))))
+    m.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+    assert float(m.sample_total) == 7  # 4 multidim samples + 3 single-element ones
+    assert np.isfinite(float(m.compute()))
+
+
+def test_dice_samplewise_functional_compute_jittable():
+    """The samplewise routing must stay host-side: functional_compute jits."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpumetrics.classification import Dice
+
+    m = Dice(average="micro", mdmc_average="samplewise", num_classes=3)
+    rng = np.random.default_rng(5)
+    m.update(jnp.asarray(rng.standard_normal((4, 3, 5)).astype(np.float32)),
+             jnp.asarray(rng.integers(0, 3, (4, 5))))
+    state = {k: getattr(m, k) for k in m._reductions}
+    out = jax.jit(m.functional_compute)(state)
+    assert np.isfinite(float(out))

@@ -7,7 +7,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-from tpumetrics.functional.classification.dice import _dice_format
+from tpumetrics.functional.classification.dice import _dice_format, _dice_samplewise
 from tpumetrics.metric import Metric
 from tpumetrics.utils.compute import _safe_divide
 
@@ -45,8 +45,10 @@ class Dice(Metric):
         num_classes: Optional[int] = None,
         threshold: float = 0.5,
         average: Optional[str] = "micro",
+        mdmc_average: Optional[str] = "global",
         ignore_index: Optional[int] = None,
         top_k: Optional[int] = None,
+        multiclass: Optional[bool] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -57,21 +59,37 @@ class Dice(Metric):
             raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
         if num_classes is not None and ignore_index is not None and not 0 <= ignore_index < num_classes:
             raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+        if mdmc_average not in (None, "samplewise", "global"):
+            raise ValueError(f"The `mdmc_average` {mdmc_average} is not valid.")
+        if mdmc_average == "samplewise" and average not in ("micro", "macro"):
+            raise ValueError(
+                "mdmc_average='samplewise' supports average in ('micro', 'macro') here"
+            )
+        if multiclass is False:
+            raise NotImplementedError(
+                "The deprecated `multiclass=False` binary reinterpretation is not supported;"
+                " use BinaryF1Score (Dice == F1 for binary inputs) instead."
+            )
         self.zero_division = zero_division
         self.num_classes = num_classes
         self.threshold = threshold
         self.average = average
+        self.mdmc_average = mdmc_average
         self.ignore_index = ignore_index
         self.top_k = top_k
+        self.multiclass = multiclass
 
-        size = 1 if average in ("micro", "samples") else num_classes
-        default = lambda: jnp.zeros(size, dtype=jnp.float32)  # noqa: E731
-        self.add_state("tp", default(), dist_reduce_fx="sum")
-        self.add_state("fp", default(), dist_reduce_fx="sum")
-        self.add_state("fn", default(), dist_reduce_fx="sum")
-        if average == "samples":
+        if average == "samples" or mdmc_average == "samplewise":
+            # samplewise-style accumulation never touches tp/fp/fn — don't
+            # register dead states that would ride every sync and checkpoint
             self.add_state("sample_score", jnp.zeros(()), dist_reduce_fx="sum")
             self.add_state("sample_total", jnp.zeros(()), dist_reduce_fx="sum")
+        else:
+            size = 1 if average == "micro" else num_classes
+            default = lambda: jnp.zeros(size, dtype=jnp.float32)  # noqa: E731
+            self.add_state("tp", default(), dist_reduce_fx="sum")
+            self.add_state("fp", default(), dist_reduce_fx="sum")
+            self.add_state("fn", default(), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         preds_oh, target_oh, n_cls = _dice_format(preds, target, self.threshold, self.top_k, self.num_classes)
@@ -79,6 +97,26 @@ class Dice(Metric):
             keep = jnp.ones(n_cls).at[self.ignore_index].set(0.0).astype(jnp.int32)
             preds_oh = preds_oh * keep
             target_oh = target_oh * keep
+
+        if self.mdmc_average is None and target.ndim > 1:
+            raise ValueError(
+                "When your inputs are multi-dimensional multi-class, you have to set the"
+                " `mdmc_average` parameter ('global' or 'samplewise')."
+            )
+        if self.mdmc_average == "samplewise":
+            # per ORIGINAL sample: stats over that sample's positions, the
+            # class-average applied within the sample, then a mean over
+            # samples (the deprecated stat-scores mdmc_reduce='samplewise',
+            # reference dice.py:82-96); a standard (N, C)/(N,) batch makes
+            # each row a one-position sample, matching the reference's
+            # measured behavior on 2-D scores (its 1-D path crashes)
+            score_sum, count = _dice_samplewise(
+                preds, target, preds_oh, target_oh, n_cls, self.average,
+                self.zero_division, self.ignore_index,
+            )
+            self.sample_score = self.sample_score + score_sum
+            self.sample_total = self.sample_total + count
+            return
 
         if self.average == "samples":
             tp = jnp.sum(preds_oh * target_oh, axis=1).astype(jnp.float32)
@@ -99,7 +137,9 @@ class Dice(Metric):
         self.fn = self.fn + fn
 
     def compute(self) -> Array:
-        if self.average == "samples":
+        # routing is on host-side config only, so functional_compute stays
+        # jittable
+        if self.average == "samples" or self.mdmc_average == "samplewise":
             return self.sample_score / self.sample_total
         if self.average == "micro":
             return _safe_divide(2.0 * self.tp[0], 2.0 * self.tp[0] + self.fp[0] + self.fn[0], self.zero_division)

@@ -62,6 +62,40 @@ def _dice_format(
     )
 
 
+def _dice_samplewise(
+    preds: Array,
+    target: Array,
+    preds_oh: Array,
+    target_oh: Array,
+    n_cls: int,
+    average: str,
+    zero_division: int,
+    ignore_index,
+) -> Tuple[Array, int]:
+    """Per-ORIGINAL-sample dice (stats over the sample's positions, class
+    average applied within the sample), returned as (score_sum, n_samples)
+    so the class metric can accumulate across updates.  ``_dice_format``
+    flattens N-major, so per-sample grouping is a plain reshape; inputs with
+    no extra dims make each row/element a one-position sample."""
+    n_samples = preds.shape[0] if preds.ndim > 1 or target.ndim > 1 else preds_oh.shape[0]
+    per = preds_oh.reshape(n_samples, -1, n_cls).astype(jnp.float32)
+    tgt = target_oh.reshape(n_samples, -1, n_cls).astype(jnp.float32)
+    tp = (per * tgt).sum(axis=1)  # (N, C)
+    fp = (per * (1 - tgt)).sum(axis=1)
+    fn = ((1 - per) * tgt).sum(axis=1)
+    if average == "micro":
+        tp, fp, fn = tp.sum(-1), fp.sum(-1), fn.sum(-1)  # (N,)
+        scores = _safe_divide(2.0 * tp, 2.0 * tp + fp + fn, zero_division)
+    else:  # macro within each sample; the ignored class column is DROPPED
+        # from the mean (reference divides by the kept class count)
+        per_class = _safe_divide(2.0 * tp, 2.0 * tp + fp + fn, zero_division)
+        keep_cls = jnp.ones(n_cls, per_class.dtype)
+        if ignore_index is not None and 0 <= ignore_index < n_cls:
+            keep_cls = keep_cls.at[ignore_index].set(0.0)
+        scores = (per_class * keep_cls).sum(axis=-1) / jnp.maximum(keep_cls.sum(), 1.0)
+    return scores.sum(), n_samples
+
+
 def dice(
     preds: Array,
     target: Array,
@@ -87,6 +121,18 @@ def dice(
     allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
     if average not in allowed_average:
         raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    if mdmc_average not in (None, "samplewise", "global"):
+        raise ValueError(f"The `mdmc_average` {mdmc_average} is not valid.")
+    if multiclass is False:
+        raise NotImplementedError(
+            "The deprecated `multiclass=False` binary reinterpretation is not supported;"
+            " use binary_f1_score (dice == F1 for binary inputs) instead."
+        )
+    if mdmc_average is None and target.ndim > 1:
+        raise ValueError(
+            "When your inputs are multi-dimensional multi-class, you have to set the"
+            " `mdmc_average` parameter ('global' or 'samplewise')."
+        )
 
     preds_oh, target_oh, n_cls = _dice_format(preds, target, threshold, top_k, num_classes)
 
@@ -94,6 +140,20 @@ def dice(
         keep = jnp.ones(n_cls).at[ignore_index].set(0.0)
         preds_oh = preds_oh * keep.astype(jnp.int32)
         target_oh = target_oh * keep.astype(jnp.int32)
+
+    # samplewise: stats per ORIGINAL sample (leading axis), class average
+    # within each sample, mean over samples (reference dice.py:82-96).  For
+    # standard (N, C)+(N,) inputs each row is a one-position sample — the
+    # reference's measured behavior; for 1-D label inputs the reference's
+    # deprecated path crashes outright, so each element being its own sample
+    # is the natural generalization here
+    if mdmc_average == "samplewise":
+        if average not in ("micro", "macro"):
+            raise ValueError("mdmc_average='samplewise' supports average in ('micro', 'macro') here")
+        score_sum, count = _dice_samplewise(
+            preds, target, preds_oh, target_oh, n_cls, average, zero_division, ignore_index
+        )
+        return score_sum / count
 
     if average == "samples":
         tp = jnp.sum(preds_oh * target_oh, axis=1)
