@@ -627,3 +627,88 @@ def test_pit_parity(ref):
     )
     np.testing.assert_allclose(np.asarray(ours_val), ref_val.numpy(), rtol=1e-4, atol=1e-5)
     np.testing.assert_array_equal(np.asarray(ours_perm), ref_perm.numpy())
+
+
+def test_task_wrapper_curve_average_forwarding(ref):
+    """The precision_recall_curve/roc TASK wrappers forward `average` to the
+    multiclass implementations (micro flattens one-vs-rest; macro merges by
+    interpolation), matching the reference's wrappers."""
+    import jax.numpy as jnp
+    import torch
+    from torchmetrics.functional.classification import precision_recall_curve as ref_prc
+    from torchmetrics.functional.classification import roc as ref_roc
+
+    from tpumetrics.functional.classification import precision_recall_curve, roc
+
+    rng = np.random.default_rng(0)
+    preds = rng.dirichlet(np.ones(4), 64).astype(np.float32)
+    target = rng.integers(0, 4, 64)
+    for avg in ("micro", "macro"):
+        got = precision_recall_curve(jnp.asarray(preds), jnp.asarray(target), task="multiclass",
+                                     num_classes=4, thresholds=16, average=avg)
+        want = ref_prc(torch.from_numpy(preds), torch.from_numpy(target), task="multiclass",
+                       num_classes=4, thresholds=16, average=avg)
+        # macro's count-based segment lookup (interp over a sorted precision
+        # grid) flips by one segment when two classes' precisions tie to
+        # within 1 ulp — a handful of grid points move by one segment height
+        tol = 1e-6 if avg == "micro" else 1e-2
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), w.numpy(), atol=tol)
+        got = roc(jnp.asarray(preds), jnp.asarray(target), task="multiclass",
+                  num_classes=4, thresholds=16, average=avg)
+        want = ref_roc(torch.from_numpy(preds), torch.from_numpy(target), task="multiclass",
+                       num_classes=4, thresholds=16, average=avg)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), w.numpy(), atol=1e-6)
+
+
+def test_rmse_sw_return_map_matches_reference(ref):
+    import jax.numpy as jnp
+    import torch
+    from torchmetrics.functional.image import root_mean_squared_error_using_sliding_window as ref_fn
+
+    from tpumetrics.functional.image import root_mean_squared_error_using_sliding_window as our_fn
+
+    rng = np.random.default_rng(1)
+    preds = rng.random((2, 3, 16, 16)).astype(np.float32)
+    target = np.clip(preds * 0.8 + 0.05, 0, 1).astype(np.float32)
+    g_rmse, g_map = our_fn(jnp.asarray(preds), jnp.asarray(target), return_rmse_map=True)
+    w_rmse, w_map = ref_fn(torch.from_numpy(preds), torch.from_numpy(target), return_rmse_map=True)
+    np.testing.assert_allclose(float(g_rmse), float(w_rmse), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g_map), w_map.numpy(), atol=1e-5)
+
+
+def test_infolm_batch_size_invariance():
+    """Chunked masked-LM forward: tiny and large batch_size agree."""
+    import jax.numpy as jnp
+
+    from tpumetrics.functional.text import infolm
+
+    class _Tok:
+        cls_token_id, sep_token_id, pad_token_id, mask_token_id = 1, 2, 0, 3
+        vocab = {}
+        def __call__(self, ss, **kw):
+            rows = [[1] + [self.vocab.setdefault(w, 4 + len(self.vocab) % 90) for w in s.split()] + [2] for s in ss]
+            ln = max(len(r) for r in rows)
+            ids = np.zeros((len(rows), ln), np.int32); att = np.zeros((len(rows), ln), np.int32)
+            for i, r in enumerate(rows):
+                ids[i, :len(r)] = r; att[i, :len(r)] = 1
+            return {"input_ids": ids, "attention_mask": att}
+
+    class _MLM:
+        table = None
+        def __call__(self, input_ids, attention_mask=None):
+            if _MLM.table is None:
+                _MLM.table = jnp.asarray(np.random.default_rng(0).standard_normal((100, 100)), np.float32)
+            class _O: pass
+            logits = _MLM.table[jnp.asarray(input_ids)]
+            o = _O(); o.logits = logits + 2.0 * logits.mean(axis=1, keepdims=True)
+            return o
+
+    preds = ["the cat sat on the mat", "a dog barked", "hello there friend today"]
+    target = ["a cat sat on a mat", "the dog barked", "hello there friend"]
+    big = float(infolm(preds, target, model=_MLM(), user_tokenizer=_Tok(),
+                       information_measure="l2_distance", idf=False, batch_size=64))
+    tiny = float(infolm(preds, target, model=_MLM(), user_tokenizer=_Tok(),
+                        information_measure="l2_distance", idf=False, batch_size=2))
+    np.testing.assert_allclose(tiny, big, atol=1e-6)

@@ -659,10 +659,12 @@ def precision_recall_curve(
     thresholds: Thresholds = None,
     num_classes: Optional[int] = None,
     num_labels: Optional[int] = None,
+    average: Optional[str] = None,
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
-    """Task-string dispatcher (reference precision_recall_curve.py:938-1003)."""
+    """Task-string dispatcher (reference precision_recall_curve.py:938-1003);
+    ``average`` merges the multiclass per-class curves (micro/macro)."""
     from tpumetrics.utils.enums import ClassificationTask
 
     task = ClassificationTask.from_str(task)
@@ -672,7 +674,7 @@ def precision_recall_curve(
         if not isinstance(num_classes, int):
             raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
         return multiclass_precision_recall_curve(
-            preds, target, num_classes, thresholds, None, ignore_index, validate_args
+            preds, target, num_classes, thresholds, average, ignore_index, validate_args
         )
     if task == ClassificationTask.MULTILABEL:
         if not isinstance(num_labels, int):

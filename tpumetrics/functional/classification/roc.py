@@ -251,10 +251,12 @@ def roc(
     thresholds: Thresholds = None,
     num_classes: Optional[int] = None,
     num_labels: Optional[int] = None,
+    average: Optional[str] = None,
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
-    """Task-string dispatcher (reference roc.py task wrapper)."""
+    """Task-string dispatcher (reference roc.py task wrapper); ``average``
+    merges the multiclass per-class curves (micro/macro)."""
     from tpumetrics.utils.enums import ClassificationTask
 
     task = ClassificationTask.from_str(task)
@@ -263,7 +265,7 @@ def roc(
     if task == ClassificationTask.MULTICLASS:
         if not isinstance(num_classes, int):
             raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
-        return multiclass_roc(preds, target, num_classes, thresholds, None, ignore_index, validate_args)
+        return multiclass_roc(preds, target, num_classes, thresholds, average, ignore_index, validate_args)
     if task == ClassificationTask.MULTILABEL:
         if not isinstance(num_labels, int):
             raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")

@@ -3,7 +3,7 @@
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -57,10 +57,11 @@ def _rmse_sw_compute(
 
 
 def root_mean_squared_error_using_sliding_window(
-    preds: Array, target: Array, window_size: int = 8
-) -> Optional[Array]:
+    preds: Array, target: Array, window_size: int = 8, return_rmse_map: bool = False
+) -> Union[Optional[Array], Tuple[Optional[Array], Array]]:
     """RMSE over sliding windows, scipy-uniform-filter compatible
-    (reference rmse_sw.py:123-148).
+    (reference rmse_sw.py:123-148); ``return_rmse_map=True`` additionally
+    returns the per-window RMSE image.
 
     Example:
         >>> import jax, jax.numpy as jnp
@@ -75,5 +76,7 @@ def root_mean_squared_error_using_sliding_window(
     rmse_val_sum, rmse_map, total_images = _rmse_sw_update(
         preds, target, window_size, rmse_val_sum=None, rmse_map=None, total_images=None
     )
-    rmse, _ = _rmse_sw_compute(rmse_val_sum, rmse_map, total_images)
+    rmse, rmse_map = _rmse_sw_compute(rmse_val_sum, rmse_map, total_images)
+    if return_rmse_map:
+        return rmse, rmse_map
     return rmse

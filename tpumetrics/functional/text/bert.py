@@ -345,11 +345,15 @@ def bert_score(
     user_forward_fn: Optional[Callable] = None,
     verbose: bool = False,
     idf: bool = False,
+    device: Optional[Any] = None,
     max_length: int = 512,
     batch_size: int = 64,
+    num_threads: int = 0,
     return_hash: bool = False,
     lang: str = "en",
     rescale_with_baseline: bool = False,
+    baseline_path: Optional[str] = None,
+    baseline_url: Optional[str] = None,
 ) -> Dict[str, Array]:
     """BERTScore: greedy cosine matching of contextual token embeddings
     (reference bert.py:246-447).
@@ -365,7 +369,9 @@ def bert_score(
         raise ValueError(
             f"Expected argument `preds` and `target` to have same length, but got {len(preds)} and {len(target)}"
         )
-    if rescale_with_baseline:
+    # device/num_threads are torch runtime knobs, accepted for drop-in
+    # compatibility and ignored: XLA owns placement and threading
+    if rescale_with_baseline or baseline_path or baseline_url:
         raise NotImplementedError(
             "Baseline rescaling requires downloadable baseline files and is not supported here."
         )

@@ -133,26 +133,45 @@ def _sentence_distribution(
     special_ids: set,
     temperature: float,
     idf_weights: Optional[np.ndarray] = None,
+    batch_size: int = 64,
 ) -> Array:
     """Aggregate positionwise masked-token distributions of one batch
     (reference infolm.py:367-430): every maskable position is masked in its
-    own copy, one batched forward yields all distributions."""
-    batch_size, seq_len = input_ids.shape
+    own copy; the forward over the masked copies runs in ``batch_size``
+    chunks (padded to one uniform shape so the model compiles once) so the
+    corpus size never sets peak memory."""
+    n_sentences, seq_len = input_ids.shape
     maskable = (attention_mask == 1) & ~np.isin(input_ids, list(special_ids))
 
     rows, positions = np.nonzero(maskable)
     masked_inputs = input_ids[rows].copy()
     masked_inputs[np.arange(len(rows)), positions] = mask_token_id
-    logits = jnp.asarray(
-        model(input_ids=jnp.asarray(masked_inputs), attention_mask=jnp.asarray(attention_mask[rows])).logits
-    )
-    probs = jax.nn.softmax(logits[jnp.arange(len(rows)), jnp.asarray(positions)] / temperature, axis=-1)
+    masks = attention_mask[rows]
+    n = len(rows)
+    step = max(1, batch_size)
+    n_pad = -(-n // step) * step if n else 0
+    if n_pad != n:
+        pad = n_pad - n
+        masked_inputs = np.concatenate([masked_inputs, np.zeros((pad, seq_len), masked_inputs.dtype)])
+        masks = np.concatenate([masks, np.zeros((pad, seq_len), masks.dtype)])
+    pos_padded = np.concatenate([positions, np.zeros(n_pad - n, positions.dtype)]) if n_pad != n else positions
+    prob_chunks = []
+    for lo in range(0, n_pad, step):
+        logits = jnp.asarray(
+            model(
+                input_ids=jnp.asarray(masked_inputs[lo : lo + step]),
+                attention_mask=jnp.asarray(masks[lo : lo + step]),
+            ).logits
+        )
+        pos = jnp.asarray(pos_padded[lo : lo + step])
+        prob_chunks.append(jax.nn.softmax(logits[jnp.arange(logits.shape[0]), pos] / temperature, axis=-1))
+    probs = (jnp.concatenate(prob_chunks, axis=0)[:n] if prob_chunks else jnp.zeros((0, 1)))
 
     vocab = probs.shape[-1]
     weights = np.ones(len(rows)) if idf_weights is None else idf_weights[rows, positions]
     weighted = probs * jnp.asarray(weights, jnp.float32)[:, None]
-    summed = jnp.zeros((batch_size, vocab)).at[jnp.asarray(rows)].add(weighted)
-    norm = jnp.zeros((batch_size,)).at[jnp.asarray(rows)].add(jnp.asarray(weights, jnp.float32))
+    summed = jnp.zeros((n_sentences, vocab)).at[jnp.asarray(rows)].add(weighted)
+    norm = jnp.zeros((n_sentences,)).at[jnp.asarray(rows)].add(jnp.asarray(weights, jnp.float32))
     return summed / jnp.clip(norm, 1e-12)[:, None]
 
 
@@ -166,12 +185,20 @@ def infolm(
     alpha: Optional[float] = None,
     beta: Optional[float] = None,
     max_length: Optional[int] = None,
+    batch_size: int = 64,
+    num_threads: int = 0,
+    device: Optional[Any] = None,
+    verbose: bool = True,
     return_sentence_level_score: bool = False,
     model: Optional[Any] = None,
     user_tokenizer: Optional[Any] = None,
 ) -> Union[Array, Tuple[Array, Array]]:
     """InfoLM score between candidate and reference sentences
-    (reference infolm.py:470-653)."""
+    (reference infolm.py:470-653).
+
+    ``batch_size`` chunks the model forward; ``device``/``num_threads`` are
+    torch runtime knobs accepted for drop-in compatibility and ignored (XLA
+    owns placement and threading), as is ``verbose``."""
     if isinstance(preds, str):
         preds = [preds]
     if isinstance(target, str):
@@ -219,10 +246,10 @@ def infolm(
         idf_t = np.vectorize(lambda t: idf_map.get(int(t), default_idf))(t_ids)
 
     preds_distribution = _sentence_distribution(
-        model, p_ids, p_mask, mask_token_id, special_ids, temperature, idf_p
+        model, p_ids, p_mask, mask_token_id, special_ids, temperature, idf_p, batch_size
     )
     target_distribution = _sentence_distribution(
-        model, t_ids, t_mask, mask_token_id, special_ids, temperature, idf_t
+        model, t_ids, t_mask, mask_token_id, special_ids, temperature, idf_t, batch_size
     )
 
     sentence_scores = measure(preds_distribution, target_distribution)

@@ -33,10 +33,13 @@ class RetrievalFallOut(RetrievalMetric):
 
     _empty_requirement: str = "negative"
 
-    def __init__(self, top_k: Optional[int] = None, empty_target_action: str = "pos", **kwargs: Any) -> None:
+    def __init__(self, top_k: Optional[int] = None, empty_target_action: str = "pos",
+                 ignore_index: Optional[int] = None, num_queries: Optional[int] = None,
+                 **kwargs: Any) -> None:
         # default differs from the base: a query with no negatives counts as
         # worst-case 1.0 fall-out (reference fall_out.py:89)
-        super().__init__(empty_target_action=empty_target_action, **kwargs)
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index,
+                         num_queries=num_queries, **kwargs)
         if top_k is not None and not (isinstance(top_k, int) and top_k > 0):
             raise ValueError("`top_k` has to be a positive integer or None")
         self.top_k = top_k

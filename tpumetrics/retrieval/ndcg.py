@@ -31,8 +31,12 @@ class RetrievalNormalizedDCG(RetrievalMetric):
     plot_lower_bound: float = 0.0
     plot_upper_bound: float = 1.0
 
-    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
-        super().__init__(**kwargs)
+    def __init__(self, top_k: Optional[int] = None, empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        num_queries: Optional[int] = None,
+        **kwargs: Any) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index,
+                         num_queries=num_queries, **kwargs)
         if top_k is not None and not (isinstance(top_k, int) and top_k > 0):
             raise ValueError("`top_k` has to be a positive integer or None")
         self.top_k = top_k

@@ -47,9 +47,13 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
         self,
         max_k: Optional[int] = None,
         adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        num_queries: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
-        super().__init__(**kwargs)
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index,
+                         num_queries=num_queries, **kwargs)
         if max_k is not None and not (isinstance(max_k, int) and max_k > 0):
             raise ValueError("`max_k` has to be a positive integer or None")
         if not isinstance(adaptive_k, bool):
@@ -121,9 +125,13 @@ class RetrievalRecallAtFixedPrecision(_AtFixedValuePlotMixin, RetrievalPrecision
         min_precision: float = 0.0,
         max_k: Optional[int] = None,
         adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        num_queries: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
-        super().__init__(max_k=max_k, adaptive_k=adaptive_k, **kwargs)
+        super().__init__(max_k=max_k, adaptive_k=adaptive_k, empty_target_action=empty_target_action,
+                         ignore_index=ignore_index, num_queries=num_queries, **kwargs)
         if not (isinstance(min_precision, float) and 0.0 <= min_precision <= 1.0):
             raise ValueError("`min_precision` has to be a positive float between 0 and 1")
         self.min_precision = min_precision

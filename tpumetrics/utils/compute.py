@@ -88,8 +88,29 @@ def auc(x: Array, y: Array, reorder: bool = False) -> Array:
 
 
 def interp(x: Array, xp: Array, fp: Array) -> Array:
-    """1-D linear interpolation matching ``np.interp`` (reference compute.py:135-157)."""
-    return jnp.interp(x, xp, fp)
+    """1-D linear interpolation replicating the reference's ``interp``
+    (reference compute.py:134-157) — NOT ``np.interp``: out-of-range points
+    extrapolate along the edge segments, segment lookup is the count of
+    ``xp`` values <= x (which the macro curve-averaging paths rely on, where
+    ``xp`` is a precision/fpr curve that need not be monotonic), and
+    zero-width segments get slope 0 via the safe divide."""
+    scalar = jnp.ndim(x) == 0
+    x1 = jnp.atleast_1d(x)
+    m = _safe_divide(fp[1:] - fp[:-1], xp[1:] - xp[:-1])
+    b = fp[:-1] - m * xp[:-1]
+    # the (x, xp) comparison counts are evaluated in bounded chunks: one
+    # dense (len(x), len(xp)) bool matrix is quadratic on the macro paths
+    # (x is the concatenated per-class grid), while per-chunk matrices stay
+    # constant-size; the chunk count is shape-derived, so this stays
+    # jit-compatible
+    chunk = 4096
+    idx_parts = []
+    for lo in range(0, x1.shape[0], chunk):
+        part = x1[lo : lo + chunk]
+        idx_parts.append(jnp.sum(part[:, None] >= xp[None, :], axis=1) - 1)
+    indices = jnp.clip(jnp.concatenate(idx_parts) if len(idx_parts) > 1 else idx_parts[0], 0, m.shape[0] - 1)
+    out = m[indices] * x1 + b[indices]
+    return out[0] if scalar else out
 
 
 def normalize_logits_if_needed(tensor: Array, normalization: str) -> Array:
