@@ -292,3 +292,82 @@ def test_establish_compute_groups_enables_functional_dedup():
     # idempotent
     col.establish_compute_groups(p, t)
     assert len(col._groups) == 1
+
+
+def test_collection_plot_clear_pop():
+    """MetricCollection dict surface + plot (reference collections.py:577-660)."""
+    import matplotlib
+
+    matplotlib.use("Agg", force=True)
+    import matplotlib.pyplot as plt
+
+    from tpumetrics import MetricCollection
+    from tpumetrics.classification import BinaryAccuracy, BinaryPrecision
+
+    coll = MetricCollection([BinaryAccuracy(), BinaryPrecision()], prefix="val_")
+    preds = jnp.asarray([0.8, 0.2, 0.6, 0.4])
+    target = jnp.asarray([1, 0, 1, 1])
+    coll.update(preds, target)
+    figs = coll.plot()
+    assert len(figs) == 2 and all(f is not None for f, _ in figs)
+    fig, _ = coll.plot(together=True)
+    assert fig is not None
+    plt.close("all")
+
+    popped = coll.pop("val_BinaryPrecision")  # renamed key resolves
+    assert type(popped).__name__ == "BinaryPrecision"
+    assert len(coll) == 1
+    coll.clear()
+    assert len(coll) == 0
+
+
+def test_tracker_plot():
+    import matplotlib
+
+    matplotlib.use("Agg", force=True)
+    import matplotlib.pyplot as plt
+
+    from tpumetrics.classification import BinaryAccuracy
+    from tpumetrics.wrappers import MetricTracker
+
+    tracker = MetricTracker(BinaryAccuracy())
+    for step in range(3):
+        tracker.increment()
+        tracker.update(jnp.asarray([1, 0, 1, int(step > 0)]), jnp.asarray([1, 0, 1, 1]))
+    fig, _ = tracker.plot()
+    assert fig is not None
+    plt.close("all")
+
+
+def test_collection_pop_with_compute_groups():
+    """pop() must materialize group-leader state into members first (only
+    leaders advance after groups merge) and tolerate user compute_groups
+    lists referencing the popped metric."""
+    from tpumetrics import MetricCollection
+    from tpumetrics.classification import MulticlassPrecision, MulticlassRecall
+
+    rng = np.random.default_rng(0)
+    b1 = (jnp.asarray(rng.standard_normal((16, 3)).astype(np.float32)), jnp.asarray(rng.integers(0, 3, 16)))
+    b2 = (jnp.asarray(rng.standard_normal((16, 3)).astype(np.float32)), jnp.asarray(rng.integers(0, 3, 16)))
+
+    ref_r = MulticlassRecall(num_classes=3)
+    ref_r.update(*b1)
+    ref_r.update(*b2)
+    want = float(ref_r.compute())
+
+    coll = MetricCollection([MulticlassPrecision(num_classes=3), MulticlassRecall(num_classes=3)])
+    coll.update(*b1)
+    coll.update(*b2)  # groups merged now; only the leader advanced
+    popped = coll.pop("MulticlassRecall")
+    assert np.isclose(float(popped.compute()), want), "popped member must carry full state"
+    assert len(coll) == 1 and np.isfinite(float(coll.compute()["MulticlassPrecision"]))
+
+    coll2 = MetricCollection(
+        [MulticlassPrecision(num_classes=3), MulticlassRecall(num_classes=3)],
+        compute_groups=[["MulticlassPrecision", "MulticlassRecall"]],
+    )
+    coll2.update(*b1)
+    popped2 = coll2.pop("MulticlassRecall")  # must not raise on the stale spec
+    assert type(popped2).__name__ == "MulticlassRecall"
+    coll2.update(*b2)
+    assert np.isfinite(float(coll2.compute()["MulticlassPrecision"]))

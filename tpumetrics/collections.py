@@ -415,6 +415,67 @@ class MetricCollection:
             repr_str += f",\n  postfix={self.postfix}"
         return repr_str + "\n)"
 
+    def clear(self) -> None:
+        """Remove every metric (MutableMapping surface, reference
+        collections.py dict ops)."""
+        self._modules.clear()
+        self._groups = {}
+        self._groups_checked = False
+
+    def pop(self, key: str) -> Metric:
+        """Remove and return one metric by (possibly prefixed) name."""
+        base_key = key
+        if base_key not in self._modules:
+            # translate a renamed (prefix/postfix) key back to its base
+            for base, renamed in zip(self.keys(keep_base=True), self.keys(keep_base=False)):
+                if renamed == key:
+                    base_key = base
+                    break
+        if base_key not in self._modules:
+            raise KeyError(key)
+        # propagate group-leader state first: with merged compute groups only
+        # leaders advance on update, so both the popped metric and the
+        # survivors must be materialized before the groups are torn down
+        self._compute_groups_create_state_ref(copy=True)
+        metric = self._modules.pop(base_key)
+        # a user-supplied group list may reference the popped metric — drop it
+        # from the spec before groups are rebuilt
+        if isinstance(self._enable_compute_groups, list):
+            self._enable_compute_groups = [
+                [name for name in group if name != base_key]
+                for group in self._enable_compute_groups
+            ]
+            self._enable_compute_groups = [g for g in self._enable_compute_groups if g]
+        self._init_compute_groups()
+        return metric
+
+    def plot(
+        self,
+        val: Optional[Any] = None,
+        ax: Optional[Any] = None,
+        together: bool = False,
+    ) -> Any:
+        """Plot every member (list of figures), or all values in one axis
+        with ``together=True`` (reference collections.py:577-660)."""
+        from tpumetrics.utils.plot import plot_single_or_multi_val
+
+        if not isinstance(together, bool):
+            raise ValueError(f"Expected argument `together` to be a boolean, but got {type(together)}")
+        if val is None:
+            val = self.compute()
+        if together:
+            return plot_single_or_multi_val(val, ax=ax)
+        fig_axs = []
+        for i, (k, m) in enumerate(self.items(keep_base=True, copy_state=False)):
+            if isinstance(val, dict):
+                member_val = val.get(k, val.get(self._set_name(k)))
+                f, a = m.plot(member_val, ax=ax[i] if ax is not None else None)
+            else:  # sequence of compute() dicts over steps
+                f, a = m.plot([v.get(k, v.get(self._set_name(k))) for v in val],
+                              ax=ax[i] if ax is not None else None)
+            fig_axs.append((f, a))
+        return fig_axs
+
     def set_dtype(self, dst_type: Any) -> "MetricCollection":
         for m in self._modules.values():
             m.set_dtype(dst_type)

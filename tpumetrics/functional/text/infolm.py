@@ -184,10 +184,10 @@ def infolm(
     idf: bool = True,
     alpha: Optional[float] = None,
     beta: Optional[float] = None,
+    device: Optional[Any] = None,
     max_length: Optional[int] = None,
     batch_size: int = 64,
     num_threads: int = 0,
-    device: Optional[Any] = None,
     verbose: bool = True,
     return_sentence_level_score: bool = False,
     model: Optional[Any] = None,

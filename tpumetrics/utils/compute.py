@@ -104,6 +104,8 @@ def interp(x: Array, xp: Array, fp: Array) -> Array:
     # constant-size; the chunk count is shape-derived, so this stays
     # jit-compatible
     chunk = 4096
+    if x1.shape[0] == 0:
+        return x1.astype(jnp.result_type(fp.dtype, x1.dtype))
     idx_parts = []
     for lo in range(0, x1.shape[0], chunk):
         part = x1[lo : lo + chunk]

@@ -87,6 +87,14 @@ class MetricTracker:
         self._check_for_increment("compute")
         return self._steps[-1].compute()
 
+    def plot(self, val: Any = None, ax: Any = None) -> Any:
+        """Plot the tracked history (``compute_all()`` by default; reference
+        wrappers/tracker.py:273-311)."""
+        from tpumetrics.utils.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute_all()
+        return plot_single_or_multi_val(val, ax=ax, name=type(self).__name__)
+
     def compute_all(self) -> Any:
         """Stacked per-step values (dict of stacks for a collection)."""
         self._check_for_increment("compute_all")
