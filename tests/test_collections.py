@@ -371,3 +371,28 @@ def test_collection_pop_with_compute_groups():
     assert type(popped2).__name__ == "MulticlassRecall"
     coll2.update(*b2)
     assert np.isfinite(float(coll2.compute()["MulticlassPrecision"]))
+
+    # auto-discovered groups survive a pop: the remaining members keep
+    # sharing state (one update advances the whole group)
+    from tpumetrics.classification import MulticlassF1Score
+
+    coll3 = MetricCollection(
+        [MulticlassPrecision(num_classes=3), MulticlassRecall(num_classes=3), MulticlassF1Score(num_classes=3)]
+    )
+    coll3.update(*b1)
+    coll3.update(*b1)  # merge happens here
+    merged = {i: sorted(g) for i, g in coll3.compute_groups.items()}
+    assert any(len(g) == 3 for g in merged.values())
+    coll3.pop("MulticlassF1Score")
+    assert any(len(g) == 2 for g in coll3.compute_groups.values()), coll3.compute_groups
+    coll3.update(*b2)
+    want_r = MulticlassRecall(num_classes=3)
+    for b in (b1, b1, b2):
+        want_r.update(*b)
+    assert np.isclose(float(coll3.compute()["MulticlassRecall"]), float(want_r.compute()))
+
+    # clear() resets a user compute_groups spec so add_metrics works again
+    coll2.clear()
+    coll2.add_metrics(MulticlassPrecision(num_classes=3))
+    coll2.update(*b1)
+    assert np.isfinite(float(coll2.compute()["MulticlassPrecision"]))

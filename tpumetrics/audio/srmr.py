@@ -33,12 +33,23 @@ class SpeechReverberationModulationEnergyRatio(Metric):
     full_state_update: bool = False
     plot_lower_bound: float = 0.0
 
-    def __init__(self, fs: int, **kwargs: Any) -> None:
+    def __init__(
+        self,
+        fs: int,
+        n_cochlear_filters: int = 23,
+        low_freq: float = 125,
+        min_cf: float = 4,
+        max_cf: Optional[float] = None,
+        norm: bool = False,
+        fast: bool = False,
+        **kwargs: Any,
+    ) -> None:
         self._srmr_kwargs = {
-            k: kwargs.pop(k)
-            for k in ("n_cochlear_filters", "low_freq", "min_cf", "max_cf", "norm", "fast")
-            if k in kwargs
+            "n_cochlear_filters": n_cochlear_filters, "low_freq": low_freq, "min_cf": min_cf,
+            "norm": norm, "fast": fast,
         }
+        if max_cf is not None:
+            self._srmr_kwargs["max_cf"] = max_cf
         super().__init__(**kwargs)
         _srmr_arg_validate(fs, **self._srmr_kwargs)
         self.fs = fs

@@ -417,10 +417,14 @@ class MetricCollection:
 
     def clear(self) -> None:
         """Remove every metric (MutableMapping surface, reference
-        collections.py dict ops)."""
+        collections.py dict ops).  A user-supplied compute_groups spec is
+        meaningless afterwards — reset to auto-discovery so a later
+        add_metrics doesn't validate against stale names."""
         self._modules.clear()
         self._groups = {}
         self._groups_checked = False
+        if isinstance(self._enable_compute_groups, list):
+            self._enable_compute_groups = True
 
     def pop(self, key: str) -> Metric:
         """Remove and return one metric by (possibly prefixed) name."""
@@ -435,18 +439,25 @@ class MetricCollection:
             raise KeyError(key)
         # propagate group-leader state first: with merged compute groups only
         # leaders advance on update, so both the popped metric and the
-        # survivors must be materialized before the groups are torn down
+        # survivors must be materialized before the membership changes
         self._compute_groups_create_state_ref(copy=True)
         metric = self._modules.pop(base_key)
-        # a user-supplied group list may reference the popped metric — drop it
-        # from the spec before groups are rebuilt
+        # a user-supplied group list may reference the popped metric — prune
+        # the spec so later rebuilds don't validate against a stale name
         if isinstance(self._enable_compute_groups, list):
             self._enable_compute_groups = [
                 [name for name in group if name != base_key]
                 for group in self._enable_compute_groups
             ]
             self._enable_compute_groups = [g for g in self._enable_compute_groups if g]
-        self._init_compute_groups()
+        # surgically remove the metric from its existing group: a full
+        # _init_compute_groups would reset to singletons with _groups_checked
+        # left True, silently disabling state-sharing for the survivors
+        self._groups = {
+            i: kept
+            for i, (idx, group) in enumerate(sorted(self._groups.items()))
+            if (kept := [name for name in group if name != base_key])
+        }
         return metric
 
     def plot(
@@ -461,6 +472,13 @@ class MetricCollection:
 
         if not isinstance(together, bool):
             raise ValueError(f"Expected argument `together` to be a boolean, but got {type(together)}")
+        if ax is not None and not together:
+            if not isinstance(ax, Sequence) or len(ax) != len(self):
+                raise ValueError(
+                    "Expected argument `ax` to be a sequence of matplotlib axis objects with the"
+                    f" same length as the number of metrics in the collection, but got {type(ax)}"
+                    " when `together=False`"
+                )
         if val is None:
             val = self.compute()
         if together:

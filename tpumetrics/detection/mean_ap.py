@@ -172,6 +172,9 @@ class MeanAveragePrecision(Metric):
             matrices and the raw ``precision``/``recall`` tensors over
             (T, R, K, A, M) / (T, K, A, M) (reference mean_ap.py:525-536).
         average: ``macro`` (COCO standard) or ``micro`` (classes pooled).
+        backend: accepted for drop-in compatibility (reference
+            mean_ap.py:360); both values select the built-in vectorized
+            engine, parity-tested against the reference's pycocotools path.
 
     Example:
         >>> import jax.numpy as jnp
@@ -224,6 +227,7 @@ class MeanAveragePrecision(Metric):
         class_metrics: bool = False,
         extended_summary: bool = False,
         average: str = "macro",
+        backend: str = "pycocotools",
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -279,6 +283,14 @@ class MeanAveragePrecision(Metric):
         if average not in ("macro", "micro"):
             raise ValueError(f"Expected argument `average` to be one of ('macro', 'micro') but got {average}")
         self.average = average
+        if backend not in ("pycocotools", "faster_coco_eval"):
+            raise ValueError(
+                f"Expected argument `backend` to be one of ('pycocotools', 'faster_coco_eval') but got {backend}"
+            )
+        # accepted for drop-in compatibility: both reference backends map to
+        # the one built-in vectorized engine here, which is parity-tested
+        # against the reference's primary (pycocotools) path
+        self.backend = backend
 
         self.add_state("detection_scores", default=[], dist_reduce_fx=None)
         self.add_state("detection_labels", default=[], dist_reduce_fx=None)

@@ -53,11 +53,15 @@ class BERTScore(HostSentenceStateMixin, Metric):
         user_forward_fn: Optional[Callable] = None,
         verbose: bool = False,
         idf: bool = False,
+        device: Optional[Any] = None,
         max_length: int = 512,
         batch_size: int = 64,
+        num_threads: int = 0,
         return_hash: bool = False,
         lang: str = "en",
         rescale_with_baseline: bool = False,
+        baseline_path: Optional[str] = None,
+        baseline_url: Optional[str] = None,
         sentences_replicated: bool = False,
         **kwargs: Any,
     ) -> None:
@@ -75,7 +79,14 @@ class BERTScore(HostSentenceStateMixin, Metric):
         self.batch_size = batch_size
         self.return_hash = return_hash
         self.lang = lang
+        if rescale_with_baseline or baseline_path or baseline_url:
+            # fail at construction, not after a full epoch of updates
+            raise NotImplementedError(
+                "Baseline rescaling requires downloadable baseline files and is not supported here."
+            )
         self.rescale_with_baseline = rescale_with_baseline
+        self.baseline_path = baseline_path
+        self.baseline_url = baseline_url
 
         self._preds: List[str] = []
         self._target: List[str] = []
@@ -112,6 +123,8 @@ class BERTScore(HostSentenceStateMixin, Metric):
             return_hash=self.return_hash,
             lang=self.lang,
             rescale_with_baseline=self.rescale_with_baseline,
+            baseline_path=self.baseline_path,
+            baseline_url=self.baseline_url,
         )
 
     def reset(self) -> None:

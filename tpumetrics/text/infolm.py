@@ -38,7 +38,11 @@ class InfoLM(HostSentenceStateMixin, Metric):
         idf: bool = True,
         alpha: Optional[float] = None,
         beta: Optional[float] = None,
+        device: Optional[Any] = None,
         max_length: Optional[int] = None,
+        batch_size: int = 64,
+        num_threads: int = 0,
+        verbose: bool = True,
         return_sentence_level_score: bool = False,
         model: Optional[Any] = None,
         user_tokenizer: Optional[Any] = None,
@@ -55,6 +59,7 @@ class InfoLM(HostSentenceStateMixin, Metric):
         self.alpha = alpha
         self.beta = beta
         self.max_length = max_length
+        self.batch_size = batch_size
         self.return_sentence_level_score = return_sentence_level_score
         self.model = model
         self.user_tokenizer = user_tokenizer
@@ -87,6 +92,7 @@ class InfoLM(HostSentenceStateMixin, Metric):
             alpha=self.alpha,
             beta=self.beta,
             max_length=self.max_length,
+            batch_size=self.batch_size,
             return_sentence_level_score=self.return_sentence_level_score,
             model=self.model,
             user_tokenizer=self.user_tokenizer,
