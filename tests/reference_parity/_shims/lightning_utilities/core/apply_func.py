@@ -1,49 +1,7 @@
-"""Recursive collection-map helper (shim for lightning_utilities.core.apply_func)."""
+"""Recursive collection-map helper (shim for lightning_utilities.core.apply_func).
 
-from collections import OrderedDict, defaultdict
-from typing import Any, Callable, Optional, Tuple, Type, Union
+The behavior-accurate implementation now ships in the package; the shim
+re-exports it so the reference and tpumetrics run the SAME code — parity
+tests cannot pass against semantics the shipped package doesn't have."""
 
-
-def apply_to_collection(
-    data: Any,
-    dtype: Union[type, Any, Tuple[Union[type, Any]]],
-    function: Callable,
-    *args: Any,
-    wrong_dtype: Optional[Union[type, Tuple[type, ...]]] = None,
-    include_none: bool = True,
-    **kwargs: Any,
-) -> Any:
-    """Apply ``function`` to every element of ``data`` that is an instance of ``dtype``.
-
-    Recurses through lists, tuples (incl. namedtuples), sets and mappings, preserving
-    the container type.  Elements matching ``wrong_dtype`` are left untouched.
-    """
-    if isinstance(data, dtype) and (wrong_dtype is None or not isinstance(data, wrong_dtype)):
-        return function(data, *args, **kwargs)
-
-    elem_type = type(data)
-
-    if isinstance(data, (defaultdict, OrderedDict, dict)):
-        out = []
-        for k, v in data.items():
-            v = apply_to_collection(
-                v, dtype, function, *args, wrong_dtype=wrong_dtype, include_none=include_none, **kwargs
-            )
-            if include_none or v is not None:
-                out.append((k, v))
-        if isinstance(data, defaultdict):
-            return defaultdict(data.default_factory, OrderedDict(out))
-        return elem_type(OrderedDict(out))
-
-    is_namedtuple = isinstance(data, tuple) and hasattr(data, "_fields")
-    if isinstance(data, (list, tuple, set)):
-        out = []
-        for d in data:
-            v = apply_to_collection(
-                d, dtype, function, *args, wrong_dtype=wrong_dtype, include_none=include_none, **kwargs
-            )
-            if include_none or v is not None:
-                out.append(v)
-        return elem_type(*out) if is_namedtuple else elem_type(out)
-
-    return data
+from tpumetrics.utils.data import apply_to_collection  # noqa: F401

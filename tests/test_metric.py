@@ -379,3 +379,25 @@ def test_metric_state_checkpoints_with_orbax(tmp_path):
     ckpt.save(path, state)
     restored = ckpt.restore(path)
     assert np.isclose(float(m.functional_compute(restored)), expected)
+
+
+def test_utilities_data_compat_surface():
+    """Drop-in imports the reference exposes from utilities.data
+    (METRIC_EPS, apply_to_collection, rank_zero_warn re-export)."""
+    import jax
+
+    from tpumetrics.utils.data import METRIC_EPS, apply_to_collection, rank_zero_warn
+
+    assert METRIC_EPS == 1e-6
+    assert callable(rank_zero_warn)
+    out = apply_to_collection({"a": jnp.ones(3), "b": [jnp.zeros(2), "keep"]}, jax.Array, lambda x: x + 1)
+    assert float(out["a"][0]) == 2.0 and float(out["b"][0][0]) == 1.0 and out["b"][1] == "keep"
+    # tuple of dtypes, extra args
+    out2 = apply_to_collection([1, 2.0, "s"], (int, float), lambda x, k: x * k, 3)
+    assert out2 == [3, 6.0, "s"]
+    # reference-faithful semantics jax pytrees would break: insertion order,
+    # sets, wrong_dtype exclusion
+    ordered = apply_to_collection({"b": 1, "a": 2}, int, lambda x: x * 10)
+    assert list(ordered) == ["b", "a"] and ordered == {"b": 10, "a": 20}
+    assert apply_to_collection({1, 2}, int, lambda x: x * 10) == {10, 20}
+    assert apply_to_collection([1, True], int, lambda x: x + 1, wrong_dtype=bool) == [2, True]

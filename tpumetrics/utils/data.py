@@ -16,7 +16,59 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import jax
 import jax.numpy as jnp
 
+from tpumetrics.utils.prints import rank_zero_warn  # noqa: F401  (re-export, reference utilities/data.py)
+
 Array = jax.Array
+
+# drop-in compatibility with ``torchmetrics.utilities.data``
+METRIC_EPS = 1e-6
+
+
+def apply_to_collection(
+    data: Any,
+    dtype: Any,
+    function: Any,
+    *args: Any,
+    wrong_dtype: Any = None,
+    include_none: bool = True,
+    **kwargs: Any,
+) -> Any:
+    """Apply ``function`` to every element of ``dtype`` inside a nested
+    collection (the lightning-utilities helper the reference re-exports from
+    ``utilities.data``).  Faithful recursion: preserves dict insertion order
+    and container types (incl. namedtuples, sets, defaultdicts), honors
+    ``wrong_dtype`` exclusions and ``include_none`` dropping — jax pytrees
+    would sort dict keys and skip sets."""
+    from collections import OrderedDict, defaultdict
+
+    if isinstance(data, dtype) and (wrong_dtype is None or not isinstance(data, wrong_dtype)):
+        return function(data, *args, **kwargs)
+
+    elem_type = type(data)
+    if isinstance(data, (defaultdict, OrderedDict, dict)):
+        out = []
+        for k, v in data.items():
+            v = apply_to_collection(
+                v, dtype, function, *args, wrong_dtype=wrong_dtype, include_none=include_none, **kwargs
+            )
+            if include_none or v is not None:
+                out.append((k, v))
+        if isinstance(data, defaultdict):
+            return defaultdict(data.default_factory, OrderedDict(out))
+        return elem_type(OrderedDict(out))
+
+    is_namedtuple = isinstance(data, tuple) and hasattr(data, "_fields")
+    if isinstance(data, (list, tuple, set)):
+        out = []
+        for d in data:
+            v = apply_to_collection(
+                d, dtype, function, *args, wrong_dtype=wrong_dtype, include_none=include_none, **kwargs
+            )
+            if include_none or v is not None:
+                out.append(v)
+        return elem_type(*out) if is_namedtuple else elem_type(out)
+
+    return data
 
 
 def _is_tracer(x: Any) -> bool:
