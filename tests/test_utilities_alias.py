@@ -1,0 +1,81 @@
+"""The ``tpumetrics.utilities`` migration alias (reference ``torchmetrics/utilities``).
+
+Reference surface: ``/root/reference/src/torchmetrics/utilities/__init__.py:14-37``.
+"""
+
+import importlib
+import importlib.util
+import pkgutil
+import subprocess
+import sys
+
+import pytest
+
+import tpumetrics.utilities
+import tpumetrics.utils
+
+# Derived from the filesystem, not hardcoded: a future utils submodule that
+# fails to alias makes this parametrization (and the identity assert) fail.
+SUBMODULES = sorted(
+    info.name
+    for info in pkgutil.iter_modules(tpumetrics.utils.__path__)
+    if not info.ispkg
+)
+
+
+def test_every_utils_submodule_is_aliased():
+    assert set(SUBMODULES) == set(tpumetrics.utilities._SUBMODULES)
+    assert "data" in SUBMODULES and "plot" in SUBMODULES  # sanity: derivation worked
+
+
+@pytest.mark.parametrize("name", SUBMODULES)
+def test_submodule_is_same_object(name):
+    alias = importlib.import_module(f"tpumetrics.utilities.{name}")
+    real = importlib.import_module(f"tpumetrics.utils.{name}")
+    assert alias is real
+    assert getattr(tpumetrics.utilities, name) is real
+
+
+@pytest.mark.parametrize("name", SUBMODULES)
+def test_find_spec_resolves(name):
+    spec = importlib.util.find_spec(f"tpumetrics.utilities.{name}")
+    assert spec is not None
+
+
+def test_find_spec_resolves_in_fresh_process():
+    """Spec probes must work before the alias package was ever imported."""
+    code = (
+        "import importlib.util; "
+        "spec = importlib.util.find_spec('tpumetrics.utilities.data'); "
+        "assert spec is not None, 'find_spec returned None'; "
+        "import tpumetrics.utilities.data as d, tpumetrics.utils.data as r; "
+        "assert d is r"
+    )
+    subprocess.run([sys.executable, "-c", code], check=True, cwd="/root/repo")
+
+
+def test_reference_star_surface():
+    """Every name the reference re-exports at utilities level resolves here."""
+    ref_all = [
+        "check_forward_full_state_property",
+        "class_reduce",
+        "reduce",
+        "rank_zero_debug",
+        "rank_zero_info",
+        "rank_zero_warn",
+        "dim_zero_cat",
+        "dim_zero_max",
+        "dim_zero_mean",
+        "dim_zero_min",
+        "dim_zero_sum",
+    ]
+    for name in ref_all:
+        assert hasattr(tpumetrics.utilities, name), name
+        assert name in tpumetrics.utilities.__all__
+
+
+def test_migration_import_patterns():
+    from tpumetrics.utilities.data import METRIC_EPS, apply_to_collection  # noqa: F401
+    from tpumetrics.utilities.exceptions import TPUMetricsUserError  # noqa: F401
+
+    assert METRIC_EPS == pytest.approx(1e-6)

@@ -1,0 +1,59 @@
+"""Migration alias: ``tpumetrics.utilities`` == :mod:`tpumetrics.utils`.
+
+The reference exposes its utility surface at ``torchmetrics.utilities``
+(reference ``src/torchmetrics/utilities/__init__.py:1-40``); this package
+mirrors that import path so code migrating from the reference keeps working
+unchanged::
+
+    >>> from tpumetrics.utilities.data import METRIC_EPS, dim_zero_cat
+    >>> from tpumetrics.utilities import rank_zero_warn, class_reduce
+
+Every submodule here *is* the corresponding :mod:`tpumetrics.utils` module
+(identical object, registered in ``sys.modules``), so there is exactly one
+implementation and no drift between the two names.  The top-level package
+itself is a namespace mirror: it re-exports ``tpumetrics.utils.__all__``.
+"""
+
+import importlib as _importlib
+import importlib.abc as _importlib_abc
+import importlib.util as _importlib_util
+import pkgutil as _pkgutil
+import sys as _sys
+
+import tpumetrics.utils as _utils
+from tpumetrics.utils import *  # noqa: F401,F403
+from tpumetrics.utils import __all__ as __all__  # noqa: PLC0414
+
+_SUBMODULES = tuple(
+    info.name for info in _pkgutil.iter_modules(_utils.__path__) if not info.ispkg
+)
+
+for _name in _SUBMODULES:
+    _mod = _importlib.import_module(f"tpumetrics.utils.{_name}")
+    _sys.modules[f"{__name__}.{_name}"] = _mod
+    globals()[_name] = _mod
+del _name, _mod
+
+
+class _UtilitiesAliasFinder(_importlib_abc.MetaPathFinder):
+    """Resolve ``find_spec('tpumetrics.utilities.<sub>')`` probes.
+
+    ``importlib.util.find_spec`` checks ``sys.modules`` *before* importing the
+    parent package, so availability probes in a fresh process would otherwise
+    see ``None`` (no ``<sub>.py`` exists on disk under ``utilities/``).  This
+    finder answers with the real :mod:`tpumetrics.utils` submodule's spec.
+    """
+
+    _prefix = __name__ + "."
+
+    def find_spec(self, fullname, path=None, target=None):
+        if not fullname.startswith(self._prefix):
+            return None
+        sub = fullname[len(self._prefix) :]
+        if sub not in _SUBMODULES:
+            return None
+        return _importlib_util.find_spec(f"tpumetrics.utils.{sub}")
+
+
+if not any(isinstance(f, _UtilitiesAliasFinder) for f in _sys.meta_path):
+    _sys.meta_path.append(_UtilitiesAliasFinder())
