@@ -262,6 +262,87 @@ def test_bert_score_batched_forward_matches_single():
         assert np.allclose(np.asarray(big[k]), np.asarray(tiny[k]), atol=1e-6), k
 
 
+def _write_baseline_csv(path, rows):
+    """bert-score rescale-baseline layout (reference bert.py:175-184):
+    header line, then ``layer,P,R,F`` rows."""
+    lines = ["LAYER,P,R,F"] + [",".join(str(v) for v in r) for r in rows]
+    path.write_text("\n".join(lines) + "\n")
+
+
+def test_bert_score_rescale_with_local_baseline(tmp_path):
+    """`(x - b) / (1 - b)` against the last baseline row when num_layers is
+    unset (reference bert.py:225-240 with num_layers=-1)."""
+    tok = _WordTokenizer()
+    emb = _ToyEmbedder()
+    preds = ["the cat sat", "hello there"]
+    target = ["the cat sat down", "hello there friend"]
+    csv_path = tmp_path / "baseline.csv"
+    _write_baseline_csv(csv_path, [[0, 0.9, 0.9, 0.9], [1, 0.3, 0.4, 0.5]])
+
+    raw = bert_score(preds, target, model=emb, user_tokenizer=tok, user_forward_fn=emb)
+    scaled = bert_score(
+        preds, target, model=emb, user_tokenizer=tok, user_forward_fn=emb,
+        rescale_with_baseline=True, baseline_path=str(csv_path),
+    )
+    for key, b in (("precision", 0.3), ("recall", 0.4), ("f1", 0.5)):
+        expect = (np.asarray(raw[key]) - b) / (1 - b)
+        assert np.allclose(np.asarray(scaled[key]), expect, atol=1e-6), key
+
+    # the class path reaches the same numbers
+    m = BERTScore(
+        model=emb, user_tokenizer=tok, user_forward_fn=emb,
+        rescale_with_baseline=True, baseline_path=str(csv_path),
+    )
+    m.update(preds, target)
+    out = m.compute()
+    assert np.allclose(np.asarray(out["f1"]), np.asarray(scaled["f1"]), atol=1e-6)
+
+
+def test_bert_score_rescale_without_local_file_raises():
+    tok = _WordTokenizer()
+    emb = _ToyEmbedder()
+    with pytest.raises(NotImplementedError, match="baseline_path"):
+        bert_score(["a"], ["a"], model=emb, user_tokenizer=tok, user_forward_fn=emb,
+                   rescale_with_baseline=True)
+    with pytest.raises(NotImplementedError, match="baseline_path"):
+        BERTScore(model=emb, user_tokenizer=tok, user_forward_fn=emb, rescale_with_baseline=True)
+
+
+def test_bert_score_baseline_path_inert_without_flag(tmp_path):
+    """Reference loads the baseline only when rescale_with_baseline=True
+    (bert.py:394); a bare baseline_path leaves scores untouched."""
+    tok = _WordTokenizer()
+    emb = _ToyEmbedder()
+    csv_path = tmp_path / "baseline.csv"
+    _write_baseline_csv(csv_path, [[0, 0.5, 0.5, 0.5]])
+    preds, target = ["the cat sat"], ["the cat sat down"]
+    raw = bert_score(preds, target, model=emb, user_tokenizer=tok, user_forward_fn=emb)
+    with_path = bert_score(
+        preds, target, model=emb, user_tokenizer=tok, user_forward_fn=emb,
+        baseline_path=str(csv_path),
+    )
+    assert np.allclose(np.asarray(raw["f1"]), np.asarray(with_path["f1"]))
+
+
+def test_bert_score_scorer_signature_independent_of_corpus_size():
+    """Corpora whose chunk counts round to the same power of two share ONE
+    compiled _score_scan signature (padding happens outside the jit)."""
+    from tpumetrics.functional.text.bert import _score_scan
+
+    tok = _WordTokenizer()
+    emb = _ToyEmbedder()
+    # same max token length (jit signature includes seq); sizes 5 and 7 both
+    # round to k=2 chunks of step=4
+    corpus5 = [f"w{i} x y z" for i in range(5)]
+    corpus7 = [f"w{i} x y z" for i in range(7)]
+    before = _score_scan._cache_size()
+    bert_score(corpus5, corpus5, model=emb, user_tokenizer=tok, user_forward_fn=emb, batch_size=4)
+    after_first = _score_scan._cache_size()
+    bert_score(corpus7, corpus7, model=emb, user_tokenizer=tok, user_forward_fn=emb, batch_size=4)
+    assert _score_scan._cache_size() == after_first
+    assert after_first >= before  # first call may have hit an existing entry
+
+
 def test_text_model_metrics_string_state_sync_policy():
     """Sentence buffers are host strings: an in-trace (array-only) backend
     must raise rather than silently score one rank's shard; an eager backend

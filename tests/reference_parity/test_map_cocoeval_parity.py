@@ -205,7 +205,15 @@ def test_tm_to_coco_round_trip(ref, tmp_path):
     want = {k: np.asarray(v) for k, v in m.compute().items()}
     m.tm_to_coco(str(tmp_path / "rt"))
 
-    p2, t2 = MeanAveragePrecision.coco_to_tm(str(tmp_path / "rt_preds.json"), str(tmp_path / "rt_target.json"))
+    # `backend=` matches the reference signature (mean_ap.py:628-633):
+    # accepted-and-ignored like the constructor's, invalid values rejected
+    with pytest.raises(ValueError, match="backend"):
+        MeanAveragePrecision.coco_to_tm(
+            str(tmp_path / "rt_preds.json"), str(tmp_path / "rt_target.json"), backend="bogus"
+        )
+    p2, t2 = MeanAveragePrecision.coco_to_tm(
+        str(tmp_path / "rt_preds.json"), str(tmp_path / "rt_target.json"), backend="faster_coco_eval"
+    )
     m2 = MeanAveragePrecision(box_format="xywh")
     m2.update(p2, t2)
     got = {k: np.asarray(v) for k, v in m2.compute().items()}

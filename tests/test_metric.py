@@ -401,3 +401,42 @@ def test_utilities_data_compat_surface():
     assert list(ordered) == ["b", "a"] and ordered == {"b": 10, "a": 20}
     assert apply_to_collection({1, 2}, int, lambda x: x * 10) == {10, 20}
     assert apply_to_collection([1, True], int, lambda x: x + 1, wrong_dtype=bool) == [2, True]
+
+
+def test_apply_to_collection_dataclass_and_frozenset():
+    """The lightning-utilities branches the reference relies on: dataclass
+    instances recurse field-wise (frozen ones raise), frozensets rebuild."""
+    import dataclasses
+
+    from tpumetrics.utils.data import apply_to_collection
+
+    @dataclasses.dataclass
+    class Batch:
+        x: int
+        tags: list
+        label: str = "keep"
+
+    out = apply_to_collection(Batch(x=2, tags=[3, "s"], label="keep"), int, lambda v: v * 10)
+    assert isinstance(out, Batch)
+    assert out.x == 20 and out.tags == [30, "s"] and out.label == "keep"
+
+    fs = apply_to_collection(frozenset({1, 2}), int, lambda v: v * 10)
+    assert isinstance(fs, frozenset) and fs == {10, 20}
+
+    @dataclasses.dataclass(frozen=True)
+    class Frozen:
+        x: int
+
+    with pytest.raises(ValueError, match="frozen dataclass"):
+        apply_to_collection(Frozen(x=1), int, lambda v: v + 1)
+
+    # a dataclass *type* (not instance) passes through untouched
+    assert apply_to_collection(Batch, int, lambda v: v + 1) is Batch
+    # non-init fields are left alone
+    @dataclasses.dataclass
+    class WithDerived:
+        x: int
+        y: int = dataclasses.field(init=False, default=7)
+
+    out2 = apply_to_collection(WithDerived(x=1), int, lambda v: v * 10)
+    assert out2.x == 10 and out2.y == 7

@@ -374,13 +374,17 @@ class MeanAveragePrecision(Metric):
         coco_preds: str,
         coco_target: str,
         iou_type: str = "bbox",
+        backend: str = "pycocotools",
     ):
         """Convert COCO-format json files into this metric's input format
         (reference mean_ap.py:612-719, without needing pycocotools: the files
         are plain json).  Boxes come back in COCO's xywh layout — construct
         the metric with ``box_format="xywh"`` — and segm masks must be
         uncompressed-RLE dicts (compressed-string counts / polygons need the
-        real pycocotools toolchain).
+        real pycocotools toolchain).  ``backend`` matches the reference
+        signature (mean_ap.py:628-633, 'pycocotools'|'faster_coco_eval') and
+        is accepted-and-ignored like the constructor's: the built-in json
+        reader serves both.
 
         Returns:
             ``(preds, target)`` lists of per-image dicts of jnp arrays.
@@ -389,6 +393,10 @@ class MeanAveragePrecision(Metric):
 
         if iou_type not in ("bbox", "segm"):
             raise ValueError(f"Expected argument `iou_type` to be bbox or segm, got {iou_type}")
+        if backend not in ("pycocotools", "faster_coco_eval"):
+            raise ValueError(
+                f"Expected argument `backend` to be `pycocotools` or `faster_coco_eval`, got {backend}"
+            )
         with open(coco_target) as fh:
             gt_data = json.load(fh)
         with open(coco_preds) as fh:

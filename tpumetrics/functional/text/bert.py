@@ -120,20 +120,55 @@ def _get_precision_recall_f1(
     return fmt(precision), fmt(recall), fmt(f1_score)
 
 
+def _read_baseline_csv(baseline_path: str) -> Array:
+    """Load a bert-score rescale-baseline CSV from a LOCAL file (reference
+    bert.py:175-184): header row skipped, first column (layer index)
+    dropped, remaining columns are per-layer (precision, recall, f1)
+    baselines."""
+    import csv
+
+    with open(baseline_path) as fname:
+        rows = [[float(item) for item in row] for idx, row in enumerate(csv.reader(fname)) if idx > 0]
+    return jnp.asarray(rows)[:, 1:]
+
+
+def _rescale_with_baseline(
+    precision: Array,
+    recall: Array,
+    f1_score: Array,
+    baseline: Array,
+    num_layers: Optional[int] = None,
+    all_layers: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """``(x - b) / (1 - b)`` per layer (reference bert.py:225-240)."""
+    if num_layers is None and all_layers is False:
+        num_layers = -1
+    all_metrics = jnp.stack([precision, recall, f1_score], axis=-1)
+    baseline_scale = baseline[:, None, :] if all_layers else baseline[num_layers]
+    all_metrics = (all_metrics - baseline_scale) / (1 - baseline_scale)
+    return all_metrics[..., 0], all_metrics[..., 1], all_metrics[..., 2]
+
+
+def _pad_rows(x: Array, rows: int) -> Array:
+    """Pad axis 0 to ``rows`` as a standalone eager op, OUTSIDE the scoring
+    jit: the expensive ``_score_scan`` signature then depends only on
+    ``(k, step, seq, dim)``, so corpora of different raw sizes that round to
+    the same chunk count share one compiled scorer."""
+    if x.shape[0] == rows:
+        return x
+    return jnp.pad(x, [(0, rows - x.shape[0])] + [(0, 0)] * (x.ndim - 1))
+
+
 @functools.partial(jax.jit, static_argnums=(4, 5))
 def _score_scan(pe, te, ps, ts, k, step):
-    """Whole-corpus scoring as ONE dispatch: pad to ``k`` chunks of ``step``,
-    ``lax.scan`` the chunked scorer (peak memory stays one chunk's
-    similarity tensor), flatten back.  Replaces a Python loop of per-chunk
-    slices + calls — hundreds of eager dispatches on a remote-attached
-    accelerator.  The sentence axis always ends up LAST, so the caller
-    trims padding with ``[..., :n]`` in both the single-layer ``(n,)`` and
-    ``all_layers`` ``(l, n)`` output forms."""
-    rows = k * step
-    pe = jnp.pad(pe, [(0, rows - pe.shape[0])] + [(0, 0)] * (pe.ndim - 1))
-    te = jnp.pad(te, [(0, rows - te.shape[0])] + [(0, 0)] * (te.ndim - 1))
-    ps = jnp.pad(ps, [(0, rows - ps.shape[0]), (0, 0)])
-    ts = jnp.pad(ts, [(0, rows - ts.shape[0]), (0, 0)])
+    """Whole-corpus scoring as ONE dispatch: inputs arrive pre-padded to
+    ``k`` chunks of ``step`` rows (see ``_pad_rows``), ``lax.scan`` the
+    chunked scorer (peak memory stays one chunk's similarity tensor),
+    flatten back.  Replaces a Python loop of per-chunk slices + calls —
+    hundreds of eager dispatches on a remote-attached accelerator.  The
+    sentence axis always ends up LAST, so the caller trims padding with
+    ``[..., :n]`` in both the single-layer ``(n,)`` and ``all_layers``
+    ``(l, n)`` output forms."""
     chunked = lambda a: a.reshape((k, step) + a.shape[1:])
     _, out = jax.lax.scan(
         lambda _, xs: (None, _get_precision_recall_f1(*xs)), None,
@@ -371,10 +406,15 @@ def bert_score(
         )
     # device/num_threads are torch runtime knobs, accepted for drop-in
     # compatibility and ignored: XLA owns placement and threading
-    if rescale_with_baseline or baseline_path or baseline_url:
-        raise NotImplementedError(
-            "Baseline rescaling requires downloadable baseline files and is not supported here."
-        )
+    baseline = None
+    if rescale_with_baseline:
+        if not baseline_path:
+            raise NotImplementedError(
+                "Baseline rescaling without a local file requires downloading the bert-score"
+                " baseline (reference bert.py:202-222), which is not supported here. Save the"
+                " baseline CSV locally and pass it via `baseline_path=`."
+            )
+        baseline = _read_baseline_csv(baseline_path)
 
     if model is None:
         model, tokenizer = _load_default_model(model_name_or_path or "roberta-large", num_layers)
@@ -410,12 +450,24 @@ def bert_score(
     n_chunks = -(-n // step) if n else 0
     if n_chunks:
         k = 1 << (n_chunks - 1).bit_length()
+        rows = k * step
         precision, recall, f1 = (
             x[..., :n]
-            for x in _score_scan(preds_emb, target_emb, preds_scale, target_scale, k, step)
+            for x in _score_scan(
+                _pad_rows(preds_emb, rows),
+                _pad_rows(target_emb, rows),
+                _pad_rows(preds_scale, rows),
+                _pad_rows(target_scale, rows),
+                k,
+                step,
+            )
         )
     else:
         precision = recall = f1 = jnp.zeros((0,), jnp.float32)
+    if baseline is not None:
+        precision, recall, f1 = _rescale_with_baseline(
+            precision, recall, f1, baseline, num_layers, all_layers
+        )
     output = {"precision": precision, "recall": recall, "f1": f1}
     if return_hash:
         output["hash"] = f"tpumetrics-bert_score-idf:{idf}"  # type: ignore[assignment]

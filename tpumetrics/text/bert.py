@@ -79,10 +79,14 @@ class BERTScore(HostSentenceStateMixin, Metric):
         self.batch_size = batch_size
         self.return_hash = return_hash
         self.lang = lang
-        if rescale_with_baseline or baseline_path or baseline_url:
-            # fail at construction, not after a full epoch of updates
+        if rescale_with_baseline and not baseline_path:
+            # fail at construction, not after a full epoch of updates: without
+            # a local file the baseline would need a download (reference
+            # bert.py:202-222); with `baseline_path=` rescaling is supported
             raise NotImplementedError(
-                "Baseline rescaling requires downloadable baseline files and is not supported here."
+                "Baseline rescaling without a local file requires downloading the bert-score"
+                " baseline, which is not supported here. Save the baseline CSV locally and pass"
+                " it via `baseline_path=`."
             )
         self.rescale_with_baseline = rescale_with_baseline
         self.baseline_path = baseline_path

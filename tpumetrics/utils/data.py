@@ -37,8 +37,12 @@ def apply_to_collection(
     collection (the lightning-utilities helper the reference re-exports from
     ``utilities.data``).  Faithful recursion: preserves dict insertion order
     and container types (incl. namedtuples, sets, defaultdicts), honors
-    ``wrong_dtype`` exclusions and ``include_none`` dropping — jax pytrees
-    would sort dict keys and skip sets."""
+    ``wrong_dtype`` exclusions and ``include_none`` dropping, recurses into
+    dataclass instances (rebuilding via field-wise setattr like the
+    lightning-utilities helper, raising on frozen ones) and frozensets —
+    jax pytrees would sort dict keys and skip sets."""
+    import copy
+    import dataclasses
     from collections import OrderedDict, defaultdict
 
     if isinstance(data, dtype) and (wrong_dtype is None or not isinstance(data, wrong_dtype)):
@@ -57,8 +61,33 @@ def apply_to_collection(
             return defaultdict(data.default_factory, OrderedDict(out))
         return elem_type(OrderedDict(out))
 
+    if dataclasses.is_dataclass(data) and not isinstance(data, type):
+        result = copy.copy(data)
+        for field in dataclasses.fields(data):
+            if not field.init:
+                continue
+            v = apply_to_collection(
+                getattr(data, field.name),
+                dtype,
+                function,
+                *args,
+                wrong_dtype=wrong_dtype,
+                include_none=include_none,
+                **kwargs,
+            )
+            if not include_none and v is None:
+                v = getattr(data, field.name)
+            try:
+                setattr(result, field.name, v)
+            except dataclasses.FrozenInstanceError as err:
+                raise ValueError(
+                    "A frozen dataclass was passed to `apply_to_collection` but this is not"
+                    " allowed."
+                ) from err
+        return result
+
     is_namedtuple = isinstance(data, tuple) and hasattr(data, "_fields")
-    if isinstance(data, (list, tuple, set)):
+    if isinstance(data, (list, tuple, set, frozenset)):
         out = []
         for d in data:
             v = apply_to_collection(
