@@ -1,0 +1,200 @@
+"""Fused sync-path collectives: one collective per (op, dtype) class.
+
+The TPU-first redesign of the reference's one-gather-per-state wire
+(reference utilities/distributed.py:97-147): all same-class reduce states of
+a metric — or of a whole MetricCollection — travel as ONE psum-style
+collective (``tpumetrics/parallel/fuse.py``). These tests pin both the
+correctness (values unchanged) and the wire shape (collective count in the
+lowered HLO).
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tests.helpers.testers import shard_map
+from tpumetrics import MetricCollection
+from tpumetrics.classification import (
+    MulticlassAccuracy,
+    MulticlassAUROC,
+    MulticlassF1Score,
+    MulticlassStatScores,
+)
+from tpumetrics.parallel.backend import AxisBackend
+from tpumetrics.parallel.fuse import FusedReducer
+
+
+def _mesh(ws=8):
+    return Mesh(np.array(jax.devices()[:ws]), ("r",))
+
+
+# ------------------------------------------------------------ FusedReducer
+
+
+class _RecordingBackend:
+    """Counts all_reduce calls; reduces over a fake world of size 1."""
+
+    def __init__(self):
+        self.calls = []
+
+    def all_reduce(self, x, op, group=None):
+        self.calls.append((op, str(x.dtype), x.size))
+        return x
+
+
+def test_fused_reducer_one_collective_per_class():
+    be = _RecordingBackend()
+    red = FusedReducer(be)
+    h1 = red.add(jnp.ones((3,), jnp.float32), "sum")
+    h2 = red.add(jnp.full((2, 2), 2.0, jnp.float32), "sum")
+    h3 = red.add(jnp.asarray(5, jnp.int32), "sum")
+    h4 = red.add(jnp.ones((4,), jnp.float32), "max")
+    red.flush()
+    # classes: (sum,f32) fused, (sum,i32) single, (max,f32) single
+    assert len(be.calls) == 3
+    fused = [c for c in be.calls if c == ("sum", "float32", 7)]
+    assert len(fused) == 1
+    # shapes reconstructed
+    assert red.result(h1).shape == (3,)
+    assert red.result(h2).shape == (2, 2)
+    assert np.allclose(np.asarray(red.result(h2)), 2.0)
+    assert red.result(h3).shape == () and int(red.result(h3)) == 5
+    assert red.result(h4).shape == (4,)
+
+
+def test_fused_reducer_guards():
+    red = FusedReducer(_RecordingBackend())
+    with pytest.raises(RuntimeError, match="before flush"):
+        red.result(0)
+    red.add(jnp.ones(2), "sum")
+    red.flush()
+    with pytest.raises(RuntimeError, match="already flushed"):
+        red.add(jnp.ones(2), "sum")
+
+
+# ------------------------------------------- values unchanged under fusion
+
+
+def _collection(C=7):
+    return MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=C, average="micro", validate_args=False),
+            "f1": MulticlassF1Score(num_classes=C, average="macro", validate_args=False),
+            "stat": MulticlassStatScores(num_classes=C, average=None, validate_args=False),
+            "auroc": MulticlassAUROC(num_classes=C, validate_args=False, thresholds=16),
+        }
+    )
+
+
+def _data(C=7, B=64, seed=0):
+    rng = np.random.default_rng(seed)
+    preds = jnp.asarray(jax.nn.softmax(jnp.asarray(rng.standard_normal((B, C)), jnp.float32)))
+    target = jnp.asarray(rng.integers(0, C, size=(B,)), jnp.int32)
+    return preds, target
+
+
+def test_collection_fused_sync_matches_global_eval():
+    """8-way sharded update + fused collection sync == unsharded compute."""
+    C = 7
+    preds, target = _data(C)
+    col = _collection(C)
+    col.establish_compute_groups(preds[:8], target[:8])
+
+    def run(p, t):
+        state = col.functional_update(col.init_state(), p, t)
+        return col.functional_compute(state, axis_name="r")
+
+    sharded = jax.jit(
+        shard_map(run, mesh=_mesh(), in_specs=(P("r"), P("r")), out_specs=P())
+    )(preds, target)
+
+    ref_col = _collection(C)
+    ref_col.update(preds, target)
+    want = ref_col.compute()
+    for k, v in want.items():
+        np.testing.assert_allclose(
+            np.asarray(sharded[k]), np.asarray(v), atol=1e-6, err_msg=k
+        )
+
+
+def test_metric_sync_state_fused_matches_unfused_semantics():
+    C = 5
+    preds, target = _data(C, B=32, seed=1)
+    m = MulticlassStatScores(num_classes=C, average=None, validate_args=False)
+
+    def run(p, t):
+        state = m.functional_update(m.init_state(), p, t)
+        return m.functional_compute(state, axis_name="r")
+
+    out = jax.jit(shard_map(run, mesh=_mesh(), in_specs=(P("r"), P("r")), out_specs=P()))(
+        preds, target
+    )
+    ref = MulticlassStatScores(num_classes=C, average=None, validate_args=False)
+    ref.update(preds, target)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref.compute()), atol=1e-6)
+
+
+# -------------------------------------------------- wire shape in the HLO
+
+
+def _count_all_reduces(stablehlo_text):
+    return len(re.findall(r"all_reduce", stablehlo_text))
+
+
+def test_collection_sync_hlo_has_one_collective_per_class():
+    """The lowered sync program contains exactly as many all_reduce ops as
+    there are distinct (op, dtype) classes across ALL metrics' states —
+    fusion across metrics, not just within one metric."""
+    C = 7
+    preds, target = _data(C)
+    col = _collection(C)
+    col.establish_compute_groups(preds[:8], target[:8])
+
+    # enumerate expected classes from the state specs themselves
+    state = col.init_state()
+    from tpumetrics.metric import _reduce_fn_to_op
+
+    classes = set()
+    n_reduce_states = 0
+    for leader, st in state.items():
+        m = col[leader] if hasattr(col, "__getitem__") else col._modules[leader]
+        for attr, red in m._reductions.items():
+            op = _reduce_fn_to_op(red)
+            val = st[attr]
+            if op in ("sum", "mean", "max", "min") and not isinstance(val, list):
+                classes.add((op, str(jnp.asarray(val).dtype)))
+                n_reduce_states += 1
+    assert n_reduce_states > len(classes) >= 1  # fusion actually collapses something
+
+    def run(p, t):
+        st = col.functional_update(col.init_state(), p, t)
+        return col.functional_compute(st, axis_name="r")
+
+    lowered = jax.jit(
+        shard_map(run, mesh=_mesh(), in_specs=(P("r"), P("r")), out_specs=P())
+    ).lower(preds, target)
+    text = lowered.as_text()
+    assert _count_all_reduces(text) == len(classes), (
+        f"expected {len(classes)} fused all_reduce classes, HLO has "
+        f"{_count_all_reduces(text)}"
+    )
+
+
+def test_single_metric_sync_hlo_fuses_states():
+    """One metric with 4 same-dtype sum states lowers to ONE all_reduce."""
+    C = 5
+    preds, target = _data(C, B=32, seed=2)
+    m = MulticlassStatScores(num_classes=C, average=None, validate_args=False)
+
+    def run(p, t):
+        state = m.functional_update(m.init_state(), p, t)
+        return m.sync_state(state, AxisBackend("r"))
+
+    lowered = jax.jit(
+        shard_map(run, mesh=_mesh(), in_specs=(P("r"), P("r")), out_specs=P())
+    ).lower(preds, target)
+    assert _count_all_reduces(lowered.as_text()) == 1

@@ -565,15 +565,40 @@ class MetricCollection:
         self, state: Dict[str, Dict[str, Any]], axis_name: Optional[Any] = None
     ) -> Dict[str, Any]:
         """Pure collection compute from group-leader states; each member
-        computes from its leader's (synced) state."""
+        computes from its leader's (synced) state.
+
+        The sync is fused ACROSS metrics: every reduce-op state of every
+        group leader registers with one shared
+        :class:`~tpumetrics.parallel.fuse.FusedReducer`, so the whole
+        collection syncs with one collective per (op, dtype) class — e.g. a
+        3-metric collection whose tp/fp/tn/fn/total states are all int32
+        sums issues ONE psum, not a dozen."""
+        synced_states = self.sync_states(state, _axis_backend(axis_name)) if axis_name is not None else state
         results: Dict[str, Any] = {}
         for cg in self._groups.values():
-            leader = self._modules[cg[0]]
-            synced = leader.sync_state(state[cg[0]], _axis_backend(axis_name)) if axis_name is not None else state[cg[0]]
             for name in cg:
                 m = self._modules[name]
-                results[name] = m.functional_compute(synced)
+                results[name] = m.functional_compute(synced_states[cg[0]])
         return self._flatten_results(results)
+
+    def sync_states(
+        self, state: Dict[str, Dict[str, Any]], backend: Any
+    ) -> Dict[str, Dict[str, Any]]:
+        """Pure cross-rank merge of all group-leader state pytrees with the
+        collection-wide fused sync (one collective per (op, dtype) class)."""
+        from tpumetrics.parallel.fuse import FusedReducer
+
+        reducer = FusedReducer(backend)
+        collected: Dict[str, tuple] = {}
+        for cg in self._groups.values():
+            leader = self._modules[cg[0]]
+            collected[cg[0]] = leader._sync_state_collect(state[cg[0]], backend, reducer)
+        reducer.flush()
+        synced: Dict[str, Dict[str, Any]] = {}
+        for name, (out, pending) in collected.items():
+            out.update(reducer.resolve(pending))
+            synced[name] = out
+        return synced
 
 
 def _axis_backend(axis_name: Any) -> Any:

@@ -28,7 +28,7 @@ import inspect
 from abc import ABC, abstractmethod
 from contextlib import contextmanager
 from copy import deepcopy
-from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -441,45 +441,18 @@ class Metric(ABC):
         group = process_group or self.process_group
         backend = self._active_backend()
 
-        from tpumetrics.buffers import MaskedBuffer, buffer_all_gather
-
         if dist_sync_fn is None:
-            # fused backend path
-            for attr, reduction_fn in self._reductions.items():
-                current_val = getattr(self, attr)
-                op = _reduce_fn_to_op(reduction_fn)
-                if isinstance(current_val, MaskedBuffer):
-                    object.__setattr__(self, attr, buffer_all_gather(current_val, backend, group=group))
-                elif isinstance(current_val, list):
-                    if reduction_fn is None:
-                        # ragged per-item list (e.g. per-image detection
-                        # states): item boundaries are part of the state and
-                        # travel as a shape matrix beside the flattened data
-                        # (reference uses all_gather_object,
-                        # detection/mean_ap.py:994-1024)
-                        object.__setattr__(
-                            self, attr, _gather_ragged_list(backend, current_val, group, self._dtype)
-                        )
-                        continue
-                    # a locally-empty list still participates in the collective
-                    # (zero-length contribution) so ranks never diverge on the
-                    # number of collectives issued — a hang otherwise
-                    catted = dim_zero_cat(current_val) if current_val else jnp.zeros((0,), dtype=self._dtype)
-                    gathered = backend.all_gather(catted, group=group)
-                    merged = dim_zero_cat(gathered)
-                    object.__setattr__(self, attr, [merged] if merged.size else [])
-                elif op in ("sum", "mean", "max", "min"):
-                    object.__setattr__(self, attr, backend.all_reduce(current_val, op, group=group))
-                else:
-                    gathered = backend.all_gather(current_val, group=group)
-                    if op == "cat":
-                        object.__setattr__(self, attr, dim_zero_cat(gathered))
-                    elif reduction_fn is None:
-                        object.__setattr__(self, attr, jnp.stack(gathered))
-                    elif callable(reduction_fn):
-                        object.__setattr__(self, attr, reduction_fn(jnp.stack(gathered)))
-                    else:
-                        raise TypeError("reduction_fn must be callable or None")
+            # fused backend path: reduce-op states share ONE collective per
+            # (op, dtype) class via the FusedReducer — one branch ladder for
+            # both the stateful (here) and pure (sync_state) paths
+            from tpumetrics.parallel.fuse import FusedReducer
+
+            reducer = FusedReducer(backend, group=group)
+            current = {attr: getattr(self, attr) for attr in self._reductions}
+            out, pending = self._sync_state_collect(current, backend, reducer, group=group)
+            out.update(reducer.resolve(pending))
+            for attr, val in out.items():
+                object.__setattr__(self, attr, val)
             return
 
         # reference-faithful custom-gather path
@@ -735,34 +708,67 @@ class Metric(ABC):
     def sync_state(
         self, state: Dict[str, StateType], backend: DistributedBackend
     ) -> Dict[str, StateType]:
-        """Pure cross-rank merge of a state pytree using each state's reduce op."""
+        """Pure cross-rank merge of a state pytree using each state's reduce op.
+
+        All "sum"/"mean"/"max"/"min" states of one dtype travel as ONE fused
+        collective (:class:`tpumetrics.parallel.fuse.FusedReducer`) — the
+        collective count is per (op, dtype) class, not per state, unlike the
+        reference's one-gather-per-state wire (utilities/distributed.py:97-147).
+        """
+        from tpumetrics.parallel.fuse import FusedReducer
+
+        reducer = FusedReducer(backend)
+        out, pending = self._sync_state_collect(state, backend, reducer)
+        out.update(reducer.resolve(pending))
+        return out
+
+    def _sync_state_collect(
+        self,
+        state: Dict[str, StateType],
+        backend: DistributedBackend,
+        reducer: Any,
+        group: Optional[Any] = None,
+    ) -> Tuple[Dict[str, StateType], Dict[str, int]]:
+        """Phase 1 of a (possibly multi-metric) fused sync: gather-style
+        states sync immediately; reduce-style states register with the shared
+        ``reducer`` and resolve after its single ``flush``. Returns
+        ``(partial_out, attr -> reducer handle)``."""
         from tpumetrics.buffers import MaskedBuffer, buffer_all_gather
 
         out: Dict[str, StateType] = {}
+        pending: Dict[str, int] = {}
         for attr, reduction_fn in self._reductions.items():
             val = state[attr]
             op = _reduce_fn_to_op(reduction_fn)
             if isinstance(val, MaskedBuffer):
                 # one all_gather + static-shape compaction; uneven per-rank
                 # valid counts are handled by the mask, not by shape surgery
-                out[attr] = buffer_all_gather(val, backend)
+                out[attr] = buffer_all_gather(val, backend, group=group)
             elif isinstance(val, list):
                 if reduction_fn is None:
-                    out[attr] = _gather_ragged_list(backend, val, None, self._dtype)
+                    # ragged per-item list (e.g. per-image detection states):
+                    # item boundaries are part of the state and travel as a
+                    # shape matrix beside the flattened data (reference uses
+                    # all_gather_object, detection/mean_ap.py:994-1024)
+                    out[attr] = _gather_ragged_list(backend, val, group, self._dtype)
                     continue
-                # empty lists still issue the collective — see _sync_dist
+                # a locally-empty list still participates in the collective
+                # (zero-length contribution) so ranks never diverge on the
+                # number of collectives issued — a hang otherwise
                 catted = dim_zero_cat(val) if val else jnp.zeros((0,), dtype=self._dtype)
-                merged = dim_zero_cat(backend.all_gather(catted))
+                merged = dim_zero_cat(backend.all_gather(catted, group=group))
                 out[attr] = [merged] if merged.size else []
             elif op in ("sum", "mean", "max", "min"):
-                out[attr] = backend.all_reduce(val, op)
+                pending[attr] = reducer.add(val, op)
             elif op == "cat":
-                out[attr] = dim_zero_cat(backend.all_gather(val))
+                out[attr] = dim_zero_cat(backend.all_gather(val, group=group))
             elif reduction_fn is None:
-                out[attr] = jnp.stack(backend.all_gather(val))
+                out[attr] = jnp.stack(backend.all_gather(val, group=group))
+            elif callable(reduction_fn):
+                out[attr] = reduction_fn(jnp.stack(backend.all_gather(val, group=group)))
             else:
-                out[attr] = reduction_fn(jnp.stack(backend.all_gather(val)))
-        return out
+                raise TypeError("reduction_fn must be callable or None")
+        return out, pending
 
     # ------------------------------------------------------------------ reset
 
