@@ -1,0 +1,47 @@
+"""The bench regression-floor gate (VERDICT r4 weak #4) and accounting."""
+
+import json
+import os
+
+import bench
+
+
+def test_floor_file_shape():
+    path = os.path.join(os.path.dirname(bench.__file__), "bench_floors.json")
+    with open(path) as fh:
+        data = json.load(fh)
+    assert set(data["floors"]) == {
+        "headline",
+        "collection_sync_8dev",
+        "map_ragged_update_compute",
+        "fid_stream_update",
+        "lpips_stream_update",
+        "bertscore_ddp_eval",
+    }
+    # floors must sit below the recorded best (headroom for chip variance)
+    for name, floor in data["floors"].items():
+        assert floor < data["best_recorded"][name], name
+
+
+def test_check_floors_flags_regressions():
+    details = {
+        "collection_sync_8dev": {"vs_baseline": 1.0},  # below any floor
+        "fid_stream_update": {"vs_baseline": 1000.0},
+        "map_ragged_update_compute": "error: Boom",  # non-dict entries skipped
+    }
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("collection_sync_8dev" in v for v in violations)
+
+
+def test_check_floors_skips_missing_reference():
+    details = {"fid_stream_update": {"us": 1.0}}  # ref side failed: no ratio
+    assert bench._check_floors(headline_vs=None, details=details) == []
+
+
+def test_accounting_fields():
+    out = bench._accounting(
+        1000.0, flops_per_step=1e9, wire_bytes_per_step=1e6, on_accelerator=False
+    )
+    assert out["achieved_gflops"] == 1000.0  # 1e9 flops / 1e-3 s
+    assert out["achieved_gbps"] == 1.0
+    assert "mfu" not in out  # no peak claimed off-accelerator
