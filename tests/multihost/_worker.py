@@ -74,6 +74,31 @@ class ToyEmbedder:
         return self.table[ids]
 
 
+class ToyMLM:
+    """Deterministic masked LM with sequence-context mixing (the InfoLM
+    driver; mirrors tests/multimodal/test_model_metrics.py)."""
+
+    def __init__(self, vocab=100, seed=0):
+        import jax.numpy as jnp
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        self.table = jnp.asarray(rng.standard_normal((vocab, vocab)), jnp.float32)
+
+    def __call__(self, input_ids, attention_mask=None):
+        import jax.numpy as jnp
+
+        class _Out:
+            pass
+
+        ids = jnp.asarray(input_ids)
+        token_logits = self.table[ids]
+        context = token_logits.mean(axis=1, keepdims=True)
+        out = _Out()
+        out.logits = token_logits + 2.0 * context
+        return out
+
+
 # --------------------------------------------------------------- corpora
 # deterministic and rank-strided so the parent can recompute the union
 
@@ -237,6 +262,20 @@ def run_scenarios(rank: int, world: int) -> dict:
     results["metric_bertscore"] = {k: _tolist(out[k]) for k in ("precision", "recall", "f1")}
     # unsync must restore the local shard
     results["bertscore_local_after_compute"] = list(bs._preds)
+
+    # InfoLM: the other raw-sentence host state riding the object wire
+    from tpumetrics.text import InfoLM
+
+    il = InfoLM(
+        model=ToyMLM(),
+        user_tokenizer=WordTokenizer(),
+        information_measure="l1_distance",
+        idf=True,
+        verbose=False,
+    )
+    if preds:
+        il.update(list(preds), list(target))
+    results["metric_infolm"] = float(il.compute())
 
     # mAP: ragged per-image reduce-None list states via _gather_ragged_list
     dpreds, dtarget = detection_corpus()

@@ -1,0 +1,74 @@
+"""Distributed class tests for EVERY exported multimodal metric.
+
+Counterpart of the reference funneling all metric tests through its
+2-process pool (reference tests/unittests/conftest.py:28-63). Both CLIP
+metrics tokenize/process on host before the Flax forward, so their
+distributed surface is the reduce-op sum-state merge (emulated-DDP mode) —
+the same wire the eager DCN backend drives. A coverage gate fails when a
+new export lacks an entry.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tpumetrics.multimodal as mm_domain
+from tests.helpers.testers import run_ddp_self_equivalence_test
+from tests.multimodal.test_model_metrics import tiny_clip  # noqa: F401  (fixture)
+
+_rng = np.random.default_rng(41)
+
+_TEXTS = [
+    "a photo of a cat",
+    "a photo of a dog",
+    "a red house on a hill",
+    "two birds in the sky",
+    "a small blue car",
+    "an empty street at night",
+]
+
+
+def _image_text_batches(n_batches=4, per_batch=2):
+    out = []
+    for b in range(n_batches):
+        images = jnp.asarray(_rng.integers(0, 255, (per_batch, 3, 32, 32)), jnp.float32)
+        texts = [_TEXTS[(b * per_batch + i) % len(_TEXTS)] for i in range(per_batch)]
+        out.append((images, texts))
+    return out
+
+
+def _image_batches(n_batches=4, per_batch=2):
+    return [
+        (jnp.asarray(_rng.random((per_batch, 3, 32, 32)), jnp.float32),)
+        for _ in range(n_batches)
+    ]
+
+
+CASES = {
+    "CLIPScore": ("image_text", ("emulated",)),
+    "CLIPImageQualityAssessment": ("image", ("emulated",)),
+}
+
+
+def test_every_multimodal_class_has_a_distributed_case():
+    assert set(CASES) == set(mm_domain.__all__)
+
+
+def test_clip_score_distributed(tiny_clip):  # noqa: F811
+    run_ddp_self_equivalence_test(
+        lambda: mm_domain.CLIPScore(model_name_or_path=tiny_clip),
+        _image_text_batches(),
+        atol=1e-4,
+    )
+
+
+def test_clip_iqa_distributed(tiny_clip):  # noqa: F811
+    run_ddp_self_equivalence_test(
+        lambda: mm_domain.CLIPImageQualityAssessment(
+            model_name_or_path=tiny_clip, prompts=("quality", "sharpness")
+        ),
+        _image_batches(),
+        atol=1e-4,
+    )

@@ -276,6 +276,30 @@ def test_bertscore_sentence_state_merge(pool):
         assert res["bertscore_local_after_compute"] == list(local_preds)
 
 
+def test_infolm_sentence_state_merge(pool):
+    """InfoLM's raw-sentence host state rides the same object wire as
+    BERTScore: every rank's compute equals the union-corpus value."""
+    from tpumetrics.text import InfoLM
+
+    world, results = pool
+    preds_all, target_all = [], []
+    for r in range(world):
+        p, t = _worker.sentence_shard(r, world)
+        preds_all += p
+        target_all += t
+    full = InfoLM(
+        model=_worker.ToyMLM(),
+        user_tokenizer=_worker.WordTokenizer(),
+        information_measure="l1_distance",
+        idf=True,
+        verbose=False,
+    )
+    full.update(preds_all, target_all)
+    want = float(full.compute())
+    for res in results:
+        assert res["metric_infolm"] == pytest.approx(want, abs=1e-5)
+
+
 def test_map_ragged_states_gather(pool):
     from tpumetrics.detection import MeanAveragePrecision
 

@@ -1,0 +1,138 @@
+"""Distributed class tests for EVERY exported text metric.
+
+Counterpart of the reference funneling all metric tests through its
+2-process pool (reference tests/unittests/conftest.py:28-63). Text updates
+are host-side (string inputs can't enter jit), so the distributed surface is
+the reduce-op state merge the eager DCN backend applies — the emulated-DDP
+mode — except Perplexity (array inputs), which also runs the in-jit
+``shard_map`` ICI path. A coverage gate fails when a new export lacks an
+entry.
+
+BERTScore/InfoLM hold raw-sentence host states whose only legal distributed
+channel is the multi-host object wire: they are covered end-to-end by the
+real 2-process ``jax.distributed`` pool (tests/test_multihost.py — scenarios
+``metric_bertscore`` and ``metric_infolm``), which this file's coverage gate
+cross-checks by name.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tpumetrics.text as text_domain
+from tests.helpers.testers import (
+    run_ddp_self_equivalence_test,
+    run_shard_map_self_equivalence_test,
+)
+
+_rng = np.random.default_rng(23)
+_VOCAB = (
+    "the a cat dog sat ran fast slow on mat hill house tree bird sky blue red "
+    "big small jumps sleeps eats barks sings over under near far happy sad"
+).split()
+
+
+def _sentence(lo=3, hi=9):
+    return " ".join(_rng.choice(_VOCAB, size=_rng.integers(lo, hi)))
+
+
+def _corpus_batches(n_batches=4, per_batch=5):
+    """(preds, target) string-list batches; targets share words with preds so
+    n-gram/edit scores are informative, not degenerate."""
+    out = []
+    for _ in range(n_batches):
+        target = [_sentence() for _ in range(per_batch)]
+        preds = []
+        for t in target:
+            words = t.split()
+            if len(words) > 3 and _rng.random() < 0.7:
+                words[_rng.integers(0, len(words))] = str(_rng.choice(_VOCAB))
+            preds.append(" ".join(words))
+        out.append((preds, target))
+    return out
+
+
+def _multi_ref_batches(n_batches=4, per_batch=4):
+    """target = list of reference-lists per sample (BLEU-style)."""
+    out = []
+    for preds, target in _corpus_batches(n_batches, per_batch):
+        out.append((preds, [[t, _sentence()] for t in target]))
+    return out
+
+
+def _squad_batches(n_batches=4, per_batch=3):
+    out = []
+    uid = 0
+    for _ in range(n_batches):
+        preds, target = [], []
+        for _ in range(per_batch):
+            answer = _sentence(2, 5)
+            pred_text = answer if _rng.random() < 0.6 else _sentence(2, 5)
+            preds.append({"prediction_text": pred_text, "id": str(uid)})
+            target.append({"answers": {"answer_start": [0], "text": [answer]}, "id": str(uid)})
+            uid += 1
+        out.append((preds, target))
+    return out
+
+
+def _perplexity_batches(n_batches=4):
+    out = []
+    for _ in range(n_batches):
+        logits = jnp.asarray(_rng.standard_normal((3, 10, 8)), jnp.float32)
+        labels = jnp.asarray(_rng.integers(0, 8, (3, 10)), jnp.int32)
+        out.append((logits, labels))
+    return out
+
+
+# ---------------------------------------------------------------- cases
+# name -> (factory, batches builder, modes); "multihost" marks classes whose
+# distributed path is the real 2-process pool in tests/test_multihost.py
+
+CASES = {
+    "BLEUScore": (lambda: text_domain.BLEUScore(), _multi_ref_batches, ("emulated",)),
+    "SacreBLEUScore": (lambda: text_domain.SacreBLEUScore(), _multi_ref_batches, ("emulated",)),
+    "CHRFScore": (lambda: text_domain.CHRFScore(), _multi_ref_batches, ("emulated",)),
+    "CharErrorRate": (lambda: text_domain.CharErrorRate(), _corpus_batches, ("emulated",)),
+    "WordErrorRate": (lambda: text_domain.WordErrorRate(), _corpus_batches, ("emulated",)),
+    "MatchErrorRate": (lambda: text_domain.MatchErrorRate(), _corpus_batches, ("emulated",)),
+    "WordInfoLost": (lambda: text_domain.WordInfoLost(), _corpus_batches, ("emulated",)),
+    "WordInfoPreserved": (lambda: text_domain.WordInfoPreserved(), _corpus_batches, ("emulated",)),
+    "EditDistance": (lambda: text_domain.EditDistance(), _corpus_batches, ("emulated",)),
+    "ExtendedEditDistance": (lambda: text_domain.ExtendedEditDistance(), _corpus_batches, ("emulated",)),
+    "TranslationEditRate": (lambda: text_domain.TranslationEditRate(), _multi_ref_batches, ("emulated",)),
+    "ROUGEScore": (lambda: text_domain.ROUGEScore(), _corpus_batches, ("emulated",)),
+    "SQuAD": (lambda: text_domain.SQuAD(), _squad_batches, ("emulated",)),
+    "Perplexity": (lambda: text_domain.Perplexity(), _perplexity_batches, ("emulated", "shard_map")),
+    "BERTScore": (None, None, ("multihost",)),
+    "InfoLM": (None, None, ("multihost",)),
+}
+
+
+def test_every_text_class_has_a_distributed_case():
+    assert set(CASES) == set(text_domain.__all__)
+
+
+def test_multihost_marked_classes_are_in_the_pool():
+    """The classes deferred to the real process pool must actually appear
+    there — the annotation may not rot."""
+    import pathlib
+
+    worker = pathlib.Path(__file__).parents[1] / "multihost" / "_worker.py"
+    src = worker.read_text()
+    for name, (_, _, modes) in CASES.items():
+        if modes == ("multihost",):
+            assert name in src, f"{name} marked multihost but absent from the pool worker"
+
+
+@pytest.mark.parametrize(
+    "name", sorted(n for n, (_, _, modes) in CASES.items() if "multihost" not in modes)
+)
+def test_text_distributed(name):
+    factory, data, modes = CASES[name]
+    batches = data()
+    if "emulated" in modes:
+        run_ddp_self_equivalence_test(factory, batches, atol=1e-6)
+    if "shard_map" in modes:
+        run_shard_map_self_equivalence_test(factory, batches, atol=1e-4)
