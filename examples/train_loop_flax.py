@@ -9,6 +9,14 @@ device, shards with the data, and syncs over the mesh axis in-trace: no
 host round-trips in the hot loop, which is the TPU-first redesign of the
 reference's module-state + hook pattern.
 
+The metric state rides with an EXPLICIT leading device axis
+(``in/out_specs=P("dp")``, shape ``(n_dev, ...)`` outside the mesh): each
+device accumulates its own shard, and the epoch-end compute psums over the
+axis. A falsely-replicated ``P()`` carry happens to work in-loop (buffers
+stay per-device) but a checkpoint of it would save only device 0's partial
+state — the device-axis layout is what makes ``orbax`` checkpoint/resume
+exact (see tests/test_lifecycle.py, which pins this pattern end-to-end).
+
 Run (any machine; forces an 8-device CPU mesh when no 8-chip TPU exists):
     python examples/train_loop_flax.py
 """
@@ -95,38 +103,46 @@ def main():
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
 
-        # metric accumulation is part of the same compiled program
-        cls_state, loss_state = metric_state
+        # metric accumulation is part of the same compiled program; the
+        # state arrives as this device's (1, ...) slice of the device axis
+        cls_state, loss_state = jax.tree.map(lambda a: a[0], metric_state)
         cls_state = metrics.functional_update(cls_state, logits, y)
         loss_state = loss_metric.functional_update(loss_state, loss)
-        return params, opt_state, (cls_state, loss_state), loss
+        new_state = jax.tree.map(lambda a: a[None], (cls_state, loss_state))
+        return params, opt_state, new_state, loss
 
     step = jax.jit(
         jax.shard_map(
             train_step,
             mesh=mesh,
-            in_specs=(P(), P(), P(), P("dp"), P("dp")),
-            out_specs=(P(), P(), P(), P()),
+            in_specs=(P(), P(), P("dp"), P("dp"), P("dp")),
+            out_specs=(P(), P(), P("dp"), P()),
             check_vma=False,
         ),
         donate_argnums=(2,),
     )
 
+    def init_metric_state():
+        """Per-device zeros stacked on the leading device axis — the
+        checkpointable layout (every shard saved, not just device 0's)."""
+        zero = (metrics.init_state(), loss_metric.init_state())
+        return jax.tree.map(lambda a: jnp.broadcast_to(a[None], (n_dev,) + a.shape), zero)
+
     # epoch-end compute syncs the sharded metric state over the mesh axis
     @jax.jit
     def epoch_compute(metric_state):
         def _compute(state):
-            cls_state, loss_state = state
+            cls_state, loss_state = jax.tree.map(lambda a: a[0], state)
             vals = metrics.functional_compute(cls_state, axis_name="dp")
             vals["loss"] = loss_metric.functional_compute(loss_state, axis_name="dp")
             return vals
 
         return jax.shard_map(
-            _compute, mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False
+            _compute, mesh=mesh, in_specs=(P("dp"),), out_specs=P(), check_vma=False
         )(metric_state)
 
     for epoch in range(EPOCHS):
-        metric_state = (metrics.init_state(), loss_metric.init_state())
+        metric_state = init_metric_state()
         for i in range(STEPS_PER_EPOCH):
             lo = i * BATCH
             x, y = x_all[lo : lo + BATCH], y_all[lo : lo + BATCH]
