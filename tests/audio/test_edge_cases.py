@@ -1,0 +1,132 @@
+"""Audio edge cases: silence, DC-only, length-1 signals, degenerate PIT
+(counterpart of the reference's per-file edge parametrizations in
+tests/unittests/audio/).
+
+Every expectation is computed from the REFERENCE's formula (eps-guarded
+ratios, reference functional/audio/snr.py:52-61, sdr.py:227-241) in numpy,
+so any divergence from the reference's degenerate-input behavior fails
+loudly rather than drifting.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics.audio import ScaleInvariantSignalNoiseRatio, SignalNoiseRatio
+from tpumetrics.functional.audio import (
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_noise_ratio,
+    speech_reverberation_modulation_energy_ratio,
+)
+
+EPS = float(np.finfo(np.float32).eps)
+
+
+def _ref_snr(preds, target, zero_mean=False):
+    preds = np.asarray(preds, np.float64)
+    target = np.asarray(target, np.float64)
+    if zero_mean:
+        preds = preds - preds.mean(-1, keepdims=True)
+        target = target - target.mean(-1, keepdims=True)
+    noise = target - preds
+    return 10 * np.log10(((target**2).sum(-1) + EPS) / ((noise**2).sum(-1) + EPS))
+
+
+def test_silence_both_sides():
+    """All-zero preds and target: eps/eps ratio -> exactly 0 dB, not NaN."""
+    z = jnp.zeros((3, 64))
+    np.testing.assert_allclose(np.asarray(signal_noise_ratio(z, z)), 0.0, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(scale_invariant_signal_noise_ratio(z, z)), 0.0, atol=1e-6
+    )
+
+
+def test_identical_signals_hit_the_eps_ceiling():
+    """Zero noise: the eps guard caps SNR at 10*log10((E+eps)/eps) — finite,
+    matching the reference formula to float32 tolerance."""
+    rng = np.random.default_rng(0)
+    s = rng.standard_normal((2, 128)).astype(np.float32)
+    got = np.asarray(signal_noise_ratio(jnp.asarray(s), jnp.asarray(s)))
+    want = _ref_snr(s, s)
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+    assert np.all(np.isfinite(got)) and np.all(got > 50)
+
+
+def test_silent_target_noisy_pred():
+    """Zero target with non-zero pred: large NEGATIVE dB (noise dominates),
+    never -inf/NaN."""
+    rng = np.random.default_rng(1)
+    p = rng.standard_normal((2, 128)).astype(np.float32)
+    z = np.zeros_like(p)
+    got = np.asarray(signal_noise_ratio(jnp.asarray(p), jnp.asarray(z)))
+    np.testing.assert_allclose(got, _ref_snr(p, z), rtol=1e-4)
+    assert np.all(np.isfinite(got)) and np.all(got < -50)
+
+
+def test_dc_only_signal_with_zero_mean():
+    """A pure-DC signal is annihilated by zero_mean: both sides become
+    silence -> 0 dB (eps/eps), not NaN."""
+    dc = jnp.full((2, 32), 3.0)
+    got = np.asarray(scale_invariant_signal_distortion_ratio(dc, dc, zero_mean=True))
+    np.testing.assert_allclose(got, 0.0, atol=1e-6)
+    # without zero_mean the DC energy is real signal: eps ceiling again
+    got2 = np.asarray(scale_invariant_signal_distortion_ratio(dc, dc, zero_mean=False))
+    assert np.all(np.isfinite(got2)) and np.all(got2 > 50)
+
+
+def test_length_one_signals():
+    """T=1: SI-SNR's zero-mean projection zeroes everything -> 0 dB; plain
+    SNR follows the eps-guarded formula."""
+    one = jnp.ones((3, 1))
+    np.testing.assert_allclose(
+        np.asarray(scale_invariant_signal_distortion_ratio(one, one, zero_mean=True)), 0.0, atol=1e-6
+    )
+    got = np.asarray(signal_noise_ratio(one, one))
+    np.testing.assert_allclose(got, _ref_snr(np.ones((3, 1)), np.ones((3, 1))), rtol=1e-4)
+
+
+def test_class_metrics_survive_degenerate_batches():
+    """Streaming silence + identical batches through the class API yields the
+    running mean of the per-batch formula values (no NaN poisoning)."""
+    rng = np.random.default_rng(2)
+    s = rng.standard_normal((2, 64)).astype(np.float32)
+    z = np.zeros_like(s)
+    m = SignalNoiseRatio()
+    m.update(jnp.asarray(s), jnp.asarray(s))
+    m.update(jnp.asarray(z), jnp.asarray(z))
+    want = float(np.concatenate([_ref_snr(s, s), _ref_snr(z, z)]).mean())
+    np.testing.assert_allclose(float(m.compute()), want, rtol=1e-4)
+
+    m2 = ScaleInvariantSignalNoiseRatio()
+    m2.update(jnp.zeros((1, 16)), jnp.zeros((1, 16)))
+    assert np.isfinite(float(m2.compute()))
+
+
+def test_pit_with_identical_speakers_is_deterministic():
+    """All speakers identical: every permutation scores the same; PIT must
+    return that score (ties can't produce NaN or nondeterminism)."""
+    from tpumetrics.functional.audio import permutation_invariant_training
+
+    rng = np.random.default_rng(3)
+    spk = rng.standard_normal((1, 1, 64)).astype(np.float32)
+    preds = jnp.asarray(np.repeat(spk, 2, axis=1))
+    target = preds
+    best1, perm1 = permutation_invariant_training(
+        preds, target, scale_invariant_signal_noise_ratio
+    )
+    best2, perm2 = permutation_invariant_training(
+        preds, target, scale_invariant_signal_noise_ratio
+    )
+    np.testing.assert_array_equal(np.asarray(best1), np.asarray(best2))
+    np.testing.assert_array_equal(np.asarray(perm1), np.asarray(perm2))
+    assert np.all(np.isfinite(np.asarray(best1)))
+
+
+def test_srmr_rejects_too_short_signals():
+    """Sub-window input fails loudly with the actionable minimum, instead of
+    returning a garbage modulation ratio."""
+    with pytest.raises((ValueError, RuntimeError)):
+        speech_reverberation_modulation_energy_ratio(jnp.ones((8,)), 8000)

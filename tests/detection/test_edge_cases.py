@@ -1,0 +1,154 @@
+"""Detection edge-case matrix: empty preds / empty gt / both, zero-area
+boxes, and score ties (counterpart of the reference's empty-case blocks in
+tests/unittests/detection/test_map.py).
+
+COCO conventions pinned here: categories with no ground truth are EXCLUDED
+from averaging — a corpus with no gt at all yields -1 sentinels (the
+reference's pycocotools convention); false positives against real gt drive
+precision down, not to a sentinel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics.detection import (
+    CompleteIntersectionOverUnion,
+    DistanceIntersectionOverUnion,
+    GeneralizedIntersectionOverUnion,
+    IntersectionOverUnion,
+    MeanAveragePrecision,
+)
+
+_rng = np.random.default_rng(53)
+
+
+def _img(boxes, labels, scores=None):
+    d = {
+        "boxes": jnp.asarray(np.asarray(boxes, np.float32).reshape(-1, 4)),
+        "labels": jnp.asarray(np.asarray(labels, np.int64).reshape(-1)),
+    }
+    if scores is not None:
+        d["scores"] = jnp.asarray(np.asarray(scores, np.float32).reshape(-1))
+    return d
+
+
+_EMPTY_P = _img(np.zeros((0, 4)), [], [])
+_EMPTY_T = _img(np.zeros((0, 4)), [])
+_BOX_P = _img([[10, 10, 30, 30]], [0], [0.9])
+_BOX_T = _img([[10, 10, 30, 30]], [0])
+
+
+# ------------------------------------------------------------------- mAP
+
+
+def test_map_empty_matrix():
+    """(empty preds, gt) -> 0; (preds, empty gt) and (both empty) -> -1
+    sentinels (no gt categories to average over)."""
+    m = MeanAveragePrecision()
+    m.update([_EMPTY_P], [_BOX_T])
+    assert float(np.asarray(m.compute()["map"]).reshape(-1)[0]) == pytest.approx(0.0, abs=1e-6)
+
+    m = MeanAveragePrecision()
+    m.update([_BOX_P], [_EMPTY_T])
+    assert float(np.asarray(m.compute()["map"]).reshape(-1)[0]) == -1.0
+
+    m = MeanAveragePrecision()
+    m.update([_EMPTY_P], [_EMPTY_T])
+    res = m.compute()
+    assert float(np.asarray(res["map"]).reshape(-1)[0]) == -1.0
+    assert float(np.asarray(res["mar_100"]).reshape(-1)[0]) == -1.0
+
+
+def test_map_empty_image_mixed_into_corpus():
+    """An all-empty image must not disturb the other images' scores, and a
+    false-positive-only image must lower precision (not flip to sentinel)."""
+    m = MeanAveragePrecision()
+    m.update([_BOX_P, _EMPTY_P], [_BOX_T, _EMPTY_T])
+    perfect = float(np.asarray(m.compute()["map"]).reshape(-1)[0])
+    assert perfect == pytest.approx(1.0, abs=1e-6)
+
+    m2 = MeanAveragePrecision()
+    # same but the second image has a spurious detection with a HIGHER score
+    # than the true positive: precision at the top of the ranking drops
+    m2.update([_BOX_P, _img([[50, 50, 70, 70]], [0], [0.95])], [_BOX_T, _EMPTY_T])
+    fp = float(np.asarray(m2.compute()["map"]).reshape(-1)[0])
+    assert 0.0 < fp < perfect
+
+
+def test_map_zero_area_boxes():
+    """Degenerate (zero-area) gt can only be matched by IoU 0 — a zero-area
+    pred at the same spot does not crash and yields a well-defined score; a
+    zero-area pred against real gt is just a false positive."""
+    degen = [[20.0, 20, 20, 20]]
+    m = MeanAveragePrecision()
+    m.update([_img(degen, [0], [0.8])], [_img(degen, [0])])
+    res = m.compute()
+    assert np.isfinite(float(np.asarray(res["map"]).reshape(-1)[0]))
+
+    m2 = MeanAveragePrecision()
+    m2.update([_img([[10, 10, 30, 30], [40.0, 40, 40, 40]], [0, 0], [0.9, 0.95])], [_BOX_T])
+    val = float(np.asarray(m2.compute()["map"]).reshape(-1)[0])
+    assert 0.0 < val <= 1.0 and np.isfinite(val)
+
+
+def test_map_score_ties_are_deterministic():
+    """Equal-score detections: repeated computes agree exactly, and the
+    result stays finite/sane (COCO's stable ordering semantics)."""
+    preds = [
+        _img(
+            [[10, 10, 30, 30], [11, 11, 31, 31], [50, 50, 70, 70]],
+            [0, 0, 0],
+            [0.5, 0.5, 0.5],
+        )
+    ]
+    target = [_img([[10, 10, 30, 30]], [0])]
+    m = MeanAveragePrecision()
+    m.update(preds, target)
+    r1 = {k: np.asarray(v) for k, v in m.compute().items()}
+    m2 = MeanAveragePrecision()
+    m2.update(preds, target)
+    r2 = {k: np.asarray(v) for k, v in m2.compute().items()}
+    for k in r1:
+        np.testing.assert_array_equal(r1[k], r2[k], err_msg=k)
+    assert 0.0 < float(r1["map"].reshape(-1)[0]) <= 1.0
+
+
+# ------------------------------------------------------------- IoU family
+
+
+@pytest.mark.parametrize(
+    "cls", [IntersectionOverUnion, GeneralizedIntersectionOverUnion,
+            DistanceIntersectionOverUnion, CompleteIntersectionOverUnion]
+)
+def test_iou_family_empty_matrix(cls):
+    """Empty preds, empty gt, and both: compute stays finite and the metric
+    key exists (the reference returns 0 for no-pair corpora)."""
+    for preds, target in (
+        ([_EMPTY_P], [_BOX_T]),
+        ([_BOX_P], [_EMPTY_T]),
+        ([_EMPTY_P], [_EMPTY_T]),
+    ):
+        m = cls()
+        m.update(
+            [{k: v for k, v in p.items() if k != "scores"} for p in preds], target
+        )
+        res = m.compute()
+        assert res, "compute returned nothing"
+        for v in res.values():
+            assert np.all(np.isfinite(np.asarray(v))), cls.__name__
+
+
+@pytest.mark.parametrize(
+    "cls", [IntersectionOverUnion, GeneralizedIntersectionOverUnion,
+            DistanceIntersectionOverUnion, CompleteIntersectionOverUnion]
+)
+def test_iou_family_zero_area_boxes(cls):
+    """Zero-area boxes produce finite scores (union/enclosure guards)."""
+    degen = [[20.0, 20, 20, 20]]
+    m = cls()
+    m.update([{k: v for k, v in _img(degen, [0]).items()}], [_img(degen, [0])])
+    for v in m.compute().values():
+        assert np.all(np.isfinite(np.asarray(v))), cls.__name__

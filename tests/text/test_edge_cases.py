@@ -148,3 +148,53 @@ def test_rouge_lsum_vs_rouge_score_newline_convention(recwarn):
     rouge_score(preds, target, rouge_keys=("rougeLsum",))
     after = len([w for w in recwarn.list if "punkt" in str(w.message)])
     assert after == before
+
+
+def test_length_mismatch_policies():
+    """Pred/target length-mismatch matrix (VERDICT r5 edge matrix):
+
+    - error-rate family RAISES (deliberate deviation: the reference's
+      ``zip`` silently drops the unmatched tail, reference
+      functional/text/wer.py:44-48 — documented in
+      docs/migrating_from_torchmetrics.md);
+    - BLEU/TER raise exactly like the reference ("Corpus has different
+      size");
+    - ROUGE keeps the reference's zip-truncation semantics verbatim.
+    """
+    from tpumetrics.functional.text import translation_edit_rate
+
+    with pytest.raises(ValueError, match="same length"):
+        word_error_rate(["a"], ["a", "b"])
+    with pytest.raises(ValueError, match="same length"):
+        char_error_rate(["a"], ["a", "b"])
+    with pytest.raises(ValueError, match="different size"):
+        bleu_score(["a"], [["a"], ["b"]])
+    with pytest.raises(ValueError, match="different size"):
+        translation_edit_rate(["a"], [["a"], ["b"]])
+    # rouge: reference zips — the extra target is ignored, same as reference
+    same = rouge_score(["the cat"], ["the cat"])
+    truncated = rouge_score(["the cat"], ["the cat", "ignored extra"])
+    assert float(truncated["rouge1_fmeasure"]) == float(same["rouge1_fmeasure"])
+
+
+def test_empty_string_matrix():
+    """Empty preds vs empty targets vs both, across score families."""
+    # both empty: zero errors over zero reference chars -> NaN, exactly the
+    # reference's 0/0 (verified against the mounted reference)
+    assert np.isnan(float(char_error_rate([""], [""])))
+    assert np.isnan(float(word_error_rate([""], [""])))
+    assert float(edit_distance([""], [""])) == 0.0
+    # empty target with non-empty pred: all insertions
+    assert float(edit_distance(["abc"], [""])) == 3.0
+    out = rouge_score([""], ["the cat"])
+    assert float(out["rouge1_fmeasure"]) == 0.0
+    out = rouge_score(["the cat"], [""])
+    assert float(out["rouge1_fmeasure"]) == 0.0
+
+
+def test_unicode_beyond_latin():
+    """Multibyte scripts and emoji count as characters, not bytes."""
+    assert float(char_error_rate(["日本語"], ["日本語"])) == 0.0
+    assert float(edit_distance(["日本語"], ["日本誤"])) == 1.0
+    assert float(edit_distance(["🙂🙃"], ["🙂"])) == 1.0
+    assert float(word_error_rate(["héllo wörld"], ["héllo wörld"])) == 0.0
