@@ -79,24 +79,24 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
     def update(self, img1: Array, img2: Array) -> None:
         """Accumulate LPIPS sums (reference lpip.py:139-145).
 
-        The per-batch distance is computed under ONE jit call (cached per
-        input shape): eagerly, the backbone + normalize/diff/average chain is
-        dozens of dispatches, each a full round trip on a remote-attached
-        accelerator."""
+        The whole update — backbone + normalize/diff/average chain AND the
+        state accumulation — is ONE jit call (cached per input shape):
+        eagerly this is dozens of dispatches, and even with a jitted loss the
+        two scalar state adds would be two extra enqueues per step on a
+        remote-attached accelerator."""
         if self._jit_loss is None:
             net, weights, normalize = self.net, self.layer_weights, self.normalize
 
-            def loss_fn(a, b):
-                return learned_perceptual_image_patch_similarity(
+            def step_fn(sum_scores, total, a, b):
+                loss = learned_perceptual_image_patch_similarity(
                     a, b, net, weights, normalize, reduction="sum"
                 )
+                return sum_scores + loss, total + a.shape[0]
 
             from tpumetrics.utils.jit_fallback import JitWithEagerFallback
 
-            self._jit_loss = JitWithEagerFallback(loss_fn, "The LPIPS backbone")
-        loss = self._jit_loss(img1, img2)
-        self.sum_scores = self.sum_scores + loss
-        self.total = self.total + img1.shape[0]
+            self._jit_loss = JitWithEagerFallback(step_fn, "The LPIPS backbone")
+        self.sum_scores, self.total = self._jit_loss(self.sum_scores, self.total, img1, img2)
 
     def compute(self) -> Array:
         """Reduced LPIPS (reference lpip.py:147-152)."""
