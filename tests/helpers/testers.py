@@ -327,8 +327,14 @@ def run_shard_map_self_equivalence_test(
     result = fn(*args)
 
     reference = metric_factory()
-    for batch in update_batches:
-        reference.update(*batch)
+    # rank order: the mesh gather concatenates rank blocks, so order-sensitive
+    # (cat) states see batches r, r+ws, ... per rank — feed the reference the
+    # same sequence (order-insensitive reduce states are unaffected)
+    rank_order = [
+        i for r in range(world_size) for i in range(r, len(update_batches), world_size)
+    ]
+    for i in rank_order:
+        reference.update(*update_batches[i])
     _assert_allclose(result, np_tree(reference.compute()), atol=atol)
 
 

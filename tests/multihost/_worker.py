@@ -277,6 +277,23 @@ def run_scenarios(rank: int, world: int) -> dict:
         il.update(list(preds), list(target))
     results["metric_infolm"] = float(il.compute())
 
+    # wrapper metrics: children own sync — each child self-syncs over the
+    # ambient MultiHostBackend at compute (wrappers/abstract.py design)
+    from tpumetrics.regression import MeanSquaredError
+    from tpumetrics.wrappers import MultitaskWrapper
+
+    mt = MultitaskWrapper(
+        {
+            "cls": MulticlassAccuracy(num_classes=7, average="micro"),
+            "reg": MeanSquaredError(),
+        }
+    )
+    mt.update(
+        {"cls": jnp.asarray(logits), "reg": jnp.asarray(logits[:, 0])},
+        {"cls": jnp.asarray(labels), "reg": jnp.asarray(logits[:, 1])},
+    )
+    results["metric_multitask"] = {k: float(v) for k, v in mt.compute().items()}
+
     # mAP: ragged per-image reduce-None list states via _gather_ragged_list
     dpreds, dtarget = detection_corpus()
     mp = MeanAveragePrecision(iou_type="bbox")

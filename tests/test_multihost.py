@@ -276,6 +276,34 @@ def test_bertscore_sentence_state_merge(pool):
         assert res["bertscore_local_after_compute"] == list(local_preds)
 
 
+def test_multitask_wrapper_child_self_sync(pool):
+    """Wrapper children sync THEMSELVES over the ambient backend at compute:
+    every rank's MultitaskWrapper result equals the union-data values."""
+    import jax.numpy as jnp2
+
+    from tpumetrics.classification import MulticlassAccuracy
+    from tpumetrics.regression import MeanSquaredError
+    from tpumetrics.wrappers import MultitaskWrapper
+
+    world, results = pool
+    mt = MultitaskWrapper(
+        {
+            "cls": MulticlassAccuracy(num_classes=7, average="micro"),
+            "reg": MeanSquaredError(),
+        }
+    )
+    for r in range(world):
+        logits, labels = _worker.classification_shard(r, world)
+        mt.update(
+            {"cls": jnp2.asarray(logits), "reg": jnp2.asarray(logits[:, 0])},
+            {"cls": jnp2.asarray(labels), "reg": jnp2.asarray(logits[:, 1])},
+        )
+    want = {k: float(v) for k, v in mt.compute().items()}
+    for res in results:
+        for k, v in want.items():
+            assert res["metric_multitask"][k] == pytest.approx(v, abs=1e-5), k
+
+
 def test_infolm_sentence_state_merge(pool):
     """InfoLM's raw-sentence host state rides the same object wire as
     BERTScore: every rank's compute equals the union-corpus value."""

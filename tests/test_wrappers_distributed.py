@@ -1,0 +1,192 @@
+"""Distributed equivalence for aggregation metrics and wrappers.
+
+Complements the per-domain ``test_distributed.py`` gates: every
+partition-independent aggregator/wrapper goes through the emulated-DDP
+merge (rank-strided replicas == one metric on the union), and the
+aggregators additionally through in-jit ``shard_map`` collectives.
+
+Deliberately NOT here, with the reason (they are order/partition-dependent
+BY DESIGN, so rank-strided == sequential does not hold and the reference
+makes the same call):
+
+- ``Running`` / ``RunningMean`` / ``RunningSum``: windowed over the last N
+  *local* updates.
+- ``MinMaxMetric``: tracks extrema of per-step compute values, which depend
+  on the update partition.
+- ``BootStrapper``: per-update resampling draws differ per replica.
+- ``MetricTracker``: bookkeeping over compute() calls, not a streaming
+  metric state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.helpers.testers import (
+    run_ddp_self_equivalence_test,
+    run_shard_map_self_equivalence_test,
+)
+from tpumetrics.parallel.merge import merge_metric_states
+from tpumetrics.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+from tpumetrics.classification import BinaryF1Score, MulticlassAccuracy, MulticlassPrecision
+from tpumetrics.regression import MeanSquaredError, R2Score
+from tpumetrics.wrappers import ClasswiseWrapper, MultioutputWrapper, MultitaskWrapper
+
+_rng = np.random.default_rng(61)
+
+
+def _scalar_batches(n=4):
+    return [(jnp.asarray(_rng.standard_normal(8), jnp.float32),) for _ in range(n)]
+
+
+@pytest.mark.parametrize("cls", [MaxMetric, MinMetric, SumMetric, MeanMetric, CatMetric])
+def test_aggregation_distributed(cls):
+    batches = _scalar_batches()
+    run_ddp_self_equivalence_test(lambda: cls(), batches)
+    run_shard_map_self_equivalence_test(lambda: cls(), batches)
+
+
+def test_mean_metric_weighted_distributed():
+    batches = [
+        (
+            jnp.asarray(_rng.standard_normal(8), jnp.float32),
+            jnp.asarray(_rng.uniform(0.1, 2.0, 8), jnp.float32),
+        )
+        for _ in range(4)
+    ]
+    run_ddp_self_equivalence_test(lambda: MeanMetric(), batches)
+    run_shard_map_self_equivalence_test(lambda: MeanMetric(), batches)
+
+
+def _cls_batches(n=4, b=32, c=4):
+    return [
+        (
+            jnp.asarray(_rng.standard_normal((b, c)), jnp.float32),
+            jnp.asarray(_rng.integers(0, c, b), jnp.int32),
+        )
+        for _ in range(n)
+    ]
+
+
+# --------------------------------------------------------------- wrappers
+#
+# Wrappers hold CHILD metrics that own their own states and sync (the
+# reference's design: each child syncs itself at compute, wrappers/abstract).
+# The distributed guarantee is therefore tested at the child-state level:
+# rank-strided wrapper replicas, each replica's children merged pairwise
+# with the wire reduce-ops, merged states loaded back, wrapper-level
+# compute == one wrapper on the union.  The real cross-process analogue
+# (children self-syncing over the ambient MultiHostBackend) runs in the
+# 2-process pool: tests/test_multihost.py multitask scenario.
+
+
+def _load_state(metric, state):
+    for k, v in state.items():
+        object.__setattr__(metric, k, v)
+
+
+def _merge_children(replicas, get_children):
+    """Merge each child position across replicas and load into replica 0."""
+    child_lists = [get_children(r) for r in replicas]
+    for children in zip(*child_lists):
+        merged = merge_metric_states(
+            [c.metric_state() for c in children], children[0]._reductions
+        )
+        _load_state(children[0], merged)
+    return replicas[0]
+
+
+def _wrapper_ddp_test(factory, batches, get_children, world_size=2, atol=1e-6):
+    replicas = [factory() for _ in range(world_size)]
+    for rank, m in enumerate(replicas):
+        for i in range(rank, len(batches), world_size):
+            m.update(*batches[i])
+    merged_wrapper = _merge_children(replicas, get_children)
+    result = merged_wrapper.compute()
+
+    reference = factory()
+    for r in range(world_size):
+        for i in range(r, len(batches), world_size):
+            reference.update(*batches[i])
+    want = reference.compute()
+    got_leaves = jax.tree.leaves(jax.tree.map(np.asarray, result))
+    want_leaves = jax.tree.leaves(jax.tree.map(np.asarray, want))
+    assert len(got_leaves) == len(want_leaves) and got_leaves
+    for g, w in zip(got_leaves, want_leaves):
+        np.testing.assert_allclose(g, w, atol=atol)
+
+
+def test_classwise_wrapper_distributed():
+    _wrapper_ddp_test(
+        lambda: ClasswiseWrapper(MulticlassPrecision(num_classes=4, average=None, validate_args=False)),
+        _cls_batches(),
+        get_children=lambda w: [w.metric],
+    )
+
+
+def test_multioutput_wrapper_distributed():
+    batches = [
+        (
+            jnp.asarray(_rng.standard_normal((16, 3)), jnp.float32),
+            jnp.asarray(_rng.standard_normal((16, 3)), jnp.float32),
+        )
+        for _ in range(4)
+    ]
+    _wrapper_ddp_test(
+        lambda: MultioutputWrapper(MeanSquaredError(), num_outputs=3),
+        batches,
+        get_children=lambda w: list(w.metrics),
+    )
+
+
+def test_multitask_wrapper_distributed():
+    batches = [
+        (
+            {
+                "cls": jnp.asarray(_rng.uniform(0, 1, 16), jnp.float32),
+                "reg": jnp.asarray(_rng.standard_normal(16), jnp.float32),
+            },
+            {
+                "cls": jnp.asarray(_rng.integers(0, 2, 16), jnp.int32),
+                "reg": jnp.asarray(_rng.standard_normal(16), jnp.float32),
+            },
+        )
+        for _ in range(4)
+    ]
+    _wrapper_ddp_test(
+        lambda: MultitaskWrapper({"cls": BinaryF1Score(validate_args=False), "reg": MeanSquaredError()}),
+        batches,
+        get_children=lambda w: [w.task_metrics[k] for k in sorted(w.task_metrics)],
+    )
+
+
+def test_compositional_metric_distributed():
+    """An operator composition syncs through its children's states."""
+
+    def factory():
+        acc = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        return 2 * acc  # CompositionalMetric
+
+    def children(comp):
+        from tpumetrics.metric import Metric
+
+        return [m for m in (comp.metric_a, comp.metric_b) if isinstance(m, Metric)]
+
+    _wrapper_ddp_test(factory, _cls_batches(), get_children=children)
+
+
+def test_r2score_distributed():
+    """Parallel-moment merge under the generic harness (R2's states are
+    running moments, the classic nontrivial DDP merge)."""
+    batches = [
+        (
+            jnp.asarray(_rng.standard_normal(32), jnp.float32),
+            jnp.asarray(_rng.standard_normal(32), jnp.float32),
+        )
+        for _ in range(4)
+    ]
+    run_ddp_self_equivalence_test(lambda: R2Score(), batches, atol=1e-4)
+    run_shard_map_self_equivalence_test(lambda: R2Score(), batches, atol=1e-4)
