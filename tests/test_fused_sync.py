@@ -184,6 +184,120 @@ def test_collection_sync_hlo_has_one_collective_per_class():
     )
 
 
+class _CountingEagerBackend:
+    """World-size-1 'distributed' backend that counts wire ops: identity
+    semantics keep values intact while the call log exposes the schedule."""
+
+    def __init__(self):
+        self.reduce_calls = []
+        self.gather_calls = 0
+
+    def available(self):
+        return True
+
+    def world_size(self):
+        return 1
+
+    def all_gather(self, x, group=None):
+        self.gather_calls += 1
+        return [x]
+
+    def all_reduce(self, x, op, group=None):
+        self.reduce_calls.append((op, str(x.dtype), x.size))
+        return x
+
+    def all_gather_object(self, obj, group=None):
+        self.gather_calls += 1
+        return [obj]
+
+
+def test_eager_collection_compute_fuses_across_metrics():
+    """MetricCollection.compute() pre-syncs ALL members through one shared
+    reducer: the wire sees one all_reduce per (op, dtype) class for the
+    whole collection, not one sync round per metric — and values, unsync
+    restoration, and recompute-after-update still behave."""
+    from tpumetrics.parallel.backend import set_default_backend
+
+    C = 7
+    preds, target = _data(C)
+    col = _collection(C)
+    col.update(preds, target)
+
+    want = {k: np.asarray(v) for k, v in col.compute().items()}  # pre-distributed
+
+    be = _CountingEagerBackend()
+    set_default_backend(be)
+    try:
+        for m in col.values():
+            m._computed = None  # force recompute under the counting backend
+        got = col.compute()
+        classes = {(op, dt) for op, dt, _ in be.reduce_calls}
+        assert len(be.reduce_calls) == len(classes), (
+            f"eager collection sync not fused: {be.reduce_calls}"
+        )
+        assert 1 <= len(classes) <= 3
+        for k, v in want.items():
+            np.testing.assert_allclose(np.asarray(got[k]), v, atol=1e-6, err_msg=k)
+        # unsync restored local state: a second compute round-trips identically
+        for m in col.values():
+            m._computed = None
+            assert not m._is_synced
+        got2 = col.compute()
+        for k, v in want.items():
+            np.testing.assert_allclose(np.asarray(got2[k]), v, atol=1e-6, err_msg=k)
+    finally:
+        set_default_backend(None)
+
+
+def test_compositional_metric_syncs_under_distributed_backend():
+    """CompositionalMetric's no-op _sync_dist must accept the deferred-sync
+    signature (regression: TypeError on any distributed compute)."""
+    from tpumetrics.aggregation import SumMetric
+    from tpumetrics.parallel.backend import set_default_backend
+
+    be = _CountingEagerBackend()
+    set_default_backend(be)
+    try:
+        c = SumMetric() + SumMetric()
+        c.update(jnp.asarray([1.0, 2.0]))
+        assert float(c.compute()) == pytest.approx(6.0)
+    finally:
+        set_default_backend(None)
+
+
+def test_eager_collection_fusion_skips_custom_process_group():
+    """A member with its own process_group syncs individually (its reduces
+    must ride ITS group, not the collection flush's default group)."""
+    from tpumetrics.aggregation import SumMetric
+    from tpumetrics.parallel.backend import set_default_backend
+
+    class _GroupRecordingBackend(_CountingEagerBackend):
+        def all_reduce(self, x, op, group=None):
+            self.reduce_calls.append((op, group))
+            return x
+
+        def all_gather(self, x, group=None):
+            self.gather_calls += 1
+            return [x]
+
+    be = _GroupRecordingBackend()
+    set_default_backend(be)
+    try:
+        col = MetricCollection(
+            {
+                "plain": SumMetric(),
+                "grouped": SumMetric(process_group="sub"),
+            }
+        )
+        col.update(jnp.asarray([1.0]))
+        col.compute()
+        groups = {g for _, g in be.reduce_calls}
+        assert "sub" in groups  # the grouped member's reduce kept its group
+        assert None in groups  # the fused flush used the default group
+    finally:
+        set_default_backend(None)
+
+
 def test_single_metric_sync_hlo_fuses_states():
     """One metric with 4 same-dtype sum states lowers to ONE all_reduce."""
     C = 5

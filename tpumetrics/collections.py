@@ -17,6 +17,7 @@ including the 1/N update-cost saving of compute groups.
 from __future__ import annotations
 
 from collections import OrderedDict
+from contextlib import contextmanager
 from copy import deepcopy
 from typing import Any, Dict, Hashable, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
 
@@ -188,18 +189,67 @@ class MetricCollection:
         prefix/postfix (reference collections.py:313-358)."""
         if method_name == "compute":
             self._compute_groups_create_state_ref(copy=False)
-        result = {}
-        for k, m in self._modules.items():
-            if method_name == "compute":
-                res = m.compute()
-            elif method_name == "forward":
-                res = m(*args, **m._filter_kwargs(**kwargs))
-            else:
-                raise ValueError(f"method_name should be either 'compute' or 'forward', but got {method_name}")
-            result[k] = res
-        if method_name == "forward":
+            with self._fused_eager_sync():
+                result = {k: m.compute() for k, m in self._modules.items()}
+        elif method_name == "forward":
+            result = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self._modules.items()}
             self._state_is_copy = False  # every metric advanced its own state
+        else:
+            raise ValueError(f"method_name should be either 'compute' or 'forward', but got {method_name}")
         return self._flatten_results(result)
+
+    @contextmanager
+    def _fused_eager_sync(self) -> Iterator[None]:
+        """Pre-sync every to-sync member with ONE shared FusedReducer flush.
+
+        The eager analogue of :meth:`sync_states`: without it a K-metric
+        collection pays K sequential sync rounds (each itself fused per
+        metric) over DCN at ``compute()``. Members using the ambient backend
+        and standard availability predicate are synced here in one flush and
+        their ``_to_sync`` flag is parked so the per-metric compute wrapper
+        neither re-syncs nor raises; each member's own ``sync_context`` still
+        performs its unsync on exit, and metrics with a custom backend/
+        predicate/dist_sync_fn keep their individual path untouched.
+        """
+        from tpumetrics.parallel.backend import get_default_backend
+        from tpumetrics.parallel.fuse import FusedReducer
+
+        candidates = [
+            m
+            for m in self._modules.values()
+            if m._to_sync
+            and not m._is_synced
+            and m._computed is None
+            and m.sync_backend is None
+            and m.dist_sync_fn is None
+            # a per-metric process_group must reduce over ITS ranks, not the
+            # collection-wide flush's default group — keep those individual
+            and m.process_group is None
+        ]
+        if not candidates:
+            yield
+            return
+        reducer = FusedReducer(get_default_backend())
+        finalizers = []
+        parked = []
+        try:
+            for m in candidates:
+                fin = m.sync(_reducer=reducer)
+                if m._is_synced:
+                    parked.append(m)
+                    m._to_sync = False
+                if fin is not None:
+                    finalizers.append(fin)
+            if finalizers:
+                reducer.flush()
+                for fin in finalizers:
+                    fin()
+            yield
+        finally:
+            for m in parked:
+                m._to_sync = True
+                if m._is_synced:  # compute never ran (exception path): restore
+                    m.unsync()
 
     def _flatten_results(self, result: Dict[str, Any]) -> Dict[str, Any]:
         """Flatten dict-valued metric results, disambiguating colliding inner

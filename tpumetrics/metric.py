@@ -430,13 +430,23 @@ class Metric(ABC):
     def _active_backend(self) -> DistributedBackend:
         return self.sync_backend if self.sync_backend is not None else get_default_backend()
 
-    def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
+    def _sync_dist(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        process_group: Optional[Any] = None,
+        _reducer: Optional[Any] = None,
+    ) -> Optional[Callable]:
         """Gather+reduce every state across ranks (reference metric.py:423-453).
 
         When no custom ``dist_sync_fn`` is given, "sum"/"mean"/"max"/"min"
         tensor states take the fused ``all_reduce`` path (one psum-style
         collective) instead of gather + local reduce — the key ICI
         optimization over the reference's always-gather wire protocol.
+
+        With an externally shared ``_reducer`` (a MetricCollection fusing its
+        whole eager sync into one flush), the reduce-op collectives are
+        DEFERRED: this returns a finalize callback to run after the shared
+        reducer's flush; gather-style states still sync immediately.
         """
         group = process_group or self.process_group
         backend = self._active_backend()
@@ -447,13 +457,19 @@ class Metric(ABC):
             # both the stateful (here) and pure (sync_state) paths
             from tpumetrics.parallel.fuse import FusedReducer
 
-            reducer = FusedReducer(backend, group=group)
+            reducer = _reducer if _reducer is not None else FusedReducer(backend, group=group)
             current = {attr: getattr(self, attr) for attr in self._reductions}
             out, pending = self._sync_state_collect(current, backend, reducer, group=group)
-            out.update(reducer.resolve(pending))
-            for attr, val in out.items():
-                object.__setattr__(self, attr, val)
-            return
+
+            def finalize() -> None:
+                out.update(reducer.resolve(pending))
+                for attr, val in out.items():
+                    object.__setattr__(self, attr, val)
+
+            if _reducer is None:
+                finalize()
+                return None
+            return finalize
 
         # reference-faithful custom-gather path
         input_dict = {attr: getattr(self, attr) for attr in self._reductions}
@@ -491,9 +507,17 @@ class Metric(ABC):
         process_group: Optional[Any] = None,
         should_sync: bool = True,
         distributed_available: Optional[Callable] = None,
-    ) -> None:
+        _reducer: Optional[Any] = None,
+    ) -> Optional[Callable]:
         """Synchronize state across ranks, caching the local state for
-        :meth:`unsync` (reference metric.py:486-528)."""
+        :meth:`unsync` (reference metric.py:486-528).
+
+        ``_reducer`` (internal): a shared FusedReducer from a collection-wide
+        eager sync; when given and the fused path applies, the reduce-op
+        collectives defer to the reducer's flush and the returned finalize
+        callback applies the results (the caller runs it after flushing).
+        Returns ``None`` when the sync was skipped or applied immediately.
+        """
         if self._is_synced and should_sync:
             raise TPUMetricsUserError("The Metric has already been synced.")
 
@@ -501,15 +525,16 @@ class Metric(ABC):
             distributed_available = self.distributed_available_fn
         is_distributed = distributed_available() if callable(distributed_available) else None
         if not should_sync or not is_distributed:
-            return
+            return None
 
         if dist_sync_fn is None:
             dist_sync_fn = self.dist_sync_fn  # may remain None → fused backend path
 
         # cache prior to syncing
         self._cache = self._copy_state_dict()
-        self._sync_dist(dist_sync_fn, process_group=process_group)
+        finalize = self._sync_dist(dist_sync_fn, process_group=process_group, _reducer=_reducer)
         self._is_synced = True
+        return finalize
 
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore the cached pre-sync local state (reference metric.py:530-550)."""
@@ -1164,7 +1189,12 @@ class CompositionalMetric(Metric):
         self.metric_a = jnp.asarray(metric_a) if isinstance(metric_a, (int, float)) else metric_a
         self.metric_b = jnp.asarray(metric_b) if isinstance(metric_b, (int, float)) else metric_b
 
-    def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
+    def _sync_dist(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        process_group: Optional[Any] = None,
+        _reducer: Optional[Any] = None,
+    ) -> None:
         pass  # children sync themselves (reference metric.py:1114-1119)
 
     def update(self, *args: Any, **kwargs: Any) -> None:

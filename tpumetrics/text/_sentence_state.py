@@ -28,14 +28,16 @@ class HostSentenceStateMixin:
         the metric."""
         return list(self._preds), list(self._target)
 
-    def _sync_dist(self, dist_sync_fn=None, process_group=None) -> None:
+    def _sync_dist(self, dist_sync_fn=None, process_group=None, _reducer=None):
         from tpumetrics.metric import TPUMetricsUserError
 
         if self.sentences_replicated:
             # array states sync normally; sentence lists are identical by
             # declaration. A custom dist_sync_fn alone is NOT enough — it
             # only sees the array states, never the strings.
-            return super()._sync_dist(dist_sync_fn=dist_sync_fn, process_group=process_group)
+            return super()._sync_dist(
+                dist_sync_fn=dist_sync_fn, process_group=process_group, _reducer=_reducer
+            )
 
         if getattr(self, "dist_sync_on_step", False):
             # forward()'s in-step sync saves/restores *registered* states only
@@ -78,10 +80,15 @@ class HostSentenceStateMixin:
             ) from None
         # merge the array states first: if that fails, the sentence buffers
         # are still untouched and a retried sync re-gathers the local shard
-        super()._sync_dist(dist_sync_fn=dist_sync_fn, process_group=process_group)
+        # (under a shared reducer the array apply defers to the returned
+        # finalize; the sentence swap below stays immediate)
+        finalize = super()._sync_dist(
+            dist_sync_fn=dist_sync_fn, process_group=process_group, _reducer=_reducer
+        )
         self._sentence_cache = (self._preds, self._target)
         self._preds = [p for rank_preds, _ in gathered for p in rank_preds]
         self._target = [t for _, rank_target in gathered for t in rank_target]
+        return finalize
 
     def unsync(self, should_unsync: bool = True) -> None:
         super().unsync(should_unsync)
