@@ -277,6 +277,25 @@ def run_scenarios(rank: int, world: int) -> dict:
         il.update(list(preds), list(target))
     results["metric_infolm"] = float(il.compute())
 
+    # heterogeneous-shape collection through the FUSED eager sync: scalar
+    # and (7,7)-matrix sum states of mixed dtypes flatten into the shared
+    # flush's per-(op, dtype) buffers across real processes
+    from tpumetrics.classification import MulticlassConfusionMatrix
+
+    mixed = MetricCollection(
+        {
+            "acc2": MulticlassAccuracy(num_classes=7, average="micro"),
+            "confmat": MulticlassConfusionMatrix(num_classes=7),
+        }
+    )
+    mixed.update(jnp.asarray(logits), jnp.asarray(labels))
+    mres = mixed.compute()
+    results["metric_mixed_collection"] = {
+        "acc2": float(mres["acc2"]),
+        "confmat_sum": int(np.asarray(mres["confmat"]).sum()),
+        "confmat_trace": int(np.asarray(mres["confmat"]).trace()),
+    }
+
     # wrapper metrics: children own sync — each child self-syncs over the
     # ambient MultiHostBackend at compute (wrappers/abstract.py design)
     from tpumetrics.regression import MeanSquaredError

@@ -276,6 +276,34 @@ def test_bertscore_sentence_state_merge(pool):
         assert res["bertscore_local_after_compute"] == list(local_preds)
 
 
+def test_mixed_shape_collection_fused_sync(pool):
+    """Scalar + (7,7)-matrix sum states of mixed dtypes through the fused
+    eager collection sync, across real processes: every rank equals the
+    union-data confusion matrix and accuracy."""
+    import jax.numpy as jnp2
+
+    from tpumetrics import MetricCollection
+    from tpumetrics.classification import MulticlassAccuracy, MulticlassConfusionMatrix
+
+    world, results = pool
+    mixed = MetricCollection(
+        {
+            "acc2": MulticlassAccuracy(num_classes=7, average="micro"),
+            "confmat": MulticlassConfusionMatrix(num_classes=7),
+        }
+    )
+    for r in range(world):
+        logits, labels = _worker.classification_shard(r, world)
+        mixed.update(jnp2.asarray(logits), jnp2.asarray(labels))
+    want = mixed.compute()
+    cm = np.asarray(want["confmat"])
+    for res in results:
+        got = res["metric_mixed_collection"]
+        assert got["acc2"] == pytest.approx(float(want["acc2"]), abs=1e-6)
+        assert got["confmat_sum"] == int(cm.sum())
+        assert got["confmat_trace"] == int(cm.trace())
+
+
 def test_multitask_wrapper_child_self_sync(pool):
     """Wrapper children sync THEMSELVES over the ambient backend at compute:
     every rank's MultitaskWrapper result equals the union-data values."""
