@@ -298,6 +298,38 @@ def test_eager_collection_fusion_skips_custom_process_group():
         set_default_backend(None)
 
 
+def test_eager_collection_fusion_with_wrapper_member():
+    """A WrapperMetric member (empty registered state, unwrapped compute,
+    children own their sync) passes through the fused eager sync without
+    corruption: values correct, flags restored, children still sync."""
+    from tpumetrics.parallel.backend import set_default_backend
+    from tpumetrics.regression import MeanSquaredError
+    from tpumetrics.wrappers import MultioutputWrapper
+
+    be = _CountingEagerBackend()
+    set_default_backend(be)
+    try:
+        col = MetricCollection(
+            {
+                "mse3": MultioutputWrapper(MeanSquaredError(), num_outputs=3),
+                "mse": MeanSquaredError(),
+            }
+        )
+        rng = np.random.default_rng(5)
+        p = jnp.asarray(rng.standard_normal((16, 3)), jnp.float32)
+        t = jnp.asarray(rng.standard_normal((16, 3)), jnp.float32)
+        col.update(p, t)
+        out = col.compute()
+        per_col = np.mean((np.asarray(p) - np.asarray(t)) ** 2, axis=0)
+        np.testing.assert_allclose(np.asarray(out["mse3"]).ravel(), per_col, atol=1e-6)
+        np.testing.assert_allclose(float(out["mse"]), per_col.mean(), atol=1e-6)
+        assert be.reduce_calls  # someone actually hit the wire
+        for m in col.values():
+            assert not m._is_synced and m._to_sync  # flags restored
+    finally:
+        set_default_backend(None)
+
+
 def test_single_metric_sync_hlo_fuses_states():
     """One metric with 4 same-dtype sum states lowers to ONE all_reduce."""
     C = 5
