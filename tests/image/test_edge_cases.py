@@ -1,0 +1,85 @@
+"""Image edge cases: constant/identical images, extreme values, tiny
+spatial sizes (counterpart of the reference's degenerate-input
+parametrizations in tests/unittests/image/).
+
+The degenerate conventions pinned here were cross-checked against the
+mounted reference (identical constant images: PSNR inf, SSIM 1, UQI 0 —
+the reference's k1=k2=0 zero-variance 0/0 resolves to 0, TV 0).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics.functional.image import (
+    peak_signal_noise_ratio,
+    structural_similarity_index_measure,
+    total_variation,
+    universal_image_quality_index,
+)
+from tpumetrics.image import PeakSignalNoiseRatio, StructuralSimilarityIndexMeasure
+
+_rng = np.random.default_rng(71)
+
+
+def _const(v, shape=(1, 3, 16, 16)):
+    return jnp.full(shape, v, jnp.float32)
+
+
+def test_identical_constant_images():
+    a = _const(0.5)
+    assert np.isposinf(float(peak_signal_noise_ratio(a, a, data_range=1.0)))
+    assert float(structural_similarity_index_measure(a, a, data_range=1.0)) == pytest.approx(1.0)
+    assert float(universal_image_quality_index(a, a)) == pytest.approx(0.0)  # reference's 0/0 -> 0
+    assert float(total_variation(a)) == 0.0
+
+
+def test_identical_noisy_images():
+    a = jnp.asarray(_rng.random((2, 3, 20, 20)), jnp.float32)
+    assert np.isposinf(float(peak_signal_noise_ratio(a, a, data_range=1.0)))
+    assert float(structural_similarity_index_measure(a, a, data_range=1.0)) == pytest.approx(1.0, abs=1e-6)
+    assert float(universal_image_quality_index(a, a)) == pytest.approx(1.0, abs=1e-4)
+
+
+def test_black_vs_white_extremes():
+    black, white = _const(0.0), _const(1.0)
+    psnr = float(peak_signal_noise_ratio(black, white, data_range=1.0))
+    assert psnr == pytest.approx(0.0, abs=1e-5)  # MSE == data_range^2
+    ssim = float(structural_similarity_index_measure(black, white, data_range=1.0))
+    assert 0.0 <= ssim < 0.05
+
+
+def test_psnr_class_streaming_with_infinite_batch():
+    """An identical-pair batch (inf PSNR) poisons the stream mean — exactly
+    like the reference (sum of squared errors accumulates 0, so the final
+    value stays finite unless ALL batches are identical)."""
+    m = PeakSignalNoiseRatio(data_range=1.0)
+    a = jnp.asarray(_rng.random((2, 3, 8, 8)), jnp.float32)
+    b = jnp.asarray(_rng.random((2, 3, 8, 8)), jnp.float32)
+    m.update(a, a)  # zero error batch
+    m.update(a, b)
+    # aggregate PSNR pools squared error over ALL pixels: finite
+    assert np.isfinite(float(m.compute()))
+    m2 = PeakSignalNoiseRatio(data_range=1.0)
+    m2.update(a, a)
+    assert np.isposinf(float(m2.compute()))
+
+
+def test_ssim_minimum_viable_size():
+    """Spatial dims below the 11x11 gaussian window yield NaN — the
+    reference's convention (verified against the mounted reference: its
+    valid-window average is empty too), never a garbage value."""
+    tiny = jnp.asarray(_rng.random((1, 3, 8, 8)), jnp.float32)
+    assert np.isnan(float(structural_similarity_index_measure(tiny, tiny, data_range=1.0)))
+    ok = jnp.asarray(_rng.random((1, 3, 11, 11)), jnp.float32)
+    assert float(structural_similarity_index_measure(ok, ok, data_range=1.0)) == pytest.approx(1.0)
+
+
+def test_single_pixel_psnr_and_tv():
+    a = _const(0.3, (1, 3, 1, 1))
+    b = _const(0.5, (1, 3, 1, 1))
+    want = 10 * np.log10(1.0 / 0.04)
+    assert float(peak_signal_noise_ratio(a, b, data_range=1.0)) == pytest.approx(want, abs=1e-4)
+    assert float(total_variation(a)) == 0.0
