@@ -1,0 +1,54 @@
+"""Clustering + nominal degenerate inputs, pinned against sklearn / the
+mounted reference's conventions (single-cluster partitions, constant
+variables, perfect association)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics.functional.clustering import (
+    adjusted_rand_score,
+    normalized_mutual_info_score,
+    rand_score,
+)
+from tpumetrics.functional.nominal import cramers_v, pearsons_contingency_coefficient, theils_u
+
+CONST = jnp.zeros(12, jnp.int32)
+MIXED = jnp.asarray([0, 1, 2] * 4, jnp.int32)
+
+
+def test_single_cluster_partitions():
+    """Everything in one cluster: agreement with itself is perfect (ARS 1,
+    Rand 1); against a real partition ARS collapses to 0 (chance level) and
+    NMI to 0 (no information) — sklearn's exact conventions."""
+    assert float(adjusted_rand_score(CONST, CONST)) == pytest.approx(1.0)
+    assert float(rand_score(CONST, CONST)) == pytest.approx(1.0)
+    assert float(adjusted_rand_score(CONST, MIXED)) == pytest.approx(0.0)
+    assert float(normalized_mutual_info_score(CONST, MIXED)) == pytest.approx(0.0)
+
+
+def test_perfect_partition_agreement():
+    assert float(adjusted_rand_score(MIXED, MIXED)) == pytest.approx(1.0)
+    assert float(normalized_mutual_info_score(MIXED, MIXED)) == pytest.approx(1.0)
+    # label permutation is still a perfect partition match
+    permuted = jnp.asarray([2, 0, 1] * 4, jnp.int32)
+    assert float(adjusted_rand_score(MIXED, permuted)) == pytest.approx(1.0)
+
+
+def test_nominal_constant_variable():
+    """A constant variable has no association to measure: Cramer's V is NaN
+    (the reference's convention — zero degrees of freedom), Theil's U is 0
+    (no uncertainty reduction)."""
+    assert np.isnan(float(cramers_v(CONST, MIXED)))
+    assert float(theils_u(CONST, MIXED)) == pytest.approx(0.0)
+
+
+def test_nominal_perfect_association():
+    assert float(cramers_v(MIXED, MIXED)) == pytest.approx(1.0)
+    assert float(theils_u(MIXED, MIXED)) == pytest.approx(1.0)
+    # Pearson's C saturates at sqrt((k-1)/k), not 1 — the textbook ceiling
+    assert float(pearsons_contingency_coefficient(MIXED, MIXED)) == pytest.approx(
+        np.sqrt(2 / 3), abs=1e-6
+    )
