@@ -1,0 +1,62 @@
+"""Retrieval edge cases: single-document queries, all-tied scores, all/no
+relevant documents.
+
+Tie-breaking is a documented deviation (docs/migrating_from_torchmetrics.md):
+the reference ranks ties by whatever its (unstable) sort produces; here the
+sort is STABLE, so tied scores keep the input document order — deterministic
+across runs, shards, and devices.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics.functional.retrieval import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_reciprocal_rank,
+)
+
+
+def test_single_document_query():
+    rel = (jnp.asarray([0.5]), jnp.asarray([True]))
+    irr = (jnp.asarray([0.5]), jnp.asarray([False]))
+    assert float(retrieval_average_precision(*rel)) == 1.0
+    assert float(retrieval_average_precision(*irr)) == 0.0
+    assert float(retrieval_reciprocal_rank(*rel)) == 1.0
+    assert float(retrieval_normalized_dcg(*rel)) == 1.0
+    assert float(retrieval_fall_out(*irr, top_k=1)) == 1.0
+
+
+def test_all_documents_relevant():
+    p = jnp.asarray([0.9, 0.1, 0.5])
+    t = jnp.asarray([True, True, True])
+    assert float(retrieval_average_precision(p, t)) == pytest.approx(1.0)
+    assert float(retrieval_precision(p, t, top_k=2)) == pytest.approx(1.0)
+    assert float(retrieval_normalized_dcg(p, t)) == pytest.approx(1.0)
+
+
+def test_tied_scores_keep_input_order():
+    """Stable tie-breaking: with every score equal, ranking == input order
+    (deterministic; the reference's unstable sort gives an arbitrary tie
+    permutation instead — documented deviation)."""
+    p = jnp.full((4,), 0.5)
+    assert float(retrieval_reciprocal_rank(p, jnp.asarray([True, False, False, False]))) == 1.0
+    assert float(retrieval_reciprocal_rank(p, jnp.asarray([False, False, False, True]))) == pytest.approx(0.25)
+    # and it is genuinely deterministic
+    vals = {
+        float(retrieval_reciprocal_rank(p, jnp.asarray([False, True, False, False])))
+        for _ in range(3)
+    }
+    assert vals == {0.5}
+
+
+def test_tie_broken_by_score_first():
+    """Ties only matter among equal scores: a higher score still wins."""
+    p = jnp.asarray([0.5, 0.5, 0.9])
+    t = jnp.asarray([False, False, True])
+    assert float(retrieval_reciprocal_rank(p, t)) == 1.0
