@@ -1,0 +1,51 @@
+"""Regression degenerate inputs, pinned against the mounted reference's
+conventions: constant targets (zero variance), perfect predictions,
+single-element inputs."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics.functional.regression import (
+    explained_variance,
+    mean_absolute_error,
+    mean_squared_error,
+    pearson_corrcoef,
+    r2_score,
+    spearman_corrcoef,
+)
+
+_rng = np.random.default_rng(0)
+NOISY = jnp.asarray(_rng.standard_normal(8), jnp.float32)
+CONST = jnp.full((8,), 3.0)
+
+
+def test_constant_target_conventions():
+    """Zero target variance — verified equal to the reference: R2 0 (its
+    0/0 guard), Pearson 1 (eps-guarded degenerate), Spearman 0, explained
+    variance 0."""
+    assert float(r2_score(NOISY, CONST)) == pytest.approx(0.0)
+    assert float(pearson_corrcoef(NOISY, CONST)) == pytest.approx(1.0)
+    assert float(spearman_corrcoef(NOISY, CONST)) == pytest.approx(0.0)
+    assert float(explained_variance(NOISY, CONST)) == pytest.approx(0.0)
+
+
+def test_perfect_predictions():
+    assert float(r2_score(NOISY, NOISY)) == pytest.approx(1.0)
+    assert float(pearson_corrcoef(NOISY, NOISY)) == pytest.approx(1.0, abs=1e-6)
+    assert float(spearman_corrcoef(NOISY, NOISY)) == pytest.approx(1.0, abs=1e-6)
+    assert float(mean_squared_error(NOISY, NOISY)) == 0.0
+    assert float(mean_absolute_error(NOISY, NOISY)) == 0.0
+
+
+def test_anti_correlated():
+    assert float(pearson_corrcoef(NOISY, -NOISY)) == pytest.approx(-1.0, abs=1e-6)
+    assert float(spearman_corrcoef(NOISY, -NOISY)) == pytest.approx(-1.0, abs=1e-6)
+
+
+def test_single_element():
+    one_p, one_t = jnp.asarray([2.0]), jnp.asarray([2.5])
+    assert float(mean_squared_error(one_p, one_t)) == pytest.approx(0.25)
+    assert float(mean_absolute_error(one_p, one_t)) == pytest.approx(0.5)
