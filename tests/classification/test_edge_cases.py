@@ -1,0 +1,52 @@
+"""Classification degenerate inputs, pinned against the mounted reference's
+conventions: single-class targets (undefined-metric cases), perfect
+all-negative predictions."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics.functional.classification import (
+    binary_accuracy,
+    binary_auroc,
+    binary_average_precision,
+    binary_f1_score,
+    multiclass_accuracy,
+)
+
+PREDS = jnp.asarray([0.2, 0.7, 0.4, 0.9])
+ALL_POS = jnp.ones(4, jnp.int32)
+ALL_NEG = jnp.zeros(4, jnp.int32)
+
+
+def test_single_class_targets_auroc_and_ap():
+    """No class boundary to rank across — verified equal to the reference:
+    AUROC degenerates to 0.0 for BOTH single-class directions (its
+    zero-area trapezoid), AP is 1.0 when everything is positive and NaN
+    when nothing is."""
+    assert float(binary_auroc(PREDS, ALL_POS)) == pytest.approx(0.0)
+    assert float(binary_auroc(PREDS, ALL_NEG)) == pytest.approx(0.0)
+    assert float(binary_average_precision(PREDS, ALL_POS)) == pytest.approx(1.0)
+    assert np.isnan(float(binary_average_precision(PREDS, ALL_NEG)))
+
+
+def test_perfect_all_negative_f1_is_zero():
+    """No positives anywhere: precision/recall are 0/0 and F1 resolves to
+    0 — the reference's zero_division default, even for a perfect
+    classifier."""
+    assert float(binary_f1_score(jnp.zeros(4), ALL_NEG)) == pytest.approx(0.0)
+    # accuracy has no such degeneracy
+    assert float(binary_accuracy(jnp.zeros(4), ALL_NEG)) == pytest.approx(1.0)
+
+
+def test_absent_classes_macro_average():
+    """Macro averaging over declared-but-absent classes follows the
+    reference: absent classes are excluded from the mean, not counted as
+    zeros."""
+    preds = jnp.asarray([0, 1, 0, 1], jnp.int32)
+    target = jnp.asarray([0, 1, 0, 1], jnp.int32)
+    # num_classes=4 but only classes {0, 1} appear, predicted perfectly
+    val = float(multiclass_accuracy(preds, target, num_classes=4, average="macro"))
+    assert val == pytest.approx(1.0)
