@@ -298,6 +298,38 @@ def test_eager_collection_fusion_skips_custom_process_group():
         set_default_backend(None)
 
 
+def test_fused_sync_mixed_precision_collection():
+    """A collection whose members carry bf16 AND f32 states: the fused sync
+    keeps dtype classes separate (no silent upcast/downcast through a shared
+    buffer) and values survive — in-trace over the mesh."""
+    from tpumetrics.aggregation import MeanMetric, SumMetric
+
+    mean_bf16 = MeanMetric()
+    mean_bf16.set_dtype(jnp.bfloat16)
+    col = MetricCollection({"sum32": SumMetric(), "mean16": mean_bf16})
+    vals = jnp.arange(1.0, 9.0, dtype=jnp.float32)  # 8 values, one per device
+
+    def run(v):
+        state = col.functional_update(col.init_state(), v)
+        return col.functional_compute(state, axis_name="r")
+
+    out = jax.jit(shard_map(run, mesh=_mesh(), in_specs=(P("r"),), out_specs=P()))(vals)
+    assert float(out["sum32"]) == pytest.approx(36.0)
+    assert float(out["mean16"]) == pytest.approx(4.5, rel=2e-2)  # bf16 tolerance
+    # dtype classes stayed separate in the lowered program: two all_reduces
+    lowered = jax.jit(
+        shard_map(run, mesh=_mesh(), in_specs=(P("r"),), out_specs=P())
+    ).lower(vals)
+    # every state is sum-reduced, so classes == distinct state dtypes
+    dtypes = {
+        str(jnp.asarray(leaf).dtype)
+        for st in col.init_state().values()
+        for leaf in jax.tree.leaves(st)
+    }
+    assert len(dtypes) >= 2  # the fixture really is mixed-precision
+    assert _count_all_reduces(lowered.as_text()) == len(dtypes)
+
+
 def test_eager_collection_fusion_with_wrapper_member():
     """A WrapperMetric member (empty registered state, unwrapped compute,
     children own their sync) passes through the fused eager sync without
