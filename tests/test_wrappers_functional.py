@@ -1,0 +1,308 @@
+"""The wrappers' functional/jit bridge: pure child-state pytrees through
+jit and shard_map.
+
+The reference has no functional path at all — this is TPU-first surface:
+Classwise/Multioutput/Multitask/MinMax and CompositionalMetric carry their
+children's states as one explicit pytree (usable inside a compiled train
+step); the order/RNG-dependent wrappers (BootStrapper, Running,
+MetricTracker) raise a directing error instead of silently mutating their
+children from inside a borrowed-state bridge.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tests.helpers.testers import shard_map
+from tpumetrics.classification import BinaryF1Score, MulticlassAccuracy, MulticlassPrecision
+from tpumetrics.metric import TPUMetricsUserError
+from tpumetrics.regression import MeanSquaredError
+from tpumetrics.wrappers import (
+    BootStrapper,
+    ClasswiseWrapper,
+    MinMaxMetric,
+    MultioutputWrapper,
+    MultitaskWrapper,
+    Running,
+)
+
+_rng = np.random.default_rng(83)
+
+
+def test_classwise_functional_jit():
+    w = ClasswiseWrapper(
+        MulticlassPrecision(num_classes=3, average=None, validate_args=False), labels=["a", "b", "c"]
+    )
+    preds = jnp.asarray(_rng.standard_normal((32, 3)), jnp.float32)
+    target = jnp.asarray(_rng.integers(0, 3, 32), jnp.int32)
+    state = jax.jit(w.functional_update)(w.init_state(), preds, target)
+    out = w.functional_compute(state)
+    ref = ClasswiseWrapper(
+        MulticlassPrecision(num_classes=3, average=None, validate_args=False), labels=["a", "b", "c"]
+    )
+    ref.update(preds, target)
+    want = ref.compute()
+    assert out.keys() == want.keys()
+    for k in want:
+        np.testing.assert_allclose(float(out[k]), float(want[k]), atol=1e-6, err_msg=k)
+
+
+def test_multioutput_functional_jit_and_shard_map():
+    def factory():
+        return MultioutputWrapper(MeanSquaredError(), num_outputs=3, remove_nans=False)
+
+    preds = jnp.asarray(_rng.standard_normal((32, 3)), jnp.float32)
+    target = jnp.asarray(_rng.standard_normal((32, 3)), jnp.float32)
+
+    w = factory()
+    state = jax.jit(w.functional_update)(w.init_state(), preds, target)
+    out = np.asarray(w.functional_compute(state))
+    want = np.mean((np.asarray(preds) - np.asarray(target)) ** 2, axis=0)
+    np.testing.assert_allclose(out.ravel(), want, atol=1e-6)
+
+    # sharded update + in-trace sync == global
+    mesh = Mesh(np.array(jax.devices()[:8]), ("r",))
+
+    def run(p, t):
+        m = factory()
+        return m.functional_compute(m.functional_update(m.init_state(), p, t), axis_name="r")
+
+    sharded = jax.jit(shard_map(run, mesh=mesh, in_specs=(P("r"), P("r")), out_specs=P()))(
+        preds, target
+    )
+    np.testing.assert_allclose(np.asarray(sharded).ravel(), want, atol=1e-6)
+
+
+def test_multioutput_functional_requires_static_shapes():
+    w = MultioutputWrapper(MeanSquaredError(), num_outputs=2)  # remove_nans default True
+    with pytest.raises(TPUMetricsUserError, match="remove_nans=False"):
+        w.init_state()
+
+
+def test_multitask_functional_forward_jit():
+    def factory():
+        return MultitaskWrapper({"cls": BinaryF1Score(validate_args=False), "reg": MeanSquaredError()})
+
+    preds = {
+        "cls": jnp.asarray(_rng.uniform(0, 1, 16), jnp.float32),
+        "reg": jnp.asarray(_rng.standard_normal(16), jnp.float32),
+    }
+    target = {
+        "cls": jnp.asarray(_rng.integers(0, 2, 16), jnp.int32),
+        "reg": jnp.asarray(_rng.standard_normal(16), jnp.float32),
+    }
+    w = factory()
+    step = jax.jit(w.functional_forward)
+    state, batch_vals = step(w.init_state(), preds, target)
+    state, batch_vals = step(state, preds, target)
+    out = w.functional_compute(state)
+
+    ref = factory()
+    ref.update(preds, target)
+    ref.update(preds, target)
+    want = ref.compute()
+    for k in ("cls", "reg"):
+        np.testing.assert_allclose(float(out[k]), float(want[k]), atol=1e-6, err_msg=k)
+        np.testing.assert_allclose(float(batch_vals[k]), float(want[k]), atol=1e-6, err_msg=k)
+
+
+def test_minmax_functional_forward_tracks_extrema():
+    w = MinMaxMetric(MulticlassAccuracy(num_classes=3, average="micro", validate_args=False))
+    step = jax.jit(w.functional_forward)
+    state = w.init_state()
+    target = jnp.asarray([0, 1, 2, 0], jnp.int32)
+    good = jax.nn.one_hot(target, 3)
+    bad = jax.nn.one_hot((target + 1) % 3, 3)
+
+    state, stats = step(state, good, target)  # acc 1.0
+    assert float(stats["raw"]) == pytest.approx(1.0)
+    state, stats = step(state, bad, target)  # running acc 0.5
+    assert float(stats["raw"]) == pytest.approx(0.5)
+    assert float(stats["max"]) == pytest.approx(1.0)  # extremum persisted in state
+    assert float(stats["min"]) == pytest.approx(0.5)
+
+    # pure compute view does not persist
+    view = w.functional_compute(state)
+    assert float(view["max"]) == pytest.approx(1.0)
+
+
+def test_compositional_functional_jit():
+    acc = MulticlassAccuracy(num_classes=3, average="micro", validate_args=False)
+    comp = 2 * acc + 1
+    preds = jnp.asarray(_rng.standard_normal((16, 3)), jnp.float32)
+    target = jnp.asarray(_rng.integers(0, 3, 16), jnp.int32)
+    state = jax.jit(comp.functional_update)(comp.init_state(), preds, target)
+    got = float(comp.functional_compute(state))
+    ref = MulticlassAccuracy(num_classes=3, average="micro", validate_args=False)
+    ref.update(preds, target)
+    assert got == pytest.approx(2 * float(ref.compute()) + 1, abs=1e-6)
+
+
+@pytest.mark.parametrize(
+    "factory",
+    [
+        lambda: BootStrapper(MeanSquaredError(), num_bootstraps=3),
+        lambda: Running(MeanSquaredError(), window=2),
+    ],
+    ids=["BootStrapper", "Running"],
+)
+def test_unbridged_wrappers_fail_loudly(factory):
+    w = factory()
+    with pytest.raises(TPUMetricsUserError, match="functional/jit bridge"):
+        w.init_state()
+    with pytest.raises(TPUMetricsUserError, match="functional/jit bridge"):
+        w.functional_update({}, jnp.zeros(2), jnp.zeros(2))
+    with pytest.raises(TPUMetricsUserError, match="functional/jit bridge"):
+        w.sync_state({}, None)
+
+
+# ------------------------------------------------- sync_state coherence
+# (review findings: every bridged wrapper must ride the shared-reducer
+# collect protocol so collection syncs and direct sync_state calls work)
+
+
+class _IdentityBackend:
+    """World-size-1 backend counting collectives (identity values)."""
+
+    def __init__(self):
+        self.reduce_calls = 0
+        self.gather_calls = 0
+
+    def available(self):
+        return True
+
+    def world_size(self):
+        return 1
+
+    def all_gather(self, x, group=None):
+        self.gather_calls += 1
+        return [x]
+
+    def all_reduce(self, x, op, group=None):
+        self.reduce_calls += 1
+        return x
+
+
+def test_bridged_wrappers_sync_state_directly():
+    """update -> sync_state -> compute works for every bridged wrapper."""
+    preds = jnp.asarray(_rng.standard_normal((16, 3)), jnp.float32)
+    target = jnp.asarray(_rng.standard_normal((16, 3)), jnp.float32)
+    be = _IdentityBackend()
+
+    mo = MultioutputWrapper(MeanSquaredError(), num_outputs=3, remove_nans=False)
+    st = mo.functional_update(mo.init_state(), preds, target)
+    out = mo.functional_compute(mo.sync_state(st, be))
+    np.testing.assert_allclose(
+        np.asarray(out).ravel(), np.mean((np.asarray(preds) - np.asarray(target)) ** 2, axis=0), atol=1e-6
+    )
+
+    mt = MultitaskWrapper({"reg": MeanSquaredError()})
+    st = mt.functional_update(mt.init_state(), {"reg": preds[:, 0]}, {"reg": target[:, 0]})
+    out = mt.functional_compute(mt.sync_state(st, be))
+    assert np.isfinite(float(out["reg"]))
+
+    comp = 2 * MeanSquaredError()
+    st = comp.functional_update(comp.init_state(), preds[:, 0], target[:, 0])
+    synced = comp.sync_state(st, be)
+    assert float(comp.functional_compute(synced)) == pytest.approx(
+        2 * float(np.mean((np.asarray(preds[:, 0]) - np.asarray(target[:, 0])) ** 2)), abs=1e-5
+    )
+
+
+def test_minmax_sync_state_is_one_flush():
+    """MinMax's extrema + ALL child states share one reducer: with a 4-state
+    sum child everything lands in at most 3 collectives (sum/min/max
+    classes), not per-state rounds."""
+    from tpumetrics.classification import MulticlassStatScores
+
+    be = _IdentityBackend()
+    w = MinMaxMetric(MulticlassStatScores(num_classes=4, average=None, validate_args=False))
+    preds = jnp.asarray(_rng.standard_normal((16, 4)), jnp.float32)
+    target = jnp.asarray(_rng.integers(0, 4, 16), jnp.int32)
+    st = w.functional_update(w.init_state(), preds, target)
+    synced = w.sync_state(st, be)
+    assert be.reduce_calls <= 3
+    out = w.functional_compute(synced)
+    assert set(out) == {"raw", "max", "min"}
+
+
+def test_wrapper_inside_collection_functional_sync():
+    """The review's failure scenario: a bridged wrapper as a collection
+    member must survive collections.sync_states / functional_compute with
+    axis_name — sharded result equals the unsharded union."""
+    from tpumetrics import MetricCollection
+
+    def col_factory():
+        return MetricCollection(
+            {
+                "cw": ClasswiseWrapper(
+                    MulticlassPrecision(num_classes=3, average=None, validate_args=False),
+                    labels=["a", "b", "c"],
+                ),
+                "acc": MulticlassAccuracy(num_classes=3, average="micro", validate_args=False),
+            }
+        )
+
+    preds = jnp.asarray(_rng.standard_normal((32, 3)), jnp.float32)
+    target = jnp.asarray(_rng.integers(0, 3, 32), jnp.int32)
+    col = col_factory()
+    col.establish_compute_groups(preds[:8], target[:8])
+    mesh = Mesh(np.array(jax.devices()[:8]), ("r",))
+
+    def run(p, t):
+        state = col.functional_update(col.init_state(), p, t)
+        return col.functional_compute(state, axis_name="r")
+
+    sharded = jax.jit(shard_map(run, mesh=mesh, in_specs=(P("r"), P("r")), out_specs=P()))(
+        preds, target
+    )
+    ref = col_factory()
+    ref.update(preds, target)
+    want = ref.compute()
+    assert sharded.keys() == want.keys()
+    for k in want:
+        np.testing.assert_allclose(float(sharded[k]), float(want[k]), atol=1e-6, err_msg=k)
+
+
+def test_multitask_collection_task_with_backend():
+    """A MetricCollection task inside MultitaskWrapper syncs through an
+    explicit backend in functional_compute (review finding: backend was
+    silently dropped)."""
+    from tpumetrics import MetricCollection
+    from tpumetrics.classification import MulticlassF1Score
+
+    class _DoublingBackend(_IdentityBackend):
+        """world=2 stand-in: sum-reduces double (both 'ranks' identical)."""
+
+        def world_size(self):
+            return 2
+
+        def all_gather(self, x, group=None):
+            self.gather_calls += 1
+            return [x, x]
+
+        def all_reduce(self, x, op, group=None):
+            self.reduce_calls += 1
+            return x + x if op == "sum" else x
+
+    w = MultitaskWrapper(
+        {
+            "multi": MetricCollection(
+                {"acc": MulticlassAccuracy(num_classes=3, average="micro", validate_args=False)}
+            ),
+        }
+    )
+    preds = jnp.asarray(_rng.standard_normal((16, 3)), jnp.float32)
+    target = jnp.asarray(_rng.integers(0, 3, 16), jnp.int32)
+    st = w.functional_update(w.init_state(), {"multi": preds}, {"multi": target})
+    be = _DoublingBackend()
+    out = w.functional_compute(st, backend=be)
+    assert be.reduce_calls > 0  # the collection task really synced
+    # doubled numerator over doubled denominator == local accuracy
+    local = MulticlassAccuracy(num_classes=3, average="micro", validate_args=False)
+    local.update(preds, target)
+    np.testing.assert_allclose(float(out["multi"]["acc"]), float(local.compute()), atol=1e-6)

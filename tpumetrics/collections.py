@@ -639,16 +639,21 @@ class MetricCollection:
         from tpumetrics.parallel.fuse import FusedReducer
 
         reducer = FusedReducer(backend)
-        collected: Dict[str, tuple] = {}
-        for cg in self._groups.values():
-            leader = self._modules[cg[0]]
-            collected[cg[0]] = leader._sync_state_collect(state[cg[0]], backend, reducer)
+        finalize = self._sync_state_collect(state, backend, reducer)
         reducer.flush()
-        synced: Dict[str, Dict[str, Any]] = {}
-        for name, (out, pending) in collected.items():
-            out.update(reducer.resolve(pending))
-            synced[name] = out
-        return synced
+        return finalize()
+
+    def _sync_state_collect(
+        self, state: Dict[str, Dict[str, Any]], backend: Any, reducer: Any, group: Any = None
+    ) -> Any:
+        """Collection-shaped phase-1 collect (same closure protocol as
+        ``Metric._sync_state_collect``) so a collection can itself nest —
+        e.g. as a MultitaskWrapper task — inside one shared flush."""
+        finalizers = {
+            cg[0]: self._modules[cg[0]]._sync_state_collect(state[cg[0]], backend, reducer, group)
+            for cg in self._groups.values()
+        }
+        return lambda: {name: fin() for name, fin in finalizers.items()}
 
 
 def _axis_backend(axis_name: Any) -> Any:

@@ -459,11 +459,14 @@ class Metric(ABC):
 
             reducer = _reducer if _reducer is not None else FusedReducer(backend, group=group)
             current = {attr: getattr(self, attr) for attr in self._reductions}
-            out, pending = self._sync_state_collect(current, backend, reducer, group=group)
+            # explicitly the BASE collect: eager sync moves this metric's
+            # REGISTERED attribute states; wrapper overrides of
+            # _sync_state_collect describe their functional (child-state
+            # pytree) shape, which does not apply to the attribute wire
+            state_finalize = Metric._sync_state_collect(self, current, backend, reducer, group=group)
 
             def finalize() -> None:
-                out.update(reducer.resolve(pending))
-                for attr, val in out.items():
+                for attr, val in state_finalize().items():
                     object.__setattr__(self, attr, val)
 
             if _reducer is None:
@@ -743,9 +746,9 @@ class Metric(ABC):
         from tpumetrics.parallel.fuse import FusedReducer
 
         reducer = FusedReducer(backend)
-        out, pending = self._sync_state_collect(state, backend, reducer)
-        out.update(reducer.resolve(pending))
-        return out
+        finalize = self._sync_state_collect(state, backend, reducer)
+        reducer.flush()
+        return finalize()
 
     def _sync_state_collect(
         self,
@@ -753,11 +756,14 @@ class Metric(ABC):
         backend: DistributedBackend,
         reducer: Any,
         group: Optional[Any] = None,
-    ) -> Tuple[Dict[str, StateType], Dict[str, int]]:
+    ) -> Callable[[], Dict[str, StateType]]:
         """Phase 1 of a (possibly multi-metric) fused sync: gather-style
         states sync immediately; reduce-style states register with the shared
-        ``reducer`` and resolve after its single ``flush``. Returns
-        ``(partial_out, attr -> reducer handle)``."""
+        ``reducer``. Returns a finalize closure to call after the reducer's
+        single ``flush``, producing the synced state. Wrappers with nested
+        child states override this (registering children with the SAME
+        reducer), which is what lets a whole MetricCollection — wrappers
+        included — sync in one flush."""
         from tpumetrics.buffers import MaskedBuffer, buffer_all_gather
 
         out: Dict[str, StateType] = {}
@@ -793,7 +799,12 @@ class Metric(ABC):
                 out[attr] = reduction_fn(jnp.stack(backend.all_gather(val, group=group)))
             else:
                 raise TypeError("reduction_fn must be callable or None")
-        return out, pending
+
+        def finalize() -> Dict[str, StateType]:
+            out.update(reducer.resolve(pending))
+            return out
+
+        return finalize
 
     # ------------------------------------------------------------------ reset
 
@@ -1238,6 +1249,55 @@ class CompositionalMetric(Metric):
             self.metric_a.reset()
         if isinstance(self.metric_b, Metric):
             self.metric_b.reset()
+
+    # ------------------------------------------------------ functional bridge
+    # child states as a {"a": ..., "b": ...} pytree (constants carry None)
+
+    def init_state(self) -> Dict[str, Any]:
+        return {
+            "a": self.metric_a.init_state() if isinstance(self.metric_a, Metric) else None,
+            "b": self.metric_b.init_state() if isinstance(self.metric_b, Metric) else None,
+        }
+
+    def functional_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        out = dict(state)
+        if isinstance(self.metric_a, Metric):
+            out["a"] = self.metric_a.functional_update(
+                state["a"], *args, **self.metric_a._filter_kwargs(**kwargs)
+            )
+        if isinstance(self.metric_b, Metric):
+            out["b"] = self.metric_b.functional_update(
+                state["b"], *args, **self.metric_b._filter_kwargs(**kwargs)
+            )
+        return out
+
+    def functional_compute(self, state: Dict[str, Any], axis_name: Any = None, backend: Any = None) -> Any:
+        val_a = (
+            self.metric_a.functional_compute(state["a"], axis_name=axis_name, backend=backend)
+            if isinstance(self.metric_a, Metric)
+            else self.metric_a
+        )
+        val_b = (
+            self.metric_b.functional_compute(state["b"], axis_name=axis_name, backend=backend)
+            if isinstance(self.metric_b, Metric)
+            else self.metric_b
+        )
+        if val_b is None:
+            return self.op(val_a)
+        return self.op(val_a, val_b)
+
+    def _sync_state_collect(self, state: Dict[str, Any], backend: Any, reducer: Any, group: Any = None) -> Any:
+        fin_a = (
+            self.metric_a._sync_state_collect(state["a"], backend, reducer, group)
+            if isinstance(self.metric_a, Metric)
+            else (lambda: state["a"])
+        )
+        fin_b = (
+            self.metric_b._sync_state_collect(state["b"], backend, reducer, group)
+            if isinstance(self.metric_b, Metric)
+            else (lambda: state["b"])
+        )
+        return lambda: {"a": fin_a(), "b": fin_b()}
 
     def persistent(self, mode: bool = False) -> None:
         if isinstance(self.metric_a, Metric):

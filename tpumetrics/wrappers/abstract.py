@@ -28,3 +28,40 @@ class WrapperMetric(Metric):
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         """Each wrapper defines its own forward protocol."""
         raise NotImplementedError
+
+    # ------------------------------------------------------ functional bridge
+    # Wrapper state lives in the wrapped children, not in registered states,
+    # so the base Metric bridge (which borrows registered states only) would
+    # silently mutate children while returning an empty pytree. Wrappers
+    # with coherent pure semantics (Classwise/Multioutput/Multitask/MinMax,
+    # CompositionalMetric) override the whole bridge; the rest — resampling
+    # (BootStrapper), windowing (Running), compute-call bookkeeping
+    # (MetricTracker) — are order/RNG-dependent by design and fail loudly.
+
+    def _no_functional_bridge(self) -> None:
+        from tpumetrics.metric import TPUMetricsUserError
+
+        raise TPUMetricsUserError(
+            f"{type(self).__name__} does not support the functional/jit bridge: its state"
+            " lives in wrapped child metrics with order- or sampling-dependent update"
+            " semantics. Use the eager API (update/compute), or wrap with a bridge-capable"
+            " wrapper (ClasswiseWrapper, MultioutputWrapper, MultitaskWrapper, MinMaxMetric)."
+        )
+
+    def init_state(self) -> Any:
+        self._no_functional_bridge()
+
+    def functional_update(self, state: Any, *args: Any, **kwargs: Any) -> Any:
+        self._no_functional_bridge()
+
+    def functional_compute(self, state: Any, axis_name: Any = None, backend: Any = None) -> Any:
+        self._no_functional_bridge()
+
+    def functional_forward(self, state: Any, *args: Any, **kwargs: Any) -> Any:
+        self._no_functional_bridge()
+
+    def sync_state(self, state: Any, backend: Any) -> Any:
+        self._no_functional_bridge()
+
+    def _sync_state_collect(self, state: Any, backend: Any, reducer: Any, group: Any = None) -> Any:
+        self._no_functional_bridge()

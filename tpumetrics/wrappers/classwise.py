@@ -80,3 +80,23 @@ class ClasswiseWrapper(WrapperMetric):
 
     def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
         return self.metric._filter_kwargs(**kwargs)
+
+    # ------------------------------------------------------ functional bridge
+    # pure delegation: the wrapper's state IS the wrapped metric's state;
+    # only the compute output gains the labeled-dict conversion
+
+    def init_state(self) -> Dict[str, Any]:
+        return self.metric.init_state()
+
+    def functional_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.metric.functional_update(state, *args, **kwargs)
+
+    def functional_compute(self, state: Dict[str, Any], axis_name: Any = None, backend: Any = None) -> Dict[str, Array]:
+        return self._convert(self.metric.functional_compute(state, axis_name=axis_name, backend=backend))
+
+    def _sync_state_collect(self, state: Dict[str, Any], backend: Any, reducer: Any, group: Any = None) -> Any:
+        return self.metric._sync_state_collect(state, backend, reducer, group)
+
+    # generic implementations work once the pieces above exist
+    functional_forward = Metric.functional_forward
+    sync_state = Metric.sync_state

@@ -78,3 +78,51 @@ class MinMaxMetric(WrapperMetric):
         if isinstance(val, (jax.Array, jnp.ndarray)):
             return val.size == 1
         return False
+
+    # ------------------------------------------------------ functional bridge
+    # state = {"base": <wrapped state>, "min_val", "max_val"}. The extrema
+    # refresh when a value is OBSERVED: ``functional_forward`` returns the
+    # refreshed state (the jit-loop analogue of the eager forward), while
+    # ``functional_compute`` is a pure read — it reports extrema as-of the
+    # current value without persisting them (persist by carrying the state
+    # that ``functional_forward`` returns).
+
+    def init_state(self) -> Dict[str, Any]:
+        return {
+            "base": self._base_metric.init_state(),
+            "min_val": jnp.asarray(jnp.inf),
+            "max_val": jnp.asarray(-jnp.inf),
+        }
+
+    def functional_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return {**state, "base": self._base_metric.functional_update(state["base"], *args, **kwargs)}
+
+    def functional_compute(self, state: Dict[str, Any], axis_name: Any = None, backend: Any = None) -> Dict[str, Array]:
+        val = jnp.asarray(
+            self._base_metric.functional_compute(state["base"], axis_name=axis_name, backend=backend)
+        )
+        return {
+            "raw": val,
+            "max": jnp.maximum(state["max_val"], val),
+            "min": jnp.minimum(state["min_val"], val),
+        }
+
+    def functional_forward(
+        self, state: Dict[str, Any], *args: Any, axis_name: Any = None, backend: Any = None, **kwargs: Any
+    ) -> tuple:
+        new_state = self.functional_update(state, *args, **kwargs)
+        stats = self.functional_compute(new_state, axis_name=axis_name, backend=backend)
+        new_state = {**new_state, "min_val": stats["min"], "max_val": stats["max"]}
+        return new_state, stats
+
+    def _sync_state_collect(self, state: Dict[str, Any], backend: Any, reducer: Any, group: Any = None) -> Any:
+        h_min = reducer.add(state["min_val"], "min")
+        h_max = reducer.add(state["max_val"], "max")
+        base_fin = self._base_metric._sync_state_collect(state["base"], backend, reducer, group)
+        return lambda: {
+            "base": base_fin(),
+            "min_val": reducer.result(h_min),
+            "max_val": reducer.result(h_max),
+        }
+
+    sync_state = Metric.sync_state

@@ -111,3 +111,49 @@ class MultioutputWrapper(WrapperMetric):
 
     def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
         return self.metrics[0]._filter_kwargs(**kwargs)
+
+    # ------------------------------------------------------ functional bridge
+    # a list of per-output child states; requires remove_nans=False (NaN-row
+    # removal is data-dependent boolean indexing — untraceable)
+
+    def _require_traceable(self) -> None:
+        if self.remove_nans:
+            from tpumetrics.metric import TPUMetricsUserError
+
+            raise TPUMetricsUserError(
+                "MultioutputWrapper's functional/jit bridge requires remove_nans=False:"
+                " NaN-row removal selects a data-dependent number of rows, which cannot"
+                " be traced. Construct with remove_nans=False (and pre-filter NaNs"
+                " outside the compiled step if needed)."
+            )
+
+    def init_state(self) -> List[Any]:
+        self._require_traceable()
+        return [m.init_state() for m in self.metrics]
+
+    def functional_update(self, state: List[Any], *args: Any, **kwargs: Any) -> List[Any]:
+        self._require_traceable()
+        reshaped = self._get_args_kwargs_by_output(*args, **kwargs)
+        return [
+            m.functional_update(st, *sel_args, **sel_kwargs)
+            for m, st, (sel_args, sel_kwargs) in zip(self.metrics, state, reshaped)
+        ]
+
+    def functional_compute(self, state: List[Any], axis_name: Any = None, backend: Any = None) -> Array:
+        return jnp.stack(
+            [
+                m.functional_compute(st, axis_name=axis_name, backend=backend)
+                for m, st in zip(self.metrics, state)
+            ],
+            0,
+        )
+
+    def _sync_state_collect(self, state: List[Any], backend: Any, reducer: Any, group: Any = None) -> Any:
+        finalizers = [
+            m._sync_state_collect(st, backend, reducer, group) for m, st in zip(self.metrics, state)
+        ]
+        return lambda: [fin() for fin in finalizers]
+
+    # generic implementations work once the pieces above exist
+    functional_forward = Metric.functional_forward
+    sync_state = Metric.sync_state

@@ -73,3 +73,58 @@ class MultitaskWrapper(WrapperMetric):
         for metric in self.task_metrics.values():
             metric.reset()
         super().reset()
+
+    # ------------------------------------------------------ functional bridge
+    # per-task child states as one pytree, so the whole wrapper rides
+    # jit/shard_map like any Metric (children's own functional bridges do
+    # the work; a task mapped to a MetricCollection nests its state dict)
+
+    def init_state(self) -> Dict[str, Any]:
+        return {name: m.init_state() for name, m in self.task_metrics.items()}
+
+    def functional_update(
+        self, state: Dict[str, Any], task_preds: Dict[str, Array], task_targets: Dict[str, Array]
+    ) -> Dict[str, Any]:
+        if not self.task_metrics.keys() == task_preds.keys() == task_targets.keys():
+            raise ValueError(
+                "Expected arguments `task_preds` and `task_targets` to have the same keys as the"
+                f" wrapped `task_metrics`. Found task_preds.keys() = {task_preds.keys()},"
+                f" task_targets.keys() = {task_targets.keys()}"
+                f" and self.task_metrics.keys() = {self.task_metrics.keys()}"
+            )
+        return {
+            name: m.functional_update(state[name], task_preds[name], task_targets[name])
+            for name, m in self.task_metrics.items()
+        }
+
+    def functional_compute(self, state: Dict[str, Any], axis_name: Any = None, backend: Any = None) -> Dict[str, Any]:
+        out = {}
+        for name, m in self.task_metrics.items():
+            if isinstance(m, Metric):
+                out[name] = m.functional_compute(state[name], axis_name=axis_name, backend=backend)
+            else:  # MetricCollection's bridge takes axis_name only; an
+                # explicit backend syncs its whole state first
+                task_state = m.sync_states(state[name], backend) if backend is not None else state[name]
+                out[name] = m.functional_compute(task_state, axis_name=axis_name)
+        return out
+
+    def _sync_state_collect(self, state: Dict[str, Any], backend: Any, reducer: Any, group: Any = None) -> Any:
+        finalizers = {
+            name: m._sync_state_collect(state[name], backend, reducer, group)
+            for name, m in self.task_metrics.items()
+        }
+        return lambda: {name: fin() for name, fin in finalizers.items()}
+
+    sync_state = Metric.sync_state
+
+    def functional_forward(
+        self,
+        state: Dict[str, Any],
+        task_preds: Dict[str, Array],
+        task_targets: Dict[str, Array],
+        axis_name: Any = None,
+        backend: Any = None,
+    ) -> tuple:
+        new_state = self.functional_update(state, task_preds, task_targets)
+        batch_state = self.functional_update(self.init_state(), task_preds, task_targets)
+        return new_state, self.functional_compute(batch_state, axis_name=axis_name, backend=backend)
