@@ -125,6 +125,11 @@ def test_classwise_wrapper_distributed():
         _cls_batches(),
         get_children=lambda w: [w.metric],
     )
+    # and the in-jit ICI path via the wrapper's functional bridge
+    run_shard_map_self_equivalence_test(
+        lambda: ClasswiseWrapper(MulticlassPrecision(num_classes=4, average=None, validate_args=False)),
+        _cls_batches(),
+    )
 
 
 def test_multioutput_wrapper_distributed():
@@ -139,6 +144,10 @@ def test_multioutput_wrapper_distributed():
         lambda: MultioutputWrapper(MeanSquaredError(), num_outputs=3),
         batches,
         get_children=lambda w: list(w.metrics),
+    )
+    run_shard_map_self_equivalence_test(
+        lambda: MultioutputWrapper(MeanSquaredError(), num_outputs=3, remove_nans=False),
+        batches,
     )
 
 
@@ -161,6 +170,30 @@ def test_multitask_wrapper_distributed():
         batches,
         get_children=lambda w: [w.task_metrics[k] for k in sorted(w.task_metrics)],
     )
+
+    # in-jit ICI path: dict-of-task inputs sharded over the mesh through the
+    # wrapper's functional bridge (pytree inputs shard natively)
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from tests.helpers.testers import shard_map as _sm
+
+    w = MultitaskWrapper({"cls": BinaryF1Score(validate_args=False), "reg": MeanSquaredError()})
+    all_preds = {k: jnp.concatenate([b[0][k] for b in batches]) for k in ("cls", "reg")}
+    all_targets = {k: jnp.concatenate([b[1][k] for b in batches]) for k in ("cls", "reg")}
+    mesh = Mesh(np.array(jax.devices()[:8]), ("r",))
+
+    def run(p, t):
+        state = w.functional_update(w.init_state(), p, t)
+        return w.functional_compute(state, axis_name="r")
+
+    sharded = jax.jit(_sm(run, mesh=mesh, in_specs=(P("r"), P("r")), out_specs=P()))(
+        all_preds, all_targets
+    )
+    ref = MultitaskWrapper({"cls": BinaryF1Score(validate_args=False), "reg": MeanSquaredError()})
+    ref.update(all_preds, all_targets)
+    want = ref.compute()
+    for k in ("cls", "reg"):
+        np.testing.assert_allclose(float(sharded[k]), float(want[k]), atol=1e-6, err_msg=k)
 
 
 def test_compositional_metric_distributed():
