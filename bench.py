@@ -75,7 +75,11 @@ def _entry(ours_us, ref_us, accounting=None):
         out["ref_us"] = round(ref_us, 2)
         out["vs_baseline"] = round(ref_us / ours_us, 3)
     if accounting:
+        accounting = dict(accounting)
+        extras = accounting.pop("extras", None) or {}
         out.update(_accounting(ours_us, **accounting))
+        out.update({k: (round(v, 2) if isinstance(v, float) else v)
+                    for k, v in extras.items() if v is not None})
     return out
 
 
@@ -263,24 +267,37 @@ def sharded_step(state, preds, target):
     new_state, vals = col.functional_forward(state, preds, target, axis_name="dp")
     return new_state, vals
 
+try:  # jax >= 0.5 exposes shard_map at top level
+    _shard_map = lambda f, **kw: jax.shard_map(f, check_vma=False, **kw)
+    jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _sm
+    _shard_map = lambda f, **kw: _sm(f, check_rep=False, **kw)
+
 rng = np.random.default_rng(0)
 preds = jnp.asarray(jax.nn.softmax(jnp.asarray(rng.standard_normal((B, C), dtype=np.float32))))
 target = jnp.asarray(rng.integers(0, C, size=(B,)), dtype=jnp.int32)
 col.establish_compute_groups(preds[:8], target[:8])
 step = jax.jit(
-    jax.shard_map(
+    _shard_map(
         sharded_step, mesh=mesh,
         in_specs=(P(), P("dp"), P("dp")), out_specs=(P(), P()),
-        check_vma=False,
     ),
 )
 state0 = col.init_state()
-state, vals = step(state0, preds, target)
+# the collective LEDGER sources wire_bytes_per_step: records are made at
+# trace time (static metadata), so capturing the first call — the trace —
+# accounts one steady-state step of the compiled program
+from tpumetrics import telemetry
+with telemetry.capture() as led:
+    state, vals = step(state0, preds, target)
 jax.block_until_ready(vals)
+ledger_summary = led.summary()
 
 # accounting: per-device FLOPs of one step (XLA cost analysis) and the
 # collective payload the per-step batch-value sync moves per device —
-# ring all_reduce moves ~2*(N-1)/N * payload bytes per device
+# ring all_reduce moves ~2*(N-1)/N * payload bytes per device (kept as an
+# analytic cross-check against the ledger)
 flops = None
 try:
     ca = step.lower(state0, preds, target).compile().cost_analysis()
@@ -295,7 +312,7 @@ payload = sum(
     for st in state0.values()
     for leaf in jax.tree.leaves(st)
 )
-wire_bytes = 2 * (N - 1) / N * payload
+wire_bytes_analytic = 2 * (N - 1) / N * payload
 
 times = []
 for _ in range(ROUNDS):
@@ -305,7 +322,14 @@ for _ in range(ROUNDS):
         state, vals = step(state, preds, target)
     jax.block_until_ready(vals)
     times.append((time.perf_counter() - t0) / STEPS * 1e6)
-print(json.dumps({"us_per_step": min(times), "flops_per_step": flops, "wire_bytes_per_step": wire_bytes}))
+print(json.dumps({
+    "us_per_step": min(times),
+    "flops_per_step": flops,
+    "wire_bytes_per_step": ledger_summary["wire_bytes_total"],
+    "wire_bytes_analytic": wire_bytes_analytic,
+    "ledger_collectives": ledger_summary["collectives_issued"],
+    "ledger_flushes": ledger_summary["flush_count"],
+}))
 """
 
 
@@ -326,10 +350,18 @@ def _bench_collection_sync_8dev():
     sub = json.loads(out.stdout.strip().splitlines()[-1])
     ours = float(sub["us_per_step"])
     accounting = {
-        # CPU-mesh subprocess: no chip peak — report flops + wire bytes/s only
+        # CPU-mesh subprocess: no chip peak — report flops + wire bytes/s
+        # only.  wire_bytes_per_step is LEDGER-sourced (telemetry.capture of
+        # the traced step); the analytic ring-model value rides along as a
+        # cross-check — the two must agree to the integer.
         "flops_per_step": sub.get("flops_per_step"),
         "wire_bytes_per_step": sub.get("wire_bytes_per_step"),
         "on_accelerator": False,
+    }
+    accounting["extras"] = {
+        "wire_bytes_analytic": sub.get("wire_bytes_analytic"),
+        "ledger_collectives": sub.get("ledger_collectives"),
+        "ledger_flushes": sub.get("ledger_flushes"),
     }
 
     ref = None
@@ -801,12 +833,19 @@ def _check_floors(headline_vs, details):
     """Regression gate (VERDICT r4 weak #4): per-config vs_baseline floors
     live in bench_floors.json; any measured ratio below its floor is a loud
     failure (exit 2) instead of a silently drifting BENCH_r*.json number.
-    Configs whose reference side failed (no vs_baseline) are skipped."""
+    Configs whose reference side failed (no vs_baseline) are skipped.
+
+    ``wire_bytes_ceilings`` gate the LEDGER-sourced collective payload the
+    same way: a config moving more bytes per step than its ceiling (e.g. a
+    regression re-registering compute-group members in the fused flush)
+    fails loudly."""
     floor_path = os.path.join(_REPO, "bench_floors.json")
     if not os.path.isfile(floor_path):
         return []
     with open(floor_path) as fh:
-        floors = json.load(fh)["floors"]
+        gate = json.load(fh)
+    floors = gate["floors"]
+    ceilings = gate.get("wire_bytes_ceilings", {})
     violations = []
     measured = {"headline": headline_vs}
     for name, entry in details.items():
@@ -816,6 +855,12 @@ def _check_floors(headline_vs, details):
         got = measured.get(name)
         if got is not None and got < floor:
             violations.append(f"{name}: vs_baseline {got} < floor {floor}")
+    for name, ceiling in ceilings.items():
+        entry = details.get(name)
+        if isinstance(entry, dict):
+            got = entry.get("wire_bytes_per_step")
+            if got is not None and got > ceiling:
+                violations.append(f"{name}: wire_bytes_per_step {got} > ceiling {ceiling}")
     return violations
 
 

@@ -323,6 +323,50 @@ def run_scenarios(rank: int, world: int) -> dict:
     mres = mp.compute()
     results["metric_map"] = {k: float(np.asarray(v).reshape(-1)[0]) for k, v in mres.items() if k != "classes"}
 
+    # --- telemetry over real DCN: ledger accounting for a fused flush ------
+    from tpumetrics import telemetry
+
+    tel = MetricCollection(
+        {
+            "acc3": MulticlassAccuracy(num_classes=7, average="micro"),
+            "f13": MulticlassF1Score(num_classes=7, average="macro"),
+        }
+    )
+    tel.update(jnp.asarray(logits), jnp.asarray(labels))
+    with telemetry.capture() as led:
+        tel_res = tel.compute()
+    s = led.summary()
+    results["telemetry_ledger"] = {
+        "collectives_issued": s["collectives_issued"],
+        "flush_count": s["flush_count"],
+        "lockstep_fingerprints": s["lockstep_fingerprints"],
+        "wire_bytes_total": s["wire_bytes_total"],
+        "backends": sorted({r.backend for r in led.records if r.source == "backend"}),
+        "acc3": float(tel_res["acc3"]),
+    }
+
+    # --- induced rank-divergent schedule: the ADVICE r5 #3 deadlock --------
+    # rank 0 enters the collection flush with one member's compute value
+    # cached, so its candidate set differs from every other rank's; the
+    # lockstep verifier must RAISE on every rank (naming the divergence)
+    # instead of hanging the DCN flush
+    from tpumetrics.classification import MulticlassConfusionMatrix as _ConfMat
+
+    div = MetricCollection(
+        {
+            "acc4": MulticlassAccuracy(num_classes=7, average="micro"),
+            "conf4": _ConfMat(num_classes=7),
+        }
+    )
+    div.update(jnp.asarray(logits), jnp.asarray(labels))
+    if rank == 0:
+        div._modules["conf4"]._computed = jnp.zeros((7, 7))  # divergent flag
+    try:
+        div.compute()
+        results["lockstep_violation"] = None
+    except telemetry.LockstepViolation as err:
+        results["lockstep_violation"] = str(err)
+
     return results
 
 

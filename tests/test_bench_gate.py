@@ -21,6 +21,9 @@ def test_floor_file_shape():
     # floors must sit below the recorded best (headroom for chip variance)
     for name, floor in data["floors"].items():
         assert floor < data["best_recorded"][name], name
+    # the wire-byte gate covers the synced-collection config
+    assert "collection_sync_8dev" in data["wire_bytes_ceilings"]
+    assert data["wire_bytes_ceilings"]["collection_sync_8dev"] > 0
 
 
 def test_check_floors_flags_regressions():
@@ -36,6 +39,70 @@ def test_check_floors_flags_regressions():
 def test_check_floors_skips_missing_reference():
     details = {"fid_stream_update": {"us": 1.0}}  # ref side failed: no ratio
     assert bench._check_floors(headline_vs=None, details=details) == []
+
+
+def test_check_floors_flags_wire_byte_regressions():
+    """Ledger wire bytes above the ceiling (e.g. a regression re-registering
+    compute-group members in the fused flush) must trip the gate even when
+    every latency ratio is healthy."""
+    details = {
+        "collection_sync_8dev": {"vs_baseline": 1000.0, "wire_bytes_per_step": 10**9},
+    }
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("wire_bytes_per_step" in v for v in violations)
+    # at or under the ceiling passes
+    details["collection_sync_8dev"]["wire_bytes_per_step"] = 1
+    assert bench._check_floors(headline_vs=1000.0, details=details) == []
+
+
+def test_wire_bytes_ceiling_pins_leader_only_payload():
+    """The recorded ceiling equals the analytic leader-only wire bytes of the
+    collection_sync_8dev config — so re-adding compute-group members (which
+    would double the shared statscores payload) violates it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpumetrics import MetricCollection
+    from tpumetrics.classification import (
+        MulticlassAccuracy,
+        MulticlassAUROC,
+        MulticlassF1Score,
+    )
+
+    C, N = 16, 8
+    col = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=C, average="micro", validate_args=False),
+            "f1": MulticlassF1Score(num_classes=C, average="macro", validate_args=False),
+            "auroc": MulticlassAUROC(num_classes=C, validate_args=False, thresholds=64),
+        }
+    )
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(jax.nn.softmax(jnp.asarray(rng.standard_normal((8, C)), jnp.float32)))
+    target = jnp.asarray(rng.integers(0, C, size=(8,)), jnp.int32)
+    col.establish_compute_groups(preds, target)
+    assert any(len(g) == 2 for g in col.compute_groups.values())  # acc+f1 share
+
+    payload = sum(
+        int(np.prod(jnp.shape(leaf))) * jnp.asarray(leaf).dtype.itemsize
+        for st in col.init_state().values()
+        for leaf in jax.tree.leaves(st)
+    )
+    analytic = 2 * (N - 1) / N * payload
+
+    path = os.path.join(os.path.dirname(bench.__file__), "bench_floors.json")
+    with open(path) as fh:
+        ceiling = json.load(fh)["wire_bytes_ceilings"]["collection_sync_8dev"]
+    assert ceiling == round(analytic)
+    # duplicating the shared group's states (the pre-fix behavior) violates
+    shared_payload = sum(
+        int(np.prod(jnp.shape(getattr(col._modules["acc"], attr))))
+        * jnp.asarray(getattr(col._modules["acc"], attr)).dtype.itemsize
+        for attr in col._modules["acc"]._defaults
+    )
+    duplicated = 2 * (N - 1) / N * (payload + shared_payload)
+    assert duplicated > ceiling
 
 
 def test_accounting_fields():

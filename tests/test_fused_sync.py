@@ -362,6 +362,112 @@ def test_eager_collection_fusion_with_wrapper_member():
         set_default_backend(None)
 
 
+def test_eager_fused_sync_registers_only_group_leaders():
+    """ADVICE r5 #2: with compute groups active (shared state refs) the eager
+    collection flush must move each shared state ONCE — group leaders only —
+    not once per member; the wire-byte saving is asserted via the ledger."""
+    from tpumetrics import telemetry
+    from tpumetrics.classification import MulticlassPrecision, MulticlassRecall
+    from tpumetrics.parallel.backend import set_default_backend
+
+    C = 7
+    preds, target = _data(C)
+    col = MetricCollection(
+        {
+            "prec": MulticlassPrecision(num_classes=C, average="macro", validate_args=False),
+            "rec": MulticlassRecall(num_classes=C, average="macro", validate_args=False),
+            "f1": MulticlassF1Score(num_classes=C, average="macro", validate_args=False),
+        }
+    )
+    col.update(preds, target)
+    assert any(len(g) == 3 for g in col.compute_groups.values())  # one shared group
+    want = {k: np.asarray(v) for k, v in col.compute().items()}  # pre-distributed
+
+    leader = col._modules[next(g[0] for g in col.compute_groups.values() if len(g) == 3)]
+    leader_elements = sum(
+        int(np.prod(jnp.shape(getattr(leader, attr)))) for attr in leader._defaults
+    )
+
+    be = _CountingEagerBackend()
+    set_default_backend(be)
+    try:
+        for m in col.values():
+            m._computed = None  # force recompute under the counting backend
+        with telemetry.capture() as led:
+            got = col.compute()
+        # the wire moved ONE copy of the shared states, not one per member
+        assert sum(size for _, _, size in be.reduce_calls) == leader_elements
+        reducer_recs = [r for r in led.records if r.source == "reducer"]
+        assert sum(r.element_count for r in reducer_recs) == leader_elements
+        assert led.summary()["flush_count"] == 1
+        # the fused class is attributed to the leader, not every member
+        tags = "+".join(r.tag for r in reducer_recs)
+        assert type(leader).__name__ in tags
+        for k, v in want.items():
+            np.testing.assert_allclose(np.asarray(got[k]), v, atol=1e-6, err_msg=k)
+        # every member (leader AND ref-sharing members) restored cleanly
+        for m in col.values():
+            assert not m._is_synced and m._to_sync and m._cache is None
+    finally:
+        set_default_backend(None)
+
+
+def test_eager_fused_sync_members_adopt_reduced_arrays():
+    """Members of a synced group must COMPUTE from the leader's reduced
+    arrays (world>1 semantics), then unsync back to local state."""
+    from tpumetrics.classification import MulticlassPrecision, MulticlassRecall
+    from tpumetrics.parallel.backend import set_default_backend
+
+    class _DoublingEagerBackend(_CountingEagerBackend):
+        """world=2 stand-in: both 'ranks' contribute identical shards."""
+
+        def world_size(self):
+            return 2
+
+        def all_gather(self, x, group=None):
+            self.gather_calls += 1
+            return [x, x]
+
+        def all_reduce(self, x, op, group=None):
+            self.reduce_calls.append((op, str(x.dtype), x.size))
+            return x + x if op == "sum" else x
+
+    C = 7
+    preds, target = _data(C)
+    col = MetricCollection(
+        {
+            "prec": MulticlassPrecision(num_classes=C, average="macro", validate_args=False),
+            "rec": MulticlassRecall(num_classes=C, average="macro", validate_args=False),
+        }
+    )
+    col.update(preds, target)
+    assert any(len(g) == 2 for g in col.compute_groups.values())
+    want = {k: np.asarray(v) for k, v in col.compute().items()}  # ratios survive doubling
+
+    be = _DoublingEagerBackend()
+    set_default_backend(be)
+    try:
+        for m in col.values():
+            m._computed = None
+        got = col.compute()
+        # doubled tp over doubled denominators == local ratios, for BOTH the
+        # leader and the ref-sharing member — the member really adopted the
+        # reduced arrays rather than computing from stale pre-sync state
+        for k, v in want.items():
+            np.testing.assert_allclose(np.asarray(got[k]), v, atol=1e-6, err_msg=k)
+        # after compute the member unsynced back to its own local state
+        for m in col.values():
+            assert not m._is_synced
+            np.testing.assert_array_equal(np.asarray(m.tp), np.asarray(leader_tp_local(col)))
+    finally:
+        set_default_backend(None)
+
+
+def leader_tp_local(col):
+    leader = col._modules[next(iter(col.compute_groups.values()))[0]]
+    return leader.tp
+
+
 def test_single_metric_sync_hlo_fuses_states():
     """One metric with 4 same-dtype sum states lowers to ONE all_reduce."""
     C = 5

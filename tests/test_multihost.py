@@ -377,11 +377,52 @@ def test_map_ragged_states_gather(pool):
             assert res["metric_map"][k] == pytest.approx(v, abs=1e-6), k
 
 
+def test_telemetry_ledger_accounts_dcn_flush(pool):
+    """A captured MetricCollection.compute() over the real DCN backend: one
+    fused flush, wire collectives recorded with bytes, the lockstep
+    fingerprint recorded — and the synced value still equals the union."""
+    from tpumetrics.classification import MulticlassAccuracy
+
+    world, results = pool
+    logits, labels = _worker.classification_shard(0, 1)
+    full = MulticlassAccuracy(num_classes=7, average="micro")
+    full.update(jnp.asarray(logits), jnp.asarray(labels))
+    want = float(full.compute())
+    for res in results:
+        led = res["telemetry_ledger"]
+        assert led["flush_count"] == 1
+        assert led["collectives_issued"] >= 1  # real DCN gathers recorded
+        assert led["wire_bytes_total"] > 0
+        assert led["lockstep_fingerprints"] == 1  # the flush was fingerprinted
+        assert led["backends"] == ["MultiHostBackend"]
+        assert led["acc3"] == pytest.approx(want, abs=1e-6)
+
+
+def test_induced_divergence_raises_lockstep_violation(pool):
+    """ADVICE r5 #3 end-to-end: rank 0 enters the collection flush with a
+    cached compute value, so candidate schedules diverge — every rank must
+    raise LockstepViolation (naming the divergence) instead of deadlocking
+    the DCN flush."""
+    world, results = pool
+    for res in results:
+        msg = res["lockstep_violation"]
+        assert msg is not None, "divergent flush did not raise"
+        assert "sync-schedule mismatch" in msg
+        assert "MetricCollection._fused_eager_sync" in msg
+        # the first differing entry is conf4's state (missing on rank 0)
+        assert "conf4" in msg
+        if world > 2:  # strict majority pins the true outlier: rank 0
+            assert "rank 0 diverges from the majority" in msg
+        else:  # two ranks cannot assign blame — symmetric report
+            assert "ranks 0 and 1 disagree" in msg
+
+
 def test_ranks_agree_on_everything(pool):
     world, results = pool
     for res in results[1:]:
         for key in results[0]:
-            if key == "init" or key == "bertscore_local_after_compute":
+            if key in ("init", "bertscore_local_after_compute", "lockstep_violation"):
+                # lockstep_violation messages name the LOCAL rank
                 continue
             assert res[key] == results[0][key], key
 

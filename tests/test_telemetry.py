@@ -1,0 +1,396 @@
+"""The telemetry subsystem: collective ledger, sinks, lockstep verification.
+
+Pins the three tentpole guarantees:
+
+- **Ledger accounting** — an in-trace fused collection sync on the 8-virtual-
+  device CPU mesh records one all_reduce per (op, dtype) class whose summed
+  wire bytes equal the analytic ring model EXACTLY (integer agreement with
+  bench.py's hand computation), with attribution tags naming members.
+- **Zero-overhead disabled path** — with telemetry off, nothing records and
+  the report helpers return before touching any state.
+- **Lockstep verification** — a rank-divergent schedule raises
+  :class:`LockstepViolation` naming the diverging rank and the first
+  differing entry; in-trace backends skip the exchange and only record.
+  (The real multi-process divergence lives in tests/test_multihost.py.)
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tests.helpers.testers import shard_map
+from tpumetrics import MetricCollection, telemetry
+from tpumetrics.classification import (
+    MulticlassAccuracy,
+    MulticlassAUROC,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+from tpumetrics.parallel.fuse import FusedReducer
+from tpumetrics.telemetry import JsonlSink, LockstepViolation, lockstep
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Every test starts and ends with global telemetry off and empty."""
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+    telemetry.configure(lockstep_verification=True)
+
+
+def _mesh(ws=8):
+    return Mesh(np.array(jax.devices()[:ws]), ("r",))
+
+
+def _bench_collection(C=16):
+    """The collection_sync_8dev bench config's collection."""
+    return MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=C, average="micro", validate_args=False),
+            "f1": MulticlassF1Score(num_classes=C, average="macro", validate_args=False),
+            "auroc": MulticlassAUROC(num_classes=C, validate_args=False, thresholds=64),
+        }
+    )
+
+
+def _data(C=16, B=64, seed=0):
+    rng = np.random.default_rng(seed)
+    preds = jnp.asarray(jax.nn.softmax(jnp.asarray(rng.standard_normal((B, C)), jnp.float32)))
+    target = jnp.asarray(rng.integers(0, C, size=(B,)), jnp.int32)
+    return preds, target
+
+
+# ------------------------------------------------------------------- ledger
+
+
+def test_ledger_matches_analytic_wire_bytes_8dev():
+    """Capturing one traced step of the collection_sync_8dev config yields
+    EXACT integer agreement between ledger wire bytes and the analytic
+    2*(N-1)/N * payload ring model bench.py cross-checks against."""
+    N = 8
+    preds, target = _data()
+    col = _bench_collection()
+    col.establish_compute_groups(preds[:8], target[:8])
+
+    state0 = col.init_state()
+    payload = sum(
+        int(np.prod(jnp.shape(leaf))) * jnp.asarray(leaf).dtype.itemsize
+        for st in state0.values()
+        for leaf in jax.tree.leaves(st)
+    )
+    analytic = 2 * (N - 1) / N * payload
+
+    def run(p, t):
+        st = col.functional_update(col.init_state(), p, t)
+        return col.functional_compute(st, axis_name="r")
+
+    step = jax.jit(shard_map(run, mesh=_mesh(), in_specs=(P("r"), P("r")), out_specs=P()))
+    with telemetry.capture() as led:
+        out = step(preds, target)  # first call traces -> records
+        jax.block_until_ready(out)
+
+    s = led.summary()
+    assert s["wire_bytes_total"] == analytic  # exact agreement, not approx
+    assert round(s["wire_bytes_total"]) == round(analytic)
+    assert s["flush_count"] == 1  # ONE fused flush for the whole collection
+    # in-trace: the exchange is skipped but the fingerprint IS recorded
+    assert s["lockstep_fingerprints"] == 1
+
+    backend_recs = [r for r in led.records if r.source == "backend"]
+    assert backend_recs, "no backend collectives recorded"
+    for r in backend_recs:
+        assert r.backend == "AxisBackend"
+        assert r.in_trace is True
+        assert r.world_size == N
+        assert r.op in ("sum", "mean", "max", "min")
+    # one collective per (op, dtype) class, elements conserved
+    classes = {(r.op, r.dtype) for r in backend_recs}
+    assert s["collectives_issued"] == len(classes)
+    total_elements = sum(
+        int(np.prod(jnp.shape(leaf))) for st in state0.values() for leaf in jax.tree.leaves(st)
+    )
+    assert sum(r.element_count for r in backend_recs) == total_elements
+
+    # attribution tags name the collection members that contributed
+    reducer_recs = [r for r in led.records if r.source == "reducer"]
+    assert reducer_recs
+    tags = " ".join(r.tag for r in reducer_recs)
+    assert "auroc" in tags and "acc" in tags
+
+
+def test_disabled_telemetry_records_nothing():
+    """With telemetry off the ledger stays empty across a full synced step
+    (the <2% headline-overhead criterion rests on this fast path)."""
+    assert not telemetry.recording()
+    preds, target = _data(C=5, B=32, seed=1)
+    col = MetricCollection(
+        {"p": MulticlassPrecision(num_classes=5, average="macro", validate_args=False)}
+    )
+    col.establish_compute_groups(preds[:8], target[:8])
+
+    def run(p, t):
+        st = col.functional_update(col.init_state(), p, t)
+        return col.functional_compute(st, axis_name="r")
+
+    out = jax.jit(shard_map(run, mesh=_mesh(), in_specs=(P("r"), P("r")), out_specs=P()))(
+        preds, target
+    )
+    jax.block_until_ready(out)
+    led = telemetry.get_ledger()
+    assert led.records == []
+    assert led.summary()["collectives_issued"] == 0
+    # report helpers bail out before touching any state
+    telemetry.record_collective(None, "all_reduce", "sum", (4,), "float32", 4, 8)
+    telemetry.record_flush(None, entries=3, classes=1)
+    assert led.records == []
+
+
+def test_enable_disable_global_ledger():
+    telemetry.enable()
+    assert telemetry.enabled() and telemetry.recording()
+    telemetry.record_collective(object(), "all_reduce", "sum", (8,), "float32", 4, 4)
+    telemetry.disable()
+    telemetry.record_collective(object(), "all_reduce", "sum", (8,), "float32", 4, 4)
+    s = telemetry.summary()
+    assert s["collectives_issued"] == 1
+    assert s["wire_bytes_total"] == 2 * 3 / 4 * 32
+    assert s["bytes_by_op"] == {"sum": 2 * 3 / 4 * 32}
+
+
+def test_capture_is_independent_of_global_flag():
+    with telemetry.capture() as led:
+        telemetry.record_collective(object(), "all_gather", "gather", (2, 3), "int32", 4, 2)
+    assert led.summary()["collectives_issued"] == 1
+    assert led.summary()["wire_bytes_total"] == 1 * 24  # (N-1) * payload
+    assert telemetry.get_ledger().records == []  # global stayed off
+    # scope exited: no further recording
+    telemetry.record_collective(object(), "all_gather", "gather", (2, 3), "int32", 4, 2)
+    assert len(led.records) == 1
+
+
+# -------------------------------------------------------------------- sinks
+
+
+class _FakeWorld1Backend:
+    """Duck-typed world-1 backend (uninstrumented, like test backends)."""
+
+    in_trace = False
+    has_object_channel = False
+
+    def world_size(self):
+        return 1
+
+    def all_reduce(self, x, op, group=None):
+        return x
+
+
+def test_fused_reducer_reports_classes_and_flush_to_jsonl(tmp_path):
+    """Even under a custom (uninstrumented) backend the FusedReducer reports
+    its logical per-(op, dtype) classes and the flush event — and the JSONL
+    sink writes one well-formed object per record."""
+    path = tmp_path / "collectives.jsonl"
+    with telemetry.capture(sinks=[JsonlSink(str(path))]) as led:
+        red = FusedReducer(_FakeWorld1Backend())
+        with telemetry.attribution("acc"):
+            red.add(jnp.ones((3,), jnp.float32), "sum")
+        with telemetry.attribution("f1"):
+            red.add(jnp.ones((2, 2), jnp.float32), "sum")
+        red.add(jnp.asarray(5, jnp.int32), "max")
+        red.flush()
+
+    s = led.summary()
+    assert s["flush_count"] == 1
+    assert s["fused_entries"] == 3
+    reducer_recs = [r for r in led.records if r.source == "reducer"]
+    assert {(r.op, r.dtype) for r in reducer_recs} == {("sum", "float32"), ("max", "int32")}
+    fused = next(r for r in reducer_recs if r.op == "sum")
+    assert fused.element_count == 7  # 3 + 4 fused into one class
+    assert fused.tag == "acc+f1"
+
+    lines = [json.loads(line) for line in path.read_text().splitlines()]
+    assert len(lines) == len(led.records)
+    for obj in lines:
+        assert {"kind", "op", "dtype", "shape", "element_count", "payload_bytes",
+                "wire_bytes", "backend", "tag", "world_size", "in_trace", "source"} <= set(obj)
+    assert any(obj["kind"] == "flush" for obj in lines)
+
+
+def test_attribution_nesting():
+    assert telemetry.current_tag() == ""
+    with telemetry.attribution("col"):
+        with telemetry.attribution("MulticlassAccuracy"):
+            assert telemetry.current_tag() == "col/MulticlassAccuracy"
+        assert telemetry.current_tag() == "col"
+    assert telemetry.current_tag() == ""
+
+
+# ----------------------------------------------------------------- lockstep
+
+
+def _schedule(n=3, start=0):
+    return [
+        (f"m{i}", "sum", "float32", (4,)) for i in range(start, start + n)
+    ]
+
+
+class _FakeRanksObjectBackend:
+    """Emulated N-rank object channel: rank 0 is us, the rest are given.
+
+    Mirrors the verifier's two-phase protocol: a string payload is the
+    digest exchange, a list payload is the schedule exchange (mismatch
+    diagnosis only)."""
+
+    in_trace = False
+    has_object_channel = True
+
+    def __init__(self, *other_entries):
+        self._others = [lockstep.normalize_schedule(e) for e in other_entries]
+        self.gathers = 0
+
+    def world_size(self):
+        return 1 + len(self._others)
+
+    def all_gather_object(self, obj, group=None):
+        self.gathers += 1
+        if isinstance(obj, str):  # digest phase
+            return [obj] + [lockstep.schedule_fingerprint(s) for s in self._others]
+        return [obj] + self._others  # schedule phase
+
+
+def test_lockstep_agreement_passes_with_one_small_gather():
+    be = _FakeRanksObjectBackend(_schedule())
+    digest = telemetry.verify_lockstep(be, _schedule(), context="test")
+    assert digest == lockstep.schedule_fingerprint(_schedule())
+    assert be.gathers == 1  # happy path ships the digest only
+
+
+def test_lockstep_violation_two_ranks_is_symmetric():
+    """With exactly two ranks there is no majority — neither rank can be
+    blamed, so the report names both and the first differing entry."""
+    ours = _schedule(3)
+    theirs = list(ours)
+    theirs[1] = ("m1", "sum", "int32", (4,))  # dtype diverges at entry 1
+    be = _FakeRanksObjectBackend(theirs)
+    with pytest.raises(LockstepViolation, match=r"ranks 0 and 1 disagree .* entry 1") as ei:
+        telemetry.verify_lockstep(be, ours, context="unit")
+    msg = str(ei.value)
+    assert "float32" in msg and "int32" in msg and "unit" in msg
+    assert be.gathers == 2  # digest phase + schedule phase
+
+
+def test_lockstep_violation_majority_names_outlier():
+    """With a strict majority the outlier rank is named — here WE (rank 0)
+    are the diverger against two agreeing peers."""
+    ours = _schedule(3)
+    theirs = _schedule(2)
+    be = _FakeRanksObjectBackend(theirs, theirs)  # world=3, peers agree
+    with pytest.raises(LockstepViolation, match=r"rank 0 diverges from the majority") as ei:
+        telemetry.verify_lockstep(be, ours)
+    assert "entry 2" in str(ei.value)  # ours has one entry more
+
+
+def test_lockstep_violation_on_missing_entry():
+    ours = _schedule(3)
+    be = _FakeRanksObjectBackend(ours[:2])  # rank 1 plans one collective fewer
+    with pytest.raises(LockstepViolation, match=r"entry 2") as ei:
+        telemetry.verify_lockstep(be, ours)
+    assert "<no entry>" in str(ei.value)
+
+
+def test_lockstep_gather_shapes_do_not_fingerprint():
+    """Gather-style entries may differ in shape across ranks (pad-gather-trim
+    handles uneven dim 0) — shape must not enter the digest for them."""
+    a = [("m0", "gather", "float32", (3, 2))]
+    b = [("m0", "gather", "float32", (7, 2))]
+    assert lockstep.schedule_fingerprint(a) == lockstep.schedule_fingerprint(b)
+    # ...but reduce-op shapes MUST match
+    a = [("m0", "sum", "float32", (3,))]
+    b = [("m0", "sum", "float32", (7,))]
+    assert lockstep.schedule_fingerprint(a) != lockstep.schedule_fingerprint(b)
+
+
+def test_lockstep_skips_in_trace_backend_and_records_fingerprint():
+    class _InTrace:
+        in_trace = True
+        has_object_channel = False
+
+        def all_gather_object(self, obj, group=None):  # pragma: no cover
+            raise AssertionError("in-trace backend must not exchange")
+
+    with telemetry.capture() as led:
+        digest = telemetry.verify_lockstep(_InTrace(), _schedule())
+    assert digest is not None
+    marks = [r for r in led.records if r.kind == "lockstep"]
+    assert len(marks) == 1
+    assert marks[0].in_trace is True
+    assert marks[0].extra["digest"] == digest
+
+
+def test_lockstep_configure_disables_exchange():
+    telemetry.configure(lockstep_verification=False)
+    try:
+        be = _FakeRanksObjectBackend(_schedule(1))  # would diverge from 3 entries
+        digest = telemetry.verify_lockstep(be, _schedule(3))
+        assert digest is not None  # no raise: exchange disabled
+        assert be.gathers == 0
+    finally:
+        telemetry.configure(lockstep_verification=True)
+
+
+def test_collection_eager_flush_preverifies_schedule():
+    """MetricCollection's fused eager sync exchanges its candidate schedule
+    over an eager object-capable backend before any collective — divergent
+    candidate sets raise instead of hanging (ADVICE r5 #3)."""
+    from tpumetrics.parallel.backend import set_default_backend
+
+    class _DivergentBackend:
+        """Rank-1 peer reports an EMPTY schedule (its metric had a cached
+        ``_computed``) — the exact ADVICE #3 deadlock scenario."""
+
+        in_trace = False
+        has_object_channel = True
+
+        def available(self):
+            return True
+
+        def world_size(self):
+            return 2
+
+        def all_gather_object(self, obj, group=None):
+            if isinstance(obj, str):  # digest phase
+                return [obj, lockstep.schedule_fingerprint([])]
+            return [obj, []]  # schedule phase
+
+        def all_reduce(self, x, op, group=None):  # pragma: no cover
+            raise AssertionError("collective issued despite schedule divergence")
+
+        def all_gather(self, x, group=None):  # pragma: no cover
+            raise AssertionError("collective issued despite schedule divergence")
+
+    col = MetricCollection(
+        {
+            "prec": MulticlassPrecision(num_classes=5, average="macro", validate_args=False),
+            "rec": MulticlassRecall(num_classes=5, average="macro", validate_args=False),
+        }
+    )
+    preds, target = _data(C=5, B=32, seed=3)
+    col.update(preds, target)
+    set_default_backend(_DivergentBackend())
+    try:
+        with pytest.raises(LockstepViolation, match="rank 1"):
+            col.compute()
+        # the abort left every member clean: flags restored, nothing synced
+        for m in col.values():
+            assert not m._is_synced and m._to_sync
+    finally:
+        set_default_backend(None)

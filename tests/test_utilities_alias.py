@@ -54,6 +54,56 @@ def test_find_spec_resolves_in_fresh_process():
     subprocess.run([sys.executable, "-c", code], check=True, cwd="/root/repo")
 
 
+def _finder_spec(fullname):
+    """Resolve ``fullname`` through the meta-path finder (find_spec consults
+    sys.modules first, where the alias package pre-registered the shared
+    module — the finder only answers once that entry is absent)."""
+    alias = sys.modules.pop(fullname, None)
+    try:
+        return importlib.util.find_spec(fullname)
+    finally:
+        if alias is not None:
+            sys.modules[fullname] = alias
+
+
+def test_alias_spec_name_matches_fullname():
+    """ADVICE r5 #4: the finder must serve a spec whose .name (and loader)
+    match the REQUESTED alias name, not the tpumetrics.utils target."""
+    spec = _finder_spec("tpumetrics.utilities.data")
+    assert spec.name == "tpumetrics.utilities.data"
+    assert spec.loader is not None
+    assert getattr(spec.loader, "name", "tpumetrics.utilities.data") == "tpumetrics.utilities.data"
+    # the real module's own spec is untouched
+    real = importlib.util.find_spec("tpumetrics.utils.data")
+    assert real.name == "tpumetrics.utils.data"
+
+
+def test_alias_spec_reload_round_trip():
+    """Executing the alias spec (the importlib.reload path after sys.modules
+    surgery) must produce a module whose __name__/__spec__.name agree with
+    its sys.modules key — and reload() must round-trip on it."""
+    import tpumetrics.utilities.data as alias
+
+    spec = _finder_spec("tpumetrics.utilities.data")
+    mod = importlib.util.module_from_spec(spec)
+    assert mod.__name__ == "tpumetrics.utilities.data"
+    try:
+        sys.modules["tpumetrics.utilities.data"] = mod
+        spec.loader.exec_module(mod)
+        assert mod.__spec__.name == "tpumetrics.utilities.data"
+        assert hasattr(mod, "dim_zero_cat")  # body really executed
+        reloaded = importlib.reload(mod)
+        assert reloaded is mod
+        assert reloaded.__name__ == "tpumetrics.utilities.data"
+        assert reloaded.__spec__.name == "tpumetrics.utilities.data"
+    finally:
+        sys.modules["tpumetrics.utilities.data"] = alias
+    # the identical-object guarantee still holds after restoration
+    import tpumetrics.utilities.data as again
+
+    assert again is alias
+
+
 def test_reference_star_surface():
     """Every name the reference re-exports at utilities level resolves here."""
     ref_all = [

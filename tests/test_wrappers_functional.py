@@ -268,6 +268,53 @@ def test_wrapper_inside_collection_functional_sync():
         np.testing.assert_allclose(float(sharded[k]), float(want[k]), atol=1e-6, err_msg=k)
 
 
+def test_multitask_axis_wins_over_backend():
+    """ADVICE r5 #1: with BOTH `axis_name` and `backend` given, Metric tasks
+    let axis win (functional_compute replaces the backend) while collection
+    tasks used to sync twice — first eagerly via sync_states(backend), then
+    in-trace over the axis — inflating their sum states by world_size.  Both
+    task kinds must agree: axis wins, the eager backend is never touched."""
+    from tpumetrics import MetricCollection
+
+    class _ExplodingBackend(_IdentityBackend):
+        """Any use proves the backend was not ignored."""
+
+        def all_gather(self, x, group=None):  # pragma: no cover
+            raise AssertionError("backend used despite axis_name")
+
+        def all_reduce(self, x, op, group=None):  # pragma: no cover
+            raise AssertionError("backend used despite axis_name")
+
+    w = MultitaskWrapper(
+        {
+            "metric": MulticlassAccuracy(num_classes=3, average="micro", validate_args=False),
+            "col": MetricCollection(
+                {"acc": MulticlassAccuracy(num_classes=3, average="micro", validate_args=False)}
+            ),
+        }
+    )
+    preds = jnp.asarray(_rng.standard_normal((32, 3)), jnp.float32)
+    target = jnp.asarray(_rng.integers(0, 3, 32), jnp.int32)
+    be = _ExplodingBackend()
+    mesh = Mesh(np.array(jax.devices()[:8]), ("r",))
+
+    def run(p, t):
+        st = w.functional_update(
+            w.init_state(), {"metric": p, "col": p}, {"metric": t, "col": t}
+        )
+        return w.functional_compute(st, axis_name="r", backend=be)
+
+    sharded = jax.jit(shard_map(run, mesh=mesh, in_specs=(P("r"), P("r")), out_specs=P()))(
+        preds, target
+    )
+    ref = MulticlassAccuracy(num_classes=3, average="micro", validate_args=False)
+    ref.update(preds, target)
+    want = float(ref.compute())
+    # both task kinds equal the full-batch union value — synced exactly once
+    np.testing.assert_allclose(float(sharded["metric"]), want, atol=1e-6)
+    np.testing.assert_allclose(float(sharded["col"]["acc"]), want, atol=1e-6)
+
+
 def test_multitask_collection_task_with_backend():
     """A MetricCollection task inside MultitaskWrapper syncs through an
     explicit backend in functional_compute (review finding: backend was

@@ -171,12 +171,19 @@ class MetricCollection:
                 m0 = self._modules[cg[0]]
                 for name in cg[1:]:
                     mi = self._modules[name]
-                    for state in m0._defaults:
-                        m0_state = getattr(m0, state)
-                        object.__setattr__(mi, state, list(m0_state) if isinstance(m0_state, list) else m0_state)
+                    self._alias_leader_states(m0, mi)
                     mi._update_count = m0._update_count
                     mi._computed = None
         self._state_is_copy = copy
+
+    @staticmethod
+    def _alias_leader_states(m0: Metric, mi: Metric) -> None:
+        """Rebind every registered state of ``mi`` to ``m0``'s arrays (alias
+        propagation — the one way group state is ever shared; lists are
+        shallow-copied so member appends never mutate the leader's)."""
+        for state in m0._defaults:
+            m0_state = getattr(m0, state)
+            object.__setattr__(mi, state, list(m0_state) if isinstance(m0_state, list) else m0_state)
 
     # ---------------------------------------------------------------- results
 
@@ -210,40 +217,97 @@ class MetricCollection:
         neither re-syncs nor raises; each member's own ``sync_context`` still
         performs its unsync on exit, and metrics with a custom backend/
         predicate/dist_sync_fn keep their individual path untouched.
+
+        With compute groups active only each group's LEADER registers with
+        the shared reducer (members alias the leader's arrays, so re-adding
+        them would multiply the flush payload by group size — ADVICE r5 #2);
+        the reduced arrays are propagated to eligible ref-sharing members
+        afterwards, and each member still unsyncs back to its own pre-sync
+        cache on exit.
+
+        **Lockstep requirement (ADVICE r5 #3).** Candidate selection depends
+        on per-rank flags (``_computed`` cache, ``_is_synced``, ``_to_sync``),
+        so every rank MUST enter this flush with the same flags: on an eager
+        multi-host backend a single divergent rank would otherwise issue a
+        different collective schedule and deadlock the entire collection
+        flush.  Before any collective, each rank therefore fingerprints its
+        intended schedule and exchanges digests over the backend's host-object
+        channel (``tpumetrics.telemetry.verify_lockstep``) — every rank,
+        including ranks whose candidate set is empty — converting divergence
+        into a :class:`~tpumetrics.telemetry.LockstepViolation` that names
+        the diverging rank and the first differing entry.  In-trace backends
+        skip the exchange and only record the fingerprint; the exchange can
+        be disabled with ``telemetry.configure(lockstep_verification=False)``
+        (see docs/telemetry.md).
         """
         from tpumetrics.parallel.backend import get_default_backend
         from tpumetrics.parallel.fuse import FusedReducer
+        from tpumetrics.telemetry import ledger as _telemetry, lockstep as _lockstep
 
-        candidates = [
-            m
-            for m in self._modules.values()
-            if m._to_sync
-            and not m._is_synced
-            and m._computed is None
-            and m.sync_backend is None
-            and m.dist_sync_fn is None
-            # a per-metric process_group must reduce over ITS ranks, not the
-            # collection-wide flush's default group — keep those individual
-            and m.process_group is None
-        ]
-        if not candidates:
+        def _eligible(m: Metric) -> bool:
+            return (
+                m._to_sync
+                and not m._is_synced
+                and m._computed is None
+                and m.sync_backend is None
+                and m.dist_sync_fn is None
+                # a per-metric process_group must reduce over ITS ranks, not
+                # the collection-wide flush's default group — keep those
+                # individual
+                and m.process_group is None
+            )
+
+        backend = get_default_backend()
+        # group leaders carry the (shared) state; eligible members adopt the
+        # leader's reduced arrays after the flush
+        leaders: List[Tuple[str, Metric, List[Metric]]] = []
+        for cg in self._groups.values():
+            m0 = self._modules[cg[0]]
+            if _eligible(m0):
+                members = [self._modules[k] for k in cg[1:] if _eligible(self._modules[k])]
+                leaders.append((cg[0], m0, members))
+
+        # exchange when the backend supports it; with only a ledger active,
+        # still record the schedule fingerprint (the documented contract)
+        if _lockstep.should_verify(backend) or _telemetry.recording():
+            schedule: List[tuple] = []
+            for key, m0, _members in leaders:
+                schedule.extend(m0._sync_schedule(tag=key))
+            _lockstep.verify_lockstep(
+                backend, schedule, context="MetricCollection._fused_eager_sync"
+            )
+
+        if not leaders:
             yield
             return
-        reducer = FusedReducer(get_default_backend())
+        reducer = FusedReducer(backend, lockstep=False)  # schedule verified above
         finalizers = []
         parked = []
+        synced_groups: List[Tuple[Metric, List[Metric]]] = []
         try:
-            for m in candidates:
-                fin = m.sync(_reducer=reducer)
-                if m._is_synced:
-                    parked.append(m)
-                    m._to_sync = False
+            for key, m0, members in leaders:
+                with _telemetry.attribution(key):
+                    fin = m0.sync(_reducer=reducer)
+                if m0._is_synced:
+                    parked.append(m0)
+                    m0._to_sync = False
+                    synced_groups.append((m0, members))
                 if fin is not None:
                     finalizers.append(fin)
             if finalizers:
                 reducer.flush()
                 for fin in finalizers:
                     fin()
+            # propagate each leader's reduced arrays to its ref-sharing
+            # members: cache their pre-sync state first so the members'
+            # own sync_context unsyncs them exactly like a leader
+            for m0, members in synced_groups:
+                for mi in members:
+                    mi._cache = mi._copy_state_dict()
+                    self._alias_leader_states(m0, mi)
+                    mi._is_synced = True
+                    mi._to_sync = False
+                    parked.append(mi)
             yield
         finally:
             for m in parked:
@@ -648,11 +712,17 @@ class MetricCollection:
     ) -> Any:
         """Collection-shaped phase-1 collect (same closure protocol as
         ``Metric._sync_state_collect``) so a collection can itself nest —
-        e.g. as a MultitaskWrapper task — inside one shared flush."""
-        finalizers = {
-            cg[0]: self._modules[cg[0]]._sync_state_collect(state[cg[0]], backend, reducer, group)
-            for cg in self._groups.values()
-        }
+        e.g. as a MultitaskWrapper task — inside one shared flush.  Each
+        leader's collectives are tagged with its collection key for the
+        telemetry ledger (``"<key>/<MetricClass>"``)."""
+        from tpumetrics.telemetry import ledger as _telemetry
+
+        finalizers = {}
+        for cg in self._groups.values():
+            with _telemetry.attribution(cg[0]):
+                finalizers[cg[0]] = self._modules[cg[0]]._sync_state_collect(
+                    state[cg[0]], backend, reducer, group
+                )
         return lambda: {name: fin() for name, fin in finalizers.items()}
 
 

@@ -39,6 +39,7 @@ from tpumetrics.parallel.backend import (
     distributed_available as _default_distributed_available,
     get_default_backend,
 )
+from tpumetrics.telemetry import ledger as _telemetry
 from tpumetrics.utils.data import (
     _flatten,
     dim_zero_cat,
@@ -457,7 +458,24 @@ class Metric(ABC):
             # both the stateful (here) and pure (sync_state) paths
             from tpumetrics.parallel.fuse import FusedReducer
 
-            reducer = _reducer if _reducer is not None else FusedReducer(backend, group=group)
+            if _reducer is None:
+                # standalone eager sync: verify the cross-rank lockstep
+                # contract (same collectives, same order) BEFORE any wire op
+                # so a divergent rank raises instead of deadlocking; the
+                # reducer then skips its own (redundant) flush verification.
+                # A collection-shared _reducer is pre-verified by the caller.
+                from tpumetrics.telemetry import lockstep as _lockstep
+
+                if _lockstep.should_verify(backend) or _telemetry.recording():
+                    _lockstep.verify_lockstep(
+                        backend,
+                        self._sync_schedule(tag=type(self).__name__),
+                        context=f"{type(self).__name__}._sync_dist",
+                        group=group,
+                    )
+                reducer: Any = FusedReducer(backend, group=group, lockstep=False)
+            else:
+                reducer = _reducer
             current = {attr: getattr(self, attr) for attr in self._reductions}
             # explicitly the BASE collect: eager sync moves this metric's
             # REGISTERED attribute states; wrapper overrides of
@@ -750,6 +768,25 @@ class Metric(ABC):
         reducer.flush()
         return finalize()
 
+    def _sync_schedule(self, tag: str = "") -> List[tuple]:
+        """The ordered collective schedule this metric's eager sync intends:
+        one ``(tag, op, dtype, shape)`` entry per registered state (shape and
+        dtype participate only for reduce ops — gather-style states may
+        legitimately differ across ranks).  Input to the lockstep verifier."""
+        entries = []
+        prefix = f"{tag}." if tag else ""
+        for attr, reduction_fn in self._reductions.items():
+            val = getattr(self, attr)
+            op = _reduce_fn_to_op(reduction_fn)
+            if (
+                op in ("sum", "mean", "max", "min")
+                and isinstance(val, jax.Array)
+            ):
+                entries.append((f"{prefix}{attr}", op, str(val.dtype), tuple(val.shape)))
+            else:
+                entries.append((f"{prefix}{attr}", "gather", "", ()))
+        return entries
+
     def _sync_state_collect(
         self,
         state: Dict[str, StateType],
@@ -763,11 +800,33 @@ class Metric(ABC):
         single ``flush``, producing the synced state. Wrappers with nested
         child states override this (registering children with the SAME
         reducer), which is what lets a whole MetricCollection — wrappers
-        included — sync in one flush."""
-        from tpumetrics.buffers import MaskedBuffer, buffer_all_gather
+        included — sync in one flush.
 
+        Collectives issued (or deferred to the reducer) here carry this
+        metric's class name as a telemetry attribution tag, nested under any
+        enclosing collection/wrapper tag."""
         out: Dict[str, StateType] = {}
         pending: Dict[str, int] = {}
+        with _telemetry.attribution(type(self).__name__):
+            self._sync_state_collect_inner(state, backend, reducer, group, out, pending)
+
+        def finalize() -> Dict[str, StateType]:
+            out.update(reducer.resolve(pending))
+            return out
+
+        return finalize
+
+    def _sync_state_collect_inner(
+        self,
+        state: Dict[str, StateType],
+        backend: DistributedBackend,
+        reducer: Any,
+        group: Optional[Any],
+        out: Dict[str, StateType],
+        pending: Dict[str, int],
+    ) -> None:
+        from tpumetrics.buffers import MaskedBuffer, buffer_all_gather
+
         for attr, reduction_fn in self._reductions.items():
             val = state[attr]
             op = _reduce_fn_to_op(reduction_fn)
@@ -799,12 +858,6 @@ class Metric(ABC):
                 out[attr] = reduction_fn(jnp.stack(backend.all_gather(val, group=group)))
             else:
                 raise TypeError("reduction_fn must be callable or None")
-
-        def finalize() -> Dict[str, StateType]:
-            out.update(reducer.resolve(pending))
-            return out
-
-        return finalize
 
     # ------------------------------------------------------------------ reset
 

@@ -28,11 +28,36 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpumetrics.telemetry import ledger as _telemetry
+
 Array = jax.Array
 
 
+def _axis_size(axis_name: Any) -> int:
+    """Static size of a bound mesh axis, across jax versions (``jax.lax.
+    axis_size`` appeared after 0.4.x; ``jax.core.axis_frame`` returns the
+    bare size there)."""
+    axis_size_fn = getattr(jax.lax, "axis_size", None)
+    if axis_size_fn is not None:
+        return int(axis_size_fn(axis_name))
+    frame = jax.core.axis_frame(axis_name)
+    return int(getattr(frame, "size", frame))
+
+
 class DistributedBackend:
-    """Strategy interface for metric state synchronization."""
+    """Strategy interface for metric state synchronization.
+
+    Class traits consumed by the telemetry layer:
+
+    - ``in_trace``: collectives lower inside a compiled program (no eager
+      host round trip) — lockstep verification skips the digest exchange and
+      only records the schedule fingerprint.
+    - ``has_object_channel``: :meth:`all_gather_object` actually moves host
+      objects, so the lockstep verifier can exchange schedule digests.
+    """
+
+    in_trace = False
+    has_object_channel = False
 
     def available(self) -> bool:
         raise NotImplementedError
@@ -80,6 +105,8 @@ class DistributedBackend:
 class NoOpBackend(DistributedBackend):
     """Single-process, single-replica backend."""
 
+    has_object_channel = True  # trivially: the one rank's object comes back
+
     def available(self) -> bool:
         return False
 
@@ -104,6 +131,8 @@ class AxisBackend(DistributedBackend):
     no host round trip, unlike every sync in the reference.
     """
 
+    in_trace = True
+
     def __init__(self, axis_name: str, axis_size: Optional[int] = None) -> None:
         self.axis_name = axis_name
         self._axis_size = axis_size
@@ -114,15 +143,27 @@ class AxisBackend(DistributedBackend):
     def world_size(self) -> int:
         if self._axis_size is not None:
             return self._axis_size
-        return jax.lax.axis_size(self.axis_name)
+        return _axis_size(self.axis_name)
 
     def all_gather(self, x: Array, group: Optional[Any] = None) -> List[Array]:
         axis = group if isinstance(group, str) else self.axis_name
+        if _telemetry.recording():  # static metadata only — trace-safe
+            _telemetry.record_collective(
+                self, "all_gather", "gather", tuple(jnp.shape(x)), jnp.asarray(x).dtype,
+                np.dtype(jnp.asarray(x).dtype).itemsize, _axis_size(axis),
+                in_trace=True,
+            )
         stacked = jax.lax.all_gather(x, axis)
         return [stacked[i] for i in range(stacked.shape[0])]
 
     def all_reduce(self, x: Array, op: str, group: Optional[Any] = None) -> Array:
         axis = group if isinstance(group, str) else self.axis_name
+        if _telemetry.recording():  # static metadata only — trace-safe
+            _telemetry.record_collective(
+                self, "all_reduce", op, tuple(jnp.shape(x)), jnp.asarray(x).dtype,
+                np.dtype(jnp.asarray(x).dtype).itemsize, _axis_size(axis),
+                in_trace=True,
+            )
         if op == "sum":
             return jax.lax.psum(x, axis)
         if op == "mean":
@@ -143,6 +184,8 @@ class MultiHostBackend(DistributedBackend):
     moves the data, and results are trimmed back per-rank.
     """
 
+    has_object_channel = True
+
     def available(self) -> bool:
         return jax.process_count() > 1
 
@@ -152,6 +195,11 @@ class MultiHostBackend(DistributedBackend):
     def _gather_equal(self, x: Array) -> List[Array]:
         from jax.experimental import multihost_utils
 
+        if _telemetry.recording():  # every real DCN wire op funnels through here
+            _telemetry.record_collective(
+                self, "all_gather", "gather", tuple(jnp.shape(x)), jnp.asarray(x).dtype,
+                np.dtype(jnp.asarray(x).dtype).itemsize, jax.process_count(),
+            )
         stacked = multihost_utils.process_allgather(x, tiled=False)
         return [jnp.asarray(stacked[i]) for i in range(stacked.shape[0])]
 
@@ -206,6 +254,8 @@ class MultiHostBackend(DistributedBackend):
         import pickle
 
         payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        if _telemetry.recording():  # payload gathers record in _gather_equal
+            _telemetry.record_event(self, "all_gather_object", pickled_bytes=int(payload.size))
         gathered = self.all_gather(jnp.asarray(payload), group=group)
         return [pickle.loads(np.asarray(g).tobytes()) for g in gathered]
 

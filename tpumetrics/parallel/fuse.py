@@ -11,6 +11,19 @@ states participate.
 
 Correctness: rank-reduction is elementwise over the rank axis for all four
 ops, so reducing a concatenation equals concatenating the reductions.
+
+Observability: every ``flush`` reports into the collective ledger
+(``tpumetrics.telemetry``) — one ``"reducer"``-source record per (op, dtype)
+class carrying the attribution tags captured at :meth:`add` time, plus a
+flush event.  On eager multi-host backends ``flush`` also verifies the
+cross-rank lockstep contract (every rank must flush the same schedule) by
+exchanging schedule digests before issuing any of ITS fused collectives,
+unless the caller pre-verified and passed ``lockstep=False``.  Note the
+scope: this guards the reducer's own reduce-op collectives; gather-style
+states that a caller syncs eagerly while collecting (``_sync_state_collect``)
+happen before ``flush`` runs — the eager OO entry points
+(``Metric._sync_dist``, ``MetricCollection._fused_eager_sync``) therefore
+pre-verify their FULL schedule, gathers included, before collecting.
 """
 
 from __future__ import annotations
@@ -19,6 +32,9 @@ from typing import Any, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.telemetry import ledger as _telemetry
 
 Array = jax.Array
 
@@ -29,38 +45,90 @@ class FusedReducer:
     Usage: ``add`` every state (returns a handle), ``flush`` once, read each
     result back with ``result(handle)``. Every rank must add the same states
     in the same order (guaranteed by iterating ``_reductions`` dicts, whose
-    order is the registration order and identical across ranks).
+    order is the registration order and identical across ranks) — see the
+    lockstep contract on ``MetricCollection._fused_eager_sync``.
+
+    Args:
+        backend: the :class:`DistributedBackend` carrying the collectives.
+        group: backend-specific process group forwarded to every collective.
+        lockstep: ``None`` (default) verifies the flush schedule across ranks
+            on eager object-capable backends; ``False`` skips it (the caller
+            already verified a superset schedule).
     """
 
-    def __init__(self, backend: Any, group: Optional[Any] = None) -> None:
+    def __init__(
+        self, backend: Any, group: Optional[Any] = None, lockstep: Optional[bool] = None
+    ) -> None:
         self._backend = backend
         self._group = group
-        self._entries: List[Tuple[Array, str]] = []
+        self._lockstep = lockstep
+        self._entries: List[Tuple[Array, str, str]] = []
         self._results: Optional[List[Array]] = None
 
-    def add(self, val: Array, op: str) -> int:
+    def add(self, val: Array, op: str, tag: Optional[str] = None) -> int:
         if self._results is not None:
             raise RuntimeError("FusedReducer already flushed")
-        self._entries.append((jnp.asarray(val), op))
+        self._entries.append(
+            (jnp.asarray(val), op, tag if tag is not None else _telemetry.current_tag())
+        )
         return len(self._entries) - 1
 
+    def schedule(self) -> List[Tuple[str, str, str, Tuple[int, ...]]]:
+        """The intended collective schedule: (tag, op, dtype, shape) per entry."""
+        return [
+            (tag, op, str(val.dtype), tuple(val.shape)) for val, op, tag in self._entries
+        ]
+
     def flush(self) -> None:
+        # every rank exchanges, even with ZERO local entries — otherwise a
+        # zero-vs-nonzero schedule divergence would hang inside the verifier
+        # itself (peers blocked in the digest gather this rank never joins)
+        if self._lockstep is not False:
+            from tpumetrics.telemetry import lockstep as _lockstep
+
+            # exchange when the backend supports it; with only a ledger
+            # active, still record the schedule fingerprint (in-trace
+            # backends "skip the exchange and only record")
+            if _lockstep.should_verify(self._backend) or _telemetry.recording():
+                _lockstep.verify_lockstep(
+                    self._backend, self.schedule(), context="FusedReducer.flush",
+                    group=self._group,
+                )
+
+        recording = _telemetry.recording()
+        in_trace = bool(getattr(self._backend, "in_trace", False))
         results: List[Optional[Array]] = [None] * len(self._entries)
         classes: dict = {}
-        for i, (val, op) in enumerate(self._entries):
+        for i, (val, op, _tag) in enumerate(self._entries):
             classes.setdefault((op, str(val.dtype)), []).append(i)
         for (op, _dtype), idxs in classes.items():
-            if len(idxs) == 1:
-                i = idxs[0]
-                results[i] = self._backend.all_reduce(self._entries[i][0], op, group=self._group)
-                continue
-            vals = [self._entries[i][0] for i in idxs]
-            flat = jnp.concatenate([v.ravel() for v in vals])
-            reduced = self._backend.all_reduce(flat, op, group=self._group)
-            offset = 0
-            for i, v in zip(idxs, vals):
-                results[i] = reduced[offset : offset + v.size].reshape(v.shape)
-                offset += v.size
+            # joined attribution of the class (insertion order, deduplicated)
+            tags = "+".join(dict.fromkeys(t for i in idxs if (t := self._entries[i][2])))
+            if recording:
+                total = sum(int(self._entries[i][0].size) for i in idxs)
+                try:
+                    world = int(self._backend.world_size())
+                except Exception:
+                    world = 1
+                _telemetry.record_collective(
+                    self._backend, "fused_class", op, (total,), _dtype,
+                    np.dtype(_dtype).itemsize, world, in_trace=in_trace,
+                    source="reducer", tag=tags, states=len(idxs),
+                )
+            with _telemetry.attribution(tags):
+                if len(idxs) == 1:
+                    i = idxs[0]
+                    results[i] = self._backend.all_reduce(self._entries[i][0], op, group=self._group)
+                    continue
+                vals = [self._entries[i][0] for i in idxs]
+                flat = jnp.concatenate([v.ravel() for v in vals])
+                reduced = self._backend.all_reduce(flat, op, group=self._group)
+                offset = 0
+                for i, v in zip(idxs, vals):
+                    results[i] = reduced[offset : offset + v.size].reshape(v.shape)
+                    offset += v.size
+        if recording:
+            _telemetry.record_flush(self._backend, len(self._entries), len(classes), in_trace)
         self._results = results  # type: ignore[assignment]
 
     def result(self, handle: int) -> Array:

@@ -1,0 +1,205 @@
+"""Sync-schedule fingerprinting and cross-rank lockstep verification.
+
+The eager multi-host sync protocol (``MultiHostBackend`` over DCN) requires
+**every rank to issue the same collectives in the same order**: candidate
+selection for a sync depends on per-rank flags (``_computed`` cache,
+``_is_synced``, ``_to_sync``), so a single rank with, say, a cached compute
+value would silently skip its collectives and deadlock every other rank —
+ADVICE r5 #3.  This module converts that hang into a diagnosable error:
+
+1. each rank normalizes its *intended* collective schedule — an ordered list
+   of ``(tag, op, dtype, shape)`` entries — and hashes it;
+2. the digests (plus the schedules themselves, for diagnosis) are exchanged
+   over the backend's existing host-object channel
+   (:meth:`DistributedBackend.all_gather_object`) **before** any state
+   collective is issued;
+3. a mismatch raises :class:`LockstepViolation` naming the diverging rank and
+   the first differing schedule entry.
+
+In-trace backends (``AxisBackend``) have no host round trip: they skip the
+exchange and only record the fingerprint into the collective ledger.  The
+exchange itself is one extra small object gather per verified flush; disable
+it globally with :func:`configure` (``lockstep_verification=False``) when the
+round trip matters more than the diagnosis.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, List, Optional, Sequence, Tuple
+
+from tpumetrics.telemetry import ledger as _ledger
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+__all__ = [
+    "LockstepViolation",
+    "configure",
+    "lockstep_verification_enabled",
+    "normalize_schedule",
+    "schedule_fingerprint",
+    "should_verify",
+    "verify_lockstep",
+]
+
+_VERIFY = True
+
+
+def configure(lockstep_verification: Optional[bool] = None) -> None:
+    """Toggle the digest exchange (the ledger fingerprint is always recorded)."""
+    global _VERIFY
+    if lockstep_verification is not None:
+        _VERIFY = bool(lockstep_verification)
+
+
+def lockstep_verification_enabled() -> bool:
+    return _VERIFY
+
+
+def should_verify(backend: Any) -> bool:
+    """Whether a digest exchange over ``backend`` is possible and enabled:
+    eager (not in-trace), object-capable, spanning more than one rank."""
+    if (
+        not _VERIFY
+        or getattr(backend, "in_trace", False)
+        or not getattr(backend, "has_object_channel", False)
+    ):
+        return False
+    try:
+        return backend.world_size() > 1
+    except Exception:
+        return False
+
+
+class LockstepViolation(TPUMetricsUserError):
+    """Ranks disagree on the collective schedule of an eager sync.
+
+    Raised on *every* participating rank (the exchanged schedules are
+    identical inputs to an identical comparison), so no rank is left blocked
+    in a half-issued sync.
+    """
+
+
+ScheduleEntry = Tuple[str, str, str, Tuple[int, ...]]  # (tag, op, dtype, shape)
+
+
+def normalize_schedule(entries: Sequence[Sequence[Any]]) -> List[ScheduleEntry]:
+    """Canonicalize schedule entries to hashable (tag, op, dtype, shape) tuples.
+
+    ``shape`` participates only for reduce-op entries: gather-style states
+    legitimately differ in dim-0 across ranks (pad-gather-trim), so their
+    shape must not enter the fingerprint.
+    """
+    out: List[ScheduleEntry] = []
+    for entry in entries:
+        tag, op, dtype, shape = entry
+        op = str(op)
+        shape = tuple(int(d) for d in shape) if op in ("sum", "mean", "max", "min") else ()
+        out.append((str(tag), op, str(dtype), shape))
+    return out
+
+
+def schedule_fingerprint(entries: Sequence[Sequence[Any]]) -> str:
+    """Stable digest of a normalized schedule."""
+    norm = normalize_schedule(entries)
+    return hashlib.sha1(repr(norm).encode()).hexdigest()
+
+
+def _rank_of(backend: Any) -> int:
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return -1
+
+
+def verify_lockstep(
+    backend: Any,
+    entries: Sequence[Sequence[Any]],
+    context: str = "",
+    group: Optional[Any] = None,
+) -> Optional[str]:
+    """Fingerprint ``entries`` and, on eager multi-rank backends, exchange
+    digests and raise :class:`LockstepViolation` on mismatch.
+
+    Returns the local digest (handy for tests/logging).  The exchange is
+    skipped — only the ledger fingerprint is recorded — when the backend is
+    in-trace, has no host-object channel, spans a single rank, or
+    verification is disabled via :func:`configure`.
+
+    The happy path ships only the fixed-size digest; the full schedules are
+    exchanged in a second gather ONLY on mismatch, to name the diverging
+    rank and the first differing entry.  Blame assignment: with a strict
+    majority digest the outlier rank is named; without one (e.g. two ranks)
+    the disagreement is reported symmetrically — two ranks cannot tell who
+    is "right".
+    """
+    norm = normalize_schedule(entries)
+    digest = hashlib.sha1(repr(norm).encode()).hexdigest()
+    in_trace = bool(getattr(backend, "in_trace", False))
+    _ledger.record_event(
+        backend, "lockstep", in_trace=in_trace, digest=digest, entries=len(norm), context=context
+    )
+    if not should_verify(backend):
+        return digest
+
+    digests = list(backend.all_gather_object(digest, group=group))
+    if len(set(digests)) == 1:
+        return digest
+
+    # mismatch: one more exchange ships the schedules for the diagnosis
+    schedules = [
+        [tuple(e) if not isinstance(e, tuple) else e for e in s]
+        for s in backend.all_gather_object(norm, group=group)
+    ]
+    counts: dict = {}
+    for d in digests:
+        counts[d] = counts.get(d, 0) + 1
+    best = max(counts.values())
+    majority = [d for d, c in counts.items() if c == best]
+    where = f" in {context}" if context else ""
+    hint = (
+        " Every rank must enter an eager multi-host sync with the same metric flags"
+        " (_computed cache, _is_synced, _to_sync) and the same compute-group merges"
+        " (auto-discovered groups merge on value-identical states after the first"
+        " rank-local update, so borderline data can group differently per rank) —"
+        " see docs/telemetry.md."
+    )
+    if best > len(digests) // 2 and len(majority) == 1:
+        ref_digest = majority[0]
+        ref_rank = digests.index(ref_digest)
+        bad_rank = next(r for r, d in enumerate(digests) if d != ref_digest)
+        idx, ref_entry, bad_entry = _first_difference(schedules[ref_rank], schedules[bad_rank])
+        raise LockstepViolation(
+            f"Cross-rank sync-schedule mismatch{where}: rank {bad_rank} diverges from the"
+            f" majority (rank {ref_rank}'s schedule) at entry {idx}: rank {ref_rank}"
+            f" intends {ref_entry}, rank {bad_rank} intends {bad_entry} (local rank"
+            f" {_rank_of(backend)}, digests {digests})." + hint
+        )
+    # no strict majority (e.g. exactly two ranks): symmetric report
+    rank_a = 0
+    rank_b = next(r for r in range(1, len(digests)) if digests[r] != digests[0])
+    idx, entry_a, entry_b = _first_difference(schedules[rank_a], schedules[rank_b])
+    raise LockstepViolation(
+        f"Cross-rank sync-schedule mismatch{where}: ranks {rank_a} and {rank_b} disagree"
+        f" at schedule entry {idx}: rank {rank_a} intends {entry_a}, rank {rank_b} intends"
+        f" {entry_b} (local rank {_rank_of(backend)}, digests {digests})." + hint
+    )
+
+
+def _first_difference(sched_a: List[Any], sched_b: List[Any]) -> Tuple[int, str, str]:
+    idx = next(
+        (i for i, (a, b) in enumerate(zip(sched_a, sched_b)) if _entry(a) != _entry(b)),
+        min(len(sched_a), len(sched_b)),
+    )
+    entry_a = _entry(sched_a[idx]) if idx < len(sched_a) else "<no entry>"
+    entry_b = _entry(sched_b[idx]) if idx < len(sched_b) else "<no entry>"
+    return idx, entry_a, entry_b
+
+
+def _entry(e: Any) -> str:
+    try:
+        tag, op, dtype, shape = e
+        return f"(tag={tag!r}, op={op}, dtype={dtype}, shape={tuple(shape)})"
+    except Exception:
+        return repr(e)

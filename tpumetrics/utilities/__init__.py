@@ -52,7 +52,28 @@ class _UtilitiesAliasFinder(_importlib_abc.MetaPathFinder):
         sub = fullname[len(self._prefix) :]
         if sub not in _SUBMODULES:
             return None
-        return _importlib_util.find_spec(f"tpumetrics.utils.{sub}")
+        spec = _importlib_util.find_spec(f"tpumetrics.utils.{sub}")
+        if spec is None:
+            return None
+        # Serve a spec whose identity matches the REQUESTED name: returning
+        # the tpumetrics.utils spec unchanged breaks the identical-object
+        # guarantee on any path that actually executes the spec (e.g.
+        # importlib.reload of an alias module after sys.modules surgery),
+        # producing a module whose __name__/__spec__.name disagree with its
+        # sys.modules key.  File loaders also name-check exec_module, so the
+        # loader is re-instantiated under the alias name.
+        import copy as _copy
+
+        alias_spec = _copy.copy(spec)
+        alias_spec.name = fullname
+        loader = getattr(spec, "loader", None)
+        loader_path = getattr(loader, "path", None) or spec.origin
+        if loader is not None and loader_path:
+            try:
+                alias_spec.loader = type(loader)(fullname, loader_path)
+            except TypeError:
+                pass  # exotic loader signature: keep the original loader
+        return alias_spec
 
 
 if not any(isinstance(f, _UtilitiesAliasFinder) for f in _sys.meta_path):

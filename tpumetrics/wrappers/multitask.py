@@ -103,16 +103,27 @@ class MultitaskWrapper(WrapperMetric):
             if isinstance(m, Metric):
                 out[name] = m.functional_compute(state[name], axis_name=axis_name, backend=backend)
             else:  # MetricCollection's bridge takes axis_name only; an
-                # explicit backend syncs its whole state first
-                task_state = m.sync_states(state[name], backend) if backend is not None else state[name]
+                # explicit backend syncs its whole state first — unless
+                # axis_name is also given, where axis wins (mirroring
+                # Metric.functional_compute, which replaces `backend` with
+                # AxisBackend(axis_name)); syncing with both would merge the
+                # collection task's states twice, inflating sum states by
+                # world_size while Metric tasks sync once (ADVICE r5 #1)
+                task_state = (
+                    m.sync_states(state[name], backend)
+                    if backend is not None and axis_name is None
+                    else state[name]
+                )
                 out[name] = m.functional_compute(task_state, axis_name=axis_name)
         return out
 
     def _sync_state_collect(self, state: Dict[str, Any], backend: Any, reducer: Any, group: Any = None) -> Any:
-        finalizers = {
-            name: m._sync_state_collect(state[name], backend, reducer, group)
-            for name, m in self.task_metrics.items()
-        }
+        from tpumetrics.telemetry import ledger as _telemetry
+
+        finalizers = {}
+        for name, m in self.task_metrics.items():
+            with _telemetry.attribution(name):
+                finalizers[name] = m._sync_state_collect(state[name], backend, reducer, group)
         return lambda: {name: fin() for name, fin in finalizers.items()}
 
     sync_state = Metric.sync_state
