@@ -817,6 +817,120 @@ def _bench_bertscore_ddp():
     }
 
 
+# -------------------------------------------------------- streaming runtime
+
+
+def _ragged_stream(n_batches=60, num_classes=32, seed=0):
+    """A serving-shaped stream: every batch a different leading dimension."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    # >= 50 DISTINCT ragged sizes (the acceptance scenario): a permutation of
+    # 1..n_batches guarantees uniqueness; the naive jitted path compiles once
+    # per size, the bucketed path once per bucket edge it touches
+    sizes = rng.permutation(np.arange(1, n_batches + 1)).tolist()
+    stream = []
+    for n in sizes:
+        stream.append(
+            (
+                jnp.asarray(rng.standard_normal((int(n), num_classes), dtype=np.float32)),
+                jnp.asarray(rng.integers(0, num_classes, int(n)).astype(np.int32)),
+            )
+        )
+    return stream
+
+
+def _bench_streaming_throughput():
+    """StreamingEvaluator (async + shape-bucketed, compile-per-bucket) vs the
+    naive per-shape-jitted update loop over the same ragged stream.
+
+    ``vs_baseline`` here is naive_time / streaming_time over an identical
+    stream — the win is the bounded compile universe (the naive path pays one
+    XLA compile per distinct batch shape).  Extras report both compile counts
+    and verify the preemption contract: a kill-then-restore_latest() run must
+    compute() bit-identically to the uninterrupted run.
+    """
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpumetrics.classification import MulticlassAccuracy
+    from tpumetrics.runtime import StreamingEvaluator
+
+    C = 32
+
+    def make():
+        return MulticlassAccuracy(num_classes=C, average="micro", validate_args=False)
+
+    stream = _ragged_stream(num_classes=C)
+    n_items = sum(int(p.shape[0]) for p, _ in stream)
+
+    def streaming_once():
+        ev = StreamingEvaluator(make(), buckets=64)
+        t0 = time.perf_counter()
+        with ev:
+            for p, t in stream:
+                ev.submit(p, t)
+            val = ev.compute()
+        jax.block_until_ready(val)
+        return (time.perf_counter() - t0) * 1e6, float(val), ev.stats()["xla_compiles"]
+
+    def naive_once():
+        metric = make()
+        step = jax.jit(lambda state, p, t: metric.functional_update(state, p, t))
+        shapes = set()
+        state = metric.init_state()
+        t0 = time.perf_counter()
+        for p, t in stream:
+            shapes.add((p.shape, t.shape))
+            state = step(state, p, t)
+        val = metric.functional_compute(state)
+        jax.block_until_ready(val)
+        return (time.perf_counter() - t0) * 1e6, float(val), len(shapes)
+
+    # interleaved min-of-k like every other config; the first streaming round
+    # pays the per-bucket compiles, later rounds hit jit caches on both sides
+    s_times, n_times = [], []
+    s_val = n_val = None
+    s_compiles = n_compiles = None
+    for _ in range(3):
+        us, s_val, s_compiles = streaming_once()
+        s_times.append(us)
+        us, n_val, n_compiles = naive_once()
+        n_times.append(us)
+    ours, ref = min(s_times), min(n_times)
+
+    # preemption contract: kill mid-stream, restore, replay — bit-identical
+    snap_dir = tempfile.mkdtemp(prefix="tpum_snap_")
+    ev = StreamingEvaluator(make(), buckets=64, snapshot_dir=snap_dir, snapshot_every=20)
+    for p, t in stream[:37]:
+        ev.submit(p, t)
+    ev.flush()
+    ev.close(drain=False)  # "kill": no final snapshot past the last boundary
+    ev2 = StreamingEvaluator(make(), buckets=64, snapshot_dir=snap_dir)
+    pos = ev2.restore_latest()
+    with ev2:
+        for p, t in stream[pos:]:
+            ev2.submit(p, t)
+        restored_val = float(ev2.compute())
+
+    assert s_val is not None and abs(s_val - n_val) < 1e-7, (s_val, n_val)
+    # both acceptance invariants fail the scenario loudly, not quietly
+    assert restored_val == s_val, f"restore not bit-identical: {restored_val} != {s_val}"
+    assert s_compiles <= 7, f"bucketed path compiled {s_compiles} > len(buckets)=7 programs"
+    extras = {
+        "items_per_sec": n_items / (ours * 1e-6),
+        "naive_items_per_sec": n_items / (ref * 1e-6),
+        "distinct_shapes": n_compiles,
+        "streaming_compiles": s_compiles,
+        "naive_compiles": n_compiles,
+        "restore_bit_identical": bool(restored_val == s_val),
+        "restore_replay_from": pos,
+    }
+    return ours, ref, {"extras": extras}
+
+
 def _enable_compilation_cache() -> None:
     """Persistent XLA compile cache: one-time eager/jit compiles (expensive on
     remote-attached accelerators) amortize across bench runs, as they do in
@@ -861,6 +975,15 @@ def _check_floors(headline_vs, details):
             got = entry.get("wire_bytes_per_step")
             if got is not None and got > ceiling:
                 violations.append(f"{name}: wire_bytes_per_step {got} > ceiling {ceiling}")
+    # compile ceilings: a bucketed config recompiling per shape is a regression
+    for name, ceiling in gate.get("compile_ceilings", {}).items():
+        entry = details.get(name)
+        if isinstance(entry, dict):
+            got = entry.get("streaming_compiles")
+            if got is not None and got > ceiling:
+                violations.append(f"{name}: streaming_compiles {got} > ceiling {ceiling}")
+        elif entry is not None:  # scenario errored: its invariants did not run
+            violations.append(f"{name}: scenario failed ({entry})")
     return violations
 
 
@@ -883,6 +1006,7 @@ def main() -> None:
         ("fid_stream_update", _bench_fid),
         ("lpips_stream_update", _bench_lpips),
         ("bertscore_ddp_eval", _bench_bertscore_ddp),
+        ("streaming_throughput", _bench_streaming_throughput),
     ):
         try:
             ours, ref, accounting = fn()

@@ -143,6 +143,12 @@ _check_examples()
 os.makedirs(os.path.join(os.path.dirname(__file__), "metrics"), exist_ok=True)
 
 index_lines = ["# All metrics", "", "Generated from the live package (`python docs/_gen_index.py`).", ""]
+_RUNTIME_NOTE = (
+    "Every metric listed here (and any `MetricCollection` of them) can be wrapped by "
+    "[`StreamingEvaluator`](runtime.md) for async ingestion, shape-bucketed batching, and "
+    "preemption-safe snapshots; metrics whose states are all `sum`/`max`/`min` tensors take "
+    "the jitted bucketed path, the rest run the eager path (`buckets=None`)."
+)
 total = 0
 for d in DOMS:
     mod = importlib.import_module(f"tpumetrics.{d}")
@@ -193,6 +199,7 @@ for d in DOMS:
 index_lines.insert(3, f"**{total} metric classes**, each with a `tpumetrics.functional.*`"
                       " counterpart where the reference has one. Click through for"
                       " per-metric args, shapes, and examples.\n")
+index_lines.insert(4, _RUNTIME_NOTE + "\n")
 out = os.path.join(os.path.dirname(__file__), "metrics_index.md")
 open(out, "w", encoding="utf-8").write("\n".join(index_lines) + "\n")
 print("wrote", out)

@@ -49,6 +49,14 @@ from tpumetrics import MetricCollection
 from tpumetrics.aggregation import MeanMetric
 from tpumetrics.classification import MulticlassAccuracy, MulticlassF1Score
 
+try:  # jax >= 0.5 exposes shard_map at top level
+    _shard_map = lambda f, **kw: jax.shard_map(f, check_vma=False, **kw)  # noqa: E731
+    jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _sm
+
+    _shard_map = lambda f, **kw: _sm(f, check_rep=False, **kw)  # noqa: E731
+
 NUM_CLASSES = 10
 BATCH = 512  # global batch, sharded over the dp axis
 STEPS_PER_EPOCH = 20
@@ -112,12 +120,11 @@ def main():
         return params, opt_state, new_state, loss
 
     step = jax.jit(
-        jax.shard_map(
+        _shard_map(
             train_step,
             mesh=mesh,
             in_specs=(P(), P(), P("dp"), P("dp"), P("dp")),
             out_specs=(P(), P(), P("dp"), P()),
-            check_vma=False,
         ),
         donate_argnums=(2,),
     )
@@ -137,8 +144,8 @@ def main():
             vals["loss"] = loss_metric.functional_compute(loss_state, axis_name="dp")
             return vals
 
-        return jax.shard_map(
-            _compute, mesh=mesh, in_specs=(P("dp"),), out_specs=P(), check_vma=False
+        return _shard_map(
+            _compute, mesh=mesh, in_specs=(P("dp"),), out_specs=P()
         )(metric_state)
 
     for epoch in range(EPOCHS):

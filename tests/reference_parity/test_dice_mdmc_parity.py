@@ -7,7 +7,7 @@ import pytest
 
 @pytest.mark.parametrize("average", ["micro", "macro"])
 @pytest.mark.parametrize("mdmc_average", ["global", "samplewise"])
-@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("seed", [0, pytest.param(1, marks=pytest.mark.slow)])
 def test_dice_mdmc_matches_reference(ref, average, mdmc_average, seed):
     import jax.numpy as jnp
     import torch

@@ -601,7 +601,23 @@ C("pw_minkowski", "pairwise_minkowski_distance", "pairwise.pairwise_minkowski_di
 C("pw_cosine_self_zero_diag", "pairwise_cosine_similarity", "pairwise.pairwise_cosine_similarity", lambda rng: (rng.normal(0, 1, (9, 5)).astype(np.float32),), kwargs={"zero_diagonal": True})
 
 
-@pytest.mark.parametrize("case", CASES, ids=[c.name for c in CASES])
+# tier-1 budget (ROADMAP): the exhaustive stat-family sweep (one base variant
+# per functional stays non-slow) and the iterative/filter-heavy image/audio
+# cases run in the slow lane (-m slow); the non-slow set still covers every
+# functional at least once
+_HEAVY_CASES = {"ms_ssim", "vif", "sdr", "sdr_loaddiag"}
+
+
+def _case_marks(name):
+    slow = name.startswith("sweep_") or name in _HEAVY_CASES
+    return (pytest.mark.slow,) if slow else ()
+
+
+@pytest.mark.parametrize(
+    "case",
+    [pytest.param(c, marks=_case_marks(c.name)) for c in CASES],
+    ids=[c.name for c in CASES],
+)
 def test_functional_parity(ref, case):
     case.run()
 

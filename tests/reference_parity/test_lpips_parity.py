@@ -15,7 +15,7 @@ import numpy as np
 import pytest
 
 
-@pytest.mark.parametrize("net_type", ["alex", "vgg", "squeeze"])
+@pytest.mark.parametrize("net_type", ["alex"] + [pytest.param(n, marks=pytest.mark.slow) for n in ("vgg", "squeeze")])
 @pytest.mark.parametrize("normalize", [False, True])
 def test_lpips_matches_reference_full_pipeline(ref, net_type, normalize):
     import jax.numpy as jnp

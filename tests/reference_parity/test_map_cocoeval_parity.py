@@ -88,7 +88,7 @@ def _assert_close(ours: dict, oracle: dict, keys=SCALAR_KEYS, atol: float = 1e-5
         )
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("seed", [0] + [pytest.param(s, marks=pytest.mark.slow) for s in (1, 2)])
 def test_cocoeval_shim_agrees_with_pure_torch_oracle(ref, seed):
     """Shim validation: on crowd-free corpora the COCOeval path must agree
     with the reference's independent pure-torch implementation."""
@@ -100,7 +100,7 @@ def test_cocoeval_shim_agrees_with_pure_torch_oracle(ref, seed):
     _assert_close(ours, via_cocoeval)
 
 
-@pytest.mark.parametrize("seed", [20, 21, 22, 23])
+@pytest.mark.parametrize("seed", [20] + [pytest.param(s, marks=pytest.mark.slow) for s in (21, 22, 23)])
 def test_bbox_crowd_parity(ref, seed):
     from tests.reference_parity._corpus import make_crowd_corpus
 
@@ -111,7 +111,7 @@ def test_bbox_crowd_parity(ref, seed):
     _assert_close(ours, oracle)
 
 
-@pytest.mark.parametrize("seed", [30, 31])
+@pytest.mark.parametrize("seed", [30, pytest.param(31, marks=pytest.mark.slow)])
 def test_bbox_crowd_class_metrics_parity(ref, seed):
     from tests.reference_parity._corpus import make_crowd_corpus
 
@@ -122,7 +122,7 @@ def test_bbox_crowd_class_metrics_parity(ref, seed):
     _assert_close(ours, oracle, keys=["map_per_class", "mar_100_per_class"])
 
 
-@pytest.mark.parametrize("seed", [40, 41])
+@pytest.mark.parametrize("seed", [40, pytest.param(41, marks=pytest.mark.slow)])
 def test_bbox_maxdet_overflow_parity(ref, seed):
     from tests.reference_parity._corpus import make_overflow_corpus
 
@@ -133,7 +133,7 @@ def test_bbox_maxdet_overflow_parity(ref, seed):
     _assert_close(ours, oracle)
 
 
-@pytest.mark.parametrize("seed", [50, 51])
+@pytest.mark.parametrize("seed", [50, pytest.param(51, marks=pytest.mark.slow)])
 def test_segm_crowd_parity(ref, seed):
     from tests.reference_parity._corpus import boxes_to_masks, make_crowd_corpus
 

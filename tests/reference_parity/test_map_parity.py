@@ -86,7 +86,7 @@ def _assert_close(ours: dict, ref: dict, keys=SCALAR_KEYS, atol: float = 1e-5):
         )
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+@pytest.mark.parametrize("seed", [0] + [pytest.param(s, marks=pytest.mark.slow) for s in (1, 2, 3, 4)])
 def test_bbox_map_matches_reference(ref, seed):
     from tests.reference_parity._corpus import make_detection_corpus
 
@@ -96,7 +96,7 @@ def test_bbox_map_matches_reference(ref, seed):
     _assert_close(ours, oracle)
 
 
-@pytest.mark.parametrize("seed", [10, 11])
+@pytest.mark.parametrize("seed", [10, pytest.param(11, marks=pytest.mark.slow)])
 def test_bbox_map_class_metrics_matches_reference(ref, seed):
     from tests.reference_parity._corpus import make_detection_corpus
 
@@ -135,7 +135,7 @@ def test_bbox_map_box_formats_match_reference(ref, box_format):
     _assert_close(ours, oracle)
 
 
-@pytest.mark.parametrize("seed", [30, 31, 32])
+@pytest.mark.parametrize("seed", [30] + [pytest.param(s, marks=pytest.mark.slow) for s in (31, 32)])
 def test_segm_map_matches_reference(ref, seed):
     from tests.reference_parity._corpus import boxes_to_masks, make_detection_corpus
 

@@ -27,7 +27,7 @@ import numpy as np
 import pytest
 
 
-@pytest.mark.parametrize("fs,seconds", [(8000, 1.0), (16000, 0.8)])
+@pytest.mark.parametrize("fs,seconds", [(8000, 1.0), pytest.param(16000, 0.8, marks=pytest.mark.slow)])
 @pytest.mark.parametrize("norm", [False, True])
 def test_srmr_matches_reference(ref, fs, seconds, norm):
     import jax.numpy as jnp

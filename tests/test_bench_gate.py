@@ -17,6 +17,7 @@ def test_floor_file_shape():
         "fid_stream_update",
         "lpips_stream_update",
         "bertscore_ddp_eval",
+        "streaming_throughput",
     }
     # floors must sit below the recorded best (headroom for chip variance)
     for name, floor in data["floors"].items():
@@ -24,6 +25,23 @@ def test_floor_file_shape():
     # the wire-byte gate covers the synced-collection config
     assert "collection_sync_8dev" in data["wire_bytes_ceilings"]
     assert data["wire_bytes_ceilings"]["collection_sync_8dev"] > 0
+    # the compile gate pins the bucketed runtime config to its bucket count
+    assert data["compile_ceilings"]["streaming_throughput"] == 7
+
+
+def test_check_floors_flags_compile_regressions():
+    """A bucketed streaming config that recompiles beyond its bucket count
+    (e.g. a padding bug reintroducing per-shape shapes) must trip the gate
+    even at healthy throughput ratios; an errored scenario entry must trip
+    it too (its invariants never ran)."""
+    details = {"streaming_throughput": {"vs_baseline": 1000.0, "streaming_compiles": 60}}
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("streaming_compiles" in v for v in violations)
+    details["streaming_throughput"]["streaming_compiles"] = 7
+    assert bench._check_floors(headline_vs=1000.0, details=details) == []
+    details["streaming_throughput"] = "error: RuntimeError: boom"
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and "scenario failed" in violations[0]
 
 
 def test_check_floors_flags_regressions():

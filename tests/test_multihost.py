@@ -63,6 +63,71 @@ def _subprocess_env() -> dict:
     return env
 
 
+# jaxlib's CPU PJRT client may be built without cross-process collectives —
+# jax.distributed.initialize succeeds but the FIRST collective fails with
+# "Multiprocess computations aren't implemented on the CPU backend".  Probe
+# that capability once per session with a minimal 2-process allgather, and
+# skip (not fail) the pool scenarios when the build can't run them; any
+# OTHER probe failure is NOT treated as a missing capability, so real pool
+# regressions still surface through the normal pool run.
+_CAPABILITY_ERR = "Multiprocess computations aren't implemented"
+
+_PROBE_SCRIPT = r"""
+import sys
+import jax
+rank, world, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=world, process_id=rank
+)
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+out = multihost_utils.process_allgather(jnp.asarray([rank]), tiled=False)
+assert out.shape[0] == world, out.shape
+print("PROBE_OK")
+"""
+
+_PROBE_CACHE: dict = {}
+
+
+def _multiprocess_collectives_unsupported():
+    """Returns a skip reason when this jaxlib cannot run cross-process
+    collectives on CPU, else None.  Result cached for the session."""
+    if "reason" not in _PROBE_CACHE:
+        port = _free_port()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", _PROBE_SCRIPT, str(rank), "2", str(port)],
+                env=_subprocess_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, cwd=REPO_ROOT,
+            )
+            for rank in range(2)
+        ]
+        logs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=300)
+                logs.append(out)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+                p.communicate()
+            logs.append("probe timed out")
+        joined = "\n".join(logs)
+        _PROBE_CACHE["reason"] = (
+            "jaxlib CPU backend cannot run multiprocess collectives "
+            f"({_CAPABILITY_ERR!r}) — pool scenarios need a collectives-capable build"
+            if _CAPABILITY_ERR in joined
+            else None
+        )
+    return _PROBE_CACHE["reason"]
+
+
+def _skip_if_pool_unsupported():
+    reason = _multiprocess_collectives_unsupported()
+    if reason:
+        pytest.skip(reason)
+
+
 def _run_pool(world: int, tmpdir: str, timeout: float = 600.0):
     port = _free_port()
     procs = []
@@ -111,6 +176,7 @@ def mh_pool(tmp_path_factory):
 
     def get(world: int):
         if world not in _POOL_CACHE:
+            _skip_if_pool_unsupported()
             out = tmp_path_factory.mktemp(f"mh{world}")
             _POOL_CACHE[world] = _run_pool(world, str(out))
         return _POOL_CACHE[world]
@@ -433,6 +499,7 @@ def test_ranks_agree_on_everything(pool):
 def test_multihost_eval_example_multiprocess(tmp_path):
     """examples/multihost_eval.py in its real 2-process mode, values asserted
     against an in-process full-corpus recompute."""
+    _skip_if_pool_unsupported()
     from tpumetrics import MetricCollection
     from tpumetrics.classification import MulticlassAccuracy, MulticlassAUROC, MulticlassF1Score
 

@@ -30,6 +30,7 @@ from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 Array = jax.Array
 
@@ -87,6 +88,20 @@ def buffer_append(buf: MaskedBuffer, batch: Array, valid: Optional[Array] = None
     )
 
 
+def buffer_append_bucketed(buf: MaskedBuffer, padded: Array, n_valid: Array) -> MaskedBuffer:
+    """Append the first ``n_valid`` rows of a bucket-padded batch.
+
+    The runtime's shape-bucketed ingestion (``tpumetrics/runtime/bucketing``)
+    pads ragged batches to a fixed set of edge sizes; this is the
+    buffer-side half of that convention — the pad rows are routed to the
+    dump slot by the derived mask, so a buffer-backed ("cat"-style) state
+    absorbs a padded batch with static shapes and exact contents.
+    """
+    padded = jnp.asarray(padded)
+    valid = jnp.arange(padded.shape[0]) < jnp.asarray(n_valid)
+    return buffer_append(buf, padded, valid=valid)
+
+
 def buffer_extend(buf: MaskedBuffer, other: MaskedBuffer) -> MaskedBuffer:
     """Append another buffer's valid rows (used when merging a batch state
     into a global state, e.g. ``forward``'s reduce-state merge).
@@ -121,10 +136,32 @@ def buffer_all_gather(buf: MaskedBuffer, backend: Any, group: Optional[Any] = No
     (in-trace: one XLA all_gather over ICI; eager: DCN process gather).
 
     Two wire ops per buffer: the values gather and one packed (count,
-    requested) scalar gather.
+    requested) scalar gather.  Both are reported to the telemetry ledger
+    here as logical ``"buffer_gather"`` records (``source="reducer"``, like
+    a :class:`~tpumetrics.parallel.fuse.FusedReducer` flush reports its
+    fused classes) so buffer-backed metrics keep wire-byte attribution even
+    through a custom/uninstrumented backend; instrumented backends
+    additionally record the actual wire calls (``source="backend"``) —
+    aggregation never double counts because only backend-source records add
+    to the wire totals.
     """
+    from tpumetrics.telemetry import ledger as _telemetry
+
+    packed = jnp.stack([buf.count, buf.requested]).astype(jnp.int32)
+    if _telemetry.recording():  # static metadata only — trace-safe
+        try:
+            world = int(backend.world_size())
+        except Exception:
+            world = 1
+        in_trace = bool(getattr(backend, "in_trace", False))
+        for arr in (buf.values, packed):
+            _telemetry.record_collective(
+                backend, "buffer_gather", "gather", tuple(jnp.shape(arr)),
+                arr.dtype, np.dtype(arr.dtype).itemsize,
+                world, in_trace=in_trace, source="reducer", capacity=buf.capacity,
+            )
     vals = backend.all_gather(buf.values, group)  # list of (cap, *f)
-    meta = backend.all_gather(jnp.stack([buf.count, buf.requested]).astype(jnp.int32), group)
+    meta = backend.all_gather(packed, group)
     stacked = jnp.stack(list(vals))
     meta_arr = jnp.stack([jnp.reshape(m, (2,)) for m in meta])  # (W, 2)
     merged = buffer_compact(stacked, meta_arr[:, 0])

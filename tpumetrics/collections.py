@@ -370,6 +370,47 @@ class MetricCollection:
         for name, m in self._modules.items():
             m.load_state_dict(state_dict, prefix=f"{name}.", strict=strict)
 
+    # -------------------------------------------------- snapshot hooks (runtime)
+
+    def state_spec(self) -> Dict[str, Dict[str, Any]]:
+        """Per-member state specs (name -> member spec), group state
+        propagated first so member specs reflect current values."""
+        self._compute_groups_create_state_ref(copy=False)
+        return {name: m.state_spec() for name, m in self._modules.items()}
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        """Collection-level runtime snapshot: each member's full
+        :meth:`~tpumetrics.metric.Metric.snapshot_state`, leaders propagated
+        to group members first so the snapshot is self-contained (a restore
+        does not need to know the compute-group layout that produced it)."""
+        self._compute_groups_create_state_ref(copy=False)
+        return {"metrics": {name: m.snapshot_state() for name, m in self._modules.items()}}
+
+    def load_snapshot_state(self, snap: Dict[str, Any], strict: bool = True) -> None:
+        """Restore a :meth:`snapshot_state` payload; member name mismatches
+        raise before any member state is touched."""
+        from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+        metrics = snap.get("metrics")
+        if not isinstance(metrics, dict):
+            raise TPUMetricsUserError(
+                "Not a MetricCollection snapshot (missing 'metrics' mapping)."
+            )
+        missing = [k for k in self._modules if k not in metrics]
+        unexpected = [k for k in metrics if k not in self._modules] if strict else []
+        if missing or unexpected:
+            raise TPUMetricsUserError(
+                "Snapshot members incompatible with this collection: "
+                + "; ".join(
+                    ([f"missing {missing}"] if missing else [])
+                    + ([f"unexpected {unexpected}"] if unexpected else [])
+                )
+            )
+        for name, m in self._modules.items():
+            m.load_snapshot_state(metrics[name], strict=strict)
+        # every member now holds exact restored values — no propagation owed
+        self._state_is_copy = True
+
     # ------------------------------------------------------------- containers
 
     def add_metrics(

@@ -911,6 +911,125 @@ class Metric(ABC):
             elif strict and self._persistent[key]:
                 raise KeyError(f"Missing key {name!r} in state_dict")
 
+    # -------------------------------------------------- snapshot hooks (runtime)
+
+    def state_spec(self) -> Dict[str, Dict[str, Any]]:
+        """Static description of every registered state — ``name -> {kind,
+        shape, dtype, reduce}`` — the compatibility contract that snapshot
+        restore validates against (``tpumetrics/runtime/snapshot.py``).
+
+        ``kind`` is ``"array"`` for tensor states, ``"list"`` for eager list
+        states (with the current length), or ``"buffer"`` for list states
+        with a declared fixed capacity.
+        """
+        spec: Dict[str, Dict[str, Any]] = {}
+        for name, default in self._defaults.items():
+            val = getattr(self, name)
+            reduction_fn = self._reductions[name]
+            op = _reduce_fn_to_op(reduction_fn)
+            entry: Dict[str, Any]
+            if isinstance(default, list):
+                if name in self._buffer_specs:
+                    cap, fshape, fdtype = self._buffer_specs[name]
+                    entry = {
+                        "kind": "buffer",
+                        "capacity": cap,
+                        "feature_shape": list(fshape),
+                        "dtype": str(jnp.dtype(fdtype) if fdtype is not None else self._dtype),
+                    }
+                else:
+                    entry = {"kind": "list", "length": len(val) if isinstance(val, list) else None}
+            else:
+                entry = {"kind": "array", "shape": list(jnp.shape(val)), "dtype": str(jnp.asarray(val).dtype)}
+            entry["reduce"] = op if op is not None else ("custom" if callable(reduction_fn) else None)
+            spec[name] = entry
+        return spec
+
+    @contextmanager
+    def _all_persistent(self) -> Generator[None, None, None]:
+        """Temporarily mark every state persistent so ``state_dict``/
+        ``load_state_dict`` cover the FULL state (snapshots must capture
+        non-persistent accumulators too)."""
+        saved = dict(self._persistent)
+        for key in self._persistent:
+            self._persistent[key] = True
+        try:
+            yield
+        finally:
+            self._persistent = saved
+
+    def _config_fingerprint(self) -> Dict[str, Any]:
+        """JSON-able instance configuration (num_classes, average, thresholds,
+        …): every plain-scalar public attribute.  Snapshots carry it so a
+        restore into a differently-configured metric fails loudly even when
+        every registered state is an eager list (whose shapes alone cannot
+        reveal the mismatch — e.g. samplewise statscores)."""
+        return {
+            k: (list(v) if isinstance(v, tuple) else v)
+            for k, v in vars(self).items()
+            if not k.startswith("_")
+            and (
+                v is None
+                or isinstance(v, (bool, int, float, str))
+                or (isinstance(v, tuple) and all(isinstance(x, (bool, int, float, str)) for x in v))
+            )
+        }
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        """Full runtime snapshot of this metric: every state (persistent or
+        not, as host arrays via :meth:`state_dict`) plus the update counter
+        and config fingerprint — the payload
+        :mod:`tpumetrics.runtime.snapshot` persists atomically."""
+        with self._all_persistent():
+            states = self.state_dict()
+        return {
+            "states": states,
+            "update_count": int(self._update_count),
+            "config": self._config_fingerprint(),
+        }
+
+    def load_snapshot_state(self, snap: Dict[str, Any], strict: bool = True) -> None:
+        """Restore a :meth:`snapshot_state` payload, validating the state
+        spec (names, shapes, dtypes of tensor states) AND the config
+        fingerprint before touching any state so a mismatched restore fails
+        atomically with a clear error."""
+        states = snap["states"]
+        problems = []
+        saved_cfg = snap.get("config")
+        if strict and saved_cfg is not None:
+            own_cfg = self._config_fingerprint()
+            for key in sorted(set(saved_cfg) | set(own_cfg)):
+                a, b = saved_cfg.get(key, "<absent>"), own_cfg.get(key, "<absent>")
+                # snapshot headers round-trip through JSON: scalar numpy
+                # leaves stay python scalars, so plain != is the right test
+                if a != b:
+                    problems.append(f"config {key}: snapshot {a!r} != this metric {b!r}")
+        for name, default in self._defaults.items():
+            if name not in states:
+                problems.append(f"missing state {name!r}")
+                continue
+            val = states[name]
+            if not isinstance(default, list):
+                want_shape, want_dtype = jnp.shape(getattr(self, name)), jnp.asarray(getattr(self, name)).dtype
+                got = jnp.asarray(val)
+                if tuple(got.shape) != tuple(want_shape) or got.dtype != want_dtype:
+                    problems.append(
+                        f"{name}: snapshot {got.dtype}{tuple(got.shape)} != expected {want_dtype}{tuple(want_shape)}"
+                    )
+        if strict:
+            problems.extend(f"unexpected state {k!r}" for k in states if k not in self._defaults)
+        if problems:
+            raise TPUMetricsUserError(
+                f"Snapshot state spec incompatible with {type(self).__name__}: " + "; ".join(problems)
+                + ". HINT: the metric configuration must match the one that wrote the snapshot."
+            )
+        with self._all_persistent():
+            self.load_state_dict(states, strict=strict)
+        self._update_count = int(snap.get("update_count", self._update_count))
+        self._computed = None
+        self._cache = None
+        self._is_synced = False
+
     # ------------------------------------------------------------ dev / dtype
 
     @property

@@ -1,0 +1,63 @@
+"""``tpumetrics.runtime`` — the streaming evaluation runtime.
+
+The layer between the L1 metric core (state pytrees, functional updates,
+fused sync) and a serving system: it owns **ingestion** (async dispatch off
+the request path), **shape discipline** (bucketed padding so ragged traffic
+compiles once per bucket), and **recovery** (preemption-safe snapshots with
+replay positions).  See ``docs/runtime.md`` for the guide.
+
+- :mod:`~tpumetrics.runtime.dispatch` — bounded async queue + backpressure
+  (block / drop-oldest / error) + worker draining micro-batches, with queue
+  depth and drop counts reported into the telemetry ledger.
+- :mod:`~tpumetrics.runtime.bucketing` — pow-2 or user-supplied bucket
+  edges, row-0 padding, and the exact masked-update semantics (native
+  ``valid`` mask or delta-correction fallback).
+- :mod:`~tpumetrics.runtime.snapshot` — atomic write-rename snapshots,
+  CRC-verified, monotonically step-tagged, restored against a validated
+  state spec.
+- :mod:`~tpumetrics.runtime.evaluator` — :class:`StreamingEvaluator`, the
+  facade tying the three together with ``compute_every(n)``
+  bounded-staleness results and clean queue-flushing shutdown.
+"""
+
+from tpumetrics.runtime.bucketing import (
+    NotBucketableError,
+    ShapeBucketer,
+    check_bucketable,
+    masked_functional_update,
+    pow2_bucket_edges,
+)
+from tpumetrics.runtime.dispatch import AsyncDispatcher, DispatcherClosedError, QueueFullError
+from tpumetrics.runtime.evaluator import StreamingEvaluator
+from tpumetrics.runtime.snapshot import (
+    SnapshotError,
+    SnapshotIntegrityError,
+    SnapshotManager,
+    SnapshotSpecError,
+    list_snapshots,
+    load_snapshot,
+    restore,
+    restore_latest,
+    save_snapshot,
+)
+
+__all__ = [
+    "AsyncDispatcher",
+    "DispatcherClosedError",
+    "NotBucketableError",
+    "QueueFullError",
+    "ShapeBucketer",
+    "SnapshotError",
+    "SnapshotIntegrityError",
+    "SnapshotManager",
+    "SnapshotSpecError",
+    "StreamingEvaluator",
+    "check_bucketable",
+    "list_snapshots",
+    "load_snapshot",
+    "masked_functional_update",
+    "pow2_bucket_edges",
+    "restore",
+    "restore_latest",
+    "save_snapshot",
+]

@@ -1,0 +1,236 @@
+"""Shape-bucketed padding: compile once per bucket, not once per batch shape.
+
+Serving traffic is ragged — every request batch has a different leading
+dimension, and a jitted update path would recompile for each novel shape
+(XLA caches executables by input shape).  The runtime's answer is the same
+static-shape idea as :class:`~tpumetrics.buffers.MaskedBuffer`: pad every
+batch up to a small fixed set of **bucket edges** and carry the true row
+count beside the data, so the compiled-program universe is bounded by
+``len(edges)`` regardless of how many distinct raw shapes the stream
+produces.
+
+Padding convention (load-bearing): pad rows are **copies of row 0** of the
+batch, never zeros.  Row 0 is always real data, so metrics whose reduce
+states are row-wise ``max``/``min`` see a no-op contribution from padding,
+and the ``sum`` correction below needs only one extra single-row update.
+
+Masked update semantics — how padded rows are kept out of the state:
+
+1. **Native mask path.**  A metric whose ``update`` signature declares a
+   ``valid`` keyword receives the boolean mask directly
+   (``arange(bucket) < n_valid``) and owns exact masking itself — the
+   :meth:`~tpumetrics.metric.Metric._append_state` convention routes it into
+   :class:`~tpumetrics.buffers.MaskedBuffer` appends in-trace.
+2. **Delta-correction fallback** (any metric with only
+   ``sum``/``max``/``min`` tensor states).  One padded-batch update and one
+   single-row (row 0) update, both from the default state, reconstruct the
+   exact valid-only transition::
+
+       contrib_all = U(init, padded)[s] - init[s]          # k valid + (B-k) pad rows
+       contrib_pad = (B - k) * (U(init, row0)[s] - init[s])  # the pad rows exactly
+       sum:      new[s] = state[s] + contrib_all - contrib_pad
+       max/min:  new[s] = op(state[s], U(init, padded)[s])   # row-0 dups are neutral
+
+   Exactness requires the update to be **row-separable** (each row's
+   contribution independent of the others — true of counting/statscores/
+   moment-style metrics); integer sum states stay exact because the pad
+   correction is a product, never a division.  Metrics with ``mean``/
+   ``cat``/custom/list states and no native ``valid`` parameter are
+   rejected at construction with :class:`NotBucketableError` — silent
+   approximation is worse than a loud error.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.metric import Metric, _reduce_fn_to_op
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+Array = jax.Array
+
+_FALLBACK_OPS = ("sum", "max", "min")
+
+
+class NotBucketableError(TPUMetricsUserError):
+    """The metric cannot take padded (bucketed) updates exactly."""
+
+
+def pow2_bucket_edges(max_size: int, min_size: int = 1) -> Tuple[int, ...]:
+    """Power-of-two bucket edges ``min_size..>=max_size`` (each edge doubles)."""
+    if min_size <= 0 or max_size < min_size:
+        raise ValueError(f"Need 0 < min_size <= max_size, got {min_size}, {max_size}")
+    edges: List[int] = []
+    e = 1
+    while e < min_size:
+        e *= 2
+    while True:
+        edges.append(e)
+        if e >= max_size:
+            break
+        e *= 2
+    return tuple(edges)
+
+
+class ShapeBucketer:
+    """Maps ragged leading dimensions onto a fixed set of padded sizes.
+
+    Args:
+        edges: strictly increasing bucket sizes.  A batch of ``n`` rows pads
+            to the smallest edge ``>= n``; batches larger than the top edge
+            are split into top-edge chunks first (:meth:`chunk_sizes`).
+    """
+
+    def __init__(self, edges: Sequence[int]) -> None:
+        edges = tuple(int(e) for e in edges)
+        if not edges:
+            raise ValueError("Need at least one bucket edge")
+        if any(e <= 0 for e in edges) or list(edges) != sorted(set(edges)):
+            raise ValueError(f"Bucket edges must be strictly increasing positives, got {edges}")
+        self.edges = edges
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest edge >= n (n must fit the top edge; see chunk_sizes)."""
+        if n <= 0:
+            raise ValueError(f"Batch must be non-empty, got {n} rows")
+        for e in self.edges:
+            if n <= e:
+                return e
+        raise ValueError(
+            f"Batch of {n} rows exceeds the largest bucket edge {self.edges[-1]}; "
+            "split it first (chunk_sizes) or widen the edges."
+        )
+
+    def chunk_sizes(self, n: int) -> List[int]:
+        """Split an arbitrary row count into bucketable chunk sizes."""
+        top = self.edges[-1]
+        sizes = [top] * (n // top)
+        if n % top:
+            sizes.append(n % top)
+        return sizes
+
+    def pad_args(self, args: Sequence[Any], n: int) -> Tuple[Tuple[Any, ...], int]:
+        """Pad every per-row array in ``args`` (leading dim == n) to the
+        bucket edge with row-0 copies; returns (padded_args, bucket)."""
+        bucket = self.bucket_for(n)
+        if bucket == n:
+            return tuple(args), bucket
+        out = []
+        for a in args:
+            if _is_per_row(a, n):
+                a = jnp.asarray(a)
+                pad = jnp.broadcast_to(a[0:1], (bucket - n,) + a.shape[1:])
+                out.append(jnp.concatenate([a, pad], axis=0))
+            else:
+                out.append(a)
+        return tuple(out), bucket
+
+
+def _is_per_row(a: Any, n: int) -> bool:
+    return hasattr(a, "shape") and getattr(a, "ndim", 0) >= 1 and a.shape[0] == n
+
+
+def _has_native_valid(metric: Metric) -> bool:
+    return "valid" in metric._update_signature.parameters
+
+
+def _check_metric_bucketable(metric: Metric, label: str) -> None:
+    if _has_native_valid(metric):
+        return
+    bad = {
+        attr: (_reduce_fn_to_op(fn) or ("list" if isinstance(metric._defaults[attr], list) else "custom"))
+        for attr, fn in metric._reductions.items()
+        if isinstance(metric._defaults[attr], list) or _reduce_fn_to_op(fn) not in _FALLBACK_OPS
+    }
+    if bad:
+        raise NotBucketableError(
+            f"Metric {label} cannot take padded (bucketed) updates: state(s) "
+            f"{bad} are outside the exact delta-correction fallback "
+            f"(supported: tensor states with {_FALLBACK_OPS} reduce). "
+            "HINT: add a `valid` mask parameter to update() (the "
+            "MaskedBuffer convention), or run the evaluator with buckets=None."
+        )
+
+
+def check_bucketable(obj: Any) -> None:
+    """Validate that a Metric / MetricCollection supports exact bucketed
+    updates; raises :class:`NotBucketableError` naming the offending state."""
+    from tpumetrics.collections import MetricCollection
+
+    if isinstance(obj, MetricCollection):
+        for cg in obj._groups.values():
+            _check_metric_bucketable(obj._modules[cg[0]], label=repr(cg[0]))
+        return
+    if isinstance(obj, Metric):
+        _check_metric_bucketable(obj, label=type(obj).__name__)
+        return
+    raise TypeError(f"Expected Metric or MetricCollection, got {type(obj)}")
+
+
+# ------------------------------------------------------------- masked update
+
+
+def _masked_metric_update(
+    metric: Metric,
+    state: Dict[str, Any],
+    padded: Tuple[Any, ...],
+    n_valid: Array,
+    bucket: int,
+    kwargs: Dict[str, Any],
+) -> Dict[str, Any]:
+    """One exact bucketed state transition for a single Metric (traceable)."""
+    if _has_native_valid(metric):
+        mask = jnp.arange(bucket) < n_valid
+        return metric.functional_update(state, *padded, valid=mask, **kwargs)
+
+    init = metric.init_state()
+    after_all = metric.functional_update(metric.init_state(), *padded, **kwargs)
+    row0 = tuple(a[0:1] if _is_per_row(a, bucket) else a for a in padded)
+    after_one = metric.functional_update(metric.init_state(), *row0, **kwargs)
+
+    n_pad = jnp.asarray(bucket) - n_valid
+    out: Dict[str, Any] = {}
+    for attr, fn in metric._reductions.items():
+        op = _reduce_fn_to_op(fn)
+        if op == "sum":
+            contrib_all = after_all[attr] - init[attr]
+            contrib_one = after_one[attr] - init[attr]
+            out[attr] = state[attr] + contrib_all - n_pad.astype(contrib_one.dtype) * contrib_one
+        elif op == "max":
+            out[attr] = jnp.maximum(state[attr], after_all[attr])
+        elif op == "min":
+            out[attr] = jnp.minimum(state[attr], after_all[attr])
+        else:  # unreachable after check_bucketable
+            raise NotBucketableError(f"State {attr!r} ({op}) has no exact masked update")
+    return out
+
+
+def masked_functional_update(
+    obj: Any,
+    state: Dict[str, Any],
+    padded: Tuple[Any, ...],
+    n_valid: Array,
+    bucket: int,
+    kwargs: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Exact bucketed state transition for a Metric or MetricCollection.
+
+    ``state`` is the functional state pytree (collection: per-group-leader
+    dict), ``padded`` the bucket-padded positional args, ``n_valid`` the true
+    row count (traced scalar), ``bucket`` the static padded size.
+    """
+    from tpumetrics.collections import MetricCollection
+
+    kwargs = kwargs or {}
+    if isinstance(obj, MetricCollection):
+        out = {}
+        for cg in obj._groups.values():
+            m0 = obj._modules[cg[0]]
+            out[cg[0]] = _masked_metric_update(
+                m0, state[cg[0]], padded, n_valid, bucket, m0._filter_kwargs(**kwargs)
+            )
+        return out
+    return _masked_metric_update(obj, state, padded, n_valid, bucket, kwargs)

@@ -1,0 +1,242 @@
+"""Async ingestion: a bounded queue + worker thread feeding metric updates.
+
+The serving-path contract of the runtime (see ``docs/runtime.md``): request
+threads call :meth:`AsyncDispatcher.submit` — O(enqueue), never a device
+step — and a single worker thread drains **micro-batches** into the drain
+callback (a :class:`~tpumetrics.runtime.evaluator.StreamingEvaluator` step,
+or any callable taking a list of items).  JAX dispatch, padding, and the
+jitted update therefore never block the request path; the queue is the only
+coupling, and it is bounded.
+
+Backpressure policy when the queue is full (``max_queue`` items):
+
+- ``"block"``   — ``submit`` waits until the worker frees a slot (lossless;
+  the request path absorbs the latency).
+- ``"drop_oldest"`` — evict the oldest queued item and enqueue the new one
+  (bounded-staleness lossy ingestion; drops are counted and reported).
+- ``"error"``   — raise :class:`QueueFullError` immediately (the caller owns
+  the retry/shed decision).
+
+Observability: drops and drain cycles report into the telemetry ledger
+(:mod:`tpumetrics.telemetry`) as payload-free events — ``runtime_drop``
+per eviction burst and ``runtime_drain`` per worker cycle (carrying queue
+depth and batch size) — and :meth:`AsyncDispatcher.stats` exposes cheap
+process-local counters (enqueued / drained / dropped / max depth) without
+requiring a ledger.
+
+A worker-side exception poisons the dispatcher: it is captured, the worker
+stops, and the exception re-raises (wrapped, original as ``__cause__``) from
+the next ``submit``/``flush``/``close`` so ingestion errors cannot vanish
+silently on a daemon thread.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from tpumetrics.telemetry import ledger as _telemetry
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+_POLICIES = ("block", "drop_oldest", "error")
+
+
+class QueueFullError(TPUMetricsUserError):
+    """Raised by ``submit`` under the ``"error"`` backpressure policy."""
+
+
+class DispatcherClosedError(TPUMetricsUserError):
+    """Raised when submitting to a closed (or poisoned) dispatcher."""
+
+
+class AsyncDispatcher:
+    """Bounded async queue draining micro-batches into a callback off-thread.
+
+    Args:
+        drain_fn: called from the worker thread with a non-empty ``list`` of
+            queued items (at most ``max_batch`` per call).
+        max_queue: queue capacity in items (> 0).
+        policy: backpressure policy — ``"block"`` | ``"drop_oldest"`` |
+            ``"error"`` (module docstring).
+        max_batch: micro-batch ceiling per drain call; ``None`` drains
+            everything currently queued in one call.
+        name: attribution tag for telemetry events (e.g. the evaluator's
+            metric class name).
+
+    Thread safety: ``submit`` may be called from many threads; ``flush`` /
+    ``close`` from any thread.  ``drain_fn`` only ever runs on the single
+    worker thread, so a non-thread-safe consumer (a Metric) is safe.
+    """
+
+    def __init__(
+        self,
+        drain_fn: Callable[[List[Any]], None],
+        *,
+        max_queue: int = 64,
+        policy: str = "block",
+        max_batch: Optional[int] = None,
+        name: str = "",
+    ) -> None:
+        if policy not in _POLICIES:
+            raise ValueError(f"Unknown backpressure policy {policy!r}; expected one of {_POLICIES}")
+        if int(max_queue) <= 0:
+            raise ValueError(f"max_queue must be positive, got {max_queue}")
+        if max_batch is not None and int(max_batch) <= 0:
+            raise ValueError(f"max_batch must be positive or None, got {max_batch}")
+        self._drain_fn = drain_fn
+        self._max_queue = int(max_queue)
+        self._policy = policy
+        self._max_batch = int(max_batch) if max_batch is not None else None
+        self._name = name or type(self).__name__
+
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._idle = threading.Condition(self._lock)  # queue empty AND worker not draining
+        self._draining = False
+        self._closed = False
+        self._error: Optional[BaseException] = None
+
+        # counters (read under lock by stats())
+        self._enqueued = 0
+        self._drained_items = 0
+        self._drain_cycles = 0
+        self._dropped = 0
+        self._max_depth = 0
+
+        self._worker = threading.Thread(
+            target=self._run, name=f"tpumetrics-dispatch[{self._name}]", daemon=True
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------- producers
+
+    def submit(self, item: Any, timeout: Optional[float] = None) -> None:
+        """Enqueue one item, applying the backpressure policy when full."""
+        with self._lock:
+            self._check_alive()
+            if len(self._q) >= self._max_queue:
+                if self._policy == "error":
+                    raise QueueFullError(
+                        f"Dispatch queue full ({self._max_queue} items) under policy='error'. "
+                        "HINT: raise max_queue, slow the producer, or use 'block'/'drop_oldest'."
+                    )
+                if self._policy == "drop_oldest":
+                    self._q.popleft()
+                    self._dropped += 1
+                    _telemetry.record_event(self, "runtime_drop", dropped_total=self._dropped)
+                else:  # block
+                    while len(self._q) >= self._max_queue:
+                        self._check_alive()
+                        if not self._not_full.wait(timeout=timeout):
+                            raise QueueFullError(
+                                f"Timed out after {timeout}s waiting for queue space "
+                                f"({self._max_queue} items, policy='block')."
+                            )
+            self._q.append(item)
+            self._enqueued += 1
+            self._max_depth = max(self._max_depth, len(self._q))
+            self._not_empty.notify()
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Block until every queued item has been drained (worker idle)."""
+        with self._lock:
+            while (self._q or self._draining) and self._error is None:
+                if not self._idle.wait(timeout=timeout):
+                    raise TimeoutError(
+                        f"Dispatch queue did not drain within {timeout}s "
+                        f"(depth={len(self._q)}, draining={self._draining})."
+                    )
+            self._check_alive(allow_closed=True)
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        """Stop the worker; by default drain the queue first.  Idempotent."""
+        with self._lock:
+            if self._closed and not self._worker.is_alive():
+                self._check_alive(allow_closed=True)
+                return
+            if not drain:
+                self._q.clear()
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+        self._worker.join(timeout=timeout)
+        if self._worker.is_alive():
+            raise TimeoutError(f"Dispatch worker did not stop within {timeout}s.")
+        with self._lock:
+            self._check_alive(allow_closed=True)
+
+    def __enter__(self) -> "AsyncDispatcher":
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> None:
+        # on an exception in the with-body, don't mask it with a drain error
+        try:
+            self.close(drain=exc_type is None)
+        except Exception:
+            if exc_type is None:
+                raise
+
+    # --------------------------------------------------------------- observe
+
+    def stats(self) -> Dict[str, int]:
+        """Cheap process-local counters (no ledger required)."""
+        with self._lock:
+            return {
+                "depth": len(self._q),
+                "max_depth": self._max_depth,
+                "enqueued": self._enqueued,
+                "drained_items": self._drained_items,
+                "drain_cycles": self._drain_cycles,
+                "dropped": self._dropped,
+            }
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # ---------------------------------------------------------------- worker
+
+    def _check_alive(self, allow_closed: bool = False) -> None:
+        if self._error is not None:
+            raise DispatcherClosedError(
+                f"Dispatch worker died: {type(self._error).__name__}: {self._error}"
+            ) from self._error
+        if self._closed and not allow_closed:
+            raise DispatcherClosedError("Dispatcher is closed.")
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while not self._q and not self._closed:
+                    self._not_empty.wait()
+                if not self._q and self._closed:
+                    self._idle.notify_all()
+                    return
+                n = len(self._q) if self._max_batch is None else min(len(self._q), self._max_batch)
+                batch = [self._q.popleft() for _ in range(n)]
+                depth_after = len(self._q)
+                self._draining = True
+                self._not_full.notify_all()
+            try:
+                self._drain_fn(batch)
+            except BaseException as err:  # noqa: BLE001 — poison, don't lose it
+                with self._lock:
+                    self._error = err
+                    self._draining = False
+                    self._q.clear()
+                    self._not_full.notify_all()
+                    self._idle.notify_all()
+                return
+            with self._lock:
+                self._drained_items += n
+                self._drain_cycles += 1
+                self._draining = False
+                _telemetry.record_event(
+                    self, "runtime_drain", items=n, depth=depth_after,
+                    drained_total=self._drained_items,
+                )
+                if not self._q:
+                    self._idle.notify_all()

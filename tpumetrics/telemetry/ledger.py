@@ -136,6 +136,14 @@ class CollectiveLedger:
             self.fused_entries += int(rec.extra.get("entries", 0))
         elif rec.kind == "lockstep":
             self.lockstep_fingerprints += 1
+        elif rec.kind == "runtime_drop":
+            # the streaming runtime's drop-oldest evictions (dispatch.py)
+            self.runtime_drops += 1
+        elif rec.kind == "runtime_drain":
+            # one worker drain cycle: micro-batch size + queue depth after
+            self.runtime_drain_cycles += 1
+            self.runtime_items_drained += int(rec.extra.get("items", 0))
+            self.runtime_max_depth = max(self.runtime_max_depth, int(rec.extra.get("depth", 0)))
         self.counts_by_kind[rec.kind] = self.counts_by_kind.get(rec.kind, 0) + 1
         for sink in self._sinks:
             sink.emit(rec)
@@ -148,6 +156,10 @@ class CollectiveLedger:
         self.flush_count = 0
         self.fused_entries = 0
         self.lockstep_fingerprints = 0
+        self.runtime_drops = 0
+        self.runtime_drain_cycles = 0
+        self.runtime_items_drained = 0
+        self.runtime_max_depth = 0
         self.bytes_by_op: Dict[str, float] = {}
         self.counts_by_kind: Dict[str, int] = {}
 
@@ -172,6 +184,10 @@ class CollectiveLedger:
             "flush_count": self.flush_count,
             "fused_entries": self.fused_entries,
             "lockstep_fingerprints": self.lockstep_fingerprints,
+            "runtime_drops": self.runtime_drops,
+            "runtime_drain_cycles": self.runtime_drain_cycles,
+            "runtime_items_drained": self.runtime_items_drained,
+            "runtime_max_depth": self.runtime_max_depth,
             "records": len(self.records),
         }
 
