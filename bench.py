@@ -931,6 +931,75 @@ def _bench_streaming_throughput():
     return ours, ref, {"extras": extras}
 
 
+def _bench_resilience_overhead():
+    """Cost of the SyncPolicy guard when NO fault fires (tpumetrics.resilience).
+
+    Two numbers, two gates:
+
+    - ``vs_baseline`` = inert_sync_us / armed_sync_us over an identical eager
+      fused sync loop (fault-injection backend with an EMPTY schedule, so the
+      guard is engaged but nothing ever fires).  Armed mode pays one watchdog
+      thread per guarded collective; the floor in bench_floors.json bounds
+      how much that may cost relative to the unguarded sync.
+    - ``inert_overhead_ns_per_call`` — the production default: with an inert
+      policy the guard must collapse to a predicate check.  Measured as the
+      per-call delta between ``run_guarded(fn)`` and ``fn()`` over a large
+      loop; gated by a ceiling (resilience_overhead_ceilings).
+    """
+    from tpumetrics.classification import MulticlassStatScores
+    from tpumetrics.parallel.backend import NoOpBackend
+    from tpumetrics.resilience import FaultInjectionBackend, SyncPolicy, run_guarded, sync_policy
+
+    backend = FaultInjectionBackend(NoOpBackend(), faults=())  # nothing ever fires
+    metric = MulticlassStatScores(num_classes=64, average=None, validate_args=False)
+    metric.sync_backend = backend
+    metric.distributed_available_fn = lambda: True
+    rng = np.random.default_rng(11)
+    import jax.numpy as jnp
+
+    preds = jnp.asarray(rng.standard_normal((256, 64)), jnp.float32)
+    target = jnp.asarray(rng.integers(0, 64, (256,)), jnp.int32)
+    metric.update(preds, target)
+
+    K = 50
+
+    def sync_loop_once():
+        t0 = time.perf_counter()
+        for _ in range(K):
+            metric._computed = None
+            metric.compute()  # eager fused sync through the guarded flush
+        return (time.perf_counter() - t0) * 1e6 / K
+
+    armed = SyncPolicy(timeout=30.0, retries=2)
+    armed_times, inert_times = [], []
+    for _ in range(3):
+        with sync_policy(armed):
+            armed_times.append(sync_loop_once())
+        inert_times.append(sync_loop_once())
+    ours, ref = min(armed_times), min(inert_times)
+    assert backend.fired == [], f"no fault was scheduled, yet {backend.fired} fired"
+
+    # inert fast path: run_guarded must be ~a predicate check per call
+    N = 50_000
+    fn = int  # cheapest stable callable
+    t0 = time.perf_counter()
+    for _ in range(N):
+        fn()
+    direct = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(N):
+        run_guarded(fn, op="noop", backend=backend)
+    guarded = time.perf_counter() - t0
+    inert_overhead_ns = max(0.0, (guarded - direct) / N * 1e9)
+
+    extras = {
+        "armed_added_us_per_sync": round(ours - ref, 2),
+        "inert_overhead_ns_per_call": round(inert_overhead_ns, 1),
+        "guarded_collectives_per_sync": 1,  # 4 same-dtype sum states fuse to one class
+    }
+    return ours, ref, {"extras": extras}
+
+
 def _enable_compilation_cache() -> None:
     """Persistent XLA compile cache: one-time eager/jit compiles (expensive on
     remote-attached accelerators) amortize across bench runs, as they do in
@@ -969,21 +1038,27 @@ def _check_floors(headline_vs, details):
         got = measured.get(name)
         if got is not None and got < floor:
             violations.append(f"{name}: vs_baseline {got} < floor {floor}")
-    for name, ceiling in ceilings.items():
-        entry = details.get(name)
+    def check_ceiling(config, key, ceiling, fail_on_error):
+        """One ceiling check: details[config][key] must not exceed ceiling;
+        an errored scenario entry optionally trips the gate too (its
+        invariants never ran)."""
+        entry = details.get(config)
         if isinstance(entry, dict):
-            got = entry.get("wire_bytes_per_step")
+            got = entry.get(key)
             if got is not None and got > ceiling:
-                violations.append(f"{name}: wire_bytes_per_step {got} > ceiling {ceiling}")
+                violations.append(f"{config}: {key} {got} > ceiling {ceiling}")
+        elif entry is not None and fail_on_error:
+            violations.append(f"{config}: scenario failed ({entry})")
+
+    for name, ceiling in ceilings.items():
+        check_ceiling(name, "wire_bytes_per_step", ceiling, fail_on_error=False)
+    # resilience ceilings: the inert SyncPolicy guard must stay ~free on the
+    # hot path (a predicate check per collective, not a thread or a lock)
+    for key, ceiling in gate.get("resilience_overhead_ceilings", {}).items():
+        check_ceiling("resilience_overhead", key, ceiling, fail_on_error=True)
     # compile ceilings: a bucketed config recompiling per shape is a regression
     for name, ceiling in gate.get("compile_ceilings", {}).items():
-        entry = details.get(name)
-        if isinstance(entry, dict):
-            got = entry.get("streaming_compiles")
-            if got is not None and got > ceiling:
-                violations.append(f"{name}: streaming_compiles {got} > ceiling {ceiling}")
-        elif entry is not None:  # scenario errored: its invariants did not run
-            violations.append(f"{name}: scenario failed ({entry})")
+        check_ceiling(name, "streaming_compiles", ceiling, fail_on_error=True)
     return violations
 
 
@@ -1007,6 +1082,7 @@ def main() -> None:
         ("lpips_stream_update", _bench_lpips),
         ("bertscore_ddp_eval", _bench_bertscore_ddp),
         ("streaming_throughput", _bench_streaming_throughput),
+        ("resilience_overhead", _bench_resilience_overhead),
     ):
         try:
             ours, ref, accounting = fn()

@@ -367,6 +367,45 @@ def run_scenarios(rank: int, world: int) -> dict:
     except telemetry.LockstepViolation as err:
         results["lockstep_violation"] = str(err)
 
+    # --- resilience over real DCN: armed SyncPolicy, no faults -------------
+    # the watchdog engages for every eager collective (MultiHostBackend,
+    # world > 1); with nothing stalling, values must match the unguarded
+    # sync exactly and nothing may be marked degraded
+    from tpumetrics import resilience as _res
+    from tpumetrics.resilience import Fault, FaultInjectionBackend, SyncPolicy
+
+    armed = MulticlassAccuracy(num_classes=7, average="micro")
+    armed.update(jnp.asarray(logits), jnp.asarray(labels))
+    with _res.sync_policy(SyncPolicy(timeout=120.0, retries=1)):
+        armed_val = float(armed.compute())
+    results["resilience_armed"] = {
+        "value": armed_val,
+        "degraded": bool(armed.degraded),
+        "guard_applies": SyncPolicy(timeout=1.0).applies(backend),
+    }
+
+    # --- deterministic all-rank stall -> typed timeout -> degraded local ---
+    # LAST scenario on purpose: every rank's fused flush stalls (30s) behind
+    # a 0.5s deadline, so each rank gets SyncTimeoutError and serves its
+    # local shard value.  The stalled watchdog threads are daemons sleeping
+    # longer than the process lives, so no orphan collective is ever issued
+    # to interleave with other traffic.
+    stall = MulticlassAccuracy(num_classes=7, average="micro")
+    stall.update(jnp.asarray(logits), jnp.asarray(labels))
+    local_ref = MulticlassAccuracy(num_classes=7, average="micro", sync_on_compute=False)
+    local_ref.update(jnp.asarray(logits), jnp.asarray(labels))
+    stall.sync_backend = FaultInjectionBackend(
+        backend, [Fault("stall", op="all_reduce", delay=30.0, count=99)], available=True
+    )
+    with _res.sync_policy(SyncPolicy(timeout=0.5, on_failure="local")):
+        stalled_val = float(stall.compute())
+    results["resilience_stall"] = {
+        "degraded": bool(stall.degraded),
+        "mode": stall.degraded_mode,
+        "value": stalled_val,
+        "local_expected": float(local_ref.compute()),
+    }
+
     return results
 
 

@@ -18,6 +18,7 @@ def test_floor_file_shape():
         "lpips_stream_update",
         "bertscore_ddp_eval",
         "streaming_throughput",
+        "resilience_overhead",
     }
     # floors must sit below the recorded best (headroom for chip variance)
     for name, floor in data["floors"].items():
@@ -27,6 +28,8 @@ def test_floor_file_shape():
     assert data["wire_bytes_ceilings"]["collection_sync_8dev"] > 0
     # the compile gate pins the bucketed runtime config to its bucket count
     assert data["compile_ceilings"]["streaming_throughput"] == 7
+    # the resilience gate pins the inert guard to ~predicate cost
+    assert data["resilience_overhead_ceilings"]["inert_overhead_ns_per_call"] > 0
 
 
 def test_check_floors_flags_compile_regressions():
@@ -40,6 +43,26 @@ def test_check_floors_flags_compile_regressions():
     details["streaming_throughput"]["streaming_compiles"] = 7
     assert bench._check_floors(headline_vs=1000.0, details=details) == []
     details["streaming_throughput"] = "error: RuntimeError: boom"
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and "scenario failed" in violations[0]
+
+
+def test_check_floors_flags_resilience_overhead_regressions():
+    """An inert SyncPolicy guard that grew a real per-call cost (a lock, a
+    thread, a policy object allocation) must trip the gate even when the
+    armed-vs-inert ratio is healthy; an errored scenario trips it too."""
+    details = {
+        "resilience_overhead": {"vs_baseline": 0.9, "inert_overhead_ns_per_call": 10**6}
+    }
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("inert_overhead_ns_per_call" in v for v in violations)
+    details["resilience_overhead"]["inert_overhead_ns_per_call"] = 100.0
+    assert bench._check_floors(headline_vs=1000.0, details=details) == []
+    # below the armed-mode floor: the watchdog path itself regressed
+    details["resilience_overhead"]["vs_baseline"] = 0.01
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("resilience_overhead" in v for v in violations)
+    details["resilience_overhead"] = "error: RuntimeError: boom"
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and "scenario failed" in violations[0]
 

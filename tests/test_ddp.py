@@ -234,3 +234,73 @@ def test_gather_ragged_list_preserves_boundaries():
     assert len(merged) == 3
     assert merged[0].shape == (2, 4) and merged[1].shape == (3, 5) and merged[2].shape == (1, 7)
     assert abs(float(merged[2].mean()) - 3.0) < 1e-5
+
+
+# --------------------------------------------- all_reduce per-rank weighting
+#
+# MultiHostBackend inherits the default gather+local-reduce all_reduce, and
+# its all_gather pads/trims uneven dim-0 shapes.  These tests pin the reduce
+# semantics at that intersection: "mean" weights each RANK equally (divide by
+# world size — psum/pmean semantics), and uneven per-rank shapes must raise a
+# clear error rather than zero-pad into a silently-corrupted mean/min.
+
+
+class _FakePaddedGatherBackend:
+    """Stands in for MultiHostBackend's wire: per-rank payloads set at
+    construction, gathers replay the pad-gather-trim result (trimmed
+    per-rank shapes, exactly what the real backend hands all_reduce)."""
+
+    def __init__(self, per_rank):
+        self.per_rank = [jnp.asarray(v) for v in per_rank]
+
+    def available(self):
+        return True
+
+    def world_size(self):
+        return len(self.per_rank)
+
+    def all_gather(self, x, group=None):
+        return list(self.per_rank)
+
+
+def test_noop_all_reduce_mean_is_identity():
+    """World size 1: NoOpBackend's mean must be the rank's own value with
+    weight 1 — the degenerate case of equal per-rank weighting."""
+    from tpumetrics.parallel import NoOpBackend
+
+    be = NoOpBackend()
+    x = jnp.asarray([1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(np.asarray(be.all_reduce(x, "mean")), np.asarray(x))
+    np.testing.assert_array_equal(np.asarray(be.all_reduce(x, "sum")), np.asarray(x))
+
+
+def test_default_all_reduce_mean_weights_ranks_equally():
+    """The default gather+reduce path divides by WORLD SIZE, not by any
+    row count: a rank's mean-state contribution has weight 1/N regardless
+    of how much data produced it (matching psum/pmean semantics, which is
+    what MeanMetric-style states assume when they carry their own weight
+    state alongside)."""
+    from tpumetrics.parallel.backend import DistributedBackend
+
+    per_rank = [jnp.asarray([2.0, 4.0]), jnp.asarray([6.0, 8.0]), jnp.asarray([1.0, 3.0])]
+    be = _FakePaddedGatherBackend(per_rank)
+    got = DistributedBackend.all_reduce(be, per_rank[0], "mean")
+    want = np.mean(np.stack([np.asarray(v) for v in per_rank]), axis=0)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-6)
+    got_sum = DistributedBackend.all_reduce(be, per_rank[0], "sum")
+    np.testing.assert_allclose(np.asarray(got_sum), want * 3, atol=1e-6)
+
+
+@pytest.mark.parametrize("op", ["sum", "mean", "max", "min"])
+def test_default_all_reduce_uneven_dim0_raises(op):
+    """Pad-gather-trim hands all_reduce ragged per-rank arrays when dim-0
+    differs; reducing those is undefined (zero-padding would corrupt
+    mean/min silently, stacking raggeds would crash deep in jnp): the
+    default path must refuse with a clear typed error — TPUMetricsUserError,
+    so the resilience retry loop treats it as deterministic, not transient."""
+    from tpumetrics.parallel.backend import DistributedBackend
+    from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+    be = _FakePaddedGatherBackend([jnp.ones((2, 3)), jnp.ones((4, 3))])
+    with pytest.raises(TPUMetricsUserError, match="identical per-rank shapes"):
+        DistributedBackend.all_reduce(be, jnp.ones((2, 3)), op)

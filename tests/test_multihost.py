@@ -483,12 +483,43 @@ def test_induced_divergence_raises_lockstep_violation(pool):
             assert "ranks 0 and 1 disagree" in msg
 
 
+def test_resilience_armed_policy_over_dcn(pool):
+    """An armed SyncPolicy (watchdog per eager collective) over REAL DCN
+    collectives: guard engaged, values identical to the unguarded sync,
+    nothing degraded — the deadline machinery must be a no-op on healthy
+    traffic."""
+    world, results = pool
+    for res in results:
+        entry = res["resilience_armed"]
+        assert entry["guard_applies"] is True  # MultiHostBackend, world > 1
+        assert entry["degraded"] is False
+        assert abs(entry["value"] - res["metric_acc"]) < 1e-6
+
+
+def test_resilience_stall_degrades_to_local_on_every_rank(pool):
+    """Every rank's fused flush stalls behind a 0.5s deadline: each rank's
+    SyncTimeoutError is swallowed per on_failure='local' and the rank serves
+    its hand-checkable local shard value, marked degraded."""
+    world, results = pool
+    for res in results:
+        entry = res["resilience_stall"]
+        assert entry["degraded"] is True
+        assert entry["mode"] == "local"
+        assert abs(entry["value"] - entry["local_expected"]) < 1e-6
+
+
 def test_ranks_agree_on_everything(pool):
     world, results = pool
     for res in results[1:]:
         for key in results[0]:
-            if key in ("init", "bertscore_local_after_compute", "lockstep_violation"):
-                # lockstep_violation messages name the LOCAL rank
+            if key in (
+                "init",
+                "bertscore_local_after_compute",
+                "lockstep_violation",
+                "resilience_stall",
+            ):
+                # lockstep_violation messages name the LOCAL rank; the stall
+                # scenario's degraded value is each rank's LOCAL shard
                 continue
             assert res[key] == results[0][key], key
 

@@ -1,0 +1,532 @@
+"""tpumetrics.resilience: fault injection, bounded-time sync, degradation.
+
+Every scenario runs deterministically on ONE CPU host: the
+:class:`FaultInjectionBackend` wraps an eager backend and injects faults
+from a declarative schedule (per-op call indices), and
+``SyncPolicy.applies`` engages the guard for fault-injected backends even at
+world size 1 — no real multi-process collectives needed (the container's
+jaxlib cannot run them anyway; see tests/test_multihost.py).
+
+Timing asserts use generous ceilings: the container's wall clock swings ~2x
+run-to-run, so "the timeout fired within budget" is asserted against
+``deadline * 20``-style bounds, never tight ones.
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics import telemetry
+from tpumetrics.aggregation import MeanMetric, SumMetric
+from tpumetrics.classification import MulticlassAccuracy
+from tpumetrics.collections import MetricCollection
+from tpumetrics.parallel.backend import NoOpBackend, set_default_backend
+from tpumetrics.resilience import (
+    Fault,
+    FaultInjectionBackend,
+    InjectedFaultError,
+    NonFiniteStateError,
+    SyncFailedError,
+    SyncPolicy,
+    SyncTimeoutError,
+    run_guarded,
+    sync_policy,
+)
+from tpumetrics.runtime import CrashLoopError, StreamingEvaluator
+
+
+def _faulty_metric(metric, faults):
+    """Wire a metric to an eager fault-injection backend (world 1 inner)."""
+    backend = FaultInjectionBackend(NoOpBackend(), faults)
+    metric.sync_backend = backend
+    metric.distributed_available_fn = lambda: True
+    return metric, backend
+
+
+class _TwoRankEcho:
+    """Eager world-2 stand-in: both 'ranks' contribute identical payloads."""
+
+    in_trace = False
+    has_object_channel = True
+
+    def available(self):
+        return True
+
+    def world_size(self):
+        return 2
+
+    def all_gather(self, x, group=None):
+        return [x, x]
+
+    def all_gather_object(self, obj, group=None):
+        return [obj, obj]
+
+    def all_reduce(self, x, op, group=None):
+        return x + x if op == "sum" else x
+
+
+# ---------------------------------------------------------------- SyncPolicy
+
+
+def test_sync_policy_validation():
+    with pytest.raises(ValueError, match="timeout"):
+        SyncPolicy(timeout=0)
+    with pytest.raises(ValueError, match="retries"):
+        SyncPolicy(retries=-1)
+    with pytest.raises(ValueError, match="on_failure"):
+        SyncPolicy(on_failure="shrug")
+    with pytest.raises(ValueError, match="guard_non_finite"):
+        SyncPolicy(guard_non_finite="maybe")
+
+
+def test_sync_policy_applies():
+    inert = SyncPolicy()
+    bounded = SyncPolicy(timeout=1.0)
+    noop = NoOpBackend()
+    fib = FaultInjectionBackend(noop)
+
+    assert not inert.applies(fib)  # nothing to bound
+    assert not bounded.applies(noop)  # eager world 1: no wire op can stall
+    assert bounded.applies(fib)  # fault-injected: engage even at world 1
+    assert bounded.applies(_TwoRankEcho())  # eager multi-rank
+
+    class _InTrace:
+        in_trace = True
+
+    assert not bounded.applies(_InTrace())  # documented exemption
+
+
+def test_run_guarded_inert_policy_is_direct_call():
+    calls = []
+    out = run_guarded(lambda: calls.append(1) or 42, op="x", backend=FaultInjectionBackend(NoOpBackend()))
+    assert out == 42 and calls == [1]
+
+
+# ------------------------------------------------------- schedule determinism
+
+
+def test_fault_schedule_is_deterministic():
+    """Two identically-configured backends fire the exact same (op, index,
+    kind) sequence for the same collective traffic."""
+    schedule = [
+        Fault("error", op="all_reduce", call=1, count=2),
+        Fault("corrupt", op="all_gather", call=0),
+        Fault("drop_object", op="all_gather_object", call=2),
+    ]
+
+    def drive(backend):
+        for i in range(4):
+            try:
+                backend.all_reduce(jnp.asarray([1.0]), "sum")
+            except InjectedFaultError:
+                pass
+            backend.all_gather(jnp.asarray([float(i)]))
+        for _ in range(3):
+            backend.all_gather_object({"k": 1})
+        return list(backend.fired)
+
+    runs = [drive(FaultInjectionBackend(NoOpBackend(), schedule)) for _ in range(2)]
+    assert runs[0] == runs[1]
+    assert ("all_reduce", 1, "error") in runs[0] and ("all_reduce", 2, "error") in runs[0]
+    assert ("all_reduce", 0, "error") not in runs[0] and ("all_reduce", 3, "error") not in runs[0]
+    assert ("all_gather", 0, "corrupt") in runs[0]
+    assert ("all_gather_object", 2, "drop_object") in runs[0]
+
+
+def test_fault_ledger_events():
+    be = FaultInjectionBackend(NoOpBackend(), [Fault("error", op="all_reduce")])
+    with telemetry.capture() as led:
+        with pytest.raises(InjectedFaultError):
+            be.all_reduce(jnp.asarray([1.0]), "sum")
+    assert led.summary()["faults_injected"] == 1
+
+
+# -------------------------------------------------------------------- timeout
+
+
+def test_stall_times_out_within_budget():
+    """A 30s rank stall under a 0.5s deadline raises the typed error fast —
+    wall-clock bounded with a generous ceiling for the container's swing."""
+    m, _ = _faulty_metric(SumMetric(), [Fault("stall", op="all_reduce", delay=30.0)])
+    m.update(jnp.asarray([1.0, 2.0]))
+    t0 = time.monotonic()
+    with sync_policy(SyncPolicy(timeout=0.5)):
+        with pytest.raises(SyncTimeoutError) as exc:
+            m.compute()
+    elapsed = time.monotonic() - t0
+    assert elapsed < 10.0, f"timeout took {elapsed:.1f}s against a 0.5s deadline"
+    # the error names op, attribution tag, and attempt count
+    msg = str(exc.value)
+    assert "all_reduce[sum]" in msg
+    assert "SumMetric" in msg
+    assert "attempt 1" in msg
+
+
+def test_timeout_during_lockstep_digest_exchange():
+    """A dead rank in the digest exchange itself (before any state
+    collective) surfaces as a typed timeout, not a verifier deadlock."""
+    inner = _TwoRankEcho()
+    be = FaultInjectionBackend(inner, [Fault("stall", op="all_gather_object", delay=30.0)])
+    m = SumMetric()
+    m.sync_backend = be
+    m.distributed_available_fn = lambda: True
+    m.update(jnp.asarray([3.0]))
+    with sync_policy(SyncPolicy(timeout=0.5)):
+        with pytest.raises(SyncTimeoutError, match="lockstep_digest_exchange"):
+            m.compute()
+
+
+def test_dropped_digest_payload_raises_lockstep_violation():
+    from tpumetrics.telemetry import LockstepViolation
+
+    be = FaultInjectionBackend(_TwoRankEcho(), [Fault("drop_object", op="all_gather_object")])
+    m = SumMetric()
+    m.sync_backend = be
+    m.distributed_available_fn = lambda: True
+    m.update(jnp.asarray([3.0]))
+    with pytest.raises(LockstepViolation, match="lost the"):
+        m.compute()
+
+
+def test_timeout_fences_backend_until_abandoned_op_completes():
+    """After a timeout the backend refuses new guarded collectives (the
+    abandoned watchdog is still in-flight and a fresh op could mis-pair
+    ranks); once the abandoned op finishes, the fence clears and sync
+    works again."""
+    be = FaultInjectionBackend(NoOpBackend(), [Fault("stall", op="all_reduce", delay=3.0)])
+    m = SumMetric()
+    m.sync_backend = be
+    m.distributed_available_fn = lambda: True
+    m.update(jnp.asarray([2.0]))
+    with sync_policy(SyncPolicy(timeout=0.3)):
+        with pytest.raises(SyncTimeoutError):
+            m.compute()
+        with pytest.raises(SyncFailedError, match="refused"):  # fenced: fails fast
+            m.compute()
+        deadline = time.monotonic() + 30.0  # generous: container swings ~2x
+        while time.monotonic() < deadline:  # the 3s stall completes -> fence clears
+            time.sleep(0.2)
+            try:
+                value = m.compute()
+                break
+            except SyncFailedError:
+                continue
+        else:
+            pytest.fail("fence never cleared after the abandoned op completed")
+    assert float(value) == 2.0
+    assert not m.degraded
+
+
+# -------------------------------------------------------------------- retries
+
+
+def test_retry_then_succeed_leaves_ledger_records():
+    """Two transient failures, then success: the value is exact, the metric
+    is NOT degraded, and the ledger holds one sync_retry record per retry."""
+    m, be = _faulty_metric(SumMetric(), [Fault("error", op="all_reduce", call=0, count=2)])
+    m.update(jnp.asarray([4.0, 6.0]))
+    with telemetry.capture() as led:
+        with sync_policy(SyncPolicy(timeout=5.0, retries=3, backoff=0.01)):
+            value = m.compute()
+    assert float(value) == 10.0
+    assert not m.degraded
+    summary = led.summary()
+    assert summary["sync_retries"] == 2
+    assert summary["degraded_computes"] == 0
+    retry_recs = [r for r in led.records if r.kind == "sync_retry"]
+    assert [r.extra["attempt"] for r in retry_recs] == [1, 2]
+    assert be.fired == [("all_reduce", 0, "error"), ("all_reduce", 1, "error")]
+
+
+def test_retries_exhausted_raises_typed_error():
+    m, _ = _faulty_metric(SumMetric(), [Fault("error", op="all_reduce", count=99)])
+    m.update(jnp.asarray([1.0]))
+    with sync_policy(SyncPolicy(timeout=5.0, retries=1, backoff=0.01)):
+        with pytest.raises(SyncFailedError, match="after 2 attempt"):
+            m.compute()
+    assert float(m.sum_value) == 1.0  # local state untouched by the failed sync
+
+
+# -------------------------------------------------------- degraded-mode serving
+
+
+def test_on_failure_local_serves_local_state():
+    """Hand-computed reference: local accuracy from the unsynced state."""
+    preds = jnp.asarray([[0.8, 0.1, 0.1], [0.1, 0.8, 0.1], [0.8, 0.1, 0.1]])
+    target = jnp.asarray([0, 1, 1])  # local accuracy = 2/3
+    m, _ = _faulty_metric(
+        MulticlassAccuracy(num_classes=3, average="micro", validate_args=False),
+        [Fault("error", op="all_reduce", count=99)],
+    )
+    m.update(preds, target)
+    with telemetry.capture() as led:
+        with sync_policy(SyncPolicy(timeout=5.0, retries=0, backoff=0.01, on_failure="local")):
+            value = m.compute()
+    np.testing.assert_allclose(float(value), 2.0 / 3.0, atol=1e-6)
+    assert m.degraded and m.degraded_mode == "local"
+    assert led.summary()["degraded_computes"] == 1
+    rec = next(r for r in led.records if r.kind == "degraded_compute")
+    assert rec.extra["mode"] == "local" and rec.extra["metric"] == "MulticlassAccuracy"
+
+
+def test_on_failure_last_good_serves_previous_synced_result():
+    """First compute syncs fine (doubling backend: sum doubles), second sync
+    fails: the PREVIOUS synced value is served, marked degraded."""
+    inner = _TwoRankEcho()
+    be = FaultInjectionBackend(inner, [Fault("error", op="all_reduce", call=1, count=99)])
+    m = SumMetric()
+    m.sync_backend = be
+    m.distributed_available_fn = lambda: True
+    m.update(jnp.asarray([5.0]))
+    with sync_policy(SyncPolicy(timeout=5.0, on_failure="last_good")):
+        good = m.compute()
+        assert float(good) == 10.0  # 5 doubled by the echo "world of 2"
+        assert not m.degraded
+        m.update(jnp.asarray([100.0]))  # invalidates the compute cache
+        served = m.compute()  # sync now fails -> previous good result
+    assert float(served) == 10.0
+    assert m.degraded and m.degraded_mode == "last_good"
+    # local state still holds everything submitted (nothing was lost)
+    assert float(m.sum_value) == 105.0
+
+
+def test_on_failure_last_good_falls_back_to_local_when_none():
+    m, _ = _faulty_metric(SumMetric(), [Fault("error", op="all_reduce", count=99)])
+    m.update(jnp.asarray([7.0]))
+    with sync_policy(SyncPolicy(timeout=5.0, on_failure="last_good")):
+        value = m.compute()
+    assert float(value) == 7.0
+    assert m.degraded_mode == "local"  # no last_good existed yet
+
+
+def test_degradation_recovers_after_transient_window():
+    """Once the fault window passes, the next compute re-syncs and clears
+    the degraded flag."""
+    m, _ = _faulty_metric(SumMetric(), [Fault("error", op="all_reduce", call=0, count=1)])
+    m.update(jnp.asarray([2.0]))
+    with sync_policy(SyncPolicy(timeout=5.0, on_failure="local")):
+        assert float(m.compute()) == 2.0
+        assert m.degraded
+        m.update(jnp.asarray([3.0]))
+        value = m.compute()  # second all_reduce call: no fault scheduled
+    assert float(value) == 5.0
+    assert not m.degraded
+
+
+def test_collection_fused_flush_degrades_all_members():
+    """A SyncError inside the collection-wide fused flush degrades every
+    registered member (local values served) instead of raising/hanging."""
+    col = MetricCollection({"s": SumMetric(), "m": MeanMetric()})
+    col.update(jnp.asarray([1.0, 3.0]))
+    want = {k: float(v) for k, v in col.compute().items()}  # pre-distributed
+    be = FaultInjectionBackend(NoOpBackend(), [Fault("error", op="all_reduce", count=99)])
+    set_default_backend(be)
+    try:
+        for m in col.values():
+            m._computed = None  # force recompute under the faulty backend
+        with telemetry.capture() as led:
+            with sync_policy(SyncPolicy(timeout=5.0, on_failure="local")):
+                got = col.compute()
+        for k, v in want.items():
+            np.testing.assert_allclose(float(got[k]), v, atol=1e-6, err_msg=k)
+        assert col.degraded
+        assert led.summary()["degraded_computes"] >= 1
+        # flags restored for the next round
+        for m in col.values():
+            assert m._to_sync and not m._is_synced
+    finally:
+        set_default_backend(None)
+
+
+# ------------------------------------------------------------- payload screens
+
+
+def test_corrupt_fault_poisons_synced_value_deterministically():
+    m, be = _faulty_metric(SumMetric(), [Fault("corrupt", op="all_reduce")])
+    m.update(jnp.asarray([1.0, 2.0]))
+    with sync_policy(SyncPolicy(timeout=5.0)):
+        value = m.compute()
+    assert np.isnan(float(value))
+    assert be.fired == [("all_reduce", 0, "corrupt")]
+
+
+def test_guard_non_finite_error_blocks_sync():
+    """A NaN state is caught BEFORE the wire with a typed error naming the
+    state; on_failure='raise' propagates it."""
+    m, _ = _faulty_metric(SumMetric(nan_strategy="disable"), [])
+    m.update(jnp.asarray([float("nan"), 1.0]))
+    with sync_policy(SyncPolicy(timeout=5.0, guard_non_finite="error")):
+        with pytest.raises(NonFiniteStateError, match="SumMetric.sum_value"):
+            m.compute()
+
+
+def test_guard_non_finite_warn_records_event():
+    m, _ = _faulty_metric(SumMetric(nan_strategy="disable"), [])
+    m.update(jnp.asarray([float("inf")]))
+    with telemetry.capture() as led:
+        with sync_policy(SyncPolicy(timeout=5.0, guard_non_finite="warn")):
+            with pytest.warns(UserWarning, match="Non-finite"):
+                value = m.compute()
+    assert np.isinf(float(value))
+    assert led.summary()["non_finite_states"] == 1
+
+
+def test_snapshot_guard_non_finite():
+    from tpumetrics.runtime import snapshot as S
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        with pytest.raises(NonFiniteStateError, match="snapshot leaf"):
+            S.save_snapshot(d, 1, {"x": np.array([np.nan])}, guard_non_finite="error")
+        assert S.list_snapshots(d) == []  # nothing was persisted
+
+
+# -------------------------------------------------- acceptance: evaluator path
+
+
+def test_stalled_flush_then_local_degraded_through_evaluator():
+    """The issue's acceptance scenario: an injected rank stall during the
+    eager fused flush surfaces SyncTimeoutError (op + attribution +
+    attempts) within the deadline; with on_failure='local' the subsequent
+    compute() serves the local value with degraded=True visible in BOTH
+    StreamingEvaluator.stats() and the telemetry ledger."""
+    m, _ = _faulty_metric(SumMetric(), [Fault("stall", op="all_reduce", delay=30.0, count=99)])
+    ev = StreamingEvaluator(m)
+    for v in (1.0, 2.0, 3.0):
+        ev.submit(jnp.asarray([v]))
+    t0 = time.monotonic()
+    with sync_policy(SyncPolicy(timeout=0.5)):
+        with pytest.raises(SyncTimeoutError) as exc:
+            ev.compute()
+    assert time.monotonic() - t0 < 10.0
+    assert "all_reduce[sum]" in str(exc.value)
+    assert "SumMetric" in str(exc.value)
+    assert "attempt 1" in str(exc.value)
+
+    with telemetry.capture() as led:
+        with sync_policy(SyncPolicy(timeout=0.5, on_failure="local")):
+            value = ev.compute()
+    assert float(value) == 6.0  # the local (unsynced) state
+    assert ev.stats()["degraded"] is True
+    summary = led.summary()
+    assert summary["degraded_computes"] == 1
+    # the second sync either timed out again or hit the abandoned-collective
+    # fence left by the first timeout — typed and degraded either way
+    assert summary["sync_timeouts"] + summary["sync_failures"] == 1
+    ev.close()
+
+
+def test_degraded_flag_roundtrips_through_snapshot(tmp_path):
+    m, _ = _faulty_metric(SumMetric(), [Fault("error", op="all_reduce", count=99)])
+    ev = StreamingEvaluator(m, snapshot_dir=str(tmp_path))
+    ev.submit(jnp.asarray([5.0]))
+    with sync_policy(SyncPolicy(timeout=5.0, on_failure="local")):
+        assert float(ev.compute()) == 5.0
+    assert ev.stats()["degraded"]
+    ev.snapshot()
+    ev.close()
+
+    fresh = StreamingEvaluator(SumMetric(), snapshot_dir=str(tmp_path))
+    assert fresh.restore_latest() == 1
+    assert fresh.stats()["degraded"] is True  # the flag survived preemption
+    assert float(fresh.compute()) == 5.0
+    fresh.close()
+
+
+# -------------------------------------------------------- runtime self-healing
+
+
+class _FlakySum(SumMetric):
+    """Crashes on a specific batch value, a configurable number of times."""
+
+    def __init__(self, fail_value, fail_times, **kwargs):
+        super().__init__(**kwargs)
+        self.fail_value = float(fail_value)
+        self._fail_budget = int(fail_times)
+
+    def update(self, value):
+        if self._fail_budget > 0 and abs(float(jnp.sum(jnp.asarray(value))) - self.fail_value) < 1e-9:
+            self._fail_budget -= 1
+            raise RuntimeError(f"flaky update at {self.fail_value}")
+        super().update(value)
+
+
+def test_crash_restore_replays_to_exact_result(tmp_path):
+    """A transient worker crash auto-restores the latest snapshot and
+    replays the journal: the final result equals an uninterrupted run, and
+    crash/restore counters + ledger events record what happened."""
+    metric = _FlakySum(fail_value=60.0, fail_times=1)
+    with telemetry.capture() as led:
+        ev = StreamingEvaluator(
+            metric,
+            snapshot_dir=str(tmp_path),
+            snapshot_every=3,
+            crash_policy="restore",
+            max_restores=3,
+        )
+        for i in range(10):
+            ev.submit(jnp.asarray([float(i * 10)]))
+        ev.flush()
+        value = float(ev.compute())
+        stats = ev.stats()
+        ev.close()
+    assert value == float(sum(i * 10 for i in range(10)))
+    assert stats["crashes"] == 1 and stats["restores"] == 1 and stats["restarts"] == 1
+    assert stats["batches"] == 10
+    summary = led.summary()
+    assert summary["runtime_crashes"] == 1 and summary["runtime_restores"] == 1
+
+
+def test_crash_loop_budget_exhaustion_raises(tmp_path):
+    """A deterministically-poisonous batch re-crashes every replay: the
+    budget bounds the loop and CrashLoopError poisons the dispatcher."""
+    metric = _FlakySum(fail_value=30.0, fail_times=10**9)
+    ev = StreamingEvaluator(
+        metric,
+        snapshot_dir=str(tmp_path),
+        snapshot_every=2,
+        crash_policy="restore",
+        max_restores=2,
+    )
+    for i in range(6):
+        ev.submit(jnp.asarray([float(i * 10)]))
+    with pytest.raises(Exception) as exc:
+        ev.flush()
+        ev.compute()
+    cause = exc.value.__cause__
+    assert isinstance(cause, CrashLoopError)
+    assert "max_restores=2" in str(cause)
+
+
+def test_crash_policy_raise_keeps_poison_semantics():
+    metric = _FlakySum(fail_value=10.0, fail_times=10**9)
+    ev = StreamingEvaluator(metric)  # crash_policy="raise" (default)
+    ev.submit(jnp.asarray([10.0]))
+    with pytest.raises(Exception, match="flaky update"):
+        ev.flush()
+        ev.submit(jnp.asarray([1.0]))
+
+
+def test_crash_restore_without_snapshots_replays_from_scratch():
+    """No snapshot_dir: restore falls back to a fresh state and the journal
+    spans the whole stream — still exact."""
+    metric = _FlakySum(fail_value=20.0, fail_times=1)
+    ev = StreamingEvaluator(metric, crash_policy="restore", max_restores=2)
+    for v in (10.0, 20.0, 30.0):
+        ev.submit(jnp.asarray([v]))
+    ev.flush()
+    assert float(ev.compute()) == 60.0
+    assert ev.stats()["restores"] == 1
+    ev.close()
+
+
+def test_evaluator_validation():
+    with pytest.raises(ValueError, match="crash_policy"):
+        StreamingEvaluator(SumMetric(), crash_policy="retry")
+    with pytest.raises(ValueError, match="max_restores"):
+        StreamingEvaluator(SumMetric(), crash_policy="restore", max_restores=-1)
+    with pytest.raises(ValueError, match="guard_non_finite"):
+        StreamingEvaluator(SumMetric(), guard_non_finite="sometimes")

@@ -242,6 +242,7 @@ class MetricCollection:
         """
         from tpumetrics.parallel.backend import get_default_backend
         from tpumetrics.parallel.fuse import FusedReducer
+        from tpumetrics.resilience.policy import SyncError, get_sync_policy
         from tpumetrics.telemetry import ledger as _telemetry, lockstep as _lockstep
 
         def _eligible(m: Metric) -> bool:
@@ -267,22 +268,51 @@ class MetricCollection:
                 members = [self._modules[k] for k in cg[1:] if _eligible(self._modules[k])]
                 leaders.append((cg[0], m0, members))
 
+        parked = []
+
+        def _park_degraded(metrics: List[Metric], err: Exception) -> None:
+            # a swallowed SyncError: every affected metric keeps its local
+            # state, carries the failure for its compute wrapper to serve
+            # per SyncPolicy.on_failure, and is parked so compute does not
+            # attempt (and re-fail) its own sync round
+            for m in metrics:
+                m._sync_failure = err
+                if m._to_sync:
+                    m._to_sync = False
+                    parked.append(m)
+
         # exchange when the backend supports it; with only a ledger active,
         # still record the schedule fingerprint (the documented contract)
+        aborted: Optional[Exception] = None
         if _lockstep.should_verify(backend) or _telemetry.recording():
             schedule: List[tuple] = []
             for key, m0, _members in leaders:
                 schedule.extend(m0._sync_schedule(tag=key))
-            _lockstep.verify_lockstep(
-                backend, schedule, context="MetricCollection._fused_eager_sync"
-            )
+            try:
+                _lockstep.verify_lockstep(
+                    backend, schedule, context="MetricCollection._fused_eager_sync"
+                )
+            except SyncError as err:
+                # a dead rank in the digest exchange itself: without proof of
+                # lockstep no state collective may be issued at all — degrade
+                # the whole collection (or propagate under "raise")
+                if get_sync_policy().on_failure == "raise":
+                    raise
+                aborted = err
 
-        if not leaders:
-            yield
+        if not leaders or aborted is not None:
+            if aborted is not None:
+                _park_degraded(
+                    [m for _key, m0, members in leaders for m in (m0, *members)], aborted
+                )
+            try:
+                yield
+            finally:
+                for m in parked:
+                    m._to_sync = True
             return
         reducer = FusedReducer(backend, lockstep=False)  # schedule verified above
         finalizers = []
-        parked = []
         synced_groups: List[Tuple[Metric, List[Metric]]] = []
         try:
             for key, m0, members in leaders:
@@ -292,12 +322,29 @@ class MetricCollection:
                     parked.append(m0)
                     m0._to_sync = False
                     synced_groups.append((m0, members))
+                elif m0._sync_failure is not None:
+                    # the leader's immediate (gather-phase) collectives failed
+                    # and sync() swallowed it per policy: degrade the group
+                    _park_degraded([m0, *members], m0._sync_failure)
                 if fin is not None:
                     finalizers.append(fin)
             if finalizers:
-                reducer.flush()
-                for fin in finalizers:
-                    fin()
+                try:
+                    reducer.flush()
+                except SyncError as err:
+                    if get_sync_policy().on_failure == "raise":
+                        raise
+                    # nothing was applied (finalize only runs after a
+                    # successful flush): unwind the synced flags and degrade
+                    # every registered group
+                    for m0, members in synced_groups:
+                        m0._is_synced = False
+                        m0._cache = None
+                        _park_degraded([m0, *members], err)
+                    synced_groups = []
+                else:
+                    for fin in finalizers:
+                        fin()
             # propagate each leader's reduced arrays to its ref-sharing
             # members: cache their pre-sync state first so the members'
             # own sync_context unsyncs them exactly like a leader
@@ -505,6 +552,12 @@ class MetricCollection:
     def compute_groups(self) -> Dict[int, List[str]]:
         """Current compute groups (reference collections.py:482-485)."""
         return self._groups
+
+    @property
+    def degraded(self) -> bool:
+        """Whether any member's latest compute was served degraded after a
+        swallowed sync failure (see :mod:`tpumetrics.resilience`)."""
+        return any(m.degraded for m in self._modules.values())
 
     def _set_name(self, base: str) -> str:
         name = base if self.prefix is None else self.prefix + base

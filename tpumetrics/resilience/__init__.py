@@ -1,0 +1,63 @@
+"""``tpumetrics.resilience`` — fault injection, bounded-time collectives,
+and degraded-mode evaluation.
+
+The sync path's answer to the failure modes a serving-scale evaluator
+actually sees (see ``docs/resilience.md`` for the guide):
+
+- :mod:`~tpumetrics.resilience.faults` — :class:`FaultInjectionBackend`, a
+  backend wrapper that deterministically injects rank stalls, transient
+  collective errors, payload corruption, and object-channel drops from a
+  declarative schedule, so every failure path is testable on one CPU host.
+- :mod:`~tpumetrics.resilience.policy` — :class:`SyncPolicy`, the bounded-
+  time contract for eager collectives: per-op deadlines (watchdog thread),
+  retries with exponential backoff + jitter, typed
+  :class:`SyncTimeoutError` / :class:`SyncFailedError` instead of hangs,
+  ``on_failure`` degraded modes (``"local"`` / ``"last_good"``), and a
+  NaN/Inf screen (``guard_non_finite``) on states before they travel.
+
+Quick start::
+
+    from tpumetrics import resilience
+
+    resilience.set_sync_policy(resilience.SyncPolicy(
+        timeout=30.0, retries=2, on_failure="last_good",
+    ))
+    value = metric.compute()       # a dead rank now raises SyncTimeoutError
+    metric.degraded                # ... or serves a marked degraded result
+
+Degradation and crash recovery surface in the runtime too:
+``StreamingEvaluator(crash_policy="restore", ...)`` auto-restores from the
+latest good snapshot on worker death (bounded by a crash-loop budget), and
+``stats()["degraded"]`` / ``latest_result()["degraded"]`` mark results served
+from unsynced or stale state.
+"""
+
+from tpumetrics.resilience.faults import Fault, FaultInjectionBackend, InjectedFaultError
+from tpumetrics.resilience.policy import (
+    NonFiniteStateError,
+    SyncError,
+    SyncFailedError,
+    SyncPolicy,
+    SyncTimeoutError,
+    get_sync_policy,
+    run_guarded,
+    screen_non_finite,
+    set_sync_policy,
+    sync_policy,
+)
+
+__all__ = [
+    "Fault",
+    "FaultInjectionBackend",
+    "InjectedFaultError",
+    "NonFiniteStateError",
+    "SyncError",
+    "SyncFailedError",
+    "SyncPolicy",
+    "SyncTimeoutError",
+    "get_sync_policy",
+    "run_guarded",
+    "screen_non_finite",
+    "set_sync_policy",
+    "sync_policy",
+]

@@ -1,0 +1,224 @@
+"""Deterministic fault injection for the eager sync path.
+
+Real sync failures — a preempted host mid-collective, a transient DCN error,
+a corrupted payload — are not reproducible on demand, and the container-level
+reality (a CPU jaxlib without cross-process collectives) means most CI hosts
+cannot run real multi-process sync at all.  :class:`FaultInjectionBackend`
+closes that gap: it wraps any :class:`~tpumetrics.parallel.backend.
+DistributedBackend` and injects faults from a **declarative schedule**, keyed
+by a per-op call index, so every failure path in ``tpumetrics.resilience`` is
+exercised by deterministic single-host tests (``tests/test_resilience.py``);
+scenarios that need real cross-process collectives reuse
+``tests/test_multihost.py``'s capability probe.
+
+Fault kinds (:class:`Fault`):
+
+- ``"stall"`` — sleep ``delay`` seconds before (``then="proceed"``) or
+  instead of (``then="fail"``) the wrapped collective: a slow or dead rank.
+  Under a :class:`~tpumetrics.resilience.policy.SyncPolicy` deadline the
+  watchdog fires first and the caller gets :class:`~tpumetrics.resilience.
+  policy.SyncTimeoutError`.
+- ``"error"`` — raise a transient exception (default ``RuntimeError``)
+  *instead of* issuing the collective: a flaky DCN hop.  Retryable.
+- ``"corrupt"`` — flip the first element of the payload to ``value``
+  (default NaN; integer dtypes get the dtype max) before the collective:
+  a torn or bit-flipped wire buffer.  Caught by ``guard_non_finite`` screens
+  downstream of the reduce.
+- ``"drop_object"`` — the host-object channel silently loses this rank's
+  payload (the gathered list carries ``None`` in its place): a dropped
+  message.  The lockstep digest exchange then sees a divergent digest and
+  raises instead of deadlocking.
+
+The wrapper is eager by construction (``in_trace = False``) and advertises
+``fault_injected = True``, which makes :meth:`SyncPolicy.applies` engage the
+guard even at world size 1 — the whole point of single-host testability.
+Every injected fault records a ``fault_injected`` ledger event and appends to
+:attr:`FaultInjectionBackend.fired` for schedule-determinism asserts.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from tpumetrics.parallel.backend import DistributedBackend
+from tpumetrics.telemetry import ledger as _telemetry
+
+__all__ = ["Fault", "FaultInjectionBackend", "InjectedFaultError"]
+
+_KINDS = ("stall", "error", "corrupt", "drop_object")
+_OPS = ("any", "all_gather", "all_reduce", "all_gather_object")
+
+
+class InjectedFaultError(RuntimeError):
+    """Default exception type for ``kind="error"`` faults (transient-shaped:
+    NOT a TPUMetricsUserError, so the policy's retry loop engages)."""
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One entry of a fault schedule.
+
+    Args:
+        kind: ``"stall"`` | ``"error"`` | ``"corrupt"`` | ``"drop_object"``.
+        op: which collective to target — ``"all_gather"``, ``"all_reduce"``,
+            ``"all_gather_object"``, or ``"any"``.
+        call: fire on the Nth *matching* call (0-based, counted per op name;
+            ``"any"`` faults count against every op's own counter).
+        count: fire for this many consecutive matching calls (a transient
+            error that clears after ``count`` attempts — the retry fixture).
+        delay: stall duration in seconds (``"stall"`` only).
+        then: after a stall, ``"proceed"`` with the real collective (slow
+            rank) or ``"fail"`` with :class:`InjectedFaultError` (dead rank
+            whose connection eventually errors).
+        value: corruption payload for ``"corrupt"`` (default NaN).
+        message: exception text for ``"error"`` faults.
+    """
+
+    kind: str
+    op: str = "any"
+    call: int = 0
+    count: int = 1
+    delay: float = 30.0
+    then: str = "proceed"
+    value: float = float("nan")
+    message: str = "injected transient collective failure"
+
+    def __post_init__(self) -> None:
+        if self.kind not in _KINDS:
+            raise ValueError(f"kind must be one of {_KINDS}, got {self.kind!r}")
+        if self.op not in _OPS:
+            raise ValueError(f"op must be one of {_OPS}, got {self.op!r}")
+        if self.call < 0 or self.count < 1:
+            raise ValueError(f"need call >= 0 and count >= 1, got call={self.call} count={self.count}")
+        if self.then not in ("proceed", "fail"):
+            raise ValueError(f"then must be 'proceed' or 'fail', got {self.then!r}")
+
+    def matches(self, op: str, index: int) -> bool:
+        return (self.op == "any" or self.op == op) and self.call <= index < self.call + self.count
+
+
+class FaultInjectionBackend(DistributedBackend):
+    """A :class:`DistributedBackend` that injects faults from a schedule.
+
+    Args:
+        inner: the real backend carrying the collectives (a
+            :class:`~tpumetrics.parallel.backend.NoOpBackend` for single-host
+            tests; any eager backend in anger).
+        faults: the declarative schedule (sequence of :class:`Fault`).
+        available: what :meth:`available` reports — default ``True`` so a
+            wrapped single-host backend still enters the sync path (that is
+            the point of the wrapper); pass ``None`` to defer to ``inner``.
+
+    Call counting is per op name and strictly deterministic: the Nth
+    ``all_reduce`` this process issues is the Nth ``all_reduce`` on every
+    run.  :attr:`fired` logs ``(op, index, kind)`` per injected fault.
+    """
+
+    in_trace = False
+    fault_injected = True
+
+    def __init__(
+        self,
+        inner: DistributedBackend,
+        faults: Sequence[Fault] = (),
+        available: Optional[bool] = True,
+    ) -> None:
+        self.inner = inner
+        self.faults = tuple(faults)
+        self._available = available
+        self.calls: dict = {}
+        self.fired: List[Tuple[str, int, str]] = []
+
+    @property
+    def has_object_channel(self) -> bool:  # type: ignore[override]
+        return bool(getattr(self.inner, "has_object_channel", False))
+
+    def available(self) -> bool:
+        if self._available is None:
+            return self.inner.available()
+        return self._available
+
+    def world_size(self) -> int:
+        return self.inner.world_size()
+
+    def barrier(self) -> None:
+        self.inner.barrier()
+
+    # ------------------------------------------------------------- injection
+
+    def _next_fault(self, op: str) -> Tuple[Optional[Fault], int]:
+        index = self.calls.get(op, 0)
+        self.calls[op] = index + 1
+        for fault in self.faults:
+            if fault.matches(op, index):
+                return fault, index
+        return None, index
+
+    def _fire(self, fault: Fault, op: str, index: int) -> None:
+        self.fired.append((op, index, fault.kind))
+        _telemetry.record_event(self, "fault_injected", fault=fault.kind, op=op, index=index)
+
+    def _pre(self, fault: Optional[Fault], op: str, index: int) -> None:
+        """Apply stall/error effects (shared by all three collectives)."""
+        if fault is None:
+            return
+        if fault.kind == "stall":
+            self._fire(fault, op, index)
+            time.sleep(fault.delay)
+            if fault.then == "fail":
+                raise InjectedFaultError(
+                    f"{fault.message} (stalled {fault.delay}s then failed, {op} call {index})"
+                )
+        elif fault.kind == "error":
+            self._fire(fault, op, index)
+            raise InjectedFaultError(f"{fault.message} ({op} call {index})")
+
+    def _corrupt(self, fault: Fault, op: str, index: int, x: Any) -> Any:
+        self._fire(fault, op, index)
+        arr = jnp.atleast_1d(jnp.asarray(x))
+        if jnp.issubdtype(arr.dtype, jnp.inexact):
+            bad = jnp.asarray(fault.value, arr.dtype)
+        elif arr.dtype == jnp.bool_:
+            bad = jnp.asarray(True)
+        else:
+            bad = jnp.asarray(jnp.iinfo(arr.dtype).max, arr.dtype)
+        flat = arr.ravel().at[0].set(bad)
+        return flat.reshape(arr.shape) if jnp.shape(x) else flat[0]
+
+    # ----------------------------------------------------------- collectives
+
+    def all_gather(self, x: Any, group: Optional[Any] = None) -> List[Any]:
+        fault, index = self._next_fault("all_gather")
+        self._pre(fault, "all_gather", index)
+        if fault is not None and fault.kind == "corrupt":
+            x = self._corrupt(fault, "all_gather", index, x)
+        return self.inner.all_gather(x, group=group)
+
+    def all_reduce(self, x: Any, op: str, group: Optional[Any] = None) -> Any:
+        fault, index = self._next_fault("all_reduce")
+        self._pre(fault, "all_reduce", index)
+        if fault is not None and fault.kind == "corrupt":
+            x = self._corrupt(fault, "all_reduce", index, x)
+        return self.inner.all_reduce(x, op, group=group)
+
+    def all_gather_object(self, obj: Any, group: Optional[Any] = None) -> List[Any]:
+        fault, index = self._next_fault("all_gather_object")
+        self._pre(fault, "all_gather_object", index)
+        gathered = self.inner.all_gather_object(obj, group=group)
+        if fault is not None and fault.kind == "drop_object":
+            self._fire(fault, "all_gather_object", index)
+            # this rank's payload was lost in flight: peers see a hole
+            try:
+                import jax
+
+                rank = int(jax.process_index())
+            except Exception:
+                rank = 0
+            gathered = list(gathered)
+            if rank < len(gathered):
+                gathered[rank] = None
+        return gathered

@@ -1,0 +1,439 @@
+"""Bounded-time eager sync: deadlines, retries, and typed failures.
+
+The sync path's availability model before this module: every eager collective
+(``MultiHostBackend`` over DCN, ``FusedReducer.flush``, the lockstep digest
+exchange) blocks until every rank shows up.  A dead, stalled, or preempted
+host therefore turns ``compute()`` into an indefinite hang — the lockstep
+verifier (``tpumetrics/telemetry/lockstep.py``) diagnoses *schedule*
+divergence, but a rank that never arrives hangs the digest exchange itself.
+
+:class:`SyncPolicy` bounds that in time:
+
+- ``timeout`` — each guarded eager collective runs on a watchdog thread and
+  must complete within the deadline, else a :class:`SyncTimeoutError` naming
+  the op, attribution tag, and attempt count is raised.  **In-trace**
+  (``AxisBackend``) collectives are exempt: they lower into a compiled XLA
+  program where the host cannot interpose a deadline — bounding those is the
+  job of the runtime's process supervision (see ``docs/resilience.md``).
+- ``retries``/``backoff``/``jitter`` — transient collective exceptions are
+  retried with exponential backoff + jitter; exhaustion raises
+  :class:`SyncFailedError` with the original failure as ``__cause__``.
+  **Retry contract:** a retry re-issues the op on THIS rank only, which is
+  safe only for failures that occur *before* the rendezvous completes
+  anywhere (connection refused, transport setup errors — the common
+  transient class, which fails symmetrically on every rank).  A transport
+  where a collective can PARTIALLY complete (one rank done, another errored)
+  cannot be retried safely — the retried op could pair with a peer's *next*
+  collective; configure ``retries=0`` there and rely on the deadline +
+  ``on_failure`` degradation instead.
+- ``on_failure`` — what the *metric layer* does when the typed error
+  surfaces: ``"raise"`` propagates, ``"local"`` computes from unsynced local
+  state, ``"last_good"`` serves the previous successful synced result; both
+  degraded modes mark the result (``Metric.degraded``,
+  ``StreamingEvaluator.stats()["degraded"]``, ``degraded_compute`` ledger
+  events).
+- ``guard_non_finite`` — screen states for NaN/Inf before they go over the
+  wire (``"off"``/``"warn"``/``"error"``): a corrupted payload poisons every
+  rank's merged state, so catching it pre-collective localizes the blast.
+
+The guard is **near-zero cost when inactive**: the default policy
+(``timeout=None, retries=0``) short-circuits to a direct call, and even an
+active policy skips backends where no wire op can stall (eager world size 1,
+unless the backend is a fault-injection wrapper).  A timed-out collective's
+watchdog thread cannot be killed — it is leaked as a daemon thread and the
+caller gets the typed error; the leak is bounded by how often syncs time out
+(each timeout = one parked thread until the stalled op completes or the
+process exits).
+
+Timeouts are NOT retried: a rank that missed one deadline is presumed dead
+or wedged, and re-entering a collective while the previous attempt's thread
+is still blocked inside it would corrupt rank matching.  Only transient
+*exceptions* retry.  For the same reason a timeout **fences the backend**:
+until the abandoned op completes (its watchdog thread clears the fence),
+every further guarded collective on that backend fails fast with
+:class:`SyncFailedError` instead of issuing a wire op that could rendezvous
+with the abandoned one on a peer — degraded serving (``on_failure``) keeps
+working throughout, so a fenced evaluator serves local/last-good results
+rather than corrupt ones.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, replace
+from typing import Any, Callable, Iterator, List, Optional, TypeVar
+
+import jax.numpy as jnp
+
+from tpumetrics.telemetry import ledger as _telemetry
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+T = TypeVar("T")
+
+__all__ = [
+    "NonFiniteStateError",
+    "SyncError",
+    "SyncFailedError",
+    "SyncPolicy",
+    "SyncTimeoutError",
+    "get_sync_policy",
+    "run_guarded",
+    "screen_non_finite",
+    "set_sync_policy",
+    "sync_policy",
+]
+
+_ON_FAILURE = ("raise", "local", "last_good")
+_GUARD_MODES = ("off", "warn", "error")
+
+
+class SyncError(TPUMetricsUserError):
+    """Base class for bounded-time sync failures (timeout / exhausted retries)."""
+
+
+class SyncTimeoutError(SyncError):
+    """An eager collective missed its :class:`SyncPolicy` deadline.
+
+    The message names the op, the attribution tag, the attempt count, and the
+    deadline — the difference between "rank 3 is dead" and a silent hang.
+    """
+
+
+class SyncFailedError(SyncError):
+    """An eager collective kept failing after every configured retry.
+
+    The final underlying exception is chained as ``__cause__``.
+    """
+
+
+class NonFiniteStateError(SyncError):
+    """A metric state contained NaN/Inf at a ``guard_non_finite="error"`` screen."""
+
+
+@dataclass(frozen=True)
+class SyncPolicy:
+    """Declarative failure policy for eager cross-rank sync.
+
+    Args:
+        timeout: per-collective deadline in seconds; ``None`` disables the
+            watchdog (collectives may block indefinitely, the pre-policy
+            behavior).
+        retries: how many times a transiently-failing collective is retried
+            (0 = fail on first error).
+        backoff: initial retry delay in seconds; doubles every retry.
+        max_backoff: cap on a single retry delay.
+        jitter: fraction of the delay added as uniform random jitter
+            (de-synchronizes retry storms across ranks).
+        on_failure: ``"raise"`` | ``"local"`` | ``"last_good"`` — how the
+            metric layer degrades when the typed error surfaces (module
+            docstring).
+        guard_non_finite: ``"off"`` | ``"warn"`` | ``"error"`` — NaN/Inf
+            screen on states before they travel.
+    """
+
+    timeout: Optional[float] = None
+    retries: int = 0
+    backoff: float = 0.05
+    max_backoff: float = 5.0
+    jitter: float = 0.1
+    on_failure: str = "raise"
+    guard_non_finite: str = "off"
+
+    def __post_init__(self) -> None:
+        if self.timeout is not None and self.timeout <= 0:
+            raise ValueError(f"timeout must be positive or None, got {self.timeout}")
+        if self.retries < 0:
+            raise ValueError(f"retries must be >= 0, got {self.retries}")
+        if self.on_failure not in _ON_FAILURE:
+            raise ValueError(f"on_failure must be one of {_ON_FAILURE}, got {self.on_failure!r}")
+        if self.guard_non_finite not in _GUARD_MODES:
+            raise ValueError(
+                f"guard_non_finite must be one of {_GUARD_MODES}, got {self.guard_non_finite!r}"
+            )
+
+    @property
+    def bounded(self) -> bool:
+        """Whether this policy actually bounds/retries anything."""
+        return self.timeout is not None or self.retries > 0
+
+    def applies(self, backend: Any) -> bool:
+        """Whether guarded execution should engage for ``backend``.
+
+        In-trace backends are exempt (no host round trip to interpose on);
+        eager single-rank backends have no wire op that can stall, so the
+        guard also skips them — unless the backend advertises
+        ``fault_injected`` (a :class:`~tpumetrics.resilience.faults.
+        FaultInjectionBackend`), which is how every failure path stays
+        testable on one CPU host.
+        """
+        if not self.bounded:
+            return False
+        if backend is None:
+            return True
+        if getattr(backend, "in_trace", False):
+            return False
+        if getattr(backend, "fault_injected", False):
+            return True
+        try:
+            return int(backend.world_size()) > 1
+        except Exception:
+            return True
+
+    def with_(self, **kwargs: Any) -> "SyncPolicy":
+        """A copy with the given fields replaced."""
+        return replace(self, **kwargs)
+
+
+# ------------------------------------------------------------- ambient policy
+#
+# Module-global (like parallel.backend's default backend): every rank must run
+# the same policy or their sync behavior diverges, so per-thread scoping would
+# be a footgun.  sync_policy() is a scoped override for tests/rollouts.
+
+_DEFAULT_POLICY = SyncPolicy()
+_POLICY_STACK: List[SyncPolicy] = []
+
+
+def get_sync_policy() -> SyncPolicy:
+    """The active :class:`SyncPolicy` (innermost :func:`sync_policy` scope,
+    else the :func:`set_sync_policy` default, else the no-op default)."""
+    if _POLICY_STACK:
+        return _POLICY_STACK[-1]
+    return _DEFAULT_POLICY
+
+
+def set_sync_policy(policy: Optional[SyncPolicy]) -> None:
+    """Install ``policy`` as the process-wide default (``None`` resets)."""
+    global _DEFAULT_POLICY
+    _DEFAULT_POLICY = policy if policy is not None else SyncPolicy()
+
+
+@contextmanager
+def sync_policy(policy: Optional[SyncPolicy] = None, **kwargs: Any) -> Iterator[SyncPolicy]:
+    """Scoped policy override::
+
+        with resilience.sync_policy(timeout=5.0, retries=2, on_failure="local"):
+            value = metric.compute()
+
+    Keyword form builds a :class:`SyncPolicy` on top of the currently active
+    one (so ``sync_policy(on_failure="local")`` keeps the ambient timeout).
+    """
+    if policy is None:
+        policy = replace(get_sync_policy(), **kwargs)
+    elif kwargs:
+        raise ValueError("pass either a SyncPolicy or keyword fields, not both")
+    _POLICY_STACK.append(policy)
+    try:
+        yield policy
+    finally:
+        # pop OUR entry (scan from the top): plain remove() would strip the
+        # first duplicate if interleaved threads pushed the same policy
+        for i in range(len(_POLICY_STACK) - 1, -1, -1):
+            if _POLICY_STACK[i] is policy:
+                del _POLICY_STACK[i]
+                break
+
+
+# ---------------------------------------------------------- guarded execution
+
+# Re-entrancy marker: a guarded call that itself issues guarded collectives
+# (FusedReducer.flush -> MultiHostBackend.all_gather) must not stack a second
+# watchdog/retry loop inside the first one's deadline.
+_GUARD_STATE = threading.local()
+
+
+def _guard_active() -> bool:
+    return bool(getattr(_GUARD_STATE, "active", False))
+
+
+# Abandoned-collective fence.  A timed-out collective's watchdog thread is
+# still blocked INSIDE the wire op; if a later sync issued a fresh collective
+# on the same backend, a peer still waiting in the old one could rendezvous
+# with the wrong op and merge wrong payloads with no error.  So a timeout
+# fences its backend: further guarded collectives fail fast (typed, so
+# on_failure degradation still applies) until the abandoned op completes and
+# its watchdog clears the fence.
+_FENCE_LOCK = threading.Lock()
+_FENCE_ATTR = "_tpumetrics_abandoned_syncs"
+
+
+def _fenced(backend: Any) -> int:
+    return int(getattr(backend, _FENCE_ATTR, 0)) if backend is not None else 0
+
+
+def _fence_adjust(backend: Any, delta: int) -> None:
+    if backend is None:
+        return
+    try:
+        with _FENCE_LOCK:
+            setattr(backend, _FENCE_ATTR, max(0, _fenced(backend) + delta))
+    except AttributeError:  # __slots__/frozen backends: no fence possible
+        pass
+
+
+def run_guarded(
+    fn: Callable[[], T],
+    *,
+    op: str,
+    backend: Any = None,
+    tag: Optional[str] = None,
+    policy: Optional[SyncPolicy] = None,
+) -> T:
+    """Run one eager collective under the active :class:`SyncPolicy`.
+
+    ``op`` names the wire operation for error messages and ledger events
+    (e.g. ``"all_reduce[sum]"``); ``tag`` defaults to the current telemetry
+    attribution.  With an inert policy (or an exempt backend) this is a
+    direct call — one predicate check of overhead.
+    """
+    pol = policy if policy is not None else get_sync_policy()
+    if not pol.applies(backend) or _guard_active():
+        return fn()
+    attr = tag if tag is not None else _telemetry.current_tag()
+    fenced = _fenced(backend)
+    if fenced:
+        # an earlier collective on this backend timed out and its watchdog
+        # is still blocked in-flight: a new collective could mis-pair ranks,
+        # so refuse fast (typed — on_failure degradation still applies)
+        _telemetry.record_event(
+            backend, "sync_failed", op=op, tag=attr, attempts=0,
+            error=f"fenced: {fenced} abandoned in-flight collective(s)",
+        )
+        raise SyncFailedError(
+            f"Collective {op} (tag={attr!r}) refused: {fenced} earlier collective(s) on "
+            "this backend timed out and their watchdog threads are still blocked "
+            "in-flight; issuing a new collective could rendezvous with the abandoned "
+            "one on a peer and merge wrong payloads. The fence clears when the "
+            "abandoned op completes (or the process restarts)."
+        )
+    attempt = 0
+    delay = pol.backoff
+    while True:
+        attempt += 1
+        try:
+            if pol.timeout is not None:
+                return _call_with_deadline(fn, pol.timeout, op=op, tag=attr, attempt=attempt, backend=backend)
+            return _call_marked(fn)
+        except SyncTimeoutError:
+            raise  # never retried: the peer is presumed dead (module docstring)
+        except TPUMetricsUserError:
+            raise  # API misuse / LockstepViolation: deterministic, not transient
+        except Exception as err:  # noqa: BLE001 — classified below
+            if attempt > pol.retries:
+                _telemetry.record_event(
+                    backend, "sync_failed", op=op, tag=attr, attempts=attempt, error=repr(err)
+                )
+                raise SyncFailedError(
+                    f"Collective {op} (tag={attr!r}) failed after {attempt} attempt(s): "
+                    f"{type(err).__name__}: {err}"
+                ) from err
+            _telemetry.record_event(
+                backend, "sync_retry", op=op, tag=attr, attempt=attempt, error=repr(err)
+            )
+            time.sleep(min(delay, pol.max_backoff) * (1.0 + random.uniform(0.0, pol.jitter)))
+            delay *= 2.0
+
+
+def _call_marked(fn: Callable[[], T]) -> T:
+    _GUARD_STATE.active = True
+    try:
+        return fn()
+    finally:
+        _GUARD_STATE.active = False
+
+
+def _call_with_deadline(
+    fn: Callable[[], T], timeout: float, *, op: str, tag: str, attempt: int, backend: Any
+) -> T:
+    box: dict = {}
+    done = threading.Event()
+    state = {"abandoned": False}
+    state_lock = threading.Lock()
+
+    def target() -> None:
+        _GUARD_STATE.active = True
+        try:
+            box["value"] = fn()
+        except BaseException as err:  # noqa: BLE001 — re-raised on the caller thread
+            box["error"] = err
+        finally:
+            with state_lock:
+                done.set()
+                if state["abandoned"]:
+                    # the abandoned op finally finished (or errored): new
+                    # collectives on this backend can pair safely again
+                    _fence_adjust(backend, -1)
+
+    worker = threading.Thread(target=target, daemon=True, name=f"tpumetrics-sync-watchdog[{op}]")
+    worker.start()
+    if not done.wait(timeout):
+        with state_lock:
+            if not done.is_set():  # really still in flight: fence the backend
+                state["abandoned"] = True
+                _fence_adjust(backend, +1)
+        if state["abandoned"]:
+            _telemetry.record_event(
+                backend, "sync_timeout", op=op, tag=tag, attempts=attempt, timeout=timeout
+            )
+            raise SyncTimeoutError(
+                f"Collective {op} (tag={tag!r}) timed out after {timeout}s on attempt "
+                f"{attempt}: a participating rank is dead, stalled, or preempted. The "
+                "in-flight collective's watchdog thread is abandoned (daemon) and the "
+                "backend is fenced against new collectives until it completes; see "
+                "SyncPolicy.on_failure for degraded-result options instead of raising."
+            )
+        # lost the race by a hair: the op completed just after the deadline
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
+# ------------------------------------------------------------ finiteness screen
+
+
+def screen_non_finite(
+    value: Any,
+    *,
+    where: str,
+    mode: Optional[str] = None,
+    backend: Any = None,
+) -> None:
+    """NaN/Inf screen for one array state about to travel (or persist).
+
+    ``mode`` defaults to the active policy's ``guard_non_finite``.  ``"warn"``
+    emits a :class:`~tpumetrics.utils.exceptions.TPUMetricsUserWarning` plus a
+    ``non_finite_state`` ledger event; ``"error"`` raises
+    :class:`NonFiniteStateError` naming ``where``.  Non-float leaves and mode
+    ``"off"`` are free.  This forces a host readback of the screened array —
+    acceptable on the eager sync path (which is host-driven anyway), never
+    called in-trace.
+    """
+    mode = mode if mode is not None else get_sync_policy().guard_non_finite
+    if mode == "off" or mode is None:
+        return
+    try:
+        arr = jnp.asarray(value)
+    except (TypeError, ValueError):
+        return
+    if not jnp.issubdtype(arr.dtype, jnp.inexact):
+        return
+    if bool(jnp.all(jnp.isfinite(arr))):
+        return
+    n_bad = int(jnp.sum(~jnp.isfinite(arr)))
+    _telemetry.record_event(
+        backend, "non_finite_state", where=where, bad=n_bad, total=int(arr.size), mode=mode
+    )
+    msg = (
+        f"Non-finite values in {where}: {n_bad}/{arr.size} elements are NaN/Inf. "
+        "Syncing would poison the merged state on every rank. "
+        "HINT: screen updates upstream, or set guard_non_finite='off' to allow."
+    )
+    if mode == "error":
+        raise NonFiniteStateError(msg)
+    from tpumetrics.utils.exceptions import TPUMetricsUserWarning
+    from tpumetrics.utils.prints import rank_zero_warn
+
+    rank_zero_warn(msg, TPUMetricsUserWarning)

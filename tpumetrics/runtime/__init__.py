@@ -28,7 +28,7 @@ from tpumetrics.runtime.bucketing import (
     pow2_bucket_edges,
 )
 from tpumetrics.runtime.dispatch import AsyncDispatcher, DispatcherClosedError, QueueFullError
-from tpumetrics.runtime.evaluator import StreamingEvaluator
+from tpumetrics.runtime.evaluator import CrashLoopError, StreamingEvaluator
 from tpumetrics.runtime.snapshot import (
     SnapshotError,
     SnapshotIntegrityError,
@@ -43,6 +43,7 @@ from tpumetrics.runtime.snapshot import (
 
 __all__ = [
     "AsyncDispatcher",
+    "CrashLoopError",
     "DispatcherClosedError",
     "NotBucketableError",
     "QueueFullError",

@@ -28,6 +28,16 @@ A worker-side exception poisons the dispatcher: it is captured, the worker
 stops, and the exception re-raises (wrapped, original as ``__cause__``) from
 the next ``submit``/``flush``/``close`` so ingestion errors cannot vanish
 silently on a daemon thread.
+
+Self-healing (``tpumetrics.resilience``): an optional ``crash_handler`` is
+consulted before poisoning.  It runs on the worker thread with the exception
+and the micro-batch that was being drained; returning ``True`` means the
+handler fully recovered (including applying or discarding the batch) and the
+worker keeps draining — a ``runtime_restart`` ledger event and the
+``restarts`` counter record it.  Returning ``False`` — or raising (e.g. a
+:class:`~tpumetrics.runtime.evaluator.CrashLoopError` once the restore
+budget is exhausted) — poisons the dispatcher as before, with the handler's
+exception taking over as the poison cause when it raised one.
 """
 
 from __future__ import annotations
@@ -63,6 +73,9 @@ class AsyncDispatcher:
             everything currently queued in one call.
         name: attribution tag for telemetry events (e.g. the evaluator's
             metric class name).
+        crash_handler: optional ``(exc, batch) -> bool`` recovery hook run on
+            the worker thread when ``drain_fn`` raises (module docstring);
+            ``True`` = recovered, keep draining; ``False``/raise = poison.
 
     Thread safety: ``submit`` may be called from many threads; ``flush`` /
     ``close`` from any thread.  ``drain_fn`` only ever runs on the single
@@ -77,6 +90,7 @@ class AsyncDispatcher:
         policy: str = "block",
         max_batch: Optional[int] = None,
         name: str = "",
+        crash_handler: Optional[Callable[[BaseException, List[Any]], bool]] = None,
     ) -> None:
         if policy not in _POLICIES:
             raise ValueError(f"Unknown backpressure policy {policy!r}; expected one of {_POLICIES}")
@@ -89,6 +103,7 @@ class AsyncDispatcher:
         self._policy = policy
         self._max_batch = int(max_batch) if max_batch is not None else None
         self._name = name or type(self).__name__
+        self._crash_handler = crash_handler
 
         self._q: deque = deque()
         self._lock = threading.Lock()
@@ -105,6 +120,7 @@ class AsyncDispatcher:
         self._drain_cycles = 0
         self._dropped = 0
         self._max_depth = 0
+        self._restarts = 0
 
         self._worker = threading.Thread(
             target=self._run, name=f"tpumetrics-dispatch[{self._name}]", daemon=True
@@ -191,6 +207,7 @@ class AsyncDispatcher:
                 "drained_items": self._drained_items,
                 "drain_cycles": self._drain_cycles,
                 "dropped": self._dropped,
+                "restarts": self._restarts,
             }
 
     @property
@@ -223,6 +240,25 @@ class AsyncDispatcher:
             try:
                 self._drain_fn(batch)
             except BaseException as err:  # noqa: BLE001 — poison, don't lose it
+                recovered = False
+                if self._crash_handler is not None:
+                    try:
+                        recovered = bool(self._crash_handler(err, batch))
+                    except BaseException as handler_err:  # noqa: BLE001
+                        err = handler_err  # e.g. CrashLoopError: budget spent
+                if recovered:
+                    with self._lock:
+                        self._restarts += 1
+                        self._drained_items += n  # the handler applied them
+                        self._drain_cycles += 1
+                        self._draining = False
+                        _telemetry.record_event(
+                            self, "runtime_restart", items=n, restarts=self._restarts
+                        )
+                        self._not_full.notify_all()
+                        if not self._q:
+                            self._idle.notify_all()
+                    continue
                 with self._lock:
                     self._error = err
                     self._draining = False

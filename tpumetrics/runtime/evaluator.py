@@ -30,6 +30,19 @@ Bounded staleness: with ``compute_every=n`` the worker refreshes
 :meth:`latest_result` after every ``n`` drained batches — serving handlers
 read a result at most ``n`` batches stale without ever blocking on a
 flush + compute.
+
+Self-healing (``tpumetrics.resilience``): with ``crash_policy="restore"``
+the evaluator keeps an in-memory **journal** of the batches applied since
+the last snapshot; when a batch crashes the worker, it restores the latest
+good snapshot, replays the journal plus the crashed micro-batch, and keeps
+serving — bounded by ``max_restores`` (the crash-loop budget: a
+deterministically-poisonous batch re-crashes every replay, and exhaustion
+raises :class:`CrashLoopError` through the dispatcher's poison path instead
+of looping forever).  Degraded results — a sync failure swallowed by the
+active :class:`~tpumetrics.resilience.policy.SyncPolicy` (``on_failure=
+"local"``/``"last_good"``) — are marked in :meth:`stats` and
+:meth:`latest_result` and stamped into snapshot metadata, so the flag
+round-trips across preemption.
 """
 
 from __future__ import annotations
@@ -50,9 +63,16 @@ from tpumetrics.runtime.bucketing import (
 )
 from tpumetrics.runtime.dispatch import AsyncDispatcher
 from tpumetrics.runtime import snapshot as _snapshot
+from tpumetrics.telemetry import ledger as _telemetry
 from tpumetrics.utils.exceptions import TPUMetricsUserError
 
 Array = jax.Array
+
+
+class CrashLoopError(TPUMetricsUserError):
+    """The crash-loop budget (``max_restores``) is spent: the same (or a new)
+    batch kept crashing the worker after every snapshot-restore-replay cycle.
+    Poisons the dispatcher; the final underlying crash is ``__cause__``."""
 
 
 class StreamingEvaluator:
@@ -77,6 +97,16 @@ class StreamingEvaluator:
         keep_snapshots: retention for :class:`SnapshotManager`.
         update_kwargs: static keyword arguments forwarded to every update
             (e.g. ``real=True``); per-batch data is positional.
+        crash_policy: ``"raise"`` (default — a crashing batch poisons the
+            dispatcher, the pre-resilience behavior) or ``"restore"`` —
+            auto-restore the latest good snapshot and replay the journal
+            (module docstring).  Without ``snapshot_dir`` the restore target
+            is a fresh state and the journal spans the whole stream (bounded
+            memory requires ``snapshot_every``).
+        max_restores: crash-loop budget for ``crash_policy="restore"``.
+        guard_non_finite: ``"off"``/``"warn"``/``"error"`` NaN/Inf screen on
+            the state at every snapshot save (a poisoned state written to
+            disk would survive restore and re-poison the stream).
     """
 
     def __init__(
@@ -92,6 +122,9 @@ class StreamingEvaluator:
         snapshot_every: Optional[int] = None,
         keep_snapshots: Optional[int] = 3,
         update_kwargs: Optional[Dict[str, Any]] = None,
+        crash_policy: str = "raise",
+        max_restores: int = 3,
+        guard_non_finite: str = "off",
     ) -> None:
         from tpumetrics.collections import MetricCollection
 
@@ -101,10 +134,21 @@ class StreamingEvaluator:
             raise ValueError(f"compute_every must be >= 1, got {compute_every}")
         if snapshot_every is not None and snapshot_dir is None:
             raise ValueError("snapshot_every requires snapshot_dir")
+        if crash_policy not in ("raise", "restore"):
+            raise ValueError(f"crash_policy must be 'raise' or 'restore', got {crash_policy!r}")
+        if max_restores < 0:
+            raise ValueError(f"max_restores must be >= 0, got {max_restores}")
+        if guard_non_finite not in ("off", "warn", "error"):
+            raise ValueError(
+                f"guard_non_finite must be 'off', 'warn' or 'error', got {guard_non_finite!r}"
+            )
         self._metric = metric
         self._update_kwargs = dict(update_kwargs or {})
         self._compute_every = compute_every
         self._snapshot_every = snapshot_every
+        self._crash_policy = crash_policy
+        self._max_restores = int(max_restores)
+        self._guard_non_finite = guard_non_finite
 
         if buckets is None:
             self._bucketer: Optional[ShapeBucketer] = None
@@ -123,6 +167,18 @@ class StreamingEvaluator:
         self._steps: Dict[Any, Any] = {}  # bucket edge (or "scalar") -> jitted step
         self._trace_signatures: set = set()  # (bucket, arg shapes/dtypes) seen
 
+        # resilience bookkeeping: batches applied since the last snapshot
+        # (the crash-replay journal), its stream base position, crash/restore
+        # counters, and whether the latest served result was degraded.
+        # journal/base/inflight are worker-thread-only; counters+flag take
+        # the lock.
+        self._journal: list = []
+        self._journal_base = 0
+        self._inflight_pos = 0
+        self._crashes = 0
+        self._restores = 0
+        self._degraded = False
+
         self._snapshots = (
             _snapshot.SnapshotManager(snapshot_dir, keep=keep_snapshots) if snapshot_dir else None
         )
@@ -134,6 +190,7 @@ class StreamingEvaluator:
             policy=backpressure,
             max_batch=micro_batch,
             name=name,
+            crash_handler=self._handle_crash if crash_policy == "restore" else None,
         )
 
     # -------------------------------------------------------------- ingestion
@@ -169,22 +226,33 @@ class StreamingEvaluator:
     # ---------------------------------------------------------------- results
 
     def compute(self) -> Any:
-        """Exact result over everything submitted so far (flushes first)."""
+        """Exact result over everything submitted so far (flushes first).
+
+        On the eager path the metric's own sync (and the active
+        :class:`~tpumetrics.resilience.policy.SyncPolicy`) applies: a
+        swallowed sync failure serves a degraded value, reflected in
+        ``stats()["degraded"]``.
+        """
         self.flush()
         with self._lock:
             if self._bucketer is None:
-                return self._metric.compute()
+                value = self._metric.compute()
+                self._degraded = bool(getattr(self._metric, "degraded", False))
+                return value
             return self._metric.functional_compute(self._state)
 
     def latest_result(self) -> Optional[Dict[str, Any]]:
         """The bounded-staleness result maintained by ``compute_every=n``:
-        ``{"value", "batches", "items"}`` — at most ``n`` batches stale —
-        or ``None`` before the first refresh.  Never blocks on the queue."""
+        ``{"value", "batches", "items", "degraded"}`` — at most ``n`` batches
+        stale — or ``None`` before the first refresh.  ``degraded`` marks a
+        value served from unsynced-local or last-good state after a swallowed
+        sync failure.  Never blocks on the queue."""
         with self._lock:
             return dict(self._latest) if self._latest is not None else None
 
     def stats(self) -> Dict[str, Any]:
-        """Dispatcher counters + stream position + compile accounting."""
+        """Dispatcher counters + stream position + compile accounting +
+        resilience status (``degraded``, ``crashes``, ``restores``)."""
         out = self._dispatcher.stats()
         with self._lock:
             out.update(
@@ -192,6 +260,9 @@ class StreamingEvaluator:
                 items=self._items,
                 xla_compiles=len(self._trace_signatures),
                 buckets=list(self._bucketer.edges) if self._bucketer else None,
+                degraded=self._degraded,
+                crashes=self._crashes,
+                restores=self._restores,
             )
         return out
 
@@ -221,12 +292,19 @@ class StreamingEvaluator:
             "items": self._items,
             "metric": type(self._metric).__name__,
             "mode": "bucketed" if self._bucketer is not None else "eager",
+            "degraded": self._degraded,  # survives preemption (restore re-flags)
         }
         if self._bucketer is not None:
             payload: Any = self._state
         else:
             payload = self._metric.snapshot_state()
-        return self._snapshots.save(self._batches, payload, meta=meta)
+        path = self._snapshots.save(
+            self._batches, payload, meta=meta, guard_non_finite=self._guard_non_finite
+        )
+        # the journal is "since the last snapshot": this save is the new base
+        self._journal = []
+        self._journal_base = self._batches
+        return path
 
     def restore_latest(self) -> Optional[int]:
         """Restore the newest compatible snapshot; returns the stream
@@ -242,46 +320,162 @@ class StreamingEvaluator:
                     "restore on a fresh evaluator, then replay the stream from the "
                     "returned position."
                 )
+            got = self._load_latest_snapshot()
+            if got is None:
+                return None
+            return self._adopt_snapshot_locked(got)
+
+    def _load_latest_snapshot(self) -> Optional[Tuple[Any, Dict[str, Any]]]:
+        """(payload, header) of the newest valid snapshot, or ``None``."""
+        if self._snapshots is None:
+            return None
+        if self._bucketer is not None:
+            return self._snapshots.restore_latest(self._metric.init_state())
+        return _snapshot.restore_latest_reconstruct(self._snapshots.directory)
+
+    def _adopt_snapshot_locked(self, got: Optional[Tuple[Any, Dict[str, Any]]]) -> int:
+        """Apply a loaded snapshot (or a fresh state when ``None``) to the
+        evaluator under the held lock: state, stream counters, journal base,
+        and the degraded flag from snapshot meta.  The single restore path —
+        shared by :meth:`restore_latest` and the crash handler so the meta
+        contract cannot drift between them.  Returns the adopted position."""
+        if got is None:
             if self._bucketer is not None:
-                got = self._snapshots.restore_latest(self._metric.init_state())
-                if got is None:
-                    return None
-                state, header = got
-                self._state = state
+                self._state = self._metric.init_state()
             else:
-                got = _snapshot.restore_latest_reconstruct(self._snapshots.directory)
-                if got is None:
-                    return None
-                payload, header = got
+                self._metric.reset()
+            restored, items, degraded = 0, 0, False
+        else:
+            payload, header = got
+            if self._bucketer is not None:
+                self._state = payload
+            else:
                 self._metric.load_snapshot_state(_as_snapshot_payload(payload))
-            self._batches = int(header["meta"]["batches"])
-            self._items = int(header["meta"]["items"])
-            self._last_compute_at = self._batches
-            return self._batches
+            restored = int(header["meta"]["batches"])
+            items = int(header["meta"]["items"])
+            degraded = bool(header["meta"].get("degraded", False))
+        self._batches = restored
+        self._items = items
+        self._last_compute_at = restored
+        self._journal = []
+        self._journal_base = restored
+        self._degraded = degraded
+        return restored
 
     # ----------------------------------------------------------------- worker
 
     def _drain(self, batch_args: list) -> None:
         """Worker-side: apply each submitted batch individually, in order."""
-        for args in batch_args:
-            if self._bucketer is None:
-                self._metric.update(*args, **self._update_kwargs)
-                n_rows = _leading_rows(args)
-            else:
-                n_rows = self._bucketed_update(args)
+        for pos, args in enumerate(batch_args):
+            self._inflight_pos = pos  # lets the crash handler find the tail
+            self._apply_one(args)
+
+    def _apply_one(self, args: Tuple[Any, ...]) -> None:
+        """Apply ONE submitted batch: journal (under a restore policy), state
+        transition, counters, and the compute/snapshot cadences."""
+        if self._crash_policy == "restore":
+            # journaled BEFORE applying so a crashed batch is replayable
+            self._journal.append(args)
+        if self._bucketer is None:
+            self._metric.update(*args, **self._update_kwargs)
+            n_rows = _leading_rows(args)
+        else:
+            n_rows = self._bucketed_update(args)
+        with self._lock:
+            self._batches += 1
+            self._items += n_rows
+            batches = self._batches
+        if self._compute_every and batches - self._last_compute_at >= self._compute_every:
+            self._refresh_latest()
+        if (
+            self._snapshot_every
+            and self._snapshots is not None
+            and batches % self._snapshot_every == 0
+        ):
             with self._lock:
-                self._batches += 1
-                self._items += n_rows
-                batches = self._batches
-            if self._compute_every and batches - self._last_compute_at >= self._compute_every:
-                self._refresh_latest()
-            if (
-                self._snapshot_every
-                and self._snapshots is not None
-                and batches % self._snapshot_every == 0
-            ):
-                with self._lock:
-                    self._save_snapshot_locked()
+                self._save_snapshot_locked()
+
+    # ------------------------------------------------------------ self-healing
+
+    def _handle_crash(self, err: BaseException, batch: list) -> bool:
+        """Dispatcher crash hook (worker thread): restore + replay, bounded.
+
+        ``pending`` is everything the restored state is missing: the journal
+        (applied-since-snapshot batches, crashed one included — it was
+        journaled before applying) plus the not-yet-reached tail of the
+        dispatcher micro-batch.  A replay that crashes again rebuilds
+        ``pending`` from the fresh journal and keeps trying until the budget
+        is spent, then raises :class:`CrashLoopError` (which poisons the
+        dispatcher — the handler's exception becomes the poison cause).
+
+        The budget bounds CONSECUTIVE crashes at the SAME stream position (a
+        deterministically-poisonous batch re-crashing every replay); any
+        forward progress — a later batch crashing, or a successful recovery —
+        resets it, so independent transient crashes never accumulate into a
+        spurious exhaustion.  ``stats()`` still reports lifetime totals.
+        """
+        pending = list(self._journal) + list(batch[self._inflight_pos + 1 :])
+        attempts = 0  # consecutive same-position crashes (lifetime: _crashes)
+        last_pos = -1
+        while True:
+            with self._lock:
+                pos = self._batches  # stream position of the item that crashed
+                self._crashes += 1
+                crashes = self._crashes
+            attempts = attempts + 1 if pos <= last_pos else 1
+            last_pos = max(last_pos, pos)
+            _telemetry.record_event(
+                self, "runtime_crash", error=repr(err), crashes=crashes, attempt=attempts
+            )
+            if attempts > self._max_restores:
+                raise CrashLoopError(
+                    f"StreamingEvaluator worker crashed {attempts} consecutive time(s) "
+                    f"without progress; crash-loop budget (max_restores="
+                    f"{self._max_restores}) is spent. Last crash: "
+                    f"{type(err).__name__}: {err}"
+                ) from err
+            idx = -1  # nothing replayed yet (restore itself may fail)
+            try:
+                self._restore_for_crash()
+                idx = 0
+                while idx < len(pending):
+                    self._apply_one(pending[idx])
+                    idx += 1
+            except TPUMetricsUserError:
+                raise  # config/snapshot-level problems are not crash-loopable
+            except BaseException as replay_err:  # noqa: BLE001 — bounded above
+                err = replay_err
+                if idx >= 0:
+                    # journal now holds the replayed prefix (+ crashed item)
+                    # since the last snapshot; the rest is still untried.
+                    # (idx < 0 = restore itself failed: the journal was not
+                    # cleared and pending already covers it — keep as is.)
+                    pending = list(self._journal) + pending[idx + 1 :]
+                continue
+            with self._lock:
+                self._restores += 1
+                restores = self._restores
+            _telemetry.record_event(
+                self, "runtime_restore", restores=restores, replayed=len(pending)
+            )
+            return True
+
+    def _restore_for_crash(self) -> None:
+        """Rewind state + counters to the latest good snapshot (or a fresh
+        state when snapshots are absent/never taken), clearing the journal.
+        The restored position must equal the journal's base — if the latest
+        snapshot was lost/corrupt and an older one is picked, the journal
+        cannot bridge the gap and the crash is not recoverable."""
+        got = self._load_latest_snapshot()
+        with self._lock:
+            expected = self._journal_base  # the position the journal covers from
+            restored = self._adopt_snapshot_locked(got)
+            if restored != expected:
+                raise _snapshot.SnapshotError(
+                    f"Crash restore landed on stream position {restored} but the replay "
+                    f"journal starts at {expected} (latest snapshot lost or "
+                    "corrupt?): the journal cannot bridge the gap."
+                )
 
     def _bucketed_update(self, args: Tuple[Any, ...]) -> int:
         n = _leading_rows(args)
@@ -342,10 +536,17 @@ class StreamingEvaluator:
         if self._bucketer is None:
             value = self._metric.compute()
             self._metric._computed = None  # the stream moves on; don't pin the cache
+            degraded = bool(getattr(self._metric, "degraded", False))
         else:
             value = self._metric.functional_compute(state)
+            with self._lock:
+                degraded = self._degraded  # bucketed updates never sync eagerly
         with self._lock:
-            self._latest = {"value": value, "batches": batches, "items": items}
+            if self._bucketer is None:
+                self._degraded = degraded
+            self._latest = {
+                "value": value, "batches": batches, "items": items, "degraded": degraded,
+            }
             self._last_compute_at = batches
 
 

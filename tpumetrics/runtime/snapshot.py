@@ -78,16 +78,31 @@ def _crc(arrays: List[np.ndarray]) -> int:
 
 
 def save_snapshot(
-    directory: str, step: int, state: Any, meta: Optional[Dict[str, Any]] = None
+    directory: str,
+    step: int,
+    state: Any,
+    meta: Optional[Dict[str, Any]] = None,
+    guard_non_finite: str = "off",
 ) -> str:
     """Atomically write ``state`` (any pytree of arrays) as snapshot ``step``.
 
     Returns the final path.  The file only appears under its final name once
     fully written (write temp -> fsync -> rename).
+
+    ``guard_non_finite`` (``"off"``/``"warn"``/``"error"``) screens every
+    float leaf for NaN/Inf before it is persisted: a poisoned state written
+    to disk would otherwise survive a crash-restore cycle and re-poison the
+    stream — ``"error"`` raises :class:`~tpumetrics.resilience.policy.
+    NonFiniteStateError` naming the offending leaf path instead.
     """
     os.makedirs(directory, exist_ok=True)
     flat = _flatten(state)
     host: List[np.ndarray] = [np.asarray(jax.device_get(leaf)) for _, leaf in flat]
+    if guard_non_finite != "off":
+        from tpumetrics.resilience.policy import screen_non_finite
+
+        for (path, _), arr in zip(flat, host):
+            screen_non_finite(arr, where=f"snapshot leaf {path!r}", mode=guard_non_finite)
     spec = [
         {"path": path, "shape": list(a.shape), "dtype": str(a.dtype)}
         for (path, _), a in zip(flat, host)
@@ -281,14 +296,22 @@ class SnapshotManager:
     def last_step(self) -> Optional[int]:
         return self._last_step
 
-    def save(self, step: int, state: Any, meta: Optional[Dict[str, Any]] = None) -> str:
+    def save(
+        self,
+        step: int,
+        state: Any,
+        meta: Optional[Dict[str, Any]] = None,
+        guard_non_finite: str = "off",
+    ) -> str:
         step = int(step)
         if self._last_step is not None and step <= self._last_step:
             raise SnapshotError(
                 f"Non-monotonic snapshot step {step} (latest on disk: {self._last_step}). "
                 "HINT: restore_latest() first, or point the manager at a fresh directory."
             )
-        path = save_snapshot(self.directory, step, state, meta=meta)
+        path = save_snapshot(
+            self.directory, step, state, meta=meta, guard_non_finite=guard_non_finite
+        )
         self._last_step = step
         if self.keep is not None:
             for _, old in list_snapshots(self.directory)[: -self.keep]:

@@ -144,6 +144,30 @@ class CollectiveLedger:
             self.runtime_drain_cycles += 1
             self.runtime_items_drained += int(rec.extra.get("items", 0))
             self.runtime_max_depth = max(self.runtime_max_depth, int(rec.extra.get("depth", 0)))
+        elif rec.kind == "sync_timeout":
+            # a guarded eager collective missed its SyncPolicy deadline
+            self.sync_timeouts += 1
+        elif rec.kind == "sync_retry":
+            # one backoff-retry of a transiently-failing collective
+            self.sync_retries += 1
+        elif rec.kind == "sync_failed":
+            # retries exhausted: the typed SyncFailedError surfaced
+            self.sync_failures += 1
+        elif rec.kind == "degraded_compute":
+            # a compute served unsynced-local or last-good state
+            self.degraded_computes += 1
+        elif rec.kind == "fault_injected":
+            # a FaultInjectionBackend fired one scheduled fault
+            self.faults_injected += 1
+        elif rec.kind == "non_finite_state":
+            # guard_non_finite caught NaN/Inf before the wire (or a snapshot)
+            self.non_finite_states += 1
+        elif rec.kind == "runtime_crash":
+            # the streaming runtime's worker died applying a batch
+            self.runtime_crashes += 1
+        elif rec.kind == "runtime_restore":
+            # crash policy restored from a snapshot and replayed the journal
+            self.runtime_restores += 1
         self.counts_by_kind[rec.kind] = self.counts_by_kind.get(rec.kind, 0) + 1
         for sink in self._sinks:
             sink.emit(rec)
@@ -160,6 +184,14 @@ class CollectiveLedger:
         self.runtime_drain_cycles = 0
         self.runtime_items_drained = 0
         self.runtime_max_depth = 0
+        self.sync_timeouts = 0
+        self.sync_retries = 0
+        self.sync_failures = 0
+        self.degraded_computes = 0
+        self.faults_injected = 0
+        self.non_finite_states = 0
+        self.runtime_crashes = 0
+        self.runtime_restores = 0
         self.bytes_by_op: Dict[str, float] = {}
         self.counts_by_kind: Dict[str, int] = {}
 
@@ -188,6 +220,14 @@ class CollectiveLedger:
             "runtime_drain_cycles": self.runtime_drain_cycles,
             "runtime_items_drained": self.runtime_items_drained,
             "runtime_max_depth": self.runtime_max_depth,
+            "sync_timeouts": self.sync_timeouts,
+            "sync_retries": self.sync_retries,
+            "sync_failures": self.sync_failures,
+            "degraded_computes": self.degraded_computes,
+            "faults_injected": self.faults_injected,
+            "non_finite_states": self.non_finite_states,
+            "runtime_crashes": self.runtime_crashes,
+            "runtime_restores": self.runtime_restores,
             "records": len(self.records),
         }
 

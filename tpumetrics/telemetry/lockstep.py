@@ -134,6 +134,8 @@ def verify_lockstep(
     the disagreement is reported symmetrically — two ranks cannot tell who
     is "right".
     """
+    from tpumetrics.resilience.policy import run_guarded
+
     norm = normalize_schedule(entries)
     digest = hashlib.sha1(repr(norm).encode()).hexdigest()
     in_trace = bool(getattr(backend, "in_trace", False))
@@ -143,14 +145,34 @@ def verify_lockstep(
     if not should_verify(backend):
         return digest
 
-    digests = list(backend.all_gather_object(digest, group=group))
+    # the digest exchange runs under the active SyncPolicy deadline: a dead
+    # rank here (before any state collective!) becomes a typed
+    # SyncTimeoutError instead of deadlocking the verifier itself
+    digests = list(
+        run_guarded(
+            lambda: backend.all_gather_object(digest, group=group),
+            op="lockstep_digest_exchange",
+            backend=backend,
+        )
+    )
+    lost = [r for r, d in enumerate(digests) if d is None]
+    if lost:
+        raise LockstepViolation(
+            f"Sync-schedule digest exchange{f' in {context}' if context else ''} lost the "
+            f"payload of rank(s) {lost} (object channel dropped the message): cannot prove "
+            f"lockstep, refusing to issue state collectives (local rank {_rank_of(backend)})."
+        )
     if len(set(digests)) == 1:
         return digest
 
     # mismatch: one more exchange ships the schedules for the diagnosis
     schedules = [
-        [tuple(e) if not isinstance(e, tuple) else e for e in s]
-        for s in backend.all_gather_object(norm, group=group)
+        [tuple(e) if not isinstance(e, tuple) else e for e in (s or ())]
+        for s in run_guarded(
+            lambda: backend.all_gather_object(norm, group=group),
+            op="lockstep_schedule_exchange",
+            backend=backend,
+        )
     ]
     counts: dict = {}
     for d in digests:
