@@ -1000,6 +1000,148 @@ def _bench_resilience_overhead():
     return ours, ref, {"extras": extras}
 
 
+def _bench_elastic_restore():
+    """Cost of elastic coordination (tpumetrics.resilience.elastic).
+
+    Two numbers, two gates, on a 50-metric collection:
+
+    - ``vs_baseline`` = plain_snapshot_us / coordinated_snapshot_us over an
+      identical save loop (emulated 8-rank barrier cohort): the barrier adds
+      one guarded object exchange + cut stamping per step; the floor in
+      bench_floors.json bounds how much of the snapshot step it may eat.
+    - ``restore_8to4_ms`` — wall time for a FULL 8→4 elastic restore: each
+      of the 4 new ranks discovers the cut, CRC-loads all 8 member payloads,
+      folds them into the canonical global state and reshards its share.
+      Gated by a ceiling (elastic_restore_ceilings); also asserts the folded
+      world-4 result equals the world-8 fold (the correctness invariant —
+      a fast but wrong restore must fail the scenario loudly).
+    """
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from tpumetrics import MetricCollection
+    from tpumetrics.classification import MulticlassAccuracy
+    from tpumetrics.parallel.backend import DistributedBackend
+    from tpumetrics.resilience import elastic as elastic_mod
+    from tpumetrics.resilience.elastic import DistributedSnapshotManager, load_latest_cut
+
+    N_METRICS, WORLD_FROM, WORLD_TO, C = 50, 8, 4, 8
+
+    def make():
+        return MetricCollection(
+            {
+                f"m{i:02d}": MulticlassAccuracy(num_classes=C, average="micro", validate_args=False)
+                for i in range(N_METRICS)
+            }
+        )
+
+    rng = np.random.default_rng(17)
+    replicas = [make() for _ in range(WORLD_FROM)]
+    for col in replicas:
+        preds = jnp.asarray(rng.standard_normal((64, C)), jnp.float32)
+        target = jnp.asarray(rng.integers(0, C, (64,)), jnp.int32)
+        col.update(preds, target)
+    payloads = [col.snapshot_state() for col in replicas]
+    config = elastic_mod.config_digest(replicas[0])
+
+    class _Cohort(DistributedBackend):
+        has_object_channel = True
+
+        def __init__(self, rank, step):
+            self._rank, self._step = rank, step
+
+        def available(self):
+            return True
+
+        def world_size(self):
+            return WORLD_FROM
+
+        def rank(self):
+            return self._rank
+
+        def all_gather_object(self, obj, group=None):
+            return [
+                obj if r == self._rank else elastic_mod.make_stamp(r, self._step, config)
+                for r in range(WORLD_FROM)
+            ]
+
+    K = 5  # coordinated-vs-plain save rounds
+
+    def coordinated_once(root):
+        mgrs = [DistributedSnapshotManager(root, r, WORLD_FROM, keep=None) for r in range(WORLD_FROM)]
+        t0 = time.perf_counter()
+        for step in range(1, K + 1):
+            for r in range(WORLD_FROM):
+                agreed, digest = elastic_mod.snapshot_barrier(
+                    _Cohort(r, step), rank=r, world_size=WORLD_FROM, step=step, config=config
+                )
+                meta = {
+                    "batches": step, "items": step, "mode": "eager", "degraded": False,
+                    "base_batches": 0, "base_items": 0,
+                    "elastic": mgrs[r].elastic_meta(agreed, digest, config),
+                }
+                mgrs[r].save(agreed, payloads[r], meta=meta)
+        return (time.perf_counter() - t0) * 1e6 / (K * WORLD_FROM)
+
+    def plain_once(root):
+        from tpumetrics.runtime.snapshot import SnapshotManager
+
+        mgrs = [SnapshotManager(os.path.join(root, f"r{r}"), keep=None) for r in range(WORLD_FROM)]
+        t0 = time.perf_counter()
+        for step in range(1, K + 1):
+            for r in range(WORLD_FROM):
+                mgrs[r].save(step, payloads[r], meta={"batches": step, "items": step})
+        return (time.perf_counter() - t0) * 1e6 / (K * WORLD_FROM)
+
+    coord_times, plain_times = [], []
+    coord_root = None
+    for _ in range(3):
+        root = tempfile.mkdtemp(prefix="tpum_elastic_")
+        coord_times.append(coordinated_once(root))
+        if coord_root is None:
+            coord_root = root  # keep one populated root for the restore leg
+        else:
+            shutil.rmtree(root, ignore_errors=True)
+        root2 = tempfile.mkdtemp(prefix="tpum_plain_")
+        plain_times.append(plain_once(root2))
+        shutil.rmtree(root2, ignore_errors=True)
+    ours, ref = min(coord_times), min(plain_times)
+
+    # ---- the 8 -> 4 restore leg (correctness-asserted, ceiling-gated)
+    proto = make()
+    ref_col = make()
+    ref_col.load_snapshot_state(proto.fold_snapshot_states(payloads))
+    want_vals = {k: float(v) for k, v in ref_col.compute().items()}
+
+    t0 = time.perf_counter()
+    new_cols = []
+    for r in range(WORLD_TO):
+        cut = load_latest_cut(coord_root)
+        folded = proto.fold_snapshot_states([cut.payloads[i] for i in sorted(cut.payloads)])
+        share = proto.reshard_snapshot_state(folded, r, WORLD_TO)
+        col = make()
+        col.load_snapshot_state(share)
+        new_cols.append(col)
+    restore_ms = (time.perf_counter() - t0) * 1e3
+    shutil.rmtree(coord_root, ignore_errors=True)
+
+    got = proto.fold_snapshot_states([c.snapshot_state() for c in new_cols])
+    final = make()
+    final.load_snapshot_state(got)
+    got_vals = {k: float(v) for k, v in final.compute().items()}
+    for k, v in want_vals.items():
+        assert abs(got_vals[k] - v) < 1e-7, (k, got_vals[k], v)
+
+    extras = {
+        "barrier_added_us_per_step": round(ours - ref, 2),
+        "restore_8to4_ms": round(restore_ms, 1),
+        "metrics_in_collection": N_METRICS,
+    }
+    return ours, ref, {"extras": extras}
+
+
 def _enable_compilation_cache() -> None:
     """Persistent XLA compile cache: one-time eager/jit compiles (expensive on
     remote-attached accelerators) amortize across bench runs, as they do in
@@ -1059,6 +1201,10 @@ def _check_floors(headline_vs, details):
     # compile ceilings: a bucketed config recompiling per shape is a regression
     for name, ceiling in gate.get("compile_ceilings", {}).items():
         check_ceiling(name, "streaming_compiles", ceiling, fail_on_error=True)
+    # elastic ceilings: the 8->4 fold+reshard restore must stay interactive
+    # (a restore that takes minutes would eat the preemption grace window)
+    for key, ceiling in gate.get("elastic_restore_ceilings", {}).items():
+        check_ceiling("elastic_restore", key, ceiling, fail_on_error=True)
     return violations
 
 
@@ -1083,6 +1229,7 @@ def main() -> None:
         ("bertscore_ddp_eval", _bench_bertscore_ddp),
         ("streaming_throughput", _bench_streaming_throughput),
         ("resilience_overhead", _bench_resilience_overhead),
+        ("elastic_restore", _bench_elastic_restore),
     ):
         try:
             ours, ref, accounting = fn()

@@ -23,7 +23,11 @@ matplotlib.use("Agg")
 import jax
 import jax.numpy as jnp
 
-OUT_DIR = os.path.join(os.path.dirname(__file__), "_plots")
+# TPUMETRICS_PLOT_DIR reroutes the output (tests point it at a tmpdir so a
+# tier-1 run never dirties the checked-in examples/_plots/*.png)
+OUT_DIR = os.environ.get("TPUMETRICS_PLOT_DIR") or os.path.join(
+    os.path.dirname(__file__), "_plots"
+)
 
 
 def _save(fig, name):

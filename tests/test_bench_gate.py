@@ -19,6 +19,7 @@ def test_floor_file_shape():
         "bertscore_ddp_eval",
         "streaming_throughput",
         "resilience_overhead",
+        "elastic_restore",
     }
     # floors must sit below the recorded best (headroom for chip variance)
     for name, floor in data["floors"].items():
@@ -30,6 +31,10 @@ def test_floor_file_shape():
     assert data["compile_ceilings"]["streaming_throughput"] == 7
     # the resilience gate pins the inert guard to ~predicate cost
     assert data["resilience_overhead_ceilings"]["inert_overhead_ns_per_call"] > 0
+    # the elastic gate bounds the 8->4 fold+reshard restore wall time
+    assert data["elastic_restore_ceilings"]["restore_8to4_ms"] > 0
+    # the tier-1 dots guard floor exists and is a sane full-suite count
+    assert data["tier1_collection_floor"] > 1000
 
 
 def test_check_floors_flags_compile_regressions():
@@ -63,6 +68,24 @@ def test_check_floors_flags_resilience_overhead_regressions():
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and all("resilience_overhead" in v for v in violations)
     details["resilience_overhead"] = "error: RuntimeError: boom"
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and "scenario failed" in violations[0]
+
+
+def test_check_floors_flags_elastic_restore_regressions():
+    """An 8->4 restore whose wall time blew past the ceiling (e.g. an
+    accidental per-rank re-fold) must trip the gate even at a healthy
+    barrier-overhead ratio; an errored scenario (the correctness invariant
+    never ran) trips it too."""
+    details = {"elastic_restore": {"vs_baseline": 0.9, "restore_8to4_ms": 10**7}}
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("restore_8to4_ms" in v for v in violations)
+    details["elastic_restore"]["restore_8to4_ms"] = 100.0
+    assert bench._check_floors(headline_vs=1000.0, details=details) == []
+    details["elastic_restore"]["vs_baseline"] = 0.01  # barrier ate the step
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("elastic_restore" in v for v in violations)
+    details["elastic_restore"] = "error: RuntimeError: boom"
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and "scenario failed" in violations[0]
 

@@ -1,0 +1,908 @@
+"""tpumetrics.resilience.elastic: coordinated snapshots + elastic restore.
+
+The acceptance surface of the elastic subsystem: world-N evaluation folded
+through a consistent snapshot cut and resharded onto world M (shrink AND
+grow) must compute exactly what the uninterrupted single-host run computes —
+bit-exact for integer/sum/list states, within 1e-6 for mean-weighted float
+states — and a partial snapshot set must either raise a typed error or
+degrade EXPLICITLY (flag + ledger event) under a quorum policy, never return
+a silently wrong answer.  Everything runs on one CPU host at emulated world
+1..4, with the ``"preempt"`` fault kind producing the partial sets.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics import MetricCollection
+from tpumetrics.aggregation import MeanMetric
+from tpumetrics.classification import (
+    MulticlassAccuracy,
+    MulticlassF1Score,
+    MulticlassStatScores,
+)
+from tpumetrics.metric import Metric
+from tpumetrics.parallel.backend import DistributedBackend, NoOpBackend
+from tpumetrics.parallel.merge import reshard_metric_states
+from tpumetrics.regression import MeanSquaredError
+from tpumetrics.resilience import (
+    DistributedSnapshotManager,
+    ElasticRestoreError,
+    Fault,
+    FaultInjectionBackend,
+    InconsistentCutError,
+    InjectedPreemption,
+    QuorumPolicy,
+    config_digest,
+    load_latest_cut,
+    scan_cuts,
+    snapshot_barrier,
+)
+from tpumetrics.resilience import elastic as elastic_mod
+from tpumetrics.runtime import StreamingEvaluator
+from tpumetrics.text import BLEUScore
+from tpumetrics.utils.data import dim_zero_cat
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+
+def _blocks(items, n):
+    """Contiguous block sharding (preserves global order under rank-major
+    concatenation — the sharding the elastic cat placement assumes)."""
+    split = np.array_split(np.arange(len(items)), n)
+    return [[items[int(i)] for i in idx] for idx in split]
+
+
+def _class_stream(rng, n_batches, num_classes=5, max_rows=12):
+    out = []
+    for _ in range(n_batches):
+        n = int(rng.integers(1, max_rows))
+        out.append(
+            (
+                jnp.asarray(rng.standard_normal((n, num_classes), dtype=np.float32)),
+                jnp.asarray(rng.integers(0, num_classes, n).astype(np.int32)),
+            )
+        )
+    return out
+
+
+def _roundtrip(make, stream, n, m, cat_placement="rank0"):
+    """world-n evaluate → coordinated payloads → fold → reshard to world-m →
+    finish the stream → fold again.  Returns (single-host value, elastic
+    value)."""
+    ref = make()
+    for b in stream:
+        ref.update(*b)
+    want = ref.compute()
+
+    k = (2 * len(stream)) // 3
+    proto = make()
+    ranks = [make() for _ in range(n)]
+    for r, block in enumerate(_blocks(stream[:k], n)):
+        for b in block:
+            ranks[r].update(*b)
+    folded = proto.fold_snapshot_states([mm.snapshot_state() for mm in ranks])
+
+    news = [make() for _ in range(m)]
+    for j, mm in enumerate(news):
+        mm.load_snapshot_state(
+            proto.reshard_snapshot_state(folded, j, m, cat_placement=cat_placement)
+        )
+    for j, block in enumerate(_blocks(stream[k:], m)):
+        for b in block:
+            news[j].update(*b)
+    final = make()
+    final.load_snapshot_state(proto.fold_snapshot_states([mm.snapshot_state() for mm in news]))
+    return want, final.compute()
+
+
+# ------------------------------------------------- fold/reshard family sweep
+
+
+WORLDS = [(3, 2), (2, 4)]  # shrink and grow, emulated at world <= 4
+
+
+class TestElasticRoundtripFamilies:
+    """The satellite sweep: >= 6 metric families, world-N → snapshot →
+    restore at world-M == single-host reference."""
+
+    @pytest.mark.parametrize("n,m", WORLDS)
+    def test_statscores_integer_states_bit_exact(self, n, m):
+        rng = np.random.default_rng(0)
+        stream = _class_stream(rng, 12)
+        want, got = _roundtrip(
+            lambda: MulticlassStatScores(num_classes=5, average="micro", validate_args=False),
+            stream, n, m,
+        )
+        assert np.array_equal(np.asarray(want), np.asarray(got))
+
+    @pytest.mark.parametrize("n,m", WORLDS)
+    def test_mse_sum_states(self, n, m):
+        rng = np.random.default_rng(1)
+        stream = [
+            (
+                jnp.asarray(rng.standard_normal(int(sz)).astype(np.float32)),
+                jnp.asarray(rng.standard_normal(int(sz)).astype(np.float32)),
+            )
+            for sz in rng.integers(1, 9, size=12)
+        ]
+        want, got = _roundtrip(MeanSquaredError, stream, n, m)
+        assert float(got) == pytest.approx(float(want), rel=1e-6)
+
+    @pytest.mark.parametrize("n,m", WORLDS)
+    def test_aggregation_mean_weighted(self, n, m):
+        rng = np.random.default_rng(2)
+        stream = [
+            (jnp.asarray(rng.standard_normal(int(sz)).astype(np.float32)),)
+            for sz in rng.integers(1, 7, size=12)
+        ]
+        want, got = _roundtrip(MeanMetric, stream, n, m)
+        assert float(got) == pytest.approx(float(want), rel=1e-6)
+
+    @pytest.mark.parametrize("n,m", WORLDS)
+    def test_text_bleu(self, n, m):
+        rng = np.random.default_rng(3)
+        vocab = ["the", "cat", "sat", "on", "a", "mat", "dog", "ran", "far", "away"]
+
+        def sentence():
+            return " ".join(rng.choice(vocab, size=int(rng.integers(3, 9))))
+
+        stream = [([sentence()], [[sentence(), sentence()]]) for _ in range(12)]
+        want, got = _roundtrip(lambda: BLEUScore(n_gram=2), stream, n, m)
+        assert float(got) == pytest.approx(float(want), rel=1e-6)
+
+    @pytest.mark.parametrize("n,m", WORLDS)
+    def test_samplewise_list_states_order_exact(self, n, m):
+        # ALL states are eager cat lists; compute is per-sample, so global
+        # row ORDER must survive the resize (rank0 placement + block shards)
+        rng = np.random.default_rng(4)
+        stream = _class_stream(rng, 12, num_classes=3)
+        want, got = _roundtrip(
+            lambda: MulticlassF1Score(
+                num_classes=3, average="macro", multidim_average="samplewise",
+                validate_args=False,
+            ),
+            stream, n, m,
+        )
+        assert np.array_equal(np.asarray(want), np.asarray(got))
+
+    @pytest.mark.parametrize("n,m", WORLDS)
+    def test_collection_with_compute_groups(self, n, m):
+        rng = np.random.default_rng(5)
+        stream = _class_stream(rng, 12, num_classes=4)
+
+        def make():
+            return MetricCollection(
+                {
+                    "acc": MulticlassAccuracy(num_classes=4, average="micro", validate_args=False),
+                    "f1": MulticlassF1Score(num_classes=4, average="macro", validate_args=False),
+                }
+            )
+
+        want, got = _roundtrip(make, stream, n, m)
+        for key, val in want.items():
+            assert np.array_equal(np.asarray(val), np.asarray(got[key])), key
+
+    @pytest.mark.parametrize("n,m", WORLDS)
+    def test_masked_buffer_functional_states(self, n, m):
+        """The bucketed-runtime shape: functional state pytrees with
+        MaskedBuffer leaves fold and reshard through fold_state_dicts /
+        reshard_state_dict, preserving row order and exact contents."""
+
+        class BufferCat(Metric):
+            full_state_update = False
+
+            def __init__(self, capacity=64, **kwargs):
+                super().__init__(**kwargs)
+                self.add_state("value", default=[], dist_reduce_fx="cat", capacity=capacity)
+
+            def update(self, x):
+                self._append_state("value", x)
+
+            def compute(self):
+                return dim_zero_cat(self.value)
+
+        rng = np.random.default_rng(6)
+        stream = [
+            jnp.asarray(rng.standard_normal(int(sz)).astype(np.float32))
+            for sz in rng.integers(1, 6, size=12)
+        ]
+        want = np.concatenate([np.asarray(b) for b in stream])
+
+        proto = BufferCat()
+        k = 8
+        states = [BufferCat().init_state() for _ in range(n)]
+        for r, block in enumerate(_blocks(stream[:k], n)):
+            for b in block:
+                states[r] = proto.functional_update(states[r], b)
+        folded = proto.fold_state_dicts(states)
+        new_states = [
+            proto.reshard_state_dict(folded, j, m, cat_placement="balanced") for j in range(m)
+        ]
+        for j, block in enumerate(_blocks(stream[k:], m)):
+            for b in block:
+                new_states[j] = proto.functional_update(new_states[j], b)
+        final = proto.fold_state_dicts(new_states)
+        from tpumetrics.buffers import materialize
+
+        got = np.asarray(materialize(final["value"]))
+        # balanced placement splits restored rows contiguously across the new
+        # ranks, so the re-fold interleaves restored blocks with new data —
+        # contents are exact, global order is only guaranteed by "rank0"
+        assert sorted(got.tolist()) == sorted(want.tolist())
+        # rank0 placement preserves exact global order end-to-end
+        rank0_states = [proto.reshard_state_dict(folded, j, m) for j in range(m)]
+        for j, block in enumerate(_blocks(stream[k:], m)):
+            for b in block:
+                rank0_states[j] = proto.functional_update(rank0_states[j], b)
+        ordered = np.asarray(materialize(proto.fold_state_dicts(rank0_states)["value"]))
+        assert np.array_equal(ordered, want)
+
+
+class TestReshardSemantics:
+    def test_update_count_folds_and_splits_additively(self):
+        rng = np.random.default_rng(7)
+        stream = _class_stream(rng, 6)
+        make = lambda: MulticlassAccuracy(num_classes=5, average="micro", validate_args=False)  # noqa: E731
+        ranks = [make() for _ in range(2)]
+        for r, block in enumerate(_blocks(stream, 2)):
+            for b in block:
+                ranks[r].update(*b)
+        proto = make()
+        folded = proto.fold_snapshot_states([mm.snapshot_state() for mm in ranks])
+        assert folded["update_count"] == 6
+        shares = [proto.reshard_snapshot_state(folded, j, 3) for j in range(3)]
+        # near-even additive split: folds back to the total, and every rank
+        # reads as updated (no spurious compute-before-update warnings)
+        assert [s["update_count"] for s in shares] == [2, 2, 2]
+        uneven = [proto.reshard_snapshot_state(folded, j, 4) for j in range(4)]
+        assert [s["update_count"] for s in uneven] == [2, 2, 1, 1]
+
+    def test_unsupported_state_kinds_raise_typed(self):
+        class CustomReduce(Metric):
+            def __init__(self, **kwargs):
+                super().__init__(**kwargs)
+                self.add_state("v", jnp.zeros(()), dist_reduce_fx=lambda x: x.sum(0))
+
+            def update(self, x):
+                self.v = self.v + x
+
+            def compute(self):
+                return self.v
+
+        m = CustomReduce()
+        m.update(jnp.asarray(1.0))
+        with pytest.raises(TPUMetricsUserError, match="custom reduce"):
+            reshard_metric_states({"v": m.v}, m._reductions, 0, 2)
+
+        class GatherArray(Metric):
+            def __init__(self, **kwargs):
+                super().__init__(**kwargs)
+                self.add_state("v", jnp.zeros((2,)), dist_reduce_fx=None)
+
+            def update(self, x):
+                self.v = x
+
+            def compute(self):
+                return self.v
+
+        g = GatherArray()
+        with pytest.raises(TPUMetricsUserError, match="resharded"):
+            reshard_metric_states({"v": g.v}, g._reductions, 0, 2)
+
+    def test_buffer_overflow_on_rank0_placement_raises(self):
+        from tpumetrics.buffers import buffer_append, create_buffer
+
+        folded = buffer_append(create_buffer(8), jnp.arange(7.0))
+        template = create_buffer(4)
+        from tpumetrics.utils.data import dim_zero_cat as _cat  # reductions map
+
+        reductions = {"value": _cat}
+        with pytest.raises(TPUMetricsUserError, match="capacity"):
+            reshard_metric_states(
+                {"value": folded}, reductions, 0, 2, templates={"value": template}
+            )
+        # balanced placement spreads 7 rows over 2 ranks of capacity 4: fits
+        shares = [
+            reshard_metric_states(
+                {"value": folded}, reductions, j, 2,
+                templates={"value": template}, cat_placement="balanced",
+            )
+            for j in range(2)
+        ]
+        assert [int(s["value"].count) for s in shares] == [4, 3]
+
+    def test_fold_rejects_mismatched_configs(self):
+        a = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        b = MulticlassAccuracy(num_classes=6, average="micro", validate_args=False)
+        with pytest.raises(TPUMetricsUserError, match="incompatible"):
+            a.fold_snapshot_states([a.snapshot_state(), b.snapshot_state()])
+
+
+# ------------------------------------------------------------------- barrier
+
+
+class _Cohort(DistributedBackend):
+    """Emulated eager cohort: this rank's object gather returns its own
+    payload plus precomputed peer stamps (rank 0 is us, the rest are given —
+    the test_telemetry idiom)."""
+
+    has_object_channel = True
+
+    def __init__(self, rank, world, peek):
+        self._rank, self._world, self._peek = rank, world, peek
+
+    def available(self):
+        return True
+
+    def world_size(self):
+        return self._world
+
+    def rank(self):
+        return self._rank
+
+    def all_gather_object(self, obj, group=None):
+        return [obj if r == self._rank else self._peek(r) for r in range(self._world)]
+
+
+class TestSnapshotBarrier:
+    def test_agreement_is_max_proposal_and_digests_match(self):
+        cfg = "c" * 40
+        steps = {0: 5, 1: 7, 2: 6}
+        results = [
+            snapshot_barrier(
+                _Cohort(r, 3, lambda p: elastic_mod.make_stamp(p, steps[p], cfg)),
+                rank=r, world_size=3, step=steps[r], config=cfg,
+            )
+            for r in range(3)
+        ]
+        assert all(step == 7 for step, _ in results)
+        assert len({digest for _, digest in results}) == 1
+
+    def test_config_mismatch_names_diverging_rank(self):
+        def peek(r):
+            return elastic_mod.make_stamp(r, 3, "bad" if r == 2 else "good")
+
+        with pytest.raises(InconsistentCutError, match=r"rank\(s\) \[2\]"):
+            snapshot_barrier(
+                _Cohort(0, 3, peek), rank=0, world_size=3, step=3, config="good"
+            )
+
+    def test_duplicate_rank_assignment_refused(self):
+        # two processes misconfigured with the same snapshot_rank would
+        # overwrite each other's files: the barrier must fail fast instead
+        def peek(r):
+            return elastic_mod.make_stamp(0 if r == 1 else r, 3, "cfg")  # rank 1 claims 0
+
+        with pytest.raises(InconsistentCutError, match="share a snapshot_rank"):
+            snapshot_barrier(
+                _Cohort(0, 3, peek), rank=0, world_size=3, step=3, config="cfg"
+            )
+
+    def test_lost_stamp_refuses_cut(self):
+        with pytest.raises(InconsistentCutError, match="lost the stamp"):
+            snapshot_barrier(
+                _Cohort(0, 2, lambda r: None), rank=0, world_size=2, step=1, config="x"
+            )
+
+    def test_world1_skips_exchange(self):
+        step, digest = snapshot_barrier(
+            None, rank=0, world_size=1, step=4, config="solo"
+        )
+        assert step == 4 and digest == elastic_mod.cut_digest(4, 1, "solo")
+
+    def test_barrier_records_ledger_event(self):
+        from tpumetrics import telemetry
+
+        with telemetry.capture() as led:
+            snapshot_barrier(None, rank=0, world_size=1, step=1, config="x")
+        assert led.summary()["elastic_barriers"] == 1
+
+
+class TestPreemptFault:
+    def test_preempt_latches_dead(self):
+        backend = FaultInjectionBackend(
+            NoOpBackend(), faults=[Fault(kind="preempt", op="all_gather_object", call=1)]
+        )
+        assert backend.all_gather_object("a") == ["a"]  # call 0: alive
+        with pytest.raises(InjectedPreemption, match="preempted"):
+            backend.all_gather_object("b")  # call 1: reclaimed
+        assert backend.preempted
+        # LATCHED: every later collective on any op refuses too
+        with pytest.raises(InjectedPreemption, match="latched"):
+            backend.all_gather(jnp.zeros(2))
+        with pytest.raises(InjectedPreemption):
+            backend.all_reduce(jnp.zeros(2), "sum")
+        assert ("all_gather_object", 1, "preempt") in backend.fired
+
+    def test_preempt_is_deterministic_under_retries(self):
+        from tpumetrics.resilience import SyncFailedError, SyncPolicy, run_guarded, sync_policy
+
+        backend = FaultInjectionBackend(
+            NoOpBackend(), faults=[Fault(kind="preempt", op="all_gather_object")]
+        )
+        with sync_policy(SyncPolicy(retries=2, backoff=0.001)):
+            with pytest.raises(SyncFailedError, match="3 attempt"):
+                run_guarded(
+                    lambda: backend.all_gather_object("x"),
+                    op="all_gather_object", backend=backend,
+                )
+
+
+# --------------------------------------------------------------- cut storage
+
+
+def _write_cut(root, world, step, payload_fn, config="cfg", ranks=None, mode="eager", bases=None):
+    """Write one coordinated cut by hand (what N processes would do)."""
+    digest = elastic_mod.cut_digest(step, world, config)
+    for r in ranks if ranks is not None else range(world):
+        mgr = DistributedSnapshotManager(root, r, world, keep=None)
+        meta = {
+            "batches": step, "items": step, "mode": mode, "degraded": False,
+            "base_batches": (bases or {}).get(r, 0), "base_items": 0,
+            "elastic": mgr.elastic_meta(step, digest, config),
+        }
+        mgr.save(step, payload_fn(r), meta=meta)
+    return digest
+
+
+class TestCutDiscovery:
+    def test_complete_cut_found_and_loaded(self, tmp_path):
+        root = str(tmp_path)
+        _write_cut(root, 3, 5, lambda r: {"v": jnp.full((2,), float(r))})
+        cuts = scan_cuts(root)
+        assert len(cuts) == 1 and cuts[0].missing == () and cuts[0].world_size == 3
+        loaded = load_latest_cut(root, template={"v": jnp.zeros(2)})
+        assert not loaded.degraded and sorted(loaded.payloads) == [0, 1, 2]
+        assert float(loaded.payloads[2]["v"][0]) == 2.0
+
+    def test_incomplete_latest_falls_back_to_older_complete(self, tmp_path):
+        root = str(tmp_path)
+        _write_cut(root, 2, 3, lambda r: {"v": jnp.zeros(1)})
+        _write_cut(root, 2, 7, lambda r: {"v": jnp.ones(1)}, ranks=[0])  # rank 1 preempted
+        loaded = load_latest_cut(root, template={"v": jnp.zeros(1)})
+        assert loaded.step == 3 and not loaded.degraded
+
+    def test_only_incomplete_raises_typed(self, tmp_path):
+        root = str(tmp_path)
+        _write_cut(root, 3, 4, lambda r: {"v": jnp.zeros(1)}, ranks=[0, 2])
+        with pytest.raises(InconsistentCutError, match=r"missing rank\(s\) \[1\]"):
+            load_latest_cut(root, template={"v": jnp.zeros(1)})
+
+    def test_quorum_degrades_explicitly_with_ledger_event(self, tmp_path):
+        from tpumetrics import telemetry
+
+        root = str(tmp_path)
+        _write_cut(root, 2, 3, lambda r: {"v": jnp.zeros(1)})
+        _write_cut(root, 4, 9, lambda r: {"v": jnp.full((1,), float(r))}, ranks=[0, 1, 3])
+        with telemetry.capture() as led:
+            loaded = load_latest_cut(
+                root, template={"v": jnp.zeros(1)}, quorum=QuorumPolicy(min_ranks=3)
+            )
+        assert loaded.step == 9 and loaded.degraded and loaded.missing == (2,)
+        assert led.summary()["elastic_degraded_cuts"] == 1
+        # a tighter quorum rejects the partial set -> older complete cut wins
+        loaded2 = load_latest_cut(
+            root, template={"v": jnp.zeros(1)}, quorum=QuorumPolicy(min_fraction=1.0)
+        )
+        assert loaded2.step == 3 and not loaded2.degraded
+
+    def test_corrupt_member_counts_as_missing(self, tmp_path):
+        root = str(tmp_path)
+        _write_cut(root, 2, 3, lambda r: {"v": jnp.zeros(1)})
+        _write_cut(root, 2, 6, lambda r: {"v": jnp.ones(1)})
+        victim = os.path.join(root, "rank-00001", "snapshot-6.npz")
+        with open(victim, "r+b") as fh:
+            fh.truncate(os.path.getsize(victim) // 2)
+        loaded = load_latest_cut(root, template={"v": jnp.zeros(1)})
+        assert loaded.step == 3  # torn member invalidated the newest cut
+
+    def test_same_step_different_worlds_stay_separate_cuts(self, tmp_path):
+        # stale rank dirs from a BIGGER former world can hold a snapshot at
+        # the same step as a current smaller-world cut; the cut digest keeps
+        # the sets apart (per-rank step monotonicity guarantees overlapping
+        # ranks never reuse a step, so only disjoint stale ranks can collide)
+        root = str(tmp_path)
+        _write_cut(root, 4, 5, lambda r: {"v": jnp.ones(1)}, ranks=[2, 3])  # stale, incomplete
+        _write_cut(root, 2, 5, lambda r: {"v": jnp.zeros(1)})
+        loaded = load_latest_cut(root, template={"v": jnp.zeros(1)})
+        assert loaded.world_size == 2 and not loaded.degraded
+        assert len(scan_cuts(root)) == 2
+
+
+# --------------------------------------------------- evaluator restore_elastic
+
+
+def _make_acc():
+    return MulticlassAccuracy(num_classes=5, average="micro", validate_args=False)
+
+
+def _elastic_evaluators(root, make, world, digest, buckets=8, backend_for=None):
+    """One evaluator per emulated rank.  The cohort backend serves PEER
+    stamps from the shared ``props`` dict, which :func:`_record_proposals`
+    fills for every rank BEFORE any rank writes — mirroring a real
+    concurrent barrier, where all proposals are gathered before any save
+    can bump a rank's on-disk step."""
+    props: dict = {}
+
+    def peek(r):
+        return elastic_mod.make_stamp(r, props[r], digest)
+
+    evs = []
+    for r in range(world):
+        backend = backend_for(r, peek) if backend_for else _Cohort(r, world, peek)
+        evs.append(
+            StreamingEvaluator(
+                make(), buckets=buckets, snapshot_dir=root,
+                snapshot_rank=r, snapshot_world_size=world, barrier_backend=backend,
+            )
+        )
+    return evs, props
+
+
+def _record_proposals(evs, props):
+    for ev in evs:
+        ev.flush()
+    for r, ev in enumerate(evs):
+        props[r] = ev._barrier_proposal()
+
+
+class TestStreamingEvaluatorElastic:
+    def _feed_and_cut(self, evs, props, batches_per_rank):
+        for ev, block in zip(evs, batches_per_rank):
+            for b in block:
+                ev.submit(*b)
+        _record_proposals(evs, props)
+        for ev in evs:
+            ev.snapshot()
+
+    @pytest.mark.parametrize("n,m", [(2, 3), (3, 1)])
+    def test_resize_roundtrip_matches_uninterrupted(self, tmp_path, n, m):
+        rng = np.random.default_rng(11)
+        stream = _class_stream(rng, 12)
+        ref = _make_acc()
+        for b in stream:
+            ref.update(*b)
+        want = float(ref.compute())
+
+        root = str(tmp_path)
+        digest = config_digest(_make_acc())
+        evs, props = _elastic_evaluators(root, _make_acc, n, digest)
+        k = 8
+        self._feed_and_cut(evs, props, _blocks(stream[:k], n))
+        for ev in evs:
+            ev.close(drain=False)  # preemption: the whole slice goes away
+
+        news, _ = _elastic_evaluators(root, _make_acc, m, digest)
+        infos = [ev.restore_elastic() for ev in news]
+        assert all(info["batches"] == k and info["from_world"] == n for info in infos)
+        assert all(not info["degraded"] for info in infos)
+        for ev, block in zip(news, _blocks(stream[k:], m)):
+            for b in block:
+                ev.submit(*b)
+        for ev in news:
+            ev.flush()
+        proto = _make_acc()
+        folded = proto.fold_state_dicts([ev._state for ev in news])
+        got = float(proto.functional_compute(folded))
+        assert got == want  # bit-identical to the uninterrupted run
+        for ev in news:
+            ev.close(drain=False)
+
+    def test_preempted_rank_partial_cut_falls_back_then_quorum_degrades(self, tmp_path):
+        rng = np.random.default_rng(12)
+        stream = _class_stream(rng, 12)
+        root = str(tmp_path)
+        digest = config_digest(_make_acc())
+
+        def backend_for(r, peek):
+            inner = _Cohort(r, 2, peek)
+            if r == 1:  # rank 1 is reclaimed at its SECOND barrier
+                return FaultInjectionBackend(
+                    inner, faults=[Fault(kind="preempt", op="all_gather_object", call=1)]
+                )
+            return FaultInjectionBackend(inner)
+
+        evs, props = _elastic_evaluators(
+            root, _make_acc, 2, digest, backend_for=backend_for
+        )
+        self._feed_and_cut(evs, props, _blocks(stream[:6], 2))  # cut 1: complete
+        for ev, block in zip(evs, _blocks(stream[6:10], 2)):
+            for b in block:
+                ev.submit(*b)
+        _record_proposals(evs, props)
+        evs[0].snapshot()  # cut 2: rank 0 writes...
+        with pytest.raises(InjectedPreemption):
+            evs[1].snapshot()  # ...rank 1 dies mid-barrier -> partial set
+        for ev in evs:
+            ev.close(drain=False)
+
+        # no quorum: the partial cut 2 is skipped, complete cut 1 restores
+        ev_new = StreamingEvaluator(
+            _make_acc(), buckets=8, snapshot_dir=root,
+            snapshot_rank=0, snapshot_world_size=1,
+        )
+        info = ev_new.restore_elastic()
+        assert info["batches"] == 6 and not info["degraded"]
+        for b in stream[6:]:
+            ev_new.submit(*b)
+        got = float(ev_new.compute())
+        ref = _make_acc()
+        for b in stream:
+            ref.update(*b)
+        assert got == float(ref.compute())
+        ev_new.close()
+
+        # with a quorum: the fresher partial cut restores, DEGRADED + event
+        from tpumetrics import telemetry
+
+        ev_q = StreamingEvaluator(
+            _make_acc(), buckets=8, snapshot_dir=root,
+            snapshot_rank=0, snapshot_world_size=1,
+        )
+        with telemetry.capture() as led:
+            info_q = ev_q.restore_elastic(quorum=QuorumPolicy(min_ranks=1))
+        assert info_q["degraded"] and info_q["missing_ranks"] == [1]
+        # fresher than the complete cut (local step 5 > 3), but the adopted
+        # position only counts the PRESENT rank's data — rank 1's batches
+        # are absent from the fold, visibly, not silently
+        assert info_q["step"] == 5 and info_q["batches"] == 5
+        assert ev_q.stats()["degraded"]
+        assert led.summary()["elastic_degraded_cuts"] == 1
+        assert led.summary()["elastic_restores"] == 1
+        ev_q.close()
+
+    def test_eager_list_state_evaluator_resize_order_exact(self, tmp_path):
+        def make():
+            return MulticlassF1Score(
+                num_classes=3, average="macro", multidim_average="samplewise",
+                validate_args=False,
+            )
+
+        rng = np.random.default_rng(13)
+        stream = _class_stream(rng, 9, num_classes=3)
+        ref = make()
+        for b in stream:
+            ref.update(*b)
+        want = np.asarray(ref.compute())
+
+        root = str(tmp_path)
+        digest = config_digest(make())
+        evs, props = _elastic_evaluators(
+            root, make, 3, digest,
+            buckets=None,  # eager mode: list states cannot take padding
+        )
+        self._feed_and_cut(evs, props, _blocks(stream[:6], 3))
+        for ev in evs:
+            ev.close(drain=False)
+
+        ev_new = StreamingEvaluator(
+            make(), snapshot_dir=root, snapshot_rank=0, snapshot_world_size=1
+        )
+        info = ev_new.restore_elastic()
+        assert info["from_world"] == 3 and info["batches"] == 6
+        for b in stream[6:]:
+            ev_new.submit(*b)
+        got = np.asarray(ev_new.compute())
+        assert np.array_equal(got, want)
+        ev_new.close()
+
+    def test_restore_elastic_guards(self, tmp_path):
+        root = str(tmp_path)
+        ev = StreamingEvaluator(_make_acc(), buckets=8, snapshot_dir=root)
+        with pytest.raises(TPUMetricsUserError, match="snapshot_rank"):
+            ev.restore_elastic()
+        ev.close()
+
+        ev2 = StreamingEvaluator(
+            _make_acc(), buckets=8, snapshot_dir=root,
+            snapshot_rank=0, snapshot_world_size=1,
+        )
+        assert ev2.restore_elastic() is None  # fresh root: nothing to adopt
+        ev2.submit(*_class_stream(np.random.default_rng(0), 1)[0])
+        ev2.flush()
+        with pytest.raises(TPUMetricsUserError, match="double-count"):
+            ev2.restore_elastic()
+        ev2.close()
+
+    def test_snapshot_every_with_multi_rank_elastic_refused(self, tmp_path):
+        # the auto cadence triggers on LOCAL batch counts, which uneven
+        # stream shards make non-lockstep: the unmatched barrier would hang
+        with pytest.raises(ValueError, match="lockstep"):
+            StreamingEvaluator(
+                _make_acc(), buckets=8, snapshot_dir=str(tmp_path),
+                snapshot_rank=0, snapshot_world_size=2, snapshot_every=10,
+            )
+        # world-1 elastic keeps the auto cadence (nobody to diverge from)
+        ev = StreamingEvaluator(
+            _make_acc(), buckets=8, snapshot_dir=str(tmp_path),
+            snapshot_rank=0, snapshot_world_size=1, snapshot_every=10,
+        )
+        ev.close()
+
+    def test_mixed_base_cut_raises_before_touching_state(self, tmp_path):
+        """A cut whose members disagree on the elastic base is rejected
+        BEFORE any state is adopted: catching the typed error must leave
+        the evaluator fresh (no half-restored state to double-count on)."""
+        root = str(tmp_path)
+        donor = _make_acc()
+        donor.update(*_class_stream(np.random.default_rng(3), 1)[0])
+        cfg = config_digest(_make_acc())
+        _write_cut(
+            root, 2, 5, lambda r: donor.snapshot_state(), config=cfg,
+            bases={0: 0, 1: 3},  # rank 1 was crash-restored from another base
+        )
+        ev = StreamingEvaluator(
+            _make_acc(), snapshot_dir=root, snapshot_rank=0, snapshot_world_size=1
+        )
+        with pytest.raises(InconsistentCutError, match="different\\s+elastic bases"):
+            ev.restore_elastic()
+        assert ev.stats()["batches"] == 0
+        assert ev._metric._update_count == 0  # state untouched
+        ev.close()
+
+    def test_restore_elastic_config_change_raises(self, tmp_path):
+        root = str(tmp_path)
+        ev = StreamingEvaluator(
+            _make_acc(), buckets=8, snapshot_dir=root,
+            snapshot_rank=0, snapshot_world_size=1,
+        )
+        ev.submit(*_class_stream(np.random.default_rng(1), 1)[0])
+        ev.flush()
+        ev.snapshot()
+        ev.close(drain=False)
+        other = StreamingEvaluator(
+            MulticlassAccuracy(num_classes=7, average="micro", validate_args=False),
+            buckets=8, snapshot_dir=root, snapshot_rank=0, snapshot_world_size=1,
+        )
+        with pytest.raises(ElasticRestoreError):
+            other.restore_elastic()
+        other.close()
+
+    def test_second_resize_totals_do_not_double_count(self, tmp_path):
+        """Two successive resizes: the elastic base bookkeeping must not
+        re-count the pre-resize prefix once per rank at the second fold."""
+        rng = np.random.default_rng(14)
+        stream = _class_stream(rng, 12)
+        root = str(tmp_path)
+        digest = config_digest(_make_acc())
+
+        ev0 = StreamingEvaluator(
+            _make_acc(), buckets=8, snapshot_dir=root,
+            snapshot_rank=0, snapshot_world_size=1,
+        )
+        for b in stream[:4]:
+            ev0.submit(*b)
+        ev0.flush()
+        ev0.snapshot()
+        ev0.close(drain=False)
+
+        # resize 1 -> 2, continue, cut again
+        evs, props = _elastic_evaluators(root, _make_acc, 2, digest)
+        infos = [ev.restore_elastic() for ev in evs]
+        assert all(i["batches"] == 4 for i in infos)
+        for ev, block in zip(evs, _blocks(stream[4:8], 2)):
+            for b in block:
+                ev.submit(*b)
+        _record_proposals(evs, props)
+        for ev in evs:
+            ev.snapshot()
+        for ev in evs:
+            ev.close(drain=False)
+
+        # resize 2 -> 1: the adopted position must be 8, not 4 + 2*4
+        ev_final = StreamingEvaluator(
+            _make_acc(), buckets=8, snapshot_dir=root,
+            snapshot_rank=0, snapshot_world_size=1,
+        )
+        info = ev_final.restore_elastic()
+        assert info["batches"] == 8, info
+        for b in stream[8:]:
+            ev_final.submit(*b)
+        got = float(ev_final.compute())
+        ref = _make_acc()
+        for b in stream:
+            ref.update(*b)
+        assert got == float(ref.compute())
+        ev_final.close()
+
+    def test_snapshot_after_degraded_restore_onto_stale_rank_dir(self, tmp_path):
+        """Regression: a quorum-degraded restore can adopt a global position
+        LOWER than a reused rank directory's last on-disk step (the lost
+        rank carried most of the stream).  The barrier proposal is floored
+        past the stale step, so coordinated snapshots keep working instead
+        of failing the per-rank monotonic check forever."""
+        rng = np.random.default_rng(15)
+        stream = _class_stream(rng, 8)
+        root = str(tmp_path)
+        # world 2, rank 0 drains 6 batches, rank 1 drains 2 -> cut step 6
+        digest = config_digest(_make_acc())
+        evs, props = _elastic_evaluators(root, _make_acc, 2, digest)
+        for b in stream[:6]:
+            evs[0].submit(*b)
+        for b in stream[6:8]:
+            evs[1].submit(*b)
+        _record_proposals(evs, props)
+        for ev in evs:
+            ev.snapshot()
+        for ev in evs:
+            ev.close(drain=False)
+        # rank 0's snapshot (6 of the 8 batches) is lost with its host
+        import shutil
+
+        shutil.rmtree(os.path.join(root, "rank-00000"))
+        ev_new = StreamingEvaluator(
+            _make_acc(), buckets=8, snapshot_dir=root,
+            snapshot_rank=0, snapshot_world_size=1,
+        )
+        info = ev_new.restore_elastic(quorum=QuorumPolicy(min_ranks=1))
+        assert info["degraded"] and info["batches"] == 2  # only rank 1 folded
+        ev_new.submit(*stream[0])
+        ev_new.flush()
+        ev_new.snapshot()  # must not raise SnapshotError (non-monotonic)
+        ev_new.close()
+
+    def test_new_world_cut_at_same_position_is_complete(self, tmp_path):
+        """Regression: after a resize, the first coordinated snapshot can
+        land at the same stream position as the pre-resize cut.  The save
+        must still write THIS world's cut member (never reuse the old
+        world's step-equal file), or the new cut is permanently missing the
+        rank."""
+        rng = np.random.default_rng(16)
+        stream = _class_stream(rng, 4)
+        root = str(tmp_path)
+        ev0 = StreamingEvaluator(
+            _make_acc(), buckets=8, snapshot_dir=root,
+            snapshot_rank=0, snapshot_world_size=1,
+        )
+        for b in stream:
+            ev0.submit(*b)
+        ev0.flush()
+        ev0.snapshot()
+        ev0.close(drain=False)
+
+        digest = config_digest(_make_acc())
+        evs, props = _elastic_evaluators(root, _make_acc, 2, digest)
+        for ev in evs:
+            assert ev.restore_elastic()["batches"] == 4
+        _record_proposals(evs, props)
+        for ev in evs:
+            ev.snapshot()  # establish a world-2 base WITHOUT new progress
+        for ev in evs:
+            ev.close(drain=False)
+        complete_world2 = [
+            c for c in scan_cuts(root) if c.world_size == 2 and not c.missing
+        ]
+        assert complete_world2, [
+            (c.step, c.world_size, c.missing) for c in scan_cuts(root)
+        ]
+        # and the fresh world-2 cut restores at the same global position
+        ev_check = StreamingEvaluator(
+            _make_acc(), buckets=8, snapshot_dir=root,
+            snapshot_rank=0, snapshot_world_size=1,
+        )
+        assert ev_check.restore_elastic()["batches"] == 4
+        ev_check.close()
+
+    def test_mode_mismatch_is_typed_not_corruption(self, tmp_path):
+        """Regression: a bucketed cut has no reconstruction skeleton; an
+        eager-mode restore must raise the typed mode-mismatch error, not
+        misclassify every member as a torn file and fall back silently."""
+        root = str(tmp_path)
+        ev = StreamingEvaluator(
+            _make_acc(), buckets=8, snapshot_dir=root,
+            snapshot_rank=0, snapshot_world_size=1,
+        )
+        ev.submit(*_class_stream(np.random.default_rng(2), 1)[0])
+        ev.flush()
+        ev.snapshot()
+        ev.close(drain=False)
+        eager = StreamingEvaluator(
+            _make_acc(), snapshot_dir=root, snapshot_rank=0, snapshot_world_size=1
+        )
+        with pytest.raises(ElasticRestoreError, match="bucketed"):
+            eager.restore_elastic()
+        eager.close()

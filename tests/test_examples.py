@@ -138,8 +138,12 @@ def _example_env() -> dict:
 
 
 @pytest.mark.parametrize("example", _EXAMPLES)
-def test_example_runs(example):
+def test_example_runs(example, tmp_path):
     env = _example_env()
+    # route plot outputs to the test's tmpdir: regenerating the checked-in
+    # examples/_plots/*.png on every tier-1 run dirtied the working tree
+    # (and had to be checked out before every commit)
+    env["TPUMETRICS_PLOT_DIR"] = str(tmp_path / "plots")
     out = subprocess.run(
         [sys.executable, os.path.join(_EXAMPLES_DIR, example)],
         capture_output=True,

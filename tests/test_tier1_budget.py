@@ -1,0 +1,64 @@
+"""Tier-1 dots-regression guard.
+
+DOTS_PASSED (the driver's tier-1 health number) can shrink SILENTLY: a
+broken conftest probe, an import error under ``--continue-on-collection-
+errors``, or an over-eager ``slow`` marker sweep all make the suite smaller
+without failing anything.  This guard pins the COLLECTED non-slow test count
+to a floor recorded in ``bench_floors.json`` (``tier1_collection_floor``),
+so an accidental mass-skip fails loudly instead of quietly eroding coverage.
+
+Engagement is decided from the INVOCATION, not the collection result: a run
+pointed at the whole ``tests/`` tree (or the repo root) is a full-suite run
+and the guard asserts — a module vanishing from such a run is exactly the
+failure being guarded against, so it must FAIL the guard, never skip it.
+Runs pointed at specific files/nodes, or filtered with ``-k``/non-tier-1
+``-m``, skip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+_TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def test_tier1_collection_floor(request):
+    keyword = getattr(request.config.option, "keyword", "") or ""
+    markexpr = getattr(request.config.option, "markexpr", "") or ""
+    if keyword or markexpr not in ("", "not slow"):
+        pytest.skip(f"filtered run (-k {keyword!r} / -m {markexpr!r}): not tier-1 shaped")
+    arg_paths = [
+        os.path.abspath(str(a).split("::")[0]) for a in (request.config.args or [])
+    ]
+    if not any(p in (_TESTS_DIR, _REPO) for p in arg_paths):
+        pytest.skip(f"targeted run ({arg_paths}): the floor only binds full-suite runs")
+
+    # full-suite invocation: every top-level test module must have survived
+    # collection — a vanished module IS the mass-skip being guarded against
+    collected_files = {
+        os.path.basename(item.location[0]) for item in request.session.items
+    }
+    all_modules = {
+        name for name in os.listdir(_TESTS_DIR)
+        if name.startswith("test_") and name.endswith(".py")
+    }
+    missing = sorted(all_modules - collected_files)
+    assert not missing, (
+        f"Full-suite run collected nothing from {missing}: a collection error or "
+        "module-wide skip is silently dropping tests (check for import failures "
+        "under --continue-on-collection-errors)."
+    )
+    with open(os.path.join(_REPO, "bench_floors.json")) as fh:
+        floor = int(json.load(fh)["tier1_collection_floor"])
+    n = len(request.session.items)
+    assert n >= floor, (
+        f"Tier-1 collected only {n} non-slow tests but the floor is {floor}: "
+        "a collection error, a broken conftest probe, or an over-eager slow-marker "
+        "sweep is silently shrinking the suite. If the shrink is intentional "
+        "(tests moved/merged), lower tier1_collection_floor in bench_floors.json "
+        "in the same change, with a note."
+    )

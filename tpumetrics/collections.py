@@ -433,12 +433,12 @@ class MetricCollection:
         self._compute_groups_create_state_ref(copy=False)
         return {"metrics": {name: m.snapshot_state() for name, m in self._modules.items()}}
 
-    def load_snapshot_state(self, snap: Dict[str, Any], strict: bool = True) -> None:
-        """Restore a :meth:`snapshot_state` payload; member name mismatches
-        raise before any member state is touched."""
+    def _check_snapshot_members(self, snap: Dict[str, Any], strict: bool = True) -> Dict[str, Any]:
+        """Validate a collection payload's member-name set against this
+        collection; returns the ``metrics`` mapping."""
         from tpumetrics.utils.exceptions import TPUMetricsUserError
 
-        metrics = snap.get("metrics")
+        metrics = snap.get("metrics") if isinstance(snap, dict) else None
         if not isinstance(metrics, dict):
             raise TPUMetricsUserError(
                 "Not a MetricCollection snapshot (missing 'metrics' mapping)."
@@ -453,10 +453,99 @@ class MetricCollection:
                     + ([f"unexpected {unexpected}"] if unexpected else [])
                 )
             )
+        return metrics
+
+    def load_snapshot_state(self, snap: Dict[str, Any], strict: bool = True) -> None:
+        """Restore a :meth:`snapshot_state` payload; member name mismatches
+        raise before any member state is touched."""
+        metrics = self._check_snapshot_members(snap, strict=strict)
         for name, m in self._modules.items():
             m.load_snapshot_state(metrics[name], strict=strict)
         # every member now holds exact restored values — no propagation owed
         self._state_is_copy = True
+
+    # ------------------------------------------------ elastic fold / reshard
+
+    def fold_snapshot_states(
+        self, payloads: List[Dict[str, Any]], strict: bool = True
+    ) -> Dict[str, Any]:
+        """Fold per-rank collection payloads member-by-member into one
+        canonical global payload (each member via
+        :meth:`~tpumetrics.metric.Metric.fold_snapshot_states`).  Snapshots
+        are leader-propagated and therefore self-contained, so compute-group
+        layout does not matter here."""
+        from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+        if not payloads:
+            raise TPUMetricsUserError("fold_snapshot_states needs at least one rank payload")
+        per_rank = [self._check_snapshot_members(p, strict=strict) for p in payloads]
+        return {
+            "metrics": {
+                name: m.fold_snapshot_states([r[name] for r in per_rank], strict=strict)
+                for name, m in self._modules.items()
+            }
+        }
+
+    def reshard_snapshot_state(
+        self,
+        snap: Dict[str, Any],
+        rank: int,
+        world_size: int,
+        cat_placement: str = "rank0",
+    ) -> Dict[str, Any]:
+        """Rank ``rank``'s share of a folded global collection payload."""
+        metrics = self._check_snapshot_members(snap)
+        return {
+            "metrics": {
+                name: m.reshard_snapshot_state(metrics[name], rank, world_size, cat_placement)
+                for name, m in self._modules.items()
+            }
+        }
+
+    def fold_state_dicts(self, states: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """Fold per-rank functional collection states (keyed by compute-group
+        leader, the :meth:`init_state` shape) into one global state.  Every
+        rank must carry the same leader set — differing keys mean the ranks
+        established different compute groups, which is a config divergence."""
+        from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+        if not states:
+            raise TPUMetricsUserError("fold_state_dicts needs at least one rank state")
+        keys = set(states[0])
+        for i, s in enumerate(states[1:], start=1):
+            if set(s) != keys:
+                raise TPUMetricsUserError(
+                    f"Rank state {i} carries compute-group leaders {sorted(set(s))} but "
+                    f"rank 0 carries {sorted(keys)}: the ranks do not agree on the "
+                    "compute-group layout; establish groups from the same "
+                    "representative batch on every rank."
+                )
+        unknown = keys - set(self._modules)
+        if unknown:
+            raise TPUMetricsUserError(
+                f"Unknown compute-group leaders {sorted(unknown)} in the folded state."
+            )
+        return {k: self._modules[k].fold_state_dicts([s[k] for s in states]) for k in keys}
+
+    def reshard_state_dict(
+        self,
+        state: Dict[str, Any],
+        rank: int,
+        world_size: int,
+        cat_placement: str = "rank0",
+    ) -> Dict[str, Any]:
+        """Rank ``rank``'s share of a folded functional collection state."""
+        from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+        unknown = set(state) - set(self._modules)
+        if unknown:
+            raise TPUMetricsUserError(
+                f"Unknown compute-group leaders {sorted(unknown)} in the folded state."
+            )
+        return {
+            k: self._modules[k].reshard_state_dict(v, rank, world_size, cat_placement)
+            for k, v in state.items()
+        }
 
     # ------------------------------------------------------------- containers
 

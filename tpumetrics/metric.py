@@ -1089,11 +1089,11 @@ class Metric(ABC):
             "config": self._config_fingerprint(),
         }
 
-    def load_snapshot_state(self, snap: Dict[str, Any], strict: bool = True) -> None:
-        """Restore a :meth:`snapshot_state` payload, validating the state
-        spec (names, shapes, dtypes of tensor states) AND the config
-        fingerprint before touching any state so a mismatched restore fails
-        atomically with a clear error."""
+    def _validate_snapshot_payload(self, snap: Dict[str, Any], strict: bool = True) -> None:
+        """Shared validation for :meth:`load_snapshot_state` and
+        :meth:`fold_snapshot_states`: state spec (names, shapes, dtypes of
+        tensor states) AND the config fingerprint, checked before any state
+        is touched so a mismatched restore fails atomically."""
         states = snap["states"]
         problems = []
         saved_cfg = snap.get("config")
@@ -1128,12 +1128,105 @@ class Metric(ABC):
                 f"Snapshot state spec incompatible with {type(self).__name__}: " + "; ".join(problems)
                 + ". HINT: the metric configuration must match the one that wrote the snapshot."
             )
+
+    def load_snapshot_state(self, snap: Dict[str, Any], strict: bool = True) -> None:
+        """Restore a :meth:`snapshot_state` payload, validating the state
+        spec (names, shapes, dtypes of tensor states) AND the config
+        fingerprint before touching any state so a mismatched restore fails
+        atomically with a clear error."""
+        self._validate_snapshot_payload(snap, strict=strict)
         with self._all_persistent():
-            self.load_state_dict(states, strict=strict)
+            self.load_state_dict(snap["states"], strict=strict)
         self._update_count = int(snap.get("update_count", self._update_count))
         self._computed = None
         self._cache = None
         self._is_synced = False
+
+    # ------------------------------------------------ elastic fold / reshard
+
+    def fold_snapshot_states(
+        self, payloads: List[Dict[str, Any]], strict: bool = True
+    ) -> Dict[str, Any]:
+        """Fold per-rank :meth:`snapshot_state` payloads into ONE canonical
+        global payload, using each state's registered ``dist_reduce_fx``
+        (reduce states fold; cat/list states concatenate in rank order) —
+        the merge half of elastic restore
+        (:mod:`tpumetrics.resilience.elastic`).
+
+        Every payload is validated against THIS metric's config fingerprint
+        first, so a cut written by differently-configured ranks fails loudly
+        before any state is merged.  ``update_count`` sums across ranks.
+        """
+        from tpumetrics.parallel.merge import merge_metric_states
+
+        if not payloads:
+            raise TPUMetricsUserError("fold_snapshot_states needs at least one rank payload")
+        for snap in payloads:
+            self._validate_snapshot_payload(snap, strict=strict)
+        merged = merge_metric_states([dict(p["states"]) for p in payloads], self._reductions)
+        return {
+            "states": merged,
+            "update_count": int(sum(int(p.get("update_count", 0)) for p in payloads)),
+            "config": self._config_fingerprint(),
+        }
+
+    def reshard_snapshot_state(
+        self,
+        snap: Dict[str, Any],
+        rank: int,
+        world_size: int,
+        cat_placement: str = "rank0",
+    ) -> Dict[str, Any]:
+        """Rank ``rank``'s share of a folded global payload for a
+        ``world_size``-rank world — the split half of elastic restore.
+        Placement semantics per state kind:
+        :func:`tpumetrics.parallel.merge.reshard_metric_states`.
+
+        The global ``update_count`` splits near-evenly across ranks
+        (additive bookkeeping: a later fold sums back to the global total,
+        and every rank that received a share of the data also reads as
+        updated — no spurious "compute before update" warnings)."""
+        from tpumetrics.parallel.merge import reshard_metric_states
+
+        states = reshard_metric_states(
+            dict(snap["states"]), self._reductions, rank, world_size,
+            cat_placement=cat_placement,
+        )
+        total = int(snap.get("update_count", 0))
+        base, extra = divmod(total, world_size)
+        return {
+            "states": states,
+            "update_count": base + (1 if rank < extra else 0),
+            "config": self._config_fingerprint(),
+        }
+
+    def fold_state_dicts(self, states: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """Fold per-rank functional state pytrees (the :meth:`init_state`
+        shape, MaskedBuffer leaves included) into one global state — the
+        bucketed-runtime counterpart of :meth:`fold_snapshot_states`."""
+        from tpumetrics.parallel.merge import merge_metric_states
+
+        if not states:
+            raise TPUMetricsUserError("fold_state_dicts needs at least one rank state")
+        return merge_metric_states(list(states), self._reductions)
+
+    def reshard_state_dict(
+        self,
+        state: Dict[str, Any],
+        rank: int,
+        world_size: int,
+        cat_placement: str = "rank0",
+    ) -> Dict[str, Any]:
+        """Rank ``rank``'s share of a folded functional state for a
+        ``world_size``-rank world.  Buffer states reshard against this
+        metric's declared per-rank capacities (:meth:`init_state`); overflow
+        raises rather than dropping restored rows."""
+        from tpumetrics.parallel.merge import reshard_metric_states
+
+        return reshard_metric_states(
+            dict(state), self._reductions, rank, world_size,
+            templates=self.init_state(), cat_placement=cat_placement,
+        )
 
     # ------------------------------------------------------------ dev / dtype
 

@@ -65,6 +65,16 @@ class DistributedBackend:
     def world_size(self) -> int:
         raise NotImplementedError
 
+    def rank(self) -> int:
+        """This process's rank within the backend's world (0-based).
+
+        Eager backends return a plain int (``jax.process_index`` for the
+        multi-host case); the in-trace :class:`AxisBackend` returns the traced
+        ``jax.lax.axis_index``.  Consumed by the elastic snapshot layer
+        (:mod:`tpumetrics.resilience.elastic`) to stamp per-rank snapshots.
+        """
+        return 0
+
     def all_gather(self, x: Array, group: Optional[Any] = None) -> List[Array]:
         """Gather ``x`` from every rank; returns a list of per-rank arrays.
 
@@ -167,6 +177,9 @@ class AxisBackend(DistributedBackend):
             return self._axis_size
         return _axis_size(self.axis_name)
 
+    def rank(self) -> int:
+        return jax.lax.axis_index(self.axis_name)  # traced, in-trace only
+
     def all_gather(self, x: Array, group: Optional[Any] = None) -> List[Array]:
         axis = group if isinstance(group, str) else self.axis_name
         if _telemetry.recording():  # static metadata only — trace-safe
@@ -213,6 +226,9 @@ class MultiHostBackend(DistributedBackend):
 
     def world_size(self) -> int:
         return jax.process_count()
+
+    def rank(self) -> int:
+        return int(jax.process_index())
 
     def _gather_equal(self, x: Array) -> List[Array]:
         from jax.experimental import multihost_utils

@@ -1,9 +1,16 @@
-"""Pure cross-replica state merging.
+"""Pure cross-replica state merging — and its inverse, elastic resharding.
 
-This is the reduce step the reference applies after its eager all_gather
-(reference metric.py:438-453), factored out as a standalone pure function so
-it can be reused by: the eager DCN sync path, checkpoint merging across
-hosts, and the test harness's emulated-rank mode.
+:func:`merge_metric_states` is the reduce step the reference applies after
+its eager all_gather (reference metric.py:438-453), factored out as a
+standalone pure function so it can be reused by: the eager DCN sync path,
+checkpoint merging across hosts, and the test harness's emulated-rank mode.
+
+:func:`reshard_metric_states` is the elastic-restore counterpart
+(``tpumetrics.resilience.elastic``): it takes ONE canonical global state —
+the output of a :func:`merge_metric_states` fold over a consistent snapshot
+cut — and splits it back into per-rank states for a possibly *different*
+world size, such that a later merge over the resharded ranks (plus whatever
+they accumulate afterwards) reproduces the uninterrupted global result.
 """
 
 from __future__ import annotations
@@ -58,6 +65,150 @@ def merge_metric_states(
             out[name] = jnp.stack(vals)
         elif callable(reduction_fn):
             out[name] = reduction_fn(jnp.stack(vals))
+        else:
+            raise TypeError(f"reduction for state {name!r} must be callable or None")
+    return out
+
+
+def _split_rows(n_rows: int, rank: int, world_size: int) -> slice:
+    """Contiguous, order-preserving row range rank ``rank`` owns of ``n_rows``
+    (np.array_split semantics: earlier ranks get the larger remainders)."""
+    base, extra = divmod(n_rows, world_size)
+    start = rank * base + min(rank, extra)
+    return slice(start, start + base + (1 if rank < extra else 0))
+
+
+def _placement_slice(n_rows: int, rank: int, world_size: int, cat_placement: str) -> slice:
+    """Which of ``n_rows`` restored rows rank ``rank`` receives: all of them
+    on rank 0 (``"rank0"`` — preserves global order under contiguous-block
+    stream sharding) or a contiguous near-even share (``"balanced"``)."""
+    if cat_placement == "balanced":
+        return _split_rows(n_rows, rank, world_size)
+    return slice(0, n_rows) if rank == 0 else slice(0, 0)
+
+
+def _reshard_buffer(
+    buf: Any, rank: int, world_size: int, template: Any, cat_placement: str
+) -> Any:
+    """Split a folded :class:`MaskedBuffer` back into rank ``rank``'s
+    per-rank-capacity buffer.  Overflow (more placed rows than the per-rank
+    capacity admits) raises — silently dropping restored rows would be a
+    silently wrong ``compute()``."""
+    from tpumetrics.buffers import MaskedBuffer, buffer_append, create_buffer, materialize
+    from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+    rows = materialize(buf)
+    mine = rows[_placement_slice(int(rows.shape[0]), rank, world_size, cat_placement)]
+    capacity = int(template.values.shape[0])
+    if int(mine.shape[0]) > capacity:
+        raise TPUMetricsUserError(
+            f"Elastic reshard would place {int(mine.shape[0])} buffer rows on rank {rank} "
+            f"but the per-rank capacity is {capacity}; refusing to drop restored rows. "
+            "HINT: use cat_placement='balanced' to spread rows across ranks, or raise "
+            "the state's declared capacity before restoring."
+        )
+    out = buffer_append(create_buffer(capacity, tuple(template.values.shape[1:]), template.values.dtype), mine) if mine.shape[0] else MaskedBuffer(
+        values=jnp.zeros_like(template.values),
+        count=jnp.zeros((), jnp.int32),
+        requested=jnp.zeros((), jnp.int32),
+    )
+    if rank == 0:
+        # overflow accounting survives the round trip: rows the folded buffer
+        # had already dropped stay visible in rank 0's `requested`
+        dropped = jnp.asarray(buf.requested, jnp.int32) - jnp.asarray(buf.count, jnp.int32)
+        out = out._replace(requested=out.requested + dropped)
+    return out
+
+
+def reshard_metric_states(
+    global_state: Dict[str, Any],
+    reductions: Dict[str, Optional[Union[str, Callable]]],
+    rank: int,
+    world_size: int,
+    templates: Optional[Dict[str, Any]] = None,
+    cat_placement: str = "rank0",
+) -> Dict[str, Any]:
+    """Split one canonical global state into rank ``rank``'s share of a
+    ``world_size``-rank world (the elastic-restore inverse of
+    :func:`merge_metric_states`).
+
+    Placement rules, chosen so a later merge reproduces the global value:
+
+    - **sum** states: rank 0 carries the folded value, every other rank the
+      additive identity (zeros) — integer-exact, no division.
+    - **max / min / mean** states: the folded value is broadcast to every
+      rank (idempotent under max/min; mean-reduced states re-merge to the
+      same value while untouched, and further updates re-weight per rank as
+      usual — the standard DDP mean approximation).
+    - **cat / list / buffer** states: row placement follows
+      ``cat_placement`` — ``"rank0"`` (default) keeps every restored row on
+      rank 0, which preserves global row ORDER under contiguous-block stream
+      sharding (restored rows, then rank 0's new rows, then rank 1's, ...);
+      ``"balanced"`` splits rows contiguously across ranks (use for
+      order-insensitive states, or when a shrink would overflow rank 0's
+      buffer capacity).
+    - **reduce-``None`` array** states (per-rank stacks) and **custom
+      callable** reductions have no generic inverse: both raise instead of
+      guessing.
+
+    ``templates`` supplies per-rank default leaves where the global value
+    alone cannot determine the per-rank shape (MaskedBuffer capacities).
+    """
+    from tpumetrics.buffers import MaskedBuffer
+    from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+    if not (0 <= rank < world_size):
+        raise ValueError(f"rank must be in [0, {world_size}), got {rank}")
+    if cat_placement not in ("rank0", "balanced"):
+        raise ValueError(f"cat_placement must be 'rank0' or 'balanced', got {cat_placement!r}")
+    out: Dict[str, Any] = {}
+    for name, reduction_fn in reductions.items():
+        val = global_state[name]
+        if isinstance(val, MaskedBuffer):
+            template = (templates or {}).get(name)
+            if not isinstance(template, MaskedBuffer):
+                raise TPUMetricsUserError(
+                    f"Resharding buffer state {name!r} needs a MaskedBuffer template "
+                    "(per-rank capacity); pass templates=metric.init_state()."
+                )
+            out[name] = _reshard_buffer(val, rank, world_size, template, cat_placement)
+            continue
+        if isinstance(val, list):
+            if reduction_fn is None:
+                # ragged per-item lists keep their items whole; placement
+                # splits BETWEEN items (item boundaries are part of the state)
+                items = list(val)
+                out[name] = items[_placement_slice(len(items), rank, world_size, cat_placement)]
+                continue
+            # cat-style list (the fold normalizes it to [one concatenated
+            # array]): split its ROWS, preserving global order
+            if not val:
+                out[name] = []
+                continue
+            rows = dim_zero_cat([jnp.atleast_1d(jnp.asarray(v)) for v in val])
+            mine_rows = rows[_placement_slice(int(rows.shape[0]), rank, world_size, cat_placement)]
+            out[name] = [mine_rows] if int(mine_rows.shape[0]) else []
+            continue
+        arr = jnp.asarray(val)
+        if reduction_fn is dim_zero_sum:
+            out[name] = arr if rank == 0 else jnp.zeros_like(arr)
+        elif reduction_fn in (dim_zero_mean, dim_zero_max, dim_zero_min):
+            out[name] = arr
+        elif reduction_fn is dim_zero_cat:
+            rows = jnp.atleast_1d(arr)
+            out[name] = rows[_placement_slice(int(rows.shape[0]), rank, world_size, cat_placement)]
+        elif reduction_fn is None:
+            raise TPUMetricsUserError(
+                f"State {name!r} uses gather (dist_reduce_fx=None) semantics on an array: "
+                "its global form is a per-rank stack with no world-size-independent "
+                "meaning, so it cannot be resharded elastically."
+            )
+        elif callable(reduction_fn):
+            raise TPUMetricsUserError(
+                f"State {name!r} uses a custom reduce function; elastic resharding has "
+                "no generic inverse for it. Register the state with one of "
+                "'sum'/'mean'/'max'/'min'/'cat' to make it elastic-restorable."
+            )
         else:
             raise TypeError(f"reduction for state {name!r} must be callable or None")
     return out

@@ -14,6 +14,12 @@ actually sees (see ``docs/resilience.md`` for the guide):
   :class:`SyncTimeoutError` / :class:`SyncFailedError` instead of hangs,
   ``on_failure`` degraded modes (``"local"`` / ``"last_good"``), and a
   NaN/Inf screen (``guard_non_finite``) on states before they travel.
+- :mod:`~tpumetrics.resilience.elastic` — coordinated multi-host snapshots
+  (a barrier agrees on the logical step and stamps every rank's snapshot
+  with a cross-rank cut digest) and **elastic restore**: fold a consistent
+  cut's per-rank states into one global state and re-shard it onto a NEW
+  world size (shrink and grow), with explicit :class:`QuorumPolicy`
+  degradation for partial sets — never a silent wrong answer.
 
 Quick start::
 
@@ -32,7 +38,24 @@ latest good snapshot on worker death (bounded by a crash-loop budget), and
 from unsynced or stale state.
 """
 
-from tpumetrics.resilience.faults import Fault, FaultInjectionBackend, InjectedFaultError
+from tpumetrics.resilience.elastic import (
+    DistributedSnapshotManager,
+    ElasticCut,
+    ElasticError,
+    ElasticRestoreError,
+    InconsistentCutError,
+    QuorumPolicy,
+    config_digest,
+    load_latest_cut,
+    scan_cuts,
+    snapshot_barrier,
+)
+from tpumetrics.resilience.faults import (
+    Fault,
+    FaultInjectionBackend,
+    InjectedFaultError,
+    InjectedPreemption,
+)
 from tpumetrics.resilience.policy import (
     NonFiniteStateError,
     SyncError,
@@ -47,17 +70,28 @@ from tpumetrics.resilience.policy import (
 )
 
 __all__ = [
+    "DistributedSnapshotManager",
+    "ElasticCut",
+    "ElasticError",
+    "ElasticRestoreError",
     "Fault",
     "FaultInjectionBackend",
+    "InconsistentCutError",
     "InjectedFaultError",
+    "InjectedPreemption",
     "NonFiniteStateError",
+    "QuorumPolicy",
     "SyncError",
     "SyncFailedError",
     "SyncPolicy",
     "SyncTimeoutError",
+    "config_digest",
     "get_sync_policy",
+    "load_latest_cut",
     "run_guarded",
+    "scan_cuts",
     "screen_non_finite",
     "set_sync_policy",
+    "snapshot_barrier",
     "sync_policy",
 ]

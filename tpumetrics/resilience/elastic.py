@@ -1,0 +1,482 @@
+"""Coordinated multi-host snapshots and elastic world-resize restore.
+
+The dominant Cloud-TPU failure mode is not a flaky collective — it is
+**preemption**: the slice is reclaimed mid-evaluation and the job restarts on
+a *different* world size.  Rank-local snapshots
+(:mod:`tpumetrics.runtime.snapshot`) survive that only per rank; nothing
+guarantees the per-rank files describe the SAME logical moment, and nothing
+turns N rank-local states into M.  This module adds both halves:
+
+**Coordinated snapshot (the consistent cut).**  Before any rank writes, the
+ranks exchange ``(rank, step-proposal, config-digest)`` stamps over the
+backend's host-object channel — the same wire, and the same
+:func:`~tpumetrics.resilience.policy.run_guarded` deadline, as the lockstep
+digest exchange (:mod:`tpumetrics.telemetry.lockstep`), so a dead rank here
+becomes a typed :class:`~tpumetrics.resilience.policy.SyncTimeoutError`
+instead of a hang.  The barrier agrees on one logical step (the max
+proposal), verifies every rank runs the same metric configuration, and
+stamps each rank's snapshot with ``{step, world_size, rank, config_digest,
+cut_digest}``.  Snapshots carrying the same ``cut_digest`` ARE one
+consistent cut; everything else is two different moments.
+
+**Elastic restore (merge-then-reshard).**  :func:`load_latest_cut` scans the
+shared snapshot root for the newest step whose rank set is complete (or
+admitted by an explicit :class:`QuorumPolicy` — degraded, flagged, ledger-
+recorded, never silent).  The per-rank payloads then fold into one canonical
+global state using each state's registered ``dist_reduce_fx``
+(:func:`tpumetrics.parallel.merge.merge_metric_states`: reduce states fold,
+cat/list/buffer states concatenate in rank order) and re-shard onto the new
+world size (:func:`tpumetrics.parallel.merge.reshard_metric_states`) —
+shrink (8→4) and grow (4→8) both supported.  The evaluator facade is
+:meth:`tpumetrics.runtime.evaluator.StreamingEvaluator.restore_elastic`.
+
+Single-host testability: the ``"preempt"`` fault kind
+(:class:`~tpumetrics.resilience.faults.FaultInjectionBackend`) kills a rank
+between a snapshot and its next barrier, producing exactly the partial cut
+sets this module must refuse or degrade on — every path runs at world 1..4
+on one CPU host (``tests/test_elastic.py``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from tpumetrics.telemetry import ledger as _telemetry
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+__all__ = [
+    "DistributedSnapshotManager",
+    "ElasticCut",
+    "ElasticError",
+    "ElasticRestoreError",
+    "InconsistentCutError",
+    "QuorumPolicy",
+    "config_digest",
+    "cut_digest",
+    "load_latest_cut",
+    "make_stamp",
+    "scan_cuts",
+    "snapshot_barrier",
+]
+
+_RANK_DIR_RE = re.compile(r"^rank-(\d+)$")
+
+
+class ElasticError(TPUMetricsUserError):
+    """Base class for elastic snapshot/restore failures."""
+
+
+class InconsistentCutError(ElasticError):
+    """A snapshot set does not form a restorable consistent cut (ranks
+    missing without a quorum policy, diverging stamps, or a barrier whose
+    participants disagree)."""
+
+
+class ElasticRestoreError(ElasticError):
+    """A consistent cut was found but cannot be restored into the caller's
+    world/metric (config mismatch, mode mismatch, unrestorable state kind)."""
+
+
+# --------------------------------------------------------------------- digests
+
+
+def config_digest(metric: Any) -> str:
+    """Stable digest of a metric/collection's configuration — the thing every
+    rank of a cut must agree on for the fold to be meaningful.  Covers each
+    member's config fingerprint (num_classes, thresholds, ...) plus its type
+    name; sync wiring is excluded by construction
+    (:meth:`~tpumetrics.metric.Metric._config_fingerprint`)."""
+    from tpumetrics.collections import MetricCollection
+
+    if isinstance(metric, MetricCollection):
+        cfg: Any = {
+            "collection": {
+                name: {"type": type(m).__name__, "config": m._config_fingerprint()}
+                for name, m in metric._modules.items()
+            }
+        }
+    else:
+        cfg = {"type": type(metric).__name__, "config": metric._config_fingerprint()}
+    return hashlib.sha1(json.dumps(cfg, sort_keys=True, default=str).encode()).hexdigest()
+
+
+def cut_digest(step: int, world_size: int, config: str) -> str:
+    """The cut identity: snapshots stamped with the same digest were written
+    by the same barrier round.  Deterministic on purpose — per-rank step
+    monotonicity (rank 0 participates in every cut) makes ``(step,
+    world_size)`` unique per run, so no nonce is needed (or wanted: a nonce
+    would break idempotent re-stamping after a barrier retry)."""
+    return hashlib.sha1(f"{int(step)}|{int(world_size)}|{config}".encode()).hexdigest()
+
+
+def make_stamp(rank: int, step: int, config: str) -> Dict[str, Any]:
+    """One rank's barrier proposal: who I am, where my stream is, what I run."""
+    return {"rank": int(rank), "step": int(step), "config": str(config)}
+
+
+# --------------------------------------------------------------------- barrier
+
+
+def snapshot_barrier(
+    backend: Any,
+    *,
+    rank: int,
+    world_size: int,
+    step: int,
+    config: str,
+    group: Optional[Any] = None,
+) -> Tuple[int, str]:
+    """Agree with every rank on the logical step of a coordinated snapshot.
+
+    Exchanges :func:`make_stamp` proposals over ``backend.all_gather_object``
+    under the active :class:`~tpumetrics.resilience.policy.SyncPolicy`
+    deadline (the lockstep digest-exchange wire), then:
+
+    - a lost payload (``None`` in the gathered list) or a wrong-size world
+      raises :class:`InconsistentCutError` — no rank writes a half-cut;
+    - a config-digest mismatch names the diverging rank (majority blame,
+      like :func:`~tpumetrics.telemetry.lockstep.verify_lockstep`);
+    - the agreed step is the MAX proposal (ranks drain independent stream
+      shards, so positions legitimately differ; the max keeps every rank's
+      per-directory step monotonic).
+
+    Returns ``(agreed_step, cut_digest)``.  World-1 backends without fault
+    injection skip the exchange (there is nobody to disagree with).
+    """
+    exchange = world_size > 1 or (
+        backend is not None and getattr(backend, "fault_injected", False)
+    )
+    if exchange and backend is None:
+        raise ElasticError(
+            f"A coordinated snapshot at world_size={world_size} needs a backend with a "
+            "host-object channel for the barrier exchange."
+        )
+    agreed = int(step)
+    if exchange:
+        from tpumetrics.resilience.policy import run_guarded
+
+        stamp = make_stamp(rank, step, config)
+        stamps = list(
+            run_guarded(
+                lambda: backend.all_gather_object(stamp, group=group),
+                op="elastic_barrier_exchange",
+                backend=backend,
+            )
+        )
+        if len(stamps) != world_size:
+            raise InconsistentCutError(
+                f"Snapshot barrier gathered {len(stamps)} stamp(s) but world_size is "
+                f"{world_size}: the barrier cohort and the declared world disagree."
+            )
+        lost = [r for r, s in enumerate(stamps) if not isinstance(s, dict)]
+        if lost:
+            raise InconsistentCutError(
+                f"Snapshot barrier lost the stamp of rank(s) {lost} (object channel "
+                "dropped the payload): cannot prove a consistent cut, refusing to "
+                "write snapshots."
+            )
+        ranks_seen = sorted(int(s.get("rank", -1)) for s in stamps)
+        if ranks_seen != list(range(world_size)):
+            raise InconsistentCutError(
+                f"Snapshot barrier gathered ranks {ranks_seen}, expected "
+                f"0..{world_size - 1}: two processes share a snapshot_rank (or one "
+                "is misassigned) and would overwrite each other's files in the same "
+                "rank directory — fix the rank assignment before snapshotting."
+            )
+        configs = [s.get("config") for s in stamps]
+        if len(set(configs)) > 1:
+            counts: Dict[Any, int] = {}
+            for c in configs:
+                counts[c] = counts.get(c, 0) + 1
+            majority = max(counts, key=counts.get)
+            bad = [r for r, c in enumerate(configs) if c != majority]
+            raise InconsistentCutError(
+                f"Snapshot barrier config mismatch: rank(s) {bad} run a different "
+                f"metric configuration than the majority ({counts[majority]}/"
+                f"{len(configs)} ranks). A fold across mismatched configs would be "
+                "meaningless; fix the configuration skew before snapshotting."
+            )
+        agreed = max(int(s.get("step", 0)) for s in stamps)
+    digest = cut_digest(agreed, world_size, config)
+    _telemetry.record_event(
+        backend, "elastic_barrier", step=agreed, world_size=int(world_size),
+        rank=int(rank), digest=digest,
+    )
+    return agreed, digest
+
+
+# ----------------------------------------------------------------- cut storage
+
+
+@dataclass(frozen=True)
+class ElasticCut:
+    """One discovered (and possibly loaded) coordinated snapshot set."""
+
+    step: int
+    world_size: int
+    config: str
+    digest: str
+    members: Dict[int, str]  # rank -> snapshot path
+    missing: Tuple[int, ...] = ()
+    degraded: bool = False
+    payloads: Dict[int, Any] = field(default_factory=dict)  # rank -> state payload
+    headers: Dict[int, Dict[str, Any]] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class QuorumPolicy:
+    """When is an INCOMPLETE cut acceptable?
+
+    Default construction (both fields ``None``) admits any quorum of at
+    least one rank; set ``min_ranks`` and/or ``min_fraction`` to tighten.
+    Passing ``quorum=None`` to the restore APIs (the default there) means
+    "complete cuts only".  An admitted incomplete cut is ALWAYS surfaced:
+    the restore result carries ``degraded=True``, an ``elastic_degraded``
+    ledger event records the missing ranks, and their data is simply absent
+    from the fold — never silently approximated.
+    """
+
+    min_ranks: Optional[int] = None
+    min_fraction: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        if self.min_ranks is not None and self.min_ranks < 1:
+            raise ValueError(f"min_ranks must be >= 1, got {self.min_ranks}")
+        if self.min_fraction is not None and not (0.0 < self.min_fraction <= 1.0):
+            raise ValueError(f"min_fraction must be in (0, 1], got {self.min_fraction}")
+
+    def admits(self, present: int, world_size: int) -> bool:
+        if present < 1:
+            return False
+        if self.min_ranks is not None and present < self.min_ranks:
+            return False
+        if self.min_fraction is not None and present < self.min_fraction * world_size:
+            return False
+        return True
+
+
+def _rank_dirs(root: str) -> Dict[int, str]:
+    if not os.path.isdir(root):
+        return {}
+    out: Dict[int, str] = {}
+    for name in os.listdir(root):
+        m = _RANK_DIR_RE.match(name)
+        if m and os.path.isdir(os.path.join(root, name)):
+            out[int(m.group(1))] = os.path.join(root, name)
+    return out
+
+
+def scan_cuts(root: str) -> List[ElasticCut]:
+    """Group every elastic-stamped snapshot under ``root`` into candidate
+    cuts, newest step first.  Headers only — no payload load, no CRC; files
+    whose header is unreadable are skipped (they cannot belong to any cut)."""
+    from tpumetrics.runtime import snapshot as _snapshot
+
+    groups: Dict[Tuple[int, int, str], Dict[int, str]] = {}
+    headers: Dict[Tuple[int, int, str], Dict[int, Dict[str, Any]]] = {}
+    configs: Dict[Tuple[int, int, str], str] = {}
+    for dir_rank, directory in _rank_dirs(root).items():
+        for _step, path in _snapshot.list_snapshots(directory):
+            try:
+                header = _snapshot.read_header(path)
+            except _snapshot.SnapshotIntegrityError:
+                continue
+            el = header.get("meta", {}).get("elastic")
+            if not isinstance(el, dict):
+                continue
+            key = (int(el["step"]), int(el["world_size"]), str(el["cut_digest"]))
+            member_rank = int(el.get("rank", dir_rank))
+            groups.setdefault(key, {})[member_rank] = path
+            headers.setdefault(key, {})[member_rank] = header
+            configs[key] = str(el.get("config_digest", ""))
+    cuts = [
+        ElasticCut(
+            step=step, world_size=world, config=configs[key], digest=digest,
+            members=dict(members),
+            missing=tuple(sorted(set(range(world)) - set(members))),
+            headers=dict(headers[key]),  # header-only view; payload loads refresh it
+        )
+        for key, members in groups.items()
+        for step, world, digest in [key]
+    ]
+    return sorted(cuts, key=lambda c: (c.step, c.world_size, c.digest), reverse=True)
+
+
+def load_latest_cut(
+    root: str,
+    template: Any = None,
+    quorum: Optional[QuorumPolicy] = None,
+    backend: Any = None,
+    mode: Optional[str] = None,
+) -> Optional[ElasticCut]:
+    """Find AND load (CRC-verified) the newest restorable cut under ``root``.
+
+    A member whose payload fails integrity verification at load time counts
+    as missing — the cut is then re-judged (complete → no; quorum → maybe),
+    falling back to older cuts.  Without a quorum policy only COMPLETE cuts
+    restore; with one, the newest admitted cut restores with
+    ``degraded=True`` plus an ``elastic_degraded`` ledger event naming the
+    missing ranks.  Raises :class:`InconsistentCutError` when elastic
+    snapshots exist but none is restorable; returns ``None`` when there are
+    no elastic snapshots at all (a fresh start).
+
+    ``template`` selects the payload decoding: a pytree template for
+    functional/bucketed states (MaskedBuffer leaves need it), ``None`` for
+    skeleton-bearing eager :meth:`~tpumetrics.metric.Metric.snapshot_state`
+    payloads.  ``mode`` (``"eager"``/``"bucketed"``), when given, is checked
+    against each member's header BEFORE decoding: a cut written in the other
+    mode raises a typed :class:`ElasticRestoreError` instead of being
+    misread as corruption (a bucketed pytree has no reconstruction skeleton,
+    so template-free decoding would otherwise classify every member as a
+    torn file and silently fall back to an older cut).
+    """
+    from tpumetrics.runtime import snapshot as _snapshot
+
+    candidates = scan_cuts(root)
+    if not candidates:
+        return None
+    tried: List[str] = []
+    for cut in candidates:
+        if cut.missing and quorum is None:
+            # scan metadata already proves this cut unrestorable: don't pay
+            # a CRC read of every present member just to discard them (the
+            # common post-preemption layout — newest cut missing one rank)
+            tried.append(
+                f"step {cut.step} (world {cut.world_size}): missing rank(s) "
+                f"{list(cut.missing)}"
+            )
+            continue
+        payloads: Dict[int, Any] = {}
+        headers: Dict[int, Dict[str, Any]] = {}
+        bad: List[int] = []
+        for member_rank, path in sorted(cut.members.items()):
+            try:
+                if mode is not None:
+                    scan_header = cut.headers.get(member_rank, {})
+                    got_mode = scan_header.get("meta", {}).get("mode")
+                    if got_mode is not None and got_mode != mode:
+                        raise ElasticRestoreError(
+                            f"Cut member rank {member_rank} at step {cut.step} was "
+                            f"written in {got_mode!r} mode but this restore expects "
+                            f"{mode!r}: elastic restore does not convert between "
+                            "eager list states and bucketed buffer states."
+                        )
+                if template is not None:
+                    payload, header = _snapshot.restore(path, template)
+                else:
+                    header, leaves = _snapshot.load_snapshot(path)
+                    payload = _snapshot.reconstruct(header, leaves)
+            except _snapshot.SnapshotIntegrityError:
+                bad.append(member_rank)
+                continue
+            except _snapshot.SnapshotSpecError as err:
+                # unlike corruption, a spec mismatch means the CALLER changed
+                # (mode or metric config): falling back to an older cut would
+                # hit the same wall, so surface it loudly instead
+                raise ElasticRestoreError(
+                    f"Cut member rank {member_rank} at step {cut.step} does not match "
+                    f"the restore template: {err} HINT: the evaluator mode (eager vs "
+                    "bucketed) and metric configuration must match the world that "
+                    "wrote the cut."
+                ) from err
+            payloads[member_rank] = payload
+            headers[member_rank] = header
+        missing = tuple(sorted(set(range(cut.world_size)) - set(payloads)))
+        if not missing:
+            return ElasticCut(
+                step=cut.step, world_size=cut.world_size, config=cut.config,
+                digest=cut.digest, members=cut.members, missing=(),
+                degraded=False, payloads=payloads, headers=headers,
+            )
+        if quorum is not None and payloads and quorum.admits(len(payloads), cut.world_size):
+            _telemetry.record_event(
+                backend, "elastic_degraded", step=cut.step,
+                world_size=cut.world_size, missing=list(missing),
+                present=len(payloads), corrupt=bad,
+            )
+            return ElasticCut(
+                step=cut.step, world_size=cut.world_size, config=cut.config,
+                digest=cut.digest, members=cut.members, missing=missing,
+                degraded=True, payloads=payloads, headers=headers,
+            )
+        tried.append(
+            f"step {cut.step} (world {cut.world_size}): missing rank(s) {list(missing)}"
+            + (f" incl. {len(bad)} corrupt" if bad else "")
+        )
+    raise InconsistentCutError(
+        "No restorable consistent cut: every candidate is incomplete and no quorum "
+        "policy admits a partial set — " + "; ".join(tried)
+        + ". HINT: pass a QuorumPolicy to degrade explicitly (missing ranks' data "
+        "will be absent from the fold and the result flagged degraded), or raise "
+        "the snapshot retention so a complete older cut survives."
+    )
+
+
+class DistributedSnapshotManager:
+    """Per-rank snapshot manager over a SHARED root directory.
+
+    Each rank writes into ``<root>/rank-<NNNNN>/`` through its own
+    :class:`~tpumetrics.runtime.snapshot.SnapshotManager` (atomic renames,
+    monotonic steps, bounded retention all apply per rank); the *set* of
+    rank directories is what :func:`load_latest_cut` validates as a
+    consistent cut.  Exposes the same ``save``/``restore_latest``/
+    ``last_step``/``directory`` surface as the rank-local manager so the
+    streaming evaluator can use either interchangeably — crash recovery
+    stays rank-local, elastic restore goes through the root.
+
+    Retention note: ``keep`` prunes PER RANK.  After a rank is preempted its
+    directory stops advancing, so the surviving ranks' retention window must
+    cover the gap back to the last complete cut — size ``keep`` to the
+    preemption-detection latency, not to disk taste.
+    """
+
+    def __init__(self, root: str, rank: int, world_size: int, keep: Optional[int] = 3) -> None:
+        from tpumetrics.runtime import snapshot as _snapshot
+
+        if world_size < 1:
+            raise ValueError(f"world_size must be >= 1, got {world_size}")
+        if not (0 <= int(rank) < int(world_size)):
+            raise ValueError(f"rank must be in [0, {world_size}), got {rank}")
+        self.root = root
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self._mgr = _snapshot.SnapshotManager(
+            os.path.join(root, f"rank-{int(rank):05d}"), keep=keep
+        )
+
+    @property
+    def directory(self) -> str:
+        return self._mgr.directory
+
+    @property
+    def last_step(self) -> Optional[int]:
+        return self._mgr.last_step
+
+    def save(
+        self,
+        step: int,
+        state: Any,
+        meta: Optional[Dict[str, Any]] = None,
+        guard_non_finite: str = "off",
+    ) -> str:
+        return self._mgr.save(step, state, meta=meta, guard_non_finite=guard_non_finite)
+
+    def restore_latest(self, template: Any) -> Optional[Tuple[Any, Dict[str, Any]]]:
+        """Rank-LOCAL latest restore (crash recovery); elastic restore uses
+        :func:`load_latest_cut` on :attr:`root` instead."""
+        return self._mgr.restore_latest(template)
+
+    def elastic_meta(self, step: int, digest: str, config: str) -> Dict[str, Any]:
+        """The per-rank cut stamp to place under ``meta["elastic"]``."""
+        return {
+            "step": int(step),
+            "world_size": self.world_size,
+            "rank": self.rank,
+            "cut_digest": str(digest),
+            "config_digest": str(config),
+        }

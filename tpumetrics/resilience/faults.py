@@ -28,6 +28,11 @@ Fault kinds (:class:`Fault`):
   payload (the gathered list carries ``None`` in its place): a dropped
   message.  The lockstep digest exchange then sees a divergent digest and
   raises instead of deadlocking.
+- ``"preempt"`` — the rank is reclaimed: :class:`InjectedPreemption` is
+  raised *instead of* the collective, and the backend **latches dead** —
+  every later collective raises too.  This is how elastic tests produce
+  partial coordinated-snapshot sets (the preempted rank never writes its
+  next snapshot) on one CPU host.
 
 The wrapper is eager by construction (``in_trace = False``) and advertises
 ``fault_injected = True``, which makes :meth:`SyncPolicy.applies` engage the
@@ -47,9 +52,9 @@ import jax.numpy as jnp
 from tpumetrics.parallel.backend import DistributedBackend
 from tpumetrics.telemetry import ledger as _telemetry
 
-__all__ = ["Fault", "FaultInjectionBackend", "InjectedFaultError"]
+__all__ = ["Fault", "FaultInjectionBackend", "InjectedFaultError", "InjectedPreemption"]
 
-_KINDS = ("stall", "error", "corrupt", "drop_object")
+_KINDS = ("stall", "error", "corrupt", "drop_object", "preempt")
 _OPS = ("any", "all_gather", "all_reduce", "all_gather_object")
 
 
@@ -58,12 +63,23 @@ class InjectedFaultError(RuntimeError):
     NOT a TPUMetricsUserError, so the policy's retry loop engages)."""
 
 
+class InjectedPreemption(InjectedFaultError):
+    """A ``kind="preempt"`` fault fired: this rank has been reclaimed.
+
+    Unlike a transient ``"error"`` fault, preemption LATCHES — every
+    subsequent collective on the backend raises too (a reclaimed slice never
+    comes back mid-run), so any retry loop fails deterministically and a
+    coordinated snapshot this rank was part of stays incomplete: exactly the
+    partial-cut scenario ``tpumetrics.resilience.elastic`` must handle."""
+
+
 @dataclass(frozen=True)
 class Fault:
     """One entry of a fault schedule.
 
     Args:
-        kind: ``"stall"`` | ``"error"`` | ``"corrupt"`` | ``"drop_object"``.
+        kind: ``"stall"`` | ``"error"`` | ``"corrupt"`` | ``"drop_object"``
+            | ``"preempt"``.
         op: which collective to target — ``"all_gather"``, ``"all_reduce"``,
             ``"all_gather_object"``, or ``"any"``.
         call: fire on the Nth *matching* call (0-based, counted per op name;
@@ -132,6 +148,7 @@ class FaultInjectionBackend(DistributedBackend):
         self._available = available
         self.calls: dict = {}
         self.fired: List[Tuple[str, int, str]] = []
+        self.preempted = False  # latched by a "preempt" fault: the rank is gone
 
     @property
     def has_object_channel(self) -> bool:  # type: ignore[override]
@@ -145,7 +162,11 @@ class FaultInjectionBackend(DistributedBackend):
     def world_size(self) -> int:
         return self.inner.world_size()
 
+    def rank(self) -> int:
+        return self.inner.rank()
+
     def barrier(self) -> None:
+        self._check_alive("barrier")
         self.inner.barrier()
 
     # ------------------------------------------------------------- injection
@@ -163,9 +184,16 @@ class FaultInjectionBackend(DistributedBackend):
         _telemetry.record_event(self, "fault_injected", fault=fault.kind, op=op, index=index)
 
     def _pre(self, fault: Optional[Fault], op: str, index: int) -> None:
-        """Apply stall/error effects (shared by all three collectives)."""
+        """Apply stall/error/preempt effects (shared by all three collectives)."""
         if fault is None:
             return
+        if fault.kind == "preempt":
+            self._fire(fault, op, index)
+            self.preempted = True
+            raise InjectedPreemption(
+                f"rank preempted (injected) at {op} call {index}: the slice was "
+                "reclaimed; no further collectives will succeed on this backend"
+            )
         if fault.kind == "stall":
             self._fire(fault, op, index)
             time.sleep(fault.delay)
@@ -189,9 +217,17 @@ class FaultInjectionBackend(DistributedBackend):
         flat = arr.ravel().at[0].set(bad)
         return flat.reshape(arr.shape) if jnp.shape(x) else flat[0]
 
+    def _check_alive(self, op: str) -> None:
+        if self.preempted:
+            raise InjectedPreemption(
+                f"rank is preempted (injected, latched): {op} refused — the slice "
+                "never comes back mid-run"
+            )
+
     # ----------------------------------------------------------- collectives
 
     def all_gather(self, x: Any, group: Optional[Any] = None) -> List[Any]:
+        self._check_alive("all_gather")
         fault, index = self._next_fault("all_gather")
         self._pre(fault, "all_gather", index)
         if fault is not None and fault.kind == "corrupt":
@@ -199,6 +235,7 @@ class FaultInjectionBackend(DistributedBackend):
         return self.inner.all_gather(x, group=group)
 
     def all_reduce(self, x: Any, op: str, group: Optional[Any] = None) -> Any:
+        self._check_alive("all_reduce")
         fault, index = self._next_fault("all_reduce")
         self._pre(fault, "all_reduce", index)
         if fault is not None and fault.kind == "corrupt":
@@ -206,6 +243,7 @@ class FaultInjectionBackend(DistributedBackend):
         return self.inner.all_reduce(x, op, group=group)
 
     def all_gather_object(self, obj: Any, group: Optional[Any] = None) -> List[Any]:
+        self._check_alive("all_gather_object")
         fault, index = self._next_fault("all_gather_object")
         self._pre(fault, "all_gather_object", index)
         gathered = self.inner.all_gather_object(obj, group=group)
@@ -213,9 +251,7 @@ class FaultInjectionBackend(DistributedBackend):
             self._fire(fault, "all_gather_object", index)
             # this rank's payload was lost in flight: peers see a hole
             try:
-                import jax
-
-                rank = int(jax.process_index())
+                rank = int(self.inner.rank())
             except Exception:
                 rank = 0
             gathered = list(gathered)

@@ -18,6 +18,11 @@ replay positions).  See ``docs/runtime.md`` for the guide.
 - :mod:`~tpumetrics.runtime.evaluator` — :class:`StreamingEvaluator`, the
   facade tying the three together with ``compute_every(n)``
   bounded-staleness results and clean queue-flushing shutdown.
+
+Multi-host: with ``snapshot_rank``/``snapshot_world_size`` set, snapshots
+become COORDINATED cuts (barrier-stamped, per-rank directories) and
+:meth:`StreamingEvaluator.restore_elastic` restores them onto a different
+world size after preemption — see :mod:`tpumetrics.resilience.elastic`.
 """
 
 from tpumetrics.runtime.bucketing import (

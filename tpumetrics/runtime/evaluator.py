@@ -107,6 +107,17 @@ class StreamingEvaluator:
         guard_non_finite: ``"off"``/``"warn"``/``"error"`` NaN/Inf screen on
             the state at every snapshot save (a poisoned state written to
             disk would survive restore and re-poison the stream).
+        snapshot_rank / snapshot_world_size: enable COORDINATED multi-host
+            snapshots (:mod:`tpumetrics.resilience.elastic`): this rank
+            writes into ``snapshot_dir/rank-<NNNNN>/`` and every
+            :meth:`snapshot` runs the cut barrier first, stamping the file
+            with the agreed step + cut digest.  :meth:`restore_elastic`
+            then folds a consistent cut from ALL rank directories and
+            re-shards it for this (possibly different-size) world.
+        barrier_backend: backend carrying the barrier's host-object
+            exchange; defaults to the ambient
+            :func:`~tpumetrics.parallel.backend.get_default_backend` when
+            ``snapshot_world_size > 1``.
     """
 
     def __init__(
@@ -125,6 +136,9 @@ class StreamingEvaluator:
         crash_policy: str = "raise",
         max_restores: int = 3,
         guard_non_finite: str = "off",
+        snapshot_rank: Optional[int] = None,
+        snapshot_world_size: Optional[int] = None,
+        barrier_backend: Optional[Any] = None,
     ) -> None:
         from tpumetrics.collections import MetricCollection
 
@@ -179,9 +193,45 @@ class StreamingEvaluator:
         self._restores = 0
         self._degraded = False
 
-        self._snapshots = (
-            _snapshot.SnapshotManager(snapshot_dir, keep=keep_snapshots) if snapshot_dir else None
-        )
+        if (snapshot_rank is None) != (snapshot_world_size is None):
+            raise ValueError("snapshot_rank and snapshot_world_size must be set together")
+        if snapshot_every is not None and snapshot_world_size is not None and snapshot_world_size > 1:
+            # the auto cadence triggers on the LOCAL batch count; ranks
+            # draining uneven stream shards would reach the trigger a
+            # different number of times and the unmatched cut barrier would
+            # hang (inert policy) or crash-loop (armed).  Coordinated cuts
+            # need an agreed trigger: call snapshot() at application-level
+            # coordinated points instead.
+            raise ValueError(
+                "snapshot_every cannot drive coordinated (multi-rank elastic) "
+                "snapshots: the per-rank batch cadence is not provably lockstep "
+                "across ranks. Call snapshot() at coordinated stream points."
+            )
+        self._elastic = snapshot_rank is not None
+        self._rank = int(snapshot_rank) if self._elastic else 0
+        self._world = int(snapshot_world_size) if self._elastic else 1
+        self._barrier_backend = barrier_backend
+        self._elastic_config: Optional[str] = None
+        self._elastic_base_batches = 0  # global stream position adopted by the
+        self._elastic_base_items = 0  # last elastic restore (0 = fresh world)
+        if self._elastic:
+            if snapshot_dir is None:
+                raise ValueError("snapshot_rank/snapshot_world_size require snapshot_dir")
+            from tpumetrics.resilience.elastic import (
+                DistributedSnapshotManager,
+                config_digest,
+            )
+
+            self._elastic_config = config_digest(metric)
+            self._snapshots: Optional[Any] = DistributedSnapshotManager(
+                snapshot_dir, self._rank, self._world, keep=keep_snapshots
+            )
+        else:
+            self._snapshots = (
+                _snapshot.SnapshotManager(snapshot_dir, keep=keep_snapshots)
+                if snapshot_dir
+                else None
+            )
 
         name = type(metric).__name__
         self._dispatcher = AsyncDispatcher(
@@ -278,14 +328,55 @@ class StreamingEvaluator:
         with self._lock:
             return self._save_snapshot_locked()
 
+    def _barrier_proposal(self) -> int:
+        """The logical step this rank proposes to the cut barrier: its
+        stream position, floored to its own next free on-disk step.  After
+        an elastic resize onto a reused snapshot root, a rank directory can
+        hold steps from the OLD world that exceed the adopted global
+        position (e.g. a quorum-degraded restore that lost a long rank);
+        since the barrier agrees on the MAX proposal, flooring here keeps
+        every rank's saves monotonic without any cross-rank special case."""
+        last = self._snapshots.last_step
+        return max(self._batches, (last + 1) if last is not None else 0)
+
     def _save_snapshot_locked(self) -> str:
-        if self._snapshots.last_step == self._batches:
+        file_step = self._batches
+        elastic_meta = None
+        if self._elastic:
+            # coordinated cut: agree on the logical step with every rank
+            # (lockstep-style object exchange under the SyncPolicy deadline)
+            # BEFORE writing, and stamp the snapshot as a cut member.  The
+            # cut digest is deterministic in (step, world, config), so a
+            # barrier re-run at the same position re-stamps identically.
+            from tpumetrics.resilience.elastic import snapshot_barrier
+
+            backend = self._barrier_backend
+            if backend is None and self._world > 1:
+                from tpumetrics.parallel.backend import get_default_backend
+
+                backend = get_default_backend()
+            file_step, digest = snapshot_barrier(
+                backend,
+                rank=self._rank,
+                world_size=self._world,
+                step=self._barrier_proposal(),
+                config=self._elastic_config,
+            )
+            elastic_meta = self._snapshots.elastic_meta(
+                file_step, digest, self._elastic_config
+            )
+        # the same-step reuse shortcut is NON-elastic only: an elastic save
+        # must write its member of THIS cut (a step-equal file from a
+        # previous world carries a different cut digest and would leave the
+        # new cut permanently missing this rank); barrier proposals are
+        # floored past last_step, so elastic saves never collide anyway
+        if not self._elastic and self._snapshots.last_step == file_step:
             # a manual snapshot right after an auto-snapshot (or vice versa)
             # at the same stream position: the state is identical by the
             # determinism contract — reuse the file instead of failing the
             # monotonic-step check
             for step, path in _snapshot.list_snapshots(self._snapshots.directory):
-                if step == self._batches:
+                if step == file_step:
                     return path
         meta = {
             "batches": self._batches,
@@ -293,13 +384,21 @@ class StreamingEvaluator:
             "metric": type(self._metric).__name__,
             "mode": "bucketed" if self._bucketer is not None else "eager",
             "degraded": self._degraded,  # survives preemption (restore re-flags)
+            # global positions already covered before this world's ranks
+            # started counting (set by restore_elastic; 0 on a fresh world) —
+            # the next fold needs them to total positions without
+            # re-counting the pre-resize prefix once per rank
+            "base_batches": self._elastic_base_batches,
+            "base_items": self._elastic_base_items,
         }
+        if elastic_meta is not None:
+            meta["elastic"] = elastic_meta
         if self._bucketer is not None:
             payload: Any = self._state
         else:
             payload = self._metric.snapshot_state()
         path = self._snapshots.save(
-            self._batches, payload, meta=meta, guard_non_finite=self._guard_non_finite
+            file_step, payload, meta=meta, guard_non_finite=self._guard_non_finite
         )
         # the journal is "since the last snapshot": this save is the new base
         self._journal = []
@@ -325,6 +424,122 @@ class StreamingEvaluator:
                 return None
             return self._adopt_snapshot_locked(got)
 
+    def restore_elastic(
+        self, quorum: Optional[Any] = None, cat_placement: str = "rank0"
+    ) -> Optional[Dict[str, Any]]:
+        """Adopt the newest consistent multi-host snapshot cut, folded into
+        one canonical global state and re-sharded for THIS evaluator's
+        ``(snapshot_rank, snapshot_world_size)`` — which may differ from the
+        world that wrote the cut (shrink and grow both work).
+
+        Requires the elastic constructor arguments and must run before any
+        ``submit`` (like :meth:`restore_latest`).  Returns ``None`` when the
+        shared root holds no elastic snapshots; otherwise a dict with the
+        adopted global position (``batches``/``items`` — the stream prefix
+        the folded state covers; replay the rest under the NEW sharding),
+        the cut ``step``, ``from_world``, and ``degraded``.
+
+        ``quorum`` (a :class:`~tpumetrics.resilience.elastic.QuorumPolicy`)
+        admits INCOMPLETE cuts: the missing ranks' data is absent from the
+        fold, ``degraded`` is flagged here and in :meth:`stats`, and an
+        ``elastic_degraded`` ledger event names the missing ranks — an
+        explicit trade of completeness for freshness, never a silent one.
+        Without it, only complete cuts restore (older complete cuts win over
+        a newer partial one); if nothing restorable exists a typed
+        :class:`~tpumetrics.resilience.elastic.InconsistentCutError` raises.
+
+        ``cat_placement`` (``"rank0"``/``"balanced"``) controls where
+        restored cat/list/buffer rows land — see
+        :func:`tpumetrics.parallel.merge.reshard_metric_states`.
+        """
+        if self._snapshots is None or not self._elastic:
+            raise TPUMetricsUserError(
+                "restore_elastic() needs snapshot_dir plus snapshot_rank/"
+                "snapshot_world_size (the elastic constructor arguments)."
+            )
+        from tpumetrics.resilience.elastic import (
+            ElasticRestoreError,
+            InconsistentCutError,
+            load_latest_cut,
+        )
+
+        with self._lock:
+            if self._batches or self._dispatcher.stats()["enqueued"]:
+                raise TPUMetricsUserError(
+                    "restore_elastic() after ingestion started would double-count; "
+                    "restore on a fresh evaluator, then replay the stream (re-sharded "
+                    "for the new world) from the returned position."
+                )
+            template = self._metric.init_state() if self._bucketer is not None else None
+            cut = load_latest_cut(
+                self._snapshots.root, template=template, quorum=quorum,
+                backend=self._barrier_backend,
+                mode="bucketed" if self._bucketer is not None else "eager",
+            )
+            if cut is None:
+                return None
+            if cut.config and self._elastic_config and cut.config != self._elastic_config:
+                raise ElasticRestoreError(
+                    f"The cut at step {cut.step} was written under config digest "
+                    f"{cut.config[:12]}… but this evaluator's metric digests to "
+                    f"{self._elastic_config[:12]}…: the metric configuration changed "
+                    "across the resize, so the fold would be meaningless."
+                )
+            ranks = sorted(cut.payloads)
+            # validate EVERYTHING that can reject the cut before any state is
+            # touched: a typed failure below must leave the evaluator fresh,
+            # not half-restored (the load_snapshot_state atomicity contract)
+            metas = [cut.headers[r]["meta"] for r in ranks]
+            bases_b = {int(m.get("base_batches", 0)) for m in metas}
+            bases_i = {int(m.get("base_items", 0)) for m in metas}
+            if len(bases_b) > 1 or len(bases_i) > 1:
+                raise InconsistentCutError(
+                    f"The cut at step {cut.step} mixes ranks restored from different "
+                    f"elastic bases (batches {sorted(bases_b)}, items {sorted(bases_i)}): "
+                    "the global position cannot be totaled."
+                )
+            base_b, base_i = bases_b.pop(), bases_i.pop()
+            if self._bucketer is not None:
+                folded = self._metric.fold_state_dicts([cut.payloads[r] for r in ranks])
+                self._state = self._metric.reshard_state_dict(
+                    folded, self._rank, self._world, cat_placement=cat_placement
+                )
+            else:
+                folded = self._metric.fold_snapshot_states(
+                    [_as_snapshot_payload(cut.payloads[r]) for r in ranks]
+                )
+                mine = self._metric.reshard_snapshot_state(
+                    folded, self._rank, self._world, cat_placement=cat_placement
+                )
+                self._metric.load_snapshot_state(mine)
+            total_batches = base_b + sum(int(m["batches"]) - base_b for m in metas)
+            total_items = base_i + sum(int(m["items"]) - base_i for m in metas)
+            degraded = bool(cut.degraded or any(m.get("degraded", False) for m in metas))
+            self._batches = total_batches
+            self._items = total_items
+            self._last_compute_at = total_batches
+            self._journal = []
+            self._journal_base = total_batches
+            self._degraded = degraded
+            self._elastic_base_batches = total_batches
+            self._elastic_base_items = total_items
+            _telemetry.record_event(
+                self._barrier_backend, "elastic_restore", step=cut.step,
+                from_world=cut.world_size, world_size=self._world, rank=self._rank,
+                batches=total_batches, degraded=degraded,
+                missing=list(cut.missing),
+            )
+            return {
+                "step": cut.step,
+                "batches": total_batches,
+                "items": total_items,
+                "from_world": cut.world_size,
+                "world_size": self._world,
+                "rank": self._rank,
+                "degraded": degraded,
+                "missing_ranks": list(cut.missing),
+            }
+
     def _load_latest_snapshot(self) -> Optional[Tuple[Any, Dict[str, Any]]]:
         """(payload, header) of the newest valid snapshot, or ``None``."""
         if self._snapshots is None:
@@ -345,6 +560,8 @@ class StreamingEvaluator:
             else:
                 self._metric.reset()
             restored, items, degraded = 0, 0, False
+            self._elastic_base_batches = 0
+            self._elastic_base_items = 0
         else:
             payload, header = got
             if self._bucketer is not None:
@@ -354,6 +571,8 @@ class StreamingEvaluator:
             restored = int(header["meta"]["batches"])
             items = int(header["meta"]["items"])
             degraded = bool(header["meta"].get("degraded", False))
+            self._elastic_base_batches = int(header["meta"].get("base_batches", 0))
+            self._elastic_base_items = int(header["meta"].get("base_items", 0))
         self._batches = restored
         self._items = items
         self._last_compute_at = restored

@@ -139,11 +139,29 @@ def save_snapshot(
             fh.flush()
             os.fsync(fh.fileno())
         os.replace(tmp, final)
+        # fsync the parent DIRECTORY too: the file's bytes are durable, but
+        # the rename itself lives in the directory inode — without this a
+        # host power-loss can leave a directory entry pointing at nothing
+        # (a vanished "latest" snapshot the CRC never gets to see)
+        _fsync_dir(directory)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
     return final
+
+
+def _fsync_dir(directory: str) -> None:
+    try:
+        dirfd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return  # platforms without directory fds (e.g. Windows): best effort
+    try:
+        os.fsync(dirfd)
+    except OSError:
+        pass
+    finally:
+        os.close(dirfd)
 
 
 def list_snapshots(directory: str) -> List[Tuple[int, str]]:
@@ -158,19 +176,35 @@ def list_snapshots(directory: str) -> List[Tuple[int, str]]:
     return sorted(out)
 
 
+def _header_of(z: Any, path: str) -> Dict[str, Any]:
+    if "__header__" not in z.files:
+        raise SnapshotIntegrityError(f"{path}: not a tpumetrics snapshot (no header)")
+    header = json.loads(bytes(z["__header__"].tobytes()).decode())
+    if header.get("format") != FORMAT:
+        raise SnapshotIntegrityError(f"{path}: unknown format {header.get('format')!r}")
+    if header.get("version") != VERSION:
+        raise SnapshotIntegrityError(
+            f"{path}: snapshot version {header.get('version')} != supported {VERSION}"
+        )
+    return header
+
+
+def read_header(path: str) -> Dict[str, Any]:
+    """Header (step/spec/meta) WITHOUT loading or checksumming the leaves —
+    the cheap scan primitive the elastic cut discovery uses to group rank
+    snapshots before committing to a full CRC-verified load."""
+    try:
+        with np.load(path) as z:
+            return _header_of(z, path)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError, zipfile.BadZipFile) as err:
+        raise SnapshotIntegrityError(f"{path}: unreadable snapshot ({err})") from err
+
+
 def load_snapshot(path: str) -> Tuple[Dict[str, Any], List[np.ndarray]]:
     """Read + integrity-check one snapshot file -> (header, leaves)."""
     try:
         with np.load(path) as z:
-            if "__header__" not in z.files:
-                raise SnapshotIntegrityError(f"{path}: not a tpumetrics snapshot (no header)")
-            header = json.loads(bytes(z["__header__"].tobytes()).decode())
-            if header.get("format") != FORMAT:
-                raise SnapshotIntegrityError(f"{path}: unknown format {header.get('format')!r}")
-            if header.get("version") != VERSION:
-                raise SnapshotIntegrityError(
-                    f"{path}: snapshot version {header.get('version')} != supported {VERSION}"
-                )
+            header = _header_of(z, path)
             leaves = [z[f"leaf_{i}"] for i in range(len(header["spec"]))]
     except (OSError, ValueError, KeyError, json.JSONDecodeError, zipfile.BadZipFile) as err:
         raise SnapshotIntegrityError(f"{path}: unreadable snapshot ({err})") from err

@@ -168,6 +168,16 @@ class CollectiveLedger:
         elif rec.kind == "runtime_restore":
             # crash policy restored from a snapshot and replayed the journal
             self.runtime_restores += 1
+        elif rec.kind == "elastic_barrier":
+            # one coordinated snapshot barrier (step agreement + cut stamp)
+            self.elastic_barriers += 1
+        elif rec.kind == "elastic_restore":
+            # one rank adopted a folded + resharded consistent cut
+            self.elastic_restores += 1
+        elif rec.kind == "elastic_degraded":
+            # a quorum policy admitted an INCOMPLETE cut (missing ranks' data
+            # is absent from the fold) — never silent
+            self.elastic_degraded_cuts += 1
         self.counts_by_kind[rec.kind] = self.counts_by_kind.get(rec.kind, 0) + 1
         for sink in self._sinks:
             sink.emit(rec)
@@ -192,6 +202,9 @@ class CollectiveLedger:
         self.non_finite_states = 0
         self.runtime_crashes = 0
         self.runtime_restores = 0
+        self.elastic_barriers = 0
+        self.elastic_restores = 0
+        self.elastic_degraded_cuts = 0
         self.bytes_by_op: Dict[str, float] = {}
         self.counts_by_kind: Dict[str, int] = {}
 
@@ -228,6 +241,9 @@ class CollectiveLedger:
             "non_finite_states": self.non_finite_states,
             "runtime_crashes": self.runtime_crashes,
             "runtime_restores": self.runtime_restores,
+            "elastic_barriers": self.elastic_barriers,
+            "elastic_restores": self.elastic_restores,
+            "elastic_degraded_cuts": self.elastic_degraded_cuts,
             "records": len(self.records),
         }
 
