@@ -1142,6 +1142,49 @@ def _bench_elastic_restore():
     return ours, ref, {"extras": extras}
 
 
+def _bench_analysis_runtime():
+    """Wall time of the tpulint self-run over the whole package
+    (tpumetrics.analysis) — the pass tier-1 gates on.
+
+    No reference side (there is nothing to compare against), two ceilings
+    (``analysis_runtime_ceilings``):
+
+    - ``analysis_wall_ms`` — the full two-pass analysis (index + rules over
+      every package file) must stay cheap enough to run on every CI commit
+      and inside tier-1; the ceiling catches algorithmic blowups (an
+      accidentally quadratic reachability or taint pass), not box noise.
+    - ``findings_unsuppressed`` — ceiling 0: the bench run re-asserts the
+      self-run is clean, so a bench-gated pipeline cannot go green with a
+      dirty package even if the pytest gate was skipped.
+    """
+    from tpumetrics.analysis import analyze_paths
+
+    pkg = os.path.join(_REPO, "tpumetrics")
+    times, findings = [], []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        findings = analyze_paths([pkg])
+        times.append((time.perf_counter() - t0) * 1e6)
+    ours = min(times)
+    unsuppressed = [f for f in findings if not f.suppressed]
+    assert not unsuppressed, (
+        f"tpulint self-run must be clean, got {len(unsuppressed)}: "
+        + "; ".join(f"{f.path}:{f.line}:{f.code}" for f in unsuppressed[:5])
+    )
+    n_files = sum(
+        len([f for f in files if f.endswith(".py")])
+        for root, dirs, files in os.walk(pkg)
+        if "__pycache__" not in root
+    )
+    extras = {
+        "analysis_wall_ms": round(ours / 1000.0, 1),
+        "files_analyzed": n_files,
+        "findings_unsuppressed": len(unsuppressed),
+        "findings_suppressed": len(findings) - len(unsuppressed),
+    }
+    return ours, None, {"extras": extras}
+
+
 def _enable_compilation_cache() -> None:
     """Persistent XLA compile cache: one-time eager/jit compiles (expensive on
     remote-attached accelerators) amortize across bench runs, as they do in
@@ -1205,6 +1248,10 @@ def _check_floors(headline_vs, details):
     # (a restore that takes minutes would eat the preemption grace window)
     for key, ceiling in gate.get("elastic_restore_ceilings", {}).items():
         check_ceiling("elastic_restore", key, ceiling, fail_on_error=True)
+    # analysis ceilings: the static lint pass must stay cheap enough to gate
+    # every commit on, and its self-run must stay clean (findings ceiling 0)
+    for key, ceiling in gate.get("analysis_runtime_ceilings", {}).items():
+        check_ceiling("analysis_runtime", key, ceiling, fail_on_error=True)
     return violations
 
 
@@ -1230,6 +1277,7 @@ def main() -> None:
         ("streaming_throughput", _bench_streaming_throughput),
         ("resilience_overhead", _bench_resilience_overhead),
         ("elastic_restore", _bench_elastic_restore),
+        ("analysis_runtime", _bench_analysis_runtime),
     ):
         try:
             ours, ref, accounting = fn()

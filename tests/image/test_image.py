@@ -300,6 +300,32 @@ def test_psnr_dim_and_tuple_range():
         PeakSignalNoiseRatio(dim=1)
 
 
+def test_psnr_tracked_range_uses_observed_extrema():
+    """data_range=None tracks the OBSERVED target extrema: for all-positive
+    targets the range is max-min, not max-0.  This deliberately diverges
+    from the reference (whose zero defaults anchor the range at 0 and, in
+    DDP, let a rank that never updated drag the folded min to 0 — the
+    tpulint TPL301 reduce-identity bug); the ±inf defaults make single-host
+    and any-world-size folds agree on the same observed range."""
+    rng = np.random.default_rng(7)
+    t = jnp.asarray(rng.uniform(10.0, 12.0, (4, 8, 8)), jnp.float32)
+    p = t + jnp.asarray(rng.normal(0, 0.1, (4, 8, 8)), jnp.float32)
+    m = PeakSignalNoiseRatio(data_range=None)
+    m.update(p, t)
+    observed_range = float(jnp.max(t) - jnp.min(t))
+    mse = float(jnp.mean((p - t) ** 2))
+    assert np.isclose(float(m.compute()), 10 * np.log10(observed_range**2 / mse), atol=1e-4)
+
+    # the DDP fold: an idle rank's default state is the reduce identity and
+    # must not perturb the observed extrema of the ranks that saw data
+    from tpumetrics.parallel.merge import merge_metric_states
+
+    idle = PeakSignalNoiseRatio(data_range=None)
+    merged = merge_metric_states([m.metric_state(), idle.metric_state()], m._reductions)
+    assert float(merged["min_target"]) == float(jnp.min(t))
+    assert float(merged["max_target"]) == float(jnp.max(t))
+
+
 def test_ssim_variants():
     p, t = PREDS[0], TARGET[0]
     sim, cs = structural_similarity_index_measure(p, t, data_range=1.0, return_contrast_sensitivity=True)

@@ -1,0 +1,159 @@
+"""Tier-1 gate: the tpulint self-run over ``tpumetrics/`` stays clean.
+
+The gate compares the analyzer's unsuppressed findings against the committed
+zero-findings baseline (tests/analysis_baseline.json): any new violation —
+a host sync sneaking into an update path, a one-branch collective, a shadow
+state, a bad ``add_state`` default — fails tier-1 with the rule code in the
+assertion message.  The seeded-hazard tests prove the gate actually bites:
+each hazard class injected into a fixture metric trips exactly its code
+through the SAME gate helper the package run uses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from tpumetrics.analysis import analyze_paths
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PACKAGE = os.path.join(_REPO, "tpumetrics")
+_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "analysis_baseline.json")
+
+
+def _gate_violations(paths):
+    """Unsuppressed findings as 'relpath:line:code — message' strings (the
+    exact check the package gate and the seeded-hazard tests share)."""
+    out = []
+    for f in analyze_paths(paths):
+        if f.suppressed:
+            continue
+        rel = os.path.relpath(f.path, _REPO) if f.path.startswith(_REPO) else f.path
+        out.append(f"{rel}:{f.line}:{f.code} — {f.message}")
+    return out
+
+
+def _baseline_allowed():
+    with open(_BASELINE) as fh:
+        payload = json.load(fh)
+    assert payload["version"] == 1
+    return payload["allowed_unsuppressed"]
+
+
+def test_package_self_run_matches_zero_findings_baseline():
+    allowed = _baseline_allowed()
+    assert allowed == [], "the baseline must stay empty: fix or inline-suppress instead"
+    violations = _gate_violations([_PACKAGE])
+    assert violations == allowed, (
+        "tpulint found new violations in tpumetrics/ — fix them or add an inline "
+        "`# tpulint: disable=CODE -- why` suppression:\n" + "\n".join(violations)
+    )
+
+
+_SEEDS = {
+    "TPL101": """
+        def update(self, preds, target):
+            self.total = self.total + float(jnp.sum(preds))
+    """,
+    "TPL102": """
+        def update(self, preds, target):
+            if jnp.any(preds > 0):
+                self.total = self.total + 1.0
+    """,
+    "TPL401": """
+        def update(self, preds, target):
+            self.hidden = jnp.sum(preds)
+            self.total = self.total + self.hidden
+    """,
+}
+
+
+@pytest.mark.parametrize("code", sorted(_SEEDS))
+def test_seeded_hazard_trips_gate_with_its_code(tmp_path, code):
+    src = textwrap.dedent(
+        """
+        import jax
+        import jax.numpy as jnp
+        from tpumetrics.metric import Metric
+
+        class Seeded(Metric):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        {update}
+            def compute(self):
+                return self.total
+        """
+    ).format(update=textwrap.indent(textwrap.dedent(_SEEDS[code]), "    "))
+    (tmp_path / "seeded.py").write_text(src)
+    violations = _gate_violations([str(tmp_path)])
+    assert violations, f"seeded {code} hazard must fail the gate"
+    assert all(f":{code} " in v or f":{code} —" in v for v in violations), violations
+
+
+def test_seeded_one_branch_collective_trips_gate(tmp_path):
+    (tmp_path / "seeded.py").write_text(
+        textwrap.dedent(
+            """
+            def flush(backend, values, rank):
+                if rank == 0:
+                    return backend.all_reduce(values)
+                return values
+            """
+        )
+    )
+    violations = _gate_violations([str(tmp_path)])
+    assert len(violations) == 1 and ":TPL201" in violations[0]
+
+
+def test_seeded_bad_state_default_trips_gate(tmp_path):
+    (tmp_path / "seeded.py").write_text(
+        textwrap.dedent(
+            """
+            import jax.numpy as jnp
+            from tpumetrics.metric import Metric
+
+            class Seeded(Metric):
+                def __init__(self, **kw):
+                    super().__init__(**kw)
+                    self.add_state("low", jnp.zeros(()), dist_reduce_fx="min")
+
+                def update(self, x):
+                    self.low = jnp.minimum(self.low, jnp.min(x))
+
+                def compute(self):
+                    return self.low
+            """
+        )
+    )
+    violations = _gate_violations([str(tmp_path)])
+    assert len(violations) == 1 and ":TPL301" in violations[0]
+
+
+def test_unjustified_suppression_trips_gate(tmp_path):
+    """Suppressing without a `-- why` is itself a gate failure (TPL901):
+    the self-run's clean state certifies every exception was justified."""
+    (tmp_path / "seeded.py").write_text(
+        textwrap.dedent(
+            """
+            import jax.numpy as jnp
+            from tpumetrics.metric import Metric
+
+            class Seeded(Metric):
+                def __init__(self, **kw):
+                    super().__init__(**kw)
+                    self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+                def update(self, preds):
+                    self.total = self.total + float(jnp.sum(preds))  # tpulint: disable=TPL101
+
+                def compute(self):
+                    return self.total
+            """
+        )
+    )
+    violations = _gate_violations([str(tmp_path)])
+    assert len(violations) == 1 and ":TPL901" in violations[0]

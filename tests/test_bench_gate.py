@@ -35,6 +35,10 @@ def test_floor_file_shape():
     assert data["elastic_restore_ceilings"]["restore_8to4_ms"] > 0
     # the tier-1 dots guard floor exists and is a sane full-suite count
     assert data["tier1_collection_floor"] > 1000
+    # the analysis gate bounds the tpulint self-run wall time AND pins the
+    # unsuppressed-findings count to exactly zero (never raise that one)
+    assert data["analysis_runtime_ceilings"]["analysis_wall_ms"] > 0
+    assert data["analysis_runtime_ceilings"]["findings_unsuppressed"] == 0
 
 
 def test_check_floors_flags_compile_regressions():
@@ -68,6 +72,23 @@ def test_check_floors_flags_resilience_overhead_regressions():
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and all("resilience_overhead" in v for v in violations)
     details["resilience_overhead"] = "error: RuntimeError: boom"
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and "scenario failed" in violations[0]
+
+
+def test_check_floors_flags_analysis_regressions():
+    """A tpulint self-run that slowed past its ceiling (algorithmic blowup)
+    or surfaced ANY unsuppressed finding must trip the bench gate; an
+    errored scenario (the self-run assert raising) trips it too."""
+    details = {"analysis_runtime": {"analysis_wall_ms": 10**6, "findings_unsuppressed": 0}}
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("analysis_wall_ms" in v for v in violations)
+    details["analysis_runtime"] = {"analysis_wall_ms": 2500.0, "findings_unsuppressed": 0}
+    assert bench._check_floors(headline_vs=1000.0, details=details) == []
+    details["analysis_runtime"]["findings_unsuppressed"] = 1
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("findings_unsuppressed" in v for v in violations)
+    details["analysis_runtime"] = "error: AssertionError: self-run dirty"
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and "scenario failed" in violations[0]
 

@@ -1,0 +1,36 @@
+"""tpumetrics.analysis — static trace-safety & sync-schedule linter ("tpulint").
+
+The package's correctness guarantees — "no host sync until ``.compute()``",
+collectives in lockstep across ranks, every accumulator declared via
+``add_state`` — are otherwise enforced only at runtime (the telemetry
+lockstep verifier catches a divergent sync schedule when ranks actually
+diverge on the wire; elastic fold/reshard silently loses undeclared state).
+This subsystem rejects those bug classes *statically*: a pure-AST pass over
+the source, on one CPU host, in milliseconds, with no jax import required
+at analysis time.
+
+Usage::
+
+    python -m tpumetrics.analysis tpumetrics/            # text report, exit 1 on findings
+    python -m tpumetrics.analysis --format json paths…   # machine-readable
+
+Inline suppression (same line, or a standalone comment on the line above)::
+
+    x = float(arr)  # tpulint: disable=TPL101 -- eager-only debug path
+
+Rule catalog: see :mod:`tpumetrics.analysis.rules` and ``docs/analysis.md``.
+"""
+
+from tpumetrics.analysis.core import Finding, PackageIndex, analyze_paths, analyze_source
+from tpumetrics.analysis.report import render_json, render_text
+from tpumetrics.analysis.rules import RULES
+
+__all__ = [
+    "Finding",
+    "PackageIndex",
+    "RULES",
+    "analyze_paths",
+    "analyze_source",
+    "render_json",
+    "render_text",
+]

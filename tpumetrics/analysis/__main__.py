@@ -1,0 +1,8 @@
+"""Entry point for ``python -m tpumetrics.analysis``."""
+
+import sys
+
+from tpumetrics.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,57 @@
+"""``python -m tpumetrics.analysis`` — the tpulint command line.
+
+Exit codes: 0 = clean (no unsuppressed findings), 1 = findings, 2 = usage /
+analyzer error.  ``--format json`` emits the round-trippable report that the
+CI gate (tests/test_analysis_gate.py) diffs against its committed baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from tpumetrics.analysis.core import analyze_paths
+from tpumetrics.analysis.report import render_json, render_text
+from tpumetrics.analysis.rules import CATALOG
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpumetrics.analysis",
+        description="tpulint: static trace-safety & sync-schedule linter for tpumetrics",
+    )
+    p.add_argument("paths", nargs="*", help="files and/or directories to analyze")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--select", default="", help="comma-separated codes to report (default: all)")
+    p.add_argument("--ignore", default="", help="comma-separated codes to drop")
+    p.add_argument("--show-suppressed", action="store_true", help="include suppressed findings in text output")
+    p.add_argument("--list-rules", action="store_true", help="print the rule catalog and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        for code, (name, desc) in sorted(CATALOG.items()):
+            print(f"{code}  {name:24s} {desc}")
+        return 0
+    if not args.paths:
+        print("error: no paths given (try: python -m tpumetrics.analysis tpumetrics/)", file=sys.stderr)
+        return 2
+    select = {c.strip() for c in args.select.split(",") if c.strip()} or None
+    ignore = {c.strip() for c in args.ignore.split(",") if c.strip()} or None
+    try:
+        findings = analyze_paths(args.paths, select=select, ignore=ignore)
+    except (OSError, ValueError) as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(render_json(findings))
+    else:
+        print(render_text(findings, show_suppressed=args.show_suppressed))
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via __main__.py
+    sys.exit(main())

@@ -1,0 +1,635 @@
+"""Analyzer core: findings, suppressions, the package index, and the driver.
+
+The analyzer is pure AST — it never imports the code under analysis (no jax
+required at lint time, so it runs on a host-only CPU box in milliseconds).
+It works in two passes:
+
+1. **Index pass** (:class:`PackageIndex`): parse every file once and record,
+   per module, its imports, module-level functions, and classes (bases,
+   methods, ``add_state`` declarations, ``__init__`` attributes).  From
+   that it resolves which classes are :class:`~tpumetrics.metric.Metric`
+   subclasses (transitively, across modules) and computes the set of
+   functions **reachable from any** ``update()`` — following ``self.m()``
+   virtual dispatch through each concrete class's method table and bare /
+   ``module.attr`` calls through the import graph.  This is what lets the
+   host-sync rules flag a hazard inside a ``tpumetrics.functional`` helper
+   three calls below ``update()`` while leaving ``compute()``-only code
+   alone.
+2. **Rule pass** (:mod:`tpumetrics.analysis.rules`): each registered rule
+   walks the per-module ASTs with the index available and yields
+   :class:`Finding`\\ s.
+
+Known approximations (documented, deliberate): calls through variables
+holding callables, ``getattr`` dispatch, and nested closures are not
+followed; loop-carried taint is not fix-pointed.  The runtime lockstep
+verifier (:mod:`tpumetrics.telemetry.lockstep`) remains the authoritative
+dynamic check — tpulint is the cheap static complement.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: codes that may never be silenced (meta-findings about the lint run itself)
+UNSUPPRESSABLE = ("TPL900", "TPL901", "TPL902")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*(?P<kind>disable|disable-next)\s*="
+    r"\s*(?P<codes>TPL[0-9]{3}(?:\s*,\s*TPL[0-9]{3})*)"
+    r"(?:\s+--\s*(?P<why>\S.*))?"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer finding, anchored to a source location.  ``end_line``
+    is the last line of the enclosing statement (0 ⇒ same as ``line``):
+    a trailing ``# tpulint: disable`` on ANY line of a multi-line statement
+    suppresses the finding."""
+
+    code: str
+    message: str
+    path: str
+    line: int
+    col: int
+    symbol: str = ""
+    suppressed: bool = False
+    justification: str = ""
+    end_line: int = 0
+
+    def key(self) -> Tuple[str, int, int, str]:
+        return (self.path, self.line, self.col, self.code)
+
+
+@dataclass
+class Suppression:
+    line: int  # the source line the suppression APPLIES to
+    codes: Set[str]
+    justification: str
+    comment_line: int  # where the comment itself lives (for TPL901)
+    used: bool = False
+
+
+@dataclass
+class FuncInfo:
+    """One function or method: its AST plus resolved-enough call edges."""
+
+    name: str
+    qualname: str
+    modname: str
+    node: ast.AST
+    # edges: ("s", meth) self-call | ("n", name) bare call | ("a", base, attr)
+    callees: Set[Tuple[str, ...]] = field(default_factory=set)
+    owner: Optional["ClassInfo"] = None
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    qualname: str
+    modname: str
+    node: ast.ClassDef
+    bases: List[str] = field(default_factory=list)  # dotted, import-resolved
+    methods: Dict[str, FuncInfo] = field(default_factory=dict)
+    state_names: Set[str] = field(default_factory=set)
+    # every self.add_state(...) call site: (call node, declaring method name)
+    add_state_calls: List[Tuple[ast.Call, str]] = field(default_factory=list)
+    init_attrs: Set[str] = field(default_factory=set)
+    class_attrs: Set[str] = field(default_factory=set)
+    property_names: Set[str] = field(default_factory=set)
+    children: Set[str] = field(default_factory=set)  # qualified "mod:Class"
+
+
+@dataclass
+class ModuleInfo:
+    modname: str
+    path: str
+    tree: Optional[ast.Module]
+    lines: List[str]
+    parse_error: Optional[SyntaxError] = None
+    imports_mod: Dict[str, str] = field(default_factory=dict)  # alias -> dotted
+    imports_from: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    functions: Dict[str, FuncInfo] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    suppressions: List[Suppression] = field(default_factory=list)
+
+
+def _module_name(path: str) -> str:
+    """Dotted module name, derived by walking up while ``__init__.py`` exists
+    (so ``…/tpumetrics/image/fid.py`` → ``tpumetrics.image.fid`` regardless of
+    the CWD the CLI ran from; a bare fixture file is just its stem)."""
+    path = os.path.abspath(path)
+    stem = os.path.splitext(os.path.basename(path))[0]
+    parts = [] if stem == "__init__" else [stem]
+    d = os.path.dirname(path)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.insert(0, os.path.basename(d))
+        d = os.path.dirname(d)
+    return ".".join(parts) or "<module>"
+
+
+def _scan_suppressions(src: str) -> List[Suppression]:
+    """Parse ``tpulint: disable`` directives from actual COMMENT tokens only
+    (a docstring or string literal *quoting* the syntax is not a directive —
+    raw-line matching produced phantom TPL901s for documentation)."""
+    out: List[Suppression] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except (tokenize.TokenizeError, SyntaxError, IndentationError):
+        return out  # unparsable file: TPL900 covers it
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        i = tok.start[0]
+        codes = {c.strip() for c in m.group("codes").split(",")}
+        target = i + 1 if m.group("kind") == "disable-next" else i
+        out.append(Suppression(target, codes, (m.group("why") or "").strip(), i))
+    return out
+
+
+class _CalleeCollector(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.callees: Set[Tuple[str, ...]] = set()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Name):
+            self.callees.add(("n", f.id))
+        elif isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            if f.value.id == "self":
+                self.callees.add(("s", f.attr))
+            else:
+                self.callees.add(("a", f.value.id, f.attr))
+        self.generic_visit(node)
+
+
+def _func_info(node: ast.AST, modname: str, owner: Optional[ClassInfo] = None) -> FuncInfo:
+    coll = _CalleeCollector()
+    for stmt in node.body:  # type: ignore[attr-defined]
+        coll.visit(stmt)
+    qual = f"{owner.name}.{node.name}" if owner else node.name  # type: ignore[attr-defined]
+    return FuncInfo(node.name, qual, modname, node, coll.callees, owner)  # type: ignore[attr-defined]
+
+
+def _literal_state_names(call: ast.Call, method: ast.AST) -> Set[str]:
+    """State name(s) a ``self.add_state(name, …)`` call declares.  The name is
+    usually a literal; the stat-scores idiom loops over a literal tuple
+    (``for name in ("tp", "fp", …): self.add_state(name, …)``) — resolve that
+    too by finding the enclosing ``for`` whose target binds the name arg."""
+    args = call.args or []
+    name_arg: Optional[ast.expr] = args[0] if args else None
+    for kw in call.keywords:
+        if kw.arg == "name":
+            name_arg = kw.value
+    if isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str):
+        return {name_arg.value}
+    if isinstance(name_arg, ast.Name):
+        for loop in ast.walk(method):
+            if (
+                isinstance(loop, ast.For)
+                and isinstance(loop.target, ast.Name)
+                and loop.target.id == name_arg.id
+                and isinstance(loop.iter, (ast.Tuple, ast.List))
+            ):
+                return {
+                    e.value
+                    for e in loop.iter.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                }
+    return set()
+
+
+def _self_attr_stores(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(fn):
+        targets: List[ast.expr] = []
+        if isinstance(n, ast.Assign):
+            targets = list(n.targets)
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            targets = [n.target]
+        for t in targets:
+            for el in ast.walk(t):
+                if (
+                    isinstance(el, ast.Attribute)
+                    and isinstance(el.value, ast.Name)
+                    and el.value.id == "self"
+                ):
+                    out.add(el.attr)
+    return out
+
+
+_PROPERTY_DECOS = {"property", "cached_property"}
+
+
+def _is_property(fn: ast.AST) -> bool:
+    for d in getattr(fn, "decorator_list", []):
+        if isinstance(d, ast.Name) and d.id in _PROPERTY_DECOS:
+            return True
+        if isinstance(d, ast.Attribute) and d.attr in ("setter", "deleter", "getter"):
+            return True
+    return False
+
+
+class PackageIndex:
+    """Cross-file symbol index + ``update()``-reachability oracle."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.classes_by_name: Dict[str, List[ClassInfo]] = {}
+        self._metric_like: Dict[int, bool] = {}
+        self._ancestor_cache: Dict[int, List[ClassInfo]] = {}
+        self._children: Optional[Dict[int, List[ClassInfo]]] = None
+        self._broad_states: Dict[int, Set[str]] = {}
+        self._declared_attrs: Dict[int, Set[str]] = {}
+        self.update_reachable: Set[int] = set()  # id(func node)
+
+    # ------------------------------------------------------------- building
+    @classmethod
+    def from_files(cls, files: Sequence[str]) -> "PackageIndex":
+        idx = cls()
+        for path in files:
+            idx._index_file(path)
+        idx._compute_reachability()
+        return idx
+
+    def _index_file(self, path: str) -> None:
+        with open(path, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        modname = _module_name(path)
+        lines = src.splitlines()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as err:
+            self.modules[modname] = ModuleInfo(modname, path, None, lines, parse_error=err)
+            return
+        mod = ModuleInfo(modname, path, tree, lines, suppressions=_scan_suppressions(src))
+        for node in tree.body:
+            self._index_toplevel(mod, node)
+        self.modules[modname] = mod
+
+    def _index_toplevel(self, mod: ModuleInfo, node: ast.stmt) -> None:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                mod.imports_mod[alias.asname or alias.name.partition(".")[0]] = (
+                    alias.name if alias.asname else alias.name.partition(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:  # relative import: anchor on this module's package
+                pkg = mod.modname.split(".")
+                pkg = pkg[: len(pkg) - node.level]
+                base = ".".join(pkg + ([node.module] if node.module else []))
+            for alias in node.names:
+                mod.imports_from[alias.asname or alias.name] = (base, alias.name)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mod.functions[node.name] = _func_info(node, mod.modname)
+        elif isinstance(node, ast.ClassDef):
+            self._index_class(mod, node)
+
+    def _index_class(self, mod: ModuleInfo, node: ast.ClassDef) -> None:
+        ci = ClassInfo(node.name, f"{mod.modname}:{node.name}", mod.modname, node)
+        for b in node.bases:
+            dotted = self._resolve_base(mod, b)
+            if dotted:
+                ci.bases.append(dotted)
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if _is_property(item):
+                    ci.property_names.add(item.name)
+                else:
+                    ci.methods[item.name] = _func_info(item, mod.modname, ci)
+                if item.name in ("__init__", "__post_init__"):
+                    ci.init_attrs |= _self_attr_stores(item)
+                for sub in ast.walk(item):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "add_state"
+                        and isinstance(sub.func.value, ast.Name)
+                        and sub.func.value.id == "self"
+                    ):
+                        ci.add_state_calls.append((sub, item.name))
+                        ci.state_names |= _literal_state_names(sub, item)
+            elif isinstance(item, ast.Assign):
+                for t in item.targets:
+                    if isinstance(t, ast.Name):
+                        ci.class_attrs.add(t.id)
+            elif isinstance(item, ast.AnnAssign) and isinstance(item.target, ast.Name):
+                ci.class_attrs.add(item.target.id)
+        mod.classes[node.name] = ci
+        self.classes_by_name.setdefault(node.name, []).append(ci)
+
+    def _resolve_base(self, mod: ModuleInfo, expr: ast.expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            if expr.id in mod.imports_from:
+                m, orig = mod.imports_from[expr.id]
+                return f"{m}.{orig}" if m else orig
+            if expr.id in mod.classes:
+                return f"{mod.modname}.{expr.id}"
+            return expr.id
+        if isinstance(expr, ast.Attribute):
+            parts: List[str] = []
+            cur: ast.expr = expr
+            while isinstance(cur, ast.Attribute):
+                parts.insert(0, cur.attr)
+                cur = cur.value
+            if isinstance(cur, ast.Name):
+                head = mod.imports_mod.get(cur.id, cur.id)
+                return ".".join([head] + parts)
+        if isinstance(expr, ast.Subscript):  # Generic[...] bases
+            return self._resolve_base(mod, expr.value)
+        return None
+
+    # ----------------------------------------------------------- hierarchy
+    def _base_classinfos(self, ci: ClassInfo) -> List[ClassInfo]:
+        out: List[ClassInfo] = []
+        for dotted in ci.bases:
+            modpart, _, name = dotted.rpartition(".")
+            hit = None
+            if modpart and modpart in self.modules:
+                hit = self.modules[modpart].classes.get(name)
+            if hit is None:
+                cands = self.classes_by_name.get(name or dotted, [])
+                hit = cands[0] if len(cands) >= 1 else None
+            if hit is not None and hit is not ci:
+                out.append(hit)
+        return out
+
+    def is_metric_like(self, ci: ClassInfo, _seen: Optional[Set[int]] = None) -> bool:
+        if id(ci) in self._metric_like:
+            return self._metric_like[id(ci)]
+        seen = _seen or set()
+        if id(ci) in seen:
+            return False
+        seen.add(id(ci))
+        result = False
+        for dotted in ci.bases:
+            tail = dotted.rpartition(".")[2]
+            if tail == "Metric":
+                result = True
+                break
+        if not result:
+            for base in self._base_classinfos(ci):
+                if self.is_metric_like(base, seen):
+                    result = True
+                    break
+        self._metric_like[id(ci)] = result
+        return result
+
+    def _ancestors(self, ci: ClassInfo) -> List[ClassInfo]:
+        cached = self._ancestor_cache.get(id(ci))
+        if cached is not None:
+            return cached
+        out: List[ClassInfo] = []
+        queue, seen = [ci], {id(ci)}
+        while queue:
+            cur = queue.pop(0)
+            for base in self._base_classinfos(cur):
+                if id(base) not in seen:
+                    seen.add(id(base))
+                    out.append(base)
+                    queue.append(base)
+        self._ancestor_cache[id(ci)] = out
+        return out
+
+    def _child_map(self) -> Dict[int, List[ClassInfo]]:
+        if self._children is None:
+            self._children = {}
+            for mod in self.modules.values():
+                for ci in mod.classes.values():
+                    for base in self._base_classinfos(ci):
+                        self._children.setdefault(id(base), []).append(ci)
+        return self._children
+
+    def _descendants(self, ci: ClassInfo) -> List[ClassInfo]:
+        children = self._child_map()
+        out: List[ClassInfo] = []
+        queue, seen = [ci], {id(ci)}
+        while queue:
+            cur = queue.pop(0)
+            for kid in children.get(id(cur), []):
+                if id(kid) not in seen:
+                    seen.add(id(kid))
+                    out.append(kid)
+                    queue.append(kid)
+        return out
+
+    def broad_state_names(self, ci: ClassInfo) -> Set[str]:
+        """``add_state`` names declared anywhere in the class's hierarchy
+        (ancestors + itself + descendants): a method defined on an abstract
+        base reads states its concrete subclasses declare."""
+        if id(ci) not in self._broad_states:
+            names = set(ci.state_names)
+            for rel in self._ancestors(ci) + self._descendants(ci):
+                names |= rel.state_names
+            self._broad_states[id(ci)] = names
+        return self._broad_states[id(ci)]
+
+    def declared_attr_names(self, ci: ClassInfo) -> Set[str]:
+        """Attributes the hierarchy legitimately owns besides states:
+        ``__init__`` assignments, class-level attributes, properties."""
+        if id(ci) not in self._declared_attrs:
+            names: Set[str] = set()
+            for rel in [ci] + self._ancestors(ci) + self._descendants(ci):
+                names |= rel.init_attrs | rel.class_attrs | rel.property_names
+            self._declared_attrs[id(ci)] = names
+        return self._declared_attrs[id(ci)]
+
+    # -------------------------------------------------------- reachability
+    def method_table(self, ci: ClassInfo) -> Dict[str, FuncInfo]:
+        table: Dict[str, FuncInfo] = {}
+        for c in [ci] + self._ancestors(ci):
+            for name, fi in c.methods.items():
+                table.setdefault(name, fi)
+        return table
+
+    def _resolve_call(self, fi: FuncInfo, key: Tuple[str, ...]) -> Optional[FuncInfo]:
+        mod = self.modules.get(fi.modname)
+        if mod is None:
+            return None
+        if key[0] == "n":
+            name = key[1]
+            if name in mod.functions:
+                return mod.functions[name]
+            if name in mod.imports_from:
+                tmod, orig = mod.imports_from[name]
+                target = self.modules.get(tmod)
+                if target:
+                    return target.functions.get(orig)
+        elif key[0] == "a":
+            base, attr = key[1], key[2]
+            dotted = mod.imports_mod.get(base)
+            if dotted and dotted in self.modules:
+                return self.modules[dotted].functions.get(attr)
+        return None
+
+    def _compute_reachability(self) -> None:
+        for mod in self.modules.values():
+            for ci in mod.classes.values():
+                if not self.is_metric_like(ci):
+                    continue
+                table = self.method_table(ci)
+                if "update" not in table:
+                    continue
+                queue: List[FuncInfo] = [table["update"]]
+                seen: Set[int] = set()
+                while queue:
+                    fi = queue.pop()
+                    if id(fi.node) in seen:
+                        continue
+                    seen.add(id(fi.node))
+                    self.update_reachable.add(id(fi.node))
+                    for key in fi.callees:
+                        nxt = table.get(key[1]) if key[0] == "s" else self._resolve_call(fi, key)
+                        if nxt is not None and id(nxt.node) not in seen:
+                            queue.append(nxt)
+
+    def is_update_reachable(self, node: ast.AST) -> bool:
+        return id(node) in self.update_reachable
+
+
+# ------------------------------------------------------------------ driver
+def _collect_files(paths: Sequence[str]) -> List[str]:
+    """Expand paths to .py files; a nonexistent path or an expansion that
+    yields NOTHING raises — a typo'd CI invocation must not read as a clean
+    lint run (exit 0 on zero files analyzed is the silent-green failure
+    mode the tier-1 gates exist to prevent)."""
+    files: List[str] = []
+    for p in paths:
+        if not os.path.exists(p):
+            raise ValueError(f"path does not exist: {p}")
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+                files.extend(os.path.join(root, n) for n in sorted(names) if n.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+        else:
+            raise ValueError(f"not a .py file or directory: {p}")
+    if not files:
+        raise ValueError(f"no .py files found under: {', '.join(map(str, paths))}")
+    return files
+
+
+def _apply_suppressions(findings: List[Finding], modules: Iterable[ModuleInfo]) -> List[Finding]:
+    by_path: Dict[str, List[Suppression]] = {}
+    for mod in modules:
+        by_path[mod.path] = mod.suppressions
+    out: List[Finding] = []
+    for f in findings:
+        hit = None
+        if f.code not in UNSUPPRESSABLE:
+            last = max(f.end_line, f.line)
+            for sup in by_path.get(f.path, []):
+                # a directive on ANY line of the finding's statement applies
+                # (a trailing comment on a multi-line call sits on the last line)
+                if f.line <= sup.line <= last and (f.code in sup.codes):
+                    hit = sup
+                    break
+        if hit is not None:
+            hit.used = True
+            out.append(
+                Finding(
+                    f.code, f.message, f.path, f.line, f.col, f.symbol,
+                    suppressed=True, justification=hit.justification,
+                    end_line=f.end_line,
+                )
+            )
+        else:
+            out.append(f)
+    for mod in modules:
+        for sup in mod.suppressions:
+            # a suppression is a claim someone audited the finding — require the why
+            if not sup.justification:
+                out.append(
+                    Finding(
+                        "TPL901",
+                        "tpulint suppression without a justification: append "
+                        "'-- <why this is safe>' to the disable comment",
+                        mod.path,
+                        sup.comment_line,
+                        0,
+                    )
+                )
+            # and a stale one (nothing left to silence) must be deleted, not
+            # accumulate — the next edit on that line would be silently muted
+            elif not sup.used:
+                out.append(
+                    Finding(
+                        "TPL902",
+                        "unused tpulint suppression: no "
+                        f"{'/'.join(sorted(sup.codes))} finding on the target "
+                        "line — delete the stale disable comment",
+                        mod.path,
+                        sup.comment_line,
+                        0,
+                    )
+                )
+    return out
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    select: Optional[Set[str]] = None,
+    ignore: Optional[Set[str]] = None,
+) -> List[Finding]:
+    """Analyze ``paths`` (files and/or directories) and return all findings,
+    suppressed ones included (callers filter on ``Finding.suppressed``)."""
+    from tpumetrics.analysis.rules import RULES
+
+    files = _collect_files(paths)
+    index = PackageIndex.from_files(files)
+    findings: List[Finding] = []
+    for mod in index.modules.values():
+        if mod.parse_error is not None:
+            findings.append(
+                Finding(
+                    "TPL900",
+                    f"syntax error: {mod.parse_error.msg}",
+                    mod.path,
+                    mod.parse_error.lineno or 1,
+                    mod.parse_error.offset or 0,
+                )
+            )
+            continue
+        for rule in RULES:
+            findings.extend(rule.check(mod, index))
+    findings = _apply_suppressions(findings, list(index.modules.values()))
+    if select:
+        findings = [f for f in findings if f.code in select or f.code in UNSUPPRESSABLE]
+    if ignore:
+        findings = [f for f in findings if f.code not in ignore]
+    seen: Set[Tuple[str, int, int, str]] = set()
+    unique: List[Finding] = []
+    for f in sorted(findings, key=lambda f: f.key()):
+        if f.key() not in seen:
+            seen.add(f.key())
+            unique.append(f)
+    return unique
+
+
+def analyze_source(src: str, path: str = "<fixture>.py") -> List[Finding]:
+    """Analyze one in-memory source blob (test/fixture convenience)."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        target = os.path.join(td, os.path.basename(path))
+        with open(target, "w", encoding="utf-8") as fh:
+            fh.write(src)
+        found = analyze_paths([target])
+    return [
+        Finding(
+            f.code, f.message, path, f.line, f.col, f.symbol,
+            f.suppressed, f.justification, f.end_line,
+        )
+        for f in found
+    ]

@@ -1,0 +1,70 @@
+"""Finding reporters: human text and machine JSON (round-trippable)."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence
+
+from tpumetrics.analysis.core import Finding
+
+
+def render_text(findings: Sequence[Finding], show_suppressed: bool = False) -> str:
+    lines: List[str] = []
+    shown = [f for f in findings if show_suppressed or not f.suppressed]
+    for f in shown:
+        mark = " [suppressed]" if f.suppressed else ""
+        sym = f" ({f.symbol})" if f.symbol else ""
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.code}{mark}{sym} {f.message}")
+    active = sum(1 for f in findings if not f.suppressed)
+    muted = len(findings) - active
+    lines.append(
+        f"tpulint: {active} finding{'s' if active != 1 else ''}"
+        + (f" ({muted} suppressed)" if muted else "")
+    )
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding]) -> str:
+    return json.dumps(
+        {
+            "version": 1,
+            "findings": [
+                {
+                    "code": f.code,
+                    "message": f.message,
+                    "path": f.path,
+                    "line": f.line,
+                    "col": f.col,
+                    "symbol": f.symbol,
+                    "suppressed": f.suppressed,
+                    "justification": f.justification,
+                    "end_line": f.end_line,
+                }
+                for f in findings
+            ],
+            "counts": _counts(findings),
+        },
+        indent=2,
+        sort_keys=True,
+    )
+
+
+def parse_json(text: str) -> List[Finding]:
+    """Inverse of :func:`render_json` (the report round-trips losslessly)."""
+    payload = json.loads(text)
+    return [
+        Finding(
+            d["code"], d["message"], d["path"], d["line"], d["col"],
+            d.get("symbol", ""), d.get("suppressed", False), d.get("justification", ""),
+            d.get("end_line", 0),
+        )
+        for d in payload["findings"]
+    ]
+
+
+def _counts(findings: Sequence[Finding]) -> Dict[str, int]:
+    out: Dict[str, int] = {"total": len(findings), "active": 0, "suppressed": 0}
+    for f in findings:
+        out["suppressed" if f.suppressed else "active"] += 1
+        out[f.code] = out.get(f.code, 0) + 1
+    return out

@@ -1,0 +1,1036 @@
+"""tpulint rules: trace-safety, sync-schedule, and state-contract checks.
+
+Rule catalog (codes are stable API — tests, suppressions, and the CI gate
+key off them):
+
+====== ======================= ==========================================================
+code   name                    what it rejects
+====== ======================= ==========================================================
+TPL101 host-transfer           ``.item()``/``.tolist()``/``float()``/``int()``/``bool()``/
+                               ``len()``/``np.asarray``/``jax.device_get`` applied to a
+                               traced value in ``update()``-reachable code
+TPL102 traced-branch           ``if``/``while``/``assert``/ternary/bool-op/``range`` on a
+                               traced value in ``update()``-reachable code
+TPL201 divergent-collective    a collective (``sync``/``all_reduce``/``all_gather``/
+                               ``flush``/…) reachable on only one branch of a rank- or
+                               data-dependent conditional — the static complement of the
+                               runtime ``LockstepViolation``
+TPL301 bad-state-default       ``add_state`` default inconsistent with ``dist_reduce_fx``
+                               (non-zero for ``sum``, non-``+inf`` for ``min``,
+                               non-``-inf`` for ``max``, non-empty for ``cat``)
+TPL302 state-mutation          in-place mutation of an array state (subscript store,
+                               discarded ``.at[...]`` result, ``.fill()``/``.sort()``)
+                               instead of reassignment
+TPL303 unshardable-state       array state declared with ``dist_reduce_fx=None`` — has no
+                               world-size-independent meaning, so ``parallel/merge.py``
+                               refuses to fold or elastically reshard it
+TPL401 shadow-state            ``self.<attr>`` assigned in ``update()``-reachable code but
+                               never declared via ``add_state`` — invisible to ``reset()``,
+                               snapshots, and elastic fold/reshard
+TPL900 syntax-error            file could not be parsed (never suppressible)
+TPL901 unjustified-suppression ``tpulint: disable`` comment without a ``-- why`` text
+                               (never suppressible)
+TPL902 unused-suppression      a ``tpulint: disable`` comment that silences nothing —
+                               stale directives mute the next edit on that line
+                               (never suppressible)
+====== ======================= ==========================================================
+
+Traced-value inference is a forward taint pass per function: parameters with
+``Array``-ish annotations (and unannotated ``update()`` parameters — arrays
+by contract), ``self.<state>`` loads of declared states, and ``jnp.*`` /
+``jax.lax.*``-family call results are traced; ``.shape``/``.dtype``/``.ndim``
+stay host-side; list states and literal containers of traced values are
+tracked separately (``len()``/emptiness checks on them are fine, transfers
+are not).  The recognized **eager-guard idiom** — any conditional whose test
+mentions ``jax.core.Tracer``/``isinstance(..., Tracer)`` or a name matching
+``is_traced``/``in_trace``/``is_concrete`` — marks its subtree as
+deliberately eager, and host-sync rules stay quiet inside it (the runtime
+check is authoritative there).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tpumetrics.analysis.core import ClassInfo, Finding, FuncInfo, ModuleInfo, PackageIndex
+
+CATALOG: Dict[str, Tuple[str, str]] = {
+    "TPL101": ("host-transfer", "host transfer of a traced value reachable from update()"),
+    "TPL102": ("traced-branch", "Python control flow on a traced value reachable from update()"),
+    "TPL201": (
+        "divergent-collective",
+        "collective reachable on only one branch of a rank- or data-dependent conditional",
+    ),
+    "TPL301": ("bad-state-default", "add_state default inconsistent with dist_reduce_fx"),
+    "TPL302": ("state-mutation", "in-place mutation of an array state instead of reassignment"),
+    "TPL303": ("unshardable-state", "array state with dist_reduce_fx=None cannot be folded/resharded"),
+    "TPL401": ("shadow-state", "attribute assigned in update()-reachable code but not declared via add_state"),
+    "TPL900": ("syntax-error", "file could not be parsed"),
+    "TPL901": ("unjustified-suppression", "tpulint disable comment without a justification"),
+    "TPL902": ("unused-suppression", "tpulint disable comment that silences nothing"),
+}
+
+# ----------------------------------------------------------- value lattice
+TRACED = "traced"  # a (potentially) traced jax array
+CONTAINER = "container"  # python container holding traced values (list state, tuple of arrays)
+HOST = "host"  # definitely host-side (shape tuples, python scalars, strings)
+UNKNOWN = "unknown"
+
+_TRACED_CALL_PREFIXES = (
+    "jax.numpy.",
+    "jax.lax.",
+    "jax.nn.",
+    "jax.scipy.",
+    "jax.random.",
+    "jax.ops.",
+    "jax.image.",
+)
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "nbytes", "itemsize", "weak_type", "sharding"}
+#: jnp/jax functions returning *static* (host) metadata, not traced arrays
+_STATIC_JNP_FUNCS = {
+    "issubdtype", "isdtype", "iinfo", "finfo", "result_type", "promote_types",
+    "can_cast", "dtype", "ndim", "shape", "size", "iscomplexobj", "isrealobj",
+}
+#: method names whose result is host-side bookkeeping even on unknown receivers
+_DICTISH_METHODS = {"keys", "values", "items", "get"}
+_COERCION_SINKS = {"float", "int", "bool", "complex", "len"}
+_METHOD_SINKS = {"item", "tolist", "block_until_ready"}
+_INPLACE_METHODS = {"fill", "sort", "partition", "put", "resize", "setflags"}
+_HOST_NEUTRAL_CALLS = {
+    "isinstance", "hasattr", "getattr", "type", "id", "repr", "str", "print",
+    "format", "issubclass", "callable", "super", "list", "tuple", "dict", "set",
+    "frozenset", "zip", "enumerate", "reversed", "map", "filter", "vars", "dir",
+    "abs", "round", "sum", "divmod",
+}
+#: python builtins that truth-test or compare their argument element-wise —
+#: on a traced array that is a host sync (TracerBoolConversionError under jit)
+_PY_TRUTH_SINKS = {"any", "all", "min", "max", "sorted"}
+_COLLECTIVE_NAMES = {
+    "all_reduce", "all_gather", "all_gather_object", "all_to_all",
+    "broadcast_object", "psum", "pmean", "pmax", "pmin", "flush", "sync",
+    "barrier", "snapshot_barrier", "_sync_state", "sync_context",
+}
+_RANKISH_NAMES = {"rank", "process_index", "axis_index", "local_rank", "host_id", "task_id", "node_rank"}
+#: base-Metric bookkeeping attrs update-reachable code may touch even when the
+#: defining class's hierarchy cannot be resolved (lone fixture files)
+_WELL_KNOWN_BASE_ATTRS = {
+    "_computed", "_update_count", "_cache", "_is_synced", "_to_sync",
+    "_should_unsync", "_enable_grad", "_last_good", "degraded", "_degraded",
+}
+
+
+_CONTAINER_WRAPPERS = (
+    "Sequence", "List", "Tuple", "Dict", "Mapping", "MutableMapping",
+    "Iterable", "Iterator", "Collection", "Set", "FrozenSet",
+    "list", "tuple", "dict", "set",
+)
+
+
+def _annotation_state(node: Optional[ast.expr], mod: ModuleInfo) -> Optional[str]:
+    """TRACED for ``Array``/``jnp.ndarray``-typed params (``Optional``/
+    ``Union`` included), CONTAINER for containers *of* arrays
+    (``Sequence[Dict[str, Array]]`` — its len()/truthiness is host-side),
+    ``None`` for everything else.  ``np.ndarray`` annotations are host data,
+    not traced."""
+    if node is None:
+        return None
+    try:
+        text = ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse failures on exotic nodes
+        return None
+    import re
+
+    arrayish = bool(re.search(r"\bArray\b", text))
+    if not arrayish:
+        for m in re.finditer(r"(?:\b(\w+)\.)?ndarray\b", text):
+            head = mod.imports_mod.get(m.group(1) or "", m.group(1) or "")
+            if head.startswith("jax"):
+                arrayish = True
+                break
+    if not arrayish:
+        return None
+    inner = text
+    if inner.startswith("Optional[") and inner.endswith("]"):
+        inner = inner[len("Optional[") : -1]
+    if re.match(r"(?:typing\.)?(%s)\[" % "|".join(_CONTAINER_WRAPPERS), inner):
+        return CONTAINER
+    return TRACED
+
+
+def _truncate(node: ast.AST, limit: int = 70) -> str:
+    try:
+        text = ast.unparse(node)
+    except Exception:  # pragma: no cover
+        return "<expr>"
+    return text if len(text) <= limit else text[: limit - 1] + "…"
+
+
+def _dotted_name(expr: ast.expr, mod: ModuleInfo) -> Optional[str]:
+    """Import-resolved dotted name of a call target (``jnp.sum`` →
+    ``jax.numpy.sum``, ``np.asarray`` → ``numpy.asarray``, bare builtins stay
+    bare).  ``None`` for anything not a plain name/attribute chain."""
+    parts: List[str] = []
+    cur = expr
+    while isinstance(cur, ast.Attribute):
+        parts.insert(0, cur.attr)
+        cur = cur.value
+    if not isinstance(cur, ast.Name):
+        return None
+    head = cur.id
+    if parts:
+        head = mod.imports_mod.get(head, head)
+        return ".".join([head] + parts)
+    if head in mod.imports_from:
+        tmod, orig = mod.imports_from[head]
+        return f"{tmod}.{orig}" if tmod else orig
+    return head
+
+
+def _mentions_rankish(test: ast.expr) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and n.id in _RANKISH_NAMES:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _RANKISH_NAMES:
+            return True
+    return False
+
+
+def _is_eager_guard(test: ast.expr) -> bool:
+    """Recognize the documented eager-guard idiom: the author already routed
+    this code to the concrete/eager world, so host reads inside it are fine."""
+    import re
+
+    pat = re.compile(r"tracer|is_?traced|in_?trace\b|is_?concrete", re.IGNORECASE)
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and pat.search(n.id):
+            return True
+        if isinstance(n, ast.Attribute) and pat.search(n.attr):
+            return True
+    return False
+
+
+def _join(a: str, b: str) -> str:
+    if a == b:
+        return a
+    if TRACED in (a, b):
+        return TRACED
+    if CONTAINER in (a, b):
+        return CONTAINER
+    return UNKNOWN
+
+
+class _TraceWalker:
+    """Forward taint pass over one function; reports TPL101/102/201."""
+
+    def __init__(
+        self,
+        mod: ModuleInfo,
+        index: PackageIndex,
+        fi: FuncInfo,
+        check_sync: bool,
+    ) -> None:
+        self.mod = mod
+        self.index = index
+        self.fi = fi
+        self.check_sync = check_sync
+        self.guard_depth = 0
+        self.env: Dict[str, str] = {}
+        self._stmt_end = 0  # last line of the enclosing SIMPLE statement
+        self.findings: List[Finding] = []
+        self._reported: Set[Tuple[int, int, str]] = set()
+        # innermost-first stack of divergent-conditional frames for TPL201
+        self.cond_stack: List[dict] = []
+        self.traced_attrs: Set[str] = set()
+        self.container_attrs: Set[str] = set()
+        if fi.owner is not None:
+            states = index.broad_state_names(fi.owner)
+            list_states = _list_state_names(fi.owner, index)
+            self.container_attrs = states & list_states
+            self.traced_attrs = states - self.container_attrs
+        self._seed_params()
+
+    # ------------------------------------------------------------- plumbing
+    def _seed_params(self) -> None:
+        node = self.fi.node
+        args = node.args  # type: ignore[attr-defined]
+        is_update = self.fi.name == "update" and self.fi.owner is not None
+        for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+            if a.arg in ("self", "cls"):
+                continue
+            ann = _annotation_state(a.annotation, self.mod)
+            if ann is not None:
+                self.env[a.arg] = ann
+            elif is_update and a.annotation is None:
+                # update()'s positional inputs are arrays by contract
+                self.env[a.arg] = TRACED
+
+    def _report(self, code: str, node: ast.AST, message: str) -> None:
+        key = (getattr(node, "lineno", 0), getattr(node, "col_offset", 0), code)
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        self.findings.append(
+            Finding(
+                code,
+                message,
+                self.mod.path,
+                getattr(node, "lineno", 1),
+                getattr(node, "col_offset", 0),
+                symbol=self.fi.qualname,
+                # a trailing disable comment may sit on the LAST line of a
+                # multi-line statement — record the extent so it still applies
+                end_line=max(self._stmt_end, getattr(node, "end_lineno", 0) or 0),
+            )
+        )
+
+    def _sync_active(self) -> bool:
+        return self.check_sync and self.guard_depth == 0
+
+    # ------------------------------------------------------------ statements
+    def run(self) -> List[Finding]:
+        self.walk_body(self.fi.node.body)  # type: ignore[attr-defined]
+        return self.findings
+
+    def walk_body(self, stmts: Sequence[ast.stmt]) -> None:
+        for s in stmts:
+            self.walk(s)
+
+    _SIMPLE_STMTS = (
+        ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Expr, ast.Return,
+        ast.Raise, ast.Assert, ast.Delete,
+    )
+
+    def walk(self, node: ast.stmt) -> None:
+        prev = self._stmt_end
+        if isinstance(node, self._SIMPLE_STMTS):
+            # compound statements (if/while/…) are excluded on purpose: their
+            # extent covers the whole body, and a comment deep inside must
+            # not accidentally suppress a finding on the header line
+            self._stmt_end = getattr(node, "end_lineno", 0) or 0
+        meth = getattr(self, f"st_{type(node).__name__}", None)
+        if meth is not None:
+            meth(node)
+        else:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt):
+                    self.walk(child)
+                elif isinstance(child, ast.expr):
+                    self.ev(child)
+        self._stmt_end = prev
+
+    def st_FunctionDef(self, node: ast.FunctionDef) -> None:  # nested defs: out of scope
+        pass
+
+    st_AsyncFunctionDef = st_FunctionDef
+
+    def st_ClassDef(self, node: ast.ClassDef) -> None:
+        pass
+
+    def st_Expr(self, node: ast.Expr) -> None:
+        self.ev(node.value)
+
+    def st_Return(self, node: ast.Return) -> None:
+        if node.value is not None:
+            self.ev(node.value)
+
+    def st_Raise(self, node: ast.Raise) -> None:
+        if node.exc is not None:
+            self.ev(node.exc)
+
+    def st_Assign(self, node: ast.Assign) -> None:
+        val = self.ev(node.value)
+        for t in node.targets:
+            self._bind(t, val)
+
+    def st_AnnAssign(self, node: ast.AnnAssign) -> None:
+        ann = _annotation_state(node.annotation, self.mod)
+        val = ann if ann is not None else (
+            self.ev(node.value) if node.value is not None else UNKNOWN
+        )
+        self._bind(node.target, val)
+
+    def st_AugAssign(self, node: ast.AugAssign) -> None:
+        val = self.ev(node.value)
+        if isinstance(node.target, ast.Name):
+            self.env[node.target.id] = _join(val, self.env.get(node.target.id, UNKNOWN))
+        else:
+            self.ev(node.target)
+
+    def _bind(self, target: ast.expr, val: str) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = val
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elem = TRACED if val in (TRACED, CONTAINER) else UNKNOWN
+            for el in target.elts:
+                self._bind(el, elem)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, CONTAINER if val in (TRACED, CONTAINER) else UNKNOWN)
+        else:
+            self.ev(target)
+
+    def st_If(self, node: ast.If) -> None:
+        guarded = _is_eager_guard(node.test)
+        # `if isinstance(x, Tracer): return` — the author forked on tracedness
+        # and one world exited: the remainder of the function is deliberately
+        # single-world, so host-sync rules stay quiet from here on (the
+        # increment is never undone for this form).
+        sticky = guarded and bool(node.body) and isinstance(
+            node.body[-1], (ast.Return, ast.Raise)
+            # NOT Continue: it only exits a loop iteration — code after the
+            # loop still runs in both worlds, so the guard must not stick
+        )
+        if guarded:
+            self.guard_depth += 1
+        test_state = self.ev_bool(node.test, "if")
+        divergent = test_state == TRACED or _mentions_rankish(node.test)
+        frame = None
+        if divergent:
+            frame = {
+                "node": node,
+                "kind": "data" if test_state == TRACED else "rank",
+                "body": [],
+                "orelse": [],
+                "branch": "body",
+            }
+            self.cond_stack.append(frame)
+        before = dict(self.env)
+        self.walk_body(node.body)
+        after_body = self.env
+        self.env = dict(before)
+        if frame is not None:
+            frame["branch"] = "orelse"
+        self.walk_body(node.orelse)
+        self.env = self._merge_env(after_body, self.env)
+        if frame is not None:
+            self.cond_stack.pop()
+            self._flag_divergent(frame)
+        if guarded and not sticky:
+            self.guard_depth -= 1
+
+    def _merge_env(self, a: Dict[str, str], b: Dict[str, str]) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for k in set(a) | set(b):
+            if k in a and k in b:
+                out[k] = a[k] if a[k] == b[k] else UNKNOWN
+            else:
+                out[k] = UNKNOWN
+        return out
+
+    def _flag_divergent(self, frame: dict) -> None:
+        from collections import Counter
+
+        body_ops = Counter(name for _, name in frame["body"])
+        orelse_ops = Counter(name for _, name in frame["orelse"])
+        if body_ops == orelse_ops:
+            return
+        kind = frame["kind"]
+        test_line = frame["node"].test.lineno
+        # only the UNMATCHED collectives diverge the schedule: a pair present
+        # on both branches runs either way and must not be reported
+        for calls, mine, other in (
+            (frame["body"], body_ops, orelse_ops),
+            (frame["orelse"], orelse_ops, body_ops),
+        ):
+            for call_node, name in calls:
+                if mine[name] == other[name]:
+                    continue
+                self._report(
+                    "TPL201",
+                    call_node,
+                    f"collective '{name}' makes the sync schedule differ between the "
+                    f"branches of a {kind}-dependent conditional (test at line "
+                    f"{test_line}): ranks taking different branches deadlock, or raise "
+                    "the runtime LockstepViolation if telemetry verification is on. "
+                    "Hoist the collective out of the conditional or make the condition "
+                    "rank-uniform.",
+                )
+
+    def st_While(self, node: ast.While) -> None:
+        test_state = self.ev_bool(node.test, "while")
+        divergent = test_state == TRACED or _mentions_rankish(node.test)
+        frame = None
+        if divergent:
+            frame = {"node": node, "kind": "data" if test_state == TRACED else "rank",
+                     "body": [], "orelse": [], "branch": "body"}
+            self.cond_stack.append(frame)
+        self.walk_body(node.body)
+        self.walk_body(node.orelse)
+        if frame is not None:
+            self.cond_stack.pop()
+            for call_node, name in frame["body"]:
+                self._report(
+                    "TPL201",
+                    call_node,
+                    f"collective '{name}' inside a {frame['kind']}-dependent while loop "
+                    f"(test at line {node.test.lineno}): ranks may run it a different "
+                    "number of times and desynchronize.",
+                )
+
+    def st_For(self, node: ast.For) -> None:
+        it = self.ev(node.iter)
+        # iterating a traced array yields traced rows; iterating a CONTAINER
+        # yields UNKNOWN (elements may be dicts/tuples, not arrays themselves)
+        self._bind(node.target, TRACED if it == TRACED else UNKNOWN)
+        self.walk_body(node.body)
+        self.walk_body(node.orelse)
+
+    def st_Assert(self, node: ast.Assert) -> None:
+        self.ev_bool(node.test, "assert")
+        if node.msg is not None:
+            self.ev(node.msg)
+
+    def st_With(self, node: ast.With) -> None:
+        for item in node.items:
+            self.ev(item.context_expr)
+            if item.optional_vars is not None:
+                self._bind(item.optional_vars, UNKNOWN)
+        self.walk_body(node.body)
+
+    st_AsyncWith = st_With
+
+    def st_Try(self, node: ast.Try) -> None:
+        self.walk_body(node.body)
+        for h in node.handlers:
+            self.walk_body(h.body)
+        self.walk_body(node.orelse)
+        self.walk_body(node.finalbody)
+
+    # ----------------------------------------------------------- expressions
+    def ev_bool(self, node: ast.expr, construct: str) -> str:
+        """Evaluate ``node`` in a boolean (truthiness-forcing) context."""
+        if isinstance(node, ast.BoolOp):
+            state = HOST
+            for v in node.values:
+                state = _join(state, self.ev_bool(v, construct))
+            return state
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            return self.ev_bool(node.operand, construct)
+        state = self.ev(node)
+        if state == TRACED and self._sync_active():
+            self._report(
+                "TPL102",
+                node,
+                f"`{construct}` on a traced value forces a host sync before .compute(): "
+                f"`{_truncate(node)}` — use jnp.where / lax.cond / masking to stay on device.",
+            )
+        return state
+
+    def ev(self, node: ast.expr) -> str:
+        meth = getattr(self, f"ev_{type(node).__name__}", None)
+        if meth is not None:
+            return meth(node)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.ev(child)
+        return UNKNOWN
+
+    def ev_Constant(self, node: ast.Constant) -> str:
+        return HOST
+
+    def ev_Name(self, node: ast.Name) -> str:
+        return self.env.get(node.id, UNKNOWN)
+
+    def ev_Attribute(self, node: ast.Attribute) -> str:
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            if node.attr in self.traced_attrs:
+                return TRACED
+            if node.attr in self.container_attrs:
+                return CONTAINER
+            return UNKNOWN
+        base = self.ev(node.value)
+        if node.attr in _STATIC_ATTRS:
+            return HOST
+        if base == TRACED:
+            return TRACED
+        return UNKNOWN
+
+    def ev_Subscript(self, node: ast.Subscript) -> str:
+        base = self.ev(node.value)
+        self.ev(node.slice)
+        if base in (TRACED, CONTAINER):
+            return TRACED
+        if base == HOST:
+            return HOST
+        return UNKNOWN
+
+    def ev_Slice(self, node: ast.Slice) -> str:
+        for part in (node.lower, node.upper, node.step):
+            if part is not None:
+                self.ev(part)
+        return HOST
+
+    def ev_BinOp(self, node: ast.BinOp) -> str:
+        return _join(self.ev(node.left), self.ev(node.right))
+
+    def ev_UnaryOp(self, node: ast.UnaryOp) -> str:
+        if isinstance(node.op, ast.Not):
+            return self.ev_bool(node.operand, "not")
+        return self.ev(node.operand)
+
+    def ev_Compare(self, node: ast.Compare) -> str:
+        states = [self.ev(node.left)] + [self.ev(c) for c in node.comparators]
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return HOST
+        for op, right_state in zip(node.ops, states[1:]):
+            if isinstance(op, (ast.In, ast.NotIn)) and right_state == TRACED and self._sync_active():
+                self._report(
+                    "TPL101",
+                    node,
+                    "`in` against a traced array calls __contains__ on device data "
+                    f"(host sync): `{_truncate(node)}`",
+                )
+        return TRACED if TRACED in states else (HOST if all(s == HOST for s in states) else UNKNOWN)
+
+    def ev_BoolOp(self, node: ast.BoolOp) -> str:
+        # a and b: every operand but the last is truth-tested
+        state = HOST
+        for v in node.values[:-1]:
+            state = _join(state, self.ev_bool(v, "and/or"))
+        return _join(state, self.ev(node.values[-1]))
+
+    def ev_IfExp(self, node: ast.IfExp) -> str:
+        self.ev_bool(node.test, "ternary")
+        return _join(self.ev(node.body), self.ev(node.orelse))
+
+    def ev_Tuple(self, node: ast.Tuple) -> str:
+        states = [self.ev(e) for e in node.elts]
+        return CONTAINER if TRACED in states or CONTAINER in states else HOST
+
+    ev_List = ev_Tuple
+    ev_Set = ev_Tuple
+
+    def ev_Dict(self, node: ast.Dict) -> str:
+        states = [self.ev(v) for v in node.values if v is not None]
+        for k in node.keys:
+            if k is not None:
+                self.ev(k)
+        return CONTAINER if TRACED in states or CONTAINER in states else HOST
+
+    def ev_JoinedStr(self, node: ast.JoinedStr) -> str:
+        for v in node.values:
+            if isinstance(v, ast.FormattedValue):
+                self.ev(v.value)
+        return HOST
+
+    def ev_Lambda(self, node: ast.Lambda) -> str:
+        return HOST
+
+    def ev_Starred(self, node: ast.Starred) -> str:
+        return self.ev(node.value)
+
+    def ev_Await(self, node: ast.Await) -> str:
+        return self.ev(node.value)
+
+    def _ev_comp(self, node: ast.expr, elts: Sequence[ast.expr]) -> str:
+        for gen in node.generators:  # type: ignore[attr-defined]
+            it = self.ev(gen.iter)
+            self._bind(gen.target, TRACED if it == TRACED else UNKNOWN)
+            for cond in gen.ifs:
+                self.ev_bool(cond, "comprehension filter")
+        states = [self.ev(e) for e in elts]
+        return CONTAINER if TRACED in states or CONTAINER in states else UNKNOWN
+
+    def ev_ListComp(self, node: ast.ListComp) -> str:
+        return self._ev_comp(node, [node.elt])
+
+    def ev_SetComp(self, node: ast.SetComp) -> str:
+        return self._ev_comp(node, [node.elt])
+
+    def ev_GeneratorExp(self, node: ast.GeneratorExp) -> str:
+        return self._ev_comp(node, [node.elt])
+
+    def ev_DictComp(self, node: ast.DictComp) -> str:
+        return self._ev_comp(node, [node.key, node.value])
+
+    def ev_Call(self, node: ast.Call) -> str:
+        dotted = _dotted_name(node.func, self.mod)
+        arg_states = [self.ev(a) for a in node.args]
+        kw_states = [self.ev(kw.value) for kw in node.keywords]
+        any_traced = TRACED in arg_states or TRACED in kw_states
+        any_payload = any_traced or CONTAINER in arg_states or CONTAINER in kw_states
+
+        recv_state = None
+        if isinstance(node.func, ast.Attribute):
+            recv_state = self.ev(node.func.value)
+            attr = node.func.attr
+            if attr in _METHOD_SINKS and recv_state == TRACED:
+                if self._sync_active():
+                    self._report(
+                        "TPL101",
+                        node,
+                        f".{attr}() on a traced value is a device→host transfer: "
+                        f"`{_truncate(node)}` — keep the value on device until .compute().",
+                    )
+                return HOST if attr in ("item", "tolist") else TRACED
+            if attr in _COLLECTIVE_NAMES and self.cond_stack:
+                frame = self.cond_stack[-1]
+                frame[frame["branch"]].append((node, attr))
+        elif isinstance(node.func, ast.Name) and node.func.id in _COLLECTIVE_NAMES and self.cond_stack:
+            frame = self.cond_stack[-1]
+            frame[frame["branch"]].append((node, node.func.id))
+
+        if dotted is not None:
+            if dotted in _COERCION_SINKS:
+                target = arg_states[0] if arg_states else UNKNOWN
+                if target == TRACED and self._sync_active():
+                    self._report(
+                        "TPL101",
+                        node,
+                        f"{dotted}() coerces a traced value on the host: `{_truncate(node)}` "
+                        "— use jnp casts/masking to stay on device until .compute().",
+                    )
+                return HOST
+            if dotted == "range":
+                if any_traced and self._sync_active():
+                    self._report(
+                        "TPL102",
+                        node,
+                        f"range() over a traced value makes loop bounds data-dependent "
+                        f"(host sync): `{_truncate(node)}`",
+                    )
+                return HOST
+            if dotted in _PY_TRUTH_SINKS:
+                if any_traced and self._sync_active():
+                    self._report(
+                        "TPL102",
+                        node,
+                        f"python {dotted}() truth-tests/compares a traced array on the "
+                        f"host: `{_truncate(node)}` — use the jnp.{dotted.rstrip('ed')} "
+                        "equivalent to stay on device.",
+                    )
+                return UNKNOWN
+            if dotted.startswith("numpy.") and any_payload:
+                if self._sync_active():
+                    self._report(
+                        "TPL101",
+                        node,
+                        f"numpy call on a traced value pulls it to the host: "
+                        f"`{_truncate(node)}` — use the jnp equivalent.",
+                    )
+                return UNKNOWN
+            if dotted in ("jax.device_get", "jax.block_until_ready"):
+                if any_payload and self._sync_active():
+                    self._report(
+                        "TPL101",
+                        node,
+                        f"{dotted} in update()-reachable code is an explicit host sync: "
+                        f"`{_truncate(node)}`",
+                    )
+                return HOST
+            if any(dotted.startswith(p) for p in _TRACED_CALL_PREFIXES):
+                if dotted.rpartition(".")[2] in _STATIC_JNP_FUNCS:
+                    return HOST  # dtype/shape introspection: static under trace
+                return TRACED
+            if dotted in _HOST_NEUTRAL_CALLS:
+                return UNKNOWN
+
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _DICTISH_METHODS:
+            return UNKNOWN  # dict-protocol methods: host bookkeeping, not payload
+        if recv_state == TRACED:
+            return TRACED  # method on a traced value (.sum(), .astype(), .reshape(), …)
+        if any_payload:
+            return TRACED  # taint through unknown callees: conservative
+        return UNKNOWN
+
+
+def _list_state_names(ci: ClassInfo, index: PackageIndex) -> Set[str]:
+    """States declared with an empty-list default anywhere in the hierarchy
+    (their truthiness/len is host-side; their *elements* are traced)."""
+    names: Set[str] = set()
+    for rel in [ci] + index._ancestors(ci) + index._descendants(ci):
+        for call, method in rel.add_state_calls:
+            default = _default_arg(call)
+            if isinstance(default, ast.List):
+                names |= _state_names_of_call(rel, call, method)
+    return names
+
+
+def _state_names_of_call(ci: ClassInfo, call: ast.Call, method_name: str) -> Set[str]:
+    from tpumetrics.analysis.core import _literal_state_names
+
+    meth = ci.methods.get(method_name)
+    scope = meth.node if meth is not None else ci.node
+    return _literal_state_names(call, scope)
+
+
+def _default_arg(call: ast.Call) -> Optional[ast.expr]:
+    if len(call.args) >= 2:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "default":
+            return kw.value
+    return None
+
+
+def _reduce_arg(call: ast.Call) -> Tuple[bool, Optional[ast.expr]]:
+    """(explicitly_given, expr) for dist_reduce_fx; omitted means None."""
+    if len(call.args) >= 3:
+        return True, call.args[2]
+    for kw in call.keywords:
+        if kw.arg == "dist_reduce_fx":
+            return True, kw.value
+    return False, None
+
+
+# default-expression classification for TPL301/TPL303
+def _default_kind(expr: Optional[ast.expr], mod: ModuleInfo) -> str:
+    """One of: zero / posinf / neginf / nonzero / empty_list / nonempty_list /
+    array_unknown (an array-producing call of undecidable value) / unknown."""
+    if expr is None:
+        return "unknown"
+    if isinstance(expr, ast.List):
+        return "empty_list" if not expr.elts else "nonempty_list"
+    if isinstance(expr, ast.Constant):
+        v = expr.value
+        if isinstance(v, bool):
+            return "nonzero" if v else "zero"
+        if isinstance(v, (int, float, complex)):
+            if v == 0:
+                return "zero"
+            if isinstance(v, float) and v == float("inf"):
+                return "posinf"
+            if isinstance(v, float) and v == float("-inf"):
+                return "neginf"
+            return "nonzero"
+        return "unknown"
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.USub):
+        inner = _default_kind(expr.operand, mod)
+        return {"posinf": "neginf", "neginf": "posinf", "zero": "zero", "nonzero": "nonzero"}.get(
+            inner, inner
+        )
+    if isinstance(expr, ast.Attribute) or isinstance(expr, ast.Name):
+        dotted = _dotted_name(expr, mod) or ""
+        if dotted.endswith(".inf") or dotted in ("inf", "Inf"):
+            return "posinf"
+        return "unknown"
+    if isinstance(expr, ast.Call):
+        dotted = _dotted_name(expr.func, mod) or ""
+        tail = dotted.rpartition(".")[2]
+        if tail in ("zeros", "zeros_like"):
+            return "zero"
+        if tail in ("ones", "ones_like"):
+            return "nonzero"
+        if tail in ("asarray", "array", "tensor"):
+            inner = _default_kind(expr.args[0] if expr.args else None, mod)
+            return inner if inner != "unknown" else "array_unknown"
+        if tail == "full":
+            inner = _default_kind(expr.args[1] if len(expr.args) >= 2 else None, mod)
+            return inner if inner != "unknown" else "array_unknown"
+        if dotted in ("float", "int") and expr.args and isinstance(expr.args[0], ast.Constant):
+            v = expr.args[0].value
+            if v in ("inf", "Inf", "+inf"):
+                return "posinf"
+            if v == "-inf":
+                return "neginf"
+            inner = _default_kind(expr.args[0], mod)
+            return inner
+        if tail in ("eye", "arange", "linspace", "full_like"):
+            return "array_unknown"
+        return "unknown"
+    return "unknown"
+
+
+class TraceSafetyRule:
+    """TPL101 / TPL102 on update()-reachable code; TPL201 everywhere."""
+
+    codes = ("TPL101", "TPL102", "TPL201")
+
+    def check(self, mod: ModuleInfo, index: PackageIndex) -> Iterator[Finding]:
+        funcs: List[FuncInfo] = list(mod.functions.values())
+        for ci in mod.classes.values():
+            funcs.extend(ci.methods.values())
+        for fi in funcs:
+            walker = _TraceWalker(mod, index, fi, check_sync=index.is_update_reachable(fi.node))
+            yield from walker.run()
+
+
+class StateDeclRule:
+    """TPL301 (defaults vs reduce), TPL302 (in-place mutation), TPL303
+    (reduce-None arrays) — all anchored at the declaring class."""
+
+    codes = ("TPL301", "TPL302", "TPL303")
+
+    _EXPECTED = {
+        "sum": ("zero",),
+        "min": ("posinf",),
+        "max": ("neginf",),
+    }
+
+    def check(self, mod: ModuleInfo, index: PackageIndex) -> Iterator[Finding]:
+        for ci in mod.classes.values():
+            yield from self._check_declarations(mod, ci)
+            yield from self._check_mutations(mod, ci, index)
+
+    def _check_declarations(self, mod: ModuleInfo, ci: ClassInfo) -> Iterator[Finding]:
+        for call, method in ci.add_state_calls:
+            names = _state_names_of_call(ci, call, method) or {"<dynamic>"}
+            label = "/".join(sorted(names))
+            default = _default_arg(call)
+            kind = _default_kind(default, mod)
+            explicit, reduce_expr = _reduce_arg(call)
+            if explicit and not isinstance(reduce_expr, ast.Constant):
+                continue  # dynamic reduce (variable / custom callable) — undecidable here
+            reduce_val = reduce_expr.value if isinstance(reduce_expr, ast.Constant) else None
+            reduce_lit = reduce_val if isinstance(reduce_val, str) else None
+            is_none = reduce_val is None  # explicit None or omitted (the signature default)
+            if reduce_lit in self._EXPECTED:
+                expected = self._EXPECTED[reduce_lit]
+                if kind not in expected and kind not in ("unknown", "array_unknown", "empty_list"):
+                    ident = {"zero": "0", "posinf": "+inf", "neginf": "-inf"}[expected[0]]
+                    yield Finding(
+                        "TPL301",
+                        f"state '{label}' uses dist_reduce_fx='{reduce_lit}' but its default "
+                        f"is not the reduce identity ({ident}): a rank that never updated "
+                        "would contribute a wrong value to the cross-rank fold.",
+                        mod.path, call.lineno, call.col_offset, symbol=f"{ci.name}.{method}",
+                    )
+            elif reduce_lit == "cat" and kind == "nonempty_list":
+                yield Finding(
+                    "TPL301",
+                    f"state '{label}' uses dist_reduce_fx='cat' with a non-empty default: "
+                    "pre-seeded rows are concatenated again on every reset/sync cycle.",
+                    mod.path, call.lineno, call.col_offset, symbol=f"{ci.name}.{method}",
+                )
+            elif is_none and kind in ("zero", "nonzero", "posinf", "neginf", "array_unknown"):
+                yield Finding(
+                    "TPL303",
+                    f"array state '{label}' has dist_reduce_fx=None: its global form is a "
+                    "per-rank stack with no world-size-independent meaning, so "
+                    "parallel/merge.py cannot fold it and elastic restore refuses it. "
+                    "Declare 'sum'/'mean'/'max'/'min'/'cat', or make it a list state.",
+                    mod.path, call.lineno, call.col_offset, symbol=f"{ci.name}.{method}",
+                )
+
+    def _check_mutations(self, mod: ModuleInfo, ci: ClassInfo, index: PackageIndex) -> Iterator[Finding]:
+        states = index.broad_state_names(ci) if index.is_metric_like(ci) else ci.state_names
+        if not states:
+            return
+        for name, fi in ci.methods.items():
+            for n in ast.walk(fi.node):
+                if isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        attr = _self_state_subscript(t, states)
+                        if attr is not None:
+                            yield Finding(
+                                "TPL302",
+                                f"in-place subscript store into state '{attr}': jax arrays "
+                                "are immutable — reassign via "
+                                f"`self.{attr} = self.{attr}.at[...].set(...)`.",
+                                mod.path, n.lineno, n.col_offset, symbol=f"{ci.name}.{name}",
+                            )
+                elif isinstance(n, ast.Expr):
+                    attr = _discarded_functional_update(n.value, states)
+                    if attr is not None:
+                        yield Finding(
+                            "TPL302",
+                            f"discarded `.at[...]` update on state '{attr}': the functional "
+                            "result is thrown away, the state never changes — assign it "
+                            f"back (`self.{attr} = self.{attr}.at[...]...`).",
+                            mod.path, n.lineno, n.col_offset, symbol=f"{ci.name}.{name}",
+                        )
+                    attr = _inplace_method_call(n.value, states)
+                    if attr is not None:
+                        yield Finding(
+                            "TPL302",
+                            f"in-place method call on state '{attr}': jax arrays are "
+                            "immutable and this either fails or silently no-ops — use the "
+                            "functional jnp equivalent and reassign.",
+                            mod.path, n.lineno, n.col_offset, symbol=f"{ci.name}.{name}",
+                        )
+
+
+def _self_state_attr(expr: ast.expr, states: Set[str]) -> Optional[str]:
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+        and expr.attr in states
+    ):
+        return expr.attr
+    return None
+
+
+def _self_state_subscript(target: ast.expr, states: Set[str]) -> Optional[str]:
+    if isinstance(target, ast.Subscript):
+        return _self_state_attr(target.value, states)
+    return None
+
+
+def _discarded_functional_update(expr: ast.expr, states: Set[str]) -> Optional[str]:
+    """Match `self.<state>.at[...].set/add/...(…)` used as a bare statement."""
+    if not isinstance(expr, ast.Call):
+        return None
+    f = expr.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    sub = f.value  # the `.at[...]` subscript
+    if isinstance(sub, ast.Subscript) and isinstance(sub.value, ast.Attribute) and sub.value.attr == "at":
+        return _self_state_attr(sub.value.value, states)
+    return None
+
+
+def _inplace_method_call(expr: ast.expr, states: Set[str]) -> Optional[str]:
+    if (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Attribute)
+        and expr.func.attr in _INPLACE_METHODS
+    ):
+        return _self_state_attr(expr.func.value, states)
+    return None
+
+
+class ShadowStateRule:
+    """TPL401: stores to undeclared ``self.<attr>`` in update()-reachable code."""
+
+    codes = ("TPL401",)
+
+    def check(self, mod: ModuleInfo, index: PackageIndex) -> Iterator[Finding]:
+        for ci in mod.classes.values():
+            if not index.is_metric_like(ci):
+                continue
+            if self._has_dynamic_state_decl(ci, index):
+                # a hierarchy declaring states under computed names (e.g.
+                # BaseAggregator's add_state(state_name, …)) has an open
+                # state set — "undeclared" cannot be proven, so stay quiet
+                continue
+            allowed = (
+                index.broad_state_names(ci)
+                | index.declared_attr_names(ci)
+                | _WELL_KNOWN_BASE_ATTRS
+            )
+            for name, fi in ci.methods.items():
+                if not index.is_update_reachable(fi.node):
+                    continue
+                for n in ast.walk(fi.node):
+                    targets: List[ast.expr] = []
+                    if isinstance(n, ast.Assign):
+                        targets = list(n.targets)
+                    elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                        targets = [n.target]
+                    for t in targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                            and t.attr not in allowed
+                        ):
+                            yield Finding(
+                                "TPL401",
+                                f"'self.{t.attr}' is assigned in update()-reachable code but "
+                                "never declared via add_state: it is invisible to reset(), "
+                                "snapshots, cross-rank sync, and elastic fold/reshard — "
+                                "declare it with add_state or move it out of the update path.",
+                                mod.path, t.lineno, t.col_offset, symbol=f"{ci.name}.{name}",
+                            )
+
+    @staticmethod
+    def _has_dynamic_state_decl(ci: ClassInfo, index: PackageIndex) -> bool:
+        for rel in [ci] + index._ancestors(ci) + index._descendants(ci):
+            for call, method in rel.add_state_calls:
+                if not _state_names_of_call(rel, call, method):
+                    return True
+        return False
+
+
+RULES = [TraceSafetyRule(), StateDeclRule(), ShadowStateRule()]

@@ -93,6 +93,7 @@ class Dice(Metric):
 
     def update(self, preds: Array, target: Array) -> None:
         preds_oh, target_oh, n_cls = _dice_format(preds, target, self.threshold, self.top_k, self.num_classes)
+        # tpulint: disable-next=TPL102 -- n_cls is a host int from the eager-only dice format helper; Dice is eager-only by reference contract
         if self.ignore_index is not None and 0 <= self.ignore_index < n_cls:
             keep = jnp.ones(n_cls).at[self.ignore_index].set(0.0).astype(jnp.int32)
             preds_oh = preds_oh * keep

@@ -30,7 +30,7 @@ def _find_best_perm_by_linear_sum_assignment(
     speaker counts where the exhaustive O(spk!) search explodes."""
     from scipy.optimize import linear_sum_assignment
 
-    mmtx = np.asarray(metric_mtx)
+    mmtx = np.asarray(metric_mtx)  # tpulint: disable=TPL101 -- scipy linear_sum_assignment runs on host; this PIT search path is eager-only by design
     best_perm = np.asarray([linear_sum_assignment(pwm, eval_func == "max")[1] for pwm in mmtx])
     best_perm_j = jnp.asarray(best_perm)
     best_metric = jnp.take_along_axis(metric_mtx, best_perm_j[:, :, None], axis=2).mean(axis=(-1, -2))

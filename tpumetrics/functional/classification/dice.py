@@ -54,6 +54,7 @@ def _dice_format(
     # integer labels
     preds_lab = preds.ravel().astype(jnp.int32)
     target_lab = target.ravel().astype(jnp.int32)
+    # tpulint: disable-next=TPL101 -- data-dependent class-count inference when num_classes is omitted; dice keeps the reference's eager-only semantics
     n_cls = num_classes if num_classes is not None else int(jnp.max(jnp.maximum(preds_lab, target_lab))) + 1
     return (
         jax.nn.one_hot(preds_lab, n_cls, dtype=jnp.int32),

@@ -80,7 +80,7 @@ def _prepocess_inputs(
 ) -> np.ndarray:
     """Flatten spatial dims, zero stuff instance ids, map unknown categories
     to void (reference :175-211). Returns a host (B, P, 2) int array."""
-    out = np.asarray(jax.device_get(inputs)).copy()
+    out = np.asarray(jax.device_get(inputs)).copy()  # tpulint: disable=TPL101 -- panoptic matching is a host-numpy algorithm by design (documented: returns a host array)
     out = out.reshape(out.shape[0], -1, 2)
     cats = out[:, :, 0]
     mask_stuffs = np.isin(cats, list(stuffs))

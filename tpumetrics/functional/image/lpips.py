@@ -145,8 +145,8 @@ def learned_perceptual_image_patch_similarity(
         img1 = 2 * img1 - 1
         img2 = 2 * img2 - 1
 
-    feats1 = net(_scaling_layer(img1))
-    feats2 = net(_scaling_layer(img2))
+    feats1: List[Array] = net(_scaling_layer(img1))
+    feats2: List[Array] = net(_scaling_layer(img2))
     if len(feats1) != len(feats2):
         raise ValueError("Backbone returned different numbers of feature maps for the two inputs")
 

@@ -92,7 +92,7 @@ def clip_image_quality_assessment(
     if images.ndim != 4:
         raise ValueError(f"Expected 4D (N, C, H, W) image input but got {images.shape}")
 
-    processed = processor(images=list(jax.device_get(images)), return_tensors="np")
+    processed = processor(images=list(jax.device_get(images)), return_tensors="np")  # tpulint: disable=TPL101 -- HF CLIP preprocessing is a host pipeline; eager-only by design
     img_features = jnp.asarray(model.get_image_features(jnp.asarray(processed["pixel_values"])))
     img_features = img_features / jnp.linalg.norm(img_features, axis=-1, keepdims=True)
     if text_features is not None:

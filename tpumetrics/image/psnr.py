@@ -66,8 +66,13 @@ class PeakSignalNoiseRatio(Metric):
             if dim is not None:
                 raise ValueError("The `data_range` must be given when `dim` is not None.")
             self.data_range = None
-            self.add_state("min_target", default=jnp.zeros(()), dist_reduce_fx="min")
-            self.add_state("max_target", default=jnp.zeros(()), dist_reduce_fx="max")
+            # reduce-identity defaults (tpulint TPL301): a rank that never
+            # updated must not drag the tracked range toward 0 in the fold.
+            # Deliberate reference divergence: torchmetrics' zero defaults
+            # anchor the tracked range at 0, so data not spanning 0 (e.g.
+            # targets in [10, 255]) gets range max-0 there and max-min here
+            self.add_state("min_target", default=jnp.asarray(jnp.inf), dist_reduce_fx="min")
+            self.add_state("max_target", default=jnp.asarray(-jnp.inf), dist_reduce_fx="max")
         elif isinstance(data_range, tuple):
             self.add_state("data_range", default=jnp.asarray(data_range[1] - data_range[0]), dist_reduce_fx="mean")
             self.clamping_fn = lambda x: jnp.clip(x, data_range[0], data_range[1])

@@ -39,7 +39,8 @@ class PeakSignalNoiseRatioWithBlockedEffect(Metric):
         self.add_state("sum_squared_error", default=jnp.zeros(()), dist_reduce_fx="sum")
         self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
         self.add_state("bef", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("data_range", default=jnp.zeros(()), dist_reduce_fx="max")
+        # reduce identity for max (tpulint TPL301); first update overwrites it
+        self.add_state("data_range", default=jnp.asarray(-jnp.inf), dist_reduce_fx="max")
 
     def update(self, preds: Array, target: Array) -> None:
         """Accumulate squared error, blocked effect, and observed range."""

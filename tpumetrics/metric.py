@@ -227,6 +227,16 @@ class Metric(ABC):
         replacement for the reference's pad-gather-trim,
         utilities/distributed.py:135-147). The eager OO path keeps exact
         Python-list behavior.
+
+        **Declaration contract** (checked statically by tpulint): the
+        default must be the reduce identity — zero for ``"sum"``, ``+inf``
+        for ``"min"``, ``-inf`` for ``"max"``, an empty list for ``"cat"``
+        (TPL301) — otherwise a rank that never updated contributes a wrong
+        value to the cross-rank fold.  Array states with
+        ``dist_reduce_fx=None`` gather into per-rank stacks that
+        ``parallel/merge.py`` can neither fold nor elastically reshard
+        (TPL303).  Update states by **reassignment** (jax arrays are
+        immutable; a discarded ``.at[...]`` result silently no-ops, TPL302).
         """
         if not name.isidentifier():
             raise ValueError(f"Argument `name` must be a valid python identifier, got {name!r}")
@@ -706,7 +716,24 @@ class Metric(ABC):
 
     @abstractmethod
     def update(self, *_: Any, **__: Any) -> None:
-        """Override to update the metric state (reference metric.py:621)."""
+        """Override to update the metric state (reference metric.py:621).
+
+        **Trace-safety contract** (checked statically by
+        ``python -m tpumetrics.analysis`` — "tpulint"): code reachable from
+        ``update()`` must not force a host sync before :meth:`compute` —
+        no ``.item()``/``.tolist()``/``float()``/``int()``/``bool()``/
+        ``len()``/``np.asarray`` on traced values (TPL101) and no Python
+        ``if``/``while``/``assert`` branching on them (TPL102); use
+        ``jnp.where``/``lax.cond``/masking instead.  Every accumulator
+        assigned here must be declared via :meth:`add_state` — an
+        undeclared ``self.<attr>`` (TPL401) is invisible to :meth:`reset`,
+        snapshots, cross-rank sync, and elastic fold/reshard.  Collectives
+        must not be reachable on only one branch of a rank- or
+        data-dependent conditional (TPL201).  Deliberately eager code is
+        exempt behind the recognized guard idiom
+        (``if isinstance(x, jax.core.Tracer): return`` or an
+        ``is_traced``-named predicate) or an inline
+        ``# tpulint: disable=CODE -- why`` suppression."""
 
     @abstractmethod
     def compute(self) -> Any:
@@ -1163,7 +1190,9 @@ class Metric(ABC):
             raise TPUMetricsUserError("fold_snapshot_states needs at least one rank payload")
         for snap in payloads:
             self._validate_snapshot_payload(snap, strict=strict)
-        merged = merge_metric_states([dict(p["states"]) for p in payloads], self._reductions)
+        merged = merge_metric_states(
+            [dict(p["states"]) for p in payloads], self._reductions, owner=type(self).__name__
+        )
         return {
             "states": merged,
             "update_count": int(sum(int(p.get("update_count", 0)) for p in payloads)),
@@ -1190,7 +1219,7 @@ class Metric(ABC):
 
         states = reshard_metric_states(
             dict(snap["states"]), self._reductions, rank, world_size,
-            cat_placement=cat_placement,
+            cat_placement=cat_placement, owner=type(self).__name__,
         )
         total = int(snap.get("update_count", 0))
         base, extra = divmod(total, world_size)
@@ -1208,7 +1237,7 @@ class Metric(ABC):
 
         if not states:
             raise TPUMetricsUserError("fold_state_dicts needs at least one rank state")
-        return merge_metric_states(list(states), self._reductions)
+        return merge_metric_states(list(states), self._reductions, owner=type(self).__name__)
 
     def reshard_state_dict(
         self,
@@ -1226,6 +1255,7 @@ class Metric(ABC):
         return reshard_metric_states(
             dict(state), self._reductions, rank, world_size,
             templates=self.init_state(), cat_placement=cat_placement,
+            owner=type(self).__name__,
         )
 
     # ------------------------------------------------------------ dev / dtype

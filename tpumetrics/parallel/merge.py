@@ -29,15 +29,26 @@ from tpumetrics.utils.data import (
 )
 
 
+def _state_label(owner: Optional[str], name: str) -> str:
+    """``MetricClass.state`` when the owning metric class is known, else the
+    bare state name — typed merge/reshard errors carry it so a runtime
+    failure cross-references the analyzer's finding for the same state
+    (tpulint TPL303 names the class and state too)."""
+    return f"{owner}.{name}" if owner else name
+
+
 def merge_metric_states(
-    states: List[Dict[str, Any]], reductions: Dict[str, Optional[Union[str, Callable]]]
+    states: List[Dict[str, Any]],
+    reductions: Dict[str, Optional[Union[str, Callable]]],
+    owner: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Merge per-rank state dicts into one global state per each state's reduce op.
 
     ``reductions`` maps state name → registered reduce function (as stored in
     ``Metric._reductions``). List states are concatenated; ``None`` states are
     stacked along a new leading rank axis, matching the reference's gather
-    semantics.
+    semantics.  ``owner`` (the metric class name) is only used to label
+    errors.
     """
     from tpumetrics.buffers import MaskedBuffer, buffer_merge
 
@@ -66,7 +77,9 @@ def merge_metric_states(
         elif callable(reduction_fn):
             out[name] = reduction_fn(jnp.stack(vals))
         else:
-            raise TypeError(f"reduction for state {name!r} must be callable or None")
+            raise TypeError(
+                f"reduction for state {_state_label(owner, name)!r} must be callable or None"
+            )
     return out
 
 
@@ -88,7 +101,7 @@ def _placement_slice(n_rows: int, rank: int, world_size: int, cat_placement: str
 
 
 def _reshard_buffer(
-    buf: Any, rank: int, world_size: int, template: Any, cat_placement: str
+    buf: Any, rank: int, world_size: int, template: Any, cat_placement: str, label: str
 ) -> Any:
     """Split a folded :class:`MaskedBuffer` back into rank ``rank``'s
     per-rank-capacity buffer.  Overflow (more placed rows than the per-rank
@@ -102,8 +115,9 @@ def _reshard_buffer(
     capacity = int(template.values.shape[0])
     if int(mine.shape[0]) > capacity:
         raise TPUMetricsUserError(
-            f"Elastic reshard would place {int(mine.shape[0])} buffer rows on rank {rank} "
-            f"but the per-rank capacity is {capacity}; refusing to drop restored rows. "
+            f"Elastic reshard of buffer state {label!r} would place {int(mine.shape[0])} "
+            f"rows on rank {rank} but the per-rank capacity is {capacity}; refusing to "
+            "drop restored rows. "
             "HINT: use cat_placement='balanced' to spread rows across ranks, or raise "
             "the state's declared capacity before restoring."
         )
@@ -127,6 +141,7 @@ def reshard_metric_states(
     world_size: int,
     templates: Optional[Dict[str, Any]] = None,
     cat_placement: str = "rank0",
+    owner: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Split one canonical global state into rank ``rank``'s share of a
     ``world_size``-rank world (the elastic-restore inverse of
@@ -153,6 +168,9 @@ def reshard_metric_states(
 
     ``templates`` supplies per-rank default leaves where the global value
     alone cannot determine the per-rank shape (MaskedBuffer capacities).
+    ``owner`` (the metric class name) labels errors as ``Class.state`` so
+    runtime reshard failures cross-reference the static analyzer's findings
+    (tpulint TPL303 flags the same states at review time).
     """
     from tpumetrics.buffers import MaskedBuffer
     from tpumetrics.utils.exceptions import TPUMetricsUserError
@@ -163,15 +181,16 @@ def reshard_metric_states(
         raise ValueError(f"cat_placement must be 'rank0' or 'balanced', got {cat_placement!r}")
     out: Dict[str, Any] = {}
     for name, reduction_fn in reductions.items():
+        label = _state_label(owner, name)
         val = global_state[name]
         if isinstance(val, MaskedBuffer):
             template = (templates or {}).get(name)
             if not isinstance(template, MaskedBuffer):
                 raise TPUMetricsUserError(
-                    f"Resharding buffer state {name!r} needs a MaskedBuffer template "
+                    f"Resharding buffer state {label!r} needs a MaskedBuffer template "
                     "(per-rank capacity); pass templates=metric.init_state()."
                 )
-            out[name] = _reshard_buffer(val, rank, world_size, template, cat_placement)
+            out[name] = _reshard_buffer(val, rank, world_size, template, cat_placement, label)
             continue
         if isinstance(val, list):
             if reduction_fn is None:
@@ -199,16 +218,17 @@ def reshard_metric_states(
             out[name] = rows[_placement_slice(int(rows.shape[0]), rank, world_size, cat_placement)]
         elif reduction_fn is None:
             raise TPUMetricsUserError(
-                f"State {name!r} uses gather (dist_reduce_fx=None) semantics on an array: "
+                f"State {label!r} uses gather (dist_reduce_fx=None) semantics on an array: "
                 "its global form is a per-rank stack with no world-size-independent "
-                "meaning, so it cannot be resharded elastically."
+                "meaning, so it cannot be resharded elastically (the static analyzer "
+                "flags these declarations as TPL303)."
             )
         elif callable(reduction_fn):
             raise TPUMetricsUserError(
-                f"State {name!r} uses a custom reduce function; elastic resharding has "
+                f"State {label!r} uses a custom reduce function; elastic resharding has "
                 "no generic inverse for it. Register the state with one of "
                 "'sum'/'mean'/'max'/'min'/'cat' to make it elastic-restorable."
             )
         else:
-            raise TypeError(f"reduction for state {name!r} must be callable or None")
+            raise TypeError(f"reduction for state {label!r} must be callable or None")
     return out

@@ -54,6 +54,7 @@ class PearsonCorrCoef(Metric):
             raise ValueError(f"Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
         self.num_outputs = num_outputs
         for name in ("mean_x", "mean_y", "var_x", "var_y", "corr_xy", "n_total"):
+            # tpulint: disable-next=TPL303 -- per-rank stacks are folded by the reference's _final_aggregation in compute(); documented not elastic-reshardable (merge.py raises typed)
             self.add_state(name, jnp.zeros(self.num_outputs), dist_reduce_fx=None)
 
     def update(self, preds: Array, target: Array) -> None:
