@@ -17,6 +17,8 @@ def test_floor_file_shape():
         "fid_stream_update",
         "lpips_stream_update",
         "bertscore_ddp_eval",
+        "fused_collection_update",
+        "compile_cache_cold_warm",
         "streaming_throughput",
         "resilience_overhead",
         "elastic_restore",
@@ -39,6 +41,13 @@ def test_floor_file_shape():
     # unsuppressed-findings count to exactly zero (never raise that one)
     assert data["analysis_runtime_ceilings"]["analysis_wall_ms"] > 0
     assert data["analysis_runtime_ceilings"]["findings_unsuppressed"] == 0
+    # the whole-collection fused step must beat sequential dispatch >= 1.5x
+    # (ISSUE 6 acceptance) and the persistent-cache warm process must pay
+    # at most half the cold process's XLA compile seconds
+    assert data["floors"]["fused_collection_update"] >= 1.5
+    assert data["compile_cache_ceilings"]["warm_cold_compile_ratio"] <= 0.5
+    # the raised mAP floor pins the batched-matcher win (was 2.9 pre-batching)
+    assert data["floors"]["map_ragged_update_compute"] >= 8.0
 
 
 def test_check_floors_flags_compile_regressions():
@@ -107,6 +116,27 @@ def test_check_floors_flags_elastic_restore_regressions():
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and all("elastic_restore" in v for v in violations)
     details["elastic_restore"] = "error: RuntimeError: boom"
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and "scenario failed" in violations[0]
+
+
+def test_check_floors_flags_compile_cache_regressions():
+    """A warm process paying more than half the cold process's XLA compile
+    seconds (cache silently disabled, keys no longer stable across
+    processes) must trip the gate even at a healthy wall ratio; an errored
+    scenario (the bit-identical-resume assert raising) trips it too."""
+    details = {
+        "compile_cache_cold_warm": {"vs_baseline": 1.7, "warm_cold_compile_ratio": 0.97}
+    }
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("warm_cold_compile_ratio" in v for v in violations)
+    details["compile_cache_cold_warm"]["warm_cold_compile_ratio"] = 0.03
+    assert bench._check_floors(headline_vs=1000.0, details=details) == []
+    # below the wall-ratio floor: warm restart got slower than cold overall
+    details["compile_cache_cold_warm"]["vs_baseline"] = 0.2
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("compile_cache_cold_warm" in v for v in violations)
+    details["compile_cache_cold_warm"] = "error: AssertionError: resume diverged"
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and "scenario failed" in violations[0]
 

@@ -1,0 +1,655 @@
+"""Whole-collection fused update (tpumetrics.parallel.fuse_update).
+
+The acceptance surface of ISSUE 6's tentpole: a MetricCollection step must
+be ONE donated-state XLA program per (collection, trace signature) — never
+one per member metric — and the fused path must be value-identical to the
+sequential per-metric path across the metric families (compute groups, a
+MaskedBuffer list-state metric, int-state metrics), mirroring the family
+sweep pattern of tests/test_elastic.py.  Donation is a real contract here:
+after a fused step the input state buffers are DELETED, so the tests also
+pin who may (the step) and may not (the caller, the stored defaults) hold
+them.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics import MetricCollection
+from tpumetrics.aggregation import MeanMetric, SumMetric
+from tpumetrics.classification import (
+    MulticlassAccuracy,
+    MulticlassAUROC,
+    MulticlassCalibrationError,
+    MulticlassCohenKappa,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MulticlassSpecificity,
+    MulticlassStatScores,
+)
+from tpumetrics.image import PeakSignalNoiseRatio
+from tpumetrics.metric import Metric
+from tpumetrics.parallel import FusedCollectionStep, UnhashableKwargsError
+from tpumetrics.parallel.fuse_update import fusable_oo_leaders, gather_donatable_state
+from tpumetrics.regression import MeanAbsoluteError, MeanSquaredError
+from tpumetrics.text import BLEUScore
+from tpumetrics.utils.data import dim_zero_cat
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+
+def _class_stream(rng, n_batches, num_classes=5, max_rows=9):
+    out = []
+    for _ in range(n_batches):
+        n = int(rng.integers(1, max_rows))
+        out.append(
+            (
+                jnp.asarray(
+                    jax.nn.softmax(
+                        jnp.asarray(rng.standard_normal((n, num_classes), dtype=np.float32))
+                    )
+                ),
+                jnp.asarray(rng.integers(0, num_classes, n).astype(np.int32)),
+            )
+        )
+    return out
+
+
+def _parity(make, stream, exact=True):
+    """Eager-update an identical collection twice — fused_update=True vs
+    False — over the same stream; returns the two compute() dicts."""
+    fused_col, plain_col = make(), make()
+    fused_col._fused_update = True
+    for batch in stream:
+        fused_col.update(*batch)
+        plain_col.update(*batch)
+    got, want = fused_col.compute(), plain_col.compute()
+    assert set(got) == set(want)
+    for key, val in want.items():
+        if exact:
+            assert np.array_equal(np.asarray(got[key]), np.asarray(val)), key
+        else:
+            np.testing.assert_allclose(
+                np.asarray(got[key]), np.asarray(val), rtol=1e-6, atol=0, err_msg=key
+            )
+    return fused_col, plain_col
+
+
+class BufferCat(Metric):
+    """MaskedBuffer-capable eager list-state metric (the test_elastic shape)."""
+
+    full_state_update = False
+
+    def __init__(self, capacity=64, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("value", default=[], dist_reduce_fx="cat", capacity=capacity)
+
+    def update(self, x):
+        self._append_state("value", x)
+
+    def compute(self):
+        return dim_zero_cat(self.value)
+
+
+# ------------------------------------------------------ family parity sweep
+
+
+class TestFusedParityFamilies:
+    """fused_update=True vs the sequential per-leader path, per family."""
+
+    def test_classification_compute_groups_int_states_bit_exact(self):
+        # acc/f1/statscores share one statscores compute group (int states);
+        # the fused program must advance the group LEADER only, bit-exactly
+        rng = np.random.default_rng(0)
+        stream = _class_stream(rng, 6, num_classes=4)
+
+        def make():
+            return MetricCollection(
+                {
+                    "acc": MulticlassAccuracy(num_classes=4, average="micro", validate_args=False),
+                    "f1": MulticlassF1Score(num_classes=4, average="macro", validate_args=False),
+                    "stat": MulticlassStatScores(num_classes=4, average="macro", validate_args=False),
+                }
+            )
+
+        fused_col, plain_col = _parity(make, stream)
+        assert fused_col.compute_groups == plain_col.compute_groups
+        assert fused_col._fused_oo_step is not None
+        assert fused_col._fused_oo_step.program_count >= 1
+
+    def test_classification_float_states(self):
+        rng = np.random.default_rng(1)
+        stream = _class_stream(rng, 6, num_classes=4)
+
+        def make():
+            return MetricCollection(
+                {
+                    "auroc": MulticlassAUROC(num_classes=4, thresholds=16, validate_args=False),
+                    "cal": MulticlassCalibrationError(num_classes=4, n_bins=10, validate_args=False),
+                },
+                compute_groups=False,
+            )
+
+        _parity(make, stream)
+
+    def test_regression_and_image(self):
+        rng = np.random.default_rng(2)
+        stream = [
+            (
+                jnp.asarray(rng.uniform(0, 1, (2, 8, 8)).astype(np.float32)),
+                jnp.asarray(rng.uniform(0, 1, (2, 8, 8)).astype(np.float32)),
+            )
+            for _ in range(5)
+        ]
+
+        def make():
+            return MetricCollection(
+                {
+                    "mse": MeanSquaredError(),
+                    "mae": MeanAbsoluteError(),
+                    "psnr": PeakSignalNoiseRatio(data_range=1.0),
+                },
+                compute_groups=False,
+            )
+
+        _parity(make, stream)
+
+    def test_aggregation(self):
+        rng = np.random.default_rng(3)
+        stream = [
+            (jnp.asarray(rng.standard_normal(int(sz)).astype(np.float32)),)
+            for sz in rng.integers(1, 7, size=6)
+        ]
+
+        def make():
+            return MetricCollection(
+                {"mean": MeanMetric(), "sum": SumMetric()}, compute_groups=False
+            )
+
+        _parity(make, stream, exact=False)
+
+    def test_list_state_leader_stays_eager_in_mixed_collection(self):
+        # BufferCat's eager list state cannot round-trip a fixed-structure
+        # jitted transition: it must keep the per-leader eager path while
+        # the array-state members still fuse — values exact on both sides
+        rng = np.random.default_rng(4)
+        stream = [
+            (jnp.asarray(rng.standard_normal(int(sz)).astype(np.float32)),)
+            for sz in rng.integers(1, 6, size=6)
+        ]
+
+        def make():
+            return MetricCollection(
+                {"buf": BufferCat(), "sum": SumMetric()}, compute_groups=False
+            )
+
+        fused_col, _plain = _parity(make, stream, exact=False)
+        step = fused_col._fused_oo_step
+        assert step is not None
+        assert "sum" in step.leaders and "buf" not in step.leaders
+        # the list state really accumulated eagerly, once per batch
+        assert len(fused_col._modules["buf"].value) == len(stream)
+
+    def test_text_host_update_falls_back_fully_eager(self):
+        # BLEU's update consumes Python strings — untraceable, so the fused
+        # program can never run; the whole collection falls back to the
+        # eager path with identical results ("when not to fuse")
+        rng = np.random.default_rng(5)
+        vocab = ["the", "cat", "sat", "on", "a", "mat", "dog", "ran"]
+
+        def sentence():
+            return " ".join(rng.choice(vocab, size=int(rng.integers(3, 8))))
+
+        stream = [([sentence()], [[sentence(), sentence()]]) for _ in range(5)]
+
+        def make():
+            return MetricCollection({"bleu": BLEUScore(n_gram=2)}, compute_groups=False)
+
+        _parity(make, stream, exact=False)
+
+
+# ----------------------------------------------------------- donation rules
+
+
+class TestDonation:
+    def _metric(self):
+        return MulticlassStatScores(num_classes=3, average="micro", validate_args=False)
+
+    def test_donated_state_is_deleted_not_reused(self):
+        m = self._metric()
+        step = FusedCollectionStep(m, donate=True)
+        state = m.init_state()
+        rng = np.random.default_rng(0)
+        preds = jnp.asarray(rng.standard_normal((4, 3)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, 3, 4).astype(np.int32))
+        held = jax.tree_util.tree_leaves(state)
+        new_state = step.update(state, preds, target)
+        assert all(leaf.is_deleted() for leaf in held)
+        with pytest.raises(RuntimeError, match="deleted"):
+            _ = np.asarray(held[0])
+        # the NEW state is fully usable — ownership moved, nothing was lost
+        _ = jax.block_until_ready(jax.tree_util.tree_leaves(new_state))
+
+    def test_donate_false_keeps_inputs_alive(self):
+        m = self._metric()
+        step = FusedCollectionStep(m, donate=False)
+        state = m.init_state()
+        rng = np.random.default_rng(0)
+        preds = jnp.asarray(rng.standard_normal((4, 3)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, 3, 4).astype(np.int32))
+        held = jax.tree_util.tree_leaves(state)
+        step.update(state, preds, target)
+        assert not any(leaf.is_deleted() for leaf in held)
+
+    def test_gather_protects_stored_defaults(self):
+        # right after reset, attribute states ARE the stored defaults —
+        # donating them would poison every later reset/init_state, so
+        # gather must copy exactly those leaves
+        col = MetricCollection({"stat": self._metric()}, compute_groups=False)
+        col._fused_update = True
+        m = col._modules["stat"]
+        defaults = list(m._defaults.values())
+        rng = np.random.default_rng(0)
+        preds = jnp.asarray(rng.standard_normal((4, 3)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, 3, 4).astype(np.int32))
+        col.update(preds, target)  # establishes groups (eager first pass)
+        col.update(preds, target)  # fused pass: donates the gathered state
+        assert not any(
+            d.is_deleted() for d in defaults if isinstance(d, jax.Array)
+        )
+        col.reset()  # must still produce fresh usable state
+        col.update(preds, target)
+        _ = col.compute()
+
+    def test_gather_copies_duplicate_leaves(self):
+        # the same array object at two leaves cannot be donated twice
+        m1 = self._metric()
+        m2 = self._metric()
+        shared = jnp.ones((3,), jnp.int32)
+        object.__setattr__(m1, "tp", shared)
+        object.__setattr__(m2, "tp", shared)
+        state = gather_donatable_state({"a": m1, "b": m2}, ["a", "b"])
+        assert state["a"]["tp"] is not state["b"]["tp"]
+
+    def test_member_access_after_fused_update_survives_donation(self):
+        # compute() propagates leader arrays to group MEMBERS by alias; the
+        # next fused step must copy — not donate — those arrays, or member
+        # access (forward, col['r'], sync) reads deleted buffers
+        def make(fused):
+            return MetricCollection(
+                {
+                    "p": MulticlassPrecision(num_classes=3, average="micro", validate_args=False),
+                    "r": MulticlassRecall(num_classes=3, average="micro", validate_args=False),
+                },
+                fused_update=fused,
+            )
+
+        rng = np.random.default_rng(7)
+        preds = jnp.asarray(
+            jax.nn.softmax(jnp.asarray(rng.standard_normal((6, 3), dtype=np.float32)))
+        )
+        target = jnp.asarray(rng.integers(0, 3, 6).astype(np.int32))
+        fused_col, plain_col = make(True), make(False)
+        outs = []
+        for col in (fused_col, plain_col):
+            col.update(preds, target)  # eager: merges p+r into one group
+            col.update(preds, target)  # fused step on the fused collection
+            col.compute()  # aliases leader state into the member
+            col.update(preds, target)  # must not donate the aliased arrays
+            outs.append(col(preds, target))  # forward reads member state
+        got, want = outs
+        assert set(got) == set(want)
+        for key in want:
+            np.testing.assert_allclose(
+                np.asarray(got[key]), np.asarray(want[key]), rtol=1e-6, err_msg=key
+            )
+        for key, val in plain_col.compute().items():
+            np.testing.assert_allclose(
+                np.asarray(fused_col.compute()[key]), np.asarray(val), rtol=1e-6, err_msg=key
+            )
+
+    def test_concurrent_snapshot_during_donating_submits(self, tmp_path):
+        # snapshot()/compute() serialize the CURRENT state under the lock;
+        # the worker's donated step must hold the same lock across its
+        # read-dispatch-write, or a racing submit deletes the buffers a
+        # snapshot is still reading
+        import threading
+
+        from tpumetrics.runtime import StreamingEvaluator
+
+        errors = []
+        ev = StreamingEvaluator(SumMetric(), buckets=(4, 8), snapshot_dir=str(tmp_path))
+        with ev:
+            def produce():
+                try:
+                    for _ in range(60):
+                        ev.submit(jnp.ones(3, jnp.float32))
+                except BaseException as e:  # noqa: BLE001 — recorded for the assert
+                    errors.append(e)
+
+            t = threading.Thread(target=produce)
+            t.start()
+            for _ in range(10):
+                ev.snapshot()
+            t.join()
+            got = float(ev.compute())
+        assert not errors, errors
+        assert got == 180.0
+
+    def test_evaluator_snapshot_restore_with_donation(self, tmp_path):
+        # the donated bucketed path must still produce bit-identical
+        # kill-and-restore replays (snapshot reads the CURRENT state, never
+        # a donated input)
+        from tpumetrics.runtime import StreamingEvaluator
+
+        rng = np.random.default_rng(0)
+        stream = _class_stream(rng, 8, num_classes=3)
+
+        def make():
+            return MetricCollection(
+                {
+                    "acc": MulticlassAccuracy(num_classes=3, average="micro", validate_args=False),
+                    "stat": MulticlassStatScores(num_classes=3, average="macro", validate_args=False),
+                },
+                compute_groups=False,
+            )
+
+        ev = StreamingEvaluator(make(), buckets=16, snapshot_dir=str(tmp_path / "a"))
+        with ev:
+            for b in stream[:5]:
+                ev.submit(*b)
+            ev.flush()
+            held = jax.tree_util.tree_leaves(ev._state)
+            ev.submit(*stream[5])
+            ev.flush()
+            # the pre-step state was donated into the step: deleted, and the
+            # caller-held alias is unusable rather than silently reused
+            assert all(leaf.is_deleted() for leaf in held)
+            ev.snapshot()
+            for b in stream[6:]:
+                ev.submit(*b)
+            want = ev.compute()
+
+        ev2 = StreamingEvaluator(make(), buckets=16, snapshot_dir=str(tmp_path / "a"))
+        restored = ev2.restore_latest()
+        assert restored == 6  # batches replayed up to the snapshot
+        with ev2:
+            for b in stream[6:]:
+                ev2.submit(*b)
+            got = ev2.compute()
+        for key, val in want.items():
+            assert np.array_equal(np.asarray(got[key]), np.asarray(val)), key
+
+
+# ------------------------------------------------- one program per signature
+
+
+class TestOneProgramPerSignature:
+    def test_ten_metric_collection_compiles_per_signature_not_per_metric(self):
+        """ISSUE 6 acceptance: stats()['xla_compiles'] for a 10-metric
+        collection equals the per-signature count, and ONE fused program per
+        bucket exists for the whole collection."""
+        from tpumetrics.runtime import StreamingEvaluator
+
+        C = 6
+        mk = dict(num_classes=C, validate_args=False)
+        col = MetricCollection(
+            {
+                "acc_micro": MulticlassAccuracy(average="micro", **mk),
+                "acc_macro": MulticlassAccuracy(average="macro", **mk),
+                "prec": MulticlassPrecision(average="macro", **mk),
+                "rec": MulticlassRecall(average="macro", **mk),
+                "f1": MulticlassF1Score(average="macro", **mk),
+                "f1_micro": MulticlassF1Score(average="micro", **mk),
+                "spec": MulticlassSpecificity(average="macro", **mk),
+                "stat": MulticlassStatScores(average="macro", **mk),
+                "auroc": MulticlassAUROC(thresholds=16, **mk),
+                "kappa": MulticlassCohenKappa(**mk),
+            },
+            compute_groups=False,
+        )
+        assert len(col) == 10
+
+        rng = np.random.default_rng(0)
+        sizes = [3, 7, 3, 12, 7, 3, 12, 9]  # buckets 4, 8, 16 under pow2(16)
+        stream = []
+        for n in sizes:
+            stream.append(
+                (
+                    jnp.asarray(
+                        jax.nn.softmax(
+                            jnp.asarray(rng.standard_normal((n, C), dtype=np.float32))
+                        )
+                    ),
+                    jnp.asarray(rng.integers(0, C, n).astype(np.int32)),
+                )
+            )
+
+        ev = StreamingEvaluator(col, buckets=16)
+        with ev:
+            for b in stream:
+                ev.submit(*b)
+            got = ev.compute()
+            stats = ev.stats()
+
+        # padded signatures: one per touched bucket (9 and 12 share 16)
+        assert stats["xla_compiles"] == 3
+        # ONE fused program per bucket for the WHOLE collection — the
+        # pre-tentpole design held 10 metrics x 3 buckets = 30 programs
+        assert ev._step.program_count == 3
+
+        plain = MetricCollection(
+            {k: copy.deepcopy(v) for k, v in col._modules.items()}, compute_groups=False
+        )
+        ref_col = MetricCollection(
+            {
+                "acc_micro": MulticlassAccuracy(average="micro", **mk),
+                "acc_macro": MulticlassAccuracy(average="macro", **mk),
+                "prec": MulticlassPrecision(average="macro", **mk),
+                "rec": MulticlassRecall(average="macro", **mk),
+                "f1": MulticlassF1Score(average="macro", **mk),
+                "f1_micro": MulticlassF1Score(average="micro", **mk),
+                "spec": MulticlassSpecificity(average="macro", **mk),
+                "stat": MulticlassStatScores(average="macro", **mk),
+                "auroc": MulticlassAUROC(thresholds=16, **mk),
+                "kappa": MulticlassCohenKappa(**mk),
+            },
+            compute_groups=False,
+        )
+        del plain
+        for b in stream:
+            ref_col.update(*b)
+        want = ref_col.compute()
+        for key, val in want.items():
+            np.testing.assert_allclose(
+                np.asarray(got[key]), np.asarray(val), rtol=1e-5, atol=1e-6, err_msg=key
+            )
+
+    def test_masked_update_requires_full_collection(self):
+        col = MetricCollection(
+            {
+                "a": MulticlassAccuracy(num_classes=3, average="micro", validate_args=False),
+                "s": MulticlassStatScores(num_classes=3, average="macro", validate_args=False),
+            },
+            compute_groups=False,
+        )
+        col._compute_groups_create_state_ref(copy=False)
+        step = FusedCollectionStep(col, leaders=["a"])
+        with pytest.raises(TPUMetricsUserError, match="whole collection"):
+            step.masked_update({}, (), jnp.asarray(0, jnp.int32), 4)
+
+    def test_unknown_leader_rejected(self):
+        col = MetricCollection(
+            {"a": MulticlassAccuracy(num_classes=3, average="micro", validate_args=False)}
+        )
+        with pytest.raises(TPUMetricsUserError, match="Not compute-group leaders"):
+            FusedCollectionStep(col, leaders=["nope"])
+
+    def test_array_kwargs_fall_back_eager(self):
+        # array-valued kwargs cannot key a static program cache: the OO
+        # fused path must run that call eagerly, with correct results
+        rng = np.random.default_rng(0)
+
+        def make():
+            return MetricCollection({"mean": MeanMetric()}, compute_groups=False)
+
+        fused_col, plain_col = make(), make()
+        fused_col._fused_update = True
+        for _ in range(4):
+            value = jnp.asarray(rng.standard_normal(5).astype(np.float32))
+            weight = jnp.asarray(rng.uniform(0.5, 2.0, 5).astype(np.float32))
+            fused_col.update(value, weight=weight)
+            plain_col.update(value, weight=weight)
+        np.testing.assert_allclose(
+            np.asarray(fused_col.compute()["mean"]),
+            np.asarray(plain_col.compute()["mean"]),
+            rtol=1e-6,
+        )
+
+    def test_per_call_array_kwargs_raise_dedicated_error(self):
+        # the fall-back signal is a dedicated TypeError subclass so callers
+        # can't confuse it with a genuine TypeError (or a jax trace error)
+        m = MeanMetric()
+        step = FusedCollectionStep(m, donate=False)
+        with pytest.raises(UnhashableKwargsError, match="per-call"):
+            step.update(m.init_state(), jnp.ones(3), weight=jnp.ones(3))
+
+    def test_constructor_array_kwargs_closure_captured(self):
+        # the evaluator's update_kwargs= may be array-valued: fixed for the
+        # step's lifetime, they closure-capture into ONE program instead of
+        # raising (regression: the scalar submit path crashed on them while
+        # the bucketed masked path accepted them)
+        w = jnp.asarray([0.5, 2.0, 1.0], jnp.float32)
+        x = jnp.asarray([1.0, 2.0, 3.0], jnp.float32)
+        m = MeanMetric()
+        step = FusedCollectionStep(m, update_kwargs={"weight": w}, donate=False)
+        state = m.init_state()
+        for _ in range(3):
+            state = step.update(state, x)
+        assert step.program_count == 1
+        ref = MeanMetric()
+        for _ in range(3):
+            ref.update(x, weight=w)
+        np.testing.assert_allclose(
+            np.asarray(m.functional_compute(state)),
+            np.asarray(ref.compute()),
+            rtol=1e-6,
+        )
+
+    def test_trace_unsafe_member_raises_not_silent_eager(self):
+        # a member whose update branches on a traced value must surface
+        # jax's trace error through fused_update=True — a silent eager
+        # fallback would hide that every step re-traces and degrades
+        class HostBranch(Metric):
+            full_state_update = False
+
+            def __init__(self, **kwargs):
+                super().__init__(**kwargs)
+                self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+            def update(self, x):
+                if x.sum() > 0:  # host branch: fine eagerly, fatal in trace
+                    self.total = self.total + x.sum()
+
+            def compute(self):
+                return self.total
+
+        col = MetricCollection(
+            {"hb": HostBranch()}, compute_groups=False, fused_update=True
+        )
+        x = jnp.ones(4, jnp.float32)
+        col.update(x)  # first update is eager (establishes groups)
+        with pytest.raises(jax.errors.TracerBoolConversionError):
+            col.update(x)
+
+    def test_clone_of_fused_collection_rebuilds_its_own_step(self):
+        rng = np.random.default_rng(0)
+        stream = _class_stream(rng, 3, num_classes=3)
+        col = MetricCollection(
+            {"acc": MulticlassAccuracy(num_classes=3, average="micro", validate_args=False)},
+            fused_update=True,
+        )
+        for b in stream:
+            col.update(*b)
+        assert col._fused_oo_step is not None
+        clone = copy.deepcopy(col)
+        # the deep copy must NOT inherit programs closed over the original
+        # modules; it lazily builds its own
+        assert clone._fused_oo_step is None
+        for b in stream:
+            clone.update(*b)
+        assert np.array_equal(
+            np.asarray(clone.compute()["acc"]), np.asarray(col.compute()["acc"])
+        )
+
+
+# ------------------------------------------- batched compute-group merging
+
+
+class TestMergedGroupsBatched:
+    """Satellite: _merged_groups' pairwise comparisons now run on host after
+    ONE batched device fetch — assignment must be unchanged on the fixtures
+    the pairwise path produced."""
+
+    def _stream(self):
+        rng = np.random.default_rng(0)
+        return _class_stream(rng, 2, num_classes=4)
+
+    def test_group_assignment_unchanged_on_shared_state_fixture(self):
+        col = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=4, average="micro", validate_args=False),
+                "f1": MulticlassF1Score(num_classes=4, average="macro", validate_args=False),
+                "auroc": MulticlassAUROC(num_classes=4, thresholds=16, validate_args=False),
+            }
+        )
+        for b in self._stream():
+            col.update(*b)
+        groups = {frozenset(g) for g in col.compute_groups.values()}
+        # acc+f1 share the statscores state; auroc's thresholded state differs
+        assert groups == {frozenset({"acc", "f1"}), frozenset({"auroc"})}
+
+    def test_equal_host_states_matches_equal_metric_states(self):
+        m1 = MulticlassStatScores(num_classes=3, average="micro", validate_args=False)
+        m2 = MulticlassStatScores(num_classes=3, average="micro", validate_args=False)
+        m3 = MulticlassStatScores(num_classes=3, average="macro", validate_args=False)
+        rng = np.random.default_rng(1)
+        preds = jnp.asarray(rng.standard_normal((6, 3)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, 3, 6).astype(np.int32))
+        for m in (m1, m2, m3):
+            m.update(preds, target)
+        modules = {"m1": m1, "m2": m2, "m3": m3}
+        groups = {0: ["m1"], 1: ["m2"], 2: ["m3"]}
+        host = MetricCollection._leader_host_states(groups, modules)
+        for a in modules:
+            for b in modules:
+                assert MetricCollection._equal_host_states(host[a], host[b]) == (
+                    MetricCollection._equal_metric_states(modules[a], modules[b])
+                ), (a, b)
+
+    def test_batched_fetch_is_one_device_call(self, monkeypatch):
+        col = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=4, average="micro", validate_args=False),
+                "f1": MulticlassF1Score(num_classes=4, average="macro", validate_args=False),
+                "auroc": MulticlassAUROC(num_classes=4, thresholds=16, validate_args=False),
+            }
+        )
+        calls = []
+        real = jax.device_get
+
+        def spy(x):
+            calls.append(1)
+            return real(x)
+
+        monkeypatch.setattr(jax, "device_get", spy)
+        for b in self._stream():
+            col.update(*b)
+        # merging ran (groups established) with exactly one batched fetch
+        assert col._groups_checked
+        assert len(calls) == 1

@@ -328,6 +328,22 @@ class TestBucketing:
             got = float(ev.compute())
         assert got == 3.0
 
+    def test_scalar_submit_with_array_update_kwargs(self):
+        # regression: an array-valued update_kwargs entry crashed the
+        # scalar-only submit path's fused step (unhashable program key)
+        # while the bucketed masked path accepted the same config; fixed
+        # constructor kwargs are closure-captured instead
+        ev = StreamingEvaluator(
+            MeanMetric(),
+            buckets=(4, 8),
+            update_kwargs={"weight": jnp.asarray(2.0, jnp.float32)},
+        )
+        with ev:
+            ev.submit(jnp.asarray(1.0))
+            ev.submit(jnp.asarray(3.0))
+            got = float(ev.compute())
+        assert got == pytest.approx(2.0)
+
     def test_bucketed_parity_weighted_mean(self):
         # MeanMetric keeps sum-reduced (value, weight) accumulators — the
         # delta-correction fallback must keep weighted means exact

@@ -45,6 +45,15 @@ class MetricCollection:
             between metrics with identical states (e.g. precision/recall/F1
             all over tp/fp/tn/fn — only the group leader runs ``update``);
             ``False`` to disable; or an explicit list of lists of names.
+        fused_update: opt in to the whole-collection fused step
+            (:class:`~tpumetrics.parallel.fuse_update.FusedCollectionStep`):
+            once compute groups are established, every array-state group
+            leader advances through ONE jitted XLA program per ``update``
+            with the state buffers donated in place, instead of one
+            Python-driven program per leader.  Leaders with eager list
+            states (mAP-style, capacity buffers) and calls with
+            array-valued kwargs transparently keep the per-leader eager
+            path.  Donation contract: see ``docs/performance.md``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -69,6 +78,7 @@ class MetricCollection:
         prefix: Optional[str] = None,
         postfix: Optional[str] = None,
         compute_groups: Union[bool, List[List[str]]] = True,
+        fused_update: bool = False,
     ) -> None:
         self._modules = OrderedDict()
         self.prefix = self._check_arg(prefix, "prefix")
@@ -76,6 +86,9 @@ class MetricCollection:
         self._enable_compute_groups = compute_groups
         self._groups_checked: bool = False
         self._state_is_copy: bool = False
+        self._fused_update = bool(fused_update)
+        self._fused_oo_step: Optional[Any] = None  # built lazily per group layout
+        self._fused_owned: Dict[int, Any] = {}  # id -> weakref of step-output leaves
 
         self.add_metrics(metrics, *additional_metrics)
 
@@ -92,9 +105,14 @@ class MetricCollection:
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Update every metric — or, once compute groups are established, only
-        each group's leader (reference collections.py:200-226)."""
+        each group's leader (reference collections.py:200-226).  With
+        ``fused_update=True``, array-state leaders advance through ONE jitted
+        donated-state XLA program instead of one dispatch per leader."""
         if self._groups_checked:
+            fused = self._fused_oo_update(args, kwargs) if self._fused_update else frozenset()
             for cg in self._groups.values():
+                if cg[0] in fused:
+                    continue
                 m0 = self._modules[cg[0]]
                 m0.update(*args, **m0._filter_kwargs(**kwargs))
             # leaders advanced: members are stale until the next propagation
@@ -107,6 +125,72 @@ class MetricCollection:
                 self._merge_compute_groups()
                 self._groups_checked = True
                 self._state_is_copy = True  # members just updated themselves
+            else:
+                # singleton groups are final by construction: later updates
+                # take the leaders path (and its fused fast path) directly
+                self._groups_checked = True
+                self._state_is_copy = False
+
+    def _fused_oo_update(self, args: tuple, kwargs: Dict[str, Any]) -> frozenset:
+        """Advance every fusable group leader through the fused one-program
+        step; returns the leader names covered (the caller runs the rest —
+        list-state leaders, or everything when kwargs carry arrays —
+        eagerly).  Attribute states are gathered donation-safely, stepped,
+        and written back, with the eager update wrapper's side effects
+        (cache invalidation, update counter) applied by hand."""
+        from tpumetrics.parallel.fuse_update import (
+            FusedCollectionStep,
+            fusable_oo_leaders,
+            gather_donatable_state,
+        )
+
+        import weakref
+
+        step = self._fused_oo_step
+        if step is None:
+            leaders = fusable_oo_leaders(self)
+            if not leaders:
+                return frozenset()
+            step = self._fused_oo_step = FusedCollectionStep(
+                self, leaders=leaders, donate=True
+            )
+            self._fused_owned = {}
+        try:
+            hash(tuple(sorted(kwargs.items())))
+        except TypeError:
+            # array-valued per-call kwargs cannot key a static program: skip
+            # the state gather (it device-copies every non-owned leader leaf)
+            # and run this call fully eager
+            return frozenset()
+        leaders = step.leaders
+        # only arrays OUR program produced last step may be donated by
+        # reference; anything newer (reset, snapshot load, manual
+        # assignment) is copied into an XLA-owned buffer by the gather
+        state = gather_donatable_state(self._modules, leaders, owned=self._fused_owned)
+        try:
+            new_state = step.update(state, *args, **kwargs)
+        except TypeError as err:
+            if isinstance(err, jax.errors.JAXTypeError):
+                # a trace error (TracerBoolConversionError & co. subclass
+                # TypeError): a leader's update is not trace-safe, and a
+                # silent eager fallback would hide that fused_update=True
+                # re-traces and degrades every step — surface it instead
+                raise
+            # deliberate fall-back signals: array-valued per-call kwargs
+            # (UnhashableKwargsError) or untraceable positional args (host
+            # strings); this call runs fully eager — a genuine TypeError
+            # bug in a member's update re-raises from the eager path
+            return frozenset()
+        owned: Dict[int, Any] = {}
+        for name in leaders:
+            m0 = self._modules[name]
+            for attr, val in new_state[name].items():
+                object.__setattr__(m0, attr, val)
+                owned[id(val)] = weakref.ref(val)
+            m0._computed = None
+            m0._update_count += 1
+        self._fused_owned = owned
+        return frozenset(leaders)
 
     def _merge_compute_groups(self) -> None:
         """Merge groups whose leaders hold value-identical states — O(n²)
@@ -118,8 +202,16 @@ class MetricCollection:
         cls, groups: Dict[int, List[str]], modules: "OrderedDict[str, Metric]"
     ) -> Dict[int, List[str]]:
         """The group-merge algorithm over any metric mapping (the real
-        modules after an eager update, or probe deep-copies)."""
+        modules after an eager update, or probe deep-copies).
+
+        The O(n²) pairwise comparisons run entirely on HOST: every leader's
+        state leaves are fetched in ONE batched ``jax.device_get`` up front,
+        so the device round-trip count is 1 per merge, not per (pair, state)
+        — on a remote-attached accelerator each ``allclose`` sync is a full
+        network round trip and a 50-metric collection pays ~thousands of
+        them otherwise."""
         groups = {k: list(v) for k, v in groups.items()}
+        host_states = cls._leader_host_states(groups, modules)
         num_groups = len(groups)
         while True:
             for cg_idx1, cg_members1 in list(groups.items()):
@@ -127,9 +219,9 @@ class MetricCollection:
                 for cg_idx2, cg_members2 in list(groups.items()):
                     if cg_idx1 == cg_idx2 or cg_idx1 not in groups or cg_idx2 not in groups:
                         continue
-                    metric1 = modules[cg_members1[0]]
-                    metric2 = modules[cg_members2[0]]
-                    if cls._equal_metric_states(metric1, metric2):
+                    if cls._equal_host_states(
+                        host_states[cg_members1[0]], host_states[cg_members2[0]]
+                    ):
                         groups[cg_idx1].extend(groups.pop(cg_idx2))
                         merged = True
                         break
@@ -139,6 +231,78 @@ class MetricCollection:
                 break
             num_groups = len(groups)
         return dict(enumerate(groups.values()))
+
+    @staticmethod
+    def _leader_host_states(
+        groups: Dict[int, List[str]], modules: "OrderedDict[str, Metric]"
+    ) -> Dict[str, Dict[str, tuple]]:
+        """Every group leader's registered states fetched to host in ONE
+        batched device call: ``{leader: {attr: (orig_type, kind, payload)}}``
+        where kind is ``"array"`` / ``"list"`` / ``"other"``."""
+        flat: List[Any] = []
+        layout: Dict[str, Dict[str, tuple]] = {}
+        for cg in groups.values():
+            m = modules[cg[0]]
+            entry: Dict[str, tuple] = {}
+            for attr in m._defaults:
+                val = getattr(m, attr)
+                if isinstance(val, jax.Array):
+                    entry[attr] = (type(val), "array", len(flat))
+                    flat.append(val)
+                elif isinstance(val, list):
+                    slots = list(range(len(flat), len(flat) + len(val)))
+                    flat.extend(val)
+                    entry[attr] = (type(val), "list", slots)
+                else:
+                    entry[attr] = (type(val), "other", None)
+            layout[cg[0]] = entry
+        fetched = jax.device_get(flat) if flat else []
+        out: Dict[str, Dict[str, tuple]] = {}
+        for name, entry in layout.items():
+            resolved: Dict[str, tuple] = {}
+            for attr, (orig_type, kind, slot) in entry.items():
+                if kind == "array":
+                    resolved[attr] = (orig_type, kind, fetched[slot])
+                elif kind == "list":
+                    resolved[attr] = (orig_type, kind, [fetched[i] for i in slot])
+                else:
+                    resolved[attr] = (orig_type, kind, None)
+            out[name] = resolved
+        return out
+
+    @staticmethod
+    def _equal_host_states(state1: Dict[str, tuple], state2: Dict[str, tuple]) -> bool:
+        """Host-side value equality of two fetched leader states — the exact
+        :meth:`_equal_metric_states` semantics (type identity, shape match,
+        ``allclose`` with its dtype-cast convention) on numpy leaves."""
+        import numpy as np
+
+        def _close(a1: Any, a2: Any) -> bool:
+            a1 = np.asarray(a1)
+            a2 = np.asarray(a2)
+            if a1.dtype != a2.dtype:
+                a2 = a2.astype(a1.dtype)
+            return bool(np.allclose(a1, a2, rtol=1e-5, atol=1e-8))
+
+        if len(state1) == 0 or len(state2) == 0:
+            return False
+        if state1.keys() != state2.keys():
+            return False
+        for key in state1:
+            type1, kind, val1 = state1[key]
+            type2, _kind2, val2 = state2[key]
+            if type1 is not type2:
+                return False
+            if kind == "array":
+                if val1.shape != val2.shape or not _close(val1, val2):
+                    return False
+            elif kind == "list":
+                if len(val1) != len(val2) or not all(
+                    np.shape(s1) == np.shape(s2) and _close(s1, s2)
+                    for s1, s2 in zip(val1, val2)
+                ):
+                    return False
+        return True
 
     @staticmethod
     def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
@@ -167,6 +331,7 @@ class MetricCollection:
         collections.py:289-307 shares by mutable reference; here arrays are
         immutable so propagation IS aliasing — free and alias-safe)."""
         if not self._state_is_copy:
+            aliased = False
             for cg in self._groups.values():
                 m0 = self._modules[cg[0]]
                 for name in cg[1:]:
@@ -174,6 +339,13 @@ class MetricCollection:
                     self._alias_leader_states(m0, mi)
                     mi._update_count = m0._update_count
                     mi._computed = None
+                    aliased = True
+            if aliased and self._fused_owned:
+                # members now alias the leaders' arrays: the fused step no
+                # longer owns them exclusively, so donating them by reference
+                # would delete the members' state out from under them — the
+                # next gather copies first
+                self._fused_owned = {}
         self._state_is_copy = copy
 
     @staticmethod
@@ -613,6 +785,8 @@ class MetricCollection:
             )
 
         self._groups_checked = False
+        self._fused_oo_step = None  # membership changed: program set is stale
+        self._fused_owned = {}
         if self._enable_compute_groups:
             self._init_compute_groups()
         else:
@@ -720,6 +894,8 @@ class MetricCollection:
         self._modules.clear()
         self._groups = {}
         self._groups_checked = False
+        self._fused_oo_step = None
+        self._fused_owned = {}
         if isinstance(self._enable_compute_groups, list):
             self._enable_compute_groups = True
 
@@ -755,6 +931,8 @@ class MetricCollection:
             for i, (idx, group) in enumerate(sorted(self._groups.items()))
             if (kept := [name for name in group if name != base_key])
         }
+        self._fused_oo_step = None  # leader set may have changed
+        self._fused_owned = {}
         return metric
 
     def plot(

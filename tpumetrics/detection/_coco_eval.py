@@ -19,6 +19,21 @@ pycocotools' ``COCOeval``, which the reference shells out to on CPU from
 Everything after the per-image matching is dense numpy (the matching itself
 is a data-dependent greedy loop, which is why — like the reference — this
 runs on host at ``compute`` time; states stay on device until then).
+
+**Batched matching** (the map_ragged hot path): the greedy loop is
+sequential only over the detections *within* one (image, class) cell —
+cells are independent.  :func:`coco_evaluate` therefore pads every cell of
+a class to a shared (D, G) bucket (pow-2 edges, the same shape discipline
+as :mod:`tpumetrics.runtime.bucketing`) and runs ONE loop over the padded
+detection axis, vectorized across all images × area ranges × IoU
+thresholds at once — the Python-dispatch count per compute drops from
+O(images × classes × dets) to O(classes × buckets × max_dets).
+Accumulation is likewise batched: per (class, max_det cap) the detections
+of all images flatten into one score-sorted matrix shared by every area
+range.  The per-cell reference implementation is kept verbatim
+(:func:`_match_image_areas`, :func:`_accumulate_class_area`,
+:func:`coco_evaluate_unfused`) and the batched path is asserted
+bit-identical against it in ``tests/detection/test_coco_batched.py``.
 """
 
 from __future__ import annotations
@@ -223,6 +238,240 @@ def _accumulate_class_area(
     return precision, recall
 
 
+# ---------------------------------------------------------- batched matching
+
+
+# batched-match work budget: N_cells * areas * thresholds * G_pad * D_pad
+# elements touched by one bucket's greedy pass.  Under it, ONE bucket per
+# class maximizes batching (every Python-level matcher dispatch covers all
+# cells); above it, pow-2 sub-buckets bound the padding blow-up a single
+# huge image would force on every small cell.
+_MATCH_BUDGET = 1 << 24
+
+
+def _cell_buckets(
+    cells: List[Tuple], max_det: int, num_areas: int, num_thrs: int
+) -> Dict[Tuple[int, int], List[int]]:
+    """Group cell indices by their padded (detection, groundtruth) bucket.
+
+    Fewest-buckets-first: if padding every cell straight to the class max
+    stays under ``_MATCH_BUDGET`` (the common case — evaluation corpora are
+    ragged but not wild), everything lands in one bucket and the greedy pass
+    is a single vectorized loop.  Otherwise cells split along pow-2 edges
+    (the :func:`tpumetrics.runtime.bucketing.pow2_bucket_edges` discipline,
+    floored at 8 so near-sized cells still share a shape)."""
+    from tpumetrics.runtime.bucketing import ShapeBucketer, pow2_bucket_edges
+
+    d_sizes = [max(min(c[2].shape[0], max_det), 1) for c in cells]
+    g_sizes = [max(c[3].shape[0], 1) for c in cells]
+    d_max, g_max = max(d_sizes, default=1), max(g_sizes, default=1)
+    if len(cells) * num_areas * num_thrs * d_max * g_max <= _MATCH_BUDGET:
+        return {(d_max, g_max): list(range(len(cells)))}
+    floor = 8
+    d_bucketer = ShapeBucketer(
+        [e for e in pow2_bucket_edges(d_max) if e >= min(floor, d_max)]
+    )
+    g_bucketer = ShapeBucketer(
+        [e for e in pow2_bucket_edges(g_max) if e >= min(floor, g_max)]
+    )
+    groups: Dict[Tuple[int, int], List[int]] = {}
+    for i, (d, g) in enumerate(zip(d_sizes, g_sizes)):
+        groups.setdefault((d_bucketer.bucket_for(d), g_bucketer.bucket_for(g)), []).append(i)
+    return groups
+
+
+def _match_cells_batched(
+    cells: List[Tuple],
+    iou_thresholds: np.ndarray,
+    area_ranges: Sequence[Tuple[float, float]],
+    max_det: int,
+    d_pad: int,
+    g_pad: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Greedy-match a batch of same-bucket (image, class) cells at once.
+
+    Semantically identical to running :func:`_match_image_areas` per cell —
+    the greedy detection loop is sequential only *within* a cell, so the
+    loop below runs over the padded detection axis once, vectorized over
+    (cell, area range, IoU threshold, gt) for every cell simultaneously.
+
+    ``cells`` entries are ``(ious, det_areas, det_scores, gt_crowd,
+    gt_area)`` with detections already score-sorted and capped to
+    ``max_det``.  Padding convention: pad IoUs are ``-1`` (below every
+    threshold), pad gts are unavailable and ignored, pad detections are
+    marked invalid and excluded by the caller's validity mask.
+
+    Returns ``(det_matches (N, A, T, Dp) bool, det_ignore (N, A, T, Dp)
+    bool, scores (N, Dp) f32, det_valid (N, Dp) bool, num_gt (N, A))``.
+    """
+    n_cells = len(cells)
+    num_areas = len(area_ranges)
+    num_thrs = len(iou_thresholds)
+
+    ious_p = np.full((n_cells, d_pad, g_pad), -1.0)
+    da_p = np.zeros((n_cells, d_pad))
+    sc_p = np.zeros((n_cells, d_pad), np.float32)
+    crowd_p = np.zeros((n_cells, g_pad), bool)
+    ga_p = np.zeros((n_cells, g_pad))
+    det_valid = np.zeros((n_cells, d_pad), bool)
+    gt_valid = np.zeros((n_cells, g_pad), bool)
+    for i, (ious, da, ds, gc, ga) in enumerate(cells):
+        d = min(ds.shape[0], max_det)
+        g = gc.shape[0]
+        ious_p[i, :d, :g] = ious[:d]
+        da_p[i, :d] = da[:d]
+        sc_p[i, :d] = ds[:d]
+        crowd_p[i, :g] = gc.astype(bool)
+        ga_p[i, :g] = ga
+        det_valid[i, :d] = True
+        gt_valid[i, :g] = True
+
+    lo = np.asarray([r[0] for r in area_ranges])
+    hi = np.asarray([r[1] for r in area_ranges])
+    # (N, A, G): crowd / out-of-range gts absorb matches without counting;
+    # pad gts are forced ignored AND unavailable so they can never match
+    gt_ignore = (
+        crowd_p[:, None, :]
+        | (ga_p[:, None, :] < lo[None, :, None])
+        | (ga_p[:, None, :] > hi[None, :, None])
+        | ~gt_valid[:, None, :]
+    )
+    real = ~gt_ignore  # pads are never "real": forced ignored above
+    thr = np.minimum(np.asarray(iou_thresholds, np.float64), 1 - 1e-10)  # (T,)
+
+    det_matches = np.zeros((n_cells, num_areas, num_thrs, d_pad), bool)
+    det_ignore = np.zeros((n_cells, num_areas, num_thrs, d_pad), bool)
+    avail = np.broadcast_to(
+        gt_valid[:, None, None, :], (n_cells, num_areas, num_thrs, g_pad)
+    ).copy()
+    g_idx = np.arange(g_pad)
+    n_idx = np.arange(n_cells)[:, None, None]
+    a_idx = np.arange(num_areas)[None, :, None]
+    for d_i in range(d_pad):
+        # pad detections (d_i >= a cell's true count) carry IoU -1 for every
+        # gt, below any threshold — no per-iteration validity masking needed
+        iou_row = ious_p[:, d_i, :]  # (N, G)
+        cand = avail & (iou_row[:, None, None, :] >= thr[None, None, :, None])
+        cand_real = cand & real[:, :, None, :]
+        use_real = cand_real.any(axis=3)  # non-ignored gts take precedence
+        pick_from = np.where(use_real[..., None], cand_real, cand & gt_ignore[:, :, None, :])
+        has = pick_from.any(axis=3)  # (N, A, T)
+        if not has.any():
+            continue
+        vals = np.where(pick_from, iou_row[:, None, None, :], -1.0)
+        best_g = g_pad - 1 - np.argmax(vals[..., ::-1], axis=3)  # last-wins argmax
+        det_matches[:, :, :, d_i] = has
+        det_ignore[:, :, :, d_i] = has & gt_ignore[n_idx, a_idx, best_g]
+        # crowd gts can absorb any number of detections: only non-crowd
+        # picks claim their gt
+        claimed = has & ~crowd_p[n_idx, best_g]
+        avail &= ~(claimed[..., None] & (g_idx[None, None, None, :] == best_g[..., None]))
+
+    # unmatched detections outside the area range are ignored
+    det_out = (da_p[:, None, :] < lo[None, :, None]) | (da_p[:, None, :] > hi[None, :, None])
+    det_ignore |= (~det_matches) & det_out[:, :, None, :] & det_valid[:, None, None, :]
+
+    num_gt = (~gt_ignore).sum(axis=2)  # (N, A)
+    return det_matches, det_ignore, sc_p, det_valid, num_gt
+
+
+def _accumulate_cells(
+    groups: List[Tuple[np.ndarray, Tuple]],
+    num_thrs: int,
+    rec_thresholds: np.ndarray,
+    max_dets: Sequence[int],
+    num_areas: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Batched :func:`_accumulate_class_area` over every (area, maxdet) cell
+    of one class at once.
+
+    ``groups`` pairs each bucket's original cell indices with its
+    :func:`_match_cells_batched` output.  Per max-det cap the detections of
+    ALL cells flatten into one score-sorted column set, shared across area
+    ranges (scores do not depend on the area range); the flatten order is
+    restored to global cell order first so stable-sort tie-breaking is
+    bit-identical to concatenating per-cell arrays.
+
+    Returns ``(precision (T, R, A, M), recall (T, A, M))``.
+    """
+    num_rec = len(rec_thresholds)
+    n_m = len(max_dets)
+    precision = -np.ones((num_thrs, num_rec, num_areas, n_m))
+    recall = -np.ones((num_thrs, num_areas, n_m))
+    if not groups:
+        return precision, recall
+    npig = np.zeros(num_areas, dtype=np.int64)
+    for _cells_idx, (_dm, _dig, _sc, _dv, num_gt) in groups:
+        npig += num_gt.sum(axis=0)
+
+    eps = np.finfo(np.float64).eps
+    single = len(groups) == 1  # one bucket: cell order is already global order
+    for m_idx, m in enumerate(max_dets):
+        if single:
+            _ci, (dm_s, dig_s, sc_s, dv_s, _ng) = groups[0]
+            valid_s = dv_s & (np.arange(dv_s.shape[1])[None, :] < m)
+            # flat (cell * Dp) positions in cell-major order == the per-cell
+            # concatenation order; one stable score sort gives the columns
+            flat = np.flatnonzero(valid_s.ravel())
+            scores = sc_s.ravel()[flat]
+            cols = flat[np.argsort(-scores, kind="mergesort")]
+        else:
+            valids = []
+            counts = []
+            for cells_idx, (_dm, _dig, sc, dv, _ng) in groups:
+                valid = dv & (np.arange(dv.shape[1])[None, :] < m)
+                valids.append(valid)
+                counts.append((cells_idx, valid.sum(axis=1)))
+            # global column order = per-cell blocks in original cell order
+            # (the per-cell concatenation order), then one stable score sort
+            rows_cell = np.concatenate([np.repeat(ci, cnt) for ci, cnt in counts])
+            perm = np.argsort(rows_cell, kind="stable")
+            scores = np.concatenate(
+                [sc[valid] for valid, (_ci, (_dm, _dig, sc, _dv, _ng)) in zip(valids, groups)]
+            )[perm]
+            cols = perm[np.argsort(-scores, kind="mergesort")]
+        nd = cols.shape[0]
+        for a_idx in range(num_areas):
+            if npig[a_idx] == 0:
+                continue
+            if nd == 0:
+                precision[:, :, a_idx, m_idx] = 0.0
+                recall[:, a_idx, m_idx] = 0.0
+                continue
+            if single:
+                matches = np.transpose(dm_s[:, a_idx], (1, 0, 2)).reshape(num_thrs, -1)[:, cols]
+                ignore = np.transpose(dig_s[:, a_idx], (1, 0, 2)).reshape(num_thrs, -1)[:, cols]
+            else:
+                matches = np.concatenate(
+                    [
+                        np.transpose(dm[:, a_idx], (1, 0, 2))[:, valid]
+                        for valid, (_ci, (dm, _dig, _sc, _dv, _ng)) in zip(valids, groups)
+                    ],
+                    axis=1,
+                )[:, cols]
+                ignore = np.concatenate(
+                    [
+                        np.transpose(dig[:, a_idx], (1, 0, 2))[:, valid]
+                        for valid, (_ci, (_dm, dig, _sc, _dv, _ng)) in zip(valids, groups)
+                    ],
+                    axis=1,
+                )[:, cols]
+            tp_sum = np.cumsum(matches & ~ignore, axis=1).astype(np.float64)
+            fp_sum = np.cumsum(~matches & ~ignore, axis=1).astype(np.float64)
+            rc = tp_sum / npig[a_idx]
+            pr = tp_sum / np.maximum(fp_sum + tp_sum, eps)
+            recall[:, a_idx, m_idx] = rc[:, -1]
+            # monotone precision envelope from the right (pycocotools loop)
+            pr = np.maximum.accumulate(pr[:, ::-1], axis=1)[:, ::-1]
+            for t_idx in range(num_thrs):
+                inds = np.searchsorted(rc[t_idx], rec_thresholds, side="left")
+                q = np.zeros(num_rec)
+                valid_i = inds < nd
+                q[valid_i] = pr[t_idx][inds[valid_i]]
+                precision[t_idx, :, a_idx, m_idx] = q
+    return precision, recall
+
+
 def precompute_geometries(
     detections: Sequence[Tuple],
     groundtruths: Sequence[Tuple],
@@ -253,6 +502,12 @@ def coco_evaluate(
 ) -> Dict[str, np.ndarray]:
     """Full COCO evaluation over per-image detections/groundtruths.
 
+    The hot path: per class, every image's cell is padded to a shared
+    pow-2 (D, G) bucket, matched by ONE vectorized greedy pass
+    (:func:`_match_cells_batched`) and accumulated by ONE batched
+    precision/recall pass (:func:`_accumulate_cells`).  Bit-identical to
+    the per-cell reference path (:func:`coco_evaluate_unfused`).
+
     Args:
         detections: per image (geometry, scores (D,), labels (D,)).
         groundtruths: per image (geometry, labels (G,), iscrowd (G,),
@@ -277,7 +532,95 @@ def coco_evaluate(
     eval_class_ids: Sequence[int] = [0] if average == "micro" else class_ids
 
     area_names = list(_AREA_RANGES)
+    all_ranges = [_AREA_RANGES[a] for a in area_names]
     # precision[T, R, K, A, M], recall[T, K, A, M]
+    precision = -np.ones((len(iou_thrs), len(rec_thrs), len(eval_class_ids), len(area_names), len(max_dets)))
+    recall = -np.ones((len(iou_thrs), len(eval_class_ids), len(area_names), len(max_dets)))
+
+    per_image_geom = (
+        geom_cache if geom_cache is not None else precompute_geometries(detections, groundtruths, iou_type)
+    )
+
+    # class-independent work, ONCE per image (shared by every class and by
+    # a micro+macro double evaluation): the full crowd-aware IoU matrix and
+    # one stable score sort — a per-class stable subset selection of a
+    # sorted order equals sorting the subset
+    per_image_full = []
+    for img in range(num_imgs):
+        _, det_scores, _ = detections[img]
+        _, _, gt_crowd, gt_area = groundtruths[img]
+        inter_full, det_area_full, gt_area_geom_full = per_image_geom[img]
+        union = det_area_full[:, None] + gt_area_geom_full[None, :] - inter_full
+        union = np.where(gt_crowd[None, :].astype(bool), det_area_full[:, None], union)
+        ious_full = inter_full / np.where(union > 0, union, 1.0)
+        area_eff = np.where(gt_area > 0, gt_area, gt_area_geom_full)
+        per_image_full.append((ious_full, np.argsort(-det_scores, kind="stable"), area_eff))
+
+    iou_map: Dict[Tuple[int, int], np.ndarray] = {}
+    for k_idx, class_id in enumerate(eval_class_ids):
+        # per (image, class) cell: slice the presorted full-image pieces
+        cells = []
+        for img in range(num_imgs):
+            _, det_scores, det_labels = detections[img]
+            _, gt_labels, gt_crowd, _ = groundtruths[img]
+            _, det_area_full, _ = per_image_geom[img]
+            ious_full, order_full, area_eff = per_image_full[img]
+            if average == "micro":
+                idx = order_full[: max_dets[-1]]
+                gt_sel = slice(None)
+            else:
+                idx = order_full[det_labels[order_full] == class_id][: max_dets[-1]]
+                gt_sel = gt_labels == class_id
+            ious = ious_full[idx][:, gt_sel]
+            cells.append(
+                (ious, det_area_full[idx], det_scores[idx], gt_crowd[gt_sel], area_eff[gt_sel])
+            )
+            if extended:
+                iou_map[(img, int(class_id))] = ious
+
+        groups = [
+            (
+                np.asarray(cell_idx, np.int64),
+                _match_cells_batched(
+                    [cells[i] for i in cell_idx], iou_thrs, all_ranges, max_dets[-1], d_pad, g_pad
+                ),
+            )
+            for (d_pad, g_pad), cell_idx in _cell_buckets(
+                cells, max_dets[-1], len(area_names), len(iou_thrs)
+            ).items()
+        ]
+        prec_k, rec_k = _accumulate_cells(groups, len(iou_thrs), rec_thrs, max_dets, len(area_names))
+        precision[:, :, k_idx] = prec_k
+        recall[:, k_idx] = rec_k
+
+    return _summarize(
+        precision, recall, iou_thrs, class_ids, eval_class_ids, area_names, max_dets, iou_map, extended
+    )
+
+
+def coco_evaluate_unfused(
+    detections: Sequence[Tuple[np.ndarray, np.ndarray, np.ndarray]],
+    groundtruths: Sequence[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]],
+    iou_thresholds: Sequence[float],
+    rec_thresholds: Sequence[float],
+    max_detection_thresholds: Sequence[int],
+    class_ids: Sequence[int],
+    average: str = "macro",
+    iou_type: str = "bbox",
+    geom_cache: Optional[List[Tuple[np.ndarray, np.ndarray, np.ndarray]]] = None,
+    extended: bool = False,
+) -> Dict[str, np.ndarray]:
+    """The per-(image, class)-cell reference evaluation (pre-batching
+    implementation, kept verbatim): the parity anchor the batched
+    :func:`coco_evaluate` is asserted bit-identical against."""
+    iou_thrs = np.asarray(iou_thresholds, dtype=np.float64)
+    rec_thrs = np.asarray(rec_thresholds, dtype=np.float64)
+    max_dets = sorted(max_detection_thresholds)
+    num_imgs = len(detections)
+
+    eval_class_ids: Sequence[int] = [0] if average == "micro" else class_ids
+
+    area_names = list(_AREA_RANGES)
     precision = -np.ones((len(iou_thrs), len(rec_thrs), len(eval_class_ids), len(area_names), len(max_dets)))
     recall = -np.ones((len(iou_thrs), len(eval_class_ids), len(area_names), len(max_dets)))
 
@@ -329,6 +672,25 @@ def coco_evaluate(
                 prec, rec = _accumulate_class_area(results, len(iou_thrs), rec_thrs, max_det)
                 precision[:, :, k_idx, a_idx, m_idx] = prec
                 recall[:, k_idx, a_idx, m_idx] = rec
+
+    return _summarize(
+        precision, recall, iou_thrs, class_ids, eval_class_ids, area_names, max_dets, iou_map, extended
+    )
+
+
+def _summarize(
+    precision: np.ndarray,
+    recall: np.ndarray,
+    iou_thrs: np.ndarray,
+    class_ids: Sequence[int],
+    eval_class_ids: Sequence[int],
+    area_names: List[str],
+    max_dets: List[int],
+    iou_map: Dict[Tuple[int, int], np.ndarray],
+    extended: bool,
+) -> Dict[str, np.ndarray]:
+    """Reduce the (T, R, K, A, M) precision / (T, K, A, M) recall tensors to
+    the COCO summary scalars (shared by the batched and reference paths)."""
 
     def _map(thr_sel=slice(None), area="all", max_det_idx=-1, class_idx=None):
         a_idx = area_names.index(area)

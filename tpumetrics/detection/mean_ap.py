@@ -61,7 +61,7 @@ def _fetch_pieces(pieces: List[Array]) -> List[np.ndarray]:
     parts: List[np.ndarray] = []
     if dev_idx:
         dev = [pieces[i] for i in dev_idx]
-        sizes = np.asarray([int(np.prod(x.shape)) for x in dev])
+        sizes = np.asarray([int(x.size) for x in dev])
         flats = [
             _pack_flat_f32(*dev[lo : lo + _PACK_CHUNK])
             for lo in range(0, len(dev), _PACK_CHUNK)
@@ -546,6 +546,18 @@ class MeanAveragePrecision(Metric):
             return np.stack([x, y, x + w, y + h], axis=1)
         return b.astype(np.float64)
 
+    def _convert_boxes_host_batched(self, boxes_list, counts) -> List[np.ndarray]:
+        """Per-image box conversion as ONE concat-convert-split: the
+        conversion is elementwise per row, so converting the concatenation
+        bit-identically equals converting each image — at O(1) numpy
+        dispatches instead of O(images)."""
+        flat = self._convert_boxes_host(
+            np.concatenate([np.asarray(b, np.float32).reshape(-1, 4) for b in boxes_list])
+            if boxes_list
+            else np.zeros((0, 4), np.float32)
+        )
+        return np.split(flat, np.cumsum(np.asarray(counts, np.int64))[:-1])
+
     def _unpack_mask_geoms(self, dcounts, gcounts):
         """Rebuild per-image ``((h, w), [runs per mask])`` geometries from the
         host-side run state (the inverse of :meth:`_append_masks`)."""
@@ -671,8 +683,8 @@ class MeanAveragePrecision(Metric):
             geoms_by_type: Dict[str, tuple] = {}
             if "bbox" in types:
                 geoms_by_type["bbox"] = (
-                    [self._convert_boxes_host(b) for b in take(num_imgs)],
-                    [self._convert_boxes_host(b) for b in take(num_imgs)],
+                    self._convert_boxes_host_batched(take(num_imgs), dcounts),
+                    self._convert_boxes_host_batched(take(num_imgs), gcounts),
                 )
             if "segm" in types:
                 geoms_by_type["segm"] = self._unpack_mask_geoms(dcounts, gcounts)

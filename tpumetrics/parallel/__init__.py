@@ -1,4 +1,5 @@
-"""Distributed sync strategies (ICI / DCN / no-op) for metric state."""
+"""Distributed sync strategies (ICI / DCN / no-op) and fused whole-collection
+state transitions for metric state."""
 
 from tpumetrics.parallel.backend import (
     AxisBackend,
@@ -9,12 +10,15 @@ from tpumetrics.parallel.backend import (
     get_default_backend,
     set_default_backend,
 )
+from tpumetrics.parallel.fuse_update import FusedCollectionStep, UnhashableKwargsError
 
 __all__ = [
     "AxisBackend",
     "DistributedBackend",
+    "FusedCollectionStep",
     "MultiHostBackend",
     "NoOpBackend",
+    "UnhashableKwargsError",
     "distributed_available",
     "get_default_backend",
     "set_default_backend",

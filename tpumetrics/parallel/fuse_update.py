@@ -1,0 +1,305 @@
+"""Whole-collection fused update: ONE XLA program per collection step.
+
+`FusedReducer` (:mod:`tpumetrics.parallel.fuse`) solved the *sync* side —
+one collective per (op, dtype) class.  This module solves the *compute*
+side: today a K-leader :class:`~tpumetrics.collections.MetricCollection`
+dispatches K Python-driven device programs per ``update`` step, paying K
+dispatch latencies and K sets of intermediate buffers.
+
+:class:`FusedCollectionStep` composes every compute-group leader's
+``functional_update`` into one jitted state-pytree transition::
+
+    {name: state} x batch  ->  {name: state}
+
+so a collection step is ONE device program regardless of member count, and
+``donate_argnums`` on the state pytree lets XLA reuse the state buffers in
+place instead of allocating a fresh copy per step (the
+:meth:`~tpumetrics.metric.Metric.init_state` contract already returns
+fresh, donation-safe buffers).
+
+Consumers:
+
+- ``MetricCollection(..., fused_update=True)`` — the eager OO path: the
+  leaders' attribute states are gathered into a pytree, stepped through the
+  fused program, and written back (:meth:`MetricCollection.update`).
+- :class:`~tpumetrics.runtime.evaluator.StreamingEvaluator` — the bucketed
+  functional path: one fused program per (bucket, trace signature) covers
+  the whole collection, with the state donated every step.
+
+**Donation contract** (see ``docs/performance.md``): after a donated step,
+every array that was part of the input state is DELETED — any alias a
+caller held (a member attribute read before the step, a not-yet-serialized
+snapshot payload) becomes unusable.  Keep donation on only when the fused
+step is the sole owner of the state between steps, which is how both
+consumers above use it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+Array = jax.Array
+
+# one warning when a step has compiled this many distinct programs — the
+# signature of a per-batch-varying kwarg silently recompiling every call
+_PROGRAM_CACHE_WARN = 32
+
+
+class UnhashableKwargsError(TypeError):
+    """Per-call ``update()`` kwargs cannot key the static program cache.
+
+    A *deliberate* fall-back signal: callers with array-valued per-call
+    kwargs catch exactly this class and run the unfused path.  It must stay
+    distinguishable from other ``TypeError``s — in particular JAX's trace
+    errors (``TracerBoolConversionError`` etc. are ``TypeError`` subclasses)
+    which mean a member's ``update`` is not trace-safe and must surface, not
+    silently degrade to eager.
+    """
+
+
+def fusable_oo_leaders(collection: Any) -> List[str]:
+    """Group-leader names whose *eager attribute* state can round-trip
+    through one jitted transition: every registered state is an array.
+
+    List states are excluded on the OO path — an eager Python-list state
+    grows unbounded (a new pytree structure every step would retrace the
+    fused program each call), and routing it through the fixed-capacity
+    ``MaskedBuffer`` functional form would silently change eager semantics.
+    Such leaders keep their individual eager update; see
+    ``docs/performance.md`` ("when not to fuse").
+    """
+    leaders = []
+    for cg in collection._groups.values():
+        m0 = collection._modules[cg[0]]
+        if m0._defaults and not any(isinstance(d, list) for d in m0._defaults.values()):
+            leaders.append(cg[0])
+    return leaders
+
+
+def gather_donatable_state(
+    modules: Dict[str, Any],
+    leaders: List[str],
+    owned: Optional[Dict[int, Any]] = None,
+) -> Dict[str, Dict[str, Array]]:
+    """Collect the leaders' attribute states into a donation-safe pytree.
+
+    Only arrays the fused program itself produced (tracked in ``owned``,
+    an ``{id: weakref}`` map the caller rebuilds after every write-back)
+    may be donated by reference.  Everything else is materialized through
+    an on-device ``.copy()`` first, because a donated buffer must be
+    XLA-owned and unaliased:
+
+    - a state attribute that still IS the metric's stored default (right
+      after ``__init__``/``reset``): donating it would delete the default
+      and poison every later ``reset``/``init_state``;
+    - an attribute assigned from outside (``load_snapshot_state``, manual
+      assignment): ``jnp.asarray`` over host data can wrap memory the
+      device allocator does not own, and donating such a buffer corrupts
+      the heap (see ``_device_state`` in ``runtime/evaluator.py``);
+    - the same array object at two leaves: XLA cannot donate one buffer
+      twice.
+    """
+    owned = owned or {}
+    seen: set = set()
+    out: Dict[str, Dict[str, Array]] = {}
+    for name in leaders:
+        m0 = modules[name]
+        leaf_dict: Dict[str, Array] = {}
+        for attr in m0._defaults:
+            val = getattr(m0, attr)
+            ref = owned.get(id(val))
+            if ref is None or ref() is not val or id(val) in seen:
+                val = jnp.asarray(val).copy()
+            seen.add(id(val))
+            leaf_dict[attr] = val
+        out[name] = leaf_dict
+    return out
+
+
+class FusedCollectionStep:
+    """One jitted, buffer-donating state transition for a whole
+    Metric / MetricCollection.
+
+    Args:
+        metric: a :class:`~tpumetrics.metric.Metric` or
+            :class:`~tpumetrics.collections.MetricCollection`.  For a
+            collection, establish compute groups first (one eager update or
+            ``establish_compute_groups``) so the fused program covers group
+            leaders only.
+        leaders: for a collection, restrict the fused transition to these
+            group-leader names (default: every group leader).  Used by the
+            eager OO path to fuse array-state leaders while list-state
+            leaders stay eager.
+        update_kwargs: static keyword arguments baked into every program
+            (e.g. ``real=True``); they participate in Python-level control
+            flow inside ``update`` and are therefore compile-time constants,
+            never traced.
+        donate: donate the state pytree to XLA (default True) — the module
+            docstring's ownership contract applies.
+
+    One Python-visible program exists per (static kwargs, bucket) key;
+    within a program XLA still specializes per input trace signature, which
+    is what :meth:`StreamingEvaluator.stats`'s ``xla_compiles`` counts.
+    """
+
+    def __init__(
+        self,
+        metric: Any,
+        *,
+        leaders: Optional[List[str]] = None,
+        update_kwargs: Optional[Dict[str, Any]] = None,
+        donate: bool = True,
+    ) -> None:
+        from tpumetrics.collections import MetricCollection
+        from tpumetrics.metric import Metric
+
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(f"Expected Metric or MetricCollection, got {type(metric)}")
+        self._metric = metric
+        self._is_collection = isinstance(metric, MetricCollection)
+        if leaders is not None and not self._is_collection:
+            raise ValueError("`leaders` only applies to a MetricCollection")
+        if self._is_collection:
+            all_leaders = [cg[0] for cg in metric._groups.values()]
+            if leaders is None:
+                leaders = all_leaders
+            else:
+                unknown = set(leaders) - set(all_leaders)
+                if unknown:
+                    raise TPUMetricsUserError(
+                        f"Not compute-group leaders of this collection: {sorted(unknown)}"
+                    )
+        self._leaders: Optional[List[str]] = leaders
+        self._update_kwargs = dict(update_kwargs or {})
+        self._donate = bool(donate)
+        self._programs: Dict[Any, Callable] = {}
+
+    # ------------------------------------------------------------- properties
+
+    @property
+    def leaders(self) -> Optional[List[str]]:
+        """Fused group-leader names (None for a single Metric)."""
+        return list(self._leaders) if self._leaders is not None else None
+
+    @property
+    def donate(self) -> bool:
+        return self._donate
+
+    @property
+    def program_count(self) -> int:
+        """Jitted programs built so far — one per (static kwargs / bucket)
+        key, NOT per trace signature (XLA's per-shape specialization lives
+        inside each program's jit cache)."""
+        return len(self._programs)
+
+    # ------------------------------------------------------------ transitions
+
+    def init_state(self) -> Dict[str, Any]:
+        """Fresh state pytree covering exactly the fused leaders."""
+        if not self._is_collection:
+            return self._metric.init_state()
+        self._metric._compute_groups_create_state_ref(copy=False)
+        return {name: self._metric._modules[name].init_state() for name in self._leaders}
+
+    def _transition(
+        self, state: Dict[str, Any], args: Tuple[Any, ...], kwargs: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        """The traced body: every fused leader's functional_update, inline in
+        ONE trace — XLA fuses the member programs and shares the batch."""
+        if not self._is_collection:
+            return self._metric.functional_update(state, *args, **kwargs)
+        out = {}
+        for name in self._leaders:
+            m0 = self._metric._modules[name]
+            out[name] = m0.functional_update(state[name], *args, **m0._filter_kwargs(**kwargs))
+        return out
+
+    def update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """One fused, donated state transition over an (unpadded) batch.
+
+        Per-call ``kwargs`` merge over the constructor's ``update_kwargs``
+        and must be hashable Python values (they key the program cache and
+        stay static in trace); pass per-batch arrays positionally.  Raises
+        :class:`UnhashableKwargsError` for unhashable per-call kwargs —
+        callers with array kwargs fall back to the unfused path.
+
+        *Constructor* kwargs are exempt from the hashability requirement:
+        they are fixed for the step's lifetime, so an array-valued
+        ``update_kwargs`` entry (the evaluator's ``update_kwargs=``) is
+        closure-captured into the program exactly as :meth:`masked_update`
+        does, instead of keying the cache.
+        """
+        merged = {**self._update_kwargs, **kwargs}
+        try:
+            key = ("update", tuple(sorted(merged.items())))
+            hash(key)
+        except TypeError:
+            try:
+                key = ("update", "ctor-closure", tuple(sorted(kwargs.items())))
+                hash(key)
+            except TypeError as err:
+                raise UnhashableKwargsError(
+                    "FusedCollectionStep.update per-call kwargs must be "
+                    f"hashable (static); got {sorted(kwargs)}: {err}. Pass "
+                    "array-valued inputs positionally, or use the unfused "
+                    "update path."
+                ) from None
+        program = self._programs.get(key)
+        if program is None:
+            donate = (0,) if self._donate else ()
+            program = jax.jit(
+                lambda s, a: self._transition(s, a, merged), donate_argnums=donate
+            )
+            self._programs[key] = program
+            if len(self._programs) == _PROGRAM_CACHE_WARN:
+                from tpumetrics.utils.prints import rank_zero_warn
+
+                rank_zero_warn(
+                    f"FusedCollectionStep has compiled {_PROGRAM_CACHE_WARN} distinct "
+                    "fused programs — every distinct per-call kwargs value keys (and "
+                    "compiles) its own program, cached for the step's lifetime. A "
+                    "kwarg that varies per batch belongs in a positional array "
+                    "argument, or on the unfused update path."
+                )
+        return program(state, tuple(args))
+
+    def masked_update(
+        self, state: Dict[str, Any], padded: Tuple[Any, ...], n_valid: Array, bucket: int
+    ) -> Dict[str, Any]:
+        """One fused, donated *bucketed* transition (the
+        :func:`~tpumetrics.runtime.bucketing.masked_functional_update`
+        semantics — native ``valid`` mask or exact delta correction) for the
+        whole collection at once.  ``bucket`` is static: one program per
+        bucket edge, shared by every metric in the collection."""
+        if self._is_collection and set(self._leaders) != {
+            cg[0] for cg in self._metric._groups.values()
+        }:
+            raise TPUMetricsUserError(
+                "masked_update fuses the whole collection; a leader subset is "
+                "only supported by update()."
+            )
+        key = ("masked", int(bucket))
+        program = self._programs.get(key)
+        if program is None:
+            from tpumetrics.runtime.bucketing import masked_functional_update
+
+            metric, kwargs = self._metric, self._update_kwargs
+            donate = (0,) if self._donate else ()
+
+            def run(s: Any, p: Tuple[Any, ...], n: Array) -> Any:
+                return masked_functional_update(metric, s, p, n, int(bucket), kwargs)
+
+            program = jax.jit(run, donate_argnums=donate)
+            self._programs[key] = program
+        return program(state, padded, n_valid)
+
+    def __deepcopy__(self, memo: dict) -> None:
+        # jitted programs are closed over the ORIGINAL metric objects; a
+        # deep-copied owner (MetricCollection.clone) must rebuild its own
+        # step lazily, so the copy carries no step at all
+        return None

@@ -17,7 +17,14 @@ replay positions).  See ``docs/runtime.md`` for the guide.
   state spec.
 - :mod:`~tpumetrics.runtime.evaluator` — :class:`StreamingEvaluator`, the
   facade tying the three together with ``compute_every(n)``
-  bounded-staleness results and clean queue-flushing shutdown.
+  bounded-staleness results and clean queue-flushing shutdown.  Bucketed
+  updates run ONE fused, buffer-donating XLA program per (bucket,
+  signature) for the whole collection
+  (:class:`~tpumetrics.parallel.fuse_update.FusedCollectionStep`).
+- :mod:`~tpumetrics.runtime.compile_cache` — JAX's persistent compilation
+  cache as a one-call option, so cold starts / preemption restarts /
+  elastic resizes reuse on-disk executables instead of re-compiling
+  (``docs/performance.md``).
 
 Multi-host: with ``snapshot_rank``/``snapshot_world_size`` set, snapshots
 become COORDINATED cuts (barrier-stamped, per-rank directories) and
@@ -31,6 +38,11 @@ from tpumetrics.runtime.bucketing import (
     check_bucketable,
     masked_functional_update,
     pow2_bucket_edges,
+)
+from tpumetrics.runtime.compile_cache import (
+    compilation_cache_info,
+    count_cache_hits,
+    enable_persistent_compilation_cache,
 )
 from tpumetrics.runtime.dispatch import AsyncDispatcher, DispatcherClosedError, QueueFullError
 from tpumetrics.runtime.evaluator import CrashLoopError, StreamingEvaluator
@@ -59,6 +71,9 @@ __all__ = [
     "SnapshotSpecError",
     "StreamingEvaluator",
     "check_bucketable",
+    "compilation_cache_info",
+    "count_cache_hits",
+    "enable_persistent_compilation_cache",
     "list_snapshots",
     "load_snapshot",
     "masked_functional_update",

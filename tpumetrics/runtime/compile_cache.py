@@ -1,0 +1,194 @@
+"""Persistent XLA compilation cache as a first-class runtime option.
+
+Every jitted program in the runtime — fused collection steps, per-bucket
+masked updates, functional computes — is recompiled from scratch by a fresh
+process: cold starts, preemption restarts, and elastic world resizes all
+pay the full XLA compile bill again even though they trace byte-identical
+programs.  JAX ships a persistent on-disk compilation cache that turns
+those recompiles into disk reads; this module surfaces it as a
+``tpumetrics.runtime`` option so the evaluator (and any embedding process)
+enables it in one call instead of three raw ``jax.config`` updates.
+
+Resolution order for the cache directory:
+
+1. the explicit ``cache_dir`` argument;
+2. ``$TPUMETRICS_COMPILE_CACHE``;
+3. ``$JAX_COMPILATION_CACHE_DIR`` (JAX's own env var — if the deployment
+   already sets it, this call only tightens the persistence thresholds).
+
+With no directory from any source the call is a no-op returning ``None`` —
+safe to run unconditionally.
+
+The defaults write EVERY compile to the cache (``min_compile_time_secs=0``,
+``min_entry_size_bytes=0``): metric update programs are small and fast to
+compile individually, exactly the entries JAX's default thresholds would
+skip, but a 10-metric collection times 7 buckets adds up to seconds of
+cold-start compile that the cache kills entirely (gated in bench.py's
+``compile_cache_cold_warm`` scenario).  See ``docs/performance.md``.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional
+
+import jax
+
+ENV_CACHE_DIR = "TPUMETRICS_COMPILE_CACHE"
+_JAX_ENV_CACHE_DIR = "JAX_COMPILATION_CACHE_DIR"
+
+
+def enable_persistent_compilation_cache(
+    cache_dir: Optional[str] = None,
+    *,
+    min_compile_time_secs: float = 0.0,
+    min_entry_size_bytes: int = 0,
+) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (resolution
+    order in the module docstring) and set the persistence thresholds.
+
+    Returns the resolved absolute cache directory (created if missing), or
+    ``None`` when no directory is configured anywhere (no-op).  Idempotent —
+    calling again with the same directory only refreshes the thresholds.
+    """
+    cache_dir = (
+        cache_dir or os.environ.get(ENV_CACHE_DIR) or os.environ.get(_JAX_ENV_CACHE_DIR)
+    )
+    if not cache_dir:
+        return None
+    cache_dir = os.path.abspath(os.fspath(cache_dir))
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", float(min_compile_time_secs))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", int(min_entry_size_bytes))
+    _rearm_cache_latch(cache_dir)
+    return cache_dir
+
+
+def _rearm_cache_latch(cache_dir: str) -> None:
+    """jax initializes its compilation cache ONCE, at the first compile: a
+    process that compiled anything before this call (an import-time jit, an
+    array built while wiring up the stream) latched the cache off, and the
+    config updates above would silently never take effect.  Detect the
+    latched-without-our-dir state and reset it so the NEXT compile
+    re-initializes against ``cache_dir`` (on-disk entries are untouched)."""
+    try:
+        from jax._src import compilation_cache as _cc
+
+        latched = _cc._cache_initialized or _cc._cache_checked
+        # the live cache's _path is a pathlib-like object — compare via
+        # os.fspath, or a same-dir re-enable would tear the cache down
+        # (StreamingEvaluator calls this on every construction)
+        path = getattr(_cc._cache, "_path", None)
+        stale = _cc._cache is not None and (
+            path is None or os.fspath(path) != cache_dir
+        )
+        if stale:
+            # jax's compilation cache is process-global: redirecting it tears
+            # down the live cache another consumer may be streaming against
+            from tpumetrics.utils.prints import rank_zero_warn
+
+            rank_zero_warn(
+                f"Redirecting the process-global persistent compilation cache "
+                f"from {os.fspath(path) if path is not None else '<unset>'} to "
+                f"{cache_dir}; programs already cached under the old directory "
+                "will recompile."
+            )
+        if (latched and _cc._cache is None) or stale:
+            _cc.reset_cache()
+    except Exception:  # private API: degrade to plain config updates
+        pass
+
+
+def compilation_cache_info() -> Dict[str, Any]:
+    """Inspect the active persistent cache: ``{"dir", "entries", "bytes"}``.
+
+    ``dir`` is ``None`` (and the counts zero) when no cache is configured;
+    entries count the on-disk executables the NEXT cold process would reuse.
+    """
+    cache_dir = jax.config.jax_compilation_cache_dir
+    if not cache_dir or not os.path.isdir(cache_dir):
+        return {"dir": cache_dir or None, "entries": 0, "bytes": 0}
+    entries = 0
+    total = 0
+    for root, _dirs, files in os.walk(cache_dir):
+        for f in files:
+            entries += 1
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return {"dir": cache_dir, "entries": entries, "bytes": total}
+
+
+# jax wraps compile-OR-cache-load in this one duration event; the hit path
+# additionally reports its retrieval time separately, so true compile
+# seconds = backend_compile - cache_retrieval
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_RETRIEVAL_EVENT = "/jax/compilation_cache/cache_retrieval_time_sec"
+
+# jax.monitoring has no unregister API, so exactly ONE listener pair is ever
+# registered (lazily, at the first count_cache_hits use); the context manager
+# pushes its counter dict here and pops it on exit, so repeated/nested use
+# adds nothing to jax's global listener list
+_active_counters: List[Dict[str, Any]] = []
+_listeners_registered = False
+
+
+def _event_listener(event: str, **_kwargs: Any) -> None:
+    for counter in _active_counters:
+        if event == "/jax/compilation_cache/cache_hits":
+            counter["hits"] += 1
+        elif event == "/jax/compilation_cache/cache_misses":
+            counter["misses"] += 1
+
+
+def _duration_listener(event: str, duration: float, **_kwargs: Any) -> None:
+    for counter in _active_counters:
+        if event == _BACKEND_COMPILE_EVENT:
+            counter["backend_compile_secs"] += float(duration)
+        elif event == _CACHE_RETRIEVAL_EVENT:
+            counter["cache_retrieval_secs"] += float(duration)
+
+
+@contextmanager
+def count_cache_hits() -> Iterator[Dict[str, Any]]:
+    """Count persistent-cache hits/misses and accumulate backend compile
+    seconds inside the ``with`` block via JAX's monitoring events — the
+    observable proof that a restarted or elastically resized process REUSED
+    executables instead of recompiling::
+
+        with count_cache_hits() as hits:
+            evaluator.restore_elastic()
+            ... resume streaming ...
+        assert hits["hits"] > 0 and hits["misses"] == 0
+
+    ``hits["backend_compile_secs"]`` sums jax's backend-compile duration
+    event.  That event times compile-OR-cache-load, so a cache hit still
+    contributes its (much cheaper) executable deserialization;
+    ``hits["cache_retrieval_secs"]`` sums exactly that part, making
+    ``backend_compile_secs - cache_retrieval_secs`` the true XLA compile
+    seconds paid — near zero for a fully warm process, while tracing and
+    dispatch time (which no cache can remove) still show up in wall time.
+
+    Safe to use repeatedly (or nested) in a long-lived process: one module
+    listener pair is registered once and dispatches to the counters of the
+    currently active ``with`` blocks only.
+    """
+    global _listeners_registered
+    counter: Dict[str, Any] = {
+        "hits": 0,
+        "misses": 0,
+        "backend_compile_secs": 0.0,
+        "cache_retrieval_secs": 0.0,
+    }
+    if not _listeners_registered:
+        jax.monitoring.register_event_listener(_event_listener)
+        jax.monitoring.register_event_duration_secs_listener(_duration_listener)
+        _listeners_registered = True
+    _active_counters.append(counter)
+    try:
+        yield counter
+    finally:
+        _active_counters.remove(counter)

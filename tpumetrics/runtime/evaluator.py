@@ -47,19 +47,24 @@ round-trips across preemption.
 
 from __future__ import annotations
 
+import os
 import threading
-from typing import Any, Dict, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 
 from tpumetrics.metric import Metric
+from tpumetrics.parallel.fuse_update import FusedCollectionStep
 from tpumetrics.runtime.bucketing import (
     ShapeBucketer,
     _is_per_row,
     check_bucketable,
-    masked_functional_update,
     pow2_bucket_edges,
+)
+from tpumetrics.runtime.compile_cache import (
+    ENV_CACHE_DIR,
+    enable_persistent_compilation_cache,
 )
 from tpumetrics.runtime.dispatch import AsyncDispatcher
 from tpumetrics.runtime import snapshot as _snapshot
@@ -107,6 +112,20 @@ class StreamingEvaluator:
         guard_non_finite: ``"off"``/``"warn"``/``"error"`` NaN/Inf screen on
             the state at every snapshot save (a poisoned state written to
             disk would survive restore and re-poison the stream).
+        donate_state: donate the state pytree to every jitted step (default
+            True) so XLA reuses the state buffers in place instead of
+            allocating fresh ones per batch.  The evaluator is the sole
+            owner of its functional state between steps, which is exactly
+            the donation contract (``docs/performance.md``); disable only
+            when external code holds references into ``_state``.
+        compile_cache_dir: enable JAX's persistent compilation cache rooted
+            here (:func:`tpumetrics.runtime.enable_persistent_compilation_cache`)
+            so cold starts, preemption restarts, and elastic resizes reuse
+            on-disk executables instead of recompiling every bucket step.
+            ``None`` falls back to ``$TPUMETRICS_COMPILE_CACHE`` if set and
+            is otherwise a no-op — in particular a deployment-level
+            ``$JAX_COMPILATION_CACHE_DIR`` is left entirely to jax (native
+            thresholds), never rewritten by this constructor.
         snapshot_rank / snapshot_world_size: enable COORDINATED multi-host
             snapshots (:mod:`tpumetrics.resilience.elastic`): this rank
             writes into ``snapshot_dir/rank-<NNNNN>/`` and every
@@ -136,6 +155,8 @@ class StreamingEvaluator:
         crash_policy: str = "raise",
         max_restores: int = 3,
         guard_non_finite: str = "off",
+        donate_state: bool = True,
+        compile_cache_dir: Optional[str] = None,
         snapshot_rank: Optional[int] = None,
         snapshot_world_size: Optional[int] = None,
         barrier_backend: Optional[Any] = None,
@@ -164,21 +185,35 @@ class StreamingEvaluator:
         self._max_restores = int(max_restores)
         self._guard_non_finite = guard_non_finite
 
+        # persistent compile cache first: every jit below benefits.  Only an
+        # explicit argument or tpumetrics' own env var opts in — a deployment
+        # that sets bare $JAX_COMPILATION_CACHE_DIR gets jax's native cache
+        # with jax's own thresholds, which this constructor must not rewrite
+        if compile_cache_dir is not None or os.environ.get(ENV_CACHE_DIR):
+            enable_persistent_compilation_cache(compile_cache_dir)
+
         if buckets is None:
             self._bucketer: Optional[ShapeBucketer] = None
             self._state: Optional[Dict[str, Any]] = None
+            self._step: Optional[FusedCollectionStep] = None
         else:
             edges = pow2_bucket_edges(int(buckets)) if isinstance(buckets, int) else tuple(buckets)
             self._bucketer = ShapeBucketer(edges)
             check_bucketable(metric)
             self._state = metric.init_state()
+            # ONE jitted program per (bucket, trace signature) covers the
+            # WHOLE collection, with the state pytree donated so XLA reuses
+            # its buffers in place — the evaluator owns the state between
+            # steps, so nothing else can observe the deleted inputs
+            self._step = FusedCollectionStep(
+                metric, update_kwargs=self._update_kwargs, donate=bool(donate_state)
+            )
 
         self._lock = threading.Lock()  # guards state/counters/latest across threads
         self._batches = 0  # submitted batches fully applied to the state
         self._items = 0  # rows applied
         self._latest: Optional[Dict[str, Any]] = None
         self._last_compute_at = 0
-        self._steps: Dict[Any, Any] = {}  # bucket edge (or "scalar") -> jitted step
         self._trace_signatures: set = set()  # (bucket, arg shapes/dtypes) seen
 
         # resilience bookkeeping: batches applied since the last snapshot
@@ -501,8 +536,10 @@ class StreamingEvaluator:
             base_b, base_i = bases_b.pop(), bases_i.pop()
             if self._bucketer is not None:
                 folded = self._metric.fold_state_dicts([cut.payloads[r] for r in ranks])
-                self._state = self._metric.reshard_state_dict(
-                    folded, self._rank, self._world, cat_placement=cat_placement
+                self._state = _device_state(
+                    self._metric.reshard_state_dict(
+                        folded, self._rank, self._world, cat_placement=cat_placement
+                    )
                 )
             else:
                 folded = self._metric.fold_snapshot_states(
@@ -565,7 +602,7 @@ class StreamingEvaluator:
         else:
             payload, header = got
             if self._bucketer is not None:
-                self._state = payload
+                self._state = _device_state(payload)
             else:
                 self._metric.load_snapshot_state(_as_snapshot_payload(payload))
             restored = int(header["meta"]["batches"])
@@ -703,21 +740,14 @@ class StreamingEvaluator:
         if not any(_is_per_row(a, n) for a in args):
             # scalar-only submit (e.g. an aggregation metric fed floats):
             # there is nothing to pad, so bucketing — and in particular the
-            # fallback's pad correction — must NOT apply; run one plain
-            # jitted update keyed separately from the bucket steps
-            step = self._steps.get("scalar")
-            if step is None:
-                metric, kwargs = self._metric, self._update_kwargs
-                step = self._steps["scalar"] = jax.jit(
-                    lambda state, a: metric.functional_update(state, *a, **kwargs)
-                )
+            # fallback's pad correction — must NOT apply; run the fused
+            # whole-collection step (donated state) over the raw args
             sig = ("scalar",) + tuple(
                 (tuple(jnp.shape(a)), str(jnp.result_type(a))) for a in args
             )
+            new_sig = sig not in self._trace_signatures
             self._trace_signatures.add(sig)
-            new_state = step(self._state, args)
-            with self._lock:
-                self._state = new_state
+            self._apply_step(new_sig, lambda s: self._step.update(s, *args))
             return n
         offset = 0
         for size in self._bucketer.chunk_sizes(n):
@@ -725,28 +755,46 @@ class StreamingEvaluator:
                 a[offset : offset + size] if _is_per_row(a, n) else a for a in args
             )
             padded, bucket = self._bucketer.pad_args(chunk, size)
-            step = self._steps.get(bucket)
-            if step is None:
-                step = self._steps[bucket] = self._make_step(bucket)
             # mirrors the jit cache key (shapes + dtypes; python scalars key
-            # by weak result type) — len() of this set == XLA compile count
+            # by weak result type) — len() of this set == XLA compile count,
+            # per (bucket, signature) for the WHOLE collection, never per
+            # member metric
             sig = (bucket,) + tuple(
                 (tuple(jnp.shape(a)), str(jnp.result_type(a))) for a in padded
             )
+            new_sig = sig not in self._trace_signatures
             self._trace_signatures.add(sig)
-            new_state = step(self._state, padded, jnp.asarray(size, jnp.int32))
-            with self._lock:
-                self._state = new_state
+            n_valid = jnp.asarray(size, jnp.int32)
+            self._apply_step(
+                new_sig,
+                lambda s, p=padded, b=bucket: self._step.masked_update(s, p, n_valid, b),
+            )
             offset += size
         return n
 
-    def _make_step(self, bucket: int) -> Any:
-        metric, kwargs = self._metric, self._update_kwargs
+    def _apply_step(self, new_sig: bool, run: Callable[[Any], Any]) -> None:
+        """Run one fused step over the current state and publish the result.
 
-        def step(state: Any, padded: Tuple[Any, ...], n_valid: Array) -> Any:
-            return masked_functional_update(metric, state, padded, n_valid, bucket, kwargs)
-
-        return jax.jit(step)
+        A donating dispatch DELETES the input buffers, so it must hold the
+        lock — a concurrently locked ``snapshot()``/``compute()`` must never
+        observe a state mid-donation.  But jit compiles at first dispatch,
+        and holding the lock through XLA would stall ``latest_result()``/
+        ``stats()`` (documented never-blocking) for the whole compile: a
+        cold trace signature is therefore pre-compiled OUTSIDE the lock on
+        a throwaway on-device copy of the state, making the locked dispatch
+        a cached one.  (The worker is the only thread that rebinds or
+        donates ``_state`` while streaming, so the unlocked copy is safe.)
+        Non-donating steps delete nothing and stay outside the lock
+        entirely, as before donation existed."""
+        if not self._step.donate:
+            new_state = run(self._state)
+            with self._lock:
+                self._state = new_state
+            return
+        if new_sig:
+            run(jax.tree_util.tree_map(lambda leaf: leaf.copy(), self._state))
+        with self._lock:
+            self._state = run(self._state)
 
     def _refresh_latest(self) -> None:
         with self._lock:
@@ -767,6 +815,19 @@ class StreamingEvaluator:
                 "value": value, "batches": batches, "items": items, "degraded": degraded,
             }
             self._last_compute_at = batches
+
+
+def _device_state(state: Any) -> Any:
+    """Adopted snapshot payloads carry host (numpy) leaves; the donated
+    fused step must only ever receive XLA-OWNED device buffers.  A plain
+    ``jnp.asarray`` is not enough: on the CPU backend the resulting array
+    can wrap host memory the device allocator does not own, and donating it
+    lets XLA reuse-then-release a foreign buffer — observed as heap
+    corruption (``malloc_consolidate: invalid chunk size``) on
+    jaxlib 0.4.37.  The explicit on-device ``.copy()`` materializes every
+    leaf into a buffer XLA allocated itself, which is exactly the
+    ``init_state`` freshness contract donation relies on."""
+    return jax.tree_util.tree_map(lambda leaf: jnp.asarray(leaf).copy(), state)
 
 
 def _leading_rows(args: Tuple[Any, ...]) -> int:
