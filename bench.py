@@ -401,6 +401,136 @@ def _bench_collection_sync_8dev():
     return ours, ref, accounting
 
 
+# ------------------------------------------- sharded one-program collection
+
+_SHARDED_COLLECTION_SCRIPT = r"""
+import os, sys, time, json
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+sys.path.insert(0, {repo_dir!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from tpumetrics import MetricCollection, telemetry
+from tpumetrics.classification import MulticlassAccuracy, MulticlassAUROC, MulticlassF1Score
+from tpumetrics.parallel import FusedCollectionStep, make_mesh
+
+C, B, N, STEPS, ROUNDS = 16, 1024, 8, 20, 3
+
+def make_col():
+    return MetricCollection({
+        "acc": MulticlassAccuracy(num_classes=C, average="micro", validate_args=False),
+        "f1": MulticlassF1Score(num_classes=C, average="macro", validate_args=False),
+        "auroc": MulticlassAUROC(num_classes=C, validate_args=False, thresholds=64),
+    })
+
+rng = np.random.default_rng(0)
+preds = jnp.asarray(jax.nn.softmax(jnp.asarray(rng.standard_normal((B, C), dtype=np.float32))))
+target = jnp.asarray(rng.integers(0, C, size=(B,)), dtype=jnp.int32)
+
+# ---- sharded mode: ONE global SPMD program per collection step
+col = make_col()
+col.establish_compute_groups(preds[:8], target[:8])
+mesh = make_mesh(N, "dp")
+step = FusedCollectionStep(col, mesh=mesh)
+state = step.init_state()
+with telemetry.capture() as led_trace:
+    state = step.update(state, preds, target)  # trace + compile
+spmd_collectives = led_trace.summary()["spmd_collectives"]
+
+sharded_times = []
+with telemetry.capture() as led_steady:
+    # the acceptance invariant: NOTHING touches the host between update()
+    # and compute() — the whole timed loop runs under a device->host
+    # transfer guard (a violation raises and fails the scenario loudly),
+    # and the eager-collective count over the loop must stay 0
+    with jax.transfer_guard_device_to_host("disallow"):
+        for _ in range(ROUNDS):
+            t0 = time.perf_counter()
+            for _ in range(STEPS):
+                state = step.update(state, preds, target)
+            jax.block_until_ready(jax.tree_util.tree_leaves(state))
+            sharded_times.append((time.perf_counter() - t0) / STEPS * 1e6)
+eager_collectives = led_steady.summary()["collectives_issued"]
+sharded_result = col.functional_compute(state)
+sharded_state = state
+
+# ---- baseline: the eager per-rank loop (the pre-sharding production path):
+# N per-rank states advanced by N Python-dispatched donated programs per
+# step over the per-rank shards, stitched back by an eager fold at compute
+col2 = make_col()
+col2.establish_compute_groups(preds[:8], target[:8])
+step2 = FusedCollectionStep(col2)
+shards_p = preds.reshape(N, B // N, C)
+shards_t = target.reshape(N, B // N)
+states = [step2.init_state() for _ in range(N)]
+for r in range(N):
+    states[r] = step2.update(states[r], shards_p[r], shards_t[r])  # compile
+per_rank_times = []
+for _ in range(ROUNDS):
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        for r in range(N):
+            states[r] = step2.update(states[r], shards_p[r], shards_t[r])
+    jax.block_until_ready(jax.tree_util.tree_leaves(states))
+    per_rank_times.append((time.perf_counter() - t0) / STEPS * 1e6)
+folded = col2.fold_state_dicts(states)
+per_rank_result = col2.functional_compute(folded)
+
+# ---- parity gates (in-scenario: a fast but wrong mode must fail loudly).
+# Integer states bit-exact — int sums are associativity-free, so the mesh
+# must not perturb them; float results allclose.
+for leader, st in sharded_state.items():
+    for attr, leaf in st.items():
+        a, b = np.asarray(leaf), np.asarray(folded[leader][attr])
+        if np.issubdtype(a.dtype, np.integer):
+            assert np.array_equal(a, b), f"int state diverged: {leader}/{attr}"
+        else:
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6, err_msg=f"{leader}/{attr}")
+for key, val in per_rank_result.items():
+    np.testing.assert_allclose(
+        np.asarray(sharded_result[key]), np.asarray(val), rtol=1e-5, atol=1e-6, err_msg=key
+    )
+assert eager_collectives == 0, f"eager collectives inside the sharded loop: {eager_collectives}"
+assert spmd_collectives > 0, "sharded trace recorded no in-trace collectives"
+
+print(json.dumps({
+    "sharded_us": min(sharded_times),
+    "per_rank_us": min(per_rank_times),
+    "spmd_collectives": spmd_collectives,
+    "eager_collectives_during_update": eager_collectives,
+}))
+"""
+
+
+def _bench_sharded_collection():
+    """One-program sharded collection step (8-virtual-device GSPMD mesh,
+    state as NamedSharding-ed arrays, in-trace psum) vs the eager per-rank
+    loop it replaces (8 per-rank donated programs per step + eager fold at
+    compute).  In-scenario asserts: zero device→host transfers and zero
+    eager collectives across the timed sharded loop (jax.transfer_guard +
+    ledger), integer states bit-exact against the per-rank fold."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    script = _SHARDED_COLLECTION_SCRIPT.replace("{repo_dir!r}", repr(_REPO))
+    out = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True, timeout=600, env=env
+    )
+    if out.returncode != 0:
+        raise RuntimeError(out.stderr[-2000:])
+    sub = json.loads(out.stdout.strip().splitlines()[-1])
+    ours = float(sub["sharded_us"])
+    ref = float(sub["per_rank_us"])
+    accounting = {
+        "on_accelerator": False,
+        "extras": {
+            "spmd_collectives": sub.get("spmd_collectives"),
+            "eager_collectives_during_update": sub.get("eager_collectives_during_update"),
+        },
+    }
+    return ours, ref, accounting
+
+
 # ------------------------------------------------------------------------ mAP
 
 
@@ -1552,6 +1682,12 @@ def _check_floors(headline_vs, details):
     # meaningfully cheaper than a cold one — the preemption/resize payoff
     for key, ceiling in gate.get("compile_cache_ceilings", {}).items():
         check_ceiling("compile_cache_cold_warm", key, ceiling, fail_on_error=True)
+    # sharded ceilings: the one-program SPMD step must issue ZERO eager
+    # collectives between update() and compute() (the zero-host-round-trip
+    # acceptance invariant; the transfer guard inside the scenario covers
+    # device->host transfers the same way)
+    for key, ceiling in gate.get("sharded_collection_ceilings", {}).items():
+        check_ceiling("sharded_collection_8dev", key, ceiling, fail_on_error=True)
     return violations
 
 
@@ -1570,6 +1706,7 @@ def main() -> None:
     details = {}
     for name, fn in (
         ("collection_sync_8dev", _bench_collection_sync_8dev),
+        ("sharded_collection_8dev", _bench_sharded_collection),
         ("map_ragged_update_compute", _bench_map),
         ("fid_stream_update", _bench_fid),
         ("lpips_stream_update", _bench_lpips),

@@ -44,5 +44,34 @@ def setup_ddp():
     )
 
 
+def cpu_mesh(world_size=NUM_DEVICES, axis_name="r"):
+    """THE standardized virtual-device CPU mesh for every mesh/shard_map/
+    sharded-state test (jaxlib CPU cannot run cross-process collectives —
+    "Multiprocess computations aren't implemented" — so single-process SPMD
+    over the forced 8-device platform above is the only way this box tests
+    the mesh path).  Tests import this instead of hand-rolling
+    ``Mesh(np.array(jax.devices()[:n]), ...)`` so the device-count
+    assumption lives in exactly one place."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.devices()[:world_size]
+    assert len(devices) == world_size, (
+        f"cpu_mesh({world_size}) needs {world_size} virtual devices, "
+        f"have {len(jax.devices())}"
+    )
+    return Mesh(np.array(devices), (axis_name,))
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def mesh8():
+    """The full 8-virtual-device data-parallel mesh (axis name ``"dp"``) —
+    the sharded-execution-mode fixture (tests/test_sharding.py)."""
+    return cpu_mesh(NUM_DEVICES, axis_name="dp")
+
+
 def pytest_configure(config):
     setup_ddp()

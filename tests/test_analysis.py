@@ -408,6 +408,152 @@ SHADOW_DYNAMIC_DECL = _src(
 )
 
 
+# ------------------------------------------------------------------- TPL304
+PARTITION_RULE_TP = _src(
+    """
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from tpumetrics.metric import Metric
+    from tpumetrics.parallel.sharding import StatePartitionRules
+
+    class M(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("scores", [], dist_reduce_fx="cat", capacity=64)
+
+        def update(self, x):
+            self._append_state("scores", x)
+
+        def compute(self):
+            return self.scores
+
+    RULES = StatePartitionRules([
+        ("scores/values", P("dp")),
+        ("score_buffer/values", P("dp")),
+        ("((", P("dp")),
+    ])
+    """
+)
+
+PARTITION_RULE_NEAR_MISS = _src(
+    """
+    import re
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from tpumetrics.metric import Metric
+    from tpumetrics.parallel.sharding import StatePartitionRules
+
+    class M(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("scores", [], dist_reduce_fx="cat", capacity=64)
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self._append_state("scores", x)
+            self.total = self.total + jnp.sum(x)
+
+        def compute(self):
+            return self.total
+
+    name = "scores"
+    RULES = StatePartitionRules([
+        (r"(^|/)scores/values$", P("dp")),   # matches the buffer field path
+        ("M/total", P()),                    # class-qualified form matches too
+        (rf"(^|/){re.escape(name)}$", P()),  # programmatic: undecidable, skipped
+        ("acc/total", P()),                  # leader-qualified: 'acc' is a dynamic
+        ("clf/scores/values", P("dp")),      # collection key -> undecidable, skipped
+    ])
+    """
+)
+
+
+def test_stale_partition_rule_true_positive():
+    """A renamed-state leftover and an uncompilable pattern are both TPL304;
+    the live pattern is not."""
+    assert _codes(analyze_source(PARTITION_RULE_TP)) == ["TPL304", "TPL304"]
+
+
+def test_stale_partition_rule_near_miss_negative():
+    """Suffix and class-qualified forms that match declared states,
+    programmatic patterns, and leader-qualified forms ('acc/total' — the
+    leader is a dynamic collection key) are undecidable and stay quiet."""
+    assert _codes(analyze_source(PARTITION_RULE_NEAR_MISS)) == []
+
+
+def test_stale_partition_rule_candidates_not_cached_across_indexes():
+    """The candidate-path set is cached ON the index: two analyses of
+    DIFFERENT sources in one process must each see their own states (an
+    id()-keyed cache on the module-lifetime rule instance served a freed
+    index's candidates to a new index reusing the same address)."""
+    # `other` declares 'ratings' instead of 'scores': under a leaked cache
+    # one of the two sources sees the other's candidates and its live rule
+    # gets (un)flagged — either count changes
+    other = PARTITION_RULE_TP.replace('"scores"', '"ratings"').replace(
+        '"scores/values"', '"ratings/values"'
+    )
+    for _ in range(30):
+        assert _codes(analyze_source(PARTITION_RULE_TP)) == ["TPL304", "TPL304"]
+        assert _codes(analyze_source(other)) == ["TPL304", "TPL304"]
+
+
+# ------------------------------------------- sharding calls in the taint pass
+SHARDING_TAINT_NEAR_MISS = _src(
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+    from tpumetrics.metric import Metric
+
+    class M(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, preds, mesh):
+            pinned = jax.lax.with_sharding_constraint(
+                preds, NamedSharding(mesh, PartitionSpec("dp"))
+            )
+            placed = jax.device_put(pinned, NamedSharding(mesh, PartitionSpec()))
+            self.total = self.total + jnp.sum(placed)
+
+        def compute(self):
+            return self.total
+    """
+)
+
+SHARDING_TAINT_TP = _src(
+    """
+    import jax
+    import jax.numpy as jnp
+    from tpumetrics.metric import Metric
+
+    class M(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, preds):
+            self.total = self.total + float(jax.device_put(preds, jax.devices()[0]))
+
+        def compute(self):
+            return self.total
+    """
+)
+
+
+def test_sharding_placement_is_not_a_host_transfer():
+    """device_put / with_sharding_constraint under a mesh keep data on
+    device: no TPL101 in update()-reachable code."""
+    assert _codes(analyze_source(SHARDING_TAINT_NEAR_MISS)) == []
+
+
+def test_device_put_result_is_still_traced():
+    """The placement result stays TRACED — a host coercion of it is still a
+    TPL101, so the taint teaching cannot be used to launder a sync."""
+    assert _codes(analyze_source(SHARDING_TAINT_TP)) == ["TPL101"]
+
+
 def test_shadow_state_true_positive():
     assert _codes(analyze_source(SHADOW_TP)) == ["TPL401"]
 

@@ -13,6 +13,7 @@ def test_floor_file_shape():
     assert set(data["floors"]) == {
         "headline",
         "collection_sync_8dev",
+        "sharded_collection_8dev",
         "map_ragged_update_compute",
         "fid_stream_update",
         "lpips_stream_update",
@@ -48,6 +49,11 @@ def test_floor_file_shape():
     assert data["compile_cache_ceilings"]["warm_cold_compile_ratio"] <= 0.5
     # the raised mAP floor pins the batched-matcher win (was 2.9 pre-batching)
     assert data["floors"]["map_ragged_update_compute"] >= 8.0
+    # the sharded one-program step must issue ZERO eager collectives between
+    # update() and compute() — the zero-host-round-trip acceptance invariant
+    # (never raise this ceiling; the wall floor only catches structural
+    # regressions, since 8 virtual devices oversubscribe this box's cores)
+    assert data["sharded_collection_ceilings"]["eager_collectives_during_update"] == 0
 
 
 def test_check_floors_flags_compile_regressions():
@@ -137,6 +143,27 @@ def test_check_floors_flags_compile_cache_regressions():
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and all("compile_cache_cold_warm" in v for v in violations)
     details["compile_cache_cold_warm"] = "error: AssertionError: resume diverged"
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and "scenario failed" in violations[0]
+
+
+def test_check_floors_flags_sharded_regressions():
+    """A sharded step that issued ANY eager collective between update() and
+    compute() (a silent fall-back to the stitched per-rank path) must trip
+    the gate even at a healthy wall ratio; an errored scenario entry (the
+    transfer guard or a parity assert raising in-scenario) trips it too."""
+    details = {
+        "sharded_collection_8dev": {"vs_baseline": 2.0, "eager_collectives_during_update": 3}
+    }
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("eager_collectives_during_update" in v for v in violations)
+    details["sharded_collection_8dev"]["eager_collectives_during_update"] = 0
+    assert bench._check_floors(headline_vs=1000.0, details=details) == []
+    # below the wall floor: a per-step retrace or eager fallback crept in
+    details["sharded_collection_8dev"]["vs_baseline"] = 0.1
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("sharded_collection_8dev" in v for v in violations)
+    details["sharded_collection_8dev"] = "error: Exception: device-to-host transfer"
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and "scenario failed" in violations[0]
 

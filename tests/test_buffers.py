@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from tests.helpers.testers import shard_map
 from tpumetrics.buffers import (
@@ -29,8 +29,7 @@ from tpumetrics.parallel import AxisBackend
 from tpumetrics.parallel.merge import merge_metric_states
 
 
-def _mesh(ws):
-    return Mesh(np.array(jax.devices()[:ws]), ("r",))
+from tests.conftest import cpu_mesh as _mesh  # noqa: E402 — shared virtual-device mesh
 
 
 class MaskedCatAUROC(Metric):

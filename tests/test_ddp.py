@@ -9,17 +9,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
+from tests.conftest import cpu_mesh as _mesh
 from tests.test_metric import DummyListMetric, DummyMeanMetric, DummyMetric
 from tpumetrics.parallel import AxisBackend
 from tpumetrics.parallel.merge import merge_metric_states
 
 from tests.helpers.testers import shard_map
-
-
-def _mesh(ws):
-    return Mesh(np.array(jax.devices()[:ws]), ("r",))
 
 
 @pytest.mark.parametrize("world_size", [2, 4, 8])

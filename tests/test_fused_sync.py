@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from tests.helpers.testers import shard_map
 from tpumetrics import MetricCollection
@@ -28,8 +28,7 @@ from tpumetrics.parallel.backend import AxisBackend
 from tpumetrics.parallel.fuse import FusedReducer
 
 
-def _mesh(ws=8):
-    return Mesh(np.array(jax.devices()[:ws]), ("r",))
+from tests.conftest import cpu_mesh as _mesh  # noqa: E402 — shared virtual-device mesh
 
 
 # ------------------------------------------------------------ FusedReducer

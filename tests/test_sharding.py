@@ -1,0 +1,486 @@
+"""Sharded-state execution mode: partition rules, one-program SPMD steps,
+zero host round trips, and elastic re-placement across mesh shapes.
+
+The GSPMD counterpart of test_fuse_update.py: metric state lives as
+``NamedSharding``-ed ``jax.Array``s on the 8-virtual-device CPU mesh
+(``tests/conftest.cpu_mesh`` — jaxlib CPU cannot run cross-process
+collectives, so single-process SPMD is how this box tests the mesh path),
+every collection step compiles to ONE global SPMD program, and
+``dist_reduce_fx`` folds lower to in-trace collectives.  Parity is against
+the plain eager path over the identical stream: integer states bit-exact,
+float states allclose.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tests.conftest import cpu_mesh
+from tpumetrics import MetricCollection, StreamingEvaluator, telemetry
+from tpumetrics.buffers import materialize
+from tpumetrics.classification import (
+    MulticlassAccuracy,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MulticlassStatScores,
+)
+from tpumetrics.image import PeakSignalNoiseRatio
+from tpumetrics.metric import Metric
+from tpumetrics.parallel import (
+    FusedCollectionStep,
+    StatePartitionRules,
+    make_mesh,
+    place_states,
+    state_paths,
+)
+from tpumetrics.regression import MeanSquaredError
+from tpumetrics.utils.data import dim_zero_cat
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+
+def _dp_mesh(n=8):
+    return cpu_mesh(n, axis_name="dp")
+
+
+class BufferRows(Metric):
+    """Native-valid samplewise metric: every valid input row is recorded, in
+    order, into a fixed-capacity MaskedBuffer (the list-state family)."""
+
+    full_state_update = False
+
+    def __init__(self, capacity=512, features=3, **kw):
+        super().__init__(**kw)
+        self.add_state(
+            "rows", default=[], dist_reduce_fx="cat",
+            capacity=capacity, feature_shape=(features,),
+        )
+
+    def update(self, x, valid=None):
+        self._append_state("rows", x, valid=valid)
+
+    def compute(self):
+        return dim_zero_cat(self.rows)
+
+
+# ------------------------------------------------------------ rules resolution
+
+
+class TestStatePartitionRules:
+    def test_scalars_always_replicate(self):
+        rules = StatePartitionRules([(".*", P("dp"))], data_axis="dp")
+        assert rules.spec_for("total", jnp.zeros(())) == P()
+        assert rules.spec_for("total", jnp.zeros((1,))) == P()
+        assert rules.spec_for("rows", jnp.zeros((16, 3))) == P("dp")
+
+    def test_first_match_wins_and_default_applies(self):
+        rules = StatePartitionRules(
+            [("rows/values$", P("dp")), ("rows", P())], data_axis="dp"
+        )
+        assert rules.spec_for("rows/values", jnp.zeros((16, 3))) == P("dp")
+        assert rules.spec_for("rows/other", jnp.zeros((16,))) == P()
+        assert rules.spec_for("unmatched", jnp.zeros((16,))) == P()
+
+    def test_invalid_regex_raises_typed(self):
+        with pytest.raises(TPUMetricsUserError, match="regex"):
+            StatePartitionRules([("((", P())])
+
+    def test_unknown_mesh_axis_raises_typed(self, mesh8):
+        rules = StatePartitionRules([("rows", P("model"))])
+        with pytest.raises(TPUMetricsUserError, match="mesh axis"):
+            rules.place(mesh8, {"rows": jnp.zeros((16, 3))})
+
+    def test_non_divisible_dim_demotes_to_replicated(self, mesh8):
+        rules = StatePartitionRules([("rows", P("dp"))], data_axis="dp")
+        placed = rules.place(mesh8, {"rows": jnp.zeros((10, 3))})  # 10 % 8 != 0
+        assert placed["rows"].sharding.spec == P()
+        placed = rules.place(mesh8, {"rows": jnp.zeros((16, 3))})
+        assert placed["rows"].sharding.spec == P("dp")
+
+    def test_state_paths_cover_buffers_and_nesting(self):
+        state = {"m": {"rows": BufferRows().init_state()["rows"], "total": jnp.zeros(())}}
+        paths = dict(state_paths(state))
+        assert set(paths) == {"m/rows/values", "m/rows/count", "m/rows/requested", "m/total"}
+
+    def test_for_metric_defaults(self):
+        rules = BufferRows().state_partition_rules(data_axis="dp")
+        state = BufferRows().init_state()
+        assert rules.spec_for("rows/values", state["rows"].values) == P("dp")
+        assert rules.spec_for("rows/count", state["rows"].count) == P()
+
+    def test_collection_rules_are_leader_agnostic(self):
+        col = MetricCollection({"b": BufferRows(), "mse": MeanSquaredError()})
+        rules = col.state_partition_rules(data_axis="dp")
+        # suffix-matching: any leader prefix resolves the same spec
+        assert rules.spec_for("b/rows/values", jnp.zeros((64, 3))) == P("dp")
+        assert rules.spec_for("renamed/rows/values", jnp.zeros((64, 3))) == P("dp")
+        assert rules.spec_for("mse/sum_squared_error", jnp.zeros((8,))) == P()
+
+    def test_stale_rule_warns_on_place(self, mesh8):
+        rules = StatePartitionRules([("long_gone/values", P("dp"))], data_axis="dp")
+        with pytest.warns(UserWarning, match="long_gone"):
+            rules.place(mesh8, {"rows": jnp.zeros((16, 3))})
+
+    def test_place_without_mesh_materializes_device_copies(self):
+        host = {"rows": np.ones((4, 3), np.float32)}
+        placed = place_states(None, None, host)
+        assert isinstance(placed["rows"], jax.Array)
+        np.testing.assert_array_equal(np.asarray(placed["rows"]), host["rows"])
+
+
+# ------------------------------------------------------- one-program parity
+
+
+def _class_stream(rng, n, num_classes=5, rows=(8, 64)):
+    out = []
+    for _ in range(n):
+        b = int(rng.integers(*rows))
+        out.append(
+            (
+                jnp.asarray(rng.standard_normal((b, num_classes)).astype(np.float32)),
+                jnp.asarray(rng.integers(0, num_classes, size=(b,)).astype(np.int32)),
+            )
+        )
+    return out
+
+
+def _sharded_vs_eager(make, stream, mesh, *, exact, buckets=(8, 64)):
+    """Drive a sharded StreamingEvaluator and a plain eager twin over the
+    identical stream; compare compute() and return both objects."""
+    ev = StreamingEvaluator(make(), buckets=buckets, mesh=mesh)
+    eager = make()
+    for batch in stream:
+        ev.submit(*batch)
+        eager.update(*batch)
+    got, want = ev.compute(), eager.compute()
+    ev.close()
+    if isinstance(want, dict):
+        assert set(got) == set(want)
+        pairs = [(got[k], want[k], k) for k in want]
+    else:
+        pairs = [(got, want, "value")]
+    for g, w, key in pairs:
+        if exact:
+            assert np.array_equal(np.asarray(g), np.asarray(w)), key
+        else:
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=1e-5, atol=1e-6, err_msg=key
+            )
+    return ev, eager
+
+
+class TestShardedParityFamilies:
+    def test_statscores_collection_int_states_bit_exact(self, mesh8):
+        rng = np.random.default_rng(0)
+
+        def make():
+            return MetricCollection(
+                {
+                    "acc": MulticlassAccuracy(num_classes=4, average="micro", validate_args=False),
+                    "prec": MulticlassPrecision(num_classes=4, average="macro", validate_args=False),
+                    "rec": MulticlassRecall(num_classes=4, average="macro", validate_args=False),
+                }
+            )
+
+        probe = _class_stream(rng, 1, num_classes=4)[0]
+        stream = _class_stream(rng, 8, num_classes=4)
+        ev_col, eager_col = None, None
+
+        def make_established():
+            col = make()
+            col.establish_compute_groups(*probe)
+            return col
+
+        ev = StreamingEvaluator(make_established(), buckets=(8, 64), mesh=mesh8)
+        eager = make_established()
+        for batch in stream:
+            ev.submit(*batch)
+            eager.update(*batch)
+        got, want = ev.compute(), eager.compute()
+        for k in want:
+            np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]), rtol=1e-6)
+        # the statscores GROUP leader's integer states must be bit-exact:
+        # integer sums are associativity-free, so sharding cannot perturb them
+        leader = next(iter(ev._state))
+        eager_leader = eager._modules[leader]
+        for attr, leaf in ev._state[leader].items():
+            assert leaf.dtype == eager_leader._defaults[attr].dtype
+            assert np.array_equal(
+                np.asarray(leaf), np.asarray(getattr(eager_leader, attr))
+            ), attr
+        # sharded mode reported in stats
+        assert ev.stats()["mesh"] == {"dp": 8}
+        ev.close()
+
+    def test_statscores_samplewise_int_bit_exact_direct_step(self, mesh8):
+        # samplewise statscores keeps per-class structure; direct (unbucketed)
+        # sharded step on fixed-size batches, int bit-exactness
+        rng = np.random.default_rng(1)
+        m = MulticlassStatScores(num_classes=5, average=None, validate_args=False)
+        step = FusedCollectionStep(m, mesh=mesh8)
+        state = step.init_state()
+        eager = MulticlassStatScores(num_classes=5, average=None, validate_args=False)
+        for _ in range(4):
+            preds = jnp.asarray(rng.standard_normal((64, 5)).astype(np.float32))
+            target = jnp.asarray(rng.integers(0, 5, size=(64,)).astype(np.int32))
+            state = step.update(state, preds, target)
+            eager.update(preds, target)
+        for attr in eager._defaults:
+            assert np.array_equal(
+                np.asarray(state[attr]), np.asarray(getattr(eager, attr))
+            ), attr
+
+    def test_regression_float(self, mesh8):
+        rng = np.random.default_rng(2)
+        stream = [
+            (
+                jnp.asarray(rng.standard_normal((int(n),)).astype(np.float32)),
+                jnp.asarray(rng.standard_normal((int(n),)).astype(np.float32)),
+            )
+            for n in rng.integers(4, 50, size=8)
+        ]
+        _sharded_vs_eager(MeanSquaredError, stream, mesh8, exact=False)
+
+    def test_image_float_min_max_states(self, mesh8):
+        # PSNR with tracked data range: exercises min/max reduces under GSPMD
+        rng = np.random.default_rng(3)
+        stream = [
+            (
+                jnp.asarray(rng.uniform(0, 4, size=(8, 3, 6, 6)).astype(np.float32)),
+                jnp.asarray(rng.uniform(0, 4, size=(8, 3, 6, 6)).astype(np.float32)),
+            )
+            for _ in range(5)
+        ]
+        _sharded_vs_eager(PeakSignalNoiseRatio, stream, mesh8, exact=False)
+
+    def test_samplewise_buffer_rows_order_exact(self, mesh8):
+        rng = np.random.default_rng(4)
+        batches = [
+            rng.standard_normal((int(n), 3)).astype(np.float32)
+            for n in rng.integers(1, 40, size=12)
+        ]
+        ev = StreamingEvaluator(BufferRows(), buckets=(8, 64), mesh=mesh8)
+        for b in batches:
+            ev.submit(jnp.asarray(b))
+        ev.flush()
+        got = np.asarray(materialize(ev._state["rows"]))
+        ev.close()
+        # ORDER-exact, not just set-equal: buffer rows land at the same
+        # logical offsets whether or not the capacity axis is distributed
+        assert np.array_equal(got, np.concatenate(batches))
+
+    def test_aggregation_scalar_submits(self, mesh8):
+        from tpumetrics import MeanMetric
+
+        rng = np.random.default_rng(5)
+        values = [float(v) for v in rng.standard_normal(10)]
+        ev = StreamingEvaluator(MeanMetric(), buckets=(8,), mesh=mesh8)
+        eager = MeanMetric()
+        for v in values:
+            ev.submit(v)
+            eager.update(v)
+        np.testing.assert_allclose(
+            np.asarray(ev.compute()), np.asarray(eager.compute()), rtol=1e-6
+        )
+        ev.close()
+
+    def test_collection_with_groups_mixed_kwargs_routing(self, mesh8):
+        rng = np.random.default_rng(6)
+
+        def make():
+            col = MetricCollection(
+                {
+                    "acc": MulticlassAccuracy(num_classes=4, average="micro", validate_args=False),
+                    "f1": MulticlassF1Score(num_classes=4, average="macro", validate_args=False),
+                    "stat": MulticlassStatScores(num_classes=4, average="macro", validate_args=False),
+                }
+            )
+            probe = _class_stream(np.random.default_rng(99), 1, num_classes=4)[0]
+            col.establish_compute_groups(*probe)
+            return col
+
+        stream = _class_stream(rng, 6, num_classes=4)
+        ev, eager = _sharded_vs_eager(make, stream, mesh8, exact=False)
+        # compute groups collapsed acc/f1/stat into one leader: the sharded
+        # state carries exactly the leader set
+        assert set(ev._state) == {cg[0] for cg in eager._groups.values()}
+
+
+# --------------------------------------------------- zero host round trips
+
+
+class TestZeroHostTransfers:
+    def test_update_loop_is_transfer_free(self, mesh8):
+        """Between update() and compute() nothing may touch the host: the
+        whole sharded update loop runs under a device→host transfer guard
+        (host→device input feeding is legitimate and stays allowed)."""
+        rng = np.random.default_rng(0)
+        col = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=5, average="micro", validate_args=False),
+                "f1": MulticlassF1Score(num_classes=5, average="macro", validate_args=False),
+            }
+        )
+        preds = jnp.asarray(rng.standard_normal((128, 5)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, 5, size=(128,)).astype(np.int32))
+        col.establish_compute_groups(preds[:8], target[:8])
+        step = FusedCollectionStep(col, mesh=mesh8)
+        state = step.init_state()
+        state = step.update(state, preds, target)  # compile outside the guard
+        with jax.transfer_guard_device_to_host("disallow"):
+            for _ in range(5):
+                state = step.update(state, preds, target)
+            jax.block_until_ready(jax.tree_util.tree_leaves(state))
+        # compute still sees everything (6 batches applied)
+        out = col.functional_compute(state)
+        assert np.isfinite(float(out["acc"]))
+
+    def test_trace_time_ledger_records_static_collectives(self, mesh8):
+        """GSPMD-inserted collectives report into the ledger at TRACE time
+        (op/bytes/axis, static=True, source='spmd') and never again on
+        steady-state steps — attribution with zero per-step host cost."""
+        m = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        step = FusedCollectionStep(m, mesh=mesh8)
+        state = step.init_state()
+        preds = jnp.asarray(np.random.default_rng(0).standard_normal((64, 4)), jnp.float32)
+        target = jnp.zeros((64,), jnp.int32)
+        with telemetry.capture() as led:
+            state = step.update(state, preds, target)  # traces -> records
+        s = led.summary()
+        assert s["spmd_collectives"] == len(m._defaults)  # one per reduce state
+        assert s["collectives_issued"] == 0  # no eager wire op at all
+        for rec in led.records:
+            assert rec.source == "spmd"
+            assert rec.in_trace is True
+            assert rec.extra["static"] is True
+            assert rec.extra["axis"] == "dp"
+            assert rec.op == "sum"
+            assert rec.world_size == 8
+        with telemetry.capture() as led2:
+            state = step.update(state, preds, target)  # cached: no re-trace
+        assert led2.summary()["records"] == 0
+
+    def test_sharded_program_contains_all_reduce(self, mesh8):
+        """The ONE compiled program really holds the in-trace collective the
+        partition rules imply (dist_reduce_fx='sum' → all-reduce over dp)."""
+        m = MeanSquaredError()
+        rules = m.state_partition_rules(data_axis="dp")
+        state = place_states(mesh8, rules, m.init_state())
+        preds = jnp.asarray(np.ones((64,), np.float32))
+        dp = jax.sharding.NamedSharding(mesh8, P("dp"))
+
+        def run(s, p, t):
+            s = rules.constrain(mesh8, s)
+            return rules.constrain(mesh8, m.functional_update(s, p, t))
+
+        lowered = jax.jit(run).lower(
+            state, jax.device_put(preds, dp), jax.device_put(preds * 0.5, dp)
+        )
+        assert "all-reduce" in lowered.compile().as_text()
+
+
+# ------------------------------------------------- elastic: re-place on mesh
+
+
+class TestElasticReplacement:
+    def _run(self, tmp_path, write_mesh, read_mesh, buckets=(8, 64)):
+        def make():
+            return MulticlassAccuracy(num_classes=5, average="micro", validate_args=False)
+
+        rng = np.random.default_rng(7)
+        stream = _class_stream(rng, 8, num_classes=5, rows=(8, 33))
+        root = str(tmp_path)
+
+        ev = StreamingEvaluator(
+            make(), buckets=buckets, mesh=write_mesh, snapshot_dir=root,
+            snapshot_rank=0, snapshot_world_size=1,
+        )
+        for batch in stream[:4]:
+            ev.submit(*batch)
+        ev.snapshot()
+        ev.close()
+
+        ev2 = StreamingEvaluator(
+            make(), buckets=buckets, mesh=read_mesh, snapshot_dir=root,
+            snapshot_rank=0, snapshot_world_size=1,
+        )
+        info = ev2.restore_elastic()
+        assert info is not None and info["batches"] == 4
+        # every restored leaf was re-placed under the NEW mesh
+        for _path, leaf in state_paths(ev2._state):
+            assert leaf.sharding.mesh.shape == read_mesh.shape
+        for batch in stream[4:]:
+            ev2.submit(*batch)
+        got = np.asarray(ev2.compute())
+        ev2.close()
+
+        ref = make()
+        st = ref.init_state()
+        for batch in stream:
+            st = ref.functional_update(st, *batch)
+        want = np.asarray(ref.functional_compute(st))
+        assert np.array_equal(got, want)  # bit-identical across the resize
+
+    def test_shrink_8_to_4(self, tmp_path):
+        self._run(tmp_path, _dp_mesh(8), _dp_mesh(4))
+
+    def test_grow_2_to_8(self, tmp_path):
+        self._run(tmp_path, _dp_mesh(2), _dp_mesh(8))
+
+    def test_buffer_state_replaced_and_order_kept(self, tmp_path, mesh8):
+        root = str(tmp_path)
+        rng = np.random.default_rng(8)
+        batches = [
+            rng.standard_normal((int(n), 3)).astype(np.float32)
+            for n in rng.integers(1, 30, size=8)
+        ]
+        ev = StreamingEvaluator(
+            BufferRows(), buckets=(8, 32), mesh=mesh8, snapshot_dir=root,
+            snapshot_rank=0, snapshot_world_size=1,
+        )
+        for b in batches[:5]:
+            ev.submit(jnp.asarray(b))
+        ev.snapshot()
+        ev.close()
+
+        mesh4 = _dp_mesh(4)
+        ev2 = StreamingEvaluator(
+            BufferRows(), buckets=(8, 32), mesh=mesh4, snapshot_dir=root,
+            snapshot_rank=0, snapshot_world_size=1,
+        )
+        assert ev2.restore_elastic() is not None
+        assert ev2._state["rows"].values.sharding.spec == P("dp")
+        assert ev2._state["rows"].values.sharding.mesh.shape == mesh4.shape
+        for b in batches[5:]:
+            ev2.submit(jnp.asarray(b))
+        ev2.flush()
+        got = np.asarray(materialize(ev2._state["rows"]))
+        ev2.close()
+        assert np.array_equal(got, np.concatenate(batches))
+
+
+# ------------------------------------------------------------- construction
+
+
+class TestConstruction:
+    def test_mesh_requires_buckets(self, mesh8):
+        with pytest.raises(ValueError, match="buckets"):
+            StreamingEvaluator(MeanSquaredError(), mesh=mesh8)
+
+    def test_rules_require_mesh(self):
+        with pytest.raises(TPUMetricsUserError, match="mesh"):
+            FusedCollectionStep(MeanSquaredError(), partition_rules=StatePartitionRules())
+
+    def test_bad_data_axis_raises(self, mesh8):
+        with pytest.raises(TPUMetricsUserError, match="data_axis"):
+            FusedCollectionStep(MeanSquaredError(), mesh=mesh8, data_axis="model")
+
+    def test_make_mesh_bounds(self):
+        assert tuple(make_mesh(4, "dp").shape.items()) == (("dp", 4),)
+        with pytest.raises(TPUMetricsUserError, match="available devices"):
+            make_mesh(10**6)

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from tests.helpers.testers import shard_map
 from tpumetrics import MetricCollection, telemetry
@@ -48,8 +48,7 @@ def _clean_telemetry():
     telemetry.configure(lockstep_verification=True)
 
 
-def _mesh(ws=8):
-    return Mesh(np.array(jax.devices()[:ws]), ("r",))
+from tests.conftest import cpu_mesh as _mesh  # noqa: E402 — shared virtual-device mesh
 
 
 def _bench_collection(C=16):

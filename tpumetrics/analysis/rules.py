@@ -24,6 +24,10 @@ TPL302 state-mutation          in-place mutation of an array state (subscript st
 TPL303 unshardable-state       array state declared with ``dist_reduce_fx=None`` — has no
                                world-size-independent meaning, so ``parallel/merge.py``
                                refuses to fold or elastically reshard it
+TPL304 stale-partition-rule    a literal ``StatePartitionRules`` regex that matches no
+                               state declared anywhere in the package (or does not
+                               compile) — the state it meant to shard is silently
+                               replicated
 TPL401 shadow-state            ``self.<attr>`` assigned in ``update()``-reachable code but
                                never declared via ``add_state`` — invisible to ``reset()``,
                                snapshots, and elastic fold/reshard
@@ -65,6 +69,7 @@ CATALOG: Dict[str, Tuple[str, str]] = {
     "TPL301": ("bad-state-default", "add_state default inconsistent with dist_reduce_fx"),
     "TPL302": ("state-mutation", "in-place mutation of an array state instead of reassignment"),
     "TPL303": ("unshardable-state", "array state with dist_reduce_fx=None cannot be folded/resharded"),
+    "TPL304": ("stale-partition-rule", "partition rule regex matches no declared state"),
     "TPL401": ("shadow-state", "attribute assigned in update()-reachable code but not declared via add_state"),
     "TPL900": ("syntax-error", "file could not be parsed"),
     "TPL901": ("unjustified-suppression", "tpulint disable comment without a justification"),
@@ -103,6 +108,16 @@ _HOST_NEUTRAL_CALLS = {
     "frozenset", "zip", "enumerate", "reversed", "map", "filter", "vars", "dir",
     "abs", "round", "sum", "divmod",
 }
+#: device placement / layout annotation under a mesh: the value STAYS on
+#: device (GSPMD resharding, not a host transfer) — the result is traced
+_SHARDING_TRACED_CALLS = {
+    "jax.device_put",
+    "jax.lax.with_sharding_constraint",
+    "jax.experimental.pjit.with_sharding_constraint",
+}
+#: mesh/spec/sharding constructors produce static placement METADATA
+_SHARDING_STATIC_PREFIXES = ("jax.sharding.",)
+_SHARDING_STATIC_CALLS = {"jax.make_mesh"}
 #: python builtins that truth-test or compare their argument element-wise —
 #: on a traced array that is a host sync (TracerBoolConversionError under jit)
 _PY_TRUTH_SINKS = {"any", "all", "min", "max", "sorted"}
@@ -709,6 +724,15 @@ class _TraceWalker:
                         f"`{_truncate(node)}` — use the jnp equivalent.",
                     )
                 return UNKNOWN
+            if dotted in _SHARDING_TRACED_CALLS:
+                # device_put / with_sharding_constraint move or annotate data
+                # ON DEVICE (a resharding is device↔device over ICI); they are
+                # not host transfers, and their result is traced
+                return TRACED
+            if dotted in _SHARDING_STATIC_CALLS or any(
+                dotted.startswith(p) for p in _SHARDING_STATIC_PREFIXES
+            ):
+                return HOST  # Mesh/PartitionSpec/NamedSharding: static metadata
             if dotted in ("jax.device_get", "jax.block_until_ready"):
                 if any_payload and self._sync_active():
                     self._report(
@@ -1033,4 +1057,118 @@ class ShadowStateRule:
         return False
 
 
-RULES = [TraceSafetyRule(), StateDeclRule(), ShadowStateRule()]
+class PartitionRuleDeclRule:
+    """TPL304: literal ``StatePartitionRules`` patterns that match no state
+    declared anywhere in the analyzed package.
+
+    Partition-rule regexes are matched at runtime against slash-joined state
+    pytree paths (``"<leader>/<state>"``, buffer fields as
+    ``"<state>/values"`` etc. — see ``tpumetrics/parallel/sharding.py``).  A
+    rule whose pattern matches nothing is not an error at runtime — the
+    state it meant to shard just stays silently replicated, which is exactly
+    the kind of quiet perf/semantics drift a rename produces.  Only literal
+    string patterns inside a literal list/tuple are decidable; patterns
+    built programmatically (f-strings, ``re.escape``) are skipped."""
+
+    codes = ("TPL304",)
+
+    def _candidate_paths(self, index: PackageIndex) -> Set[str]:
+        """Every path form a declared state can take in a state pytree:
+        the bare name, class-qualified, and buffer-field variants.  Cached
+        ON the index itself — rule instances are module-lifetime while a
+        fresh index is built per analyze call, so an id()-keyed cache here
+        would serve a freed index's candidates to a new index reusing the
+        same address (allocation-order-dependent lint results)."""
+        cached = getattr(index, "_tpl304_candidates", None)
+        if cached is not None:
+            return cached
+        out: Set[str] = set()
+        for mod in index.modules.values():
+            for ci in mod.classes.values():
+                for call, method in ci.add_state_calls:
+                    for state in _state_names_of_call(ci, call, method):
+                        out |= {
+                            state,
+                            f"{ci.name}/{state}",
+                            f"{state}/values",
+                            f"{state}/count",
+                            f"{state}/requested",
+                            f"{ci.name}/{state}/values",
+                            f"{state}/0",
+                        }
+        index._tpl304_candidates = out  # type: ignore[attr-defined]
+        return out
+
+    @staticmethod
+    def _leader_prefixed_match(pattern: str, candidates: Set[str]) -> bool:
+        """Collection state paths are ``"<leader>/<path>"`` where the
+        leader is a DYNAMIC collection key no static pass can know.  A
+        pattern like ``"acc/tp"`` that fails against every candidate may
+        still be live at runtime, so before flagging, retry each
+        ``/``-suffix of the pattern (``"tp"``) ANCHORED at the start of a
+        candidate — that is exactly where the tail would sit in a runtime
+        ``"<leader>/" + <metric path>`` match, and anchoring keeps a tail
+        like ``"values"`` from substring-matching ``"scores/values"`` and
+        excusing a genuinely stale rule.  A hit means the failure is
+        explained by an unknown leader prefix: undecidable, not stale."""
+        import re as _re
+
+        parts = pattern.split("/")
+        for k in range(1, len(parts)):
+            try:
+                tail = _re.compile("/".join(parts[k:]))
+            except _re.error:
+                continue  # splitting broke the regex: try a shorter suffix
+            if any(tail.match(c) for c in candidates):
+                return True
+        return False
+
+    def check(self, mod: ModuleInfo, index: PackageIndex) -> Iterator[Finding]:
+        if mod.tree is None:
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted_name(node.func, mod) or ""
+            if dotted.rpartition(".")[2] != "StatePartitionRules":
+                continue
+            rules_arg: Optional[ast.expr] = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "rules":
+                    rules_arg = kw.value
+            if not isinstance(rules_arg, (ast.List, ast.Tuple)):
+                continue  # programmatic rules: undecidable here
+            candidates = self._candidate_paths(index)
+            import re as _re
+
+            for pair in rules_arg.elts:
+                if not isinstance(pair, (ast.Tuple, ast.List)) or not pair.elts:
+                    continue
+                pat = pair.elts[0]
+                if not (isinstance(pat, ast.Constant) and isinstance(pat.value, str)):
+                    continue  # non-literal pattern: undecidable
+                try:
+                    compiled = _re.compile(pat.value)
+                except _re.error as err:
+                    yield Finding(
+                        "TPL304",
+                        f"partition rule pattern {pat.value!r} is not a valid regex: {err}.",
+                        mod.path, pat.lineno, pat.col_offset,
+                    )
+                    continue
+                if (
+                    candidates
+                    and not any(compiled.search(c) for c in candidates)
+                    and not self._leader_prefixed_match(pat.value, candidates)
+                ):
+                    yield Finding(
+                        "TPL304",
+                        f"partition rule pattern {pat.value!r} matches no state declared "
+                        "in this package: the state it meant to shard stays silently "
+                        "replicated. Patterns match slash-joined state paths "
+                        "('<leader>/<state>', buffer fields '<state>/values').",
+                        mod.path, pat.lineno, pat.col_offset,
+                    )
+
+
+RULES = [TraceSafetyRule(), StateDeclRule(), ShadowStateRule(), PartitionRuleDeclRule()]

@@ -1056,6 +1056,16 @@ class MetricCollection:
                 results[name] = m.functional_compute(synced_states[cg[0]])
         return self._flatten_results(results)
 
+    def state_partition_rules(self, data_axis: str = "dp") -> Any:
+        """Default partition rules over the collection's functional state
+        pytree (``"<leader>/<state>"`` paths): the union of every member's
+        :meth:`~tpumetrics.metric.Metric.state_partition_rules`, so the rule
+        set is stable under compute-group re-layout (rules are suffix-matched
+        and leader-agnostic)."""
+        from tpumetrics.parallel.sharding import StatePartitionRules
+
+        return StatePartitionRules.for_metric(self, data_axis=data_axis)
+
     def sync_states(
         self, state: Dict[str, Dict[str, Any]], backend: Any
     ) -> Dict[str, Dict[str, Any]]:

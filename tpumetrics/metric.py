@@ -847,6 +847,19 @@ class Metric(ABC):
         batch_val = self.functional_compute(batch_state, axis_name=axis_name, backend=backend)
         return new_state, batch_val
 
+    def state_partition_rules(self, data_axis: str = "dp") -> Any:
+        """Default :class:`~tpumetrics.parallel.sharding.StatePartitionRules`
+        for this metric's registered states: reduce-op states replicated
+        (their ``dist_reduce_fx`` lowers to an in-trace all-reduce under
+        GSPMD), ``cat``-style and declared-capacity buffer rows sharded
+        along ``data_axis``.  Consumed by the sharded
+        :class:`~tpumetrics.parallel.fuse_update.FusedCollectionStep` and
+        ``StreamingEvaluator(mesh=...)``; override per state by constructing
+        :class:`StatePartitionRules` with explicit ``(regex, spec)`` pairs."""
+        from tpumetrics.parallel.sharding import StatePartitionRules
+
+        return StatePartitionRules.for_metric(self, data_axis=data_axis)
+
     def sync_state(
         self, state: Dict[str, StateType], backend: DistributedBackend
     ) -> Dict[str, StateType]:

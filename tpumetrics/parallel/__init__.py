@@ -11,6 +11,12 @@ from tpumetrics.parallel.backend import (
     set_default_backend,
 )
 from tpumetrics.parallel.fuse_update import FusedCollectionStep, UnhashableKwargsError
+from tpumetrics.parallel.sharding import (
+    StatePartitionRules,
+    make_mesh,
+    place_states,
+    state_paths,
+)
 
 __all__ = [
     "AxisBackend",
@@ -18,8 +24,12 @@ __all__ = [
     "FusedCollectionStep",
     "MultiHostBackend",
     "NoOpBackend",
+    "StatePartitionRules",
     "UnhashableKwargsError",
     "distributed_available",
     "get_default_backend",
+    "make_mesh",
+    "place_states",
     "set_default_backend",
+    "state_paths",
 ]

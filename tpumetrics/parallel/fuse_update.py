@@ -40,7 +40,9 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from tpumetrics.telemetry import ledger as _telemetry
 from tpumetrics.utils.exceptions import TPUMetricsUserError
 
 Array = jax.Array
@@ -100,7 +102,7 @@ def gather_donatable_state(
     - an attribute assigned from outside (``load_snapshot_state``, manual
       assignment): ``jnp.asarray`` over host data can wrap memory the
       device allocator does not own, and donating such a buffer corrupts
-      the heap (see ``_device_state`` in ``runtime/evaluator.py``);
+      the heap (see :func:`tpumetrics.parallel.sharding.place_states`);
     - the same array object at two leaves: XLA cannot donate one buffer
       twice.
     """
@@ -141,6 +143,19 @@ class FusedCollectionStep:
             never traced.
         donate: donate the state pytree to XLA (default True) — the module
             docstring's ownership contract applies.
+        mesh: a :class:`jax.sharding.Mesh` enabling **sharded execution
+            mode**: the state pytree is placed as ``NamedSharding``-ed
+            arrays per ``partition_rules``, per-row batch arguments are
+            sharded along ``data_axis``, and every transition compiles to
+            ONE global SPMD program whose cross-shard folds XLA lowers to
+            in-trace ``all-reduce``/``all-gather`` over the mesh axis —
+            zero host round trips from ``update()`` to ``compute()``.
+        partition_rules: a
+            :class:`~tpumetrics.parallel.sharding.StatePartitionRules`
+            overriding the registry-derived defaults (scalars and reduce-op
+            states replicated, ``cat``/buffer rows sharded on ``data_axis``).
+        data_axis: mesh axis the batch (and concat-style states) shard
+            along; defaults to the mesh's first axis name.
 
     One Python-visible program exists per (static kwargs, bucket) key;
     within a program XLA still specializes per input trace signature, which
@@ -154,12 +169,36 @@ class FusedCollectionStep:
         leaders: Optional[List[str]] = None,
         update_kwargs: Optional[Dict[str, Any]] = None,
         donate: bool = True,
+        mesh: Optional[Mesh] = None,
+        partition_rules: Optional[Any] = None,
+        data_axis: Optional[str] = None,
     ) -> None:
         from tpumetrics.collections import MetricCollection
         from tpumetrics.metric import Metric
+        from tpumetrics.parallel.sharding import StatePartitionRules
 
         if not isinstance(metric, (Metric, MetricCollection)):
             raise TypeError(f"Expected Metric or MetricCollection, got {type(metric)}")
+        if mesh is None and (partition_rules is not None or data_axis is not None):
+            raise TPUMetricsUserError(
+                "partition_rules/data_axis require a mesh (sharded execution mode)."
+            )
+        self._mesh = mesh
+        if mesh is not None:
+            self._data_axis = data_axis if data_axis is not None else mesh.axis_names[0]
+            if self._data_axis not in mesh.axis_names:
+                raise TPUMetricsUserError(
+                    f"data_axis {self._data_axis!r} is not a mesh axis "
+                    f"{tuple(mesh.axis_names)}"
+                )
+            self._rules = (
+                partition_rules
+                if partition_rules is not None
+                else StatePartitionRules.for_metric(metric, data_axis=self._data_axis)
+            )
+        else:
+            self._data_axis = None
+            self._rules = None
         self._metric = metric
         self._is_collection = isinstance(metric, MetricCollection)
         if leaders is not None and not self._is_collection:
@@ -191,6 +230,16 @@ class FusedCollectionStep:
         return self._donate
 
     @property
+    def mesh(self) -> Optional[Mesh]:
+        """The mesh of sharded execution mode (None = single-device mode)."""
+        return self._mesh
+
+    @property
+    def partition_rules(self) -> Optional[Any]:
+        """Active :class:`StatePartitionRules` in sharded mode, else None."""
+        return self._rules
+
+    @property
     def program_count(self) -> int:
         """Jitted programs built so far — one per (static kwargs / bucket)
         key, NOT per trace signature (XLA's per-shape specialization lives
@@ -200,24 +249,106 @@ class FusedCollectionStep:
     # ------------------------------------------------------------ transitions
 
     def init_state(self) -> Dict[str, Any]:
-        """Fresh state pytree covering exactly the fused leaders."""
+        """Fresh state pytree covering exactly the fused leaders; in sharded
+        mode the pytree is placed on the mesh per the partition rules."""
         if not self._is_collection:
-            return self._metric.init_state()
-        self._metric._compute_groups_create_state_ref(copy=False)
-        return {name: self._metric._modules[name].init_state() for name in self._leaders}
+            state = self._metric.init_state()
+        else:
+            self._metric._compute_groups_create_state_ref(copy=False)
+            state = {name: self._metric._modules[name].init_state() for name in self._leaders}
+        return self.place(state) if self._mesh is not None else state
+
+    def place(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        """(Re-)place a state pytree for this step: ``NamedSharding``-ed
+        device arrays per rule in sharded mode, donation-safe on-device
+        materialization otherwise.  THE elastic path for sharded states —
+        restoring a snapshot onto a different mesh shape is exactly this
+        call on the folded pytree (:func:`~tpumetrics.parallel.sharding.
+        place_states`); no mesh-specific fold/reshard branch exists."""
+        from tpumetrics.parallel.sharding import place_states
+
+        return place_states(self._mesh, self._rules, state)
+
+    def _record_implied_collectives(self, state: Dict[str, Any]) -> None:
+        """Ledger records for the collectives GSPMD inserts into the sharded
+        program: each reduce-op array state's batch-fold lowers to one
+        in-trace all-reduce over the data axis.  Runs INSIDE the trace, so it
+        fires once per compile with static metadata only (shape/dtype of a
+        tracer are compile-time constants) — attribution stays complete with
+        zero per-step host cost.  Records carry ``source="spmd"`` and
+        ``static=True`` so eager wire accounting never conflates them."""
+        if not _telemetry.recording():
+            return
+        from tpumetrics.metric import _reduce_fn_to_op
+
+        world = int(self._mesh.shape[self._data_axis])
+        if self._is_collection:
+            per_leader = [
+                (name, self._metric._modules[name], state[name]) for name in self._leaders
+            ]
+        else:
+            per_leader = [(type(self._metric).__name__, self._metric, state)]
+        for tag, m, leader_state in per_leader:
+            for attr, reduction_fn in m._reductions.items():
+                op = _reduce_fn_to_op(reduction_fn)
+                leaf = leader_state.get(attr)
+                if op not in ("sum", "mean", "max", "min") or not hasattr(leaf, "dtype"):
+                    continue
+                _telemetry.record_collective(
+                    self, "sharded_collective", op, tuple(jnp.shape(leaf)), leaf.dtype,
+                    jnp.dtype(leaf.dtype).itemsize, world, in_trace=True,
+                    source="spmd", tag=f"{tag}/{attr}",
+                    static=True, axis=self._data_axis,
+                )
 
     def _transition(
         self, state: Dict[str, Any], args: Tuple[Any, ...], kwargs: Dict[str, Any]
     ) -> Dict[str, Any]:
         """The traced body: every fused leader's functional_update, inline in
-        ONE trace — XLA fuses the member programs and shares the batch."""
+        ONE trace — XLA fuses the member programs and shares the batch.  In
+        sharded mode the state layout is pinned with
+        ``with_sharding_constraint`` on entry and exit, so the ONE program
+        GSPMD partitions keeps scalars replicated (their batch-folds become
+        in-trace all-reduces) and concat rows distributed."""
+        sharded = self._mesh is not None
+        if sharded:
+            state = self._rules.constrain(self._mesh, state)
+            self._record_implied_collectives(state)
         if not self._is_collection:
-            return self._metric.functional_update(state, *args, **kwargs)
-        out = {}
-        for name in self._leaders:
-            m0 = self._metric._modules[name]
-            out[name] = m0.functional_update(state[name], *args, **m0._filter_kwargs(**kwargs))
-        return out
+            out: Any = self._metric.functional_update(state, *args, **kwargs)
+        else:
+            out = {}
+            for name in self._leaders:
+                m0 = self._metric._modules[name]
+                out[name] = m0.functional_update(
+                    state[name], *args, **m0._filter_kwargs(**kwargs)
+                )
+        return self._rules.constrain(self._mesh, out) if sharded else out
+
+    def _place_args(self, args: Tuple[Any, ...]) -> Tuple[Any, ...]:
+        """Commit per-batch array arguments to the mesh: per-row arrays
+        (leading dim divisible by the data-axis size) shard along
+        ``data_axis``, everything else replicates.  Host→device input
+        placement — never a device→host transfer, so a
+        ``jax.transfer_guard_device_to_host`` around the update loop stays
+        silent."""
+        if self._mesh is None:
+            return args
+        world = int(self._mesh.shape[self._data_axis])
+        out = []
+        for a in args:
+            try:
+                arr = jnp.asarray(a)
+            except (TypeError, ValueError):
+                out.append(a)  # host object (string, ...): untouched
+                continue
+            spec = (
+                PartitionSpec(self._data_axis)
+                if arr.ndim >= 1 and arr.shape[0] > 1 and arr.shape[0] % world == 0
+                else PartitionSpec()
+            )
+            out.append(jax.device_put(arr, NamedSharding(self._mesh, spec)))
+        return tuple(out)
 
     def update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """One fused, donated state transition over an (unpadded) batch.
@@ -266,7 +397,7 @@ class FusedCollectionStep:
                     "kwarg that varies per batch belongs in a positional array "
                     "argument, or on the unfused update path."
                 )
-        return program(state, tuple(args))
+        return program(state, self._place_args(tuple(args)))
 
     def masked_update(
         self, state: Dict[str, Any], padded: Tuple[Any, ...], n_valid: Array, bucket: int
@@ -290,13 +421,18 @@ class FusedCollectionStep:
 
             metric, kwargs = self._metric, self._update_kwargs
             donate = (0,) if self._donate else ()
+            sharded = self._mesh is not None
 
             def run(s: Any, p: Tuple[Any, ...], n: Array) -> Any:
-                return masked_functional_update(metric, s, p, n, int(bucket), kwargs)
+                if sharded:
+                    s = self._rules.constrain(self._mesh, s)
+                    self._record_implied_collectives(s)
+                out = masked_functional_update(metric, s, p, n, int(bucket), kwargs)
+                return self._rules.constrain(self._mesh, out) if sharded else out
 
             program = jax.jit(run, donate_argnums=donate)
             self._programs[key] = program
-        return program(state, padded, n_valid)
+        return program(state, self._place_args(tuple(padded)), n_valid)
 
     def __deepcopy__(self, memo: dict) -> None:
         # jitted programs are closed over the ORIGINAL metric objects; a

@@ -1,0 +1,347 @@
+"""Partition rules: metric state pytrees as first-class sharded ``jax.Array``s.
+
+This is the layer that collapses the four historical parallel code paths —
+eager per-rank backends, the in-trace :class:`AxisBackend`, the
+``parallel/merge.py`` fold/reshard pair, and elastic restore's re-placement
+— into ONE abstraction: a state pytree plus a
+:class:`jax.sharding.PartitionSpec` per leaf.
+
+- :class:`StatePartitionRules` maps state pytree **paths** (slash-joined
+  names, e.g. ``"acc/tp"`` or ``"scores/values"`` for a
+  :class:`~tpumetrics.buffers.MaskedBuffer` field) to ``PartitionSpec``s via
+  an ordered list of ``(regex, spec)`` pairs — the ``match_partition_rules``
+  idiom.  Scalars are replicated unconditionally; anything no rule matches
+  takes the default spec (replicated unless overridden).
+- :func:`place_states` turns a host/abstract state pytree into
+  ``NamedSharding``-ed device arrays on a mesh — and with ``mesh=None`` it
+  degrades to the donation-safe on-device materialization the runtime used
+  to do ad hoc (``_device_state``), so restore, elastic re-placement, and
+  fresh initialization are all the same operation: *place this pytree under
+  these rules*.
+- :meth:`StatePartitionRules.constrain` applies
+  ``jax.lax.with_sharding_constraint`` per rule inside a trace, which is how
+  the sharded :class:`~tpumetrics.parallel.fuse_update.FusedCollectionStep`
+  pins state layout through ONE global SPMD program: the batch is sharded
+  along the data axis, reduce-``dist_reduce_fx`` states stay replicated, and
+  XLA's GSPMD partitioner lowers the cross-shard fold to in-trace
+  ``all-reduce``/``all-gather`` collectives over the mesh axis — no host
+  round trip between ``update()`` and ``compute()``.
+
+Elastic restore on a *different* mesh is then literally "re-place the same
+pytree": the folded global state is mesh-shape-independent, so
+``place_states(new_mesh, rules, state)`` is the whole resize story for
+sharded states (no sharded branch in ``parallel/merge.py`` at all).
+
+Default specs per state kind (see ``docs/jit_and_sharding.md``):
+
+====================== ==========================================
+state kind             default spec
+====================== ==========================================
+scalar / 1-element     replicated ``P()`` (always, rules ignored)
+sum/mean/max/min array replicated ``P()`` (GSPMD inserts the psum)
+``cat`` array/list     ``P(data_axis)`` on the concat axis (dim 0)
+buffer ``values``      ``P(data_axis)`` on the capacity axis
+buffer count/requested replicated ``P()``
+====================== ==========================================
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+from tpumetrics.utils.prints import rank_zero_warn
+
+Array = jax.Array
+P = PartitionSpec
+
+__all__ = [
+    "StatePartitionRules",
+    "make_mesh",
+    "place_states",
+    "state_paths",
+]
+
+
+def make_mesh(
+    world_size: Optional[int] = None,
+    axis_name: str = "dp",
+    devices: Optional[Sequence[Any]] = None,
+) -> Mesh:
+    """A 1-D data-parallel :class:`jax.sharding.Mesh` over the first
+    ``world_size`` devices (default: all).  The one mesh shape metric
+    evaluation needs — metric state is replicated or concat-axis sharded,
+    never model-parallel."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if world_size is not None:
+        if world_size > len(devs):
+            raise TPUMetricsUserError(
+                f"make_mesh(world_size={world_size}) exceeds the {len(devs)} "
+                "available devices."
+            )
+        devs = devs[:world_size]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def _iter_paths(state: Any, prefix: str = "") -> Iterator[Tuple[str, Any]]:
+    from tpumetrics.buffers import MaskedBuffer
+
+    if isinstance(state, dict):
+        for key, val in state.items():
+            yield from _iter_paths(val, f"{prefix}{key}/")
+    elif isinstance(state, MaskedBuffer):
+        base = prefix[:-1] if prefix else ""
+        yield f"{base}/values" if base else "values", state.values
+        yield f"{base}/count" if base else "count", state.count
+        yield f"{base}/requested" if base else "requested", state.requested
+    elif isinstance(state, (list, tuple)):
+        for i, val in enumerate(state):
+            yield from _iter_paths(val, f"{prefix}{i}/")
+    elif state is None:
+        return
+    else:
+        yield prefix[:-1], state
+
+
+def state_paths(state: Any) -> List[Tuple[str, Any]]:
+    """Flatten a state pytree into ``(path, leaf)`` pairs.  Paths are
+    slash-joined dict keys (collection states prefix the group-leader name:
+    ``"acc/tp"``), :class:`MaskedBuffer` leaves expand to their
+    ``values``/``count``/``requested`` fields, and list elements use their
+    index.  This is the name space partition-rule regexes match against."""
+    return list(_iter_paths(state))
+
+
+def _map_state(fn: Callable[[str, Any], Any], state: Any, prefix: str = "") -> Any:
+    """Structure-preserving map over a state pytree with the same path
+    convention as :func:`state_paths`."""
+    from tpumetrics.buffers import MaskedBuffer
+
+    if isinstance(state, dict):
+        return {k: _map_state(fn, v, f"{prefix}{k}/") for k, v in state.items()}
+    if isinstance(state, MaskedBuffer):
+        base = prefix[:-1] if prefix else ""
+        join = (lambda f: f"{base}/{f}") if base else (lambda f: f)
+        return MaskedBuffer(
+            values=fn(join("values"), state.values),
+            count=fn(join("count"), state.count),
+            requested=fn(join("requested"), state.requested),
+        )
+    if isinstance(state, (list, tuple)):
+        mapped = [_map_state(fn, v, f"{prefix}{i}/") for i, v in enumerate(state)]
+        return type(state)(mapped) if isinstance(state, tuple) else mapped
+    if state is None:
+        return None
+    return fn(prefix[:-1], state)
+
+
+class StatePartitionRules:
+    """Ordered ``(regex, PartitionSpec)`` rules over state pytree paths.
+
+    The first rule whose pattern ``re.search``-matches a leaf's path wins;
+    scalars (0-d or single-element leaves) are always replicated, and leaves
+    no rule matches take ``default``.  A spec naming a mesh axis that does
+    not evenly divide the leaf's dimension is demoted to replicated for that
+    leaf (``jax.device_put`` refuses uneven shards; correctness never
+    depends on a leaf being distributed).
+
+    Args:
+        rules: sequence of ``(pattern, spec)`` pairs, checked in order.
+        data_axis: the mesh axis name concat-style states shard along; used
+            by :meth:`for_metric` when deriving default rules and recorded
+            for telemetry attribution.
+        default: spec for unmatched non-scalar leaves (replicated ``P()``).
+    """
+
+    def __init__(
+        self,
+        rules: Sequence[Tuple[str, PartitionSpec]] = (),
+        *,
+        data_axis: str = "dp",
+        default: PartitionSpec = P(),
+    ) -> None:
+        self.data_axis = str(data_axis)
+        self.default = default
+        self._rules: List[Tuple[str, Any, PartitionSpec]] = []
+        for pattern, spec in rules:
+            try:
+                compiled = re.compile(pattern)
+            except re.error as err:
+                raise TPUMetricsUserError(
+                    f"Invalid partition-rule regex {pattern!r}: {err}"
+                ) from None
+            self._rules.append((pattern, compiled, spec))
+        self._warned_stale = False
+
+    # ------------------------------------------------------------- derivation
+
+    @classmethod
+    def for_metric(cls, metric: Any, data_axis: str = "dp") -> "StatePartitionRules":
+        """Default rules derived from a Metric / MetricCollection's state
+        registry: ``cat``-reduce states and declared-capacity buffer
+        ``values`` shard along ``data_axis`` (their row/concat axis carries
+        per-example data); every reduce-op scalar/array state stays
+        replicated, which is what lets GSPMD lower its ``dist_reduce_fx``
+        to an in-trace all-reduce."""
+        from tpumetrics.collections import MetricCollection
+        from tpumetrics.metric import Metric
+        from tpumetrics.utils.data import dim_zero_cat
+
+        if isinstance(metric, MetricCollection):
+            members: List[Metric] = list(metric._modules.values())
+        elif isinstance(metric, Metric):
+            members = [metric]
+        else:
+            raise TypeError(f"Expected Metric or MetricCollection, got {type(metric)}")
+
+        rules: List[Tuple[str, PartitionSpec]] = []
+        seen: set = set()
+
+        def _add(pattern: str, spec: PartitionSpec) -> None:
+            if pattern not in seen:
+                seen.add(pattern)
+                rules.append((pattern, spec))
+
+        for m in members:
+            for attr, reduction_fn in m._reductions.items():
+                escaped = re.escape(attr)
+                if attr in m._buffer_specs:
+                    _add(rf"(^|/){escaped}/values$", P(data_axis))
+                elif reduction_fn is dim_zero_cat:
+                    # array form matches "attr", functional list form "attr/0"
+                    _add(rf"(^|/){escaped}(/\d+)*$", P(data_axis))
+        return cls(rules, data_axis=data_axis)
+
+    # -------------------------------------------------------------- resolution
+
+    @property
+    def patterns(self) -> List[str]:
+        return [pattern for pattern, _c, _s in self._rules]
+
+    def spec_for(self, path: str, leaf: Any) -> PartitionSpec:
+        """The spec for one leaf: scalars replicate, first matching rule
+        wins, else the default."""
+        ndim = getattr(leaf, "ndim", 0)
+        size = int(np.prod(getattr(leaf, "shape", ()) or (1,)))
+        if ndim == 0 or size <= 1:
+            return P()
+        for _pattern, compiled, spec in self._rules:
+            if compiled.search(path) is not None:
+                return spec
+        return self.default
+
+    def _resolved_spec(self, mesh: Mesh, path: str, leaf: Any) -> PartitionSpec:
+        """:meth:`spec_for` with the mesh in hand: demote specs whose named
+        axes do not evenly divide the leaf dimension they shard."""
+        spec = self.spec_for(path, leaf)
+        shape = tuple(getattr(leaf, "shape", ()))
+        for dim, entry in enumerate(spec):
+            if entry is None:
+                continue
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            factor = 1
+            for ax in axes:
+                if ax not in mesh.shape:
+                    raise TPUMetricsUserError(
+                        f"Partition rule for state {path!r} names mesh axis {ax!r} "
+                        f"but the mesh axes are {tuple(mesh.axis_names)}."
+                    )
+                factor *= int(mesh.shape[ax])
+            if dim >= len(shape) or shape[dim] % factor != 0:
+                return P()
+        return spec
+
+    def sharding_tree(self, mesh: Mesh, state: Any) -> Any:
+        """A pytree of :class:`NamedSharding` congruent with ``state``."""
+        return _map_state(
+            lambda path, leaf: NamedSharding(mesh, self._resolved_spec(mesh, path, leaf)),
+            state,
+        )
+
+    def unmatched(self, state: Any) -> List[str]:
+        """Rule patterns that match NO path of ``state`` — a stale regex
+        silently replicates the state it meant to shard.  The static
+        analyzer flags literal stale rules as TPL304; this is the runtime
+        companion for programmatic rules."""
+        paths = [path for path, _leaf in state_paths(state)]
+        return [
+            pattern
+            for pattern, compiled, _spec in self._rules
+            if not any(compiled.search(p) for p in paths)
+        ]
+
+    def _warn_stale(self, state: Any) -> None:
+        if self._warned_stale:
+            return
+        self._warned_stale = True
+        stale = self.unmatched(state)
+        if stale:
+            rank_zero_warn(
+                f"Partition rule(s) {stale} match no state in the pytree being "
+                "placed — the states they meant to shard stay replicated "
+                "(tpulint TPL304 flags literal rules like this statically). "
+                f"Declared paths: {[p for p, _ in state_paths(state)]}"
+            )
+
+    # -------------------------------------------------------------- placement
+
+    def place(self, mesh: Optional[Mesh], state: Any) -> Any:
+        """Device-put every leaf of ``state`` under its resolved
+        :class:`NamedSharding` — or, with ``mesh=None``, materialize every
+        leaf into a fresh XLA-owned on-device buffer (the unsharded runtime
+        path; see :func:`place_states` for why a plain ``jnp.asarray`` is
+        not enough).  Either way the result is donation-safe: every buffer
+        was allocated by XLA for this pytree alone."""
+        if mesh is None:
+            return _map_state(lambda _path, leaf: jnp.asarray(leaf).copy(), state)
+        self._warn_stale(state)
+        return _map_state(
+            lambda path, leaf: jax.device_put(
+                leaf, NamedSharding(mesh, self._resolved_spec(mesh, path, leaf))
+            ),
+            state,
+        )
+
+    def constrain(self, mesh: Mesh, state: Any) -> Any:
+        """Pin ``state``'s layout inside a trace with
+        ``jax.lax.with_sharding_constraint`` per resolved rule — the sharded
+        step applies this to its input AND output state so donation reuses
+        buffers in place and GSPMD cannot migrate layouts between steps."""
+        return _map_state(
+            lambda path, leaf: jax.lax.with_sharding_constraint(
+                leaf, NamedSharding(mesh, self._resolved_spec(mesh, path, leaf))
+            ),
+            state,
+        )
+
+    def __repr__(self) -> str:
+        rules = ", ".join(f"({p!r}, {s})" for p, _c, s in self._rules)
+        return f"StatePartitionRules([{rules}], data_axis={self.data_axis!r})"
+
+
+def place_states(mesh: Optional[Mesh], rules: Optional[StatePartitionRules], state: Any) -> Any:
+    """Place a state pytree: ``NamedSharding``-ed device arrays on ``mesh``
+    per ``rules`` (``rules=None`` → replicate everything), or — with
+    ``mesh=None`` — donation-safe on-device materialization.
+
+    The ``mesh=None`` branch exists because restored/host pytrees carry
+    numpy leaves, and the donated fused step must only ever receive
+    XLA-OWNED buffers: a plain ``jnp.asarray`` on the CPU backend can wrap
+    host memory the device allocator does not own, and donating such a
+    buffer lets XLA reuse-then-release a foreign allocation — observed as
+    heap corruption (``malloc_consolidate``) on jaxlib 0.4.37.  An explicit
+    on-device copy (or a real ``device_put`` under a sharding) materializes
+    every leaf into a buffer XLA allocated itself.
+
+    This one function is the restore path, the elastic re-place-on-a-new-
+    mesh path, and the fresh-state placement path — there is no separate
+    fold/reshard branch for sharded states."""
+    if rules is None:
+        rules = StatePartitionRules()
+    return rules.place(mesh, state)

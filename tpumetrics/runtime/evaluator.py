@@ -118,6 +118,19 @@ class StreamingEvaluator:
             owner of its functional state between steps, which is exactly
             the donation contract (``docs/performance.md``); disable only
             when external code holds references into ``_state``.
+        mesh: a :class:`jax.sharding.Mesh` enabling **sharded execution
+            mode** (requires ``buckets``): the state pytree lives as
+            ``NamedSharding``-ed ``jax.Array``s placed per
+            ``partition_rules``, batches shard along ``data_axis``, and
+            every collection step runs as ONE global SPMD program whose
+            ``dist_reduce_fx`` folds lower to in-trace collectives — zero
+            host round trips from ``update()`` to ``compute()``, and
+            :meth:`restore_elastic` becomes "re-place the same pytree on
+            this (possibly different) mesh".
+        partition_rules: optional
+            :class:`~tpumetrics.parallel.sharding.StatePartitionRules`
+            override (default: derived from the metric's state registry).
+        data_axis: mesh axis batches shard along (default: first mesh axis).
         compile_cache_dir: enable JAX's persistent compilation cache rooted
             here (:func:`tpumetrics.runtime.enable_persistent_compilation_cache`)
             so cold starts, preemption restarts, and elastic resizes reuse
@@ -160,6 +173,9 @@ class StreamingEvaluator:
         snapshot_rank: Optional[int] = None,
         snapshot_world_size: Optional[int] = None,
         barrier_backend: Optional[Any] = None,
+        mesh: Optional[Any] = None,
+        partition_rules: Optional[Any] = None,
+        data_axis: Optional[str] = None,
     ) -> None:
         from tpumetrics.collections import MetricCollection
 
@@ -192,6 +208,12 @@ class StreamingEvaluator:
         if compile_cache_dir is not None or os.environ.get(ENV_CACHE_DIR):
             enable_persistent_compilation_cache(compile_cache_dir)
 
+        if mesh is not None and buckets is None:
+            raise ValueError(
+                "mesh (sharded execution mode) requires buckets: sharded steps "
+                "ride the functional/jitted path."
+            )
+        self._mesh = mesh
         if buckets is None:
             self._bucketer: Optional[ShapeBucketer] = None
             self._state: Optional[Dict[str, Any]] = None
@@ -200,14 +222,17 @@ class StreamingEvaluator:
             edges = pow2_bucket_edges(int(buckets)) if isinstance(buckets, int) else tuple(buckets)
             self._bucketer = ShapeBucketer(edges)
             check_bucketable(metric)
-            self._state = metric.init_state()
             # ONE jitted program per (bucket, trace signature) covers the
             # WHOLE collection, with the state pytree donated so XLA reuses
             # its buffers in place — the evaluator owns the state between
-            # steps, so nothing else can observe the deleted inputs
+            # steps, so nothing else can observe the deleted inputs.  With a
+            # mesh, that one program is a global SPMD program over all mesh
+            # devices and the state lives as NamedSharding-ed arrays.
             self._step = FusedCollectionStep(
-                metric, update_kwargs=self._update_kwargs, donate=bool(donate_state)
+                metric, update_kwargs=self._update_kwargs, donate=bool(donate_state),
+                mesh=mesh, partition_rules=partition_rules, data_axis=data_axis,
             )
+            self._state = self._step.init_state()
 
         self._lock = threading.Lock()  # guards state/counters/latest across threads
         self._batches = 0  # submitted batches fully applied to the state
@@ -345,6 +370,11 @@ class StreamingEvaluator:
                 items=self._items,
                 xla_compiles=len(self._trace_signatures),
                 buckets=list(self._bucketer.edges) if self._bucketer else None,
+                mesh=(
+                    {str(k): int(v) for k, v in self._mesh.shape.items()}
+                    if self._mesh is not None
+                    else None
+                ),
                 degraded=self._degraded,
                 crashes=self._crashes,
                 restores=self._restores,
@@ -535,8 +565,12 @@ class StreamingEvaluator:
                 )
             base_b, base_i = bases_b.pop(), bases_i.pop()
             if self._bucketer is not None:
+                # world-level fold/reshard first (rank shares of the stream),
+                # then RE-PLACE the pytree on this evaluator's mesh — the
+                # entire mesh-resize story for sharded states is this one
+                # placement call; there is no sharded fold/reshard branch
                 folded = self._metric.fold_state_dicts([cut.payloads[r] for r in ranks])
-                self._state = _device_state(
+                self._state = self._place_state(
                     self._metric.reshard_state_dict(
                         folded, self._rank, self._world, cat_placement=cat_placement
                     )
@@ -577,6 +611,20 @@ class StreamingEvaluator:
                 "missing_ranks": list(cut.missing),
             }
 
+    def _place_state(self, payload: Any) -> Any:
+        """Adopted snapshot payloads carry host (numpy) leaves; the donated
+        fused step must only ever receive XLA-OWNED device buffers, and in
+        sharded mode the leaves must land under their partition rules.  Both
+        are the same operation — place the pytree
+        (:func:`tpumetrics.parallel.sharding.place_states`): on-device
+        materialization without a mesh (a plain ``jnp.asarray`` can wrap
+        host memory the device allocator does not own — donating such a
+        buffer corrupted the heap on jaxlib 0.4.37), ``NamedSharding``
+        placement with one.  Restoring a snapshot written under a DIFFERENT
+        mesh shape needs nothing more: the pytree is mesh-shape-independent
+        and this call is the entire re-placement."""
+        return self._step.place(payload)
+
     def _load_latest_snapshot(self) -> Optional[Tuple[Any, Dict[str, Any]]]:
         """(payload, header) of the newest valid snapshot, or ``None``."""
         if self._snapshots is None:
@@ -593,7 +641,7 @@ class StreamingEvaluator:
         contract cannot drift between them.  Returns the adopted position."""
         if got is None:
             if self._bucketer is not None:
-                self._state = self._metric.init_state()
+                self._state = self._step.init_state()
             else:
                 self._metric.reset()
             restored, items, degraded = 0, 0, False
@@ -602,7 +650,7 @@ class StreamingEvaluator:
         else:
             payload, header = got
             if self._bucketer is not None:
-                self._state = _device_state(payload)
+                self._state = self._place_state(payload)
             else:
                 self._metric.load_snapshot_state(_as_snapshot_payload(payload))
             restored = int(header["meta"]["batches"])
@@ -815,19 +863,6 @@ class StreamingEvaluator:
                 "value": value, "batches": batches, "items": items, "degraded": degraded,
             }
             self._last_compute_at = batches
-
-
-def _device_state(state: Any) -> Any:
-    """Adopted snapshot payloads carry host (numpy) leaves; the donated
-    fused step must only ever receive XLA-OWNED device buffers.  A plain
-    ``jnp.asarray`` is not enough: on the CPU backend the resulting array
-    can wrap host memory the device allocator does not own, and donating it
-    lets XLA reuse-then-release a foreign buffer — observed as heap
-    corruption (``malloc_consolidate: invalid chunk size``) on
-    jaxlib 0.4.37.  The explicit on-device ``.copy()`` materializes every
-    leaf into a buffer XLA allocated itself, which is exactly the
-    ``init_state`` freshness contract donation relies on."""
-    return jax.tree_util.tree_map(lambda leaf: jnp.asarray(leaf).copy(), state)
 
 
 def _leading_rows(args: Tuple[Any, ...]) -> int:

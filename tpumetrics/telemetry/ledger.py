@@ -79,8 +79,11 @@ class CollectiveRecord:
     (``DistributedBackend.all_gather``/``all_reduce``); ``"reducer"`` records
     are the logical per-(op, dtype) classes a :class:`FusedReducer` flush
     hands to the backend (useful for attribution even under a custom,
-    uninstrumented backend); ``"event"`` records are bookkeeping marks
-    (flushes, lockstep fingerprints) that carry no payload.
+    uninstrumented backend); ``"spmd"`` records are the GSPMD-inserted
+    in-trace collectives of a sharded step, recorded at trace time with
+    ``extra["static"]=True`` (once per compile, no per-step host cost);
+    ``"event"`` records are bookkeeping marks (flushes, lockstep
+    fingerprints) that carry no payload.
     """
 
     kind: str  # "all_gather" | "all_reduce" | "fused_class" | "flush" | "lockstep" | ...
@@ -94,7 +97,7 @@ class CollectiveRecord:
     tag: str  # attribution path, e.g. "acc/MulticlassAccuracy"
     world_size: int
     in_trace: bool
-    source: str = "backend"  # "backend" | "reducer" | "event"
+    source: str = "backend"  # "backend" | "reducer" | "spmd" | "event"
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
@@ -131,6 +134,12 @@ class CollectiveLedger:
             self.wire_bytes_total += rec.wire_bytes
             self.payload_bytes_total += rec.payload_bytes
             self.bytes_by_op[rec.op] = self.bytes_by_op.get(rec.op, 0.0) + rec.wire_bytes
+        elif rec.source == "spmd":
+            # GSPMD-inserted in-trace collectives of a sharded step, recorded
+            # at trace time (static metadata, once per compile) — kept apart
+            # from eager wire accounting so neither pollutes the other
+            self.spmd_collectives += 1
+            self.spmd_wire_bytes += rec.wire_bytes
         elif rec.kind == "flush":
             self.flush_count += 1
             self.fused_entries += int(rec.extra.get("entries", 0))
@@ -205,6 +214,8 @@ class CollectiveLedger:
         self.elastic_barriers = 0
         self.elastic_restores = 0
         self.elastic_degraded_cuts = 0
+        self.spmd_collectives = 0
+        self.spmd_wire_bytes = 0.0
         self.bytes_by_op: Dict[str, float] = {}
         self.counts_by_kind: Dict[str, int] = {}
 
@@ -244,6 +255,8 @@ class CollectiveLedger:
             "elastic_barriers": self.elastic_barriers,
             "elastic_restores": self.elastic_restores,
             "elastic_degraded_cuts": self.elastic_degraded_cuts,
+            "spmd_collectives": self.spmd_collectives,
+            "spmd_wire_bytes": self.spmd_wire_bytes,
             "records": len(self.records),
         }
 
