@@ -1357,6 +1357,156 @@ def _bench_streaming_throughput():
     return ours, ref, {"extras": extras}
 
 
+def _bench_multitenant_scaling():
+    """16 same-fingerprint tenants through ONE EvaluationService vs 16
+    independent (sequentially-run) StreamingEvaluators over identical
+    streams — the ISSUE 8 acceptance scenario.
+
+    ``vs_baseline`` = sequential_wall / service_wall.  The service's wins
+    are structural: ONE worker thread instead of 16, ONE fused-step trace
+    universe instead of 16 (global signature dedupe — every evaluator
+    re-traces its own step per bucket even when the persistent compile
+    cache serves the XLA binary), and the megabatch fast path driving up to
+    16 same-signature updates through one vmapped device program.
+
+    In-scenario asserts (loud failures, not drifting numbers):
+
+    - per-tenant parity: every tenant's compute() is BIT-IDENTICAL to its
+      sequential-evaluator twin (integer statscores states);
+    - signature dedupe: the service's distinct XLA compiles <= the
+      16-evaluator total (the acceptance "<= 1x the distinct compiles");
+    - the megabatch path actually engaged.
+
+    Extras carry the 1000-stream soak: 1000 tenants over 4 distinct
+    configurations registered on one service, p99 submit-call latency
+    gated by ``multitenant_ceilings.soak_p99_submit_ms`` (submit is an
+    enqueue + a signature probe — it must stay off the device path no
+    matter how many streams share the worker).
+    """
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from tpumetrics.classification import MulticlassAccuracy
+    from tpumetrics.runtime import EvaluationService, StreamingEvaluator
+
+    T, C, BATCHES = 16, 16, 8
+
+    def make():
+        return MulticlassAccuracy(num_classes=C, average="micro", validate_args=False)
+
+    rng = np.random.default_rng(8)
+    streams = [
+        [
+            (
+                jnp.asarray(np.random.default_rng(100 * i + j).standard_normal((int(n), C), dtype=np.float32)),
+                jnp.asarray(np.random.default_rng(100 * i + j).integers(0, C, int(n)).astype(np.int32)),
+            )
+            for j, n in enumerate(rng.integers(8, 33, BATCHES))
+        ]
+        for i in range(T)
+    ]
+
+    def service_once():
+        svc = EvaluationService()
+        handles = [svc.register(f"t{i}", make(), buckets=[32]) for i in range(T)]
+        t0 = time.perf_counter()
+        for j in range(BATCHES):
+            for i in range(T):
+                handles[i].submit(*streams[i][j])
+        vals = [float(h.compute()) for h in handles]
+        wall = (time.perf_counter() - t0) * 1e6
+        stats = svc.stats()
+        svc.close()
+        return wall, vals, stats
+
+    def sequential_once():
+        t0 = time.perf_counter()
+        vals, compiles = [], 0
+        for i in range(T):
+            ev = StreamingEvaluator(make(), buckets=[32])
+            with ev:
+                for p, t in streams[i]:
+                    ev.submit(p, t)
+                vals.append(float(ev.compute()))
+            compiles += ev.stats()["xla_compiles"]
+        wall = (time.perf_counter() - t0) * 1e6
+        return wall, vals, compiles
+
+    s_times, q_times = [], []
+    svc_vals = seq_vals = None
+    svc_stats = None
+    seq_compiles = None
+    for _ in range(3):
+        wall, svc_vals, svc_stats = service_once()
+        s_times.append(wall)
+        wall, seq_vals, seq_compiles = sequential_once()
+        q_times.append(wall)
+    ours, ref = min(s_times), min(q_times)
+
+    assert svc_vals == seq_vals, "multi-tenant parity broke: service != sequential"
+    svc_compiles = svc_stats["xla_compiles"]
+    # the acceptance bound: 16 tenants for <= 1x the baseline's compiles
+    # (in practice ~6 megabatch-K programs vs 16 per-evaluator traces)
+    assert svc_compiles <= seq_compiles, (
+        f"signature dedupe regressed: service compiled {svc_compiles} distinct "
+        f"signatures vs {seq_compiles} across 16 evaluators"
+    )
+    assert svc_stats["shared_steps"] == 1, "same-fingerprint tenants did not share a step"
+    assert svc_stats["megabatch_steps"] > 0, "megabatch fast path never engaged"
+
+    # ---- 1000-stream soak: p99 submit latency stays enqueue-shaped --------
+    SOAK_T, SOAK_BATCHES = 1000, 2
+    svc = EvaluationService()
+    soak_handles = []
+    for i in range(SOAK_T):
+        classes = (8, 12, 16, 24)[i % 4]
+        m = MulticlassAccuracy(num_classes=classes, average="micro", validate_args=False)
+        soak_handles.append((svc.register(f"s{i}", m, buckets=[16]), classes))
+    lat_ms = []
+    soak_batches = {
+        classes: (
+            jnp.asarray(np.random.default_rng(classes).standard_normal((16, classes), dtype=np.float32)),
+            jnp.asarray(np.random.default_rng(classes).integers(0, classes, 16).astype(np.int32)),
+        )
+        for classes in (8, 12, 16, 24)
+    }
+    for _ in range(SOAK_BATCHES):
+        for h, classes in soak_handles:
+            p, t = soak_batches[classes]
+            t0 = time.perf_counter()
+            h.submit(p, t)
+            lat_ms.append((time.perf_counter() - t0) * 1e3)
+    svc.flush()
+    soak_p99 = float(np.percentile(lat_ms, 99))
+    soak_stats = svc.stats()
+    # spot-check correctness under the soak: every stream fully applied,
+    # sampled tenants compute the same value as a direct functional run
+    for h, classes in soak_handles[::250]:
+        assert h.stats()["batches"] == SOAK_BATCHES, h.stats()
+        m = MulticlassAccuracy(num_classes=classes, average="micro", validate_args=False)
+        s = m.init_state()
+        for _ in range(SOAK_BATCHES):
+            s = m.functional_update(s, *soak_batches[classes])
+        assert float(h.compute()) == float(m.functional_compute(s))
+    svc.close()
+
+    extras = {
+        "tenants": T,
+        "service_compiles": svc_compiles,
+        "sequential_compiles": seq_compiles,
+        "compile_ratio": round(svc_compiles / max(seq_compiles, 1), 3),
+        "megabatch_steps": svc_stats["megabatch_steps"],
+        "megabatch_tenants": svc_stats["megabatch_tenants"],
+        "shared_steps": svc_stats["shared_steps"],
+        "soak_streams": SOAK_T,
+        "soak_p99_submit_ms": round(soak_p99, 3),
+        "soak_shared_steps": soak_stats["shared_steps"],
+        "soak_compiles": soak_stats["xla_compiles"],
+    }
+    return ours, ref, {"extras": extras}
+
+
 def _bench_resilience_overhead():
     """Cost of the SyncPolicy guard when NO fault fires (tpumetrics.resilience).
 
@@ -1670,6 +1820,11 @@ def _check_floors(headline_vs, details):
     # compile ceilings: a bucketed config recompiling per shape is a regression
     for name, ceiling in gate.get("compile_ceilings", {}).items():
         check_ceiling(name, "streaming_compiles", ceiling, fail_on_error=True)
+    # multi-tenant ceilings: the 1000-stream soak's p99 submit latency must
+    # stay enqueue-shaped (an errored scenario also trips the gate — its
+    # parity/dedupe asserts never ran)
+    for key, ceiling in gate.get("multitenant_ceilings", {}).items():
+        check_ceiling("multitenant_scaling", key, ceiling, fail_on_error=True)
     # elastic ceilings: the 8->4 fold+reshard restore must stay interactive
     # (a restore that takes minutes would eat the preemption grace window)
     for key, ceiling in gate.get("elastic_restore_ceilings", {}).items():
@@ -1714,6 +1869,7 @@ def main() -> None:
         ("fused_collection_update", _bench_fused_collection_update),
         ("compile_cache_cold_warm", _bench_compile_cache_cold_warm),
         ("streaming_throughput", _bench_streaming_throughput),
+        ("multitenant_scaling", _bench_multitenant_scaling),
         ("resilience_overhead", _bench_resilience_overhead),
         ("elastic_restore", _bench_elastic_restore),
         ("analysis_runtime", _bench_analysis_runtime),

@@ -21,6 +21,7 @@ def test_floor_file_shape():
         "fused_collection_update",
         "compile_cache_cold_warm",
         "streaming_throughput",
+        "multitenant_scaling",
         "resilience_overhead",
         "elastic_restore",
     }
@@ -54,6 +55,11 @@ def test_floor_file_shape():
     # (never raise this ceiling; the wall floor only catches structural
     # regressions, since 8 virtual devices oversubscribe this box's cores)
     assert data["sharded_collection_ceilings"]["eager_collectives_during_update"] == 0
+    # 16 tenants through one service must beat 16 sequential evaluators
+    # >= 2x (ISSUE 8 acceptance) and the 1000-stream soak's p99 submit
+    # latency must stay enqueue-shaped
+    assert data["floors"]["multitenant_scaling"] >= 2.0
+    assert data["multitenant_ceilings"]["soak_p99_submit_ms"] > 0
 
 
 def test_check_floors_flags_compile_regressions():
@@ -67,6 +73,25 @@ def test_check_floors_flags_compile_regressions():
     details["streaming_throughput"]["streaming_compiles"] = 7
     assert bench._check_floors(headline_vs=1000.0, details=details) == []
     details["streaming_throughput"] = "error: RuntimeError: boom"
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and "scenario failed" in violations[0]
+
+
+def test_check_floors_flags_multitenant_regressions():
+    """A 1000-stream soak whose p99 submit latency blew past the ceiling
+    (a device step or compile leaking onto the submit path) must trip the
+    gate even at a healthy 16-tenant throughput ratio; a scaling ratio
+    below the floor, and an errored scenario (the in-scenario parity /
+    dedupe asserts never ran), trip it too."""
+    details = {"multitenant_scaling": {"vs_baseline": 100.0, "soak_p99_submit_ms": 5000.0}}
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("soak_p99_submit_ms" in v for v in violations)
+    details["multitenant_scaling"]["soak_p99_submit_ms"] = 0.5
+    assert bench._check_floors(headline_vs=1000.0, details=details) == []
+    details["multitenant_scaling"]["vs_baseline"] = 0.9  # below the 2.0 floor
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("multitenant_scaling" in v for v in violations)
+    details["multitenant_scaling"] = "error: AssertionError: parity broke"
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and "scenario failed" in violations[0]
 

@@ -1,0 +1,813 @@
+"""Multi-tenant EvaluationService: fairness, dedupe, megabatch, isolation.
+
+Covers the ISSUE 8 surface:
+
+- scheduler primitives (DeficitRoundRobin, SignatureRegistry) in isolation
+  — deterministic, no threads, no devices;
+- the AsyncDispatcher per-tag counter split;
+- service parity: every tenant's ``compute()`` is bit-identical to an
+  independently-maintained functional state over the same stream, with the
+  megabatch path engaged and with it disabled;
+- tenant ISOLATION: a crash, a spent crash-loop budget, a snapshot-spec
+  mismatch, and a non-finite snapshot guard each fence exactly ONE tenant
+  while every other tenant keeps computing bit-identical results;
+- per-tenant snapshot round-trips through per-tenant directories, with no
+  cross-contamination on restore.
+
+Bit-identical claims ride integer-counting metrics (accuracy's statscores
+states), where exactness is arithmetic fact, not float luck.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics.aggregation import MeanMetric
+from tpumetrics.classification import MulticlassAccuracy
+from tpumetrics.runtime import (
+    AsyncDispatcher,
+    DeficitRoundRobin,
+    EvaluationService,
+    QueueFullError,
+    SignatureRegistry,
+    TenantQuarantinedError,
+)
+from tpumetrics.runtime.bucketing import (
+    ShapeBucketer,
+    plan_bucketed_update,
+    single_chunk_signature,
+)
+from tpumetrics.runtime.evaluator import CrashLoopError
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+C = 8
+
+
+def _batch(n, seed, classes=C):
+    r = np.random.default_rng(seed)
+    return (
+        jnp.asarray(r.standard_normal((n, classes), dtype=np.float32)),
+        jnp.asarray(r.integers(0, classes, n).astype(np.int32)),
+    )
+
+
+def _acc(classes=C):
+    return MulticlassAccuracy(num_classes=classes, average="micro", validate_args=False)
+
+
+def _ground_truth(stream, classes=C):
+    m = _acc(classes)
+    s = m.init_state()
+    for p, t in stream:
+        s = m.functional_update(s, p, t)
+    return float(m.functional_compute(s))
+
+
+# --------------------------------------------------------------- scheduler
+
+
+class TestDeficitRoundRobin:
+    def _queues(self, drr, costs):
+        """Drive select() against dict-of-deques work queues; returns the
+        served tenant order."""
+        order = []
+
+        def head_cost(tid):
+            q = costs[tid]
+            return q[0] if q else None
+
+        while True:
+            tid = drr.select(head_cost)
+            if tid is None:
+                return order
+            costs[tid].pop(0)
+            order.append(tid)
+
+    def test_equal_quanta_round_robin(self):
+        drr = DeficitRoundRobin()
+        costs = {}
+        for tid in ("a", "b", "c"):
+            drr.add(tid, quantum=1.0)
+            drr.activate(tid)
+            costs[tid] = [1.0] * 3
+        order = self._queues(drr, costs)
+        assert sorted(order) == ["a"] * 3 + ["b"] * 3 + ["c"] * 3
+        # no tenant is served twice before every backlogged tenant is served
+        # once (round-robin property)
+        assert set(order[:3]) == {"a", "b", "c"}
+        assert set(order[3:6]) == {"a", "b", "c"}
+
+    def test_quota_weighting(self):
+        drr = DeficitRoundRobin()
+        drr.add("heavy", quantum=2.0)
+        drr.add("light", quantum=1.0)
+        costs = {"heavy": [1.0] * 20, "light": [1.0] * 20}
+        drr.activate("heavy")
+        drr.activate("light")
+        order = []
+
+        def head_cost(tid):
+            q = costs[tid]
+            return q[0] if q else None
+
+        for _ in range(12):
+            tid = drr.select(head_cost)
+            costs[tid].pop(0)
+            order.append(tid)
+        # a 2x quantum buys ~2x the service while both stay backlogged
+        assert order.count("heavy") == 2 * order.count("light")
+
+    def test_idle_tenant_forfeits_deficit(self):
+        drr = DeficitRoundRobin()
+        drr.add("a", quantum=1.0)
+        drr.activate("a")
+        assert drr.select(lambda tid: None) is None
+        assert drr.deficit("a") == 0.0
+        assert drr.active == 0
+
+    def test_large_cost_accumulates_until_served(self):
+        # a head item costing 5 quanta is NOT starved: deficit accumulates
+        # across rounds until it covers the cost
+        drr = DeficitRoundRobin()
+        drr.add("big", quantum=1.0)
+        drr.add("small", quantum=1.0)
+        costs = {"big": [5.0], "small": [1.0] * 10}
+        drr.activate("big")
+        drr.activate("small")
+        order = self._queues(drr, costs)
+        assert "big" in order
+        # the small tenant was meanwhile served several times, not blocked
+        assert order.index("big") >= 4
+
+    def test_charge_defers_next_turn(self):
+        drr = DeficitRoundRobin()
+        drr.add("a", quantum=1.0)
+        drr.add("b", quantum=1.0)
+        drr.charge("a", 3.0)  # co-served 3 rows out of turn (megabatch)
+        costs = {"a": [1.0] * 5, "b": [1.0] * 5}
+        drr.activate("a")
+        drr.activate("b")
+        order = []
+
+        def head_cost(tid):
+            q = costs[tid]
+            return q[0] if q else None
+
+        for _ in range(5):
+            tid = drr.select(head_cost)
+            costs[tid].pop(0)
+            order.append(tid)
+        # b catches up first: a's negative deficit defers its solo turns
+        assert order.count("b") > order.count("a")
+
+    def test_membership_errors(self):
+        drr = DeficitRoundRobin()
+        drr.add("a", quantum=1.0)
+        with pytest.raises(ValueError):
+            drr.add("a", quantum=1.0)
+        with pytest.raises(KeyError):
+            drr.activate("nope")
+        drr.remove("a")
+        with pytest.raises(KeyError):
+            drr.activate("a")
+
+
+class TestSignatureRegistry:
+    def test_lru_eviction_order_and_counts(self):
+        reg = SignatureRegistry(capacity=2)
+        assert reg.observe("a") and reg.observe("b")
+        assert reg.observe("c")  # evicts a (LRU)
+        assert reg.evictions == 1
+        assert "a" not in reg and "b" in reg and "c" in reg
+        assert reg.observe("a")  # re-seen after eviction counts as new again
+        assert reg.inserts == 4
+
+    def test_observe_refreshes_recency(self):
+        reg = SignatureRegistry(capacity=2)
+        reg.observe("a")
+        reg.observe("b")
+        assert not reg.observe("a")  # refresh: a becomes most-recent
+        reg.observe("c")  # evicts b, NOT a
+        assert "a" in reg and "b" not in reg
+
+    def test_unbounded(self):
+        reg = SignatureRegistry(None)
+        for i in range(100):
+            reg.observe(i)
+        assert len(reg) == 100 and reg.evictions == 0
+
+    def test_capacity_validation(self):
+        with pytest.raises(ValueError):
+            SignatureRegistry(0)
+
+
+def test_probe_signature_matches_plan():
+    """The service's lock-held megabatch probe must produce BIT-IDENTICAL
+    signatures to the padding path, or compile accounting drifts between
+    the megabatch and single-tenant routes."""
+    bucketer = ShapeBucketer((8, 32))
+    for n in (3, 8, 20, 32):  # pad-to-bucket, exact edge, mid, top edge
+        args = _batch(n, seed=n)
+        probe = single_chunk_signature(bucketer, args)
+        assert probe is not None
+        bucket, size, sig = probe
+        _, chunks = plan_bucketed_update(bucketer, args)
+        assert len(chunks) == 1
+        kind, _padded, p_bucket, p_size, p_sig = chunks[0]
+        assert (bucket, size, sig) == (p_bucket, p_size, p_sig)
+    # multi-chunk (past the top edge) and scalar-only: no single-chunk sig
+    assert single_chunk_signature(bucketer, _batch(33, seed=0)) is None
+    assert single_chunk_signature(bucketer, (1.5,)) is None
+
+
+# -------------------------------------------------------- dispatcher by_tag
+
+
+def test_dispatcher_by_tag_counters():
+    drained = []
+    d = AsyncDispatcher(lambda batch: drained.extend(batch), max_queue=64)
+    for i in range(3):
+        d.submit(("x", i), tag="alpha")
+    d.submit(("y", 0), tag="beta")
+    d.submit(("z", 0))  # untagged: global counters only
+    d.flush()
+    st = d.stats()
+    assert st["enqueued"] == 5 and st["drained_items"] == 5
+    assert st["by_tag"]["alpha"] == {"enqueued": 3, "drained": 3, "dropped": 0}
+    assert st["by_tag"]["beta"] == {"enqueued": 1, "drained": 1, "dropped": 0}
+    assert set(st["by_tag"]) == {"alpha", "beta"}
+    d.close()
+
+
+def test_dispatcher_drop_oldest_blames_evicted_tag():
+    import threading
+
+    release = threading.Event()
+    d = AsyncDispatcher(
+        lambda batch: release.wait(timeout=10), max_queue=2, policy="drop_oldest"
+    )
+    d.submit("a1", tag="alpha")  # picked up by the worker almost immediately
+    time.sleep(0.2)  # let the worker block inside drain
+    d.submit("a2", tag="alpha")
+    d.submit("b1", tag="beta")
+    d.submit("b2", tag="beta")  # queue full: evicts a2 -> blamed on alpha
+    release.set()
+    d.flush()
+    st = d.stats()
+    assert st["dropped"] == 1
+    assert st["by_tag"]["alpha"]["dropped"] == 1
+    assert st["by_tag"]["beta"]["dropped"] == 0
+    d.close()
+
+
+# ----------------------------------------------------------- evaluator LRU
+
+
+def test_evaluator_signature_lru_evictions():
+    from tpumetrics.runtime import StreamingEvaluator
+
+    stream = [_batch(n, seed=n) for n in (3, 9, 17, 33, 3, 9, 17, 33)]
+    ev = StreamingEvaluator(
+        _acc(), buckets=[4, 16, 32, 64], signature_cache_size=2
+    )
+    with ev:
+        for p, t in stream:
+            ev.submit(p, t)
+        val = float(ev.compute())
+    st = ev.stats()
+    # 4 distinct signatures through a 2-slot LRU: the second lap re-inserts
+    assert st["signature_evictions"] >= 2
+    assert st["xla_compiles"] >= 4
+    assert val == _ground_truth(stream)
+
+
+# ----------------------------------------------------------- service parity
+
+
+def _run_streams(svc, handles, streams):
+    """Interleave submission round-robin (the serving pattern) and flush."""
+    for j in range(len(streams[0])):
+        for i, h in enumerate(handles):
+            h.submit(*streams[i][j])
+    svc.flush()
+
+
+class TestServiceParity:
+    def test_megabatch_parity_bit_identical(self):
+        with EvaluationService() as svc:
+            handles = [svc.register(f"t{i}", _acc(), buckets=[32]) for i in range(4)]
+            streams = [
+                [_batch(int(np.random.default_rng(100 * i + j).integers(4, 32)), 100 * i + j) for j in range(6)]
+                for i in range(4)
+            ]
+            _run_streams(svc, handles, streams)
+            st = svc.stats()
+            for i, h in enumerate(handles):
+                assert float(h.compute()) == _ground_truth(streams[i])
+        assert st["shared_steps"] == 1  # 4 same-config tenants, ONE step
+        assert st["megabatch_steps"] > 0
+        assert st["megabatch_tenants"] >= 2 * st["megabatch_steps"]
+
+    def test_megabatch_disabled_parity(self):
+        with EvaluationService() as svc:
+            handles = [
+                svc.register(f"t{i}", _acc(), buckets=[32], megabatch=False)
+                for i in range(3)
+            ]
+            streams = [[_batch(10 + i, 10 * i + j) for j in range(4)] for i in range(3)]
+            _run_streams(svc, handles, streams)
+            assert svc.stats()["megabatch_steps"] == 0
+            for i, h in enumerate(handles):
+                assert float(h.compute()) == _ground_truth(streams[i])
+
+    def test_mixed_configs_share_per_fingerprint(self):
+        with EvaluationService() as svc:
+            a0 = svc.register("a0", _acc(8), buckets=[32])
+            a1 = svc.register("a1", _acc(8), buckets=[32])
+            b0 = svc.register("b0", _acc(4), buckets=[32])
+            sa0 = [_batch(12, 1, classes=8)]
+            sa1 = [_batch(12, 2, classes=8)]
+            sb0 = [_batch(12, 3, classes=4)]
+            for h, s in ((a0, sa0), (a1, sa1), (b0, sb0)):
+                h.submit(*s[0])
+            svc.flush()
+            assert svc.stats()["shared_steps"] == 2  # one per fingerprint
+            assert float(a0.compute()) == _ground_truth(sa0, classes=8)
+            assert float(a1.compute()) == _ground_truth(sa1, classes=8)
+            assert float(b0.compute()) == _ground_truth(sb0, classes=4)
+
+    def test_multi_chunk_batches_take_single_path(self):
+        # rows past the top bucket edge split into chunks — megabatch skips
+        # them, the plan path applies them, parity holds exactly
+        with EvaluationService() as svc:
+            h0 = svc.register("t0", _acc(), buckets=[8])
+            h1 = svc.register("t1", _acc(), buckets=[8])
+            s0 = [_batch(21, 7)]  # 8 + 8 + 5
+            s1 = [_batch(19, 8)]
+            h0.submit(*s0[0])
+            h1.submit(*s1[0])
+            svc.flush()
+            assert float(h0.compute()) == _ground_truth(s0)
+            assert float(h1.compute()) == _ground_truth(s1)
+
+    def test_collection_tenants_share_step_and_megabatch(self):
+        from tpumetrics.classification import MulticlassF1Score
+        from tpumetrics.collections import MetricCollection
+
+        def col():
+            return MetricCollection(
+                {
+                    "acc": MulticlassAccuracy(num_classes=C, validate_args=False),
+                    "f1": MulticlassF1Score(num_classes=C, validate_args=False),
+                }
+            )
+
+        streams = [[_batch(10 + i, 100 * i + j) for j in range(4)] for i in range(2)]
+        with EvaluationService() as svc:
+            handles = [svc.register(f"c{i}", col(), buckets=[32]) for i in range(2)]
+            _run_streams(svc, handles, streams)
+            st = svc.stats()
+            assert st["shared_steps"] == 1 and st["megabatch_steps"] > 0
+            for i, h in enumerate(handles):
+                # ground truth: an unfused functional run of the same collection
+                m = col()
+                m._compute_groups_create_state_ref(copy=False)
+                state = {
+                    name: m._modules[name].init_state()
+                    for name in (cg[0] for cg in m._groups.values())
+                }
+                for p, t in streams[i]:
+                    state = {
+                        name: m._modules[name].functional_update(state[name], p, t)
+                        for name in state
+                    }
+                gt = m.functional_compute(state)
+                got = h.compute()
+                assert all(float(got[k]) == float(gt[k]) for k in gt)
+
+    def test_eager_tenant_parity(self):
+        with EvaluationService() as svc:
+            h = svc.register("agg", MeanMetric())
+            for v in (1.0, 2.0, 6.0):
+                h.submit(jnp.asarray([v]))
+            svc.flush()
+            assert float(h.compute()) == 3.0
+
+    def test_scalar_submit_bucketed(self):
+        with EvaluationService() as svc:
+            h = svc.register("agg", MeanMetric(), buckets=[8])
+            for v in (1.0, 2.0, 6.0):
+                h.submit(v)
+            svc.flush()
+            assert float(h.compute()) == 3.0
+
+    def test_compute_every_latest_result(self):
+        with EvaluationService() as svc:
+            h = svc.register("t", _acc(), buckets=[32], compute_every=2)
+            stream = [_batch(8, j) for j in range(4)]
+            for p, t in stream:
+                h.submit(p, t)
+            svc.flush()
+            latest = h.latest_result()
+            assert latest is not None and latest["batches"] in (2, 4)
+            assert float(h.compute()) == _ground_truth(stream)
+
+    def test_service_stats_by_tag(self):
+        with EvaluationService() as svc:
+            h0 = svc.register("alpha", _acc(), buckets=[32])
+            h1 = svc.register("beta", _acc(), buckets=[32])
+            for j in range(3):
+                h0.submit(*_batch(8, j))
+            h1.submit(*_batch(8, 9))
+            svc.flush()
+            by_tag = svc.stats()["by_tag"]
+            assert by_tag["alpha"]["enqueued"] == 3 and by_tag["alpha"]["drained"] == 3
+            assert by_tag["beta"]["enqueued"] == 1
+
+
+# ------------------------------------------------------------- backpressure
+
+
+class _SlowMean(MeanMetric):
+    """Eager metric whose update stalls — makes queue overflow deterministic."""
+
+    def update(self, value, weight=1.0):  # type: ignore[override]
+        time.sleep(0.05)
+        return super().update(value, weight)
+
+
+class TestBackpressure:
+    def test_drop_oldest_counts_per_tenant(self):
+        with EvaluationService() as svc:
+            slow = svc.register(
+                "slow", _SlowMean(), max_queue=2, backpressure="drop_oldest"
+            )
+            for v in range(10):
+                slow.submit(float(v))
+            svc.flush()
+            st = slow.stats()
+            assert st["dropped"] > 0
+            assert st["batches"] + st["dropped"] == st["enqueued"] == 10
+
+    def test_error_policy_raises(self):
+        with EvaluationService() as svc:
+            slow = svc.register("slow", _SlowMean(), max_queue=1, backpressure="error")
+            with pytest.raises(QueueFullError):
+                for v in range(10):
+                    slow.submit(float(v))
+            svc.flush()
+
+    def test_block_policy_lossless(self):
+        with EvaluationService() as svc:
+            slow = svc.register("slow", _SlowMean(), max_queue=1, backpressure="block")
+            for v in (1.0, 2.0, 3.0, 6.0):
+                slow.submit(v)
+            svc.flush()
+            assert slow.stats()["dropped"] == 0
+            assert float(slow.compute()) == 3.0
+
+    def test_hot_tenant_does_not_starve_cold(self):
+        # a flooding drop_oldest tenant must not stop a block-policy tenant
+        # from completing losslessly
+        with EvaluationService() as svc:
+            hot = svc.register(
+                "hot", _SlowMean(), max_queue=2, backpressure="drop_oldest", quota=1.0
+            )
+            cold = svc.register("cold", MeanMetric(), quota=1.0)
+            for v in range(8):
+                hot.submit(float(v))
+            for v in (2.0, 4.0):
+                cold.submit(v)
+            svc.flush()
+            assert cold.stats()["dropped"] == 0
+            assert float(cold.compute()) == 3.0
+
+
+# ---------------------------------------------------------------- isolation
+
+
+class _Poison(RuntimeError):
+    pass
+
+
+class _CrashyMean(MeanMetric):
+    """Raises on values above the poison threshold (deterministic crash)."""
+
+    def update(self, value, weight=1.0):  # type: ignore[override]
+        if float(np.asarray(value).max()) > 1e8:
+            raise _Poison("poisoned batch")
+        return super().update(value, weight)
+
+
+class _TransientCrashMean(MeanMetric):
+    """Crashes the FIRST time it sees the trigger value, succeeds on replay."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._tripped = False
+
+    def update(self, value, weight=1.0):  # type: ignore[override]
+        if not self._tripped and float(np.asarray(value).max()) > 1e8:
+            self._tripped = True
+            raise _Poison("transient crash")
+        return super().update(value, weight)
+
+
+class TestTenantIsolation:
+    def test_crash_quarantines_only_that_tenant(self):
+        with EvaluationService() as svc:
+            good = [svc.register(f"g{i}", _acc(), buckets=[32]) for i in range(3)]
+            bad = svc.register("bad", _CrashyMean())
+            streams = [[_batch(8, 10 * i + j) for j in range(4)] for i in range(3)]
+            bad.submit(jnp.asarray([1.0]))
+            for j in range(4):
+                for i, h in enumerate(good):
+                    h.submit(*streams[i][j])
+                if j == 1:
+                    bad.submit(jnp.asarray([2e9]))  # poison mid-stream
+            for h in good:
+                h.flush()
+            # the crash fenced exactly one tenant...
+            with pytest.raises(TenantQuarantinedError) as exc:
+                bad.compute()
+            assert isinstance(exc.value.__cause__, _Poison)
+            assert bad.quarantined and bad.stats()["quarantined"]
+            with pytest.raises(TenantQuarantinedError):
+                bad.submit(jnp.asarray([1.0]))
+            # ...and every other tenant computes BIT-IDENTICAL results
+            for i, h in enumerate(good):
+                assert float(h.compute()) == _ground_truth(streams[i])
+                assert not h.stats()["quarantined"]
+            assert svc.stats()["quarantined_tenants"] == 1
+
+    def test_crash_loop_budget_quarantines_with_crash_loop_error(self, tmp_path):
+        with EvaluationService() as svc:
+            bad = svc.register(
+                "bad", _CrashyMean(), snapshot_dir=str(tmp_path / "bad"),
+                crash_policy="restore", max_restores=2,
+            )
+            other = svc.register("ok", _acc(), buckets=[32])
+            stream = [_batch(8, j) for j in range(3)]
+            bad.submit(jnp.asarray([1.0]))
+            bad.submit(jnp.asarray([2e9]))  # deterministic poison: replays re-crash
+            for p, t in stream:
+                other.submit(p, t)
+            other.flush()
+            with pytest.raises(TenantQuarantinedError) as exc:
+                bad.flush()
+            assert isinstance(exc.value.__cause__, CrashLoopError)
+            assert bad.stats()["crashes"] == 3  # initial + 2 budgeted replays
+            assert float(other.compute()) == _ground_truth(stream)
+
+    def test_transient_crash_restores_and_replays(self, tmp_path):
+        with EvaluationService() as svc:
+            t = svc.register(
+                "t", _TransientCrashMean(), snapshot_dir=str(tmp_path / "t"),
+                crash_policy="restore", max_restores=2,
+            )
+            t.submit(jnp.asarray([2.0]))
+            t.flush()
+            t.snapshot()
+            t.submit(jnp.asarray([4e9]))  # crashes once, succeeds on replay
+            t.submit(jnp.asarray([4.0]))
+            t.flush()
+            st = t.stats()
+            assert st["crashes"] == 1 and st["restores"] == 1
+            assert not st["quarantined"]
+            # float32 accumulator: compare against the same-precision mean
+            assert float(t.compute()) == pytest.approx(np.mean([2.0, 4e9, 4.0]), rel=1e-6)
+
+    def test_snapshot_spec_mismatch_isolated(self, tmp_path):
+        snap = str(tmp_path / "a")
+        with EvaluationService() as svc:
+            a = svc.register("a", _acc(8), buckets=[32], snapshot_dir=snap)
+            a.submit(*_batch(8, 1, classes=8))
+            a.flush()
+            a.snapshot()
+        with EvaluationService() as svc2:
+            # same dir, DIFFERENT config: the restore must fail typed...
+            wrong = svc2.register("a", _acc(4), buckets=[32], snapshot_dir=snap)
+            ok = svc2.register("ok", _acc(8), buckets=[32])
+            with pytest.raises(TPUMetricsUserError):
+                wrong.restore_latest()
+            # ...and the OTHER tenant is untouched by the failed restore
+            stream = [_batch(8, 5, classes=8)]
+            ok.submit(*stream[0])
+            ok.flush()
+            assert float(ok.compute()) == _ground_truth(stream, classes=8)
+
+    def test_non_finite_guard_isolated(self, tmp_path):
+        with EvaluationService() as svc:
+            nan_t = svc.register(
+                "nan", MeanMetric(), snapshot_dir=str(tmp_path / "nan"),
+                guard_non_finite="error",
+            )
+            ok = svc.register(
+                "ok", MeanMetric(), snapshot_dir=str(tmp_path / "ok"),
+                guard_non_finite="error",
+            )
+            # MeanMetric's nan_strategy strips NaN inputs, but a float32
+            # accumulator OVERFLOWING to inf is exactly what the snapshot
+            # guard exists to catch before it hits disk
+            nan_t.submit(jnp.asarray([3e38], dtype=jnp.float32))
+            nan_t.submit(jnp.asarray([3e38], dtype=jnp.float32))
+            ok.submit(jnp.asarray([2.0]))
+            svc.flush()
+            with pytest.raises(TPUMetricsUserError):
+                nan_t.snapshot()
+            # the guard failure is the CALLER's error, never a quarantine,
+            # and the healthy tenant still snapshots + computes
+            assert not nan_t.stats()["quarantined"]
+            ok.snapshot()
+            assert float(ok.compute()) == 2.0
+
+    def test_per_tenant_snapshot_round_trip_no_cross_contamination(self, tmp_path):
+        dirs = {f"t{i}": str(tmp_path / f"t{i}") for i in range(2)}
+        streams = [[_batch(8, 10 * i + j) for j in range(4)] for i in range(2)]
+        with EvaluationService() as svc:
+            handles = [
+                svc.register(f"t{i}", _acc(), buckets=[32], snapshot_dir=dirs[f"t{i}"])
+                for i in range(2)
+            ]
+            # tenants snapshot at DIFFERENT positions into their OWN dirs
+            for i, h in enumerate(handles):
+                for j in range(2 + i):
+                    h.submit(*streams[i][j])
+                h.flush()
+                h.snapshot()
+        with EvaluationService() as svc2:
+            restored = [
+                svc2.register(f"t{i}", _acc(), buckets=[32], snapshot_dir=dirs[f"t{i}"])
+                for i in range(2)
+            ]
+            positions = [h.restore_latest() for h in restored]
+            assert positions == [2, 3]  # each tenant's OWN position, not the peer's
+            for i, h in enumerate(restored):
+                for j in range(positions[i], 4):
+                    h.submit(*streams[i][j])
+                h.flush()
+                # bit-identical to the uninterrupted stream
+                assert float(h.compute()) == _ground_truth(streams[i])
+
+
+# --------------------------------------------------------------- validation
+
+
+class TestRegistration:
+    def test_duplicate_tenant_id(self):
+        with EvaluationService() as svc:
+            svc.register("t", _acc(), buckets=[32])
+            with pytest.raises(ValueError):
+                svc.register("t", _acc(), buckets=[32])
+
+    def test_unknown_tenant(self):
+        with EvaluationService() as svc:
+            with pytest.raises(KeyError):
+                svc.submit("nope", 1.0)
+
+    def test_bad_arguments(self):
+        with EvaluationService() as svc:
+            with pytest.raises(ValueError):
+                svc.register("a", _acc(), buckets=[32], backpressure="wat")
+            with pytest.raises(ValueError):
+                svc.register("b", _acc(), buckets=[32], max_queue=0)
+            with pytest.raises(ValueError):
+                svc.register("c", _acc(), snapshot_every=2)  # needs snapshot_dir
+            with pytest.raises(TypeError):
+                svc.register("d", object())
+
+    def test_snapshot_without_dir(self):
+        with EvaluationService() as svc:
+            h = svc.register("t", _acc(), buckets=[32])
+            with pytest.raises(TPUMetricsUserError):
+                h.snapshot()
+            with pytest.raises(TPUMetricsUserError):
+                h.restore_latest()
+
+    def test_empty_submit(self):
+        with EvaluationService() as svc:
+            h = svc.register("t", _acc(), buckets=[32])
+            with pytest.raises(ValueError):
+                h.submit()
+
+
+def test_invalid_quota_leaves_no_zombie_tenant():
+    """A failed register() must not publish a half-registered tenant: the
+    id stays free and a valid re-register works."""
+    with EvaluationService() as svc:
+        with pytest.raises(ValueError):
+            svc.register("t", _acc(), buckets=[32], quota=0)
+        h = svc.register("t", _acc(), buckets=[32])  # id was NOT consumed
+        stream = [_batch(8, 1)]
+        h.submit(*stream[0])
+        svc.flush()
+        assert float(h.compute()) == _ground_truth(stream)
+
+
+def test_megabatch_same_config_different_bucket_edges():
+    """Same-fingerprint tenants with DIFFERENT bucket edges share a step
+    (and a ready set); a group member must be padded to the GROUP's bucket
+    from its own probe, never re-bucketed through another tenant's edges."""
+    with EvaluationService() as svc:
+        a = svc.register("a", _acc(), buckets=[24, 32])
+        b = svc.register("b", _acc(), buckets=[32])
+        # n=28: both probe to bucket 32 -> groupable; n=20: a probes 24,
+        # b probes 32 -> signatures differ, single path; parity must hold
+        # through both
+        sa = [_batch(28, 1), _batch(20, 2)]
+        sb = [_batch(28, 3), _batch(20, 4)]
+        for j in range(2):
+            a.submit(*sa[j])
+            b.submit(*sb[j])
+        svc.flush()
+        assert float(a.compute()) == _ground_truth(sa)
+        assert float(b.compute()) == _ground_truth(sb)
+
+
+def test_raising_telemetry_sink_does_not_double_apply_megabatch():
+    """A user sink that raises on the megabatch event fires AFTER the
+    states were written back — it must be contained, never cascade into
+    the individual fallback re-applying every member's batch."""
+    from tpumetrics.telemetry import ledger as telemetry
+
+    class _AngrySink:
+        def emit(self, rec):
+            if rec.kind == "megabatch_step":
+                raise RuntimeError("sink is angry")
+
+    streams = [[_batch(8, 10 * i + j) for j in range(3)] for i in range(2)]
+    with telemetry.capture(sinks=[_AngrySink()]):
+        with EvaluationService() as svc:
+            handles = [svc.register(f"t{i}", _acc(), buckets=[32]) for i in range(2)]
+            _run_streams(svc, handles, streams)
+            stats = [h.stats() for h in handles]
+            vals = [float(h.compute()) for h in handles]
+    assert svc.stats()["megabatch_steps"] > 0  # the fast path DID run
+    for i in range(2):
+        assert stats[i]["batches"] == 3  # applied once, not twice
+        assert vals[i] == _ground_truth(streams[i])
+
+
+def test_megabatch_parity_without_donation():
+    """donate_state=False tenants still share a step and megabatch (their
+    cold compile must run outside the lock like the donating path)."""
+    with EvaluationService() as svc:
+        handles = [
+            svc.register(f"t{i}", _acc(), buckets=[32], donate_state=False)
+            for i in range(3)
+        ]
+        streams = [[_batch(9 + i, 20 * i + j) for j in range(4)] for i in range(3)]
+        _run_streams(svc, handles, streams)
+        assert svc.stats()["megabatch_steps"] > 0
+        for i, h in enumerate(handles):
+            assert float(h.compute()) == _ground_truth(streams[i])
+
+
+def test_snapshot_trims_only_covered_journal_prefix(tmp_path):
+    """A user snapshot() must not discard a journal entry the worker
+    appended for a not-yet-counted in-flight batch (journaling happens
+    lock-free BEFORE applying): only the covered prefix is trimmed."""
+    with EvaluationService() as svc:
+        h = svc.register(
+            "t", MeanMetric(), snapshot_dir=str(tmp_path), crash_policy="restore"
+        )
+        h.submit(jnp.asarray([1.0]))
+        h.submit(jnp.asarray([2.0]))
+        h.flush()
+        tenant = svc._tenants["t"]
+        assert len(tenant.journal) == 2 and tenant.journal_base == 0
+        # simulate the race: a third batch journaled (pre-apply) but not yet
+        # counted in `batches` when the snapshot lock is acquired
+        inflight = (jnp.asarray([3.0]),)
+        tenant.journal.append(inflight)
+        with svc._lock:
+            svc._save_snapshot_locked(tenant)
+        assert tenant.journal == [inflight]  # the in-flight entry SURVIVES
+        assert tenant.journal_base == tenant.batches == 2
+
+
+def test_state_alive_detects_deleted_buffers():
+    from tpumetrics.runtime.service import _state_alive
+
+    state = {"a": jnp.ones(3), "b": jnp.zeros(2)}
+    assert _state_alive(state)
+    state["a"].delete()
+    assert not _state_alive(state)
+
+
+def test_service_signature_lru_evictions():
+    """A shape-churning tenant degrades to eviction accounting, not a leak."""
+    with EvaluationService(signature_cache_size=2) as svc:
+        h = svc.register("churn", _acc(), buckets=[4, 16, 32, 64], megabatch=False)
+        stream = [_batch(n, seed=n) for n in (3, 9, 17, 33)]
+        for p, t in stream:
+            h.submit(p, t)
+        svc.flush()
+        st = svc.stats()
+        assert st["signature_evictions"] >= 2
+        assert st["signatures_tracked"] <= 2
+        assert float(h.compute()) == _ground_truth(stream)

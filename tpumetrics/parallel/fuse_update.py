@@ -434,6 +434,75 @@ class FusedCollectionStep:
             self._programs[key] = program
         return program(state, self._place_args(tuple(padded)), n_valid)
 
+    def megabatch_update(
+        self,
+        states: List[Dict[str, Any]],
+        padded: List[Tuple[Any, ...]],
+        n_valid: List[Any],
+        bucket: int,
+    ) -> List[Dict[str, Any]]:
+        """One fused *multi-tenant* transition: the masked bucketed update
+        vmapped over a leading **tenant axis**, K tenants per device program.
+
+        ``states`` is a list of K same-structure state pytrees (one per
+        tenant), ``padded`` the K tenants' bucket-padded positional args
+        (identical trace signatures — the caller groups by signature),
+        ``n_valid`` the K true row counts.  Returns the K updated state
+        pytrees, in order.
+
+        The stack along the tenant axis, the vmapped transition, and the
+        unstack back to per-tenant states all happen INSIDE one trace, so
+        the whole group is ONE XLA dispatch end to end — K small dispatches
+        become one, with no host-side stack/gather programs around it.  The
+        state lists are donated as usual (the service owns its tenants'
+        states between steps); duplicate pytree leaves across list entries
+        would break donation, so callers pad short groups with *fresh*
+        ``init_state()`` dummies, never with aliases.
+
+        One Python program object exists per bucket; jit re-specializes per
+        K (the input pytree structure carries it), which callers bound by
+        padding group sizes to powers of two.  Sharded execution mode is
+        excluded — a mesh-placed state already runs as one global SPMD
+        program and the tenant axis would fight the mesh layout.
+        """
+        if self._mesh is not None:
+            raise TPUMetricsUserError(
+                "megabatch_update is single-device-mode only: sharded states "
+                "already run as one global SPMD program per tenant."
+            )
+        if self._is_collection and set(self._leaders) != {
+            cg[0] for cg in self._metric._groups.values()
+        }:
+            raise TPUMetricsUserError(
+                "megabatch_update fuses the whole collection; a leader subset "
+                "is only supported by update()."
+            )
+        key = ("megabatch", int(bucket))
+        program = self._programs.get(key)
+        if program is None:
+            from tpumetrics.runtime.bucketing import masked_functional_update
+
+            metric, kwargs = self._metric, self._update_kwargs
+            donate = (0,) if self._donate else ()
+
+            def run(ss: List[Any], pp: List[Tuple[Any, ...]], nn: List[Any]) -> List[Any]:
+                k = len(ss)  # static: carried by the input pytree structure
+                stacked_s = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ss)
+                stacked_p = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *pp)
+                n_vec = jnp.stack([jnp.asarray(n, jnp.int32) for n in nn])
+
+                def run_one(s: Any, p: Tuple[Any, ...], n: Array) -> Any:
+                    return masked_functional_update(metric, s, p, n, int(bucket), kwargs)
+
+                out = jax.vmap(run_one)(stacked_s, stacked_p, n_vec)
+                return [
+                    jax.tree_util.tree_map(lambda leaf: leaf[i], out) for i in range(k)
+                ]
+
+            program = jax.jit(run, donate_argnums=donate)
+            self._programs[key] = program
+        return program(list(states), list(padded), list(n_valid))
+
     def __deepcopy__(self, memo: dict) -> None:
         # jitted programs are closed over the ORIGINAL metric objects; a
         # deep-copied owner (MetricCollection.clone) must rebuild its own

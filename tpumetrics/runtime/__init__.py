@@ -25,6 +25,15 @@ replay positions).  See ``docs/runtime.md`` for the guide.
   cache as a one-call option, so cold starts / preemption restarts /
   elastic resizes reuse on-disk executables instead of re-compiling
   (``docs/performance.md``).
+- :mod:`~tpumetrics.runtime.scheduler` — deficit-round-robin fairness and
+  the LRU-bounded trace-signature registry, the primitives under the
+  multi-tenant service.
+- :mod:`~tpumetrics.runtime.service` — :class:`EvaluationService`:
+  thousands of tenant streams multiplexed onto ONE dispatcher, with
+  cross-tenant compile dedupe (same-config tenants share one fused step),
+  a vmapped megabatch fast path, DRR fairness + per-tenant backpressure
+  and quotas, and per-tenant quarantine/snapshots/telemetry
+  (``docs/service.md``).
 
 Multi-host: with ``snapshot_rank``/``snapshot_world_size`` set, snapshots
 become COORDINATED cuts (barrier-stamped, per-rank directories) and
@@ -46,6 +55,12 @@ from tpumetrics.runtime.compile_cache import (
 )
 from tpumetrics.runtime.dispatch import AsyncDispatcher, DispatcherClosedError, QueueFullError
 from tpumetrics.runtime.evaluator import CrashLoopError, StreamingEvaluator
+from tpumetrics.runtime.scheduler import DeficitRoundRobin, SignatureRegistry
+from tpumetrics.runtime.service import (
+    EvaluationService,
+    TenantHandle,
+    TenantQuarantinedError,
+)
 from tpumetrics.runtime.snapshot import (
     SnapshotError,
     SnapshotIntegrityError,
@@ -61,10 +76,15 @@ from tpumetrics.runtime.snapshot import (
 __all__ = [
     "AsyncDispatcher",
     "CrashLoopError",
+    "DeficitRoundRobin",
     "DispatcherClosedError",
+    "EvaluationService",
     "NotBucketableError",
     "QueueFullError",
     "ShapeBucketer",
+    "SignatureRegistry",
+    "TenantHandle",
+    "TenantQuarantinedError",
     "SnapshotError",
     "SnapshotIntegrityError",
     "SnapshotManager",

@@ -116,21 +116,106 @@ class ShapeBucketer:
         """Pad every per-row array in ``args`` (leading dim == n) to the
         bucket edge with row-0 copies; returns (padded_args, bucket)."""
         bucket = self.bucket_for(n)
-        if bucket == n:
-            return tuple(args), bucket
-        out = []
-        for a in args:
-            if _is_per_row(a, n):
-                a = jnp.asarray(a)
-                pad = jnp.broadcast_to(a[0:1], (bucket - n,) + a.shape[1:])
-                out.append(jnp.concatenate([a, pad], axis=0))
-            else:
-                out.append(a)
-        return tuple(out), bucket
+        return pad_args_to(args, n, bucket), bucket
+
+
+def pad_args_to(args: Sequence[Any], n: int, bucket: int) -> Tuple[Any, ...]:
+    """Pad per-row arrays (leading dim == n) to an EXPLICIT bucket size with
+    row-0 copies.  The megabatch path pads each group member to the GROUP's
+    bucket — taken from the member's own signature probe, never re-derived
+    from another tenant's bucket edges (same-config tenants may bucket the
+    same row count differently)."""
+    if bucket == n:
+        return tuple(args)
+    out = []
+    for a in args:
+        if _is_per_row(a, n):
+            a = jnp.asarray(a)
+            pad = jnp.broadcast_to(a[0:1], (bucket - n,) + a.shape[1:])
+            out.append(jnp.concatenate([a, pad], axis=0))
+        else:
+            out.append(a)
+    return tuple(out)
 
 
 def _is_per_row(a: Any, n: int) -> bool:
     return hasattr(a, "shape") and getattr(a, "ndim", 0) >= 1 and a.shape[0] == n
+
+
+def _args_signature(args: Sequence[Any]) -> Tuple[Any, ...]:
+    """The (shape, dtype) tuple mirroring the jit cache key; python scalars
+    key by their weak result type."""
+    return tuple((tuple(jnp.shape(a)), str(jnp.result_type(a))) for a in args)
+
+
+def leading_rows(args: Sequence[Any]) -> int:
+    """The batch's row count: leading dim of the first per-row array, or 1
+    for scalar-only updates (aggregation metrics fed floats)."""
+    for a in args:
+        if hasattr(a, "shape") and getattr(a, "ndim", 0) >= 1:
+            return int(a.shape[0])
+    return 1
+
+
+def plan_bucketed_update(bucketer: "ShapeBucketer", args: Sequence[Any]):
+    """Split one submitted batch into the bucketed step calls it will run as.
+
+    Returns ``(n_rows, chunks)`` where each chunk is one device dispatch:
+
+    - ``("scalar", args, sig)`` — a scalar-only submit (no per-row array):
+      nothing to pad, so bucketing (and the fallback's pad correction) must
+      NOT apply; the caller runs the plain fused update over the raw args.
+    - ``("masked", padded_args, bucket, size, sig)`` — ``size`` valid rows
+      padded to ``bucket``; the caller runs the masked fused update.
+
+    ``sig`` mirrors the jit cache key (bucket + shapes/dtypes), so the set
+    of distinct sigs == the XLA compile count of the stream.  Shared by the
+    single-stream :class:`~tpumetrics.runtime.evaluator.StreamingEvaluator`
+    and the multi-tenant :class:`~tpumetrics.runtime.service.
+    EvaluationService` (which additionally groups same-sig chunks from
+    different tenants into one vmapped megabatch program).
+    """
+    n = leading_rows(args)
+    if n == 0:
+        raise ValueError("submit() got arguments with no per-row array (or zero rows)")
+    if not any(_is_per_row(a, n) for a in args):
+        return n, [("scalar", tuple(args), ("scalar",) + _args_signature(args))]
+    chunks = []
+    offset = 0
+    for size in bucketer.chunk_sizes(n):
+        chunk = tuple(a[offset : offset + size] if _is_per_row(a, n) else a for a in args)
+        padded, bucket = bucketer.pad_args(chunk, size)
+        chunks.append(("masked", padded, bucket, size, (bucket,) + _args_signature(padded)))
+        offset += size
+    return n, chunks
+
+
+def single_chunk_signature(
+    bucketer: "ShapeBucketer", args: Sequence[Any]
+) -> Optional[Tuple[int, int, Tuple[Any, ...]]]:
+    """``(bucket, n_rows, sig)`` when the batch would bucketize to exactly ONE
+    masked chunk, else ``None`` — WITHOUT materializing the padding.
+
+    The multi-tenant service's megabatch probe: it must compare head-of-queue
+    signatures across tenants under a lock, so the signature is derived from
+    shapes alone (a per-row array pads to ``(bucket,) + shape[1:]``, same
+    dtype).  Produces bit-identical signatures to :func:`plan_bucketed_update`
+    for the same batch (pinned by a test) — the two MUST agree, or the
+    compile accounting drifts between the megabatch and single-tenant paths.
+    """
+    n = leading_rows(args)
+    if n <= 0 or not any(_is_per_row(a, n) for a in args):
+        return None
+    if len(bucketer.chunk_sizes(n)) != 1:
+        return None  # splits past the top edge: megabatch handles heads only
+    bucket = bucketer.bucket_for(n)
+    parts = []
+    for a in args:
+        shape = tuple(jnp.shape(a))
+        if _is_per_row(a, n):
+            shape = (bucket,) + shape[1:]
+        parts.append((shape, str(jnp.result_type(a))))
+    return bucket, n, (bucket,) + tuple(parts)
 
 
 def _has_native_valid(metric: Metric) -> bool:

@@ -22,7 +22,12 @@ Observability: drops and drain cycles report into the telemetry ledger
 per eviction burst and ``runtime_drain`` per worker cycle (carrying queue
 depth and batch size) — and :meth:`AsyncDispatcher.stats` exposes cheap
 process-local counters (enqueued / drained / dropped / max depth) without
-requiring a ledger.
+requiring a ledger.  Items submitted with an attribution ``tag`` (the
+multi-tenant service passes the tenant id) are additionally counted per
+tag — ``stats()["by_tag"]`` splits enqueued/drained/dropped so a
+``runtime_drop`` burst can be blamed on the tenant that overflowed, not
+just observed globally — and the ``runtime_drop`` ledger event carries the
+evicted item's tag.
 
 A worker-side exception poisons the dispatcher: it is captured, the worker
 stops, and the exception re-raises (wrapped, original as ``__cause__``) from
@@ -121,6 +126,10 @@ class AsyncDispatcher:
         self._dropped = 0
         self._max_depth = 0
         self._restarts = 0
+        # per-attribution-tag split of the three blameable counters; only
+        # tagged submits pay for it (the single-stream evaluator passes no
+        # tag and keeps the zero-cost path)
+        self._by_tag: Dict[str, Dict[str, int]] = {}
 
         self._worker = threading.Thread(
             target=self._run, name=f"tpumetrics-dispatch[{self._name}]", daemon=True
@@ -129,8 +138,17 @@ class AsyncDispatcher:
 
     # ------------------------------------------------------------- producers
 
-    def submit(self, item: Any, timeout: Optional[float] = None) -> None:
-        """Enqueue one item, applying the backpressure policy when full."""
+    def _tag_counters(self, tag: str) -> Dict[str, int]:
+        got = self._by_tag.get(tag)
+        if got is None:
+            got = self._by_tag[tag] = {"enqueued": 0, "drained": 0, "dropped": 0}
+        return got
+
+    def submit(self, item: Any, timeout: Optional[float] = None, tag: Optional[str] = None) -> None:
+        """Enqueue one item, applying the backpressure policy when full.
+
+        ``tag`` attributes the item for the per-tag counter split (and for
+        the ``runtime_drop`` event should it later be evicted)."""
         with self._lock:
             self._check_alive()
             if len(self._q) >= self._max_queue:
@@ -140,9 +158,14 @@ class AsyncDispatcher:
                         "HINT: raise max_queue, slow the producer, or use 'block'/'drop_oldest'."
                     )
                 if self._policy == "drop_oldest":
-                    self._q.popleft()
+                    _, dropped_tag = self._q.popleft()
                     self._dropped += 1
-                    _telemetry.record_event(self, "runtime_drop", dropped_total=self._dropped)
+                    if dropped_tag is not None:
+                        self._tag_counters(dropped_tag)["dropped"] += 1
+                    # the event blames the EVICTED item's tenant — the drop is
+                    # charged to whoever overflowed the queue, per satellite
+                    with _telemetry.attribution(dropped_tag):
+                        _telemetry.record_event(self, "runtime_drop", dropped_total=self._dropped)
                 else:  # block
                     while len(self._q) >= self._max_queue:
                         self._check_alive()
@@ -151,8 +174,10 @@ class AsyncDispatcher:
                                 f"Timed out after {timeout}s waiting for queue space "
                                 f"({self._max_queue} items, policy='block')."
                             )
-            self._q.append(item)
+            self._q.append((item, tag))
             self._enqueued += 1
+            if tag is not None:
+                self._tag_counters(tag)["enqueued"] += 1
             self._max_depth = max(self._max_depth, len(self._q))
             self._not_empty.notify()
 
@@ -208,6 +233,7 @@ class AsyncDispatcher:
                 "drain_cycles": self._drain_cycles,
                 "dropped": self._dropped,
                 "restarts": self._restarts,
+                "by_tag": {tag: dict(c) for tag, c in self._by_tag.items()},
             }
 
     @property
@@ -233,7 +259,9 @@ class AsyncDispatcher:
                     self._idle.notify_all()
                     return
                 n = len(self._q) if self._max_batch is None else min(len(self._q), self._max_batch)
-                batch = [self._q.popleft() for _ in range(n)]
+                pairs = [self._q.popleft() for _ in range(n)]
+                batch = [item for item, _ in pairs]
+                tags = [t for _, t in pairs if t is not None]
                 depth_after = len(self._q)
                 self._draining = True
                 self._not_full.notify_all()
@@ -250,6 +278,8 @@ class AsyncDispatcher:
                     with self._lock:
                         self._restarts += 1
                         self._drained_items += n  # the handler applied them
+                        for t in tags:
+                            self._tag_counters(t)["drained"] += 1
                         self._drain_cycles += 1
                         self._draining = False
                         _telemetry.record_event(
@@ -268,6 +298,8 @@ class AsyncDispatcher:
                 return
             with self._lock:
                 self._drained_items += n
+                for t in tags:
+                    self._tag_counters(t)["drained"] += 1
                 self._drain_cycles += 1
                 self._draining = False
                 _telemetry.record_event(

@@ -58,8 +58,9 @@ from tpumetrics.metric import Metric
 from tpumetrics.parallel.fuse_update import FusedCollectionStep
 from tpumetrics.runtime.bucketing import (
     ShapeBucketer,
-    _is_per_row,
     check_bucketable,
+    leading_rows,
+    plan_bucketed_update,
     pow2_bucket_edges,
 )
 from tpumetrics.runtime.compile_cache import (
@@ -67,6 +68,7 @@ from tpumetrics.runtime.compile_cache import (
     enable_persistent_compilation_cache,
 )
 from tpumetrics.runtime.dispatch import AsyncDispatcher
+from tpumetrics.runtime.scheduler import SignatureRegistry
 from tpumetrics.runtime import snapshot as _snapshot
 from tpumetrics.telemetry import ledger as _telemetry
 from tpumetrics.utils.exceptions import TPUMetricsUserError
@@ -150,6 +152,11 @@ class StreamingEvaluator:
             exchange; defaults to the ambient
             :func:`~tpumetrics.parallel.backend.get_default_backend` when
             ``snapshot_world_size > 1``.
+        signature_cache_size: LRU capacity of the trace-signature registry
+            backing ``stats()["xla_compiles"]`` (``None`` = unbounded).  A
+            shape-churning stream beyond the capacity costs only eviction
+            accounting (``stats()["signature_evictions"]``) and redundant
+            cold-signature pre-compiles — never correctness or a leak.
     """
 
     def __init__(
@@ -176,6 +183,7 @@ class StreamingEvaluator:
         mesh: Optional[Any] = None,
         partition_rules: Optional[Any] = None,
         data_axis: Optional[str] = None,
+        signature_cache_size: Optional[int] = 4096,
     ) -> None:
         from tpumetrics.collections import MetricCollection
 
@@ -239,7 +247,11 @@ class StreamingEvaluator:
         self._items = 0  # rows applied
         self._latest: Optional[Dict[str, Any]] = None
         self._last_compute_at = 0
-        self._trace_signatures: set = set()  # (bucket, arg shapes/dtypes) seen
+        # (bucket, arg shapes/dtypes) signatures seen — LRU-bounded so an
+        # adversarial shape-churning stream degrades to extra pre-compile
+        # accounting (signature_evictions in stats()) instead of leaking an
+        # unbounded set; jit's own executable cache is unaffected
+        self._trace_signatures = SignatureRegistry(signature_cache_size)
 
         # resilience bookkeeping: batches applied since the last snapshot
         # (the crash-replay journal), its stream base position, crash/restore
@@ -368,7 +380,8 @@ class StreamingEvaluator:
             out.update(
                 batches=self._batches,
                 items=self._items,
-                xla_compiles=len(self._trace_signatures),
+                xla_compiles=self._trace_signatures.inserts,
+                signature_evictions=self._trace_signatures.evictions,
                 buckets=list(self._bucketer.edges) if self._bucketer else None,
                 mesh=(
                     {str(k): int(v) for k, v in self._mesh.shape.items()}
@@ -682,7 +695,7 @@ class StreamingEvaluator:
             self._journal.append(args)
         if self._bucketer is None:
             self._metric.update(*args, **self._update_kwargs)
-            n_rows = _leading_rows(args)
+            n_rows = leading_rows(args)
         else:
             n_rows = self._bucketed_update(args)
         with self._lock:
@@ -782,42 +795,28 @@ class StreamingEvaluator:
                 )
 
     def _bucketed_update(self, args: Tuple[Any, ...]) -> int:
-        n = _leading_rows(args)
-        if n == 0:
-            raise ValueError("submit() got arguments with no per-row array (or zero rows)")
-        if not any(_is_per_row(a, n) for a in args):
-            # scalar-only submit (e.g. an aggregation metric fed floats):
-            # there is nothing to pad, so bucketing — and in particular the
-            # fallback's pad correction — must NOT apply; run the fused
-            # whole-collection step (donated state) over the raw args
-            sig = ("scalar",) + tuple(
-                (tuple(jnp.shape(a)), str(jnp.result_type(a))) for a in args
-            )
-            new_sig = sig not in self._trace_signatures
-            self._trace_signatures.add(sig)
-            self._apply_step(new_sig, lambda s: self._step.update(s, *args))
-            return n
-        offset = 0
-        for size in self._bucketer.chunk_sizes(n):
-            chunk = tuple(
-                a[offset : offset + size] if _is_per_row(a, n) else a for a in args
-            )
-            padded, bucket = self._bucketer.pad_args(chunk, size)
-            # mirrors the jit cache key (shapes + dtypes; python scalars key
-            # by weak result type) — len() of this set == XLA compile count,
-            # per (bucket, signature) for the WHOLE collection, never per
-            # member metric
-            sig = (bucket,) + tuple(
-                (tuple(jnp.shape(a)), str(jnp.result_type(a))) for a in padded
-            )
-            new_sig = sig not in self._trace_signatures
-            self._trace_signatures.add(sig)
+        # the plan (chunking, padding, jit-cache-mirroring signatures) is
+        # shared with the multi-tenant service; signatures feed the
+        # LRU-bounded registry whose insert count == XLA compile count, per
+        # (bucket, signature) for the WHOLE collection, never per member
+        n, chunks = plan_bucketed_update(self._bucketer, args)
+        for chunk in chunks:
+            if chunk[0] == "scalar":
+                # scalar-only submit (e.g. an aggregation metric fed floats):
+                # nothing to pad, so bucketing — and in particular the
+                # fallback's pad correction — must NOT apply; run the fused
+                # whole-collection step (donated state) over the raw args
+                _, cargs, sig = chunk
+                new_sig = self._trace_signatures.observe(sig)
+                self._apply_step(new_sig, lambda s, a=cargs: self._step.update(s, *a))
+                continue
+            _, padded, bucket, size, sig = chunk
+            new_sig = self._trace_signatures.observe(sig)
             n_valid = jnp.asarray(size, jnp.int32)
             self._apply_step(
                 new_sig,
-                lambda s, p=padded, b=bucket: self._step.masked_update(s, p, n_valid, b),
+                lambda s, p=padded, b=bucket, nv=n_valid: self._step.masked_update(s, p, nv, b),
             )
-            offset += size
         return n
 
     def _apply_step(self, new_sig: bool, run: Callable[[Any], Any]) -> None:
@@ -863,13 +862,6 @@ class StreamingEvaluator:
                 "value": value, "batches": batches, "items": items, "degraded": degraded,
             }
             self._last_compute_at = batches
-
-
-def _leading_rows(args: Tuple[Any, ...]) -> int:
-    for a in args:
-        if hasattr(a, "shape") and getattr(a, "ndim", 0) >= 1:
-            return int(a.shape[0])
-    return 1  # scalar-only updates (e.g. aggregation metrics fed floats)
 
 
 def _as_snapshot_payload(payload: Any) -> Dict[str, Any]:

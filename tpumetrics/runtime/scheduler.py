@@ -1,0 +1,177 @@
+"""Fairness and compile-bookkeeping primitives for multiplexed streams.
+
+Two small, lock-free (caller-synchronized) data structures the
+multi-tenant :class:`~tpumetrics.runtime.service.EvaluationService` is
+built from — kept separate so their invariants are unit-testable without
+threads or devices:
+
+- :class:`DeficitRoundRobin` — the classic DRR scheduler over tenant ids.
+  Each tenant carries a *quantum* (its fair share per scheduling round, in
+  whatever cost unit the caller charges — the service charges batch rows)
+  and a *deficit counter*; a tenant may be served while its deficit covers
+  the head-of-queue cost, and earns one quantum per round otherwise.  DRR
+  is O(1) per decision and starvation-free: a backlogged tenant is visited
+  every round regardless of how hot its neighbors run, and a tenant whose
+  cost exceeds its quantum accumulates deficit across rounds until it can
+  be served (never skipped forever).
+
+- :class:`SignatureRegistry` — an LRU-bounded replacement for the
+  unbounded ``set`` the single-stream evaluator used to track trace
+  signatures.  A shape-churning (or adversarial) stream produces unbounded
+  distinct signatures; the registry caps the tracked set and counts
+  evictions instead of leaking.  Eviction only costs accounting accuracy
+  (a re-seen evicted signature is conservatively treated as new again —
+  jit's own executable cache is unaffected), never correctness.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+from typing import Any, Callable, Dict, Hashable, Optional
+
+__all__ = ["DeficitRoundRobin", "SignatureRegistry"]
+
+
+class SignatureRegistry:
+    """LRU-bounded set of trace signatures with insert/eviction accounting.
+
+    Args:
+        capacity: maximum number of signatures tracked; ``None`` = unbounded
+            (the pre-LRU behavior).
+
+    :meth:`observe` returns ``True`` when the signature is *new* (not
+    currently tracked) — the caller's cue to pre-compile — and refreshes
+    recency otherwise.  ``inserts`` counts every new-at-observation
+    signature (== the number of distinct signatures when nothing was ever
+    evicted, which keeps the evaluator's ``xla_compiles`` stat identical on
+    non-adversarial streams); ``evictions`` counts LRU evictions.
+    """
+
+    def __init__(self, capacity: Optional[int] = None) -> None:
+        if capacity is not None and int(capacity) <= 0:
+            raise ValueError(f"capacity must be positive or None, got {capacity}")
+        self._capacity = int(capacity) if capacity is not None else None
+        self._seen: "OrderedDict[Hashable, None]" = OrderedDict()
+        self.inserts = 0
+        self.evictions = 0
+
+    def observe(self, sig: Hashable) -> bool:
+        """Record one signature; ``True`` iff it was not currently tracked."""
+        if sig in self._seen:
+            self._seen.move_to_end(sig)
+            return False
+        self._seen[sig] = None
+        self.inserts += 1
+        if self._capacity is not None:
+            while len(self._seen) > self._capacity:
+                self._seen.popitem(last=False)
+                self.evictions += 1
+        return True
+
+    def __len__(self) -> int:
+        return len(self._seen)
+
+    def __contains__(self, sig: Hashable) -> bool:
+        return sig in self._seen
+
+
+class DeficitRoundRobin:
+    """Deficit round-robin over tenant ids (caller holds the lock).
+
+    The caller owns the actual work queues; the scheduler only tracks the
+    *active ring* (tenants with queued work), per-tenant quanta, and deficit
+    counters.  Protocol per decision::
+
+        tid = drr.select(head_cost)   # head_cost(tid) -> cost or None
+        ... pop + run that tenant's head item ...
+
+    ``head_cost`` returns the cost of a tenant's head-of-queue item, or
+    ``None`` when the tenant has no work (it is then dropped from the ring
+    and its deficit reset — the DRR rule that keeps an idle tenant from
+    hoarding credit).  ``select`` charges the returned tenant's deficit for
+    the head cost; :meth:`charge` lets the caller bill extra cost to a
+    tenant served out of turn (the megabatch path serves several tenants'
+    heads in one device program — fairness must still account for them).
+    """
+
+    def __init__(self) -> None:
+        self._quantum: Dict[Any, float] = {}
+        self._deficit: Dict[Any, float] = {}
+        self._ring: deque = deque()  # active tenants, head = next to visit
+        self._in_ring: set = set()
+
+    # ------------------------------------------------------------ membership
+
+    def add(self, tid: Any, quantum: float) -> None:
+        if tid in self._quantum:
+            raise ValueError(f"tenant {tid!r} already scheduled")
+        if not quantum > 0:
+            raise ValueError(f"quantum must be positive, got {quantum}")
+        self._quantum[tid] = float(quantum)
+        self._deficit[tid] = 0.0
+
+    def remove(self, tid: Any) -> None:
+        self._quantum.pop(tid, None)
+        self._deficit.pop(tid, None)
+        if tid in self._in_ring:
+            self._in_ring.discard(tid)
+            self._ring.remove(tid)
+
+    def activate(self, tid: Any) -> None:
+        """Mark a tenant as having queued work (idempotent)."""
+        if tid not in self._quantum:
+            raise KeyError(f"unknown tenant {tid!r}")
+        if tid not in self._in_ring:
+            self._ring.append(tid)
+            self._in_ring.add(tid)
+
+    @property
+    def active(self) -> int:
+        return len(self._ring)
+
+    # ------------------------------------------------------------ scheduling
+
+    def select(self, head_cost: Callable[[Any], Optional[float]]) -> Optional[Any]:
+        """Pick the next tenant to serve one head item from, or ``None``
+        when no tenant has work.  Charges the winner's deficit."""
+        while self._ring:
+            # one pass over the ring; tenants whose deficit cannot cover
+            # their head cost earn a quantum and rotate to the tail.  If a
+            # full pass serves nobody, the loop re-enters and everyone earns
+            # again — the bounded "fast-forward" of DRR rounds for a head
+            # item costing more than one quantum.
+            served_possible = False
+            for _ in range(len(self._ring)):
+                tid = self._ring[0]
+                cost = head_cost(tid)
+                if cost is None:
+                    # no work: leave the ring, forfeit accumulated deficit
+                    self._ring.popleft()
+                    self._in_ring.discard(tid)
+                    self._deficit[tid] = 0.0
+                    if not self._ring:
+                        return None
+                    continue
+                if self._deficit[tid] >= cost:
+                    self._deficit[tid] -= cost
+                    return tid
+                self._deficit[tid] += self._quantum[tid]
+                if self._deficit[tid] >= cost:
+                    served_possible = True
+                self._ring.rotate(-1)
+            if not self._ring:
+                return None
+            if not served_possible:
+                # every active tenant still short after earning this round's
+                # quantum — keep earning (equivalent to idling real rounds)
+                continue
+        return None
+
+    def charge(self, tid: Any, cost: float) -> None:
+        """Bill extra served cost to a tenant (megabatch co-service); the
+        deficit may go negative, deferring its next solo turn."""
+        if tid in self._deficit:
+            self._deficit[tid] -= float(cost)
+
+    def deficit(self, tid: Any) -> float:
+        return self._deficit.get(tid, 0.0)

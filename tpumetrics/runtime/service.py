@@ -1,0 +1,1127 @@
+"""EvaluationService — thousands of tenant streams, one dispatcher.
+
+The single-stream :class:`~tpumetrics.runtime.evaluator.StreamingEvaluator`
+owns a whole worker thread and a private compile universe.  Production
+traffic is many models / tenants / experiments evaluated *concurrently* on
+shared hardware, where the wins come from sharing — the device, the
+compile cache, and the batch:
+
+- **One dispatcher.**  N tenants multiplex onto ONE
+  :class:`~tpumetrics.runtime.dispatch.AsyncDispatcher` / worker thread /
+  device owner.  Each tenant registers a Metric / MetricCollection and gets
+  a lightweight :class:`TenantHandle` with the familiar
+  ``submit/flush/compute/snapshot/stats`` surface backed by a per-tenant
+  **bounded queue** (own backpressure policy: ``block`` / ``drop_oldest`` /
+  ``error``) — one hot tenant fills its own queue, never the neighbors'.
+
+- **Cross-tenant fairness.**  The worker drains a deficit-round-robin
+  schedule (:class:`~tpumetrics.runtime.scheduler.DeficitRoundRobin`) over
+  the tenant queues; each tenant's ``quota`` is its DRR quantum in batch
+  rows per round.  DRR is starvation-free: a backlogged tenant is served
+  every round no matter how hot its neighbors run.
+
+- **Global trace-signature dedupe.**  Tenants whose metric configuration
+  digests identically (same
+  :func:`~tpumetrics.resilience.elastic.config_digest`, update kwargs, and
+  donation mode) SHARE one
+  :class:`~tpumetrics.parallel.fuse_update.FusedCollectionStep` — and with
+  it one jit program cache: K tenants running the same model eval compile
+  once, not K times (and once per *process set* with the PR 6 persistent
+  compile cache).  The per-evaluator trace-signature set becomes one
+  service-wide LRU :class:`~tpumetrics.runtime.scheduler.SignatureRegistry`
+  keyed by (step identity, bucket, signature).
+
+- **Megabatch fast path.**  Same-step, same-bucket, same-signature head
+  batches from *different* tenants are driven through ONE vmapped device
+  program per drain decision
+  (:meth:`~tpumetrics.parallel.fuse_update.FusedCollectionStep.
+  megabatch_update`): the per-tenant states ride a leading tenant axis
+  inside the trace and come back unstacked, so K small dispatches become
+  one.  Groups pad to power-of-two sizes with fresh-state dummies to bound
+  the K-specialization universe.  Eligibility: bucketed tenant, no mesh,
+  megabatch enabled, and the head batch fits one bucket chunk — everything
+  else takes the same single-tenant path the evaluator runs.
+
+- **Per-tenant failure domains.**  A batch that crashes the worker is
+  handled inside the tenant that submitted it: ``crash_policy="restore"``
+  replays the tenant's journal from its latest snapshot (bounded by
+  ``max_restores``), and exhaustion — or ``crash_policy="raise"`` — puts
+  THAT tenant into **quarantine** (its queue dropped, its handle raising
+  :class:`TenantQuarantinedError`) while every other tenant keeps
+  computing, bit-identically.  The dispatcher itself is never poisoned by
+  tenant work.
+
+- **Per-tenant telemetry.**  Every ledger event the service emits runs
+  under an attribution tag naming the tenant, the dispatcher splits its
+  drop/drain counters per tag, and snapshots live in per-tenant
+  directories (per-tenant ``snapshot_dir``; restores validate the spec per
+  tenant and never cross-contaminate).
+
+See ``docs/service.md`` for the tenancy model and megabatch eligibility
+rules; ``bench.py``'s ``multitenant_scaling`` scenario gates the
+16-tenants-through-one-service throughput ratio and the 1000-stream soak's
+p99 submit latency.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.metric import Metric
+from tpumetrics.parallel.fuse_update import FusedCollectionStep
+from tpumetrics.runtime.bucketing import (
+    ShapeBucketer,
+    check_bucketable,
+    leading_rows,
+    pad_args_to,
+    plan_bucketed_update,
+    pow2_bucket_edges,
+    single_chunk_signature,
+)
+from tpumetrics.runtime.compile_cache import (
+    ENV_CACHE_DIR,
+    enable_persistent_compilation_cache,
+)
+from tpumetrics.runtime.dispatch import AsyncDispatcher
+from tpumetrics.runtime.evaluator import CrashLoopError
+from tpumetrics.runtime.scheduler import DeficitRoundRobin, SignatureRegistry
+from tpumetrics.runtime import snapshot as _snapshot
+from tpumetrics.telemetry import ledger as _telemetry
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+_POLICIES = ("block", "drop_oldest", "error")
+
+
+def _state_alive(state: Any) -> bool:
+    """Whether every array leaf of a state pytree is still usable — a
+    donating dispatch that failed mid-execution leaves deleted buffers."""
+    for leaf in jax.tree_util.tree_leaves(state):
+        deleted = getattr(leaf, "is_deleted", None)
+        if deleted is not None and deleted():
+            return False
+    return True
+
+
+class TenantQuarantinedError(TPUMetricsUserError):
+    """The tenant's stream is fenced off after a crash (or a spent
+    crash-loop budget); the underlying failure is ``__cause__``.  Other
+    tenants are unaffected — quarantine is the service's unit of blast
+    radius."""
+
+
+class _Tenant:
+    """Internal per-tenant record; every field is guarded by the service
+    lock except ``journal``/``journal_base``/``crash bookkeeping``, which
+    only the worker thread touches (the evaluator's convention)."""
+
+    def __init__(
+        self,
+        tid: str,
+        metric: Any,
+        bucketer: Optional[ShapeBucketer],
+        step: Optional[FusedCollectionStep],
+        step_token: Any,
+        state: Optional[Dict[str, Any]],
+        *,
+        max_queue: int,
+        policy: str,
+        quota: float,
+        update_kwargs: Dict[str, Any],
+        compute_every: Optional[int],
+        snapshots: Optional[_snapshot.SnapshotManager],
+        snapshot_every: Optional[int],
+        crash_policy: str,
+        max_restores: int,
+        guard_non_finite: str,
+        megabatch: bool,
+    ) -> None:
+        self.tid = tid
+        self.metric = metric
+        self.bucketer = bucketer
+        self.step = step
+        self.step_token = step_token  # signature-registry namespace + share key
+        self.state = state
+        self.max_queue = int(max_queue)
+        self.policy = policy
+        self.quota = float(quota)
+        self.update_kwargs = update_kwargs
+        self.compute_every = compute_every
+        self.snapshots = snapshots
+        self.snapshot_every = snapshot_every
+        self.crash_policy = crash_policy
+        self.max_restores = int(max_restores)
+        self.guard_non_finite = guard_non_finite
+        self.megabatch = bool(megabatch)
+
+        # queue entries: (args, n_rows, single_chunk_sig_or_None) — the row
+        # count and megabatch probe are computed at submit time (caller
+        # thread) so the worker's locked scheduling pass stays O(heads)
+        self.queue: deque = deque()
+        self.pending = 0  # queued + in-flight batches (flush waits on 0)
+        self.error: Optional[BaseException] = None  # quarantine cause
+
+        self.batches = 0
+        self.items = 0
+        self.enqueued = 0
+        self.dropped = 0
+        self.megabatched = 0  # batches applied via the megabatch path
+        self.latest: Optional[Dict[str, Any]] = None
+        self.last_compute_at = 0
+        self.degraded = False
+
+        self.journal: list = []
+        self.journal_base = 0
+        self.crashes = 0
+        self.restores = 0
+
+
+class TenantHandle:
+    """A tenant's view of the service: the familiar single-stream surface
+    (``submit``/``flush``/``compute``/``snapshot``/``restore_latest``/
+    ``latest_result``/``stats``) routed through the shared dispatcher.
+    Lightweight — holding a thousand of these costs a thousand queue
+    objects, not a thousand worker threads."""
+
+    def __init__(self, service: "EvaluationService", tid: str) -> None:
+        self._service = service
+        self._tid = tid
+
+    @property
+    def tenant_id(self) -> str:
+        return self._tid
+
+    def submit(self, *args: Any) -> None:
+        self._service.submit(self._tid, *args)
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        self._service.flush(self._tid, timeout=timeout)
+
+    def compute(self) -> Any:
+        return self._service.compute(self._tid)
+
+    def latest_result(self) -> Optional[Dict[str, Any]]:
+        return self._service.latest_result(self._tid)
+
+    def snapshot(self) -> str:
+        return self._service.snapshot(self._tid)
+
+    def restore_latest(self) -> Optional[int]:
+        return self._service.restore_latest(self._tid)
+
+    def stats(self) -> Dict[str, Any]:
+        return self._service.tenant_stats(self._tid)
+
+    @property
+    def quarantined(self) -> bool:
+        return self._service.tenant_error(self._tid) is not None
+
+    @property
+    def quarantine_cause(self) -> Optional[BaseException]:
+        return self._service.tenant_error(self._tid)
+
+
+class EvaluationService:
+    """Multi-tenant streaming evaluation: N metric streams, one dispatcher.
+
+    Args:
+        max_tokens: capacity of the shared dispatcher's wake-token queue.
+            Tokens are tiny (one per submitted batch); real backpressure is
+            per-tenant, so this only bounds total queued batches across all
+            tenants.
+        signature_cache_size: LRU capacity of the service-wide trace-
+            signature registry (``None`` = unbounded) — the global analog
+            of the evaluator's ``signature_cache_size``.
+        megabatch_max_group: cap on tenants stacked into one megabatch
+            program (default 16).  Bounds both the vmapped program's
+            parameter count (a thousand-tenant group would compile a
+            thousand-input XLA program) and — with power-of-two group
+            padding — the K-specialization universe to
+            ``log2(megabatch_max_group)`` programs per bucket.
+        compile_cache_dir: enable JAX's persistent compilation cache
+            (:func:`~tpumetrics.runtime.compile_cache.
+            enable_persistent_compilation_cache`) so the deduped compiles
+            also amortize across processes/restarts.
+        name: dispatcher thread / telemetry name.
+
+    Register tenants with :meth:`register`; each returns a
+    :class:`TenantHandle`.  The module docstring describes the sharing
+    layers (step dedupe, megabatch) and the isolation contract.
+    """
+
+    def __init__(
+        self,
+        *,
+        max_tokens: int = 65536,
+        signature_cache_size: Optional[int] = 8192,
+        megabatch_max_group: int = 16,
+        compile_cache_dir: Optional[str] = None,
+        name: str = "EvaluationService",
+    ) -> None:
+        if int(megabatch_max_group) < 2:
+            raise ValueError(
+                f"megabatch_max_group must be >= 2, got {megabatch_max_group}"
+            )
+        if compile_cache_dir is not None or os.environ.get(ENV_CACHE_DIR):
+            enable_persistent_compilation_cache(compile_cache_dir)
+        self._lock = threading.Lock()
+        self._space = threading.Condition(self._lock)  # per-tenant queue space
+        self._done = threading.Condition(self._lock)  # per-tenant pending -> 0
+        self._tenants: Dict[str, _Tenant] = {}
+        self._drr = DeficitRoundRobin()
+        self._signatures = SignatureRegistry(signature_cache_size)
+        # step dedupe: share key -> FusedCollectionStep (single-device,
+        # hashable-kwargs steps only; mesh'd / unhashable-kwargs tenants get
+        # private steps)
+        self._steps: Dict[Any, FusedCollectionStep] = {}
+        # megabatch readiness: share key -> tenant ids with queued work
+        self._ready: Dict[Any, set] = {}
+        self._megabatch_max = int(megabatch_max_group)
+        self._megabatch_steps = 0
+        self._megabatch_tenants = 0
+        self._mega_group_meta = (0, 0, 0)  # worker-thread-only scratch
+        self._quarantines = 0
+        self._dispatcher = AsyncDispatcher(
+            self._drain, max_queue=max_tokens, policy="block", name=name
+        )
+
+    # ------------------------------------------------------------ registration
+
+    def register(
+        self,
+        tenant_id: str,
+        metric: Any,
+        *,
+        buckets: Union[None, int, Sequence[int]] = None,
+        update_kwargs: Optional[Dict[str, Any]] = None,
+        quota: float = 64.0,
+        max_queue: int = 256,
+        backpressure: str = "block",
+        compute_every: Optional[int] = None,
+        snapshot_dir: Optional[str] = None,
+        snapshot_every: Optional[int] = None,
+        keep_snapshots: Optional[int] = 3,
+        crash_policy: str = "raise",
+        max_restores: int = 3,
+        guard_non_finite: str = "off",
+        donate_state: bool = True,
+        megabatch: bool = True,
+        mesh: Optional[Any] = None,
+        partition_rules: Optional[Any] = None,
+        data_axis: Optional[str] = None,
+    ) -> TenantHandle:
+        """Register one tenant stream; returns its :class:`TenantHandle`.
+
+        The per-tenant arguments mirror :class:`StreamingEvaluator`:
+        ``buckets`` (``None`` = the eager update path — no sharing, no
+        megabatch), ``backpressure``/``max_queue`` (this tenant's bounded
+        queue), ``snapshot_dir`` (this tenant's private snapshot root),
+        ``crash_policy``/``max_restores`` (quarantine is the budget-spent
+        outcome), ``mesh``/``partition_rules``/``data_axis`` (sharded
+        execution — a private step, megabatch-excluded).  ``quota`` is the
+        DRR quantum in batch rows per scheduling round — a tenant with
+        twice the quota gets twice the share of a contended worker.
+        ``megabatch=False`` opts this tenant out of cross-tenant stacking
+        (it still shares the step's compile cache)."""
+        from tpumetrics.collections import MetricCollection
+
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(f"Expected Metric or MetricCollection, got {type(metric)}")
+        if backpressure not in _POLICIES:
+            raise ValueError(
+                f"Unknown backpressure policy {backpressure!r}; expected one of {_POLICIES}"
+            )
+        if int(max_queue) <= 0:
+            raise ValueError(f"max_queue must be positive, got {max_queue}")
+        if crash_policy not in ("raise", "restore"):
+            raise ValueError(f"crash_policy must be 'raise' or 'restore', got {crash_policy!r}")
+        if guard_non_finite not in ("off", "warn", "error"):
+            raise ValueError(
+                f"guard_non_finite must be 'off', 'warn' or 'error', got {guard_non_finite!r}"
+            )
+        if not quota > 0:
+            raise ValueError(f"quota must be positive, got {quota}")
+        if snapshot_every is not None and snapshot_dir is None:
+            raise ValueError("snapshot_every requires snapshot_dir")
+        kwargs = dict(update_kwargs or {})
+
+        if buckets is None:
+            if mesh is not None:
+                raise ValueError("mesh (sharded execution mode) requires buckets")
+            bucketer = step = None
+            state = None
+            step_token: Any = ("eager", tenant_id)
+        else:
+            edges = pow2_bucket_edges(int(buckets)) if isinstance(buckets, int) else tuple(buckets)
+            bucketer = ShapeBucketer(edges)
+            check_bucketable(metric)
+            step, step_token = self._resolve_step(
+                metric, kwargs, donate=bool(donate_state), mesh=mesh,
+                partition_rules=partition_rules, data_axis=data_axis,
+                tenant_id=tenant_id,
+            )
+            state = step.init_state()
+
+        snapshots = (
+            _snapshot.SnapshotManager(snapshot_dir, keep=keep_snapshots)
+            if snapshot_dir
+            else None
+        )
+        tenant = _Tenant(
+            tenant_id, metric, bucketer, step, step_token, state,
+            max_queue=max_queue, policy=backpressure, quota=quota,
+            update_kwargs=kwargs, compute_every=compute_every,
+            snapshots=snapshots, snapshot_every=snapshot_every,
+            crash_policy=crash_policy, max_restores=max_restores,
+            guard_non_finite=guard_non_finite,
+            megabatch=megabatch and step is not None and mesh is None,
+        )
+        with self._lock:
+            if tenant_id in self._tenants:
+                raise ValueError(f"tenant {tenant_id!r} is already registered")
+            # the scheduler joins FIRST: a failure here must not publish a
+            # half-registered zombie tenant
+            self._drr.add(tenant_id, quota)
+            self._tenants[tenant_id] = tenant
+        return TenantHandle(self, tenant_id)
+
+    def _resolve_step(
+        self,
+        metric: Any,
+        kwargs: Dict[str, Any],
+        *,
+        donate: bool,
+        mesh: Optional[Any],
+        partition_rules: Optional[Any],
+        data_axis: Optional[str],
+        tenant_id: str,
+    ) -> Tuple[FusedCollectionStep, Any]:
+        """The global dedupe layer: same (config digest, static kwargs,
+        donation) tenants share ONE step — one program cache, one compile
+        per (bucket, signature) no matter how many tenants run the eval.
+        Mesh'd tenants and unhashable kwargs fall back to a private step
+        (still persistent-cache-backed), keyed per tenant."""
+        from tpumetrics.resilience.elastic import config_digest
+
+        share_key: Any = None
+        if mesh is None:
+            try:
+                share_key = (config_digest(metric), tuple(sorted(kwargs.items())), donate)
+                hash(share_key)
+            except TypeError:
+                share_key = None
+        if share_key is not None:
+            with self._lock:
+                step = self._steps.get(share_key)
+            if step is not None:
+                return step, share_key
+        step = FusedCollectionStep(
+            metric, update_kwargs=kwargs, donate=donate,
+            mesh=mesh, partition_rules=partition_rules, data_axis=data_axis,
+        )
+        if share_key is not None:
+            with self._lock:
+                step = self._steps.setdefault(share_key, step)
+            return step, share_key
+        return step, ("private", tenant_id)
+
+    # -------------------------------------------------------------- ingestion
+
+    def submit(self, tenant_id: str, *args: Any) -> None:
+        """Enqueue one batch for a tenant; applies THAT tenant's
+        backpressure policy.  Never runs a device step on the caller's
+        thread — cost is one signature probe + one bounded enqueue."""
+        if not args:
+            raise ValueError("submit() needs at least one positional batch argument")
+        tenant = self._get(tenant_id)
+        # probe computed outside the lock: row count for DRR cost, and the
+        # single-chunk signature for the worker's megabatch grouping.  A
+        # probe failure (pathological args) is NOT the caller's crash — the
+        # batch takes the single-tenant worker path, whose crash fence owns
+        # the failure and quarantines only this tenant.
+        n = leading_rows(args)
+        probe = None
+        if tenant.bucketer is not None:
+            try:
+                probe = single_chunk_signature(tenant.bucketer, args)
+            except Exception:
+                probe = None
+        entry = (tuple(args), max(int(n), 1), probe)
+        with self._lock:
+            self._raise_if_quarantined(tenant)
+            if len(tenant.queue) >= tenant.max_queue:
+                if tenant.policy == "error":
+                    from tpumetrics.runtime.dispatch import QueueFullError
+
+                    raise QueueFullError(
+                        f"Tenant {tenant_id!r} queue full ({tenant.max_queue} batches) "
+                        "under policy='error'."
+                    )
+                if tenant.policy == "drop_oldest":
+                    tenant.queue.popleft()
+                    tenant.pending -= 1
+                    tenant.dropped += 1
+                    with _telemetry.attribution(tenant_id):
+                        _telemetry.record_event(
+                            self, "runtime_drop", dropped_total=tenant.dropped
+                        )
+                else:  # block
+                    while len(tenant.queue) >= tenant.max_queue:
+                        self._raise_if_quarantined(tenant)
+                        self._space.wait()
+            tenant.queue.append(entry)
+            tenant.pending += 1
+            tenant.enqueued += 1
+            self._drr.activate(tenant_id)
+            self._mark_ready(tenant)
+        self._dispatcher.submit(tenant_id, tag=tenant_id)
+
+    def flush(self, tenant_id: Optional[str] = None, timeout: Optional[float] = None) -> None:
+        """Block until the tenant's queue is fully applied (``tenant_id=None``
+        = every tenant).  Raises :class:`TenantQuarantinedError` when the
+        awaited tenant was quarantined (its queue was discarded)."""
+        if tenant_id is None:
+            self._dispatcher.flush(timeout=timeout)
+            return
+        tenant = self._get(tenant_id)
+        with self._lock:
+            while tenant.pending > 0 and tenant.error is None:
+                if not self._done.wait(timeout=timeout):
+                    raise TimeoutError(
+                        f"Tenant {tenant_id!r} did not drain within {timeout}s "
+                        f"(pending={tenant.pending})."
+                    )
+            self._raise_if_quarantined(tenant)
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        """Flush every tenant (unless ``drain=False``) and stop the worker."""
+        self._dispatcher.close(drain=drain, timeout=timeout)
+
+    def __enter__(self) -> "EvaluationService":
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> None:
+        try:
+            self.close(drain=exc_type is None)
+        except Exception:
+            if exc_type is None:
+                raise
+
+    # ---------------------------------------------------------------- results
+
+    def compute(self, tenant_id: str) -> Any:
+        """Exact result over everything the tenant submitted (flushes it
+        first)."""
+        tenant = self._get(tenant_id)
+        self.flush(tenant_id)
+        with self._lock:
+            self._raise_if_quarantined(tenant)
+            if tenant.bucketer is None:
+                value = tenant.metric.compute()
+                tenant.degraded = bool(getattr(tenant.metric, "degraded", False))
+                return value
+            # the step's metric runs ALL functional ops for shared-step
+            # tenants (init/update/compute from one config-identical object),
+            # so state structure and compute can never drift between sharers
+            return tenant.step._metric.functional_compute(tenant.state)
+
+    def latest_result(self, tenant_id: str) -> Optional[Dict[str, Any]]:
+        """The tenant's bounded-staleness result (``compute_every=n``);
+        never blocks on the queue."""
+        tenant = self._get(tenant_id)
+        with self._lock:
+            return dict(tenant.latest) if tenant.latest is not None else None
+
+    def tenant_error(self, tenant_id: str) -> Optional[BaseException]:
+        tenant = self._get(tenant_id)
+        with self._lock:
+            return tenant.error
+
+    def tenant_stats(self, tenant_id: str) -> Dict[str, Any]:
+        tenant = self._get(tenant_id)
+        with self._lock:
+            return {
+                "batches": tenant.batches,
+                "items": tenant.items,
+                "enqueued": tenant.enqueued,
+                "depth": len(tenant.queue),
+                "pending": tenant.pending,
+                "dropped": tenant.dropped,
+                "megabatched": tenant.megabatched,
+                "quarantined": tenant.error is not None,
+                "degraded": tenant.degraded,
+                "crashes": tenant.crashes,
+                "restores": tenant.restores,
+                "buckets": list(tenant.bucketer.edges) if tenant.bucketer else None,
+            }
+
+    def stats(self) -> Dict[str, Any]:
+        """Service-wide counters: the shared dispatcher's (with the per-tag
+        split), compile dedupe accounting, and megabatch totals."""
+        out = self._dispatcher.stats()
+        with self._lock:
+            out.update(
+                tenants=len(self._tenants),
+                shared_steps=len(self._steps),
+                xla_compiles=self._signatures.inserts,
+                signatures_tracked=len(self._signatures),
+                signature_evictions=self._signatures.evictions,
+                megabatch_steps=self._megabatch_steps,
+                megabatch_tenants=self._megabatch_tenants,
+                quarantined_tenants=self._quarantines,
+            )
+        return out
+
+    # -------------------------------------------------------------- snapshots
+
+    def snapshot(self, tenant_id: str) -> str:
+        """Flush the tenant, then persist its state into its own snapshot
+        directory, tagged with its stream position."""
+        tenant = self._get(tenant_id)
+        if tenant.snapshots is None:
+            raise TPUMetricsUserError(
+                f"Tenant {tenant_id!r} was registered without snapshot_dir"
+            )
+        self.flush(tenant_id)
+        with self._lock:
+            self._raise_if_quarantined(tenant)
+            return self._save_snapshot_locked(tenant)
+
+    def _save_snapshot_locked(self, tenant: _Tenant) -> str:
+        if tenant.snapshots.last_step == tenant.batches:
+            # a manual snapshot right after an auto-snapshot at the same
+            # stream position: identical state by the determinism contract
+            for step, path in _snapshot.list_snapshots(tenant.snapshots.directory):
+                if step == tenant.batches:
+                    return path
+        meta = {
+            "batches": tenant.batches,
+            "items": tenant.items,
+            "metric": type(tenant.metric).__name__,
+            "mode": "bucketed" if tenant.bucketer is not None else "eager",
+            "degraded": tenant.degraded,
+            "tenant": tenant.tid,
+        }
+        payload: Any = (
+            tenant.state if tenant.bucketer is not None else tenant.metric.snapshot_state()
+        )
+        path = tenant.snapshots.save(
+            tenant.batches, payload, meta=meta, guard_non_finite=tenant.guard_non_finite
+        )
+        self._trim_journal(tenant)
+        return path
+
+    @staticmethod
+    def _trim_journal(tenant: _Tenant) -> None:
+        """Discard exactly the journal entries the just-saved snapshot
+        covers.  The worker journals a batch BEFORE applying it (lock-free),
+        so a batch drained between a user snapshot()'s flush and its lock
+        acquisition may already sit in the journal without being counted in
+        ``batches`` — rebinding ``journal = []`` would silently drop it from
+        crash replay.  Entries covered by the snapshot number exactly
+        ``batches - journal_base``; deleting that prefix keeps any in-flight
+        tail (del/append interleave safely under the GIL)."""
+        covered = tenant.batches - tenant.journal_base
+        del tenant.journal[:covered]
+        tenant.journal_base = tenant.batches
+
+    def restore_latest(self, tenant_id: str) -> Optional[int]:
+        """Restore the tenant's newest compatible snapshot; returns the
+        stream position to replay from (``None`` = no snapshot).  Must run
+        before the tenant's first ``submit``."""
+        tenant = self._get(tenant_id)
+        if tenant.snapshots is None:
+            raise TPUMetricsUserError(
+                f"Tenant {tenant_id!r} was registered without snapshot_dir"
+            )
+        with self._lock:
+            self._raise_if_quarantined(tenant)
+            if tenant.batches or tenant.pending:
+                raise TPUMetricsUserError(
+                    "restore_latest() after ingestion started would double-count; "
+                    "restore on a fresh tenant, then replay from the returned position."
+                )
+            got = self._load_latest_snapshot(tenant)
+            if got is None:
+                return None
+            return self._adopt_snapshot_locked(tenant, got)
+
+    def _load_latest_snapshot(self, tenant: _Tenant) -> Optional[Tuple[Any, Dict[str, Any]]]:
+        if tenant.snapshots is None:
+            return None
+        if tenant.bucketer is not None:
+            return tenant.snapshots.restore_latest(tenant.step._metric.init_state())
+        return _snapshot.restore_latest_reconstruct(tenant.snapshots.directory)
+
+    def _adopt_snapshot_locked(
+        self, tenant: _Tenant, got: Optional[Tuple[Any, Dict[str, Any]]]
+    ) -> int:
+        if got is None:
+            if tenant.bucketer is not None:
+                tenant.state = tenant.step.init_state()
+            else:
+                tenant.metric.reset()
+            restored, items, degraded = 0, 0, False
+        else:
+            payload, header = got
+            if tenant.bucketer is not None:
+                # donation-safe on-device placement (host-backed leaves must
+                # never be donated — see StreamingEvaluator._place_state)
+                tenant.state = tenant.step.place(payload)
+            else:
+                from tpumetrics.runtime.evaluator import _as_snapshot_payload
+
+                tenant.metric.load_snapshot_state(_as_snapshot_payload(payload))
+            restored = int(header["meta"]["batches"])
+            items = int(header["meta"]["items"])
+            degraded = bool(header["meta"].get("degraded", False))
+        tenant.batches = restored
+        tenant.items = items
+        tenant.last_compute_at = restored
+        tenant.journal = []
+        tenant.journal_base = restored
+        tenant.degraded = degraded
+        return restored
+
+    # ----------------------------------------------------------------- worker
+
+    def _get(self, tenant_id: str) -> _Tenant:
+        tenant = self._tenants.get(tenant_id)
+        if tenant is None:
+            raise KeyError(f"unknown tenant {tenant_id!r}")
+        return tenant
+
+    def _raise_if_quarantined(self, tenant: _Tenant) -> None:
+        if tenant.error is not None:
+            raise TenantQuarantinedError(
+                f"Tenant {tenant.tid!r} is quarantined after "
+                f"{type(tenant.error).__name__}: {tenant.error}"
+            ) from tenant.error
+
+    def _mark_ready(self, tenant: _Tenant) -> None:
+        if tenant.megabatch and tenant.queue:
+            self._ready.setdefault(tenant.step_token, set()).add(tenant.tid)
+
+    def _unmark_ready(self, tenant: _Tenant) -> None:
+        if not tenant.queue:
+            ready = self._ready.get(tenant.step_token)
+            if ready is not None:
+                ready.discard(tenant.tid)
+
+    def _drain(self, tokens: List[Any]) -> None:
+        """Worker-side: serve the DRR schedule until every tenant queue is
+        empty.  Tokens only wake the worker — one is enqueued per submitted
+        batch, so the dispatcher's flush/idle semantics hold (the queues
+        are provably empty whenever the token queue is); a token whose
+        batch was already co-served (megabatch) or dropped drains as a
+        no-op."""
+        while True:
+            group = self._take_group()
+            if group is None:
+                return
+            self._run_group(*group)
+
+    def _take_group(self):
+        """Pick the next fair unit of work under the lock: the DRR winner's
+        head batch, plus — when it is megabatch-eligible — every other
+        ready tenant's head with the SAME (step, bucket, signature), each
+        co-served tenant's deficit charged for its rows."""
+        with self._lock:
+            tid = self._drr.select(self._head_cost)
+            if tid is None:
+                return None
+            tenant = self._tenants[tid]
+            args, n, probe = tenant.queue.popleft()
+            self._unmark_ready(tenant)
+            self._space.notify_all()
+            if not (tenant.megabatch and probe is not None):
+                return ("single", [(tenant, args, n, probe)])
+            bucket, _, sig = probe
+            members = [(tenant, args, n, probe)]
+            ready = self._ready.get(tenant.step_token)
+            if ready:
+                for other_id in list(ready):
+                    if len(members) >= self._megabatch_max:
+                        break
+                    if other_id == tid:
+                        continue
+                    other = self._tenants[other_id]
+                    if other.error is not None or not other.queue:
+                        continue
+                    o_args, o_n, o_probe = other.queue[0]
+                    if o_probe is None or o_probe[0] != bucket or o_probe[2] != sig:
+                        continue
+                    other.queue.popleft()
+                    self._unmark_ready(other)
+                    self._drr.charge(other_id, o_n)
+                    members.append((other, o_args, o_n, o_probe))
+                self._space.notify_all()
+            if len(members) == 1:
+                return ("single", members)
+            return ("mega", members)
+
+    def _head_cost(self, tid: str) -> Optional[float]:
+        tenant = self._tenants[tid]
+        if tenant.error is not None or not tenant.queue:
+            return None
+        return float(tenant.queue[0][1])
+
+    def _run_group(self, kind: str, members: list) -> None:
+        if kind == "mega" and len(members) > 1:
+            try:
+                self._megabatch_dispatch(members)
+            except BaseException as err:  # noqa: BLE001 — fenced per member
+                # a megabatch failure cannot be attributed to one tenant and
+                # nothing was written back — re-run members individually and
+                # let each tenant's own crash path fence the actual culprit
+                self._megabatch_fallback(members, err)
+                return
+            self._megabatch_finish(members)
+            return
+        for tenant, args, _n, _probe in members:
+            self._run_single(tenant, args)
+
+    # ------------------------------------------------------------- single path
+
+    def _run_single(self, tenant: _Tenant, args: Tuple[Any, ...]) -> None:
+        try:
+            with _telemetry.attribution(tenant.tid):
+                self._apply_batch(tenant, args)
+        except BaseException as err:  # noqa: BLE001 — fenced per tenant
+            self._handle_tenant_crash(tenant, err)
+        finally:
+            self._finish_one(tenant)
+
+    def _finish_one(self, tenant: _Tenant) -> None:
+        with self._lock:
+            tenant.pending -= 1
+            self._done.notify_all()
+
+    def _apply_batch(self, tenant: _Tenant, args: Tuple[Any, ...]) -> None:
+        """Apply ONE batch to one tenant (journal, transition, counters,
+        cadences) — the evaluator's ``_apply_one``, scoped to a tenant."""
+        if tenant.crash_policy == "restore":
+            tenant.journal.append(args)
+        if tenant.bucketer is None:
+            tenant.metric.update(*args, **tenant.update_kwargs)
+            n_rows = leading_rows(args)
+        else:
+            n_rows = self._bucketed_update(tenant, args)
+        self._count_applied(tenant, args, n_rows)
+
+    def _count_applied(self, tenant: _Tenant, args: Tuple[Any, ...], n_rows: int) -> None:
+        with self._lock:
+            tenant.batches += 1
+            tenant.items += n_rows
+            batches = tenant.batches
+        if (
+            tenant.compute_every
+            and batches - tenant.last_compute_at >= tenant.compute_every
+        ):
+            self._refresh_latest(tenant)
+        if (
+            tenant.snapshot_every
+            and tenant.snapshots is not None
+            and batches % tenant.snapshot_every == 0
+        ):
+            self._auto_snapshot(tenant)
+
+    def _auto_snapshot(self, tenant: _Tenant) -> None:
+        """The worker-side snapshot cadence serializes OUTSIDE the service
+        lock: the worker is the only thread that mutates (or donates) this
+        tenant's state and journal, so a reference captured under the lock
+        stays valid for the whole file write — and one tenant's disk write
+        never sits in every other tenant's submit path (the 1000-stream
+        soak's p99 gate).  The user-facing :meth:`snapshot` keeps the full
+        lock instead: it must exclude concurrent worker donation, which the
+        worker itself never has to."""
+        with self._lock:
+            if tenant.snapshots.last_step == tenant.batches:
+                # a crash-restore replay re-fires the cadence at an
+                # already-saved position: the state is identical by the
+                # determinism contract — reuse, like the evaluator does
+                return
+            payload: Any = (
+                tenant.state if tenant.bucketer is not None
+                else tenant.metric.snapshot_state()
+            )
+            meta = {
+                "batches": tenant.batches,
+                "items": tenant.items,
+                "metric": type(tenant.metric).__name__,
+                "mode": "bucketed" if tenant.bucketer is not None else "eager",
+                "degraded": tenant.degraded,
+                "tenant": tenant.tid,
+            }
+            batches = tenant.batches
+        tenant.snapshots.save(
+            batches, payload, meta=meta, guard_non_finite=tenant.guard_non_finite
+        )
+        # worker-side: nothing can be appended meanwhile, but the covered-
+        # prefix trim is the one correct formula on both paths
+        with self._lock:
+            self._trim_journal(tenant)
+
+    def _bucketed_update(self, tenant: _Tenant, args: Tuple[Any, ...]) -> int:
+        n, chunks = plan_bucketed_update(tenant.bucketer, args)
+        for chunk in chunks:
+            if chunk[0] == "scalar":
+                _, cargs, sig = chunk
+                new_sig = self._observe(tenant, sig)
+                self._apply_step(
+                    tenant, new_sig, lambda s, a=cargs: tenant.step.update(s, *a)
+                )
+                continue
+            _, padded, bucket, size, sig = chunk
+            new_sig = self._observe(tenant, sig)
+            n_valid = jnp.asarray(size, jnp.int32)
+            self._apply_step(
+                tenant,
+                new_sig,
+                lambda s, p=padded, b=bucket, nv=n_valid: tenant.step.masked_update(s, p, nv, b),
+            )
+        return n
+
+    def _observe(self, tenant: _Tenant, sig: Any) -> bool:
+        """One service-WIDE signature observation: namespaced by the shared
+        step's identity, so K tenants on one step count ONE compile."""
+        with self._lock:
+            return self._signatures.observe((tenant.step_token, sig))
+
+    def _apply_step(self, tenant: _Tenant, new_sig: bool, run: Callable[[Any], Any]) -> None:
+        """The evaluator's donation discipline, per tenant: a donating
+        dispatch deletes the input buffers, so it holds the lock (a
+        concurrent snapshot()/compute() must never see a state
+        mid-donation); cold signatures pre-compile OUTSIDE the lock on a
+        throwaway copy so ``latest_result``/``stats`` never block on XLA."""
+        if not tenant.step.donate:
+            new_state = run(tenant.state)
+            with self._lock:
+                tenant.state = new_state
+            return
+        if new_sig:
+            run(jax.tree_util.tree_map(lambda leaf: leaf.copy(), tenant.state))
+        with self._lock:
+            tenant.state = run(tenant.state)
+
+    # ---------------------------------------------------------- megabatch path
+
+    def _megabatch_dispatch(self, members: list) -> None:
+        """Drive K tenants' same-signature head batches through ONE vmapped
+        device program; unstacked states write back under the lock.  May
+        raise ONLY with no state written back (the caller then falls back
+        per member); after a successful return, every member's state is the
+        stepped one and only :meth:`_megabatch_finish` may run."""
+        tenant0 = members[0][0]
+        step = tenant0.step
+        bucket, _, sig = members[0][3]
+        k = len(members)
+        # pad the group to a power of two with fresh-state dummies so the
+        # K-specialization universe stays logarithmic in the tenant count
+        k_padded = 1
+        while k_padded < k:
+            k_padded *= 2
+        padded_list, n_list = [], []
+        for _tenant, args, n, _probe in members:
+            # pad to the GROUP's bucket (from the member's own signature
+            # probe — signature equality guarantees identical padded
+            # shapes), never through another tenant's bucket edges: two
+            # same-config tenants may bucket the same row count differently
+            padded_list.append(pad_args_to(args, n, bucket))
+            n_list.append(n)
+        for _ in range(k_padded - k):
+            padded_list.append(padded_list[0])  # args are not donated: alias ok
+            n_list.append(n_list[0])
+        mega_sig = (tenant0.step_token, ("mega", bucket, k_padded, sig))
+        with self._lock:
+            new_sig = self._signatures.observe(mega_sig)
+        if new_sig:
+            # cold compile outside the lock on throwaway copies (+ fresh
+            # dummies — a donating program consumes every state-list leaf,
+            # and even a non-donating one must not trace + XLA-compile
+            # inside the lock, where it would stall every tenant's submit)
+            states = [
+                jax.tree_util.tree_map(lambda leaf: leaf.copy(), m[0].state)
+                for m in members
+            ] + [step.init_state() for _ in range(k_padded - k)]
+            step.megabatch_update(states, padded_list, n_list, bucket)
+        dummies = [step.init_state() for _ in range(k_padded - k)]
+        with self._lock:
+            states = [m[0].state for m in members] + dummies
+            outs = step.megabatch_update(states, padded_list, n_list, bucket)
+            for i, (tenant, args, n, _probe) in enumerate(members):
+                tenant.state = outs[i]
+                tenant.megabatched += 1
+                if tenant.crash_policy == "restore":
+                    tenant.journal.append(args)
+            self._megabatch_steps += 1
+            self._megabatch_tenants += k
+            self._mega_group_meta = (k, k_padded, bucket)
+
+    def _megabatch_finish(self, members: list) -> None:
+        """Post-write-back tail: the event record and each member's counter
+        and cadence bookkeeping.  NOTHING here may escape to the caller — a
+        re-raise would trigger the individual fallback and double-apply the
+        already-written states."""
+        k, k_padded, bucket = self._mega_group_meta
+        try:
+            _telemetry.record_event(
+                self, "megabatch_step", tenants=k, padded_to=k_padded, bucket=bucket
+            )
+        except Exception:  # noqa: BLE001 — a raising user sink must not
+            pass  # cascade into re-applied batches; the step already ran
+        for tenant, args, n, _probe in members:
+            try:
+                with _telemetry.attribution(tenant.tid):
+                    self._count_applied(tenant, args, n)
+            except BaseException as err:  # noqa: BLE001 — cadence failure
+                # the batch IS applied and journaled; a failing cadence
+                # (snapshot guard, compute refresh) takes the tenant's own
+                # crash path like the single-tenant route would
+                self._handle_tenant_crash(tenant, err)
+            finally:
+                self._finish_one(tenant)
+
+    def _megabatch_fallback(self, members: list, err: BaseException) -> None:
+        """A failed group dispatch re-runs members individually — but a
+        raise DURING a donating execution may already have consumed some
+        members' state buffers.  A member whose state is intact re-runs in
+        place; one whose buffers were deleted cannot, and takes its crash
+        path instead (restore + journal replay rebuilds the state — the
+        crashed batch is journaled first, exactly as the single path would
+        have), so co-batched tenants are never quarantined for a neighbor's
+        poison when their own buffers survived."""
+        for tenant, args, _n, _probe in members:
+            if _state_alive(tenant.state):
+                self._run_single(tenant, args)
+                continue
+            try:
+                if tenant.crash_policy == "restore":
+                    tenant.journal.append(args)
+                with _telemetry.attribution(tenant.tid):
+                    self._handle_tenant_crash(tenant, err)
+            finally:
+                self._finish_one(tenant)
+
+    # ------------------------------------------------------------ self-healing
+
+    def _handle_tenant_crash(self, tenant: _Tenant, err: BaseException) -> None:
+        """Per-tenant crash fence (worker thread): restore + replay under a
+        consecutive-crash budget when the tenant opted into
+        ``crash_policy="restore"``, quarantine otherwise — the service
+        itself NEVER poisons on tenant work."""
+        if tenant.crash_policy != "restore":
+            self._quarantine(tenant, err)
+            return
+        pending = list(tenant.journal)
+        # the budget bounds CONSECUTIVE crashes at the SAME stream position
+        # within this incident (the evaluator's semantics); attempts stay
+        # local so a successful later incident starts its own budget
+        attempts = 0
+        last_pos = -1
+        while True:
+            with self._lock:
+                pos = tenant.batches
+                tenant.crashes += 1
+                crashes = tenant.crashes
+            attempts = attempts + 1 if pos <= last_pos else 1
+            last_pos = max(last_pos, pos)
+            with _telemetry.attribution(tenant.tid):
+                _telemetry.record_event(
+                    self, "runtime_crash", error=repr(err), crashes=crashes,
+                    attempt=attempts,
+                )
+            if attempts > tenant.max_restores:
+                self._quarantine(
+                    tenant,
+                    CrashLoopError(
+                        f"Tenant {tenant.tid!r} crashed {attempts} "
+                        f"consecutive time(s) without progress; crash-loop budget "
+                        f"(max_restores={tenant.max_restores}) is spent. Last crash: "
+                        f"{type(err).__name__}: {err}"
+                    ),
+                )
+                return
+            idx = -1
+            try:
+                self._restore_for_crash(tenant)
+                idx = 0
+                while idx < len(pending):
+                    self._apply_batch(tenant, pending[idx])
+                    idx += 1
+            except TPUMetricsUserError as user_err:
+                # config/snapshot-level problems are not crash-loopable
+                self._quarantine(tenant, user_err)
+                return
+            except BaseException as replay_err:  # noqa: BLE001 — bounded above
+                err = replay_err
+                if idx >= 0:
+                    pending = list(tenant.journal) + pending[idx + 1 :]
+                continue
+            with self._lock:
+                tenant.restores += 1
+                restores = tenant.restores
+            with _telemetry.attribution(tenant.tid):
+                _telemetry.record_event(
+                    self, "runtime_restore", restores=restores, replayed=len(pending)
+                )
+            return
+
+    def _restore_for_crash(self, tenant: _Tenant) -> None:
+        got = self._load_latest_snapshot(tenant)
+        with self._lock:
+            expected = tenant.journal_base
+            restored = self._adopt_snapshot_locked(tenant, got)
+            if restored != expected:
+                raise _snapshot.SnapshotError(
+                    f"Tenant {tenant.tid!r} crash restore landed on stream position "
+                    f"{restored} but the replay journal starts at {expected} (latest "
+                    "snapshot lost or corrupt?): the journal cannot bridge the gap."
+                )
+
+    def _quarantine(self, tenant: _Tenant, err: BaseException) -> None:
+        """Fence one tenant: record the cause, discard its queue, release
+        its producers and waiters.  Every other tenant is untouched — this
+        is the isolation contract the tests pin bit-identically."""
+        with self._lock:
+            tenant.error = err
+            discarded = len(tenant.queue)
+            tenant.queue.clear()
+            # discarded queued batches release their pending counts here; the
+            # in-flight batch that crashed is finished by its own _finish_one
+            tenant.pending -= discarded
+            self._unmark_ready(tenant)
+            self._quarantines += 1
+            self._space.notify_all()
+            self._done.notify_all()
+        with _telemetry.attribution(tenant.tid):
+            _telemetry.record_event(
+                self, "tenant_quarantined", error=repr(err), discarded=discarded
+            )
+
+    # ------------------------------------------------------------ cadences
+
+    def _refresh_latest(self, tenant: _Tenant) -> None:
+        with self._lock:
+            state = tenant.state
+            batches, items = tenant.batches, tenant.items
+        if tenant.bucketer is None:
+            value = tenant.metric.compute()
+            tenant.metric._computed = None  # the stream moves on
+            degraded = bool(getattr(tenant.metric, "degraded", False))
+        else:
+            value = tenant.step._metric.functional_compute(state)
+            with self._lock:
+                degraded = tenant.degraded
+        with self._lock:
+            if tenant.bucketer is None:
+                tenant.degraded = degraded
+            tenant.latest = {
+                "value": value, "batches": batches, "items": items, "degraded": degraded,
+            }
+            tenant.last_compute_at = batches

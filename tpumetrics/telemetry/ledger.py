@@ -187,6 +187,14 @@ class CollectiveLedger:
             # a quorum policy admitted an INCOMPLETE cut (missing ranks' data
             # is absent from the fold) — never silent
             self.elastic_degraded_cuts += 1
+        elif rec.kind == "megabatch_step":
+            # the service drove K tenants' same-signature updates through
+            # ONE vmapped device program (extra["tenants"] = K)
+            self.megabatch_steps += 1
+            self.megabatch_tenants += int(rec.extra.get("tenants", 0))
+        elif rec.kind == "tenant_quarantined":
+            # one tenant's crash was fenced off; the service kept serving
+            self.tenant_quarantines += 1
         self.counts_by_kind[rec.kind] = self.counts_by_kind.get(rec.kind, 0) + 1
         for sink in self._sinks:
             sink.emit(rec)
@@ -214,6 +222,9 @@ class CollectiveLedger:
         self.elastic_barriers = 0
         self.elastic_restores = 0
         self.elastic_degraded_cuts = 0
+        self.megabatch_steps = 0
+        self.megabatch_tenants = 0
+        self.tenant_quarantines = 0
         self.spmd_collectives = 0
         self.spmd_wire_bytes = 0.0
         self.bytes_by_op: Dict[str, float] = {}
@@ -255,6 +266,9 @@ class CollectiveLedger:
             "elastic_barriers": self.elastic_barriers,
             "elastic_restores": self.elastic_restores,
             "elastic_degraded_cuts": self.elastic_degraded_cuts,
+            "megabatch_steps": self.megabatch_steps,
+            "megabatch_tenants": self.megabatch_tenants,
+            "tenant_quarantines": self.tenant_quarantines,
             "spmd_collectives": self.spmd_collectives,
             "spmd_wire_bytes": self.spmd_wire_bytes,
             "records": len(self.records),
