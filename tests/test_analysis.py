@@ -90,6 +90,100 @@ def test_host_sync_near_miss_negative():
     assert _codes(found) == []
 
 
+# ------------------------------------------------------------------- TPL104
+HOST_TELEMETRY_TP = _src(
+    """
+    import jax.numpy as jnp
+    from tpumetrics.metric import Metric
+    from tpumetrics.telemetry import spans
+    from tpumetrics.telemetry.instruments import counter
+
+    class M(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, preds, target):
+            with spans.span("update"):                  # trace-time only under jit
+                self.total = self.total + jnp.sum(preds)
+            counter("updates_total").inc()              # drifts with the compile cache
+
+        def compute(self):
+            return self.total
+    """
+)
+
+HOST_TELEMETRY_NEAR_MISS = _src(
+    """
+    import jax.numpy as jnp
+    from tpumetrics.metric import Metric
+    from tpumetrics.telemetry import spans
+    from tpumetrics.telemetry.instruments import histogram
+
+    class M(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, preds, target):
+            self.total = self.total + jnp.sum(preds)
+
+        def compute(self):
+            # compute() is host-driven by contract: spans/instruments are fine
+            with spans.span("compute"):
+                histogram("compute_ms").observe(1.0)
+                return self.total
+
+    def runtime_helper(obj):
+        # a .span()/.counter() method on an unknown receiver is NOT telemetry
+        obj.span("not ours")
+        obj.counter("still not ours")
+    """
+)
+
+
+def test_host_telemetry_in_update_true_positive():
+    found = analyze_source(HOST_TELEMETRY_TP)
+    assert _codes(found).count("TPL104") == 2  # the span AND the counter
+
+
+def test_host_telemetry_near_miss_negative():
+    # compute()-only telemetry and same-named methods on foreign objects
+    # must not trigger — the boundary is update()-reachability plus the
+    # import-resolved tpumetrics.telemetry.{spans,instruments} modules
+    assert _codes(analyze_source(HOST_TELEMETRY_NEAR_MISS)) == []
+
+
+def test_host_telemetry_reachable_helper_is_flagged():
+    src = _src(
+        """
+        import jax.numpy as jnp
+        from tpumetrics.metric import Metric
+        from tpumetrics.telemetry import instruments
+
+        def _tally(rows):
+            instruments.counter("rows_total").inc(rows)   # three calls below update()
+
+        class M(Metric):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+            def update(self, preds, target):
+                self._accumulate(preds)
+
+            def _accumulate(self, preds):
+                _tally(preds.shape[0])
+                self.total = self.total + jnp.sum(preds)
+
+            def compute(self):
+                return self.total
+        """
+    )
+    found = analyze_source(src)
+    assert "TPL104" in _codes(found)
+
+
 def test_sticky_eager_guard_covers_function_remainder():
     src = _src(
         """

@@ -23,6 +23,7 @@ def test_floor_file_shape():
         "streaming_throughput",
         "multitenant_scaling",
         "resilience_overhead",
+        "observability_overhead",
         "elastic_restore",
     }
     # floors must sit below the recorded best (headroom for chip variance)
@@ -60,6 +61,10 @@ def test_floor_file_shape():
     # latency must stay enqueue-shaped
     assert data["floors"]["multitenant_scaling"] >= 2.0
     assert data["multitenant_ceilings"]["soak_p99_submit_ms"] > 0
+    # the observability gate pins the DISABLED span path to ~a flag test and
+    # the always-on instruments to submit-path-cheap
+    assert data["observability_overhead_ceilings"]["inert_span_ns_per_call"] > 0
+    assert data["observability_overhead_ceilings"]["counter_ns_per_call"] > 0
 
 
 def test_check_floors_flags_compile_regressions():
@@ -112,6 +117,30 @@ def test_check_floors_flags_resilience_overhead_regressions():
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and all("resilience_overhead" in v for v in violations)
     details["resilience_overhead"] = "error: RuntimeError: boom"
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and "scenario failed" in violations[0]
+
+
+def test_check_floors_flags_observability_regressions():
+    """A disabled span() that grew real per-call work (allocation, a lock)
+    or an instrument update too slow for the submit path must trip the
+    gate even at a healthy inert/armed ratio; an errored scenario (the
+    singleton/ring-bound asserts never ran) trips it too."""
+    details = {
+        "observability_overhead": {
+            "vs_baseline": 0.05,
+            "inert_span_ns_per_call": 10**6,
+            "counter_ns_per_call": 100.0,
+        }
+    }
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("inert_span_ns_per_call" in v for v in violations)
+    details["observability_overhead"]["inert_span_ns_per_call"] = 100.0
+    assert bench._check_floors(headline_vs=1000.0, details=details) == []
+    details["observability_overhead"]["counter_ns_per_call"] = 10**6
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("counter_ns_per_call" in v for v in violations)
+    details["observability_overhead"] = "error: AssertionError: ring grew"
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and "scenario failed" in violations[0]
 

@@ -1,0 +1,823 @@
+"""Full-pipeline observability (ISSUE 9): spans, instruments, export,
+compile attribution, and the flight recorder.
+
+The acceptance spine lives in ``TestServiceObservability``: a 2-tenant
+service run produces COMPLETE per-batch traces (queue-wait / schedule /
+dispatch / write-back children nested under one trace id), every XLA
+compile in the run is attributed to a (signature, tenant), and a forced
+quarantine dumps a flight-recorder JSONL file whose tail holds the poisoned
+batch's spans.  Around it: unit tests for the disabled path (no allocation,
+bounded rings), the Prometheus/JSONL round-trip validators that pin the
+export formats, and the backward-compat key pins for ``stats()``.
+"""
+
+from __future__ import annotations
+
+import collections
+import gc
+import json
+import os
+import re
+import threading
+import time
+import tracemalloc
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics.aggregation import MeanMetric
+from tpumetrics.classification import MulticlassAccuracy
+from tpumetrics.runtime import EvaluationService, StreamingEvaluator
+from tpumetrics.runtime.dispatch import AsyncDispatcher, DispatcherClosedError
+from tpumetrics.runtime.service import TenantQuarantinedError
+from tpumetrics.telemetry import export, instruments, ledger, spans, xla
+
+
+@pytest.fixture(autouse=True)
+def _observability_hygiene():
+    """Every test starts and ends with observability OFF and empty: spans
+    disabled + cleared, flight recorder uninstalled, attribution disabled.
+    Instruments stay registered (process-global families) but keep their
+    series — clearing them here would race the OTHER suites' evaluators."""
+    yield
+    spans.disable()
+    spans.reset()
+    export.disable_flight_recorder()
+    xla.disable_compile_attribution()
+    instruments.enable()
+
+
+def _acc(classes=4):
+    return MulticlassAccuracy(num_classes=classes, average="micro", validate_args=False)
+
+
+def _batch(classes=4, seed=0, rows=5):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.standard_normal((rows, classes)), jnp.float32),
+        jnp.asarray(rng.integers(0, classes, rows), jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------- spans
+
+
+class TestSpans:
+    def test_disabled_span_is_the_shared_singleton(self):
+        spans.disable()
+        a = spans.span("a", attr=1)
+        b = spans.span("b")
+        assert a is b
+        assert spans.start_span("c") is None
+        assert spans.start_trace("d") is None
+        assert spans.activate(None) is spans.span("e")
+        spans.end_span(None)  # None-safe
+        spans.record_span("f", 0, 1)
+        assert spans.spans() == []
+
+    def test_disabled_span_retains_no_memory_per_call(self):
+        spans.disable()
+        tracemalloc.start()
+        try:
+            for _ in range(50):
+                spans.span("warmup")
+            gc.collect()
+            base = tracemalloc.get_traced_memory()[0]
+            for _ in range(5000):
+                spans.span("noop", k=1)
+            gc.collect()
+            grown = tracemalloc.get_traced_memory()[0] - base
+        finally:
+            tracemalloc.stop()
+        assert grown < 1024, f"disabled span() retained {grown} bytes over 5000 calls"
+
+    def test_nesting_shares_trace_and_parents_correctly(self):
+        spans.enable()
+        with spans.span("root") as r:
+            with spans.span("child"):
+                with spans.span("grandchild"):
+                    pass
+        got = {s.name: s for s in spans.spans()}
+        assert set(got) == {"root", "child", "grandchild"}
+        assert got["child"].trace_id == got["root"].trace_id == got["grandchild"].trace_id
+        assert got["child"].parent_id == got["root"].span_id
+        assert got["grandchild"].parent_id == got["child"].span_id
+        assert got["root"].parent_id is None
+        for s in got.values():
+            assert s.end_ns >= s.start_ns
+
+    def test_exception_marks_error_and_still_records(self):
+        spans.enable()
+        with pytest.raises(ValueError):
+            with spans.span("boom"):
+                raise ValueError("nope")
+        (s,) = spans.spans()
+        assert s.attrs["error"].startswith("ValueError")
+
+    def test_cross_thread_explicit_span_and_activation(self):
+        spans.enable()
+        root = spans.start_trace("batch", stream="t")
+        qspan = spans.start_span("queue_wait", parent=root)
+
+        def worker():
+            spans.end_span(qspan, depth_after=0)
+            with spans.activate(root):
+                with spans.span("dispatch"):
+                    pass
+            spans.end_span(root)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        got = {s.name: s for s in spans.spans()}
+        assert set(got) == {"batch", "queue_wait", "dispatch"}
+        assert got["queue_wait"].parent_id == got["batch"].span_id
+        assert got["dispatch"].parent_id == got["batch"].span_id
+        assert len({s.trace_id for s in got.values()}) == 1
+
+    def test_retroactive_record_span(self):
+        spans.enable()
+        root = spans.start_trace("batch")
+        t0 = time.monotonic_ns()
+        spans.record_span("schedule", t0, t0 + 1000, parent=root, k=2)
+        spans.end_span(root)
+        sched = [s for s in spans.spans() if s.name == "schedule"][0]
+        assert sched.end_ns - sched.start_ns == 1000
+        assert sched.parent_id == root.span_id
+
+    def test_ring_is_bounded_and_counts_evictions(self):
+        spans.enable(capacity=32)
+        for i in range(100):
+            with spans.span(f"s{i}"):
+                pass
+        tracer = spans.get_tracer()
+        assert len(tracer.spans()) == 32
+        assert tracer.evicted == 68
+        assert tracer.finished == 100
+        assert spans.drain() and spans.spans() == []
+
+
+# ----------------------------------------------------------------- instruments
+
+
+class TestInstruments:
+    def test_counter_gauge_histogram_basics(self):
+        c = instruments.counter("obs_test_total", labels=("who",))
+        c.clear()
+        c.inc(1, "a")
+        c.inc(2, "a")
+        c.inc(5, "b")
+        assert c.value("a") == 3 and c.value("b") == 5
+        assert c.value() == 8  # cross-label aggregate
+
+        g = instruments.gauge("obs_test_gauge", labels=("who",))
+        g.clear()
+        g.set(7, "a")
+        g.inc(3, "a")
+        g.dec(1, "a")
+        assert g.value("a") == 9
+
+        h = instruments.histogram("obs_test_ms", labels=("who",))
+        h.clear()
+        for v in (0.3, 0.4, 0.6, 200.0):
+            h.observe(v, "a")
+        s = h.summary("a")
+        assert s["count"] == 4 and s["max"] == 200.0
+        assert 0.25 <= s["p50"] <= 0.6
+        assert s["p99"] <= 200.0
+        # overflow bucket reports the exact tracked max
+        h.observe(99999.0, "a")
+        assert h.quantile(1.0, "a") == 99999.0
+
+    def test_empty_summary_is_none_shaped(self):
+        h = instruments.histogram("obs_empty_ms", labels=("who",))
+        h.clear()
+        assert h.summary("nobody") == {
+            "count": 0, "p50": None, "p90": None, "p99": None, "max": None,
+        }
+
+    def test_registration_is_a_contract(self):
+        instruments.counter("obs_contract_total", labels=("x",))
+        with pytest.raises(ValueError):
+            instruments.gauge("obs_contract_total", labels=("x",))
+        with pytest.raises(ValueError):
+            instruments.counter("obs_contract_total", labels=("x", "y"))
+
+    def test_label_arity_checked(self):
+        c = instruments.counter("obs_arity_total", labels=("a", "b"))
+        with pytest.raises(ValueError):
+            c.inc(1, "only-one")
+
+    def test_disable_makes_updates_free_noops(self):
+        c = instruments.counter("obs_off_total", labels=("who",))
+        c.clear()
+        instruments.disable()
+        try:
+            c.inc(5, "a")
+            assert c.value("a") == 0
+        finally:
+            instruments.enable()
+        c.inc(5, "a")
+        assert c.value("a") == 5
+
+
+# ----------------------------------------------------- export: prometheus text
+
+
+def _parse_prometheus(text):
+    """Minimal exposition-format parser: the round-trip validator the
+    exporter is pinned by (satellite: exporters can't silently drift)."""
+    types = {}
+    samples = []
+    line_re = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+    label_re = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, typ = line.split(" ", 3)
+            assert typ in ("counter", "gauge", "histogram", "untyped"), line
+            types[name] = typ
+        elif line.startswith("#"):
+            continue
+        else:
+            m = line_re.match(line)
+            assert m, f"unparseable exposition line: {line!r}"
+            name, labels_raw, value = m.groups()
+            labels = dict(label_re.findall(labels_raw)) if labels_raw else {}
+            v = float("inf") if value == "+Inf" else float(value)
+            samples.append((name, labels, v))
+    return types, samples
+
+
+class TestPrometheusExport:
+    def test_round_trip_families_labels_and_histogram_shape(self):
+        c = instruments.counter("obs_prom_total", help="a counter", labels=("who",))
+        c.clear()
+        c.inc(3, "a")
+        g = instruments.gauge("obs_prom_gauge")
+        g.clear()
+        g.set(2.5)
+        h = instruments.histogram("obs_prom_ms", labels=("who",), buckets=(1.0, 10.0))
+        h.clear()
+        for v in (0.5, 5.0, 50.0):
+            h.observe(v, 'we"ird\nlabel')
+
+        types, samples = _parse_prometheus(export.prometheus_text())
+        by_name = collections.defaultdict(list)
+        for name, labels, v in samples:
+            by_name[name].append((labels, v))
+
+        assert types["obs_prom_total"] == "counter"
+        assert ({"who": "a"}, 3.0) in by_name["obs_prom_total"]
+        assert types["obs_prom_gauge"] == "gauge"
+        assert ({}, 2.5) in by_name["obs_prom_gauge"]
+
+        # every sample belongs to a declared family (histograms via suffixes)
+        for name in by_name:
+            base = re.sub(r"_(bucket|sum|count)$", "", name)
+            assert name in types or base in types, f"undeclared family for {name}"
+
+        assert types["obs_prom_ms"] == "histogram"
+        buckets = [
+            (labels, v) for labels, v in by_name["obs_prom_ms_bucket"]
+        ]
+        # cumulative and capped by the +Inf bucket == count
+        les = sorted(
+            (float("inf") if l["le"] == "+Inf" else float(l["le"]), v) for l, v in buckets
+        )
+        counts = [v for _, v in les]
+        assert counts == sorted(counts), "bucket counts must be cumulative"
+        assert counts[-1] == 3.0
+        (_, count_v), = by_name["obs_prom_ms_count"]
+        assert count_v == 3.0
+        (_, sum_v), = by_name["obs_prom_ms_sum"]
+        assert sum_v == pytest.approx(55.5)
+
+    def test_ledger_aggregates_exported_as_views(self):
+        ledger.enable()
+        try:
+            ledger.reset()
+            ledger.record_event(None, "runtime_drain", items=3, depth=0)
+        finally:
+            ledger.disable()
+        types, samples = _parse_prometheus(export.prometheus_text())
+        assert types["tpumetrics_ledger_events_total"] == "counter"
+        assert any(
+            name == "tpumetrics_ledger_events_total" and labels.get("kind") == "runtime_drain"
+            for name, labels, _ in samples
+        )
+        ledger.reset()
+
+
+class TestJsonlExport:
+    def test_spans_jsonl_round_trip(self, tmp_path):
+        spans.enable()
+        with spans.span("a", k=1):
+            pass
+        path = str(tmp_path / "spans.jsonl")
+        n = export.spans_jsonl(path)
+        lines = [json.loads(l) for l in open(path)]
+        assert n == len(lines) == 1
+        assert lines[0]["type"] == "span" and lines[0]["name"] == "a"
+        assert lines[0]["attrs"] == {"k": 1}
+
+    def test_instruments_jsonl_decodes(self, tmp_path):
+        c = instruments.counter("obs_jsonl_total", labels=("who",))
+        c.clear()
+        c.inc(1, "a")
+        path = str(tmp_path / "instruments.jsonl")
+        export.instruments_jsonl(path)
+        lines = [json.loads(l) for l in open(path)]
+        mine = [l for l in lines if l["name"] == "obs_jsonl_total"]
+        assert mine and mine[0]["type"] == "counter"
+        assert mine[0]["series"] == [{"label_values": ["a"], "value": 1.0}]
+
+
+# ------------------------------------------------------------- flight recorder
+
+
+class TestFlightRecorder:
+    def test_ring_never_grows_past_capacity(self, tmp_path):
+        rec = export.FlightRecorder(str(tmp_path), capacity=16)
+        for i in range(200):
+            rec.note("tick", i=i)
+        assert len(rec) == 16
+        # oldest evicted, newest kept
+        assert [e["i"] for e in rec.entries()] == list(range(184, 200))
+
+    def test_hooks_capture_spans_and_ledger_even_when_nobody_records(self, tmp_path):
+        rec = export.enable_flight_recorder(str(tmp_path), capacity=64)
+        assert not ledger.enabled() and not spans.enabled()
+        # ledger globally disabled: the flight hook still sees events
+        ledger.record_event(None, "runtime_drop", dropped_total=1)
+        spans.enable()
+        with spans.span("observed"):
+            pass
+        kinds = [(e.get("type"), e.get("kind"), e.get("name")) for e in rec.entries()]
+        assert ("ledger", "runtime_drop", None) in kinds
+        assert ("span", None, "observed") in kinds
+        # and the global ledger itself stayed empty (it was disabled)
+        assert ledger.summary()["counts_by_kind"].get("runtime_drop") is None
+
+    def test_dump_schema_validates_line_by_line(self, tmp_path):
+        rec = export.enable_flight_recorder(str(tmp_path), capacity=64)
+        spans.enable()
+        with spans.span("work"):
+            pass
+        ledger.record_event(None, "runtime_drain", items=1, depth=0)
+        export.note_incident("sync_timeout", op="all_reduce")
+        path = export.flight_dump("unit_test", RuntimeError("boom"), extra="x")
+        lines = [json.loads(l) for l in open(path)]
+        # every line decodes to a known record schema (satellite: validator)
+        for line in lines:
+            assert line["type"] in export.FLIGHT_RECORD_TYPES, line
+            if line["type"] == "span":
+                assert {"name", "trace", "span", "start_ns"} <= set(line)
+            elif line["type"] == "ledger":
+                assert "kind" in line
+            elif line["type"] == "incident":
+                assert "kind" in line
+        header = lines[0]
+        assert header["type"] == "flight_header"
+        assert header["reason"] == "unit_test"
+        assert "boom" in header["error"]
+        assert header["entries"] == len(lines) - 1
+        # body entries carry a monotonically increasing seq (ring order)
+        seqs = [l["seq"] for l in lines[1:]]
+        assert seqs == sorted(seqs)
+
+    def test_flight_dump_without_recorder_is_none(self):
+        export.disable_flight_recorder()
+        assert export.flight_dump("whatever", RuntimeError("x")) is None
+        export.note_incident("noop")  # must not raise either
+
+    def test_reenabled_recorder_never_reuses_dump_names(self, tmp_path):
+        """Dump numbering is process-wide: re-enabling a recorder over a
+        fixed directory must not overwrite an earlier incident's file
+        (review catch)."""
+        rec1 = export.enable_flight_recorder(str(tmp_path))
+        p1 = rec1.dump("incident")
+        rec2 = export.enable_flight_recorder(str(tmp_path))  # reconfiguration
+        p2 = rec2.dump("incident")
+        assert p1 != p2
+        assert os.path.isfile(p1) and os.path.isfile(p2)
+
+
+# --------------------------------------------------------- compile attribution
+
+
+class TestCompileAttribution:
+    def test_attribution_and_retrace_detection(self):
+        import jax
+
+        xla.enable_compile_attribution()
+        xla.reset_compile_attribution()
+        before = len(xla.compile_records())
+        with xla.attribute_compiles("tenant-a", ("sig", 7), token="tok"):
+            jax.jit(lambda x: x + 1)(jnp.ones(3))
+            # a second, DIFFERENT compile in the SAME activation: the small
+            # eager helpers around a cold dispatch — not a retrace
+            jax.jit(lambda x: x - 1)(jnp.ones(3))
+        recs = xla.compile_records()[before:]
+        assert recs and all(r["tenant"] == "tenant-a" for r in recs)
+        assert not any(r["retrace"] for r in recs)
+
+        # the SAME (token, signature) compiling in a LATER activation IS
+        retrace_before = xla.recompile_count("tenant-a")
+        with pytest.warns(UserWarning, match="recompiled a previously-seen"):
+            with xla.attribute_compiles("tenant-a", ("sig", 7), token="tok"):
+                jax.jit(lambda x: x * 3)(jnp.ones(3))
+        assert xla.recompile_count("tenant-a") == retrace_before + 1
+        assert any(r["retrace"] for r in xla.compile_records())
+
+    def test_unattributed_compiles_are_visible_not_dropped(self):
+        import jax
+
+        xla.enable_compile_attribution()
+        before = len(xla.compile_records())
+        jax.jit(lambda x: x * 5 + 2)(jnp.ones(4))
+        recs = xla.compile_records()[before:]
+        assert recs and all(r["tenant"] == "<unattributed>" for r in recs)
+
+
+# --------------------------------------------- runtime integration: evaluator
+
+
+class TestEvaluatorObservability:
+    def test_batch_trace_complete_and_stats_sections(self):
+        spans.enable()
+        ev = StreamingEvaluator(_acc(), buckets=[8])
+        with ev:
+            for seed in range(3):
+                ev.submit(*_batch(seed=seed))
+            ev.flush()
+            st = ev.stats()
+        traces = collections.defaultdict(list)
+        for s in spans.spans():
+            traces[s.trace_id].append(s)
+        batch_traces = [t for t in traces.values() if any(x.name == "batch" for x in t)]
+        assert len(batch_traces) == 3
+        for t in batch_traces:
+            names = {x.name for x in t}
+            assert {"batch", "queue_wait", "plan", "dispatch", "write_back"} <= names
+            root = [x for x in t if x.name == "batch"][0]
+            for x in t:
+                if x.name in ("queue_wait", "plan", "dispatch", "write_back"):
+                    assert x.parent_id == root.span_id
+        # the latency section reads the shared histograms for THIS stream
+        assert st["latency"]["submit_ms"]["count"] == 3
+        assert st["latency"]["submit_ms"]["p99"] is not None
+        assert st["latency"]["dispatch_ms"]["count"] >= 1
+        assert st["recompiles"] == 0
+
+    def test_stats_keys_backward_compatible(self):
+        ev = StreamingEvaluator(_acc(), buckets=[8])
+        with ev:
+            ev.submit(*_batch())
+            ev.flush()
+            st = ev.stats()
+        # the PR-2..PR-8 contract: no key renamed or removed
+        assert {
+            "depth", "max_depth", "enqueued", "drained_items", "drain_cycles",
+            "dropped", "restarts", "by_tag", "batches", "items", "xla_compiles",
+            "signature_evictions", "buckets", "mesh", "degraded", "crashes",
+            "restores",
+        } <= set(st)
+        # the new sections only ADD keys
+        assert set(st["latency"]) == {"submit_ms", "dispatch_ms"}
+        assert isinstance(st["recompiles"], int)
+
+    def test_disabled_tracing_records_nothing_during_streaming(self):
+        spans.disable()
+        spans.reset()
+        ev = StreamingEvaluator(_acc(), buckets=[8])
+        with ev:
+            ev.submit(*_batch())
+            ev.flush()
+        assert spans.spans() == []
+        assert spans.get_tracer().finished == 0
+
+    def test_crash_loop_error_names_flight_dump(self, tmp_path):
+        export.enable_flight_recorder(str(tmp_path / "flight"))
+
+        class _Poison(RuntimeError):
+            pass
+
+        class _Crashy(MeanMetric):
+            def update(self, value):  # noqa: D102
+                if float(jnp.max(jnp.asarray(value))) > 1e9:
+                    raise _Poison("poisoned batch")
+                super().update(value)
+
+        ev = StreamingEvaluator(
+            _Crashy(), snapshot_dir=str(tmp_path / "snaps"),
+            crash_policy="restore", max_restores=1,
+        )
+        ev.submit(jnp.asarray([1.0]))
+        ev.submit(jnp.asarray([2e9]))  # deterministic poison: budget spends
+        with pytest.raises(DispatcherClosedError) as exc:
+            ev.flush()
+            ev.compute()
+        msg = str(exc.value)
+        assert "Flight record: " in msg
+        path = msg.split("Flight record: ")[-1].rstrip(".")
+        lines = [json.loads(l) for l in open(path)]
+        assert lines[0]["reason"] == "crash_loop"
+
+    def test_dropped_batch_trace_is_completed_not_orphaned(self):
+        """drop_oldest eviction must END the evicted batch's ROOT span too —
+        an open root would leave its recorded queue_wait child parentless
+        (review catch)."""
+        spans.enable()
+        release = threading.Event()
+
+        def slow_drain(items):
+            release.wait(5.0)
+            for _i, root in items:  # the consumer owns drained items' roots
+                spans.end_span(root)
+
+        d = AsyncDispatcher(slow_drain, max_queue=1, policy="drop_oldest")
+        roots = []
+        for i in range(4):
+            root = spans.start_trace("batch", i=i)
+            roots.append(root)
+            d.submit((i, root), trace_ctx=root)
+        release.set()
+        d.flush()
+        d.close()
+        recorded = {s.span_id for s in spans.spans()}
+        dropped_roots = [
+            s for s in spans.spans()
+            if s.name == "batch" and "dropped" in str(s.attrs.get("error", ""))
+        ]
+        assert dropped_roots, "evicted batches' roots never completed"
+        # every recorded queue_wait's parent exists in the ring
+        for s in spans.spans():
+            if s.name == "queue_wait":
+                assert s.parent_id in recorded, "orphaned queue_wait child"
+
+    def test_crash_completes_undrained_tail_roots(self):
+        """A crash mid-drain must complete the popped-but-undrained tail
+        batches' root spans too — their queue_wait children are already in
+        the ring (review catch)."""
+        spans.enable(capacity=1024)
+        gate = threading.Event()
+
+        class _Gated(MeanMetric):
+            def update(self, value):  # noqa: D102
+                v = float(jnp.max(jnp.asarray(value)))
+                if v == 0.5:
+                    gate.wait(5.0)  # park the worker so the queue fills
+                if v > 1e9:
+                    raise RuntimeError("poison")
+                super().update(value)
+
+        ev = StreamingEvaluator(_Gated())
+        ev.submit(jnp.asarray([0.5]))    # drains alone, parks the worker
+        time.sleep(0.2)
+        ev.submit(jnp.asarray([2e9]))    # poison
+        ev.submit(jnp.asarray([1.0]))    # tail batches popped in the same
+        ev.submit(jnp.asarray([2.0]))    # micro-batch as the poison
+        gate.set()
+        with pytest.raises(DispatcherClosedError):
+            ev.flush()
+        # every recorded queue_wait has its root in the ring
+        recorded = {s.span_id for s in spans.spans()}
+        for s in spans.spans():
+            if s.name == "queue_wait":
+                assert s.parent_id in recorded, "orphaned queue_wait child"
+        interrupted = [
+            s for s in spans.spans()
+            if s.name == "batch" and "drain interrupted" in str(s.attrs.get("error", ""))
+        ]
+        assert interrupted, "tail roots never completed"
+
+    def test_crash_replay_emits_no_fragment_traces(self, tmp_path):
+        """Replayed batches run span-less: their traces ended at the crash,
+        so replay child spans must not root fresh fragment traces (review
+        catch)."""
+        spans.enable(capacity=1024)
+
+        class _Once(MeanMetric):
+            crashed = False
+
+            def update(self, value):  # noqa: D102
+                if float(jnp.max(jnp.asarray(value))) > 1e9 and not _Once.crashed:
+                    _Once.crashed = True
+                    raise RuntimeError("transient")
+                super().update(value)
+
+        # eager path: a host-float check in update() is only legal there
+        ev = StreamingEvaluator(
+            _Once(), snapshot_dir=str(tmp_path),
+            crash_policy="restore", max_restores=2,
+        )
+        with ev:
+            ev.submit(jnp.asarray([1.0, 2.0]))
+            ev.submit(jnp.asarray([3e9, 1.0]))  # crashes once, replays fine
+            ev.flush()
+            assert ev.stats()["restores"] == 1
+        # no span without a parent except batch roots: a fragment trace
+        # would surface as a parentless plan/dispatch/write_back span
+        for s in spans.spans():
+            if s.name in ("plan", "compile", "dispatch", "write_back", "schedule"):
+                assert s.parent_id is not None, f"fragment trace: {s.name}"
+
+    def test_service_close_releases_tenant_series(self):
+        svc = EvaluationService()
+        h = svc.register("close-release-tenant", _acc(), buckets=[8])
+        h.submit(*_batch())
+        h.flush()
+        assert h.stats()["latency"]["submit_ms"]["count"] == 1
+        svc.close()
+        hist = instruments.histogram(instruments.SUBMIT_LATENCY_MS, labels=("stream",))
+        assert hist.summary("close-release-tenant")["count"] == 0
+
+    def test_close_releases_auto_minted_instrument_series(self):
+        xla.enable_compile_attribution()
+        ev = StreamingEvaluator(_acc(), buckets=[8])
+        stream = ev._stream
+        with ev:
+            ev.submit(*_batch())
+            ev.flush()
+            assert ev.stats()["latency"]["submit_ms"]["count"] == 1
+        # close() dropped the per-construction label from the global registry
+        hist = instruments.histogram(instruments.SUBMIT_LATENCY_MS, labels=("stream",))
+        assert hist.summary(stream)["count"] == 0
+        assert ev.stats()["latency"]["submit_ms"]["count"] == 0
+        # ...including the XLA attribution side (compile-seconds series and
+        # the retrace keys under this stream's token — review catch)
+        compile_hist = instruments.histogram(
+            instruments.XLA_COMPILE_SECONDS, labels=("tenant",),
+            buckets=instruments.DEFAULT_S_BUCKETS,
+        )
+        assert compile_hist.summary(stream)["count"] == 0
+        assert not any(k[0] == stream for k in xla._seen_keys)
+        # a racing submit AFTER close must not re-mint the released series
+        with pytest.raises(DispatcherClosedError):
+            ev.submit(*_batch())
+        assert hist.summary(stream)["count"] == 0
+
+    def test_dispatcher_poison_dumps_flight(self, tmp_path):
+        export.enable_flight_recorder(str(tmp_path))
+
+        def bad_drain(items):
+            raise RuntimeError("worker died")
+
+        d = AsyncDispatcher(bad_drain, max_queue=4)
+        d.submit("x")
+        with pytest.raises(DispatcherClosedError) as exc:
+            d.flush()
+        msg = str(exc.value)
+        assert "Flight record: " in msg
+        path = msg.split("Flight record: ")[-1].rstrip(".")
+        header = json.loads(open(path).readline())
+        assert header["reason"] == "dispatcher_poisoned"
+        with pytest.raises(DispatcherClosedError):  # close re-raises the poison
+            d.close(drain=False)
+
+
+# ----------------------------------------------- runtime integration: service
+
+
+class _Poison(RuntimeError):
+    pass
+
+
+class _CrashyMean(MeanMetric):
+    """Raises on values above the poison threshold (deterministic crash)."""
+
+    def update(self, value):  # noqa: D102
+        if float(jnp.max(jnp.asarray(value))) > 1e9:
+            raise _Poison("poisoned batch")
+        super().update(value)
+
+
+class TestServiceObservability:
+    def test_acceptance_traces_attribution_and_quarantine_dump(self, tmp_path):
+        """The ISSUE 9 acceptance scenario, end to end."""
+        spans.enable(capacity=8192)
+        xla.enable_compile_attribution()
+        xla.reset_compile_attribution()
+        export.enable_flight_recorder(str(tmp_path))
+
+        svc = EvaluationService()
+        handles = [svc.register(f"t{i}", _acc(), buckets=[8]) for i in range(2)]
+        batches = [_batch(seed=s) for s in range(3)]
+        records_before = len(xla.compile_records())
+        for p, t in batches:
+            for h in handles:
+                h.submit(p, t)
+        svc.flush()
+
+        # --- every XLA compile in the run is attributed (tenant + signature
+        # for the program dispatches; helper ops carry the tenant)
+        recs = xla.compile_records()[records_before:]
+        assert recs, "the cold run must have compiled something"
+        assert all(r["tenant"] in ("t0", "t1") for r in recs), recs
+        assert any(r["signature"] is not None for r in recs)
+        assert not any(r["retrace"] for r in recs)
+
+        # --- complete per-batch traces: one trace per submitted batch with
+        # queue-wait/schedule/dispatch/write-back children under ONE root
+        traces = collections.defaultdict(list)
+        for s in spans.spans():
+            traces[s.trace_id].append(s)
+        batch_traces = [t for t in traces.values() if any(x.name == "batch" for x in t)]
+        assert len(batch_traces) == 6  # 3 batches x 2 tenants
+        need = {"queue_wait", "schedule", "dispatch", "write_back"}
+        for t in batch_traces:
+            assert need <= {x.name for x in t}, sorted(x.name for x in t)
+            root = [x for x in t if x.name == "batch"][0]
+            for x in t:
+                if x.name in need:
+                    assert x.parent_id == root.span_id
+        # both tenants produced traces
+        streams = {
+            [x for x in t if x.name == "batch"][0].attrs["stream"] for t in batch_traces
+        }
+        assert streams == {"t0", "t1"}
+
+        # --- forced quarantine: flight dump whose tail has the poisoned
+        # batch's spans, path named in the raised error, neighbor untouched
+        bad = svc.register("bad", _CrashyMean())
+        bad.submit(jnp.asarray([1.0]))
+        bad.submit(jnp.asarray([2e9]))  # poison
+        deadline = time.time() + 20
+        while not bad.quarantined and time.time() < deadline:
+            time.sleep(0.02)
+        assert bad.quarantined
+        with pytest.raises(TenantQuarantinedError) as exc:
+            bad.compute()
+        msg = str(exc.value)
+        assert "Flight record: " in msg
+        path = msg.split("Flight record: ")[-1].rstrip(".")
+        lines = [json.loads(l) for l in open(path)]
+        assert lines[0]["type"] == "flight_header"
+        assert lines[0]["reason"] == "tenant_quarantined"
+        for line in lines:
+            assert line["type"] in export.FLIGHT_RECORD_TYPES
+        # the poisoned batch's root span (error attr) sits in the dump tail
+        tail = lines[-20:]
+        assert any(
+            l.get("type") == "span"
+            and l.get("name") == "batch"
+            and "Poison" in str((l.get("attrs") or {}).get("error", ""))
+            for l in tail
+        ), [l.get("name") for l in tail]
+        # the quarantine event itself is in the ring too
+        assert any(
+            l.get("type") == "ledger" and l.get("kind") == "tenant_quarantined"
+            for l in lines
+        )
+
+        # neighbors: bit-identical to an unobserved functional run
+        m = _acc()
+        s = m.init_state()
+        for p, t in batches:
+            s = m.functional_update(s, p, t)
+        assert float(handles[1].compute()) == float(m.functional_compute(s))
+        svc.close()
+
+    def test_tenant_stats_keys_backward_compatible(self):
+        with EvaluationService() as svc:
+            # unique tenant id: instrument labels are process-global, so a
+            # reused id would aggregate with other tests' streams
+            h = svc.register("bc-stats-tenant", _acc(), buckets=[8])
+            h.submit(*_batch())
+            h.flush()
+            st = h.stats()
+        assert {
+            "batches", "items", "enqueued", "depth", "pending", "dropped",
+            "megabatched", "quarantined", "degraded", "crashes", "restores",
+            "buckets",
+        } <= set(st)
+        assert set(st["latency"]) == {"submit_ms", "dispatch_ms"}
+        assert st["latency"]["submit_ms"]["count"] == 1
+        assert isinstance(st["recompiles"], int)
+
+    def test_megabatched_batches_still_trace_completely(self):
+        """Co-served (vmapped group) batches get the same four children —
+        dispatch/write_back recorded retroactively under each member."""
+        spans.enable(capacity=8192)
+        with EvaluationService() as svc:
+            handles = [svc.register(f"m{i}", _acc(), buckets=[8]) for i in range(4)]
+            p, t = _batch(seed=3)
+            for _ in range(2):
+                for h in handles:
+                    svc.submit(h.tenant_id, p, t)
+            svc.flush()
+            assert svc.stats()["megabatch_steps"] > 0, "group path never engaged"
+        traces = collections.defaultdict(list)
+        for s in spans.spans():
+            traces[s.trace_id].append(s)
+        batch_traces = [t for t in traces.values() if any(x.name == "batch" for x in t)]
+        assert len(batch_traces) == 8
+        need = {"queue_wait", "schedule", "dispatch", "write_back"}
+        for t in batch_traces:
+            assert need <= {x.name for x in t}, sorted(x.name for x in t)
+        # at least one trace rode the megabatch (dispatch marked megabatch)
+        assert any(
+            any(x.name == "dispatch" and x.attrs.get("megabatch") for x in t)
+            for t in batch_traces
+        )

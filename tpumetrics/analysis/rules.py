@@ -11,6 +11,11 @@ TPL101 host-transfer           ``.item()``/``.tolist()``/``float()``/``int()``/`
                                traced value in ``update()``-reachable code
 TPL102 traced-branch           ``if``/``while``/``assert``/ternary/bool-op/``range`` on a
                                traced value in ``update()``-reachable code
+TPL104 host-telemetry          a ``telemetry.spans``/``telemetry.instruments`` call (span
+                               opened, counter bumped) in ``update()``-reachable code —
+                               host-side effects that run at trace time only under jit
+                               (and re-run on every retrace); instrument the runtime
+                               seams instead
 TPL201 divergent-collective    a collective (``sync``/``all_reduce``/``all_gather``/
                                ``flush``/…) reachable on only one branch of a rank- or
                                data-dependent conditional — the static complement of the
@@ -62,6 +67,7 @@ from tpumetrics.analysis.core import ClassInfo, Finding, FuncInfo, ModuleInfo, P
 CATALOG: Dict[str, Tuple[str, str]] = {
     "TPL101": ("host-transfer", "host transfer of a traced value reachable from update()"),
     "TPL102": ("traced-branch", "Python control flow on a traced value reachable from update()"),
+    "TPL104": ("host-telemetry", "span/instrument call in update()-reachable metric code"),
     "TPL201": (
         "divergent-collective",
         "collective reachable on only one branch of a rank- or data-dependent conditional",
@@ -1057,6 +1063,89 @@ class ShadowStateRule:
         return False
 
 
+#: the two host-telemetry modules whose calls TPL104 rejects in update paths
+_TPL104_MODULES = (
+    "tpumetrics.telemetry.spans",
+    "tpumetrics.telemetry.instruments",
+)
+#: package-level re-exports of the same entry points (``telemetry.span(...)``)
+_TPL104_NAMES = {
+    "span", "start_span", "start_trace", "end_span", "record_span", "activate",
+    "counter", "gauge", "histogram",
+}
+
+
+def _import_resolved_dotted(expr: ast.expr, mod: ModuleInfo) -> Optional[str]:
+    """Like :func:`_dotted_name`, but ALSO resolves attribute-chain heads
+    through ``from``-imports (``from tpumetrics.telemetry import spans;
+    spans.span(...)`` → ``tpumetrics.telemetry.spans.span``), which
+    _dotted_name leaves unresolved for module objects."""
+    parts: List[str] = []
+    cur = expr
+    while isinstance(cur, ast.Attribute):
+        parts.insert(0, cur.attr)
+        cur = cur.value
+    if not isinstance(cur, ast.Name):
+        return None
+    head = cur.id
+    if head in mod.imports_from:
+        tmod, orig = mod.imports_from[head]
+        head = f"{tmod}.{orig}" if tmod else orig
+    else:
+        head = mod.imports_mod.get(head, head)
+    return ".".join([head] + parts)
+
+
+class HostTelemetryRule:
+    """TPL104: spans opened / instruments bumped in ``update()``-reachable
+    metric code.
+
+    Spans and instruments are **host-side effects by design** (monotonic
+    clocks, thread-locals, locked rings) — the exact things a jitted
+    ``update()`` must not touch.  Under jit they would not even measure the
+    step: trace-time code runs ONCE per compile (and again on every
+    retrace), so a span there times tracing, not execution, and a counter
+    there drifts with the compile cache.  The runtime instruments the host
+    seams (submit, schedule, dispatch, write-back) instead — metric code
+    never needs its own telemetry.  Eager-guard idioms are deliberately NOT
+    honored here (unlike TPL101): even eagerly, per-update spans belong to
+    the runtime layer, not inside metric math."""
+
+    codes = ("TPL104",)
+
+    def check(self, mod: ModuleInfo, index: PackageIndex) -> Iterator[Finding]:
+        funcs: List[FuncInfo] = list(mod.functions.values())
+        for ci in mod.classes.values():
+            funcs.extend(ci.methods.values())
+        for fi in funcs:
+            if not index.is_update_reachable(fi.node):
+                continue
+            for n in ast.walk(fi.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                dotted = _import_resolved_dotted(n.func, mod)
+                if dotted is None or not self._is_host_telemetry(dotted):
+                    continue
+                yield Finding(
+                    "TPL104",
+                    f"telemetry call `{_truncate(n)}` in update()-reachable code: "
+                    "spans and instruments are host-side effects — under jit they "
+                    "run at trace time only (and re-run per retrace), so nothing "
+                    "meaningful is measured. Instrument the runtime seams "
+                    "(submit/schedule/dispatch/write-back) instead of metric code.",
+                    mod.path, n.lineno, n.col_offset, symbol=fi.qualname,
+                )
+
+    @staticmethod
+    def _is_host_telemetry(dotted: str) -> bool:
+        for m in _TPL104_MODULES:
+            if dotted == m or dotted.startswith(m + "."):
+                return True
+        if dotted.startswith("tpumetrics.telemetry."):
+            return dotted.rpartition(".")[2] in _TPL104_NAMES
+        return False
+
+
 class PartitionRuleDeclRule:
     """TPL304: literal ``StatePartitionRules`` patterns that match no state
     declared anywhere in the analyzed package.
@@ -1171,4 +1260,10 @@ class PartitionRuleDeclRule:
                     )
 
 
-RULES = [TraceSafetyRule(), StateDeclRule(), ShadowStateRule(), PartitionRuleDeclRule()]
+RULES = [
+    TraceSafetyRule(),
+    HostTelemetryRule(),
+    StateDeclRule(),
+    ShadowStateRule(),
+    PartitionRuleDeclRule(),
+]

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from tpumetrics.telemetry import ledger as _telemetry
+from tpumetrics.telemetry import xla as _xla
 from tpumetrics.utils.exceptions import TPUMetricsUserError
 
 Array = jax.Array
@@ -397,7 +398,12 @@ class FusedCollectionStep:
                     "kwarg that varies per batch belongs in a positional array "
                     "argument, or on the unfused update path."
                 )
-        return program(state, self._place_args(tuple(args)))
+        # compile hook: an OO-path dispatch with no runtime attribution
+        # context still names the step + program key for any compile it
+        # fires (signature None: one program re-specializes per shape, so
+        # retrace detection is the runtime callers' richer context's job)
+        with _xla.fallback_attribution(None, label=self._compile_label(key)):
+            return program(state, self._place_args(tuple(args)))
 
     def masked_update(
         self, state: Dict[str, Any], padded: Tuple[Any, ...], n_valid: Array, bucket: int
@@ -432,7 +438,8 @@ class FusedCollectionStep:
 
             program = jax.jit(run, donate_argnums=donate)
             self._programs[key] = program
-        return program(state, self._place_args(tuple(padded)), n_valid)
+        with _xla.fallback_attribution(None, label=self._compile_label(key)):
+            return program(state, self._place_args(tuple(padded)), n_valid)
 
     def megabatch_update(
         self,
@@ -501,7 +508,13 @@ class FusedCollectionStep:
 
             program = jax.jit(run, donate_argnums=donate)
             self._programs[key] = program
-        return program(list(states), list(padded), list(n_valid))
+        with _xla.fallback_attribution(None, label=self._compile_label(key)):
+            return program(list(states), list(padded), list(n_valid))
+
+    def _compile_label(self, key: Any) -> str:
+        """Fallback compile-attribution label: metric class + program key
+        (bounded cardinality — one label per cached program)."""
+        return f"step:{type(self._metric).__name__}:{key!r}"
 
     def __deepcopy__(self, memo: dict) -> None:
         # jitted programs are closed over the ORIGINAL metric objects; a

@@ -68,6 +68,7 @@ from typing import Any, Callable, Iterator, List, Optional, TypeVar
 
 import jax.numpy as jnp
 
+from tpumetrics.telemetry import export as _export
 from tpumetrics.telemetry import ledger as _telemetry
 from tpumetrics.utils.exceptions import TPUMetricsUserError
 
@@ -378,6 +379,11 @@ def _call_with_deadline(
             _telemetry.record_event(
                 backend, "sync_timeout", op=op, tag=tag, attempts=attempt, timeout=timeout
             )
+            # the fence that follows can starve this backend for a long time:
+            # mark the incident in the flight ring (no dump — timeouts are
+            # survivable; the fatal seams dump) so a later crash dump shows
+            # the sync stall that preceded it
+            _export.note_incident("sync_timeout", op=op, tag=tag, timeout=timeout)
             raise SyncTimeoutError(
                 f"Collective {op} (tag={tag!r}) timed out after {timeout}s on attempt "
                 f"{attempt}: a participating rank is dead, stalled, or preempted. The "

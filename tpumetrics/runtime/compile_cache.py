@@ -30,8 +30,7 @@ cold-start compile that the cache kills entirely (gated in bench.py's
 from __future__ import annotations
 
 import os
-from contextlib import contextmanager
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Optional
 
 import jax
 
@@ -122,73 +121,14 @@ def compilation_cache_info() -> Dict[str, Any]:
     return {"dir": cache_dir, "entries": entries, "bytes": total}
 
 
-# jax wraps compile-OR-cache-load in this one duration event; the hit path
-# additionally reports its retrieval time separately, so true compile
-# seconds = backend_compile - cache_retrieval
-_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
-_CACHE_RETRIEVAL_EVENT = "/jax/compilation_cache/cache_retrieval_time_sec"
-
-# jax.monitoring has no unregister API, so exactly ONE listener pair is ever
-# registered (lazily, at the first count_cache_hits use); the context manager
-# pushes its counter dict here and pops it on exit, so repeated/nested use
-# adds nothing to jax's global listener list
-_active_counters: List[Dict[str, Any]] = []
-_listeners_registered = False
-
-
-def _event_listener(event: str, **_kwargs: Any) -> None:
-    for counter in _active_counters:
-        if event == "/jax/compilation_cache/cache_hits":
-            counter["hits"] += 1
-        elif event == "/jax/compilation_cache/cache_misses":
-            counter["misses"] += 1
-
-
-def _duration_listener(event: str, duration: float, **_kwargs: Any) -> None:
-    for counter in _active_counters:
-        if event == _BACKEND_COMPILE_EVENT:
-            counter["backend_compile_secs"] += float(duration)
-        elif event == _CACHE_RETRIEVAL_EVENT:
-            counter["cache_retrieval_secs"] += float(duration)
-
-
-@contextmanager
-def count_cache_hits() -> Iterator[Dict[str, Any]]:
-    """Count persistent-cache hits/misses and accumulate backend compile
-    seconds inside the ``with`` block via JAX's monitoring events — the
-    observable proof that a restarted or elastically resized process REUSED
-    executables instead of recompiling::
-
-        with count_cache_hits() as hits:
-            evaluator.restore_elastic()
-            ... resume streaming ...
-        assert hits["hits"] > 0 and hits["misses"] == 0
-
-    ``hits["backend_compile_secs"]`` sums jax's backend-compile duration
-    event.  That event times compile-OR-cache-load, so a cache hit still
-    contributes its (much cheaper) executable deserialization;
-    ``hits["cache_retrieval_secs"]`` sums exactly that part, making
-    ``backend_compile_secs - cache_retrieval_secs`` the true XLA compile
-    seconds paid — near zero for a fully warm process, while tracing and
-    dispatch time (which no cache can remove) still show up in wall time.
-
-    Safe to use repeatedly (or nested) in a long-lived process: one module
-    listener pair is registered once and dispatches to the counters of the
-    currently active ``with`` blocks only.
-    """
-    global _listeners_registered
-    counter: Dict[str, Any] = {
-        "hits": 0,
-        "misses": 0,
-        "backend_compile_secs": 0.0,
-        "cache_retrieval_secs": 0.0,
-    }
-    if not _listeners_registered:
-        jax.monitoring.register_event_listener(_event_listener)
-        jax.monitoring.register_event_duration_secs_listener(_duration_listener)
-        _listeners_registered = True
-    _active_counters.append(counter)
-    try:
-        yield counter
-    finally:
-        _active_counters.remove(counter)
+# The jax.monitoring listener machinery this module introduced for cache-hit
+# accounting grew into full compile ATTRIBUTION (who paid for each compile,
+# retrace detection) and moved to tpumetrics/telemetry/xla.py; the public
+# names stay importable from here — the runtime's cache story and the
+# telemetry attribution story share one listener pair.
+from tpumetrics.telemetry.xla import (  # noqa: E402,F401  (re-exported API)
+    attribute_compiles,
+    count_cache_hits,
+    enable_compile_attribution,
+    recompile_count,
+)

@@ -29,10 +29,20 @@ tag — ``stats()["by_tag"]`` splits enqueued/drained/dropped so a
 just observed globally — and the ``runtime_drop`` ledger event carries the
 evicted item's tag.
 
+Span tracing (:mod:`tpumetrics.telemetry.spans`): a submit that carries a
+``trace_ctx`` gets a ``queue_wait`` child span — started at enqueue, ended
+when the worker pops the item — so a batch's trace shows exactly how long
+it sat in this queue; the worker refreshes the
+``tpumetrics_queue_depth{dispatcher=…}`` gauge each drain cycle.  Both are
+inert (``None`` span, flag-test gauge) when observability is off.
+
 A worker-side exception poisons the dispatcher: it is captured, the worker
 stops, and the exception re-raises (wrapped, original as ``__cause__``) from
 the next ``submit``/``flush``/``close`` so ingestion errors cannot vanish
-silently on a daemon thread.
+silently on a daemon thread.  If a flight recorder is installed
+(:func:`tpumetrics.telemetry.export.enable_flight_recorder`), the poison
+path dumps the recent-activity ring to a JSONL file first and every later
+``DispatcherClosedError`` names the dump path.
 
 Self-healing (``tpumetrics.resilience``): an optional ``crash_handler`` is
 consulted before poisoning.  It runs on the worker thread with the exception
@@ -51,10 +61,29 @@ import threading
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
+from tpumetrics.telemetry import export as _export
+from tpumetrics.telemetry import instruments as _instruments
 from tpumetrics.telemetry import ledger as _telemetry
+from tpumetrics.telemetry import spans as _spans
 from tpumetrics.utils.exceptions import TPUMetricsUserError
 
 _POLICIES = ("block", "drop_oldest", "error")
+
+# queue depth per dispatcher, refreshed each worker cycle (cheap: one gauge
+# store per drain, not per item)
+_DEPTH_GAUGE = _instruments.gauge(
+    _instruments.QUEUE_DEPTH, help="dispatch queue depth", labels=("dispatcher",)
+)
+
+
+def _end_root(trace_ctx: Any, **attrs: Any) -> None:
+    """Complete a queued batch's ROOT span when the batch will never be
+    drained (evicted, discarded by poison/close) — an orphaned open root
+    would leave its already-recorded queue_wait child parentless in the
+    ring.  Only Span handles can be ended; a bare (trace_id, span_id)
+    context belongs to its submitter."""
+    if isinstance(trace_ctx, _spans.Span):
+        _spans.end_span(trace_ctx, **attrs)
 
 
 class QueueFullError(TPUMetricsUserError):
@@ -78,6 +107,10 @@ class AsyncDispatcher:
             everything currently queued in one call.
         name: attribution tag for telemetry events (e.g. the evaluator's
             metric class name).
+        instrument_label: label for the queue-depth gauge (defaults to
+            ``name``).  Pass a process-unique label (the evaluator's stream
+            label) when several same-named dispatchers may coexist — gauges
+            are last-write-wins per label.
         crash_handler: optional ``(exc, batch) -> bool`` recovery hook run on
             the worker thread when ``drain_fn`` raises (module docstring);
             ``True`` = recovered, keep draining; ``False``/raise = poison.
@@ -95,6 +128,7 @@ class AsyncDispatcher:
         policy: str = "block",
         max_batch: Optional[int] = None,
         name: str = "",
+        instrument_label: Optional[str] = None,
         crash_handler: Optional[Callable[[BaseException, List[Any]], bool]] = None,
     ) -> None:
         if policy not in _POLICIES:
@@ -108,6 +142,7 @@ class AsyncDispatcher:
         self._policy = policy
         self._max_batch = int(max_batch) if max_batch is not None else None
         self._name = name or type(self).__name__
+        self._instr_label = instrument_label or self._name
         self._crash_handler = crash_handler
 
         self._q: deque = deque()
@@ -118,6 +153,7 @@ class AsyncDispatcher:
         self._draining = False
         self._closed = False
         self._error: Optional[BaseException] = None
+        self._flight_path: Optional[str] = None  # flight dump of the poison
 
         # counters (read under lock by stats())
         self._enqueued = 0
@@ -144,11 +180,42 @@ class AsyncDispatcher:
             got = self._by_tag[tag] = {"enqueued": 0, "drained": 0, "dropped": 0}
         return got
 
-    def submit(self, item: Any, timeout: Optional[float] = None, tag: Optional[str] = None) -> None:
+    def submit(
+        self,
+        item: Any,
+        timeout: Optional[float] = None,
+        tag: Optional[str] = None,
+        trace_ctx: Any = None,
+    ) -> None:
         """Enqueue one item, applying the backpressure policy when full.
 
         ``tag`` attributes the item for the per-tag counter split (and for
-        the ``runtime_drop`` event should it later be evicted)."""
+        the ``runtime_drop`` event should it later be evicted).
+        ``trace_ctx`` (a :class:`~tpumetrics.telemetry.spans.Span` or
+        ``(trace_id, span_id)``) parents a ``queue_wait`` child span under
+        the submitter's batch trace, started here and ended when the worker
+        pops the item — the batch's time in THIS queue."""
+        qspan = (
+            _spans.start_span("queue_wait", parent=trace_ctx)
+            if trace_ctx is not None
+            else None
+        )
+        try:
+            self._submit_locked(item, timeout, tag, trace_ctx, qspan)
+        except BaseException as err:
+            # never enqueued: complete the wait span (the submitter owns —
+            # and on failure ends — the root itself)
+            _spans.end_span(qspan, error=repr(err))
+            raise
+
+    def _submit_locked(
+        self,
+        item: Any,
+        timeout: Optional[float],
+        tag: Optional[str],
+        trace_ctx: Any,
+        qspan: Any,
+    ) -> None:
         with self._lock:
             self._check_alive()
             if len(self._q) >= self._max_queue:
@@ -158,7 +225,9 @@ class AsyncDispatcher:
                         "HINT: raise max_queue, slow the producer, or use 'block'/'drop_oldest'."
                     )
                 if self._policy == "drop_oldest":
-                    _, dropped_tag = self._q.popleft()
+                    _, dropped_tag, dropped_span, dropped_ctx = self._q.popleft()
+                    _spans.end_span(dropped_span, dropped=True)
+                    _end_root(dropped_ctx, error="dropped (drop_oldest)")
                     self._dropped += 1
                     if dropped_tag is not None:
                         self._tag_counters(dropped_tag)["dropped"] += 1
@@ -174,7 +243,7 @@ class AsyncDispatcher:
                                 f"Timed out after {timeout}s waiting for queue space "
                                 f"({self._max_queue} items, policy='block')."
                             )
-            self._q.append((item, tag))
+            self._q.append((item, tag, qspan, trace_ctx))
             self._enqueued += 1
             if tag is not None:
                 self._tag_counters(tag)["enqueued"] += 1
@@ -199,6 +268,9 @@ class AsyncDispatcher:
                 self._check_alive(allow_closed=True)
                 return
             if not drain:
+                for _, _, qspan, ctx in self._q:
+                    _spans.end_span(qspan, discarded=True)
+                    _end_root(ctx, error="discarded (close(drain=False))")
                 self._q.clear()
             self._closed = True
             self._not_empty.notify_all()
@@ -244,8 +316,9 @@ class AsyncDispatcher:
 
     def _check_alive(self, allow_closed: bool = False) -> None:
         if self._error is not None:
+            flight = f" Flight record: {self._flight_path}" if self._flight_path else ""
             raise DispatcherClosedError(
-                f"Dispatch worker died: {type(self._error).__name__}: {self._error}"
+                f"Dispatch worker died: {type(self._error).__name__}: {self._error}.{flight}"
             ) from self._error
         if self._closed and not allow_closed:
             raise DispatcherClosedError("Dispatcher is closed.")
@@ -260,11 +333,14 @@ class AsyncDispatcher:
                     return
                 n = len(self._q) if self._max_batch is None else min(len(self._q), self._max_batch)
                 pairs = [self._q.popleft() for _ in range(n)]
-                batch = [item for item, _ in pairs]
-                tags = [t for _, t in pairs if t is not None]
+                batch = [item for item, _, _, _ in pairs]
+                tags = [t for _, t, _, _ in pairs if t is not None]
                 depth_after = len(self._q)
                 self._draining = True
                 self._not_full.notify_all()
+            _DEPTH_GAUGE.set(depth_after, self._instr_label)
+            for _, _, qspan, _ in pairs:
+                _spans.end_span(qspan, depth_after=depth_after)
             try:
                 self._drain_fn(batch)
             except BaseException as err:  # noqa: BLE001 — poison, don't lose it
@@ -289,9 +365,25 @@ class AsyncDispatcher:
                         if not self._q:
                             self._idle.notify_all()
                     continue
+                # the dispatcher is about to die un-drainable: dump the
+                # flight ring (when a recorder is installed) so the last
+                # spans/events before the poison are on disk, and name the
+                # file in every later DispatcherClosedError.  An error that
+                # already carries a dump (CrashLoopError: the crash handler
+                # dumped at budget exhaustion) is the SAME incident — reuse
+                # its file instead of writing a near-duplicate
+                flight_path = getattr(err, "_tpumetrics_flight_path", None)
+                if flight_path is None:
+                    flight_path = _export.flight_dump(
+                        "dispatcher_poisoned", err, dispatcher=self._name
+                    )
                 with self._lock:
                     self._error = err
+                    self._flight_path = flight_path
                     self._draining = False
+                    for _, _, qspan, ctx in self._q:
+                        _spans.end_span(qspan, poisoned=True)
+                        _end_root(ctx, error="discarded (dispatcher poisoned)")
                     self._q.clear()
                     self._not_full.notify_all()
                     self._idle.notify_all()

@@ -47,8 +47,10 @@ round-trips across preemption.
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
+import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
 
 import jax
@@ -65,15 +67,34 @@ from tpumetrics.runtime.bucketing import (
 )
 from tpumetrics.runtime.compile_cache import (
     ENV_CACHE_DIR,
+    attribute_compiles,
     enable_persistent_compilation_cache,
+    recompile_count,
 )
-from tpumetrics.runtime.dispatch import AsyncDispatcher
+from tpumetrics.runtime.dispatch import _DEPTH_GAUGE, AsyncDispatcher
 from tpumetrics.runtime.scheduler import SignatureRegistry
 from tpumetrics.runtime import snapshot as _snapshot
+from tpumetrics.telemetry import export as _export
+from tpumetrics.telemetry import instruments as _instruments
 from tpumetrics.telemetry import ledger as _telemetry
+from tpumetrics.telemetry import spans as _spans
 from tpumetrics.utils.exceptions import TPUMetricsUserError
 
 Array = jax.Array
+
+#: distinguishes two evaluators over the same metric class in the shared
+#: process-global instrument registry (label cardinality: one per evaluator)
+_STREAM_IDS = itertools.count(1)
+
+_SUBMIT_HIST = _instruments.histogram(
+    _instruments.SUBMIT_LATENCY_MS, help="submit() call latency", labels=("stream",)
+)
+_DISPATCH_HIST = _instruments.histogram(
+    _instruments.DISPATCH_LATENCY_MS, help="device dispatch latency", labels=("stream",)
+)
+_JOURNAL_GAUGE = _instruments.gauge(
+    _instruments.JOURNAL_LEN, help="crash-replay journal length", labels=("stream",)
+)
 
 
 class CrashLoopError(TPUMetricsUserError):
@@ -306,12 +327,14 @@ class StreamingEvaluator:
             )
 
         name = type(metric).__name__
+        self._stream = f"{name}#{next(_STREAM_IDS)}"
         self._dispatcher = AsyncDispatcher(
             self._drain,
             max_queue=max_queue,
             policy=backpressure,
             max_batch=micro_batch,
             name=name,
+            instrument_label=self._stream,  # gauges are last-write-wins per label
             crash_handler=self._handle_crash if crash_policy == "restore" else None,
         )
 
@@ -321,19 +344,52 @@ class StreamingEvaluator:
         """Enqueue one batch (positional update args); applies backpressure.
 
         Never runs the update on the calling thread — cost is one bounded
-        enqueue (plus the policy's wait when the queue is full).
+        enqueue (plus the policy's wait when the queue is full).  With span
+        tracing on, the batch roots a fresh trace here ("one batch = one
+        trace"); with instruments on, the call duration lands in the shared
+        ``tpumetrics_submit_latency_ms{stream=…}`` histogram.
         """
         if not args:
             raise ValueError("submit() needs at least one positional batch argument")
-        self._dispatcher.submit(args)
+        timed = _instruments.enabled()
+        t0 = time.perf_counter() if timed else 0.0
+        root = _spans.start_trace("batch", stream=self._stream)
+        try:
+            self._dispatcher.submit((args, root), trace_ctx=root)
+            # successful submits only: a failed one (closed dispatcher, full
+            # queue) must not pollute the distribution — or re-mint the
+            # series close() just released
+            if timed:
+                _SUBMIT_HIST.observe((time.perf_counter() - t0) * 1e3, self._stream)
+        except BaseException as err:
+            _spans.end_span(root, error=repr(err))
+            raise
 
     def flush(self, timeout: Optional[float] = None) -> None:
         """Block until every submitted batch has been applied to the state."""
         self._dispatcher.flush(timeout=timeout)
 
     def close(self, drain: bool = True, timeout: Optional[float] = None) -> None:
-        """Flush (unless ``drain=False``) and stop the worker.  Idempotent."""
-        self._dispatcher.close(drain=drain, timeout=timeout)
+        """Flush (unless ``drain=False``) and stop the worker.  Idempotent.
+
+        Releases this evaluator's auto-minted ``stream`` label series from
+        the process-global instruments — each construction mints a fresh
+        label, so without the release a construct-per-job process would
+        grow dead histogram series forever.  ``stats()`` after ``close``
+        therefore reports an empty ``latency`` section.  The release runs
+        even when ``close`` raises (poisoned worker, join timeout): a
+        replaced-after-crash evaluator must not leak its series."""
+        try:
+            self._dispatcher.close(drain=drain, timeout=timeout)
+        finally:
+            for inst in (_SUBMIT_HIST, _DISPATCH_HIST, _JOURNAL_GAUGE):
+                inst.remove(self._stream)
+            _DEPTH_GAUGE.remove(self._stream)
+            # the XLA attribution side of the same contract: compile-seconds
+            # / recompile series and the retrace keys under this token
+            from tpumetrics.telemetry.xla import release_attribution
+
+            release_attribution(self._stream, tokens=(self._stream,))
 
     def __enter__(self) -> "StreamingEvaluator":
         return self
@@ -374,7 +430,11 @@ class StreamingEvaluator:
 
     def stats(self) -> Dict[str, Any]:
         """Dispatcher counters + stream position + compile accounting +
-        resilience status (``degraded``, ``crashes``, ``restores``)."""
+        resilience status (``degraded``, ``crashes``, ``restores``) +
+        observability (``latency`` — submit/dispatch p50/p99 from the shared
+        instrument histograms — and ``recompiles``, the attributed-retrace
+        count for this stream).  Existing keys are a stable contract; the
+        new sections only ever ADD keys."""
         out = self._dispatcher.stats()
         with self._lock:
             out.update(
@@ -392,6 +452,8 @@ class StreamingEvaluator:
                 crashes=self._crashes,
                 restores=self._restores,
             )
+        out["latency"] = _instruments.latency_section(self._stream)
+        out["recompiles"] = recompile_count(self._stream)
         return out
 
     # -------------------------------------------------------------- snapshots
@@ -481,6 +543,8 @@ class StreamingEvaluator:
         # the journal is "since the last snapshot": this save is the new base
         self._journal = []
         self._journal_base = self._batches
+        if self._crash_policy == "restore":
+            _JOURNAL_GAUGE.set(0, self._stream)  # cleared, not just appended
         return path
 
     def restore_latest(self) -> Optional[int]:
@@ -677,40 +741,69 @@ class StreamingEvaluator:
         self._journal = []
         self._journal_base = restored
         self._degraded = degraded
+        if self._crash_policy == "restore":
+            _JOURNAL_GAUGE.set(0, self._stream)
         return restored
 
     # ----------------------------------------------------------------- worker
 
-    def _drain(self, batch_args: list) -> None:
-        """Worker-side: apply each submitted batch individually, in order."""
-        for pos, args in enumerate(batch_args):
+    def _drain(self, batch: list) -> None:
+        """Worker-side: apply each submitted batch individually, in order.
+        Queue items are ``(args, root_span_or_None)`` pairs — the span rides
+        next to the batch so the worker's child spans join its trace.  A
+        crash completes the undrained tail's roots too (their batches are
+        applied — if at all — via span-less replay or discarded by poison;
+        an open root would orphan its recorded queue_wait child)."""
+        for pos, (args, ctx) in enumerate(batch):
             self._inflight_pos = pos  # lets the crash handler find the tail
-            self._apply_one(args)
+            try:
+                self._apply_one(args, ctx)
+            except BaseException as err:
+                for _t_args, t_ctx in batch[pos + 1 :]:
+                    _spans.end_span(t_ctx, error=f"drain interrupted: {err!r}")
+                raise
 
-    def _apply_one(self, args: Tuple[Any, ...]) -> None:
+    def _apply_one(self, args: Tuple[Any, ...], ctx: Any = None) -> None:
         """Apply ONE submitted batch: journal (under a restore policy), state
-        transition, counters, and the compute/snapshot cadences."""
+        transition, counters, and the compute/snapshot cadences.  ``ctx`` is
+        the batch's root span (``None`` on crash replays and with tracing
+        off): the worker adopts it so plan/dispatch/write-back children nest
+        under the submit-side trace, and ends it when the batch — cadences
+        included — is fully applied."""
         if self._crash_policy == "restore":
             # journaled BEFORE applying so a crashed batch is replayable
             self._journal.append(args)
-        if self._bucketer is None:
-            self._metric.update(*args, **self._update_kwargs)
-            n_rows = leading_rows(args)
-        else:
-            n_rows = self._bucketed_update(args)
-        with self._lock:
-            self._batches += 1
-            self._items += n_rows
-            batches = self._batches
-        if self._compute_every and batches - self._last_compute_at >= self._compute_every:
-            self._refresh_latest()
-        if (
-            self._snapshot_every
-            and self._snapshots is not None
-            and batches % self._snapshot_every == 0
-        ):
-            with self._lock:
-                self._save_snapshot_locked()
+            _JOURNAL_GAUGE.set(len(self._journal), self._stream)
+        try:
+            # outer attribution (signature None): eager helper ops (padding,
+            # casts) outside the per-chunk program contexts still charge
+            # their compiles to this stream
+            with attribute_compiles(self._stream, None, token=self._stream), _spans.activate(ctx):
+                if self._bucketer is None:
+                    with _spans.span("dispatch", mode="eager"):
+                        self._metric.update(*args, **self._update_kwargs)
+                    n_rows = leading_rows(args)
+                else:
+                    n_rows = self._bucketed_update(args)
+                with self._lock:
+                    self._batches += 1
+                    self._items += n_rows
+                    batches = self._batches
+                if self._compute_every and batches - self._last_compute_at >= self._compute_every:
+                    self._refresh_latest()
+                if (
+                    self._snapshot_every
+                    and self._snapshots is not None
+                    and batches % self._snapshot_every == 0
+                ):
+                    with self._lock:
+                        self._save_snapshot_locked()
+        except BaseException as err:
+            # end the root NOW so the poisoned batch's trace is complete
+            # (and in the flight ring) before crash handling dumps/raises
+            _spans.end_span(ctx, error=repr(err))
+            raise
+        _spans.end_span(ctx, batches=batches)
 
     # ------------------------------------------------------------ self-healing
 
@@ -731,7 +824,9 @@ class StreamingEvaluator:
         resets it, so independent transient crashes never accumulate into a
         spurious exhaustion.  ``stats()`` still reports lifetime totals.
         """
-        pending = list(self._journal) + list(batch[self._inflight_pos + 1 :])
+        # dispatcher items are (args, root_span) pairs; the journal holds raw
+        # args — replays run span-less (their traces ended at the crash)
+        pending = list(self._journal) + [item[0] for item in batch[self._inflight_pos + 1 :]]
         attempts = 0  # consecutive same-position crashes (lifetime: _crashes)
         last_pos = -1
         while True:
@@ -745,19 +840,29 @@ class StreamingEvaluator:
                 self, "runtime_crash", error=repr(err), crashes=crashes, attempt=attempts
             )
             if attempts > self._max_restores:
-                raise CrashLoopError(
+                flight = _export.flight_dump("crash_loop", err, stream=self._stream)
+                note = f" Flight record: {flight}" if flight else ""
+                loop_err = CrashLoopError(
                     f"StreamingEvaluator worker crashed {attempts} consecutive time(s) "
                     f"without progress; crash-loop budget (max_restores="
                     f"{self._max_restores}) is spent. Last crash: "
-                    f"{type(err).__name__}: {err}"
-                ) from err
+                    f"{type(err).__name__}: {err}.{note}"
+                )
+                if flight:
+                    # the dispatcher's poison path reuses this dump instead
+                    # of writing a second one for the same incident
+                    loop_err._tpumetrics_flight_path = flight
+                raise loop_err from err
             idx = -1  # nothing replayed yet (restore itself may fail)
             try:
-                self._restore_for_crash()
-                idx = 0
-                while idx < len(pending):
-                    self._apply_one(pending[idx])
-                    idx += 1
+                # span-less: the replayed batches' traces ended at the crash;
+                # child spans fired here would root fresh fragment traces
+                with _spans.suppress():
+                    self._restore_for_crash()
+                    idx = 0
+                    while idx < len(pending):
+                        self._apply_one(pending[idx])
+                        idx += 1
             except TPUMetricsUserError:
                 raise  # config/snapshot-level problems are not crash-loopable
             except BaseException as replay_err:  # noqa: BLE001 — bounded above
@@ -799,7 +904,8 @@ class StreamingEvaluator:
         # shared with the multi-tenant service; signatures feed the
         # LRU-bounded registry whose insert count == XLA compile count, per
         # (bucket, signature) for the WHOLE collection, never per member
-        n, chunks = plan_bucketed_update(self._bucketer, args)
+        with _spans.span("plan"):
+            n, chunks = plan_bucketed_update(self._bucketer, args)
         for chunk in chunks:
             if chunk[0] == "scalar":
                 # scalar-only submit (e.g. an aggregation metric fed floats):
@@ -808,15 +914,17 @@ class StreamingEvaluator:
                 # whole-collection step (donated state) over the raw args
                 _, cargs, sig = chunk
                 new_sig = self._trace_signatures.observe(sig)
-                self._apply_step(new_sig, lambda s, a=cargs: self._step.update(s, *a))
+                with attribute_compiles(self._stream, sig, token=self._stream):
+                    self._apply_step(new_sig, lambda s, a=cargs: self._step.update(s, *a))
                 continue
             _, padded, bucket, size, sig = chunk
             new_sig = self._trace_signatures.observe(sig)
             n_valid = jnp.asarray(size, jnp.int32)
-            self._apply_step(
-                new_sig,
-                lambda s, p=padded, b=bucket, nv=n_valid: self._step.masked_update(s, p, nv, b),
-            )
+            with attribute_compiles(self._stream, sig, token=self._stream):
+                self._apply_step(
+                    new_sig,
+                    lambda s, p=padded, b=bucket, nv=n_valid: self._step.masked_update(s, p, nv, b),
+                )
         return n
 
     def _apply_step(self, new_sig: bool, run: Callable[[Any], Any]) -> None:
@@ -833,15 +941,28 @@ class StreamingEvaluator:
         donates ``_state`` while streaming, so the unlocked copy is safe.)
         Non-donating steps delete nothing and stay outside the lock
         entirely, as before donation existed."""
+        timed = _instruments.enabled()
         if not self._step.donate:
-            new_state = run(self._state)
+            t0 = time.perf_counter() if timed else 0.0
+            with _spans.span("dispatch", cold=new_sig):
+                new_state = run(self._state)
+            if timed:
+                _DISPATCH_HIST.observe((time.perf_counter() - t0) * 1e3, self._stream)
             with self._lock:
-                self._state = new_state
+                with _spans.span("write_back"):
+                    self._state = new_state
             return
         if new_sig:
-            run(jax.tree_util.tree_map(lambda leaf: leaf.copy(), self._state))
+            with _spans.span("compile"):
+                run(jax.tree_util.tree_map(lambda leaf: leaf.copy(), self._state))
         with self._lock:
-            self._state = run(self._state)
+            t0 = time.perf_counter() if timed else 0.0
+            with _spans.span("dispatch", cold=new_sig):
+                new_state = run(self._state)
+            if timed:
+                _DISPATCH_HIST.observe((time.perf_counter() - t0) * 1e3, self._stream)
+            with _spans.span("write_back"):
+                self._state = new_state
 
     def _refresh_latest(self) -> None:
         with self._lock:

@@ -65,8 +65,10 @@ p99 submit latency.
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
+import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -86,16 +88,37 @@ from tpumetrics.runtime.bucketing import (
 )
 from tpumetrics.runtime.compile_cache import (
     ENV_CACHE_DIR,
+    attribute_compiles,
     enable_persistent_compilation_cache,
+    recompile_count,
 )
-from tpumetrics.runtime.dispatch import AsyncDispatcher
+from tpumetrics.runtime.dispatch import _DEPTH_GAUGE, AsyncDispatcher
 from tpumetrics.runtime.evaluator import CrashLoopError
 from tpumetrics.runtime.scheduler import DeficitRoundRobin, SignatureRegistry
 from tpumetrics.runtime import snapshot as _snapshot
+from tpumetrics.telemetry import export as _export
+from tpumetrics.telemetry import instruments as _instruments
 from tpumetrics.telemetry import ledger as _telemetry
+from tpumetrics.telemetry import spans as _spans
 from tpumetrics.utils.exceptions import TPUMetricsUserError
 
 _POLICIES = ("block", "drop_oldest", "error")
+
+# shared-with-the-evaluator instrument families (get-or-create): the service
+# labels them by tenant id — 1000-stream-scale cardinality is a documented
+# budget (docs/observability.md), ~20 numbers per series
+_SUBMIT_HIST = _instruments.histogram(
+    _instruments.SUBMIT_LATENCY_MS, help="submit() call latency", labels=("stream",)
+)
+_DISPATCH_HIST = _instruments.histogram(
+    _instruments.DISPATCH_LATENCY_MS, help="device dispatch latency", labels=("stream",)
+)
+_TENANTS_GAUGE = _instruments.gauge(
+    _instruments.TENANTS_LIVE, help="registered, non-quarantined tenants", labels=("service",)
+)
+#: gauges are last-write-wins per label: two default-named services must not
+#: share one series, so each instance mints a unique instrument label
+_SERVICE_IDS = itertools.count(1)
 
 
 def _state_alive(state: Any) -> bool:
@@ -179,6 +202,7 @@ class _Tenant:
         self.journal_base = 0
         self.crashes = 0
         self.restores = 0
+        self.flight_path: Optional[str] = None  # quarantine's flight dump
 
 
 class TenantHandle:
@@ -286,8 +310,11 @@ class EvaluationService:
         self._megabatch_tenants = 0
         self._mega_group_meta = (0, 0, 0)  # worker-thread-only scratch
         self._quarantines = 0
+        self._name = name
+        self._label = f"{name}#{next(_SERVICE_IDS)}"
         self._dispatcher = AsyncDispatcher(
-            self._drain, max_queue=max_tokens, policy="block", name=name
+            self._drain, max_queue=max_tokens, policy="block", name=name,
+            instrument_label=self._label,
         )
 
     # ------------------------------------------------------------ registration
@@ -388,6 +415,7 @@ class EvaluationService:
             # half-registered zombie tenant
             self._drr.add(tenant_id, quota)
             self._tenants[tenant_id] = tenant
+            _TENANTS_GAUGE.set(len(self._tenants) - self._quarantines, self._label)
         return TenantHandle(self, tenant_id)
 
     def _resolve_step(
@@ -435,10 +463,14 @@ class EvaluationService:
     def submit(self, tenant_id: str, *args: Any) -> None:
         """Enqueue one batch for a tenant; applies THAT tenant's
         backpressure policy.  Never runs a device step on the caller's
-        thread — cost is one signature probe + one bounded enqueue."""
+        thread — cost is one signature probe + one bounded enqueue (and,
+        with observability on, one histogram observation + a batch root
+        span: "one batch = one trace" is anchored here)."""
         if not args:
             raise ValueError("submit() needs at least one positional batch argument")
         tenant = self._get(tenant_id)
+        timed = _instruments.enabled()
+        t0 = time.perf_counter() if timed else 0.0
         # probe computed outside the lock: row count for DRR cost, and the
         # single-chunk signature for the worker's megabatch grouping.  A
         # probe failure (pathological args) is NOT the caller's crash — the
@@ -451,35 +483,48 @@ class EvaluationService:
                 probe = single_chunk_signature(tenant.bucketer, args)
             except Exception:
                 probe = None
-        entry = (tuple(args), max(int(n), 1), probe)
-        with self._lock:
-            self._raise_if_quarantined(tenant)
-            if len(tenant.queue) >= tenant.max_queue:
-                if tenant.policy == "error":
-                    from tpumetrics.runtime.dispatch import QueueFullError
+        root = _spans.start_trace("batch", stream=tenant_id)
+        qspan = _spans.start_span("queue_wait", parent=root) if root is not None else None
+        entry = (tuple(args), max(int(n), 1), probe, (root, qspan))
+        try:
+            with self._lock:
+                self._raise_if_quarantined(tenant)
+                if len(tenant.queue) >= tenant.max_queue:
+                    if tenant.policy == "error":
+                        from tpumetrics.runtime.dispatch import QueueFullError
 
-                    raise QueueFullError(
-                        f"Tenant {tenant_id!r} queue full ({tenant.max_queue} batches) "
-                        "under policy='error'."
-                    )
-                if tenant.policy == "drop_oldest":
-                    tenant.queue.popleft()
-                    tenant.pending -= 1
-                    tenant.dropped += 1
-                    with _telemetry.attribution(tenant_id):
-                        _telemetry.record_event(
-                            self, "runtime_drop", dropped_total=tenant.dropped
+                        raise QueueFullError(
+                            f"Tenant {tenant_id!r} queue full ({tenant.max_queue} batches) "
+                            "under policy='error'."
                         )
-                else:  # block
-                    while len(tenant.queue) >= tenant.max_queue:
-                        self._raise_if_quarantined(tenant)
-                        self._space.wait()
-            tenant.queue.append(entry)
-            tenant.pending += 1
-            tenant.enqueued += 1
-            self._drr.activate(tenant_id)
-            self._mark_ready(tenant)
-        self._dispatcher.submit(tenant_id, tag=tenant_id)
+                    if tenant.policy == "drop_oldest":
+                        _, _, _, (d_root, d_qspan) = tenant.queue.popleft()
+                        _spans.end_span(d_qspan, dropped=True)
+                        _spans.end_span(d_root, error="dropped (drop_oldest)")
+                        tenant.pending -= 1
+                        tenant.dropped += 1
+                        with _telemetry.attribution(tenant_id):
+                            _telemetry.record_event(
+                                self, "runtime_drop", dropped_total=tenant.dropped
+                            )
+                    else:  # block
+                        while len(tenant.queue) >= tenant.max_queue:
+                            self._raise_if_quarantined(tenant)
+                            self._space.wait()
+                tenant.queue.append(entry)
+                tenant.pending += 1
+                tenant.enqueued += 1
+                self._drr.activate(tenant_id)
+                self._mark_ready(tenant)
+            self._dispatcher.submit(tenant_id, tag=tenant_id)
+            # successful submits only: a quarantined/full-queue failure must
+            # not pollute the distribution or re-mint a released series
+            if timed:
+                _SUBMIT_HIST.observe((time.perf_counter() - t0) * 1e3, tenant_id)
+        except BaseException as err:
+            _spans.end_span(qspan, error=repr(err))
+            _spans.end_span(root, error=repr(err))
+            raise
 
     def flush(self, tenant_id: Optional[str] = None, timeout: Optional[float] = None) -> None:
         """Block until the tenant's queue is fully applied (``tenant_id=None``
@@ -499,8 +544,39 @@ class EvaluationService:
             self._raise_if_quarantined(tenant)
 
     def close(self, drain: bool = True, timeout: Optional[float] = None) -> None:
-        """Flush every tenant (unless ``drain=False``) and stop the worker."""
-        self._dispatcher.close(drain=drain, timeout=timeout)
+        """Flush every tenant (unless ``drain=False``) and stop the worker.
+
+        Releases this service's instrument series — the per-instance gauge
+        labels and every tenant's submit/dispatch histogram series — from
+        the process-global registry, so a construct-per-job process does
+        not grow dead series (the evaluator's ``close`` contract).  Note a
+        tenant id reused by ANOTHER live service shares (and here loses)
+        its series — ids are aggregation keys, use unique ones.  The
+        release (and the abandoned-batch span completion) runs even when
+        ``close`` raises — a poisoned dispatcher or a drain timeout is
+        exactly when batches are left behind."""
+        from tpumetrics.telemetry.xla import release_attribution
+
+        try:
+            self._dispatcher.close(drain=drain, timeout=timeout)
+        finally:
+            with self._lock:
+                tenants = list(self._tenants.values())
+                # any batch still in a tenant queue will never be drained
+                # (drain=False, a poisoned dispatcher, a timed-out drain):
+                # complete its spans like the dispatcher's discard paths do,
+                # or recorded queue_wait children stay orphaned.  After a
+                # clean drain the queues are empty and this is a no-op.
+                for tenant in tenants:
+                    for _args, _n, _probe, (d_root, d_qspan) in tenant.queue:
+                        _spans.end_span(d_qspan, discarded=True)
+                        _spans.end_span(d_root, error="discarded (service close)")
+            for tenant in tenants:
+                _SUBMIT_HIST.remove(tenant.tid)
+                _DISPATCH_HIST.remove(tenant.tid)
+                release_attribution(tenant.tid, tokens=(tenant.step_token,))
+            _TENANTS_GAUGE.remove(self._label)
+            _DEPTH_GAUGE.remove(self._label)
 
     def __enter__(self) -> "EvaluationService":
         return self
@@ -527,8 +603,11 @@ class EvaluationService:
                 return value
             # the step's metric runs ALL functional ops for shared-step
             # tenants (init/update/compute from one config-identical object),
-            # so state structure and compute can never drift between sharers
-            return tenant.step._metric.functional_compute(tenant.state)
+            # so state structure and compute can never drift between sharers.
+            # Compile attribution: signature None = attribute, but exempt
+            # from retrace detection (eager computes re-fire per new shape)
+            with attribute_compiles(tenant.tid, None, token=tenant.step_token):
+                return tenant.step._metric.functional_compute(tenant.state)
 
     def latest_result(self, tenant_id: str) -> Optional[Dict[str, Any]]:
         """The tenant's bounded-staleness result (``compute_every=n``);
@@ -545,7 +624,7 @@ class EvaluationService:
     def tenant_stats(self, tenant_id: str) -> Dict[str, Any]:
         tenant = self._get(tenant_id)
         with self._lock:
-            return {
+            out = {
                 "batches": tenant.batches,
                 "items": tenant.items,
                 "enqueued": tenant.enqueued,
@@ -559,6 +638,12 @@ class EvaluationService:
                 "restores": tenant.restores,
                 "buckets": list(tenant.bucketer.edges) if tenant.bucketer else None,
             }
+        # observability sections (outside the lock: instrument reads take
+        # per-instrument locks only).  Existing keys are a stable contract —
+        # these only ever ADD keys.
+        out["latency"] = _instruments.latency_section(tenant_id)
+        out["recompiles"] = recompile_count(tenant_id)
+        return out
 
     def stats(self) -> Dict[str, Any]:
         """Service-wide counters: the shared dispatcher's (with the per-tag
@@ -698,9 +783,10 @@ class EvaluationService:
 
     def _raise_if_quarantined(self, tenant: _Tenant) -> None:
         if tenant.error is not None:
+            flight = f" Flight record: {tenant.flight_path}" if tenant.flight_path else ""
             raise TenantQuarantinedError(
                 f"Tenant {tenant.tid!r} is quarantined after "
-                f"{type(tenant.error).__name__}: {tenant.error}"
+                f"{type(tenant.error).__name__}: {tenant.error}.{flight}"
             ) from tenant.error
 
     def _mark_ready(self, tenant: _Tenant) -> None:
@@ -730,19 +816,26 @@ class EvaluationService:
         """Pick the next fair unit of work under the lock: the DRR winner's
         head batch, plus — when it is megabatch-eligible — every other
         ready tenant's head with the SAME (step, bucket, signature), each
-        co-served tenant's deficit charged for its rows."""
+        co-served tenant's deficit charged for its rows.  Each popped
+        batch's ``queue_wait`` span ends here, and the selection window is
+        recorded as a ``schedule`` child span (the DRR scheduling delay)
+        under every member's trace."""
+        sched_t0 = _spans._now_ns() if _spans.enabled() else 0
         with self._lock:
             tid = self._drr.select(self._head_cost)
             if tid is None:
                 return None
             tenant = self._tenants[tid]
-            args, n, probe = tenant.queue.popleft()
+            args, n, probe, (root, qspan) = tenant.queue.popleft()
+            _spans.end_span(qspan)
             self._unmark_ready(tenant)
             self._space.notify_all()
             if not (tenant.megabatch and probe is not None):
-                return ("single", [(tenant, args, n, probe)])
+                members = [(tenant, args, n, probe, root)]
+                self._record_schedule(members, sched_t0)
+                return ("single", members)
             bucket, _, sig = probe
-            members = [(tenant, args, n, probe)]
+            members = [(tenant, args, n, probe, root)]
             ready = self._ready.get(tenant.step_token)
             if ready:
                 for other_id in list(ready):
@@ -753,17 +846,29 @@ class EvaluationService:
                     other = self._tenants[other_id]
                     if other.error is not None or not other.queue:
                         continue
-                    o_args, o_n, o_probe = other.queue[0]
+                    o_args, o_n, o_probe, (o_root, o_qspan) = other.queue[0]
                     if o_probe is None or o_probe[0] != bucket or o_probe[2] != sig:
                         continue
                     other.queue.popleft()
+                    _spans.end_span(o_qspan, co_served=True)
                     self._unmark_ready(other)
                     self._drr.charge(other_id, o_n)
-                    members.append((other, o_args, o_n, o_probe))
+                    members.append((other, o_args, o_n, o_probe, o_root))
                 self._space.notify_all()
+            self._record_schedule(members, sched_t0)
             if len(members) == 1:
                 return ("single", members)
             return ("mega", members)
+
+    @staticmethod
+    def _record_schedule(members: list, sched_t0: int) -> None:
+        if not _spans.enabled():
+            return
+        end = _spans._now_ns()
+        start = sched_t0 or end  # tracing flipped on mid-selection: zero-width
+        for _tenant, _args, _n, _probe, root in members:
+            if root is not None:
+                _spans.record_span("schedule", start, end, parent=root)
 
     def _head_cost(self, tid: str) -> Optional[float]:
         tenant = self._tenants[tid]
@@ -774,7 +879,12 @@ class EvaluationService:
     def _run_group(self, kind: str, members: list) -> None:
         if kind == "mega" and len(members) > 1:
             try:
-                self._megabatch_dispatch(members)
+                # outer attribution for the group's helper ops (padding,
+                # dummy init states); the program dispatch inside carries
+                # its own signature-bearing context
+                tenant0 = members[0][0]
+                with attribute_compiles(tenant0.tid, None, token=tenant0.step_token):
+                    self._megabatch_dispatch(members)
             except BaseException as err:  # noqa: BLE001 — fenced per member
                 # a megabatch failure cannot be attributed to one tenant and
                 # nothing was written back — re-run members individually and
@@ -783,17 +893,27 @@ class EvaluationService:
                 return
             self._megabatch_finish(members)
             return
-        for tenant, args, _n, _probe in members:
-            self._run_single(tenant, args)
+        for tenant, args, _n, _probe, root in members:
+            self._run_single(tenant, args, root)
 
     # ------------------------------------------------------------- single path
 
-    def _run_single(self, tenant: _Tenant, args: Tuple[Any, ...]) -> None:
+    def _run_single(self, tenant: _Tenant, args: Tuple[Any, ...], root: Any = None) -> None:
         try:
             with _telemetry.attribution(tenant.tid):
-                self._apply_batch(tenant, args)
+                # outer attribution (signature None): the small eager helper
+                # ops a batch fires outside the per-chunk program contexts
+                # (padding, casts) still charge their compiles to THIS tenant
+                with attribute_compiles(tenant.tid, None, token=tenant.step_token):
+                    with _spans.activate(root):
+                        self._apply_batch(tenant, args)
         except BaseException as err:  # noqa: BLE001 — fenced per tenant
+            # complete the poisoned batch's trace BEFORE crash handling, so
+            # a quarantine's flight dump carries its spans in the ring tail
+            _spans.end_span(root, error=repr(err))
             self._handle_tenant_crash(tenant, err)
+        else:
+            _spans.end_span(root, batches=tenant.batches)
         finally:
             self._finish_one(tenant)
 
@@ -808,7 +928,8 @@ class EvaluationService:
         if tenant.crash_policy == "restore":
             tenant.journal.append(args)
         if tenant.bucketer is None:
-            tenant.metric.update(*args, **tenant.update_kwargs)
+            with _spans.span("dispatch", mode="eager"):
+                tenant.metric.update(*args, **tenant.update_kwargs)
             n_rows = leading_rows(args)
         else:
             n_rows = self._bucketed_update(tenant, args)
@@ -868,23 +989,26 @@ class EvaluationService:
             self._trim_journal(tenant)
 
     def _bucketed_update(self, tenant: _Tenant, args: Tuple[Any, ...]) -> int:
-        n, chunks = plan_bucketed_update(tenant.bucketer, args)
+        with _spans.span("plan"):
+            n, chunks = plan_bucketed_update(tenant.bucketer, args)
         for chunk in chunks:
             if chunk[0] == "scalar":
                 _, cargs, sig = chunk
                 new_sig = self._observe(tenant, sig)
-                self._apply_step(
-                    tenant, new_sig, lambda s, a=cargs: tenant.step.update(s, *a)
-                )
+                with attribute_compiles(tenant.tid, sig, token=tenant.step_token):
+                    self._apply_step(
+                        tenant, new_sig, lambda s, a=cargs: tenant.step.update(s, *a)
+                    )
                 continue
             _, padded, bucket, size, sig = chunk
             new_sig = self._observe(tenant, sig)
             n_valid = jnp.asarray(size, jnp.int32)
-            self._apply_step(
-                tenant,
-                new_sig,
-                lambda s, p=padded, b=bucket, nv=n_valid: tenant.step.masked_update(s, p, nv, b),
-            )
+            with attribute_compiles(tenant.tid, sig, token=tenant.step_token):
+                self._apply_step(
+                    tenant,
+                    new_sig,
+                    lambda s, p=padded, b=bucket, nv=n_valid: tenant.step.masked_update(s, p, nv, b),
+                )
         return n
 
     def _observe(self, tenant: _Tenant, sig: Any) -> bool:
@@ -899,15 +1023,28 @@ class EvaluationService:
         concurrent snapshot()/compute() must never see a state
         mid-donation); cold signatures pre-compile OUTSIDE the lock on a
         throwaway copy so ``latest_result``/``stats`` never block on XLA."""
+        timed = _instruments.enabled()
         if not tenant.step.donate:
-            new_state = run(tenant.state)
+            t0 = time.perf_counter() if timed else 0.0
+            with _spans.span("dispatch", cold=new_sig):
+                new_state = run(tenant.state)
+            if timed:
+                _DISPATCH_HIST.observe((time.perf_counter() - t0) * 1e3, tenant.tid)
             with self._lock:
-                tenant.state = new_state
+                with _spans.span("write_back"):
+                    tenant.state = new_state
             return
         if new_sig:
-            run(jax.tree_util.tree_map(lambda leaf: leaf.copy(), tenant.state))
+            with _spans.span("compile"):
+                run(jax.tree_util.tree_map(lambda leaf: leaf.copy(), tenant.state))
         with self._lock:
-            tenant.state = run(tenant.state)
+            t0 = time.perf_counter() if timed else 0.0
+            with _spans.span("dispatch", cold=new_sig):
+                new_state = run(tenant.state)
+            if timed:
+                _DISPATCH_HIST.observe((time.perf_counter() - t0) * 1e3, tenant.tid)
+            with _spans.span("write_back"):
+                tenant.state = new_state
 
     # ---------------------------------------------------------- megabatch path
 
@@ -927,7 +1064,7 @@ class EvaluationService:
         while k_padded < k:
             k_padded *= 2
         padded_list, n_list = [], []
-        for _tenant, args, n, _probe in members:
+        for _tenant, args, n, _probe, _root in members:
             # pad to the GROUP's bucket (from the member's own signature
             # probe — signature equality guarantees identical padded
             # shapes), never through another tenant's bucket edges: two
@@ -940,6 +1077,9 @@ class EvaluationService:
         mega_sig = (tenant0.step_token, ("mega", bucket, k_padded, sig))
         with self._lock:
             new_sig = self._signatures.observe(mega_sig)
+        # the group program is attributed to the DRR winner that formed the
+        # group (one label, bounded cardinality); attrs carry the group size
+        attrib = attribute_compiles(tenant0.tid, mega_sig[1], token=tenant0.step_token)
         if new_sig:
             # cold compile outside the lock on throwaway copies (+ fresh
             # dummies — a donating program consumes every state-list leaf,
@@ -949,16 +1089,35 @@ class EvaluationService:
                 jax.tree_util.tree_map(lambda leaf: leaf.copy(), m[0].state)
                 for m in members
             ] + [step.init_state() for _ in range(k_padded - k)]
-            step.megabatch_update(states, padded_list, n_list, bucket)
+            with attrib:
+                step.megabatch_update(states, padded_list, n_list, bucket)
         dummies = [step.init_state() for _ in range(k_padded - k)]
+        timed_spans = _spans.enabled()
         with self._lock:
             states = [m[0].state for m in members] + dummies
-            outs = step.megabatch_update(states, padded_list, n_list, bucket)
-            for i, (tenant, args, n, _probe) in enumerate(members):
+            t0 = _spans._now_ns() if timed_spans else 0
+            with attrib:
+                outs = step.megabatch_update(states, padded_list, n_list, bucket)
+            t1 = _spans._now_ns() if timed_spans else 0
+            for i, (tenant, args, n, _probe, root) in enumerate(members):
                 tenant.state = outs[i]
                 tenant.megabatched += 1
                 if tenant.crash_policy == "restore":
                     tenant.journal.append(args)
+            t2 = _spans._now_ns() if timed_spans else 0
+            if timed_spans:
+                # the shared device program + the GROUP's write-back loop,
+                # recorded under every co-served member's own trace with the
+                # SAME window (a per-iteration end time would charge member
+                # i for members 0..i-1's bookkeeping)
+                for _tenant, _args, _n, _probe, root in members:
+                    if root is not None:
+                        _spans.record_span(
+                            "dispatch", t0, t1, parent=root, megabatch=True, tenants=k
+                        )
+                        _spans.record_span(
+                            "write_back", t1, t2, parent=root, megabatch=True
+                        )
             self._megabatch_steps += 1
             self._megabatch_tenants += k
             self._mega_group_meta = (k, k_padded, bucket)
@@ -975,7 +1134,7 @@ class EvaluationService:
             )
         except Exception:  # noqa: BLE001 — a raising user sink must not
             pass  # cascade into re-applied batches; the step already ran
-        for tenant, args, n, _probe in members:
+        for tenant, args, n, _probe, root in members:
             try:
                 with _telemetry.attribution(tenant.tid):
                     self._count_applied(tenant, args, n)
@@ -983,7 +1142,10 @@ class EvaluationService:
                 # the batch IS applied and journaled; a failing cadence
                 # (snapshot guard, compute refresh) takes the tenant's own
                 # crash path like the single-tenant route would
+                _spans.end_span(root, error=repr(err))
                 self._handle_tenant_crash(tenant, err)
+            else:
+                _spans.end_span(root, batches=tenant.batches, megabatch=True)
             finally:
                 self._finish_one(tenant)
 
@@ -996,13 +1158,14 @@ class EvaluationService:
         crashed batch is journaled first, exactly as the single path would
         have), so co-batched tenants are never quarantined for a neighbor's
         poison when their own buffers survived."""
-        for tenant, args, _n, _probe in members:
+        for tenant, args, _n, _probe, root in members:
             if _state_alive(tenant.state):
-                self._run_single(tenant, args)
+                self._run_single(tenant, args, root)
                 continue
             try:
                 if tenant.crash_policy == "restore":
                     tenant.journal.append(args)
+                _spans.end_span(root, error=repr(err))
                 with _telemetry.attribution(tenant.tid):
                     self._handle_tenant_crash(tenant, err)
             finally:
@@ -1049,11 +1212,13 @@ class EvaluationService:
                 return
             idx = -1
             try:
-                self._restore_for_crash(tenant)
-                idx = 0
-                while idx < len(pending):
-                    self._apply_batch(tenant, pending[idx])
-                    idx += 1
+                # span-less replay: these batches' traces ended at the crash
+                with _spans.suppress():
+                    self._restore_for_crash(tenant)
+                    idx = 0
+                    while idx < len(pending):
+                        self._apply_batch(tenant, pending[idx])
+                        idx += 1
             except TPUMetricsUserError as user_err:
                 # config/snapshot-level problems are not crash-loopable
                 self._quarantine(tenant, user_err)
@@ -1091,18 +1256,29 @@ class EvaluationService:
         with self._lock:
             tenant.error = err
             discarded = len(tenant.queue)
+            for _args, _n, _probe, (d_root, d_qspan) in tenant.queue:
+                _spans.end_span(d_qspan, quarantined=True)
+                _spans.end_span(d_root, error="discarded (tenant quarantined)")
             tenant.queue.clear()
             # discarded queued batches release their pending counts here; the
             # in-flight batch that crashed is finished by its own _finish_one
             tenant.pending -= discarded
             self._unmark_ready(tenant)
             self._quarantines += 1
+            _TENANTS_GAUGE.set(len(self._tenants) - self._quarantines, self._label)
             self._space.notify_all()
             self._done.notify_all()
         with _telemetry.attribution(tenant.tid):
             _telemetry.record_event(
                 self, "tenant_quarantined", error=repr(err), discarded=discarded
             )
+        # the quarantine fences this stream for good: dump the flight ring
+        # (when a recorder is installed) — its tail holds the poisoned
+        # batch's spans and the crash/quarantine events just recorded — and
+        # name the file in every TenantQuarantinedError this tenant raises
+        tenant.flight_path = _export.flight_dump(
+            "tenant_quarantined", err, tenant=tenant.tid, discarded=discarded
+        )
 
     # ------------------------------------------------------------ cadences
 
@@ -1115,7 +1291,8 @@ class EvaluationService:
             tenant.metric._computed = None  # the stream moves on
             degraded = bool(getattr(tenant.metric, "degraded", False))
         else:
-            value = tenant.step._metric.functional_compute(state)
+            with attribute_compiles(tenant.tid, None, token=tenant.step_token):
+                value = tenant.step._metric.functional_compute(state)
             with self._lock:
                 degraded = tenant.degraded
         with self._lock:

@@ -1,6 +1,6 @@
-"""``tpumetrics.telemetry`` — observability for the sync machinery.
+"""``tpumetrics.telemetry`` — the observability stack.
 
-Three parts (see ``docs/telemetry.md`` for the guide):
+Six parts (see ``docs/telemetry.md`` and ``docs/observability.md``):
 
 - **Collective ledger** (:mod:`~tpumetrics.telemetry.ledger`): every
   ``DistributedBackend.all_gather``/``all_reduce`` call and every
@@ -15,6 +15,21 @@ Three parts (see ``docs/telemetry.md`` for the guide):
   differing entry instead of deadlocking (ADVICE r5 #3).
 - **Sinks** (:mod:`~tpumetrics.telemetry.sinks`): pluggable record
   consumers — stdlib logging and JSON-lines.
+- **Spans** (:mod:`~tpumetrics.telemetry.spans`): where a batch's wall time
+  goes — one submitted batch = one trace, with child spans for queue wait,
+  scheduling delay, planning, device dispatch, and write-back.  Strictly
+  host-side, ring-buffered, near-zero cost when disabled.
+- **Instruments** (:mod:`~tpumetrics.telemetry.instruments`): process-global
+  counters, gauges, and fixed-bucket latency histograms cheap enough for the
+  submit path; ``stats()`` latency sections and the bench soak gate read
+  them.
+- **Export + flight recorder** (:mod:`~tpumetrics.telemetry.export`):
+  Prometheus text exposition, JSONL span/instrument dumps, and a bounded
+  ring of recent records that auto-dumps to a JSONL file on tenant
+  quarantine, dispatcher poison, and crash-loop exhaustion.
+- **XLA compile attribution** (:mod:`~tpumetrics.telemetry.xla`, lazy —
+  imports jax): every backend compile charged to the (tenant, step token,
+  trace signature) that triggered it, with a retrace detector.
 
 Quick start::
 
@@ -49,6 +64,20 @@ from tpumetrics.telemetry.ledger import (
     summary,
 )
 from tpumetrics.telemetry.sinks import JsonlSink, LoggingSink, TelemetrySink
+from tpumetrics.telemetry import instruments, spans
+from tpumetrics.telemetry import export
+from tpumetrics.telemetry.export import (
+    FlightRecorder,
+    disable_flight_recorder,
+    enable_flight_recorder,
+    flight_dump,
+    flight_recorder,
+    note_incident,
+    prometheus_text,
+    spans_jsonl,
+)
+from tpumetrics.telemetry.instruments import counter, gauge, histogram
+from tpumetrics.telemetry.spans import span, start_span, end_span, record_span
 
 # Lockstep names resolve lazily (PEP 562): lockstep.py pulls in
 # tpumetrics.utils (for the exception base class), whose distributed module
@@ -72,16 +101,40 @@ def __getattr__(name: str):
 
         mod = importlib.import_module("tpumetrics.telemetry.lockstep")
         return mod if name == "lockstep" else getattr(mod, name)
+    if name == "xla":
+        # lazy like lockstep: xla.py imports jax at module top, which the
+        # pure-AST analysis tooling must not pull in just to name the package
+        import importlib
+
+        return importlib.import_module("tpumetrics.telemetry.xla")
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "CollectiveLedger",
     "CollectiveRecord",
+    "FlightRecorder",
     "JsonlSink",
     "LockstepViolation",
     "LoggingSink",
     "TelemetrySink",
     "attribution",
+    "counter",
+    "disable_flight_recorder",
+    "enable_flight_recorder",
+    "end_span",
+    "export",
+    "flight_dump",
+    "flight_recorder",
+    "gauge",
+    "histogram",
+    "instruments",
+    "note_incident",
+    "prometheus_text",
+    "record_span",
+    "span",
+    "spans",
+    "spans_jsonl",
+    "start_span",
     "capture",
     "configure",
     "current_tag",

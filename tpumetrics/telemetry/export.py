@@ -1,0 +1,315 @@
+"""Export: Prometheus text exposition, JSONL dumps, and the flight recorder.
+
+Three ways observability data leaves the process:
+
+- :func:`prometheus_text` — the whole instruments registry (and, by
+  default, the global ledger's aggregates as derived families) in
+  Prometheus text exposition format, ready to serve from any ``/metrics``
+  handler.  A round-trip validator test parses what this emits, so the
+  exposition cannot silently drift from the format.
+- :func:`spans_jsonl` / :func:`instruments_jsonl` — machine-readable JSON
+  lines of the span ring / instrument registry.
+- The **flight recorder** — a bounded in-memory ring of the most recent
+  spans, ledger records, and incident marks that auto-dumps to a JSONL
+  file when the runtime hits a fatal seam (tenant quarantine, dispatcher
+  poison, crash-loop exhaustion); the raised error carries the dump path.
+  Think cockpit voice recorder: nobody reads it until something crashes,
+  and then the last N seconds are exactly what you need.
+
+The flight recorder is opt-in (:func:`enable_flight_recorder`); while
+enabled it installs itself as the span tracer's and ledger's forwarding
+hook, so it sees traffic even when nobody else is recording — the ring is
+the only cost (bounded, a few thousand dicts).  Dump files are JSON lines:
+one ``flight_header`` line (reason, error, counters), then the ring oldest
+→ newest, so the *tail* of the file is the most recent activity before the
+incident.  Every line carries a ``type`` field from a closed set — the
+JSONL round-trip validator test pins the schema.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import tempfile
+import threading
+from collections import deque
+from typing import IO, Any, Dict, Iterator, List, Optional, Union
+
+from tpumetrics.telemetry import instruments as _instruments
+from tpumetrics.telemetry import ledger as _ledger
+from tpumetrics.telemetry import spans as _spans
+
+__all__ = [
+    "FlightRecorder",
+    "disable_flight_recorder",
+    "enable_flight_recorder",
+    "flight_dump",
+    "flight_recorder",
+    "instruments_jsonl",
+    "note_incident",
+    "prometheus_text",
+    "spans_jsonl",
+]
+
+ENV_FLIGHT_DIR = "TPUMETRICS_FLIGHT_DIR"
+
+#: every JSONL line type a dump may contain (the round-trip validator and
+#: any replay tooling key off this closed set)
+FLIGHT_RECORD_TYPES = ("flight_header", "span", "ledger", "incident")
+
+
+# ------------------------------------------------------------ prometheus text
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    f = float(v)
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+def _fmt_labels(names: tuple, values: tuple, extra: Optional[Dict[str, str]] = None) -> str:
+    pairs = [(n, v) for n, v in zip(names, values)]
+    if extra:
+        pairs += list(extra.items())
+    if not pairs:
+        return ""
+    body = ",".join(
+        '%s="%s"' % (n, str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n"))
+        for n, v in pairs
+    )
+    return "{" + body + "}"
+
+
+def _prometheus_families() -> Iterator[str]:
+    for inst in _instruments.registry():
+        if inst.help:
+            yield f"# HELP {inst.name} {inst.help}"
+        yield f"# TYPE {inst.name} {inst.kind}"
+        if inst.kind == "histogram":
+            for lv, data in inst.collect():
+                cum = 0
+                for edge, c in data["buckets"]:
+                    cum += c
+                    yield (
+                        f"{inst.name}_bucket"
+                        f"{_fmt_labels(inst.labelnames, lv, {'le': _fmt_value(edge)})} {cum}"
+                    )
+                cum += data["overflow"]
+                yield (
+                    f"{inst.name}_bucket"
+                    f"{_fmt_labels(inst.labelnames, lv, {'le': '+Inf'})} {cum}"
+                )
+                yield f"{inst.name}_sum{_fmt_labels(inst.labelnames, lv)} {_fmt_value(data['sum'])}"
+                yield f"{inst.name}_count{_fmt_labels(inst.labelnames, lv)} {data['count']}"
+        else:
+            for lv, value in inst.collect():
+                yield f"{inst.name}{_fmt_labels(inst.labelnames, lv)} {_fmt_value(value)}"
+
+
+def _ledger_families() -> Iterator[str]:
+    summ = _ledger.summary()
+    yield "# TYPE tpumetrics_ledger_events_total counter"
+    for kind in sorted(summ["counts_by_kind"]):
+        yield (
+            f"tpumetrics_ledger_events_total{_fmt_labels(('kind',), (kind,))} "
+            f"{summ['counts_by_kind'][kind]}"
+        )
+    yield "# TYPE tpumetrics_ledger_collectives_total counter"
+    yield f"tpumetrics_ledger_collectives_total {summ['collectives_issued']}"
+    yield "# TYPE tpumetrics_ledger_wire_bytes_total counter"
+    yield f"tpumetrics_ledger_wire_bytes_total {_fmt_value(summ['wire_bytes_total'])}"
+
+
+def prometheus_text(include_ledger: bool = True) -> str:
+    """The instruments registry (+ ledger aggregates) in Prometheus text
+    exposition format.  The ledger's aggregate counters are exported as
+    derived families (``tpumetrics_ledger_events_total{kind=…}`` etc.) —
+    views over the same numbers ``telemetry.summary()`` reports, so one
+    scrape covers both layers."""
+    lines = list(_prometheus_families())
+    if include_ledger:
+        lines.extend(_ledger_families())
+    return "\n".join(lines) + "\n"
+
+
+# -------------------------------------------------------------- JSONL dumps
+
+
+def _open_target(target: Union[str, IO[str]]):
+    if isinstance(target, str):
+        return open(target, "w"), True
+    return target, False
+
+
+def spans_jsonl(target: Union[str, IO[str]], span_list: Optional[List[Any]] = None) -> int:
+    """Write spans (default: the current ring) as JSON lines; returns the
+    line count."""
+    if span_list is None:
+        span_list = _spans.spans()
+    fh, owns = _open_target(target)
+    try:
+        n = 0
+        for sp in span_list:
+            fh.write(json.dumps(sp.to_dict(), sort_keys=True, default=repr) + "\n")
+            n += 1
+        return n
+    finally:
+        if owns:
+            fh.close()
+
+
+def instruments_jsonl(target: Union[str, IO[str]]) -> int:
+    """Write every registered instrument (name, labels, series) as JSON
+    lines; returns the line count."""
+    fh, owns = _open_target(target)
+    try:
+        n = 0
+        for inst in _instruments.registry():
+            fh.write(json.dumps(inst.to_dict(), sort_keys=True, default=repr) + "\n")
+            n += 1
+        return n
+    finally:
+        if owns:
+            fh.close()
+
+
+# ------------------------------------------------------------ flight recorder
+
+
+class FlightRecorder:
+    """Bounded ring of recent observability records, dumped on incidents.
+
+    While installed (:func:`enable_flight_recorder`) it receives every
+    finished span and every ledger record regardless of whether span
+    tracing or the ledger is otherwise enabled — the ring is cheap and the
+    whole point is having the last seconds of context when something dies
+    unobserved.  :meth:`dump` writes the ring to a JSONL file (oldest
+    first — the file's tail is the newest activity) and returns the path,
+    which the runtime splices into the raised error's message.
+    """
+
+    def __init__(self, directory: str, capacity: int = 2048) -> None:
+        if int(capacity) <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self.directory = directory
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=int(capacity))
+        self._seq = 0
+        self._dumps = 0
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen  # type: ignore[return-value]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # ------------------------------------------------------------ recording
+
+    def _append(self, entry: Dict[str, Any]) -> None:
+        with self._lock:
+            self._seq += 1
+            entry["seq"] = self._seq
+            self._ring.append(entry)
+
+    def record_span(self, sp: Any) -> None:
+        self._append(sp.to_dict())
+
+    def record_ledger(self, rec: Any) -> None:
+        entry = rec.to_dict()
+        entry["type"] = "ledger"
+        self._append(entry)
+
+    def note(self, kind: str, **info: Any) -> None:
+        """Mark a non-fatal incident (a sync timeout, a fence) in the ring."""
+        self._append({"type": "incident", "kind": kind, **info})
+
+    def entries(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._ring]
+
+    # -------------------------------------------------------------- dumping
+
+    def dump(self, reason: str, error: Optional[BaseException] = None, **info: Any) -> str:
+        """Write the ring (oldest → newest) to a fresh JSONL file under
+        ``directory``; returns the path.  Names carry the pid and a
+        PROCESS-wide dump sequence (not per-recorder: re-enabling a
+        recorder over a fixed directory must never reuse a name and
+        silently overwrite an earlier incident's forensics)."""
+        with self._lock:
+            entries = [dict(e) for e in self._ring]
+            self._dumps += 1
+        n = next(_DUMP_IDS)
+        os.makedirs(self.directory, exist_ok=True)
+        path = os.path.join(
+            self.directory, f"flight-{os.getpid()}-{n:04d}-{reason}.jsonl"
+        )
+        header = {
+            "type": "flight_header",
+            "reason": reason,
+            "error": repr(error) if error is not None else None,
+            "entries": len(entries),
+            **info,
+        }
+        with open(path, "w") as fh:
+            fh.write(json.dumps(header, sort_keys=True, default=repr) + "\n")
+            for e in entries:
+                fh.write(json.dumps(e, sort_keys=True, default=repr) + "\n")
+        return path
+
+
+_RECORDER: Optional[FlightRecorder] = None
+#: process-wide dump numbering — survives recorder replacement, so a fixed
+#: $TPUMETRICS_FLIGHT_DIR accumulates incidents instead of overwriting them
+_DUMP_IDS = itertools.count(1)
+
+
+def flight_recorder() -> Optional[FlightRecorder]:
+    """The installed :class:`FlightRecorder`, or ``None``."""
+    return _RECORDER
+
+
+def enable_flight_recorder(
+    directory: Optional[str] = None, capacity: int = 2048
+) -> FlightRecorder:
+    """Install a flight recorder.  ``directory`` resolves: argument →
+    ``$TPUMETRICS_FLIGHT_DIR`` → a fresh temp directory.  Installs the span
+    and ledger forwarding hooks; idempotent reconfiguration replaces the
+    previous recorder (its ring is dropped, dump files stay)."""
+    global _RECORDER
+    directory = directory or os.environ.get(ENV_FLIGHT_DIR) or tempfile.mkdtemp(
+        prefix="tpumetrics-flight-"
+    )
+    rec = FlightRecorder(os.path.abspath(directory), capacity=capacity)
+    _RECORDER = rec
+    _spans._FLIGHT_HOOK = rec.record_span
+    _ledger._FLIGHT_HOOK = rec.record_ledger
+    return rec
+
+
+def disable_flight_recorder() -> None:
+    global _RECORDER
+    _RECORDER = None
+    _spans._FLIGHT_HOOK = None
+    _ledger._FLIGHT_HOOK = None
+
+
+def flight_dump(reason: str, error: Optional[BaseException] = None, **info: Any) -> Optional[str]:
+    """Dump the flight ring on a fatal incident; returns the file path, or
+    ``None`` when no recorder is installed (the runtime's call sites are
+    one ``is-None`` test when flight recording is off)."""
+    rec = _RECORDER
+    if rec is None:
+        return None
+    rec.note(reason, error=repr(error) if error is not None else None, **info)
+    return rec.dump(reason, error=error, **info)
+
+
+def note_incident(kind: str, **info: Any) -> None:
+    """Mark a non-fatal incident in the flight ring (no dump)."""
+    rec = _RECORDER
+    if rec is not None:
+        rec.note(kind, **info)

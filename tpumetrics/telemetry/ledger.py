@@ -195,6 +195,14 @@ class CollectiveLedger:
         elif rec.kind == "tenant_quarantined":
             # one tenant's crash was fenced off; the service kept serving
             self.tenant_quarantines += 1
+        elif rec.kind == "xla_compile":
+            # one attributed backend compile (telemetry/xla.py): the event
+            # carries tenant + seconds; the per-tenant histogram has the rest
+            self.xla_attributed_compiles += 1
+        elif rec.kind == "xla_retrace":
+            # a previously-seen (token, signature) compiled AGAIN — the jit
+            # executable cache should have served it (retrace detector)
+            self.xla_retraces += 1
         self.counts_by_kind[rec.kind] = self.counts_by_kind.get(rec.kind, 0) + 1
         for sink in self._sinks:
             sink.emit(rec)
@@ -225,6 +233,8 @@ class CollectiveLedger:
         self.megabatch_steps = 0
         self.megabatch_tenants = 0
         self.tenant_quarantines = 0
+        self.xla_attributed_compiles = 0
+        self.xla_retraces = 0
         self.spmd_collectives = 0
         self.spmd_wire_bytes = 0.0
         self.bytes_by_op: Dict[str, float] = {}
@@ -269,6 +279,8 @@ class CollectiveLedger:
             "megabatch_steps": self.megabatch_steps,
             "megabatch_tenants": self.megabatch_tenants,
             "tenant_quarantines": self.tenant_quarantines,
+            "xla_attributed_compiles": self.xla_attributed_compiles,
+            "xla_retraces": self.xla_retraces,
             "spmd_collectives": self.spmd_collectives,
             "spmd_wire_bytes": self.spmd_wire_bytes,
             "records": len(self.records),
@@ -284,6 +296,12 @@ _LEDGER = CollectiveLedger()
 _ACTIVE: List[CollectiveLedger] = []
 _ENABLED = False
 _LOCK = threading.Lock()
+
+#: installed by export.enable_flight_recorder(): every record additionally
+#: lands in the flight ring while a recorder is active, even when neither
+#: the global ledger nor a capture scope is recording — the crash dump must
+#: carry the last events regardless of who else was listening
+_FLIGHT_HOOK = None
 
 # attribution is a plain thread-local stack of tags; pushed around sync
 # collection so records name the metric/collection member they belong to
@@ -386,6 +404,9 @@ def current_tag() -> str:
 def _emit(rec: CollectiveRecord) -> None:
     if _ENABLED:
         _LEDGER.record(rec)
+    hook = _FLIGHT_HOOK
+    if hook is not None:
+        hook(rec)
     # the lock pairs with capture()'s remove-then-close: once a ledger is
     # removed under the lock, no emitter can still deliver to its sinks
     with _LOCK:
@@ -407,7 +428,7 @@ def record_collective(
     **extra: Any,
 ) -> None:
     """Report one collective.  First line is the disabled fast path."""
-    if not (_ENABLED or _ACTIVE):
+    if not (_ENABLED or _ACTIVE or _FLIGHT_HOOK is not None):
         return
     count = 1
     for d in shape:
@@ -438,7 +459,7 @@ def record_collective(
 
 def record_flush(backend: Any, entries: int, classes: int, in_trace: bool = False) -> None:
     """Report one :class:`FusedReducer` flush (bookkeeping only, no payload)."""
-    if not (_ENABLED or _ACTIVE):
+    if not (_ENABLED or _ACTIVE or _FLIGHT_HOOK is not None):
         return
     _emit(
         CollectiveRecord(
@@ -461,7 +482,7 @@ def record_flush(backend: Any, entries: int, classes: int, in_trace: bool = Fals
 
 def record_event(backend: Any, kind: str, in_trace: bool = False, **extra: Any) -> None:
     """Report a payload-free bookkeeping event (e.g. a lockstep fingerprint)."""
-    if not (_ENABLED or _ACTIVE):
+    if not (_ENABLED or _ACTIVE or _FLIGHT_HOOK is not None):
         return
     _emit(
         CollectiveRecord(

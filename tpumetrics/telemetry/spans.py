@@ -1,0 +1,433 @@
+"""Span tracing — where a batch's wall time goes, host-side only.
+
+The ledger (:mod:`~tpumetrics.telemetry.ledger`) counts *that* things
+happened (collectives, drops, crashes); spans record *where the time went*:
+one submitted batch = one **trace**, with child spans for every host-side
+seam the runtime drives it through — queue wait, DRR scheduling delay,
+bucket/pad planning, the device dispatch, and the state write-back.  The
+paper's contract ("no host sync until ``compute()``") means those seams are
+the only place the system may observe itself, so spans are **strictly
+host-side**: nothing here is ever called inside a ``jit`` trace (tpulint
+TPL104 enforces it for ``update()``-reachable metric code), and a span
+records wall time on the **monotonic** clock — immune to NTP steps.
+
+Design rules (the ``SyncPolicy`` inert-predicate discipline):
+
+- **Near-zero cost when disabled.**  Tracing is off by default; every public
+  entry point's first statement is one module-flag test.  A disabled
+  :func:`span` returns a shared singleton no-op context manager —
+  *no allocation per call* (pinned by test and benched as
+  ``observability_overhead``); a disabled :func:`start_span` returns ``None``
+  so queue entries carry a ``None`` instead of a span object.
+- **Bounded memory.**  Finished spans land in a ring (``deque(maxlen=…)``);
+  an unobserved long-running process evicts oldest-first and counts the
+  evictions instead of leaking.
+- **Thread-safe, cross-thread capable.**  Same-thread nesting rides a
+  thread-local context stack (:func:`span`); spans whose start and end live
+  on different threads (a batch enqueued on a request thread, drained on the
+  worker) use the explicit :func:`start_span`/:func:`end_span` pair, and a
+  worker adopts a batch's trace as its ambient parent with
+  :func:`activate`.  Retroactive measurements (a scheduling window timed
+  under a lock) record in one shot via :func:`record_span`.
+
+Quick start::
+
+    from tpumetrics.telemetry import spans
+
+    spans.enable()
+    with spans.span("plan", bucket=32):
+        ...
+    for s in spans.drain():
+        print(s.name, s.duration_ms, s.trace_id)
+
+Export: :func:`tpumetrics.telemetry.export.spans_jsonl` writes the ring as
+JSON lines; the flight recorder (:mod:`~tpumetrics.telemetry.export`)
+additionally receives every finished span while it is enabled, so a crash
+dump carries the poisoned batch's trace.  See ``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+__all__ = [
+    "Span",
+    "SpanTracer",
+    "activate",
+    "current",
+    "disable",
+    "drain",
+    "enable",
+    "enabled",
+    "end_span",
+    "get_tracer",
+    "record_span",
+    "reset",
+    "span",
+    "spans",
+    "start_span",
+    "start_trace",
+    "suppress",
+]
+
+_ENABLED = False
+#: monotonically increasing ids shared by traces and spans (itertools.count
+#: is effectively atomic under the GIL; ids only need process-uniqueness)
+_IDS = itertools.count(1)
+_CTX = threading.local()  # .stack: [(trace_id, span_id), ...] innermost last
+
+#: installed by export.enable_flight_recorder(): every finished span is
+#: forwarded here so crash dumps carry the recent traces even when nobody
+#: is polling the ring
+_FLIGHT_HOOK = None
+
+
+def _now_ns() -> int:
+    return time.monotonic_ns()
+
+
+class Span:
+    """One finished (or in-flight) host-side measurement.
+
+    ``trace_id`` groups every span of one logical unit of work (one
+    submitted batch); ``parent_id`` nests children under the root.  Times
+    are monotonic-clock nanoseconds — durations are exact, absolute epochs
+    are deliberately absent (compare spans only within one process).
+    """
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start_ns", "end_ns", "attrs", "thread")
+
+    def __init__(
+        self,
+        name: str,
+        trace_id: int,
+        span_id: int,
+        parent_id: Optional[int],
+        start_ns: int,
+        end_ns: Optional[int],
+        attrs: Dict[str, Any],
+    ) -> None:
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start_ns = start_ns
+        self.end_ns = end_ns
+        self.attrs = attrs
+        self.thread = threading.get_ident()
+
+    @property
+    def duration_ms(self) -> Optional[float]:
+        if self.end_ns is None:
+            return None
+        return (self.end_ns - self.start_ns) / 1e6
+
+    def context(self) -> Tuple[int, int]:
+        """The ``(trace_id, span_id)`` pair children parent under."""
+        return (self.trace_id, self.span_id)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "type": "span",
+            "name": self.name,
+            "trace": self.trace_id,
+            "span": self.span_id,
+            "parent": self.parent_id,
+            "start_ns": self.start_ns,
+            "end_ns": self.end_ns,
+            "duration_ms": self.duration_ms,
+            "thread": self.thread,
+            "attrs": dict(self.attrs),
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        dur = f"{self.duration_ms:.3f}ms" if self.end_ns is not None else "open"
+        return f"Span({self.name!r}, trace={self.trace_id}, {dur})"
+
+
+class SpanTracer:
+    """Thread-safe bounded ring of finished spans."""
+
+    def __init__(self, capacity: int = 4096) -> None:
+        if int(capacity) <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=int(capacity))
+        self.finished = 0  # lifetime count (ring may have evicted)
+        self.evicted = 0
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen  # type: ignore[return-value]
+
+    def record(self, sp: Span) -> None:
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.evicted += 1
+            self._ring.append(sp)
+            self.finished += 1
+        hook = _FLIGHT_HOOK
+        if hook is not None:
+            hook(sp)
+
+    def spans(self) -> List[Span]:
+        """Snapshot of the ring, oldest first."""
+        with self._lock:
+            return list(self._ring)
+
+    def drain(self) -> List[Span]:
+        """Snapshot AND clear the ring (lifetime counters kept)."""
+        with self._lock:
+            out = list(self._ring)
+            self._ring.clear()
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.finished = 0
+            self.evicted = 0
+
+
+_TRACER = SpanTracer()
+
+
+# ------------------------------------------------------------- module switch
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def enable(capacity: Optional[int] = None) -> None:
+    """Turn tracing on (optionally resizing the ring, which clears it)."""
+    global _ENABLED, _TRACER
+    if capacity is not None and capacity != _TRACER.capacity:
+        _TRACER = SpanTracer(capacity)
+    _ENABLED = True
+
+
+def disable() -> None:
+    global _ENABLED
+    _ENABLED = False
+
+
+def reset() -> None:
+    _TRACER.reset()
+
+
+def get_tracer() -> SpanTracer:
+    return _TRACER
+
+
+def spans() -> List[Span]:
+    """Snapshot of the finished-span ring, oldest first."""
+    return _TRACER.spans()
+
+
+def drain() -> List[Span]:
+    """Snapshot and clear the ring."""
+    return _TRACER.drain()
+
+
+# ---------------------------------------------------------- context plumbing
+
+
+def _stack() -> List[Tuple[int, int]]:
+    st = getattr(_CTX, "stack", None)
+    if st is None:
+        st = _CTX.stack = []
+    return st
+
+
+def _suppressed() -> bool:
+    return bool(getattr(_CTX, "suppress", 0))
+
+
+class _Suppression:
+    """Span-less mode for this thread (re-entrant): crash replays re-apply
+    batches whose traces already ended at the crash — child spans fired
+    during the replay would root fresh fragment traces, so the replay loop
+    suppresses them instead."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_Suppression":
+        _CTX.suppress = getattr(_CTX, "suppress", 0) + 1
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        _CTX.suppress -= 1
+        return False
+
+
+def suppress() -> _Suppression:
+    """Context manager: no spans are created on this thread inside the
+    ``with`` (even with tracing enabled).  Explicit ``end_span`` on spans
+    started OUTSIDE still records — suppression gates creation only."""
+    return _Suppression()
+
+
+def current() -> Optional[Tuple[int, int]]:
+    """The innermost active ``(trace_id, span_id)`` on this thread."""
+    st = getattr(_CTX, "stack", None)
+    return st[-1] if st else None
+
+
+def _resolve_parent(parent: Union[None, Span, Tuple[int, int]]) -> Tuple[int, Optional[int]]:
+    """(trace_id, parent_span_id) for a new span: explicit parent wins, then
+    the thread's current span, then a fresh trace."""
+    if parent is not None:
+        if isinstance(parent, Span):
+            return parent.trace_id, parent.span_id
+        return int(parent[0]), int(parent[1])
+    cur = current()
+    if cur is not None:
+        return cur[0], cur[1]
+    return next(_IDS), None
+
+
+class _NullSpan:
+    """Shared no-op stand-in for every disabled-path context manager: one
+    module-lifetime instance, so a disabled ``span()`` allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+    def set(self, **attrs: Any) -> "_NullSpan":
+        return self
+
+
+_NULL = _NullSpan()
+
+
+class _ActiveSpan:
+    """Same-thread span context manager (returned by :func:`span`)."""
+
+    __slots__ = ("span",)
+
+    def __init__(self, name: str, attrs: Dict[str, Any]) -> None:
+        tid, pid = _resolve_parent(None)
+        sp = Span(name, tid, next(_IDS), pid, _now_ns(), None, attrs)
+        self.span = sp
+        _stack().append((tid, sp.span_id))
+
+    def __enter__(self) -> "_ActiveSpan":
+        return self
+
+    def set(self, **attrs: Any) -> "_ActiveSpan":
+        self.span.attrs.update(attrs)
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
+        _stack().pop()
+        sp = self.span
+        sp.end_ns = _now_ns()
+        if exc_type is not None:
+            sp.attrs.setdefault("error", f"{exc_type.__name__}: {exc}")
+        _TRACER.record(sp)
+        return False
+
+
+class _Activation:
+    """Adopt an explicit span context as this thread's ambient parent (the
+    worker thread nesting its child spans under a batch's root span)."""
+
+    __slots__ = ()
+
+    def __init__(self, ctx: Tuple[int, int]) -> None:
+        _stack().append((int(ctx[0]), int(ctx[1])))
+
+    def __enter__(self) -> "_Activation":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        _stack().pop()
+        return False
+
+
+# ------------------------------------------------------------------- the API
+
+
+def span(name: str, **attrs: Any) -> Union[_NullSpan, _ActiveSpan]:
+    """Context manager measuring one same-thread operation::
+
+        with spans.span("dispatch", bucket=32):
+            state = program(state, batch)
+
+    Nests under the thread's current span (or an :func:`activate`-d batch
+    context); with no ambient context it roots a fresh trace.  Disabled:
+    returns the shared no-op singleton — no allocation."""
+    if not _ENABLED or _suppressed():
+        return _NULL
+    return _ActiveSpan(name, attrs)
+
+
+def start_trace(name: str, **attrs: Any) -> Optional[Span]:
+    """Start a ROOT span for a fresh trace, regardless of any ambient span
+    on this thread — "one batch = one trace" is anchored here.  Returns the
+    open root (``None`` when disabled); finish with :func:`end_span`."""
+    if not _ENABLED or _suppressed():
+        return None
+    return Span(name, next(_IDS), next(_IDS), None, _now_ns(), None, dict(attrs))
+
+
+def start_span(
+    name: str, parent: Union[None, Span, Tuple[int, int]] = None, **attrs: Any
+) -> Optional[Span]:
+    """Explicitly start a span whose end may happen on another thread (the
+    queue-wait span: started at submit, ended at the worker's pop).  Returns
+    the open :class:`Span` handle, or ``None`` when tracing is disabled —
+    pass the handle wherever the work travels and finish it with
+    :func:`end_span`.  Does NOT touch the thread-local context stack."""
+    if not _ENABLED or _suppressed():
+        return None
+    tid, pid = _resolve_parent(parent)
+    return Span(name, tid, next(_IDS), pid, _now_ns(), None, dict(attrs))
+
+
+def end_span(sp: Optional[Span], **attrs: Any) -> None:
+    """Finish a :func:`start_span` handle (``None``-safe: the disabled path
+    hands ``None`` around and this is then a no-op)."""
+    if sp is None or sp.end_ns is not None:
+        return
+    sp.end_ns = _now_ns()
+    if attrs:
+        sp.attrs.update(attrs)
+    _TRACER.record(sp)
+
+
+def record_span(
+    name: str,
+    start_ns: int,
+    end_ns: int,
+    parent: Union[None, Span, Tuple[int, int]] = None,
+    **attrs: Any,
+) -> None:
+    """Record a retroactive span in one shot — for windows measured under a
+    lock where opening a live span would be awkward (the DRR scheduling
+    delay, a megabatch group's shared dispatch)."""
+    if not _ENABLED or _suppressed():
+        return
+    tid, pid = _resolve_parent(parent)
+    _TRACER.record(Span(name, tid, next(_IDS), pid, int(start_ns), int(end_ns), dict(attrs)))
+
+
+def activate(ctx: Union[None, Span, Tuple[int, int]]) -> Union[_NullSpan, _Activation]:
+    """Make ``ctx`` (a Span or ``(trace_id, span_id)``) the ambient parent
+    for :func:`span` calls on this thread — the worker adopting a batch's
+    root span.  ``None`` (or disabled tracing) is the no-op singleton."""
+    if not _ENABLED or ctx is None:
+        return _NULL
+    if isinstance(ctx, Span):
+        ctx = ctx.context()
+    return _Activation(ctx)

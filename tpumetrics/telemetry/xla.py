@@ -1,0 +1,338 @@
+"""XLA compile attribution — who paid for every compile, and retraces.
+
+``jax.monitoring`` reports each backend compile (and persistent-cache
+retrieval) as anonymous process-global events; this module — grown from the
+listener machinery ``tpumetrics/runtime/compile_cache.py`` introduced for
+cache-hit accounting, which now lives here — turns them into *attributed*
+records: every XLA compile is charged to the ``(tenant, step token,
+trace signature)`` that triggered it.
+
+How attribution works: the runtime knows exactly when it is about to
+dispatch a **cold** trace signature (the evaluator/service pre-compile path,
+``SignatureRegistry.observe`` returning True); it pushes an attribution
+context for the dispatch, and the duration listener charges any compile
+event that fires on that thread to the context.  Compiles with no context
+(a user's own jit, a warm-up ``jnp`` op) are attributed to
+``"<unattributed>"`` — visible, never silently dropped.
+:class:`~tpumetrics.parallel.fuse_update.FusedCollectionStep` additionally
+installs a *fallback* context naming the step and program key, so the OO
+fused path (no evaluator involved) still attributes its compiles.
+
+**Retrace detection**: a ``(token, signature)`` pair that compiles a second
+time in one process is a retrace — the jit executable cache should have
+served it, so something invalidated it (a new program object per call, a
+donation-mode flip, an unhashable-kwarg fallback rebuilding steps).  Each
+retrace warns once per key, emits an ``xla_retrace`` ledger event, and
+bumps the ``tpumetrics_recompiles_total{tenant}`` counter that
+``stats()["recompiles"]`` reads.  Note the persistent compile cache
+(``compile_cache.py``) makes a *cold process's* compile cheap but still
+fires the compile event — a cache-served compile is attributed like any
+other (its near-zero ``seconds`` tells them apart).
+
+Everything here is host-side and off by default:
+:func:`enable_compile_attribution` registers the (single, module-lifetime)
+listener pair and arms the context checks; disabled, an attribution context
+manager is the shared no-op singleton.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+
+from tpumetrics.telemetry import instruments as _instruments
+from tpumetrics.telemetry import ledger as _ledger
+
+__all__ = [
+    "attribute_compiles",
+    "attribution_enabled",
+    "compile_records",
+    "count_cache_hits",
+    "disable_compile_attribution",
+    "enable_compile_attribution",
+    "fallback_attribution",
+    "recompile_count",
+    "release_attribution",
+    "reset_compile_attribution",
+]
+
+# jax wraps compile-OR-cache-load in this one duration event; the hit path
+# additionally reports its retrieval time separately, so true compile
+# seconds = backend_compile - cache_retrieval
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_RETRIEVAL_EVENT = "/jax/compilation_cache/cache_retrieval_time_sec"
+
+# jax.monitoring has no unregister API, so exactly ONE listener pair is ever
+# registered (lazily, at the first count_cache_hits/attribution use); the
+# hit-counting context manager pushes its counter dict here and pops it on
+# exit, so repeated/nested use adds nothing to jax's global listener list
+_active_counters: List[Dict[str, Any]] = []
+_listeners_registered = False
+_REG_LOCK = threading.Lock()
+
+_ATTRIB_ENABLED = False
+_CTX = threading.local()  # .stack: [(tenant, token, signature, activation), ...]
+_LOCK = threading.Lock()
+#: (token, signature) -> id of the ACTIVATION whose dispatch first compiled
+#: it.  One activation (one `with attribute_compiles(...)` entry) may fire
+#: several backend-compile events — the jitted program plus the small eager
+#: helper ops (state copies, casts) XLA also compiles the first time a shape
+#: appears — and none of those are retraces; a compile event for a known key
+#: in a LATER activation is (the jit executable cache should have served it).
+_seen_keys: Dict[Tuple[Any, Any], int] = {}
+_warned_keys: set = set()
+_records: deque = deque(maxlen=4096)
+_ACTIVATIONS = itertools.count(1)
+
+# ONE registration site for the attribution instruments (the name/help/
+# labels/buckets tuple is a registry contract — duplicating it at call
+# sites invites silent drift or a runtime mismatch error)
+_COMPILE_HIST = _instruments.histogram(
+    _instruments.XLA_COMPILE_SECONDS,
+    help="attributed XLA backend-compile seconds",
+    labels=("tenant",),
+    buckets=_instruments.DEFAULT_S_BUCKETS,
+)
+_RECOMPILES = _instruments.counter(
+    _instruments.RECOMPILES_TOTAL,
+    help="compiles of a previously-seen trace signature",
+    labels=("tenant",),
+)
+
+
+def _ensure_listeners() -> None:
+    global _listeners_registered
+    with _REG_LOCK:
+        if _listeners_registered:
+            return
+        jax.monitoring.register_event_listener(_event_listener)
+        jax.monitoring.register_event_duration_secs_listener(_duration_listener)
+        _listeners_registered = True
+
+
+def _event_listener(event: str, **_kwargs: Any) -> None:
+    for counter in _active_counters:
+        if event == "/jax/compilation_cache/cache_hits":
+            counter["hits"] += 1
+        elif event == "/jax/compilation_cache/cache_misses":
+            counter["misses"] += 1
+
+
+def _duration_listener(event: str, duration: float, **_kwargs: Any) -> None:
+    for counter in _active_counters:
+        if event == _BACKEND_COMPILE_EVENT:
+            counter["backend_compile_secs"] += float(duration)
+        elif event == _CACHE_RETRIEVAL_EVENT:
+            counter["cache_retrieval_secs"] += float(duration)
+    if _ATTRIB_ENABLED and event == _BACKEND_COMPILE_EVENT:
+        _attribute(float(duration))
+
+
+# ------------------------------------------------------------- attribution
+
+
+def attribution_enabled() -> bool:
+    return _ATTRIB_ENABLED
+
+
+def enable_compile_attribution() -> None:
+    """Arm compile attribution (registers the listener pair on first use)."""
+    global _ATTRIB_ENABLED
+    _ensure_listeners()
+    _ATTRIB_ENABLED = True
+
+
+def disable_compile_attribution() -> None:
+    global _ATTRIB_ENABLED
+    _ATTRIB_ENABLED = False
+
+
+def reset_compile_attribution() -> None:
+    """Clear the attribution records and the seen/warned signature sets."""
+    with _LOCK:
+        _seen_keys.clear()
+        _warned_keys.clear()
+        _records.clear()
+
+
+def _ctx_stack() -> List[Tuple[str, Any, Any]]:
+    st = getattr(_CTX, "stack", None)
+    if st is None:
+        st = _CTX.stack = []
+    return st
+
+
+class _NullCtx:
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullCtx":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+
+_NULL = _NullCtx()
+
+
+class _AttribCtx:
+    """Pushes on ``__enter__`` (not construction) so one context object can
+    guard several dispatches of the same attributed program; each entry is
+    a fresh *activation* (the retrace detector's unit of innocence)."""
+
+    __slots__ = ("_entry",)
+
+    def __init__(self, entry: Tuple[str, Any, Any]) -> None:
+        self._entry = entry
+
+    def __enter__(self) -> "_AttribCtx":
+        _ctx_stack().append(self._entry + (next(_ACTIVATIONS),))
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        _ctx_stack().pop()
+        return False
+
+
+def attribute_compiles(tenant: str, signature: Any, token: Any = None):
+    """Context manager charging any XLA compile fired on this thread inside
+    the ``with`` to ``(tenant, token, signature)``.  ``signature`` must be
+    hashable (the runtime's trace signatures are); ``token`` namespaces it
+    (the service's step token, the evaluator's stream label).  No-op
+    singleton when attribution is disabled."""
+    if not _ATTRIB_ENABLED:
+        return _NULL
+    return _AttribCtx((str(tenant), token, signature))
+
+
+def fallback_attribution(signature: Any, label: str = "") -> Any:
+    """Like :func:`attribute_compiles` but only engages when NO context is
+    already active — :class:`FusedCollectionStep` wraps its program
+    dispatches with this so OO-path compiles are attributed to the step
+    without overriding the runtime's richer (tenant, signature) context."""
+    if not _ATTRIB_ENABLED:
+        return _NULL
+    if _ctx_stack():
+        return _NULL
+    return _AttribCtx((label or "<step>", None, signature))
+
+
+def _attribute(seconds: float) -> None:
+    stack = getattr(_CTX, "stack", None)
+    tenant, token, sig, activation = (
+        stack[-1] if stack else ("<unattributed>", None, None, 0)
+    )
+    key = (token, sig)
+    with _LOCK:
+        first_act = _seen_keys.get(key) if sig is not None else None
+        retrace = first_act is not None and first_act != activation
+        if sig is not None and first_act is None:
+            _seen_keys[key] = activation
+        warn = retrace and key not in _warned_keys
+        if warn:
+            _warned_keys.add(key)
+        _records.append(
+            {
+                "tenant": tenant,
+                "token": repr(token) if token is not None else None,
+                "signature": repr(sig) if sig is not None else None,
+                "seconds": seconds,
+                "retrace": retrace,
+            }
+        )
+    _COMPILE_HIST.observe(seconds, tenant)
+    _ledger.record_event(
+        None, "xla_compile", tenant=tenant, seconds=round(seconds, 6), retrace=retrace
+    )
+    if retrace:
+        _RECOMPILES.inc(1, tenant)
+        _ledger.record_event(None, "xla_retrace", tenant=tenant, seconds=round(seconds, 6))
+        if warn:
+            from tpumetrics.utils.prints import rank_zero_warn
+
+            rank_zero_warn(
+                f"XLA recompiled a previously-seen trace signature for tenant "
+                f"{tenant!r} (signature {sig!r}): the jit executable cache should "
+                "have served it. Common causes: a fused step rebuilt per call, a "
+                "donation-mode flip, or per-batch-varying static kwargs."
+            )
+
+
+def release_attribution(tenant: str, tokens: Sequence[Any] = ()) -> None:
+    """Drop one stream/tenant's attribution state: its label series from
+    the XLA instruments and the retrace-detector keys under its ``tokens``
+    (a closed stream's auto-minted labels must not live in the process
+    registry forever — the ``close()`` contract)."""
+    tenant = str(tenant)
+    _COMPILE_HIST.remove(tenant)
+    _RECOMPILES.remove(tenant)
+    if tokens:
+        token_set = set(tokens)
+        with _LOCK:
+            for key in [k for k in _seen_keys if k[0] in token_set]:
+                del _seen_keys[key]
+            _warned_keys.difference_update(
+                k for k in list(_warned_keys) if k[0] in token_set
+            )
+
+
+def compile_records() -> List[Dict[str, Any]]:
+    """Snapshot of the attributed-compile ring (oldest first): one dict per
+    backend compile with tenant/token/signature/seconds/retrace."""
+    with _LOCK:
+        return [dict(r) for r in _records]
+
+
+def recompile_count(tenant: Optional[str] = None) -> int:
+    """Retrace count (for one tenant label, or total)."""
+    if tenant is None:
+        return int(_RECOMPILES.value())
+    return int(_RECOMPILES.value(str(tenant)))
+
+
+# ------------------------------------------------------- cache-hit counting
+
+
+from contextlib import contextmanager  # noqa: E402  (single consumer below)
+
+
+@contextmanager
+def count_cache_hits() -> Iterator[Dict[str, Any]]:
+    """Count persistent-cache hits/misses and accumulate backend compile
+    seconds inside the ``with`` block via JAX's monitoring events — the
+    observable proof that a restarted or elastically resized process REUSED
+    executables instead of recompiling::
+
+        with count_cache_hits() as hits:
+            evaluator.restore_elastic()
+            ... resume streaming ...
+        assert hits["hits"] > 0 and hits["misses"] == 0
+
+    ``hits["backend_compile_secs"]`` sums jax's backend-compile duration
+    event.  That event times compile-OR-cache-load, so a cache hit still
+    contributes its (much cheaper) executable deserialization;
+    ``hits["cache_retrieval_secs"]`` sums exactly that part, making
+    ``backend_compile_secs - cache_retrieval_secs`` the true XLA compile
+    seconds paid — near zero for a fully warm process, while tracing and
+    dispatch time (which no cache can remove) still show up in wall time.
+
+    Safe to use repeatedly (or nested) in a long-lived process: one module
+    listener pair is registered once and dispatches to the counters of the
+    currently active ``with`` blocks only.
+    """
+    counter: Dict[str, Any] = {
+        "hits": 0,
+        "misses": 0,
+        "backend_compile_secs": 0.0,
+        "cache_retrieval_secs": 0.0,
+    }
+    _ensure_listeners()
+    _active_counters.append(counter)
+    try:
+        yield counter
+    finally:
+        _active_counters.remove(counter)
