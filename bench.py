@@ -1881,6 +1881,107 @@ def _enable_compilation_cache() -> None:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
+def _bench_monitoring_window():
+    """Windowed monitoring (ring-of-subwindow-states) vs the naive
+    recompute-from-CatMetric baseline over an identical unbounded-style
+    stream.
+
+    The serving pattern: every step ingests one batch AND reads the current
+    window aggregate.  Without windows the only exact option is a CatMetric
+    history + recompute over the concatenated tail — O(window · rows) device
+    work per step on state that never stops growing.  The windowed
+    aggregator folds the batch into one ring slot (O(rows)) and computes
+    from ``slots`` partials (O(slots)); ``vs_baseline`` = naive / windowed.
+
+    In-scenario asserts: windowed reads match the naive tail recompute
+    (parity), the windowed state stays fixed-shape, and the whole stream
+    runs through ONE compiled step (no per-position retrace).  The ceiling
+    ``monitoring_ceilings.sketch_update_ns_per_row`` separately pins the
+    quantile sketch's scatter-add ingest cost (the drift/quantile hot path).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpumetrics.monitoring import SketchQuantiles, WindowedMean
+    from tpumetrics.utils.data import dim_zero_cat
+
+    W, ROWS, STEPS = 64, 128, 150
+    rng = np.random.default_rng(17)
+    stream = [
+        jnp.asarray(rng.normal(2.0, 1.0, ROWS).astype(np.float32)) for _ in range(STEPS)
+    ]
+    jax.block_until_ready(stream[-1])
+
+    metric = WindowedMean(window=W, slots=W)
+    step = jax.jit(lambda s, v: metric.functional_update(s, v))
+    read = jax.jit(
+        lambda s: jnp.sum(s["slot_sum"]) / jnp.sum(s["slot_weight"])
+    )
+
+    def windowed_once():
+        state = metric.init_state()
+        t0 = time.perf_counter()
+        vals = []
+        for b in stream:
+            state = step(state, b)
+            vals.append(read(state))
+        jax.block_until_ready(vals[-1])
+        return (time.perf_counter() - t0) * 1e6, vals, state
+
+    naive_read = jax.jit(lambda rows: jnp.mean(rows))
+
+    def naive_once():
+        history = []  # the CatMetric pattern: keep everything, slice the tail
+        t0 = time.perf_counter()
+        vals = []
+        for b in stream:
+            history.append(b)
+            vals.append(naive_read(dim_zero_cat(history[-W:])))
+        jax.block_until_ready(vals[-1])
+        return (time.perf_counter() - t0) * 1e6, vals
+
+    w_times, n_times = [], []
+    w_vals = n_vals = None
+    state = None
+    for _ in range(3):
+        us, w_vals, state = windowed_once()
+        w_times.append(us)
+        us, n_vals = naive_once()
+        n_times.append(us)
+    ours, ref = min(w_times), min(n_times)
+
+    # parity: every windowed read equals the naive tail recompute
+    w_arr = np.asarray(jax.device_get(jnp.stack(w_vals)))
+    n_arr = np.asarray(jax.device_get(jnp.stack(n_vals)))
+    assert np.allclose(w_arr, n_arr, rtol=1e-5), "windowed reads drifted from naive tail"
+    assert state["slot_sum"].shape == (W,), "windowed state must stay fixed-shape"
+    assert step._cache_size() == 1, f"windowed step retraced: {step._cache_size()} programs"
+
+    # sketch ingest ceiling: ns/row through the jitted sketch update
+    sk = SketchQuantiles(quantiles=(0.5, 0.99))
+    sk_step = jax.jit(lambda s, v: sk.functional_update(s, v))
+    sk_state = sk.init_state()
+    sk_state = sk_step(sk_state, stream[0])  # compile
+    jax.block_until_ready(jax.tree_util.tree_leaves(sk_state))
+    t0 = time.perf_counter()
+    for b in stream:
+        sk_state = sk_step(sk_state, b)
+    jax.block_until_ready(jax.tree_util.tree_leaves(sk_state))
+    sketch_ns_per_row = (time.perf_counter() - t0) * 1e9 / (STEPS * ROWS)
+
+    extras = {
+        "window": W,
+        "rows_per_step": ROWS,
+        "windowed_us_per_step": ours / STEPS,
+        "naive_us_per_step": ref / STEPS,
+        "sketch_update_ns_per_row": sketch_ns_per_row,
+        "windowed_compiles": step._cache_size(),
+        "parity_ok": True,
+    }
+    return ours, ref, {"extras": extras}
+
+
 def _check_floors(headline_vs, details):
     """Regression gate (VERDICT r4 weak #4): per-config vs_baseline floors
     live in bench_floors.json; any measured ratio below its floor is a loud
@@ -1956,6 +2057,11 @@ def _check_floors(headline_vs, details):
     # device->host transfers the same way)
     for key, ceiling in gate.get("sharded_collection_ceilings", {}).items():
         check_ceiling("sharded_collection_8dev", key, ceiling, fail_on_error=True)
+    # monitoring ceilings: the quantile sketch's scatter-add ingest must stay
+    # cheap per row (the drift/quantile hot path; an errored scenario also
+    # trips the gate — its parity/no-retrace asserts never ran)
+    for key, ceiling in gate.get("monitoring_ceilings", {}).items():
+        check_ceiling("monitoring_window", key, ceiling, fail_on_error=True)
     return violations
 
 
@@ -1986,6 +2092,7 @@ def main() -> None:
         ("resilience_overhead", _bench_resilience_overhead),
         ("observability_overhead", _bench_observability_overhead),
         ("elastic_restore", _bench_elastic_restore),
+        ("monitoring_window", _bench_monitoring_window),
         ("analysis_runtime", _bench_analysis_runtime),
     ):
         try:

@@ -591,6 +591,123 @@ def test_stale_partition_rule_candidates_not_cached_across_indexes():
         assert _codes(analyze_source(other)) == ["TPL304", "TPL304"]
 
 
+# ------------------------------------------------ TPL301 for callable merges
+CALLABLE_MERGE_TP = _src(
+    """
+    import jax.numpy as jnp
+    from tpumetrics.metric import Metric
+    from tpumetrics.monitoring.sketch import sketch_merge, SketchLayout
+
+    def my_merge(stacked):
+        return stacked.sum(0)
+
+    class PreSeededSketch(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("sketch", default=jnp.ones((64,)), dist_reduce_fx=my_merge)
+            self.add_state("prior", default=[jnp.ones(3)], dist_reduce_fx=my_merge)
+
+        def update(self, x):
+            self.sketch = self.sketch + x
+
+        def compute(self):
+            return self.sketch
+    """
+)
+
+CALLABLE_MERGE_NEAR_MISS = _src(
+    """
+    import jax.numpy as jnp
+    from tpumetrics.metric import Metric
+    from tpumetrics.monitoring.sketch import empty_sketch, sketch_merge, SketchLayout
+
+    def my_merge(stacked):
+        return stacked.sum(0)
+
+    class GoodSketch(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            layout = SketchLayout(levels=4, capacity=8)
+            # the merge identity: an EMPTY sketch (undecidable-but-named
+            # constructor) and literal zeros both pass
+            self.add_state("sketch", default=empty_sketch(layout, 1),
+                           dist_reduce_fx=sketch_merge(layout))
+            self.add_state("acc", default=jnp.zeros((8,)), dist_reduce_fx=my_merge)
+            # dynamic defaults stay undecidable (the stat-scores idiom)
+            d = jnp.zeros(())
+            self.add_state("dyn", default=d, dist_reduce_fx=my_merge)
+            # +/-inf IS the identity of an extremum-style merge (and of a
+            # variable-held "max"/"min" string reduce): must stay quiet
+            self.add_state("peak", default=-jnp.asarray(jnp.inf), dist_reduce_fx=my_merge)
+
+        def update(self, x):
+            self.sketch = self.sketch + x
+
+        def compute(self):
+            return self.sketch
+    """
+)
+
+
+def test_callable_merge_non_identity_default_is_tpl301():
+    """A callable dist_reduce_fx (the merge state kind) with a provably
+    non-identity default — ones, a pre-seeded list — is TPL301."""
+    found = analyze_source(CALLABLE_MERGE_TP)
+    assert _codes(found) == ["TPL301", "TPL301"]
+    assert "merge" in found[0].message
+
+
+def test_callable_merge_identity_default_near_miss_negative():
+    """empty_sketch(...) defaults, literal zeros, ±inf (an extremum-merge
+    identity), and dynamic defaults under a callable merge must all pass —
+    and TPL303 must NOT fire (the state has a reduce, it is not a gather
+    stack)."""
+    assert _codes(analyze_source(CALLABLE_MERGE_NEAR_MISS)) == []
+
+
+# ----------------------------------------------------------------- TPL305
+DYNAMIC_WINDOW_TP = _src(
+    """
+    from tpumetrics.monitoring import SketchQuantiles, WindowedMean
+
+    def build(xs, cfg):
+        a = WindowedMean(window=int(xs.mean()))   # call: data-dependent
+        b = WindowedMean(window=xs.shape[0])      # subscript
+        c = SketchQuantiles(window=2.5)           # float literal
+        d = WindowedMean(64, slots=len(xs))       # dynamic slots
+        return a, b, c, d
+    """
+)
+
+DYNAMIC_WINDOW_NEAR_MISS = _src(
+    """
+    from tpumetrics.monitoring import SketchQuantiles, WindowedMean
+    from tpumetrics import monitoring
+
+    WINDOW = 64
+
+    def build(cfg):
+        a = WindowedMean(window=64)                 # literal
+        b = WindowedMean(window=WINDOW)             # module constant: undecidable
+        c = WindowedMean(window=cfg.window)         # attribute: undecidable
+        d = SketchQuantiles(window=None)            # unwindowed mode
+        e = monitoring.WindowedMean(32, slots=16)   # positional static
+        f = WindowedMean(window=4 * 16)             # constant arithmetic
+        return a, b, c, d, e, f
+    """
+)
+
+
+def test_dynamic_window_is_tpl305():
+    found = analyze_source(DYNAMIC_WINDOW_TP)
+    assert _codes(found) == ["TPL305", "TPL305", "TPL305", "TPL305"]
+    assert "static int" in found[0].message
+
+
+def test_static_window_near_miss_negative():
+    assert _codes(analyze_source(DYNAMIC_WINDOW_NEAR_MISS)) == []
+
+
 # ------------------------------------------- sharding calls in the taint pass
 SHARDING_TAINT_NEAR_MISS = _src(
     """

@@ -25,6 +25,7 @@ def test_floor_file_shape():
         "resilience_overhead",
         "observability_overhead",
         "elastic_restore",
+        "monitoring_window",
     }
     # floors must sit below the recorded best (headroom for chip variance)
     for name, floor in data["floors"].items():
@@ -65,6 +66,11 @@ def test_floor_file_shape():
     # the always-on instruments to submit-path-cheap
     assert data["observability_overhead_ceilings"]["inert_span_ns_per_call"] > 0
     assert data["observability_overhead_ceilings"]["counter_ns_per_call"] > 0
+    # the windowed-monitoring path must clearly beat the CatMetric-history
+    # tail recompute (ISSUE 11 acceptance) and the sketch ingest must stay
+    # scatter-add-cheap per row
+    assert data["floors"]["monitoring_window"] >= 4.0
+    assert data["monitoring_ceilings"]["sketch_update_ns_per_row"] > 0
 
 
 def test_check_floors_flags_compile_regressions():
@@ -97,6 +103,25 @@ def test_check_floors_flags_multitenant_regressions():
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and all("multitenant_scaling" in v for v in violations)
     details["multitenant_scaling"] = "error: AssertionError: parity broke"
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and "scenario failed" in violations[0]
+
+
+def test_check_floors_flags_monitoring_regressions():
+    """A sketch ingest that blew past its ns/row ceiling (e.g. the scatter
+    falling off the jitted path) must trip the gate even at a healthy
+    windowed-vs-naive ratio; a ratio below the floor (an O(window) update or
+    a per-position retrace), and an errored scenario (the in-scenario
+    parity/no-retrace asserts never ran), trip it too."""
+    details = {"monitoring_window": {"vs_baseline": 50.0, "sketch_update_ns_per_row": 10**6}}
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("sketch_update_ns_per_row" in v for v in violations)
+    details["monitoring_window"]["sketch_update_ns_per_row"] = 300.0
+    assert bench._check_floors(headline_vs=1000.0, details=details) == []
+    details["monitoring_window"]["vs_baseline"] = 1.1  # below the 4.0 floor
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("monitoring_window" in v for v in violations)
+    details["monitoring_window"] = "error: AssertionError: parity drifted"
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and "scenario failed" in violations[0]
 

@@ -1,0 +1,840 @@
+"""tpumetrics.monitoring: windows, decay, sketches, drift — the online-
+monitoring workload class.
+
+Acceptance surface (ISSUE 11): windowed/decayed aggregators are exact and
+trace-safe under the bucketed/fused/megabatch runtime paths; the quantile
+sketch is a *mergeable* state kind (bit-identical under any fold order,
+resharded as sketch-on-rank0 + empties); a windowed stream killed mid-window
+and resized elastically computes bit-identically to an uninterrupted
+single-world run; drift monitors alert exactly once per threshold crossing,
+into the ledger, the Prometheus export, and ``stats()["monitoring"]``.
+"""
+
+from __future__ import annotations
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics import MetricCollection
+from tpumetrics.monitoring import (
+    DecayedMean,
+    KLDrift,
+    KSDistance,
+    PSI,
+    SketchLayout,
+    SketchQuantiles,
+    WindowedMax,
+    WindowedMean,
+    WindowedMin,
+    WindowedSum,
+    monitoring_stats,
+    stream_scope,
+)
+from tpumetrics.monitoring.sketch import sketch_merge
+from tpumetrics.parallel.backend import DistributedBackend
+from tpumetrics.parallel.fuse_update import FusedCollectionStep
+from tpumetrics.parallel.merge import (
+    AssociativeMerge,
+    merge_metric_states,
+    reshard_metric_states,
+)
+from tpumetrics.resilience import config_digest
+from tpumetrics.resilience import elastic as elastic_mod
+from tpumetrics.runtime import StreamingEvaluator
+from tpumetrics.runtime.service import EvaluationService
+from tpumetrics.runtime.snapshot import SnapshotSpecError
+from tpumetrics.telemetry import ledger
+from tpumetrics.telemetry.export import prometheus_text
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+
+# ------------------------------------------------------------------ windowed
+
+
+class TestWindowedAggregators:
+    def test_windowed_mean_matches_naive_recompute(self):
+        rng = np.random.default_rng(0)
+        m = WindowedMean(window=5)
+        history = []
+        for _ in range(17):
+            batch = rng.normal(0, 2, int(rng.integers(1, 9))).astype(np.float32)
+            history.append(batch)
+            m.update(jnp.asarray(batch))
+            recent = np.concatenate(history[-5:])
+            assert np.isclose(float(m.compute()), float(recent.mean()), rtol=1e-5)
+            m._computed = None
+
+    @pytest.mark.parametrize(
+        "cls,fold",
+        [(WindowedSum, np.sum), (WindowedMax, np.max), (WindowedMin, np.min)],
+    )
+    def test_windowed_extrema_and_sum_match_naive(self, cls, fold):
+        rng = np.random.default_rng(1)
+        m = cls(window=4)
+        history = []
+        for _ in range(11):
+            batch = rng.normal(0, 3, int(rng.integers(1, 6))).astype(np.float32)
+            history.append(batch)
+            m.update(jnp.asarray(batch))
+            want = float(fold(np.concatenate(history[-4:])))
+            assert np.isclose(float(m.compute()), want, rtol=1e-5)
+            m._computed = None
+
+    def test_coarse_slots_pane_semantics(self):
+        # window=4, slots=2 -> each slot covers 2 updates; after 5 updates
+        # the live window is updates 3..5 (the current pane is half full)
+        m = WindowedSum(window=4, slots=2)
+        for x in (1.0, 2.0, 4.0, 8.0, 16.0):
+            m.update(x)
+            m._computed = None
+        assert float(m.compute()) == 4.0 + 8.0 + 16.0
+
+    def test_weighted_windowed_mean(self):
+        m = WindowedMean(window=2)
+        m.update(jnp.asarray([1.0, 3.0]), weight=jnp.asarray([1.0, 3.0]))
+        m.update(2.0)
+        # (1*1 + 3*3 + 2) / (1 + 3 + 1)
+        assert float(m.compute()) == pytest.approx(12.0 / 5.0)
+
+    def test_valid_mask_is_exact(self):
+        m = WindowedMean(window=8)
+        padded = jnp.asarray([5.0, 7.0, 999.0, 999.0])
+        m.update(padded, valid=jnp.asarray([True, True, False, False]))
+        assert float(m.compute()) == 6.0
+        mx = WindowedMax(window=8)
+        mx.update(padded, valid=jnp.asarray([True, True, False, False]))
+        assert float(mx.compute()) == 7.0
+
+    def test_nan_ignored_by_default(self):
+        m = WindowedMean(window=4)
+        m.update(jnp.asarray([1.0, jnp.nan, 3.0]))
+        assert float(m.compute()) == 2.0
+
+    def test_eviction_is_one_slot_write(self):
+        # state shapes are (slots,) regardless of the data — eviction cannot
+        # be O(window * rows)
+        m = WindowedMean(window=1024, slots=8)
+        assert m.slot_sum.shape == (8,)
+        m.update(jnp.arange(16.0))
+        assert m.slot_sum.shape == (8,)
+
+    def test_window_must_be_static(self):
+        with pytest.raises(TPUMetricsUserError, match="static python int"):
+            WindowedMean(window=jnp.asarray(8))
+        with pytest.raises(TPUMetricsUserError, match="evenly"):
+            WindowedMean(window=6, slots=4)
+        with pytest.raises(TPUMetricsUserError, match=">= 1"):
+            WindowedSum(window=0)
+
+    def test_no_retrace_across_window_positions(self):
+        # the ring index is traced state: wrapping the window must not mint
+        # new trace signatures (fixed shapes -> one compiled step per shape)
+        m = WindowedMean(window=2)
+        step = jax.jit(lambda s, v: m.functional_update(s, v))
+        state = m.init_state()
+        for i in range(7):
+            state = step(state, jnp.full((4,), float(i)))
+        assert step._cache_size() == 1
+        assert float(m.functional_compute(state)) == pytest.approx((5.0 + 6.0) / 2)
+
+    def test_decayed_mean_recurrence(self):
+        m = DecayedMean(half_life=2)
+        alpha = 2.0 ** (-1 / 2)
+        s = w = 0.0
+        for x in (1.0, 5.0, 2.0, 8.0):
+            m.update(x)
+            s = s * alpha + x
+            w = w * alpha + 1.0
+        assert float(m.compute()) == pytest.approx(s / w, rel=1e-6)
+
+    def test_decayed_mean_half_life_semantics(self):
+        # an observation half_life updates old carries half the weight
+        m = DecayedMean(half_life=4)
+        m.update(0.0)
+        for _ in range(4):
+            m.update(1.0)
+        # weight of the first obs is 0.5 vs 1.0 for the latest
+        w = 2.0 ** (-np.arange(5) / 4.0)[::-1]
+        x = np.asarray([0.0, 1.0, 1.0, 1.0, 1.0])
+        assert float(m.compute()) == pytest.approx(float((w * x).sum() / w.sum()), rel=1e-5)
+
+
+# -------------------------------------------------------------------- sketch
+
+
+class TestSketch:
+    def test_merge_associative_commutative_bit_identical(self):
+        """Random split orders of the same data fold to BIT-identical
+        sketches — the contract that makes the sketch a dist_reduce_fx."""
+        rng = np.random.default_rng(2)
+        layout = SketchLayout(levels=16, capacity=32)
+        parts = [rng.normal(0, 3, 200).astype(np.float32) for _ in range(7)]
+        rows = []
+        for p in parts:
+            rows.append(
+                layout.update_row(layout.empty(1)[0], jnp.asarray(p), jnp.ones(p.shape))
+            )
+
+        def fold(order):
+            acc = rows[order[0]]
+            for i in order[1:]:
+                acc = layout.merge(jnp.stack([acc, rows[i]]))
+            return np.asarray(acc)
+
+        base = fold(list(range(7)))
+        rnd = random.Random(7)
+        for _ in range(12):
+            order = list(range(7))
+            rnd.shuffle(order)
+            assert np.array_equal(fold(order), base), order
+        # pairwise-tree fold too (associativity, not just permutations)
+        left = layout.merge(jnp.stack([rows[0], rows[1]]))
+        right = layout.merge(jnp.stack([rows[2], rows[3]]))
+        tree = layout.merge(jnp.stack([np.asarray(left), np.asarray(right)]))
+        flat = fold([0, 1, 2, 3])
+        assert np.array_equal(np.asarray(tree), flat)
+
+    @pytest.mark.parametrize(
+        "corpus",
+        [
+            lambda rng: rng.normal(5.0, 2.0, 20000),
+            lambda rng: rng.lognormal(0.0, 1.0, 20000),
+            lambda rng: rng.uniform(-3.0, 3.0, 20000),
+        ],
+        ids=["normal", "lognormal", "uniform_signed"],
+    )
+    def test_quantile_error_bound_vs_numpy(self, corpus):
+        rng = np.random.default_rng(3)
+        data = corpus(rng).astype(np.float32)
+        capacity = 128
+        m = SketchQuantiles(
+            quantiles=(0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99), capacity=capacity
+        )
+        m.update(jnp.asarray(data))
+        got = np.asarray(m.compute())
+        for q, est in zip(m.quantiles, got):
+            true = float(np.quantile(data, q))
+            # bucket midpoint: <= one bucket width (~2|x|/capacity in the
+            # geometric range), plus sub-unit absolute slack
+            tol = 3.0 * abs(true) / capacity + 2.0 * m.unit + 1e-3
+            assert abs(est - true) <= tol, (q, est, true, tol)
+
+    def test_min_max_are_exact_and_bound_the_estimates(self):
+        m = SketchQuantiles(quantiles=(0.0, 1.0))
+        m.update(jnp.asarray([3.25, -7.5, 0.125, 11.0]))
+        lo, hi = np.asarray(m.compute())
+        assert lo == -7.5 and hi == 11.0
+
+    def test_empty_sketch_computes_nan(self):
+        m = SketchQuantiles()
+        m._update_count = 1  # silence the pre-update warning; state is empty
+        assert np.isnan(np.asarray(m.compute())).all()
+
+    def test_windowed_sketch_evicts(self):
+        m = SketchQuantiles(quantiles=(0.5,), window=2, slots=2)
+        m.update(jnp.full((64,), 1000.0))
+        m.update(jnp.full((64,), 1.0))
+        m.update(jnp.full((64,), 2.0))  # the 1000s slide out
+        m._computed = None
+        est = float(np.asarray(m.compute()))
+        assert est <= 3.0
+
+    def test_sketch_counts_weighted_by_valid_mask(self):
+        m = SketchQuantiles(quantiles=(0.5,))
+        m.update(
+            jnp.asarray([2.0, 2.0, 900.0, 900.0]),
+            valid=jnp.asarray([True, True, False, False]),
+        )
+        layout = m._sketch_layout
+        assert float(layout.total(m.merged_row())) == 2.0
+        assert float(np.asarray(m.compute())) == pytest.approx(2.0, rel=1.0 / 64)
+
+    def test_geometry_must_be_static(self):
+        with pytest.raises(TPUMetricsUserError, match="static python int"):
+            SketchQuantiles(capacity=jnp.asarray(8))
+        with pytest.raises(TPUMetricsUserError, match="evenly"):
+            SketchQuantiles(window=5, slots=2)
+
+    def test_inf_outliers_land_in_the_top_bucket(self):
+        # floor(log2(inf)) cast to int32 saturates; the +1 must not wrap an
+        # inf outlier into the near-zero bucket (documented top-bucket clip)
+        layout = SketchLayout(levels=16, capacity=32)
+        idx = np.asarray(layout.bucket_index(jnp.asarray([jnp.inf, -jnp.inf, 1.0])))
+        assert idx[0] == layout.side - 1  # top positive bucket
+        assert idx[1] == 2 * layout.side - 1  # top negative bucket
+        m = SketchQuantiles(quantiles=(0.5,))
+        m.update(jnp.asarray([jnp.inf] * 5 + [100.0]))
+        est = float(np.asarray(m.compute()))
+        assert est >= 2.0**22, est  # saturates at the range top, not near 0
+
+    def test_non_integral_window_refused(self):
+        with pytest.raises(TPUMetricsUserError, match="truncate"):
+            WindowedMean(window=2.5)
+        with pytest.raises(TPUMetricsUserError, match="truncate"):
+            SketchQuantiles(window=8, slots=2.5)
+        assert WindowedMean(window=4.0).window == 4  # integral float is fine
+
+    def test_default_unit_anchors_the_range_top(self):
+        # shrinking levels must coarsen precision near zero, NOT silently
+        # clip real-world magnitudes: the covered top stays ~2^23 and a
+        # small sketch still separates ordinary values
+        small = SketchQuantiles(quantiles=(0.5,), levels=16, capacity=64)
+        assert small.unit * 2 ** (small.levels - 1) == 2.0**23
+        for i in range(6):
+            small.update(jnp.full((32,), 100.0 * i))
+        est = float(np.asarray(small.compute()))
+        # nearest-rank median of 192 values is the 96th (= 200); one bucket
+        # of slack for the midpoint representative
+        assert abs(est - 200.0) <= small.unit / 64 + 1e-3
+        assert SketchQuantiles().unit == 2.0**-20  # levels=44 default unchanged
+
+    def test_default_slots_divide_any_window(self):
+        # the default must be a divisor of the window, not a flat 8 — any
+        # window length constructs without hand-picking slots
+        assert SketchQuantiles(window=12).slots == 6
+        assert SketchQuantiles(window=7).slots == 7
+        assert SketchQuantiles(window=13).slots == 1  # prime > 8: cumulative panes
+        assert SketchQuantiles(window=64).slots == 8
+
+
+# ------------------------------------------------- merge state kind plumbing
+
+
+class TestMergeStateKind:
+    def _sketch_states(self, seed, n_ranks):
+        rng = np.random.default_rng(seed)
+        states, metrics = [], []
+        for _ in range(n_ranks):
+            m = SketchQuantiles(levels=12, capacity=16)
+            m.update(jnp.asarray(rng.normal(0, 1, 50).astype(np.float32)))
+            states.append(m.metric_state())
+            metrics.append(m)
+        return metrics[0], states
+
+    def test_reshard_is_rank0_plus_empties_and_folds_back(self):
+        proto, states = self._sketch_states(4, 3)
+        folded = merge_metric_states(states, proto._reductions)
+        shards = [
+            reshard_metric_states(dict(folded), proto._reductions, r, 4)
+            for r in range(4)
+        ]
+        layout = proto._sketch_layout
+        for r in (1, 2, 3):
+            counts = np.asarray(shards[r]["sketch"])[..., : layout.total_index + 1]
+            assert counts.sum() == 0.0  # empties everywhere but rank 0
+        refold = merge_metric_states(shards, proto._reductions)
+        assert np.array_equal(np.asarray(refold["sketch"]), np.asarray(folded["sketch"]))
+
+    def test_bare_callable_reduce_still_refuses_reshard(self):
+        reductions = {"s": lambda stacked: stacked.sum(0)}
+        with pytest.raises(TPUMetricsUserError, match="AssociativeMerge"):
+            reshard_metric_states({"s": jnp.ones((4,))}, reductions, 0, 2)
+
+    def test_state_spec_reports_merge_kind_with_params(self):
+        m = SketchQuantiles(levels=12, capacity=16)
+        spec = m.state_spec()["sketch"]
+        assert spec["kind"] == "merge"
+        assert spec["reduce"] == "merge:sketch"
+        assert spec["params"]["levels"] == 12 and spec["params"]["capacity"] == 16
+
+    def test_snapshot_spec_error_names_sketch_params(self, tmp_path):
+        ev = StreamingEvaluator(
+            SketchQuantiles(levels=12, capacity=32), buckets=16, snapshot_dir=str(tmp_path)
+        )
+        ev.submit(jnp.arange(8.0))
+        ev.flush()
+        ev.snapshot()
+        ev.close()
+        ev2 = StreamingEvaluator(
+            SketchQuantiles(levels=12, capacity=16), buckets=16, snapshot_dir=str(tmp_path)
+        )
+        with pytest.raises(SnapshotSpecError, match=r"capacity=16.*levels=12|levels=12.*capacity=16"):
+            ev2.restore_latest()
+        ev2.close(drain=False)
+
+    def test_oo_snapshot_mismatch_names_sketch_params(self):
+        a = SketchQuantiles(levels=12, capacity=32)
+        b = SketchQuantiles(levels=12, capacity=16)
+        a.update(jnp.arange(4.0))
+        snap = a.snapshot_state()
+        with pytest.raises(TPUMetricsUserError, match="merge:sketch"):
+            b.load_snapshot_state(snap)
+
+    def test_collection_annotations_keep_same_named_sketches_apart(self):
+        # two members both declare a state literally named 'sketch' with
+        # DIFFERENT geometry: each spec-error annotation must carry its own
+        # member's parameters (a bare-name key would let the last one win)
+        from tpumetrics.runtime.snapshot import state_annotations
+
+        col = MetricCollection(
+            {
+                "q": SketchQuantiles(levels=12, capacity=128),
+                "psi": PSI(reference=np.arange(50.0), levels=12, capacity=16),
+            }
+        )
+        ann = state_annotations(col)
+        assert "capacity=128" in ann["['q']['sketch']"]
+        assert "capacity=16" in ann["['psi']['sketch']"]
+
+    def test_drift_monitor_clone_rebuilds_alert_lock(self):
+        m = PSI(reference=np.arange(50.0), threshold=0.1)
+        m.update(jnp.arange(200.0))
+        c = m.clone()  # deepcopy: the lock must not travel, latches may
+        c.update(jnp.arange(200.0))
+        assert c._alert_lock is not m._alert_lock
+        float(c.compute())
+
+    def test_identity_contract(self):
+        layout = SketchLayout(levels=8, capacity=8)
+        fn = sketch_merge(layout)
+        assert isinstance(fn, AssociativeMerge)
+        row = layout.update_row(layout.empty(1)[0], jnp.asarray([1.0, 2.0]), jnp.ones(2))
+        ring = jnp.stack([row])
+        merged = fn(jnp.stack([ring, fn.identity_like(ring)]))
+        assert np.array_equal(np.asarray(merged), np.asarray(ring))
+
+
+# ------------------------------------------------------- runtime path parity
+
+
+def _ref_values(seed=11):
+    return np.random.default_rng(seed).normal(0, 1, 1500).astype(np.float32)
+
+
+def _monitoring_collection(window=8):
+    ref = _ref_values()
+    return MetricCollection(
+        {
+            "wmean": WindowedMean(window=window, slots=4),
+            "q": SketchQuantiles(quantiles=(0.5, 0.99), levels=20, capacity=64),
+            "psi": PSI(
+                reference=ref, threshold=0.25, hysteresis=0.05, levels=20, capacity=64
+            ),
+        }
+    )
+
+
+def _stream(seed, n, lo=1, hi=30, loc=2.0):
+    rng = np.random.default_rng(seed)
+    return [
+        jnp.asarray(rng.normal(loc, 1.0, int(rng.integers(lo, hi))).astype(np.float32))
+        for _ in range(n)
+    ]
+
+
+class TestRuntimeParity:
+    def test_bucketed_evaluator_bit_identical_to_oo(self):
+        batches = _stream(21, 7)
+        col = _monitoring_collection()
+        for b in batches:
+            col.update(b)
+        want = col.compute()
+
+        ev = StreamingEvaluator(_monitoring_collection(), buckets=32)
+        for b in batches:
+            ev.submit(b)
+        got = ev.compute()
+        st = ev.stats()
+        ev.close()
+        for k in want:
+            assert np.array_equal(
+                np.asarray(want[k]), np.asarray(got[k]), equal_nan=True
+            ), k
+        assert st["monitoring"]["psi"]["alert_active"] is True
+
+    def test_fused_oo_collection_parity(self):
+        batches = [jnp.asarray([1.0, 2.0]), jnp.asarray([3.0]), jnp.asarray([4.0, 5.0])]
+        plain = MetricCollection({"wm": WindowedMean(window=2), "dm": DecayedMean(half_life=3)})
+        fused = MetricCollection(
+            {"wm": WindowedMean(window=2), "dm": DecayedMean(half_life=3)},
+            fused_update=True,
+        )
+        for b in batches:
+            plain.update(b)
+            fused.update(b)
+        a, b_ = plain.compute(), fused.compute()
+        for k in a:
+            assert np.array_equal(np.asarray(a[k]), np.asarray(b_[k])), k
+
+    def test_fused_step_masked_update_parity(self):
+        col = _monitoring_collection()
+        step = FusedCollectionStep(col, donate=False)
+        state = step.init_state()
+        raw = jnp.asarray([1.0, 2.0, 5.0])
+        padded = jnp.concatenate([raw, jnp.broadcast_to(raw[0:1], (5,))])
+        state = step.masked_update(state, (padded,), jnp.asarray(3, jnp.int32), 8)
+        want = _monitoring_collection()
+        want.update(raw)
+        got = col.functional_compute(state)
+        expect = want.compute()
+        for k in expect:
+            assert np.array_equal(
+                np.asarray(expect[k]), np.asarray(got[k]), equal_nan=True
+            ), k
+
+    def test_megabatch_parity_windowed(self):
+        streams = [_stream(31 + i, 5, lo=8, hi=9) for i in range(3)]  # same shapes
+        with EvaluationService() as svc:
+            handles = [
+                svc.register(f"t{i}", _monitoring_collection(), buckets=[16])
+                for i in range(3)
+            ]
+            for j in range(5):
+                for i, h in enumerate(handles):
+                    h.submit(streams[i][j])
+            svc.flush()
+            st = svc.stats()
+            got = [h.compute() for h in handles]
+            mon = [h.stats().get("monitoring") for h in handles]
+        assert st["shared_steps"] == 1
+        assert st["megabatch_steps"] > 0
+        for i in range(3):
+            want_col = _monitoring_collection()
+            for b in streams[i]:
+                want_col.update(b)
+            want = want_col.compute()
+            for k in want:
+                assert np.array_equal(
+                    np.asarray(want[k]), np.asarray(got[i][k]), equal_nan=True
+                ), (i, k)
+            assert mon[i]["psi"]["alert_active"] is True
+
+    def test_scalar_submits_route_through_windows(self):
+        ev = StreamingEvaluator(WindowedMean(window=2), buckets=8)
+        for x in (1.0, 2.0, 9.0):
+            ev.submit(x)
+        assert float(ev.compute()) == pytest.approx((2.0 + 9.0) / 2)
+        ev.close()
+
+
+# --------------------------------------------------------------------- drift
+
+
+class TestDriftMonitors:
+    def test_alert_fires_exactly_once_per_crossing_with_hysteresis(self):
+        ref = _ref_values(5)
+        m = KSDistance(
+            reference=ref, threshold=0.5, hysteresis=0.1, window=4, slots=4,
+            compute_with_cache=False, levels=20, capacity=64,
+        )
+        rng = np.random.default_rng(6)
+        shifted = lambda: jnp.asarray(rng.normal(8.0, 1.0, 200).astype(np.float32))
+        matched = lambda: jnp.asarray(rng.normal(0.0, 1.0, 200).astype(np.float32))
+        with ledger.capture() as cap:
+            m.update(shifted())
+            assert float(m.compute()) >= 0.5
+            entry = m._runtime("")
+            assert entry["alerts"] == 1 and entry["active"]
+            # still above threshold: latched, no second alert
+            m.update(shifted())
+            m.compute()
+            assert m._runtime("")["alerts"] == 1
+            # window slides to matched data: score drops below re-arm point
+            for _ in range(4):
+                m.update(matched())
+            assert float(m.compute()) < 0.4
+            assert not m._runtime("")["active"]
+            # second genuine crossing fires again
+            for _ in range(4):
+                m.update(shifted())
+            m.compute()
+            assert m._runtime("")["alerts"] == 2
+        events = [r for r in cap.records if r.kind == "drift_alert"]
+        assert len(events) == 2
+        assert cap.summary()["drift_alerts"] == 2
+        assert events[0].extra["monitor"] == "KSDistance"
+
+    def test_kl_and_psi_detect_shift_and_stay_quiet_on_match(self):
+        rng = np.random.default_rng(7)
+        ref = rng.normal(0, 1, 4000).astype(np.float32)
+        for cls in (PSI, KLDrift):
+            same = cls(reference=ref, threshold=0.25)
+            same.update(jnp.asarray(rng.normal(0, 1, 4000).astype(np.float32)))
+            assert float(same.compute()) < 0.1, cls
+            moved = cls(reference=ref, threshold=0.25)
+            moved.update(jnp.asarray(rng.normal(1.5, 1, 4000).astype(np.float32)))
+            assert float(moved.compute()) > 0.25, cls
+
+    def test_per_stream_latches_are_independent(self):
+        ref = _ref_values(8)
+        m = PSI(reference=ref, threshold=0.1, compute_with_cache=False)
+        m.update(jnp.asarray(_ref_values(9) + 3.0))
+        with ledger.capture() as cap:
+            with stream_scope("tenant-a"):
+                m.compute()
+            with stream_scope("tenant-b"):
+                m.compute()
+        assert m._runtime("tenant-a")["alerts"] == 1
+        assert m._runtime("tenant-b")["alerts"] == 1
+        assert m._runtime("")["alerts"] == 0
+        streams = {r.extra["stream"] for r in cap.records if r.kind == "drift_alert"}
+        assert streams == {"tenant-a", "tenant-b"}
+        stats = monitoring_stats(m, "tenant-a")
+        assert stats["PSI"]["alert_active"] is True
+
+    def test_gauge_and_counter_in_prometheus_export(self):
+        ref = _ref_values(10)
+        m = PSI(reference=ref, threshold=0.1, name="psi_feature_x")
+        m.update(jnp.asarray(ref + 5.0))
+        with stream_scope("svc-tenant"):
+            m.compute()
+        text = prometheus_text()
+        assert "tpumetrics_drift_score" in text
+        assert 'stream="svc-tenant"' in text and 'monitor="psi_feature_x"' in text
+        assert "tpumetrics_drift_alerts_total" in text
+        from tpumetrics.monitoring import release_stream
+
+        release_stream(m, "svc-tenant")
+        assert 'monitor="psi_feature_x"' not in prometheus_text()
+
+    def test_tenant_handle_stats_surface_monitoring(self):
+        ref = _ref_values(12)
+        with EvaluationService() as svc:
+            h = svc.register(
+                "tenant-m",
+                MetricCollection({"psi": PSI(reference=ref, threshold=0.1)}),
+                buckets=[16],
+            )
+            h.submit(jnp.asarray(ref[:16] + 4.0))
+            h.compute()
+            section = h.stats()["monitoring"]
+        assert section["psi"]["monitor"] == "PSI"
+        assert section["psi"]["alert_active"] is True
+        assert section["psi"]["alerts"] == 1
+
+    def test_reference_digest_guards_restore(self):
+        ref_a = _ref_values(13)
+        a = PSI(reference=ref_a, threshold=0.5)
+        b = PSI(reference=ref_a * 3.0, threshold=0.5)
+        a.update(jnp.asarray(ref_a))
+        snap = a.snapshot_state()
+        with pytest.raises(TPUMetricsUserError, match="reference_digest"):
+            b.load_snapshot_state(snap)
+
+    def test_evaluator_close_releases_drift_series(self):
+        ref = _ref_values(14)
+        ev = StreamingEvaluator(
+            MetricCollection({"psi": PSI(reference=ref, threshold=0.05)}), buckets=[16]
+        )
+        ev.submit(jnp.asarray(ref[:12] + 4.0))
+        ev.compute()
+        stream = ev._stream
+        assert f'stream="{stream}"' in prometheus_text()
+        ev.close()
+        assert f'stream="{stream}"' not in prometheus_text()
+
+
+# ------------------------------------------------------------------ sharding
+
+
+class TestShardedMonitoring:
+    def test_for_metric_keeps_merge_states_replicated(self):
+        rules = _monitoring_collection().state_partition_rules()
+        # no rule may target the sketch/slot states: the merge IS the
+        # collective, windows/sketches replicate like reduce-op states
+        assert not any("sketch" in p or "slot" in p for p in rules.patterns)
+
+    def test_sharded_evaluator_parity(self, mesh8):
+        batches = [
+            jnp.asarray(np.arange(float(8 * i), 8.0 * (i + 1), dtype=np.float32))
+            for i in range(6)
+        ]
+        plain = _monitoring_collection()
+        for b in batches:
+            plain.update(b)
+        want = plain.compute()
+        ev = StreamingEvaluator(_monitoring_collection(), buckets=[8], mesh=mesh8)
+        for b in batches:
+            ev.submit(b)
+        got = ev.compute()
+        ev.close()
+        for k in want:
+            assert np.array_equal(
+                np.asarray(want[k]), np.asarray(got[k]), equal_nan=True
+            ), k
+
+
+# ------------------------------------------------------------------- elastic
+
+
+def _int_stream(seed, n, rows=(3, 9)):
+    """Integer-valued float batches: cross-rank sums are exact in f32, so
+    bit-identical claims survive any summation grouping."""
+    rng = np.random.default_rng(seed)
+    return [
+        jnp.asarray(
+            rng.integers(-20, 50, int(rng.integers(*rows))).astype(np.float32)
+        )
+        for _ in range(n)
+    ]
+
+
+def _shards(batch, world):
+    return np.array_split(np.asarray(batch), world)
+
+
+class TestElasticWindows:
+    @pytest.mark.parametrize("n,m", [(2, 4), (3, 2)])
+    def test_midwindow_shrink_grow_lockstep_bit_identical(self, n, m):
+        """Lockstep data-parallel windows (every rank sees its shard of
+        every batch) across a mid-window resize: fold -> reshard -> resume
+        must equal the uninterrupted single-world run BIT-identically, with
+        evictions crossing the resize boundary."""
+
+        def make():
+            return MetricCollection(
+                {
+                    "wm": WindowedMean(window=4, slots=2),
+                    "dm": DecayedMean(half_life=2),
+                    "q": SketchQuantiles(quantiles=(0.5, 0.9), levels=16, capacity=32),
+                }
+            )
+
+        batches = _int_stream(40, 10, rows=(max(n, m) + 2, 16))
+        proto = make()
+        single = proto.init_state()
+        for b in batches:
+            single = proto.functional_update(single, b)
+        want = proto.functional_compute(single)
+
+        states = [proto.init_state() for _ in range(n)]
+        cut_at = 6  # mid-window: slot ring has wrapped and is part-filled
+        for b in batches[:cut_at]:
+            for r, shard in enumerate(_shards(b, n)):
+                states[r] = proto.functional_update(states[r], jnp.asarray(shard))
+        folded = proto.fold_state_dicts(states)
+        resharded = [proto.reshard_state_dict(folded, j, m) for j in range(m)]
+        for b in batches[cut_at:]:
+            for j, shard in enumerate(_shards(b, m)):
+                resharded[j] = proto.functional_update(resharded[j], jnp.asarray(shard))
+        refolded = proto.fold_state_dicts(resharded)
+        got = proto.functional_compute(refolded)
+        for k in want:
+            assert np.array_equal(
+                np.asarray(want[k]), np.asarray(got[k]), equal_nan=True
+            ), k
+
+
+class _Cohort(DistributedBackend):
+    """Emulated eager cohort (the test_elastic idiom): this rank's object
+    gather returns its own payload plus precomputed peer stamps."""
+
+    has_object_channel = True
+
+    def __init__(self, rank, world, peek):
+        self._rank, self._world, self._peek = rank, world, peek
+
+    def available(self):
+        return True
+
+    def world_size(self):
+        return self._world
+
+    def rank(self):
+        return self._rank
+
+    def all_gather_object(self, obj, group=None):
+        return [obj if r == self._rank else self._peek(r) for r in range(self._world)]
+
+
+def _blocks(items, n):
+    split = np.array_split(np.arange(len(items)), n)
+    return [[items[int(i)] for i in idx] for idx in split]
+
+
+class TestAcceptance:
+    def test_streaming_windowed_kill_restore_resize_bit_identical(self, tmp_path):
+        """THE acceptance run: a StreamingEvaluator over a windowed
+        collection (WindowedMean + sketch p50/p99 + PSI monitor) is killed
+        mid-window, restored and resized 2 -> 4 via restore_elastic(), and
+        its compute()/drift scores are bit-identical to an uninterrupted
+        single-world run; the drift alert lands in the ledger AND the
+        Prometheus export."""
+        ref = np.asarray(_int_stream(50, 1, rows=(400, 401))[0])
+
+        def make():
+            return MetricCollection(
+                {
+                    "wmean": WindowedMean(window=32, slots=8),
+                    "q": SketchQuantiles(quantiles=(0.5, 0.99), levels=16, capacity=64),
+                    "psi": PSI(
+                        reference=ref, threshold=0.25, hysteresis=0.05,
+                        levels=16, capacity=64,
+                    ),
+                }
+            )
+
+        # 12 batches; shifted so PSI must alert.  Window (32) exceeds the
+        # stream: the kill at batch 8 is genuinely MID-window.
+        batches = [jnp.asarray(np.asarray(b) + 60.0) for b in _int_stream(51, 12)]
+        single = make()
+        with stream_scope("single"):
+            for b in batches:
+                single.update(b)
+            want = single.compute()
+
+        root = str(tmp_path)
+        digest = config_digest(make())
+        props: dict = {}
+
+        def peek(r):
+            return elastic_mod.make_stamp(r, props[r], digest)
+
+        def cohort_evaluators(world):
+            return [
+                StreamingEvaluator(
+                    make(), buckets=16, snapshot_dir=root,
+                    snapshot_rank=r, snapshot_world_size=world,
+                    barrier_backend=_Cohort(r, world, peek),
+                )
+                for r in range(world)
+            ]
+
+        evs = cohort_evaluators(2)
+        k = 8
+        for ev, block in zip(evs, _blocks(batches[:k], 2)):
+            for b in block:
+                ev.submit(b)
+        for ev in evs:
+            ev.flush()
+        for r, ev in enumerate(evs):
+            props[r] = ev._barrier_proposal()
+        for ev in evs:
+            ev.snapshot()
+        for ev in evs:
+            ev.close(drain=False)  # the kill: whole world preempted
+
+        with ledger.capture() as cap:
+            news = cohort_evaluators(4)
+            infos = [ev.restore_elastic() for ev in news]
+            assert all(i["batches"] == k and i["from_world"] == 2 for i in infos)
+            for ev, block in zip(news, _blocks(batches[k:], 4)):
+                for b in block:
+                    ev.submit(b)
+            for ev in news:
+                ev.flush()
+            proto = make()
+            folded = proto.fold_state_dicts([ev._state for ev in news])
+            with stream_scope("global"):
+                got = proto.functional_compute(folded)
+            news[0].compute()  # rank-local compute: fires this rank's alert
+            stats0 = news[0].stats()
+            prom = prometheus_text()
+            for ev in news:
+                ev.close(drain=False)
+
+        # bit-identical values AND drift scores across kill + 2->4 resize
+        for key in want:
+            assert np.array_equal(
+                np.asarray(want[key]), np.asarray(got[key]), equal_nan=True
+            ), key
+        # the drift alert is visible in stats, the ledger, and Prometheus
+        assert stats0["monitoring"]["psi"]["alert_active"] is True
+        assert cap.summary()["drift_alerts"] >= 1
+        assert any(r.kind == "drift_alert" for r in cap.records)
+        assert "tpumetrics_drift_score" in prom and 'monitor="PSI"' in prom
+        assert any(r.kind == "elastic_restore" for r in cap.records)

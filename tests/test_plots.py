@@ -160,6 +160,28 @@ REGISTRY = {
     "SumMetric": (lambda: tm.SumMetric(), [(1.0,), (3.0,)]),
     "RunningMean": (lambda: tm.RunningMean(window=3), [(1.0,), (2.0,), (3.0,)]),
     "RunningSum": (lambda: tm.RunningSum(window=3), [(1.0,), (2.0,), (3.0,)]),
+    # monitoring (windows / decay / sketches / drift)
+    "WindowedMean": (lambda: tm.WindowedMean(window=2), [(1.0,), (2.0,), (3.0,)]),
+    "WindowedSum": (lambda: tm.WindowedSum(window=2), [(1.0,), (2.0,), (3.0,)]),
+    "WindowedMax": (lambda: tm.WindowedMax(window=2), [(1.0,), (3.0,), (2.0,)]),
+    "WindowedMin": (lambda: tm.WindowedMin(window=2), [(3.0,), (1.0,), (2.0,)]),
+    "DecayedMean": (lambda: tm.DecayedMean(half_life=2), [(1.0,), (2.0,), (3.0,)]),
+    "SketchQuantiles": (
+        lambda: tm.SketchQuantiles(quantiles=(0.25, 0.5, 0.75), levels=12, capacity=16),
+        [(jnp.arange(1.0, 33.0),)],
+    ),
+    "PSI": (
+        lambda: tm.PSI(reference=np.arange(64.0), levels=12, capacity=16),
+        [(jnp.arange(10.0, 74.0),)],
+    ),
+    "KLDrift": (
+        lambda: tm.KLDrift(reference=np.arange(64.0), levels=12, capacity=16),
+        [(jnp.arange(10.0, 74.0),)],
+    ),
+    "KSDistance": (
+        lambda: tm.KSDistance(reference=np.arange(64.0), levels=12, capacity=16),
+        [(jnp.arange(10.0, 74.0),)],
+    ),
     # classification (task dispatch)
     "Accuracy": (lambda: tm.Accuracy(task="multiclass", num_classes=C), [(logits_mc, target_mc)]),
     "AUROC": (lambda: tm.AUROC(task="multiclass", num_classes=C, thresholds=16), [(logits_mc, target_mc)]),

@@ -171,6 +171,20 @@ class SumMetric(BaseAggregator):
 class CatMetric(BaseAggregator):
     """Concatenate a stream of values (reference aggregation.py:429-490).
 
+    .. warning::
+        **Unbounded streams.** ``CatMetric`` keeps every value it has ever
+        seen — its list state grows by one array per ``update()`` forever,
+        so on a serving/monitoring stream it is a slow, guaranteed OOM (and
+        each sync/snapshot ships the entire history).  For run-forever
+        streams use the fixed-shape monitoring family instead:
+        :class:`tpumetrics.monitoring.WindowedMean` /
+        :class:`~tpumetrics.monitoring.WindowedSum` /
+        :class:`~tpumetrics.monitoring.WindowedMax` /
+        :class:`~tpumetrics.monitoring.WindowedMin` for sliding windows,
+        :class:`tpumetrics.monitoring.DecayedMean` for decayed averages, or
+        :class:`tpumetrics.monitoring.SketchQuantiles` when you kept the
+        raw values only to compute quantiles (``docs/monitoring.md``).
+
     Example:
         >>> import jax.numpy as jnp
         >>> from tpumetrics.aggregation import CatMetric

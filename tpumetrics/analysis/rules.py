@@ -22,7 +22,9 @@ TPL201 divergent-collective    a collective (``sync``/``all_reduce``/``all_gathe
                                runtime ``LockstepViolation``
 TPL301 bad-state-default       ``add_state`` default inconsistent with ``dist_reduce_fx``
                                (non-zero for ``sum``, non-``+inf`` for ``min``,
-                               non-``-inf`` for ``max``, non-empty for ``cat``)
+                               non-``-inf`` for ``max``, non-empty for ``cat``; for a
+                               callable merge — the sketch state kind — a provably
+                               non-identity default, e.g. a pre-seeded sketch)
 TPL302 state-mutation          in-place mutation of an array state (subscript store,
                                discarded ``.at[...]`` result, ``.fill()``/``.sort()``)
                                instead of reassignment
@@ -33,6 +35,10 @@ TPL304 stale-partition-rule    a literal ``StatePartitionRules`` regex that matc
                                state declared anywhere in the package (or does not
                                compile) — the state it meant to shard is silently
                                replicated
+TPL305 dynamic-window          a windowed-aggregator construction whose ``window``/
+                               ``slots`` argument is provably not a static int (a call,
+                               subscript, or non-int literal) — window length is state
+                               SHAPE, so a data-dependent window retraces every step
 TPL401 shadow-state            ``self.<attr>`` assigned in ``update()``-reachable code but
                                never declared via ``add_state`` — invisible to ``reset()``,
                                snapshots, and elastic fold/reshard
@@ -76,6 +82,7 @@ CATALOG: Dict[str, Tuple[str, str]] = {
     "TPL302": ("state-mutation", "in-place mutation of an array state instead of reassignment"),
     "TPL303": ("unshardable-state", "array state with dist_reduce_fx=None cannot be folded/resharded"),
     "TPL304": ("stale-partition-rule", "partition rule regex matches no declared state"),
+    "TPL305": ("dynamic-window", "windowed metric whose window length is not a static int"),
     "TPL401": ("shadow-state", "attribute assigned in update()-reachable code but not declared via add_state"),
     "TPL900": ("syntax-error", "file could not be parsed"),
     "TPL901": ("unjustified-suppression", "tpulint disable comment without a justification"),
@@ -900,7 +907,29 @@ class StateDeclRule:
             kind = _default_kind(default, mod)
             explicit, reduce_expr = _reduce_arg(call)
             if explicit and not isinstance(reduce_expr, ast.Constant):
-                continue  # dynamic reduce (variable / custom callable) — undecidable here
+                # callable merge (the sketch state kind) / dynamic reduce.
+                # The merge's identity is undecidable statically, but some
+                # defaults are provably NOT any merge's identity: a finite
+                # non-zero scalar or a pre-seeded list contributes real
+                # mass on every cross-rank fold from a rank that never
+                # updated.  ±inf stays quiet — it IS the identity of
+                # extremum-style merges (and of a variable-held "max"/
+                # "min" string reduce) — as do empty-sketch constructors
+                # (``empty_*``), zeros, and anything dynamic.
+                if kind in ("nonzero", "nonempty_list"):
+                    yield Finding(
+                        "TPL301",
+                        f"state '{label}' uses a callable dist_reduce_fx (merge state "
+                        "kind) with a non-zero/pre-seeded default — for additive-"
+                        "style merges (the common case: sketches, counts) a rank "
+                        "that never updated would contribute real mass to every "
+                        "cross-rank fold. Use the merge's identity (e.g. an empty "
+                        "sketch) as the default; if this IS the identity (a "
+                        "product-style merge whose identity is 1), suppress with a "
+                        "justification naming the merge.",
+                        mod.path, call.lineno, call.col_offset, symbol=f"{ci.name}.{method}",
+                    )
+                continue  # identity-ness beyond that is undecidable here
             reduce_val = reduce_expr.value if isinstance(reduce_expr, ast.Constant) else None
             reduce_lit = reduce_val if isinstance(reduce_val, str) else None
             is_none = reduce_val is None  # explicit None or omitted (the signature default)
@@ -1260,10 +1289,91 @@ class PartitionRuleDeclRule:
                     )
 
 
+_WINDOWED_CLASSES = {
+    "WindowedMean",
+    "WindowedSum",
+    "WindowedMax",
+    "WindowedMin",
+    "SketchQuantiles",
+    "PSI",
+    "KLDrift",
+    "KSDistance",
+    "DriftMonitor",
+}
+_WINDOW_KWARGS = ("window", "slots")
+
+
+class WindowedWindowRule:
+    """TPL305: a windowed-metric construction whose ``window``/``slots``
+    argument is provably not a static int.
+
+    Window length is state SHAPE (the ring of sub-window slots): a value
+    derived from data — a call result, a subscript, a float — changes the
+    compiled update's shapes, so every step retraces (the windowed runtime's
+    whole point is a bounded compile universe).  The constructors reject
+    traced values at runtime; this catches the host-side variants (e.g.
+    ``window=int(batch.mean())``) at review time.  Bare names/attributes are
+    config constants as far as a static pass can tell — undecidable,
+    skipped, like TPL304's programmatic patterns."""
+
+    codes = ("TPL305",)
+
+    @staticmethod
+    def _static_verdict(expr: ast.expr) -> str:
+        """'static' (a compile-time int), 'dynamic' (provably not), or
+        'unknown' (a name/attribute — could be a config constant)."""
+        if isinstance(expr, ast.Constant):
+            if isinstance(expr.value, bool) or not isinstance(expr.value, int):
+                return "dynamic"  # float/str/bool window: never a valid length
+            return "static"
+        if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, (ast.USub, ast.UAdd)):
+            return WindowedWindowRule._static_verdict(expr.operand)
+        if isinstance(expr, ast.BinOp):
+            left = WindowedWindowRule._static_verdict(expr.left)
+            right = WindowedWindowRule._static_verdict(expr.right)
+            if "dynamic" in (left, right):
+                return "dynamic"
+            return "static" if left == right == "static" else "unknown"
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            return "unknown"
+        return "dynamic"  # calls, subscripts, comprehensions, f-strings, ...
+
+    def check(self, mod: ModuleInfo, index: PackageIndex) -> Iterator[Finding]:
+        if mod.tree is None:
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted_name(node.func, mod) or _import_resolved_dotted(node.func, mod) or ""
+            if dotted.rpartition(".")[2] not in _WINDOWED_CLASSES:
+                continue
+            args: List[Tuple[str, ast.expr]] = []
+            for kw in node.keywords:
+                if kw.arg in _WINDOW_KWARGS and kw.value is not None:
+                    if isinstance(kw.value, ast.Constant) and kw.value.value is None:
+                        continue  # window=None: the unwindowed sketch mode
+                    args.append((kw.arg, kw.value))
+            # Windowed* take window as the first positional argument
+            if node.args and dotted.rpartition(".")[2].startswith("Windowed"):
+                args.append(("window", node.args[0]))
+            for name, expr in args:
+                if self._static_verdict(expr) == "dynamic":
+                    yield Finding(
+                        "TPL305",
+                        f"`{name}` of {_truncate(node)} is not a static int: window "
+                        "length is state shape, so a data-dependent window changes "
+                        "the traced shapes and retraces the update step every call. "
+                        "Pick the window at construction (a literal or module "
+                        "constant).",
+                        mod.path, expr.lineno, expr.col_offset,
+                    )
+
+
 RULES = [
     TraceSafetyRule(),
     HostTelemetryRule(),
     StateDeclRule(),
     ShadowStateRule(),
     PartitionRuleDeclRule(),
+    WindowedWindowRule(),
 ]

@@ -237,6 +237,16 @@ class Metric(ABC):
         ``parallel/merge.py`` can neither fold nor elastically reshard
         (TPL303).  Update states by **reassignment** (jax arrays are
         immutable; a discarded ``.at[...]`` result silently no-ops, TPL302).
+
+        **Callable merges** (the "sketch" state kind): a callable
+        ``dist_reduce_fx`` must be associative and commutative over its
+        rank-stacked input, and its default must be the merge *identity*
+        (TPL301 applies to callable merges too — e.g. an empty sketch, never
+        a pre-seeded one).  Wrap the callable in
+        :class:`~tpumetrics.parallel.merge.AssociativeMerge` to declare that
+        identity explicitly: only then can elastic restore reshard the state
+        (folded value on rank 0, identity elsewhere) and snapshot spec
+        errors name the declaration parameters (capacity/levels).
         """
         if not name.isidentifier():
             raise ValueError(f"Argument `name` must be a valid python identifier, got {name!r}")
@@ -1052,9 +1062,15 @@ class Metric(ABC):
         restore validates against (``tpumetrics/runtime/snapshot.py``).
 
         ``kind`` is ``"array"`` for tensor states, ``"list"`` for eager list
-        states (with the current length), or ``"buffer"`` for list states
-        with a declared fixed capacity.
+        states (with the current length), ``"buffer"`` for list states with
+        a declared fixed capacity, or ``"merge"`` for tensor states whose
+        ``dist_reduce_fx`` is an
+        :class:`~tpumetrics.parallel.merge.AssociativeMerge` (mergeable
+        sketches) — those entries carry the merge's declared parameters
+        (e.g. a sketch's capacity/levels) so spec mismatches can name them.
         """
+        from tpumetrics.parallel.merge import AssociativeMerge
+
         spec: Dict[str, Dict[str, Any]] = {}
         for name, default in self._defaults.items():
             val = getattr(self, name)
@@ -1072,9 +1088,19 @@ class Metric(ABC):
                     }
                 else:
                     entry = {"kind": "list", "length": len(val) if isinstance(val, list) else None}
+            elif isinstance(reduction_fn, AssociativeMerge):
+                entry = {
+                    "kind": "merge",
+                    "shape": list(jnp.shape(val)),
+                    "dtype": str(jnp.asarray(val).dtype),
+                    "params": dict(reduction_fn.params),
+                }
             else:
                 entry = {"kind": "array", "shape": list(jnp.shape(val)), "dtype": str(jnp.asarray(val).dtype)}
-            entry["reduce"] = op if op is not None else ("custom" if callable(reduction_fn) else None)
+            if isinstance(reduction_fn, AssociativeMerge):
+                entry["reduce"] = f"merge:{reduction_fn.name}"
+            else:
+                entry["reduce"] = op if op is not None else ("custom" if callable(reduction_fn) else None)
             spec[name] = entry
         return spec
 
@@ -1158,8 +1184,17 @@ class Metric(ABC):
                 want_shape, want_dtype = jnp.shape(getattr(self, name)), jnp.asarray(getattr(self, name)).dtype
                 got = jnp.asarray(val)
                 if tuple(got.shape) != tuple(want_shape) or got.dtype != want_dtype:
+                    from tpumetrics.parallel.merge import AssociativeMerge
+
+                    note = ""
+                    reduction_fn = self._reductions.get(name)
+                    if isinstance(reduction_fn, AssociativeMerge):
+                        # a merge-kind (sketch) state's shape IS its declared
+                        # parameters: name them, like the config fingerprint
+                        # names classification configs
+                        note = f" [this metric declares {reduction_fn.describe()}]"
                     problems.append(
-                        f"{name}: snapshot {got.dtype}{tuple(got.shape)} != expected {want_dtype}{tuple(want_shape)}"
+                        f"{name}: snapshot {got.dtype}{tuple(got.shape)} != expected {want_dtype}{tuple(want_shape)}{note}"
                     )
         if strict:
             problems.extend(f"unexpected state {k!r}" for k in states if k not in self._defaults)

@@ -1,0 +1,52 @@
+"""Continuous-monitoring metrics: windows, decay, sketches, drift.
+
+The online-monitoring workload class (`docs/monitoring.md`): unbounded
+serving streams where "the metric" is a sliding window, a decayed average, a
+streaming quantile, or a drift score — all with fixed-shape, trace-safe,
+*mergeable* state, so the existing runtime (bucketed/fused/megabatch paths),
+snapshot/elastic, and GSPMD machinery carry them unchanged.
+"""
+
+from tpumetrics.monitoring.drift import (
+    DriftMonitor,
+    KLDrift,
+    KSDistance,
+    PSI,
+    current_stream,
+    monitoring_stats,
+    release_stream,
+    stream_scope,
+)
+from tpumetrics.monitoring.sketch import (
+    SketchLayout,
+    SketchQuantiles,
+    empty_sketch,
+    sketch_merge,
+)
+from tpumetrics.monitoring.windowed import (
+    DecayedMean,
+    WindowedMax,
+    WindowedMean,
+    WindowedMin,
+    WindowedSum,
+)
+
+__all__ = [
+    "DecayedMean",
+    "DriftMonitor",
+    "KLDrift",
+    "KSDistance",
+    "PSI",
+    "SketchLayout",
+    "SketchQuantiles",
+    "WindowedMax",
+    "WindowedMean",
+    "WindowedMin",
+    "WindowedSum",
+    "current_stream",
+    "empty_sketch",
+    "monitoring_stats",
+    "release_stream",
+    "sketch_merge",
+    "stream_scope",
+]

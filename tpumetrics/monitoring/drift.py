@@ -1,0 +1,366 @@
+"""Drift monitors: live sketch vs a frozen reference distribution.
+
+A drift monitor is an ordinary sketch-backed metric (state = the mergeable
+``(slots, N)`` sketch of :mod:`tpumetrics.monitoring.sketch`, optionally
+windowed) whose ``compute()`` returns a **divergence score** between the
+live distribution and a reference distribution frozen at construction:
+
+========================= =============================================
+:class:`PSI`              population stability index
+                          ``sum((p - q) * ln(p / q))`` over sketch
+                          buckets (eps-smoothed); the industry-standard
+                          "has the feature shifted" score (rule of
+                          thumb: < 0.1 stable, > 0.25 shifted)
+:class:`KLDrift`          ``KL(live || reference)`` over sketch buckets
+                          (eps-smoothed)
+:class:`KSDistance`       Kolmogorov–Smirnov statistic: max CDF gap
+                          between the live (windowed) histogram and the
+                          reference — scale-free, in ``[0, 1]``
+========================= =============================================
+
+The reference is pushed through the *same* sketch binning once, eagerly, at
+construction, and stored as plain (non-state) bucket masses — so live and
+reference are always compared on identical bins, and the monitor's
+registered state stays a pure mergeable sketch (snapshots, elastic resize,
+and cross-rank merge need nothing new).  ``reference_digest`` (a content
+hash) rides the config fingerprint, so restoring a snapshot into a monitor
+with a *different* reference fails loudly.
+
+**Alerting** is a host-side ``compute()``-time effect (never reachable from
+``update()`` — tpulint TPL104 enforces that separation): every concrete
+score refreshes the ``tpumetrics_drift_score{stream,monitor}`` gauge, and an
+upward threshold crossing emits ONE ``drift_alert`` ledger event + bumps
+``tpumetrics_drift_alerts_total{stream,monitor}``.  The alert then latches:
+it re-arms only after the score falls below ``threshold - hysteresis``, so a
+score jittering around the threshold cannot page once per compute.  The
+ambient stream label comes from :func:`stream_scope` (the runtime wraps its
+compute paths in it; standalone OO use gets the ``""`` stream), and latches
+are kept **per stream** so one shared-step metric instance serving many
+tenants alerts independently per tenant.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, Generator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.metric import Metric
+from tpumetrics.monitoring.sketch import _SketchBacked
+from tpumetrics.telemetry import instruments as _instruments
+from tpumetrics.telemetry import ledger as _telemetry
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+Array = jax.Array
+
+__all__ = [
+    "DriftMonitor",
+    "KLDrift",
+    "KSDistance",
+    "PSI",
+    "current_stream",
+    "monitoring_stats",
+    "release_stream",
+    "stream_scope",
+]
+
+_DRIFT_GAUGE = _instruments.gauge(
+    _instruments.DRIFT_SCORE, help="latest drift-monitor score", labels=("stream", "monitor")
+)
+_DRIFT_ALERTS = _instruments.counter(
+    _instruments.DRIFT_ALERTS,
+    help="drift threshold crossings (hysteresis-latched)",
+    labels=("stream", "monitor"),
+)
+
+_SCOPE = threading.local()
+
+
+@contextmanager
+def stream_scope(stream: str) -> Generator[None, None, None]:
+    """Ambient stream/tenant label for drift bookkeeping on this thread —
+    the runtime wraps its compute paths in it so one shared metric instance
+    keeps per-tenant scores, latches, and gauge series apart."""
+    prev = getattr(_SCOPE, "stream", "")
+    _SCOPE.stream = str(stream)
+    try:
+        yield
+    finally:
+        _SCOPE.stream = prev
+
+
+def current_stream() -> str:
+    return getattr(_SCOPE, "stream", "")
+
+
+class DriftMonitor(_SketchBacked):
+    """Base class: live sketch vs frozen reference + threshold alerting.
+
+    Args:
+        reference: reference sample values (array-like) — binned once at
+            construction through this monitor's own sketch layout.
+        threshold: score at or above which a ``drift_alert`` fires.
+        hysteresis: re-arm margin — after an alert, the latch clears only
+            once the score drops below ``threshold - hysteresis``.
+        score_bins: PSI/KL are scored over this many **equal-reference-mass
+            groups** of sketch buckets (the classic "reference deciles"
+            practice, assignment frozen at construction): scoring directly
+            over thousands of fine sketch buckets would drown a real shift
+            in per-bucket sampling noise.  KS ignores it (a max-CDF-gap is
+            noise-robust at full resolution).
+        eps: probability floor for the PSI/KL ratio terms (ignored by KS).
+        name: monitor label for telemetry (default: the class name).
+        window / slots / levels / capacity / unit: sketch geometry
+            (:class:`~tpumetrics.monitoring.sketch._SketchBacked`).
+    """
+
+    higher_is_better = False
+
+    def __init__(
+        self,
+        reference: Any,
+        threshold: float = 0.25,
+        hysteresis: float = 0.0,
+        score_bins: int = 10,
+        eps: float = 1e-6,
+        name: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.threshold = float(threshold)
+        self.hysteresis = float(hysteresis)
+        self.eps = float(eps)
+        self.score_bins = int(score_bins)
+        if self.hysteresis < 0:
+            raise TPUMetricsUserError(f"hysteresis must be >= 0, got {hysteresis}")
+        if self.score_bins < 2:
+            raise TPUMetricsUserError(f"score_bins must be >= 2, got {score_bins}")
+        self.monitor_name = str(name) if name is not None else type(self).__name__
+        ref = np.asarray(jax.device_get(jnp.asarray(reference, self._dtype)))
+        ref = ref[np.isfinite(ref)]
+        if ref.size == 0:
+            raise TPUMetricsUserError(
+                f"{type(self).__name__} needs a non-empty finite reference sample."
+            )
+        layout = self._sketch_layout
+        row = np.asarray(
+            jax.device_get(
+                layout.update_row(layout.empty(1)[0], jnp.asarray(ref), jnp.ones(ref.shape))
+            )
+        )
+        counts = np.asarray(jax.device_get(layout.ordered_counts(jnp.asarray(row))))
+        self._ref_pmf = (counts / max(float(row[layout.total_index]), 1.0)).astype(np.float32)
+        # sketch bucket -> score-bin assignment at equal reference mass
+        # (midpoint-CDF rule; zero-mass tail buckets join the edge bins, so
+        # out-of-reference-range live data still shows up as edge-bin mass)
+        cdf = np.cumsum(self._ref_pmf, dtype=np.float64)
+        mid = cdf - 0.5 * self._ref_pmf
+        self._score_assign = np.clip(
+            (mid * self.score_bins).astype(np.int32), 0, self.score_bins - 1
+        )
+        self._ref_binned = np.bincount(
+            self._score_assign, weights=self._ref_pmf, minlength=self.score_bins
+        ).astype(np.float32)
+        # content hash of the binned reference: restoring a snapshot into a
+        # monitor frozen against a DIFFERENT reference must fail loudly, and
+        # a plain-scalar public attr rides _config_fingerprint for free
+        self.reference_digest = hashlib.sha1(counts.tobytes()).hexdigest()
+        # per-stream host bookkeeping: {stream: {score, active, alerts}},
+        # guarded by a lock — the evaluator's compute_every refresh runs
+        # compute() on the worker thread while user threads compute() too,
+        # and an unguarded check-then-act on the latch would double-page one
+        # crossing (the exactly-once contract)
+        self._stream_state: Dict[str, Dict[str, Any]] = {}
+        self._alert_lock = threading.Lock()
+
+    def _binned(self, pmf: Array) -> Array:
+        """Aggregate a full-resolution pmf into the frozen equal-reference-
+        mass score bins (pure; static assignment)."""
+        return jax.ops.segment_sum(
+            pmf, jnp.asarray(self._score_assign), num_segments=self.score_bins
+        )
+
+    # locks don't deepcopy/pickle: clone()/collection construction rebuild a
+    # fresh one (latch state itself is plain data and copies fine)
+    def __getstate__(self) -> Dict[str, Any]:
+        state = super().__getstate__()
+        state.pop("_alert_lock", None)
+        return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        super().__setstate__(state)
+        self._alert_lock = threading.Lock()
+
+    # ----------------------------------------------------------------- score
+
+    def _score(self, live_pmf: Array, ref_pmf: Array) -> Array:
+        raise NotImplementedError
+
+    def drift_score(self) -> Array:
+        """The pure score (no alerting side effects): live sketch pmf vs the
+        frozen reference pmf; ``0`` before any live data."""
+        layout = self._sketch_layout
+        row = self.merged_row()
+        live = layout.pmf(row)
+        score = self._score(live, jnp.asarray(self._ref_pmf))
+        return jnp.where(layout.total(row) > 0, score, 0.0)
+
+    def compute(self) -> Array:
+        score = self.drift_score()
+        self._maybe_alert(score)
+        return score
+
+    # -------------------------------------------------------------- alerting
+
+    def _runtime(self, stream: str) -> Dict[str, Any]:
+        entry = self._stream_state.get(stream)
+        if entry is None:
+            entry = {"score": None, "active": False, "alerts": 0}
+            self._stream_state[stream] = entry
+        return entry
+
+    def _maybe_alert(self, score: Any) -> None:
+        """Host-side: gauge refresh + hysteresis-latched threshold alert.
+        Inert inside a trace (a traced score has no concrete value to
+        compare — the runtime's compute paths are eager over concrete
+        states, which is where alerting belongs).  The whole read-modify-
+        write runs under the alert lock so a worker-thread compute_every
+        refresh racing a user-thread compute() cannot double-fire one
+        crossing."""
+        if isinstance(score, jax.core.Tracer):
+            return
+        value = float(score)
+        stream = current_stream()
+        with self._alert_lock:
+            entry = self._runtime(stream)
+            entry["score"] = value
+            if _instruments.enabled():
+                _DRIFT_GAUGE.set(value, stream, self.monitor_name)
+            if value >= self.threshold and not entry["active"]:
+                entry["active"] = True
+                entry["alerts"] += 1
+                if _instruments.enabled():
+                    _DRIFT_ALERTS.inc(1, stream, self.monitor_name)
+                _telemetry.record_event(
+                    self._active_backend(),
+                    "drift_alert",
+                    monitor=self.monitor_name,
+                    metric=type(self).__name__,
+                    stream=stream,
+                    score=value,
+                    threshold=self.threshold,
+                )
+            elif entry["active"] and value < self.threshold - self.hysteresis:
+                entry["active"] = False
+
+    def monitoring_entry(self, stream: Optional[str] = None) -> Dict[str, Any]:
+        """This monitor's telemetry view for one stream (``stats()``
+        ``"monitoring"`` section)."""
+        with self._alert_lock:
+            entry = dict(self._runtime(current_stream() if stream is None else stream))
+        return {
+            "monitor": type(self).__name__,
+            "score": entry["score"],
+            "threshold": self.threshold,
+            "hysteresis": self.hysteresis,
+            "alert_active": entry["active"],
+            "alerts": entry["alerts"],
+            "window": self.window,
+        }
+
+
+class PSI(DriftMonitor):
+    """Population stability index between the live sketch and the reference.
+
+    Example:
+        >>> import numpy as np
+        >>> from tpumetrics.monitoring import PSI
+        >>> rng = np.random.default_rng(0)
+        >>> ref = rng.normal(0.0, 1.0, 4000)
+        >>> m = PSI(reference=ref, threshold=0.25)
+        >>> m.update(rng.normal(0.0, 1.0, 4000))  # same distribution
+        >>> bool(m.compute() < 0.1)
+        True
+    """
+
+    def _score(self, live_pmf: Array, ref_pmf: Array) -> Array:
+        p = jnp.clip(self._binned(live_pmf), self.eps, 1.0)
+        q = jnp.clip(jnp.asarray(self._ref_binned), self.eps, 1.0)
+        return jnp.sum((p - q) * jnp.log(p / q))
+
+
+class KLDrift(DriftMonitor):
+    """``KL(live || reference)`` over the shared sketch bins.
+
+    Example:
+        >>> import numpy as np
+        >>> from tpumetrics.monitoring import KLDrift
+        >>> ref = np.arange(1.0, 1001.0)
+        >>> m = KLDrift(reference=ref, threshold=0.25)
+        >>> m.update(ref + 2000.0)  # the live stream moved entirely
+        >>> bool(m.compute() > 0.25)
+        True
+    """
+
+    def _score(self, live_pmf: Array, ref_pmf: Array) -> Array:
+        p = jnp.clip(self._binned(live_pmf), self.eps, 1.0)
+        q = jnp.clip(jnp.asarray(self._ref_binned), self.eps, 1.0)
+        return jnp.sum(p * jnp.log(p / q))
+
+
+class KSDistance(DriftMonitor):
+    """Kolmogorov–Smirnov distance between the live (windowed) histogram's
+    CDF and the reference CDF — scale-free, bounded in ``[0, 1]``, the usual
+    choice for "did the whole shape move" monitoring.
+
+    Example:
+        >>> import numpy as np
+        >>> from tpumetrics.monitoring import KSDistance
+        >>> ref = np.arange(1.0, 1001.0)
+        >>> m = KSDistance(reference=ref, threshold=0.5)
+        >>> m.update(ref)  # live matches the reference
+        >>> bool(m.compute() < 0.05)
+        True
+    """
+
+    def _score(self, live_pmf: Array, ref_pmf: Array) -> Array:
+        return jnp.max(jnp.abs(jnp.cumsum(live_pmf) - jnp.cumsum(ref_pmf)))
+
+
+# ----------------------------------------------------------- runtime surface
+
+
+def _iter_monitors(metric: Any):
+    from tpumetrics.collections import MetricCollection
+
+    if isinstance(metric, MetricCollection):
+        for key, member in metric._modules.items():
+            if isinstance(member, DriftMonitor):
+                yield key, member
+    elif isinstance(metric, DriftMonitor):
+        yield metric.monitor_name, metric
+
+
+def monitoring_stats(metric: Any, stream: str) -> Dict[str, Dict[str, Any]]:
+    """The ``stats()["monitoring"]`` section for one stream: every
+    :class:`DriftMonitor` in ``metric`` (a bare monitor or a collection
+    member), keyed by its collection key / monitor name.  Empty dict when
+    the metric carries no monitors."""
+    return {key: mon.monitoring_entry(stream) for key, mon in _iter_monitors(metric)}
+
+
+def release_stream(metric: Any, stream: str) -> None:
+    """Drop one stream's drift bookkeeping and its gauge/counter label
+    series — the monitoring side of the runtime's close() contract (auto-
+    minted stream labels must not leak dead series in construct-per-job
+    processes)."""
+    for _key, mon in _iter_monitors(metric):
+        with mon._alert_lock:
+            mon._stream_state.pop(stream, None)
+        _DRIFT_GAUGE.remove(stream, mon.monitor_name)
+        _DRIFT_ALERTS.remove(stream, mon.monitor_name)

@@ -1,0 +1,405 @@
+"""Mergeable quantile/histogram sketches as a first-class metric state kind.
+
+The sketch is a **log-linear histogram** (HDR-histogram-style compactor
+levels): ``levels`` geometric magnitude ranges, each split into ``capacity``
+linear buckets, one mirrored set per sign, plus exact total/min/max slots —
+all packed into ONE flat ``float32`` ``jax.Array`` so the whole sketch is a
+single fixed-shape, jit-compatible metric state:
+
+======================= ==================================================
+layout (last axis)      meaning
+======================= ==================================================
+``[0, L*k)``            positive-magnitude counts, level-major
+``[L*k, 2*L*k)``        negative-magnitude counts, level-major
+``[2*L*k]``             total observation count (exact)
+``[2*L*k + 1]``         exact min (identity ``+inf``)
+``[2*L*k + 2]``         exact max (identity ``-inf``)
+======================= ==================================================
+
+Level 0 covers magnitudes ``[0, unit)`` with linear buckets of width
+``unit/capacity``; level ``l >= 1`` covers ``[unit*2**(l-1), unit*2**l)``
+with ``capacity`` linear buckets each.  Quantile estimates therefore carry a
+**relative error <= 1/capacity** for magnitudes in
+``[unit, unit*2**(levels-1))`` and an absolute error ``<= unit/capacity``
+below ``unit`` (values past the top level clip into the last bucket; the
+exact max slot still bounds upper quantiles).  Counts are integers stored in
+float32 — exact up to ``2**24`` observations per bucket.
+
+Why this shape: the merge of two sketches is an **elementwise sum of the
+count slots plus min/max of the extrema slots** — associative, commutative,
+and bit-identical under any fold order (integer-valued float adds are
+exact), which is precisely the contract ``dist_reduce_fx`` needs.  The
+sketch registers through ``add_state(..., dist_reduce_fx=sketch_merge(...))``
+— an :class:`~tpumetrics.parallel.merge.AssociativeMerge` whose declared
+identity is the empty sketch — so the existing fold/reshard, elastic-cut,
+and GSPMD machinery handle it like any other state: elastic reshard places
+the folded sketch on rank 0 and empties elsewhere (mirroring
+``cat_placement="rank0"``), and the sharded step keeps it replicated with
+the merge lowered to the collective.
+
+**Windowing**: sketch-backed metrics optionally keep a ring of ``slots``
+sub-sketches (shape ``(slots, N)``), each covering ``window/slots``
+consecutive updates; rotating into a slot resets just that row — O(1)
+device-side eviction, fixed shapes, no retrace (the ring index is a traced
+function of the ``count`` state).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.metric import Metric
+from tpumetrics.parallel.merge import AssociativeMerge
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+Array = jax.Array
+
+__all__ = [
+    "SketchLayout",
+    "SketchQuantiles",
+    "empty_sketch",
+    "sketch_merge",
+]
+
+
+def _require_static_int(value: Any, name: str) -> int:
+    """Sketch/window geometry is state SHAPE — it must be a concrete python
+    int (a traced or data-dependent value would change shapes per step and
+    retrace every update; tpulint flags windowed cases as TPL305).  A
+    non-integral float is rejected too — silently truncating 2.5 to a
+    2-update window would monitor something the caller never asked for."""
+    if isinstance(value, (jax.core.Tracer, jax.Array, np.ndarray)) or isinstance(value, bool):
+        raise TPUMetricsUserError(
+            f"`{name}` must be a static python int (got {type(value).__name__}): "
+            "it determines state shapes, and a data-dependent value would "
+            "retrace the update step every call (tpulint TPL305)."
+        )
+    if int(value) != value:
+        raise TPUMetricsUserError(
+            f"`{name}` must be a static python int, got {value!r} (refusing to "
+            "silently truncate; tpulint TPL305 flags non-int window literals)."
+        )
+    return int(value)
+
+
+class SketchLayout:
+    """Static geometry of one sketch row: index math, representative values,
+    and the merge/identity pair.  Hash/eq by parameters so equal layouts
+    share jit caches.
+
+    ``unit`` defaults to ``2**(24 - levels)``, anchoring the TOP of the
+    covered range at ``unit * 2**(levels-1) = 2**23 ≈ 8.4e6`` regardless of
+    ``levels`` — so shrinking ``levels`` coarsens precision near zero
+    instead of silently cutting the range off at tiny magnitudes (a
+    levels=16 sketch with a bottom-anchored unit would top out at 0.03 and
+    clip every real-world latency/score into one bucket).  Set ``unit``
+    explicitly when small magnitudes need relative precision."""
+
+    def __init__(
+        self, levels: int = 44, capacity: int = 64, unit: Optional[float] = None
+    ) -> None:
+        self.levels = _require_static_int(levels, "levels")
+        self.capacity = _require_static_int(capacity, "capacity")
+        self.unit = float(unit) if unit is not None else 2.0 ** (24 - self.levels)
+        if self.levels < 2 or self.capacity < 2:
+            raise TPUMetricsUserError(
+                f"Sketch needs levels >= 2 and capacity >= 2, got levels={self.levels}, "
+                f"capacity={self.capacity}"
+            )
+        if not (self.unit > 0.0 and math.isfinite(self.unit)):
+            raise TPUMetricsUserError(f"Sketch unit must be a positive finite float, got {unit}")
+        self.side = self.levels * self.capacity  # buckets per sign
+        self.total_index = 2 * self.side
+        self.min_index = 2 * self.side + 1
+        self.max_index = 2 * self.side + 2
+        self.width = 2 * self.side + 3  # N: flat row length
+        # representative (midpoint) magnitude per positive bucket, level-major
+        lvl = np.repeat(np.arange(self.levels), self.capacity)
+        j = np.tile(np.arange(self.capacity), self.levels)
+        lo = np.where(lvl == 0, 0.0, self.unit * 2.0 ** (lvl - 1))
+        width = np.where(lvl == 0, self.unit, self.unit * 2.0 ** (lvl - 1)) / self.capacity
+        self._reps = (lo + (j + 0.5) * width).astype(np.float32)
+        # canonical ascending value order: negatives (magnitude descending)
+        # then positives (magnitude ascending)
+        self._ordered_reps = np.concatenate([-self._reps[::-1], self._reps]).astype(np.float32)
+
+    @property
+    def params(self) -> dict:
+        return {"levels": self.levels, "capacity": self.capacity, "unit": self.unit}
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, SketchLayout) and self.params == other.params
+
+    def __hash__(self) -> int:
+        return hash((self.levels, self.capacity, self.unit))
+
+    def __repr__(self) -> str:
+        return f"SketchLayout(levels={self.levels}, capacity={self.capacity}, unit={self.unit!r})"
+
+    # ------------------------------------------------------------- ingestion
+
+    def bucket_index(self, values: Array) -> Array:
+        """Flat count-slot index per value (sign-mirrored, level-major);
+        trace-safe, static output shape."""
+        a = jnp.abs(values)
+        safe = jnp.maximum(a, jnp.asarray(self.unit, values.dtype) * 2.0**-40)
+        # clip in FLOAT space before the int cast: floor(log2(inf)) cast to
+        # int32 saturates to INT32_MAX and the +1 would wrap to INT32_MIN,
+        # sending an inf outlier to the near-zero bucket instead of the
+        # documented top-bucket clip
+        lvl = jnp.clip(
+            jnp.floor(jnp.log2(safe / self.unit)) + 1.0, 0, self.levels - 1
+        ).astype(jnp.int32)
+        lo = jnp.where(lvl == 0, 0.0, self.unit * jnp.exp2((lvl - 1).astype(values.dtype)))
+        width = jnp.where(lvl == 0, self.unit, self.unit * jnp.exp2((lvl - 1).astype(values.dtype)))
+        j = jnp.clip(((a - lo) * self.capacity / width).astype(jnp.int32), 0, self.capacity - 1)
+        flat = lvl * self.capacity + j
+        return jnp.where(values < 0, flat + self.side, flat)
+
+    def update_row(self, row: Array, values: Array, weights: Array) -> Array:
+        """One sketch-row transition: scatter-add ``weights`` at each value's
+        bucket, bump total, refresh exact min/max (weight-0 rows are inert).
+        Pure and traceable; static shapes throughout."""
+        values = values.reshape(-1)
+        weights = weights.reshape(-1).astype(row.dtype)
+        counts = row[: self.total_index].at[self.bucket_index(values)].add(weights)
+        total = row[self.total_index] + jnp.sum(weights)
+        live = weights > 0
+        # initial= keeps a zero-size batch a neutral no-op
+        minv = jnp.minimum(
+            row[self.min_index], jnp.min(jnp.where(live, values, jnp.inf), initial=jnp.inf)
+        )
+        maxv = jnp.maximum(
+            row[self.max_index], jnp.max(jnp.where(live, values, -jnp.inf), initial=-jnp.inf)
+        )
+        return jnp.concatenate([counts, total[None], minv[None], maxv[None]])
+
+    # ----------------------------------------------------------------- fold
+
+    def empty(self, panes: int = 1) -> Array:
+        """The merge identity: zero counts, ``+inf`` min, ``-inf`` max — one
+        ``(panes, N)`` ring of empty sub-sketch rows (``panes=1`` for an
+        unwindowed sketch)."""
+        row = np.zeros((self.width,), np.float32)
+        row[self.min_index] = np.inf
+        row[self.max_index] = -np.inf
+        return jnp.asarray(np.broadcast_to(row, (int(panes), self.width)).copy())
+
+    def merge(self, stacked: Array) -> Array:
+        """Fold a rank-stacked sketch state ``(R, ..., N)`` along axis 0:
+        counts (and the total slot) sum, min/max slots fold with min/max.
+        Associative, commutative, and bit-identical under any fold order
+        (counts are integer-valued floats)."""
+        counts = jnp.sum(stacked[..., : self.total_index + 1], axis=0)
+        minv = jnp.min(stacked[..., self.min_index : self.min_index + 1], axis=0)
+        maxv = jnp.max(stacked[..., self.max_index : self.max_index + 1], axis=0)
+        return jnp.concatenate([counts, minv, maxv], axis=-1)
+
+    def merge_panes(self, ring: Array) -> Array:
+        """Collapse a ``(panes, N)`` ring into one logical sketch row — the
+        same fold as :meth:`merge`, over the pane axis."""
+        return self.merge(ring)
+
+    def identity_like(self, value: Any) -> Array:
+        """The merge identity shaped like ``value`` (a method, not a
+        closure, so sketch metrics stay picklable mid-stream)."""
+        shape = tuple(jnp.shape(value))
+        panes = int(np.prod(shape[:-1])) if len(shape) > 1 else 1
+        return self.empty(panes).reshape(shape)
+
+    # ---------------------------------------------------------------- reading
+
+    def total(self, row: Array) -> Array:
+        return row[..., self.total_index]
+
+    def ordered_counts(self, row: Array) -> Array:
+        """Counts in canonical ascending value order (most negative first)."""
+        pos = row[..., : self.side]
+        neg = row[..., self.side : self.total_index]
+        return jnp.concatenate([neg[..., ::-1], pos], axis=-1)
+
+    def pmf(self, row: Array, eps: float = 0.0) -> Array:
+        """Bucket probability masses in canonical order; an empty sketch
+        yields all-zeros.  ``eps`` floors each mass (drift-score smoothing)."""
+        counts = self.ordered_counts(row)
+        total = jnp.maximum(self.total(row), 1.0)
+        p = counts / total
+        return jnp.maximum(p, eps) if eps else p
+
+    def quantile(self, row: Array, q: Any) -> Array:
+        """Quantile estimate(s) from one logical sketch row: bucket-midpoint
+        lookup on the cumulative counts, clamped into the exact
+        ``[min, max]`` envelope.  ``q`` may be a scalar or a vector; an empty
+        sketch returns NaN."""
+        qs = jnp.asarray(q, jnp.float32)
+        counts = self.ordered_counts(row)
+        cdf = jnp.cumsum(counts)
+        total = self.total(row)
+        idx = jnp.clip(
+            jnp.searchsorted(cdf, qs * total, side="left"), 0, 2 * self.side - 1
+        )
+        est = jnp.asarray(self._ordered_reps)[idx]
+        est = jnp.clip(est, row[..., self.min_index], row[..., self.max_index])
+        return jnp.where(total > 0, est, jnp.nan)
+
+
+def empty_sketch(layout: SketchLayout, panes: int = 1) -> Array:
+    """The sketch state default — the merge identity (tpulint TPL301 for the
+    callable-merge kind: a non-identity default would double-count on every
+    cross-rank fold)."""
+    return layout.empty(panes)
+
+
+def sketch_merge(layout: SketchLayout) -> AssociativeMerge:
+    """The sketch's ``dist_reduce_fx``: an
+    :class:`~tpumetrics.parallel.merge.AssociativeMerge` wrapping
+    :meth:`SketchLayout.merge` with the empty sketch as its declared
+    identity, carrying the layout parameters so snapshot spec mismatches
+    name them (capacity/levels/unit).  Built from bound layout methods (no
+    closures), so sketch metrics pickle/deepcopy mid-stream."""
+    return AssociativeMerge(
+        layout.merge, layout.identity_like, name="sketch", params=layout.params
+    )
+
+
+def ring_position(count: Array, pane_updates: int, slots: int) -> Tuple[Array, Array]:
+    """``(slot index, is-first-update-of-its-pane)`` for the ``count``-th
+    update of a ``slots``-slot ring whose panes span ``pane_updates``
+    updates each.  THE one copy of the window-rotation math — the windowed
+    aggregators and the sketch ring share it, which is what keeps the two
+    families' pane alignment (and the lockstep mid-window resize guarantee)
+    bit-identical."""
+    idx = jnp.mod(count // pane_updates, slots)
+    fresh = jnp.equal(jnp.mod(count, pane_updates), 0)
+    return idx, fresh
+
+
+def _broadcast_rowmask(mask: Array, like: Array) -> Array:
+    """Expand a per-row ``valid`` mask to ``like``'s shape (mask covers the
+    leading dims; trailing feature dims broadcast)."""
+    mask = jnp.asarray(mask)
+    extra = like.ndim - mask.ndim
+    if extra > 0:
+        mask = mask.reshape(mask.shape + (1,) * extra)
+    return jnp.broadcast_to(mask, like.shape)
+
+
+class _SketchBacked(Metric):
+    """Shared machinery for sketch-state metrics: the ``(slots, N)`` ring
+    state, the pane-rotating trace-safe update (native ``valid`` mask
+    protocol — exact under the runtime's bucketed/megabatch paths), and the
+    merged logical-row reader.
+
+    ``window`` (in ``update()`` calls) splits into ``slots`` sub-sketches of
+    ``window/slots`` updates each; rotation resets one ring row (O(1)
+    eviction).  ``window=None`` keeps one cumulative sketch.
+    """
+
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        levels: int = 44,
+        capacity: int = 64,
+        unit: Optional[float] = None,
+        window: Optional[int] = None,
+        slots: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        layout = SketchLayout(levels=levels, capacity=capacity, unit=unit)
+        self._sketch_layout = layout
+        self.levels = layout.levels
+        self.capacity = layout.capacity
+        self.unit = layout.unit
+        if window is None:
+            self.window = None
+            self.slots = 1
+        else:
+            self.window = _require_static_int(window, "window")
+            if self.window < 1:
+                raise TPUMetricsUserError(f"window must be >= 1 update, got {self.window}")
+            if slots is None:
+                # largest divisor of the window <= 8: any window constructs
+                slots = max(s for s in range(1, min(self.window, 8) + 1) if self.window % s == 0)
+            self.slots = _require_static_int(slots, "slots")
+            if self.slots < 1 or self.window % self.slots:
+                raise TPUMetricsUserError(
+                    f"window ({self.window}) must divide evenly into slots ({self.slots}) "
+                    "sub-windows (pane size = window // slots)."
+                )
+        self._pane_updates = (self.window // self.slots) if self.window else 1
+        self.add_state(
+            "sketch",
+            default=empty_sketch(layout, self.slots),
+            dist_reduce_fx=sketch_merge(layout),
+        )
+        # lockstep tick counter driving the pane ring; ranks hold identical
+        # values, so the idempotent max-fold is the correct merge
+        self.add_state("count", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="max")  # tpulint: disable=TPL301 -- lockstep tick counter: ranks hold identical nonnegative counts, so 0 is the fold identity on this domain
+
+    def update(self, value: Any, valid: Optional[Array] = None) -> None:
+        """Fold one batch of samples into the current sub-window's sketch.
+
+        ``valid`` is the runtime's native bucket mask (per leading row);
+        masked and NaN samples contribute zero weight.  Every call ticks the
+        window by one update regardless of the mask."""
+        v = jnp.asarray(value, self._dtype)
+        v = jnp.atleast_1d(v)
+        w = jnp.ones_like(v)
+        if valid is not None:
+            w = w * _broadcast_rowmask(valid, v).astype(v.dtype)
+        nan = jnp.isnan(v)
+        w = jnp.where(nan, 0.0, w)
+        v = jnp.where(nan, 0.0, v)
+
+        layout = self._sketch_layout
+        if self.window is None:
+            self.sketch = layout.update_row(self.sketch[0], v, w)[None, :]
+        else:
+            idx, fresh = ring_position(self.count, self._pane_updates, self.slots)
+            base = jnp.where(fresh, layout.empty(1)[0], self.sketch[idx])
+            self.sketch = self.sketch.at[idx].set(layout.update_row(base, v, w))
+        self.count = self.count + 1
+
+    def merged_row(self) -> Array:
+        """The ring collapsed to one logical sketch row (pure)."""
+        return self._sketch_layout.merge_panes(self.sketch)
+
+    def compute(self) -> Any:  # pragma: no cover - abstract-ish
+        raise NotImplementedError
+
+
+class SketchQuantiles(_SketchBacked):
+    """Streaming quantiles over an unbounded (optionally windowed) stream.
+
+    ``compute()`` returns one estimate per requested quantile, with relative
+    error ``<= 1/capacity`` inside the sketch's magnitude range
+    (:mod:`tpumetrics.monitoring.sketch` module docstring has the exact
+    bounds).  State is a fixed-shape mergeable sketch: cross-rank sync,
+    snapshots, elastic resize, and the fused/bucketed runtime paths all work
+    like any reduce-op metric.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.monitoring import SketchQuantiles
+        >>> m = SketchQuantiles(quantiles=(0.5,), capacity=128)
+        >>> m.update(jnp.arange(1.0, 101.0))
+        >>> bool(abs(float(m.compute()) - 50.0) < 1.0)
+        True
+    """
+
+    def __init__(self, quantiles: Sequence[float] = (0.5, 0.9, 0.99), **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        qs = tuple(float(q) for q in quantiles)
+        if not qs or any(not (0.0 <= q <= 1.0) for q in qs):
+            raise TPUMetricsUserError(f"quantiles must be within [0, 1], got {quantiles}")
+        self.quantiles = qs
+
+    def compute(self) -> Array:
+        return self._sketch_layout.quantile(self.merged_row(), jnp.asarray(self.quantiles))

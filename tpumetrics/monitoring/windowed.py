@@ -1,0 +1,299 @@
+"""Sliding-window and exponentially-decayed aggregators for unbounded streams.
+
+The run-to-completion aggregators (``tpumetrics.aggregation``) answer "what
+is the mean/sum/extremum of *everything* seen so far" — the right question
+for batch eval, the wrong one for serving: a monitoring stream never ends,
+and "the metric" is the last N minutes, not the lifetime total.  Two
+fixed-shape answers, both trace-safe and exact under the runtime's bucketed
+paths:
+
+- **Sliding window** (:class:`WindowedMean` / :class:`WindowedSum` /
+  :class:`WindowedMax` / :class:`WindowedMin`): a ring buffer of ``slots``
+  **sub-window states**, each covering ``window // slots`` consecutive
+  ``update()`` calls.  An update folds the batch into the current slot;
+  rotating into a slot resets just that slot — eviction is O(1) device work
+  (one dynamic-index write), state shapes are static (``(slots,)``), and the
+  ring index is a traced function of the ``count`` state, so nothing
+  retraces.  With ``slots == window`` (the default) the window is exact;
+  coarser ``slots`` trade pane-granularity staleness (the window covers
+  between ``window - pane + 1`` and ``window`` most recent updates, ``pane =
+  window // slots``) for ``slots``-sized state.
+- **Exponential decay** (:class:`DecayedMean`): half-life-parameterized
+  running mean — every update multiplies the accumulated sum/weight by
+  ``alpha = 2**(-1/half_life)`` before adding the batch, so an observation's
+  influence halves every ``half_life`` updates.  Two scalars of state.
+
+Distribution contract: slot/decayed accumulators are per-rank *shares* of
+each sub-window (``dist_reduce_fx="sum"``, extrema ``"max"``/``"min"``), and
+the ``count`` tick is lockstep-identical across ranks (``"max"`` — the
+idempotent fold).  That means windows fit the existing merge/reshard and
+elastic machinery unchanged: reshard places slot sums on rank 0 (zeros
+elsewhere) and broadcasts ticks/extrema, and a later fold — plus whatever
+the resized world accumulates — reproduces the uninterrupted window exactly
+(windows are "exactly once" across preemptions because slot content is
+ordinary snapshot state).
+
+Window length is **static by design** (it is state shape): passing a traced
+or data-dependent ``window`` raises here, and tpulint flags literal
+occurrences as TPL305.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.metric import Metric
+from tpumetrics.monitoring.sketch import (
+    _broadcast_rowmask,
+    _require_static_int,
+    ring_position,
+)
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+Array = jax.Array
+
+__all__ = [
+    "DecayedMean",
+    "WindowedMax",
+    "WindowedMean",
+    "WindowedMin",
+    "WindowedSum",
+]
+
+
+class _WindowedAggregator(Metric):
+    """Ring-of-sub-window-states base: window bookkeeping + the trace-safe
+    pane rotation.  Subclasses declare their slot states and fold batches
+    via :meth:`_write_slot`."""
+
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        window: int,
+        slots: Optional[int] = None,
+        nan_strategy: Union[str, float] = "ignore",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.window = _require_static_int(window, "window")
+        if self.window < 1:
+            raise TPUMetricsUserError(f"window must be >= 1 update, got {self.window}")
+        self.slots = _require_static_int(slots if slots is not None else self.window, "slots")
+        if self.slots < 1 or self.slots > self.window or self.window % self.slots:
+            raise TPUMetricsUserError(
+                f"slots ({self.slots}) must evenly divide window ({self.window}): each "
+                "slot covers window // slots consecutive updates."
+            )
+        if nan_strategy not in ("ignore", "disable") and not isinstance(nan_strategy, float):
+            raise TPUMetricsUserError(
+                "Windowed aggregators are trace-first: nan_strategy must be 'ignore', "
+                f"'disable', or a float fill value, got {nan_strategy!r}"
+            )
+        self.nan_strategy = nan_strategy
+        self._pane_updates = self.window // self.slots
+        # lockstep tick counter driving the ring; ranks hold identical values
+        self.add_state("count", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="max")  # tpulint: disable=TPL301 -- lockstep tick counter: ranks hold identical nonnegative counts, so 0 is the fold identity on this domain
+
+    # ------------------------------------------------------------- ingestion
+
+    def _prepare(self, value: Any, weight: Any, valid: Optional[Array], neutral: float):
+        """Batch → (values, weights) with the ``valid`` bucket mask and the
+        NaN policy applied as pure masking (masked rows carry zero weight and
+        the reduction's neutral element)."""
+        v = jnp.atleast_1d(jnp.asarray(value, self._dtype))
+        w = jnp.broadcast_to(jnp.asarray(weight, self._dtype), v.shape)
+        if valid is not None:
+            w = w * _broadcast_rowmask(valid, v).astype(v.dtype)
+        if self.nan_strategy != "disable":
+            nan = jnp.isnan(v) | jnp.isnan(w)
+            if isinstance(self.nan_strategy, float):
+                v = jnp.where(nan, self.nan_strategy, v)
+                w = jnp.where(jnp.isnan(w), 0.0, w)
+            else:  # "ignore": masked out entirely
+                v = jnp.where(nan, neutral, v)
+                w = jnp.where(nan, 0.0, w)
+        dead = w == 0
+        return jnp.where(dead, neutral, v), w
+
+    def _write_slot(self, name: str, batch_value: Array, neutral: float, combine) -> None:
+        """Fold ``batch_value`` into the current pane's slot of state
+        ``name``; the first update of a pane resets (evicts) the slot first.
+        One dynamic-index write — O(1) in the window length."""
+        slots = getattr(self, name)
+        idx, fresh = ring_position(self.count, self._pane_updates, self.slots)
+        base = jnp.where(fresh, jnp.asarray(neutral, slots.dtype), slots[idx])
+        setattr(self, name, slots.at[idx].set(combine(base, batch_value)))
+
+    def _tick(self) -> None:
+        self.count = self.count + 1
+
+
+class WindowedMean(_WindowedAggregator):
+    """(Weighted) mean over the last ``window`` updates.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from tpumetrics.monitoring import WindowedMean
+        >>> m = WindowedMean(window=2)
+        >>> for x in (1.0, 2.0, 3.0, 4.0):
+        ...     m.update(x)
+        >>> float(m.compute())  # mean of the last 2 updates
+        3.5
+    """
+
+    def __init__(self, window: int, slots: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(window, slots=slots, **kwargs)
+        self.add_state("slot_sum", default=jnp.zeros((self.slots,)), dist_reduce_fx="sum")
+        self.add_state("slot_weight", default=jnp.zeros((self.slots,)), dist_reduce_fx="sum")
+
+    def update(
+        self, value: Any, weight: Any = 1.0, valid: Optional[Array] = None
+    ) -> None:
+        v, w = self._prepare(value, weight, valid, neutral=0.0)
+        self._write_slot("slot_sum", jnp.sum(v * w), 0.0, jnp.add)
+        self._write_slot("slot_weight", jnp.sum(w), 0.0, jnp.add)
+        self._tick()
+
+    def compute(self) -> Array:
+        return jnp.sum(self.slot_sum) / jnp.sum(self.slot_weight)
+
+
+class WindowedSum(_WindowedAggregator):
+    """Sum over the last ``window`` updates.
+
+    Example:
+        >>> from tpumetrics.monitoring import WindowedSum
+        >>> m = WindowedSum(window=2)
+        >>> for x in (1.0, 2.0, 3.0):
+        ...     m.update(x)
+        >>> float(m.compute())
+        5.0
+    """
+
+    def __init__(self, window: int, slots: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(window, slots=slots, **kwargs)
+        self.add_state("slot_sum", default=jnp.zeros((self.slots,)), dist_reduce_fx="sum")
+
+    def update(self, value: Any, valid: Optional[Array] = None) -> None:
+        v, w = self._prepare(value, 1.0, valid, neutral=0.0)
+        self._write_slot("slot_sum", jnp.sum(v * w), 0.0, jnp.add)
+        self._tick()
+
+    def compute(self) -> Array:
+        return jnp.sum(self.slot_sum)
+
+
+class WindowedMax(_WindowedAggregator):
+    """Max over the last ``window`` updates (``-inf`` before any data).
+
+    Example:
+        >>> from tpumetrics.monitoring import WindowedMax
+        >>> m = WindowedMax(window=2)
+        >>> for x in (9.0, 1.0, 2.0):
+        ...     m.update(x)
+        >>> float(m.compute())  # the 9 has slid out
+        2.0
+    """
+
+    def __init__(self, window: int, slots: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(window, slots=slots, **kwargs)
+        self.add_state(
+            "slot_max", default=jnp.full((self.slots,), -jnp.inf), dist_reduce_fx="max"
+        )
+
+    def update(self, value: Any, valid: Optional[Array] = None) -> None:
+        v, _w = self._prepare(value, 1.0, valid, neutral=-jnp.inf)
+        # initial= keeps a zero-size batch a neutral no-op (still ticks)
+        self._write_slot("slot_max", jnp.max(v, initial=-jnp.inf), -jnp.inf, jnp.maximum)
+        self._tick()
+
+    def compute(self) -> Array:
+        return jnp.max(self.slot_max)
+
+
+class WindowedMin(_WindowedAggregator):
+    """Min over the last ``window`` updates (``+inf`` before any data).
+
+    Example:
+        >>> from tpumetrics.monitoring import WindowedMin
+        >>> m = WindowedMin(window=2)
+        >>> for x in (0.5, 3.0, 2.0):
+        ...     m.update(x)
+        >>> float(m.compute())
+        2.0
+    """
+
+    def __init__(self, window: int, slots: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(window, slots=slots, **kwargs)
+        self.add_state(
+            "slot_min", default=jnp.full((self.slots,), jnp.inf), dist_reduce_fx="min"
+        )
+
+    def update(self, value: Any, valid: Optional[Array] = None) -> None:
+        v, _w = self._prepare(value, 1.0, valid, neutral=jnp.inf)
+        self._write_slot("slot_min", jnp.min(v, initial=jnp.inf), jnp.inf, jnp.minimum)
+        self._tick()
+
+    def compute(self) -> Array:
+        return jnp.min(self.slot_min)
+
+
+class DecayedMean(Metric):
+    """Exponentially-decayed (weighted) mean: each ``update()`` halves the
+    influence of observations ``half_life`` updates old.
+
+    Unlike a sliding window there is no eviction at all — two scalars of
+    state (`decayed sum` and `decayed weight`, both ``dist_reduce_fx="sum"``)
+    and one multiply-add per update, so it is the cheapest "recent average"
+    for serving dashboards.  ``half_life`` is measured in ``update()`` calls
+    and must be a static number (it parameterizes the trace, not the state
+    shape).
+
+    Example:
+        >>> from tpumetrics.monitoring import DecayedMean
+        >>> m = DecayedMean(half_life=1)
+        >>> for x in (0.0, 0.0, 8.0):
+        ...     m.update(x)
+        >>> round(float(m.compute()), 4)  # recent 8 dominates: (8 + 0/2 + 0/4) / (1 + 1/2 + 1/4)
+        4.5714
+    """
+
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update: bool = False
+
+    def __init__(self, half_life: float = 100.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if isinstance(half_life, (jax.core.Tracer, jax.Array)):
+            raise TPUMetricsUserError(
+                "half_life must be a static python number: it parameterizes the "
+                "compiled update, and a traced value would retrace every step."
+            )
+        self.half_life = float(half_life)
+        if not self.half_life > 0:
+            raise TPUMetricsUserError(f"half_life must be > 0 updates, got {half_life}")
+        self._alpha = 2.0 ** (-1.0 / self.half_life)
+        self.add_state("decayed_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("decayed_weight", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(
+        self, value: Any, weight: Any = 1.0, valid: Optional[Array] = None
+    ) -> None:
+        v = jnp.atleast_1d(jnp.asarray(value, self._dtype))
+        w = jnp.broadcast_to(jnp.asarray(weight, self._dtype), v.shape)
+        if valid is not None:
+            w = w * _broadcast_rowmask(valid, v).astype(v.dtype)
+        nan = jnp.isnan(v) | jnp.isnan(w)
+        v = jnp.where(nan, 0.0, v)
+        w = jnp.where(nan, 0.0, w)
+        self.decayed_sum = self.decayed_sum * self._alpha + jnp.sum(v * w)
+        self.decayed_weight = self.decayed_weight * self._alpha + jnp.sum(w)
+
+    def compute(self) -> Array:
+        return self.decayed_sum / self.decayed_weight

@@ -11,6 +11,7 @@ from tpumetrics.parallel.backend import (
     set_default_backend,
 )
 from tpumetrics.parallel.fuse_update import FusedCollectionStep, UnhashableKwargsError
+from tpumetrics.parallel.merge import AssociativeMerge
 from tpumetrics.parallel.sharding import (
     StatePartitionRules,
     make_mesh,
@@ -19,6 +20,7 @@ from tpumetrics.parallel.sharding import (
 )
 
 __all__ = [
+    "AssociativeMerge",
     "AxisBackend",
     "DistributedBackend",
     "FusedCollectionStep",

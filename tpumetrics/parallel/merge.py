@@ -37,6 +37,67 @@ def _state_label(owner: Optional[str], name: str) -> str:
     return f"{owner}.{name}" if owner else name
 
 
+class AssociativeMerge:
+    """A custom ``dist_reduce_fx`` with a declared identity — the contract
+    that turns a callable-merge state into a first-class **mergeable state
+    kind** (the "sketch" kind of ``tpumetrics.monitoring``).
+
+    A plain callable reduce can fold (:func:`merge_metric_states` stacks the
+    per-rank values and calls it) but cannot be elastically *resharded*:
+    without knowing the merge's identity element there is no way to split
+    one global value back into per-rank shares such that a later fold
+    reproduces it.  Declaring the identity closes that gap:
+
+    - **fold**: ``fn(stacked)`` over a rank-stacked array — the caller
+      promises ``fn`` is associative and commutative (quantile-sketch
+      merges, count merges, min/max-composites all are), so fold order
+      never matters and elastic cuts/megabatch paths stay deterministic.
+    - **reshard**: the folded value lands whole on rank 0 and every other
+      rank receives ``identity_like(value)`` — mirroring
+      ``cat_placement="rank0"`` for row states: a later merge over the
+      resharded ranks (plus whatever they accumulate) reproduces the
+      uninterrupted global value exactly.
+
+    Args:
+        fn: ``(stacked: (R, *state_shape)) -> (*state_shape)`` associative
+            commutative fold over the leading rank axis.
+        identity_like: ``(value) -> identity`` returning the merge identity
+            with ``value``'s shape/dtype (what an empty rank contributes).
+        name: short kind label (``state_spec()`` reports ``merge:<name>``).
+        params: JSON-able declaration parameters (e.g. a sketch's
+            ``capacity``/``levels``) — snapshot spec mismatches name them,
+            like ``_config_fingerprint`` names classification configs.
+    """
+
+    def __init__(
+        self,
+        fn: Callable[[Any], Any],
+        identity_like: Callable[[Any], Any],
+        name: str = "merge",
+        params: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self._fn = fn
+        self._identity_like = identity_like
+        self.name = str(name)
+        self.params = dict(params or {})
+
+    def __call__(self, stacked: Any) -> Any:
+        return self._fn(stacked)
+
+    def identity_like(self, value: Any) -> Any:
+        """The merge identity, shaped/typed like ``value`` (an empty-rank
+        contribution: ``fn(stack([x, identity_like(x)])) == x``)."""
+        return self._identity_like(value)
+
+    def describe(self) -> str:
+        """Human label for spec errors: ``merge:<name>(k=v, ...)``."""
+        inner = ", ".join(f"{k}={v}" for k, v in sorted(self.params.items()))
+        return f"merge:{self.name}({inner})" if inner else f"merge:{self.name}"
+
+    def __repr__(self) -> str:
+        return f"AssociativeMerge({self.describe()})"
+
+
 def merge_metric_states(
     states: List[Dict[str, Any]],
     reductions: Dict[str, Optional[Union[str, Callable]]],
@@ -162,9 +223,13 @@ def reshard_metric_states(
       ``"balanced"`` splits rows contiguously across ranks (use for
       order-insensitive states, or when a shrink would overflow rank 0's
       buffer capacity).
-    - **reduce-``None`` array** states (per-rank stacks) and **custom
-      callable** reductions have no generic inverse: both raise instead of
-      guessing.
+    - :class:`AssociativeMerge` states (mergeable sketches): the folded
+      value lands whole on rank 0, every other rank gets the declared merge
+      identity (an empty sketch) — the exact analogue of
+      ``cat_placement="rank0"`` for the callable-merge state kind.
+    - **reduce-``None`` array** states (per-rank stacks) and **bare custom
+      callable** reductions (no declared identity) have no generic inverse:
+      both raise instead of guessing.
 
     ``templates`` supplies per-rank default leaves where the global value
     alone cannot determine the per-rank shape (MaskedBuffer capacities).
@@ -223,11 +288,18 @@ def reshard_metric_states(
                 "meaning, so it cannot be resharded elastically (the static analyzer "
                 "flags these declarations as TPL303)."
             )
+        elif isinstance(reduction_fn, AssociativeMerge):
+            # sketch-kind state: fold result whole on rank 0, declared merge
+            # identity (an empty sketch) everywhere else — a later fold over
+            # the ranks reproduces the global sketch exactly
+            out[name] = arr if rank == 0 else reduction_fn.identity_like(arr)
         elif callable(reduction_fn):
             raise TPUMetricsUserError(
                 f"State {label!r} uses a custom reduce function; elastic resharding has "
                 "no generic inverse for it. Register the state with one of "
-                "'sum'/'mean'/'max'/'min'/'cat' to make it elastic-restorable."
+                "'sum'/'mean'/'max'/'min'/'cat', or wrap the merge in "
+                "tpumetrics.parallel.merge.AssociativeMerge (declared identity) "
+                "to make it elastic-restorable."
             )
         else:
             raise TypeError(f"reduction for state {label!r} must be callable or None")

@@ -188,7 +188,14 @@ class StatePartitionRules:
         ``values`` shard along ``data_axis`` (their row/concat axis carries
         per-example data); every reduce-op scalar/array state stays
         replicated, which is what lets GSPMD lower its ``dist_reduce_fx``
-        to an in-trace all-reduce."""
+        to an in-trace all-reduce.
+
+        Merge-kind states (:class:`~tpumetrics.parallel.merge.
+        AssociativeMerge`, e.g. the monitoring sketches) intentionally get
+        NO rule — they replicate like reduce-op states, because the merge
+        itself is the collective: under GSPMD the per-shard contributions
+        fold in-trace, and an explicitly sharded sketch would have no
+        world-size-independent meaning."""
         from tpumetrics.collections import MetricCollection
         from tpumetrics.metric import Metric
         from tpumetrics.utils.data import dim_zero_cat

@@ -466,10 +466,12 @@ class DistributedSnapshotManager:
     ) -> str:
         return self._mgr.save(step, state, meta=meta, guard_non_finite=guard_non_finite)
 
-    def restore_latest(self, template: Any) -> Optional[Tuple[Any, Dict[str, Any]]]:
+    def restore_latest(
+        self, template: Any, annotations: Optional[Dict[str, str]] = None
+    ) -> Optional[Tuple[Any, Dict[str, Any]]]:
         """Rank-LOCAL latest restore (crash recovery); elastic restore uses
         :func:`load_latest_cut` on :attr:`root` instead."""
-        return self._mgr.restore_latest(template)
+        return self._mgr.restore_latest(template, annotations=annotations)
 
     def elastic_meta(self, step: int, digest: str, config: str) -> Dict[str, Any]:
         """The per-rank cut stamp to place under ``meta["elastic"]``."""

@@ -385,6 +385,11 @@ class StreamingEvaluator:
             for inst in (_SUBMIT_HIST, _DISPATCH_HIST, _JOURNAL_GAUGE):
                 inst.remove(self._stream)
             _DEPTH_GAUGE.remove(self._stream)
+            # drift monitors: per-stream latch state + the
+            # drift_score/drift_alerts label series under this stream
+            from tpumetrics.monitoring.drift import release_stream
+
+            release_stream(self._metric, self._stream)
             # the XLA attribution side of the same contract: compile-seconds
             # / recompile series and the retrace keys under this token
             from tpumetrics.telemetry.xla import release_attribution
@@ -411,8 +416,12 @@ class StreamingEvaluator:
         swallowed sync failure serves a degraded value, reflected in
         ``stats()["degraded"]``.
         """
+        from tpumetrics.monitoring.drift import stream_scope
+
         self.flush()
-        with self._lock:
+        with self._lock, stream_scope(self._stream):
+            # drift monitors alert at compute time under this stream's label
+            # (gauge + drift_alert ledger event; stats()["monitoring"])
             if self._bucketer is None:
                 value = self._metric.compute()
                 self._degraded = bool(getattr(self._metric, "degraded", False))
@@ -454,6 +463,11 @@ class StreamingEvaluator:
             )
         out["latency"] = _instruments.latency_section(self._stream)
         out["recompiles"] = recompile_count(self._stream)
+        from tpumetrics.monitoring.drift import monitoring_stats
+
+        monitoring = monitoring_stats(self._metric, self._stream)
+        if monitoring:
+            out["monitoring"] = monitoring
         return out
 
     # -------------------------------------------------------------- snapshots
@@ -707,7 +721,13 @@ class StreamingEvaluator:
         if self._snapshots is None:
             return None
         if self._bucketer is not None:
-            return self._snapshots.restore_latest(self._metric.init_state())
+            # annotations name merge-kind (sketch) declaration parameters in
+            # any SnapshotSpecError this raises (capacity/levels, not just
+            # opaque flat shapes)
+            return self._snapshots.restore_latest(
+                self._metric.init_state(),
+                annotations=_snapshot.state_annotations(self._metric),
+            )
         return _snapshot.restore_latest_reconstruct(self._snapshots.directory)
 
     def _adopt_snapshot_locked(self, got: Optional[Tuple[Any, Dict[str, Any]]]) -> int:
@@ -965,15 +985,19 @@ class StreamingEvaluator:
                 self._state = new_state
 
     def _refresh_latest(self) -> None:
+        from tpumetrics.monitoring.drift import stream_scope
+
         with self._lock:
             state = self._state
             batches, items = self._batches, self._items
         if self._bucketer is None:
-            value = self._metric.compute()
+            with stream_scope(self._stream):
+                value = self._metric.compute()
             self._metric._computed = None  # the stream moves on; don't pin the cache
             degraded = bool(getattr(self._metric, "degraded", False))
         else:
-            value = self._metric.functional_compute(state)
+            with stream_scope(self._stream):
+                value = self._metric.functional_compute(state)
             with self._lock:
                 degraded = self._degraded  # bucketed updates never sync eagerly
         with self._lock:

@@ -571,9 +571,12 @@ class EvaluationService:
                     for _args, _n, _probe, (d_root, d_qspan) in tenant.queue:
                         _spans.end_span(d_qspan, discarded=True)
                         _spans.end_span(d_root, error="discarded (service close)")
+            from tpumetrics.monitoring.drift import release_stream
+
             for tenant in tenants:
                 _SUBMIT_HIST.remove(tenant.tid)
                 _DISPATCH_HIST.remove(tenant.tid)
+                release_stream(self._stats_metric(tenant), tenant.tid)
                 release_attribution(tenant.tid, tokens=(tenant.step_token,))
             _TENANTS_GAUGE.remove(self._label)
             _DEPTH_GAUGE.remove(self._label)
@@ -593,9 +596,14 @@ class EvaluationService:
     def compute(self, tenant_id: str) -> Any:
         """Exact result over everything the tenant submitted (flushes it
         first)."""
+        from tpumetrics.monitoring.drift import stream_scope
+
         tenant = self._get(tenant_id)
         self.flush(tenant_id)
-        with self._lock:
+        with self._lock, stream_scope(tenant.tid):
+            # drift monitors alert under THIS tenant's label — latches are
+            # per-stream on the (possibly shared) metric instance, so one
+            # shared-step monitor pages each tenant independently
             self._raise_if_quarantined(tenant)
             if tenant.bucketer is None:
                 value = tenant.metric.compute()
@@ -643,7 +651,19 @@ class EvaluationService:
         # these only ever ADD keys.
         out["latency"] = _instruments.latency_section(tenant_id)
         out["recompiles"] = recompile_count(tenant_id)
+        from tpumetrics.monitoring.drift import monitoring_stats
+
+        monitoring = monitoring_stats(self._stats_metric(tenant), tenant_id)
+        if monitoring:
+            out["monitoring"] = monitoring
         return out
+
+    @staticmethod
+    def _stats_metric(tenant: "_Tenant") -> Any:
+        """The metric instance whose compute path serves this tenant — the
+        SHARED step metric on the bucketed path (drift latches there are
+        keyed per tenant id), the tenant's own on the eager path."""
+        return tenant.step._metric if tenant.bucketer is not None else tenant.metric
 
     def stats(self) -> Dict[str, Any]:
         """Service-wide counters: the shared dispatcher's (with the per-tag
@@ -740,7 +760,10 @@ class EvaluationService:
         if tenant.snapshots is None:
             return None
         if tenant.bucketer is not None:
-            return tenant.snapshots.restore_latest(tenant.step._metric.init_state())
+            return tenant.snapshots.restore_latest(
+                tenant.step._metric.init_state(),
+                annotations=_snapshot.state_annotations(tenant.step._metric),
+            )
         return _snapshot.restore_latest_reconstruct(tenant.snapshots.directory)
 
     def _adopt_snapshot_locked(
@@ -1283,15 +1306,20 @@ class EvaluationService:
     # ------------------------------------------------------------ cadences
 
     def _refresh_latest(self, tenant: _Tenant) -> None:
+        from tpumetrics.monitoring.drift import stream_scope
+
         with self._lock:
             state = tenant.state
             batches, items = tenant.batches, tenant.items
         if tenant.bucketer is None:
-            value = tenant.metric.compute()
+            with stream_scope(tenant.tid):
+                value = tenant.metric.compute()
             tenant.metric._computed = None  # the stream moves on
             degraded = bool(getattr(tenant.metric, "degraded", False))
         else:
-            with attribute_compiles(tenant.tid, None, token=tenant.step_token):
+            with attribute_compiles(tenant.tid, None, token=tenant.step_token), stream_scope(
+                tenant.tid
+            ):
                 value = tenant.step._metric.functional_compute(state)
             with self._lock:
                 degraded = tenant.degraded

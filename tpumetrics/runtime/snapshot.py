@@ -213,9 +213,19 @@ def load_snapshot(path: str) -> Tuple[Dict[str, Any], List[np.ndarray]]:
     return header, leaves
 
 
-def validate_spec(header: Dict[str, Any], template: Any, context: str = "") -> None:
+def validate_spec(
+    header: Dict[str, Any],
+    template: Any,
+    context: str = "",
+    annotations: Optional[Dict[str, str]] = None,
+) -> None:
     """Compare a snapshot's stored spec against a template pytree; raise
-    :class:`SnapshotSpecError` listing every path/shape/dtype mismatch."""
+    :class:`SnapshotSpecError` listing every path/shape/dtype mismatch.
+
+    ``annotations`` maps leaf-path *suffixes* (e.g. ``"['sketch']"``) to
+    human notes appended to that path's mismatch lines — how merge-kind
+    (sketch) states get their declared capacity/levels named in the error,
+    the way ``_config_fingerprint`` names classification configs."""
     flat = _flatten(template)
     want = [
         {"path": p, "shape": list(np.shape(leaf)), "dtype": str(np.asarray(jax.device_get(leaf)).dtype)}
@@ -225,17 +235,24 @@ def validate_spec(header: Dict[str, Any], template: Any, context: str = "") -> N
     problems = []
     got_by_path = {e["path"]: e for e in got}
     want_by_path = {e["path"]: e for e in want}
+
+    def _note(path: str) -> str:
+        for suffix, text in (annotations or {}).items():
+            if path.endswith(suffix):
+                return f" [{text}]"
+        return ""
+
     for p in want_by_path:
         if p not in got_by_path:
-            problems.append(f"missing state {p}")
+            problems.append(f"missing state {p}{_note(p)}")
     for p in got_by_path:
         if p not in want_by_path:
-            problems.append(f"unexpected state {p}")
+            problems.append(f"unexpected state {p}{_note(p)}")
     for p, w in want_by_path.items():
         g = got_by_path.get(p)
         if g and (g["shape"] != w["shape"] or g["dtype"] != w["dtype"]):
             problems.append(
-                f"{p}: stored {g['dtype']}{g['shape']} != expected {w['dtype']}{w['shape']}"
+                f"{p}: stored {g['dtype']}{g['shape']} != expected {w['dtype']}{w['shape']}{_note(p)}"
             )
     if problems:
         raise SnapshotSpecError(
@@ -246,16 +263,49 @@ def validate_spec(header: Dict[str, Any], template: Any, context: str = "") -> N
         )
 
 
-def restore(path: str, template: Any) -> Tuple[Any, Dict[str, Any]]:
+def restore(
+    path: str, template: Any, annotations: Optional[Dict[str, str]] = None
+) -> Tuple[Any, Dict[str, Any]]:
     """Load one snapshot into the template's pytree structure -> (state, header)."""
     header, leaves = load_snapshot(path)
-    validate_spec(header, template, context=f"template for {path}")
+    validate_spec(header, template, context=f"template for {path}", annotations=annotations)
     treedef = jax.tree_util.tree_structure(template)
     ordered = [jax.numpy.asarray(a) for a in leaves]
     return jax.tree_util.tree_unflatten(treedef, ordered), header
 
 
-def restore_latest(directory: str, template: Any) -> Optional[Tuple[Any, Dict[str, Any]]]:
+def state_annotations(metric: Any) -> Dict[str, str]:
+    """Leaf-path-suffix annotations for ``metric``'s functional state
+    template: one entry per merge-kind (:class:`~tpumetrics.parallel.merge.
+    AssociativeMerge`) state, naming its declared parameters — threaded into
+    :func:`validate_spec` by the runtime so a sketch-geometry mismatch reads
+    ``sketch: stored f32[1, 5379] != expected f32[1, 2051] [merge state
+    'sketch' (merge:sketch(capacity=16, levels=16, ...))]`` instead of bare
+    shapes."""
+    from tpumetrics.collections import MetricCollection
+    from tpumetrics.parallel.merge import AssociativeMerge
+
+    if isinstance(metric, MetricCollection):
+        members = list(metric._modules.items())
+    else:
+        members = [(None, metric)]
+    out: Dict[str, str] = {}
+    for key, m in members:
+        for name, fn in getattr(m, "_reductions", {}).items():
+            if isinstance(fn, AssociativeMerge) and fn.params:
+                # collection leaf paths are leader-qualified — key each
+                # annotation by the member too, so two members with a
+                # same-named sketch state of DIFFERENT geometry never
+                # collide onto one entry (the suffix match would then name
+                # the wrong parameters)
+                suffix = f"['{key}']['{name}']" if key is not None else f"['{name}']"
+                out[suffix] = f"merge state {name!r} ({fn.describe()})"
+    return out
+
+
+def restore_latest(
+    directory: str, template: Any, annotations: Optional[Dict[str, str]] = None
+) -> Optional[Tuple[Any, Dict[str, Any]]]:
     """Restore the highest-step valid snapshot in ``directory``.
 
     Corrupt/torn files (e.g. a crash mid-write that still left a temp file,
@@ -267,7 +317,7 @@ def restore_latest(directory: str, template: Any) -> Optional[Tuple[Any, Dict[st
     """
     for _step, path in reversed(list_snapshots(directory)):
         try:
-            return restore(path, template)
+            return restore(path, template, annotations=annotations)
         except SnapshotIntegrityError:
             continue
     return None
@@ -355,5 +405,7 @@ class SnapshotManager:
                     pass
         return path
 
-    def restore_latest(self, template: Any) -> Optional[Tuple[Any, Dict[str, Any]]]:
-        return restore_latest(self.directory, template)
+    def restore_latest(
+        self, template: Any, annotations: Optional[Dict[str, str]] = None
+    ) -> Optional[Tuple[Any, Dict[str, Any]]]:
+        return restore_latest(self.directory, template, annotations=annotations)

@@ -75,6 +75,8 @@ TENANTS_LIVE = "tpumetrics_tenants_live"
 JOURNAL_LEN = "tpumetrics_journal_len"
 XLA_COMPILE_SECONDS = "tpumetrics_xla_compile_seconds"
 RECOMPILES_TOTAL = "tpumetrics_recompiles_total"
+DRIFT_SCORE = "tpumetrics_drift_score"
+DRIFT_ALERTS = "tpumetrics_drift_alerts_total"
 
 
 def enabled() -> bool:

@@ -203,6 +203,10 @@ class CollectiveLedger:
             # a previously-seen (token, signature) compiled AGAIN — the jit
             # executable cache should have served it (retrace detector)
             self.xla_retraces += 1
+        elif rec.kind == "drift_alert":
+            # a drift monitor's score crossed its threshold upward
+            # (hysteresis-latched: one event per crossing, not per compute)
+            self.drift_alerts += 1
         self.counts_by_kind[rec.kind] = self.counts_by_kind.get(rec.kind, 0) + 1
         for sink in self._sinks:
             sink.emit(rec)
@@ -235,6 +239,7 @@ class CollectiveLedger:
         self.tenant_quarantines = 0
         self.xla_attributed_compiles = 0
         self.xla_retraces = 0
+        self.drift_alerts = 0
         self.spmd_collectives = 0
         self.spmd_wire_bytes = 0.0
         self.bytes_by_op: Dict[str, float] = {}
@@ -281,6 +286,7 @@ class CollectiveLedger:
             "tenant_quarantines": self.tenant_quarantines,
             "xla_attributed_compiles": self.xla_attributed_compiles,
             "xla_retraces": self.xla_retraces,
+            "drift_alerts": self.drift_alerts,
             "spmd_collectives": self.spmd_collectives,
             "spmd_wire_bytes": self.spmd_wire_bytes,
             "records": len(self.records),
