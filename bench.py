@@ -1982,6 +1982,73 @@ def _bench_monitoring_window():
     return ours, ref, {"extras": extras}
 
 
+def _bench_chaos_soak():
+    """The resilience hot path as a STANDING bench gate (ISSUE 12): a real
+    3-process pool under a deterministic chaos schedule — one SIGTERM
+    graceful drain, one SIGKILL at an arbitrary stream point, one shrink,
+    one grow — with every recovery bit-identity-verified against the
+    uninterrupted oracle by the supervisor (a divergence errors the
+    scenario, which trips the gate).
+
+    Emitted series and gates (``chaos_soak_floors``/``chaos_soak_ceilings``):
+
+    - ``restore_latency_p50_ms`` / ``restore_latency_p99_ms`` — max-over-
+      ranks wall time of each recovery cycle's ``restore_elastic`` call
+      (cut discovery + CRC loads + fold + reshard + place).  The p99
+      ceiling catches algorithmic blowups in the restore path (a per-rank
+      re-fold, an O(history) cut scan after retention broke), not box
+      noise.
+    - ``throughput_rows_per_s_min`` — the slowest leg's feed throughput
+      (submit+flush+coordinated-cut cadence).  The floor is deliberately
+      far below observed: it exists to catch structural stalls (a wedged
+      barrier retrying every cut, a retrace per feed), not to benchmark
+      row throughput (the legs are tiny by design).
+    - ``unrecovered_incidents`` — ceiling 0 BY DESIGN: the bench cannot go
+      green while any induced incident fails recovery or any standing gate
+      (bit-identity, exactly-once adoption, ledger/flight continuity).
+    """
+    import shutil
+    import tempfile
+
+    from tpumetrics.soak.schedule import ChaosSchedule, Incident
+    from tpumetrics.soak.supervisor import run_soak
+
+    schedule = ChaosSchedule(
+        seed=0, world=3, cut_every=3,
+        incidents=(
+            Incident(kind="sigterm", feed=6, world_after=3),
+            Incident(kind="sigkill", feed=7, world_after=3, abrupt=True,
+                     target_rank=1, tail=2),
+            Incident(kind="shrink", feed=6, world_after=2),
+            Incident(kind="grow", feed=6, world_after=3, abrupt=True,
+                     target_rank=0, tail=1),
+        ),
+        restore_ceiling_s=60.0,
+    )
+    root = tempfile.mkdtemp(prefix="tpum_chaos_")
+    t0 = time.perf_counter()
+    try:
+        report = run_soak(schedule, root)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    wall_us = (time.perf_counter() - t0) * 1e6
+    assert report["unrecovered"] == 0, report  # every gate held, every cycle
+    assert report["final"].get("ok") is True, report["final"]
+    lat = report["restore_latency_s"]
+    extras = {
+        "restore_latency_p50_ms": round(lat["p50"] * 1e3, 1),
+        "restore_latency_p99_ms": round(lat["p99"] * 1e3, 1),
+        "restore_latency_max_ms": round(lat["max"] * 1e3, 1),
+        "throughput_rows_per_s_min": report["throughput_rows_per_s"]["min"],
+        "throughput_rows_per_s_mean": report["throughput_rows_per_s"]["mean"],
+        "unrecovered_incidents": report["unrecovered"],
+        "incidents": report["n_incidents"],
+        "worlds": report["worlds"],
+        "soak_wall_s": round(wall_us / 1e6, 1),
+    }
+    return wall_us, None, {"extras": extras}
+
+
 def _check_floors(headline_vs, details):
     """Regression gate (VERDICT r4 weak #4): per-config vs_baseline floors
     live in bench_floors.json; any measured ratio below its floor is a loud
@@ -2062,6 +2129,27 @@ def _check_floors(headline_vs, details):
     # trips the gate — its parity/no-retrace asserts never ran)
     for key, ceiling in gate.get("monitoring_ceilings", {}).items():
         check_ceiling("monitoring_window", key, ceiling, fail_on_error=True)
+
+    def check_floor_extra(config, key, floor, fail_on_error):
+        """One extras-keyed floor: details[config][key] must not fall BELOW
+        floor (the mirror of check_ceiling for scenarios whose headline is
+        not a vs_baseline ratio)."""
+        entry = details.get(config)
+        if isinstance(entry, dict):
+            got = entry.get(key)
+            if got is not None and got < floor:
+                violations.append(f"{config}: {key} {got} < floor {floor}")
+        elif entry is not None and fail_on_error:
+            violations.append(f"{config}: scenario failed ({entry})")
+
+    # chaos-soak gates: zero unrecovered incidents (by design — an errored
+    # scenario means a recovery gate raised mid-soak, which must also trip),
+    # bounded per-cycle restore latency, and a structural-stall throughput
+    # floor for the feed+cut cadence
+    for key, ceiling in gate.get("chaos_soak_ceilings", {}).items():
+        check_ceiling("chaos_soak", key, ceiling, fail_on_error=True)
+    for key, floor in gate.get("chaos_soak_floors", {}).items():
+        check_floor_extra("chaos_soak", key, floor, fail_on_error=True)
     return violations
 
 
@@ -2093,6 +2181,7 @@ def main() -> None:
         ("observability_overhead", _bench_observability_overhead),
         ("elastic_restore", _bench_elastic_restore),
         ("monitoring_window", _bench_monitoring_window),
+        ("chaos_soak", _bench_chaos_soak),
         ("analysis_runtime", _bench_analysis_runtime),
     ):
         try:

@@ -65,6 +65,17 @@ def _speechy_batches(n_batches=2, batch=2):
     return out
 
 
+def _sdr_batches(n_batches=4, batch=2, t=256):
+    """SDR's corpus, from its own rng (see the SignalDistortionRatio case)."""
+    rng = np.random.default_rng(23)
+    out = []
+    for _ in range(n_batches):
+        target = rng.standard_normal((batch, t)).astype(np.float32)
+        preds = target + 0.1 * rng.standard_normal((batch, t)).astype(np.float32)
+        out.append((jnp.asarray(preds), jnp.asarray(target)))
+    return out
+
+
 def _pit_factory():
     from tpumetrics.audio import PermutationInvariantTraining
     from tpumetrics.functional.audio import scale_invariant_signal_noise_ratio
@@ -156,9 +167,18 @@ CASES = {
         lambda: _wave_batches(),
         ("emulated", "shard_map"),
     ),
+    # SDR gets a DEDICATED rng and a well-posed filter: with the default
+    # filter_length=512 on t=256 signals the fp32 Toeplitz system is rank-
+    # deficient (more taps than samples), so the optimal-filter coherence can
+    # numerically reach 1 and log10(coh/(1-coh)) goes NaN on the EAGER path
+    # while the jitted shard_map path stays finite — a numerics property of a
+    # singular solve, not a sync bug, and it made this the suite's one
+    # standing failure (drifting with module rng consumption).  filter_length
+    # <= t keeps the system well-posed; the dedicated rng pins the corpus
+    # regardless of what other cases consume from the shared stream.
     "SignalDistortionRatio": (
-        lambda: audio_domain.SignalDistortionRatio(),
-        lambda: _wave_batches(n_batches=4, batch=2, t=256),
+        lambda: audio_domain.SignalDistortionRatio(filter_length=128),
+        lambda: _sdr_batches(),
         ("emulated", "shard_map"),
     ),
     "SourceAggregatedSignalDistortionRatio": (

@@ -409,12 +409,88 @@ def run_scenarios(rank: int, world: int) -> dict:
     return results
 
 
+# ------------------------------------------- elastic-over-real-DCN scenarios
+# The single-host fault-injection story (tests/test_elastic.py) validated
+# once over real process boundaries: a COORDINATED snapshot_barrier cut whose
+# object exchange rides the real MultiHostBackend wire, one rank dying
+# abruptly right after the cut, and restore_elastic() onto a SMALLER world
+# that finishes the stream and cuts again on the new world.  Traffic and
+# metric come from tpumetrics.soak.traffic so the parent can recompute the
+# uninterrupted oracle bit-identically.
+
+
+def run_elastic_write(rank: int, world: int, snap_root: str, stop: int) -> dict:
+    """Phase 1: feed [0, stop) strided, coordinated cut, kill the top rank."""
+    import jax.numpy as jnp
+
+    from tpumetrics.parallel.backend import MultiHostBackend
+    from tpumetrics.runtime import StreamingEvaluator
+    from tpumetrics.soak.traffic import make_batch, make_metric
+
+    ev = StreamingEvaluator(
+        make_metric(5), buckets=8, snapshot_dir=snap_root,
+        snapshot_rank=rank, snapshot_world_size=world,
+        barrier_backend=MultiHostBackend(),
+    )
+    for i in range(rank, stop, world):
+        preds, target = make_batch(1, i, num_classes=5, max_rows=8)
+        ev.submit(jnp.asarray(preds), jnp.asarray(target))
+    ev.flush()
+    path = ev.snapshot()  # the barrier crosses real process boundaries here
+    stats = ev.stats()
+    if rank == world - 1:
+        # "kill one rank": die abruptly AFTER the cut completed — no close,
+        # no result file; everything it applied since the cut is lost, which
+        # is exactly nothing (the cut just covered it)
+        sys.stdout.flush()
+        os._exit(0)
+    ev.close(drain=False)
+    return {"cut_path": path, "batches": stats["batches"], "items": stats["items"]}
+
+
+def run_elastic_restore(
+    rank: int, world: int, snap_root: str, start: int, stop: int
+) -> dict:
+    """Phase 2 (smaller world): restore the cut, finish the stream, cut again."""
+    import jax.numpy as jnp
+
+    from tpumetrics.parallel.backend import MultiHostBackend
+    from tpumetrics.runtime import StreamingEvaluator
+    from tpumetrics.soak.traffic import make_batch, make_metric
+
+    ev = StreamingEvaluator(
+        make_metric(5), buckets=8, snapshot_dir=snap_root,
+        snapshot_rank=rank, snapshot_world_size=world,
+        barrier_backend=MultiHostBackend(),
+    )
+    info = ev.restore_elastic()
+    for i in range(start + rank, stop, world):
+        preds, target = make_batch(1, i, num_classes=5, max_rows=8)
+        ev.submit(jnp.asarray(preds), jnp.asarray(target))
+    ev.flush()
+    ev.snapshot()  # a coordinated cut on the NEW world
+    stats = ev.stats()
+    ev.close(drain=False)
+    return {
+        "restore": info,
+        "batches": stats["batches"],
+        "items": stats["items"],
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rank", type=int, required=True)
     ap.add_argument("--world", type=int, required=True)
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--out", required=True)
+    ap.add_argument(
+        "--scenario", choices=("pool", "elastic-write", "elastic-restore"),
+        default="pool",
+    )
+    ap.add_argument("--snap-root", default=None)
+    ap.add_argument("--feed-start", type=int, default=0)
+    ap.add_argument("--feed-stop", type=int, default=0)
     args = ap.parse_args()
 
     import jax
@@ -425,7 +501,14 @@ def main() -> None:
         process_id=args.rank,
     )
 
-    results = run_scenarios(args.rank, args.world)
+    if args.scenario == "pool":
+        results = run_scenarios(args.rank, args.world)
+    elif args.scenario == "elastic-write":
+        results = run_elastic_write(args.rank, args.world, args.snap_root, args.feed_stop)
+    else:
+        results = run_elastic_restore(
+            args.rank, args.world, args.snap_root, args.feed_start, args.feed_stop
+        )
 
     path = os.path.join(args.out, f"rank{args.rank}.json")
     with open(path + ".tmp", "w") as fh:

@@ -71,6 +71,12 @@ def test_floor_file_shape():
     # scatter-add-cheap per row
     assert data["floors"]["monitoring_window"] >= 4.0
     assert data["monitoring_ceilings"]["sketch_update_ns_per_row"] > 0
+    # the chaos-soak standing gates (ISSUE 12 acceptance): a per-cycle
+    # restore-latency ceiling, a structural-stall throughput floor, and
+    # ZERO unrecovered incidents — never raise that last one
+    assert data["chaos_soak_ceilings"]["restore_latency_p99_ms"] > 0
+    assert data["chaos_soak_ceilings"]["unrecovered_incidents"] == 0
+    assert data["chaos_soak_floors"]["throughput_rows_per_s_min"] > 0
 
 
 def test_check_floors_flags_compile_regressions():
@@ -243,6 +249,35 @@ def test_check_floors_flags_sharded_regressions():
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and all("sharded_collection_8dev" in v for v in violations)
     details["sharded_collection_8dev"] = "error: Exception: device-to-host transfer"
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and "scenario failed" in violations[0]
+
+
+def test_check_floors_flags_chaos_soak_regressions():
+    """A soak whose restore p99 blew past the ceiling, whose feed+cut
+    cadence stalled below the structural floor, or that left ANY incident
+    unrecovered must trip the gate; an errored scenario entry (a recovery
+    gate raised mid-soak — bit-identity, exactly-once, ledger continuity)
+    trips it too."""
+    details = {
+        "chaos_soak": {
+            "restore_latency_p99_ms": 10**6,
+            "throughput_rows_per_s_min": 20.0,
+            "unrecovered_incidents": 0,
+        }
+    }
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("restore_latency_p99_ms" in v for v in violations)
+    details["chaos_soak"]["restore_latency_p99_ms"] = 400.0
+    assert bench._check_floors(headline_vs=1000.0, details=details) == []
+    details["chaos_soak"]["unrecovered_incidents"] = 1
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("unrecovered_incidents" in v for v in violations)
+    details["chaos_soak"]["unrecovered_incidents"] = 0
+    details["chaos_soak"]["throughput_rows_per_s_min"] = 0.1  # wedged cadence
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("throughput_rows_per_s_min" in v for v in violations)
+    details["chaos_soak"] = "error: ChaosSoakError: compute() diverged"
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and "scenario failed" in violations[0]
 

@@ -530,3 +530,57 @@ def test_evaluator_validation():
         StreamingEvaluator(SumMetric(), crash_policy="restore", max_restores=-1)
     with pytest.raises(ValueError, match="guard_non_finite"):
         StreamingEvaluator(SumMetric(), guard_non_finite="sometimes")
+
+
+# --------------------------------------------------------- watchdog pooling
+
+
+def test_watchdog_pool_holds_constant_thread_count():
+    """A soak issuing thousands of guarded collectives must not spawn a
+    thread per call: the reusable watchdog pool runs a healthy sequential
+    stream on ONE long-lived thread (regression for the spawn-per-collective
+    design)."""
+    import threading
+
+    from tpumetrics.resilience.policy import _WATCHDOGS
+
+    backend = FaultInjectionBackend(NoOpBackend(), faults=[])
+    with sync_policy(SyncPolicy(timeout=30.0)):
+        run_guarded(lambda: 0, op="warm", backend=backend)  # pool warm-up
+        created_before = _WATCHDOGS.stats()["created"]
+        threads_before = threading.active_count()
+        for i in range(2000):
+            assert run_guarded(lambda: i, op="loop", backend=backend) == i
+        assert threading.active_count() <= threads_before
+        assert _WATCHDOGS.stats()["created"] == created_before  # zero spawns
+
+
+def test_watchdog_thread_survives_timeout_and_rejoins_pool():
+    """A timed-out op abandons the OP, not the THREAD: when the wedged
+    collective finally completes, the fence clears and the same pooled
+    thread serves later guarded calls (no leak, no permanent growth)."""
+    import threading
+
+    from tpumetrics.resilience.policy import _WATCHDOGS, _fenced
+
+    backend = FaultInjectionBackend(NoOpBackend(), faults=[])
+    release = threading.Event()
+
+    def wedged():
+        release.wait(10.0)
+        return "late"
+
+    with sync_policy(SyncPolicy(timeout=0.2)):
+        with pytest.raises(SyncTimeoutError):
+            run_guarded(wedged, op="wedged", backend=backend)
+        assert _fenced(backend) == 1  # abandoned op fences the backend
+    release.set()
+    deadline = time.monotonic() + 5.0
+    while _fenced(backend) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert _fenced(backend) == 0  # completion cleared the fence
+    created = _WATCHDOGS.stats()["created"]
+    with sync_policy(SyncPolicy(timeout=30.0)):
+        for i in range(50):
+            assert run_guarded(lambda: i, op="after", backend=backend) == i
+    assert _WATCHDOGS.stats()["created"] == created  # the thread came back

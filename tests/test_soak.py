@@ -1,0 +1,235 @@
+"""tpumetrics.soak: the chaos-soak harness.
+
+Non-slow: schedule determinism/validation, the file-wire barrier across
+real concurrency, CLI round-trips.  Slow (the acceptance gate): a REAL
+3-process pool survives a seeded schedule of 6 incidents — SIGKILL, SIGTERM
+graceful drain, shrink, grow — with ``compute()`` bit-identical to the
+uninterrupted oracle after every recovery, restore latency under the
+declared ceiling each cycle, exactly-once adoption, ledger/flight
+continuity, and zero unrecovered incidents.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import pytest
+
+from tpumetrics.resilience import SyncPolicy, run_guarded, sync_policy
+from tpumetrics.resilience.policy import SyncFailedError
+from tpumetrics.soak import (
+    ChaosSchedule,
+    FileBarrierBackend,
+    Incident,
+    generate_schedule,
+)
+from tpumetrics.soak.cli import main as cli_main
+from tpumetrics.soak.schedule import KINDS, ScheduleError
+from tpumetrics.soak.wire import BarrierWireError
+
+# ------------------------------------------------------------------ schedule
+
+
+class TestSchedule:
+    def test_same_seed_same_schedule(self):
+        a = generate_schedule(11, world=3, n_incidents=8)
+        b = generate_schedule(11, world=3, n_incidents=8)
+        assert a == b
+        assert a != generate_schedule(12, world=3, n_incidents=8)
+
+    def test_acceptance_mix_and_bounds(self):
+        for seed in range(8):
+            s = generate_schedule(seed, world=3, n_incidents=6, min_world=2, max_world=4)
+            kinds = {i.kind for i in s.incidents}
+            assert kinds == set(KINDS), (seed, kinds)  # all four, every seed
+            assert all(2 <= w <= 4 for w in s.worlds), (seed, s.worlds)
+            # shrink and grow really resize; abrupt incidents carry a victim
+            for prev, inc in zip(s.worlds, s.incidents):
+                if inc.kind == "shrink":
+                    assert inc.world_after < prev
+                if inc.kind == "grow":
+                    assert inc.world_after > prev
+                if inc.abrupt:
+                    assert 0 <= inc.target_rank < prev
+                    assert 0 <= inc.tail < inc.feed
+
+    def test_json_roundtrip(self):
+        s = generate_schedule(5, world=3, n_incidents=6)
+        assert ChaosSchedule.from_json(s.to_json()) == s
+
+    def test_validation_rejects_malformed(self):
+        ok = dict(kind="sigterm", feed=4, world_after=2)
+        ChaosSchedule(seed=0, world=2, incidents=(Incident(**ok),))
+        bad = [
+            dict(kind="nuke", feed=4, world_after=2),
+            dict(kind="sigterm", feed=0, world_after=2),
+            dict(kind="shrink", feed=4, world_after=2),  # not a shrink at world 2
+            dict(kind="grow", feed=4, world_after=2),  # not a grow at world 2
+            dict(kind="sigkill", feed=4, world_after=2),  # abrupt=False
+            dict(kind="sigkill", feed=4, world_after=2, abrupt=True),  # no victim
+            dict(
+                kind="sigkill", feed=4, world_after=2, abrupt=True,
+                target_rank=0, tail=4,  # tail >= feed
+            ),
+            dict(
+                kind="sigkill", feed=4, world_after=2, abrupt=True,
+                target_rank=0, tail=1, lose_member=True,  # rank-0 member loss
+            ),
+            dict(kind="sigterm", feed=4, world_after=2, tail=1),  # graceful tail
+        ]
+        for kwargs in bad:
+            with pytest.raises(ScheduleError):
+                ChaosSchedule(seed=0, world=2, incidents=(Incident(**kwargs),))
+
+    def test_unreadable_json_typed(self):
+        with pytest.raises(ScheduleError):
+            ChaosSchedule.from_json("{not json")
+
+
+# ---------------------------------------------------------------- file wire
+
+
+class TestFileBarrier:
+    def test_gathers_in_rank_order_across_threads(self, tmp_path):
+        world = 3
+        outs = [None] * world
+
+        def rank_main(r):
+            be = FileBarrierBackend(str(tmp_path), rank=r, world_size=world, timeout=30.0)
+            for rnd in range(3):  # rounds stay aligned across invocations
+                outs[r] = be.all_gather_object({"rank": r, "round": rnd})
+
+        threads = [threading.Thread(target=rank_main, args=(r,)) for r in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        for r in range(world):
+            assert outs[r] == [{"rank": i, "round": 2} for i in range(world)]
+
+    def test_missing_rank_times_out_named(self, tmp_path):
+        be = FileBarrierBackend(str(tmp_path), rank=0, world_size=2, timeout=0.3)
+        with pytest.raises(BarrierWireError, match=r"rank\(s\) \[1\]"):
+            be.all_gather_object({"rank": 0})
+
+    def test_guarded_missing_rank_is_typed_sync_failure(self, tmp_path):
+        """Under the SyncPolicy the soak workers run, a dead peer surfaces
+        as the typed failure class the degraded modes key off."""
+        be = FileBarrierBackend(str(tmp_path), rank=0, world_size=2, timeout=0.3)
+        # the wire's own backstop (0.3s) fires inside the armed watchdog
+        # deadline (5s): the named-rank error becomes the typed failure
+        with sync_policy(SyncPolicy(timeout=5.0, retries=0)):
+            with pytest.raises(SyncFailedError, match="elastic_barrier"):
+                run_guarded(
+                    lambda: be.all_gather_object({"r": 0}),
+                    op="elastic_barrier_exchange", backend=be,
+                )
+
+    def test_identity_validation(self, tmp_path):
+        with pytest.raises(ValueError):
+            FileBarrierBackend(str(tmp_path), rank=2, world_size=2)
+        with pytest.raises(ValueError):
+            FileBarrierBackend(str(tmp_path), rank=0, world_size=0)
+        be = FileBarrierBackend(str(tmp_path), rank=1, world_size=3)
+        assert be.rank() == 1 and be.world_size() == 3 and be.available()
+        assert be.has_object_channel
+
+
+# ---------------------------------------------------------------------- CLI
+
+
+class TestCli:
+    def test_generate_roundtrips(self, tmp_path, capsys):
+        out = str(tmp_path / "sched.json")
+        assert cli_main(["generate", "--seed", "3", "--world", "3",
+                         "--incidents", "6", "-o", out]) == 0
+        with open(out) as fh:
+            sched = ChaosSchedule.from_json(fh.read())
+        assert sched == generate_schedule(3, world=3, n_incidents=6)
+
+    def test_generate_stdout(self, capsys):
+        assert cli_main(["generate", "--seed", "4", "--incidents", "4"]) == 0
+        sched = ChaosSchedule.from_json(capsys.readouterr().out)
+        assert sched.seed == 4 and len(sched.incidents) == 4
+
+    def test_bad_schedule_file_exits_2(self, tmp_path, capsys):
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as fh:
+            fh.write("{}")
+        assert cli_main(["run", "--schedule", bad]) == 2
+
+
+# ------------------------------------------------------- the short soak gate
+
+
+@pytest.mark.slow
+def test_chaos_soak_short(tmp_path):
+    """THE ACCEPTANCE GATE: a real >=3-process pool under a seeded schedule
+    of 6 incidents (>=1 SIGKILL, >=1 SIGTERM graceful drain, >=1 shrink,
+    >=1 grow — asserted), every recovery bit-identical to the uninterrupted
+    oracle, restore latency under the ceiling each cycle, zero unrecovered
+    incidents, telemetry continuity per incident."""
+    from tpumetrics.soak.supervisor import run_soak
+
+    schedule = generate_schedule(7, world=3, n_incidents=6, min_world=2, max_world=4)
+    kinds = {i.kind for i in schedule.incidents}
+    assert kinds == set(KINDS)
+    assert any(i.lose_member for i in schedule.incidents)  # a degraded cycle too
+    out = str(tmp_path / "report.jsonl")
+    report = run_soak(schedule, str(tmp_path / "soak"), out_jsonl=out)
+
+    assert report["unrecovered"] == 0, report
+    assert report["completed"] == 6
+    assert report["final"].get("ok") is True
+    # every cycle's restore stayed under the declared ceiling (the
+    # supervisor enforces per-cycle; re-assert the series here)
+    lat = report["restore_latency_s"]
+    assert lat["count"] == 6
+    assert lat["max"] <= schedule.restore_ceiling_s
+    for rec in report["incidents"]:
+        assert rec["ok"], rec
+        assert rec["verify"]["cut_step"] >= 0  # bit-identity ran (it raises on mismatch)
+        assert rec["ledger_restore_events"] == rec["world_after"]
+        assert rec["flight_dump"] and os.path.isfile(rec["flight_dump"])
+        if rec["kind"] == "sigterm" or not rec["abrupt"]:
+            for fl in rec["drain_flights"]:
+                assert fl and os.path.isfile(fl)
+    # a lose_member cycle really lost exactly the victim's leg and was
+    # restored degraded with the exact expected value
+    degraded = [r for r in report["incidents"] if r["lose_member"]]
+    assert degraded and all(r["degraded"] and r["lost_batches"] > 0 for r in degraded)
+    assert report["lost_batches"] == sum(r["lost_batches"] for r in degraded)
+
+    # the incident JSONL is complete and machine-readable
+    with open(out) as fh:
+        lines = [json.loads(line) for line in fh]
+    assert [rec["type"] for rec in lines] == ["incident"] * 6 + ["summary"]
+    assert lines[-1]["unrecovered"] == 0
+
+
+@pytest.mark.slow
+def test_cli_run_tiny_soak(tmp_path, capsys):
+    """End-to-end CLI: generate a tiny schedule, run it, exit 0, report
+    parses."""
+    sched_path = str(tmp_path / "sched.json")
+    sched = ChaosSchedule(
+        seed=0, world=2, cut_every=3,
+        incidents=(
+            Incident(kind="sigterm", feed=4, world_after=2),
+            Incident(kind="grow", feed=5, world_after=3, abrupt=True,
+                     target_rank=1, tail=1),
+        ),
+    )
+    with open(sched_path, "w") as fh:
+        fh.write(sched.to_json())
+    out = str(tmp_path / "report.jsonl")
+    rc = cli_main([
+        "run", "--schedule", sched_path, "--root", str(tmp_path / "root"),
+        "--out", out,
+    ])
+    summary = json.loads(capsys.readouterr().out)
+    assert rc == 0, summary
+    assert summary["unrecovered"] == 0 and summary["completed"] == 2
+    assert os.path.isfile(out)

@@ -46,6 +46,7 @@ from tpumetrics.resilience.elastic import (
     InconsistentCutError,
     QuorumPolicy,
     config_digest,
+    gc_cuts,
     load_latest_cut,
     scan_cuts,
     snapshot_barrier,
@@ -86,6 +87,7 @@ __all__ = [
     "SyncPolicy",
     "SyncTimeoutError",
     "config_digest",
+    "gc_cuts",
     "get_sync_policy",
     "load_latest_cut",
     "run_guarded",

@@ -43,6 +43,7 @@ import hashlib
 import json
 import os
 import re
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -58,6 +59,7 @@ __all__ = [
     "QuorumPolicy",
     "config_digest",
     "cut_digest",
+    "gc_cuts",
     "load_latest_cut",
     "make_stamp",
     "scan_cuts",
@@ -417,6 +419,82 @@ def load_latest_cut(
     )
 
 
+def gc_cuts(
+    root: str,
+    keep_cuts: int,
+    *,
+    backend: Any = None,
+    tmp_grace_s: float = 300.0,
+) -> List[str]:
+    """Garbage-collect superseded elastic cuts under ``root``; returns the
+    removed file paths.  The retention rule a days-long soak needs (closes
+    the retention caveat documented since the elastic PR):
+
+    - the newest ``keep_cuts`` COMPLETE cuts always survive, and so does
+      every file at a step at or above the oldest kept complete cut —
+      in-progress cuts (a barrier round whose laggard ranks are still
+      writing) are always newer than every complete cut, so an in-progress
+      write can NEVER be collected;
+    - everything strictly older than that watermark is superseded — partial
+      cuts a preemption orphaned, and complete cuts beyond the window —
+      and is removed, which keeps :func:`scan_cuts` O(keep_cuts) instead
+      of O(history);
+    - rank directories left empty afterwards (a shrunk world's stale ranks)
+      are removed, as is atomic-write temp debris (``.snapshot-*.tmp``)
+      older than ``tmp_grace_s`` — a rank SIGKILLed mid-write leaks one
+      temp file that no rename will ever claim.
+
+    Safe to run concurrently from every rank after its save: deletions are
+    idempotent (missing files are skipped) and the watermark is derived
+    from scan metadata each time.  With no complete cut on disk nothing is
+    collected — a cut set that never completed is evidence, not garbage.
+    """
+    if int(keep_cuts) < 1:
+        raise ValueError(f"keep_cuts must be >= 1, got {keep_cuts}")
+    cuts = scan_cuts(root)  # newest step first
+    complete = [c for c in cuts if not c.missing]
+    removed: List[str] = []
+    stale_cuts = 0
+    if complete:
+        watermark = complete[: int(keep_cuts)][-1].step
+        for cut in cuts:
+            if cut.step >= watermark:
+                continue
+            stale_cuts += 1
+            for path in cut.members.values():
+                try:
+                    os.unlink(path)
+                    removed.append(path)
+                except OSError:
+                    pass  # a concurrent rank's GC got there first
+    now = time.time()
+    for directory in _rank_dirs(root).values():
+        try:
+            names = os.listdir(directory)
+        except OSError:
+            continue
+        for name in names:
+            if name.startswith(".snapshot-") and name.endswith(".tmp"):
+                path = os.path.join(directory, name)
+                try:
+                    if now - os.path.getmtime(path) > tmp_grace_s:
+                        os.unlink(path)
+                        removed.append(path)
+                except OSError:
+                    pass
+        try:
+            if not os.listdir(directory):
+                os.rmdir(directory)  # stale rank dir (shrunk world)
+        except OSError:
+            pass
+    if removed:
+        _telemetry.record_event(
+            backend, "elastic_gc", removed=len(removed), cuts=stale_cuts,
+            keep_cuts=int(keep_cuts),
+        )
+    return removed
+
+
 class DistributedSnapshotManager:
     """Per-rank snapshot manager over a SHARED root directory.
 
@@ -429,24 +507,47 @@ class DistributedSnapshotManager:
     streaming evaluator can use either interchangeably — crash recovery
     stays rank-local, elastic restore goes through the root.
 
-    Retention note: ``keep`` prunes PER RANK.  After a rank is preempted its
-    directory stops advancing, so the surviving ranks' retention window must
-    cover the gap back to the last complete cut — size ``keep`` to the
-    preemption-detection latency, not to disk taste.
+    Retention: two modes.
+
+    - ``keep`` prunes PER RANK (the pre-``keep_cuts`` behavior).  After a
+      rank is preempted its directory stops advancing, so the surviving
+      ranks' retention window must cover the gap back to the last complete
+      cut — size ``keep`` to the preemption-detection latency, not to disk
+      taste.
+    - ``keep_cuts`` prunes PER CUT (:func:`gc_cuts`, auto-run by RANK 0
+      after its saves — one scan per cut, not one per rank):
+      the newest ``keep_cuts`` COMPLETE cuts survive, superseded partial
+      cuts and stale rank dirs are collected, and in-progress writes never
+      are.  This is the mode a days-long soak needs — it cannot strand the
+      restore side the way a per-rank window can, because the watermark is
+      *defined* by a surviving complete cut.  Mutually exclusive with
+      ``keep`` (cut-level GC owns retention; a per-rank window could
+      delete members out from under a kept cut).
     """
 
-    def __init__(self, root: str, rank: int, world_size: int, keep: Optional[int] = 3) -> None:
+    def __init__(
+        self,
+        root: str,
+        rank: int,
+        world_size: int,
+        keep: Optional[int] = 3,
+        keep_cuts: Optional[int] = None,
+    ) -> None:
         from tpumetrics.runtime import snapshot as _snapshot
 
         if world_size < 1:
             raise ValueError(f"world_size must be >= 1, got {world_size}")
         if not (0 <= int(rank) < int(world_size)):
             raise ValueError(f"rank must be in [0, {world_size}), got {rank}")
+        if keep_cuts is not None and int(keep_cuts) < 1:
+            raise ValueError(f"keep_cuts must be >= 1 or None, got {keep_cuts}")
         self.root = root
         self.rank = int(rank)
         self.world_size = int(world_size)
+        self.keep_cuts = int(keep_cuts) if keep_cuts is not None else None
         self._mgr = _snapshot.SnapshotManager(
-            os.path.join(root, f"rank-{int(rank):05d}"), keep=keep
+            os.path.join(root, f"rank-{int(rank):05d}"),
+            keep=None if keep_cuts is not None else keep,
         )
 
     @property
@@ -464,7 +565,22 @@ class DistributedSnapshotManager:
         meta: Optional[Dict[str, Any]] = None,
         guard_non_finite: str = "off",
     ) -> str:
-        return self._mgr.save(step, state, meta=meta, guard_non_finite=guard_non_finite)
+        path = self._mgr.save(step, state, meta=meta, guard_non_finite=guard_non_finite)
+        # auto-GC from rank 0 ONLY: every rank scanning every rank's headers
+        # after every save would be O(world^2) metadata reads per cut on the
+        # shared filesystem.  Rank 0 participates in every cut (the barrier
+        # invariant), so one scan per cut gives identical retention —
+        # trailing by at most one save, bounded at keep_cuts + 1 complete
+        # cuts on disk.  Any rank may still run gc() explicitly.
+        if self.keep_cuts is not None and self.rank == 0:
+            gc_cuts(self.root, self.keep_cuts)
+        return path
+
+    def gc(self) -> List[str]:
+        """Run cut-level retention now (no-op without ``keep_cuts``)."""
+        if self.keep_cuts is None:
+            return []
+        return gc_cuts(self.root, self.keep_cuts)
 
     def restore_latest(
         self, template: Any, annotations: Optional[Dict[str, str]] = None

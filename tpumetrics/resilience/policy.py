@@ -39,11 +39,16 @@ divergence, but a rank that never arrives hangs the digest exchange itself.
 The guard is **near-zero cost when inactive**: the default policy
 (``timeout=None, retries=0``) short-circuits to a direct call, and even an
 active policy skips backends where no wire op can stall (eager world size 1,
-unless the backend is a fault-injection wrapper).  A timed-out collective's
-watchdog thread cannot be killed — it is leaked as a daemon thread and the
-caller gets the typed error; the leak is bounded by how often syncs time out
-(each timeout = one parked thread until the stalled op completes or the
-process exits).
+unless the backend is a fault-injection wrapper).  Deadline-guarded calls
+run on a small **reusable watchdog pool** (:class:`_WatchdogPool`): a soak
+issuing thousands of guarded collectives holds a constant thread count (one
+long-lived runner in the sequential case) instead of spawning per call.  A
+timed-out collective cannot be killed — its *op* is abandoned in-flight on
+its pooled thread and the caller gets the typed error; the thread itself is
+not lost: when the wedged op finally completes it clears the backend fence
+and the thread rejoins the pool.  Concurrency (parallel guarded syncs plus
+currently-abandoned ops) is the only thing that grows the pool, and idle
+threads beyond a small cap exit.
 
 Timeouts are NOT retried: a rank that missed one deadline is presumed dead
 or wedged, and re-entering a collective while the previous attempt's thread
@@ -346,36 +351,130 @@ def _call_marked(fn: Callable[[], T]) -> T:
         _GUARD_STATE.active = False
 
 
+class _WatchdogJob:
+    """One deadline-guarded call handed to a pool thread.
+
+    ``abandoned`` flips (under ``lock``) when the caller gives up at the
+    deadline; whichever side loses the race still sees a consistent pair of
+    (done, abandoned) — the pool thread clears the backend fence exactly
+    when an abandoned op finally completes."""
+
+    __slots__ = ("fn", "backend", "box", "done", "abandoned", "lock")
+
+    def __init__(self, fn: Callable[[], Any], backend: Any) -> None:
+        self.fn = fn
+        self.backend = backend
+        self.box: dict = {}
+        self.done = threading.Event()
+        self.abandoned = False
+        self.lock = threading.Lock()
+
+
+class _WatchdogPool:
+    """Reusable deadline-runner threads for guarded collectives.
+
+    The previous design spawned one daemon thread PER guarded collective —
+    correct, but a soak issuing thousands of guarded syncs paid a thread
+    spawn each time and (worse) a profile full of short-lived threads.  The
+    pool keeps a small free list instead: a healthy stream of guarded
+    collectives runs on ONE long-lived thread, and the thread count only
+    grows with genuine concurrency — parallel guarded syncs plus abandoned
+    (timed-out, still in-flight) ops.  An abandoned op does NOT orphan its
+    thread: when the wedged collective finally returns, the thread clears
+    the fence and rejoins the free list.  Threads beyond ``max_idle`` exit
+    instead of parking forever, so a burst does not permanently raise the
+    floor.  Everything is daemonic — a thread wedged in a dead collective
+    must never block process exit.
+    """
+
+    def __init__(self, max_idle: int = 4) -> None:
+        self._lock = threading.Lock()
+        self._idle: List["_WatchdogThread"] = []
+        self._max_idle = int(max_idle)
+        self._created = 0  # lifetime spawn count (observability/tests)
+
+    def submit(self, fn: Callable[[], Any], backend: Any) -> _WatchdogJob:
+        job = _WatchdogJob(fn, backend)
+        with self._lock:
+            if self._idle:
+                worker = self._idle.pop()
+            else:
+                self._created += 1
+                worker = _WatchdogThread(self, self._created)
+        worker.assign(job)
+        return job
+
+    def _release(self, worker: "_WatchdogThread") -> bool:
+        """Return a finished thread to the free list; ``False`` = list is
+        full, the thread should exit."""
+        with self._lock:
+            if len(self._idle) < self._max_idle:
+                self._idle.append(worker)
+                return True
+            return False
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"idle": len(self._idle), "created": self._created}
+
+
+class _WatchdogThread:
+    """One pooled runner: blocks on its own condition until assigned a job,
+    runs it with the re-entrancy marker set, completes it (clearing the
+    abandoned-op fence when applicable), then rejoins the pool."""
+
+    def __init__(self, pool: _WatchdogPool, n: int) -> None:
+        self._pool = pool
+        self._cv = threading.Condition()
+        self._job: Optional[_WatchdogJob] = None
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"tpumetrics-sync-watchdog[pool-{n}]"
+        )
+        self._thread.start()
+
+    def assign(self, job: _WatchdogJob) -> None:
+        with self._cv:
+            self._job = job
+            self._cv.notify()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while self._job is None:
+                    self._cv.wait()
+                job, self._job = self._job, None
+            _GUARD_STATE.active = True
+            try:
+                job.box["value"] = job.fn()
+            except BaseException as err:  # noqa: BLE001 — re-raised on the caller thread
+                job.box["error"] = err
+            finally:
+                _GUARD_STATE.active = False
+                with job.lock:
+                    job.done.set()
+                    if job.abandoned:
+                        # the abandoned op finally finished (or errored): new
+                        # collectives on this backend can pair safely again
+                        _fence_adjust(job.backend, -1)
+            if not self._pool._release(self):
+                return
+
+
+_WATCHDOGS = _WatchdogPool()
+
+
 def _call_with_deadline(
     fn: Callable[[], T], timeout: float, *, op: str, tag: str, attempt: int, backend: Any
 ) -> T:
-    box: dict = {}
-    done = threading.Event()
-    state = {"abandoned": False}
-    state_lock = threading.Lock()
-
-    def target() -> None:
-        _GUARD_STATE.active = True
-        try:
-            box["value"] = fn()
-        except BaseException as err:  # noqa: BLE001 — re-raised on the caller thread
-            box["error"] = err
-        finally:
-            with state_lock:
-                done.set()
-                if state["abandoned"]:
-                    # the abandoned op finally finished (or errored): new
-                    # collectives on this backend can pair safely again
-                    _fence_adjust(backend, -1)
-
-    worker = threading.Thread(target=target, daemon=True, name=f"tpumetrics-sync-watchdog[{op}]")
-    worker.start()
-    if not done.wait(timeout):
-        with state_lock:
-            if not done.is_set():  # really still in flight: fence the backend
-                state["abandoned"] = True
+    job = _WATCHDOGS.submit(fn, backend)
+    box = job.box
+    if not job.done.wait(timeout):
+        abandoned = False
+        with job.lock:
+            if not job.done.is_set():  # really still in flight: fence the backend
+                job.abandoned = abandoned = True
                 _fence_adjust(backend, +1)
-        if state["abandoned"]:
+        if abandoned:
             _telemetry.record_event(
                 backend, "sync_timeout", op=op, tag=tag, attempts=attempt, timeout=timeout
             )
@@ -387,9 +486,10 @@ def _call_with_deadline(
             raise SyncTimeoutError(
                 f"Collective {op} (tag={tag!r}) timed out after {timeout}s on attempt "
                 f"{attempt}: a participating rank is dead, stalled, or preempted. The "
-                "in-flight collective's watchdog thread is abandoned (daemon) and the "
-                "backend is fenced against new collectives until it completes; see "
-                "SyncPolicy.on_failure for degraded-result options instead of raising."
+                "in-flight collective stays abandoned on its pooled watchdog thread "
+                "(daemon) and the backend is fenced against new collectives until it "
+                "completes; see SyncPolicy.on_failure for degraded-result options "
+                "instead of raising."
             )
         # lost the race by a hair: the op completed just after the deadline
     if "error" in box:

@@ -54,6 +54,13 @@ from tpumetrics.runtime.compile_cache import (
     enable_persistent_compilation_cache,
 )
 from tpumetrics.runtime.dispatch import AsyncDispatcher, DispatcherClosedError, QueueFullError
+from tpumetrics.runtime.drain import (
+    DrainReport,
+    DrainingError,
+    PreemptionGuard,
+    PreemptionInterrupt,
+    install_preemption_handler,
+)
 from tpumetrics.runtime.evaluator import CrashLoopError, StreamingEvaluator
 from tpumetrics.runtime.scheduler import DeficitRoundRobin, SignatureRegistry
 from tpumetrics.runtime.service import (
@@ -78,7 +85,12 @@ __all__ = [
     "CrashLoopError",
     "DeficitRoundRobin",
     "DispatcherClosedError",
+    "DrainReport",
+    "DrainingError",
     "EvaluationService",
+    "PreemptionGuard",
+    "PreemptionInterrupt",
+    "install_preemption_handler",
     "NotBucketableError",
     "QueueFullError",
     "ShapeBucketer",

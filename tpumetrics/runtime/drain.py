@@ -1,0 +1,269 @@
+"""Graceful drain on preemption notice: stop intake, flush, cut, exit typed.
+
+Cloud TPU fleets deliver two kinds of death: the abrupt SIGKILL (handled by
+snapshots + :meth:`~tpumetrics.runtime.evaluator.StreamingEvaluator.
+restore_elastic`) and the *polite* preemption — a SIGTERM (or maintenance
+notice) with a grace window.  A polite preemption should lose NOTHING: every
+batch already submitted must reach the state, one final coordinated snapshot
+cut must cover exactly that position, and late submitters must get a typed
+error instead of silently feeding a dying process.  This module is that
+contract:
+
+- :class:`DrainingError` — the typed refusal every ``submit`` raises once a
+  drain began (on :class:`~tpumetrics.runtime.evaluator.StreamingEvaluator`
+  and on :class:`~tpumetrics.runtime.service.EvaluationService` /
+  :class:`~tpumetrics.runtime.service.TenantHandle` alike).
+- ``request_drain()`` / ``drain()`` on the evaluator and the service — the
+  programmatic half: mark draining (intake off), flush the queues, write the
+  final cut, close, and return a :class:`DrainReport` describing exactly
+  what the cut covers.
+- :func:`install_preemption_handler` — the signal half: registers a SIGTERM
+  (configurable) handler that records the notice (``preemption_notice``
+  ledger event + flight-ring incident mark) and either just flags a
+  :class:`PreemptionGuard` (``mode="notify"``) or interrupts the main thread
+  with :class:`PreemptionInterrupt` (``mode="raise"``) so a blocked main
+  loop reacts within the grace window.  The handler itself does NO heavy
+  work (async-signal discipline): draining runs wherever the caller calls
+  :meth:`PreemptionGuard.drain_now`.
+
+Why the final cut is safe under SIGTERM: a coordinated (elastic) cut runs a
+barrier over the host-object wire.  A *polite* preemption preempts the whole
+job, so every rank receives the notice and every rank reaches its final
+``snapshot()`` — the barrier completes.  A rank that dies instead of
+draining turns the final cut partial, which the restore side refuses or
+quorum-degrades explicitly (:mod:`tpumetrics.resilience.elastic`) — never
+silently.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from tpumetrics.telemetry import export as _export
+from tpumetrics.telemetry import ledger as _telemetry
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+__all__ = [
+    "DrainReport",
+    "DrainingError",
+    "PreemptionGuard",
+    "PreemptionInterrupt",
+    "install_preemption_handler",
+]
+
+
+class DrainingError(TPUMetricsUserError):
+    """Submit refused: this evaluator/service is draining for shutdown.
+
+    Raised by every ``submit`` after ``request_drain()`` (or a preemption
+    notice) — the typed signal for load balancers/callers to re-route the
+    stream instead of feeding a process that is about to exit."""
+
+
+class PreemptionInterrupt(BaseException):
+    """Raised IN THE MAIN THREAD by a ``mode="raise"`` preemption handler.
+
+    Derives from :class:`BaseException` (like ``KeyboardInterrupt``) so
+    ordinary ``except Exception`` recovery paths cannot swallow the notice;
+    catch it explicitly at the serving loop's top level and drain."""
+
+    def __init__(self, signum: int) -> None:
+        super().__init__(f"preemption notice (signal {signum})")
+        self.signum = signum
+
+
+@dataclass
+class DrainReport:
+    """What one target's graceful drain covered (returned by ``drain()``).
+
+    ``batches``/``items`` are the stream position the final state covers
+    (everything submitted before intake stopped — nothing in flight was
+    lost); ``cut_path``/``cut_step`` identify the final snapshot when one
+    was written (``final_cut=True`` and a snapshot dir configured);
+    ``drain_ms`` is the flush+final-cut wall time (also stamped into the
+    ``drain_complete`` ledger event — the durable copy, since ``close``
+    releases the per-stream histogram series as part of its own
+    contract)."""
+
+    target: str
+    batches: int
+    items: int
+    cut_path: Optional[str] = None
+    cut_step: Optional[int] = None
+    drain_ms: Optional[float] = None
+    tenants: Dict[str, "DrainReport"] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "target": self.target,
+            "batches": self.batches,
+            "items": self.items,
+            "cut_path": self.cut_path,
+            "cut_step": self.cut_step,
+            "drain_ms": self.drain_ms,
+        }
+        if self.tenants:
+            out["tenants"] = {k: v.to_dict() for k, v in self.tenants.items()}
+        return out
+
+
+class PreemptionGuard:
+    """The main-loop side of an installed preemption handler.
+
+    ``requested`` flips (and :meth:`wait` unblocks) when the signal lands;
+    :meth:`drain_now` runs the graceful sequence over every registered
+    target — stop intake, flush, final cut, close — and returns the per-
+    target :class:`DrainReport` list.  Idempotent: a second signal or a
+    second ``drain_now`` call does not double-drain."""
+
+    def __init__(
+        self,
+        targets: Sequence[Any],
+        *,
+        final_cut: bool = True,
+        on_drained: Optional[Callable[[List[DrainReport]], None]] = None,
+    ) -> None:
+        self._targets = list(targets)
+        self._final_cut = bool(final_cut)
+        self._on_drained = on_drained
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._signum: Optional[int] = None
+        self._notified_at: Optional[float] = None
+        self._reports: Optional[List[DrainReport]] = None
+        self._previous: Dict[int, Any] = {}
+        # the notice runner is PRE-SPAWNED and parked: the signal handler
+        # may not allocate or start threads (Thread.start takes threading's
+        # internal non-reentrant lock — a signal landing while the main
+        # thread is itself inside Thread.start would self-deadlock), so the
+        # handler only flips the wake event of a thread that already exists
+        self._wake = threading.Event()
+        self._closed = False
+        self._runner = threading.Thread(
+            target=self._notice_runner, name="tpumetrics-preemption-notice",
+            daemon=True,
+        )
+        self._runner.start()
+
+    # ------------------------------------------------------------- observe
+
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def signum(self) -> Optional[int]:
+        return self._signum
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until a preemption notice arrives (or ``timeout``)."""
+        return self._event.wait(timeout)
+
+    # -------------------------------------------------------------- notice
+
+    def _notice(self, signum: int) -> bool:
+        """Signal-handler body; returns True only for the FIRST notice.
+        MUST stay lock-free against anything the interrupted main thread
+        could hold: the handler runs in the main thread between bytecodes,
+        so taking the service lock (mid-submit), the ledger lock (mid-emit)
+        or threading's thread-startup lock would self-deadlock.  It
+        therefore only records the signum and wakes the PRE-SPAWNED runner
+        (the wake event's internal lock is touched by no other main-thread
+        code path); everything that locks — telemetry records,
+        ``request_drain`` on the targets — runs on the runner, which sets
+        the guard's public event AFTER intake is off, so ``wait()``/
+        ``requested`` returning true implies late submits already fail
+        typed."""
+        if self._signum is not None:
+            return False  # repeated signal: the first notice is in flight
+        self._signum = signum
+        self._notified_at = time.monotonic()
+        self._wake.set()
+        return True
+
+    def _notice_runner(self) -> None:
+        self._wake.wait()
+        if self._closed:
+            return
+        signum = self._signum
+        _telemetry.record_event(
+            None, "preemption_notice", signum=int(signum), pid=os.getpid()
+        )
+        _export.note_incident("preemption_notice", signum=int(signum))
+        for t in self._targets:
+            request = getattr(t, "request_drain", None)
+            if request is not None:
+                request()  # intake off: late submits get typed errors
+        self._event.set()
+
+    # --------------------------------------------------------------- drain
+
+    def drain_now(self, timeout: Optional[float] = None) -> List[DrainReport]:
+        """Run the graceful sequence on every target (idempotent)."""
+        with self._lock:
+            if self._reports is not None:
+                return self._reports
+            reports: List[DrainReport] = []
+            for t in self._targets:
+                reports.append(t.drain(final_cut=self._final_cut, timeout=timeout))
+            self._reports = reports
+        if self._on_drained is not None:
+            self._on_drained(reports)
+        return reports
+
+    def uninstall(self) -> None:
+        """Restore the previously-installed signal handlers and release the
+        parked notice runner."""
+        for signum, prev in self._previous.items():
+            try:
+                signal.signal(signum, prev)
+            except (ValueError, OSError):  # not main thread / signal gone
+                pass
+        self._previous.clear()
+        if self._signum is None:  # never signaled: let the runner exit
+            self._closed = True
+            self._wake.set()
+
+
+def install_preemption_handler(
+    *targets: Any,
+    signals: Tuple[int, ...] = (signal.SIGTERM,),
+    mode: str = "notify",
+    final_cut: bool = True,
+    on_drained: Optional[Callable[[List[DrainReport]], None]] = None,
+) -> PreemptionGuard:
+    """Register a preemption-notice handler for ``targets`` (evaluators/
+    services — anything with ``request_drain``/``drain``).
+
+    ``mode="notify"`` sets the returned guard's flag (poll ``requested`` or
+    block in ``wait()``); ``mode="raise"`` additionally raises
+    :class:`PreemptionInterrupt` in the main thread, interrupting a blocked
+    main loop — the right choice for command-loop workers whose grace
+    window is short.  Either way the handler marks intake off on every
+    target immediately, so submits racing the notice fail typed instead of
+    landing in a queue that is about to be drained for the last time.
+
+    Must be called from the main thread (CPython restricts
+    ``signal.signal``); returns the :class:`PreemptionGuard`.  Call
+    :meth:`PreemptionGuard.uninstall` to restore previous handlers (tests).
+    """
+    if mode not in ("notify", "raise"):
+        raise ValueError(f"mode must be 'notify' or 'raise', got {mode!r}")
+    guard = PreemptionGuard(targets, final_cut=final_cut, on_drained=on_drained)
+
+    def _handler(signum: int, _frame: Any) -> None:
+        first = guard._notice(signum)
+        if mode == "raise" and first:
+            # only the FIRST notice interrupts: a fleet re-sending SIGTERM
+            # during the grace window must not abort the drain the first
+            # signal already started (the guard's documented idempotency)
+            raise PreemptionInterrupt(signum)
+
+    for signum in signals:
+        guard._previous[signum] = signal.signal(signum, _handler)
+    return guard

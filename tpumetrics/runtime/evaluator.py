@@ -95,6 +95,16 @@ _DISPATCH_HIST = _instruments.histogram(
 _JOURNAL_GAUGE = _instruments.gauge(
     _instruments.JOURNAL_LEN, help="crash-replay journal length", labels=("stream",)
 )
+_RESTORE_HIST = _instruments.histogram(
+    _instruments.RESTORE_LATENCY_MS,
+    help="elastic restore (cut discovery + fold + reshard + place) latency",
+    labels=("stream",),
+)
+_DRAIN_HIST = _instruments.histogram(
+    _instruments.DRAIN_LATENCY_MS,
+    help="graceful drain (flush + final cut) latency",
+    labels=("stream",),
+)
 
 
 class CrashLoopError(TPUMetricsUserError):
@@ -122,7 +132,15 @@ class StreamingEvaluator:
         snapshot_dir: enable snapshots into this directory.
         snapshot_every: auto-snapshot every n drained batches (requires
             ``snapshot_dir``); manual :meth:`snapshot` works regardless.
-        keep_snapshots: retention for :class:`SnapshotManager`.
+        keep_snapshots: retention for :class:`SnapshotManager` (per rank
+            directory in elastic mode).
+        keep_cuts: CUT-level retention for elastic mode (requires
+            ``snapshot_rank``/``snapshot_world_size``): keep the newest N
+            complete coordinated cuts and garbage-collect superseded
+            partial cuts + stale rank dirs, auto-run on rank 0's saves
+            (:func:`tpumetrics.resilience.elastic.gc_cuts`) — the policy a
+            days-long soak needs so the snapshot root stays O(N) instead
+            of O(history).  Overrides ``keep_snapshots``.
         update_kwargs: static keyword arguments forwarded to every update
             (e.g. ``real=True``); per-batch data is positional.
         crash_policy: ``"raise"`` (default — a crashing batch poisons the
@@ -192,6 +210,7 @@ class StreamingEvaluator:
         snapshot_dir: Optional[str] = None,
         snapshot_every: Optional[int] = None,
         keep_snapshots: Optional[int] = 3,
+        keep_cuts: Optional[int] = None,
         update_kwargs: Optional[Dict[str, Any]] = None,
         crash_policy: str = "raise",
         max_restores: int = 3,
@@ -285,6 +304,12 @@ class StreamingEvaluator:
         self._crashes = 0
         self._restores = 0
         self._degraded = False
+        # graceful-drain state: flag read lock-free on the submit hot path
+        # (a single store-release is enough — late submits only need to fail
+        # EVENTUALLY-before-close, and drain() flushes after setting it)
+        self._drain_requested = False
+        self._drain_report: Optional[Any] = None
+        self._drain_lock = threading.Lock()  # serializes concurrent drain()s
 
         if (snapshot_rank is None) != (snapshot_world_size is None):
             raise ValueError("snapshot_rank and snapshot_world_size must be set together")
@@ -317,9 +342,16 @@ class StreamingEvaluator:
 
             self._elastic_config = config_digest(metric)
             self._snapshots: Optional[Any] = DistributedSnapshotManager(
-                snapshot_dir, self._rank, self._world, keep=keep_snapshots
+                snapshot_dir, self._rank, self._world, keep=keep_snapshots,
+                keep_cuts=keep_cuts,
             )
         else:
+            if keep_cuts is not None:
+                raise ValueError(
+                    "keep_cuts is cut-level retention and needs the elastic "
+                    "constructor arguments (snapshot_rank/snapshot_world_size); "
+                    "use keep_snapshots for rank-local retention."
+                )
             self._snapshots = (
                 _snapshot.SnapshotManager(snapshot_dir, keep=keep_snapshots)
                 if snapshot_dir
@@ -351,6 +383,15 @@ class StreamingEvaluator:
         """
         if not args:
             raise ValueError("submit() needs at least one positional batch argument")
+        if self._drain_requested:
+            from tpumetrics.runtime.drain import DrainingError
+
+            raise DrainingError(
+                f"StreamingEvaluator {self._stream!r} is draining (preemption notice "
+                "or request_drain()): intake is closed. Re-route the stream; batches "
+                "submitted before the drain began are being applied and will be "
+                "covered by the final snapshot cut."
+            )
         timed = _instruments.enabled()
         t0 = time.perf_counter() if timed else 0.0
         root = _spans.start_trace("batch", stream=self._stream)
@@ -382,7 +423,9 @@ class StreamingEvaluator:
         try:
             self._dispatcher.close(drain=drain, timeout=timeout)
         finally:
-            for inst in (_SUBMIT_HIST, _DISPATCH_HIST, _JOURNAL_GAUGE):
+            for inst in (
+                _SUBMIT_HIST, _DISPATCH_HIST, _JOURNAL_GAUGE, _RESTORE_HIST, _DRAIN_HIST,
+            ):
                 inst.remove(self._stream)
             _DEPTH_GAUGE.remove(self._stream)
             # drift monitors: per-stream latch state + the
@@ -405,6 +448,65 @@ class StreamingEvaluator:
         except Exception:
             if exc_type is None:
                 raise
+
+    # --------------------------------------------------------- graceful drain
+
+    def request_drain(self) -> None:
+        """Close intake NOW (``submit`` raises a typed
+        :class:`~tpumetrics.runtime.drain.DrainingError`) without touching
+        the queue — already-submitted batches keep applying.  The first half
+        of the graceful-preemption contract; :meth:`drain` is the rest."""
+        if not self._drain_requested:
+            self._drain_requested = True
+            _telemetry.record_event(None, "drain_requested", stream=self._stream)
+
+    @property
+    def draining(self) -> bool:
+        return self._drain_requested
+
+    def drain(self, final_cut: bool = True, timeout: Optional[float] = None) -> Any:
+        """Graceful shutdown: stop intake, apply every queued batch, write
+        one final snapshot cut (when ``final_cut`` and snapshots are
+        configured — a COORDINATED cut in elastic mode, so a politely
+        preempted world loses zero in-flight batches), close the worker,
+        and return a :class:`~tpumetrics.runtime.drain.DrainReport` naming
+        exactly the stream position the final state covers.  Idempotent AND
+        serialized: concurrent callers (the preemption guard racing an
+        application shutdown path) get ONE drain — a duplicate final cut
+        would re-enter the elastic barrier after the peers already exited
+        and burn the grace window on its timeout."""
+        from tpumetrics.runtime.drain import DrainReport
+
+        with self._drain_lock:
+            if self._drain_report is not None:
+                return self._drain_report
+            self.request_drain()
+            timed = _instruments.enabled()
+            t0 = time.perf_counter()
+            self.flush(timeout=timeout)
+            cut_path: Optional[str] = None
+            cut_step: Optional[int] = None
+            if final_cut and self._snapshots is not None:
+                cut_path = self.snapshot()
+                cut_step = self._snapshots.last_step
+            with self._lock:
+                batches, items = self._batches, self._items
+            drain_ms = (time.perf_counter() - t0) * 1e3
+            if timed:
+                _DRAIN_HIST.observe(drain_ms, self._stream)
+            # the ledger event is the DURABLE latency record: close() below
+            # releases this stream's histogram series per its own contract
+            _telemetry.record_event(
+                None, "drain_complete", stream=self._stream, batches=batches,
+                items=items, cut_step=cut_step, drain_ms=round(drain_ms, 3),
+            )
+            report = DrainReport(
+                target=self._stream, batches=batches, items=items,
+                cut_path=cut_path, cut_step=cut_step, drain_ms=drain_ms,
+            )
+            self.close(drain=True, timeout=timeout)
+            self._drain_report = report  # cached only once the close succeeded
+            return report
 
     # ---------------------------------------------------------------- results
 
@@ -619,6 +721,7 @@ class StreamingEvaluator:
             load_latest_cut,
         )
 
+        t_restore = time.perf_counter()
         with self._lock:
             if self._batches or self._dispatcher.stats()["enqueued"]:
                 raise TPUMetricsUserError(
@@ -685,11 +788,16 @@ class StreamingEvaluator:
             self._degraded = degraded
             self._elastic_base_batches = total_batches
             self._elastic_base_items = total_items
+            restore_ms = (time.perf_counter() - t_restore) * 1e3
+            if _instruments.enabled():
+                # the per-cycle number the chaos soak / bench series reads:
+                # cut discovery + CRC loads + fold + reshard + placement
+                _RESTORE_HIST.observe(restore_ms, self._stream)
             _telemetry.record_event(
                 self._barrier_backend, "elastic_restore", step=cut.step,
                 from_world=cut.world_size, world_size=self._world, rank=self._rank,
                 batches=total_batches, degraded=degraded,
-                missing=list(cut.missing),
+                missing=list(cut.missing), restore_ms=round(restore_ms, 3),
             )
             return {
                 "step": cut.step,
@@ -700,6 +808,7 @@ class StreamingEvaluator:
                 "rank": self._rank,
                 "degraded": degraded,
                 "missing_ranks": list(cut.missing),
+                "restore_ms": restore_ms,
             }
 
     def _place_state(self, payload: Any) -> Any:

@@ -310,6 +310,9 @@ class EvaluationService:
         self._megabatch_tenants = 0
         self._mega_group_meta = (0, 0, 0)  # worker-thread-only scratch
         self._quarantines = 0
+        self._draining = False  # graceful drain: intake refused service-wide
+        self._drain_report: Optional[Any] = None
+        self._drain_lock = threading.Lock()  # serializes concurrent drain()s
         self._name = name
         self._label = f"{name}#{next(_SERVICE_IDS)}"
         self._dispatcher = AsyncDispatcher(
@@ -409,6 +412,12 @@ class EvaluationService:
             megabatch=megabatch and step is not None and mesh is None,
         )
         with self._lock:
+            if self._draining:
+                from tpumetrics.runtime.drain import DrainingError
+
+                raise DrainingError(
+                    f"EvaluationService {self._label!r} is draining: no new tenants."
+                )
             if tenant_id in self._tenants:
                 raise ValueError(f"tenant {tenant_id!r} is already registered")
             # the scheduler joins FIRST: a failure here must not publish a
@@ -468,6 +477,15 @@ class EvaluationService:
         span: "one batch = one trace" is anchored here)."""
         if not args:
             raise ValueError("submit() needs at least one positional batch argument")
+        if self._draining:
+            from tpumetrics.runtime.drain import DrainingError
+
+            raise DrainingError(
+                f"EvaluationService {self._label!r} is draining (preemption notice "
+                f"or request_drain()): intake is closed for tenant {tenant_id!r}. "
+                "Batches submitted before the drain began are being applied and "
+                "will be covered by each tenant's final snapshot."
+            )
         tenant = self._get(tenant_id)
         timed = _instruments.enabled()
         t0 = time.perf_counter() if timed else 0.0
@@ -510,6 +528,14 @@ class EvaluationService:
                     else:  # block
                         while len(tenant.queue) >= tenant.max_queue:
                             self._raise_if_quarantined(tenant)
+                            if self._draining:
+                                from tpumetrics.runtime.drain import DrainingError
+
+                                raise DrainingError(
+                                    f"EvaluationService {self._label!r} began draining "
+                                    f"while tenant {tenant_id!r} waited for queue "
+                                    "space: intake is closed."
+                                )
                             self._space.wait()
                 tenant.queue.append(entry)
                 tenant.pending += 1
@@ -542,6 +568,76 @@ class EvaluationService:
                         f"(pending={tenant.pending})."
                     )
             self._raise_if_quarantined(tenant)
+
+    # --------------------------------------------------------- graceful drain
+
+    def request_drain(self) -> None:
+        """Close intake service-wide: every tenant's ``submit`` (and
+        :meth:`TenantHandle.submit`) raises a typed
+        :class:`~tpumetrics.runtime.drain.DrainingError` from now on, while
+        already-queued batches keep applying.  Blocked ``"block"``-policy
+        submitters are woken so they observe the drain instead of waiting
+        on queue space forever."""
+        notify = False
+        with self._lock:
+            if not self._draining:
+                self._draining = True
+                notify = True
+            self._space.notify_all()
+        if notify:
+            _telemetry.record_event(None, "drain_requested", stream=self._label)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self, final_cut: bool = True, timeout: Optional[float] = None) -> Any:
+        """Graceful shutdown of the whole service: stop intake, apply every
+        tenant's queued batches, write one final snapshot per tenant that
+        has a snapshot dir (when ``final_cut``), close the shared worker,
+        and return a :class:`~tpumetrics.runtime.drain.DrainReport` whose
+        ``tenants`` section names each tenant's covered position.
+        Quarantined tenants are skipped (their queues were already
+        discarded; the report omits them).  Idempotent AND serialized:
+        concurrent callers get ONE drain (a duplicate per-tenant final cut
+        is wasted work at best, a barrier hang in elastic setups)."""
+        from tpumetrics.runtime.drain import DrainReport
+
+        with self._drain_lock:
+            if self._drain_report is not None:
+                return self._drain_report
+            self.request_drain()
+            t0 = time.perf_counter()
+            self._dispatcher.flush(timeout=timeout)
+            with self._lock:
+                tenants = [t for t in self._tenants.values() if t.error is None]
+            reports: Dict[str, DrainReport] = {}
+            total_b = total_i = 0
+            for tenant in tenants:
+                cut_path = cut_step = None
+                if final_cut and tenant.snapshots is not None:
+                    cut_path = self.snapshot(tenant.tid)
+                    cut_step = tenant.snapshots.last_step
+                with self._lock:
+                    b, i = tenant.batches, tenant.items
+                reports[tenant.tid] = DrainReport(
+                    target=tenant.tid, batches=b, items=i,
+                    cut_path=cut_path, cut_step=cut_step,
+                )
+                total_b += b
+                total_i += i
+            drain_ms = (time.perf_counter() - t0) * 1e3
+            _telemetry.record_event(
+                None, "drain_complete", stream=self._label, batches=total_b,
+                items=total_i, tenants=len(reports), drain_ms=round(drain_ms, 3),
+            )
+            report = DrainReport(
+                target=self._label, batches=total_b, items=total_i,
+                drain_ms=drain_ms, tenants=reports,
+            )
+            self.close(drain=True, timeout=timeout)
+            self._drain_report = report  # cached only once the close succeeded
+            return report
 
     def close(self, drain: bool = True, timeout: Optional[float] = None) -> None:
         """Flush every tenant (unless ``drain=False``) and stop the worker.

@@ -1,0 +1,58 @@
+"""``tpumetrics.soak`` — the chaos-soak harness: a real multi-process pool
+under a deterministic preemption/resize schedule, with standing recovery
+gates.
+
+The resilience stack (elastic cuts, quorum restore, crash replay, graceful
+drain) is exercised elsewhere through in-process emulation
+(:class:`~tpumetrics.resilience.faults.FaultInjectionBackend`) — this
+package turns the "kill the job anywhere, on any topology" claim into a
+*standing gate* over real operating-system processes and real signals:
+
+- :mod:`~tpumetrics.soak.schedule` — a seeded, deterministic chaos schedule
+  (:func:`generate_schedule`): SIGKILL at arbitrary points, SIGTERM
+  graceful-drain preemptions, and repeated world resizes (grow AND shrink,
+  e.g. 4→2→3→4), JSON round-trippable for the CLI.
+- :mod:`~tpumetrics.soak.wire` — :class:`FileBarrierBackend`, a host-object
+  barrier channel over a shared directory, so the coordinated snapshot cut
+  runs across real process boundaries on ANY box (``jax.distributed`` /
+  DCN collectives are not required; where they exist the evaluator takes
+  the real backend instead — ``tests/multihost``).
+- :mod:`~tpumetrics.soak.worker` — one rank = one subprocess driving
+  continuous traffic through a :class:`~tpumetrics.runtime.evaluator.
+  StreamingEvaluator` (bucketed, donated, elastic snapshots, cut-level
+  retention), with a SIGTERM handler that drains gracefully: intake off,
+  queue applied, one final coordinated cut, typed exit status.
+- :mod:`~tpumetrics.soak.supervisor` — spawns the pool, executes the
+  schedule, and after EVERY incident asserts the standing gates:
+  ``compute()`` bit-identical to an uninterrupted single-world oracle,
+  restore latency under the declared ceiling, exactly-once replay (the
+  adopted position equals the covered stream prefix), and telemetry
+  continuity (``elastic_restore``/``elastic_degraded`` ledger events match
+  the schedule, one flight-recorder dump per induced incident).  Emits a
+  JSONL incident report plus a summary with throughput and restore-latency
+  p50/p99 — the series the ``chaos_soak`` bench scenario gates.
+
+Three entry points: ``python -m tpumetrics.soak`` (CLI: schedule file in,
+incident JSONL out), the ``-m slow`` pytest short soak
+(``tests/test_soak.py``), and the ``chaos_soak`` bench scenario
+(``bench.py``).  See the "Chaos soak & preemption runbook" section of
+``docs/resilience.md``.
+"""
+
+from tpumetrics.soak.schedule import (
+    ChaosSchedule,
+    Incident,
+    generate_schedule,
+)
+from tpumetrics.soak.supervisor import ChaosSoakError, SoakSupervisor, run_soak
+from tpumetrics.soak.wire import FileBarrierBackend
+
+__all__ = [
+    "ChaosSchedule",
+    "ChaosSoakError",
+    "FileBarrierBackend",
+    "Incident",
+    "SoakSupervisor",
+    "generate_schedule",
+    "run_soak",
+]

@@ -1,0 +1,8 @@
+"""Entry point for ``python -m tpumetrics.soak`` (see soak/cli.py)."""
+
+import sys
+
+from tpumetrics.soak.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

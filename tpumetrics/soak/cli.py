@@ -1,0 +1,99 @@
+"""``python -m tpumetrics.soak`` — the chaos-soak CLI.
+
+Two subcommands:
+
+- ``generate`` — derive a deterministic schedule from a seed and write it
+  as JSON (inspect it, check it into CI, replay a failure)::
+
+      python -m tpumetrics.soak generate --seed 7 --world 3 --incidents 6 \\
+          -o schedule.json
+
+- ``run`` — execute a schedule (from a file, or generated inline from
+  ``--seed``) over a real process pool rooted at ``--root``, writing the
+  JSONL incident report (one line per incident, a ``summary`` line last)::
+
+      python -m tpumetrics.soak run --schedule schedule.json \\
+          --root /tmp/soak --out report.jsonl
+
+Exit status: 0 when every incident recovered and every gate held, 1 when
+any incident was unrecovered, 2 for usage/schedule errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+from typing import Optional, Sequence
+
+from tpumetrics.soak.schedule import ChaosSchedule, ScheduleError, generate_schedule
+from tpumetrics.soak.supervisor import run_soak
+
+__all__ = ["main"]
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpumetrics.soak", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    gen = sub.add_parser("generate", help="derive a schedule from a seed")
+    gen.add_argument("--seed", type=int, default=0)
+    gen.add_argument("--world", type=int, default=3)
+    gen.add_argument("--incidents", type=int, default=6)
+    gen.add_argument("--min-world", type=int, default=2)
+    gen.add_argument("--max-world", type=int, default=4)
+    gen.add_argument("--feed-low", type=int, default=6)
+    gen.add_argument("--feed-high", type=int, default=16)
+    gen.add_argument("--cut-every", type=int, default=4)
+    gen.add_argument("-o", "--out", default="-", help="schedule JSON path ('-' = stdout)")
+
+    run = sub.add_parser("run", help="execute a schedule over a real pool")
+    src = run.add_mutually_exclusive_group(required=True)
+    src.add_argument("--schedule", help="schedule JSON file (from 'generate')")
+    src.add_argument("--seed", type=int, help="generate the schedule inline from this seed")
+    run.add_argument("--world", type=int, default=3, help="initial world for --seed")
+    run.add_argument("--incidents", type=int, default=6, help="incident count for --seed")
+    run.add_argument("--root", default=None, help="soak root dir (default: a fresh tempdir)")
+    run.add_argument("--out", default=None, help="JSONL incident report path")
+    run.add_argument("--verbose", action="store_true")
+    return ap
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    try:
+        if args.command == "generate":
+            schedule = generate_schedule(
+                args.seed, world=args.world, n_incidents=args.incidents,
+                min_world=args.min_world, max_world=args.max_world,
+                feed_low=args.feed_low, feed_high=args.feed_high,
+                cut_every=args.cut_every,
+            )
+            text = schedule.to_json()
+            if args.out == "-":
+                print(text)
+            else:
+                with open(args.out, "w") as fh:
+                    fh.write(text + "\n")
+            return 0
+
+        if args.schedule is not None:
+            with open(args.schedule) as fh:
+                schedule = ChaosSchedule.from_json(fh.read())
+        else:
+            schedule = generate_schedule(
+                args.seed, world=args.world, n_incidents=args.incidents
+            )
+    except (ScheduleError, OSError) as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 2
+
+    root = args.root or tempfile.mkdtemp(prefix="tpumetrics-soak-")
+    report = run_soak(schedule, root, out_jsonl=args.out, verbose=args.verbose)
+    summary = {k: v for k, v in report.items() if k != "incidents"}
+    print(json.dumps(summary, sort_keys=True))
+    return 0 if report["unrecovered"] == 0 else 1

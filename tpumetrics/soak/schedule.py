@@ -1,0 +1,240 @@
+"""Deterministic, seeded chaos schedules.
+
+A schedule is a list of :class:`Incident`\\ s executed in order by the
+supervisor.  One incident = one *leg* of traffic (``feed`` batches through
+the current world, coordinated cuts every ``cut_every`` batches) followed by
+one induced failure and one recovery+verification cycle:
+
+- ``"sigterm"`` — polite preemption of the whole job: every rank receives
+  SIGTERM, drains gracefully (intake off → queue applied → one final
+  coordinated cut → typed exit), and the restore must cover EVERY fed
+  batch — a polite preemption loses nothing.
+- ``"sigkill"`` — abrupt death: ``tail`` batches are fed *after* the last
+  cut (so the kill lands at an arbitrary point of the stream, not at a cut
+  boundary), then the victim rank is SIGKILLed and the remaining ranks'
+  slice is torn down.  Recovery restores the last complete cut; the tail is
+  re-fed — the exactly-once gate.  With ``lose_member`` the victim's newest
+  cut member is destroyed too (the killed-between-rename-and-replication
+  failure mode), forcing an explicit quorum-degraded restore whose expected
+  value the supervisor still predicts exactly.
+- ``"shrink"`` / ``"grow"`` — world resize (``world_after`` differs), via
+  graceful drain or abruptly (``abrupt=True`` rides the sigkill mechanism).
+
+Determinism is load-bearing: :func:`generate_schedule` derives everything
+from one seed via :class:`random.Random`, so a failing soak replays
+bit-identically from its seed, and the pytest/bench gates pin known-good
+seeds.  :func:`ChaosSchedule.to_json`/:func:`~ChaosSchedule.from_json`
+round-trip the schedule for the ``python -m tpumetrics.soak`` CLI.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import asdict, dataclass, replace
+from typing import Any, Dict, Optional, Tuple
+
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+__all__ = ["ChaosSchedule", "Incident", "ScheduleError", "generate_schedule"]
+
+KINDS = ("sigkill", "sigterm", "shrink", "grow")
+
+
+class ScheduleError(TPUMetricsUserError):
+    """A chaos schedule is malformed (unknown kind, illegal world size,
+    tail exceeding the leg, victim outside the world)."""
+
+
+@dataclass(frozen=True)
+class Incident:
+    """One leg of traffic plus one induced failure (module docstring)."""
+
+    kind: str
+    feed: int  # batches fed across the world during this leg
+    world_after: int  # world size of the NEXT leg
+    abrupt: bool = False  # SIGKILL mechanism (always True for kind="sigkill")
+    target_rank: Optional[int] = None  # victim rank for abrupt incidents
+    tail: int = 0  # batches fed after the last cut (lost by an abrupt kill)
+    lose_member: bool = False  # destroy the victim's newest cut member too
+
+    def validate(self, world_before: int, min_world: int = 1) -> None:
+        if self.kind not in KINDS:
+            raise ScheduleError(f"Unknown incident kind {self.kind!r}; expected one of {KINDS}")
+        if self.feed < 1:
+            raise ScheduleError(f"{self.kind}: feed must be >= 1, got {self.feed}")
+        if self.world_after < max(1, min_world):
+            raise ScheduleError(
+                f"{self.kind}: world_after must be >= {max(1, min_world)}, got {self.world_after}"
+            )
+        if self.kind == "shrink" and not self.world_after < world_before:
+            raise ScheduleError(
+                f"shrink must reduce the world ({world_before} -> {self.world_after})"
+            )
+        if self.kind == "grow" and not self.world_after > world_before:
+            raise ScheduleError(
+                f"grow must enlarge the world ({world_before} -> {self.world_after})"
+            )
+        if self.kind == "sigterm" and self.abrupt:
+            raise ScheduleError("sigterm is the graceful mechanism; use sigkill for abrupt")
+        if self.kind == "sigkill" and not self.abrupt:
+            raise ScheduleError("sigkill incidents must set abrupt=True")
+        if self.abrupt:
+            if self.target_rank is None or not (0 <= self.target_rank < world_before):
+                raise ScheduleError(
+                    f"{self.kind}: abrupt incidents need target_rank in [0, {world_before}), "
+                    f"got {self.target_rank}"
+                )
+            if not (0 <= self.tail < self.feed):
+                raise ScheduleError(
+                    f"{self.kind}: tail must be in [0, feed), got tail={self.tail} feed={self.feed}"
+                )
+            if self.lose_member and self.target_rank == 0:
+                # rank 0 carries the whole resharded prefix (sum states land
+                # rank0 + zeros): losing its member would lose the entire
+                # history, which is a different scenario than "one rank's
+                # leg went missing" — keep the expected-value math honest
+                raise ScheduleError("lose_member incidents need target_rank >= 1")
+        else:
+            if self.tail:
+                raise ScheduleError(f"{self.kind}: graceful incidents drain everything (tail=0)")
+            if self.lose_member:
+                raise ScheduleError("lose_member needs an abrupt incident")
+
+
+@dataclass(frozen=True)
+class ChaosSchedule:
+    """A full soak: initial world, incident list, cadences, gates."""
+
+    seed: int
+    world: int
+    incidents: Tuple[Incident, ...]
+    cut_every: int = 4  # coordinated-cut cadence in batches
+    num_classes: int = 5  # traffic/metric shape
+    max_rows: int = 8  # rows per batch in [1, max_rows]; also the bucket cap
+    traffic_seed: int = 1
+    keep_cuts: int = 3  # cut-level retention during the soak
+    restore_ceiling_s: float = 60.0  # per-cycle restore latency gate
+    barrier_timeout_s: float = 90.0  # file-wire + SyncPolicy deadline
+
+    def __post_init__(self) -> None:
+        if self.world < 1:
+            raise ScheduleError(f"world must be >= 1, got {self.world}")
+        if self.cut_every < 1:
+            raise ScheduleError(f"cut_every must be >= 1, got {self.cut_every}")
+        world = self.world
+        for inc in self.incidents:
+            inc.validate(world)
+            world = inc.world_after
+
+    @property
+    def worlds(self) -> Tuple[int, ...]:
+        """World-size trajectory, initial world first."""
+        out = [self.world]
+        for inc in self.incidents:
+            out.append(inc.world_after)
+        return tuple(out)
+
+    def with_(self, **kwargs: Any) -> "ChaosSchedule":
+        return replace(self, **kwargs)
+
+    # ------------------------------------------------------------ round trip
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = asdict(self)
+        out["incidents"] = [asdict(i) for i in self.incidents]
+        return out
+
+    def to_json(self, indent: int = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "ChaosSchedule":
+        data = dict(data)
+        incidents = tuple(Incident(**i) for i in data.pop("incidents", ()))
+        return cls(incidents=incidents, **data)
+
+    @classmethod
+    def from_json(cls, text: str) -> "ChaosSchedule":
+        try:
+            return cls.from_dict(json.loads(text))
+        except (TypeError, KeyError, json.JSONDecodeError) as err:
+            raise ScheduleError(f"Unreadable schedule: {err}") from err
+
+
+def generate_schedule(
+    seed: int = 0,
+    *,
+    world: int = 3,
+    n_incidents: int = 6,
+    min_world: int = 2,
+    max_world: int = 4,
+    feed_low: int = 6,
+    feed_high: int = 16,
+    cut_every: int = 4,
+    **schedule_kwargs: Any,
+) -> ChaosSchedule:
+    """Derive a legal chaos schedule from one seed.
+
+    Guarantees (for ``n_incidents >= 4``): at least one SIGKILL, one SIGTERM
+    graceful drain, one shrink and one grow — the acceptance mix — placed in
+    seeded order; remaining slots draw random kinds.  World sizes stay in
+    ``[min_world, max_world]`` throughout; every abrupt incident gets a
+    seeded victim and a seeded post-cut ``tail`` so kills land at arbitrary
+    stream points.  Same seed → byte-identical schedule.
+    """
+    if n_incidents < 1:
+        raise ScheduleError(f"n_incidents must be >= 1, got {n_incidents}")
+    if not (1 <= min_world <= world <= max_world):
+        raise ScheduleError(
+            f"need 1 <= min_world <= world <= max_world, got {min_world}/{world}/{max_world}"
+        )
+    rng = random.Random(seed)
+    required = list(KINDS) if n_incidents >= len(KINDS) else list(KINDS[:n_incidents])
+    rng.shuffle(required)
+    kinds = required + [rng.choice(KINDS) for _ in range(n_incidents - len(required))]
+
+    incidents = []
+    cur = world
+    for kind in kinds:
+        # keep every slot legal for the CURRENT world (random extras may
+        # land on a world already at a bound; required kinds are placed
+        # first, while both directions are still reachable)
+        if kind == "shrink" and cur <= min_world:
+            kind = "grow" if cur < max_world else "sigterm"
+        if kind == "grow" and cur >= max_world:
+            kind = "shrink" if cur > min_world else "sigterm"
+        feed = rng.randint(feed_low, feed_high)
+        if kind == "sigterm":
+            inc = Incident(kind="sigterm", feed=feed, world_after=cur)
+        elif kind == "sigkill":
+            lose = cur >= 2 and rng.random() < 0.34
+            target = rng.randrange(1, cur) if lose else rng.randrange(cur)
+            inc = Incident(
+                kind="sigkill", feed=feed, world_after=cur, abrupt=True,
+                target_rank=target, tail=rng.randint(1, max(1, cut_every - 1)),
+                lose_member=lose,
+            )
+        else:
+            world_after = (
+                rng.randint(min_world, cur - 1) if kind == "shrink"
+                else rng.randint(cur + 1, max_world)
+            )
+            abrupt = rng.random() < 0.5
+            if abrupt:
+                lose = cur >= 2 and rng.random() < 0.25
+                target = rng.randrange(1, cur) if lose else rng.randrange(cur)
+                inc = Incident(
+                    kind=kind, feed=feed, world_after=world_after, abrupt=True,
+                    target_rank=target, tail=rng.randint(1, max(1, cut_every - 1)),
+                    lose_member=lose,
+                )
+            else:
+                inc = Incident(kind=kind, feed=feed, world_after=world_after)
+        incidents.append(inc)
+        cur = inc.world_after
+
+    return ChaosSchedule(
+        seed=seed, world=world, incidents=tuple(incidents), cut_every=cut_every,
+        **schedule_kwargs,
+    )

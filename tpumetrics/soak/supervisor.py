@@ -1,0 +1,623 @@
+"""The chaos-soak supervisor: spawn, injure, recover, verify — repeatedly.
+
+Owns a pool of real worker subprocesses (:mod:`tpumetrics.soak.worker`),
+executes a deterministic :class:`~tpumetrics.soak.schedule.ChaosSchedule`,
+and asserts the standing recovery gates after EVERY incident:
+
+1. **Bit-identity.**  The newest restorable cut, folded in-process, must
+   ``compute()`` bit-identically to the uninterrupted single-world oracle
+   over exactly the committed stream prefix (for a scheduled quorum-degraded
+   restore, the oracle excludes precisely the victim's leg batches — the
+   expected degraded value is still exact, never "approximately right").
+2. **Exactly-once.**  Every restoring rank must adopt exactly the committed
+   position: an abrupt kill rolls back to the last cut and the tail is
+   re-fed once; a graceful drain covers every fed batch with zero loss.
+3. **Bounded restore latency.**  Each recovery cycle's wall time (max over
+   ranks) must stay under the schedule's declared ceiling; the per-cycle
+   series feeds the ``chaos_soak`` bench gates (p50/p99).
+4. **Telemetry continuity.**  One ``elastic_restore`` ledger event per
+   restoring rank per cycle, ``elastic_degraded`` exactly when scheduled,
+   and one flight-recorder dump per induced incident (the dying side's own
+   ``preemption_drain`` dump for graceful incidents, the supervisor's
+   incident dump always).
+
+A failed gate marks the incident unrecovered, aborts the remaining schedule
+(the state is no longer trustworthy), and surfaces in the report — the
+pytest/bench gates assert ``unrecovered == 0``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from tpumetrics.soak.schedule import ChaosSchedule, Incident
+from tpumetrics.soak.traffic import make_metric, oracle_value, values_equal
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+__all__ = ["ChaosSoakError", "SoakSupervisor", "run_soak"]
+
+_READY_TIMEOUT = 300.0  # first jax import + backend init per worker
+_CMD_TIMEOUT = 300.0  # any single command (first feed pays the XLA compile)
+
+
+class ChaosSoakError(TPUMetricsUserError):
+    """A soak invariant failed (a gate, a wedged worker, a bad schedule)."""
+
+
+class _WorkerHandle:
+    """One rank subprocess + a reader thread draining its stdout lines."""
+
+    def __init__(self, proc: subprocess.Popen, rank: int) -> None:
+        self.proc = proc
+        self.rank = rank
+        self._lines: "queue.Queue[Optional[str]]" = queue.Queue()
+        self._reader = threading.Thread(target=self._read, daemon=True)
+        self._reader.start()
+
+    def _read(self) -> None:
+        for line in self.proc.stdout:  # type: ignore[union-attr]
+            self._lines.put(line)
+        self._lines.put(None)  # EOF
+
+    def send(self, obj: Dict[str, Any]) -> None:
+        try:
+            self.proc.stdin.write(json.dumps(obj) + "\n")  # type: ignore[union-attr]
+            self.proc.stdin.flush()  # type: ignore[union-attr]
+        except (BrokenPipeError, OSError) as err:
+            raise ChaosSoakError(
+                f"rank {self.rank}: worker pipe closed while sending {obj.get('cmd')!r} "
+                f"({err}) — the process died unexpectedly (rc={self.proc.poll()})."
+            ) from err
+
+    def recv(self, timeout: float = _CMD_TIMEOUT) -> Dict[str, Any]:
+        try:
+            line = self._lines.get(timeout=timeout)
+        except queue.Empty:
+            raise ChaosSoakError(
+                f"rank {self.rank}: no response within {timeout}s "
+                f"(alive={self.proc.poll() is None})."
+            ) from None
+        if line is None:
+            raise ChaosSoakError(
+                f"rank {self.rank}: worker exited (rc={self.proc.poll()}) while a "
+                "response was expected."
+            )
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError as err:
+            raise ChaosSoakError(
+                f"rank {self.rank}: undecodable worker line {line!r} ({err})."
+            ) from err
+
+    def recv_until(self, key: str, value: Any, timeout: float = _CMD_TIMEOUT) -> Dict[str, Any]:
+        """Skip lines until one carries ``key == value`` (tolerates stray
+        output such as jax warnings routed through stdout)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = max(0.1, deadline - time.monotonic())
+            msg = self.recv(timeout=remaining)
+            if msg.get(key) == value:
+                return msg
+
+    def kill(self) -> None:
+        try:
+            self.proc.kill()
+        except OSError:
+            pass
+        self.proc.wait()
+
+    def close_pipes(self) -> None:
+        for fh in (self.proc.stdin, self.proc.stdout):
+            try:
+                if fh is not None:
+                    fh.close()
+            except OSError:
+                pass
+
+
+class SoakSupervisor:
+    """Executes one :class:`ChaosSchedule` over a real process pool."""
+
+    def __init__(
+        self,
+        schedule: ChaosSchedule,
+        root: str,
+        *,
+        python: Optional[str] = None,
+        verbose: bool = False,
+    ) -> None:
+        self.schedule = schedule
+        self.root = os.path.abspath(root)
+        self.python = python or sys.executable
+        self.verbose = bool(verbose)
+        os.makedirs(self.root, exist_ok=True)
+        self._workers: List[_WorkerHandle] = []
+        self._epoch = 0
+        # stream bookkeeping (module docstring of soak.supervisor):
+        self._stream_pos = 0  # next stream index to feed
+        self._state_pos = 0  # batches the canonical state covers
+        self._epoch_stream_start = 0  # this epoch's feed/assignment base
+        self._epoch_state_base = 0  # state position adopted at epoch start
+        self._lost: set = set()  # stream indices permanently lost (degraded)
+        self._degraded_sticky = False  # degraded round-trips via snapshot meta
+        self._cut_stream_pos = 0  # stream position of the newest cut
+        self._cut_state_pos = 0  # state position of the newest cut
+        self._restore_walls: List[float] = []
+        self._throughputs: List[float] = []
+
+    # ----------------------------------------------------------------- pool
+
+    def _env(self) -> Dict[str, str]:
+        import tpumetrics
+
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("AXON_POOL_SVC_OVERRIDE", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = ""  # one CPU device per worker
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(tpumetrics.__file__)))
+        extra = env.get("PYTHONPATH")
+        env["PYTHONPATH"] = pkg_parent + (os.pathsep + extra if extra else "")
+        # warm XLA programs across epochs: every respawned world replays the
+        # same bucketed step signatures, which is exactly what the
+        # persistent compile cache amortizes
+        env.setdefault(
+            "JAX_COMPILATION_CACHE_DIR", os.path.join(self.root, "jax_cache")
+        )
+        env.setdefault("TPUMETRICS_FLIGHT_DIR", os.path.join(self.root, "flight"))
+        return env
+
+    def _spawn(self, world: int) -> None:
+        sched = self.schedule
+        self._workers = []
+        for rank in range(world):
+            proc = subprocess.Popen(
+                [
+                    self.python, "-m", "tpumetrics.soak.worker",
+                    "--rank", str(rank), "--world", str(world),
+                    "--epoch", str(self._epoch), "--root", self.root,
+                    "--traffic-seed", str(sched.traffic_seed),
+                    "--num-classes", str(sched.num_classes),
+                    "--max-rows", str(sched.max_rows),
+                    "--keep-cuts", str(sched.keep_cuts),
+                    "--barrier-timeout", str(sched.barrier_timeout_s),
+                ],
+                env=self._env(),
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL if not self.verbose else None,
+                text=True,
+            )
+            self._workers.append(_WorkerHandle(proc, rank))
+        for w in self._workers:
+            w.recv_until("event", "ready", timeout=_READY_TIMEOUT)
+        self._log(f"epoch {self._epoch}: world {world} ready")
+
+    def _cmd_all(
+        self, cmd: Dict[str, Any], timeout: float = _CMD_TIMEOUT
+    ) -> List[Dict[str, Any]]:
+        for w in self._workers:
+            w.send(cmd)
+        out = []
+        for w in self._workers:
+            resp = w.recv_until("cmd", cmd["cmd"], timeout=timeout)
+            if not resp.get("ok"):
+                raise ChaosSoakError(
+                    f"rank {w.rank}: command {cmd['cmd']!r} failed: {resp.get('error')}"
+                )
+            out.append(resp)
+        return out
+
+    def _teardown(self, kill: bool = True) -> None:
+        for w in self._workers:
+            if kill:
+                w.kill()
+            w.close_pipes()
+        self._workers = []
+
+    def _log(self, msg: str) -> None:
+        if self.verbose:
+            print(f"[soak] {msg}", file=sys.stderr, flush=True)
+
+    # ------------------------------------------------------------- the legs
+
+    def _feed(self, start: int, stop: int) -> int:
+        """Feed stream indices [start, stop) across the pool; returns rows."""
+        if stop <= start:
+            return 0
+        acks = self._cmd_all(
+            {"cmd": "feed", "start": start, "stop": stop, "base": self._epoch_stream_start}
+        )
+        fed = sum(a["batches"] for a in acks)
+        if fed != stop - start:
+            raise ChaosSoakError(
+                f"feed [{start}, {stop}) applied {fed} batches across the pool, "
+                f"expected {stop - start}: the strided sharding drifted."
+            )
+        self._stream_pos = stop
+        self._state_pos += stop - start
+        return sum(a["rows"] for a in acks)
+
+    def _cut(self) -> None:
+        """One coordinated cut across the pool; verifies the position."""
+        self._cmd_all({"cmd": "cut"})
+        self._cut_stream_pos = self._stream_pos
+        self._cut_state_pos = self._state_pos
+
+    def _run_leg(self, inc: Incident) -> float:
+        """Feed the incident's leg (cuts every ``cut_every``; an abrupt
+        incident's ``tail`` is fed after the last cut).  Returns rows/s."""
+        covered = inc.feed - inc.tail
+        if covered < 1:
+            raise ChaosSoakError(f"incident leg covers no batches: {inc}")
+        t0 = time.monotonic()
+        rows = 0
+        pos = self._stream_pos
+        end_covered = pos + covered
+        while pos < end_covered:
+            chunk_end = min(pos + self.schedule.cut_every, end_covered)
+            rows += self._feed(pos, chunk_end)
+            pos = chunk_end
+            self._cut()
+        if inc.tail:
+            rows += self._feed(pos, pos + inc.tail)
+        wall = max(time.monotonic() - t0, 1e-9)
+        return rows / wall
+
+    # ----------------------------------------------------------- incidents
+
+    def _induce(self, inc: Incident) -> Dict[str, Any]:
+        """Execute the failure mechanism; returns mechanism details."""
+        from tpumetrics.telemetry.export import note_incident
+
+        note_incident(
+            "chaos_incident", incident=inc.kind, epoch=self._epoch,
+            stream_pos=self._stream_pos,
+        )
+        if inc.abrupt:
+            victim = self._workers[inc.target_rank]
+            victim_pid = victim.proc.pid
+            os.kill(victim_pid, signal.SIGKILL)
+            victim.proc.wait()
+            # slice teardown: the surviving ranks go away without a cut,
+            # exactly as a reclaimed fleet does
+            for w in self._workers:
+                if w is victim:
+                    continue
+                try:
+                    w.send({"cmd": "abort"})
+                except ChaosSoakError:
+                    pass
+            self._teardown()
+            details: Dict[str, Any] = {"mechanism": "sigkill", "victim": inc.target_rank}
+            if inc.lose_member:
+                removed = self._destroy_newest_member(inc.target_rank)
+                details["destroyed_member"] = removed
+            # rollback: everything after the last cut is gone; the tail
+            # will be re-fed by the next epoch (exactly-once via restore)
+            self._stream_pos = self._cut_stream_pos
+            self._state_pos = self._cut_state_pos
+            if inc.lose_member:
+                # the victim's member of the newest cut is gone too: its leg
+                # batches (strided assignment within this epoch) are lost for
+                # good, and the quorum-degraded restore must adopt EXACTLY
+                # the remainder — the expected value stays exact
+                victim_leg = [
+                    i for i in range(self._epoch_stream_start, self._cut_stream_pos)
+                    if (i - self._epoch_stream_start) % self._world_now == inc.target_rank
+                ]
+                self._lost.update(victim_leg)
+                self._state_pos -= len(victim_leg)
+                self._cut_state_pos -= len(victim_leg)
+                details["lost_batches"] = len(victim_leg)
+            return details
+        # graceful: SIGTERM the whole pool, collect typed drained statuses
+        for w in self._workers:
+            try:
+                os.kill(w.proc.pid, signal.SIGTERM)
+            except OSError:
+                pass
+        drained = []
+        for w in self._workers:
+            msg = w.recv_until("event", "drained", timeout=_CMD_TIMEOUT)
+            drained.append(msg)
+            w.proc.wait()
+        self._teardown(kill=False)
+        for msg in drained:
+            if msg.get("flight") is None or not os.path.isfile(str(msg.get("flight"))):
+                raise ChaosSoakError(
+                    f"rank {msg.get('rank')}: graceful drain left no flight dump."
+                )
+        # a polite preemption loses nothing: the final coordinated cut
+        # covers every batch fed so far
+        self._cut_stream_pos = self._stream_pos
+        self._cut_state_pos = self._state_pos
+        return {
+            "mechanism": "sigterm",
+            "drain_s_max": max(d.get("drain_s", 0.0) for d in drained),
+            "drain_flights": [d.get("flight") for d in drained],
+        }
+
+    @property
+    def _world_now(self) -> int:
+        return self.schedule.worlds[self._epoch]
+
+    def _destroy_newest_member(self, rank: int) -> Optional[str]:
+        """The killed-with-its-disk failure mode: remove the victim rank's
+        newest snapshot file (its member of the newest cut)."""
+        from tpumetrics.runtime.snapshot import list_snapshots
+
+        directory = os.path.join(self.root, "snapshots", f"rank-{rank:05d}")
+        snaps = list_snapshots(directory)
+        if not snaps:
+            return None
+        _, path = snaps[-1]
+        os.unlink(path)
+        return path
+
+    # ---------------------------------------------------------- verification
+
+    def _committed(self) -> List[int]:
+        return [i for i in range(self._cut_stream_pos) if i not in self._lost]
+
+    def _verify_fold(self, quorum_min_ranks: Optional[int]) -> Dict[str, Any]:
+        """Supervisor-side gate 1: fold the newest restorable cut in-process
+        and compare bit-identically to the oracle over the committed
+        prefix."""
+        from tpumetrics.resilience.elastic import QuorumPolicy, load_latest_cut
+
+        sched = self.schedule
+        proto = make_metric(sched.num_classes)
+        cut = load_latest_cut(
+            os.path.join(self.root, "snapshots"),
+            template=proto.init_state(),
+            quorum=QuorumPolicy(min_ranks=quorum_min_ranks) if quorum_min_ranks else None,
+            mode="bucketed",
+        )
+        if cut is None:
+            raise ChaosSoakError("verification found no elastic cut at all")
+        folded = proto.fold_state_dicts([cut.payloads[r] for r in sorted(cut.payloads)])
+        got = {
+            k: np.asarray(v) for k, v in proto.functional_compute(folded).items()
+        }
+        want = oracle_value(
+            sched.traffic_seed, self._committed(),
+            num_classes=sched.num_classes, max_rows=sched.max_rows,
+        )
+        if not values_equal(got, want):
+            raise ChaosSoakError(
+                f"recovered compute() diverged from the uninterrupted oracle at "
+                f"cut step {cut.step}: got {got}, want {want} "
+                f"(committed={len(self._committed())}, lost={len(self._lost)})."
+            )
+        return {
+            "cut_step": cut.step,
+            "cut_world": cut.world_size,
+            "degraded": cut.degraded,
+            "value": {k: v.tolist() for k, v in got.items()},
+        }
+
+    def _ledger_events(self, epoch: int, kind: str) -> int:
+        tel_dir = os.path.join(self.root, "telemetry")
+        count = 0
+        if not os.path.isdir(tel_dir):
+            return 0
+        prefix = f"epoch{epoch:03d}-"
+        for name in os.listdir(tel_dir):
+            if not name.startswith(prefix):
+                continue
+            with open(os.path.join(tel_dir, name)) as fh:
+                for line in fh:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if rec.get("kind") == kind:
+                        count += 1
+        return count
+
+    def _recover(self, inc: Incident) -> Dict[str, Any]:
+        """Spawn the post-incident world, restore every rank, assert the
+        exactly-once/latency/telemetry gates."""
+        sched = self.schedule
+        quorum = 1 if inc.lose_member else None
+        self._epoch += 1
+        t0 = time.monotonic()
+        self._spawn(inc.world_after)
+        acks = self._cmd_all({"cmd": "restore", "quorum_min_ranks": quorum})
+        restore_wall = time.monotonic() - t0
+        infos = [a["restore"] for a in acks]
+        if any(info is None for info in infos):
+            raise ChaosSoakError("a restoring rank found no cut to adopt")
+        positions = {int(info["batches"]) for info in infos}
+        if positions != {self._cut_state_pos}:
+            raise ChaosSoakError(
+                f"exactly-once violated: restoring ranks adopted positions "
+                f"{sorted(positions)}, expected {{{self._cut_state_pos}}} — the fold "
+                "double-counted or skipped part of the stream."
+            )
+        # the degraded flag round-trips via snapshot meta BY DESIGN: once a
+        # quorum-degraded restore happened, every later restore stays marked
+        expect_degraded = bool(inc.lose_member) or self._degraded_sticky
+        degraded = {bool(info["degraded"]) for info in infos}
+        if degraded != {expect_degraded}:
+            raise ChaosSoakError(
+                f"degraded flags {degraded} do not match the schedule "
+                f"(lose_member={inc.lose_member}, sticky={self._degraded_sticky})."
+            )
+        if inc.lose_member:
+            self._degraded_sticky = True
+        max_restore_call_s = max(float(a["wall_s"]) for a in acks)
+        if max_restore_call_s > sched.restore_ceiling_s:
+            raise ChaosSoakError(
+                f"restore latency {max_restore_call_s:.2f}s exceeds the declared "
+                f"ceiling {sched.restore_ceiling_s}s."
+            )
+        # telemetry continuity: one elastic_restore per restoring rank; the
+        # degraded event exactly when scheduled
+        n_restore = self._ledger_events(self._epoch, "elastic_restore")
+        if n_restore != inc.world_after:
+            raise ChaosSoakError(
+                f"ledger continuity: {n_restore} elastic_restore event(s) for epoch "
+                f"{self._epoch}, expected {inc.world_after}."
+            )
+        n_degraded = self._ledger_events(self._epoch, "elastic_degraded")
+        if bool(n_degraded) != bool(inc.lose_member):
+            raise ChaosSoakError(
+                f"ledger continuity: {n_degraded} elastic_degraded event(s) for epoch "
+                f"{self._epoch}, schedule expected degraded={inc.lose_member}."
+            )
+        self._restore_walls.append(max_restore_call_s)
+        # the new epoch's bases: feed resumes at the cut's stream position
+        self._state_pos = self._cut_state_pos
+        self._epoch_state_base = self._cut_state_pos
+        self._stream_pos = self._cut_stream_pos
+        self._epoch_stream_start = self._cut_stream_pos
+        return {
+            "adopted": self._cut_state_pos,
+            "degraded": expect_degraded,
+            "restore_wall_s": restore_wall,
+            "restore_call_s_max": max_restore_call_s,
+            "restore_ms_evaluator_max": max(
+                float(info.get("restore_ms", 0.0)) for info in infos
+            ),
+            "ledger_restore_events": n_restore,
+            "ledger_degraded_events": n_degraded,
+        }
+
+    # ------------------------------------------------------------------ run
+
+    def run(self) -> Dict[str, Any]:
+        """Execute the whole schedule; returns the soak report dict."""
+        from tpumetrics.telemetry.export import (
+            disable_flight_recorder,
+            enable_flight_recorder,
+            flight_dump,
+            flight_recorder,
+        )
+
+        sched = self.schedule
+        prior = flight_recorder()
+        enable_flight_recorder(os.path.join(self.root, "flight"))
+        incidents_out: List[Dict[str, Any]] = []
+        unrecovered = 0
+        final: Dict[str, Any] = {}
+        try:
+            self._spawn(sched.world)
+            for idx, inc in enumerate(sched.incidents):
+                record: Dict[str, Any] = {
+                    "index": idx,
+                    "kind": inc.kind,
+                    "world_before": sched.worlds[idx],
+                    "world_after": inc.world_after,
+                    "abrupt": inc.abrupt,
+                    "lose_member": inc.lose_member,
+                    "feed": inc.feed,
+                    "tail": inc.tail,
+                }
+                try:
+                    throughput = self._run_leg(inc)
+                    record["throughput_rows_per_s"] = round(throughput, 1)
+                    self._throughputs.append(throughput)
+                    record["stream_pos"] = self._stream_pos
+                    record.update(self._induce(inc))
+                    record.update(self._recover(inc))
+                    record["verify"] = self._verify_fold(1 if inc.lose_member else None)
+                    record["flight_dump"] = flight_dump(
+                        f"incident-{idx}-{inc.kind}", epoch=self._epoch, index=idx
+                    )
+                    record["ok"] = True
+                    self._log(
+                        f"incident {idx} ({inc.kind}) recovered: pos={self._state_pos} "
+                        f"world={inc.world_after}"
+                    )
+                except ChaosSoakError as err:
+                    record["ok"] = False
+                    record["error"] = str(err)
+                    record["flight_dump"] = flight_dump(
+                        f"incident-{idx}-{inc.kind}-FAILED", epoch=self._epoch, index=idx
+                    )
+                    unrecovered += 1
+                    incidents_out.append(record)
+                    self._teardown()
+                    break
+                incidents_out.append(record)
+            else:
+                # the final pool drains gracefully: one last zero-loss gate
+                final_inc = Incident(
+                    kind="sigterm", feed=1, world_after=sched.worlds[-1]
+                )
+                self._feed(self._stream_pos, self._stream_pos + 1)
+                final.update(self._induce(final_inc))
+                final["verify"] = self._verify_fold(None)
+                final["ok"] = True
+        except Exception as err:
+            unrecovered += 1
+            final = {"ok": False, "error": f"{type(err).__name__}: {err}"}
+            self._teardown()
+        finally:
+            self._teardown()
+            if prior is None:
+                disable_flight_recorder()
+            else:
+                enable_flight_recorder(prior.directory, prior.capacity)
+
+        walls = sorted(self._restore_walls)
+
+        def _pct(p: float) -> Optional[float]:
+            if not walls:
+                return None
+            return walls[min(len(walls) - 1, int(round(p * (len(walls) - 1))))]
+
+        return {
+            "seed": sched.seed,
+            "worlds": list(sched.worlds),
+            "incidents": incidents_out,
+            "n_incidents": len(sched.incidents),
+            "completed": len([r for r in incidents_out if r.get("ok")]),
+            "unrecovered": unrecovered,
+            "stream_batches": self._stream_pos,
+            "lost_batches": len(self._lost),
+            "restore_latency_s": {
+                "p50": _pct(0.50), "p99": _pct(0.99),
+                "max": walls[-1] if walls else None, "count": len(walls),
+            },
+            "throughput_rows_per_s": {
+                "mean": (
+                    round(sum(self._throughputs) / len(self._throughputs), 1)
+                    if self._throughputs else None
+                ),
+                "min": round(min(self._throughputs), 1) if self._throughputs else None,
+            },
+            "final": final,
+        }
+
+
+def run_soak(
+    schedule: ChaosSchedule,
+    root: str,
+    *,
+    out_jsonl: Optional[str] = None,
+    verbose: bool = False,
+) -> Dict[str, Any]:
+    """Execute ``schedule`` under a :class:`SoakSupervisor` rooted at
+    ``root``; optionally stream the incident report to ``out_jsonl`` (one
+    line per incident, a ``summary`` line last).  Returns the report."""
+    report = SoakSupervisor(schedule, root, verbose=verbose).run()
+    if out_jsonl:
+        with open(out_jsonl, "w") as fh:
+            for rec in report["incidents"]:
+                fh.write(json.dumps({"type": "incident", **rec}, sort_keys=True) + "\n")
+            summary = {k: v for k, v in report.items() if k != "incidents"}
+            fh.write(json.dumps({"type": "summary", **summary}, sort_keys=True) + "\n")
+    return report

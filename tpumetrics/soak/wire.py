@@ -1,0 +1,143 @@
+"""A host-object barrier channel over a shared directory.
+
+The coordinated snapshot cut (:func:`tpumetrics.resilience.elastic.
+snapshot_barrier`) needs exactly one wire primitive: ``all_gather_object``
+of a small JSON-able stamp across every rank.  On a real fleet that rides
+the DCN backend; on boxes whose jaxlib cannot run cross-process collectives
+(the common CPU container), the chaos soak still needs REAL process
+boundaries — so this backend implements the object gather over the one
+transport every pool shares anyway: the snapshot filesystem.
+
+Protocol: the barrier's ``n``-th invocation on every rank writes its stamp
+atomically (temp + rename) to ``<dir>/round-<n>/stamp-<rank>.json``, then
+polls until all ``world`` stamps exist and returns them in rank order.
+Rounds are aligned by construction — every rank performs the same sequence
+of coordinated cuts (the supervisor commands them in lockstep), and each
+epoch gets a fresh wire directory, so round ``n`` on one rank can only ever
+meet round ``n`` on a peer.
+
+Failure semantics match the DCN wire: a rank that died before writing its
+stamp stalls the poll until the deadline, which surfaces through the active
+:class:`~tpumetrics.resilience.policy.SyncPolicy` as a typed timeout/
+failure (the barrier runs under :func:`~tpumetrics.resilience.policy.
+run_guarded`); the internal ``timeout`` here is a backstop for unguarded
+use.  Stamps are single-use files: nothing is ever overwritten, so a
+late-arriving reader can never observe a torn payload (rename is atomic).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Any, List, Optional
+
+from tpumetrics.parallel.backend import DistributedBackend
+
+__all__ = ["BarrierWireError", "FileBarrierBackend"]
+
+
+class BarrierWireError(RuntimeError):
+    """The file-wire barrier could not complete (deadline, unreadable stamp).
+
+    Deliberately NOT a ``TPUMetricsUserError``: :func:`~tpumetrics.
+    resilience.policy.run_guarded` treats user errors as deterministic
+    (never retried) — a missing peer stamp is the transient/dead-peer
+    class, the same classification a dropped DCN collective gets."""
+
+
+class FileBarrierBackend(DistributedBackend):
+    """``all_gather_object`` over a shared directory (module docstring).
+
+    Args:
+        directory: the wire directory, shared by every rank of the pool
+            (one per epoch — a restored world must start a fresh round
+            sequence).
+        rank / world_size: this process's identity in the pool.
+        timeout: internal poll deadline in seconds (backstop; the real
+            deadline is the ambient :class:`SyncPolicy`).
+        poll_interval: sleep between directory polls.
+    """
+
+    has_object_channel = True
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        rank: int,
+        world_size: int,
+        timeout: float = 120.0,
+        poll_interval: float = 0.005,
+    ) -> None:
+        if world_size < 1:
+            raise ValueError(f"world_size must be >= 1, got {world_size}")
+        if not (0 <= int(rank) < int(world_size)):
+            raise ValueError(f"rank must be in [0, {world_size}), got {rank}")
+        self.directory = directory
+        self._rank = int(rank)
+        self._world = int(world_size)
+        self._timeout = float(timeout)
+        self._poll = float(poll_interval)
+        self._round = 0
+
+    # ------------------------------------------------------------- identity
+
+    def available(self) -> bool:
+        return True
+
+    def world_size(self) -> int:
+        return self._world
+
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def rounds_completed(self) -> int:
+        return self._round
+
+    # ---------------------------------------------------------------- wire
+
+    def all_gather_object(self, obj: Any, group: Optional[Any] = None) -> List[Any]:
+        n = self._round
+        self._round += 1
+        rdir = os.path.join(self.directory, f"round-{n:06d}")
+        os.makedirs(rdir, exist_ok=True)
+        mine = os.path.join(rdir, f"stamp-{self._rank:05d}.json")
+        fd, tmp = tempfile.mkstemp(prefix=".stamp-", suffix=".tmp", dir=rdir)
+        try:
+            with os.fdopen(fd, "w") as fh:
+                json.dump(obj, fh)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, mine)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+        paths = [os.path.join(rdir, f"stamp-{r:05d}.json") for r in range(self._world)]
+        deadline = time.monotonic() + self._timeout
+        while True:
+            missing = [r for r, p in enumerate(paths) if not os.path.exists(p)]
+            if not missing:
+                break
+            if time.monotonic() > deadline:
+                raise BarrierWireError(
+                    f"File-wire barrier round {n} timed out after {self._timeout}s: "
+                    f"rank(s) {missing} never wrote a stamp under {rdir!r} — dead, "
+                    "preempted, or not running the same barrier sequence."
+                )
+            time.sleep(self._poll)
+        out: List[Any] = []
+        for r, path in enumerate(paths):
+            try:
+                with open(path) as fh:
+                    out.append(json.load(fh))
+            except (OSError, json.JSONDecodeError) as err:
+                raise BarrierWireError(
+                    f"File-wire barrier round {n}: rank {r}'s stamp at {path!r} is "
+                    f"unreadable ({err})."
+                ) from err
+        return out

@@ -1,0 +1,205 @@
+"""One soak rank: a subprocess driving a StreamingEvaluator under chaos.
+
+Launched by the :mod:`~tpumetrics.soak.supervisor` (one process per rank,
+every epoch), speaking a JSON-lines command protocol on stdin/stdout:
+
+- ``{"cmd": "restore"}`` — adopt the newest consistent cut for THIS world
+  via :meth:`~tpumetrics.runtime.evaluator.StreamingEvaluator.
+  restore_elastic` (optionally quorum-degraded); replies with the adopted
+  position and restore latency.
+- ``{"cmd": "feed", "start": s, "stop": e, "base": b}`` — submit every
+  stream index ``i`` in ``[s, e)`` with ``(i - b) % world == rank`` (the
+  strided sharding the supervisor's oracle mirrors), flush, ack with the
+  row count.
+- ``{"cmd": "cut"}`` — one coordinated snapshot cut (barrier over the
+  file wire; the supervisor issues this to every rank concurrently).
+- ``{"cmd": "stats"}`` / ``{"cmd": "ping"}`` — observability/liveness.
+- ``{"cmd": "abort"}`` — immediate ``os._exit`` (the supervisor tears the
+  slice down after a SIGKILL incident, as a preempted fleet would).
+- ``{"cmd": "exit"}`` — clean close (drain queue, no final cut) and exit.
+
+SIGTERM is the *graceful preemption notice*: the installed
+:func:`~tpumetrics.runtime.drain.install_preemption_handler` (raise mode)
+interrupts the command loop, the evaluator drains — intake off, queue
+applied, ONE final coordinated cut (every rank received the same notice, so
+the cut barrier completes) — a flight-recorder dump is written, and the
+process exits 0 with a typed ``{"event": "drained", ...}`` status line.
+In-flight batches are never lost by a polite preemption; the supervisor
+asserts exactly that.
+
+Telemetry continuity: the global collective ledger streams to a per-rank
+JSONL sink under ``<root>/telemetry/`` (the supervisor checks
+``elastic_restore``/``elastic_degraded`` events against the schedule) and a
+flight recorder rides ``<root>/flight/``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _println(obj: dict) -> None:
+    sys.stdout.write(json.dumps(obj, sort_keys=True, default=repr) + "\n")
+    sys.stdout.flush()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpumetrics.soak.worker")
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--epoch", type=int, required=True)
+    ap.add_argument("--root", required=True, help="shared soak root directory")
+    ap.add_argument("--traffic-seed", type=int, default=1)
+    ap.add_argument("--num-classes", type=int, default=5)
+    ap.add_argument("--max-rows", type=int, default=8)
+    ap.add_argument("--keep-cuts", type=int, default=3)
+    ap.add_argument("--barrier-timeout", type=float, default=90.0)
+    args = ap.parse_args(argv)
+
+    # heavy imports AFTER arg parsing (a bad invocation fails fast)
+    import jax.numpy as jnp  # noqa: F401  (forces backend init before traffic)
+
+    from tpumetrics import telemetry
+    from tpumetrics.resilience import QuorumPolicy, SyncPolicy, set_sync_policy
+    from tpumetrics.runtime import StreamingEvaluator, install_preemption_handler
+    from tpumetrics.runtime.drain import PreemptionInterrupt
+    from tpumetrics.soak.traffic import make_batch, make_metric
+    from tpumetrics.soak.wire import FileBarrierBackend
+    from tpumetrics.telemetry.export import enable_flight_recorder, flight_dump
+    from tpumetrics.telemetry.sinks import JsonlSink
+
+    rank, world, epoch = args.rank, args.world, args.epoch
+    os.makedirs(os.path.join(args.root, "telemetry"), exist_ok=True)
+    sink = JsonlSink(
+        os.path.join(args.root, "telemetry", f"epoch{epoch:03d}-rank{rank:05d}.jsonl")
+    )
+    telemetry.get_ledger().add_sink(sink)
+    telemetry.enable()  # the global ledger records only while enabled
+    enable_flight_recorder(os.path.join(args.root, "flight"))
+
+    # the cut barrier's deadline: the file wire's own poll backstop sits just
+    # under the SyncPolicy watchdog so a dead peer surfaces as the wire's
+    # named-rank error rather than a bare watchdog timeout
+    set_sync_policy(SyncPolicy(timeout=args.barrier_timeout))
+    backend = FileBarrierBackend(
+        os.path.join(args.root, "wire", f"epoch-{epoch:03d}"),
+        rank=rank, world_size=world, timeout=max(1.0, args.barrier_timeout - 5.0),
+    )
+    ev = StreamingEvaluator(
+        make_metric(args.num_classes),
+        buckets=int(args.max_rows),
+        snapshot_dir=os.path.join(args.root, "snapshots"),
+        snapshot_rank=rank,
+        snapshot_world_size=world,
+        barrier_backend=backend,
+        keep_cuts=args.keep_cuts,
+    )
+    guard = install_preemption_handler(ev, mode="raise", final_cut=True)
+
+    def _drain_and_exit(signum) -> int:
+        t0 = time.perf_counter()
+        reports = guard.drain_now()
+        flight = flight_dump("preemption_drain", rank=rank, epoch=epoch)
+        _println(
+            {
+                "event": "drained",
+                "rank": rank,
+                "signum": signum,
+                "drain_s": time.perf_counter() - t0,
+                "report": reports[0].to_dict(),
+                "flight": flight,
+            }
+        )
+        sink.flush()
+        return 0
+
+    def handle(cmd: dict) -> dict:
+        name = cmd["cmd"]
+        if name == "ping":
+            return {"ok": True, "cmd": "ping", "rank": rank}
+        if name == "restore":
+            q = cmd.get("quorum_min_ranks")
+            t0 = time.perf_counter()
+            info = ev.restore_elastic(
+                quorum=QuorumPolicy(min_ranks=int(q)) if q else None
+            )
+            wall = time.perf_counter() - t0
+            return {"ok": True, "cmd": "restore", "restore": info, "wall_s": wall}
+        if name == "feed":
+            start, stop, base = int(cmd["start"]), int(cmd["stop"]), int(cmd["base"])
+            rows = batches = 0
+            for i in range(start, stop):
+                if (i - base) % world != rank:
+                    continue
+                preds, target = make_batch(
+                    args.traffic_seed, i,
+                    num_classes=args.num_classes, max_rows=args.max_rows,
+                )
+                ev.submit(jnp.asarray(preds), jnp.asarray(target))
+                rows += preds.shape[0]
+                batches += 1
+            ev.flush()
+            return {"ok": True, "cmd": "feed", "batches": batches, "rows": rows}
+        if name == "cut":
+            path = ev.snapshot()
+            return {
+                "ok": True, "cmd": "cut", "path": path,
+                "batches": ev.stats()["batches"],
+            }
+        if name == "stats":
+            s = ev.stats()
+            return {
+                "ok": True, "cmd": "stats",
+                "batches": s["batches"], "items": s["items"],
+                "degraded": s["degraded"], "crashes": s["crashes"],
+            }
+        raise ValueError(f"unknown command {name!r}")
+
+    _println({"event": "ready", "rank": rank, "world": world, "epoch": epoch, "pid": os.getpid()})
+    try:
+        while True:
+            try:
+                line = sys.stdin.readline()
+            except PreemptionInterrupt as notice:
+                return _drain_and_exit(notice.signum)
+            if not line:  # EOF: the supervisor is gone — exit quietly
+                ev.close(drain=False)
+                return 0
+            line = line.strip()
+            if not line:
+                continue
+            cmd = json.loads(line)
+            if cmd.get("cmd") == "abort":
+                # slice teardown after a peer's SIGKILL: no drain, no cut
+                _println({"event": "aborted", "rank": rank})
+                sys.stdout.flush()
+                os._exit(3)
+            if cmd.get("cmd") == "exit":
+                ev.close(drain=True)
+                _println({"ok": True, "cmd": "exit"})
+                sink.flush()
+                return 0
+            try:
+                resp = handle(cmd)
+            except PreemptionInterrupt as notice:
+                return _drain_and_exit(notice.signum)
+            except Exception as err:  # surface to the supervisor, typed
+                resp = {
+                    "ok": False, "cmd": cmd.get("cmd"),
+                    "error": f"{type(err).__name__}: {err}",
+                }
+            # flush BEFORE the ack: the supervisor reads ledger continuity
+            # the moment every ack arrives, so the ack must imply the events
+            # are on disk
+            sink.flush()
+            _println(resp)
+    except PreemptionInterrupt as notice:
+        return _drain_and_exit(notice.signum)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,8 @@ XLA_COMPILE_SECONDS = "tpumetrics_xla_compile_seconds"
 RECOMPILES_TOTAL = "tpumetrics_recompiles_total"
 DRIFT_SCORE = "tpumetrics_drift_score"
 DRIFT_ALERTS = "tpumetrics_drift_alerts_total"
+RESTORE_LATENCY_MS = "tpumetrics_restore_latency_ms"
+DRAIN_LATENCY_MS = "tpumetrics_drain_latency_ms"
 
 
 def enabled() -> bool:
