@@ -561,7 +561,13 @@ def _bench_map():
     """MeanAveragePrecision update+compute (ragged-state path). Reference:
     the mounted reference's pure-torch ``_mean_ap`` on the same corpus (its
     pycocotools backend cannot run offline; ``_mean_ap`` is the reference's
-    own all-torch implementation)."""
+    own all-torch implementation).
+
+    The hot path under test is the JITTED dense-cell matcher
+    (``detection/_coco_eval_jax``): ONE compiled XLA program for greedy
+    matching + PR accumulation, compiled once per bucket shape.  In-scenario
+    parity gate: the jitted result must be BIT-identical to the per-cell
+    numpy reference path (``coco_evaluate_unfused``) on this exact corpus."""
     import jax.numpy as jnp
 
     from tpumetrics.detection import MeanAveragePrecision
@@ -572,22 +578,41 @@ def _bench_map():
     steps = 5
 
     m = MeanAveragePrecision()
-    m.update(preds, target)  # warmup (traces IoU kernels)
+    m.update(preds, target)  # warmup (traces IoU kernels + the matcher program)
     fused_vals = m.compute()
 
-    # correctness gate: the batched matcher must reproduce the per-cell
+    # correctness gate: the jitted matcher must reproduce the per-cell
     # reference path bit-identically on this exact corpus
     from unittest import mock
 
-    from tpumetrics.detection import _coco_eval, mean_ap as _mean_ap_mod
+    from tpumetrics.detection import _coco_eval, _coco_eval_jax, mean_ap as _mean_ap_mod
 
+    assert _coco_eval_jax._LAST_CALL is not None, (
+        "the jitted matcher did not engage on the bench corpus — the scenario "
+        "would silently time the numpy fallback"
+    )
     m._computed = None  # drop the cached result or the mocked compute is a no-op
-    with mock.patch.object(_mean_ap_mod, "coco_evaluate", _coco_eval.coco_evaluate_unfused):
+    with mock.patch.object(_coco_eval_jax, "_ENABLED", False), mock.patch.object(
+        _mean_ap_mod, "coco_evaluate", _coco_eval.coco_evaluate_unfused
+    ):
         unfused_vals = m.compute()
     for key, val in fused_vals.items():
         ref_val = unfused_vals[key]
         assert np.array_equal(np.asarray(val), np.asarray(ref_val)), (
-            f"batched mAP != per-cell reference for {key}: {val} vs {ref_val}"
+            f"jitted mAP != per-cell reference for {key}: {val} vs {ref_val}"
+        )
+
+    # device-resident state gate: the packed dense update path (flat row
+    # buffers + segment ids) must land on the SAME bits as the list path
+    from tpumetrics.detection import pack_detection_batch
+
+    mp = MeanAveragePrecision()
+    pd, td = pack_detection_batch(preds_np, target_np)
+    mp.update({k: jnp.asarray(v) for k, v in pd.items()}, {k: jnp.asarray(v) for k, v in td.items()})
+    packed_vals = mp.compute()
+    for key, val in fused_vals.items():
+        assert np.array_equal(np.asarray(val), np.asarray(packed_vals[key])), (
+            f"packed mAP != list-state mAP for {key}"
         )
 
     def ours_once():
@@ -625,9 +650,13 @@ def _bench_map():
         ref_once = None
 
     ours, ref = _interleaved(ours_once, ref_once, rounds=2)
-    # analytic: the arithmetic is one IoU matrix per image (~16 flops/pair)
-    # plus threshold matching — deliberately tiny, to make the point that mAP
-    # cost is the ragged protocol (sort/match/accumulate), not FLOPs
+    # real compiled flops from the matcher program's XLA cost analysis (one
+    # program execution per compute == per step), so achieved_gflops/mfu stop
+    # reading as vacuously zero; the analytic IoU count stays as fallback for
+    # a corpus the jitted path declines
+    cost = _coco_eval_jax.last_cost_analysis()
+    if cost and cost.get("flops", 0) > 0:
+        return ours, ref, {"flops_per_step": float(cost["flops"]), "flops_source": "cost_analysis"}
     pair_flops = 16 * sum(len(p["scores"]) * len(t["labels"]) for p, t in zip(preds_np, target_np))
     return ours, ref, {"flops_per_step": float(pair_flops), "flops_source": "analytic-iou"}
 

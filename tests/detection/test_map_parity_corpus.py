@@ -1,0 +1,198 @@
+"""Adversarial parity corpus: the jitted dense-cell matcher and the batched
+numpy matcher vs the per-cell reference path, BIT-identical.
+
+Every case here pins a semantic the jnp port must replicate exactly —
+stable score sorts, last-wins argmax ties, crowd absorption, ignored-gt
+precedence, empty cells — against ``coco_evaluate_unfused`` (the verbatim
+pre-batching implementation).  ``np.array_equal``, never ``allclose``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tpumetrics.detection import _coco_eval, _coco_eval_jax
+from tpumetrics.detection.mean_ap import _torch_f32_linspace
+
+IOU_THRS = _torch_f32_linspace(0.5, 0.95, 10)
+REC_THRS = _torch_f32_linspace(0.0, 1.0, 101)
+MAX_DETS = [1, 10, 100]
+
+
+def _boxes(rng, n, dup=False):
+    xy = rng.uniform(0, 60, (n, 2))
+    wh = rng.uniform(2, 40, (n, 2))
+    b = np.concatenate([xy, xy + wh], 1).astype(np.float32).astype(np.float64)
+    if dup and n >= 2:
+        b[1] = b[0]  # exact duplicate box
+    return b
+
+
+def _det(rng, n, n_cls, scores=None, dup=False):
+    return (
+        _boxes(rng, n, dup=dup),
+        (rng.random(n).astype(np.float32) if scores is None else np.asarray(scores, np.float32)),
+        rng.integers(0, n_cls, n).astype(np.int64),
+    )
+
+
+def _gt(rng, n, n_cls, crowd=None, area=None):
+    return (
+        _boxes(rng, n),
+        rng.integers(0, n_cls, n).astype(np.int64),
+        (np.zeros(n, np.int64) if crowd is None else np.asarray(crowd, np.int64)),
+        (np.zeros(n, np.float64) if area is None else np.asarray(area, np.float64)),
+    )
+
+
+def _corpora():
+    rng = np.random.default_rng(7)
+    empty_det = (np.zeros((0, 4)), np.zeros(0, np.float32), np.zeros(0, np.int64))
+    empty_gt = (np.zeros((0, 4)), np.zeros(0, np.int64), np.zeros(0, np.int64), np.zeros(0))
+
+    yield "empty_images", [empty_det, empty_det], [empty_gt, empty_gt]
+
+    # images with detections but no gts, and gts but no dets
+    yield (
+        "no_dets_no_gts",
+        [empty_det, _det(rng, 5, 2), empty_det],
+        [_gt(rng, 4, 2), empty_gt, empty_gt],
+    )
+
+    # crowd-only images: every gt is a crowd (absorbs without counting)
+    yield (
+        "crowd_only",
+        [_det(rng, 6, 2), _det(rng, 3, 2)],
+        [_gt(rng, 4, 2, crowd=[1, 1, 1, 1]), _gt(rng, 2, 2, crowd=[1, 1])],
+    )
+
+    # duplicate boxes with duplicate scores: pure tie-break territory
+    yield (
+        "duplicate_boxes",
+        [_det(rng, 4, 2, scores=[0.5, 0.5, 0.5, 0.5], dup=True)],
+        [_gt(rng, 3, 2)],
+    )
+
+    # score ties across and within images (stable-sort order is the result)
+    tie_scores = [0.75, 0.75, 0.25, 0.75]
+    yield (
+        "score_ties",
+        [_det(rng, 4, 3, scores=tie_scores), _det(rng, 4, 3, scores=tie_scores)],
+        [_gt(rng, 3, 3), _gt(rng, 3, 3)],
+    )
+
+    # a crowded cell: >32 same-class gts in one image exercises the dense
+    # (non-bitmask, gp > 32) matcher branch — realistic under max_dets=100
+    crowded_gt = _gt(rng, 40, 1, crowd=(rng.random(40) < 0.2).astype(np.int64))
+    yield (
+        "crowded_cell_gp_over_32",
+        [_det(rng, 20, 1), _det(rng, 3, 1)],
+        [crowded_gt, _gt(rng, 2, 1)],
+    )
+
+    # mixed everything, with explicit areas and some crowds
+    dets, gts = [], []
+    for _ in range(9):
+        nd, ng = int(rng.integers(0, 9)), int(rng.integers(0, 6))
+        dets.append(_det(rng, nd, 4, dup=bool(rng.integers(0, 2))))
+        gts.append(
+            _gt(
+                rng, ng, 4,
+                crowd=(rng.random(ng) < 0.3).astype(np.int64),
+                area=np.where(rng.random(ng) < 0.5, rng.uniform(1, 5000, ng), 0.0),
+            )
+        )
+    yield "mixed_adversarial", dets, gts
+
+
+def _class_ids(dets, gts):
+    labels = [d[2] for d in dets] + [g[1] for g in gts]
+    cat = np.concatenate(labels) if labels else np.zeros(0, np.int64)
+    return sorted(np.unique(cat).astype(int).tolist())
+
+
+_CORPORA = list(_corpora())
+
+
+@pytest.mark.parametrize("name,dets,gts", _CORPORA, ids=[c[0] for c in _CORPORA])
+@pytest.mark.parametrize("average", ["macro", "micro"])
+def test_all_paths_bit_identical(name, dets, gts, average):
+    class_ids = _class_ids(dets, gts)
+    want = _coco_eval.coco_evaluate_unfused(
+        dets, gts, IOU_THRS, REC_THRS, MAX_DETS, class_ids, average=average
+    )
+    batched = _coco_eval.coco_evaluate(
+        dets, gts, IOU_THRS, REC_THRS, MAX_DETS, class_ids, average=average
+    )
+    jitted = _coco_eval_jax.coco_evaluate_jit(
+        dets, gts, IOU_THRS, REC_THRS, MAX_DETS, class_ids, average=average
+    )
+    for key, val in want.items():
+        assert np.array_equal(np.asarray(val), np.asarray(batched[key])), f"numpy-batched {key}"
+    if not class_ids:
+        assert jitted is None  # nothing to evaluate: the jit path declines
+        return
+    assert jitted is not None, "jitted matcher declined an in-budget bbox corpus"
+    for key, val in want.items():
+        assert np.array_equal(np.asarray(val), np.asarray(jitted[key])), f"jitted {key}"
+
+
+def test_jit_path_declines_nonfinite_scores():
+    rng = np.random.default_rng(0)
+    dets = [_det(rng, 3, 2, scores=[0.5, np.inf, 0.25])]
+    gts = [_gt(rng, 2, 2)]
+    assert (
+        _coco_eval_jax.coco_evaluate_jit(dets, gts, IOU_THRS, REC_THRS, MAX_DETS, [0, 1])
+        is None
+    )
+
+
+def test_jit_path_declines_over_budget(monkeypatch):
+    rng = np.random.default_rng(1)
+    dets = [_det(rng, 8, 2)]
+    gts = [_gt(rng, 4, 2)]
+    monkeypatch.setattr(_coco_eval_jax, "MATCH_BUDGET", 1)
+    assert (
+        _coco_eval_jax.coco_evaluate_jit(dets, gts, IOU_THRS, REC_THRS, MAX_DETS, [0, 1])
+        is None
+    )
+
+
+def test_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("TPUMETRICS_JIT_MATCHER", "0")
+    assert not _coco_eval_jax.jit_matcher_enabled()
+    rng = np.random.default_rng(2)
+    assert (
+        _coco_eval_jax.coco_evaluate_jit(
+            [_det(rng, 3, 2)], [_gt(rng, 2, 2)], IOU_THRS, REC_THRS, MAX_DETS, [0, 1]
+        )
+        is None
+    )
+
+
+def test_metric_api_jit_vs_numpy_bit_identical():
+    """End to end through MeanAveragePrecision: the default (jitted) compute
+    equals a compute with the jit matcher disabled, bit for bit."""
+    from unittest import mock
+
+    import jax.numpy as jnp
+
+    from tpumetrics.detection import MeanAveragePrecision
+
+    rng = np.random.default_rng(3)
+    preds, target = [], []
+    for _ in range(6):
+        nd, ng = int(rng.integers(0, 7)), int(rng.integers(0, 5))
+        d = _det(rng, nd, 3)
+        g = _gt(rng, ng, 3, crowd=(rng.random(ng) < 0.3).astype(np.int64))
+        preds.append({"boxes": jnp.asarray(np.asarray(d[0], np.float32)), "scores": jnp.asarray(d[1]), "labels": jnp.asarray(d[2])})
+        target.append({"boxes": jnp.asarray(np.asarray(g[0], np.float32)), "labels": jnp.asarray(g[1]), "iscrowd": jnp.asarray(g[2])})
+    m = MeanAveragePrecision(class_metrics=True)
+    m.update(preds, target)
+    got = m.compute()
+    m._computed = None
+    with mock.patch.object(_coco_eval_jax, "_ENABLED", False):
+        want = m.compute()
+    for key in want:
+        assert np.array_equal(np.asarray(got[key]), np.asarray(want[key])), key

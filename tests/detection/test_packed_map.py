@@ -1,0 +1,382 @@
+"""Packed device-resident detection state: the dense update path end to end.
+
+Covers the ISSUE 13 acceptance surface: dense-dict updates land on the SAME
+bits as the list-of-dicts path (eager, functional-MaskedBuffer, and GSPMD
+mesh execution), the update loop is device→host-transfer-free, the packed
+state streams through a bucketed :class:`StreamingEvaluator` on the 8-device
+CPU mesh with bit-identical elastic shrink/grow restores, and the runtime's
+dict-of-ragged bucketing primitives behave like their array counterparts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tests.conftest import cpu_mesh
+from tpumetrics.detection import MeanAveragePrecision, pack_detection_batch
+from tpumetrics.parallel.fuse_update import FusedCollectionStep
+from tpumetrics.runtime.bucketing import (
+    ShapeBucketer,
+    check_bucketable,
+    leading_rows,
+    plan_bucketed_update,
+    single_chunk_signature,
+)
+from tpumetrics.runtime.evaluator import StreamingEvaluator
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+N_IMGS = 16
+DET_SLOTS = 16
+GT_SLOTS = 8
+
+
+def _corpus(seed=0, n_imgs=N_IMGS, with_crowds=True):
+    rng = np.random.default_rng(seed)
+
+    def boxes(n):
+        xy = rng.uniform(0, 80, (n, 2))
+        wh = rng.uniform(4, 20, (n, 2))
+        return np.concatenate([xy, xy + wh], 1).astype(np.float32)
+
+    preds, target = [], []
+    for i in range(n_imgs):
+        nd, ng = int(rng.integers(0, 11)), int(rng.integers(0, 7))
+        preds.append(
+            {
+                "boxes": boxes(nd),
+                "scores": rng.uniform(0.1, 1.0, nd).astype(np.float32),
+                "labels": rng.integers(0, 3, nd).astype(np.int64),
+            }
+        )
+        t = {"boxes": boxes(ng), "labels": rng.integers(0, 3, ng).astype(np.int64)}
+        if with_crowds and i % 3 == 0:
+            t["iscrowd"] = (rng.random(ng) < 0.4).astype(np.int64)
+            t["area"] = np.where(rng.random(ng) < 0.5, rng.uniform(1, 4000, ng), 0.0).astype(
+                np.float32
+            )
+        target.append(t)
+    return preds, target
+
+
+def _as_jnp(items):
+    return [{k: jnp.asarray(v) for k, v in d.items()} for d in items]
+
+
+def _list_reference(preds, target, **kwargs):
+    m = MeanAveragePrecision(**kwargs)
+    m.update(_as_jnp(preds), _as_jnp(target))
+    return m.compute()
+
+
+def _assert_same(got, want):
+    assert set(got) == set(want)
+    for key in want:
+        assert np.array_equal(np.asarray(got[key]), np.asarray(want[key])), key
+
+
+def _packed_batches(preds, target, sizes, seed=1):
+    """Split the corpus into ragged image batches, packed densely."""
+    out, pos = [], 0
+    rng = np.random.default_rng(seed)
+    while pos < len(preds):
+        b = min(int(rng.integers(*sizes)), len(preds) - pos)
+        out.append(
+            pack_detection_batch(
+                preds[pos : pos + b], target[pos : pos + b],
+                det_slots=DET_SLOTS, gt_slots=GT_SLOTS,
+            )
+        )
+        pos += b
+    return out
+
+
+# ------------------------------------------------------------- eager parity
+
+
+class TestEagerPackedParity:
+    def test_dense_equals_list_bit_identical(self):
+        preds, target = _corpus()
+        want = _list_reference(preds, target, class_metrics=True)
+        m = MeanAveragePrecision(class_metrics=True)
+        for pd, td in _packed_batches(preds, target, (3, 9)):
+            m.update({k: jnp.asarray(v) for k, v in pd.items()},
+                     {k: jnp.asarray(v) for k, v in td.items()})
+        _assert_same(m.compute(), want)
+
+    def test_mixed_list_then_dense(self):
+        preds, target = _corpus()
+        want = _list_reference(preds, target)
+        m = MeanAveragePrecision()
+        m.update(_as_jnp(preds[:7]), _as_jnp(target[:7]))
+        pd, td = pack_detection_batch(preds[7:], target[7:], det_slots=DET_SLOTS, gt_slots=GT_SLOTS)
+        m.update(pd, td)
+        _assert_same(m.compute(), want)
+
+    def test_valid_mask_drops_padded_images(self):
+        preds, target = _corpus()
+        want = _list_reference(preds, target)
+        pd, td = pack_detection_batch(preds, target, det_slots=DET_SLOTS, gt_slots=GT_SLOTS)
+        pad = lambda a: np.concatenate([a, np.repeat(a[:1], 4, axis=0)], 0)
+        valid = np.concatenate([np.ones(N_IMGS, bool), np.zeros(4, bool)])
+        m = MeanAveragePrecision()
+        m.update({k: pad(v) for k, v in pd.items()}, {k: pad(v) for k, v in td.items()},
+                 valid=jnp.asarray(valid))
+        _assert_same(m.compute(), want)
+
+    def test_packed_requires_bbox_only(self):
+        preds, target = _corpus(n_imgs=2)
+        pd, td = pack_detection_batch(preds, target)
+        m = MeanAveragePrecision(iou_type=("bbox", "segm"))
+        with pytest.raises(TPUMetricsUserError, match="bbox"):
+            m.update(pd, td)
+
+    def test_valid_rejected_for_list_layout(self):
+        preds, target = _corpus(n_imgs=2)
+        m = MeanAveragePrecision()
+        with pytest.raises(TPUMetricsUserError, match="valid"):
+            m.update(_as_jnp(preds), _as_jnp(target), valid=jnp.ones(2, bool))
+
+    def test_shape_validation(self):
+        m = MeanAveragePrecision()
+        with pytest.raises(ValueError, match="boxes"):
+            m.update({"boxes": jnp.zeros((2, 3)), "scores": jnp.zeros((2, 3)), "labels": jnp.zeros((2, 3))},
+                     {"boxes": jnp.zeros((2, 3, 4)), "labels": jnp.zeros((2, 3))})
+        with pytest.raises(ValueError, match="images"):
+            m.update({"boxes": jnp.zeros((2, 3, 4)), "scores": jnp.zeros((2, 3)), "labels": jnp.zeros((2, 3))},
+                     {"boxes": jnp.zeros((3, 3, 4)), "labels": jnp.zeros((3, 3))})
+
+    def test_list_layout_under_trace_raises_instructive(self):
+        """Submitting the list-of-dicts layout to a bucketed evaluator must
+        fail with the pack_detection_batch hint, not an opaque dtype error."""
+        from tpumetrics.runtime.dispatch import DispatcherClosedError
+
+        import contextlib
+
+        ev = StreamingEvaluator(MeanAveragePrecision(), buckets=(4, 8))
+        preds, target = _corpus(n_imgs=2)
+        try:
+            with pytest.raises((TPUMetricsUserError, DispatcherClosedError),
+                               match="pack_detection_batch"):
+                ev.submit(_as_jnp(preds), _as_jnp(target))
+                ev.flush()
+        finally:
+            with contextlib.suppress(Exception):  # the worker died on purpose
+                ev.close(drain=False)
+
+    def test_count_past_slot_budget_raises(self):
+        preds, target = _corpus(n_imgs=2)
+        pd, td = pack_detection_batch(preds, target)
+        pd["count"] = np.full(2, pd["boxes"].shape[1] + 3, np.int32)
+        with pytest.raises(ValueError, match="slots"):
+            MeanAveragePrecision().update(pd, td)
+
+    def test_pack_rejects_missing_scores(self):
+        preds, target = _corpus(n_imgs=1)
+        del preds[0]["scores"]
+        with pytest.raises(ValueError, match="scores"):
+            pack_detection_batch(preds, target)
+
+    def test_cross_rank_cat_merge_raises(self):
+        """Concatenating per-rank packed states (colliding id spaces) must
+        fail loudly at compute — including the rank-contributed-one-image
+        corner a flat nondecreasing check cannot see."""
+        preds, target = _corpus(n_imgs=4)
+        rank0 = MeanAveragePrecision()
+        pd, td = pack_detection_batch(preds[:1], target[:1])
+        rank0.update(pd, td)
+        rank1 = MeanAveragePrecision()
+        pd, td = pack_detection_batch(preds[1:], target[1:])
+        rank1.update(pd, td)
+        # what an eager cat-merge of the two ranks' states would produce
+        rank0.det_rows.extend(rank1.det_rows)
+        rank0.gt_rows.extend(rank1.gt_rows)
+        rank0.packed_imgs = rank0.packed_imgs + rank1.packed_imgs
+        with pytest.raises(TPUMetricsUserError, match="id spaces"):
+            rank0.compute()
+
+    def test_pack_rejects_labels_past_f32_exact_range(self):
+        preds = [{"boxes": np.zeros((1, 4), np.float32), "scores": np.ones(1, np.float32),
+                  "labels": np.asarray([2**24 + 1])}]
+        target = [{"boxes": np.zeros((1, 4), np.float32), "labels": np.asarray([0])}]
+        with pytest.raises(ValueError, match="2\\^24"):
+            pack_detection_batch(preds, target)
+
+    def test_tm_to_coco_guards_packed_rows(self, tmp_path):
+        preds, target = _corpus(n_imgs=2)
+        pd, td = pack_detection_batch(preds, target)
+        m = MeanAveragePrecision()
+        m.update(pd, td)
+        with pytest.raises(NotImplementedError, match="packed"):
+            m.tm_to_coco(str(tmp_path / "x"))
+
+
+# ----------------------------------------------------- functional / buffers
+
+
+class TestFunctionalPackedState:
+    def test_bucketable_native_valid(self):
+        check_bucketable(MeanAveragePrecision())  # no NotBucketableError
+
+    def test_masked_buffer_path_bit_identical(self):
+        preds, target = _corpus()
+        want = _list_reference(preds, target)
+        m = MeanAveragePrecision(det_capacity=1024, gt_capacity=1024)
+        step = FusedCollectionStep(m)
+        state = step.init_state()
+        bucketer = ShapeBucketer([4, 8])
+        for pd, td in _packed_batches(preds, target, (2, 8)):
+            _n, chunks = plan_bucketed_update(bucketer, (pd, td))
+            for _kind, padded, bucket, size, _sig in chunks:
+                state = step.masked_update(state, padded, jnp.asarray(size, jnp.int32), bucket)
+        _assert_same(m.functional_compute(state), want)
+
+    def test_buffer_overflow_raises_at_compute(self):
+        preds, target = _corpus()
+        m = MeanAveragePrecision(det_capacity=8, gt_capacity=8)
+        step = FusedCollectionStep(m)
+        state = step.init_state()
+        pd, td = pack_detection_batch(preds, target, det_slots=DET_SLOTS, gt_slots=GT_SLOTS)
+        state = step.masked_update(state, (pd, td), jnp.asarray(N_IMGS, jnp.int32), N_IMGS)
+        with pytest.raises(TPUMetricsUserError, match="overflowed"):
+            m.functional_compute(state)
+
+    def test_partition_rules_shard_packed_rows(self):
+        rules = MeanAveragePrecision().state_partition_rules(data_axis="dp")
+        patterns = rules.patterns
+        assert any("det_rows" in p and "values" in p for p in patterns)
+        assert any("gt_rows" in p and "values" in p for p in patterns)
+
+
+# -------------------------------------------------- zero host round trips
+
+
+class TestTransferGuard:
+    def test_eager_list_update_is_transfer_free(self):
+        """The paper claim as a test, list layout: update() stores device
+        arrays as-is — nothing may touch the host."""
+        preds, target = _corpus()
+        jp, jt = _as_jnp(preds), _as_jnp(target)
+        m = MeanAveragePrecision()
+        with jax.transfer_guard_device_to_host("disallow"):
+            for _ in range(3):
+                m.update(jp, jt)
+        assert float(m.compute()["map"]) >= 0
+
+    def test_mesh_packed_update_loop_is_transfer_free(self, mesh8):
+        """The paper claim as a test, packed layout on the GSPMD mesh: the
+        whole fused masked-update loop runs under the device→host guard
+        (same pattern as tests/test_sharding.py's zero-host-transfer loop)."""
+        preds, target = _corpus()
+        want = _list_reference(preds, target)
+        m = MeanAveragePrecision(det_capacity=1024, gt_capacity=1024)
+        step = FusedCollectionStep(m, mesh=mesh8)
+        batches = _packed_batches(preds, target, (4, 9))
+        state = step.init_state()
+        # compile every bucket signature outside the guard, then restart
+        bucketer = ShapeBucketer([4, 8])
+        plans = [plan_bucketed_update(bucketer, (pd, td))[1] for pd, td in batches]
+        for chunks in plans:
+            for _kind, padded, bucket, size, _sig in chunks:
+                state = step.masked_update(state, padded, jnp.asarray(size, jnp.int32), bucket)
+        state = step.init_state()
+        with jax.transfer_guard_device_to_host("disallow"):
+            for chunks in plans:
+                for _kind, padded, bucket, size, _sig in chunks:
+                    state = step.masked_update(state, padded, jnp.asarray(size, jnp.int32), bucket)
+            jax.block_until_ready(jax.tree_util.tree_leaves(state))
+        assert state["det_rows"].values.sharding.spec == jax.sharding.PartitionSpec("dp")
+        _assert_same(m.functional_compute(state), want)
+
+
+# ------------------------------------------- streaming + elastic acceptance
+
+
+class TestStreamingAndElastic:
+    def _stream(self, mesh, snapshot_dir=None):
+        return StreamingEvaluator(
+            MeanAveragePrecision(det_capacity=1024, gt_capacity=1024),
+            buckets=(4, 8), mesh=mesh,
+            **(
+                dict(snapshot_dir=snapshot_dir, snapshot_rank=0, snapshot_world_size=1)
+                if snapshot_dir else {}
+            ),
+        )
+
+    def test_bucketed_streaming_on_mesh_bit_identical(self, mesh8):
+        preds, target = _corpus()
+        want = _list_reference(preds, target)
+        ev = self._stream(mesh8)
+        for pd, td in _packed_batches(preds, target, (2, 8)):
+            ev.submit(pd, td)
+        got = ev.compute()
+        ev.close()
+        _assert_same(got, want)
+
+    @pytest.mark.parametrize("w0,w1", [(8, 4), (2, 8)], ids=["shrink_8_to_4", "grow_2_to_8"])
+    def test_elastic_resize_bit_identical(self, tmp_path, w0, w1):
+        """Kill mid-stream, restore onto a DIFFERENT mesh, finish: compute()
+        must equal the uninterrupted single-world run bit for bit."""
+        preds, target = _corpus()
+        want = _list_reference(preds, target)
+        batches = _packed_batches(preds, target, (2, 7))
+        cut = len(batches) // 2
+
+        ev = self._stream(cpu_mesh(w0, axis_name="dp"), snapshot_dir=str(tmp_path))
+        for pd, td in batches[:cut]:
+            ev.submit(pd, td)
+        ev.snapshot()
+        ev.close()
+
+        ev2 = self._stream(cpu_mesh(w1, axis_name="dp"), snapshot_dir=str(tmp_path))
+        info = ev2.restore_elastic()
+        assert info is not None and info["batches"] == cut
+        mesh1 = cpu_mesh(w1, axis_name="dp")
+        assert ev2._state["det_rows"].values.sharding.mesh.shape == mesh1.shape
+        for pd, td in batches[cut:]:
+            ev2.submit(pd, td)
+        got = ev2.compute()
+        ev2.close()
+        _assert_same(got, want)
+
+
+# ------------------------------------------------ dict bucketing primitives
+
+
+class TestDictBucketing:
+    def _dict_args(self, n=6):
+        return (
+            {"boxes": np.zeros((n, 4, 4), np.float32), "count": np.arange(n, dtype=np.int32)},
+            {"labels": np.zeros((n, 3), np.float32)},
+        )
+
+    def test_leading_rows_sees_dict_leaves(self):
+        assert leading_rows(self._dict_args(6)) == 6
+
+    def test_plan_pads_and_slices_dict_leaves(self):
+        args = self._dict_args(6)
+        n, chunks = plan_bucketed_update(ShapeBucketer([4, 8]), args)
+        assert n == 6 and len(chunks) == 1
+        kind, padded, bucket, size, sig = chunks[0]
+        assert (kind, bucket, size) == ("masked", 8, 6)
+        assert padded[0]["boxes"].shape == (8, 4, 4)
+        assert padded[1]["labels"].shape == (8, 3)
+        # pad rows are row-0 copies
+        assert np.array_equal(np.asarray(padded[0]["count"])[6:], [0, 0])
+
+    def test_single_chunk_signature_matches_plan(self):
+        args = self._dict_args(6)
+        bucketer = ShapeBucketer([4, 8])
+        probe = single_chunk_signature(bucketer, args)
+        assert probe is not None
+        bucket, n, sig = probe
+        _n, chunks = plan_bucketed_update(bucketer, args)
+        assert sig == chunks[0][4]
+
+    def test_oversized_dict_batch_splits(self):
+        args = self._dict_args(10)
+        n, chunks = plan_bucketed_update(ShapeBucketer([4]), args)
+        assert n == 10 and [c[3] for c in chunks] == [4, 4, 2]

@@ -1,0 +1,51 @@
+"""LPIPS forward is jitted ONCE per input signature (ISSUE 13 satellite).
+
+The whole update — backbone forwards for both images, the normalize/diff/
+average chain, AND the two state adds — must be one cached jit program:
+a re-trace per stream step would silently turn the one-dispatch update into
+dozens.  A Python-side counter inside the backbone callable counts TRACES
+(the callable only executes while tracing): exactly one trace means exactly
+2 invocations (the img1 and img2 forwards of that single trace), and zero
+further invocations across repeated same-shape updates.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.image import LearnedPerceptualImagePatchSimilarity
+
+
+def _counting_backbone(counter):
+    rng = np.random.default_rng(0)
+    k1 = jnp.asarray((rng.standard_normal((8, 3, 3, 3)) * 0.1).astype(np.float32))
+
+    def backbone(x):
+        counter["calls"] += 1
+        return [jax.nn.relu(jax.lax.conv_general_dilated(x, k1, (2, 2), "SAME"))]
+
+    return backbone
+
+
+def test_lpips_update_traces_once_per_signature():
+    counter = {"calls": 0}
+    m = LearnedPerceptualImagePatchSimilarity(net_type=_counting_backbone(counter))
+    rng = np.random.default_rng(1)
+    img1 = jnp.asarray(rng.uniform(-1, 1, (4, 3, 16, 16)).astype(np.float32))
+    img2 = jnp.asarray(rng.uniform(-1, 1, (4, 3, 16, 16)).astype(np.float32))
+    for _ in range(5):
+        m.update(img1, img2)
+    # one trace == two backbone invocations (img1 + img2), then cache hits
+    assert counter["calls"] == 2, f"LPIPS re-traced: {counter['calls']} backbone calls"
+    jit_loss = m._jit_loss
+    # a new shape re-specializes (one more trace), the old signature stays hot
+    img3 = jnp.asarray(rng.uniform(-1, 1, (2, 3, 16, 16)).astype(np.float32))
+    m.update(img3, img3)
+    assert counter["calls"] == 4
+    m.update(img1, img2)
+    assert counter["calls"] == 4
+    assert m._jit_loss is jit_loss  # the cached program object is stable
+    assert float(m.compute()) > 0

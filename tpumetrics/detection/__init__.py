@@ -5,8 +5,12 @@ from tpumetrics.detection.diou import DistanceIntersectionOverUnion
 from tpumetrics.detection.giou import GeneralizedIntersectionOverUnion
 from tpumetrics.detection.iou import IntersectionOverUnion
 from tpumetrics.detection.mean_ap import MeanAveragePrecision
+from tpumetrics.detection.packing import pack_detection_batch
 from tpumetrics.detection.panoptic_qualities import ModifiedPanopticQuality, PanopticQuality
 
+# NOTE: __all__ lists metric classes only (tests/detection/test_distributed
+# keys its per-class DDP coverage off it); pack_detection_batch is public
+# API but a helper, imported explicitly.
 __all__ = [
     "CompleteIntersectionOverUnion",
     "DistanceIntersectionOverUnion",

@@ -34,6 +34,13 @@ range.  The per-cell reference implementation is kept verbatim
 (:func:`_match_image_areas`, :func:`_accumulate_class_area`,
 :func:`coco_evaluate_unfused`) and the batched path is asserted
 bit-identical against it in ``tests/detection/test_coco_batched.py``.
+
+The default bbox hot path goes one layer further:
+:mod:`tpumetrics.detection._coco_eval_jax` compiles the same bucketed
+matching + accumulation into ONE jitted XLA program (bit-identical by
+construction, pinned in ``tests/detection/test_map_parity_corpus.py``).
+This module remains the oracle, the ``segm``/``extended_summary``/
+over-budget path, and the fallback when the jitted path declines.
 """
 
 from __future__ import annotations

@@ -1,0 +1,611 @@
+"""Jitted bucketed COCO matcher: the mAP hot path as ONE compiled program.
+
+:mod:`tpumetrics.detection._coco_eval` already collapsed the per-(image,
+class) greedy matching into a batched numpy pass over padded ``(cells, D,
+G)`` cell buckets.  This module pushes the same ragged→bucketed-dense trick
+one layer further down: the greedy matcher *and* the PR-curve accumulation
+run as **one jitted XLA program** over a dense ``(K, I, D, G)`` cell grid —
+pow-2 padded on every axis, so the compiled-program universe is bounded by
+the bucket edges (the :mod:`tpumetrics.runtime.bucketing` shape discipline)
+and the persistent compilation cache amortizes compiles across processes.
+
+Bit-identical parity with the numpy reference path
+(:func:`~tpumetrics.detection._coco_eval.coco_evaluate_unfused`) is a hard
+contract, engineered rather than hoped for:
+
+- all IoU/area arithmetic is **float64** (under a scoped
+  ``jax.experimental.enable_x64``) with the exact elementwise formulas of
+  the numpy path — elementwise IEEE double ops are deterministic, and the
+  parity tests pin them bitwise;
+- TP/FP cumulative sums act on 0/1 indicators, so any XLA scan
+  re-association still produces exact integers;
+- every division keeps a *runtime* divisor (XLA strength-reduces division
+  by a compile-time constant into multiply-by-reciprocal, which is NOT
+  bit-equal — ``npig`` is computed in-program from the inputs);
+- sorts are stable, so forcing pad slots to ``-inf`` score provably
+  preserves the relative order of real detections (a stable sort of a
+  superset, restricted to a subset, equals the stable sort of the subset),
+  and pad columns are TP=FP=0 no-ops that cannot move any sampled
+  precision/recall value;
+- the last-wins argmax is the same reversed-argmax trick as the numpy
+  matcher.
+
+The program runs on the default accelerator when a startup probe proves it
+computes real float64 (many accelerator stacks lack f64 or silently demote
+it, which would break the parity contract), and otherwise on the **host
+CPU XLA client**: ``compute()`` is the one place the paper contract allows
+a host sync, the inputs just arrived from the single state fetch, and the
+CPU build keeps the math exact with zero extra round trips to a
+remote-attached chip.  "Device-resident" mAP means the *state* lives on the
+accelerator until ``compute()``; the protocol itself is compiled, not
+interpreted, wherever it runs.
+
+Scope: ``bbox`` matching without ``extended_summary``; RLE ``segm`` (host
+mask decode) and the extended IoU payload stay on the numpy path, as does
+any corpus whose padded cell grid exceeds :data:`MATCH_BUDGET` (a single
+huge image would force the padding blow-up onto every cell).
+:func:`coco_evaluate_jit` returns ``None`` for those, and callers fall back
+to :func:`~tpumetrics.detection._coco_eval.coco_evaluate`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tpumetrics.detection._coco_eval import _AREA_RANGES, _summarize
+from tpumetrics.runtime.bucketing import pow2_at_least as _pow2_at_least
+
+#: padded work budget: cells * areas * thresholds * D_pad * G_pad elements
+#: touched per matching pass.  Above it the dense grid would not fit the
+#: fused program comfortably; the numpy bucketed path (which can split
+#: buckets) takes over.
+MATCH_BUDGET = 1 << 26
+
+#: flip to False (or set TPUMETRICS_JIT_MATCHER=0) to force the numpy
+#: matcher everywhere — the bench uses this to time the interpreted path
+#: and tests use it to cross-check all three implementations.
+_ENABLED = True
+
+_PROGRAMS: Dict[Tuple, Callable] = {}
+_LAST_CALL: Optional[Tuple[Callable, Tuple]] = None
+
+
+def jit_matcher_enabled() -> bool:
+    """Whether the jitted matcher is active (module flag + env override)."""
+    return _ENABLED and os.environ.get("TPUMETRICS_JIT_MATCHER", "1") != "0"
+
+
+def _cpu_device() -> Any:
+    import jax
+
+    try:
+        return jax.local_devices(backend="cpu")[0]
+    except Exception:  # no CPU client (exotic build): let callers fall back
+        return None
+
+
+_MATCHER_DEVICE: List[Any] = []  # memoized [device-or-None]
+
+
+def _matcher_device() -> Any:
+    """Where the matcher program runs: the default backend when it PROVABLY
+    computes float64 (verified by a probe whose result a float32 fallback
+    cannot produce — some accelerator stacks silently demote x64), else the
+    host CPU XLA client.  Bit-exact parity is the contract; the accelerator
+    is only an optimization when it keeps the contract."""
+    if _MATCHER_DEVICE:
+        return _MATCHER_DEVICE[0]
+    import jax
+    from jax.experimental import enable_x64
+
+    device = _cpu_device()
+    try:
+        default = jax.devices()[0]
+        if default.platform != "cpu":
+            with enable_x64():
+                eps = float(np.float64(2.0) ** -40)
+                x = jax.device_put(np.float64(1.0 + eps), default)
+                if float(jax.jit(lambda v: v - 1.0)(x)) == eps:
+                    device = default
+    except Exception:
+        pass  # unprobeable backend: stay on the host CPU client
+    _MATCHER_DEVICE.append(device)
+    return device
+
+
+def _build_program(
+    kp: int,
+    ip: int,
+    dp: int,
+    gp: int,
+    c2s: int,
+    d_trip: int,
+    iou_thrs: Tuple[float, ...],
+    rec_thrs: Tuple[float, ...],
+    max_dets: Tuple[int, ...],
+    area_ranges: Tuple[Tuple[float, float], ...],
+) -> Callable:
+    """One jitted match+accumulate program for a static cell-grid shape.
+
+    Inputs (all dense, cell grid ``C = kp * ip`` flattened on the leading
+    axis): det boxes f64 ``(C, dp, 4)`` xyxy, det scores f32 ``(C, dp)``,
+    det valid bool, gt boxes f64 ``(C, gp, 4)``, gt crowd bool, gt area f64
+    (user-provided; ``0`` falls back to geometry area in-program), gt valid
+    bool.  Returns ``(precision (kp, A, T, M, R) f64, recall (kp, A, T, M)
+    f64, npig (kp, A) i64)`` — assembled into the COCO ``(T, R, K, A, M)``
+    layout on host.
+
+    ``c2s`` is the static post-sort column budget: the caller guarantees no
+    class holds more than ``c2s`` real (capped) detections, so after the
+    stable score sort — pad slots forced to ``-inf`` — every real column
+    lives in the first ``c2s`` positions and the tail is all no-op padding,
+    which the accumulation may drop without moving any sampled value.  This
+    is what keeps the cumsum/envelope work proportional to real detections
+    instead of to the pow-2 cell-grid padding.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    num_areas = len(area_ranges)
+    num_thrs = len(iou_thrs)
+    c2 = ip * dp
+
+    def run(dbox, dscore, dvalid, gbox, gcrowd, garea, gvalid):
+        # ---- geometry (f64 elementwise, formula-identical to numpy path)
+        da = (dbox[..., 2] - dbox[..., 0]) * (dbox[..., 3] - dbox[..., 1])  # (C, dp)
+        geom_ga = (gbox[..., 2] - gbox[..., 0]) * (gbox[..., 3] - gbox[..., 1])
+        area_eff = jnp.where(garea > 0, garea, geom_ga)  # (C, gp)
+
+        lt = jnp.maximum(dbox[:, :, None, :2], gbox[:, None, :, :2])
+        rb = jnp.minimum(dbox[:, :, None, 2:], gbox[:, None, :, 2:])
+        wh = jnp.clip(rb - lt, 0, None)
+        inter = wh[..., 0] * wh[..., 1]  # (C, dp, gp)
+        union = da[:, :, None] + geom_ga[:, None, :] - inter
+        union = jnp.where(gcrowd[:, None, :], da[:, :, None], union)
+        ious = inter / jnp.where(union > 0, union, 1.0)
+        # pad pairs carry IoU -1 (below any threshold), like the numpy pad
+        ious = jnp.where(dvalid[:, :, None] & gvalid[:, None, :], ious, -1.0)
+
+        lo = jnp.asarray([r[0] for r in area_ranges], jnp.float64)
+        hi = jnp.asarray([r[1] for r in area_ranges], jnp.float64)
+        thr = jnp.minimum(jnp.asarray(iou_thrs, jnp.float64), 1 - 1e-10)  # (T,)
+
+        # (C, A, G): crowd / out-of-range / pad gts absorb without counting
+        gt_ignore = (
+            gcrowd[:, None, :]
+            | (area_eff[:, None, :] < lo[None, :, None])
+            | (area_eff[:, None, :] > hi[None, :, None])
+            | ~gvalid[:, None, :]
+        )
+        real = ~gt_ignore
+        n_cells = ious.shape[0]
+
+        det_matches0 = jnp.zeros((n_cells, num_areas, num_thrs, dp), bool)
+        det_ignore0 = jnp.zeros((n_cells, num_areas, num_thrs, dp), bool)
+
+        if gp <= 32:
+            # ---- bitmask matching: the candidate/availability sets live as
+            # ONE uint32 bitmask over the gt axis, so the loop's working set
+            # shrinks ~G-fold.  Picking the greedy winner scans the gts in
+            # descending (IoU, index) order — a flip of a stable ascending
+            # argsort — which is EXACTLY the reference's last-wins argmax:
+            # max IoU first, ties broken toward the larger index.
+            pow2 = jnp.asarray((np.uint32(1) << np.arange(gp)).astype(np.uint32))
+
+            def packbits(mask):  # (..., G) bool -> (...) uint32
+                return jnp.sum(jnp.where(mask, pow2, jnp.uint32(0)), axis=-1, dtype=jnp.uint32)
+
+            cand_thr = packbits(ious[:, :, None, :] >= thr[None, None, :, None])  # (C, dp, T)
+            perm = jnp.flip(jnp.argsort(ious, axis=2, stable=True), axis=2)
+            perm_bits = jnp.left_shift(jnp.uint32(1), perm.astype(jnp.uint32))  # (C, dp, G)
+            real_b = packbits(real)  # (C, A)
+            ign_b = packbits(gt_ignore)
+            crowd_b = packbits(gcrowd & gvalid)  # (C,)
+            avail0 = jnp.broadcast_to(
+                packbits(gvalid)[:, None, None], (n_cells, num_areas, num_thrs)
+            )
+
+            def body(d_i, carry):
+                avail, det_matches, det_ignore = carry
+                ct = jax.lax.dynamic_index_in_dim(cand_thr, d_i, axis=1, keepdims=False)
+                bitj = jax.lax.dynamic_index_in_dim(perm_bits, d_i, axis=1, keepdims=False)
+                cand = avail & ct[:, None, :]  # (C, A, T)
+                cand_real = cand & real_b[:, :, None]
+                # non-ignored gts take precedence (reference sorted-ignored-last)
+                pick = jnp.where(cand_real != 0, cand_real, cand & ign_b[:, :, None])
+                has = pick != 0
+                best = jnp.zeros((n_cells, num_areas, num_thrs), jnp.uint32)
+                found = jnp.zeros((n_cells, num_areas, num_thrs), bool)
+                for j in range(gp):  # static scan in descending (IoU, g) order
+                    bj = bitj[:, j][:, None, None]
+                    hit = (pick & bj) != 0
+                    best = jnp.where(hit & ~found, bj, best)
+                    found = found | hit
+                picked_ignored = (best & ign_b[:, :, None]) != 0
+                picked_crowd = (best & crowd_b[:, None, None]) != 0
+                det_matches = jax.lax.dynamic_update_index_in_dim(
+                    det_matches, has, d_i, axis=3
+                )
+                det_ignore = jax.lax.dynamic_update_index_in_dim(
+                    det_ignore, has & picked_ignored, d_i, axis=3
+                )
+                # crowd gts absorb without being claimed
+                avail = avail & ~jnp.where(has & ~picked_crowd, best, jnp.uint32(0))
+                return avail, det_matches, det_ignore
+
+        else:
+            g_idx = jnp.arange(gp)
+            avail0 = jnp.broadcast_to(
+                gvalid[:, None, None, :], (n_cells, num_areas, num_thrs, gp)
+            )
+
+            def body(d_i, carry):
+                avail, det_matches, det_ignore = carry
+                iou_row = jax.lax.dynamic_index_in_dim(ious, d_i, axis=1, keepdims=False)
+                cand = avail & (iou_row[:, None, None, :] >= thr[None, None, :, None])
+                cand_real = cand & real[:, :, None, :]
+                use_real = cand_real.any(axis=3)  # non-ignored gts take precedence
+                pick_from = jnp.where(
+                    use_real[..., None], cand_real, cand & gt_ignore[:, :, None, :]
+                )
+                has = pick_from.any(axis=3)  # (C, A, T)
+                vals = jnp.where(pick_from, iou_row[:, None, None, :], -1.0)
+                best_g = gp - 1 - jnp.argmax(vals[..., ::-1], axis=3)  # last-wins
+                onehot = g_idx[None, None, None, :] == best_g[..., None]  # (C, A, T, G)
+                picked_ignored = jnp.any(onehot & gt_ignore[:, :, None, :], axis=3)
+                picked_crowd = jnp.any(onehot & gcrowd[:, None, None, :], axis=3)
+                det_matches = jax.lax.dynamic_update_index_in_dim(
+                    det_matches, has, d_i, axis=3
+                )
+                det_ignore = jax.lax.dynamic_update_index_in_dim(
+                    det_ignore, has & picked_ignored, d_i, axis=3
+                )
+                claimed = has & ~picked_crowd  # crowd gts absorb without claiming
+                avail = avail & ~(onehot & claimed[..., None])
+                return avail, det_matches, det_ignore
+
+        # trip count: detection slots past every cell's true (capped) count
+        # hold IoU -1 everywhere — those iterations cannot match anything,
+        # so the loop stops at d_trip (<= dp) exactly
+        _avail, det_matches, det_ignore = jax.lax.fori_loop(
+            0, d_trip, body, (avail0, det_matches0, det_ignore0)
+        )
+
+        # unmatched detections outside the area range are ignored
+        det_out = (da[:, None, :] < lo[None, :, None]) | (da[:, None, :] > hi[None, :, None])
+        det_ignore = det_ignore | (
+            (~det_matches) & det_out[:, :, None, :] & dvalid[:, None, None, :]
+        )
+        num_gt = (~gt_ignore).sum(axis=2)  # (C, A)
+        npig = num_gt.reshape(kp, ip, num_areas).sum(axis=1)  # (kp, A)
+
+        # ---- accumulate: per class, ONE stable score sort over all columns.
+        # Pad slots get score -inf: a stable sort of the superset restricted
+        # to the real columns equals the numpy path's sort of the compacted
+        # columns, and pad columns are TP=FP=0 no-ops everywhere below.
+        scores_flat = jnp.where(dvalid, dscore, -jnp.inf).reshape(kp, c2)
+        order = jnp.argsort(-scores_flat, axis=1, stable=True)[:, :c2s]  # (kp, c2s)
+        rank_flat = jnp.broadcast_to(jnp.arange(dp)[None, :], (ip, dp)).reshape(c2)
+        rank_sorted = jnp.take_along_axis(
+            jnp.broadcast_to(rank_flat[None, :], (kp, c2)), order, axis=1
+        )  # (kp, c2s)
+        valid_sorted = jnp.take_along_axis(dvalid.reshape(kp, c2), order, axis=1)
+
+        def sort_cols(x):  # (C, A, T, dp) -> (kp, A, T, c2s) in score order
+            x = x.reshape(kp, ip, num_areas, num_thrs, dp)
+            x = jnp.transpose(x, (0, 2, 3, 1, 4)).reshape(kp, num_areas, num_thrs, c2)
+            return jnp.take_along_axis(x, order[:, None, None, :], axis=3)
+
+        m_sorted = sort_cols(det_matches)
+        i_sorted = sort_cols(det_ignore)
+        live = valid_sorted[:, None, None, :]  # (kp, 1, 1, c2s)
+        caps = jnp.stack(
+            [(rank_sorted < m)[:, None, None, :] & live for m in max_dets], axis=3
+        )  # (kp, 1, 1, M, c2s) broadcastable
+        tp = (m_sorted & ~i_sorted)[:, :, :, None, :] & caps
+        fp = (~m_sorted & ~i_sorted)[:, :, :, None, :] & caps
+        # int32 scan then cast: TP/FP counts are 0/1 sums far below 2^31, so
+        # the narrower scan is exact and halves the memory traffic of the
+        # hottest tensors
+        tp_sum = jnp.cumsum(tp.astype(jnp.int32), axis=4).astype(jnp.float64)
+        fp_sum = jnp.cumsum(fp.astype(jnp.int32), axis=4).astype(jnp.float64)
+        # npig is a traced value — the divisor must never be a compile-time
+        # constant, or XLA strength-reduces to multiply-by-reciprocal and
+        # the quotient is no longer bit-equal to the numpy division
+        npig_safe = jnp.maximum(npig, 1).astype(jnp.float64)[:, :, None, None, None]
+        rc = tp_sum / npig_safe
+        pr = tp_sum / jnp.maximum(fp_sum + tp_sum, jnp.finfo(jnp.float64).eps)
+        recall = rc[..., -1]  # (kp, A, T, M)
+        env = jnp.flip(jax.lax.cummax(jnp.flip(pr, axis=4), axis=4), axis=4)
+
+        rec_arr = jnp.asarray(rec_thrs, jnp.float64)
+        rc2 = rc.reshape(-1, c2s)
+        inds = jax.vmap(lambda r: jnp.searchsorted(r, rec_arr, side="left"))(rc2)
+        env2 = env.reshape(-1, c2s)
+        sampled = jnp.take_along_axis(env2, jnp.clip(inds, 0, c2s - 1), axis=1)
+        q = jnp.where(inds < c2s, sampled, 0.0)
+        precision = q.reshape(kp, num_areas, num_thrs, len(max_dets), len(rec_thrs))
+        return precision, recall, npig
+
+    return jax.jit(run)
+
+
+def _dense_cells(
+    boxes: np.ndarray,
+    img: np.ndarray,
+    cls_slot: np.ndarray,
+    kp: int,
+    ip: int,
+    slot_pad: int,
+    max_rows: Optional[int],
+    extra: Sequence[np.ndarray] = (),
+    order: Optional[np.ndarray] = None,
+) -> Tuple[np.ndarray, np.ndarray, List[np.ndarray], int]:
+    """Scatter flat rows into a dense ``(kp * ip, slot)`` cell grid.
+
+    ``order`` pre-orders rows (score-descending for detections; ``None``
+    keeps the stored order, which is the ground-truth convention).  Returns
+    ``(dense_boxes, valid, dense_extras, max_cell_rows)`` where ``slot`` is
+    ``slot_pad`` columns wide; rows whose within-cell rank reaches
+    ``max_rows`` (the detection cap) are dropped exactly like the numpy
+    path's ``order[:max_det]``.
+    """
+    n = boxes.shape[0]
+    cell = cls_slot.astype(np.int64) * ip + img.astype(np.int64)
+    if order is None:
+        rows = np.argsort(cell, kind="mergesort")  # stable: keeps stored order
+    else:
+        rows = order[np.argsort(cell[order], kind="mergesort")]
+    counts = np.bincount(cell, minlength=kp * ip)
+    starts = np.cumsum(counts) - counts
+    rank = np.arange(n, dtype=np.int64) - starts[cell[rows]]
+    keep = rank < (slot_pad if max_rows is None else min(slot_pad, max_rows))
+    slot = cell[rows][keep] * slot_pad + rank[keep]
+
+    dense_boxes = np.zeros((kp * ip, slot_pad, 4), np.float64)
+    dense_boxes.reshape(-1, 4)[slot] = boxes[rows][keep]
+    valid = np.zeros((kp * ip, slot_pad), bool)
+    valid.reshape(-1)[slot] = True
+    outs = []
+    for arr in extra:
+        dense = np.zeros((kp * ip, slot_pad), arr.dtype)
+        dense.reshape(-1)[slot] = arr[rows][keep]
+        outs.append(dense)
+    max_cell = int(counts.max()) if n else 0
+    return dense_boxes, valid, outs, max_cell
+
+
+def coco_evaluate_jit(
+    detections: Sequence[Tuple[np.ndarray, np.ndarray, np.ndarray]],
+    groundtruths: Sequence[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]],
+    iou_thresholds: Sequence[float],
+    rec_thresholds: Sequence[float],
+    max_detection_thresholds: Sequence[int],
+    class_ids: Sequence[int],
+    average: str = "macro",
+    iou_type: str = "bbox",
+    extended: bool = False,
+) -> Optional[Dict[str, np.ndarray]]:
+    """Full COCO evaluation through the jitted dense-cell program.
+
+    Same contract as :func:`~tpumetrics.detection._coco_eval.coco_evaluate`
+    (``detections`` per image ``(xyxy f64 geometry, scores, labels)``,
+    ``groundtruths`` ``(geometry, labels, iscrowd, area)``); ``class_ids``
+    must be sorted.  Returns ``None`` when the jitted path does not apply
+    (disabled, ``segm``, ``extended``, empty corpus, or over
+    :data:`MATCH_BUDGET`) — the caller falls back to the numpy matcher.
+    """
+    if iou_type != "bbox" or extended:
+        return None
+    num_imgs = len(detections)
+    if num_imgs == 0:
+        return None
+
+    # ---- flatten the per-image lists into packed rows + segment ids
+    d_img = np.repeat(np.arange(num_imgs), [d[1].shape[0] for d in detections])
+    g_img = np.repeat(np.arange(num_imgs), [g[1].shape[0] for g in groundtruths])
+    d_box = (
+        np.concatenate([np.asarray(d[0], np.float64).reshape(-1, 4) for d in detections])
+        if d_img.size else np.zeros((0, 4))
+    )
+    d_score = (
+        np.concatenate([np.asarray(d[1], np.float32).reshape(-1) for d in detections])
+        if d_img.size else np.zeros(0, np.float32)
+    )
+    d_label = (
+        np.concatenate([np.asarray(d[2], np.int64).reshape(-1) for d in detections])
+        if d_img.size else np.zeros(0, np.int64)
+    )
+    g_box = (
+        np.concatenate([np.asarray(g[0], np.float64).reshape(-1, 4) for g in groundtruths])
+        if g_img.size else np.zeros((0, 4))
+    )
+    g_label = (
+        np.concatenate([np.asarray(g[1], np.int64).reshape(-1) for g in groundtruths])
+        if g_img.size else np.zeros(0, np.int64)
+    )
+    g_crowd = (
+        np.concatenate([np.asarray(g[2], np.int64).reshape(-1) for g in groundtruths])
+        if g_img.size else np.zeros(0, np.int64)
+    )
+    g_area = (
+        np.concatenate([np.asarray(g[3], np.float64).reshape(-1) for g in groundtruths])
+        if g_img.size else np.zeros(0)
+    )
+    return coco_evaluate_rows(
+        (d_box, d_score, d_label, d_img),
+        (g_box, g_label, g_crowd, g_area, g_img),
+        num_imgs, iou_thresholds, rec_thresholds, max_detection_thresholds,
+        class_ids, average=average,
+    )
+
+
+def coco_evaluate_rows(
+    det: Tuple[np.ndarray, ...],
+    gt: Tuple[np.ndarray, ...],
+    num_imgs: int,
+    iou_thresholds: Sequence[float],
+    rec_thresholds: Sequence[float],
+    max_detection_thresholds: Sequence[int],
+    class_ids: Sequence[int],
+    average: str = "macro",
+) -> Optional[Dict[str, np.ndarray]]:
+    """Jitted evaluation straight off packed flat rows + segment ids — the
+    device-resident state layout, with no per-image detour.
+
+    ``det`` = ``(boxes_xyxy f64 (N, 4), scores f32, labels i64, img i64)``;
+    ``gt`` adds crowd and area columns before the ids.  Same decline
+    contract as :func:`coco_evaluate_jit` (returns ``None``).
+    """
+    if not jit_matcher_enabled() or num_imgs == 0 or not class_ids:
+        return None
+    device = _matcher_device()
+    if device is None:
+        return None
+    return coco_evaluate_packed(
+        det, gt, num_imgs,
+        tuple(float(t) for t in iou_thresholds),
+        tuple(float(t) for t in rec_thresholds),
+        tuple(sorted(int(m) for m in max_detection_thresholds)),
+        np.asarray(sorted(class_ids), np.int64),
+        average,
+        list(_AREA_RANGES),
+        tuple(_AREA_RANGES[a] for a in _AREA_RANGES),
+        device,
+    )
+
+
+def coco_evaluate_packed(
+    det: Tuple[np.ndarray, ...],
+    gt: Tuple[np.ndarray, ...],
+    num_imgs: int,
+    iou_thrs: Tuple[float, ...],
+    rec_thrs: Tuple[float, ...],
+    max_dets: Tuple[int, ...],
+    class_arr: np.ndarray,
+    average: str,
+    area_names: List[str],
+    area_ranges: Tuple[Tuple[float, float], ...],
+    device: Any,
+) -> Optional[Dict[str, np.ndarray]]:
+    """Evaluate packed flat rows (the device-resident state layout) through
+    the jitted program; ``None`` over budget (caller falls back)."""
+    global _LAST_CALL
+    import jax
+    from jax.experimental import enable_x64
+
+    d_box, d_score, d_label, d_img = det
+    g_box, g_label, g_crowd, g_area, g_img = gt
+    eval_class_ids: Sequence[int] = [0] if average == "micro" else class_arr.tolist()
+    k = len(eval_class_ids)
+
+    # class slot per row (micro pools everything into slot 0)
+    if average == "micro":
+        d_slot = np.zeros(d_label.shape[0], np.int64)
+        g_slot = np.zeros(g_label.shape[0], np.int64)
+    else:
+        d_slot = np.searchsorted(class_arr, d_label)
+        g_slot = np.searchsorted(class_arr, g_label)
+
+    if d_score.size and not np.isfinite(d_score).all():
+        return None  # -inf is the pad sentinel and NaN breaks stable sorts
+
+    kp = _pow2_at_least(k)
+    ip = _pow2_at_least(num_imgs)
+    # score-descending, stable in stored order — the numpy path's per-image
+    # ``argsort(-scores, kind="stable")`` composed with its stable class
+    # selection; within-cell relative order is identical by the stable-sort
+    # subset property
+    d_order = np.argsort(-d_score, kind="mergesort")
+
+    # detection slots: cap at the top max-det threshold like order[:max_det]
+    cell_d = d_slot * ip + d_img
+    counts_d = np.bincount(cell_d, minlength=kp * ip) if d_img.size else np.zeros(kp * ip, np.int64)
+    capped = int(min(counts_d.max() if d_img.size else 0, max_dets[-1]))
+    dp = _pow2_at_least(max(capped, 1))
+    counts_g = (
+        np.bincount(g_slot * ip + g_img, minlength=kp * ip) if g_img.size else np.zeros(kp * ip, np.int64)
+    )
+    gp = _pow2_at_least(max(int(counts_g.max() if g_img.size else 0), 1))
+    if kp * ip * len(area_ranges) * len(iou_thrs) * dp * gp > MATCH_BUDGET:
+        return None
+    # post-sort column budget: the worst class holds at most this many real
+    # (per-cell max-det-capped) detection columns, so the accumulation can
+    # statically drop the -inf pad tail beyond it (see _build_program)
+    capped_counts = np.minimum(counts_d, max_dets[-1]).reshape(kp, ip)
+    per_class_cols = int(capped_counts.sum(axis=1).max()) if counts_d.size else 0
+    c2s = _pow2_at_least(max(per_class_cols, 1))
+    c2s = min(c2s, ip * dp)
+
+    dense_dbox, d_valid, (dense_score,), _ = _dense_cells(
+        d_box, d_img, d_slot, kp, ip, dp, max_dets[-1], extra=[d_score], order=d_order
+    )
+    dense_gbox, g_valid, (dense_crowd, dense_garea), _ = _dense_cells(
+        g_box, g_img, g_slot, kp, ip, gp, None,
+        extra=[g_crowd.astype(bool), np.asarray(g_area, np.float64)],
+    )
+
+    # loop-trip bucketing: exact would recompile per distinct max cell count,
+    # so round up to the next multiple of 4 (<= 4 variants per dp edge)
+    d_trip = min(dp, 4 * ((max(capped, 1) + 3) // 4))
+    key = (kp, ip, dp, gp, c2s, d_trip, iou_thrs, rec_thrs, max_dets, area_ranges)
+    program = _PROGRAMS.get(key)
+    with enable_x64():
+        if program is None:
+            program = _build_program(
+                kp, ip, dp, gp, c2s, d_trip, iou_thrs, rec_thrs, max_dets, area_ranges
+            )
+            _PROGRAMS[key] = program
+        args = jax.device_put(
+            (dense_dbox, dense_score.astype(np.float32), d_valid,
+             dense_gbox, dense_crowd, dense_garea, g_valid),
+            device,
+        )
+        precision_d, recall_d, npig_d = jax.device_get(program(*args))
+    # record only ABSTRACT input specs for the bench's cost analysis: holding
+    # the concrete args would pin the dense device grids (potentially
+    # MATCH_BUDGET-scale) in memory for the rest of the process
+    _LAST_CALL = (
+        program,
+        tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args),
+    )
+
+    # ---- host assembly into the COCO (T, R, K, A, M) / (T, K, A, M) layout
+    num_thrs, num_rec, num_areas, n_m = len(iou_thrs), len(rec_thrs), len(area_names), len(max_dets)
+    precision = -np.ones((num_thrs, num_rec, k, num_areas, n_m))
+    recall = -np.ones((num_thrs, k, num_areas, n_m))
+    live = npig_d[:k] > 0  # (k, A): cells with no countable gts stay -1
+    for k_idx in range(k):
+        for a_idx in range(num_areas):
+            if not live[k_idx, a_idx]:
+                continue
+            # precision_d[k, a] is (T, M, R) -> (T, R, M)
+            precision[:, :, k_idx, a_idx, :] = np.transpose(
+                precision_d[k_idx, a_idx], (0, 2, 1)
+            )
+            recall[:, k_idx, a_idx, :] = recall_d[k_idx, a_idx]
+    return _summarize(
+        precision, recall, np.asarray(iou_thrs), class_arr.tolist(), eval_class_ids,
+        area_names, list(max_dets), {}, False,
+    )
+
+
+def last_cost_analysis() -> Optional[Dict[str, float]]:
+    """XLA ``cost_analysis`` of the most recently executed matcher program
+    (bench accounting: real compiled-flops instead of an analytic guess)."""
+    from jax.experimental import enable_x64
+
+    if _LAST_CALL is None:
+        return None
+    program, args = _LAST_CALL
+    try:
+        with enable_x64():
+            cost = program.lower(*args).compile().cost_analysis()
+        if isinstance(cost, list):  # older jaxlibs return [dict]
+            cost = cost[0] if cost else None
+        return dict(cost) if cost else None
+    except Exception:
+        return None

@@ -19,10 +19,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpumetrics.detection._coco_eval import coco_evaluate, precompute_geometries
+from tpumetrics.detection._coco_eval_jax import coco_evaluate_jit
 from tpumetrics.detection.helpers import _input_validator
 from tpumetrics.metric import Metric
+from tpumetrics.utils.exceptions import TPUMetricsUserError
 
 Array = jax.Array
+
+#: packed-row layouts (see the class docstring's "packed device-resident
+#: state"): one f32 row per detection / ground truth, segment id last.
+#: f32 carries class ids, crowd flags and image ids exactly below 2^24.
+_DET_COLS = 7  # x1, y1, x2, y2, score, label, image id (-1 = pad sentinel)
+_GT_COLS = 8  # x1, y1, x2, y2, label, iscrowd, area, image id
 
 
 @jax.jit
@@ -125,6 +133,72 @@ def _fix_empty_boxes(boxes) -> np.ndarray:
     return boxes
 
 
+_PACKED_MERGE_ERROR = (
+    "Packed detection rows from distinct id spaces were merged: per-rank "
+    "packed states collide.  Packed (dense) updates support a single logical "
+    "stream — one process, or ONE global program on a GSPMD mesh; use the "
+    "list-of-dicts layout for eager per-rank DDP."
+)
+
+
+def _check_packed_chunk_order(chunks: Sequence[np.ndarray]) -> None:
+    """Across the fetched per-update chunks of ONE logical stream, image ids
+    must STRICTLY increase at every chunk boundary (each update's ids start
+    past everything before it).  A cat-merge of per-rank states restarts the
+    id sequence — caught here even when a rank contributed a single image,
+    which plain nondecreasing-over-the-flat-rows cannot distinguish from one
+    image's contiguous rows."""
+    last = -1
+    for chunk in chunks:
+        ids = np.rint(np.asarray(chunk).reshape(-1, chunk.shape[-1])[:, -1]).astype(np.int64)
+        ids = ids[ids >= 0]
+        if not ids.size:
+            continue
+        if int(ids[0]) <= last:
+            raise TPUMetricsUserError(_PACKED_MERGE_ERROR)
+        last = int(ids[-1])
+
+
+def _filter_packed_rows(flat: np.ndarray, n_imgs: int, label_col: int) -> tuple:
+    """Validate fetched packed rows and return ``(rows, ids)`` flat.
+
+    Drops the eager path's ``-1`` pad-sentinel rows, validates that ids are
+    nondecreasing (rows of one logical stream always are — a violation means
+    per-rank packed states with colliding id spaces were concatenated, which
+    only the single-program GSPMD path supports) and in range, and that
+    class labels sit inside float32's exact-integer range (a larger label
+    would have silently aliased in the f32 row column — fail loudly).
+    """
+    ids = np.rint(flat[:, -1]).astype(np.int64)
+    rows = flat[ids >= 0]
+    ids = ids[ids >= 0]
+    if ids.size and np.any(np.diff(ids) < 0):
+        raise TPUMetricsUserError(_PACKED_MERGE_ERROR)
+    if ids.size and ids[-1] >= n_imgs:
+        raise TPUMetricsUserError(
+            f"Packed detection state is inconsistent: row image id {int(ids[-1])} "
+            f">= recorded image count {n_imgs}."
+        )
+    if rows.shape[0] and float(np.abs(rows[:, label_col]).max()) > 2.0**24:
+        raise TPUMetricsUserError(
+            "Packed detection state holds class labels beyond the 2^24 "
+            "exact-integer range of the float32 row columns — distinct classes "
+            "may already have aliased.  Use smaller class ids (or the "
+            "list-of-dicts layout)."
+        )
+    return rows, ids
+
+
+def _split_packed_rows(flat: np.ndarray, n_imgs: int, label_col: int) -> tuple:
+    """:func:`_filter_packed_rows`, then split into per-image arrays:
+    ``(per_image_rows, per_image_counts)``."""
+    rows, ids = _filter_packed_rows(flat, n_imgs, label_col)
+    counts = np.bincount(ids, minlength=n_imgs).astype(np.int64)
+    if n_imgs == 0:
+        return [], counts
+    return np.split(rows, np.cumsum(counts)[:-1]), counts
+
+
 def _rle_encode_batch(masks: np.ndarray) -> tuple:
     """Column-major RLE encode an (N, H, W) boolean stack.
 
@@ -158,6 +232,16 @@ class MeanAveragePrecision(Metric):
     stacks replace ``boxes`` (reference mean_ap.py:430-438); masks are
     RLE-encoded at update and matched by mask IoU at compute.
 
+    **Packed dense layout** (bbox only): each side of a batch may instead be
+    ONE dict of ``(B, slots, ...)`` arrays plus a per-image ``count`` —
+    built by :func:`tpumetrics.detection.pack_detection_batch` — with an
+    optional ``valid`` image mask.  That update is a trace-safe fixed-shape
+    append into packed row states (``det_rows``/``gt_rows`` + segment ids),
+    runs under ``jit`` / ``FusedCollectionStep`` / the bucketed
+    ``StreamingEvaluator`` / a GSPMD mesh with zero device→host transfers,
+    and lands on bit-identical results (``docs/performance.md``,
+    "Device-resident detection").
+
     Args:
         box_format: ``xyxy``/``xywh``/``cxcywh`` input box format.
         iou_type: ``bbox`` (box IoU), ``segm`` (instance-mask IoU), or a
@@ -175,6 +259,11 @@ class MeanAveragePrecision(Metric):
         backend: accepted for drop-in compatibility (reference
             mean_ap.py:360); both values select the built-in vectorized
             engine, parity-tested against the reference's pycocotools path.
+        det_capacity / gt_capacity: row capacities of the packed
+            ``det_rows``/``gt_rows`` states on the functional/jit path
+            (fixed-shape :class:`~tpumetrics.buffers.MaskedBuffer`\\ s;
+            overflow raises at ``compute`` rather than truncating).  The
+            eager OO path keeps unbounded lists and ignores these.
 
     Example:
         >>> import jax.numpy as jnp
@@ -216,6 +305,22 @@ class MeanAveragePrecision(Metric):
     groundtruth_mask_runs: List[Array]
     groundtruth_mask_nruns: List[Array]
     mask_sizes: List[Array]
+    # packed device-resident state (bbox only): flat row buffers + segment
+    # ids instead of per-image host lists.  ``det_rows`` is (N, 7) f32 —
+    # box xyxy (raw input format), score, label, image id — and ``gt_rows``
+    # (N, 8) adds crowd/area columns; ``packed_imgs`` counts the images the
+    # packed rows describe.  The segment id rides as the LAST COLUMN of the
+    # same buffer (not a sibling array) so merge / elastic fold / reshard /
+    # overflow can never de-align rows from their ids.  Registered through
+    # the buffer-state machinery: "cat" reduce semantics, a declared
+    # capacity (MaskedBuffer on the functional/jit path), P(dp) partition
+    # rules from StatePartitionRules.for_metric, and snapshot specs — all
+    # for free.  On the eager OO path the states stay Python lists and pad
+    # rows carry image id -1 (dropped at compute), so eager dense updates
+    # are exactly as host-sync-free as the traced ones.
+    det_rows: List[Array]
+    gt_rows: List[Array]
+    packed_imgs: Array
 
     def __init__(
         self,
@@ -228,6 +333,8 @@ class MeanAveragePrecision(Metric):
         extended_summary: bool = False,
         average: str = "macro",
         backend: str = "pycocotools",
+        det_capacity: int = 8192,
+        gt_capacity: int = 8192,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -292,6 +399,11 @@ class MeanAveragePrecision(Metric):
         # against the reference's primary (pycocotools) path
         self.backend = backend
 
+        if not (isinstance(det_capacity, int) and isinstance(gt_capacity, int)) or min(det_capacity, gt_capacity) < 1:
+            raise ValueError(
+                f"Expected `det_capacity`/`gt_capacity` to be positive ints, got {det_capacity}/{gt_capacity}"
+            )
+
         self.add_state("detection_scores", default=[], dist_reduce_fx=None)
         self.add_state("detection_labels", default=[], dist_reduce_fx=None)
         self.add_state("detection_counts", default=[], dist_reduce_fx=None)
@@ -302,6 +414,19 @@ class MeanAveragePrecision(Metric):
         if "bbox" in iou_types:
             self.add_state("detection_boxes", default=[], dist_reduce_fx=None)
             self.add_state("groundtruth_boxes", default=[], dist_reduce_fx=None)
+            # packed device-resident states (class docstring): the declared
+            # capacity only binds the functional/jit path, where the state
+            # becomes a fixed-capacity MaskedBuffer (overflow raises at
+            # compute); the eager path keeps unbounded Python lists
+            self.add_state(
+                "det_rows", default=[], dist_reduce_fx="cat",
+                capacity=det_capacity, feature_shape=(_DET_COLS,), feature_dtype=jnp.float32,
+            )
+            self.add_state(
+                "gt_rows", default=[], dist_reduce_fx="cat",
+                capacity=gt_capacity, feature_shape=(_GT_COLS,), feature_dtype=jnp.float32,
+            )
+            self.add_state("packed_imgs", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
         if "segm" in iou_types:
             self.add_state("detection_mask_runs", default=[], dist_reduce_fx=None)
             self.add_state("detection_mask_nruns", default=[], dist_reduce_fx=None)
@@ -309,19 +434,57 @@ class MeanAveragePrecision(Metric):
             self.add_state("groundtruth_mask_nruns", default=[], dist_reduce_fx=None)
             self.add_state("mask_sizes", default=[], dist_reduce_fx=None)
 
-    def update(self, preds: Sequence[Dict[str, Array]], target: Sequence[Dict[str, Array]]) -> None:
-        """Append one batch of per-image detections and ground truths
-        (reference mean_ap.py:366-400).
+    def update(
+        self,
+        preds: Union[Sequence[Dict[str, Array]], Dict[str, Array]],
+        target: Union[Sequence[Dict[str, Array]], Dict[str, Array]],
+        valid: Optional[Array] = None,
+    ) -> None:
+        """Append one batch of detections and ground truths.
 
-        ZERO device operations happen here: per-image arrays are stored
-        as-is (device or host), per-image boundaries as host int arrays, and
-        missing ``iscrowd``/``area`` as host zero placeholders.  All device
-        work is deferred to ``compute``, which packs every device-resident
-        piece into ONE jitted concatenation and pays ONE transfer — on a
-        remote-attached accelerator each eager dispatch or fetch is a full
-        network round trip, so per-update device math (the reference does
-        O(images) tensor ops per update) is the dominant cost, not the
-        protocol itself."""
+        Two input layouts:
+
+        - **list-of-dicts** (the reference format, mean_ap.py:366-400): one
+          dict per image with ragged arrays.  ZERO device operations happen
+          here: per-image arrays are stored as-is (device or host),
+          per-image boundaries as host int arrays, and missing
+          ``iscrowd``/``area`` as host zero placeholders.
+        - **packed dense dicts** (``preds``/``target`` each ONE dict of
+          ``(B, slots, ...)`` arrays — :func:`tpumetrics.detection.packing.
+          pack_detection_batch` builds them): a trace-safe fixed-shape
+          append into the packed row states.  ``valid`` masks padded
+          images (the :mod:`tpumetrics.runtime.bucketing` convention), so
+          this path runs under ``jit`` / ``FusedCollectionStep`` / the
+          bucketed ``StreamingEvaluator`` / a GSPMD mesh with zero
+          device→host transfers — the paper's no-host-sync-until-compute
+          contract for the detection family.
+
+        All device work is deferred to ``compute``, which packs every
+        device-resident piece into ONE jitted concatenation and pays ONE
+        transfer — on a remote-attached accelerator each eager dispatch or
+        fetch is a full network round trip, so per-update device math (the
+        reference does O(images) tensor ops per update) is the dominant
+        cost, not the protocol itself."""
+        if isinstance(preds, dict) or isinstance(target, dict):
+            self._update_packed(preds, target, valid)
+            return
+        if valid is not None:
+            raise TPUMetricsUserError(
+                "`valid` only applies to packed (dict) detection batches; the "
+                "list-of-dicts layout is always fully valid."
+            )
+        from tpumetrics.utils.data import _is_tracer
+
+        if any(_is_tracer(v) for p in preds for v in p.values()) or any(
+            _is_tracer(v) for t in target for v in t.values()
+        ):
+            raise TPUMetricsUserError(
+                "The list-of-dicts detection layout cannot run under jit / the "
+                "bucketed runtime (per-image arrays are ragged).  Pack the batch "
+                "into the dense dict layout first — "
+                "tpumetrics.detection.pack_detection_batch(preds, target) — and "
+                "submit the two dicts."
+            )
         _input_validator(preds, target, iou_type=self.iou_type)
         if not preds:
             return
@@ -368,6 +531,129 @@ class MeanAveragePrecision(Metric):
             for t, n in zip(target, gcounts)
         )
         self.groundtruth_counts.append(np.asarray(gcounts, np.int64))
+
+    # ------------------------------------------------- packed (device) path
+
+    @staticmethod
+    def _check_packed_shapes(side: str, d: Dict[str, Array], keys: tuple) -> tuple:
+        """Static (metadata-only, trace-safe) validation of one dense dict;
+        returns ``(B, slots)``."""
+        for key in keys:
+            if key not in d:
+                raise ValueError(f"Packed {side} dict is missing the `{key}` key")
+        boxes = d["boxes"]
+        if getattr(boxes, "ndim", 0) != 3 or boxes.shape[-1] != 4:
+            raise ValueError(
+                f"Packed {side} `boxes` must have shape (B, slots, 4), got {jnp.shape(boxes)}"
+            )
+        b, slots = boxes.shape[0], boxes.shape[1]
+        for key in d:
+            if key == "boxes":
+                continue
+            shape = tuple(jnp.shape(d[key]))
+            want = (b,) if key == "count" else (b, slots)
+            if shape != want:
+                raise ValueError(
+                    f"Packed {side} `{key}` must have shape {want}, got {shape}"
+                )
+        return b, slots
+
+    def _update_packed(
+        self, preds: Dict[str, Array], target: Dict[str, Array], valid: Optional[Array]
+    ) -> None:
+        """One fixed-shape append of a packed dense batch (class docstring).
+
+        Every operation here is shape-metadata checks plus traced ``jnp``
+        math — no data-dependent Python branch and no device→host transfer —
+        so the same code path serves the eager OO metric, ``jit`` via
+        ``functional_update``, the bucketed masked update, and a GSPMD mesh.
+        """
+        if not (isinstance(preds, dict) and isinstance(target, dict)):
+            raise ValueError(
+                "Packed detection updates need BOTH `preds` and `target` as dense dicts"
+            )
+        if self._iou_types != ("bbox",):
+            raise TPUMetricsUserError(
+                "Packed detection updates support iou_type='bbox' only; the RLE "
+                "segm path needs host mask decode — use the list-of-dicts layout."
+            )
+        b, d_slots = self._check_packed_shapes("preds", preds, ("boxes", "scores", "labels"))
+        bt, g_slots = self._check_packed_shapes("target", target, ("boxes", "labels"))
+        if bt != b:  # tpulint: disable=TPL102 -- b/bt are Python ints read off .shape metadata (static at trace time), never traced values
+            raise ValueError(f"Packed preds describe {b} images but target {bt}")
+
+        if valid is None:
+            valid_b = jnp.ones((b,), bool)
+        else:
+            valid_b = jnp.asarray(valid).astype(bool).reshape((b,))
+        vi = valid_b.astype(jnp.int32)
+        base = jnp.asarray(self.packed_imgs, jnp.int32)
+        # compacted image ids: the j-th VALID image of this batch gets
+        # base + j, so ids stay dense however the bucketer padded the batch
+        ids = base + jnp.cumsum(vi) - 1  # (B,) int32
+
+        def rows_for(d: Dict[str, Array], slots: int, is_det: bool):
+            count = d.get("count")
+            if count is None:
+                count = jnp.full((b,), slots, jnp.int32)
+            if isinstance(count, (np.ndarray, list, tuple)):
+                # host counts (the pack_detection_batch output): a count past
+                # the slot budget would mark zero-filled pad slots as real
+                # detections — fail loudly while the value is host-readable
+                host_max = int(np.max(count)) if np.size(count) else 0
+                if host_max > slots:
+                    raise ValueError(
+                        f"Packed `count` claims {host_max} rows but the dict has "
+                        f"only {slots} slots per image"
+                    )
+            # device/traced counts can't be value-checked without a host sync
+            # (this path must stay transfer-free); clamping keeps the row mask
+            # inside the slot budget either way
+            count = jnp.minimum(jnp.asarray(count), slots)
+            rvalid = (jnp.arange(slots)[None, :] < count[:, None]) & valid_b[:, None]
+            img_col = jnp.where(rvalid, ids[:, None].astype(jnp.float32), -1.0)
+            cols = [
+                jnp.reshape(d["boxes"], (b * slots, 4)).astype(jnp.float32),
+                jnp.reshape(d["scores"] if is_det else d["labels"], (b * slots, 1)).astype(jnp.float32),
+            ]
+            if is_det:
+                cols.append(jnp.reshape(d["labels"], (b * slots, 1)).astype(jnp.float32))
+            else:
+                crowds = d.get("iscrowd")
+                areas = d.get("area")
+                cols.append(
+                    jnp.reshape(
+                        jnp.zeros((b, slots), jnp.float32) if crowds is None else crowds,
+                        (b * slots, 1),
+                    ).astype(jnp.float32)
+                )
+                cols.append(
+                    jnp.reshape(
+                        jnp.zeros((b, slots), jnp.float32) if areas is None else areas,
+                        (b * slots, 1),
+                    ).astype(jnp.float32)
+                )
+            cols.append(jnp.reshape(img_col, (b * slots, 1)))
+            return jnp.concatenate(cols, axis=1), jnp.reshape(rvalid, (b * slots,))
+
+        det_rows, det_valid = rows_for(preds, d_slots, is_det=True)
+        gt_rows, gt_valid = rows_for(target, g_slots, is_det=False)
+        self._append_packed("det_rows", det_rows, det_valid)
+        self._append_packed("gt_rows", gt_rows, gt_valid)
+        self.packed_imgs = base + jnp.sum(vi)
+
+    def _append_packed(self, name: str, rows: Array, mask: Array) -> None:
+        """Append packed rows: masked-compacted into the MaskedBuffer on the
+        functional/jit path; appended whole on the eager list path, where the
+        ``-1`` image-id sentinel already marks pad rows (a boolean compaction
+        would force a device→host sync, which this path must never do)."""
+        from tpumetrics.buffers import _BufferList
+
+        val = getattr(self, name)
+        if isinstance(val, _BufferList):
+            val.append(rows, valid=mask)
+        else:
+            val.append(rows)
 
     @staticmethod
     def coco_to_tm(
@@ -477,6 +763,11 @@ class MeanAveragePrecision(Metric):
             raise NotImplementedError(
                 "tm_to_coco currently exports bbox states (segm export needs a compressed-RLE"
                 " writer to be readable by pycocotools)."
+            )
+        if len(self.det_rows) or len(self.gt_rows):
+            raise NotImplementedError(
+                "tm_to_coco exports the per-image list states; this metric holds packed"
+                " (dense-update) rows.  Use the list-of-dicts update layout for COCO export."
             )
         dcounts = np.concatenate([np.asarray(c) for c in self.detection_counts]).astype(int) if self.detection_counts else np.zeros(0, int)
         gcounts = np.concatenate([np.asarray(c) for c in self.groundtruth_counts]).astype(int) if self.groundtruth_counts else np.zeros(0, int)
@@ -653,77 +944,140 @@ class MeanAveragePrecision(Metric):
         runs) never touch the device.  Per-image boundaries come from the
         host-side counts."""
         types = self._iou_types
+        self._check_packed_overflow()
         if self.detection_counts:
             dcounts = np.concatenate([np.asarray(c) for c in self.detection_counts]).astype(np.int64)
             gcounts = np.concatenate([np.asarray(c) for c in self.groundtruth_counts]).astype(np.int64)
-            num_imgs = len(dcounts)
-
-            geom_pieces = (self.detection_boxes + self.groundtruth_boxes) if "bbox" in types else []
-            fetched = _fetch_pieces(
-                list(self.detection_scores)
-                + list(self.detection_labels)
-                + list(self.groundtruth_labels)
-                + list(self.groundtruth_crowds)
-                + list(self.groundtruth_area)
-                + list(geom_pieces)
-            )
-            pos = 0
-
-            def take(n):
-                nonlocal pos
-                out = fetched[pos : pos + n]
-                pos += n
-                return out
-
-            det_scores = [s.reshape(-1).astype(np.float32) for s in take(num_imgs)]
-            det_labels = [lab.reshape(-1).astype(np.int64) for lab in take(num_imgs)]
-            gt_labels = [lab.reshape(-1).astype(np.int64) for lab in take(num_imgs)]
-            gt_crowds = [c.reshape(-1).astype(np.int64) for c in take(num_imgs)]
-            gt_area = [a.reshape(-1).astype(np.float32) for a in take(num_imgs)]
-            geoms_by_type: Dict[str, tuple] = {}
-            if "bbox" in types:
-                geoms_by_type["bbox"] = (
-                    self._convert_boxes_host_batched(take(num_imgs), dcounts),
-                    self._convert_boxes_host_batched(take(num_imgs), gcounts),
-                )
-            if "segm" in types:
-                geoms_by_type["segm"] = self._unpack_mask_geoms(dcounts, gcounts)
         else:
-            num_imgs = 0
-            det_scores = det_labels = []
-            gt_labels = gt_crowds = gt_area = []
-            geoms_by_type = {t: ([], []) for t in types}
-        all_labels = det_labels + gt_labels
-        class_ids = (
-            sorted(np.unique(np.concatenate(all_labels)).astype(int).tolist()) if all_labels else []
+            dcounts = np.zeros(0, np.int64)
+            gcounts = np.zeros(0, np.int64)
+        num_list = len(dcounts)
+
+        # every device-resident piece — list-path states AND packed row
+        # chunks AND the packed image counter — rides the ONE pack + transfer
+        packed_det_pieces = list(self.det_rows) if "bbox" in types else []
+        packed_gt_pieces = list(self.gt_rows) if "bbox" in types else []
+        geom_pieces = (self.detection_boxes + self.groundtruth_boxes) if "bbox" in types else []
+        fetched = _fetch_pieces(
+            list(self.detection_scores)
+            + list(self.detection_labels)
+            + list(self.groundtruth_labels)
+            + list(self.groundtruth_crowds)
+            + list(self.groundtruth_area)
+            + list(geom_pieces)
+            + packed_det_pieces
+            + packed_gt_pieces
+            + ([jnp.asarray(self.packed_imgs)] if "bbox" in types else [])
         )
+        pos = 0
+
+        def take(n):
+            nonlocal pos
+            out = fetched[pos : pos + n]
+            pos += n
+            return out
+
+        det_scores = [s.reshape(-1).astype(np.float32) for s in take(num_list)]
+        det_labels = [lab.reshape(-1).astype(np.int64) for lab in take(num_list)]
+        gt_labels = [lab.reshape(-1).astype(np.int64) for lab in take(num_list)]
+        gt_crowds = [c.reshape(-1).astype(np.int64) for c in take(num_list)]
+        gt_area = [a.reshape(-1).astype(np.float32) for a in take(num_list)]
+        geoms_by_type: Dict[str, tuple] = {}
+        n_packed = 0
+        direct_bbox = None  # (result, class_ids) from the packed-only fast path
+        if "bbox" in types:
+            det_boxes_raw: List[np.ndarray] = [b.reshape(-1, 4) for b in take(num_list)]
+            gt_boxes_raw: List[np.ndarray] = [b.reshape(-1, 4) for b in take(num_list)]
+            det_chunks = [p.reshape(-1, _DET_COLS) for p in take(len(packed_det_pieces))]
+            gt_chunks = [p.reshape(-1, _GT_COLS) for p in take(len(packed_gt_pieces))]
+            # one update = one chunk: ids must strictly increase across chunk
+            # boundaries, or per-rank id spaces were cat-merged (see helper)
+            _check_packed_chunk_order(det_chunks)
+            _check_packed_chunk_order(gt_chunks)
+            det_flat = (
+                np.concatenate(det_chunks) if det_chunks else np.zeros((0, _DET_COLS), np.float32)
+            )
+            gt_flat = (
+                np.concatenate(gt_chunks) if gt_chunks else np.zeros((0, _GT_COLS), np.float32)
+            )
+            n_packed = int(round(float(take(1)[0].reshape(()))))
+            if n_packed > 2**24:
+                raise TPUMetricsUserError(
+                    f"Packed detection state describes {n_packed} images, past the "
+                    "2^24 exact-integer range of the float32 image-id column — ids "
+                    "would alias and mAP would be silently wrong.  Compute/reset in "
+                    "smaller windows, or use the list-of-dicts layout."
+                )
+            if n_packed and not num_list and not self.extended_summary and not (
+                self.class_metrics and self.average == "micro"
+            ):
+                # packed-only fast path: the state already IS the flat
+                # rows-plus-segment-ids layout the jitted matcher consumes —
+                # skip the per-image split/re-concatenate detour entirely
+                # (O(images) small-array churn per compute); a declined jit
+                # path falls through to the per-image route below
+                direct_bbox = self._evaluate_packed_rows(det_flat, gt_flat, n_packed)
+            if direct_bbox is None:
+                if n_packed or det_flat.size or gt_flat.size:
+                    d_per, extra_d = _split_packed_rows(det_flat, n_packed, label_col=5)
+                    g_per, extra_g = _split_packed_rows(gt_flat, n_packed, label_col=4)
+                    for rows in d_per:
+                        det_boxes_raw.append(rows[:, :4])
+                        det_scores.append(rows[:, 4].astype(np.float32))
+                        det_labels.append(np.rint(rows[:, 5]).astype(np.int64))
+                    for rows in g_per:
+                        gt_boxes_raw.append(rows[:, :4])
+                        gt_labels.append(np.rint(rows[:, 4]).astype(np.int64))
+                        gt_crowds.append(np.rint(rows[:, 5]).astype(np.int64))
+                        gt_area.append(rows[:, 6].astype(np.float32))
+                    dcounts = np.concatenate([dcounts, extra_d])
+                    gcounts = np.concatenate([gcounts, extra_g])
+                geoms_by_type["bbox"] = (
+                    self._convert_boxes_host_batched(det_boxes_raw, dcounts),
+                    self._convert_boxes_host_batched(gt_boxes_raw, gcounts),
+                )
+            else:
+                geoms_by_type["bbox"] = ([], [])  # evaluation already done
+        if "segm" in types:
+            geoms_by_type["segm"] = (
+                self._unpack_mask_geoms(dcounts, gcounts) if len(dcounts) else ([], [])
+            )
+        num_imgs = num_list + n_packed
+        if direct_bbox is not None:
+            class_ids = direct_bbox[1]
+        else:
+            all_labels = det_labels + gt_labels
+            class_ids = (
+                sorted(np.unique(np.concatenate(all_labels)).astype(int).tolist())
+                if all_labels else []
+            )
 
         max_det = self.max_detection_thresholds[-1]
-        out: Dict[str, Array] = {}
+        # staged on host, shipped to device by ONE device_put at the end —
+        # on a remote-attached accelerator each per-key jnp.asarray would be
+        # its own round trip (~16 of them), the batched put is one
+        staged: Dict[str, Any] = {}
+        np_only: Dict[str, Any] = {}
         for i_type in types:
             # prefix outputs only when evaluating both geometries at once,
             # like the reference (mean_ap.py:508)
             prefix = "" if len(types) == 1 else f"{i_type}_"
-            det_geoms, gt_geoms = geoms_by_type[i_type]
-            detections = [(det_geoms[i], det_scores[i], det_labels[i]) for i in range(num_imgs)]
-            groundtruths = [
-                (gt_geoms[i], gt_labels[i], gt_crowds[i], gt_area[i]) for i in range(num_imgs)
-            ]
-            # pay the geometry cost (mask decode + intersections) once,
-            # shared by the optional second macro evaluation below
-            geom_cache = precompute_geometries(detections, groundtruths, i_type)
-            result = coco_evaluate(
-                detections,
-                groundtruths,
-                self.iou_thresholds,
-                self.rec_thresholds,
-                self.max_detection_thresholds,
-                class_ids,
-                average=self.average,
-                iou_type=i_type,
-                geom_cache=geom_cache,
-                extended=self.extended_summary,
-            )
+            if i_type == "bbox" and direct_bbox is not None:
+                # the jitted matcher already consumed the flat rows; no
+                # per-image tuples exist (and none are needed: the micro
+                # per-class recompute is excluded from the direct path)
+                detections, groundtruths = [], []
+                result, geom_cache = direct_bbox[0], None
+            else:
+                det_geoms, gt_geoms = geoms_by_type[i_type]
+                detections = [(det_geoms[i], det_scores[i], det_labels[i]) for i in range(num_imgs)]
+                groundtruths = [
+                    (gt_geoms[i], gt_labels[i], gt_crowds[i], gt_area[i]) for i in range(num_imgs)
+                ]
+                result, geom_cache = self._evaluate(
+                    detections, groundtruths, class_ids, i_type, self.average, None,
+                    extended=self.extended_summary,
+                )
             if self.extended_summary:
                 # reference mean_ap.py:525-536: score-sorted (image, class)
                 # IoU matrices + the raw precision/recall tensors over
@@ -731,9 +1085,9 @@ class MeanAveragePrecision(Metric):
                 # host-produced diagnostics, and device_put-ing
                 # O(images x classes) tiny matrices would pay one transfer
                 # round trip each
-                out[f"{prefix}ious"] = {k: np.asarray(v, np.float32) for k, v in result["ious"].items()}
-                out[f"{prefix}precision"] = jnp.asarray(result["precision"])
-                out[f"{prefix}recall"] = jnp.asarray(result["recall"])
+                np_only[f"{prefix}ious"] = {k: np.asarray(v, np.float32) for k, v in result["ious"].items()}
+                staged[f"{prefix}precision"] = np.asarray(result["precision"])
+                staged[f"{prefix}recall"] = np.asarray(result["recall"])
             for key in (
                 "map",
                 "map_50",
@@ -746,12 +1100,145 @@ class MeanAveragePrecision(Metric):
                 "mar_large",
                 *(f"mar_{m}" for m in self.max_detection_thresholds),
             ):
-                out[f"{prefix}{key}"] = jnp.asarray(result[key])
-            self._add_per_class(out, prefix, result, detections, groundtruths, class_ids, i_type, geom_cache, max_det)
-        out["classes"] = jnp.asarray(
-            np.asarray(class_ids, np.int32) if class_ids else np.zeros(0, np.int32)
-        )
+                staged[f"{prefix}{key}"] = np.asarray(result[key])
+            self._add_per_class(staged, prefix, result, detections, groundtruths, class_ids, i_type, geom_cache, max_det)
+        staged["classes"] = np.asarray(class_ids, np.int32) if class_ids else np.zeros(0, np.int32)
+        out: Dict[str, Array] = jax.device_put(staged)
+        out.update(np_only)
         return out
+
+    def _evaluate(
+        self, detections, groundtruths, class_ids, i_type, average, geom_cache, extended=False
+    ):
+        """Route one COCO evaluation: the jitted dense-cell matcher
+        (:func:`~tpumetrics.detection._coco_eval_jax.coco_evaluate_jit`)
+        when it applies — bbox, non-extended, in budget — else the batched
+        numpy path.  Returns ``(result, geom_cache)``; the cache is only
+        materialized when a numpy evaluation actually needs it, so the jit
+        hot path never pays the per-image intersection precompute."""
+        if not extended and i_type == "bbox":
+            result = coco_evaluate_jit(
+                detections,
+                groundtruths,
+                self.iou_thresholds,
+                self.rec_thresholds,
+                self.max_detection_thresholds,
+                class_ids,
+                average=average,
+                iou_type=i_type,
+            )
+            if result is not None:
+                return result, geom_cache
+        if geom_cache is None:
+            # pay the geometry cost (mask decode + intersections) once,
+            # shared by the optional second macro evaluation
+            geom_cache = precompute_geometries(detections, groundtruths, i_type)
+        result = coco_evaluate(
+            detections,
+            groundtruths,
+            self.iou_thresholds,
+            self.rec_thresholds,
+            self.max_detection_thresholds,
+            class_ids,
+            average=average,
+            iou_type=i_type,
+            geom_cache=geom_cache,
+            extended=extended,
+        )
+        return result, geom_cache
+
+    def _evaluate_packed_rows(self, det_flat, gt_flat, n_packed):
+        """Packed-only fast path: run the jitted matcher straight off the
+        flat row layout (validated + sentinel-filtered, boxes converted in
+        ONE vectorized pass) — no per-image split/re-concatenate detour.
+        Returns ``(result, class_ids)`` or ``None`` when the jitted path
+        declines (the caller then builds the per-image form and falls back).
+        """
+        from tpumetrics.detection._coco_eval_jax import coco_evaluate_rows
+
+        d_rows, d_img = _filter_packed_rows(det_flat, n_packed, label_col=5)
+        g_rows, g_img = _filter_packed_rows(gt_flat, n_packed, label_col=4)
+        d_labels = np.rint(d_rows[:, 5]).astype(np.int64)
+        g_labels = np.rint(g_rows[:, 4]).astype(np.int64)
+        cat = np.concatenate([d_labels, g_labels])
+        class_ids = sorted(np.unique(cat).astype(int).tolist()) if cat.size else []
+        result = coco_evaluate_rows(
+            (
+                self._convert_boxes_host(d_rows[:, :4]),
+                d_rows[:, 4].astype(np.float32),
+                d_labels,
+                d_img,
+            ),
+            (
+                self._convert_boxes_host(g_rows[:, :4]),
+                g_labels,
+                np.rint(g_rows[:, 5]).astype(np.int64),
+                g_rows[:, 6].astype(np.float64),
+                g_img,
+            ),
+            n_packed,
+            self.iou_thresholds,
+            self.rec_thresholds,
+            self.max_detection_thresholds,
+            class_ids,
+            average=self.average,
+        )
+        return None if result is None else (result, class_ids)
+
+    def _sync_state_collect_inner(self, state, backend, reducer, group, out, pending):
+        """Refuse a cross-rank eager sync while packed rows exist: a generic
+        cat-merge would concatenate independent per-rank image-id spaces,
+        which is semantically wrong (and the compacted-buffer form can make
+        the collision undetectable after the fact).  Multi-rank packed
+        detection belongs to the ONE-global-program GSPMD path; eager DDP
+        uses the list-of-dicts layout."""
+        try:
+            world = int(backend.world_size())
+        except Exception:
+            world = 1
+        if world > 1 and "bbox" in self._iou_types and self._packed_rows_present(state):
+            raise TPUMetricsUserError(
+                "Packed detection state cannot sync across eager ranks: per-rank "
+                "image-id spaces would collide in the cat-merge.  Use the "
+                "list-of-dicts update layout for eager DDP, or run the packed "
+                "layout as ONE global program on a GSPMD mesh."
+            )
+        return super()._sync_state_collect_inner(state, backend, reducer, group, out, pending)
+
+    @staticmethod
+    def _packed_rows_present(state) -> bool:
+        from tpumetrics.buffers import MaskedBuffer, _BufferList
+        from tpumetrics.utils.data import _is_tracer
+
+        for name in ("det_rows", "gt_rows"):
+            val = state.get(name)
+            if isinstance(val, _BufferList):
+                val = val.buffer
+            if isinstance(val, MaskedBuffer):
+                if _is_tracer(val.count):
+                    return True  # in-trace: emptiness unknowable — be strict
+                if int(val.count) > 0:  # eager sync context: host read is fine
+                    return True
+            elif isinstance(val, list) and val:
+                return True
+        return False
+
+    def _check_packed_overflow(self) -> None:
+        """A packed MaskedBuffer that dropped rows must fail loudly: mAP over
+        silently truncated detections is a wrong number, not an estimate."""
+        from tpumetrics.buffers import _BufferList, buffer_overflowed
+
+        if "bbox" not in self._iou_types:
+            return
+        for name in ("det_rows", "gt_rows"):
+            val = getattr(self, name)
+            if isinstance(val, _BufferList) and bool(buffer_overflowed(val.buffer)):
+                raise TPUMetricsUserError(
+                    f"Packed detection state {name!r} overflowed its declared "
+                    f"capacity {val.buffer.capacity} ({int(val.buffer.requested)} rows "
+                    "requested) — rows were dropped and mAP would be silently "
+                    "wrong.  Raise `det_capacity`/`gt_capacity`."
+                )
 
     def _add_per_class(self, out, prefix, result, detections, groundtruths, class_ids, i_type, geom_cache, max_det):
         """Per-class map/mar entries for one iou type (reference mean_ap.py:538-570)."""
@@ -761,21 +1248,13 @@ class MeanAveragePrecision(Metric):
                 # values only make sense macro-style (reference mean_ap.py
                 # recomputes them with average="macro"), keeping
                 # map_per_class aligned with the observed `classes`
-                per_class = coco_evaluate(
-                    detections,
-                    groundtruths,
-                    self.iou_thresholds,
-                    self.rec_thresholds,
-                    self.max_detection_thresholds,
-                    class_ids,
-                    average="macro",
-                    iou_type=i_type,
-                    geom_cache=geom_cache,
+                per_class, _cache = self._evaluate(
+                    detections, groundtruths, class_ids, i_type, "macro", geom_cache
                 )
             else:
                 per_class = result
-            out[f"{prefix}map_per_class"] = jnp.asarray(per_class["map_per_class"])
-            out[f"{prefix}mar_{max_det}_per_class"] = jnp.asarray(per_class["mar_per_class"])
+            out[f"{prefix}map_per_class"] = np.asarray(per_class["map_per_class"])
+            out[f"{prefix}mar_{max_det}_per_class"] = np.asarray(per_class["mar_per_class"])
         else:
-            out[f"{prefix}map_per_class"] = jnp.asarray(-1.0)
-            out[f"{prefix}mar_{max_det}_per_class"] = jnp.asarray(-1.0)
+            out[f"{prefix}map_per_class"] = np.asarray(-1.0, np.float32)
+            out[f"{prefix}mar_{max_det}_per_class"] = np.asarray(-1.0, np.float32)

@@ -329,27 +329,31 @@ class FusedCollectionStep:
     def _place_args(self, args: Tuple[Any, ...]) -> Tuple[Any, ...]:
         """Commit per-batch array arguments to the mesh: per-row arrays
         (leading dim divisible by the data-axis size) shard along
-        ``data_axis``, everything else replicates.  Host→device input
-        placement — never a device→host transfer, so a
+        ``data_axis``, everything else replicates.  Dict arguments (the
+        packed detection layout) place leaf-wise — every leaf shares the
+        batch axis, so each shards along it.  Host→device input placement —
+        never a device→host transfer, so a
         ``jax.transfer_guard_device_to_host`` around the update loop stays
         silent."""
         if self._mesh is None:
             return args
         world = int(self._mesh.shape[self._data_axis])
-        out = []
-        for a in args:
+
+        def place_one(a: Any) -> Any:
+            if isinstance(a, dict):
+                return {k: place_one(v) for k, v in a.items()}
             try:
                 arr = jnp.asarray(a)
             except (TypeError, ValueError):
-                out.append(a)  # host object (string, ...): untouched
-                continue
+                return a  # host object (string, ...): untouched
             spec = (
                 PartitionSpec(self._data_axis)
                 if arr.ndim >= 1 and arr.shape[0] > 1 and arr.shape[0] % world == 0
                 else PartitionSpec()
             )
-            out.append(jax.device_put(arr, NamedSharding(self._mesh, spec)))
-        return tuple(out)
+            return jax.device_put(arr, NamedSharding(self._mesh, spec))
+
+        return tuple(place_one(a) for a in args)
 
     def update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """One fused, donated state transition over an (unpadded) batch.

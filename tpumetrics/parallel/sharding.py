@@ -43,6 +43,14 @@ sum/mean/max/min array replicated ``P()`` (GSPMD inserts the psum)
 buffer ``values``      ``P(data_axis)`` on the capacity axis
 buffer count/requested replicated ``P()``
 ====================== ==========================================
+
+The packed detection states are the worked example of the buffer row:
+``MeanAveragePrecision``'s ``det_rows``/``gt_rows`` declare capacities, so
+:meth:`StatePartitionRules.for_metric` shards their ``values`` rows along
+``data_axis`` while the ``packed_imgs`` counter (a sum state) replicates —
+which is what lets the dense detection update run as one GSPMD program
+with zero host round trips (see ``docs/performance.md``,
+"Device-resident detection").
 """
 
 from __future__ import annotations

@@ -59,6 +59,16 @@ class NotBucketableError(TPUMetricsUserError):
     """The metric cannot take padded (bucketed) updates exactly."""
 
 
+def pow2_at_least(n: int, floor: int = 1) -> int:
+    """Smallest power of two >= max(n, floor) — the single-size counterpart
+    of :func:`pow2_bucket_edges`, shared by every pow-2 shape-bucketing site
+    (detection packing, the jitted matcher's cell grids)."""
+    e = max(int(floor), 1)
+    while e < n:
+        e *= 2
+    return e
+
+
 def pow2_bucket_edges(max_size: int, min_size: int = 1) -> Tuple[int, ...]:
     """Power-of-two bucket edges ``min_size..>=max_size`` (each edge doubles)."""
     if min_size <= 0 or max_size < min_size:
@@ -127,31 +137,70 @@ def pad_args_to(args: Sequence[Any], n: int, bucket: int) -> Tuple[Any, ...]:
     same row count differently)."""
     if bucket == n:
         return tuple(args)
-    out = []
-    for a in args:
-        if _is_per_row(a, n):
-            a = jnp.asarray(a)
-            pad = jnp.broadcast_to(a[0:1], (bucket - n,) + a.shape[1:])
-            out.append(jnp.concatenate([a, pad], axis=0))
-        else:
-            out.append(a)
-    return tuple(out)
+    return tuple(_pad_one(a, n, bucket) for a in args)
+
+
+def _pad_one(a: Any, n: int, bucket: int) -> Any:
+    if isinstance(a, dict):
+        return {k: _pad_one(v, n, bucket) for k, v in a.items()}
+    if not _is_per_row(a, n):
+        return a
+    a = jnp.asarray(a)
+    pad = jnp.broadcast_to(a[0:1], (bucket - n,) + a.shape[1:])
+    return jnp.concatenate([a, pad], axis=0)
 
 
 def _is_per_row(a: Any, n: int) -> bool:
+    """A per-row argument: an array with leading dim ``n``, or a **dict of
+    per-row arrays** (the packed detection layout — every leaf shares the
+    batch's image axis, so the whole dict pads/slices as one unit)."""
+    if isinstance(a, dict):
+        return bool(a) and all(_is_per_row(v, n) for v in a.values())
     return hasattr(a, "shape") and getattr(a, "ndim", 0) >= 1 and a.shape[0] == n
+
+
+def _slice_rows(a: Any, n: int, lo: int, hi: int) -> Any:
+    """Row-slice one argument (dict leaves slice together)."""
+    if isinstance(a, dict):
+        return {k: _slice_rows(v, n, lo, hi) for k, v in a.items()}
+    return a[lo:hi] if _is_per_row(a, n) else a
 
 
 def _args_signature(args: Sequence[Any]) -> Tuple[Any, ...]:
     """The (shape, dtype) tuple mirroring the jit cache key; python scalars
-    key by their weak result type."""
-    return tuple((tuple(jnp.shape(a)), str(jnp.result_type(a))) for a in args)
+    key by their weak result type, dict args by their sorted item specs."""
+    out = []
+    for a in args:
+        if isinstance(a, dict):
+            out.append(
+                ("dict",)
+                + tuple(
+                    (k, tuple(jnp.shape(v)), str(jnp.result_type(v)))
+                    for k, v in sorted(a.items())
+                )
+            )
+        else:
+            try:
+                out.append((tuple(jnp.shape(a)), str(jnp.result_type(a))))
+            except (TypeError, ValueError):
+                # not array-able (e.g. a list of per-image dicts): key by
+                # structure so the metric's own update can reject the layout
+                # with ITS typed, instructive error instead of an opaque
+                # dtype failure here
+                out.append(("opaque", type(a).__name__))
+    return tuple(out)
 
 
 def leading_rows(args: Sequence[Any]) -> int:
-    """The batch's row count: leading dim of the first per-row array, or 1
-    for scalar-only updates (aggregation metrics fed floats)."""
+    """The batch's row count: leading dim of the first per-row array (dicts:
+    their first array leaf), or 1 for scalar-only updates (aggregation
+    metrics fed floats)."""
     for a in args:
+        if isinstance(a, dict):
+            for _k, v in sorted(a.items()):
+                if hasattr(v, "shape") and getattr(v, "ndim", 0) >= 1:
+                    return int(v.shape[0])
+            continue
         if hasattr(a, "shape") and getattr(a, "ndim", 0) >= 1:
             return int(a.shape[0])
     return 1
@@ -183,7 +232,7 @@ def plan_bucketed_update(bucketer: "ShapeBucketer", args: Sequence[Any]):
     chunks = []
     offset = 0
     for size in bucketer.chunk_sizes(n):
-        chunk = tuple(a[offset : offset + size] if _is_per_row(a, n) else a for a in args)
+        chunk = tuple(_slice_rows(a, n, offset, offset + size) for a in args)
         padded, bucket = bucketer.pad_args(chunk, size)
         chunks.append(("masked", padded, bucket, size, (bucket,) + _args_signature(padded)))
         offset += size
@@ -209,12 +258,22 @@ def single_chunk_signature(
     if len(bucketer.chunk_sizes(n)) != 1:
         return None  # splits past the top edge: megabatch handles heads only
     bucket = bucketer.bucket_for(n)
-    parts = []
-    for a in args:
+
+    def padded_spec_leaf(a: Any):
         shape = tuple(jnp.shape(a))
         if _is_per_row(a, n):
             shape = (bucket,) + shape[1:]
-        parts.append((shape, str(jnp.result_type(a))))
+        return (shape, str(jnp.result_type(a)))
+
+    parts = []
+    for a in args:
+        if isinstance(a, dict):
+            parts.append(
+                ("dict",)
+                + tuple((k, *padded_spec_leaf(v)) for k, v in sorted(a.items()))
+            )
+        else:
+            parts.append(padded_spec_leaf(a))
     return bucket, n, (bucket,) + tuple(parts)
 
 
@@ -273,7 +332,7 @@ def _masked_metric_update(
 
     init = metric.init_state()
     after_all = metric.functional_update(metric.init_state(), *padded, **kwargs)
-    row0 = tuple(a[0:1] if _is_per_row(a, bucket) else a for a in padded)
+    row0 = tuple(_slice_rows(a, bucket, 0, 1) for a in padded)
     after_one = metric.functional_update(metric.init_state(), *row0, **kwargs)
 
     n_pad = jnp.asarray(bucket) - n_valid
