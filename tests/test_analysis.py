@@ -154,6 +154,72 @@ def test_host_telemetry_near_miss_negative():
     assert _codes(analyze_source(HOST_TELEMETRY_NEAR_MISS)) == []
 
 
+# ------------------------------------------------------------------- TPL105
+HOST_HEALTH_TP = _src(
+    """
+    import jax.numpy as jnp
+    from tpumetrics.metric import Metric
+    from tpumetrics.telemetry import health
+
+    class M(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, preds, target):
+            self.total = self.total + jnp.sum(preds)
+            summ = health.summarize(health.probe_tree({"total": self.total}))
+            if summ["nonfinite_total"]:
+                raise ValueError("poisoned")
+
+        def compute(self):
+            return self.total
+    """
+)
+
+HOST_HEALTH_NEAR_MISS = _src(
+    """
+    import jax.numpy as jnp
+    from tpumetrics.metric import Metric
+    from tpumetrics.telemetry import health
+    from tpumetrics.telemetry.health import probe_packed
+
+    class M(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, preds, target):
+            self.total = self.total + jnp.sum(preds)
+            # the PROBE is pure jnp and trace-safe by design: not a finding
+            self._last_probe = probe_packed({"total": self.total})
+
+        def compute(self):
+            # compute() is host-driven by contract: the READ belongs here
+            return self.total, health.summarize(self._last_probe, ["total"])
+
+    def runtime_helper(obj):
+        # a .summarize() method on an unknown receiver is NOT the health read
+        obj.summarize("not ours")
+    """
+)
+
+
+def test_host_health_read_in_update_true_positive():
+    found = analyze_source(HOST_HEALTH_TP)
+    assert "TPL105" in _codes(found)
+    # the trace-safe probe_tree inside the same call is NOT itself flagged
+    assert _codes(found).count("TPL105") == 1
+
+
+def test_host_health_read_near_miss_negative():
+    # in-update probes (pure jnp), compute()-side reads, and same-named
+    # methods on foreign objects must not trigger — the boundary is
+    # update()-reachability plus the import-resolved host-syncing names
+    found = analyze_source(HOST_HEALTH_NEAR_MISS)
+    assert "TPL105" not in _codes(found)
+
+
 def test_host_telemetry_reachable_helper_is_flagged():
     src = _src(
         """

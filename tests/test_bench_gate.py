@@ -24,6 +24,7 @@ def test_floor_file_shape():
         "multitenant_scaling",
         "resilience_overhead",
         "observability_overhead",
+        "device_observability",
         "elastic_restore",
         "monitoring_window",
     }
@@ -68,6 +69,11 @@ def test_floor_file_shape():
     # the always-on instruments to submit-path-cheap
     assert data["observability_overhead_ceilings"]["inert_span_ns_per_call"] > 0
     assert data["observability_overhead_ceilings"]["counter_ns_per_call"] > 0
+    # the device-observability gates (ISSUE 14 acceptance): the in-trace
+    # health probe must cost <5% step time — never raise past 1.05 — and
+    # the armed profile registry's per-dispatch check must stay cheap
+    assert data["device_observability_ceilings"]["health_probe_overhead_ratio"] <= 1.05
+    assert data["device_observability_ceilings"]["profile_lookup_ns_per_call"] > 0
     # the windowed-monitoring path must clearly beat the CatMetric-history
     # tail recompute (ISSUE 11 acceptance) and the sketch ingest must stay
     # scatter-add-cheap per row
@@ -174,6 +180,35 @@ def test_check_floors_flags_observability_regressions():
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and all("counter_ns_per_call" in v for v in violations)
     details["observability_overhead"] = "error: AssertionError: ring grew"
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and "scenario failed" in violations[0]
+
+
+def test_check_floors_flags_device_observability_regressions():
+    """A health probe that grew past 5% of step time (a second dispatch, a
+    per-step host sync) must trip the gate even at a healthy unprobed/
+    probed ratio; so must a profile-registry seen-check too slow for the
+    dispatch path, a ratio below the floor, and an errored scenario (the
+    bit-parity asserts never ran)."""
+    details = {
+        "device_observability": {
+            "vs_baseline": 1.0,
+            "health_probe_overhead_ratio": 1.5,
+            "profile_lookup_ns_per_call": 100.0,
+        }
+    }
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("health_probe_overhead_ratio" in v for v in violations)
+    details["device_observability"]["health_probe_overhead_ratio"] = 1.01
+    assert bench._check_floors(headline_vs=1000.0, details=details) == []
+    details["device_observability"]["profile_lookup_ns_per_call"] = 10**6
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("profile_lookup_ns_per_call" in v for v in violations)
+    details["device_observability"]["profile_lookup_ns_per_call"] = 100.0
+    details["device_observability"]["vs_baseline"] = 0.1  # below the 0.5 floor
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("device_observability" in v for v in violations)
+    details["device_observability"] = "error: AssertionError: parity broke"
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and "scenario failed" in violations[0]
 

@@ -1,0 +1,763 @@
+"""Device-side observability (ISSUE 14): program profiles, in-trace state
+health, HBM accounting, Perfetto export, and cross-rank straggler timelines.
+
+The acceptance spine lives in ``TestServiceAcceptance``: a 2-tenant service
+run with health probes armed exports (a) a Perfetto trace that validates
+round-trip with both tenants' batch spans, compile marks, and device
+(dispatch) slices present, and (b) after one tenant's stream is fed an
+``inf``, a ``state_health`` ledger event + nonzero
+``tpumetrics_state_nonfinite_total`` for that tenant BEFORE ``compute()``,
+with the neighbor tenant bit-identical to an unprobed run.  The straggler
+acceptance (a merged 2-rank timeline naming the deliberately-delayed rank)
+runs over synthesized per-rank JSONL in ``TestTimeline`` — the same files a
+soak writes, with a controlled delay.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics.aggregation import MeanMetric
+from tpumetrics.classification import MulticlassAccuracy
+from tpumetrics.parallel.fuse_update import FusedCollectionStep
+from tpumetrics.runtime import EvaluationService, StreamingEvaluator
+from tpumetrics.telemetry import device, export, health, instruments, ledger, spans, timeline, xla
+
+
+@pytest.fixture(autouse=True)
+def _device_observability_hygiene():
+    """Every test starts and ends with the device layer OFF and empty (the
+    test-local mirrors of the observability-suite hygiene): profiling
+    disabled + registry cleared, spans off, attribution off, global ledger
+    off."""
+    yield
+    device.disable_device_profiles()
+    device.reset_device_profiles()
+    spans.disable()
+    spans.reset()
+    xla.disable_compile_attribution()
+    ledger.disable()
+    export.disable_flight_recorder()
+
+
+def _acc(classes=4):
+    return MulticlassAccuracy(num_classes=classes, average="micro", validate_args=False)
+
+
+def _batch(classes=4, seed=0, rows=5):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.standard_normal((rows, classes)), jnp.float32),
+        jnp.asarray(rng.integers(0, classes, rows), jnp.int32),
+    )
+
+
+# ------------------------------------------------------------- health: units
+
+
+class TestHealthProbeUnits:
+    def test_float_nan_inf_saturation_counts(self):
+        arr = jnp.asarray([1.0, jnp.nan, jnp.inf, -jnp.inf, 2.0], jnp.float32)
+        vec = np.asarray(health.probe_tree(arr))
+        assert vec.tolist() == [1, 2, 0]
+
+    def test_float_saturation_near_dtype_max(self):
+        top = float(np.finfo(np.float32).max)
+        arr = jnp.asarray([top, -top, top * 0.5, 1.0], jnp.float32)
+        vec = np.asarray(health.probe_tree(arr))
+        assert vec.tolist() == [0, 0, 2]  # finite-but-at-the-edge only
+
+    def test_int_saturation_at_dtype_bounds(self):
+        ii = np.iinfo(np.int32)
+        arr = jnp.asarray([0, ii.max, ii.min, 7], jnp.int32)
+        vec = np.asarray(health.probe_tree(arr))
+        assert vec.tolist() == [0, 0, 2]
+
+    def test_bool_and_nonarray_probe_as_zero(self):
+        tree = health.probe_tree({"flag": jnp.asarray([True, False]), "label": "x"})
+        assert np.asarray(tree["flag"]).tolist() == [0, 0, 0]
+        assert np.asarray(tree["label"]).tolist() == [0, 0, 0]
+
+    def test_packed_matches_tree_and_paths(self):
+        state = {
+            "b": {"y": jnp.asarray([jnp.inf]), "x": jnp.asarray([1.0])},
+            "a": jnp.asarray([jnp.nan, 2.0]),
+        }
+        packed = np.asarray(health.probe_packed(state))
+        paths = health.state_paths(state)
+        assert paths == ["a", "b/x", "b/y"]  # sorted recursion order
+        flat = health.flatten(health.probe_tree(state))
+        assert [p for p, _ in flat] == paths
+        for i, (_path, vec) in enumerate(flat):
+            assert packed[i].tolist() == np.asarray(vec).tolist()
+
+    def test_summarize_packed_and_tree_agree(self):
+        state = {"m": jnp.asarray([jnp.nan, jnp.inf, 1.0])}
+        via_tree = health.summarize(health.probe_tree(state))
+        via_packed = health.summarize(
+            health.probe_packed(state), health.state_paths(state)
+        )
+        assert via_tree == via_packed
+        assert via_tree["nonfinite_total"] == 2
+        assert via_tree["per_state"]["m"] == {
+            "nan": 1, "inf": 1, "saturated": 0, "nonfinite": 2,
+        }
+
+    def test_masked_buffer_state_probes_per_field(self):
+        """NamedTuple state nodes (the MaskedBuffer kind backing
+        capacity-declared list states — PR 12's packed detection rows) probe
+        per FIELD with sharding-convention paths; the probed evaluator runs
+        end to end on such a metric (regression: the generator-rebuild form
+        crashed MaskedBuffer's positional constructor)."""
+        from tpumetrics.aggregation import CatMetric
+
+        m = CatMetric()
+        m.set_state_capacity("value", 16)
+        s = m.init_state()
+        assert health.state_paths(s) == ["value/values", "value/count", "value/requested"]
+        s = m.functional_update(s, jnp.asarray([1.0, np.inf]))
+        summ = health.summarize(health.probe_packed(s), health.state_paths(s))
+        assert summ["per_state"]["value/values"]["inf"] == 1
+
+        # the probed COMPILED step over the buffer state (where the
+        # generator-rebuild crash lived, at trace time inside _finish)
+        m2 = CatMetric()
+        m2.set_state_capacity("value", 16)
+        step = FusedCollectionStep(m2, donate=False, health_probe=True)
+        s2, h = step.update(m2.init_state(), jnp.asarray([2.0, 3.0]))
+        summ2 = health.summarize(h, health.state_paths(s2))
+        assert summ2["nonfinite_total"] == 0
+        assert "value/values" in summ2["per_state"]
+
+    def test_summarize_none_is_all_zero(self):
+        assert health.summarize(None) == {
+            "per_state": {}, "nonfinite_total": 0, "saturated_total": 0,
+        }
+
+
+# ----------------------------------------------------------- health: parity
+
+
+class TestHealthProbeParity:
+    def test_probed_step_state_is_bit_identical(self):
+        """THE parity contract: arming the probe changes not one state bit,
+        on both the update and the masked_update program."""
+        preds, target = _batch(rows=12, seed=3)
+        plain = FusedCollectionStep(_acc())
+        probed = FusedCollectionStep(_acc(), health_probe=True)
+        s_plain = plain.update(plain.init_state(), preds, target)
+        s_probed, h = probed.update(probed.init_state(), preds, target)
+        for key in s_plain:
+            assert np.array_equal(np.asarray(s_plain[key]), np.asarray(s_probed[key])), key
+        n_valid = jnp.asarray(12, jnp.int32)
+        s_plain2 = plain.masked_update(s_plain, (preds, target), n_valid, 16)
+        s_probed2, h2 = probed.masked_update(s_probed, (preds, target), n_valid, 16)
+        for key in s_plain2:
+            assert np.array_equal(np.asarray(s_plain2[key]), np.asarray(s_probed2[key])), key
+        # the probe output is the packed (N, 3) counter array, all clean
+        assert np.asarray(h2).shape == (len(health.state_paths(s_probed2)), 3)
+        assert int(np.asarray(h2).sum()) == 0
+
+    def test_probe_stays_on_device(self):
+        """The probed step's health output is a device array — nothing in
+        the dispatch path fetched it (zero extra device→host transfers)."""
+        preds, target = _batch(rows=8, seed=1)
+        probed = FusedCollectionStep(_acc(), health_probe=True)
+        with jax.transfer_guard_device_to_host("disallow"):
+            s, h = probed.update(probed.init_state(), preds, target)
+            s, h = probed.update(s, preds, target)
+        assert isinstance(h, jax.Array)
+
+    def test_megabatch_refuses_probe(self):
+        probed = FusedCollectionStep(_acc(), health_probe=True)
+        s = probed.init_state()
+        with pytest.raises(Exception, match="health_probe"):
+            probed.megabatch_update([s], [(jnp.zeros((4, 4)),)], [4], 4)
+
+
+# ------------------------------------------------------- evaluator integration
+
+
+class TestEvaluatorHealth:
+    def test_requires_buckets(self):
+        with pytest.raises(ValueError, match="health_probe"):
+            StreamingEvaluator(_acc(), health_probe=True)
+
+    def test_clean_stream_reads_zero(self):
+        ev = StreamingEvaluator(_acc(), buckets=[8], health_probe=True)
+        with ev:
+            ev.submit(*_batch(seed=0))
+            ev.flush()
+            st = ev.stats()
+        h = st["device"]["health"]
+        assert h is not None and h["nonfinite_total"] == 0
+        assert set(h["per_state"]) == {"fn", "fp", "tn", "tp"}
+
+    def test_poisoned_stream_pages_before_compute(self):
+        """Feed an inf: stats() after flush — BEFORE any compute() — must
+        surface a nonzero non-finite count, exactly ONE state_health ledger
+        event, and the per-(stream, state) gauge series."""
+        ledger.enable()
+        ledger.reset()
+        ev = StreamingEvaluator(MeanMetric(), buckets=[8], health_probe=True)
+        try:
+            ev.submit(jnp.asarray([1.0, 2.0]))
+            ev.submit(jnp.asarray([np.inf, 3.0]))
+            ev.flush()
+            stream = ev._stream
+            st = ev.stats()
+            h = st["device"]["health"]
+            assert h["nonfinite_total"] >= 1
+            # the bucketed masked path's delta correction turns the inf into
+            # nan (inf − inf); either way mean_value reads non-finite
+            assert h["per_state"]["mean_value"]["nonfinite"] >= 1
+            events = [r for r in ledger.get_ledger().records if r.kind == "state_health"]
+            assert len(events) == 1
+            assert events[0].extra["stream"] == stream
+            assert events[0].extra["state"] == "mean_value"
+            gauge = instruments.gauge(
+                instruments.STATE_NONFINITE, labels=("stream", "state")
+            )
+            assert gauge.value(stream, "mean_value") >= 1
+            # a second read latches: no duplicate event
+            ev.stats()
+            events = [r for r in ledger.get_ledger().records if r.kind == "state_health"]
+            assert len(events) == 1
+            # compute() still works (guard off) and the value is the inf
+            assert not np.isfinite(float(ev.compute()))
+        finally:
+            ev.close()
+        # close() released the minted series
+        assert gauge.value(stream, "mean_value") == 0.0
+        assert (stream, "mean_value") not in dict(gauge.collect())
+
+    def test_saturation_only_corruption_pages_too(self):
+        """A finite-but-saturated state is the EARLY warning the probe
+        exists for — it must latch a state_health event without waiting for
+        the value to actually overflow to inf."""
+        ledger.enable()
+        ledger.reset()
+        from tpumetrics.aggregation import SumMetric
+
+        # 0.995*max: past the 0.99 saturation fraction but still finite.
+        # bucket=1 so pad replication cannot double the value into inf —
+        # the point is a state that sits AT the edge without overflowing
+        top = float(np.finfo(np.float32).max) * 0.995
+        ev = StreamingEvaluator(SumMetric(), buckets=[1], health_probe=True)
+        try:
+            ev.submit(jnp.asarray([top]))  # sum_value sits at the f32 edge
+            ev.flush()
+            h = ev.stats()["device"]["health"]
+            assert h["nonfinite_total"] == 0, h
+            assert h["saturated_total"] >= 1, h
+            events = [r for r in ledger.get_ledger().records if r.kind == "state_health"]
+            assert len(events) == 1
+            assert events[0].extra["saturated"] >= 1
+        finally:
+            ev.close()
+
+    def test_probed_evaluator_bit_identical_to_unprobed(self):
+        batches = [_batch(seed=s, rows=6) for s in range(4)]
+        with StreamingEvaluator(_acc(), buckets=[8], health_probe=True) as probed, \
+                StreamingEvaluator(_acc(), buckets=[8]) as plain:
+            for b in batches:
+                probed.submit(*b)
+                plain.submit(*b)
+            v_probed, v_plain = probed.compute(), plain.compute()
+        assert float(v_probed) == float(v_plain)
+
+    def test_stats_after_close_does_not_remint_series(self):
+        """close() releases the device series; a later stats() read still
+        answers (the section is computed from live objects) but must not
+        re-mint the released gauge labels or re-page a past corruption."""
+        ledger.enable()
+        ledger.reset()
+        ev = StreamingEvaluator(MeanMetric(), buckets=[8], health_probe=True)
+        ev.submit(jnp.asarray([np.inf, 1.0]))
+        ev.flush()
+        stream = ev._stream
+        ev.stats()  # pages: one state_health event + the gauge series
+        ev.close()
+        gauge = instruments.gauge(
+            instruments.STATE_NONFINITE, labels=("stream", "state")
+        )
+        hbm_gauge = instruments.gauge(
+            instruments.STATE_HBM_BYTES, labels=("stream",)
+        )
+        assert (stream, "mean_value") not in dict(gauge.collect())
+        assert (stream,) not in dict(hbm_gauge.collect())
+        st = ev.stats()  # still answers, mints nothing, pages nothing
+        assert st["device"]["hbm"]["state_bytes"] > 0
+        assert (stream, "mean_value") not in dict(gauge.collect())
+        assert (stream,) not in dict(hbm_gauge.collect())
+        events = [r for r in ledger.get_ledger().records if r.kind == "state_health"]
+        assert len(events) == 1  # no re-page after close
+
+    def test_mesh_probe_bit_identical(self, mesh8):
+        """The probe composes with sharded execution mode: the counter
+        reductions ride the ONE global SPMD program, and the probed mesh
+        evaluator computes bit-identically to an unprobed single-device
+        one."""
+        rng = np.random.default_rng(0)
+        batches = [
+            (
+                jnp.asarray(rng.standard_normal((16, 4)), jnp.float32),
+                jnp.asarray(rng.integers(0, 4, 16)),
+            )
+            for _ in range(3)
+        ]
+        probed = StreamingEvaluator(_acc(), buckets=[16], mesh=mesh8, health_probe=True)
+        plain = StreamingEvaluator(_acc(), buckets=[16])
+        with probed, plain:
+            for b in batches:
+                probed.submit(*b)
+                plain.submit(*b)
+            probed.flush()
+            h = probed.stats()["device"]["health"]
+            assert h["nonfinite_total"] == 0
+            assert float(probed.compute()) == float(plain.compute())
+
+    def test_hbm_section_tracks_state_bytes(self):
+        ev = StreamingEvaluator(_acc(), buckets=[8])
+        with ev:
+            ev.submit(*_batch())
+            ev.flush()
+            sec = ev.stats()["device"]["hbm"]
+        # 4 int scalar states -> a small, nonzero, watermark >= current
+        assert sec["state_bytes"] > 0
+        assert sec["watermark_bytes"] >= sec["state_bytes"]
+
+    def test_hbm_section_eager_metric_and_collection(self):
+        """The EAGER path reads metric_state() — a method — per metric, and
+        a MetricCollection contributes every member (regression: the bound
+        method referenced as an attribute crashed collections and read 0
+        for plain metrics)."""
+        from tpumetrics.collections import MetricCollection
+
+        ev = StreamingEvaluator(_acc())  # buckets=None: eager
+        with ev:
+            ev.submit(*_batch())
+            ev.flush()
+            assert ev.stats()["device"]["hbm"]["state_bytes"] > 0
+        col = MetricCollection({"a": _acc(), "b": _acc()})
+        ev2 = StreamingEvaluator(col)
+        with ev2:
+            ev2.submit(*_batch())
+            ev2.flush()
+            assert ev2.stats()["device"]["hbm"]["state_bytes"] > 0
+        with EvaluationService() as svc:
+            h = svc.register("eager-hbm-tenant", MeanMetric())
+            h.submit(jnp.asarray([1.0, 2.0]))
+            h.flush()
+            assert h.stats()["device"]["hbm"]["state_bytes"] > 0
+
+
+# ------------------------------------------------------------ program profiles
+
+
+class TestDeviceProfileRegistry:
+    def test_disabled_hook_registers_nothing(self):
+        ev = StreamingEvaluator(_acc(), buckets=[8])
+        with ev:
+            ev.submit(*_batch())
+            ev.flush()
+        assert len(device.registry()) == 0
+
+    def test_armed_registry_attributes_and_resolves(self):
+        device.enable_device_profiles()
+        ev = StreamingEvaluator(_acc(), buckets=[8])
+        stream = ev._stream
+        with ev:
+            ev.submit(*_batch(rows=5))
+            ev.submit(*_batch(rows=5, seed=1))  # same signature: ONE profile
+            ev.flush()
+            profs = device.profiles(tenant=stream)
+            assert len(profs) == 1
+            assert profs[0]["flops"] > 0
+            assert profs[0]["label"].startswith("step:MulticlassAccuracy")
+            summary = device.profile_summary(stream)
+            assert summary["registered"] == 1 and summary["resolved"] == 1
+            assert summary["flops_per_step"] == profs[0]["flops"]
+            st = ev.stats()
+            assert st["device"]["programs"]["registered"] == 1
+            flops_gauge = instruments.gauge(
+                instruments.PROGRAM_FLOPS, labels=("tenant",)
+            )
+            assert flops_gauge.value(stream) > 0
+        # close() released the stream's profiles + gauge series
+        assert device.profiles(tenant=stream) == []
+        assert flops_gauge.value(stream) == 0.0
+
+    def test_stats_never_resolves(self):
+        """stats() must not pay an XLA compile: it reports the registered
+        count but resolves nothing."""
+        device.enable_device_profiles()
+        ev = StreamingEvaluator(_acc(), buckets=[8])
+        with ev:
+            ev.submit(*_batch())
+            ev.flush()
+            sec = ev.stats()["device"]["programs"]
+            assert sec["registered"] == 1
+            assert sec["resolved"] == 0  # lazy until an explicit reader asks
+            assert sec["flops_per_step"] == 0
+
+    def test_distinct_signatures_register_separately(self):
+        device.enable_device_profiles()
+        ev = StreamingEvaluator(_acc(), buckets=[4, 8])
+        with ev:
+            ev.submit(*_batch(rows=3))
+            ev.submit(*_batch(rows=7))  # different bucket -> different program
+            ev.flush()
+            assert len(device.registry()) == 2
+
+    def test_registry_is_bounded(self):
+        reg = device.ProfileRegistry(capacity=2)
+        for i in range(5):
+            reg.register(f"p{i}", object(), (jnp.zeros((i + 1,)),))
+        assert len(reg) == 2
+        assert reg.registered == 5 and reg.evictions == 3
+
+    def test_newest_tracks_recency_of_dispatch(self):
+        """newest() means most recently DISPATCHED, not first-seen — the
+        last_cost_analysis semantics the matcher's bench read replaced
+        (regression: an early-return on a known key froze recency, so
+        A, B, A-again answered B)."""
+        reg = device.ProfileRegistry(capacity=8)
+        prog_a, prog_b = object(), object()
+        reg.register("m", prog_a, (jnp.zeros((2,)),))
+        reg.register("m", prog_b, (jnp.zeros((4,)),))
+        assert reg.newest("m")._program is prog_b
+        reg.register("m", prog_a, (jnp.zeros((2,)),))  # A dispatches again
+        assert reg.newest("m")._program is prog_a
+
+    def test_matcher_registers_under_shared_label(self):
+        """The detection matcher feeds the SAME registry (no private
+        last_cost_analysis variant): one small jitted evaluation registers
+        a resolvable profile under its label."""
+        from tpumetrics.detection import _coco_eval_jax
+        from tpumetrics.detection.mean_ap import _torch_f32_linspace
+
+        rng = np.random.default_rng(5)
+
+        def boxes(n):
+            xy = rng.uniform(0, 40, (n, 2))
+            wh = rng.uniform(2, 20, (n, 2))
+            return np.concatenate([xy, xy + wh], 1).astype(np.float64)
+
+        dets = [
+            (boxes(4), rng.random(4).astype(np.float32), rng.integers(0, 2, 4).astype(np.int64))
+        ]
+        gts = [
+            (boxes(3), rng.integers(0, 2, 3).astype(np.int64),
+             np.zeros(3, np.int64), np.zeros(3, np.float64))
+        ]
+        got = _coco_eval_jax.coco_evaluate_jit(
+            dets, gts,
+            _torch_f32_linspace(0.5, 0.95, 10), _torch_f32_linspace(0.0, 1.0, 101),
+            [1, 10, 100], [0, 1],
+        )
+        assert got is not None
+        prof = device.registry().newest(_coco_eval_jax.MATCHER_PROFILE_LABEL)
+        assert prof is not None
+        resolved = prof.resolve()
+        assert resolved["flops"] > 0, resolved
+
+
+# -------------------------------------------------------- perfetto round-trip
+
+
+def _validate_perfetto(trace, span_dicts, record_dicts):
+    """The round-trip validator: valid trace-event JSON, monotone ts, every
+    span/ledger record represented exactly once, process metadata per pid."""
+    parsed = json.loads(json.dumps(trace))  # valid JSON end to end
+    events = parsed["traceEvents"]
+    meta = [e for e in events if e.get("ph") == "M"]
+    body = [e for e in events if e.get("ph") != "M"]
+    # monotone timestamps
+    ts = [e["ts"] for e in body]
+    assert ts == sorted(ts)
+    # one process_name per pid present in the body
+    assert {e["pid"] for e in meta} == {e["pid"] for e in body}
+    # every span exactly once (matched by its unique span id)
+    span_events = [e for e in body if e.get("cat") == "span"]
+    assert sorted(e["args"]["span"] for e in span_events) == sorted(
+        s["span"] for s in span_dicts
+    )
+    # every ledger record exactly once (compile marks + slices + instants)
+    ledger_events = [e for e in body if e.get("cat") in ("compile", "collective", "ledger")]
+    assert len(ledger_events) == len(record_dicts)
+    return parsed
+
+
+class TestPerfettoRoundTrip:
+    def test_spans_and_ledger_each_exactly_once(self):
+        spans.enable()
+        ledger.enable()
+        ledger.reset()
+        with spans.span("batch", stream="t0"):
+            with spans.span("dispatch", bucket=8):
+                pass
+        ledger.record_event(None, "xla_compile", tenant="t0", seconds=0.25)
+        ledger.record_collective(
+            None, "all_reduce", "sum", (4, 4), "float32", 4, world_size=4
+        )
+        ledger.record_event(None, "drain_requested", stream="t0")
+        span_dicts = [s.to_dict() for s in spans.spans()]
+        record_dicts = [r.to_dict() for r in ledger.get_ledger().records]
+        trace = export.perfetto_trace()
+        parsed = _validate_perfetto(trace, span_dicts, record_dicts)
+        body = [e for e in parsed["traceEvents"] if e.get("ph") != "M"]
+        # the tenant track: both spans ride the root's stream label
+        assert {e["tid"] for e in body if e.get("cat") == "span"} == {"t0"}
+        # the compile mark is a real slice with the event's duration
+        compile_marks = [e for e in body if e.get("cat") == "compile"]
+        assert len(compile_marks) == 1 and compile_marks[0]["dur"] == 0.25 * 1e6
+        # the collective is a visible device slice
+        assert any(e["cat"] == "collective" for e in body)
+
+    def test_file_target_writes_json(self, tmp_path):
+        spans.enable()
+        with spans.span("batch", stream="t0"):
+            pass
+        path = str(tmp_path / "trace.json")
+        out = export.perfetto_trace(path, record_list=[])
+        assert out == path
+        with open(path) as fh:
+            parsed = json.load(fh)
+        assert any(e.get("cat") == "span" for e in parsed["traceEvents"])
+
+
+# ----------------------------------------------------- cross-rank timelines
+
+
+def _write_rank_stream(directory, rank, epoch, records):
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"epoch{epoch:03d}-rank{rank:05d}.jsonl")
+    with open(path, "a") as fh:
+        for rec in records:
+            fh.write(json.dumps(rec) + "\n")
+    return path
+
+
+def _barrier_rec(step, mono_ns, wall_ns, **extra):
+    return {
+        "kind": "elastic_barrier", "op": "elastic_barrier", "dtype": "",
+        "shape": [], "element_count": 0, "payload_bytes": 0, "wire_bytes": 0.0,
+        "backend": "FileBarrierBackend", "tag": "", "world_size": 2,
+        "in_trace": False, "source": "event", "extra": {"step": step, **extra},
+        "mono_ns": mono_ns, "wall_ns": wall_ns,
+    }
+
+
+class TestTimeline:
+    WALL0 = 1_700_000_000_000_000_000
+
+    def _two_rank_dir(self, tmp_path, delay_ns=40_000_000):
+        """Two ranks, one epoch: rank 1 deliberately enters every barrier
+        ``delay_ns`` late, and its process has a DIFFERENT monotonic epoch
+        (the cross-process alignment the wall anchor exists for)."""
+        tel = str(tmp_path / "telemetry")
+        for rank, (mono0, delay) in enumerate([(3_000_000_000, 0), (11_000_000_000, delay_ns)]):
+            recs = [
+                _barrier_rec(
+                    step + 1,
+                    mono0 + step * 500_000_000 + delay,
+                    self.WALL0 + step * 500_000_000 + delay,
+                    rank=rank,
+                )
+                for step in range(3)
+            ]
+            _write_rank_stream(tel, rank, 0, recs)
+        return tel
+
+    def test_merge_aligns_across_monotonic_epochs(self, tmp_path):
+        tel = self._two_rank_dir(tmp_path)
+        tl = timeline.merge_timelines(tel)
+        assert tl.ranks == [0, 1]
+        assert len(tl.events) == 6
+        # despite wildly different mono bases, same-window events are close
+        per_rank = tl.by_rank()
+        gap = abs(per_rank[1][0]["t_global_ns"] - per_rank[0][0]["t_global_ns"])
+        assert gap == 40_000_000
+
+    def test_straggler_names_the_delayed_rank(self, tmp_path):
+        """THE straggler acceptance: a 2-rank timeline with one
+        deliberately-delayed rank names that rank in the report."""
+        tel = self._two_rank_dir(tmp_path, delay_ns=40_000_000)
+        tl = timeline.merge_timelines(tel)
+        report = timeline.straggler_report(tl)
+        assert report["straggler"] == 1
+        assert report["n_windows"] == 3
+        assert all(w["slowest_rank"] == 1 for w in report["windows"])
+        assert 39.0 < report["max_skew_ms"] < 41.0
+        text = timeline.render_report(tl, report)
+        assert "straggler: rank 1" in text
+
+    def test_occurrence_keyed_windows_without_step(self, tmp_path):
+        tel = str(tmp_path / "telemetry")
+        for rank, delay in ((0, 0), (1, 10_000_000)):
+            recs = []
+            for i in range(2):
+                rec = _barrier_rec(0, 1_000_000_000 * (i + 1) + delay,
+                                   self.WALL0 + 1_000_000_000 * (i + 1) + delay)
+                rec["extra"] = {}  # no step: k-th occurrence matching
+                recs.append(rec)
+            _write_rank_stream(tel, rank, 0, recs)
+        report = timeline.straggler_report(timeline.merge_timelines(tel))
+        assert report["n_windows"] == 2 and report["straggler"] == 1
+
+    def test_to_perfetto_one_process_per_rank(self, tmp_path):
+        tel = self._two_rank_dir(tmp_path)
+        tl = timeline.merge_timelines(tel)
+        trace = timeline.to_perfetto(tl)
+        body = [e for e in trace["traceEvents"] if e.get("ph") != "M"]
+        meta = [e for e in trace["traceEvents"] if e.get("ph") == "M"]
+        assert {e["pid"] for e in body} == {0, 1}
+        assert {(e["pid"], e["args"]["name"]) for e in meta} == {
+            (0, "rank 0"), (1, "rank 1"),
+        }
+        assert len(body) == 6  # every record exactly once
+        ts = [e["ts"] for e in body]
+        assert ts == sorted(ts)
+
+    def test_cli_report_subcommand(self, tmp_path, capsys):
+        from tpumetrics.soak.cli import main as cli_main
+
+        self._two_rank_dir(tmp_path)
+        trace_path = str(tmp_path / "soak.trace.json")
+        rc = cli_main(["report", str(tmp_path), "--perfetto", trace_path])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "straggler: rank 1" in out
+        with open(trace_path) as fh:
+            parsed = json.load(fh)
+        assert parsed["traceEvents"]
+        # --json mode emits the machine-readable report
+        rc = cli_main(["report", str(tmp_path), "--json"])
+        assert rc == 0
+        rep = json.loads(capsys.readouterr().out)
+        assert rep["straggler"] == 1
+
+    def test_cli_report_empty_dir_is_usage_error(self, tmp_path, capsys):
+        from tpumetrics.soak.cli import main as cli_main
+
+        rc = cli_main(["report", str(tmp_path / "nothing-here")])
+        assert rc == 2
+
+    def test_cli_report_io_error_is_usage_error(self, tmp_path, capsys):
+        """An unwritable --perfetto target exits 2 with a clean error line,
+        like generate/run do for the same failure class — never a
+        traceback."""
+        from tpumetrics.soak.cli import main as cli_main
+
+        self._two_rank_dir(tmp_path)
+        rc = cli_main([
+            "report", str(tmp_path),
+            "--perfetto", str(tmp_path / "no-such-dir" / "out.json"),
+        ])
+        assert rc == 2
+        assert "error:" in capsys.readouterr().err
+
+
+# -------------------------------------------------------------- clock pairs
+
+
+class TestClockPairs:
+    def test_ledger_records_carry_both_clocks(self):
+        ledger.enable()
+        ledger.reset()
+        ledger.record_event(None, "drain_requested", stream="s")
+        rec = ledger.get_ledger().records[-1]
+        assert rec.mono_ns > 0 and rec.wall_ns > 0
+        d = rec.to_dict()
+        assert d["mono_ns"] == rec.mono_ns and d["wall_ns"] == rec.wall_ns
+
+    def test_spans_carry_wall_anchor(self):
+        spans.enable()
+        with spans.span("x"):
+            pass
+        sp = spans.spans()[-1]
+        assert sp.wall_ns > 0
+        assert sp.to_dict()["wall_ns"] == sp.wall_ns
+        # the pair is consistent: wall anchor ~ now (within a minute)
+        import time as _time
+
+        assert abs(sp.wall_ns - _time.time_ns()) < 60 * 1e9
+
+
+# ------------------------------------------------------- acceptance: service
+
+
+class TestServiceAcceptance:
+    def test_two_tenant_probed_service_acceptance(self, tmp_path):
+        """ISSUE 14 acceptance (a)+(b): 2 probed tenants; a Perfetto trace
+        with both tenants' batch spans, compile marks, and device slices;
+        the poisoned tenant pages BEFORE compute; the clean neighbor is
+        bit-identical to an unprobed functional run."""
+        spans.enable(capacity=8192)
+        spans.reset()
+        ledger.enable()
+        ledger.reset()
+        xla.enable_compile_attribution()
+        xla.reset_compile_attribution()
+        clean_batches = [_batch(seed=s, rows=6) for s in range(3)]
+        with EvaluationService() as svc:
+            ha = svc.register("acc-clean", _acc(), buckets=[8], health_probe=True)
+            hb = svc.register("mean-poison", MeanMetric(), buckets=[8], health_probe=True)
+            for b in clean_batches:
+                ha.submit(*b)
+            hb.submit(jnp.asarray([1.0, 2.0]))
+            hb.submit(jnp.asarray([np.inf, 3.0]))
+            ha.flush()
+            hb.flush()
+
+            # (b) the poisoned tenant pages BEFORE compute()
+            st_b = hb.stats()
+            assert st_b["device"]["health"]["nonfinite_total"] >= 1
+            events = [
+                r for r in ledger.get_ledger().records if r.kind == "state_health"
+            ]
+            assert len(events) == 1
+            assert events[0].extra["stream"] == "mean-poison"
+            gauge = instruments.gauge(
+                instruments.STATE_NONFINITE, labels=("stream", "state")
+            )
+            assert gauge.value("mean-poison", "mean_value") >= 1
+            # the clean neighbor reads clean
+            assert ha.stats()["device"]["health"]["nonfinite_total"] == 0
+
+            # neighbor bit-identity vs an UNPROBED functional run
+            m = _acc()
+            s = m.init_state()
+            for p, t in clean_batches:
+                s = m.functional_update(s, p, t)
+            assert float(ha.compute()) == float(m.functional_compute(s))
+
+            # (a) the Perfetto trace round-trips with both tenants' batch
+            # spans, compile marks, and device (dispatch) slices
+            span_dicts = [sp.to_dict() for sp in spans.spans()]
+            record_dicts = [r.to_dict() for r in ledger.get_ledger().records]
+            trace = export.perfetto_trace(
+                span_list=spans.spans(),
+                record_list=ledger.get_ledger().records,
+            )
+            parsed = _validate_perfetto(trace, span_dicts, record_dicts)
+            body = [e for e in parsed["traceEvents"] if e.get("ph") != "M"]
+            batch_tracks = {
+                e["tid"] for e in body if e.get("cat") == "span" and e["name"] == "batch"
+            }
+            assert {"acc-clean", "mean-poison"} <= batch_tracks
+            dispatch_tracks = {
+                e["tid"] for e in body if e.get("cat") == "span" and e["name"] == "dispatch"
+            }
+            assert {"acc-clean", "mean-poison"} <= dispatch_tracks
+            assert any(e.get("cat") == "compile" for e in body), (
+                "no compile marks in the trace despite attributed compiles"
+            )

@@ -486,6 +486,16 @@ class TestEvaluatorObservability:
         # the new sections only ADD keys
         assert set(st["latency"]) == {"submit_ms", "dispatch_ms"}
         assert isinstance(st["recompiles"], int)
+        # PR 13: the device section (program profiles, HBM, health) is now
+        # part of the contract too
+        assert set(st["device"]) == {"programs", "hbm", "health"}
+        assert set(st["device"]["programs"]) == {
+            "registered", "resolved", "flops_per_step", "program_hbm_bytes",
+            "errors",
+        }
+        assert set(st["device"]["hbm"]) == {"state_bytes", "watermark_bytes"}
+        assert st["device"]["hbm"]["state_bytes"] > 0
+        assert st["device"]["health"] is None  # probe not armed here
 
     def test_disabled_tracing_records_nothing_during_streaming(self):
         spans.disable()
@@ -795,6 +805,10 @@ class TestServiceObservability:
         assert set(st["latency"]) == {"submit_ms", "dispatch_ms"}
         assert st["latency"]["submit_ms"]["count"] == 1
         assert isinstance(st["recompiles"], int)
+        # PR 13: the device section is part of the contract too
+        assert set(st["device"]) == {"programs", "hbm", "health"}
+        assert st["device"]["hbm"]["state_bytes"] > 0
+        assert st["device"]["health"] is None  # probe not armed here
 
     def test_megabatched_batches_still_trace_completely(self):
         """Co-served (vmapped group) batches get the same four children —

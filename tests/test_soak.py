@@ -193,6 +193,11 @@ def test_chaos_soak_short(tmp_path):
         assert rec["verify"]["cut_step"] >= 0  # bit-identity ran (it raises on mismatch)
         assert rec["ledger_restore_events"] == rec["world_after"]
         assert rec["flight_dump"] and os.path.isfile(rec["flight_dump"])
+        # PR 13: every incident line carries the merged-timeline straggler
+        # summary (cross-rank barrier windows exist once a cut happened)
+        assert rec["straggler"] is not None and "error" not in rec["straggler"], rec
+        assert rec["straggler"]["n_windows"] >= 1
+        assert rec["straggler"]["straggler"] is not None
         if rec["kind"] == "sigterm" or not rec["abrupt"]:
             for fl in rec["drain_flights"]:
                 assert fl and os.path.isfile(fl)

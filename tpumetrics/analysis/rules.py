@@ -16,6 +16,12 @@ TPL104 host-telemetry          a ``telemetry.spans``/``telemetry.instruments`` c
                                host-side effects that run at trace time only under jit
                                (and re-run on every retrace); instrument the runtime
                                seams instead
+TPL105 host-health-read        a host-SYNCING ``telemetry.health`` read (``summarize``/
+                               ``publish_health``/``release_health``) in ``update()``-
+                               reachable code — it ``device_get``\\ s the probe counters,
+                               forcing a device sync per step; the trace-safe probe
+                               (``probe_tree``/``probe_packed``) belongs in the step
+                               program, the READ belongs on the compute()/stats() seam
 TPL201 divergent-collective    a collective (``sync``/``all_reduce``/``all_gather``/
                                ``flush``/…) reachable on only one branch of a rank- or
                                data-dependent conditional — the static complement of the
@@ -74,6 +80,7 @@ CATALOG: Dict[str, Tuple[str, str]] = {
     "TPL101": ("host-transfer", "host transfer of a traced value reachable from update()"),
     "TPL102": ("traced-branch", "Python control flow on a traced value reachable from update()"),
     "TPL104": ("host-telemetry", "span/instrument call in update()-reachable metric code"),
+    "TPL105": ("host-health-read", "host-syncing health read in update()-reachable metric code"),
     "TPL201": (
         "divergent-collective",
         "collective reachable on only one branch of a rank- or data-dependent conditional",
@@ -1175,6 +1182,60 @@ class HostTelemetryRule:
         return False
 
 
+#: host-SYNCING entry points of the health layer: each fetches the device
+#: counters (device_get).  The trace-safe probes (probe_tree/probe_packed/
+#: state_paths/flatten) are deliberately NOT listed — they are pure jnp and
+#: belong inside step programs.
+_TPL105_SYNC_NAMES = {"summarize", "publish_health", "release_health"}
+_TPL105_MODULE = "tpumetrics.telemetry.health"
+
+
+class HostHealthReadRule:
+    """TPL105: host-syncing health reads in ``update()``-reachable code.
+
+    The health layer splits sharply in two: the *probe*
+    (``health.probe_tree``/``probe_packed``) is pure ``jnp`` and designed to
+    run inside the step program, while the *read*
+    (``health.summarize`` and the publish/release plumbing) calls
+    ``jax.device_get`` — a device sync.  A read reachable from ``update()``
+    would stall the stream once per step, exactly the host round-trip the
+    paper contract forbids; reads belong on the ``compute()``/``stats()``
+    seam, where the runtime already fetches results.  (The structural twin
+    of TPL104, specialized to the health module's split contract.)"""
+
+    codes = ("TPL105",)
+
+    def check(self, mod: ModuleInfo, index: PackageIndex) -> Iterator[Finding]:
+        funcs: List[FuncInfo] = list(mod.functions.values())
+        for ci in mod.classes.values():
+            funcs.extend(ci.methods.values())
+        for fi in funcs:
+            if not index.is_update_reachable(fi.node):
+                continue
+            for n in ast.walk(fi.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                dotted = _import_resolved_dotted(n.func, mod)
+                if dotted is None or not self._is_sync_read(dotted):
+                    continue
+                yield Finding(
+                    "TPL105",
+                    f"host-syncing health read `{_truncate(n)}` in update()-"
+                    "reachable code: it device_gets the probe counters, "
+                    "stalling the stream once per step. The in-trace probe "
+                    "(health.probe_tree/probe_packed) belongs in the step "
+                    "program; read the counters on the compute()/stats() "
+                    "seam instead.",
+                    mod.path, n.lineno, n.col_offset, symbol=fi.qualname,
+                )
+
+    @staticmethod
+    def _is_sync_read(dotted: str) -> bool:
+        if dotted.startswith(_TPL105_MODULE + "."):
+            return dotted.rpartition(".")[2] in _TPL105_SYNC_NAMES
+        return False
+
+
 class PartitionRuleDeclRule:
     """TPL304: literal ``StatePartitionRules`` patterns that match no state
     declared anywhere in the analyzed package.
@@ -1372,6 +1433,7 @@ class WindowedWindowRule:
 RULES = [
     TraceSafetyRule(),
     HostTelemetryRule(),
+    HostHealthReadRule(),
     StateDeclRule(),
     ShadowStateRule(),
     PartitionRuleDeclRule(),

@@ -70,7 +70,13 @@ MATCH_BUDGET = 1 << 26
 _ENABLED = True
 
 _PROGRAMS: Dict[Tuple, Callable] = {}
-_LAST_CALL: Optional[Tuple[Callable, Tuple]] = None
+
+#: the matcher's identity in the shared device-profile registry
+#: (:mod:`tpumetrics.telemetry.device`): every distinct compiled matcher
+#: program registers its abstract call signature there, and the bench's MFU
+#: accounting reads the newest profile under this label — ONE code path for
+#: program cost, no detection-private ``last_cost_analysis`` variant
+MATCHER_PROFILE_LABEL = "detection/coco_matcher"
 
 
 def jit_matcher_enabled() -> bool:
@@ -493,7 +499,6 @@ def coco_evaluate_packed(
 ) -> Optional[Dict[str, np.ndarray]]:
     """Evaluate packed flat rows (the device-resident state layout) through
     the jitted program; ``None`` over budget (caller falls back)."""
-    global _LAST_CALL
     import jax
     from jax.experimental import enable_x64
 
@@ -565,13 +570,14 @@ def coco_evaluate_packed(
             device,
         )
         precision_d, recall_d, npig_d = jax.device_get(program(*args))
-    # record only ABSTRACT input specs for the bench's cost analysis: holding
-    # the concrete args would pin the dense device grids (potentially
-    # MATCH_BUDGET-scale) in memory for the rest of the process
-    _LAST_CALL = (
-        program,
-        tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args),
-    )
+    # register the program in the SHARED device-profile registry (only the
+    # abstract input specs are retained — holding the concrete args would
+    # pin the dense device grids, potentially MATCH_BUDGET-scale, in memory
+    # for the rest of the process); the cost/memory analysis resolves
+    # lazily on the reader's thread (bench MFU, stats()["device"])
+    from tpumetrics.telemetry import device as _device
+
+    _device.register_program(MATCHER_PROFILE_LABEL, program, args, x64=True)
 
     # ---- host assembly into the COCO (T, R, K, A, M) / (T, K, A, M) layout
     num_thrs, num_rec, num_areas, n_m = len(iou_thrs), len(rec_thrs), len(area_names), len(max_dets)
@@ -591,21 +597,3 @@ def coco_evaluate_packed(
         precision, recall, np.asarray(iou_thrs), class_arr.tolist(), eval_class_ids,
         area_names, list(max_dets), {}, False,
     )
-
-
-def last_cost_analysis() -> Optional[Dict[str, float]]:
-    """XLA ``cost_analysis`` of the most recently executed matcher program
-    (bench accounting: real compiled-flops instead of an analytic guess)."""
-    from jax.experimental import enable_x64
-
-    if _LAST_CALL is None:
-        return None
-    program, args = _LAST_CALL
-    try:
-        with enable_x64():
-            cost = program.lower(*args).compile().cost_analysis()
-        if isinstance(cost, list):  # older jaxlibs return [dict]
-            cost = cost[0] if cost else None
-        return dict(cost) if cost else None
-    except Exception:
-        return None

@@ -42,6 +42,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from tpumetrics.telemetry import device as _device
+from tpumetrics.telemetry import health as _health
 from tpumetrics.telemetry import ledger as _telemetry
 from tpumetrics.telemetry import xla as _xla
 from tpumetrics.utils.exceptions import TPUMetricsUserError
@@ -157,6 +159,15 @@ class FusedCollectionStep:
             states replicated, ``cat``/buffer rows sharded on ``data_axis``).
         data_axis: mesh axis the batch (and concat-style states) shard
             along; defaults to the mesh's first axis name.
+        health_probe: append :func:`tpumetrics.telemetry.health.probe_tree`
+            (pure ``jnp`` NaN/inf/saturation reductions over the NEW state)
+            to every compiled step.  Probed :meth:`update`/
+            :meth:`masked_update` return ``(state, health)`` — the health
+            pytree stays on device; nothing extra crosses to the host.  The
+            state transition itself is untouched, so probed and unprobed
+            steps produce bit-identical state (the parity contract).
+            Megabatch grouping is excluded (per-dispatch probe results are
+            per-tenant state, which the group path does not unstack).
 
     One Python-visible program exists per (static kwargs, bucket) key;
     within a program XLA still specializes per input trace signature, which
@@ -173,6 +184,7 @@ class FusedCollectionStep:
         mesh: Optional[Mesh] = None,
         partition_rules: Optional[Any] = None,
         data_axis: Optional[str] = None,
+        health_probe: bool = False,
     ) -> None:
         from tpumetrics.collections import MetricCollection
         from tpumetrics.metric import Metric
@@ -217,6 +229,7 @@ class FusedCollectionStep:
         self._leaders: Optional[List[str]] = leaders
         self._update_kwargs = dict(update_kwargs or {})
         self._donate = bool(donate)
+        self._health = bool(health_probe)
         self._programs: Dict[Any, Callable] = {}
 
     # ------------------------------------------------------------- properties
@@ -229,6 +242,12 @@ class FusedCollectionStep:
     @property
     def donate(self) -> bool:
         return self._donate
+
+    @property
+    def health_probe(self) -> bool:
+        """Whether step programs also emit an on-device health counter tree
+        (probed :meth:`update`/:meth:`masked_update` return a 2-tuple)."""
+        return self._health
 
     @property
     def mesh(self) -> Optional[Mesh]:
@@ -326,6 +345,17 @@ class FusedCollectionStep:
                 )
         return self._rules.constrain(self._mesh, out) if sharded else out
 
+    def _finish(self, out: Dict[str, Any]) -> Any:
+        """Traced tail of every single-tenant program: with the health probe
+        armed, append the pure-``jnp`` counter reductions over the NEW state
+        (same XLA program, outputs stay on device) and return the pair.  The
+        counters ship PACKED — one ``(N, 3)`` buffer regardless of how many
+        states the collection holds (``health.state_paths`` names the rows),
+        so the probe adds one output handle to the dispatch, not N."""
+        if self._health:
+            return out, _health.probe_packed(out)
+        return out
+
     def _place_args(self, args: Tuple[Any, ...]) -> Tuple[Any, ...]:
         """Commit per-batch array arguments to the mesh: per-row arrays
         (leading dim divisible by the data-axis size) shard along
@@ -389,7 +419,8 @@ class FusedCollectionStep:
         if program is None:
             donate = (0,) if self._donate else ()
             program = jax.jit(
-                lambda s, a: self._transition(s, a, merged), donate_argnums=donate
+                lambda s, a: self._finish(self._transition(s, a, merged)),
+                donate_argnums=donate,
             )
             self._programs[key] = program
             if len(self._programs) == _PROGRAM_CACHE_WARN:
@@ -406,8 +437,12 @@ class FusedCollectionStep:
         # context still names the step + program key for any compile it
         # fires (signature None: one program re-specializes per shape, so
         # retrace detection is the runtime callers' richer context's job)
-        with _xla.fallback_attribution(None, label=self._compile_label(key)):
-            return program(state, self._place_args(tuple(args)))
+        placed = self._place_args(tuple(args))
+        label = self._compile_label(key)
+        if _device.profiling_enabled():
+            _device.note_dispatch(label, program, (state, placed))
+        with _xla.fallback_attribution(None, label=label):
+            return program(state, placed)
 
     def masked_update(
         self, state: Dict[str, Any], padded: Tuple[Any, ...], n_valid: Array, bucket: int
@@ -438,12 +473,18 @@ class FusedCollectionStep:
                     s = self._rules.constrain(self._mesh, s)
                     self._record_implied_collectives(s)
                 out = masked_functional_update(metric, s, p, n, int(bucket), kwargs)
-                return self._rules.constrain(self._mesh, out) if sharded else out
+                return self._finish(
+                    self._rules.constrain(self._mesh, out) if sharded else out
+                )
 
             program = jax.jit(run, donate_argnums=donate)
             self._programs[key] = program
-        with _xla.fallback_attribution(None, label=self._compile_label(key)):
-            return program(state, self._place_args(tuple(padded)), n_valid)
+        placed = self._place_args(tuple(padded))
+        label = self._compile_label(key)
+        if _device.profiling_enabled():
+            _device.note_dispatch(label, program, (state, placed, n_valid))
+        with _xla.fallback_attribution(None, label=label):
+            return program(state, placed, n_valid)
 
     def megabatch_update(
         self,
@@ -481,6 +522,12 @@ class FusedCollectionStep:
                 "megabatch_update is single-device-mode only: sharded states "
                 "already run as one global SPMD program per tenant."
             )
+        if self._health:
+            raise TPUMetricsUserError(
+                "megabatch_update does not run with health_probe: probe "
+                "results are per-tenant state and the group path does not "
+                "unstack them. Probed tenants take the single-tenant path."
+            )
         if self._is_collection and set(self._leaders) != {
             cg[0] for cg in self._metric._groups.values()
         }:
@@ -512,7 +559,12 @@ class FusedCollectionStep:
 
             program = jax.jit(run, donate_argnums=donate)
             self._programs[key] = program
-        with _xla.fallback_attribution(None, label=self._compile_label(key)):
+        label = self._compile_label(key)
+        if _device.profiling_enabled():
+            _device.note_dispatch(
+                label, program, (list(states), list(padded), list(n_valid))
+            )
+        with _xla.fallback_attribution(None, label=label):
             return program(list(states), list(padded), list(n_valid))
 
     def _compile_label(self, key: Any) -> str:

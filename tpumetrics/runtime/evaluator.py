@@ -74,7 +74,9 @@ from tpumetrics.runtime.compile_cache import (
 from tpumetrics.runtime.dispatch import _DEPTH_GAUGE, AsyncDispatcher
 from tpumetrics.runtime.scheduler import SignatureRegistry
 from tpumetrics.runtime import snapshot as _snapshot
+from tpumetrics.telemetry import device as _device
 from tpumetrics.telemetry import export as _export
+from tpumetrics.telemetry import health as _health
 from tpumetrics.telemetry import instruments as _instruments
 from tpumetrics.telemetry import ledger as _telemetry
 from tpumetrics.telemetry import spans as _spans
@@ -103,6 +105,11 @@ _RESTORE_HIST = _instruments.histogram(
 _DRAIN_HIST = _instruments.histogram(
     _instruments.DRAIN_LATENCY_MS,
     help="graceful drain (flush + final cut) latency",
+    labels=("stream",),
+)
+_STATE_HBM_GAUGE = _instruments.gauge(
+    _instruments.STATE_HBM_BYTES,
+    help="live metric-state buffer bytes held on device for the stream",
     labels=("stream",),
 )
 
@@ -196,6 +203,16 @@ class StreamingEvaluator:
             shape-churning stream beyond the capacity costs only eviction
             accounting (``stats()["signature_evictions"]``) and redundant
             cold-signature pre-compiles — never correctness or a leak.
+        health_probe: arm the in-trace state health probe (requires
+            ``buckets``): every step program additionally emits per-state
+            NaN/inf/saturation counters (:mod:`tpumetrics.telemetry.health`)
+            that stay ON DEVICE and ride down on the host fetches
+            ``compute()``/``stats()`` already make — zero extra transfers,
+            bit-identical state.  First corruption of a state latches one
+            ``state_health`` ledger event and the
+            ``tpumetrics_state_nonfinite_total{stream,state}`` series, so a
+            poisoned stream is visible BEFORE the compute-time non-finite
+            guard trips.
     """
 
     def __init__(
@@ -224,6 +241,7 @@ class StreamingEvaluator:
         partition_rules: Optional[Any] = None,
         data_axis: Optional[str] = None,
         signature_cache_size: Optional[int] = 4096,
+        health_probe: bool = False,
     ) -> None:
         from tpumetrics.collections import MetricCollection
 
@@ -262,6 +280,11 @@ class StreamingEvaluator:
                 "ride the functional/jitted path."
             )
         self._mesh = mesh
+        if health_probe and buckets is None:
+            raise ValueError(
+                "health_probe rides the functional/jitted step path and "
+                "therefore requires buckets."
+            )
         if buckets is None:
             self._bucketer: Optional[ShapeBucketer] = None
             self._state: Optional[Dict[str, Any]] = None
@@ -279,6 +302,7 @@ class StreamingEvaluator:
             self._step = FusedCollectionStep(
                 metric, update_kwargs=self._update_kwargs, donate=bool(donate_state),
                 mesh=mesh, partition_rules=partition_rules, data_axis=data_axis,
+                health_probe=bool(health_probe),
             )
             self._state = self._step.init_state()
 
@@ -304,6 +328,17 @@ class StreamingEvaluator:
         self._crashes = 0
         self._restores = 0
         self._degraded = False
+        # device-side observability: the latest on-device health counter
+        # tree (probed steps only; fetched host-side at stats()/compute()),
+        # the per-state first-corruption latch (doubles as the minted
+        # instrument-label ledger close() releases), and the live-state HBM
+        # watermark
+        self._device_health: Optional[Any] = None
+        self._health_summary: Optional[Dict[str, Any]] = None  # last fetched
+        self._health_alerted: set = set()
+        self._health_lock = threading.Lock()  # one state_health event per corruption
+        self._hbm_watermark = 0
+        self._closed = False  # stats() after close must not re-mint released series
         # graceful-drain state: flag read lock-free on the submit hot path
         # (a single store-release is enough — late submits only need to fail
         # EVENTUALLY-before-close, and drain() flushes after setting it)
@@ -428,6 +463,18 @@ class StreamingEvaluator:
             ):
                 inst.remove(self._stream)
             _DEPTH_GAUGE.remove(self._stream)
+            # device-side series (the health latch's minted labels, the
+            # state-HBM gauge, the program-profile records + gauges): latch
+            # _closed and release UNDER the health lock, which the stats()-
+            # side gauge writes also take — a concurrent stats() either
+            # lands before the release (its series is removed below) or
+            # observes _closed and writes nothing; without the shared lock
+            # it could re-mint a series between the remove and the flag
+            with self._health_lock:
+                self._closed = True
+                _STATE_HBM_GAUGE.remove(self._stream)
+                _health.release_health(self._stream, self._health_alerted)
+                _device.release_profiles(self._stream)
             # drift monitors: per-stream latch state + the
             # drift_score/drift_alerts label series under this stream
             from tpumetrics.monitoring.drift import release_stream
@@ -521,6 +568,10 @@ class StreamingEvaluator:
         from tpumetrics.monitoring.drift import stream_scope
 
         self.flush()
+        # health first: a poisoned state must page (state_health event +
+        # nonzero nonfinite series) BEFORE any value is computed or the
+        # non-finite guard turns the corruption into an exception
+        self._refresh_health(block=True)
         with self._lock, stream_scope(self._stream):
             # drift monitors alert at compute time under this stream's label
             # (gauge + drift_alert ledger event; stats()["monitoring"])
@@ -565,12 +616,78 @@ class StreamingEvaluator:
             )
         out["latency"] = _instruments.latency_section(self._stream)
         out["recompiles"] = recompile_count(self._stream)
+        out["device"] = self._device_section()
         from tpumetrics.monitoring.drift import monitoring_stats
 
         monitoring = monitoring_stats(self._metric, self._stream)
         if monitoring:
             out["monitoring"] = monitoring
         return out
+
+    # ----------------------------------------------------- device observability
+
+    def _device_section(self) -> Dict[str, Any]:
+        """The ``stats()["device"]`` payload: program-profile aggregate for
+        this stream (registered/resolved counts + flops/bytes of already-
+        resolved profiles — ``stats()`` never blocks on an XLA compile, so
+        lazy resolution is left to explicit readers), the live-state HBM
+        footprint + watermark, and the health summary (probed steps only —
+        one ``device_get`` of a few int32 vectors, the fetch ``stats()``
+        piggybacks the counters on)."""
+        with self._health_lock:  # serializes the gauge writes with close()
+            programs = _device.profile_summary(self._stream)
+        return {
+            "programs": programs,
+            "hbm": self._hbm_section(),
+            "health": self._refresh_health(),
+        }
+
+    def _hbm_section(self) -> Dict[str, Any]:
+        with self._lock:
+            if self._bucketer is not None:
+                leaves = jax.tree_util.tree_leaves(self._state)
+            else:
+                leaves = _eager_state_leaves(self._metric)
+            current = sum(int(getattr(l, "nbytes", 0) or 0) for l in leaves)
+            if current > self._hbm_watermark:
+                self._hbm_watermark = current
+            watermark = self._hbm_watermark
+        with self._health_lock:
+            if not self._closed:  # close() released the series; don't re-mint
+                _STATE_HBM_GAUGE.set(current, self._stream)
+        return {"state_bytes": current, "watermark_bytes": watermark}
+
+    def _refresh_health(self, block: bool = False) -> Optional[Dict[str, Any]]:
+        """Fetch + publish the latest on-device health counters (None when
+        the probe is not armed).  First corruption per state latches ONE
+        ``state_health`` ledger event and the per-(stream, state) non-finite
+        series — this runs on the stats()/compute() read path, never per
+        step.
+
+        ``stats()`` is documented never-blocking, and a ``device_get`` of
+        counters produced by an in-flight async dispatch would wait for the
+        whole step program: with ``block=False`` a not-yet-ready probe
+        output serves the LAST fetched summary instead (all-zero before the
+        first fetch); ``compute()`` passes ``block=True`` — it synchronizes
+        with the device anyway, and corruption must page before a value is
+        served."""
+        if self._step is None or not self._step.health_probe:
+            return None
+        with self._lock:
+            health = self._device_health
+            paths = _health.state_paths(self._state) if health is not None else None
+        if not block and health is not None:
+            is_ready = getattr(health, "is_ready", None)
+            if is_ready is not None and not is_ready():
+                with self._health_lock:
+                    cached = self._health_summary
+                return cached if cached is not None else _health.summarize(None)
+        summary = _health.summarize(health, paths)
+        with self._health_lock:
+            if not self._closed:  # post-close reads must not re-mint/re-page
+                _health.publish_health(self._stream, summary, self._health_alerted)
+            self._health_summary = summary
+        return summary
 
     # -------------------------------------------------------------- snapshots
 
@@ -786,6 +903,7 @@ class StreamingEvaluator:
             self._journal = []
             self._journal_base = total_batches
             self._degraded = degraded
+            self._device_health = None  # counters describe the pre-restore pytree
             self._elastic_base_batches = total_batches
             self._elastic_base_items = total_items
             restore_ms = (time.perf_counter() - t_restore) * 1e3
@@ -870,6 +988,10 @@ class StreamingEvaluator:
         self._journal = []
         self._journal_base = restored
         self._degraded = degraded
+        # the adopted state is a different pytree: stale health counters
+        # describe buffers that no longer exist (the alert latch stays — a
+        # past corruption event remains true of the stream's history)
+        self._device_health = None
         if self._crash_policy == "restore":
             _JOURNAL_GAUGE.set(0, self._stream)
         return restored
@@ -1032,9 +1154,16 @@ class StreamingEvaluator:
         # the plan (chunking, padding, jit-cache-mirroring signatures) is
         # shared with the multi-tenant service; signatures feed the
         # LRU-bounded registry whose insert count == XLA compile count, per
-        # (bucket, signature) for the WHOLE collection, never per member
+        # (bucket, signature) for the WHOLE collection, never per member.
+        # The device tenant scope names this stream as the owner of any
+        # program profile the dispatches below register (no-op singleton
+        # with profiling off).
         with _spans.span("plan"):
             n, chunks = plan_bucketed_update(self._bucketer, args)
+        with _device.tenant_scope(self._stream):
+            return self._run_chunks(chunks, n)
+
+    def _run_chunks(self, chunks: Any, n: int) -> int:
         for chunk in chunks:
             if chunk[0] == "scalar":
                 # scalar-only submit (e.g. an aggregation metric fed floats):
@@ -1071,6 +1200,7 @@ class StreamingEvaluator:
         Non-donating steps delete nothing and stay outside the lock
         entirely, as before donation existed."""
         timed = _instruments.enabled()
+        probed = self._step.health_probe
         if not self._step.donate:
             t0 = time.perf_counter() if timed else 0.0
             with _spans.span("dispatch", cold=new_sig):
@@ -1079,7 +1209,11 @@ class StreamingEvaluator:
                 _DISPATCH_HIST.observe((time.perf_counter() - t0) * 1e3, self._stream)
             with self._lock:
                 with _spans.span("write_back"):
-                    self._state = new_state
+                    if probed:
+                        # probed programs return (state, on-device health)
+                        self._state, self._device_health = new_state
+                    else:
+                        self._state = new_state
             return
         if new_sig:
             with _spans.span("compile"):
@@ -1091,7 +1225,10 @@ class StreamingEvaluator:
             if timed:
                 _DISPATCH_HIST.observe((time.perf_counter() - t0) * 1e3, self._stream)
             with _spans.span("write_back"):
-                self._state = new_state
+                if probed:
+                    self._state, self._device_health = new_state
+                else:
+                    self._state = new_state
 
     def _refresh_latest(self) -> None:
         from tpumetrics.monitoring.drift import stream_scope
@@ -1116,6 +1253,19 @@ class StreamingEvaluator:
                 "value": value, "batches": batches, "items": items, "degraded": degraded,
             }
             self._last_compute_at = batches
+
+
+def _eager_state_leaves(metric: Any) -> list:
+    """Array leaves of an eager-path metric's LIVE attribute state —
+    ``metric_state()`` per metric (a collection contributes every member's).
+    Shared by the evaluator's and the service's HBM accounting."""
+    from tpumetrics.collections import MetricCollection
+
+    if isinstance(metric, MetricCollection):
+        return jax.tree_util.tree_leaves(
+            {name: m.metric_state() for name, m in metric._modules.items()}
+        )
+    return jax.tree_util.tree_leaves(metric.metric_state())
 
 
 def _as_snapshot_payload(payload: Any) -> Dict[str, Any]:

@@ -96,7 +96,9 @@ from tpumetrics.runtime.dispatch import _DEPTH_GAUGE, AsyncDispatcher
 from tpumetrics.runtime.evaluator import CrashLoopError
 from tpumetrics.runtime.scheduler import DeficitRoundRobin, SignatureRegistry
 from tpumetrics.runtime import snapshot as _snapshot
+from tpumetrics.telemetry import device as _device
 from tpumetrics.telemetry import export as _export
+from tpumetrics.telemetry import health as _health
 from tpumetrics.telemetry import instruments as _instruments
 from tpumetrics.telemetry import ledger as _telemetry
 from tpumetrics.telemetry import spans as _spans
@@ -115,6 +117,11 @@ _DISPATCH_HIST = _instruments.histogram(
 )
 _TENANTS_GAUGE = _instruments.gauge(
     _instruments.TENANTS_LIVE, help="registered, non-quarantined tenants", labels=("service",)
+)
+_STATE_HBM_GAUGE = _instruments.gauge(
+    _instruments.STATE_HBM_BYTES,
+    help="live metric-state buffer bytes held on device for the stream",
+    labels=("stream",),
 )
 #: gauges are last-write-wins per label: two default-named services must not
 #: share one series, so each instance mints a unique instrument label
@@ -203,6 +210,16 @@ class _Tenant:
         self.crashes = 0
         self.restores = 0
         self.flight_path: Optional[str] = None  # quarantine's flight dump
+
+        # device-side observability (health probe + HBM watermark); the
+        # alerted set doubles as the minted health-label ledger close()
+        # releases, guarded by health_lock (one state_health per corruption)
+        self.device_health: Optional[Any] = None
+        self.health_summary: Optional[Dict[str, Any]] = None  # last fetched
+        self.health_alerted: set = set()
+        self.health_lock = threading.Lock()
+        self.hbm_watermark = 0
+        self.released = False  # stats() after close must not re-mint series
 
 
 class TenantHandle:
@@ -344,6 +361,7 @@ class EvaluationService:
         mesh: Optional[Any] = None,
         partition_rules: Optional[Any] = None,
         data_axis: Optional[str] = None,
+        health_probe: bool = False,
     ) -> TenantHandle:
         """Register one tenant stream; returns its :class:`TenantHandle`.
 
@@ -357,7 +375,14 @@ class EvaluationService:
         DRR quantum in batch rows per scheduling round — a tenant with
         twice the quota gets twice the share of a contended worker.
         ``megabatch=False`` opts this tenant out of cross-tenant stacking
-        (it still shares the step's compile cache)."""
+        (it still shares the step's compile cache).  ``health_probe=True``
+        (requires ``buckets``) arms the in-trace state health probe — the
+        tenant's step programs also emit on-device NaN/inf/saturation
+        counters, surfaced via ``stats()["device"]`` and latched into one
+        ``state_health`` ledger event per corrupted state BEFORE compute;
+        probed tenants are excluded from megabatch grouping and share steps
+        only with other probed tenants (the probe is part of the program
+        shape)."""
         from tpumetrics.collections import MetricCollection
 
         if not isinstance(metric, (Metric, MetricCollection)):
@@ -383,6 +408,11 @@ class EvaluationService:
         if buckets is None:
             if mesh is not None:
                 raise ValueError("mesh (sharded execution mode) requires buckets")
+            if health_probe:
+                raise ValueError(
+                    "health_probe rides the functional/jitted step path and "
+                    "therefore requires buckets"
+                )
             bucketer = step = None
             state = None
             step_token: Any = ("eager", tenant_id)
@@ -393,7 +423,7 @@ class EvaluationService:
             step, step_token = self._resolve_step(
                 metric, kwargs, donate=bool(donate_state), mesh=mesh,
                 partition_rules=partition_rules, data_axis=data_axis,
-                tenant_id=tenant_id,
+                tenant_id=tenant_id, health_probe=bool(health_probe),
             )
             state = step.init_state()
 
@@ -409,7 +439,10 @@ class EvaluationService:
             snapshots=snapshots, snapshot_every=snapshot_every,
             crash_policy=crash_policy, max_restores=max_restores,
             guard_non_finite=guard_non_finite,
-            megabatch=megabatch and step is not None and mesh is None,
+            # probed tenants are megabatch-excluded: the group path does not
+            # unstack per-tenant probe results (fuse_update refuses)
+            megabatch=megabatch and step is not None and mesh is None
+            and not health_probe,
         )
         with self._lock:
             if self._draining:
@@ -437,10 +470,13 @@ class EvaluationService:
         partition_rules: Optional[Any],
         data_axis: Optional[str],
         tenant_id: str,
+        health_probe: bool = False,
     ) -> Tuple[FusedCollectionStep, Any]:
         """The global dedupe layer: same (config digest, static kwargs,
-        donation) tenants share ONE step — one program cache, one compile
-        per (bucket, signature) no matter how many tenants run the eval.
+        donation, health probe) tenants share ONE step — one program cache,
+        one compile per (bucket, signature) no matter how many tenants run
+        the eval.  The probe flag is part of the share key because it is
+        part of the program SHAPE (probed programs return a counter tree).
         Mesh'd tenants and unhashable kwargs fall back to a private step
         (still persistent-cache-backed), keyed per tenant."""
         from tpumetrics.resilience.elastic import config_digest
@@ -448,7 +484,10 @@ class EvaluationService:
         share_key: Any = None
         if mesh is None:
             try:
-                share_key = (config_digest(metric), tuple(sorted(kwargs.items())), donate)
+                share_key = (
+                    config_digest(metric), tuple(sorted(kwargs.items())), donate,
+                    health_probe,
+                )
                 hash(share_key)
             except TypeError:
                 share_key = None
@@ -460,6 +499,7 @@ class EvaluationService:
         step = FusedCollectionStep(
             metric, update_kwargs=kwargs, donate=donate,
             mesh=mesh, partition_rules=partition_rules, data_axis=data_axis,
+            health_probe=health_probe,
         )
         if share_key is not None:
             with self._lock:
@@ -674,6 +714,15 @@ class EvaluationService:
                 _DISPATCH_HIST.remove(tenant.tid)
                 release_stream(self._stats_metric(tenant), tenant.tid)
                 release_attribution(tenant.tid, tokens=(tenant.step_token,))
+                # device-side series: latch + release UNDER the health lock
+                # the stats()-side gauge writes also take, so a concurrent
+                # tenant_stats() cannot re-mint what is being released (the
+                # evaluator's close() ordering, per tenant)
+                with tenant.health_lock:
+                    tenant.released = True
+                    _STATE_HBM_GAUGE.remove(tenant.tid)
+                    _health.release_health(tenant.tid, tenant.health_alerted)
+                    _device.release_profiles(tenant.tid)
             _TENANTS_GAUGE.remove(self._label)
             _DEPTH_GAUGE.remove(self._label)
 
@@ -696,6 +745,10 @@ class EvaluationService:
 
         tenant = self._get(tenant_id)
         self.flush(tenant_id)
+        # health first: a poisoned tenant must page (state_health event +
+        # nonzero nonfinite series) BEFORE any value is computed or the
+        # non-finite guard turns the corruption into an exception
+        self._refresh_health(tenant, block=True)
         with self._lock, stream_scope(tenant.tid):
             # drift monitors alert under THIS tenant's label — latches are
             # per-stream on the (possibly shared) metric instance, so one
@@ -747,12 +800,73 @@ class EvaluationService:
         # these only ever ADD keys.
         out["latency"] = _instruments.latency_section(tenant_id)
         out["recompiles"] = recompile_count(tenant_id)
+        out["device"] = self._device_section(tenant)
         from tpumetrics.monitoring.drift import monitoring_stats
 
         monitoring = monitoring_stats(self._stats_metric(tenant), tenant_id)
         if monitoring:
             out["monitoring"] = monitoring
         return out
+
+    # ----------------------------------------------------- device observability
+
+    def _device_section(self, tenant: _Tenant) -> Dict[str, Any]:
+        """The ``TenantHandle.stats()["device"]`` payload: program-profile
+        aggregate (already-resolved profiles only — ``stats()`` never
+        blocks on an XLA compile), the tenant's live-state HBM footprint +
+        watermark, and the health summary (probed tenants only)."""
+        with tenant.health_lock:  # serializes the gauge writes with close()
+            programs = _device.profile_summary(tenant.tid)
+        return {
+            "programs": programs,
+            "hbm": self._hbm_section(tenant),
+            "health": self._refresh_health(tenant),
+        }
+
+    def _hbm_section(self, tenant: _Tenant) -> Dict[str, Any]:
+        from tpumetrics.runtime.evaluator import _eager_state_leaves
+
+        with self._lock:
+            if tenant.bucketer is not None:
+                leaves = jax.tree_util.tree_leaves(tenant.state)
+            else:
+                leaves = _eager_state_leaves(tenant.metric)
+            current = sum(int(getattr(l, "nbytes", 0) or 0) for l in leaves)
+            if current > tenant.hbm_watermark:
+                tenant.hbm_watermark = current
+            watermark = tenant.hbm_watermark
+        with tenant.health_lock:
+            if not tenant.released:  # close() released the series; don't re-mint
+                _STATE_HBM_GAUGE.set(current, tenant.tid)
+        return {"state_bytes": current, "watermark_bytes": watermark}
+
+    def _refresh_health(
+        self, tenant: _Tenant, block: bool = False
+    ) -> Optional[Dict[str, Any]]:
+        """Fetch + publish the tenant's latest on-device health counters
+        (None when its step is unprobed): one ``device_get`` of a few int32
+        vectors on the stats()/compute() read path, never per step; first
+        corruption per state latches ONE ``state_health`` ledger event.
+        ``block=False`` (the never-blocking ``stats()`` contract) serves
+        the last fetched summary while an in-flight async dispatch still
+        owns the probe output; ``compute()`` passes ``block=True``."""
+        if tenant.step is None or not tenant.step.health_probe:
+            return None
+        with self._lock:
+            health = tenant.device_health
+            paths = _health.state_paths(tenant.state) if health is not None else None
+        if not block and health is not None:
+            is_ready = getattr(health, "is_ready", None)
+            if is_ready is not None and not is_ready():
+                with tenant.health_lock:
+                    cached = tenant.health_summary
+                return cached if cached is not None else _health.summarize(None)
+        summary = _health.summarize(health, paths)
+        with tenant.health_lock:
+            if not tenant.released:  # post-close reads must not re-mint/re-page
+                _health.publish_health(tenant.tid, summary, tenant.health_alerted)
+            tenant.health_summary = summary
+        return summary
 
     @staticmethod
     def _stats_metric(tenant: "_Tenant") -> Any:
@@ -890,6 +1004,9 @@ class EvaluationService:
         tenant.journal = []
         tenant.journal_base = restored
         tenant.degraded = degraded
+        # stale health counters describe the pre-restore pytree; the alert
+        # latch stays (a past corruption remains true of the stream history)
+        tenant.device_health = None
         return restored
 
     # ----------------------------------------------------------------- worker
@@ -1110,6 +1227,12 @@ class EvaluationService:
     def _bucketed_update(self, tenant: _Tenant, args: Tuple[Any, ...]) -> int:
         with _spans.span("plan"):
             n, chunks = plan_bucketed_update(tenant.bucketer, args)
+        # the device tenant scope names this tenant as the owner of any
+        # program profile the dispatches register (no-op with profiling off)
+        with _device.tenant_scope(tenant.tid):
+            return self._run_chunks(tenant, chunks, n)
+
+    def _run_chunks(self, tenant: _Tenant, chunks: Any, n: int) -> int:
         for chunk in chunks:
             if chunk[0] == "scalar":
                 _, cargs, sig = chunk
@@ -1143,6 +1266,7 @@ class EvaluationService:
         mid-donation); cold signatures pre-compile OUTSIDE the lock on a
         throwaway copy so ``latest_result``/``stats`` never block on XLA."""
         timed = _instruments.enabled()
+        probed = tenant.step.health_probe
         if not tenant.step.donate:
             t0 = time.perf_counter() if timed else 0.0
             with _spans.span("dispatch", cold=new_sig):
@@ -1151,7 +1275,11 @@ class EvaluationService:
                 _DISPATCH_HIST.observe((time.perf_counter() - t0) * 1e3, tenant.tid)
             with self._lock:
                 with _spans.span("write_back"):
-                    tenant.state = new_state
+                    if probed:
+                        # probed programs return (state, on-device health)
+                        tenant.state, tenant.device_health = new_state
+                    else:
+                        tenant.state = new_state
             return
         if new_sig:
             with _spans.span("compile"):
@@ -1163,7 +1291,10 @@ class EvaluationService:
             if timed:
                 _DISPATCH_HIST.observe((time.perf_counter() - t0) * 1e3, tenant.tid)
             with _spans.span("write_back"):
-                tenant.state = new_state
+                if probed:
+                    tenant.state, tenant.device_health = new_state
+                else:
+                    tenant.state = new_state
 
     # ---------------------------------------------------------- megabatch path
 
@@ -1196,6 +1327,8 @@ class EvaluationService:
         mega_sig = (tenant0.step_token, ("mega", bucket, k_padded, sig))
         with self._lock:
             new_sig = self._signatures.observe(mega_sig)
+        # group programs attribute to the DRR winner, like the compile does
+        mega_scope = _device.tenant_scope(tenant0.tid)
         # the group program is attributed to the DRR winner that formed the
         # group (one label, bounded cardinality); attrs carry the group size
         attrib = attribute_compiles(tenant0.tid, mega_sig[1], token=tenant0.step_token)
@@ -1208,14 +1341,14 @@ class EvaluationService:
                 jax.tree_util.tree_map(lambda leaf: leaf.copy(), m[0].state)
                 for m in members
             ] + [step.init_state() for _ in range(k_padded - k)]
-            with attrib:
+            with mega_scope, attrib:
                 step.megabatch_update(states, padded_list, n_list, bucket)
         dummies = [step.init_state() for _ in range(k_padded - k)]
         timed_spans = _spans.enabled()
         with self._lock:
             states = [m[0].state for m in members] + dummies
             t0 = _spans._now_ns() if timed_spans else 0
-            with attrib:
+            with mega_scope, attrib:
                 outs = step.megabatch_update(states, padded_list, n_list, bucket)
             t1 = _spans._now_ns() if timed_spans else 0
             for i, (tenant, args, n, _probe, root) in enumerate(members):

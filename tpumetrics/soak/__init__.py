@@ -32,11 +32,13 @@ package turns the "kill the job anywhere, on any topology" claim into a
   JSONL incident report plus a summary with throughput and restore-latency
   p50/p99 — the series the ``chaos_soak`` bench scenario gates.
 
-Three entry points: ``python -m tpumetrics.soak`` (CLI: schedule file in,
-incident JSONL out), the ``-m slow`` pytest short soak
-(``tests/test_soak.py``), and the ``chaos_soak`` bench scenario
-(``bench.py``).  See the "Chaos soak & preemption runbook" section of
-``docs/resilience.md``.
+Three entry points: ``python -m tpumetrics.soak`` (CLI: ``generate`` /
+``run`` — schedule file in, incident JSONL out — and ``report``, which
+merges a soak's per-rank telemetry into one clock-aligned timeline with a
+cross-rank straggler summary via :mod:`tpumetrics.telemetry.timeline`),
+the ``-m slow`` pytest short soak (``tests/test_soak.py``), and the
+``chaos_soak`` bench scenario (``bench.py``).  See the "Chaos soak &
+preemption runbook" section of ``docs/resilience.md``.
 """
 
 from tpumetrics.soak.schedule import (

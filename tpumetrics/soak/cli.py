@@ -1,6 +1,6 @@
 """``python -m tpumetrics.soak`` — the chaos-soak CLI.
 
-Two subcommands:
+Three subcommands:
 
 - ``generate`` — derive a deterministic schedule from a seed and write it
   as JSON (inspect it, check it into CI, replay a failure)::
@@ -15,14 +15,24 @@ Two subcommands:
       python -m tpumetrics.soak run --schedule schedule.json \\
           --root /tmp/soak --out report.jsonl
 
-Exit status: 0 when every incident recovered and every gate held, 1 when
-any incident was unrecovered, 2 for usage/schedule errors.
+- ``report`` — merge an existing soak's per-rank telemetry JSONL into one
+  clock-aligned global timeline (:mod:`tpumetrics.telemetry.timeline`),
+  print the cross-rank straggler summary, and optionally render the whole
+  soak as a Perfetto/Chrome trace::
+
+      python -m tpumetrics.soak report /tmp/soak --perfetto soak.trace.json
+
+Exit status: 0 when every incident recovered and every gate held (for
+``report``: when telemetry was found), 1 when any incident was
+unrecovered, 2 for usage/schedule errors (or an empty/missing telemetry
+directory).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import tempfile
 from typing import Optional, Sequence
@@ -60,11 +70,64 @@ def _build_parser() -> argparse.ArgumentParser:
     run.add_argument("--root", default=None, help="soak root dir (default: a fresh tempdir)")
     run.add_argument("--out", default=None, help="JSONL incident report path")
     run.add_argument("--verbose", action="store_true")
+
+    rep = sub.add_parser(
+        "report", help="merged cross-rank timeline + straggler summary"
+    )
+    rep.add_argument(
+        "root",
+        help="a soak root (its telemetry/ subdirectory) or a directory of "
+        "per-rank epochNNN-rankNNNNN.jsonl files",
+    )
+    rep.add_argument(
+        "--perfetto", default=None,
+        help="also write the merged timeline as Chrome trace-event JSON here",
+    )
+    rep.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the straggler report as JSON instead of text",
+    )
     return ap
+
+
+def _report(args: argparse.Namespace) -> int:
+    from tpumetrics.telemetry import timeline as _timeline
+
+    try:
+        candidates = [os.path.join(args.root, "telemetry"), args.root]
+        streams = {}
+        for directory in candidates:
+            streams = _timeline.load_rank_streams(directory)
+            if streams:
+                break
+        if not streams:
+            print(
+                f"error: no per-rank telemetry JSONL (epochNNN-rankNNNNN.jsonl) "
+                f"under {candidates[0]} or {candidates[1]}",
+                file=sys.stderr,
+            )
+            return 2
+        merged = _timeline.merge_timelines(streams)
+        report = _timeline.straggler_report(merged)
+        if args.perfetto:
+            _timeline.to_perfetto(merged, args.perfetto)
+            print(f"perfetto trace written: {args.perfetto}", file=sys.stderr)
+    except OSError as err:
+        # the generate/run contract: I/O problems are clean usage errors
+        # (exit 2), never a traceback
+        print(f"error: {err}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(_timeline.render_report(merged, report))
+    return 0
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
+    if args.command == "report":
+        return _report(args)
     try:
         if args.command == "generate":
             schedule = generate_schedule(

@@ -154,6 +154,10 @@ class SoakSupervisor:
         self._cut_state_pos = 0  # state position of the newest cut
         self._restore_walls: List[float] = []
         self._throughputs: List[float] = []
+        # straggler analysis: per-file (size, parsed records) cache so each
+        # incident's timeline merge re-parses only files that GREW since the
+        # last incident, not the whole soak history (O(new), not O(history))
+        self._timeline_cache: Dict[str, Any] = {}
 
     # ----------------------------------------------------------------- pool
 
@@ -407,6 +411,57 @@ class SoakSupervisor:
             "value": {k: v.tolist() for k, v in got.items()},
         }
 
+    def _cached_streams(self) -> Dict[Any, List[Dict[str, Any]]]:
+        """The per-rank telemetry streams, parsed incrementally: a file
+        whose size is unchanged since the last incident serves its cached
+        records (past epochs' files never change; only the current epoch's
+        grow), so the per-incident cost is O(new records), not
+        O(soak history)."""
+        from tpumetrics.telemetry import timeline as _timeline
+
+        directory = os.path.join(self.root, "telemetry")
+        streams: Dict[Any, List[Dict[str, Any]]] = {}
+        if not os.path.isdir(directory):
+            return streams
+        for name in sorted(os.listdir(directory)):
+            m = _timeline.RANK_FILE_RE.search(name)
+            if not m:
+                continue
+            path = os.path.join(directory, name)
+            size = os.path.getsize(path)
+            cached = self._timeline_cache.get(path)
+            if cached is None or cached[0] != size:
+                # parse just this file (load_rank_streams would re-read all),
+                # through the timeline's ONE parse rule
+                self._timeline_cache[path] = (size, _timeline.parse_jsonl(path))
+            key = (int(m.group(2)), int(m.group(1)))  # (rank, epoch)
+            records = self._timeline_cache[path][1]
+            if records:
+                streams.setdefault(key, []).extend(records)
+        return streams
+
+    def _straggler_summary(self) -> Optional[Dict[str, Any]]:
+        """Merge the per-rank telemetry streams flushed so far into one
+        clock-aligned timeline and summarize the cross-rank skew — the
+        "which rank is the straggler" answer attached to every incident
+        line.  Never fatal: a soak must not fail on its own analysis."""
+        from tpumetrics.telemetry import timeline as _timeline
+
+        try:
+            merged = _timeline.merge_timelines(self._cached_streams())
+            if not merged.events:
+                return None
+            report = _timeline.straggler_report(merged)
+            return {
+                "straggler": report["straggler"],
+                "n_windows": report["n_windows"],
+                "max_skew_ms": round(report["max_skew_ms"], 3),
+                "mean_skew_ms": round(report["mean_skew_ms"], 3),
+                "slowest_counts": report["slowest_counts"],
+            }
+        except Exception as err:  # noqa: BLE001 — analysis must not fail the soak
+            return {"error": f"{type(err).__name__}: {err}"}
+
     def _ledger_events(self, epoch: int, kind: str) -> int:
         tel_dir = os.path.join(self.root, "telemetry")
         count = 0
@@ -533,6 +588,7 @@ class SoakSupervisor:
                     record.update(self._induce(inc))
                     record.update(self._recover(inc))
                     record["verify"] = self._verify_fold(1 if inc.lose_member else None)
+                    record["straggler"] = self._straggler_summary()
                     record["flight_dump"] = flight_dump(
                         f"incident-{idx}-{inc.kind}", epoch=self._epoch, index=idx
                     )

@@ -30,6 +30,14 @@ Six parts (see ``docs/telemetry.md`` and ``docs/observability.md``):
 - **XLA compile attribution** (:mod:`~tpumetrics.telemetry.xla`, lazy —
   imports jax): every backend compile charged to the (tenant, step token,
   trace signature) that triggered it, with a retrace detector.
+- **Device-side observability** (:mod:`~tpumetrics.telemetry.device`,
+  :mod:`~tpumetrics.telemetry.health`, lazy): a program-profile registry
+  (per-program XLA flops/HBM, resolved lazily) and the in-trace state
+  health probe (NaN/inf/saturation counters computed inside the step
+  program, zero extra device→host transfers).
+- **Cross-rank timelines** (:mod:`~tpumetrics.telemetry.timeline`): merge
+  per-rank JSONL streams onto one wall-anchored axis, per-collective entry
+  skew, straggler reports, and :func:`perfetto_trace` rendering.
 
 Quick start::
 
@@ -73,9 +81,11 @@ from tpumetrics.telemetry.export import (
     flight_dump,
     flight_recorder,
     note_incident,
+    perfetto_trace,
     prometheus_text,
     spans_jsonl,
 )
+from tpumetrics.telemetry import timeline
 from tpumetrics.telemetry.instruments import counter, gauge, histogram
 from tpumetrics.telemetry.spans import span, start_span, end_span, record_span
 
@@ -101,12 +111,14 @@ def __getattr__(name: str):
 
         mod = importlib.import_module("tpumetrics.telemetry.lockstep")
         return mod if name == "lockstep" else getattr(mod, name)
-    if name == "xla":
-        # lazy like lockstep: xla.py imports jax at module top, which the
-        # pure-AST analysis tooling must not pull in just to name the package
+    if name in ("xla", "device", "health"):
+        # lazy like lockstep: xla.py imports jax at module top, and device/
+        # health defer their jax imports — keeping them lazy means the
+        # pure-AST analysis tooling never pulls heavy deps just to name the
+        # package
         import importlib
 
-        return importlib.import_module("tpumetrics.telemetry.xla")
+        return importlib.import_module(f"tpumetrics.telemetry.{name}")
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
@@ -129,12 +141,14 @@ __all__ = [
     "histogram",
     "instruments",
     "note_incident",
+    "perfetto_trace",
     "prometheus_text",
     "record_span",
     "span",
     "spans",
     "spans_jsonl",
     "start_span",
+    "timeline",
     "capture",
     "configure",
     "current_tag",

@@ -1,0 +1,491 @@
+"""Device program profiles — what every compiled program costs, attributed.
+
+PR 9 made host-side seams observable (spans, instruments, compile
+attribution); this module opens the device side: every compiled XLA program
+the runtime dispatches — the :class:`~tpumetrics.parallel.fuse_update.
+FusedCollectionStep` programs behind bucketed evaluator steps and megabatch
+groups, and the jitted mAP matcher — **registers** itself here once per
+(program key, trace signature), under the same attribution identity the
+compile attributor uses (tenant / step token / signature).  A registered
+program's XLA ``cost_analysis()`` (flops, bytes accessed) and
+``memory_analysis()`` (argument/output/temp/generated-code bytes — the HBM
+a dispatch holds) are resolved **lazily on first read** and cached, so the
+dispatch hot path pays only a seen-set lookup (benched as
+``device_observability``'s ``profile_lookup_ns_per_call`` ceiling) and the
+compile-twice cost of ``program.lower(...).compile()`` lands on the
+*reader* (``stats()["device"]``, the bench, an operator poking
+:func:`profiles`), never on a serving step.
+
+Two registration modes:
+
+- **gated** (:func:`note_dispatch`) — the runtime's per-dispatch hook: a
+  no-op unless :func:`enable_device_profiles` armed the registry (one
+  module-flag test when off, the PR 9 inert-predicate discipline).
+- **always** (:func:`register_program`) — for the few programs whose cost
+  IS the product (the detection matcher feeding the bench's MFU): one dict
+  insert per distinct program key/signature regardless of the flag.  This
+  replaces the detection-private ``last_cost_analysis()`` plumbing — one
+  code path for program cost.
+
+Resolved profiles feed two Prometheus gauges, both labeled by tenant and
+released by the owning stream's ``close()``:
+
+- ``tpumetrics_program_flops{tenant}`` — summed flops of one step through
+  every program registered under the tenant (the bench's MFU numerator);
+- ``tpumetrics_program_hbm_bytes{tenant}`` — the largest single program's
+  total buffer footprint (arguments + outputs + temps), i.e. the peak HBM a
+  dispatch for this tenant holds beyond its live state.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from tpumetrics.telemetry import instruments as _instruments
+
+__all__ = [
+    "ProgramProfile",
+    "ProfileRegistry",
+    "abstract_signature",
+    "disable_device_profiles",
+    "enable_device_profiles",
+    "note_dispatch",
+    "profile_summary",
+    "profiles",
+    "profiling_enabled",
+    "registry",
+    "release_profiles",
+    "register_program",
+    "reset_device_profiles",
+    "tenant_scope",
+]
+
+_ENABLED = False
+
+#: registered-program cap: a shape-churning adversarial stream degrades to
+#: eviction accounting, never an unbounded registry (the signature-LRU rule)
+_DEFAULT_CAPACITY = 1024
+
+_FLOPS_GAUGE = _instruments.gauge(
+    _instruments.PROGRAM_FLOPS,
+    help="summed per-step flops of the tenant's registered device programs",
+    labels=("tenant",),
+)
+_HBM_GAUGE = _instruments.gauge(
+    _instruments.PROGRAM_HBM_BYTES,
+    help="largest registered program's total buffer bytes (args+outputs+temps)",
+    labels=("tenant",),
+)
+
+
+def profiling_enabled() -> bool:
+    return _ENABLED
+
+
+def enable_device_profiles() -> None:
+    """Arm the per-dispatch registration hook (:func:`note_dispatch`)."""
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable_device_profiles() -> None:
+    global _ENABLED
+    _ENABLED = False
+
+
+def _leaf_sig(leaf: Any) -> Tuple:
+    # shape is already a tuple on jax/numpy arrays and dtype objects hash —
+    # no re-tupling or str() per leaf: this runs per DISPATCH when profiling
+    # is armed, and is what the profile_lookup_ns_per_call ceiling times
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return (shape if type(shape) is tuple else tuple(shape), dtype)
+    return ("py", type(leaf).__name__, repr(leaf)[:32])
+
+
+_TREE_LEAVES = None  # jax.tree_util.tree_leaves, bound on first use (lazy jax)
+
+
+def abstract_signature(args: Tuple[Any, ...]) -> Tuple:
+    """A hashable (shape, dtype)-tuple signature over a pytree of call
+    arguments — the registry's dedupe key (mirrors, but does not have to
+    equal, the runtime's trace signatures)."""
+    global _TREE_LEAVES
+    if _TREE_LEAVES is None:
+        import jax
+
+        _TREE_LEAVES = jax.tree_util.tree_leaves
+    return tuple(_leaf_sig(l) for l in _TREE_LEAVES(args))
+
+
+def _abstract_args(args: Tuple[Any, ...]) -> Tuple[Any, ...]:
+    """ShapeDtypeStruct pytree snapshot of concrete call args: what the lazy
+    ``program.lower(...)`` needs, WITHOUT pinning the concrete buffers (a
+    MATCH_BUDGET-scale dense grid held for the process lifetime was exactly
+    the bug the detection module's abstract-spec convention avoided)."""
+    import jax
+
+    def one(leaf: Any) -> Any:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            return jax.ShapeDtypeStruct(tuple(shape), dtype)
+        import jax.numpy as jnp
+
+        arr = jnp.asarray(leaf)
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+    return jax.tree_util.tree_map(one, args)
+
+
+class ProgramProfile:
+    """One registered compiled program; cost/memory analyses resolve lazily.
+
+    ``resolve()`` runs ``program.lower(*abstract_args).compile()`` and reads
+    XLA's ``cost_analysis``/``memory_analysis`` — real work (an XLA compile,
+    typically served by the persistent cache), so it runs at most once per
+    profile, on the reader's thread, and failures degrade to an ``error``
+    note instead of raising into ``stats()``.
+    """
+
+    __slots__ = (
+        "label", "tenant", "signature", "registered_mono_ns", "x64",
+        "_program", "_abstract", "_resolved", "_lock",
+    )
+
+    def __init__(
+        self,
+        label: str,
+        tenant: str,
+        signature: Tuple,
+        program: Any,
+        abstract: Tuple[Any, ...],
+        x64: bool = False,
+    ) -> None:
+        self.label = label
+        self.tenant = tenant
+        self.signature = signature
+        self.registered_mono_ns = time.monotonic_ns()
+        self.x64 = bool(x64)
+        self._program = program
+        self._abstract = abstract
+        self._resolved: Optional[Dict[str, Any]] = None
+        self._lock = threading.Lock()
+
+    def resolve(self) -> Dict[str, Any]:
+        with self._lock:
+            if self._resolved is not None:
+                return self._resolved
+            out: Dict[str, Any] = {
+                "label": self.label,
+                "tenant": self.tenant,
+                "flops": 0.0,
+                "bytes_accessed": 0.0,
+                "hbm_bytes": 0.0,
+                "argument_bytes": 0.0,
+                "output_bytes": 0.0,
+                "temp_bytes": 0.0,
+                "generated_code_bytes": 0.0,
+            }
+            try:
+                from contextlib import nullcontext
+
+                scope: Any = nullcontext()
+                if self.x64:
+                    from jax.experimental import enable_x64
+
+                    scope = enable_x64()
+                with scope:
+                    compiled = self._program.lower(*self._abstract).compile()
+                cost = compiled.cost_analysis()
+                if isinstance(cost, list):  # older jaxlibs return [dict]
+                    cost = cost[0] if cost else None
+                if cost:
+                    out["flops"] = float(cost.get("flops", 0.0))
+                    out["bytes_accessed"] = float(cost.get("bytes accessed", 0.0))
+                try:
+                    mem = compiled.memory_analysis()
+                except Exception:
+                    mem = None
+                if mem is not None:
+                    for key, attr in (
+                        ("argument_bytes", "argument_size_in_bytes"),
+                        ("output_bytes", "output_size_in_bytes"),
+                        ("temp_bytes", "temp_size_in_bytes"),
+                        ("generated_code_bytes", "generated_code_size_in_bytes"),
+                    ):
+                        out[key] = float(getattr(mem, attr, 0.0) or 0.0)
+                    alias = float(getattr(mem, "alias_size_in_bytes", 0.0) or 0.0)
+                    out["hbm_bytes"] = max(
+                        0.0,
+                        out["argument_bytes"] + out["output_bytes"]
+                        + out["temp_bytes"] - alias,
+                    )
+                elif out["bytes_accessed"]:
+                    out["hbm_bytes"] = out["bytes_accessed"]
+            except Exception as err:  # noqa: BLE001 — degrade, never raise into stats()
+                out["error"] = f"{type(err).__name__}: {err}"
+            self._resolved = out
+            return out
+
+    @property
+    def resolved(self) -> bool:
+        return self._resolved is not None
+
+
+class ProfileRegistry:
+    """Bounded process-global registry of :class:`ProgramProfile`\\ s."""
+
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY) -> None:
+        if int(capacity) <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self._lock = threading.Lock()
+        self._capacity = int(capacity)
+        self._records: "OrderedDict[Tuple, ProgramProfile]" = OrderedDict()
+        self.registered = 0  # lifetime inserts
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def seen(self, key: Tuple) -> bool:
+        """The dispatch fast path: has this (label, signature) registered?
+        A hit refreshes the entry's recency — the registry is an LRU (the
+        bound evicts the LEAST recently dispatched program, and
+        :meth:`newest` means most recently dispatched, the semantics the
+        detection matcher's bench read relies on)."""
+        with self._lock:
+            if key in self._records:
+                self._records.move_to_end(key)
+                return True
+            return False
+
+    def register(
+        self,
+        label: str,
+        program: Any,
+        args: Tuple[Any, ...],
+        *,
+        tenant: Optional[str] = None,
+        signature: Optional[Tuple] = None,
+        x64: bool = False,
+    ) -> bool:
+        """Register one program dispatch (idempotent per (label, signature));
+        returns True when the profile is NEW.  ``args`` may be concrete —
+        only their ShapeDtypeStruct snapshot is retained."""
+        sig = signature if signature is not None else abstract_signature(args)
+        key = (label, sig)
+        with self._lock:
+            if key in self._records:
+                self._records.move_to_end(key)  # re-dispatch refreshes recency
+                return False
+        abstract = _abstract_args(args)
+        prof = ProgramProfile(
+            label, tenant if tenant is not None else "<unattributed>",
+            sig, program, abstract, x64=x64,
+        )
+        with self._lock:
+            if key in self._records:  # lost a race: first registration wins
+                self._records.move_to_end(key)
+                return False
+            self._records[key] = prof
+            self.registered += 1
+            while len(self._records) > self._capacity:
+                self._records.popitem(last=False)
+                self.evictions += 1
+        return True
+
+    def profiles(
+        self, tenant: Optional[str] = None, label: Optional[str] = None,
+        resolve: bool = True,
+    ) -> List[Dict[str, Any]]:
+        """Registered profiles (optionally filtered), resolved on demand."""
+        with self._lock:
+            records = list(self._records.values())
+        out = []
+        for prof in records:
+            if tenant is not None and prof.tenant != tenant:
+                continue
+            if label is not None and prof.label != label:
+                continue
+            out.append(prof.resolve() if resolve else {
+                "label": prof.label, "tenant": prof.tenant, "resolved": prof.resolved,
+            })
+        return out
+
+    def newest(self, label: str) -> Optional[ProgramProfile]:
+        """The most recently DISPATCHED profile under ``label`` (repeat
+        registrations refresh recency) — the detection matcher's "cost of
+        the program that just ran" read, matching the semantics of the
+        ``last_cost_analysis`` plumbing this registry replaced."""
+        with self._lock:
+            for key in reversed(self._records):
+                if key[0] == label:
+                    return self._records[key]
+        return None
+
+    def summary(self, tenant: str, resolve: bool = False) -> Dict[str, Any]:
+        """One tenant's aggregate: registered program count, summed per-step
+        flops, and the largest single program's buffer bytes.
+
+        ``resolve=False`` (the ``stats()`` default) aggregates only the
+        profiles that already resolved — ``stats()`` is documented
+        never-blocking, and resolution is an XLA compile.  ``resolve=True``
+        forces resolution of every registered profile first (the bench /
+        explicit-reader path).  Resolved numbers update the
+        ``tpumetrics_program_flops``/``_hbm_bytes`` gauges for the label."""
+        with self._lock:
+            records = [p for p in self._records.values() if p.tenant == tenant]
+        rows = [p.resolve() for p in records if resolve or p.resolved]
+        flops = sum(r["flops"] for r in rows)
+        hbm = max((r["hbm_bytes"] for r in rows), default=0.0)
+        if rows:
+            _FLOPS_GAUGE.set(flops, tenant)
+            _HBM_GAUGE.set(hbm, tenant)
+        return {
+            "registered": len(records),
+            "resolved": len(rows),
+            "flops_per_step": flops,
+            "program_hbm_bytes": hbm,
+            "errors": sum(1 for r in rows if "error" in r),
+        }
+
+    def release(self, tenant: str) -> None:
+        """Drop one tenant's profiles and gauge series (the ``close()``
+        contract: auto-minted labels never outlive their stream)."""
+        with self._lock:
+            for key in [k for k, p in self._records.items() if p.tenant == tenant]:
+                del self._records[key]
+        _FLOPS_GAUGE.remove(tenant)
+        _HBM_GAUGE.remove(tenant)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self.registered = 0
+            self.evictions = 0
+
+
+_REGISTRY = ProfileRegistry()
+
+
+def registry() -> ProfileRegistry:
+    return _REGISTRY
+
+
+_TENANT_CTX = threading.local()  # .stack: [tenant, ...] innermost last
+
+
+class _NullScope:
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullScope":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+
+_NULL_SCOPE = _NullScope()
+
+
+class _TenantScope:
+    """Pushes on ``__enter__`` (not construction) so one scope object can
+    guard several dispatches (the megabatch cold-compile + dispatch pair)."""
+
+    __slots__ = ("_tenant",)
+
+    def __init__(self, tenant: str) -> None:
+        self._tenant = str(tenant)
+
+    def __enter__(self) -> "_TenantScope":
+        st = getattr(_TENANT_CTX, "stack", None)
+        if st is None:
+            st = _TENANT_CTX.stack = []
+        st.append(self._tenant)
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        _TENANT_CTX.stack.pop()
+        return False
+
+
+def tenant_scope(tenant: str):
+    """Name the tenant that owns programs registered on this thread inside
+    the ``with`` (the evaluator/service dispatch loops).  A no-op singleton
+    when profiling is disabled — the runtime call sites stay one flag test.
+    Deliberately independent of the compile-attribution switch: profiles
+    must attribute correctly whether or not ``xla`` attribution is armed."""
+    if not _ENABLED:
+        return _NULL_SCOPE
+    return _TenantScope(tenant)
+
+
+def _current_tenant() -> Optional[str]:
+    """The tenant owning this thread's dispatches: the device layer's own
+    scope first, then the ambient compile-attribution context (the same
+    identity xla.py charges the compile to), when armed."""
+    st = getattr(_TENANT_CTX, "stack", None)
+    if st:
+        return st[-1]
+    from tpumetrics.telemetry import xla as _xla
+
+    stack = getattr(_xla._CTX, "stack", None)
+    return stack[-1][0] if stack else None
+
+
+def note_dispatch(label: str, program: Any, args: Tuple[Any, ...]) -> None:
+    """The runtime's per-dispatch hook: register (label, signature) once.
+    First statement is the module-flag test — disabled, the whole device-
+    profile layer is one bool check per dispatch."""
+    if not _ENABLED:
+        return
+    sig = abstract_signature(args)
+    if _REGISTRY.seen((label, sig)):
+        return
+    _REGISTRY.register(
+        label, program, args, tenant=_current_tenant(), signature=sig
+    )
+
+
+def register_program(
+    label: str, program: Any, args: Tuple[Any, ...], *, x64: bool = False,
+    tenant: Optional[str] = None,
+) -> None:
+    """Ungated registration for programs whose cost IS the product (the
+    detection matcher): one dict insert per distinct signature, independent
+    of :func:`enable_device_profiles`."""
+    _REGISTRY.register(
+        label, program, args,
+        tenant=tenant if tenant is not None else _current_tenant(),
+        x64=x64,
+    )
+
+
+def profiles(
+    tenant: Optional[str] = None, label: Optional[str] = None
+) -> List[Dict[str, Any]]:
+    """Resolved profiles from the process registry (module-level shorthand)."""
+    return _REGISTRY.profiles(tenant=tenant, label=label)
+
+
+def profile_summary(tenant: str, resolve: bool = False) -> Dict[str, Any]:
+    """One tenant's aggregate profile (``stats()["device"]["programs"]``
+    with ``resolve=False``; pass ``resolve=True`` to force the lazy XLA
+    cost/memory analyses first — reader-path cost)."""
+    return _REGISTRY.summary(tenant, resolve=resolve)
+
+
+def release_profiles(tenant: str) -> None:
+    """Release one tenant's profiles + gauge series (``close()``)."""
+    _REGISTRY.release(tenant)
+
+
+def reset_device_profiles() -> None:
+    """Clear the registry (tests)."""
+    _REGISTRY.reset()

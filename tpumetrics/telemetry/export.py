@@ -48,6 +48,7 @@ __all__ = [
     "flight_recorder",
     "instruments_jsonl",
     "note_incident",
+    "perfetto_trace",
     "prometheus_text",
     "spans_jsonl",
 ]
@@ -173,6 +174,177 @@ def instruments_jsonl(target: Union[str, IO[str]]) -> int:
     finally:
         if owns:
             fh.close()
+
+
+# ------------------------------------------------------------- perfetto trace
+
+
+def _as_dict(obj: Any) -> Dict[str, Any]:
+    return obj if isinstance(obj, dict) else obj.to_dict()
+
+
+def _perfetto_span_events(
+    span_dicts: List[Dict[str, Any]],
+    ts_of,
+    pid_of,
+    tid_fallback: str = "spans",
+) -> Iterator[Dict[str, Any]]:
+    """Complete ("ph":"X") events for spans.  Track (tid) resolution: the
+    root span of each trace names the stream/tenant it belongs to (the
+    runtime stamps ``stream=`` on every batch root), and every child of
+    that trace inherits the track — one track per tenant, as Perfetto
+    renders it."""
+    tid_by_trace: Dict[Any, str] = {}
+    for sp in span_dicts:
+        stream = sp.get("attrs", {}).get("stream")
+        if stream is not None and sp.get("trace") not in tid_by_trace:
+            tid_by_trace[sp["trace"]] = str(stream)
+    for sp in span_dicts:
+        start = ts_of(sp)
+        end_ns = sp.get("end_ns")
+        dur_us = (
+            max(0.0, (end_ns - sp["start_ns"]) / 1e3) if end_ns is not None else 0.0
+        )
+        args = {k: repr(v) for k, v in sp.get("attrs", {}).items()}
+        args.update(trace=sp.get("trace"), span=sp.get("span"), parent=sp.get("parent"))
+        yield {
+            "name": sp["name"],
+            "cat": "span",
+            "ph": "X",
+            "ts": start,
+            "dur": dur_us,
+            "pid": pid_of(sp),
+            "tid": tid_by_trace.get(sp.get("trace"), tid_fallback),
+            "args": args,
+        }
+
+
+def _perfetto_ledger_events(
+    record_dicts: List[Dict[str, Any]], ts_of, pid_of
+) -> Iterator[Dict[str, Any]]:
+    """Ledger records as slices/instants: an ``xla_compile`` event becomes a
+    compile-mark slice (its ``seconds`` is a real duration, drawn ending at
+    the record's stamp); payload-carrying collectives become short device
+    slices on a per-kind track; bookkeeping events are instants."""
+    for rec in record_dicts:
+        kind = rec.get("kind", "event")
+        ts = ts_of(rec)
+        args = {
+            k: rec[k]
+            for k in ("op", "tag", "world_size", "wire_bytes", "source", "rank")
+            if k in rec and rec[k] not in ("", 0, None)
+        }
+        args.update(rec.get("extra", {}))
+        if kind == "xla_compile":
+            secs = float(rec.get("extra", {}).get("seconds", 0.0) or 0.0)
+            dur_us = secs * 1e6
+            yield {
+                "name": "xla_compile",
+                "cat": "compile",
+                "ph": "X",
+                "ts": max(0.0, ts - dur_us),
+                "dur": dur_us,
+                "pid": pid_of(rec),
+                "tid": "compiles",
+                "args": args,
+            }
+        elif rec.get("source") in ("backend", "reducer", "spmd"):
+            yield {
+                "name": f"{kind}:{rec.get('op', '')}",
+                "cat": "collective",
+                "ph": "X",
+                "ts": ts,
+                "dur": 1.0,  # payload ops render as visible 1us slices
+                "pid": pid_of(rec),
+                "tid": "collectives",
+                "args": args,
+            }
+        else:
+            yield {
+                "name": kind,
+                "cat": "ledger",
+                "ph": "i",
+                "s": "p",  # process-scoped instant
+                "ts": ts,
+                "pid": pid_of(rec),
+                "tid": "events",
+                "args": args,
+            }
+
+
+def perfetto_trace(
+    target: Union[None, str, IO[str]] = None,
+    *,
+    span_list: Optional[List[Any]] = None,
+    record_list: Optional[List[Any]] = None,
+    rank_of=None,
+    process_names: Optional[Dict[int, str]] = None,
+) -> Union[Dict[str, Any], str]:
+    """Chrome trace-event JSON (the format Perfetto / ``chrome://tracing``
+    open directly) over spans + ledger records.
+
+    Defaults to the live process: the span ring and the global ledger's
+    records, as ``pid 0``.  Every span becomes one complete ("X") slice
+    (one track per tenant — the batch root's ``stream`` attribute names
+    the track, children inherit it), every ``xla_compile`` ledger event a
+    compile-mark slice, every payload collective a device slice, and every
+    other ledger record an instant — **each input exactly once**, sorted by
+    timestamp (the round-trip validator pins all of this).
+
+    ``rank_of`` maps a span/record dict to its process row (pid) — the
+    multi-rank merge (:mod:`tpumetrics.telemetry.timeline`) passes the
+    rank, so a whole soak opens as one Perfetto view with one process per
+    rank; ``process_names`` adds ``process_name`` metadata per pid.
+    Timestamps are monotonic-clock microseconds unless the caller's dicts
+    carry ``t_global_ns`` (the timeline's wall-aligned axis), which wins.
+
+    ``target=None`` returns the trace dict; a path/handle writes JSON and
+    returns the path (for a handle: the dict)."""
+    if span_list is None:
+        span_list = _spans.spans()
+    if record_list is None:
+        record_list = list(_ledger.get_ledger().records)
+    span_dicts = [_as_dict(s) for s in span_list]
+    record_dicts = [_as_dict(r) for r in record_list]
+
+    def ts_of_span(sp: Dict[str, Any]) -> float:
+        if "t_global_ns" in sp:
+            return sp["t_global_ns"] / 1e3
+        return sp["start_ns"] / 1e3
+
+    def ts_of_rec(rec: Dict[str, Any]) -> float:
+        if "t_global_ns" in rec:
+            return rec["t_global_ns"] / 1e3
+        return rec.get("mono_ns", 0) / 1e3
+
+    if rank_of is None:
+        rank_of = lambda d: int(d.get("rank", 0))  # noqa: E731
+
+    events = list(_perfetto_span_events(span_dicts, ts_of_span, rank_of))
+    events.extend(_perfetto_ledger_events(record_dicts, ts_of_rec, rank_of))
+    events.sort(key=lambda e: (e["ts"], e["pid"], str(e["tid"])))
+    pids = sorted({e["pid"] for e in events})
+    meta = []
+    for pid in pids:
+        name = (process_names or {}).get(pid, f"rank {pid}" if pids != [0] else "process")
+        meta.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {"name": name},
+            }
+        )
+    trace = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+    if target is None:
+        return trace
+    fh, owns = _open_target(target)
+    try:
+        json.dump(trace, fh, sort_keys=True, default=repr)
+    finally:
+        if owns:
+            fh.close()
+    return target if isinstance(target, str) else trace
 
 
 # ------------------------------------------------------------ flight recorder

@@ -1,0 +1,242 @@
+"""In-trace metric-state health: NaN/inf/saturation counters on device.
+
+The compute-time non-finite guard (``guard_non_finite``) discovers a
+poisoned state only when it is already being served or snapshotted.  The
+**health probe** closes that gap from inside the device program: with
+``health_probe=True`` a :class:`~tpumetrics.parallel.fuse_update.
+FusedCollectionStep` appends :func:`probe_tree` — pure ``jnp`` reductions
+over the *new* state — to every step it compiles, so each dispatch also
+yields a tiny counter pytree (one ``(3,)`` int32 vector per state leaf:
+``[nan, inf, saturated]``) describing the state it just produced.
+
+Trace-safety argument (the contract ``docs/observability.md`` documents):
+
+- the probe reads only the state the transition already produced — it adds
+  reductions to the SAME XLA program, no second dispatch;
+- its outputs stay **on device** next to the state; nothing here calls
+  ``device_get``/``float()``/``item()``, so arming the probe adds **zero
+  device→host transfers** to the steady-state loop.  The counters ride
+  down on the host fetches ``compute()``/``stats()`` already make
+  (:func:`summarize` is the ONLY host-syncing entry point, and tpulint
+  TPL105 rejects it in ``update()``-reachable metric code);
+- the state-transition subgraph is untouched — the probe's reductions are
+  pure consumers of the output leaves, so a probed and an unprobed step
+  produce **bit-identical** metric state (pinned by the parity test and
+  the ``device_observability`` bench assert).
+
+Semantics: the probe describes the CURRENT state, not a running total — a
+leaf's ``nan`` count is "NaN elements in this state now".  Corruption is
+monotone in practice (a NaN accumulator stays NaN), and the runtime latches
+the first nonzero reading into one ``state_health`` ledger event per
+(stream, state) plus the ``tpumetrics_state_nonfinite_total{stream,state}``
+series, so a poisoned stream pages exactly once, *before* compute.
+
+Saturation: a float leaf element counts as saturated when it is finite but
+``|x| >= SATURATION_FRACTION * finfo(dtype).max`` (the last stop before
+inf — fp16/bf16 accumulators overflow long before f32 ones); an integer
+element when it sits exactly at its dtype's min/max (a clamped counter).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from tpumetrics.telemetry import instruments as _instruments
+from tpumetrics.telemetry import ledger as _ledger
+
+__all__ = [
+    "SATURATION_FRACTION",
+    "flatten",
+    "probe_packed",
+    "probe_tree",
+    "publish_health",
+    "release_health",
+    "state_paths",
+    "summarize",
+]
+
+_NONFINITE_GAUGE = _instruments.gauge(
+    _instruments.STATE_NONFINITE,
+    help="non-finite (NaN+inf) elements currently in the stream's metric state",
+    labels=("stream", "state"),
+)
+
+#: |x| >= this fraction of the dtype's max counts as saturated (finite
+#: values only — inf has its own counter)
+SATURATION_FRACTION = 0.99
+
+
+def _probe_leaf(leaf: Any) -> Any:
+    """(3,) int32 ``[nan, inf, saturated]`` for one array leaf (pure jnp —
+    safe inside any trace).  Non-numeric / non-array leaves probe as zeros."""
+    import jax.numpy as jnp
+
+    try:
+        arr = jnp.asarray(leaf)
+    except (TypeError, ValueError):
+        return jnp.zeros((3,), jnp.int32)
+    if arr.dtype == jnp.bool_:
+        return jnp.zeros((3,), jnp.int32)
+    if jnp.issubdtype(arr.dtype, jnp.floating) or jnp.issubdtype(arr.dtype, jnp.complexfloating):
+        finfo = jnp.finfo(arr.dtype)
+        mag = jnp.abs(arr)
+        nan = jnp.sum(jnp.isnan(arr), dtype=jnp.int32)
+        inf = jnp.sum(jnp.isinf(arr), dtype=jnp.int32)
+        sat = jnp.sum(
+            jnp.isfinite(arr) & (mag >= SATURATION_FRACTION * float(finfo.max)),
+            dtype=jnp.int32,
+        )
+        return jnp.stack([nan, inf, sat])
+    if jnp.issubdtype(arr.dtype, jnp.integer):
+        iinfo = jnp.iinfo(arr.dtype)
+        sat = jnp.sum((arr == iinfo.min) | (arr == iinfo.max), dtype=jnp.int32)
+        zero = jnp.zeros((), jnp.int32)
+        return jnp.stack([zero, zero, sat])
+    return jnp.zeros((3,), jnp.int32)
+
+
+def probe_tree(state: Any) -> Any:
+    """Mirror ``state``'s pytree structure with a ``(3,)`` int32
+    ``[nan, inf, saturated]`` vector per leaf.  Pure ``jnp`` reductions —
+    designed to be appended to an existing jitted step, where XLA fuses the
+    probe into the program it already built.  NamedTuple nodes — the
+    :class:`~tpumetrics.buffers.MaskedBuffer` state kind — rebuild
+    positionally (``type(state)(*children)``; the generator form would call
+    the NamedTuple constructor with one argument)."""
+    if isinstance(state, dict):
+        return {k: probe_tree(v) for k, v in state.items()}
+    if isinstance(state, tuple) and hasattr(state, "_fields"):
+        return type(state)(*(probe_tree(v) for v in state))
+    if isinstance(state, (list, tuple)):
+        return type(state)(probe_tree(v) for v in state)
+    return _probe_leaf(state)
+
+
+def probe_packed(state: Any) -> Any:
+    """:func:`probe_tree` packed into ONE ``(N, 3)`` int32 array (rows in
+    :func:`state_paths` order).  This is what the runtime's probed step
+    programs emit: a single extra output buffer per dispatch instead of one
+    per state leaf — the probe's host-side dispatch overhead is one array
+    handle regardless of how many states the collection holds."""
+    import jax.numpy as jnp
+
+    rows = [vec for _path, vec in flatten(probe_tree(state))]
+    if not rows:
+        return jnp.zeros((0, 3), jnp.int32)
+    return jnp.stack(rows)
+
+
+def state_paths(state: Any) -> List[str]:
+    """The slash-joined leaf paths of ``state`` in packed-row order — the
+    label vocabulary a packed probe's rows map onto.  Deliberately THE SAME
+    traversal as :func:`flatten` (``probe_tree`` mirrors the state's pytree
+    structure, so flattening the state IS flattening the probe): one
+    recursion defines the row order, nothing to keep in sync."""
+    return [path for path, _leaf in flatten(state)]
+
+
+def flatten(tree: Any, prefix: str = "") -> List[Tuple[str, Any]]:
+    """``[("leader/attr", leaf), ...]`` — slash-joined leaf paths in stable
+    (sorted-dict) order; the label vocabulary of the
+    ``tpumetrics_state_nonfinite_total{stream,state}`` series.  NamedTuple
+    nodes (the :class:`~tpumetrics.buffers.MaskedBuffer` state kind) name
+    their components by FIELD (``rows/values``), matching the buffer-field
+    path convention of ``parallel/sharding.py``."""
+    out: List[Tuple[str, Any]] = []
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            path = f"{prefix}/{k}" if prefix else str(k)
+            out.extend(flatten(tree[k], path))
+        return out
+    if isinstance(tree, tuple) and hasattr(tree, "_fields"):
+        for name, v in zip(tree._fields, tree):
+            path = f"{prefix}/{name}" if prefix else str(name)
+            out.extend(flatten(v, path))
+        return out
+    if isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            path = f"{prefix}/{i}" if prefix else str(i)
+            out.extend(flatten(v, path))
+        return out
+    return [(prefix or "<state>", tree)]
+
+
+def summarize(
+    health: Optional[Any], paths: Optional[List[str]] = None
+) -> Dict[str, Any]:
+    """Fetch a device health probe result and fold it to a host summary::
+
+        {"per_state": {"acc/tp": {"nan": 0, "inf": 2, "saturated": 0,
+                                  "nonfinite": 2}, ...},
+         "nonfinite_total": 2, "saturated_total": 0}
+
+    ``health`` is either a :func:`probe_tree` pytree, or — the runtime's
+    form — a :func:`probe_packed` ``(N, 3)`` array with ``paths`` naming
+    its rows (:func:`state_paths` of the probed state).
+
+    THE host-syncing read of the health layer (one ``device_get`` of a few
+    int32 counters): call it from ``stats()``/``compute()``-side code only —
+    tpulint TPL105 rejects it in ``update()``-reachable metric code, where
+    it would force a device sync per step.  ``None`` (no probed step ran
+    yet) summarizes as all-zero."""
+    if health is None:
+        return {"per_state": {}, "nonfinite_total": 0, "saturated_total": 0}
+    import jax
+
+    if paths is not None:
+        packed = jax.device_get(health)
+        pairs = list(zip(paths, packed))
+    else:
+        pairs = flatten(jax.device_get(health))
+    per_state: Dict[str, Dict[str, int]] = {}
+    nonfinite_total = 0
+    saturated_total = 0
+    for path, vec in pairs:
+        nan, inf, sat = (int(v) for v in vec)
+        per_state[path] = {
+            "nan": nan, "inf": inf, "saturated": sat, "nonfinite": nan + inf,
+        }
+        nonfinite_total += nan + inf
+        saturated_total += sat
+    return {
+        "per_state": per_state,
+        "nonfinite_total": nonfinite_total,
+        "saturated_total": saturated_total,
+    }
+
+
+def publish_health(stream: str, summary: Dict[str, Any], alerted: Set[str]) -> None:
+    """Latch a health summary into the telemetry stack for one stream:
+
+    - a state path whose non-finite count is nonzero for the FIRST time
+      emits ONE ``state_health`` ledger event naming the stream, the state,
+      and the counts (the page an operator gets *before* the compute-time
+      non-finite guard trips), and joins ``alerted``;
+    - every alerted-or-corrupt path keeps its
+      ``tpumetrics_state_nonfinite_total{stream,state}`` series current (a
+      restored-clean state reads 0 again, the series stays until the
+      stream's ``close()`` releases it via :func:`release_health`).
+
+    ``alerted`` is the caller-owned latch set (per stream) — it doubles as
+    the minted-label ledger the release path walks.  Saturation pages too:
+    a finite-but-at-the-edge accumulator is exactly the early warning the
+    probe exists for (low-precision state overflows to inf only AFTER
+    sitting at the edge), so waiting for ``nonfinite`` would re-create the
+    late detection the probe preempts."""
+    for path, row in summary.get("per_state", {}).items():
+        corrupt = row["nonfinite"] > 0 or row["saturated"] > 0
+        if corrupt and path not in alerted:
+            alerted.add(path)
+            _ledger.record_event(
+                None, "state_health", stream=stream, state=path,
+                nan=row["nan"], inf=row["inf"], saturated=row["saturated"],
+            )
+        if corrupt or path in alerted:
+            _NONFINITE_GAUGE.set(row["nonfinite"], stream, path)
+
+
+def release_health(stream: str, alerted: Set[str]) -> None:
+    """Drop the stream's minted health series (the ``close()`` contract)."""
+    for path in alerted:
+        _NONFINITE_GAUGE.remove(stream, path)
+    alerted.clear()

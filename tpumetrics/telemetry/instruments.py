@@ -79,6 +79,11 @@ DRIFT_SCORE = "tpumetrics_drift_score"
 DRIFT_ALERTS = "tpumetrics_drift_alerts_total"
 RESTORE_LATENCY_MS = "tpumetrics_restore_latency_ms"
 DRAIN_LATENCY_MS = "tpumetrics_drain_latency_ms"
+# device-side observability (telemetry/device.py + telemetry/health.py)
+PROGRAM_FLOPS = "tpumetrics_program_flops"
+PROGRAM_HBM_BYTES = "tpumetrics_program_hbm_bytes"
+STATE_HBM_BYTES = "tpumetrics_state_hbm_bytes"
+STATE_NONFINITE = "tpumetrics_state_nonfinite_total"
 
 
 def enabled() -> bool:

@@ -31,6 +31,7 @@ Wire-byte model (per-device traffic, ring algorithms):
 from __future__ import annotations
 
 import threading
+import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
@@ -99,10 +100,20 @@ class CollectiveRecord:
     in_trace: bool
     source: str = "backend"  # "backend" | "reducer" | "spmd" | "event"
     extra: Dict[str, Any] = field(default_factory=dict)
+    #: monotonic + wall clock PAIR stamped when the record was made.  The
+    #: monotonic clock orders records exactly within one process; the wall
+    #: anchor lets :mod:`tpumetrics.telemetry.timeline` align per-rank JSONL
+    #: streams from DIFFERENT processes onto one global axis.  Trace-safe:
+    #: a record made at trace time stamps the trace instant (once per
+    #: compile), never forcing a host sync.
+    mono_ns: int = 0
+    wall_ns: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         return {
             "kind": self.kind,
+            "mono_ns": self.mono_ns,
+            "wall_ns": self.wall_ns,
             "op": self.op,
             "dtype": self.dtype,
             "shape": list(self.shape),
@@ -207,6 +218,11 @@ class CollectiveLedger:
             # a drift monitor's score crossed its threshold upward
             # (hysteresis-latched: one event per crossing, not per compute)
             self.drift_alerts += 1
+        elif rec.kind == "state_health":
+            # an armed health probe surfaced NaN/inf/saturation in a stream's
+            # metric state (one event per stream+state on FIRST corruption —
+            # before the compute-time non-finite guard would trip)
+            self.state_health_events += 1
         self.counts_by_kind[rec.kind] = self.counts_by_kind.get(rec.kind, 0) + 1
         for sink in self._sinks:
             sink.emit(rec)
@@ -240,6 +256,7 @@ class CollectiveLedger:
         self.xla_attributed_compiles = 0
         self.xla_retraces = 0
         self.drift_alerts = 0
+        self.state_health_events = 0
         self.spmd_collectives = 0
         self.spmd_wire_bytes = 0.0
         self.bytes_by_op: Dict[str, float] = {}
@@ -287,6 +304,7 @@ class CollectiveLedger:
             "xla_attributed_compiles": self.xla_attributed_compiles,
             "xla_retraces": self.xla_retraces,
             "drift_alerts": self.drift_alerts,
+            "state_health_events": self.state_health_events,
             "spmd_collectives": self.spmd_collectives,
             "spmd_wire_bytes": self.spmd_wire_bytes,
             "records": len(self.records),
@@ -407,6 +425,12 @@ def current_tag() -> str:
 # ------------------------------------------------------------- report helpers
 
 
+def _clocks() -> Tuple[int, int]:
+    """The (monotonic_ns, wall_ns) stamp every record carries — captured
+    only on the recording path (the disabled fast path never reaches it)."""
+    return time.monotonic_ns(), time.time_ns()
+
+
 def _emit(rec: CollectiveRecord) -> None:
     if _ENABLED:
         _LEDGER.record(rec)
@@ -444,6 +468,7 @@ def record_collective(
         wire = reduce_wire_bytes(payload, world_size)
     else:
         wire = gather_wire_bytes(payload, world_size)
+    mono_ns, wall_ns = _clocks()
     _emit(
         CollectiveRecord(
             kind=kind,
@@ -459,6 +484,8 @@ def record_collective(
             in_trace=bool(in_trace),
             source=source,
             extra=extra,
+            mono_ns=mono_ns,
+            wall_ns=wall_ns,
         )
     )
 
@@ -467,6 +494,7 @@ def record_flush(backend: Any, entries: int, classes: int, in_trace: bool = Fals
     """Report one :class:`FusedReducer` flush (bookkeeping only, no payload)."""
     if not (_ENABLED or _ACTIVE or _FLIGHT_HOOK is not None):
         return
+    mono_ns, wall_ns = _clocks()
     _emit(
         CollectiveRecord(
             kind="flush",
@@ -482,6 +510,8 @@ def record_flush(backend: Any, entries: int, classes: int, in_trace: bool = Fals
             in_trace=bool(in_trace),
             source="event",
             extra={"entries": int(entries), "classes": int(classes)},
+            mono_ns=mono_ns,
+            wall_ns=wall_ns,
         )
     )
 
@@ -490,6 +520,7 @@ def record_event(backend: Any, kind: str, in_trace: bool = False, **extra: Any) 
     """Report a payload-free bookkeeping event (e.g. a lockstep fingerprint)."""
     if not (_ENABLED or _ACTIVE or _FLIGHT_HOOK is not None):
         return
+    mono_ns, wall_ns = _clocks()
     _emit(
         CollectiveRecord(
             kind=kind,
@@ -505,5 +536,7 @@ def record_event(backend: Any, kind: str, in_trace: bool = False, **extra: Any) 
             in_trace=bool(in_trace),
             source="event",
             extra=extra,
+            mono_ns=mono_ns,
+            wall_ns=wall_ns,
         )
     )

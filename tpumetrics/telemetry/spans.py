@@ -99,7 +99,10 @@ class Span:
     are deliberately absent (compare spans only within one process).
     """
 
-    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start_ns", "end_ns", "attrs", "thread")
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id", "start_ns", "end_ns",
+        "attrs", "thread", "wall_ns",
+    )
 
     def __init__(
         self,
@@ -119,6 +122,13 @@ class Span:
         self.end_ns = end_ns
         self.attrs = attrs
         self.thread = threading.get_ident()
+        # monotonic + wall clock PAIR anchored at the same instant: the
+        # monotonic clock orders spans exactly within a process, and the wall
+        # anchor lets timeline.py align streams from DIFFERENT processes/
+        # ranks onto one global axis (for a retroactive record_span the
+        # anchor is back-dated by the same monotonic distance, so the pair
+        # stays consistent)
+        self.wall_ns = time.time_ns() - (_now_ns() - start_ns)
 
     @property
     def duration_ms(self) -> Optional[float]:
@@ -139,6 +149,7 @@ class Span:
             "parent": self.parent_id,
             "start_ns": self.start_ns,
             "end_ns": self.end_ns,
+            "wall_ns": self.wall_ns,
             "duration_ms": self.duration_ms,
             "thread": self.thread,
             "attrs": dict(self.attrs),

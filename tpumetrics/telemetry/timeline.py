@@ -1,0 +1,290 @@
+"""Cross-rank timelines: merge per-rank JSONL streams, find the straggler.
+
+Every ledger record (and span) carries a **monotonic + wall clock pair**
+stamped at the same instant.  Within one process the monotonic clock orders
+events exactly; across processes the monotonic epochs differ, so this
+module aligns each per-rank stream onto one global axis using the pair:
+``offset = median(wall_ns - mono_ns)`` over the stream (the median rejects
+an NTP step mid-stream), and ``t_global_ns = mono_ns + offset`` — wall-
+anchored, monotonic-ordered.
+
+The soak workers already flush the global ledger to per-rank JSONL sinks
+(``<root>/telemetry/epochNNN-rankNNNNN.jsonl``); :func:`merge_timelines`
+turns that directory into one clock-aligned :class:`GlobalTimeline`, and:
+
+- :func:`collective_windows` groups the timeline's sync points — one
+  window per ``(kind, epoch, step)`` for step-stamped collectives like the
+  ``elastic_barrier`` each coordinated cut runs, k-th-occurrence matching
+  otherwise — and computes each window's **entry skew** across ranks;
+- :func:`straggler_report` names the slowest rank per window and the rank
+  that is slowest most often — "which rank is the straggler" as a first-
+  class answer instead of a grep;
+- :func:`to_perfetto` renders the merged timeline as Chrome trace-event
+  JSON (one process per rank) via
+  :func:`tpumetrics.telemetry.export.perfetto_trace`, so a whole soak
+  opens in Perfetto.
+
+``python -m tpumetrics.soak report <root>`` drives all three from the CLI,
+and the soak supervisor attaches the straggler summary to every incident
+line it emits.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+__all__ = [
+    "GlobalTimeline",
+    "collective_windows",
+    "load_rank_streams",
+    "merge_timelines",
+    "parse_jsonl",
+    "render_report",
+    "straggler_report",
+    "to_perfetto",
+]
+
+#: the soak worker's per-rank sink naming convention
+RANK_FILE_RE = re.compile(r"epoch(\d+)-rank(\d+)\.jsonl$")
+
+#: ledger kinds that are cross-rank sync points (every rank emits one per
+#: window); used by the default straggler analysis
+SYNC_KINDS = ("elastic_barrier",)
+
+
+@dataclass
+class GlobalTimeline:
+    """One clock-aligned, cross-rank event sequence.
+
+    ``events`` are the per-rank JSONL dicts, each augmented with ``rank``,
+    ``epoch``, and ``t_global_ns`` (wall-anchored global nanoseconds),
+    sorted by ``t_global_ns``.  ``offsets`` records the per-(rank, epoch)
+    wall−mono offset the alignment used.
+    """
+
+    events: List[Dict[str, Any]] = field(default_factory=list)
+    ranks: List[int] = field(default_factory=list)
+    offsets: Dict[Tuple[int, int], int] = field(default_factory=dict)
+
+    def span_ns(self) -> int:
+        if not self.events:
+            return 0
+        return self.events[-1]["t_global_ns"] - self.events[0]["t_global_ns"]
+
+    def by_rank(self) -> Dict[int, List[Dict[str, Any]]]:
+        out: Dict[int, List[Dict[str, Any]]] = {r: [] for r in self.ranks}
+        for e in self.events:
+            out.setdefault(e["rank"], []).append(e)
+        return out
+
+
+def _median(values: List[int]) -> int:
+    vals = sorted(values)
+    return vals[len(vals) // 2]
+
+
+def parse_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Parse one JSONL record stream (undecodable lines and non-dict values
+    are skipped — a killed worker can leave a torn tail, which is evidence,
+    not an error).  THE parse rule for per-rank telemetry: the supervisor's
+    incremental per-incident cache and :func:`load_rank_streams` both read
+    through here, so the two can never drift."""
+    records: List[Dict[str, Any]] = []
+    with open(path) as fh:
+        for line in fh:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+    return records
+
+
+def load_rank_streams(
+    directory: str,
+) -> Dict[Tuple[int, int], List[Dict[str, Any]]]:
+    """Parse every ``epochNNN-rankNNNNN.jsonl`` under ``directory`` into
+    ``{(rank, epoch): [record, ...]}``."""
+    streams: Dict[Tuple[int, int], List[Dict[str, Any]]] = {}
+    if not os.path.isdir(directory):
+        return streams
+    for name in sorted(os.listdir(directory)):
+        m = RANK_FILE_RE.search(name)
+        if not m:
+            continue
+        epoch, rank = int(m.group(1)), int(m.group(2))
+        records = parse_jsonl(os.path.join(directory, name))
+        if records:
+            streams.setdefault((rank, epoch), []).extend(records)
+    return streams
+
+
+def merge_timelines(
+    source: Union[str, Dict[Tuple[int, int], List[Dict[str, Any]]]],
+) -> GlobalTimeline:
+    """Align per-rank streams (a soak telemetry directory, or the mapping
+    :func:`load_rank_streams` returns) onto one global wall-anchored axis.
+
+    Records without a clock pair (``mono_ns == 0`` — written before PR 13,
+    or synthesized) fall back to their ``wall_ns`` (or 0) so old soak
+    output still merges, just with wall-clock precision only."""
+    streams = load_rank_streams(source) if isinstance(source, str) else source
+    timeline = GlobalTimeline()
+    for (rank, epoch), records in sorted(streams.items()):
+        pairs = [
+            (r["wall_ns"] - r["mono_ns"])
+            for r in records
+            if r.get("mono_ns") and r.get("wall_ns")
+        ]
+        offset = _median(pairs) if pairs else 0
+        timeline.offsets[(rank, epoch)] = offset
+        for rec in records:
+            rec = dict(rec)
+            rec["rank"] = rank
+            rec["epoch"] = epoch
+            mono = rec.get("mono_ns") or 0
+            rec["t_global_ns"] = (
+                mono + offset if mono else int(rec.get("wall_ns") or 0)
+            )
+            timeline.events.append(rec)
+    timeline.events.sort(key=lambda e: (e["t_global_ns"], e["rank"]))
+    timeline.ranks = sorted({e["rank"] for e in timeline.events})
+    return timeline
+
+
+def collective_windows(
+    timeline: GlobalTimeline, kinds: Tuple[str, ...] = SYNC_KINDS
+) -> List[Dict[str, Any]]:
+    """Group the timeline's sync-point records into cross-rank windows and
+    compute each window's entry skew.
+
+    Window identity: ``(kind, epoch, step)`` when the record's ``extra``
+    carries a ``step`` (the elastic barrier stamps one — every rank of a
+    coordinated cut shares it); otherwise the k-th occurrence of ``kind``
+    on each rank within the epoch (the lockstep contract: ranks issue sync
+    collectives in identical order, which ``verify_lockstep`` enforces at
+    runtime).  Each window reports per-rank entry times, the skew
+    (max − min, ms), and the slowest (last-arriving) rank."""
+    occurrence: Dict[Tuple[int, int, str], int] = {}
+    grouped: Dict[Tuple, Dict[int, int]] = {}
+    for e in timeline.events:
+        kind = e.get("kind")
+        if kind not in kinds:
+            continue
+        rank, epoch = e["rank"], e["epoch"]
+        step = (e.get("extra") or {}).get("step")
+        if step is not None:
+            key: Tuple = (kind, epoch, "step", step)
+        else:
+            i = occurrence.get((rank, epoch, kind), 0)
+            occurrence[(rank, epoch, kind)] = i + 1
+            key = (kind, epoch, "occ", i)
+        # first arrival per rank defines the rank's entry into the window
+        grouped.setdefault(key, {}).setdefault(rank, e["t_global_ns"])
+    windows = []
+    for key in sorted(grouped, key=lambda k: min(grouped[k].values())):
+        entries = grouped[key]
+        if len(entries) < 2:
+            continue  # a 1-rank window has no skew to speak of
+        t_min = min(entries.values())
+        t_max = max(entries.values())
+        slowest = max(entries, key=lambda r: (entries[r], r))
+        windows.append(
+            {
+                "kind": key[0],
+                "epoch": key[1],
+                "window": key[3],
+                "keyed_by": key[2],
+                "ranks": sorted(entries),
+                "entry_ns": {str(r): entries[r] for r in sorted(entries)},
+                "skew_ms": (t_max - t_min) / 1e6,
+                "slowest_rank": slowest,
+            }
+        )
+    return windows
+
+
+def straggler_report(
+    timeline: GlobalTimeline, kinds: Tuple[str, ...] = SYNC_KINDS
+) -> Dict[str, Any]:
+    """Who is holding the job back: per-window skew + the rank that arrives
+    last most often.  ``straggler`` is ``None`` when no multi-rank window
+    exists (a world-1 soak, or telemetry without sync kinds)."""
+    windows = collective_windows(timeline, kinds=kinds)
+    counts: Dict[int, int] = {}
+    for w in windows:
+        counts[w["slowest_rank"]] = counts.get(w["slowest_rank"], 0) + 1
+    straggler = (
+        max(counts, key=lambda r: (counts[r], -r)) if counts else None
+    )
+    return {
+        "windows": windows,
+        "n_windows": len(windows),
+        "slowest_counts": {str(r): n for r, n in sorted(counts.items())},
+        "straggler": straggler,
+        "max_skew_ms": max((w["skew_ms"] for w in windows), default=0.0),
+        "mean_skew_ms": (
+            sum(w["skew_ms"] for w in windows) / len(windows) if windows else 0.0
+        ),
+    }
+
+
+def to_perfetto(
+    timeline: GlobalTimeline, target: Optional[str] = None
+) -> Union[Dict[str, Any], str]:
+    """Render the merged timeline as Chrome trace-event JSON — one Perfetto
+    process per rank, the ``t_global_ns`` axis, every record exactly once
+    (records that are spans — ``type == "span"`` lines from a flight dump —
+    render as slices, ledger records as collective slices / instants)."""
+    from tpumetrics.telemetry import export as _export
+
+    span_like = [e for e in timeline.events if e.get("type") == "span"]
+    ledger_like = [e for e in timeline.events if e.get("type") != "span"]
+    return _export.perfetto_trace(
+        target,
+        span_list=span_like,
+        record_list=ledger_like,
+        rank_of=lambda d: int(d.get("rank", 0)),
+        process_names={r: f"rank {r}" for r in timeline.ranks},
+    )
+
+
+def render_report(
+    timeline: GlobalTimeline, report: Dict[str, Any], max_windows: int = 12
+) -> str:
+    """Human-readable straggler summary (the CLI's output)."""
+    lines = []
+    by_rank = timeline.by_rank()
+    lines.append(
+        f"timeline: {len(timeline.events)} events over {len(timeline.ranks)} "
+        f"rank(s), {timeline.span_ns() / 1e9:.3f}s span"
+    )
+    for rank in timeline.ranks:
+        lines.append(f"  rank {rank}: {len(by_rank.get(rank, []))} events")
+    lines.append(
+        f"sync windows: {report['n_windows']} "
+        f"(max skew {report['max_skew_ms']:.3f}ms, "
+        f"mean {report['mean_skew_ms']:.3f}ms)"
+    )
+    shown = report["windows"][:max_windows]
+    for w in shown:
+        lines.append(
+            f"  {w['kind']} epoch {w['epoch']} window {w['window']}: "
+            f"skew {w['skew_ms']:.3f}ms, slowest rank {w['slowest_rank']}"
+        )
+    if len(report["windows"]) > len(shown):
+        lines.append(f"  … {len(report['windows']) - len(shown)} more window(s)")
+    if report["straggler"] is not None:
+        lines.append(
+            f"straggler: rank {report['straggler']} "
+            f"(slowest in {report['slowest_counts'][str(report['straggler'])]}"
+            f"/{report['n_windows']} windows)"
+        )
+    else:
+        lines.append("straggler: none (no multi-rank sync window found)")
+    return "\n".join(lines)
