@@ -1,0 +1,825 @@
+"""The live introspection plane (ISSUE 15): admin endpoints, SLO burn-rate
+alerting, sketch-exact latency quantiles, and cross-rank federation.
+
+The acceptance spine lives in ``TestAcceptance``: a 2-tenant service with
+the admin server up and an SLO ruleset armed — an induced breach (a crashy
+tenant driving quarantine) flips ``/healthz`` to 503, emits EXACTLY ONE
+``slo_violation`` ledger event plus Prometheus series visible through a
+real HTTP scrape, while the neighbor tenant stays bit-identical to an
+unobserved functional run.  Around it: endpoint round-trip validators in
+the style of the Prometheus/flight validators, the ``/healthz``
+status-code matrix, the scrape-under-load non-blocking pin (a scrape
+returns while a deliberately slow device program is still in flight), SLO
+burn-rate unit tests over synthetic series, sketch-histogram parity and
+error-bound pins, federation merge semantics, and the gauge/histogram
+series-release-parity (stats-after-close) pin.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics.aggregation import MeanMetric
+from tpumetrics.classification import MulticlassAccuracy
+from tpumetrics.runtime import EvaluationService, StreamingEvaluator
+from tpumetrics.telemetry import export, federate, instruments, ledger, slo, spans
+from tpumetrics.telemetry.serve import AdminServer, start_admin_server
+
+
+@pytest.fixture(autouse=True)
+def _plane_hygiene():
+    """Spans/flight/ledger off and clean after every test; instruments stay
+    registered (process-global families) — tests mint uniquely-named ones
+    or clear only the series they wrote."""
+    yield
+    spans.disable()
+    spans.reset()
+    export.disable_flight_recorder()
+    ledger.disable()
+    ledger.reset()
+    instruments.enable()
+
+
+def _get(url, path, timeout=15):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+def _parse_prometheus(text):
+    """The exposition round-trip validator (same grammar as the exporter
+    pins in test_observability)."""
+    types = {}
+    samples = []
+    line_re = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+    label_re = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, typ = line.split(" ", 3)
+            assert typ in ("counter", "gauge", "histogram", "untyped"), line
+            types[name] = typ
+        elif line.startswith("#"):
+            continue
+        else:
+            m = line_re.match(line)
+            assert m, f"unparseable exposition line: {line!r}"
+            name, labels_raw, value = m.groups()
+            labels = dict(label_re.findall(labels_raw)) if labels_raw else {}
+            v = float("inf") if value == "+Inf" else float(value)
+            samples.append((name, labels, v))
+    return types, samples
+
+
+def _acc(classes=4):
+    return MulticlassAccuracy(num_classes=classes, average="micro", validate_args=False)
+
+
+def _batch(classes=4, seed=0, rows=5):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.standard_normal((rows, classes)), jnp.float32),
+        jnp.asarray(rng.integers(0, classes, rows), jnp.int32),
+    )
+
+
+# ------------------------------------------------------- sketch histograms
+
+
+class TestSketchHistogram:
+    def test_bin_parity_with_device_sketch(self):
+        """The host-side binning is BIT-identical to SketchLayout's: the
+        'dogfooded' claim, pinned — the two geometries can never drift."""
+        from tpumetrics.monitoring.sketch import SketchLayout
+
+        lay = SketchLayout()  # the shared defaults (levels=44, capacity=64)
+        assert (lay.levels, lay.capacity) == (
+            instruments.SKETCH_LEVELS, instruments.SKETCH_CAPACITY,
+        )
+        rng = np.random.default_rng(0)
+        vals = np.concatenate([
+            rng.lognormal(0, 3, 1500),
+            -rng.lognormal(0, 2, 400),
+            [0.0, 1e-9, 8.3e6, 1e9, np.inf, -np.inf],
+        ]).astype(np.float32)
+        dev = np.asarray(lay.bucket_index(jnp.asarray(vals)))
+        host = np.array([instruments.sketch_index(float(v)) for v in vals])
+        assert np.array_equal(dev, host)
+
+    def test_quantile_error_bound(self):
+        """Sketch-mode quantiles honor the documented relative-error bound
+        (<= 1/capacity) — where fixed-grid interpolation on the default
+        millisecond edges can be off by the whole bucket width."""
+        h = instruments.Histogram("plane_sketch_bound_ms", sketch=True)
+        rng = np.random.default_rng(3)
+        data = rng.lognormal(1.5, 1.2, 30000)
+        for v in data:
+            h.observe(float(v))
+        bound = 1.0 / instruments.SKETCH_CAPACITY
+        for q in (0.5, 0.9, 0.99, 0.999):
+            est = h.quantile(q)
+            exact = float(np.quantile(data, q))
+            assert abs(est - exact) / exact <= bound, (q, est, exact)
+        # the exact envelope still holds: q=1 clamps to the tracked max
+        assert h.quantile(1.0) == pytest.approx(float(data.max()))
+
+    def test_exposition_is_unchanged_by_sketch_mode(self):
+        """Sketch mode is a quantile/federation upgrade — the Prometheus
+        exposition (fixed le-grid) must stay identical in shape."""
+        plain = instruments.Histogram("plane_sk_plain_ms", buckets=(1.0, 10.0))
+        sk = instruments.Histogram("plane_sk_mode_ms", buckets=(1.0, 10.0), sketch=True)
+        for v in (0.5, 5.0, 50.0):
+            plain.observe(v)
+            sk.observe(v)
+        d_plain = dict(plain.collect())[()]
+        d_sk = dict(sk.collect())[()]
+        assert d_plain["buckets"] == d_sk["buckets"]
+        assert d_plain["count"] == d_sk["count"] and d_plain["sum"] == d_sk["sum"]
+        assert "sketch" in d_sk and "sketch" not in d_plain
+
+    def test_runtime_histograms_are_sketch_backed(self):
+        """The shared submit/dispatch/restore/drain families really run in
+        sketch mode (the tentpole's 'dogfood into the instruments layer')."""
+        import tpumetrics.runtime.evaluator  # noqa: F401 — registers them
+
+        for name in (
+            instruments.SUBMIT_LATENCY_MS,
+            instruments.DISPATCH_LATENCY_MS,
+            instruments.RESTORE_LATENCY_MS,
+            instruments.DRAIN_LATENCY_MS,
+        ):
+            inst = instruments.get_instrument(name)
+            assert isinstance(inst, instruments.Histogram) and inst.sketch, name
+
+    def test_get_or_create_ignores_later_sketch_flag(self):
+        a = instruments.histogram("plane_sk_contract_ms", sketch=True)
+        b = instruments.histogram("plane_sk_contract_ms")  # no sketch: ignored
+        assert a is b and a.sketch
+
+
+# ------------------------------------------------------------- federation
+
+
+class TestFederation:
+    def _snapshots(self):
+        h = instruments.Histogram("fed_lat_ms", labels=("stream",), sketch=True)
+        c = instruments.Counter("fed_total", labels=("stream",))
+        rng = np.random.default_rng(7)
+        a = rng.lognormal(1.0, 1.0, 4000)
+        b = rng.lognormal(2.0, 0.5, 4000)
+        for v in a:
+            h.observe(float(v), "r0")
+        c.inc(3, "r0")
+        fam_h, fam_c = h.to_dict(), c.to_dict()
+        snap0 = {"v": 1, "rank": 0, "instruments": [fam_h, fam_c],
+                 "ledger": {"counts_by_kind": {"elastic_restore": 1}}}
+        h.clear()
+        c.clear()
+        for v in b:
+            h.observe(float(v), "r0")  # same label tuple on purpose: merges
+        c.inc(5, "r0")
+        snap1 = {"v": 1, "rank": 1, "instruments": [h.to_dict(), c.to_dict()],
+                 "ledger": {"counts_by_kind": {"elastic_restore": 2}}}
+        h.clear()
+        c.clear()
+        # JSON round trip: snapshots travel over the soak's stdio wire
+        return json.loads(json.dumps(snap0)), json.loads(json.dumps(snap1)), a, b
+
+    def test_merge_is_exact_bound_and_sums(self):
+        snap0, snap1, a, b = self._snapshots()
+        view = federate.merge_snapshots([snap0, snap1])
+        allv = np.concatenate([a, b])
+        bound = 1.0 / instruments.SKETCH_CAPACITY
+        for q in (0.5, 0.99):
+            est = view.quantile("fed_lat_ms", q)
+            exact = float(np.quantile(allv, q))
+            assert abs(est - exact) / exact <= bound, (q, est, exact)
+        types, samples = _parse_prometheus(view.prometheus_text())
+        assert types["fed_lat_ms"] == "histogram"
+        assert ("fed_total", {"stream": "r0"}, 8.0) in samples
+        assert ("tpumetrics_ledger_events_total", {"kind": "elastic_restore"}, 3.0) in samples
+        status = view.statusz()
+        assert status["world"] == 2 and status["ranks"] == [0, 1]
+
+    def test_mismatched_edges_refused(self):
+        h1 = instruments.Histogram("fed_bad_a", buckets=(1.0, 2.0))
+        h2 = instruments.Histogram("fed_bad_a", buckets=(1.0, 3.0))
+        h1.observe(0.5)
+        h2.observe(0.5)
+        s0 = {"v": 1, "rank": 0, "instruments": [h1.to_dict()], "ledger": None}
+        s1 = {"v": 1, "rank": 1, "instruments": [h2.to_dict()], "ledger": None}
+        with pytest.raises(federate.FederationError):
+            federate.merge_snapshots([s0, s1])
+
+    def test_local_snapshot_is_json_roundtrippable(self):
+        c = instruments.counter("fed_local_total")
+        c.clear()
+        c.inc(2)
+        snap = json.loads(json.dumps(federate.local_snapshot(rank=9)))
+        assert snap["rank"] == 9 and snap["v"] == 1
+        names = {f["name"] for f in snap["instruments"]}
+        assert "fed_local_total" in names
+        c.clear()
+
+
+# --------------------------------------------------------- admin endpoints
+
+
+class TestAdminEndpoints:
+    def test_metrics_identical_to_prometheus_text_and_parses(self):
+        c = instruments.counter("plane_metrics_total", labels=("who",))
+        c.clear()
+        c.inc(4, "x")
+        with start_admin_server() as srv:
+            st, ctype, body = _get(srv.url, "/metrics")
+        assert st == 200 and ctype.startswith("text/plain")
+        assert body.decode() == export.prometheus_text()
+        types, samples = _parse_prometheus(body.decode())
+        assert ("plane_metrics_total", {"who": "x"}, 4.0) in samples
+        c.clear()
+
+    def test_statusz_schema_pinned(self):
+        """The /statusz JSON schema is a contract: top-level keys, target
+        entry keys, and the per-tenant section (stats incl. the device
+        section, queue depth, DRR share, signature-cache occupancy)."""
+        svc = EvaluationService(admin_port=0)
+        try:
+            h = svc.register("t0", _acc(), buckets=[8], quota=32.0)
+            h.submit(*_batch())
+            h.flush()
+            st, ctype, body = _get(svc.admin.url, "/statusz")
+            assert st == 200 and ctype.startswith("application/json")
+            payload = json.loads(body)
+            assert {"name", "uptime_s", "scrapes", "targets", "slo"} <= set(payload)
+            (target,) = payload["targets"].values()
+            assert target["kind"] == "service"
+            # service-wide stats: queue + signature-cache occupancy
+            assert {"depth", "tenants", "signatures_tracked", "shared_steps"} <= set(
+                target["stats"]
+            )
+            tenant = target["tenants"]["t0"]
+            # the per-tenant contract: stream counters, queue depth, the DRR
+            # share, and the stats() observability sections incl. device
+            assert {"batches", "depth", "pending", "quota", "latency",
+                    "device", "quarantined", "degraded"} <= set(tenant)
+            assert tenant["quota"] == 32.0
+            assert {"programs", "hbm", "health"} <= set(tenant["device"])
+        finally:
+            svc.close()
+
+    def test_spanz_serves_the_ring(self):
+        spans.enable()
+        with spans.span("plane_spanz_probe", k=1):
+            pass
+        with start_admin_server() as srv:
+            st, _, body = _get(srv.url, "/spanz?limit=5")
+        payload = json.loads(body)
+        assert st == 200 and payload["enabled"] is True
+        assert any(sp["name"] == "plane_spanz_probe" for sp in payload["spans"])
+
+    def test_flightz_triggers_and_downloads(self, tmp_path):
+        export.enable_flight_recorder(str(tmp_path))
+        export.note_incident("plane_flight_probe", detail=1)
+        with start_admin_server() as srv:
+            st, ctype, body = _get(srv.url, "/flightz")
+        assert st == 200 and "ndjson" in ctype
+        lines = [json.loads(l) for l in body.decode().splitlines()]
+        assert lines[0]["type"] == "flight_header"
+        assert lines[0]["reason"] == "admin_flightz"
+        assert any(
+            l.get("type") == "incident" and l.get("kind") == "plane_flight_probe"
+            for l in lines
+        )
+
+    def test_flightz_404_without_recorder(self):
+        export.disable_flight_recorder()
+        with start_admin_server() as srv:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(srv.url, "/flightz")
+            assert err.value.code == 404
+
+    def test_unknown_path_404_and_root_lists_endpoints(self):
+        with start_admin_server() as srv:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(srv.url, "/nope")
+            assert err.value.code == 404
+            st, _, body = _get(srv.url, "/")
+            assert st == 200 and "/metrics" in json.loads(body)["endpoints"]
+
+    def test_close_is_idempotent_and_frees_the_port(self):
+        srv = start_admin_server()
+        srv.close()
+        srv.close()
+        with pytest.raises(Exception):
+            _get(srv.url, "/healthz", timeout=2)
+
+
+# ------------------------------------------------------- /healthz matrix
+
+
+class _FakeTarget:
+    """A duck-typed evaluator: /healthz only ever reads stats()."""
+
+    def __init__(self, **overrides):
+        self._stats = {
+            "degraded": False, "quarantined": False,
+            "device": {"health": {"nonfinite_total": 0}},
+        }
+        self._stats.update(overrides)
+
+    def stats(self):
+        return dict(self._stats)
+
+
+class TestHealthzMatrix:
+    def test_healthy_200(self):
+        with AdminServer(targets={"ev": _FakeTarget()}) as srv:
+            st, _, body = _get(srv.url, "/healthz")
+        assert st == 200 and json.loads(body)["status"] == "ok"
+
+    def test_degraded_mode_503(self):
+        with AdminServer(targets={"ev": _FakeTarget(degraded=True)}) as srv:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(srv.url, "/healthz")
+        assert err.value.code == 503
+        payload = json.loads(err.value.read())
+        assert payload["status"] == "degraded"
+        assert any(r.startswith("degraded:") for r in payload["reasons"])
+
+    def test_state_health_503(self):
+        bad = _FakeTarget(device={"health": {"nonfinite_total": 3}})
+        with AdminServer(targets={"ev": bad}) as srv:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(srv.url, "/healthz")
+        assert err.value.code == 503
+        payload = json.loads(err.value.read())
+        assert any(r.startswith("state_health:") for r in payload["reasons"])
+        assert payload["streams"]["ev"]["state_nonfinite"] == 3
+
+    def test_quarantined_tenant_503_names_the_tenant(self):
+        svc = EvaluationService(admin_port=0)
+        try:
+            good = svc.register("good", MeanMetric())
+            bad = svc.register("bad", _Crashy())
+            good.submit(jnp.asarray([1.0]))
+            bad.submit(jnp.asarray([np.inf]))  # the poison trigger
+            good.flush()
+            with pytest.raises(Exception):
+                bad.flush()
+            assert bad.quarantined
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(svc.admin.url, "/healthz")
+            assert err.value.code == 503
+            payload = json.loads(err.value.read())
+            assert any("quarantined" in r and "bad" in r for r in payload["reasons"])
+            # the healthy neighbor is visible and clean in the same body
+            key = [k for k in payload["streams"] if k.endswith("/good")][0]
+            assert payload["streams"][key]["quarantined"] is False
+        finally:
+            svc.close()
+
+    def test_latched_slo_breach_503_then_rearmed_200(self):
+        vals = [0.0]
+        rule = slo.SloRule(
+            "probe", lambda: vals[0], 1.0, budget=0.5,
+            fast_window_s=10.0, fast_burn=1.9, slow_window_s=100.0, slow_burn=1.9,
+            hysteresis=0.1,
+        )
+        engine = slo.SloEngine([rule], clock=lambda: 0.0)
+        with AdminServer(slo=engine) as srv:
+            st, _, _ = _get(srv.url, "/healthz")
+            assert st == 200
+            vals[0] = 5.0
+            for t in range(10):
+                engine.tick(float(t))
+            assert engine.breached() == ["probe"]
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(srv.url, "/healthz")
+            assert err.value.code == 503
+            payload = json.loads(err.value.read())
+            assert payload["slo_breached"] == ["probe"]
+            assert any(r.startswith("slo_breach:") for r in payload["reasons"])
+            # recovery: good samples wash the windows, the latch re-arms,
+            # /healthz goes green again
+            vals[0] = 0.0
+            for t in range(10, 130):
+                engine.tick(float(t))
+            assert engine.breached() == []
+            st, _, _ = _get(srv.url, "/healthz")
+            assert st == 200
+        engine.close()
+
+
+class _Crashy(MeanMetric):
+    """Eager-path metric that poisons on a non-finite batch."""
+
+    def update(self, value):
+        if bool(jnp.any(jnp.isinf(value))):
+            raise RuntimeError("poisoned batch")
+        super().update(value)
+
+
+# ------------------------------------------------- SLO burn-rate semantics
+
+
+class TestSloBurnRate:
+    """Synthetic-series unit tests: fast-burn pages, slow-burn pages,
+    recovery re-arms below threshold - hysteresis, exactly once per
+    crossing, series release on close."""
+
+    def _engine(self, vals, **kw):
+        kw.setdefault("budget", 0.1)
+        kw.setdefault("fast_window_s", 60.0)
+        kw.setdefault("fast_burn", 8.0)
+        kw.setdefault("slow_window_s", 600.0)
+        kw.setdefault("slow_burn", 2.0)
+        kw.setdefault("hysteresis", 0.2)
+        rule = slo.SloRule("r", lambda: vals[0], 10.0, **kw)
+        return slo.SloEngine([rule], clock=lambda: 0.0), rule
+
+    def test_fast_burn_pages(self):
+        vals = [1.0]
+        eng, rule = self._engine(vals)
+        for t in range(300):
+            eng.tick(float(t))
+        assert eng.violations() == 0
+        vals[0] = 99.0  # 100% bad: fast burn = 1/0.1 = 10 >= 8 within 60s
+        for t in range(300, 360):
+            eng.tick(float(t))
+        assert eng.violations("r") == 1 and eng.breached() == ["r"]
+        fast, _slow = rule.burn_rates(359.0)
+        assert fast >= 8.0
+        eng.close()
+
+    def test_slow_burn_pages_without_fast(self):
+        vals = [1.0]
+        eng, rule = self._engine(vals)
+        # 30% duty-cycle badness: fast burn ~3 (< 8, never a fast page),
+        # slow burn ~3 (>= 2) once the slow window fills — the simmer case
+        for t in range(600):
+            vals[0] = 99.0 if t % 10 < 3 else 1.0
+            eng.tick(float(t))
+        fast, slow = rule.burn_rates(599.0)
+        assert fast < 8.0 <= 10.0 and slow >= 2.0
+        assert eng.violations("r") == 1
+        eng.close()
+
+    def test_exactly_once_per_crossing_and_rearm_needs_hysteresis(self):
+        vals = [99.0]
+        eng, rule = self._engine(vals)
+        for t in range(120):
+            eng.tick(float(t))
+        assert eng.violations("r") == 1  # continued breach: still ONE event
+        # drop to good: the breach stays latched until the worst normalized
+        # burn falls below 1 - hysteresis, then re-arms; a NEW crossing
+        # pages exactly once more
+        vals[0] = 1.0
+        for t in range(120, 800):
+            eng.tick(float(t))
+        assert eng.breached() == []
+        vals[0] = 99.0
+        for t in range(800, 900):
+            eng.tick(float(t))
+        assert eng.violations("r") == 2
+        eng.close()
+
+    def test_violation_emits_ledger_event_series_and_notifier(self, tmp_path):
+        ledger.enable()
+        ledger.reset()
+        notes = []
+        out = str(tmp_path / "pages.jsonl")
+        vals = [99.0]
+        rule = slo.SloRule(
+            "page_me", lambda: vals[0], 10.0, budget=0.1,
+            fast_window_s=60.0, fast_burn=5.0, slow_window_s=600.0, slow_burn=2.0,
+        )
+        eng = slo.SloEngine(
+            [rule], notifiers=(notes.append, slo.jsonl_notifier(out)),
+            clock=lambda: 0.0,
+        )
+        for t in range(60):
+            eng.tick(float(t))
+        assert ledger.summary()["slo_violations"] == 1
+        assert ledger.summary()["counts_by_kind"]["slo_violation"] == 1
+        assert len(notes) == 1 and notes[0]["slo"] == "page_me"
+        with open(out) as fh:
+            lines = [json.loads(l) for l in fh]
+        assert len(lines) == 1 and lines[0]["type"] == "slo_violation"
+        # the series are live while the engine is
+        burn = instruments.get_instrument(instruments.SLO_BURN_RATE)
+        viol = instruments.get_instrument(instruments.SLO_VIOLATIONS)
+        assert burn.value("page_me") > 0
+        assert viol.value("page_me") == 1
+        eng.close()
+        # ... and released on close (the series-release contract)
+        assert ("page_me",) not in dict(burn.collect())
+        assert ("page_me",) not in dict(viol.collect())
+
+    def test_raising_notifier_and_signal_never_fatal(self):
+        def bad_notify(payload):
+            raise RuntimeError("pager down")
+
+        calls = [0]
+
+        def flaky_signal():
+            calls[0] += 1
+            if calls[0] % 2:
+                raise RuntimeError("scrape failed")
+            return 99.0
+
+        rule = slo.SloRule(
+            "flaky", flaky_signal, 10.0, budget=0.1,
+            fast_window_s=60.0, fast_burn=5.0,
+        )
+        eng = slo.SloEngine([rule], notifiers=(bad_notify,), clock=lambda: 0.0)
+        for t in range(60):
+            eng.tick(float(t))
+        status = eng.status()
+        assert eng.violations("flaky") == 1  # still paged despite both
+        assert status["notify_errors"] == 1
+        eng.close()
+
+    def test_armed_sampler_thread_ticks_and_stops(self):
+        vals = [99.0]
+        rule = slo.SloRule(
+            "armed", lambda: vals[0], 10.0, budget=0.5,
+            fast_window_s=5.0, fast_burn=1.9,
+        )
+        eng = slo.SloEngine([rule], sample_every_s=0.02)
+        with eng:
+            deadline = time.monotonic() + 5.0
+            while eng.violations("armed") == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+        assert eng.violations("armed") == 1
+        assert eng.status()["armed"] is False
+
+
+# ---------------------------------------- series release (stats-after-close)
+
+
+class TestSeriesReleaseParity:
+    def _series_with_label(self, label):
+        hits = []
+        for inst in instruments.registry():
+            for lv, _v in inst.collect():
+                if label in lv:
+                    hits.append((inst.name, lv))
+        return hits
+
+    def test_evaluator_close_releases_every_series_kind(self):
+        """The satellite pin: counters, HISTOGRAMS and GAUGES all honor the
+        same remove() contract — after close() not one series registry-wide
+        still carries the evaluator's auto-minted stream label, and a
+        stats() read AFTER close must not re-mint any (the gauge parity
+        half: state-HBM/journal gauges write on the stats() path)."""
+        ev = StreamingEvaluator(
+            _acc(), buckets=[8], crash_policy="restore", health_probe=True
+        )
+        ev.submit(*_batch())
+        ev.flush()
+        stream = ev._stream
+        ev.stats()  # mints the state-HBM gauge series via the stats() path
+        assert self._series_with_label(stream), "nothing was minted at all?"
+        ev.close()
+        assert self._series_with_label(stream) == []
+        ev.stats()  # the post-close read must NOT re-mint released series
+        assert self._series_with_label(stream) == []
+
+    def test_service_close_releases_every_series_kind(self):
+        svc = EvaluationService()
+        label = svc._label
+        h = svc.register("parity_t0", _acc(), buckets=[8], health_probe=True)
+        h.submit(*_batch())
+        h.flush()
+        h.stats()
+        assert self._series_with_label("parity_t0")
+        assert self._series_with_label(label)
+        svc.close()
+        assert self._series_with_label("parity_t0") == []
+        assert self._series_with_label(label) == []
+        svc.tenant_stats("parity_t0")  # post-close stats: no re-mint
+        assert self._series_with_label("parity_t0") == []
+
+
+# ------------------------------------------- scrape never blocks on device
+
+
+class _SlowStep(MeanMetric):
+    """A metric whose jitted step program takes ~0.5s of device time (a
+    chain of large matmuls, value-preserving), so an in-flight dispatch is
+    easy to catch mid-execution.  Dispatch itself stays async (~0.1ms) —
+    which is exactly the property the scrape pin relies on."""
+
+    def update(self, value):
+        pad = jnp.ones((1600, 1600), value.dtype) * jnp.mean(value)
+        for _ in range(8):
+            pad = pad @ pad / 1600.0
+        super().update(value + 0.0 * pad[0, : value.shape[0]])
+
+
+class TestScrapeNeverBlocks:
+    def test_scrape_mid_dispatch_returns_without_device_sync(self):
+        """THE non-blocking pin: with ~2s of device work in flight,
+        /metrics, /healthz and /statusz all answer in a fraction of that —
+        a handler that synchronized with the device (device_get on a
+        pending output, a lock held through execution, block_until_ready
+        anywhere) would take about as long as the queue.  Handlers
+        additionally run under the device→host transfer guard."""
+        ev = StreamingEvaluator(_SlowStep(), buckets=[4], admin_port=0)
+        try:
+            warm = jnp.asarray([1.0, 2.0])
+            ev.submit(warm)  # first batch pays the compile
+            ev.compute()  # synchronize: the timed window is execution-only
+            t_exec0 = time.perf_counter()
+            ev.submit(warm)
+            ev.flush()
+            jax.block_until_ready(jax.tree_util.tree_leaves(ev._state))
+            step_s = time.perf_counter() - t_exec0  # one warm step's wall
+            n_flight = 4
+            for _ in range(n_flight):
+                ev.submit(warm)
+            url = ev.admin.url
+            t0 = time.perf_counter()
+            for path in ("/healthz", "/statusz", "/metrics"):
+                st, _, _ = _get(url, path)
+                assert st == 200, path
+            elapsed = time.perf_counter() - t0
+            assert elapsed < max(0.5, 0.5 * n_flight * step_s), (
+                f"scrapes took {elapsed:.2f}s against ~{n_flight * step_s:.1f}s "
+                "of in-flight device work: a handler synchronized with the "
+                "dispatch"
+            )
+            ev.flush()
+            assert float(ev.compute()) == pytest.approx(1.5)
+        finally:
+            ev.close()
+
+
+# -------------------------------------------------- supervisor federation
+
+
+class TestSupervisorFederation:
+    def _supervisor(self, tmp_path):
+        from tpumetrics.soak.schedule import ChaosSchedule, Incident
+        from tpumetrics.soak.supervisor import SoakSupervisor
+
+        sched = ChaosSchedule(
+            seed=0, world=2,
+            incidents=(Incident(kind="sigterm", feed=4, world_after=2),),
+        )
+        return SoakSupervisor(sched, str(tmp_path / "soak"))
+
+    def test_federated_admin_endpoint_serves_merged_pool(self, tmp_path):
+        sup = self._supervisor(tmp_path)
+        h = instruments.Histogram(
+            instruments.SUBMIT_LATENCY_MS, labels=("stream",), sketch=True
+        )
+        h.observe(1.0, "w")
+        fam = h.to_dict()
+        snap = {"v": 1, "instruments": [fam],
+                "ledger": {"counts_by_kind": {"elastic_restore": 1}}}
+        sup._fed_snapshots = {
+            0: json.loads(json.dumps({**snap, "rank": 0})),
+            1: json.loads(json.dumps({**snap, "rank": 1})),
+        }
+        srv = sup.start_admin(0)
+        try:
+            st, _, body = _get(srv.url, "/metrics")
+            types, samples = _parse_prometheus(body.decode())
+            assert st == 200
+            # the merged view: BOTH ranks' counts summed into one family
+            count = [
+                v for name, labels, v in samples
+                if name == instruments.SUBMIT_LATENCY_MS + "_count"
+            ]
+            assert count == [2.0]
+            assert ("tpumetrics_ledger_events_total",
+                    {"kind": "elastic_restore"}, 2.0) in samples
+            # ?local=1 falls back to THIS process's registry
+            st, _, local_body = _get(srv.url, "/metrics?local=1")
+            assert local_body.decode() == export.prometheus_text()
+            st, _, statusz = _get(srv.url, "/statusz")
+            fed = json.loads(statusz)["federation"]
+            assert fed["world"] == 2 and fed["ranks"] == [0, 1]
+            assert fed["latency"]["submit_ms"]["p99"] is not None
+        finally:
+            srv.close()
+            sup._admin = None
+
+        summary = sup.federation_summary()
+        assert summary["world"] == 2
+        assert summary["ledger_events"]["elastic_restore"] == 2
+
+    def test_slo_summary_never_fatal_and_counts_breaches(self, tmp_path):
+        sup = self._supervisor(tmp_path)
+        out = sup._slo_summary()
+        assert out == {"breaches": 0, "breached": [], "worst_burn_rate": 0.0}
+        # an induced failure drives the standing unrecovered rule to page
+        sup._unrecovered = 1
+        out = sup._slo_summary()
+        assert out["breaches"] == 1 and "soak_unrecovered" in out["breached"]
+        sup._slo.close()
+
+
+# ---------------------------------------------------------- THE acceptance
+
+
+class TestAcceptance:
+    def test_breach_flips_healthz_pages_once_and_neighbor_stays_bit_identical(
+        self, tmp_path
+    ):
+        """ISSUE 15 acceptance: a 2-tenant service with the admin server up
+        and an SLO ruleset armed — the crashy tenant's quarantine flips
+        /healthz, emits exactly ONE slo_violation ledger event + Prometheus
+        series visible via a real HTTP scrape, and the neighbor tenant's
+        result is BIT-identical to an unobserved functional run."""
+        ledger.enable()
+        ledger.reset()
+        batches = [_batch(seed=s, rows=4 + s % 3) for s in range(6)]
+
+        # the unobserved baseline: a plain functional run, no admin plane
+        oracle = _acc()
+        s = oracle.init_state()
+        for p, t in batches:
+            s = oracle.functional_update(s, p, t)
+        want = np.asarray(oracle.functional_compute(s))
+
+        svc = EvaluationService(admin_port=0)
+        engine = slo.SloEngine(
+            slo.standard_rules(
+                svc, submit_p99_ms=10_000.0, queue_depth_max=1e6,
+                budget=1e-3, fast_window_s=60.0, fast_burn=1.0,
+                slow_window_s=600.0, slow_burn=1.0,
+            ),
+            clock=lambda: 0.0,
+        )
+        svc.admin.add_slo(engine)
+        url = svc.admin.url
+        try:
+            good = svc.register("good", _acc(), buckets=[8])
+            bad = svc.register("bad", _Crashy())
+            for p, t in batches:
+                good.submit(p, t)
+            bad.submit(jnp.asarray([1.0]))
+            engine.tick(0.0)  # healthy sample before the incident
+            st, _, _ = _get(url, "/healthz")
+            assert st == 200
+
+            # induce the breach: poison the crashy tenant -> quarantine
+            bad.submit(jnp.asarray([np.inf]))
+            with pytest.raises(Exception):
+                bad.flush()
+            assert bad.quarantined
+            for t_s in range(1, 5):
+                engine.tick(float(t_s))  # the quarantine drives the rule bad
+
+            # 1) /healthz flipped, naming both the tenant and the SLO
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(url, "/healthz")
+            assert err.value.code == 503
+            payload = json.loads(err.value.read())
+            assert "quarantined_tenants" in payload["slo_breached"]
+            assert any("bad" in r for r in payload["reasons"])
+
+            # 2) exactly ONE slo_violation ledger event, despite 4 breach
+            # ticks (the hysteresis latch) + the quarantine event itself
+            assert ledger.summary()["slo_violations"] == 1
+            assert ledger.summary()["tenant_quarantines"] == 1
+
+            # 3) the series are visible via a REAL HTTP scrape
+            st, _, body = _get(url, "/metrics")
+            types, samples = _parse_prometheus(body.decode())
+            assert types[instruments.SLO_VIOLATIONS] == "counter"
+            assert (
+                instruments.SLO_VIOLATIONS,
+                {"slo": "quarantined_tenants"}, 1.0,
+            ) in samples
+            assert any(
+                name == instruments.SLO_BURN_RATE
+                and labels == {"slo": "quarantined_tenants"} and v > 0
+                for name, labels, v in samples
+            )
+            assert any(
+                name == "tpumetrics_ledger_events_total"
+                and labels == {"kind": "slo_violation"} and v == 1.0
+                for name, labels, v in samples
+            )
+
+            # 4) the neighbor tenant is untouched: bit-identical to the
+            # unobserved functional run
+            got = np.asarray(good.compute())
+            assert np.array_equal(got, want)
+        finally:
+            engine.close()
+            svc.close()
+        # the engine + service released their series (stats-after-close)
+        burn = instruments.get_instrument(instruments.SLO_BURN_RATE)
+        assert ("quarantined_tenants",) not in dict(burn.collect())

@@ -220,6 +220,89 @@ def test_host_health_read_near_miss_negative():
     assert "TPL105" not in _codes(found)
 
 
+# ------------------------------------------------------------------- TPL106
+SERVING_LAYER_TP = _src(
+    """
+    import jax
+    import jax.numpy as jnp
+    from http.server import BaseHTTPRequestHandler
+    from tpumetrics.metric import Metric
+    from tpumetrics.telemetry.serve import start_admin_server
+
+    class M(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, preds, target):
+            start_admin_server(0)                  # a server per traced step!
+            self.total = self.total + jnp.sum(preds)
+
+        def compute(self):
+            return self.total
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = self._render()                  # handler-reachable helper
+            self.wfile.write(body)
+
+        def _render(self):
+            # a scrape synchronizing with the in-flight dispatch: the exact
+            # stall the strict-reader discipline forbids
+            return str(jax.device_get(self._state)).encode()
+    """
+)
+
+SERVING_LAYER_NEAR_MISS = _src(
+    """
+    import jax
+    import jax.numpy as jnp
+    from http.server import BaseHTTPRequestHandler
+    from tpumetrics.metric import Metric
+    from tpumetrics.telemetry.serve import start_admin_server
+    from tpumetrics.telemetry.export import prometheus_text
+
+    class M(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+            # construction seam: the runtime owns the server's lifecycle
+            self.admin = start_admin_server(0)
+
+        def update(self, preds, target):
+            self.total = self.total + jnp.sum(preds)
+
+        def compute(self):
+            return self.total
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            # a pure host-side reader: instrument locks only, no device
+            self.wfile.write(prometheus_text().encode())
+
+    def offline_reader(state):
+        # blocking reads are fine OUTSIDE handler/sampler paths (this is
+        # what compute()-side readers do)
+        return jax.device_get(state)
+    """
+)
+
+
+def test_serving_layer_true_positives():
+    found = analyze_source(SERVING_LAYER_TP)
+    codes = _codes(found)
+    # the update()-reachable server start AND the handler-reachable
+    # blocking read (through the module-local helper) are both findings
+    assert codes.count("TPL106") == 2
+
+
+def test_serving_layer_near_miss_negative():
+    # constructor-seam server starts, pure host-reader handlers, and
+    # blocking reads outside serving paths must not trigger
+    found = analyze_source(SERVING_LAYER_NEAR_MISS)
+    assert "TPL106" not in _codes(found)
+
+
 def test_host_telemetry_reachable_helper_is_flagged():
     src = _src(
         """

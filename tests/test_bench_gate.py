@@ -65,6 +65,11 @@ def test_floor_file_shape():
     # latency must stay enqueue-shaped
     assert data["floors"]["multitenant_scaling"] >= 2.0
     assert data["multitenant_ceilings"]["soak_p99_submit_ms"] > 0
+    # the admin-plane gates (ISSUE 15): a scrape of the loaded 1000-tenant
+    # service stays reader-cheap, and a live scraper adds ~zero dispatch-
+    # path overhead (the server has no hook on the submit path at all)
+    assert data["admin_plane_ceilings"]["scrape_ms_p99"] > 0
+    assert data["admin_plane_ceilings"]["dispatch_overhead_ratio"] <= 2.0
     # the observability gate pins the DISABLED span path to ~a flag test and
     # the always-on instruments to submit-path-cheap
     assert data["observability_overhead_ceilings"]["inert_span_ns_per_call"] > 0
@@ -117,6 +122,25 @@ def test_check_floors_flags_multitenant_regressions():
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and all("multitenant_scaling" in v for v in violations)
     details["multitenant_scaling"] = "error: AssertionError: parity broke"
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and "scenario failed" in violations[0]
+
+
+def test_check_floors_flags_admin_plane_regressions():
+    """A scrape p99 past the ceiling (the scrape synchronized with the
+    device, or camped on the service lock through a dispatch), a live
+    scraper adding real submit-path overhead, and an errored scenario (the
+    /metrics identity + health asserts never ran) must all trip the gate."""
+    details = {"admin_plane": {"scrape_ms_p99": 10.0, "dispatch_overhead_ratio": 1.0}}
+    assert bench._check_floors(headline_vs=1000.0, details=details) == []
+    details["admin_plane"]["scrape_ms_p99"] = 60000.0
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("scrape_ms_p99" in v for v in violations)
+    details["admin_plane"]["scrape_ms_p99"] = 10.0
+    details["admin_plane"]["dispatch_overhead_ratio"] = 5.0
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("dispatch_overhead_ratio" in v for v in violations)
+    details["admin_plane"] = "error: AssertionError: scrape failed under load"
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and "scenario failed" in violations[0]
 

@@ -198,6 +198,12 @@ def test_chaos_soak_short(tmp_path):
         assert rec["straggler"] is not None and "error" not in rec["straggler"], rec
         assert rec["straggler"]["n_windows"] >= 1
         assert rec["straggler"]["straggler"] is not None
+        # PR 15: every incident line carries the supervisor SLO summary
+        # (breach count + worst burn rate, never fatal) — a healthy soak
+        # shows zero breaches
+        assert rec["slo"] is not None and "error" not in rec["slo"], rec
+        assert rec["slo"]["breaches"] == 0
+        assert rec["slo"]["worst_burn_rate"] >= 0.0
         if rec["kind"] == "sigterm" or not rec["abrupt"]:
             for fl in rec["drain_flights"]:
                 assert fl and os.path.isfile(fl)
@@ -206,6 +212,15 @@ def test_chaos_soak_short(tmp_path):
     degraded = [r for r in report["incidents"] if r["lose_member"]]
     assert degraded and all(r["degraded"] and r["lost_batches"] > 0 for r in degraded)
     assert report["lost_batches"] == sum(r["lost_batches"] for r in degraded)
+
+    # PR 15: the supervisor federated every rank's telemetry snapshot into
+    # one pool view — the merged submit p99 (sketch-backed) and the merged
+    # ledger's elastic_restore continuity are both visible
+    fed = report["federation"]
+    assert fed is not None and "error" not in fed, fed
+    assert fed["world"] >= 2
+    assert fed["submit_p99_ms"] is not None and fed["submit_p99_ms"] > 0
+    assert fed["ledger_events"].get("elastic_restore", 0) >= 1
 
     # the incident JSONL is complete and machine-readable
     with open(out) as fh:

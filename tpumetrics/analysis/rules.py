@@ -22,6 +22,15 @@ TPL105 host-health-read        a host-SYNCING ``telemetry.health`` read (``summa
                                forcing a device sync per step; the trace-safe probe
                                (``probe_tree``/``probe_packed``) belongs in the step
                                program, the READ belongs on the compute()/stats() seam
+TPL106 serving-layer           (a) a ``telemetry.serve``/``telemetry.slo`` entry point
+                               (admin server start, SLO engine) reachable from
+                               ``update()`` — the serving plane lives beside the stream,
+                               never inside a step; (b) a BLOCKING device read
+                               (``jax.device_get``/``block_until_ready``/``.item()``/
+                               ``health.summarize``) reachable from an admin HTTP
+                               handler (``do_GET``-family methods of a
+                               ``BaseHTTPRequestHandler``) or an SLO sampler loop — a
+                               scrape must never synchronize with an in-flight dispatch
 TPL201 divergent-collective    a collective (``sync``/``all_reduce``/``all_gather``/
                                ``flush``/…) reachable on only one branch of a rank- or
                                data-dependent conditional — the static complement of the
@@ -81,6 +90,11 @@ CATALOG: Dict[str, Tuple[str, str]] = {
     "TPL102": ("traced-branch", "Python control flow on a traced value reachable from update()"),
     "TPL104": ("host-telemetry", "span/instrument call in update()-reachable metric code"),
     "TPL105": ("host-health-read", "host-syncing health read in update()-reachable metric code"),
+    "TPL106": (
+        "serving-layer",
+        "admin/SLO entry point in update()-reachable code, or a blocking device "
+        "read in an admin-handler/SLO-sampler path",
+    ),
     "TPL201": (
         "divergent-collective",
         "collective reachable on only one branch of a rank- or data-dependent conditional",
@@ -1236,6 +1250,162 @@ class HostHealthReadRule:
         return False
 
 
+#: the serving-layer modules whose entry points TPL106 rejects in update paths
+_TPL106_MODULES = (
+    "tpumetrics.telemetry.serve",
+    "tpumetrics.telemetry.slo",
+)
+#: package-level re-exports of the same entry points
+_TPL106_NAMES = {"start_admin_server", "AdminServer", "SloEngine"}
+#: blocking device reads a handler/sampler path must never reach: each one
+#: synchronizes the host thread with the device, which makes a scrape (or a
+#: sampler tick) wait on an in-flight dispatch
+_TPL106_BLOCKING_CALLS = {"jax.device_get", "jax.block_until_ready"}
+_TPL106_BLOCKING_METHODS = {"block_until_ready", "item", "tolist"}
+#: HTTP-handler dispatch methods (the stdlib BaseHTTPRequestHandler
+#: convention): any of these on a handler class roots a serving path
+_TPL106_HANDLER_METHODS = {"do_GET", "do_POST", "do_PUT", "do_HEAD", "do_DELETE"}
+#: SLO sampler roots: the tick/run loop of an engine class
+_TPL106_SAMPLER_METHODS = {"tick", "_run"}
+
+
+class ServingLayerRule:
+    """TPL106: the serving layer's two-sided trace-safety contract.
+
+    Side (a) mirrors TPL104/TPL105 for the new plane: an admin server
+    started — or an SLO engine constructed/armed — from ``update()``-
+    reachable metric code would run at trace time only under jit (and spawn
+    threads per retrace).  The serving plane lives BESIDE the stream
+    (constructor / runtime seams), never inside a step.
+
+    Side (b) extends the discipline to the handlers themselves: an admin
+    HTTP handler (a ``do_GET``-family method on a
+    ``BaseHTTPRequestHandler`` subclass, and everything module-locally
+    reachable from one) or an SLO sampler loop (``tick``/``_run`` on an
+    ``*SloEngine``-ish class) is a **strict host-side reader** — a
+    ``jax.device_get``/``block_until_ready``/``.item()`` (or the
+    host-syncing ``health.summarize``) reachable from one makes every
+    scrape synchronize with whatever dispatch is in flight, which is
+    precisely the stall the never-blocking ``stats()`` contract exists to
+    prevent.  Reachability is module-local plus resolvable imports — the
+    same resolution power the update-reachability pass has."""
+
+    codes = ("TPL106",)
+
+    def check(self, mod: ModuleInfo, index: PackageIndex) -> Iterator[Finding]:
+        yield from self._check_update_reachable(mod, index)
+        yield from self._check_serving_paths(mod, index)
+
+    # ------------------------------------------------- (a) update() side
+
+    def _check_update_reachable(
+        self, mod: ModuleInfo, index: PackageIndex
+    ) -> Iterator[Finding]:
+        funcs: List[FuncInfo] = list(mod.functions.values())
+        for ci in mod.classes.values():
+            funcs.extend(ci.methods.values())
+        for fi in funcs:
+            if not index.is_update_reachable(fi.node):
+                continue
+            for n in ast.walk(fi.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                dotted = _import_resolved_dotted(n.func, mod)
+                if dotted is None or not self._is_serving_entry(dotted):
+                    continue
+                yield Finding(
+                    "TPL106",
+                    f"serving-layer call `{_truncate(n)}` in update()-reachable "
+                    "code: the admin server and the SLO engine live beside the "
+                    "stream (constructed at the runtime seams), never inside a "
+                    "step — under jit this would run at trace time only and "
+                    "spawn a thread per retrace.",
+                    mod.path, n.lineno, n.col_offset, symbol=fi.qualname,
+                )
+
+    @staticmethod
+    def _is_serving_entry(dotted: str) -> bool:
+        for m in _TPL106_MODULES:
+            if dotted == m or dotted.startswith(m + "."):
+                return True
+        if dotted.startswith("tpumetrics.telemetry."):
+            return dotted.rpartition(".")[2] in _TPL106_NAMES
+        return dotted in _TPL106_NAMES
+
+    # -------------------------------------------- (b) handler/sampler side
+
+    def _serving_roots(self, mod: ModuleInfo) -> List[Tuple[ClassInfo, FuncInfo, str]]:
+        roots: List[Tuple[ClassInfo, FuncInfo, str]] = []
+        for ci in mod.classes.values():
+            is_handler = any(
+                b.rpartition(".")[2] == "BaseHTTPRequestHandler" for b in ci.bases
+            )
+            is_engine = ci.name.endswith("SloEngine") or ci.name == "SloEngine"
+            for name, fi in ci.methods.items():
+                if name in _TPL106_HANDLER_METHODS and (
+                    is_handler or name.startswith("do_")
+                ):
+                    roots.append((ci, fi, "admin handler"))
+                elif is_engine and name in _TPL106_SAMPLER_METHODS:
+                    roots.append((ci, fi, "SLO sampler"))
+        return roots
+
+    def _check_serving_paths(
+        self, mod: ModuleInfo, index: PackageIndex
+    ) -> Iterator[Finding]:
+        for ci, root, role in self._serving_roots(mod):
+            table = index.method_table(ci)
+            queue: List[FuncInfo] = [root]
+            seen: set = set()
+            while queue:
+                fi = queue.pop()
+                if id(fi.node) in seen:
+                    continue
+                seen.add(id(fi.node))
+                yield from self._blocking_reads(fi, mod, role, root)
+                for key in fi.callees:
+                    nxt = (
+                        table.get(key[1])
+                        if key[0] == "s"
+                        else index._resolve_call(fi, key)
+                    )
+                    if nxt is not None and id(nxt.node) not in seen:
+                        queue.append(nxt)
+
+    def _blocking_reads(
+        self, fi: FuncInfo, mod: ModuleInfo, role: str, root: FuncInfo
+    ) -> Iterator[Finding]:
+        for n in ast.walk(fi.node):
+            if not isinstance(n, ast.Call):
+                continue
+            blocked = None
+            dotted = _import_resolved_dotted(n.func, mod)
+            if dotted is not None and (
+                dotted in _TPL106_BLOCKING_CALLS
+                or (
+                    dotted.startswith(_TPL105_MODULE + ".")
+                    and dotted.rpartition(".")[2] in _TPL105_SYNC_NAMES
+                )
+            ):
+                blocked = dotted
+            elif (
+                isinstance(n.func, ast.Attribute)
+                and n.func.attr in _TPL106_BLOCKING_METHODS
+            ):
+                blocked = n.func.attr
+            if blocked is None:
+                continue
+            yield Finding(
+                "TPL106",
+                f"blocking device read `{_truncate(n)}` reachable from the "
+                f"{role} `{root.qualname}`: a scrape/sampler tick must never "
+                "synchronize with an in-flight dispatch — serve the cached "
+                "summary (the never-blocking stats() discipline) and leave "
+                "device fetches to compute()-side readers.",
+                mod.path, n.lineno, n.col_offset, symbol=fi.qualname,
+            )
+
+
 class PartitionRuleDeclRule:
     """TPL304: literal ``StatePartitionRules`` patterns that match no state
     declared anywhere in the analyzed package.
@@ -1434,6 +1604,7 @@ RULES = [
     TraceSafetyRule(),
     HostTelemetryRule(),
     HostHealthReadRule(),
+    ServingLayerRule(),
     StateDeclRule(),
     ShadowStateRule(),
     PartitionRuleDeclRule(),

@@ -88,11 +88,17 @@ Array = jax.Array
 #: process-global instrument registry (label cardinality: one per evaluator)
 _STREAM_IDS = itertools.count(1)
 
+# sketch=True: the runtime's latency quantiles carry the sketch's
+# <= 1/capacity relative-error bound (and federate across ranks) instead of
+# fixed-bucket interpolation — the SLO engine's p99 objectives compare
+# against these
 _SUBMIT_HIST = _instruments.histogram(
-    _instruments.SUBMIT_LATENCY_MS, help="submit() call latency", labels=("stream",)
+    _instruments.SUBMIT_LATENCY_MS, help="submit() call latency", labels=("stream",),
+    sketch=True,
 )
 _DISPATCH_HIST = _instruments.histogram(
-    _instruments.DISPATCH_LATENCY_MS, help="device dispatch latency", labels=("stream",)
+    _instruments.DISPATCH_LATENCY_MS, help="device dispatch latency", labels=("stream",),
+    sketch=True,
 )
 _JOURNAL_GAUGE = _instruments.gauge(
     _instruments.JOURNAL_LEN, help="crash-replay journal length", labels=("stream",)
@@ -101,11 +107,13 @@ _RESTORE_HIST = _instruments.histogram(
     _instruments.RESTORE_LATENCY_MS,
     help="elastic restore (cut discovery + fold + reshard + place) latency",
     labels=("stream",),
+    sketch=True,
 )
 _DRAIN_HIST = _instruments.histogram(
     _instruments.DRAIN_LATENCY_MS,
     help="graceful drain (flush + final cut) latency",
     labels=("stream",),
+    sketch=True,
 )
 _STATE_HBM_GAUGE = _instruments.gauge(
     _instruments.STATE_HBM_BYTES,
@@ -118,6 +126,34 @@ class CrashLoopError(TPUMetricsUserError):
     """The crash-loop budget (``max_restores``) is spent: the same (or a new)
     batch kept crashing the worker after every snapshot-restore-replay cycle.
     Poisons the dispatcher; the final underlying crash is ``__cause__``."""
+
+
+#: how long a stats()-path reader may wait for the state lock before
+#: serving its cached snapshot.  A donating dispatch holds the lock for the
+#: host-side dispatch — normally microseconds, but a backend that
+#: synchronizes on a pending donated input (the CPU client does) can hold
+#: it for a whole device step; the never-blocking stats() contract (and the
+#: admin plane's scrape-under-load pin) bounds the reader instead of the
+#: backend.
+_STATS_LOCK_TIMEOUT_S = 0.02
+
+
+class _bounded_lock:
+    """``with _bounded_lock(lock) as got:`` — acquire with a small timeout;
+    ``got`` is False when the owner kept it (serve the cached snapshot)."""
+
+    __slots__ = ("_lock", "_got")
+
+    def __init__(self, lock: threading.Lock, timeout: float = _STATS_LOCK_TIMEOUT_S):
+        self._lock = lock
+        self._got = lock.acquire(timeout=timeout)
+
+    def __enter__(self) -> bool:
+        return self._got
+
+    def __exit__(self, *exc: Any) -> None:
+        if self._got:
+            self._lock.release()
 
 
 class StreamingEvaluator:
@@ -213,6 +249,12 @@ class StreamingEvaluator:
             ``tpumetrics_state_nonfinite_total{stream,state}`` series, so a
             poisoned stream is visible BEFORE the compute-time non-finite
             guard trips.
+        admin_port: start the embedded admin server
+            (:mod:`tpumetrics.telemetry.serve`) on this port (``0`` = an
+            ephemeral port, read back from ``evaluator.admin.port``):
+            ``/metrics``, ``/healthz``, ``/statusz``, ``/spanz``,
+            ``/flightz`` served from a daemon thread, scoped to this
+            evaluator and stopped by ``close()``.
     """
 
     def __init__(
@@ -242,6 +284,7 @@ class StreamingEvaluator:
         data_axis: Optional[str] = None,
         signature_cache_size: Optional[int] = 4096,
         health_probe: bool = False,
+        admin_port: Optional[int] = None,
     ) -> None:
         from tpumetrics.collections import MetricCollection
 
@@ -339,6 +382,11 @@ class StreamingEvaluator:
         self._health_lock = threading.Lock()  # one state_health event per corruption
         self._hbm_watermark = 0
         self._closed = False  # stats() after close must not re-mint released series
+        # bounded-staleness snapshots served when a donating dispatch owns
+        # the state lock (the never-blocking stats() contract; guarded by
+        # _health_lock, which is never held across a dispatch)
+        self._stats_cache: Dict[str, Any] = {}
+        self._hbm_cache: Dict[str, int] = {"state_bytes": 0, "watermark_bytes": 0}
         # graceful-drain state: flag read lock-free on the submit hot path
         # (a single store-release is enough — late submits only need to fail
         # EVENTUALLY-before-close, and drain() flushes after setting it)
@@ -404,6 +452,22 @@ class StreamingEvaluator:
             instrument_label=self._stream,  # gauges are last-write-wins per label
             crash_handler=self._handle_crash if crash_policy == "restore" else None,
         )
+        # the embedded admin plane (telemetry/serve.py): a strict host-side
+        # reader over this evaluator — /metrics, /healthz, /statusz, /spanz,
+        # /flightz on a daemon thread.  Owned here, stopped by close().
+        self._admin = None
+        if admin_port is not None:
+            from tpumetrics.telemetry.serve import start_admin_server
+
+            self._admin = start_admin_server(
+                int(admin_port), targets={self._stream: self}, name=self._stream
+            )
+
+    @property
+    def admin(self):
+        """The embedded :class:`~tpumetrics.telemetry.serve.AdminServer`
+        (``admin_port=``), or ``None``."""
+        return self._admin
 
     # -------------------------------------------------------------- ingestion
 
@@ -458,6 +522,8 @@ class StreamingEvaluator:
         try:
             self._dispatcher.close(drain=drain, timeout=timeout)
         finally:
+            if self._admin is not None:
+                self._admin.close()
             for inst in (
                 _SUBMIT_HIST, _DISPATCH_HIST, _JOURNAL_GAUGE, _RESTORE_HIST, _DRAIN_HIST,
             ):
@@ -596,24 +662,42 @@ class StreamingEvaluator:
         observability (``latency`` — submit/dispatch p50/p99 from the shared
         instrument histograms — and ``recompiles``, the attributed-retrace
         count for this stream).  Existing keys are a stable contract; the
-        new sections only ever ADD keys."""
+        new sections only ever ADD keys.
+
+        Never-blocking, now by construction: the state lock is taken with a
+        bounded acquire — when a donating dispatch owns it (a backend may
+        hold it for a whole device step while synchronizing a pending
+        donated input), the last successful snapshot is served instead
+        (``stale=True``), so a ``/statusz`` scrape never waits on the
+        device."""
         out = self._dispatcher.stats()
-        with self._lock:
-            out.update(
-                batches=self._batches,
-                items=self._items,
-                xla_compiles=self._trace_signatures.inserts,
-                signature_evictions=self._trace_signatures.evictions,
-                buckets=list(self._bucketer.edges) if self._bucketer else None,
-                mesh=(
-                    {str(k): int(v) for k, v in self._mesh.shape.items()}
-                    if self._mesh is not None
-                    else None
-                ),
-                degraded=self._degraded,
-                crashes=self._crashes,
-                restores=self._restores,
-            )
+        with _bounded_lock(self._lock) as got:
+            if got:
+                core = dict(
+                    batches=self._batches,
+                    items=self._items,
+                    xla_compiles=self._trace_signatures.inserts,
+                    signature_evictions=self._trace_signatures.evictions,
+                    buckets=list(self._bucketer.edges) if self._bucketer else None,
+                    mesh=(
+                        {str(k): int(v) for k, v in self._mesh.shape.items()}
+                        if self._mesh is not None
+                        else None
+                    ),
+                    degraded=self._degraded,
+                    crashes=self._crashes,
+                    restores=self._restores,
+                )
+                with self._health_lock:
+                    self._stats_cache = core
+        if not got:
+            with self._health_lock:
+                core = dict(self._stats_cache) or dict(
+                    batches=0, items=0, xla_compiles=0, signature_evictions=0,
+                    buckets=None, mesh=None, degraded=False, crashes=0, restores=0,
+                )
+        out.update(core)
+        out["stale"] = not got
         out["latency"] = _instruments.latency_section(self._stream)
         out["recompiles"] = recompile_count(self._stream)
         out["device"] = self._device_section()
@@ -643,16 +727,21 @@ class StreamingEvaluator:
         }
 
     def _hbm_section(self) -> Dict[str, Any]:
-        with self._lock:
-            if self._bucketer is not None:
-                leaves = jax.tree_util.tree_leaves(self._state)
-            else:
-                leaves = _eager_state_leaves(self._metric)
-            current = sum(int(getattr(l, "nbytes", 0) or 0) for l in leaves)
-            if current > self._hbm_watermark:
-                self._hbm_watermark = current
-            watermark = self._hbm_watermark
+        with _bounded_lock(self._lock) as got:
+            if got:
+                if self._bucketer is not None:
+                    leaves = jax.tree_util.tree_leaves(self._state)
+                else:
+                    leaves = _eager_state_leaves(self._metric)
+                current = sum(int(getattr(l, "nbytes", 0) or 0) for l in leaves)
+                if current > self._hbm_watermark:
+                    self._hbm_watermark = current
+                watermark = self._hbm_watermark
         with self._health_lock:
+            if not got:
+                # a donating dispatch owns the state: bounded-stale footprint
+                return dict(self._hbm_cache)
+            self._hbm_cache = {"state_bytes": current, "watermark_bytes": watermark}
             if not self._closed:  # close() released the series; don't re-mint
                 _STATE_HBM_GAUGE.set(current, self._stream)
         return {"state_bytes": current, "watermark_bytes": watermark}
@@ -673,9 +762,21 @@ class StreamingEvaluator:
         served."""
         if self._step is None or not self._step.health_probe:
             return None
-        with self._lock:
-            health = self._device_health
-            paths = _health.state_paths(self._state) if health is not None else None
+        if block:
+            with self._lock:
+                health = self._device_health
+                paths = _health.state_paths(self._state) if health is not None else None
+        else:
+            with _bounded_lock(self._lock) as got:
+                if got:
+                    health = self._device_health
+                    paths = _health.state_paths(self._state) if health is not None else None
+            if not got:
+                # the lock owner is mid-dispatch: the cached summary is the
+                # never-blocking answer (all-zero before the first fetch)
+                with self._health_lock:
+                    cached = self._health_summary
+                return cached if cached is not None else _health.summarize(None)
         if not block and health is not None:
             is_ready = getattr(health, "is_ready", None)
             if is_ready is not None and not is_ready():

@@ -93,7 +93,7 @@ from tpumetrics.runtime.compile_cache import (
     recompile_count,
 )
 from tpumetrics.runtime.dispatch import _DEPTH_GAUGE, AsyncDispatcher
-from tpumetrics.runtime.evaluator import CrashLoopError
+from tpumetrics.runtime.evaluator import CrashLoopError, _bounded_lock
 from tpumetrics.runtime.scheduler import DeficitRoundRobin, SignatureRegistry
 from tpumetrics.runtime import snapshot as _snapshot
 from tpumetrics.telemetry import device as _device
@@ -110,10 +110,12 @@ _POLICIES = ("block", "drop_oldest", "error")
 # labels them by tenant id — 1000-stream-scale cardinality is a documented
 # budget (docs/observability.md), ~20 numbers per series
 _SUBMIT_HIST = _instruments.histogram(
-    _instruments.SUBMIT_LATENCY_MS, help="submit() call latency", labels=("stream",)
+    _instruments.SUBMIT_LATENCY_MS, help="submit() call latency", labels=("stream",),
+    sketch=True,
 )
 _DISPATCH_HIST = _instruments.histogram(
-    _instruments.DISPATCH_LATENCY_MS, help="device dispatch latency", labels=("stream",)
+    _instruments.DISPATCH_LATENCY_MS, help="device dispatch latency", labels=("stream",),
+    sketch=True,
 )
 _TENANTS_GAUGE = _instruments.gauge(
     _instruments.TENANTS_LIVE, help="registered, non-quarantined tenants", labels=("service",)
@@ -220,6 +222,11 @@ class _Tenant:
         self.health_lock = threading.Lock()
         self.hbm_watermark = 0
         self.released = False  # stats() after close must not re-mint series
+        # bounded-staleness snapshots served when a donating dispatch owns
+        # the service lock (the never-blocking stats() contract; guarded by
+        # health_lock, which is never held across a dispatch)
+        self.stats_cache: Dict[str, Any] = {}
+        self.hbm_cache: Dict[str, int] = {"state_bytes": 0, "watermark_bytes": 0}
 
 
 class TenantHandle:
@@ -289,6 +296,11 @@ class EvaluationService:
             enable_persistent_compilation_cache`) so the deduped compiles
             also amortize across processes/restarts.
         name: dispatcher thread / telemetry name.
+        admin_port: start the embedded admin server
+            (:mod:`tpumetrics.telemetry.serve`) on this port (``0`` = an
+            ephemeral port, read back from ``service.admin.port``) — the
+            live ``/metrics`` / ``/healthz`` / ``/statusz`` plane over
+            every tenant, stopped by ``close()``.
 
     Register tenants with :meth:`register`; each returns a
     :class:`TenantHandle`.  The module docstring describes the sharing
@@ -303,6 +315,7 @@ class EvaluationService:
         megabatch_max_group: int = 16,
         compile_cache_dir: Optional[str] = None,
         name: str = "EvaluationService",
+        admin_port: Optional[int] = None,
     ) -> None:
         if int(megabatch_max_group) < 2:
             raise ValueError(
@@ -331,11 +344,43 @@ class EvaluationService:
         self._drain_report: Optional[Any] = None
         self._drain_lock = threading.Lock()  # serializes concurrent drain()s
         self._name = name
+        self._stats_cache: Dict[str, Any] = {}  # never-blocking stats() fallback
+        self._tenant_ids_cache: List[str] = []  # never-blocking census fallback
         self._label = f"{name}#{next(_SERVICE_IDS)}"
         self._dispatcher = AsyncDispatcher(
             self._drain, max_queue=max_tokens, policy="block", name=name,
             instrument_label=self._label,
         )
+        # the embedded admin plane (telemetry/serve.py): /metrics, /healthz
+        # (per-tenant degraded/quarantine/state-health), /statusz (per-tenant
+        # stats incl. device section, DRR shares, signature-cache occupancy),
+        # /spanz, /flightz.  Owned here, stopped by close().
+        self._admin = None
+        if admin_port is not None:
+            from tpumetrics.telemetry.serve import start_admin_server
+
+            self._admin = start_admin_server(
+                int(admin_port), targets={self._label: self}, name=self._label
+            )
+
+    @property
+    def admin(self):
+        """The embedded :class:`~tpumetrics.telemetry.serve.AdminServer`
+        (``admin_port=``), or ``None``."""
+        return self._admin
+
+    def tenant_ids(self) -> List[str]:
+        """Registered tenant ids (quarantined included — their stats still
+        report, which is exactly what ``/healthz`` needs to see).  Bounded
+        like every stats-path reader: when a donating dispatch owns the
+        lock, the last census is served (registration is rare; the census
+        is as fresh as the last unowned read)."""
+        with _bounded_lock(self._lock) as locked:
+            if locked:
+                ids = sorted(self._tenants)
+                self._tenant_ids_cache = ids
+                return ids
+        return list(self._tenant_ids_cache)
 
     # ------------------------------------------------------------ registration
 
@@ -696,6 +741,8 @@ class EvaluationService:
         try:
             self._dispatcher.close(drain=drain, timeout=timeout)
         finally:
+            if self._admin is not None:
+                self._admin.close()
             with self._lock:
                 tenants = list(self._tenants.values())
                 # any batch still in a tenant queue will never be drained
@@ -748,7 +795,7 @@ class EvaluationService:
         # health first: a poisoned tenant must page (state_health event +
         # nonzero nonfinite series) BEFORE any value is computed or the
         # non-finite guard turns the corruption into an exception
-        self._refresh_health(tenant, block=True)
+        self._refresh_health(tenant)
         with self._lock, stream_scope(tenant.tid):
             # drift monitors alert under THIS tenant's label — latches are
             # per-stream on the (possibly shared) metric instance, so one
@@ -779,91 +826,158 @@ class EvaluationService:
             return tenant.error
 
     def tenant_stats(self, tenant_id: str) -> Dict[str, Any]:
+        """Never-blocking by construction: ONE bounded acquire of the
+        service lock grabs everything the lock guards (counters, HBM, the
+        health probe handle) — when a donating dispatch owns it, the
+        tenant's last successful snapshot is served (``stale=True``) so a
+        scrape never waits on the device (the admin plane's contract)."""
         tenant = self._get(tenant_id)
-        with self._lock:
-            out = {
-                "batches": tenant.batches,
-                "items": tenant.items,
-                "enqueued": tenant.enqueued,
-                "depth": len(tenant.queue),
-                "pending": tenant.pending,
-                "dropped": tenant.dropped,
-                "megabatched": tenant.megabatched,
-                "quarantined": tenant.error is not None,
-                "degraded": tenant.degraded,
-                "crashes": tenant.crashes,
-                "restores": tenant.restores,
-                "buckets": list(tenant.bucketer.edges) if tenant.bucketer else None,
-            }
-        # observability sections (outside the lock: instrument reads take
-        # per-instrument locks only).  Existing keys are a stable contract —
-        # these only ever ADD keys.
-        out["latency"] = _instruments.latency_section(tenant_id)
-        out["recompiles"] = recompile_count(tenant_id)
-        out["device"] = self._device_section(tenant)
+        with _bounded_lock(self._lock) as locked:
+            grab = self._grab_locked(tenant) if locked else None
+        return self._assemble_tenant_stats(tenant, grab)
+
+    def all_tenant_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Every tenant's stats under ONE bounded lock acquire — the admin
+        plane's census read: a ``/statusz`` scrape of a 1000-tenant service
+        pays at most one bounded wait, never one per tenant (per-tenant
+        bounded acquires would stack N timeouts under a continuously
+        contended lock)."""
+        with _bounded_lock(self._lock) as locked:
+            if locked:
+                tenants = [self._tenants[tid] for tid in sorted(self._tenants)]
+                self._tenant_ids_cache = [t.tid for t in tenants]
+                grabs: List[Any] = [self._grab_locked(t) for t in tenants]
+            else:
+                tenants = [
+                    self._tenants[tid]
+                    for tid in self._tenant_ids_cache
+                    if tid in self._tenants
+                ]
+                grabs = [None] * len(tenants)
+        return {
+            t.tid: self._assemble_tenant_stats(t, g) for t, g in zip(tenants, grabs)
+        }
+
+    # ----------------------------------------------------- device observability
+
+    def _grab_locked(self, tenant: _Tenant) -> Tuple[Any, ...]:
+        """Everything ``tenant_stats`` needs from under the service lock,
+        grabbed quickly (host-side counter/shape reads only): the core
+        counters, the live-state HBM numbers, and the health probe's device
+        handle.  Assembly — instrument reads, summaries — happens OUTSIDE
+        the lock (:meth:`_assemble_tenant_stats`)."""
+        from tpumetrics.runtime.evaluator import _eager_state_leaves
+
+        core = {
+            "batches": tenant.batches,
+            "items": tenant.items,
+            "enqueued": tenant.enqueued,
+            "depth": len(tenant.queue),
+            "pending": tenant.pending,
+            "dropped": tenant.dropped,
+            "megabatched": tenant.megabatched,
+            "quarantined": tenant.error is not None,
+            "degraded": tenant.degraded,
+            "crashes": tenant.crashes,
+            "restores": tenant.restores,
+            "buckets": list(tenant.bucketer.edges) if tenant.bucketer else None,
+            # the tenant's DRR quantum (its fair share of a contended
+            # worker, in batch rows per round) — /statusz surfaces it
+            "quota": tenant.quota,
+        }
+        if tenant.bucketer is not None:
+            leaves = jax.tree_util.tree_leaves(tenant.state)
+        else:
+            leaves = _eager_state_leaves(tenant.metric)
+        current = sum(int(getattr(l, "nbytes", 0) or 0) for l in leaves)
+        if current > tenant.hbm_watermark:
+            tenant.hbm_watermark = current
+        hbm = {"state_bytes": current, "watermark_bytes": tenant.hbm_watermark}
+        probed = tenant.step is not None and tenant.step.health_probe
+        health = tenant.device_health if probed else None
+        paths = _health.state_paths(tenant.state) if health is not None else None
+        return core, hbm, health, paths
+
+    def _assemble_tenant_stats(
+        self, tenant: _Tenant, grab: Optional[Tuple[Any, ...]]
+    ) -> Dict[str, Any]:
+        """Build the ``TenantHandle.stats()`` payload from a lock grab
+        (``None`` = the lock was contended: serve the cached snapshot with
+        ``stale=True``).  Runs entirely outside the service lock; existing
+        keys are a stable contract — sections only ever ADD keys."""
+        locked = grab is not None
+        if locked:
+            core, hbm, health_dev, paths = grab
+            with tenant.health_lock:
+                tenant.stats_cache = dict(core)
+                tenant.hbm_cache = dict(hbm)
+                if not tenant.released:  # close() released; don't re-mint
+                    _STATE_HBM_GAUGE.set(hbm["state_bytes"], tenant.tid)
+        else:
+            with tenant.health_lock:
+                core = dict(tenant.stats_cache) or {
+                    "batches": 0, "items": 0, "enqueued": 0, "depth": 0,
+                    "pending": 0, "dropped": 0, "megabatched": 0,
+                    "quarantined": False, "degraded": False, "crashes": 0,
+                    "restores": 0, "buckets": None, "quota": tenant.quota,
+                }
+                hbm = dict(tenant.hbm_cache)
+            health_dev = paths = None
+        out = dict(core)
+        out["stale"] = not locked
+        out["latency"] = _instruments.latency_section(tenant.tid)
+        out["recompiles"] = recompile_count(tenant.tid)
+        with tenant.health_lock:  # serializes the gauge writes with close()
+            programs = _device.profile_summary(tenant.tid)
+        out["device"] = {
+            "programs": programs,
+            "hbm": hbm,
+            "health": self._health_section(tenant, health_dev, paths, locked),
+        }
         from tpumetrics.monitoring.drift import monitoring_stats
 
-        monitoring = monitoring_stats(self._stats_metric(tenant), tenant_id)
+        monitoring = monitoring_stats(self._stats_metric(tenant), tenant.tid)
         if monitoring:
             out["monitoring"] = monitoring
         return out
 
-    # ----------------------------------------------------- device observability
-
-    def _device_section(self, tenant: _Tenant) -> Dict[str, Any]:
-        """The ``TenantHandle.stats()["device"]`` payload: program-profile
-        aggregate (already-resolved profiles only — ``stats()`` never
-        blocks on an XLA compile), the tenant's live-state HBM footprint +
-        watermark, and the health summary (probed tenants only)."""
-        with tenant.health_lock:  # serializes the gauge writes with close()
-            programs = _device.profile_summary(tenant.tid)
-        return {
-            "programs": programs,
-            "hbm": self._hbm_section(tenant),
-            "health": self._refresh_health(tenant),
-        }
-
-    def _hbm_section(self, tenant: _Tenant) -> Dict[str, Any]:
-        from tpumetrics.runtime.evaluator import _eager_state_leaves
-
-        with self._lock:
-            if tenant.bucketer is not None:
-                leaves = jax.tree_util.tree_leaves(tenant.state)
-            else:
-                leaves = _eager_state_leaves(tenant.metric)
-            current = sum(int(getattr(l, "nbytes", 0) or 0) for l in leaves)
-            if current > tenant.hbm_watermark:
-                tenant.hbm_watermark = current
-            watermark = tenant.hbm_watermark
-        with tenant.health_lock:
-            if not tenant.released:  # close() released the series; don't re-mint
-                _STATE_HBM_GAUGE.set(current, tenant.tid)
-        return {"state_bytes": current, "watermark_bytes": watermark}
-
-    def _refresh_health(
-        self, tenant: _Tenant, block: bool = False
+    def _health_section(
+        self, tenant: _Tenant, health: Any, paths: Any, locked: bool
     ) -> Optional[Dict[str, Any]]:
-        """Fetch + publish the tenant's latest on-device health counters
-        (None when its step is unprobed): one ``device_get`` of a few int32
-        vectors on the stats()/compute() read path, never per step; first
-        corruption per state latches ONE ``state_health`` ledger event.
-        ``block=False`` (the never-blocking ``stats()`` contract) serves
-        the last fetched summary while an in-flight async dispatch still
-        owns the probe output; ``compute()`` passes ``block=True``."""
+        """The never-blocking stats()-side health summary: a contended lock
+        or a not-yet-ready probe output serves the LAST fetched summary
+        (all-zero before the first fetch); a ready one is summarized and
+        latched (first corruption per state pages ONE ``state_health``
+        event)."""
+        if tenant.step is None or not tenant.step.health_probe:
+            return None
+        ready = locked and (
+            health is None or getattr(health, "is_ready", lambda: True)()
+        )
+        if not ready:
+            with tenant.health_lock:
+                cached = tenant.health_summary
+            return cached if cached is not None else _health.summarize(None)
+        summary = _health.summarize(health, paths)
+        with tenant.health_lock:
+            if not tenant.released:  # post-close reads must not re-mint/re-page
+                _health.publish_health(tenant.tid, summary, tenant.health_alerted)
+            tenant.health_summary = summary
+        return summary
+
+    def _refresh_health(self, tenant: _Tenant) -> Optional[Dict[str, Any]]:
+        """The compute()-side BLOCKING health fetch (None when unprobed):
+        compute() synchronizes with the device anyway, and corruption must
+        page — one ``state_health`` ledger event per (stream, state) —
+        BEFORE a value is served."""
         if tenant.step is None or not tenant.step.health_probe:
             return None
         with self._lock:
             health = tenant.device_health
             paths = _health.state_paths(tenant.state) if health is not None else None
-        if not block and health is not None:
-            is_ready = getattr(health, "is_ready", None)
-            if is_ready is not None and not is_ready():
-                with tenant.health_lock:
-                    cached = tenant.health_summary
-                return cached if cached is not None else _health.summarize(None)
         summary = _health.summarize(health, paths)
         with tenant.health_lock:
-            if not tenant.released:  # post-close reads must not re-mint/re-page
+            if not tenant.released:
                 _health.publish_health(tenant.tid, summary, tenant.health_alerted)
             tenant.health_summary = summary
         return summary
@@ -877,19 +991,32 @@ class EvaluationService:
 
     def stats(self) -> Dict[str, Any]:
         """Service-wide counters: the shared dispatcher's (with the per-tag
-        split), compile dedupe accounting, and megabatch totals."""
+        split), compile dedupe accounting, and megabatch totals.  The
+        service lock is taken with a bounded acquire (``tenant_stats``'s
+        never-blocking contract); ``stale=True`` marks a snapshot served
+        while a donating dispatch owned the lock."""
         out = self._dispatcher.stats()
-        with self._lock:
-            out.update(
-                tenants=len(self._tenants),
-                shared_steps=len(self._steps),
-                xla_compiles=self._signatures.inserts,
-                signatures_tracked=len(self._signatures),
-                signature_evictions=self._signatures.evictions,
-                megabatch_steps=self._megabatch_steps,
-                megabatch_tenants=self._megabatch_tenants,
-                quarantined_tenants=self._quarantines,
+        with _bounded_lock(self._lock) as locked:
+            if locked:
+                core = dict(
+                    tenants=len(self._tenants),
+                    shared_steps=len(self._steps),
+                    xla_compiles=self._signatures.inserts,
+                    signatures_tracked=len(self._signatures),
+                    signature_evictions=self._signatures.evictions,
+                    megabatch_steps=self._megabatch_steps,
+                    megabatch_tenants=self._megabatch_tenants,
+                    quarantined_tenants=self._quarantines,
+                )
+                self._stats_cache = core
+        if not locked:
+            core = dict(self._stats_cache) or dict(
+                tenants=0, shared_steps=0, xla_compiles=0, signatures_tracked=0,
+                signature_evictions=0, megabatch_steps=0, megabatch_tenants=0,
+                quarantined_tenants=0,
             )
+        out.update(core)
+        out["stale"] = not locked
         return out
 
     # -------------------------------------------------------------- snapshots

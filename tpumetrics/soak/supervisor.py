@@ -135,11 +135,13 @@ class SoakSupervisor:
         *,
         python: Optional[str] = None,
         verbose: bool = False,
+        admin_port: Optional[int] = None,
     ) -> None:
         self.schedule = schedule
         self.root = os.path.abspath(root)
         self.python = python or sys.executable
         self.verbose = bool(verbose)
+        self.admin_port = admin_port
         os.makedirs(self.root, exist_ok=True)
         self._workers: List[_WorkerHandle] = []
         self._epoch = 0
@@ -158,6 +160,119 @@ class SoakSupervisor:
         # incident's timeline merge re-parses only files that GREW since the
         # last incident, not the whole soak history (O(new), not O(history))
         self._timeline_cache: Dict[str, Any] = {}
+        # federation: the newest telemetry snapshot per rank (refreshed at
+        # leg/recovery boundaries — a live scrape serves the cached merge, so
+        # the HTTP thread never drives the stdio command protocol; the cache
+        # dict itself is the one piece of shared state, hence the lock)
+        self._fed_snapshots: Dict[int, Dict[str, Any]] = {}
+        self._fed_lock = threading.Lock()
+        self._admin: Optional[Any] = None
+        # the supervisor's own SLO plane: standing objectives over the soak
+        # itself, ticked once per incident; record["slo"] mirrors PR 13's
+        # straggler field — an observability annotation, never a gate
+        self._unrecovered = 0
+        self._slo = self._make_slo_engine()
+
+    # ----------------------------------------------------------- federation
+
+    def _make_slo_engine(self) -> Any:
+        from tpumetrics.telemetry.slo import SloEngine, callable_rule
+
+        sched = self.schedule
+        rules = [
+            callable_rule(
+                "soak_restore_latency",
+                lambda: (self._restore_walls[-1] * 1e3) if self._restore_walls else None,
+                float(sched.restore_ceiling_s) * 1e3,
+                budget=1e-3, fast_window_s=3600.0, fast_burn=1.0,
+                slow_window_s=7200.0, slow_burn=1.0,
+                description="per-cycle restore wall under the schedule ceiling",
+            ),
+            callable_rule(
+                "soak_unrecovered",
+                lambda: float(self._unrecovered), 0.0,
+                budget=1e-3, fast_window_s=3600.0, fast_burn=1.0,
+                slow_window_s=7200.0, slow_burn=1.0,
+                description="zero unrecovered incidents",
+            ),
+        ]
+        # unarmed: the supervisor ticks it at incident boundaries (sparse,
+        # deterministic) instead of running a sampler thread under chaos
+        return SloEngine(rules, sample_every_s=60.0)
+
+    def _slo_summary(self) -> Optional[Dict[str, Any]]:
+        """Tick the supervisor SLO plane and summarize it for the incident
+        line (breach count + worst burn rate).  Never fatal — the soak must
+        not fail on its own alerting."""
+        try:
+            self._slo.tick()
+            status = self._slo.status()
+            worst = 0.0
+            for entry in status["rules"].values():
+                worst = max(worst, entry["burn_fast"], entry["burn_slow"])
+            return {
+                "breaches": status["violations_total"],
+                "breached": status["breached"],
+                "worst_burn_rate": round(worst, 4),
+            }
+        except Exception as err:  # noqa: BLE001 — annotation, not a gate
+            return {"error": f"{type(err).__name__}: {err}"}
+
+    def _refresh_federation(self) -> None:
+        """Pull every live rank's telemetry snapshot over the command wire
+        (never fatal; a mid-teardown refresh just keeps the last view)."""
+        if not self._workers:
+            return
+        try:
+            acks = self._cmd_all({"cmd": "telemetry"})
+            for w, ack in zip(self._workers, acks):
+                snap = ack.get("snapshot")
+                if snap:
+                    with self._fed_lock:
+                        self._fed_snapshots[w.rank] = snap
+        except Exception:  # noqa: BLE001 — observability, not a soak gate
+            pass
+
+    def federation_snapshots(self) -> List[Dict[str, Any]]:
+        """The cached per-rank snapshots, rank order (the admin server's
+        federation provider — called from the HTTP thread, so the read
+        takes the cache lock a leg-boundary refresh writes under)."""
+        with self._fed_lock:
+            return [self._fed_snapshots[r] for r in sorted(self._fed_snapshots)]
+
+    def federation_summary(self) -> Optional[Dict[str, Any]]:
+        """Merged pool view for the soak report (never fatal)."""
+        try:
+            snaps = self.federation_snapshots()
+            if not snaps:
+                return None
+            from tpumetrics.telemetry import federate as _federate
+
+            view = _federate.merge_snapshots(snaps)
+            status = view.statusz()
+            return {
+                "world": status["world"],
+                "ranks": status["ranks"],
+                "submit_p99_ms": status["latency"]["submit_ms"]["p99"],
+                "restore_p99_ms": status["latency"]["restore_ms"]["p99"],
+                "ledger_events": status["ledger"].get("counts_by_kind", {}),
+            }
+        except Exception as err:  # noqa: BLE001
+            return {"error": f"{type(err).__name__}: {err}"}
+
+    def start_admin(self, port: int = 0) -> Any:
+        """Start the pool-wide federated admin endpoint: ``/metrics`` and
+        ``/statusz`` serve the MERGED view of every rank's cached snapshot
+        — live what ``timeline.merge_timelines`` only does post-hoc."""
+        from tpumetrics.telemetry.serve import start_admin_server
+
+        if self._admin is None:
+            self._admin = start_admin_server(
+                port,
+                federation=self.federation_snapshots,
+                name="soak-supervisor",
+            )
+        return self._admin
 
     # ----------------------------------------------------------------- pool
 
@@ -276,6 +391,9 @@ class SoakSupervisor:
         if inc.tail:
             rows += self._feed(pos, pos + inc.tail)
         wall = max(time.monotonic() - t0, 1e-9)
+        # leg boundary: the pool is alive and quiescent — refresh the
+        # federated view here so a live scrape serves this leg's state
+        self._refresh_federation()
         return rows / wall
 
     # ----------------------------------------------------------- incidents
@@ -538,6 +656,7 @@ class SoakSupervisor:
         self._epoch_state_base = self._cut_state_pos
         self._stream_pos = self._cut_stream_pos
         self._epoch_stream_start = self._cut_stream_pos
+        self._refresh_federation()  # the new world's first federated view
         return {
             "adopted": self._cut_state_pos,
             "degraded": expect_degraded,
@@ -568,6 +687,8 @@ class SoakSupervisor:
         unrecovered = 0
         final: Dict[str, Any] = {}
         try:
+            if self.admin_port is not None:
+                self.start_admin(int(self.admin_port))
             self._spawn(sched.world)
             for idx, inc in enumerate(sched.incidents):
                 record: Dict[str, Any] = {
@@ -589,6 +710,7 @@ class SoakSupervisor:
                     record.update(self._recover(inc))
                     record["verify"] = self._verify_fold(1 if inc.lose_member else None)
                     record["straggler"] = self._straggler_summary()
+                    record["slo"] = self._slo_summary()
                     record["flight_dump"] = flight_dump(
                         f"incident-{idx}-{inc.kind}", epoch=self._epoch, index=idx
                     )
@@ -600,10 +722,12 @@ class SoakSupervisor:
                 except ChaosSoakError as err:
                     record["ok"] = False
                     record["error"] = str(err)
+                    unrecovered += 1
+                    self._unrecovered = unrecovered
+                    record["slo"] = self._slo_summary()
                     record["flight_dump"] = flight_dump(
                         f"incident-{idx}-{inc.kind}-FAILED", epoch=self._epoch, index=idx
                     )
-                    unrecovered += 1
                     incidents_out.append(record)
                     self._teardown()
                     break
@@ -619,10 +743,18 @@ class SoakSupervisor:
                 final["ok"] = True
         except Exception as err:
             unrecovered += 1
+            self._unrecovered = unrecovered
             final = {"ok": False, "error": f"{type(err).__name__}: {err}"}
             self._teardown()
         finally:
             self._teardown()
+            if self._admin is not None:
+                self._admin.close()
+                self._admin = None
+            try:
+                self._slo.close()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                pass
             if prior is None:
                 disable_flight_recorder()
             else:
@@ -655,6 +787,7 @@ class SoakSupervisor:
                 ),
                 "min": round(min(self._throughputs), 1) if self._throughputs else None,
             },
+            "federation": self.federation_summary(),
             "final": final,
         }
 
@@ -665,11 +798,14 @@ def run_soak(
     *,
     out_jsonl: Optional[str] = None,
     verbose: bool = False,
+    admin_port: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Execute ``schedule`` under a :class:`SoakSupervisor` rooted at
     ``root``; optionally stream the incident report to ``out_jsonl`` (one
-    line per incident, a ``summary`` line last).  Returns the report."""
-    report = SoakSupervisor(schedule, root, verbose=verbose).run()
+    line per incident, a ``summary`` line last).  ``admin_port`` serves the
+    pool-wide federated admin endpoint for the soak's duration.  Returns
+    the report."""
+    report = SoakSupervisor(schedule, root, verbose=verbose, admin_port=admin_port).run()
     if out_jsonl:
         with open(out_jsonl, "w") as fh:
             for rec in report["incidents"]:

@@ -157,6 +157,13 @@ def main(argv=None) -> int:
                 "batches": s["batches"], "items": s["items"],
                 "degraded": s["degraded"], "crashes": s["crashes"],
             }
+        if name == "telemetry":
+            # the federation payload: this rank's whole instruments registry
+            # (sketch state included) + ledger counters, as plain JSON — the
+            # supervisor merges every rank's into one live /metrics view
+            from tpumetrics.telemetry.federate import local_snapshot
+
+            return {"ok": True, "cmd": "telemetry", "snapshot": local_snapshot(rank=rank)}
         raise ValueError(f"unknown command {name!r}")
 
     _println({"event": "ready", "rank": rank, "world": world, "epoch": epoch, "pid": os.getpid()})

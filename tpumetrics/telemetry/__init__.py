@@ -38,6 +38,12 @@ Six parts (see ``docs/telemetry.md`` and ``docs/observability.md``):
 - **Cross-rank timelines** (:mod:`~tpumetrics.telemetry.timeline`): merge
   per-rank JSONL streams onto one wall-anchored axis, per-collective entry
   skew, straggler reports, and :func:`perfetto_trace` rendering.
+- **The live introspection plane** (:mod:`~tpumetrics.telemetry.serve`,
+  :mod:`~tpumetrics.telemetry.slo`,
+  :mod:`~tpumetrics.telemetry.federate`, lazy): an embedded admin server
+  (``/metrics``, ``/healthz``, ``/statusz``, ``/spanz``, ``/flightz``),
+  declarative SLOs with multi-window burn-rate alerting, and cross-rank
+  federation of the instruments/ledger state into one merged live view.
 
 Quick start::
 
@@ -111,23 +117,40 @@ def __getattr__(name: str):
 
         mod = importlib.import_module("tpumetrics.telemetry.lockstep")
         return mod if name == "lockstep" else getattr(mod, name)
-    if name in ("xla", "device", "health"):
+    if name in ("xla", "device", "health", "serve", "slo", "federate"):
         # lazy like lockstep: xla.py imports jax at module top, and device/
         # health defer their jax imports — keeping them lazy means the
         # pure-AST analysis tooling never pulls heavy deps just to name the
-        # package
+        # package.  serve/slo/federate (the live introspection plane) are
+        # pure host-side but stay lazy for symmetry: importing telemetry
+        # must never start threads or touch sockets implicitly.
         import importlib
 
         return importlib.import_module(f"tpumetrics.telemetry.{name}")
+    if name in ("AdminServer", "start_admin_server"):
+        import importlib
+
+        return getattr(importlib.import_module("tpumetrics.telemetry.serve"), name)
+    if name in ("SloEngine", "SloRule"):
+        import importlib
+
+        return getattr(importlib.import_module("tpumetrics.telemetry.slo"), name)
+    if name in ("local_snapshot", "merge_snapshots"):
+        import importlib
+
+        return getattr(importlib.import_module("tpumetrics.telemetry.federate"), name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
+    "AdminServer",
     "CollectiveLedger",
     "CollectiveRecord",
     "FlightRecorder",
     "JsonlSink",
     "LockstepViolation",
     "LoggingSink",
+    "SloEngine",
+    "SloRule",
     "TelemetrySink",
     "attribution",
     "counter",
@@ -137,16 +160,22 @@ __all__ = [
     "export",
     "flight_dump",
     "flight_recorder",
+    "federate",
     "gauge",
     "histogram",
     "instruments",
+    "local_snapshot",
+    "merge_snapshots",
     "note_incident",
     "perfetto_trace",
     "prometheus_text",
     "record_span",
+    "serve",
+    "slo",
     "span",
     "spans",
     "spans_jsonl",
+    "start_admin_server",
     "start_span",
     "timeline",
     "capture",

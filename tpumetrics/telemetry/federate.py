@@ -1,0 +1,282 @@
+"""Cross-rank federation: merge N processes' telemetry into one live view.
+
+:mod:`tpumetrics.telemetry.timeline` merges per-rank JSONL *after the
+fact*; this module does the live equivalent for the aggregate layers.  A
+rank serializes its whole instruments registry + ledger counters with
+:func:`local_snapshot` (plain JSON — it travels over the soak's stdio
+wire, a file, or HTTP), and rank 0 / a supervisor merges any number of
+snapshots into a :class:`FederatedView` that renders one ``/metrics``
+exposition and one ``/statusz`` summary for the whole pool.
+
+Merge semantics per instrument kind — chosen so the merged family means
+the same thing the per-rank family does:
+
+- **counter**: key-wise sum over identical label tuples (counts add).
+- **gauge**: key-wise sum (queue depth, live tenants, state HBM — the
+  pool total; per-rank values stay distinguishable only when the label
+  carries the rank/stream, which the runtime's auto-minted stream labels
+  do).
+- **histogram**: bucket-wise sum of the cumulative grid, sum/count add,
+  max/min fold — and when the series carry **sketch state**
+  (:mod:`~tpumetrics.telemetry.instruments` sketch mode), the sparse
+  sketches merge by key-wise sum, so a federated ``p99`` carries the SAME
+  ≤ 1/capacity relative-error bound as a local one.  This is the
+  dogfooded :mod:`tpumetrics.monitoring.sketch` mergeability argument,
+  applied to the telemetry plane itself.
+- **ledger**: ``counts_by_kind`` and the scalar aggregates sum.
+
+Families that disagree on kind/labels/bucket edges across snapshots are
+refused loudly (a federated view silently mixing two different grids
+would render meaningless buckets).  Snapshots are versioned; unknown
+future fields are ignored.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from tpumetrics.telemetry import instruments as _instruments
+from tpumetrics.telemetry import ledger as _ledger
+from tpumetrics.telemetry.export import _fmt_labels, _fmt_value
+
+__all__ = ["FederatedView", "local_snapshot", "merge_snapshots"]
+
+SNAPSHOT_VERSION = 1
+
+
+def local_snapshot(
+    rank: Optional[int] = None, include_ledger: bool = True
+) -> Dict[str, Any]:
+    """This process's aggregate telemetry as one JSON-able dict: every
+    registered instrument (:meth:`~tpumetrics.telemetry.instruments.
+    Instrument.to_dict`, sketch state included) plus the global ledger's
+    counters.  A pure read — nothing is minted, reset, or synced."""
+    return {
+        "v": SNAPSHOT_VERSION,
+        "rank": rank if rank is not None else os.getpid(),
+        "instruments": [inst.to_dict() for inst in _instruments.registry()],
+        "ledger": _ledger.summary() if include_ledger else None,
+    }
+
+
+class FederationError(ValueError):
+    """Snapshots disagree on a family's shape (kind / labels / edges)."""
+
+
+def _merge_histogram_series(
+    into: Dict[str, Any], series: Dict[str, Any]
+) -> None:
+    a, b = into, series
+    a["overflow"] = a.get("overflow", 0) + b.get("overflow", 0)
+    a["sum"] = a.get("sum", 0.0) + b.get("sum", 0.0)
+    a["count"] = a.get("count", 0) + b.get("count", 0)
+    a["max"] = max(a.get("max", 0.0), b.get("max", 0.0))
+    mins = [m for m in (a.get("min"), b.get("min")) if m is not None]
+    a["min"] = min(mins) if mins else None
+    edges_a = [e for e, _ in a["buckets"]]
+    edges_b = [e for e, _ in b["buckets"]]
+    if edges_a != edges_b:
+        raise FederationError(
+            f"histogram bucket edges differ across snapshots: {edges_a} vs {edges_b}"
+        )
+    a["buckets"] = [
+        (e, ca + cb) for (e, ca), (_e, cb) in zip(a["buckets"], b["buckets"])
+    ]
+    if "sketch" in a or "sketch" in b:
+        merged = dict(a.get("sketch") or {})
+        for k, c in (b.get("sketch") or {}).items():
+            merged[k] = merged.get(k, 0.0) + c
+        a["sketch"] = merged
+
+
+class FederatedView:
+    """N merged snapshots, rendered as one exposition / one status dict."""
+
+    def __init__(self, families: Dict[str, Dict[str, Any]],
+                 ledger: Dict[str, Any], ranks: List[Any]) -> None:
+        self._families = families
+        self._ledger = ledger
+        self.ranks = ranks
+
+    # ------------------------------------------------------------ renderers
+
+    def _family_lines(self, fam: Dict[str, Any]) -> Iterator[str]:
+        name, kind = fam["name"], fam["type"]
+        labelnames = tuple(fam["labels"])
+        if fam.get("help"):
+            yield f"# HELP {name} {fam['help']}"
+        yield f"# TYPE {name} {kind}"
+        for lv_key in sorted(fam["series"]):
+            data = fam["series"][lv_key]
+            lv = tuple(lv_key)
+            if kind == "histogram":
+                cum = 0
+                for edge, c in data["buckets"]:
+                    cum += c
+                    yield (
+                        f"{name}_bucket"
+                        f"{_fmt_labels(labelnames, lv, {'le': _fmt_value(edge)})} {cum}"
+                    )
+                cum += data["overflow"]
+                yield f"{name}_bucket{_fmt_labels(labelnames, lv, {'le': '+Inf'})} {cum}"
+                yield f"{name}_sum{_fmt_labels(labelnames, lv)} {_fmt_value(data['sum'])}"
+                yield f"{name}_count{_fmt_labels(labelnames, lv)} {data['count']}"
+            else:
+                yield f"{name}{_fmt_labels(labelnames, lv)} {_fmt_value(data)}"
+
+    def prometheus_text(self) -> str:
+        """The merged registries in Prometheus text exposition format —
+        the same grammar :func:`~tpumetrics.telemetry.export.
+        prometheus_text` emits (the round-trip validator parses both), plus
+        the merged ledger families."""
+        lines: List[str] = []
+        for name in sorted(self._families):
+            lines.extend(self._family_lines(self._families[name]))
+        if self._ledger:
+            lines.append("# TYPE tpumetrics_ledger_events_total counter")
+            for kind in sorted(self._ledger.get("counts_by_kind", {})):
+                lines.append(
+                    f"tpumetrics_ledger_events_total{_fmt_labels(('kind',), (kind,))} "
+                    f"{self._ledger['counts_by_kind'][kind]}"
+                )
+            lines.append("# TYPE tpumetrics_ledger_collectives_total counter")
+            lines.append(
+                f"tpumetrics_ledger_collectives_total {self._ledger.get('collectives_issued', 0)}"
+            )
+            lines.append("# TYPE tpumetrics_ledger_wire_bytes_total counter")
+            lines.append(
+                "tpumetrics_ledger_wire_bytes_total "
+                f"{_fmt_value(self._ledger.get('wire_bytes_total', 0.0))}"
+            )
+        return "\n".join(lines) + "\n"
+
+    def quantile(self, name: str, q: float, *labels: str) -> Optional[float]:
+        """Federated q-quantile of a merged histogram family: read from the
+        merged sketch when the series carry one (the exact-bound path),
+        else bucket interpolation over the merged grid."""
+        fam = self._families.get(name)
+        if fam is None or fam["type"] != "histogram":
+            return None
+        rows = (
+            [fam["series"].get(tuple(labels))]
+            if labels
+            else list(fam["series"].values())
+        )
+        rows = [r for r in rows if r]
+        if not rows:
+            return None
+        agg: Dict[str, Any] = {
+            "buckets": [(e, 0) for e, _ in rows[0]["buckets"]],
+            "overflow": 0, "sum": 0.0, "count": 0, "max": 0.0, "min": None,
+        }
+        for row in rows:
+            _merge_histogram_series(agg, row)
+        if agg["count"] == 0:
+            return None
+        sketch = agg.get("sketch")
+        if sketch:
+            params = fam.get("sketch_params") or {}
+            return _instruments.sketch_quantile(
+                {int(k): v for k, v in sketch.items()}, q,
+                minimum=agg["min"] if agg["min"] is not None else 0.0,
+                maximum=agg["max"],
+                levels=int(params.get("levels", _instruments.SKETCH_LEVELS)),
+                capacity=int(params.get("capacity", _instruments.SKETCH_CAPACITY)),
+            )
+        # fixed-grid fallback: linear interpolation like Histogram._quantile_of
+        rank = q * agg["count"]
+        cum = 0.0
+        prev_edge = 0.0
+        for edge, c in agg["buckets"]:
+            prev = cum
+            cum += c
+            if cum >= rank and c > 0:
+                frac = (rank - prev) / c
+                return min(prev_edge + (edge - prev_edge) * frac, agg["max"])
+            prev_edge = edge
+        return agg["max"]
+
+    def statusz(self) -> Dict[str, Any]:
+        """The merged ``/statusz`` section: pool membership, headline
+        latency quantiles from the merged sketches, and the summed ledger
+        counters."""
+        out: Dict[str, Any] = {
+            "ranks": list(self.ranks),
+            "world": len(self.ranks),
+            "ledger": dict(self._ledger) if self._ledger else {},
+            "latency": {},
+            "families": sorted(self._families),
+        }
+        for key, name in (
+            ("submit_ms", _instruments.SUBMIT_LATENCY_MS),
+            ("dispatch_ms", _instruments.DISPATCH_LATENCY_MS),
+            ("restore_ms", _instruments.RESTORE_LATENCY_MS),
+        ):
+            out["latency"][key] = {
+                "p50": self.quantile(name, 0.50),
+                "p99": self.quantile(name, 0.99),
+            }
+        return out
+
+
+def merge_snapshots(snapshots: List[Dict[str, Any]]) -> FederatedView:
+    """Fold N :func:`local_snapshot` payloads into one
+    :class:`FederatedView` (module docstring has the per-kind semantics).
+    Order-independent: counter/bucket/sketch sums and min/max folds are the
+    associative merges the sketch state kind was designed around."""
+    families: Dict[str, Dict[str, Any]] = {}
+    ledger_merged: Dict[str, Any] = {}
+    ranks: List[Any] = []
+    for snap in snapshots:
+        ranks.append(snap.get("rank"))
+        for fam in snap.get("instruments", []):
+            name = fam["name"]
+            got = families.get(name)
+            if got is None:
+                got = families[name] = {
+                    "name": name,
+                    "type": fam["type"],
+                    "help": fam.get("help", ""),
+                    "labels": list(fam.get("labels", [])),
+                    "series": {},
+                }
+                if fam.get("sketch_params"):
+                    got["sketch_params"] = dict(fam["sketch_params"])
+            if got["type"] != fam["type"] or got["labels"] != list(fam.get("labels", [])):
+                raise FederationError(
+                    f"family {name!r} disagrees across snapshots: "
+                    f"{got['type']}/{got['labels']} vs "
+                    f"{fam['type']}/{fam.get('labels')}"
+                )
+            for series in fam.get("series", []):
+                lv = tuple(series["label_values"])
+                value = series["value"]
+                if fam["type"] == "histogram":
+                    # normalize the JSON round-trip's list-pairs to tuples
+                    value = dict(value)
+                    value["buckets"] = [tuple(p) for p in value["buckets"]]
+                    if lv not in got["series"]:
+                        base = dict(value)
+                        base["buckets"] = [(e, 0) for e, _ in value["buckets"]]
+                        base.update(overflow=0, sum=0.0, count=0, max=0.0, min=None)
+                        if "sketch" in value:
+                            base["sketch"] = {}
+                        got["series"][lv] = base
+                    _merge_histogram_series(got["series"][lv], value)
+                else:
+                    got["series"][lv] = got["series"].get(lv, 0.0) + float(value)
+        led = snap.get("ledger")
+        if led:
+            for key, val in led.items():
+                if key == "counts_by_kind":
+                    bucket = ledger_merged.setdefault("counts_by_kind", {})
+                    for kind, n in val.items():
+                        bucket[kind] = bucket.get(kind, 0) + n
+                elif key == "bytes_by_op":
+                    bucket = ledger_merged.setdefault("bytes_by_op", {})
+                    for op, n in val.items():
+                        bucket[op] = bucket.get(op, 0.0) + n
+                elif isinstance(val, (int, float)):
+                    ledger_merged[key] = ledger_merged.get(key, 0) + val
+    return FederatedView(families, ledger_merged, ranks)

@@ -28,10 +28,27 @@ interpolation inside the covering bucket; the overflow bucket reports the
 exact tracked ``max``), so a ``p99`` is only as fine as the bucket grid —
 the default millisecond grid resolves sub-millisecond latencies, which is
 what the soak gate needs.  ``sum``/``count``/``max`` are exact.
+
+**Sketch mode** (``histogram(..., sketch=True)``) additionally folds every
+observation into a sparse host-side log-linear sketch with EXACTLY the
+geometry of :class:`tpumetrics.monitoring.sketch.SketchLayout` (levels ×
+capacity linear buckets per magnitude octave, mirrored per sign, exact
+min/max envelope — a parity test pins the bin indices against the device
+sketch).  Quantile reads then carry the sketch's documented bound —
+**relative error ≤ 1/capacity** inside the covered magnitude range —
+instead of fixed-grid interpolation, and because the sketch is a sparse
+count map its merge is a plain key-wise sum: serialized series from N
+processes federate into one exact-bound distribution
+(:mod:`tpumetrics.telemetry.federate`).  The Prometheus exposition is
+unchanged (the fixed ``le`` buckets still export); only ``quantile()``/
+``summary()`` and the federation payload see the sketch.  Cost per
+``observe``: one log2, two clips, one dict bump — the runtime's shared
+submit/dispatch/restore histograms run in this mode.
 """
 
 from __future__ import annotations
 
+import math
 import threading
 from bisect import bisect_left
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
@@ -41,15 +58,20 @@ __all__ = [
     "Gauge",
     "Histogram",
     "Instrument",
+    "SKETCH_CAPACITY",
+    "SKETCH_LEVELS",
     "counter",
     "disable",
     "enable",
     "enabled",
     "gauge",
+    "get_instrument",
     "histogram",
     "latency_section",
     "registry",
     "reset",
+    "sketch_index",
+    "sketch_quantile",
 ]
 
 _ENABLED = True
@@ -66,6 +88,93 @@ DEFAULT_MS_BUCKETS: Tuple[float, ...] = (
 DEFAULT_S_BUCKETS: Tuple[float, ...] = (
     0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
+
+# -------------------------------------------------------- sketch geometry
+#
+# The host-side mirror of monitoring/sketch.py's SketchLayout index math —
+# pure python so the submit path never touches jax.  Parameters default to
+# the device sketch's defaults; a parity test pins the two bucket_index
+# implementations against each other, so the geometry cannot drift.
+
+#: default sketch geometry for sketch-mode histograms (matches
+#: monitoring.sketch.SketchLayout defaults: relative error <= 1/capacity)
+SKETCH_LEVELS = 44
+SKETCH_CAPACITY = 64
+
+
+def _sketch_unit(levels: int) -> float:
+    return 2.0 ** (24 - levels)
+
+
+def sketch_index(value: float, levels: int = SKETCH_LEVELS,
+                 capacity: int = SKETCH_CAPACITY) -> int:
+    """Flat sketch-slot index of one value (sign-mirrored, level-major) —
+    bit-identical to ``SketchLayout.bucket_index`` on the same geometry."""
+    unit = _sketch_unit(levels)
+    a = abs(value)
+    if a != a:  # NaN: bin like the device sketch's masked zero
+        a = 0.0
+    safe = max(a, unit * 2.0 ** -40)
+    if math.isinf(safe):  # the device sketch's float-space clip to the top level
+        lvl = levels - 1
+    else:
+        lvl = min(max(int(math.floor(math.log2(safe / unit))) + 1, 0), levels - 1)
+    if lvl == 0:
+        lo, width = 0.0, unit
+    else:
+        lo = width = unit * 2.0 ** (lvl - 1)
+    if math.isinf(a):
+        j = capacity - 1  # inf outliers clip into the top bucket, not wrap
+    else:
+        j = min(max(int((a - lo) * capacity / width), 0), capacity - 1)
+    flat = lvl * capacity + j
+    side = levels * capacity
+    return flat + side if value < 0 else flat
+
+
+def _sketch_rep(index: int, levels: int, capacity: int) -> float:
+    """Signed bucket-midpoint representative value of one sketch slot."""
+    unit = _sketch_unit(levels)
+    side = levels * capacity
+    sign = -1.0 if index >= side else 1.0
+    flat = index - side if index >= side else index
+    lvl, j = divmod(flat, capacity)
+    if lvl == 0:
+        lo, width = 0.0, unit
+    else:
+        lo = width = unit * 2.0 ** (lvl - 1)
+    return sign * (lo + (j + 0.5) * (width / capacity))
+
+
+def sketch_quantile(
+    counts: Dict[int, float],
+    q: float,
+    *,
+    minimum: float,
+    maximum: float,
+    levels: int = SKETCH_LEVELS,
+    capacity: int = SKETCH_CAPACITY,
+) -> Optional[float]:
+    """q-quantile of a sparse sketch count map: midpoint lookup on the
+    cumulative counts in ascending value order, clamped into the exact
+    ``[minimum, maximum]`` envelope (``SketchLayout.quantile`` semantics).
+    ``None`` on an empty sketch.  THE one copy of the read — live
+    summaries and the federated merged view both call it."""
+    total = sum(counts.values())
+    if total <= 0:
+        return None
+    reps = sorted(
+        (_sketch_rep(i, levels, capacity), c) for i, c in counts.items() if c > 0
+    )
+    rank = q * total
+    cum = 0.0
+    est = reps[-1][0]
+    for rep, c in reps:
+        cum += c
+        if cum >= rank:
+            est = rep
+            break
+    return min(max(est, minimum), maximum)
 
 # shared instrument names the runtime registers (stats()/bench read these)
 SUBMIT_LATENCY_MS = "tpumetrics_submit_latency_ms"
@@ -84,6 +193,9 @@ PROGRAM_FLOPS = "tpumetrics_program_flops"
 PROGRAM_HBM_BYTES = "tpumetrics_program_hbm_bytes"
 STATE_HBM_BYTES = "tpumetrics_state_hbm_bytes"
 STATE_NONFINITE = "tpumetrics_state_nonfinite_total"
+# SLO engine (telemetry/slo.py)
+SLO_BURN_RATE = "tpumetrics_slo_burn_rate"
+SLO_VIOLATIONS = "tpumetrics_slo_violations_total"
 
 
 def enabled() -> bool:
@@ -220,13 +332,16 @@ class Gauge(Instrument):
 
 
 class _Series:
-    __slots__ = ("counts", "sum", "count", "max")
+    __slots__ = ("counts", "sum", "count", "max", "min", "sketch")
 
-    def __init__(self, n_buckets: int) -> None:
+    def __init__(self, n_buckets: int, sketch: bool = False) -> None:
         self.counts = [0] * n_buckets
         self.sum = 0.0
         self.count = 0
         self.max = 0.0
+        self.min = float("inf")  # exact envelope (sketch-mode clamp)
+        # sparse sketch-slot counts ({flat index: count}); None in plain mode
+        self.sketch: Optional[Dict[int, float]] = {} if sketch else None
 
 
 class Histogram(Instrument):
@@ -244,12 +359,18 @@ class Histogram(Instrument):
         help: str = "",
         labels: Sequence[str] = (),
         buckets: Sequence[float] = DEFAULT_MS_BUCKETS,
+        sketch: bool = False,
     ) -> None:
         super().__init__(name, help, labels)
         edges = tuple(sorted(float(b) for b in buckets))
         if not edges:
             raise ValueError("histogram needs at least one bucket edge")
         self.edges = edges
+        #: sketch mode (module docstring): quantiles carry the sketch's
+        #: <= 1/capacity relative-error bound and series become federatable
+        self.sketch = bool(sketch)
+        self.sketch_levels = SKETCH_LEVELS
+        self.sketch_capacity = SKETCH_CAPACITY
         self._series: Dict[Tuple[str, ...], _Series] = {}
 
     def observe(self, value: float, *labels: str) -> None:
@@ -257,20 +378,29 @@ class Histogram(Instrument):
             return
         self._check_labels(labels)
         i = bisect_left(self.edges, value)
+        si = (
+            sketch_index(value, self.sketch_levels, self.sketch_capacity)
+            if self.sketch
+            else -1
+        )
         with self._lock:
             row = self._series.get(labels)
             if row is None:
-                row = self._series[labels] = _Series(len(self.edges) + 1)
+                row = self._series[labels] = _Series(len(self.edges) + 1, self.sketch)
             row.counts[i] += 1
             row.sum += value
             row.count += 1
             if value > row.max:
                 row.max = value
+            if value < row.min:
+                row.min = value
+            if row.sketch is not None:
+                row.sketch[si] = row.sketch.get(si, 0.0) + 1.0
 
     # ------------------------------------------------------------- reading
 
     def _aggregate(self, labels: Optional[Tuple[str, ...]]) -> _Series:
-        agg = _Series(len(self.edges) + 1)
+        agg = _Series(len(self.edges) + 1, self.sketch)
         with self._lock:
             rows = (
                 [self._series[labels]]
@@ -283,11 +413,23 @@ class Histogram(Instrument):
                 agg.sum += row.sum
                 agg.count += row.count
                 agg.max = max(agg.max, row.max)
+                agg.min = min(agg.min, row.min)
+                if agg.sketch is not None and row.sketch is not None:
+                    for si, c in row.sketch.items():
+                        agg.sketch[si] = agg.sketch.get(si, 0.0) + c
         return agg
 
     def _quantile_of(self, agg: _Series, q: float) -> Optional[float]:
         if agg.count == 0:
             return None
+        if agg.sketch:
+            # sketch mode: bucket-midpoint lookup with the documented
+            # <= 1/capacity relative-error bound, clamped to the exact
+            # [min, max] envelope — SketchLayout.quantile semantics
+            return sketch_quantile(
+                agg.sketch, q, minimum=agg.min, maximum=agg.max,
+                levels=self.sketch_levels, capacity=self.sketch_capacity,
+            )
         rank = q * agg.count
         cum = 0.0
         for i, c in enumerate(agg.counts):
@@ -340,13 +482,27 @@ class Histogram(Instrument):
         with self._lock:
             rows = list(self._series.items())
         for lv, row in rows:
-            yield lv, {
+            data = {
                 "buckets": list(zip(self.edges, row.counts[:-1])),
                 "overflow": row.counts[-1],
                 "sum": row.sum,
                 "count": row.count,
                 "max": row.max,
+                "min": row.min if row.count else None,
             }
+            if row.sketch is not None:
+                # JSON-able sparse sketch state: the federation payload
+                # (key-wise sum is the merge; telemetry/federate.py)
+                data["sketch"] = {str(i): c for i, c in row.sketch.items()}
+            yield lv, data
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = super().to_dict()
+        if self.sketch:
+            out["sketch_params"] = {
+                "levels": self.sketch_levels, "capacity": self.sketch_capacity,
+            }
+        return out
 
 
 # ------------------------------------------------------------------ registry
@@ -383,13 +539,16 @@ def histogram(
     help: str = "",
     labels: Sequence[str] = (),
     buckets: Optional[Sequence[float]] = None,
+    sketch: bool = False,
 ) -> Histogram:
-    """Get-or-create the named :class:`Histogram` (``buckets`` only applies
-    at creation; a later mismatched ``buckets`` is ignored — edges are part
-    of the first registration)."""
+    """Get-or-create the named :class:`Histogram` (``buckets`` and
+    ``sketch`` only apply at creation; a later mismatched value is ignored
+    — like the edges, the quantile mode is part of the first
+    registration)."""
     return _get_or_create(
         Histogram, name, help, labels,
         buckets=tuple(buckets) if buckets is not None else DEFAULT_MS_BUCKETS,
+        sketch=bool(sketch),
     )
 
 
@@ -400,10 +559,12 @@ def latency_section(stream: str) -> Dict[str, Any]:
     nothing was observed (instruments disabled, or a fresh stream)."""
     return {
         "submit_ms": histogram(
-            SUBMIT_LATENCY_MS, help="submit() call latency", labels=("stream",)
+            SUBMIT_LATENCY_MS, help="submit() call latency", labels=("stream",),
+            sketch=True,
         ).summary(stream),
         "dispatch_ms": histogram(
-            DISPATCH_LATENCY_MS, help="device dispatch latency", labels=("stream",)
+            DISPATCH_LATENCY_MS, help="device dispatch latency", labels=("stream",),
+            sketch=True,
         ).summary(stream),
     }
 
@@ -412,6 +573,14 @@ def registry() -> List[Instrument]:
     """Snapshot of every registered instrument (export order: by name)."""
     with _LOCK:
         return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def get_instrument(name: str) -> Optional[Instrument]:
+    """The registered instrument, or ``None`` — a pure read (no
+    get-or-create side effects: SLO signals and federation must observe
+    the registry, never mint families)."""
+    with _LOCK:
+        return _REGISTRY.get(name)
 
 
 def reset(full: bool = False) -> None:

@@ -223,6 +223,10 @@ class CollectiveLedger:
             # metric state (one event per stream+state on FIRST corruption —
             # before the compute-time non-finite guard would trip)
             self.state_health_events += 1
+        elif rec.kind == "slo_violation":
+            # an SLO rule's burn rate crossed its fast/slow threshold
+            # (hysteresis-latched: one event per crossing — telemetry/slo.py)
+            self.slo_violations += 1
         self.counts_by_kind[rec.kind] = self.counts_by_kind.get(rec.kind, 0) + 1
         for sink in self._sinks:
             sink.emit(rec)
@@ -257,6 +261,7 @@ class CollectiveLedger:
         self.xla_retraces = 0
         self.drift_alerts = 0
         self.state_health_events = 0
+        self.slo_violations = 0
         self.spmd_collectives = 0
         self.spmd_wire_bytes = 0.0
         self.bytes_by_op: Dict[str, float] = {}
@@ -305,6 +310,7 @@ class CollectiveLedger:
             "xla_retraces": self.xla_retraces,
             "drift_alerts": self.drift_alerts,
             "state_health_events": self.state_health_events,
+            "slo_violations": self.slo_violations,
             "spmd_collectives": self.spmd_collectives,
             "spmd_wire_bytes": self.spmd_wire_bytes,
             "records": len(self.records),

@@ -1,0 +1,391 @@
+"""The embedded admin server — the live side of the observability stack.
+
+Everything the telemetry layers record (instruments, ledger, spans, device
+profiles, health, flight ring) is host-resident process state; this module
+*serves* it while the job runs, so an operator can point a Prometheus
+scraper, a k8s probe, or a pager at a live evaluator instead of calling
+python functions from their own code:
+
+========== ===============================================================
+endpoint   payload
+========== ===============================================================
+/metrics   Prometheus text exposition — the whole instruments registry +
+           ledger-derived families (:func:`~tpumetrics.telemetry.export.
+           prometheus_text`); with a federation provider installed, the
+           MERGED multi-process view (``?local=1`` forces this process)
+/healthz   process liveness + per-stream degraded / quarantine / state-
+           health + latched SLO breaches.  **200** while everything is
+           healthy, **503** otherwise — wire it as a k8s readiness probe
+           (the process answering at all is the liveness signal)
+/statusz   JSON: per-target ``stats()`` (the ``device`` section included),
+           per-tenant queue depths and DRR shares, signature-cache
+           occupancy, SLO engine status, federation membership
+/spanz     the recent finished-span ring as JSON (``?limit=N``)
+/flightz   trigger a flight dump and download it as JSONL (404 when no
+           flight recorder is installed)
+========== ===============================================================
+
+**Strict reader discipline** (the PR 13 contract, now load-bearing for a
+scraper): every handler only ever *reads* host-side state — instrument
+locks, ``stats()`` (documented never-blocking: health reads serve the
+cached summary while a dispatch is in flight), the span ring.  Handlers
+additionally run under ``jax.transfer_guard_device_to_host("disallow")``
+when jax is loaded, so a reader that would synchronize with the device
+raises a 500 instead of silently stalling the scrape — and an in-flight-
+step concurrency test pins that a scrape returns while a slow device
+program is still executing.  Nothing in this module is ever reachable
+from ``update()`` and no handler may issue a blocking device read —
+tpulint **TPL106** enforces both statically.
+
+The server is a stdlib ``ThreadingHTTPServer`` on a **daemon thread**:
+``port=0`` binds an ephemeral port (read it back from
+:attr:`AdminServer.port`), startup is synchronous (the constructor returns
+with the socket listening), and ``close()`` is idempotent.  Construct one
+directly, via :func:`start_admin_server`, or let the runtime own it —
+``StreamingEvaluator(admin_port=0)`` / ``EvaluationService(admin_port=0)``
+start one scoped to that instance and stop it on ``close()``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from tpumetrics.telemetry import export as _export
+from tpumetrics.telemetry import spans as _spans
+
+__all__ = ["AdminServer", "start_admin_server"]
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _no_device_sync():
+    """A transfer guard for the handler body: device→host syncs raise
+    instead of stalling the scrape.  Inert (a null context) when jax was
+    never imported — serving pure-host telemetry must not pull in jax."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return contextlib.nullcontext()
+    return jax.transfer_guard_device_to_host("disallow")
+
+
+def _target_kind(obj: Any) -> str:
+    return "service" if hasattr(obj, "tenant_ids") else "evaluator"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Thin HTTP shim: parse, delegate to the server's render table, write.
+    All state lives on ``self.server`` (the :class:`_AdminHTTPServer`)."""
+
+    server_version = "tpumetrics-admin"
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self) -> None:  # noqa: N802 - stdlib naming
+        parsed = urlparse(self.path)
+        query = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
+        try:
+            with _no_device_sync():
+                status, ctype, body = self.server.admin.render(parsed.path, query)
+        except Exception as err:  # noqa: BLE001 — a broken reader is a 500,
+            # never a dead serving thread (and never a device stall)
+            status, ctype = 500, "application/json"
+            body = json.dumps(
+                {"error": f"{type(err).__name__}: {err}"}
+            ).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, format: str, *args: Any) -> None:  # noqa: A002
+        pass  # scrapes must not spam stderr; /statusz carries the counters
+
+
+class _AdminHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    admin: "AdminServer"
+
+
+class AdminServer:
+    """The embedded admin/introspection server (module docstring).
+
+    Args:
+        port: TCP port (0 = ephemeral; read :attr:`port` back).
+        host: bind address (default loopback — expose deliberately).
+        targets: ``{name: evaluator_or_service}`` to surface in
+            ``/healthz`` / ``/statusz``; add more with :meth:`add_target`.
+        slo: optional :class:`~tpumetrics.telemetry.slo.SloEngine` (or a
+            list of them) whose latched breaches flip ``/healthz``.
+        federation: optional zero-arg callable returning a list of
+            :func:`~tpumetrics.telemetry.federate.local_snapshot` dicts
+            (one per rank/process); installs the merged ``/metrics`` +
+            ``/statusz`` view.
+        name: served in ``/statusz`` (defaults to ``tpumetrics-admin``).
+    """
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        *,
+        targets: Optional[Dict[str, Any]] = None,
+        slo: Any = None,
+        federation: Optional[Callable[[], Optional[List[Dict[str, Any]]]]] = None,
+        name: str = "tpumetrics-admin",
+    ) -> None:
+        self.name = str(name)
+        self._lock = threading.Lock()
+        self._targets: Dict[str, Any] = dict(targets or {})
+        engines = slo if isinstance(slo, (list, tuple)) else ([slo] if slo else [])
+        self._slo: List[Any] = list(engines)
+        self._federation = federation
+        self._started = time.monotonic()
+        self._scrapes = 0
+        self._closed = False
+        self._httpd = _AdminHTTPServer((host, int(port)), _Handler)
+        self._httpd.admin = self
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"tpumetrics-admin[{self._httpd.server_address[1]}]",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- plumbing
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def add_target(self, name: str, obj: Any) -> None:
+        with self._lock:
+            self._targets[str(name)] = obj
+
+    def remove_target(self, name: str) -> None:
+        with self._lock:
+            self._targets.pop(str(name), None)
+
+    def add_slo(self, engine: Any) -> None:
+        with self._lock:
+            self._slo.append(engine)
+
+    def set_federation(
+        self, provider: Optional[Callable[[], Optional[List[Dict[str, Any]]]]]
+    ) -> None:
+        with self._lock:
+            self._federation = provider
+
+    def close(self) -> None:
+        """Stop serving (idempotent).  Attached SLO engines are NOT closed
+        — they belong to whoever constructed them (the runtime's
+        ``admin_port`` convenience owns and closes both)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=10.0)
+
+    def __enter__(self) -> "AdminServer":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    # ------------------------------------------------------------ rendering
+
+    def render(self, path: str, query: Dict[str, str]) -> Tuple[int, str, bytes]:
+        """(status, content type, body) for one request path — the whole
+        routing table, callable without a socket (tests exercise it both
+        ways)."""
+        with self._lock:
+            self._scrapes += 1
+        if path in ("/metrics", "/metrics/"):
+            return self._metrics(query)
+        if path in ("/healthz", "/healthz/"):
+            return self._healthz()
+        if path in ("/statusz", "/statusz/"):
+            return self._statusz()
+        if path in ("/spanz", "/spanz/"):
+            return self._spanz(query)
+        if path in ("/flightz", "/flightz/"):
+            return self._flightz()
+        if path in ("", "/"):
+            body = json.dumps(
+                {"endpoints": ["/metrics", "/healthz", "/statusz", "/spanz", "/flightz"]}
+            ).encode()
+            return 200, "application/json", body
+        return 404, "application/json", json.dumps({"error": f"unknown path {path}"}).encode()
+
+    def _metrics(self, query: Dict[str, str]) -> Tuple[int, str, bytes]:
+        with self._lock:
+            provider = self._federation
+        if provider is not None and not query.get("local"):
+            snaps = provider()
+            if snaps:
+                from tpumetrics.telemetry import federate as _federate
+
+                text = _federate.merge_snapshots(snaps).prometheus_text()
+                return 200, PROMETHEUS_CONTENT_TYPE, text.encode()
+        return 200, PROMETHEUS_CONTENT_TYPE, _export.prometheus_text().encode()
+
+    # -------------------------------------------------------------- healthz
+
+    def _healthz(self) -> Tuple[int, str, bytes]:
+        reasons: List[str] = []
+        streams: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            targets = dict(self._targets)
+            engines = list(self._slo)
+        for name, obj in targets.items():
+            for label, stats in self._target_streams(name, obj):
+                entry = self._stream_health(label, stats)
+                streams[label] = entry
+                reasons.extend(entry["reasons"])
+        breached: List[str] = []
+        for engine in engines:
+            breached.extend(engine.breached())
+        if breached:
+            reasons.append(f"slo_breach:{','.join(sorted(breached))}")
+        status = "ok" if not reasons else "degraded"
+        body = json.dumps(
+            {
+                "status": status,
+                "reasons": sorted(set(reasons)),
+                "streams": streams,
+                "slo_breached": sorted(breached),
+            },
+            sort_keys=True,
+        ).encode()
+        return (200 if status == "ok" else 503), "application/json", body
+
+    @staticmethod
+    def _target_streams(name: str, obj: Any):
+        """``(label, stats)`` per stream of one target.  A service's whole
+        tenant census reads under ONE bounded lock acquire
+        (``all_tenant_stats``) — per-tenant reads would stack one bounded
+        wait per tenant while a dispatch holds the service lock."""
+        if _target_kind(obj) != "service":
+            yield name, obj.stats()
+            return
+        census = getattr(obj, "all_tenant_stats", None)
+        if census is not None:
+            for tid, stats in census().items():
+                yield f"{name}/{tid}", stats
+        else:  # duck-typed service targets without the census read
+            for tid in obj.tenant_ids():
+                yield f"{name}/{tid}", obj.tenant_stats(tid)
+
+    @staticmethod
+    def _stream_health(label: str, stats: Dict[str, Any]) -> Dict[str, Any]:
+        """One stream's health row from its (never-blocking) stats dict."""
+        reasons: List[str] = []
+        quarantined = bool(stats.get("quarantined", False))
+        degraded = bool(stats.get("degraded", False))
+        if quarantined:
+            reasons.append(f"quarantined:{label}")
+        if degraded:
+            reasons.append(f"degraded:{label}")
+        nonfinite = 0
+        device = stats.get("device") or {}
+        health = device.get("health")
+        if health is not None:
+            nonfinite = int(health.get("nonfinite_total", 0))
+            if nonfinite:
+                reasons.append(f"state_health:{label}")
+        # a service-wide stats dict counts quarantines across tenants
+        q_tenants = int(stats.get("quarantined_tenants", 0) or 0)
+        if q_tenants:
+            reasons.append(f"quarantined_tenants:{label}")
+        return {
+            "quarantined": quarantined,
+            "degraded": degraded,
+            "state_nonfinite": nonfinite,
+            "reasons": reasons,
+        }
+
+    # -------------------------------------------------------------- statusz
+
+    def _statusz(self) -> Tuple[int, str, bytes]:
+        with self._lock:
+            targets = dict(self._targets)
+            engines = list(self._slo)
+            provider = self._federation
+            scrapes = self._scrapes
+        payload: Dict[str, Any] = {
+            "name": self.name,
+            "uptime_s": round(time.monotonic() - self._started, 3),
+            "scrapes": scrapes,
+            "targets": {},
+            "slo": [engine.status() for engine in engines],
+        }
+        for name, obj in targets.items():
+            kind = _target_kind(obj)
+            entry: Dict[str, Any] = {"kind": kind, "stats": obj.stats()}
+            if kind == "service":
+                census = getattr(obj, "all_tenant_stats", None)
+                entry["tenants"] = (
+                    census()
+                    if census is not None
+                    else {tid: obj.tenant_stats(tid) for tid in obj.tenant_ids()}
+                )
+            payload["targets"][name] = entry
+        if provider is not None:
+            snaps = provider()
+            if snaps:
+                from tpumetrics.telemetry import federate as _federate
+
+                payload["federation"] = _federate.merge_snapshots(snaps).statusz()
+        body = json.dumps(payload, sort_keys=True, default=repr).encode()
+        return 200, "application/json", body
+
+    # ---------------------------------------------------------- spanz/flight
+
+    @staticmethod
+    def _spanz(query: Dict[str, str]) -> Tuple[int, str, bytes]:
+        ring = [sp.to_dict() for sp in _spans.spans()]
+        try:
+            limit = int(query.get("limit", 0))
+        except ValueError:
+            limit = 0
+        if limit > 0:
+            ring = ring[-limit:]
+        body = json.dumps(
+            {"enabled": _spans.enabled(), "spans": ring}, default=repr
+        ).encode()
+        return 200, "application/json", body
+
+    @staticmethod
+    def _flightz() -> Tuple[int, str, bytes]:
+        if _export.flight_recorder() is None:
+            return 404, "application/json", json.dumps(
+                {"error": "no flight recorder installed (enable_flight_recorder)"}
+            ).encode()
+        path = _export.flight_dump("admin_flightz")
+        with open(path, "rb") as fh:  # type: ignore[arg-type]
+            body = fh.read()
+        return 200, "application/x-ndjson", body
+
+
+def start_admin_server(
+    port: int = 0,
+    host: str = "127.0.0.1",
+    **kwargs: Any,
+) -> AdminServer:
+    """Start an :class:`AdminServer` (daemon thread, listening on return).
+    ``port=0`` binds an ephemeral port — ``server.port`` has the real one."""
+    return AdminServer(port, host, **kwargs)

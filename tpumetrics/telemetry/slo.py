@@ -1,0 +1,523 @@
+"""Declarative SLOs with multi-window burn-rate alerting.
+
+The recording stack (instruments, ledger, health, drift) answers "what is
+happening"; this module answers "is it acceptable, and should someone be
+paged".  An :class:`SloRule` binds an **objective** — a predicate over one
+live signal (a histogram quantile, a gauge, a ledger event count) — to
+**fast/slow burn-rate windows** (the SRE multi-window pattern): each
+sampler tick classifies the signal as good or bad against the objective,
+and the *burn rate* over a window is
+
+    burn = bad_fraction(window) / error_budget
+
+so ``burn == 1`` means "exactly spending the budget", ``burn == 14`` means
+"the whole budget gone in 1/14 of the window".  A rule **breaches** when
+the fast window burns at ``fast_burn`` or the slow window at ``slow_burn``
+(fast catches an outage in minutes, slow catches a simmer that would miss
+any single spike threshold).
+
+Breaches **latch with hysteresis**, exactly like
+:mod:`tpumetrics.monitoring.drift`: one crossing emits ONE
+``slo_violation`` ledger event, bumps
+``tpumetrics_slo_violations_total{slo}``, and fans out to every notifier;
+the latch re-arms only once the worst normalized burn drops below
+``1 - hysteresis``, so a rate jittering around the threshold cannot page
+per tick.  ``tpumetrics_slo_burn_rate{slo}`` tracks the worst burn every
+tick, breach or not — the series an external alertmanager would page on.
+
+The :class:`SloEngine` samples on a **background daemon thread**
+(:meth:`~SloEngine.arm`), entirely host-side: a tick reads instruments
+(per-instrument locks), the ledger's aggregate counters, and plain python
+callables — never the device (tpulint TPL106 holds the sampler to the same
+no-blocking-reads discipline as the admin handlers).  Tests and embedders
+may instead drive :meth:`~SloEngine.tick` directly with an explicit clock,
+which is how the burn-rate unit tests pin fast-burn/slow-burn/recovery
+semantics deterministically.  ``close()`` stops the thread, releases the
+engine's minted ``{slo}`` label series, and clears the latches — the same
+series-release contract every runtime ``close()`` honors.
+
+Rule builders for the common objectives (:func:`latency_rule`,
+:func:`gauge_ceiling_rule`, :func:`event_rule`, :func:`callable_rule`) and
+:func:`standard_rules` for a whole evaluator/service are at the bottom;
+``docs/observability.md`` has the math walkthrough and a k8s wiring
+recipe.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
+
+from tpumetrics.telemetry import instruments as _instruments
+from tpumetrics.telemetry import ledger as _ledger
+
+__all__ = [
+    "SloEngine",
+    "SloRule",
+    "callable_rule",
+    "event_rule",
+    "gauge_ceiling_rule",
+    "jsonl_notifier",
+    "latency_rule",
+    "standard_rules",
+]
+
+_BURN_GAUGE = _instruments.gauge(
+    _instruments.SLO_BURN_RATE,
+    help="worst-window SLO burn rate (1.0 = spending the error budget exactly)",
+    labels=("slo",),
+)
+_VIOLATIONS = _instruments.counter(
+    _instruments.SLO_VIOLATIONS,
+    help="SLO breach crossings (hysteresis-latched: one per crossing)",
+    labels=("slo",),
+)
+
+
+class SloRule:
+    """One objective bound to fast/slow burn-rate thresholds.
+
+    Args:
+        name: rule label (the ``{slo}`` series label; must be unique per
+            engine).
+        signal: zero-arg callable returning the current measured value, or
+            ``None`` when there is no data yet (no-data ticks are neither
+            good nor bad — they leave the windows untouched).
+        objective: the bound the signal must honor.
+        comparison: ``"le"`` (good while ``signal <= objective``, e.g. a
+            p99 ceiling) or ``"ge"`` (good while ``signal >= objective``).
+        budget: allowed bad-sample fraction — the error budget the burn
+            rate is measured against (default 1e-2: 99% of samples good).
+        fast_window_s / fast_burn: the page-fast pair — breach when the
+            bad fraction over the last ``fast_window_s`` seconds reaches
+            ``fast_burn * budget``.
+        slow_window_s / slow_burn: the simmer pair, same shape.
+        hysteresis: re-arm margin on the NORMALIZED worst burn (breach at
+            1.0, re-arm below ``1 - hysteresis``).
+        description: free text carried on the violation event/notification.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        signal: Callable[[], Optional[float]],
+        objective: float,
+        *,
+        comparison: str = "le",
+        budget: float = 1e-2,
+        fast_window_s: float = 60.0,
+        fast_burn: float = 14.0,
+        slow_window_s: float = 3600.0,
+        slow_burn: float = 2.0,
+        hysteresis: float = 0.1,
+        description: str = "",
+    ) -> None:
+        if comparison not in ("le", "ge"):
+            raise ValueError(f"comparison must be 'le' or 'ge', got {comparison!r}")
+        if not 0.0 < budget <= 1.0:
+            raise ValueError(f"budget must be in (0, 1], got {budget}")
+        if fast_window_s <= 0 or slow_window_s <= 0:
+            raise ValueError("burn windows must be positive")
+        if fast_window_s > slow_window_s:
+            raise ValueError(
+                f"fast window ({fast_window_s}s) must not exceed the slow window "
+                f"({slow_window_s}s)"
+            )
+        if fast_burn <= 0 or slow_burn <= 0:
+            raise ValueError("burn thresholds must be positive")
+        if not 0.0 <= hysteresis < 1.0:
+            raise ValueError(f"hysteresis must be in [0, 1), got {hysteresis}")
+        self.name = str(name)
+        self.signal = signal
+        self.objective = float(objective)
+        self.comparison = comparison
+        self.budget = float(budget)
+        self.fast_window_s = float(fast_window_s)
+        self.fast_burn = float(fast_burn)
+        self.slow_window_s = float(slow_window_s)
+        self.slow_burn = float(slow_burn)
+        self.hysteresis = float(hysteresis)
+        self.description = str(description)
+        # sampler-thread-only (or the caller's tick thread): (t, bad) pairs
+        # covering the slow window; the fast window is its suffix
+        self._samples: Deque[Tuple[float, float]] = deque()
+
+    def is_bad(self, value: float) -> bool:
+        if self.comparison == "le":
+            return value > self.objective
+        return value < self.objective
+
+    # ------------------------------------------------------------- windows
+
+    def _observe(self, now: float, value: Optional[float]) -> None:
+        if value is None:
+            return
+        self._samples.append((now, 1.0 if self.is_bad(float(value)) else 0.0))
+        cutoff = now - self.slow_window_s
+        while self._samples and self._samples[0][0] < cutoff:
+            self._samples.popleft()
+
+    def _burn(self, now: float, window_s: float) -> float:
+        cutoff = now - window_s
+        n = bad = 0
+        for t, b in reversed(self._samples):
+            if t < cutoff:
+                break
+            n += 1
+            bad += b
+        if n == 0:
+            return 0.0
+        return (bad / n) / self.budget
+
+    def burn_rates(self, now: float) -> Tuple[float, float]:
+        """(fast, slow) burn rates at ``now`` (1.0 = spending the budget)."""
+        return self._burn(now, self.fast_window_s), self._burn(now, self.slow_window_s)
+
+    def worst_normalized(self, now: float) -> float:
+        """Worst window burn normalized to its threshold (breach at 1.0)."""
+        fast, slow = self.burn_rates(now)
+        return max(fast / self.fast_burn, slow / self.slow_burn)
+
+
+def jsonl_notifier(path: str) -> Callable[[Dict[str, Any]], None]:
+    """A notifier appending one JSON line per violation to ``path`` —
+    the file an on-call pipeline (or the soak supervisor) tails."""
+
+    lock = threading.Lock()
+
+    def notify(payload: Dict[str, Any]) -> None:
+        with lock, open(path, "a") as fh:
+            fh.write(json.dumps(payload, sort_keys=True, default=repr) + "\n")
+
+    return notify
+
+
+class SloEngine:
+    """Evaluates a ruleset on a background sampler thread.
+
+    Args:
+        rules: the :class:`SloRule` set (unique names).
+        sample_every_s: sampler cadence while armed.
+        notifiers: callables invoked once per breach crossing with the
+            violation payload dict; a raising notifier is swallowed (paging
+            plumbing must never take down the evaluator) and counted in
+            :meth:`status`.
+        clock: monotonic-clock override (tests inject a manual clock).
+    """
+
+    def __init__(
+        self,
+        rules: Sequence[SloRule],
+        *,
+        sample_every_s: float = 1.0,
+        notifiers: Sequence[Callable[[Dict[str, Any]], None]] = (),
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        names = [r.name for r in rules]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate SLO rule names: {names}")
+        if sample_every_s <= 0:
+            raise ValueError(f"sample_every_s must be positive, got {sample_every_s}")
+        self.rules: List[SloRule] = list(rules)
+        self.sample_every_s = float(sample_every_s)
+        self._notifiers = list(notifiers)
+        self._clock = clock
+        self._lock = threading.Lock()  # latches + published status
+        self._active: Dict[str, bool] = {r.name: False for r in self.rules}
+        self._violations: Dict[str, int] = {r.name: 0 for r in self.rules}
+        self._last: Dict[str, Dict[str, Any]] = {}
+        self._notify_errors = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # ---------------------------------------------------------------- tick
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """One sampling pass over every rule: read the signal, update the
+        windows, refresh the burn gauge, latch/re-arm breaches.  The armed
+        sampler thread calls this on its cadence; tests call it directly
+        with an explicit ``now``."""
+        with self._lock:
+            if self._closed:
+                # a tick after close() must not re-mint the released {slo}
+                # series (or re-page a still-bad signal): close is final
+                return
+        t = self._clock() if now is None else float(now)
+        for rule in self.rules:
+            try:
+                value = rule.signal()
+            except Exception as err:  # noqa: BLE001 — a broken signal must
+                # not kill the sampler; surface it through status() instead
+                value, err_text = None, f"{type(err).__name__}: {err}"
+            else:
+                err_text = None
+            rule._observe(t, value)
+            fast, slow = rule.burn_rates(t)
+            worst = max(fast / rule.fast_burn, slow / rule.slow_burn)
+            breach = fast >= rule.fast_burn or slow >= rule.slow_burn
+            with self._lock:
+                if not self._closed and _instruments.enabled():
+                    _BURN_GAUGE.set(max(fast, slow), rule.name)
+                entry = {
+                    "value": value,
+                    "objective": rule.objective,
+                    "comparison": rule.comparison,
+                    "burn_fast": fast,
+                    "burn_slow": slow,
+                    "active": self._active[rule.name],
+                    "violations": self._violations[rule.name],
+                    "error": err_text,
+                }
+                if breach and not self._active[rule.name]:
+                    # exactly-once per crossing: the latch flips under the
+                    # lock, so a racing manual tick cannot double-page
+                    self._active[rule.name] = True
+                    self._violations[rule.name] += 1
+                    entry["active"] = True
+                    entry["violations"] = self._violations[rule.name]
+                    payload = self._violation_payload(rule, value, fast, slow)
+                elif self._active[rule.name] and worst < 1.0 - rule.hysteresis:
+                    self._active[rule.name] = False
+                    entry["active"] = False
+                    payload = None
+                else:
+                    payload = None
+                self._last[rule.name] = entry
+            if payload is not None:
+                self._page(rule, payload)
+
+    def _violation_payload(
+        self, rule: SloRule, value: Optional[float], fast: float, slow: float
+    ) -> Dict[str, Any]:
+        return {
+            "type": "slo_violation",
+            "slo": rule.name,
+            "description": rule.description,
+            "value": value,
+            "objective": rule.objective,
+            "comparison": rule.comparison,
+            "burn_fast": round(fast, 4),
+            "burn_slow": round(slow, 4),
+            "fast_burn_threshold": rule.fast_burn,
+            "slow_burn_threshold": rule.slow_burn,
+            "budget": rule.budget,
+        }
+
+    def _page(self, rule: SloRule, payload: Dict[str, Any]) -> None:
+        if _instruments.enabled():
+            _VIOLATIONS.inc(1, rule.name)
+        _ledger.record_event(
+            None, "slo_violation",
+            **{k: v for k, v in payload.items() if k != "type"},
+        )
+        for notify in self._notifiers:
+            try:
+                notify(dict(payload))
+            except Exception:  # noqa: BLE001 — paging plumbing never fatal
+                with self._lock:
+                    self._notify_errors += 1
+
+    # -------------------------------------------------------------- status
+
+    def status(self) -> Dict[str, Any]:
+        """The engine's live view (the ``/statusz`` ``"slo"`` section):
+        per-rule value/burn/latch state plus breach totals."""
+        with self._lock:
+            return {
+                "armed": self._thread is not None and self._thread.is_alive(),
+                "sample_every_s": self.sample_every_s,
+                "breached": sorted(n for n, a in self._active.items() if a),
+                "violations_total": sum(self._violations.values()),
+                "notify_errors": self._notify_errors,
+                "rules": {name: dict(entry) for name, entry in self._last.items()},
+            }
+
+    def breached(self) -> List[str]:
+        """Names of the rules whose breach latch is currently active —
+        what flips ``/healthz`` to 503."""
+        with self._lock:
+            return sorted(n for n, a in self._active.items() if a)
+
+    def violations(self, name: Optional[str] = None) -> int:
+        with self._lock:
+            if name is not None:
+                return self._violations.get(name, 0)
+            return sum(self._violations.values())
+
+    # ----------------------------------------------------------- lifecycle
+
+    def arm(self) -> "SloEngine":
+        """Start the background sampler (idempotent); returns self."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("SloEngine is closed")
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="tpumetrics-slo-sampler", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.sample_every_s):
+            self.tick()
+
+    def close(self) -> None:
+        """Stop the sampler, release the engine's minted ``{slo}`` series,
+        and clear the latches.  Idempotent."""
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=max(5.0, 2 * self.sample_every_s))
+        with self._lock:
+            self._closed = True
+            self._thread = None
+            for rule in self.rules:
+                _BURN_GAUGE.remove(rule.name)
+                _VIOLATIONS.remove(rule.name)
+                self._active[rule.name] = False
+
+    def __enter__(self) -> "SloEngine":
+        return self.arm()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+# ------------------------------------------------------------ rule builders
+
+
+def latency_rule(
+    name: str,
+    histogram_name: str,
+    objective_ms: float,
+    *,
+    labels: Sequence[str] = (),
+    q: float = 0.99,
+    **kwargs: Any,
+) -> SloRule:
+    """Objective: the named latency histogram's q-quantile stays at or
+    under ``objective_ms`` (labels empty = cross-label aggregate).  With
+    the runtime's sketch-backed histograms the quantile carries the
+    sketch's relative-error bound, so the objective compares against a
+    number, not a bucket-grid artifact."""
+    label_values = tuple(str(v) for v in labels)
+
+    def signal() -> Optional[float]:
+        inst = _instruments.get_instrument(histogram_name)
+        if not isinstance(inst, _instruments.Histogram):
+            return None
+        return inst.quantile(q, *label_values)
+
+    return SloRule(
+        name, signal, objective_ms,
+        description=f"{histogram_name} p{int(q * 100)} <= {objective_ms}ms",
+        **kwargs,
+    )
+
+
+def gauge_ceiling_rule(
+    name: str,
+    gauge_name: str,
+    objective: float,
+    *,
+    labels: Sequence[str] = (),
+    **kwargs: Any,
+) -> SloRule:
+    """Objective: the named gauge stays at or under ``objective`` (queue
+    depth saturation, live-state HBM, …)."""
+    label_values = tuple(str(v) for v in labels)
+
+    def signal() -> Optional[float]:
+        inst = _instruments.get_instrument(gauge_name)
+        if not isinstance(inst, _instruments.Gauge):
+            return None
+        return inst.value(*label_values)
+
+    return SloRule(
+        name, signal, objective,
+        description=f"{gauge_name} <= {objective}", **kwargs,
+    )
+
+
+def event_rule(name: str, kind: str, **kwargs: Any) -> SloRule:
+    """Objective: ZERO new ledger events of ``kind`` (``state_health``,
+    ``drift_alert``, ``tenant_quarantined``, …) per sampling interval.  The
+    signal is the per-tick DELTA of the ledger's cumulative per-kind
+    counter — a one-off burst recovers once the window drains, which is
+    what lets the latch re-arm."""
+    last: List[Optional[int]] = [None]
+
+    def signal() -> Optional[float]:
+        count = int(_ledger.summary()["counts_by_kind"].get(kind, 0))
+        prev, last[0] = last[0], count
+        if prev is None:
+            return 0.0  # the pre-existing history is not this window's fault
+        return float(count - prev)
+
+    kwargs.setdefault("budget", 1e-3)
+    return SloRule(
+        name, signal, 0.0,
+        description=f"zero {kind} ledger events", **kwargs,
+    )
+
+
+def callable_rule(
+    name: str,
+    signal: Callable[[], Optional[float]],
+    objective: float,
+    **kwargs: Any,
+) -> SloRule:
+    """Objective over any zero-arg callable (a ``stats()`` field, a custom
+    probe) — the escape hatch the declarative builders sit on."""
+    return SloRule(name, signal, objective, **kwargs)
+
+
+def standard_rules(
+    target: Any,
+    *,
+    submit_p99_ms: Optional[float] = None,
+    restore_p99_ms: Optional[float] = None,
+    queue_depth_max: Optional[float] = None,
+    quarantined_max: float = 0.0,
+    page_on_state_health: bool = True,
+    page_on_drift: bool = True,
+    **kwargs: Any,
+) -> List[SloRule]:
+    """The standing ruleset for one evaluator/service ``target``: latency
+    ceilings over the shared sketch histograms, queue-depth saturation and
+    quarantine count over ``target.stats()``, and zero
+    ``state_health``/``drift_alert`` events.  Pass the ceilings you want;
+    ``None`` skips that rule."""
+    rules: List[SloRule] = []
+    if submit_p99_ms is not None:
+        rules.append(latency_rule(
+            "submit_p99", _instruments.SUBMIT_LATENCY_MS, submit_p99_ms, **kwargs
+        ))
+    if restore_p99_ms is not None:
+        rules.append(latency_rule(
+            "restore_p99", _instruments.RESTORE_LATENCY_MS, restore_p99_ms, **kwargs
+        ))
+    if queue_depth_max is not None:
+        rules.append(callable_rule(
+            "queue_depth", lambda: float(target.stats().get("depth", 0)),
+            queue_depth_max,
+            description=f"dispatch queue depth <= {queue_depth_max}", **kwargs,
+        ))
+    rules.append(callable_rule(
+        "quarantined_tenants",
+        lambda: float(target.stats().get("quarantined_tenants", 0)),
+        quarantined_max,
+        description=f"quarantined tenants <= {quarantined_max}", **kwargs,
+    ))
+    if page_on_state_health:
+        rules.append(event_rule("state_health", "state_health", **kwargs))
+    if page_on_drift:
+        rules.append(event_rule("drift_alert", "drift_alert", **kwargs))
+    return rules
