@@ -850,6 +850,101 @@ def _bench_lpips():
     return ours, ref, {"flops_per_step": flops}
 
 
+# ----------------------------------------------------------- backbone runtime
+
+
+def _bench_backbone_runtime():
+    """N fresh LPIPS-alex tenants spinning up against the SHARED backbone
+    runtime vs the same N tenants on private per-instance weight plumbing
+    (the pre-registry behavior: each instance placed its own copy of the
+    weight tree and jit-compiled its own identical forward).
+
+    One measured round = spin up ``tenants`` instances + run ``steps`` eval
+    batches each + release.  The shared side digest-dedupes every
+    acquisition to ONE resident handle whose engine holds the only compiled
+    program (per-tenant cost: a content hash + a dict hit); the private side
+    pays a fresh weight placement AND a fresh XLA compile per tenant per
+    round — exactly what a service sees when same-backbone tenants churn.
+    The per-batch unit is (tenants * steps) forwards either way.
+
+    In-scenario gates: the shared engine compiled exactly ONCE across every
+    tenant (trace universe = one bucket signature) and the shared forward is
+    BIT-identical to the private one (meshless placement is fp32-exact).
+    MFU/flops come from the shared forward's ``backbones/<key>`` program
+    profile (XLA cost_analysis), like the detection matcher's."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpumetrics.backbones.registry import get_backbone, registry_stats
+    from tpumetrics.image._backbones import alexnet_features
+    from tpumetrics.telemetry import device as tele_device
+
+    rng = np.random.default_rng(0)
+    shapes = [(64, 3, 11, 11), (192, 64, 5, 5), (384, 192, 3, 3), (256, 384, 3, 3), (256, 256, 3, 3)]
+    params_np = [
+        ((rng.standard_normal(s) * 0.05).astype(np.float32), np.zeros(s[0], np.float32))
+        for s in shapes
+    ]
+    tenants, steps, batch = 3, 4, 8
+    img_np = rng.uniform(-1, 1, (batch, 3, 64, 64)).astype(np.float32)
+    img = jnp.asarray(img_np)
+
+    # the long-lived service case: the resident handle outlives tenant churn
+    # (it registers its program profile on the first eager dispatch)
+    seed = get_backbone("lpips:alex", params_np)
+    shared_out = seed(img)
+    jax.block_until_ready(shared_out[-1])
+
+    def ours_once():
+        t0 = time.perf_counter()
+        handles = [get_backbone("lpips:alex", params_np) for _ in range(tenants)]
+        out = None
+        for h in handles:
+            for _ in range(steps):
+                out = h(img)
+        jax.block_until_ready(out[-1])
+        for h in handles:
+            h.close()
+        return (time.perf_counter() - t0) / (tenants * steps) * 1e6
+
+    def ref_once():
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(tenants):
+            own = [(jnp.asarray(w), jnp.asarray(b)) for w, b in params_np]
+            fwd = jax.jit(lambda p, x: alexnet_features(p)(x))  # noqa: B023
+            for _ in range(steps):
+                out = fwd(own, img)
+        jax.block_until_ready(out[-1])
+        return (time.perf_counter() - t0) / (tenants * steps) * 1e6
+
+    ours, ref = _interleaved(ours_once, ref_once, rounds=3)
+
+    # gates: one compile total across every tenant of every round, refcount
+    # back to the resident seed only, and fp32 bit-parity with the private path
+    stats = registry_stats()[seed.key]
+    assert stats["compiles"] == 1, f"shared engine compiled {stats['compiles']}x, expected 1"
+    assert stats["refs"] == 1, f"tenant churn leaked refs: {stats['refs']}"
+    private_out = alexnet_features([(jnp.asarray(w), jnp.asarray(b)) for w, b in params_np])(img)
+    for a, b in zip(shared_out, private_out):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), "shared forward != private forward"
+
+    prof = tele_device.registry().newest(seed.label)
+    cost = prof.resolve() if prof is not None else None
+    extras = {
+        "shared_compiles": stats["compiles"],
+        "resident_bytes": stats["bytes"],
+    }
+    seed.close()
+    if cost and cost.get("flops", 0) > 0:
+        return ours, ref, {
+            "flops_per_step": float(cost["flops"]),
+            "flops_source": "cost_analysis",
+            "extras": extras,
+        }
+    return ours, ref, {"extras": extras}
+
+
 # ------------------------------------------------------------------ BERTScore
 
 
@@ -2492,6 +2587,7 @@ def main() -> None:
         ("map_ragged_update_compute", _bench_map),
         ("fid_stream_update", _bench_fid),
         ("lpips_stream_update", _bench_lpips),
+        ("backbone_runtime", _bench_backbone_runtime),
         ("bertscore_ddp_eval", _bench_bertscore_ddp),
         ("fused_collection_update", _bench_fused_collection_update),
         ("compile_cache_cold_warm", _bench_compile_cache_cold_warm),

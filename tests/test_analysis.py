@@ -303,6 +303,89 @@ def test_serving_layer_near_miss_negative():
     assert "TPL106" not in _codes(found)
 
 
+# ------------------------------------------------------------------- TPL107
+BACKBONE_TP = _src(
+    """
+    import jax
+    import jax.numpy as jnp
+    from tpumetrics.metric import Metric
+    from tpumetrics.backbones.registry import get_backbone
+
+    class M(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, preds, target):
+            # a full digest walk + placement of the weight tree per step
+            net = get_backbone("lpips:alex", self.params)
+            self._place(self.params)
+            self.total = self.total + jnp.sum(net(preds))
+
+        def _place(self, weights):
+            # update-reachable helper re-placing resident weights
+            return jax.device_put(weights)
+
+        def compute(self):
+            return self.total
+    """
+)
+
+BACKBONE_NEAR_MISS = _src(
+    """
+    import jax
+    import jax.numpy as jnp
+    from tpumetrics.metric import Metric
+    from tpumetrics.backbones.registry import get_backbone
+
+    class M(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+            # construction seam: exactly where acquisition belongs
+            self.net = get_backbone("lpips:alex", kw.get("params"))
+
+        def update(self, preds, target):
+            # device_put of BATCH data is placement of inputs, not weights
+            preds = jax.device_put(preds)
+            self.total = self.total + jnp.sum(self.net(preds))
+
+        def compute(self):
+            return self.total
+
+    def offline_loader(params):
+        # not update()-reachable: resolve seams may construct freely
+        return get_backbone("inception:2048", params)
+    """
+)
+
+
+def test_backbone_lifecycle_true_positives():
+    found = analyze_source(BACKBONE_TP)
+    codes = _codes(found)
+    # the update()-time registry construction AND the weight device_put in
+    # the update-reachable helper are both findings
+    assert codes.count("TPL107") == 2
+
+
+def test_backbone_lifecycle_near_miss_negative():
+    # constructor-seam acquisition, batch-data device_put, and construction
+    # outside update paths must not trigger
+    found = analyze_source(BACKBONE_NEAR_MISS)
+    assert "TPL107" not in _codes(found)
+
+
+def test_backbone_lifecycle_registry_modules_exempt(tmp_path):
+    # the registry's own modules ARE the lifecycle seam — calls inside
+    # tpumetrics/backbones/ are never findings (path-based exemption, so the
+    # fixture must live at a real backbones/ path)
+    pkg = tmp_path / "tpumetrics" / "backbones"
+    pkg.mkdir(parents=True)
+    (pkg / "registry.py").write_text(BACKBONE_TP)
+    found = analyze_paths([str(pkg)])
+    assert "TPL107" not in _codes(found)
+
+
 def test_host_telemetry_reachable_helper_is_flagged():
     src = _src(
         """

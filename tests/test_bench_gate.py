@@ -17,6 +17,7 @@ def test_floor_file_shape():
         "map_ragged_update_compute",
         "fid_stream_update",
         "lpips_stream_update",
+        "backbone_runtime",
         "bertscore_ddp_eval",
         "fused_collection_update",
         "compile_cache_cold_warm",
@@ -84,6 +85,13 @@ def test_floor_file_shape():
     # scatter-add-cheap per row
     assert data["floors"]["monitoring_window"] >= 4.0
     assert data["monitoring_ceilings"]["sketch_update_ns_per_row"] > 0
+    # the shared backbone runtime must clearly beat private per-tenant
+    # weight plumbing on tenant churn (ISSUE 16 acceptance), and the model-
+    # bound streams it de-duplicated keep their RAISED floors (never lower
+    # one back to excuse a regression)
+    assert data["floors"]["backbone_runtime"] >= 1.5
+    assert data["floors"]["fid_stream_update"] >= 29.0
+    assert data["floors"]["bertscore_ddp_eval"] >= 5.2
     # the chaos-soak standing gates (ISSUE 12 acceptance): a per-cycle
     # restore-latency ceiling, a structural-stall throughput floor, and
     # ZERO unrecovered incidents — never raise that last one
@@ -341,6 +349,17 @@ def test_check_floors_flags_chaos_soak_regressions():
     details["chaos_soak"] = "error: ChaosSoakError: compute() diverged"
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and "scenario failed" in violations[0]
+
+
+def test_check_floors_flags_backbone_runtime_regressions():
+    """A shared-backbone round that lost its edge over private per-tenant
+    plumbing (a digest miss re-placing weights per tenant, or a per-tenant
+    recompile) must trip the floor; a healthy ratio passes."""
+    details = {"backbone_runtime": {"vs_baseline": 1.0}}  # below the 1.5 floor
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("backbone_runtime" in v for v in violations)
+    details["backbone_runtime"]["vs_baseline"] = 3.0
+    assert bench._check_floors(headline_vs=1000.0, details=details) == []
 
 
 def test_check_floors_flags_regressions():

@@ -493,8 +493,13 @@ class TestEvaluatorObservability:
             "registered", "resolved", "flops_per_step", "program_hbm_bytes",
             "errors",
         }
-        assert set(st["device"]["hbm"]) == {"state_bytes", "watermark_bytes"}
+        # backbone_bytes joined the contract with the shared backbone
+        # runtime: process-wide resident weights, 0 when nothing is resident
+        assert set(st["device"]["hbm"]) == {
+            "state_bytes", "watermark_bytes", "backbone_bytes",
+        }
         assert st["device"]["hbm"]["state_bytes"] > 0
+        assert st["device"]["hbm"]["backbone_bytes"] >= 0
         assert st["device"]["health"] is None  # probe not armed here
 
     def test_disabled_tracing_records_nothing_during_streaming(self):

@@ -31,6 +31,14 @@ TPL106 serving-layer           (a) a ``telemetry.serve``/``telemetry.slo`` entry
                                handler (``do_GET``-family methods of a
                                ``BaseHTTPRequestHandler``) or an SLO sampler loop — a
                                scrape must never synchronize with an in-flight dispatch
+TPL107 backbone-in-update      backbone construction or weight placement (``lpips_backbone``/
+                               ``load_inception_params``/``inception_feature_extractor``/
+                               ``backbones.get_backbone``, or a ``jax.device_put`` of a
+                               param/weight tree) in ``update()``-reachable code — resident
+                               weights are acquired ONCE per process through the backbone
+                               registry at metric construction; in a step they re-place
+                               per call (or per retrace under jit).  Acquire in
+                               ``__init__``, dispatch the handle in ``update()``
 TPL201 divergent-collective    a collective (``sync``/``all_reduce``/``all_gather``/
                                ``flush``/…) reachable on only one branch of a rank- or
                                data-dependent conditional — the static complement of the
@@ -94,6 +102,10 @@ CATALOG: Dict[str, Tuple[str, str]] = {
         "serving-layer",
         "admin/SLO entry point in update()-reachable code, or a blocking device "
         "read in an admin-handler/SLO-sampler path",
+    ),
+    "TPL107": (
+        "backbone-in-update",
+        "backbone construction or pretrained-weight placement in update()-reachable code",
     ),
     "TPL201": (
         "divergent-collective",
@@ -1250,6 +1262,94 @@ class HostHealthReadRule:
         return False
 
 
+#: backbone constructors / weight-placement entry points: each loads or
+#: places a pretrained weight tree.  The registry dedupes by weights digest,
+#: but the digest itself hashes every leaf's bytes — calling any of these
+#: per step pays a full host walk of the tree (and `device_put` re-places it
+#: outright, or burns a retrace under jit).
+_TPL107_CONSTRUCTORS = {
+    "tpumetrics.backbones.get_backbone",
+    "tpumetrics.backbones.registry.get_backbone",
+    "tpumetrics.image._backbones.lpips_backbone",
+    "tpumetrics.image._inception.load_inception_params",
+    "tpumetrics.image._inception.inception_feature_extractor",
+}
+#: identifier fragments marking a `jax.device_put` operand as a weight tree
+_TPL107_WEIGHT_HINTS = ("param", "weight")
+#: the same constructors by bare name — function-local ``from`` imports are
+#: invisible to the module import table, so a deferred-import call site
+#: resolves to the bare callable name; these are distinctive enough to match
+_TPL107_BARE = {d.rpartition(".")[2] for d in _TPL107_CONSTRUCTORS}
+
+
+class BackboneLifecycleRule:
+    """TPL107: backbone construction / weight placement in ``update()``-reachable code.
+
+    Pretrained forwards live in the process-global backbone registry
+    (:mod:`tpumetrics.backbones`): weights are digested, placed once, and
+    shared by every metric instance and service tenant.  Constructing a
+    backbone — or ``jax.device_put``-ing a param/weight tree — inside an
+    update path defeats exactly that: eagerly it re-digests (a full host
+    walk of the tree) or re-places the weights every step; under jit the
+    call runs at trace time only and silently re-runs per retrace.  Acquire
+    the handle in ``__init__`` (or a resolve seam) and dispatch it in
+    ``update()``.  The registry's own modules are exempt — they ARE the
+    lifecycle seam."""
+
+    codes = ("TPL107",)
+
+    def check(self, mod: ModuleInfo, index: PackageIndex) -> Iterator[Finding]:
+        path = str(mod.path).replace("\\", "/")
+        if "tpumetrics/backbones/" in path:
+            return
+        funcs: List[FuncInfo] = list(mod.functions.values())
+        for ci in mod.classes.values():
+            funcs.extend(ci.methods.values())
+        for fi in funcs:
+            if not index.is_update_reachable(fi.node):
+                continue
+            for n in ast.walk(fi.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                dotted = _import_resolved_dotted(n.func, mod)
+                if dotted is None:
+                    continue
+                if dotted in _TPL107_CONSTRUCTORS or ("." not in dotted and dotted in _TPL107_BARE):
+                    yield Finding(
+                        "TPL107",
+                        f"backbone construction `{_truncate(n)}` in update()-"
+                        "reachable code: pretrained weights are digested and "
+                        "placed ONCE through the backbone registry — per step "
+                        "this re-walks the weight tree on host (or re-runs "
+                        "only at retrace under jit). Acquire the handle in "
+                        "__init__ and dispatch it in update().",
+                        mod.path, n.lineno, n.col_offset, symbol=fi.qualname,
+                    )
+                elif dotted == "jax.device_put" and self._places_weights(n):
+                    yield Finding(
+                        "TPL107",
+                        f"weight placement `{_truncate(n)}` in update()-"
+                        "reachable code: device_put of a param/weight tree "
+                        "re-places resident backbone weights every step. "
+                        "Placement belongs to the backbone registry "
+                        "(tpumetrics.backbones.get_backbone) at construction "
+                        "time.",
+                        mod.path, n.lineno, n.col_offset, symbol=fi.qualname,
+                    )
+
+    @staticmethod
+    def _places_weights(call: ast.Call) -> bool:
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            name = None
+            if isinstance(arg, ast.Name):
+                name = arg.id
+            elif isinstance(arg, ast.Attribute):
+                name = arg.attr
+            if name is not None and any(h in name.lower() for h in _TPL107_WEIGHT_HINTS):
+                return True
+        return False
+
+
 #: the serving-layer modules whose entry points TPL106 rejects in update paths
 _TPL106_MODULES = (
     "tpumetrics.telemetry.serve",
@@ -1604,6 +1704,7 @@ RULES = [
     TraceSafetyRule(),
     HostTelemetryRule(),
     HostHealthReadRule(),
+    BackboneLifecycleRule(),
     ServingLayerRule(),
     StateDeclRule(),
     ShadowStateRule(),

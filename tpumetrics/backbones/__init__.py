@@ -1,0 +1,44 @@
+"""Shared sharded backbone runtime for the model-bound metric families.
+
+The model-bound metrics (BERTScore/InfoLM, FID/KID/MiFID/IS, LPIPS/PPL) are
+small inference services wearing a metric API; this package gives them ONE
+process-global runtime instead of a private backbone per instance:
+
+- :mod:`~tpumetrics.backbones.registry` — :func:`get_backbone` returns one
+  refcounted resident :class:`BackboneHandle` per (architecture,
+  weights-digest, mesh, dtype policy).
+- :mod:`~tpumetrics.backbones.placement` — regex→``PartitionSpec`` weight
+  rules per architecture over the ``parallel/sharding.py`` plumbing, with a
+  bit-identical meshless fallback and the one-time dtype-policy cast.
+- :mod:`~tpumetrics.backbones.engine` — the jitted, bucketed, donated
+  forward every sharing instance and tenant dispatches through.
+
+See ``docs/backbones.md`` for lifecycle, rule syntax, the bf16 gate, and
+tenancy sharing semantics.
+"""
+
+from tpumetrics.backbones.engine import BackboneEngine
+from tpumetrics.backbones.placement import (
+    DTYPE_POLICIES,
+    backbone_partition_rules,
+    cast_params,
+    place_backbone,
+)
+from tpumetrics.backbones.registry import (
+    BackboneHandle,
+    get_backbone,
+    registry_stats,
+    resident_bytes,
+)
+
+__all__ = [
+    "BackboneEngine",
+    "BackboneHandle",
+    "DTYPE_POLICIES",
+    "backbone_partition_rules",
+    "cast_params",
+    "get_backbone",
+    "place_backbone",
+    "registry_stats",
+    "resident_bytes",
+]

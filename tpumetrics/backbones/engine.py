@@ -1,0 +1,201 @@
+"""The shared backbone forward engine: jitted, bucketed, donated, policied.
+
+One :class:`BackboneEngine` per resident :class:`~tpumetrics.backbones.
+registry.BackboneHandle` owns the compiled forward every metric instance and
+service tenant sharing that backbone dispatches through:
+
+- **bucketed**: eager inputs are padded to the next power of two along the
+  batch (and optionally sequence) axes via ``runtime/bucketing.py``'s
+  ``pow2_at_least``, so the trace universe is bounded — log2(max batch)
+  compiles, not one per shape.  Padded rows are zeros; the forward must be
+  row-independent (every built-in backbone is), and the engine slices the
+  pad rows back off, which is the ``valid``-mask contract in output form.
+- **donated**: the engine always materializes its own padded staging buffer
+  (a fresh XLA-owned copy even when no padding is needed), so the activation
+  arguments are donated to the forward — XLA reuses them for intermediates
+  instead of holding input + activations live together.
+- **dtype policy**: params arrive already cast by
+  :func:`~tpumetrics.backbones.placement.place_backbone`; the engine casts
+  floating inputs to the policy dtype in-trace and casts floating outputs
+  back to fp32, so downstream accumulators (Fréchet moments, cosine scores)
+  keep fp32 state regardless of the forward precision.  fp32 is the default
+  and the oracle; bf16 is opt-in behind the error-bound gate
+  (``tests/test_backbones.py``).
+- **trace-transparent**: called under an outer trace (a metric's fused update
+  step, the service megabatch vmap), the engine inlines the forward into the
+  caller's program instead of nesting a ``jit`` — the outer program compiles
+  once and the engine's own compile counter stays untouched, which is what
+  lets the 3-tenant sharing test assert "the embed compiled ONCE".
+
+Every compiled (bucket, signature) registers a ``backbones/<key>`` program
+profile (``telemetry/device.py``), so MFU and HBM for the shared forward are
+readable from XLA's ``cost_analysis`` exactly like the detection matcher's.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpumetrics.runtime.bucketing import pow2_at_least
+from tpumetrics.telemetry import device as _device
+from tpumetrics.utils.data import _is_tracer
+
+Array = jax.Array
+
+__all__ = ["BackboneEngine"]
+
+
+def _floating(arr: Any) -> bool:
+    return jnp.issubdtype(jnp.asarray(arr).dtype, jnp.floating)
+
+
+class BackboneEngine:
+    """Compiled forward dispatch for one resident backbone.
+
+    Args:
+        forward: pure function ``(params, *arrays) -> pytree`` whose array
+            leaves carry the batch on dim 0.
+        label: the program-profile label (``backbones/<key>``).
+        dtype_policy: ``"float32"`` (default, the oracle) or ``"bfloat16"``.
+        mesh / data_axis: when set, activations are pinned batch-sharded
+            along ``data_axis`` inside the trace (a sharding constraint), so
+            the forward runs as one GSPMD program over the mesh.
+        pad_axes: input axes padded to the next power of two (dim 0 = batch;
+            add dim 1 for token-id/mask sequence axes).
+    """
+
+    def __init__(
+        self,
+        forward: Callable[..., Any],
+        *,
+        label: str,
+        dtype_policy: str = "float32",
+        mesh: Optional[Any] = None,
+        data_axis: str = "dp",
+        pad_axes: Sequence[int] = (0,),
+    ) -> None:
+        self.forward = forward
+        self.label = label
+        self.dtype_policy = dtype_policy
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.pad_axes = tuple(sorted(set(int(a) for a in pad_axes)))
+        self.compile_count = 0  # incremented at trace time, once per compile
+        self.dispatch_count = 0
+        self._lock = threading.Lock()
+        self._jits: Dict[int, Any] = {}  # arg count -> jitted wrapper
+        self._signatures: set = set()
+
+    # ----------------------------------------------------------- trace body
+
+    def _cast_in(self, arr: Array) -> Array:
+        if self.dtype_policy != "float32" and _floating(arr):
+            return arr.astype(jnp.dtype(self.dtype_policy))
+        return arr
+
+    def _cast_out(self, arr: Any) -> Any:
+        if _floating(arr) and jnp.asarray(arr).dtype != jnp.float32:
+            return jnp.asarray(arr, jnp.float32)
+        return arr
+
+    def _constrain_batch(self, arr: Array) -> Array:
+        if self.mesh is None:
+            return arr
+        shape = getattr(arr, "shape", ())
+        world = int(self.mesh.shape[self.data_axis])
+        if not shape or shape[0] % world != 0:
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        spec = PartitionSpec(self.data_axis)
+        return jax.lax.with_sharding_constraint(arr, NamedSharding(self.mesh, spec))
+
+    def _apply(self, params: Any, *args: Array) -> Any:
+        args = tuple(self._constrain_batch(self._cast_in(a)) for a in args)
+        out = self.forward(params, *args)
+        return jax.tree_util.tree_map(self._cast_out, out)
+
+    def _traced(self, params: Any, *args: Array) -> Any:
+        self.compile_count += 1  # python side effect: runs once per trace
+        return self._apply(params, *args)
+
+    # ------------------------------------------------------------- dispatch
+
+    def _pad(self, arr: Array) -> Array:
+        """Pad every bucketed axis up to the next power of two with zeros and
+        materialize a fresh XLA-owned buffer either way — the staging copy
+        that makes donating this argument safe."""
+        arr = jnp.asarray(arr)
+        pads = [(0, 0)] * arr.ndim
+        padded = False
+        for axis in self.pad_axes:
+            if axis >= arr.ndim:
+                continue
+            n = arr.shape[axis]
+            bucket = pow2_at_least(max(1, n))
+            if bucket != n:
+                pads[axis] = (0, bucket - n)
+                padded = True
+        if padded:
+            return jnp.pad(arr, pads)
+        return arr.copy()
+
+    def _jit_for(self, n_args: int) -> Any:
+        jitted = self._jits.get(n_args)
+        if jitted is None:
+            with self._lock:
+                jitted = self._jits.get(n_args)
+                if jitted is None:
+                    jitted = jax.jit(
+                        self._traced, donate_argnums=tuple(range(1, 1 + n_args))
+                    )
+                    self._jits[n_args] = jitted
+        return jitted
+
+    def __call__(self, params: Any, *args: Any) -> Any:
+        """Run the forward.  Under an outer trace: inline (the caller's
+        program owns bucketing and compile accounting).  Eagerly: pad to the
+        bucket, dispatch the donated jitted program, slice the pad rows off.
+        """
+        if any(_is_tracer(a) for a in args) or _is_tracer(
+            next(iter(jax.tree_util.tree_leaves(params)), None)
+        ):
+            return self._apply(params, *args)
+
+        n = int(jnp.asarray(args[0]).shape[0]) if args else 0
+        padded = tuple(self._pad(a) for a in args)
+        jitted = self._jit_for(len(padded))
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in padded)
+        if sig not in self._signatures:
+            with self._lock:
+                if sig not in self._signatures:
+                    self._signatures.add(sig)
+                    # profile registration wants live args; donation consumes
+                    # them on dispatch, so register against the abstract
+                    # signature BEFORE the call
+                    _device.register_program(
+                        self.label, jitted, (params,) + padded, tenant=self.label
+                    )
+        import warnings
+
+        with warnings.catch_warnings():
+            # XLA reuses whichever donated staging buffers it can; the ones it
+            # can't (shape-mismatched on this backend) are simply not reused —
+            # not actionable for the metric user
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            out = jitted(params, *padded)
+        self.dispatch_count += 1
+
+        def trim(leaf: Any) -> Any:
+            shape = getattr(leaf, "shape", ())
+            if shape and args and shape[0] != n and shape[0] == padded[0].shape[0]:
+                return leaf[:n]
+            return leaf
+
+        return jax.tree_util.tree_map(trim, out)

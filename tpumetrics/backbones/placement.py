@@ -1,0 +1,138 @@
+"""Placement layer: pretrained backbone weights as long-lived sharded arrays.
+
+Backbone parameter pytrees get the same treatment metric STATE pytrees got in
+``parallel/sharding.py``: an ordered regex→``PartitionSpec`` rule list over
+slash-joined paths (the ``match_partition_rules`` idiom), resolved against the
+metric's mesh, with uneven shards demoted to replicated and a meshless
+single-device fallback that is bit-identical to the private per-metric
+placement it replaces.
+
+Two things are deliberately different from state placement:
+
+- **dtype policy is applied here, once.**  The forwards in
+  ``image/_backbones.py`` / ``image/_inception.py`` used to re-cast every
+  weight *inside the trace* (``jnp.asarray(w, x.dtype)`` per conv), so a bf16
+  run still carried the fp32 constants in the program.  Placement casts every
+  floating leaf to the policy dtype before the ``device_put``, and the
+  forwards consume parameters as-is.
+- **weights shard along non-contraction dims only.**  The built-in rules
+  shard conv kernels along their output-channel dim and matmul kernels along
+  their output-feature dim, so GSPMD never splits a reduction — no
+  partial-sum collectives enter the math (pinned bit-identical by the mesh8
+  test in ``tests/test_backbones.py``; per-shard re-vectorization can still
+  reorder same-value FMA chains at large channel counts, ≈1e-6 relative).
+
+See ``docs/backbones.md`` for the rule syntax and the worked example.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+from tpumetrics.parallel.sharding import StatePartitionRules, _map_state
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+Array = jax.Array
+P = PartitionSpec
+
+__all__ = [
+    "DTYPE_POLICIES",
+    "backbone_partition_rules",
+    "cast_params",
+    "place_backbone",
+]
+
+# the two supported forward precisions: fp32 is the default AND the oracle;
+# bf16 is opt-in behind the per-metric error-bound gate (docs/backbones.md)
+DTYPE_POLICIES = ("float32", "bfloat16")
+
+
+def _check_policy(dtype_policy: str) -> jnp.dtype:
+    if dtype_policy not in DTYPE_POLICIES:
+        raise TPUMetricsUserError(
+            f"Backbone dtype policy must be one of {DTYPE_POLICIES}, got {dtype_policy!r}."
+        )
+    return jnp.dtype(dtype_policy)
+
+
+# per-architecture-family weight rules; "O" shards dim 0 (conv output
+# channels, OIHW layout), "LAST" shards dim 1 (matmul output features).
+# Everything unmatched — biases, BN leaves, embeddings — replicates.
+_FAMILY_RULES = {
+    # LPIPS params are a flat list of (weight, bias) pairs: paths "i/0", "i/1"
+    "lpips": [(r"(^|/)\d+/0$", "O")],
+    # InceptionV3 params are a flat torch-state-dict mapping (dotted keys)
+    "inception": [(r"conv\.weight$", "O"), (r"^fc\.weight$", "O")],
+    # BERT-style encoders: dense kernels are (in, out) — shard the out dim;
+    # 1-D / uneven leaves demote to replicated automatically
+    "encoder": [(r"(kernel|weight)$", "LAST")],
+}
+
+
+def backbone_partition_rules(
+    arch: str,
+    *,
+    data_axis: str = "dp",
+    model_axis: Optional[str] = None,
+    extra_rules: Sequence[Tuple[str, PartitionSpec]] = (),
+) -> StatePartitionRules:
+    """The regex→spec rules for one backbone architecture.
+
+    ``arch`` is a registry key like ``"lpips:alex"`` or ``"inception:2048"``;
+    its family (the part before ``":"``) selects the built-in rule set.
+    Unknown families replicate everything (always safe).  ``model_axis``
+    names the mesh axis big weight leaves shard along — the 1-D metric
+    meshes from :func:`~tpumetrics.parallel.sharding.make_mesh` have only
+    ``data_axis``, so it defaults to that; uneven leaves demote to
+    replicated per :class:`StatePartitionRules` semantics.  ``extra_rules``
+    prepend caller rules (first match wins), which is how a custom
+    architecture plugs its own specs into the same plumbing
+    :meth:`StatePartitionRules.for_metric` uses for state.
+    """
+    axis = model_axis if model_axis is not None else data_axis
+    family = arch.split(":", 1)[0]
+    rules: List[Tuple[str, PartitionSpec]] = list(extra_rules)
+    for pattern, kind in _FAMILY_RULES.get(family, ()):
+        rules.append((pattern, P(axis) if kind == "O" else P(None, axis)))
+    return StatePartitionRules(rules, data_axis=data_axis)
+
+
+def cast_params(params: Any, dtype_policy: str = "float32") -> Any:
+    """Cast every floating leaf of a parameter pytree to the policy dtype —
+    ONCE, at placement, so no forward re-materializes fp32 constants inside
+    its trace.  Integer/bool leaves pass through untouched."""
+    dtype = _check_policy(dtype_policy)
+
+    def one(_path: str, leaf: Any) -> Any:
+        arr = jnp.asarray(leaf)
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            return arr.astype(dtype)
+        return arr
+
+    return _map_state(one, params)
+
+
+def place_backbone(
+    arch: str,
+    params: Any,
+    *,
+    mesh: Optional[Mesh] = None,
+    rules: Optional[StatePartitionRules] = None,
+    data_axis: str = "dp",
+    model_axis: Optional[str] = None,
+    dtype_policy: str = "float32",
+) -> Any:
+    """Cast + place a backbone parameter pytree.
+
+    With a mesh, every leaf is ``device_put`` under its resolved
+    ``NamedSharding`` (one resident sharded copy, the registry's contract);
+    with ``mesh=None`` it degrades to the donation-safe on-device
+    materialization state placement uses — bit-identical to the private
+    ``jnp.asarray`` path each metric used to run."""
+    if rules is None:
+        rules = backbone_partition_rules(arch, data_axis=data_axis, model_axis=model_axis)
+    return rules.place(mesh, cast_params(params, dtype_policy))

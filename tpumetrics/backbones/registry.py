@@ -1,0 +1,301 @@
+"""The process-global backbone registry: ONE resident weight set per
+(architecture, weights-digest, mesh, dtype policy).
+
+Every pretrained forward the metric families use — the LPIPS conv stacks,
+the FID InceptionV3, BERT-style encoders — used to be loaded, cast, and
+placed privately per metric instance: two FID instances on one stream held
+two copies of a ~95 MB weight tree and compiled two identical programs.
+:func:`get_backbone` collapses that to one :class:`BackboneHandle` per
+registry key, refcounted across metric instances AND service tenants:
+
+- weights are ``device_put`` once, sharded per
+  :mod:`~tpumetrics.backbones.placement` (meshless fallback bit-identical
+  to the old private path);
+- the compiled forward lives in the handle's
+  :class:`~tpumetrics.backbones.engine.BackboneEngine` — N instances share
+  one program cache, so the embed compiles once no matter how many tenants
+  dispatch it;
+- HBM is attributed through the program-profile registry: each handle owns
+  a ``backbones/<key>`` label whose profiles release on last close, and
+  :func:`resident_bytes` feeds the ``backbone_bytes`` key of
+  ``stats()["device"]["hbm"]``.
+
+Handles are acquired in metric ``__init__`` and closed in ``close()`` —
+never construct weights in ``update()``-reachable code (tpulint TPL107).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumetrics.backbones.engine import BackboneEngine
+from tpumetrics.backbones.placement import (
+    DTYPE_POLICIES,
+    backbone_partition_rules,
+    place_backbone,
+)
+from tpumetrics.parallel.sharding import StatePartitionRules, state_paths
+from tpumetrics.telemetry import device as _device
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+Array = jax.Array
+
+__all__ = ["BackboneHandle", "get_backbone", "resident_bytes", "registry_stats"]
+
+
+def _weights_digest(params: Any) -> str:
+    """Content digest of a parameter pytree: path + shape + dtype + bytes per
+    leaf.  Two metrics constructed from the same converted checkpoint hash
+    identically even through separate ``np.load`` calls."""
+    h = hashlib.sha1()
+    for path, leaf in state_paths(params):
+        arr = np.asarray(leaf)
+        h.update(path.encode())
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+def _builtin_forward(arch: str) -> Callable[..., Any]:
+    """The forward for a built-in arch key (``lpips:<net>`` /
+    ``inception:<tap>``); raises for unknown keys so custom architectures
+    must pass ``forward=`` explicitly."""
+    family, _, variant = arch.partition(":")
+    if family == "lpips":
+        from tpumetrics.image._backbones import _BACKBONE_BUILDERS
+
+        if variant not in _BACKBONE_BUILDERS:
+            raise TPUMetricsUserError(
+                f"Unknown LPIPS backbone arch {arch!r}; expected lpips:alex/vgg/squeeze."
+            )
+
+        def forward(params: Any, x: Array) -> Any:
+            return _BACKBONE_BUILDERS[variant](params)(x)
+
+        return forward
+    if family == "inception":
+        from tpumetrics.image._inception import inception_v3_features
+
+        def forward(params: Any, x: Array) -> Array:
+            return inception_v3_features(params, (variant,))(x)[0]
+
+        return forward
+    raise TPUMetricsUserError(
+        f"Unknown backbone arch {arch!r} and no `forward=` given; built-in families"
+        " are 'lpips:<alex|vgg|squeeze>' and 'inception:<tap>'."
+    )
+
+
+class BackboneHandle:
+    """One resident backbone: placed params + shared engine + refcount.
+
+    Instances come from :func:`get_backbone` only.  ``close()`` drops one
+    reference; the last close evicts the handle from the registry, frees the
+    weight tree, and releases the ``backbones/<key>`` program profiles."""
+
+    def __init__(
+        self,
+        reg_key: Tuple,
+        key: str,
+        arch: str,
+        params: Any,
+        engine: BackboneEngine,
+        mesh: Optional[Any],
+        dtype_policy: str,
+    ) -> None:
+        self._reg_key = reg_key
+        self.key = key
+        self.arch = arch
+        self.params = params
+        self.engine = engine
+        self.mesh = mesh
+        self.dtype_policy = dtype_policy
+        self.label = f"backbones/{key}"
+        self.refs = 0
+        self.closed = False
+
+    def __call__(self, *args: Any) -> Any:
+        """Dispatch the shared forward (see :class:`BackboneEngine`)."""
+        if self.closed:
+            raise TPUMetricsUserError(
+                f"Backbone handle {self.key!r} is closed; re-acquire it via get_backbone()."
+            )
+        return self.engine(self.params, *args)
+
+    def acquire(self) -> "BackboneHandle":
+        """Take one more reference (e.g. a metric adopting a caller-supplied
+        handle) and return self.  Pair with :meth:`close`."""
+        with _LOCK:
+            if self.closed:
+                raise TPUMetricsUserError(
+                    f"Backbone handle {self.key!r} is closed; re-acquire it via get_backbone()."
+                )
+            self.refs += 1
+        return self
+
+    def resident_bytes(self) -> int:
+        """On-device bytes held by this handle's weight tree."""
+        if self.params is None:
+            return 0
+        return sum(
+            int(getattr(leaf, "nbytes", 0) or 0)
+            for leaf in jax.tree_util.tree_leaves(self.params)
+        )
+
+    def close(self) -> None:
+        """Drop one reference; the last reference frees the weights."""
+        with _LOCK:
+            if self.closed:
+                return
+            self.refs -= 1
+            if self.refs > 0:
+                return
+            self.closed = True
+            _HANDLES.pop(self._reg_key, None)
+        self.params = None
+        _device.release_profiles(self.label)
+
+    def __deepcopy__(self, memo: Dict) -> "BackboneHandle":
+        """Handles are shared by reference: a cloned metric dispatches the
+        same resident backbone and owns one more reference on it."""
+        # memo ourselves: deepcopy only records y when y is not x, so without
+        # this every encounter within one clone would bump the refcount again
+        memo[id(self)] = self
+        with _LOCK:
+            if not self.closed:
+                self.refs += 1
+        return self
+
+    def __repr__(self) -> str:
+        return (
+            f"BackboneHandle({self.key!r}, refs={self.refs},"
+            f" bytes={self.resident_bytes()})"
+        )
+
+
+_LOCK = threading.Lock()
+_HANDLES: Dict[Tuple, BackboneHandle] = {}
+
+
+def _mesh_key(mesh: Optional[Any]) -> Any:
+    if mesh is None:
+        return None
+    return (
+        tuple(mesh.axis_names),
+        tuple(int(mesh.shape[a]) for a in mesh.axis_names),
+        tuple(id(d) for d in mesh.devices.flat),
+    )
+
+
+def get_backbone(
+    arch: str,
+    params: Any,
+    *,
+    mesh: Optional[Any] = None,
+    data_axis: str = "dp",
+    model_axis: Optional[str] = None,
+    dtype_policy: str = "float32",
+    forward: Optional[Callable[..., Any]] = None,
+    rules: Optional[StatePartitionRules] = None,
+    pad_axes: Sequence[int] = (0,),
+    key: Optional[str] = None,
+    acquire: bool = True,
+) -> BackboneHandle:
+    """Acquire the resident :class:`BackboneHandle` for (arch, weights,
+    mesh, dtype policy) — placing the weights on first acquisition, bumping
+    the refcount on every later one.
+
+    Args:
+        arch: built-in key (``"lpips:alex"``, ``"inception:2048"``) or any
+            caller-chosen name for a custom ``forward=``.
+        params: the weight pytree (host numpy or device arrays).
+        mesh / data_axis / model_axis / rules: placement inputs — see
+            :func:`~tpumetrics.backbones.placement.place_backbone`.
+        dtype_policy: ``"float32"`` (default/oracle) or ``"bfloat16"``
+            (opt-in; gate with the per-metric error-bound suite).
+        forward: ``(params, *arrays) -> pytree`` for custom architectures.
+        pad_axes: engine bucketing axes (dim 0 batch; add dim 1 for
+            token-id sequence axes).
+        key: explicit weights identity, skipping the content digest — for
+            callers that acquire per step and cannot afford the hash.
+        acquire: ``True`` (default) bumps the refcount — the caller owns a
+            reference and must ``close()`` it.  ``False`` is the functional
+            idiom: an existing handle is returned without a ref bump, and a
+            freshly placed one keeps a single registry-owned reference (a
+            process-lifetime cache), so one-shot functional calls neither
+            leak refs nor thrash placement.
+    """
+    if dtype_policy not in DTYPE_POLICIES:
+        raise TPUMetricsUserError(
+            f"Backbone dtype policy must be one of {DTYPE_POLICIES}, got {dtype_policy!r}."
+        )
+    digest = key if key is not None else _weights_digest(params)
+    reg_key = (arch, digest, _mesh_key(mesh), dtype_policy)
+    with _LOCK:
+        handle = _HANDLES.get(reg_key)
+        if handle is not None:
+            if acquire:
+                handle.refs += 1
+            return handle
+    # placement (a device_put of the whole tree) runs OUTSIDE the lock; the
+    # setdefault below resolves the rare duplicate-placement race in favor
+    # of the first publisher
+    fwd = forward if forward is not None else _builtin_forward(arch)
+    placed = place_backbone(
+        arch, params, mesh=mesh, rules=rules,
+        data_axis=data_axis, model_axis=model_axis, dtype_policy=dtype_policy,
+    )
+    public = f"{arch}:{digest[:12]}:{dtype_policy}" + ("" if mesh is None else ":mesh")
+    engine = BackboneEngine(
+        fwd, label=f"backbones/{public}", dtype_policy=dtype_policy,
+        mesh=mesh, data_axis=data_axis, pad_axes=pad_axes,
+    )
+    fresh = BackboneHandle(reg_key, public, arch, placed, engine, mesh, dtype_policy)
+    with _LOCK:
+        handle = _HANDLES.setdefault(reg_key, fresh)
+        if acquire or handle.refs == 0:
+            handle.refs += 1
+    return handle
+
+
+def resident_bytes() -> int:
+    """Total on-device bytes held by every resident backbone — the
+    ``backbone_bytes`` number ``stats()["device"]["hbm"]`` reports."""
+    with _LOCK:
+        handles = list(_HANDLES.values())
+    return sum(h.resident_bytes() for h in handles)
+
+
+def registry_stats() -> Dict[str, Dict[str, Any]]:
+    """Per-handle registry snapshot: refs, resident bytes, engine counters."""
+    with _LOCK:
+        handles = list(_HANDLES.values())
+    return {
+        h.key: {
+            "arch": h.arch,
+            "refs": h.refs,
+            "bytes": h.resident_bytes(),
+            "compiles": h.engine.compile_count,
+            "dispatches": h.engine.dispatch_count,
+            "dtype_policy": h.dtype_policy,
+        }
+        for h in handles
+    }
+
+
+def _reset_backbones() -> None:
+    """Drop every resident handle (tests only)."""
+    with _LOCK:
+        handles = list(_HANDLES.values())
+        _HANDLES.clear()
+    for h in handles:
+        h.closed = True
+        h.params = None
+        _device.release_profiles(h.label)

@@ -54,18 +54,24 @@ def resolve_lpips_net(
     backbone_params: Optional[Sequence] = None,
     layer_weights: Optional[Sequence] = None,
     arg_name: str = "net_type",
+    *,
+    dtype_policy: str = "float32",
+    mesh: Optional[object] = None,
+    acquire: bool = False,
 ) -> Tuple[Callable, Optional[Sequence]]:
     """Resolve a ``net`` spec into (backbone callable, layer weights).
 
     A string net (``alex``/``vgg``/``squeeze``) requires ``backbone_params``
     (offline-converted convs, see :mod:`tpumetrics.image._backbones`) and
-    defaults ``layer_weights`` to the bundled trained heads; a callable passes
-    through unchanged.  Shared by the functional (``arg_name="net"``) and the
-    Metric class (``arg_name="net_type"``) so errors name the caller's
-    parameter."""
+    defaults ``layer_weights`` to the bundled trained heads; the weights are
+    placed ONCE through the process-global backbone registry
+    (:mod:`tpumetrics.backbones`), so every LPIPS instance / functional call
+    over the same converted params shares one resident weight set and one
+    compiled forward.  A callable passes through unchanged.  Shared by the
+    functional (``arg_name="net"``, ``acquire=False``) and the Metric class
+    (``arg_name="net_type"``, ``acquire=True`` — the metric owns a registry
+    reference and releases it in ``release_backbones()``)."""
     if isinstance(net, str):
-        from tpumetrics.image._backbones import lpips_backbone
-
         if net not in ("alex", "vgg", "squeeze"):
             raise ValueError(f"Argument `{arg_name}` must be 'alex', 'vgg', 'squeeze' or a callable, got {net!r}")
         if backbone_params is None:
@@ -78,7 +84,18 @@ def resolve_lpips_net(
             )
         if layer_weights is None:
             layer_weights = lpips_head_weights(net)
-        net = lpips_backbone(net, backbone_params)
+        from tpumetrics.backbones.registry import get_backbone
+        from tpumetrics.image._backbones import _check_params
+
+        # keep the old resolve-time error for wrong param counts (the registry
+        # would otherwise only surface it at first forward trace)
+        _check_params(net, backbone_params, {"alex": 5, "vgg": 13, "squeeze": 25}[net])
+
+        # tpulint: disable-next=TPL107 -- this IS the lifecycle seam: metrics resolve here once at __init__, and the functional acquire=False lookup digest-dedupes to the same resident handle
+        net = get_backbone(
+            f"lpips:{net}", backbone_params,
+            dtype_policy=dtype_policy, mesh=mesh, acquire=acquire,
+        )
     if not callable(net):
         raise ValueError(f"Argument `{arg_name}` must be a string or a callable backbone")
     return net, layer_weights

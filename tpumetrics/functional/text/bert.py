@@ -219,10 +219,22 @@ class _EmbedFns:
             return None
 
 
-def _chunk_embed_fn(model: Any, user_forward_fn: Optional[Callable], all_layers: bool, num_layers: Optional[int]):
+def _chunk_embed_fn(
+    model: Any,
+    user_forward_fn: Optional[Callable],
+    all_layers: bool,
+    num_layers: Optional[int],
+    backbone: Optional[Any] = None,
+):
     """The :class:`_EmbedFns` for one (model, forward, layer-config),
     cached by identity so repeated ``compute`` calls (and every chunk within
     one) reuse the compiled programs.
+
+    A ``backbone`` (a :class:`~tpumetrics.backbones.registry.BackboneHandle`
+    over an encoder) is cached by its REGISTRY KEY: every metric instance and
+    service tenant holding the same resident handle shares one compiled embed
+    pipeline — the handle's forward inlines into the pipeline jit, so the
+    encoder compiles once process-wide per (weights, layer-config).
 
     Falls back to an unjitted pipeline when the model/forward are unhashable
     or refuse tracing (exotic user forwards that leave jax)."""
@@ -232,12 +244,18 @@ def _chunk_embed_fn(model: Any, user_forward_fn: Optional[Callable], all_layers:
     # constructed metric would recompile the chunk pipeline (~seconds on a
     # remote-attached accelerator) for an identical program
     stateless = type(model) is object
-    key = ("__stateless__" if stateless else id(model), id(user_forward_fn), all_layers, num_layers)
+    if backbone is not None:
+        key = ("__backbone__", backbone.key, all_layers, num_layers)
+    else:
+        key = ("__stateless__" if stateless else id(model), id(user_forward_fn), all_layers, num_layers)
     cached = _CHUNK_EMBED_CACHE.get(key)
     # guard id-reuse after GC: keep strong refs alongside the compiled fn
     if (
         cached is not None
-        and (cached[1] is model or (stateless and type(cached[1]) is object))
+        and (
+            (backbone is not None and cached[1] is backbone)
+            or (backbone is None and (cached[1] is model or (stateless and type(cached[1]) is object)))
+        )
         and cached[2] is user_forward_fn
     ):
         return cached[0]
@@ -246,7 +264,11 @@ def _chunk_embed_fn(model: Any, user_forward_fn: Optional[Callable], all_layers:
         # the model sees the real attention mask; the score weighting uses the
         # special-token-stripped one (reference helper_embedding_metric.py:35-50)
         model_batch = {"input_ids": ids, "attention_mask": mask}
-        if user_forward_fn is not None:
+        if backbone is not None:
+            part = jnp.asarray(backbone(ids, mask))
+            if part.ndim == 3:
+                part = part[:, None]
+        elif user_forward_fn is not None:
             part = jnp.asarray(user_forward_fn(model, model_batch))
             if part.ndim == 3:
                 part = part[:, None]
@@ -256,6 +278,8 @@ def _chunk_embed_fn(model: Any, user_forward_fn: Optional[Callable], all_layers:
         return part * jnp.asarray(weight_mask, jnp.float32)[:, None, :, None]
 
     fns = _EmbedFns(pipeline)
+    if backbone is not None:
+        model = backbone  # pin the handle (identity guard above)
 
     # bounded FIFO: the cached closure necessarily pins its model, so cap how
     # many distinct models stay pinned; evicting oldest (not clearing all)
@@ -277,6 +301,7 @@ def _embed(
     idf_map: Optional[Dict[int, float]] = None,
     num_layers: Optional[int] = None,
     batch_size: int = 64,
+    backbone: Optional[Any] = None,
 ) -> Tuple[Array, Array, List[List[int]]]:
     """Tokenize + embed + unit-normalize + mask; returns (embeddings,
     idf-or-uniform token weights, token id lists). The model forward runs in
@@ -320,7 +345,7 @@ def _embed(
     # forward + unit-normalize + mask fused into jit (cached across chunks
     # AND compute calls — uniform chunking keeps the shape signature
     # constant); eagerly this path is dozens of dispatches
-    fns = _chunk_embed_fn(model, user_forward_fn, all_layers, num_layers)
+    fns = _chunk_embed_fn(model, user_forward_fn, all_layers, num_layers, backbone)
     n_chunks = n_pad // step if step else 0
     emb = None
     if n_chunks > 4:
@@ -369,82 +394,22 @@ def _embed(
     return emb, jnp.asarray(scale), token_lists
 
 
-def bert_score(
-    preds: Union[str, List[str]],
-    target: Union[str, List[str]],
-    model_name_or_path: Optional[str] = None,
+def _score_embeddings(
+    preds_emb: Array,
+    target_emb: Array,
+    preds_scale: Array,
+    target_scale: Array,
+    batch_size: int = 64,
+    baseline: Optional[Array] = None,
     num_layers: Optional[int] = None,
     all_layers: bool = False,
-    model: Optional[Any] = None,
-    user_tokenizer: Optional[Any] = None,
-    user_forward_fn: Optional[Callable] = None,
-    verbose: bool = False,
-    idf: bool = False,
-    device: Optional[Any] = None,
-    max_length: int = 512,
-    batch_size: int = 64,
-    num_threads: int = 0,
-    return_hash: bool = False,
-    lang: str = "en",
-    rescale_with_baseline: bool = False,
-    baseline_path: Optional[str] = None,
-    baseline_url: Optional[str] = None,
-) -> Dict[str, Array]:
-    """BERTScore: greedy cosine matching of contextual token embeddings
-    (reference bert.py:246-447).
-
-    Pass ``model`` + ``user_tokenizer`` (+ optionally ``user_forward_fn``)
-    to use any embedding model; a hub id downloads via transformers.
-    """
-    if isinstance(preds, str):
-        preds = [preds]
-    if isinstance(target, str):
-        target = [target]
-    if len(preds) != len(target):
-        raise ValueError(
-            f"Expected argument `preds` and `target` to have same length, but got {len(preds)} and {len(target)}"
-        )
-    # device/num_threads are torch runtime knobs, accepted for drop-in
-    # compatibility and ignored: XLA owns placement and threading
-    baseline = None
-    if rescale_with_baseline:
-        if not baseline_path:
-            raise NotImplementedError(
-                "Baseline rescaling without a local file requires downloading the bert-score"
-                " baseline (reference bert.py:202-222), which is not supported here. Save the"
-                " baseline CSV locally and pass it via `baseline_path=`."
-            )
-        baseline = _read_baseline_csv(baseline_path)
-
-    if model is None:
-        model, tokenizer = _load_default_model(model_name_or_path or "roberta-large", num_layers)
-    else:
-        if user_tokenizer is None:
-            raise ValueError("`user_tokenizer` must be provided together with a custom `model`")
-        tokenizer = user_tokenizer
-
-    idf_map: Optional[Dict[int, float]] = None
-    if idf:
-        target_batch = _tokenize_padded(tokenizer, list(target), max_length)
-        token_lists = [
-            [int(t) for t, a in zip(row, arow) if a]
-            for row, arow in zip(target_batch["input_ids"], target_batch["attention_mask"])
-        ]
-        idf_map = _compute_idf(token_lists, len(target))
-
-    preds_emb, preds_scale, _ = _embed(
-        list(preds), model, tokenizer, user_forward_fn, all_layers, max_length, idf, idf_map,
-        num_layers, batch_size
-    )
-    target_emb, target_scale, _ = _embed(
-        list(target), model, tokenizer, user_forward_fn, all_layers, max_length, idf, idf_map,
-        num_layers, batch_size
-    )
-
-    # score in chunks too: the (b, l, p, r) similarity tensor is the peak;
-    # the whole chunked loop (pad, slice, score, concatenate) runs as ONE
-    # dispatch via _score_scan, with the chunk count padded to a power of two
-    # so corpora of different sizes share a handful of compiled signatures
+) -> Tuple[Array, Array, Array]:
+    """Score pre-computed (n, L, S, D) embeddings + (n, S) token weights into
+    (precision, recall, f1) — the scoring tail of :func:`bert_score`, shared
+    with the stream-time embedding path of :class:`~tpumetrics.text.bert.
+    BERTScore`.  Chunked via ``_score_scan`` (one dispatch; the chunk count
+    pads to a power of two so corpora of different sizes share a handful of
+    compiled signatures)."""
     n = preds_emb.shape[0]
     step = max(1, batch_size)
     n_chunks = -(-n // step) if n else 0
@@ -468,6 +433,99 @@ def bert_score(
         precision, recall, f1 = _rescale_with_baseline(
             precision, recall, f1, baseline, num_layers, all_layers
         )
+    return precision, recall, f1
+
+
+def bert_score(
+    preds: Union[str, List[str]],
+    target: Union[str, List[str]],
+    model_name_or_path: Optional[str] = None,
+    num_layers: Optional[int] = None,
+    all_layers: bool = False,
+    model: Optional[Any] = None,
+    user_tokenizer: Optional[Any] = None,
+    user_forward_fn: Optional[Callable] = None,
+    verbose: bool = False,
+    idf: bool = False,
+    device: Optional[Any] = None,
+    max_length: int = 512,
+    batch_size: int = 64,
+    num_threads: int = 0,
+    return_hash: bool = False,
+    lang: str = "en",
+    rescale_with_baseline: bool = False,
+    baseline_path: Optional[str] = None,
+    baseline_url: Optional[str] = None,
+    backbone: Optional[Any] = None,
+) -> Dict[str, Array]:
+    """BERTScore: greedy cosine matching of contextual token embeddings
+    (reference bert.py:246-447).
+
+    Pass ``model`` + ``user_tokenizer`` (+ optionally ``user_forward_fn``)
+    to use any embedding model; a hub id downloads via transformers.
+    Alternatively pass ``backbone`` — a shared registry handle
+    (:func:`tpumetrics.backbones.get_backbone`) over an encoder forward
+    ``(params, input_ids, attention_mask) -> (B, S, D)`` or ``(B, L, S, D)``
+    — together with ``user_tokenizer``; every caller over the same handle
+    then shares one resident weight set and one compiled embed.
+    """
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    if len(preds) != len(target):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have same length, but got {len(preds)} and {len(target)}"
+        )
+    # device/num_threads are torch runtime knobs, accepted for drop-in
+    # compatibility and ignored: XLA owns placement and threading
+    baseline = None
+    if rescale_with_baseline:
+        if not baseline_path:
+            raise NotImplementedError(
+                "Baseline rescaling without a local file requires downloading the bert-score"
+                " baseline (reference bert.py:202-222), which is not supported here. Save the"
+                " baseline CSV locally and pass it via `baseline_path=`."
+            )
+        baseline = _read_baseline_csv(baseline_path)
+
+    if backbone is not None:
+        if user_tokenizer is None:
+            raise ValueError("`user_tokenizer` must be provided together with a `backbone`")
+        tokenizer = user_tokenizer
+        model = object()  # unused placeholder; the backbone owns the forward
+    elif model is None:
+        model, tokenizer = _load_default_model(model_name_or_path or "roberta-large", num_layers)
+    else:
+        if user_tokenizer is None:
+            raise ValueError("`user_tokenizer` must be provided together with a custom `model`")
+        tokenizer = user_tokenizer
+
+    idf_map: Optional[Dict[int, float]] = None
+    if idf:
+        target_batch = _tokenize_padded(tokenizer, list(target), max_length)
+        token_lists = [
+            [int(t) for t, a in zip(row, arow) if a]
+            for row, arow in zip(target_batch["input_ids"], target_batch["attention_mask"])
+        ]
+        idf_map = _compute_idf(token_lists, len(target))
+
+    preds_emb, preds_scale, _ = _embed(
+        list(preds), model, tokenizer, user_forward_fn, all_layers, max_length, idf, idf_map,
+        num_layers, batch_size, backbone
+    )
+    target_emb, target_scale, _ = _embed(
+        list(target), model, tokenizer, user_forward_fn, all_layers, max_length, idf, idf_map,
+        num_layers, batch_size, backbone
+    )
+
+    # score in chunks too: the (b, l, p, r) similarity tensor is the peak;
+    # the whole chunked loop (pad, slice, score, concatenate) runs as ONE
+    # dispatch via _score_scan (see _score_embeddings)
+    precision, recall, f1 = _score_embeddings(
+        preds_emb, target_emb, preds_scale, target_scale,
+        batch_size, baseline, num_layers, all_layers,
+    )
     output = {"precision": precision, "recall": recall, "f1": f1}
     if return_hash:
         output["hash"] = f"tpumetrics-bert_score-idf:{idf}"  # type: ignore[assignment]

@@ -42,14 +42,22 @@ LPIPS_CHANNELS = {
 
 def _conv(x: Array, wb: ConvParams, stride: int = 1, padding: int = 0) -> Array:
     w, b = wb
+    # params normally enter the trace already in the forward's dtype (the
+    # backbone registry casts the whole tree once at placement); the guards
+    # only fire for legacy direct callers with host/mismatched params, so a
+    # bf16 run no longer carries fp32 constants + per-conv converts
+    if getattr(w, "dtype", None) != x.dtype:
+        w = jnp.asarray(w, x.dtype)
+    if getattr(b, "dtype", None) != x.dtype:
+        b = jnp.asarray(b, x.dtype)
     out = lax.conv_general_dilated(
         x,
-        jnp.asarray(w, x.dtype),
+        w,
         window_strides=(stride, stride),
         padding=[(padding, padding), (padding, padding)],
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
     )
-    return out + jnp.asarray(b, x.dtype).reshape(1, -1, 1, 1)
+    return out + jnp.reshape(b, (1, -1, 1, 1))
 
 
 def _maxpool(x: Array, kernel: int = 3, stride: int = 2, ceil_mode: bool = False) -> Array:

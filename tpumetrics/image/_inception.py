@@ -177,15 +177,17 @@ def check_inception_params(params: Mapping[str, np.ndarray]) -> None:
             raise ValueError(f"InceptionV3 parameter `{key}` has shape {got}, expected {shape}")
 
 
-_PARAMS_CACHE: Dict[Tuple[str, float], Dict[str, Array]] = {}
+_PARAMS_CACHE: Dict[Tuple[str, float], Dict[str, np.ndarray]] = {}
 
 
-def load_inception_params(path: str) -> Dict[str, Array]:
+def load_inception_params(path: str) -> Dict[str, np.ndarray]:
     """Load a converted ``.npz`` parameter file (see ``_inception_convert``).
 
-    Cached per (absolute path, mtime): a typical eval builds FID + KID + IS
-    against the same file, and the ~24M-parameter upload should happen once.
-    Treat the returned mapping as read-only.
+    Cached per (absolute path, mtime) as HOST numpy arrays — device residency
+    belongs to the backbone registry (:mod:`tpumetrics.backbones`), which
+    ``device_put``s exactly one copy per (weights, mesh, dtype policy) no
+    matter how many FID/KID/IS instances load the same file.  Treat the
+    returned mapping as read-only.
     """
     import os
 
@@ -195,10 +197,20 @@ def load_inception_params(path: str) -> Dict[str, Array]:
     with np.load(path) as data:
         params = {k: np.asarray(data[k]) for k in data.files}
     check_inception_params(params)
-    loaded = {k: jnp.asarray(v) for k, v in params.items()}
-    _PARAMS_CACHE.clear()  # keep at most one weight set resident
-    _PARAMS_CACHE[key] = loaded
-    return loaded
+    _PARAMS_CACHE.clear()  # keep at most one weight set cached
+    _PARAMS_CACHE[key] = params
+    return params
+
+
+def _inception_weights_key(path: str) -> str:
+    """Registry weights-identity for a converted checkpoint file: hashing the
+    (absolute path, mtime) pair stands in for digesting the ~95 MB tree."""
+    import hashlib
+    import os
+
+    return hashlib.sha1(
+        f"{os.path.abspath(path)}:{os.path.getmtime(path)}".encode()
+    ).hexdigest()
 
 
 # ---------------------------------------------------------------- kernels
@@ -275,7 +287,11 @@ class _Net:
 
     def conv(self, x: Array, name: str) -> Array:
         kernel, stride, (ph, pw) = self.spec[name]
-        w = jnp.asarray(self.p[f"{name}.conv.weight"], x.dtype)
+        w = self.p[f"{name}.conv.weight"]
+        if getattr(w, "dtype", None) != x.dtype:
+            # legacy direct callers only — registry-placed params arrive
+            # pre-cast, keeping the program free of fp32 constants under bf16
+            w = jnp.asarray(w, x.dtype)
         out = lax.conv_general_dilated(
             x, w, (stride, stride), [(ph, ph), (pw, pw)], dimension_numbers=("NCHW", "OIHW", "NCHW")
         )
@@ -403,19 +419,29 @@ def inception_v3_features(
             if "2048" in wanted:
                 out["2048"] = h
         if deepest > depth_order.index("2048"):
-            logits = h @ jnp.asarray(params["fc.weight"], h.dtype).T
+            fc_w, fc_b = params["fc.weight"], params["fc.bias"]
+            if getattr(fc_w, "dtype", None) != h.dtype:
+                fc_w = jnp.asarray(fc_w, h.dtype)
+            if getattr(fc_b, "dtype", None) != h.dtype:
+                fc_b = jnp.asarray(fc_b, h.dtype)
+            logits = h @ fc_w.T
             if "logits_unbiased" in wanted:
                 out["logits_unbiased"] = logits
             if "logits" in wanted:
-                out["logits"] = logits + jnp.asarray(params["fc.bias"], h.dtype)[None]
+                out["logits"] = logits + fc_b[None]
         return tuple(out[f] for f in wanted)
 
     return forward
 
 
 def inception_feature_extractor(
-    feature, weights_path: Optional[str] = None
-) -> Callable[[Array], Array]:
+    feature,
+    weights_path: Optional[str] = None,
+    *,
+    dtype_policy: str = "float32",
+    mesh=None,
+    acquire: bool = False,
+):
     """Resolve an int/str ``feature`` request into a single-tap extractor.
 
     The converted-weights path comes from ``weights_path`` or the
@@ -423,6 +449,12 @@ def inception_feature_extractor(
     raises with the conversion recipe (the reference equally gates this path
     on torch-fidelity being installed + its checkpoint download,
     reference fid.py:53-58).
+
+    Returns a :class:`~tpumetrics.backbones.registry.BackboneHandle` from the
+    process-global registry: FID + KID + IS over the same converted file
+    share ONE resident weight set and one compiled forward per tap.  With
+    ``acquire=True`` the caller owns a reference and must ``close()`` it
+    (the Metric classes route that through ``release_backbones()``).
     """
     import os
 
@@ -442,10 +474,13 @@ def inception_feature_extractor(
             " TPUMETRICS_INCEPTION_WEIGHTS). Alternatively pass any callable image→(N, D)"
             " feature extractor."
         )
-    params = load_inception_params(path)
-    fwd = inception_v3_features(params, (tap,))
+    from tpumetrics.backbones.registry import get_backbone
 
-    def extract(imgs: Array) -> Array:
-        return fwd(imgs)[0]
-
-    return extract
+    return get_backbone(
+        f"inception:{tap}",
+        load_inception_params(path),
+        key=_inception_weights_key(path),
+        dtype_policy=dtype_policy,
+        mesh=mesh,
+        acquire=acquire,
+    )

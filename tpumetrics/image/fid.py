@@ -18,20 +18,39 @@ def _resolve_feature_extractor(
     feature: Union[int, str, Callable],
     metric_name: str,
     weights_path: Optional[str] = None,
+    *,
+    dtype_policy: str = "float32",
+    acquire: bool = False,
 ):
     """Resolve the ``feature`` argument: a callable extractor (any function
     mapping an image batch to (N, D) features — e.g. a jitted Flax apply) is
     used directly; an int/str selects a tap of the FID InceptionV3
-    (reference fid.py:30-44 → ``_inception.py``), built from converted
-    weights (``weights_path`` / ``TPUMETRICS_INCEPTION_WEIGHTS``) and raising
-    with the conversion recipe when none are available."""
+    (reference fid.py:30-44 → ``_inception.py``), resolved through the
+    process-global backbone registry from converted weights (``weights_path``
+    / ``TPUMETRICS_INCEPTION_WEIGHTS``) and raising with the conversion
+    recipe when none are available.  ``acquire=True`` makes the caller own a
+    registry reference (see :func:`_adopt_backbone`)."""
     if callable(feature):
         return feature, None
     if isinstance(feature, (int, str)):
         from tpumetrics.image._inception import inception_feature_extractor
 
-        return inception_feature_extractor(feature, weights_path), feature
+        handle = inception_feature_extractor(
+            feature, weights_path, dtype_policy=dtype_policy, acquire=acquire
+        )
+        return handle, feature
     raise TypeError("Got unknown input to argument `feature`")
+
+
+def _adopt_backbone(metric: Metric, extractor: Callable) -> None:
+    """Record an acquired :class:`~tpumetrics.backbones.registry.
+    BackboneHandle` on ``metric``: the handle joins ``_backbone_handles``
+    (released by ``Metric.release_backbones()``) and its registry key becomes
+    the public ``backbone_key`` attribute, so the config digest — and with it
+    the service share key — separates tenants over different weight sets."""
+    if hasattr(extractor, "key") and hasattr(extractor, "close"):
+        metric._backbone_handles = getattr(metric, "_backbone_handles", ()) + (extractor,)
+        metric.backbone_key = extractor.key
 
 
 def _tap_num_features(tap: Union[int, str, None]) -> Optional[int]:
@@ -111,8 +130,9 @@ class FrechetInceptionDistance(Metric):
     ) -> None:
         super().__init__(**kwargs)
         self.inception, tap = _resolve_feature_extractor(
-            feature, type(self).__name__, feature_extractor_weights_path
+            feature, type(self).__name__, feature_extractor_weights_path, acquire=True
         )
+        _adopt_backbone(self, self.inception)
         if num_features is None:
             num_features = _tap_num_features(tap)
         if num_features is None:

@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpumetrics.image.fid import _resolve_feature_extractor
+from tpumetrics.image.fid import _adopt_backbone, _resolve_feature_extractor
 from tpumetrics.metric import Metric
 from tpumetrics.utils.data import dim_zero_cat
 
@@ -53,8 +53,9 @@ class InceptionScore(Metric):
     ) -> None:
         super().__init__(**kwargs)
         self.inception, _ = _resolve_feature_extractor(
-            feature, type(self).__name__, feature_extractor_weights_path
+            feature, type(self).__name__, feature_extractor_weights_path, acquire=True
         )
+        _adopt_backbone(self, self.inception)
         if not isinstance(normalize, bool):
             raise ValueError("Argument `normalize` expected to be a bool")
         self.normalize = normalize

@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpumetrics.image.fid import _resolve_feature_extractor
+from tpumetrics.image.fid import _adopt_backbone, _resolve_feature_extractor
 from tpumetrics.metric import Metric
 from tpumetrics.utils.data import dim_zero_cat
 
@@ -103,8 +103,9 @@ class KernelInceptionDistance(Metric):
     ) -> None:
         super().__init__(**kwargs)
         self.inception, _ = _resolve_feature_extractor(
-            feature, type(self).__name__, feature_extractor_weights_path
+            feature, type(self).__name__, feature_extractor_weights_path, acquire=True
         )
+        _adopt_backbone(self, self.inception)
 
         if not (isinstance(subsets, int) and subsets > 0):
             raise ValueError("Argument `subsets` expected to be integer larger than 0")

@@ -56,13 +56,27 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         normalize: bool = False,
         layer_weights: Optional[Sequence[Array]] = None,
         backbone_params: Optional[Sequence] = None,
+        backbone_dtype_policy: str = "float32",
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         from tpumetrics.functional.image.lpips import resolve_lpips_net
 
-        net_type, layer_weights = resolve_lpips_net(net_type, backbone_params, layer_weights)
+        # a string net resolves through the process-global backbone registry
+        # (tpumetrics.backbones): this instance owns one refcounted handle to
+        # the shared resident weight set — release it via release_backbones()
+        net_type, layer_weights = resolve_lpips_net(
+            net_type, backbone_params, layer_weights,
+            dtype_policy=backbone_dtype_policy, acquire=True,
+        )
         self.net = net_type
+        self.backbone_dtype_policy = backbone_dtype_policy
+        self._backbone_handles = ()
+        if hasattr(net_type, "key") and hasattr(net_type, "close"):
+            self._backbone_handles = (net_type,)
+            # public str attr -> enters config_digest, so tenants over
+            # different weight sets never share a service slot
+            self.backbone_key = net_type.key
         valid_reduction = ("mean", "sum")
         if reduction not in valid_reduction:
             raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")

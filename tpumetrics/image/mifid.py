@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpumetrics.image.fid import _compute_fid, _resolve_feature_extractor
+from tpumetrics.image.fid import _adopt_backbone, _compute_fid, _resolve_feature_extractor
 from tpumetrics.metric import Metric
 from tpumetrics.utils.data import dim_zero_cat
 
@@ -75,8 +75,9 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
     ) -> None:
         super().__init__(**kwargs)
         self.inception, _ = _resolve_feature_extractor(
-            feature, type(self).__name__, feature_extractor_weights_path
+            feature, type(self).__name__, feature_extractor_weights_path, acquire=True
         )
+        _adopt_backbone(self, self.inception)
         if not isinstance(reset_real_features, bool):
             raise ValueError("Argument `reset_real_features` expected to be a bool")
         self.reset_real_features = reset_real_features

@@ -48,15 +48,19 @@ def perceptual_path_length(
     resize: Optional[int] = 64,
     lower_discard: Optional[float] = 0.01,
     upper_discard: Optional[float] = 0.99,
-    sim_net: Optional[Callable] = None,
+    sim_net: Optional[Union[str, Callable]] = None,
     latent_dim: int = 128,
     key: Optional[Array] = None,
+    backbone_params: Optional[Sequence] = None,
 ) -> Tuple[Array, Array, Array]:
     """PPL (Karras et al. 2019): LPIPS distance between images generated from
     epsilon-separated latents, scaled by 1/eps², with percentile discarding.
 
     ``generator`` maps latent batches to image batches; ``sim_net`` is the
-    perceptual backbone (see LPIPS — the pretrained default is gated).
+    perceptual backbone — a callable feature stack, or one of
+    ``"alex"``/``"vgg"``/``"squeeze"`` with the offline-converted conv
+    weights passed as ``backbone_params`` (resolved through the shared
+    backbone registry, same as LPIPS itself).
 
     Returns (mean, std, per-pair distances).
     """
@@ -64,6 +68,13 @@ def perceptual_path_length(
         raise ModuleNotFoundError(
             "perceptual_path_length requires a perceptual backbone: pass `sim_net` (see"
             " LearnedPerceptualImagePatchSimilarity — the pretrained default is unavailable here)."
+        )
+    layer_weights = None
+    if isinstance(sim_net, str):
+        from tpumetrics.functional.image.lpips import resolve_lpips_net
+
+        sim_net, layer_weights = resolve_lpips_net(
+            sim_net, backbone_params, None, arg_name="sim_net"
         )
     if conditional:
         raise NotImplementedError(
@@ -88,7 +99,9 @@ def perceptual_path_length(
         if resize is not None:
             img1 = jax.image.resize(img1, (img1.shape[0], img1.shape[1], resize, resize), "bilinear")
             img2 = jax.image.resize(img2, (img2.shape[0], img2.shape[1], resize, resize), "bilinear")
-        per_pair = learned_perceptual_image_patch_similarity(img1, img2, sim_net, reduction="none")
+        per_pair = learned_perceptual_image_patch_similarity(
+            img1, img2, sim_net, layer_weights, reduction="none"
+        )
         distances.append(per_pair / (epsilon**2))
     dist = jnp.concatenate(distances)[:num_samples]
 
@@ -139,8 +152,9 @@ class PerceptualPathLength(Metric):
         resize: Optional[int] = 64,
         lower_discard: Optional[float] = 0.01,
         upper_discard: Optional[float] = 0.99,
-        sim_net: Optional[Callable] = None,
+        sim_net: Optional[Union[str, Callable]] = None,
         latent_dim: int = 128,
+        backbone_params: Optional[Sequence] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -153,6 +167,18 @@ class PerceptualPathLength(Metric):
         self.lower_discard = lower_discard
         self.upper_discard = upper_discard
         self.sim_net = sim_net
+        self.backbone_params = backbone_params
+        if isinstance(sim_net, str):
+            from tpumetrics.functional.image.lpips import resolve_lpips_net
+
+            # acquire the shared registry handle up front so this instance
+            # owns a reference (released by release_backbones()); compute()
+            # re-resolves against the same resident handle
+            handle, _ = resolve_lpips_net(
+                sim_net, backbone_params, None, arg_name="sim_net", acquire=True
+            )
+            self._backbone_handles = (handle,)
+            self.backbone_key = handle.key
         self.latent_dim = latent_dim
         self._generator: Optional[Callable] = None
         self.add_state("dummy", jnp.zeros(()), dist_reduce_fx="sum")
@@ -176,4 +202,5 @@ class PerceptualPathLength(Metric):
             upper_discard=self.upper_discard,
             sim_net=self.sim_net,
             latent_dim=self.latent_dim,
+            backbone_params=self.backbone_params,
         )

@@ -1021,6 +1021,29 @@ class Metric(ABC):
         """Deep copy of the metric (reference metric.py:686-688)."""
         return deepcopy(self)
 
+    # ------------------------------------------------------- shared backbones
+
+    @property
+    def _backbone_share_ids(self) -> tuple:
+        """Registry keys of the resident backbones this metric dispatches
+        (``tpumetrics.backbones``).  The service folds these into its share
+        key so only tenants over the SAME resident weight set megabatch
+        together.  Empty for metrics without a pretrained forward."""
+        return tuple(h.key for h in getattr(self, "_backbone_handles", ()))
+
+    def release_backbones(self) -> None:
+        """Release this metric's references on shared backbone handles.
+
+        Idempotent.  Metrics that acquire a :class:`~tpumetrics.backbones.
+        registry.BackboneHandle` in ``__init__`` (LPIPS, the FID family,
+        BERTScore/InfoLM when given a ``backbone=``) record it in
+        ``self._backbone_handles``; the last release across all instances
+        frees the resident weight tree and its program profiles.  The
+        evaluation service calls this per tenant on ``close()``."""
+        handles, self._backbone_handles = getattr(self, "_backbone_handles", ()), ()
+        for h in handles:
+            h.close()
+
     # ------------------------------------------------------------ persistence
 
     def persistent(self, mode: bool = False) -> None:

@@ -51,6 +51,26 @@ The packed detection states are the worked example of the buffer row:
 which is what lets the dense detection update run as one GSPMD program
 with zero host round trips (see ``docs/performance.md``,
 "Device-resident detection").
+
+The same rule machinery places pretrained backbone WEIGHTS
+(``tpumetrics/backbones/placement.py``): a worked example, sharding an
+encoder's dense kernels along their output-feature dim on the metric mesh
+while biases replicate::
+
+    from tpumetrics.backbones import get_backbone
+    from tpumetrics.parallel.sharding import StatePartitionRules, make_mesh
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(8, axis_name="dp")
+    rules = StatePartitionRules(
+        [(r"(kernel|weight)$", P(None, "dp"))], data_axis="dp"
+    )
+    handle = get_backbone("bert:my-encoder", params, mesh=mesh, rules=rules,
+                          forward=encoder_fwd, pad_axes=(0, 1))
+
+Output-dim sharding never splits a contraction — no partial-sum
+collectives enter the math (``docs/backbones.md``; pinned bit-identical
+by the mesh8 test in ``tests/test_backbones.py``).
 """
 
 from __future__ import annotations

@@ -386,7 +386,7 @@ class StreamingEvaluator:
         # the state lock (the never-blocking stats() contract; guarded by
         # _health_lock, which is never held across a dispatch)
         self._stats_cache: Dict[str, Any] = {}
-        self._hbm_cache: Dict[str, int] = {"state_bytes": 0, "watermark_bytes": 0}
+        self._hbm_cache: Dict[str, int] = {"state_bytes": 0, "watermark_bytes": 0, "backbone_bytes": 0}
         # graceful-drain state: flag read lock-free on the submit hot path
         # (a single store-release is enough — late submits only need to fail
         # EVENTUALLY-before-close, and drain() flushes after setting it)
@@ -737,14 +737,20 @@ class StreamingEvaluator:
                 if current > self._hbm_watermark:
                     self._hbm_watermark = current
                 watermark = self._hbm_watermark
+        from tpumetrics.backbones.registry import resident_bytes as _backbone_bytes
+
         with self._health_lock:
             if not got:
                 # a donating dispatch owns the state: bounded-stale footprint
                 return dict(self._hbm_cache)
-            self._hbm_cache = {"state_bytes": current, "watermark_bytes": watermark}
+            self._hbm_cache = {
+                "state_bytes": current,
+                "watermark_bytes": watermark,
+                "backbone_bytes": _backbone_bytes(),
+            }
             if not self._closed:  # close() released the series; don't re-mint
                 _STATE_HBM_GAUGE.set(current, self._stream)
-        return {"state_bytes": current, "watermark_bytes": watermark}
+            return dict(self._hbm_cache)
 
     def _refresh_health(self, block: bool = False) -> Optional[Dict[str, Any]]:
         """Fetch + publish the latest on-device health counters (None when

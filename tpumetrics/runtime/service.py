@@ -532,6 +532,12 @@ class EvaluationService:
                 share_key = (
                     config_digest(metric), tuple(sorted(kwargs.items())), donate,
                     health_probe,
+                    # resident-backbone identity: config digests of two metrics
+                    # built from different WEIGHT SETS can coincide (weights are
+                    # not config), and megabatching them would silently score
+                    # through one tenant's backbone — the registry keys keep
+                    # sharing to same-backbone tenants only
+                    tuple(getattr(metric, "_backbone_share_ids", ())),
                 )
                 hash(share_key)
             except TypeError:
@@ -770,6 +776,13 @@ class EvaluationService:
                     _STATE_HBM_GAUGE.remove(tenant.tid)
                     _health.release_health(tenant.tid, tenant.health_alerted)
                     _device.release_profiles(tenant.tid)
+                # shared-backbone protocol: drop the metric's registry
+                # references (the LAST tenant over a weight set frees it);
+                # outside the health lock — handle close can release device
+                # buffers and program profiles of its own label
+                release = getattr(tenant.metric, "release_backbones", None)
+                if callable(release):
+                    release()
             _TENANTS_GAUGE.remove(self._label)
             _DEPTH_GAUGE.remove(self._label)
 
@@ -892,7 +905,16 @@ class EvaluationService:
         current = sum(int(getattr(l, "nbytes", 0) or 0) for l in leaves)
         if current > tenant.hbm_watermark:
             tenant.hbm_watermark = current
-        hbm = {"state_bytes": current, "watermark_bytes": tenant.hbm_watermark}
+        from tpumetrics.backbones.registry import resident_bytes as _backbone_bytes
+
+        hbm = {
+            "state_bytes": current,
+            "watermark_bytes": tenant.hbm_watermark,
+            # process-wide resident backbone weights (shared across tenants,
+            # reported flat — NOT multiplied per tenant); section contract:
+            # keys only ever get added
+            "backbone_bytes": _backbone_bytes(),
+        }
         probed = tenant.step is not None and tenant.step.health_probe
         health = tenant.device_health if probed else None
         paths = _health.state_paths(tenant.state) if health is not None else None

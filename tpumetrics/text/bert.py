@@ -24,10 +24,31 @@ class BERTScore(HostSentenceStateMixin, Metric):
     cannot live in device states, and this keeps update cheap while the
     heavy model forward batches once at the end.
 
+    With a ``backbone`` (a handle from
+    :func:`tpumetrics.backbones.get_backbone` over an encoder forward
+    ``(params, input_ids, attention_mask) -> (B, S, D)`` or ``(B, L, S, D)``,
+    plus ``user_tokenizer``) the metric instead embeds at STREAM TIME: each
+    ``update`` batch runs through the shared compiled embed immediately and
+    only the (much smaller) embeddings wait for ``compute``, which just
+    scores them.  The encoder forward requires mask-respecting, per-row
+    independent embeddings (any standard masked transformer qualifies) since
+    batches are embedded at their own padded length.  The host sentence
+    lists are still kept, so snapshots restore exactly as before — a
+    restored metric falls back to the compute-time embedding pass.
+
+    **Migration note (backbone runtime):** pretrained forwards now live in
+    the process-global backbone registry (:mod:`tpumetrics.backbones`).
+    Passing ``model=``/``user_forward_fn=`` keeps the historical
+    compute-time behavior, bit for bit; passing ``backbone=`` opts into the
+    shared resident weight set, the stream-time embed, and cross-tenant
+    sharing in the evaluation service.  Call ``release_backbones()`` (or let
+    the service ``close()`` do it) when done.
+
     Args:
         model_name_or_path: transformers hub id (gated when not downloadable).
         model / user_tokenizer / user_forward_fn: custom embedding stack.
         idf: inverse-document-frequency weighting over the reference corpus.
+        backbone: shared registry handle over the encoder (see above).
 
     Example:
         >>> from tpumetrics.text import BERTScore
@@ -63,9 +84,17 @@ class BERTScore(HostSentenceStateMixin, Metric):
         baseline_path: Optional[str] = None,
         baseline_url: Optional[str] = None,
         sentences_replicated: bool = False,
+        backbone: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        self.backbone = backbone
+        if backbone is not None:
+            if user_tokenizer is None:
+                raise ValueError("`user_tokenizer` must be provided together with a `backbone`")
+            # own one registry reference (released by release_backbones())
+            self._backbone_handles = (backbone.acquire(),)
+            self.backbone_key = backbone.key
         self.sentences_replicated = sentences_replicated
         self.model_name_or_path = model_name_or_path
         self.num_layers = num_layers
@@ -94,10 +123,15 @@ class BERTScore(HostSentenceStateMixin, Metric):
 
         self._preds: List[str] = []
         self._target: List[str] = []
+        # stream-time embedding buffers (backbone mode): per-update-batch
+        # (embeddings, token-weight scale) pairs, NOT part of snapshots — a
+        # restored metric re-embeds from the sentence lists at compute
+        self._streamed: List[Any] = []
         self.add_state("dummy", jnp.zeros(()), dist_reduce_fx="sum")
 
     def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
-        """Store sentences for the compute-time embedding pass."""
+        """Store sentences; with a ``backbone`` also embed the batch now
+        through the shared compiled encoder (stream-time embedding)."""
         if isinstance(preds, str):
             preds = [preds]
         if isinstance(target, str):
@@ -108,9 +142,59 @@ class BERTScore(HostSentenceStateMixin, Metric):
             )
         self._preds.extend(preds)
         self._target.extend(target)
+        # idf weights need the full reference corpus, so idf mode keeps the
+        # historical embed-at-compute path
+        if self.backbone is not None and not self.idf and preds:
+            from tpumetrics.functional.text.bert import _embed
+
+            pe, ps, _ = _embed(
+                list(preds), None, self.user_tokenizer, None, self.all_layers,
+                self.max_length, False, None, self.num_layers, self.batch_size,
+                self.backbone,
+            )
+            te, ts, _ = _embed(
+                list(target), None, self.user_tokenizer, None, self.all_layers,
+                self.max_length, False, None, self.num_layers, self.batch_size,
+                self.backbone,
+            )
+            self._streamed.append(((pe, ps, len(preds)), (te, ts, len(target))))
+
+    @staticmethod
+    def _cat_streamed(parts: List[Any]) -> Any:
+        """Concatenate per-batch (emb, scale, n) triples: pad the sequence
+        axis to the common max (padded positions carry zero embeddings and
+        zero weight, exactly like in-batch padding) and stack rows."""
+        import numpy as np
+
+        seq = max(p[0].shape[2] for p in parts)
+        embs, scales = [], []
+        for emb, scale, n in parts:
+            pad_s = seq - emb.shape[2]
+            if pad_s:
+                emb = jnp.pad(emb, [(0, 0), (0, 0), (0, pad_s), (0, 0)])
+                scale = jnp.pad(scale, [(0, 0), (0, pad_s)])
+            embs.append(emb[:n])
+            scales.append(scale[:n])
+        return jnp.concatenate(embs, axis=0), jnp.concatenate(scales, axis=0)
 
     def compute(self) -> Dict[str, Array]:
-        """Embed everything and score (reference text/bert.py compute)."""
+        """Score (reference text/bert.py compute): streamed embeddings when
+        complete, otherwise embed everything now."""
+        streamed_rows = sum(p[0][2] for p in self._streamed)
+        if self.backbone is not None and self._streamed and streamed_rows == len(self._preds):
+            from tpumetrics.functional.text.bert import _read_baseline_csv, _score_embeddings
+
+            preds_emb, preds_scale = self._cat_streamed([p[0] for p in self._streamed])
+            target_emb, target_scale = self._cat_streamed([p[1] for p in self._streamed])
+            baseline = _read_baseline_csv(self.baseline_path) if self.rescale_with_baseline else None
+            precision, recall, f1 = _score_embeddings(
+                preds_emb, target_emb, preds_scale, target_scale,
+                self.batch_size, baseline, self.num_layers, self.all_layers,
+            )
+            output: Dict[str, Array] = {"precision": precision, "recall": recall, "f1": f1}
+            if self.return_hash:
+                output["hash"] = f"tpumetrics-bert_score-idf:{self.idf}"  # type: ignore[assignment]
+            return output
         return bert_score(
             self._preds,
             self._target,
@@ -129,10 +213,19 @@ class BERTScore(HostSentenceStateMixin, Metric):
             rescale_with_baseline=self.rescale_with_baseline,
             baseline_path=self.baseline_path,
             baseline_url=self.baseline_url,
+            backbone=self.backbone,
         )
 
     def reset(self) -> None:
         super().reset()
         self._preds = []
         self._target = []
+        self._streamed = []
+
+    def __getstate__(self):
+        state = super().__getstate__()
+        # device-resident embed buffers don't snapshot; restore re-embeds
+        # from the sentence lists (same scores, one extra forward pass)
+        state["_streamed"] = []
+        return state
 

@@ -14,6 +14,26 @@ from tpumetrics.text._sentence_state import HostSentenceStateMixin
 Array = jax.Array
 
 
+class _BackboneMLM:
+    """Adapter presenting a shared backbone handle as InfoLM's masked-LM
+    model protocol (``model(input_ids=, attention_mask=).logits``).
+
+    The handle's forward is ``(params, input_ids, attention_mask) ->
+    (B, S, V) logits``; dispatching through the handle gives InfoLM's
+    per-chunk model pass the shared engine's jit + pow-2 bucketing + donated
+    staging buffers — the raw model call in
+    ``functional/text/infolm.py::_sentence_distribution`` is eager.
+    """
+
+    def __init__(self, handle: Any) -> None:
+        self.handle = handle
+
+    def __call__(self, input_ids: Any = None, attention_mask: Any = None, **_: Any):
+        from types import SimpleNamespace
+
+        return SimpleNamespace(logits=self.handle(input_ids, attention_mask))
+
+
 class InfoLM(HostSentenceStateMixin, Metric):
     """InfoLM accumulated over batches (sentences stored, embedded at compute
     like :class:`~tpumetrics.text.bert.BERTScore`).
@@ -47,11 +67,23 @@ class InfoLM(HostSentenceStateMixin, Metric):
         model: Optional[Any] = None,
         user_tokenizer: Optional[Any] = None,
         sentences_replicated: bool = False,
+        backbone: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.sentences_replicated = sentences_replicated
         _InformationMeasure(information_measure, alpha, beta)  # validate early
+        if backbone is not None:
+            if user_tokenizer is None:
+                raise ValueError("`user_tokenizer` must be provided together with a `backbone`")
+            if model is not None:
+                raise ValueError("Pass either `model` or `backbone`, not both")
+            # the metric owns one registry reference (release_backbones());
+            # the adapter routes the masked-LM forward through the shared
+            # engine (jit + bucketing + donation) instead of the eager call
+            self._backbone_handles = (backbone.acquire(),)
+            self.backbone_key = backbone.key
+            model = _BackboneMLM(backbone)
         self.model_name_or_path = model_name_or_path
         self.temperature = temperature
         self.information_measure = information_measure
