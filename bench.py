@@ -1664,6 +1664,178 @@ def _bench_multitenant_scaling():
     return ours, ref, {"extras": extras}
 
 
+def _bench_tenant_lifecycle():
+    """Tenant lifecycle at registration scale (ISSUE 17 acceptance): 100k
+    registered tenants at ~99% idle on ONE budgeted EvaluationService.
+
+    ``vs_baseline`` = baseline_wall / lifecycle_wall over the IDENTICAL
+    hot-tenant submit+flush workload: the same 1k hot tenants driven through
+    a plain 1k-tenant service (ref) vs through the 100k-registered budgeted
+    service (ours).  O(active) scheduling is the claim under test — the 99k
+    hibernated tenants leave the DRR ring and the instrument registry
+    entirely, so the ratio must hold ~1 no matter how many tenants are
+    registered.
+
+    The registration wave itself exercises pristine-start: once the HBM
+    budget saturates, ``register()`` creates tenants directly in the
+    hibernated state (no device allocation, no scheduler entry, no spill
+    file), which is what makes 100k registrations tractable at all.
+
+    In-scenario asserts (loud failures, not drifting numbers):
+
+    - pristine-start engaged: registrations past the budget went straight
+      to hibernated;
+    - the scheduler census is O(active): DRR membership stays at the
+      resident count, never the registered count;
+    - bit-identity: a hot tenant's compute() equals the functional oracle,
+      and a revived tenant's compute() equals its oracle too;
+    - the steady-state HBM watermark holds under the budget after the
+      revival wave forced evictions.
+
+    Extras carry the three gated series (``tenant_lifecycle_ceilings``):
+    ``hbm_watermark_budget_ratio`` (max sampled resident bytes / budget,
+    ceiling 1.0 — the budget is a contract, not a target),
+    ``hot_p99_submit_ratio`` (hot-tenant p99 submit on the 100k service /
+    the 1k baseline, from the shared submit-latency histogram), and
+    ``revival_latency_p99_ms`` (the manager's revival histogram over a
+    200-tenant revival wave).
+    """
+    import gc
+
+    import jax.numpy as jnp
+
+    from tpumetrics.aggregation import MeanMetric
+    from tpumetrics.backbones.registry import resident_bytes
+    from tpumetrics.runtime import EvaluationService
+    from tpumetrics.telemetry import instruments as _instruments
+
+    REG_T = int(os.environ.get("TPUMETRICS_BENCH_LIFECYCLE_TENANTS", 100_000))
+    HOT = max(min(1000, REG_T // 100), 8)
+    REVIVE = max(min(200, REG_T // 500), 4)
+    BATCHES = 2
+
+    batch = jnp.asarray(
+        np.random.default_rng(17).standard_normal(16, dtype=np.float32)
+    )
+
+    def make():
+        return MeanMetric()
+
+    # one tenant's resident state size, measured — the budget then caps the
+    # resident set at 1.5x the hot-tenant count
+    probe = EvaluationService(hbm_budget_bytes=1 << 40)
+    probe.register("probe", make(), buckets=[16])
+    size = probe.stats()["lifecycle"]["resident_state_bytes"]
+    probe.close()
+    assert size > 0, "lifecycle accounting recorded no resident bytes"
+    resident_cap = int(HOT * 1.5)
+    budget = size * resident_cap
+
+    submit_hist = _instruments.histogram(
+        _instruments.SUBMIT_LATENCY_MS, labels=("stream",)
+    )
+
+    def hot_round(svc, handles):
+        t0 = time.perf_counter()
+        for _ in range(BATCHES):
+            for h in handles:
+                h.submit(batch)
+        svc.flush()
+        return (time.perf_counter() - t0) * 1e6
+
+    # ---- ref: the same hot workload on a plain service of exactly HOT ----
+    ref_svc = EvaluationService()
+    ref_handles = [
+        ref_svc.register(f"b{i}", make(), buckets=[16]) for i in range(HOT)
+    ]
+    submit_hist.clear()
+    gc.collect()
+    gc.freeze()
+    try:
+        ref_us = hot_round(ref_svc, ref_handles)
+    finally:
+        gc.unfreeze()
+    base_p99 = float(submit_hist.summary()["p99"])
+    ref_svc.close()
+
+    # ---- ours: 100k registered, budget caps residency -------------------
+    svc = EvaluationService(hbm_budget_bytes=budget)
+    t0 = time.perf_counter()
+    handles = [svc.register(f"t{i}", make(), buckets=[16]) for i in range(REG_T)]
+    register_wall_s = time.perf_counter() - t0
+    lc = svc.stats()["lifecycle"]
+    assert lc["hibernated_tenants"] >= REG_T - resident_cap - 1, (
+        f"pristine-start never engaged: {lc}"
+    )
+    assert lc["scheduled_tenants"] <= resident_cap, (
+        f"DRR census is not O(active): {lc}"
+    )
+    hot_handles = handles[:HOT]  # registered first -> resident
+
+    def watermark():
+        s = svc.stats()["lifecycle"]
+        return s["resident_state_bytes"] + resident_bytes()
+
+    submit_hist.clear()
+    gc.collect()
+    gc.freeze()
+    try:
+        ours_us = hot_round(svc, hot_handles)
+    finally:
+        gc.unfreeze()
+    hot_p99 = float(submit_hist.summary()["p99"])
+    svc.lifecycle.enforce_budget()  # settle worker-side eviction first
+    marks = [watermark()]
+
+    # ---- revival wave: deep-hibernated tail comes back interactive ------
+    revive_hist = _instruments.histogram(
+        _instruments.REVIVAL_LATENCY_MS, labels=("service",), sketch=True
+    )
+    revive_ids = [f"t{i}" for i in range(REG_T - REVIVE, REG_T)]
+    for tid in revive_ids:
+        svc.submit(tid, batch)
+    svc.flush()
+    svc.lifecycle.enforce_budget()
+    marks.append(watermark())
+    rev = revive_hist.summary(svc._label)
+    assert rev["count"] >= REVIVE, f"revival histogram missed revivals: {rev}"
+    revival_p99 = float(rev["p99"])
+
+    # bit-identity spot checks against the functional oracle
+    oracle = make()
+    s = oracle.init_state()
+    for _ in range(BATCHES):
+        s = oracle.functional_update(s, batch)
+    assert float(hot_handles[0].compute()) == float(oracle.functional_compute(s))
+    s1 = oracle.functional_update(oracle.init_state(), batch)
+    assert float(svc.compute(revive_ids[0])) == float(oracle.functional_compute(s1))
+
+    lc = svc.stats()["lifecycle"]
+    watermark_ratio = max(marks) / budget
+    assert watermark_ratio <= 1.0, (
+        f"steady-state HBM watermark {max(marks)} over budget {budget}"
+    )
+    extras = {
+        "registered_tenants": REG_T,
+        "hot_tenants": HOT,
+        "resident_cap": resident_cap,
+        "hbm_budget_bytes": budget,
+        "hbm_watermark_budget_ratio": round(watermark_ratio, 4),
+        "baseline_p99_submit_ms": round(base_p99, 3),
+        "hot_p99_submit_ms": round(hot_p99, 3),
+        "hot_p99_submit_ratio": round(hot_p99 / max(base_p99, 1e-9), 3),
+        "revived_tenants": REVIVE,
+        "revival_latency_p99_ms": round(revival_p99, 3),
+        "register_wall_s": round(register_wall_s, 3),
+        "scheduled_tenants": lc["scheduled_tenants"],
+        "hibernated_tenants": lc["hibernated_tenants"],
+        "evictions": lc["evictions"],
+        "revivals": lc["revivals"],
+    }
+    svc.close()
+    return ours_us, ref_us, {"extras": extras}
+
+
 def _bench_resilience_overhead():
     """Cost of the SyncPolicy guard when NO fault fires (tpumetrics.resilience).
 
@@ -2513,6 +2685,13 @@ def _check_floors(headline_vs, details):
     # parity/dedupe asserts never ran)
     for key, ceiling in gate.get("multitenant_ceilings", {}).items():
         check_ceiling("multitenant_scaling", key, ceiling, fail_on_error=True)
+    # tenant-lifecycle ceilings: the steady-state HBM watermark must hold
+    # under the budget no matter how many tenants are registered, the hot-
+    # tenant submit path must stay flat vs the 1k baseline (O(active)
+    # scheduling), and revival must stay interactive (an errored scenario
+    # also trips — its bit-identity/pristine-start asserts never ran)
+    for key, ceiling in gate.get("tenant_lifecycle_ceilings", {}).items():
+        check_ceiling("tenant_lifecycle", key, ceiling, fail_on_error=True)
     # admin-plane ceilings: a scrape of the loaded 1000-tenant service must
     # stay reader-cheap (never synchronizing with a dispatch) and a live
     # scraper must add ~zero submit-path overhead — the admin server has no
@@ -2593,6 +2772,7 @@ def main() -> None:
         ("compile_cache_cold_warm", _bench_compile_cache_cold_warm),
         ("streaming_throughput", _bench_streaming_throughput),
         ("multitenant_scaling", _bench_multitenant_scaling),
+        ("tenant_lifecycle", _bench_tenant_lifecycle),
         ("resilience_overhead", _bench_resilience_overhead),
         ("observability_overhead", _bench_observability_overhead),
         ("device_observability", _bench_device_observability),

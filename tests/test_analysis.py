@@ -386,6 +386,72 @@ def test_backbone_lifecycle_registry_modules_exempt(tmp_path):
     assert "TPL107" not in _codes(found)
 
 
+# ------------------------------------------------------------------- TPL108
+RESIDENCY_TP = _src(
+    """
+    def drain_one(svc, tenant):
+        cached = tenant.state                 # device residency, cached...
+        svc.lifecycle.sweep_lifecycle()       # ...across a hibernation point
+        return cached                         # dangling if tenant was spilled
+
+    def probe(svc, tenant_rec):
+        health = tenant_rec.device_health
+        svc.lifecycle.enforce_budget()
+        return health
+    """
+)
+
+RESIDENCY_NEAR_MISS = _src(
+    """
+    def reread_after_point(svc, tenant):
+        cached = tenant.state
+        svc.lifecycle.sweep_lifecycle()
+        cached = tenant.state                 # fresh re-read: launders the cache
+        return cached
+
+    def under_lock(svc, tenant):
+        with svc.lifecycle.residency_lock:    # demotion takes the same lock
+            cached = tenant.state
+            svc.lifecycle.enforce_budget()
+            return cached
+
+    def no_point_between(svc, tenant):
+        cached = tenant.state
+        total = cached + 1                    # no hibernation point crossed
+        svc.lifecycle.sweep_lifecycle()
+        return total
+
+    def not_a_tenant(svc, machine):
+        cached = machine.state                # base is not tenant-named
+        svc.lifecycle.sweep_lifecycle()
+        return cached
+    """
+)
+
+
+def test_residency_lifecycle_true_positives():
+    found = analyze_source(RESIDENCY_TP)
+    # both the cached .state and the cached .device_health dangle
+    assert _codes(found).count("TPL108") == 2
+
+
+def test_residency_lifecycle_near_miss_negative():
+    # re-reads after the point, residency_lock-protected spans, uses before
+    # the point, and non-tenant bases must not trigger
+    found = analyze_source(RESIDENCY_NEAR_MISS)
+    assert "TPL108" not in _codes(found)
+
+
+def test_residency_lifecycle_manager_modules_exempt(tmp_path):
+    # the lifecycle manager's own modules ARE the residency seam — reads
+    # inside tpumetrics/lifecycle/ are never findings
+    pkg = tmp_path / "tpumetrics" / "lifecycle"
+    pkg.mkdir(parents=True)
+    (pkg / "manager.py").write_text(RESIDENCY_TP)
+    found = analyze_paths([str(pkg)])
+    assert "TPL108" not in _codes(found)
+
+
 def test_host_telemetry_reachable_helper_is_flagged():
     src = _src(
         """

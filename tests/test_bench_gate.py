@@ -66,6 +66,14 @@ def test_floor_file_shape():
     # latency must stay enqueue-shaped
     assert data["floors"]["multitenant_scaling"] >= 2.0
     assert data["multitenant_ceilings"]["soak_p99_submit_ms"] > 0
+    # the tenant-lifecycle gates (ISSUE 17 acceptance): the steady-state HBM
+    # watermark may NEVER exceed the budget (the budget is a contract — do
+    # not raise past 1.0), the hot-tenant p99 submit path must stay flat vs
+    # the 1k baseline no matter how many tenants are registered (O(active)
+    # scheduling), and revival must stay interactive
+    assert data["tenant_lifecycle_ceilings"]["hbm_watermark_budget_ratio"] <= 1.0
+    assert data["tenant_lifecycle_ceilings"]["hot_p99_submit_ratio"] > 0
+    assert data["tenant_lifecycle_ceilings"]["revival_latency_p99_ms"] > 0
     # the admin-plane gates (ISSUE 15): a scrape of the loaded 1000-tenant
     # service stays reader-cheap, and a live scraper adds ~zero dispatch-
     # path overhead (the server has no hook on the submit path at all)
@@ -130,6 +138,35 @@ def test_check_floors_flags_multitenant_regressions():
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and all("multitenant_scaling" in v for v in violations)
     details["multitenant_scaling"] = "error: AssertionError: parity broke"
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and "scenario failed" in violations[0]
+
+
+def test_check_floors_flags_tenant_lifecycle_regressions():
+    """A steady-state HBM watermark over the budget (eviction stopped
+    holding the contract), a hot-tenant p99 submit blown up by registered-
+    tenant count (hibernated tenants leaking onto the dispatch path), a
+    revival latency past interactive, and an errored scenario (its
+    bit-identity / pristine-start asserts never ran) must each trip the
+    gate independently."""
+    healthy = {
+        "vs_baseline": 1.0,
+        "hbm_watermark_budget_ratio": 0.97,
+        "hot_p99_submit_ratio": 1.3,
+        "revival_latency_p99_ms": 1.0,
+    }
+    details = {"tenant_lifecycle": dict(healthy)}
+    assert bench._check_floors(headline_vs=1000.0, details=details) == []
+    details["tenant_lifecycle"]["hbm_watermark_budget_ratio"] = 1.2
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("hbm_watermark_budget_ratio" in v for v in violations)
+    details["tenant_lifecycle"] = dict(healthy, hot_p99_submit_ratio=50.0)
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("hot_p99_submit_ratio" in v for v in violations)
+    details["tenant_lifecycle"] = dict(healthy, revival_latency_p99_ms=5000.0)
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("revival_latency_p99_ms" in v for v in violations)
+    details["tenant_lifecycle"] = "error: SnapshotIntegrityError: batches drifted"
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and "scenario failed" in violations[0]
 

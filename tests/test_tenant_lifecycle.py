@@ -1,0 +1,639 @@
+"""Tenant lifecycle manager (ISSUE 17): hibernation, HBM budgets, O(active).
+
+The acceptance spine is BIT-IDENTITY: a tenant that hibernates (state cut to
+the spill store, device buffers + instrument series + backbone references
+released) and later revives must compute exactly what an uninterrupted run
+computes — eager, bucketed, and mesh-sharded execution modes alike.  Around
+it: budget-driven LRU eviction order, the revive-under-concurrent-submit
+race, series released/re-minted across the residency round trip, spill-store
+retention across churn, backbone parking (release-on-hibernate without
+re-upload while another holder stays resident), the ``/statusz`` census
+schema pin, and exactly-once ledger events per residency transition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics.aggregation import MeanMetric
+from tpumetrics.backbones.registry import (
+    _HANDLES,
+    _reset_backbones,
+    get_backbone,
+    resident_bytes,
+)
+from tpumetrics.classification import MulticlassAccuracy
+from tpumetrics.lifecycle import (
+    HIBERNATED,
+    RESIDENT,
+    LifecyclePolicy,
+    SpillStore,
+    TenantRevivingError,
+)
+from tpumetrics.runtime import EvaluationService
+from tpumetrics.telemetry import instruments, ledger
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+from conftest import cpu_mesh
+
+
+@pytest.fixture(autouse=True)
+def _lifecycle_hygiene():
+    """Backbone registry empty, ledger off, before and after every test —
+    both are process-global and would couple tests through residue."""
+    _reset_backbones()
+    yield
+    _reset_backbones()
+    ledger.disable()
+
+
+def _acc(classes=4):
+    return MulticlassAccuracy(num_classes=classes, average="micro", validate_args=False)
+
+
+def _batch(classes=4, seed=0, rows=5):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.standard_normal((rows, classes)), jnp.float32),
+        jnp.asarray(rng.integers(0, classes, rows), jnp.int32),
+    )
+
+
+def _exact(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    assert a.dtype == b.dtype and a.shape == b.shape
+    np.testing.assert_array_equal(a, b)
+
+
+# ------------------------------------------------------------- bit identity
+
+
+class TestBitIdentity:
+    """Hibernate mid-stream, revive on the next submit, compute() must be
+    bit-identical to an oracle that never hibernated."""
+
+    def _roundtrip(self, make_metric, oracle_metric, batches, **register_kw):
+        oracle = EvaluationService()
+        svc = EvaluationService(hbm_budget_bytes=1 << 30)
+        try:
+            oracle.register("t", oracle_metric, **register_kw)
+            svc.register("t", make_metric, **register_kw)
+            half = len(batches) // 2
+            for b in batches[:half]:
+                oracle.submit("t", *b)
+                svc.submit("t", *b)
+            svc.flush()
+            assert svc.hibernate("t") is True
+            assert svc.tenant_stats("t")["residency"] == HIBERNATED
+            for b in batches[half:]:
+                oracle.submit("t", *b)
+                svc.submit("t", *b)  # first one revives lazily
+            oracle.flush()
+            svc.flush()
+            assert svc.tenant_stats("t")["residency"] == RESIDENT
+            _exact(svc.compute("t"), oracle.compute("t"))
+            lc = svc.stats()["lifecycle"]
+            assert lc["hibernations"] == 1 and lc["revivals"] == 1
+        finally:
+            svc.close()
+            oracle.close()
+
+    def test_eager_roundtrip_bit_identical(self):
+        batches = [_batch(seed=s) for s in range(4)]
+        self._roundtrip(_acc(), _acc(), batches)
+
+    def test_bucketed_roundtrip_bit_identical(self):
+        batches = [_batch(seed=s) for s in range(4)]
+        self._roundtrip(_acc(), _acc(), batches, buckets=[8])
+
+    def test_mesh_roundtrip_bit_identical(self):
+        mesh = cpu_mesh(8, axis_name="dp")
+        batches = [
+            (jnp.asarray(np.random.default_rng(s).standard_normal(8), jnp.float32),)
+            for s in range(4)
+        ]
+        self._roundtrip(
+            MeanMetric(), MeanMetric(), batches, buckets=(8,), mesh=mesh
+        )
+
+    def test_double_hibernate_revive_churn_stays_identical(self):
+        """Repeated round trips accumulate no drift and no spill files."""
+        oracle = EvaluationService()
+        svc = EvaluationService(hbm_budget_bytes=1 << 30)
+        try:
+            oracle.register("t", _acc(), buckets=[8])
+            svc.register("t", _acc(), buckets=[8])
+            for s in range(6):
+                b = _batch(seed=s)
+                oracle.submit("t", *b)
+                svc.submit("t", *b)
+                svc.flush()
+                assert svc.hibernate("t") is True
+            oracle.flush()
+            _exact(svc.compute("t"), oracle.compute("t"))
+            store = svc.lifecycle.store
+            # revival superseded every cut: nothing retained for the tenant
+            assert store.file_count("t") == 0
+        finally:
+            svc.close()
+            oracle.close()
+
+
+# ------------------------------------------------------------------ budget
+
+
+class TestBudget:
+    def _sized_service(self, ratio):
+        """A service whose budget fits ``ratio`` × one tenant's state —
+        measured with a throwaway service so the test does not hardcode
+        per-metric state sizes."""
+        probe = EvaluationService(hbm_budget_bytes=1 << 30)
+        probe.register("p", MeanMetric(), buckets=[8])
+        probe.submit("p", jnp.ones((4,)))
+        probe.flush()
+        size = probe.stats()["lifecycle"]["resident_state_bytes"]
+        probe.close()
+        assert size > 0
+        return EvaluationService(hbm_budget_bytes=int(size * ratio)), size
+
+    def test_lru_eviction_order_and_watermark(self):
+        svc, size = self._sized_service(2.5)
+        try:
+            for tid in ("a", "b", "c"):
+                svc.register(tid, MeanMetric(), buckets=[8])
+                svc.submit(tid, jnp.ones((4,)))
+                svc.flush()  # orders last_dispatch: a oldest ... c newest
+                time.sleep(0.01)
+            # three tenants at 3×size > 2.5×size budget: the worker-side
+            # budget hook evicted the LRU tenant ("a") and then stopped
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                lc = svc.stats()["lifecycle"]
+                if lc["evictions"] >= 1:
+                    break
+                time.sleep(0.01)
+            assert svc.tenant_stats("a")["residency"] == HIBERNATED
+            assert svc.tenant_stats("b")["residency"] == RESIDENT
+            assert svc.tenant_stats("c")["residency"] == RESIDENT
+            lc = svc.stats()["lifecycle"]
+            assert lc["evictions"] == 1
+            assert lc["resident_state_bytes"] <= int(size * 2.5)
+            # tighten the budget: the NEXT LRU tenant ("b") goes next
+            mgr = svc.lifecycle
+            mgr.policy = dataclasses.replace(
+                mgr.policy, hbm_budget_bytes=int(size * 1.5)
+            )
+            assert mgr.enforce_budget() == ["b"]
+            assert svc.tenant_stats("c")["residency"] == RESIDENT
+            # watermark holds under the tightened budget too
+            assert svc.stats()["lifecycle"]["resident_state_bytes"] <= int(size * 1.5)
+        finally:
+            svc.close()
+
+    def test_over_budget_single_tenant_evicts_once_idle(self):
+        svc, size = self._sized_service(0.5)  # nothing fits
+        try:
+            svc.register("busy", MeanMetric(), buckets=[8])
+            svc.submit("busy", jnp.ones((4,)))
+            svc.flush()
+            # over budget with a single candidate: the worker-side budget
+            # hook evicts it as soon as it goes idle
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if svc.tenant_stats("busy")["residency"] == HIBERNATED:
+                    break
+                time.sleep(0.01)
+            assert svc.tenant_stats("busy")["residency"] == HIBERNATED
+            # the stream still works: revival + another round trip
+            svc.submit("busy", jnp.full((4,), 3.0))
+            svc.flush()
+            _exact(svc.compute("busy"), jnp.asarray(2.0))
+        finally:
+            svc.close()
+
+    def test_idle_sweep_hibernates_cold_tenants(self):
+        svc = EvaluationService(
+            lifecycle=LifecyclePolicy(idle_hibernate_after=3600.0),
+            hbm_budget_bytes=1 << 30,
+        )
+        try:
+            for tid in ("x", "y"):
+                svc.register(tid, MeanMetric(), buckets=[8])
+                svc.submit(tid, jnp.ones((4,)))
+            svc.flush()
+            assert svc.sweep_lifecycle() == []  # nobody is an hour cold
+            demoted = svc.sweep_lifecycle(idle_for=0.0)
+            assert sorted(demoted) == ["x", "y"]
+            lc = svc.stats()["lifecycle"]
+            assert lc["resident_tenants"] == 0 and lc["hibernated_tenants"] == 2
+            assert lc["scheduled_tenants"] == 0  # O(active): scheduler empty
+        finally:
+            svc.close()
+
+    def test_lifecycle_api_requires_manager(self):
+        svc = EvaluationService()
+        try:
+            svc.register("t", MeanMetric(), buckets=[8])
+            with pytest.raises(TPUMetricsUserError, match="lifecycle"):
+                svc.hibernate("t")
+            with pytest.raises(TPUMetricsUserError, match="lifecycle"):
+                svc.sweep_lifecycle()
+            assert "lifecycle" not in svc.stats()
+        finally:
+            svc.close()
+
+
+# ------------------------------------------------------- revival under race
+
+
+class TestConcurrentRevival:
+    def test_revive_under_concurrent_submit(self):
+        """Many threads submit to a hibernated tenant at once: exactly one
+        revival happens and every batch lands exactly once."""
+        oracle = EvaluationService()
+        svc = EvaluationService(hbm_budget_bytes=1 << 30)
+        try:
+            oracle.register("t", MeanMetric(), buckets=[8])
+            svc.register("t", MeanMetric(), buckets=[8])
+            first = jnp.ones((4,))
+            oracle.submit("t", first)
+            svc.submit("t", first)
+            svc.flush()
+            assert svc.hibernate("t") is True
+
+            vals = [float(i) for i in range(16)]
+            for v in vals:
+                oracle.submit("t", jnp.full((4,), v))
+            errors = []
+
+            def _submit(v):
+                try:
+                    svc.submit("t", jnp.full((4,), v))
+                except BaseException as exc:  # pragma: no cover - fail loud
+                    errors.append(exc)
+
+            threads = [threading.Thread(target=_submit, args=(v,)) for v in vals]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors
+            oracle.flush()
+            svc.flush()
+            _exact(svc.compute("t"), oracle.compute("t"))
+            assert svc.stats()["lifecycle"]["revivals"] == 1
+        finally:
+            svc.close()
+            oracle.close()
+
+    def test_error_policy_gets_typed_refusal_mid_revival(self):
+        svc = EvaluationService(hbm_budget_bytes=1 << 30)
+        try:
+            svc.register("t", MeanMetric(), buckets=[8], backpressure="error")
+            svc.submit("t", jnp.ones((4,)))
+            svc.flush()
+            assert svc.hibernate("t") is True
+
+            mgr = svc.lifecycle
+            started, hold = threading.Event(), threading.Event()
+            orig_restore = mgr._restore
+
+            def slow_restore(tenant):
+                started.set()
+                assert hold.wait(5.0)
+                return orig_restore(tenant)
+
+            mgr._restore = slow_restore
+            reviver = threading.Thread(
+                target=svc.submit, args=("t", jnp.full((4,), 2.0))
+            )
+            reviver.start()
+            assert started.wait(5.0)
+            # the transition is in flight: an "error"-policy submitter gets
+            # the typed refusal instead of blocking on the condition
+            with pytest.raises(TenantRevivingError, match="reviving"):
+                svc.submit("t", jnp.full((4,), 3.0))
+            hold.set()
+            reviver.join(5.0)
+            mgr._restore = orig_restore
+            svc.flush()
+            _exact(svc.compute("t"), jnp.asarray(1.5))
+        finally:
+            svc.close()
+
+
+# ----------------------------------------------------- series + spill store
+
+
+class TestSeriesAndSpill:
+    def test_series_released_on_hibernate_and_reminted_on_revive(self):
+        svc = EvaluationService(hbm_budget_bytes=1 << 30)
+        try:
+            svc.register("series-t", _acc(), buckets=[8])
+            svc.submit("series-t", *_batch())
+            svc.flush()
+            hist = instruments.histogram(
+                instruments.SUBMIT_LATENCY_MS, labels=("stream",)
+            )
+            assert hist.summary("series-t")["count"] == 1
+            assert svc.hibernate("series-t") is True
+            # the close() release set ran: no series left for the tenant
+            assert hist.summary("series-t")["count"] == 0
+            svc.submit("series-t", *_batch(seed=1))  # revives + re-mints
+            svc.flush()
+            assert hist.summary("series-t")["count"] == 1
+        finally:
+            svc.close()
+
+    def test_spill_retention_across_churn(self, tmp_path):
+        svc = EvaluationService(
+            hbm_budget_bytes=1 << 30, spill_dir=str(tmp_path)
+        )
+        try:
+            svc.register("t", _acc(), buckets=[8])
+            store = svc.lifecycle.store
+            for s in range(5):
+                svc.submit("t", *_batch(seed=s))
+                svc.flush()
+                assert svc.hibernate("t") is True
+                # one cut per hibernation, pruned to policy.spill_keep
+                assert store.file_count("t") == 1
+            # the LAST revival deletes the superseded cut atomically
+            svc.submit("t", *_batch(seed=9))
+            svc.flush()
+            assert store.file_count("t") == 0
+            assert store.bytes_for("t") == 0
+            assert store.spills == 5 and store.discards >= 5
+        finally:
+            svc.close()
+
+    def test_spill_store_owned_root_cleaned_on_close(self):
+        store = SpillStore(None, keep=2)
+        root = store.root
+        store.spill("x", {"a": np.ones((2,), np.float32)}, {"batches": 1})
+        assert store.file_count("x") == 1
+        store.close()
+        import os
+
+        assert not os.path.exists(root)
+
+    def test_pristine_hibernation_writes_no_file(self, tmp_path):
+        svc = EvaluationService(
+            hbm_budget_bytes=1 << 30, spill_dir=str(tmp_path)
+        )
+        try:
+            svc.register("t", MeanMetric(), buckets=[8])
+            assert svc.hibernate("t") is True  # zero batches: nothing to cut
+            assert svc.lifecycle.store.file_count("t") == 0
+            svc.submit("t", jnp.ones((4,)))  # revival is a fresh init_state
+            svc.flush()
+            _exact(svc.compute("t"), jnp.asarray(1.0))
+        finally:
+            svc.close()
+
+
+# --------------------------------------------------------- backbone parking
+
+
+def _conv_params(rng, cout=8, cin=3, k=3):
+    return {
+        "w": (rng.standard_normal((cout, cin, k, k)) * 0.2).astype(np.float32),
+        "b": (rng.standard_normal((cout,)) * 0.1).astype(np.float32),
+    }
+
+
+def _feat_forward(params, x):
+    import jax
+
+    out = jax.lax.conv_general_dilated(
+        x, jnp.asarray(params["w"]), (1, 1), "SAME",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    return jnp.tanh(out + jnp.reshape(jnp.asarray(params["b"]), (1, -1, 1, 1))).mean(
+        axis=(2, 3)
+    )
+
+
+class _BackboneMean(MeanMetric):
+    """An eager metric holding a shared backbone reference — the smallest
+    shape that exercises release-on-hibernate through the registry."""
+
+    def __init__(self, params, **kw):
+        super().__init__(**kw)
+        self._backbone_handles = (
+            get_backbone("test:conv", params, forward=_feat_forward),
+        )
+
+    def update(self, value):  # noqa: D102 - feature-mean of the backbone
+        feats = self._backbone_handles[0](jnp.asarray(value)) if (
+            self._backbone_handles
+        ) else value
+        super().update(jnp.asarray(feats))
+
+
+class TestBackboneParking:
+    def test_resident_bytes_flat_while_another_holder_stays(self):
+        """Satellite pin: hibernating ONE of two same-digest tenants must
+        not move the registry's resident byte count (no re-upload either
+        way on revival)."""
+        params = _conv_params(np.random.default_rng(0))
+        svc = EvaluationService(hbm_budget_bytes=1 << 30)
+        try:
+            x = jnp.ones((2, 3, 8, 8), jnp.float32)
+            svc.register("a", _BackboneMean(params))
+            svc.register("b", _BackboneMean(params))
+            svc.submit("a", x)
+            svc.submit("b", x)
+            svc.flush()
+            single = resident_bytes()
+            assert single > 0 and len(_HANDLES) == 1
+            (handle,) = _HANDLES.values()
+            assert handle.refs == 2
+            assert svc.hibernate("a") is True
+            # "b" still resident: weights stay placed, refcount moves to parked
+            assert resident_bytes() == single
+            assert handle.refs == 1 and handle.parked == 1
+            svc.submit("a", x)  # revival: reacquire, no re-placement needed
+            svc.flush()
+            assert resident_bytes() == single
+            assert handle.refs == 2 and handle.parked == 0
+        finally:
+            svc.close()
+        assert resident_bytes() == 0 and len(_HANDLES) == 0
+
+    def test_last_holder_release_frees_hbm_and_revives(self):
+        params = _conv_params(np.random.default_rng(1))
+        svc = EvaluationService(hbm_budget_bytes=1 << 30)
+        try:
+            x = jnp.ones((2, 3, 8, 8), jnp.float32)
+            svc.register("only", _BackboneMean(params))
+            svc.submit("only", x)
+            svc.flush()
+            before = float(np.asarray(svc.compute("only")))
+            single = resident_bytes()
+            assert single > 0
+            assert svc.hibernate("only") is True
+            # the LAST holder parked: the weight tree leaves HBM entirely
+            assert resident_bytes() == 0
+            (handle,) = _HANDLES.values()
+            assert handle.refs == 0 and handle.parked == 1
+            assert handle.params is None
+            svc.submit("only", x)  # re-places from the host stash
+            svc.flush()
+            assert resident_bytes() == single
+            after = float(np.asarray(svc.compute("only")))
+            assert after == before  # same weights, same features
+        finally:
+            svc.close()
+        assert resident_bytes() == 0 and len(_HANDLES) == 0
+
+
+# ---------------------------------------------------------- census + ledger
+
+
+def _get(url, path, timeout=15):
+    with urllib.request.urlopen(url + path, timeout=timeout) as resp:
+        return resp.status, resp.headers.get("Content-Type", ""), resp.read()
+
+
+class TestCensusAndLedger:
+    def test_statusz_census_schema_pinned(self):
+        """The lifecycle additions to the /statusz contract: the service
+        stats carry a ``lifecycle`` section with pinned keys, and every
+        tenant entry carries its ``residency``."""
+        svc = EvaluationService(admin_port=0, hbm_budget_bytes=1 << 30)
+        try:
+            svc.register("hot", _acc(), buckets=[8])
+            svc.register("cold", _acc(), buckets=[8])
+            svc.submit("hot", *_batch())
+            svc.submit("cold", *_batch())
+            svc.flush()
+            assert svc.hibernate("cold") is True
+            st, ctype, body = _get(svc.admin.url, "/statusz")
+            assert st == 200 and ctype.startswith("application/json")
+            (target,) = json.loads(body)["targets"].values()
+            lc = target["stats"]["lifecycle"]
+            assert set(lc) == {
+                "resident_tenants", "hibernated_tenants", "hibernated_bytes",
+                "resident_state_bytes", "hbm_budget_bytes", "scheduled_tenants",
+                "hibernations", "revivals", "evictions",
+            }
+            assert lc["resident_tenants"] == 1 and lc["hibernated_tenants"] == 1
+            assert lc["hibernated_bytes"] > 0
+            assert target["tenants"]["hot"]["residency"] == RESIDENT
+            assert target["tenants"]["cold"]["residency"] == HIBERNATED
+        finally:
+            svc.close()
+
+    def test_gauges_track_residency(self):
+        svc = EvaluationService(hbm_budget_bytes=1 << 30)
+        label = svc._label
+        try:
+            svc.register("t", _acc(), buckets=[8])
+            resident = instruments.gauge(
+                instruments.RESIDENT_TENANTS, labels=("service",)
+            )
+            hibernated = instruments.gauge(
+                instruments.HIBERNATED_BYTES, labels=("service",)
+            )
+            assert resident.value(label) == 1
+            svc.submit("t", *_batch())
+            svc.flush()
+            assert svc.hibernate("t") is True
+            assert resident.value(label) == 0
+            assert hibernated.value(label) > 0
+            svc.submit("t", *_batch(seed=1))
+            svc.flush()
+            assert resident.value(label) == 1
+            assert hibernated.value(label) == 0
+        finally:
+            svc.close()
+
+    def test_ledger_events_exactly_once_per_transition(self):
+        ledger.enable()
+        ledger.reset()
+        svc = EvaluationService(hbm_budget_bytes=1 << 30)
+        try:
+            svc.register("t", _acc(), buckets=[8])
+            svc.submit("t", *_batch())
+            svc.flush()
+            assert svc.hibernate("t") is True
+
+            def _events(kind):
+                return [
+                    r for r in ledger.get_ledger().records if r.kind == kind
+                ]
+
+            (hib,) = _events("tenant_hibernated")
+            assert hib.tag == "t"
+            assert hib.extra["reason"] == "manual"
+            assert hib.extra["pristine"] is False and hib.extra["batches"] == 1
+            assert hib.extra["spill_bytes"] > 0
+            assert not _events("tenant_revived") and not _events("tenant_evicted")
+
+            svc.submit("t", *_batch(seed=1))
+            svc.flush()
+            (rev,) = _events("tenant_revived")
+            assert rev.tag == "t"
+            assert rev.extra["pristine"] is False
+            assert rev.extra["revive_ms"] >= 0
+            assert len(_events("tenant_hibernated")) == 1  # still exactly one
+        finally:
+            svc.close()
+
+    def test_budget_eviction_emits_tenant_evicted(self):
+        ledger.enable()
+        ledger.reset()
+        svc = EvaluationService(hbm_budget_bytes=1 << 30)
+        try:
+            svc.register("v", _acc(), buckets=[8])
+            svc.submit("v", *_batch())
+            svc.flush()
+            mgr = svc.lifecycle
+            mgr.policy = dataclasses.replace(mgr.policy, hbm_budget_bytes=1)
+            assert mgr.enforce_budget() == ["v"]
+            events = [
+                r for r in ledger.get_ledger().records if r.kind == "tenant_evicted"
+            ]
+            assert len(events) == 1
+            assert events[0].extra["reason"] == "budget"
+            assert svc.stats()["lifecycle"]["evictions"] == 1
+        finally:
+            svc.close()
+
+
+# ------------------------------------------------------------------ policy
+
+
+class TestPolicy:
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            LifecyclePolicy(idle_hibernate_after=-1.0)
+        with pytest.raises(ValueError):
+            LifecyclePolicy(hbm_budget_bytes=0)
+        with pytest.raises(ValueError):
+            LifecyclePolicy(spill_keep=0)
+        with pytest.raises(ValueError):
+            LifecyclePolicy(register_hibernated="sometimes")
+
+    def test_service_rejects_non_policy_lifecycle(self):
+        with pytest.raises(TypeError):
+            EvaluationService(lifecycle={"idle": 5})
+
+    def test_hbm_budget_kwarg_overrides_policy(self):
+        svc = EvaluationService(
+            lifecycle=LifecyclePolicy(hbm_budget_bytes=1),
+            hbm_budget_bytes=1 << 20,
+        )
+        try:
+            assert svc.lifecycle.policy.hbm_budget_bytes == 1 << 20
+        finally:
+            svc.close()

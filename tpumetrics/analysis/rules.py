@@ -39,6 +39,15 @@ TPL107 backbone-in-update      backbone construction or weight placement (``lpip
                                registry at metric construction; in a step they re-place
                                per call (or per retrace under jit).  Acquire in
                                ``__init__``, dispatch the handle in ``update()``
+TPL108 stale-residency-read    a local caching a tenant's device residency
+                               (``<tenant>.state``/``<tenant>.device_health``) used after a
+                               hibernation point (``hibernate``/``sweep_lifecycle``/
+                               ``enforce_budget``/``ensure_resident``/``revive``/
+                               ``maybe_hibernate``) without re-reading — the lifecycle
+                               manager may have spilled the tenant and dropped those
+                               device buffers between bind and use.  Hold the manager's
+                               ``residency_lock`` across read *and* use, or re-read after
+                               the point
 TPL201 divergent-collective    a collective (``sync``/``all_reduce``/``all_gather``/
                                ``flush``/…) reachable on only one branch of a rank- or
                                data-dependent conditional — the static complement of the
@@ -106,6 +115,11 @@ CATALOG: Dict[str, Tuple[str, str]] = {
     "TPL107": (
         "backbone-in-update",
         "backbone construction or pretrained-weight placement in update()-reachable code",
+    ),
+    "TPL108": (
+        "stale-residency-read",
+        "tenant device-state read cached across a hibernation point outside the "
+        "residency lock",
     ),
     "TPL201": (
         "divergent-collective",
@@ -1350,6 +1364,128 @@ class BackboneLifecycleRule:
         return False
 
 
+#: hibernation points: any of these calls may demote a tenant (or, for the
+#: budget paths, demote a *different* tenant to make room) — the spill drops
+#: the tenant's device buffers, so a residency read cached before the call is
+#: a dangling reference after it
+_TPL108_POINTS = {
+    "hibernate",
+    "sweep_lifecycle",
+    "enforce_budget",
+    "ensure_resident",
+    "revive",
+    "maybe_hibernate",
+}
+#: the per-tenant device-resident attributes whose cached reads go stale
+_TPL108_ATTRS = {"state", "device_health"}
+
+
+class ResidencyLifecycleRule:
+    """TPL108: tenant device-state read cached across a hibernation point.
+
+    The lifecycle manager (:mod:`tpumetrics.lifecycle`) may demote a tenant
+    at any hibernation point — ``hibernate``/``sweep_lifecycle``/
+    ``enforce_budget`` directly, ``ensure_resident``/``revive`` indirectly
+    (reviving one tenant can budget-evict another).  Demotion spills the
+    tenant's state and *replaces the device buffers with nothing*: a local
+    that cached ``<tenant>.state`` or ``<tenant>.device_health`` before the
+    point dangles after it — it pins freed device memory at best, computes
+    from a stale tree at worst.  The safe shapes are (a) hold the manager's
+    ``residency_lock`` across read AND use (demotion takes the same lock),
+    or (b) re-read the attribute after the point.  The lifecycle manager's
+    own modules are exempt — they ARE the residency seam."""
+
+    codes = ("TPL108",)
+
+    def check(self, mod: ModuleInfo, index: PackageIndex) -> Iterator[Finding]:
+        path = str(mod.path).replace("\\", "/")
+        if "tpumetrics/lifecycle/" in path:
+            return
+        funcs: List[FuncInfo] = list(mod.functions.values())
+        for ci in mod.classes.values():
+            funcs.extend(ci.methods.values())
+        for fi in funcs:
+            yield from self._check_func(fi, mod)
+
+    def _check_func(self, fi: FuncInfo, mod: ModuleInfo) -> Iterator[Finding]:
+        # line spans of `with <...>.residency_lock:` bodies — reads and uses
+        # inside one are serialized against demotion by construction
+        locked: List[Tuple[int, int]] = []
+        for n in ast.walk(fi.node):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    if self._terminal(item.context_expr) == "residency_lock":
+                        locked.append((n.lineno, n.end_lineno or n.lineno))
+                        break
+
+        def in_lock(line: int) -> bool:
+            return any(lo <= line <= hi for lo, hi in locked)
+
+        # every simple-name assignment, tainted iff it caches a residency
+        # attribute of a tenant-named base; later clean rebinds launder
+        binds: Dict[str, List[Tuple[int, bool, ast.expr]]] = {}
+        points: List[int] = []
+        uses: List[Tuple[str, ast.Name]] = []
+        for n in ast.walk(fi.node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and isinstance(
+                n.targets[0], ast.Name
+            ):
+                binds.setdefault(n.targets[0].id, []).append(
+                    (n.lineno, self._residency_read(n.value), n.value)
+                )
+            elif isinstance(n, ast.Call) and self._terminal(n.func) in _TPL108_POINTS:
+                points.append(n.lineno)
+            elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                uses.append((n.id, n))
+        if not points or not binds:
+            return
+
+        reported: Set[Tuple[str, int]] = set()
+        for name, node in uses:
+            history = binds.get(name)
+            if not history:
+                continue
+            prior = [b for b in history if b[0] < node.lineno]
+            if not prior:
+                continue
+            bind_line, tainted, _value = max(prior, key=lambda b: b[0])
+            if not tainted:
+                continue
+            crossed = any(bind_line < p < node.lineno for p in points)
+            if not crossed:
+                continue
+            if in_lock(bind_line) and in_lock(node.lineno):
+                continue
+            key = (name, bind_line)
+            if key in reported:
+                continue
+            reported.add(key)
+            yield Finding(
+                "TPL108",
+                f"`{name}` caches a tenant residency read (bound at line "
+                f"{bind_line}) and is used after a hibernation point: the "
+                "lifecycle manager may have spilled the tenant and dropped "
+                "its device buffers in between. Hold residency_lock across "
+                "the read and the use, or re-read after the point.",
+                mod.path, node.lineno, node.col_offset, symbol=fi.qualname,
+            )
+
+    @staticmethod
+    def _residency_read(expr: ast.expr) -> bool:
+        if not (isinstance(expr, ast.Attribute) and expr.attr in _TPL108_ATTRS):
+            return False
+        base = ResidencyLifecycleRule._terminal(expr.value)
+        return base is not None and "tenant" in base.lower()
+
+    @staticmethod
+    def _terminal(expr: ast.expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if isinstance(expr, ast.Attribute):
+            return expr.attr
+        return None
+
+
 #: the serving-layer modules whose entry points TPL106 rejects in update paths
 _TPL106_MODULES = (
     "tpumetrics.telemetry.serve",
@@ -1705,6 +1841,7 @@ RULES = [
     HostTelemetryRule(),
     HostHealthReadRule(),
     BackboneLifecycleRule(),
+    ResidencyLifecycleRule(),
     ServingLayerRule(),
     StateDeclRule(),
     ShadowStateRule(),

@@ -109,6 +109,7 @@ class BackboneHandle:
         engine: BackboneEngine,
         mesh: Optional[Any],
         dtype_policy: str,
+        placement: Optional[Dict[str, Any]] = None,
     ) -> None:
         self._reg_key = reg_key
         self.key = key
@@ -120,6 +121,14 @@ class BackboneHandle:
         self.label = f"backbones/{key}"
         self.refs = 0
         self.closed = False
+        # tenant-lifecycle parking: refs that moved resident -> parked (a
+        # hibernated tenant still owns its reference, it just does not pin
+        # HBM); when the LAST resident ref parks, the device tree is staged
+        # to a host stash and freed — reacquire() re-places it from there
+        # using the placement inputs recorded at first acquisition
+        self.parked = 0
+        self._host_params: Any = None
+        self._placement: Dict[str, Any] = dict(placement or {})
 
     def __call__(self, *args: Any) -> Any:
         """Dispatch the shared forward (see :class:`BackboneEngine`)."""
@@ -149,16 +158,95 @@ class BackboneHandle:
             for leaf in jax.tree_util.tree_leaves(self.params)
         )
 
+    def release_resident(self) -> bool:
+        """Tenant hibernation: move one reference from resident to parked.
+
+        The reference is still owned (the hibernated tenant will
+        :meth:`reacquire` on revival, or :meth:`discard_parked` if closed
+        for good while hibernated) — only HBM residency changes hands.
+        When the last RESIDENT reference parks, the device tree is fetched
+        to a host stash and freed, and the handle's program profiles are
+        released; another resident holder keeps the weights exactly where
+        they are (``resident_bytes()`` stays flat).  Returns ``True`` iff
+        THIS call released the device tree."""
+        with _LOCK:
+            if self.closed:
+                raise TPUMetricsUserError(
+                    f"Backbone handle {self.key!r} is closed; re-acquire it via get_backbone()."
+                )
+            self.refs -= 1
+            self.parked += 1
+            if self.refs > 0 or self.params is None:
+                return False
+            # the fetch runs under the registry lock: parking is a rare
+            # control-plane transition, and serializing it against
+            # reacquire() keeps stash-vs-placed states impossible to race
+            self._host_params = jax.device_get(self.params)
+            self.params = None
+        _device.release_profiles(self.label)
+        return True
+
+    def reacquire(self) -> "BackboneHandle":
+        """Tenant revival: move one parked reference back to resident,
+        re-placing the weight tree from the host stash when this is the
+        first resident holder since the park.  Pair with
+        :meth:`release_resident`."""
+        with _LOCK:
+            if self.closed:
+                raise TPUMetricsUserError(
+                    f"Backbone handle {self.key!r} is closed; re-acquire it via get_backbone()."
+                )
+            if self.parked > 0:
+                self.parked -= 1
+            self.refs += 1
+            self._ensure_placed_locked()
+        return self
+
+    def _ensure_placed_locked(self) -> None:
+        """Re-place a parked handle's weights from the host stash
+        (registry lock held)."""
+        if self.params is not None:
+            return
+        host, self._host_params = self._host_params, None
+        if host is None:
+            raise TPUMetricsUserError(
+                f"Backbone handle {self.key!r} has neither resident nor parked "
+                "weights; it was corrupted or reset mid-lifecycle."
+            )
+        self.params = place_backbone(
+            self.arch, host, mesh=self.mesh, dtype_policy=self.dtype_policy,
+            **self._placement,
+        )
+
+    def discard_parked(self) -> None:
+        """Drop one PARKED reference without reviving — a hibernated
+        tenant's metric being released for good.  The last reference
+        (resident or parked) frees the handle entirely."""
+        with _LOCK:
+            if self.closed or self.parked <= 0:
+                return
+            self.parked -= 1
+            if self.refs > 0 or self.parked > 0:
+                return
+            self.closed = True
+            _HANDLES.pop(self._reg_key, None)
+            self._host_params = None
+        self.params = None
+        _device.release_profiles(self.label)
+
     def close(self) -> None:
-        """Drop one reference; the last reference frees the weights."""
+        """Drop one reference; the last reference frees the weights.  A
+        parked reference (a hibernated tenant's claim) keeps the handle
+        registered: its host stash must survive for the revival."""
         with _LOCK:
             if self.closed:
                 return
             self.refs -= 1
-            if self.refs > 0:
+            if self.refs > 0 or self.parked > 0:
                 return
             self.closed = True
             _HANDLES.pop(self._reg_key, None)
+            self._host_params = None
         self.params = None
         _device.release_profiles(self.label)
 
@@ -243,6 +331,10 @@ def get_backbone(
         if handle is not None:
             if acquire:
                 handle.refs += 1
+            # a parked handle (every holder hibernated) re-places from its
+            # host stash before being handed out — the caller expects a
+            # dispatchable backbone
+            handle._ensure_placed_locked()
             return handle
     # placement (a device_put of the whole tree) runs OUTSIDE the lock; the
     # setdefault below resolves the rare duplicate-placement race in favor
@@ -257,7 +349,10 @@ def get_backbone(
         fwd, label=f"backbones/{public}", dtype_policy=dtype_policy,
         mesh=mesh, data_axis=data_axis, pad_axes=pad_axes,
     )
-    fresh = BackboneHandle(reg_key, public, arch, placed, engine, mesh, dtype_policy)
+    fresh = BackboneHandle(
+        reg_key, public, arch, placed, engine, mesh, dtype_policy,
+        placement=dict(rules=rules, data_axis=data_axis, model_axis=model_axis),
+    )
     with _LOCK:
         handle = _HANDLES.setdefault(reg_key, fresh)
         if acquire or handle.refs == 0:
@@ -281,6 +376,7 @@ def registry_stats() -> Dict[str, Dict[str, Any]]:
         h.key: {
             "arch": h.arch,
             "refs": h.refs,
+            "parked": h.parked,
             "bytes": h.resident_bytes(),
             "compiles": h.engine.compile_count,
             "dispatches": h.engine.dispatch_count,
@@ -298,4 +394,5 @@ def _reset_backbones() -> None:
     for h in handles:
         h.closed = True
         h.params = None
+        h._host_params = None
         _device.release_profiles(h.label)

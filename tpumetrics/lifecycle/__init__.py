@@ -1,0 +1,32 @@
+"""Tenant lifecycle: hibernation, HBM budgets, O(active) scheduling.
+
+The subsystem that lets an :class:`~tpumetrics.runtime.service.
+EvaluationService` carry millions of *mostly idle* registered streams:
+cold tenants spill to the CRC'd snapshot format and release HBM,
+instrument series, and scheduler state; hot tenants stay resident; the
+first submit after hibernation revives bit-identically.  See
+``docs/lifecycle.md`` for the residency state machine and budget
+semantics.
+"""
+
+from tpumetrics.lifecycle.manager import LifecycleManager
+from tpumetrics.lifecycle.policy import (
+    HIBERNATED,
+    HIBERNATING,
+    RESIDENT,
+    REVIVING,
+    LifecyclePolicy,
+    TenantRevivingError,
+)
+from tpumetrics.lifecycle.store import SpillStore
+
+__all__ = [
+    "HIBERNATED",
+    "HIBERNATING",
+    "RESIDENT",
+    "REVIVING",
+    "LifecycleManager",
+    "LifecyclePolicy",
+    "SpillStore",
+    "TenantRevivingError",
+]

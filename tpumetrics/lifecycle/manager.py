@@ -1,0 +1,484 @@
+"""LifecycleManager — the residency state machine over a service's tenants.
+
+One manager per :class:`~tpumetrics.runtime.service.EvaluationService`
+(constructed when the service is given a lifecycle policy, an HBM budget,
+or a spill directory).  It owns exactly one concern: WHICH tenants hold
+device state right now.  Three forces demote a tenant to the spill store:
+
+- **Idle sweep** — ``service.sweep_lifecycle()`` hibernates every tenant
+  idle past ``policy.idle_hibernate_after`` (recency is the tenant's
+  last-dispatch timestamp, stamped at submit and at batch application).
+- **Explicit demand** — ``service.hibernate(tid)`` flushes then demotes.
+- **Budget pressure** — with ``hbm_budget_bytes`` set, every byte-count
+  change (registration, batch application, revival) re-checks the
+  watermark and evicts LRU-by-last-dispatch *idle* tenants until resident
+  tenant-state bytes plus resident backbone bytes fit the budget again.
+
+Demotion cuts the tenant's state through the atomic snapshot format into
+the :class:`~tpumetrics.lifecycle.store.SpillStore`, then releases what
+the tenant pinned: device buffers, per-tenant instrument series (the
+``close()`` release set), device program profiles, and — via the backbone
+registry's refcounts — parks the metric's backbone references so the LAST
+holder's weights leave HBM too (:meth:`~tpumetrics.backbones.registry.
+BackboneHandle.release_resident`).  A hibernated tenant also leaves the
+DRR scheduler entirely: every per-dispatch pass is O(active), not
+O(registered).
+
+The first ``submit()``/``compute()``/``snapshot()`` after hibernation
+revives lazily and bit-identically — restore, re-place through the same
+donation-safe path crash-restore uses, re-enter the scheduler — while
+concurrent submitters wait on the residency condition (or get a typed
+:class:`~tpumetrics.lifecycle.policy.TenantRevivingError` under the
+``"error"`` overflow policy).
+
+Locking: the manager's **residency lock** IS the service lock (one lock,
+one ordering).  Reads of a tenant's device buffers taken outside it must
+not be cached across a hibernation point — tpulint TPL108 flags exactly
+that pattern.  All disk I/O (spill writes, restores) runs OUTSIDE the
+lock: the ``"hibernating"``/``"reviving"`` states gate the tenant while
+its bytes move, so one tenant's disk never sits in a neighbor's submit.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from tpumetrics.lifecycle.policy import (
+    HIBERNATED,
+    HIBERNATING,
+    RESIDENT,
+    REVIVING,
+    LifecyclePolicy,
+    TenantRevivingError,
+)
+from tpumetrics.lifecycle.store import SpillStore
+from tpumetrics.runtime import snapshot as _snapshot
+from tpumetrics.telemetry import instruments as _instruments
+from tpumetrics.telemetry import ledger as _telemetry
+
+__all__ = ["LifecycleManager"]
+
+_RESIDENT_GAUGE = _instruments.gauge(
+    _instruments.RESIDENT_TENANTS,
+    help="tenants currently holding device state (resident census)",
+    labels=("service",),
+)
+_HIBERNATED_GAUGE = _instruments.gauge(
+    _instruments.HIBERNATED_BYTES,
+    help="bytes of tenant state held in the spill store",
+    labels=("service",),
+)
+_REVIVAL_HIST = _instruments.histogram(
+    _instruments.REVIVAL_LATENCY_MS,
+    help="hibernated-tenant revival latency (restore + re-place)",
+    labels=("service",),
+    sketch=True,
+)
+
+
+def _tenant_state_bytes(tenant: Any) -> int:
+    """On-device bytes of one tenant's live metric state."""
+    if tenant.bucketer is not None:
+        leaves = jax.tree_util.tree_leaves(tenant.state)
+    else:
+        from tpumetrics.runtime.evaluator import _eager_state_leaves
+
+        leaves = _eager_state_leaves(tenant.metric)
+    return sum(int(getattr(leaf, "nbytes", 0) or 0) for leaf in leaves)
+
+
+def _backbone_resident_bytes() -> int:
+    from tpumetrics.backbones.registry import resident_bytes
+
+    return resident_bytes()
+
+
+class LifecycleManager:
+    """Tenant residency for one service: hibernate / revive / evict.
+
+    Constructed by :class:`~tpumetrics.runtime.service.EvaluationService`;
+    not a public entry point on its own.  All residency transitions happen
+    under :attr:`residency_lock` (the service lock), with disk I/O staged
+    outside it behind the transitional ``hibernating``/``reviving``
+    states."""
+
+    def __init__(
+        self,
+        service: Any,
+        policy: LifecyclePolicy,
+        *,
+        spill_dir: Optional[str] = None,
+    ) -> None:
+        import threading
+
+        self._service = service
+        self.policy = policy
+        self.store = SpillStore(spill_dir, keep=policy.spill_keep)
+        # the residency condition rides the SERVICE lock — one lock guards
+        # queues, counters, and residency, so there is no ordering to get
+        # wrong between them
+        self._cond = threading.Condition(service._lock)
+        self._resident_bytes = 0  # sum of per-tenant state bytes, resident only
+        self._state_bytes: Dict[str, int] = {}
+        # first-materialization state size per step token: lets register()
+        # predict whether a new same-config tenant would bust the budget
+        # without materializing it first
+        self._token_bytes: Dict[Any, int] = {}
+        self._hibernated = 0
+        self.hibernations = 0
+        self.revivals = 0
+        self.evictions = 0
+
+    # ------------------------------------------------------------------ lock
+
+    @property
+    def residency_lock(self):
+        """The lock every residency transition (and every safe read of a
+        tenant's device buffers near a hibernation point) runs under —
+        the service lock itself."""
+        return self._service._lock
+
+    # ------------------------------------------------------------ accounting
+
+    def _publish_gauges_locked(self) -> None:
+        label = self._service._label
+        _RESIDENT_GAUGE.set(len(self._service._tenants) - self._hibernated, label)
+        _HIBERNATED_GAUGE.set(self.store.total_bytes(), label)
+
+    def _account_resident_locked(self, tenant: Any) -> None:
+        current = _tenant_state_bytes(tenant)
+        self._resident_bytes += current - self._state_bytes.get(tenant.tid, 0)
+        self._state_bytes[tenant.tid] = current
+        if tenant.bucketer is not None and tenant.step_token not in self._token_bytes:
+            self._token_bytes[tenant.step_token] = current
+
+    def _over_budget_locked(self) -> bool:
+        budget = self.policy.hbm_budget_bytes
+        if budget is None:
+            return False
+        return self._resident_bytes + _backbone_resident_bytes() > budget
+
+    # ---------------------------------------------------------- registration
+
+    def starts_hibernated(self, step_token: Any) -> bool:
+        """Whether a new tenant of this step should be created directly in
+        the ``hibernated`` state (pristine — no device allocation, no
+        scheduler entry).  True only under ``register_hibernated="auto"``
+        with a saturated budget AND a known state size for the step (the
+        first tenant of any config always materializes, which is what
+        records the size)."""
+        if self.policy.register_hibernated != "auto":
+            return False
+        budget = self.policy.hbm_budget_bytes
+        if budget is None:
+            return False
+        with self.residency_lock:
+            known = self._token_bytes.get(step_token)
+            if known is None:
+                return False
+            return self._resident_bytes + known + _backbone_resident_bytes() > budget
+
+    def on_register_locked(self, tenant: Any, *, hibernated: bool) -> None:
+        """Adopt a freshly registered tenant into the residency census
+        (service lock held).  ``hibernated=True`` is the pristine start:
+        nothing was materialized and there is nothing to spill — revival
+        is a fresh ``init_state()``."""
+        tenant.last_dispatch = time.monotonic()
+        if hibernated:
+            tenant.residency = HIBERNATED
+            tenant.released = True  # no series minted while hibernated
+            self._hibernated += 1
+            self.hibernations += 1
+        else:
+            tenant.residency = RESIDENT
+            self._account_resident_locked(tenant)
+        self._publish_gauges_locked()
+
+    # ------------------------------------------------------------- demotion
+
+    def hibernate(self, tenant_id: str, *, reason: str = "idle") -> bool:
+        """Demote one idle tenant to the spill store.  Returns ``False``
+        when the tenant cannot hibernate right now (queued/in-flight work,
+        quarantine, an in-progress transition, or a draining service) —
+        demotion is opportunistic, never forced."""
+        svc = self._service
+        with self._cond:
+            tenant = svc._tenants.get(tenant_id)
+            if tenant is None:
+                raise KeyError(f"unknown tenant {tenant_id!r}")
+            if (
+                tenant.residency != RESIDENT
+                or tenant.error is not None
+                or tenant.queue
+                or tenant.pending
+                or svc._draining
+            ):
+                return False
+            tenant.residency = HIBERNATING
+            # a tenant that never applied a batch has nothing worth a file:
+            # revival is a fresh init_state() (exactly what it holds now)
+            pristine = tenant.batches == 0 and not tenant.journal
+        # ---- outside the lock: the "hibernating" state gates the tenant
+        # (its queue is empty, new submits wait on the residency condition),
+        # so the cut, the series release, and the backbone parking cannot
+        # race a dispatch or a revival
+        try:
+            if not pristine:
+                payload: Any = (
+                    tenant.state
+                    if tenant.bucketer is not None
+                    else tenant.metric.snapshot_state()
+                )
+                meta = {
+                    "batches": tenant.batches,
+                    "items": tenant.items,
+                    "metric": type(tenant.metric).__name__,
+                    "mode": "bucketed" if tenant.bucketer is not None else "eager",
+                    "degraded": tenant.degraded,
+                    "tenant": tenant.tid,
+                }
+                self.store.spill(
+                    tenant.tid, payload, meta, guard_non_finite=tenant.guard_non_finite
+                )
+        except BaseException:
+            with self._cond:
+                tenant.residency = RESIDENT
+                self._cond.notify_all()
+            raise
+        svc._release_tenant_series(tenant)
+        if tenant.bucketer is None:
+            tenant.metric.reset()  # eager states live on the metric itself
+        park = getattr(tenant.metric, "hibernate_backbones", None)
+        if callable(park):
+            park()
+        with self._cond:
+            tenant.state = None
+            tenant.device_health = None
+            svc._drr.remove(tenant.tid)
+            tenant.residency = HIBERNATED
+            self._resident_bytes -= self._state_bytes.pop(tenant.tid, 0)
+            self._hibernated += 1
+            if reason == "budget":
+                self.evictions += 1
+            else:
+                self.hibernations += 1
+            self._publish_gauges_locked()
+            self._cond.notify_all()
+        with _telemetry.attribution(tenant.tid):
+            _telemetry.record_event(
+                svc,
+                "tenant_evicted" if reason == "budget" else "tenant_hibernated",
+                reason=reason,
+                pristine=pristine,
+                batches=tenant.batches,
+                spill_bytes=self.store.bytes_for(tenant.tid),
+            )
+        return True
+
+    def sweep(self, *, idle_for: Optional[float] = None) -> List[str]:
+        """Hibernate every resident tenant idle past the threshold
+        (``idle_for`` overrides ``policy.idle_hibernate_after``); returns
+        the demoted tenant ids."""
+        threshold = self.policy.idle_hibernate_after if idle_for is None else idle_for
+        if threshold is None:
+            return []
+        now = time.monotonic()
+        with self.residency_lock:
+            candidates = [
+                t.tid
+                for t in self._service._tenants.values()
+                if t.residency == RESIDENT
+                and t.error is None
+                and not t.queue
+                and t.pending == 0
+                and now - t.last_dispatch >= threshold
+            ]
+        return [tid for tid in candidates if self.hibernate(tid, reason="idle")]
+
+    def enforce_budget(self) -> List[str]:
+        """Evict LRU-by-last-dispatch idle tenants until resident state +
+        backbone bytes fit ``hbm_budget_bytes``; returns evicted ids."""
+        if self.policy.hbm_budget_bytes is None:
+            return []
+        evicted: List[str] = []
+        tried: set = set()
+        while True:
+            with self.residency_lock:
+                if not self._over_budget_locked():
+                    break
+                candidates = [
+                    t
+                    for t in self._service._tenants.values()
+                    if t.residency == RESIDENT
+                    and t.error is None
+                    and not t.queue
+                    and t.pending == 0
+                    and t.tid not in tried
+                ]
+                if not candidates:
+                    break  # everything left is busy: nothing safe to evict
+                victim = min(candidates, key=lambda t: t.last_dispatch).tid
+            tried.add(victim)
+            if self.hibernate(victim, reason="budget"):
+                evicted.append(victim)
+        return evicted
+
+    def after_batch(self, tenant: Any) -> None:
+        """Worker-side accounting hook after one applied batch: refresh the
+        tenant's byte count and re-check the budget."""
+        with self.residency_lock:
+            if tenant.residency != RESIDENT:
+                return
+            self._account_resident_locked(tenant)
+            over = self._over_budget_locked()
+        if over:
+            self.enforce_budget()
+
+    # -------------------------------------------------------------- revival
+
+    def ensure_resident(self, tenant: Any) -> None:
+        """Make the tenant resident, reviving it when hibernated (restore
+        -> re-place -> re-enter the scheduler).  The FIRST caller over a
+        hibernated tenant becomes the reviver; concurrent callers wait on
+        the residency condition — or, under the tenant's ``"error"``
+        overflow policy, get a typed :class:`TenantRevivingError` refusal
+        instead of blocking."""
+        if tenant.residency == RESIDENT:
+            return  # racy fast path; mutating callers re-check under the lock
+        svc = self._service
+        with self._cond:
+            while True:
+                residency = tenant.residency
+                if residency == RESIDENT:
+                    return
+                if residency == HIBERNATED:
+                    break
+                # hibernating / reviving: another thread owns the transition
+                if tenant.policy == "error":
+                    raise TenantRevivingError(
+                        f"Tenant {tenant.tid!r} is {residency} (lifecycle transition in "
+                        "progress) under policy='error'; retry once it is resident."
+                    )
+                self._cond.wait()
+            tenant.residency = REVIVING
+            self._hibernated -= 1
+        t0 = time.perf_counter()
+        try:
+            state, pristine = self._restore(tenant)
+            revive = getattr(tenant.metric, "revive_backbones", None)
+            if callable(revive):
+                revive()
+        except BaseException:
+            with self._cond:
+                tenant.residency = HIBERNATED
+                self._hibernated += 1
+                self._cond.notify_all()
+            raise
+        with tenant.health_lock:
+            tenant.released = False  # series re-mint on the next observation
+        self.store.discard(tenant.tid)  # the resident state supersedes the cut
+        with self._cond:
+            if tenant.bucketer is not None:
+                tenant.state = state
+            tenant.last_dispatch = time.monotonic()
+            self._account_resident_locked(tenant)
+            svc._drr.add(tenant.tid, tenant.quota)
+            tenant.residency = RESIDENT
+            self.revivals += 1
+            self._publish_gauges_locked()
+            self._cond.notify_all()
+        revive_ms = (time.perf_counter() - t0) * 1e3
+        if _instruments.enabled():
+            _REVIVAL_HIST.observe(revive_ms, svc._label)
+        with _telemetry.attribution(tenant.tid):
+            _telemetry.record_event(
+                svc,
+                "tenant_revived",
+                pristine=pristine,
+                batches=tenant.batches,
+                revive_ms=round(revive_ms, 3),
+            )
+
+    def _restore(self, tenant: Any):
+        """Load the newest cut and re-place it (bucketed: the donation-safe
+        ``step.place`` path crash-restore uses; eager: the template-free
+        skeleton restore into ``load_snapshot_state``).  No cut means a
+        pristine hibernation: revival is a fresh state."""
+        if tenant.bucketer is not None:
+            got = self.store.load(
+                tenant.tid,
+                template=tenant.step._metric.init_state(),
+                annotations=_snapshot.state_annotations(tenant.step._metric),
+            )
+        else:
+            got = self.store.load(tenant.tid)
+        if got is None:
+            if tenant.batches:
+                raise _snapshot.SnapshotIntegrityError(
+                    f"Tenant {tenant.tid!r} hibernated at stream position "
+                    f"{tenant.batches} but its spill store holds no cut "
+                    "(deleted or lost?): the stream cannot resume bit-identically."
+                )
+            if tenant.bucketer is not None:
+                return tenant.step.init_state(), True
+            tenant.metric.reset()
+            return None, True
+        payload, header = got
+        stored = int(header["meta"].get("batches", -1))
+        if stored != tenant.batches:
+            raise _snapshot.SnapshotIntegrityError(
+                f"Tenant {tenant.tid!r} hibernated at stream position "
+                f"{tenant.batches} but its newest cut covers position {stored}: "
+                "the spill store was cross-contaminated or rolled back."
+            )
+        if tenant.bucketer is not None:
+            return tenant.step.place(payload), False
+        from tpumetrics.runtime.evaluator import _as_snapshot_payload
+
+        tenant.metric.load_snapshot_state(_as_snapshot_payload(payload))
+        return None, False
+
+    # ---------------------------------------------------------------- stats
+
+    def stats_locked(self) -> Dict[str, Any]:
+        """Lifecycle section of ``service.stats()`` (service lock held)."""
+        return {
+            "resident_tenants": len(self._service._tenants) - self._hibernated,
+            "hibernated_tenants": self._hibernated,
+            "hibernated_bytes": self.store.total_bytes(),
+            "resident_state_bytes": self._resident_bytes,
+            "hbm_budget_bytes": self.policy.hbm_budget_bytes,
+            "scheduled_tenants": len(self._service._drr),
+            "hibernations": self.hibernations,
+            "revivals": self.revivals,
+            "evictions": self.evictions,
+        }
+
+    @staticmethod
+    def stats_default() -> Dict[str, Any]:
+        """Zero-valued lifecycle section for the never-blocking stats()
+        fallback (contended lock)."""
+        return {
+            "resident_tenants": 0,
+            "hibernated_tenants": 0,
+            "hibernated_bytes": 0,
+            "resident_state_bytes": 0,
+            "hbm_budget_bytes": None,
+            "scheduled_tenants": 0,
+            "hibernations": 0,
+            "revivals": 0,
+            "evictions": 0,
+        }
+
+    def close(self) -> None:
+        """Release this manager's instrument series and its spill root (the
+        service's close contract: a construct-per-job process must not grow
+        dead series or spill directories)."""
+        label = self._service._label
+        _RESIDENT_GAUGE.remove(label)
+        _HIBERNATED_GAUGE.remove(label)
+        _REVIVAL_HIST.remove(label)
+        self.store.close()

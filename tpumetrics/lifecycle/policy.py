@@ -1,0 +1,115 @@
+"""Lifecycle policy: the knobs that decide WHEN a tenant leaves HBM.
+
+The reference library has no notion of stream lifetime — a Metric's state
+lives exactly as long as the Python object.  At service scale ("millions
+of users", ROADMAP item 2) that model pins device buffers, instrument
+series, and scheduler state for every stream ever registered, active or
+not.  :class:`LifecyclePolicy` is the declarative half of the fix: it
+names the idle threshold past which a cold tenant is demoted to the spill
+store, the HBM budget proactive eviction defends, and how registration
+behaves once the budget is already saturated.  The imperative half — the
+residency state machine — lives in
+:class:`~tpumetrics.lifecycle.manager.LifecycleManager`.
+
+Residency states (per tenant, guarded by the manager's residency lock):
+
+- ``"resident"``     — state on device, tenant in the DRR ring when it has
+  queued work.  The only state in which batches apply.
+- ``"hibernating"``  — a demotion in progress: the state cut is being
+  written to the spill store.  Intake is gated exactly like a full queue.
+- ``"hibernated"``   — state lives in the spill store (or nowhere, for a
+  pristine tenant that never applied a batch); device buffers, per-tenant
+  instrument series, and last-holder backbone references are released.
+  The tenant has left the scheduler entirely.
+- ``"reviving"``     — the first ``submit()``/``compute()`` after
+  hibernation is restoring + re-placing the cut; concurrent submitters
+  block (policy ``"block"``/``"drop_oldest"``) or get a typed
+  :class:`TenantRevivingError` (policy ``"error"``).
+
+``resident -> hibernating -> hibernated -> reviving -> resident`` is the
+only cycle; every transition is exactly-once observable via the ledger
+events ``tenant_hibernated`` / ``tenant_evicted`` / ``tenant_revived``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+__all__ = [
+    "HIBERNATED",
+    "HIBERNATING",
+    "RESIDENT",
+    "REVIVING",
+    "LifecyclePolicy",
+    "TenantRevivingError",
+]
+
+# residency state constants (string-valued so they serialize into stats()
+# and /statusz census payloads as-is)
+RESIDENT = "resident"
+HIBERNATING = "hibernating"
+HIBERNATED = "hibernated"
+REVIVING = "reviving"
+
+
+class TenantRevivingError(TPUMetricsUserError):
+    """The tenant is mid-revival (restore -> re-place -> resume) and its
+    backpressure policy is ``"error"``: the submit is refused rather than
+    blocked, exactly like a full queue under the same policy.  Retry once
+    the revival completes (``TenantHandle.stats()["residency"]`` flips
+    back to ``"resident"``)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class LifecyclePolicy:
+    """Declarative residency policy for an :class:`~tpumetrics.runtime.
+    service.EvaluationService`.
+
+    Args:
+        idle_hibernate_after: seconds of last-dispatch idleness after which
+            ``sweep_lifecycle()`` demotes a tenant to the spill store;
+            ``None`` disables the time-based sweep (explicit
+            ``hibernate()`` and budget-driven eviction still work).
+        hbm_budget_bytes: ceiling on resident tenant-state bytes plus
+            resident backbone bytes.  When set, the manager proactively
+            evicts LRU-by-last-dispatch idle tenants to keep the watermark
+            under budget no matter how many tenants register, and
+            registration itself may start a tenant pre-hibernated
+            (``register_hibernated="auto"``) once the budget is saturated.
+            ``None`` disables budget-driven eviction.
+        spill_keep: spill files retained per tenant (older cuts are pruned
+            after each successful spill — the ``gc_cuts`` retention
+            contract, so hibernate/revive churn never accumulates files).
+        register_hibernated: ``"auto"`` (default) lets ``register()``
+            create a tenant directly in the ``"hibernated"`` state — no
+            device allocation, no scheduler entry — when the budget is
+            already saturated and the step's state size is known from a
+            previous materialization.  Registration of mostly-idle fleets
+            then costs O(1) per tenant.  ``"never"`` always materializes
+            (the budget evicts afterwards instead).
+    """
+
+    idle_hibernate_after: Optional[float] = None
+    hbm_budget_bytes: Optional[int] = None
+    spill_keep: int = 1
+    register_hibernated: str = "auto"
+
+    def __post_init__(self) -> None:
+        if self.idle_hibernate_after is not None and not self.idle_hibernate_after >= 0:
+            raise ValueError(
+                f"idle_hibernate_after must be >= 0 or None, got {self.idle_hibernate_after}"
+            )
+        if self.hbm_budget_bytes is not None and int(self.hbm_budget_bytes) <= 0:
+            raise ValueError(
+                f"hbm_budget_bytes must be positive or None, got {self.hbm_budget_bytes}"
+            )
+        if int(self.spill_keep) < 1:
+            raise ValueError(f"spill_keep must be >= 1, got {self.spill_keep}")
+        if self.register_hibernated not in ("auto", "never"):
+            raise ValueError(
+                "register_hibernated must be 'auto' or 'never', "
+                f"got {self.register_hibernated!r}"
+            )

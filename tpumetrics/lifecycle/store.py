@@ -1,0 +1,157 @@
+"""Per-service spill store: hibernation cuts in the atomic snapshot format.
+
+A hibernating tenant's state is cut through the exact
+:mod:`~tpumetrics.runtime.snapshot` format the crash-restore path already
+trusts — write-temp -> fsync -> rename, CRC32 over the leaf bytes, a JSON
+header carrying the state spec (and the structure skeleton, so eager
+payloads restore template-free).  What differs from a
+:class:`~tpumetrics.runtime.snapshot.SnapshotManager` directory is the
+*key*: a tenant can hibernate repeatedly at the SAME stream position
+(hibernate -> revive -> hibernate with no batch in between), so cuts are
+numbered by a per-tenant monotonic **spill sequence**, not the batch
+position (which rides in the meta instead).
+
+Retention is the ``gc_cuts`` contract: each successful spill prunes the
+tenant's older cuts down to ``keep``, and a revival *discards* its spill
+outright (the resident state supersedes it) — hibernate/revive churn
+therefore never accumulates files.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+import shutil
+import tempfile
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from tpumetrics.runtime import snapshot as _snapshot
+
+__all__ = ["SpillStore"]
+
+
+def _safe_dirname(tenant_id: str) -> str:
+    """Filesystem-safe per-tenant directory name: printable slug + a short
+    content digest so two ids that slug identically never share a dir."""
+    slug = re.sub(r"[^A-Za-z0-9._-]", "_", tenant_id)[:80]
+    digest = hashlib.sha1(tenant_id.encode()).hexdigest()[:10]
+    return f"{slug}-{digest}"
+
+
+class SpillStore:
+    """Atomic, CRC'd, retention-bounded spill files for hibernated tenants.
+
+    Args:
+        root: spill root directory (one subdirectory per tenant).  ``None``
+            creates a private temporary root that :meth:`close` removes —
+            the default for services that treat hibernation as a pure HBM
+            release (cuts need not outlive the process).
+        keep: spill files retained per tenant after each successful spill.
+    """
+
+    def __init__(self, root: Optional[str] = None, *, keep: int = 1) -> None:
+        self._owned = root is None
+        self.root = root if root is not None else tempfile.mkdtemp(prefix="tpumetrics-spill-")
+        os.makedirs(self.root, exist_ok=True)
+        self.keep = max(1, int(keep))
+        self._lock = threading.Lock()
+        self._seq: Dict[str, int] = {}  # tenant id -> last spill sequence
+        self._bytes: Dict[str, int] = {}  # tenant id -> newest spill file size
+        self.spills = 0
+        self.discards = 0
+
+    def _dir(self, tenant_id: str) -> str:
+        return os.path.join(self.root, _safe_dirname(tenant_id))
+
+    def _next_seq(self, tenant_id: str, directory: str) -> int:
+        with self._lock:
+            last = self._seq.get(tenant_id)
+            if last is None:
+                # adopt whatever a previous process left behind so the
+                # sequence stays monotonic across restarts
+                existing = _snapshot.list_snapshots(directory)
+                last = existing[-1][0] if existing else 0
+            nxt = last + 1
+            self._seq[tenant_id] = nxt
+        return nxt
+
+    def spill(
+        self,
+        tenant_id: str,
+        payload: Any,
+        meta: Dict[str, Any],
+        *,
+        guard_non_finite: str = "off",
+    ) -> str:
+        """Atomically persist one hibernation cut; prunes older cuts down
+        to ``keep`` and returns the final path."""
+        directory = self._dir(tenant_id)
+        seq = self._next_seq(tenant_id, directory)
+        meta = dict(meta)
+        meta["spill_seq"] = seq
+        path = _snapshot.save_snapshot(
+            directory, seq, payload, meta=meta, guard_non_finite=guard_non_finite
+        )
+        for _, old in _snapshot.list_snapshots(directory)[: -self.keep]:
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
+        size = 0
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            pass
+        with self._lock:
+            self._bytes[tenant_id] = size
+            self.spills += 1
+        return path
+
+    def load(
+        self,
+        tenant_id: str,
+        *,
+        template: Any = None,
+        annotations: Optional[Dict[str, str]] = None,
+    ) -> Optional[Tuple[Any, Dict[str, Any]]]:
+        """Restore the tenant's newest valid cut -> ``(payload, header)``,
+        or ``None`` when no cut exists (a pristine hibernation).  With a
+        ``template`` the payload is validated + unflattened against it
+        (bucketed states); without one the stored skeleton rebuilds the
+        structure (eager ``snapshot_state`` payloads)."""
+        directory = self._dir(tenant_id)
+        if template is not None:
+            return _snapshot.restore_latest(directory, template, annotations=annotations)
+        return _snapshot.restore_latest_reconstruct(directory)
+
+    def discard(self, tenant_id: str) -> None:
+        """Drop every cut the tenant holds — the revival supersession: the
+        freshly re-placed resident state is now the single source of truth.
+        The sequence counter survives so a later hibernation stays
+        monotonic."""
+        directory = self._dir(tenant_id)
+        shutil.rmtree(directory, ignore_errors=True)
+        with self._lock:
+            if self._bytes.pop(tenant_id, None) is not None:
+                self.discards += 1
+
+    def bytes_for(self, tenant_id: str) -> int:
+        with self._lock:
+            return self._bytes.get(tenant_id, 0)
+
+    def total_bytes(self) -> int:
+        """Newest-cut bytes summed over every hibernated tenant — the
+        ``tpumetrics_hibernated_bytes`` gauge's value."""
+        with self._lock:
+            return sum(self._bytes.values())
+
+    def file_count(self, tenant_id: str) -> int:
+        """Spill files currently on disk for the tenant (retention tests)."""
+        return len(_snapshot.list_snapshots(self._dir(tenant_id)))
+
+    def close(self) -> None:
+        """Remove the spill root when this store owns it (temporary root)."""
+        if self._owned:
+            shutil.rmtree(self.root, ignore_errors=True)

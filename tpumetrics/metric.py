@@ -1043,6 +1043,42 @@ class Metric(ABC):
         handles, self._backbone_handles = getattr(self, "_backbone_handles", ()), ()
         for h in handles:
             h.close()
+        parked, self._parked_backbone_handles = (
+            getattr(self, "_parked_backbone_handles", ()), (),
+        )
+        for h in parked:
+            h.discard_parked()
+
+    def hibernate_backbones(self) -> None:
+        """Park this metric's backbone references for tenant hibernation.
+
+        The references stay owned (``_parked_backbone_handles``) so a later
+        :meth:`release_backbones` still settles them, but they no longer
+        pin HBM: when the hibernating tenant was the LAST resident holder
+        of a weight set, :meth:`~tpumetrics.backbones.registry.
+        BackboneHandle.release_resident` stages the weights to host and
+        frees the device tree.  Idempotent; reversed by
+        :meth:`revive_backbones`."""
+        handles = getattr(self, "_backbone_handles", ())
+        if not handles:
+            return
+        self._parked_backbone_handles = handles
+        self._backbone_handles = ()
+        for h in handles:
+            h.release_resident()
+
+    def revive_backbones(self) -> None:
+        """Un-park this metric's backbone references on tenant revival —
+        re-placing a weight set only when every holder had hibernated (a
+        surviving resident holder means no re-upload happens).  Idempotent;
+        the inverse of :meth:`hibernate_backbones`."""
+        handles = getattr(self, "_parked_backbone_handles", ())
+        if not handles:
+            return
+        for h in handles:
+            h.reacquire()
+        self._backbone_handles = handles
+        self._parked_backbone_handles = ()
 
     # ------------------------------------------------------------ persistence
 

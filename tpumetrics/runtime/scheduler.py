@@ -129,6 +129,16 @@ class DeficitRoundRobin:
     def active(self) -> int:
         return len(self._ring)
 
+    def __len__(self) -> int:
+        """Scheduled tenants (resident membership, active or not) — the
+        lifecycle manager's O(active) census: hibernated tenants are
+        removed entirely, so this tracks residents, never the registered
+        total."""
+        return len(self._quantum)
+
+    def __contains__(self, tid: Any) -> bool:
+        return tid in self._quantum
+
     # ------------------------------------------------------------ scheduling
 
     def select(self, head_cost: Callable[[Any], Optional[float]]) -> Optional[Any]:

@@ -213,6 +213,14 @@ class _Tenant:
         self.restores = 0
         self.flight_path: Optional[str] = None  # quarantine's flight dump
 
+        # tenant lifecycle (lifecycle/manager.py): residency state machine
+        # + the last-dispatch recency hibernation and LRU eviction key off.
+        # Both guarded by the service lock (== the manager's residency
+        # lock); without a lifecycle manager the tenant stays "resident"
+        # forever and only the timestamp is maintained.
+        self.residency = "resident"
+        self.last_dispatch = time.monotonic()
+
         # device-side observability (health probe + HBM watermark); the
         # alerted set doubles as the minted health-label ledger close()
         # releases, guarded by health_lock (one state_health per corruption)
@@ -301,6 +309,19 @@ class EvaluationService:
             ephemeral port, read back from ``service.admin.port``) — the
             live ``/metrics`` / ``/healthz`` / ``/statusz`` plane over
             every tenant, stopped by ``close()``.
+        lifecycle: a :class:`~tpumetrics.lifecycle.policy.LifecyclePolicy`
+            enabling the tenant lifecycle manager: cold tenants hibernate
+            to a per-service spill store (releasing device buffers,
+            instrument series, and last-holder backbone references) and
+            revive bit-identically on their next submit.  See
+            ``docs/lifecycle.md``.
+        hbm_budget_bytes: shorthand for a lifecycle policy with a budget —
+            proactive LRU eviction keeps resident tenant-state + backbone
+            bytes under this ceiling no matter how many tenants register.
+            Combines with ``lifecycle=`` (the explicit budget wins).
+        spill_dir: spill-store root for hibernation cuts (enables the
+            lifecycle manager); default is a private temporary directory
+            removed by ``close()``.
 
     Register tenants with :meth:`register`; each returns a
     :class:`TenantHandle`.  The module docstring describes the sharing
@@ -316,6 +337,9 @@ class EvaluationService:
         compile_cache_dir: Optional[str] = None,
         name: str = "EvaluationService",
         admin_port: Optional[int] = None,
+        lifecycle: Optional[Any] = None,
+        hbm_budget_bytes: Optional[int] = None,
+        spill_dir: Optional[str] = None,
     ) -> None:
         if int(megabatch_max_group) < 2:
             raise ValueError(
@@ -347,6 +371,24 @@ class EvaluationService:
         self._stats_cache: Dict[str, Any] = {}  # never-blocking stats() fallback
         self._tenant_ids_cache: List[str] = []  # never-blocking census fallback
         self._label = f"{name}#{next(_SERVICE_IDS)}"
+        # tenant lifecycle: any of the three knobs arms the manager (the
+        # import is lazy — services without lifecycle pay nothing)
+        self._lifecycle = None
+        if lifecycle is not None or hbm_budget_bytes is not None or spill_dir is not None:
+            import dataclasses
+
+            from tpumetrics.lifecycle import LifecycleManager, LifecyclePolicy
+
+            policy = lifecycle if lifecycle is not None else LifecyclePolicy()
+            if not isinstance(policy, LifecyclePolicy):
+                raise TypeError(
+                    f"lifecycle must be a LifecyclePolicy, got {type(policy)}"
+                )
+            if hbm_budget_bytes is not None:
+                policy = dataclasses.replace(
+                    policy, hbm_budget_bytes=int(hbm_budget_bytes)
+                )
+            self._lifecycle = LifecycleManager(self, policy, spill_dir=spill_dir)
         self._dispatcher = AsyncDispatcher(
             self._drain, max_queue=max_tokens, policy="block", name=name,
             instrument_label=self._label,
@@ -461,6 +503,7 @@ class EvaluationService:
             bucketer = step = None
             state = None
             step_token: Any = ("eager", tenant_id)
+            start_hibernated = False
         else:
             edges = pow2_bucket_edges(int(buckets)) if isinstance(buckets, int) else tuple(buckets)
             bucketer = ShapeBucketer(edges)
@@ -470,7 +513,16 @@ class EvaluationService:
                 partition_rules=partition_rules, data_axis=data_axis,
                 tenant_id=tenant_id, health_probe=bool(health_probe),
             )
-            state = step.init_state()
+            # pristine hibernated start: once the HBM budget is saturated
+            # and the step's state size is known, a new same-config tenant
+            # is created with NO device allocation and NO scheduler entry —
+            # registration of a mostly-idle fleet is O(1) per tenant, and
+            # its first submit revives it (a fresh init_state) lazily
+            start_hibernated = (
+                self._lifecycle is not None
+                and self._lifecycle.starts_hibernated(step_token)
+            )
+            state = None if start_hibernated else step.init_state()
 
         snapshots = (
             _snapshot.SnapshotManager(snapshot_dir, keep=keep_snapshots)
@@ -498,11 +550,25 @@ class EvaluationService:
                 )
             if tenant_id in self._tenants:
                 raise ValueError(f"tenant {tenant_id!r} is already registered")
-            # the scheduler joins FIRST: a failure here must not publish a
-            # half-registered zombie tenant
-            self._drr.add(tenant_id, quota)
+            if not start_hibernated:
+                # the scheduler joins FIRST: a failure here must not publish
+                # a half-registered zombie tenant (a hibernated start joins
+                # the scheduler on revival instead)
+                self._drr.add(tenant_id, quota)
             self._tenants[tenant_id] = tenant
+            if self._lifecycle is not None:
+                self._lifecycle.on_register_locked(tenant, hibernated=start_hibernated)
             _TENANTS_GAUGE.set(len(self._tenants) - self._quarantines, self._label)
+        if start_hibernated:
+            with _telemetry.attribution(tenant_id):
+                _telemetry.record_event(
+                    self, "tenant_hibernated", reason="register_budget",
+                    pristine=True, batches=0, spill_bytes=0,
+                )
+        elif self._lifecycle is not None:
+            # a materialized registration can push the watermark over the
+            # budget: evict LRU idle tenants back under it proactively
+            self._lifecycle.enforce_budget()
         return TenantHandle(self, tenant_id)
 
     def _resolve_step(
@@ -596,43 +662,20 @@ class EvaluationService:
         qspan = _spans.start_span("queue_wait", parent=root) if root is not None else None
         entry = (tuple(args), max(int(n), 1), probe, (root, qspan))
         try:
-            with self._lock:
-                self._raise_if_quarantined(tenant)
-                if len(tenant.queue) >= tenant.max_queue:
-                    if tenant.policy == "error":
-                        from tpumetrics.runtime.dispatch import QueueFullError
-
-                        raise QueueFullError(
-                            f"Tenant {tenant_id!r} queue full ({tenant.max_queue} batches) "
-                            "under policy='error'."
-                        )
-                    if tenant.policy == "drop_oldest":
-                        _, _, _, (d_root, d_qspan) = tenant.queue.popleft()
-                        _spans.end_span(d_qspan, dropped=True)
-                        _spans.end_span(d_root, error="dropped (drop_oldest)")
-                        tenant.pending -= 1
-                        tenant.dropped += 1
-                        with _telemetry.attribution(tenant_id):
-                            _telemetry.record_event(
-                                self, "runtime_drop", dropped_total=tenant.dropped
-                            )
-                    else:  # block
-                        while len(tenant.queue) >= tenant.max_queue:
-                            self._raise_if_quarantined(tenant)
-                            if self._draining:
-                                from tpumetrics.runtime.drain import DrainingError
-
-                                raise DrainingError(
-                                    f"EvaluationService {self._label!r} began draining "
-                                    f"while tenant {tenant_id!r} waited for queue "
-                                    "space: intake is closed."
-                                )
-                            self._space.wait()
-                tenant.queue.append(entry)
-                tenant.pending += 1
-                tenant.enqueued += 1
-                self._drr.activate(tenant_id)
-                self._mark_ready(tenant)
+            while True:
+                if self._lifecycle is not None and tenant.residency != "resident":
+                    # the FIRST submit over a hibernated tenant revives it
+                    # (restore -> re-place -> re-enter the scheduler);
+                    # concurrent submitters wait on the residency condition
+                    # or get a typed refusal per the tenant's policy
+                    self._lifecycle.ensure_resident(tenant)
+                with self._lock:
+                    if self._lifecycle is not None and tenant.residency != "resident":
+                        # an idle sweep won the race between revival and
+                        # enqueue: revive again before enqueueing
+                        continue
+                    self._submit_locked(tenant, entry)
+                break
             self._dispatcher.submit(tenant_id, tag=tenant_id)
             # successful submits only: a quarantined/full-queue failure must
             # not pollute the distribution or re-mint a released series
@@ -642,6 +685,48 @@ class EvaluationService:
             _spans.end_span(qspan, error=repr(err))
             _spans.end_span(root, error=repr(err))
             raise
+
+    def _submit_locked(self, tenant: _Tenant, entry: Tuple[Any, ...]) -> None:
+        """The enqueue body of :meth:`submit` (service lock held): the
+        tenant's backpressure policy, then queue + scheduler bookkeeping."""
+        tenant_id = tenant.tid
+        self._raise_if_quarantined(tenant)
+        if len(tenant.queue) >= tenant.max_queue:
+            if tenant.policy == "error":
+                from tpumetrics.runtime.dispatch import QueueFullError
+
+                raise QueueFullError(
+                    f"Tenant {tenant_id!r} queue full ({tenant.max_queue} batches) "
+                    "under policy='error'."
+                )
+            if tenant.policy == "drop_oldest":
+                _, _, _, (d_root, d_qspan) = tenant.queue.popleft()
+                _spans.end_span(d_qspan, dropped=True)
+                _spans.end_span(d_root, error="dropped (drop_oldest)")
+                tenant.pending -= 1
+                tenant.dropped += 1
+                with _telemetry.attribution(tenant_id):
+                    _telemetry.record_event(
+                        self, "runtime_drop", dropped_total=tenant.dropped
+                    )
+            else:  # block
+                while len(tenant.queue) >= tenant.max_queue:
+                    self._raise_if_quarantined(tenant)
+                    if self._draining:
+                        from tpumetrics.runtime.drain import DrainingError
+
+                        raise DrainingError(
+                            f"EvaluationService {self._label!r} began draining "
+                            f"while tenant {tenant_id!r} waited for queue "
+                            "space: intake is closed."
+                        )
+                    self._space.wait()
+        tenant.queue.append(entry)
+        tenant.pending += 1
+        tenant.enqueued += 1
+        tenant.last_dispatch = time.monotonic()
+        self._drr.activate(tenant_id)
+        self._mark_ready(tenant)
 
     def flush(self, tenant_id: Optional[str] = None, timeout: Optional[float] = None) -> None:
         """Block until the tenant's queue is fully applied (``tenant_id=None``
@@ -659,6 +744,43 @@ class EvaluationService:
                         f"(pending={tenant.pending})."
                     )
             self._raise_if_quarantined(tenant)
+
+    # -------------------------------------------------------- tenant lifecycle
+
+    @property
+    def lifecycle(self):
+        """The :class:`~tpumetrics.lifecycle.manager.LifecycleManager`
+        owning tenant residency (``None`` when the service was built
+        without ``lifecycle=``/``hbm_budget_bytes=``/``spill_dir=``)."""
+        return self._lifecycle
+
+    def _require_lifecycle(self):
+        if self._lifecycle is None:
+            raise TPUMetricsUserError(
+                f"EvaluationService {self._label!r} has no lifecycle manager; "
+                "construct it with lifecycle=LifecyclePolicy(...), "
+                "hbm_budget_bytes=, or spill_dir= to enable hibernation."
+            )
+        return self._lifecycle
+
+    def hibernate(self, tenant_id: str) -> bool:
+        """Explicitly demote one tenant: flush its queue, cut its state to
+        the spill store, release its device buffers / instrument series /
+        last-holder backbone references, and remove it from the scheduler.
+        Returns ``False`` when the tenant cannot hibernate right now (new
+        work raced the flush, quarantine, a draining service).  Its next
+        ``submit()``/``compute()`` revives it bit-identically."""
+        manager = self._require_lifecycle()
+        self.flush(tenant_id)
+        return manager.hibernate(tenant_id, reason="manual")
+
+    def sweep_lifecycle(self, idle_for: Optional[float] = None) -> List[str]:
+        """Hibernate every tenant idle past the policy threshold
+        (``idle_for`` overrides ``LifecyclePolicy.idle_hibernate_after``);
+        returns the demoted tenant ids.  Run it from a maintenance cadence
+        — the sweep itself is O(registered) in bookkeeping but performs
+        I/O only for the tenants it demotes."""
+        return self._require_lifecycle().sweep(idle_for=idle_for)
 
     # --------------------------------------------------------- graceful drain
 
@@ -742,8 +864,6 @@ class EvaluationService:
         release (and the abandoned-batch span completion) runs even when
         ``close`` raises — a poisoned dispatcher or a drain timeout is
         exactly when batches are left behind."""
-        from tpumetrics.telemetry.xla import release_attribution
-
         try:
             self._dispatcher.close(drain=drain, timeout=timeout)
         finally:
@@ -760,31 +880,42 @@ class EvaluationService:
                     for _args, _n, _probe, (d_root, d_qspan) in tenant.queue:
                         _spans.end_span(d_qspan, discarded=True)
                         _spans.end_span(d_root, error="discarded (service close)")
-            from tpumetrics.monitoring.drift import release_stream
-
             for tenant in tenants:
-                _SUBMIT_HIST.remove(tenant.tid)
-                _DISPATCH_HIST.remove(tenant.tid)
-                release_stream(self._stats_metric(tenant), tenant.tid)
-                release_attribution(tenant.tid, tokens=(tenant.step_token,))
-                # device-side series: latch + release UNDER the health lock
-                # the stats()-side gauge writes also take, so a concurrent
-                # tenant_stats() cannot re-mint what is being released (the
-                # evaluator's close() ordering, per tenant)
-                with tenant.health_lock:
-                    tenant.released = True
-                    _STATE_HBM_GAUGE.remove(tenant.tid)
-                    _health.release_health(tenant.tid, tenant.health_alerted)
-                    _device.release_profiles(tenant.tid)
+                self._release_tenant_series(tenant)
                 # shared-backbone protocol: drop the metric's registry
                 # references (the LAST tenant over a weight set frees it);
                 # outside the health lock — handle close can release device
-                # buffers and program profiles of its own label
+                # buffers and program profiles of its own label.  Parked
+                # references (hibernated tenants) are discarded too.
                 release = getattr(tenant.metric, "release_backbones", None)
                 if callable(release):
                     release()
             _TENANTS_GAUGE.remove(self._label)
             _DEPTH_GAUGE.remove(self._label)
+            if self._lifecycle is not None:
+                self._lifecycle.close()
+
+    def _release_tenant_series(self, tenant: _Tenant) -> None:
+        """Release one tenant's per-tenant instrument series from the
+        process-global registry — shared by :meth:`close` (permanent) and
+        the lifecycle manager's hibernation path (the tenant re-mints its
+        series on revival).  Idempotent."""
+        from tpumetrics.monitoring.drift import release_stream
+        from tpumetrics.telemetry.xla import release_attribution
+
+        _SUBMIT_HIST.remove(tenant.tid)
+        _DISPATCH_HIST.remove(tenant.tid)
+        release_stream(self._stats_metric(tenant), tenant.tid)
+        release_attribution(tenant.tid, tokens=(tenant.step_token,))
+        # device-side series: latch + release UNDER the health lock
+        # the stats()-side gauge writes also take, so a concurrent
+        # tenant_stats() cannot re-mint what is being released (the
+        # evaluator's close() ordering, per tenant)
+        with tenant.health_lock:
+            tenant.released = True
+            _STATE_HBM_GAUGE.remove(tenant.tid)
+            _health.release_health(tenant.tid, tenant.health_alerted)
+            _device.release_profiles(tenant.tid)
 
     def __enter__(self) -> "EvaluationService":
         return self
@@ -805,26 +936,38 @@ class EvaluationService:
 
         tenant = self._get(tenant_id)
         self.flush(tenant_id)
-        # health first: a poisoned tenant must page (state_health event +
-        # nonzero nonfinite series) BEFORE any value is computed or the
-        # non-finite guard turns the corruption into an exception
-        self._refresh_health(tenant)
-        with self._lock, stream_scope(tenant.tid):
-            # drift monitors alert under THIS tenant's label — latches are
-            # per-stream on the (possibly shared) metric instance, so one
-            # shared-step monitor pages each tenant independently
-            self._raise_if_quarantined(tenant)
-            if tenant.bucketer is None:
-                value = tenant.metric.compute()
-                tenant.degraded = bool(getattr(tenant.metric, "degraded", False))
-                return value
-            # the step's metric runs ALL functional ops for shared-step
-            # tenants (init/update/compute from one config-identical object),
-            # so state structure and compute can never drift between sharers.
-            # Compile attribution: signature None = attribute, but exempt
-            # from retrace detection (eager computes re-fire per new shape)
-            with attribute_compiles(tenant.tid, None, token=tenant.step_token):
-                return tenant.step._metric.functional_compute(tenant.state)
+        while True:
+            if self._lifecycle is not None and tenant.residency != "resident":
+                # a hibernated tenant's result is served by reviving it:
+                # restore -> re-place -> the SAME functional compute an
+                # uninterrupted stream would run (the bit-identity contract)
+                self._lifecycle.ensure_resident(tenant)
+            # health first: a poisoned tenant must page (state_health event +
+            # nonzero nonfinite series) BEFORE any value is computed or the
+            # non-finite guard turns the corruption into an exception
+            self._refresh_health(tenant)
+            with self._lock, stream_scope(tenant.tid):
+                if self._lifecycle is not None and tenant.residency != "resident":
+                    continue  # an idle sweep raced the revival: revive again
+                return self._compute_locked(tenant)
+
+    def _compute_locked(self, tenant: _Tenant) -> Any:
+        """The compute body (service lock held, drift stream scope
+        active).  Drift monitors alert under THIS tenant's label — latches
+        are per-stream on the (possibly shared) metric instance, so one
+        shared-step monitor pages each tenant independently."""
+        self._raise_if_quarantined(tenant)
+        if tenant.bucketer is None:
+            value = tenant.metric.compute()
+            tenant.degraded = bool(getattr(tenant.metric, "degraded", False))
+            return value
+        # the step's metric runs ALL functional ops for shared-step
+        # tenants (init/update/compute from one config-identical object),
+        # so state structure and compute can never drift between sharers.
+        # Compile attribution: signature None = attribute, but exempt
+        # from retrace detection (eager computes re-fire per new shape)
+        with attribute_compiles(tenant.tid, None, token=tenant.step_token):
+            return tenant.step._metric.functional_compute(tenant.state)
 
     def latest_result(self, tenant_id: str) -> Optional[Dict[str, Any]]:
         """The tenant's bounded-staleness result (``compute_every=n``);
@@ -897,6 +1040,9 @@ class EvaluationService:
             # the tenant's DRR quantum (its fair share of a contended
             # worker, in batch rows per round) — /statusz surfaces it
             "quota": tenant.quota,
+            # lifecycle census: resident / hibernating / hibernated /
+            # reviving (always "resident" without a lifecycle manager)
+            "residency": tenant.residency,
         }
         if tenant.bucketer is not None:
             leaves = jax.tree_util.tree_leaves(tenant.state)
@@ -942,6 +1088,7 @@ class EvaluationService:
                     "pending": 0, "dropped": 0, "megabatched": 0,
                     "quarantined": False, "degraded": False, "crashes": 0,
                     "restores": 0, "buckets": None, "quota": tenant.quota,
+                    "residency": tenant.residency,
                 }
                 hbm = dict(tenant.hbm_cache)
             health_dev = paths = None
@@ -1030,6 +1177,8 @@ class EvaluationService:
                     megabatch_tenants=self._megabatch_tenants,
                     quarantined_tenants=self._quarantines,
                 )
+                if self._lifecycle is not None:
+                    core["lifecycle"] = self._lifecycle.stats_locked()
                 self._stats_cache = core
         if not locked:
             core = dict(self._stats_cache) or dict(
@@ -1037,6 +1186,8 @@ class EvaluationService:
                 signature_evictions=0, megabatch_steps=0, megabatch_tenants=0,
                 quarantined_tenants=0,
             )
+            if self._lifecycle is not None and "lifecycle" not in core:
+                core["lifecycle"] = self._lifecycle.stats_default()
         out.update(core)
         out["stale"] = not locked
         return out
@@ -1052,9 +1203,14 @@ class EvaluationService:
                 f"Tenant {tenant_id!r} was registered without snapshot_dir"
             )
         self.flush(tenant_id)
-        with self._lock:
-            self._raise_if_quarantined(tenant)
-            return self._save_snapshot_locked(tenant)
+        while True:
+            if self._lifecycle is not None and tenant.residency != "resident":
+                self._lifecycle.ensure_resident(tenant)
+            with self._lock:
+                if self._lifecycle is not None and tenant.residency != "resident":
+                    continue  # an idle sweep raced the revival
+                self._raise_if_quarantined(tenant)
+                return self._save_snapshot_locked(tenant)
 
     def _save_snapshot_locked(self, tenant: _Tenant) -> str:
         if tenant.snapshots.last_step == tenant.batches:
@@ -1103,17 +1259,24 @@ class EvaluationService:
             raise TPUMetricsUserError(
                 f"Tenant {tenant_id!r} was registered without snapshot_dir"
             )
-        with self._lock:
-            self._raise_if_quarantined(tenant)
-            if tenant.batches or tenant.pending:
-                raise TPUMetricsUserError(
-                    "restore_latest() after ingestion started would double-count; "
-                    "restore on a fresh tenant, then replay from the returned position."
-                )
-            got = self._load_latest_snapshot(tenant)
-            if got is None:
-                return None
-            return self._adopt_snapshot_locked(tenant, got)
+        while True:
+            if self._lifecycle is not None and tenant.residency != "resident":
+                # a pristine hibernated tenant may restore_latest: revival
+                # is a fresh state, which is exactly what restore expects
+                self._lifecycle.ensure_resident(tenant)
+            with self._lock:
+                if self._lifecycle is not None and tenant.residency != "resident":
+                    continue  # an idle sweep raced the revival
+                self._raise_if_quarantined(tenant)
+                if tenant.batches or tenant.pending:
+                    raise TPUMetricsUserError(
+                        "restore_latest() after ingestion started would double-count; "
+                        "restore on a fresh tenant, then replay from the returned position."
+                    )
+                got = self._load_latest_snapshot(tenant)
+                if got is None:
+                    return None
+                return self._adopt_snapshot_locked(tenant, got)
 
     def _load_latest_snapshot(self, tenant: _Tenant) -> Optional[Tuple[Any, Dict[str, Any]]]:
         if tenant.snapshots is None:
@@ -1303,9 +1466,22 @@ class EvaluationService:
             self._finish_one(tenant)
 
     def _finish_one(self, tenant: _Tenant) -> None:
+        over = False
         with self._lock:
             tenant.pending -= 1
+            if (
+                self._lifecycle is not None
+                and tenant.pending == 0
+                and tenant.residency == "resident"
+            ):
+                # the batch that just completed may have pushed the watermark
+                # over the budget while this tenant still counted as busy
+                # (pending > 0 excludes it from eviction candidacy) — now
+                # idle, it is a candidate itself
+                over = self._lifecycle._over_budget_locked()
             self._done.notify_all()
+        if over:
+            self._lifecycle.enforce_budget()
 
     def _apply_batch(self, tenant: _Tenant, args: Tuple[Any, ...]) -> None:
         """Apply ONE batch to one tenant (journal, transition, counters,
@@ -1324,7 +1500,13 @@ class EvaluationService:
         with self._lock:
             tenant.batches += 1
             tenant.items += n_rows
+            tenant.last_dispatch = time.monotonic()
             batches = tenant.batches
+        if self._lifecycle is not None:
+            # refresh the tenant's resident-byte count and evict LRU idle
+            # tenants if this batch pushed the watermark over the budget
+            # (worker-side — never in a submit path)
+            self._lifecycle.after_batch(tenant)
         if (
             tenant.compute_every
             and batches - tenant.last_compute_at >= tenant.compute_every

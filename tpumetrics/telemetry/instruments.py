@@ -196,6 +196,10 @@ STATE_NONFINITE = "tpumetrics_state_nonfinite_total"
 # SLO engine (telemetry/slo.py)
 SLO_BURN_RATE = "tpumetrics_slo_burn_rate"
 SLO_VIOLATIONS = "tpumetrics_slo_violations_total"
+# tenant lifecycle (lifecycle/manager.py)
+RESIDENT_TENANTS = "tpumetrics_resident_tenants"
+HIBERNATED_BYTES = "tpumetrics_hibernated_bytes"
+REVIVAL_LATENCY_MS = "tpumetrics_revival_latency_ms"
 
 
 def enabled() -> bool:
