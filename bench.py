@@ -2484,6 +2484,133 @@ def _bench_chaos_soak():
     return wall_us, None, {"extras": extras}
 
 
+def _bench_fleet_resize():
+    """The self-scaling fleet loop as a STANDING bench gate (ISSUE 18): a
+    hot-tenant wave saturates a 1-rank pool until the fast-burn SLO
+    breaches, the autoscaler grows the pool, displaced tenants live-migrate
+    to the new ranks, and the submit p99 must RECOVER — with zero lost or
+    double-counted updates across every migration.
+
+    Emitted series and gates (``fleet_ceilings``):
+
+    - ``migration_latency_p99_ms`` — p99 wall of every zero-loss handoff
+      (window → cut → adopt → commit) the resize performed.  The ceiling
+      catches algorithmic blowups (an O(history) cut, a revival instead of
+      a spill-file ship), not box noise.
+    - ``lost_updates`` — ceiling 0 BY DESIGN: the confusion-matrix row
+      total after every migration must equal the rows fed; one lost or
+      double-counted row is a zero-loss contract violation, never raise it.
+    - ``p99_recovery_ratio`` — recovered-wave p99 / hot-wave p99.  Under
+      1.0 means the grown pool actually relieved the saturated rank; the
+      ceiling catches a grow that re-routes nothing (rebalance broken) or
+      migrations that wedge the new ranks.
+
+    In-scenario asserts: the fast-burn breach fired, the pool grew, at
+    least one tenant migrated, and every tenant's ``compute()`` is
+    bit-identical to its unmigrated oracle."""
+    import tempfile
+    from collections import deque
+
+    from tpumetrics.fleet import Autoscaler, AutoscalerPolicy, FleetController
+    from tpumetrics.soak.traffic import make_batch, make_metric, oracle_value, values_equal
+    from tpumetrics.telemetry.slo import SloEngine, SloRule
+
+    tenants = [f"hot-{i}" for i in range(8)]
+    recent = deque(maxlen=256)  # sliding submit-latency window the SLO reads
+
+    def p99_signal():
+        if not recent:
+            return None
+        ordered = sorted(recent)
+        return ordered[min(len(ordered) - 1, int(0.99 * len(ordered)))]
+
+    rule = SloRule(
+        "submit_p99", p99_signal, objective=5.0, budget=0.01,
+        fast_window_s=60.0, slow_window_s=600.0,
+        description="fleet submit p99 <= 5ms",
+    )
+    engine = SloEngine([rule])  # never armed: the bench ticks it manually
+    scaler = Autoscaler(
+        engine,
+        AutoscalerPolicy(min_ranks=1, max_ranks=3, grow_after=2,
+                         shrink_after=10_000, cooldown_s=0.0),
+    )
+    fc = FleetController(
+        lambda tid: make_metric(), ranks=1,
+        register_kw={"max_queue": 4, "backpressure": "block", "megabatch": False},
+        handoff_dir=tempfile.mkdtemp(prefix="tpum_fleet_"),
+        autoscaler=scaler, slo=engine,
+    )
+    fed = {tid: 0 for tid in tenants}
+
+    def wave(rounds):
+        # one saturating wave: every tenant fed round-robin against tiny
+        # block-policy queues — submit wall time IS the backpressure signal
+        lat = []
+        for _ in range(rounds):
+            for tid in tenants:
+                preds, target = make_batch(hash(tid) % 997, fed[tid])
+                t0 = time.perf_counter()
+                fc.submit(tid, preds, target)
+                ms = (time.perf_counter() - t0) * 1e3
+                lat.append(ms)
+                recent.append(ms)
+                fed[tid] += 1
+        return lat
+
+    t0 = time.perf_counter()
+    reports = []
+    try:
+        for tid in tenants:
+            fc.register(tid)
+        wave(2)  # warm the compile caches off the measured waves
+        hot = wave(6)
+        # manual clock: one tick per 10 simulated seconds until the burn
+        # windows fill, the breach latches, and the hysteresis grows the pool
+        now, grew = 0.0, False
+        for _ in range(8):
+            decision, world, moved = fc.autoscale_tick(now)
+            reports.extend(moved)
+            if decision == "grow":
+                grew = True
+            if grew and fc.world > 1:
+                break
+            now += 10.0
+        assert engine.violations("submit_p99") >= 1, "fast-burn SLO never breached"
+        assert grew and fc.world > 1, f"pool never grew (world={fc.world})"
+        assert reports, "grow rebalanced no tenants"
+        fc.flush()
+        post = wave(6)
+        # ---- zero-loss across every migration: bit-identity per tenant
+        lost = 0
+        for tid in tenants:
+            got = fc.compute(tid)
+            want = oracle_value(hash(tid) % 997, range(fed[tid]))
+            lost += abs(int(want["confmat"].sum()) - int(got["confmat"].sum()))
+            assert values_equal(got, want), f"{tid} diverged from unmigrated oracle"
+        wall_us = (time.perf_counter() - t0) * 1e6
+        hot_p99 = sorted(hot)[int(0.99 * len(hot))]
+        post_p99 = sorted(post)[int(0.99 * len(post))]
+        lat_sorted = sorted(r.latency_ms for r in reports)
+        extras = {
+            "hot_p99_ms": round(hot_p99, 3),
+            "recovered_p99_ms": round(post_p99, 3),
+            "p99_recovery_ratio": round(post_p99 / hot_p99, 4) if hot_p99 else 0.0,
+            "migration_latency_p99_ms": round(
+                lat_sorted[int(0.99 * len(lat_sorted))], 1
+            ),
+            "migrations": len(reports),
+            "lost_updates": lost,
+            "world_after": fc.world,
+            "routing_epoch": fc.ring.epoch,
+            "grow_decisions": scaler.decisions["grow"],
+        }
+        return wall_us, None, {"extras": extras}
+    finally:
+        fc.close(drain=False)
+        engine.close()
+
+
 def _bench_admin_plane():
     """The embedded admin plane (ISSUE 15): scrape latency against a LOADED
     1000-tenant service, plus the inert-predicate discipline — the admin
@@ -2744,6 +2871,12 @@ def _check_floors(headline_vs, details):
         check_ceiling("chaos_soak", key, ceiling, fail_on_error=True)
     for key, floor in gate.get("chaos_soak_floors", {}).items():
         check_floor_extra("chaos_soak", key, floor, fail_on_error=True)
+    # fleet gates: zero lost updates across every live migration (by design
+    # — an errored scenario means a zero-loss or bit-identity assert raised
+    # mid-resize, which must also trip), bounded handoff latency, and a
+    # submit-p99 that actually recovers once the pool grows
+    for key, ceiling in gate.get("fleet_ceilings", {}).items():
+        check_ceiling("fleet_resize", key, ceiling, fail_on_error=True)
     return violations
 
 
@@ -2780,6 +2913,7 @@ def main() -> None:
         ("elastic_restore", _bench_elastic_restore),
         ("monitoring_window", _bench_monitoring_window),
         ("chaos_soak", _bench_chaos_soak),
+        ("fleet_resize", _bench_fleet_resize),
         ("analysis_runtime", _bench_analysis_runtime),
     ):
         try:

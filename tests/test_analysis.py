@@ -452,6 +452,86 @@ def test_residency_lifecycle_manager_modules_exempt(tmp_path):
     assert "TPL108" not in _codes(found)
 
 
+# ------------------------------------------------------------------- TPL109
+ROUTING_TP = _src(
+    """
+    def route_after_migrate(fc, ring, tid, batch):
+        rank = ring.owner(tid)[0]             # placement, cached...
+        fc.migrate(tid, 2)                    # ...across a migration seam
+        fc.service(rank).submit(tid, batch)   # stale: the tenant may have moved
+
+    def census_row_after_resize(fc, row):
+        owner = row.owner_rank
+        fc.resize(3)
+        return owner
+    """
+)
+
+ROUTING_NEAR_MISS = _src(
+    """
+    def reread_after_seam(fc, ring, tid):
+        rank = ring.owner(tid)[0]
+        fc.migrate(tid, 2)
+        rank = ring.owner(tid)[0]             # fresh re-read: launders the cache
+        return rank
+
+    def under_lock(fc, ring, tid):
+        with fc.routing_lock:                 # migrations take the same lock
+            rank = ring.owner(tid)[0]
+            fc.migrate(tid, 2)
+            return rank
+
+    def no_seam_between(fc, ring, tid):
+        rank = ring.owner(tid)[0]
+        out = rank + 1                        # used before any seam
+        fc.migrate(tid, 2)
+        return out
+
+    def not_a_ring(fc, table, tid):
+        rank = table.owner(tid)[0]            # base is not ring-named
+        fc.migrate(tid, 2)
+        return rank
+    """
+)
+
+
+def test_routing_epoch_true_positives():
+    found = analyze_source(ROUTING_TP)
+    # both the cached owner() rank and the cached owner_rank row dangle
+    assert _codes(found).count("TPL109") == 2
+
+
+def test_routing_epoch_near_miss_negative():
+    # re-reads after the seam, routing_lock-protected spans, uses before
+    # the seam, and non-ring bases must not trigger
+    found = analyze_source(ROUTING_NEAR_MISS)
+    assert "TPL109" not in _codes(found)
+
+
+def test_routing_epoch_fleet_modules_exempt(tmp_path):
+    # the fleet package's own modules ARE the routing seam — reads inside
+    # tpumetrics/fleet/ are never findings
+    pkg = tmp_path / "tpumetrics" / "fleet"
+    pkg.mkdir(parents=True)
+    (pkg / "controller.py").write_text(ROUTING_TP)
+    found = analyze_paths([str(pkg)])
+    assert "TPL109" not in _codes(found)
+
+
+def test_routing_epoch_suppression():
+    src = _src(
+        """
+        def route(fc, ring, tid):
+            rank = ring.owner(tid)[0]
+            fc.migrate(tid, 2)
+            return rank  # tpulint: disable=TPL109 -- fixture: target pinned by caller
+        """
+    )
+    found = analyze_source(src)
+    assert "TPL109" not in _codes(found)
+    assert "TPL109" in _codes(found, suppressed=True)
+
+
 def test_host_telemetry_reachable_helper_is_flagged():
     src = _src(
         """

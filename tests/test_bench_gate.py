@@ -106,6 +106,13 @@ def test_floor_file_shape():
     assert data["chaos_soak_ceilings"]["restore_latency_p99_ms"] > 0
     assert data["chaos_soak_ceilings"]["unrecovered_incidents"] == 0
     assert data["chaos_soak_floors"]["throughput_rows_per_s_min"] > 0
+    # the fleet standing gates (ISSUE 18 acceptance): bounded zero-loss
+    # handoff latency, ZERO lost/double-counted updates across every live
+    # migration — never raise that one — and a submit p99 that actually
+    # recovers (ratio < 1) once the autoscaler grows the pool
+    assert data["fleet_ceilings"]["migration_latency_p99_ms"] > 0
+    assert data["fleet_ceilings"]["lost_updates"] == 0
+    assert 0 < data["fleet_ceilings"]["p99_recovery_ratio"] < 1.0
 
 
 def test_check_floors_flags_compile_regressions():
@@ -384,6 +391,33 @@ def test_check_floors_flags_chaos_soak_regressions():
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and all("throughput_rows_per_s_min" in v for v in violations)
     details["chaos_soak"] = "error: ChaosSoakError: compute() diverged"
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and "scenario failed" in violations[0]
+
+
+def test_check_floors_flags_fleet_regressions():
+    """A fleet resize whose migrations blew past the handoff-latency
+    ceiling, that lost (or double-counted) ANY update, or whose grown pool
+    never relieved the saturated rank's submit p99 must each trip the gate
+    independently; an errored scenario entry (a zero-loss or bit-identity
+    assert raised mid-resize) trips it too."""
+    healthy = {
+        "migration_latency_p99_ms": 50.0,
+        "lost_updates": 0,
+        "p99_recovery_ratio": 0.1,
+    }
+    details = {"fleet_resize": dict(healthy)}
+    assert bench._check_floors(headline_vs=1000.0, details=details) == []
+    details["fleet_resize"]["migration_latency_p99_ms"] = 10**6
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("migration_latency_p99_ms" in v for v in violations)
+    details["fleet_resize"] = dict(healthy, lost_updates=1)
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("lost_updates" in v for v in violations)
+    details["fleet_resize"] = dict(healthy, p99_recovery_ratio=1.3)
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("p99_recovery_ratio" in v for v in violations)
+    details["fleet_resize"] = "error: AssertionError: hot-0 diverged"
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and "scenario failed" in violations[0]
 

@@ -1,0 +1,815 @@
+"""Self-scaling fleet (ISSUE 18): placement, live migration, autoscaling.
+
+The acceptance spine is ZERO LOSS: a tenant that live-migrates between
+ranks — including a SIGKILL landing at the worst instant of the handoff —
+must compute exactly what an unmigrated single-service oracle computes
+over the same fed stream, with every update counted exactly once (the
+confusion-matrix row total IS the row count, so loss and double-count are
+both one visible integer).  Around it: the consistent-hash ring (pins
+win, epoch-versioned routing), the handoff manifest as THE commit point
+(roll back before, roll forward after), the typed in-window refusal under
+16-thread contention, autoscaler hysteresis, SLO-driven resize end to
+end, the /statusz federation census schema pin, and the seeded fleet
+chaos soak.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpumetrics.fleet import (
+    Autoscaler,
+    AutoscalerPolicy,
+    ConsistentHashRing,
+    FleetController,
+    HandoffStore,
+    MigrationError,
+    RingError,
+    TenantMigratingError,
+    migrate_tenant,
+    recover_handoffs,
+)
+from tpumetrics.runtime import EvaluationService
+from tpumetrics.soak.traffic import make_batch, make_metric, oracle_value, values_equal
+from tpumetrics.telemetry import ledger
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+
+@pytest.fixture(autouse=True)
+def _fleet_hygiene():
+    yield
+    ledger.disable()
+    ledger.reset()
+
+
+def _factory(tid):
+    return make_metric(5)
+
+
+# eager path (no buckets), no megabatch grouping: the smallest config that
+# still exercises queues, flush, and the migration window
+REG = {"megabatch": False, "max_queue": 64}
+
+
+def _feed(submit, seed, lo, hi):
+    """Feed batches [lo, hi) of the seeded stream through ``submit``."""
+    for i in range(lo, hi):
+        submit(*make_batch(seed, i))
+
+
+def _oracle(seed, n):
+    return oracle_value(seed, range(n))
+
+
+def _rows(value):
+    """Total rows folded into a compute() result — the lost/double-count
+    detector (integer confusion-matrix total)."""
+    return int(np.asarray(value["confmat"]).sum())
+
+
+# ------------------------------------------------------------------- ring
+
+
+class TestConsistentHashRing:
+    def test_deterministic_and_stable(self):
+        a = ConsistentHashRing([0, 1, 2])
+        b = ConsistentHashRing([0, 1, 2])
+        tids = [f"t{i}" for i in range(64)]
+        assert [a.natural_owner(t) for t in tids] == [b.natural_owner(t) for t in tids]
+        owners = {a.natural_owner(t) for t in tids}
+        assert owners == {0, 1, 2}  # 64 tenants spread over all 3 ranks
+
+    def test_add_rank_moves_a_minority(self):
+        ring = ConsistentHashRing([0, 1, 2, 3])
+        tids = [f"t{i}" for i in range(256)]
+        before = {t: ring.natural_owner(t) for t in tids}
+        ring.add_rank(4)
+        moved = sum(1 for t in tids if ring.natural_owner(t) != before[t])
+        # consistent hashing: ~1/5 of tenants move, never a full reshuffle
+        assert 0 < moved < len(tids) // 2
+        # every moved tenant moved TO the new rank
+        assert all(
+            ring.natural_owner(t) == 4 for t in tids if ring.natural_owner(t) != before[t]
+        )
+
+    def test_pins_win_and_epoch_bumps(self):
+        ring = ConsistentHashRing([0, 1])
+        e0 = ring.epoch
+        natural = ring.natural_owner("tid")
+        other = 1 - natural
+        e1 = ring.reassign("tid", other)
+        assert e1 > e0
+        assert ring.owner("tid") == (other, e1)
+        assert ring.natural_owner("tid") == natural  # the hash never lies
+        e2 = ring.unpin("tid")
+        assert e2 > e1
+        assert ring.owner("tid")[0] == natural
+
+    def test_topology_changes_bump_epoch(self):
+        ring = ConsistentHashRing([0])
+        e = ring.epoch
+        e = ring.add_rank(1)
+        assert ring.ranks == (0, 1)
+        e2 = ring.remove_rank(1)
+        assert e2 > e and ring.ranks == (0,)
+
+    def test_remove_rank_drops_its_pins(self):
+        ring = ConsistentHashRing([0, 1])
+        ring.reassign("tid", 1)
+        ring.remove_rank(1)
+        assert ring.owner("tid")[0] == 0
+        assert "tid" not in ring.pins()
+
+    def test_errors(self):
+        ring = ConsistentHashRing([0])
+        with pytest.raises(RingError):
+            ring.remove_rank(7)
+        with pytest.raises(RingError):
+            ring.reassign("tid", 7)
+        with pytest.raises(RingError):
+            ConsistentHashRing([]).owner("tid")
+
+    def test_census_schema(self):
+        ring = ConsistentHashRing([0, 1])
+        ring.reassign("a", 1)
+        census = ring.census(["a", "b"], migrating={"b"})
+        assert set(census) == {"a", "b"}
+        for row in census.values():
+            assert set(row) == {"owner_rank", "routing_epoch", "migrating"}
+        assert census["a"]["owner_rank"] == 1
+        assert census["b"]["migrating"] is True
+        assert census["a"]["migrating"] is False
+
+    def test_dict_round_trip(self):
+        ring = ConsistentHashRing([0, 1, 2], vnodes=16)
+        ring.reassign("a", 2)
+        clone = ConsistentHashRing.from_dict(json.loads(json.dumps(ring.to_dict())))
+        assert clone.epoch == ring.epoch
+        assert clone.ranks == ring.ranks
+        assert clone.vnodes == ring.vnodes
+        for t in ("a", "x", "y"):
+            assert clone.owner(t) == ring.owner(t)
+
+
+# -------------------------------------------------------- handoff manifest
+
+
+class TestHandoffStore:
+    def test_manifest_states_and_resolve(self, tmp_path):
+        store = HandoffStore(str(tmp_path))
+        metric = make_metric(5)
+        store.cut("tid", metric.snapshot_state(), {"batches": 3},
+                  mode="live", source_rank=0, target_rank=1)
+        (pending,) = store.pending()
+        assert pending["state"] == "cut"
+        assert pending["tenant"] == "tid"
+        assert pending["source_rank"] == 0 and pending["target_rank"] == 1
+        store.mark_committed("tid")
+        (pending,) = store.pending()
+        assert pending["state"] == "committed"
+        store.resolve("tid")
+        assert store.pending() == []
+        store.close()
+
+
+# -------------------------------------------------------- live migration
+
+
+class TestLiveMigration:
+    def test_bit_identical_across_migrate(self, tmp_path):
+        seed = 900
+        fc = FleetController(_factory, ranks=2, register_kw=REG,
+                             handoff_dir=str(tmp_path))
+        try:
+            src = fc.register("tid")
+            tgt = [r for r in fc.ranks if r != src][0]
+            _feed(lambda *b: fc.submit("tid", *b), seed, 0, 6)
+            fc.flush("tid")
+            report = fc.migrate("tid", tgt)
+            assert report.tenant == "tid" and report.batches == 6
+            assert report.source_rank == src and report.target_rank == tgt
+            _feed(lambda *b: fc.submit("tid", *b), seed, 6, 10)
+            fc.flush("tid")
+            value = fc.compute("tid")
+            assert values_equal(value, _oracle(seed, 10))
+            assert _rows(value) == _rows(_oracle(seed, 10))  # zero loss
+            row = fc.census()["tid"]
+            assert row["owner_rank"] == tgt and row["migrating"] is False
+        finally:
+            fc.close()
+
+    def test_migrate_to_current_rank_is_noop(self, tmp_path):
+        fc = FleetController(_factory, ranks=2, register_kw=REG,
+                             handoff_dir=str(tmp_path))
+        try:
+            rank = fc.register("tid")
+            epoch = fc.ring.epoch
+            assert fc.migrate("tid", rank) is None
+            assert fc.ring.epoch == epoch
+        finally:
+            fc.close()
+
+    def test_ledger_events_exactly_once(self, tmp_path):
+        ledger.enable()
+        ledger.reset()
+        fc = FleetController(_factory, ranks=2, register_kw=REG,
+                             handoff_dir=str(tmp_path))
+        try:
+            src = fc.register("tid")
+            tgt = [r for r in fc.ranks if r != src][0]
+            _feed(lambda *b: fc.submit("tid", *b), 17, 0, 3)
+            fc.migrate("tid", tgt)
+
+            def events(kind):
+                return [r for r in ledger.get_ledger().records if r.kind == kind]
+
+            assert len(events("tenant_migrate_started")) == 1
+            (committed,) = events("tenant_migrate_committed")
+            assert committed.extra["batches"] == 3
+            assert committed.extra["target_rank"] == tgt
+            assert events("tenant_migrate_aborted") == []
+        finally:
+            fc.close()
+
+    def test_abort_rolls_back_to_source(self, tmp_path):
+        """A failure before the manifest commit leaves the tenant live on
+        the source — window closed, nothing lost, manifest resolved."""
+        seed = 901
+        src = EvaluationService(name="src")
+        tgt = EvaluationService(name="tgt")
+        handoff = HandoffStore(str(tmp_path))
+        ledger.enable()
+        ledger.reset()
+        try:
+            src.register("tid", make_metric(5), **REG)
+            _feed(lambda *b: src.submit("tid", *b), seed, 0, 5)
+            src.flush("tid")
+
+            def bad_factory(tid):
+                raise RuntimeError("target cannot build the metric")
+
+            with pytest.raises(RuntimeError):
+                migrate_tenant(src, tgt, "tid", metric_factory=bad_factory,
+                               handoff=handoff, source_rank=0, target_rank=1)
+            aborted = [r for r in ledger.get_ledger().records
+                       if r.kind == "tenant_migrate_aborted"]
+            assert len(aborted) == 1
+            assert handoff.pending() == []  # manifest resolved
+            assert "tid" not in set(tgt.tenant_ids())  # never double-resident
+            # the window closed: the source accepts the stream again
+            _feed(lambda *b: src.submit("tid", *b), seed, 5, 8)
+            src.flush("tid")
+            assert values_equal(src.compute("tid"), _oracle(seed, 8))
+        finally:
+            handoff.close()
+            src.close(drain=False)
+            tgt.close(drain=False)
+
+    def test_straggler_refused_toward_new_owner(self, tmp_path):
+        """After commit, a submit aimed at the OLD rank gets the typed
+        moved-refusal naming the new owner; the controller follows it."""
+        seed = 902
+        fc = FleetController(_factory, ranks=2, register_kw=REG,
+                             handoff_dir=str(tmp_path))
+        try:
+            src = fc.register("tid")
+            tgt = [r for r in fc.ranks if r != src][0]
+            _feed(lambda *b: fc.submit("tid", *b), seed, 0, 4)
+            fc.migrate("tid", tgt)
+            old = fc.service(src)
+            with pytest.raises(TenantMigratingError) as err:
+                old.submit("tid", *make_batch(seed, 4))
+            assert err.value.target_rank == tgt
+            assert err.value.routing_epoch == fc.ring.epoch
+            # the controller transparently re-reads the ring
+            _feed(lambda *b: fc.submit("tid", *b), seed, 4, 7)
+            fc.flush("tid")
+            assert values_equal(fc.compute("tid"), _oracle(seed, 7))
+        finally:
+            fc.close()
+
+
+# ------------------------------------------------- crash-window recovery
+
+
+class TestHandoffRecovery:
+    def _interrupted(self, tmp_path, seed, *, commit):
+        """Open a window, cut, optionally commit — then crash (services
+        discarded without drain).  Returns the handoff store."""
+        src = EvaluationService(name="src")
+        try:
+            src.register("tid", make_metric(5), **REG)
+            _feed(lambda *b: src.submit("tid", *b), seed, 0, 6)
+            src.flush("tid")
+            handoff = HandoffStore(str(tmp_path))
+            mode, cut, meta = src.begin_migration("tid")
+            handoff.cut("tid", cut, meta, mode=mode, source_rank=0, target_rank=1)
+            if commit:
+                handoff.mark_committed("tid")
+        finally:
+            src.close(drain=False)  # SIGKILL: no drain, no commit bookkeeping
+        return handoff
+
+    @pytest.mark.parametrize("commit", [False, True], ids=["cut", "committed"])
+    def test_manifest_state_arbitrates_ownership(self, tmp_path, commit):
+        seed = 903
+        handoff = self._interrupted(tmp_path, seed, commit=commit)
+        ranks = {0: EvaluationService(name="r0"), 1: EvaluationService(name="r1")}
+        try:
+            reports = recover_handoffs(handoff, ranks, _factory, register_kw=REG)
+            (report,) = reports
+            assert report.recovered is True
+            owner = 1 if commit else 0
+            assert report.extra["owner_rank"] == owner
+            assert report.extra["committed"] is commit
+            present = [r for r, s in ranks.items() if "tid" in set(s.tenant_ids())]
+            assert present == [owner]  # exactly one rank, chosen by the manifest
+            svc = ranks[owner]
+            _feed(lambda *b: svc.submit("tid", *b), seed, 6, 9)
+            svc.flush("tid")
+            assert values_equal(svc.compute("tid"), _oracle(seed, 9))
+            assert handoff.pending() == []
+        finally:
+            handoff.close()
+            for s in ranks.values():
+                s.close(drain=False)
+
+    def test_double_residency_refused(self, tmp_path, seed=904):
+        handoff = self._interrupted(tmp_path, seed, commit=True)
+        ranks = {0: EvaluationService(name="r0"), 1: EvaluationService(name="r1")}
+        try:
+            for s in ranks.values():
+                s.register("tid", make_metric(5), **REG)
+            with pytest.raises(MigrationError, match="double"):
+                recover_handoffs(handoff, ranks, _factory, register_kw=REG)
+        finally:
+            handoff.close()
+            for s in ranks.values():
+                s.close(drain=False)
+
+    def test_already_resident_tenant_left_alone(self, tmp_path, seed=905):
+        """A re-registration that beat recovery wins: the cut is superseded,
+        never folded on top of the live stream (no double count)."""
+        handoff = self._interrupted(tmp_path, seed, commit=True)
+        ranks = {0: EvaluationService(name="r0"), 1: EvaluationService(name="r1")}
+        try:
+            ranks[0].register("tid", make_metric(5), **REG)
+            _feed(lambda *b: ranks[0].submit("tid", *b), seed, 0, 2)
+            ranks[0].flush("tid")
+            (report,) = recover_handoffs(handoff, ranks, _factory, register_kw=REG)
+            assert report.extra["owner_rank"] == 0  # the resident copy won
+            assert values_equal(ranks[0].compute("tid"), _oracle(seed, 2))
+        finally:
+            handoff.close()
+            for s in ranks.values():
+                s.close(drain=False)
+
+    def test_controller_sigkill_mid_migration(self, tmp_path, seed=906):
+        """End to end through the controller: crash between cut and commit,
+        rebuild cold on the same handoff root, recover() → exactly one
+        rank, bit-identical."""
+        fc = FleetController(_factory, ranks=2, register_kw=REG,
+                             handoff_dir=str(tmp_path))
+        src = fc.register("tid")
+        tgt = [r for r in fc.ranks if r != src][0]
+        _feed(lambda *b: fc.submit("tid", *b), seed, 0, 6)
+        fc.flush("tid")
+        mode, cut, meta = fc.service(src).begin_migration("tid")
+        fc.handoff.cut("tid", cut, meta, mode=mode,
+                       source_rank=src, target_rank=tgt)
+        fc.close(drain=False)  # SIGKILL the whole pool mid-handoff
+
+        fc = FleetController(_factory, ranks=2, register_kw=REG,
+                             handoff_dir=str(tmp_path))
+        try:
+            reports = fc.recover()
+            assert len(reports) == 1
+            present = [r for r in fc.ranks
+                       if "tid" in set(fc.service(r).tenant_ids())]
+            assert present == [src]  # never committed: rolls back to source
+            assert fc.census()["tid"]["owner_rank"] == src
+            _feed(lambda *b: fc.submit("tid", *b), seed, 6, 10)
+            fc.flush("tid")
+            assert values_equal(fc.compute("tid"), _oracle(seed, 10))
+        finally:
+            fc.close()
+
+
+# ------------------------------------------- the final-cut window (races)
+
+
+class TestMigrationWindow:
+    N_THREADS = 16
+
+    def _race(self, svc, seed, start_at, outcomes):
+        """Fire N_THREADS concurrent submits (distinct batches) against an
+        open window; record ('ok' | exception) per thread."""
+        barrier = threading.Barrier(self.N_THREADS)
+
+        def worker(i):
+            batch = make_batch(seed, start_at + i)
+            barrier.wait()
+            try:
+                svc.submit("tid", *batch)
+                outcomes[i] = "ok"
+            except BaseException as err:  # noqa: BLE001 - the outcome IS the test
+                outcomes[i] = err
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(self.N_THREADS)]
+        for t in threads:
+            t.start()
+        return threads
+
+    def test_error_policy_typed_refusal_16_threads(self, seed=907):
+        """policy='error': every in-window submit gets the typed refusal
+        (target_rank None — the window, not a move); after abort the same
+        batches land and compute is bit-identical."""
+        svc = EvaluationService(name="race")
+        try:
+            svc.register("tid", make_metric(5), backpressure="error",
+                         megabatch=False, max_queue=64)
+            _feed(lambda *b: svc.submit("tid", *b), seed, 0, 4)
+            svc.flush("tid")
+            svc.begin_migration("tid")  # window open
+            outcomes = [None] * self.N_THREADS
+            for t in self._race(svc, seed, 4, outcomes):
+                t.join(timeout=30)
+            assert all(isinstance(o, TenantMigratingError) for o in outcomes)
+            assert all(o.target_rank is None for o in outcomes)
+            assert svc.abort_migration("tid") is True
+            _feed(lambda *b: svc.submit("tid", *b), seed, 4, 4 + self.N_THREADS)
+            svc.flush("tid")
+            value = svc.compute("tid")
+            oracle = _oracle(seed, 4 + self.N_THREADS)
+            assert values_equal(value, oracle)
+            assert _rows(value) == _rows(oracle)  # nothing lost, nothing doubled
+        finally:
+            svc.close(drain=False)
+
+    def test_block_policy_waits_out_the_window(self, seed=908):
+        """policy='block': 16 threads park at the gate; abort releases them
+        and every batch lands exactly once."""
+        svc = EvaluationService(name="race-block")
+        try:
+            svc.register("tid", make_metric(5), backpressure="block",
+                         megabatch=False, max_queue=64)
+            _feed(lambda *b: svc.submit("tid", *b), seed, 0, 4)
+            svc.flush("tid")
+            svc.begin_migration("tid")
+            outcomes = [None] * self.N_THREADS
+            threads = self._race(svc, seed, 4, outcomes)
+            # the window holds: no thread may complete while it is open
+            threads[0].join(timeout=0.3)
+            assert outcomes.count("ok") == 0
+            svc.abort_migration("tid")
+            for t in threads:
+                t.join(timeout=30)
+            assert outcomes == ["ok"] * self.N_THREADS
+            svc.flush("tid")
+            value = svc.compute("tid")
+            oracle = _oracle(seed, 4 + self.N_THREADS)
+            assert values_equal(value, oracle)
+            assert _rows(value) == _rows(oracle)
+        finally:
+            svc.close(drain=False)
+
+    def test_commit_mid_race_loses_nothing(self, tmp_path, seed=909):
+        """The hard interleaving: 16 error-policy threads race a window that
+        COMMITS under them.  Every refusal is typed; re-driving each refused
+        batch through the controller lands it on the new owner exactly
+        once."""
+        fc = FleetController(_factory, ranks=2,
+                             register_kw={"backpressure": "error",
+                                          "megabatch": False, "max_queue": 64},
+                             handoff_dir=str(tmp_path))
+        try:
+            src = fc.register("tid")
+            tgt = [r for r in fc.ranks if r != src][0]
+            _feed(lambda *b: fc.submit("tid", *b), seed, 0, 4)
+            fc.flush("tid")
+            svc = fc.service(src)
+            outcomes = [None] * self.N_THREADS
+            barrier = threading.Barrier(self.N_THREADS + 1)
+
+            def worker(i):
+                batch = make_batch(seed, 4 + i)
+                barrier.wait()
+                try:
+                    svc.submit("tid", *batch)  # aimed at the OLD rank
+                    outcomes[i] = "ok"
+                except BaseException as err:  # noqa: BLE001
+                    outcomes[i] = err
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(self.N_THREADS)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            fc.migrate("tid", tgt)
+            for t in threads:
+                t.join(timeout=30)
+            # every thread either landed on the source pre-window or got the
+            # typed refusal (in-window or moved) — never a silent drop
+            refused = [i for i, o in enumerate(outcomes)
+                       if isinstance(o, TenantMigratingError)]
+            landed = [i for i, o in enumerate(outcomes) if o == "ok"]
+            assert len(refused) + len(landed) == self.N_THREADS
+            for i in refused:  # re-drive through the ring
+                fc.submit("tid", *make_batch(seed, 4 + i))
+            fc.flush("tid")
+            value = fc.compute("tid")
+            oracle = _oracle(seed, 4 + self.N_THREADS)
+            assert values_equal(value, oracle)
+            assert _rows(value) == _rows(oracle)
+        finally:
+            fc.close()
+
+
+# -------------------------------------------------------------- autoscaler
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.breaches = []
+
+    def breached(self):
+        return list(self.breaches)
+
+    def tick(self, now=None):
+        pass
+
+
+class TestAutoscaler:
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            AutoscalerPolicy(min_ranks=0)
+        with pytest.raises(ValueError):
+            AutoscalerPolicy(min_ranks=4, max_ranks=2)
+        with pytest.raises(ValueError):
+            AutoscalerPolicy(grow_after=0)
+        with pytest.raises(ValueError):
+            AutoscalerPolicy(cooldown_s=-1.0)
+
+    def test_grow_needs_a_streak(self):
+        eng = _FakeEngine()
+        asc = Autoscaler(eng, AutoscalerPolicy(grow_after=3, cooldown_s=0.0))
+        eng.breaches = ["p99"]
+        assert asc.observe(1, now=0.0)[0] == "hold"
+        assert asc.observe(1, now=1.0)[0] == "hold"
+        assert asc.observe(1, now=2.0) == ("grow", 2)
+
+    def test_single_calm_tick_resets_the_streak(self):
+        eng = _FakeEngine()
+        asc = Autoscaler(eng, AutoscalerPolicy(grow_after=2, cooldown_s=0.0))
+        eng.breaches = ["p99"]
+        asc.observe(1, now=0.0)
+        eng.breaches = []  # a calm tick: hysteresis resets
+        asc.observe(1, now=1.0)
+        eng.breaches = ["p99"]
+        assert asc.observe(1, now=2.0)[0] == "hold"  # streak restarted at 1
+        assert asc.observe(1, now=3.0)[0] == "grow"
+
+    def test_shrink_after_sustained_calm_with_cooldown(self):
+        eng = _FakeEngine()
+        asc = Autoscaler(eng, AutoscalerPolicy(
+            shrink_after=2, grow_after=1, cooldown_s=10.0))
+        eng.breaches = ["p99"]
+        assert asc.observe(1, now=0.0) == ("grow", 2)
+        eng.breaches = []
+        assert asc.observe(2, now=1.0)[0] == "hold"
+        assert asc.observe(2, now=5.0)[0] == "hold"   # calm enough, but cooling
+        assert asc.observe(2, now=11.0) == ("shrink", 1)
+
+    def test_bounds_clamp(self):
+        eng = _FakeEngine()
+        asc = Autoscaler(eng, AutoscalerPolicy(
+            min_ranks=1, max_ranks=2, grow_after=1, shrink_after=1,
+            cooldown_s=0.0))
+        eng.breaches = ["p99"]
+        assert asc.observe(2, now=0.0)[0] == "hold"   # already at max
+        eng.breaches = []
+        assert asc.observe(1, now=1.0)[0] == "hold"   # already at min
+        assert asc.decisions["grow"] == 0 and asc.decisions["shrink"] == 0
+
+    def test_slo_driven_resize_end_to_end(self, tmp_path):
+        """Controller + fake engine: sustained breach grows the pool and
+        every tenant stays bit-identical through the re-placement."""
+        eng = _FakeEngine()
+        fc = FleetController(
+            _factory, ranks=1, register_kw=REG, handoff_dir=str(tmp_path),
+            slo=eng,
+            autoscaler=Autoscaler(eng, AutoscalerPolicy(
+                min_ranks=1, max_ranks=3, grow_after=2, shrink_after=10_000,
+                cooldown_s=0.0)),
+        )
+        try:
+            seeds = {f"t{i}": 910 + i for i in range(4)}
+            for tid in seeds:
+                fc.register(tid)
+            for tid, seed in seeds.items():
+                _feed(lambda *b, t=tid: fc.submit(t, *b), seed, 0, 5)
+            fc.flush()
+            eng.breaches = ["submit_p99"]
+            decision, world, _ = fc.autoscale_tick(now=0.0)
+            assert decision == "hold" and world == 1  # one breach is not a streak
+            decision, world, reports = fc.autoscale_tick(now=1.0)
+            assert decision == "grow" and world == 2 and fc.world == 2
+            assert all(r.batches > 0 for r in reports) or reports == []
+            for tid, seed in seeds.items():
+                _feed(lambda *b, t=tid: fc.submit(t, *b), seed, 5, 8)
+            fc.flush()
+            for tid, seed in seeds.items():
+                value = fc.compute(tid)
+                assert values_equal(value, _oracle(seed, 8))
+                assert _rows(value) == _rows(_oracle(seed, 8))
+            assert fc.fleet_status()["autoscaler"]["decisions"]["grow"] == 1
+        finally:
+            fc.close()
+
+
+# -------------------------------------------------------------- controller
+
+
+class TestFleetController:
+    def test_register_pins_and_duplicate_refused(self, tmp_path):
+        fc = FleetController(_factory, ranks=3, register_kw=REG,
+                             handoff_dir=str(tmp_path))
+        try:
+            rank = fc.register("tid")
+            assert fc.ring.owner("tid")[0] == rank
+            with pytest.raises(TPUMetricsUserError, match="already registered"):
+                fc.register("tid")
+            explicit = fc.register("pinned", rank=2)
+            assert explicit == 2 and fc.ring.owner("pinned")[0] == 2
+        finally:
+            fc.close()
+
+    def test_resize_round_trip_bit_identical(self, tmp_path):
+        """1 → 3 → 1 with six tenants: every displaced stream survives both
+        the grow re-placement and the shrink evacuation."""
+        fc = FleetController(_factory, ranks=1, register_kw=REG,
+                             handoff_dir=str(tmp_path))
+        try:
+            seeds = {f"t{i}": 920 + i for i in range(6)}
+            for tid in seeds:
+                fc.register(tid)
+            for tid, seed in seeds.items():
+                _feed(lambda *b, t=tid: fc.submit(t, *b), seed, 0, 4)
+            fc.flush()
+            fc.resize(3)
+            assert fc.world == 3
+            spread = {fc.census()[t]["owner_rank"] for t in seeds}
+            assert len(spread) > 1  # the grow actually re-placed tenants
+            for tid, seed in seeds.items():
+                _feed(lambda *b, t=tid: fc.submit(t, *b), seed, 4, 7)
+            fc.flush()
+            fc.resize(1)
+            assert fc.world == 1
+            for tid, seed in seeds.items():
+                _feed(lambda *b, t=tid: fc.submit(t, *b), seed, 7, 9)
+            fc.flush()
+            for tid, seed in seeds.items():
+                value = fc.compute(tid)
+                oracle = _oracle(seed, 9)
+                assert values_equal(value, oracle)
+                assert _rows(value) == _rows(oracle)
+            census = fc.census()
+            only = fc.ranks[0]
+            assert all(row["owner_rank"] == only for row in census.values())
+        finally:
+            fc.close()
+
+    def test_fleet_status_schema(self, tmp_path):
+        fc = FleetController(_factory, ranks=2, register_kw=REG,
+                             handoff_dir=str(tmp_path), name="pin")
+        try:
+            fc.register("tid")
+            status = json.loads(json.dumps(fc.fleet_status()))
+            assert status["name"] == "pin"
+            assert status["world"] == 2
+            assert sorted(status["ranks"]) == sorted(fc.ranks)
+            assert status["routing_epoch"] == fc.ring.epoch
+            assert set(status["tenants"]["tid"]) == {
+                "owner_rank", "routing_epoch", "migrating"}
+        finally:
+            fc.close()
+
+    def test_close_idempotent(self, tmp_path):
+        fc = FleetController(_factory, ranks=1, register_kw=REG,
+                             handoff_dir=str(tmp_path))
+        fc.close()
+        fc.close()
+
+
+# ------------------------------------------------- /statusz federation pin
+
+
+class TestFleetFederation:
+    def test_statusz_fleet_census_schema_pinned(self, tmp_path):
+        """The /statusz federation carries the per-tenant routing census —
+        the schema external scrapers depend on, pinned over live HTTP."""
+        fc = FleetController(_factory, ranks=2, register_kw=REG,
+                             handoff_dir=str(tmp_path), admin_port=0,
+                             name="fedpin")
+        try:
+            src = fc.register("tid")
+            tgt = [r for r in fc.ranks if r != src][0]
+            _feed(lambda *b: fc.submit("tid", *b), 930, 0, 3)
+            fc.migrate("tid", tgt)
+            with urllib.request.urlopen(fc.admin.url + "/statusz", timeout=15) as r:
+                assert r.status == 200
+                payload = json.loads(r.read())
+            fleet = payload["federation"]["fleet"]
+            assert fleet["name"] == "fedpin"
+            assert fleet["world"] == 2
+            assert fleet["routing_epoch"] == fc.ring.epoch
+            row = fleet["tenants"]["tid"]
+            assert set(row) >= {"owner_rank", "routing_epoch", "migrating"}
+            assert row["owner_rank"] == tgt
+            assert row["migrating"] is False
+        finally:
+            fc.close()
+
+    def test_merge_newest_epoch_wins(self):
+        from tpumetrics.telemetry import federate
+
+        def snap(rank, epoch, owner):
+            s = json.loads(json.dumps(federate.local_snapshot(rank=rank)))
+            s["fleet"] = {
+                "name": "m", "routing_epoch": epoch, "world": 2,
+                "ranks": [0, 1],
+                "tenants": {"tid": {"owner_rank": owner,
+                                    "routing_epoch": epoch,
+                                    "migrating": False}},
+            }
+            return s
+
+        merged = federate.merge_snapshots(
+            [snap(0, epoch=3, owner=0), snap(1, epoch=7, owner=1)]).statusz()
+        fleet = merged["fleet"]
+        assert fleet["routing_epoch"] == 7
+        assert fleet["tenants"]["tid"]["owner_rank"] == 1  # newest epoch won
+
+
+# ----------------------------------------------------- seeded fleet soak
+
+
+class TestFleetSoak:
+    def test_fleet_schedule_generation(self):
+        from tpumetrics.soak.schedule import FLEET_KINDS, generate_schedule
+
+        a = generate_schedule(5, fleet=True, world=2, n_incidents=4,
+                              min_world=1, max_world=3)
+        b = generate_schedule(5, fleet=True, world=2, n_incidents=4,
+                              min_world=1, max_world=3)
+        assert a.to_dict() == b.to_dict()  # same seed, byte-identical
+        kinds = [inc.kind for inc in a.incidents]
+        assert set(kinds) <= set(FLEET_KINDS)
+        assert any(inc.kind == "migrate" and inc.abrupt for inc in a.incidents)
+        worlds = [inc.world_after for inc in a.incidents if inc.kind == "resize"]
+        assert any(w > 2 for w in worlds) or any(w < 2 for w in worlds)
+
+    def test_short_fleet_soak(self, tmp_path):
+        """Tier-1 smoke: 3 seeded incidents (incl. the required abrupt
+        migrate = SIGKILL mid-handoff) with every standing gate armed."""
+        from tpumetrics.soak import run_fleet_soak
+        from tpumetrics.soak.schedule import generate_schedule
+
+        schedule = generate_schedule(
+            11, fleet=True, world=2, n_incidents=3, min_world=1, max_world=3,
+            feed_low=4, feed_high=8)
+        report = run_fleet_soak(schedule, tenants=3,
+                                handoff_dir=str(tmp_path), register_kw=REG)
+        assert report["bit_identical"] is True
+        assert report["exactly_once"] is True
+        assert report["lost_updates"] == 0
+        assert report["legs"] == 3
+
+    @pytest.mark.slow
+    def test_fleet_chaos_soak(self, tmp_path):
+        """The acceptance soak: a longer seeded schedule of migrations and
+        resizes, SIGKILL mid-migration included, zero loss throughout."""
+        from tpumetrics.soak import run_fleet_soak
+        from tpumetrics.soak.schedule import generate_schedule
+
+        schedule = generate_schedule(
+            23, fleet=True, world=2, n_incidents=8, min_world=1, max_world=4,
+            feed_low=6, feed_high=14)
+        report = run_fleet_soak(schedule, tenants=6,
+                                handoff_dir=str(tmp_path), register_kw=REG)
+        assert report["bit_identical"] is True
+        assert report["exactly_once"] is True
+        assert report["lost_updates"] == 0
+        assert report["legs"] == 8
+        assert report["migrations"] >= 1
+        assert report["migration_latency_p99_ms"] > 0.0
+        kinds = {inc["kind"] for inc in report["incidents"]}
+        assert kinds == {"migrate", "resize"}
+        assert any(inc["kind"] == "migrate" and inc.get("abrupt")
+                   for inc in report["incidents"])

@@ -48,6 +48,16 @@ TPL108 stale-residency-read    a local caching a tenant's device residency
                                device buffers between bind and use.  Hold the manager's
                                ``residency_lock`` across read *and* use, or re-read after
                                the point
+TPL109 stale-routing-read      a local caching a tenant's rank placement (a routing
+                               ``.owner(...)``/``.natural_owner(...)`` read or an
+                               ``owner_rank`` attribute) used after a migration seam
+                               (``migrate``/``migrate_tenant``/``commit_migration``/
+                               ``rebalance``/``resize``/``recover_handoffs``/
+                               ``reassign``) without re-reading — the seam re-pins the
+                               ring and bumps the routing epoch, so the cached rank may
+                               name a service the tenant has already left.  Hold the
+                               controller's ``routing_lock`` across read *and* use, or
+                               re-read after the seam
 TPL201 divergent-collective    a collective (``sync``/``all_reduce``/``all_gather``/
                                ``flush``/…) reachable on only one branch of a rank- or
                                data-dependent conditional — the static complement of the
@@ -120,6 +130,11 @@ CATALOG: Dict[str, Tuple[str, str]] = {
         "stale-residency-read",
         "tenant device-state read cached across a hibernation point outside the "
         "residency lock",
+    ),
+    "TPL109": (
+        "stale-routing-read",
+        "tenant->rank routing read cached across a migration seam outside the "
+        "routing lock",
     ),
     "TPL201": (
         "divergent-collective",
@@ -1486,6 +1501,137 @@ class ResidencyLifecycleRule:
         return None
 
 
+#: migration seams: any of these calls may re-pin a tenant's ring placement
+#: and bump the routing epoch — a rank cached before the seam can name a
+#: service the tenant has already migrated away from
+_TPL109_POINTS = {
+    "migrate",
+    "migrate_tenant",
+    "commit_migration",
+    "rebalance",
+    "resize",
+    "recover_handoffs",
+    "reassign",
+}
+#: routing reads whose cached result goes stale across a seam: the ring's
+#: owner lookups (call form) and a census row's owner attribute (attr form)
+_TPL109_CALLS = {"owner", "natural_owner"}
+_TPL109_ATTRS = {"owner_rank"}
+
+
+class RoutingEpochRule:
+    """TPL109: tenant->rank routing read cached across a migration seam.
+
+    The fleet layer (:mod:`tpumetrics.fleet`) moves tenants between
+    evaluation services through zero-loss migrations; every seam —
+    ``migrate``/``migrate_tenant`` directly, ``commit_migration`` at the
+    handoff's commit point, ``rebalance``/``resize``/``recover_handoffs``
+    in bulk, ``reassign`` on the ring itself — re-pins the routing ring and
+    bumps its epoch.  A local that cached ``ring.owner(tid)`` (or an
+    ``owner_rank`` census attribute) before the seam dangles after it: the
+    rank it names may no longer host the tenant, and submitting there
+    raises at best, double-routes at worst.  The safe shapes are (a) hold
+    the controller's ``routing_lock`` across read AND use (migrations
+    serialize on the same lock), or (b) re-read the owner after the seam.
+    The fleet package itself is exempt — it IS the routing seam."""
+
+    codes = ("TPL109",)
+
+    def check(self, mod: ModuleInfo, index: PackageIndex) -> Iterator[Finding]:
+        path = str(mod.path).replace("\\", "/")
+        if "tpumetrics/fleet/" in path:
+            return
+        funcs: List[FuncInfo] = list(mod.functions.values())
+        for ci in mod.classes.values():
+            funcs.extend(ci.methods.values())
+        for fi in funcs:
+            yield from self._check_func(fi, mod)
+
+    def _check_func(self, fi: FuncInfo, mod: ModuleInfo) -> Iterator[Finding]:
+        # line spans of `with <...>.routing_lock:` bodies — reads and uses
+        # inside one are serialized against migration by construction
+        locked: List[Tuple[int, int]] = []
+        for n in ast.walk(fi.node):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    if self._terminal(item.context_expr) == "routing_lock":
+                        locked.append((n.lineno, n.end_lineno or n.lineno))
+                        break
+
+        def in_lock(line: int) -> bool:
+            return any(lo <= line <= hi for lo, hi in locked)
+
+        binds: Dict[str, List[Tuple[int, bool, ast.expr]]] = {}
+        points: List[int] = []
+        uses: List[Tuple[str, ast.Name]] = []
+        for n in ast.walk(fi.node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and isinstance(
+                n.targets[0], ast.Name
+            ):
+                binds.setdefault(n.targets[0].id, []).append(
+                    (n.lineno, self._routing_read(n.value), n.value)
+                )
+            elif isinstance(n, ast.Call) and self._terminal(n.func) in _TPL109_POINTS:
+                points.append(n.lineno)
+            elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                uses.append((n.id, n))
+        if not points or not binds:
+            return
+
+        reported: Set[Tuple[str, int]] = set()
+        for name, node in uses:
+            history = binds.get(name)
+            if not history:
+                continue
+            prior = [b for b in history if b[0] < node.lineno]
+            if not prior:
+                continue
+            bind_line, tainted, _value = max(prior, key=lambda b: b[0])
+            if not tainted:
+                continue
+            crossed = any(bind_line < p < node.lineno for p in points)
+            if not crossed:
+                continue
+            if in_lock(bind_line) and in_lock(node.lineno):
+                continue
+            key = (name, bind_line)
+            if key in reported:
+                continue
+            reported.add(key)
+            yield Finding(
+                "TPL109",
+                f"`{name}` caches a tenant->rank routing read (bound at line "
+                f"{bind_line}) and is used after a migration seam: the seam "
+                "re-pins the ring and bumps the routing epoch, so the cached "
+                "rank may no longer host the tenant. Hold routing_lock across "
+                "the read and the use, or re-read the owner after the seam.",
+                mod.path, node.lineno, node.col_offset, symbol=fi.qualname,
+            )
+
+    @classmethod
+    def _routing_read(cls, expr: ast.expr) -> bool:
+        # `rank = ring.owner(tid)[0]` caches through the subscript too
+        if isinstance(expr, ast.Subscript):
+            return cls._routing_read(expr.value)
+        if isinstance(expr, ast.Call):
+            func = expr.func
+            if isinstance(func, ast.Attribute) and func.attr in _TPL109_CALLS:
+                base = cls._terminal(func.value)
+                return base is not None and "ring" in base.lower()
+            return False
+        if isinstance(expr, ast.Attribute) and expr.attr in _TPL109_ATTRS:
+            return True
+        return False
+
+    @staticmethod
+    def _terminal(expr: ast.expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if isinstance(expr, ast.Attribute):
+            return expr.attr
+        return None
+
+
 #: the serving-layer modules whose entry points TPL106 rejects in update paths
 _TPL106_MODULES = (
     "tpumetrics.telemetry.serve",
@@ -1842,6 +1988,7 @@ RULES = [
     HostHealthReadRule(),
     BackboneLifecycleRule(),
     ResidencyLifecycleRule(),
+    RoutingEpochRule(),
     ServingLayerRule(),
     StateDeclRule(),
     ShadowStateRule(),

@@ -1,0 +1,38 @@
+"""Self-scaling fleet: consistent-hash tenant placement, zero-loss live
+migration, and SLO-driven pool resize.
+
+- :mod:`~tpumetrics.fleet.ring` — the epoch-versioned consistent-hash
+  routing ring (placement + census).
+- :mod:`~tpumetrics.fleet.migrate` — the two-phase zero-loss tenant
+  handoff and its crash recovery.
+- :mod:`~tpumetrics.fleet.autoscaler` — burn-rate signal -> grow/shrink
+  decisions with hysteresis.
+- :mod:`~tpumetrics.fleet.controller` — the :class:`FleetController`
+  tying them together over N evaluation services.
+"""
+
+from tpumetrics.fleet.autoscaler import Autoscaler, AutoscalerPolicy
+from tpumetrics.fleet.controller import FleetController
+from tpumetrics.fleet.migrate import (
+    HandoffStore,
+    MigrationError,
+    MigrationReport,
+    TenantMigratingError,
+    migrate_tenant,
+    recover_handoffs,
+)
+from tpumetrics.fleet.ring import ConsistentHashRing, RingError
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalerPolicy",
+    "ConsistentHashRing",
+    "FleetController",
+    "HandoffStore",
+    "MigrationError",
+    "MigrationReport",
+    "RingError",
+    "TenantMigratingError",
+    "migrate_tenant",
+    "recover_handoffs",
+]

@@ -1,0 +1,158 @@
+"""SLO-driven pool autoscaler with hysteresis.
+
+The autoscaler consumes the burn-rate signal the SLO engine already
+computes (:class:`~tpumetrics.telemetry.slo.SloEngine` latches a breach
+when BOTH the fast and slow burn windows exceed the objective's budget)
+and turns it into grow/shrink decisions for the fleet controller.  It
+deliberately owns NO metric math — the SLO rules define "too slow", the
+autoscaler only answers "how many ranks".
+
+Hysteresis is three-fold, so a recovering pool cannot thrash:
+
+- **streaks** — grow only after ``grow_after`` consecutive breached
+  observations, shrink only after ``shrink_after`` consecutive calm ones
+  (shrink is the slower direction by default: scale up fast, down slow);
+- **cooldown** — after any action, hold for ``cooldown_s`` regardless of
+  the signal (a fresh rank needs time to absorb rebalanced tenants before
+  the burn windows can reflect it);
+- **bounds** — the world stays in ``[min_ranks, max_ranks]``.
+
+Clock-injectable (``clock=``) and driven by explicit
+:meth:`Autoscaler.observe` calls, so tests and the soak advance it
+deterministically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from tpumetrics.telemetry import instruments as _instruments
+
+__all__ = ["Autoscaler", "AutoscalerPolicy"]
+
+_DECISIONS_TOTAL = _instruments.counter(
+    _instruments.AUTOSCALE_DECISIONS,
+    help="autoscaler decisions by kind",
+    labels=("decision",),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalerPolicy:
+    """Declarative autoscaling policy.
+
+    Args:
+        min_ranks / max_ranks: inclusive world-size bounds.
+        grow_after: consecutive breached observations before growing.
+        shrink_after: consecutive calm observations before shrinking
+            (larger than ``grow_after`` by default — up fast, down slow).
+        cooldown_s: hold time after any resize, regardless of the signal.
+        step: ranks added/removed per decision.
+    """
+
+    min_ranks: int = 1
+    max_ranks: int = 8
+    grow_after: int = 2
+    shrink_after: int = 6
+    cooldown_s: float = 30.0
+    step: int = 1
+
+    def __post_init__(self) -> None:
+        if not 1 <= int(self.min_ranks) <= int(self.max_ranks):
+            raise ValueError(
+                f"need 1 <= min_ranks <= max_ranks, got {self.min_ranks}/{self.max_ranks}"
+            )
+        if int(self.grow_after) < 1 or int(self.shrink_after) < 1:
+            raise ValueError(
+                f"grow_after/shrink_after must be >= 1, got "
+                f"{self.grow_after}/{self.shrink_after}"
+            )
+        if not self.cooldown_s >= 0:
+            raise ValueError(f"cooldown_s must be >= 0, got {self.cooldown_s}")
+        if int(self.step) < 1:
+            raise ValueError(f"step must be >= 1, got {self.step}")
+
+
+class Autoscaler:
+    """Burn-rate signal -> world-size decisions (module docstring).
+
+    Args:
+        engine: the :class:`~tpumetrics.telemetry.slo.SloEngine` whose
+            breach latches drive the decisions (``None`` = always calm).
+        policy: the :class:`AutoscalerPolicy` hysteresis knobs.
+        clock: monotonic-seconds source (injectable for tests).
+    """
+
+    def __init__(
+        self,
+        engine: Any = None,
+        policy: AutoscalerPolicy = AutoscalerPolicy(),
+        *,
+        clock: Any = time.monotonic,
+    ) -> None:
+        self.engine = engine
+        self.policy = policy
+        self._clock = clock
+        self._breach_streak = 0
+        self._calm_streak = 0
+        self._last_action_at: Optional[float] = None
+        self.decisions: Dict[str, int] = {"grow": 0, "shrink": 0, "hold": 0}
+
+    def observe(
+        self, world: int, now: Optional[float] = None
+    ) -> Tuple[str, int]:
+        """Fold one observation of the SLO signal into the streaks and
+        decide: ``("grow" | "shrink" | "hold", target_world)``.  The
+        caller (the fleet controller) performs the resize; this only
+        decides."""
+        now = self._clock() if now is None else now
+        breached = bool(self.engine.breached()) if self.engine is not None else False
+        if breached:
+            self._breach_streak += 1
+            self._calm_streak = 0
+        else:
+            self._calm_streak += 1
+            self._breach_streak = 0
+        cooling = (
+            self._last_action_at is not None
+            and now - self._last_action_at < self.policy.cooldown_s
+        )
+        decision, target = "hold", int(world)
+        if not cooling:
+            if (
+                breached
+                and self._breach_streak >= self.policy.grow_after
+                and world < self.policy.max_ranks
+            ):
+                decision = "grow"
+                target = min(world + self.policy.step, self.policy.max_ranks)
+            elif (
+                not breached
+                and self._calm_streak >= self.policy.shrink_after
+                and world > self.policy.min_ranks
+            ):
+                decision = "shrink"
+                target = max(world - self.policy.step, self.policy.min_ranks)
+        if decision != "hold":
+            self._last_action_at = now
+            self._breach_streak = 0
+            self._calm_streak = 0
+        self.decisions[decision] += 1
+        if _instruments.enabled():
+            _DECISIONS_TOTAL.inc(1, decision)
+        return decision, target
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "breach_streak": self._breach_streak,
+            "calm_streak": self._calm_streak,
+            "cooling": (
+                self._last_action_at is not None
+                and self._clock() - self._last_action_at < self.policy.cooldown_s
+            ),
+            "decisions": dict(self.decisions),
+            "min_ranks": self.policy.min_ranks,
+            "max_ranks": self.policy.max_ranks,
+        }

@@ -1,0 +1,430 @@
+"""Self-scaling fleet of evaluation services behind one routing ring.
+
+:class:`FleetController` owns N :class:`~tpumetrics.runtime.service.
+EvaluationService` ranks and the :class:`~tpumetrics.fleet.ring.
+ConsistentHashRing` that places tenants on them.  It is the one component
+that ties the fleet layers together:
+
+- **placement** — every registration pins its tenant on the ring, so
+  topology changes never silently move a tenant: the ONLY way a tenant
+  changes rank is an explicit zero-loss migration
+  (:func:`~tpumetrics.fleet.migrate.migrate_tenant`), which re-pins at
+  commit.
+- **routing** — :meth:`submit` / :meth:`compute` read the ring lock-free
+  and retry on a *moved* refusal (:class:`~tpumetrics.fleet.migrate.
+  TenantMigratingError` with ``target_rank`` set): the refusal itself
+  names the new owner, so a bounded re-read converges without any global
+  pause.
+- **resize** — :meth:`resize` grows by adding ranks and rebalancing
+  displaced tenants to their natural owners, or shrinks by migrating
+  every tenant off the doomed (highest-numbered) ranks using a *survivor
+  ring*, so routing stays answerable at every intermediate step.
+- **autoscaling** — :meth:`autoscale_tick` folds the SLO engine's
+  burn-rate breach latch through the :class:`~tpumetrics.fleet.
+  autoscaler.Autoscaler` hysteresis and applies the decision.
+- **federation** — with ``admin_port=``, the embedded admin server's
+  ``/statusz`` federation section carries the per-tenant routing census
+  (``owner_rank`` / ``routing_epoch`` / ``migrating``), so any reader of
+  any rank can answer "who owns tenant T".
+
+Structural operations (migrate / resize / recover) serialize on one
+re-entrant lock; the data plane (submit / compute / flush) never takes
+it — the ring and each service are independently thread-safe, and the
+migration seams inside the service provide the per-tenant ordering.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from tpumetrics.fleet.autoscaler import Autoscaler
+from tpumetrics.fleet.migrate import (
+    HandoffStore,
+    MigrationReport,
+    TenantMigratingError,
+    migrate_tenant,
+    recover_handoffs,
+)
+from tpumetrics.fleet.ring import ConsistentHashRing, RingError
+from tpumetrics.telemetry import instruments as _instruments
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+__all__ = ["FleetController"]
+
+_RANKS_GAUGE = _instruments.gauge(
+    _instruments.FLEET_RANKS,
+    help="evaluation-service ranks in the fleet",
+    labels=("fleet",),
+)
+_EPOCH_GAUGE = _instruments.gauge(
+    _instruments.ROUTING_EPOCH,
+    help="routing-ring epoch (bumps on every placement change)",
+    labels=("fleet",),
+)
+
+# bounded retry for the moved-refusal loop: each retry follows a refusal
+# that NAMED the new owner, so >1 iteration only happens when the tenant
+# migrates again mid-call; a handful covers any sane churn without
+# masking a routing livelock
+_ROUTE_RETRIES = 8
+
+
+class FleetController:
+    """N evaluation services + one routing ring (module docstring).
+
+    Args:
+        metric_factory: ``metric_factory(tenant_id)`` builds the tenant's
+            metric — used by auto-registration and migration adoption,
+            which must construct a config-identical instance on the
+            target rank.
+        ranks: initial world size (>= 1).
+        register_kw: keyword defaults for every ``register`` call (per-call
+            kwargs override).
+        service_kw: keyword arguments for every
+            :class:`~tpumetrics.runtime.service.EvaluationService` built.
+        handoff_dir: durable root for the migration
+            :class:`~tpumetrics.fleet.migrate.HandoffStore`; ``None`` uses
+            a private temp dir (fine in-process, no cross-process crash
+            recovery).
+        vnodes: virtual nodes per rank on the ring.
+        autoscaler: optional :class:`~tpumetrics.fleet.autoscaler.
+            Autoscaler`; built automatically from ``slo`` when omitted.
+        slo: optional :class:`~tpumetrics.telemetry.slo.SloEngine` whose
+            breach latch drives :meth:`autoscale_tick`.
+        admin_port: optional port for an embedded admin server carrying
+            the federated routing census (0 = ephemeral).
+        name: fleet label on the gauges, service names, and admin server.
+    """
+
+    def __init__(
+        self,
+        metric_factory: Callable[[str], Any],
+        *,
+        ranks: int = 1,
+        register_kw: Optional[Dict[str, Any]] = None,
+        service_kw: Optional[Dict[str, Any]] = None,
+        handoff_dir: Optional[str] = None,
+        vnodes: int = 64,
+        autoscaler: Optional[Autoscaler] = None,
+        slo: Any = None,
+        admin_port: Optional[int] = None,
+        name: str = "fleet",
+    ) -> None:
+        if int(ranks) < 1:
+            raise ValueError(f"ranks must be >= 1, got {ranks}")
+        self._metric_factory = metric_factory
+        self._register_kw = dict(register_kw or {})
+        self._service_kw = dict(service_kw or {})
+        self._name = str(name)
+        self._ring = ConsistentHashRing(vnodes=vnodes)
+        self._services: Dict[int, Any] = {}
+        self._rank_ids = itertools.count()
+        self._struct = threading.RLock()  # migrate / resize / recover
+        self._migrating: set = set()
+        self._mig_lock = threading.Lock()  # the set above (census readers)
+        self.handoff = HandoffStore(handoff_dir)
+        self.slo = slo
+        self.autoscaler = (
+            autoscaler
+            if autoscaler is not None
+            else (Autoscaler(engine=slo) if slo is not None else None)
+        )
+        self._closed = False
+        for _ in range(int(ranks)):
+            self._add_rank_locked()
+        self._publish()
+        self.admin = None
+        if admin_port is not None:
+            from tpumetrics.telemetry.federate import local_snapshot
+            from tpumetrics.telemetry.serve import start_admin_server
+
+            # ONE snapshot: the instruments registry is process-global, so
+            # in-process ranks already share it — emitting a snapshot per
+            # rank would double-count every family in the merged view.  The
+            # fleet census rides along, giving /statusz its federation
+            # section with the per-tenant routing rows.
+            self.admin = start_admin_server(
+                int(admin_port),
+                targets={f"{self._name}-r{r}": s for r, s in self._services.items()},
+                slo=slo,
+                federation=lambda: [
+                    local_snapshot(rank=0, fleet=self.fleet_status())
+                ],
+                name=self._name,
+            )
+
+    # ------------------------------------------------------------- topology
+
+    @property
+    def ring(self) -> ConsistentHashRing:
+        return self._ring
+
+    @property
+    def world(self) -> int:
+        return len(self._services)
+
+    @property
+    def ranks(self) -> List[int]:
+        return sorted(self._services)
+
+    def service(self, rank: int) -> Any:
+        try:
+            return self._services[rank]
+        except KeyError:
+            raise RingError(
+                f"rank {rank!r} is not in the fleet (ranks: {self.ranks})"
+            ) from None
+
+    def _add_rank_locked(self) -> int:
+        from tpumetrics.runtime.service import EvaluationService
+
+        rank = next(self._rank_ids)
+        svc = EvaluationService(
+            name=f"{self._name}-r{rank}", **self._service_kw
+        )
+        self._services[rank] = svc
+        self._ring.add_rank(rank)
+        return rank
+
+    def _publish(self) -> None:
+        if _instruments.enabled():
+            _RANKS_GAUGE.set(len(self._services), self._name)
+            _EPOCH_GAUGE.set(self._ring.epoch, self._name)
+
+    def _find_rank(self, tenant_id: str) -> Optional[int]:
+        for rank in sorted(self._services):
+            if tenant_id in set(self._services[rank].tenant_ids()):
+                return rank
+        return None
+
+    # ------------------------------------------------------------ data plane
+
+    def register(
+        self, tenant_id: str, metric: Any = None, *, rank: Optional[int] = None,
+        **kwargs: Any,
+    ) -> int:
+        """Register a tenant on its ring-assigned rank (or an explicit
+        ``rank=``) and PIN the placement — the pin is what guarantees the
+        routing answer stays stable across resizes until a migration
+        deliberately moves it.  Returns the owning rank."""
+        with self._struct:
+            have = self._find_rank(tenant_id)
+            if have is not None:
+                raise TPUMetricsUserError(
+                    f"Tenant {tenant_id!r} is already registered on rank "
+                    f"{have}; deregister or migrate it instead."
+                )
+            owner = self._ring.owner(tenant_id)[0] if rank is None else int(rank)
+            svc = self.service(owner)
+            if metric is None:
+                metric = self._metric_factory(tenant_id)
+            svc.register(tenant_id, metric, **{**self._register_kw, **kwargs})
+            self._ring.reassign(tenant_id, owner)
+            self._publish()
+            return owner
+
+    def _route(self, tenant_id: str, op: Callable[[Any], Any]) -> Any:
+        last: Optional[TenantMigratingError] = None
+        for _ in range(_ROUTE_RETRIES):
+            rank = self._ring.owner(tenant_id)[0]
+            svc = self._services.get(rank)
+            if svc is None:
+                raise RingError(
+                    f"Tenant {tenant_id!r} routes to rank {rank}, which has "
+                    f"left the fleet (ranks: {self.ranks})."
+                )
+            try:
+                return op(svc)
+            except TenantMigratingError as err:
+                if err.target_rank is None:
+                    raise  # window refusal under policy "error": caller's call
+                last = err  # moved: the ring is already bumped — re-read
+        raise TenantMigratingError(
+            f"Tenant {tenant_id!r} kept moving across {_ROUTE_RETRIES} "
+            "routing reads; giving up rather than spinning.",
+            target_rank=last.target_rank if last else None,
+            routing_epoch=last.routing_epoch if last else None,
+        )
+
+    def submit(self, tenant_id: str, *args: Any) -> None:
+        """Submit to the tenant's current owner, transparently following a
+        committed migration (a *moved* refusal re-reads the ring)."""
+        self._route(tenant_id, lambda svc: svc.submit(tenant_id, *args))
+
+    def compute(self, tenant_id: str) -> Any:
+        return self._route(tenant_id, lambda svc: svc.compute(tenant_id))
+
+    def flush(self, tenant_id: Optional[str] = None,
+              timeout: Optional[float] = None) -> None:
+        if tenant_id is not None:
+            self._route(tenant_id, lambda svc: svc.flush(tenant_id, timeout))
+            return
+        for rank in self.ranks:
+            svc = self._services.get(rank)
+            if svc is not None:
+                svc.flush(None, timeout)
+
+    def tenant_ids(self) -> List[str]:
+        out: set = set()
+        for svc in list(self._services.values()):
+            out.update(svc.tenant_ids())
+        return sorted(out)
+
+    # ------------------------------------------------------------ migrations
+
+    def migrate(self, tenant_id: str, target_rank: int) -> Optional[MigrationReport]:
+        """Zero-loss migrate one tenant to ``target_rank`` (no-op when it
+        already lives there)."""
+        with self._struct:
+            target = self.service(int(target_rank))
+            source_rank = self._find_rank(tenant_id)
+            if source_rank is None:
+                raise TPUMetricsUserError(
+                    f"Tenant {tenant_id!r} is not registered on any rank."
+                )
+            if source_rank == int(target_rank):
+                return None
+            with self._mig_lock:
+                self._migrating.add(tenant_id)
+            try:
+                report = migrate_tenant(
+                    self._services[source_rank], target, tenant_id,
+                    metric_factory=self._metric_factory,
+                    handoff=self.handoff,
+                    source_rank=source_rank, target_rank=int(target_rank),
+                    ring=self._ring, register_kw=self._register_kw,
+                )
+            finally:
+                with self._mig_lock:
+                    self._migrating.discard(tenant_id)
+            self._publish()
+            return report
+
+    def resize(self, n: int) -> List[MigrationReport]:
+        """Grow or shrink the pool to ``n`` ranks, migrating every
+        displaced tenant with the same zero-loss handoff as
+        :meth:`migrate`.  Shrink retires the highest-numbered ranks and
+        routes their tenants via a *survivor ring*, so the live ring stays
+        valid at every intermediate step."""
+        if int(n) < 1:
+            raise ValueError(f"resize target must be >= 1, got {n}")
+        reports: List[MigrationReport] = []
+        with self._struct:
+            current = self.ranks
+            if int(n) == len(current):
+                return reports
+            if int(n) > len(current):
+                for _ in range(int(n) - len(current)):
+                    self._add_rank_locked()
+                # rebalance: a grown ring changes natural placement; pins
+                # keep routing stable, so deliberately move each displaced
+                # tenant to its new natural owner
+                for tid in self.tenant_ids():
+                    natural = self._ring.natural_owner(tid)
+                    if natural != self._ring.owner(tid)[0]:
+                        report = self.migrate(tid, natural)
+                        if report is not None:
+                            reports.append(report)
+            else:
+                survivors = current[: int(n)]
+                doomed = current[int(n):]
+                placed = ConsistentHashRing(
+                    survivors, vnodes=self._ring.vnodes
+                )
+                for rank in doomed:
+                    for tid in sorted(self._services[rank].tenant_ids()):
+                        report = self.migrate(tid, placed.owner(tid)[0])
+                        if report is not None:
+                            reports.append(report)
+                for rank in doomed:
+                    self._ring.remove_rank(rank)
+                    svc = self._services.pop(rank)
+                    if self.admin is not None:
+                        self.admin.remove_target(f"{self._name}-r{rank}")
+                    svc.close(drain=True)
+            if self.admin is not None:
+                for rank, svc in self._services.items():
+                    self.admin.add_target(f"{self._name}-r{rank}", svc)
+            self._publish()
+        return reports
+
+    def recover(self) -> List[MigrationReport]:
+        """Resolve interrupted migrations left in the handoff store by a
+        crash (:func:`~tpumetrics.fleet.migrate.recover_handoffs`): each
+        tenant ends resident on exactly one rank — the source when the cut
+        never committed, the target when it did."""
+        with self._struct:
+            reports = recover_handoffs(
+                self.handoff, dict(self._services), self._metric_factory,
+                ring=self._ring, register_kw=self._register_kw,
+            )
+            self._publish()
+            return reports
+
+    # ----------------------------------------------------------- autoscaling
+
+    def autoscale_tick(
+        self, now: Optional[float] = None
+    ) -> Tuple[str, int, List[MigrationReport]]:
+        """One autoscaling observation: tick the SLO engine, fold the
+        breach latch through the hysteresis, and apply the decision.
+        Returns ``(decision, world_after, migration_reports)``."""
+        if self.autoscaler is None:
+            raise TPUMetricsUserError(
+                "autoscale_tick needs an autoscaler (pass autoscaler= or slo=)."
+            )
+        if self.slo is not None:
+            self.slo.tick(now)
+        with self._struct:
+            decision, target = self.autoscaler.observe(self.world, now)
+            reports = self.resize(target) if decision != "hold" else []
+            return decision, self.world, reports
+
+    # ------------------------------------------------------------- federation
+
+    def census(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant routing rows: ``{tid: {"owner_rank", "routing_epoch",
+        "migrating"}}``."""
+        with self._mig_lock:
+            migrating = set(self._migrating)
+        return self._ring.census(self.tenant_ids(), migrating=migrating)
+
+    def fleet_status(self) -> Dict[str, Any]:
+        """The fleet section of the federated ``/statusz``: ring epoch,
+        membership, the per-tenant census, and the autoscaler's posture."""
+        out: Dict[str, Any] = {
+            "name": self._name,
+            "routing_epoch": self._ring.epoch,
+            "world": self.world,
+            "ranks": self.ranks,
+            "tenants": self.census(),
+        }
+        if self.autoscaler is not None:
+            out["autoscaler"] = self.autoscaler.stats()
+        return out
+
+    # -------------------------------------------------------------- shutdown
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the admin plane, close every rank (draining by default),
+        and release the fleet's gauges (idempotent)."""
+        with self._struct:
+            if self._closed:
+                return
+            self._closed = True
+            if self.admin is not None:
+                self.admin.close()
+            for rank in self.ranks:
+                self._services.pop(rank).close(drain=drain)
+            self.handoff.close()
+            if _instruments.enabled():
+                _RANKS_GAUGE.remove(self._name)
+                _EPOCH_GAUGE.remove(self._name)
+
+    def __enter__(self) -> "FleetController":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close(drain=all(e is None for e in exc))

@@ -1,0 +1,455 @@
+"""Zero-loss live migration of one tenant between evaluation services.
+
+The state machine (``docs/fleet.md`` draws it) is a two-phase handoff
+whose single durable commit point is the handoff manifest:
+
+1. **window** — ``source.begin_migration`` gates the tenant's intake by
+   its own backpressure policy and flushes pending batches; the stream
+   position is final from here.
+2. **cut** — the final state crosses through the atomic snapshot format
+   (write-temp -> fsync -> rename, CRC'd, batch count stamped in the
+   header) into the :class:`HandoffStore`; a hibernated tenant ships its
+   existing spill file verbatim instead — O(1), no revival.
+3. **adopt** — the target registers the tenant fresh and places the cut.
+   Registration's duplicate check is the exactly-once guard.
+4. **commit** — the manifest flips to ``"committed"`` (atomic rename).
+   Everything before this point rolls BACK (abort the window, withdraw
+   the adoption — loss-free, since no traffic reached the target yet);
+   everything after rolls FORWARD (the tenant's home is the target).
+5. **re-place** — the routing ring pins the tenant to the target and
+   bumps the epoch; the source deregisters, tombstoning the id so gated
+   waiters and late submitters get a typed refusal naming the new owner.
+
+A SIGKILL at ANY point leaves the manifest in exactly one state:
+``"cut"`` (recover on the source from the cut — the migration never
+happened) or ``"committed"`` (recover on the target — it already did).
+:func:`recover_handoffs` adopts accordingly, refuses double residency,
+and re-pins the ring — the soak's exactly-once gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from tpumetrics.lifecycle.store import SpillStore, _safe_dirname
+from tpumetrics.runtime import snapshot as _snapshot
+from tpumetrics.telemetry import instruments as _instruments
+from tpumetrics.telemetry import ledger as _telemetry
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+__all__ = [
+    "HandoffStore",
+    "MigrationError",
+    "MigrationReport",
+    "TenantMigratingError",
+    "migrate_tenant",
+    "recover_handoffs",
+]
+
+_MIGRATION_HIST = _instruments.histogram(
+    _instruments.MIGRATION_LATENCY_MS,
+    help="tenant live-migration latency (window -> cut -> adopt -> commit)",
+    labels=("stream",),
+    sketch=True,
+)
+_MIGRATIONS_TOTAL = _instruments.counter(
+    _instruments.MIGRATIONS_TOTAL,
+    help="tenant migrations by outcome",
+    labels=("outcome",),
+)
+
+
+class TenantMigratingError(TPUMetricsUserError):
+    """The tenant is inside (or past) a migration's final-cut window under
+    backpressure policy ``"error"``: the call is refused rather than
+    blocked, exactly like a full queue under the same policy.  A refusal
+    issued AFTER the commit carries the new placement — ``target_rank``
+    and ``routing_epoch`` — so the caller re-reads the routing ring and
+    resubmits to the new owner."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        target_rank: Any = None,
+        routing_epoch: Any = None,
+    ) -> None:
+        super().__init__(message)
+        self.target_rank = target_rank
+        self.routing_epoch = routing_epoch
+
+
+class MigrationError(TPUMetricsUserError):
+    """A migration step cannot proceed (double residency discovered during
+    recovery, an unreadable manifest, a missing rank)."""
+
+
+@dataclass
+class MigrationReport:
+    """One migration's outcome (returned by :func:`migrate_tenant` and
+    :func:`recover_handoffs`)."""
+
+    tenant: str
+    source_rank: Any
+    target_rank: Any
+    mode: str  # "live" | "spill" | "pristine"
+    batches: int
+    items: int
+    routing_epoch: Any = None
+    latency_ms: float = 0.0
+    recovered: bool = False
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+class HandoffStore:
+    """Durable middle ground of a migration: the final cut plus a tiny
+    atomic JSON manifest whose ``state`` field IS the commit point.
+
+    Cuts ride a :class:`~tpumetrics.lifecycle.store.SpillStore` under
+    ``root/cuts`` (atomic snapshot format, CRC, retention); manifests are
+    written temp-then-rename under ``root/manifests`` so a crash can never
+    leave a half-written commit record.  ``root=None`` creates a private
+    temporary root removed by :meth:`close` — crash recovery across
+    processes needs a real directory."""
+
+    def __init__(self, root: Optional[str] = None) -> None:
+        self._owned = root is None
+        self.root = (
+            root if root is not None else tempfile.mkdtemp(prefix="tpumetrics-handoff-")
+        )
+        self.cuts = SpillStore(os.path.join(self.root, "cuts"), keep=1)
+        self._manifests = os.path.join(self.root, "manifests")
+        os.makedirs(self._manifests, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _manifest_path(self, tenant_id: str) -> str:
+        return os.path.join(self._manifests, _safe_dirname(tenant_id) + ".json")
+
+    def _write_manifest(self, tenant_id: str, data: Dict[str, Any]) -> None:
+        path = self._manifest_path(tenant_id)
+        fd, tmp = tempfile.mkstemp(dir=self._manifests, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as fh:
+                json.dump(data, fh, sort_keys=True)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    def cut(
+        self,
+        tenant_id: str,
+        payload: Any,
+        meta: Dict[str, Any],
+        *,
+        mode: str = "live",
+        source_rank: Any = None,
+        target_rank: Any = None,
+        guard_non_finite: str = "off",
+    ) -> str:
+        """Persist a live cut + its ``"cut"``-state manifest; returns the
+        cut path."""
+        path = self.cuts.spill(
+            tenant_id, payload, dict(meta), guard_non_finite=guard_non_finite
+        )
+        self._write_manifest(
+            tenant_id,
+            {
+                "tenant": tenant_id,
+                "state": "cut",
+                "mode": mode,
+                "source_rank": source_rank,
+                "target_rank": target_rank,
+                "meta": dict(meta),
+            },
+        )
+        return path
+
+    def cut_file(
+        self,
+        tenant_id: str,
+        src_path: Optional[str],
+        meta: Dict[str, Any],
+        *,
+        source_rank: Any = None,
+        target_rank: Any = None,
+    ) -> Optional[str]:
+        """Adopt a hibernated tenant's spill file verbatim as the cut
+        (``None`` = pristine: manifest only) + its manifest."""
+        path = None
+        mode = "pristine"
+        if src_path is not None:
+            path = self.cuts.adopt_file(tenant_id, src_path)
+            mode = "spill"
+        self._write_manifest(
+            tenant_id,
+            {
+                "tenant": tenant_id,
+                "state": "cut",
+                "mode": mode,
+                "source_rank": source_rank,
+                "target_rank": target_rank,
+                "meta": dict(meta),
+            },
+        )
+        return path
+
+    def load(
+        self,
+        tenant_id: str,
+        *,
+        template: Any = None,
+        annotations: Optional[Dict[str, str]] = None,
+    ):
+        """Restore the tenant's cut -> ``(payload, header)`` or ``None``."""
+        return self.cuts.load(tenant_id, template=template, annotations=annotations)
+
+    def newest_cut_path(self, tenant_id: str) -> Optional[str]:
+        return self.cuts.newest_path(tenant_id)
+
+    def manifest(self, tenant_id: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._manifest_path(tenant_id)) as fh:
+                return json.load(fh)
+        except FileNotFoundError:
+            return None
+        except (OSError, json.JSONDecodeError) as err:
+            raise MigrationError(
+                f"Unreadable handoff manifest for tenant {tenant_id!r}: {err}"
+            ) from err
+
+    def mark_committed(self, tenant_id: str) -> None:
+        """Flip the manifest to ``"committed"`` — THE durable commit point
+        of the migration (atomic rename)."""
+        data = self.manifest(tenant_id)
+        if data is None:
+            raise MigrationError(
+                f"No handoff manifest for tenant {tenant_id!r} to commit."
+            )
+        data["state"] = "committed"
+        self._write_manifest(tenant_id, data)
+
+    def pending(self) -> List[Dict[str, Any]]:
+        """Every unresolved manifest (sorted by tenant id) — an interrupted
+        migration per entry; feed to :func:`recover_handoffs`."""
+        out = []
+        for name in sorted(os.listdir(self._manifests)):
+            if not name.endswith(".json"):
+                continue
+            with open(os.path.join(self._manifests, name)) as fh:
+                out.append(json.load(fh))
+        return sorted(out, key=lambda m: m.get("tenant", ""))
+
+    def resolve(self, tenant_id: str) -> None:
+        """Drop a finished migration's manifest + cut (idempotent)."""
+        try:
+            os.unlink(self._manifest_path(tenant_id))
+        except FileNotFoundError:
+            pass
+        self.cuts.discard(tenant_id)
+
+    def close(self) -> None:
+        self.cuts.close()
+        if self._owned:
+            shutil.rmtree(self.root, ignore_errors=True)
+
+
+def _record(kind: str, tenant_id: str, **extra: Any) -> None:
+    with _telemetry.attribution(tenant_id):
+        _telemetry.record_event(None, kind, tenant=tenant_id, **extra)
+
+
+def _adopt_from_cut(
+    service: Any,
+    tenant_id: str,
+    manifest_mode: str,
+    meta: Dict[str, Any],
+    metric_factory: Callable[[str], Any],
+    handoff: HandoffStore,
+    register_kw: Optional[Dict[str, Any]],
+) -> None:
+    """Place one cut on ``service`` (the shared adopt step of the live path
+    and crash recovery).  Live cuts load through the durable file — the
+    adopted state is byte-for-byte what recovery would restore."""
+    metric = metric_factory(tenant_id)
+    kw = dict(register_kw or {})
+    if manifest_mode == "live":
+        if meta.get("mode") == "bucketed":
+            got = handoff.load(
+                tenant_id,
+                template=metric.init_state(),
+                annotations=_snapshot.state_annotations(metric),
+            )
+        else:
+            got = handoff.load(tenant_id)
+        if got is None:
+            raise MigrationError(
+                f"Handoff cut for tenant {tenant_id!r} is missing: the "
+                "migration cannot be loss-free."
+            )
+        payload, header = got
+        service.adopt_migrated(tenant_id, metric, payload, header["meta"], **kw)
+    else:
+        path = handoff.newest_cut_path(tenant_id) if manifest_mode == "spill" else None
+        if manifest_mode == "spill" and path is None:
+            raise MigrationError(
+                f"Handoff spill file for tenant {tenant_id!r} is missing."
+            )
+        service.adopt_hibernated(tenant_id, metric, meta, spill_path=path, **kw)
+
+
+def migrate_tenant(
+    source: Any,
+    target: Any,
+    tenant_id: str,
+    *,
+    metric_factory: Callable[[str], Any],
+    handoff: HandoffStore,
+    source_rank: Any = None,
+    target_rank: Any = None,
+    ring: Any = None,
+    register_kw: Optional[Dict[str, Any]] = None,
+) -> MigrationReport:
+    """Move one tenant from ``source`` to ``target`` with zero loss (the
+    module docstring's state machine).  ``metric_factory(tenant_id)`` must
+    build a config-identical metric for the target registration.  Any
+    failure before the manifest commits rolls back to the source — window
+    aborted, adoption withdrawn, tenant never double-resident; the
+    ``tenant_migrate_started/committed/aborted`` ledger events are
+    exactly-once per attempt."""
+    t0 = time.perf_counter()
+    _record(
+        "tenant_migrate_started", tenant_id,
+        source_rank=source_rank, target_rank=target_rank,
+    )
+    adopted = False
+    try:
+        mode, cut, meta = source.begin_migration(tenant_id)
+        if mode == "live":
+            handoff.cut(
+                tenant_id, cut, meta,
+                mode=mode, source_rank=source_rank, target_rank=target_rank,
+            )
+        else:
+            handoff.cut_file(
+                tenant_id, cut, meta,
+                source_rank=source_rank, target_rank=target_rank,
+            )
+        _adopt_from_cut(
+            target, tenant_id, mode, meta, metric_factory, handoff, register_kw
+        )
+        adopted = True
+        handoff.mark_committed(tenant_id)
+    except BaseException as err:
+        source.abort_migration(tenant_id)
+        if adopted:
+            target.withdraw_adoption(tenant_id)
+        handoff.resolve(tenant_id)
+        _record(
+            "tenant_migrate_aborted", tenant_id,
+            source_rank=source_rank, target_rank=target_rank, error=repr(err),
+        )
+        if _instruments.enabled():
+            _MIGRATIONS_TOTAL.inc(1, "aborted")
+        raise
+    # ---- past the commit point: roll forward only
+    epoch = ring.reassign(tenant_id, target_rank) if ring is not None else None
+    source.commit_migration(
+        tenant_id, target_rank=target_rank, routing_epoch=epoch
+    )
+    handoff.resolve(tenant_id)
+    latency_ms = (time.perf_counter() - t0) * 1e3
+    _record(
+        "tenant_migrate_committed", tenant_id,
+        source_rank=source_rank, target_rank=target_rank, mode=mode,
+        batches=int(meta.get("batches", 0)), routing_epoch=epoch,
+        latency_ms=round(latency_ms, 3),
+    )
+    if _instruments.enabled():
+        _MIGRATION_HIST.observe(latency_ms, tenant_id)
+        _MIGRATIONS_TOTAL.inc(1, "committed")
+    return MigrationReport(
+        tenant=tenant_id, source_rank=source_rank, target_rank=target_rank,
+        mode=mode, batches=int(meta.get("batches", 0)),
+        items=int(meta.get("items", 0)), routing_epoch=epoch,
+        latency_ms=latency_ms,
+    )
+
+
+def recover_handoffs(
+    handoff: HandoffStore,
+    services_by_rank: Dict[Any, Any],
+    metric_factory: Callable[[str], Any],
+    *,
+    ring: Any = None,
+    register_kw: Optional[Dict[str, Any]] = None,
+) -> List[MigrationReport]:
+    """Resolve every interrupted migration after a crash: a ``"cut"``
+    manifest means the migration never committed — the tenant belongs to
+    its SOURCE rank, restored from the final cut; a ``"committed"`` one
+    means it already moved — adopt on the TARGET.  Either way the tenant
+    ends resident on exactly one rank; finding it already resident on two
+    raises :class:`MigrationError` (never silently double-count), and a
+    tenant already resident on one rank is left alone (the cut is
+    superseded).  Returns one recovered :class:`MigrationReport` per
+    manifest."""
+    reports: List[MigrationReport] = []
+    for manifest in handoff.pending():
+        tid = manifest["tenant"]
+        meta = manifest.get("meta", {})
+        committed = manifest.get("state") == "committed"
+        owner_rank = manifest["target_rank"] if committed else manifest["source_rank"]
+        present = [
+            rank
+            for rank, svc in sorted(services_by_rank.items(), key=lambda kv: str(kv[0]))
+            if tid in set(svc.tenant_ids())
+        ]
+        if len(present) > 1:
+            raise MigrationError(
+                f"Tenant {tid!r} is resident on ranks {present} during handoff "
+                "recovery: double residency would double-count its stream."
+            )
+        if present:
+            owner_rank = present[0]  # an earlier recovery / re-registration won
+        else:
+            if owner_rank not in services_by_rank:
+                raise MigrationError(
+                    f"Tenant {tid!r} recovers on rank {owner_rank}, which is "
+                    "not in the fleet."
+                )
+            _adopt_from_cut(
+                services_by_rank[owner_rank], tid, manifest.get("mode", "live"),
+                meta, metric_factory, handoff, register_kw,
+            )
+        epoch = ring.reassign(tid, owner_rank) if ring is not None else None
+        handoff.resolve(tid)
+        _record(
+            "tenant_migrate_committed" if committed else "tenant_migrate_aborted",
+            tid,
+            source_rank=manifest.get("source_rank"),
+            target_rank=manifest.get("target_rank"),
+            recovered=True, owner_rank=owner_rank, routing_epoch=epoch,
+        )
+        if _instruments.enabled():
+            _MIGRATIONS_TOTAL.inc(1, "recovered")
+        reports.append(
+            MigrationReport(
+                tenant=tid, source_rank=manifest.get("source_rank"),
+                target_rank=manifest.get("target_rank"),
+                mode=manifest.get("mode", "live"),
+                batches=int(meta.get("batches", 0)),
+                items=int(meta.get("items", 0)),
+                routing_epoch=epoch, recovered=True,
+                extra={"owner_rank": owner_rank, "committed": committed},
+            )
+        )
+    return reports

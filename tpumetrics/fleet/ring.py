@@ -1,0 +1,195 @@
+"""Epoch-versioned consistent-hash routing ring for tenant placement.
+
+The ring answers exactly one question — "who owns tenant T right now?" —
+and stamps every answer with the **routing epoch** under which it was
+produced.  An epoch is a monotonically increasing integer bumped on every
+topology or placement change (rank added/removed, tenant reassigned).  A
+cached ``(tenant -> rank)`` binding is valid only while the epoch it was
+read under is still current; readers that hold bindings across a migration
+seam must re-read after observing an epoch bump (tpulint TPL109 flags code
+that doesn't).
+
+Placement is classic consistent hashing: each rank contributes ``vnodes``
+virtual points on a 64-bit SHA-1 ring and a tenant maps to the first point
+clockwise of its own hash.  Explicit **pins** overlay the hash placement —
+a migration commits by pinning the tenant to its new rank — so the hash
+ring only decides *natural* ownership; :meth:`natural_owner` exposes that
+undecorated answer for rebalancing (move pinned tenants back toward their
+natural rank when the topology changes).
+
+Everything here is process-local, lock-protected, and cheap: O(log V)
+lookups, O(V) topology edits.  Cross-process agreement rides the
+federation plane — the controller publishes :meth:`census` under
+``/statusz`` so any rank can answer ownership questions for the pool.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+__all__ = ["ConsistentHashRing", "RingError"]
+
+
+class RingError(TPUMetricsUserError):
+    """The ring cannot answer (empty ring, unknown rank, bad epoch)."""
+
+
+def _hash(key: str) -> int:
+    """Stable 64-bit ring position (first 8 bytes of SHA-1, big-endian)."""
+    return int.from_bytes(hashlib.sha1(key.encode("utf-8")).digest()[:8], "big")
+
+
+class ConsistentHashRing:
+    """Thread-safe consistent-hash ring with pins and a routing epoch."""
+
+    def __init__(self, ranks: Iterable[int] = (), *, vnodes: int = 64) -> None:
+        if vnodes < 1:
+            raise RingError(f"vnodes must be >= 1, got {vnodes}")
+        self._vnodes = int(vnodes)
+        self._lock = threading.Lock()
+        self._epoch = 0
+        self._ranks: List[int] = []
+        self._points: List[Tuple[int, int]] = []  # (position, rank), sorted
+        self._pins: Dict[str, int] = {}  # tenant id -> pinned rank
+        for rank in ranks:
+            self.add_rank(rank)
+
+    # ------------------------------------------------------------ topology
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    @property
+    def ranks(self) -> Tuple[int, ...]:
+        with self._lock:
+            return tuple(self._ranks)
+
+    @property
+    def vnodes(self) -> int:
+        return self._vnodes
+
+    def add_rank(self, rank: int) -> int:
+        """Add ``rank``'s vnodes; returns the new routing epoch."""
+        rank = int(rank)
+        with self._lock:
+            if rank in self._ranks:
+                raise RingError(f"Rank {rank} is already on the ring")
+            self._ranks.append(rank)
+            self._ranks.sort()
+            for v in range(self._vnodes):
+                pos = _hash(f"rank:{rank}:vnode:{v}")
+                bisect.insort(self._points, (pos, rank))
+            self._epoch += 1
+            return self._epoch
+
+    def remove_rank(self, rank: int) -> int:
+        """Drop ``rank`` (and any pins to it); returns the new epoch."""
+        rank = int(rank)
+        with self._lock:
+            if rank not in self._ranks:
+                raise RingError(f"Rank {rank} is not on the ring")
+            self._ranks.remove(rank)
+            self._points = [(p, r) for (p, r) in self._points if r != rank]
+            for tid in [t for t, r in self._pins.items() if r == rank]:
+                del self._pins[tid]
+            self._epoch += 1
+            return self._epoch
+
+    # ------------------------------------------------------------ placement
+
+    def _natural_locked(self, tenant_id: str) -> int:
+        if not self._points:
+            raise RingError("Ring has no ranks; cannot place a tenant")
+        pos = _hash(f"tenant:{tenant_id}")
+        i = bisect.bisect_right(self._points, (pos, 1 << 62))
+        if i == len(self._points):
+            i = 0  # wrap around the ring
+        return self._points[i][1]
+
+    def owner(self, tenant_id: str) -> Tuple[int, int]:
+        """``(owner_rank, routing_epoch)`` for ``tenant_id`` — pins win."""
+        tenant_id = str(tenant_id)
+        with self._lock:
+            pinned = self._pins.get(tenant_id)
+            rank = pinned if pinned is not None else self._natural_locked(tenant_id)
+            return rank, self._epoch
+
+    def natural_owner(self, tenant_id: str) -> int:
+        """Hash-only placement, ignoring pins (the rebalance target)."""
+        with self._lock:
+            return self._natural_locked(str(tenant_id))
+
+    def reassign(self, tenant_id: str, rank: int) -> int:
+        """Pin ``tenant_id`` to ``rank`` and bump the epoch; returns it."""
+        rank = int(rank)
+        with self._lock:
+            if rank not in self._ranks:
+                raise RingError(f"Cannot pin {tenant_id!r} to rank {rank}: not on the ring")
+            self._pins[str(tenant_id)] = rank
+            self._epoch += 1
+            return self._epoch
+
+    def unpin(self, tenant_id: str) -> int:
+        """Drop an explicit pin (tenant reverts to natural placement)."""
+        with self._lock:
+            self._pins.pop(str(tenant_id), None)
+            self._epoch += 1
+            return self._epoch
+
+    def pins(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._pins)
+
+    # ------------------------------------------------------------ census
+
+    def census(
+        self, tenant_ids: Iterable[str], migrating: Iterable[str] = ()
+    ) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant routing rows for ``/statusz``: ``owner_rank``,
+        ``routing_epoch``, ``migrating``."""
+        moving = {str(t) for t in migrating}
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            for tid in tenant_ids:
+                tid = str(tid)
+                pinned = self._pins.get(tid)
+                rank = pinned if pinned is not None else self._natural_locked(tid)
+                out[tid] = {
+                    "owner_rank": rank,
+                    "routing_epoch": self._epoch,
+                    "migrating": tid in moving,
+                }
+        return out
+
+    # ------------------------------------------------------------ round trip
+
+    def to_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "epoch": self._epoch,
+                "vnodes": self._vnodes,
+                "ranks": list(self._ranks),
+                "pins": dict(self._pins),
+            }
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "ConsistentHashRing":
+        ring = cls(data.get("ranks", ()), vnodes=int(data.get("vnodes", 64)))
+        with ring._lock:
+            ring._pins = {str(k): int(v) for k, v in dict(data.get("pins", {})).items()}
+            ring._epoch = int(data.get("epoch", ring._epoch))
+        return ring
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        with self._lock:
+            return (
+                f"ConsistentHashRing(ranks={self._ranks}, epoch={self._epoch}, "
+                f"pins={len(self._pins)}, vnodes={self._vnodes})"
+            )

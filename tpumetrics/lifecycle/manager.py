@@ -197,6 +197,17 @@ class LifecycleManager:
             self._account_resident_locked(tenant)
         self._publish_gauges_locked()
 
+    def on_migrate_out_locked(self, tenant: Any) -> None:
+        """Drop a tenant that just migrated to another rank from the
+        residency census (service lock held).  The spill-store discard for
+        a hibernated tenant happens outside the lock, in the service's
+        deregistration tail."""
+        if tenant.residency == HIBERNATED:
+            self._hibernated -= 1
+        else:
+            self._resident_bytes -= self._state_bytes.pop(tenant.tid, 0)
+        self._publish_gauges_locked()
+
     # ------------------------------------------------------------- demotion
 
     def hibernate(self, tenant_id: str, *, reason: str = "idle") -> bool:
@@ -214,6 +225,7 @@ class LifecycleManager:
                 or tenant.error is not None
                 or tenant.queue
                 or tenant.pending
+                or tenant.migrating
                 or svc._draining
             ):
                 return False
@@ -354,6 +366,23 @@ class LifecycleManager:
                 residency = tenant.residency
                 if residency == RESIDENT:
                     return
+                if getattr(tenant, "migrated_to", None) is not None:
+                    # the tenant migrated away while this caller waited:
+                    # the service's gate raises the typed moved-refusal
+                    svc._gate_migration_locked(tenant)
+                if getattr(tenant, "migrating", False):
+                    # a hibernated tenant mid-migration ships its spill file
+                    # verbatim: reviving now would discard the file being
+                    # handed off.  Wait the window out (commit/abort notify
+                    # this condition); a committed move refuses via the
+                    # service's migration gate on the next loop.
+                    if tenant.policy == "error":
+                        raise TenantRevivingError(
+                            f"Tenant {tenant.tid!r} is mid-migration under "
+                            "policy='error'; retry once the window closes."
+                        )
+                    self._cond.wait()
+                    continue
                 if residency == HIBERNATED:
                     break
                 # hibernating / reviving: another thread owns the transition

@@ -137,6 +137,45 @@ class SpillStore:
             if self._bytes.pop(tenant_id, None) is not None:
                 self.discards += 1
 
+    def newest_path(self, tenant_id: str) -> Optional[str]:
+        """Path of the tenant's newest cut, or ``None`` (pristine).  A
+        hibernated tenant migrates by shipping this file verbatim — O(1)
+        in state size, no revival."""
+        existing = _snapshot.list_snapshots(self._dir(tenant_id))
+        return existing[-1][1] if existing else None
+
+    def adopt_file(self, tenant_id: str, src_path: str) -> str:
+        """Adopt a foreign cut file (a migrated hibernated tenant) as this
+        store's newest spill for ``tenant_id``.  The file is copied under
+        the next spill sequence via temp-write + atomic rename, so a crash
+        mid-adoption leaves no partial cut behind."""
+        directory = self._dir(tenant_id)
+        os.makedirs(directory, exist_ok=True)
+        seq = self._next_seq(tenant_id, directory)
+        final = os.path.join(directory, f"snapshot-{seq}.npz")
+        fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+        os.close(fd)
+        try:
+            shutil.copyfile(src_path, tmp)
+            os.replace(tmp, final)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        for _, old in _snapshot.list_snapshots(directory)[: -self.keep]:
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
+        size = 0
+        try:
+            size = os.path.getsize(final)
+        except OSError:
+            pass
+        with self._lock:
+            self._bytes[tenant_id] = size
+            self.spills += 1
+        return final
+
     def bytes_for(self, tenant_id: str) -> int:
         with self._lock:
             return self._bytes.get(tenant_id, 0)

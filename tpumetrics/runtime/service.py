@@ -221,6 +221,14 @@ class _Tenant:
         self.residency = "resident"
         self.last_dispatch = time.monotonic()
 
+        # live migration (fleet/migrate.py): ``migrating`` opens the
+        # final-cut window — intake gated by the tenant's own backpressure
+        # policy; ``migrated_to`` is stamped at commit as
+        # ``(target_rank, routing_epoch)`` so woken waiters learn the new
+        # owner.  Both guarded by the service lock.
+        self.migrating = False
+        self.migrated_to: Optional[Tuple[Any, Any]] = None
+
         # device-side observability (health probe + HBM watermark); the
         # alerted set doubles as the minted health-label ledger close()
         # releases, guarded by health_lock (one state_health per corruption)
@@ -364,6 +372,11 @@ class EvaluationService:
         self._megabatch_tenants = 0
         self._mega_group_meta = (0, 0, 0)  # worker-thread-only scratch
         self._quarantines = 0
+        # migration tombstones: tenant id -> (target_rank, routing_epoch).
+        # A submit/compute against a migrated-away id gets a typed
+        # TenantMigratingError naming the new owner instead of a bare
+        # KeyError; re-registration clears the tombstone.
+        self._migrated: Dict[str, Tuple[Any, Any]] = {}
         self._draining = False  # graceful drain: intake refused service-wide
         self._drain_report: Optional[Any] = None
         self._drain_lock = threading.Lock()  # serializes concurrent drain()s
@@ -449,6 +462,7 @@ class EvaluationService:
         partition_rules: Optional[Any] = None,
         data_axis: Optional[str] = None,
         health_probe: bool = False,
+        _start_hibernated: bool = False,
     ) -> TenantHandle:
         """Register one tenant stream; returns its :class:`TenantHandle`.
 
@@ -491,6 +505,11 @@ class EvaluationService:
         if snapshot_every is not None and snapshot_dir is None:
             raise ValueError("snapshot_every requires snapshot_dir")
         kwargs = dict(update_kwargs or {})
+        if _start_hibernated and self._lifecycle is None:
+            raise TPUMetricsUserError(
+                "_start_hibernated registration (a migrated hibernated tenant) "
+                "requires a lifecycle manager (lifecycle=/spill_dir=)."
+            )
 
         if buckets is None:
             if mesh is not None:
@@ -503,7 +522,7 @@ class EvaluationService:
             bucketer = step = None
             state = None
             step_token: Any = ("eager", tenant_id)
-            start_hibernated = False
+            start_hibernated = bool(_start_hibernated)
         else:
             edges = pow2_bucket_edges(int(buckets)) if isinstance(buckets, int) else tuple(buckets)
             bucketer = ShapeBucketer(edges)
@@ -518,7 +537,7 @@ class EvaluationService:
             # is created with NO device allocation and NO scheduler entry —
             # registration of a mostly-idle fleet is O(1) per tenant, and
             # its first submit revives it (a fresh init_state) lazily
-            start_hibernated = (
+            start_hibernated = bool(_start_hibernated) or (
                 self._lifecycle is not None
                 and self._lifecycle.starts_hibernated(step_token)
             )
@@ -550,6 +569,9 @@ class EvaluationService:
                 )
             if tenant_id in self._tenants:
                 raise ValueError(f"tenant {tenant_id!r} is already registered")
+            # a re-registered (or migrated-back) id is a fresh stream: the
+            # migration tombstone no longer describes it
+            self._migrated.pop(tenant_id, None)
             if not start_hibernated:
                 # the scheduler joins FIRST: a failure here must not publish
                 # a half-registered zombie tenant (a hibernated start joins
@@ -562,7 +584,8 @@ class EvaluationService:
         if start_hibernated:
             with _telemetry.attribution(tenant_id):
                 _telemetry.record_event(
-                    self, "tenant_hibernated", reason="register_budget",
+                    self, "tenant_hibernated",
+                    reason="migrate_in" if _start_hibernated else "register_budget",
                     pristine=True, batches=0, spill_bytes=0,
                 )
         elif self._lifecycle is not None:
@@ -663,6 +686,10 @@ class EvaluationService:
         entry = (tuple(args), max(int(n), 1), probe, (root, qspan))
         try:
             while True:
+                # the migration gate comes FIRST: a hibernated tenant whose
+                # spill file is mid-handoff must not be revived here (the
+                # file is the thing being shipped)
+                self._gate_migration(tenant)
                 if self._lifecycle is not None and tenant.residency != "resident":
                     # the FIRST submit over a hibernated tenant revives it
                     # (restore -> re-place -> re-enter the scheduler);
@@ -690,8 +717,19 @@ class EvaluationService:
         """The enqueue body of :meth:`submit` (service lock held): the
         tenant's backpressure policy, then queue + scheduler bookkeeping."""
         tenant_id = tenant.tid
-        self._raise_if_quarantined(tenant)
-        if len(tenant.queue) >= tenant.max_queue:
+        while True:
+            self._raise_if_quarantined(tenant)
+            if tenant.migrating or tenant.migrated_to is not None:
+                # the final-cut window opened (or closed as a commit) while
+                # this submitter held — or waited for — the lock: gate by the
+                # tenant's own policy, then re-check EVERYTHING.  Without
+                # this re-check a block-policy submitter woken for queue
+                # space could enqueue a batch the final cut already missed —
+                # a silently lost update at commit.
+                self._gate_migration_locked(tenant)
+                continue
+            if len(tenant.queue) < tenant.max_queue:
+                break
             if tenant.policy == "error":
                 from tpumetrics.runtime.dispatch import QueueFullError
 
@@ -709,18 +747,17 @@ class EvaluationService:
                     _telemetry.record_event(
                         self, "runtime_drop", dropped_total=tenant.dropped
                     )
-            else:  # block
-                while len(tenant.queue) >= tenant.max_queue:
-                    self._raise_if_quarantined(tenant)
-                    if self._draining:
-                        from tpumetrics.runtime.drain import DrainingError
+                break
+            # block
+            if self._draining:
+                from tpumetrics.runtime.drain import DrainingError
 
-                        raise DrainingError(
-                            f"EvaluationService {self._label!r} began draining "
-                            f"while tenant {tenant_id!r} waited for queue "
-                            "space: intake is closed."
-                        )
-                    self._space.wait()
+                raise DrainingError(
+                    f"EvaluationService {self._label!r} began draining "
+                    f"while tenant {tenant_id!r} waited for queue "
+                    "space: intake is closed."
+                )
+            self._space.wait()
         tenant.queue.append(entry)
         tenant.pending += 1
         tenant.enqueued += 1
@@ -781,6 +818,276 @@ class EvaluationService:
         — the sweep itself is O(registered) in bookkeeping but performs
         I/O only for the tenants it demotes."""
         return self._require_lifecycle().sweep(idle_for=idle_for)
+
+    # ---------------------------------------------------------- live migration
+
+    def _gate_migration(self, tenant: _Tenant) -> None:
+        """Hold the caller at the migration gate when the tenant's final-cut
+        window is open (lock-free fast path; the locked recheck in
+        :meth:`_submit_locked` / the residency loops is authoritative)."""
+        if not tenant.migrating and tenant.migrated_to is None:
+            return
+        with self._lock:
+            self._gate_migration_locked(tenant)
+
+    def _gate_migration_locked(self, tenant: _Tenant) -> None:
+        """The final-cut window gate (service lock held): ``block`` and
+        ``drop_oldest`` tenants wait out the window on the queue-space
+        condition (commit/abort notify it); ``error`` tenants get the typed
+        refusal immediately.  A committed migration wakes waiters with
+        ``migrated_to`` stamped — they are refused toward the new owner."""
+        from tpumetrics.fleet.migrate import TenantMigratingError
+
+        while tenant.migrating:
+            if tenant.policy == "error":
+                raise TenantMigratingError(
+                    f"Tenant {tenant.tid!r} is mid-migration (final-cut window) "
+                    "under policy='error'; retry once the window closes."
+                )
+            self._space.wait()
+        if tenant.migrated_to is not None:
+            rank, epoch = tenant.migrated_to
+            raise TenantMigratingError(
+                f"Tenant {tenant.tid!r} migrated to rank {rank} at routing "
+                f"epoch {epoch}: resubmit to the new owner.",
+                target_rank=rank, routing_epoch=epoch,
+            )
+
+    def begin_migration(self, tenant_id: str) -> Tuple[str, Any, Dict[str, Any]]:
+        """Open the final-cut window on this (source) service and produce
+        the cut: gate intake by the tenant's backpressure policy, flush its
+        pending batches, and return ``(mode, cut, meta)`` where ``mode`` is
+
+        - ``"live"`` — a resident tenant: ``cut`` is the state payload
+          (bucketed pytree or eager ``snapshot_state()``), exactly the
+          atomic-snapshot shape with the batch count stamped in ``meta``;
+        - ``"spill"`` — a hibernated tenant: ``cut`` is the PATH of its
+          newest spill file, shipped verbatim — O(1) in state size, no
+          revival;
+        - ``"pristine"`` — a hibernated tenant that never applied a batch:
+          ``cut`` is ``None`` (the target registers it pre-hibernated).
+
+        The window stays open (intake gated) until :meth:`commit_migration`
+        or :meth:`abort_migration` closes it."""
+        mgr = self._lifecycle
+        with self._lock:
+            while True:
+                tenant = self._tenants.get(tenant_id)
+                if tenant is None:
+                    raise KeyError(f"unknown tenant {tenant_id!r}")
+                self._raise_if_quarantined(tenant)
+                if self._draining:
+                    from tpumetrics.runtime.drain import DrainingError
+
+                    raise DrainingError(
+                        f"EvaluationService {self._label!r} is draining: "
+                        f"tenant {tenant_id!r} cannot migrate out now."
+                    )
+                if tenant.migrating:
+                    raise TPUMetricsUserError(
+                        f"Tenant {tenant_id!r} already has an open migration window."
+                    )
+                if mgr is None or tenant.residency == "resident":
+                    mode = "live"
+                    break
+                if tenant.residency == "hibernated":
+                    mode = "pristine" if tenant.batches == 0 else "spill"
+                    break
+                # hibernating / reviving: the transition owner notifies the
+                # residency condition when it completes — wait it out
+                mgr._cond.wait()
+            tenant.migrating = True
+        if mode != "live":
+            with self._lock:
+                meta = self._cut_meta_locked(tenant)
+            path = mgr.store.newest_path(tenant_id) if mode == "spill" else None
+            if mode == "spill" and path is None:
+                # the spill store lost the cut: the stream cannot move
+                self.abort_migration(tenant_id)
+                raise _snapshot.SnapshotIntegrityError(
+                    f"Tenant {tenant_id!r} hibernated at stream position "
+                    f"{meta['batches']} but its spill store holds no cut: "
+                    "the migration cannot be loss-free."
+                )
+            return mode, path, meta
+        try:
+            # with the window open no NEW batch can be enqueued (the gate in
+            # _submit_locked re-checks after every wake), so after this
+            # flush the tenant's stream position is final
+            self.flush(tenant_id)
+        except BaseException:
+            self.abort_migration(tenant_id)
+            raise
+        with self._lock:
+            meta = self._cut_meta_locked(tenant)
+            payload: Any = (
+                tenant.state
+                if tenant.bucketer is not None
+                else tenant.metric.snapshot_state()
+            )
+        return "live", payload, meta
+
+    def _cut_meta_locked(self, tenant: _Tenant) -> Dict[str, Any]:
+        """The migration cut's header meta — the exact shape the snapshot /
+        spill formats stamp, so restore-side integrity checks apply as-is."""
+        return {
+            "batches": tenant.batches,
+            "items": tenant.items,
+            "metric": type(tenant.metric).__name__,
+            "mode": "bucketed" if tenant.bucketer is not None else "eager",
+            "degraded": tenant.degraded,
+            "tenant": tenant.tid,
+        }
+
+    def abort_migration(self, tenant_id: str) -> bool:
+        """Close an open final-cut window WITHOUT moving the tenant: it
+        stays (or re-becomes) the live resident stream here, gated waiters
+        resume, and nothing was lost (the window admitted no batches).
+        Idempotent; returns whether a window was actually open."""
+        with self._lock:
+            tenant = self._tenants.get(tenant_id)
+            if tenant is None or not tenant.migrating:
+                return False
+            tenant.migrating = False
+            self._space.notify_all()
+            self._done.notify_all()
+            if self._lifecycle is not None:
+                self._lifecycle._cond.notify_all()
+            return True
+
+    def commit_migration(
+        self, tenant_id: str, *, target_rank: Any = None, routing_epoch: Any = None
+    ) -> None:
+        """Finalize an outbound migration: deregister the tenant here,
+        tombstone its id toward ``(target_rank, routing_epoch)``, release
+        its series/buffers (or discard its spill — the target adopted the
+        file), and wake gated waiters into the typed moved-refusal."""
+        with self._lock:
+            tenant = self._tenants.get(tenant_id)
+            if tenant is None or not tenant.migrating:
+                raise TPUMetricsUserError(
+                    f"Tenant {tenant_id!r} has no open migration window to commit."
+                )
+            was_hibernated = self._deregister_locked(
+                tenant, (target_rank, routing_epoch)
+            )
+        self._deregister_finish(tenant, was_hibernated)
+
+    def withdraw_adoption(self, tenant_id: str) -> None:
+        """Roll back an adoption on this (target) service: deregister the
+        just-adopted tenant WITHOUT a tombstone (it still lives on the
+        source).  Refused once the tenant accepted work here — at that
+        point the adoption is the live stream and rollback would lose it."""
+        with self._lock:
+            tenant = self._tenants.get(tenant_id)
+            if tenant is None:
+                raise KeyError(f"unknown tenant {tenant_id!r}")
+            if tenant.queue or tenant.pending or tenant.enqueued:
+                raise TPUMetricsUserError(
+                    f"Tenant {tenant_id!r} accepted work since adoption; "
+                    "withdrawing now would lose updates."
+                )
+            was_hibernated = self._deregister_locked(tenant, None)
+        self._deregister_finish(tenant, was_hibernated)
+
+    def _deregister_locked(
+        self, tenant: _Tenant, moved_to: Optional[Tuple[Any, Any]]
+    ) -> bool:
+        """Remove one tenant from every locked structure (service lock
+        held); ``moved_to`` non-None stamps the migration tombstone.
+        Returns whether the tenant left in the hibernated state (the caller
+        finishes the matching release path outside the lock)."""
+        tid = tenant.tid
+        tenant.migrating = False
+        tenant.migrated_to = moved_to
+        del self._tenants[tid]
+        if moved_to is not None:
+            self._migrated[tid] = moved_to
+        was_hibernated = tenant.residency == "hibernated"
+        if not was_hibernated:
+            self._drr.remove(tid)
+        self._unmark_ready(tenant)
+        if self._lifecycle is not None:
+            self._lifecycle.on_migrate_out_locked(tenant)
+        if tenant.error is not None:
+            self._quarantines -= 1
+        _TENANTS_GAUGE.set(len(self._tenants) - self._quarantines, self._label)
+        self._space.notify_all()
+        self._done.notify_all()
+        if self._lifecycle is not None:
+            self._lifecycle._cond.notify_all()
+        return was_hibernated
+
+    def _deregister_finish(self, tenant: _Tenant, was_hibernated: bool) -> None:
+        """The out-of-lock deregistration tail: series release and state
+        drop for a resident leaver, spill discard for a hibernated one
+        (the file moved with it), backbone references either way."""
+        if was_hibernated:
+            if self._lifecycle is not None:
+                self._lifecycle.store.discard(tenant.tid)
+        else:
+            self._release_tenant_series(tenant)
+            tenant.state = None
+            if tenant.bucketer is None:
+                tenant.metric.reset()
+        release = getattr(tenant.metric, "release_backbones", None)
+        if callable(release):
+            release()
+
+    def adopt_migrated(
+        self,
+        tenant_id: str,
+        metric: Any,
+        payload: Any,
+        meta: Dict[str, Any],
+        **register_kw: Any,
+    ) -> TenantHandle:
+        """Adopt a live-migrated tenant on this (target) service: register
+        it fresh, then place the final cut — batch count, items, and
+        degraded flag stamped from the cut's meta.  Registration's own
+        duplicate check IS the exactly-once guard: a second adoption of the
+        same id raises before any state moves."""
+        handle = self.register(tenant_id, metric, **register_kw)
+        tenant = self._get(tenant_id)
+        if self._lifecycle is not None and tenant.residency != "resident":
+            # a saturated budget started the registration hibernated —
+            # adoption needs a resident target (pristine revival: fresh state)
+            self._lifecycle.ensure_resident(tenant)
+        with self._lock:
+            self._adopt_snapshot_locked(tenant, (payload, {"meta": dict(meta)}))
+            if self._lifecycle is not None:
+                self._lifecycle._account_resident_locked(tenant)
+        return handle
+
+    def adopt_hibernated(
+        self,
+        tenant_id: str,
+        metric: Any,
+        meta: Dict[str, Any],
+        spill_path: Optional[str] = None,
+        **register_kw: Any,
+    ) -> TenantHandle:
+        """Adopt a hibernated tenant on this (target) service at O(1):
+        register it directly in the hibernated state, adopt its spill file
+        verbatim (``None`` = a pristine tenant with nothing to ship), and
+        stamp its stream position — no revival, no device allocation.  Its
+        next submit/compute revives it here bit-identically."""
+        mgr = self._require_lifecycle()
+        handle = self.register(
+            tenant_id, metric, _start_hibernated=True, **register_kw
+        )
+        if spill_path is not None:
+            mgr.store.adopt_file(tenant_id, spill_path)
+        tenant = self._get(tenant_id)
+        with self._lock:
+            tenant.batches = int(meta.get("batches", 0))
+            tenant.items = int(meta.get("items", 0))
+            tenant.last_compute_at = tenant.batches
+            tenant.journal = []
+            tenant.journal_base = tenant.batches
+            tenant.degraded = bool(meta.get("degraded", False))
+            mgr._publish_gauges_locked()
+        return handle
 
     # --------------------------------------------------------- graceful drain
 
@@ -937,6 +1244,9 @@ class EvaluationService:
         tenant = self._get(tenant_id)
         self.flush(tenant_id)
         while True:
+            # compute during the final-cut window gates like submit: block /
+            # drop_oldest wait the window out, error gets the typed refusal
+            self._gate_migration(tenant)
             if self._lifecycle is not None and tenant.residency != "resident":
                 # a hibernated tenant's result is served by reviving it:
                 # restore -> re-place -> the SAME functional compute an
@@ -947,6 +1257,9 @@ class EvaluationService:
             # non-finite guard turns the corruption into an exception
             self._refresh_health(tenant)
             with self._lock, stream_scope(tenant.tid):
+                if tenant.migrating or tenant.migrated_to is not None:
+                    self._gate_migration_locked(tenant)
+                    continue  # the window closed as an abort: state is live
                 if self._lifecycle is not None and tenant.residency != "resident":
                     continue  # an idle sweep raced the revival: revive again
                 return self._compute_locked(tenant)
@@ -1043,6 +1356,8 @@ class EvaluationService:
             # lifecycle census: resident / hibernating / hibernated /
             # reviving (always "resident" without a lifecycle manager)
             "residency": tenant.residency,
+            # live-migration census: True while the final-cut window is open
+            "migrating": tenant.migrating,
         }
         if tenant.bucketer is not None:
             leaves = jax.tree_util.tree_leaves(tenant.state)
@@ -1089,6 +1404,7 @@ class EvaluationService:
                     "quarantined": False, "degraded": False, "crashes": 0,
                     "restores": 0, "buckets": None, "quota": tenant.quota,
                     "residency": tenant.residency,
+                    "migrating": tenant.migrating,
                 }
                 hbm = dict(tenant.hbm_cache)
             health_dev = paths = None
@@ -1204,9 +1520,13 @@ class EvaluationService:
             )
         self.flush(tenant_id)
         while True:
+            self._gate_migration(tenant)
             if self._lifecycle is not None and tenant.residency != "resident":
                 self._lifecycle.ensure_resident(tenant)
             with self._lock:
+                if tenant.migrating or tenant.migrated_to is not None:
+                    self._gate_migration_locked(tenant)
+                    continue
                 if self._lifecycle is not None and tenant.residency != "resident":
                     continue  # an idle sweep raced the revival
                 self._raise_if_quarantined(tenant)
@@ -1260,11 +1580,15 @@ class EvaluationService:
                 f"Tenant {tenant_id!r} was registered without snapshot_dir"
             )
         while True:
+            self._gate_migration(tenant)
             if self._lifecycle is not None and tenant.residency != "resident":
                 # a pristine hibernated tenant may restore_latest: revival
                 # is a fresh state, which is exactly what restore expects
                 self._lifecycle.ensure_resident(tenant)
             with self._lock:
+                if tenant.migrating or tenant.migrated_to is not None:
+                    self._gate_migration_locked(tenant)
+                    continue
                 if self._lifecycle is not None and tenant.residency != "resident":
                     continue  # an idle sweep raced the revival
                 self._raise_if_quarantined(tenant)
@@ -1326,6 +1650,16 @@ class EvaluationService:
     def _get(self, tenant_id: str) -> _Tenant:
         tenant = self._tenants.get(tenant_id)
         if tenant is None:
+            moved = self._migrated.get(tenant_id)
+            if moved is not None:
+                from tpumetrics.fleet.migrate import TenantMigratingError
+
+                raise TenantMigratingError(
+                    f"Tenant {tenant_id!r} migrated to rank {moved[0]} at "
+                    f"routing epoch {moved[1]}: re-read the routing ring and "
+                    "resubmit to the new owner.",
+                    target_rank=moved[0], routing_epoch=moved[1],
+                )
             raise KeyError(f"unknown tenant {tenant_id!r}")
         return tenant
 

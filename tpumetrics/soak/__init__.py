@@ -41,6 +41,7 @@ the ``-m slow`` pytest short soak (``tests/test_soak.py``), and the
 preemption runbook" section of ``docs/resilience.md``.
 """
 
+from tpumetrics.soak.fleet import FleetSoakError, run_fleet_soak
 from tpumetrics.soak.schedule import (
     ChaosSchedule,
     Incident,
@@ -53,8 +54,10 @@ __all__ = [
     "ChaosSchedule",
     "ChaosSoakError",
     "FileBarrierBackend",
+    "FleetSoakError",
     "Incident",
     "SoakSupervisor",
     "generate_schedule",
+    "run_fleet_soak",
     "run_soak",
 ]

@@ -1,0 +1,261 @@
+"""Fleet-layer chaos soak: seeded migrations, resizes, and SIGKILL
+mid-migration, with standing zero-loss gates.
+
+:mod:`~tpumetrics.soak.supervisor` gates the *rank* failure domain (real
+subprocesses, real signals, coordinated cuts).  This runner gates the
+*placement* failure domain on top of it: an in-process
+:class:`~tpumetrics.fleet.FleetController` executes a
+``generate_schedule(fleet=True)`` schedule — each leg feeds deterministic
+traffic (:mod:`~tpumetrics.soak.traffic`), then performs one incident:
+
+- ``migrate`` — a seeded tenant moves to a seeded target rank through the
+  zero-loss two-phase handoff; with ``abrupt=True`` the whole pool is
+  SIGKILLed mid-migration (after the cut — and, on a seeded coin, after
+  the manifest committed), rebuilt cold on the same handoff root, and
+  :meth:`~tpumetrics.fleet.FleetController.recover` must land the tenant
+  on exactly one rank, chosen by the manifest state.
+- ``resize`` — the pool grows or shrinks to ``world_after``, migrating
+  every displaced tenant.
+
+After EVERY incident the standing gates run: each tenant resident on
+exactly one rank (the census agrees), ``compute()`` bit-identical to an
+unmigrated single-service oracle over its full fed stream, and zero lost
+or double-counted rows (the confusion-matrix total IS the row count, so
+loss and double-count are both visible in one integer).  The report
+carries the migration-latency p99 the ``fleet_resize`` bench ceiling
+gates.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional
+
+from tpumetrics.soak.schedule import ChaosSchedule, ScheduleError
+from tpumetrics.soak.traffic import make_batch, make_metric, oracle_value, values_equal
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+__all__ = ["FleetSoakError", "run_fleet_soak"]
+
+
+class FleetSoakError(TPUMetricsUserError):
+    """A standing fleet-soak gate failed (lost update, double residency,
+    divergent compute, or an incident that did not recover)."""
+
+
+def _tenant_seed(schedule: ChaosSchedule, idx: int) -> int:
+    # disjoint per-tenant streams derived from the schedule's traffic seed
+    return int(schedule.traffic_seed) * 1000 + 101 * idx
+
+
+def _quantile(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    pos = min(len(ordered) - 1, int(q * len(ordered)))
+    return ordered[pos]
+
+
+def run_fleet_soak(
+    schedule: ChaosSchedule,
+    *,
+    tenants: int = 4,
+    handoff_dir: Optional[str] = None,
+    register_kw: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Execute a ``fleet=True`` chaos schedule and return the gate report
+    (module docstring).  Raises :class:`FleetSoakError` on the first gate
+    violation — the gates are the point, not the report."""
+    if tenants < 1:
+        raise ScheduleError(f"tenants must be >= 1, got {tenants}")
+    for inc in schedule.incidents:
+        if inc.kind not in ("migrate", "resize"):
+            raise ScheduleError(
+                f"run_fleet_soak executes fleet schedules only; got {inc.kind!r} "
+                "(use generate_schedule(fleet=True))"
+            )
+    from tpumetrics.fleet import FleetController
+
+    tids = [f"ft-{i}" for i in range(tenants)]
+    seeds = {tid: _tenant_seed(schedule, i) for i, tid in enumerate(tids)}
+    fed: Dict[str, int] = {tid: 0 for tid in tids}
+
+    def factory(tid: str) -> Any:
+        return make_metric(schedule.num_classes)
+
+    def build(ranks: int) -> FleetController:
+        return FleetController(
+            factory, ranks=ranks, handoff_dir=handoff_dir,
+            register_kw=dict(register_kw or {}),
+        )
+
+    fc = build(schedule.world)
+    latencies: List[float] = []
+    incident_log: List[Dict[str, Any]] = []
+    lost_updates = 0
+    try:
+        for tid in tids:
+            fc.register(tid)
+        for leg, inc in enumerate(schedule.incidents):
+            rng = random.Random(int(schedule.seed) * 100003 + leg)
+            for _ in range(inc.feed):
+                tid = rng.choice(tids)
+                fc.submit(
+                    tid,
+                    *make_batch(
+                        seeds[tid], fed[tid],
+                        num_classes=schedule.num_classes,
+                        max_rows=schedule.max_rows,
+                    ),
+                )
+                fed[tid] += 1
+            entry: Dict[str, Any] = {"leg": leg, "kind": inc.kind, "abrupt": inc.abrupt}
+            if inc.kind == "resize":
+                reports = fc.resize(inc.world_after)
+                if fc.world != inc.world_after:
+                    raise FleetSoakError(
+                        f"leg {leg}: resize targeted {inc.world_after} ranks, "
+                        f"fleet has {fc.world}"
+                    )
+                latencies.extend(r.latency_ms for r in reports)
+                entry.update(world=fc.world, moved=len(reports))
+            else:
+                tid = inc.tenant or rng.choice(tids)
+                ranks = fc.ranks
+                source = next(r for r in ranks if tid in fc.service(r).tenant_ids())
+                if inc.target_rank is not None:
+                    target = inc.target_rank
+                else:
+                    others = [r for r in ranks if r != source]
+                    target = rng.choice(others) if others else source
+                if inc.abrupt:
+                    fc = _sigkill_mid_migration(
+                        fc, build, schedule, tid, source, target,
+                        commit_first=rng.random() < 0.5,
+                        tids=tids, seeds=seeds, fed=fed,
+                    )
+                    entry.update(tenant=tid, source=source, target=target,
+                                 recovered=True)
+                else:
+                    report = fc.migrate(tid, target)
+                    if report is not None:
+                        latencies.append(report.latency_ms)
+                    entry.update(tenant=tid, source=source, target=target)
+            # ---- standing gates, after EVERY incident
+            census = fc.census()
+            for tid in tids:
+                homes = [r for r in fc.ranks if tid in fc.service(r).tenant_ids()]
+                if len(homes) != 1:
+                    raise FleetSoakError(
+                        f"leg {leg}: tenant {tid!r} resident on ranks {homes} "
+                        "(exactly-once violated)"
+                    )
+                if census[tid]["owner_rank"] != homes[0]:
+                    raise FleetSoakError(
+                        f"leg {leg}: census says rank {census[tid]['owner_rank']} "
+                        f"for {tid!r} but it lives on {homes[0]}"
+                    )
+                got = fc.compute(tid)
+                want = oracle_value(
+                    seeds[tid], range(fed[tid]),
+                    num_classes=schedule.num_classes,
+                    max_rows=schedule.max_rows,
+                )
+                lost = int(want["confmat"].sum()) - int(got["confmat"].sum())
+                if lost:
+                    lost_updates += abs(lost)
+                    raise FleetSoakError(
+                        f"leg {leg}: tenant {tid!r} {'lost' if lost > 0 else 'double-counted'} "
+                        f"{abs(lost)} rows"
+                    )
+                if not values_equal(got, want):
+                    raise FleetSoakError(
+                        f"leg {leg}: tenant {tid!r} compute() diverged from the "
+                        "unmigrated oracle"
+                    )
+            incident_log.append(entry)
+        return {
+            "seed": schedule.seed,
+            "legs": len(schedule.incidents),
+            "tenants": tenants,
+            "world": fc.world,
+            "routing_epoch": fc.ring.epoch,
+            "bit_identical": True,
+            "exactly_once": True,
+            "lost_updates": lost_updates,
+            "migrations": len(latencies),
+            "migration_latency_p99_ms": _quantile(latencies, 0.99),
+            "migration_latency_p50_ms": _quantile(latencies, 0.50),
+            "incidents": incident_log,
+        }
+    finally:
+        fc.close(drain=False)
+
+
+def _sigkill_mid_migration(
+    fc: Any,
+    build: Any,
+    schedule: ChaosSchedule,
+    tid: str,
+    source: int,
+    target: int,
+    *,
+    commit_first: bool,
+    tids: List[str],
+    seeds: Dict[str, int],
+    fed: Dict[str, int],
+) -> Any:
+    """Kill the pool mid-migration and recover it from the handoff root.
+
+    The kill lands at one of the two durable states the manifest can hold:
+    after the final cut (``commit_first=False`` — the migration never
+    happened, the tenant recovers on the SOURCE) or after the manifest
+    committed (``commit_first=True`` — it already did, recover on the
+    TARGET).  The rebuilt pool re-registers and deterministically replays
+    every OTHER tenant (standing in for their own snapshot recovery, which
+    the rank soak gates); the victim must come back from the cut alone,
+    batch count intact."""
+    src = fc.service(source)
+    mode, cut, meta = src.begin_migration(tid)
+    if mode == "live":
+        fc.handoff.cut(tid, cut, meta, mode=mode, source_rank=source,
+                       target_rank=target)
+    else:
+        fc.handoff.cut_file(tid, cut, meta, source_rank=source,
+                            target_rank=target)
+    if commit_first and target != source:
+        # the crash lands between the manifest flip and the ring/source
+        # bookkeeping — the worst window: only the manifest state survives
+        # to arbitrate ownership
+        fc.handoff.mark_committed(tid)
+    world = fc.world
+    fc.close(drain=False)  # SIGKILL: every rank's memory is gone
+
+    fc = build(world)
+    for other in tids:
+        if other == tid:
+            continue
+        fc.register(other)
+        for i in range(fed[other]):
+            fc.submit(
+                other,
+                *make_batch(
+                    seeds[other], i,
+                    num_classes=schedule.num_classes,
+                    max_rows=schedule.max_rows,
+                ),
+            )
+    reports = fc.recover()
+    mine = [r for r in reports if r.tenant == tid]
+    if len(mine) != 1:
+        raise FleetSoakError(
+            f"SIGKILL recovery produced {len(mine)} reports for {tid!r}, "
+            "expected exactly one"
+        )
+    expect = target if (commit_first and target != source) else source
+    if mine[0].extra.get("owner_rank") != expect:
+        raise FleetSoakError(
+            f"{tid!r} recovered on rank {mine[0].extra.get('owner_rank')}, "
+            f"manifest state demands {expect}"
+        )
+    return fc

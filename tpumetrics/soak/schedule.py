@@ -39,6 +39,12 @@ from tpumetrics.utils.exceptions import TPUMetricsUserError
 __all__ = ["ChaosSchedule", "Incident", "ScheduleError", "generate_schedule"]
 
 KINDS = ("sigkill", "sigterm", "shrink", "grow")
+# fleet-layer incidents (tpumetrics.soak.fleet runner): a zero-loss tenant
+# migration ("migrate", abrupt=True SIGKILLs the pool mid-migration and
+# recovers from the handoff manifest) and an SLO-style pool resize
+# ("resize", world_after != world).  Kept out of KINDS so pinned legacy
+# seeds stay byte-identical; generate_schedule(fleet=True) opts in.
+FLEET_KINDS = ("migrate", "resize")
 
 
 class ScheduleError(TPUMetricsUserError):
@@ -57,12 +63,19 @@ class Incident:
     target_rank: Optional[int] = None  # victim rank for abrupt incidents
     tail: int = 0  # batches fed after the last cut (lost by an abrupt kill)
     lose_member: bool = False  # destroy the victim's newest cut member too
+    tenant: Optional[str] = None  # migration subject (fleet kinds; None = seeded)
 
     def validate(self, world_before: int, min_world: int = 1) -> None:
-        if self.kind not in KINDS:
-            raise ScheduleError(f"Unknown incident kind {self.kind!r}; expected one of {KINDS}")
+        if self.kind not in KINDS + FLEET_KINDS:
+            raise ScheduleError(
+                f"Unknown incident kind {self.kind!r}; expected one of "
+                f"{KINDS + FLEET_KINDS}"
+            )
         if self.feed < 1:
             raise ScheduleError(f"{self.kind}: feed must be >= 1, got {self.feed}")
+        if self.kind in FLEET_KINDS:
+            self._validate_fleet(world_before, min_world)
+            return
         if self.world_after < max(1, min_world):
             raise ScheduleError(
                 f"{self.kind}: world_after must be >= {max(1, min_world)}, got {self.world_after}"
@@ -100,6 +113,44 @@ class Incident:
                 raise ScheduleError(f"{self.kind}: graceful incidents drain everything (tail=0)")
             if self.lose_member:
                 raise ScheduleError("lose_member needs an abrupt incident")
+
+    def _validate_fleet(self, world_before: int, min_world: int) -> None:
+        # the fleet runner's kill point is mid-MIGRATION (between cut and
+        # commit), not mid-stream, so tail/lose_member don't apply; the
+        # manifest is the single durable artifact being exercised
+        if self.tail or self.lose_member:
+            raise ScheduleError(
+                f"{self.kind}: fleet incidents take no tail/lose_member"
+            )
+        if self.kind == "migrate":
+            if self.world_after != world_before:
+                raise ScheduleError(
+                    f"migrate must keep the world ({world_before} -> {self.world_after})"
+                )
+            if self.target_rank is not None and not (
+                0 <= self.target_rank < world_before
+            ):
+                raise ScheduleError(
+                    f"migrate: target_rank must be in [0, {world_before}) or None, "
+                    f"got {self.target_rank}"
+                )
+        else:  # resize
+            if self.world_after == world_before:
+                raise ScheduleError(
+                    f"resize must change the world (stayed {world_before})"
+                )
+            if self.world_after < max(1, min_world):
+                raise ScheduleError(
+                    f"resize: world_after must be >= {max(1, min_world)}, "
+                    f"got {self.world_after}"
+                )
+            if self.abrupt:
+                raise ScheduleError(
+                    "resize is always graceful; SIGKILL coverage rides "
+                    "abrupt migrate incidents"
+                )
+            if self.target_rank is not None or self.tenant is not None:
+                raise ScheduleError("resize takes no target_rank/tenant")
 
 
 @dataclass(frozen=True)
@@ -172,6 +223,7 @@ def generate_schedule(
     feed_low: int = 6,
     feed_high: int = 16,
     cut_every: int = 4,
+    fleet: bool = False,
     **schedule_kwargs: Any,
 ) -> ChaosSchedule:
     """Derive a legal chaos schedule from one seed.
@@ -182,12 +234,25 @@ def generate_schedule(
     ``[min_world, max_world]`` throughout; every abrupt incident gets a
     seeded victim and a seeded post-cut ``tail`` so kills land at arbitrary
     stream points.  Same seed → byte-identical schedule.
+
+    ``fleet=True`` switches to the fleet-layer mix (``FLEET_KINDS``, run by
+    :func:`tpumetrics.soak.fleet.run_fleet_soak`): migrations and pool
+    resizes, guaranteeing (for ``n_incidents >= 3``) at least one ABRUPT
+    migrate (SIGKILL mid-migration), one grow and one shrink.  The flag is
+    an explicit opt-in precisely so ``fleet=False`` schedules stay
+    byte-identical to every pinned pre-fleet seed.
     """
     if n_incidents < 1:
         raise ScheduleError(f"n_incidents must be >= 1, got {n_incidents}")
     if not (1 <= min_world <= world <= max_world):
         raise ScheduleError(
             f"need 1 <= min_world <= world <= max_world, got {min_world}/{world}/{max_world}"
+        )
+    if fleet:
+        return _generate_fleet_schedule(
+            seed, world=world, n_incidents=n_incidents, min_world=min_world,
+            max_world=max_world, feed_low=feed_low, feed_high=feed_high,
+            cut_every=cut_every, **schedule_kwargs,
         )
     rng = random.Random(seed)
     required = list(KINDS) if n_incidents >= len(KINDS) else list(KINDS[:n_incidents])
@@ -234,6 +299,70 @@ def generate_schedule(
         incidents.append(inc)
         cur = inc.world_after
 
+    return ChaosSchedule(
+        seed=seed, world=world, incidents=tuple(incidents), cut_every=cut_every,
+        **schedule_kwargs,
+    )
+
+
+def _generate_fleet_schedule(
+    seed: int,
+    *,
+    world: int,
+    n_incidents: int,
+    min_world: int,
+    max_world: int,
+    feed_low: int,
+    feed_high: int,
+    cut_every: int,
+    **schedule_kwargs: Any,
+) -> ChaosSchedule:
+    """The ``fleet=True`` arm of :func:`generate_schedule`: seeded
+    ``migrate``/``resize`` legs, with the acceptance trio (abrupt migrate,
+    grow, shrink) guaranteed once ``n_incidents >= 3``.  Tenants and
+    migration targets stay ``None`` here — the fleet runner derives both
+    from the same seed, so they track the live world at execution time."""
+    rng = random.Random(seed)
+    required = ["migrate", "resize", "resize"][:n_incidents]
+    rng.shuffle(required)
+    kinds = required + [
+        rng.choice(FLEET_KINDS) for _ in range(n_incidents - len(required))
+    ]
+    # force the guaranteed trio: the required "migrate" slot is abrupt
+    # (SIGKILL mid-migration), the two required resizes go opposite ways
+    force_abrupt = {kinds.index("migrate")} if "migrate" in kinds else set()
+    resize_dirs = []  # seeded grow/shrink balance for the required resizes
+    incidents = []
+    cur = world
+    for idx, kind in enumerate(kinds):
+        feed = rng.randint(feed_low, feed_high)
+        if kind == "migrate":
+            abrupt = idx in force_abrupt or rng.random() < 0.34
+            incidents.append(
+                Incident(kind="migrate", feed=feed, world_after=cur, abrupt=abrupt)
+            )
+        else:
+            grow_ok, shrink_ok = cur < max_world, cur > min_world
+            if not resize_dirs and grow_ok and shrink_ok:
+                want_grow = rng.random() < 0.5
+            else:
+                # alternate the forced directions, bounded by legality
+                want_grow = grow_ok and (not shrink_ok or "grow" not in resize_dirs)
+            if not grow_ok and not shrink_ok:  # min==max: degrade to migrate
+                incidents.append(
+                    Incident(kind="migrate", feed=feed, world_after=cur, abrupt=True)
+                )
+                cur = incidents[-1].world_after
+                continue
+            world_after = (
+                rng.randint(cur + 1, max_world) if want_grow
+                else rng.randint(min_world, cur - 1)
+            )
+            resize_dirs.append("grow" if want_grow else "shrink")
+            incidents.append(
+                Incident(kind="resize", feed=feed, world_after=world_after)
+            )
+        cur = incidents[-1].world_after
     return ChaosSchedule(
         seed=seed, world=world, incidents=tuple(incidents), cut_every=cut_every,
         **schedule_kwargs,

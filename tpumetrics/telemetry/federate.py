@@ -46,18 +46,27 @@ SNAPSHOT_VERSION = 1
 
 
 def local_snapshot(
-    rank: Optional[int] = None, include_ledger: bool = True
+    rank: Optional[int] = None,
+    include_ledger: bool = True,
+    fleet: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """This process's aggregate telemetry as one JSON-able dict: every
     registered instrument (:meth:`~tpumetrics.telemetry.instruments.
     Instrument.to_dict`, sketch state included) plus the global ledger's
-    counters.  A pure read — nothing is minted, reset, or synced."""
-    return {
+    counters.  A pure read — nothing is minted, reset, or synced.
+    ``fleet`` (optional) attaches the placement layer's routing census —
+    ``{"routing_epoch": int, "tenants": {tid: {"owner_rank", "routing_epoch",
+    "migrating"}}, ...}`` — so any rank holding the merged view can answer
+    "who owns tenant T"."""
+    out = {
         "v": SNAPSHOT_VERSION,
         "rank": rank if rank is not None else os.getpid(),
         "instruments": [inst.to_dict() for inst in _instruments.registry()],
         "ledger": _ledger.summary() if include_ledger else None,
     }
+    if fleet is not None:
+        out["fleet"] = fleet
+    return out
 
 
 class FederationError(ValueError):
@@ -94,10 +103,12 @@ class FederatedView:
     """N merged snapshots, rendered as one exposition / one status dict."""
 
     def __init__(self, families: Dict[str, Dict[str, Any]],
-                 ledger: Dict[str, Any], ranks: List[Any]) -> None:
+                 ledger: Dict[str, Any], ranks: List[Any],
+                 fleet: Optional[Dict[str, Any]] = None) -> None:
         self._families = families
         self._ledger = ledger
         self.ranks = ranks
+        self._fleet = fleet
 
     # ------------------------------------------------------------ renderers
 
@@ -217,6 +228,8 @@ class FederatedView:
                 "p50": self.quantile(name, 0.50),
                 "p99": self.quantile(name, 0.99),
             }
+        if self._fleet is not None:
+            out["fleet"] = self._fleet
         return out
 
 
@@ -228,8 +241,34 @@ def merge_snapshots(snapshots: List[Dict[str, Any]]) -> FederatedView:
     families: Dict[str, Dict[str, Any]] = {}
     ledger_merged: Dict[str, Any] = {}
     ranks: List[Any] = []
+    fleet_merged: Optional[Dict[str, Any]] = None
     for snap in snapshots:
         ranks.append(snap.get("rank"))
+        fleet = snap.get("fleet")
+        if fleet is not None:
+            if fleet_merged is None:
+                fleet_merged = {
+                    k: (dict(v) if isinstance(v, dict) else v)
+                    for k, v in fleet.items()
+                }
+            else:
+                # epochs are totally ordered: the freshest census wins per
+                # tenant (a stale rank's routing row must not mask a newer
+                # placement), scalar fields follow the max epoch
+                a, b = fleet_merged, fleet
+                newest = b if b.get("routing_epoch", 0) >= a.get("routing_epoch", 0) else a
+                tenants = dict(a.get("tenants", {}))
+                for tid, row in b.get("tenants", {}).items():
+                    have = tenants.get(tid)
+                    if have is None or row.get("routing_epoch", 0) >= have.get(
+                        "routing_epoch", 0
+                    ):
+                        tenants[tid] = dict(row)
+                fleet_merged = {
+                    k: (v if k != "tenants" else tenants)
+                    for k, v in newest.items()
+                }
+                fleet_merged["tenants"] = tenants
         for fam in snap.get("instruments", []):
             name = fam["name"]
             got = families.get(name)
@@ -279,4 +318,4 @@ def merge_snapshots(snapshots: List[Dict[str, Any]]) -> FederatedView:
                         bucket[op] = bucket.get(op, 0.0) + n
                 elif isinstance(val, (int, float)):
                     ledger_merged[key] = ledger_merged.get(key, 0) + val
-    return FederatedView(families, ledger_merged, ranks)
+    return FederatedView(families, ledger_merged, ranks, fleet=fleet_merged)

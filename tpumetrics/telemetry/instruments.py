@@ -200,6 +200,12 @@ SLO_VIOLATIONS = "tpumetrics_slo_violations_total"
 RESIDENT_TENANTS = "tpumetrics_resident_tenants"
 HIBERNATED_BYTES = "tpumetrics_hibernated_bytes"
 REVIVAL_LATENCY_MS = "tpumetrics_revival_latency_ms"
+# fleet placement + migration (fleet/)
+FLEET_RANKS = "tpumetrics_fleet_ranks"
+ROUTING_EPOCH = "tpumetrics_routing_epoch"
+MIGRATION_LATENCY_MS = "tpumetrics_migration_latency_ms"
+MIGRATIONS_TOTAL = "tpumetrics_migrations_total"
+AUTOSCALE_DECISIONS = "tpumetrics_autoscale_decisions_total"
 
 
 def enabled() -> bool:
