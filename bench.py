@@ -2484,6 +2484,78 @@ def _bench_chaos_soak():
     return wall_us, None, {"extras": extras}
 
 
+def _bench_storage_faults():
+    """The storage-fault path as a STANDING bench gate (ISSUE 19): a real
+    2-process pool under a deterministic storage-incident schedule — one
+    transient-EIO window (``io_flaky``), one bounded ENOSPC window
+    (``disk_full``), one on-disk cut corruption (``corrupt_cut``) — plus
+    one clean SIGTERM leg as the overhead baseline.  The supervisor
+    bit-identity-verifies every recovery against the uninterrupted oracle
+    and asserts the storage-specific gates (retries absorbed with the
+    exactly-once anchor intact, durability degraded AND resumed, corrupt
+    member quarantined with the fallback inside the retention window); any
+    failure errors the scenario, which trips the gate.
+
+    Emitted series and gates (``storage_fault_ceilings``):
+
+    - ``io_retry_overhead_ratio`` — clean-leg feed throughput over the
+      flaky leg's (both legs the same shape).  The ceiling catches a
+      retry path gone pathological (unbounded backoff, a retry storm per
+      write), not the bounded handful of deterministic retries the fault
+      plan schedules.
+    - ``heal_resume_ms_p99`` — wall time of the explicit heal cut that
+      closes a durability-degraded window (fault cleared -> cut durable ->
+      ``durability_resumed``).  Healing is one snapshot write; the ceiling
+      catches it growing into a rebuild.
+    - ``lost_updates`` — ceiling 0 BY DESIGN: storage faults never lose
+      an update (retries absorb transients, degraded windows keep serving
+      from HBM and re-cover on heal, corrupt cuts roll back and re-feed).
+    """
+    import shutil
+    import tempfile
+
+    from tpumetrics.soak.schedule import ChaosSchedule, Incident
+    from tpumetrics.soak.supervisor import run_soak
+
+    schedule = ChaosSchedule(
+        seed=0, world=2, cut_every=3,
+        incidents=(
+            Incident(kind="io_flaky", feed=9, world_after=2),
+            Incident(kind="disk_full", feed=9, world_after=2),
+            Incident(kind="corrupt_cut", feed=9, world_after=2, abrupt=True,
+                     target_rank=1),
+            Incident(kind="sigterm", feed=9, world_after=2),
+        ),
+        restore_ceiling_s=60.0,
+    )
+    root = tempfile.mkdtemp(prefix="tpum_storage_")
+    t0 = time.perf_counter()
+    try:
+        report = run_soak(schedule, root)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    wall_us = (time.perf_counter() - t0) * 1e6
+    assert report["unrecovered"] == 0, report  # every storage gate held
+    assert report["final"].get("ok") is True, report["final"]
+    recs = {r["kind"]: r for r in report["incidents"]}
+    clean_tp = recs["sigterm"]["throughput_rows_per_s"]
+    flaky_tp = recs["io_flaky"]["throughput_rows_per_s"]
+    heal_ms = [
+        r["heal_cut_s"] * 1e3 for r in report["incidents"] if "heal_cut_s" in r
+    ]
+    extras = {
+        "io_retry_overhead_ratio": round(clean_tp / max(flaky_tp, 1e-9), 3),
+        "heal_resume_ms_p99": round(max(heal_ms), 1),
+        "lost_updates": report["lost_batches"],
+        "io_retry_events": recs["io_flaky"]["io_retry_events"],
+        "degraded_windows": recs["disk_full"]["degraded_events"],
+        "quarantined_events": recs["corrupt_cut"]["quarantined_events"],
+        "fallback_depth_max": recs["corrupt_cut"]["fallback_depth_max"],
+        "soak_wall_s": round(wall_us / 1e6, 1),
+    }
+    return wall_us, None, {"extras": extras}
+
+
 def _bench_fleet_resize():
     """The self-scaling fleet loop as a STANDING bench gate (ISSUE 18): a
     hot-tenant wave saturates a 1-rank pool until the fast-burn SLO
@@ -2871,6 +2943,13 @@ def _check_floors(headline_vs, details):
         check_ceiling("chaos_soak", key, ceiling, fail_on_error=True)
     for key, floor in gate.get("chaos_soak_floors", {}).items():
         check_floor_extra("chaos_soak", key, floor, fail_on_error=True)
+    # storage-fault ceilings: the retry path must stay a bounded handful of
+    # deterministic backoffs (not a storm), healing a degraded-durability
+    # window must stay one snapshot write, and storage faults must lose
+    # ZERO updates (an errored scenario also trips — the quarantine/
+    # fallback/exactly-once asserts never ran)
+    for key, ceiling in gate.get("storage_fault_ceilings", {}).items():
+        check_ceiling("storage_faults", key, ceiling, fail_on_error=True)
     # fleet gates: zero lost updates across every live migration (by design
     # — an errored scenario means a zero-loss or bit-identity assert raised
     # mid-resize, which must also trip), bounded handoff latency, and a
@@ -2913,6 +2992,7 @@ def main() -> None:
         ("elastic_restore", _bench_elastic_restore),
         ("monitoring_window", _bench_monitoring_window),
         ("chaos_soak", _bench_chaos_soak),
+        ("storage_faults", _bench_storage_faults),
         ("fleet_resize", _bench_fleet_resize),
         ("analysis_runtime", _bench_analysis_runtime),
     ):

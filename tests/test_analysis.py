@@ -532,6 +532,81 @@ def test_routing_epoch_suppression():
     assert "TPL109" in _codes(found, suppressed=True)
 
 
+# ------------------------------------------------------------------- TPL110
+DURABILITY_TP = _src(
+    """
+    import os
+
+    def save(directory, payload):
+        with open(os.path.join(directory, "x.npz"), "wb") as fh:  # bare write
+            fh.write(payload)
+        os.replace("x.tmp", "x.npz")          # bare rename: no shim, no faults
+    """
+)
+
+DURABILITY_NEAR_MISS = _src(
+    """
+    import os
+
+    def load(path):
+        with open(path, "rb") as fh:          # reads are not durability writes
+            return fh.read()
+
+    def probe(path, mode):
+        return open(path, mode)               # dynamic mode: can't prove a write
+
+    def default_mode(path):
+        return open(path)                     # default "r"
+    """
+)
+
+
+def _seam_tree(tmp_path, rel, src):
+    target = tmp_path / rel
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(src)
+    return str(target)
+
+
+def test_bare_durability_write_true_positives(tmp_path):
+    # a write-mode open AND an os.replace inside a seam module both dangle
+    target = _seam_tree(tmp_path, "tpumetrics/lifecycle/store.py", DURABILITY_TP)
+    assert _codes(analyze_paths([target])).count("TPL110") == 2
+
+
+def test_bare_durability_write_fires_in_every_seam_module(tmp_path):
+    for rel in (
+        "tpumetrics/runtime/snapshot.py",
+        "tpumetrics/resilience/elastic.py",
+        "tpumetrics/fleet/migrate.py",
+    ):
+        target = _seam_tree(tmp_path, rel, DURABILITY_TP)
+        assert "TPL110" in _codes(analyze_paths([target])), rel
+
+
+def test_bare_durability_write_near_miss_negative(tmp_path):
+    # reads, dynamic modes, and default-mode opens stay quiet even in a seam
+    target = _seam_tree(
+        tmp_path, "tpumetrics/lifecycle/store.py", DURABILITY_NEAR_MISS
+    )
+    assert "TPL110" not in _codes(analyze_paths([target]))
+
+
+def test_bare_durability_write_non_seam_module_quiet(tmp_path):
+    # durability hygiene is scoped to the seam modules: ordinary code may
+    # write files without routing through the shim
+    target = _seam_tree(tmp_path, "tpumetrics/other/util.py", DURABILITY_TP)
+    assert "TPL110" not in _codes(analyze_paths([target]))
+
+
+def test_bare_durability_write_shim_itself_exempt(tmp_path):
+    # the shim is WHERE the bare syscalls are supposed to live
+    target = _seam_tree(
+        tmp_path, "tpumetrics/resilience/storage.py", DURABILITY_TP
+    )
+    assert "TPL110" not in _codes(analyze_paths([target]))
+
+
 def test_host_telemetry_reachable_helper_is_flagged():
     src = _src(
         """

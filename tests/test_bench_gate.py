@@ -422,6 +422,49 @@ def test_check_floors_flags_fleet_regressions():
     assert violations and "scenario failed" in violations[0]
 
 
+def test_storage_fault_ceilings_shape():
+    path = os.path.join(os.path.dirname(bench.__file__), "bench_floors.json")
+    with open(path) as fh:
+        data = json.load(fh)
+    ceil = data["storage_fault_ceilings"]
+    assert set(ceil) == {
+        "io_retry_overhead_ratio", "heal_resume_ms_p99", "lost_updates",
+    }
+    # retried I/O may slow a leg but never by an order of magnitude, healing
+    # from a full disk is bounded, and a storage fault NEVER loses an update
+    # (degradation keeps serving from HBM) — so lost_updates is pinned to
+    # exactly zero and must never be raised to "make the gate pass"
+    assert 1.0 < ceil["io_retry_overhead_ratio"] < 10.0
+    assert ceil["heal_resume_ms_p99"] > 0
+    assert ceil["lost_updates"] == 0
+
+
+def test_check_floors_flags_storage_fault_regressions():
+    """A storage soak whose retried-I/O leg ran an order of magnitude slow,
+    whose disk-full heal took too long, or that lost ANY update must each
+    trip the gate independently; an errored scenario entry (a shim gate or
+    quarantine census assert raised mid-soak) trips it too."""
+    healthy = {
+        "io_retry_overhead_ratio": 1.4,
+        "heal_resume_ms_p99": 120.0,
+        "lost_updates": 0,
+    }
+    details = {"storage_faults": dict(healthy)}
+    assert bench._check_floors(headline_vs=1000.0, details=details) == []
+    details["storage_faults"] = dict(healthy, io_retry_overhead_ratio=50.0)
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("io_retry_overhead_ratio" in v for v in violations)
+    details["storage_faults"] = dict(healthy, heal_resume_ms_p99=10**6)
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("heal_resume_ms_p99" in v for v in violations)
+    details["storage_faults"] = dict(healthy, lost_updates=1)
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("lost_updates" in v for v in violations)
+    details["storage_faults"] = "error: ChaosSoakError: io_retry anchor moved"
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and "scenario failed" in violations[0]
+
+
 def test_check_floors_flags_backbone_runtime_regressions():
     """A shared-backbone round that lost its edge over private per-tenant
     plumbing (a digest miss re-placing weights per tenant, or a per-tenant

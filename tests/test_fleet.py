@@ -339,6 +339,58 @@ class TestHandoffRecovery:
             for s in ranks.values():
                 s.close(drain=False)
 
+    def _tear(self, handoff, tenant_id="tid"):
+        path = handoff._manifest_path(tenant_id)
+        data = open(path, "rb").read()
+        with open(path, "wb") as fh:
+            fh.write(data[: len(data) // 2])  # torn rename: truncated JSON
+        return path
+
+    @pytest.mark.parametrize("commit", [False, True], ids=["cut", "committed"])
+    def test_torn_manifest_arbitrates_from_prev(self, tmp_path, commit):
+        """A manifest found torn at recovery rolls back to the atomic-rename
+        predecessor — the state machine's previous durable state.  Torn
+        AFTER commit, the predecessor is the "cut" manifest, so ownership
+        arbitrates to the SOURCE rank (roll back, nothing lost); torn on
+        the FIRST write there is no predecessor, which means the migration
+        never durably began — no manifest at all, both ranks untouched."""
+        seed = 906
+        handoff = self._interrupted(tmp_path, seed, commit=commit)
+        self._tear(handoff)
+        ledger.enable()
+        ledger.reset()
+        ranks = {0: EvaluationService(name="r0"), 1: EvaluationService(name="r1")}
+        try:
+            reports = recover_handoffs(handoff, ranks, _factory, register_kw=REG)
+            torn = [
+                r for r in ledger.get_ledger().records if r.kind == "manifest_torn"
+            ]
+            assert torn and torn[0].extra["arbitrated"] == (
+                "prev" if commit else "absent"
+            )
+            if commit:
+                # predecessor state is "cut": roll back to the source rank
+                (report,) = reports
+                assert report.extra["owner_rank"] == 0
+                assert report.extra["committed"] is False
+                svc = ranks[0]
+                _feed(lambda *b: svc.submit("tid", *b), seed, 6, 9)
+                svc.flush("tid")
+                assert values_equal(svc.compute("tid"), _oracle(seed, 9))
+            else:
+                # first write torn with no .prev: migration never durably
+                # began — nothing to recover, nobody owns the tenant
+                assert reports == []
+                assert handoff.pending() == []
+                for s in ranks.values():
+                    assert "tid" not in set(s.tenant_ids())
+        finally:
+            ledger.disable()
+            ledger.reset()
+            handoff.close()
+            for s in ranks.values():
+                s.close(drain=False)
+
     def test_double_residency_refused(self, tmp_path, seed=904):
         handoff = self._interrupted(tmp_path, seed, commit=True)
         ranks = {0: EvaluationService(name="r0"), 1: EvaluationService(name="r1")}

@@ -26,7 +26,7 @@ from tpumetrics.soak import (
     generate_schedule,
 )
 from tpumetrics.soak.cli import main as cli_main
-from tpumetrics.soak.schedule import KINDS, ScheduleError
+from tpumetrics.soak.schedule import KINDS, STORAGE_KINDS, ScheduleError
 from tpumetrics.soak.wire import BarrierWireError
 
 # ------------------------------------------------------------------ schedule
@@ -86,6 +86,48 @@ class TestSchedule:
     def test_unreadable_json_typed(self):
         with pytest.raises(ScheduleError):
             ChaosSchedule.from_json("{not json")
+
+    def test_storage_opt_in_guarantees_all_three_kinds(self):
+        """n_incidents == 3 with storage=True IS the standing storage-fault
+        gate: every seed must run corrupt_cut, disk_full, AND io_flaky."""
+        for seed in range(8):
+            s = generate_schedule(seed, world=2, n_incidents=3, storage=True)
+            assert {i.kind for i in s.incidents} == set(STORAGE_KINDS), seed
+            for inc in s.incidents:
+                assert inc.world_after == 2  # the disk fails, not the fleet
+                assert inc.feed >= 3 * s.cut_every  # room for >= 3 cuts
+                if inc.kind == "corrupt_cut":
+                    assert inc.abrupt and inc.target_rank is not None
+                else:
+                    assert not inc.abrupt and inc.target_rank is None
+
+    def test_storage_off_is_byte_identical_to_pinned_seeds(self):
+        """The default path must not shift under the storage feature flag:
+        pinned chaos-soak seeds stay bit-stable."""
+        for seed in range(4):
+            a = generate_schedule(seed, world=3, n_incidents=6)
+            b = generate_schedule(seed, world=3, n_incidents=6, storage=False)
+            assert a.to_json() == b.to_json()
+            assert not any(i.kind in STORAGE_KINDS for i in a.incidents)
+
+    def test_storage_incident_validation(self):
+        good = dict(kind="io_flaky", feed=9, world_after=2)
+        ChaosSchedule(seed=0, world=2, incidents=(Incident(**good),))
+        bad = [
+            dict(kind="io_flaky", feed=9, world_after=3),  # world resized
+            dict(kind="disk_full", feed=9, world_after=2, tail=1),  # tail
+            dict(kind="io_flaky", feed=9, world_after=2, abrupt=True,
+                 target_rank=0),  # shim incidents recover gracefully
+            dict(kind="disk_full", feed=9, world_after=2, target_rank=1),
+            dict(kind="corrupt_cut", feed=9, world_after=2),  # needs abrupt
+            dict(kind="corrupt_cut", feed=9, world_after=2, abrupt=True,
+                 target_rank=5),  # victim out of range
+            dict(kind="corrupt_cut", feed=9, world_after=2, abrupt=True,
+                 target_rank=0, lose_member=True),
+        ]
+        for kwargs in bad:
+            with pytest.raises(ScheduleError):
+                ChaosSchedule(seed=0, world=2, incidents=(Incident(**kwargs),))
 
 
 # ---------------------------------------------------------------- file wire

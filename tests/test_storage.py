@@ -1,0 +1,506 @@
+"""Storage fault tolerance (ISSUE 19): the shared I/O shim, quarantine,
+durability degradation, and the seeded fault plans.
+
+The contract under test, end to end:
+
+- every durability seam retries transient I/O errors with deterministic
+  bounded backoff (``io_retry`` ledger events) and classifies permanent
+  ones into typed ``StorageError``/``StorageFullError``;
+- a CRC-failing (or header-destroying) cut member is QUARANTINED — renamed
+  into a bounded ``.quarantine/`` sibling, never re-walked, eventually
+  collected by ``gc_cuts`` — and the restore walk falls back to the
+  newest surviving complete cut BIT-IDENTICALLY, however deep;
+- an evaluator whose cut save exhausts its retry budget keeps serving
+  from HBM: durability suspends behind a backoff heal probe, latches one
+  ``durability_degraded`` event, resumes (with an immediate cut) on heal,
+  and a drain under degraded storage returns a typed PARTIAL report
+  naming the uncovered tail instead of crashing;
+- the seeded :class:`~tpumetrics.soak.faults.FaultPlan` is deterministic
+  and JSON-round-trippable, so a red soak epoch replays exactly.
+"""
+
+import errno
+import os
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpumetrics import telemetry
+from tpumetrics.resilience import storage
+from tpumetrics.resilience.elastic import (
+    DistributedSnapshotManager,
+    cut_digest,
+    gc_cuts,
+    load_latest_cut,
+    scan_cuts,
+)
+from tpumetrics.soak.faults import FAULT_KINDS, FaultPlan, IOFault, plan_for_incident
+
+
+@pytest.fixture(autouse=True)
+def _no_injector_residue():
+    """The fault injector is process-global: never leak one across tests."""
+    yield
+    storage.clear_fault_injector()
+    telemetry.disable()
+
+
+FAST = storage.RetryPolicy(attempts=4, base_delay_s=0.001, max_delay_s=0.004)
+
+
+# --------------------------------------------------------------- retry shim
+
+
+class TestRunWithRetry:
+    def test_transient_errno_retried_to_success_with_ledger_events(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError(errno.EIO, "boom")
+            return "ok"
+
+        with telemetry.capture() as led:
+            got = storage.run_with_retry(flaky, seam="cut", policy=FAST)
+        assert got == "ok" and len(calls) == 3
+        retries = [r for r in led.records if r.kind == "io_retry"]
+        assert len(retries) == 2  # one event per retried failure
+        assert all(r.extra["seam"] == "cut" for r in retries)
+
+    def test_exhausted_transient_raises_typed_with_seam_and_errno(self):
+        def always():
+            raise OSError(errno.EIO, "boom")
+
+        with pytest.raises(storage.StorageError, match="cut") as ei:
+            storage.run_with_retry(always, seam="cut", policy=FAST)
+        assert ei.value.errno == errno.EIO
+
+    @pytest.mark.parametrize("num", sorted(storage.PERMANENT_ERRNOS))
+    def test_permanent_errno_fails_fast_no_retry(self, num):
+        calls = []
+
+        def full():
+            calls.append(1)
+            raise OSError(num, "no space")
+
+        expected = (
+            storage.StorageFullError
+            if num in (errno.ENOSPC, errno.EDQUOT)
+            else storage.StorageError
+        )
+        with pytest.raises(expected):
+            storage.run_with_retry(full, seam="spill", policy=FAST)
+        assert len(calls) == 1  # a full/readonly disk never improves by retrying
+
+    def test_unknown_errno_propagates_unchanged(self):
+        with pytest.raises(FileNotFoundError):
+            storage.run_with_retry(
+                lambda: open("/nonexistent/dir/x", "rb"), seam="cut", policy=FAST
+            )
+
+    def test_storage_error_passes_through_unreclassified(self):
+        err = storage.StorageFullError("disk full", seam="spill", errno=errno.ENOSPC)
+
+        def reraise():
+            raise err
+
+        with pytest.raises(storage.StorageFullError) as ei:
+            storage.run_with_retry(reraise, seam="cut", policy=FAST)
+        assert ei.value is err  # not re-wrapped with the outer seam
+
+    def test_deadline_bounds_total_retry_time(self):
+        policy = storage.RetryPolicy(
+            attempts=1000, base_delay_s=0.05, max_delay_s=0.05, deadline_s=0.12
+        )
+        t0 = time.monotonic()
+        with pytest.raises(storage.StorageError, match=r"attempt\(s\)"):
+            storage.run_with_retry(
+                lambda: (_ for _ in ()).throw(OSError(errno.EIO, "x")),
+                seam="cut", policy=policy,
+            )
+        assert time.monotonic() - t0 < 2.0
+
+    def test_retry_counts_accumulate_per_seam(self):
+        before = dict(storage.retry_counts())
+        calls = []
+
+        def once_flaky():
+            calls.append(1)
+            if len(calls) < 2:
+                raise OSError(errno.EAGAIN, "busy")
+            return None
+
+        storage.run_with_retry(once_flaky, seam="manifest", policy=FAST)
+        after = storage.retry_counts()
+        assert after.get("manifest", 0) == before.get("manifest", 0) + 1
+
+
+class TestClassify:
+    def test_classification_table(self):
+        def cls(num):
+            return storage.classify_errno(OSError(num, "x"))
+
+        assert cls(errno.EIO) == "transient"
+        assert cls(errno.EAGAIN) == "transient"
+        assert cls(errno.ENOSPC) == "permanent"
+        assert cls(errno.EROFS) == "permanent"
+        assert cls(errno.ENOENT) == "unknown"
+
+
+# ------------------------------------------------------------- atomic_write
+
+
+class TestAtomicWrite:
+    def test_success_leaves_only_final_file(self, tmp_path):
+        final = str(tmp_path / "out.bin")
+        got = storage.atomic_write(
+            str(tmp_path), final, lambda fh: fh.write(b"payload"), seam="cut"
+        )
+        assert got == final
+        assert open(final, "rb").read() == b"payload"
+        assert os.listdir(tmp_path) == ["out.bin"]  # no temp debris
+
+    def test_transient_injected_faults_absorbed(self, tmp_path):
+        FaultPlan([IOFault("eio", "write", after=0, count=2)]).install()
+        final = str(tmp_path / "out.bin")
+        with telemetry.capture() as led:
+            storage.atomic_write(
+                str(tmp_path), final, lambda fh: fh.write(b"x" * 64),
+                seam="cut", policy=FAST,
+            )
+        assert open(final, "rb").read() == b"x" * 64
+        assert len([r for r in led.records if r.kind == "io_retry"]) == 2
+        assert os.listdir(tmp_path) == ["out.bin"]  # failed attempts cleaned up
+
+    def test_directory_collected_mid_retry_is_recreated(self, tmp_path):
+        """The GC-vs-writer race: a concurrent gc may rmdir the directory
+        between attempts (the failed attempt's temp was its only entry);
+        every attempt recreates it, so the retry heals instead of ENOENT."""
+        directory = str(tmp_path / "rank-00000")
+        os.makedirs(directory)
+        plan = FaultPlan([IOFault("eio", "write", after=0, count=1)])
+
+        real_call = plan.__call__
+
+        def call_and_collect(op, path):
+            try:
+                real_call(op, path)
+            except OSError:
+                raise
+            finally:
+                if op == "write" and not plan.fired[:1]:
+                    pass
+
+        plan.install()
+        # simulate the GC firing right after the first failed attempt
+        orig_sleep = time.sleep
+
+        def sleep_and_rmdir(s):
+            try:
+                os.rmdir(directory)  # empty: attempt debris already unlinked
+            except OSError:
+                pass
+            orig_sleep(0)
+
+        time.sleep, _saved = sleep_and_rmdir, time.sleep
+        try:
+            storage.atomic_write(
+                directory, os.path.join(directory, "out.bin"),
+                lambda fh: fh.write(b"y"), seam="cut", policy=FAST,
+            )
+        finally:
+            time.sleep = _saved
+            storage.clear_fault_injector()
+        assert open(os.path.join(directory, "out.bin"), "rb").read() == b"y"
+
+
+# -------------------------------------------------------------- quarantine
+
+
+class TestQuarantine:
+    def test_quarantine_moves_file_and_records_event(self, tmp_path):
+        bad = tmp_path / "snapshot-3.npz"
+        bad.write_bytes(b"corrupt")
+        with telemetry.capture() as led:
+            dest = storage.quarantine(str(bad), reason="crc mismatch")
+        assert dest is not None and os.path.isfile(dest)
+        assert storage.QUARANTINE_DIRNAME in dest
+        assert not bad.exists()
+        events = [r for r in led.records if r.kind == "snapshot_quarantined"]
+        assert len(events) == 1 and events[0].extra["reason"] == "crc mismatch"
+
+    def test_quarantine_missing_file_returns_none(self, tmp_path):
+        assert storage.quarantine(str(tmp_path / "gone"), reason="x") is None
+
+    def test_bound_prunes_oldest(self, tmp_path):
+        for i in range(6):
+            f = tmp_path / f"snapshot-{i}.npz"
+            f.write_bytes(b"junk")
+            storage.quarantine(str(f), reason="crc", bound=3)
+        census = storage.quarantine_census(str(tmp_path))
+        assert census["files"] == 3  # bounded: quarantine never grows a disk leak
+
+    def test_census_walks_nested_rank_dirs(self, tmp_path):
+        for r in range(2):
+            d = tmp_path / f"rank-0000{r}"
+            d.mkdir()
+            f = d / "snapshot-1.npz"
+            f.write_bytes(b"junk")
+            storage.quarantine(str(f), reason="crc")
+        census = storage.quarantine_census(str(tmp_path))
+        assert census == {"dirs": 2, "files": 2, "bytes": 8}
+
+    def test_empty_root_census(self, tmp_path):
+        assert storage.quarantine_census(str(tmp_path)) == {
+            "dirs": 0, "files": 0, "bytes": 0,
+        }
+
+
+# ------------------------------------------------- multi-depth cut fallback
+
+
+def _write_cut(root, world, step, fill):
+    digest = cut_digest(step, world, "cfg")
+    for r in range(world):
+        mgr = DistributedSnapshotManager(root, r, world, keep=None)
+        meta = {
+            "batches": step, "items": step, "mode": "eager", "degraded": False,
+            "base_batches": 0, "base_items": 0,
+            "elastic": mgr.elastic_meta(step, digest, "cfg"),
+        }
+        mgr.save(step, {"v": jnp.full((2,), float(fill))}, meta=meta)
+
+
+def _member(root, rank, step):
+    return os.path.join(root, f"rank-{rank:05d}", f"snapshot-{step}.npz")
+
+
+def _truncate(path):
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.truncate(size // 2)
+
+
+class TestMultiDepthFallback:
+    def test_two_newest_cuts_corrupt_on_different_members(self, tmp_path):
+        """Newest cut corrupt on rank 0, second-newest on rank 1: the walk
+        must quarantine BOTH and land on cut N-2 bit-identically."""
+        root = str(tmp_path)
+        _write_cut(root, 2, 3, fill=1.0)
+        _write_cut(root, 2, 7, fill=2.0)
+        _write_cut(root, 2, 11, fill=3.0)
+        _truncate(_member(root, 0, 11))
+        _truncate(_member(root, 1, 7))
+        with telemetry.capture() as led:
+            cut = load_latest_cut(root, template={"v": jnp.zeros(2)})
+        assert cut.step == 3 and not cut.degraded
+        np.testing.assert_array_equal(np.asarray(cut.payloads[0]["v"]), np.ones(2))
+        np.testing.assert_array_equal(np.asarray(cut.payloads[1]["v"]), np.ones(2))
+        assert cut.fallback_depth == 2
+        quarantined = [r for r in led.records if r.kind == "snapshot_quarantined"]
+        assert len(quarantined) == 2
+        census = storage.quarantine_census(root)
+        assert census["files"] == 2
+        # the quarantined members never re-enter the scan
+        steps = [c.step for c in scan_cuts(root)]
+        assert 11 not in steps or all(
+            c.missing for c in scan_cuts(root) if c.step == 11
+        )
+
+    def test_healthy_latest_has_depth_zero(self, tmp_path):
+        root = str(tmp_path)
+        _write_cut(root, 2, 3, fill=1.0)
+        cut = load_latest_cut(root, template={"v": jnp.zeros(2)})
+        assert cut.step == 3 and cut.fallback_depth == 0
+
+    def test_scan_quarantines_unreadable_header(self, tmp_path):
+        """A torn write that destroys the zip directory never reaches the
+        CRC walk — scan itself must quarantine it, not silently skip."""
+        root = str(tmp_path)
+        _write_cut(root, 1, 5, fill=1.0)
+        bad = _member(root, 0, 5)
+        with open(bad, "wb") as fh:
+            fh.write(b"not a zip at all")
+        with telemetry.capture() as led:
+            cuts = scan_cuts(root)
+        assert all(c.step != 5 or c.missing for c in cuts)
+        assert any(r.kind == "snapshot_quarantined" for r in led.records)
+        assert storage.quarantine_census(root)["files"] == 1
+
+    def test_gc_collects_quarantined_members_below_watermark(self, tmp_path):
+        """Quarantined evidence is bounded TWICE: by the per-dir bound at
+        quarantine time and by gc_cuts once the cut it came from falls out
+        of retention."""
+        root = str(tmp_path)
+        for step, fill in ((3, 1.0), (7, 2.0), (11, 3.0), (15, 4.0)):
+            _write_cut(root, 1, step, fill)
+        _truncate(_member(root, 0, 3))
+        cut = load_latest_cut(root, template={"v": jnp.zeros(2)})
+        assert cut.step == 15  # newest is healthy; 3 is just old AND corrupt
+        # the scan quarantined the torn step-3 member; add one more directly
+        storage.quarantine(_member(root, 0, 7), reason="test")
+        assert storage.quarantine_census(root)["files"] == 2
+        gc_cuts(root, keep_cuts=2)  # watermark = 11: steps 3, 7 are superseded
+        assert storage.quarantine_census(root)["files"] == 0
+        steps = sorted(c.step for c in scan_cuts(root) if not c.missing)
+        assert steps == [11, 15]
+
+
+# --------------------------------------------- evaluator durability machine
+
+
+def _make_eval(tmp_path, **kw):
+    from tpumetrics.soak.traffic import make_metric
+    from tpumetrics.runtime import StreamingEvaluator
+
+    return StreamingEvaluator(
+        make_metric(4), buckets=6,
+        snapshot_dir=str(tmp_path / "snapshots"),
+        snapshot_rank=0, snapshot_world_size=1, keep_cuts=3, **kw,
+    )
+
+
+def _feed(ev, n, seed=0):
+    from tpumetrics.soak.traffic import make_batch
+
+    for i in range(n):
+        preds, target = make_batch(seed, i, num_classes=4, max_rows=6)
+        ev.submit(jnp.asarray(preds), jnp.asarray(target))
+    ev.flush()
+
+
+class TestDurabilityDegradation:
+    def test_enospc_latches_degraded_and_keeps_serving(self, tmp_path):
+        ev = _make_eval(tmp_path)
+        try:
+            _feed(ev, 3)
+            FaultPlan([IOFault("enospc", "write", after=0, count=99)]).install()
+            with telemetry.capture() as led:
+                with pytest.raises(storage.StorageFullError):
+                    ev.snapshot()
+            assert [r.kind for r in led.records].count("durability_degraded") == 1
+            st = ev.stats()["storage"]
+            assert st["degraded"] is True and "StorageFullError" in st["reason"]
+            # serving continues: submits still apply while durability is down
+            _feed(ev, 2, seed=1)
+            assert ev.stats()["batches"] == 5
+        finally:
+            storage.clear_fault_injector()
+            ev.close(drain=False)
+
+    def test_heal_probe_resumes_and_cuts_immediately(self, tmp_path):
+        ev = _make_eval(tmp_path)
+        try:
+            _feed(ev, 3)
+            FaultPlan([IOFault("enospc", "write", after=0, count=99)]).install()
+            with pytest.raises(storage.StorageFullError):
+                ev.snapshot()
+            storage.clear_fault_injector()  # the disk heals
+            with telemetry.capture() as led:
+                path = ev.snapshot()  # explicit cut doubles as the probe
+            assert path is not None and os.path.isfile(path)
+            assert [r.kind for r in led.records].count("durability_resumed") == 1
+            st = ev.stats()["storage"]
+            assert st["degraded"] is False and st["heal_backoff_s"] == 0.0
+        finally:
+            storage.clear_fault_injector()
+            ev.close(drain=False)
+
+    def test_degraded_drain_returns_typed_partial_report(self, tmp_path):
+        ev = _make_eval(tmp_path)
+        _feed(ev, 3)
+        assert ev.snapshot()  # durable point at 3 batches
+        _feed(ev, 2, seed=1)
+        FaultPlan([IOFault("enospc", "write", after=0, count=99)]).install()
+        try:
+            reports = ev.drain()
+        finally:
+            storage.clear_fault_injector()
+        rep = reports[0] if isinstance(reports, (list, tuple)) else reports
+        assert rep.partial is True
+        assert "StorageFullError" in rep.reason
+        assert rep.uncovered_batches == 2  # exactly the tail past the last cut
+        d = rep.to_dict()
+        assert d["partial"] is True and d["uncovered_batches"] == 2
+
+    def test_clean_drain_report_is_not_partial(self, tmp_path):
+        ev = _make_eval(tmp_path)
+        _feed(ev, 2)
+        reports = ev.drain()
+        rep = reports[0] if isinstance(reports, (list, tuple)) else reports
+        assert rep.partial is False and rep.uncovered_batches == 0
+        assert "partial" not in rep.to_dict()
+
+    def test_statusz_storage_section_shape(self, tmp_path):
+        ev = _make_eval(tmp_path)
+        try:
+            _feed(ev, 2)
+            ev.snapshot()
+            st = ev.stats()["storage"]
+            assert st["degraded"] is False and st["reason"] is None
+            assert st["suspended_cuts"] == 0
+            assert isinstance(st["retries"], dict)
+            assert set(st["quarantine"]) == {"dirs", "files", "bytes"}
+        finally:
+            ev.close(drain=False)
+
+
+# -------------------------------------------------------------- fault plans
+
+
+class TestFaultPlan:
+    def test_from_seed_is_deterministic(self):
+        for profile in ("io_flaky", "disk_full", "corrupt_cut"):
+            a = FaultPlan.from_seed(42, profile)
+            b = FaultPlan.from_seed(42, profile)
+            assert a.to_json() == b.to_json()
+        assert (
+            FaultPlan.from_seed(1, "io_flaky").to_json()
+            != FaultPlan.from_seed(2, "io_flaky").to_json()
+        )
+
+    def test_json_round_trip(self):
+        plan = FaultPlan.from_seed(7, "io_flaky", path_contains="rank-00001")
+        again = FaultPlan.from_json(plan.to_json())
+        assert again.to_json() == plan.to_json()
+        assert all(f.path_contains == "rank-00001" for f in again.faults)
+
+    def test_per_op_counting_fires_exact_window(self, tmp_path):
+        plan = FaultPlan([IOFault("eio", "write", after=1, count=2)])
+        plan.install()
+        try:
+            fired_per_call = []
+            for i in range(4):
+                try:
+                    plan("write", "/x")
+                    fired_per_call.append(False)
+                except OSError:
+                    fired_per_call.append(True)
+        finally:
+            storage.clear_fault_injector()
+        # plan() called directly above ALSO counts via install? No: we drove
+        # the plan object itself — indices 0..3, window [1, 3)
+        assert fired_per_call == [False, True, True, False]
+
+    def test_unknown_kind_and_bad_bounds_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            IOFault("meteor", "write")
+        with pytest.raises(ValueError, match="count"):
+            IOFault("eio", "write", count=0)
+        with pytest.raises(ValueError, match="unknown fault profile"):
+            FaultPlan.from_seed(0, "nope")
+
+    def test_plan_for_incident_maps_kinds(self):
+        assert plan_for_incident("io_flaky", 1) is not None
+        assert plan_for_incident("disk_full", 1) is not None
+        assert plan_for_incident("corrupt_cut", 1) is not None
+        assert plan_for_incident("sigterm", 1) is None
+
+    def test_corruption_kinds_cover_catalog(self):
+        assert set(FAULT_KINDS) == {
+            "eio", "enospc", "slow_io", "torn_write", "bit_flip", "vanish",
+        }

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import threading
 import time
 import urllib.request
@@ -36,9 +37,11 @@ from tpumetrics.lifecycle import (
     RESIDENT,
     LifecyclePolicy,
     SpillStore,
+    TenantRevivalError,
     TenantRevivingError,
 )
 from tpumetrics.runtime import EvaluationService
+from tpumetrics.runtime.snapshot import SnapshotIntegrityError
 from tpumetrics.telemetry import instruments, ledger
 from tpumetrics.utils.exceptions import TPUMetricsUserError
 
@@ -326,6 +329,112 @@ class TestConcurrentRevival:
             mgr._restore = orig_restore
             svc.flush()
             _exact(svc.compute("t"), jnp.asarray(1.5))
+        finally:
+            svc.close()
+
+    def _truncate(self, path):
+        size = os.path.getsize(path)
+        with open(path, "r+b") as fh:
+            fh.truncate(max(1, size // 2))
+
+    def _race_submit(self, svc, vals):
+        """16 threads submit concurrently; returns the per-thread errors."""
+        errors = []
+        lock = threading.Lock()
+        gate = threading.Barrier(len(vals))
+
+        def _submit(v):
+            gate.wait(5.0)
+            try:
+                svc.submit("t", jnp.full((4,), v))
+            except BaseException as exc:
+                with lock:
+                    errors.append(exc)
+
+        threads = [threading.Thread(target=_submit, args=(v,)) for v in vals]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10.0)
+        assert not any(t.is_alive() for t in threads)  # the no-wedge bar
+        return errors
+
+    def test_corrupt_spill_race_falls_back_to_retained_spill(self):
+        """keep=2 retains two spills at the same stream position; the newest
+        is corrupt.  16 concurrent submits: ONE revival quarantines the bad
+        cut, restores the predecessor, and every thread's batch lands
+        exactly once — zero errors, bit-identical fold."""
+        from tpumetrics.resilience import storage as _storage
+
+        oracle = EvaluationService()
+        svc = EvaluationService(
+            lifecycle=LifecyclePolicy(
+                hbm_budget_bytes=1 << 30, spill_keep=2
+            ),
+        )
+        try:
+            oracle.register("t", MeanMetric(), buckets=[8])
+            svc.register("t", MeanMetric(), buckets=[8])
+            first = jnp.ones((4,))
+            oracle.submit("t", first)
+            svc.submit("t", first)
+            svc.flush()
+            assert svc.hibernate("t") is True
+            store = svc.lifecycle.store
+            newest = store.newest_path("t")
+            # a second spill at the SAME stream position, then tear it
+            store.adopt_file("t", newest)
+            self._truncate(store.newest_path("t"))
+
+            vals = [float(i) for i in range(16)]
+            for v in vals:
+                oracle.submit("t", jnp.full((4,), v))
+            ledger.enable()
+            ledger.reset()
+            errors = self._race_submit(svc, vals)
+            assert errors == []
+            quarantined = [
+                r for r in ledger.get_ledger().records
+                if r.kind == "snapshot_quarantined"
+            ]
+            assert len(quarantined) == 1  # the torn cut, exactly once
+            oracle.flush()
+            svc.flush()
+            _exact(svc.compute("t"), oracle.compute("t"))
+            assert svc.stats()["lifecycle"]["revivals"] == 1
+            # the revival's discard supersedes the whole spill dir,
+            # quarantined evidence included — no disk leak survives it
+            assert _storage.quarantine_census(store.root)["files"] == 0
+        finally:
+            svc.close()
+            oracle.close()
+
+    def test_unrecoverable_spill_race_types_every_submitter(self):
+        """EVERY retained spill corrupt: the revival fails, and all 16
+        blocked submitters get a typed error instead of wedging or each
+        serially re-paying the broken restore.  The tenant survives: it is
+        still hibernated, still registered, and its stats still serve."""
+        svc = EvaluationService(
+            lifecycle=LifecyclePolicy(hbm_budget_bytes=1 << 30),
+        )
+        try:
+            svc.register("t", MeanMetric(), buckets=[8])
+            svc.submit("t", jnp.ones((4,)))
+            svc.flush()
+            assert svc.hibernate("t") is True
+            self._truncate(svc.lifecycle.store.newest_path("t"))
+
+            errors = self._race_submit(svc, [float(i) for i in range(16)])
+            assert len(errors) == 16  # nobody silently dropped a batch
+            for exc in errors:
+                # the thread that owned the attempt surfaces the integrity
+                # error; every waiter gets the typed revival refusal
+                assert isinstance(
+                    exc, (TenantRevivalError, SnapshotIntegrityError)
+                ), exc
+            assert any(isinstance(e, TenantRevivalError) for e in errors)
+            assert "t" in set(svc.tenant_ids())
+            assert svc.stats()["lifecycle"]["hibernated_tenants"] == 1
         finally:
             svc.close()
 

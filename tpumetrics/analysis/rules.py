@@ -136,6 +136,11 @@ CATALOG: Dict[str, Tuple[str, str]] = {
         "tenant->rank routing read cached across a migration seam outside the "
         "routing lock",
     ),
+    "TPL110": (
+        "bare-durability-write",
+        "direct write/rename in a durability seam module bypassing the storage "
+        "shim's retry/quarantine/fault-injection path",
+    ),
     "TPL201": (
         "divergent-collective",
         "collective reachable on only one branch of a rank- or data-dependent conditional",
@@ -1632,6 +1637,93 @@ class RoutingEpochRule:
         return None
 
 
+#: the durability seam modules: every byte they persist must flow through
+#: the storage shim (:mod:`tpumetrics.resilience.storage`), which owns
+#: retry/backoff, errno classification, quarantine, and fault injection —
+#: a bare write in a seam module silently opts out of all four
+_TPL110_SEAMS = (
+    "tpumetrics/runtime/snapshot.py",
+    "tpumetrics/resilience/elastic.py",
+    "tpumetrics/lifecycle/store.py",
+    "tpumetrics/fleet/migrate.py",
+)
+#: the shim itself is the one sanctioned bare-write site
+_TPL110_EXEMPT = ("tpumetrics/resilience/storage.py",)
+#: rename/replace are the atomic-publish step — bypassing the shim there
+#: skips the injector AND the post-replace durability fsync
+_TPL110_RENAMES = {"os.replace", "os.rename"}
+#: any of these mode characters makes an ``open`` write-capable
+_TPL110_WRITE_MODES = frozenset("wax+")
+
+
+class BareDurabilityWriteRule:
+    """TPL110: a bare durability write bypassing the storage shim.
+
+    The durability seam modules (``_TPL110_SEAMS`` — snapshot cuts, elastic
+    cut groups, lifecycle spills, migration manifests) promise retry on
+    transient I/O errors, typed classification of permanent ones,
+    corruption quarantine, and seeded fault injection.  All four live in
+    ONE place: :func:`tpumetrics.resilience.storage.atomic_write` /
+    :func:`~tpumetrics.resilience.storage.run_with_retry`.  A direct
+    ``open(path, "w"/"wb")``, ``os.replace`` or ``os.rename`` in a seam
+    module writes bytes the shim never sees — it won't retry, won't latch
+    durability degradation, and the chaos soak's fault plans can't reach
+    it, so the write looks durable in every test and fails only in
+    production.  Read-side opens are fine; the shim module itself is
+    exempt (it IS the bare-write layer)."""
+
+    codes = ("TPL110",)
+
+    def check(self, mod: ModuleInfo, index: PackageIndex) -> Iterator[Finding]:
+        path = str(mod.path).replace("\\", "/")
+        if any(path.endswith(exempt) for exempt in _TPL110_EXEMPT):
+            return
+        if not any(path.endswith(seam) for seam in _TPL110_SEAMS):
+            return
+        if mod.tree is None:
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted_name(node.func, mod) or ""
+            if dotted in _TPL110_RENAMES:
+                yield Finding(
+                    "TPL110",
+                    f"`{dotted}` in a durability seam module bypasses the "
+                    "storage shim: the atomic publish step never sees the "
+                    "retry policy, the fault injector, or the post-replace "
+                    "directory fsync. Route it through "
+                    "tpumetrics.resilience.storage.atomic_write (or "
+                    "run_with_retry for a bare rename).",
+                    mod.path, node.lineno, node.col_offset,
+                )
+            elif dotted == "open" and self._write_mode(node):
+                yield Finding(
+                    "TPL110",
+                    "write-capable `open` in a durability seam module "
+                    "bypasses the storage shim: the bytes get no retry, no "
+                    "errno classification, no durability-degradation latch, "
+                    "and the soak's fault plans cannot reach them. Route "
+                    "the write through "
+                    "tpumetrics.resilience.storage.atomic_write.",
+                    mod.path, node.lineno, node.col_offset,
+                )
+
+    @staticmethod
+    def _write_mode(call: ast.Call) -> bool:
+        mode: Optional[ast.expr] = None
+        if len(call.args) >= 2:
+            mode = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if mode is None:
+            return False  # default mode "r": read-side
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+            return any(ch in _TPL110_WRITE_MODES for ch in mode.value)
+        return False  # dynamic mode: unknowable statically, stay quiet
+
+
 #: the serving-layer modules whose entry points TPL106 rejects in update paths
 _TPL106_MODULES = (
     "tpumetrics.telemetry.serve",
@@ -1989,6 +2081,7 @@ RULES = [
     BackboneLifecycleRule(),
     ResidencyLifecycleRule(),
     RoutingEpochRule(),
+    BareDurabilityWriteRule(),
     ServingLayerRule(),
     StateDeclRule(),
     ShadowStateRule(),

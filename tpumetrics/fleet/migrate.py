@@ -39,6 +39,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from tpumetrics.lifecycle.store import SpillStore, _safe_dirname
+from tpumetrics.resilience import storage as _storage
 from tpumetrics.runtime import snapshot as _snapshot
 from tpumetrics.telemetry import instruments as _instruments
 from tpumetrics.telemetry import ledger as _telemetry
@@ -124,7 +125,7 @@ class HandoffStore:
         self.root = (
             root if root is not None else tempfile.mkdtemp(prefix="tpumetrics-handoff-")
         )
-        self.cuts = SpillStore(os.path.join(self.root, "cuts"), keep=1)
+        self.cuts = SpillStore(os.path.join(self.root, "cuts"), keep=1, seam="migration")
         self._manifests = os.path.join(self.root, "manifests")
         os.makedirs(self._manifests, exist_ok=True)
         self._lock = threading.Lock()
@@ -134,16 +135,27 @@ class HandoffStore:
 
     def _write_manifest(self, tenant_id: str, data: Dict[str, Any]) -> None:
         path = self._manifest_path(tenant_id)
-        fd, tmp = tempfile.mkstemp(dir=self._manifests, suffix=".tmp")
+        # retain the current manifest as the ".prev" sibling BEFORE the
+        # rename: a manifest found torn at recovery (a power loss that tore
+        # the rename's data out from under the directory entry) then
+        # arbitrates from the atomic-rename predecessor — the state machine's
+        # previous durable state — instead of being unrecoverable
+        prior = None
         try:
-            with os.fdopen(fd, "w") as fh:
-                json.dump(data, fh, sort_keys=True)
-                fh.flush()
-                os.fsync(fh.fileno())
-            os.replace(tmp, path)
-        finally:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
+            with open(path, "rb") as fh:
+                prior = fh.read()
+            json.loads(prior.decode())  # never retain an already-torn file
+        except (OSError, ValueError):
+            prior = None
+        if prior is not None:
+            _storage.atomic_write(
+                self._manifests, path + ".prev",
+                lambda fh: fh.write(prior), seam="manifest",
+            )
+        payload = json.dumps(data, sort_keys=True).encode()
+        _storage.atomic_write(
+            self._manifests, path, lambda fh: fh.write(payload), seam="manifest",
+        )
 
     def cut(
         self,
@@ -216,16 +228,42 @@ class HandoffStore:
     def newest_cut_path(self, tenant_id: str) -> Optional[str]:
         return self.cuts.newest_path(tenant_id)
 
-    def manifest(self, tenant_id: str) -> Optional[Dict[str, Any]]:
+    def _load_manifest(self, path: str) -> Optional[Dict[str, Any]]:
+        """One manifest file -> dict, ``None`` when absent.  A TORN manifest
+        (truncated JSON — the rename's data lost under the directory entry)
+        arbitrates from the retained atomic-rename predecessor: the previous
+        durable state of the state machine.  Torn with no predecessor means
+        the FIRST write never durably landed — the migration never reached
+        its durable phase, i.e. no manifest at all."""
+
+        def _read(p: str) -> Optional[Dict[str, Any]]:
+            try:
+                with open(p) as fh:
+                    return json.load(fh)
+            except FileNotFoundError:
+                return None
+
         try:
-            with open(self._manifest_path(tenant_id)) as fh:
-                return json.load(fh)
-        except FileNotFoundError:
-            return None
-        except (OSError, json.JSONDecodeError) as err:
+            return _storage.read_with_retry(lambda: _read(path), seam="manifest", path=path)
+        except json.JSONDecodeError as torn:
+            try:
+                prev = _storage.read_with_retry(
+                    lambda: _read(path + ".prev"), seam="manifest", path=path + ".prev"
+                )
+            except (OSError, json.JSONDecodeError):
+                prev = None
+            _telemetry.record_event(
+                None, "manifest_torn", path=path, error=str(torn),
+                arbitrated="prev" if prev is not None else "absent",
+            )
+            return prev
+        except OSError as err:
             raise MigrationError(
-                f"Unreadable handoff manifest for tenant {tenant_id!r}: {err}"
+                f"Unreadable handoff manifest at {path!r}: {err}"
             ) from err
+
+    def manifest(self, tenant_id: str) -> Optional[Dict[str, Any]]:
+        return self._load_manifest(self._manifest_path(tenant_id))
 
     def mark_committed(self, tenant_id: str) -> None:
         """Flip the manifest to ``"committed"`` — THE durable commit point
@@ -245,16 +283,18 @@ class HandoffStore:
         for name in sorted(os.listdir(self._manifests)):
             if not name.endswith(".json"):
                 continue
-            with open(os.path.join(self._manifests, name)) as fh:
-                out.append(json.load(fh))
+            data = self._load_manifest(os.path.join(self._manifests, name))
+            if data is not None:
+                out.append(data)
         return sorted(out, key=lambda m: m.get("tenant", ""))
 
     def resolve(self, tenant_id: str) -> None:
         """Drop a finished migration's manifest + cut (idempotent)."""
-        try:
-            os.unlink(self._manifest_path(tenant_id))
-        except FileNotFoundError:
-            pass
+        for path in (self._manifest_path(tenant_id), self._manifest_path(tenant_id) + ".prev"):
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
         self.cuts.discard(tenant_id)
 
     def close(self) -> None:

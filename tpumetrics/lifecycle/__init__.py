@@ -16,6 +16,7 @@ from tpumetrics.lifecycle.policy import (
     RESIDENT,
     REVIVING,
     LifecyclePolicy,
+    TenantRevivalError,
     TenantRevivingError,
 )
 from tpumetrics.lifecycle.store import SpillStore
@@ -28,5 +29,6 @@ __all__ = [
     "LifecycleManager",
     "LifecyclePolicy",
     "SpillStore",
+    "TenantRevivalError",
     "TenantRevivingError",
 ]

@@ -52,6 +52,7 @@ from tpumetrics.lifecycle.policy import (
     RESIDENT,
     REVIVING,
     LifecyclePolicy,
+    TenantRevivalError,
     TenantRevivingError,
 )
 from tpumetrics.lifecycle.store import SpillStore
@@ -392,7 +393,21 @@ class LifecycleManager:
                         "progress) under policy='error'; retry once it is resident."
                     )
                 self._cond.wait()
+                # the transition this caller was blocked on may have FAILED:
+                # surface the reviver's error as a typed refusal to every
+                # waiter instead of each serially re-paying the broken
+                # restore (the corrupt-spill wedge).  A fresh submit — one
+                # that never waited — retries the revival from scratch.
+                err = getattr(tenant, "revival_error", None)
+                if err is not None:
+                    raise TenantRevivalError(
+                        f"Tenant {tenant.tid!r}: the revival this call waited on "
+                        f"failed ({type(err).__name__}: {err}). A corrupt spill is "
+                        "quarantined; a retry restores from the previous retained "
+                        "spill."
+                    ) from err
             tenant.residency = REVIVING
+            tenant.revival_error = None  # a new attempt clears the latch
             self._hibernated -= 1
         t0 = time.perf_counter()
         try:
@@ -400,9 +415,10 @@ class LifecycleManager:
             revive = getattr(tenant.metric, "revive_backbones", None)
             if callable(revive):
                 revive()
-        except BaseException:
+        except BaseException as revival_err:
             with self._cond:
                 tenant.residency = HIBERNATED
+                tenant.revival_error = revival_err
                 self._hibernated += 1
                 self._cond.notify_all()
             raise

@@ -44,6 +44,7 @@ __all__ = [
     "RESIDENT",
     "REVIVING",
     "LifecyclePolicy",
+    "TenantRevivalError",
     "TenantRevivingError",
 ]
 
@@ -61,6 +62,14 @@ class TenantRevivingError(TPUMetricsUserError):
     blocked, exactly like a full queue under the same policy.  Retry once
     the revival completes (``TenantHandle.stats()["residency"]`` flips
     back to ``"resident"``)."""
+
+
+class TenantRevivalError(TPUMetricsUserError):
+    """A revival ATTEMPT the caller was blocked on failed (corrupt spill,
+    storage error): every waiter gets this typed refusal instead of
+    serially re-paying the failing restore or waiting forever.  A fresh
+    submit retries the revival — if the corrupt spill was quarantined, the
+    retry restores from the previous retained spill."""
 
 
 @dataclasses.dataclass(frozen=True)

@@ -27,6 +27,7 @@ import tempfile
 import threading
 from typing import Any, Dict, Optional, Tuple
 
+from tpumetrics.resilience import storage as _storage
 from tpumetrics.runtime import snapshot as _snapshot
 
 __all__ = ["SpillStore"]
@@ -49,13 +50,19 @@ class SpillStore:
             the default for services that treat hibernation as a pure HBM
             release (cuts need not outlive the process).
         keep: spill files retained per tenant after each successful spill.
+        seam: the durability-seam label this store's writes carry through
+            the storage shim (``"spill"``; the migration HandoffStore's cut
+            store uses ``"migration"``).
     """
 
-    def __init__(self, root: Optional[str] = None, *, keep: int = 1) -> None:
+    def __init__(
+        self, root: Optional[str] = None, *, keep: int = 1, seam: str = "spill"
+    ) -> None:
         self._owned = root is None
         self.root = root if root is not None else tempfile.mkdtemp(prefix="tpumetrics-spill-")
         os.makedirs(self.root, exist_ok=True)
         self.keep = max(1, int(keep))
+        self.seam = seam
         self._lock = threading.Lock()
         self._seq: Dict[str, int] = {}  # tenant id -> last spill sequence
         self._bytes: Dict[str, int] = {}  # tenant id -> newest spill file size
@@ -92,7 +99,8 @@ class SpillStore:
         meta = dict(meta)
         meta["spill_seq"] = seq
         path = _snapshot.save_snapshot(
-            directory, seq, payload, meta=meta, guard_non_finite=guard_non_finite
+            directory, seq, payload, meta=meta, guard_non_finite=guard_non_finite,
+            seam=self.seam,
         )
         for _, old in _snapshot.list_snapshots(directory)[: -self.keep]:
             try:
@@ -153,14 +161,14 @@ class SpillStore:
         os.makedirs(directory, exist_ok=True)
         seq = self._next_seq(tenant_id, directory)
         final = os.path.join(directory, f"snapshot-{seq}.npz")
-        fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
-        os.close(fd)
-        try:
-            shutil.copyfile(src_path, tmp)
-            os.replace(tmp, final)
-        finally:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
+
+        def _copy(fh: Any) -> None:
+            with open(src_path, "rb") as src:
+                shutil.copyfileobj(src, fh)
+
+        _storage.atomic_write(
+            directory, final, _copy, seam=self.seam, prefix=".snapshot-",
+        )
         for _, old in _snapshot.list_snapshots(directory)[: -self.keep]:
             try:
                 os.unlink(old)

@@ -69,6 +69,14 @@ from tpumetrics.resilience.policy import (
     set_sync_policy,
     sync_policy,
 )
+from tpumetrics.resilience.storage import (
+    RetryPolicy,
+    StorageError,
+    StorageFullError,
+    atomic_write,
+    quarantine,
+    quarantine_census,
+)
 
 __all__ = [
     "DistributedSnapshotManager",
@@ -82,14 +90,20 @@ __all__ = [
     "InjectedPreemption",
     "NonFiniteStateError",
     "QuorumPolicy",
+    "RetryPolicy",
+    "StorageError",
+    "StorageFullError",
     "SyncError",
     "SyncFailedError",
     "SyncPolicy",
     "SyncTimeoutError",
+    "atomic_write",
     "config_digest",
     "gc_cuts",
     "get_sync_policy",
     "load_latest_cut",
+    "quarantine",
+    "quarantine_census",
     "run_guarded",
     "scan_cuts",
     "screen_non_finite",

@@ -47,6 +47,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from tpumetrics.resilience import storage as _qstorage
 from tpumetrics.telemetry import ledger as _telemetry
 from tpumetrics.utils.exceptions import TPUMetricsUserError
 
@@ -228,6 +229,10 @@ class ElasticCut:
     degraded: bool = False
     payloads: Dict[int, Any] = field(default_factory=dict)  # rank -> state payload
     headers: Dict[int, Dict[str, Any]] = field(default_factory=dict)
+    # how many newer candidate cuts the restore walked past (0 = the newest
+    # cut restored): the soak gates fallback_depth <= keep_cuts, and the
+    # evaluator surfaces it in stats()["storage"]
+    fallback_depth: int = 0
 
 
 @dataclass(frozen=True)
@@ -273,10 +278,12 @@ def _rank_dirs(root: str) -> Dict[int, str]:
     return out
 
 
-def scan_cuts(root: str) -> List[ElasticCut]:
+def scan_cuts(root: str, *, quarantine_corrupt: bool = True) -> List[ElasticCut]:
     """Group every elastic-stamped snapshot under ``root`` into candidate
-    cuts, newest step first.  Headers only — no payload load, no CRC; files
-    whose header is unreadable are skipped (they cannot belong to any cut)."""
+    cuts, newest step first.  Headers only — no payload load, no CRC; a file
+    whose header is unreadable cannot belong to any cut and is quarantined
+    (a torn write that destroyed the zip directory never even reaches the
+    CRC walk, but it is just as corrupt as one that fails it)."""
     from tpumetrics.runtime import snapshot as _snapshot
 
     groups: Dict[Tuple[int, int, str], Dict[int, str]] = {}
@@ -286,7 +293,9 @@ def scan_cuts(root: str) -> List[ElasticCut]:
         for _step, path in _snapshot.list_snapshots(directory):
             try:
                 header = _snapshot.read_header(path)
-            except _snapshot.SnapshotIntegrityError:
+            except _snapshot.SnapshotIntegrityError as err:
+                if quarantine_corrupt:
+                    _qstorage.quarantine(path, reason=f"unreadable header: {err}")
                 continue
             el = header.get("meta", {}).get("elastic")
             if not isinstance(el, dict):
@@ -315,6 +324,8 @@ def load_latest_cut(
     quorum: Optional[QuorumPolicy] = None,
     backend: Any = None,
     mode: Optional[str] = None,
+    *,
+    quarantine_corrupt: bool = True,
 ) -> Optional[ElasticCut]:
     """Find AND load (CRC-verified) the newest restorable cut under ``root``.
 
@@ -343,7 +354,7 @@ def load_latest_cut(
     if not candidates:
         return None
     tried: List[str] = []
-    for cut in candidates:
+    for depth, cut in enumerate(candidates):
         if cut.missing and quorum is None:
             # scan metadata already proves this cut unrestorable: don't pay
             # a CRC read of every present member just to discard them (the
@@ -373,8 +384,13 @@ def load_latest_cut(
                 else:
                     header, leaves = _snapshot.load_snapshot(path)
                     payload = _snapshot.reconstruct(header, leaves)
-            except _snapshot.SnapshotIntegrityError:
+            except _snapshot.SnapshotIntegrityError as err:
                 bad.append(member_rank)
+                if quarantine_corrupt:
+                    # pay the CRC walk once: the corrupt member leaves the
+                    # rank directory (scan_cuts never sees it again) and the
+                    # fallback resumes from here on every later restore
+                    _qstorage.quarantine(path, reason=str(err), backend=backend)
                 continue
             except _snapshot.SnapshotSpecError as err:
                 # unlike corruption, a spec mismatch means the CALLER changed
@@ -394,6 +410,7 @@ def load_latest_cut(
                 step=cut.step, world_size=cut.world_size, config=cut.config,
                 digest=cut.digest, members=cut.members, missing=(),
                 degraded=False, payloads=payloads, headers=headers,
+                fallback_depth=depth,
             )
         if quorum is not None and payloads and quorum.admits(len(payloads), cut.world_size):
             _telemetry.record_event(
@@ -405,6 +422,7 @@ def load_latest_cut(
                 step=cut.step, world_size=cut.world_size, config=cut.config,
                 digest=cut.digest, members=cut.members, missing=missing,
                 degraded=True, payloads=payloads, headers=headers,
+                fallback_depth=depth,
             )
         tried.append(
             f"step {cut.step} (world {cut.world_size}): missing rank(s) {list(missing)}"
@@ -468,7 +486,14 @@ def gc_cuts(
                 except OSError:
                     pass  # a concurrent rank's GC got there first
     now = time.time()
-    for directory in _rank_dirs(root).values():
+    watermark = complete[: int(keep_cuts)][-1].step if complete else None
+    # the NEWEST cut's declared world decides which rank dirs are stale: a
+    # rank inside it is live even when its dir is momentarily empty (a
+    # faulted first write unlinked the failed attempt's temp — the only
+    # entry — and the retry is about to recreate it), while a rank outside
+    # it was shrunk away and its emptied dir is garbage right now
+    current_world = cuts[0].world_size if cuts else None
+    for dir_rank, directory in _rank_dirs(root).items():
         try:
             names = os.listdir(directory)
         except OSError:
@@ -482,9 +507,35 @@ def gc_cuts(
                         removed.append(path)
                 except OSError:
                     pass
+        # quarantined members are NEVER retained cuts: collect the ones
+        # whose embedded step fell below the watermark (their cut is gone,
+        # so the evidence has no restore left to serve)
+        qdir = os.path.join(directory, _qstorage.QUARANTINE_DIRNAME)
+        if os.path.isdir(qdir):
+            try:
+                qnames = os.listdir(qdir)
+            except OSError:
+                qnames = []
+            for name in qnames:
+                m = re.match(r"^snapshot-(\d+)\.npz(?:\.\d+)?$", name)
+                if m and watermark is not None and int(m.group(1)) < watermark:
+                    try:
+                        os.unlink(os.path.join(qdir, name))
+                        removed.append(os.path.join(qdir, name))
+                    except OSError:
+                        pass
+            try:
+                if not os.listdir(qdir):
+                    os.rmdir(qdir)
+            except OSError:
+                pass
         try:
-            if not os.listdir(directory):
-                os.rmdir(directory)  # stale rank dir (shrunk world)
+            if (
+                current_world is not None
+                and dir_rank >= current_world
+                and not os.listdir(directory)
+            ):
+                os.rmdir(directory)
         except OSError:
             pass
     if removed:
@@ -548,6 +599,7 @@ class DistributedSnapshotManager:
         self._mgr = _snapshot.SnapshotManager(
             os.path.join(root, f"rank-{int(rank):05d}"),
             keep=None if keep_cuts is not None else keep,
+            seam="cut",
         )
 
     @property

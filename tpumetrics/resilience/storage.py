@@ -1,0 +1,427 @@
+"""The storage fault-tolerance shim: ONE copy of durable I/O for every seam.
+
+Every durability seam in the repo — snapshot cuts (``runtime/snapshot.py``),
+elastic cut members and barrier stamps (``resilience/elastic.py``),
+hibernation spills (``lifecycle/store.py``), and migration manifests
+(``fleet/migrate.py``) — routes its writes and reads through this module
+instead of calling ``open``/``os.replace`` directly (tpulint **TPL110**
+enforces exactly that).  The shim owns three policies those seams share:
+
+1. **Retry/backoff** (:class:`RetryPolicy`): deterministic bounded
+   exponential backoff with a wall-clock deadline.  Errnos are classified —
+   transient (``EIO``/``EAGAIN``/``EINTR``/``EBUSY``/``ETIMEDOUT``) are
+   retried and, on exhaustion, surface as a typed :class:`StorageError`;
+   permanent (``ENOSPC``/``EDQUOT`` → :class:`StorageFullError`, ``EROFS`` →
+   :class:`StorageError`) fail fast without burning the deadline; anything
+   else (``ENOENT``, a bad path, a programming error) propagates unchanged so
+   callers' own semantics (missing file → ``None``) keep working.  Every
+   retry records an ``io_retry`` ledger event and bumps
+   ``tpumetrics_io_retries_total{seam}``.
+
+2. **Atomic durable writes** (:func:`atomic_write`): the
+   tmp-file → write → flush → fsync → ``os.replace`` → directory-fsync
+   sequence, retried as a WHOLE per attempt — a lone fsync retry after a
+   failed one is not durable, so each attempt starts from a fresh temp file.
+
+3. **Quarantine** (:func:`quarantine`): a file that failed CRC at load is
+   renamed into a bounded sibling ``.quarantine/`` directory (ledger
+   ``snapshot_quarantined``), so read-side fallback work — walking to an
+   older cut or spill — is paid ONCE, not on every subsequent restore.
+   :func:`quarantine_census` summarizes the tree for ``/statusz``.
+
+Fault injection hooks the shim at named sub-op points (``open``, ``write``,
+``fsync``, ``replace``, ``post_replace``, ``read``) via
+:func:`set_fault_injector` — the seeded storage-chaos soak
+(:mod:`tpumetrics.soak.faults`) is the standing gate built on it.
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import os
+import tempfile
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, Optional
+
+from tpumetrics.telemetry import instruments as _instruments
+from tpumetrics.telemetry import ledger as _telemetry
+from tpumetrics.utils.exceptions import TPUMetricsUserError
+
+__all__ = [
+    "DEFAULT_POLICY",
+    "QUARANTINE_DIRNAME",
+    "RetryPolicy",
+    "StorageError",
+    "StorageFullError",
+    "atomic_write",
+    "classify_errno",
+    "clear_fault_injector",
+    "fsync_directory",
+    "quarantine",
+    "quarantine_census",
+    "read_with_retry",
+    "run_with_retry",
+    "set_fault_injector",
+]
+
+# read-side retries are semantically safe to repeat; writes restart the whole
+# atomic sequence, so both sides share one transient set
+TRANSIENT_ERRNOS = frozenset(
+    {_errno.EIO, _errno.EAGAIN, _errno.EINTR, _errno.EBUSY, _errno.ETIMEDOUT}
+)
+# "the disk is full / read-only" does not heal inside one retry window:
+# fail fast and let the caller degrade (suspend durability, keep serving)
+PERMANENT_ERRNOS = frozenset({_errno.ENOSPC, _errno.EDQUOT, _errno.EROFS})
+_FULL_ERRNOS = frozenset({_errno.ENOSPC, _errno.EDQUOT})
+
+QUARANTINE_DIRNAME = ".quarantine"
+DEFAULT_QUARANTINE_BOUND = 16
+
+
+class StorageError(TPUMetricsUserError):
+    """A durability operation failed permanently (retries exhausted on a
+    transient errno, or a permanent one like ``EROFS``).  Carries the
+    classified ``errno`` and the ``seam`` it fired on."""
+
+    def __init__(self, message: str, *, seam: str = "", errno: Optional[int] = None) -> None:
+        super().__init__(message)
+        self.seam = seam
+        self.errno = errno
+
+
+class StorageFullError(StorageError):
+    """``ENOSPC``/``EDQUOT``: the volume is out of space or quota.  The
+    evaluator's degradation path latches on this — serving continues from
+    HBM while a heal-probe waits for the window to clear."""
+
+
+def classify_errno(err: OSError) -> str:
+    """``"transient"`` | ``"permanent"`` | ``"unknown"`` for an OSError."""
+    code = getattr(err, "errno", None)
+    if code in TRANSIENT_ERRNOS:
+        return "transient"
+    if code in PERMANENT_ERRNOS:
+        return "permanent"
+    return "unknown"
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Deterministic bounded exponential backoff with a deadline.
+
+    Args:
+        attempts: total tries (first call + ``attempts - 1`` retries).
+        base_delay_s: delay before the first retry.
+        multiplier: per-retry backoff growth.
+        max_delay_s: per-retry delay cap.
+        deadline_s: wall-clock budget across all attempts; a retry whose
+            sleep would cross the deadline is not taken.
+
+    No jitter by design: the soak's bit-for-bit reproducibility extends to
+    the retry schedule itself.
+    """
+
+    attempts: int = 5
+    base_delay_s: float = 0.01
+    multiplier: float = 2.0
+    max_delay_s: float = 1.0
+    deadline_s: float = 30.0
+
+    def __post_init__(self) -> None:
+        if self.attempts < 1:
+            raise ValueError(f"attempts must be >= 1, got {self.attempts}")
+        if self.base_delay_s < 0 or self.max_delay_s < 0 or self.deadline_s <= 0:
+            raise ValueError(
+                "need base_delay_s >= 0, max_delay_s >= 0, deadline_s > 0; got "
+                f"{self.base_delay_s}/{self.max_delay_s}/{self.deadline_s}"
+            )
+        if self.multiplier < 1.0:
+            raise ValueError(f"multiplier must be >= 1, got {self.multiplier}")
+
+    def delays(self) -> Iterator[float]:
+        """The retry sleep schedule (``attempts - 1`` entries)."""
+        d = self.base_delay_s
+        for _ in range(self.attempts - 1):
+            yield min(d, self.max_delay_s)
+            d *= self.multiplier
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+# the seeded fault injector (tpumetrics.soak.faults installs one): called at
+# every named sub-op point with (op, path); it may raise OSError or mutate
+# the file in place.  Module-global on purpose — the worker process installs
+# it once and every seam in-process is covered.
+_INJECTOR: Optional[Callable[[str, str], None]] = None
+_INJECTOR_LOCK = threading.Lock()
+
+
+def set_fault_injector(fn: Optional[Callable[[str, str], None]]) -> None:
+    global _INJECTOR
+    with _INJECTOR_LOCK:
+        _INJECTOR = fn
+
+
+def clear_fault_injector() -> None:
+    set_fault_injector(None)
+
+
+def _inject(op: str, path: str) -> None:
+    fn = _INJECTOR
+    if fn is not None:
+        fn(op, path)
+
+
+def _io_retries():
+    return _instruments.counter(
+        _instruments.IO_RETRIES_TOTAL,
+        "durable I/O retries per seam (transient errno, retried by the shim)",
+        labels=("seam",),
+    )
+
+
+# write-side retry/exhaustion census for stats()["storage"]: seam -> count
+_RETRY_COUNTS: Dict[str, int] = {}
+_COUNTS_LOCK = threading.Lock()
+
+
+def retry_counts() -> Dict[str, int]:
+    """Per-seam retry totals for this process (``stats()`` storage section)."""
+    with _COUNTS_LOCK:
+        return dict(_RETRY_COUNTS)
+
+
+def _note_retry(seam: str, op: str, err: OSError, attempt: int, delay: float) -> None:
+    with _COUNTS_LOCK:
+        _RETRY_COUNTS[seam] = _RETRY_COUNTS.get(seam, 0) + 1
+    if _instruments.enabled():
+        _io_retries().inc(1.0, seam)
+    _telemetry.record_event(
+        None,
+        "io_retry",
+        seam=seam,
+        op=op,
+        errno=getattr(err, "errno", None),
+        attempt=attempt,
+        delay_s=round(delay, 6),
+    )
+
+
+def _permanent(err: OSError, seam: str, op: str) -> StorageError:
+    cls = StorageFullError if err.errno in _FULL_ERRNOS else StorageError
+    return cls(
+        f"{op} on seam {seam!r} failed permanently "
+        f"(errno {err.errno}, {os.strerror(err.errno) if err.errno else err}): {err}",
+        seam=seam,
+        errno=err.errno,
+    )
+
+
+def run_with_retry(
+    fn: Callable[[], Any],
+    *,
+    seam: str,
+    op: str = "write",
+    policy: Optional[RetryPolicy] = None,
+    backend: Any = None,
+) -> Any:
+    """Run ``fn`` retrying transient OSErrors under ``policy``.
+
+    Transient errnos retry with backoff and, on exhaustion, raise a typed
+    :class:`StorageError`; permanent errnos raise immediately
+    (:class:`StorageFullError` for out-of-space); every other exception
+    propagates unchanged.  ``backend`` only labels ledger events.
+    """
+    del backend  # events carry no backend identity; kept for call-site symmetry
+    policy = policy or DEFAULT_POLICY
+    start = time.monotonic()
+    delays = list(policy.delays())
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except StorageError:
+            raise  # already classified by a nested shim call
+        except OSError as err:
+            kind = classify_errno(err)
+            if kind == "permanent":
+                raise _permanent(err, seam, op) from err
+            if kind != "transient":
+                raise
+            elapsed = time.monotonic() - start
+            if attempt >= len(delays) or elapsed + delays[attempt] > policy.deadline_s:
+                raise StorageError(
+                    f"{op} on seam {seam!r} failed after {attempt + 1} attempt(s) "
+                    f"over {elapsed:.3f}s (transient errno {err.errno} never "
+                    f"cleared): {err}",
+                    seam=seam,
+                    errno=err.errno,
+                ) from err
+            delay = delays[attempt]
+            attempt += 1
+            _note_retry(seam, op, err, attempt, delay)
+            time.sleep(delay)
+
+
+def read_with_retry(
+    fn: Callable[[], Any],
+    *,
+    seam: str,
+    path: str = "",
+    policy: Optional[RetryPolicy] = None,
+    backend: Any = None,
+) -> Any:
+    """Read-side wrapper: injector ``("read", path)`` point + transient
+    retry.  ``FileNotFoundError`` passes through untouched (missing file is
+    a semantic answer, not a fault)."""
+
+    def _attempt():
+        _inject("read", path)
+        return fn()
+
+    return run_with_retry(_attempt, seam=seam, op="read", policy=policy, backend=backend)
+
+
+def fsync_directory(directory: str) -> None:
+    """Make a rename in ``directory`` durable (best-effort on platforms
+    whose directory fds reject fsync)."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(
+    directory: str,
+    final_path: str,
+    writer: Callable[[Any], None],
+    *,
+    seam: str,
+    prefix: str = ".storage-",
+    suffix: str = ".tmp",
+    policy: Optional[RetryPolicy] = None,
+    backend: Any = None,
+    fsync_dir: bool = True,
+) -> str:
+    """Durably write ``final_path``: temp file in ``directory`` → ``writer(fh)``
+    → flush → fsync → ``os.replace`` → directory fsync, the WHOLE sequence
+    retried per attempt under ``policy`` (each attempt gets a fresh temp
+    file; a failed attempt's debris is unlinked).  Returns ``final_path``.
+    """
+
+    def _attempt() -> None:
+        # self-healing per attempt: a concurrent GC may collect the
+        # directory while THIS writer is between retries (its failed
+        # attempt's debris was the directory's only entry) — recreating it
+        # here turns that race into one more transient, not an ENOENT
+        os.makedirs(directory, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(prefix=prefix, suffix=suffix, dir=directory)
+        try:
+            _inject("open", tmp)
+            with os.fdopen(fd, "wb") as fh:
+                writer(fh)
+                fh.flush()
+                _inject("write", tmp)
+                os.fsync(fh.fileno())
+                _inject("fsync", tmp)
+            _inject("replace", final_path)
+            os.replace(tmp, final_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        if fsync_dir:
+            fsync_directory(directory)
+        _inject("post_replace", final_path)
+
+    run_with_retry(_attempt, seam=seam, op="write", policy=policy, backend=backend)
+    return final_path
+
+
+# ------------------------------------------------------------------ quarantine
+
+
+def quarantine(
+    path: str,
+    *,
+    reason: str,
+    backend: Any = None,
+    bound: int = DEFAULT_QUARANTINE_BOUND,
+) -> Optional[str]:
+    """Rename a corrupt durability file into its directory's bounded
+    ``.quarantine/`` sibling so no later restore pays the CRC walk again.
+
+    Returns the quarantined path, or ``None`` if the file could not be
+    moved (already gone, or the rename itself failed — fallback proceeds
+    either way; quarantine is an optimization, never a gate).  Records a
+    ``snapshot_quarantined`` ledger event and prunes the quarantine dir to
+    ``bound`` newest files.
+    """
+    directory = os.path.dirname(os.path.abspath(path))
+    qdir = os.path.join(directory, QUARANTINE_DIRNAME)
+    base = os.path.basename(path)
+    try:
+        os.makedirs(qdir, exist_ok=True)
+        dest = os.path.join(qdir, base)
+        n = 1
+        while os.path.lexists(dest):
+            dest = os.path.join(qdir, f"{base}.{n}")
+            n += 1
+        os.replace(path, dest)
+    except OSError:
+        return None
+    _prune_quarantine(qdir, bound)
+    _telemetry.record_event(
+        backend, "snapshot_quarantined", path=path, dest=dest, reason=reason
+    )
+    return dest
+
+
+def _prune_quarantine(qdir: str, bound: int) -> None:
+    try:
+        names = [n for n in os.listdir(qdir) if os.path.isfile(os.path.join(qdir, n))]
+    except OSError:
+        return
+    if len(names) <= max(0, bound):
+        return
+    # oldest first by mtime (name as a deterministic tiebreak)
+    def _key(name: str):
+        try:
+            return (os.path.getmtime(os.path.join(qdir, name)), name)
+        except OSError:
+            return (0.0, name)
+
+    for name in sorted(names, key=_key)[: len(names) - bound]:
+        try:
+            os.unlink(os.path.join(qdir, name))
+        except OSError:
+            pass
+
+
+def quarantine_census(root: str) -> Dict[str, int]:
+    """Count quarantined files under ``root`` (recursive) for ``/statusz``:
+    ``{"dirs": N, "files": N, "bytes": N}``."""
+    dirs = files = total = 0
+    if not os.path.isdir(root):
+        return {"dirs": 0, "files": 0, "bytes": 0}
+    for dirpath, dirnames, filenames in os.walk(root):
+        if os.path.basename(dirpath) == QUARANTINE_DIRNAME:
+            dirs += 1
+            for name in filenames:
+                files += 1
+                try:
+                    total += os.path.getsize(os.path.join(dirpath, name))
+                except OSError:
+                    pass
+            dirnames[:] = []  # never descend further
+    return {"dirs": dirs, "files": files, "bytes": total}

@@ -88,7 +88,16 @@ class DrainReport:
     ``drain_ms`` is the flush+final-cut wall time (also stamped into the
     ``drain_complete`` ledger event — the durable copy, since ``close``
     releases the per-stream histogram series as part of its own
-    contract)."""
+    contract).
+
+    ``partial`` marks a drain whose FINAL CUT failed with a storage error
+    that survived the retry budget (degraded durability at shutdown): the
+    state that was drained is NOT fully covered by any snapshot.  The
+    report then names the uncovered tail — ``uncovered_batches`` /
+    ``uncovered_items`` (stream positions past the last durable cut) and
+    ``reason`` (the typed storage error) — so the caller can re-route or
+    replay that tail explicitly instead of discovering the loss at the
+    next restore."""
 
     target: str
     batches: int
@@ -97,6 +106,10 @@ class DrainReport:
     cut_step: Optional[int] = None
     drain_ms: Optional[float] = None
     tenants: Dict[str, "DrainReport"] = field(default_factory=dict)
+    partial: bool = False
+    uncovered_batches: int = 0
+    uncovered_items: int = 0
+    reason: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -107,6 +120,11 @@ class DrainReport:
             "cut_step": self.cut_step,
             "drain_ms": self.drain_ms,
         }
+        if self.partial:
+            out["partial"] = True
+            out["uncovered_batches"] = self.uncovered_batches
+            out["uncovered_items"] = self.uncovered_items
+            out["reason"] = self.reason
         if self.tenants:
             out["tenants"] = {k: v.to_dict() for k, v in self.tenants.items()}
         return out

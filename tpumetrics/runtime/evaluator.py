@@ -74,6 +74,7 @@ from tpumetrics.runtime.compile_cache import (
 from tpumetrics.runtime.dispatch import _DEPTH_GAUGE, AsyncDispatcher
 from tpumetrics.runtime.scheduler import SignatureRegistry
 from tpumetrics.runtime import snapshot as _snapshot
+from tpumetrics.resilience import storage as _storage
 from tpumetrics.telemetry import device as _device
 from tpumetrics.telemetry import export as _export
 from tpumetrics.telemetry import health as _health
@@ -120,6 +121,17 @@ _STATE_HBM_GAUGE = _instruments.gauge(
     help="live metric-state buffer bytes held on device for the stream",
     labels=("stream",),
 )
+_DURABILITY_GAUGE = _instruments.gauge(
+    _instruments.DURABILITY_DEGRADED,
+    help="1 while cut durability is suspended behind the heal probe",
+    labels=("stream",),
+)
+
+# heal-probe backoff while durability is degraded: the first re-attempt
+# comes quickly (a transient ENOSPC window clears fast), later ones back
+# off so a genuinely full disk is probed, not hammered
+_HEAL_BACKOFF_BASE_S = 0.5
+_HEAL_BACKOFF_MAX_S = 30.0
 
 
 class CrashLoopError(TPUMetricsUserError):
@@ -393,6 +405,21 @@ class StreamingEvaluator:
         self._drain_requested = False
         self._drain_report: Optional[Any] = None
         self._drain_lock = threading.Lock()  # serializes concurrent drain()s
+        # durability degradation: a cut save whose StorageError survived the
+        # shim's retry budget must not kill serving — the state is intact in
+        # HBM.  Saves are suspended behind a backoff heal probe instead, the
+        # window is latched observably (durability_degraded ledger event +
+        # gauge, stats()["storage"], /healthz reason), and the first healed
+        # probe IS the resume cut.  Mutated under _lock on the save paths;
+        # read lock-free (GIL-atomic scalars) by the never-blocking stats().
+        self._storage_degraded = False
+        self._storage_reason: Optional[str] = None
+        self._storage_degraded_at: Optional[float] = None
+        self._suspended_cuts = 0  # auto-cadence saves skipped while degraded
+        self._heal_backoff_s = _HEAL_BACKOFF_BASE_S
+        self._next_heal_at = 0.0  # monotonic deadline for the next probe
+        self._durable_items = 0  # items covered by the last durable cut
+        self._restore_fallback_depth: Optional[int] = None  # restore_elastic
 
         if (snapshot_rank is None) != (snapshot_world_size is None):
             raise ValueError("snapshot_rank and snapshot_world_size must be set together")
@@ -526,6 +553,7 @@ class StreamingEvaluator:
                 self._admin.close()
             for inst in (
                 _SUBMIT_HIST, _DISPATCH_HIST, _JOURNAL_GAUGE, _RESTORE_HIST, _DRAIN_HIST,
+                _DURABILITY_GAUGE,
             ):
                 inst.remove(self._stream)
             _DEPTH_GAUGE.remove(self._stream)
@@ -599,11 +627,21 @@ class StreamingEvaluator:
             self.flush(timeout=timeout)
             cut_path: Optional[str] = None
             cut_step: Optional[int] = None
+            cut_error: Optional[str] = None
             if final_cut and self._snapshots is not None:
-                cut_path = self.snapshot()
-                cut_step = self._snapshots.last_step
+                # degraded storage must not turn a polite preemption into a
+                # hang or a lie: the final cut is attempted regardless of
+                # the heal-probe schedule (last chance before exit), and a
+                # surviving StorageError yields a PARTIAL report naming the
+                # uncovered tail instead of an exception mid-grace-window
+                try:
+                    cut_path = self.snapshot()
+                    cut_step = self._snapshots.last_step
+                except _storage.StorageError as err:
+                    cut_error = f"{type(err).__name__}: {err}"
             with self._lock:
                 batches, items = self._batches, self._items
+                durable_batches, durable_items = self._journal_base, self._durable_items
             drain_ms = (time.perf_counter() - t0) * 1e3
             if timed:
                 _DRAIN_HIST.observe(drain_ms, self._stream)
@@ -612,11 +650,17 @@ class StreamingEvaluator:
             _telemetry.record_event(
                 None, "drain_complete", stream=self._stream, batches=batches,
                 items=items, cut_step=cut_step, drain_ms=round(drain_ms, 3),
+                partial=cut_error is not None,
             )
             report = DrainReport(
                 target=self._stream, batches=batches, items=items,
                 cut_path=cut_path, cut_step=cut_step, drain_ms=drain_ms,
             )
+            if cut_error is not None:
+                report.partial = True
+                report.reason = cut_error
+                report.uncovered_batches = batches - durable_batches
+                report.uncovered_items = items - durable_items
             self.close(drain=True, timeout=timeout)
             self._drain_report = report  # cached only once the close succeeded
             return report
@@ -701,12 +745,37 @@ class StreamingEvaluator:
         out["latency"] = _instruments.latency_section(self._stream)
         out["recompiles"] = recompile_count(self._stream)
         out["device"] = self._device_section()
+        out["storage"] = self._storage_section()
         from tpumetrics.monitoring.drift import monitoring_stats
 
         monitoring = monitoring_stats(self._metric, self._stream)
         if monitoring:
             out["monitoring"] = monitoring
         return out
+
+    # ---------------------------------------------------- storage observability
+
+    def _storage_section(self) -> Dict[str, Any]:
+        """The ``stats()["storage"]`` payload: the durability-degradation
+        latch, the shim's per-seam retry counts, the fallback depth of the
+        last elastic restore, and the quarantine census under the snapshot
+        root.  Reads GIL-atomic scalars lock-free (the never-blocking
+        ``stats()`` contract); the census is one bounded directory walk."""
+        section: Dict[str, Any] = {
+            "degraded": self._storage_degraded,
+            "reason": self._storage_reason,
+            "suspended_cuts": self._suspended_cuts,
+            "heal_backoff_s": self._heal_backoff_s if self._storage_degraded else 0.0,
+            "retries": _storage.retry_counts(),
+            "fallback_depth": self._restore_fallback_depth,
+        }
+        t0 = self._storage_degraded_at
+        if self._storage_degraded and t0 is not None:
+            section["degraded_s"] = round(time.monotonic() - t0, 3)
+        if self._snapshots is not None:
+            root = getattr(self._snapshots, "root", None) or self._snapshots.directory
+            section["quarantine"] = _storage.quarantine_census(root)
+        return section
 
     # ----------------------------------------------------- device observability
 
@@ -801,12 +870,86 @@ class StreamingEvaluator:
     def snapshot(self) -> str:
         """Flush, then atomically persist the state tagged with the stream
         position (step = batches drained).  The saved state covers exactly
-        the submitted prefix of the stream — the crash-consistency anchor."""
+        the submitted prefix of the stream — the crash-consistency anchor.
+
+        While durability is degraded an explicit call still attempts the
+        write (it doubles as a heal probe — an explicit request outranks the
+        probe schedule): success resumes durability, failure re-raises the
+        typed :class:`~tpumetrics.resilience.storage.StorageError`."""
         if self._snapshots is None:
             raise TPUMetricsUserError("StreamingEvaluator was built without snapshot_dir")
         self.flush()
         with self._lock:
-            return self._save_snapshot_locked()
+            return self._durable_save_locked()
+
+    def _durable_save_locked(self) -> str:
+        """:meth:`_save_snapshot_locked` + the durability-degradation latch:
+        a surviving :class:`~tpumetrics.resilience.storage.StorageError`
+        (the shim's retry budget is already spent by the time it surfaces)
+        enters/extends the degraded window before re-raising; a success
+        heals it (the successful save IS the resume cut)."""
+        try:
+            path = self._save_snapshot_locked()
+        except _storage.StorageError as err:
+            self._note_storage_failure(err)
+            raise
+        self._note_storage_healed()
+        return path
+
+    def _autosave_locked(self) -> Optional[str]:
+        """The auto-cadence (``snapshot_every``) save: while degraded, skip
+        until the heal probe is due — serving continues from HBM and the
+        skipped cut is counted (``stats()["storage"]["suspended_cuts"]``).
+        A failure is fully latched by :meth:`_durable_save_locked`; it never
+        propagates into the worker (a storage fault is not a crash — the
+        state is intact and restore+replay would not fix the disk)."""
+        if self._storage_degraded and time.monotonic() < self._next_heal_at:
+            self._suspended_cuts += 1
+            return None
+        try:
+            return self._durable_save_locked()
+        except _storage.StorageError:
+            return None
+
+    def _note_storage_failure(self, err: BaseException) -> None:
+        now = time.monotonic()
+        self._storage_reason = f"{type(err).__name__}: {err}"
+        if not self._storage_degraded:
+            # entry: ONE durability_degraded event + gauge flip per window
+            self._storage_degraded = True
+            self._storage_degraded_at = now
+            self._suspended_cuts = 0
+            self._heal_backoff_s = _HEAL_BACKOFF_BASE_S
+            if _instruments.enabled() and not self._closed:
+                _DURABILITY_GAUGE.set(1.0, self._stream)
+            _telemetry.record_event(
+                None, "durability_degraded", stream=self._stream,
+                error=self._storage_reason, seam=getattr(err, "seam", ""),
+                batches=self._batches, durable_batches=self._journal_base,
+            )
+        else:
+            # a failed heal probe: back off before the next one
+            self._heal_backoff_s = min(self._heal_backoff_s * 2.0, _HEAL_BACKOFF_MAX_S)
+        self._next_heal_at = now + self._heal_backoff_s
+
+    def _note_storage_healed(self) -> None:
+        if not self._storage_degraded:
+            return
+        t0 = self._storage_degraded_at
+        degraded_s = time.monotonic() - t0 if t0 is not None else 0.0
+        suspended, self._suspended_cuts = self._suspended_cuts, 0
+        self._storage_degraded = False
+        self._storage_reason = None
+        self._storage_degraded_at = None
+        self._heal_backoff_s = _HEAL_BACKOFF_BASE_S
+        self._next_heal_at = 0.0
+        if _instruments.enabled() and not self._closed:
+            _DURABILITY_GAUGE.set(0.0, self._stream)
+        _telemetry.record_event(
+            None, "durability_resumed", stream=self._stream,
+            suspended_cuts=suspended, degraded_s=round(degraded_s, 3),
+            batches=self._batches,
+        )
 
     def _barrier_proposal(self) -> int:
         """The logical step this rank proposes to the cut barrier: its
@@ -883,6 +1026,7 @@ class StreamingEvaluator:
         # the journal is "since the last snapshot": this save is the new base
         self._journal = []
         self._journal_base = self._batches
+        self._durable_items = self._items
         if self._crash_policy == "restore":
             _JOURNAL_GAUGE.set(0, self._stream)  # cleared, not just appended
         return path
@@ -1009,7 +1153,11 @@ class StreamingEvaluator:
             self._last_compute_at = total_batches
             self._journal = []
             self._journal_base = total_batches
+            self._durable_items = total_items
             self._degraded = degraded
+            # how deep the CRC walk had to fall back past corrupt cuts to
+            # find this one (0 = newest; the chaos soak gates <= keep_cuts)
+            self._restore_fallback_depth = int(getattr(cut, "fallback_depth", 0))
             self._device_health = None  # counters describe the pre-restore pytree
             self._elastic_base_batches = total_batches
             self._elastic_base_items = total_items
@@ -1023,6 +1171,7 @@ class StreamingEvaluator:
                 from_world=cut.world_size, world_size=self._world, rank=self._rank,
                 batches=total_batches, degraded=degraded,
                 missing=list(cut.missing), restore_ms=round(restore_ms, 3),
+                fallback_depth=self._restore_fallback_depth,
             )
             return {
                 "step": cut.step,
@@ -1034,6 +1183,7 @@ class StreamingEvaluator:
                 "degraded": degraded,
                 "missing_ranks": list(cut.missing),
                 "restore_ms": restore_ms,
+                "fallback_depth": self._restore_fallback_depth,
             }
 
     def _place_state(self, payload: Any) -> Any:
@@ -1094,6 +1244,7 @@ class StreamingEvaluator:
         self._last_compute_at = restored
         self._journal = []
         self._journal_base = restored
+        self._durable_items = items
         self._degraded = degraded
         # the adopted state is a different pytree: stale health counters
         # describe buffers that no longer exist (the alert latch stays — a
@@ -1155,7 +1306,7 @@ class StreamingEvaluator:
                     and batches % self._snapshot_every == 0
                 ):
                     with self._lock:
-                        self._save_snapshot_locked()
+                        self._autosave_locked()
         except BaseException as err:
             # end the root NOW so the poisoned batch's trace is complete
             # (and in the flight ring) before crash handling dumps/raises

@@ -220,6 +220,9 @@ class _Tenant:
         # forever and only the timestamp is maintained.
         self.residency = "resident"
         self.last_dispatch = time.monotonic()
+        # a failed revival latches its error here for the waiters blocked on
+        # that attempt (typed TenantRevivalError); the next attempt clears it
+        self.revival_error: Optional[BaseException] = None
 
         # live migration (fleet/migrate.py): ``migrating`` opens the
         # final-cut window — intake gated by the tenant's own backpressure

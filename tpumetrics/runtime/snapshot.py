@@ -38,7 +38,6 @@ from __future__ import annotations
 import json
 import os
 import re
-import tempfile
 import zipfile
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
@@ -46,6 +45,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from tpumetrics.resilience import storage as _storage
 from tpumetrics.utils.exceptions import TPUMetricsUserError
 
 FORMAT = "tpumetrics-snapshot"
@@ -83,11 +83,18 @@ def save_snapshot(
     state: Any,
     meta: Optional[Dict[str, Any]] = None,
     guard_non_finite: str = "off",
+    *,
+    seam: str = "snapshot",
+    retry_policy: Optional[_storage.RetryPolicy] = None,
 ) -> str:
     """Atomically write ``state`` (any pytree of arrays) as snapshot ``step``.
 
     Returns the final path.  The file only appears under its final name once
-    fully written (write temp -> fsync -> rename).
+    fully written (write temp -> fsync -> rename), via the
+    :mod:`~tpumetrics.resilience.storage` shim: transient I/O errors retry
+    under ``retry_policy`` (labelled ``seam`` in the ledger/instruments),
+    permanent ones raise a typed
+    :class:`~tpumetrics.resilience.storage.StorageError`.
 
     ``guard_non_finite`` (``"off"``/``"warn"``/``"error"``) screens every
     float leaf for NaN/Inf before it is persisted: a poisoned state written
@@ -132,36 +139,24 @@ def save_snapshot(
     payload["__header__"] = np.frombuffer(json.dumps(header).encode(), dtype=np.uint8)
 
     final = os.path.join(directory, f"snapshot-{int(step)}.npz")
-    fd, tmp = tempfile.mkstemp(prefix=".snapshot-", suffix=".tmp", dir=directory)
-    try:
-        with os.fdopen(fd, "wb") as fh:
-            np.savez(fh, **payload)
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, final)
-        # fsync the parent DIRECTORY too: the file's bytes are durable, but
-        # the rename itself lives in the directory inode — without this a
-        # host power-loss can leave a directory entry pointing at nothing
-        # (a vanished "latest" snapshot the CRC never gets to see)
-        _fsync_dir(directory)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
-    return final
+    # the shim owns the temp-write -> fsync -> rename -> directory-fsync
+    # sequence (the directory fsync matters: the file's bytes are durable,
+    # but the rename itself lives in the directory inode — without it a host
+    # power-loss can leave a directory entry pointing at nothing) and retries
+    # the WHOLE sequence on transient I/O errors
+    return _storage.atomic_write(
+        directory,
+        final,
+        lambda fh: np.savez(fh, **payload),
+        seam=seam,
+        prefix=".snapshot-",
+        suffix=".tmp",
+        policy=retry_policy,
+    )
 
 
 def _fsync_dir(directory: str) -> None:
-    try:
-        dirfd = os.open(directory, os.O_RDONLY)
-    except OSError:
-        return  # platforms without directory fds (e.g. Windows): best effort
-    try:
-        os.fsync(dirfd)
-    except OSError:
-        pass
-    finally:
-        os.close(dirfd)
+    _storage.fsync_directory(directory)
 
 
 def list_snapshots(directory: str) -> List[Tuple[int, str]]:
@@ -189,23 +184,33 @@ def _header_of(z: Any, path: str) -> Dict[str, Any]:
     return header
 
 
-def read_header(path: str) -> Dict[str, Any]:
+def read_header(path: str, *, seam: str = "snapshot") -> Dict[str, Any]:
     """Header (step/spec/meta) WITHOUT loading or checksumming the leaves —
     the cheap scan primitive the elastic cut discovery uses to group rank
-    snapshots before committing to a full CRC-verified load."""
-    try:
+    snapshots before committing to a full CRC-verified load.  Transient read
+    errors retry through the storage shim."""
+
+    def _read() -> Dict[str, Any]:
         with np.load(path) as z:
             return _header_of(z, path)
+
+    try:
+        return _storage.read_with_retry(_read, seam=seam, path=path)
     except (OSError, ValueError, KeyError, json.JSONDecodeError, zipfile.BadZipFile) as err:
         raise SnapshotIntegrityError(f"{path}: unreadable snapshot ({err})") from err
 
 
-def load_snapshot(path: str) -> Tuple[Dict[str, Any], List[np.ndarray]]:
+def load_snapshot(path: str, *, seam: str = "snapshot") -> Tuple[Dict[str, Any], List[np.ndarray]]:
     """Read + integrity-check one snapshot file -> (header, leaves)."""
-    try:
+
+    def _read() -> Tuple[Dict[str, Any], List[np.ndarray]]:
         with np.load(path) as z:
             header = _header_of(z, path)
             leaves = [z[f"leaf_{i}"] for i in range(len(header["spec"]))]
+        return header, leaves
+
+    try:
+        header, leaves = _storage.read_with_retry(_read, seam=seam, path=path)
     except (OSError, ValueError, KeyError, json.JSONDecodeError, zipfile.BadZipFile) as err:
         raise SnapshotIntegrityError(f"{path}: unreadable snapshot ({err})") from err
     if _crc(leaves) != header["crc32"]:
@@ -304,13 +309,19 @@ def state_annotations(metric: Any) -> Dict[str, str]:
 
 
 def restore_latest(
-    directory: str, template: Any, annotations: Optional[Dict[str, str]] = None
+    directory: str,
+    template: Any,
+    annotations: Optional[Dict[str, str]] = None,
+    *,
+    quarantine_corrupt: bool = True,
 ) -> Optional[Tuple[Any, Dict[str, Any]]]:
     """Restore the highest-step valid snapshot in ``directory``.
 
     Corrupt/torn files (e.g. a crash mid-write that still left a temp file,
     or disk-level damage) are skipped with the next-newest tried, so a bad
     latest snapshot degrades to the previous one instead of failing the
+    restore — and (by default) moved into the directory's bounded
+    ``.quarantine/`` so the fallback walk is paid once, not on every later
     restore.  Spec mismatches are NOT skipped — they mean the caller's
     configuration changed, which must surface.  Returns ``None`` when the
     directory holds no snapshot.
@@ -318,7 +329,9 @@ def restore_latest(
     for _step, path in reversed(list_snapshots(directory)):
         try:
             return restore(path, template, annotations=annotations)
-        except SnapshotIntegrityError:
+        except SnapshotIntegrityError as err:
+            if quarantine_corrupt:
+                _storage.quarantine(path, reason=str(err))
             continue
     return None
 
@@ -348,13 +361,22 @@ def reconstruct(header: Dict[str, Any], leaves: List[np.ndarray]) -> Any:
     return build(skeleton)
 
 
-def restore_latest_reconstruct(directory: str) -> Optional[Tuple[Any, Dict[str, Any]]]:
+def restore_latest_reconstruct(
+    directory: str, *, quarantine_corrupt: bool = True
+) -> Optional[Tuple[Any, Dict[str, Any]]]:
     """Template-free :func:`restore_latest` for skeleton-bearing snapshots."""
     for _step, path in reversed(list_snapshots(directory)):
         try:
             header, leaves = load_snapshot(path)
+        except SnapshotIntegrityError as err:
+            if quarantine_corrupt:
+                _storage.quarantine(path, reason=str(err))
+            continue
+        try:
             return reconstruct(header, leaves), header
         except SnapshotIntegrityError:
+            # a skeleton-less snapshot is HEALTHY (it just needs a template
+            # restore) — skip it, but never quarantine it
             continue
     return None
 
@@ -366,13 +388,18 @@ class SnapshotManager:
         directory: snapshot directory (created on first save).
         keep: how many most-recent snapshots to retain (older ones are
             pruned after a successful save); ``None`` keeps everything.
+        seam: the durability-seam label saves carry through the storage
+            shim (``io_retry`` events, ``tpumetrics_io_retries_total``).
     """
 
-    def __init__(self, directory: str, keep: Optional[int] = 3) -> None:
+    def __init__(
+        self, directory: str, keep: Optional[int] = 3, *, seam: str = "snapshot"
+    ) -> None:
         if keep is not None and keep < 1:
             raise ValueError(f"keep must be >= 1 or None, got {keep}")
         self.directory = directory
         self.keep = keep
+        self.seam = seam
         existing = list_snapshots(directory)
         self._last_step: Optional[int] = existing[-1][0] if existing else None
 
@@ -394,7 +421,8 @@ class SnapshotManager:
                 "HINT: restore_latest() first, or point the manager at a fresh directory."
             )
         path = save_snapshot(
-            self.directory, step, state, meta=meta, guard_non_finite=guard_non_finite
+            self.directory, step, state, meta=meta, guard_non_finite=guard_non_finite,
+            seam=self.seam,
         )
         self._last_step = step
         if self.keep is not None:

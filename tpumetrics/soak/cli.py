@@ -59,6 +59,12 @@ def _build_parser() -> argparse.ArgumentParser:
     gen.add_argument("--feed-low", type=int, default=6)
     gen.add_argument("--feed-high", type=int, default=16)
     gen.add_argument("--cut-every", type=int, default=4)
+    gen.add_argument(
+        "--storage", action="store_true",
+        help="lead the incident mix with the storage-fault kinds "
+        "(corrupt_cut/disk_full/io_flaky); --incidents 3 is exactly the "
+        "standing storage-fault gate",
+    )
     gen.add_argument("-o", "--out", default="-", help="schedule JSON path ('-' = stdout)")
 
     run = sub.add_parser("run", help="execute a schedule over a real pool")
@@ -67,6 +73,10 @@ def _build_parser() -> argparse.ArgumentParser:
     src.add_argument("--seed", type=int, help="generate the schedule inline from this seed")
     run.add_argument("--world", type=int, default=3, help="initial world for --seed")
     run.add_argument("--incidents", type=int, default=6, help="incident count for --seed")
+    run.add_argument(
+        "--storage", action="store_true",
+        help="with --seed: include the storage-fault incident kinds",
+    )
     run.add_argument("--root", default=None, help="soak root dir (default: a fresh tempdir)")
     run.add_argument("--out", default=None, help="JSONL incident report path")
     run.add_argument("--verbose", action="store_true")
@@ -134,7 +144,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 args.seed, world=args.world, n_incidents=args.incidents,
                 min_world=args.min_world, max_world=args.max_world,
                 feed_low=args.feed_low, feed_high=args.feed_high,
-                cut_every=args.cut_every,
+                cut_every=args.cut_every, storage=args.storage,
             )
             text = schedule.to_json()
             if args.out == "-":
@@ -149,7 +159,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 schedule = ChaosSchedule.from_json(fh.read())
         else:
             schedule = generate_schedule(
-                args.seed, world=args.world, n_incidents=args.incidents
+                args.seed, world=args.world, n_incidents=args.incidents,
+                storage=args.storage,
             )
     except (ScheduleError, OSError) as err:
         print(f"error: {err}", file=sys.stderr)

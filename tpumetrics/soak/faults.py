@@ -1,0 +1,242 @@
+"""Seeded I/O fault plans — the storage half of the chaos soak.
+
+The resilience claims of the storage shim (:mod:`tpumetrics.resilience.
+storage`) are only worth what exercises them: retry/backoff needs flaky
+writes, the quarantine path needs corrupt bytes, the durability-degradation
+latch needs a disk that is actually full for a while.  This module builds
+**deterministic, seeded** fault schedules that install as the shim's
+process-global fault injector — the same fault plan (seed) always fires the
+same faults at the same shim call indices, so a red soak epoch replays
+exactly and the pinned schedules in ``tests/test_soak.py`` stay stable.
+
+A :class:`FaultPlan` is JSON-round-trippable so the soak supervisor can
+ship it to worker subprocesses over ``--fault-plan`` (the workers own the
+evaluator whose cut writes the faults must hit; injecting in the
+supervisor process would miss every seam that matters).
+
+Fault kinds (``IOFault.kind``):
+
+``eio``
+    Raise transient ``EIO`` on matching calls — the shim must absorb these
+    via retry/backoff (``io_retry`` ledger events, zero data loss).
+``enospc``
+    Raise permanent ``ENOSPC`` for a bounded window — the evaluator must
+    latch durability degradation, keep serving from HBM, and resume (with
+    an immediate cut) once the window passes.
+``slow_io``
+    Sleep ``delay_s`` on matching calls — exercises retry deadlines and
+    the heal probe's backoff without failing anything.
+``torn_write``
+    Truncate the temp file to half its bytes just before the atomic
+    rename — the classic torn write.  CRC verification must catch it and
+    the reader must fall back + quarantine.
+``bit_flip``
+    Flip one byte of the FINAL file right after the rename — silent media
+    corruption.  Same detection contract as ``torn_write``.
+``vanish``
+    Unlink the final file right after the rename — a lying close/rename
+    (the metadata landed, the data did not).  Readers must treat the
+    missing member like any other incomplete cut.
+
+Injection points are the shim's documented ops: ``open``/``write``/
+``fsync``/``replace``/``post_replace`` (tmp-file path for the first
+three, final path for the last two) and ``read``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno as _errno
+import json
+import os
+import random
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from tpumetrics.resilience import storage as _storage
+
+__all__ = [
+    "FAULT_KINDS",
+    "FaultPlan",
+    "IOFault",
+    "plan_for_incident",
+    "torn_truncate",
+]
+
+FAULT_KINDS = ("eio", "enospc", "slow_io", "torn_write", "bit_flip", "vanish")
+
+#: kinds that RAISE into the shim (the others corrupt/delay out-of-band)
+_RAISING = {"eio": _errno.EIO, "enospc": _errno.ENOSPC}
+
+
+@dataclasses.dataclass(frozen=True)
+class IOFault:
+    """One scheduled fault: fire ``count`` times on shim op ``op`` starting
+    at that op's ``after``-th call (per-op call indices are 0-based and
+    counted by the plan — deterministic given a deterministic workload).
+    ``path_contains`` narrows matching to paths carrying the substring
+    (e.g. a rank directory); ``delay_s`` only applies to ``slow_io``."""
+
+    kind: str
+    op: str
+    after: int = 0
+    count: int = 1
+    path_contains: str = ""
+    delay_s: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} (one of {FAULT_KINDS})")
+        if self.count < 1:
+            raise ValueError(f"count must be >= 1, got {self.count}")
+        if self.after < 0:
+            raise ValueError(f"after must be >= 0, got {self.after}")
+
+    def matches(self, op: str, path: str, index: int) -> bool:
+        return (
+            op == self.op
+            and self.after <= index < self.after + self.count
+            and (not self.path_contains or self.path_contains in path)
+        )
+
+
+def torn_truncate(path: str) -> None:
+    """Truncate ``path`` to half its size — the canonical torn write (never
+    raises: a fault that cannot land must not break the write it was meant
+    to tear).  Public because the soak supervisor also tears cut members
+    directly on disk for ``corrupt_cut`` incidents."""
+    try:
+        size = os.path.getsize(path)
+        with open(path, "r+b") as fh:
+            fh.truncate(max(size // 2, 1))
+    except OSError:
+        pass
+
+
+def _corrupt_flip(path: str) -> None:
+    """Flip one byte in the middle of ``path`` (deterministic offset)."""
+    try:
+        size = os.path.getsize(path)
+        if size == 0:
+            return
+        with open(path, "r+b") as fh:
+            fh.seek(size // 2)
+            byte = fh.read(1)
+            fh.seek(size // 2)
+            fh.write(bytes([byte[0] ^ 0xFF]) if byte else b"\xff")
+    except OSError:
+        pass
+
+
+def _vanish(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+class FaultPlan:
+    """A deterministic schedule of :class:`IOFault`\\ s, installable as the
+    storage shim's fault injector (callable with the ``(op, path)``
+    protocol).  Per-op call counting makes firing a pure function of the
+    shim call sequence; ``fired`` records every hit for assertions."""
+
+    def __init__(self, faults: List[IOFault]) -> None:
+        self.faults = list(faults)
+        self._calls: Dict[str, int] = {}
+        self.fired: List[Tuple[str, str, int]] = []  # (kind, op, index)
+
+    # ------------------------------------------------------------- injector
+
+    def __call__(self, op: str, path: str) -> None:
+        index = self._calls.get(op, 0)
+        self._calls[op] = index + 1
+        for fault in self.faults:
+            if not fault.matches(op, path, index):
+                continue
+            self.fired.append((fault.kind, op, index))
+            if fault.kind in _RAISING:
+                num = _RAISING[fault.kind]
+                raise OSError(num, os.strerror(num))
+            if fault.kind == "slow_io":
+                time.sleep(fault.delay_s)
+            elif fault.kind == "torn_write":
+                torn_truncate(path)
+            elif fault.kind == "bit_flip":
+                _corrupt_flip(path)
+            elif fault.kind == "vanish":
+                _vanish(path)
+
+    def install(self) -> None:
+        _storage.set_fault_injector(self)
+
+    @staticmethod
+    def uninstall() -> None:
+        _storage.clear_fault_injector()
+
+    # ----------------------------------------------------------- round-trip
+
+    def to_json(self) -> str:
+        return json.dumps(
+            [dataclasses.asdict(f) for f in self.faults], sort_keys=True
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        return cls([IOFault(**spec) for spec in json.loads(text)])
+
+    # ------------------------------------------------------------- seeding
+
+    @classmethod
+    def from_seed(
+        cls, seed: int, profile: str, *, path_contains: str = ""
+    ) -> "FaultPlan":
+        """Compile a seeded plan for one storage-incident profile.
+
+        ``io_flaky``   — a burst of transient ``eio`` across write/fsync
+        plus one ``slow_io`` stall: everything must succeed via retries.
+        ``disk_full``  — a bounded ``enospc`` window on the write path:
+        durability degrades, serving continues, the window heals.
+        ``corrupt_cut`` — one seeded corruption (``torn_write`` /
+        ``bit_flip`` / ``vanish``) of a written file: CRC fallback +
+        quarantine.
+
+        Deterministic: the same ``(seed, profile)`` always compiles the
+        same plan (``random.Random(seed)``, no ambient entropy).
+        """
+        rng = random.Random(f"{int(seed)}:{profile}")  # str-seeded: stable across runs
+        kw = {"path_contains": path_contains}
+        if profile == "io_flaky":
+            faults = [
+                IOFault("eio", "write", after=rng.randrange(0, 3),
+                        count=rng.randrange(1, 3), **kw),
+                IOFault("eio", "fsync", after=rng.randrange(0, 3),
+                        count=rng.randrange(1, 3), **kw),
+                IOFault("slow_io", "replace", after=rng.randrange(0, 4),
+                        delay_s=0.02, **kw),
+            ]
+        elif profile == "disk_full":
+            faults = [
+                IOFault("enospc", "write", after=rng.randrange(0, 2),
+                        count=rng.randrange(2, 5), **kw),
+            ]
+        elif profile == "corrupt_cut":
+            kind = rng.choice(("torn_write", "bit_flip", "vanish"))
+            op = "replace" if kind == "torn_write" else "post_replace"
+            faults = [IOFault(kind, op, after=rng.randrange(0, 2), **kw)]
+        else:
+            raise ValueError(
+                f"unknown fault profile {profile!r} "
+                "(one of io_flaky/disk_full/corrupt_cut)"
+            )
+        return cls(faults)
+
+
+def plan_for_incident(
+    kind: str, seed: int, *, path_contains: str = ""
+) -> Optional[FaultPlan]:
+    """The storage-incident-kind → fault-plan mapping the soak supervisor
+    ships to workers (``None`` for non-storage incident kinds)."""
+    if kind in ("io_flaky", "disk_full", "corrupt_cut"):
+        return FaultPlan.from_seed(seed, kind, path_contains=path_contains)
+    return None

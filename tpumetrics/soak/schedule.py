@@ -45,6 +45,19 @@ KINDS = ("sigkill", "sigterm", "shrink", "grow")
 # ("resize", world_after != world).  Kept out of KINDS so pinned legacy
 # seeds stay byte-identical; generate_schedule(fleet=True) opts in.
 FLEET_KINDS = ("migrate", "resize")
+# storage-fault incidents (the resilience/storage.py shim's standing gate),
+# opted in via generate_schedule(storage=True) for the same pinned-seed
+# reason.  All keep the world size: the failure is the DISK, not the fleet.
+# - "io_flaky":    seeded transient EIO/stalls armed in every worker for the
+#   leg — the shim's retries must absorb all of it (io_retry events, zero
+#   loss, every cut complete).
+# - "disk_full":   a bounded ENOSPC window — cut saves fail permanently,
+#   the evaluator latches durability degradation and KEEPS SERVING; after
+#   the window a heal cut must succeed and durability must resume.
+# - "corrupt_cut": the newest cut member of a seeded rank (``target_rank``)
+#   is corrupted on disk after an abrupt teardown — restore must fall back
+#   (depth <= keep_cuts), quarantine the member, and re-feed exactly-once.
+STORAGE_KINDS = ("corrupt_cut", "disk_full", "io_flaky")
 
 
 class ScheduleError(TPUMetricsUserError):
@@ -66,15 +79,18 @@ class Incident:
     tenant: Optional[str] = None  # migration subject (fleet kinds; None = seeded)
 
     def validate(self, world_before: int, min_world: int = 1) -> None:
-        if self.kind not in KINDS + FLEET_KINDS:
+        if self.kind not in KINDS + FLEET_KINDS + STORAGE_KINDS:
             raise ScheduleError(
                 f"Unknown incident kind {self.kind!r}; expected one of "
-                f"{KINDS + FLEET_KINDS}"
+                f"{KINDS + FLEET_KINDS + STORAGE_KINDS}"
             )
         if self.feed < 1:
             raise ScheduleError(f"{self.kind}: feed must be >= 1, got {self.feed}")
         if self.kind in FLEET_KINDS:
             self._validate_fleet(world_before, min_world)
+            return
+        if self.kind in STORAGE_KINDS:
+            self._validate_storage(world_before)
             return
         if self.world_after < max(1, min_world):
             raise ScheduleError(
@@ -113,6 +129,38 @@ class Incident:
                 raise ScheduleError(f"{self.kind}: graceful incidents drain everything (tail=0)")
             if self.lose_member:
                 raise ScheduleError("lose_member needs an abrupt incident")
+
+    def _validate_storage(self, world_before: int) -> None:
+        # the disk fails, not the fleet: the world never resizes, nothing is
+        # permanently lost (tail/lose_member are the abrupt-kill knobs), and
+        # only corrupt_cut needs a victim (whose cut MEMBER is corrupted —
+        # the process itself is torn down with the rest of the slice)
+        if self.world_after != world_before:
+            raise ScheduleError(
+                f"{self.kind} must keep the world "
+                f"({world_before} -> {self.world_after})"
+            )
+        if self.tail or self.lose_member:
+            raise ScheduleError(f"{self.kind}: storage incidents take no tail/lose_member")
+        if self.kind == "corrupt_cut":
+            if not self.abrupt:
+                raise ScheduleError(
+                    "corrupt_cut must be abrupt: corruption is only observable "
+                    "by a world that restores, not one that keeps its HBM state"
+                )
+            if self.target_rank is None or not (0 <= self.target_rank < world_before):
+                raise ScheduleError(
+                    f"corrupt_cut: target_rank (the rank whose cut member is "
+                    f"corrupted) must be in [0, {world_before}), got {self.target_rank}"
+                )
+        else:
+            if self.abrupt:
+                raise ScheduleError(
+                    f"{self.kind} recovers gracefully (the shim/degradation "
+                    "latch is the mechanism under test, not an abrupt kill)"
+                )
+            if self.target_rank is not None:
+                raise ScheduleError(f"{self.kind} takes no target_rank")
 
     def _validate_fleet(self, world_before: int, min_world: int) -> None:
         # the fleet runner's kill point is mid-MIGRATION (between cut and
@@ -224,6 +272,7 @@ def generate_schedule(
     feed_high: int = 16,
     cut_every: int = 4,
     fleet: bool = False,
+    storage: bool = False,
     **schedule_kwargs: Any,
 ) -> ChaosSchedule:
     """Derive a legal chaos schedule from one seed.
@@ -241,6 +290,14 @@ def generate_schedule(
     migrate (SIGKILL mid-migration), one grow and one shrink.  The flag is
     an explicit opt-in precisely so ``fleet=False`` schedules stay
     byte-identical to every pinned pre-fleet seed.
+
+    ``storage=True`` (same opt-in contract) ADDS the ``STORAGE_KINDS`` to
+    the mix AND puts them first in the required set: all three storage
+    incidents are guaranteed once ``n_incidents >= 3``, and storage legs
+    are stretched to at least
+    ``3 * cut_every`` batches so every seeded fault window provably
+    overlaps real cut writes and a corrupt-cut restore always has an older
+    complete cut to fall back to.
     """
     if n_incidents < 1:
         raise ScheduleError(f"n_incidents must be >= 1, got {n_incidents}")
@@ -255,13 +312,36 @@ def generate_schedule(
             cut_every=cut_every, **schedule_kwargs,
         )
     rng = random.Random(seed)
-    required = list(KINDS) if n_incidents >= len(KINDS) else list(KINDS[:n_incidents])
+    pool = KINDS + STORAGE_KINDS if storage else KINDS
+    # the required mix leads with the storage kinds when they are opted in:
+    # a short storage soak (n_incidents == 3) is exactly the standing
+    # storage-fault gate, not a lottery ticket
+    required = (
+        list(STORAGE_KINDS + KINDS)[:n_incidents] if storage
+        else list(KINDS)[:n_incidents]
+    )
     rng.shuffle(required)
-    kinds = required + [rng.choice(KINDS) for _ in range(n_incidents - len(required))]
+    kinds = required + [rng.choice(pool) for _ in range(n_incidents - len(required))]
 
     incidents = []
     cur = world
     for kind in kinds:
+        if kind in STORAGE_KINDS:
+            # long enough for >= 3 cuts: every seeded fault window (after
+            # <= 2) lands on a real cut write, and corrupt_cut always has
+            # an in-leg predecessor cut to fall back to
+            feed = rng.randint(
+                max(feed_low, 3 * cut_every), max(feed_high, 3 * cut_every + 1)
+            )
+            if kind == "corrupt_cut":
+                inc = Incident(
+                    kind=kind, feed=feed, world_after=cur, abrupt=True,
+                    target_rank=rng.randrange(cur),
+                )
+            else:
+                inc = Incident(kind=kind, feed=feed, world_after=cur)
+            incidents.append(inc)
+            continue
         # keep every slot legal for the CURRENT world (random extras may
         # land on a world already at a bound; required kinds are placed
         # first, while both directions are still reachable)

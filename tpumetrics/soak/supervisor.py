@@ -40,7 +40,11 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from tpumetrics.soak.schedule import ChaosSchedule, Incident
+from tpumetrics.soak.schedule import (
+    STORAGE_KINDS as _STORAGE_KINDS,
+    ChaosSchedule,
+    Incident,
+)
 from tpumetrics.soak.traffic import make_metric, oracle_value, values_equal
 from tpumetrics.utils.exceptions import TPUMetricsUserError
 
@@ -152,8 +156,12 @@ class SoakSupervisor:
         self._epoch_state_base = 0  # state position adopted at epoch start
         self._lost: set = set()  # stream indices permanently lost (degraded)
         self._degraded_sticky = False  # degraded round-trips via snapshot meta
-        self._cut_stream_pos = 0  # stream position of the newest cut
-        self._cut_state_pos = 0  # state position of the newest cut
+        self._cut_stream_pos = 0  # stream position of the newest COMPLETE cut
+        self._cut_state_pos = 0  # state position of the newest COMPLETE cut
+        # (stream, state) positions of every complete cut, oldest first —
+        # the corrupt_cut incident rolls back to the SECOND-newest entry
+        # (the newest one just lost a member to corruption)
+        self._cut_history: List[tuple] = []
         self._restore_walls: List[float] = []
         self._throughputs: List[float] = []
         # straggler analysis: per-file (size, parsed records) cache so each
@@ -367,11 +375,22 @@ class SoakSupervisor:
         self._state_pos += stop - start
         return sum(a["rows"] for a in acks)
 
-    def _cut(self) -> None:
-        """One coordinated cut across the pool; verifies the position."""
-        self._cmd_all({"cmd": "cut"})
+    def _cut(self) -> bool:
+        """One coordinated cut across the pool.  Advances the committed
+        positions only when EVERY rank durably wrote its member — under a
+        disk_full fault window ranks ack the attempt with ``path: None``
+        (durability degraded, still serving), and an incomplete cut must
+        not move the exactly-once anchor the next restore is gated on."""
+        acks = self._cmd_all({"cmd": "cut"})
+        if not all(a.get("path") for a in acks):
+            return False
         self._cut_stream_pos = self._stream_pos
         self._cut_state_pos = self._state_pos
+        if not self._cut_history or self._cut_history[-1] != (
+            self._cut_stream_pos, self._cut_state_pos
+        ):
+            self._cut_history.append((self._cut_stream_pos, self._cut_state_pos))
+        return True
 
     def _run_leg(self, inc: Incident) -> float:
         """Feed the incident's leg (cuts every ``cut_every``; an abrupt
@@ -406,6 +425,8 @@ class SoakSupervisor:
             "chaos_incident", incident=inc.kind, epoch=self._epoch,
             stream_pos=self._stream_pos,
         )
+        if inc.kind in _STORAGE_KINDS:
+            return self._induce_storage(inc)
         if inc.abrupt:
             victim = self._workers[inc.target_rank]
             victim_pid = victim.proc.pid
@@ -443,7 +464,11 @@ class SoakSupervisor:
                 self._cut_state_pos -= len(victim_leg)
                 details["lost_batches"] = len(victim_leg)
             return details
-        # graceful: SIGTERM the whole pool, collect typed drained statuses
+        return self._induce_graceful()
+
+    def _induce_graceful(self) -> Dict[str, Any]:
+        """SIGTERM the whole pool, collect typed drained statuses; the final
+        coordinated cut covers every batch fed so far (zero loss)."""
         for w in self._workers:
             try:
                 os.kill(w.proc.pid, signal.SIGTERM)
@@ -460,15 +485,142 @@ class SoakSupervisor:
                 raise ChaosSoakError(
                     f"rank {msg.get('rank')}: graceful drain left no flight dump."
                 )
+            report = msg.get("report") or {}
+            if report.get("partial"):
+                raise ChaosSoakError(
+                    f"rank {msg.get('rank')}: graceful drain returned a PARTIAL "
+                    f"report ({report.get('reason')}) — the final cut did not "
+                    f"cover {report.get('uncovered_batches')} batch(es)."
+                )
         # a polite preemption loses nothing: the final coordinated cut
         # covers every batch fed so far
         self._cut_stream_pos = self._stream_pos
         self._cut_state_pos = self._state_pos
+        if not self._cut_history or self._cut_history[-1] != (
+            self._cut_stream_pos, self._cut_state_pos
+        ):
+            self._cut_history.append((self._cut_stream_pos, self._cut_state_pos))
         return {
             "mechanism": "sigterm",
             "drain_s_max": max(d.get("drain_s", 0.0) for d in drained),
             "drain_flights": [d.get("flight") for d in drained],
         }
+
+    # ---------------------------------------------------- storage incidents
+
+    def _arm_storage_faults(self, inc: Incident) -> Optional[Dict[str, Any]]:
+        """Arm a seeded per-rank fault plan in every worker for this leg
+        (``io_flaky``/``disk_full`` only); deterministic in (schedule seed,
+        epoch, rank), so a red soak replays its exact fault sequence."""
+        if inc.kind not in ("io_flaky", "disk_full"):
+            return None
+        from tpumetrics.soak.faults import FaultPlan
+
+        plans: Dict[int, str] = {}
+        for w in self._workers:
+            seed = self.schedule.seed * 10007 + self._epoch * 101 + w.rank
+            plans[w.rank] = FaultPlan.from_seed(seed, inc.kind).to_json()
+            w.send({"cmd": "faults", "plan": plans[w.rank]})
+        for w in self._workers:
+            resp = w.recv_until("cmd", "faults")
+            if not resp.get("ok") or not resp.get("armed"):
+                raise ChaosSoakError(
+                    f"rank {w.rank}: fault plan failed to arm: {resp.get('error')}"
+                )
+        return {"profile": inc.kind, "plans": plans}
+
+    def _induce_storage(self, inc: Incident) -> Dict[str, Any]:
+        """The storage-incident mechanisms + their shim-specific gates (the
+        generic exactly-once/latency/ledger gates still run in
+        :meth:`_recover` afterwards)."""
+        details: Dict[str, Any] = {"mechanism": inc.kind}
+        if inc.kind in ("io_flaky", "disk_full"):
+            # close the fault window BEFORE judging: the gates below reason
+            # about what the shim absorbed while the window was open
+            self._cmd_all({"cmd": "faults", "plan": None})
+            if inc.kind == "io_flaky":
+                n_retry = self._ledger_events(self._epoch, "io_retry")
+                if n_retry < 1:
+                    raise ChaosSoakError(
+                        "io_flaky leg recorded no io_retry events: the fault "
+                        "window missed every durability write (schedule bug) "
+                        "or retries are not instrumented."
+                    )
+                if self._cut_stream_pos != self._stream_pos:
+                    raise ChaosSoakError(
+                        f"io_flaky leg left the newest complete cut at "
+                        f"{self._cut_stream_pos} < stream {self._stream_pos}: "
+                        "transient faults must be fully absorbed by retries."
+                    )
+                details["io_retry_events"] = n_retry
+            else:  # disk_full
+                n_deg = self._ledger_events(self._epoch, "durability_degraded")
+                if n_deg < 1:
+                    raise ChaosSoakError(
+                        "disk_full leg latched no durability_degraded window: "
+                        "the ENOSPC burst missed every cut write."
+                    )
+                # the window is closed: one explicit heal cut must succeed
+                # and resume durability
+                t_heal = time.monotonic()
+                if not self._cut():
+                    raise ChaosSoakError(
+                        "heal cut still failed after the ENOSPC window closed."
+                    )
+                details["heal_cut_s"] = time.monotonic() - t_heal
+                n_res = self._ledger_events(self._epoch, "durability_resumed")
+                if n_res < 1:
+                    raise ChaosSoakError(
+                        "durability did not resume after the heal cut "
+                        "(no durability_resumed event)."
+                    )
+                details["degraded_events"] = n_deg
+                details["resumed_events"] = n_res
+            details.update(self._induce_graceful())
+            details["mechanism"] = inc.kind
+            return details
+        # corrupt_cut: tear the slice down abruptly, then corrupt the
+        # victim's member of the newest cut on disk — the next world must
+        # fall back, quarantine, and re-feed exactly-once
+        for w in self._workers:
+            try:
+                w.send({"cmd": "abort"})
+            except ChaosSoakError:
+                pass
+        self._teardown()
+        corrupted = self._corrupt_newest_member(inc.target_rank)
+        if corrupted is None:
+            raise ChaosSoakError(
+                f"corrupt_cut: rank {inc.target_rank} has no cut member to corrupt."
+            )
+        if len(self._cut_history) < 2:
+            raise ChaosSoakError(
+                "corrupt_cut needs at least two complete cuts on disk "
+                "(schedule guarantees >= 3 in-leg cuts — bookkeeping bug?)."
+            )
+        # roll back to the newest SURVIVING complete cut; the corrupted
+        # one can never restore complete again
+        self._cut_history.pop()
+        prev_stream, prev_state = self._cut_history[-1]
+        self._stream_pos = self._cut_stream_pos = prev_stream
+        self._state_pos = self._cut_state_pos = prev_state
+        details.update({"victim": inc.target_rank, "corrupted_member": corrupted})
+        return details
+
+    def _corrupt_newest_member(self, rank: int) -> Optional[str]:
+        """Corrupt (torn-truncate) the victim rank's newest cut member in
+        place — the media-corruption sibling of
+        :meth:`_destroy_newest_member`, which models total loss."""
+        from tpumetrics.runtime.snapshot import list_snapshots
+        from tpumetrics.soak.faults import torn_truncate
+
+        directory = os.path.join(self.root, "snapshots", f"rank-{rank:05d}")
+        snaps = list_snapshots(directory)
+        if not snaps:
+            return None
+        _, path = snaps[-1]
+        torn_truncate(path)
+        return path
 
     @property
     def _world_now(self) -> int:
@@ -650,6 +802,42 @@ class SoakSupervisor:
                 f"ledger continuity: {n_degraded} elastic_degraded event(s) for epoch "
                 f"{self._epoch}, schedule expected degraded={inc.lose_member}."
             )
+        storage_gates: Dict[str, Any] = {}
+        if inc.kind == "corrupt_cut":
+            # the storage-specific continuity gates: the corrupted member
+            # must have been QUARANTINED (not silently skipped) and every
+            # rank's fallback walk must stay inside the retention window.
+            # fallback_depth legitimately differs across concurrently
+            # restoring ranks (the first to scan quarantines the member;
+            # later ranks never see the incomplete group), so only the max
+            # is gated.
+            from tpumetrics.resilience.storage import quarantine_census
+
+            depths = [int(info.get("fallback_depth") or 0) for info in infos]
+            if max(depths) > sched.keep_cuts:
+                raise ChaosSoakError(
+                    f"fallback depths {sorted(depths)} exceed the retention "
+                    f"window keep_cuts={sched.keep_cuts}: the walk left the "
+                    "set of cuts the evaluator promises to keep."
+                )
+            n_quar = self._ledger_events(self._epoch, "snapshot_quarantined")
+            if n_quar < 1:
+                raise ChaosSoakError(
+                    "no snapshot_quarantined event for the corrupted member: "
+                    "the fallback silently skipped corrupt bytes instead of "
+                    "quarantining them."
+                )
+            census = quarantine_census(os.path.join(self.root, "snapshots"))
+            if census["files"] < 1:
+                raise ChaosSoakError(
+                    "quarantine census is empty after a corrupt_cut recovery "
+                    "(the ledger said quarantined, the disk disagrees)."
+                )
+            storage_gates = {
+                "fallback_depth_max": max(depths),
+                "quarantined_events": n_quar,
+                "quarantine_census": census,
+            }
         self._restore_walls.append(max_restore_call_s)
         # the new epoch's bases: feed resumes at the cut's stream position
         self._state_pos = self._cut_state_pos
@@ -667,6 +855,7 @@ class SoakSupervisor:
             ),
             "ledger_restore_events": n_restore,
             "ledger_degraded_events": n_degraded,
+            **storage_gates,
         }
 
     # ------------------------------------------------------------------ run
@@ -702,6 +891,9 @@ class SoakSupervisor:
                     "tail": inc.tail,
                 }
                 try:
+                    armed = self._arm_storage_faults(inc)
+                    if armed is not None:
+                        record["faults"] = armed
                     throughput = self._run_leg(inc)
                     record["throughput_rows_per_s"] = round(throughput, 1)
                     self._throughputs.append(throughput)

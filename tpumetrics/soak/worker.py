@@ -58,15 +58,23 @@ def main(argv=None) -> int:
     ap.add_argument("--max-rows", type=int, default=8)
     ap.add_argument("--keep-cuts", type=int, default=3)
     ap.add_argument("--barrier-timeout", type=float, default=90.0)
+    ap.add_argument(
+        "--fault-plan", default=None,
+        help="JSON IOFault list (tpumetrics.soak.faults.FaultPlan.to_json) "
+        "installed as the storage shim's fault injector at startup; the "
+        "supervisor normally arms plans over the {'cmd': 'faults'} wire "
+        "instead, so windows open and close at leg boundaries",
+    )
     args = ap.parse_args(argv)
 
     # heavy imports AFTER arg parsing (a bad invocation fails fast)
     import jax.numpy as jnp  # noqa: F401  (forces backend init before traffic)
 
     from tpumetrics import telemetry
-    from tpumetrics.resilience import QuorumPolicy, SyncPolicy, set_sync_policy
+    from tpumetrics.resilience import QuorumPolicy, StorageError, SyncPolicy, set_sync_policy
     from tpumetrics.runtime import StreamingEvaluator, install_preemption_handler
     from tpumetrics.runtime.drain import PreemptionInterrupt
+    from tpumetrics.soak.faults import FaultPlan
     from tpumetrics.soak.traffic import make_batch, make_metric
     from tpumetrics.soak.wire import FileBarrierBackend
     from tpumetrics.telemetry.export import enable_flight_recorder, flight_dump
@@ -99,6 +107,8 @@ def main(argv=None) -> int:
         keep_cuts=args.keep_cuts,
     )
     guard = install_preemption_handler(ev, mode="raise", final_cut=True)
+    if args.fault_plan:
+        FaultPlan.from_json(args.fault_plan).install()
 
     def _drain_and_exit(signum) -> int:
         t0 = time.perf_counter()
@@ -145,11 +155,33 @@ def main(argv=None) -> int:
             ev.flush()
             return {"ok": True, "cmd": "feed", "batches": batches, "rows": rows}
         if name == "cut":
-            path = ev.snapshot()
+            # a StorageError here is the degradation contract, not a wedge:
+            # the shim's retry budget is spent, the evaluator latched the
+            # durability_degraded window and keeps serving from HBM — ack
+            # the cut as ATTEMPTED (path None) so the supervisor tracks the
+            # newest COMPLETE cut instead of aborting the leg
+            try:
+                path = ev.snapshot()
+            except StorageError as err:
+                return {
+                    "ok": True, "cmd": "cut", "path": None,
+                    "storage_error": f"{type(err).__name__}: {err}",
+                    "batches": ev.stats()["batches"],
+                }
             return {
                 "ok": True, "cmd": "cut", "path": path,
                 "batches": ev.stats()["batches"],
             }
+        if name == "faults":
+            # arm/disarm a seeded storage fault plan for the NEXT leg; the
+            # shim's injector is process-global, so this window scopes every
+            # durability write this worker performs
+            plan = cmd.get("plan")
+            if plan:
+                FaultPlan.from_json(plan).install()
+            else:
+                FaultPlan.uninstall()
+            return {"ok": True, "cmd": "faults", "armed": bool(plan)}
         if name == "stats":
             s = ev.stats()
             return {

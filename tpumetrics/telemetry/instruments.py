@@ -206,6 +206,10 @@ ROUTING_EPOCH = "tpumetrics_routing_epoch"
 MIGRATION_LATENCY_MS = "tpumetrics_migration_latency_ms"
 MIGRATIONS_TOTAL = "tpumetrics_migrations_total"
 AUTOSCALE_DECISIONS = "tpumetrics_autoscale_decisions_total"
+# storage fault tolerance (resilience/storage.py + the evaluator's
+# durability-degradation latch)
+IO_RETRIES_TOTAL = "tpumetrics_io_retries_total"
+DURABILITY_DEGRADED = "tpumetrics_durability_degraded"
 
 
 def enabled() -> bool:

@@ -307,6 +307,13 @@ class AdminServer:
             nonfinite = int(health.get("nonfinite_total", 0))
             if nonfinite:
                 reasons.append(f"state_health:{label}")
+        # durability degraded: cut saves suspended behind the heal probe —
+        # the stream still SERVES (no degraded: flag), but a preemption in
+        # this window loses the uncovered tail, so the probe must page
+        storage = stats.get("storage") or {}
+        durability_degraded = bool(storage.get("degraded", False))
+        if durability_degraded:
+            reasons.append(f"durability_degraded:{label}")
         # a service-wide stats dict counts quarantines across tenants
         q_tenants = int(stats.get("quarantined_tenants", 0) or 0)
         if q_tenants:
@@ -314,6 +321,7 @@ class AdminServer:
         return {
             "quarantined": quarantined,
             "degraded": degraded,
+            "durability_degraded": durability_degraded,
             "state_nonfinite": nonfinite,
             "reasons": reasons,
         }
