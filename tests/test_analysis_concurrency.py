@@ -1,0 +1,833 @@
+"""tpulint concurrency plane (TPL120–TPL123) — fixtures + the retro-corpus.
+
+Same contract as test_analysis.py: every rule gets TRUE POSITIVE,
+NEAR-MISS NEGATIVE, and EXEMPTION fixtures.  The retro-corpus at the
+bottom reconstructs the five concurrency bugs hand-found in review rounds
+of PRs 11/13/15/19 — each reconstruction must trip its rule (that is the
+value proposition: the gate now catches at lint time what previously cost
+a review round), and each ships with the shape of the fix as a negative.
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+import pytest
+
+from tpumetrics.analysis import analyze_source
+
+
+def _codes(findings, suppressed=False):
+    return sorted(f.code for f in findings if f.suppressed == suppressed)
+
+
+def _src(body: str) -> str:
+    return textwrap.dedent(body)
+
+
+# ------------------------------------------------------- TPL120: lock order
+LOCK_ORDER_TP = _src(
+    """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._placement = threading.Lock()
+            self._budget = threading.Lock()
+
+        def grow(self):
+            with self._placement:
+                with self._budget:
+                    return 1
+
+        def shrink(self):
+            with self._budget:
+                with self._placement:
+                    return 2
+    """
+)
+
+LOCK_ORDER_NEAR_MISS = _src(
+    """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._placement = threading.Lock()
+            self._budget = threading.Lock()
+
+        def grow(self):
+            with self._placement:
+                with self._budget:
+                    return 1
+
+        def shrink(self):
+            # same nesting order as grow(): a consistent hierarchy, no cycle
+            with self._placement:
+                with self._budget:
+                    return 2
+    """
+)
+
+SELF_DEADLOCK_TP = _src(
+    """
+    import threading
+
+    class Ledger:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def put(self, k, v):
+            with self._lock:
+                self.flush()
+
+        def flush(self):
+            self._lock.acquire()
+            self._lock.release()
+    """
+)
+
+RLOCK_REENTRY_EXEMPT = _src(
+    """
+    import threading
+
+    class Ledger:
+        def __init__(self):
+            self._lock = threading.RLock()
+
+        def put(self, k, v):
+            with self._lock:
+                with self._lock:
+                    return 1
+    """
+)
+
+
+def test_lock_order_inversion_true_positive():
+    codes = _codes(analyze_source(LOCK_ORDER_TP))
+    assert codes.count("TPL120") == 2  # both sides of the inversion
+
+
+def test_lock_order_consistent_nesting_near_miss():
+    assert "TPL120" not in _codes(analyze_source(LOCK_ORDER_NEAR_MISS))
+
+
+def test_lock_order_self_deadlock_true_positive():
+    # flush() re-acquires the non-reentrant lock put() already holds — the
+    # CROSS-function case: the transitive acquire-set of the callee is
+    # projected through the call site made under the held lock.
+    assert "TPL120" in _codes(analyze_source(SELF_DEADLOCK_TP))
+
+
+def test_lock_order_rlock_reentry_exempt():
+    assert "TPL120" not in _codes(analyze_source(RLOCK_REENTRY_EXEMPT))
+
+
+def test_lock_order_declared_hierarchy_allowlisted(tmp_path):
+    """service-lock -> ledger-lock nesting is the DECLARED order: even when a
+    reverse edge elsewhere closes a cycle, the declared edge stays quiet and
+    the violating edge is the one flagged."""
+    pkg = tmp_path / "tpumetrics"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    runtime = pkg / "runtime"
+    runtime.mkdir()
+    (runtime / "__init__.py").write_text("")
+    (runtime / "service.py").write_text(
+        _src(
+            """
+            import threading
+            from tpumetrics.telemetry import ledger
+
+            class EvaluationService:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def submit(self):
+                    with self._lock:
+                        ledger.record()
+            """
+        )
+    )
+    telemetry = pkg / "telemetry"
+    telemetry.mkdir()
+    (telemetry / "__init__.py").write_text("")
+    (telemetry / "ledger.py").write_text(
+        _src(
+            """
+            import threading
+
+            _LOCK = threading.Lock()
+
+            def record():
+                with _LOCK:
+                    return 1
+            """
+        )
+    )
+    from tpumetrics.analysis import analyze_paths
+
+    findings = analyze_paths([str(pkg)])
+    assert "TPL120" not in [f.code for f in findings]
+
+
+# ------------------------------------------ TPL121: unguarded guarded attr
+GUARDED_ATTR_TP = _src(
+    """
+    import threading
+
+    class Registry:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._series = {}
+            self._t = threading.Thread(target=self._loop, daemon=True)
+
+        def _loop(self):
+            self._series["beat"] = 1      # bare write on the sampler thread
+
+        def mint(self, name):
+            with self._lock:
+                self._series[name] = object()
+
+        def close(self, name):
+            with self._lock:
+                self._series.pop(name, None)
+    """
+)
+
+GUARDED_ATTR_LOCKED_NEAR_MISS = _src(
+    """
+    import threading
+
+    class Registry:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._series = {}
+            self._t = threading.Thread(target=self._loop, daemon=True)
+
+        def _loop(self):
+            with self._lock:              # disciplined: same guard as writers
+                self._series["beat"] = 1
+
+        def mint(self, name):
+            with self._lock:
+                self._series[name] = object()
+
+        def close(self, name):
+            with self._lock:
+                self._series.pop(name, None)
+    """
+)
+
+GUARDED_ATTR_NOT_THREADED_EXEMPT = _src(
+    """
+    import threading
+
+    class Registry:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._series = {}
+
+        def read_bare(self):
+            # bare access, but NO thread root reaches this class: the
+            # join-outside-the-lock close() pattern must stay quiet
+            return self._series.get("x")
+
+        def mint(self, name):
+            with self._lock:
+                self._series[name] = object()
+
+        def close(self, name):
+            with self._lock:
+                self._series.pop(name, None)
+    """
+)
+
+GUARDED_ATTR_BOUNDED_LOCK_NEAR_MISS = _src(
+    """
+    import threading
+
+    class _bounded_lock:
+        def __init__(self, lock):
+            self._lock = lock
+            self._got = lock.acquire(timeout=0.02)
+
+        def __enter__(self):
+            return self._got
+
+        def __exit__(self, *exc):
+            if self._got:
+                self._lock.release()
+
+    class Evaluator:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._latest = None
+            self._t = threading.Thread(target=self._loop, daemon=True)
+
+        def _loop(self):
+            with _bounded_lock(self._lock) as got:
+                if got:
+                    self._latest = {}     # under the bounded acquisition
+
+        def apply(self, snap):
+            with self._lock:
+                self._latest = snap
+    """
+)
+
+
+def test_guarded_attr_bare_write_true_positive():
+    assert "TPL121" in _codes(analyze_source(GUARDED_ATTR_TP))
+
+
+def test_guarded_attr_locked_access_near_miss():
+    assert "TPL121" not in _codes(analyze_source(GUARDED_ATTR_LOCKED_NEAR_MISS))
+
+
+def test_guarded_attr_unthreaded_class_exempt():
+    assert "TPL121" not in _codes(analyze_source(GUARDED_ATTR_NOT_THREADED_EXEMPT))
+
+
+def test_guarded_attr_bounded_lock_counts_as_held():
+    assert "TPL121" not in _codes(analyze_source(GUARDED_ATTR_BOUNDED_LOCK_NEAR_MISS))
+
+
+# --------------------------------------------- TPL122: signal-handler safety
+SIGNAL_LOCK_TP = _src(
+    """
+    import signal
+    import threading
+
+    class Guard:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def _on_signal(self, signum, frame):
+            with self._lock:              # the interrupted thread may hold it
+                self.note = signum
+
+        def install(self):
+            signal.signal(signal.SIGTERM, self._on_signal)
+    """
+)
+
+SIGNAL_EVENT_SET_NEAR_MISS = _src(
+    """
+    import signal
+    import threading
+
+    class Guard:
+        def __init__(self):
+            self._wake = threading.Event()
+            self._signum = None
+
+        def _on_signal(self, signum, frame):
+            # the sanctioned idiom: record + set + return, no locks taken
+            self._signum = signum
+            self._wake.set()
+
+        def install(self):
+            signal.signal(signal.SIGTERM, self._on_signal)
+    """
+)
+
+SIGNAL_NOT_INSTALLED_EXEMPT = _src(
+    """
+    import threading
+
+    class Guard:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def _on_signal(self, signum, frame):
+            # never registered with signal.signal: plain method, lock is fine
+            with self._lock:
+                self.note = signum
+    """
+)
+
+
+def test_signal_handler_lock_true_positive():
+    assert "TPL122" in _codes(analyze_source(SIGNAL_LOCK_TP))
+
+
+def test_signal_handler_event_set_near_miss():
+    assert "TPL122" not in _codes(analyze_source(SIGNAL_EVENT_SET_NEAR_MISS))
+
+
+def test_signal_handler_uninstalled_exempt():
+    assert "TPL122" not in _codes(analyze_source(SIGNAL_NOT_INSTALLED_EXEMPT))
+
+
+# ----------------------------------------------- TPL123: blocking under lock
+BLOCKING_UNDER_LOCK_TP = _src(
+    """
+    import threading
+    import jax
+
+    class Evaluator:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._latest = None
+
+        def stats(self):
+            with self._lock:
+                return jax.device_get(self._latest)
+    """
+)
+
+BLOCKING_OUTSIDE_LOCK_NEAR_MISS = _src(
+    """
+    import threading
+    import jax
+
+    class Evaluator:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._latest = None
+
+        def stats(self):
+            with self._lock:
+                snap = self._latest
+            return jax.device_get(snap)   # fetch AFTER the lock is released
+    """
+)
+
+CONDITION_WAIT_EXEMPT = _src(
+    """
+    import threading
+
+    class Queue:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._not_empty = threading.Condition(self._lock)
+            self._items = []
+
+        def pop(self):
+            with self._not_empty:
+                while not self._items:
+                    self._not_empty.wait()   # releases the lock while parked
+                return self._items.pop()
+    """
+)
+
+EVENT_WAIT_UNDER_LOCK_TP = _src(
+    """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._done = threading.Event()
+
+        def join_done(self):
+            with self._lock:
+                self._done.wait()            # Event.wait releases NOTHING
+    """
+)
+
+
+def test_blocking_under_lock_true_positive():
+    assert "TPL123" in _codes(analyze_source(BLOCKING_UNDER_LOCK_TP))
+
+
+def test_blocking_after_release_near_miss():
+    assert "TPL123" not in _codes(analyze_source(BLOCKING_OUTSIDE_LOCK_NEAR_MISS))
+
+
+def test_condition_wait_exempt():
+    assert "TPL123" not in _codes(analyze_source(CONDITION_WAIT_EXEMPT))
+
+
+def test_event_wait_under_lock_true_positive():
+    assert "TPL123" in _codes(analyze_source(EVENT_WAIT_UNDER_LOCK_TP))
+
+
+def test_suppression_works_on_concurrency_codes():
+    src = BLOCKING_UNDER_LOCK_TP.replace(
+        "return jax.device_get(self._latest)",
+        "return jax.device_get(self._latest)  "
+        "# tpulint: disable=TPL123 -- eager debug helper, single-threaded harness",
+    )
+    findings = analyze_source(src)
+    assert "TPL123" not in _codes(findings)
+    assert "TPL123" in _codes(findings, suppressed=True)
+
+
+# ===========================================================================
+# The retro-corpus: the five concurrency bugs hand-found in review rounds.
+# Each fixture reconstructs the PRE-FIX shape of the bug; the paired
+# negative reconstructs the shipped fix.  These are the acceptance tests
+# for the whole rule family — every historical bug must be flagged.
+# ===========================================================================
+
+# (1) PR-11: the preemption handler spawned its drain thread INSIDE the
+# signal handler.  Thread.start() takes CPython's interpreter-level
+# threading lock; a SIGTERM landing while any thread is mid-start()
+# deadlocks the process during the preemption grace window.
+PR11_SIGNAL_THREAD_START = _src(
+    """
+    import signal
+    import threading
+
+    class PreemptionGuard:
+        def drain_all(self):
+            pass
+
+        def _notice(self, signum, frame):
+            runner = threading.Thread(target=self.drain_all, daemon=True)
+            runner.start()
+
+        def install(self):
+            signal.signal(signal.SIGTERM, self._notice)
+    """
+)
+
+# the shipped fix: pre-spawn a parked runner at construction; the handler
+# only records the signum and sets the wake event.
+PR11_SIGNAL_FIX = _src(
+    """
+    import signal
+    import threading
+
+    class PreemptionGuard:
+        def __init__(self):
+            self._wake = threading.Event()
+            self._signum = None
+            self._runner = threading.Thread(target=self._drain_loop, daemon=True)
+            self._runner.start()
+
+        def _drain_loop(self):
+            self._wake.wait()
+
+        def _notice(self, signum, frame):
+            self._signum = signum
+            self._wake.set()
+
+        def install(self):
+            signal.signal(signal.SIGTERM, self._notice)
+    """
+)
+
+# (2) PR-11: double drain.  drain_now() and the notice runner could both
+# run the report pass; the fix serialized them under the guard lock with
+# an idempotency latch.  Pre-fix shape: the latch write races because the
+# runner-thread path touches it bare while the foreground path locks.
+PR11_DOUBLE_DRAIN = _src(
+    """
+    import threading
+
+    class PreemptionGuard:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._reports = None
+            self._runner = threading.Thread(target=self._drain_loop, daemon=True)
+
+        def _drain_loop(self):
+            if self._reports is None:     # unlocked check on the runner thread
+                self._reports = ["drained"]   # races drain_now's locked write
+
+        def drain_now(self):
+            with self._lock:
+                if self._reports is None:
+                    self._reports = ["drained"]
+                return self._reports
+
+        def reset(self):
+            with self._lock:
+                self._reports = None
+    """
+)
+
+PR11_DOUBLE_DRAIN_FIX = _src(
+    """
+    import threading
+
+    class PreemptionGuard:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._reports = None
+            self._runner = threading.Thread(target=self._drain_loop, daemon=True)
+
+        def _drain_loop(self):
+            with self._lock:              # both paths under the same lock:
+                if self._reports is None: # second entrant sees the latch
+                    self._reports = ["drained"]
+
+        def drain_now(self):
+            with self._lock:
+                if self._reports is None:
+                    self._reports = ["drained"]
+                return self._reports
+
+        def reset(self):
+            with self._lock:
+                self._reports = None
+    """
+)
+
+# (3) PR-13: series re-mint after close.  The instruments registry's series
+# map is written under the registry lock by mint/remove, but the sampler
+# thread's touch() path re-created a closed series bare — a re-mint racing
+# the close that was concurrently pruning it.
+PR13_SERIES_REMINT = _src(
+    """
+    import threading
+
+    class SeriesRegistry:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._series = {}
+            self._sampler = threading.Thread(target=self._sample, daemon=True)
+
+        def _sample(self):
+            if "beat" not in self._series:
+                self._series["beat"] = 0      # bare re-mint on the sampler
+
+        def mint(self, name):
+            with self._lock:
+                self._series[name] = 0
+
+        def close(self, name):
+            with self._lock:
+                self._series.pop(name, None)
+    """
+)
+
+PR13_SERIES_REMINT_FIX = _src(
+    """
+    import threading
+
+    class SeriesRegistry:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._series = {}
+            self._sampler = threading.Thread(target=self._sample, daemon=True)
+
+        def _sample(self):
+            with self._lock:                  # mint-or-touch under the lock
+                if "beat" not in self._series:
+                    self._series["beat"] = 0
+
+        def mint(self, name):
+            with self._lock:
+                self._series[name] = 0
+
+        def close(self, name):
+            with self._lock:
+                self._series.pop(name, None)
+    """
+)
+
+# (4) PR-15: stats() held the evaluator lock across a donating dispatch's
+# device fetch — a scrape thread calling stats() stalled submit() for the
+# full dispatch.  Fixed with bounded acquisition + a cached snapshot; the
+# pre-fix shape is a blocking device read under the state lock.
+PR15_STATS_LOCK_DISPATCH = _src(
+    """
+    import threading
+    import jax
+
+    class Evaluator:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._latest = None
+
+        def stats(self):
+            with self._lock:
+                fetched = jax.device_get(self._latest)
+            return {"latest": fetched}
+    """
+)
+
+PR15_STATS_FIX = _src(
+    """
+    import threading
+    import jax
+
+    class Evaluator:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._latest = None
+            self._snapshot = {}
+
+        def stats(self):
+            with self._lock:
+                snap = dict(self._snapshot)   # cached host-side summary only
+            return snap
+
+        def _writeback(self, result):
+            fetched = jax.device_get(result)  # fetch OUTSIDE the lock
+            with self._lock:
+                self._snapshot = {"latest": fetched}
+    """
+)
+
+# (5) PR-19: GC-vs-retry rank-dir race.  The migration GC pruned a rank
+# directory while a retrying writer was re-staging into it: the writer's
+# view of the staged set is lock-guarded on the commit path but was read
+# bare on the GC thread, so GC could prune a dir the retry had just
+# re-registered.
+PR19_GC_RETRY_RACE = _src(
+    """
+    import threading
+
+    class HandoffStore:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._staged = {}
+            self._gc = threading.Thread(target=self._gc_loop, daemon=True)
+
+        def _gc_loop(self):
+            for rank in list(self._staged):   # bare read on the GC thread
+                self._staged.pop(rank)        # prunes a just-restaged dir
+
+        def stage(self, rank, payload):
+            with self._lock:
+                self._staged[rank] = payload
+
+        def commit(self, rank):
+            with self._lock:
+                return self._staged.pop(rank, None)
+    """
+)
+
+PR19_GC_RETRY_FIX = _src(
+    """
+    import threading
+
+    class HandoffStore:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._staged = {}
+            self._gc = threading.Thread(target=self._gc_loop, daemon=True)
+
+        def _gc_loop(self):
+            with self._lock:                  # GC sees retry's re-stage or
+                for rank in list(self._staged):   # waits for it — never both
+                    self._staged.pop(rank)
+
+        def stage(self, rank, payload):
+            with self._lock:
+                self._staged[rank] = payload
+
+        def commit(self, rank):
+            with self._lock:
+                return self._staged.pop(rank, None)
+    """
+)
+
+
+@pytest.mark.parametrize(
+    "name, src, expected_code",
+    [
+        ("pr11-signal-thread-start", PR11_SIGNAL_THREAD_START, "TPL122"),
+        ("pr11-double-drain", PR11_DOUBLE_DRAIN, "TPL121"),
+        ("pr13-series-remint", PR13_SERIES_REMINT, "TPL121"),
+        ("pr15-stats-lock-dispatch", PR15_STATS_LOCK_DISPATCH, "TPL123"),
+        ("pr19-gc-retry-race", PR19_GC_RETRY_RACE, "TPL121"),
+    ],
+    ids=lambda v: v if isinstance(v, str) and v.startswith("pr") else "",
+)
+def test_retro_corpus_historical_bug_flagged(name, src, expected_code):
+    assert expected_code in _codes(analyze_source(src)), name
+
+
+@pytest.mark.parametrize(
+    "name, src",
+    [
+        ("pr11-signal-fix", PR11_SIGNAL_FIX),
+        ("pr11-double-drain-fix", PR11_DOUBLE_DRAIN_FIX),
+        ("pr13-series-remint-fix", PR13_SERIES_REMINT_FIX),
+        ("pr15-stats-fix", PR15_STATS_FIX),
+        ("pr19-gc-retry-fix", PR19_GC_RETRY_FIX),
+    ],
+    ids=lambda v: v if isinstance(v, str) and v.startswith("pr") else "",
+)
+def test_retro_corpus_shipped_fix_clean(name, src):
+    codes = _codes(analyze_source(src))
+    assert not {"TPL120", "TPL121", "TPL122", "TPL123"} & set(codes), (name, codes)
+
+
+# ------------------------------------------------- oracle plumbing details
+def test_thread_oracle_follows_call_edges():
+    """Reachability propagates through self-calls: a helper two hops below
+    the Thread target is still thread-reachable."""
+    src = _src(
+        """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._depth = 0
+                self._t = threading.Thread(target=self._loop, daemon=True)
+
+            def _loop(self):
+                self._tick()
+
+            def _tick(self):
+                self._depth += 1          # bare write, two hops from the root
+
+            def submit(self):
+                with self._lock:
+                    self._depth += 1
+
+            def flush(self):
+                with self._lock:
+                    self._depth = 0
+        """
+    )
+    assert "TPL121" in _codes(analyze_source(src))
+
+
+def test_signal_oracle_sees_nested_handler_defs():
+    """The PR-11 drain.py shape: the handler is a closure inside the
+    installer, registered via signal.signal — the oracle must still root it."""
+    src = _src(
+        """
+        import signal
+        import threading
+
+        def install(guard):
+            def _handler(signum, frame):
+                t = threading.Thread(target=guard.drain)
+                t.start()
+            signal.signal(signal.SIGTERM, _handler)
+        """
+    )
+    assert "TPL122" in _codes(analyze_source(src))
+
+
+def test_http_handler_is_thread_root():
+    """do_GET runs on a ThreadingHTTPServer worker thread: bare access to a
+    lock-guarded attribute of the SAME class is flagged."""
+    src = _src(
+        """
+        import threading
+        from http.server import BaseHTTPRequestHandler
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                self._hits += 1           # bare on the serving thread
+
+            def bump_locked(self):
+                with self._lock:
+                    self._hits += 1
+
+            def bump_again(self):
+                with self._lock:
+                    self._hits += 1
+        """
+    )
+    # _hits has 2 locked writes vs 1 bare: majority-guarded, do_GET flagged.
+    # (self._lock is not declared in __init__ here, so give it one: see below)
+    src = src.replace(
+        "class Handler(BaseHTTPRequestHandler):",
+        "class Handler(BaseHTTPRequestHandler):\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._hits = 0\n",
+    )
+    assert "TPL121" in _codes(analyze_source(src))

@@ -46,6 +46,12 @@ def test_floor_file_shape():
     # the analysis gate bounds the tpulint self-run wall time AND pins the
     # unsuppressed-findings count to exactly zero (never raise that one)
     assert data["analysis_runtime_ceilings"]["analysis_wall_ms"] > 0
+    # the cold one-shot ceiling must be at least as generous as the
+    # warm-repeat one (first pass pays source reads + index build)
+    assert (
+        data["analysis_runtime_ceilings"]["tpulint_self_run_ms"]
+        >= data["analysis_runtime_ceilings"]["analysis_wall_ms"]
+    )
     assert data["analysis_runtime_ceilings"]["findings_unsuppressed"] == 0
     # the whole-collection fused step must beat sequential dispatch >= 1.5x
     # (ISSUE 6 acceptance) and the persistent-cache warm process must pay
@@ -297,6 +303,14 @@ def test_check_floors_flags_analysis_regressions():
     violations = bench._check_floors(headline_vs=1000.0, details=details)
     assert violations and all("analysis_wall_ms" in v for v in violations)
     details["analysis_runtime"] = {"analysis_wall_ms": 2500.0, "findings_unsuppressed": 0}
+    assert bench._check_floors(headline_vs=1000.0, details=details) == []
+    # the cold one-shot self-run (what a single CI invocation pays) has its
+    # own ceiling: the rule set growing must not silently drift it past
+    # what tier-1 can absorb, even while the warm-repeat floor stays green
+    details["analysis_runtime"]["tpulint_self_run_ms"] = 10**6
+    violations = bench._check_floors(headline_vs=1000.0, details=details)
+    assert violations and all("tpulint_self_run_ms" in v for v in violations)
+    details["analysis_runtime"]["tpulint_self_run_ms"] = 9000.0
     assert bench._check_floors(headline_vs=1000.0, details=details) == []
     details["analysis_runtime"]["findings_unsuppressed"] = 1
     violations = bench._check_floors(headline_vs=1000.0, details=details)

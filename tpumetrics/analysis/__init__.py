@@ -22,7 +22,7 @@ Rule catalog: see :mod:`tpumetrics.analysis.rules` and ``docs/analysis.md``.
 """
 
 from tpumetrics.analysis.core import Finding, PackageIndex, analyze_paths, analyze_source
-from tpumetrics.analysis.report import render_json, render_text
+from tpumetrics.analysis.report import render_json, render_sarif, render_text
 from tpumetrics.analysis.rules import RULES
 
 __all__ = [
@@ -32,5 +32,6 @@ __all__ = [
     "analyze_paths",
     "analyze_source",
     "render_json",
+    "render_sarif",
     "render_text",
 ]

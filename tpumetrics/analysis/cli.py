@@ -2,7 +2,8 @@
 
 Exit codes: 0 = clean (no unsuppressed findings), 1 = findings, 2 = usage /
 analyzer error.  ``--format json`` emits the round-trippable report that the
-CI gate (tests/test_analysis_gate.py) diffs against its committed baseline.
+CI gate (tests/test_analysis_gate.py) diffs against its committed baseline;
+``--format sarif`` emits SARIF 2.1.0 for PR-annotation tooling.
 """
 
 from __future__ import annotations
@@ -12,7 +13,7 @@ import sys
 from typing import List, Optional
 
 from tpumetrics.analysis.core import analyze_paths
-from tpumetrics.analysis.report import render_json, render_text
+from tpumetrics.analysis.report import render_json, render_sarif, render_text
 from tpumetrics.analysis.rules import CATALOG
 
 
@@ -22,7 +23,7 @@ def _build_parser() -> argparse.ArgumentParser:
         description="tpulint: static trace-safety & sync-schedule linter for tpumetrics",
     )
     p.add_argument("paths", nargs="*", help="files and/or directories to analyze")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"), default="text")
     p.add_argument("--select", default="", help="comma-separated codes to report (default: all)")
     p.add_argument("--ignore", default="", help="comma-separated codes to drop")
     p.add_argument("--show-suppressed", action="store_true", help="include suppressed findings in text output")
@@ -48,6 +49,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     if args.format == "json":
         print(render_json(findings))
+    elif args.format == "sarif":
+        print(render_sarif(findings))
     else:
         print(render_text(findings, show_suppressed=args.show_suppressed))
     return 1 if any(not f.suppressed for f in findings) else 0

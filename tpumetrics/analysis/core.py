@@ -39,6 +39,12 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 #: codes that may never be silenced (meta-findings about the lint run itself)
 UNSUPPRESSABLE = ("TPL900", "TPL901", "TPL902")
 
+#: thread-entry roots shared with the serving-layer rule: HTTP-handler
+#: dispatch methods (stdlib BaseHTTPRequestHandler convention) and the
+#: sampler/tick loops of SLO-engine-shaped classes
+_THREAD_HANDLER_METHODS = {"do_GET", "do_POST", "do_PUT", "do_HEAD", "do_DELETE"}
+_THREAD_SAMPLER_METHODS = {"tick", "_run"}
+
 _SUPPRESS_RE = re.compile(
     r"#\s*tpulint:\s*(?P<kind>disable|disable-next)\s*="
     r"\s*(?P<codes>TPL[0-9]{3}(?:\s*,\s*TPL[0-9]{3})*)"
@@ -251,6 +257,13 @@ class PackageIndex:
         self._broad_states: Dict[int, Set[str]] = {}
         self._declared_attrs: Dict[int, Set[str]] = {}
         self.update_reachable: Set[int] = set()  # id(func node)
+        #: thread-entry oracle: id(func node) -> description of the concurrent
+        #: root it is reachable from (Thread target, HTTP handler, sampler
+        #: loop, soak worker loop).  Signal-handler reachability is tracked
+        #: separately — a handler preempts ANY thread, so it is also a member
+        #: of the thread-reachable set.
+        self.thread_reachable: Dict[int, str] = {}
+        self.signal_reachable: Dict[int, str] = {}
 
     # ------------------------------------------------------------- building
     @classmethod
@@ -259,6 +272,7 @@ class PackageIndex:
         for path in files:
             idx._index_file(path)
         idx._compute_reachability()
+        idx._compute_thread_reachability()
         return idx
 
     def _index_file(self, path: str) -> None:
@@ -495,6 +509,154 @@ class PackageIndex:
 
     def is_update_reachable(self, node: ast.AST) -> bool:
         return id(node) in self.update_reachable
+
+    # ------------------------------------------- thread-entry reachability
+    #
+    # The thread-entry oracle answers "can this function run on something
+    # other than the caller's own thread?" — the precondition for the
+    # concurrency rules (TPL120–TPL123).  Roots:
+    #
+    #   * functions/methods passed as ``threading.Thread(target=...)``
+    #     (bare names, ``self.m``, and nested defs of the spawning function)
+    #   * ``do_GET``-family HTTP handler methods (each request runs on a
+    #     ThreadingHTTPServer worker thread)
+    #   * sampler loops (``tick``/``_run`` of SLO-engine-shaped classes)
+    #   * the soak worker's command loop (a separate *process*, but its
+    #     telemetry objects are shared-shape with the supervisor's)
+    #   * functions installed as signal handlers (``signal.signal``,
+    #     ``install_preemption_handler`` callbacks) — tracked in the
+    #     stricter ``signal_reachable`` set AND as thread roots, since a
+    #     handler preempts whatever thread holds whatever lock
+    #
+    # Propagation follows the same call-edge graph as update-reachability;
+    # the same documented approximations apply (callables in variables,
+    # ``getattr`` dispatch, and attribute-chain receivers are not followed).
+
+    def _callback_target(self, mod: ModuleInfo, fi: FuncInfo, expr: ast.expr) -> Optional[FuncInfo]:
+        """Resolve a callback expression (a ``Thread`` target, a signal
+        handler): a bare name (nested def of the registering function,
+        module function, or ``from``-import) or ``self.m`` on the
+        registering method's own class."""
+        if isinstance(expr, ast.Name):
+            for n in ast.walk(fi.node):
+                if (
+                    isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and n.name == expr.id
+                    and n is not fi.node
+                ):
+                    return _func_info(n, mod.modname, fi.owner)
+            if expr.id in mod.functions:
+                return mod.functions[expr.id]
+            if expr.id in mod.imports_from:
+                tmod, orig = mod.imports_from[expr.id]
+                target = self.modules.get(tmod)
+                if target is not None:
+                    return target.functions.get(orig)
+        elif isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+            if expr.value.id == "self" and fi.owner is not None:
+                return self.method_table(fi.owner).get(expr.attr)
+        return None
+
+    @staticmethod
+    def _call_dotted(mod: ModuleInfo, expr: ast.expr) -> Optional[str]:
+        """Import-resolved dotted name of a call target (the core-side twin
+        of the rules module's resolver — core cannot import rules)."""
+        parts: List[str] = []
+        cur = expr
+        while isinstance(cur, ast.Attribute):
+            parts.insert(0, cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        head = cur.id
+        if head in mod.imports_from:
+            tmod, orig = mod.imports_from[head]
+            head = f"{tmod}.{orig}" if tmod else orig
+        else:
+            head = mod.imports_mod.get(head, head)
+        return ".".join([head] + parts)
+
+    def _registration_roots(self, mod: ModuleInfo, fi: FuncInfo) -> List[Tuple[FuncInfo, str, bool]]:
+        """(callback, description, is_signal) triples registered inside one
+        function: ``Thread(target=...)`` spawns and signal-handler installs."""
+        out: List[Tuple[FuncInfo, str, bool]] = []
+        for n in ast.walk(fi.node):
+            if not isinstance(n, ast.Call):
+                continue
+            dotted = self._call_dotted(mod, n.func) or ""
+            if dotted == "threading.Thread" or dotted == "Thread":
+                for kw in n.keywords:
+                    if kw.arg == "target":
+                        cb = self._callback_target(mod, fi, kw.value)
+                        if cb is not None:
+                            out.append(
+                                (cb, f"thread target spawned in `{fi.qualname}`", False)
+                            )
+            elif dotted == "signal.signal":
+                if len(n.args) >= 2:
+                    cb = self._callback_target(mod, fi, n.args[1])
+                    if cb is not None:
+                        out.append(
+                            (cb, f"signal handler installed in `{fi.qualname}`", True)
+                        )
+            elif dotted.rpartition(".")[2] == "install_preemption_handler":
+                # any resolvable callable argument is treated as the handler
+                for arg in list(n.args) + [k.value for k in n.keywords]:
+                    cb = self._callback_target(mod, fi, arg)
+                    if cb is not None:
+                        out.append(
+                            (cb, f"preemption handler installed in `{fi.qualname}`", True)
+                        )
+        return out
+
+    def _thread_entry_roots(self) -> List[Tuple[FuncInfo, str, bool]]:
+        roots: List[Tuple[FuncInfo, str, bool]] = []
+        for mod in self.modules.values():
+            for ci in mod.classes.values():
+                is_handler = any(
+                    b.rpartition(".")[2] == "BaseHTTPRequestHandler" for b in ci.bases
+                )
+                is_engine = ci.name.endswith("SloEngine")
+                for name, mfi in ci.methods.items():
+                    if name in _THREAD_HANDLER_METHODS and (
+                        is_handler or name.startswith("do_")
+                    ):
+                        roots.append((mfi, f"HTTP handler `{mfi.qualname}`", False))
+                    elif is_engine and name in _THREAD_SAMPLER_METHODS:
+                        roots.append((mfi, f"sampler loop `{mfi.qualname}`", False))
+            if mod.modname.endswith("soak.worker") and "main" in mod.functions:
+                roots.append((mod.functions["main"], "soak worker loop", False))
+            funcs: List[FuncInfo] = list(mod.functions.values())
+            for ci in mod.classes.values():
+                funcs.extend(ci.methods.values())
+            for fi in funcs:
+                roots.extend(self._registration_roots(mod, fi))
+        return roots
+
+    def _mark_reachable(self, root: FuncInfo, why: str, out: Dict[int, str]) -> None:
+        queue: List[FuncInfo] = [root]
+        while queue:
+            fi = queue.pop()
+            if id(fi.node) in out:
+                continue
+            out[id(fi.node)] = why
+            table = self.method_table(fi.owner) if fi.owner is not None else {}
+            for key in fi.callees:
+                nxt = table.get(key[1]) if key[0] == "s" else self._resolve_call(fi, key)
+                if nxt is not None and id(nxt.node) not in out:
+                    queue.append(nxt)
+
+    def _compute_thread_reachability(self) -> None:
+        for root, why, is_signal in self._thread_entry_roots():
+            self._mark_reachable(root, why, self.thread_reachable)
+            if is_signal:
+                self._mark_reachable(root, why, self.signal_reachable)
+
+    def is_thread_reachable(self, node: ast.AST) -> bool:
+        return id(node) in self.thread_reachable
+
+    def is_signal_reachable(self, node: ast.AST) -> bool:
+        return id(node) in self.signal_reachable
 
 
 # ------------------------------------------------------------------ driver

@@ -1,0 +1,429 @@
+"""Lock-context dataflow for the concurrency rules (TPL120–TPL123).
+
+Pure AST, like the rest of tpulint.  This module answers three questions
+the rules need:
+
+1. **Which expressions are locks?**  A lock *identity* is a stable string
+   naming the object: ``"pkg.mod:Class.attr"`` for ``self.<attr>`` locks
+   declared in ``__init__``/``__post_init__`` (``self._lock =
+   threading.Lock()``), ``"pkg.mod.NAME"`` for module-global locks.  A
+   ``threading.Condition(self._lock)`` *aliases* the lock it wraps —
+   acquiring the condition acquires that lock — so both spellings resolve
+   to one identity.  ``RLock``\\ s are recorded as reentrant (their
+   self-edges are not deadlocks).
+
+2. **Where is each lock held?**  Per function, a list of ``(first_line,
+   last_line, identity)`` spans: ``with self._lock:`` bodies (including the
+   runtime's ``_bounded_lock(self._lock)`` acquire-with-timeout idiom,
+   whose first argument is the lock), and ``lock.acquire()`` …
+   ``lock.release()`` line ranges (an unmatched ``acquire`` holds to the
+   end of the function).
+
+3. **Which attributes does each lock guard?**  Per class: an attribute
+   written under lock L in any method is *guarded-by-L*; it is
+   **consistently guarded** when every write outside
+   ``__init__``/``__post_init__`` (construction happens-before publication)
+   happens under the same single identity.  Only consistently guarded
+   attributes feed TPL121 — mixed-discipline attributes are ambiguous and
+   the rules stay quiet about them.
+
+Documented approximations (deliberate, same spirit as the core index):
+locks reaching a function as parameters or locals are not tracked; lock
+identity follows ``self.<attr>`` / module globals only; ``acquire``/
+``release`` matching is line-ranged, not control-flow-sensitive; a lock
+stored on another object (``self.server.lock``) is invisible.  The runtime
+remains authoritative — this is the cheap static complement.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tpumetrics.analysis.core import ClassInfo, FuncInfo, ModuleInfo, PackageIndex
+
+#: constructor tails that mint a lock object, mapped to the lock kind
+_LOCK_CTORS = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+    "Semaphore": "lock",
+    "BoundedSemaphore": "lock",
+}
+#: the runtime's acquire-with-timeout wrapper (evaluator.py): its first
+#: argument is the lock being (boundedly) acquired
+_BOUNDED_WRAPPER = "_bounded_lock"
+
+#: attributes holding these are self-synchronizing objects, not guarded
+#: data: an Event's set/clear/wait and a Queue's put/get carry their own
+#: internal locking, so they are excluded from guarded-attribute inference
+#: (a deque is NOT here — it is a plain container and exactly the kind of
+#: state the dispatch lock guards)
+_SYNC_CTORS = {
+    "threading.Event": "Event",
+    "Event": "Event",
+    "queue.Queue": "Queue",
+    "queue.SimpleQueue": "Queue",
+    "queue.LifoQueue": "Queue",
+    "queue.PriorityQueue": "Queue",
+}
+
+
+@dataclass
+class LockDecl:
+    identity: str
+    kind: str  # "lock" | "rlock" | "condition"
+    alias_of: Optional[str] = None  # Condition(wrapped_lock) -> wrapped identity
+
+
+@dataclass
+class AcquisitionSite:
+    """One lock acquisition: where, what, and what was already held."""
+
+    identity: str
+    line: int
+    col: int
+    end_line: int
+    held: Tuple[str, ...]  # identities already held at this site (outer spans)
+    qualname: str
+    path: str
+    bounded: bool = False  # acquired via the _bounded_lock timeout wrapper
+
+
+@dataclass
+class ClassLocks:
+    """Per-class guarded-attribute inference (write-site counts)."""
+
+    # attr -> lock identity -> number of write sites under that lock
+    guards: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    # attr -> number of write sites with no lock held
+    bare: Dict[str, int] = field(default_factory=dict)
+
+    def consistently_guarded(self) -> Dict[str, str]:
+        """Attrs guarded by exactly ONE lock whose guarded writes form a
+        strict majority.  The all-writes-guarded case is the clean one; the
+        strict-majority case is the historical bug shape (N disciplined
+        writers plus the one forgotten one) — a 50/50 split is ambiguous
+        discipline and stays quiet."""
+        out: Dict[str, str] = {}
+        for attr, by_lock in self.guards.items():
+            if len(by_lock) != 1:
+                continue
+            (lock, guarded_n), = by_lock.items()
+            if guarded_n > self.bare.get(attr, 0):
+                out[attr] = lock
+        return out
+
+
+class LockModel:
+    """The package-wide lock census + per-function held-span computer.
+
+    Built once per :class:`PackageIndex` (see :func:`lock_model`) — the
+    declaration census is cross-module, the spans are per-function.
+    """
+
+    def __init__(self, index: PackageIndex) -> None:
+        self.index = index
+        self.decls: Dict[str, LockDecl] = {}
+        self.syncs: Set[str] = set()  # Event/Queue identities (self-synchronizing)
+        self._span_cache: Dict[int, List[Tuple[int, int, str, bool]]] = {}
+        self._class_cache: Dict[int, ClassLocks] = {}
+        for mod in index.modules.values():
+            self._census_module(mod)
+
+    # -------------------------------------------------------------- census
+    def _census_module(self, mod: ModuleInfo) -> None:
+        if mod.tree is None:
+            return
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    self._maybe_decl(mod, f"{mod.modname}.{t.id}", node.value, owner=None)
+        for ci in mod.classes.values():
+            for name in ("__init__", "__post_init__"):
+                fi = ci.methods.get(name)
+                if fi is None:
+                    continue
+                for n in ast.walk(fi.node):
+                    if not (isinstance(n, ast.Assign) and len(n.targets) == 1):
+                        continue
+                    t = n.targets[0]
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        self._maybe_decl(
+                            mod, f"{ci.qualname}.{t.attr}", n.value, owner=ci
+                        )
+
+    def _maybe_decl(
+        self, mod: ModuleInfo, identity: str, value: ast.expr, owner: Optional[ClassInfo]
+    ) -> None:
+        if not isinstance(value, ast.Call):
+            return
+        dotted = PackageIndex._call_dotted(mod, value.func) or ""
+        if dotted in _SYNC_CTORS:
+            self.syncs.add(identity)
+            return
+        tail = dotted.rpartition(".")[2]
+        kind = _LOCK_CTORS.get(tail)
+        if kind is None or not (dotted == tail or dotted.startswith("threading.")):
+            return
+        alias = None
+        if kind == "condition" and value.args:
+            # Condition(self._lock): acquiring the condition acquires the lock
+            wrapped = self._self_attr_identity(owner, value.args[0])
+            if wrapped is not None:
+                alias = wrapped
+        self.decls[identity] = LockDecl(identity, kind, alias)
+
+    @staticmethod
+    def _self_attr_identity(owner: Optional[ClassInfo], expr: ast.expr) -> Optional[str]:
+        if (
+            owner is not None
+            and isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            return f"{owner.qualname}.{expr.attr}"
+        return None
+
+    # ------------------------------------------------------------ identity
+    def resolve(self, expr: ast.expr, fi: FuncInfo, mod: ModuleInfo) -> Optional[str]:
+        """Canonical identity of a lock expression, or ``None`` if it is not
+        a declared lock.  Conditions resolve through their alias to the
+        wrapped lock's identity."""
+        identity: Optional[str] = None
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and fi.owner is not None
+        ):
+            for ci in [fi.owner] + self.index._ancestors(fi.owner):
+                cand = f"{ci.qualname}.{expr.attr}"
+                if cand in self.decls:
+                    identity = cand
+                    break
+        elif isinstance(expr, ast.Name):
+            cand = f"{fi.modname}.{expr.id}"
+            if cand in self.decls:
+                identity = cand
+        if identity is None:
+            return None
+        decl = self.decls[identity]
+        return decl.alias_of if decl.alias_of else identity
+
+    def is_reentrant(self, identity: str) -> bool:
+        decl = self.decls.get(identity)
+        return decl is not None and decl.kind == "rlock"
+
+    # --------------------------------------------------------------- spans
+    def held_spans(self, fi: FuncInfo, mod: ModuleInfo) -> List[Tuple[int, int, str, bool]]:
+        """``(first_line, last_line, identity, bounded)`` spans where a lock
+        is held inside ``fi``."""
+        cached = self._span_cache.get(id(fi.node))
+        if cached is not None:
+            return cached
+        spans: List[Tuple[int, int, str, bool]] = []
+        acquires: Dict[str, int] = {}
+        for n in ast.walk(fi.node):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    identity, bounded = self._with_lock(item.context_expr, fi, mod)
+                    if identity is not None:
+                        spans.append(
+                            (n.lineno, n.end_lineno or n.lineno, identity, bounded)
+                        )
+            elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+                identity = self.resolve(n.func.value, fi, mod)
+                if identity is None:
+                    continue
+                if n.func.attr == "acquire":
+                    acquires.setdefault(identity, n.lineno)
+                elif n.func.attr == "release":
+                    start = acquires.pop(identity, None)
+                    if start is not None:
+                        spans.append((start, n.lineno, identity, False))
+        fn_end = getattr(fi.node, "end_lineno", None) or 0
+        for identity, start in acquires.items():
+            spans.append((start, fn_end, identity, False))
+        self._span_cache[id(fi.node)] = spans
+        return spans
+
+    def _with_lock(
+        self, expr: ast.expr, fi: FuncInfo, mod: ModuleInfo
+    ) -> Tuple[Optional[str], bool]:
+        """Lock identity acquired by one ``with`` item (direct lock or the
+        ``_bounded_lock(lock)`` wrapper), plus whether it was bounded."""
+        identity = self.resolve(expr, fi, mod)
+        if identity is not None:
+            return identity, False
+        if isinstance(expr, ast.Call):
+            dotted = PackageIndex._call_dotted(mod, expr.func) or ""
+            if dotted.rpartition(".")[2] == _BOUNDED_WRAPPER and expr.args:
+                return self.resolve(expr.args[0], fi, mod), True
+        return None, False
+
+    def held_at(self, fi: FuncInfo, mod: ModuleInfo, line: int) -> Set[str]:
+        """Identities of every lock held at ``line`` of ``fi``."""
+        return {
+            ident
+            for a, b, ident, _bounded in self.held_spans(fi, mod)
+            if a <= line <= b
+        }
+
+    # -------------------------------------------------------- acquisitions
+    def acquisition_sites(self, fi: FuncInfo, mod: ModuleInfo) -> List[AcquisitionSite]:
+        """Every lock acquisition in ``fi`` together with the set of locks
+        already held at that point (outer ``with`` spans / open
+        ``acquire()`` ranges containing the site, excluding re-entry on the
+        same identity)."""
+        spans = self.held_spans(fi, mod)
+        out: List[AcquisitionSite] = []
+        for n in ast.walk(fi.node):
+            sites: List[Tuple[str, int, int, int, bool]] = []
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    identity, bounded = self._with_lock(item.context_expr, fi, mod)
+                    if identity is not None:
+                        sites.append(
+                            (
+                                identity,
+                                n.lineno,
+                                item.context_expr.col_offset,
+                                n.end_lineno or n.lineno,
+                                bounded,
+                            )
+                        )
+            elif (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "acquire"
+            ):
+                identity = self.resolve(n.func.value, fi, mod)
+                if identity is not None:
+                    sites.append((identity, n.lineno, n.col_offset, n.lineno, False))
+            for identity, line, col, end, bounded in sites:
+                # a span of the SAME identity opened earlier still counts as
+                # held (that is the self-deadlock case) — only the span this
+                # very site opens (same identity, same start line) is excluded
+                held = tuple(
+                    sorted(
+                        ident
+                        for a, b, ident, _bnd in spans
+                        if a <= line <= b and not (ident == identity and a == line)
+                    )
+                )
+                out.append(
+                    AcquisitionSite(
+                        identity, line, col, end, held, fi.qualname, mod.path, bounded
+                    )
+                )
+        return out
+
+    # ------------------------------------------------------- guarded attrs
+    def class_locks(self, ci: ClassInfo, mod: ModuleInfo) -> ClassLocks:
+        """Guarded-attribute census for one class: every ``self.<attr>``
+        write site in every non-constructor method, classified by the locks
+        held there."""
+        cached = self._class_cache.get(id(ci))
+        if cached is not None:
+            return cached
+        cl = ClassLocks()
+        for name, fi in ci.methods.items():
+            if name in ("__init__", "__post_init__", "__del__"):
+                continue
+            for attr, line in _attr_writes(fi.node):
+                identity = f"{ci.qualname}.{attr}"
+                if identity in self.decls or identity in self.syncs:
+                    continue  # locks/events/queues are not "guarded data"
+                held = self.held_at(fi, mod, line)
+                if held:
+                    for ident in held:
+                        by_lock = cl.guards.setdefault(attr, {})
+                        by_lock[ident] = by_lock.get(ident, 0) + 1
+                else:
+                    cl.bare[attr] = cl.bare.get(attr, 0) + 1
+        self._class_cache[id(ci)] = cl
+        return cl
+
+
+def _attr_writes(fn: ast.AST) -> List[Tuple[str, int]]:
+    """``(attr, line)`` for every ``self.<attr>`` store: plain/aug/ann
+    assignment targets AND container mutation through the attribute
+    (``self.m[k] = v``, ``self.m.pop(k)``, ``self.q.append(x)``) — the
+    mutation forms are exactly how the guarded dict/deque races happened."""
+    out: List[Tuple[str, int]] = []
+
+    def _self_attr(e: ast.expr) -> Optional[str]:
+        if (
+            isinstance(e, ast.Attribute)
+            and isinstance(e.value, ast.Name)
+            and e.value.id == "self"
+        ):
+            return e.attr
+        return None
+
+    for n in ast.walk(fn):
+        targets: List[ast.expr] = []
+        if isinstance(n, ast.Assign):
+            targets = list(n.targets)
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            targets = [n.target]
+        elif isinstance(n, ast.Delete):
+            targets = list(n.targets)
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is not None:
+                out.append((attr, t.lineno))
+            elif isinstance(t, ast.Subscript):
+                attr = _self_attr(t.value)
+                if attr is not None:
+                    out.append((attr, t.lineno))
+        if (
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr in _MUTATORS
+        ):
+            attr = _self_attr(n.func.value)
+            if attr is not None:
+                out.append((attr, n.lineno))
+    return out
+
+
+def _attr_reads(fn: ast.AST) -> List[Tuple[str, int, int]]:
+    """``(attr, line, col)`` for every bare ``self.<attr>`` load."""
+    out: List[Tuple[str, int, int]] = []
+    for n in ast.walk(fn):
+        if (
+            isinstance(n, ast.Attribute)
+            and isinstance(n.ctx, ast.Load)
+            and isinstance(n.value, ast.Name)
+            and n.value.id == "self"
+        ):
+            out.append((n.attr, n.lineno, n.col_offset))
+    return out
+
+
+#: container methods that mutate the receiver in place — a write for
+#: guarded-attribute purposes (the re-mint/double-drain races were exactly
+#: dict/deque mutations, not attribute rebinds)
+_MUTATORS = {
+    "append", "appendleft", "extend", "pop", "popleft", "popitem", "remove",
+    "discard", "clear", "add", "insert", "setdefault", "update",
+}
+
+
+def lock_model(index: PackageIndex) -> LockModel:
+    """The (cached) :class:`LockModel` for an index.  Cached ON the index
+    itself, not in a module-level dict keyed by ``id(index)`` — rule
+    instances outlive indices, and a freed index's address can be reused."""
+    model = getattr(index, "_lock_model", None)
+    if model is None:
+        model = LockModel(index)
+        index._lock_model = model  # type: ignore[attr-defined]
+    return model

@@ -1,11 +1,35 @@
-"""Finding reporters: human text and machine JSON (round-trippable)."""
+"""Finding reporters: human text, machine JSON (round-trippable), and SARIF.
+
+The JSON payload carries a ``version`` field; :func:`parse_json` rejects
+any version it does not understand (:class:`ReportVersionError`) — a CI
+consumer silently mis-reading a future payload shape is the same
+silent-green failure mode the zero-files guard exists for.  SARIF 2.1.0
+output (``--format sarif``) is the static-analysis interchange format PR
+annotation tooling ingests; suppressed findings are carried as in-source
+suppressions with their justifications, so an annotator can render them
+greyed-out instead of dropping them.
+"""
 
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Sequence
+from typing import Any, Dict, List, Sequence
 
 from tpumetrics.analysis.core import Finding
+
+#: the JSON payload shape this module writes and can read back
+PAYLOAD_VERSION = 1
+
+#: SARIF pin: schema URI + spec version emitted by :func:`render_sarif`
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+class ReportVersionError(ValueError):
+    """A JSON report payload declares a version this reader cannot parse."""
 
 
 def render_text(findings: Sequence[Finding], show_suppressed: bool = False) -> str:
@@ -27,7 +51,7 @@ def render_text(findings: Sequence[Finding], show_suppressed: bool = False) -> s
 def render_json(findings: Sequence[Finding]) -> str:
     return json.dumps(
         {
-            "version": 1,
+            "version": PAYLOAD_VERSION,
             "findings": [
                 {
                     "code": f.code,
@@ -50,8 +74,19 @@ def render_json(findings: Sequence[Finding]) -> str:
 
 
 def parse_json(text: str) -> List[Finding]:
-    """Inverse of :func:`render_json` (the report round-trips losslessly)."""
+    """Inverse of :func:`render_json` (the report round-trips losslessly).
+
+    Raises :class:`ReportVersionError` when the payload's ``version`` is
+    missing or not one this reader understands — a consumer must never
+    silently mis-read a future payload shape as an empty/clean run."""
     payload = json.loads(text)
+    version = payload.get("version") if isinstance(payload, dict) else None
+    if version != PAYLOAD_VERSION:
+        raise ReportVersionError(
+            f"unsupported tpulint report version {version!r} "
+            f"(this reader understands version {PAYLOAD_VERSION}); "
+            "regenerate the report with a matching tpumetrics checkout"
+        )
     return [
         Finding(
             d["code"], d["message"], d["path"], d["line"], d["col"],
@@ -60,6 +95,69 @@ def parse_json(text: str) -> List[Finding]:
         )
         for d in payload["findings"]
     ]
+
+
+def render_sarif(findings: Sequence[Finding]) -> str:
+    """SARIF 2.1.0 report: one run, one rule descriptor per catalog entry
+    that actually fired, one result per finding.  Suppressed findings get
+    a SARIF ``suppressions`` entry (``kind: inSource``) carrying the
+    ``-- why`` justification instead of being dropped."""
+    from tpumetrics.analysis.rules import CATALOG
+
+    fired = sorted({f.code for f in findings})
+    rules: List[Dict[str, Any]] = []
+    for code in fired:
+        name, desc = CATALOG.get(code, (code.lower(), ""))
+        rules.append(
+            {
+                "id": code,
+                "name": name,
+                "shortDescription": {"text": desc or name},
+            }
+        )
+    results: List[Dict[str, Any]] = []
+    for f in findings:
+        result: Dict[str, Any] = {
+            "ruleId": f.code,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {
+                            "startLine": max(f.line, 1),
+                            # SARIF columns are 1-based; tpulint cols are 0-based
+                            "startColumn": f.col + 1,
+                        },
+                    },
+                }
+            ],
+        }
+        if f.symbol:
+            result["partialFingerprints"] = {"tpulint/symbol": f.symbol}
+        if f.suppressed:
+            suppression: Dict[str, Any] = {"kind": "inSource"}
+            if f.justification:
+                suppression["justification"] = f.justification
+            result["suppressions"] = [suppression]
+        results.append(result)
+    payload = {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "tpulint",
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(payload, indent=2, sort_keys=True)
 
 
 def _counts(findings: Sequence[Finding]) -> Dict[str, int]:

@@ -58,6 +58,23 @@ TPL109 stale-routing-read      a local caching a tenant's rank placement (a rout
                                name a service the tenant has already left.  Hold the
                                controller's ``routing_lock`` across read *and* use, or
                                re-read after the seam
+TPL120 lock-order-inversion    a pair of locks acquired in opposite nesting orders on
+                               two code paths (or a non-reentrant lock re-acquired
+                               while already held) — a concurrent pair of threads can
+                               deadlock.  The declared hierarchy (service lock ≡
+                               residency lock → ledger → instruments) is allowlisted
+TPL121 unguarded-guarded-attr  an attribute consistently written under one lock
+                               elsewhere in the class, read or written bare in
+                               thread-reachable code — the torn-read/lost-update race
+TPL122 signal-handler-safety   lock acquisition, ``Thread``/``.start()``, blocking
+                               I/O, or a ledger write reachable from an installed
+                               signal handler — a handler preempts the very thread
+                               holding the lock it would need (``Event.set()`` + a
+                               pre-spawned parked runner is the sanctioned idiom)
+TPL123 blocking-under-lock     ``jax.device_get``/``block_until_ready``/``.item()``/
+                               file I/O/HTTP/``sleep`` while a declared lock is held —
+                               every reader/writer of that lock inherits the stall
+                               (bounded acquisition + cached snapshot is the fix)
 TPL201 divergent-collective    a collective (``sync``/``all_reduce``/``all_gather``/
                                ``flush``/…) reachable on only one branch of a rank- or
                                data-dependent conditional — the static complement of the
@@ -140,6 +157,26 @@ CATALOG: Dict[str, Tuple[str, str]] = {
         "bare-durability-write",
         "direct write/rename in a durability seam module bypassing the storage "
         "shim's retry/quarantine/fault-injection path",
+    ),
+    "TPL120": (
+        "lock-order-inversion",
+        "locks acquired in opposite nesting orders on two paths (or a "
+        "non-reentrant lock re-acquired while held) — potential deadlock",
+    ),
+    "TPL121": (
+        "unguarded-guarded-attr",
+        "attribute consistently lock-guarded elsewhere read/written bare in "
+        "thread-reachable code",
+    ),
+    "TPL122": (
+        "signal-handler-safety",
+        "lock acquisition, thread start, blocking I/O, or ledger write "
+        "reachable from an installed signal handler",
+    ),
+    "TPL123": (
+        "blocking-under-lock",
+        "blocking call (device sync, file I/O, HTTP, sleep) while a declared "
+        "lock is held",
     ),
     "TPL201": (
         "divergent-collective",
@@ -2074,6 +2111,468 @@ class WindowedWindowRule:
                     )
 
 
+# --------------------------------------------------------------------------
+# Concurrency rules (TPL120–TPL123): built on the thread-entry reachability
+# oracle (core.PackageIndex.thread_reachable / signal_reachable) and the
+# lock-context dataflow (analysis/locks.py).
+
+#: the declared lock hierarchy — nesting DOWN this order is the designed
+#: discipline and never a finding: the service lock (≡ the lifecycle
+#: manager's residency lock, which IS the service lock by delegation) may
+#: be held while the ledger lock is taken, and either while an instruments
+#: lock is taken.  Tier is inferred from the lock identity's module/attr.
+def _tpl120_tier(identity: str) -> Optional[int]:
+    modpart = identity.split(":")[0]
+    attr = identity.rpartition(".")[2]
+    if "residency" in attr:
+        return 0
+    if ".runtime.service" in modpart or ".runtime.evaluator" in modpart:
+        return 0
+    if ".lifecycle." in modpart:
+        return 0
+    if ".telemetry.ledger" in modpart:
+        return 1
+    if ".telemetry.instruments" in modpart:
+        return 2
+    return None
+
+
+def _tpl120_declared_order(held: str, acquired: str) -> bool:
+    a, b = _tpl120_tier(held), _tpl120_tier(acquired)
+    return a is not None and b is not None and a <= b
+
+
+class LockOrderRule:
+    """TPL120: lock-order inversions over the cross-module acquisition graph.
+
+    Every acquisition site contributes edges ``held -> acquired``.  An edge
+    that sits on a cycle (the acquired lock can, on some other path, be
+    held while this edge's held lock is taken) is a potential deadlock: two
+    threads entering the cycle from different sides block forever.  A
+    non-reentrant lock acquired while already held is the one-lock special
+    case (self-deadlock, no second thread needed).  Edges consistent with
+    the declared hierarchy (service ≡ residency → ledger → instruments)
+    are allowlisted — a cycle through them is reported only at its
+    order-violating edge.  Lock identity follows ``self.<attr>`` declares
+    and module globals (see :mod:`tpumetrics.analysis.locks`); within-
+    function nesting only — a lock held across a call into another
+    function that locks is not seen (documented approximation)."""
+
+    codes = ("TPL120",)
+
+    def _findings_by_path(self, index: PackageIndex) -> Dict[str, List[Finding]]:
+        cached = getattr(index, "_tpl120_by_path", None)
+        if cached is not None:
+            return cached
+        from tpumetrics.analysis import locks as _locks
+
+        model = _locks.lock_model(index)
+        funcs_by_id: Dict[int, Tuple[FuncInfo, ModuleInfo]] = {}
+        for mod in index.modules.values():
+            funcs: List[FuncInfo] = list(mod.functions.values())
+            for ci in mod.classes.values():
+                funcs.extend(ci.methods.values())
+            for fi in funcs:
+                funcs_by_id[id(fi.node)] = (fi, mod)
+        # transitive acquire-sets: every lock a function may take itself or
+        # via its (resolvable) callees — fixed-point over the call graph, so
+        # "holds L, calls f, f acquires L" is seen across function boundaries
+        callee_ids: Dict[int, Set[int]] = {}
+        closure: Dict[int, Set[str]] = {}
+        for nid, (fi, mod) in funcs_by_id.items():
+            closure[nid] = {s.identity for s in model.acquisition_sites(fi, mod)}
+            outs: Set[int] = set()
+            table = index.method_table(fi.owner) if fi.owner is not None else {}
+            for key in fi.callees:
+                nxt = table.get(key[1]) if key[0] == "s" else index._resolve_call(fi, key)
+                if nxt is not None and id(nxt.node) in funcs_by_id:
+                    outs.add(id(nxt.node))
+            callee_ids[nid] = outs
+        changed = True
+        while changed:
+            changed = False
+            for nid, outs in callee_ids.items():
+                before = len(closure[nid])
+                for c in outs:
+                    closure[nid] |= closure[c]
+                if len(closure[nid]) != before:
+                    changed = True
+
+        edges: Dict[Tuple[str, str], List[_locks.AcquisitionSite]] = {}
+        for nid, (fi, mod) in funcs_by_id.items():
+            for s in model.acquisition_sites(fi, mod):
+                for h in s.held:
+                    edges.setdefault((h, s.identity), []).append(s)
+            # call-mediated edges: a call made while holding H acquires (via
+            # the callee's transitive acquire-set) every lock in closure(c)
+            table = index.method_table(fi.owner) if fi.owner is not None else {}
+            for n in ast.walk(fi.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                held = model.held_at(fi, mod, n.lineno)
+                if not held:
+                    continue
+                key = None
+                f = n.func
+                if isinstance(f, ast.Name):
+                    key = ("n", f.id)
+                elif isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+                    key = ("s", f.attr) if f.value.id == "self" else ("a", f.value.id, f.attr)
+                if key is None:
+                    continue
+                nxt = table.get(key[1]) if key[0] == "s" else index._resolve_call(fi, key)
+                if nxt is None or id(nxt.node) not in funcs_by_id:
+                    continue
+                for acquired in closure[id(nxt.node)]:
+                    for h in held:
+                        edges.setdefault((h, acquired), []).append(
+                            _locks.AcquisitionSite(
+                                acquired, n.lineno, n.col_offset, n.lineno,
+                                (h,), fi.qualname, mod.path,
+                            )
+                        )
+        graph: Dict[str, Set[str]] = {}
+        for (a, b), _ in edges.items():
+            graph.setdefault(a, set()).add(b)
+
+        def _reaches(src: str, dst: str) -> bool:
+            queue, seen = [src], {src}
+            while queue:
+                cur = queue.pop()
+                if cur == dst:
+                    return True
+                for nxt in graph.get(cur, ()):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        queue.append(nxt)
+            return False
+
+        out: Dict[str, List[Finding]] = {}
+        for (held, acquired), sitelist in sorted(edges.items()):
+            if held == acquired:
+                if not model.is_reentrant(held):
+                    for s in sitelist:
+                        out.setdefault(s.path, []).append(
+                            Finding(
+                                "TPL120",
+                                f"`{_short_lock(held)}` re-acquired while already "
+                                "held: the lock is not reentrant, so this path "
+                                "self-deadlocks (no second thread needed). Use an "
+                                "RLock only if re-entry is truly the design; "
+                                "usually the inner acquisition belongs in a "
+                                "_locked variant of the callee.",
+                                s.path, s.line, s.col, symbol=s.qualname,
+                            )
+                        )
+                continue
+            if _tpl120_declared_order(held, acquired):
+                continue
+            if not _reaches(acquired, held):
+                continue
+            back = edges.get((acquired, held))
+            where = (
+                f" (reverse order at {back[0].path}:{back[0].line})" if back else ""
+            )
+            for s in sitelist:
+                out.setdefault(s.path, []).append(
+                    Finding(
+                        "TPL120",
+                        f"lock-order inversion: `{_short_lock(s.identity)}` "
+                        f"acquired while holding `{_short_lock(held)}`, but "
+                        "another path nests them in the opposite order"
+                        f"{where} — a concurrent pair of threads can deadlock. "
+                        "Pick one order (or declare the hierarchy) and nest "
+                        "consistently.",
+                        s.path, s.line, s.col, symbol=s.qualname,
+                    )
+                )
+        index._tpl120_by_path = out  # type: ignore[attr-defined]
+        return out
+
+    def check(self, mod: ModuleInfo, index: PackageIndex) -> Iterator[Finding]:
+        if mod.tree is None:
+            return
+        yield from iter(self._findings_by_path(index).get(mod.path, []))
+
+
+def _short_lock(identity: str) -> str:
+    """``pkg.mod:Class.attr`` → ``Class.attr`` (messages stay readable)."""
+    return identity.rpartition(":")[2]
+
+
+class GuardedAttrRule:
+    """TPL121: a guarded attribute accessed bare in thread-reachable code.
+
+    The guarded-attribute sets come from the lock-context census: an
+    attribute whose every non-constructor write happens under one lock is
+    *consistently guarded* by it (a strict majority of writes, with bare
+    writes in the minority, also qualifies — that is exactly the historical
+    bug shape: N disciplined writers plus the one forgotten one).  A bare
+    read or write of such an attribute in a **thread-reachable** method of
+    the same class is then a torn-read/lost-update race.  Constructors are
+    exempt (construction happens-before publication), as is code no thread
+    root reaches — a deliberate join-outside-the-lock in a close() only
+    the owner calls stays quiet."""
+
+    codes = ("TPL121",)
+
+    def check(self, mod: ModuleInfo, index: PackageIndex) -> Iterator[Finding]:
+        if mod.tree is None:
+            return
+        from tpumetrics.analysis import locks as _locks
+
+        model = _locks.lock_model(index)
+        for ci in mod.classes.values():
+            guarded = model.class_locks(ci, mod).consistently_guarded()
+            if not guarded:
+                continue
+            for name, fi in ci.methods.items():
+                if name in ("__init__", "__post_init__", "__del__"):
+                    continue
+                if not index.is_thread_reachable(fi.node):
+                    continue
+                root = index.thread_reachable[id(fi.node)]
+                seen_lines: Set[Tuple[str, int]] = set()
+                accesses = [
+                    (attr, line, col)
+                    for attr, line, col in _locks._attr_reads(fi.node)
+                ] + [(attr, line, 0) for attr, line in _locks._attr_writes(fi.node)]
+                for attr, line, col in accesses:
+                    guard = guarded.get(attr)
+                    if guard is None or (attr, line) in seen_lines:
+                        continue
+                    if guard in model.held_at(fi, mod, line):
+                        continue
+                    seen_lines.add((attr, line))
+                    yield Finding(
+                        "TPL121",
+                        f"`self.{attr}` accessed without `{_short_lock(guard)}` "
+                        f"in thread-reachable code (via {root}): every other "
+                        f"write of `{attr}` holds that lock, so this access "
+                        "races them (torn read / lost update). Take the lock, "
+                        "or serve a snapshot captured under it.",
+                        mod.path, line, col, symbol=fi.qualname,
+                    )
+
+
+#: calls a signal handler must never reach.  ``Event.set()`` is absent by
+#: design — setting an event to wake a pre-spawned parked runner thread is
+#: the sanctioned handler idiom (see runtime/drain.py).
+_TPL122_LEDGER_TAILS = {"record_event", "mint_series", "close_series"}
+_TPL122_BLOCKING_CALLS = {"time.sleep", "open", "io.open"}
+_TPL122_BLOCKING_PREFIXES = (
+    "requests.", "urllib.request.", "http.client.", "subprocess.", "socket.",
+)
+
+
+class SignalSafetyRule:
+    """TPL122: async-signal-unsafe work reachable from an installed handler.
+
+    A signal handler runs *on top of* whatever frame the interrupted thread
+    was in.  Acquiring any lock can deadlock against the interrupted
+    holder; ``Thread.start()`` takes CPython's own interpreter-level
+    threading lock, so a handler that spawns its drain thread deadlocks
+    against an in-flight ``start()`` (the PR-11 bug this rule
+    retro-covers); blocking I/O stalls the whole process; a ledger write
+    takes the ledger lock *and* does I/O.  The safe shape is: record the
+    signum, ``Event.set()`` a pre-spawned parked runner, return.
+    Reachability comes from the signal-entry oracle (``signal.signal`` /
+    ``install_preemption_handler`` registrations, nested handler defs
+    included)."""
+
+    codes = ("TPL122",)
+
+    def check(self, mod: ModuleInfo, index: PackageIndex) -> Iterator[Finding]:
+        if mod.tree is None:
+            return
+        from tpumetrics.analysis import locks as _locks
+
+        model = _locks.lock_model(index)
+        funcs: List[FuncInfo] = list(mod.functions.values())
+        for ci in mod.classes.values():
+            funcs.extend(ci.methods.values())
+        scanned: Set[int] = set()
+        for fi in funcs:
+            yield from self._scan(fi, mod, index, model, scanned)
+        # nested defs registered as handlers (e.g. a `_handler` closed over
+        # by its installer) — walk enclosing functions for nested FunctionDefs
+        # that the oracle marked reachable
+        for fi in funcs:
+            for n in ast.walk(fi.node):
+                if (
+                    isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and n is not fi.node
+                    and id(n) in index.signal_reachable
+                ):
+                    nested = _nested_func_info(n, fi)
+                    yield from self._scan(nested, mod, index, model, scanned)
+
+    def _scan(
+        self,
+        fi: FuncInfo,
+        mod: ModuleInfo,
+        index: PackageIndex,
+        model: "object",
+        scanned: Set[int],
+    ) -> Iterator[Finding]:
+        if id(fi.node) not in index.signal_reachable or id(fi.node) in scanned:
+            return
+        scanned.add(id(fi.node))
+        root = index.signal_reachable[id(fi.node)]
+
+        def _finding(n: ast.AST, what: str, fix: str) -> Finding:
+            return Finding(
+                "TPL122",
+                f"{what} in signal-handler-reachable code ({root}): a handler "
+                "preempts an arbitrary frame, so "
+                f"{fix} Record the signum, `Event.set()` a pre-spawned parked "
+                "runner thread, and return.",
+                mod.path, n.lineno, n.col_offset, symbol=fi.qualname,
+            )
+
+        for site in model.acquisition_sites(fi, mod):  # type: ignore[attr-defined]
+            yield Finding(
+                "TPL122",
+                f"lock `{_short_lock(site.identity)}` acquired in signal-"
+                f"handler-reachable code ({root}): the interrupted thread may "
+                "hold it, and it can never release while the handler runs — "
+                "self-deadlock. Record the signum, `Event.set()` a pre-spawned "
+                "parked runner thread, and return.",
+                mod.path, site.line, site.col, symbol=fi.qualname,
+            )
+        for n in ast.walk(fi.node):
+            if not isinstance(n, ast.Call):
+                continue
+            dotted = _import_resolved_dotted(n.func, mod) or ""
+            tail = dotted.rpartition(".")[2]
+            if dotted in ("threading.Thread", "Thread"):
+                yield _finding(
+                    n, "`Thread(...)` constructed",
+                    "`Thread.start()` would take CPython's interpreter-level "
+                    "threading lock and deadlock against any in-flight start.",
+                )
+            elif isinstance(n.func, ast.Attribute) and n.func.attr == "start":
+                yield _finding(
+                    n, f"`{_truncate(n)}`",
+                    "`Thread.start()` takes CPython's interpreter-level "
+                    "threading lock and deadlocks against any in-flight start.",
+                )
+            elif (
+                dotted in _TPL122_BLOCKING_CALLS
+                or dotted.startswith(_TPL122_BLOCKING_PREFIXES)
+            ):
+                yield _finding(
+                    n, f"blocking call `{_truncate(n)}`",
+                    "blocking I/O stalls the entire interrupted thread.",
+                )
+            elif (
+                ".telemetry.ledger" in dotted
+                or (
+                    tail in _TPL122_LEDGER_TAILS
+                    and dotted.startswith("tpumetrics.")
+                )
+            ):
+                yield _finding(
+                    n, f"ledger write `{_truncate(n)}`",
+                    "the ledger write takes the ledger lock and appends to "
+                    "sinks (I/O) — both forbidden in a handler.",
+                )
+
+
+def _nested_func_info(node: ast.AST, outer: FuncInfo) -> FuncInfo:
+    """FuncInfo for a nested def (a closure handler) — owner carried from
+    the enclosing function so ``self.<lock>`` still resolves."""
+    from tpumetrics.analysis.core import _func_info
+
+    return _func_info(node, outer.modname, outer.owner)
+
+
+#: blocking calls TPL123 rejects while a declared lock is held
+_TPL123_BLOCKING_CALLS = {"jax.device_get", "jax.block_until_ready", "time.sleep"}
+_TPL123_BLOCKING_METHODS = {"item", "tolist", "block_until_ready"}
+_TPL123_OPEN_CALLS = {"open", "io.open"}
+_TPL123_BLOCKING_PREFIXES = (
+    "requests.", "urllib.request.", "http.client.", "subprocess.",
+)
+
+
+class BlockingUnderLockRule:
+    """TPL123: a blocking call while a declared lock is held.
+
+    Every other reader and writer of that lock inherits the block: a
+    device sync under the evaluator lock stalls `submit()` on another
+    thread for the duration of an in-flight dispatch (the PR-15 `stats()`
+    bug, fixed there with bounded acquisition + a cached snapshot — this
+    rule generalizes that one call site to the whole repo).  Flagged while
+    holding ANY declared lock, bounded spans included (the timeout caps
+    the *acquisition* wait, not the time the holder then sits on the lock).
+    ``Condition.wait()`` is exempt — it releases the lock while parked —
+    as is a ``.wait()`` whose receiver resolves to a held condition/lock;
+    an ``Event.wait()`` (which releases nothing) is flagged."""
+
+    codes = ("TPL123",)
+
+    def check(self, mod: ModuleInfo, index: PackageIndex) -> Iterator[Finding]:
+        if mod.tree is None:
+            return
+        from tpumetrics.analysis import locks as _locks
+
+        model = _locks.lock_model(index)
+        funcs: List[FuncInfo] = list(mod.functions.values())
+        for ci in mod.classes.values():
+            funcs.extend(ci.methods.values())
+        for fi in funcs:
+            spans = model.held_spans(fi, mod)
+            if not spans:
+                continue
+            for n in ast.walk(fi.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                held = model.held_at(fi, mod, n.lineno)
+                if not held:
+                    continue
+                what = self._blocking(n, fi, mod, model, held)
+                if what is None:
+                    continue
+                lock = sorted(held)[0]
+                yield Finding(
+                    "TPL123",
+                    f"{what} while holding `{_short_lock(lock)}`: every other "
+                    "reader/writer of that lock inherits the stall. Move the "
+                    "blocking work outside the critical section, or serve a "
+                    "cached snapshot (the bounded-lock stats() discipline).",
+                    mod.path, n.lineno, n.col_offset, symbol=fi.qualname,
+                )
+
+    def _blocking(
+        self,
+        n: ast.Call,
+        fi: FuncInfo,
+        mod: ModuleInfo,
+        model: "object",
+        held: Set[str],
+    ) -> Optional[str]:
+        dotted = _import_resolved_dotted(n.func, mod) or ""
+        if dotted in _TPL123_BLOCKING_CALLS:
+            return f"blocking call `{_truncate(n)}`"
+        if dotted in _TPL123_OPEN_CALLS:
+            return f"file I/O `{_truncate(n)}`"
+        if dotted.startswith(_TPL123_BLOCKING_PREFIXES):
+            return f"network/subprocess call `{_truncate(n)}`"
+        if isinstance(n.func, ast.Attribute):
+            attr = n.func.attr
+            if attr in _TPL123_BLOCKING_METHODS:
+                return f"blocking device read `{_truncate(n)}`"
+            if attr == "wait":
+                # Condition.wait releases the held lock while parked — exempt
+                # when the receiver resolves to a held lock/condition; an
+                # Event.wait (releases nothing) or unknown receiver is flagged
+                ident = model.resolve(n.func.value, fi, mod)  # type: ignore[attr-defined]
+                if ident is None or ident not in held:
+                    return f"`{_truncate(n)}`"
+        return None
+
+
 RULES = [
     TraceSafetyRule(),
     HostTelemetryRule(),
@@ -2083,6 +2582,10 @@ RULES = [
     RoutingEpochRule(),
     BareDurabilityWriteRule(),
     ServingLayerRule(),
+    LockOrderRule(),
+    GuardedAttrRule(),
+    SignalSafetyRule(),
+    BlockingUnderLockRule(),
     StateDeclRule(),
     ShadowStateRule(),
     PartitionRuleDeclRule(),

@@ -181,6 +181,7 @@ class BackboneHandle:
             # the fetch runs under the registry lock: parking is a rare
             # control-plane transition, and serializing it against
             # reacquire() keeps stash-vs-placed states impossible to race
+            # tpulint: disable-next=TPL123 -- deliberate (comment above): parking is a rare control-plane transition, and fetching under the registry lock is what makes stash-vs-placed states impossible to race with reacquire()
             self._host_params = jax.device_get(self.params)
             self.params = None
         _device.release_profiles(self.label)

@@ -898,6 +898,7 @@ class EvaluationService:
                     break
                 # hibernating / reviving: the transition owner notifies the
                 # residency condition when it completes — wait it out
+                # tpulint: disable-next=TPL123 -- mgr._cond wraps THIS service's _lock (Condition(service._lock), manager.py), so wait() releases the held lock while parked; the cross-object alias is beyond the static resolver
                 mgr._cond.wait()
             tenant.migrating = True
         if mode != "live":
